@@ -1,0 +1,25 @@
+"""cilium-tpu: a TPU-native network-policy and flow-analytics framework.
+
+A ground-up rebuild of the capabilities of Cilium's per-packet hot path
+(reference: ``bpf/bpf_lxc.c`` verdict pipeline + ``pkg/hubble`` flow
+parsing + the Go control plane under ``pkg/policy`` / ``pkg/identity`` /
+``pkg/ipcache``) as a batched header-tensor pipeline under JAX/XLA/Pallas.
+
+Layer map (mirrors SURVEY.md §1, re-drawn TPU-first):
+
+- ``core``      packet/header tensor schema, pcap ingest (host side)
+- ``ops``       pallas/XLA kernels: policy gather, LPM, conntrack hash
+- ``datapath``  the verdict pipeline + Loader seam (tpu / interpreter)
+- ``policy``    rule schema, repository, selector cache, MapState compiler
+- ``identity``  label->numeric identity allocation, reserved identities
+- ``ipcache``   IP/CIDR -> identity store, compiled to DIR-24-8 tensors
+- ``flow``      hubble-equivalent: threefour parser, observer, metrics
+- ``monitor``   event vocabulary (drop/trace/policy-verdict) + agent
+- ``models``    learned flow classifier (embedding from identity labels)
+- ``parallel``  device-mesh sharding of batch + replicated tables
+- ``kvstore``   in-memory kvstore + distributed allocator
+- ``api``/``cli`` REST-ish control API and cilium-style CLI
+- ``utils``     controller/trigger/eventqueue/logging/metrics/config
+"""
+
+__version__ = "0.1.0"

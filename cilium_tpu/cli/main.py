@@ -1,0 +1,36 @@
+"""cilium-tpu CLI (reference: cilium/cmd cobra CLI).
+
+Verbs mirror the reference operator tooling: ``policy import|get``,
+``endpoint list``, ``bpf policy get``, ``bpf ct list``, ``monitor``,
+``status``.  Grows alongside the agent; verbs not yet wired report so
+explicitly instead of failing cryptically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="cilium-tpu",
+        description="TPU-native network policy + flow analytics CLI",
+    )
+    sub = parser.add_subparsers(dest="cmd")
+    sub.add_parser("status", help="agent status")
+    sub.add_parser("version", help="print version")
+    args = parser.parse_args(argv)
+    if args.cmd == "version":
+        from .. import __version__
+        print(f"cilium-tpu {__version__}")
+        return 0
+    if args.cmd == "status":
+        print("agent: not running (standalone CLI) — see cilium_tpu.api")
+        return 0
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,139 @@
+"""Identity allocation: label set -> cluster-unique numeric identity.
+
+Reference: upstream cilium ``pkg/identity/cache``
+(``CachingIdentityAllocator``) on top of ``pkg/allocator`` — ref-counted,
+kvstore-backed, collision-free allocation with reserved identities
+pre-registered and CIDR identities allocated from a node-local scope.
+
+The kvstore backend here is the in-process one from
+``cilium_tpu.kvstore``; in a multi-host deployment the same interface is
+served by the jax.distributed-backed store (the ClusterMesh analogue).
+
+Observers (e.g. the policy SelectorCache and the datapath's
+IdentityRowMap) register callbacks fired on add/remove so incremental
+identity churn propagates to device tensors without a full recompile.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..labels import Label, LabelSet, SOURCE_CIDR
+from .identity import (
+    Identity,
+    LOCAL_IDENTITY_FLAG,
+    MIN_ALLOCATED,
+    MAX_ALLOCATED,
+    RESERVED_BY_LABELS,
+    RESERVED_LABELSETS,
+)
+
+IdentityChangeFn = Callable[[str, Identity], None]  # kind: "add"|"remove"
+
+
+class CachingIdentityAllocator:
+    """Ref-counted label-set -> identity allocator with observers."""
+
+    def __init__(self, backend=None, min_id: int = MIN_ALLOCATED,
+                 max_id: int = MAX_ALLOCATED):
+        # backend: optional kvstore-like .allocate(key)->int shared across
+        # "nodes"; None = purely local allocation.
+        self._backend = backend
+        self._lock = threading.RLock()
+        self._by_labels: Dict[str, Identity] = {}
+        self._by_id: Dict[int, Identity] = {}
+        self._refcount: Dict[int, int] = {}
+        self._observers: List[IdentityChangeFn] = []
+        self._next_id = min_id
+        self._max_id = max_id
+        self._next_local = LOCAL_IDENTITY_FLAG | 1
+        for num, ls in RESERVED_LABELSETS.items():
+            ident = Identity(num, ls)
+            self._by_labels[ls.sorted_key()] = ident
+            self._by_id[num] = ident
+            self._refcount[num] = 1  # pinned
+
+    # -- observer fan-out (reference: identity Observer / events) --------
+    def observe(self, fn: IdentityChangeFn) -> None:
+        with self._lock:
+            self._observers.append(fn)
+            for ident in self._by_id.values():
+                fn("add", ident)
+
+    def _notify(self, kind: str, ident: Identity) -> None:
+        for fn in list(self._observers):
+            fn(kind, ident)
+
+    # -- allocation ------------------------------------------------------
+    def allocate(self, labels: LabelSet) -> Identity:
+        """Allocate (or ref) the identity for a label set."""
+        key = labels.sorted_key()
+        with self._lock:
+            if key in RESERVED_BY_LABELS:
+                return self._by_labels[key]
+            ident = self._by_labels.get(key)
+            if ident is not None:
+                self._refcount[ident.numeric_id] += 1
+                return ident
+            local = any(l.source == SOURCE_CIDR for l in labels)
+            if local:
+                num = self._next_local
+                self._next_local += 1
+            elif self._backend is not None:
+                num = self._backend.allocate(key)
+            else:
+                if self._next_id >= self._max_id:
+                    raise RuntimeError("identity space exhausted")
+                num = self._next_id
+                self._next_id += 1
+            ident = Identity(num, labels)
+            self._by_labels[key] = ident
+            self._by_id[num] = ident
+            self._refcount[num] = 1
+            self._notify("add", ident)
+            return ident
+
+    def allocate_cidr(self, cidr: str) -> Identity:
+        """Allocate a node-local identity for a CIDR (toCIDR / fqdn flows).
+
+        Reference: pkg/identity CIDR-derived local identities; labels are
+        ``cidr:<prefix>`` plus ``reserved:world``.
+        """
+        net = ipaddress.ip_network(cidr, strict=False)
+        labels = LabelSet(
+            [Label(SOURCE_CIDR, str(net)), Label("reserved", "world")]
+        )
+        return self.allocate(labels)
+
+    def release(self, ident: Identity) -> bool:
+        """Deref; returns True when the identity was freed."""
+        with self._lock:
+            num = ident.numeric_id
+            if num in RESERVED_LABELSETS:
+                return False
+            if num not in self._refcount:
+                return False  # unknown or already freed — no-op
+            cnt = self._refcount[num] - 1
+            if cnt > 0:
+                self._refcount[num] = cnt
+                return False
+            self._refcount.pop(num, None)
+            self._by_id.pop(num, None)
+            self._by_labels.pop(ident.labels.sorted_key(), None)
+            self._notify("remove", ident)
+            return True
+
+    # -- lookup ----------------------------------------------------------
+    def lookup_by_id(self, numeric_id: int) -> Optional[Identity]:
+        with self._lock:
+            return self._by_id.get(numeric_id)
+
+    def lookup_by_labels(self, labels: LabelSet) -> Optional[Identity]:
+        with self._lock:
+            return self._by_labels.get(labels.sorted_key())
+
+    def all_identities(self) -> List[Identity]:
+        with self._lock:
+            return list(self._by_id.values())

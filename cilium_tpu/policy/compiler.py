@@ -1,0 +1,248 @@
+"""MapState -> dense device tensors (the "policymap" of the TPU datapath).
+
+Reference: upstream cilium ``pkg/maps/policymap`` (the kernel-side
+policy map the agent syncs MapState into) and ``bpf/lib/policy.h``'s
+lookup.  TPU-first redesign: instead of a sparse hash map probed with
+wildcard fallbacks, ALL precedence (deny > redirect > allow > default,
+L3-only vs L4 wildcards) is resolved at **compile time** on the host
+into a dense verdict tensor, so the device hot path is two gathers:
+
+    class   = port_class[proto_idx, dport]          # [N_PROTO, 65536]
+    packed  = verdict[policy_row, dir, id_row, class]
+
+``packed`` (int32) encodes ``verdict | proxy_port << 8``.
+
+Identity axis: numeric identities are remapped to dense rows by
+:class:`IdentityRowMap` (row 0 = unknown), with power-of-two capacity
+headroom so identity churn patches rows instead of reshaping tensors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..identity import Identity
+from .mapstate import (
+    Contribution,
+    MapState,
+    N_PROTO,
+    PROTO_ANY,
+    PROTO_ICMP,
+    PROTO_OTHER,
+    PROTO_SCTP,
+    PROTO_TCP,
+    PROTO_UDP,
+    VERDICT_ALLOW,
+    VERDICT_DEFAULT_DENY,
+    VERDICT_DENY,
+    VERDICT_REDIRECT,
+)
+from .resolve import EndpointPolicy
+
+VERDICT_MASK = 0xFF
+PROXY_SHIFT = 8
+
+
+def pack_entry(verdict: int, proxy_port: int = 0) -> int:
+    return (verdict & VERDICT_MASK) | (proxy_port << PROXY_SHIFT)
+
+
+def unpack_verdict(packed: np.ndarray) -> np.ndarray:
+    return packed & VERDICT_MASK
+
+
+def unpack_proxy(packed: np.ndarray) -> np.ndarray:
+    return packed >> PROXY_SHIFT
+
+
+def make_proto_table() -> np.ndarray:
+    """IP protocol number -> dense proto index (device lookup table)."""
+    t = np.full(256, PROTO_OTHER, dtype=np.int32)
+    t[6] = PROTO_TCP
+    t[17] = PROTO_UDP
+    t[1] = PROTO_ICMP
+    t[58] = PROTO_ICMP  # ICMPv6 shares the ICMP class space
+    t[132] = PROTO_SCTP
+    return t
+
+
+class IdentityRowMap:
+    """Numeric identity <-> dense device row, with capacity headroom.
+
+    Row 0 is pinned to numeric identity 0 (unknown/invalid), so an
+    ipcache miss naturally lands on the wildcard-only policy row.
+    """
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = capacity
+        self._num_to_row: Dict[int, int] = {0: 0}
+        self._row_to_num = np.zeros(capacity, dtype=np.int64)
+        self._next = 1
+
+    def add(self, numeric_id: int) -> int:
+        row = self._num_to_row.get(numeric_id)
+        if row is not None:
+            return row
+        if self._next >= self.capacity:
+            self._grow()
+        row = self._next
+        self._next += 1
+        self._num_to_row[numeric_id] = row
+        self._row_to_num[row] = numeric_id
+        return row
+
+    def _grow(self) -> None:
+        self.capacity *= 2
+        grown = np.zeros(self.capacity, dtype=np.int64)
+        grown[: len(self._row_to_num)] = self._row_to_num
+        self._row_to_num = grown
+
+    def row(self, numeric_id: int) -> int:
+        return self._num_to_row.get(numeric_id, 0)
+
+    def numeric(self, row: int) -> int:
+        return int(self._row_to_num[row]) if 0 <= row < self.capacity else 0
+
+    def rows_for(self, ids: Iterable[int]) -> np.ndarray:
+        rows = [self._num_to_row[i] for i in ids if i in self._num_to_row]
+        return np.asarray(sorted(rows), dtype=np.int32)
+
+    @property
+    def n_rows(self) -> int:
+        return self._next
+
+    def numeric_array(self) -> np.ndarray:
+        """Device-side row -> numeric identity table (for event decode)."""
+        return self._row_to_num.copy()
+
+
+@dataclass
+class PolicyTensors:
+    """The compiled device policy state (all host-side numpy; the
+    datapath uploads them as jax arrays)."""
+
+    proto_table: np.ndarray  # [256] int32: ip proto -> dense proto
+    port_class: np.ndarray  # [N_PROTO, 65536] int32: dport -> class
+    n_classes: int
+    verdict: np.ndarray  # [n_pol, 2, n_rows, n_classes_padded] int32
+    policy_index: Dict[str, int]  # subject labels key -> policy row
+    row_map: IdentityRowMap
+    class_intervals: Dict[int, List[Tuple[int, int, int]]] = field(
+        default_factory=dict)  # proto -> [(lo, hi_excl, class_id)]
+
+    def policy_row(self, subject_key: str) -> int:
+        return self.policy_index[subject_key]
+
+    # NumPy reference of the device lookup — used by CPU tests and as
+    # executable documentation of the gather semantics.
+    def lookup_np(self, policy_row: np.ndarray, direction: np.ndarray,
+                  id_row: np.ndarray, ip_proto: np.ndarray,
+                  dport: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        proto = self.proto_table[ip_proto]
+        cls = self.port_class[proto, dport]
+        packed = self.verdict[policy_row, direction, id_row, cls]
+        return unpack_verdict(packed), unpack_proxy(packed)
+
+
+def _collect_boundaries(policies: Sequence[EndpointPolicy]
+                        ) -> Dict[int, np.ndarray]:
+    """Per-proto sorted boundary sets partitioning [0, 65536)."""
+    bounds: Dict[int, set] = {p: {0, 65536} for p in range(N_PROTO)}
+    for pol in policies:
+        for ms in (pol.ingress, pol.egress):
+            for c in ms.contributions:
+                protos = (range(N_PROTO) if c.proto == PROTO_ANY
+                          else [c.proto])
+                for p in protos:
+                    bounds[p].add(c.lo)
+                    bounds[p].add(c.hi + 1)
+    return {p: np.asarray(sorted(x for x in b if 0 <= x <= 65536),
+                          dtype=np.int64)
+            for p, b in bounds.items()}
+
+
+def compile_policy(
+    policies: Sequence[EndpointPolicy],
+    row_map: IdentityRowMap,
+    class_pad: int = 128,
+) -> PolicyTensors:
+    """Compile resolved endpoint policies into dense device tensors.
+
+    O(contributions x touched-rows) via vectorized numpy scatters; the
+    10k-identity benchmark set compiles in milliseconds.
+    """
+    # Ensure every identity referenced by any contribution has a row.
+    for pol in policies:
+        for ms in (pol.ingress, pol.egress):
+            for c in ms.contributions:
+                if c.identities:
+                    for i in c.identities:
+                        row_map.add(i)
+
+    bounds = _collect_boundaries(policies)
+    port_class = np.zeros((N_PROTO, 65536), dtype=np.int32)
+    class_intervals: Dict[int, List[Tuple[int, int, int]]] = {}
+    next_class = 0
+    for p in range(N_PROTO):
+        b = bounds[p]
+        intervals = []
+        for lo, hi in zip(b[:-1], b[1:]):
+            port_class[p, lo:hi] = next_class
+            intervals.append((int(lo), int(hi), next_class))
+            next_class += 1
+        class_intervals[p] = intervals
+    n_classes = next_class
+    n_classes_padded = -(-n_classes // class_pad) * class_pad
+
+    n_rows = row_map.capacity
+    n_pol = len(policies)
+    verdict = np.zeros((n_pol, 2, n_rows, n_classes_padded), dtype=np.int32)
+    policy_index: Dict[str, int] = {}
+
+    def classes_for(proto: int, lo: int, hi: int) -> np.ndarray:
+        return np.unique(port_class[proto, lo:hi + 1])
+
+    for pi, pol in enumerate(policies):
+        policy_index[pol.subject_labels.sorted_key()] = pi
+        for di, ms in ((0, pol.ingress), (1, pol.egress)):
+            default = (pack_entry(VERDICT_DEFAULT_DENY) if ms.enforcing
+                       else pack_entry(VERDICT_ALLOW))
+            verdict[pi, di, :, :] = default
+            plain = [c for c in ms.contributions
+                     if not c.is_deny and not c.redirect]
+            # reversed: oracle gives the FIRST covering redirect's proxy
+            # port; last writer wins in the scatter.
+            redirs = [c for c in reversed(ms.contributions)
+                      if c.redirect and not c.is_deny]
+            denies = [c for c in ms.contributions if c.is_deny]
+            for group, value_of in (
+                (plain, lambda c: pack_entry(VERDICT_ALLOW)),
+                (redirs, lambda c: pack_entry(VERDICT_REDIRECT,
+                                              c.proxy_port)),
+                (denies, lambda c: pack_entry(VERDICT_DENY)),
+            ):
+                for c in group:
+                    protos = (range(N_PROTO) if c.proto == PROTO_ANY
+                              else [c.proto])
+                    cls = np.unique(np.concatenate(
+                        [classes_for(p, c.lo, c.hi) for p in protos]))
+                    val = value_of(c)
+                    if c.identities is None:
+                        verdict[pi, di][:, cls] = val
+                    else:
+                        rows = row_map.rows_for(c.identities)
+                        if rows.size:
+                            verdict[pi, di][np.ix_(rows, cls)] = val
+
+    return PolicyTensors(
+        proto_table=make_proto_table(),
+        port_class=port_class,
+        n_classes=n_classes,
+        verdict=verdict,
+        policy_index=policy_index,
+        row_map=row_map,
+        class_intervals=class_intervals,
+    )

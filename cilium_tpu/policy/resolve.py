@@ -1,0 +1,221 @@
+"""Policy resolution: repository rules + subject labels -> EndpointPolicy.
+
+Reference: upstream cilium ``pkg/policy/resolve.go`` (``ResolvePolicy``
+producing an ``EndpointPolicy`` whose ``MapState`` holds the desired
+policy-map entries) and ``pkg/policy/l4.go`` (``L4Filter`` expansion of
+peer selectors x port specs).
+
+Expansion rules (mirroring the reference's L4Filter semantics):
+
+- a rule with no ``toPorts`` grants all protocols/ports (one PROTO_ANY
+  contribution covering every dense proto, including OTHER);
+- ``toPorts`` with protocol ANY expands to TCP+UDP+SCTP (port rules
+  never cover ICMP/OTHER);
+- peer sets are the union of fromEndpoints/toEndpoints selections (via
+  SelectorCache), entity selectors, and CIDR-derived local identities;
+- an L7 section on an allow turns it into a proxy REDIRECT.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import FrozenSet, List, Optional, Sequence, Tuple
+
+from ..labels import Label, LabelSet, SOURCE_RESERVED
+from ..identity.allocator import CachingIdentityAllocator
+from .api import (
+    CIDRRule,
+    ENTITY_ALL,
+    ENTITY_CLUSTER,
+    ENTITY_SELECTORS,
+    EgressRule,
+    EndpointSelector,
+    IngressRule,
+    PortRule,
+    Rule,
+)
+from .mapstate import (
+    Contribution,
+    DIR_EGRESS,
+    DIR_INGRESS,
+    MapState,
+    PROTO_ANY,
+    PROTO_BY_NAME,
+    PROTO_ICMP,
+    PROTO_SCTP,
+    PROTO_TCP,
+    PROTO_UDP,
+)
+from .selectorcache import SelectorCache
+
+# Base port for proxy redirect allocation (reference: pkg/proxy port
+# allocator range).
+PROXY_PORT_BASE = 10000
+
+
+@dataclass
+class EndpointPolicy:
+    """Resolved policy for one subject identity (shared across endpoints
+    with the same identity — reference: pkg/policy/distillery.go
+    ``SelectorPolicy``/``PolicyCache``)."""
+
+    subject_labels: LabelSet
+    revision: int
+    ingress: MapState
+    egress: MapState
+    redirects: List[Tuple[int, str]] = field(default_factory=list)
+
+    def mapstate(self, direction: int) -> MapState:
+        return self.ingress if direction == DIR_INGRESS else self.egress
+
+    def lookup(self, direction: int, identity: int, proto: int,
+               port: int) -> Tuple[int, int]:
+        return self.mapstate(direction).lookup(identity, proto, port)
+
+
+def _peer_identities(
+    selectors: Sequence[EndpointSelector],
+    cidrs: Sequence[CIDRRule],
+    entities: Sequence[str],
+    selector_cache: SelectorCache,
+    allocator: CachingIdentityAllocator,
+    fqdns: Sequence[str] = (),
+) -> Optional[FrozenSet[int]]:
+    """None == wildcard peer (no L3 constraint)."""
+    if not selectors and not cidrs and not entities and not fqdns:
+        return None
+    ids: set = set()
+    for sel in selectors:
+        ids |= selector_cache.selections(sel)
+    for ent in entities:
+        if ent in (ENTITY_ALL,):
+            return None
+        if ent == ENTITY_CLUSTER:
+            # cluster = every non-world identity (reference: entity
+            # "cluster" covers all cluster-managed endpoints + host).
+            world = Label(SOURCE_RESERVED, "world")
+            ids |= {
+                i.numeric_id for i in selector_cache.known_identities()
+                if not i.labels.has(world)
+            }
+            continue
+        sel = ENTITY_SELECTORS.get(ent)
+        if sel is None:
+            raise ValueError(f"unknown entity {ent!r}")
+        ids |= selector_cache.selections(sel)
+    for c in cidrs:
+        ident = allocator.allocate_cidr(c.cidr)
+        ids.add(ident.numeric_id)
+        # 'except' CIDRs allocate identities too so the ipcache can carve
+        # them out; they are excluded from this peer set.
+        for exc in c.except_cidrs:
+            allocator.allocate_cidr(exc)
+    # toFQDNs select identities carrying an fqdn:<name> label — created
+    # by the DNS-proxy subsystem (reference: pkg/fqdn) as lookups are
+    # observed.  Before any DNS activity the set is empty (deny), never
+    # a wildcard.
+    for name in fqdns:
+        sel = EndpointSelector.from_labels(f"fqdn:{name}")
+        ids |= selector_cache.selections(sel)
+    return frozenset(ids)
+
+
+def _port_specs(to_ports: Sequence[PortRule]) -> List[Tuple[int, int, int, bool]]:
+    """Expand toPorts into (dense_proto, lo, hi, has_l7) tuples."""
+    if not to_ports:
+        return [(PROTO_ANY, 0, 65535, False)]
+    out: List[Tuple[int, int, int, bool]] = []
+    for pr in to_ports:
+        has_l7 = not pr.rules.is_empty
+        ports = pr.ports or ()
+        if not ports:
+            if has_l7:
+                # an L7 section without ports still only applies to
+                # port-bearing protocols — never ICMP/OTHER
+                for p in (PROTO_TCP, PROTO_UDP, PROTO_SCTP):
+                    out.append((p, 0, 65535, True))
+            else:
+                out.append((PROTO_ANY, 0, 65535, False))
+            continue
+        for pp in ports:
+            lo, hi = pp.port_range()
+            proto = PROTO_BY_NAME.get(pp.protocol, PROTO_ANY)
+            if proto == PROTO_ANY:
+                for p in (PROTO_TCP, PROTO_UDP, PROTO_SCTP):
+                    out.append((p, lo, hi, has_l7))
+            else:
+                out.append((proto, lo, hi, has_l7))
+    return out
+
+
+def resolve_policy(
+    rules: Sequence[Rule],
+    subject_labels: LabelSet,
+    selector_cache: SelectorCache,
+    allocator: CachingIdentityAllocator,
+    revision: int = 0,
+) -> EndpointPolicy:
+    """Resolve the rule set down to per-direction MapStates for a subject."""
+    ing = MapState(direction=DIR_INGRESS, enforcing=False)
+    egr = MapState(direction=DIR_EGRESS, enforcing=False)
+    redirects: List[Tuple[int, str]] = []
+    next_proxy = PROXY_PORT_BASE
+
+    for rule in rules:
+        if not rule.endpoint_selector.matches(subject_labels):
+            continue
+        if rule.enables_ingress:
+            ing.enforcing = True
+        if rule.enables_egress:
+            egr.enforcing = True
+        label = ",".join(rule.labels) or rule.description
+
+        def emit(ms: MapState, peers: Optional[FrozenSet[int]],
+                 to_ports, is_deny: bool) -> None:
+            nonlocal next_proxy
+            for proto, lo, hi, has_l7 in _port_specs(to_ports):
+                redirect = has_l7 and not is_deny
+                proxy_port = 0
+                if redirect:
+                    proxy_port = next_proxy
+                    next_proxy += 1
+                    redirects.append((proxy_port, label))
+                ms.contributions.append(Contribution(
+                    is_deny=is_deny,
+                    identities=peers,
+                    proto=proto,
+                    lo=lo,
+                    hi=hi,
+                    redirect=redirect,
+                    proxy_port=proxy_port,
+                    rule_label=label,
+                ))
+
+        for r in rule.ingress:
+            peers = _peer_identities(r.from_endpoints, r.from_cidr,
+                                     r.from_entities, selector_cache,
+                                     allocator)
+            emit(ing, peers, r.to_ports, is_deny=False)
+        for r in rule.ingress_deny:
+            peers = _peer_identities(r.from_endpoints, r.from_cidr,
+                                     r.from_entities, selector_cache,
+                                     allocator)
+            emit(ing, peers, r.to_ports, is_deny=True)
+        for r in rule.egress:
+            peers = _peer_identities(r.to_endpoints, r.to_cidr,
+                                     r.to_entities, selector_cache,
+                                     allocator, fqdns=r.to_fqdns)
+            emit(egr, peers, r.to_ports, is_deny=False)
+        for r in rule.egress_deny:
+            peers = _peer_identities(r.to_endpoints, r.to_cidr,
+                                     r.to_entities, selector_cache,
+                                     allocator, fqdns=r.to_fqdns)
+            emit(egr, peers, r.to_ports, is_deny=True)
+
+    return EndpointPolicy(
+        subject_labels=subject_labels,
+        revision=revision,
+        ingress=ing,
+        egress=egr,
+        redirects=redirects,
+    )

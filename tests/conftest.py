@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+All unit tests run on CPU with 8 virtual devices so that multi-chip
+sharding code paths (``jax.sharding.Mesh`` + ``shard_map``/``pjit``) are
+exercised without TPU hardware, mirroring the reference's strategy of
+testing multi-node control-plane logic with fake datapaths and in-memory
+kvstores (SURVEY.md §4).
+
+This file MUST set the environment before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,80 @@
+"""Label + identity allocator tests (reference: pkg/labels, pkg/identity)."""
+
+from cilium_tpu.labels import Label, LabelSet
+from cilium_tpu.identity import (
+    CachingIdentityAllocator,
+    ID_HOST,
+    ID_WORLD,
+    LOCAL_IDENTITY_FLAG,
+    is_reserved,
+)
+
+
+def test_label_parse():
+    l = Label.parse("k8s:app=frontend")
+    assert (l.source, l.key, l.value) == ("k8s", "app", "frontend")
+    l = Label.parse("app=frontend")
+    assert (l.source, l.key, l.value) == ("unspec", "app", "frontend")
+    l = Label.parse("reserved:host")
+    assert (l.source, l.key, l.value) == ("reserved", "host", "")
+    # '=' before ':' means the ':' is part of the value
+    l = Label.parse("key=va:lue")
+    assert (l.source, l.key, l.value) == ("unspec", "key", "va:lue")
+
+
+def test_labelset_canonical_order():
+    a = LabelSet.parse("k8s:app=web", "k8s:tier=db")
+    b = LabelSet.parse("k8s:tier=db", "k8s:app=web")
+    assert a.sorted_key() == b.sorted_key()
+    assert a == b
+
+
+def test_any_source_matching():
+    endpoint = LabelSet.parse("k8s:app=web")
+    assert endpoint.has(Label("any", "app", "web"))
+    assert not endpoint.has(Label("container", "app", "web"))
+
+
+def test_reserved_identities():
+    alloc = CachingIdentityAllocator()
+    host = alloc.lookup_by_id(ID_HOST)
+    assert host is not None and host.labels.has(Label("any", "host"))
+    world = alloc.allocate(LabelSet.parse("reserved:world"))
+    assert world.numeric_id == ID_WORLD
+
+
+def test_allocate_same_labels_same_identity():
+    alloc = CachingIdentityAllocator()
+    a = alloc.allocate(LabelSet.parse("k8s:app=web", "k8s:io.kubernetes.pod.namespace=default"))
+    b = alloc.allocate(LabelSet.parse("k8s:io.kubernetes.pod.namespace=default", "k8s:app=web"))
+    assert a.numeric_id == b.numeric_id
+    assert a.numeric_id >= 256
+    assert not is_reserved(a.numeric_id)
+
+
+def test_release_refcount():
+    alloc = CachingIdentityAllocator()
+    ls = LabelSet.parse("k8s:app=x")
+    a = alloc.allocate(ls)
+    alloc.allocate(ls)
+    assert not alloc.release(a)  # still referenced
+    assert alloc.release(a)  # freed now
+    assert alloc.lookup_by_labels(ls) is None
+
+
+def test_cidr_identity_is_local():
+    alloc = CachingIdentityAllocator()
+    ident = alloc.allocate_cidr("10.0.0.0/8")
+    assert ident.numeric_id & LOCAL_IDENTITY_FLAG
+    again = alloc.allocate_cidr("10.0.0.0/8")
+    assert again.numeric_id == ident.numeric_id
+
+
+def test_observer_sees_existing_and_new():
+    alloc = CachingIdentityAllocator()
+    seen = []
+    alloc.observe(lambda kind, i: seen.append((kind, i.numeric_id)))
+    assert ("add", ID_HOST) in seen
+    n_before = len(seen)
+    alloc.allocate(LabelSet.parse("k8s:app=new"))
+    assert len(seen) == n_before + 1

@@ -1,0 +1,135 @@
+"""Compiler-vs-oracle equivalence: the dense tensors must reproduce the
+MapState oracle exactly (the in-repo analogue of the eBPF verdict-
+divergence gate in BASELINE.md — gated at 0% here)."""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.labels import LabelSet
+from cilium_tpu.identity import CachingIdentityAllocator
+from cilium_tpu.policy import (
+    IdentityRowMap,
+    PolicyRepository,
+    compile_policy,
+)
+from cilium_tpu.policy.mapstate import N_PROTO, IP_PROTO_NUMBERS
+
+DB = LabelSet.parse("k8s:app=db")
+WEB = LabelSet.parse("k8s:app=web")
+
+RULES = [
+    {
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [
+            {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+             "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+            {"fromEndpoints": [{"matchLabels": {"tier": "cache"}}]},
+            {"toPorts": [{"ports": [{"port": "443", "protocol": "TCP"}]}]},
+            {"fromCIDR": ["10.1.0.0/16"],
+             "toPorts": [{"ports": [{"port": "8000", "endPort": 8999,
+                                     "protocol": "ANY"}]}]},
+            {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+             "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}],
+                          "rules": {"http": [{"method": "GET"}]}}]},
+        ],
+        "ingressDeny": [
+            {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+             "toPorts": [{"ports": [{"port": "22", "protocol": "TCP"}]}]},
+        ],
+        "egress": [
+            {"toEntities": ["world"],
+             "toPorts": [{"ports": [{"port": "53", "protocol": "UDP"}]}]},
+        ],
+    },
+    {
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [
+            {"toEndpoints": [{"matchLabels": {"app": "db"}}]},
+        ],
+    },
+]
+
+
+@pytest.fixture
+def setup():
+    alloc = CachingIdentityAllocator()
+    repo = PolicyRepository(alloc)
+    # a spread of identities, some matching, some not
+    for i in range(40):
+        alloc.allocate(LabelSet.parse(f"k8s:app=svc{i}", "k8s:ns=default"))
+    alloc.allocate(WEB)
+    alloc.allocate(DB)
+    alloc.allocate(LabelSet.parse("k8s:tier=cache"))
+    repo.add_obj(RULES)
+    policies = [repo.resolve(DB), repo.resolve(WEB)]
+    row_map = IdentityRowMap(capacity=256)
+    for ident in alloc.all_identities():
+        row_map.add(ident.numeric_id)
+    tensors = compile_policy(policies, row_map)
+    return repo, policies, tensors, row_map
+
+
+def test_tensor_matches_oracle_exhaustive_classes(setup):
+    """Check every (identity-row, proto, class-representative-port)."""
+    repo, policies, tensors, row_map = setup
+    rng = np.random.default_rng(0)
+    numerics = [row_map.numeric(r) for r in range(row_map.n_rows)]
+    for pi, pol in enumerate(policies):
+        for di in (0, 1):
+            ms = pol.mapstate(di)
+            for proto in range(N_PROTO):
+                for (lo, hi, cls) in tensors.class_intervals[proto]:
+                    # representative ports: ends + a random interior point
+                    ports = {lo, hi - 1}
+                    if hi - lo > 2:
+                        ports.add(int(rng.integers(lo, hi)))
+                    for port in ports:
+                        for row, numeric in enumerate(numerics):
+                            want_v, want_p = ms.lookup(numeric, proto, port)
+                            packed = tensors.verdict[pi, di, row, cls]
+                            got_v = packed & 0xFF
+                            got_p = packed >> 8
+                            assert got_v == want_v, (
+                                pi, di, numeric, proto, port)
+                            if want_v == 3:
+                                assert got_p == want_p
+
+
+def test_lookup_np_random_packets(setup):
+    repo, policies, tensors, row_map = setup
+    rng = np.random.default_rng(1)
+    n = 5000
+    pol_rows = rng.integers(0, len(policies), n)
+    dirs = rng.integers(0, 2, n)
+    rows = rng.integers(0, row_map.n_rows, n)
+    ip_protos = rng.choice([6, 17, 1, 132, 47, 50], n)  # incl GRE/ESP
+    ports = rng.integers(0, 65536, n)
+    got_v, got_p = tensors.lookup_np(pol_rows, dirs, rows,
+                                     ip_protos, ports)
+    proto_dense = tensors.proto_table[ip_protos]
+    for i in range(n):
+        pol = policies[pol_rows[i]]
+        numeric = row_map.numeric(int(rows[i]))
+        want_v, want_p = pol.mapstate(int(dirs[i])).lookup(
+            numeric, int(proto_dense[i]), int(ports[i]))
+        assert got_v[i] == want_v, i
+        if want_v == 3:
+            assert got_p[i] == want_p
+
+
+def test_unknown_identity_row0(setup):
+    repo, policies, tensors, row_map = setup
+    # row 0 = unknown identity: only wildcard rules apply
+    v, _ = tensors.lookup_np(np.array([0]), np.array([0]), np.array([0]),
+                             np.array([6]), np.array([443]))
+    assert v[0] == 1  # L4-only wildcard-peer allow on 443/TCP
+    v, _ = tensors.lookup_np(np.array([0]), np.array([0]), np.array([0]),
+                             np.array([6]), np.array([5432]))
+    assert v[0] == 0  # no wildcard coverage -> default deny
+
+
+def test_proto_table():
+    from cilium_tpu.policy.compiler import make_proto_table
+    t = make_proto_table()
+    assert t[6] == 0 and t[17] == 1 and t[1] == 2 and t[132] == 3
+    assert t[47] == 4  # GRE -> OTHER

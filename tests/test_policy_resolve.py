@@ -1,0 +1,256 @@
+"""Golden policy-resolution tests.
+
+Modeled on upstream cilium ``pkg/policy``'s resolve/repository/mapstate
+tests (SURVEY.md §4): construct rule sets + identities in memory and
+assert the resolved verdicts — no datapath needed.
+"""
+
+import pytest
+
+from cilium_tpu.labels import LabelSet
+from cilium_tpu.identity import CachingIdentityAllocator, ID_WORLD, ID_HOST
+from cilium_tpu.policy import (
+    DIR_EGRESS,
+    DIR_INGRESS,
+    PROTO_ICMP,
+    PROTO_OTHER,
+    PROTO_TCP,
+    PROTO_UDP,
+    PolicyRepository,
+    VERDICT_ALLOW,
+    VERDICT_DEFAULT_DENY,
+    VERDICT_DENY,
+    VERDICT_REDIRECT,
+    rules_from_obj,
+)
+
+WEB = LabelSet.parse("k8s:app=web")
+DB = LabelSet.parse("k8s:app=db")
+OTHER = LabelSet.parse("k8s:app=other")
+
+
+@pytest.fixture
+def repo():
+    alloc = CachingIdentityAllocator()
+    r = PolicyRepository(alloc)
+    return r
+
+
+def setup_ids(repo):
+    alloc = repo.allocator
+    return {
+        "web": alloc.allocate(WEB).numeric_id,
+        "db": alloc.allocate(DB).numeric_id,
+        "other": alloc.allocate(OTHER).numeric_id,
+    }
+
+
+L3_L4_RULE = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }],
+    "labels": ["db-ingress"],
+}]
+
+
+def test_l3_l4_allow(repo):
+    ids = setup_ids(repo)
+    repo.add_obj(L3_L4_RULE)
+    pol = repo.resolve(DB)
+    # web -> db:5432/TCP allowed
+    v, _ = pol.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, 5432)
+    assert v == VERDICT_ALLOW
+    # wrong port denied (default-deny engaged)
+    v, _ = pol.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, 80)
+    assert v == VERDICT_DEFAULT_DENY
+    # wrong proto denied
+    v, _ = pol.lookup(DIR_INGRESS, ids["web"], PROTO_UDP, 5432)
+    assert v == VERDICT_DEFAULT_DENY
+    # other identity denied
+    v, _ = pol.lookup(DIR_INGRESS, ids["other"], PROTO_TCP, 5432)
+    assert v == VERDICT_DEFAULT_DENY
+    # egress unaffected: no egress rules -> default allow
+    v, _ = pol.lookup(DIR_EGRESS, ids["other"], PROTO_TCP, 1)
+    assert v == VERDICT_ALLOW
+
+
+def test_non_selected_endpoint_default_allow(repo):
+    ids = setup_ids(repo)
+    repo.add_obj(L3_L4_RULE)
+    pol = repo.resolve(WEB)  # rule selects db, not web
+    v, _ = pol.lookup(DIR_INGRESS, ids["other"], PROTO_TCP, 22)
+    assert v == VERDICT_ALLOW
+
+
+def test_l3_only_rule_allows_all_ports(repo):
+    ids = setup_ids(repo)
+    repo.add_obj([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{"fromEndpoints": [{"matchLabels": {"app": "web"}}]}],
+    }])
+    pol = repo.resolve(DB)
+    for proto, port in [(PROTO_TCP, 80), (PROTO_UDP, 53), (PROTO_ICMP, 8),
+                        (PROTO_OTHER, 0)]:
+        v, _ = pol.lookup(DIR_INGRESS, ids["web"], proto, port)
+        assert v == VERDICT_ALLOW, (proto, port)
+    v, _ = pol.lookup(DIR_INGRESS, ids["other"], PROTO_TCP, 80)
+    assert v == VERDICT_DEFAULT_DENY
+
+
+def test_l4_only_wildcard_peer(repo):
+    ids = setup_ids(repo)
+    repo.add_obj([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{"toPorts": [{"ports": [{"port": "443",
+                                             "protocol": "TCP"}]}]}],
+    }])
+    pol = repo.resolve(DB)
+    # anyone can reach 443/TCP, including world
+    for ident in (ids["web"], ids["other"], ID_WORLD, 0):
+        v, _ = pol.lookup(DIR_INGRESS, ident, PROTO_TCP, 443)
+        assert v == VERDICT_ALLOW
+    v, _ = pol.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, 444)
+    assert v == VERDICT_DEFAULT_DENY
+
+
+def test_deny_takes_precedence(repo):
+    ids = setup_ids(repo)
+    repo.add_obj([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{"fromEndpoints": [{}]}],  # allow all endpoints
+        "ingressDeny": [{
+            "fromEndpoints": [{"matchLabels": {"app": "other"}}],
+        }],
+    }])
+    pol = repo.resolve(DB)
+    v, _ = pol.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, 80)
+    assert v == VERDICT_ALLOW
+    v, _ = pol.lookup(DIR_INGRESS, ids["other"], PROTO_TCP, 80)
+    assert v == VERDICT_DENY
+
+
+def test_deny_narrow_port_within_broad_allow(repo):
+    ids = setup_ids(repo)
+    repo.add_obj([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{"fromEndpoints": [{"matchLabels": {"app": "web"}}]}],
+        "ingressDeny": [{
+            "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+            "toPorts": [{"ports": [{"port": "22", "protocol": "TCP"}]}],
+        }],
+    }])
+    pol = repo.resolve(DB)
+    v, _ = pol.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, 80)
+    assert v == VERDICT_ALLOW
+    v, _ = pol.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, 22)
+    assert v == VERDICT_DENY
+    v, _ = pol.lookup(DIR_INGRESS, ids["web"], PROTO_UDP, 22)
+    assert v == VERDICT_ALLOW
+
+
+def test_port_range(repo):
+    ids = setup_ids(repo)
+    repo.add_obj([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{
+            "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+            "toPorts": [{"ports": [{"port": "8000", "endPort": 8999,
+                                    "protocol": "TCP"}]}],
+        }],
+    }])
+    pol = repo.resolve(DB)
+    for port, want in [(7999, VERDICT_DEFAULT_DENY), (8000, VERDICT_ALLOW),
+                       (8500, VERDICT_ALLOW), (8999, VERDICT_ALLOW),
+                       (9000, VERDICT_DEFAULT_DENY)]:
+        v, _ = pol.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, port)
+        assert v == want, port
+
+
+def test_proto_any_expands_to_tcp_udp_sctp(repo):
+    ids = setup_ids(repo)
+    repo.add_obj([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{
+            "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+            "toPorts": [{"ports": [{"port": "53", "protocol": "ANY"}]}],
+        }],
+    }])
+    pol = repo.resolve(DB)
+    assert pol.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, 53)[0] == VERDICT_ALLOW
+    assert pol.lookup(DIR_INGRESS, ids["web"], PROTO_UDP, 53)[0] == VERDICT_ALLOW
+    # port rules never cover ICMP/OTHER
+    assert pol.lookup(DIR_INGRESS, ids["web"], PROTO_ICMP, 53)[0] == VERDICT_DEFAULT_DENY
+    assert pol.lookup(DIR_INGRESS, ids["web"], PROTO_OTHER, 53)[0] == VERDICT_DEFAULT_DENY
+
+
+def test_l7_redirect(repo):
+    ids = setup_ids(repo)
+    repo.add_obj([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{
+            "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+            "toPorts": [{
+                "ports": [{"port": "80", "protocol": "TCP"}],
+                "rules": {"http": [{"method": "GET", "path": "/public"}]},
+            }],
+        }],
+    }])
+    pol = repo.resolve(DB)
+    v, proxy = pol.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, 80)
+    assert v == VERDICT_REDIRECT
+    assert proxy >= 10000
+    assert pol.redirects
+
+
+def test_entities_and_cidr(repo):
+    ids = setup_ids(repo)
+    repo.add_obj([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [
+            {"fromEntities": ["host"]},
+            {"fromCIDR": ["192.168.0.0/16"],
+             "toPorts": [{"ports": [{"port": "9000", "protocol": "TCP"}]}]},
+        ],
+    }])
+    pol = repo.resolve(DB)
+    assert pol.lookup(DIR_INGRESS, ID_HOST, PROTO_TCP, 1)[0] == VERDICT_ALLOW
+    cidr_id = repo.allocator.allocate_cidr("192.168.0.0/16").numeric_id
+    assert pol.lookup(DIR_INGRESS, cidr_id, PROTO_TCP, 9000)[0] == VERDICT_ALLOW
+    assert pol.lookup(DIR_INGRESS, cidr_id, PROTO_TCP, 9001)[0] == VERDICT_DEFAULT_DENY
+
+
+def test_match_expressions(repo):
+    alloc = repo.allocator
+    a = alloc.allocate(LabelSet.parse("k8s:env=prod", "k8s:app=a"))
+    b = alloc.allocate(LabelSet.parse("k8s:env=dev", "k8s:app=b"))
+    repo.add_obj([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{
+            "fromEndpoints": [{
+                "matchExpressions": [
+                    {"key": "env", "operator": "In", "values": ["prod"]},
+                ],
+            }],
+        }],
+    }])
+    pol = repo.resolve(DB)
+    assert pol.lookup(DIR_INGRESS, a.numeric_id, PROTO_TCP, 1)[0] == VERDICT_ALLOW
+    assert pol.lookup(DIR_INGRESS, b.numeric_id, PROTO_TCP, 1)[0] == VERDICT_DEFAULT_DENY
+
+
+def test_revision_bumps_and_cache_invalidation(repo):
+    ids = setup_ids(repo)
+    rev0 = repo.revision
+    repo.add_obj(L3_L4_RULE)
+    assert repo.revision == rev0 + 1
+    pol1 = repo.resolve(DB)
+    pol2 = repo.resolve(DB)
+    assert pol1 is pol2  # distillery cache hit
+    repo.delete_by_labels(["db-ingress"])
+    pol3 = repo.resolve(DB)
+    assert pol3 is not pol1
+    # rule gone: default allow again
+    v, _ = pol3.lookup(DIR_INGRESS, ids["web"], PROTO_TCP, 5432)
+    assert v == VERDICT_ALLOW
