@@ -1,0 +1,33 @@
+"""Header-tensor core: the packet representation of the TPU datapath.
+
+Reference: upstream cilium's per-packet context is a ``struct __sk_buff``
+parsed in ``bpf/lib/ipv4.h``/``l4.h``; here packets are rows of a fixed
+[N, N_COLS] uint32 tensor so the whole datapath runs batched on the MXU.
+"""
+
+from .packets import (  # noqa: F401
+    COL_DIR,
+    COL_DPORT,
+    COL_DST_IP0,
+    COL_DST_IP3,
+    COL_EP,
+    COL_FAMILY,
+    COL_FLAGS,
+    COL_LEN,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP0,
+    COL_SRC_IP3,
+    N_COLS,
+    TCP_ACK,
+    TCP_FIN,
+    TCP_PSH,
+    TCP_RST,
+    TCP_SYN,
+    HeaderBatch,
+    ip_to_words,
+    make_batch,
+    synth_batch,
+    words_to_ip,
+)
+from .pcap import read_pcap, write_pcap  # noqa: F401

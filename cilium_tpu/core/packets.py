@@ -1,0 +1,173 @@
+"""Packet header tensor schema + synthetic traffic generation.
+
+Reference: upstream cilium parses each packet in-kernel
+(``bpf/lib/ipv4.h``, ``bpf/lib/ipv6.h``, ``bpf/lib/l4.h``) into a
+5-tuple + flags used by conntrack and policy.  TPU-first redesign: a
+*batch* of packets is one ``[N, N_COLS] uint32`` tensor ("header
+tensor"); every datapath stage is a vectorized op over the batch axis.
+
+Column layout (all uint32):
+
+====  ==========  =====================================================
+col   name        contents
+====  ==========  =====================================================
+0-3   SRC_IP0-3   128-bit source IP, 4 big-endian words.  IPv4 lives in
+                  word 3 (words 0-2 zero), i.e. IPv4-mapped layout.
+4-7   DST_IP0-3   128-bit destination IP, same layout.
+8     SPORT       L4 source port (0 when the proto has no ports)
+9     DPORT       L4 destination port / ICMP type
+10    PROTO       IP protocol number (6 TCP, 17 UDP, 1 ICMP, ...)
+11    FLAGS       TCP flags byte (0 otherwise)
+12    LEN         IP total length in bytes
+13    FAMILY      4 or 6
+14    EP          local endpoint id (dense row; which policy applies)
+15    DIR         0 ingress / 1 egress (relative to endpoint EP)
+====  ==========  =====================================================
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+COL_SRC_IP0 = 0
+COL_SRC_IP3 = 3
+COL_DST_IP0 = 4
+COL_DST_IP3 = 7
+COL_SPORT = 8
+COL_DPORT = 9
+COL_PROTO = 10
+COL_FLAGS = 11
+COL_LEN = 12
+COL_FAMILY = 13
+COL_EP = 14
+COL_DIR = 15
+N_COLS = 16
+
+TCP_FIN = 0x01
+TCP_SYN = 0x02
+TCP_RST = 0x04
+TCP_PSH = 0x08
+TCP_ACK = 0x10
+
+IPAddr = Union[str, int, ipaddress.IPv4Address, ipaddress.IPv6Address]
+
+
+def ip_to_words(ip: IPAddr) -> Tuple[int, int, int, int]:
+    """IP address -> 4 big-endian uint32 words (IPv4 in word 3)."""
+    addr = ipaddress.ip_address(ip)
+    n = int(addr)
+    if addr.version == 4:
+        return (0, 0, 0, n)
+    return ((n >> 96) & 0xFFFFFFFF, (n >> 64) & 0xFFFFFFFF,
+            (n >> 32) & 0xFFFFFFFF, n & 0xFFFFFFFF)
+
+
+def words_to_ip(words: Sequence[int], family: int = 4) -> str:
+    if family == 4:
+        return str(ipaddress.IPv4Address(int(words[3])))
+    n = (int(words[0]) << 96) | (int(words[1]) << 64) | \
+        (int(words[2]) << 32) | int(words[3])
+    return str(ipaddress.IPv6Address(n))
+
+
+@dataclass
+class HeaderBatch:
+    """A batch of parsed packet headers (host-side view of the tensor)."""
+
+    data: np.ndarray  # [N, N_COLS] uint32
+
+    def __post_init__(self):
+        assert self.data.ndim == 2 and self.data.shape[1] == N_COLS
+        self.data = np.ascontiguousarray(self.data, dtype=np.uint32)
+
+    def __len__(self) -> int:
+        return self.data.shape[0]
+
+    def col(self, c: int) -> np.ndarray:
+        return self.data[:, c]
+
+    def describe(self, i: int) -> str:
+        r = self.data[i]
+        fam = int(r[COL_FAMILY])
+        return (f"{words_to_ip(r[COL_SRC_IP0:COL_SRC_IP3 + 1], fam)}:"
+                f"{r[COL_SPORT]} -> "
+                f"{words_to_ip(r[COL_DST_IP0:COL_DST_IP3 + 1], fam)}:"
+                f"{r[COL_DPORT]} proto={r[COL_PROTO]} "
+                f"flags={r[COL_FLAGS]:#x} len={r[COL_LEN]} "
+                f"ep={r[COL_EP]} dir={'egress' if r[COL_DIR] else 'ingress'}")
+
+
+def make_batch(rows: Sequence[dict]) -> HeaderBatch:
+    """Build a HeaderBatch from dicts: {src, dst, sport, dport, proto,
+    flags, length, ep, dir}.  ``src``/``dst`` accept any IP form."""
+    out = np.zeros((len(rows), N_COLS), dtype=np.uint32)
+    for i, r in enumerate(rows):
+        sw = ip_to_words(r.get("src", 0))
+        dw = ip_to_words(r.get("dst", 0))
+        fam = 6 if (sw[:3] != (0, 0, 0) or dw[:3] != (0, 0, 0)
+                    or r.get("family") == 6) else 4
+        out[i, COL_SRC_IP0:COL_SRC_IP3 + 1] = sw
+        out[i, COL_DST_IP0:COL_DST_IP3 + 1] = dw
+        out[i, COL_SPORT] = r.get("sport", 0)
+        out[i, COL_DPORT] = r.get("dport", 0)
+        out[i, COL_PROTO] = r.get("proto", 6)
+        out[i, COL_FLAGS] = r.get("flags", TCP_SYN if r.get("proto", 6) == 6
+                                  else 0)
+        out[i, COL_LEN] = r.get("length", 64)
+        out[i, COL_FAMILY] = r.get("family", fam)
+        out[i, COL_EP] = r.get("ep", 0)
+        out[i, COL_DIR] = r.get("dir", 0)
+    return HeaderBatch(out)
+
+
+def synth_batch(
+    n: int,
+    rng: Optional[np.random.Generator] = None,
+    n_hosts: int = 256,
+    subnet: int = 0x0A000000,  # 10.0.0.0
+    dports: Optional[np.ndarray] = None,
+    protos: Optional[np.ndarray] = None,
+    ep: int = 0,
+    direction: int = 0,
+) -> HeaderBatch:
+    """Synthesize a plausible IPv4 traffic batch (the benchmark's
+    packet-gen; reference analogue: bpf/tests crafted packets)."""
+    rng = rng or np.random.default_rng(0)
+    out = np.zeros((n, N_COLS), dtype=np.uint32)
+    src = subnet + rng.integers(1, n_hosts + 1, n, dtype=np.uint32)
+    dst = subnet + rng.integers(1, n_hosts + 1, n, dtype=np.uint32)
+    out[:, COL_SRC_IP3] = src
+    out[:, COL_DST_IP3] = dst
+    out[:, COL_SPORT] = rng.integers(1024, 61000, n, dtype=np.uint32)
+    if dports is None:
+        out[:, COL_DPORT] = rng.choice(
+            np.array([80, 443, 8080, 53, 22, 5432], dtype=np.uint32), n)
+    else:
+        out[:, COL_DPORT] = rng.choice(dports.astype(np.uint32), n)
+    if protos is None:
+        out[:, COL_PROTO] = rng.choice(
+            np.array([6, 6, 6, 17, 1], dtype=np.uint32), n)
+    else:
+        out[:, COL_PROTO] = rng.choice(protos.astype(np.uint32), n)
+    is_tcp = out[:, COL_PROTO] == 6
+    out[:, COL_FLAGS] = np.where(
+        is_tcp,
+        rng.choice(np.array([TCP_SYN, TCP_ACK, TCP_ACK | TCP_PSH],
+                            dtype=np.uint32), n),
+        0,
+    )
+    out[:, COL_SPORT] = np.where(out[:, COL_PROTO] == 1, 0,
+                                 out[:, COL_SPORT])
+    out[:, COL_DPORT] = np.where(
+        out[:, COL_PROTO] == 1,
+        rng.integers(0, 2, n, dtype=np.uint32) * 8,  # echo req/reply
+        out[:, COL_DPORT])
+    out[:, COL_LEN] = rng.integers(60, 1500, n, dtype=np.uint32)
+    out[:, COL_FAMILY] = 4
+    out[:, COL_EP] = ep
+    out[:, COL_DIR] = direction
+    return HeaderBatch(out)

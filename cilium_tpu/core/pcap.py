@@ -1,0 +1,191 @@
+"""pcap ingest/egest: raw capture files <-> header tensors.
+
+Reference: upstream cilium's bpf test harness crafts packets as byte
+arrays (``bpf/tests``) and Hubble replays captures; here a classic
+libpcap file parses straight into the ``[N, N_COLS]`` header tensor
+(the datapath's wire format), and a HeaderBatch can be written back out
+as a valid pcap for interop with tcpdump/wireshark.
+
+Pure Python (struct) — this is the control-plane ingest path; the bulk
+benchmark path synthesizes batches directly on-host (core.packets) or
+on-device.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .packets import (
+    COL_DIR,
+    COL_DPORT,
+    COL_DST_IP0,
+    COL_EP,
+    COL_FAMILY,
+    COL_FLAGS,
+    COL_LEN,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP0,
+    N_COLS,
+    HeaderBatch,
+)
+
+PCAP_MAGIC = 0xA1B2C3D4
+PCAP_MAGIC_SWAPPED = 0xD4C3B2A1
+LINKTYPE_ETHERNET = 1
+LINKTYPE_RAW = 101
+
+ETH_P_IP = 0x0800
+ETH_P_IPV6 = 0x86DD
+
+
+def _parse_l4(proto: int, payload: bytes) -> Tuple[int, int, int]:
+    """Return (sport, dport, tcp_flags)."""
+    if proto in (6, 17, 132) and len(payload) >= 4:
+        sport, dport = struct.unpack_from("!HH", payload, 0)
+        flags = payload[13] if proto == 6 and len(payload) >= 14 else 0
+        return sport, dport, flags
+    if proto in (1, 58) and len(payload) >= 2:
+        return 0, payload[0], 0  # ICMP: dport column carries the type
+    return 0, 0, 0
+
+
+def _parse_ip(pkt: bytes
+              ) -> Optional[Tuple[int, bytes, bytes, int, bytes, int]]:
+    """Parse an IP packet -> (family, src16, dst16, proto, l4payload,
+    ip_total_len).  ``ip_total_len`` is the header-declared IP length
+    (the COL_LEN schema value), not the captured frame length."""
+    if len(pkt) < 20:
+        return None
+    ver = pkt[0] >> 4
+    if ver == 4:
+        ihl = (pkt[0] & 0xF) * 4
+        if len(pkt) < ihl:
+            return None
+        proto = pkt[9]
+        total = struct.unpack_from("!H", pkt, 2)[0]
+        src = b"\x00" * 12 + pkt[12:16]
+        dst = b"\x00" * 12 + pkt[16:20]
+        return 4, src, dst, proto, pkt[ihl:], total
+    if ver == 6 and len(pkt) >= 40:
+        proto = pkt[6]
+        payload_len = struct.unpack_from("!H", pkt, 4)[0]
+        return 6, pkt[8:24], pkt[24:40], proto, pkt[40:], 40 + payload_len
+    return None
+
+
+def read_pcap(path: str, ep: int = 0, direction: int = 0) -> HeaderBatch:
+    """Parse a pcap file into a HeaderBatch (non-IP frames are skipped)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < 24:
+        return HeaderBatch(np.zeros((0, N_COLS), dtype=np.uint32))
+    magic = struct.unpack_from("<I", data, 0)[0]
+    if magic == PCAP_MAGIC:
+        endian = "<"
+    elif magic == PCAP_MAGIC_SWAPPED:
+        endian = ">"
+    else:
+        raise ValueError(f"{path}: not a pcap file (magic {magic:#x})")
+    linktype = struct.unpack_from(endian + "I", data, 20)[0]
+    rows: List[np.ndarray] = []
+    off = 24
+    while off + 16 <= len(data):
+        _, _, caplen, origlen = struct.unpack_from(endian + "IIII", data, off)
+        off += 16
+        frame = data[off:off + caplen]
+        off += caplen
+        if linktype == LINKTYPE_ETHERNET:
+            if len(frame) < 14:
+                continue
+            ethertype = struct.unpack_from("!H", frame, 12)[0]
+            # skip VLAN tags
+            l3off = 14
+            while ethertype in (0x8100, 0x88A8) and len(frame) >= l3off + 4:
+                ethertype = struct.unpack_from("!H", frame, l3off + 2)[0]
+                l3off += 4
+            if ethertype not in (ETH_P_IP, ETH_P_IPV6):
+                continue
+            ip = frame[l3off:]
+        elif linktype == LINKTYPE_RAW:
+            ip = frame
+        else:
+            continue
+        parsed = _parse_ip(ip)
+        if parsed is None:
+            continue
+        fam, src, dst, proto, l4, ip_len = parsed
+        sport, dport, flags = _parse_l4(proto, l4)
+        row = np.zeros(N_COLS, dtype=np.uint32)
+        row[COL_SRC_IP0:COL_SRC_IP0 + 4] = np.frombuffer(
+            src, dtype=">u4").astype(np.uint32)
+        row[COL_DST_IP0:COL_DST_IP0 + 4] = np.frombuffer(
+            dst, dtype=">u4").astype(np.uint32)
+        row[COL_SPORT] = sport
+        row[COL_DPORT] = dport
+        row[COL_PROTO] = proto
+        row[COL_FLAGS] = flags
+        row[COL_LEN] = ip_len
+        row[COL_FAMILY] = fam
+        row[COL_EP] = ep
+        row[COL_DIR] = direction
+        rows.append(row)
+    if not rows:
+        return HeaderBatch(np.zeros((0, N_COLS), dtype=np.uint32))
+    return HeaderBatch(np.stack(rows))
+
+
+def write_pcap(path: str, batch: HeaderBatch) -> None:
+    """Write a HeaderBatch as a LINKTYPE_RAW pcap (synthetic payloads)."""
+    out = bytearray()
+    out += struct.pack("<IHHiIII", PCAP_MAGIC, 2, 4, 0, 0, 65535,
+                       LINKTYPE_RAW)
+    for i in range(len(batch)):
+        r = batch.data[i]
+        fam = int(r[COL_FAMILY])
+        proto = int(r[COL_PROTO])
+        # declare the batch's LEN in the IP header (truncated capture
+        # style: caplen < origlen) so read_pcap round-trips COL_LEN
+        if fam == 4:
+            total = max(int(r[COL_LEN]), 20 + _l4_len(proto))
+            ip = struct.pack("!BBHHHBBH4s4s",
+                             0x45, 0, total, i & 0xFFFF, 0, 64, proto, 0,
+                             int(r[COL_SRC_IP0 + 3]).to_bytes(4, "big"),
+                             int(r[COL_DST_IP0 + 3]).to_bytes(4, "big"))
+            origlen = total
+        else:
+            src = b"".join(int(r[COL_SRC_IP0 + j]).to_bytes(4, "big")
+                           for j in range(4))
+            dst = b"".join(int(r[COL_DST_IP0 + j]).to_bytes(4, "big")
+                           for j in range(4))
+            origlen = max(int(r[COL_LEN]), 40 + _l4_len(proto))
+            ip = struct.pack("!IHBB16s16s", 0x60000000, origlen - 40,
+                             proto, 64, src, dst)
+        ip += _l4_bytes(proto, int(r[COL_SPORT]), int(r[COL_DPORT]),
+                        int(r[COL_FLAGS]))
+        out += struct.pack("<IIII", 0, 0, len(ip), max(len(ip), origlen))
+        out += ip
+    with open(path, "wb") as f:
+        f.write(bytes(out))
+
+
+def _l4_len(proto: int) -> int:
+    if proto == 6:
+        return 20
+    if proto in (17, 132, 1, 58):
+        return 8
+    return 0
+
+
+def _l4_bytes(proto: int, sport: int, dport: int, flags: int) -> bytes:
+    if proto == 6:
+        return struct.pack("!HHIIBBHHH", sport, dport, 0, 0, 0x50, flags,
+                           65535, 0, 0)
+    if proto in (17, 132):
+        return struct.pack("!HHHH", sport, dport, 8, 0)
+    if proto in (1, 58):
+        return struct.pack("!BBHI", dport, 0, 0, 0)
+    return b""

@@ -1,0 +1,38 @@
+"""TPU datapath: the eBPF hot path as batched JAX kernels.
+
+Reference: upstream cilium ``bpf/`` (bpf_lxc.c + bpf/lib) and
+``pkg/datapath``.  See ``verdict.datapath_step`` for the fused
+pipeline and ``loader.Loader`` for the agent-facing seam.
+"""
+
+from .conntrack import (  # noqa: F401
+    CT_ESTABLISHED,
+    CT_NEW,
+    CT_RELATED,
+    CT_REPLY,
+    CTTable,
+    ct_gc,
+    ct_keys_from_headers,
+    ct_lookup,
+    ct_update,
+)
+from .lpm import DeviceLPM, LPMTensors, compile_lpm, lpm_lookup  # noqa: F401
+from .verdict import (  # noqa: F401
+    EV_DROP,
+    EV_TRACE,
+    EV_VERDICT,
+    OUT_CT,
+    OUT_EVENT,
+    OUT_ID_ROW,
+    OUT_PROXY,
+    OUT_REASON,
+    OUT_VERDICT,
+    REASON_FORWARDED,
+    REASON_POLICY_DEFAULT_DENY,
+    REASON_POLICY_DENY,
+    DatapathState,
+    DevicePolicy,
+    build_state,
+    datapath_step,
+    datapath_step_jit,
+)

@@ -1,0 +1,294 @@
+"""Connection tracking: fixed-capacity open-addressing hash in HBM.
+
+Reference: upstream cilium ``bpf/lib/conntrack.h`` (``ct_lookup4/6``,
+``ct_create4/6``, TCP state handling, per-proto lifetimes) and
+``pkg/maps/ctmap`` (GC).  TPU-first redesign: the kernel's per-packet
+hash probe becomes a **batched** probe — every packet in the header
+tensor probes concurrently via gathers; inserts use a vectorized
+write-then-verify claim (scatter the whole row, re-gather the key,
+check who won) instead of a CAS loop, giving lock-free semantics
+across the batch.  Key and value words live in ONE row of one table so
+an insert is a single scatter — no torn entries between concurrent
+claimants of the same slot.
+
+Static shapes: capacity is fixed at construction (power of two); a full
+probe window drops new inserts (counted, like the reference's CT map
+pressure) rather than reallocating.  Aging is a vectorized sweep
+(``ctmap.GC``); expired entries are lookup misses immediately and their
+slots are reclaimable by inserts.
+
+Known deliberate divergences from eBPF (documented for the divergence
+suite): duplicate tuples in one batch collapse to one entry with
+last-writer counters (the kernel, processing serially, would count
+both; the accounting delta is bounded by batch size and reconciled at
+the flow layer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.packets import (
+    COL_DPORT,
+    COL_DST_IP0,
+    COL_FLAGS,
+    COL_LEN,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP0,
+    TCP_FIN,
+    TCP_RST,
+)
+
+# Lookup results (reference: bpf/lib/common.h CT_* codes).
+CT_NEW = 0
+CT_ESTABLISHED = 1
+CT_REPLY = 2
+CT_RELATED = 3
+
+# Entry states stored in the table.
+ST_FREE = 0
+ST_SYN_SENT = 1  # open, no reply seen yet
+ST_ESTABLISHED = 2
+ST_CLOSING = 3  # FIN/RST seen
+
+# Lifetimes in seconds (reference: bpf CT_CONNECTION_LIFETIME_TCP/
+# NONTCP, CT_SYN_TIMEOUT, CT_CLOSE_TIMEOUT defaults).
+LIFETIME_TCP = 21600
+LIFETIME_NONTCP = 60
+LIFETIME_SYN = 60
+LIFETIME_CLOSE = 10
+
+KEY_WORDS = 10  # src[4] dst[4] ports proto
+N_PROBE = 16  # linear probe window
+
+# value columns (offsets within the combined row, after the key words)
+V_STATE = KEY_WORDS + 0
+V_EXPIRES = KEY_WORDS + 1
+V_TX_PKTS = KEY_WORDS + 2
+V_RX_PKTS = KEY_WORDS + 3
+V_TX_BYTES = KEY_WORDS + 4
+V_RX_BYTES = KEY_WORDS + 5
+V_PROXY = KEY_WORDS + 6  # proxy redirect port (reference: proxy_redirect)
+ROW_WORDS = KEY_WORDS + 7
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class CTTable:
+    """Device CT state (a pytree threading functionally through jit)."""
+
+    table: jnp.ndarray  # [C, ROW_WORDS] uint32
+    dropped: jnp.ndarray  # [] uint32 — failed inserts (map pressure)
+
+    @staticmethod
+    def create(capacity: int = 1 << 20) -> "CTTable":
+        assert capacity & (capacity - 1) == 0, "capacity must be 2^k"
+        return CTTable(
+            table=jnp.zeros((capacity, ROW_WORDS), dtype=jnp.uint32),
+            dropped=jnp.zeros((), dtype=jnp.uint32),
+        )
+
+    @property
+    def capacity(self) -> int:
+        return self.table.shape[0]
+
+    def tree_flatten(self):
+        return ((self.table, self.dropped), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def ct_keys_from_headers(hdr: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Header tensor [N, N_COLS] -> (forward, reverse) CT key tensors.
+
+    The key carries the hook direction like the reference's
+    ``TUPLE_F_OUT``/``TUPLE_F_IN`` (word 9 = proto | dir << 8), so an
+    egress-created entry never satisfies an ingress lookup of the same
+    5-tuple on another endpoint.  The reverse (reply) key flips both
+    the tuple AND the direction bit — a reply to an ingress-created
+    flow is seen at the egress hook (reference:
+    ``ipv4_ct_tuple_reverse``).  ICMP zeroes the port word so echo
+    request/reply share a tuple modulo the swap.
+    """
+    from ..core.packets import COL_DIR
+
+    src = hdr[:, COL_SRC_IP0:COL_SRC_IP0 + 4].astype(jnp.uint32)
+    dst = hdr[:, COL_DST_IP0:COL_DST_IP0 + 4].astype(jnp.uint32)
+    proto = hdr[:, COL_PROTO].astype(jnp.uint32)
+    dirn = hdr[:, COL_DIR].astype(jnp.uint32)
+    is_icmp = (proto == 1) | (proto == 58)
+    sport = jnp.where(is_icmp, 0, hdr[:, COL_SPORT]).astype(jnp.uint32)
+    dport = jnp.where(is_icmp, 0, hdr[:, COL_DPORT]).astype(jnp.uint32)
+    fwd_ports = (sport << 16) | dport
+    rev_ports = (dport << 16) | sport
+    fwd_pd = proto | (dirn << 8)
+    rev_pd = proto | ((1 - dirn) << 8)
+    fwd = jnp.concatenate(
+        [src, dst, fwd_ports[:, None], fwd_pd[:, None]], axis=1)
+    rev = jnp.concatenate(
+        [dst, src, rev_ports[:, None], rev_pd[:, None]], axis=1)
+    return fwd, rev
+
+
+def _hash(keys: jnp.ndarray) -> jnp.ndarray:
+    """FNV-1a over the key words: [N, KEY_WORDS] uint32 -> [N] uint32."""
+    h = jnp.full(keys.shape[0], 0x811C9DC5, dtype=jnp.uint32)
+    for w in range(KEY_WORDS):
+        h = (h ^ keys[:, w]) * jnp.uint32(0x01000193)
+    return h
+
+
+def _probe(table: jnp.ndarray, keys: jnp.ndarray, now: jnp.ndarray
+           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Probe the window for each key: -> (found [N] bool, slot [N] i32).
+
+    Expired entries don't match (an expired entry is a miss; GC frees
+    the slot later, and inserts may reclaim it immediately)."""
+    mask = table.shape[0] - 1
+    h = _hash(keys)
+    found = jnp.zeros(keys.shape[0], dtype=bool)
+    slot = jnp.zeros(keys.shape[0], dtype=jnp.int32)
+    for step in range(N_PROBE):
+        s = ((h + step) & mask).astype(jnp.int32)
+        row = table[s]  # [N, ROW_WORDS]
+        live = (row[:, V_STATE] != ST_FREE) & (row[:, V_EXPIRES] >= now)
+        match = live & jnp.all(row[:, :KEY_WORDS] == keys, axis=1)
+        slot = jnp.where(match & ~found, s, slot)
+        found = found | match
+    return found, slot
+
+
+def ct_lookup(ct: CTTable, fwd: jnp.ndarray, rev: jnp.ndarray,
+              now: jnp.ndarray
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Batched ``ct_lookup4`` equivalent.
+
+    Returns (result [N] int32 in CT_*, slot [N] int32, is_reply [N]
+    bool).  ``slot`` is valid only where result != CT_NEW.
+    """
+    f_found, f_slot = _probe(ct.table, fwd, now)
+    r_found, r_slot = _probe(ct.table, rev, now)
+    is_reply = ~f_found & r_found
+    slot = jnp.where(f_found, f_slot, r_slot)
+    result = jnp.where(f_found, CT_ESTABLISHED,
+                       jnp.where(is_reply, CT_REPLY, CT_NEW))
+    return result.astype(jnp.int32), slot, is_reply
+
+
+def ct_update(ct: CTTable, hdr: jnp.ndarray, fwd: jnp.ndarray,
+              result: jnp.ndarray, slot: jnp.ndarray,
+              is_reply: jnp.ndarray, do_create: jnp.ndarray,
+              proxy_port: jnp.ndarray, now: jnp.ndarray) -> CTTable:
+    """Refresh hit entries, apply the TCP state machine, insert NEW.
+
+    ``do_create`` marks NEW packets whose policy verdict allowed them
+    (reference: ``ct_create4`` is called on the allow path only).
+    """
+    proto = hdr[:, COL_PROTO].astype(jnp.uint32)
+    flags = hdr[:, COL_FLAGS].astype(jnp.uint32)
+    length = hdr[:, COL_LEN].astype(jnp.uint32)
+    is_tcp = proto == 6
+    closing = is_tcp & ((flags & (TCP_FIN | TCP_RST)) != 0)
+
+    table = ct.table
+    capacity = ct.capacity
+
+    # --- refresh existing entries (hits) -------------------------------
+    # State transitions are MONOTONE upgrades (SYN_SENT < ESTABLISHED <
+    # CLOSING, no downgrades), so concurrent refreshes of one slot by
+    # several packets of the same flow in one batch combine with
+    # scatter-max — matching the oracle's sequential result regardless
+    # of intra-batch order.  Expiry is then recomputed from the POST-max
+    # state so the lifetime matches the winning state.
+    hit = result != CT_NEW
+    hslot = jnp.where(hit, slot, 0)
+    old_state = table[hslot, V_STATE]
+    # reply seen -> ESTABLISHED; FIN/RST -> CLOSING
+    new_state = jnp.where(is_reply & (old_state == ST_SYN_SENT),
+                          ST_ESTABLISHED, old_state)
+    new_state = jnp.where(closing, ST_CLOSING, new_state)
+    upd_rows = jnp.where(hit, hslot, capacity)  # OOB rows dropped
+    table = table.at[upd_rows, V_STATE].max(
+        new_state.astype(jnp.uint32), mode="drop")
+    final_state = table[hslot, V_STATE]
+    lifetime = jnp.where(
+        final_state == ST_CLOSING, LIFETIME_CLOSE,
+        jnp.where(is_tcp,
+                  jnp.where(final_state >= ST_ESTABLISHED, LIFETIME_TCP,
+                            LIFETIME_SYN),
+                  LIFETIME_NONTCP)).astype(jnp.uint32)
+    table = table.at[upd_rows, V_EXPIRES].set(now + lifetime, mode="drop")
+    pkt_col = jnp.where(is_reply, V_RX_PKTS, V_TX_PKTS)
+    byte_col = jnp.where(is_reply, V_RX_BYTES, V_TX_BYTES)
+    table = table.at[upd_rows, pkt_col].add(1, mode="drop")
+    table = table.at[upd_rows, byte_col].add(length, mode="drop")
+
+    # --- insert NEW entries (write-then-verify claim) ------------------
+    pending = do_create & (result == CT_NEW)
+    mask = capacity - 1
+    h = _hash(fwd)
+    init_state = jnp.where(is_tcp, ST_SYN_SENT, ST_ESTABLISHED)
+    init_life = jnp.where(is_tcp, LIFETIME_SYN, LIFETIME_NONTCP)
+    new_row = jnp.concatenate([
+        fwd,
+        jnp.stack([
+            init_state.astype(jnp.uint32),
+            now + init_life.astype(jnp.uint32),
+            jnp.ones_like(length),  # tx_pkts
+            jnp.zeros_like(length),
+            length,  # tx_bytes
+            jnp.zeros_like(length),
+            proxy_port.astype(jnp.uint32),
+        ], axis=1),
+    ], axis=1)  # [N, ROW_WORDS]
+
+    for step in range(N_PROBE):
+        s = ((h + step) & mask).astype(jnp.int32)
+        stored = table[s]
+        claimable = ((stored[:, V_STATE] == ST_FREE)
+                     | (stored[:, V_EXPIRES] < now)
+                     | jnp.all(stored[:, :KEY_WORDS] == fwd, axis=1))
+        trying = pending & claimable
+        rows = jnp.where(trying, s, capacity)
+        table = table.at[rows].set(new_row, mode="drop")
+        won = trying & jnp.all(table[s, :KEY_WORDS] == fwd, axis=1)
+        pending = pending & ~won
+
+    dropped = ct.dropped + jnp.sum(pending).astype(jnp.uint32)
+    return CTTable(table=table, dropped=dropped)
+
+
+def ct_gc(ct: CTTable, now: jnp.ndarray) -> Tuple[CTTable, jnp.ndarray]:
+    """Age out expired entries (reference: pkg/maps/ctmap.GC interval
+    sweep).  Returns (table, n_evicted)."""
+    live = ct.table[:, V_STATE] != ST_FREE
+    expired = live & (ct.table[:, V_EXPIRES] < now)
+    n = jnp.sum(expired).astype(jnp.uint32)
+    state = jnp.where(expired, ST_FREE, ct.table[:, V_STATE])
+    table = ct.table.at[:, V_STATE].set(state.astype(jnp.uint32))
+    return CTTable(table=table, dropped=ct.dropped), n
+
+
+@partial(jax.jit, donate_argnums=0)
+def ct_gc_jit(ct: CTTable, now: jnp.ndarray) -> Tuple[CTTable, jnp.ndarray]:
+    return ct_gc(ct, now)
+
+
+# Jitted entry points: each eager scatter/gather costs a separate XLA
+# compile, so callers outside the fused datapath_step use these.
+ct_lookup_jit = jax.jit(ct_lookup)
+ct_update_jit = jax.jit(ct_update, donate_argnums=0)
+ct_keys_jit = jax.jit(ct_keys_from_headers)
+
+
+def ct_live_count(ct: CTTable) -> int:
+    return int(np.asarray(jnp.sum(ct.table[:, V_STATE] != ST_FREE)))

@@ -1,0 +1,214 @@
+"""The fused per-packet verdict pipeline — ``bpf_lxc.c`` as one jit fn.
+
+Reference: upstream cilium ``bpf/bpf_lxc.c`` ``handle_xgress``: parse ->
+ipcache LPM (``lib/eps.h``) -> ``ct_lookup4`` (``lib/conntrack.h``) ->
+``policy_can_access_ingress`` (``lib/policy.h``) -> ``ct_create4`` ->
+emit trace/drop/policy-verdict events.  TPU-first redesign: the whole
+stack is ONE jitted function over the ``[N, N_COLS]`` header tensor;
+every stage is gathers/elementwise so XLA fuses it into a handful of
+kernels, and the batch axis shards across chips with ``shard_map``
+(tables replicated, packets split).
+
+State (policy tensors, ipcache LPM, conntrack) threads functionally:
+``datapath_step(state, hdr, now) -> (out, state')`` where ``out`` is the
+per-packet event tensor the monitor layer decodes (the perf-ringbuffer
+analogue, returned via outfeed/device->host copy).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.packets import (
+    COL_DIR,
+    COL_DPORT,
+    COL_DST_IP0,
+    COL_EP,
+    COL_FAMILY,
+    COL_PROTO,
+    COL_SRC_IP0,
+)
+from ..policy.compiler import PolicyTensors, PROXY_SHIFT, VERDICT_MASK
+from ..policy.mapstate import (
+    VERDICT_ALLOW,
+    VERDICT_DEFAULT_DENY,
+    VERDICT_DENY,
+    VERDICT_REDIRECT,
+)
+from .conntrack import (
+    CT_ESTABLISHED,
+    CT_NEW,
+    CT_REPLY,
+    CTTable,
+    V_PROXY,
+    ct_keys_from_headers,
+    ct_lookup,
+    ct_update,
+)
+from .lpm import DeviceLPM, LPMTensors, lpm_lookup
+
+# Drop reasons (reference: bpf/lib/drop.h DROP_* codes, renumbered).
+REASON_FORWARDED = 0
+REASON_POLICY_DENY = 1  # explicit deny rule
+REASON_POLICY_DEFAULT_DENY = 2  # no rule allowed it (default deny)
+N_REASONS = 8
+
+# Event types in the out tensor (monitor vocabulary).
+EV_TRACE = 0  # TraceNotify: forwarded established/reply traffic
+EV_VERDICT = 1  # PolicyVerdictNotify: NEW connection decision
+EV_DROP = 2  # DropNotify
+
+# Out tensor columns.
+OUT_VERDICT = 0  # final VERDICT_* code
+OUT_PROXY = 1  # proxy port when redirected
+OUT_CT = 2  # CT_* lookup result
+OUT_ID_ROW = 3  # remote identity row (host maps to numeric id)
+OUT_REASON = 4  # drop reason (REASON_*)
+OUT_EVENT = 5  # EV_*
+N_OUT = 6
+
+MAX_ENDPOINTS = 4096
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DevicePolicy:
+    """Compiled policy tensors on device + endpoint->policy-row map
+    (the policymap + lxcmap of the TPU datapath)."""
+
+    proto_table: jnp.ndarray  # [256] int32
+    port_class: jnp.ndarray  # [N_PROTO, 65536] int32
+    verdict: jnp.ndarray  # [n_pol, 2, n_rows, n_cls] int32
+    ep_policy: jnp.ndarray  # [MAX_ENDPOINTS] int32 endpoint -> policy row
+
+    @staticmethod
+    def from_tensors(t: PolicyTensors,
+                     ep_policy: np.ndarray = None) -> "DevicePolicy":
+        if ep_policy is None:
+            ep_policy = np.zeros(MAX_ENDPOINTS, dtype=np.int32)
+        return DevicePolicy(
+            proto_table=jnp.asarray(t.proto_table),
+            port_class=jnp.asarray(t.port_class),
+            verdict=jnp.asarray(t.verdict),
+            ep_policy=jnp.asarray(ep_policy),
+        )
+
+    def tree_flatten(self):
+        return ((self.proto_table, self.port_class, self.verdict,
+                 self.ep_policy), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class DatapathState:
+    """Full device datapath state — the BPF-maps bundle as a pytree."""
+
+    policy: DevicePolicy
+    ipcache: DeviceLPM
+    ct: CTTable
+    metrics: jnp.ndarray  # [N_REASONS, 2] uint32: [reason, dir] counts
+
+    @staticmethod
+    def create(policy: DevicePolicy, ipcache: DeviceLPM,
+               ct: CTTable) -> "DatapathState":
+        return DatapathState(
+            policy=policy, ipcache=ipcache, ct=ct,
+            metrics=jnp.zeros((N_REASONS, 2), dtype=jnp.uint32))
+
+    def tree_flatten(self):
+        return ((self.policy, self.ipcache, self.ct, self.metrics), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def datapath_step(state: DatapathState, hdr: jnp.ndarray,
+                  now: jnp.ndarray) -> Tuple[jnp.ndarray, DatapathState]:
+    """One batched pass of the full verdict pipeline (see module doc)."""
+    hdr = hdr.astype(jnp.uint32)
+    dirn = hdr[:, COL_DIR].astype(jnp.int32)
+    fam = hdr[:, COL_FAMILY].astype(jnp.int32)
+
+    # 1. ipcache: remote IP -> identity row (src for ingress, dst for
+    #    egress — reference: lookup_ip4_remote_endpoint on the peer).
+    src_words = hdr[:, COL_SRC_IP0:COL_SRC_IP0 + 4]
+    dst_words = hdr[:, COL_DST_IP0:COL_DST_IP0 + 4]
+    remote = jnp.where((dirn == 0)[:, None], src_words, dst_words)
+    id_row = lpm_lookup(state.ipcache, remote, fam)
+
+    # 2. conntrack lookup.
+    fwd, rev = ct_keys_from_headers(hdr)
+    ct_res, slot, is_reply = ct_lookup(state.ct, fwd, rev, now)
+
+    # 3. policy map lookup (two gathers; all precedence precompiled).
+    pol_row = state.policy.ep_policy[hdr[:, COL_EP].astype(jnp.int32)]
+    proto_idx = state.policy.proto_table[hdr[:, COL_PROTO].astype(jnp.int32)]
+    cls = state.policy.port_class[proto_idx, hdr[:, COL_DPORT].astype(jnp.int32)]
+    packed = state.policy.verdict[pol_row, dirn, id_row, cls]
+    p_verdict = (packed & VERDICT_MASK).astype(jnp.int32)
+    p_proxy = (packed >> PROXY_SHIFT).astype(jnp.int32)
+
+    # 4. final verdict: established/reply bypass policy (reference: the
+    #    CT fast path — policy applies to NEW connections only).
+    is_new = ct_res == CT_NEW
+    ct_proxy = state.ct.table[slot, V_PROXY].astype(jnp.int32)
+    allowed_new = (p_verdict == VERDICT_ALLOW) | (p_verdict == VERDICT_REDIRECT)
+    allowed = ~is_new | allowed_new
+    proxy = jnp.where(is_new, jnp.where(p_verdict == VERDICT_REDIRECT,
+                                        p_proxy, 0),
+                      ct_proxy)
+    verdict = jnp.where(
+        allowed,
+        jnp.where(proxy > 0, VERDICT_REDIRECT, VERDICT_ALLOW),
+        p_verdict)  # deny or default-deny code as-is
+    reason = jnp.where(
+        allowed, REASON_FORWARDED,
+        jnp.where(p_verdict == VERDICT_DENY, REASON_POLICY_DENY,
+                  REASON_POLICY_DEFAULT_DENY))
+
+    # 5. conntrack create/refresh (create only on allowed NEW).
+    ct = ct_update(state.ct, hdr, fwd, ct_res, slot, is_reply,
+                   do_create=allowed & is_new,
+                   proxy_port=proxy.astype(jnp.uint32),
+                   now=now)
+
+    # 6. metrics (reference: bpf metricsmap per-reason counters).
+    metrics = state.metrics.at[reason, dirn].add(1)
+
+    event = jnp.where(~allowed, EV_DROP,
+                      jnp.where(is_new, EV_VERDICT, EV_TRACE))
+    out = jnp.stack([
+        verdict.astype(jnp.uint32),
+        proxy.astype(jnp.uint32),
+        ct_res.astype(jnp.uint32),
+        id_row.astype(jnp.uint32),
+        reason.astype(jnp.uint32),
+        event.astype(jnp.uint32),
+    ], axis=1)
+    return out, DatapathState(policy=state.policy, ipcache=state.ipcache,
+                              ct=ct, metrics=metrics)
+
+
+datapath_step_jit = jax.jit(datapath_step, donate_argnums=0)
+
+
+def build_state(policy_tensors: PolicyTensors, lpm_tensors: LPMTensors,
+                ep_policy: np.ndarray = None,
+                ct_capacity: int = 1 << 20) -> DatapathState:
+    """Assemble a fresh device state from host-compiled tensors."""
+    return DatapathState.create(
+        policy=DevicePolicy.from_tensors(policy_tensors, ep_policy),
+        ipcache=DeviceLPM.from_tensors(lpm_tensors),
+        ct=CTTable.create(ct_capacity),
+    )

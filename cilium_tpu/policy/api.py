@@ -267,6 +267,24 @@ class CIDRRule:
         )
 
 
+def _fqdn_from_obj(obj) -> str:
+    """One toFQDNs entry -> name or glob pattern string.
+
+    Reference: api.FQDNSelector has matchName (exact) and matchPattern
+    (glob, ``*`` wildcards).  Patterns keep their ``*`` and are matched
+    with fnmatch against observed fqdn labels at resolve time.
+    """
+    if isinstance(obj, str):
+        return obj
+    name = obj.get("matchName")
+    if name:
+        return name
+    pattern = obj.get("matchPattern")
+    if pattern:
+        return pattern
+    raise ValueError(f"toFQDNs entry needs matchName or matchPattern: {obj}")
+
+
 # ---------------------------------------------------------------------------
 # Ingress / Egress rules
 
@@ -318,8 +336,8 @@ class EgressRule:
             to_entities=tuple(d.get("toEntities") or ()),
             to_ports=tuple(PortRule.from_dict(p)
                            for p in d.get("toPorts") or ()),
-            to_fqdns=tuple((f.get("matchName") if isinstance(f, dict) else f)
-                           for f in (d.get("toFQDNs") or ())),
+            to_fqdns=tuple(_fqdn_from_obj(f) for f in (d.get("toFQDNs")
+                                                       or ())),
         )
 
     @property

@@ -113,10 +113,20 @@ def _peer_identities(
     # toFQDNs select identities carrying an fqdn:<name> label — created
     # by the DNS-proxy subsystem (reference: pkg/fqdn) as lookups are
     # observed.  Before any DNS activity the set is empty (deny), never
-    # a wildcard.
+    # a wildcard.  matchPattern globs match against all observed fqdn
+    # labels (reference: api.FQDNSelector.MatchPattern).
+    import fnmatch
+
     for name in fqdns:
-        sel = EndpointSelector.from_labels(f"fqdn:{name}")
-        ids |= selector_cache.selections(sel)
+        if "*" in name:
+            for ident in selector_cache.known_identities():
+                for lab in ident.labels:
+                    if lab.source == "fqdn" and fnmatch.fnmatch(lab.key,
+                                                                name):
+                        ids.add(ident.numeric_id)
+        else:
+            sel = EndpointSelector.from_labels(f"fqdn:{name}")
+            ids |= selector_cache.selections(sel)
     return frozenset(ids)
 
 

@@ -1,0 +1,7 @@
+"""Test support: the oracle datapath + golden packet corpora.
+
+Reference: upstream cilium's ``pkg/datapath/fake`` (a no-kernel
+Datapath/Loader) and ``bpf/tests`` golden packets — the model for the
+verdict-divergence suite (BASELINE.md gate)."""
+
+from .oracle import OracleDatapath  # noqa: F401

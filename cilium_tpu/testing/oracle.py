@@ -1,0 +1,208 @@
+"""Oracle datapath: sequential pure-Python reference semantics.
+
+Reference: the eBPF behavior of ``bpf/bpf_lxc.c`` + ``bpf/lib`` as
+described in SURVEY.md §3.2, implemented with plain dicts so the TPU
+datapath can be checked packet-for-packet (the divergence gate is 0%
+in-tree; BASELINE.md allows <=1%).
+
+Batch semantics match the device: lookups see the state as of batch
+start (snapshot), then updates apply — the device is data-parallel
+within a batch, so the oracle must not let packet i's CT insert be
+visible to packet i+1 of the same batch.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.packets import (
+    COL_DIR,
+    COL_DPORT,
+    COL_DST_IP0,
+    COL_EP,
+    COL_FAMILY,
+    COL_FLAGS,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP0,
+    TCP_FIN,
+    TCP_RST,
+    HeaderBatch,
+    words_to_ip,
+)
+from ..datapath.conntrack import (
+    CT_ESTABLISHED,
+    CT_NEW,
+    CT_REPLY,
+    LIFETIME_CLOSE,
+    LIFETIME_NONTCP,
+    LIFETIME_SYN,
+    LIFETIME_TCP,
+)
+from ..datapath.verdict import (
+    EV_DROP,
+    EV_TRACE,
+    EV_VERDICT,
+    REASON_FORWARDED,
+    REASON_POLICY_DEFAULT_DENY,
+    REASON_POLICY_DENY,
+)
+from ..policy.mapstate import (
+    VERDICT_ALLOW,
+    VERDICT_DENY,
+    VERDICT_REDIRECT,
+)
+from ..policy.compiler import make_proto_table
+from ..policy.resolve import EndpointPolicy
+
+
+@dataclass
+class _CTEntry:
+    state: int  # ST_* from conntrack
+    expires: int
+    proxy: int
+
+
+@dataclass
+class OracleResult:
+    verdict: int
+    proxy: int
+    ct: int
+    identity: int  # remote numeric identity
+    reason: int
+    event: int
+
+
+class OracleDatapath:
+    """Sequential reference of the full verdict pipeline."""
+
+    def __init__(self, ep_policies: Dict[int, EndpointPolicy],
+                 ipcache: Dict[str, int]):
+        self.ep_policies = ep_policies
+        self.ipcache: List[Tuple[int, int, int, int]] = []  # ver, net, plen, id
+        for cidr, ident in ipcache.items():
+            net = ipaddress.ip_network(cidr, strict=False)
+            self.ipcache.append((net.version, int(net.network_address),
+                                 net.prefixlen, ident))
+        self.ct: Dict[tuple, _CTEntry] = {}
+        self.proto_table = make_proto_table()
+
+    def lookup_identity(self, ip: str) -> int:
+        addr = ipaddress.ip_address(ip)
+        n = int(addr)
+        bits = 32 if addr.version == 4 else 128
+        best_len, best_id = -1, 0
+        for ver, net, plen, ident in self.ipcache:
+            if ver != addr.version:
+                continue
+            shift = bits - plen
+            if plen == 0 or (n >> shift) == (net >> shift):
+                if plen > best_len:
+                    best_len, best_id = plen, ident
+        return best_id
+
+    @staticmethod
+    def _tuple(row: np.ndarray) -> tuple:
+        proto = int(row[COL_PROTO])
+        icmp = proto in (1, 58)
+        sport = 0 if icmp else int(row[COL_SPORT])
+        dport = 0 if icmp else int(row[COL_DPORT])
+        src = tuple(int(x) for x in row[COL_SRC_IP0:COL_SRC_IP0 + 4])
+        dst = tuple(int(x) for x in row[COL_DST_IP0:COL_DST_IP0 + 4])
+        return (src, dst, sport, dport, proto, int(row[COL_DIR]))
+
+    @staticmethod
+    def _rev(t: tuple) -> tuple:
+        # reply: swap tuple AND hook direction (ipv4_ct_tuple_reverse)
+        return (t[1], t[0], t[3], t[2], t[4], 1 - t[5])
+
+    def step(self, batch: HeaderBatch, now: int) -> List[OracleResult]:
+        results: List[OracleResult] = []
+        updates: List[Tuple[tuple, np.ndarray, bool, int, int]] = []
+        # phase 1: lookups against the batch-start snapshot
+        for i in range(len(batch)):
+            row = batch.data[i]
+            dirn = int(row[COL_DIR])
+            fam = int(row[COL_FAMILY])
+            remote_words = (row[COL_SRC_IP0:COL_SRC_IP0 + 4] if dirn == 0
+                            else row[COL_DST_IP0:COL_DST_IP0 + 4])
+            ident = self.lookup_identity(words_to_ip(remote_words, fam))
+
+            fwd = self._tuple(row)
+            entry = self.ct.get(fwd)
+            is_reply = False
+            if entry is not None and entry.expires >= now:
+                ct_res = CT_ESTABLISHED
+            else:
+                rentry = self.ct.get(self._rev(fwd))
+                if rentry is not None and rentry.expires >= now:
+                    ct_res, is_reply, entry = CT_REPLY, True, rentry
+                else:
+                    ct_res, entry = CT_NEW, None
+
+            pol = self.ep_policies[int(row[COL_EP])]
+            proto_idx = int(self.proto_table[int(row[COL_PROTO])])
+            p_verdict, p_proxy = pol.lookup(dirn, ident, proto_idx,
+                                            int(row[COL_DPORT]))
+            if ct_res != CT_NEW:
+                proxy = entry.proxy
+                verdict = VERDICT_REDIRECT if proxy > 0 else VERDICT_ALLOW
+                reason = REASON_FORWARDED
+                event = EV_TRACE
+            elif p_verdict in (VERDICT_ALLOW, VERDICT_REDIRECT):
+                proxy = p_proxy if p_verdict == VERDICT_REDIRECT else 0
+                verdict = p_verdict
+                reason = REASON_FORWARDED
+                event = EV_VERDICT
+            else:
+                proxy = 0
+                verdict = p_verdict
+                reason = (REASON_POLICY_DENY if p_verdict == VERDICT_DENY
+                          else REASON_POLICY_DEFAULT_DENY)
+                event = EV_DROP
+            results.append(OracleResult(verdict, proxy, ct_res, ident,
+                                        reason, event))
+            allowed = reason == REASON_FORWARDED
+            updates.append((fwd, row, is_reply, ct_res, proxy if allowed
+                            else 0, allowed))
+        # phase 2: apply CT updates
+        from ..datapath.conntrack import (ST_CLOSING, ST_ESTABLISHED,
+                                          ST_SYN_SENT)
+        for fwd, row, is_reply, ct_res, proxy, allowed in (
+                (u[0], u[1], u[2], u[3], u[4], u[5]) for u in updates):
+            proto = int(row[COL_PROTO])
+            flags = int(row[COL_FLAGS])
+            is_tcp = proto == 6
+            closing = is_tcp and (flags & (TCP_FIN | TCP_RST)) != 0
+            if ct_res == CT_NEW:
+                if allowed:
+                    st = ST_SYN_SENT if is_tcp else ST_ESTABLISHED
+                    life = LIFETIME_SYN if is_tcp else LIFETIME_NONTCP
+                    self.ct[fwd] = _CTEntry(st, now + life, proxy)
+                continue
+            key = self._rev(fwd) if is_reply else fwd
+            e = self.ct[key]
+            if is_reply and e.state == ST_SYN_SENT:
+                e.state = ST_ESTABLISHED
+            if closing:
+                e.state = ST_CLOSING
+            if e.state == ST_CLOSING:
+                life = LIFETIME_CLOSE
+            elif is_tcp:
+                life = (LIFETIME_TCP if e.state >= ST_ESTABLISHED
+                        else LIFETIME_SYN)
+            else:
+                life = LIFETIME_NONTCP
+            e.expires = now + life
+        return results
+
+    def gc(self, now: int) -> int:
+        """Expire entries (ctmap.GC)."""
+        dead = [k for k, e in self.ct.items() if e.expires < now]
+        for k in dead:
+            del self.ct[k]
+        return len(dead)

@@ -1,0 +1,237 @@
+"""Verdict-divergence suite: fused TPU pipeline vs sequential oracle.
+
+The in-repo analogue of BASELINE.md's <=1% divergence-vs-eBPF gate —
+gated here at 0%: every packet of every batch must agree on verdict,
+proxy port, CT result, remote identity, drop reason, and event type.
+
+Modeled on the reference's bpf/tests (golden packets through
+BPF_PROG_RUN) + pkg/policy resolve tests (SURVEY.md §4).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cilium_tpu.core import TCP_ACK, TCP_FIN, TCP_SYN, make_batch
+from cilium_tpu.core.packets import (
+    COL_DIR,
+    COL_DPORT,
+    COL_EP,
+    COL_FLAGS,
+    COL_PROTO,
+    COL_SPORT,
+    HeaderBatch,
+    ip_to_words,
+    N_COLS,
+)
+from cilium_tpu.datapath import build_state, datapath_step_jit
+from cilium_tpu.datapath.lpm import compile_lpm
+from cilium_tpu.identity import CachingIdentityAllocator
+from cilium_tpu.labels import LabelSet
+from cilium_tpu.policy import IdentityRowMap, PolicyRepository, compile_policy
+from cilium_tpu.testing import OracleDatapath
+
+WEB = LabelSet.parse("k8s:app=web")
+DB = LabelSet.parse("k8s:app=db")
+
+RULES = [
+    {
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [
+            {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+             "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+            {"fromCIDR": ["192.168.0.0/16"],
+             "toPorts": [{"ports": [{"port": "8000", "endPort": 8999}]}]},
+            {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+             "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}],
+                          "rules": {"http": [{"method": "GET"}]}}]},
+        ],
+        "ingressDeny": [
+            {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+             "toPorts": [{"ports": [{"port": "22", "protocol": "TCP"}]}]},
+        ],
+        "egress": [
+            {"toEntities": ["world"],
+             "toPorts": [{"ports": [{"port": "53", "protocol": "UDP"}]}]},
+        ],
+    },
+    {
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [
+            {"toEndpoints": [{"matchLabels": {"app": "db"}}]},
+            {"toPorts": [{"ports": [{"port": "443", "protocol": "TCP"}]}]},
+        ],
+    },
+]
+
+WEB_IPS = [f"10.0.1.{i}" for i in range(1, 9)]
+DB_IPS = [f"10.0.2.{i}" for i in range(1, 9)]
+EXT_IPS = [f"192.168.7.{i}" for i in range(1, 5)] + ["8.8.8.8", "1.1.1.1"]
+ALL_IPS = WEB_IPS + DB_IPS + EXT_IPS
+
+
+# function-scoped: datapath_step_jit donates the state buffers, so each
+# test needs its own state (the compiled jit graph is shared anyway)
+@pytest.fixture()
+def world():
+    alloc = CachingIdentityAllocator()
+    repo = PolicyRepository(alloc)
+    web_id = alloc.allocate(WEB).numeric_id
+    db_id = alloc.allocate(DB).numeric_id
+    world_id = alloc.allocate(LabelSet.parse("reserved:world")).numeric_id
+    repo.add_obj(RULES)
+    pol_web = repo.resolve(WEB)
+    pol_db = repo.resolve(DB)
+
+    ipcache = {ip + "/32": web_id for ip in WEB_IPS}
+    ipcache.update({ip + "/32": db_id for ip in DB_IPS})
+    # CIDR identities allocated during resolve (fromCIDR 192.168/16)
+    cidr_ident = alloc.allocate_cidr("192.168.0.0/16")
+    ipcache["192.168.0.0/16"] = cidr_ident.numeric_id
+    ipcache["0.0.0.0/0"] = world_id  # the reference's world catch-all
+
+    row_map = IdentityRowMap(capacity=256)
+    for ident in alloc.all_identities():
+        row_map.add(ident.numeric_id)
+    policies = [pol_web, pol_db]  # policy row 0 = web, 1 = db
+    tensors = compile_policy(policies, row_map)
+    lpm = compile_lpm({c: row_map.row(i) for c, i in ipcache.items()})
+    ep_policy = np.zeros(4096, dtype=np.int32)
+    ep_policy[0] = 0  # ep 0 = a web pod
+    ep_policy[1] = 1  # ep 1 = a db pod
+    state = build_state(tensors, lpm, ep_policy, ct_capacity=1 << 16)
+    oracle = OracleDatapath({0: pol_web, 1: pol_db}, ipcache)
+    row_to_numeric = row_map.numeric_array()
+    return state, oracle, row_to_numeric
+
+
+def _compare(state, oracle, row_to_numeric, batch: HeaderBatch, now: int):
+    out, state = datapath_step_jit(state, jnp.asarray(batch.data),
+                                   jnp.uint32(now))
+    out = np.asarray(out)
+    want = oracle.step(batch, now)
+    n_div = 0
+    for i, w in enumerate(want):
+        got = (int(out[i, 0]), int(out[i, 1]), int(out[i, 2]),
+               int(row_to_numeric[out[i, 3]]), int(out[i, 4]),
+               int(out[i, 5]))
+        exp = (w.verdict, w.proxy, w.ct, w.identity, w.reason, w.event)
+        if got != exp:
+            n_div += 1
+            if n_div <= 5:
+                print(f"DIVERGE pkt {i}: {batch.describe(i)}\n"
+                      f"  got  {got}\n  want {exp}")
+    assert n_div == 0, f"{n_div}/{len(want)} packets diverged"
+    return state
+
+
+def _random_batch(rng, n) -> HeaderBatch:
+    rows = []
+    for _ in range(n):
+        src = rng.choice(ALL_IPS)
+        dst = rng.choice(ALL_IPS)
+        proto = int(rng.choice([6, 6, 6, 17, 1, 47]))
+        rows.append(dict(
+            src=src, dst=dst,
+            sport=int(rng.integers(1024, 60000)),
+            dport=int(rng.choice([5432, 80, 443, 22, 53, 8080, 8443,
+                                  int(rng.integers(1, 65536))])),
+            proto=proto,
+            flags=int(rng.choice([TCP_SYN, TCP_ACK, TCP_ACK | TCP_FIN]))
+            if proto == 6 else 0,
+            ep=int(rng.integers(0, 2)),
+            dir=int(rng.integers(0, 2)),
+        ))
+    return make_batch(rows)
+
+
+def test_random_traffic_zero_divergence(world):
+    state, oracle, row_to_numeric = world
+    rng = np.random.default_rng(42)
+    now = 1000
+    for step in range(6):
+        batch = _random_batch(rng, 512)
+        state = _compare(state, oracle, row_to_numeric, batch, now)
+        now += int(rng.integers(1, 30))
+
+
+def test_conversation_lifecycle(world):
+    """SYN -> SYN/ACK -> data -> FIN through both endpoints' hooks,
+    exercising NEW/ESTABLISHED/REPLY and the CT fast path."""
+    state, oracle, row_to_numeric = world
+    now = 50_000
+    web, db = WEB_IPS[0], DB_IPS[0]
+
+    def pkt(src, dst, sport, dport, flags, ep, dirn):
+        return dict(src=src, dst=dst, sport=sport, dport=dport, proto=6,
+                    flags=flags, ep=ep, dir=dirn)
+
+    # the same wire packet seen at web's egress hook and db's ingress hook
+    syn_out = pkt(web, db, 33000, 5432, TCP_SYN, 0, 1)
+    syn_in = pkt(web, db, 33000, 5432, TCP_SYN, 1, 0)
+    ack_back_out = pkt(db, web, 5432, 33000, TCP_SYN | TCP_ACK, 1, 1)
+    ack_back_in = pkt(db, web, 5432, 33000, TCP_SYN | TCP_ACK, 0, 0)
+    data_out = pkt(web, db, 33000, 5432, TCP_ACK, 0, 1)
+    data_in = pkt(web, db, 33000, 5432, TCP_ACK, 1, 0)
+    fin_out = pkt(web, db, 33000, 5432, TCP_ACK | TCP_FIN, 0, 1)
+    fin_in = pkt(web, db, 33000, 5432, TCP_ACK | TCP_FIN, 1, 0)
+
+    for step_pkts in ([syn_out, syn_in], [ack_back_out, ack_back_in],
+                      [data_out, data_in], [fin_out, fin_in]):
+        state = _compare(state, oracle, row_to_numeric,
+                         make_batch(step_pkts), now)
+        now += 1
+
+
+def test_denied_then_no_ct_entry(world):
+    """A denied SYN must not create CT state (reference: ct_create only
+    on allow), so a retry is NEW again, not ESTABLISHED."""
+    state, oracle, row_to_numeric = world
+    now = 90_000
+    web, db = WEB_IPS[1], DB_IPS[1]
+    deny = dict(src=web, dst=db, sport=40000, dport=22, proto=6,
+                flags=TCP_SYN, ep=1, dir=0)
+    for _ in range(2):
+        state = _compare(state, oracle, row_to_numeric,
+                         make_batch([deny]), now)
+        now += 1
+
+
+def test_same_flow_reply_and_forward_in_one_batch(world):
+    """Reply (SYN_SENT->ESTABLISHED) and a forward retransmit of the
+    same flow in ONE batch: the monotone scatter-max state combine must
+    end ESTABLISHED with the long lifetime, like the sequential oracle
+    (regression: snapshot .set scatter could lose the upgrade)."""
+    state, oracle, row_to_numeric = world
+    now = 97_000
+    web, db = WEB_IPS[3], DB_IPS[3]
+    syn = dict(src=web, dst=db, sport=42000, dport=5432, proto=6,
+               flags=TCP_SYN, ep=1, dir=0)
+    state = _compare(state, oracle, row_to_numeric, make_batch([syn]), now)
+    # one batch: reply at egress + forward retransmit at ingress
+    reply = dict(src=db, dst=web, sport=5432, dport=42000, proto=6,
+                 flags=TCP_SYN | TCP_ACK, ep=1, dir=1)
+    retrans = dict(src=web, dst=db, sport=42000, dport=5432, proto=6,
+                   flags=TCP_SYN, ep=1, dir=0)
+    state = _compare(state, oracle, row_to_numeric,
+                     make_batch([retrans, reply]), now + 1)
+    # past the SYN lifetime but within established lifetime: must hit
+    state = _compare(state, oracle, row_to_numeric,
+                     make_batch([dict(src=web, dst=db, sport=42000,
+                                      dport=5432, proto=6, flags=TCP_ACK,
+                                      ep=1, dir=0)]), now + 1000)
+
+
+def test_redirect_streams_through_proxy(world):
+    """L7 HTTP rule: NEW gets REDIRECT + proxy port; established packets
+    of the flow keep redirecting via the CT proxy_redirect."""
+    state, oracle, row_to_numeric = world
+    now = 95_000
+    web, db = WEB_IPS[2], DB_IPS[2]
+    syn = dict(src=web, dst=db, sport=41000, dport=80, proto=6,
+               flags=TCP_SYN, ep=1, dir=0)
+    data = dict(src=web, dst=db, sport=41000, dport=80, proto=6,
+                flags=TCP_ACK, ep=1, dir=0)
+    state = _compare(state, oracle, row_to_numeric, make_batch([syn]), now)
+    state = _compare(state, oracle, row_to_numeric, make_batch([data]),
+                     now + 1)
