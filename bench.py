@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Headline benchmark: policy verdicts/sec on one chip.
+
+BASELINE.md north-star: >= 10M policy verdicts/sec on one TPU v5e chip
+over the 10k-identity L3/L4 policy set, <= 1% divergence vs the oracle.
+
+Runs the full fused pipeline (ipcache LPM -> conntrack -> policy ->
+ct-create -> events) on synthetic steady-state traffic (95% established
+/ 5% new flows), replaying a pool of pre-generated batches.  Prints ONE
+JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.datapath import datapath_step_jit
+    from cilium_tpu.testing.fixtures import bench_traffic, build_world
+
+    batch_size = 1 << 17  # 131072 packets/batch
+    n_pool = 4
+    iters = 30
+
+    world = build_world(n_identities=10_000, ct_capacity=1 << 21)
+    rng = np.random.default_rng(0)
+    pool = [jnp.asarray(bench_traffic(world, batch_size, rng))
+            for _ in range(n_pool)]
+    state = world.state
+    now = jnp.uint32(1_000)
+
+    # warmup: compile + populate CT with the steady-state flows
+    for b in pool:
+        out, state = datapath_step_jit(state, b, now)
+    out.block_until_ready()
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out, state = datapath_step_jit(state, pool[i % n_pool], now)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    pps = batch_size * iters / dt
+    baseline = 10_000_000.0  # north-star target
+    print(json.dumps({
+        "metric": "policy_verdicts_per_sec_per_chip",
+        "value": round(pps),
+        "unit": "verdicts/s",
+        "vs_baseline": round(pps / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,18 @@ TCP_RST = 0x04
 TCP_PSH = 0x08
 TCP_ACK = 0x10
 
+# Protocols whose CT tuple carries no ports (ICMP/ICMPv6: echo req and
+# reply must share a tuple modulo direction swap).  Flow steering and
+# CT key construction MUST use the same normalization — both call
+# normalize_ports below.
+PORTLESS_PROTOS = (1, 58)
+
+
+def normalize_ports(xp, proto, sport, dport):
+    """Zero the ports of portless protocols (xp = np or jnp)."""
+    portless = (proto == PORTLESS_PROTOS[0]) | (proto == PORTLESS_PROTOS[1])
+    return xp.where(portless, 0, sport), xp.where(portless, 0, dport)
+
 IPAddr = Union[str, int, ipaddress.IPv4Address, ipaddress.IPv6Address]
 
 

@@ -88,8 +88,14 @@ class CTTable:
     dropped: jnp.ndarray  # [] uint32 — failed inserts (map pressure)
 
     @staticmethod
-    def create(capacity: int = 1 << 20) -> "CTTable":
-        assert capacity & (capacity - 1) == 0, "capacity must be 2^k"
+    def create(capacity: int = 1 << 20, shards: int = 1) -> "CTTable":
+        """``capacity`` is the GLOBAL entry count; when the table is
+        sharded over ``shards`` chips each shard's slice must be a
+        power of two (the probe mask is per-shard)."""
+        per_shard, rem = divmod(capacity, shards)
+        assert rem == 0, "capacity must divide evenly across shards"
+        assert per_shard & (per_shard - 1) == 0, \
+            "per-shard capacity must be 2^k"
         return CTTable(
             table=jnp.zeros((capacity, ROW_WORDS), dtype=jnp.uint32),
             dropped=jnp.zeros((), dtype=jnp.uint32),
@@ -121,13 +127,16 @@ def ct_keys_from_headers(hdr: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """
     from ..core.packets import COL_DIR
 
+    from ..core.packets import normalize_ports
+
     src = hdr[:, COL_SRC_IP0:COL_SRC_IP0 + 4].astype(jnp.uint32)
     dst = hdr[:, COL_DST_IP0:COL_DST_IP0 + 4].astype(jnp.uint32)
     proto = hdr[:, COL_PROTO].astype(jnp.uint32)
     dirn = hdr[:, COL_DIR].astype(jnp.uint32)
-    is_icmp = (proto == 1) | (proto == 58)
-    sport = jnp.where(is_icmp, 0, hdr[:, COL_SPORT]).astype(jnp.uint32)
-    dport = jnp.where(is_icmp, 0, hdr[:, COL_DPORT]).astype(jnp.uint32)
+    sport, dport = normalize_ports(jnp, proto, hdr[:, COL_SPORT],
+                                   hdr[:, COL_DPORT])
+    sport = sport.astype(jnp.uint32)
+    dport = dport.astype(jnp.uint32)
     fwd_ports = (sport << 16) | dport
     rev_ports = (dport << 16) | sport
     fwd_pd = proto | (dirn << 8)
@@ -153,6 +162,11 @@ def _probe(table: jnp.ndarray, keys: jnp.ndarray, now: jnp.ndarray
 
     Expired entries don't match (an expired entry is a miss; GC frees
     the slot later, and inserts may reclaim it immediately)."""
+    c = table.shape[0]
+    if c & (c - 1):
+        raise ValueError(
+            f"CT probe needs 2^k capacity, got {c} — a multi-shard "
+            "table must be probed inside shard_map (per-shard slice)")
     mask = table.shape[0] - 1
     h = _hash(keys)
     found = jnp.zeros(keys.shape[0], dtype=bool)
@@ -187,11 +201,14 @@ def ct_lookup(ct: CTTable, fwd: jnp.ndarray, rev: jnp.ndarray,
 def ct_update(ct: CTTable, hdr: jnp.ndarray, fwd: jnp.ndarray,
               result: jnp.ndarray, slot: jnp.ndarray,
               is_reply: jnp.ndarray, do_create: jnp.ndarray,
-              proxy_port: jnp.ndarray, now: jnp.ndarray) -> CTTable:
+              proxy_port: jnp.ndarray, now: jnp.ndarray,
+              valid: jnp.ndarray = None) -> CTTable:
     """Refresh hit entries, apply the TCP state machine, insert NEW.
 
     ``do_create`` marks NEW packets whose policy verdict allowed them
     (reference: ``ct_create4`` is called on the allow path only).
+    ``valid`` masks out padding rows (batch routing pads shards to a
+    common size); invalid rows touch nothing.
     """
     proto = hdr[:, COL_PROTO].astype(jnp.uint32)
     flags = hdr[:, COL_FLAGS].astype(jnp.uint32)
@@ -210,6 +227,8 @@ def ct_update(ct: CTTable, hdr: jnp.ndarray, fwd: jnp.ndarray,
     # of intra-batch order.  Expiry is then recomputed from the POST-max
     # state so the lifetime matches the winning state.
     hit = result != CT_NEW
+    if valid is not None:
+        hit = hit & valid
     hslot = jnp.where(hit, slot, 0)
     old_state = table[hslot, V_STATE]
     # reply seen -> ESTABLISHED; FIN/RST -> CLOSING
@@ -234,6 +253,8 @@ def ct_update(ct: CTTable, hdr: jnp.ndarray, fwd: jnp.ndarray,
 
     # --- insert NEW entries (write-then-verify claim) ------------------
     pending = do_create & (result == CT_NEW)
+    if valid is not None:
+        pending = pending & valid
     mask = capacity - 1
     h = _hash(fwd)
     init_state = jnp.where(is_tcp, ST_SYN_SENT, ST_ESTABLISHED)

@@ -134,8 +134,13 @@ class DatapathState:
 
 
 def datapath_step(state: DatapathState, hdr: jnp.ndarray,
-                  now: jnp.ndarray) -> Tuple[jnp.ndarray, DatapathState]:
-    """One batched pass of the full verdict pipeline (see module doc)."""
+                  now: jnp.ndarray, valid: jnp.ndarray = None
+                  ) -> Tuple[jnp.ndarray, DatapathState]:
+    """One batched pass of the full verdict pipeline (see module doc).
+
+    ``valid`` (optional [N] bool) masks padding rows added by the
+    multi-chip flow router; masked rows produce output rows but touch
+    neither CT state nor metrics."""
     hdr = hdr.astype(jnp.uint32)
     dirn = hdr[:, COL_DIR].astype(jnp.int32)
     fam = hdr[:, COL_FAMILY].astype(jnp.int32)
@@ -181,10 +186,12 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     ct = ct_update(state.ct, hdr, fwd, ct_res, slot, is_reply,
                    do_create=allowed & is_new,
                    proxy_port=proxy.astype(jnp.uint32),
-                   now=now)
+                   now=now, valid=valid)
 
     # 6. metrics (reference: bpf metricsmap per-reason counters).
-    metrics = state.metrics.at[reason, dirn].add(1)
+    m_reason = reason if valid is None else jnp.where(valid, reason,
+                                                     N_REASONS)
+    metrics = state.metrics.at[m_reason, dirn].add(1, mode="drop")
 
     event = jnp.where(~allowed, EV_DROP,
                       jnp.where(is_new, EV_VERDICT, EV_TRACE))
@@ -205,10 +212,11 @@ datapath_step_jit = jax.jit(datapath_step, donate_argnums=0)
 
 def build_state(policy_tensors: PolicyTensors, lpm_tensors: LPMTensors,
                 ep_policy: np.ndarray = None,
-                ct_capacity: int = 1 << 20) -> DatapathState:
+                ct_capacity: int = 1 << 20,
+                ct_shards: int = 1) -> DatapathState:
     """Assemble a fresh device state from host-compiled tensors."""
     return DatapathState.create(
         policy=DevicePolicy.from_tensors(policy_tensors, ep_policy),
         ipcache=DeviceLPM.from_tensors(lpm_tensors),
-        ct=CTTable.create(ct_capacity),
+        ct=CTTable.create(ct_capacity, shards=ct_shards),
     )

@@ -1,0 +1,21 @@
+"""Multi-chip scale-out for the datapath (the per-CPU / per-node axis).
+
+Reference mapping (SURVEY.md §2c): cilium's per-packet parallelism is
+per-CPU kernel execution with per-CPU maps; its scale-out axis is one
+agent+datapath per node.  TPU-native equivalent: the packet batch
+shards across chips over a ``jax.sharding.Mesh``; policy + ipcache
+tensors are replicated (they are read-only in the hot path, updated by
+the control plane via broadcast, the way the kvstore replicates
+identities to every node); the conntrack table is **sharded** — each
+chip owns a private CT shard, and packets are routed to the chip that
+owns their flow via a symmetric flow hash (RSS-style), so both
+directions of a flow land on the same shard.
+"""
+
+from .mesh import (  # noqa: F401
+    flow_shard_ids,
+    make_mesh,
+    make_sharded_step,
+    route_by_flow,
+    shard_state,
+)

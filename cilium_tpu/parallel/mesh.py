@@ -1,0 +1,173 @@
+"""Mesh construction, flow routing, and the sharded datapath step.
+
+Reference mapping: cilium scales per-packet work across CPUs (per-CPU
+eBPF execution, RSS steering flows to CPUs) and across nodes (one
+agent per node, identities replicated via kvstore).  Here:
+
+- ``make_mesh``: 1-D device mesh over the ``data`` axis (chips).
+- ``flow_shard_ids``: symmetric (direction-invariant) flow hash so
+  both directions of a flow land on the same chip — the RSS analogue.
+- ``route_by_flow``: host-side packet steering into equal-size
+  per-shard blocks (padding masked via ``valid``).
+- ``make_sharded_step``: ``shard_map``-wrapped ``datapath_step`` —
+  policy/ipcache tensors replicated, conntrack sharded (each chip owns
+  a private CT shard), batch sharded; drop/metric counters are
+  ``psum``-ed so every replica carries the global totals, the way
+  every cilium agent sees the cluster-wide identity state.
+
+Multi-host: the same mesh spans hosts under ``jax.distributed`` — XLA
+runs the psums over ICI/DCN; no application code changes (the
+ClusterMesh analogue).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map  # type: ignore
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+from ..core.packets import (
+    COL_DPORT,
+    COL_DST_IP0,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP0,
+    N_COLS,
+)
+from ..datapath.conntrack import CTTable
+from ..datapath.verdict import DatapathState, datapath_step
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def flow_shard_ids(data: np.ndarray, n_shards: int) -> np.ndarray:
+    """Symmetric flow hash -> shard id per packet (host numpy).
+
+    Direction-invariant: uses commutative combines of src/dst words and
+    ports so a flow's forward and reply packets hash identically."""
+    from ..core.packets import normalize_ports
+
+    d = data.astype(np.uint64)
+    src = d[:, COL_SRC_IP0:COL_SRC_IP0 + 4]
+    dst = d[:, COL_DST_IP0:COL_DST_IP0 + 4]
+    # same tuple normalization as ct_keys_from_headers, or a flow's
+    # packets would land on a shard that doesn't own its CT entry
+    sport, dport = normalize_ports(np, d[:, COL_PROTO], d[:, COL_SPORT],
+                                   d[:, COL_DPORT])
+    h = np.zeros(len(d), dtype=np.uint64)
+    for w in range(4):
+        h = h * 31 + (src[:, w] + dst[:, w])
+        h ^= (src[:, w] ^ dst[:, w]) * np.uint64(0x9E3779B97F4A7C15)
+    h += (sport + dport) * np.uint64(0x85EBCA6B)
+    h ^= (sport ^ dport) * np.uint64(0xC2B2AE35)
+    h += d[:, COL_PROTO]
+    h ^= h >> 33
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> 33
+    return (h % np.uint64(n_shards)).astype(np.int64)
+
+
+def route_by_flow(data: np.ndarray, n_shards: int,
+                  block: Optional[int] = None
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Steer packets into equal-size per-shard blocks (host side).
+
+    Returns (routed [n_shards*block, N_COLS], valid [...] bool,
+    orig_idx [...] int64 — original row index, -1 on padding).  The RSS
+    analogue: the device-side pipeline shards this batch contiguously.
+
+    ``block`` (per-shard rows) should be FIXED by the caller across
+    batches — a data-dependent shape would retrace the jitted sharded
+    step every batch.  Default: 2x the fair share, rounded to a power
+    of two.  If a shard overflows its block, the excess packets are
+    dropped (an RSS queue overflow); detect via (orig_idx >= 0).sum()
+    < len(data)."""
+    ids = flow_shard_ids(data, n_shards)
+    if block is None:
+        fair = max(-(-len(data) // n_shards), 1)
+        block = 1
+        while block < 2 * fair:
+            block *= 2
+    routed = np.zeros((n_shards, block, N_COLS), dtype=np.uint32)
+    valid = np.zeros((n_shards, block), dtype=bool)
+    orig = np.full((n_shards, block), -1, dtype=np.int64)
+    for s in range(n_shards):
+        where = np.nonzero(ids == s)[0][:block]
+        routed[s, :len(where)] = data[where]
+        valid[s, :len(where)] = True
+        orig[s, :len(where)] = where
+    return (routed.reshape(n_shards * block, N_COLS), valid.reshape(-1),
+            orig.reshape(-1))
+
+
+def shard_state(state: DatapathState, mesh: Mesh,
+                axis: str = "data") -> DatapathState:
+    """Place device state per the sharded-step layout: CT table sharded
+    over chips, everything else replicated."""
+    repl = NamedSharding(mesh, P())
+    ct_sh = NamedSharding(mesh, P(axis, None))
+
+    def put(x, sharding):
+        return jax.device_put(x, sharding)
+
+    return DatapathState(
+        policy=jax.tree.map(lambda x: put(x, repl), state.policy),
+        ipcache=jax.tree.map(lambda x: put(x, repl), state.ipcache),
+        ct=CTTable(table=put(state.ct.table, ct_sh),
+                   dropped=put(state.ct.dropped, repl)),
+        metrics=put(state.metrics, repl),
+    )
+
+
+def make_sharded_step(mesh: Mesh, axis: str = "data") -> Callable:
+    """Build the jitted multi-chip datapath step.
+
+    step(state, hdr, now, valid) -> (out, state') with hdr/out sharded
+    on the batch axis, CT sharded, policy/ipcache replicated."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P(axis, None), P(), P(),
+                  P(axis, None), P(), P(axis)),
+        out_specs=(P(axis, None), P(axis, None), P(), P()),
+    )
+    def _step(policy, ipcache, ct_table, ct_dropped, metrics, hdr, now,
+              valid):
+        state = DatapathState(
+            policy=policy, ipcache=ipcache,
+            ct=CTTable(table=ct_table, dropped=ct_dropped),
+            metrics=metrics)
+        out, ns = datapath_step(state, hdr, now, valid=valid)
+        # counters are replicated state: accumulate the global delta so
+        # every replica agrees (the kvstore-replication analogue)
+        d_dropped = jax.lax.psum(ns.ct.dropped - ct_dropped, axis)
+        d_metrics = jax.lax.psum(ns.metrics - metrics, axis)
+        return (out, ns.ct.table, ct_dropped + d_dropped,
+                metrics + d_metrics)
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(state: DatapathState, hdr: jnp.ndarray, now: jnp.ndarray,
+             valid: jnp.ndarray) -> Tuple[jnp.ndarray, DatapathState]:
+        out, table, dropped, metrics = _step(
+            state.policy, state.ipcache, state.ct.table, state.ct.dropped,
+            state.metrics, hdr, now, valid)
+        return out, DatapathState(
+            policy=state.policy, ipcache=state.ipcache,
+            ct=CTTable(table=table, dropped=dropped), metrics=metrics)
+
+    return step

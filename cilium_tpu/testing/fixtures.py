@@ -1,0 +1,154 @@
+"""Canned worlds: policy sets + ipcache + device state for bench/demo.
+
+The big one mirrors BASELINE.md's "10k-identity L3/L4 CIDR policy set"
+config: 10k distinct identities with /32 ipcache entries, a rule set
+mixing selector allows, CIDR ranges, port ranges, denies and an L7
+redirect, compiled to device tensors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..identity.allocator import CachingIdentityAllocator
+from ..labels import LabelSet
+from ..policy import IdentityRowMap, PolicyRepository, compile_policy
+from ..policy.compiler import PolicyTensors
+from ..policy.resolve import EndpointPolicy
+from ..datapath.lpm import LPMTensors, compile_lpm
+from ..datapath.verdict import DatapathState, build_state
+
+
+@dataclass
+class World:
+    state: DatapathState
+    policies: List[EndpointPolicy]
+    ep_policy: np.ndarray
+    row_map: IdentityRowMap
+    ipcache: Dict[str, int]  # cidr -> numeric identity
+    alloc: CachingIdentityAllocator
+    repo: PolicyRepository
+    tensors: PolicyTensors
+    lpm: LPMTensors
+    pod_ips: List[str]
+
+
+def _pod_ip(i: int) -> str:
+    return f"10.{(i >> 16) & 0xFF}.{(i >> 8) & 0xFF}.{i & 0xFF}"
+
+
+def build_world(n_identities: int = 10_000, n_rules: int = 64,
+                ct_capacity: int = 1 << 20, ct_shards: int = 1,
+                row_capacity: Optional[int] = None) -> World:
+    """The 10k-identity benchmark world (BASELINE.md config #3).
+
+    Identities svc0..svcN-1 get /32 pod IPs; the subject endpoint (a
+    "db" workload, ep 0) has ``n_rules`` ingress rules allowing slices
+    of the identity space on assorted port ranges, CIDR allows, one
+    deny, and one L7 redirect — so the compiled tensors exercise every
+    verdict class.
+    """
+    alloc = CachingIdentityAllocator()
+    repo = PolicyRepository(alloc)
+    db = LabelSet.parse("k8s:app=db")
+    alloc.allocate(db)
+    world_id = alloc.allocate(LabelSet.parse("reserved:world")).numeric_id
+
+    pod_ips: List[str] = []
+    ipcache: Dict[str, int] = {}
+    for i in range(n_identities):
+        ident = alloc.allocate(LabelSet.parse(f"k8s:app=svc{i}",
+                                              "k8s:ns=default"))
+        ip = _pod_ip(i + 256)  # skip 10.0.0.x
+        pod_ips.append(ip)
+        ipcache[ip + "/32"] = ident.numeric_id
+    ipcache["0.0.0.0/0"] = world_id
+
+    # rule set: each rule allows one "service group" label slice on a
+    # port range; every identity matches ns=default so selector slices
+    # use app labels
+    rules: List[dict] = []
+    group = max(n_identities // n_rules, 1)
+    for r in range(n_rules):
+        ports = [{"port": str(1000 + r * 7), "protocol": "TCP",
+                  "endPort": 1000 + r * 7 + 5}]
+        sel = {"matchLabels": {"app": f"svc{r * group}"}}
+        rules.append({
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [
+                {"fromEndpoints": [sel], "toPorts": [{"ports": ports}]},
+            ],
+        })
+    rules.append({
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [
+            # broad: everyone in the namespace may reach 5432/TCP
+            {"fromEndpoints": [{"matchLabels": {"ns": "default"}}],
+             "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+            {"fromCIDR": ["192.168.0.0/16"],
+             "toPorts": [{"ports": [{"port": "8000", "endPort": 8999}]}]},
+            {"fromEndpoints": [{"matchLabels": {"ns": "default"}}],
+             "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}],
+                          "rules": {"http": [{"method": "GET"}]}}]},
+        ],
+        "ingressDeny": [
+            {"fromEndpoints": [{"matchLabels": {"app": "svc0"}}],
+             "toPorts": [{"ports": [{"port": "22", "protocol": "TCP"}]}]},
+        ],
+        "egress": [
+            {"toEntities": ["world"],
+             "toPorts": [{"ports": [{"port": "53", "protocol": "UDP"}]}]},
+        ],
+    })
+    repo.add_obj(rules)
+    pol_db = repo.resolve(db)
+
+    if row_capacity is None:
+        row_capacity = 1
+        while row_capacity < n_identities + 64:
+            row_capacity *= 2
+    row_map = IdentityRowMap(capacity=row_capacity)
+    for ident in alloc.all_identities():
+        row_map.add(ident.numeric_id)
+    policies = [pol_db]
+    tensors = compile_policy(policies, row_map)
+    lpm = compile_lpm({c: row_map.row(i) for c, i in ipcache.items()})
+    ep_policy = np.zeros(4096, dtype=np.int32)  # every ep -> db policy
+    state = build_state(tensors, lpm, ep_policy, ct_capacity=ct_capacity,
+                        ct_shards=ct_shards)
+    return World(state=state, policies=policies, ep_policy=ep_policy,
+                 row_map=row_map, ipcache=ipcache, alloc=alloc, repo=repo,
+                 tensors=tensors, lpm=lpm, pod_ips=pod_ips)
+
+
+def bench_traffic(world: World, n: int, rng: np.random.Generator,
+                  new_flow_frac: float = 0.05) -> np.ndarray:
+    """Benchmark traffic over the world's pod IPs: steady-state mix of
+    established flows + a trickle of new connections (iperf-ish)."""
+    from ..core.packets import (COL_DIR, COL_DPORT, COL_DST_IP3, COL_EP,
+                                COL_FAMILY, COL_FLAGS, COL_LEN, COL_PROTO,
+                                COL_SPORT, COL_SRC_IP3, N_COLS, TCP_ACK,
+                                TCP_SYN)
+    import ipaddress
+
+    out = np.zeros((n, N_COLS), dtype=np.uint32)
+    ips = np.array([int(ipaddress.IPv4Address(ip))
+                    for ip in world.pod_ips], dtype=np.uint32)
+    src = rng.choice(ips, n)
+    dst_db = int(ipaddress.IPv4Address(world.pod_ips[0]))
+    out[:, COL_SRC_IP3] = src
+    out[:, COL_DST_IP3] = dst_db
+    out[:, COL_SPORT] = rng.integers(1024, 61000, n, dtype=np.uint32)
+    out[:, COL_DPORT] = rng.choice(
+        np.array([5432, 5432, 80, 1007, 443, 8080], dtype=np.uint32), n)
+    out[:, COL_PROTO] = 6
+    is_new = rng.random(n) < new_flow_frac
+    out[:, COL_FLAGS] = np.where(is_new, TCP_SYN, TCP_ACK)
+    out[:, COL_LEN] = rng.integers(60, 1500, n, dtype=np.uint32)
+    out[:, COL_FAMILY] = 4
+    out[:, COL_EP] = 0
+    out[:, COL_DIR] = 0
+    return out

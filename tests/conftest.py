@@ -11,7 +11,10 @@ This file MUST set the environment before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force (not setdefault): the driver environment pre-sets
+# JAX_PLATFORMS=axon (the real TPU); unit tests always run on the
+# virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -23,3 +26,10 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(os.path.dirname(__file__), "..",
                                    ".jax_cache"))
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+
+# The driver image's sitecustomize imports jax at interpreter startup
+# (axon PJRT plugin), which snapshots JAX_PLATFORMS=axon before this
+# file runs — override via the config API too, before any backend init.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
