@@ -1,0 +1,17 @@
+"""Flow plane: Hubble-equivalent observability.
+
+Reference: upstream cilium ``pkg/hubble`` — ``parser/threefour``
+decodes monitor events into ``flow.Flow`` records enriched with
+identity/endpoint metadata; the observer keeps a ring buffer served
+over an API; metrics and exporters consume the same stream.
+
+TPU-first redesign: flows live as struct-of-arrays in a fixed-size
+ring (one vectorized append per device batch); typed Flow objects are
+materialized only at the query/export edge.
+"""
+
+from .flow import Flow, VERDICT_NAMES  # noqa: F401
+from .parser import ThreeFourParser  # noqa: F401
+from .observer import FlowFilter, Observer  # noqa: F401
+from .metrics import FlowMetrics  # noqa: F401
+from .exporter import FlowExporter  # noqa: F401

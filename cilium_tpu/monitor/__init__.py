@@ -1,0 +1,23 @@
+"""Monitor plane: the datapath event bus (perf ring buffer analogue).
+
+Reference: upstream cilium ``pkg/monitor`` — the perf-buffer reader
+that fans datapath events (drop/trace/policy-verdict) out to the
+``cilium monitor`` CLI and to Hubble.  TPU-first redesign: the device
+returns a per-packet out tensor from the fused pipeline; the host
+decodes it **vectorized** into a struct-of-arrays event batch, and the
+agent fans that out to subscribers (Hubble consumer, CLI stream,
+exporters) without per-event Python object churn.
+"""
+
+from .api import (  # noqa: F401
+    MSG_DROP,
+    MSG_POLICY_VERDICT,
+    MSG_TRACE,
+    DropNotify,
+    EventBatch,
+    MonitorEvent,
+    PolicyVerdictNotify,
+    TraceNotify,
+    decode_out,
+)
+from .agent import MonitorAgent  # noqa: F401

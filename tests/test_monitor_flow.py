@@ -1,0 +1,205 @@
+"""Monitor + Hubble flow pipeline tests.
+
+Modeled on the reference's pkg/hubble/parser golden tests (SURVEY.md
+§4): event payloads -> expected Flow fields; plus ring wraparound,
+filters, metrics aggregation and JSONL export.
+"""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cilium_tpu.core import TCP_ACK, TCP_SYN, make_batch
+from cilium_tpu.datapath import datapath_step_jit
+from cilium_tpu.flow import (
+    FlowExporter,
+    FlowFilter,
+    FlowMetrics,
+    Observer,
+    ThreeFourParser,
+)
+from cilium_tpu.monitor import (
+    MSG_DROP,
+    MSG_POLICY_VERDICT,
+    MSG_TRACE,
+    MonitorAgent,
+    MonitorEvent,
+    decode_out,
+)
+from cilium_tpu.policy.mapstate import VERDICT_ALLOW, VERDICT_DENY
+from cilium_tpu.testing.fixtures import bench_traffic, build_world
+
+
+@pytest.fixture(scope="module")
+def pipeline_result():
+    """Run one device batch through the datapath and decode events."""
+    world = build_world(n_identities=32, n_rules=4, ct_capacity=1 << 12)
+    rng = np.random.default_rng(5)
+    hdr = bench_traffic(world, 256, rng)
+    out, state = datapath_step_jit(world.state, jnp.asarray(hdr),
+                                   jnp.uint32(100))
+    batch = decode_out(np.asarray(out), hdr,
+                       world.row_map.numeric_array(), timestamp=1234.5)
+    return world, hdr, batch
+
+
+class TestMonitor:
+    def test_decode_types(self, pipeline_result):
+        world, hdr, batch = pipeline_result
+        assert len(batch) == 256
+        assert set(np.unique(batch.msg_type)) <= {MSG_DROP, MSG_TRACE,
+                                                  MSG_POLICY_VERDICT}
+        # first batch: every allowed packet is NEW -> policy verdict evt
+        assert (batch.msg_type != MSG_TRACE).all()
+
+    def test_wire_roundtrip(self, pipeline_result):
+        world, hdr, batch = pipeline_result
+        ev = next(iter(batch))
+        data = ev.pack()
+        assert len(data) == MonitorEvent.WIRE_SIZE
+        back = MonitorEvent.unpack(data, ev.timestamp)
+        assert back == ev
+
+    def test_agent_fanout_and_loss(self, pipeline_result):
+        world, hdr, batch = pipeline_result
+        agent = MonitorAgent(queue_depth=2)
+        seen = []
+        agent.register("hubble", lambda b: seen.append(len(b)))
+
+        def broken(b):
+            raise RuntimeError("boom")
+
+        agent.register("broken", broken)
+        q = agent.subscribe_queue("cli")
+        for _ in range(4):
+            agent.publish(batch)
+        assert seen == [256] * 4
+        assert agent.lost_count("broken") == 4 * 256
+        assert len(q) == 2  # bounded queue dropped the oldest
+        assert agent.lost_count("cli") > 0
+
+
+class TestObserver:
+    def _consume(self, obs, batch):
+        obs.consume(batch)
+
+    def test_flows_enriched(self, pipeline_result):
+        world, hdr, batch = pipeline_result
+        labels = {i.numeric_id: tuple(str(l) for l in i.labels)
+                  for i in world.alloc.all_identities()}
+        obs = Observer(capacity=1024,
+                       identity_getter=lambda n: labels.get(n, ()),
+                       endpoint_getter=lambda e: (f"pod-{e}", e))
+        obs.consume(batch)
+        flows = obs.get_flows(number=10)
+        assert len(flows) == 10
+        fl = flows[0]
+        # ingress: remote is source, local endpoint is destination
+        assert fl.destination.pod_name == "pod-0"
+        assert fl.source.identity > 0
+        assert fl.source.labels  # enriched from the allocator
+        d = fl.to_dict()
+        assert d["verdict"] in ("FORWARDED", "DROPPED", "REDIRECTED")
+        assert d["l4"]  # TCP section present
+        assert "Summary" in d
+
+    def test_ring_wraparound(self, pipeline_result):
+        world, hdr, batch = pipeline_result
+        obs = Observer(capacity=128)
+        for _ in range(3):
+            obs.consume(batch)  # 768 flows into a 128-ring
+        assert len(obs) == 128
+        flows = obs.get_flows(number=128)
+        assert len(flows) == 128
+        # newest-first: uuids strictly decreasing
+        uuids = [f.uuid for f in flows]
+        assert uuids == sorted(uuids, reverse=True)
+        assert uuids[0] == 3 * 256 - 1
+
+    def test_oversize_batch_keeps_ring_aligned(self, pipeline_result):
+        """A batch larger than the ring must keep oldest-pointer and
+        uuid order intact (regression: misaligned oversize append)."""
+        world, hdr, batch = pipeline_result
+        obs = Observer(capacity=8)  # 256-row batch >> 8-ring
+        obs.consume(batch)
+        uuids = [f.uuid for f in obs.get_flows(number=8)]
+        assert uuids == list(range(255, 247, -1))
+        # a following normal-size append lands as the newest rows
+        obs.consume(batch)
+        uuids = [f.uuid for f in obs.get_flows(number=8)]
+        assert uuids == list(range(511, 503, -1))
+
+    def test_filters(self, pipeline_result):
+        world, hdr, batch = pipeline_result
+        obs = Observer(capacity=1024)
+        obs.consume(batch)
+        fwd = obs.get_flows([FlowFilter(verdict=VERDICT_ALLOW)],
+                            number=1000)
+        assert all(f.verdict == VERDICT_ALLOW for f in fwd)
+        port = obs.get_flows([FlowFilter(port=5432)], number=1000)
+        assert all(5432 in (f.source.port, f.destination.port)
+                   for f in port)
+        assert len(port) > 0
+        # OR of two filters
+        both = obs.get_flows([FlowFilter(verdict=VERDICT_ALLOW),
+                              FlowFilter(port=5432)], number=1000)
+        assert len(both) >= max(len([f for f in fwd]), 0)
+
+    def test_parser_wire_decode(self, pipeline_result):
+        world, hdr, batch = pipeline_result
+        obs = Observer(capacity=64)
+        parser = ThreeFourParser(obs)
+        ev = next(iter(batch))
+        fl = parser.decode(ev.pack(), timestamp=9.0)
+        assert fl.source.ip == ev.src_ip
+        assert fl.destination.port == ev.dport
+        with pytest.raises(ValueError):
+            parser.decode(b"short")
+
+
+class TestMetricsExporter:
+    def test_metrics_render(self, pipeline_result):
+        world, hdr, batch = pipeline_result
+        m = FlowMetrics()
+        m.consume(batch)
+        text = m.render()
+        assert "hubble_flows_processed_total" in text
+        assert 'verdict="forwarded"' in text
+        total = sum(v for k, v in m.flows_total.items())
+        assert total == 256
+
+    def test_exporter_jsonl(self, pipeline_result, tmp_path):
+        world, hdr, batch = pipeline_result
+        p = str(tmp_path / "flows.log")
+        ex = FlowExporter(p)
+        ex.consume(batch)
+        ex.consume(batch)
+        ex.close()
+        lines = open(p).read().splitlines()
+        assert len(lines) == 512
+        rec = json.loads(lines[0])
+        assert "flow" in rec and "node_name" in rec
+        assert rec["flow"]["IP"]["source"]
+        # uuids monotone across batches
+        u0 = int(json.loads(lines[0])["flow"]["uuid"])
+        u511 = int(json.loads(lines[511])["flow"]["uuid"])
+        assert u511 == u0 + 511
+
+
+class TestEndToEndPipeline:
+    def test_datapath_to_flows(self, pipeline_result):
+        """Full wiring: datapath out -> monitor agent -> parser ->
+        observer + metrics + exporter (the serve() loop)."""
+        world, hdr, batch = pipeline_result
+        agent = MonitorAgent()
+        obs = Observer(capacity=1024)
+        parser = ThreeFourParser(obs)
+        metrics = FlowMetrics()
+        agent.register("hubble", parser.consume)
+        agent.register("metrics", metrics.consume)
+        agent.publish(batch)
+        assert parser.decoded == 256
+        assert len(obs) == 256
+        assert sum(metrics.flows_total.values()) == 256
