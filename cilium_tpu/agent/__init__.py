@@ -1,0 +1,11 @@
+"""The agent: per-node control plane (the cilium-agent analogue).
+
+Reference: upstream cilium ``daemon/`` + ``pkg/endpoint`` +
+``pkg/endpointmanager`` — process lifecycle, endpoint state machines,
+policy regeneration, and the wiring of every subsystem (SURVEY.md
+§3.1/§3.3 call stacks).
+"""
+
+from .endpoint import Endpoint, EndpointState  # noqa: F401
+from .endpointmanager import EndpointManager  # noqa: F401
+from .daemon import Daemon, DaemonConfig  # noqa: F401

@@ -1,0 +1,260 @@
+"""Daemon: the full agent wiring (NewDaemon, SURVEY.md §3.1).
+
+Reference: upstream cilium ``daemon/cmd`` — config parse, state
+restore, identity allocator, policy repository, datapath init,
+endpoint restore/regeneration, monitor + Hubble, API serve.
+
+Lifecycle here: construct -> (optionally) ``restore(dir)`` ->
+add endpoints / import policy -> ``process_batch`` per packet tensor ->
+``checkpoint(dir)`` on shutdown.  Background work (CT GC) runs in
+named controllers; identity churn invalidates resolve caches and
+coalesces into one regeneration (the SelectorCache-notification
+analogue).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..datapath.loader import InterpreterLoader, Loader, TPULoader
+from ..flow import FlowExporter, FlowMetrics, Observer, ThreeFourParser
+from ..identity.allocator import CachingIdentityAllocator
+from ..infra.controller import ControllerManager
+from ..ipcache import IPCache
+from ..kvstore import InMemoryKVStore
+from ..labels import LabelSet, SOURCE_CIDR
+from ..monitor import MonitorAgent, decode_out
+from ..monitor.api import EventBatch
+from ..policy.api import rule_to_dict
+from ..policy.repository import PolicyRepository
+from .endpoint import Endpoint
+from .endpointmanager import EndpointManager
+
+VERSION = "0.1.0"
+
+
+@dataclass
+class DaemonConfig:
+    """Reference: pkg/option.DaemonConfig (the ~300 viper flags; the
+    subset that matters here)."""
+
+    node_name: str = "node0"
+    backend: str = "tpu"  # "tpu" | "interpreter"
+    ct_capacity: int = 1 << 20
+    ct_gc_interval: float = 30.0
+    flow_ring_capacity: int = 4096
+    export_path: Optional[str] = None
+    state_dir: Optional[str] = None
+    enable_hubble: bool = True
+
+
+class Daemon:
+    def __init__(self, config: Optional[DaemonConfig] = None):
+        self.config = config or DaemonConfig()
+        self.kvstore = InMemoryKVStore()
+        self.allocator = CachingIdentityAllocator()
+        self.repo = PolicyRepository(self.allocator)
+        self.ipcache = IPCache()
+        if self.config.backend == "tpu":
+            self.loader: Loader = TPULoader(self.config.ct_capacity)
+        else:
+            self.loader = InterpreterLoader()
+        self.endpoints = EndpointManager(self.repo, self.ipcache,
+                                         self.loader)
+        self.monitor = MonitorAgent()
+        self.controllers = ControllerManager()
+        self._boot_time = time.time()
+        self._started = False
+
+        # hubble plane
+        self.observer = Observer(
+            capacity=self.config.flow_ring_capacity,
+            identity_getter=self._identity_labels,
+            endpoint_getter=self._endpoint_info)
+        self.parser = ThreeFourParser(self.observer)
+        self.flow_metrics = FlowMetrics()
+        self.exporter: Optional[FlowExporter] = None
+        if self.config.enable_hubble:
+            self.monitor.register("hubble", self.parser.consume)
+            self.monitor.register("metrics", self.flow_metrics.consume)
+        if self.config.export_path:
+            self.exporter = FlowExporter(
+                self.config.export_path, self.config.node_name,
+                identity_getter=self._identity_labels,
+                endpoint_getter=self._endpoint_info)
+            self.monitor.register("exporter", self.exporter.consume)
+
+        # wiring: rule changes and identity churn both end in one
+        # coalesced regeneration (SURVEY.md §3.3)
+        self.repo.on_change(lambda rev: self.endpoints.regenerate())
+        self.allocator.observe(self._on_identity_change)
+
+        # initial empty attach so the datapath is live pre-endpoints
+        self.endpoints.regenerate()
+
+    # -- getters for flow enrichment ---------------------------------
+    def _identity_labels(self, numeric: int) -> Tuple[str, ...]:
+        ident = self.allocator.lookup_by_id(numeric)
+        return tuple(str(l) for l in ident.labels) if ident else ()
+
+    def _endpoint_info(self, ep_id: int) -> Tuple[str, int]:
+        ep = self.endpoints.get(ep_id)
+        return (ep.name, ep.id) if ep else ("", ep_id)
+
+    # -- identity churn ----------------------------------------------
+    def _on_identity_change(self, kind: str, ident) -> None:
+        # CIDR-derived identities feed the ipcache (reference: ipcache
+        # CIDR entries appear when policy references them)
+        if kind == "add":
+            for l in ident.labels:
+                if l.source == SOURCE_CIDR:
+                    self.ipcache.upsert(l.key, ident.numeric_id,
+                                        source="generated")
+        if self._started:
+            self.repo.invalidate()  # also triggers regeneration
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> None:
+        """Start background controllers (CT GC)."""
+        self._started = True
+        self.controllers.update(
+            "ct-gc", lambda: self.loader.gc(self._now()),
+            self.config.ct_gc_interval)
+
+    def shutdown(self) -> None:
+        self.controllers.stop_all()
+        if self.exporter:
+            self.exporter.close()
+        if self.config.state_dir:
+            self.checkpoint(self.config.state_dir)
+
+    def _now(self) -> int:
+        return int(time.time() - self._boot_time) + 1
+
+    # -- the serve loop ----------------------------------------------
+    def process_batch(self, hdr: np.ndarray,
+                      now: Optional[int] = None) -> EventBatch:
+        """One packet tensor through the datapath + monitor fan-out."""
+        if now is None:
+            now = self._now()
+        out = self.loader.step(hdr, now)
+        batch = decode_out(out, hdr, self.loader.row_map.numeric_array(),
+                           timestamp=time.time())
+        self.monitor.publish(batch)
+        return batch
+
+    # -- policy API ---------------------------------------------------
+    def policy_import(self, obj) -> int:
+        return self.repo.add_obj(obj)
+
+    def policy_delete(self, labels: List[str]) -> int:
+        return self.repo.delete_by_labels(labels)
+
+    def policy_get(self) -> dict:
+        return {"revision": self.repo.revision,
+                "rules": [rule_to_dict(r) for r in self.repo.rules()]}
+
+    # -- endpoint API --------------------------------------------------
+    def add_endpoint(self, name: str, ips: Tuple[str, ...],
+                     labels: List[str]) -> Endpoint:
+        return self.endpoints.add(name, ips, LabelSet.parse(*labels))
+
+    # -- status --------------------------------------------------------
+    def status(self) -> dict:
+        m = self.loader.metrics()
+        return {
+            "version": VERSION,
+            "node": self.config.node_name,
+            "backend": self.config.backend,
+            "uptime-seconds": round(time.time() - self._boot_time, 1),
+            "policy-revision": self.repo.revision,
+            "endpoints": {
+                "total": len(self.endpoints.list()),
+                "by-state": self._eps_by_state(),
+            },
+            "identities": len(self.allocator.all_identities()),
+            "ipcache-entries": len(self.ipcache.entries()),
+            "regenerations": self.endpoints.regenerations,
+            "forwarded": int(m[0].sum()),
+            "dropped": int(m[1:].sum()),
+            "monitor-events": self.monitor.published,
+            "flows-seen": self.observer.seq,
+            "controllers": {
+                n: {"success": s.success_count, "failure": s.failure_count,
+                    "last-error": s.last_error.splitlines()[-1]
+                    if s.last_error else ""}
+                for n, s in self.controllers.statuses().items()},
+        }
+
+    def _eps_by_state(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for ep in self.endpoints.list():
+            out[ep.state.value] = out.get(ep.state.value, 0) + 1
+        return out
+
+    # -- checkpoint / restore -----------------------------------------
+    def checkpoint(self, state_dir: str) -> None:
+        """Persist control-plane state + CT snapshot (reference:
+        /var/run/cilium/state + pinned maps, SURVEY.md §5)."""
+        os.makedirs(state_dir, exist_ok=True)
+        ids = [{"id": i.numeric_id,
+                "labels": [str(l) for l in i.labels]}
+               for i in self.allocator.all_identities()]
+        eps = [ep.to_dict() for ep in self.endpoints.list()]
+        meta = {
+            "version": VERSION,
+            "node": self.config.node_name,
+            "revision": self.repo.revision,
+            "identities": ids,
+            "endpoints": eps,
+            "ipcache": [
+                {"cidr": e.cidr, "identity": e.identity,
+                 "source": e.source}
+                for e in self.ipcache.entries()
+                if e.source not in ("endpoint", "generated")],
+            "rules": [rule_to_dict(r) for r in self.repo.rules()],
+        }
+        tmp = os.path.join(state_dir, "state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, os.path.join(state_dir, "state.json"))
+        try:
+            ct = self.loader.ct_snapshot()
+            np.savez_compressed(os.path.join(state_dir, "ct.npz"),
+                                table=ct)
+        except NotImplementedError:
+            pass
+
+    def restore(self, state_dir: str) -> bool:
+        """Reload a checkpoint (the agent-restart path: datapath state
+        survives; endpoints re-register and regenerate)."""
+        path = os.path.join(state_dir, "state.json")
+        if not os.path.exists(path):
+            return False
+        with open(path) as f:
+            meta = json.load(f)
+        for rec in meta["identities"]:
+            self.allocator.restore_identity(
+                rec["id"], LabelSet.parse(*rec["labels"]))
+        for rec in meta["ipcache"]:
+            self.ipcache.upsert(rec["cidr"], rec["identity"],
+                                rec["source"])
+        if meta["rules"]:
+            self.repo.add_obj(meta["rules"])
+        for rec in meta["endpoints"]:
+            self.endpoints.add(rec["name"], tuple(rec["ips"]),
+                               LabelSet.parse(*rec["labels"]),
+                               ep_id=rec["id"])
+        ct_path = os.path.join(state_dir, "ct.npz")
+        if os.path.exists(ct_path):
+            try:
+                self.loader.ct_restore(np.load(ct_path)["table"])
+            except NotImplementedError:
+                pass
+        return True

@@ -1,0 +1,129 @@
+"""EndpointManager: registry + the regeneration pipeline.
+
+Reference: upstream cilium ``pkg/endpointmanager`` (registry, bulk
+regeneration triggers) + the regeneration flow of
+``pkg/endpoint/bpf.go`` (SURVEY.md §3.3): policy resolve ->
+policy-map/datapath update.
+
+TPU-first: all endpoints on the node share one compiled tensor set, so
+regeneration is: resolve one EndpointPolicy per DISTINCT subject
+identity (the distillery/PolicyCache sharing), assemble the policy
+list + endpoint->row map + ipcache view, and swap via the Loader.
+Bursts coalesce through a Trigger.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..datapath.loader import Loader
+from ..infra.trigger import Trigger
+from ..ipcache import IPCache
+from ..labels import LabelSet
+from ..policy.compiler import IdentityRowMap
+from ..policy.repository import PolicyRepository
+from .endpoint import Endpoint, EndpointState
+
+
+class EndpointManager:
+    def __init__(self, repo: PolicyRepository, ipcache: IPCache,
+                 loader: Loader, row_capacity: int = 1 << 14):
+        self._lock = threading.RLock()
+        self._endpoints: Dict[int, Endpoint] = {}
+        self._next_id = 1
+        self.repo = repo
+        self.ipcache = ipcache
+        self.loader = loader
+        self.row_capacity = row_capacity
+        self.regenerations = 0
+        self._regen_trigger = Trigger(self._regenerate_all,
+                                      name="endpoint-regeneration")
+
+    # -- registry ----------------------------------------------------
+    def add(self, name: str, ips: Tuple[str, ...], labels: LabelSet,
+            ep_id: Optional[int] = None) -> Endpoint:
+        """``ep_id`` pins a checkpointed id on restore so COL_EP
+        tagging, policy rows, and the CT snapshot stay coherent."""
+        with self._lock:
+            if ep_id is None:
+                ep_id = self._next_id
+            elif ep_id in self._endpoints:
+                raise ValueError(f"endpoint id {ep_id} already in use")
+            self._next_id = max(self._next_id, ep_id + 1)
+            ep = Endpoint(id=ep_id, name=name, ips=tuple(ips),
+                          labels=labels)
+            self._endpoints[ep_id] = ep
+        ident = self.repo.allocator.allocate(labels)
+        ep.identity = ident
+        for ip in ips:
+            suffix = "/128" if ":" in ip else "/32"
+            self.ipcache.upsert(ip + suffix, ident.numeric_id,
+                                source="endpoint")
+        self.regenerate()
+        return ep
+
+    def remove(self, ep_id: int) -> bool:
+        with self._lock:
+            ep = self._endpoints.pop(ep_id, None)
+        if ep is None:
+            return False
+        ep.state = EndpointState.DISCONNECTING
+        for ip in ep.ips:
+            suffix = "/128" if ":" in ip else "/32"
+            self.ipcache.delete(ip + suffix)
+        if ep.identity is not None:
+            self.repo.allocator.release(ep.identity)
+        self.regenerate()
+        return True
+
+    def get(self, ep_id: int) -> Optional[Endpoint]:
+        with self._lock:
+            return self._endpoints.get(ep_id)
+
+    def list(self) -> List[Endpoint]:
+        with self._lock:
+            return sorted(self._endpoints.values(), key=lambda e: e.id)
+
+    def lookup_by_ip(self, ip: str) -> Optional[Endpoint]:
+        with self._lock:
+            for ep in self._endpoints.values():
+                if ip in ep.ips:
+                    return ep
+        return None
+
+    # -- regeneration ------------------------------------------------
+    def regenerate(self) -> None:
+        """Trigger regeneration (coalesces bursts)."""
+        self._regen_trigger.trigger()
+
+    def _regenerate_all(self) -> None:
+        with self._lock:
+            eps = list(self._endpoints.values())
+        for ep in eps:
+            ep.state = EndpointState.REGENERATING
+        revision = self.repo.revision
+        # distillery: one resolved policy per distinct subject identity
+        policies = []
+        row_of: Dict[str, int] = {}
+        ep_policy: Dict[int, int] = {}
+        for ep in eps:
+            key = ep.labels.sorted_key()
+            if key not in row_of:
+                row_of[key] = len(policies)
+                policies.append(self.repo.resolve(ep.labels))
+            ep_policy[ep.id] = row_of[key]
+            ep.policy_row = row_of[key]
+        if not policies:
+            # no endpoints: an empty permissive policy keeps the
+            # datapath well-formed
+            policies = [self.repo.resolve(LabelSet.parse("reserved:init"))]
+        row_map = IdentityRowMap(capacity=self.row_capacity)
+        for ident in self.repo.allocator.all_identities():
+            row_map.add(ident.numeric_id)
+        self.loader.attach(policies, self.ipcache.to_identity_map(),
+                           ep_policy, row_map)
+        for ep in eps:
+            ep.state = EndpointState.READY
+            ep.policy_revision = revision
+        self.regenerations += 1

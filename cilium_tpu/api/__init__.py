@@ -1,0 +1,10 @@
+"""Agent REST API over a unix socket.
+
+Reference: upstream cilium ``api/v1`` (go-swagger REST served on
+``/var/run/cilium/cilium.sock``) — the surface the ``cilium`` CLI
+speaks.  Routes mirror the reference's verbs: /healthz, /policy,
+/endpoint, /identity, /map, /metrics, /flows, /config, /debuginfo.
+"""
+
+from .server import APIServer  # noqa: F401
+from .client import APIClient  # noqa: F401

@@ -1,0 +1,110 @@
+"""API client over the unix socket (the CLI's transport).
+
+Reference: upstream cilium ``api/v1/client`` (go-swagger generated)
+talking to ``/var/run/cilium/cilium.sock``.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+from typing import Optional
+
+DEFAULT_SOCKET = "/tmp/cilium-tpu/cilium.sock"
+
+
+class _UnixHTTPConnection(http.client.HTTPConnection):
+    def __init__(self, socket_path: str, timeout: float = 30.0):
+        super().__init__("localhost", timeout=timeout)
+        self._socket_path = socket_path
+
+    def connect(self) -> None:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(self.timeout)
+        try:
+            s.connect(self._socket_path)
+        except OSError as e:  # missing socket == agent down
+            raise ConnectionRefusedError(
+                f"no agent on {self._socket_path}: {e}") from e
+        self.sock = s
+
+
+class APIError(Exception):
+    def __init__(self, status: int, body):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = status
+        self.body = body
+
+
+class APIClient:
+    def __init__(self, socket_path: str = DEFAULT_SOCKET):
+        self.socket_path = socket_path
+
+    def _request(self, method: str, path: str, body=None):
+        conn = _UnixHTTPConnection(self.socket_path)
+        try:
+            payload = json.dumps(body) if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload \
+                else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+            data = (json.loads(raw) if "json" in ctype
+                    else raw.decode())
+            if resp.status >= 400:
+                raise APIError(resp.status, data)
+            return data
+        finally:
+            conn.close()
+
+    # typed verbs (mirroring api/v1 client surface)
+    def healthz(self):
+        return self._request("GET", "/healthz")
+
+    def config(self):
+        return self._request("GET", "/config")
+
+    def policy_get(self):
+        return self._request("GET", "/policy")
+
+    def policy_put(self, rules):
+        return self._request("PUT", "/policy", rules)
+
+    def policy_delete(self, labels):
+        return self._request("DELETE", "/policy", {"labels": labels})
+
+    def endpoint_list(self):
+        return self._request("GET", "/endpoint")
+
+    def endpoint_get(self, ep_id: int):
+        return self._request("GET", f"/endpoint/{ep_id}")
+
+    def endpoint_create(self, name: str, ips, labels):
+        return self._request("PUT", f"/endpoint/{name}",
+                             {"name": name, "ips": list(ips),
+                              "labels": list(labels)})
+
+    def endpoint_delete(self, ep_id: int):
+        return self._request("DELETE", f"/endpoint/{ep_id}")
+
+    def identity_list(self):
+        return self._request("GET", "/identity")
+
+    def map_list(self):
+        return self._request("GET", "/map")
+
+    def map_get(self, name: str):
+        return self._request("GET", f"/map/{name}")
+
+    def metrics(self) -> str:
+        return self._request("GET", "/metrics")
+
+    def flows(self, **params):
+        q = "&".join(f"{k}={v}" for k, v in params.items()
+                     if v is not None)
+        return self._request("GET", f"/flows{'?' + q if q else ''}")
+
+    def debuginfo(self):
+        return self._request("GET", "/debuginfo")

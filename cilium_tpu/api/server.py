@@ -1,0 +1,270 @@
+"""REST API server on a unix socket (cilium.sock analogue).
+
+Reference: upstream cilium ``api/v1`` REST API + the daemon handlers
+in ``daemon/cmd`` (``GET/PUT /policy``, ``GET /endpoint``, ...).
+Implemented with the stdlib http machinery over ``AF_UNIX``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socketserver
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+from typing import Optional
+
+from ..agent.daemon import Daemon
+from ..flow import FlowFilter
+
+
+class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class APIServer:
+    def __init__(self, daemon: Daemon, socket_path: str):
+        self.daemon = daemon
+        self.socket_path = socket_path
+        self._server: Optional[_UnixHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if os.path.exists(self.socket_path):
+            os.unlink(self.socket_path)
+        handler = _make_handler(self.daemon)
+        self._server = _UnixHTTPServer(self.socket_path, handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="api-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._server:
+            self._server.shutdown()
+            self._server.server_close()
+            if os.path.exists(self.socket_path):
+                os.unlink(self.socket_path)
+
+
+def _make_handler(daemon: Daemon):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # silence per-request stderr logging
+        def log_message(self, fmt, *args):  # noqa: N802
+            pass
+
+        def _send(self, code: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_text(self, code: int, text: str) -> None:
+            body = text.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _body(self):
+            n = int(self.headers.get("Content-Length", 0))
+            if n == 0:
+                return None
+            return json.loads(self.rfile.read(n))
+
+        def do_GET(self) -> None:  # noqa: N802
+            url = urllib.parse.urlparse(self.path)
+            q = urllib.parse.parse_qs(url.query)
+            path = url.path.rstrip("/") or "/"
+            try:
+                if path == "/healthz":
+                    self._send(200, daemon.status())
+                elif path == "/config":
+                    cfg = daemon.config
+                    self._send(200, {
+                        "node-name": cfg.node_name,
+                        "backend": cfg.backend,
+                        "ct-capacity": cfg.ct_capacity,
+                        "ct-gc-interval": cfg.ct_gc_interval,
+                        "flow-ring-capacity": cfg.flow_ring_capacity,
+                        "enable-hubble": cfg.enable_hubble,
+                    })
+                elif path == "/policy":
+                    self._send(200, daemon.policy_get())
+                elif path == "/endpoint":
+                    self._send(200, [ep.to_dict()
+                                     for ep in daemon.endpoints.list()])
+                elif m := re.fullmatch(r"/endpoint/(\d+)", path):
+                    ep = daemon.endpoints.get(int(m.group(1)))
+                    if ep is None:
+                        self._send(404, {"error": "endpoint not found"})
+                    else:
+                        self._send(200, ep.to_dict())
+                elif path == "/identity":
+                    self._send(200, [
+                        {"id": i.numeric_id,
+                         "labels": [str(l) for l in i.labels]}
+                        for i in daemon.allocator.all_identities()])
+                elif m := re.fullmatch(r"/identity/(\d+)", path):
+                    ident = daemon.allocator.lookup_by_id(int(m.group(1)))
+                    if ident is None:
+                        self._send(404, {"error": "identity not found"})
+                    else:
+                        self._send(200, {
+                            "id": ident.numeric_id,
+                            "labels": [str(l) for l in ident.labels]})
+                elif path == "/map":
+                    self._send(200, _map_list(daemon))
+                elif path == "/map/ipcache":
+                    self._send(200, [
+                        {"cidr": e.cidr, "identity": e.identity,
+                         "source": e.source}
+                        for e in daemon.ipcache.entries()])
+                elif path == "/map/ct":
+                    from ..datapath.conntrack import \
+                        ct_entries_from_snapshot
+
+                    limit = int(q.get("limit", ["1000"])[0])
+                    self._send(200, ct_entries_from_snapshot(
+                        daemon.loader.ct_snapshot(), limit))
+                elif m := re.fullmatch(r"/map/policy/(\d+)", path):
+                    self._send(200, _policy_map(daemon, int(m.group(1))))
+                elif path == "/metrics":
+                    self._send_text(200, _metrics_text(daemon))
+                elif path == "/flows":
+                    self._send(200, _flows(daemon, q))
+                elif path == "/debuginfo":
+                    self._send(200, {
+                        "status": daemon.status(),
+                        "policy": daemon.policy_get(),
+                        "subsystems": {
+                            "monitor-lost": {
+                                n: daemon.monitor.lost_count(n)
+                                for n in ("hubble", "metrics")},
+                        },
+                    })
+                else:
+                    self._send(404, {"error": f"no such path {path}"})
+            except Exception as e:  # surface handler bugs as 500s
+                self._send(500, {"error": str(e)})
+
+        def do_PUT(self) -> None:  # noqa: N802
+            path = self.path.rstrip("/")
+            try:
+                if path == "/policy":
+                    rev = daemon.policy_import(self._body())
+                    self._send(200, {"revision": rev})
+                elif m := re.fullmatch(r"/endpoint/([\w.-]+)", path):
+                    body = self._body() or {}
+                    ep = daemon.add_endpoint(
+                        body.get("name", m.group(1)),
+                        tuple(body.get("ips", ())),
+                        body.get("labels", []))
+                    self._send(201, ep.to_dict())
+                else:
+                    self._send(404, {"error": f"no such path {path}"})
+            except Exception as e:
+                self._send(500, {"error": str(e)})
+
+        def do_DELETE(self) -> None:  # noqa: N802
+            path = self.path.rstrip("/")
+            try:
+                if path == "/policy":
+                    body = self._body() or {}
+                    rev = daemon.policy_delete(body.get("labels", []))
+                    self._send(200, {"revision": rev})
+                elif m := re.fullmatch(r"/endpoint/(\d+)", path):
+                    ok = daemon.endpoints.remove(int(m.group(1)))
+                    self._send(200 if ok else 404, {"removed": ok})
+                else:
+                    self._send(404, {"error": f"no such path {path}"})
+            except Exception as e:
+                self._send(500, {"error": str(e)})
+
+    return Handler
+
+
+def _map_list(daemon: Daemon) -> list:
+    """GET /map — the BPF-maps listing analogue."""
+    out = [{"name": "cilium_ipcache",
+            "entries": len(daemon.ipcache.entries())}]
+    loader = daemon.loader
+    if getattr(loader, "state", None) is not None:
+        from ..datapath.conntrack import ct_live_count
+
+        out.append({"name": "cilium_ct_global",
+                    "entries": ct_live_count(loader.state.ct),
+                    "capacity": loader.state.ct.capacity})
+        v = loader.state.policy.verdict
+        out.append({"name": "cilium_policy",
+                    "shape": list(v.shape)})
+    return out
+
+
+def _policy_map(daemon: Daemon, ep_id: int) -> list:
+    """GET /map/policy/{ep} — the `bpf policy get` listing: the
+    realized policy-map entries for one endpoint."""
+    from ..policy.mapstate import PROTO_NAMES
+
+    ep = daemon.endpoints.get(ep_id)
+    if ep is None:
+        return []
+    pol = daemon.repo.resolve(ep.labels)
+    out = []
+    for ms in (pol.ingress, pol.egress):
+        for key, entry in ms.to_entries().items():
+            out.append({
+                "direction": "ingress" if key.direction == 0 else "egress",
+                "identity": key.identity,
+                "proto": PROTO_NAMES.get(key.proto, str(key.proto)),
+                "dport": (str(key.dport_lo) if key.dport_lo == key.dport_hi
+                          else f"{key.dport_lo}-{key.dport_hi}"),
+                "verdict": {0: "deny", 1: "allow", 2: "deny",
+                            3: "redirect"}[entry.verdict],
+                "proxy-port": entry.proxy_port,
+                "derived-from": list(entry.derived_from),
+            })
+    return out
+
+
+def _metrics_text(daemon: Daemon) -> str:
+    """Prometheus exposition: agent + hubble metrics (pkg/metrics)."""
+    m = daemon.loader.metrics()
+    lines = ["# TYPE cilium_datapath_packets_total counter"]
+    for reason in range(m.shape[0]):
+        for d in (0, 1):
+            if m[reason, d]:
+                lines.append(
+                    f'cilium_datapath_packets_total{{reason="{reason}",'
+                    f'direction="{"ingress" if d == 0 else "egress"}"}} '
+                    f'{int(m[reason, d])}')
+    lines.append(
+        f"cilium_policy_revision {daemon.repo.revision}")
+    lines.append(
+        f"cilium_endpoint_count {len(daemon.endpoints.list())}")
+    lines.append(
+        f"cilium_identity_count {len(daemon.allocator.all_identities())}")
+    return "\n".join(lines) + "\n" + daemon.flow_metrics.render()
+
+
+def _flows(daemon: Daemon, q: dict) -> list:
+    f = FlowFilter(
+        verdict=int(q["verdict"][0]) if "verdict" in q else None,
+        port=int(q["port"][0]) if "port" in q else None,
+        protocol=int(q["protocol"][0]) if "protocol" in q else None,
+        source_ip=q.get("source_ip", [None])[0],
+        destination_ip=q.get("destination_ip", [None])[0],
+    )
+    n = int(q.get("number", ["100"])[0])
+    filters = [] if all(
+        v is None for v in (f.verdict, f.port, f.protocol, f.source_ip,
+                            f.destination_ip)) else [f]
+    return [fl.to_dict() for fl in daemon.observer.get_flows(filters, n)]

@@ -1,15 +1,212 @@
 """cilium-tpu CLI (reference: cilium/cmd cobra CLI).
 
-Verbs mirror the reference operator tooling: ``policy import|get``,
-``endpoint list``, ``bpf policy get``, ``bpf ct list``, ``monitor``,
-``status``.  Grows alongside the agent; verbs not yet wired report so
-explicitly instead of failing cryptically.
+Verbs mirror the reference operator tooling: ``policy import|get|
+delete``, ``endpoint list|add|delete``, ``identity list``, ``bpf ct
+list``, ``bpf policy get``, ``map list``, ``monitor``, ``status``,
+``metrics``, ``flows`` (hubble observe), plus ``daemon run`` to start
+an agent serving the API socket.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+import time
+
+from ..api.client import DEFAULT_SOCKET, APIClient, APIError
+
+
+def _client(args) -> APIClient:
+    return APIClient(args.socket)
+
+
+def _print(obj) -> None:
+    print(json.dumps(obj, indent=2))
+
+
+def cmd_status(args) -> int:
+    st = _client(args).healthz()
+    if args.json:
+        _print(st)
+        return 0
+    print(f"Agent:     {st['node']} v{st['version']} "
+          f"(backend={st['backend']}, up {st['uptime-seconds']}s)")
+    print(f"Policy:    revision {st['policy-revision']}, "
+          f"{st['identities']} identities, "
+          f"{st['ipcache-entries']} ipcache entries")
+    eps = st["endpoints"]
+    print(f"Endpoints: {eps['total']} ({eps['by-state']})")
+    print(f"Datapath:  {st['forwarded']} forwarded, "
+          f"{st['dropped']} dropped, {st['flows-seen']} flows seen")
+    for name, c in st.get("controllers", {}).items():
+        ok = "ok" if not c["last-error"] else f"FAILING: {c['last-error']}"
+        print(f"Controller {name}: {c['success']} runs, {ok}")
+    return 0
+
+
+def cmd_policy(args) -> int:
+    c = _client(args)
+    if args.action == "get":
+        _print(c.policy_get())
+    elif args.action == "import":
+        if not args.file:
+            print("usage: cilium-tpu policy import FILE", file=sys.stderr)
+            return 1
+        with open(args.file) as f:
+            rules = json.load(f)
+        out = c.policy_put(rules)
+        print(f"Revision: {out['revision']}")
+    elif args.action == "delete":
+        out = c.policy_delete(args.labels.split(","))
+        print(f"Revision: {out['revision']}")
+    return 0
+
+
+def cmd_endpoint(args) -> int:
+    c = _client(args)
+    if args.action == "list":
+        eps = c.endpoint_list()
+        if args.json:
+            _print(eps)
+            return 0
+        print(f"{'ID':<6}{'STATE':<22}{'IDENTITY':<10}{'IPS':<34}NAME")
+        for ep in eps:
+            print(f"{ep['id']:<6}{ep['state']:<22}"
+                  f"{str(ep['identity']):<10}"
+                  f"{','.join(ep['ips']):<34}{ep['name']}")
+    elif args.action == "get":
+        _print(c.endpoint_get(args.id))
+    elif args.action == "add":
+        ep = c.endpoint_create(args.name, args.ip, args.label)
+        _print(ep)
+    elif args.action == "delete":
+        _print(c.endpoint_delete(args.id))
+    return 0
+
+
+def cmd_identity(args) -> int:
+    ids = _client(args).identity_list()
+    if args.json:
+        _print(ids)
+        return 0
+    print(f"{'ID':<12}LABELS")
+    for i in ids:
+        print(f"{i['id']:<12}{' '.join(i['labels'])}")
+    return 0
+
+
+def cmd_bpf(args) -> int:
+    c = _client(args)
+    if args.obj == "ct":
+        entries = c.map_get("ct")
+        if args.json:
+            _print(entries)
+            return 0
+        for e in entries:
+            print(f"{e['proto']} {args_dir(e)} {e['src']}:{e['sport']} "
+                  f"-> {e['dst']}:{e['dport']} {e['state']} "
+                  f"expires={e['expires']} tx={e['tx_packets']} "
+                  f"rx={e['rx_packets']}"
+                  + (f" proxy={e['proxy_port']}" if e['proxy_port']
+                     else ""))
+    elif args.obj == "policy":
+        entries = c.map_get(f"policy/{args.id}")
+        if args.json:
+            _print(entries)
+            return 0
+        print(f"{'DIR':<9}{'IDENTITY':<10}{'PROTO':<7}{'PORT':<12}"
+              f"{'VERDICT':<10}DERIVED-FROM")
+        for e in entries:
+            print(f"{e['direction']:<9}{e['identity']:<10}"
+                  f"{e['proto']:<7}{e['dport']:<12}{e['verdict']:<10}"
+                  f"{';'.join(e['derived-from'])}")
+    elif args.obj == "ipcache":
+        entries = c.map_get("ipcache")
+        if args.json:
+            _print(entries)
+            return 0
+        for e in entries:
+            print(f"{e['cidr']:<24}identity={e['identity']} "
+                  f"source={e['source']}")
+    return 0
+
+
+def args_dir(e) -> str:
+    return {"ingress": "in ", "egress": "out"}.get(e.get("dir", ""), "?")
+
+
+def cmd_map(args) -> int:
+    _print(_client(args).map_list())
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    print(_client(args).metrics(), end="")
+    return 0
+
+
+def cmd_flows(args) -> int:
+    c = _client(args)
+    flows = c.flows(number=args.number, verdict=args.verdict,
+                    port=args.port, protocol=args.protocol)
+    if args.json:
+        _print(flows)
+        return 0
+    for fl in reversed(flows):
+        print(f"{fl['time']:.3f} {fl['Summary']}")
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    """Tail the flow stream (reference: `cilium monitor`)."""
+    c = _client(args)
+    seen = 0
+    try:
+        while True:
+            flows = c.flows(number=500)
+            fresh = [f for f in flows if int(f["uuid"]) >= seen]
+            for fl in sorted(fresh, key=lambda f: int(f["uuid"])):
+                print(f"{fl['time']:.3f} [{fl['event_type']['type']}] "
+                      f"{fl['Summary']}")
+                seen = max(seen, int(fl["uuid"]) + 1)
+            if not args.follow:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_daemon(args) -> int:
+    import os
+
+    from ..agent.daemon import Daemon, DaemonConfig
+    from ..api.server import APIServer
+
+    cfg = DaemonConfig(
+        node_name=args.node_name,
+        backend=args.backend,
+        state_dir=args.state_dir,
+        export_path=args.export,
+    )
+    d = Daemon(cfg)
+    if args.state_dir and d.restore(args.state_dir):
+        print(f"restored state from {args.state_dir}")
+    d.start()
+    sock_dir = os.path.dirname(args.socket)
+    if sock_dir:
+        os.makedirs(sock_dir, exist_ok=True)
+    server = APIServer(d, args.socket)
+    server.start()
+    print(f"cilium-tpu agent up — API on {args.socket}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("shutting down")
+        server.stop()
+        d.shutdown()
+    return 0
 
 
 def main(argv=None) -> int:
@@ -17,19 +214,84 @@ def main(argv=None) -> int:
         prog="cilium-tpu",
         description="TPU-native network policy + flow analytics CLI",
     )
+    parser.add_argument("--socket", default=DEFAULT_SOCKET,
+                        help="agent API socket path")
+    parser.add_argument("--json", action="store_true",
+                        help="raw JSON output")
     sub = parser.add_subparsers(dest="cmd")
-    sub.add_parser("status", help="agent status")
+
     sub.add_parser("version", help="print version")
+    sub.add_parser("status", help="agent status")
+
+    p = sub.add_parser("policy", help="policy get|import|delete")
+    p.add_argument("action", choices=["get", "import", "delete"])
+    p.add_argument("file", nargs="?", help="rules JSON (import)")
+    p.add_argument("--labels", default="", help="labels (delete)")
+
+    p = sub.add_parser("endpoint", help="endpoint list|get|add|delete")
+    p.add_argument("action", choices=["list", "get", "add", "delete"])
+    p.add_argument("id", nargs="?", type=int)
+    p.add_argument("--name", default="ep")
+    p.add_argument("--ip", action="append", default=[])
+    p.add_argument("--label", action="append", default=[])
+
+    sub.add_parser("identity", help="identity list")
+
+    p = sub.add_parser("bpf", help="bpf ct list | bpf policy get ID | "
+                                   "bpf ipcache list")
+    p.add_argument("obj", choices=["ct", "policy", "ipcache"])
+    p.add_argument("action", nargs="?", default="list")
+    p.add_argument("id", nargs="?", type=int, default=0)
+
+    sub.add_parser("map", help="list datapath maps")
+    sub.add_parser("metrics", help="prometheus metrics")
+
+    p = sub.add_parser("flows", help="recent flows (hubble observe)")
+    p.add_argument("--number", type=int, default=20)
+    p.add_argument("--verdict", type=int)
+    p.add_argument("--port", type=int)
+    p.add_argument("--protocol", type=int)
+
+    p = sub.add_parser("monitor", help="tail the event stream")
+    p.add_argument("--follow", "-f", action="store_true")
+    p.add_argument("--interval", type=float, default=1.0)
+
+    p = sub.add_parser("daemon", help="run the agent")
+    p.add_argument("action", choices=["run"])
+    p.add_argument("--backend", default="tpu",
+                   choices=["tpu", "interpreter"])
+    p.add_argument("--node-name", default="node0")
+    p.add_argument("--state-dir")
+    p.add_argument("--export", help="flow export JSONL path")
+
     args = parser.parse_args(argv)
     if args.cmd == "version":
         from .. import __version__
+
         print(f"cilium-tpu {__version__}")
         return 0
-    if args.cmd == "status":
-        print("agent: not running (standalone CLI) — see cilium_tpu.api")
-        return 0
-    parser.print_help()
-    return 1
+    try:
+        handler = {
+            "status": cmd_status, "policy": cmd_policy,
+            "endpoint": cmd_endpoint, "identity": cmd_identity,
+            "bpf": cmd_bpf, "map": cmd_map, "metrics": cmd_metrics,
+            "flows": cmd_flows, "monitor": cmd_monitor,
+            "daemon": cmd_daemon,
+        }.get(args.cmd)
+        if handler is None:
+            parser.print_help()
+            return 1
+        return handler(args)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except ConnectionRefusedError:
+        print(f"error: agent not reachable on {args.socket} "
+              "(start one: cilium-tpu daemon run)", file=sys.stderr)
+        return 1
+    except APIError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

@@ -313,3 +313,39 @@ ct_keys_jit = jax.jit(ct_keys_from_headers)
 
 def ct_live_count(ct: CTTable) -> int:
     return int(np.asarray(jnp.sum(ct.table[:, V_STATE] != ST_FREE)))
+
+
+_STATE_NAMES = {ST_SYN_SENT: "SYN_SENT", ST_ESTABLISHED: "ESTABLISHED",
+                ST_CLOSING: "CLOSING"}
+
+
+def ct_entries_from_snapshot(table: np.ndarray,
+                             limit: int = 1000) -> list:
+    """Decode live CT rows for display (`cilium bpf ct list`)."""
+    from ..core.packets import words_to_ip
+
+    table = np.asarray(table)
+    live = np.nonzero(table[:, V_STATE] != ST_FREE)[0][:limit]
+    out = []
+    for i in live:
+        row = table[i]
+        proto = int(row[9]) & 0xFF
+        dirn = (int(row[9]) >> 8) & 1
+        fam = 4 if not row[0:3].any() else 6
+        out.append({
+            "src": words_to_ip(row[0:4], fam),
+            "dst": words_to_ip(row[4:8], fam),
+            "sport": int(row[8]) >> 16,
+            "dport": int(row[8]) & 0xFFFF,
+            "proto": proto,
+            "dir": "ingress" if dirn == 0 else "egress",
+            "state": _STATE_NAMES.get(int(row[V_STATE]),
+                                      str(int(row[V_STATE]))),
+            "expires": int(row[V_EXPIRES]),
+            "tx_packets": int(row[V_TX_PKTS]),
+            "rx_packets": int(row[V_RX_PKTS]),
+            "tx_bytes": int(row[V_TX_BYTES]),
+            "rx_bytes": int(row[V_RX_BYTES]),
+            "proxy_port": int(row[V_PROXY]),
+        })
+    return out

@@ -1,0 +1,199 @@
+"""The Loader seam: agent-facing datapath interface + backends.
+
+Reference: upstream cilium ``pkg/datapath`` — the ``Loader`` /
+``Datapath`` interfaces that ``daemon`` drives ("compile + attach"
+eBPF), with ``pkg/datapath/fake`` proving the seam supports non-eBPF
+backends.  BASELINE.md's north-star gates the TPU path behind exactly
+this seam: "compile+attach" becomes "compile policy/ipcache tensors +
+bind device buffers".
+
+Backends:
+- :class:`TPULoader` — device tensors + the fused jit pipeline.
+- :class:`InterpreterLoader` — the sequential oracle; runs the whole
+  agent without any accelerator (the fake-datapath analogue; also the
+  divergence-checking reference).
+
+Policy/ipcache updates swap tensors while KEEPING the live conntrack
+table and metric counters — the analogue of cilium replacing pinned
+BPF programs while maps persist in bpffs (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..policy.compiler import IdentityRowMap, compile_policy
+from ..policy.resolve import EndpointPolicy
+from .lpm import compile_lpm
+from .verdict import MAX_ENDPOINTS, DatapathState, DevicePolicy
+
+
+class Loader(abc.ABC):
+    """What the agent needs from a datapath (pkg/datapath.Loader)."""
+
+    @abc.abstractmethod
+    def attach(self, policies: Sequence[EndpointPolicy],
+               ipcache: Dict[str, int], ep_policy: Dict[int, int],
+               row_map: IdentityRowMap) -> None:
+        """Full (re)compile + swap — endpoint regeneration's final step.
+
+        ``ipcache`` maps cidr -> NUMERIC identity; ``ep_policy`` maps
+        endpoint id -> row index into ``policies``."""
+
+    @abc.abstractmethod
+    def step(self, hdr: np.ndarray, now: int) -> np.ndarray:
+        """Verdict one batch; returns the out tensor [N, N_OUT]."""
+
+    @abc.abstractmethod
+    def gc(self, now: int) -> int:
+        """Expire CT entries; returns eviction count."""
+
+    @abc.abstractmethod
+    def metrics(self) -> np.ndarray:
+        """[N_REASONS, 2] per-reason/direction packet counters."""
+
+    @abc.abstractmethod
+    def ct_snapshot(self) -> np.ndarray:
+        """CT table contents for checkpoint / `bpf ct list`."""
+
+    @abc.abstractmethod
+    def ct_restore(self, table: np.ndarray) -> None:
+        """Reload a CT snapshot (agent restart keeps connections)."""
+
+
+class TPULoader(Loader):
+    """The real datapath: device tensors + fused jit pipeline."""
+
+    def __init__(self, ct_capacity: int = 1 << 20):
+        import threading
+
+        import jax.numpy as jnp  # deferred so CPU-only tools can import
+
+        self._jnp = jnp
+        self.ct_capacity = ct_capacity
+        self.state: Optional[DatapathState] = None
+        self.row_map: Optional[IdentityRowMap] = None
+        self.attach_count = 0
+        # attach() runs on API/regeneration threads while the serve
+        # loop is in step(); every state swap must be atomic or a
+        # concurrent step would resurrect the pre-attach tensors
+        self._lock = threading.Lock()
+
+    def attach(self, policies, ipcache, ep_policy, row_map) -> None:
+        from .conntrack import CTTable
+        from .lpm import DeviceLPM
+
+        tensors = compile_policy(list(policies), row_map)
+        lpm = compile_lpm({c: row_map.row(i) for c, i in ipcache.items()})
+        epp = np.zeros(MAX_ENDPOINTS, dtype=np.int32)
+        for ep_id, pol_row in ep_policy.items():
+            epp[ep_id] = pol_row
+        policy = DevicePolicy.from_tensors(tensors, epp)
+        device_lpm = DeviceLPM.from_tensors(lpm)
+        with self._lock:
+            self.row_map = row_map
+            self.tensors = tensors
+            if self.state is None:  # keep live CT + counters otherwise
+                self.state = DatapathState.create(
+                    policy=policy, ipcache=device_lpm,
+                    ct=CTTable.create(self.ct_capacity))
+            else:
+                self.state = DatapathState(
+                    policy=policy, ipcache=device_lpm,
+                    ct=self.state.ct, metrics=self.state.metrics)
+            self.attach_count += 1
+
+    def step(self, hdr: np.ndarray, now: int) -> np.ndarray:
+        from .verdict import datapath_step_jit
+
+        jnp = self._jnp
+        hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        with self._lock:
+            out, self.state = datapath_step_jit(self.state, hdr,
+                                                jnp.uint32(now))
+        return np.asarray(out)
+
+    def gc(self, now: int) -> int:
+        from .conntrack import ct_gc_jit
+
+        with self._lock:
+            ct, n = ct_gc_jit(self.state.ct, self._jnp.uint32(now))
+            self.state = DatapathState(
+                policy=self.state.policy, ipcache=self.state.ipcache,
+                ct=ct, metrics=self.state.metrics)
+        return int(n)
+
+    def metrics(self) -> np.ndarray:
+        with self._lock:
+            return np.asarray(self.state.metrics)
+
+    def ct_snapshot(self) -> np.ndarray:
+        with self._lock:
+            return np.asarray(self.state.ct.table)
+
+    def ct_restore(self, table: np.ndarray) -> None:
+        from .conntrack import CTTable
+
+        jnp = self._jnp
+        with self._lock:
+            self.state = DatapathState(
+                policy=self.state.policy, ipcache=self.state.ipcache,
+                ct=CTTable(table=jnp.asarray(table),
+                           dropped=jnp.zeros((), jnp.uint32)),
+                metrics=self.state.metrics)
+
+
+class InterpreterLoader(Loader):
+    """Oracle-backed datapath — no accelerator needed (fake datapath)."""
+
+    def __init__(self, ct_capacity: int = 0):
+        self.oracle = None
+        self.row_map: Optional[IdentityRowMap] = None
+        self._metrics = np.zeros((8, 2), dtype=np.uint64)
+        self.attach_count = 0
+
+    def attach(self, policies, ipcache, ep_policy, row_map) -> None:
+        from ..testing.oracle import OracleDatapath
+
+        old_ct = self.oracle.ct if self.oracle is not None else None
+        self.row_map = row_map
+        pol_by_ep = {ep: policies[row] for ep, row in ep_policy.items()}
+        # default: endpoints not listed use policy row 0 when present
+        if policies:
+            import collections
+
+            default_pol = policies[0]
+            pol_by_ep = collections.defaultdict(lambda: default_pol,
+                                                pol_by_ep)
+        self.oracle = OracleDatapath(pol_by_ep, dict(ipcache))
+        if old_ct is not None:
+            self.oracle.ct = old_ct
+        self.attach_count += 1
+
+    def step(self, hdr: np.ndarray, now: int) -> np.ndarray:
+        from ..core.packets import HeaderBatch, COL_DIR
+        from .verdict import N_OUT
+
+        results = self.oracle.step(HeaderBatch(np.asarray(hdr)), now)
+        out = np.zeros((len(results), N_OUT), dtype=np.uint32)
+        for i, r in enumerate(results):
+            out[i] = (r.verdict, r.proxy, r.ct,
+                      self.row_map.row(r.identity), r.reason, r.event)
+            self._metrics[r.reason, int(hdr[i][COL_DIR])] += 1
+        return out
+
+    def gc(self, now: int) -> int:
+        return self.oracle.gc(now)
+
+    def metrics(self) -> np.ndarray:
+        return self._metrics.copy()
+
+    def ct_snapshot(self) -> np.ndarray:
+        raise NotImplementedError(
+            "interpreter CT is a dict; checkpoint via the agent state")
+
+    def ct_restore(self, table: np.ndarray) -> None:
+        raise NotImplementedError

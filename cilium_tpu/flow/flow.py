@@ -32,6 +32,12 @@ PROTO_NAMES = {6: "TCP", 17: "UDP", 1: "ICMPv4", 58: "ICMPv6",
 EVENT_TYPE_NAMES = {1: "DropNotify", 4: "TraceNotify",
                     9: "PolicyVerdictNotify", 129: "L7"}
 
+# flow.proto DropReason enum-style names (hubble JSON renders strings)
+DROP_REASON_DESC = {
+    1: "POLICY_DENIED",
+    2: "POLICY_DENY_DEFAULT",
+}
+
 
 @dataclass
 class FlowEndpoint:
@@ -102,7 +108,9 @@ class Flow:
             "is_reply": self.is_reply,
         }
         if self.drop_reason:
-            d["drop_reason_desc"] = self.drop_reason
+            d["drop_reason_desc"] = DROP_REASON_DESC.get(
+                self.drop_reason, f"DROP_REASON_{self.drop_reason}")
+            d["drop_reason"] = self.drop_reason
         if self.l7:
             d["l7"] = self.l7
         d["Summary"] = self.summary()

@@ -15,7 +15,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from ..core.packets import COL_DIR, COL_DPORT, COL_PROTO
-from ..monitor.api import MSG_DROP, EventBatch
+from ..monitor.api import MSG_DROP, MSG_POLICY_VERDICT, EventBatch
 from ..policy.mapstate import VERDICT_ALLOW, VERDICT_REDIRECT
 
 
@@ -56,7 +56,7 @@ class FlowMetrics:
         uniq, counts = np.unique(key, return_counts=True)
         for k, n in zip(uniq.tolist(), counts.tolist()):
             self.port_distribution[(k >> 16, k & 0xFFFF)] += n
-        verdict_ev = batch.msg_type == 9
+        verdict_ev = batch.msg_type == MSG_POLICY_VERDICT
         if verdict_ev.any():
             allowed = fwd & verdict_ev
             self.policy_verdicts[("allowed", "L3_L4")] += int(allowed.sum())

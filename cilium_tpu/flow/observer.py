@@ -130,9 +130,10 @@ class Observer:
         with self._lock:
             if n >= self.capacity:  # keep the newest capacity rows
                 sl = slice(n - self.capacity, n)
-                # stay aligned with seq so get_flows' oldest-pointer
-                # (seq % capacity) keeps meaning after the append
-                pos = (self.seq % self.capacity
+                # land each kept row where a sequential append of all n
+                # rows would have put it, so get_flows' oldest-pointer
+                # ((seq + n) % capacity) stays meaningful for any n
+                pos = (self.seq + n - self.capacity
                        + np.arange(self.capacity)) % self.capacity
             else:
                 start = self.seq % self.capacity

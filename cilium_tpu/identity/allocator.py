@@ -125,6 +125,40 @@ class CachingIdentityAllocator:
             self._notify("remove", ident)
             return True
 
+    # -- restore (checkpoint/resume) -------------------------------------
+    def restore_identity(self, numeric_id: int,
+                         labels: LabelSet) -> Identity:
+        """Re-register a checkpointed identity under its old numeric id
+        (reference: identities restored from the state dir / CRDs keep
+        their numbers so policy maps stay valid across restarts)."""
+        key = labels.sorted_key()
+        with self._lock:
+            if key in RESERVED_BY_LABELS:
+                return self._by_labels[key]
+            existing = self._by_id.get(numeric_id)
+            if existing is not None:
+                if existing.labels.sorted_key() != key:
+                    raise ValueError(
+                        f"identity {numeric_id} already bound to "
+                        f"{existing.labels}")
+                return existing  # idempotent, holds no ref
+            ident = Identity(numeric_id, labels)
+            self._by_labels[key] = ident
+            self._by_id[numeric_id] = ident
+            # the restore itself holds NO reference: restored endpoints
+            # re-allocate (ref 1 each) as they register, so deleting
+            # them later frees the identity instead of leaking it.
+            # Orphans (refcount 0, e.g. CIDR identities whose rules are
+            # gone) are swept by identity GC (the operator's job in the
+            # reference).
+            self._refcount[numeric_id] = 0
+            if numeric_id & LOCAL_IDENTITY_FLAG:
+                self._next_local = max(self._next_local, numeric_id + 1)
+            else:
+                self._next_id = max(self._next_id, numeric_id + 1)
+            self._notify("add", ident)
+            return ident
+
     # -- lookup ----------------------------------------------------------
     def lookup_by_id(self, numeric_id: int) -> Optional[Identity]:
         with self._lock:

@@ -1,0 +1,9 @@
+"""Cross-cutting plumbing mirrored from the reference's pkg/ utilities.
+
+Reference: ``pkg/controller`` (named retry loops with backoff, surfaced
+in ``cilium status``), ``pkg/trigger`` (debounced triggers serializing
+expensive work like endpoint regeneration).
+"""
+
+from .controller import Controller, ControllerManager  # noqa: F401
+from .trigger import Trigger  # noqa: F401

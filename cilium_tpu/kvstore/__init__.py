@@ -1,0 +1,13 @@
+"""kvstore: cluster state store with watches (etcd analogue).
+
+Reference: upstream cilium ``pkg/kvstore`` — the etcd client behind
+identity allocation, node discovery, and ClusterMesh, with the
+``store`` shared-store pattern (watch a prefix, mirror into memory).
+
+The in-memory backend serves a single host (tests, single-node runs);
+the same interface backs the multi-host store when processes join via
+``jax.distributed`` (one process elected writer; replicas mirror by
+watch replay — the ClusterMesh analogue).
+"""
+
+from .store import InMemoryKVStore, KVEvent, SharedStore  # noqa: F401

@@ -1,0 +1,160 @@
+"""In-memory kvstore with revisioned watches + the shared-store mirror.
+
+Reference: upstream cilium ``pkg/kvstore`` (etcd ``Get/Update/Delete``
++ ``Watch`` with mod-revisions, lease TTLs for liveness) and
+``pkg/kvstore/store`` (``SharedStore``: local keys written by this
+node, remote keys mirrored from watch events).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class KVEvent:
+    kind: str  # "create" | "modify" | "delete"
+    key: str
+    value: bytes
+    revision: int
+
+
+Watcher = Callable[[KVEvent], None]
+
+
+class InMemoryKVStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._data: Dict[str, Tuple[bytes, int]] = {}  # key -> (val, rev)
+        self._leases: Dict[str, float] = {}  # key -> expiry
+        self._revision = 0
+        self._watchers: List[Tuple[str, Watcher]] = []
+
+    # -- kv ops ------------------------------------------------------
+    def get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            self._expire_leases()
+            v = self._data.get(key)
+            return v[0] if v else None
+
+    def update(self, key: str, value: bytes,
+               lease_ttl: Optional[float] = None) -> int:
+        with self._lock:
+            self._revision += 1
+            kind = "modify" if key in self._data else "create"
+            self._data[key] = (value, self._revision)
+            if lease_ttl is not None:
+                self._leases[key] = time.time() + lease_ttl
+            rev = self._revision
+            self._notify(KVEvent(kind, key, value, rev))
+            return rev
+
+    def create_only(self, key: str, value: bytes,
+                    lease_ttl: Optional[float] = None) -> bool:
+        """Atomic create-if-absent (the allocator's claim op)."""
+        with self._lock:
+            self._expire_leases()
+            if key in self._data:
+                return False
+            self.update(key, value, lease_ttl)
+            return True
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            if key not in self._data:
+                return False
+            self._revision += 1
+            self._data.pop(key)
+            self._leases.pop(key, None)
+            self._notify(KVEvent("delete", key, b"", self._revision))
+            return True
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        with self._lock:
+            self._expire_leases()
+            return {k: v for k, (v, _) in self._data.items()
+                    if k.startswith(prefix)}
+
+    def keepalive(self, key: str, lease_ttl: float) -> bool:
+        """Refresh a lease (the heartbeat path)."""
+        with self._lock:
+            if key not in self._data:
+                return False
+            self._leases[key] = time.time() + lease_ttl
+            return True
+
+    # -- watches -----------------------------------------------------
+    def watch_prefix(self, prefix: str, fn: Watcher,
+                     replay: bool = True) -> Callable[[], None]:
+        """Subscribe; optionally replay existing keys as creates.
+        Returns an unsubscribe function."""
+        with self._lock:
+            if replay:
+                for k, (v, rev) in sorted(self._data.items()):
+                    if k.startswith(prefix):
+                        fn(KVEvent("create", k, v, rev))
+            entry = (prefix, fn)
+            self._watchers.append(entry)
+
+        def cancel() -> None:
+            with self._lock:
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+        return cancel
+
+    def _notify(self, ev: KVEvent) -> None:
+        for prefix, fn in list(self._watchers):
+            if ev.key.startswith(prefix):
+                fn(ev)
+
+    def _expire_leases(self) -> None:
+        now = time.time()
+        dead = [k for k, exp in self._leases.items() if exp < now]
+        for k in dead:
+            self._leases.pop(k, None)
+            if k in self._data:
+                self._revision += 1
+                self._data.pop(k)
+                self._notify(KVEvent("delete", k, b"", self._revision))
+
+
+class SharedStore:
+    """Prefix mirror: local writes + remote watch replay into one view.
+
+    Reference: pkg/kvstore/store.SharedStore — each node writes its own
+    keys under a shared prefix and observes everyone's."""
+
+    def __init__(self, kv: InMemoryKVStore, prefix: str, node: str):
+        self.kv = kv
+        self.prefix = prefix.rstrip("/") + "/"
+        self.node = node
+        self._mirror: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+        self._cancel = kv.watch_prefix(self.prefix, self._on_event)
+
+    def _on_event(self, ev: KVEvent) -> None:
+        with self._lock:
+            if ev.kind == "delete":
+                self._mirror.pop(ev.key, None)
+            else:
+                self._mirror[ev.key] = ev.value
+
+    def update_local(self, name: str, value: bytes,
+                     lease_ttl: Optional[float] = None) -> None:
+        self.kv.update(f"{self.prefix}{self.node}/{name}", value,
+                       lease_ttl)
+
+    def delete_local(self, name: str) -> None:
+        self.kv.delete(f"{self.prefix}{self.node}/{name}")
+
+    def snapshot(self) -> Dict[str, bytes]:
+        with self._lock:
+            return dict(self._mirror)
+
+    def close(self) -> None:
+        self._cancel()

@@ -398,3 +398,95 @@ def rules_from_obj(obj) -> List[Rule]:
     if isinstance(obj, dict):
         return [rule_from_dict(obj)]
     return [rule_from_dict(d) for d in obj]
+
+
+# ---------------------------------------------------------------------------
+# Serialization (GET /policy renders the repository back as JSON)
+
+
+def _selector_to_dict(sel: EndpointSelector) -> dict:
+    d: dict = {}
+    if sel.match_labels:
+        d["matchLabels"] = {k: v for k, v in sel.match_labels}
+    if sel.match_expressions:
+        d["matchExpressions"] = [
+            {"key": r.key, "operator": r.operator,
+             **({"values": list(r.values)} if r.values else {})}
+            for r in sel.match_expressions]
+    return d
+
+
+def _ports_to_dict(pr: PortRule) -> dict:
+    d: dict = {"ports": [
+        {"port": p.port, "protocol": p.protocol,
+         **({"endPort": p.end_port} if p.end_port else {})}
+        for p in pr.ports]}
+    rules: dict = {}
+    if pr.rules.http:
+        rules["http"] = [
+            {k: v for k, v in (("method", h.method), ("path", h.path),
+                               ("host", h.host)) if v}
+            for h in pr.rules.http]
+    if pr.rules.dns:
+        rules["dns"] = [
+            {k: v for k, v in (("matchName", x.match_name),
+                               ("matchPattern", x.match_pattern)) if v}
+            for x in pr.rules.dns]
+    if pr.rules.kafka:
+        rules["kafka"] = [dict(x) for x in pr.rules.kafka]
+    if rules:
+        d["rules"] = rules
+    return d
+
+
+def _ingress_to_dict(r: IngressRule) -> dict:
+    d: dict = {}
+    if r.from_endpoints:
+        d["fromEndpoints"] = [_selector_to_dict(s) for s in r.from_endpoints]
+    if r.from_cidr:
+        d["fromCIDRSet"] = [
+            {"cidr": c.cidr,
+             **({"except": list(c.except_cidrs)} if c.except_cidrs else {})}
+            for c in r.from_cidr]
+    if r.from_entities:
+        d["fromEntities"] = list(r.from_entities)
+    if r.to_ports:
+        d["toPorts"] = [_ports_to_dict(p) for p in r.to_ports]
+    return d
+
+
+def _egress_to_dict(r: EgressRule) -> dict:
+    d: dict = {}
+    if r.to_endpoints:
+        d["toEndpoints"] = [_selector_to_dict(s) for s in r.to_endpoints]
+    if r.to_cidr:
+        d["toCIDRSet"] = [
+            {"cidr": c.cidr,
+             **({"except": list(c.except_cidrs)} if c.except_cidrs else {})}
+            for c in r.to_cidr]
+    if r.to_entities:
+        d["toEntities"] = list(r.to_entities)
+    if r.to_fqdns:
+        d["toFQDNs"] = [
+            ({"matchPattern": f} if "*" in f else {"matchName": f})
+            for f in r.to_fqdns]
+    if r.to_ports:
+        d["toPorts"] = [_ports_to_dict(p) for p in r.to_ports]
+    return d
+
+
+def rule_to_dict(rule: Rule) -> dict:
+    d: dict = {"endpointSelector": _selector_to_dict(rule.endpoint_selector)}
+    if rule.ingress:
+        d["ingress"] = [_ingress_to_dict(r) for r in rule.ingress]
+    if rule.ingress_deny:
+        d["ingressDeny"] = [_ingress_to_dict(r) for r in rule.ingress_deny]
+    if rule.egress:
+        d["egress"] = [_egress_to_dict(r) for r in rule.egress]
+    if rule.egress_deny:
+        d["egressDeny"] = [_egress_to_dict(r) for r in rule.egress_deny]
+    if rule.labels:
+        d["labels"] = list(rule.labels)
+    if rule.description:
+        d["description"] = rule.description
+    return d

@@ -64,6 +64,15 @@ class PolicyRepository:
             fn(rev)
         return rev
 
+    def invalidate(self) -> int:
+        """Bump the revision without a rule change — identity churn
+        makes cached resolutions stale because peer sets are frozen at
+        resolve time (reference: SelectorCache identity notifications
+        trigger incremental policy-map updates; here the daemon calls
+        this and regenerates)."""
+        with self._lock:
+            return self._bump()
+
     # -- queries ---------------------------------------------------------
     @property
     def revision(self) -> int:

@@ -1,0 +1,249 @@
+"""Agent control plane + REST API + CLI tests.
+
+Covers the SURVEY.md §3.1/§3.3 call stacks: daemon wiring, endpoint
+add/remove + regeneration, identity-churn invalidation, policy import
+round trip, checkpoint/restore, the Loader seam (tpu vs interpreter
+backends agreeing), the API server/client, and the CLI.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_ACK, TCP_SYN, make_batch
+from cilium_tpu.infra import Controller, Trigger
+from cilium_tpu.monitor.api import MSG_DROP, MSG_POLICY_VERDICT, MSG_TRACE
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [
+        {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+         "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+    ],
+    "labels": ["db-policy"],
+}]
+
+
+def _mk_daemon(backend="tpu", **kw) -> Daemon:
+    return Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12,
+                               **kw))
+
+
+def _pkt(src, dst, dport, ep, dirn=0, flags=TCP_SYN, sport=40000):
+    return dict(src=src, dst=dst, sport=sport, dport=dport, proto=6,
+                flags=flags, ep=ep, dir=dirn)
+
+
+class TestDaemon:
+    def test_end_to_end_policy_enforcement(self):
+        d = _mk_daemon()
+        web = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES)
+        batch = make_batch([
+            _pkt("10.0.1.1", "10.0.2.1", 5432, db.id),  # allowed
+            _pkt("10.0.1.1", "10.0.2.1", 22, db.id),  # default deny
+        ])
+        evb = d.process_batch(batch.data, now=10)
+        assert list(evb.verdict) == [1, 0]
+        assert list(evb.msg_type) == [MSG_POLICY_VERDICT, MSG_DROP]
+        # flows landed in hubble
+        flows = d.observer.get_flows(number=10)
+        assert len(flows) == 2
+        assert flows[1].verdict == 1 and flows[0].verdict == 0
+        # identities enriched from the allocator
+        assert any("app=web" in l for l in flows[1].source.labels)
+        st = d.status()
+        assert st["forwarded"] == 1 and st["endpoints"]["total"] == 2
+
+    def test_identity_churn_regenerates(self):
+        """A NEW pod matching an existing selector must be allowed
+        without any rule change (regression: peer sets frozen at
+        resolve time)."""
+        d = _mk_daemon()
+        d._started = True  # enable churn-invalidation wiring
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES)
+        web1 = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        out = d.process_batch(make_batch(
+            [_pkt("10.0.1.1", "10.0.2.1", 5432, db.id)]).data, now=5)
+        assert out.verdict[0] == 1
+        # new identity (different labels, still app=web via extra label)
+        web2 = d.add_endpoint("web-2", ("10.0.1.2",),
+                              ["k8s:app=web", "k8s:zone=b"])
+        out = d.process_batch(make_batch(
+            [_pkt("10.0.1.2", "10.0.2.1", 5432, db.id, sport=40001)]).data,
+            now=6)
+        assert out.verdict[0] == 1, "new identity not granted by selector"
+
+    def test_endpoint_remove_denies(self):
+        d = _mk_daemon()
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        web = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        d.policy_import(RULES)
+        d.endpoints.remove(web.id)
+        # web's ipcache entry is gone: traffic resolves to world ->
+        # not selected by the rule -> default deny
+        out = d.process_batch(make_batch(
+            [_pkt("10.0.1.1", "10.0.2.1", 5432, db.id)]).data, now=5)
+        assert out.verdict[0] == 0
+
+    def test_backends_agree(self):
+        """The Loader seam: tpu and interpreter daemons produce the
+        same verdicts (the fake-datapath proof)."""
+        results = {}
+        for backend in ("tpu", "interpreter"):
+            d = _mk_daemon(backend)
+            db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+            d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+            d.policy_import(RULES)
+            batch = make_batch([
+                _pkt("10.0.1.1", "10.0.2.1", 5432, db.id),
+                _pkt("10.0.1.1", "10.0.2.1", 80, db.id),
+                _pkt("10.0.1.1", "10.0.2.1", 5432, db.id,
+                     flags=TCP_ACK, sport=40002),
+            ])
+            evb = d.process_batch(batch.data, now=20)
+            results[backend] = (list(evb.verdict), list(evb.ct_state),
+                                list(evb.identity))
+        assert results["tpu"] == results["interpreter"]
+
+    def test_ct_gc_controller(self):
+        d = _mk_daemon()
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        d.policy_import(RULES)
+        d.process_batch(make_batch(
+            [_pkt("10.0.1.1", "10.0.2.1", 5432, db.id)]).data, now=5)
+        assert d.loader.gc(now=5) == 0  # still alive
+        assert d.loader.gc(now=10_000) == 1  # SYN lifetime expired
+
+
+class TestCheckpointRestore:
+    def test_round_trip(self, tmp_path):
+        state_dir = str(tmp_path / "state")
+        d = _mk_daemon()
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        d.policy_import(RULES)
+        # establish a connection pre-restart
+        d.process_batch(make_batch(
+            [_pkt("10.0.1.1", "10.0.2.1", 5432, db.id)]).data, now=30)
+        ids_before = {i.numeric_id: str(i.labels)
+                      for i in d.allocator.all_identities()}
+        d.checkpoint(state_dir)
+
+        d2 = _mk_daemon()
+        assert d2.restore(state_dir)
+        ids_after = {i.numeric_id: str(i.labels)
+                     for i in d2.allocator.all_identities()}
+        assert ids_before == ids_after  # numerics survive restart
+        assert d2.policy_get()["rules"] == d.policy_get()["rules"]
+        assert len(d2.endpoints.list()) == 2
+        # the restored CT keeps the established connection: a non-SYN
+        # packet of the old flow is EST, not policy-evaluated
+        db2 = [e for e in d2.endpoints.list() if e.name == "db-1"][0]
+        out = d2.process_batch(make_batch(
+            [_pkt("10.0.1.1", "10.0.2.1", 5432, db2.id,
+                  flags=TCP_ACK)]).data, now=35)
+        assert out.ct_state[0] == 1  # CT_ESTABLISHED from snapshot
+
+
+class TestAPIandCLI:
+    @pytest.fixture()
+    def served(self, tmp_path):
+        from cilium_tpu.api import APIClient, APIServer
+
+        d = _mk_daemon()
+        sock = "/tmp/ciltpu-test.sock"
+        server = APIServer(d, sock)
+        server.start()
+        yield d, APIClient(sock), sock
+        server.stop()
+
+    def test_rest_round_trip(self, served):
+        d, c, sock = served
+        assert c.healthz()["version"]
+        c.endpoint_create("db-1", ["10.0.2.1"], ["k8s:app=db"])
+        c.endpoint_create("web-1", ["10.0.1.1"], ["k8s:app=web"])
+        rev = c.policy_put(RULES)["revision"]
+        assert c.policy_get()["revision"] == rev
+        eps = c.endpoint_list()
+        assert {e["name"] for e in eps} == {"db-1", "web-1"}
+        db_id = [e for e in eps if e["name"] == "db-1"][0]["id"]
+        d.process_batch(make_batch(
+            [_pkt("10.0.1.1", "10.0.2.1", 5432, db_id)]).data, now=3)
+        flows = c.flows(number=5)
+        assert len(flows) == 1 and flows[0]["verdict"] == "FORWARDED"
+        ct = c.map_get("ct")
+        assert len(ct) == 1 and ct[0]["dport"] == 5432
+        pol = c.map_get(f"policy/{db_id}")
+        assert any(e["verdict"] == "allow" and e["dport"] == "5432"
+                   for e in pol)
+        metrics = c.metrics()
+        assert "cilium_policy_revision" in metrics
+        assert "hubble_flows_processed_total" in metrics
+        assert c.debuginfo()["status"]["endpoints"]["total"] == 2
+        # deletes
+        assert c.policy_delete(["db-policy"])["revision"] > rev
+        assert c.endpoint_delete(db_id)["removed"] is True
+
+    def test_cli(self, served, capsys):
+        d, c, sock = served
+        from cilium_tpu.cli.main import main
+
+        c.endpoint_create("db-1", ["10.0.2.1"], ["k8s:app=db"])
+        assert main(["--socket", sock, "status"]) == 0
+        out = capsys.readouterr().out
+        assert "Agent:" in out and "Endpoints: 1" in out
+        assert main(["--socket", sock, "endpoint", "list"]) == 0
+        assert "db-1" in capsys.readouterr().out
+        assert main(["--socket", sock, "identity"]) == 0
+        assert "app=db" in capsys.readouterr().out
+        assert main(["--socket", sock, "version"]) == 0
+        # policy import via file
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            json.dump(RULES, f)
+        assert main(["--socket", sock, "policy", "import", f.name]) == 0
+        assert "Revision" in capsys.readouterr().out
+        assert main(["--socket", sock, "bpf", "ipcache"]) == 0
+        assert "10.0.2.1/32" in capsys.readouterr().out
+        os.unlink(f.name)
+
+    def test_cli_agent_unreachable(self, capsys):
+        from cilium_tpu.cli.main import main
+
+        assert main(["--socket", "/tmp/nope-9x.sock", "status"]) == 1
+        assert "not reachable" in capsys.readouterr().err
+
+
+class TestInfra:
+    def test_controller_backoff_status(self):
+        calls = []
+
+        def fail():
+            calls.append(1)
+            raise RuntimeError("kaboom")
+
+        c = Controller("t", fail, interval=100)
+        assert c.run_once() is False
+        assert c.status.consecutive_failures == 1
+        assert "kaboom" in c.status.last_error
+
+        ok = Controller("t2", lambda: calls.append(2), interval=100)
+        assert ok.run_once() is True
+        assert ok.status.success_count == 1
+
+    def test_trigger_coalesces(self):
+        runs = []
+        t = Trigger(lambda: runs.append(1))
+        t.trigger()
+        t.trigger()
+        assert len(runs) == 2  # idle triggers run synchronously
