@@ -52,6 +52,8 @@ class DaemonConfig:
     export_path: Optional[str] = None
     state_dir: Optional[str] = None
     enable_hubble: bool = True
+    anomaly_model_path: Optional[str] = None  # trained AnomalyModel .npz
+    anomaly_threshold: float = 0.8
 
 
 class Daemon:
@@ -89,6 +91,17 @@ class Daemon:
                 identity_getter=self._identity_labels,
                 endpoint_getter=self._endpoint_info)
             self.monitor.register("exporter", self.exporter.consume)
+        # learned path: advisory anomaly scores on the monitor stream
+        self.anomaly = None
+        if self.config.anomaly_model_path:
+            from ..ml import AnomalyScorer, load_model
+
+            self.anomaly = AnomalyScorer(
+                load_model(self.config.anomaly_model_path),
+                lambda numeric: (self.loader.row_map.row(numeric)
+                                 if self.loader.row_map else 0),
+                threshold=self.config.anomaly_threshold)
+            self.monitor.register("anomaly", self.anomaly.consume)
 
         # wiring: rule changes and identity churn both end in one
         # coalesced regeneration (SURVEY.md §3.3)

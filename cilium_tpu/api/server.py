@@ -141,6 +141,12 @@ def _make_handler(daemon: Daemon):
                     self._send_text(200, _metrics_text(daemon))
                 elif path == "/flows":
                     self._send(200, _flows(daemon, q))
+                elif path == "/anomaly":
+                    if daemon.anomaly is None:
+                        self._send(404, {"error": "anomaly scoring "
+                                         "not enabled"})
+                    else:
+                        self._send(200, daemon.anomaly.stats())
                 elif path == "/debuginfo":
                     self._send(200, {
                         "status": daemon.status(),

@@ -158,6 +158,11 @@ def cmd_flows(args) -> int:
     return 0
 
 
+def cmd_anomaly(args) -> int:
+    _print(_client(args)._request("GET", "/anomaly"))
+    return 0
+
+
 def cmd_monitor(args) -> int:
     """Tail the flow stream (reference: `cilium monitor`)."""
     c = _client(args)
@@ -188,6 +193,7 @@ def cmd_daemon(args) -> int:
         backend=args.backend,
         state_dir=args.state_dir,
         export_path=args.export,
+        anomaly_model_path=args.anomaly_model,
     )
     d = Daemon(cfg)
     if args.state_dir and d.restore(args.state_dir):
@@ -256,6 +262,8 @@ def main(argv=None) -> int:
     p.add_argument("--follow", "-f", action="store_true")
     p.add_argument("--interval", type=float, default=1.0)
 
+    sub.add_parser("anomaly", help="learned-path anomaly stats")
+
     p = sub.add_parser("daemon", help="run the agent")
     p.add_argument("action", choices=["run"])
     p.add_argument("--backend", default="tpu",
@@ -263,6 +271,7 @@ def main(argv=None) -> int:
     p.add_argument("--node-name", default="node0")
     p.add_argument("--state-dir")
     p.add_argument("--export", help="flow export JSONL path")
+    p.add_argument("--anomaly-model", help="trained AnomalyModel .npz")
 
     args = parser.parse_args(argv)
     if args.cmd == "version":
@@ -276,7 +285,7 @@ def main(argv=None) -> int:
             "endpoint": cmd_endpoint, "identity": cmd_identity,
             "bpf": cmd_bpf, "map": cmd_map, "metrics": cmd_metrics,
             "flows": cmd_flows, "monitor": cmd_monitor,
-            "daemon": cmd_daemon,
+            "anomaly": cmd_anomaly, "daemon": cmd_daemon,
         }.get(args.cmd)
         if handler is None:
             parser.print_help()

@@ -1,0 +1,24 @@
+"""Learned flow classification: the anomaly side of the north-star.
+
+BASELINE.md: "policy evaluation is a learned + rule-encoded classifier
+... pkg/policy's SelectorCache and identity->label mapping compile into
+the model's embedding table; verdicts and anomaly scores flow back via
+pkg/monitor."  The rule-encoded half is the dense verdict tensor
+(authoritative — packets drop only on rule verdicts); this package is
+the learned half: an identity-embedding + MLP anomaly scorer over
+datapath flow features, trained data-parallel over the device mesh.
+The anomaly score is ADVISORY (never overrides a rule allow), keeping
+the <=1% divergence gate intact by construction.
+"""
+
+from .features import FEAT_DIM, flow_features  # noqa: F401
+from .model import (  # noqa: F401
+    AnomalyModel,
+    forward,
+    init_params,
+    label_embedding_init,
+    load_model,
+    save_model,
+)
+from .train import auc, make_train_step, synth_labeled_traffic, train  # noqa: F401
+from .scorer import AnomalyScorer  # noqa: F401

@@ -1,0 +1,66 @@
+"""Flow feature extraction — on-device, straight from the datapath
+tensors (no host round trip on the hot path).
+
+Features mirror what CIC-IDS2017-style flow classifiers consume
+(packet sizes, flags, ports, direction, CT state) with the remote
+identity handled separately as an embedding index (the SelectorCache
+-derived table in ``ml.model``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from ..core.packets import (
+    COL_DIR,
+    COL_DPORT,
+    COL_FLAGS,
+    COL_LEN,
+    COL_PROTO,
+    COL_SPORT,
+)
+from ..datapath.verdict import OUT_CT, OUT_ID_ROW
+
+FEAT_DIM = 18
+
+
+def flow_features(hdr: jnp.ndarray, out: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Header + out tensors -> (id_row [N] int32, feats [N, FEAT_DIM]
+    float32 in roughly [0, 1])."""
+    hdr = hdr.astype(jnp.uint32)
+    proto = hdr[:, COL_PROTO].astype(jnp.float32)
+    dport = hdr[:, COL_DPORT].astype(jnp.float32)
+    sport = hdr[:, COL_SPORT].astype(jnp.float32)
+    length = hdr[:, COL_LEN].astype(jnp.float32)
+    flags = hdr[:, COL_FLAGS]
+    dirn = hdr[:, COL_DIR].astype(jnp.float32)
+    ct = out[:, OUT_CT].astype(jnp.float32)
+
+    def bit(b):
+        return ((flags >> b) & 1).astype(jnp.float32)
+
+    feats = jnp.stack([
+        (proto == 6).astype(jnp.float32),
+        (proto == 17).astype(jnp.float32),
+        (proto == 1).astype(jnp.float32) + (proto == 58).astype(
+            jnp.float32),
+        jnp.log1p(dport) / 12.0,
+        jnp.log1p(sport) / 12.0,
+        (dport < 1024).astype(jnp.float32),  # well-known port
+        jnp.log1p(length) / 12.0,
+        (length < 100).astype(jnp.float32),  # tiny packets (scans)
+        bit(0),  # FIN
+        bit(1),  # SYN
+        bit(2),  # RST
+        bit(3),  # PSH
+        bit(4),  # ACK
+        dirn,
+        (ct == 0).astype(jnp.float32),  # NEW
+        (ct == 1).astype(jnp.float32),  # ESTABLISHED
+        (ct == 2).astype(jnp.float32),  # REPLY
+        jnp.ones_like(dirn),  # bias
+    ], axis=1)
+    return out[:, OUT_ID_ROW].astype(jnp.int32), feats

@@ -1,0 +1,124 @@
+"""The anomaly model: identity embedding + MLP head (pure jax pytree).
+
+North-star hook: the embedding table's rows are INITIALIZED from each
+identity's label set (feature-hashed multi-hot projected to the
+embedding dim), i.e. the SelectorCache identity->labels mapping
+compiles into the table — label-similar workloads start near each
+other before any gradient step.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .features import FEAT_DIM
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class AnomalyModel:
+    embed: jnp.ndarray  # [V, D] identity embedding table
+    w1: jnp.ndarray  # [D + FEAT_DIM, H]
+    b1: jnp.ndarray
+    w2: jnp.ndarray  # [H, H]
+    b2: jnp.ndarray
+    w3: jnp.ndarray  # [H, 1]
+    b3: jnp.ndarray
+
+    def tree_flatten(self):
+        return ((self.embed, self.w1, self.b1, self.w2, self.b2,
+                 self.w3, self.b3), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def label_embedding_init(labels_by_row: Dict[int, Tuple[str, ...]],
+                         n_rows: int, dim: int,
+                         seed: int = 7) -> np.ndarray:
+    """Identity labels -> embedding rows by feature hashing.
+
+    Each label string hashes to ``dim`` signed buckets; a row is the
+    normalized sum over its labels, so identities sharing labels get
+    correlated rows (the SelectorCache compilation)."""
+    table = np.zeros((n_rows, dim), dtype=np.float32)
+    for row, labels in labels_by_row.items():
+        if row >= n_rows:
+            continue
+        v = np.zeros(dim, dtype=np.float32)
+        for lab in labels:
+            h = hashlib.blake2b(f"{seed}:{lab}".encode(),
+                                digest_size=8).digest()
+            idx = int.from_bytes(h[:4], "little") % dim
+            sign = 1.0 if h[4] & 1 else -1.0
+            v[idx] += sign
+        norm = np.linalg.norm(v)
+        if norm > 0:
+            table[row] = v / norm
+    return table
+
+
+def init_params(rng: jax.Array, n_rows: int, dim: int = 32,
+                hidden: int = 64,
+                labels_by_row: Optional[Dict[int, Tuple[str, ...]]] = None
+                ) -> AnomalyModel:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    if labels_by_row is not None:
+        embed = jnp.asarray(label_embedding_init(labels_by_row, n_rows,
+                                                 dim))
+    else:
+        embed = jax.random.normal(k1, (n_rows, dim)) * 0.05
+    fan_in = dim + FEAT_DIM
+    return AnomalyModel(
+        embed=embed.astype(jnp.float32),
+        w1=jax.random.normal(k1, (fan_in, hidden)) * (2.0 / fan_in) ** 0.5,
+        b1=jnp.zeros(hidden),
+        w2=jax.random.normal(k2, (hidden, hidden)) * (2.0 / hidden) ** 0.5,
+        b2=jnp.zeros(hidden),
+        w3=jax.random.normal(k3, (hidden, 1)) * (2.0 / hidden) ** 0.5,
+        b3=jnp.zeros(1),
+    )
+
+
+def forward(params: AnomalyModel, id_row: jnp.ndarray,
+            feats: jnp.ndarray) -> jnp.ndarray:
+    """-> anomaly logits [N].  bfloat16 matmuls on the MXU, float32
+    accumulation/output."""
+    e = params.embed[id_row]  # [N, D] gather
+    x = jnp.concatenate([e, feats], axis=1).astype(jnp.bfloat16)
+    h = jax.nn.relu(x @ params.w1.astype(jnp.bfloat16)
+                    + params.b1.astype(jnp.bfloat16))
+    h = jax.nn.relu(h @ params.w2.astype(jnp.bfloat16)
+                    + params.b2.astype(jnp.bfloat16))
+    logit = h @ params.w3.astype(jnp.bfloat16) + params.b3.astype(
+        jnp.bfloat16)
+    return logit[:, 0].astype(jnp.float32)
+
+
+def bce_loss(params: AnomalyModel, id_row: jnp.ndarray,
+             feats: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    logits = forward(params, id_row, feats)
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels
+        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+
+def save_model(path: str, params: AnomalyModel) -> None:
+    """Persist to .npz (part of the agent checkpoint family)."""
+    np.savez_compressed(
+        path, **{k: np.asarray(v) for k, v in zip(
+            ("embed", "w1", "b1", "w2", "b2", "w3", "b3"),
+            params.tree_flatten()[0])})
+
+
+def load_model(path: str) -> AnomalyModel:
+    z = np.load(path)
+    return AnomalyModel(*(jnp.asarray(z[k]) for k in
+                          ("embed", "w1", "b1", "w2", "b2", "w3", "b3")))
