@@ -156,8 +156,8 @@ class Daemon:
         """One packet tensor through the datapath + monitor fan-out."""
         if now is None:
             now = self._now()
-        out = self.loader.step(hdr, now)
-        batch = decode_out(out, hdr, self.loader.row_map.numeric_array(),
+        out, row_map = self.loader.step(hdr, now)
+        batch = decode_out(out, hdr, row_map.numeric_array(),
                            timestamp=time.time())
         self.monitor.publish(batch)
         return batch
@@ -237,12 +237,13 @@ class Daemon:
         with open(tmp, "w") as f:
             json.dump(meta, f, indent=1)
         os.replace(tmp, os.path.join(state_dir, "state.json"))
-        try:
-            ct = self.loader.ct_snapshot()
-            np.savez_compressed(os.path.join(state_dir, "ct.npz"),
-                                table=ct)
-        except NotImplementedError:
-            pass
+        ct = self.loader.ct_snapshot()
+        # atomic like state.json: a crash mid-savez must not leave a
+        # corrupt ct.npz that poisons the next restore
+        ct_tmp = os.path.join(state_dir, "ct.npz.tmp")
+        with open(ct_tmp, "wb") as f:
+            np.savez_compressed(f, table=ct)
+        os.replace(ct_tmp, os.path.join(state_dir, "ct.npz"))
 
     def restore(self, state_dir: str) -> bool:
         """Reload a checkpoint (the agent-restart path: datapath state
@@ -268,6 +269,12 @@ class Daemon:
         if os.path.exists(ct_path):
             try:
                 self.loader.ct_restore(np.load(ct_path)["table"])
-            except NotImplementedError:
-                pass
+            except Exception as e:  # corrupt snapshot: identities/
+                # rules/endpoints above are already restored; losing
+                # live connections is the lesser failure
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "CT snapshot restore failed (%s); continuing "
+                    "without connection state", e)
         return True

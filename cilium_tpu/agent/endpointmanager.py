@@ -45,11 +45,18 @@ class EndpointManager:
             ep_id: Optional[int] = None) -> Endpoint:
         """``ep_id`` pins a checkpointed id on restore so COL_EP
         tagging, policy rows, and the CT snapshot stay coherent."""
+        from ..datapath.verdict import MAX_ENDPOINTS
+
         with self._lock:
             if ep_id is None:
                 ep_id = self._next_id
             elif ep_id in self._endpoints:
                 raise ValueError(f"endpoint id {ep_id} already in use")
+            if not 0 < ep_id < MAX_ENDPOINTS:
+                raise ValueError(
+                    f"endpoint id {ep_id} out of range (1.."
+                    f"{MAX_ENDPOINTS - 1}); the ep_policy table is "
+                    f"fixed at {MAX_ENDPOINTS} rows")
             self._next_id = max(self._next_id, ep_id + 1)
             ep = Endpoint(id=ep_id, name=name, ips=tuple(ips),
                           labels=labels)

@@ -21,7 +21,11 @@ Known deliberate divergences from eBPF (documented for the divergence
 suite): duplicate tuples in one batch collapse to one entry with
 last-writer counters (the kernel, processing serially, would count
 both; the accounting delta is bounded by batch size and reconciled at
-the flow layer).
+the flow layer).  Per-flow tx/rx packet and byte counters are uint32
+table words and WRAP at 2^32 (the reference ctmap uses u64) — a
+deliberate trade: one uint32 row keeps insert a single scatter; flows
+past 4 GiB show wrapped accounting in ``bpf ct list`` (the flow layer
+aggregates per-batch deltas host-side in uint64 and is unaffected).
 """
 
 from __future__ import annotations
@@ -317,6 +321,56 @@ def ct_live_count(ct: CTTable) -> int:
 
 _STATE_NAMES = {ST_SYN_SENT: "SYN_SENT", ST_ESTABLISHED: "ESTABLISHED",
                 ST_CLOSING: "CLOSING"}
+
+
+def _hash_np(keys: np.ndarray) -> np.ndarray:
+    """Host-side FNV-1a identical to :func:`_hash` (for re-placement)."""
+    keys = keys.astype(np.uint32)
+    h = np.full(keys.shape[0], 0x811C9DC5, dtype=np.uint32)
+    with np.errstate(over="ignore"):
+        for w in range(KEY_WORDS):
+            h = (h ^ keys[:, w]) * np.uint32(0x01000193)
+    return h
+
+
+def ct_rows_from_table(table: np.ndarray) -> np.ndarray:
+    """Live rows of a (hashed) CT table -> dense [n, ROW_WORDS] array.
+
+    The dense form is the portable snapshot format: it carries no slot
+    placement, so it can be restored into a table of ANY capacity (or
+    into the interpreter backend's dict)."""
+    table = np.asarray(table)
+    return table[table[:, V_STATE] != ST_FREE].copy()
+
+
+def ct_table_from_rows(rows: np.ndarray,
+                       capacity: int) -> Tuple[np.ndarray, int]:
+    """Rebuild a hashed CT table from dense snapshot rows.
+
+    Re-places every entry with the same FNV hash + linear probe the
+    device uses, so a snapshot taken at one capacity (or from the
+    interpreter oracle) restores correctly into another.  Returns
+    ``(table, n_dropped)``: entries that cannot be placed within the
+    probe window are dropped and counted — seed ``CTTable.dropped``
+    with the count so restore-time map pressure shows in metrics like
+    live-insert pressure does."""
+    assert capacity & (capacity - 1) == 0, "capacity must be 2^k"
+    table = np.zeros((capacity, ROW_WORDS), dtype=np.uint32)
+    rows = np.asarray(rows, dtype=np.uint32)
+    if rows.size == 0:
+        return table, 0
+    mask = capacity - 1
+    n_dropped = 0
+    hs = _hash_np(rows[:, :KEY_WORDS])
+    for row, h in zip(rows, hs):
+        for step in range(N_PROBE):
+            s = int((h + step) & mask)
+            if table[s, V_STATE] == ST_FREE:
+                table[s] = row
+                break
+        else:
+            n_dropped += 1
+    return table, n_dropped
 
 
 def ct_entries_from_snapshot(table: np.ndarray,

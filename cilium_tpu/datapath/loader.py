@@ -44,8 +44,14 @@ class Loader(abc.ABC):
         endpoint id -> row index into ``policies``."""
 
     @abc.abstractmethod
-    def step(self, hdr: np.ndarray, now: int) -> np.ndarray:
-        """Verdict one batch; returns the out tensor [N, N_OUT]."""
+    def step(self, hdr: np.ndarray, now: int):
+        """Verdict one batch.
+
+        Returns ``(out, row_map)``: the out tensor [N, N_OUT] plus the
+        IdentityRowMap snapshot that produced it.  The snapshot is
+        taken under the same lock as the device step so a concurrent
+        ``attach`` can never make the caller decode OUT_ID_ROW values
+        through the wrong row table."""
 
     @abc.abstractmethod
     def gc(self, now: int) -> int:
@@ -90,6 +96,11 @@ class TPULoader(Loader):
         lpm = compile_lpm({c: row_map.row(i) for c, i in ipcache.items()})
         epp = np.zeros(MAX_ENDPOINTS, dtype=np.int32)
         for ep_id, pol_row in ep_policy.items():
+            if not 0 <= ep_id < MAX_ENDPOINTS:
+                # on-device gathers clamp out-of-range ids to the last
+                # row, silently diverging from the oracle — reject here
+                raise ValueError(
+                    f"endpoint id {ep_id} out of range [0, {MAX_ENDPOINTS})")
             epp[ep_id] = pol_row
         policy = DevicePolicy.from_tensors(tensors, epp)
         device_lpm = DeviceLPM.from_tensors(lpm)
@@ -106,7 +117,7 @@ class TPULoader(Loader):
                     ct=self.state.ct, metrics=self.state.metrics)
             self.attach_count += 1
 
-    def step(self, hdr: np.ndarray, now: int) -> np.ndarray:
+    def step(self, hdr: np.ndarray, now: int):
         from .verdict import datapath_step_jit
 
         jnp = self._jnp
@@ -114,7 +125,8 @@ class TPULoader(Loader):
         with self._lock:
             out, self.state = datapath_step_jit(self.state, hdr,
                                                 jnp.uint32(now))
-        return np.asarray(out)
+            row_map = self.row_map
+        return np.asarray(out), row_map
 
     def gc(self, now: int) -> int:
         from .conntrack import ct_gc_jit
@@ -131,18 +143,30 @@ class TPULoader(Loader):
             return np.asarray(self.state.metrics)
 
     def ct_snapshot(self) -> np.ndarray:
+        """Dense live rows — the canonical (placement-free) snapshot
+        format, restorable into any capacity or backend."""
+        from .conntrack import ct_rows_from_table
+
         with self._lock:
-            return np.asarray(self.state.ct.table)
+            return ct_rows_from_table(np.asarray(self.state.ct.table))
 
     def ct_restore(self, table: np.ndarray) -> None:
-        from .conntrack import CTTable
+        from .conntrack import (CTTable, ROW_WORDS, ct_rows_from_table,
+                                ct_table_from_rows)
 
         jnp = self._jnp
+        table = np.asarray(table)
+        assert table.ndim == 2 and table.shape[1] == ROW_WORDS
+        # normalize (accepts dense rows OR a full hashed table — live
+        # rows are extracted either way), then re-place with the device
+        # hash so probes find every entry at this capacity
+        table, n_dropped = ct_table_from_rows(ct_rows_from_table(table),
+                                              self.ct_capacity)
         with self._lock:
             self.state = DatapathState(
                 policy=self.state.policy, ipcache=self.state.ipcache,
                 ct=CTTable(table=jnp.asarray(table),
-                           dropped=jnp.zeros((), jnp.uint32)),
+                           dropped=jnp.uint32(n_dropped)),
                 metrics=self.state.metrics)
 
 
@@ -173,7 +197,7 @@ class InterpreterLoader(Loader):
             self.oracle.ct = old_ct
         self.attach_count += 1
 
-    def step(self, hdr: np.ndarray, now: int) -> np.ndarray:
+    def step(self, hdr: np.ndarray, now: int):
         from ..core.packets import HeaderBatch, COL_DIR
         from .verdict import N_OUT
 
@@ -183,7 +207,7 @@ class InterpreterLoader(Loader):
             out[i] = (r.verdict, r.proxy, r.ct,
                       self.row_map.row(r.identity), r.reason, r.event)
             self._metrics[r.reason, int(hdr[i][COL_DIR])] += 1
-        return out
+        return out, self.row_map
 
     def gc(self, now: int) -> int:
         return self.oracle.gc(now)
@@ -192,8 +216,37 @@ class InterpreterLoader(Loader):
         return self._metrics.copy()
 
     def ct_snapshot(self) -> np.ndarray:
-        raise NotImplementedError(
-            "interpreter CT is a dict; checkpoint via the agent state")
+        """Oracle CT dict -> dense snapshot rows (the portable format;
+        restorable into either backend).  The oracle tracks no per-flow
+        packet/byte counters, so those words are zero."""
+        from .conntrack import ROW_WORDS, V_EXPIRES, V_PROXY, V_STATE
+
+        rows = np.zeros((len(self.oracle.ct), ROW_WORDS), dtype=np.uint32)
+        for i, (key, e) in enumerate(self.oracle.ct.items()):
+            src, dst, sport, dport, proto, dirn = key
+            rows[i, 0:4] = src
+            rows[i, 4:8] = dst
+            rows[i, 8] = (sport << 16) | dport
+            rows[i, 9] = proto | (dirn << 8)
+            rows[i, V_STATE] = e.state
+            rows[i, V_EXPIRES] = e.expires
+            rows[i, V_PROXY] = e.proxy
+        return rows
 
     def ct_restore(self, table: np.ndarray) -> None:
-        raise NotImplementedError
+        """Accepts dense rows or a full hashed table from either
+        backend; live rows decode back into the oracle dict."""
+        from ..testing.oracle import _CTEntry
+        from .conntrack import (V_EXPIRES, V_PROXY, V_STATE,
+                                ct_rows_from_table)
+
+        assert self.oracle is not None, "attach() before ct_restore()"
+        self.oracle.ct.clear()
+        for row in ct_rows_from_table(np.asarray(table)):
+            key = (tuple(int(x) for x in row[0:4]),
+                   tuple(int(x) for x in row[4:8]),
+                   int(row[8]) >> 16, int(row[8]) & 0xFFFF,
+                   int(row[9]) & 0xFF, (int(row[9]) >> 8) & 1)
+            self.oracle.ct[key] = _CTEntry(state=int(row[V_STATE]),
+                                           expires=int(row[V_EXPIRES]),
+                                           proxy=int(row[V_PROXY]))

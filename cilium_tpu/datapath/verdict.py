@@ -57,6 +57,7 @@ from .lpm import DeviceLPM, LPMTensors, lpm_lookup
 REASON_FORWARDED = 0
 REASON_POLICY_DENY = 1  # explicit deny rule
 REASON_POLICY_DEFAULT_DENY = 2  # no rule allowed it (default deny)
+REASON_ROUTE_OVERFLOW = 3  # flow-router shard block overflow (RSS queue)
 N_REASONS = 8
 
 # Event types in the out tensor (monitor vocabulary).

@@ -16,6 +16,7 @@ from .mesh import (  # noqa: F401
     flow_shard_ids,
     make_mesh,
     make_sharded_step,
+    add_route_overflow,
     route_by_flow,
     shard_state,
 )

@@ -83,19 +83,21 @@ def flow_shard_ids(data: np.ndarray, n_shards: int) -> np.ndarray:
 
 def route_by_flow(data: np.ndarray, n_shards: int,
                   block: Optional[int] = None
-                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """Steer packets into equal-size per-shard blocks (host side).
 
     Returns (routed [n_shards*block, N_COLS], valid [...] bool,
-    orig_idx [...] int64 — original row index, -1 on padding).  The RSS
-    analogue: the device-side pipeline shards this batch contiguously.
+    orig_idx [...] int64 — original row index, -1 on padding,
+    n_overflow — packets dropped because their shard's block was full).
+    The RSS analogue: the device-side pipeline shards this batch
+    contiguously; an overflow is an RSS queue overflow and MUST be
+    accounted (feed ``n_overflow`` to :func:`add_route_overflow` so it
+    lands in the metricsmap like CT map-pressure drops do).
 
     ``block`` (per-shard rows) should be FIXED by the caller across
     batches — a data-dependent shape would retrace the jitted sharded
     step every batch.  Default: 2x the fair share, rounded to a power
-    of two.  If a shard overflows its block, the excess packets are
-    dropped (an RSS queue overflow); detect via (orig_idx >= 0).sum()
-    < len(data)."""
+    of two."""
     ids = flow_shard_ids(data, n_shards)
     if block is None:
         fair = max(-(-len(data) // n_shards), 1)
@@ -105,13 +107,30 @@ def route_by_flow(data: np.ndarray, n_shards: int,
     routed = np.zeros((n_shards, block, N_COLS), dtype=np.uint32)
     valid = np.zeros((n_shards, block), dtype=bool)
     orig = np.full((n_shards, block), -1, dtype=np.int64)
+    n_overflow = 0
     for s in range(n_shards):
-        where = np.nonzero(ids == s)[0][:block]
+        all_rows = np.nonzero(ids == s)[0]
+        n_overflow += max(0, len(all_rows) - block)
+        where = all_rows[:block]
         routed[s, :len(where)] = data[where]
         valid[s, :len(where)] = True
         orig[s, :len(where)] = where
     return (routed.reshape(n_shards * block, N_COLS), valid.reshape(-1),
-            orig.reshape(-1))
+            orig.reshape(-1), n_overflow)
+
+
+def add_route_overflow(state: DatapathState, n: int) -> DatapathState:
+    """Account host-side router overflow drops in the device metricsmap
+    (REASON_ROUTE_OVERFLOW, ingress column) so the loss is visible to
+    operators exactly like CT map-pressure drops."""
+    from ..datapath.verdict import REASON_ROUTE_OVERFLOW
+
+    if n == 0:
+        return state
+    metrics = state.metrics.at[REASON_ROUTE_OVERFLOW, 0].add(
+        jnp.uint32(n))
+    return DatapathState(policy=state.policy, ipcache=state.ipcache,
+                         ct=state.ct, metrics=metrics)
 
 
 def shard_state(state: DatapathState, mesh: Mesh,

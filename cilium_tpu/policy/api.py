@@ -150,9 +150,23 @@ ENTITY_SELECTORS: Dict[str, EndpointSelector] = {
 
 @dataclass(frozen=True)
 class PortProtocol:
+    """One port+protocol spec.
+
+    ICMP semantics (deliberate, documented): for ``protocol: ICMP`` the
+    ``port`` value is the **ICMP type** — the datapath carries the ICMP
+    type in the dport column (core/packets.py COL_DPORT) and ICMP owns
+    its own dense proto class row, so a TCP port-80 rule and an ICMP
+    type-8 rule never share table entries.  The upstream ``icmps`` rule
+    field (reference: api.ICMPRule, cilium 1.12+) parses into exactly
+    this form.  ``protocol: ANY`` never covers ICMP (matches upstream:
+    port rules expand to TCP/UDP/SCTP only)."""
+
     port: str  # numeric string or named port; "0" or "" == all ports
     protocol: str = "ANY"  # TCP | UDP | SCTP | ICMP | ANY
     end_port: int = 0  # inclusive range end (0 = single port)
+    # exact ICMP type from an `icmps` rule; distinguishes type 0 (echo
+    # reply) from the "port 0 == all" wildcard convention above
+    icmp_type: Optional[int] = None
 
     @staticmethod
     def from_dict(d: dict) -> "PortProtocol":
@@ -174,10 +188,20 @@ class PortProtocol:
         protocol = str(d.get("protocol", "ANY")).upper()
         if protocol not in ("TCP", "UDP", "SCTP", "ICMP", "ANY"):
             raise ValueError(f"unknown protocol {protocol!r}")
-        return PortProtocol(port=port, protocol=protocol, end_port=end_port)
+        icmp_type = d.get("icmpType")
+        if icmp_type is not None and protocol != "ICMP":
+            raise ValueError(
+                f"icmpType is only valid with protocol ICMP, got "
+                f"{protocol!r}")
+        return PortProtocol(port=port, protocol=protocol,
+                            end_port=end_port,
+                            icmp_type=(int(icmp_type)
+                                       if icmp_type is not None else None))
 
     def port_range(self) -> Tuple[int, int]:
         """Resolve to an inclusive [lo, hi] numeric port range."""
+        if self.icmp_type is not None:
+            return (self.icmp_type, self.icmp_type)
         p = int(self.port or 0)
         if p == 0:
             return (0, 65535)
@@ -248,6 +272,30 @@ class PortRule:
         )
 
 
+def _icmp_port_rules(icmps) -> Tuple[PortRule, ...]:
+    """Upstream ``icmps`` field -> PortRules with protocol ICMP.
+
+    Reference schema (api.ICMPRule): ``[{fields: [{type: 8, family:
+    "IPv4"}]}]``.  ICMPv4 and ICMPv6 share one dense proto class here
+    (compiler.make_proto_table maps both 1 and 58 to PROTO_ICMP), so
+    family only validates."""
+    out = []
+    for icmp in icmps or ():
+        ports = []
+        for f in icmp.get("fields") or ():
+            fam = str(f.get("family", "IPv4"))
+            if fam not in ("IPv4", "IPv6", "4", "6"):
+                raise ValueError(f"unknown ICMP family {fam!r}")
+            t = int(f.get("type", 0))
+            if not 0 <= t <= 255:
+                raise ValueError(f"ICMP type {t} out of range")
+            ports.append(PortProtocol(port=str(t), protocol="ICMP",
+                                      icmp_type=t))
+        if ports:
+            out.append(PortRule(ports=tuple(ports)))
+    return tuple(out)
+
+
 # ---------------------------------------------------------------------------
 # CIDR
 
@@ -307,7 +355,8 @@ class IngressRule:
                             for c in (d.get("fromCIDRSet") or ())),
             from_entities=tuple(d.get("fromEntities") or ()),
             to_ports=tuple(PortRule.from_dict(p)
-                           for p in d.get("toPorts") or ()),
+                           for p in d.get("toPorts") or ()) +
+                     _icmp_port_rules(d.get("icmps")),
         )
 
     @property
@@ -335,7 +384,8 @@ class EgressRule:
                           for c in (d.get("toCIDRSet") or ())),
             to_entities=tuple(d.get("toEntities") or ()),
             to_ports=tuple(PortRule.from_dict(p)
-                           for p in d.get("toPorts") or ()),
+                           for p in d.get("toPorts") or ()) +
+                     _icmp_port_rules(d.get("icmps")),
             to_fqdns=tuple(_fqdn_from_obj(f) for f in (d.get("toFQDNs")
                                                        or ())),
         )
@@ -419,7 +469,11 @@ def _selector_to_dict(sel: EndpointSelector) -> dict:
 def _ports_to_dict(pr: PortRule) -> dict:
     d: dict = {"ports": [
         {"port": p.port, "protocol": p.protocol,
-         **({"endPort": p.end_port} if p.end_port else {})}
+         **({"endPort": p.end_port} if p.end_port else {}),
+         # extension key so exact ICMP types (esp. type 0) survive the
+         # serialize -> import round trip (checkpoint saves rules as
+         # JSON); absent for plain port rules, ignored by upstream
+         **({"icmpType": p.icmp_type} if p.icmp_type is not None else {})}
         for p in pr.ports]}
     rules: dict = {}
     if pr.rules.http:

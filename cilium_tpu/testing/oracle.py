@@ -84,16 +84,35 @@ class OracleDatapath:
                  ipcache: Dict[str, int]):
         self.ep_policies = ep_policies
         self.ipcache: List[Tuple[int, int, int, int]] = []  # ver, net, plen, id
+        # host-route fast path: /32 (v4) and /128 (v6) are the longest
+        # possible prefixes, so an exact hit always wins LPM — keeps the
+        # oracle usable at the 10k-identity scale without changing
+        # longest-prefix-match semantics
+        self._exact: Dict[Tuple[int, int], int] = {}
         for cidr, ident in ipcache.items():
             net = ipaddress.ip_network(cidr, strict=False)
-            self.ipcache.append((net.version, int(net.network_address),
-                                 net.prefixlen, ident))
+            host_bits = 32 if net.version == 4 else 128
+            if net.prefixlen == host_bits:
+                self._exact[(net.version,
+                             int(net.network_address))] = ident
+            else:
+                self.ipcache.append((net.version,
+                                     int(net.network_address),
+                                     net.prefixlen, ident))
+        self._lpm_memo: Dict[str, int] = {}
         self.ct: Dict[tuple, _CTEntry] = {}
         self.proto_table = make_proto_table()
 
     def lookup_identity(self, ip: str) -> int:
+        cached = self._lpm_memo.get(ip)
+        if cached is not None:
+            return cached
         addr = ipaddress.ip_address(ip)
         n = int(addr)
+        exact = self._exact.get((addr.version, n))
+        if exact is not None:
+            self._lpm_memo[ip] = exact
+            return exact
         bits = 32 if addr.version == 4 else 128
         best_len, best_id = -1, 0
         for ver, net, plen, ident in self.ipcache:
@@ -103,6 +122,7 @@ class OracleDatapath:
             if plen == 0 or (n >> shift) == (net >> shift):
                 if plen > best_len:
                     best_len, best_id = plen, ident
+        self._lpm_memo[ip] = best_id
         return best_id
 
     @staticmethod

@@ -49,7 +49,7 @@ def test_sharded_step_matches_oracle(world):  # noqa: F811
     now = 5000
     for _ in range(4):
         batch = _random_batch(rng, 256)
-        routed, valid, orig = route_by_flow(batch.data, 8)
+        routed, valid, orig, _ovf = route_by_flow(batch.data, 8)
         out, state = step(state, jnp.asarray(routed), jnp.uint32(now),
                           jnp.asarray(valid))
         out = np.asarray(out)
@@ -76,7 +76,7 @@ def test_replicated_counters_agree(world):  # noqa: F811
     state = shard_state(state, mesh)
     step = make_sharded_step(mesh)
     batch = _random_batch(np.random.default_rng(3), 256)
-    routed, valid, orig = route_by_flow(batch.data, 8)
+    routed, valid, orig, _ovf = route_by_flow(batch.data, 8)
     out, state = step(state, jnp.asarray(routed), jnp.uint32(10),
                       jnp.asarray(valid))
     total = int(np.asarray(state.metrics).sum())
