@@ -4,10 +4,30 @@
 BASELINE.md north-star: >= 10M policy verdicts/sec on one TPU v5e chip
 over the 10k-identity L3/L4 policy set, <= 1% divergence vs the oracle.
 
-Runs the full fused pipeline (ipcache LPM -> conntrack -> policy ->
-ct-create -> events) on synthetic steady-state traffic (95% established
-/ 5% new flows), replaying a pool of pre-generated batches.  Prints ONE
-JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Two phases, one JSON line:
+
+1. **device** — the fused pipeline (ipcache LPM -> conntrack -> policy
+   -> ct-create -> events) replaying pre-staged device batches: the
+   kernel-rate ceiling (headline metric, matches BASELINE's
+   verdicts/s/chip definition).
+2. **end_to_end** — the honest number: raw ethernet frames in host
+   memory -> native C++ parse -> header tensor -> device_put -> fused
+   pipeline -> device event ring (compacted drops/verdicts/sampled
+   traces, monitor/ring.py) -> single host drain.  Non-replayed
+   traffic (every batch distinct), advancing clock.
+
+   The event-ring architecture mirrors the reference (the kernel
+   streams *events* through the perf ring and counts the rest in the
+   metricsmap; it does not copy every packet to userspace).  It also
+   sidesteps a measured harness artifact: on the tunneled-TPU bench
+   host, ANY device->host fetch permanently degrades subsequent
+   executions by ~4.5 s each (axon tunnel pathology, measured and
+   reported below as d2h_artifact) — so the hot loop must be
+   fetch-free, which the ring design is anyway.
+
+Output: ONE JSON line {"metric", "value", "unit", "vs_baseline",
+"end_to_end": {...}} — extra keys carry the e2e numbers + bottleneck
+split.
 """
 
 import json
@@ -15,43 +35,148 @@ import time
 
 import numpy as np
 
+BATCH = 1 << 17  # 131072 packets/batch
+BASELINE_PPS = 10_000_000.0  # north-star target
+
+
+def bench_device(world, jnp, datapath_step_jit, iters=20):
+    from cilium_tpu.testing.fixtures import bench_traffic
+
+    rng = np.random.default_rng(0)
+    pool = [jnp.asarray(bench_traffic(world, BATCH, rng))
+            for _ in range(4)]
+    state = world.state
+    now = 1_000
+    for b in pool:  # warmup: compile + seed steady-state CT
+        out, state = datapath_step_jit(state, b, jnp.uint32(now))
+    out.block_until_ready()
+    t0 = time.perf_counter()
+    for i in range(iters):
+        now += 1
+        out, state = datapath_step_jit(state, pool[i % 4],
+                                       jnp.uint32(now))
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    return BATCH * iters / dt, state, now
+
+
+def bench_end_to_end(world, state, now0, jax, jnp, datapath_step_jit,
+                     iters=16):
+    """Host frames -> device verdicts + event ring; one drain at end."""
+    from cilium_tpu import native
+    from cilium_tpu.core.ingest import frames_from_batch, parse_frames
+    from cilium_tpu.monitor.ring import (EventRing, ring_append_jit,
+                                         ring_drain)
+    from cilium_tpu.testing.fixtures import steady_flow_pool, steady_traffic
+
+    rng = np.random.default_rng(1)
+    # bounded flow pool: replaying it once establishes the steady state
+    # (95% established / 5% new / 2% scan-drops thereafter)
+    pool = steady_flow_pool(world, 2 * BATCH, rng)
+    # distinct traffic every iteration — nothing replays
+    frame_bufs = [frames_from_batch(steady_traffic(pool, BATCH, rng))
+                  for _ in range(iters)]
+    wire_bytes = sum(len(b) for b in frame_bufs)
+
+    # parse-stage rate alone (for the bottleneck split); warm first so
+    # the one-time g++ compile/dlopen of the native lib isn't timed
+    native.available()
+    parse_frames(frame_bufs[0][: 1 << 12])
+    t0 = time.perf_counter()
+    rows0 = parse_frames(frame_bufs[0])
+    parse_dt = time.perf_counter() - t0
+    parse_pps = len(rows0) / parse_dt
+
+    ring = EventRing.create(1 << 18)
+    # warmup: establish the pool's flows in CT + compile the e2e shapes
+    # — NO host fetch (see module doc)
+    for chunk in pool.reshape(2, BATCH, -1):
+        out, state = datapath_step_jit(state, jnp.asarray(chunk),
+                                       jnp.uint32(now0))
+    out, state = datapath_step_jit(state, jnp.asarray(rows0),
+                                   jnp.uint32(now0))
+    ring = ring_append_jit(ring, out, jnp.uint32(0))
+    ring.cursor.block_until_ready()
+
+    # two dispatches per batch (step, append) pipelines better through
+    # the tunnel than the fused serve_step on this harness; real
+    # deployments should prefer monitor.ring.serve_step_jit (one
+    # dispatch, compaction fused into the datapath executable)
+    t0 = time.perf_counter()
+    for i, buf in enumerate(frame_bufs):
+        rows = parse_frames(buf)  # host: native C++
+        dev = jax.device_put(rows)  # h2d (async)
+        out, state = datapath_step_jit(state, dev,
+                                       jnp.uint32(now0 + 1 + i))
+        ring = ring_append_jit(ring, out, jnp.uint32(i + 1))
+    ring.cursor.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    # the monitor's drain: the ONE host fetch, outside the hot loop
+    t0 = time.perf_counter()
+    events, total, lost = ring_drain(ring)
+    drain_dt = time.perf_counter() - t0
+
+    return {
+        "verdicts_per_sec": round(BATCH * iters / dt),
+        "vs_target_10M": round(BATCH * iters / dt / BASELINE_PPS, 3),
+        "wire_gbps": round(wire_bytes * 8 / dt / 1e9, 2),
+        "parse_stage_pps": round(parse_pps),
+        "native_ingest": native.available(),
+        "batches": iters,
+        "batch_size": BATCH,
+        "events_streamed": int(total),
+        "events_lost": int(lost),
+        "ring_drain_ms": round(drain_dt * 1e3, 1),
+    }, state
+
+
+def bench_full_readback(world, state, now0, jax, jnp,
+                        datapath_step_jit, iters=2):
+    """The naive path (full out tensor fetched per batch) — measures
+    the harness's d2h artifact; runs LAST because the first fetch
+    permanently degrades this process's executions (~4.5s each on the
+    tunneled bench host; sub-ms on directly-attached TPUs)."""
+    from cilium_tpu.core.ingest import frames_from_batch, parse_frames
+    from cilium_tpu.testing.fixtures import bench_traffic
+
+    rng = np.random.default_rng(2)
+    bufs = [frames_from_batch(bench_traffic(world, BATCH, rng))
+            for _ in range(iters)]
+    t0 = time.perf_counter()
+    for i, buf in enumerate(bufs):
+        rows = parse_frames(buf)
+        out, state = datapath_step_jit(state, jax.device_put(rows),
+                                       jnp.uint32(now0 + i))
+        np.asarray(out)  # full 24B/pkt readback
+    dt = time.perf_counter() - t0
+    return {
+        "verdicts_per_sec": round(BATCH * iters / dt),
+        "note": "full per-packet readback; dominated by the harness "
+                "d2h artifact on tunneled TPUs",
+    }
+
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
     from cilium_tpu.datapath import datapath_step_jit
-    from cilium_tpu.testing.fixtures import bench_traffic, build_world
-
-    batch_size = 1 << 17  # 131072 packets/batch
-    n_pool = 4
-    iters = 30
+    from cilium_tpu.testing.fixtures import build_world
 
     world = build_world(n_identities=10_000, ct_capacity=1 << 21)
-    rng = np.random.default_rng(0)
-    pool = [jnp.asarray(bench_traffic(world, batch_size, rng))
-            for _ in range(n_pool)]
-    state = world.state
-    now = jnp.uint32(1_000)
-
-    # warmup: compile + populate CT with the steady-state flows
-    for b in pool:
-        out, state = datapath_step_jit(state, b, now)
-    out.block_until_ready()
-
-    t0 = time.perf_counter()
-    for i in range(iters):
-        out, state = datapath_step_jit(state, pool[i % n_pool], now)
-    out.block_until_ready()
-    dt = time.perf_counter() - t0
-
-    pps = batch_size * iters / dt
-    baseline = 10_000_000.0  # north-star target
+    dev_pps, state, now = bench_device(world, jnp, datapath_step_jit)
+    e2e, state = bench_end_to_end(world, state, now + 1, jax, jnp,
+                                  datapath_step_jit)
+    artifact = bench_full_readback(world, state, now + 100, jax, jnp,
+                                   datapath_step_jit)
     print(json.dumps({
         "metric": "policy_verdicts_per_sec_per_chip",
-        "value": round(pps),
+        "value": round(dev_pps),
         "unit": "verdicts/s",
-        "vs_baseline": round(pps / baseline, 3),
+        "vs_baseline": round(dev_pps / BASELINE_PPS, 3),
+        "end_to_end": e2e,
+        "d2h_artifact": artifact,
     }))
 
 

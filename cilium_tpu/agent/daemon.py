@@ -233,17 +233,20 @@ class Daemon:
                 if e.source not in ("endpoint", "generated")],
             "rules": [rule_to_dict(r) for r in self.repo.rules()],
         }
-        tmp = os.path.join(state_dir, "state.json.tmp")
-        with open(tmp, "w") as f:
-            json.dump(meta, f, indent=1)
-        os.replace(tmp, os.path.join(state_dir, "state.json"))
+        # ct.npz first, state.json LAST: state.json is the commit point
+        # of the checkpoint pair, so a crash between the two renames
+        # can never pair new control-plane state with a stale CT
+        # snapshot (stale CT would resurrect established flows admitted
+        # under since-revoked policy)
         ct = self.loader.ct_snapshot()
-        # atomic like state.json: a crash mid-savez must not leave a
-        # corrupt ct.npz that poisons the next restore
         ct_tmp = os.path.join(state_dir, "ct.npz.tmp")
         with open(ct_tmp, "wb") as f:
             np.savez_compressed(f, table=ct)
         os.replace(ct_tmp, os.path.join(state_dir, "ct.npz"))
+        tmp = os.path.join(state_dir, "state.json.tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1)
+        os.replace(tmp, os.path.join(state_dir, "state.json"))
 
     def restore(self, state_dir: str) -> bool:
         """Reload a checkpoint (the agent-restart path: datapath state

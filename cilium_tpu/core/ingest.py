@@ -1,0 +1,93 @@
+"""Host ingest: raw wire frames <-> header tensors, at line rate.
+
+Reference: cilium's packets arrive as kernel skbs and are parsed by
+native code (bpf/lib/eth.h, ipv4.h, l4.h); the TPU analogue receives
+raw frames on the host, parses them natively
+(cilium_tpu/native/ingest.cpp), and ships fixed-size header tensors to
+the device.  This module provides:
+
+- :func:`frames_from_batch` — render a header tensor as length-prefixed
+  ethernet frames (vectorized; the benchmark's packet source, and the
+  inverse of the ingest parser — used to prove parse fidelity).
+- :func:`parse_frames` — frames -> header rows, native C++ fast path
+  with a Python fallback.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .packets import (
+    COL_DPORT,
+    COL_DST_IP3,
+    COL_FLAGS,
+    COL_LEN,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP3,
+    N_COLS,
+)
+
+# fixed ipv4 frame: 4B length prefix + 14B eth + 20B ip + 20B l4 room
+FRAME_LEN = 54
+_REC_LEN = 4 + FRAME_LEN
+
+
+def frames_from_batch(hdr: np.ndarray) -> bytes:
+    """Header tensor [N, N_COLS] (IPv4 rows) -> length-prefixed
+    ethernet frame stream.
+
+    The IP header declares COL_LEN as the total length while the frame
+    carries only headers (truncated-capture style, like a snaplen'd
+    pcap), so ``parse -> frames -> parse`` round-trips every column the
+    datapath reads.  EP/DIR are ingest-side metadata, not wire bytes —
+    the parser stamps them per stream."""
+    hdr = np.ascontiguousarray(hdr, dtype=np.uint32)
+    n = hdr.shape[0]
+    assert hdr.shape[1] == N_COLS
+    buf = np.zeros((n, _REC_LEN), dtype=np.uint8)
+    # u32le length prefix
+    buf[:, 0] = FRAME_LEN
+    # ethernet: zero macs, ethertype 0x0800
+    buf[:, 4 + 12] = 0x08
+    buf[:, 4 + 13] = 0x00
+    ip = buf[:, 18:38]
+    ip[:, 0] = 0x45
+    total = hdr[:, COL_LEN].astype(np.uint16)
+    ip[:, 2] = (total >> 8).astype(np.uint8)
+    ip[:, 3] = (total & 0xFF).astype(np.uint8)
+    ip[:, 8] = 64  # ttl
+    ip[:, 9] = hdr[:, COL_PROTO].astype(np.uint8)
+    src = hdr[:, COL_SRC_IP3]
+    dst = hdr[:, COL_DST_IP3]
+    for b in range(4):
+        ip[:, 12 + b] = ((src >> (8 * (3 - b))) & 0xFF).astype(np.uint8)
+        ip[:, 16 + b] = ((dst >> (8 * (3 - b))) & 0xFF).astype(np.uint8)
+    l4 = buf[:, 38:58]
+    proto = hdr[:, COL_PROTO]
+    sport = hdr[:, COL_SPORT].astype(np.uint16)
+    dport = hdr[:, COL_DPORT].astype(np.uint16)
+    has_ports = (proto == 6) | (proto == 17) | (proto == 132)
+    l4[:, 0] = np.where(has_ports, sport >> 8, 0).astype(np.uint8)
+    l4[:, 1] = np.where(has_ports, sport & 0xFF, 0).astype(np.uint8)
+    l4[:, 2] = np.where(has_ports, dport >> 8, 0).astype(np.uint8)
+    l4[:, 3] = np.where(has_ports, dport & 0xFF, 0).astype(np.uint8)
+    # tcp flags byte; icmp type byte
+    l4[:, 13] = np.where(proto == 6, hdr[:, COL_FLAGS] & 0xFF, 0
+                         ).astype(np.uint8)
+    is_icmp = (proto == 1) | (proto == 58)
+    l4[:, 0] = np.where(is_icmp, dport & 0xFF, l4[:, 0]).astype(np.uint8)
+    return buf.tobytes()
+
+
+def parse_frames(buf: bytes, ep: int = 0,
+                 direction: int = 0) -> np.ndarray:
+    """Length-prefixed frame stream -> [N, N_COLS] header rows.
+
+    Native C++ when available, Python fallback otherwise."""
+    from .. import native
+
+    rows = native.parse_frames(buf, ep, direction)
+    if rows is None:
+        rows = native.parse_frames_py(buf, ep, direction)
+    return rows

@@ -63,7 +63,7 @@ def _parse_ip(pkt: bytes
     ver = pkt[0] >> 4
     if ver == 4:
         ihl = (pkt[0] & 0xF) * 4
-        if len(pkt) < ihl:
+        if ihl < 20 or len(pkt) < ihl:
             return None
         proto = pkt[9]
         total = struct.unpack_from("!H", pkt, 2)[0]
@@ -78,11 +78,23 @@ def _parse_ip(pkt: bytes
 
 
 def read_pcap(path: str, ep: int = 0, direction: int = 0) -> HeaderBatch:
-    """Parse a pcap file into a HeaderBatch (non-IP frames are skipped)."""
+    """Parse a pcap file into a HeaderBatch (non-IP frames are skipped).
+
+    Uses the native C++ parser (cilium_tpu/native) when the toolchain
+    is available; the Python path below is the fallback AND the
+    reference the native parser is equivalence-tested against."""
     with open(path, "rb") as f:
         data = f.read()
     if len(data) < 24:
         return HeaderBatch(np.zeros((0, N_COLS), dtype=np.uint32))
+    from .. import native
+
+    try:
+        rows = native.parse_pcap_bytes(data, ep, direction)
+    except ValueError:
+        raise ValueError(f"{path}: not a pcap file") from None
+    if rows is not None:
+        return HeaderBatch(rows)
     magic = struct.unpack_from("<I", data, 0)[0]
     if magic == PCAP_MAGIC:
         endian = "<"
@@ -96,6 +108,8 @@ def read_pcap(path: str, ep: int = 0, direction: int = 0) -> HeaderBatch:
     while off + 16 <= len(data):
         _, _, caplen, origlen = struct.unpack_from(endian + "IIII", data, off)
         off += 16
+        if off + caplen > len(data):  # truncated record: stop (native
+            break                     # parser parity)
         frame = data[off:off + caplen]
         off += caplen
         if linktype == LINKTYPE_ETHERNET:

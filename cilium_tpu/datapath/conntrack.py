@@ -359,18 +359,27 @@ def ct_table_from_rows(rows: np.ndarray,
     rows = np.asarray(rows, dtype=np.uint32)
     if rows.size == 0:
         return table, 0
-    mask = capacity - 1
-    n_dropped = 0
+    mask = np.uint32(capacity - 1)
     hs = _hash_np(rows[:, :KEY_WORDS])
-    for row, h in zip(rows, hs):
-        for step in range(N_PROBE):
-            s = int((h + step) & mask)
-            if table[s, V_STATE] == ST_FREE:
-                table[s] = row
-                break
-        else:
-            n_dropped += 1
-    return table, n_dropped
+    # vectorized placement: per probe step, every still-pending row
+    # bids for its slot; the first bidder (original row order) of each
+    # free slot wins — restart restores of ~1M flows stay sub-second
+    pending = np.arange(len(rows))
+    for step in range(N_PROBE):
+        if not len(pending):
+            break
+        slots = (hs[pending] + np.uint32(step)) & mask
+        free = table[slots, V_STATE] == ST_FREE
+        order = np.argsort(slots, kind="stable")
+        s_sorted = slots[order]
+        first = np.ones(len(order), dtype=bool)
+        first[1:] = s_sorted[1:] != s_sorted[:-1]
+        win = np.zeros(len(pending), dtype=bool)
+        win[order] = first
+        place = free & win
+        table[slots[place]] = rows[pending[place]]
+        pending = pending[~place]
+    return table, len(pending)
 
 
 def ct_entries_from_snapshot(table: np.ndarray,

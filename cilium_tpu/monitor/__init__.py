@@ -21,3 +21,9 @@ from .api import (  # noqa: F401
     decode_out,
 )
 from .agent import MonitorAgent  # noqa: F401
+from .ring import (  # noqa: F401
+    EventRing,
+    ring_append,
+    ring_append_jit,
+    ring_drain,
+)

@@ -1,0 +1,164 @@
+// Native packet ingest: raw frames -> header-tensor rows.
+//
+// Reference: upstream cilium parses packets in native code on the hot
+// path (bpf/lib/eth.h, ipv4.h, ipv6.h, l4.h compiled to eBPF).  The
+// TPU framework's hot path is the device pipeline; THIS is the
+// host-side ingest stage that feeds it — the one part of the ingest
+// path where Python-per-packet cost would dominate the end-to-end
+// verdict rate (SURVEY.md §7 hard part #4: ingest bandwidth).
+//
+// Row layout mirrors cilium_tpu/core/packets.py exactly:
+//   0-3 SRC_IP0-3 | 4-7 DST_IP0-3 | 8 SPORT | 9 DPORT/ICMP-type
+//   10 PROTO | 11 TCP FLAGS | 12 IP LEN | 13 FAMILY | 14 EP | 15 DIR
+//
+// Build: g++ -O3 -shared -fPIC (driven by cilium_tpu/native/__init__.py,
+// loaded via ctypes; no pybind11 dependency).
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int N_COLS = 16;
+
+inline uint32_t be32(const uint8_t* p) {
+    return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+           (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+inline uint16_t be16(const uint8_t* p) {
+    return uint16_t((p[0] << 8) | p[1]);
+}
+
+// Parse one IP packet (no link header) into a header row.
+// Returns true when the row was produced.
+bool parse_ip(const uint8_t* pkt, long len, uint32_t* row, uint32_t ep,
+              uint32_t dir) {
+    if (len < 20) return false;
+    const int ver = pkt[0] >> 4;
+    uint32_t proto, ip_len, fam;
+    const uint8_t* l4;
+    long l4_len;
+    if (ver == 4) {
+        const int ihl = (pkt[0] & 0xF) * 4;
+        if (len < ihl || ihl < 20) return false;
+        proto = pkt[9];
+        ip_len = be16(pkt + 2);
+        fam = 4;
+        row[0] = row[1] = row[2] = 0;
+        row[3] = be32(pkt + 12);
+        row[4] = row[5] = row[6] = 0;
+        row[7] = be32(pkt + 16);
+        l4 = pkt + ihl;
+        l4_len = len - ihl;
+    } else if (ver == 6 && len >= 40) {
+        proto = pkt[6];
+        ip_len = 40 + be16(pkt + 4);
+        fam = 6;
+        for (int w = 0; w < 4; ++w) row[w] = be32(pkt + 8 + 4 * w);
+        for (int w = 0; w < 4; ++w) row[4 + w] = be32(pkt + 24 + 4 * w);
+        l4 = pkt + 40;
+        l4_len = len - 40;
+    } else {
+        return false;
+    }
+    uint32_t sport = 0, dport = 0, flags = 0;
+    if ((proto == 6 || proto == 17 || proto == 132) && l4_len >= 4) {
+        sport = be16(l4);
+        dport = be16(l4 + 2);
+        if (proto == 6 && l4_len >= 14) flags = l4[13];
+    } else if ((proto == 1 || proto == 58) && l4_len >= 2) {
+        dport = l4[0];  // ICMP type rides the dport column
+    }
+    row[8] = sport;
+    row[9] = dport;
+    row[10] = proto;
+    row[11] = flags;
+    row[12] = ip_len;
+    row[13] = fam;
+    row[14] = ep;
+    row[15] = dir;
+    return true;
+}
+
+// Ethernet frame -> IP payload (skipping VLAN tags); nullptr if non-IP.
+const uint8_t* eth_payload(const uint8_t* frame, long len, long* ip_len) {
+    if (len < 14) return nullptr;
+    uint16_t ethertype = be16(frame + 12);
+    long off = 14;
+    while ((ethertype == 0x8100 || ethertype == 0x88A8) &&
+           len >= off + 4) {
+        ethertype = be16(frame + off + 2);
+        off += 4;
+    }
+    if (ethertype != 0x0800 && ethertype != 0x86DD) return nullptr;
+    *ip_len = len - off;
+    return frame + off;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Length-prefixed frame stream: [u32le frame_len][frame bytes]...
+// Writes up to max_rows rows into out ([max_rows * N_COLS] u32);
+// returns the number of rows produced.
+long parse_frames(const uint8_t* buf, long buf_len, uint32_t* out,
+                  long max_rows, uint32_t ep, uint32_t dir) {
+    long off = 0, rows = 0;
+    while (off + 4 <= buf_len && rows < max_rows) {
+        uint32_t flen;
+        std::memcpy(&flen, buf + off, 4);  // little-endian host
+        off += 4;
+        if (off + flen > buf_len) break;
+        long ip_len;
+        const uint8_t* ip = eth_payload(buf + off, flen, &ip_len);
+        if (ip && parse_ip(ip, ip_len, out + rows * N_COLS, ep, dir))
+            ++rows;
+        off += flen;
+    }
+    return rows;
+}
+
+// Classic libpcap file buffer -> rows.  Handles both byte orders and
+// LINKTYPE_ETHERNET (1) / LINKTYPE_RAW (101).
+long parse_pcap(const uint8_t* buf, long buf_len, uint32_t* out,
+                long max_rows, uint32_t ep, uint32_t dir) {
+    if (buf_len < 24) return 0;
+    uint32_t magic;
+    std::memcpy(&magic, buf, 4);
+    bool swapped;
+    if (magic == 0xA1B2C3D4u) swapped = false;
+    else if (magic == 0xD4C3B2A1u) swapped = true;
+    else return -1;  // not a pcap
+    auto rd32 = [&](long off) {
+        uint32_t v;
+        std::memcpy(&v, buf + off, 4);
+        if (swapped) v = __builtin_bswap32(v);
+        return v;
+    };
+    const uint32_t linktype = rd32(20);
+    long off = 24, rows = 0;
+    while (off + 16 <= buf_len && rows < max_rows) {
+        const uint32_t caplen = rd32(off + 8);
+        off += 16;
+        if (off + caplen > buf_len) break;
+        const uint8_t* frame = buf + off;
+        off += caplen;
+        const uint8_t* ip = nullptr;
+        long ip_len = 0;
+        if (linktype == 1) {
+            ip = eth_payload(frame, caplen, &ip_len);
+        } else if (linktype == 101) {
+            ip = frame;
+            ip_len = caplen;
+        } else {
+            continue;
+        }
+        if (ip && parse_ip(ip, ip_len, out + rows * N_COLS, ep, dir))
+            ++rows;
+    }
+    return rows;
+}
+
+}  // extern "C"

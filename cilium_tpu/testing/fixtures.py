@@ -124,6 +124,64 @@ def build_world(n_identities: int = 10_000, n_rules: int = 64,
                  tensors=tensors, lpm=lpm, pod_ips=pod_ips)
 
 
+def steady_flow_pool(world: World, n_flows: int,
+                     rng: np.random.Generator,
+                     denied_frac: float = 0.02) -> np.ndarray:
+    """A bounded pool of flows for steady-state benchmarking.
+
+    Returns [n_flows, N_COLS] header rows (SYN) — replaying the pool
+    once establishes every allowed flow in CT; subsequent draws from
+    the pool are the established 95%+ of real traffic.  ``denied_frac``
+    of flows target a denied port (they re-drop every time, the way
+    real scans do)."""
+    from ..core.packets import (COL_DPORT, COL_DST_IP3, COL_FAMILY,
+                                COL_FLAGS, COL_LEN, COL_PROTO, COL_SPORT,
+                                COL_SRC_IP3, N_COLS, TCP_SYN)
+    import ipaddress
+
+    out = np.zeros((n_flows, N_COLS), dtype=np.uint32)
+    ips = np.array([int(ipaddress.IPv4Address(ip))
+                    for ip in world.pod_ips], dtype=np.uint32)
+    out[:, COL_SRC_IP3] = rng.choice(ips, n_flows)
+    out[:, COL_DST_IP3] = int(ipaddress.IPv4Address(world.pod_ips[0]))
+    # sports in a dedicated low range so fresh flows (high range) never
+    # collide with pool flows
+    out[:, COL_SPORT] = 1024 + rng.integers(0, 30000, n_flows,
+                                            dtype=np.uint32)
+    # 5432 (allowed for every ns=default pod) + 80 (the L7 redirect);
+    # NOT 1007 — its rule admits a single service identity, so random
+    # sources would mass-drop and flood the event ring
+    allowed = np.array([5432, 5432, 5432, 80, 80], dtype=np.uint32)
+    out[:, COL_DPORT] = rng.choice(allowed, n_flows)
+    denied = rng.random(n_flows) < denied_frac
+    out[:, COL_DPORT] = np.where(denied, 443, out[:, COL_DPORT])
+    out[:, COL_PROTO] = 6
+    out[:, COL_FLAGS] = TCP_SYN
+    out[:, COL_LEN] = rng.integers(60, 1500, n_flows, dtype=np.uint32)
+    out[:, COL_FAMILY] = 4
+    return out
+
+
+def steady_traffic(pool: np.ndarray, n: int, rng: np.random.Generator,
+                   new_frac: float = 0.05) -> np.ndarray:
+    """One steady-state batch: draws from the established flow pool
+    (ACK data packets) with ``new_frac`` fresh connections (SYN, sport
+    in the high range so they are genuinely new flows)."""
+    from ..core.packets import (COL_FLAGS, COL_LEN, COL_SPORT, TCP_ACK,
+                                TCP_SYN)
+
+    rows = pool[rng.integers(0, len(pool), n)].copy()
+    rows[:, COL_FLAGS] = np.where(rows[:, COL_FLAGS] == TCP_SYN, TCP_ACK,
+                                  rows[:, COL_FLAGS])
+    rows[:, COL_LEN] = rng.integers(60, 1500, n, dtype=np.uint32)
+    fresh = rng.random(n) < new_frac
+    rows[:, COL_SPORT] = np.where(
+        fresh, 40000 + rng.integers(0, 20000, n, dtype=np.uint32),
+        rows[:, COL_SPORT])
+    rows[:, COL_FLAGS] = np.where(fresh, TCP_SYN, rows[:, COL_FLAGS])
+    return rows
+
+
 def bench_traffic(world: World, n: int, rng: np.random.Generator,
                   new_flow_frac: float = 0.05) -> np.ndarray:
     """Benchmark traffic over the world's pod IPs: steady-state mix of

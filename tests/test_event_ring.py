@@ -1,0 +1,142 @@
+"""Device event ring: compaction semantics, wrap-overwrite, loss
+accounting — the eventsmap/perf-ring analogue (monitor/ring.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cilium_tpu.datapath.verdict import (
+    EV_DROP,
+    EV_TRACE,
+    EV_VERDICT,
+    N_OUT,
+    OUT_EVENT,
+)
+from cilium_tpu.monitor.ring import (
+    COL_BATCH,
+    COL_PKT_IDX,
+    EventRing,
+    ring_append,
+    ring_drain,
+)
+
+
+def _out(events):
+    """Build an out tensor whose rows carry distinct payloads."""
+    n = len(events)
+    out = np.zeros((n, N_OUT), dtype=np.uint32)
+    out[:, 0] = np.arange(n)  # verdict column doubles as a payload tag
+    out[:, OUT_EVENT] = events
+    return jnp.asarray(out)
+
+
+def test_compaction_keeps_drops_and_verdicts():
+    ring = EventRing.create(64)
+    ev = [EV_TRACE, EV_DROP, EV_TRACE, EV_VERDICT, EV_DROP]
+    ring = ring_append(ring, _out(ev), jnp.uint32(7), trace_sample=0)
+    rows, total, lost = ring_drain(ring)
+    assert total == 3 and lost == 0
+    # append order preserved; pkt idx + batch id recorded
+    assert list(rows[:, COL_PKT_IDX]) == [1, 3, 4]
+    assert set(rows[:, COL_BATCH]) == {7}
+    assert list(rows[:, 0]) == [1, 3, 4]
+
+
+def test_trace_sampling():
+    ring = EventRing.create(256)
+    ev = [EV_TRACE] * 100
+    ring = ring_append(ring, _out(ev), jnp.uint32(0), trace_sample=10)
+    rows, total, _ = ring_drain(ring)
+    assert total == 10  # packets 0, 10, ..., 90
+    assert list(rows[:, COL_PKT_IDX]) == list(range(0, 100, 10))
+
+
+def test_wrap_overwrite_and_loss():
+    ring = EventRing.create(8)
+    # 3 batches x 5 drops = 15 events into an 8-slot ring
+    for b in range(3):
+        ring = ring_append(ring, _out([EV_DROP] * 5), jnp.uint32(b),
+                           trace_sample=0)
+    rows, total, lost = ring_drain(ring)
+    assert total == 15 and lost == 7
+    assert len(rows) == 8
+    # survivors are the newest 8 in order: batch1 pkts 2-4, batch2 all
+    assert [(int(r[COL_BATCH]), int(r[COL_PKT_IDX])) for r in rows] == \
+        [(1, 2), (1, 3), (1, 4), (2, 0), (2, 1), (2, 2), (2, 3), (2, 4)]
+
+
+def test_valid_mask_excludes_padding():
+    ring = EventRing.create(64)
+    ev = [EV_DROP, EV_DROP, EV_DROP]
+    valid = jnp.asarray([True, False, True])
+    ring = ring_append(ring, _out(ev), jnp.uint32(1), trace_sample=0,
+                       valid=valid)
+    rows, total, _ = ring_drain(ring)
+    assert total == 2
+    assert list(rows[:, COL_PKT_IDX]) == [0, 2]
+
+
+def test_ring_matches_host_filter_on_pipeline_output():
+    """Ring compaction over real datapath output == host-side filter."""
+    import jax
+
+    from cilium_tpu.datapath import datapath_step_jit
+    from cilium_tpu.testing.fixtures import bench_traffic, build_world
+
+    world = build_world(n_identities=128, n_rules=8, ct_capacity=1 << 12)
+    rng = np.random.default_rng(3)
+    hdr = jnp.asarray(bench_traffic(world, 2048, rng))
+    out, _state = datapath_step_jit(world.state, hdr, jnp.uint32(100))
+    ring = EventRing.create(1 << 12)
+    ring = ring_append(ring, out, jnp.uint32(0), trace_sample=256)
+    rows, total, lost = ring_drain(ring)
+    host_out = np.asarray(out)
+    keep = (host_out[:, OUT_EVENT] != EV_TRACE) | \
+        (np.arange(2048) % 256 == 0)
+    assert lost == 0
+    assert total == int(keep.sum())
+    np.testing.assert_array_equal(rows[:, :N_OUT], host_out[keep])
+    np.testing.assert_array_equal(rows[:, COL_PKT_IDX],
+                                  np.nonzero(keep)[0])
+
+
+def test_serve_step_matches_separate_dispatch():
+    """Fused serve_step (datapath + ring append in one executable) ==
+    step-then-append, state and ring both."""
+    import jax
+
+    from cilium_tpu.datapath import datapath_step_jit
+    from cilium_tpu.monitor.ring import ring_append, serve_step_jit
+    from cilium_tpu.testing.fixtures import bench_traffic, build_world
+
+    w1 = build_world(n_identities=64, n_rules=4, ct_capacity=1 << 10)
+    w2 = build_world(n_identities=64, n_rules=4, ct_capacity=1 << 10)
+    rng = np.random.default_rng(5)
+    hdr = jnp.asarray(bench_traffic(w1, 512, rng))
+    r1 = EventRing.create(1 << 10)
+    r2 = EventRing.create(1 << 10)
+
+    s1, r1 = serve_step_jit(w1.state, r1, hdr, jnp.uint32(50),
+                            jnp.uint32(3), trace_sample=64)
+    out, s2 = datapath_step_jit(w2.state, hdr, jnp.uint32(50))
+    r2 = ring_append(r2, out, jnp.uint32(3), trace_sample=64)
+
+    a1, t1, l1 = ring_drain(r1)
+    a2, t2, l2 = ring_drain(r2)
+    np.testing.assert_array_equal(a1, a2)
+    assert (t1, l1) == (t2, l2)
+    np.testing.assert_array_equal(np.asarray(s1.ct.table),
+                                  np.asarray(s2.ct.table))
+    np.testing.assert_array_equal(np.asarray(s1.metrics),
+                                  np.asarray(s2.metrics))
+
+
+def test_single_batch_overflow_newest_wins():
+    """One append larger than the ring: survivors are exactly the
+    newest `capacity` kept events, in order (no duplicate-slot
+    scatter nondeterminism)."""
+    ring = EventRing.create(8)
+    ring = ring_append(ring, _out([EV_DROP] * 20), jnp.uint32(5),
+                       trace_sample=0)
+    rows, total, lost = ring_drain(ring)
+    assert total == 20 and lost == 12
+    assert list(rows[:, COL_PKT_IDX]) == list(range(12, 20))

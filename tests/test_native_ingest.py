@@ -1,0 +1,107 @@
+"""Native C++ ingest parser: build, equivalence with the Python
+reference, pcap fast path, and frame round-trip fidelity.
+
+The native parser is the host half of SURVEY.md §7 hard-part #4
+(ingest bandwidth); its semantics are pinned to the pure-Python parser
+byte for byte.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from cilium_tpu import native
+from cilium_tpu.core.ingest import FRAME_LEN, frames_from_batch, parse_frames
+from cilium_tpu.core.packets import (
+    COL_DIR,
+    COL_EP,
+    N_COLS,
+    synth_batch,
+)
+from cilium_tpu.core.pcap import read_pcap, write_pcap
+
+
+def test_native_builds():
+    """The resident toolchain must produce the ingest library — the
+    framework's native runtime component is not optional in CI."""
+    assert native.available()
+
+
+def test_roundtrip_batch_to_frames_to_rows():
+    batch = synth_batch(4096, np.random.default_rng(7))
+    buf = frames_from_batch(batch.data)
+    rows = parse_frames(buf)
+    # EP/DIR are stream metadata (stamped at parse time), rest is wire
+    want = batch.data.copy()
+    want[:, COL_EP] = 0
+    want[:, COL_DIR] = 0
+    np.testing.assert_array_equal(rows, want)
+
+
+def test_native_matches_python_reference():
+    batch = synth_batch(512, np.random.default_rng(8))
+    buf = frames_from_batch(batch.data)
+    got_native = native.parse_frames(buf, ep=3, direction=1)
+    got_py = native.parse_frames_py(buf, ep=3, direction=1)
+    assert got_native is not None
+    np.testing.assert_array_equal(got_native, got_py)
+
+
+def test_native_handles_vlan_and_junk():
+    """VLAN-tagged frame parses to the same row; non-IP and truncated
+    frames are skipped by both parsers."""
+    batch = synth_batch(4, np.random.default_rng(9))
+    plain = frames_from_batch(batch.data[:1])
+    frame = plain[4:]  # strip the length prefix
+    tagged = (frame[:12] + b"\x81\x00\x00\x2a" + frame[12:])
+    arp = frame[:12] + b"\x08\x06" + b"\x00" * 28
+    runt = frame[:10]
+    buf = b"".join(struct.pack("<I", len(f)) + f
+                   for f in (tagged, arp, runt, frame))
+    got_native = native.parse_frames(buf)
+    got_py = native.parse_frames_py(buf)
+    np.testing.assert_array_equal(got_native, got_py)
+    assert got_native.shape[0] == 2  # tagged + plain, junk skipped
+    np.testing.assert_array_equal(got_native[0], got_native[1])
+
+
+def test_pcap_native_matches_python(tmp_path):
+    """read_pcap's native fast path returns exactly what the Python
+    fallback returns, for both IPv4 and IPv6 rows."""
+    rng = np.random.default_rng(10)
+    batch = synth_batch(256, rng)
+    path = str(tmp_path / "t.pcap")
+    write_pcap(path, batch)
+    with open(path, "rb") as f:
+        data = f.read()
+    got_native = native.parse_pcap_bytes(data, ep=1, direction=1)
+    assert got_native is not None
+    via_reader = read_pcap(path, ep=1, direction=1)
+    np.testing.assert_array_equal(got_native, via_reader.data)
+    # full round trip back to the synthesized batch
+    want = batch.data.copy()
+    want[:, COL_EP] = 1
+    want[:, COL_DIR] = 1
+    np.testing.assert_array_equal(via_reader.data, want)
+
+
+def test_pcap_bad_magic():
+    with pytest.raises(ValueError):
+        native.parse_pcap_bytes(b"\x00" * 64)
+
+
+def test_native_ingest_rate():
+    """The native parser must sustain well past Python rates — this is
+    the stage that would otherwise bottleneck end-to-end verdicts/s.
+    Conservative floor: 2M pkt/s (observed ~50M+ on dev hosts)."""
+    import time
+
+    batch = synth_batch(1 << 16, np.random.default_rng(11))
+    buf = frames_from_batch(batch.data)
+    native.parse_frames(buf)  # warm
+    t0 = time.perf_counter()
+    rows = native.parse_frames(buf)
+    dt = time.perf_counter() - t0
+    assert rows.shape[0] == 1 << 16
+    assert rows.shape[0] / dt > 2e6

@@ -174,3 +174,40 @@ class TestICMPSemantics:
         (pr,) = _icmp_port_rules([{"fields": [{"type": 0}]}])
         (pp,) = pr.ports
         assert pp.port_range() == (0, 0)
+
+
+class TestVectorizedCTPlacement:
+    def test_many_flows_place_and_lookup(self):
+        """Vectorized snapshot placement: every row findable by the
+        device probe; drop count correct under forced pressure."""
+        from cilium_tpu.datapath.conntrack import (
+            KEY_WORDS, ROW_WORDS, ST_ESTABLISHED, V_EXPIRES, V_STATE,
+            _hash_np, ct_table_from_rows)
+
+        rng = np.random.default_rng(12)
+        n = 5000
+        rows = np.zeros((n, ROW_WORDS), dtype=np.uint32)
+        rows[:, :KEY_WORDS] = rng.integers(
+            1, 2**32, (n, KEY_WORDS), dtype=np.uint32)
+        rows[:, V_STATE] = ST_ESTABLISHED
+        rows[:, V_EXPIRES] = 10_000
+        # 30% load: no pressure drops expected (at 60%+ the 16-slot
+        # probe window genuinely saturates — for the sequential placer
+        # too — and drops are counted, see below)
+        table, dropped = ct_table_from_rows(rows, 1 << 14)
+        assert dropped == 0
+        # every key must be reachable within the probe window
+        hs = _hash_np(rows[:, :KEY_WORDS])
+        mask = (1 << 14) - 1
+        for i in range(0, n, 97):
+            found = False
+            for step in range(16):
+                s = int((hs[i] + np.uint32(step)) & mask)
+                if (table[s, :KEY_WORDS] == rows[i, :KEY_WORDS]).all():
+                    found = True
+                    break
+            assert found, f"row {i} not reachable by probe"
+        # pressure: tiny table must drop the overflow, counted
+        _t, dropped = ct_table_from_rows(rows, 1 << 8)
+        assert dropped == n - (_t[:, V_STATE] != 0).sum() \
+            and dropped > 0
