@@ -157,6 +157,23 @@ def bench_full_readback(world, state, now0, jax, jnp,
     }
 
 
+def bench_anomaly() -> dict:
+    """BASELINE eval config #5 in a SUBPROCESS: a fresh process gets a
+    fresh tunnel session, so the training loop (fetch-free) and this
+    process's phases cannot degrade each other."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "cilium_tpu.ml.evaluate"],
+            capture_output=True, text=True, timeout=900)
+        line = proc.stdout.strip().splitlines()[-1]
+        return json.loads(line)
+    except Exception as e:  # bench must still print its JSON line
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -170,6 +187,7 @@ def main() -> None:
                                   datapath_step_jit)
     artifact = bench_full_readback(world, state, now + 100, jax, jnp,
                                    datapath_step_jit)
+    anomaly = bench_anomaly()
     print(json.dumps({
         "metric": "policy_verdicts_per_sec_per_chip",
         "value": round(dev_pps),
@@ -177,6 +195,8 @@ def main() -> None:
         "vs_baseline": round(dev_pps / BASELINE_PPS, 3),
         "end_to_end": e2e,
         "d2h_artifact": artifact,
+        "anomaly_auc": anomaly.get("value"),
+        "anomaly": anomaly,
     }))
 
 

@@ -159,8 +159,59 @@ def cmd_flows(args) -> int:
 
 
 def cmd_anomaly(args) -> int:
-    _print(_client(args)._request("GET", "/anomaly"))
-    return 0
+    action = getattr(args, "action", "stats")
+    if action == "stats":
+        _print(_client(args)._request("GET", "/anomaly"))
+        return 0
+    # offline verbs: no agent needed (BASELINE eval config #5)
+    from ..ml.evaluate import (evaluate_capture, synth_labeled_capture,
+                               train_and_evaluate)
+
+    if action == "train":
+        result = train_and_evaluate(n_identities=args.identities,
+                                    model_out=args.model)
+        _print(result)
+        return 0
+    if not (args.pcap and args.labels):
+        print(f"usage: cilium-tpu anomaly {action} --pcap FILE "
+              "--labels FILE", file=sys.stderr)
+        return 1
+
+    # synth and score MUST agree with train on the world shape —
+    # identity rows index the model's embedding table, so a mismatched
+    # world silently remaps identities and poisons the AUC
+    from ..testing.fixtures import build_world
+
+    world = build_world(n_identities=args.identities, n_rules=16,
+                        ct_capacity=1 << 16)
+    if action == "synth":
+        synth_labeled_capture(args.pcap, args.labels, world,
+                              n=args.number)
+        print(f"wrote {args.pcap} + {args.labels}")
+        return 0
+    if action == "score":
+        import jax
+
+        from ..ml.model import init_params, load_model
+
+        if args.model:
+            model = load_model(args.model)
+            if model.embed.shape[0] != world.row_map.capacity:
+                print(f"error: model embedding rows "
+                      f"({model.embed.shape[0]}) != world identity "
+                      f"rows ({world.row_map.capacity}); pass the "
+                      "--identities the model was trained with",
+                      file=sys.stderr)
+                return 1
+        else:
+            print("note: no --model given; scoring with an untrained "
+                  "model", file=sys.stderr)
+            model = init_params(jax.random.PRNGKey(0),
+                                world.row_map.capacity)
+        result = evaluate_capture(model, world, args.pcap, args.labels)
+        _print(result)
+        return 0
+    return 1
 
 
 def cmd_monitor(args) -> int:
@@ -262,7 +313,18 @@ def main(argv=None) -> int:
     p.add_argument("--follow", "-f", action="store_true")
     p.add_argument("--interval", type=float, default=1.0)
 
-    sub.add_parser("anomaly", help="learned-path anomaly stats")
+    p = sub.add_parser("anomaly", help="anomaly stats | train | synth "
+                                       "| score (pcap evaluation)")
+    p.add_argument("action", nargs="?", default="stats",
+                   choices=["stats", "train", "synth", "score"])
+    p.add_argument("--pcap", help="capture file")
+    p.add_argument("--labels", help="label sidecar (.npz or CIC .csv)")
+    p.add_argument("--model", help="AnomalyModel .npz path")
+    p.add_argument("--number", type=int, default=65536,
+                   help="packets for synth")
+    p.add_argument("--identities", type=int, default=1024,
+                   help="world size; must match across train/synth/"
+                        "score (identity rows index the embedding)")
 
     p = sub.add_parser("daemon", help="run the agent")
     p.add_argument("action", choices=["run"])

@@ -1,0 +1,220 @@
+"""CIC-IDS2017-style anomaly evaluation: labeled pcap -> AUC.
+
+Reference: BASELINE.md's measured metric is "anomaly AUC on CIC-IDS2017
+pcap replay vs eBPF drops".  The real dataset cannot ship in-repo, so
+this module (a) synthesizes a labeled capture with the same attack
+taxonomy (port scans, SYN floods, exfiltration) against benign
+steady-state traffic, and (b) evaluates ANY labeled capture of the
+same shape: a pcap plus a label sidecar.
+
+Sidecar formats accepted by :func:`load_labels`:
+- ``.npz`` — arrays ``labels`` [N] (1=attack), optional ``dir``/``ep``
+  per-packet ingest metadata (direction/endpoint are not wire bytes).
+- ``.csv`` — CIC-IDS2017 flow-CSV style: columns for the 5-tuple +
+  ``Label`` (anything not BENIGN counts as attack); packets match by
+  5-tuple.
+
+Run standalone (fresh process, fetch-free hot loop — see bench.py on
+why that matters on tunneled TPU hosts):
+``python -m cilium_tpu.ml.evaluate`` prints ONE JSON line
+``{"metric": "anomaly_auc", ...}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..core.packets import (
+    COL_DIR,
+    COL_DPORT,
+    COL_DST_IP3,
+    COL_EP,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP3,
+)
+
+
+def synth_labeled_capture(pcap_path: str, labels_path: str, world,
+                          n: int = 65536, seed: int = 1,
+                          attack_frac: float = 0.25) -> None:
+    """Write a labeled pcap + npz sidecar with the synthetic attack mix
+    (the in-repo stand-in for CIC-IDS2017)."""
+    from ..core.packets import HeaderBatch
+    from ..core.pcap import write_pcap
+    from .train import synth_labeled_traffic
+
+    rng = np.random.default_rng(seed)
+    hdr, labels = synth_labeled_traffic(world, n, rng,
+                                        attack_frac=attack_frac)
+    write_pcap(pcap_path, HeaderBatch(hdr))
+    np.savez_compressed(labels_path, labels=labels,
+                        dir=hdr[:, COL_DIR].astype(np.uint8),
+                        ep=hdr[:, COL_EP].astype(np.uint16))
+
+
+def load_labels(path: str, hdr: np.ndarray) -> np.ndarray:
+    """Label sidecar -> per-packet labels aligned with ``hdr`` rows.
+
+    Also applies ``dir``/``ep`` ingest metadata from npz sidecars onto
+    the header tensor in place (direction is not recoverable from wire
+    bytes alone)."""
+    if path.endswith(".npz"):
+        z = np.load(path)
+        labels = np.asarray(z["labels"], dtype=np.float32)
+        if len(labels) != len(hdr):
+            raise ValueError(
+                f"label count {len(labels)} != packet count {len(hdr)}")
+        if "dir" in z:
+            hdr[:, COL_DIR] = z["dir"]
+        if "ep" in z:
+            hdr[:, COL_EP] = z["ep"]
+        return labels
+    # CIC-IDS2017 flow CSV: map 5-tuples to labels
+    import csv
+    import ipaddress
+
+    flow_label: Dict[tuple, float] = {}
+    with open(path, newline="") as f:
+        reader = csv.DictReader(f)
+        cols = {c.strip().lower(): c for c in reader.fieldnames or ()}
+
+        def col(row, *names):
+            for nm in names:
+                c = cols.get(nm)
+                if c is not None:
+                    return row[c].strip()
+            raise KeyError(names)
+
+        for row in reader:
+            try:
+                key = (int(ipaddress.ip_address(
+                           col(row, "source ip", "src ip"))),
+                       int(ipaddress.ip_address(
+                           col(row, "destination ip", "dst ip"))),
+                       int(col(row, "source port", "src port")),
+                       int(col(row, "destination port", "dst port")),
+                       int(col(row, "protocol")))
+            except (ValueError, KeyError):
+                continue
+            lab = col(row, "label").upper()
+            flow_label[key] = 0.0 if lab == "BENIGN" else 1.0
+    labels = np.zeros(len(hdr), dtype=np.float32)
+    for i in range(len(hdr)):
+        key = (int(hdr[i, COL_SRC_IP3]), int(hdr[i, COL_DST_IP3]),
+               int(hdr[i, COL_SPORT]), int(hdr[i, COL_DPORT]),
+               int(hdr[i, COL_PROTO]))
+        labels[i] = flow_label.get(key, 0.0)
+    return labels
+
+
+def score_capture(model, world, hdr: np.ndarray,
+                  batch_size: int = 4096, now: int = 50_000
+                  ) -> np.ndarray:
+    """Replay a header tensor through the real datapath and score every
+    packet.  Fetch-free until the single final device->host copy."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..datapath.verdict import datapath_step
+    from .features import flow_features
+    from .model import forward
+
+    dp_step = jax.jit(datapath_step, donate_argnums=0)
+
+    @jax.jit
+    def score(params, hdr_b, out_b):
+        id_row, feats = flow_features(hdr_b, out_b)
+        return jax.nn.sigmoid(forward(params, id_row, feats))
+
+    n = len(hdr)
+    pad = (-n) % batch_size
+    if pad:
+        hdr = np.concatenate([hdr, np.repeat(hdr[-1:], pad, axis=0)])
+    state = world.state
+    chunks = []
+    for i in range(0, len(hdr), batch_size):
+        jb = jnp.asarray(hdr[i:i + batch_size])
+        out, state = dp_step(state, jb, jnp.uint32(now + i))
+        chunks.append(score(model, jb, out))
+    world.state = state
+    scores = np.asarray(jnp.concatenate(chunks))  # the one fetch
+    return scores[:n]
+
+
+def evaluate_capture(model, world, pcap_path: str,
+                     labels_path: str) -> dict:
+    """pcap + labels -> {"anomaly_auc": ...} (BASELINE eval config #5)."""
+    from ..core.pcap import read_pcap
+    from .train import auc
+
+    batch = read_pcap(pcap_path)
+    hdr = batch.data
+    labels = load_labels(labels_path, hdr)
+    scores = score_capture(model, world, hdr)
+    return {
+        "anomaly_auc": round(float(auc(scores, labels)), 4),
+        "packets": int(len(hdr)),
+        "attack_packets": int((labels > 0.5).sum()),
+    }
+
+
+def train_and_evaluate(n_identities: int = 1024, train_steps: int = 150,
+                       train_batch: int = 4096, eval_packets: int = 65536,
+                       seed: int = 0, model_out: Optional[str] = None,
+                       workdir: Optional[str] = None) -> dict:
+    """The full BASELINE config-#5 pipeline: train on synthetic labeled
+    traffic through the datapath, then evaluate a held-out labeled
+    pcap THROUGH the pcap reader (proving the capture path end to
+    end)."""
+    import tempfile
+
+    import jax
+
+    from ..testing.fixtures import build_world
+    from .model import init_params, save_model
+    from .train import train
+
+    world = build_world(n_identities=n_identities, n_rules=16,
+                        ct_capacity=1 << 18)
+    labels_by_row = {
+        world.row_map.row(i.numeric_id): tuple(str(l) for l in i.labels)
+        for i in world.alloc.all_identities()}
+    params = init_params(jax.random.PRNGKey(seed),
+                         world.row_map.capacity,
+                         labels_by_row=labels_by_row)
+    params, losses = train(params, world, steps=train_steps,
+                           batch=train_batch, seed=seed)
+    workdir = workdir or tempfile.mkdtemp(prefix="cilium-anomaly-")
+    pcap = os.path.join(workdir, "eval.pcap")
+    sidecar = os.path.join(workdir, "eval_labels.npz")
+    synth_labeled_capture(pcap, sidecar, world, n=eval_packets,
+                          seed=seed + 1)
+    result = evaluate_capture(params, world, pcap, sidecar)
+    result.update({
+        "train_steps": train_steps,
+        "final_loss": round(losses[-1], 4),
+        "eval_pcap": pcap,
+    })
+    if model_out:
+        save_model(model_out, params)
+        result["model"] = model_out
+    return result
+
+
+def main() -> None:
+    result = train_and_evaluate()
+    print(json.dumps({
+        "metric": "anomaly_auc",
+        "value": result["anomaly_auc"],
+        "unit": "auc",
+        **{k: v for k, v in result.items() if k != "anomaly_auc"},
+    }))
+
+
+if __name__ == "__main__":
+    main()

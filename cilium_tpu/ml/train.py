@@ -137,8 +137,11 @@ def train(params: AnomalyModel, world, steps: int = 200,
         id_row, feats = flow_features(jhdr, out)
         params, opt_state, loss = step_fn(params, opt_state, id_row,
                                           feats, jnp.asarray(labels))
-        losses.append(float(loss))
+        losses.append(loss)  # stays on device: the training loop is
+        # fetch-free (a per-step float() would sync the tunnel)
     world.state = state
+    if losses:
+        losses = [float(x) for x in np.asarray(jnp.stack(losses))]
     return params, losses
 
 
