@@ -63,7 +63,9 @@ class EndpointPolicy:
     revision: int
     ingress: MapState
     egress: MapState
-    redirects: List[Tuple[int, str]] = field(default_factory=list)
+    # (proxy_port, rule_label, L7Rules) per redirect — the L7 proxy
+    # compiles these into per-port request-verdict tensors
+    redirects: List[Tuple[int, str, object]] = field(default_factory=list)
 
     def mapstate(self, direction: int) -> MapState:
         return self.ingress if direction == DIR_INGRESS else self.egress
@@ -130,31 +132,31 @@ def _peer_identities(
     return frozenset(ids)
 
 
-def _port_specs(to_ports: Sequence[PortRule]) -> List[Tuple[int, int, int, bool]]:
-    """Expand toPorts into (dense_proto, lo, hi, has_l7) tuples."""
+def _port_specs(to_ports: Sequence[PortRule]):
+    """Expand toPorts into (dense_proto, lo, hi, l7_rules|None) tuples."""
     if not to_ports:
-        return [(PROTO_ANY, 0, 65535, False)]
-    out: List[Tuple[int, int, int, bool]] = []
+        return [(PROTO_ANY, 0, 65535, None)]
+    out = []
     for pr in to_ports:
-        has_l7 = not pr.rules.is_empty
+        l7 = None if pr.rules.is_empty else pr.rules
         ports = pr.ports or ()
         if not ports:
-            if has_l7:
+            if l7 is not None:
                 # an L7 section without ports still only applies to
                 # port-bearing protocols — never ICMP/OTHER
                 for p in (PROTO_TCP, PROTO_UDP, PROTO_SCTP):
-                    out.append((p, 0, 65535, True))
+                    out.append((p, 0, 65535, l7))
             else:
-                out.append((PROTO_ANY, 0, 65535, False))
+                out.append((PROTO_ANY, 0, 65535, None))
             continue
         for pp in ports:
             lo, hi = pp.port_range()
             proto = PROTO_BY_NAME.get(pp.protocol, PROTO_ANY)
             if proto == PROTO_ANY:
                 for p in (PROTO_TCP, PROTO_UDP, PROTO_SCTP):
-                    out.append((p, lo, hi, has_l7))
+                    out.append((p, lo, hi, l7))
             else:
-                out.append((proto, lo, hi, has_l7))
+                out.append((proto, lo, hi, l7))
     return out
 
 
@@ -164,12 +166,25 @@ def resolve_policy(
     selector_cache: SelectorCache,
     allocator: CachingIdentityAllocator,
     revision: int = 0,
+    proxy_port_for=None,
 ) -> EndpointPolicy:
-    """Resolve the rule set down to per-direction MapStates for a subject."""
+    """Resolve the rule set down to per-direction MapStates for a subject.
+
+    ``proxy_port_for(key) -> port`` allocates redirect listener ports;
+    the repository passes a persistent registry so ports are unique
+    across ALL subjects' policies and stable across re-resolves
+    (reference: pkg/proxy redirect lifecycle keeps ports across
+    regenerations).  The default is a per-call counter (unit tests)."""
     ing = MapState(direction=DIR_INGRESS, enforcing=False)
     egr = MapState(direction=DIR_EGRESS, enforcing=False)
-    redirects: List[Tuple[int, str]] = []
-    next_proxy = PROXY_PORT_BASE
+    redirects: List[Tuple[int, str, object]] = []
+    if proxy_port_for is None:
+        _counter = iter(range(PROXY_PORT_BASE, PROXY_PORT_BASE + 10000))
+
+        def proxy_port_for(key: str) -> int:
+            return next(_counter)
+
+    subject_key = subject_labels.sorted_key()
 
     for rule in rules:
         if not rule.endpoint_selector.matches(subject_labels):
@@ -182,14 +197,14 @@ def resolve_policy(
 
         def emit(ms: MapState, peers: Optional[FrozenSet[int]],
                  to_ports, is_deny: bool) -> None:
-            nonlocal next_proxy
-            for proto, lo, hi, has_l7 in _port_specs(to_ports):
-                redirect = has_l7 and not is_deny
+            for proto, lo, hi, l7 in _port_specs(to_ports):
+                redirect = l7 is not None and not is_deny
                 proxy_port = 0
                 if redirect:
-                    proxy_port = next_proxy
-                    next_proxy += 1
-                    redirects.append((proxy_port, label))
+                    proxy_port = proxy_port_for(
+                        f"{subject_key}|{label}|{ms.direction}|"
+                        f"{proto}:{lo}-{hi}")
+                    redirects.append((proxy_port, label, l7))
                 ms.contributions.append(Contribution(
                     is_deny=is_deny,
                     identities=peers,
