@@ -8,14 +8,19 @@ parsing + the Go control plane under ``pkg/policy`` / ``pkg/identity`` /
 Layer map (mirrors SURVEY.md §1, re-drawn TPU-first):
 
 - ``core``      packet/header tensor schema, pcap ingest (host side)
-- ``ops``       pallas/XLA kernels: policy gather, LPM, conntrack hash
-- ``datapath``  the verdict pipeline + Loader seam (tpu / interpreter)
+- ``native``    C++ host runtime (ingest parser), g++-compiled at import
+- ``datapath``  the verdict pipeline + Loader seam (tpu / interpreter).
+                Kernels are XLA gather/scatter programs, not pallas: the
+                pipeline is gather-bound and XLA's fused gathers already
+                saturate it (datapath/verdict.py); pallas is reserved
+                for the day a probe kernel beats the fused gather
+- ``policy``    rule schema, repository, selector cache, MapState compiler
 - ``policy``    rule schema, repository, selector cache, MapState compiler
 - ``identity``  label->numeric identity allocation, reserved identities
 - ``ipcache``   IP/CIDR -> identity store, compiled to DIR-24-8 tensors
 - ``flow``      hubble-equivalent: threefour parser, observer, metrics
 - ``monitor``   event vocabulary (drop/trace/policy-verdict) + agent
-- ``models``    learned flow classifier (embedding from identity labels)
+- ``ml``        learned flow classifier (embedding from identity labels)
 - ``parallel``  device-mesh sharding of batch + replicated tables
 - ``kvstore``   in-memory kvstore + distributed allocator
 - ``api``/``cli`` REST-ish control API and cilium-style CLI

@@ -103,6 +103,13 @@ class Daemon:
                 threshold=self.config.anomaly_threshold)
             self.monitor.register("anomaly", self.anomaly.consume)
 
+        # L7 proxy plane: listeners follow the resolved redirects
+        # (reference: pkg/proxy redirect lifecycle + Envoy filter)
+        from ..proxy import L7Proxy
+
+        self.proxy = L7Proxy()
+        self.endpoints.on_attach(self.proxy.update)
+
         # wiring: rule changes and identity churn both end in one
         # coalesced regeneration (SURVEY.md §3.3)
         self.repo.on_change(lambda rev: self.endpoints.regenerate())
@@ -124,13 +131,25 @@ class Daemon:
     def _on_identity_change(self, kind: str, ident) -> None:
         # CIDR-derived identities feed the ipcache (reference: ipcache
         # CIDR entries appear when policy references them)
+        cidr_labels = []
         if kind == "add":
             for l in ident.labels:
                 if l.source == SOURCE_CIDR:
                     self.ipcache.upsert(l.key, ident.numeric_id,
                                         source="generated")
-        if self._started:
-            self.repo.invalidate()  # also triggers regeneration
+                    cidr_labels.append(l.key)
+        if not self._started:
+            return
+        # Incremental fast path (SURVEY.md §7 hard part #3): patch the
+        # identity's verdict row + LPM slots in place — no re-resolve,
+        # no compile_policy, no re-attach.  Falls back to a full
+        # regeneration when the backend can't express the patch.
+        if self.endpoints.patch_identity(kind, ident):
+            ok = all(self.endpoints.patch_ipcache(c, ident.numeric_id)
+                     for c in cidr_labels)
+            if ok:
+                return
+        self.repo.invalidate()  # also triggers regeneration
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> None:
@@ -177,6 +196,31 @@ class Daemon:
     def add_endpoint(self, name: str, ips: Tuple[str, ...],
                      labels: List[str]) -> Endpoint:
         return self.endpoints.add(name, ips, LabelSet.parse(*labels))
+
+    # -- L7 proxy API (the listener-facing entry) ----------------------
+    def handle_l7_http(self, proxy_port: int, requests,
+                       src_identity: int = 0) -> np.ndarray:
+        """Verdict HTTP requests arriving on a redirect listener
+        (1 = forward, 0 = 403)."""
+        row = (self.loader.row_map.row(src_identity)
+               if self.loader.row_map else 0)
+        return self.proxy.handle_http(proxy_port, requests, row)
+
+    def handle_l7_dns(self, proxy_port: int, qnames,
+                      src_identity: int = 0) -> np.ndarray:
+        row = (self.loader.row_map.row(src_identity)
+               if self.loader.row_map else 0)
+        return self.proxy.handle_dns(proxy_port, qnames, row)
+
+    # -- ipcache API (the k8s-watcher/clustermesh-facing entry) --------
+    def upsert_ipcache(self, cidr: str, numeric_id: int,
+                       source: str = "k8s") -> None:
+        """Map a prefix to an identity; patches the device LPM in
+        place when possible, else falls back to regeneration."""
+        self.ipcache.upsert(cidr, numeric_id, source=source)
+        if self.endpoints.patch_ipcache(cidr, numeric_id):
+            return
+        self.endpoints.regenerate()
 
     # -- status --------------------------------------------------------
     def status(self) -> dict:
@@ -237,11 +281,17 @@ class Daemon:
         # of the checkpoint pair, so a crash between the two renames
         # can never pair new control-plane state with a stale CT
         # snapshot (stale CT would resurrect established flows admitted
-        # under since-revoked policy)
+        # under since-revoked policy).  The CT snapshot additionally
+        # carries the policy revision it was taken under: the INVERSE
+        # crash ordering (new ct.npz + old state.json) is caught at
+        # restore time by the revision mismatch and the snapshot is
+        # skipped.
         ct = self.loader.ct_snapshot()
         ct_tmp = os.path.join(state_dir, "ct.npz.tmp")
         with open(ct_tmp, "wb") as f:
-            np.savez_compressed(f, table=ct)
+            np.savez_compressed(
+                f, table=ct,
+                revision=np.int64(self.repo.revision))
         os.replace(ct_tmp, os.path.join(state_dir, "ct.npz"))
         tmp = os.path.join(state_dir, "state.json.tmp")
         with open(tmp, "w") as f:
@@ -271,7 +321,25 @@ class Daemon:
         ct_path = os.path.join(state_dir, "ct.npz")
         if os.path.exists(ct_path):
             try:
-                self.loader.ct_restore(np.load(ct_path)["table"])
+                snap = np.load(ct_path)
+                # revision stamp: a CT snapshot taken under a different
+                # policy revision than state.json records is the torn-
+                # checkpoint case (crash between the two renames) —
+                # skip it rather than resurrect flows admitted under
+                # policy that is absent from the restored ruleset.
+                # Pre-stamp snapshots (no "revision" key) restore as
+                # before.
+                snap_rev = (int(snap["revision"])
+                            if "revision" in snap.files else None)
+                if snap_rev is not None and snap_rev != meta["revision"]:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "CT snapshot revision %s != checkpoint revision "
+                        "%s (torn checkpoint); skipping connection "
+                        "state", snap_rev, meta["revision"])
+                else:
+                    self.loader.ct_restore(snap["table"])
             except Exception as e:  # corrupt snapshot: identities/
                 # rules/endpoints above are already restored; losing
                 # live connections is the lesser failure

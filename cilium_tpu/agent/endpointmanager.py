@@ -37,8 +37,21 @@ class EndpointManager:
         self.loader = loader
         self.row_capacity = row_capacity
         self.regenerations = 0
+        # persistent identity->row map: rows are stable across identity
+        # churn so incremental tensor patches address the same row the
+        # attached tensors were compiled with (rows are never reused;
+        # released identities leave unreferenced rows behind)
+        self.row_map = IdentityRowMap(capacity=row_capacity)
+        self._attached_policies: List = []
+        self._attach_hooks: List = []  # fn(policies) after every attach
         self._regen_trigger = Trigger(self._regenerate_all,
                                       name="endpoint-regeneration")
+
+    def on_attach(self, fn) -> None:
+        """Register fn(policies), called after every successful attach
+        (the L7 proxy re-syncs its listeners here, the way pkg/proxy
+        updates redirects on endpoint regeneration)."""
+        self._attach_hooks.append(fn)
 
     # -- registry ----------------------------------------------------
     def add(self, name: str, ips: Tuple[str, ...], labels: LabelSet,
@@ -125,12 +138,37 @@ class EndpointManager:
             # no endpoints: an empty permissive policy keeps the
             # datapath well-formed
             policies = [self.repo.resolve(LabelSet.parse("reserved:init"))]
-        row_map = IdentityRowMap(capacity=self.row_capacity)
         for ident in self.repo.allocator.all_identities():
-            row_map.add(ident.numeric_id)
+            self.row_map.add(ident.numeric_id)
         self.loader.attach(policies, self.ipcache.to_identity_map(),
-                           ep_policy, row_map)
+                           ep_policy, self.row_map)
+        with self._lock:
+            self._attached_policies = policies
+        for fn in list(self._attach_hooks):
+            fn(policies)
         for ep in eps:
             ep.state = EndpointState.READY
             ep.policy_revision = revision
         self.regenerations += 1
+
+    # -- incremental identity churn (SURVEY.md §7 hard part #3) -------
+    def patch_identity(self, kind: str, ident) -> bool:
+        """Apply one identity add/remove as an in-place tensor patch
+        (no re-resolve, no recompile, no re-attach).  Returns False
+        when the caller must fall back to full regeneration."""
+        from ..policy.incremental import update_contributions
+
+        with self._lock:
+            policies = self._attached_policies
+        if not policies:
+            return False
+        # peer sets first (keeps the oracle/MapState view and any later
+        # full recompile consistent with the patched tensors) ...
+        update_contributions(policies, kind, ident.numeric_id,
+                             ident.labels)
+        # ... then the device row
+        return self.loader.patch_identity(kind, ident.numeric_id,
+                                          policies)
+
+    def patch_ipcache(self, cidr: str, numeric_id: int) -> bool:
+        return self.loader.patch_ipcache(cidr, numeric_id)

@@ -69,6 +69,23 @@ class Loader(abc.ABC):
     def ct_restore(self, table: np.ndarray) -> None:
         """Reload a CT snapshot (agent restart keeps connections)."""
 
+    # -- incremental updates (SURVEY.md §7 hard part #3) --------------
+    # Identity churn must NOT cost a full compile_policy + upload; the
+    # default False sends callers down the full-attach path, backends
+    # that can patch in place override.
+
+    def patch_identity(self, kind: str, numeric_id: int,
+                       policies) -> bool:
+        """Patch one identity's verdict row in place (peer sets in
+        ``policies`` must already reflect the change — see
+        policy.incremental.update_contributions).  Returns False when
+        a full attach is required instead."""
+        return False
+
+    def patch_ipcache(self, cidr: str, numeric_id: int) -> bool:
+        """Patch one ipcache prefix -> identity mapping in place."""
+        return False
+
 
 class TPULoader(Loader):
     """The real datapath: device tensors + fused jit pipeline."""
@@ -107,6 +124,9 @@ class TPULoader(Loader):
         with self._lock:
             self.row_map = row_map
             self.tensors = tensors
+            self._policies = list(policies)
+            self._lpm_entries = dict(ipcache)  # cidr -> numeric id
+            self._lpm_tensors = lpm  # host mirror, mutated by patches
             if self.state is None:  # keep live CT + counters otherwise
                 self.state = DatapathState.create(
                     policy=policy, ipcache=device_lpm,
@@ -127,6 +147,77 @@ class TPULoader(Loader):
                                                 jnp.uint32(now))
             row_map = self.row_map
         return np.asarray(out), row_map
+
+    # -- incremental patching (no recompile, no full upload) ----------
+    def patch_identity(self, kind: str, numeric_id: int,
+                       policies) -> bool:
+        from ..policy.incremental import compose_row
+        from .verdict import DevicePolicy
+
+        jnp = self._jnp
+        with self._lock:
+            if self.state is None or self.row_map is None:
+                return False
+            if len(policies) != self.tensors.verdict.shape[0]:
+                return False  # policy list changed shape: full attach
+            if kind == "remove" and self.row_map.row(numeric_id) == 0:
+                return True  # identity never had a row; nothing to patch
+            row = self.row_map.add(numeric_id)
+            if row >= self.tensors.verdict.shape[2]:
+                return False  # row capacity grew past the tensor
+            vals = compose_row(policies, numeric_id, self.tensors)
+            self.tensors.verdict[:, :, row, :] = vals  # host mirror
+            policy = self.state.policy
+            verdict = policy.verdict.at[:, :, row, :].set(
+                jnp.asarray(vals))
+            self.state = DatapathState(
+                policy=DevicePolicy(
+                    proto_table=policy.proto_table,
+                    port_class=policy.port_class,
+                    verdict=verdict,
+                    ep_policy=policy.ep_policy),
+                ipcache=self.state.ipcache, ct=self.state.ct,
+                metrics=self.state.metrics)
+            self._policies = list(policies)
+        return True
+
+    def patch_ipcache(self, cidr: str, numeric_id: int) -> bool:
+        from .lpm import DeviceLPM, lpm_upsert
+
+        jnp = self._jnp
+        with self._lock:
+            if self.state is None or self.row_map is None:
+                return False
+            row = self.row_map.add(numeric_id)
+            if row >= self.tensors.verdict.shape[2]:
+                return False
+            self._lpm_entries[cidr] = numeric_id
+            patches = lpm_upsert(self._lpm_tensors, cidr, row)
+            lpm = self.state.ipcache
+            if patches is None:
+                # padding exhausted / shadowing rebuild: recompile the
+                # LPM alone (never the policy tensors) and swap
+                t = compile_lpm({c: self.row_map.row(i)
+                                 for c, i in self._lpm_entries.items()})
+                self._lpm_tensors = t
+                new_lpm = DeviceLPM.from_tensors(t)
+            else:
+                l1, l2, l3 = lpm.l1, lpm.l2, lpm.l3
+                for field, idx, payload in patches:
+                    if field == "l1":
+                        l1 = l1.at[idx].set(jnp.asarray(payload))
+                    elif field == "l2":
+                        l2 = l2.at[idx].set(jnp.asarray(payload))
+                    else:
+                        l3 = l3.at[idx].set(jnp.asarray(payload))
+                new_lpm = DeviceLPM(
+                    l1=l1, l2=l2, l3=l3, v6_net=lpm.v6_net,
+                    v6_mask=lpm.v6_mask, v6_value=lpm.v6_value,
+                    v6_plen=lpm.v6_plen, default=lpm.default)
+            self.state = DatapathState(
+                policy=self.state.policy, ipcache=new_lpm,
+                ct=self.state.ct, metrics=self.state.metrics)
+        return True
 
     def gc(self, now: int) -> int:
         from .conntrack import ct_gc_jit
@@ -211,6 +302,38 @@ class InterpreterLoader(Loader):
 
     def gc(self, now: int) -> int:
         return self.oracle.gc(now)
+
+    # -- incremental patching -----------------------------------------
+    # The oracle evaluates MapState.lookup over the live contribution
+    # lists (already updated by update_contributions), so the policy
+    # side needs only a row for event decode; ipcache patches edit the
+    # oracle's prefix list directly.
+
+    def patch_identity(self, kind: str, numeric_id: int,
+                       policies) -> bool:
+        if self.oracle is None or self.row_map is None:
+            return False
+        self.row_map.add(numeric_id)
+        return True
+
+    def patch_ipcache(self, cidr: str, numeric_id: int) -> bool:
+        import ipaddress
+
+        if self.oracle is None:
+            return False
+        net = ipaddress.ip_network(cidr, strict=False)
+        host_bits = 32 if net.version == 4 else 128
+        addr = int(net.network_address)
+        if net.prefixlen == host_bits:
+            self.oracle._exact[(net.version, addr)] = numeric_id
+        else:
+            key = (net.version, addr, net.prefixlen)
+            self.oracle.ipcache = [
+                e for e in self.oracle.ipcache if e[:3] != key]
+            self.oracle.ipcache.append((net.version, addr,
+                                        net.prefixlen, numeric_id))
+        self.oracle._lpm_memo.clear()
+        return True
 
     def metrics(self) -> np.ndarray:
         return self._metrics.copy()

@@ -137,6 +137,77 @@ def compile_lpm(entries: Dict[str, int], default: int = 0,
     )
 
 
+def lpm_used_blocks(t: LPMTensors) -> Tuple[int, int]:
+    """(n_l2_used, n_l3_used) — block-pad headroom is what makes
+    incremental upserts possible without reshaping device tensors."""
+    # pointers encode block b as -(b+1): the used count is determined
+    # by the MOST NEGATIVE pointer
+    n_l2 = int(-(t.l1[t.l1 < 0]).min()) if (t.l1 < 0).any() else 0
+    n_l3 = int(-(t.l2[t.l2 < 0]).min()) if (t.l2 < 0).any() else 0
+    return n_l2, n_l3
+
+
+def lpm_upsert(t: LPMTensors, cidr: str,
+               value: int) -> Optional[List[tuple]]:
+    """Insert/overwrite one HOST ROUTE (/32) in place.
+
+    Returns the device patch list [(field, index, payload), ...] —
+    ``("l1", slot, scalar)`` / ``("l2"|"l3", block, row[256])``,
+    ordered children-first so a step between patch applications never
+    follows a pointer into an unwritten block — or None when the entry
+    needs a full recompile+upload of the LPM tensors (still never a
+    policy recompile).
+
+    ONLY /32s patch in place: the compiled tables store no per-slot
+    prefix lengths, so painting a shorter prefix's range could
+    overwrite longer (more-specific) sibling values and break
+    longest-prefix-match — those go down the rebuild path.  A /32 is
+    always the most specific, and identity churn (pod IPs, fqdn IPs)
+    is host routes, so the hot path is covered.
+
+    This is the ipcache analogue of a BPF LPM-map update: one map
+    entry changes, nothing re-attaches.
+    """
+    if value < 0:
+        raise ValueError(f"LPM value must be >= 0, got {value}")
+    net = ipaddress.ip_network(cidr, strict=False)
+    if net.version != 4 or net.prefixlen != 32:
+        return None  # rebuild path (v6 TCAM swap / non-host-route)
+    addr = int(net.network_address)
+    n_l2, n_l3 = lpm_used_blocks(t)
+    hi16, mid8, lo8 = addr >> 16, (addr >> 8) & 0xFF, addr & 0xFF
+
+    cur1 = int(t.l1[hi16])
+    l1_created = cur1 >= 0
+    if l1_created:
+        if n_l2 >= t.l2.shape[0]:
+            return None  # l2 padding exhausted
+        blk2 = n_l2
+        t.l2[blk2, :] = cur1  # inherit the shorter prefix's value
+        t.l1[hi16] = -(blk2 + 1)
+    else:
+        blk2 = -cur1 - 1
+
+    cur2 = int(t.l2[blk2, mid8])
+    l2_changed = cur2 >= 0
+    if l2_changed:
+        if n_l3 >= t.l3.shape[0]:
+            return None
+        blk3 = n_l3
+        t.l3[blk3, :] = cur2
+        t.l2[blk2, mid8] = -(blk3 + 1)
+    else:
+        blk3 = -cur2 - 1
+
+    t.l3[blk3, lo8] = value
+    patches: List[tuple] = [("l3", blk3, t.l3[blk3].copy())]
+    if l2_changed or l1_created:
+        patches.append(("l2", blk2, t.l2[blk2].copy()))
+    if l1_created:
+        patches.append(("l1", hi16, np.int32(-(blk2 + 1))))
+    return patches
+
+
 def lookup_v4(t_l1: jnp.ndarray, t_l2: jnp.ndarray, t_l3: jnp.ndarray,
               ip: jnp.ndarray) -> jnp.ndarray:
     """Batched IPv4 LPM: [N] uint32 -> [N] int32 values. Three gathers."""

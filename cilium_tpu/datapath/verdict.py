@@ -211,6 +211,23 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
 datapath_step_jit = jax.jit(datapath_step, donate_argnums=0)
 
 
+def datapath_step_packed(state: DatapathState, packed: jnp.ndarray,
+                         now: jnp.ndarray, ep, dirn,
+                         valid: jnp.ndarray = None
+                         ) -> Tuple[jnp.ndarray, DatapathState]:
+    """The ingest fast path: packed IPv4 rows (16 B/packet on the h2d
+    link — see core/packets.py PACKED_*) unpack on device and run the
+    same fused pipeline.  ``ep``/``dirn`` are per-stream scalars, like
+    the per-endpoint tc hook in the reference."""
+    from ..core.packets import unpack_hdr
+
+    return datapath_step(state, unpack_hdr(packed, ep, dirn), now,
+                         valid=valid)
+
+
+datapath_step_packed_jit = jax.jit(datapath_step_packed, donate_argnums=0)
+
+
 def build_state(policy_tensors: PolicyTensors, lpm_tensors: LPMTensors,
                 ep_policy: np.ndarray = None,
                 ct_capacity: int = 1 << 20,

@@ -42,14 +42,19 @@ class EventRing:
     """Device state of the ring (pytree: threads through jit)."""
 
     buf: jnp.ndarray  # [capacity, RING_COLS] uint32
-    cursor: jnp.ndarray  # [] uint32 — total events ever appended
+    # total events ever appended, as TWO u32 words [lo, hi] — a single
+    # u32 wraps after 2^32 events (hours at target rates; the reference
+    # perf/Hubble rings count in u64) and a wrapped cursor makes drain
+    # misread a full ring as nearly empty.  x64 is off under jit, so
+    # the 64-bit count is carried as lo + carry-into-hi on device.
+    cursor: jnp.ndarray  # [2] uint32
 
     @staticmethod
     def create(capacity: int = 1 << 15) -> "EventRing":
         assert capacity & (capacity - 1) == 0, "capacity must be 2^k"
         buf = jnp.full((capacity, RING_COLS), EMPTY_BATCH,
                        dtype=jnp.uint32)
-        return EventRing(buf=buf, cursor=jnp.zeros((), jnp.uint32))
+        return EventRing(buf=buf, cursor=jnp.zeros((2,), jnp.uint32))
 
     @property
     def capacity(self) -> int:
@@ -82,8 +87,8 @@ def ring_append(ring: EventRing, out: jnp.ndarray, batch_id: jnp.ndarray,
     pos = jnp.cumsum(keep) - 1  # position among kept rows
     count = keep.sum().astype(jnp.uint32)
     mask = ring.capacity - 1
-    slot = ((ring.cursor + pos.astype(jnp.uint32)) & mask).astype(
-        jnp.int32)
+    lo, hi = ring.cursor[0], ring.cursor[1]
+    slot = ((lo + pos.astype(jnp.uint32)) & mask).astype(jnp.int32)
     # newest-wins under overflow: when one batch keeps more events than
     # the ring holds, only the newest `capacity` rows write — otherwise
     # duplicate slot indices in one scatter would make the survivor
@@ -96,7 +101,9 @@ def ring_append(ring: EventRing, out: jnp.ndarray, batch_id: jnp.ndarray,
         jnp.full((n, 1), batch_id, dtype=jnp.uint32),
     ], axis=1)
     buf = ring.buf.at[target].set(rows, mode="drop")
-    return EventRing(buf=buf, cursor=ring.cursor + count)
+    new_lo = lo + count
+    new_hi = hi + (new_lo < lo).astype(jnp.uint32)  # carry
+    return EventRing(buf=buf, cursor=jnp.stack([new_lo, new_hi]))
 
 
 ring_append_jit = jax.jit(ring_append, donate_argnums=0,
@@ -121,6 +128,22 @@ serve_step_jit = jax.jit(serve_step, donate_argnums=(0, 1),
                          static_argnames=("trace_sample",))
 
 
+def serve_step_packed(state, ring: EventRing, packed: jnp.ndarray,
+                      now: jnp.ndarray, batch_id: jnp.ndarray,
+                      ep, dirn, trace_sample: int = 1024):
+    """Serving path for the packed ingest format (16 B/packet h2d):
+    unpack + fused datapath + ring append, ONE dispatch per batch."""
+    from ..datapath.verdict import datapath_step_packed
+
+    out, state = datapath_step_packed(state, packed, now, ep, dirn)
+    ring = ring_append(ring, out, batch_id, trace_sample=trace_sample)
+    return state, ring
+
+
+serve_step_packed_jit = jax.jit(serve_step_packed, donate_argnums=(0, 1),
+                                static_argnames=("trace_sample",))
+
+
 def ring_drain(ring: EventRing) -> Tuple[np.ndarray, int, int]:
     """Fetch + decode the ring on host.
 
@@ -128,7 +151,8 @@ def ring_drain(ring: EventRing) -> Tuple[np.ndarray, int, int]:
     n_overwritten).  The single host fetch happens HERE, at the
     monitor's cadence — never in the datapath hot loop."""
     buf = np.asarray(ring.buf)
-    total = int(np.asarray(ring.cursor))
+    lo, hi = (int(w) for w in np.asarray(ring.cursor))
+    total = (hi << 32) | lo
     cap = buf.shape[0]
     if total <= cap:
         rows = buf[:total]

@@ -35,6 +35,19 @@ def _so_path() -> str:
     return os.path.join(_DIR, f"_ingest_{digest}.so")
 
 
+def _compile(so: str) -> bool:
+    tmp = so + f".tmp{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+             "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, so)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
 def _load() -> Optional[ctypes.CDLL]:
     """Compile (once, content-addressed) and dlopen the ingest library."""
     global _lib, _build_failed
@@ -44,22 +57,30 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _build_failed:
             return _lib
         so = _so_path()
-        if not os.path.exists(so):
-            tmp = so + f".tmp{os.getpid()}"
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                     "-o", tmp, _SRC],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, so)
-            except (OSError, subprocess.SubprocessError):
-                _build_failed = True
-                return None
+        preexisting = os.path.exists(so)
+        if not preexisting and not _compile(so):
+            _build_failed = True
+            return None
         try:
             lib = ctypes.CDLL(so)
         except OSError:
-            _build_failed = True
-            return None
+            # A stale .so built on another arch/glibc must not disable
+            # the native path while g++ can rebuild from source: drop
+            # it and try one rebuild before falling back.
+            lib = None
+            if preexisting:
+                try:
+                    os.unlink(so)
+                except OSError:
+                    pass
+                if _compile(so):
+                    try:
+                        lib = ctypes.CDLL(so)
+                    except OSError:
+                        lib = None
+            if lib is None:
+                _build_failed = True
+                return None
         for fn in (lib.parse_frames, lib.parse_pcap):
             fn.restype = ctypes.c_long
             fn.argtypes = [
@@ -67,6 +88,12 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.POINTER(ctypes.c_uint32), ctypes.c_long,
                 ctypes.c_uint32, ctypes.c_uint32,
             ]
+        lib.parse_frames_packed.restype = ctypes.c_long
+        lib.parse_frames_packed.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+        ]
         _lib = lib
         return _lib
 
@@ -99,6 +126,59 @@ def parse_frames(buf: bytes, ep: int = 0, direction: int = 0,
     if max_rows is None:
         max_rows = max(len(buf) // 24, 1)  # 4B prefix + >=20B IP
     return _call("parse_frames", buf, max_rows, ep, direction)
+
+
+def parse_frames_packed(buf: bytes, out: Optional[np.ndarray] = None
+                        ) -> Optional[tuple]:
+    """Length-prefixed frame stream -> packed IPv4 rows [n, 4] u32.
+
+    The packed format is the h2d wire layout (core/packets.py
+    PACKED_*); non-IPv4 frames are skipped and counted.  Pass a reused
+    ``out`` buffer ([max_rows, 4] u32, C-contiguous) so transfers hit
+    the host page-registration cache — the packed path exists for
+    ingest bandwidth (SURVEY.md §7 hard part #4).
+
+    Returns (rows_view, n_rows, n_skipped); rows_view is ``out[:n]``
+    (a view, NOT a copy).  None when the native library is missing.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    if out is None:
+        out = np.empty((max(len(buf) // 24, 1), 4), dtype=np.uint32)
+    assert out.dtype == np.uint32 and out.flags["C_CONTIGUOUS"]
+    skipped = ctypes.c_long(0)
+    overflow = ctypes.c_long(0)
+    n = lib.parse_frames_packed(
+        buf, len(buf),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        out.shape[0], ctypes.byref(skipped), ctypes.byref(overflow))
+    if overflow.value:
+        raise ValueError(
+            f"out buffer too small: {overflow.value} frames beyond "
+            f"{out.shape[0]} rows (silent truncation would be "
+            "undetectable packet loss)")
+    return out[:n], int(n), int(skipped.value)
+
+
+def parse_frames_packed_py(buf: bytes,
+                           out: Optional[np.ndarray] = None) -> tuple:
+    """Pure-Python fallback for :func:`parse_frames_packed` — parses
+    wide rows then packs; same return contract."""
+    from ..core.packets import COL_FAMILY, pack_rows
+
+    wide = parse_frames_py(buf)
+    v4 = wide[wide[:, COL_FAMILY] == 4]
+    skipped = len(wide) - len(v4)
+    packed = pack_rows(v4)
+    if out is None:
+        return packed, len(v4), skipped
+    if len(v4) > out.shape[0]:  # same contract as the native path
+        raise ValueError(
+            f"out buffer too small: {len(v4) - out.shape[0]} frames "
+            f"beyond {out.shape[0]} rows")
+    out[:len(v4)] = packed
+    return out[:len(v4)], len(v4), skipped
 
 
 def parse_pcap_bytes(buf: bytes, ep: int = 0, direction: int = 0,

@@ -161,4 +161,54 @@ long parse_pcap(const uint8_t* buf, long buf_len, uint32_t* out,
     return rows;
 }
 
+// Packed IPv4 fast path: 4 u32 words per packet, the h2d wire format
+// (cilium_tpu/core/packets.py PACKED_*):
+//   w0 = src ip | w1 = dst ip | w2 = sport<<16|dport
+//   w3 = proto<<24 | tcp_flags<<16 | ip_total_len
+// One pass, no intermediate wide row: frames stream -> packed rows
+// written straight into the (reused) transfer buffer.  Non-IPv4
+// frames are skipped and counted in *n_skipped (callers route those
+// through the wide parser).
+// *n_overflow counts parseable IPv4 frames that did NOT fit in
+// max_rows — the caller's buffer was undersized and it must know
+// (silent truncation would be undetectable packet loss).
+long parse_frames_packed(const uint8_t* buf, long buf_len, uint32_t* out,
+                         long max_rows, long* n_skipped,
+                         long* n_overflow) {
+    long off = 0, rows = 0, skipped = 0, overflow = 0;
+    while (off + 4 <= buf_len) {
+        uint32_t flen;
+        std::memcpy(&flen, buf + off, 4);
+        off += 4;
+        if (off + flen > buf_len) break;
+        long ip_len;
+        const uint8_t* p = eth_payload(buf + off, flen, &ip_len);
+        off += flen;
+        if (!p || ip_len < 20 || (p[0] >> 4) != 4) { ++skipped; continue; }
+        if (rows >= max_rows) { ++overflow; continue; }
+        const int ihl = (p[0] & 0xF) * 4;
+        if (ip_len < ihl || ihl < 20) { ++skipped; continue; }
+        const uint32_t proto = p[9];
+        uint32_t sport = 0, dport = 0, flags = 0;
+        const uint8_t* l4 = p + ihl;
+        const long l4_len = ip_len - ihl;
+        if ((proto == 6 || proto == 17 || proto == 132) && l4_len >= 4) {
+            sport = be16(l4);
+            dport = be16(l4 + 2);
+            if (proto == 6 && l4_len >= 14) flags = l4[13];
+        } else if (proto == 1 && l4_len >= 2) {
+            dport = l4[0];
+        }
+        uint32_t* w = out + rows * 4;
+        w[0] = be32(p + 12);
+        w[1] = be32(p + 16);
+        w[2] = (sport << 16) | dport;
+        w[3] = (proto << 24) | (flags << 16) | be16(p + 2);
+        ++rows;
+    }
+    if (n_skipped) *n_skipped = skipped;
+    if (n_overflow) *n_overflow = overflow;
+    return rows;
+}
+
 }  // extern "C"

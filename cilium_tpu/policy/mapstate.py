@@ -64,6 +64,14 @@ class Contribution:
     constraint, or explicitly selected all).  ``proto`` is a dense proto
     index or PROTO_ANY.  ``lo``/``hi`` is an inclusive dport range
     ([0, 65535] = all ports; for ICMP the range is over icmp type).
+
+    ``selectors``/``fqdn_patterns`` record WHERE the identity set came
+    from (the label selectors + fqdn matchPattern globs whose
+    selections were unioned in), so identity churn can be applied
+    incrementally: a new identity joins the frozen set iff it matches
+    one of them (reference: L4Filter holds CachedSelectors and receives
+    SelectorCache delta notifications).  CIDR-derived members are
+    static (resolved by ipcache/LPM, not by labels).
     """
 
     is_deny: bool
@@ -74,6 +82,8 @@ class Contribution:
     redirect: bool = False
     proxy_port: int = 0
     rule_label: str = ""
+    selectors: Tuple = ()  # Tuple[EndpointSelector, ...]
+    fqdn_patterns: Tuple[str, ...] = ()
 
     def covers(self, identity: int, proto: int, port: int) -> bool:
         if self.identities is not None and identity not in self.identities:
@@ -81,6 +91,21 @@ class Contribution:
         if self.proto != PROTO_ANY and self.proto != proto:
             return False
         return self.lo <= port <= self.hi
+
+    def selects_labels(self, labels) -> bool:
+        """Would an identity with these labels belong to the peer set?
+        (The incremental-membership test; wildcard peers select all.)"""
+        import fnmatch
+
+        if self.identities is None:
+            return True
+        if any(sel.matches(labels) for sel in self.selectors):
+            return True
+        for pat in self.fqdn_patterns:
+            for lab in labels:
+                if lab.source == "fqdn" and fnmatch.fnmatch(lab.key, pat):
+                    return True
+        return False
 
 
 @dataclass(frozen=True)

@@ -75,6 +75,27 @@ class EndpointPolicy:
         return self.mapstate(direction).lookup(identity, proto, port)
 
 
+# The "cluster" entity as a live selector: every identity NOT carrying
+# reserved:world (reference: entity "cluster" covers all
+# cluster-managed endpoints + host).  Expressed as a selector so
+# identity churn updates cluster peer sets incrementally.
+from .api import Requirement  # noqa: E402
+
+CLUSTER_SELECTOR = EndpointSelector(
+    match_expressions=(Requirement(key=f"{SOURCE_RESERVED}:world",
+                                   operator="DoesNotExist"),))
+
+
+@dataclass(frozen=True)
+class PeerSet:
+    """Resolved peer identities + the live selectors they came from
+    (the selectors make the set incrementally updatable on churn)."""
+
+    ids: Optional[FrozenSet[int]]  # None == wildcard peer
+    selectors: Tuple[EndpointSelector, ...] = ()
+    fqdn_patterns: Tuple[str, ...] = ()
+
+
 def _peer_identities(
     selectors: Sequence[EndpointSelector],
     cidrs: Sequence[CIDRRule],
@@ -82,29 +103,32 @@ def _peer_identities(
     selector_cache: SelectorCache,
     allocator: CachingIdentityAllocator,
     fqdns: Sequence[str] = (),
-) -> Optional[FrozenSet[int]]:
-    """None == wildcard peer (no L3 constraint)."""
+) -> PeerSet:
+    """PeerSet(ids=None) == wildcard peer (no L3 constraint)."""
     if not selectors and not cidrs and not entities and not fqdns:
-        return None
+        return PeerSet(ids=None)
     ids: set = set()
+    live: list = []
+    patterns: list = []
     for sel in selectors:
         ids |= selector_cache.selections(sel)
+        live.append(sel)
     for ent in entities:
         if ent in (ENTITY_ALL,):
-            return None
+            return PeerSet(ids=None)
         if ent == ENTITY_CLUSTER:
-            # cluster = every non-world identity (reference: entity
-            # "cluster" covers all cluster-managed endpoints + host).
             world = Label(SOURCE_RESERVED, "world")
             ids |= {
                 i.numeric_id for i in selector_cache.known_identities()
                 if not i.labels.has(world)
             }
+            live.append(CLUSTER_SELECTOR)
             continue
         sel = ENTITY_SELECTORS.get(ent)
         if sel is None:
             raise ValueError(f"unknown entity {ent!r}")
         ids |= selector_cache.selections(sel)
+        live.append(sel)
     for c in cidrs:
         ident = allocator.allocate_cidr(c.cidr)
         ids.add(ident.numeric_id)
@@ -126,10 +150,13 @@ def _peer_identities(
                     if lab.source == "fqdn" and fnmatch.fnmatch(lab.key,
                                                                 name):
                         ids.add(ident.numeric_id)
+            patterns.append(name)
         else:
             sel = EndpointSelector.from_labels(f"fqdn:{name}")
             ids |= selector_cache.selections(sel)
-    return frozenset(ids)
+            live.append(sel)
+    return PeerSet(ids=frozenset(ids), selectors=tuple(live),
+                   fqdn_patterns=tuple(patterns))
 
 
 def _port_specs(to_ports: Sequence[PortRule]):
@@ -195,7 +222,7 @@ def resolve_policy(
             egr.enforcing = True
         label = ",".join(rule.labels) or rule.description
 
-        def emit(ms: MapState, peers: Optional[FrozenSet[int]],
+        def emit(ms: MapState, peers: PeerSet,
                  to_ports, is_deny: bool) -> None:
             for proto, lo, hi, l7 in _port_specs(to_ports):
                 redirect = l7 is not None and not is_deny
@@ -207,13 +234,15 @@ def resolve_policy(
                     redirects.append((proxy_port, label, l7))
                 ms.contributions.append(Contribution(
                     is_deny=is_deny,
-                    identities=peers,
+                    identities=peers.ids,
                     proto=proto,
                     lo=lo,
                     hi=hi,
                     redirect=redirect,
                     proxy_port=proxy_port,
                     rule_label=label,
+                    selectors=peers.selectors,
+                    fqdn_patterns=peers.fqdn_patterns,
                 ))
 
         for r in rule.ingress:

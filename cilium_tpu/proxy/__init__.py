@@ -1,0 +1,33 @@
+"""L7 request enforcement: the Envoy/proxylib plane, TPU-first.
+
+Reference: upstream cilium redirects L7-policied connections to an
+Envoy listener (``pkg/proxy`` allocates the ports, ``pkg/envoy`` pushes
+``NetworkPolicy`` over xDS, ``proxylib/`` parses requests and returns
+per-request verdicts, the DNS proxy lives in ``pkg/fqdn``).  TPU-first
+redesign (SURVEY.md §2a rows 5-6): parsers become *featurizers* that
+emit fixed-width L7 feature rows; ``L7Rules`` compile into per-rule
+match tensors; request verdicts are one batched masked-compare on
+device, with a host fallback only for regex/glob rules that cannot
+compile to exact hashes.
+"""
+
+from .l7policy import (
+    L7PolicyTensors,
+    METHOD_IDS,
+    compile_l7,
+    l7_verdict,
+)
+from .featurize import featurize_dns, featurize_http, fnv64
+from .proxy import L7Proxy, L7Record
+
+__all__ = [
+    "L7PolicyTensors",
+    "METHOD_IDS",
+    "compile_l7",
+    "l7_verdict",
+    "featurize_http",
+    "featurize_dns",
+    "fnv64",
+    "L7Proxy",
+    "L7Record",
+]

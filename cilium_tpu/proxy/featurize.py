@@ -1,0 +1,114 @@
+"""L7 featurizers: requests -> fixed-width feature rows.
+
+Reference: ``proxylib/`` parsers (Go, loaded into Envoy via cgo) parse
+protocol payloads and hand structured requests to the policy filter.
+TPU-first: the parser's output is a ``[N, L7_COLS] uint32`` tensor —
+string fields ride as 64-bit FNV-1a hashes (two u32 words) so the
+policy match is pure tensor compares; the raw strings travel alongside
+only for (a) regex-rule host fallback and (b) access-log records.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# Feature row columns.
+L7_PORT = 0  # proxy port the request arrived on
+L7_KIND = 1  # 0 = HTTP, 1 = DNS
+L7_METHOD = 2  # dense method id (HTTP) / query type (DNS)
+L7_PATH_H0 = 3  # FNV-64 low word of path (HTTP) / qname (DNS)
+L7_PATH_H1 = 4  # FNV-64 high word
+L7_HOST_H0 = 5  # FNV-64 low word of Host header
+L7_HOST_H1 = 6
+L7_SRC_ROW = 7  # source identity row (for per-peer L7 policy + logs)
+L7_COLS = 8
+
+KIND_HTTP = 0
+KIND_DNS = 1
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = (1 << 64) - 1
+
+
+def fnv64(s: str) -> Tuple[int, int]:
+    """FNV-1a 64-bit of the utf-8 bytes -> (lo32, hi32); ('' -> (0,0)).
+
+    The empty string maps to (0, 0) = the wildcard marker, so policy
+    fields left blank mean "any" (upstream: empty method/path/host
+    fields are unconstrained)."""
+    if not s:
+        return 0, 0
+    h = _FNV_OFFSET
+    for b in s.encode():
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    lo, hi = h & 0xFFFFFFFF, h >> 32
+    if lo == 0 and hi == 0:  # never collide with the wildcard marker
+        lo = 1
+    return lo, hi
+
+
+def _norm_dns(name: str) -> str:
+    return name.rstrip(".").lower()
+
+
+def featurize_http(requests: Sequence[dict], port: int,
+                   src_row: int = 0) -> Tuple[np.ndarray, List[dict]]:
+    """Structured HTTP requests ({method, path, host}) -> feature rows.
+
+    Returns (rows [N, L7_COLS], the requests echoed back — callers keep
+    them for regex fallback + access logs)."""
+    from .l7policy import METHOD_IDS
+
+    n = len(requests)
+    out = np.zeros((n, L7_COLS), dtype=np.uint32)
+    out[:, L7_PORT] = port
+    out[:, L7_KIND] = KIND_HTTP
+    out[:, L7_SRC_ROW] = src_row
+    for i, r in enumerate(requests):
+        out[i, L7_METHOD] = METHOD_IDS.get(r.get("method", "").upper(), 0)
+        lo, hi = fnv64(r.get("path", ""))
+        out[i, L7_PATH_H0], out[i, L7_PATH_H1] = lo, hi
+        lo, hi = fnv64(r.get("host", ""))
+        out[i, L7_HOST_H0], out[i, L7_HOST_H1] = lo, hi
+    return out, list(requests)
+
+
+def featurize_dns(qnames: Sequence[str], port: int,
+                  src_row: int = 0) -> Tuple[np.ndarray, List[str]]:
+    """DNS query names -> feature rows (qname hash in the path words)."""
+    n = len(qnames)
+    out = np.zeros((n, L7_COLS), dtype=np.uint32)
+    out[:, L7_PORT] = port
+    out[:, L7_KIND] = KIND_DNS
+    out[:, L7_SRC_ROW] = src_row
+    names = [_norm_dns(q) for q in qnames]
+    for i, q in enumerate(names):
+        lo, hi = fnv64(q)
+        out[i, L7_PATH_H0], out[i, L7_PATH_H1] = lo, hi
+    return out, names
+
+
+def parse_http_bytes(payloads: Iterable[bytes]) -> List[dict]:
+    """Minimal HTTP/1.x request parser: request line + Host header.
+
+    The wire-facing half of the featurizer (reference: proxylib's HTTP
+    parser); malformed requests become empty dicts, which match no
+    rule and are therefore denied by an enforcing L7 policy."""
+    out = []
+    for raw in payloads:
+        try:
+            head = raw.split(b"\r\n\r\n", 1)[0].decode("latin-1")
+            lines = head.split("\r\n")
+            method, path, _ = lines[0].split(" ", 2)
+            host = ""
+            for ln in lines[1:]:
+                if ln.lower().startswith("host:"):
+                    host = ln.split(":", 1)[1].strip()
+                    break
+            out.append({"method": method, "path": path, "host": host})
+        except (ValueError, IndexError):
+            out.append({})
+    return out

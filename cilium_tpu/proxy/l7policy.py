@@ -1,0 +1,183 @@
+"""L7Rules -> per-rule match tensors + host fallback matchers.
+
+Reference: upstream cilium pushes ``api.L7Rules`` to Envoy as an xDS
+``NetworkPolicy`` (``pkg/envoy``); the cilium Envoy filter evaluates
+each request against the rule list (an unmatched request on an
+L7-policied port gets 403 / a refused DNS answer — L7 default deny).
+
+TPU-first: each HTTP/DNS rule row compiles to one row of a match
+tensor; a request matches a rule iff every constrained field agrees
+(method id, 64-bit path/qname hash, host hash).  The batched verdict
+is one masked compare over [N requests x R rules] on device.  Rules
+whose fields are regexes/globs (not expressible as exact hashes)
+compile to *host matchers* instead; a port is host-evaluated only for
+requests no exact rule already admitted.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..policy.api import L7Rules
+from .featurize import (
+    KIND_DNS,
+    KIND_HTTP,
+    L7_COLS,
+    L7_HOST_H0,
+    L7_HOST_H1,
+    L7_KIND,
+    L7_METHOD,
+    L7_PATH_H0,
+    L7_PATH_H1,
+    L7_PORT,
+    fnv64,
+)
+
+METHOD_IDS: Dict[str, int] = {
+    "GET": 1, "POST": 2, "PUT": 3, "DELETE": 4, "HEAD": 5,
+    "OPTIONS": 6, "PATCH": 7, "CONNECT": 8, "TRACE": 9,
+}
+
+# rule tensor columns
+R_PORT = 0
+R_KIND = 1
+R_METHOD = 2  # 0 == any
+R_PATH_H0 = 3  # (0,0) == any
+R_PATH_H1 = 4
+R_HOST_H0 = 5
+R_HOST_H1 = 6
+R_COLS = 7
+
+_REGEX_CHARS = re.compile(r"[.*+?^$()\[\]{}|\\]")
+
+
+def _is_literal(path: str) -> bool:
+    """True when the rule's path regex is a plain literal (the common
+    case), so it compiles to an exact hash."""
+    return not _REGEX_CHARS.search(path)
+
+
+@dataclass
+class L7PolicyTensors:
+    """Compiled L7 policy: device rule tensor + host fallback."""
+
+    rules: np.ndarray  # [R, R_COLS] uint32 (exact rules)
+    # port -> [fn(request_dict) -> bool] for regex/glob rules
+    host_matchers: Dict[int, List[Callable]] = field(default_factory=dict)
+    # every L7-policied port (requests on other ports bypass the proxy)
+    ports: frozenset = frozenset()
+    # port -> original L7Rules (for xDS-style display / DNS observers)
+    by_port: Dict[int, L7Rules] = field(default_factory=dict)
+
+
+def compile_l7(redirects: Sequence[Tuple[int, str, L7Rules]]
+               ) -> L7PolicyTensors:
+    """Compile ``EndpointPolicy.redirects`` into match tensors.
+
+    ``redirects`` is the resolver's (proxy_port, rule_label, L7Rules)
+    list; one listener per port (reference: pkg/proxy redirect
+    lifecycle)."""
+    rows: List[List[int]] = []
+    host_matchers: Dict[int, List[Callable]] = {}
+    by_port: Dict[int, L7Rules] = {}
+    ports = set()
+
+    for port, _label, l7 in redirects:
+        ports.add(port)
+        by_port[port] = l7
+        for h in l7.http:
+            # 0 in the method column means "any"; a method OUTSIDE the
+            # dense id table (PURGE, custom verbs) must NOT compile to
+            # 0 — that would widen the rule — so it takes the host
+            # matcher path, which compares method strings.
+            method_id = (0 if not h.method
+                         else METHOD_IDS.get(h.method.upper()))
+            literal = ((not h.path or _is_literal(h.path))
+                       and not h.headers and method_id is not None
+                       and _is_literal(h.host))
+            if literal:
+                p_lo, p_hi = fnv64(h.path)
+                ho_lo, ho_hi = fnv64(h.host)
+                rows.append([
+                    port, KIND_HTTP, method_id,
+                    p_lo, p_hi, ho_lo, ho_hi,
+                ])
+                continue
+            host_matchers.setdefault(port, []).append(
+                _http_matcher(h))
+        for d in l7.dns:
+            if d.match_name:
+                lo, hi = fnv64(d.match_name.rstrip(".").lower())
+                rows.append([port, KIND_DNS, 0, lo, hi, 0, 0])
+            if d.match_pattern:
+                pat = d.match_pattern.rstrip(".").lower()
+                host_matchers.setdefault(port, []).append(
+                    _dns_matcher(pat))
+
+    rules = (np.asarray(rows, dtype=np.uint32) if rows
+             else np.zeros((0, R_COLS), dtype=np.uint32))
+    return L7PolicyTensors(rules=rules, host_matchers=host_matchers,
+                           ports=frozenset(ports), by_port=by_port)
+
+
+def _http_matcher(h) -> Callable:
+    meth = h.method.upper()
+    path_re = re.compile(h.path) if h.path else None
+    host_re = re.compile(h.host) if h.host else None
+
+    def match(req: dict) -> bool:
+        if meth and req.get("method", "").upper() != meth:
+            return False
+        if path_re and not path_re.fullmatch(req.get("path", "")):
+            return False
+        if host_re and not host_re.fullmatch(req.get("host", "")):
+            return False
+        if h.headers:
+            have = {x.strip() for x in req.get("headers", ())}
+            if not set(h.headers).issubset(have):
+                return False
+        return True
+
+    return match
+
+
+def _dns_matcher(pattern: str) -> Callable:
+    def match(req) -> bool:
+        name = req if isinstance(req, str) else req.get("qname", "")
+        return fnmatch.fnmatch(name, pattern)
+
+    return match
+
+
+def l7_verdict(rules: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+    """Batched request verdict: [N, L7_COLS] x [R, R_COLS] -> [N] bool.
+
+    A request is admitted iff SOME rule row matches on every
+    constrained field (L7 default deny otherwise).  One fused masked
+    compare — no per-request control flow."""
+    if rules.shape[0] == 0:
+        return jnp.zeros(rows.shape[0], dtype=bool)
+    r = rules[None, :, :].astype(jnp.uint32)  # [1, R, C]
+    q = rows[:, None, :].astype(jnp.uint32)  # [N, 1, C]
+    port_ok = q[:, :, L7_PORT] == r[:, :, R_PORT]
+    kind_ok = q[:, :, L7_KIND] == r[:, :, R_KIND]
+    meth_ok = (r[:, :, R_METHOD] == 0) | (q[:, :, L7_METHOD]
+                                          == r[:, :, R_METHOD])
+    path_any = (r[:, :, R_PATH_H0] == 0) & (r[:, :, R_PATH_H1] == 0)
+    path_ok = path_any | ((q[:, :, L7_PATH_H0] == r[:, :, R_PATH_H0])
+                          & (q[:, :, L7_PATH_H1] == r[:, :, R_PATH_H1]))
+    host_any = (r[:, :, R_HOST_H0] == 0) & (r[:, :, R_HOST_H1] == 0)
+    host_ok = host_any | ((q[:, :, L7_HOST_H0] == r[:, :, R_HOST_H0])
+                          & (q[:, :, L7_HOST_H1] == r[:, :, R_HOST_H1]))
+    hit = port_ok & kind_ok & meth_ok & path_ok & host_ok
+    return jnp.any(hit, axis=1)
+
+
+l7_verdict_jit = jax.jit(l7_verdict)

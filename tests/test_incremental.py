@@ -1,0 +1,246 @@
+"""Incremental tensor updates (SURVEY.md §7 hard part #3).
+
+Identity churn must patch device tensors in place: no re-resolve, no
+``compile_policy``, no re-attach.  The gate tests here are the round-3
+"done" criteria: attach-count stays flat under churn, the patched
+tensors match a from-scratch recompile bit for bit, and patched
+verdicts agree with the oracle.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.labels import LabelSet
+
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [
+        {"fromEndpoints": [{"matchLabels": {"role": "web"}}],
+         "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+        {"fromCIDR": ["192.168.0.0/16"],
+         "toPorts": [{"ports": [{"port": "8080", "protocol": "TCP"}]}]},
+    ],
+    "ingressDeny": [
+        {"fromEndpoints": [{"matchLabels": {"role": "banned"}}],
+         "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+    ],
+}]
+
+
+def _mk(backend="tpu"):
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+    d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+    d.policy_import(RULES)
+    d.start()
+    return d
+
+
+def _pkt(src, dst, dport, ep, flags=TCP_SYN, sport=40000):
+    return dict(src=src, dst=dst, sport=sport, dport=dport, proto=6,
+                flags=flags, ep=ep, dir=0)
+
+
+class TestIncrementalIdentityChurn:
+    def test_attach_count_flat_under_churn(self):
+        d = _mk()
+        db = d.endpoints.list()[0]
+        attaches_before = d.loader.attach_count
+        idents = []
+        for i in range(20):
+            ident = d.allocator.allocate(
+                LabelSet.parse(f"k8s:app=w{i}", "k8s:role=web"))
+            idents.append(ident)
+            d.upsert_ipcache(f"10.1.0.{i + 1}/32", ident.numeric_id)
+        # no re-attach happened — every event was an in-place patch
+        assert d.loader.attach_count == attaches_before
+        # and the datapath actually honors the patched rows
+        evb = d.process_batch(make_batch([
+            _pkt("10.1.0.1", "10.0.2.1", 5432, db.id),   # web: allow
+            _pkt("10.1.0.1", "10.0.2.1", 9999, db.id),   # other port: deny
+        ]).data, now=10)
+        assert list(evb.verdict) == [1, 0]
+
+    def test_patch_matches_full_recompile(self):
+        """Bit-exact gate: after N patched adds, the device verdict
+        tensor equals what a from-scratch compile produces."""
+        from cilium_tpu.policy.compiler import compile_policy
+
+        d = _mk()
+        for i in range(8):
+            ident = d.allocator.allocate(
+                LabelSet.parse(f"k8s:app=w{i}", "k8s:role=web"))
+            d.upsert_ipcache(f"10.1.0.{i + 1}/32", ident.numeric_id)
+        patched = np.asarray(d.loader.state.policy.verdict)
+        # recompile from the SAME resolved policies + row map
+        fresh = compile_policy(list(d.loader._policies),
+                               d.loader.row_map)
+        np.testing.assert_array_equal(patched, fresh.verdict)
+
+    def test_removal_resets_row(self):
+        d = _mk()
+        db = d.endpoints.list()[0]
+        ident = d.allocator.allocate(
+            LabelSet.parse("k8s:app=w0", "k8s:role=web"))
+        d.upsert_ipcache("10.1.0.1/32", ident.numeric_id)
+        evb = d.process_batch(make_batch([
+            _pkt("10.1.0.1", "10.0.2.1", 5432, db.id)]).data, now=10)
+        assert list(evb.verdict) == [1]
+        attaches = d.loader.attach_count
+        d.allocator.release(ident)
+        assert d.loader.attach_count == attaches  # patched, not rebuilt
+        # the released identity's row no longer allows 5432 (fresh flow)
+        evb = d.process_batch(make_batch([
+            _pkt("10.1.0.1", "10.0.2.1", 5432, db.id, sport=41000)
+        ]).data, now=20)
+        assert list(evb.verdict) == [0]
+
+    def test_deny_identity_patch(self):
+        d = _mk()
+        db = d.endpoints.list()[0]
+        ident = d.allocator.allocate(
+            LabelSet.parse("k8s:app=evil", "k8s:role=banned"))
+        d.upsert_ipcache("10.9.0.1/32", ident.numeric_id)
+        evb = d.process_batch(make_batch([
+            _pkt("10.9.0.1", "10.0.2.1", 5432, db.id)]).data, now=10)
+        assert list(evb.verdict) == [2]  # explicit deny
+
+    def test_tpu_matches_interpreter_after_churn(self):
+        """Divergence gate under churn: both backends, same patches,
+        same verdicts."""
+        results = {}
+        for backend in ("tpu", "interpreter"):
+            d = _mk(backend)
+            db = d.endpoints.list()[0]
+            for i in range(6):
+                ident = d.allocator.allocate(
+                    LabelSet.parse(f"k8s:app=w{i}", "k8s:role=web"))
+                d.upsert_ipcache(f"10.1.0.{i + 1}/32", ident.numeric_id)
+            bad = d.allocator.allocate(
+                LabelSet.parse("k8s:app=evil", "k8s:role=banned"))
+            d.upsert_ipcache("10.9.0.1/32", bad.numeric_id)
+            evb = d.process_batch(make_batch([
+                _pkt("10.1.0.3", "10.0.2.1", 5432, db.id),
+                _pkt("10.1.0.3", "10.0.2.1", 80, db.id),
+                _pkt("10.9.0.1", "10.0.2.1", 5432, db.id),
+                _pkt("192.168.7.7", "10.0.2.1", 8080, db.id),
+            ]).data, now=10)
+            results[backend] = list(evb.verdict)
+        assert results["tpu"] == results["interpreter"]
+
+    def test_patch_latency_much_cheaper_than_regen(self):
+        """The point of the patch path: identity events cost ~ms, not a
+        full compile.  Compare one patched add against one full
+        regeneration on the same daemon."""
+        d = _mk()
+        # a realistically sized identity space: full regeneration has
+        # to recompile every row; the patch touches one
+        for i in range(400):
+            ident = d.allocator.allocate(
+                LabelSet.parse(f"k8s:app=m{i}", "k8s:role=web"))
+            d.upsert_ipcache(f"10.2.{i // 250}.{i % 250 + 1}/32",
+                             ident.numeric_id)
+
+        ident = d.allocator.allocate(
+            LabelSet.parse("k8s:app=wx", "k8s:role=web"))
+        t0 = time.perf_counter()
+        d.upsert_ipcache("10.1.9.9/32", ident.numeric_id)
+        patch_dt = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        d.endpoints._regenerate_all()
+        regen_dt = time.perf_counter() - t0
+        # generous bound: patches must be at least 3x cheaper (in
+        # practice ~100x on the 10k-identity set); guards regressions
+        # that silently reroute churn through compile_policy
+        assert patch_dt < regen_dt / 3, (patch_dt, regen_dt)
+
+
+class TestLPMUpsert:
+    def _roundtrip(self, base, upserts):
+        """lpm_upsert over `base` must equal compile_lpm of the union."""
+        from cilium_tpu.datapath.lpm import compile_lpm, lpm_upsert
+        import jax.numpy as jnp
+        from cilium_tpu.datapath.lpm import DeviceLPM, lookup_v4
+
+        t = compile_lpm(dict(base))
+        merged = dict(base)
+        for cidr, val in upserts:
+            patches = lpm_upsert(t, cidr, val)
+            merged[cidr] = val
+            if patches is None:
+                t = compile_lpm(merged)
+        want = compile_lpm(merged)
+        # compare lookups over a probe set (tables may differ in block
+        # allocation order; semantics must match)
+        probes = []
+        import ipaddress
+
+        for cidr in merged:
+            net = ipaddress.ip_network(cidr)
+            lo = int(net.network_address)
+            probes += [lo, lo + net.num_addresses - 1,
+                       lo + net.num_addresses // 2]
+        probes += [0, 0xFFFFFFFF, 0x0A000001]
+        ips = jnp.asarray(np.array(probes, dtype=np.uint32))
+        got = np.asarray(lookup_v4(jnp.asarray(t.l1), jnp.asarray(t.l2),
+                                   jnp.asarray(t.l3), ips))
+        exp = np.asarray(lookup_v4(jnp.asarray(want.l1),
+                                   jnp.asarray(want.l2),
+                                   jnp.asarray(want.l3), ips))
+        np.testing.assert_array_equal(got, exp)
+
+    def test_host_route_into_value_region(self):
+        self._roundtrip({"10.0.0.0/8": 1}, [("10.1.2.3/32", 7)])
+
+    def test_host_route_into_existing_blocks(self):
+        self._roundtrip({"10.0.0.0/8": 1, "10.1.2.0/24": 3},
+                        [("10.1.2.3/32", 7), ("10.1.2.4/32", 8)])
+
+    def test_slash24_upsert(self):
+        self._roundtrip({"10.0.0.0/8": 1}, [("10.5.6.0/24", 9)])
+
+    def test_short_prefix_upsert(self):
+        self._roundtrip({}, [("172.16.0.0/12", 4)])
+
+    def test_short_prefix_over_children_falls_back(self):
+        from cilium_tpu.datapath.lpm import compile_lpm, lpm_upsert
+
+        t = compile_lpm({"10.1.2.0/24": 3})
+        # /8 would have to paint over the child pointer -> rebuild
+        assert lpm_upsert(t, "10.0.0.0/8", 5) is None
+
+    def test_short_prefix_never_clobbers_sibling_values(self):
+        """r03 review: a shorter prefix painted over a same-level
+        more-specific VALUE (not just pointers) broke LPM; now any
+        non-/32 takes the rebuild path."""
+        from cilium_tpu.datapath.lpm import compile_lpm, lpm_upsert
+
+        t = compile_lpm({"10.1.0.0/16": 7})
+        assert lpm_upsert(t, "10.0.0.0/8", 9) is None
+        # and the host mirror was not corrupted by the attempt
+        assert int(t.l1[0x0A01]) == 7
+        # full-roundtrip sanity via the rebuild path
+        self._roundtrip({"10.1.0.0/16": 7}, [("10.0.0.0/8", 9)])
+
+    def test_many_host_routes_until_padding_exhausts(self):
+        """Pods keep landing in fresh /16s; when the block padding runs
+        out lpm_upsert signals rebuild instead of corrupting."""
+        from cilium_tpu.datapath.lpm import compile_lpm, lpm_upsert
+
+        t = compile_lpm({"0.0.0.0/0": 1})
+        merged = {"0.0.0.0/0": 1}
+        rebuilt = 0
+        for i in range(40):
+            cidr = f"10.{i}.0.1/32"
+            patches = lpm_upsert(t, cidr, i + 2)
+            merged[cidr] = i + 2
+            if patches is None:
+                rebuilt += 1
+                t = compile_lpm(merged)
+        assert rebuilt >= 1  # padding (8 blocks) must have exhausted
+        self._roundtrip(merged, [])

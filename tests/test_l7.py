@@ -1,0 +1,219 @@
+"""L7 request enforcement (eval config #4; SURVEY.md §2a rows 5-6).
+
+Covers: L7Rules -> match tensors, batched request verdicts (device
+exact path + host regex fallback), HTTP allow/deny by method/path/
+host, DNS matchName/matchPattern, L7 default deny, the access-record
+stream, and the daemon e2e: packet redirect -> request verdicts.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.policy.api import L7Rules
+from cilium_tpu.proxy import (
+    L7Proxy,
+    compile_l7,
+    featurize_http,
+    l7_verdict,
+)
+from cilium_tpu.proxy.featurize import KIND_DNS, KIND_HTTP
+
+
+def _l7(http=None, dns=None) -> L7Rules:
+    return L7Rules.from_dict(
+        {k: v for k, v in (("http", http), ("dns", dns)) if v})
+
+
+class TestCompile:
+    def test_literal_rules_become_tensor_rows(self):
+        t = compile_l7([(10000, "r1", _l7(http=[
+            {"method": "GET", "path": "/healthz"},
+            {"method": "POST", "path": "/api/v1"},
+        ]))])
+        assert t.rules.shape[0] == 2
+        assert not t.host_matchers
+        assert t.ports == frozenset({10000})
+
+    def test_regex_rules_become_host_matchers(self):
+        t = compile_l7([(10000, "r1", _l7(http=[
+            {"method": "GET", "path": "/api/.*"},
+        ]))])
+        assert t.rules.shape[0] == 0
+        assert len(t.host_matchers[10000]) == 1
+
+    def test_unknown_method_is_not_widened_to_any(self):
+        """r03 review: PURGE (outside the dense method table) must not
+        compile to method-any; it takes the host path and still
+        constrains the method."""
+        t = compile_l7([(10000, "r1", _l7(http=[
+            {"method": "PURGE", "path": "/cache"}]))])
+        assert t.rules.shape[0] == 0
+        assert len(t.host_matchers[10000]) == 1
+        p = L7Proxy()
+        p.update([type("P", (), {"redirects": [
+            (10000, "r1", _l7(http=[{"method": "PURGE",
+                                     "path": "/cache"}]))]})()])
+        got = p.handle_http(10000, [
+            {"method": "PURGE", "path": "/cache"},
+            {"method": "GET", "path": "/cache"},
+        ])
+        assert list(got) == [1, 0]
+
+    def test_dns_name_vs_pattern_split(self):
+        t = compile_l7([(10053, "r1", _l7(dns=[
+            {"matchName": "example.com"},
+            {"matchPattern": "*.example.com"},
+        ]))])
+        assert t.rules.shape[0] == 1
+        assert len(t.host_matchers[10053]) == 1
+
+
+class TestHTTPVerdicts:
+    def _proxy(self, http):
+        p = L7Proxy()
+        p.update([type("P", (), {
+            "redirects": [(10000, "rule", _l7(http=http))]})()])
+        return p
+
+    def test_method_and_path_allow_deny(self):
+        p = self._proxy([{"method": "GET", "path": "/data"}])
+        got = p.handle_http(10000, [
+            {"method": "GET", "path": "/data"},     # allow
+            {"method": "POST", "path": "/data"},    # wrong method
+            {"method": "GET", "path": "/other"},    # wrong path
+            {"method": "GET", "path": "/data/x"},   # not the literal
+        ])
+        assert list(got) == [1, 0, 0, 0]
+
+    def test_method_only_rule_allows_any_path(self):
+        p = self._proxy([{"method": "GET"}])
+        got = p.handle_http(10000, [
+            {"method": "GET", "path": "/anything"},
+            {"method": "DELETE", "path": "/anything"},
+        ])
+        assert list(got) == [1, 0]
+
+    def test_host_constraint(self):
+        p = self._proxy([{"method": "GET", "host": "api.internal"}])
+        got = p.handle_http(10000, [
+            {"method": "GET", "path": "/x", "host": "api.internal"},
+            {"method": "GET", "path": "/x", "host": "evil.example"},
+        ])
+        assert list(got) == [1, 0]
+
+    def test_regex_path_fallback(self):
+        p = self._proxy([{"method": "GET", "path": "/api/v[0-9]+/.*"}])
+        got = p.handle_http(10000, [
+            {"method": "GET", "path": "/api/v1/users"},
+            {"method": "GET", "path": "/api/vX/users"},
+            {"method": "POST", "path": "/api/v1/users"},
+        ])
+        assert list(got) == [1, 0, 0]
+
+    def test_mixed_exact_and_regex(self):
+        p = self._proxy([{"method": "GET", "path": "/exact"},
+                         {"method": "PUT", "path": "/re/.*"}])
+        got = p.handle_http(10000, [
+            {"method": "GET", "path": "/exact"},
+            {"method": "PUT", "path": "/re/anything"},
+            {"method": "PUT", "path": "/exact"},
+        ])
+        assert list(got) == [1, 1, 0]
+
+    def test_unknown_port_passes_through(self):
+        p = self._proxy([{"method": "GET"}])
+        got = p.handle_http(31337, [{"method": "DELETE", "path": "/"}])
+        assert list(got) == [1]
+
+    def test_records_emitted(self):
+        p = self._proxy([{"method": "GET", "path": "/ok"}])
+        recs = []
+        p.on_record(recs.append)
+        p.handle_http(10000, [{"method": "GET", "path": "/ok"},
+                              {"method": "POST", "path": "/no"}])
+        assert len(recs) == 2
+        assert recs[0].status == 200 and recs[0].verdict == 1
+        assert recs[1].status == 403 and recs[1].verdict == 0
+        assert recs[1].method == "POST" and recs[1].path == "/no"
+        assert p.requests_total == 2 and p.requests_denied == 1
+
+
+class TestDNSVerdicts:
+    def _proxy(self, dns):
+        p = L7Proxy()
+        p.update([type("P", (), {
+            "redirects": [(10053, "rule", _l7(dns=dns))]})()])
+        return p
+
+    def test_match_name_exact(self):
+        p = self._proxy([{"matchName": "example.com"}])
+        got = p.handle_dns(10053, ["example.com", "example.com.",
+                                   "EXAMPLE.COM", "evil.com",
+                                   "sub.example.com"])
+        assert list(got) == [1, 1, 1, 0, 0]
+
+    def test_match_pattern_glob(self):
+        p = self._proxy([{"matchPattern": "*.example.com"}])
+        got = p.handle_dns(10053, ["api.example.com", "example.com",
+                                   "deep.sub.example.com", "evil.com"])
+        # fnmatch "*" spans dots, matching upstream's matchPattern
+        assert list(got) == [1, 0, 1, 0]
+
+    def test_observe_answer_notifies_fqdn_observers(self):
+        p = self._proxy([{"matchName": "example.com"}])
+        seen = []
+        p.observe_dns(lambda name, ips, ttl: seen.append((name,
+                                                          tuple(ips))))
+        p.observe_answer("Example.COM.", ["93.184.216.34"], ttl=300)
+        assert seen == [("example.com", ("93.184.216.34",))]
+
+
+RULES_L7 = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [
+        {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+         "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}],
+                      "rules": {"http": [{"method": "GET",
+                                          "path": "/public"}]}}]},
+    ],
+}]
+
+
+class TestDaemonE2E:
+    def test_redirect_then_request_verdicts(self):
+        """The full plane: L3/L4 verdict says REDIRECT with a proxy
+        port; requests on that port are L7-enforced."""
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+        web = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES_L7)
+        d.start()
+
+        evb = d.process_batch(make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=40000, dport=80,
+                 proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data, now=10)
+        assert list(evb.verdict) == [3]  # VERDICT_REDIRECT
+        proxy_port = int(evb.proxy_port[0])
+        assert proxy_port in d.proxy.ports
+
+        got = d.handle_l7_http(proxy_port, [
+            {"method": "GET", "path": "/public"},
+            {"method": "GET", "path": "/secret"},
+            {"method": "POST", "path": "/public"},
+        ], src_identity=web.identity.numeric_id)
+        assert list(got) == [1, 0, 0]
+
+    def test_parse_http_bytes_roundtrip(self):
+        from cilium_tpu.proxy.featurize import parse_http_bytes
+
+        reqs = parse_http_bytes([
+            b"GET /public HTTP/1.1\r\nHost: db.svc\r\n\r\n",
+            b"POST /x HTTP/1.1\r\n\r\nbody",
+            b"garbage",
+        ])
+        assert reqs[0] == {"method": "GET", "path": "/public",
+                           "host": "db.svc"}
+        assert reqs[1]["method"] == "POST" and reqs[1]["host"] == ""
+        assert reqs[2] == {}

@@ -1,0 +1,168 @@
+"""Packed ingest path: the 16 B/packet h2d wire format.
+
+The packed pipeline exists for end-to-end ingest bandwidth (SURVEY.md
+§7 hard part #4): the wide [N, 16] u32 tensor costs 64 B/packet over
+the host->device link; IPv4 traffic ships as [N, 4] packed rows and
+unpacks on device inside the fused step.  These tests pin:
+
+- native packed parse == Python fallback == pack_rows(wide parse)
+- device unpack is the exact inverse of host pack
+- datapath_step_packed produces identical verdicts + CT state to
+  datapath_step on the wide tensor
+- the event-ring cursor survives the 2^32 wrap (64-bit count as two
+  u32 words)
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cilium_tpu import native
+from cilium_tpu.core.ingest import frames_from_batch, parse_frames
+from cilium_tpu.core.packets import (
+    COL_DIR,
+    COL_EP,
+    COL_FAMILY,
+    N_COLS,
+    PACKED_COLS,
+    pack_rows,
+    synth_batch,
+    unpack_hdr,
+)
+
+
+def _v4_batch(n=512, seed=0):
+    batch = synth_batch(n, np.random.default_rng(seed)).data
+    return batch
+
+
+def test_native_packed_matches_python_fallback():
+    batch = _v4_batch()
+    buf = frames_from_batch(batch)
+    got = native.parse_frames_packed(buf)
+    assert got is not None, "native library must build in CI"
+    rows_n, n_n, sk_n = got
+    rows_p, n_p, sk_p = native.parse_frames_packed_py(buf)
+    assert n_n == n_p and sk_n == sk_p
+    np.testing.assert_array_equal(np.asarray(rows_n), np.asarray(rows_p))
+
+
+def test_packed_parse_equals_packed_wide_parse():
+    batch = _v4_batch(1024, seed=3)
+    buf = frames_from_batch(batch)
+    wide = parse_frames(buf)
+    rows, n, skipped = native.parse_frames_packed(buf)
+    assert n == len(wide) and skipped == 0
+    np.testing.assert_array_equal(np.asarray(rows), pack_rows(wide))
+
+
+def test_packed_skips_non_ipv4_and_counts():
+    import struct
+
+    batch = _v4_batch(8, seed=1)
+    buf = frames_from_batch(batch)
+    # splice in one IPv6 frame: eth (type 0x86DD) + minimal v6 header
+    v6 = b"\x00" * 12 + b"\x86\xdd" + bytes([0x60] + [0] * 39)
+    buf = buf + struct.pack("<I", len(v6)) + v6
+    rows, n, skipped = native.parse_frames_packed(buf)
+    assert n == 8
+    assert skipped == 1
+
+
+def test_undersized_out_buffer_raises():
+    """Silent truncation would be undetectable packet loss; both the
+    native and Python paths must raise instead (r03 review)."""
+    batch = _v4_batch(64)
+    buf = frames_from_batch(batch)
+    out = np.empty((10, PACKED_COLS), dtype=np.uint32)
+    with pytest.raises(ValueError, match="too small"):
+        native.parse_frames_packed(buf, out)
+    with pytest.raises(ValueError, match="too small"):
+        native.parse_frames_packed_py(buf, out)
+
+
+def test_reused_out_buffer_returns_view():
+    batch = _v4_batch(64)
+    buf = frames_from_batch(batch)
+    out = np.empty((256, PACKED_COLS), dtype=np.uint32)
+    rows, n, _ = native.parse_frames_packed(buf, out)
+    assert n == 64
+    assert rows.base is out  # view into the reused transfer buffer
+
+
+def test_unpack_is_inverse_of_pack():
+    batch = _v4_batch(256, seed=7)
+    batch[:, COL_EP] = 5
+    batch[:, COL_DIR] = 1
+    packed = pack_rows(batch)
+    wide = np.asarray(unpack_hdr(jnp.asarray(packed), 5, 1))
+    np.testing.assert_array_equal(wide, batch)
+
+
+def test_step_packed_matches_step_wide():
+    from cilium_tpu.datapath import datapath_step_jit
+    from cilium_tpu.datapath.verdict import datapath_step_packed_jit
+    from cilium_tpu.testing.fixtures import build_world
+
+    world = build_world(n_identities=64, n_rules=4, ct_capacity=1 << 12)
+    batch = _v4_batch(512, seed=11)
+    packed = pack_rows(batch)
+    now = jnp.uint32(100)
+
+    out_w, st_w = datapath_step_jit(world.state, jnp.asarray(batch), now)
+
+    world2 = build_world(n_identities=64, n_rules=4, ct_capacity=1 << 12)
+    out_p, st_p = datapath_step_packed_jit(
+        world2.state, jnp.asarray(packed), now, jnp.uint32(0),
+        jnp.uint32(0))
+
+    np.testing.assert_array_equal(np.asarray(out_w), np.asarray(out_p))
+    np.testing.assert_array_equal(np.asarray(st_w.ct.table),
+                                  np.asarray(st_p.ct.table))
+    np.testing.assert_array_equal(np.asarray(st_w.metrics),
+                                  np.asarray(st_p.metrics))
+
+
+def test_serve_step_packed_streams_events():
+    from cilium_tpu.monitor.ring import (EventRing, ring_drain,
+                                         serve_step_packed_jit)
+    from cilium_tpu.testing.fixtures import build_world
+
+    world = build_world(n_identities=64, n_rules=4, ct_capacity=1 << 12)
+    batch = _v4_batch(512, seed=13)
+    packed = jnp.asarray(pack_rows(batch))
+    ring = EventRing.create(1 << 10)
+    z = jnp.uint32(0)
+    state, ring = serve_step_packed_jit(world.state, ring, packed,
+                                        jnp.uint32(100), z, z, z)
+    rows, total, lost = ring_drain(ring)
+    assert total > 0 and lost == 0
+    assert len(rows) == total
+
+
+def test_ring_cursor_survives_u32_wrap():
+    """ADVICE r02 (medium): a u32 event count wraps after 2^32 events
+    and drain misreads a full ring as nearly empty.  The cursor is two
+    u32 words; force lo near the wrap and check the carry + drain
+    accounting."""
+    from cilium_tpu.datapath.verdict import N_OUT, OUT_EVENT, EV_DROP
+    from cilium_tpu.monitor.ring import (EventRing, ring_append_jit,
+                                         ring_drain)
+
+    cap = 256
+    ring = EventRing.create(cap)
+    # pretend 2^32 - 100 events have already flowed (ring full: the buf
+    # holds the last `cap` of them)
+    filled = jnp.zeros((cap, ring.buf.shape[1]), dtype=jnp.uint32)
+    ring = EventRing(buf=filled,
+                     cursor=jnp.asarray([2**32 - 100, 0], dtype=jnp.uint32))
+    out = jnp.full((512, N_OUT), EV_DROP, dtype=jnp.uint32)
+    out = out.at[:, OUT_EVENT].set(EV_DROP)  # every row kept
+    ring = ring_append_jit(ring, out, jnp.uint32(1), trace_sample=0)
+    rows, total, lost = ring_drain(ring)
+    assert total == 2**32 - 100 + 512  # > 2^32: carried into hi word
+    assert int(np.asarray(ring.cursor[1])) == 1
+    assert lost == total - cap
+    assert len(rows) <= cap

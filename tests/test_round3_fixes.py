@@ -1,0 +1,125 @@
+"""Round-3 robustness fixes (ADVICE r02).
+
+Covers: torn-checkpoint detection via the CT snapshot's policy-revision
+stamp, and the stale-.so rebuild path in the native loader.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [
+        {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+         "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+    ],
+}]
+
+
+def _mk_daemon(backend="tpu", **kw) -> Daemon:
+    return Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12, **kw))
+
+
+def _pkt(src, dst, dport, ep, dirn=0, flags=TCP_SYN, sport=40000):
+    return dict(src=src, dst=dst, sport=sport, dport=dport, proto=6,
+                flags=flags, ep=ep, dir=dirn)
+
+
+class TestTornCheckpoint:
+    def test_ct_snapshot_carries_revision(self, tmp_path):
+        d = _mk_daemon()
+        d.policy_import(RULES)
+        d.checkpoint(str(tmp_path))
+        snap = np.load(tmp_path / "ct.npz")
+        assert int(snap["revision"]) == d.repo.revision
+
+    def test_revision_mismatch_skips_ct_snapshot(self, tmp_path):
+        """A crash between the ct.npz and state.json renames pairs a
+        NEW CT snapshot with OLD control-plane state; the revision
+        stamp catches it and the snapshot is skipped (flows admitted
+        under policy absent from the restored ruleset must not be
+        resurrected)."""
+        d = _mk_daemon()
+        web = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES)
+        evb = d.process_batch(make_batch([
+            _pkt("10.0.1.1", "10.0.2.1", 5432, db.id)]).data, now=10)
+        assert list(evb.verdict) == [1]
+        d.checkpoint(str(tmp_path))
+
+        # simulate the torn pair: bump the snapshot's revision stamp
+        with np.load(tmp_path / "ct.npz") as snap:
+            table, rev = snap["table"].copy(), int(snap["revision"])
+        with open(tmp_path / "ct.npz", "wb") as f:
+            np.savez_compressed(f, table=table,
+                                revision=np.int64(rev + 1))
+
+        d2 = _mk_daemon()
+        assert d2.restore(str(tmp_path))  # control plane restores fine
+        assert len(d2.endpoints.list()) == 2
+        # but the CT snapshot was skipped: the reply-direction packet
+        # of the old flow is NEW (no established entry), not TRACE
+        from cilium_tpu.monitor.api import MSG_TRACE
+
+        evb2 = d2.process_batch(make_batch([
+            _pkt("10.0.2.1", "10.0.1.1", 40000, db.id, dirn=1,
+                 sport=5432, flags=0x10)]).data, now=20)
+        assert list(evb2.msg_type) != [MSG_TRACE]
+
+    def test_matching_revision_restores_ct(self, tmp_path):
+        d = _mk_daemon()
+        web = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES)
+        d.process_batch(make_batch([
+            _pkt("10.0.1.1", "10.0.2.1", 5432, db.id)]).data, now=10)
+        d.checkpoint(str(tmp_path))
+
+        d2 = _mk_daemon()
+        assert d2.restore(str(tmp_path))
+        from cilium_tpu.monitor.api import MSG_TRACE
+
+        evb = d2.process_batch(make_batch([
+            _pkt("10.0.2.1", "10.0.1.1", 40000, db.id, dirn=1,
+                 sport=5432, flags=0x10)]).data, now=20)
+        assert list(evb.msg_type) == [MSG_TRACE]
+
+
+class TestStaleNativeLib:
+    def test_stale_so_is_rebuilt(self):
+        """ADVICE r02: a committed/stale .so from another arch must not
+        permanently disable the native path — on CDLL failure the
+        loader deletes it and rebuilds from source once.
+
+        Runs in a subprocess: this process may already have the good
+        library mapped, and the stale file must be a FRESH inode
+        (unlink + write) so the parent's mapping stays intact."""
+        import os
+        import subprocess
+        import sys
+
+        import cilium_tpu.native as native
+
+        so = native._so_path()
+        native.available()  # ensure it exists, then replace with junk
+        os.unlink(so)
+        with open(so, "wb") as f:
+            f.write(b"\x7fELF garbage not a real shared object")
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "from cilium_tpu import native; import sys;"
+             "ok = native.available();"
+             "r = native.parse_frames_packed(b'') if ok else None;"
+             "sys.exit(0 if ok and r is not None else 1)"],
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(native.__file__)))),
+            capture_output=True, text=True, timeout=180,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 0, proc.stderr[-800:]
+        # the subprocess rebuilt a working library at the same path
+        assert os.path.getsize(so) > 1000
