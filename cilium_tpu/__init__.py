@@ -15,7 +15,6 @@ Layer map (mirrors SURVEY.md §1, re-drawn TPU-first):
                 saturate it (datapath/verdict.py); pallas is reserved
                 for the day a probe kernel beats the fused gather
 - ``policy``    rule schema, repository, selector cache, MapState compiler
-- ``policy``    rule schema, repository, selector cache, MapState compiler
 - ``identity``  label->numeric identity allocation, reserved identities
 - ``ipcache``   IP/CIDR -> identity store, compiled to DIR-24-8 tensors
 - ``flow``      hubble-equivalent: threefour parser, observer, metrics

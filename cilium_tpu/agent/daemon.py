@@ -54,13 +54,34 @@ class DaemonConfig:
     enable_hubble: bool = True
     anomaly_model_path: Optional[str] = None  # trained AnomalyModel .npz
     anomaly_threshold: float = 0.8
+    fqdn_gc_interval: float = 15.0  # pkg/fqdn TTL sweep cadence
+    # gRPC Observer address ("unix:///run/hubble.sock" or "host:port");
+    # None = in-process only (REST /flows still serves)
+    hubble_listen: Optional[str] = None
+    # AF_UNIX path of this agent's REST API socket; advertised in the
+    # node registry so peers' health meshes can probe it
+    api_socket_path: Optional[str] = None
+    health_probe_interval: float = 10.0
 
 
 class Daemon:
-    def __init__(self, config: Optional[DaemonConfig] = None):
+    def __init__(self, config: Optional[DaemonConfig] = None,
+                 kvstore: Optional[InMemoryKVStore] = None):
+        """``kvstore``: pass one shared store to multiple daemons and
+        they agree on identity numerics through the distributed
+        allocator protocol AND replicate each other's allocations by
+        watch (reference: pkg/kvstore + pkg/allocator + clustermesh).
+        Without it the daemon allocates locally."""
+        from ..kvstore import ClusterIdentitySync, KVStoreAllocatorBackend
+
         self.config = config or DaemonConfig()
-        self.kvstore = InMemoryKVStore()
-        self.allocator = CachingIdentityAllocator()
+        self.kvstore = kvstore if kvstore is not None else InMemoryKVStore()
+        backend = None
+        if kvstore is not None:
+            backend = KVStoreAllocatorBackend(
+                self.kvstore, node=self.config.node_name)
+        self.allocator = CachingIdentityAllocator(backend=backend)
+        self.identity_sync: Optional[ClusterIdentitySync] = None
         self.repo = PolicyRepository(self.allocator)
         self.ipcache = IPCache()
         if self.config.backend == "tpu":
@@ -74,6 +95,14 @@ class Daemon:
         self._boot_time = time.time()
         self._started = False
 
+        # L7 proxy plane: listeners follow the resolved redirects
+        # (reference: pkg/proxy redirect lifecycle + Envoy filter);
+        # created before hubble so the seven parser can subscribe
+        from ..proxy import L7Proxy
+
+        self.proxy = L7Proxy()
+        self.endpoints.on_attach(self.proxy.update)
+
         # hubble plane
         self.observer = Observer(
             capacity=self.config.flow_ring_capacity,
@@ -85,6 +114,16 @@ class Daemon:
         if self.config.enable_hubble:
             self.monitor.register("hubble", self.parser.consume)
             self.monitor.register("metrics", self.flow_metrics.consume)
+            # the seven parser: proxy access records -> L7 flows in
+            # the same ring (reference: pkg/hubble/parser/seven)
+            from ..flow.seven import SevenParser
+
+            self.seven = SevenParser(
+                self.observer,
+                numeric_of_row=lambda r: (
+                    self.loader.row_map.numeric(r)
+                    if self.loader.row_map else 0))
+            self.proxy.on_record(self.seven.consume)
         if self.config.export_path:
             self.exporter = FlowExporter(
                 self.config.export_path, self.config.node_name,
@@ -103,12 +142,26 @@ class Daemon:
                 threshold=self.config.anomaly_threshold)
             self.monitor.register("anomaly", self.anomaly.consume)
 
-        # L7 proxy plane: listeners follow the resolved redirects
-        # (reference: pkg/proxy redirect lifecycle + Envoy filter)
-        from ..proxy import L7Proxy
+        # service LB: VIP -> Maglev backend selection, applied before
+        # the policy pipeline (reference: pkg/service + bpf/lib/lb.h)
+        from ..service import ServiceManager
 
-        self.proxy = L7Proxy()
-        self.endpoints.on_attach(self.proxy.update)
+        self.services = ServiceManager()
+
+        # fqdn loop: DNS answers observed by the proxy become
+        # identities + ipcache entries (reference: pkg/fqdn)
+        from ..fqdn import NameManager
+
+        self.fqdn = NameManager(self.allocator, self.delete_ipcache)
+        self.proxy.observe_dns(self.fqdn.observe)
+
+        # ipcache catch-all: IPs no entry covers belong to WORLD
+        # (reference: ipcache misses resolve to the world identity, so
+        # toEntities:[world] policies see all external traffic)
+        world = self.allocator.allocate(LabelSet.parse("reserved:world"))
+        self.ipcache.upsert("0.0.0.0/0", world.numeric_id,
+                            source="reserved")
+        self.ipcache.upsert("::/0", world.numeric_id, source="reserved")
 
         # wiring: rule changes and identity churn both end in one
         # coalesced regeneration (SURVEY.md §3.3)
@@ -117,6 +170,24 @@ class Daemon:
 
         # initial empty attach so the datapath is live pre-endpoints
         self.endpoints.regenerate()
+
+        # join the cluster identity plane LAST (the watch replays every
+        # existing remote identity through the observer->patch chain,
+        # which needs the wiring above in place)
+        self.health = None
+        if kvstore is not None:
+            self.identity_sync = ClusterIdentitySync(self.kvstore,
+                                                     self.allocator)
+            # node registry + probe mesh (reference: cilium-health)
+            from ..health import HealthMesh, NodeRegistry
+
+            self.node_registry = NodeRegistry(self.kvstore)
+            info = {}
+            if self.config.api_socket_path:
+                info["api_socket"] = self.config.api_socket_path
+            self.node_registry.register(self.config.node_name, info)
+            self.health = HealthMesh(self.node_registry,
+                                     self.config.node_name)
 
     # -- getters for flow enrichment ---------------------------------
     def _identity_labels(self, numeric: int) -> Tuple[str, ...]:
@@ -153,14 +224,38 @@ class Daemon:
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> None:
-        """Start background controllers (CT GC)."""
+        """Start background controllers (CT GC, fqdn TTL GC)."""
         self._started = True
         self.controllers.update(
             "ct-gc", lambda: self.loader.gc(self._now()),
             self.config.ct_gc_interval)
+        self.controllers.update(
+            "fqdn-gc", self.fqdn.gc, self.config.fqdn_gc_interval)
+        if self.config.hubble_listen:
+            from ..flow.grpc_server import serve as hubble_serve
+
+            self.hubble_server = hubble_serve(self.observer,
+                                              self.config.hubble_listen)
+        if self.health is not None:
+            def _health_sweep():
+                self.node_registry.heartbeat(self.config.node_name)
+                self.health.probe_all()
+
+            self.controllers.update(
+                "health-probe", _health_sweep,
+                self.config.health_probe_interval)
+        # endpoints whose identity allocation failed (kvstore outage)
+        # retry here until they leave waiting-for-identity
+        self.controllers.update(
+            "identity-retry", self.endpoints.retry_pending_identities,
+            5.0)
+
+    hubble_server = None
 
     def shutdown(self) -> None:
         self.controllers.stop_all()
+        if self.hubble_server is not None:
+            self.hubble_server.stop(grace=0.5)
         if self.exporter:
             self.exporter.close()
         if self.config.state_dir:
@@ -172,9 +267,27 @@ class Daemon:
     # -- the serve loop ----------------------------------------------
     def process_batch(self, hdr: np.ndarray,
                       now: Optional[int] = None) -> EventBatch:
-        """One packet tensor through the datapath + monitor fan-out."""
+        """One packet tensor through LB -> datapath -> monitor."""
         if now is None:
             now = self._now()
+        if len(self.services):
+            from ..service import lb_stage_jit
+
+            import jax.numpy as jnp
+
+            # hdr stays ON DEVICE between the LB stage and the
+            # datapath step (loader.step accepts device arrays); the
+            # one host fetch below feeds event decode, which needed
+            # the (possibly DNAT-rewritten) rows anyway
+            hdr_dev, _hits = lb_stage_jit(self.services.tensors(),
+                                          jnp.asarray(
+                                              np.ascontiguousarray(hdr)))
+            out, row_map = self.loader.step(hdr_dev, now)
+            hdr = np.asarray(hdr_dev)
+            batch = decode_out(out, hdr, row_map.numeric_array(),
+                               timestamp=time.time())
+            self.monitor.publish(batch)
+            return batch
         out, row_map = self.loader.step(hdr, now)
         batch = decode_out(out, hdr, row_map.numeric_array(),
                            timestamp=time.time())
@@ -194,8 +307,11 @@ class Daemon:
 
     # -- endpoint API --------------------------------------------------
     def add_endpoint(self, name: str, ips: Tuple[str, ...],
-                     labels: List[str]) -> Endpoint:
-        return self.endpoints.add(name, ips, LabelSet.parse(*labels))
+                     labels: List[str],
+                     named_ports: Optional[Dict[str, int]] = None
+                     ) -> Endpoint:
+        return self.endpoints.add(name, ips, LabelSet.parse(*labels),
+                                  named_ports=named_ports)
 
     # -- L7 proxy API (the listener-facing entry) ----------------------
     def handle_l7_http(self, proxy_port: int, requests,
@@ -222,6 +338,66 @@ class Daemon:
             return
         self.endpoints.regenerate()
 
+    def delete_ipcache(self, cidr: str) -> None:
+        self.ipcache.delete(cidr)
+        if self.loader.delete_ipcache(cidr):
+            return
+        self.endpoints.regenerate()
+
+    # -- runtime config mutation (PATCH /config) -----------------------
+    # the mutable subset of DaemonConfig; everything else (backend,
+    # capacities) is construction-time (reference: option.DaemonConfig
+    # runtime-mutable options like MonitorAggregation/PolicyEnforcement)
+    _MUTABLE_CONFIG = {
+        "ct-gc-interval": ("ct_gc_interval", float),
+        "fqdn-gc-interval": ("fqdn_gc_interval", float),
+        "health-probe-interval": ("health_probe_interval", float),
+        "anomaly-threshold": ("anomaly_threshold", float),
+    }
+
+    def patch_config(self, body: Dict[str, object]) -> Dict[str, object]:
+        """Apply runtime-mutable option changes; returns what changed.
+        Unknown or immutable keys raise (reference: PATCH /config
+        rejects non-mutable options)."""
+        # validate + cast EVERYTHING first: a bad key must not leave
+        # earlier keys half-applied behind a 400
+        staged: Dict[str, tuple] = {}
+        for key, raw in body.items():
+            spec = self._MUTABLE_CONFIG.get(key)
+            if spec is None:
+                raise ValueError(f"option {key!r} is not runtime-"
+                                 "mutable (or unknown)")
+            attr, cast = spec
+            staged[key] = (attr, cast(raw))
+        changed: Dict[str, object] = {}
+        for key, (attr, value) in staged.items():
+            setattr(self.config, attr, value)
+            changed[key] = value
+        if not changed:
+            return changed
+        # re-arm controllers whose cadence changed
+        if self._started:
+            if "ct-gc-interval" in changed:
+                self.controllers.update(
+                    "ct-gc", lambda: self.loader.gc(self._now()),
+                    self.config.ct_gc_interval)
+            if "fqdn-gc-interval" in changed:
+                self.controllers.update(
+                    "fqdn-gc", self.fqdn.gc,
+                    self.config.fqdn_gc_interval)
+            if ("health-probe-interval" in changed
+                    and self.health is not None):
+                def _health_sweep():
+                    self.node_registry.heartbeat(self.config.node_name)
+                    self.health.probe_all()
+
+                self.controllers.update(
+                    "health-probe", _health_sweep,
+                    self.config.health_probe_interval)
+        if "anomaly-threshold" in changed and self.anomaly is not None:
+            self.anomaly.threshold = self.config.anomaly_threshold
+        return changed
+
     # -- status --------------------------------------------------------
     def status(self) -> dict:
         m = self.loader.metrics()
@@ -237,6 +413,8 @@ class Daemon:
             },
             "identities": len(self.allocator.all_identities()),
             "ipcache-entries": len(self.ipcache.entries()),
+            "fqdn-entries": len(self.fqdn.entries()),
+            "l7-requests": self.proxy.requests_total,
             "regenerations": self.endpoints.regenerations,
             "forwarded": int(m[0].sum()),
             "dropped": int(m[1:].sum()),
@@ -247,6 +425,8 @@ class Daemon:
                     "last-error": s.last_error.splitlines()[-1]
                     if s.last_error else ""}
                 for n, s in self.controllers.statuses().items()},
+            **({"cluster-health": self.health.to_dict()}
+               if self.health is not None else {}),
         }
 
     def _eps_by_state(self) -> Dict[str, int]:
@@ -315,9 +495,14 @@ class Daemon:
         if meta["rules"]:
             self.repo.add_obj(meta["rules"])
         for rec in meta["endpoints"]:
+            # RESTORING until the batched regeneration below realizes
+            # their policy (reference: the endpoint restore state)
             self.endpoints.add(rec["name"], tuple(rec["ips"]),
                                LabelSet.parse(*rec["labels"]),
-                               ep_id=rec["id"])
+                               ep_id=rec["id"],
+                               named_ports=rec.get("named-ports"),
+                               restoring=True, defer_regen=True)
+        self.endpoints.regenerate()
         ct_path = os.path.join(state_dir, "ct.npz")
         if os.path.exists(ct_path):
             try:

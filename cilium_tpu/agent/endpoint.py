@@ -39,6 +39,9 @@ class Endpoint:
     policy_revision: int = 0  # realized revision
     created_at: float = field(default_factory=time.time)
     policy_row: int = 0  # row into the loader's policy list
+    # container port names (reference: pod spec containerPort names;
+    # named ports in policy resolve against these)
+    named_ports: Dict[str, int] = field(default_factory=dict)
 
     def to_dict(self) -> dict:
         """API rendering (GET /endpoint/{id})."""
@@ -51,4 +54,6 @@ class Endpoint:
                          else None),
             "state": self.state.value,
             "policy-revision": self.policy_revision,
+            **({"named-ports": dict(self.named_ports)}
+               if self.named_ports else {}),
         }

@@ -37,6 +37,7 @@ class EndpointManager:
         self.loader = loader
         self.row_capacity = row_capacity
         self.regenerations = 0
+        repo.named_ports_getter = self.named_ports
         # persistent identity->row map: rows are stable across identity
         # churn so incremental tensor patches address the same row the
         # attached tensors were compiled with (rows are never reused;
@@ -47,6 +48,18 @@ class EndpointManager:
         self._regen_trigger = Trigger(self._regenerate_all,
                                       name="endpoint-regeneration")
 
+    def named_ports(self) -> Dict[str, int]:
+        """The node's port-name registry (union over endpoints;
+        last-registered endpoint wins on conflicts — reference:
+        per-endpoint resolution; documented divergence: one registry
+        per node)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for ep in sorted(self._endpoints.values(),
+                             key=lambda e: e.created_at):
+                out.update(ep.named_ports)
+        return out
+
     def on_attach(self, fn) -> None:
         """Register fn(policies), called after every successful attach
         (the L7 proxy re-syncs its listeners here, the way pkg/proxy
@@ -55,9 +68,17 @@ class EndpointManager:
 
     # -- registry ----------------------------------------------------
     def add(self, name: str, ips: Tuple[str, ...], labels: LabelSet,
-            ep_id: Optional[int] = None) -> Endpoint:
+            ep_id: Optional[int] = None,
+            named_ports: Optional[Dict[str, int]] = None,
+            restoring: bool = False,
+            defer_regen: bool = False) -> Endpoint:
         """``ep_id`` pins a checkpointed id on restore so COL_EP
-        tagging, policy rows, and the CT snapshot stay coherent."""
+        tagging, policy rows, and the CT snapshot stay coherent.
+        ``named_ports`` (name -> number) feeds the policy resolver's
+        named-port registry.  ``restoring`` marks checkpoint-restore
+        endpoints (state RESTORING until their first regeneration);
+        ``defer_regen`` lets the restore loop batch one regeneration
+        for all endpoints instead of one each."""
         from ..datapath.verdict import MAX_ENDPOINTS
 
         with self._lock:
@@ -72,16 +93,54 @@ class EndpointManager:
                     f"fixed at {MAX_ENDPOINTS} rows")
             self._next_id = max(self._next_id, ep_id + 1)
             ep = Endpoint(id=ep_id, name=name, ips=tuple(ips),
-                          labels=labels)
+                          labels=labels,
+                          named_ports=dict(named_ports or {}))
+            if restoring:
+                ep.state = EndpointState.RESTORING
             self._endpoints[ep_id] = ep
-        ident = self.repo.allocator.allocate(labels)
+        try:
+            ident = self.repo.allocator.allocate(labels)
+        except Exception:
+            # kvstore outage / id-space pressure: the endpoint exists
+            # but cannot enforce yet — it waits (reference: the
+            # waiting-for-identity endpoint state) and the retry
+            # controller re-attempts until allocation succeeds
+            ep.state = EndpointState.WAITING_FOR_IDENTITY
+            return ep
+        self._bind_identity(ep, ident)
+        if not defer_regen:
+            self.regenerate()
+        return ep
+
+    def _bind_identity(self, ep: Endpoint, ident) -> None:
         ep.identity = ident
-        for ip in ips:
+        for ip in ep.ips:
             suffix = "/128" if ":" in ip else "/32"
             self.ipcache.upsert(ip + suffix, ident.numeric_id,
                                 source="endpoint")
-        self.regenerate()
-        return ep
+        if ep.named_ports:
+            # named-port bindings change what rules resolve to; cached
+            # resolutions at the current revision are stale
+            self.repo.invalidate()
+
+    def retry_pending_identities(self) -> int:
+        """Re-attempt allocation for waiting-for-identity endpoints;
+        returns how many advanced (controller-driven)."""
+        with self._lock:
+            pending = [ep for ep in self._endpoints.values()
+                       if ep.identity is None
+                       and ep.state == EndpointState.WAITING_FOR_IDENTITY]
+        advanced = 0
+        for ep in pending:
+            try:
+                ident = self.repo.allocator.allocate(ep.labels)
+            except Exception:
+                continue
+            self._bind_identity(ep, ident)
+            advanced += 1
+        if advanced:
+            self.regenerate()
+        return advanced
 
     def remove(self, ep_id: int) -> bool:
         with self._lock:
@@ -94,6 +153,8 @@ class EndpointManager:
             self.ipcache.delete(ip + suffix)
         if ep.identity is not None:
             self.repo.allocator.release(ep.identity)
+        if ep.named_ports:
+            self.repo.invalidate()
         self.regenerate()
         return True
 
@@ -119,7 +180,11 @@ class EndpointManager:
 
     def _regenerate_all(self) -> None:
         with self._lock:
-            eps = list(self._endpoints.values())
+            # endpoints without an identity cannot enforce yet: they
+            # keep waiting (their state machine advances when the
+            # retry controller lands an allocation)
+            eps = [ep for ep in self._endpoints.values()
+                   if ep.identity is not None]
         for ep in eps:
             ep.state = EndpointState.REGENERATING
         revision = self.repo.revision

@@ -108,3 +108,25 @@ class APIClient:
 
     def debuginfo(self):
         return self._request("GET", "/debuginfo")
+
+    def config_patch(self, options: dict):
+        return self._request("PATCH", "/config", options)
+
+    def service_list(self):
+        return self._request("GET", "/service")
+
+    def service_upsert(self, name: str, frontend: str, backends,
+                       protocol: int = 6):
+        return self._request("PUT", f"/service/{name}",
+                             {"frontend": frontend,
+                              "backends": list(backends),
+                              "protocol": protocol})
+
+    def service_delete(self, name: str):
+        return self._request("DELETE", f"/service/{name}")
+
+    def fqdn_cache(self):
+        return self._request("GET", "/fqdn/cache")
+
+    def cluster_health(self):
+        return self._request("GET", "/cluster/health")

@@ -141,6 +141,17 @@ def _make_handler(daemon: Daemon):
                     self._send_text(200, _metrics_text(daemon))
                 elif path == "/flows":
                     self._send(200, _flows(daemon, q))
+                elif path == "/service":
+                    self._send(200, [s.to_dict()
+                                     for s in daemon.services.list()])
+                elif path == "/fqdn/cache":
+                    self._send(200, daemon.fqdn.entries())
+                elif path == "/cluster/health":
+                    if daemon.health is None:
+                        self._send(404, {"error": "no cluster (run "
+                                         "with a shared kvstore)"})
+                    else:
+                        self._send(200, daemon.health.to_dict())
                 elif path == "/anomaly":
                     if daemon.anomaly is None:
                         self._send(404, {"error": "anomaly scoring "
@@ -173,10 +184,40 @@ def _make_handler(daemon: Daemon):
                     ep = daemon.add_endpoint(
                         body.get("name", m.group(1)),
                         tuple(body.get("ips", ())),
-                        body.get("labels", []))
+                        body.get("labels", []),
+                        named_ports=body.get("named-ports"))
                     self._send(201, ep.to_dict())
+                elif m := re.fullmatch(r"/service/([\w.-]+)", path):
+                    body = self._body() or {}
+                    frontend = body.get("frontend")
+                    if not isinstance(frontend, str) or ":" not in \
+                            frontend:
+                        self._send(400, {"error": "frontend must be "
+                                         "an 'ip:port' string"})
+                        return
+                    svc = daemon.services.upsert(
+                        m.group(1), frontend,
+                        body.get("backends", ()),
+                        protocol=int(body.get("protocol", 6)))
+                    self._send(201, svc.to_dict())
                 else:
                     self._send(404, {"error": f"no such path {path}"})
+            except Exception as e:
+                self._send(500, {"error": str(e)})
+
+        def do_PATCH(self) -> None:  # noqa: N802
+            path = self.path.rstrip("/")
+            try:
+                if path == "/config":
+                    # runtime-mutable options (reference: REST PATCH
+                    # /config mutates a subset of DaemonConfig)
+                    body = self._body() or {}
+                    changed = daemon.patch_config(body)
+                    self._send(200, {"changed": changed})
+                else:
+                    self._send(404, {"error": f"no such path {path}"})
+            except ValueError as e:
+                self._send(400, {"error": str(e)})
             except Exception as e:
                 self._send(500, {"error": str(e)})
 
@@ -189,6 +230,9 @@ def _make_handler(daemon: Daemon):
                     self._send(200, {"revision": rev})
                 elif m := re.fullmatch(r"/endpoint/(\d+)", path):
                     ok = daemon.endpoints.remove(int(m.group(1)))
+                    self._send(200 if ok else 404, {"removed": ok})
+                elif m := re.fullmatch(r"/service/([\w.-]+)", path):
+                    ok = daemon.services.delete(m.group(1))
                     self._send(200 if ok else 404, {"removed": ok})
                 else:
                     self._send(404, {"error": f"no such path {path}"})

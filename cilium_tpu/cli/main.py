@@ -85,6 +85,64 @@ def cmd_endpoint(args) -> int:
     return 0
 
 
+def cmd_service(args) -> int:
+    c = _client(args)
+    if args.action == "list":
+        svcs = c.service_list()
+        if args.json:
+            _print(svcs)
+            return 0
+        print(f"{'NAME':<20}{'FRONTEND':<24}BACKENDS")
+        for s in svcs:
+            bes = ",".join(f"{b['ip']}:{b['port']}"
+                           for b in s["backends"])
+            print(f"{s['name']:<20}{s['frontend']:<24}{bes}")
+    elif args.action == "upsert":
+        if not args.name or not args.frontend:
+            print("usage: cilium-tpu service upsert NAME --frontend "
+                  "IP:PORT [--backend IP:PORT ...]", file=sys.stderr)
+            return 1
+        _print(c.service_upsert(args.name, args.frontend,
+                                args.backend or []))
+    elif args.action == "delete":
+        _print(c.service_delete(args.name))
+    return 0
+
+
+def cmd_fqdn(args) -> int:
+    entries = _client(args).fqdn_cache()
+    if args.json:
+        _print(entries)
+        return 0
+    print(f"{'IP':<40}{'IDENTITY':<12}NAMES")
+    for e in entries:
+        print(f"{e['ip']:<40}{e['identity']:<12}{','.join(e['names'])}")
+    return 0
+
+
+def cmd_health(args) -> int:
+    h = _client(args).cluster_health()
+    if args.json:
+        _print(h)
+        return 0
+    print(f"Cluster health (from {h['local']}): "
+          f"{h['reachable']} reachable, {h['unreachable']} unreachable")
+    for n in h["nodes"]:
+        state = (f"reachable {n['latency-ms']}ms" if n["reachable"]
+                 else f"UNREACHABLE ({n.get('error', '')})")
+        print(f"  {n['name']:<20}{state}")
+    return 0
+
+
+def cmd_config(args) -> int:
+    c = _client(args)
+    if args.action == "get":
+        _print(c.config())
+    else:  # set KEY VALUE
+        _print(c.config_patch({args.key: args.value}))
+    return 0
+
+
 def cmd_identity(args) -> int:
     ids = _client(args).identity_list()
     if args.json:
@@ -294,6 +352,24 @@ def main(argv=None) -> int:
 
     sub.add_parser("identity", help="identity list")
 
+    p = sub.add_parser("service", help="service list|upsert|delete")
+    p.add_argument("action", choices=["list", "upsert", "delete"])
+    p.add_argument("name", nargs="?", default="")
+    p.add_argument("--frontend", help="VIP ip:port")
+    p.add_argument("--backend", action="append", help="backend ip:port")
+
+    p = sub.add_parser("fqdn", help="fqdn cache list")
+    p.add_argument("action", nargs="?", default="cache",
+                   choices=["cache"])
+
+    sub.add_parser("health", help="cluster health (probe mesh)")
+
+    p = sub.add_parser("config", help="config get | set KEY VALUE")
+    p.add_argument("action", nargs="?", default="get",
+                   choices=["get", "set"])
+    p.add_argument("key", nargs="?")
+    p.add_argument("value", nargs="?")
+
     p = sub.add_parser("bpf", help="bpf ct list | bpf policy get ID | "
                                    "bpf ipcache list")
     p.add_argument("obj", choices=["ct", "policy", "ipcache"])
@@ -348,6 +424,8 @@ def main(argv=None) -> int:
             "bpf": cmd_bpf, "map": cmd_map, "metrics": cmd_metrics,
             "flows": cmd_flows, "monitor": cmd_monitor,
             "anomaly": cmd_anomaly, "daemon": cmd_daemon,
+            "service": cmd_service, "fqdn": cmd_fqdn,
+            "health": cmd_health, "config": cmd_config,
         }.get(args.cmd)
         if handler is None:
             parser.print_help()

@@ -75,6 +75,23 @@ TCP_RST = 0x04
 TCP_PSH = 0x08
 TCP_ACK = 0x10
 
+# COL_FLAGS bit 8 (above the TCP flags byte): this row is an ICMP
+# ERROR whose columns carry the EMBEDDED (original) packet's 5-tuple —
+# the conntrack lookup relates it to the original flow (CT_RELATED,
+# reference: bpf/lib/conntrack.h ICMP error handling).  Wide-format
+# only: the packed 16B wire format has just the 8 TCP-flag bits, so
+# the packed fast path leaves ICMP errors un-related (outer tuple,
+# policy-evaluated) — a documented divergence; ingest adapters that
+# need RELATED on the fast path shunt proto-1/58 frames to the wide
+# parser.
+FLAG_RELATED = 0x100
+
+# VXLAN / Geneve UDP ports (reference: bpf_overlay.c decap; Linux
+# defaults).  Overlay frames decap at ingest: the row carries the
+# INNER packet's tuple.
+VXLAN_PORT = 8472
+GENEVE_PORT = 6081
+
 # Protocols whose CT tuple carries no ports (ICMP/ICMPv6: echo req and
 # reply must share a tuple modulo direction swap).  Flow steering and
 # CT key construction MUST use the same normalization — both call

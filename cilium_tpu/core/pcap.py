@@ -53,11 +53,10 @@ def _parse_l4(proto: int, payload: bytes) -> Tuple[int, int, int]:
     return 0, 0, 0
 
 
-def _parse_ip(pkt: bytes
-              ) -> Optional[Tuple[int, bytes, bytes, int, bytes, int]]:
-    """Parse an IP packet -> (family, src16, dst16, proto, l4payload,
-    ip_total_len).  ``ip_total_len`` is the header-declared IP length
-    (the COL_LEN schema value), not the captured frame length."""
+def _parse_ip_one(pkt: bytes
+                  ) -> Optional[Tuple[int, bytes, bytes, int, bytes, int]]:
+    """Parse ONE IP header (no decap) -> (family, src16, dst16, proto,
+    l4payload, ip_total_len)."""
     if len(pkt) < 20:
         return None
     ver = pkt[0] >> 4
@@ -75,6 +74,114 @@ def _parse_ip(pkt: bytes
         payload_len = struct.unpack_from("!H", pkt, 4)[0]
         return 6, pkt[8:24], pkt[24:40], proto, pkt[40:], 40 + payload_len
     return None
+
+
+def _decap_overlay(proto: int, l4: bytes) -> Optional[bytes]:
+    """UDP VXLAN/Geneve payload -> inner IP packet bytes, or None.
+
+    Reference: ``bpf_overlay.c`` decap — the datapath verdicts the
+    INNER packet; the outer header is transport."""
+    from .packets import GENEVE_PORT, VXLAN_PORT
+
+    if proto != 17 or len(l4) < 8:
+        return None
+    dport = struct.unpack_from("!H", l4, 2)[0]
+    payload = l4[8:]
+    if dport == VXLAN_PORT:
+        if len(payload) < 8 + 14:
+            return None
+        inner_eth = payload[8:]  # 8B VXLAN header (flags + VNI)
+    elif dport == GENEVE_PORT:
+        if len(payload) < 8:
+            return None
+        optlen = (payload[0] & 0x3F) * 4
+        if len(payload) < 8 + optlen + 14:
+            return None
+        inner_eth = payload[8 + optlen:]
+    else:
+        return None
+    ethertype = struct.unpack_from("!H", inner_eth, 12)[0]
+    if ethertype not in (ETH_P_IP, ETH_P_IPV6):
+        return None
+    return inner_eth[14:]
+
+
+# ICMP error types whose payload embeds the original packet's header
+# (reference: icmp_is_error / bpf conntrack related handling)
+_ICMP4_ERRORS = (3, 4, 5, 11, 12)
+_ICMP6_ERRORS = (1, 2, 3, 4)
+
+
+def _related_tuple(fam: int, proto: int, l4: bytes):
+    """For ICMP errors: -> (src16, dst16, inner_proto, sport, dport)
+    of the EMBEDDED original packet, or None."""
+    if len(l4) < 8 + 20:
+        return None
+    t = l4[0]
+    if not ((proto == 1 and t in _ICMP4_ERRORS)
+            or (proto == 58 and t in _ICMP6_ERRORS)):
+        return None
+    inner = _parse_ip_one(l4[8:])
+    if inner is None:
+        return None
+    ifam, isrc, idst, iproto, il4, _ = inner
+    if ifam != fam:
+        return None
+    isport = idport = 0
+    if iproto in (6, 17, 132) and len(il4) >= 4:
+        isport, idport = struct.unpack_from("!HH", il4, 0)
+    elif iproto in (1, 58) and len(il4) >= 2:
+        idport = il4[0]
+    return isrc, idst, iproto, isport, idport
+
+
+def _parse_ip(pkt: bytes
+              ) -> Optional[Tuple[int, bytes, bytes, int, bytes, int]]:
+    """Parse an IP packet, decapsulating VXLAN/Geneve overlays ->
+    (family, src16, dst16, proto, l4payload, ip_total_len).
+    ``ip_total_len`` is the header-declared IP length (COL_LEN)."""
+    parsed = _parse_ip_one(pkt)
+    if parsed is None:
+        return None
+    for _ in range(2):  # bounded decap depth
+        fam, src, dst, proto, l4, total = parsed
+        inner = _decap_overlay(proto, l4)
+        if inner is None:
+            return parsed
+        deeper = _parse_ip_one(inner)
+        if deeper is None:
+            return parsed
+        parsed = deeper
+    return parsed
+
+
+def build_row(parsed, ep: int, direction: int) -> np.ndarray:
+    """(family, src16, dst16, proto, l4, total) -> one header row,
+    including the CT_RELATED transform: an ICMP error row carries the
+    EMBEDDED packet's tuple + FLAG_RELATED (reference: conntrack
+    relates ICMP errors to the original flow)."""
+    from .packets import FLAG_RELATED
+
+    fam, src, dst, proto, l4, ip_len = parsed
+    sport, dport, flags = _parse_l4(proto, l4)
+    rel = _related_tuple(fam, proto, l4)
+    if rel is not None:
+        src, dst, proto, sport, dport = rel
+        flags = FLAG_RELATED
+    row = np.zeros(N_COLS, dtype=np.uint32)
+    row[COL_SRC_IP0:COL_SRC_IP0 + 4] = np.frombuffer(
+        src, dtype=">u4").astype(np.uint32)
+    row[COL_DST_IP0:COL_DST_IP0 + 4] = np.frombuffer(
+        dst, dtype=">u4").astype(np.uint32)
+    row[COL_SPORT] = sport
+    row[COL_DPORT] = dport
+    row[COL_PROTO] = proto
+    row[COL_FLAGS] = flags
+    row[COL_LEN] = ip_len
+    row[COL_FAMILY] = fam
+    row[COL_EP] = ep
+    row[COL_DIR] = direction
+    return row
 
 
 def read_pcap(path: str, ep: int = 0, direction: int = 0) -> HeaderBatch:
@@ -131,22 +238,7 @@ def read_pcap(path: str, ep: int = 0, direction: int = 0) -> HeaderBatch:
         parsed = _parse_ip(ip)
         if parsed is None:
             continue
-        fam, src, dst, proto, l4, ip_len = parsed
-        sport, dport, flags = _parse_l4(proto, l4)
-        row = np.zeros(N_COLS, dtype=np.uint32)
-        row[COL_SRC_IP0:COL_SRC_IP0 + 4] = np.frombuffer(
-            src, dtype=">u4").astype(np.uint32)
-        row[COL_DST_IP0:COL_DST_IP0 + 4] = np.frombuffer(
-            dst, dtype=">u4").astype(np.uint32)
-        row[COL_SPORT] = sport
-        row[COL_DPORT] = dport
-        row[COL_PROTO] = proto
-        row[COL_FLAGS] = flags
-        row[COL_LEN] = ip_len
-        row[COL_FAMILY] = fam
-        row[COL_EP] = ep
-        row[COL_DIR] = direction
-        rows.append(row)
+        rows.append(build_row(parsed, ep, direction))
     if not rows:
         return HeaderBatch(np.zeros((0, N_COLS), dtype=np.uint32))
     return HeaderBatch(np.stack(rows))

@@ -129,9 +129,7 @@ def ct_keys_from_headers(hdr: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     ``ipv4_ct_tuple_reverse``).  ICMP zeroes the port word so echo
     request/reply share a tuple modulo the swap.
     """
-    from ..core.packets import COL_DIR
-
-    from ..core.packets import normalize_ports
+    from ..core.packets import COL_DIR, FLAG_RELATED, normalize_ports
 
     src = hdr[:, COL_SRC_IP0:COL_SRC_IP0 + 4].astype(jnp.uint32)
     dst = hdr[:, COL_DST_IP0:COL_DST_IP0 + 4].astype(jnp.uint32)
@@ -149,6 +147,15 @@ def ct_keys_from_headers(hdr: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
         [src, dst, fwd_ports[:, None], fwd_pd[:, None]], axis=1)
     rev = jnp.concatenate(
         [dst, src, rev_ports[:, None], rev_pd[:, None]], axis=1)
+    # RELATED rows (ICMP errors) carry the EMBEDDED original tuple; the
+    # entry to relate to was created with that SAME tuple under either
+    # hook direction, so the "reverse" probe flips only the direction
+    # bit instead of swapping the tuple (reference: the kernel looks up
+    # the inner tuple for icmp errors)
+    related = ((hdr[:, COL_FLAGS] & FLAG_RELATED) != 0)[:, None]
+    rev_rel = jnp.concatenate(
+        [src, dst, fwd_ports[:, None], rev_pd[:, None]], axis=1)
+    rev = jnp.where(related, rev_rel, rev)
     return fwd, rev
 
 

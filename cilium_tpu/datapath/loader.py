@@ -86,6 +86,10 @@ class Loader(abc.ABC):
         """Patch one ipcache prefix -> identity mapping in place."""
         return False
 
+    def delete_ipcache(self, cidr: str) -> bool:
+        """Remove one ipcache prefix in place (fqdn TTL expiry)."""
+        return False
+
 
 class TPULoader(Loader):
     """The real datapath: device tensors + fused jit pipeline."""
@@ -137,11 +141,15 @@ class TPULoader(Loader):
                     ct=self.state.ct, metrics=self.state.metrics)
             self.attach_count += 1
 
-    def step(self, hdr: np.ndarray, now: int):
+    def step(self, hdr, now: int):
+        """``hdr`` may be a numpy array OR an already-on-device jax
+        array (the LB stage hands its output over without a host
+        round trip)."""
         from .verdict import datapath_step_jit
 
         jnp = self._jnp
-        hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        if isinstance(hdr, np.ndarray):
+            hdr = jnp.asarray(np.ascontiguousarray(hdr))
         with self._lock:
             out, self.state = datapath_step_jit(self.state, hdr,
                                                 jnp.uint32(now))
@@ -179,6 +187,11 @@ class TPULoader(Loader):
                 ipcache=self.state.ipcache, ct=self.state.ct,
                 metrics=self.state.metrics)
             self._policies = list(policies)
+            if (kind == "remove"
+                    and numeric_id not in self._lpm_entries.values()):
+                # row contents are back to defaults and nothing maps
+                # to it: recycle (unbounded churn must not grow rows)
+                self.row_map.remove(numeric_id)
         return True
 
     def patch_ipcache(self, cidr: str, numeric_id: int) -> bool:
@@ -214,6 +227,68 @@ class TPULoader(Loader):
                     l1=l1, l2=l2, l3=l3, v6_net=lpm.v6_net,
                     v6_mask=lpm.v6_mask, v6_value=lpm.v6_value,
                     v6_plen=lpm.v6_plen, default=lpm.default)
+            self.state = DatapathState(
+                policy=self.state.policy, ipcache=new_lpm,
+                ct=self.state.ct, metrics=self.state.metrics)
+        return True
+
+    def delete_ipcache(self, cidr: str) -> bool:
+        """Remove one prefix (fqdn TTL expiry).  A /32 is patched in
+        place — the slot reverts to the longest remaining covering
+        prefix's value, computed from the host entry mirror; anything
+        else rebuilds the LPM tensors (never the policy)."""
+        import ipaddress
+
+        from .lpm import DeviceLPM
+
+        jnp = self._jnp
+        with self._lock:
+            if self.state is None or self.row_map is None:
+                return False
+            if self._lpm_entries.pop(cidr, None) is None:
+                return True  # unknown entry: nothing to do
+            net = ipaddress.ip_network(cidr, strict=False)
+            lpm = self.state.ipcache
+            in_place = net.version == 4 and net.prefixlen == 32
+            if in_place:
+                addr = int(net.network_address)
+                t = self._lpm_tensors
+                hi16, mid8, lo8 = (addr >> 16, (addr >> 8) & 0xFF,
+                                   addr & 0xFF)
+                cur1 = int(t.l1[hi16])
+                cur2 = int(t.l2[-cur1 - 1, mid8]) if cur1 < 0 else 0
+                if cur1 >= 0 or cur2 >= 0:
+                    # the /32 was never expanded into an l3 slot (it
+                    # came in via a full compile that merged it, or was
+                    # shadowed) — too ambiguous to patch: rebuild
+                    in_place = False
+            if in_place:
+                # longest remaining covering v4 prefix -> slot value
+                best_len, best_num = -1, None
+                for c, num in self._lpm_entries.items():
+                    n2 = ipaddress.ip_network(c, strict=False)
+                    if n2.version != 4 or n2.prefixlen <= best_len:
+                        continue
+                    shift = 32 - n2.prefixlen
+                    if n2.prefixlen == 0 or (
+                            addr >> shift) == (int(n2.network_address)
+                                               >> shift):
+                        best_len, best_num = n2.prefixlen, num
+                value = (self._lpm_tensors.default if best_num is None
+                         else self.row_map.row(best_num))
+                blk3 = -cur2 - 1
+                t.l3[blk3, lo8] = value
+                new_lpm = DeviceLPM(
+                    l1=lpm.l1, l2=lpm.l2,
+                    l3=lpm.l3.at[blk3].set(jnp.asarray(t.l3[blk3])),
+                    v6_net=lpm.v6_net, v6_mask=lpm.v6_mask,
+                    v6_value=lpm.v6_value, v6_plen=lpm.v6_plen,
+                    default=lpm.default)
+            else:
+                t = compile_lpm({c: self.row_map.row(i)
+                                 for c, i in self._lpm_entries.items()})
+                self._lpm_tensors = t
+                new_lpm = DeviceLPM.from_tensors(t)
             self.state = DatapathState(
                 policy=self.state.policy, ipcache=new_lpm,
                 ct=self.state.ct, metrics=self.state.metrics)
@@ -313,7 +388,10 @@ class InterpreterLoader(Loader):
                        policies) -> bool:
         if self.oracle is None or self.row_map is None:
             return False
-        self.row_map.add(numeric_id)
+        if kind == "remove":
+            self.row_map.remove(numeric_id)
+        else:
+            self.row_map.add(numeric_id)
         return True
 
     def patch_ipcache(self, cidr: str, numeric_id: int) -> bool:
@@ -332,6 +410,23 @@ class InterpreterLoader(Loader):
                 e for e in self.oracle.ipcache if e[:3] != key]
             self.oracle.ipcache.append((net.version, addr,
                                         net.prefixlen, numeric_id))
+        self.oracle._lpm_memo.clear()
+        return True
+
+    def delete_ipcache(self, cidr: str) -> bool:
+        import ipaddress
+
+        if self.oracle is None:
+            return False
+        net = ipaddress.ip_network(cidr, strict=False)
+        host_bits = 32 if net.version == 4 else 128
+        addr = int(net.network_address)
+        if net.prefixlen == host_bits:
+            self.oracle._exact.pop((net.version, addr), None)
+        else:
+            key = (net.version, addr, net.prefixlen)
+            self.oracle.ipcache = [
+                e for e in self.oracle.ipcache if e[:3] != key]
         self.oracle._lpm_memo.clear()
         return True
 

@@ -44,6 +44,7 @@ from ..policy.mapstate import (
 from .conntrack import (
     CT_ESTABLISHED,
     CT_NEW,
+    CT_RELATED,
     CT_REPLY,
     CTTable,
     V_PROXY,
@@ -153,9 +154,16 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     remote = jnp.where((dirn == 0)[:, None], src_words, dst_words)
     id_row = lpm_lookup(state.ipcache, remote, fam)
 
-    # 2. conntrack lookup.
+    # 2. conntrack lookup.  RELATED rows (ICMP errors carrying the
+    #    embedded original tuple, core/packets.py FLAG_RELATED) probe
+    #    the original flow's entry; a hit is CT_RELATED — forwarded
+    #    like established traffic, never refreshed, never created.
+    from ..core.packets import COL_FLAGS, FLAG_RELATED
+
     fwd, rev = ct_keys_from_headers(hdr)
     ct_res, slot, is_reply = ct_lookup(state.ct, fwd, rev, now)
+    related_hint = (hdr[:, COL_FLAGS] & FLAG_RELATED) != 0
+    is_related = related_hint & (ct_res != CT_NEW)
 
     # 3. policy map lookup (two gathers; all precedence precompiled).
     pol_row = state.policy.ep_policy[hdr[:, COL_EP].astype(jnp.int32)]
@@ -174,6 +182,9 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     proxy = jnp.where(is_new, jnp.where(p_verdict == VERDICT_REDIRECT,
                                         p_proxy, 0),
                       ct_proxy)
+    # an ICMP error related to a proxied flow is forwarded, not
+    # redirected (the proxy speaks the flow's L7, not ICMP)
+    proxy = jnp.where(is_related, 0, proxy)
     verdict = jnp.where(
         allowed,
         jnp.where(proxy > 0, VERDICT_REDIRECT, VERDICT_ALLOW),
@@ -183,9 +194,13 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
         jnp.where(p_verdict == VERDICT_DENY, REASON_POLICY_DENY,
                   REASON_POLICY_DEFAULT_DENY))
 
-    # 5. conntrack create/refresh (create only on allowed NEW).
-    ct = ct_update(state.ct, hdr, fwd, ct_res, slot, is_reply,
-                   do_create=allowed & is_new,
+    # 5. conntrack create/refresh (create only on allowed NEW; related
+    #    rows neither create nor refresh — the ICMP error is evidence
+    #    about a flow, not flow traffic).
+    ct = ct_update(state.ct, hdr, fwd,
+                   jnp.where(is_related, CT_NEW, ct_res), slot,
+                   is_reply,
+                   do_create=allowed & is_new & ~related_hint,
                    proxy_port=proxy.astype(jnp.uint32),
                    now=now, valid=valid)
 
@@ -199,7 +214,7 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     out = jnp.stack([
         verdict.astype(jnp.uint32),
         proxy.astype(jnp.uint32),
-        ct_res.astype(jnp.uint32),
+        jnp.where(is_related, CT_RELATED, ct_res).astype(jnp.uint32),
         id_row.astype(jnp.uint32),
         reason.astype(jnp.uint32),
         event.astype(jnp.uint32),

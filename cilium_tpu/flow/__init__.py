@@ -15,3 +15,5 @@ from .parser import ThreeFourParser  # noqa: F401
 from .observer import FlowFilter, Observer  # noqa: F401
 from .metrics import FlowMetrics  # noqa: F401
 from .exporter import FlowExporter  # noqa: F401
+from .seven import SevenParser  # noqa: F401
+from .relay import Relay  # noqa: F401

@@ -1,0 +1,109 @@
+"""The Observer gRPC API: hubble's external surface.
+
+Reference: upstream hubble serves ``observer.Observer`` over gRPC
+(``GetFlows`` server-streaming + ``ServerStatus``; schema
+``api/v1/flow/flow.proto``).  This environment ships the grpc runtime
+but not the protoc-gen-grpc plugin, so the service is registered with
+generic method handlers and the messages travel as the flow.proto
+JSON rendering (the exact dicts ``Flow.to_dict`` produces — the same
+bytes hubble's JSON exporter emits).  A consumer with real hubble
+stubs would need the binary proto; the METHOD SHAPE and payload schema
+are kept so that swap is mechanical.
+
+``serve(observer, address)`` -> grpc.Server;
+:class:`ObserverClient` is the matching client (used by the relay for
+remote peers and by the CLI's ``hubble observe``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterator, List, Optional, Sequence
+
+import grpc
+
+SERVICE = "observer.Observer"
+
+_dumps = lambda d: json.dumps(d).encode()  # noqa: E731
+_loads = lambda b: json.loads(b.decode()) if b else {}  # noqa: E731
+
+
+class _ObserverHandler(grpc.GenericRpcHandler):
+    def __init__(self, observer):
+        self.observer = observer
+
+    def service(self, handler_call_details):
+        method = handler_call_details.method
+        if method == f"/{SERVICE}/GetFlows":
+            return grpc.unary_stream_rpc_method_handler(
+                self._get_flows,
+                request_deserializer=_loads,
+                response_serializer=_dumps)
+        if method == f"/{SERVICE}/ServerStatus":
+            return grpc.unary_unary_rpc_method_handler(
+                self._server_status,
+                request_deserializer=_loads,
+                response_serializer=_dumps)
+        return None
+
+    def _get_flows(self, request: dict, context) -> Iterator[dict]:
+        from .observer import FlowFilter
+
+        number = int(request.get("number", 100))
+        filters = [FlowFilter(**f)
+                   for f in request.get("whitelist", ())]
+        flows = self.observer.get_flows(
+            filters=filters, number=number,
+            oldest_first=bool(request.get("oldest_first", False)))
+        for f in flows:
+            yield {"flow": f.to_dict() if hasattr(f, "to_dict")
+                   else dict(f)}
+
+    def _server_status(self, request: dict, context) -> dict:
+        obs = self.observer
+        if hasattr(obs, "server_status"):
+            return obs.server_status()
+        return {"num_flows": len(obs), "seen_flows": obs.seq,
+                "max_flows": obs.capacity}
+
+
+def serve(observer, address: str = "unix:///tmp/hubble.sock",
+          max_workers: int = 4) -> grpc.Server:
+    """Start the Observer service (unix:// or host:port address).
+    ``observer`` may be an Observer or a Relay (relay exposes the same
+    GetFlows protocol, making this the hubble-relay server too)."""
+    from concurrent import futures
+
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers((_ObserverHandler(observer),))
+    server.add_insecure_port(address)
+    server.start()
+    return server
+
+
+class ObserverClient:
+    """GetFlows/ServerStatus client; quacks like an Observer for the
+    relay (get_flows returns flow dicts)."""
+
+    def __init__(self, address: str = "unix:///tmp/hubble.sock"):
+        self.channel = grpc.insecure_channel(address)
+        self._get = self.channel.unary_stream(
+            f"/{SERVICE}/GetFlows",
+            request_serializer=_dumps, response_deserializer=_loads)
+        self._status = self.channel.unary_unary(
+            f"/{SERVICE}/ServerStatus",
+            request_serializer=_dumps, response_deserializer=_loads)
+
+    def get_flows(self, filters: Sequence = (), number: int = 100,
+                  oldest_first: bool = False) -> List[dict]:
+        req = {"number": number, "oldest_first": oldest_first}
+        if filters:
+            req["whitelist"] = [f.__dict__ for f in filters]
+        return [msg["flow"] for msg in self._get(req)]
+
+    def server_status(self) -> dict:
+        return self._status({})
+
+    def close(self) -> None:
+        self.channel.close()

@@ -114,6 +114,8 @@ class Observer:
         self.proxy = np.zeros(capacity, dtype=np.uint16)
         self.hdr = np.zeros((capacity, N_COLS), dtype=np.uint32)
         self.flow_seq = np.zeros(capacity, dtype=np.int64)
+        # L7 payloads (seven-parser flows); None for L3/L4 rows
+        self.l7 = np.empty(capacity, dtype=object)
         self.seq = 0  # total flows ever written
         self.identity_getter = identity_getter or (lambda n: ())
         self.endpoint_getter = endpoint_getter or (lambda e: ("", e))
@@ -148,7 +150,27 @@ class Observer:
             self.proxy[pos] = batch.proxy_port[sl]
             self.hdr[pos] = batch.hdr[sl]
             self.flow_seq[pos] = self.seq + np.arange(n)[sl]
+            self.l7[pos] = None
             self.seq += n
+
+    def append_l7(self, hdr_row: np.ndarray, l7: dict, verdict: int,
+                  identity: int, timestamp: float) -> None:
+        """One seven-parser flow (proxy access record) into the ring."""
+        from ..flow.seven import MSG_L7
+
+        with self._lock:
+            pos = self.seq % self.capacity
+            self.time[pos] = timestamp
+            self.verdict[pos] = verdict
+            self.reason[pos] = 0
+            self.ct_state[pos] = 0
+            self.msg_type[pos] = MSG_L7
+            self.identity[pos] = identity
+            self.proxy[pos] = 0
+            self.hdr[pos] = hdr_row
+            self.flow_seq[pos] = self.seq
+            self.l7[pos] = l7
+            self.seq += 1
 
     def get_flows(self, filters: Sequence[FlowFilter] = (),
                   number: int = 100, oldest_first: bool = False
@@ -175,12 +197,15 @@ class Observer:
             return [self._materialize(i) for i in idx]
 
     def _materialize(self, i: int) -> Flow:
-        return materialize_flow(
+        f = materialize_flow(
             self.hdr[i], float(self.time[i]), int(self.flow_seq[i]),
             int(self.verdict[i]), int(self.reason[i]),
             int(self.ct_state[i]), int(self.msg_type[i]),
             int(self.identity[i]), self.identity_getter,
             self.endpoint_getter)
+        if self.l7[i] is not None:
+            f.l7 = self.l7[i]
+        return f
 
 
 def materialize_flow(r: np.ndarray, time: float, seq: int, verdict: int,

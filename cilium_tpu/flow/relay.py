@@ -1,0 +1,61 @@
+"""Relay: cluster-wide flow aggregation across agents.
+
+Reference: upstream ``hubble-relay`` — fans GetFlows out to every
+node's hubble server and merges the streams time-ordered, stamping
+each flow with its node of origin.  Peers here are anything with the
+Observer ``get_flows`` protocol: in-process Observers, or
+:class:`cilium_tpu.flow.grpc_server.ObserverClient` handles to remote
+agents' gRPC servers.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .flow import Flow
+from .observer import FlowFilter
+
+
+class Relay:
+    def __init__(self, peers: Dict[str, object]):
+        """``peers``: node name -> Observer-protocol object."""
+        self.peers = dict(peers)
+
+    def add_peer(self, name: str, obs) -> None:
+        self.peers[name] = obs
+
+    def remove_peer(self, name: str) -> None:
+        self.peers.pop(name, None)
+
+    def get_flows(self, filters: Sequence[FlowFilter] = (),
+                  number: int = 100,
+                  oldest_first: bool = False) -> List[dict]:
+        """Merged, time-ordered flows as dicts with ``node_name``
+        stamped (relay adds the node dimension the per-agent API
+        lacks)."""
+        merged: List[dict] = []
+        for name, obs in self.peers.items():
+            for f in obs.get_flows(filters=filters, number=number,
+                                   oldest_first=oldest_first):
+                d = f.to_dict() if isinstance(f, Flow) else dict(f)
+                d["node_name"] = name
+                merged.append(d)
+        merged.sort(key=lambda d: d.get("time", 0.0),
+                    reverse=not oldest_first)
+        return merged[:number]
+
+    def server_status(self) -> dict:
+        """hubble-relay ServerStatus: aggregate over peers."""
+        total = seen = 0
+        nodes = []
+        for name, obs in self.peers.items():
+            try:
+                n = len(obs) if hasattr(obs, "__len__") else 0
+                s = getattr(obs, "seq", n)
+                nodes.append({"name": name, "flows": n, "seen": s})
+                total += n
+                seen += s
+            except Exception as e:  # a dead peer must not kill status
+                nodes.append({"name": name, "error": str(e)[:100]})
+        return {"num_flows": total, "seen_flows": seen,
+                "num_connected_nodes": len(self.peers), "nodes": nodes}

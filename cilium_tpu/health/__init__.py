@@ -1,0 +1,143 @@
+"""Health: node registry + inter-node probe mesh.
+
+Reference: upstream ``cilium-health`` / ``pkg/health`` — every node
+registers itself, a prober sweeps all known nodes (ICMP + TCP to node
+and endpoint IPs), and ``cilium status`` / ``cilium-health status``
+report per-node reachability and latency.
+
+TPU-first mapping: node discovery rides the kvstore (the same plane
+identities replicate over); the probe transport is pluggable — the
+default probes the peer agent's AF_UNIX API socket (the in-process/
+single-host deployment), a TCP prober covers multi-host.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+NODES_PREFIX = "cilium/state/nodes/v1"
+
+
+class NodeRegistry:
+    """Node announcements over the kvstore (pkg/node discovery)."""
+
+    def __init__(self, kv, lease_ttl: Optional[float] = 60.0):
+        self.kv = kv
+        self.lease_ttl = lease_ttl
+
+    def register(self, name: str, info: dict) -> None:
+        self.kv.update(f"{NODES_PREFIX}/{name}",
+                       json.dumps({"name": name, **info}).encode(),
+                       lease_ttl=self.lease_ttl)
+
+    def heartbeat(self, name: str) -> None:
+        if self.lease_ttl:
+            self.kv.keepalive(f"{NODES_PREFIX}/{name}", self.lease_ttl)
+
+    def unregister(self, name: str) -> None:
+        self.kv.delete(f"{NODES_PREFIX}/{name}")
+
+    def nodes(self) -> List[dict]:
+        return [json.loads(v) for v in
+                self.kv.list_prefix(NODES_PREFIX + "/").values()]
+
+
+@dataclass
+class NodeHealth:
+    name: str
+    reachable: bool = False
+    latency_ms: float = 0.0
+    last_probe: float = 0.0
+    consecutive_failures: int = 0
+    error: str = ""
+
+
+def unix_socket_prober(info: dict) -> float:
+    """Default probe: connect to the node's API socket (AF_UNIX) and
+    time it.  Raises on unreachable."""
+    path = info.get("api_socket")
+    if not path:
+        raise ValueError("node advertises no api_socket")
+    t0 = time.perf_counter()
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.settimeout(2.0)
+    try:
+        s.connect(path)
+    finally:
+        s.close()
+    return (time.perf_counter() - t0) * 1e3
+
+
+def tcp_prober(info: dict) -> float:
+    """Multi-host probe: TCP connect to the node's health address."""
+    host, port = info["health_addr"].rsplit(":", 1)
+    t0 = time.perf_counter()
+    s = socket.create_connection((host, int(port)), timeout=2.0)
+    s.close()
+    return (time.perf_counter() - t0) * 1e3
+
+
+class HealthMesh:
+    """The probe mesh: sweep every registered node, keep per-node
+    status (drive ``probe_all`` from a controller)."""
+
+    def __init__(self, registry: NodeRegistry, local_name: str,
+                 prober: Callable[[dict], float] = unix_socket_prober):
+        self.registry = registry
+        self.local_name = local_name
+        self.prober = prober
+        self._lock = threading.Lock()
+        self._status: Dict[str, NodeHealth] = {}
+
+    def probe_all(self) -> None:
+        now = time.time()
+        seen = set()
+        for info in self.registry.nodes():
+            name = info["name"]
+            seen.add(name)
+            if name == self.local_name:
+                continue  # self is reported by liveness, not probes
+            with self._lock:
+                h = self._status.setdefault(name, NodeHealth(name))
+            try:
+                latency = self.prober(info)
+                with self._lock:
+                    h.reachable = True
+                    h.latency_ms = round(latency, 3)
+                    h.consecutive_failures = 0
+                    h.error = ""
+                    h.last_probe = now
+            except Exception as e:
+                with self._lock:
+                    h.reachable = False
+                    h.consecutive_failures += 1
+                    h.error = f"{type(e).__name__}: {e}"[:200]
+                    h.last_probe = now
+        with self._lock:
+            for name in list(self._status):
+                if name not in seen:  # node lease expired: drop it
+                    del self._status[name]
+
+    def statuses(self) -> List[NodeHealth]:
+        with self._lock:
+            return [self._status[k] for k in sorted(self._status)]
+
+    def to_dict(self) -> dict:
+        """`cilium-health status`-shaped rendering."""
+        nodes = self.statuses()
+        return {
+            "local": self.local_name,
+            "nodes": [{
+                "name": h.name,
+                "reachable": h.reachable,
+                "latency-ms": h.latency_ms,
+                **({"error": h.error} if h.error else {}),
+            } for h in nodes],
+            "reachable": sum(1 for h in nodes if h.reachable),
+            "unreachable": sum(1 for h in nodes if not h.reachable),
+        }

@@ -75,7 +75,16 @@ class CachingIdentityAllocator:
                 return self._by_labels[key]
             ident = self._by_labels.get(key)
             if ident is not None:
-                self._refcount[ident.numeric_id] += 1
+                prev = self._refcount.get(ident.numeric_id, 0)
+                self._refcount[ident.numeric_id] = prev + 1
+                if (prev == 0 and self._backend is not None
+                        and hasattr(self._backend, "ref")
+                        and not (ident.numeric_id & LOCAL_IDENTITY_FLAG)
+                        and ident.numeric_id not in RESERVED_LABELSETS):
+                    # first local use of a watch-replayed identity:
+                    # take this node's kvstore reference so identity
+                    # GC sees the id as live
+                    self._backend.ref(key, ident.numeric_id)
                 return ident
             local = any(l.source == SOURCE_CIDR for l in labels)
             if local:
@@ -121,7 +130,17 @@ class CachingIdentityAllocator:
                 return False
             self._refcount.pop(num, None)
             self._by_id.pop(num, None)
-            self._by_labels.pop(ident.labels.sorted_key(), None)
+            # pop the labels index only when it still maps to THIS
+            # identity — a stale release must not remove a newer
+            # identity that re-bound the same label set
+            cur = self._by_labels.get(ident.labels.sorted_key())
+            if cur is not None and cur.numeric_id == num:
+                self._by_labels.pop(ident.labels.sorted_key(), None)
+            if self._backend is not None and hasattr(self._backend,
+                                                     "release"):
+                # drop this node's kvstore reference; the master key
+                # stays until identity GC sweeps orphans (operator)
+                self._backend.release(ident.labels.sorted_key())
             self._notify("remove", ident)
             return True
 

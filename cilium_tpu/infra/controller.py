@@ -79,6 +79,7 @@ class Controller:
 class ControllerManager:
     def __init__(self):
         self._controllers: Dict[str, Controller] = {}
+        _MANAGERS.add(self)
 
     def update(self, name: str, fn: Callable[[], None],
                interval: float) -> Controller:
@@ -102,3 +103,25 @@ class ControllerManager:
 
     def statuses(self) -> Dict[str, ControllerStatus]:
         return {n: c.status for n, c in self._controllers.items()}
+
+
+# Controllers run device work (CT GC) on daemon threads; a thread
+# caught mid-XLA-dispatch while the interpreter tears down crashes the
+# runtime's C++ destructors (std::terminate).  Stop every live
+# controller at interpreter exit — also the correct agent-shutdown
+# order (background reconciliation quiesces before the datapath).
+import atexit
+import weakref
+
+_MANAGERS: "weakref.WeakSet[ControllerManager]" = weakref.WeakSet()
+
+
+def _stop_all_at_exit() -> None:
+    for mgr in list(_MANAGERS):
+        try:
+            mgr.stop_all()
+        except Exception:
+            pass
+
+
+atexit.register(_stop_all_at_exit)

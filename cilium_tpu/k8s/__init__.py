@@ -1,0 +1,138 @@
+"""k8s integration: CiliumNetworkPolicy objects -> repository rules.
+
+Reference: upstream cilium ``pkg/k8s`` — generated CRD clients,
+``apis/cilium.io/v2`` (CiliumNetworkPolicy with ``spec``/``specs``),
+and the watchers translating k8s objects into ``api.Rule`` lists
+(``pkg/k8s/apis/cilium.io/v2.ParseToCiliumRule``).  This module is the
+translation layer alone: it accepts CNP-shaped dicts (parsed YAML/
+JSON) and produces repository mutations; a fake watcher drives it in
+tests the way ``pkg/k8s`` fake clientsets do (SURVEY.md §4).
+
+Namespace semantics (mirroring ParseToCiliumRule):
+
+- the subject endpointSelector gains
+  ``k8s:io.kubernetes.pod.namespace=<ns>`` unless it already
+  constrains the namespace;
+- ``fromEndpoints``/``toEndpoints`` selectors likewise default to the
+  policy's namespace unless they name one or match cluster-wide
+  (``NamespaceSelector`` is out of scope — documented divergence);
+- every derived rule carries identity labels
+  ``k8s:io.cilium.k8s.policy.name/namespace/uid`` so delete-by-labels
+  removes exactly this CNP's rules.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..policy.api import Rule, rule_from_dict
+
+NS_LABEL = "io.kubernetes.pod.namespace"
+POLICY_NAME_LABEL = "k8s:io.cilium.k8s.policy.name"
+POLICY_NS_LABEL = "k8s:io.cilium.k8s.policy.namespace"
+POLICY_UID_LABEL = "k8s:io.cilium.k8s.policy.uid"
+
+
+def _selector_in_namespace(sel: Optional[dict], ns: str) -> dict:
+    """Scope a (possibly empty) selector dict to the namespace unless
+    it already constrains it."""
+    sel = dict(sel or {})
+    ml = dict(sel.get("matchLabels") or {})
+    me = list(sel.get("matchExpressions") or ())
+    constrained = any(k.split(":", 1)[-1] == NS_LABEL for k in ml) or any(
+        e.get("key", "").split(":", 1)[-1] == NS_LABEL for e in me)
+    if not constrained:
+        ml[f"k8s:{NS_LABEL}"] = ns
+    out: dict = {}
+    if ml:
+        out["matchLabels"] = ml
+    if me:
+        out["matchExpressions"] = me
+    return out
+
+
+def _scope_peers(section: dict, ns: str) -> dict:
+    """Namespace the peer selectors of one ingress/egress entry."""
+    out = dict(section)
+    for key in ("fromEndpoints", "toEndpoints"):
+        if key in out and out[key]:
+            out[key] = [_selector_in_namespace(s, ns) for s in out[key]]
+    return out
+
+
+def rules_from_cnp(obj: dict) -> List[Rule]:
+    """One CiliumNetworkPolicy object (parsed YAML/JSON) -> rules.
+
+    Accepts ``spec`` (one rule) or ``specs`` (several); both error if
+    absent, matching upstream sanitization."""
+    kind = obj.get("kind", "")
+    if kind not in ("CiliumNetworkPolicy", "CiliumClusterwideNetworkPolicy"):
+        raise ValueError(f"not a CNP object: kind={kind!r}")
+    meta = obj.get("metadata") or {}
+    name = meta.get("name", "")
+    if not name:
+        raise ValueError("CNP metadata.name is required")
+    ns = meta.get("namespace", "default")
+    clusterwide = kind == "CiliumClusterwideNetworkPolicy"
+    specs = []
+    if obj.get("spec"):
+        specs.append(obj["spec"])
+    specs.extend(obj.get("specs") or ())
+    if not specs:
+        raise ValueError("CNP needs spec or specs")
+
+    derived = [f"{POLICY_NAME_LABEL}={name}"]
+    if not clusterwide:
+        derived.append(f"{POLICY_NS_LABEL}={ns}")
+    if meta.get("uid"):
+        derived.append(f"{POLICY_UID_LABEL}={meta['uid']}")
+
+    rules = []
+    for spec in specs:
+        d = dict(spec)
+        if not clusterwide:
+            sel_key = ("endpointSelector" if "endpointSelector" in d
+                       else "nodeSelector" if "nodeSelector" in d
+                       else "endpointSelector")
+            d[sel_key] = _selector_in_namespace(d.get(sel_key), ns)
+            for section in ("ingress", "ingressDeny", "egress",
+                            "egressDeny"):
+                if d.get(section):
+                    d[section] = [_scope_peers(s, ns)
+                                  for s in d[section]]
+        d["labels"] = list(d.get("labels") or ()) + derived
+        if not d.get("description"):
+            d["description"] = f"cnp:{ns}/{name}" if not clusterwide \
+                else f"ccnp:{name}"
+        rules.append(rule_from_dict(d))
+    return rules
+
+
+def cnp_identity_labels(obj: dict) -> List[str]:
+    """The derived labels identifying one CNP's rules (for delete)."""
+    meta = obj.get("metadata") or {}
+    out = [f"{POLICY_NAME_LABEL}={meta.get('name', '')}"]
+    if obj.get("kind") != "CiliumClusterwideNetworkPolicy":
+        out.append(
+            f"{POLICY_NS_LABEL}={meta.get('namespace', 'default')}")
+    return out
+
+
+class CNPWatcher:
+    """The watcher half: CNP add/update/delete events -> repository
+    mutations (reference: pkg/k8s/watchers cilium_network_policy.go).
+    Drive it from a fake event stream in tests, or a real informer in
+    deployment."""
+
+    def __init__(self, repo):
+        self.repo = repo
+
+    def on_add(self, obj: dict) -> int:
+        return self.repo.add_list(rules_from_cnp(obj))
+
+    def on_update(self, obj: dict) -> int:
+        self.repo.delete_by_labels(cnp_identity_labels(obj))
+        return self.repo.add_list(rules_from_cnp(obj))
+
+    def on_delete(self, obj: dict) -> int:
+        return self.repo.delete_by_labels(cnp_identity_labels(obj))

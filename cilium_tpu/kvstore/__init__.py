@@ -10,4 +10,8 @@ the same interface backs the multi-host store when processes join via
 watch replay — the ClusterMesh analogue).
 """
 
+from .allocator import (  # noqa: F401
+    ClusterIdentitySync,
+    KVStoreAllocatorBackend,
+)
 from .store import InMemoryKVStore, KVEvent, SharedStore  # noqa: F401

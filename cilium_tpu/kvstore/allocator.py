@@ -1,0 +1,148 @@
+"""Distributed identity allocation over the kvstore.
+
+Reference: upstream cilium ``pkg/allocator`` + ``pkg/kvstore/allocator``
+— cluster-wide collision-free numeric IDs via an etcd protocol:
+
+- master key   ``id/<numeric>`` -> label key (created create-only; the
+  atomic claim that makes allocation collision-free)
+- node ref     ``value/<labels>/<node>`` -> numeric (leased; a node's
+  liveness reference — when every node's lease expires the identity is
+  garbage, swept by the operator)
+
+TPU-first framing: the kvstore is the control-plane consistency axis
+(SURVEY.md §2c "cluster-wide consistency"); every agent replays the
+``id/`` prefix into its local allocator, whose observers patch device
+tensors incrementally — the identity tensor IS the replicated state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..identity.identity import MAX_ALLOCATED, MIN_ALLOCATED
+from ..labels import LabelSet
+from .store import InMemoryKVStore, KVEvent
+
+DEFAULT_PREFIX = "cilium/state/identities/v1"
+
+
+class KVStoreAllocatorBackend:
+    """The ``backend.allocate(key)`` hook for CachingIdentityAllocator,
+    speaking the id/ + value/ kvstore protocol."""
+
+    def __init__(self, kv: InMemoryKVStore, node: str = "node0",
+                 prefix: str = DEFAULT_PREFIX,
+                 min_id: int = MIN_ALLOCATED,
+                 max_id: int = MAX_ALLOCATED,
+                 lease_ttl: Optional[float] = None):
+        self.kv = kv
+        self.node = node
+        self.prefix = prefix.rstrip("/")
+        self.min_id = min_id
+        self.max_id = max_id
+        self.lease_ttl = lease_ttl
+        self._lock = threading.Lock()
+
+    def _id_key(self, num: int) -> str:
+        return f"{self.prefix}/id/{num}"
+
+    def _value_prefix(self, key: str) -> str:
+        return f"{self.prefix}/value/{key}/"
+
+    def allocate(self, key: str) -> int:
+        """Return the cluster-wide numeric id for a label key —
+        reusing the existing id when one exists, claiming a fresh one
+        (create-only on the master key) otherwise."""
+        # reuse path 1: a node currently references this key
+        existing = self.kv.list_prefix(self._value_prefix(key))
+        for _, raw in existing.items():
+            num = int(raw)
+            self.kv.update(self._value_prefix(key) + self.node,
+                           raw, lease_ttl=self.lease_ttl)
+            return num
+        # reuse path 2: an unreferenced MASTER key still maps this
+        # label set (all node refs released but identity GC has not
+        # swept it) — minting a fresh id here would make nodes that
+        # replayed the master disagree on the numeric
+        for id_key, raw in self.kv.list_prefix(
+                f"{self.prefix}/id/").items():
+            if raw.decode() == key:
+                num = int(id_key.rsplit("/", 1)[1])
+                self.kv.update(self._value_prefix(key) + self.node,
+                               str(num).encode(),
+                               lease_ttl=self.lease_ttl)
+                return num
+        # claim path: race create-only on successive candidate ids
+        # (reference: pkg/allocator selects a random free id and
+        # retries on conflict; sequential probing is equivalent under
+        # the same atomicity and deterministic for tests)
+        num = self._first_free()
+        while num < self.max_id:
+            if self.kv.create_only(self._id_key(num), key.encode()):
+                self.kv.update(self._value_prefix(key) + self.node,
+                               str(num).encode(),
+                               lease_ttl=self.lease_ttl)
+                return num
+            num += 1
+        raise RuntimeError("identity space exhausted")
+
+    def _first_free(self) -> int:
+        used = self.kv.list_prefix(f"{self.prefix}/id/")
+        nums = [int(k.rsplit("/", 1)[1]) for k in used]
+        return max(nums) + 1 if nums else self.min_id
+
+    def ref(self, key: str, num: int) -> None:
+        """Write this node's reference for an id learned by watch
+        replay (a replayed master key conveys no liveness; the first
+        local use must take a ref or identity GC could sweep an id
+        this node actively enforces with)."""
+        self.kv.update(self._value_prefix(key) + self.node,
+                       str(num).encode(), lease_ttl=self.lease_ttl)
+
+    def release(self, key: str) -> None:
+        """Drop this node's reference (master key stays; identity GC —
+        the operator's job in the reference — sweeps orphans)."""
+        self.kv.delete(self._value_prefix(key) + self.node)
+
+    def gc(self) -> int:
+        """Operator-style sweep: delete master keys with no node refs.
+        Returns the number of identities collected."""
+        n = 0
+        for id_key, raw in self.kv.list_prefix(
+                f"{self.prefix}/id/").items():
+            key = raw.decode()
+            if not self.kv.list_prefix(self._value_prefix(key)):
+                if self.kv.delete(id_key):
+                    n += 1
+        return n
+
+
+class ClusterIdentitySync:
+    """Watch the id/ prefix and replay remote allocations into the
+    local allocator (the ClusterMesh identity-replication analogue).
+
+    A remote agent's allocation appears as an ``id/<n>`` create; the
+    local allocator registers it under the SAME numeric id
+    (restore_identity), its observers fire, and the incremental patch
+    path updates the device tensors — remote identity churn costs this
+    node one row patch, no recompile."""
+
+    def __init__(self, kv: InMemoryKVStore, allocator,
+                 prefix: str = DEFAULT_PREFIX):
+        self.prefix = prefix.rstrip("/")
+        self._allocator = allocator
+        self._cancel = kv.watch_prefix(f"{self.prefix}/id/",
+                                       self._on_event, replay=True)
+
+    def _on_event(self, ev: KVEvent) -> None:
+        if ev.kind == "delete":
+            return  # master-key GC; local release is refcount-driven
+        num = int(ev.key.rsplit("/", 1)[1])
+        labels = LabelSet.parse(
+            *[s for s in ev.value.decode().split(";") if s])
+        if self._allocator.lookup_by_id(num) is None:
+            self._allocator.restore_identity(num, labels)
+
+    def close(self) -> None:
+        self._cancel()

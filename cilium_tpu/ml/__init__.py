@@ -17,8 +17,11 @@ from .model import (  # noqa: F401
     forward,
     init_params,
     label_embedding_init,
+    fit_novelty,
     load_model,
+    novelty_d2,
     save_model,
+    score_packets,
 )
 from .train import auc, make_train_step, synth_labeled_traffic, train  # noqa: F401
 from .scorer import AnomalyScorer  # noqa: F401

@@ -20,9 +20,26 @@ import numpy as np
 from .features import FEAT_DIM
 
 
+_FIELDS = ("embed", "w1", "b1", "w2", "b2", "w3", "b3",
+           "feat_mean", "feat_prec", "nov_thresh")
+
+# sentinel threshold meaning "novelty stats not fitted" — with
+# feat_prec all zeros d2 is identically 0, so the novelty branch
+# contributes sigmoid(-huge) ~ 0 and scoring is purely supervised
+NOV_DISABLED = 1e9
+
+
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class AnomalyModel:
+    """Supervised head + benign-novelty detector.
+
+    The supervised MLP learns the labeled attack kinds; the novelty
+    half (Mahalanobis distance over flow features, fit on BENIGN
+    traffic only — no label leakage) flags deviations from the benign
+    manifold, which is what generalizes to attack kinds never seen in
+    training (the held-out-kind evaluation)."""
+
     embed: jnp.ndarray  # [V, D] identity embedding table
     w1: jnp.ndarray  # [D + FEAT_DIM, H]
     b1: jnp.ndarray
@@ -30,10 +47,12 @@ class AnomalyModel:
     b2: jnp.ndarray
     w3: jnp.ndarray  # [H, 1]
     b3: jnp.ndarray
+    feat_mean: jnp.ndarray  # [FEAT_DIM] benign feature mean
+    feat_prec: jnp.ndarray  # [FEAT_DIM, FEAT_DIM] benign precision
+    nov_thresh: jnp.ndarray  # [] benign d2 high quantile
 
     def tree_flatten(self):
-        return ((self.embed, self.w1, self.b1, self.w2, self.b2,
-                 self.w3, self.b3), None)
+        return (tuple(getattr(self, f) for f in _FIELDS), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -84,6 +103,9 @@ def init_params(rng: jax.Array, n_rows: int, dim: int = 32,
         b2=jnp.zeros(hidden),
         w3=jax.random.normal(k3, (hidden, 1)) * (2.0 / hidden) ** 0.5,
         b3=jnp.zeros(1),
+        feat_mean=jnp.zeros(FEAT_DIM),
+        feat_prec=jnp.zeros((FEAT_DIM, FEAT_DIM)),
+        nov_thresh=jnp.asarray(NOV_DISABLED, dtype=jnp.float32),
     )
 
 
@@ -110,15 +132,68 @@ def bce_loss(params: AnomalyModel, id_row: jnp.ndarray,
         + jnp.log1p(jnp.exp(-jnp.abs(logits))))
 
 
+def novelty_d2(params: AnomalyModel, feats: jnp.ndarray) -> jnp.ndarray:
+    """Mahalanobis distance^2 of each row from the benign manifold."""
+    d = feats - params.feat_mean
+    return jnp.einsum("nf,fg,ng->n", d, params.feat_prec, d)
+
+
+def score_packets(params: AnomalyModel, id_row: jnp.ndarray,
+                  feats: jnp.ndarray) -> jnp.ndarray:
+    """Per-packet anomaly score in [0, 1]: the max of the supervised
+    probability and the benign-novelty score (each catches what the
+    other misses — the novelty half is what fires on attack kinds
+    absent from training)."""
+    p = jax.nn.sigmoid(forward(params, id_row, feats))
+    d2 = novelty_d2(params, feats)
+    scale = params.nov_thresh * 0.25 + 1e-6
+    nov = jax.nn.sigmoid((d2 - params.nov_thresh) / scale)
+    # unfitted stats (NOV_DISABLED sentinel): the novelty branch must
+    # contribute EXACTLY zero, or max() floors every low supervised
+    # score at sigmoid(-4) and collapses their ranking
+    nov = jnp.where(params.nov_thresh >= NOV_DISABLED, 0.0, nov)
+    return jnp.maximum(p, nov)
+
+
+def fit_novelty(params: AnomalyModel, feats: np.ndarray,
+                ridge: float = 1e-3,
+                quantile: float = 0.995) -> AnomalyModel:
+    """Fit the benign novelty stats from a benign feature sample
+    (labels never consulted): mean + ridge-regularized precision +
+    the d2 threshold at the given benign quantile."""
+    from dataclasses import replace
+
+    x = np.asarray(feats, dtype=np.float64)
+    mu = x.mean(axis=0)
+    xc = x - mu
+    cov = xc.T @ xc / max(len(x) - 1, 1)
+    cov += ridge * np.eye(cov.shape[0])
+    prec = np.linalg.inv(cov)
+    d2 = np.einsum("nf,fg,ng->n", xc, prec, xc)
+    thresh = float(np.quantile(d2, quantile))
+    return replace(
+        params,
+        feat_mean=jnp.asarray(mu, dtype=jnp.float32),
+        feat_prec=jnp.asarray(prec, dtype=jnp.float32),
+        nov_thresh=jnp.asarray(max(thresh, 1e-3), dtype=jnp.float32))
+
+
 def save_model(path: str, params: AnomalyModel) -> None:
     """Persist to .npz (part of the agent checkpoint family)."""
     np.savez_compressed(
         path, **{k: np.asarray(v) for k, v in zip(
-            ("embed", "w1", "b1", "w2", "b2", "w3", "b3"),
-            params.tree_flatten()[0])})
+            _FIELDS, params.tree_flatten()[0])})
 
 
 def load_model(path: str) -> AnomalyModel:
     z = np.load(path)
-    return AnomalyModel(*(jnp.asarray(z[k]) for k in
-                          ("embed", "w1", "b1", "w2", "b2", "w3", "b3")))
+    kw = {}
+    for k in _FIELDS:
+        if k in z.files:
+            kw[k] = jnp.asarray(z[k])
+    # pre-novelty checkpoints: supervised-only scoring
+    kw.setdefault("feat_mean", jnp.zeros(FEAT_DIM))
+    kw.setdefault("feat_prec", jnp.zeros((FEAT_DIM, FEAT_DIM)))
+    kw.setdefault("nov_thresh", jnp.asarray(NOV_DISABLED,
+                                            dtype=jnp.float32))
+    return AnomalyModel(**kw)

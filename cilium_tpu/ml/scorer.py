@@ -16,7 +16,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..monitor.api import EventBatch
-from .model import AnomalyModel, forward
+from .model import AnomalyModel, score_packets
 
 
 class AnomalyScorer:
@@ -30,7 +30,7 @@ class AnomalyScorer:
         self.row_of_identity = row_of_identity
         self.threshold = threshold
         self.top_k = top_k
-        self._fwd = jax.jit(forward)
+        self._score = jax.jit(score_packets)
         self._lock = threading.Lock()
         self.scored = 0
         self.flagged = 0
@@ -58,8 +58,7 @@ class AnomalyScorer:
         ], axis=1)
         id_row, feats = flow_features(jnp.asarray(batch.hdr),
                                       jnp.asarray(out_cols))
-        logits = np.asarray(self._fwd(self.params, id_row, feats))
-        scores = 1.0 / (1.0 + np.exp(-logits))
+        scores = np.asarray(self._score(self.params, id_row, feats))
         hot = np.nonzero(scores >= self.threshold)[0]
         with self._lock:
             self.scored += len(scores)

@@ -45,10 +45,23 @@ except ImportError:  # pragma: no cover
 from jax.sharding import Mesh, PartitionSpec as P
 
 
+ATTACK_KINDS = {0: "portscan", 1: "flood", 2: "exfil"}
+
+
 def synth_labeled_traffic(world, n: int, rng: np.random.Generator,
-                          attack_frac: float = 0.25
+                          attack_frac: float = 0.25,
+                          kinds: Tuple[int, ...] = (0, 1, 2),
+                          hard_negatives: bool = True,
                           ) -> Tuple[np.ndarray, np.ndarray]:
-    """-> (hdr [n, N_COLS] uint32, labels [n] float32 1=attack)."""
+    """-> (hdr [n, N_COLS] uint32, labels [n] float32 1=attack).
+
+    ``kinds`` restricts which attack kinds appear (held-out-kind
+    evaluation trains on a subset and tests generalization on the
+    rest).  ``hard_negatives`` injects BENIGN traffic that resembles
+    attacks along single features — reconnect storms (SYN bursts to a
+    real service) and bulk transfers (MTU-size pushes on a well-known
+    port) — so separability must come from feature conjunctions, not
+    one trivial column."""
     import ipaddress
 
     from ..testing.fixtures import bench_traffic
@@ -57,18 +70,18 @@ def synth_labeled_traffic(world, n: int, rng: np.random.Generator,
     labels = np.zeros(n, dtype=np.float32)
     n_attack = int(n * attack_frac)
     idx = rng.choice(n, n_attack, replace=False)
-    kinds = rng.integers(0, 3, n_attack)
+    kind_of = rng.choice(np.asarray(kinds, dtype=np.int64), n_attack)
     ips = np.array([int(ipaddress.IPv4Address(ip))
                     for ip in world.pod_ips], dtype=np.uint32)
     scanner = ips[0]
     victim = ips[1]
-    for i, kind in zip(idx, kinds):
+    for i, kind in zip(idx, kind_of):
         labels[i] = 1.0
         if kind == 0:  # port scan: tiny SYNs sweeping the port space
-            hdr[i, COL_SRC_IP3] = scanner
+            hdr[i, COL_SRC_IP3] = rng.choice(ips[:8])  # several scanners
             hdr[i, COL_DPORT] = rng.integers(1, 65535)
             hdr[i, COL_FLAGS] = TCP_SYN
-            hdr[i, COL_LEN] = 40
+            hdr[i, COL_LEN] = rng.integers(40, 60)
             hdr[i, COL_PROTO] = 6
         elif kind == 1:  # flood: spoofed sources hammering one service
             hdr[i, COL_SRC_IP3] = rng.choice(ips)
@@ -83,6 +96,23 @@ def synth_labeled_traffic(world, n: int, rng: np.random.Generator,
             hdr[i, COL_FLAGS] = TCP_ACK | 0x08  # PSH|ACK
             hdr[i, COL_LEN] = rng.integers(1400, 1500)
             hdr[i, COL_PROTO] = 6
+    if hard_negatives:
+        # benign rows that share single attack features
+        benign = np.nonzero(labels == 0)[0]
+        n_hard = len(benign) // 5
+        hard = rng.choice(benign, n_hard, replace=False)
+        half = n_hard // 2
+        # reconnect storm: SYNs to a real service port, normal sizes
+        storm = hard[:half]
+        hdr[storm, COL_DPORT] = 5432
+        hdr[storm, COL_FLAGS] = TCP_SYN
+        hdr[storm, COL_LEN] = rng.integers(52, 80, len(storm))
+        # bulk transfer: MTU-size PSH|ACK egress on a well-known port
+        bulk = hard[half:]
+        hdr[bulk, COL_DIR] = 1
+        hdr[bulk, COL_DPORT] = 443
+        hdr[bulk, COL_FLAGS] = TCP_ACK | 0x08
+        hdr[bulk, COL_LEN] = rng.integers(1400, 1500, len(bulk))
     return hdr, labels
 
 
@@ -116,10 +146,12 @@ def make_train_step(optimizer, mesh: Optional[Mesh] = None,
 def train(params: AnomalyModel, world, steps: int = 200,
           batch: int = 4096, lr: float = 3e-3,
           mesh: Optional[Mesh] = None, seed: int = 0,
-          now: int = 1000) -> Tuple[AnomalyModel, list]:
+          now: int = 1000,
+          kinds: Tuple[int, ...] = (0, 1, 2)) -> Tuple[AnomalyModel, list]:
     """Train on synthetic labeled traffic run through the real
     datapath (features include CT state, so the model sees what the
-    device sees)."""
+    device sees).  ``kinds`` restricts the attack kinds seen in
+    training (held-out-kind evaluation)."""
     from ..datapath.verdict import datapath_step
     from .features import flow_features
 
@@ -131,7 +163,8 @@ def train(params: AnomalyModel, world, steps: int = 200,
     state = world.state
     losses = []
     for s in range(steps):
-        hdr, labels = synth_labeled_traffic(world, batch, rng)
+        hdr, labels = synth_labeled_traffic(world, batch, rng,
+                                            kinds=kinds)
         jhdr = jnp.asarray(hdr)
         out, state = dp_step(state, jhdr, jnp.uint32(now + s))
         id_row, feats = flow_features(jhdr, out)

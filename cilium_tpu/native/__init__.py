@@ -146,7 +146,10 @@ def parse_frames_packed(buf: bytes, out: Optional[np.ndarray] = None
         return None
     if out is None:
         out = np.empty((max(len(buf) // 24, 1), 4), dtype=np.uint32)
-    assert out.dtype == np.uint32 and out.flags["C_CONTIGUOUS"]
+    if out.dtype != np.uint32 or not out.flags["C_CONTIGUOUS"]:
+        # a bare assert would vanish under python -O and hand the raw
+        # pointer of a wrong-dtype/strided buffer to C
+        raise ValueError("out must be a C-contiguous uint32 array")
     skipped = ctypes.c_long(0)
     overflow = ctypes.c_long(0)
     n = lib.parse_frames_packed(
@@ -197,10 +200,7 @@ def parse_frames_py(buf: bytes, ep: int = 0,
     native-vs-python equivalence tests."""
     import struct
 
-    from ..core.pcap import _parse_ip, _parse_l4
-    from ..core.packets import (COL_DIR, COL_DPORT, COL_DST_IP0, COL_EP,
-                                COL_FAMILY, COL_FLAGS, COL_LEN,
-                                COL_PROTO, COL_SPORT, COL_SRC_IP0)
+    from ..core.pcap import _parse_ip, build_row
 
     rows = []
     off = 0
@@ -223,20 +223,7 @@ def parse_frames_py(buf: bytes, ep: int = 0,
         parsed = _parse_ip(frame[l3:])
         if parsed is None:
             continue
-        fam, src, dst, proto, l4, ip_len = parsed
-        sport, dport, flags = _parse_l4(proto, l4)
-        row = np.zeros(N_COLS, dtype=np.uint32)
-        row[COL_SRC_IP0:COL_SRC_IP0 + 4] = np.frombuffer(src, dtype=">u4")
-        row[COL_DST_IP0:COL_DST_IP0 + 4] = np.frombuffer(dst, dtype=">u4")
-        row[COL_SPORT] = sport
-        row[COL_DPORT] = dport
-        row[COL_PROTO] = proto
-        row[COL_FLAGS] = flags
-        row[COL_LEN] = ip_len
-        row[COL_FAMILY] = fam
-        row[COL_EP] = ep
-        row[COL_DIR] = direction
-        rows.append(row)
+        rows.append(build_row(parsed, ep, direction))
     if not rows:
         return np.zeros((0, N_COLS), dtype=np.uint32)
     return np.stack(rows)

@@ -30,10 +30,47 @@ inline uint16_t be16(const uint8_t* p) {
     return uint16_t((p[0] << 8) | p[1]);
 }
 
+constexpr uint32_t FLAG_RELATED = 0x100;  // core/packets.py
+constexpr uint16_t VXLAN_PORT = 8472;
+constexpr uint16_t GENEVE_PORT = 6081;
+
+// VXLAN/Geneve UDP payload -> inner IP packet, or nullptr.
+const uint8_t* decap_overlay(uint32_t proto, const uint8_t* l4,
+                             long l4_len, long* inner_len) {
+    if (proto != 17 || l4_len < 8) return nullptr;
+    const uint16_t dport = be16(l4 + 2);
+    const uint8_t* p = l4 + 8;
+    long n = l4_len - 8;
+    long hdr;
+    if (dport == VXLAN_PORT) {
+        hdr = 8;  // flags + VNI
+    } else if (dport == GENEVE_PORT) {
+        if (n < 8) return nullptr;
+        hdr = 8 + (p[0] & 0x3F) * 4;
+    } else {
+        return nullptr;
+    }
+    if (n < hdr + 14) return nullptr;
+    const uint8_t* eth = p + hdr;
+    const uint16_t ethertype = be16(eth + 12);
+    if (ethertype != 0x0800 && ethertype != 0x86DD) return nullptr;
+    *inner_len = n - hdr - 14;
+    return eth + 14;
+}
+
+inline bool icmp_is_error(uint32_t proto, uint8_t type) {
+    if (proto == 1)
+        return type == 3 || type == 4 || type == 5 || type == 11 ||
+               type == 12;
+    if (proto == 58) return type >= 1 && type <= 4;
+    return false;
+}
+
 // Parse one IP packet (no link header) into a header row.
-// Returns true when the row was produced.
+// Returns true when the row was produced.  depth bounds overlay decap
+// recursion to match the Python reference (core/pcap.py: 2 levels).
 bool parse_ip(const uint8_t* pkt, long len, uint32_t* row, uint32_t ep,
-              uint32_t dir) {
+              uint32_t dir, int depth = 0) {
     if (len < 20) return false;
     const int ver = pkt[0] >> 4;
     uint32_t proto, ip_len, fam;
@@ -62,6 +99,18 @@ bool parse_ip(const uint8_t* pkt, long len, uint32_t* row, uint32_t ep,
     } else {
         return false;
     }
+    // overlay decap: the row carries the INNER packet (bounded depth)
+    if (depth < 2) {
+        long inner_len;
+        const uint8_t* inner = decap_overlay(proto, l4, l4_len,
+                                             &inner_len);
+        if (inner) {
+            if (parse_ip(inner, inner_len, row, ep, dir, depth + 1))
+                return true;
+            // unparseable inner: fall through to the outer row,
+            // matching the Python reference
+        }
+    }
     uint32_t sport = 0, dport = 0, flags = 0;
     if ((proto == 6 || proto == 17 || proto == 132) && l4_len >= 4) {
         sport = be16(l4);
@@ -69,6 +118,69 @@ bool parse_ip(const uint8_t* pkt, long len, uint32_t* row, uint32_t ep,
         if (proto == 6 && l4_len >= 14) flags = l4[13];
     } else if ((proto == 1 || proto == 58) && l4_len >= 2) {
         dport = l4[0];  // ICMP type rides the dport column
+        // ICMP ERROR: relate to the embedded original packet — the
+        // row carries the INNER tuple + FLAG_RELATED (matches
+        // core/pcap.py build_row)
+        if (icmp_is_error(proto, l4[0]) && l4_len >= 8 + 20) {
+            const uint8_t* in = l4 + 8;
+            const long in_len = l4_len - 8;
+            const int iver = in[0] >> 4;
+            if (iver == 4 && fam == 4 && in_len >= 20) {
+                const int iihl = (in[0] & 0xF) * 4;
+                if (iihl >= 20 && in_len >= iihl) {
+                    const uint32_t iproto = in[9];
+                    uint32_t isp = 0, idp = 0;
+                    const uint8_t* il4 = in + iihl;
+                    const long il4_len = in_len - iihl;
+                    if ((iproto == 6 || iproto == 17 || iproto == 132)
+                        && il4_len >= 4) {
+                        isp = be16(il4);
+                        idp = be16(il4 + 2);
+                    } else if ((iproto == 1 || iproto == 58)
+                               && il4_len >= 2) {
+                        idp = il4[0];
+                    }
+                    row[0] = row[1] = row[2] = 0;
+                    row[3] = be32(in + 12);
+                    row[4] = row[5] = row[6] = 0;
+                    row[7] = be32(in + 16);
+                    row[8] = isp;
+                    row[9] = idp;
+                    row[10] = iproto;
+                    row[11] = FLAG_RELATED;
+                    row[12] = ip_len;
+                    row[13] = fam;
+                    row[14] = ep;
+                    row[15] = dir;
+                    return true;
+                }
+            } else if (iver == 6 && fam == 6 && in_len >= 40) {
+                const uint32_t iproto = in[6];
+                uint32_t isp = 0, idp = 0;
+                const uint8_t* il4 = in + 40;
+                const long il4_len = in_len - 40;
+                if ((iproto == 6 || iproto == 17 || iproto == 132)
+                    && il4_len >= 4) {
+                    isp = be16(il4);
+                    idp = be16(il4 + 2);
+                } else if ((iproto == 1 || iproto == 58)
+                           && il4_len >= 2) {
+                    idp = il4[0];
+                }
+                for (int w = 0; w < 4; ++w) row[w] = be32(in + 8 + 4 * w);
+                for (int w = 0; w < 4; ++w)
+                    row[4 + w] = be32(in + 24 + 4 * w);
+                row[8] = isp;
+                row[9] = idp;
+                row[10] = iproto;
+                row[11] = FLAG_RELATED;
+                row[12] = ip_len;
+                row[13] = fam;
+                row[14] = ep;
+                row[15] = dir;
+                return true;
+            }
+        }
     }
     row[8] = sport;
     row[9] = dport;
@@ -186,18 +298,43 @@ long parse_frames_packed(const uint8_t* buf, long buf_len, uint32_t* out,
         off += flen;
         if (!p || ip_len < 20 || (p[0] >> 4) != 4) { ++skipped; continue; }
         if (rows >= max_rows) { ++overflow; continue; }
-        const int ihl = (p[0] & 0xF) * 4;
+        int ihl = (p[0] & 0xF) * 4;
         if (ip_len < ihl || ihl < 20) { ++skipped; continue; }
-        const uint32_t proto = p[9];
-        uint32_t sport = 0, dport = 0, flags = 0;
+        uint32_t proto = p[9];
         const uint8_t* l4 = p + ihl;
-        const long l4_len = ip_len - ihl;
+        long l4_len = ip_len - ihl;
+        // overlay decap (v4-in-v4 only on the fast path; depth 2 to
+        // match the wide/Python parsers)
+        bool drop = false;
+        for (int d = 0; d < 2; ++d) {
+            long inner_len;
+            const uint8_t* inner = decap_overlay(proto, l4, l4_len,
+                                                 &inner_len);
+            if (!inner) break;
+            if (inner_len < 20 || (inner[0] >> 4) != 4) {
+                drop = true;  // v6-in-v4 overlay: wide path only
+                break;
+            }
+            p = inner;
+            ip_len = inner_len;
+            ihl = (p[0] & 0xF) * 4;
+            if (ip_len < ihl || ihl < 20) { drop = true; break; }
+            proto = p[9];
+            l4 = p + ihl;
+            l4_len = ip_len - ihl;
+        }
+        if (drop) { ++skipped; continue; }
+        uint32_t sport = 0, dport = 0, flags = 0;
         if ((proto == 6 || proto == 17 || proto == 132) && l4_len >= 4) {
             sport = be16(l4);
             dport = be16(l4 + 2);
             if (proto == 6 && l4_len >= 14) flags = l4[13];
-        } else if (proto == 1 && l4_len >= 2) {
-            dport = l4[0];
+        } else if ((proto == 1 || proto == 58) && l4_len >= 2) {
+            dport = l4[0];  // ICMP/ICMPv6 type rides the dport column
+            // NOTE: ICMP-error RELATED extraction is wide-path only
+            // (the packed format has no flag bit for it); adapters
+            // needing RELATED on the fast path shunt ICMP to the
+            // wide parser (core/packets.py FLAG_RELATED)
         }
         uint32_t* w = out + rows * 4;
         w[0] = be32(p + 12);

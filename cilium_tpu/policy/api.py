@@ -148,6 +148,14 @@ ENTITY_SELECTORS: Dict[str, EndpointSelector] = {
 # L4 / L7
 
 
+import re as _re
+
+# k8s IANA_SVC_NAME: lowercase alnum + '-', <=15 chars, at least one
+# letter, no leading/trailing/double '-'
+_NAMED_PORT_RE = _re.compile(
+    r"(?=.*[a-z])(?!-)(?!.*--)[a-z0-9-]{1,15}(?<!-)")
+
+
 @dataclass(frozen=True)
 class PortProtocol:
     """One port+protocol spec.
@@ -171,18 +179,26 @@ class PortProtocol:
     @staticmethod
     def from_dict(d: dict) -> "PortProtocol":
         """Parse + sanitize (reference: api.Rule.Sanitize rejects bad
-        ports at import time, not resolve time)."""
+        ports at import time, not resolve time).  Named ports (k8s
+        IANA_SVC_NAME: lowercase alphanumeric + '-', <= 15 chars, at
+        least one letter) are kept symbolic and resolved against the
+        endpoint port registry at resolve time."""
         port = str(d.get("port", "0"))
+        end_port = int(d.get("endPort", 0))
         try:
             port_num = int(port or 0)
         except ValueError:
-            raise ValueError(
-                f"invalid port {port!r}: named ports are not supported; "
-                "use a numeric port") from None
-        if not 0 <= port_num <= 65535:
+            if not _NAMED_PORT_RE.fullmatch(port):
+                raise ValueError(
+                    f"invalid port {port!r}: not numeric and not a "
+                    "valid named port") from None
+            if end_port:
+                raise ValueError("endPort cannot combine with a named "
+                                 f"port {port!r}")
+            port_num = None
+        if port_num is not None and not 0 <= port_num <= 65535:
             raise ValueError(f"port {port_num} out of range")
-        end_port = int(d.get("endPort", 0))
-        if end_port and end_port < port_num:
+        if end_port and port_num is not None and end_port < port_num:
             raise ValueError(
                 f"endPort {end_port} must be >= port {port_num}")
         protocol = str(d.get("protocol", "ANY")).upper()
@@ -198,11 +214,30 @@ class PortProtocol:
                             icmp_type=(int(icmp_type)
                                        if icmp_type is not None else None))
 
-    def port_range(self) -> Tuple[int, int]:
-        """Resolve to an inclusive [lo, hi] numeric port range."""
+    @property
+    def is_named(self) -> bool:
+        try:
+            int(self.port or 0)
+            return False
+        except ValueError:
+            return True
+
+    def port_range(self, named_ports=None) -> Optional[Tuple[int, int]]:
+        """Resolve to an inclusive [lo, hi] numeric port range.
+
+        A named port resolves through ``named_ports`` (name -> number,
+        the endpoint port registry); unresolvable names return None
+        and the spec matches nothing (reference: policy with unknown
+        named ports selects no traffic until a pod defines the name)."""
         if self.icmp_type is not None:
             return (self.icmp_type, self.icmp_type)
-        p = int(self.port or 0)
+        try:
+            p = int(self.port or 0)
+        except ValueError:
+            num = (named_ports or {}).get(self.port)
+            if num is None:
+                return None
+            return (int(num), int(num))
         if p == 0:
             return (0, 65535)
         return (p, self.end_port if self.end_port else p)
@@ -444,10 +479,20 @@ def rule_from_dict(d: dict) -> Rule:
 
 
 def rules_from_obj(obj) -> List[Rule]:
-    """Accept a single rule dict or a list (cilium policy import format)."""
+    """Accept a single rule dict, a list of rules, or a
+    CiliumNetworkPolicy object (`cilium policy import` takes all
+    three; CNPs route through the k8s translation layer)."""
     if isinstance(obj, dict):
+        if obj.get("kind") in ("CiliumNetworkPolicy",
+                               "CiliumClusterwideNetworkPolicy"):
+            from ..k8s import rules_from_cnp
+
+            return rules_from_cnp(obj)
         return [rule_from_dict(obj)]
-    return [rule_from_dict(d) for d in obj]
+    out: List[Rule] = []
+    for d in obj:
+        out.extend(rules_from_obj(d))
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -81,17 +81,33 @@ class IdentityRowMap:
         self._num_to_row: Dict[int, int] = {0: 0}
         self._row_to_num = np.zeros(capacity, dtype=np.int64)
         self._next = 1
+        self._free: List[int] = []  # recycled rows (identity released)
 
     def add(self, numeric_id: int) -> int:
         row = self._num_to_row.get(numeric_id)
         if row is not None:
             return row
-        if self._next >= self.capacity:
-            self._grow()
-        row = self._next
-        self._next += 1
+        if self._free:
+            row = self._free.pop()
+        else:
+            if self._next >= self.capacity:
+                self._grow()
+            row = self._next
+            self._next += 1
         self._num_to_row[numeric_id] = row
         self._row_to_num[row] = numeric_id
+        return row
+
+    def remove(self, numeric_id: int) -> Optional[int]:
+        """Recycle a released identity's row (fqdn/identity churn must
+        not grow the verdict tensor without bound).  Callers free a
+        row ONLY after its tensor contents were reset to defaults and
+        no LPM entry references it."""
+        row = self._num_to_row.pop(numeric_id, None)
+        if row is None or row == 0:
+            return None
+        self._row_to_num[row] = 0
+        self._free.append(row)
         return row
 
     def _grow(self) -> None:

@@ -32,6 +32,10 @@ class PolicyRepository:
         # distillery: subject labels key -> resolved policy @ revision
         self._cache: Dict[str, EndpointPolicy] = {}
         self._listeners: List[Callable[[int], None]] = []
+        # name -> numeric port, fed by the endpoint manager's registry
+        # (reference: named ports resolve against pod container ports)
+        self.named_ports_getter: Optional[Callable[[], Dict[str, int]]] \
+            = None
 
     # -- mutation --------------------------------------------------------
     def add_list(self, rules: Sequence[Rule]) -> int:
@@ -94,8 +98,11 @@ class PolicyRepository:
             pol = self._cache.get(key)
             if pol is not None and pol.revision == self._revision:
                 return pol
+            named = (self.named_ports_getter()
+                     if self.named_ports_getter else None)
             pol = resolve_policy(self._rules, subject_labels,
                                  self.selector_cache, self.allocator,
-                                 revision=self._revision)
+                                 revision=self._revision,
+                                 named_ports=named)
             self._cache[key] = pol
             return pol

@@ -159,8 +159,12 @@ def _peer_identities(
                    fqdn_patterns=tuple(patterns))
 
 
-def _port_specs(to_ports: Sequence[PortRule]):
-    """Expand toPorts into (dense_proto, lo, hi, l7_rules|None) tuples."""
+def _port_specs(to_ports: Sequence[PortRule], named_ports=None):
+    """Expand toPorts into (dense_proto, lo, hi, l7_rules|None) tuples.
+
+    ``named_ports`` (name -> number) resolves symbolic ports; a name
+    with no mapping contributes nothing (matches upstream: the rule is
+    inert until some endpoint defines the port name)."""
     if not to_ports:
         return [(PROTO_ANY, 0, 65535, None)]
     out = []
@@ -177,7 +181,10 @@ def _port_specs(to_ports: Sequence[PortRule]):
                 out.append((PROTO_ANY, 0, 65535, None))
             continue
         for pp in ports:
-            lo, hi = pp.port_range()
+            rng = pp.port_range(named_ports)
+            if rng is None:
+                continue  # unresolved named port: matches nothing
+            lo, hi = rng
             proto = PROTO_BY_NAME.get(pp.protocol, PROTO_ANY)
             if proto == PROTO_ANY:
                 for p in (PROTO_TCP, PROTO_UDP, PROTO_SCTP):
@@ -194,6 +201,7 @@ def resolve_policy(
     allocator: CachingIdentityAllocator,
     revision: int = 0,
     proxy_port_for=None,
+    named_ports=None,
 ) -> EndpointPolicy:
     """Resolve the rule set down to per-direction MapStates for a subject.
 
@@ -224,7 +232,7 @@ def resolve_policy(
 
         def emit(ms: MapState, peers: PeerSet,
                  to_ports, is_deny: bool) -> None:
-            for proto, lo, hi, l7 in _port_specs(to_ports):
+            for proto, lo, hi, l7 in _port_specs(to_ports, named_ports):
                 redirect = l7 is not None and not is_deny
                 proxy_port = 0
                 if redirect:

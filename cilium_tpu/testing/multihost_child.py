@@ -1,0 +1,70 @@
+"""Multi-process (jax.distributed) sharded-datapath child.
+
+Run as ``python -m cilium_tpu.testing.multihost_child <coordinator>
+<num_processes> <process_id> <devices_per_process>``: joins the
+distributed runtime on the CPU backend, builds the GLOBAL 1-D mesh
+over every process's virtual devices, and runs one step of the full
+sharded datapath (batch sharded, CT sharded, tables replicated,
+counters psum-replicated over ICI/DCN — here the TCP transport
+jax.distributed provides).
+
+This is the ClusterMesh/multi-host axis of SURVEY.md §2c validated
+without multi-host hardware: 2 processes x 4 virtual devices = the
+same program a 2-host x 4-chip pod slice runs.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, n_proc, pid, dev_per_proc = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=n_proc, process_id=pid)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cilium_tpu.parallel import (
+        make_mesh,
+        make_sharded_step,
+        route_by_flow,
+        shard_state,
+    )
+    from cilium_tpu.testing.fixtures import build_world, bench_traffic
+
+    n_devices = n_proc * dev_per_proc
+    assert len(jax.devices()) == n_devices, (
+        len(jax.devices()), n_devices)
+    # identical world on every process (deterministic build): the
+    # replicated tables agree byte-for-byte, like kvstore-synced agents
+    world = build_world(n_identities=64, n_rules=8,
+                        ct_capacity=(1 << 10) * n_devices,
+                        ct_shards=n_devices)
+    mesh = make_mesh(n_devices)
+    state = shard_state(world.state, mesh)
+    step = make_sharded_step(mesh)
+
+    rng = np.random.default_rng(7)  # same seed everywhere
+    batch = bench_traffic(world, 32 * n_devices, rng)
+    routed, valid, _, ovf = route_by_flow(batch, n_devices)
+    out, state = step(state, jnp.asarray(routed), jnp.uint32(10),
+                      jnp.asarray(valid))
+    out.block_until_ready()
+    # metrics are psum-replicated: every process sees the GLOBAL count
+    metrics = np.asarray(state.metrics)
+    print(json.dumps({
+        "process": pid,
+        "n_devices": n_devices,
+        "forwarded": int(metrics[0].sum()),
+        "dropped": int(metrics[1:].sum()),
+        "overflow": ovf,
+    }))
+
+
+if __name__ == "__main__":
+    main()

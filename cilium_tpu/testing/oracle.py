@@ -29,6 +29,7 @@ from ..core.packets import (
     COL_PROTO,
     COL_SPORT,
     COL_SRC_IP0,
+    FLAG_RELATED,
     TCP_FIN,
     TCP_RST,
     HeaderBatch,
@@ -37,6 +38,7 @@ from ..core.packets import (
 from ..datapath.conntrack import (
     CT_ESTABLISHED,
     CT_NEW,
+    CT_RELATED,
     CT_REPLY,
     LIFETIME_CLOSE,
     LIFETIME_NONTCP,
@@ -155,7 +157,18 @@ class OracleDatapath:
             fwd = self._tuple(row)
             entry = self.ct.get(fwd)
             is_reply = False
-            if entry is not None and entry.expires >= now:
+            related = bool(int(row[COL_FLAGS]) & FLAG_RELATED)
+            if related:
+                # ICMP error carrying the embedded original tuple:
+                # probe that tuple under BOTH hook directions (the
+                # datapath's related rev-key flips only the dir bit)
+                if entry is None or entry.expires < now:
+                    entry = self.ct.get(fwd[:5] + (1 - fwd[5],))
+                if entry is not None and entry.expires >= now:
+                    ct_res = CT_RELATED
+                else:
+                    ct_res, entry = CT_NEW, None
+            elif entry is not None and entry.expires >= now:
                 ct_res = CT_ESTABLISHED
             else:
                 rentry = self.ct.get(self._rev(fwd))
@@ -169,7 +182,8 @@ class OracleDatapath:
             p_verdict, p_proxy = pol.lookup(dirn, ident, proto_idx,
                                             int(row[COL_DPORT]))
             if ct_res != CT_NEW:
-                proxy = entry.proxy
+                # a related ICMP error is forwarded, never redirected
+                proxy = 0 if ct_res == CT_RELATED else entry.proxy
                 verdict = VERDICT_REDIRECT if proxy > 0 else VERDICT_ALLOW
                 reason = REASON_FORWARDED
                 event = EV_TRACE
@@ -188,12 +202,14 @@ class OracleDatapath:
                                         reason, event))
             allowed = reason == REASON_FORWARDED
             updates.append((fwd, row, is_reply, ct_res, proxy if allowed
-                            else 0, allowed))
+                            else 0, allowed, related))
         # phase 2: apply CT updates
         from ..datapath.conntrack import (ST_CLOSING, ST_ESTABLISHED,
                                           ST_SYN_SENT)
-        for fwd, row, is_reply, ct_res, proxy, allowed in (
-                (u[0], u[1], u[2], u[3], u[4], u[5]) for u in updates):
+        for fwd, row, is_reply, ct_res, proxy, allowed, related in (
+                updates):
+            if related or ct_res == CT_RELATED:
+                continue  # ICMP errors neither create nor refresh
             proto = int(row[COL_PROTO])
             flags = int(row[COL_FLAGS])
             is_tcp = proto == 6

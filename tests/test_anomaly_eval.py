@@ -16,24 +16,31 @@ from cilium_tpu.ml.evaluate import (
 
 
 def test_train_and_evaluate_end_to_end(tmp_path):
-    """Small config: the full pipeline must clear AUC 0.9 on the
-    synthetic attack mix (scans/floods/exfil vs steady-state)."""
+    """Small config: the pipeline's headline is now the HELD-OUT
+    attack-kind AUC (training never saw that kind); the supervised
+    half covers the trained kinds and the benign-novelty half must
+    carry the held-out one."""
     result = train_and_evaluate(n_identities=128, train_steps=40,
                                 train_batch=1024, eval_packets=8192,
                                 model_out=str(tmp_path / "m.npz"),
                                 workdir=str(tmp_path))
-    assert result["anomaly_auc"] > 0.9
-    assert result["packets"] == 8192
+    assert result["holdout_kind"] == "exfil"
+    assert result["holdout_kind"] not in result["train_kinds"]
+    assert result["auc_heldout_kind"] > 0.9  # generalization, honest
+    for kind in result["train_kinds"]:
+        assert result["auc_by_kind"][kind] > 0.95
+    assert result["auc_same_mix_smoke"] > 0.95
     assert (tmp_path / "m.npz").exists()
-    # the model artifact reloads and scores the same capture
+    # the model artifact reloads (incl. novelty stats) and re-scores
+    # the held-out capture
     from cilium_tpu.ml.model import load_model
     from cilium_tpu.testing.fixtures import build_world
 
     world = build_world(n_identities=128, n_rules=16,
                         ct_capacity=1 << 14)
+    sidecar = result["eval_pcap"].replace(".pcap", ".npz")
     again = evaluate_capture(load_model(str(tmp_path / "m.npz")), world,
-                             str(tmp_path / "eval.pcap"),
-                             str(tmp_path / "eval_labels.npz"))
+                             result["eval_pcap"], sidecar)
     assert again["anomaly_auc"] > 0.9
 
 

@@ -1,0 +1,161 @@
+"""Distributed control plane (SURVEY.md §2c rows 33-34, VERDICT r02
+item 4): two daemons sharing one kvstore agree on identity numerics
+through the distributed allocator, replicate each other's allocations
+by watch, and enforce consistently.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.kvstore import InMemoryKVStore, KVStoreAllocatorBackend
+from cilium_tpu.labels import LabelSet
+
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [
+        {"fromEndpoints": [{"matchLabels": {"role": "web"}}],
+         "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+    ],
+}]
+
+
+class TestKVStoreBackend:
+    def test_same_key_same_id_across_nodes(self):
+        kv = InMemoryKVStore()
+        a = KVStoreAllocatorBackend(kv, node="a")
+        b = KVStoreAllocatorBackend(kv, node="b")
+        ida = a.allocate("k8s:app=web;")
+        idb = b.allocate("k8s:app=web;")
+        assert ida == idb
+        assert a.allocate("k8s:app=db;") != ida
+
+    def test_claim_race_is_collision_free(self):
+        """Two backends interleaving claims never hand out one id for
+        two different keys (the create_only master key is the atomic
+        claim)."""
+        kv = InMemoryKVStore()
+        a = KVStoreAllocatorBackend(kv, node="a")
+        b = KVStoreAllocatorBackend(kv, node="b")
+        ids = {}
+        for i in range(20):
+            backend = a if i % 2 else b
+            ids[f"key{i}"] = backend.allocate(f"key{i};")
+        assert len(set(ids.values())) == 20
+
+    def test_release_then_reallocate_keeps_numeric(self):
+        """r03 review: releasing every node ref and re-allocating the
+        same key must reuse the surviving MASTER key's numeric (until
+        GC sweeps it), or nodes that replayed the master diverge."""
+        kv = InMemoryKVStore()
+        a = KVStoreAllocatorBackend(kv, node="a")
+        num = a.allocate("k8s:app=web;")
+        a.release("k8s:app=web;")
+        assert a.allocate("k8s:app=web;") == num
+
+    def test_watch_holder_takes_ref_on_first_use(self):
+        """r03 review: a daemon that learned an identity by watch
+        replay must take a kvstore node ref on first local use, or
+        identity GC sweeps an id it actively enforces with."""
+        kv = InMemoryKVStore()
+        da = Daemon(DaemonConfig(node_name="a", backend="interpreter"),
+                    kvstore=kv)
+        db_d = Daemon(DaemonConfig(node_name="b", backend="interpreter"),
+                      kvstore=kv)
+        web = da.allocator.allocate(LabelSet.parse("k8s:app=web"))
+        # B uses the replayed identity locally
+        web_b = db_d.allocator.allocate(LabelSet.parse("k8s:app=web"))
+        assert web_b.numeric_id == web.numeric_id
+        # A drops its ref; B's ref must keep the identity from GC
+        da.allocator.release(web)
+        backend = da.allocator._backend
+        assert backend.gc() == 0
+        refs = kv.list_prefix(
+            "cilium/state/identities/v1/value/")
+        assert any(k.endswith("/b") for k in refs)
+
+    def test_release_and_gc(self):
+        kv = InMemoryKVStore()
+        a = KVStoreAllocatorBackend(kv, node="a")
+        b = KVStoreAllocatorBackend(kv, node="b")
+        num = a.allocate("key1;")
+        b.allocate("key1;")
+        a.release("key1;")
+        assert a.gc() == 0  # b still holds a reference
+        b.release("key1;")
+        assert a.gc() == 1
+        # after GC the id may be reused
+        assert a.allocate("key2;") == num
+
+
+class TestTwoDaemons:
+    def test_identity_agreement_and_replication(self):
+        """Daemon A allocates an identity; daemon B sees the SAME
+        numeric id — by backend agreement AND by watch replication —
+        and both enforce the same verdicts after B learns the
+        identity's IP."""
+        kv = InMemoryKVStore()
+        da = Daemon(DaemonConfig(node_name="node-a", backend="tpu",
+                                 ct_capacity=1 << 12), kvstore=kv)
+        db_d = Daemon(DaemonConfig(node_name="node-b", backend="tpu",
+                                   ct_capacity=1 << 12), kvstore=kv)
+        for d in (da, db_d):
+            d.add_endpoint("db-" + d.config.node_name, ("10.0.2.1",),
+                           ["k8s:app=db"])
+            d.policy_import(RULES)
+            d.start()
+
+        # node A learns a remote web pod
+        web = da.allocator.allocate(
+            LabelSet.parse("k8s:app=web", "k8s:role=web"))
+        # node B's allocator learned the same identity via the watch
+        got = db_d.allocator.lookup_by_id(web.numeric_id)
+        assert got is not None
+        assert got.labels == web.labels
+        # and allocating the same labels on B returns the same numeric
+        web_b = db_d.allocator.allocate(
+            LabelSet.parse("k8s:app=web", "k8s:role=web"))
+        assert web_b.numeric_id == web.numeric_id
+
+        # both nodes map the pod IP and agree on the verdict
+        for d in (da, db_d):
+            d.upsert_ipcache("10.1.0.9/32", web.numeric_id)
+        ep_a = da.endpoints.list()[0]
+        ep_b = db_d.endpoints.list()[0]
+        pkt = lambda ep: make_batch([dict(
+            src="10.1.0.9", dst="10.0.2.1", sport=40000, dport=5432,
+            proto=6, flags=TCP_SYN, ep=ep.id, dir=0)]).data
+        va = da.process_batch(pkt(ep_a), now=10)
+        vb = db_d.process_batch(pkt(ep_b), now=10)
+        assert list(va.verdict) == [1]
+        assert list(vb.verdict) == [1]
+
+    def test_late_joiner_replays_existing_identities(self):
+        """A daemon that joins AFTER identities exist replays the id/
+        prefix and knows them all."""
+        kv = InMemoryKVStore()
+        da = Daemon(DaemonConfig(node_name="node-a", backend="tpu",
+                                 ct_capacity=1 << 12), kvstore=kv)
+        idents = [da.allocator.allocate(
+            LabelSet.parse(f"k8s:app=svc{i}")) for i in range(5)]
+
+        db_d = Daemon(DaemonConfig(node_name="node-b", backend="tpu",
+                                   ct_capacity=1 << 12), kvstore=kv)
+        for ident in idents:
+            got = db_d.allocator.lookup_by_id(ident.numeric_id)
+            assert got is not None and got.labels == ident.labels
+
+    def test_reserved_and_cidr_identities_stay_local(self):
+        """CIDR identities are node-local (LOCAL_IDENTITY_FLAG) and
+        never round-trip the kvstore; reserved ids are pinned."""
+        kv = InMemoryKVStore()
+        da = Daemon(DaemonConfig(node_name="node-a", backend="tpu",
+                                 ct_capacity=1 << 12), kvstore=kv)
+        cidr_ident = da.allocator.allocate_cidr("192.168.0.0/16")
+        from cilium_tpu.identity.identity import LOCAL_IDENTITY_FLAG
+
+        assert cidr_ident.numeric_id & LOCAL_IDENTITY_FLAG
+        assert not kv.list_prefix(
+            "cilium/state/identities/v1/value/cidr:")

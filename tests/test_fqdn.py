@@ -1,0 +1,150 @@
+"""The fqdn loop (SURVEY.md:116, pkg/fqdn): DNS answers -> identities
+-> ipcache -> toFQDNs policies match.
+
+The round-3 "done" gate: a ``toFQDNs: example.com`` policy + a
+synthetic DNS answer makes subsequent traffic to the resolved IP
+allowed — end to end, through the incremental patch path (no
+re-attach).
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "client"}},
+    "egress": [
+        # DNS to anywhere, L7-inspected: only example.com may resolve
+        {"toEntities": ["world"],
+         "toPorts": [{"ports": [{"port": "53", "protocol": "UDP"}],
+                      "rules": {"dns": [{"matchName": "example.com"},
+                                        {"matchPattern": "*.corp.io"}]}}]},
+        # and traffic may flow only to IPs example.com resolved to
+        {"toFQDNs": ["example.com"],
+         "toPorts": [{"ports": [{"port": "443", "protocol": "TCP"}]}]},
+        {"toFQDNs": ["*.corp.io"],
+         "toPorts": [{"ports": [{"port": "8443", "protocol": "TCP"}]}]},
+    ],
+}]
+
+
+def _mk(backend="tpu"):
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+    ep = d.add_endpoint("client-1", ("10.0.1.1",), ["k8s:app=client"])
+    d.policy_import(RULES)
+    d.start()
+    return d, ep
+
+
+def _egress(dst, dport, ep, sport=40000):
+    return dict(src="10.0.1.1", dst=dst, sport=sport, dport=dport,
+                proto=6, flags=TCP_SYN, ep=ep, dir=1)
+
+
+class TestFQDNLoop:
+    def test_dns_answer_enables_traffic(self):
+        d, ep = _mk()
+        # before any DNS activity the fqdn selector set is empty: deny
+        evb = d.process_batch(make_batch([
+            _egress("93.184.216.34", 443, ep.id)]).data, now=10)
+        assert list(evb.verdict) == [0]
+
+        attaches = d.loader.attach_count
+        # the DNS proxy observes the answer (as if a response transited)
+        d.proxy.observe_answer("example.com", ["93.184.216.34"], ttl=300)
+        assert d.loader.attach_count == attaches  # patched, not rebuilt
+
+        evb = d.process_batch(make_batch([
+            _egress("93.184.216.34", 443, ep.id, sport=40001),
+            _egress("93.184.216.34", 80, ep.id, sport=40002),  # not 443
+            _egress("1.2.3.4", 443, ep.id, sport=40003),  # unresolved IP
+        ]).data, now=20)
+        assert list(evb.verdict) == [1, 0, 0]
+
+    def test_match_pattern_fqdn(self):
+        d, ep = _mk()
+        d.proxy.observe_answer("api.corp.io", ["198.51.100.7"], ttl=300)
+        evb = d.process_batch(make_batch([
+            _egress("198.51.100.7", 8443, ep.id),
+            _egress("198.51.100.7", 443, ep.id, sport=40001),
+        ]).data, now=10)
+        # *.corp.io grants 8443 only; 443 is the example.com rule
+        assert list(evb.verdict) == [1, 0]
+
+    def test_dns_request_enforcement(self):
+        """The L7 DNS side: only policied names may resolve at all."""
+        d, ep = _mk()
+        evb = d.process_batch(make_batch([
+            _egress("8.8.8.8", 53, ep.id) | {"proto": 17}]).data, now=5)
+        assert list(evb.verdict) == [3]  # redirect to the DNS proxy
+        port = int(evb.proxy_port[0])
+        got = d.handle_l7_dns(port, ["example.com", "evil.com",
+                                     "www.corp.io"])
+        assert list(got) == [1, 0, 1]
+
+    def test_ttl_expiry_revokes(self):
+        import time as _time
+
+        d, ep = _mk()
+        d.proxy.observe_answer("example.com", ["93.184.216.34"], ttl=60)
+        evb = d.process_batch(make_batch([
+            _egress("93.184.216.34", 443, ep.id)]).data, now=10)
+        assert list(evb.verdict) == [1]
+        assert len(d.fqdn.entries()) == 1
+
+        dropped = d.fqdn.gc(now=_time.time() + 3600)
+        assert dropped == 1
+        assert d.fqdn.entries() == []
+        # fresh flow to the expired IP: denied again
+        evb = d.process_batch(make_batch([
+            _egress("93.184.216.34", 443, ep.id, sport=41000)
+        ]).data, now=20)
+        assert list(evb.verdict) == [0]
+
+    def test_two_names_one_ip_merge(self):
+        """An IP serving two names carries both fqdn labels (upstream:
+        metadata merge), so either name's policy admits it."""
+        d, ep = _mk()
+        d.proxy.observe_answer("example.com", ["203.0.113.9"], ttl=300)
+        d.proxy.observe_answer("www.corp.io", ["203.0.113.9"], ttl=300)
+        evb = d.process_batch(make_batch([
+            _egress("203.0.113.9", 443, ep.id),
+            _egress("203.0.113.9", 8443, ep.id, sport=40001),
+        ]).data, now=10)
+        assert list(evb.verdict) == [1, 1]
+        assert len(d.fqdn.entries()) == 1
+        assert d.fqdn.entries()[0]["names"] == ["example.com",
+                                                "www.corp.io"]
+
+    def test_churn_does_not_grow_rows(self):
+        """r03 review: every DNS re-observation/expiry cycle allocated
+        a fresh identity row and rows were never recycled — unbounded
+        tensor growth under steady DNS traffic.  Rows must be reused."""
+        import time as _time
+
+        d, ep = _mk()
+        d.proxy.observe_answer("example.com", ["93.184.216.34"], ttl=60)
+        high = d.endpoints.row_map._next
+        for i in range(12):
+            d.fqdn.gc(now=_time.time() + 3600)  # expire everything
+            d.proxy.observe_answer("example.com", ["93.184.216.34"],
+                                   ttl=60)
+        assert d.endpoints.row_map._next <= high + 1, (
+            high, d.endpoints.row_map._next)
+
+    def test_backend_parity(self):
+        outs = {}
+        for backend in ("tpu", "interpreter"):
+            d, ep = _mk(backend)
+            d.proxy.observe_answer("example.com", ["93.184.216.34"],
+                                   ttl=300)
+            evb = d.process_batch(make_batch([
+                _egress("93.184.216.34", 443, ep.id),
+                _egress("93.184.216.34", 22, ep.id, sport=40001),
+                _egress("9.9.9.9", 443, ep.id, sport=40002),
+            ]).data, now=10)
+            outs[backend] = list(evb.verdict)
+        assert outs["tpu"] == outs["interpreter"]

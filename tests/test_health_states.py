@@ -1,0 +1,157 @@
+"""Health probe mesh (SURVEY.md §2b row 30) + the endpoint state
+machine's non-trivial states (r02 weak #10: states existed but
+everything went READY synchronously).
+"""
+
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.agent.endpoint import EndpointState
+from cilium_tpu.health import HealthMesh, NodeRegistry
+from cilium_tpu.kvstore import InMemoryKVStore
+from cilium_tpu.labels import LabelSet
+
+
+class TestNodeRegistry:
+    def test_register_and_list(self):
+        kv = InMemoryKVStore()
+        reg = NodeRegistry(kv, lease_ttl=None)
+        reg.register("node-a", {"api_socket": "/tmp/a.sock"})
+        reg.register("node-b", {})
+        names = sorted(n["name"] for n in reg.nodes())
+        assert names == ["node-a", "node-b"]
+        reg.unregister("node-a")
+        assert [n["name"] for n in reg.nodes()] == ["node-b"]
+
+
+class TestHealthMesh:
+    def _listener(self, path):
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.bind(path)
+        s.listen(4)
+
+        def accept_loop():
+            while True:
+                try:
+                    c, _ = s.accept()
+                    c.close()
+                except OSError:
+                    return
+
+        t = threading.Thread(target=accept_loop, daemon=True)
+        t.start()
+        return s
+
+    def test_probe_reachable_and_dead_nodes(self, tmp_path):
+        kv = InMemoryKVStore()
+        reg = NodeRegistry(kv, lease_ttl=None)
+        alive = str(tmp_path / "alive.sock")
+        srv = self._listener(alive)
+        reg.register("local", {})
+        reg.register("peer-alive", {"api_socket": alive})
+        reg.register("peer-dead",
+                     {"api_socket": str(tmp_path / "no.sock")})
+        mesh = HealthMesh(reg, "local")
+        mesh.probe_all()
+        st = {h.name: h for h in mesh.statuses()}
+        assert st["peer-alive"].reachable
+        assert st["peer-alive"].latency_ms >= 0
+        assert not st["peer-dead"].reachable
+        assert st["peer-dead"].consecutive_failures == 1
+        d = mesh.to_dict()
+        assert d["reachable"] == 1 and d["unreachable"] == 1
+        # the dead peer comes back
+        srv2 = self._listener(str(tmp_path / "no.sock"))
+        mesh.probe_all()
+        st = {h.name: h for h in mesh.statuses()}
+        assert st["peer-dead"].reachable
+        srv.close()
+        srv2.close()
+
+    def test_departed_node_dropped(self, tmp_path):
+        kv = InMemoryKVStore()
+        reg = NodeRegistry(kv, lease_ttl=None)
+        reg.register("local", {})
+        reg.register("ghost", {"api_socket": "/nonexistent"})
+        mesh = HealthMesh(reg, "local")
+        mesh.probe_all()
+        assert [h.name for h in mesh.statuses()] == ["ghost"]
+        reg.unregister("ghost")
+        mesh.probe_all()
+        assert mesh.statuses() == []
+
+    def test_daemon_cluster_health_in_status(self, tmp_path):
+        kv = InMemoryKVStore()
+        alive = str(tmp_path / "b.sock")
+        srv = self._listener(alive)
+        da = Daemon(DaemonConfig(node_name="a", backend="interpreter"),
+                    kvstore=kv)
+        db = Daemon(DaemonConfig(node_name="b", backend="interpreter",
+                                 api_socket_path=alive), kvstore=kv)
+        da.health.probe_all()
+        status = da.status()
+        nodes = {n["name"]: n
+                 for n in status["cluster-health"]["nodes"]}
+        assert nodes["b"]["reachable"]
+        srv.close()
+
+
+class _FlakyBackend:
+    """Allocator backend that fails until told to recover."""
+
+    def __init__(self):
+        self.fail = True
+        self._next = 1000
+
+    def allocate(self, key: str) -> int:
+        if self.fail:
+            raise RuntimeError("kvstore unavailable")
+        self._next += 1
+        return self._next
+
+
+class TestEndpointStates:
+    def test_waiting_for_identity_until_backend_recovers(self):
+        from cilium_tpu.identity.allocator import CachingIdentityAllocator
+
+        d = Daemon(DaemonConfig(backend="interpreter"))
+        backend = _FlakyBackend()
+        d.allocator._backend = backend
+        ep = d.add_endpoint("stuck-1", ("10.0.5.5",), ["k8s:app=stuck"])
+        assert ep.state == EndpointState.WAITING_FOR_IDENTITY
+        assert ep.identity is None
+        # regeneration while waiting must not crash nor mark it READY
+        d.endpoints._regenerate_all()
+        assert ep.state == EndpointState.WAITING_FOR_IDENTITY
+        # backend recovers; the retry controller's body advances it
+        backend.fail = False
+        assert d.endpoints.retry_pending_identities() == 1
+        assert ep.identity is not None
+        assert ep.state == EndpointState.READY
+
+    def test_restore_passes_through_restoring(self, tmp_path):
+        d = Daemon(DaemonConfig(backend="interpreter",
+                                ct_capacity=1 << 10))
+        d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        d.checkpoint(str(tmp_path))
+
+        d2 = Daemon(DaemonConfig(backend="interpreter",
+                                 ct_capacity=1 << 10))
+        # observe the state an endpoint holds between registration and
+        # its first regeneration: hook the attach to record it
+        seen = []
+        d2.endpoints.on_attach(
+            lambda pols: seen.extend(
+                ep.state for ep in d2.endpoints.list()))
+        assert d2.restore(str(tmp_path))
+        ep = d2.endpoints.list()[0]
+        assert ep.state == EndpointState.READY  # end state
+        # during the restore regeneration the endpoint was REGENERATING
+        # (it entered via RESTORING, not the add->ready fast path)
+        assert EndpointState.REGENERATING in seen

@@ -1,0 +1,184 @@
+"""Hubble completion (SURVEY.md §2b row 27): seven parser, relay,
+and the gRPC Observer API surface.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.flow import Observer, Relay, SevenParser
+from cilium_tpu.flow.seven import MSG_L7
+
+
+RULES_L7 = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [
+        {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+         "toPorts": [{"ports": [{"port": "80", "protocol": "TCP"}],
+                      "rules": {"http": [{"method": "GET",
+                                          "path": "/ok"}]}}]},
+    ],
+}]
+
+
+def _daemon_with_l7(**cfg):
+    d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12, **cfg))
+    web = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+    db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+    d.policy_import(RULES_L7)
+    d.start()
+    return d, web, db
+
+
+class TestSevenParser:
+    def test_proxy_records_become_l7_flows(self):
+        d, web, db = _daemon_with_l7()
+        evb = d.process_batch(make_batch([dict(
+            src="10.0.1.1", dst="10.0.2.1", sport=40000, dport=80,
+            proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data, now=10)
+        port = int(evb.proxy_port[0])
+        d.handle_l7_http(port, [
+            {"method": "GET", "path": "/ok", "host": "db"},
+            {"method": "POST", "path": "/ok"},
+        ], src_identity=web.identity.numeric_id)
+
+        flows = d.observer.get_flows(number=10)
+        l7_flows = [f for f in flows if f.l7 is not None]
+        assert len(l7_flows) == 2
+        allowed = [f for f in l7_flows if f.verdict_name == "FORWARDED"]
+        denied = [f for f in l7_flows if f.verdict_name == "DROPPED"]
+        assert len(allowed) == 1 and len(denied) == 1
+        assert allowed[0].l7["http"]["method"] == "GET"
+        assert allowed[0].l7["http"]["url"] == "/ok"
+        assert allowed[0].l7["http"]["code"] == 200
+        assert denied[0].l7["http"]["code"] == 403
+        assert allowed[0].event_type == MSG_L7
+        # enriched with the requesting identity
+        assert allowed[0].source.identity == web.identity.numeric_id
+        d.shutdown()
+
+    def test_flow_json_carries_l7(self):
+        d, web, db = _daemon_with_l7()
+        evb = d.process_batch(make_batch([dict(
+            src="10.0.1.1", dst="10.0.2.1", sport=40000, dport=80,
+            proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data, now=10)
+        d.handle_l7_http(int(evb.proxy_port[0]),
+                         [{"method": "GET", "path": "/ok"}])
+        f = [x for x in d.observer.get_flows(number=10)
+             if x.l7 is not None][0]
+        j = f.to_dict()
+        assert j["Type"] == "L7"
+        assert j["l7"]["http"]["url"] == "/ok"
+        d.shutdown()
+
+    def test_dns_records(self):
+        obs = Observer(capacity=64)
+        seven = SevenParser(obs)
+        from cilium_tpu.proxy.featurize import KIND_DNS
+        from cilium_tpu.proxy.proxy import L7Record
+
+        seven.consume(L7Record(kind=KIND_DNS, verdict=0,
+                               proxy_port=10053, src_row=0,
+                               timestamp=time.time(),
+                               qname="evil.com"))
+        f = obs.get_flows(number=1)[0]
+        assert f.l7["dns"]["query"] == "evil.com"
+        assert f.l7["dns"]["rcode"] == 5  # refused
+
+
+class TestRelay:
+    def test_merges_and_stamps_nodes(self):
+        a, b = Observer(capacity=64), Observer(capacity=64)
+        sa, sb = SevenParser(a), SevenParser(b)
+        from cilium_tpu.proxy.featurize import KIND_HTTP
+        from cilium_tpu.proxy.proxy import L7Record
+
+        t0 = time.time()
+        for i, (p, t) in enumerate(((sa, t0 + 1), (sb, t0 + 2),
+                                    (sa, t0 + 3))):
+            p.consume(L7Record(kind=KIND_HTTP, verdict=1,
+                               proxy_port=10000, src_row=0,
+                               timestamp=t, method="GET",
+                               path=f"/r{i}", status=200))
+        relay = Relay({"node-a": a, "node-b": b})
+        flows = relay.get_flows(number=10)
+        assert len(flows) == 3
+        assert flows[0]["l7"]["http"]["url"] == "/r2"  # newest first
+        assert flows[0]["node_name"] == "node-a"
+        assert flows[1]["node_name"] == "node-b"
+        status = relay.server_status()
+        assert status["num_connected_nodes"] == 2
+        assert status["num_flows"] == 3
+
+
+class TestObserverGRPC:
+    def test_get_flows_over_grpc(self, tmp_path):
+        from cilium_tpu.flow.grpc_server import ObserverClient, serve
+
+        d, web, db = _daemon_with_l7()
+        evb = d.process_batch(make_batch([dict(
+            src="10.0.1.1", dst="10.0.2.1", sport=40000, dport=80,
+            proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data, now=10)
+        d.handle_l7_http(int(evb.proxy_port[0]),
+                         [{"method": "GET", "path": "/ok"}])
+
+        addr = f"unix://{tmp_path}/hubble.sock"
+        server = serve(d.observer, addr)
+        try:
+            client = ObserverClient(addr)
+            flows = client.get_flows(number=10)
+            assert len(flows) >= 2  # the L3/L4 redirect + the L7 flow
+            l7 = [f for f in flows if f.get("l7")]
+            assert l7 and l7[0]["l7"]["http"]["url"] == "/ok"
+            status = client.server_status()
+            assert status["seen_flows"] >= 2
+            client.close()
+        finally:
+            server.stop(grace=0.2)
+            d.shutdown()
+
+    def test_daemon_config_serves_hubble(self, tmp_path):
+        from cilium_tpu.flow.grpc_server import ObserverClient
+
+        addr = f"unix://{tmp_path}/hubble2.sock"
+        d, web, db = _daemon_with_l7(hubble_listen=addr)
+        d.process_batch(make_batch([dict(
+            src="10.0.1.1", dst="10.0.2.1", sport=40000, dport=80,
+            proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data, now=10)
+        client = ObserverClient(addr)
+        assert client.server_status()["seen_flows"] >= 1
+        client.close()
+        d.shutdown()
+
+    def test_relay_over_grpc_peers(self, tmp_path):
+        """The hubble-relay shape: relay peers are gRPC clients to two
+        agents' Observer servers."""
+        from cilium_tpu.flow.grpc_server import ObserverClient, serve
+
+        obs_a, obs_b = Observer(capacity=64), Observer(capacity=64)
+        from cilium_tpu.proxy.featurize import KIND_HTTP
+        from cilium_tpu.proxy.proxy import L7Record
+
+        SevenParser(obs_a).consume(L7Record(
+            kind=KIND_HTTP, verdict=1, proxy_port=1, src_row=0,
+            timestamp=time.time(), method="GET", path="/a", status=200))
+        SevenParser(obs_b).consume(L7Record(
+            kind=KIND_HTTP, verdict=1, proxy_port=1, src_row=0,
+            timestamp=time.time() + 1, method="GET", path="/b",
+            status=200))
+        sa = serve(obs_a, f"unix://{tmp_path}/a.sock")
+        sb = serve(obs_b, f"unix://{tmp_path}/b.sock")
+        try:
+            relay = Relay({
+                "a": ObserverClient(f"unix://{tmp_path}/a.sock"),
+                "b": ObserverClient(f"unix://{tmp_path}/b.sock"),
+            })
+            flows = relay.get_flows(number=10)
+            assert [f["node_name"] for f in flows] == ["b", "a"]
+        finally:
+            sa.stop(grace=0.2)
+            sb.stop(grace=0.2)

@@ -1,0 +1,187 @@
+"""CNP adapter + named ports (SURVEY.md §2b rows 11, 10; VERDICT r02
+items 5 and 8): upstream-format CiliumNetworkPolicy objects import
+into the repository, namespaced correctly, deletable by identity
+labels; named ports resolve against the endpoint port registry.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.k8s import CNPWatcher, cnp_identity_labels, rules_from_cnp
+from cilium_tpu.labels import LabelSet
+from cilium_tpu.policy.api import PortProtocol
+
+
+CNP = {
+    "apiVersion": "cilium.io/v2",
+    "kind": "CiliumNetworkPolicy",
+    "metadata": {"name": "allow-web-to-db", "namespace": "prod",
+                 "uid": "abc-123"},
+    "spec": {
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [
+            {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+             "toPorts": [{"ports": [{"port": "5432",
+                                     "protocol": "TCP"}]}]},
+        ],
+    },
+}
+
+
+class TestCNPTranslation:
+    def test_subject_and_peers_are_namespaced(self):
+        rules = rules_from_cnp(CNP)
+        assert len(rules) == 1
+        r = rules[0]
+        sel = dict(r.endpoint_selector.match_labels)
+        assert sel["k8s:io.kubernetes.pod.namespace"] == "prod"
+        peer = dict(r.ingress[0].from_endpoints[0].match_labels)
+        assert peer["k8s:io.kubernetes.pod.namespace"] == "prod"
+
+    def test_derived_labels_identify_the_cnp(self):
+        r = rules_from_cnp(CNP)[0]
+        assert "k8s:io.cilium.k8s.policy.name=allow-web-to-db" in r.labels
+        assert "k8s:io.cilium.k8s.policy.namespace=prod" in r.labels
+        assert "k8s:io.cilium.k8s.policy.uid=abc-123" in r.labels
+
+    def test_explicit_namespace_not_overridden(self):
+        cnp = {**CNP, "spec": {
+            "endpointSelector": {"matchLabels": {
+                "app": "db", "k8s:io.kubernetes.pod.namespace": "other"}},
+            "ingress": [{"fromEndpoints": [{}]}],
+        }}
+        r = rules_from_cnp(cnp)[0]
+        sel = dict(r.endpoint_selector.match_labels)
+        assert sel["k8s:io.kubernetes.pod.namespace"] == "other"
+
+    def test_specs_plural(self):
+        cnp = {**CNP}
+        cnp.pop("spec", None)
+        cnp = {**cnp, "specs": [CNP["spec"], CNP["spec"]]}
+        assert len(rules_from_cnp(cnp)) == 2
+
+    def test_clusterwide_skips_namespacing(self):
+        ccnp = {**CNP, "kind": "CiliumClusterwideNetworkPolicy"}
+        r = rules_from_cnp(ccnp)[0]
+        sel = dict(r.endpoint_selector.match_labels)
+        assert "k8s:io.kubernetes.pod.namespace" not in sel
+
+    def test_rejects_non_cnp(self):
+        with pytest.raises(ValueError, match="not a CNP"):
+            rules_from_cnp({"kind": "NetworkPolicy", "metadata": {}})
+
+
+class TestCNPWatcher:
+    def test_add_update_delete_lifecycle(self):
+        d = Daemon(DaemonConfig(backend="interpreter"))
+        w = CNPWatcher(d.repo)
+        w.on_add(CNP)
+        assert len(d.repo.rules()) == 1
+        # update: replace with a 2-spec object
+        cnp2 = {**CNP}
+        cnp2.pop("spec", None)
+        cnp2 = {**cnp2, "specs": [CNP["spec"], CNP["spec"]]}
+        w.on_update(cnp2)
+        assert len(d.repo.rules()) == 2
+        w.on_delete(CNP)
+        assert d.repo.rules() == []
+
+    def test_cnp_through_policy_import_and_enforced(self):
+        """The e2e replay: an upstream-format CNP through `policy
+        import`, then packets verdict per its rules."""
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+        ns = "k8s:io.kubernetes.pod.namespace=prod"
+        web = d.add_endpoint("web-1", ("10.0.1.1",),
+                             ["k8s:app=web", ns])
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db", ns])
+        d.policy_import(CNP)  # kind-detected, k8s-translated
+        d.start()
+        evb = d.process_batch(make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=40000,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=40001,
+                 dport=80, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+        ]).data, now=10)
+        assert list(evb.verdict) == [1, 0]
+
+
+class TestNamedPorts:
+    def test_parse_accepts_valid_names(self):
+        pp = PortProtocol.from_dict({"port": "http-metrics",
+                                     "protocol": "TCP"})
+        assert pp.is_named
+        assert pp.port_range() is None
+        assert pp.port_range({"http-metrics": 9100}) == (9100, 9100)
+
+    def test_parse_rejects_bad_names(self):
+        for bad in ("Has-Upper", "-lead", "trail-", "a--b",
+                    "way-too-long-port-name", "1234567890123456"):
+            with pytest.raises(ValueError):
+                PortProtocol.from_dict({"port": bad, "protocol": "TCP"})
+
+    def test_named_port_resolves_against_endpoint_registry(self):
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+        web = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"],
+                            named_ports={"postgres": 5432})
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [
+                {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                 "toPorts": [{"ports": [{"port": "postgres",
+                                         "protocol": "TCP"}]}]},
+            ],
+        }])
+        d.start()
+        evb = d.process_batch(make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=40000,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=40001,
+                 dport=5433, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+        ]).data, now=10)
+        assert list(evb.verdict) == [1, 0]
+
+    def test_unresolved_named_port_matches_nothing(self):
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [
+                {"fromEndpoints": [{}],
+                 "toPorts": [{"ports": [{"port": "nosuch",
+                                         "protocol": "TCP"}]}]},
+            ],
+        }])
+        d.start()
+        evb = d.process_batch(make_batch([
+            dict(src="10.0.9.9", dst="10.0.2.1", sport=40000,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+        ]).data, now=10)
+        assert list(evb.verdict) == [0]  # enforcing, nothing matches
+
+    def test_late_endpoint_binds_the_name(self):
+        """A named port defined by a LATER endpoint re-resolves rules
+        (registration invalidates the resolve cache)."""
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [
+                {"fromEndpoints": [{}],
+                 "toPorts": [{"ports": [{"port": "postgres",
+                                         "protocol": "TCP"}]}]},
+            ],
+        }])
+        d.start()
+        pkt = make_batch([dict(
+            src="10.0.9.9", dst="10.0.2.1", sport=40000, dport=5432,
+            proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data
+        assert list(d.process_batch(pkt, now=10).verdict) == [0]
+        d.add_endpoint("db-2", ("10.0.2.2",), ["k8s:app=db"],
+                       named_ports={"postgres": 5432})
+        pkt2 = make_batch([dict(
+            src="10.0.9.9", dst="10.0.2.1", sport=40002, dport=5432,
+            proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data
+        assert list(d.process_batch(pkt2, now=20).verdict) == [1]

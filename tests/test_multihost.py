@@ -1,0 +1,68 @@
+"""Multi-process sharded datapath under jax.distributed (SURVEY.md
+§2c rows 33-34: per-node sharding / multi-host).
+
+Spawns 2 processes x 4 virtual CPU devices; both join one distributed
+runtime, build the global 8-device mesh, and run the full sharded
+step.  Validates the program a 2-host pod slice would run, with the
+collectives crossing the process boundary.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_sharded_step():
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+    n_proc, dev_per_proc = 2, 4
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={dev_per_proc}"
+    ).strip()
+    env.pop("CILIUM_TPU_DRYRUN_CHILD", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.testing.multihost_child",
+             coordinator, str(n_proc), str(pid), str(dev_per_proc)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True)
+        for pid in range(n_proc)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=600)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, err[-2000:]
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    assert all(o["n_devices"] == n_proc * dev_per_proc for o in outs)
+    # psum-replicated counters: every process reports the same GLOBAL
+    # forwarded/dropped totals, covering the whole sharded batch
+    assert outs[0]["forwarded"] == outs[1]["forwarded"] > 0
+    assert outs[0]["dropped"] == outs[1]["dropped"]
+    total = outs[0]["forwarded"] + outs[0]["dropped"] + outs[0]["overflow"]
+    assert total == 32 * n_proc * dev_per_proc

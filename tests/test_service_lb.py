@@ -1,0 +1,161 @@
+"""Service LB / Maglev (SURVEY.md §2b row 18; VERDICT r02 item 9).
+
+Pins the Maglev properties that justify the algorithm (full table,
+near-uniform distribution, minimal disruption on backend change) and
+the device selection/DNAT semantics.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cilium_tpu.core.packets import (
+    COL_DPORT,
+    COL_DST_IP3,
+    COL_FAMILY,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP3,
+    N_COLS,
+)
+from cilium_tpu.service import (
+    M_DEFAULT,
+    ServiceManager,
+    lb_stage_jit,
+    maglev_table,
+)
+
+M = 2039  # a smaller prime for test speed
+
+
+class TestMaglevTable:
+    def test_full_and_in_range(self):
+        t = maglev_table([f"10.0.0.{i}:80" for i in range(5)], M)
+        assert t.shape == (M,)
+        assert (t >= 0).all() and (t < 5).all()
+
+    def test_near_uniform(self):
+        n = 7
+        t = maglev_table([f"10.0.0.{i}:80" for i in range(n)], M)
+        counts = np.bincount(t, minlength=n)
+        # Maglev guarantees slot counts within ~1% of each other at
+        # table sizes >> backends; allow a loose band
+        assert counts.min() > 0.8 * M / n
+        assert counts.max() < 1.2 * M / n
+
+    def test_minimal_disruption_on_removal(self):
+        keys = [f"10.0.0.{i}:80" for i in range(10)]
+        before = maglev_table(keys, M)
+        after = maglev_table(keys[:-1], M)  # drop the last backend
+        moved = int((before != after).sum())
+        lost = int((before == 9).sum())  # slots that HAD to move
+        # consistent hashing: barely more slots move than must
+        assert moved < lost * 2.0, (moved, lost)
+
+    def test_empty_backends(self):
+        t = maglev_table([], M)
+        assert (t == -1).all()
+
+    def test_deterministic(self):
+        keys = ["a:1", "b:2", "c:3"]
+        np.testing.assert_array_equal(maglev_table(keys, M),
+                                      maglev_table(keys, M))
+
+
+def _pkt_rows(n, dst, dport, rng):
+    rows = np.zeros((n, N_COLS), dtype=np.uint32)
+    rows[:, COL_SRC_IP3] = 0x0A000100 + rng.integers(0, 200, n)
+    rows[:, COL_SPORT] = rng.integers(1024, 60000, n)
+    rows[:, COL_DST_IP3] = dst
+    rows[:, COL_DPORT] = dport
+    rows[:, COL_PROTO] = 6
+    rows[:, COL_FAMILY] = 4
+    return rows
+
+
+class TestLBStage:
+    def _mgr(self):
+        mgr = ServiceManager(m=M)
+        mgr.upsert("web", "10.96.0.10:80",
+                   ["10.0.1.1:8080", "10.0.1.2:8080", "10.0.1.3:8080"])
+        mgr.upsert("dns", "10.96.0.53:53",
+                   ["10.0.2.1:5353"], protocol=17)
+        return mgr
+
+    def test_vip_traffic_is_dnatted(self):
+        mgr = self._mgr()
+        rng = np.random.default_rng(0)
+        vip = 0x0A60000A  # 10.96.0.10
+        rows = _pkt_rows(256, vip, 80, rng)
+        out, hits = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
+        out = np.asarray(out)
+        assert np.asarray(hits).all()
+        # every packet now targets one of the three backends on 8080
+        backends = {0x0A000101, 0x0A000102, 0x0A000103}
+        assert set(out[:, COL_DST_IP3].tolist()) <= backends
+        assert (out[:, COL_DPORT] == 8080).all()
+        assert len(set(out[:, COL_DST_IP3].tolist())) == 3  # spread
+
+    def test_flow_affinity(self):
+        """Same 5-tuple -> same backend, every time."""
+        mgr = self._mgr()
+        rng = np.random.default_rng(1)
+        rows = _pkt_rows(64, 0x0A60000A, 80, rng)
+        t = mgr.tensors()
+        out1 = np.asarray(lb_stage_jit(t, jnp.asarray(rows))[0])
+        out2 = np.asarray(lb_stage_jit(t, jnp.asarray(rows))[0])
+        np.testing.assert_array_equal(out1, out2)
+
+    def test_non_vip_traffic_untouched(self):
+        mgr = self._mgr()
+        rng = np.random.default_rng(2)
+        rows = _pkt_rows(64, 0x0A000042, 80, rng)  # not a VIP
+        out, hits = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
+        assert not np.asarray(hits).any()
+        np.testing.assert_array_equal(np.asarray(out), rows)
+
+    def test_proto_must_match(self):
+        mgr = self._mgr()
+        rng = np.random.default_rng(3)
+        rows = _pkt_rows(16, 0x0A600035, 53, rng)  # dns VIP but TCP
+        out, hits = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
+        assert not np.asarray(hits).any()
+
+    def test_vip_with_no_backends_passes_through(self):
+        mgr = ServiceManager(m=M)
+        mgr.upsert("empty", "10.96.0.99:80", [])
+        rng = np.random.default_rng(4)
+        rows = _pkt_rows(8, 0x0A600063, 80, rng)
+        out, hits = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
+        assert not np.asarray(hits).any()
+
+
+class TestDaemonIntegration:
+    def test_policy_applies_to_backend_not_vip(self):
+        """LB-before-policy ordering: a rule allowing traffic to the
+        BACKEND admits VIP-addressed traffic after DNAT."""
+        from cilium_tpu.agent import Daemon, DaemonConfig
+        from cilium_tpu.core import TCP_SYN, make_batch
+
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+        web = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [
+                {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                 "toPorts": [{"ports": [{"port": "5432",
+                                         "protocol": "TCP"}]}]},
+            ],
+        }])
+        d.services.upsert("db-svc", "10.96.0.5:5432",
+                          ["10.0.2.1:5432"])
+        d.start()
+        evb = d.process_batch(make_batch([dict(
+            src="10.0.1.1", dst="10.96.0.5", sport=40000, dport=5432,
+            proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data, now=10)
+        assert list(evb.verdict) == [1]
+        # status/introspection surface
+        assert d.services.list()[0].to_dict()["backends"][0]["port"] \
+            == 5432
