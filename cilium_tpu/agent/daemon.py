@@ -155,6 +155,36 @@ class Daemon:
         self.fqdn = NameManager(self.allocator, self.delete_ipcache)
         self.proxy.observe_dns(self.fqdn.observe)
 
+        # recorder: FlowFilter-gated pcap capture off the monitor
+        # stream (reference: pkg/hubble/recorder)
+        from ..flow.recorder import Recorder
+
+        self.recorder = Recorder()
+        self.monitor.register("recorder", self.recorder.consume)
+
+        # clustermesh: remote clusters mirror in as incremental
+        # identity/ipcache patches (reference: pkg/clustermesh)
+        from ..clustermesh import (ClusterMesh, publish_endpoint_ip,
+                                   withdraw_endpoint_ip)
+
+        self.clustermesh = ClusterMesh(self.allocator,
+                                       self.upsert_ipcache,
+                                       self.delete_ipcache)
+        if kvstore is not None:
+            # agent side of the ipcache shared store: announce local
+            # endpoint IPs for remote clusters/nodes to mirror
+            def _publish_ep(kind: str, ep) -> None:
+                if ep.identity is None:
+                    return
+                for ip in ep.ips:
+                    if kind == "add":
+                        publish_endpoint_ip(self.kvstore, ip,
+                                            ep.identity.numeric_id)
+                    else:
+                        withdraw_endpoint_ip(self.kvstore, ip)
+
+            self.endpoints.on_endpoint_change(_publish_ep)
+
         # ipcache catch-all: IPs no entry covers belong to WORLD
         # (reference: ipcache misses resolve to the world identity, so
         # toEntities:[world] policies see all external traffic)
@@ -328,6 +358,12 @@ class Daemon:
                if self.loader.row_map else 0)
         return self.proxy.handle_dns(proxy_port, qnames, row)
 
+    # -- clustermesh API ----------------------------------------------
+    def connect_cluster(self, name: str, cluster_id: int, kv):
+        """Join a remote cluster's store (reference: clustermesh
+        config per remote cluster)."""
+        return self.clustermesh.connect(name, cluster_id, kv)
+
     # -- ipcache API (the k8s-watcher/clustermesh-facing entry) --------
     def upsert_ipcache(self, cidr: str, numeric_id: int,
                        source: str = "k8s") -> None:
@@ -401,6 +437,7 @@ class Daemon:
     # -- status --------------------------------------------------------
     def status(self) -> dict:
         m = self.loader.metrics()
+        mesh = self.clustermesh.status()
         return {
             "version": VERSION,
             "node": self.config.node_name,
@@ -427,6 +464,7 @@ class Daemon:
                 for n, s in self.controllers.statuses().items()},
             **({"cluster-health": self.health.to_dict()}
                if self.health is not None else {}),
+            **({"clustermesh": mesh} if mesh else {}),
         }
 
     def _eps_by_state(self) -> Dict[str, int]:

@@ -45,6 +45,7 @@ class EndpointManager:
         self.row_map = IdentityRowMap(capacity=row_capacity)
         self._attached_policies: List = []
         self._attach_hooks: List = []  # fn(policies) after every attach
+        self._ep_hooks: List = []  # fn(kind, ep) on add/remove
         self._regen_trigger = Trigger(self._regenerate_all,
                                       name="endpoint-regeneration")
 
@@ -65,6 +66,15 @@ class EndpointManager:
         (the L7 proxy re-syncs its listeners here, the way pkg/proxy
         updates redirects on endpoint regeneration)."""
         self._attach_hooks.append(fn)
+
+    def on_endpoint_change(self, fn) -> None:
+        """Register fn(kind, ep) for endpoint add/remove (clustermesh
+        publishes endpoint IPs here)."""
+        self._ep_hooks.append(fn)
+
+    def _fire_ep(self, kind: str, ep: Endpoint) -> None:
+        for fn in list(self._ep_hooks):
+            fn(kind, ep)
 
     # -- registry ----------------------------------------------------
     def add(self, name: str, ips: Tuple[str, ...], labels: LabelSet,
@@ -108,6 +118,7 @@ class EndpointManager:
             ep.state = EndpointState.WAITING_FOR_IDENTITY
             return ep
         self._bind_identity(ep, ident)
+        self._fire_ep("add", ep)
         if not defer_regen:
             self.regenerate()
         return ep
@@ -137,6 +148,10 @@ class EndpointManager:
             except Exception:
                 continue
             self._bind_identity(ep, ident)
+            # the add-time hook was skipped while waiting (no identity
+            # to publish); fire it now so clustermesh/watchers see the
+            # endpoint exactly once it can enforce
+            self._fire_ep("add", ep)
             advanced += 1
         if advanced:
             self.regenerate()
@@ -155,6 +170,7 @@ class EndpointManager:
             self.repo.allocator.release(ep.identity)
         if ep.named_ports:
             self.repo.invalidate()
+        self._fire_ep("remove", ep)
         self.regenerate()
         return True
 

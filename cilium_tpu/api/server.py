@@ -25,6 +25,10 @@ class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
     allow_reuse_address = True
 
 
+# jax's profiler is process-global state; one capture at a time
+_PROFILE_LOCK = threading.Lock()
+
+
 class APIServer:
     def __init__(self, daemon: Daemon, socket_path: str):
         self.daemon = daemon
@@ -158,6 +162,32 @@ def _make_handler(daemon: Daemon):
                                          "not enabled"})
                     else:
                         self._send(200, daemon.anomaly.stats())
+                elif path == "/debug/profile":
+                    # the pprof-endpoint analogue: capture an XLA/jax
+                    # profiler trace (viewable in TensorBoard/Perfetto).
+                    # The jax profiler is process-global and cannot
+                    # nest; overlapping requests get 409 busy.
+                    import tempfile
+
+                    import jax
+
+                    if not _PROFILE_LOCK.acquire(blocking=False):
+                        self._send(409, {"error": "a profile capture "
+                                         "is already in progress"})
+                        return
+                    try:
+                        seconds = float(q.get("seconds", ["1.0"])[0])
+                        seconds = min(max(seconds, 0.1), 30.0)
+                        out_dir = q.get("dir", [None])[0] or \
+                            tempfile.mkdtemp(prefix="cilium-profile-")
+                        import time as _t
+
+                        with jax.profiler.trace(out_dir):
+                            _t.sleep(seconds)
+                    finally:
+                        _PROFILE_LOCK.release()
+                    self._send(200, {"trace-dir": out_dir,
+                                     "seconds": seconds})
                 elif path == "/debuginfo":
                     self._send(200, {
                         "status": daemon.status(),

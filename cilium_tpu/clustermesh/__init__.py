@@ -1,0 +1,132 @@
+"""ClusterMesh: multi-cluster identity/ipcache federation.
+
+Reference: upstream ``pkg/clustermesh`` — the agent opens a watch into
+EVERY remote cluster's etcd (via clustermesh-apiserver) and mirrors
+remote nodes, identities, and endpoints locally, so policies can
+select peers cluster-wide.  TPU-first mapping: each remote cluster is
+another kvstore handle; remote identities replay through the local
+allocator (namespaced into a per-cluster numeric range so clusters'
+id spaces cannot collide) and remote endpoint IPs upsert the ipcache
+— both landing as the same incremental tensor patches local churn
+uses.  DCN is the transport the stores ride in a real deployment; the
+mesh logic is transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..kvstore.allocator import DEFAULT_PREFIX
+from ..kvstore.store import InMemoryKVStore, KVEvent
+from ..labels import Label, LabelSet
+
+# Remote identities are remapped into per-cluster ranges so two
+# clusters' numeric spaces never collide locally (reference: clustermesh
+# requires disjoint identity ranges / uses cluster-id bits 16-23).
+CLUSTER_ID_SHIFT = 16
+MAX_CLUSTER_ID = 255
+
+
+class RemoteCluster:
+    """One remote cluster's watches (identities + ipcache)."""
+
+    def __init__(self, name: str, cluster_id: int, kv: InMemoryKVStore,
+                 allocator, upsert_ipcache: Callable[[str, int], None],
+                 delete_ipcache: Callable[[str], None]):
+        if not 1 <= cluster_id <= MAX_CLUSTER_ID:
+            raise ValueError(f"cluster id {cluster_id} out of range")
+        self.name = name
+        self.cluster_id = cluster_id
+        self._allocator = allocator
+        self._upsert = upsert_ipcache
+        self._delete = delete_ipcache
+        self._lock = threading.Lock()
+        self._ip_identity: Dict[str, int] = {}
+        self._cancels = [
+            kv.watch_prefix(f"{DEFAULT_PREFIX}/id/", self._on_identity),
+            kv.watch_prefix("cilium/state/ip/v1/", self._on_ip),
+        ]
+
+    def _remap(self, remote_numeric: int) -> int:
+        return (self.cluster_id << CLUSTER_ID_SHIFT) | (
+            remote_numeric & ((1 << CLUSTER_ID_SHIFT) - 1))
+
+    def _on_identity(self, ev: KVEvent) -> None:
+        if ev.kind == "delete":
+            return  # remote GC; local refcounts drive removal
+        remote_num = int(ev.key.rsplit("/", 1)[1])
+        local_num = self._remap(remote_num)
+        if self._allocator.lookup_by_id(local_num) is not None:
+            return
+        labels = LabelSet(
+            list(LabelSet.parse(
+                *[s for s in ev.value.decode().split(";") if s]).labels)
+            + [Label("k8s", "io.cilium.k8s.policy.cluster",
+                     self.name)])
+        self._allocator.restore_identity(local_num, labels)
+
+    def _on_ip(self, ev: KVEvent) -> None:
+        """Remote endpoint IP -> identity mapping (the ipcache shared
+        store: ``cilium/state/ip/v1/<ip>`` -> remote numeric id)."""
+        ip = ev.key.rsplit("/", 1)[1]
+        suffix = "/128" if ":" in ip else "/32"
+        if ev.kind == "delete":
+            with self._lock:
+                self._ip_identity.pop(ip, None)
+            self._delete(ip + suffix)
+            return
+        local_num = self._remap(int(ev.value))
+        with self._lock:
+            self._ip_identity[ip] = local_num
+        self._upsert(ip + suffix, local_num)
+
+    def num_mirrored(self) -> int:
+        with self._lock:
+            return len(self._ip_identity)
+
+    def close(self) -> None:
+        for c in self._cancels:
+            c()
+
+
+class ClusterMesh:
+    """The local end: one RemoteCluster per peer (pkg/clustermesh)."""
+
+    def __init__(self, allocator, upsert_ipcache, delete_ipcache):
+        self._allocator = allocator
+        self._upsert = upsert_ipcache
+        self._delete = delete_ipcache
+        self._remotes: Dict[str, RemoteCluster] = {}
+
+    def connect(self, name: str, cluster_id: int,
+                kv: InMemoryKVStore) -> RemoteCluster:
+        if name in self._remotes:
+            raise ValueError(f"cluster {name!r} already connected")
+        rc = RemoteCluster(name, cluster_id, kv, self._allocator,
+                           self._upsert, self._delete)
+        self._remotes[name] = rc
+        return rc
+
+    def disconnect(self, name: str) -> bool:
+        rc = self._remotes.pop(name, None)
+        if rc is None:
+            return False
+        rc.close()
+        return True
+
+    def status(self) -> List[dict]:
+        return [{"name": rc.name, "cluster-id": rc.cluster_id,
+                 "ips-mirrored": rc.num_mirrored()}
+                for rc in self._remotes.values()]
+
+
+def publish_endpoint_ip(kv: InMemoryKVStore, ip: str,
+                        numeric_id: int) -> None:
+    """Agent side of the ipcache shared store: announce a local
+    endpoint's IP -> identity for remote clusters to mirror."""
+    kv.update(f"cilium/state/ip/v1/{ip}", str(numeric_id).encode())
+
+
+def withdraw_endpoint_ip(kv: InMemoryKVStore, ip: str) -> None:
+    kv.delete(f"cilium/state/ip/v1/{ip}")

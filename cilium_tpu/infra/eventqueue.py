@@ -1,0 +1,88 @@
+"""EventQueue: serialized, droppable event processing per owner.
+
+Reference: upstream cilium ``pkg/eventqueue`` — each endpoint owns a
+queue; events (regenerations, policy recalculations) execute strictly
+in order on one consumer goroutine, can be waited on, and a closed
+queue drains deterministically.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional
+
+
+class Event:
+    """One queued unit of work; ``wait()`` blocks until it ran (or the
+    queue closed underneath it)."""
+
+    def __init__(self, fn: Callable[[], Any]):
+        self._fn = fn
+        self._done = threading.Event()
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self.dropped = False
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def _run(self) -> None:
+        try:
+            self.result = self._fn()
+        except BaseException as e:  # surfaced via .error, never lost
+            self.error = e
+        finally:
+            self._done.set()
+
+    def _drop(self) -> None:
+        self.dropped = True
+        self._done.set()
+
+
+class EventQueue:
+    def __init__(self, name: str = "", maxsize: int = 0):
+        self.name = name
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize)
+        self._closed = threading.Event()
+        self._drained = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"eventq-{name or id(self)}")
+        self._thread.start()
+
+    def enqueue(self, fn: Callable[[], Any]) -> Event:
+        """Queue fn; returns its Event.  A closed queue drops
+        immediately (event.dropped = True), like the reference's
+        nil-return after Close."""
+        ev = Event(fn)
+        if self._closed.is_set():
+            ev._drop()
+            return ev
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:
+            ev._drop()
+        return ev
+
+    def _loop(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                break
+            ev._run()
+        # anything that slipped in behind the close sentinel drops
+        while not self._q.empty():
+            ev = self._q.get_nowait()
+            if ev is not None:
+                ev._drop()
+        self._drained.set()
+
+    def close(self, wait: bool = True,
+              timeout: Optional[float] = 10.0) -> None:
+        """Stop accepting NEW events; everything already queued runs
+        to completion first (reference: eventqueue Stop + drain)."""
+        self._closed.set()
+        self._q.put(None)
+        if wait:
+            self._drained.wait(timeout)

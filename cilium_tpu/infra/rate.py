@@ -1,0 +1,85 @@
+"""Rate limiting: token bucket + the api-rate-limit style gate.
+
+Reference: upstream cilium ``pkg/rate`` (golang.org/x/time/rate
+wrapper) — API calls and reconciliations pass through named limiters
+with burst + sustained-rate knobs, surfaced in metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class TokenBucket:
+    def __init__(self, rate: float, burst: int):
+        """``rate`` tokens/second sustained, up to ``burst`` stored."""
+        if rate <= 0 or burst < 1:
+            raise ValueError("rate must be > 0 and burst >= 1")
+        self.rate = rate
+        self.burst = burst
+        self._tokens = float(burst)
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(self.burst,
+                           self._tokens + (now - self._t) * self.rate)
+        self._t = now
+
+    def allow(self, n: int = 1) -> bool:
+        """Non-blocking: take n tokens if available."""
+        with self._lock:
+            self._refill()
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def wait(self, n: int = 1, timeout: Optional[float] = None) -> bool:
+        """Blocking acquire; False on timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                self._refill()
+                if self._tokens >= n:
+                    self._tokens -= n
+                    return True
+                need = (n - self._tokens) / self.rate
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                need = min(need, remaining)
+            time.sleep(min(need, 0.1))
+
+
+class LimiterSet:
+    """Named limiters (the api-rate-limit map); unknown names pass."""
+
+    def __init__(self):
+        self._limiters: Dict[str, TokenBucket] = {}
+        self._stats: Dict[str, Dict[str, int]] = {}
+        self._lock = threading.Lock()
+
+    def configure(self, name: str, rate: float, burst: int) -> None:
+        with self._lock:
+            self._limiters[name] = TokenBucket(rate, burst)
+            self._stats.setdefault(name, {"allowed": 0, "limited": 0})
+
+    def allow(self, name: str) -> bool:
+        with self._lock:
+            lim = self._limiters.get(name)
+            st = self._stats.setdefault(name,
+                                        {"allowed": 0, "limited": 0})
+        if lim is None or lim.allow():
+            st["allowed"] += 1
+            return True
+        st["limited"] += 1
+        return False
+
+    def stats(self) -> Dict[str, Dict[str, int]]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._stats.items()}

@@ -1,0 +1,132 @@
+"""IPAM: pod IP allocation (cluster-pool mode).
+
+Reference: upstream cilium ``pkg/ipam`` — the agent carves pod IPs out
+of the node's podCIDR; in cluster-pool mode the operator assigns each
+node a podCIDR from cluster-wide pools.  The ENI/Azure cloud
+allocators are out of scope (no cloud API in a TPU pod); cluster-pool
+is the mode the reference's own e2e runs on.
+
+Two pieces:
+
+- :class:`ClusterPool` — operator side: carve per-node podCIDRs out of
+  the cluster pool (kvstore-backed so every operator replica agrees).
+- :class:`NodeIPAM` — agent side: allocate/release pod IPs from the
+  node's podCIDR with a free-bitmap (O(1) alloc, restart-restorable).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+import json
+import threading
+from typing import Dict, List, Optional
+
+POOL_PREFIX = "cilium/state/podcidrs/v1"
+
+
+class ClusterPool:
+    """Carve node podCIDRs from a cluster pool (operator side)."""
+
+    def __init__(self, kv, cluster_cidr: str = "10.0.0.0/8",
+                 node_mask: int = 24):
+        self.kv = kv
+        self.cluster = ipaddress.ip_network(cluster_cidr)
+        self.node_mask = node_mask
+        if node_mask < self.cluster.prefixlen:
+            raise ValueError("node mask shorter than the cluster pool")
+
+    def allocate_node_cidr(self, node: str) -> str:
+        """Assign (or return) the node's podCIDR — create-only on the
+        kvstore makes concurrent operators collision-free."""
+        key = f"{POOL_PREFIX}/{node}"
+        existing = self.kv.get(key)
+        if existing is not None:
+            return json.loads(existing)["cidr"]
+        used = {json.loads(v)["cidr"]
+                for v in self.kv.list_prefix(POOL_PREFIX + "/").values()}
+        for subnet in self.cluster.subnets(new_prefix=self.node_mask):
+            cidr = str(subnet)
+            if cidr in used:
+                continue
+            if self.kv.create_only(key, json.dumps(
+                    {"node": node, "cidr": cidr}).encode()):
+                return cidr
+            # another operator claimed this node concurrently: reuse
+            raced = self.kv.get(key)
+            if raced is not None:
+                return json.loads(raced)["cidr"]
+        raise RuntimeError("cluster pool exhausted")
+
+    def release_node_cidr(self, node: str) -> bool:
+        return self.kv.delete(f"{POOL_PREFIX}/{node}")
+
+    def assignments(self) -> Dict[str, str]:
+        return {json.loads(v)["node"]: json.loads(v)["cidr"]
+                for v in self.kv.list_prefix(POOL_PREFIX + "/").values()}
+
+
+class NodeIPAM:
+    """Per-node pod IP allocator over the podCIDR (agent side).
+
+    The network and broadcast addresses plus the first host (gateway,
+    matching the reference's router IP) are reserved."""
+
+    def __init__(self, pod_cidr: str):
+        self.cidr = ipaddress.ip_network(pod_cidr)
+        n = self.cidr.num_addresses
+        if n < 4:
+            raise ValueError(f"podCIDR {pod_cidr} too small")
+        self._lock = threading.Lock()
+        self._used: set = {0, 1, n - 1}  # network, gateway, broadcast
+        self._owner: Dict[int, str] = {}
+        self._next = 2
+
+    @property
+    def gateway(self) -> str:
+        return str(self.cidr.network_address + 1)
+
+    def allocate(self, owner: str = "") -> str:
+        with self._lock:
+            n = self.cidr.num_addresses
+            for _ in range(n):
+                idx = self._next
+                self._next = 2 + (self._next - 1) % (n - 3)
+                if idx not in self._used:
+                    self._used.add(idx)
+                    if owner:
+                        self._owner[idx] = owner
+                    return str(self.cidr.network_address + idx)
+            raise RuntimeError(f"podCIDR {self.cidr} exhausted")
+
+    def allocate_specific(self, ip: str, owner: str = "") -> str:
+        """Restore path: re-claim a checkpointed pod IP."""
+        addr = ipaddress.ip_address(ip)
+        idx = int(addr) - int(self.cidr.network_address)
+        with self._lock:
+            if not 0 <= idx < self.cidr.num_addresses:
+                raise ValueError(f"{ip} outside podCIDR {self.cidr}")
+            if idx in self._used:
+                raise ValueError(f"{ip} already allocated")
+            self._used.add(idx)
+            if owner:
+                self._owner[idx] = owner
+        return ip
+
+    def release(self, ip: str) -> bool:
+        idx = int(ipaddress.ip_address(ip)) - int(
+            self.cidr.network_address)
+        with self._lock:
+            if idx in (0, 1, self.cidr.num_addresses - 1):
+                return False  # reserved
+            if idx not in self._used:
+                return False
+            self._used.discard(idx)
+            self._owner.pop(idx, None)
+            return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            used = len(self._used) - 3
+        return {"cidr": str(self.cidr), "used": used,
+                "capacity": self.cidr.num_addresses - 3,
+                "gateway": self.gateway}

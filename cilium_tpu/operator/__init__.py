@@ -1,0 +1,68 @@
+"""Operator: the cluster-singleton housekeeping process.
+
+Reference: upstream cilium ``operator/`` — one replica per cluster
+garbage-collects unreferenced identities, assigns cluster-pool
+podCIDRs to nodes, and cleans up state of departed nodes.  The heavy
+k8s parts (CEP batching, CRD management) have no analogue here; the
+three responsibilities above do, and all ride the kvstore.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Dict, Optional
+
+from ..health import NODES_PREFIX
+from ..ipam import ClusterPool
+from ..kvstore.allocator import DEFAULT_PREFIX, KVStoreAllocatorBackend
+
+
+class Operator:
+    def __init__(self, kv, cluster_cidr: str = "10.0.0.0/8",
+                 node_mask: int = 24):
+        self.kv = kv
+        self._alloc_gc = KVStoreAllocatorBackend(kv, node="operator")
+        self.pool = ClusterPool(kv, cluster_cidr, node_mask)
+        self.identities_collected = 0
+        self.cidrs_collected = 0
+        self.sweeps = 0
+
+    def sweep(self) -> dict:
+        """One housekeeping pass (drive from a controller):
+        1. identity GC — master keys with no live node refs;
+        2. podCIDR assignment for registered nodes without one;
+        3. podCIDR reclamation for nodes whose lease expired."""
+        collected = self._alloc_gc.gc()
+        self.identities_collected += collected
+
+        live = {n["name"] for n in self._nodes()}
+        assigned = self.pool.assignments()
+        cidrs_assigned = 0
+        for name in live:
+            if name not in assigned:
+                self.pool.allocate_node_cidr(name)
+                cidrs_assigned += 1
+        cidrs_reclaimed = 0
+        for name in list(assigned):
+            if name not in live:
+                self.pool.release_node_cidr(name)
+                cidrs_reclaimed += 1
+        self.cidrs_collected += cidrs_reclaimed
+        self.sweeps += 1
+        return {
+            "identities-collected": collected,
+            "podcidrs-assigned": cidrs_assigned,
+            "podcidrs-reclaimed": cidrs_reclaimed,
+        }
+
+    def _nodes(self):
+        return [json.loads(v) for v in
+                self.kv.list_prefix(NODES_PREFIX + "/").values()]
+
+    def status(self) -> dict:
+        return {
+            "sweeps": self.sweeps,
+            "identities-collected": self.identities_collected,
+            "podcidrs": self.pool.assignments(),
+        }

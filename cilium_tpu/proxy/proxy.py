@@ -102,10 +102,17 @@ class L7Proxy:
             # pass-through (reference: proxy without policy forwards)
             return np.ones(len(raw), dtype=np.uint8)
         if t.rules.shape[0]:
+            import jax
             import jax.numpy as jnp
 
-            allow = np.array(l7_verdict_jit(jnp.asarray(t.rules),
-                                            jnp.asarray(rows)))
+            # the proxy lives host-side (requests arrive here); the
+            # match tensor is tiny, so it runs on the LOCAL cpu
+            # backend — a per-request-batch round trip to a remote/
+            # tunneled accelerator would be pure latency (measured
+            # ~180ms/batch through the harness tunnel)
+            with jax.default_device(jax.devices("cpu")[0]):
+                allow = np.array(l7_verdict_jit(jnp.asarray(t.rules),
+                                                jnp.asarray(rows)))
         else:
             allow = np.zeros(len(raw), dtype=bool)
         matchers = t.host_matchers.get(port)

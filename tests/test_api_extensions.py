@@ -76,6 +76,18 @@ class TestConfigPatch:
         ctrl = d.controllers.get("fqdn-gc")
         assert ctrl is not None and ctrl._interval == 2.0
 
+    def test_debug_profile_captures_trace(self, served, tmp_path):
+        """The pprof analogue: /debug/profile runs the jax profiler
+        and returns the trace dir."""
+        import os
+
+        d, c = served
+        out = c._request("GET",
+                         f"/debug/profile?seconds=0.1&dir={tmp_path}")
+        assert out["trace-dir"] == str(tmp_path)
+        # the profiler wrote its plugin directory structure
+        assert os.path.isdir(os.path.join(str(tmp_path), "plugins"))
+
     def test_cluster_health_404_without_kvstore(self, served):
         d, c = served
         with pytest.raises(APIError) as ei:
