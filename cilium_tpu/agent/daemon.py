@@ -62,6 +62,10 @@ class DaemonConfig:
     # node registry so peers' health meshes can probe it
     api_socket_path: Optional[str] = None
     health_probe_interval: float = 10.0
+    # egress masquerade (bpf/lib/nat.h analogue; service/nat.py)
+    masquerade: bool = False
+    node_ip: Optional[str] = None
+    non_masquerade_cidrs: Tuple[str, ...] = ("10.0.0.0/8",)
 
 
 class Daemon:
@@ -147,6 +151,21 @@ class Daemon:
         from ..service import ServiceManager
 
         self.services = ServiceManager()
+        # egress masquerade (applies after LB, before the datapath, so
+        # CT tracks the post-NAT tuple)
+        self.nat = None
+        if self.config.masquerade:
+            if not self.config.node_ip:
+                # silently running WITHOUT masquerade when the operator
+                # asked for it would leak pod source IPs
+                raise ValueError(
+                    "masquerade=True requires node_ip to be set")
+            from ..service.nat import NATConfig
+
+            self.nat = NATConfig(
+                node_ip=self.config.node_ip,
+                non_masquerade_cidrs=self.config.non_masquerade_cidrs,
+            ).compile()
 
         # fqdn loop: DNS answers observed by the proxy become
         # identities + ipcache entries (reference: pkg/fqdn)
@@ -300,18 +319,23 @@ class Daemon:
         """One packet tensor through LB -> datapath -> monitor."""
         if now is None:
             now = self._now()
-        if len(self.services):
-            from ..service import lb_stage_jit
-
+        if len(self.services) or self.nat is not None:
             import jax.numpy as jnp
 
-            # hdr stays ON DEVICE between the LB stage and the
-            # datapath step (loader.step accepts device arrays); the
-            # one host fetch below feeds event decode, which needed
-            # the (possibly DNAT-rewritten) rows anyway
-            hdr_dev, _hits = lb_stage_jit(self.services.tensors(),
-                                          jnp.asarray(
-                                              np.ascontiguousarray(hdr)))
+            # hdr stays ON DEVICE across the LB -> SNAT -> datapath
+            # stages (loader.step accepts device arrays); the one host
+            # fetch below feeds event decode, which needed the
+            # rewritten rows anyway
+            hdr_dev = jnp.asarray(np.ascontiguousarray(hdr))
+            if len(self.services):
+                from ..service import lb_stage_jit
+
+                hdr_dev, _hits = lb_stage_jit(self.services.tensors(),
+                                              hdr_dev)
+            if self.nat is not None:
+                from ..service.nat import snat_stage_jit
+
+                hdr_dev, _masq = snat_stage_jit(self.nat, hdr_dev)
             out, row_map = self.loader.step(hdr_dev, now)
             hdr = np.asarray(hdr_dev)
             batch = decode_out(out, hdr, row_map.numeric_array(),

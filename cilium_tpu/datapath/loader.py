@@ -95,9 +95,9 @@ class TPULoader(Loader):
     """The real datapath: device tensors + fused jit pipeline."""
 
     def __init__(self, ct_capacity: int = 1 << 20):
-        import threading
-
         import jax.numpy as jnp  # deferred so CPU-only tools can import
+
+        from ..infra.lockdebug import make_lock
 
         self._jnp = jnp
         self.ct_capacity = ct_capacity
@@ -106,8 +106,10 @@ class TPULoader(Loader):
         self.attach_count = 0
         # attach() runs on API/regeneration threads while the serve
         # loop is in step(); every state swap must be atomic or a
-        # concurrent step would resurrect the pre-attach tensors
-        self._lock = threading.Lock()
+        # concurrent step would resurrect the pre-attach tensors.
+        # make_lock: plain Lock normally, order-checked DebugLock
+        # under CILIUM_TPU_LOCKDEBUG=1 (SURVEY §5 race detection)
+        self._lock = make_lock("datapath-loader")
 
     def attach(self, policies, ipcache, ep_policy, row_map) -> None:
         from .conntrack import CTTable

@@ -86,9 +86,14 @@ class Recorder:
         if not active:
             return
         for rec in active:
-            keep = np.ones(len(batch), dtype=bool)
-            for f in rec.filters:
-                keep &= _mask_batch(f, batch)
+            if rec.filters:
+                # whitelist semantics: a packet matching ANY filter is
+                # captured (the observer's get_flows contract)
+                keep = np.zeros(len(batch), dtype=bool)
+                for f in rec.filters:
+                    keep |= _mask_batch(f, batch)
+            else:
+                keep = np.ones(len(batch), dtype=bool)
             idx = np.nonzero(keep)[0]
             with self._lock:
                 room = rec.max_packets - rec.captured
@@ -98,12 +103,14 @@ class Recorder:
 
 
 def _mask_batch(f: FlowFilter, batch: EventBatch) -> np.ndarray:
-    """FlowFilter over an EventBatch (the observer ring applies the
-    same fields over its SoA arrays)."""
+    """FlowFilter over an EventBatch — EVERY FlowFilter field applies
+    (the observer ring implements the same contract over its SoA
+    arrays; an ignored field would silently widen a capture)."""
     import ipaddress
 
     from ..core.packets import (COL_DPORT, COL_DST_IP3, COL_PROTO,
                                 COL_SPORT, COL_SRC_IP3)
+    from ..datapath.conntrack import CT_REPLY
 
     m = np.ones(len(batch), dtype=bool)
     hdr = batch.hdr
@@ -120,4 +127,17 @@ def _mask_batch(f: FlowFilter, batch: EventBatch) -> np.ndarray:
     if f.destination_ip:
         m &= hdr[:, COL_DST_IP3] == int(
             ipaddress.IPv4Address(f.destination_ip))
+    if f.source_identity is not None or f.destination_identity \
+            is not None:
+        # the batch carries ONE identity column (the remote peer);
+        # match it for whichever side the filter names
+        want = (f.source_identity if f.source_identity is not None
+                else f.destination_identity)
+        m &= batch.identity == want
+    if f.reply is not None:
+        m &= (batch.ct_state == CT_REPLY) == f.reply
+    if f.since is not None:
+        m &= np.full(len(batch), batch.timestamp >= f.since)
+    if f.until is not None:
+        m &= np.full(len(batch), batch.timestamp <= f.until)
     return m

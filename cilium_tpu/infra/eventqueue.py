@@ -46,6 +46,11 @@ class EventQueue:
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize)
         self._closed = threading.Event()
         self._drained = threading.Event()
+        # serializes the closed-check-then-put against close()'s
+        # set-then-sentinel: without it an event can slip in BEHIND
+        # the sentinel after the drain finished — neither run nor
+        # dropped, and its wait() would hang forever
+        self._enqueue_mutex = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name=f"eventq-{name or id(self)}")
@@ -56,13 +61,14 @@ class EventQueue:
         immediately (event.dropped = True), like the reference's
         nil-return after Close."""
         ev = Event(fn)
-        if self._closed.is_set():
-            ev._drop()
-            return ev
-        try:
-            self._q.put_nowait(ev)
-        except queue.Full:
-            ev._drop()
+        with self._enqueue_mutex:
+            if self._closed.is_set():
+                ev._drop()
+                return ev
+            try:
+                self._q.put_nowait(ev)
+            except queue.Full:
+                ev._drop()
         return ev
 
     def _loop(self) -> None:
@@ -82,7 +88,8 @@ class EventQueue:
               timeout: Optional[float] = 10.0) -> None:
         """Stop accepting NEW events; everything already queued runs
         to completion first (reference: eventqueue Stop + drain)."""
-        self._closed.set()
-        self._q.put(None)
+        with self._enqueue_mutex:
+            self._closed.set()
+            self._q.put(None)
         if wait:
             self._drained.wait(timeout)

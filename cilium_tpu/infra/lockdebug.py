@@ -1,0 +1,118 @@
+"""Lock-order debugging: the pkg/lock + go-deadlock analogue.
+
+Reference: upstream cilium builds with a ``lockdebug`` tag wrapping
+every mutex in go-deadlock, which reports lock-order inversions and
+too-long holds in CI.  Here: :class:`DebugLock` records the global
+acquisition-order graph; acquiring B while holding A adds edge A->B,
+and an edge that closes a cycle is a potential deadlock, reported
+immediately with both stacks' names.  Zero overhead when disabled —
+:func:`make_lock` returns a plain ``threading.Lock`` unless
+``CILIUM_TPU_LOCKDEBUG=1`` (tests enable it explicitly).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderError(RuntimeError):
+    pass
+
+
+class _Registry:
+    """Process-global acquisition-order graph."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._edges: Dict[str, Set[str]] = {}  # held -> then-acquired
+        self.violations: List[Tuple[str, str]] = []
+
+    def record(self, held: List[str], acquiring: str,
+               raise_on_cycle: bool) -> None:
+        with self._lock:
+            for h in held:
+                if h == acquiring:
+                    continue
+                self._edges.setdefault(h, set()).add(acquiring)
+                # does acquiring -> ... -> h already exist?  Then the
+                # new edge h -> acquiring closes an order cycle.
+                if self._reachable(acquiring, h):
+                    self.violations.append((h, acquiring))
+                    if raise_on_cycle:
+                        raise LockOrderError(
+                            f"lock-order inversion: {acquiring!r} is "
+                            f"acquired while holding {h!r}, but the "
+                            f"reverse order exists elsewhere")
+
+    def _reachable(self, src: str, dst: str) -> bool:
+        seen: Set[str] = set()
+        stack = [src]
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(self._edges.get(n, ()))
+        return False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._edges.clear()
+            self.violations.clear()
+
+
+REGISTRY = _Registry()
+_held = threading.local()
+
+
+class DebugLock:
+    """A named lock that reports order inversions (reentrant-safe via
+    the per-thread held list)."""
+
+    def __init__(self, name: str, raise_on_cycle: bool = True):
+        self.name = name
+        self._lock = threading.Lock()
+        self._raise = raise_on_cycle
+
+    def acquire(self, blocking: bool = True,
+                timeout: float = -1) -> bool:
+        held = getattr(_held, "names", None)
+        if held is None:
+            held = _held.names = []
+        REGISTRY.record(list(held), self.name, self._raise)
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            held.append(self.name)
+        return ok
+
+    def release(self) -> None:
+        held = getattr(_held, "names", [])
+        if self.name in held:
+            held.remove(self.name)
+        self._lock.release()
+
+    def __enter__(self) -> "DebugLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+
+def enabled() -> bool:
+    return os.environ.get("CILIUM_TPU_LOCKDEBUG", "") == "1"
+
+
+def make_lock(name: str):
+    """Factory the subsystems use: plain Lock in production, DebugLock
+    under CILIUM_TPU_LOCKDEBUG=1 (CI)."""
+    if enabled():
+        return DebugLock(name)
+    return threading.Lock()

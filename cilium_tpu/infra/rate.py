@@ -39,7 +39,11 @@ class TokenBucket:
             return False
 
     def wait(self, n: int = 1, timeout: Optional[float] = None) -> bool:
-        """Blocking acquire; False on timeout."""
+        """Blocking acquire; False on timeout.  n > burst can never be
+        satisfied (tokens cap at burst) and is an error, matching
+        golang.org/x/time/rate."""
+        if n > self.burst:
+            raise ValueError(f"wait({n}) exceeds burst {self.burst}")
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             with self._lock:
@@ -70,15 +74,18 @@ class LimiterSet:
             self._stats.setdefault(name, {"allowed": 0, "limited": 0})
 
     def allow(self, name: str) -> bool:
+        # stats increments stay under the set lock (lost updates would
+        # underreport the metric surface); TokenBucket.allow is
+        # non-blocking and lock-ordered set -> bucket consistently
         with self._lock:
             lim = self._limiters.get(name)
             st = self._stats.setdefault(name,
                                         {"allowed": 0, "limited": 0})
-        if lim is None or lim.allow():
-            st["allowed"] += 1
-            return True
-        st["limited"] += 1
-        return False
+            if lim is None or lim.allow():
+                st["allowed"] += 1
+                return True
+            st["limited"] += 1
+            return False
 
     def stats(self) -> Dict[str, Dict[str, int]]:
         with self._lock:

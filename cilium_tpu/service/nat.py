@@ -1,0 +1,108 @@
+"""NAT: egress masquerade (SNAT) schema + device stage.
+
+Reference: upstream ``bpf/lib/nat.h`` + ``pkg/maps/nat`` — egress
+traffic leaving the cluster is source-NATed to the node IP, with a
+NAT map remembering the translation for reverse application on
+replies.  SURVEY.md §2b keeps NAT at schema-level scope for this
+rebuild; what is implemented:
+
+- :class:`NATConfig` — masquerade prefixes (destinations that should
+  NOT be masqueraded, i.e. cluster-internal ranges) + the node IP.
+- :func:`snat_stage` — batched egress rewrite: src -> node IP for
+  packets leaving the cluster ranges.  PORT-PRESERVING (documented
+  divergence: the reference allocates a free port per flow from the
+  NAT map; here source ports pass through, which is collision-free
+  per node as long as local endpoints don't share sports to one
+  destination — the common CNI case).
+- reverse translation rides conntrack: the CT entry is created with
+  the POST-NAT tuple, so replies match it and the deployment's
+  ingress adapter restores the original destination from the CT
+  reverse lookup.
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from dataclasses import dataclass, field
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.packets import COL_DIR, COL_FAMILY, COL_SRC_IP3
+
+
+@dataclass
+class NATConfig:
+    """Masquerade configuration (node-level)."""
+
+    node_ip: str
+    # destinations inside these ranges keep the original source
+    # (cluster-internal traffic; reference: --native-routing-cidr /
+    # ipMasqAgent nonMasqueradeCIDRs)
+    non_masquerade_cidrs: Tuple[str, ...] = ("10.0.0.0/8",)
+    enabled: bool = True
+
+    def compile(self) -> "NATTensors":
+        nets = [ipaddress.ip_network(c)
+                for c in self.non_masquerade_cidrs]
+        nets = [n for n in nets if n.version == 4]
+        k = max(len(nets), 1)
+        # an EMPTY exclusion list must match nothing ("masquerade
+        # everything"); a zero pad row (dst & 0 == 0) would match
+        # every destination and silently disable SNAT — pad with an
+        # unsatisfiable row instead (dst & 0 == 0xFFFFFFFF)
+        net = np.full(k, 0xFFFFFFFF, dtype=np.uint32)
+        mask = np.zeros(k, dtype=np.uint32)
+        for i, n in enumerate(nets):
+            net[i] = int(n.network_address)
+            mask[i] = int(n.netmask)
+        return NATTensors(
+            node_ip=jnp.uint32(int(ipaddress.IPv4Address(self.node_ip))),
+            net=jnp.asarray(net),
+            mask=jnp.asarray(mask),
+            enabled=self.enabled,
+        )
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class NATTensors:
+    node_ip: jnp.ndarray  # [] uint32
+    net: jnp.ndarray  # [K] uint32 non-masquerade networks
+    mask: jnp.ndarray  # [K] uint32
+    enabled: bool
+
+    def tree_flatten(self):
+        return ((self.node_ip, self.net, self.mask), self.enabled)
+
+    @classmethod
+    def tree_unflatten(cls, enabled, children):
+        return cls(*children, enabled=enabled)
+
+
+def snat_stage(t: NATTensors, hdr: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Masquerade egress IPv4 leaving the cluster: src -> node IP.
+
+    Returns (hdr', masqueraded [N] bool).  Composes after the LB
+    stage and before the datapath step (the CT entry then carries the
+    post-NAT tuple, which is what replies will match)."""
+    from ..core.packets import COL_DST_IP3
+
+    hdr = hdr.astype(jnp.uint32)
+    if not t.enabled:
+        return hdr, jnp.zeros(hdr.shape[0], dtype=bool)
+    dst = hdr[:, COL_DST_IP3]
+    internal = jnp.any(
+        (dst[:, None] & t.mask[None, :]) == t.net[None, :], axis=1)
+    egress = hdr[:, COL_DIR] == 1
+    v4 = hdr[:, COL_FAMILY] == 4
+    masq = egress & v4 & ~internal
+    new_src = jnp.where(masq, t.node_ip, hdr[:, COL_SRC_IP3])
+    hdr = hdr.at[:, COL_SRC_IP3].set(new_src)
+    return hdr, masq
+
+
+snat_stage_jit = jax.jit(snat_stage)

@@ -1,0 +1,86 @@
+"""Lock-order debugging (SURVEY.md §5 race detection: the pkg/lock
+lockdebug / go-deadlock analogue)."""
+
+import threading
+
+import pytest
+
+from cilium_tpu.infra.lockdebug import (
+    DebugLock,
+    LockOrderError,
+    REGISTRY,
+    make_lock,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    REGISTRY.reset()
+    yield
+    REGISTRY.reset()
+
+
+class TestLockOrder:
+    def test_consistent_order_is_silent(self):
+        a, b = DebugLock("A"), DebugLock("B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert REGISTRY.violations == []
+
+    def test_inversion_detected(self):
+        a, b = DebugLock("A"), DebugLock("B")
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(LockOrderError, match="inversion"):
+                a.acquire()
+        assert REGISTRY.violations == [("B", "A")]
+
+    def test_three_lock_cycle(self):
+        a, b, c = DebugLock("A"), DebugLock("B"), DebugLock("C")
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with c:
+            with pytest.raises(LockOrderError):
+                a.acquire()
+
+    def test_cross_thread_graph_is_shared(self):
+        """The order graph is global: thread 1 establishes A->B,
+        thread 2's B->A attempt is the classic deadlock shape."""
+        a, b = DebugLock("A"), DebugLock("B")
+
+        def t1():
+            with a:
+                with b:
+                    pass
+
+        th = threading.Thread(target=t1)
+        th.start()
+        th.join()
+        got = []
+
+        def t2():
+            with b:
+                try:
+                    a.acquire()
+                    a.release()
+                except LockOrderError as e:
+                    got.append(e)
+
+        th = threading.Thread(target=t2)
+        th.start()
+        th.join()
+        assert got, "cross-thread inversion must be detected"
+
+    def test_factory_respects_env(self, monkeypatch):
+        monkeypatch.setenv("CILIUM_TPU_LOCKDEBUG", "1")
+        assert isinstance(make_lock("x"), DebugLock)
+        monkeypatch.delenv("CILIUM_TPU_LOCKDEBUG")
+        assert isinstance(make_lock("x"), type(threading.Lock()))

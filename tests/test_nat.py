@@ -1,0 +1,116 @@
+"""Egress masquerade (SNAT schema + stage; SURVEY.md §2a row 3 NAT)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cilium_tpu.core.packets import (
+    COL_DIR,
+    COL_DST_IP3,
+    COL_FAMILY,
+    COL_SRC_IP3,
+    N_COLS,
+)
+from cilium_tpu.service.nat import NATConfig, snat_stage_jit
+
+
+def _rows(entries):
+    out = np.zeros((len(entries), N_COLS), dtype=np.uint32)
+    for i, (src, dst, dirn) in enumerate(entries):
+        out[i, COL_SRC_IP3] = src
+        out[i, COL_DST_IP3] = dst
+        out[i, COL_DIR] = dirn
+        out[i, COL_FAMILY] = 4
+    return out
+
+
+POD = 0x0A000201  # 10.0.2.1
+PEER = 0x0A000101  # 10.0.1.1 (cluster-internal)
+WORLD = 0x08080808  # 8.8.8.8
+NODE = 0xC0A80001  # 192.168.0.1
+
+
+class TestSNAT:
+    def test_egress_to_world_masquerades(self):
+        t = NATConfig(node_ip="192.168.0.1").compile()
+        hdr, masq = snat_stage_jit(t, jnp.asarray(_rows([
+            (POD, WORLD, 1),   # egress to world: masquerade
+            (POD, PEER, 1),    # egress cluster-internal: keep
+            (WORLD, POD, 0),   # ingress: never
+        ])))
+        hdr = np.asarray(hdr)
+        assert list(np.asarray(masq)) == [True, False, False]
+        assert hdr[0, COL_SRC_IP3] == NODE
+        assert hdr[1, COL_SRC_IP3] == POD
+        assert hdr[2, COL_SRC_IP3] == WORLD
+
+    def test_empty_exclusions_masquerade_everything(self):
+        """r03 review: an empty non-masquerade list padded with a
+        zero row matched every destination and silently disabled
+        SNAT."""
+        t = NATConfig(node_ip="192.168.0.1",
+                      non_masquerade_cidrs=()).compile()
+        hdr, masq = snat_stage_jit(t, jnp.asarray(_rows([
+            (POD, WORLD, 1), (POD, PEER, 1)])))
+        assert list(np.asarray(masq)) == [True, True]
+
+    def test_masquerade_without_node_ip_rejected(self):
+        from cilium_tpu.agent import Daemon, DaemonConfig
+
+        with pytest.raises(ValueError, match="node_ip"):
+            Daemon(DaemonConfig(backend="interpreter",
+                                masquerade=True))
+
+    def test_disabled_is_identity(self):
+        t = NATConfig(node_ip="192.168.0.1", enabled=False).compile()
+        rows = _rows([(POD, WORLD, 1)])
+        hdr, masq = snat_stage_jit(t, jnp.asarray(rows))
+        np.testing.assert_array_equal(np.asarray(hdr), rows)
+        assert not np.asarray(masq).any()
+
+    def test_daemon_masquerade_end_to_end(self):
+        from cilium_tpu.agent import Daemon, DaemonConfig
+        from cilium_tpu.core import TCP_SYN, make_batch
+
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12,
+                                masquerade=True,
+                                node_ip="192.168.0.1"))
+        ep = d.add_endpoint("client-1", ("10.0.2.1",),
+                            ["k8s:app=client"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "client"}},
+            "egress": [{"toEntities": ["world"]}],
+        }])
+        d.start()
+        evb = d.process_batch(make_batch([dict(
+            src="10.0.2.1", dst="8.8.8.8", sport=41000, dport=443,
+            proto=6, flags=TCP_SYN, ep=ep.id, dir=1)]).data, now=10)
+        assert list(evb.verdict) == [1]
+        # the monitor sees the post-NAT source (node IP)
+        from cilium_tpu.core.packets import COL_SRC_IP3
+
+        assert int(evb.hdr[0, COL_SRC_IP3]) == NODE
+        d.shutdown()
+
+    def test_ct_tracks_post_nat_tuple(self):
+        """The CT entry carries the post-NAT tuple so replies (to the
+        node IP) match it — the reverse-translation anchor."""
+        from cilium_tpu.datapath import datapath_step_jit
+        from cilium_tpu.datapath.conntrack import ct_entries_from_snapshot
+        from cilium_tpu.testing.fixtures import build_world
+
+        world = build_world(n_identities=16, n_rules=2,
+                            ct_capacity=1 << 10)
+        t = NATConfig(node_ip="192.168.0.1",
+                      non_masquerade_cidrs=("10.0.0.0/8",)).compile()
+        rows = _rows([(POD, WORLD, 1)])
+        rows[0, 8] = 41000  # sport
+        rows[0, 9] = 53  # dport
+        rows[0, 10] = 17  # udp
+        hdr, _ = snat_stage_jit(t, jnp.asarray(rows))
+        out, state = datapath_step_jit(world.state, hdr,
+                                       jnp.uint32(10))
+        entries = ct_entries_from_snapshot(np.asarray(state.ct.table))
+        srcs = {e["src"] for e in entries}
+        assert "192.168.0.1" in srcs  # post-NAT source tracked

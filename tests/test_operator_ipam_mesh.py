@@ -167,6 +167,13 @@ class TestEventQueue:
         assert ev2.dropped
         assert ran == [1]
 
+    def test_wait_beyond_burst_rejected(self):
+        from cilium_tpu.infra.rate import TokenBucket
+
+        tb = TokenBucket(rate=10.0, burst=1)
+        with pytest.raises(ValueError, match="burst"):
+            tb.wait(2)  # r03 review: used to spin forever
+
     def test_error_surfaces(self):
         from cilium_tpu.infra.eventqueue import EventQueue
 
@@ -199,6 +206,33 @@ class TestRate:
 
 
 class TestRecorder:
+    def test_filters_or_together(self, tmp_path):
+        """r03 review: a filter LIST is a whitelist (OR), matching the
+        observer's get_flows contract — AND made multi-port captures
+        empty."""
+        from cilium_tpu.flow.observer import FlowFilter
+
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+        db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{"fromEndpoints": [{}]}],
+        }])
+        d.start()
+        path = str(tmp_path / "multi.pcap")
+        rec = d.recorder.start(path, [FlowFilter(port=80),
+                                      FlowFilter(port=443)])
+        d.process_batch(make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=40000,
+                 dport=80, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=40001,
+                 dport=443, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=40002,
+                 dport=22, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+        ]).data, now=10)
+        got = d.recorder.stop(rec.recording_id)
+        assert got.captured == 2  # 80 OR 443, not 80 AND 443
+
     def test_record_filtered_traffic_to_pcap(self, tmp_path):
         from cilium_tpu.core.pcap import read_pcap
         from cilium_tpu.flow.observer import FlowFilter
