@@ -326,16 +326,17 @@ class Daemon:
             # stages (loader.step accepts device arrays); the one host
             # fetch below feeds event decode, which needed the
             # rewritten rows anyway
-            hdr_dev = jnp.asarray(np.ascontiguousarray(hdr))
+            hdr_dev = hdr
             if len(self.services):
                 from ..service import lb_stage_jit
 
-                hdr_dev, _hits = lb_stage_jit(self.services.tensors(),
-                                              hdr_dev)
+                hdr_dev, _hits = lb_stage_jit(
+                    self.services.tensors(),
+                    jnp.asarray(np.ascontiguousarray(hdr_dev)))
             if self.nat is not None:
-                from ..service.nat import snat_stage_jit
-
-                hdr_dev, _masq = snat_stage_jit(self.nat, hdr_dev)
+                # conntrack-aware: inbound-connection replies keep
+                # their source (verdict.apply_masquerade)
+                hdr_dev = self.loader.masquerade(self.nat, hdr_dev, now)
             out, row_map = self.loader.step(hdr_dev, now)
             hdr = np.asarray(hdr_dev)
             batch = decode_out(out, hdr, row_map.numeric_array(),
@@ -381,6 +382,12 @@ class Daemon:
         row = (self.loader.row_map.row(src_identity)
                if self.loader.row_map else 0)
         return self.proxy.handle_dns(proxy_port, qnames, row)
+
+    def handle_l7_kafka(self, proxy_port: int, requests,
+                        src_identity: int = 0) -> np.ndarray:
+        row = (self.loader.row_map.row(src_identity)
+               if self.loader.row_map else 0)
+        return self.proxy.handle_kafka(proxy_port, requests, row)
 
     # -- clustermesh API ----------------------------------------------
     def connect_cluster(self, name: str, cluster_id: int, kv):

@@ -148,13 +148,7 @@ def _make_handler(daemon: Daemon):
                 elif path == "/proxy":
                     # redirect listeners + their L7 rule shapes (the
                     # xDS NetworkPolicy view; reference: pkg/envoy)
-                    t = daemon.proxy._tensors
-                    self._send(200, [{
-                        "proxy-port": port,
-                        "http-rules": len(l7.http),
-                        "dns-rules": len(l7.dns),
-                        "kafka-rules": len(l7.kafka),
-                    } for port, l7 in sorted(t.by_port.items())])
+                    self._send(200, daemon.proxy.listeners())
                 elif path == "/service":
                     self._send(200, [s.to_dict()
                                      for s in daemon.services.list()])

@@ -158,6 +158,18 @@ class TPULoader(Loader):
             row_map = self.row_map
         return np.asarray(out), row_map
 
+    def masquerade(self, nat, hdr, now: int):
+        """CT-aware egress SNAT stage (see verdict.apply_masquerade);
+        returns the rewritten device hdr."""
+        from .verdict import apply_masquerade_jit
+
+        jnp = self._jnp
+        if isinstance(hdr, np.ndarray):
+            hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        with self._lock:
+            ct = self.state.ct
+        return apply_masquerade_jit(ct, nat, hdr, jnp.uint32(now))
+
     # -- incremental patching (no recompile, no full upload) ----------
     def patch_identity(self, kind: str, numeric_id: int,
                        policies) -> bool:
@@ -395,6 +407,33 @@ class InterpreterLoader(Loader):
         else:
             self.row_map.add(numeric_id)
         return True
+
+    def masquerade(self, nat, hdr, now: int) -> np.ndarray:
+        """Python mirror of verdict.apply_masquerade over the oracle's
+        CT dict (keeps backend parity for masqueraded daemons)."""
+        import ipaddress
+
+        from ..core.packets import (COL_DIR, COL_DST_IP3, COL_FAMILY,
+                                    COL_SRC_IP3)
+        from ..testing.oracle import OracleDatapath
+
+        hdr = np.array(hdr, dtype=np.uint32)
+        nets = [(int(n), int(m)) for n, m in
+                zip(np.asarray(nat.net), np.asarray(nat.mask))]
+        node_ip = int(np.asarray(nat.node_ip))
+        for i in range(len(hdr)):
+            row = hdr[i]
+            if row[COL_DIR] != 1 or row[COL_FAMILY] != 4:
+                continue
+            dst = int(row[COL_DST_IP3])
+            if any((dst & m) == n for n, m in nets):
+                continue
+            rev = OracleDatapath._rev(OracleDatapath._tuple(row))
+            e = self.oracle.ct.get(rev)
+            if e is not None and e.expires >= now:
+                continue  # reply of an inbound connection
+            row[COL_SRC_IP3] = node_ip
+        return hdr
 
     def patch_ipcache(self, cidr: str, numeric_id: int) -> bool:
         import ipaddress

@@ -223,6 +223,34 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
                               ct=ct, metrics=metrics)
 
 
+def apply_masquerade(ct: CTTable, nat, hdr: jnp.ndarray,
+                     now: jnp.ndarray) -> jnp.ndarray:
+    """CONNTRACK-AWARE egress masquerade: egress-to-world sources
+    rewrite to the node IP UNLESS the row's reverse CT entry exists —
+    that row replies to a connection a remote originated INTO us and
+    must keep its source (reference: the bpf masquerade path consults
+    CT before SNAT).  Runs as its own stage before datapath_step so
+    event decode sees the post-NAT rows; the CT entry of a
+    masqueraded flow carries the post-NAT tuple (reverse-translation
+    anchor)."""
+    from ..core.packets import COL_DST_IP3, COL_SRC_IP3
+    from .conntrack import _probe, ct_keys_from_headers
+
+    hdr = hdr.astype(jnp.uint32)
+    dst = hdr[:, COL_DST_IP3]
+    internal = jnp.any(
+        (dst[:, None] & nat.mask[None, :]) == nat.net[None, :], axis=1)
+    egress = hdr[:, COL_DIR] == 1
+    v4 = hdr[:, COL_FAMILY] == 4
+    _fwd, rev = ct_keys_from_headers(hdr)
+    r_found, _slot = _probe(ct.table, rev, now)
+    masq = egress & v4 & ~internal & ~r_found
+    new_src = jnp.where(masq, nat.node_ip, hdr[:, COL_SRC_IP3])
+    return hdr.at[:, COL_SRC_IP3].set(new_src)
+
+
+apply_masquerade_jit = jax.jit(apply_masquerade)
+
 datapath_step_jit = jax.jit(datapath_step, donate_argnums=0)
 
 

@@ -129,11 +129,21 @@ def _mask_batch(f: FlowFilter, batch: EventBatch) -> np.ndarray:
             ipaddress.IPv4Address(f.destination_ip))
     if f.source_identity is not None or f.destination_identity \
             is not None:
-        # the batch carries ONE identity column (the remote peer);
-        # match it for whichever side the filter names
-        want = (f.source_identity if f.source_identity is not None
-                else f.destination_identity)
-        m &= batch.identity == want
+        # identical side-mapping to FlowFilter.mask: the one identity
+        # column holds the REMOTE peer, which sits on the src side for
+        # ingress non-reply rows (and flips with reply direction)
+        from ..core.packets import COL_DIR
+
+        is_reply = batch.ct_state == CT_REPLY
+        ingress = hdr[:, COL_DIR] == 0
+        remote_is_src = ingress ^ is_reply
+        if f.source_identity is not None:
+            m &= np.where(remote_is_src,
+                          batch.identity == f.source_identity, True)
+        if f.destination_identity is not None:
+            m &= np.where(~remote_is_src,
+                          batch.identity == f.destination_identity,
+                          True)
     if f.reply is not None:
         m &= (batch.ct_state == CT_REPLY) == f.reply
     if f.since is not None:

@@ -58,10 +58,20 @@ class SevenParser:
                     "code": rec.status,
                 },
             }
+        if rec.kind == KIND_DNS:
+            return {
+                "type": "REQUEST",
+                "dns": {
+                    "query": rec.qname,
+                    "rcode": 0 if rec.verdict else 5,  # REFUSED
+                },
+            }
         return {
             "type": "REQUEST",
-            "dns": {
-                "query": rec.qname,
-                "rcode": 0 if rec.verdict else 5,  # REFUSED when denied
+            "kafka": {
+                "api_key": rec.method,
+                "topic": rec.path,
+                # 29 = TOPIC_AUTHORIZATION_FAILED (kafka error code)
+                "error_code": 0 if rec.verdict else 29,
             },
         }

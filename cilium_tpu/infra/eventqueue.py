@@ -88,8 +88,12 @@ class EventQueue:
               timeout: Optional[float] = 10.0) -> None:
         """Stop accepting NEW events; everything already queued runs
         to completion first (reference: eventqueue Stop + drain)."""
+        # set closed UNDER the mutex (no enqueue can pass the check
+        # afterwards), but put the sentinel OUTSIDE it: a bounded full
+        # queue would otherwise deadlock against a worker whose event
+        # callback calls enqueue() (blocked on the mutex)
         with self._enqueue_mutex:
             self._closed.set()
-            self._q.put(None)
+        self._q.put(None)
         if wait:
             self._drained.wait(timeout)

@@ -27,6 +27,13 @@ L7_COLS = 8
 
 KIND_HTTP = 0
 KIND_DNS = 1
+KIND_KAFKA = 2
+
+# Kafka api keys the policy schema names (reference: proxylib kafka
+# parser + api.PortRuleKafka role/apiKey)
+KAFKA_API_IDS = {"produce": 1, "fetch": 2, "consume": 2,
+                 "metadata": 3, "offsets": 4, "offsetcommit": 8,
+                 "offsetfetch": 9}
 
 _FNV_OFFSET = 0xCBF29CE484222325
 _FNV_PRIME = 0x100000001B3
@@ -89,6 +96,25 @@ def featurize_dns(qnames: Sequence[str], port: int,
         lo, hi = fnv64(q)
         out[i, L7_PATH_H0], out[i, L7_PATH_H1] = lo, hi
     return out, names
+
+
+def featurize_kafka(requests: Sequence[dict], port: int,
+                    src_row: int = 0) -> Tuple[np.ndarray, List[dict]]:
+    """Kafka requests ({api_key, topic, client_id}) -> feature rows:
+    api id in the method column, topic hash in the path words."""
+    n = len(requests)
+    out = np.zeros((n, L7_COLS), dtype=np.uint32)
+    out[:, L7_PORT] = port
+    out[:, L7_KIND] = KIND_KAFKA
+    out[:, L7_SRC_ROW] = src_row
+    for i, r in enumerate(requests):
+        out[i, L7_METHOD] = KAFKA_API_IDS.get(
+            str(r.get("api_key", "")).lower(), 0)
+        lo, hi = fnv64(r.get("topic", ""))
+        out[i, L7_PATH_H0], out[i, L7_PATH_H1] = lo, hi
+        lo, hi = fnv64(r.get("client_id", ""))
+        out[i, L7_HOST_H0], out[i, L7_HOST_H1] = lo, hi
+    return out, list(requests)
 
 
 def parse_http_bytes(payloads: Iterable[bytes]) -> List[dict]:

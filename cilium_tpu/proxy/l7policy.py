@@ -27,8 +27,10 @@ import numpy as np
 
 from ..policy.api import L7Rules
 from .featurize import (
+    KAFKA_API_IDS,
     KIND_DNS,
     KIND_HTTP,
+    KIND_KAFKA,
     L7_COLS,
     L7_HOST_H0,
     L7_HOST_H1,
@@ -120,6 +122,22 @@ def compile_l7(redirects: Sequence[Tuple[int, str, L7Rules]]
                 pat = d.match_pattern.rstrip(".").lower()
                 host_matchers.setdefault(port, []).append(
                     _dns_matcher(pat))
+        for k in l7.kafka:
+            # reference: api.PortRuleKafka {role|apiKey, topic,
+            # clientID}; role produce/consume maps onto api ids
+            api = str(k.get("apiKey") or k.get("role") or "").lower()
+            api_id = KAFKA_API_IDS.get(api, 0) if api else 0
+            topic = str(k.get("topic") or "")
+            client = str(k.get("clientID") or "")
+            if api and api_id == 0:
+                # unknown api name: host matcher compares strings
+                host_matchers.setdefault(port, []).append(
+                    _kafka_matcher(k))
+                continue
+            t_lo, t_hi = fnv64(topic)
+            c_lo, c_hi = fnv64(client)
+            rows.append([port, KIND_KAFKA, api_id,
+                         t_lo, t_hi, c_lo, c_hi])
 
     rules = (np.asarray(rows, dtype=np.uint32) if rows
              else np.zeros((0, R_COLS), dtype=np.uint32))
@@ -132,7 +150,9 @@ def _http_matcher(h) -> Callable:
     path_re = re.compile(h.path) if h.path else None
     host_re = re.compile(h.host) if h.host else None
 
-    def match(req: dict) -> bool:
+    def match(req) -> bool:
+        if not isinstance(req, dict):
+            return False  # a DNS qname on a mixed-rule port
         if meth and req.get("method", "").upper() != meth:
             return False
         if path_re and not path_re.fullmatch(req.get("path", "")):
@@ -143,6 +163,25 @@ def _http_matcher(h) -> Callable:
             have = {x.strip() for x in req.get("headers", ())}
             if not set(h.headers).issubset(have):
                 return False
+        return True
+
+    return match
+
+
+def _kafka_matcher(rule: dict) -> Callable:
+    api = str(rule.get("apiKey") or rule.get("role") or "").lower()
+    topic = str(rule.get("topic") or "")
+    client = str(rule.get("clientID") or "")
+
+    def match(req) -> bool:
+        if not isinstance(req, dict):
+            return False  # a DNS qname on a mixed-rule port
+        if api and str(req.get("api_key", "")).lower() != api:
+            return False
+        if topic and req.get("topic", "") != topic:
+            return False
+        if client and req.get("client_id", "") != client:
+            return False
         return True
 
     return match

@@ -92,6 +92,18 @@ class L7Proxy:
         with self._lock:
             return self._tensors.ports
 
+    def listeners(self) -> list:
+        """Redirect listeners + their rule shapes (GET /proxy; the
+        xDS NetworkPolicy view)."""
+        with self._lock:
+            by_port = dict(self._tensors.by_port)
+        return [{
+            "proxy-port": port,
+            "http-rules": len(l7.http),
+            "dns-rules": len(l7.dns),
+            "kafka-rules": len(l7.kafka),
+        } for port, l7 in sorted(by_port.items())]
+
     # -- request paths ------------------------------------------------
     def _verdicts(self, rows: np.ndarray, port: int,
                   raw: Sequence) -> np.ndarray:
@@ -139,6 +151,25 @@ class L7Proxy:
                 method=req.get("method", ""), path=req.get("path", ""),
                 host=req.get("host", ""),
                 status=200 if allow[i] else 403))
+        return allow
+
+    def handle_kafka(self, port: int, requests: Sequence[dict],
+                     src_row: int = 0) -> np.ndarray:
+        """Verdict Kafka requests ({api_key, topic, client_id});
+        1 = forward, 0 = topic-authorization-failed."""
+        from .featurize import KIND_KAFKA, featurize_kafka
+
+        rows, raw = featurize_kafka(requests, port, src_row)
+        allow = self._verdicts(rows, port, raw)
+        now = time.time()
+        self.requests_total += len(raw)
+        self.requests_denied += int((allow == 0).sum())
+        for i, req in enumerate(raw):
+            self._emit(L7Record(
+                kind=KIND_KAFKA, verdict=int(allow[i]),
+                proxy_port=port, src_row=src_row, timestamp=now,
+                method=str(req.get("api_key", "")),
+                path=str(req.get("topic", ""))))
         return allow
 
     def handle_dns(self, port: int, qnames: Sequence[str],

@@ -92,6 +92,18 @@ def _traffic(world, rng, n):
         egress & (out[:, COL_DPORT] == 53), 17, out[:, COL_PROTO])
     out[:, COL_FLAGS] = np.where(out[:, COL_PROTO] != 6, 0,
                                  out[:, COL_FLAGS])
+    # ~3% RELATED rows: ICMP errors whose columns carry an embedded
+    # tuple (what the ingest parser produces for dest-unreachable
+    # etc.) — some relate to flows that exist, some to nothing
+    from cilium_tpu.core.packets import FLAG_RELATED
+
+    related = rng.random(n) < 0.03
+    out[:, COL_FLAGS] = np.where(related, FLAG_RELATED,
+                                 out[:, COL_FLAGS])
+    # the embedded tuple reuses the row's own 5-tuple space, so a
+    # fraction will hit live CT entries (CT_RELATED) and the rest miss
+    out[:, COL_PROTO] = np.where(related & (out[:, COL_PROTO] == 47),
+                                 6, out[:, COL_PROTO])
     return out
 
 

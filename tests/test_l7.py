@@ -170,6 +170,48 @@ class TestDNSVerdicts:
         assert seen == [("example.com", ("93.184.216.34",))]
 
 
+class TestKafkaVerdicts:
+    def _proxy(self, kafka):
+        p = L7Proxy()
+        p.update([type("P", (), {
+            "redirects": [(19092, "rule", _l7_kafka(kafka))]})()])
+        return p
+
+    def test_produce_topic_rule(self):
+        p = self._proxy([{"role": "produce", "topic": "orders"}])
+        got = p.handle_kafka(19092, [
+            {"api_key": "produce", "topic": "orders"},
+            {"api_key": "produce", "topic": "secrets"},
+            {"api_key": "fetch", "topic": "orders"},
+        ])
+        assert list(got) == [1, 0, 0]
+
+    def test_topic_only_rule_allows_any_api(self):
+        p = self._proxy([{"topic": "orders"}])
+        got = p.handle_kafka(19092, [
+            {"api_key": "produce", "topic": "orders"},
+            {"api_key": "fetch", "topic": "orders"},
+            {"api_key": "fetch", "topic": "other"},
+        ])
+        assert list(got) == [1, 1, 0]
+
+    def test_kafka_seven_flow(self):
+        from cilium_tpu.flow import Observer, SevenParser
+
+        p = self._proxy([{"topic": "orders"}])
+        obs = Observer(capacity=64)
+        p.on_record(SevenParser(obs).consume)
+        p.handle_kafka(19092, [{"api_key": "produce",
+                               "topic": "denied-topic"}])
+        f = obs.get_flows(number=1)[0]
+        assert f.l7["kafka"]["topic"] == "denied-topic"
+        assert f.l7["kafka"]["error_code"] == 29
+
+
+def _l7_kafka(kafka) -> L7Rules:
+    return L7Rules.from_dict({"kafka": kafka})
+
+
 RULES_L7 = [{
     "endpointSelector": {"matchLabels": {"app": "db"}},
     "ingress": [

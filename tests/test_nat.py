@@ -69,6 +69,53 @@ class TestSNAT:
         np.testing.assert_array_equal(np.asarray(hdr), rows)
         assert not np.asarray(masq).any()
 
+    def test_inbound_reply_is_never_masqueraded(self):
+        """r03 review: stateless SNAT corrupted replies of INBOUND
+        connections.  The CT-aware stage keeps their source, and the
+        reply still matches the existing CT entry (TRACE, not a new
+        flow).  Both backends agree."""
+        from cilium_tpu.agent import Daemon, DaemonConfig
+        from cilium_tpu.core import TCP_SYN, TCP_ACK, make_batch
+        from cilium_tpu.core.packets import COL_SRC_IP3
+        from cilium_tpu.monitor.api import MSG_TRACE
+
+        outs = {}
+        for backend in ("tpu", "interpreter"):
+            d = Daemon(DaemonConfig(backend=backend,
+                                    ct_capacity=1 << 12,
+                                    masquerade=True,
+                                    node_ip="192.168.0.1"))
+            ep = d.add_endpoint("srv-1", ("10.0.2.1",),
+                                ["k8s:app=srv"])
+            d.policy_import([{
+                "endpointSelector": {"matchLabels": {"app": "srv"}},
+                "ingress": [{"fromEntities": ["world"],
+                             "toPorts": [{"ports": [
+                                 {"port": "443",
+                                  "protocol": "TCP"}]}]}],
+            }])
+            d.start()
+            # inbound connection from the world
+            evb1 = d.process_batch(make_batch([dict(
+                src="8.8.8.8", dst="10.0.2.1", sport=50000, dport=443,
+                proto=6, flags=TCP_SYN, ep=ep.id, dir=0)]).data,
+                now=10)
+            assert list(evb1.verdict) == [1]
+            # the pod's reply: egress to a non-internal destination —
+            # the naive masquerade would rewrite it
+            evb2 = d.process_batch(make_batch([dict(
+                src="10.0.2.1", dst="8.8.8.8", sport=443, dport=50000,
+                proto=6, flags=TCP_ACK, ep=ep.id, dir=1)]).data,
+                now=11)
+            outs[backend] = (list(evb2.verdict), list(evb2.msg_type),
+                             int(evb2.hdr[0, COL_SRC_IP3]))
+            d.shutdown()
+        for backend, (verdict, msg, src) in outs.items():
+            assert verdict == [1], backend
+            assert msg == [MSG_TRACE], backend  # matched existing CT
+            assert src == POD, (backend, hex(src))  # source KEPT
+        assert outs["tpu"] == outs["interpreter"]
+
     def test_daemon_masquerade_end_to_end(self):
         from cilium_tpu.agent import Daemon, DaemonConfig
         from cilium_tpu.core import TCP_SYN, make_batch
