@@ -132,11 +132,16 @@ def bench_end_to_end(world, state, now0, jax, jnp, datapath_step_jit,
     parse_dt = time.perf_counter() - t0
     parse_pps = 8 * BATCH / parse_dt
 
-    # ring sized for the run's event volume (~490k compacted events
-    # over 64 batches): a 512k-row ring keeps loss at zero so the
-    # monitor plane demonstrably loses nothing at 35M+ pps; the
-    # wrap-overwrite economy still backstops under-provisioning
-    ring = EventRing.create(1 << 19)
+    # ring sized FROM the run length (~7.5k compacted events/batch:
+    # 5% new-flow verdicts + 2% drops + sampled traces; bound by
+    # BATCH/16) so the zero-loss claim holds for any iters/
+    # sustain_iters a caller passes; both the timed and sustained runs
+    # (plus one warmup append) land in the ring before the drain
+    n_appends = iters + n_bufs + 1
+    cap = 1
+    while cap < n_appends * (BATCH // 16):
+        cap *= 2
+    ring = EventRing.create(cap)
     # warmup: establish the pool's flows in CT + compile the e2e shapes
     # — NO host fetch (see module doc)
     for chunk in pool.reshape(2, BATCH, -1):

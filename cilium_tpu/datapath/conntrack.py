@@ -172,7 +172,12 @@ def _probe(table: jnp.ndarray, keys: jnp.ndarray, now: jnp.ndarray
     """Probe the window for each key: -> (found [N] bool, slot [N] i32).
 
     Expired entries don't match (an expired entry is a miss; GC frees
-    the slot later, and inserts may reclaim it immediately)."""
+    the slot later, and inserts may reclaim it immediately).
+
+    The whole window loads as ONE [N, N_PROBE, ROW_WORDS] gather
+    (instead of N_PROBE dependent gathers) so the memory system
+    pipelines the probe; first-match selection is an argmax over the
+    window axis."""
     c = table.shape[0]
     if c & (c - 1):
         raise ValueError(
@@ -180,16 +185,17 @@ def _probe(table: jnp.ndarray, keys: jnp.ndarray, now: jnp.ndarray
             "table must be probed inside shard_map (per-shard slice)")
     mask = table.shape[0] - 1
     h = _hash(keys)
-    found = jnp.zeros(keys.shape[0], dtype=bool)
-    slot = jnp.zeros(keys.shape[0], dtype=jnp.int32)
-    for step in range(N_PROBE):
-        s = ((h + step) & mask).astype(jnp.int32)
-        row = table[s]  # [N, ROW_WORDS]
-        live = (row[:, V_STATE] != ST_FREE) & (row[:, V_EXPIRES] >= now)
-        match = live & jnp.all(row[:, :KEY_WORDS] == keys, axis=1)
-        slot = jnp.where(match & ~found, s, slot)
-        found = found | match
-    return found, slot
+    steps = jnp.arange(N_PROBE, dtype=jnp.uint32)
+    slots = ((h[:, None] + steps[None, :]) & mask).astype(jnp.int32)
+    rows = table[slots]  # [N, N_PROBE, ROW_WORDS] — one gather
+    live = (rows[:, :, V_STATE] != ST_FREE) & (rows[:, :, V_EXPIRES]
+                                               >= now)
+    match = live & jnp.all(rows[:, :, :KEY_WORDS]
+                           == keys[:, None, :], axis=2)  # [N, N_PROBE]
+    found = jnp.any(match, axis=1)
+    first = jnp.argmax(match, axis=1)  # first True (0 when none)
+    slot = jnp.take_along_axis(slots, first[:, None], axis=1)[:, 0]
+    return found, jnp.where(found, slot, 0).astype(jnp.int32)
 
 
 def ct_lookup(ct: CTTable, fwd: jnp.ndarray, rev: jnp.ndarray,
