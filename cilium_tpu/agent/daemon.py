@@ -309,6 +309,11 @@ class Daemon:
             self.exporter.close()
         if self.config.state_dir:
             self.checkpoint(self.config.state_dir)
+        # unsubscribe kvstore watchers: a shared store outliving this
+        # daemon would otherwise keep invoking (and retaining) it
+        if self.identity_sync is not None:
+            self.identity_sync.close()
+        self.allocator.close()
 
     def _now(self) -> int:
         return int(time.time() - self._boot_time) + 1

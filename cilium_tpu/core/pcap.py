@@ -155,16 +155,19 @@ def _parse_ip(pkt: bytes
     return parsed
 
 
-def build_row(parsed, ep: int, direction: int) -> np.ndarray:
+def build_row(parsed, ep: int, direction: int,
+              related: bool = True) -> np.ndarray:
     """(family, src16, dst16, proto, l4, total) -> one header row,
     including the CT_RELATED transform: an ICMP error row carries the
     EMBEDDED packet's tuple + FLAG_RELATED (reference: conntrack
-    relates ICMP errors to the original flow)."""
+    relates ICMP errors to the original flow).  ``related=False``
+    keeps the OUTER tuple (the packed fast path's semantics — the
+    16 B wire format has no RELATED bit, see packets.FLAG_RELATED)."""
     from .packets import FLAG_RELATED
 
     fam, src, dst, proto, l4, ip_len = parsed
     sport, dport, flags = _parse_l4(proto, l4)
-    rel = _related_tuple(fam, proto, l4)
+    rel = _related_tuple(fam, proto, l4) if related else None
     if rel is not None:
         src, dst, proto, sport, dport = rel
         flags = FLAG_RELATED

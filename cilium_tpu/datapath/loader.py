@@ -166,9 +166,13 @@ class TPULoader(Loader):
         jnp = self._jnp
         if isinstance(hdr, np.ndarray):
             hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        # dispatch INSIDE the lock: step() donates state.ct
+        # (donate_argnums=0), so reading it here must not race a
+        # concurrent step that would invalidate the buffer between
+        # capture and dispatch
         with self._lock:
-            ct = self.state.ct
-        return apply_masquerade_jit(ct, nat, hdr, jnp.uint32(now))
+            return apply_masquerade_jit(self.state.ct, nat, hdr,
+                                        jnp.uint32(now))
 
     # -- incremental patching (no recompile, no full upload) ----------
     def patch_identity(self, kind: str, numeric_id: int,
@@ -418,6 +422,8 @@ class InterpreterLoader(Loader):
         from ..testing.oracle import OracleDatapath
 
         hdr = np.array(hdr, dtype=np.uint32)
+        if not nat.enabled:  # parity with apply_masquerade
+            return hdr
         nets = [(int(n), int(m)) for n, m in
                 zip(np.asarray(nat.net), np.asarray(nat.mask))]
         node_ip = int(np.asarray(nat.node_ip))

@@ -177,22 +177,26 @@ def lpm_upsert(t: LPMTensors, cidr: str,
     n_l2, n_l3 = lpm_used_blocks(t)
     hi16, mid8, lo8 = addr >> 16, (addr >> 8) & 0xFF, addr & 0xFF
 
+    # Plan the whole insert BEFORE mutating anything: a partial
+    # mutation followed by a None return would leak a block per failed
+    # upsert and make correctness depend on the caller discarding the
+    # host mirror.
     cur1 = int(t.l1[hi16])
     l1_created = cur1 >= 0
+    blk2 = n_l2 if l1_created else -cur1 - 1
+    # a freshly-created l2 block inherits cur1 everywhere, so its
+    # mid8 slot is cur1 (a leaf >= 0) and an l3 block is needed too
+    cur2 = cur1 if l1_created else int(t.l2[blk2, mid8])
+    l2_changed = cur2 >= 0
+    if l1_created and n_l2 >= t.l2.shape[0]:
+        return None  # l2 padding exhausted
+    if l2_changed and n_l3 >= t.l3.shape[0]:
+        return None  # l3 padding exhausted
+
     if l1_created:
-        if n_l2 >= t.l2.shape[0]:
-            return None  # l2 padding exhausted
-        blk2 = n_l2
         t.l2[blk2, :] = cur1  # inherit the shorter prefix's value
         t.l1[hi16] = -(blk2 + 1)
-    else:
-        blk2 = -cur1 - 1
-
-    cur2 = int(t.l2[blk2, mid8])
-    l2_changed = cur2 >= 0
     if l2_changed:
-        if n_l3 >= t.l3.shape[0]:
-            return None
         blk3 = n_l3
         t.l3[blk3, :] = cur2
         t.l2[blk2, mid8] = -(blk3 + 1)

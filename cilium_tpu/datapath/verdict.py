@@ -237,6 +237,8 @@ def apply_masquerade(ct: CTTable, nat, hdr: jnp.ndarray,
     from .conntrack import _probe, ct_keys_from_headers
 
     hdr = hdr.astype(jnp.uint32)
+    if not nat.enabled:  # static pytree aux: baked in at trace time
+        return hdr
     dst = hdr[:, COL_DST_IP3]
     internal = jnp.any(
         (dst[:, None] & nat.mask[None, :]) == nat.net[None, :], axis=1)

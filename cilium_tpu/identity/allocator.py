@@ -178,6 +178,54 @@ class CachingIdentityAllocator:
             self._notify("add", ident)
             return ident
 
+    # -- watch replay (ClusterIdentitySync) ------------------------------
+    def watch_update(self, numeric_id: int, labels: LabelSet) -> Identity:
+        """Apply a watched ``id/<num>`` create: register the identity,
+        or RE-BIND a GC'd-and-reused numeric (the ABA case hole-reuse
+        makes common: k1 -> N is released cluster-wide, identity GC
+        sweeps id/N, another node mints k2 -> N).  A locally-referenced
+        identity is never re-bound — live refs imply a kvstore ref
+        that keeps GC away, so a conflicting create for a referenced
+        numeric means a lease blip; keeping local state is the safe
+        side."""
+        key = labels.sorted_key()
+        with self._lock:
+            existing = self._by_id.get(numeric_id)
+            if existing is not None:
+                if existing.labels.sorted_key() == key:
+                    return existing
+                if self._refcount.get(numeric_id, 0) > 0:
+                    return existing
+                self._drop(existing)
+            return self.restore_identity(numeric_id, labels)
+
+    def watch_remove(self, numeric_id: int) -> bool:
+        """Apply a watched ``id/<num>`` delete (identity GC swept the
+        master).  Only unreferenced identities drop — local release
+        stays refcount-driven."""
+        with self._lock:
+            if numeric_id in RESERVED_LABELSETS:
+                return False
+            existing = self._by_id.get(numeric_id)
+            if existing is None or self._refcount.get(numeric_id, 0) > 0:
+                return False
+            self._drop(existing)
+            return True
+
+    def _drop(self, ident: Identity) -> None:
+        num = ident.numeric_id
+        self._refcount.pop(num, None)
+        self._by_id.pop(num, None)
+        cur = self._by_labels.get(ident.labels.sorted_key())
+        if cur is not None and cur.numeric_id == num:
+            self._by_labels.pop(ident.labels.sorted_key(), None)
+        self._notify("remove", ident)
+
+    def close(self) -> None:
+        """Release backend resources (kvstore watch subscription)."""
+        if self._backend is not None and hasattr(self._backend, "close"):
+            self._backend.close()
+
     # -- lookup ----------------------------------------------------------
     def lookup_by_id(self, numeric_id: int) -> Optional[Identity]:
         with self._lock:

@@ -18,6 +18,8 @@ tensors incrementally — the identity tensor IS the replicated state.
 from __future__ import annotations
 
 import threading
+import time
+import uuid
 from typing import Callable, Optional
 
 from ..identity.identity import MAX_ALLOCATED, MIN_ALLOCATED
@@ -43,6 +45,33 @@ class KVStoreAllocatorBackend:
         self.max_id = max_id
         self.lease_ttl = lease_ttl
         self._lock = threading.Lock()
+        # Local mirror of the id/ prefix, maintained by watch: one
+        # subscription replaces the per-allocation prefix scans the
+        # reference avoids the same way (pkg/allocator caches the id
+        # space in its idpool).  Over a networked store this turns
+        # allocation from O(identities) round trips into O(1).
+        self._key_by_id: dict = {}
+        self._id_by_key: dict = {}
+        self._cancel = kv.watch_prefix(f"{self.prefix}/id/",
+                                       self._on_id_event, replay=True)
+
+    def _on_id_event(self, ev: KVEvent) -> None:
+        try:
+            num = int(ev.key.rsplit("/", 1)[1])
+        except ValueError:
+            return
+        with self._lock:
+            if ev.kind == "delete":
+                old = self._key_by_id.pop(num, None)
+                if old is not None and self._id_by_key.get(old) == num:
+                    del self._id_by_key[old]
+            else:
+                key = ev.value.decode()
+                self._key_by_id[num] = key
+                self._id_by_key[key] = num
+
+    def close(self) -> None:
+        self._cancel()
 
     def _id_key(self, num: int) -> str:
         return f"{self.prefix}/id/{num}"
@@ -54,49 +83,143 @@ class KVStoreAllocatorBackend:
         """Return the cluster-wide numeric id for a label key —
         reusing the existing id when one exists, claiming a fresh one
         (create-only on the master key) otherwise."""
-        # reuse path 1: a node currently references this key
-        existing = self.kv.list_prefix(self._value_prefix(key))
-        for _, raw in existing.items():
-            num = int(raw)
-            self.kv.update(self._value_prefix(key) + self.node,
-                           raw, lease_ttl=self.lease_ttl)
-            return num
-        # reuse path 2: an unreferenced MASTER key still maps this
-        # label set (all node refs released but identity GC has not
-        # swept it) — minting a fresh id here would make nodes that
-        # replayed the master disagree on the numeric
-        for id_key, raw in self.kv.list_prefix(
-                f"{self.prefix}/id/").items():
-            if raw.decode() == key:
-                num = int(id_key.rsplit("/", 1)[1])
+        while True:
+            # reuse path 1: a node currently references this key.
+            # Repair a missing master key while here (reference:
+            # pkg/allocator recreateMasterKey — a master swept while
+            # refs live, e.g. by a crashed claimant's undo, must come
+            # back or watch replay and GC lose sight of the id).
+            existing = self.kv.list_prefix(self._value_prefix(key))
+            for _, raw in existing.items():
+                num = int(raw)
+                self.kv.create_only(self._id_key(num), key.encode())
                 self.kv.update(self._value_prefix(key) + self.node,
-                               str(num).encode(),
-                               lease_ttl=self.lease_ttl)
+                               raw, lease_ttl=self.lease_ttl)
                 return num
-        # claim path: race create-only on successive candidate ids
-        # (reference: pkg/allocator selects a random free id and
-        # retries on conflict; sequential probing is equivalent under
-        # the same atomicity and deterministic for tests)
-        num = self._first_free()
-        while num < self.max_id:
-            if self.kv.create_only(self._id_key(num), key.encode()):
-                self.kv.update(self._value_prefix(key) + self.node,
-                               str(num).encode(),
-                               lease_ttl=self.lease_ttl)
+            # reuse path 2: an unreferenced MASTER key still maps this
+            # label set (all node refs released but identity GC has not
+            # swept it) — minting a fresh id here would make nodes
+            # that replayed the master disagree on the numeric.  The
+            # local mirror is the index; the store read re-verifies it
+            # (the mirror can lag a GC delete over a networked
+            # transport).
+            with self._lock:
+                hint = self._id_by_key.get(key)
+            if hint is not None:
+                raw = self.kv.get(self._id_key(hint))
+                if raw is not None and raw.decode() == key:
+                    self.kv.update(self._value_prefix(key) + self.node,
+                                   str(hint).encode(),
+                                   lease_ttl=self.lease_ttl)
+                    return hint
+            num = self._claim(key)
+            if num is not None:
                 return num
-            num += 1
-        raise RuntimeError("identity space exhausted")
+            # fencing breach (lock lease expired mid-claim): retry —
+            # the rescan adopts whatever master the interim winner
+            # minted, or re-mints
 
-    def _first_free(self) -> int:
-        used = self.kv.list_prefix(f"{self.prefix}/id/")
-        nums = [int(k.rsplit("/", 1)[1]) for k in used]
-        return max(nums) + 1 if nums else self.min_id
+    def _claim(self, key: str) -> Optional[int]:
+        """Mint (or adopt) the master key for ``key`` under the
+        per-key cluster lock.  Returns None on a fencing breach (the
+        caller retries).
+
+        The lock (reference: pkg/kvstore LockPath around
+        pkg/allocator claims) serializes same-key minting: without
+        it, two nodes whose watch mirrors lag differently can each
+        miss the other's freshly-minted master and claim DIFFERENT
+        numerics for one label set.  Inside the lock one
+        authoritative prefix scan replaces the mirror (the scan is
+        O(identities) but only fresh mints pay it; reuse hits stay
+        O(1))."""
+        lock_key = f"{self.prefix}/locks/{key}"
+        # unique token per ACQUISITION: the bare node name would make
+        # the fencing check / release match a different acquisition by
+        # another thread of this same daemon
+        me = f"{self.node}:{uuid.uuid4().hex}".encode()
+        ttl = self.lease_ttl if self.lease_ttl is not None else 10.0
+        deadline = time.time() + 4 * ttl
+        while not self.kv.create_only(lock_key, me, lease_ttl=ttl):
+            if time.time() > deadline:
+                raise TimeoutError(f"allocator lock stuck: {lock_key}")
+            time.sleep(0.005)
+        try:
+            for id_key, raw in self.kv.list_prefix(
+                    f"{self.prefix}/id/").items():
+                if raw.decode() == key:
+                    num = int(id_key.rsplit("/", 1)[1])
+                    self.kv.update(self._value_prefix(key) + self.node,
+                                   str(num).encode(),
+                                   lease_ttl=self.lease_ttl)
+                    return num
+            num = self._first_free()
+            while num < self.max_id:
+                # create_only still arbitrates cross-KEY races (two
+                # nodes minting different label sets probe the same
+                # candidate); same-key races are excluded by the lock
+                if self.kv.create_only(self._id_key(num), key.encode()):
+                    if self.kv.get(lock_key) != me:
+                        # Fencing: our lock lease expired before the
+                        # mint — another same-key claimant may have
+                        # minted concurrently.  Undo — but never
+                        # delete a master another node has already
+                        # adopted (its live ref would point at a
+                        # numeric invisible to scans/GC, and the slot
+                        # could be re-minted for a different key).
+                        if self._ref_exists(key, num):
+                            self.kv.update(
+                                self._value_prefix(key) + self.node,
+                                str(num).encode(),
+                                lease_ttl=self.lease_ttl)
+                            return num
+                        self.kv.delete(self._id_key(num))
+                        if self._ref_exists(key, num):
+                            # adopted during the delete window:
+                            # resurrect the master (recreateMasterKey)
+                            self.kv.create_only(self._id_key(num),
+                                                key.encode())
+                            self.kv.update(
+                                self._value_prefix(key) + self.node,
+                                str(num).encode(),
+                                lease_ttl=self.lease_ttl)
+                            return num
+                        return None
+                    self.kv.update(self._value_prefix(key) + self.node,
+                                   str(num).encode(),
+                                   lease_ttl=self.lease_ttl)
+                    return num
+                cur = self.kv.get(self._id_key(num))
+                if cur is not None:  # learn the conflict; None means
+                    with self._lock:  # created-and-GC'd: just move on
+                        self._key_by_id.setdefault(num, cur.decode())
+                num = self._first_free(num + 1)
+            raise RuntimeError("identity space exhausted")
+        finally:
+            # only release our own lock (lease expiry may have handed
+            # it to another node while we slept)
+            if self.kv.get(lock_key) == me:
+                self.kv.delete(lock_key)
+
+    def _ref_exists(self, key: str, num: int) -> bool:
+        return any(int(raw) == num for raw in
+                   self.kv.list_prefix(self._value_prefix(key)).values())
+
+    def _first_free(self, start: Optional[int] = None) -> int:
+        """Lowest id ≥ start not in the local mirror — GC'd holes are
+        reused instead of growing max+1 forever."""
+        num = self.min_id if start is None else max(start, self.min_id)
+        with self._lock:
+            while num in self._key_by_id:
+                num += 1
+        return num
 
     def ref(self, key: str, num: int) -> None:
         """Write this node's reference for an id learned by watch
         replay (a replayed master key conveys no liveness; the first
         local use must take a ref or identity GC could sweep an id
-        this node actively enforces with)."""
+        this node actively enforces with).  Repairs a missing master
+        on the way (recreateMasterKey analogue)."""
+        self.kv.create_only(self._id_key(num), key.encode())
         self.kv.update(self._value_prefix(key) + self.node,
                        str(num).encode(), lease_ttl=self.lease_ttl)
 
@@ -136,13 +259,18 @@ class ClusterIdentitySync:
                                        self._on_event, replay=True)
 
     def _on_event(self, ev: KVEvent) -> None:
-        if ev.kind == "delete":
-            return  # master-key GC; local release is refcount-driven
         num = int(ev.key.rsplit("/", 1)[1])
+        if ev.kind == "delete":
+            # identity GC swept the master: drop the unreferenced
+            # local replica, or a reused numeric (hole reuse) would
+            # keep its STALE labels here while the cluster rebinds it
+            # (ABA) — locally-referenced identities stay (refcount-
+            # driven release)
+            self._allocator.watch_remove(num)
+            return
         labels = LabelSet.parse(
             *[s for s in ev.value.decode().split(";") if s])
-        if self._allocator.lookup_by_id(num) is None:
-            self._allocator.restore_identity(num, labels)
+        self._allocator.watch_update(num, labels)
 
     def close(self) -> None:
         self._cancel()

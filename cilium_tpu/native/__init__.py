@@ -167,10 +167,16 @@ def parse_frames_packed(buf: bytes, out: Optional[np.ndarray] = None
 def parse_frames_packed_py(buf: bytes,
                            out: Optional[np.ndarray] = None) -> tuple:
     """Pure-Python fallback for :func:`parse_frames_packed` — parses
-    wide rows then packs; same return contract."""
+    wide rows then packs; same return contract.
+
+    ``related=False`` mirrors the native packed parser: ICMP-error
+    frames keep the OUTER tuple (the packed wire format has no
+    FLAG_RELATED bit, and packing the embedded inner tuple as ordinary
+    traffic would let a forged ICMP error refresh the original flow's
+    CT entry)."""
     from ..core.packets import COL_FAMILY, pack_rows
 
-    wide = parse_frames_py(buf)
+    wide = parse_frames_py(buf, related=False)
     v4 = wide[wide[:, COL_FAMILY] == 4]
     skipped = len(wide) - len(v4)
     packed = pack_rows(v4)
@@ -194,10 +200,12 @@ def parse_pcap_bytes(buf: bytes, ep: int = 0, direction: int = 0,
 
 
 def parse_frames_py(buf: bytes, ep: int = 0,
-                    direction: int = 0) -> np.ndarray:
+                    direction: int = 0,
+                    related: bool = True) -> np.ndarray:
     """Pure-Python reference/fallback for :func:`parse_frames` —
     identical semantics, used when g++ is unavailable and by the
-    native-vs-python equivalence tests."""
+    native-vs-python equivalence tests.  ``related=False`` skips the
+    ICMP-error RELATED transform (packed-path semantics)."""
     import struct
 
     from ..core.pcap import _parse_ip, build_row
@@ -223,7 +231,7 @@ def parse_frames_py(buf: bytes, ep: int = 0,
         parsed = _parse_ip(frame[l3:])
         if parsed is None:
             continue
-        rows.append(build_row(parsed, ep, direction))
+        rows.append(build_row(parsed, ep, direction, related=related))
     if not rows:
         return np.zeros((0, N_COLS), dtype=np.uint32)
     return np.stack(rows)

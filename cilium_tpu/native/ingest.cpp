@@ -297,7 +297,6 @@ long parse_frames_packed(const uint8_t* buf, long buf_len, uint32_t* out,
         const uint8_t* p = eth_payload(buf + off, flen, &ip_len);
         off += flen;
         if (!p || ip_len < 20 || (p[0] >> 4) != 4) { ++skipped; continue; }
-        if (rows >= max_rows) { ++overflow; continue; }
         int ihl = (p[0] & 0xF) * 4;
         if (ip_len < ihl || ihl < 20) { ++skipped; continue; }
         uint32_t proto = p[9];
@@ -324,6 +323,10 @@ long parse_frames_packed(const uint8_t* buf, long buf_len, uint32_t* out,
             l4_len = ip_len - ihl;
         }
         if (drop) { ++skipped; continue; }
+        // overflow is counted only AFTER full validation so it counts
+        // exactly the frames that would have produced rows — an out
+        // buffer sized for the valid rows never spuriously overflows
+        if (rows >= max_rows) { ++overflow; continue; }
         uint32_t sport = 0, dport = 0, flags = 0;
         if ((proto == 6 || proto == 17 || proto == 132) && l4_len >= 4) {
             sport = be16(l4);

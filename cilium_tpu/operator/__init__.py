@@ -28,6 +28,9 @@ class Operator:
         self.cidrs_collected = 0
         self.sweeps = 0
 
+    def close(self) -> None:
+        self._alloc_gc.close()
+
     def sweep(self) -> dict:
         """One housekeeping pass (drive from a controller):
         1. identity GC — master keys with no live node refs;

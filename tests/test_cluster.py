@@ -45,6 +45,66 @@ class TestKVStoreBackend:
             ids[f"key{i}"] = backend.allocate(f"key{i};")
         assert len(set(ids.values())) == 20
 
+    def test_concurrent_same_key_claims_agree(self):
+        """ADVICE r03 (medium): concurrent nodes allocating the SAME
+        label set must converge on ONE numeric with ONE master key —
+        the per-key kvstore lock (reference: pkg/kvstore LockPath
+        around pkg/allocator claims) serializes same-key minting."""
+        import threading
+
+        kv = InMemoryKVStore()
+        results = []
+
+        def run(node):
+            be = KVStoreAllocatorBackend(kv, node=node, lease_ttl=2.0)
+            results.append(be.allocate("k8s:app=web;"))
+            be.close()
+
+        ts = [threading.Thread(target=run, args=(f"n{i}",))
+              for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(results) == 8 and len(set(results)) == 1
+        prefix = "cilium/state/identities/v1"
+        masters = [v for v in kv.list_prefix(f"{prefix}/id/").values()
+                   if v.decode() == "k8s:app=web;"]
+        assert len(masters) == 1
+        # the lock key is released, not leaked
+        assert not kv.list_prefix(f"{prefix}/locks/")
+
+    def test_concurrent_distinct_key_claims_are_collision_free(self):
+        import threading
+
+        kv = InMemoryKVStore()
+        results = {}
+
+        def run(i):
+            be = KVStoreAllocatorBackend(kv, node=f"n{i}")
+            results[i] = be.allocate(f"key{i};")
+            be.close()
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(12)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert len(set(results.values())) == 12
+
+    def test_gcd_hole_is_reused(self):
+        """r03 weak #8: GC'd numeric holes are reused instead of the id
+        space growing max+1 forever."""
+        kv = InMemoryKVStore()
+        a = KVStoreAllocatorBackend(kv, node="a")
+        n1 = a.allocate("k1;")
+        n2 = a.allocate("k2;")
+        a.allocate("k3;")
+        assert n2 == n1 + 1
+        a.release("k2;")
+        assert a.gc() == 1
+        assert a.allocate("k4;") == n2  # fills the hole
+
     def test_release_then_reallocate_keeps_numeric(self):
         """r03 review: releasing every node ref and re-allocating the
         same key must reuse the surviving MASTER key's numeric (until
@@ -146,6 +206,29 @@ class TestTwoDaemons:
         for ident in idents:
             got = db_d.allocator.lookup_by_id(ident.numeric_id)
             assert got is not None and got.labels == ident.labels
+
+    def test_hole_reuse_aba_rebinds_watched_identity(self):
+        """r04 review: hole reuse makes the ABA case common — a peer
+        that replayed k1->N must drop N when identity GC sweeps it and
+        rebind N when the cluster re-mints it as k2, or it enforces
+        k1's policy on k2's traffic."""
+        kv = InMemoryKVStore()
+        da = Daemon(DaemonConfig(node_name="a", backend="interpreter"),
+                    kvstore=kv)
+        db_d = Daemon(DaemonConfig(node_name="b", backend="interpreter"),
+                      kvstore=kv)
+        k1 = da.allocator.allocate(LabelSet.parse("k8s:app=one"))
+        n = k1.numeric_id
+        got = db_d.allocator.lookup_by_id(n)
+        assert got is not None and got.labels == k1.labels
+        da.allocator.release(k1)
+        assert da.allocator._backend.gc() == 1
+        # the unreferenced replica dropped on BOTH nodes
+        assert db_d.allocator.lookup_by_id(n) is None
+        k2 = da.allocator.allocate(LabelSet.parse("k8s:app=two"))
+        assert k2.numeric_id == n  # hole reused
+        got2 = db_d.allocator.lookup_by_id(n)
+        assert got2 is not None and got2.labels == k2.labels
 
     def test_reserved_and_cidr_identities_stay_local(self):
         """CIDR identities are node-local (LOCAL_IDENTITY_FLAG) and

@@ -248,3 +248,22 @@ class TestLPMUpsert:
                 t = compile_lpm(merged)
         assert rebuilt >= 1  # padding (8 blocks) must have exhausted
         self._roundtrip(merged, [])
+
+    def test_failed_upsert_leaves_tensors_untouched(self):
+        """ADVICE r03 (low): when l3 padding is exhausted but l2 has
+        headroom, lpm_upsert must NOT allocate the l2 block and point
+        l1 at it before returning None — a partial mutation leaks a
+        block per failed upsert."""
+        from cilium_tpu.datapath.lpm import compile_lpm, lpm_upsert
+
+        # 1 l2 block, 2 l3 blocks; block_pad=2 -> l2 has headroom
+        # (1/2 used) while l3 is exhausted (2/2 used)
+        t = compile_lpm({"10.0.0.1/32": 5, "10.0.1.1/32": 6},
+                        block_pad=2)
+        l1, l2, l3 = t.l1.copy(), t.l2.copy(), t.l3.copy()
+        # fresh hi16 -> wants one l2 block (available) AND one l3
+        # block (exhausted): must fail with zero side effects
+        assert lpm_upsert(t, "10.9.0.1/32", 7) is None
+        np.testing.assert_array_equal(t.l1, l1)
+        np.testing.assert_array_equal(t.l2, l2)
+        np.testing.assert_array_equal(t.l3, l3)

@@ -69,6 +69,26 @@ class TestSNAT:
         np.testing.assert_array_equal(np.asarray(hdr), rows)
         assert not np.asarray(masq).any()
 
+    def test_disabled_is_identity_ct_aware_path(self):
+        """ADVICE r03 (low): apply_masquerade (the CT-aware stage the
+        loader dispatches) must honor NATTensors.enabled like
+        snat_stage does."""
+        from cilium_tpu.datapath.verdict import apply_masquerade_jit
+        from cilium_tpu.testing.fixtures import build_world
+
+        world = build_world(n_identities=8, n_rules=2,
+                            ct_capacity=1 << 10)
+        t = NATConfig(node_ip="192.168.0.1", enabled=False).compile()
+        rows = _rows([(POD, WORLD, 1)])
+        hdr = apply_masquerade_jit(world.state.ct, t,
+                                   jnp.asarray(rows), jnp.uint32(5))
+        np.testing.assert_array_equal(np.asarray(hdr), rows)
+        # interpreter backend parity
+        from cilium_tpu.datapath.loader import InterpreterLoader
+
+        il = InterpreterLoader()
+        np.testing.assert_array_equal(il.masquerade(t, rows, 5), rows)
+
     def test_inbound_reply_is_never_masqueraded(self):
         """r03 review: stateless SNAT corrupted replies of INBOUND
         connections.  The CT-aware stage keeps their source, and the

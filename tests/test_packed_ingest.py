@@ -71,6 +71,68 @@ def test_packed_skips_non_ipv4_and_counts():
     assert skipped == 1
 
 
+def _icmp_error_frame():
+    """Eth + IPv4 ICMP dest-unreachable embedding an original UDP
+    packet 10.0.0.9:5353 -> 10.0.0.7:53."""
+    import struct
+
+    inner = struct.pack("!BBHHHBBH4s4s", 0x45, 0, 28, 0, 0, 64, 17, 0,
+                        bytes([10, 0, 0, 9]), bytes([10, 0, 0, 7]))
+    inner += struct.pack("!HHHH", 5353, 53, 8, 0)
+    icmp = struct.pack("!BBHI", 3, 1, 0, 0) + inner
+    ip = struct.pack("!BBHHHBBH4s4s", 0x45, 0, 20 + len(icmp), 0, 0, 64,
+                     1, 0, bytes([10, 0, 0, 7]), bytes([10, 0, 0, 9]))
+    eth = b"\x00" * 12 + b"\x08\x00" + ip + icmp
+    return struct.pack("<I", len(eth)) + eth
+
+
+def test_packed_icmp_error_keeps_outer_tuple_native_and_python():
+    """ADVICE r03 (medium): the packed fast path has no RELATED bit, so
+    BOTH packed parsers must keep the ICMP error's OUTER tuple —
+    packing the embedded inner tuple as ordinary traffic would let a
+    forged ICMP error refresh the original flow's CT entry."""
+    import struct
+
+    buf = _icmp_error_frame()
+    rows_n, n_n, sk_n = native.parse_frames_packed(buf)
+    rows_p, n_p, sk_p = native.parse_frames_packed_py(buf)
+    assert (n_n, sk_n) == (1, 0) and (n_p, sk_p) == (1, 0)
+    np.testing.assert_array_equal(np.asarray(rows_n), np.asarray(rows_p))
+    src = int(rows_n[0, 0])
+    dst = int(rows_n[0, 1])
+    ports = int(rows_n[0, 2])
+    meta = int(rows_n[0, 3])
+    assert src == 0x0A000007 and dst == 0x0A000009  # OUTER, not inner
+    assert ports == 3  # sport 0, dport = ICMP type
+    assert meta >> 24 == 1  # proto stays ICMP
+    # ...while the WIDE path applies the RELATED transform
+    from cilium_tpu.core.packets import (COL_DST_IP3, COL_FLAGS,
+                                         COL_PROTO, COL_SRC_IP3,
+                                         FLAG_RELATED)
+    wide = native.parse_frames_py(buf)
+    assert int(wide[0, COL_SRC_IP3]) == 0x0A000009
+    assert int(wide[0, COL_DST_IP3]) == 0x0A000007
+    assert int(wide[0, COL_PROTO]) == 17
+    assert int(wide[0, COL_FLAGS]) == FLAG_RELATED
+
+
+def test_packed_overflow_counts_only_valid_rows():
+    """ADVICE r03 (low): once the out buffer is full, malformed /
+    skipped frames must NOT count as overflow — a buffer sized exactly
+    for the valid rows never spuriously raises."""
+    import ctypes
+    import struct
+
+    batch = _v4_batch(8, seed=2)
+    buf = frames_from_batch(batch)
+    # append a skippable IPv6 frame AFTER 8 valid v4 frames
+    v6 = b"\x00" * 12 + b"\x86\xdd" + bytes([0x60] + [0] * 39)
+    buf = buf + struct.pack("<I", len(v6)) + v6
+    out = np.empty((8, PACKED_COLS), dtype=np.uint32)  # exactly-sized
+    rows, n, skipped = native.parse_frames_packed(buf, out)
+    assert n == 8 and skipped == 1  # no spurious overflow raise
+
+
 def test_undersized_out_buffer_raises():
     """Silent truncation would be undetectable packet loss; both the
     native and Python paths must raise instead (r03 review)."""
