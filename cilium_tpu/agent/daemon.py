@@ -66,6 +66,12 @@ class DaemonConfig:
     masquerade: bool = False
     node_ip: Optional[str] = None
     non_masquerade_cidrs: Tuple[str, ...] = ("10.0.0.0/8",)
+    # identity value-ref lease (reference: etcd lease on pkg/allocator
+    # slave keys): None = unleased refs (single-process tests); set it
+    # when the kvstore is networked so a crashed agent's refs expire
+    # and identity GC can sweep.  A keepalive controller refreshes at
+    # ttl/3.
+    identity_lease_ttl: Optional[float] = None
 
 
 class Daemon:
@@ -83,7 +89,8 @@ class Daemon:
         backend = None
         if kvstore is not None:
             backend = KVStoreAllocatorBackend(
-                self.kvstore, node=self.config.node_name)
+                self.kvstore, node=self.config.node_name,
+                lease_ttl=self.config.identity_lease_ttl)
         self.allocator = CachingIdentityAllocator(backend=backend)
         self.identity_sync: Optional[ClusterIdentitySync] = None
         self.repo = PolicyRepository(self.allocator)
@@ -298,6 +305,15 @@ class Daemon:
         self.controllers.update(
             "identity-retry", self.endpoints.retry_pending_identities,
             5.0)
+        # leased identity refs need a heartbeat (reference: etcd lease
+        # keepalive on allocator slave keys)
+        ttl = self.config.identity_lease_ttl
+        backend = self.allocator._backend
+        if ttl and backend is not None and hasattr(backend,
+                                                   "refresh_refs"):
+            self.controllers.update(
+                "identity-keepalive", backend.refresh_refs,
+                max(ttl / 3.0, 0.05))
 
     hubble_server = None
 

@@ -4,14 +4,16 @@ Reference: upstream cilium ``pkg/kvstore`` — the etcd client behind
 identity allocation, node discovery, and ClusterMesh, with the
 ``store`` shared-store pattern (watch a prefix, mirror into memory).
 
-The in-memory backend serves a single host (tests, single-node runs);
-the same interface backs the multi-host store when processes join via
-``jax.distributed`` (one process elected writer; replicas mirror by
-watch replay — the ClusterMesh analogue).
+The in-memory backend serves a single process (tests, single-node
+runs); ``KVStoreServer``/``RemoteKVStore`` (remote.py) serve the SAME
+interface over a unix/TCP socket so separate OS processes — agents,
+the operator, remote clusters — share one store the way the
+reference's components share etcd.
 """
 
 from .allocator import (  # noqa: F401
     ClusterIdentitySync,
     KVStoreAllocatorBackend,
 )
+from .remote import KVStoreServer, RemoteKVStore  # noqa: F401
 from .store import InMemoryKVStore, KVEvent, SharedStore  # noqa: F401

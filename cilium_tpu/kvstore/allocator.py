@@ -52,6 +52,7 @@ class KVStoreAllocatorBackend:
         # allocation from O(identities) round trips into O(1).
         self._key_by_id: dict = {}
         self._id_by_key: dict = {}
+        self._held: set = set()  # ref keys this node wrote (keepalive)
         self._cancel = kv.watch_prefix(f"{self.prefix}/id/",
                                        self._on_id_event, replay=True)
 
@@ -84,18 +85,10 @@ class KVStoreAllocatorBackend:
         reusing the existing id when one exists, claiming a fresh one
         (create-only on the master key) otherwise."""
         while True:
-            # reuse path 1: a node currently references this key.
-            # Repair a missing master key while here (reference:
-            # pkg/allocator recreateMasterKey — a master swept while
-            # refs live, e.g. by a crashed claimant's undo, must come
-            # back or watch replay and GC lose sight of the id).
+            # reuse path 1: a node currently references this key
             existing = self.kv.list_prefix(self._value_prefix(key))
             for _, raw in existing.items():
-                num = int(raw)
-                self.kv.create_only(self._id_key(num), key.encode())
-                self.kv.update(self._value_prefix(key) + self.node,
-                               raw, lease_ttl=self.lease_ttl)
-                return num
+                return self._adopt(key, int(raw))
             # reuse path 2: an unreferenced MASTER key still maps this
             # label set (all node refs released but identity GC has not
             # swept it) — minting a fresh id here would make nodes
@@ -108,16 +101,27 @@ class KVStoreAllocatorBackend:
             if hint is not None:
                 raw = self.kv.get(self._id_key(hint))
                 if raw is not None and raw.decode() == key:
-                    self.kv.update(self._value_prefix(key) + self.node,
-                                   str(hint).encode(),
-                                   lease_ttl=self.lease_ttl)
-                    return hint
+                    return self._adopt(key, hint)
             num = self._claim(key)
             if num is not None:
                 return num
             # fencing breach (lock lease expired mid-claim): retry —
             # the rescan adopts whatever master the interim winner
             # minted, or re-mints
+
+    def _adopt(self, key: str, num: int) -> int:
+        """Take this node's ref on an existing id, then repair the
+        master key if identity GC swept it in the meantime
+        (reference: pkg/allocator recreateMasterKey).  REF FIRST: once
+        the ref exists, gc() (which only sweeps masters with zero
+        refs) can no longer race the repair."""
+        ref_key = self._value_prefix(key) + self.node
+        self.kv.update(ref_key, str(num).encode(),
+                       lease_ttl=self.lease_ttl)
+        self.kv.create_only(self._id_key(num), key.encode())
+        with self._lock:
+            self._held.add(ref_key)
+        return num
 
     def _claim(self, key: str) -> Optional[int]:
         """Mint (or adopt) the master key for ``key`` under the
@@ -147,11 +151,7 @@ class KVStoreAllocatorBackend:
             for id_key, raw in self.kv.list_prefix(
                     f"{self.prefix}/id/").items():
                 if raw.decode() == key:
-                    num = int(id_key.rsplit("/", 1)[1])
-                    self.kv.update(self._value_prefix(key) + self.node,
-                                   str(num).encode(),
-                                   lease_ttl=self.lease_ttl)
-                    return num
+                    return self._adopt(key, int(id_key.rsplit("/", 1)[1]))
             num = self._first_free()
             while num < self.max_id:
                 # create_only still arbitrates cross-KEY races (two
@@ -167,38 +167,60 @@ class KVStoreAllocatorBackend:
                         # numeric invisible to scans/GC, and the slot
                         # could be re-minted for a different key).
                         if self._ref_exists(key, num):
-                            self.kv.update(
-                                self._value_prefix(key) + self.node,
-                                str(num).encode(),
-                                lease_ttl=self.lease_ttl)
-                            return num
+                            return self._adopt(key, num)
                         self.kv.delete(self._id_key(num))
                         if self._ref_exists(key, num):
                             # adopted during the delete window:
                             # resurrect the master (recreateMasterKey)
-                            self.kv.create_only(self._id_key(num),
-                                                key.encode())
-                            self.kv.update(
-                                self._value_prefix(key) + self.node,
-                                str(num).encode(),
-                                lease_ttl=self.lease_ttl)
-                            return num
+                            return self._adopt(key, num)
                         return None
-                    self.kv.update(self._value_prefix(key) + self.node,
-                                   str(num).encode(),
+                    ref_key = self._value_prefix(key) + self.node
+                    self.kv.update(ref_key, str(num).encode(),
                                    lease_ttl=self.lease_ttl)
+                    with self._lock:
+                        self._held.add(ref_key)
                     return num
                 cur = self.kv.get(self._id_key(num))
-                if cur is not None:  # learn the conflict; None means
-                    with self._lock:  # created-and-GC'd: just move on
+                if cur is not None:
+                    if cur.decode() == key:
+                        # Our own mint surfaced as a conflict: a
+                        # concurrent ref() repair re-created it, or a
+                        # RemoteKVStore retry-after-reconnect applied
+                        # the create server-side and replayed False.
+                        # Probing onward would mint a SECOND master
+                        # for this label set — adopt instead.
+                        return self._adopt(key, num)
+                    with self._lock:  # learn the foreign conflict
                         self._key_by_id.setdefault(num, cur.decode())
+                # cur None means created-and-GC'd: just move on
                 num = self._first_free(num + 1)
             raise RuntimeError("identity space exhausted")
         finally:
-            # only release our own lock (lease expiry may have handed
-            # it to another node while we slept)
-            if self.kv.get(lock_key) == me:
+            # release only OUR acquisition: compare-and-delete (a
+            # get-then-delete could remove the lock a successor
+            # acquired after our lease expired)
+            if hasattr(self.kv, "delete_if"):
+                self.kv.delete_if(lock_key, me)
+            elif self.kv.get(lock_key) == me:
                 self.kv.delete(lock_key)
+
+    def refresh_refs(self) -> int:
+        """Keepalive every value ref this node holds (the etcd lease
+        heartbeat analogue); driven by the daemon's identity-keepalive
+        controller when refs are leased.  Iterates the locally-held
+        ref set — O(own refs), no cluster-wide prefix scan."""
+        if self.lease_ttl is None:
+            return 0
+        with self._lock:
+            held = list(self._held)
+        n = 0
+        for ref_key in held:
+            if self.kv.keepalive(ref_key, self.lease_ttl):
+                n += 1
+            else:  # expired or released elsewhere: stop tracking
+                with self._lock:
+                    self._held.discard(ref_key)
+        return n
 
     def _ref_exists(self, key: str, num: int) -> bool:
         return any(int(raw) == num for raw in
@@ -219,14 +241,15 @@ class KVStoreAllocatorBackend:
         local use must take a ref or identity GC could sweep an id
         this node actively enforces with).  Repairs a missing master
         on the way (recreateMasterKey analogue)."""
-        self.kv.create_only(self._id_key(num), key.encode())
-        self.kv.update(self._value_prefix(key) + self.node,
-                       str(num).encode(), lease_ttl=self.lease_ttl)
+        self._adopt(key, num)
 
     def release(self, key: str) -> None:
         """Drop this node's reference (master key stays; identity GC —
         the operator's job in the reference — sweeps orphans)."""
-        self.kv.delete(self._value_prefix(key) + self.node)
+        ref_key = self._value_prefix(key) + self.node
+        with self._lock:
+            self._held.discard(ref_key)
+        self.kv.delete(ref_key)
 
     def gc(self) -> int:
         """Operator-style sweep: delete master keys with no node refs.

@@ -1,0 +1,514 @@
+"""Networked kvstore transport: the in-memory store served over a
+socket.
+
+Reference: upstream cilium ``pkg/kvstore/etcd.go`` — every distributed
+subsystem (identity allocator, ClusterMesh, operator, node registry,
+IPAM) talks to etcd over the network with watches, leases, and
+create-only transactions.  Here the SAME protocol surface that
+``InMemoryKVStore`` exposes in-process (get/update/create_only/delete/
+list_prefix/keepalive/watch_prefix, revisions, lease TTLs) is served
+over a unix or TCP socket by :class:`KVStoreServer` and consumed
+through :class:`RemoteKVStore`, a drop-in client: the allocator,
+clustermesh, operator and health registry run UNCHANGED against it —
+the proof that the protocol layer was transport-agnostic.
+
+Wire format: newline-delimited JSON frames (values base64).
+
+- request   ``{"i": n, "op": "...", ...args}``
+- response  ``{"i": n, "r": <result>}`` or ``{"i": n, "e": "msg"}``
+- watch push ``{"w": wid, "k": kind, "key": k, "v": b64, "rev": n}``
+
+The client reconnects with backoff on connection loss and re-subscribes
+its watches with replay (consumers are idempotent: allocator mirrors,
+``watch_update``, SharedStore).  Server-side lease expiry runs on a
+ticker so a crashed client's leases die even when the store is idle —
+the failure-detection path the reference gets from etcd lease expiry.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import queue
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from .store import InMemoryKVStore, KVEvent, Watcher
+
+__all__ = ["KVStoreServer", "RemoteKVStore"]
+
+
+def _enc(value: bytes) -> str:
+    return base64.b64encode(value).decode("ascii")
+
+
+def _dec(s: str) -> bytes:
+    return base64.b64decode(s.encode("ascii"))
+
+
+class _Conn:
+    """One client connection on the server: a reader loop dispatching
+    ops + a writer thread draining an outbound queue (watch events are
+    pushed from store-mutation threads and must never block the store
+    lock on a slow client socket)."""
+
+    def __init__(self, server: "KVStoreServer", sock: socket.socket):
+        self.server = server
+        self.sock = sock
+        self._out: list = []
+        self._out_lock = threading.Lock()
+        self._out_ready = threading.Event()
+        self._closed = False
+        self._watches: Dict[int, Callable[[], None]] = {}
+        threading.Thread(target=self._read_loop, daemon=True).start()
+        threading.Thread(target=self._write_loop, daemon=True).start()
+
+    def _send(self, obj: dict) -> None:
+        data = (json.dumps(obj) + "\n").encode()
+        with self._out_lock:
+            if self._closed:
+                return
+            self._out.append(data)
+        self._out_ready.set()
+
+    def _write_loop(self) -> None:
+        while True:
+            self._out_ready.wait()
+            with self._out_lock:
+                chunks, self._out = self._out, []
+                self._out_ready.clear()
+                if self._closed and not chunks:
+                    return
+            try:
+                for c in chunks:
+                    self.sock.sendall(c)
+            except OSError:
+                self.close()
+                return
+
+    def _read_loop(self) -> None:
+        buf = b""
+        try:
+            while True:
+                data = self.sock.recv(1 << 16)
+                if not data:
+                    break
+                buf += data
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        self._handle(json.loads(line))
+        except (OSError, ValueError):
+            pass
+        finally:
+            self.close()
+
+    def _handle(self, req: dict) -> None:
+        store = self.server.store
+        i = req.get("i")
+        op = req.get("op")
+        try:
+            if op == "get":
+                v = store.get(req["key"])
+                r = None if v is None else _enc(v)
+            elif op == "update":
+                r = store.update(req["key"], _dec(req["v"]),
+                                 lease_ttl=req.get("ttl"))
+            elif op == "create_only":
+                r = store.create_only(req["key"], _dec(req["v"]),
+                                      lease_ttl=req.get("ttl"))
+            elif op == "delete":
+                r = store.delete(req["key"])
+            elif op == "delete_if":
+                r = store.delete_if(req["key"], _dec(req["v"]))
+            elif op == "list_prefix":
+                r = {k: _enc(v)
+                     for k, v in store.list_prefix(req["prefix"]).items()}
+            elif op == "keepalive":
+                r = store.keepalive(req["key"], req["ttl"])
+            elif op == "watch":
+                wid = req["wid"]
+
+                def push(ev: KVEvent, _wid=wid) -> None:
+                    self._send({"w": _wid, "k": ev.kind, "key": ev.key,
+                                "v": _enc(ev.value), "rev": ev.revision})
+
+                cancel = store.watch_prefix(req["prefix"], push,
+                                            replay=req.get("replay", True))
+                self._watches[wid] = cancel
+                r = wid
+            elif op == "unwatch":
+                cancel = self._watches.pop(req["wid"], None)
+                if cancel:
+                    cancel()
+                r = True
+            elif op == "ping":
+                r = "pong"
+            else:
+                raise ValueError(f"unknown op {op!r}")
+            self._send({"i": i, "r": r})
+        except Exception as exc:  # surface to the caller, keep serving
+            self._send({"i": i, "e": f"{type(exc).__name__}: {exc}"})
+
+    def close(self) -> None:
+        with self._out_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._out_ready.set()
+        for cancel in self._watches.values():
+            cancel()
+        self._watches.clear()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.server._conns.discard(self)
+
+
+class KVStoreServer:
+    """Serve an :class:`InMemoryKVStore` over a unix or TCP socket.
+
+    The cluster's single etcd analogue: start one (its own process in
+    production — see ``python -m cilium_tpu.kvstore.remote``), point
+    every agent/operator's :class:`RemoteKVStore` at its address."""
+
+    def __init__(self, store: Optional[InMemoryKVStore] = None,
+                 path: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 lease_tick: float = 0.2):
+        self.store = store or InMemoryKVStore()
+        self._conns: set = set()
+        self._closed = False
+        if path is not None:
+            self.address: Tuple[str, ...] = ("unix", path)
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            if os.path.exists(path):
+                os.unlink(path)
+            self._sock.bind(path)
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            self.address = ("tcp", host, self._sock.getsockname()[1])
+        self._sock.listen(64)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        # lease expiry must fire without client traffic (a crashed
+        # client stops calling; its leases still have to die)
+        self._lease_tick = lease_tick
+        threading.Thread(target=self._tick_loop, daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                sock, _ = self._sock.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
+                if self.address[0] == "tcp" else None
+            self._conns.add(_Conn(self, sock))
+
+    def _tick_loop(self) -> None:
+        while not self._closed:
+            time.sleep(self._lease_tick)
+            with self.store._lock:
+                self.store._expire_leases()
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        for c in list(self._conns):
+            c.close()
+        if self.address[0] == "unix" and os.path.exists(self.address[1]):
+            try:
+                os.unlink(self.address[1])
+            except OSError:
+                pass
+
+
+class RemoteKVStore:
+    """Drop-in ``InMemoryKVStore`` replacement speaking to a
+    :class:`KVStoreServer` — the etcd-client analogue.
+
+    Reconnect semantics (reference: pkg/kvstore etcd client): on
+    connection loss every in-flight call fails over to one retry after
+    re-dial, and every watch re-subscribes WITH replay — consumers are
+    idempotent, so replayed creates are absorbed; a key deleted during
+    the outage simply stops appearing in lookups (its delete event is
+    lost, matching a compacted etcd watch re-sync via list+watch)."""
+
+    def __init__(self, address, dial_timeout: float = 5.0,
+                 call_timeout: float = 30.0, reconnect: bool = True,
+                 max_backoff: float = 2.0):
+        self.address = tuple(address)
+        self._dial_timeout = dial_timeout
+        self._call_timeout = call_timeout
+        self._reconnect = reconnect
+        self._max_backoff = max_backoff
+        self._lock = threading.Lock()  # pending/watch bookkeeping
+        self._send_lock = threading.Lock()  # sendall may block; never
+        self._next_id = 0                   # hold _lock across it
+        self._pending: Dict[int, list] = {}
+        self._watches: Dict[int, Tuple[str, Watcher]] = {}
+        self._next_wid = 0
+        self._closed = False
+        self._sock: Optional[socket.socket] = None
+        self._connected = threading.Event()
+        # Watch callbacks run on their OWN thread, not the reader:
+        # a callback may block on an application lock held by a
+        # caller that is itself waiting for a response only the
+        # reader can demux (allocator watch-mirror updates do exactly
+        # this).  One dispatcher thread preserves event order.
+        self._events: "queue.Queue" = queue.Queue()
+        self._dial()
+        threading.Thread(target=self._read_loop, daemon=True).start()
+        threading.Thread(target=self._event_loop, daemon=True).start()
+
+    # -- transport ---------------------------------------------------
+    def _dial(self) -> None:
+        deadline = time.time() + self._dial_timeout
+        delay = 0.02
+        last: Optional[Exception] = None
+        while time.time() < deadline:
+            try:
+                if self.address[0] == "unix":
+                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                    s.connect(self.address[1])
+                else:
+                    s = socket.create_connection(
+                        (self.address[1], self.address[2]), timeout=2.0)
+                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(None)
+                self._sock = s
+                self._connected.set()
+                return
+            except OSError as exc:
+                last = exc
+                time.sleep(min(delay, self._max_backoff))
+                delay *= 2
+        raise ConnectionError(
+            f"kvstore server unreachable at {self.address}: {last}")
+
+    def _read_loop(self) -> None:
+        buf = b""
+        while not self._closed:
+            sock = self._sock
+            if sock is None:
+                time.sleep(0.01)
+                continue
+            try:
+                data = sock.recv(1 << 16)
+            except OSError:
+                data = b""
+            if not data:
+                if self._closed:
+                    return
+                self._on_disconnect()
+                buf = b""
+                continue
+            buf += data
+            while b"\n" in buf:
+                line, buf = buf.split(b"\n", 1)
+                if not line.strip():
+                    continue
+                msg = json.loads(line)
+                if "w" in msg and "i" not in msg:
+                    self._dispatch_watch(msg)
+                else:
+                    with self._lock:
+                        slot = self._pending.get(msg["i"])
+                    if slot is not None:
+                        slot[1] = msg
+                        slot[0].set()
+
+    def _on_disconnect(self) -> None:
+        self._connected.clear()
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        # fail in-flight calls so callers can retry
+        with self._lock:
+            for slot in self._pending.values():
+                slot[1] = {"e": "ConnectionError: connection lost"}
+                slot[0].set()
+            self._pending.clear()
+        if not self._reconnect or self._closed:
+            return
+        while not self._closed:
+            try:
+                self._dial()
+                break
+            except ConnectionError:
+                time.sleep(self._max_backoff / 4)
+        if self._closed:
+            return
+        # re-subscribe watches with replay (list+watch re-sync) — from
+        # a SEPARATE thread: this method runs on the reader thread,
+        # which must get back to demuxing responses or the watch calls
+        # below would wait on themselves
+        with self._lock:
+            watches = dict(self._watches)
+
+        def resubscribe() -> None:
+            for wid, (prefix, _fn) in watches.items():
+                try:
+                    self._call("watch", wid=wid, prefix=prefix,
+                               replay=True)
+                except (ConnectionError, TimeoutError):
+                    pass  # next disconnect cycle retries
+
+        if watches:
+            threading.Thread(target=resubscribe, daemon=True).start()
+
+    def _dispatch_watch(self, msg: dict) -> None:
+        self._events.put(msg)
+
+    def _event_loop(self) -> None:
+        while True:
+            msg = self._events.get()
+            if msg is None:
+                return
+            with self._lock:
+                entry = self._watches.get(msg["w"])
+            if entry is None:
+                continue
+            _prefix, fn = entry
+            try:
+                fn(KVEvent(msg["k"], msg["key"], _dec(msg["v"]),
+                           msg["rev"]))
+            except Exception:
+                pass  # a broken observer must not kill the dispatcher
+
+    def _call(self, op: str, **args):
+        """One request/response round trip; one transparent retry
+        after a reconnect."""
+        for attempt in (0, 1):
+            self._connected.wait(self._dial_timeout)
+            slot = [threading.Event(), None]
+            with self._lock:
+                self._next_id += 1
+                i = self._next_id
+                self._pending[i] = slot
+                sock = self._sock
+            frame = dict(args)
+            frame["i"] = i
+            frame["op"] = op
+            data = (json.dumps(frame) + "\n").encode()
+            try:
+                if sock is None:
+                    raise OSError("not connected")
+                with self._send_lock:
+                    sock.sendall(data)
+            except OSError:
+                with self._lock:
+                    self._pending.pop(i, None)
+                if attempt == 0 and self._reconnect and not self._closed:
+                    continue
+                raise ConnectionError("kvstore connection lost")
+            if not slot[0].wait(self._call_timeout):
+                with self._lock:
+                    self._pending.pop(i, None)
+                raise TimeoutError(f"kvstore call {op} timed out")
+            with self._lock:
+                self._pending.pop(i, None)
+            msg = slot[1]
+            if "e" in msg:
+                if msg["e"].startswith("ConnectionError") \
+                        and attempt == 0 and self._reconnect \
+                        and not self._closed:
+                    continue
+                raise RuntimeError(msg["e"])
+            return msg["r"]
+        raise ConnectionError("kvstore connection lost")
+
+    # -- InMemoryKVStore interface ------------------------------------
+    def get(self, key: str) -> Optional[bytes]:
+        r = self._call("get", key=key)
+        return None if r is None else _dec(r)
+
+    def update(self, key: str, value: bytes,
+               lease_ttl: Optional[float] = None) -> int:
+        return self._call("update", key=key, v=_enc(value), ttl=lease_ttl)
+
+    def create_only(self, key: str, value: bytes,
+                    lease_ttl: Optional[float] = None) -> bool:
+        return self._call("create_only", key=key, v=_enc(value),
+                          ttl=lease_ttl)
+
+    def delete(self, key: str) -> bool:
+        return self._call("delete", key=key)
+
+    def delete_if(self, key: str, expected: bytes) -> bool:
+        return self._call("delete_if", key=key, v=_enc(expected))
+
+    def list_prefix(self, prefix: str) -> Dict[str, bytes]:
+        return {k: _dec(v) for k, v in
+                self._call("list_prefix", prefix=prefix).items()}
+
+    def keepalive(self, key: str, lease_ttl: float) -> bool:
+        return self._call("keepalive", key=key, ttl=lease_ttl)
+
+    def watch_prefix(self, prefix: str, fn: Watcher,
+                     replay: bool = True) -> Callable[[], None]:
+        with self._lock:
+            self._next_wid += 1
+            wid = self._next_wid
+            self._watches[wid] = (prefix, fn)
+        self._call("watch", wid=wid, prefix=prefix, replay=replay)
+
+        def cancel() -> None:
+            with self._lock:
+                self._watches.pop(wid, None)
+            try:
+                self._call("unwatch", wid=wid)
+            except (ConnectionError, TimeoutError, RuntimeError):
+                pass
+
+        return cancel
+
+    def ping(self) -> bool:
+        return self._call("ping") == "pong"
+
+    def close(self) -> None:
+        self._closed = True
+        self._connected.set()
+        self._events.put(None)
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+
+
+def main() -> None:
+    """Standalone server process:
+    ``python -m cilium_tpu.kvstore.remote --socket /path`` or
+    ``--port N``."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--socket", default=None,
+                   help="unix socket path (preferred)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args()
+    srv = KVStoreServer(path=args.socket, host=args.host, port=args.port)
+    print(json.dumps({"address": list(srv.address)}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,18 @@ class InMemoryKVStore:
             self._notify(KVEvent("delete", key, b"", self._revision))
             return True
 
+    def delete_if(self, key: str, expected: bytes) -> bool:
+        """Atomic compare-and-delete (etcd txn analogue): delete only
+        while the stored value still equals ``expected``.  The safe
+        lock-release primitive — a plain get-then-delete could remove
+        a lock a successor acquired after the caller's lease expired."""
+        with self._lock:
+            self._expire_leases()
+            v = self._data.get(key)
+            if v is None or v[0] != expected:
+                return False
+            return self.delete(key)
+
     def list_prefix(self, prefix: str) -> Dict[str, bytes]:
         with self._lock:
             self._expire_leases()

@@ -1,0 +1,256 @@
+"""Networked kvstore transport (VERDICT r03 item 1).
+
+The distributed plane was protocol-complete but in-process; these
+tests prove the SAME allocator/daemon/operator code runs over a
+socket — including as separate OS processes — with reconnect and
+lease-expiry behavior (the etcd semantics the reference leans on:
+pkg/kvstore/etcd.go).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.kvstore import (
+    InMemoryKVStore,
+    KVStoreAllocatorBackend,
+    KVStoreServer,
+    RemoteKVStore,
+)
+from cilium_tpu.labels import LabelSet
+
+
+@pytest.fixture
+def server(tmp_path):
+    srv = KVStoreServer(path=str(tmp_path / "kv.sock"), lease_tick=0.05)
+    yield srv
+    srv.close()
+
+
+def _client(server, **kw):
+    return RemoteKVStore(server.address, **kw)
+
+
+class TestRemoteSemantics:
+    def test_kv_ops_round_trip(self, server):
+        c = _client(server)
+        assert c.get("a") is None
+        rev1 = c.update("a", b"1")
+        rev2 = c.update("a", b"2")
+        assert rev2 > rev1
+        assert c.get("a") == b"2"
+        assert c.create_only("a", b"x") is False
+        assert c.create_only("b", b"3") is True
+        assert c.list_prefix("") == {"a": b"2", "b": b"3"}
+        assert c.delete("a") is True
+        assert c.delete("a") is False
+        c.close()
+
+    def test_watch_replay_and_live_events(self, server):
+        c1, c2 = _client(server), _client(server)
+        c1.update("pre/x", b"1")
+        seen = []
+        cancel = c2.watch_prefix("pre/", lambda ev: seen.append(
+            (ev.kind, ev.key, ev.value)))
+        deadline = time.time() + 2
+        while len(seen) < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        assert ("create", "pre/x", b"1") in seen  # replay
+        c1.update("pre/y", b"2")
+        c1.delete("pre/x")
+        deadline = time.time() + 2
+        while len(seen) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        kinds = [(k, key) for k, key, _ in seen]
+        assert ("create", "pre/y") in kinds
+        assert ("delete", "pre/x") in kinds
+        cancel()
+        c1.update("pre/z", b"3")
+        time.sleep(0.1)
+        assert not any(key == "pre/z" for _, key, _ in seen)
+        c1.close()
+        c2.close()
+
+    def test_lease_expires_without_traffic(self, server):
+        """A crashed client's leased keys must die on the server's
+        ticker — no other client traffic required."""
+        c = _client(server)
+        c.update("leased", b"v", lease_ttl=0.15)
+        c.close()  # the "crash": nobody refreshes
+        c2 = _client(server)
+        assert c2.get("leased") == b"v"
+        time.sleep(0.4)
+        assert c2.get("leased") is None
+        c2.close()
+
+    def test_keepalive_refreshes_lease(self, server):
+        c = _client(server)
+        c.update("hb", b"v", lease_ttl=0.2)
+        for _ in range(4):
+            time.sleep(0.1)
+            assert c.keepalive("hb", 0.2)
+        assert c.get("hb") == b"v"
+        c.close()
+
+    def test_reconnect_retries_call_and_resubscribes_watch(self, server):
+        c = _client(server)
+        seen = []
+        c.watch_prefix("w/", lambda ev: seen.append(ev.key))
+        c.update("w/a", b"1")
+        # sever every connection server-side (network blip)
+        for conn in list(server._conns):
+            conn.close()
+        # calls ride the transparent retry after re-dial
+        assert c.get("w/a") == b"1"
+        c.update("w/b", b"2")
+        deadline = time.time() + 3
+        while "w/b" not in seen and time.time() < deadline:
+            time.sleep(0.01)
+        assert "w/b" in seen  # the watch survived the reconnect
+        assert "w/a" in seen
+        c.close()
+
+
+class TestClusterOverSocket:
+    def test_two_daemons_agree_over_socket(self, server):
+        """The r02/r03 identity-agreement test, verbatim logic, with
+        networked store handles — zero changes to allocator/daemon
+        code (the transport-agnostic-protocol proof)."""
+        kva, kvb = _client(server), _client(server)
+        da = Daemon(DaemonConfig(node_name="a", backend="interpreter"),
+                    kvstore=kva)
+        db_d = Daemon(DaemonConfig(node_name="b", backend="interpreter"),
+                      kvstore=kvb)
+        web = da.allocator.allocate(
+            LabelSet.parse("k8s:app=web", "k8s:role=web"))
+        deadline = time.time() + 3
+        got = None
+        while got is None and time.time() < deadline:
+            got = db_d.allocator.lookup_by_id(web.numeric_id)
+            time.sleep(0.01)
+        assert got is not None and got.labels == web.labels
+        web_b = db_d.allocator.allocate(
+            LabelSet.parse("k8s:app=web", "k8s:role=web"))
+        assert web_b.numeric_id == web.numeric_id
+        da.shutdown()
+        db_d.shutdown()
+        kva.close()
+        kvb.close()
+
+    def test_operator_gc_over_socket(self, server):
+        from cilium_tpu.operator import Operator
+
+        kv1, kv2 = _client(server), _client(server)
+        be = KVStoreAllocatorBackend(kv1, node="agent")
+        num = be.allocate("k8s:app=tmp;")
+        op = Operator(kv2)
+        assert op.sweep()["identities-collected"] == 0
+        be.release("k8s:app=tmp;")
+        assert op.sweep()["identities-collected"] == 1
+        be.close()
+        op.close()
+        kv1.close()
+        kv2.close()
+
+
+def _spawn_child(socket_path, node, labels, lease_ttl="0.3"):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "cilium_tpu.testing.cluster_child",
+         socket_path, node, labels, lease_ttl],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+        text=True)
+
+
+class TestOSProcesses:
+    def test_three_processes_share_one_store(self, tmp_path):
+        """Server + two agents as SEPARATE OS PROCESSES + operator in
+        this one: agents agree on identity numerics over the socket,
+        enforce the same verdict; killing an agent expires its leased
+        refs so identity GC sweeps (crash recovery)."""
+        from cilium_tpu.operator import Operator
+
+        sock = str(tmp_path / "kv.sock")
+        srv_proc = subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.kvstore.remote",
+             "--socket", sock],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        children = []
+        try:
+            assert json.loads(srv_proc.stdout.readline())["address"] == \
+                ["unix", sock]
+            a = _spawn_child(sock, "node-a", "k8s:app=web,k8s:role=web")
+            b = _spawn_child(sock, "node-b", "k8s:app=web,k8s:role=web")
+            children = [a, b]
+            outs = []
+            for p in children:
+                line = p.stdout.readline()
+                assert line, p.stderr.read()
+                outs.append(json.loads(line))
+            by_node = {o["node"]: o for o in outs}
+            # cluster-wide agreement on the numeric, same verdict
+            assert by_node["node-a"]["identity"] == \
+                by_node["node-b"]["identity"]
+            assert by_node["node-a"]["verdict"] == [1]
+            assert by_node["node-b"]["verdict"] == [1]
+
+            op_kv = RemoteKVStore(("unix", sock))
+            op = Operator(op_kv)
+            # both agents alive: their web identity is referenced
+            assert op.sweep()["identities-collected"] == 0
+
+            # crash node-b; its leased refs expire, node-a's keepalive
+            # holds its own
+            b.kill()
+            b.wait(timeout=10)
+            time.sleep(1.0)  # > lease_ttl (0.3s) + server tick
+            assert op.sweep()["identities-collected"] == 0  # a holds on
+            a.kill()
+            a.wait(timeout=10)
+            deadline = time.time() + 5
+            collected = 0
+            while collected == 0 and time.time() < deadline:
+                time.sleep(0.2)
+                collected = op.sweep()["identities-collected"]
+            # every agent gone -> all refs expired -> identity GC
+            # sweeps web AND each agent's db endpoint identity
+            assert collected >= 1
+            op.close()
+            op_kv.close()
+        finally:
+            for p in children:
+                if p.poll() is None:
+                    p.kill()
+            srv_proc.send_signal(signal.SIGINT)
+            try:
+                srv_proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                srv_proc.kill()
+
+    def test_killed_and_restarted_agent_rejoins(self, tmp_path):
+        """An agent that dies and comes back re-adopts the SAME
+        identity numeric from the store (restore path over the
+        network)."""
+        sock = str(tmp_path / "kv.sock")
+        srv = KVStoreServer(path=sock, lease_tick=0.05)
+        try:
+            a = _spawn_child(sock, "node-a", "k8s:app=web", "5.0")
+            first = json.loads(a.stdout.readline())
+            a.kill()
+            a.wait(timeout=10)
+            # restart before the (5s) lease expires: numeric survives
+            a2 = _spawn_child(sock, "node-a", "k8s:app=web", "5.0")
+            second = json.loads(a2.stdout.readline())
+            a2.kill()
+            a2.wait(timeout=10)
+            assert first["identity"] == second["identity"]
+        finally:
+            srv.close()
