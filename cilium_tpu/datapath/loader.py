@@ -117,7 +117,11 @@ class TPULoader(Loader):
 
         tensors = compile_policy(list(policies), row_map)
         lpm = compile_lpm({c: row_map.row(i) for c, i in ipcache.items()})
-        epp = np.zeros(MAX_ENDPOINTS, dtype=np.int32)
+        # -1 = lxcmap-miss sentinel: a packet with an unregistered
+        # endpoint id DROPS (REASON_NO_ENDPOINT) instead of being
+        # judged under endpoint 0's policy (reference: bpf_lxc drops
+        # on endpoint lookup failure)
+        epp = np.full(MAX_ENDPOINTS, -1, dtype=np.int32)
         for ep_id, pol_row in ep_policy.items():
             if not 0 <= ep_id < MAX_ENDPOINTS:
                 # on-device gathers clamp out-of-range ids to the last
@@ -368,14 +372,9 @@ class InterpreterLoader(Loader):
 
         old_ct = self.oracle.ct if self.oracle is not None else None
         self.row_map = row_map
+        # endpoints not listed are lxcmap misses: the oracle drops
+        # them (REASON_NO_ENDPOINT), matching the device's -1 sentinel
         pol_by_ep = {ep: policies[row] for ep, row in ep_policy.items()}
-        # default: endpoints not listed use policy row 0 when present
-        if policies:
-            import collections
-
-            default_pol = policies[0]
-            pol_by_ep = collections.defaultdict(lambda: default_pol,
-                                                pol_by_ep)
         self.oracle = OracleDatapath(pol_by_ep, dict(ipcache))
         if old_ct is not None:
             self.oracle.ct = old_ct

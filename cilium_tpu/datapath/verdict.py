@@ -59,6 +59,7 @@ REASON_FORWARDED = 0
 REASON_POLICY_DENY = 1  # explicit deny rule
 REASON_POLICY_DEFAULT_DENY = 2  # no rule allowed it (default deny)
 REASON_ROUTE_OVERFLOW = 3  # flow-router shard block overflow (RSS queue)
+REASON_NO_ENDPOINT = 4  # unregistered endpoint id (lxcmap miss)
 N_REASONS = 8
 
 # Event types in the out tensor (monitor vocabulary).
@@ -93,7 +94,10 @@ class DevicePolicy:
     def from_tensors(t: PolicyTensors,
                      ep_policy: np.ndarray = None) -> "DevicePolicy":
         if ep_policy is None:
-            ep_policy = np.zeros(MAX_ENDPOINTS, dtype=np.int32)
+            # default matches TPULoader.attach: every endpoint id is
+            # an lxcmap miss until registered (callers that want the
+            # all-registered single-policy shape pass explicit zeros)
+            ep_policy = np.full(MAX_ENDPOINTS, -1, dtype=np.int32)
         return DevicePolicy(
             proto_table=jnp.asarray(t.proto_table),
             port_class=jnp.asarray(t.port_class),
@@ -166,7 +170,18 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     is_related = related_hint & (ct_res != CT_NEW)
 
     # 3. policy map lookup (two gathers; all precedence precompiled).
-    pol_row = state.policy.ep_policy[hdr[:, COL_EP].astype(jnp.int32)]
+    #    ep_policy row -1 = unregistered endpoint (the lxcmap-miss
+    #    sentinel): the reference DROPS when the endpoint lookup fails
+    #    (bpf_lxc lxcmap miss) instead of judging under some other
+    #    endpoint's policy.
+    ep_col = hdr[:, COL_EP]  # uint32: range-check BEFORE the int32
+    pol_row_raw = state.policy.ep_policy[ep_col.astype(jnp.int32)]
+    # out-of-range ids would clamp onto the boundary rows in the
+    # gather (>= 4096 -> 4095; >= 2^31 -> wraps negative -> 0) and be
+    # judged under whatever endpoint lives there — a forged ep id must
+    # be a miss, not a clamp
+    no_ep = (pol_row_raw < 0) | (ep_col >= MAX_ENDPOINTS)
+    pol_row = jnp.maximum(pol_row_raw, 0)
     proto_idx = state.policy.proto_table[hdr[:, COL_PROTO].astype(jnp.int32)]
     cls = state.policy.port_class[proto_idx, hdr[:, COL_DPORT].astype(jnp.int32)]
     packed = state.policy.verdict[pol_row, dirn, id_row, cls]
@@ -178,7 +193,9 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     is_new = ct_res == CT_NEW
     ct_proxy = state.ct.table[slot, V_PROXY].astype(jnp.int32)
     allowed_new = (p_verdict == VERDICT_ALLOW) | (p_verdict == VERDICT_REDIRECT)
-    allowed = ~is_new | allowed_new
+    # no_ep drops even ESTABLISHED traffic: the endpoint is gone/never
+    # existed, so its CT fast path must not forward either
+    allowed = (~is_new | allowed_new) & ~no_ep
     proxy = jnp.where(is_new, jnp.where(p_verdict == VERDICT_REDIRECT,
                                         p_proxy, 0),
                       ct_proxy)
@@ -188,17 +205,18 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     verdict = jnp.where(
         allowed,
         jnp.where(proxy > 0, VERDICT_REDIRECT, VERDICT_ALLOW),
-        p_verdict)  # deny or default-deny code as-is
+        jnp.where(no_ep, VERDICT_DENY, p_verdict))
     reason = jnp.where(
         allowed, REASON_FORWARDED,
-        jnp.where(p_verdict == VERDICT_DENY, REASON_POLICY_DENY,
-                  REASON_POLICY_DEFAULT_DENY))
+        jnp.where(no_ep, REASON_NO_ENDPOINT,
+                  jnp.where(p_verdict == VERDICT_DENY, REASON_POLICY_DENY,
+                            REASON_POLICY_DEFAULT_DENY)))
 
     # 5. conntrack create/refresh (create only on allowed NEW; related
     #    rows neither create nor refresh — the ICMP error is evidence
-    #    about a flow, not flow traffic).
+    #    about a flow, not flow traffic; no_ep rows touch nothing).
     ct = ct_update(state.ct, hdr, fwd,
-                   jnp.where(is_related, CT_NEW, ct_res), slot,
+                   jnp.where(is_related | no_ep, CT_NEW, ct_res), slot,
                    is_reply,
                    do_create=allowed & is_new & ~related_hint,
                    proxy_port=proxy.astype(jnp.uint32),

@@ -36,6 +36,8 @@ EVENT_TYPE_NAMES = {1: "DropNotify", 4: "TraceNotify",
 DROP_REASON_DESC = {
     1: "POLICY_DENIED",
     2: "POLICY_DENY_DEFAULT",
+    3: "QUEUE_OVERFLOW",
+    4: "UNKNOWN_ENDPOINT",  # lxcmap miss (unregistered endpoint id)
 }
 
 

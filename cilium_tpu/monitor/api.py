@@ -60,6 +60,8 @@ _EVENT_TO_MSG[EV_DROP] = MSG_DROP
 DROP_REASON_NAMES = {
     1: "Policy denied",
     2: "Policy denied (default deny)",
+    3: "Shard queue overflow",
+    4: "No endpoint found",  # lxcmap miss (unregistered endpoint id)
 }
 
 

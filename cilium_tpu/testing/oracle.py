@@ -50,6 +50,7 @@ from ..datapath.verdict import (
     EV_TRACE,
     EV_VERDICT,
     REASON_FORWARDED,
+    REASON_NO_ENDPOINT,
     REASON_POLICY_DEFAULT_DENY,
     REASON_POLICY_DENY,
 )
@@ -177,7 +178,17 @@ class OracleDatapath:
                 else:
                     ct_res, entry = CT_NEW, None
 
-            pol = self.ep_policies[int(row[COL_EP])]
+            pol = self.ep_policies.get(int(row[COL_EP]))
+            if pol is None:
+                # lxcmap miss: unregistered endpoint -> drop, CT
+                # untouched (reference: bpf_lxc endpoint lookup
+                # failure), even for packets matching a live CT entry
+                results.append(OracleResult(
+                    VERDICT_DENY, 0, ct_res, ident,
+                    REASON_NO_ENDPOINT, EV_DROP))
+                updates.append((fwd, row, is_reply, CT_NEW, 0, False,
+                                related))
+                continue
             proto_idx = int(self.proto_table[int(row[COL_PROTO])])
             p_verdict, p_proxy = pol.lookup(dirn, ident, proto_idx,
                                             int(row[COL_DPORT]))
