@@ -72,6 +72,11 @@ class DaemonConfig:
     # and identity GC can sweep.  A keepalive controller refreshes at
     # ttl/3.
     identity_lease_ttl: Optional[float] = None
+    # monitor trace aggregation (reference: --monitor-aggregation):
+    # "none" emits a TraceNotify per forwarded packet; "medium" only
+    # for flow-state-changing packets (non-TCP, or TCP SYN/FIN/RST).
+    # Per-endpoint Debug=True exempts an endpoint from aggregation.
+    monitor_aggregation: str = "none"
 
 
 class Daemon:
@@ -362,13 +367,54 @@ class Daemon:
             hdr = np.asarray(hdr_dev)
             batch = decode_out(out, hdr, row_map.numeric_array(),
                                timestamp=time.time())
-            self.monitor.publish(batch)
+            self.monitor.publish(self._filter_events(batch))
             return batch
         out, row_map = self.loader.step(hdr, now)
         batch = decode_out(out, hdr, row_map.numeric_array(),
                            timestamp=time.time())
-        self.monitor.publish(batch)
+        self.monitor.publish(self._filter_events(batch))
         return batch
+
+    def _filter_events(self, batch: EventBatch) -> EventBatch:
+        """Per-endpoint event options + monitor aggregation (reference:
+        pkg/option endpoint options DropNotification/TraceNotification/
+        Debug and --monitor-aggregation).  Filters what the MONITOR
+        plane sees; the caller's EventBatch (and metrics) keep every
+        row — the reference likewise only gates event emission."""
+        from ..core.packets import (COL_EP, COL_FLAGS, COL_PROTO,
+                                    TCP_FIN, TCP_RST, TCP_SYN)
+        from ..monitor.api import MSG_DROP, MSG_TRACE
+
+        opts = self.endpoints.event_options()
+        aggregate = self.config.monitor_aggregation == "medium"
+        if not opts and not aggregate:
+            return batch
+        keep = np.ones(len(batch), dtype=bool)
+        ep_col = batch.hdr[:, COL_EP]
+        if aggregate:
+            proto = batch.hdr[:, COL_PROTO]
+            flags = batch.hdr[:, COL_FLAGS]
+            boring = ((proto == 6)
+                      & ((flags & (TCP_SYN | TCP_FIN | TCP_RST)) == 0)
+                      & (batch.msg_type == MSG_TRACE))
+            debug_eps = [e for e, o in opts.items() if o.get("Debug")]
+            for e in debug_eps:
+                boring &= ep_col != e
+            keep &= ~boring
+        for ep_id, o in opts.items():
+            m = ep_col == ep_id
+            if not o.get("DropNotification", True):
+                keep &= ~(m & (batch.msg_type == MSG_DROP))
+            if not o.get("TraceNotification", True):
+                keep &= ~(m & (batch.msg_type == MSG_TRACE))
+        if keep.all():
+            return batch
+        return EventBatch(
+            msg_type=batch.msg_type[keep], verdict=batch.verdict[keep],
+            reason=batch.reason[keep], ct_state=batch.ct_state[keep],
+            identity=batch.identity[keep],
+            proxy_port=batch.proxy_port[keep], hdr=batch.hdr[keep],
+            timestamp=batch.timestamp)
 
     # -- policy API ---------------------------------------------------
     def policy_import(self, obj) -> int:
@@ -436,11 +482,21 @@ class Daemon:
     # the mutable subset of DaemonConfig; everything else (backend,
     # capacities) is construction-time (reference: option.DaemonConfig
     # runtime-mutable options like MonitorAggregation/PolicyEnforcement)
+    @staticmethod
+    def _cast_aggregation(raw) -> str:
+        v = str(raw)
+        if v not in ("none", "medium"):
+            raise ValueError(f"monitor-aggregation must be none|medium,"
+                             f" got {v!r}")
+        return v
+
     _MUTABLE_CONFIG = {
         "ct-gc-interval": ("ct_gc_interval", float),
         "fqdn-gc-interval": ("fqdn_gc_interval", float),
         "health-probe-interval": ("health_probe_interval", float),
         "anomaly-threshold": ("anomaly_threshold", float),
+        "monitor-aggregation": ("monitor_aggregation",
+                                _cast_aggregation.__func__),
     }
 
     def patch_config(self, body: Dict[str, object]) -> Dict[str, object]:
@@ -586,12 +642,18 @@ class Daemon:
             self.repo.add_obj(meta["rules"])
         for rec in meta["endpoints"]:
             # RESTORING until the batched regeneration below realizes
-            # their policy (reference: the endpoint restore state)
+            # their policy (reference: the endpoint restore state).
+            # Enforcement mode + options round-trip — silently
+            # resetting a "never"/"always" endpoint to "default" on
+            # restart would change verdicts.
             self.endpoints.add(rec["name"], tuple(rec["ips"]),
                                LabelSet.parse(*rec["labels"]),
                                ep_id=rec["id"],
                                named_ports=rec.get("named-ports"),
-                               restoring=True, defer_regen=True)
+                               restoring=True, defer_regen=True,
+                               enforcement=rec.get("policy-enforcement",
+                                                   "default"),
+                               options=rec.get("options"))
         self.endpoints.regenerate()
         ct_path = os.path.join(state_dir, "ct.npz")
         if os.path.exists(ct_path):

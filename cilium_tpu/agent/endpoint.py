@@ -42,6 +42,17 @@ class Endpoint:
     # container port names (reference: pod spec containerPort names;
     # named ports in policy resolve against these)
     named_ports: Dict[str, int] = field(default_factory=dict)
+    # policy enforcement mode (reference: pkg/option per-endpoint
+    # PolicyEnforcement): "default" | "always" | "never"
+    enforcement: str = "default"
+    # per-endpoint runtime options (reference: pkg/option endpoint
+    # options Debug / DropNotification / TraceNotification).  Debug
+    # exempts this endpoint from monitor trace aggregation.
+    options: Dict[str, bool] = field(default_factory=lambda: {
+        "Debug": False,
+        "DropNotification": True,
+        "TraceNotification": True,
+    })
 
     def to_dict(self) -> dict:
         """API rendering (GET /endpoint/{id})."""
@@ -54,6 +65,8 @@ class Endpoint:
                          else None),
             "state": self.state.value,
             "policy-revision": self.policy_revision,
+            "policy-enforcement": self.enforcement,
+            "options": dict(self.options),
             **({"named-ports": dict(self.named_ports)}
                if self.named_ports else {}),
         }

@@ -48,6 +48,7 @@ class EndpointManager:
         self._ep_hooks: List = []  # fn(kind, ep) on add/remove
         self._regen_trigger = Trigger(self._regenerate_all,
                                       name="endpoint-regeneration")
+        self._event_options_cache: Optional[Dict] = None
 
     def named_ports(self) -> Dict[str, int]:
         """The node's port-name registry (union over endpoints;
@@ -81,16 +82,24 @@ class EndpointManager:
             ep_id: Optional[int] = None,
             named_ports: Optional[Dict[str, int]] = None,
             restoring: bool = False,
-            defer_regen: bool = False) -> Endpoint:
+            defer_regen: bool = False,
+            enforcement: str = "default",
+            options: Optional[Dict[str, bool]] = None) -> Endpoint:
         """``ep_id`` pins a checkpointed id on restore so COL_EP
         tagging, policy rows, and the CT snapshot stay coherent.
         ``named_ports`` (name -> number) feeds the policy resolver's
         named-port registry.  ``restoring`` marks checkpoint-restore
         endpoints (state RESTORING until their first regeneration);
         ``defer_regen`` lets the restore loop batch one regeneration
-        for all endpoints instead of one each."""
+        for all endpoints instead of one each.  ``enforcement`` /
+        ``options`` restore per-endpoint config (checkpoint round
+        trip)."""
         from ..datapath.verdict import MAX_ENDPOINTS
+        from ..policy.resolve import ENFORCEMENT_MODES
 
+        if enforcement not in ENFORCEMENT_MODES:
+            raise ValueError(f"enforcement mode {enforcement!r} not "
+                             f"in {ENFORCEMENT_MODES}")
         with self._lock:
             if ep_id is None:
                 ep_id = self._next_id
@@ -104,10 +113,16 @@ class EndpointManager:
             self._next_id = max(self._next_id, ep_id + 1)
             ep = Endpoint(id=ep_id, name=name, ips=tuple(ips),
                           labels=labels,
-                          named_ports=dict(named_ports or {}))
+                          named_ports=dict(named_ports or {}),
+                          enforcement=enforcement)
+            if options:
+                ep.options.update({k: bool(v)
+                                   for k, v in options.items()
+                                   if k in ep.options})
             if restoring:
                 ep.state = EndpointState.RESTORING
             self._endpoints[ep_id] = ep
+            self._event_options_cache = None
         try:
             ident = self.repo.allocator.allocate(labels)
         except Exception:
@@ -160,6 +175,7 @@ class EndpointManager:
     def remove(self, ep_id: int) -> bool:
         with self._lock:
             ep = self._endpoints.pop(ep_id, None)
+            self._event_options_cache = None
         if ep is None:
             return False
         ep.state = EndpointState.DISCONNECTING
@@ -173,6 +189,61 @@ class EndpointManager:
         self._fire_ep("remove", ep)
         self.regenerate()
         return True
+
+    def update_config(self, ep_id: int,
+                      enforcement: Optional[str] = None,
+                      options: Optional[Dict[str, bool]] = None) -> bool:
+        """PATCH /endpoint/{id}/config: change the enforcement mode
+        and/or runtime options.  A mode change regenerates through
+        the shared trigger (synchronous when idle; folded into the
+        in-flight run otherwise — never two interleaved
+        regenerations); option changes are host-side event filters
+        and need no regen."""
+        from ..policy.resolve import ENFORCEMENT_MODES
+
+        # validate EVERYTHING before applying anything: a bad mode
+        # must not leave options half-applied behind a 400 (same
+        # stage-then-apply rule as Daemon.patch_config)
+        if enforcement is not None and enforcement not in \
+                ENFORCEMENT_MODES:
+            raise ValueError(f"enforcement mode {enforcement!r} not "
+                             f"in {ENFORCEMENT_MODES}")
+        with self._lock:
+            ep = self._endpoints.get(ep_id)
+            if ep is None:
+                return False
+            if options:
+                unknown = set(options) - set(ep.options)
+                if unknown:
+                    raise ValueError(f"unknown endpoint options "
+                                     f"{sorted(unknown)}")
+                ep.options.update({k: bool(v) for k, v in options.items()})
+            mode_changed = (enforcement is not None
+                            and enforcement != ep.enforcement)
+            if mode_changed:
+                ep.enforcement = enforcement
+            self._event_options_cache = None
+        if mode_changed:
+            self._regen_trigger.trigger()
+        return True
+
+    def event_options(self) -> Dict[int, Dict[str, bool]]:
+        """{ep_id: options} for endpoints with NON-DEFAULT options —
+        the monitor's per-endpoint event filter input.  Cached (and
+        invalidated on add/remove/update_config) so the per-batch hot
+        path is one attribute read in the all-default case."""
+        cached = self._event_options_cache
+        if cached is not None:
+            return cached
+        out: Dict[int, Dict[str, bool]] = {}
+        with self._lock:
+            for ep in self._endpoints.values():
+                if (ep.options.get("Debug")
+                        or not ep.options.get("DropNotification", True)
+                        or not ep.options.get("TraceNotification", True)):
+                    out[ep.id] = dict(ep.options)
+            self._event_options_cache = out
+        return out
 
     def get(self, ep_id: int) -> Optional[Endpoint]:
         with self._lock:
@@ -204,15 +275,25 @@ class EndpointManager:
         for ep in eps:
             ep.state = EndpointState.REGENERATING
         revision = self.repo.revision
-        # distillery: one resolved policy per distinct subject identity
+        # distillery: one resolved policy per distinct (subject
+        # identity, enforcement mode) — non-default modes derive their
+        # own variant from the shared resolve (pkg/policy distillery +
+        # pkg/option per-endpoint enforcement)
+        from ..policy.resolve import with_enforcement
+
         policies = []
-        row_of: Dict[str, int] = {}
+        row_of: Dict[tuple, int] = {}
         ep_policy: Dict[int, int] = {}
+        resolved: Dict[str, object] = {}
         for ep in eps:
-            key = ep.labels.sorted_key()
+            lkey = ep.labels.sorted_key()
+            key = (lkey, ep.enforcement)
             if key not in row_of:
+                if lkey not in resolved:
+                    resolved[lkey] = self.repo.resolve(ep.labels)
                 row_of[key] = len(policies)
-                policies.append(self.repo.resolve(ep.labels))
+                policies.append(with_enforcement(resolved[lkey],
+                                                 ep.enforcement))
             ep_policy[ep.id] = row_of[key]
             ep.policy_row = row_of[key]
         if not policies:

@@ -248,6 +248,15 @@ def _make_handler(daemon: Daemon):
                     body = self._body() or {}
                     changed = daemon.patch_config(body)
                     self._send(200, {"changed": changed})
+                elif m := re.fullmatch(r"/endpoint/(\d+)/config", path):
+                    # per-endpoint enforcement mode + options
+                    # (reference: pkg/option endpoint options)
+                    body = self._body() or {}
+                    ok = daemon.endpoints.update_config(
+                        int(m.group(1)),
+                        enforcement=body.get("policy-enforcement"),
+                        options=body.get("options"))
+                    self._send(200 if ok else 404, {"updated": ok})
                 else:
                     self._send(404, {"error": f"no such path {path}"})
             except ValueError as e:

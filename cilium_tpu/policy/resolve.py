@@ -75,6 +75,45 @@ class EndpointPolicy:
         return self.mapstate(direction).lookup(identity, proto, port)
 
 
+# Policy enforcement modes (reference: pkg/option PolicyEnforcement —
+# "default" enforces iff a rule selects the endpoint, "always" is
+# default-deny even with no rules, "never" disables enforcement).
+ENFORCEMENT_DEFAULT = "default"
+ENFORCEMENT_ALWAYS = "always"
+ENFORCEMENT_NEVER = "never"
+ENFORCEMENT_MODES = (ENFORCEMENT_DEFAULT, ENFORCEMENT_ALWAYS,
+                     ENFORCEMENT_NEVER)
+
+
+def with_enforcement(pol: EndpointPolicy, mode: str) -> EndpointPolicy:
+    """Apply a policy-enforcement mode to a resolved policy.
+
+    The mode is per ENDPOINT while the resolved policy is per identity
+    (distillery sharing), so endpoints with non-default modes get
+    their own derived policy — contribution lists are copied so
+    incremental identity churn patches each variant independently."""
+    if mode == ENFORCEMENT_DEFAULT:
+        return pol
+    if mode == ENFORCEMENT_ALWAYS:
+        return EndpointPolicy(
+            subject_labels=pol.subject_labels,
+            revision=pol.revision,
+            ingress=MapState(DIR_INGRESS, True,
+                             list(pol.ingress.contributions)),
+            egress=MapState(DIR_EGRESS, True,
+                            list(pol.egress.contributions)),
+            redirects=list(pol.redirects))
+    if mode == ENFORCEMENT_NEVER:
+        return EndpointPolicy(
+            subject_labels=pol.subject_labels,
+            revision=pol.revision,
+            ingress=MapState(DIR_INGRESS, False, []),
+            egress=MapState(DIR_EGRESS, False, []),
+            redirects=[])
+    raise ValueError(
+        f"enforcement mode {mode!r} not in {ENFORCEMENT_MODES}")
+
+
 # The "cluster" entity as a live selector: every identity NOT carrying
 # reserved:world (reference: entity "cluster" covers all
 # cluster-managed endpoints + host).  Expressed as a selector so

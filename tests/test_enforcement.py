@@ -1,0 +1,258 @@
+"""Per-endpoint policy enforcement modes + runtime options
+(VERDICT r03 item 6; reference: pkg/option PolicyEnforcement and
+endpoint options Debug/DropNotification/TraceNotification, plus
+--monitor-aggregation).
+
+Divergence gate: the TPU backend and the interpreter (oracle) backend
+must agree on every packet in every mode.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_ACK, TCP_FIN, TCP_SYN, make_batch
+from cilium_tpu.monitor.api import MSG_DROP, MSG_POLICY_VERDICT, MSG_TRACE
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [
+        {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+         "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}]},
+    ],
+}]
+
+
+def _daemon(backend, **kw):
+    return Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12,
+                               **kw))
+
+
+def _world(backend):
+    """One daemon with one db endpoint per enforcement mode + a web
+    peer; RULES select only app=db."""
+    d = _daemon(backend)
+    eps = {}
+    for mode in ("default", "always", "never"):
+        ep = d.add_endpoint(f"db-{mode}", (f"10.0.2.{len(eps) + 1}",),
+                            ["k8s:app=db"])
+        assert d.endpoints.update_config(ep.id, enforcement=mode)
+        eps[mode] = ep
+    web = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+    d.policy_import(RULES)
+    return d, eps, web
+
+
+def _traffic(eps, web):
+    rows = []
+    for i, (mode, ep) in enumerate(sorted(eps.items())):
+        dst = ep.ips[0]
+        # allowed-by-rule, denied-by-default, and unmatched-port flows
+        rows += [
+            dict(src="10.0.1.1", dst=dst, sport=41000 + i, dport=5432,
+                 proto=6, flags=TCP_SYN, ep=ep.id, dir=0),
+            dict(src="10.9.9.9", dst=dst, sport=42000 + i, dport=5432,
+                 proto=6, flags=TCP_SYN, ep=ep.id, dir=0),
+            dict(src="10.0.1.1", dst=dst, sport=43000 + i, dport=80,
+                 proto=6, flags=TCP_SYN, ep=ep.id, dir=0),
+        ]
+    # the web endpoint has NO selecting rule: default vs always differ
+    rows.append(dict(src="10.0.2.1", dst="10.0.1.1", sport=5432,
+                     dport=44000, proto=6, flags=TCP_SYN, ep=web.id,
+                     dir=0))
+    return make_batch(rows)
+
+
+class TestEnforcementModes:
+    def test_tpu_matches_interpreter_across_modes(self):
+        outs = {}
+        for backend in ("tpu", "interpreter"):
+            d, eps, web = _world(backend)
+            batch = _traffic(eps, web)
+            evb = d.process_batch(batch.data, now=10)
+            outs[backend] = (list(evb.verdict), list(evb.reason),
+                             list(evb.msg_type))
+        assert outs["tpu"] == outs["interpreter"]
+
+    def test_mode_semantics(self):
+        d, eps, web = _world("tpu")
+        batch = _traffic(eps, web)
+        evb = d.process_batch(batch.data, now=10)
+        v = {i: int(x) for i, x in enumerate(evb.verdict)}
+        # rows 0-2: always-mode db ep (sorted order: always first)
+        assert v[0] == 1  # rule allows web->5432
+        assert v[1] == 0  # unknown peer: default-deny
+        assert v[2] == 0  # port 80: default-deny
+        # rows 3-5: default mode — same as always when a rule selects
+        assert (v[3], v[4], v[5]) == (1, 0, 0)
+        # rows 6-8: never mode — everything allowed
+        assert (v[6], v[7], v[8]) == (1, 1, 1)
+        # row 9: web ep, no selecting rule, default mode -> allow
+        assert v[9] == 1
+
+    def test_always_applies_without_any_rule(self):
+        """always = default-deny even when NO rule selects the
+        endpoint (the difference from default mode)."""
+        for backend in ("tpu", "interpreter"):
+            d = _daemon(backend)
+            ep = d.add_endpoint("lonely", ("10.0.3.1",),
+                                ["k8s:app=lonely"])
+            assert d.endpoints.update_config(ep.id,
+                                             enforcement="always")
+            pkt = make_batch([dict(src="10.9.9.9", dst="10.0.3.1",
+                                   sport=40000, dport=443, proto=6,
+                                   flags=TCP_SYN, ep=ep.id, dir=0)])
+            evb = d.process_batch(pkt.data, now=5)
+            assert list(evb.verdict) == [0], backend
+            assert list(evb.msg_type) == [MSG_DROP], backend
+
+    def test_patch_mode_takes_effect_immediately(self):
+        d, eps, web = _world("tpu")
+        ep = eps["default"]
+        pkt = make_batch([dict(src="10.9.9.9", dst=ep.ips[0],
+                               sport=45000, dport=5432, proto=6,
+                               flags=TCP_SYN, ep=ep.id, dir=0)])
+        assert list(d.process_batch(pkt.data, now=10).verdict) == [0]
+        assert d.endpoints.update_config(ep.id, enforcement="never")
+        assert list(d.process_batch(pkt.data, now=11).verdict) == [1]
+        # rendered in the endpoint API view
+        assert d.endpoints.get(ep.id).to_dict()[
+            "policy-enforcement"] == "never"
+
+    def test_invalid_mode_and_option_rejected(self):
+        d = _daemon("interpreter")
+        ep = d.add_endpoint("x", ("10.0.4.1",), ["k8s:app=x"])
+        with pytest.raises(ValueError, match="enforcement"):
+            d.endpoints.update_config(ep.id, enforcement="sometimes")
+        with pytest.raises(ValueError, match="unknown endpoint options"):
+            d.endpoints.update_config(ep.id, options={"Bogus": True})
+        # r04 review: an invalid mode combined with valid options must
+        # not half-apply the options behind the 400
+        with pytest.raises(ValueError, match="enforcement"):
+            d.endpoints.update_config(
+                ep.id, enforcement="sometimes",
+                options={"DropNotification": False})
+        assert d.endpoints.get(ep.id).options["DropNotification"] is True
+
+    def test_enforcement_survives_checkpoint_restore(self, tmp_path):
+        """r04 review: restore() must round-trip per-endpoint
+        enforcement + options — resetting 'always' to 'default' on
+        restart silently changes verdicts."""
+        state_dir = str(tmp_path / "state")
+        d = _daemon("tpu", state_dir=state_dir)
+        ep = d.add_endpoint("lonely", ("10.0.3.1",), ["k8s:app=lonely"])
+        assert d.endpoints.update_config(
+            ep.id, enforcement="always",
+            options={"DropNotification": False})
+        d.checkpoint(state_dir)
+
+        d2 = _daemon("tpu", state_dir=state_dir)
+        assert d2.restore(state_dir)
+        got = d2.endpoints.get(ep.id)
+        assert got.enforcement == "always"
+        assert got.options["DropNotification"] is False
+        pkt = make_batch([dict(src="10.9.9.9", dst="10.0.3.1",
+                               sport=40000, dport=443, proto=6,
+                               flags=TCP_SYN, ep=ep.id, dir=0)])
+        assert list(d2.process_batch(pkt.data, now=5).verdict) == [0]
+
+
+class TestEventOptions:
+    def _flow(self, d, ep, flags=TCP_SYN, dport=22, sport=40000):
+        return make_batch([dict(src="10.9.9.9", dst=ep.ips[0],
+                                sport=sport, dport=dport, proto=6,
+                                flags=flags, ep=ep.id, dir=0)])
+
+    def test_drop_notification_off_suppresses_monitor_drops(self):
+        d = _daemon("tpu")
+        ep = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES)
+        seen = []
+        d.monitor.register("t", lambda b: seen.extend(b.msg_type))
+        assert d.endpoints.update_config(
+            ep.id, options={"DropNotification": False})
+        evb = d.process_batch(self._flow(d, ep).data, now=5)
+        # the datapath still DROPS (verdict + metrics) ...
+        assert list(evb.verdict) == [0]
+        # ... but the monitor plane saw nothing
+        assert MSG_DROP not in seen
+
+    def test_trace_notification_off_suppresses_traces_only(self):
+        d = _daemon("tpu")
+        ep = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES)
+        d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+        seen = []
+        d.monitor.register("t", lambda b: seen.extend(b.msg_type))
+        assert d.endpoints.update_config(
+            ep.id, options={"TraceNotification": False})
+        syn = make_batch([dict(src="10.0.1.1", dst="10.0.2.1",
+                               sport=40000, dport=5432, proto=6,
+                               flags=TCP_SYN, ep=ep.id, dir=0)])
+        d.process_batch(syn.data, now=5)
+        ack = make_batch([dict(src="10.0.1.1", dst="10.0.2.1",
+                               sport=40000, dport=5432, proto=6,
+                               flags=TCP_ACK, ep=ep.id, dir=0)])
+        d.process_batch(ack.data, now=6)
+        assert MSG_TRACE not in seen
+        # verdict events still flow
+        assert MSG_POLICY_VERDICT in seen or MSG_DROP in seen
+
+    def test_aggregation_medium_with_debug_override(self):
+        """monitor-aggregation=medium drops mid-flow ACK traces;
+        Debug=True exempts an endpoint."""
+        d = _daemon("tpu", monitor_aggregation="medium")
+        web = d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES)
+        seen = []
+        d.monitor.register("t", lambda b: seen.append(
+            (list(b.msg_type), list(b.hdr[:, 14]))))
+        mk = lambda ep, flags: make_batch([dict(
+            src="10.0.1.1", dst="10.0.2.1", sport=40000, dport=5432,
+            proto=6, flags=flags, ep=ep.id, dir=0)])
+        d.process_batch(mk(db, TCP_SYN).data, now=5)   # verdict event
+        d.process_batch(mk(db, TCP_ACK).data, now=6)   # boring trace
+        flat = [m for ms, _ in seen for m in ms]
+        assert MSG_TRACE not in flat  # aggregated away
+        # Debug exempts: same flow keeps tracing
+        assert d.endpoints.update_config(db.id, options={"Debug": True})
+        d.process_batch(mk(db, TCP_ACK).data, now=7)
+        flat = [m for ms, _ in seen for m in ms]
+        assert MSG_TRACE in flat
+        # FIN traces always pass aggregation
+        assert d.endpoints.update_config(db.id, options={"Debug": False})
+        d.process_batch(mk(db, TCP_ACK | TCP_FIN).data, now=8)
+        assert MSG_TRACE in [m for ms, _ in seen[-1:] for m in ms]
+
+    def test_rest_patch_endpoint_config(self, tmp_path):
+        """PATCH /endpoint/{id}/config over the unix-socket REST API."""
+        from cilium_tpu.api import APIClient, APIServer
+
+        d = _daemon("tpu")
+        ep = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        sock = str(tmp_path / "api.sock")
+        server = APIServer(d, sock)
+        server.start()
+        try:
+            c = APIClient(sock)
+            got = c._request("PATCH", f"/endpoint/{ep.id}/config",
+                             {"policy-enforcement": "always",
+                              "options": {"Debug": True}})
+            assert got["updated"] is True
+            view = d.endpoints.get(ep.id).to_dict()
+            assert view["policy-enforcement"] == "always"
+            assert view["options"]["Debug"] is True
+            pkt = make_batch([dict(src="10.9.9.9", dst="10.0.2.1",
+                                   sport=40000, dport=443, proto=6,
+                                   flags=TCP_SYN, ep=ep.id, dir=0)])
+            assert list(d.process_batch(pkt.data, now=5).verdict) == [0]
+        finally:
+            server.stop()
+
+    def test_patch_config_monitor_aggregation(self):
+        d = _daemon("tpu")
+        assert d.patch_config({"monitor-aggregation": "medium"}) == {
+            "monitor-aggregation": "medium"}
+        with pytest.raises(ValueError):
+            d.patch_config({"monitor-aggregation": "verbose"})
