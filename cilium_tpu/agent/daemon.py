@@ -360,10 +360,17 @@ class Daemon:
                     self.services.tensors(),
                     jnp.asarray(np.ascontiguousarray(hdr_dev)))
             if self.nat is not None:
-                # conntrack-aware: inbound-connection replies keep
-                # their source (verdict.apply_masquerade)
+                # conntrack-aware egress SNAT with port allocation
+                # (service.nat.snat_egress): inbound-connection
+                # replies keep their source
                 hdr_dev = self.loader.masquerade(self.nat, hdr_dev, now)
             out, row_map = self.loader.step(hdr_dev, now)
+            if self.nat is not None:
+                # reverse translation AFTER the verdict (CT/policy see
+                # the wire tuple; delivery + events see the restored
+                # pod destination)
+                hdr_dev = self.loader.reverse_nat(self.nat, hdr_dev,
+                                                  now)
             hdr = np.asarray(hdr_dev)
             batch = decode_out(out, hdr, row_map.numeric_array(),
                                timestamp=time.time())
@@ -573,6 +580,11 @@ class Daemon:
             **({"cluster-health": self.health.to_dict()}
                if self.health is not None else {}),
             **({"clustermesh": mesh} if mesh else {}),
+            **({"nat": nat_st} if (nat_st := (
+                self.loader.nat_status(self._now())
+                if self.nat is not None
+                and hasattr(self.loader, "nat_status") else None))
+               else {}),
         }
 
     def _eps_by_state(self) -> Dict[str, int]:
@@ -614,10 +626,16 @@ class Daemon:
         # skipped.
         ct = self.loader.ct_snapshot()
         ct_tmp = os.path.join(state_dir, "ct.npz.tmp")
+        extra = {}
+        nat = getattr(self.loader, "nat_snapshot", lambda: None)()
+        if nat is not None:
+            # NAT entries pair with the CT snapshot (both carry the
+            # post-NAT tuples); riding the same file keeps them atomic
+            extra["nat"] = nat
         with open(ct_tmp, "wb") as f:
             np.savez_compressed(
                 f, table=ct,
-                revision=np.int64(self.repo.revision))
+                revision=np.int64(self.repo.revision), **extra)
         os.replace(ct_tmp, os.path.join(state_dir, "ct.npz"))
         tmp = os.path.join(state_dir, "state.json.tmp")
         with open(tmp, "w") as f:
@@ -677,6 +695,11 @@ class Daemon:
                         "state", snap_rev, meta["revision"])
                 else:
                     self.loader.ct_restore(snap["table"])
+                    if "nat" in snap.files and hasattr(
+                            self.loader, "nat_restore"):
+                        # replies to allocated node ports must keep
+                        # reverse-translating across restarts
+                        self.loader.nat_restore(snap["nat"])
             except Exception as e:  # corrupt snapshot: identities/
                 # rules/endpoints above are already restored; losing
                 # live connections is the lesser failure

@@ -102,6 +102,7 @@ class TPULoader(Loader):
         self._jnp = jnp
         self.ct_capacity = ct_capacity
         self.state: Optional[DatapathState] = None
+        self.nat_state = None  # NATTable, created on first masquerade
         self.row_map: Optional[IdentityRowMap] = None
         self.attach_count = 0
         # attach() runs on API/regeneration threads while the serve
@@ -163,9 +164,11 @@ class TPULoader(Loader):
         return np.asarray(out), row_map
 
     def masquerade(self, nat, hdr, now: int):
-        """CT-aware egress SNAT stage (see verdict.apply_masquerade);
-        returns the rewritten device hdr."""
-        from .verdict import apply_masquerade_jit
+        """CT-aware egress SNAT with port allocation (service/nat.py
+        snat_egress); returns the rewritten device hdr.  The NAT
+        table lives with the loader like the CT table does (the
+        pkg/maps/nat analogue)."""
+        from ..service.nat import NATTable, snat_egress_jit
 
         jnp = self._jnp
         if isinstance(hdr, np.ndarray):
@@ -175,8 +178,28 @@ class TPULoader(Loader):
         # concurrent step that would invalidate the buffer between
         # capture and dispatch
         with self._lock:
-            return apply_masquerade_jit(self.state.ct, nat, hdr,
-                                        jnp.uint32(now))
+            if self.nat_state is None:
+                self.nat_state = NATTable.create()
+            hdr, self.nat_state = snat_egress_jit(
+                self.nat_state, nat, self.state.ct, hdr,
+                jnp.uint32(now))
+            return hdr
+
+    def reverse_nat(self, nat, hdr, now: int):
+        """Ingress reverse translation (post-verdict delivery rewrite:
+        replies to allocated node ports restore the original pod
+        destination)."""
+        from ..service.nat import NATTable, snat_reverse_jit
+
+        jnp = self._jnp
+        if isinstance(hdr, np.ndarray):
+            hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        with self._lock:
+            if self.nat_state is None:
+                self.nat_state = NATTable.create()
+            hdr, self.nat_state = snat_reverse_jit(
+                self.nat_state, nat, hdr, jnp.uint32(now))
+            return hdr
 
     # -- incremental patching (no recompile, no full upload) ----------
     def patch_identity(self, kind: str, numeric_id: int,
@@ -316,6 +339,33 @@ class TPULoader(Loader):
                 ct=self.state.ct, metrics=self.state.metrics)
         return True
 
+    def nat_snapshot(self) -> Optional[np.ndarray]:
+        with self._lock:
+            if self.nat_state is None:
+                return None
+            return np.asarray(self.nat_state.table)
+
+    def nat_restore(self, table: np.ndarray) -> None:
+        from ..service.nat import NATTable
+
+        table = np.ascontiguousarray(table, dtype=np.uint32)
+        with self._lock:
+            self.nat_state = NATTable(table=self._jnp.asarray(table),
+                                      failed=self._jnp.uint32(0))
+
+    def nat_status(self, now: int) -> Optional[dict]:
+        from ..service.nat import NAT_PORT_MIN, nat_live_count
+
+        with self._lock:
+            if self.nat_state is None:
+                return None
+            return {
+                "capacity": self.nat_state.capacity,
+                "port-min": NAT_PORT_MIN,
+                "live": nat_live_count(self.nat_state, now),
+                "alloc-failed": int(np.asarray(self.nat_state.failed)),
+            }
+
     def gc(self, now: int) -> int:
         from .conntrack import ct_gc_jit
 
@@ -358,14 +408,42 @@ class TPULoader(Loader):
                 metrics=self.state.metrics)
 
 
+def _nat_hash_py(key) -> int:
+    """Host FNV-1a identical to service.nat._nat_hash (backend parity)."""
+    h = 0x811C9DC5
+    for w in key:
+        h = ((h ^ (w & 0xFFFFFFFF)) * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
 class InterpreterLoader(Loader):
     """Oracle-backed datapath — no accelerator needed (fake datapath)."""
 
     def __init__(self, ct_capacity: int = 0):
         self.oracle = None
+        self.nat_state = None  # numpy NAT table (port-pool mirror)
+        self.nat_failed = 0
         self.row_map: Optional[IdentityRowMap] = None
         self._metrics = np.zeros((8, 2), dtype=np.uint64)
         self.attach_count = 0
+
+    def nat_snapshot(self) -> Optional[np.ndarray]:
+        return None if self.nat_state is None else self.nat_state.copy()
+
+    def nat_restore(self, table: np.ndarray) -> None:
+        self.nat_state = np.ascontiguousarray(table, dtype=np.uint32)
+
+    def nat_status(self, now: int) -> Optional[dict]:
+        from ..service.nat import NAT_PORT_MIN, NV_EXPIRES
+
+        if self.nat_state is None:
+            return None
+        return {
+            "capacity": self.nat_state.shape[0],
+            "port-min": NAT_PORT_MIN,
+            "live": int((self.nat_state[:, NV_EXPIRES] >= now).sum()),
+            "alloc-failed": self.nat_failed,
+        }
 
     def attach(self, policies, ipcache, ep_policy, row_map) -> None:
         from ..testing.oracle import OracleDatapath
@@ -411,21 +489,45 @@ class InterpreterLoader(Loader):
             self.row_map.add(numeric_id)
         return True
 
-    def masquerade(self, nat, hdr, now: int) -> np.ndarray:
-        """Python mirror of verdict.apply_masquerade over the oracle's
-        CT dict (keeps backend parity for masqueraded daemons)."""
-        import ipaddress
+    def _nat_table(self):
+        from ..service.nat import NAT_DEFAULT_CAPACITY, NAT_ROW_WORDS
 
-        from ..core.packets import (COL_DIR, COL_DST_IP3, COL_FAMILY,
+        if self.nat_state is None:
+            self.nat_state = np.zeros(
+                (NAT_DEFAULT_CAPACITY, NAT_ROW_WORDS), dtype=np.uint32)
+        return self.nat_state
+
+    def masquerade(self, nat, hdr, now: int) -> np.ndarray:
+        """Mirror of service.nat.snat_egress over a numpy NAT table +
+        the oracle's CT dict.  Same FNV hash, same window, and the
+        SAME two-phase order as the device kernel — full-window match
+        scan first, then a step-outer/row-inner claim loop (the
+        device awards contended slots to the lowest batch row, which
+        is exactly what the inner row loop does here) — so allocated
+        ports are bit-equal across backends."""
+        from ..core.packets import (COL_DIR, COL_DPORT, COL_DST_IP3,
+                                    COL_FAMILY, COL_PROTO, COL_SPORT,
                                     COL_SRC_IP3)
+        from ..service.nat import (NAT_LIFETIME, NAT_PORT_MIN,
+                                   NAT_PROBE, NV_DP, NV_DST,
+                                   NV_EXPIRES, NV_SPORT, NV_SRC)
         from ..testing.oracle import OracleDatapath
 
         hdr = np.array(hdr, dtype=np.uint32)
-        if not nat.enabled:  # parity with apply_masquerade
+        if not nat.enabled:
             return hdr
+        table = self._nat_table()
+        P = table.shape[0]
         nets = [(int(n), int(m)) for n, m in
                 zip(np.asarray(nat.net), np.asarray(nat.mask))]
         node_ip = int(np.asarray(nat.node_ip))
+
+        def r_key(s):
+            r = table[s]
+            return (int(r[NV_SRC]), int(r[NV_SPORT]), int(r[NV_DST]),
+                    int(r[NV_DP]))
+
+        claimants = []  # (hdr_row_index, key, h)
         for i in range(len(hdr)):
             row = hdr[i]
             if row[COL_DIR] != 1 or row[COL_FAMILY] != 4:
@@ -437,7 +539,77 @@ class InterpreterLoader(Loader):
             e = self.oracle.ct.get(rev)
             if e is not None and e.expires >= now:
                 continue  # reply of an inbound connection
+            src, sport = int(row[COL_SRC_IP3]), int(row[COL_SPORT])
+            proto = int(row[COL_PROTO])
             row[COL_SRC_IP3] = node_ip
+            if proto not in (6, 17, 132):
+                continue  # portless: port-preserving rewrite only
+            dp = (int(row[COL_DPORT]) << 8) | proto
+            key = (src, sport, dst, dp)
+            h = _nat_hash_py(key)
+            # phase 1: full-window scan for a live same-tuple mapping
+            hit = None
+            for step in range(NAT_PROBE):
+                s = (h + step) % P
+                if (int(table[s][NV_EXPIRES]) >= now
+                        and r_key(s) == key):
+                    hit = s
+                    break
+            if hit is not None:
+                table[hit] = (*key, now + NAT_LIFETIME, 0)
+                row[COL_SPORT] = NAT_PORT_MIN + hit
+            else:
+                claimants.append((i, key, h))
+        # phase 2: lockstep claim rounds (device parity)
+        for step in range(NAT_PROBE):
+            if not claimants:
+                break
+            still = []
+            for i, key, h in claimants:
+                s = (h + step) % P
+                if (int(table[s][NV_EXPIRES]) < now
+                        or r_key(s) == key):
+                    table[s] = (*key, now + NAT_LIFETIME, 0)
+                    hdr[i][COL_SPORT] = NAT_PORT_MIN + s
+                else:
+                    still.append((i, key, h))
+            claimants = still
+        # leftover claimants: pool exhaustion — port-preserving
+        # fallback (parity with snat_egress's `failed` path)
+        self.nat_failed += len(claimants)
+        return hdr
+
+    def reverse_nat(self, nat, hdr, now: int) -> np.ndarray:
+        """Sequential mirror of service.nat.snat_reverse."""
+        from ..core.packets import (COL_DIR, COL_DPORT, COL_DST_IP3,
+                                    COL_FAMILY, COL_PROTO, COL_SPORT,
+                                    COL_SRC_IP3)
+        from ..service.nat import (NAT_LIFETIME, NAT_PORT_MIN, NV_DP,
+                                   NV_DST, NV_EXPIRES, NV_SPORT,
+                                   NV_SRC)
+
+        hdr = np.array(hdr, dtype=np.uint32)
+        if not nat.enabled:
+            return hdr
+        table = self._nat_table()
+        P = table.shape[0]
+        node_ip = int(np.asarray(nat.node_ip))
+        for i in range(len(hdr)):
+            row = hdr[i]
+            dport = int(row[COL_DPORT])
+            if (row[COL_DIR] != 0 or row[COL_FAMILY] != 4
+                    or int(row[COL_DST_IP3]) != node_ip
+                    or not NAT_PORT_MIN <= dport < NAT_PORT_MIN + P):
+                continue
+            s = dport - NAT_PORT_MIN
+            r = table[s]
+            rdp = (int(row[COL_SPORT]) << 8) | int(row[COL_PROTO])
+            if (int(r[NV_EXPIRES]) >= now
+                    and int(r[NV_DST]) == int(row[COL_SRC_IP3])
+                    and int(r[NV_DP]) == rdp):
+                row[COL_DST_IP3] = r[NV_SRC]
+                row[COL_DPORT] = r[NV_SPORT]
+                table[s][NV_EXPIRES] = now + NAT_LIFETIME
         return hdr
 
     def patch_ipcache(self, cidr: str, numeric_id: int) -> bool:

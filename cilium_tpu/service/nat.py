@@ -1,36 +1,47 @@
-"""NAT: egress masquerade (SNAT) schema + device stage.
+"""NAT: egress masquerade (SNAT) with per-node port allocation.
 
 Reference: upstream ``bpf/lib/nat.h`` + ``pkg/maps/nat`` — egress
-traffic leaving the cluster is source-NATed to the node IP, with a
-NAT map remembering the translation for reverse application on
-replies.  SURVEY.md §2b keeps NAT at schema-level scope for this
-rebuild; what is implemented:
+traffic leaving the cluster is source-NATed to the node IP with a
+port allocated from a per-node pool; the NAT map remembers the
+translation both ways so replies reverse-translate on ingress.
 
-- :class:`NATConfig` — masquerade prefixes (destinations that should
-  NOT be masqueraded, i.e. cluster-internal ranges) + the node IP.
-- :func:`snat_stage` — batched egress rewrite: src -> node IP for
-  packets leaving the cluster ranges.  PORT-PRESERVING (documented
-  divergence: the reference allocates a free port per flow from the
-  NAT map; here source ports pass through, which is collision-free
-  per node as long as local endpoints don't share sports to one
-  destination — the common CNI case).
-- reverse translation rides conntrack: the CT entry is created with
-  the POST-NAT tuple, so replies match it and the deployment's
-  ingress adapter restores the original destination from the CT
-  reverse lookup.
+TPU-first redesign of the NAT map: **the port pool IS the table
+index**.  One ``[P, 6]`` tensor, where slot ``s`` owns node port
+``NAT_PORT_MIN + s``:
+
+- egress allocation = CT-style write-then-verify hash claim over the
+  slot window (each claimed slot is a unique node port — collision-
+  free by construction, closing DIVERGENCES #17);
+- reverse translation on ingress = ONE gather (``dport - PORT_MIN``
+  indexes the table directly; no reverse map, no second hash table —
+  the reference needs a whole second BPF map for this direction).
+
+Port allocation covers port-bearing protocols (TCP/UDP/SCTP); ICMP
+keeps the port-preserving rewrite (its "port" is the type/id).  The
+CT entry is created with the POST-NAT tuple, so replies hit CT as
+REPLY on the wire tuple; the reverse stage then restores the original
+destination for delivery.
 """
 
 from __future__ import annotations
 
 import ipaddress
 from dataclasses import dataclass, field
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.packets import COL_DIR, COL_FAMILY, COL_SRC_IP3
+from ..core.packets import (
+    COL_DIR,
+    COL_DPORT,
+    COL_DST_IP3,
+    COL_FAMILY,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP3,
+)
 
 
 @dataclass
@@ -80,6 +91,218 @@ class NATTensors:
     @classmethod
     def tree_unflatten(cls, enabled, children):
         return cls(*children, enabled=enabled)
+
+
+# --- the NAT table (per-node port pool) ------------------------------
+
+NAT_PORT_MIN = 32768  # pool = [NAT_PORT_MIN, NAT_PORT_MIN + capacity)
+NAT_LIFETIME = 300  # seconds; refreshed on every use in either direction
+NAT_PROBE = 8  # claim window (linear probes from the tuple hash)
+NAT_DEFAULT_CAPACITY = 1 << 14  # shared by NATTable.create + mirrors
+
+NAT_ROW_WORDS = 6
+NV_SRC = 0  # original source IP
+NV_SPORT = 1  # original source port
+NV_DST = 2  # destination IP
+NV_DP = 3  # dport << 8 | proto
+NV_EXPIRES = 4
+NV_PAD = 5
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class NATTable:
+    """Slot ``s`` <=> node port ``NAT_PORT_MIN + s``."""
+
+    table: jnp.ndarray  # [P, NAT_ROW_WORDS] uint32
+    failed: jnp.ndarray  # [] uint32 — pool-pressure allocation failures
+
+    @staticmethod
+    def create(capacity: int = NAT_DEFAULT_CAPACITY) -> "NATTable":
+        if capacity & (capacity - 1):
+            raise ValueError("NAT capacity must be a power of two")
+        if NAT_PORT_MIN + capacity > 65536:
+            raise ValueError("NAT pool exceeds the port space")
+        return NATTable(
+            table=jnp.zeros((capacity, NAT_ROW_WORDS), dtype=jnp.uint32),
+            failed=jnp.uint32(0))
+
+    @property
+    def capacity(self) -> int:
+        return self.table.shape[0]
+
+    def tree_flatten(self):
+        return ((self.table, self.failed), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+
+def _nat_hash(words: jnp.ndarray) -> jnp.ndarray:
+    """FNV-1a over [N, 4] uint32 key words -> [N] uint32."""
+    h = jnp.full(words.shape[0], 0x811C9DC5, dtype=jnp.uint32)
+    for w in range(4):
+        h = (h ^ words[:, w]) * jnp.uint32(0x01000193)
+    return h
+
+
+def snat_egress(tbl: NATTable, t: NATTensors, ct, hdr: jnp.ndarray,
+                now: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, NATTable]:
+    """Egress masquerade with port allocation.
+
+    Port-bearing egress-to-world rows claim a slot (= unique node
+    port) via the CT-style write-then-verify loop; existing mappings
+    refresh in place (``claimable`` includes the row's own tuple).
+    Rows whose reverse CT entry exists reply to an INBOUND connection
+    and keep their source.  Pool exhaustion falls back to the
+    port-preserving rewrite and counts in ``failed`` (the reference
+    drops; here the verdict stage owns dropping, so the counter is
+    the pressure signal)."""
+    from ..datapath.conntrack import _probe, ct_keys_from_headers
+
+    hdr = hdr.astype(jnp.uint32)
+    if not t.enabled:
+        return hdr, tbl
+    P = tbl.capacity
+    mask = P - 1
+    src = hdr[:, COL_SRC_IP3]
+    dst = hdr[:, COL_DST_IP3]
+    sport = hdr[:, COL_SPORT]
+    dport = hdr[:, COL_DPORT]
+    proto = hdr[:, COL_PROTO]
+    internal = jnp.any(
+        (dst[:, None] & t.mask[None, :]) == t.net[None, :], axis=1)
+    egress = hdr[:, COL_DIR] == 1
+    v4 = hdr[:, COL_FAMILY] == 4
+    _fwd, rev = ct_keys_from_headers(hdr)
+    r_found, _slot = _probe(ct.table, rev, now)
+    masq = egress & v4 & ~internal & ~r_found
+    portful = (proto == 6) | (proto == 17) | (proto == 132)
+    need = masq & portful
+
+    dp = (dport << 8) | proto
+    key = jnp.stack([src, sport, dst, dp], axis=1)
+    h = _nat_hash(key)
+    expires = jnp.broadcast_to(now + jnp.uint32(NAT_LIFETIME),
+                               src.shape).astype(jnp.uint32)
+    new_row = jnp.stack([
+        src, sport, dst, dp, expires,
+        jnp.zeros_like(src),
+    ], axis=1)
+    n = src.shape[0]
+    ridx = jnp.arange(n, dtype=jnp.int32)
+
+    def key_match_w(rows):  # window gather [N, K, W]
+        return ((rows[..., NV_SRC] == src[:, None])
+                & (rows[..., NV_SPORT] == sport[:, None])
+                & (rows[..., NV_DST] == dst[:, None])
+                & (rows[..., NV_DP] == dp[:, None]))
+
+    def key_match(rows):  # one row per packet [N, W]
+        return ((rows[:, NV_SRC] == src)
+                & (rows[:, NV_SPORT] == sport)
+                & (rows[:, NV_DST] == dst)
+                & (rows[:, NV_DP] == dp))
+
+    table = tbl.table
+    # phase 1: scan the WHOLE window for a live same-tuple mapping —
+    # an existing allocation must win over any expired earlier slot,
+    # or a live flow's node port would change mid-stream (r04 review)
+    win = ((h[:, None] + jnp.arange(NAT_PROBE, dtype=jnp.uint32))
+           & mask).astype(jnp.int32)  # [N, K]
+    wrows = table[win]  # [N, K, W]
+    live_same = (wrows[..., NV_EXPIRES] >= now) & key_match_w(wrows)
+    have_match = jnp.any(live_same, axis=1)
+    mcol = jnp.argmax(live_same, axis=1)
+    mslot = jnp.take_along_axis(win, mcol[:, None], axis=1)[:, 0]
+    # refresh matched mappings (duplicate rows of one flow write the
+    # same content, so scatter order is immaterial here)
+    refresh = jnp.where(need & have_match, mslot, P)
+    table = table.at[refresh].set(new_row, mode="drop")
+
+    # phase 2: claim loop.  Per step, contended slots are awarded to
+    # the LOWEST batch row (scatter-min owner) so the result is
+    # deterministic and equal to the interpreter mirror's
+    # step-outer/row-inner order; same-tuple losers adopt the
+    # winner's slot via the readback check.
+    pending = need & ~have_match
+    final_slot = jnp.where(have_match, mslot,
+                           jnp.zeros_like(mslot))
+    for step in range(NAT_PROBE):
+        s = ((h + step) & mask).astype(jnp.int32)
+        stored = table[s]
+        same = key_match(stored)
+        claimable = (stored[:, NV_EXPIRES] < now) | same
+        trying = pending & claimable
+        rows = jnp.where(trying, s, P)
+        owner = jnp.full((P + 1,), n, dtype=jnp.int32
+                         ).at[rows].min(ridx, mode="drop")
+        writer = trying & (owner[s] == ridx)
+        wslots = jnp.where(writer, s, P)
+        table = table.at[wslots].set(new_row, mode="drop")
+        back = table[s]
+        won = trying & key_match(back)
+        final_slot = jnp.where(won, s, final_slot)
+        pending = pending & ~won
+
+    allocated = need & ~pending
+    new_port = (jnp.uint32(NAT_PORT_MIN)
+                + final_slot.astype(jnp.uint32))
+    hdr = hdr.at[:, COL_SRC_IP3].set(
+        jnp.where(masq, t.node_ip, src))
+    hdr = hdr.at[:, COL_SPORT].set(
+        jnp.where(allocated, new_port, sport))
+    failed = tbl.failed + jnp.sum(need & pending).astype(jnp.uint32)
+    return hdr, NATTable(table=table, failed=failed)
+
+
+def snat_reverse(tbl: NATTable, t: NATTensors, hdr: jnp.ndarray,
+                 now: jnp.ndarray) -> Tuple[jnp.ndarray, NATTable]:
+    """Ingress reverse translation: ONE gather.
+
+    A reply to ``node_ip:(NAT_PORT_MIN + s)`` whose source matches
+    slot s's recorded destination restores the original
+    (pod IP, pod port); everything else passes through untouched."""
+    hdr = hdr.astype(jnp.uint32)
+    if not t.enabled:
+        return hdr, tbl
+    P = tbl.capacity
+    src = hdr[:, COL_SRC_IP3]
+    dst = hdr[:, COL_DST_IP3]
+    sport = hdr[:, COL_SPORT]
+    dport = hdr[:, COL_DPORT]
+    proto = hdr[:, COL_PROTO]
+    ingress = hdr[:, COL_DIR] == 0
+    v4 = hdr[:, COL_FAMILY] == 4
+    in_pool = (dport >= NAT_PORT_MIN) & (dport < NAT_PORT_MIN + P)
+    cand = jnp.where(in_pool, dport - NAT_PORT_MIN, 0).astype(jnp.int32)
+    row = tbl.table[cand]
+    # the reply's (src, sport) must be the mapping's (dst, dport)
+    rdp = (sport << 8) | proto
+    hit = (ingress & v4 & in_pool & (dst == t.node_ip)
+           & (row[:, NV_EXPIRES] >= now)
+           & (row[:, NV_DST] == src) & (row[:, NV_DP] == rdp))
+    hdr = hdr.at[:, COL_DST_IP3].set(
+        jnp.where(hit, row[:, NV_SRC], dst))
+    hdr = hdr.at[:, COL_DPORT].set(
+        jnp.where(hit, row[:, NV_SPORT], dport))
+    # refresh on use (replies keep the mapping alive, like the
+    # reference's NAT entry aging)
+    refresh_rows = jnp.where(hit, cand, P)
+    table = tbl.table.at[refresh_rows, NV_EXPIRES].set(
+        now + jnp.uint32(NAT_LIFETIME), mode="drop")
+    return hdr, NATTable(table=table, failed=tbl.failed)
+
+
+snat_egress_jit = jax.jit(snat_egress, donate_argnums=0)
+snat_reverse_jit = jax.jit(snat_reverse, donate_argnums=0)
+
+
+def nat_live_count(tbl: NATTable, now: int) -> int:
+    return int(np.asarray(
+        jnp.sum(tbl.table[:, NV_EXPIRES] >= jnp.uint32(now))))
 
 
 def snat_stage(t: NATTensors, hdr: jnp.ndarray
