@@ -463,6 +463,18 @@ class Daemon:
                if self.loader.row_map else 0)
         return self.proxy.handle_kafka(proxy_port, requests, row)
 
+    # -- k8s integration ----------------------------------------------
+    _k8s_hub = None
+
+    def k8s_watchers(self):
+        """The k8s watcher aggregate (pkg/k8s/watchers analogue);
+        drive it from an informer stream or fixture replay."""
+        if self._k8s_hub is None:
+            from ..k8s.watchers import K8sWatcherHub
+
+            self._k8s_hub = K8sWatcherHub(self)
+        return self._k8s_hub
+
     # -- clustermesh API ----------------------------------------------
     def connect_cluster(self, name: str, cluster_id: int, kv):
         """Join a remote cluster's store (reference: clustermesh

@@ -408,14 +408,6 @@ class TPULoader(Loader):
                 metrics=self.state.metrics)
 
 
-def _nat_hash_py(key) -> int:
-    """Host FNV-1a identical to service.nat._nat_hash (backend parity)."""
-    h = 0x811C9DC5
-    for w in key:
-        h = ((h ^ (w & 0xFFFFFFFF)) * 0x01000193) & 0xFFFFFFFF
-    return h
-
-
 class InterpreterLoader(Loader):
     """Oracle-backed datapath — no accelerator needed (fake datapath)."""
 
@@ -508,9 +500,10 @@ class InterpreterLoader(Loader):
         from ..core.packets import (COL_DIR, COL_DPORT, COL_DST_IP3,
                                     COL_FAMILY, COL_PROTO, COL_SPORT,
                                     COL_SRC_IP3)
-        from ..service.nat import (NAT_LIFETIME, NAT_PORT_MIN,
-                                   NAT_PROBE, NV_DP, NV_DST,
-                                   NV_EXPIRES, NV_SPORT, NV_SRC)
+        from ..service.nat import (NAT_PORT_MIN, NAT_PROBE, NV_DP,
+                                   NV_DST, NV_EXPIRES, NV_SPORT,
+                                   NV_SRC, _nat_hash_py,
+                                   _nat_lifetime_py)
         from ..testing.oracle import OracleDatapath
 
         hdr = np.array(hdr, dtype=np.uint32)
@@ -556,23 +549,23 @@ class InterpreterLoader(Loader):
                     hit = s
                     break
             if hit is not None:
-                table[hit] = (*key, now + NAT_LIFETIME, 0)
+                table[hit] = (*key, now + _nat_lifetime_py(proto), 0)
                 row[COL_SPORT] = NAT_PORT_MIN + hit
             else:
-                claimants.append((i, key, h))
+                claimants.append((i, key, h, proto))
         # phase 2: lockstep claim rounds (device parity)
         for step in range(NAT_PROBE):
             if not claimants:
                 break
             still = []
-            for i, key, h in claimants:
+            for i, key, h, proto in claimants:
                 s = (h + step) % P
                 if (int(table[s][NV_EXPIRES]) < now
                         or r_key(s) == key):
-                    table[s] = (*key, now + NAT_LIFETIME, 0)
+                    table[s] = (*key, now + _nat_lifetime_py(proto), 0)
                     hdr[i][COL_SPORT] = NAT_PORT_MIN + s
                 else:
-                    still.append((i, key, h))
+                    still.append((i, key, h, proto))
             claimants = still
         # leftover claimants: pool exhaustion — port-preserving
         # fallback (parity with snat_egress's `failed` path)
@@ -584,9 +577,9 @@ class InterpreterLoader(Loader):
         from ..core.packets import (COL_DIR, COL_DPORT, COL_DST_IP3,
                                     COL_FAMILY, COL_PROTO, COL_SPORT,
                                     COL_SRC_IP3)
-        from ..service.nat import (NAT_LIFETIME, NAT_PORT_MIN, NV_DP,
-                                   NV_DST, NV_EXPIRES, NV_SPORT,
-                                   NV_SRC)
+        from ..service.nat import (NAT_PORT_MIN, NV_DP, NV_DST,
+                                   NV_EXPIRES, NV_SPORT, NV_SRC,
+                                   _nat_lifetime_py)
 
         hdr = np.array(hdr, dtype=np.uint32)
         if not nat.enabled:
@@ -609,7 +602,8 @@ class InterpreterLoader(Loader):
                     and int(r[NV_DP]) == rdp):
                 row[COL_DST_IP3] = r[NV_SRC]
                 row[COL_DPORT] = r[NV_SPORT]
-                table[s][NV_EXPIRES] = now + NAT_LIFETIME
+                table[s][NV_EXPIRES] = now + _nat_lifetime_py(
+                    int(row[COL_PROTO]))
         return hdr
 
     def patch_ipcache(self, cidr: str, numeric_id: int) -> bool:

@@ -1,0 +1,404 @@
+"""k8s watchers: Service/Endpoints, Pod, CiliumIdentity,
+CiliumEndpoint, CiliumNode event handlers.
+
+Reference: upstream cilium ``pkg/k8s/watchers`` — informer callbacks
+translating k8s objects into agent mutations:
+
+- ``service.go`` + ``endpoints.go``: Service + Endpoints objects
+  reconcile into the ServiceManager (frontend = clusterIP:port,
+  backends = ready endpoint addresses x matching port);
+- ``pod.go``: local pods become endpoints (labels -> identity, pod IP
+  -> ipcache host route, container ports -> named ports);
+- ``cilium_identity.go`` (CRD identity mode): CiliumIdentity objects
+  replay into the local allocator exactly like kvstore watch events;
+- ``cilium_endpoint.go``: REMOTE CiliumEndpoints feed ipcache (pod IP
+  -> identity) — the CRD-mode replacement for kvstore ipcache sync;
+- ``cilium_node.go``: node lifecycle into the node registry the
+  operator/health mesh read.
+
+Like :class:`~cilium_tpu.k8s.CNPWatcher`, each watcher is the
+translation half only: drive it from fake event streams in tests
+(SURVEY.md §4 fake-clientset pattern) or a real informer in
+deployment.  All handlers are idempotent — k8s informers re-deliver.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..labels import LabelSet
+from . import NS_LABEL
+
+_PROTO_NUM = {"TCP": 6, "UDP": 17, "SCTP": 132}
+
+
+def _meta_key(obj: dict) -> str:
+    meta = obj.get("metadata") or {}
+    return f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
+
+
+class ServiceWatcher:
+    """Service + Endpoints objects -> ServiceManager entries.
+
+    One LB service per (k8s service, port): registry name
+    ``<ns>/<name>:<portname-or-number>``.  Either object may arrive
+    first; reconciliation runs on every event with whatever halves
+    exist (reference: pkg/k8s/watchers service+endpoints caches)."""
+
+    def __init__(self, services):
+        self.services = services  # ServiceManager
+        self._svc: Dict[str, dict] = {}
+        self._eps: Dict[str, dict] = {}
+        self._installed: Dict[str, set] = {}  # key -> LB names
+
+    # -- Service objects ---------------------------------------------
+    def on_service_add(self, obj: dict) -> None:
+        key = _meta_key(obj)
+        self._svc[key] = obj
+        self._reconcile(key)
+
+    on_service_update = on_service_add
+
+    def on_service_delete(self, obj: dict) -> None:
+        key = _meta_key(obj)
+        self._svc.pop(key, None)
+        self._reconcile(key)
+
+    # -- Endpoints objects -------------------------------------------
+    def on_endpoints_add(self, obj: dict) -> None:
+        key = _meta_key(obj)
+        self._eps[key] = obj
+        self._reconcile(key)
+
+    on_endpoints_update = on_endpoints_add
+
+    def on_endpoints_delete(self, obj: dict) -> None:
+        key = _meta_key(obj)
+        self._eps.pop(key, None)
+        self._reconcile(key)
+
+    def _reconcile(self, key: str) -> None:
+        svc = self._svc.get(key)
+        eps = self._eps.get(key)
+        wanted: Dict[str, Tuple[str, List[str], int]] = {}
+        if svc is not None and eps is not None:
+            spec = svc.get("spec") or {}
+            cluster_ip = spec.get("clusterIP")
+            if cluster_ip and cluster_ip != "None":  # headless: skip
+                for p in spec.get("ports") or ():
+                    pname = p.get("name") or str(p.get("port"))
+                    proto = _PROTO_NUM.get(p.get("protocol", "TCP"), 6)
+                    backends = self._backends(eps, p)
+                    if backends:
+                        wanted[f"{key}:{pname}"] = (
+                            f"{cluster_ip}:{p.get('port')}", backends,
+                            proto)
+        have = self._installed.get(key, set())
+        for name in have - set(wanted):
+            self.services.delete(name)
+        for name, (frontend, backends, proto) in wanted.items():
+            self.services.upsert(name, frontend, backends,
+                                 protocol=proto)
+        self._installed[key] = set(wanted)
+
+    @staticmethod
+    def _backends(eps: dict, svc_port: dict) -> List[str]:
+        """Ready addresses x the subset port matching this service
+        port (by name, or the single unnamed port)."""
+        pname = svc_port.get("name")
+        out = []
+        for subset in eps.get("subsets") or ():
+            ports = subset.get("ports") or ()
+            target = None
+            for sp in ports:
+                if (pname and sp.get("name") == pname) or (
+                        not pname and len(ports) == 1):
+                    target = sp.get("port")
+                    break
+            if target is None:
+                continue
+            for addr in subset.get("addresses") or ():
+                ip = addr.get("ip")
+                if ip:
+                    out.append(f"{ip}:{target}")
+        return sorted(out)
+
+
+def pod_labels(obj: dict) -> List[str]:
+    """Pod metadata labels -> cilium identity labels (``k8s:`` source
+    + the namespace label, reference: k8s.GetPodMetadata)."""
+    meta = obj.get("metadata") or {}
+    ns = meta.get("namespace", "default")
+    out = [f"k8s:{k}={v}" for k, v in (meta.get("labels") or {}).items()]
+    out.append(f"k8s:{NS_LABEL}={ns}")
+    return sorted(out)
+
+
+class PodWatcher:
+    """Local pods -> endpoint lifecycle (reference: pod.go).
+
+    Only pods scheduled on THIS node become endpoints (remote pods
+    reach the ipcache via CiliumEndpoint objects).  A label change
+    re-registers the endpoint (identity change = new endpoint policy,
+    like upstream's UpdateLabels regeneration)."""
+
+    def __init__(self, daemon, node_name: Optional[str] = None):
+        self.daemon = daemon
+        self.node_name = node_name or daemon.config.node_name
+        self._eps: Dict[str, int] = {}  # ns/name -> endpoint id
+        self._labels: Dict[str, List[str]] = {}
+
+    def _pod_ips(self, obj: dict) -> Tuple[str, ...]:
+        st = obj.get("status") or {}
+        ips = [e.get("ip") for e in st.get("podIPs") or () if e.get("ip")]
+        if not ips and st.get("podIP"):
+            ips = [st["podIP"]]
+        return tuple(ips)
+
+    @staticmethod
+    def _named_ports(obj: dict) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for c in (obj.get("spec") or {}).get("containers") or ():
+            for p in c.get("ports") or ():
+                if p.get("name") and p.get("containerPort"):
+                    out[p["name"]] = int(p["containerPort"])
+        return out
+
+    def on_add(self, obj: dict) -> Optional[int]:
+        key = _meta_key(obj)
+        if (obj.get("spec") or {}).get("nodeName") != self.node_name:
+            return None
+        ips = self._pod_ips(obj)
+        if not ips:
+            return None  # not yet scheduled/IP'd; a later update fires
+        labels = pod_labels(obj)
+        if key in self._eps:
+            if labels == self._labels.get(key):
+                return self._eps[key]  # idempotent re-deliver
+            self.on_delete(obj)  # label change: re-register
+        ep = self.daemon.add_endpoint(
+            key, ips, labels, named_ports=self._named_ports(obj))
+        self._eps[key] = ep.id
+        self._labels[key] = labels
+        return ep.id
+
+    on_update = on_add
+
+    def on_delete(self, obj: dict) -> bool:
+        key = _meta_key(obj)
+        ep_id = self._eps.pop(key, None)
+        self._labels.pop(key, None)
+        if ep_id is None:
+            return False
+        return self.daemon.endpoints.remove(ep_id)
+
+
+class CiliumIdentityWatcher:
+    """CiliumIdentity CRD objects -> local allocator replay
+    (reference: CRD identity allocation mode).  Same semantics as the
+    kvstore id/ watch: creates register/rebind, deletes drop
+    unreferenced replicas."""
+
+    def __init__(self, allocator):
+        self.allocator = allocator
+
+    @staticmethod
+    def _parse(obj: dict) -> Tuple[int, LabelSet]:
+        num = int((obj.get("metadata") or {}).get("name"))
+        labels = obj.get("security-labels") or {}
+        return num, LabelSet.parse(
+            *[f"{k}={v}" if v else k for k, v in labels.items()])
+
+    def on_add(self, obj: dict):
+        num, labels = self._parse(obj)
+        return self.allocator.watch_update(num, labels)
+
+    on_update = on_add
+
+    def on_delete(self, obj: dict) -> bool:
+        num = int((obj.get("metadata") or {}).get("name"))
+        return self.allocator.watch_remove(num)
+
+
+def cep_from_endpoint(ep, node_ip: str = "") -> dict:
+    """Local endpoint -> CiliumEndpoint object (what the agent would
+    publish for remote nodes to consume; reference:
+    pkg/k8s/apis/cilium.io/v2 CiliumEndpoint)."""
+    ns = "default"
+    name = ep.name
+    if "/" in ep.name:
+        ns, name = ep.name.split("/", 1)
+    return {
+        "apiVersion": "cilium.io/v2",
+        "kind": "CiliumEndpoint",
+        "metadata": {"name": name, "namespace": ns},
+        "status": {
+            "id": ep.id,
+            "identity": {
+                "id": (ep.identity.numeric_id if ep.identity else 0),
+                "labels": sorted(str(l) for l in ep.labels),
+            },
+            "networking": {
+                "addressing": [{"ipv6" if ":" in ip else "ipv4": ip}
+                               for ip in ep.ips],
+                **({"node": node_ip} if node_ip else {}),
+            },
+            "state": ep.state.value,
+        },
+    }
+
+
+class CiliumEndpointWatcher:
+    """REMOTE CiliumEndpoint objects -> ipcache (pod IP -> identity)
+    — the CRD-mode ipcache propagation path (reference:
+    cilium_endpoint.go endpointUpdated -> ipcache.Upsert)."""
+
+    def __init__(self, daemon):
+        self.daemon = daemon
+        self._ips: Dict[str, Tuple[str, ...]] = {}
+
+    @staticmethod
+    def _addresses(obj: dict) -> Tuple[str, ...]:
+        net = ((obj.get("status") or {}).get("networking") or {})
+        out = []
+        for pair in net.get("addressing") or ():
+            for fam in ("ipv4", "ipv6"):
+                if pair.get(fam):
+                    out.append(pair[fam])
+        return tuple(out)
+
+    def _is_local(self, ips) -> bool:
+        """A real informer delivers ALL CiliumEndpoints, including the
+        ones this agent publishes for its own pods — those must be
+        skipped (upstream cilium_endpoint.go does the same) or a CEP
+        re-sync/delete would clobber the LOCAL endpoint's ipcache
+        entry and misclassify its traffic."""
+        return any(self.daemon.endpoints.lookup_by_ip(ip) is not None
+                   for ip in ips)
+
+    def on_add(self, obj: dict) -> int:
+        key = _meta_key(obj)
+        status = obj.get("status") or {}
+        ident = int((status.get("identity") or {}).get("id", 0))
+        ips = self._addresses(obj)
+        if self._is_local(ips):
+            return 0
+        # remove addresses that disappeared in an update
+        for ip in self._ips.get(key, ()):
+            if ip not in ips:
+                self._del_ip(ip)
+        n = 0
+        for ip in ips:
+            suffix = "/128" if ":" in ip else "/32"
+            self.daemon.upsert_ipcache(ip + suffix, ident)
+            n += 1
+        self._ips[key] = ips
+        return n
+
+    on_update = on_add
+
+    def on_delete(self, obj: dict) -> int:
+        key = _meta_key(obj)
+        ips = self._ips.pop(key, None) or self._addresses(obj)
+        if self._is_local(ips):
+            return 0
+        n = 0
+        for ip in ips:
+            self._del_ip(ip)
+            n += 1
+        return n
+
+    def _del_ip(self, ip: str) -> None:
+        suffix = "/128" if ":" in ip else "/32"
+        self.daemon.delete_ipcache(ip + suffix)
+
+
+class CiliumNodeWatcher:
+    """CiliumNode objects -> the kvstore node registry (what the
+    health mesh probes and the operator's dead-node sweep reads;
+    reference: cilium_node.go + pkg/node/manager)."""
+
+    def __init__(self, kv):
+        from ..health import NODES_PREFIX
+
+        self.kv = kv
+        self._prefix = NODES_PREFIX
+
+    def on_add(self, obj: dict) -> None:
+        meta = obj.get("metadata") or {}
+        name = meta.get("name", "")
+        spec = obj.get("spec") or {}
+        addrs = spec.get("addresses") or ()
+        ip = next((a.get("ip") for a in addrs
+                   if a.get("type") == "InternalIP"), None)
+        info = {"name": name,
+                **({"ip": ip} if ip else {}),
+                **({"pod-cidrs": spec["ipam"]["podCIDRs"]}
+                   if (spec.get("ipam") or {}).get("podCIDRs") else {})}
+        self.kv.update(f"{self._prefix}/{name}",
+                       json.dumps(info).encode())
+
+    on_update = on_add
+
+    def on_delete(self, obj: dict) -> None:
+        name = (obj.get("metadata") or {}).get("name", "")
+        self.kv.delete(f"{self._prefix}/{name}")
+
+
+class K8sWatcherHub:
+    """All watchers wired to one daemon — the pkg/k8s/watchers
+    K8sWatcher aggregate.  ``dispatch(kind, event, obj)`` routes a
+    fake (or real) informer stream."""
+
+    def __init__(self, daemon):
+        from . import CNPWatcher
+
+        self.cnp = CNPWatcher(daemon.repo)
+        self.services = ServiceWatcher(daemon.services)
+        self.pods = PodWatcher(daemon)
+        self.identities = CiliumIdentityWatcher(daemon.allocator)
+        self.ceps = CiliumEndpointWatcher(daemon)
+        self.nodes = CiliumNodeWatcher(daemon.kvstore)
+        self._routes = {
+            "CiliumNetworkPolicy": self.cnp,
+            "CiliumClusterwideNetworkPolicy": self.cnp,
+            "Service": _Renamed(self.services, "service"),
+            "Endpoints": _Renamed(self.services, "endpoints"),
+            "Pod": self.pods,
+            "CiliumIdentity": self.identities,
+            "CiliumEndpoint": self.ceps,
+            "CiliumNode": self.nodes,
+        }
+
+    def dispatch(self, event: str, obj: dict):
+        """``event`` in add|update|delete; ``obj`` any supported
+        kind."""
+        kind = obj.get("kind", "")
+        handler = self._routes.get(kind)
+        if handler is None:
+            raise ValueError(f"unhandled k8s kind {kind!r}")
+        return getattr(handler, f"on_{event}")(obj)
+
+    def replay(self, events) -> int:
+        """Apply a fixture stream of (event, obj) pairs."""
+        n = 0
+        for event, obj in events:
+            self.dispatch(event, obj)
+            n += 1
+        return n
+
+
+class _Renamed:
+    """Adapts ServiceWatcher's per-kind handler names to the generic
+    on_add/on_update/on_delete surface."""
+
+    def __init__(self, inner, prefix: str):
+        self._inner = inner
+        self._prefix = prefix
+
+    def __getattr__(self, name: str):
+        if name.startswith("on_"):
+            return getattr(self._inner,
+                           f"on_{self._prefix}_{name[3:]}")
+        raise AttributeError(name)

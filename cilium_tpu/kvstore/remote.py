@@ -412,7 +412,23 @@ class RemoteKVStore:
                 with self._lock:
                     self._pending.pop(i, None)
                 if attempt == 0 and self._reconnect and not self._closed:
-                    continue
+                    # the send hit the dead socket before the reader
+                    # noticed EOF: wait for the reader's re-dial to
+                    # install a FRESH socket before retrying (retrying
+                    # on the same object would just fail again).  If
+                    # no fresh socket appears within the dial budget,
+                    # fail now — falling through to attempt 1 would
+                    # block a SECOND dial_timeout in _connected.wait.
+                    deadline = time.time() + self._dial_timeout
+                    fresh = False
+                    while time.time() < deadline and not self._closed:
+                        cur = self._sock
+                        if cur is not None and cur is not sock:
+                            fresh = True
+                            break
+                        time.sleep(0.005)
+                    if fresh:
+                        continue
                 raise ConnectionError("kvstore connection lost")
             if not slot[0].wait(self._call_timeout):
                 with self._lock:

@@ -96,9 +96,29 @@ class NATTensors:
 # --- the NAT table (per-node port pool) ------------------------------
 
 NAT_PORT_MIN = 32768  # pool = [NAT_PORT_MIN, NAT_PORT_MIN + capacity)
-NAT_LIFETIME = 300  # seconds; refreshed on every use in either direction
 NAT_PROBE = 8  # claim window (linear probes from the tuple hash)
 NAT_DEFAULT_CAPACITY = 1 << 14  # shared by NATTable.create + mirrors
+
+# NAT entry lifetimes track conntrack's (reference: the NAT map is
+# GC'd alongside CT): a mapping outliving its flow's CT entry is
+# harmless, but one that expires UNDER a live CT entry re-ports an
+# idle-but-established connection mid-stream.  Refreshed on every use
+# in either direction.
+NAT_LIFETIME_TCP = 21600  # == conntrack.LIFETIME_TCP
+NAT_LIFETIME_NONTCP = 180  # >= conntrack.LIFETIME_NONTCP (60)
+
+
+def _nat_lifetime_py(proto: int) -> int:
+    return NAT_LIFETIME_TCP if proto == 6 else NAT_LIFETIME_NONTCP
+
+
+def _nat_hash_py(key) -> int:
+    """Host FNV-1a identical to :func:`_nat_hash` — kept adjacent so a
+    hash change cannot silently break TPU/interpreter port parity."""
+    h = 0x811C9DC5
+    for w in key:
+        h = ((h ^ (w & 0xFFFFFFFF)) * 0x01000193) & 0xFFFFFFFF
+    return h
 
 NAT_ROW_WORDS = 6
 NV_SRC = 0  # original source IP
@@ -185,8 +205,9 @@ def snat_egress(tbl: NATTable, t: NATTensors, ct, hdr: jnp.ndarray,
     dp = (dport << 8) | proto
     key = jnp.stack([src, sport, dst, dp], axis=1)
     h = _nat_hash(key)
-    expires = jnp.broadcast_to(now + jnp.uint32(NAT_LIFETIME),
-                               src.shape).astype(jnp.uint32)
+    lifetime = jnp.where(proto == 6, jnp.uint32(NAT_LIFETIME_TCP),
+                         jnp.uint32(NAT_LIFETIME_NONTCP))
+    expires = (now + lifetime).astype(jnp.uint32)
     new_row = jnp.stack([
         src, sport, dst, dp, expires,
         jnp.zeros_like(src),
@@ -290,9 +311,11 @@ def snat_reverse(tbl: NATTable, t: NATTensors, hdr: jnp.ndarray,
         jnp.where(hit, row[:, NV_SPORT], dport))
     # refresh on use (replies keep the mapping alive, like the
     # reference's NAT entry aging)
+    lifetime = jnp.where(proto == 6, jnp.uint32(NAT_LIFETIME_TCP),
+                         jnp.uint32(NAT_LIFETIME_NONTCP))
     refresh_rows = jnp.where(hit, cand, P)
     table = tbl.table.at[refresh_rows, NV_EXPIRES].set(
-        now + jnp.uint32(NAT_LIFETIME), mode="drop")
+        now + lifetime, mode="drop")
     return hdr, NATTable(table=table, failed=tbl.failed)
 
 

@@ -167,8 +167,8 @@ class TestSNAT:
         from cilium_tpu.agent import Daemon, DaemonConfig
         from cilium_tpu.core import make_batch
         from cilium_tpu.core.packets import COL_SPORT
-        from cilium_tpu.datapath.loader import _nat_hash_py
-        from cilium_tpu.service.nat import NAT_DEFAULT_CAPACITY
+        from cilium_tpu.service.nat import (NAT_DEFAULT_CAPACITY,
+                                            _nat_hash_py)
 
         import ipaddress
         mask = NAT_DEFAULT_CAPACITY - 1
@@ -228,10 +228,13 @@ class TestSNAT:
         # before it and re-hash from there is moot; instead force the
         # general case: mark every other slot expired (they are: the
         # table is empty), and verify the mapping is stable anyway
+        from cilium_tpu.service.nat import NAT_LIFETIME_NONTCP
+
         hdr2, tbl = snat_egress(tbl, t, ct, jnp.asarray(pkt),
                                 jnp.uint32(250))
         assert int(np.asarray(hdr2)[0, COL_SPORT]) == p1
-        assert int(np.asarray(tbl.table)[slot, NV_EXPIRES]) == 550
+        assert int(np.asarray(tbl.table)[slot, NV_EXPIRES]) == \
+            250 + NAT_LIFETIME_NONTCP
 
     def test_nat_survives_checkpoint_restore(self, tmp_path):
         """r04 review: replies to allocated node ports must keep
