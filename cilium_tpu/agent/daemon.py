@@ -271,6 +271,16 @@ class Daemon:
                                         source="generated")
                     cidr_labels.append(l.key)
         if not self._started:
+            # no serve loop to patch yet, but cached resolutions are
+            # STALE (peer sets freeze at resolve time) — without this,
+            # an endpoint added after a policy import resolves against
+            # the pre-churn peer sets and its traffic default-denies
+            # until an unrelated revision bump (r04 endpoint-after-
+            # policy ordering bug).  Cache-only clear: the
+            # regeneration add_endpoint triggers re-resolves fresh,
+            # and a full invalidate() would regen once per replayed
+            # identity at startup.
+            self.repo.invalidate_cache()
             return
         # Incremental fast path (SURVEY.md §7 hard part #3): patch the
         # identity's verdict row + LPM slots in place — no re-resolve,

@@ -1,18 +1,24 @@
 """The Observer gRPC API: hubble's external surface.
 
 Reference: upstream hubble serves ``observer.Observer`` over gRPC
-(``GetFlows`` server-streaming + ``ServerStatus``; schema
-``api/v1/flow/flow.proto``).  This environment ships the grpc runtime
-but not the protoc-gen-grpc plugin, so the service is registered with
-generic method handlers and the messages travel as the flow.proto
-JSON rendering (the exact dicts ``Flow.to_dict`` produces — the same
-bytes hubble's JSON exporter emits).  A consumer with real hubble
-stubs would need the binary proto; the METHOD SHAPE and payload schema
-are kept so that swap is mechanical.
+(``GetFlows`` server-streaming + ``ServerStatus``; schemas
+``api/v1/flow/flow.proto`` + ``api/v1/observer/observer.proto``).
+
+The service speaks BOTH encodings on the same method paths:
+
+- **binary flow.proto** (hand-encoded wire format, ``flow/proto.py``)
+  — what a stock hubble CLI with generated stubs sends/expects;
+- **flow.proto JSON** (the dicts ``Flow.to_dict`` produces — hubble's
+  JSON rendering) — used by the in-repo relay/CLI clients.
+
+Requests are sniffed: JSON starts with ``{`` (0x7b decodes as an
+invalid protobuf tag, so the sniff is unambiguous); each response is
+serialized in the encoding its request used.
 
 ``serve(observer, address)`` -> grpc.Server;
-:class:`ObserverClient` is the matching client (used by the relay for
-remote peers and by the CLI's ``hubble observe``).
+:class:`ObserverClient` is the matching JSON client (used by the
+relay for remote peers and by the CLI's ``hubble observe``);
+:class:`BinaryObserverClient` drives the binary surface.
 """
 
 from __future__ import annotations
@@ -26,49 +32,96 @@ SERVICE = "observer.Observer"
 
 _dumps = lambda d: json.dumps(d).encode()  # noqa: E731
 _loads = lambda b: json.loads(b.decode()) if b else {}  # noqa: E731
+_ident = lambda b: b  # noqa: E731 — handlers serialize per-request
+
+
+def _sniff_request(data: bytes) -> dict:
+    """bytes -> request dict + ``_wire`` marker ("json" | "proto")."""
+    from .proto import decode_get_flows_request
+
+    if not data:
+        return {"_wire": "proto"}
+    if data[:1] == b"{":
+        req = _loads(data)
+        req["_wire"] = "json"
+        return req
+    req = decode_get_flows_request(data)
+    req["_wire"] = "proto"
+    return req
 
 
 class _ObserverHandler(grpc.GenericRpcHandler):
-    def __init__(self, observer):
+    def __init__(self, observer, node_name: str = ""):
         self.observer = observer
+        self.node_name = node_name
 
     def service(self, handler_call_details):
         method = handler_call_details.method
         if method == f"/{SERVICE}/GetFlows":
             return grpc.unary_stream_rpc_method_handler(
                 self._get_flows,
-                request_deserializer=_loads,
-                response_serializer=_dumps)
+                request_deserializer=_sniff_request,
+                response_serializer=_ident)
         if method == f"/{SERVICE}/ServerStatus":
             return grpc.unary_unary_rpc_method_handler(
                 self._server_status,
-                request_deserializer=_loads,
-                response_serializer=_dumps)
+                request_deserializer=_sniff_request,
+                response_serializer=_ident)
         return None
 
-    def _get_flows(self, request: dict, context) -> Iterator[dict]:
+    def _get_flows(self, request: dict, context) -> Iterator[bytes]:
         from .observer import FlowFilter
+        from .proto import encode_get_flows_response
 
+        binary = request.get("_wire") == "proto"
         number = int(request.get("number", 100))
-        filters = [FlowFilter(**f)
-                   for f in request.get("whitelist", ())]
+        filters = []
+        for f in request.get("whitelist", ()):
+            if binary and "verdict" in f:
+                # binary filters carry WIRE Verdict enum values; the
+                # ring compares INTERNAL codes (one wire DROPPED spans
+                # two internal codes, so a filter may expand into
+                # several OR'd ones)
+                from .proto import VERDICT_WIRE_TO_INTERNAL
+
+                f = dict(f)
+                internals = VERDICT_WIRE_TO_INTERNAL.get(
+                    f.pop("verdict"), (-1,))  # unknown: match nothing
+                filters.extend(FlowFilter(verdict=v, **f)
+                               for v in internals)
+            else:
+                filters.append(FlowFilter(**f))
         flows = self.observer.get_flows(
             filters=filters, number=number,
             oldest_first=bool(request.get("oldest_first", False)))
         for f in flows:
-            yield {"flow": f.to_dict() if hasattr(f, "to_dict")
-                   else dict(f)}
+            is_flow = hasattr(f, "to_dict")
+            if binary and is_flow:
+                yield encode_get_flows_response(f, self.node_name)
+            else:
+                # relay-aggregated dicts have no Flow object to
+                # re-encode; they stream as JSON either way
+                yield _dumps({"flow": f.to_dict() if is_flow
+                              else dict(f)})
 
-    def _server_status(self, request: dict, context) -> dict:
+    def _server_status(self, request: dict, context) -> bytes:
+        from .proto import encode_server_status
+
         obs = self.observer
         if hasattr(obs, "server_status"):
-            return obs.server_status()
-        return {"num_flows": len(obs), "seen_flows": obs.seq,
-                "max_flows": obs.capacity}
+            st = obs.server_status()
+        else:
+            st = {"num_flows": len(obs), "seen_flows": obs.seq,
+                  "max_flows": obs.capacity}
+        if request.get("_wire") == "proto":
+            return encode_server_status(
+                int(st.get("num_flows", 0)), int(st.get("max_flows", 0)),
+                int(st.get("seen_flows", 0)))
+        return _dumps(st)
 
 
 def serve(observer, address: str = "unix:///tmp/hubble.sock",
-          max_workers: int = 4) -> grpc.Server:
+          max_workers: int = 4, node_name: str = "") -> grpc.Server:
     """Start the Observer service (unix:// or host:port address).
     ``observer`` may be an Observer or a Relay (relay exposes the same
     GetFlows protocol, making this the hubble-relay server too)."""
@@ -76,7 +129,8 @@ def serve(observer, address: str = "unix:///tmp/hubble.sock",
 
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_workers))
-    server.add_generic_rpc_handlers((_ObserverHandler(observer),))
+    server.add_generic_rpc_handlers(
+        (_ObserverHandler(observer, node_name),))
     server.add_insecure_port(address)
     server.start()
     return server
@@ -104,6 +158,42 @@ class ObserverClient:
 
     def server_status(self) -> dict:
         return self._status({})
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+class BinaryObserverClient:
+    """Binary flow.proto client — what a stock hubble CLI's generated
+    stubs put on the wire; responses decode through the schema-less
+    decoder (flow/proto.py field numbers)."""
+
+    def __init__(self, address: str = "unix:///tmp/hubble.sock"):
+        self.channel = grpc.insecure_channel(address)
+        self._get = self.channel.unary_stream(
+            f"/{SERVICE}/GetFlows",
+            request_serializer=_ident, response_deserializer=_ident)
+        self._status = self.channel.unary_unary(
+            f"/{SERVICE}/ServerStatus",
+            request_serializer=_ident, response_deserializer=_ident)
+
+    def get_flows(self, number: int = 100,
+                  whitelist: Sequence[dict] = ()) -> List[dict]:
+        """Returns schema-less decodes of each GetFlowsResponse:
+        {field: [values]} with field 1 = the encoded Flow."""
+        from .proto import decode_message, encode_get_flows_request
+
+        req = encode_get_flows_request(number=number,
+                                       whitelist=whitelist)
+        return [decode_message(raw) for raw in self._get(req)]
+
+    def server_status(self) -> dict:
+        from .proto import decode_message
+
+        msg = decode_message(self._status(b""))
+        return {"num_flows": int(msg.get(1, [0])[-1]),
+                "max_flows": int(msg.get(2, [0])[-1]),
+                "seen_flows": int(msg.get(3, [0])[-1])}
 
     def close(self) -> None:
         self.channel.close()

@@ -100,7 +100,10 @@ class ServiceWatcher:
         for name, (frontend, backends, proto) in wanted.items():
             self.services.upsert(name, frontend, backends,
                                  protocol=proto)
-        self._installed[key] = set(wanted)
+        if wanted:
+            self._installed[key] = set(wanted)
+        else:  # fully withdrawn: don't grow an empty entry per
+            self._installed.pop(key, None)  # ever-seen service
 
     @staticmethod
     def _backends(eps: dict, svc_port: dict) -> List[str]:
@@ -147,7 +150,7 @@ class PodWatcher:
         self.daemon = daemon
         self.node_name = node_name or daemon.config.node_name
         self._eps: Dict[str, int] = {}  # ns/name -> endpoint id
-        self._labels: Dict[str, List[str]] = {}
+        self._sig: Dict[str, tuple] = {}  # ns/name -> (labels,ips,ports)
 
     def _pod_ips(self, obj: dict) -> Tuple[str, ...]:
         st = obj.get("status") or {}
@@ -173,14 +176,19 @@ class PodWatcher:
         if not ips:
             return None  # not yet scheduled/IP'd; a later update fires
         labels = pod_labels(obj)
+        ports = self._named_ports(obj)
+        # idempotency covers EVERYTHING the endpoint derives from the
+        # pod: an IP change (sandbox restart) or port change with
+        # unchanged labels must still re-register
+        sig = (tuple(labels), ips, tuple(sorted(ports.items())))
         if key in self._eps:
-            if labels == self._labels.get(key):
+            if sig == self._sig.get(key):
                 return self._eps[key]  # idempotent re-deliver
-            self.on_delete(obj)  # label change: re-register
-        ep = self.daemon.add_endpoint(
-            key, ips, labels, named_ports=self._named_ports(obj))
+            self.on_delete(obj)  # pod changed: re-register
+        ep = self.daemon.add_endpoint(key, ips, labels,
+                                      named_ports=ports)
         self._eps[key] = ep.id
-        self._labels[key] = labels
+        self._sig[key] = sig
         return ep.id
 
     on_update = on_add
@@ -188,7 +196,7 @@ class PodWatcher:
     def on_delete(self, obj: dict) -> bool:
         key = _meta_key(obj)
         ep_id = self._eps.pop(key, None)
-        self._labels.pop(key, None)
+        self._sig.pop(key, None)
         if ep_id is None:
             return False
         return self.daemon.endpoints.remove(ep_id)

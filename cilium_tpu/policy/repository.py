@@ -77,6 +77,16 @@ class PolicyRepository:
         with self._lock:
             return self._bump()
 
+    def invalidate_cache(self) -> None:
+        """Drop cached resolutions WITHOUT bumping the revision or
+        firing listeners.  For identity churn before the daemon
+        starts: the caller's own regeneration (add_endpoint triggers
+        one) re-resolves with fresh peer sets, and firing listeners
+        here would run one full regeneration per replayed identity at
+        startup."""
+        with self._lock:
+            self._cache.clear()
+
     # -- queries ---------------------------------------------------------
     @property
     def revision(self) -> int:

@@ -151,6 +151,16 @@ class TestPodWatcher:
         ev = d.process_batch(pkt, now=10)
         assert int(ev.reason[0]) == REASON_NO_ENDPOINT
 
+    def test_pod_ip_change_reregisters(self):
+        """r04 review: a sandbox restart changes the pod IP with
+        unchanged labels — the endpoint must follow the IP."""
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _pod())
+        hub.dispatch("update", _pod(ip="10.0.2.33"))
+        assert d.endpoints.lookup_by_ip("10.0.2.1") is None
+        assert d.endpoints.lookup_by_ip("10.0.2.33") is not None
+
     def test_remote_pod_ignored_by_pod_watcher(self):
         d = _daemon()
         hub = d.k8s_watchers()
