@@ -305,8 +305,9 @@ class Daemon:
         if self.config.hubble_listen:
             from ..flow.grpc_server import serve as hubble_serve
 
-            self.hubble_server = hubble_serve(self.observer,
-                                              self.config.hubble_listen)
+            self.hubble_server = hubble_serve(
+                self.observer, self.config.hubble_listen,
+                node_name=self.config.node_name)
         if self.health is not None:
             def _health_sweep():
                 self.node_registry.heartbeat(self.config.node_name)

@@ -75,32 +75,48 @@ class _ObserverHandler(grpc.GenericRpcHandler):
 
         binary = request.get("_wire") == "proto"
         number = int(request.get("number", 100))
-        filters = []
-        for f in request.get("whitelist", ()):
-            if binary and "verdict" in f:
-                # binary filters carry WIRE Verdict enum values; the
-                # ring compares INTERNAL codes (one wire DROPPED spans
-                # two internal codes, so a filter may expand into
-                # several OR'd ones)
-                from .proto import VERDICT_WIRE_TO_INTERNAL
 
-                f = dict(f)
-                internals = VERDICT_WIRE_TO_INTERNAL.get(
-                    f.pop("verdict"), (-1,))  # unknown: match nothing
-                filters.extend(FlowFilter(verdict=v, **f)
+        def to_filters(entries) -> list:
+            out = []
+            for f in entries:
+                if binary and "verdict" in f:
+                    # binary filters carry WIRE Verdict enum values;
+                    # the ring compares INTERNAL codes (one wire
+                    # DROPPED spans two internal codes, so a filter
+                    # may expand into several OR'd ones)
+                    from .proto import VERDICT_WIRE_TO_INTERNAL
+
+                    f = dict(f)
+                    internals = VERDICT_WIRE_TO_INTERNAL.get(
+                        f.pop("verdict"), (-1,))  # unknown: none
+                    out.extend(FlowFilter(verdict=v, **f)
                                for v in internals)
-            else:
-                filters.append(FlowFilter(**f))
-        flows = self.observer.get_flows(
-            filters=filters, number=number,
+                else:
+                    out.append(FlowFilter(**f))
+            return out
+
+        kwargs = dict(
+            filters=to_filters(request.get("whitelist", ())),
+            number=number,
             oldest_first=bool(request.get("oldest_first", False)))
+        blacklist = to_filters(request.get("blacklist", ()))
+        if blacklist:
+            kwargs["blacklist"] = blacklist
+        flows = self.observer.get_flows(**kwargs)
         for f in flows:
             is_flow = hasattr(f, "to_dict")
-            if binary and is_flow:
+            if binary:
+                if not is_flow:
+                    # relay-aggregated dicts carry no Flow object to
+                    # re-encode; answering a proto request with JSON
+                    # bytes would crash the client's decoder
+                    # mid-stream — fail the RPC explicitly instead
+                    context.abort(
+                        grpc.StatusCode.UNIMPLEMENTED,
+                        "binary wire unavailable for relay-aggregated "
+                        "flows; use the JSON encoding")
                 yield encode_get_flows_response(f, self.node_name)
             else:
-                # relay-aggregated dicts have no Flow object to
-                # re-encode; they stream as JSON either way
                 yield _dumps({"flow": f.to_dict() if is_flow
                               else dict(f)})
 

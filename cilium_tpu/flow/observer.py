@@ -173,9 +173,12 @@ class Observer:
             self.seq += 1
 
     def get_flows(self, filters: Sequence[FlowFilter] = (),
-                  number: int = 100, oldest_first: bool = False
+                  number: int = 100, oldest_first: bool = False,
+                  blacklist: Sequence[FlowFilter] = ()
                   ) -> List[Flow]:
-        """The Observer.GetFlows equivalent."""
+        """The Observer.GetFlows equivalent: ``filters`` (whitelist)
+        OR together; ``blacklist`` filters then EXCLUDE (reference:
+        GetFlowsRequest whitelist/blacklist semantics)."""
         with self._lock:
             n = len(self)
             if n == 0:
@@ -191,6 +194,8 @@ class Observer:
                 for f in filters:
                     keep |= f.mask(self, idx)
                 idx = idx[keep]
+            for f in blacklist:
+                idx = idx[~f.mask(self, idx)]
             if not oldest_first:
                 idx = idx[::-1]
             idx = idx[:number]

@@ -93,6 +93,10 @@ def decode_message(data: bytes) -> Dict[int, list]:
             v, off = decode_varint(data, off)
         elif wt == 2:
             ln, off = decode_varint(data, off)
+            if off + ln > len(data):
+                # Python slicing would silently truncate: a corrupt
+                # request must error, not decode to partial filters
+                raise ValueError("truncated length-delimited field")
             v = data[off:off + ln]
             off += ln
         else:
@@ -298,8 +302,9 @@ def encode_server_status(num_flows: int, max_flows: int,
 
 def decode_get_flows_request(data: bytes) -> dict:
     """observer.proto GetFlowsRequest subset: number=1, follow=3,
-    blacklist=4, whitelist=5 (FlowFilter messages are passed through
-    schema-lessly: source_ip=1, destination_ip=2, verdict=5 only)."""
+    blacklist=4, whitelist=5.  FlowFilter fields handled:
+    source_ip=1, destination_ip=4, verdict=6 (the _FILTER_* constants
+    above); other filter fields are skipped rather than misread."""
     msg = decode_message(data)
     out: dict = {}
     if 1 in msg:

@@ -127,6 +127,16 @@ class TestFlowEncoding:
         http = decode_message(l7[101][0])
         assert http[2] == [b"GET"] and http[3] == [b"/x"]
 
+    def test_truncated_field_raises(self):
+        """r04 review: a corrupt request must error, not decode to
+        partial filters (a dropped verdict filter would return ALL
+        flows)."""
+        good = encode_get_flows_request(number=7)
+        # declare a length-delimited field longer than the payload
+        bad = good + bytes.fromhex("2aff01")  # field 5, len 255, EOF
+        with pytest.raises((ValueError, IndexError)):
+            decode_message(bad)
+
     def test_request_round_trip(self):
         raw = encode_get_flows_request(
             number=50, whitelist=[{"source_ip": "10.0.1.1",
@@ -204,5 +214,12 @@ class TestBinaryObserver:
             drop_flow = decode_message(dropped[0][1][0])
             assert drop_flow[2] == [2]  # wire DROPPED
             bc.close()
+            # blacklist excludes (r04 review: it was decoded then
+            # silently ignored)
+            from cilium_tpu.flow.observer import FlowFilter
+
+            flows = d.observer.get_flows(
+                number=10, blacklist=[FlowFilter(verdict=1)])
+            assert flows and all(f.verdict != 1 for f in flows)
         finally:
             server.stop(grace=0.5)
