@@ -71,6 +71,8 @@ LIFETIME_CLOSE = 10
 
 KEY_WORDS = 10  # src[4] dst[4] ports proto
 N_PROBE = 16  # linear probe window
+N_CAND = 4  # full rows fetched per fingerprint-filtered probe
+N_CAND_INS = 4  # claim attempts against fingerprint-filtered slots
 
 # value columns (offsets within the combined row, after the key words)
 V_STATE = KEY_WORDS + 0
@@ -86,9 +88,18 @@ ROW_WORDS = KEY_WORDS + 7
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class CTTable:
-    """Device CT state (a pytree threading functionally through jit)."""
+    """Device CT state (a pytree threading functionally through jit).
+
+    ``fp`` is a per-slot 1-byte key fingerprint (0 = free slot) kept in
+    its own HBM array: probes gather the 16-slot fingerprint window
+    first (64 B/packet) and fetch full 68 B rows only for the few
+    fingerprint-matching candidates — a ~3x probe-byte diet over
+    loading the whole [N, 16, ROW_WORDS] window.  The fingerprint is a
+    pure function of the stored key (``_fp_mix`` of the slot hash), so
+    snapshots stay placement-free and restores recompute it."""
 
     table: jnp.ndarray  # [C, ROW_WORDS] uint32
+    fp: jnp.ndarray  # [C] uint32 — key fingerprint per slot, 0 = free
     dropped: jnp.ndarray  # [] uint32 — failed inserts (map pressure)
 
     @staticmethod
@@ -102,6 +113,7 @@ class CTTable:
             "per-shard capacity must be 2^k"
         return CTTable(
             table=jnp.zeros((capacity, ROW_WORDS), dtype=jnp.uint32),
+            fp=jnp.zeros((capacity,), dtype=jnp.uint32),
             dropped=jnp.zeros((), dtype=jnp.uint32),
         )
 
@@ -110,7 +122,7 @@ class CTTable:
         return self.table.shape[0]
 
     def tree_flatten(self):
-        return ((self.table, self.dropped), None)
+        return ((self.table, self.fp, self.dropped), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -160,11 +172,37 @@ def ct_keys_from_headers(hdr: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def _hash(keys: jnp.ndarray) -> jnp.ndarray:
-    """FNV-1a over the key words: [N, KEY_WORDS] uint32 -> [N] uint32."""
+    """FNV-1a over the key words + murmur3 finalizer:
+    [N, KEY_WORDS] uint32 -> [N] uint32.
+
+    The finalizer is load-bearing: word-FNV's low product bits depend
+    ONLY on low input bits (low16(h*p) = low16(low16(h)*low16(p))), and
+    the ports word packs sport into the HIGH half — without avalanche,
+    home slots collapse to |srcs|*|dports| distinct values and probe
+    windows chain to overflow at a few percent occupancy."""
     h = jnp.full(keys.shape[0], 0x811C9DC5, dtype=jnp.uint32)
     for w in range(KEY_WORDS):
         h = (h ^ keys[:, w]) * jnp.uint32(0x01000193)
-    return h
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def _fp_mix(h):
+    """Key hash -> fingerprint byte in 1..255 (0 is the free marker).
+
+    The slot index consumes the LOW bits of ``h``, so the fingerprint
+    runs the murmur3 finalizer over it and takes the TOP byte — within
+    one probe window (slots that differ only in low bits) fingerprints
+    of distinct keys are ~independent, giving a 1/255 false-candidate
+    rate per live slot."""
+    g = h ^ (h >> 16)
+    g = g * jnp.uint32(0x85EBCA6B)
+    g = g ^ (g >> 13)
+    g = g * jnp.uint32(0xC2B2AE35)
+    return (g >> 24) % jnp.uint32(255) + jnp.uint32(1)
 
 
 def _probe(table: jnp.ndarray, keys: jnp.ndarray, now: jnp.ndarray
@@ -198,6 +236,55 @@ def _probe(table: jnp.ndarray, keys: jnp.ndarray, now: jnp.ndarray
     return found, jnp.where(found, slot, 0).astype(jnp.int32)
 
 
+def _fp_window(fp: jnp.ndarray, keys: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Gather each key's fingerprint window: -> (slots [N, N_PROBE],
+    window fingerprints [N, N_PROBE], key fingerprint [N])."""
+    mask = fp.shape[0] - 1
+    h = _hash(keys)
+    steps = jnp.arange(N_PROBE, dtype=jnp.uint32)
+    slots = ((h[:, None] + steps[None, :]) & mask).astype(jnp.int32)
+    return slots, fp[slots], _fp_mix(h)
+
+
+def _first_k(mask: jnp.ndarray, k: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """First ``k`` True positions per row of [N, N_PROBE] ``mask`` in
+    window order: -> (positions [N, k] int32, valid [N, k] bool)."""
+    steps = jnp.arange(N_PROBE, dtype=jnp.int32)
+    rank = jnp.where(mask, steps[None, :], N_PROBE)
+    order = jnp.sort(rank, axis=1)[:, :k]
+    return jnp.minimum(order, N_PROBE - 1), order < N_PROBE
+
+
+def _probe_fp(table: jnp.ndarray, fp: jnp.ndarray, keys: jnp.ndarray,
+              now: jnp.ndarray
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fingerprint-filtered probe: -> (found, slot, overflow).
+
+    Gathers the 16-slot fingerprint window (16 words/key), then full
+    rows for only the first ``N_CAND`` fingerprint matches.  Exactness:
+    a miss with more than ``N_CAND`` fingerprint matches in the window
+    is flagged ``overflow`` — the true entry could hide past the
+    candidate budget (P ~ (occupancy/255)^N_CAND per probe), and the
+    caller reruns the full-window probe under ``lax.cond``.  Stale
+    fingerprints of expired-but-unswept entries only cost a candidate
+    slot; the liveness check on the gathered row rejects them."""
+    slots, win_fp, key_fp = _fp_window(fp, keys)
+    fmatch = win_fp == key_fp[:, None]  # [N, N_PROBE]
+    pos, cand_valid = _first_k(fmatch, N_CAND)
+    cand_slots = jnp.take_along_axis(slots, pos, axis=1)  # [N, N_CAND]
+    rows = table[cand_slots]  # [N, N_CAND, ROW_WORDS]
+    live = (rows[:, :, V_STATE] != ST_FREE) & (rows[:, :, V_EXPIRES]
+                                               >= now)
+    match = cand_valid & live & jnp.all(
+        rows[:, :, :KEY_WORDS] == keys[:, None, :], axis=2)
+    found = jnp.any(match, axis=1)
+    first = jnp.argmax(match, axis=1)
+    slot = jnp.take_along_axis(cand_slots, first[:, None], axis=1)[:, 0]
+    overflow = ~found & (jnp.sum(fmatch, axis=1) > N_CAND)
+    return found, jnp.where(found, slot, 0).astype(jnp.int32), overflow
+
+
 def ct_lookup(ct: CTTable, fwd: jnp.ndarray, rev: jnp.ndarray,
               now: jnp.ndarray
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -205,9 +292,26 @@ def ct_lookup(ct: CTTable, fwd: jnp.ndarray, rev: jnp.ndarray,
 
     Returns (result [N] int32 in CT_*, slot [N] int32, is_reply [N]
     bool).  ``slot`` is valid only where result != CT_NEW.
+
+    Fast path: fingerprint-filtered probes (:func:`_probe_fp`).  If
+    ANY packet's fingerprint candidates overflowed without a match,
+    the whole batch reruns the exact full-window probe — semantics are
+    bit-identical to the unfiltered probe, the filter is purely a
+    memory-traffic optimization.
     """
-    f_found, f_slot = _probe(ct.table, fwd, now)
-    r_found, r_slot = _probe(ct.table, rev, now)
+    f_found, f_slot, f_ovf = _probe_fp(ct.table, ct.fp, fwd, now)
+    r_found, r_slot, r_ovf = _probe_fp(ct.table, ct.fp, rev, now)
+
+    def _full(_):
+        ff, fs = _probe(ct.table, fwd, now)
+        rf, rs = _probe(ct.table, rev, now)
+        return ff, fs, rf, rs
+
+    def _fast(_):
+        return f_found, f_slot, r_found, r_slot
+
+    f_found, f_slot, r_found, r_slot = jax.lax.cond(
+        jnp.any(f_ovf | r_ovf), _full, _fast, None)
     is_reply = ~f_found & r_found
     slot = jnp.where(f_found, f_slot, r_slot)
     result = jnp.where(f_found, CT_ESTABLISHED,
@@ -272,8 +376,6 @@ def ct_update(ct: CTTable, hdr: jnp.ndarray, fwd: jnp.ndarray,
     pending = do_create & (result == CT_NEW)
     if valid is not None:
         pending = pending & valid
-    mask = capacity - 1
-    h = _hash(fwd)
     init_state = jnp.where(is_tcp, ST_SYN_SENT, ST_ESTABLISHED)
     init_life = jnp.where(is_tcp, LIFETIME_SYN, LIFETIME_NONTCP)
     new_row = jnp.concatenate([
@@ -289,20 +391,51 @@ def ct_update(ct: CTTable, hdr: jnp.ndarray, fwd: jnp.ndarray,
         ], axis=1),
     ], axis=1)  # [N, ROW_WORDS]
 
-    for step in range(N_PROBE):
-        s = ((h + step) & mask).astype(jnp.int32)
+    fp = ct.fp
+    slots_w, win_fp, key_fp = _fp_window(fp, fwd)
+
+    def _claim(table, fp, pending, s, also_try=None):
         stored = table[s]
         claimable = ((stored[:, V_STATE] == ST_FREE)
                      | (stored[:, V_EXPIRES] < now)
                      | jnp.all(stored[:, :KEY_WORDS] == fwd, axis=1))
         trying = pending & claimable
+        if also_try is not None:
+            trying = trying & also_try
         rows = jnp.where(trying, s, capacity)
         table = table.at[rows].set(new_row, mode="drop")
         won = trying & jnp.all(table[s, :KEY_WORDS] == fwd, axis=1)
-        pending = pending & ~won
+        fp = fp.at[jnp.where(won, s, capacity)].set(key_fp, mode="drop")
+        return table, fp, pending & ~won
+
+    # fast path: claim among fingerprint-filtered candidates only —
+    # free slots (fp 0) and same-fingerprint slots (own key re-claim,
+    # expired twins).  Probe-byte diet: N_CAND_INS row gathers instead
+    # of N_PROBE.
+    cand_mask = (win_fp == 0) | (win_fp == key_fp[:, None])
+    pos, cand_valid = _first_k(cand_mask, N_CAND_INS)
+    for k in range(N_CAND_INS):
+        s = jnp.take_along_axis(slots_w, pos[:, k:k + 1], axis=1)[:, 0]
+        table, fp, pending = _claim(table, fp, pending, s,
+                                    cand_valid[:, k])
+
+    # exact fallback: a still-pending insert might claim an
+    # expired-other-key slot the fingerprint can't identify, or lost
+    # every candidate to same-window racers — rerun the full-window
+    # loop for the batch (rare: needs >= N_CAND_INS contenders or an
+    # exhausted window, so steady state never pays it)
+    def _full(args):
+        table, fp, pending = args
+        for step in range(N_PROBE):
+            table, fp, pending = _claim(table, fp, pending,
+                                        slots_w[:, step])
+        return table, fp, pending
+
+    table, fp, pending = jax.lax.cond(
+        jnp.any(pending), _full, lambda a: a, (table, fp, pending))
 
     dropped = ct.dropped + jnp.sum(pending).astype(jnp.uint32)
-    return CTTable(table=table, dropped=dropped)
+    return CTTable(table=table, fp=fp, dropped=dropped)
 
 
 def ct_gc(ct: CTTable, now: jnp.ndarray) -> Tuple[CTTable, jnp.ndarray]:
@@ -313,7 +446,8 @@ def ct_gc(ct: CTTable, now: jnp.ndarray) -> Tuple[CTTable, jnp.ndarray]:
     n = jnp.sum(expired).astype(jnp.uint32)
     state = jnp.where(expired, ST_FREE, ct.table[:, V_STATE])
     table = ct.table.at[:, V_STATE].set(state.astype(jnp.uint32))
-    return CTTable(table=table, dropped=ct.dropped), n
+    fp = jnp.where(expired, jnp.uint32(0), ct.fp)
+    return CTTable(table=table, fp=fp, dropped=ct.dropped), n
 
 
 @partial(jax.jit, donate_argnums=0)
@@ -337,13 +471,40 @@ _STATE_NAMES = {ST_SYN_SENT: "SYN_SENT", ST_ESTABLISHED: "ESTABLISHED",
 
 
 def _hash_np(keys: np.ndarray) -> np.ndarray:
-    """Host-side FNV-1a identical to :func:`_hash` (for re-placement)."""
+    """Host-side hash identical to :func:`_hash` (for re-placement)."""
     keys = keys.astype(np.uint32)
     h = np.full(keys.shape[0], 0x811C9DC5, dtype=np.uint32)
     with np.errstate(over="ignore"):
         for w in range(KEY_WORDS):
             h = (h ^ keys[:, w]) * np.uint32(0x01000193)
-    return h
+        h = h ^ (h >> np.uint32(16))
+        h = h * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> np.uint32(13))
+        h = h * np.uint32(0xC2B2AE35)
+    return h ^ (h >> np.uint32(16))
+
+
+def _fp_mix_np(h: np.ndarray) -> np.ndarray:
+    """Host mirror of :func:`_fp_mix`."""
+    with np.errstate(over="ignore"):
+        g = h ^ (h >> np.uint32(16))
+        g = g * np.uint32(0x85EBCA6B)
+        g = g ^ (g >> np.uint32(13))
+        g = g * np.uint32(0xC2B2AE35)
+    return (g >> np.uint32(24)) % np.uint32(255) + np.uint32(1)
+
+
+def ct_fp_from_table(table: np.ndarray) -> np.ndarray:
+    """Recompute the per-slot fingerprint array from a placed table.
+
+    The fingerprint is derived state (a pure function of each live
+    slot's key), so restores rebuild it instead of persisting it."""
+    table = np.asarray(table, dtype=np.uint32)
+    fp = np.zeros(table.shape[0], dtype=np.uint32)
+    live = table[:, V_STATE] != ST_FREE
+    if live.any():
+        fp[live] = _fp_mix_np(_hash_np(table[live, :KEY_WORDS]))
+    return fp
 
 
 def ct_rows_from_table(table: np.ndarray) -> np.ndarray:

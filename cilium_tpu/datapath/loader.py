@@ -389,8 +389,8 @@ class TPULoader(Loader):
             return ct_rows_from_table(np.asarray(self.state.ct.table))
 
     def ct_restore(self, table: np.ndarray) -> None:
-        from .conntrack import (CTTable, ROW_WORDS, ct_rows_from_table,
-                                ct_table_from_rows)
+        from .conntrack import (CTTable, ROW_WORDS, ct_fp_from_table,
+                                ct_rows_from_table, ct_table_from_rows)
 
         jnp = self._jnp
         table = np.asarray(table)
@@ -404,6 +404,7 @@ class TPULoader(Loader):
             self.state = DatapathState(
                 policy=self.state.policy, ipcache=self.state.ipcache,
                 ct=CTTable(table=jnp.asarray(table),
+                           fp=jnp.asarray(ct_fp_from_table(table)),
                            dropped=jnp.uint32(n_dropped)),
                 metrics=self.state.metrics)
 

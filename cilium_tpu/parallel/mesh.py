@@ -148,6 +148,7 @@ def shard_state(state: DatapathState, mesh: Mesh,
     over chips, everything else replicated."""
     repl = NamedSharding(mesh, P())
     ct_sh = NamedSharding(mesh, P(axis, None))
+    fp_sh = NamedSharding(mesh, P(axis))
 
     def put(x, sharding):
         return jax.device_put(x, sharding)
@@ -156,6 +157,7 @@ def shard_state(state: DatapathState, mesh: Mesh,
         policy=jax.tree.map(lambda x: put(x, repl), state.policy),
         ipcache=jax.tree.map(lambda x: put(x, repl), state.ipcache),
         ct=CTTable(table=put(state.ct.table, ct_sh),
+                   fp=put(state.ct.fp, fp_sh),
                    dropped=put(state.ct.dropped, repl)),
         metrics=put(state.metrics, repl),
     )
@@ -170,32 +172,33 @@ def make_sharded_step(mesh: Mesh, axis: str = "data") -> Callable:
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(), P(axis, None), P(), P(),
+        in_specs=(P(), P(), P(axis, None), P(axis), P(), P(),
                   P(axis, None), P(), P(axis)),
-        out_specs=(P(axis, None), P(axis, None), P(), P()),
+        out_specs=(P(axis, None), P(axis, None), P(axis), P(), P()),
     )
-    def _step(policy, ipcache, ct_table, ct_dropped, metrics, hdr, now,
-              valid):
+    def _step(policy, ipcache, ct_table, ct_fp, ct_dropped, metrics,
+              hdr, now, valid):
         state = DatapathState(
             policy=policy, ipcache=ipcache,
-            ct=CTTable(table=ct_table, dropped=ct_dropped),
+            ct=CTTable(table=ct_table, fp=ct_fp, dropped=ct_dropped),
             metrics=metrics)
         out, ns = datapath_step(state, hdr, now, valid=valid)
         # counters are replicated state: accumulate the global delta so
         # every replica agrees (the kvstore-replication analogue)
         d_dropped = jax.lax.psum(ns.ct.dropped - ct_dropped, axis)
         d_metrics = jax.lax.psum(ns.metrics - metrics, axis)
-        return (out, ns.ct.table, ct_dropped + d_dropped,
+        return (out, ns.ct.table, ns.ct.fp, ct_dropped + d_dropped,
                 metrics + d_metrics)
 
     @partial(jax.jit, donate_argnums=0)
     def step(state: DatapathState, hdr: jnp.ndarray, now: jnp.ndarray,
              valid: jnp.ndarray) -> Tuple[jnp.ndarray, DatapathState]:
-        out, table, dropped, metrics = _step(
-            state.policy, state.ipcache, state.ct.table, state.ct.dropped,
-            state.metrics, hdr, now, valid)
+        out, table, fp, dropped, metrics = _step(
+            state.policy, state.ipcache, state.ct.table, state.ct.fp,
+            state.ct.dropped, state.metrics, hdr, now, valid)
         return out, DatapathState(
             policy=state.policy, ipcache=state.ipcache,
-            ct=CTTable(table=table, dropped=dropped), metrics=metrics)
+            ct=CTTable(table=table, fp=fp, dropped=dropped),
+            metrics=metrics)
 
     return step
