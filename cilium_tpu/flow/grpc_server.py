@@ -166,10 +166,13 @@ class ObserverClient:
             request_serializer=_dumps, response_deserializer=_loads)
 
     def get_flows(self, filters: Sequence = (), number: int = 100,
-                  oldest_first: bool = False) -> List[dict]:
+                  oldest_first: bool = False,
+                  blacklist: Sequence = ()) -> List[dict]:
         req = {"number": number, "oldest_first": oldest_first}
         if filters:
             req["whitelist"] = [f.__dict__ for f in filters]
+        if blacklist:
+            req["blacklist"] = [f.__dict__ for f in blacklist]
         return [msg["flow"] for msg in self._get(req)]
 
     def server_status(self) -> dict:
@@ -194,13 +197,15 @@ class BinaryObserverClient:
             request_serializer=_ident, response_deserializer=_ident)
 
     def get_flows(self, number: int = 100,
-                  whitelist: Sequence[dict] = ()) -> List[dict]:
+                  whitelist: Sequence[dict] = (),
+                  blacklist: Sequence[dict] = ()) -> List[dict]:
         """Returns schema-less decodes of each GetFlowsResponse:
         {field: [values]} with field 1 = the encoded Flow."""
         from .proto import decode_message, encode_get_flows_request
 
         req = encode_get_flows_request(number=number,
-                                       whitelist=whitelist)
+                                       whitelist=whitelist,
+                                       blacklist=blacklist)
         return [decode_message(raw) for raw in self._get(req)]
 
     def server_status(self) -> dict:

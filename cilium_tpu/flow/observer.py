@@ -56,8 +56,14 @@ class FlowFilter:
     since: Optional[float] = None
     until: Optional[float] = None
     reply: Optional[bool] = None
+    # set by the wire decoder when the filter carried a field this
+    # implementation cannot evaluate: such a filter matches NOTHING
+    # (conservative for both whitelist and blacklist use)
+    unsupported: bool = False
 
     def mask(self, ring: "Observer", idx: np.ndarray) -> np.ndarray:
+        if self.unsupported:
+            return np.zeros(len(idx), dtype=bool)
         m = np.ones(len(idx), dtype=bool)
         if self.verdict is not None:
             m &= ring.verdict[idx] == self.verdict

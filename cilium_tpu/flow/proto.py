@@ -275,20 +275,24 @@ _FILTER_VERDICT = 6
 
 
 def encode_get_flows_request(number: int = 0, follow: bool = False,
-                             whitelist: Sequence[dict] = ()) -> bytes:
+                             whitelist: Sequence[dict] = (),
+                             blacklist: Sequence[dict] = ()) -> bytes:
     """Client-side GetFlowsRequest (for the binary client + tests).
     ``verdict`` values are WIRE enum values (FORWARDED=1, DROPPED=2,
     REDIRECTED=5)."""
     out = _varint_field(1, number)
     out += _varint_field(3, 1 if follow else 0)
+
+    def _filter_payload(f: dict) -> bytes:
+        return (_str_field(_FILTER_SOURCE_IP, f.get("source_ip", ""))
+                + _str_field(_FILTER_DEST_IP,
+                             f.get("destination_ip", ""))
+                + _varint_field(_FILTER_VERDICT, f.get("verdict", 0)))
+
+    for f in blacklist:
+        out += _msg_field(4, _filter_payload(f))
     for f in whitelist:
-        payload = (_str_field(_FILTER_SOURCE_IP,
-                              f.get("source_ip", ""))
-                   + _str_field(_FILTER_DEST_IP,
-                                f.get("destination_ip", ""))
-                   + _varint_field(_FILTER_VERDICT,
-                                   f.get("verdict", 0)))
-        out += _msg_field(5, payload)
+        out += _msg_field(5, _filter_payload(f))
     return out
 
 
@@ -313,6 +317,7 @@ def decode_get_flows_request(data: bytes) -> dict:
         out["follow"] = bool(msg[3][-1])
 
     def _filters(raws) -> list:
+        supported = {_FILTER_SOURCE_IP, _FILTER_DEST_IP, _FILTER_VERDICT}
         fs = []
         for raw in raws:
             m = decode_message(raw)
@@ -323,6 +328,12 @@ def decode_get_flows_request(data: bytes) -> dict:
                 f["destination_ip"] = m[_FILTER_DEST_IP][-1].decode()
             if _FILTER_VERDICT in m:
                 f["verdict"] = int(m[_FILTER_VERDICT][-1])
+            if set(m) - supported:
+                # a condition we cannot evaluate: the filter must match
+                # NOTHING (matching everything would turn a narrow
+                # blacklist into exclude-all / a whitelist into
+                # match-all)
+                f["unsupported"] = True
             fs.append(f)
         return fs
 

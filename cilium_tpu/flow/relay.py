@@ -29,14 +29,16 @@ class Relay:
 
     def get_flows(self, filters: Sequence[FlowFilter] = (),
                   number: int = 100,
-                  oldest_first: bool = False) -> List[dict]:
+                  oldest_first: bool = False,
+                  blacklist: Sequence[FlowFilter] = ()) -> List[dict]:
         """Merged, time-ordered flows as dicts with ``node_name``
         stamped (relay adds the node dimension the per-agent API
         lacks)."""
         merged: List[dict] = []
         for name, obs in self.peers.items():
             for f in obs.get_flows(filters=filters, number=number,
-                                   oldest_first=oldest_first):
+                                   oldest_first=oldest_first,
+                                   blacklist=blacklist):
                 d = f.to_dict() if isinstance(f, Flow) else dict(f)
                 d["node_name"] = name
                 merged.append(d)

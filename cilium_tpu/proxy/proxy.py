@@ -64,6 +64,12 @@ class L7Proxy:
         self._dns_observers: List[DNSAnswerFn] = []
         self.requests_total = 0
         self.requests_denied = 0
+        # host-fallback accounting: requests the device tensors did NOT
+        # admit that were re-checked against regex/glob host matchers
+        # (the per-request Python cost center — the bench reports the
+        # hit fraction so the device-tensor coverage is visible)
+        self.host_fallback_checked = 0
+        self.host_fallback_allowed = 0
 
     # -- wiring -------------------------------------------------------
     def update(self, policies) -> None:
@@ -129,9 +135,12 @@ class L7Proxy:
             allow = np.zeros(len(raw), dtype=bool)
         matchers = t.host_matchers.get(port)
         if matchers:
-            for i in np.nonzero(~allow)[0]:
+            pending = np.nonzero(~allow)[0]
+            self.host_fallback_checked += len(pending)
+            for i in pending:
                 if any(m(raw[i]) for m in matchers):
                     allow[i] = True
+                    self.host_fallback_allowed += 1
         return allow.astype(np.uint8)
 
     def handle_http(self, port: int, requests: Sequence[dict],

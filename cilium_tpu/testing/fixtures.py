@@ -34,6 +34,7 @@ class World:
     tensors: PolicyTensors
     lpm: LPMTensors
     pod_ips: List[str]
+    pod_ips6: List[str] = None  # v6 pods (build_world(n_v6=...))
 
 
 def _pod_ip(i: int) -> str:
@@ -42,7 +43,8 @@ def _pod_ip(i: int) -> str:
 
 def build_world(n_identities: int = 10_000, n_rules: int = 64,
                 ct_capacity: int = 1 << 20, ct_shards: int = 1,
-                row_capacity: Optional[int] = None) -> World:
+                row_capacity: Optional[int] = None,
+                n_v6: int = 0) -> World:
     """The 10k-identity benchmark world (BASELINE.md config #3).
 
     Identities svc0..svcN-1 get /32 pod IPs; the subject endpoint (a
@@ -66,6 +68,18 @@ def build_world(n_identities: int = 10_000, n_rules: int = 64,
         pod_ips.append(ip)
         ipcache[ip + "/32"] = ident.numeric_id
     ipcache["0.0.0.0/0"] = world_id
+
+    # dual-stack pods (the wide-path benchmark's v6 sources): same
+    # ns=default label space so the broad 5432 allow admits them
+    pod_ips6: List[str] = []
+    for i in range(n_v6):
+        ident = alloc.allocate(LabelSet.parse(f"k8s:app=v6svc{i}",
+                                              "k8s:ns=default"))
+        ip6 = f"2001:db8::{i + 1:x}"
+        pod_ips6.append(ip6)
+        ipcache[ip6 + "/128"] = ident.numeric_id
+    if n_v6:
+        ipcache["::/0"] = world_id
 
     # rule set: each rule allows one "service group" label slice on a
     # port range; every identity matches ns=default so selector slices
@@ -108,7 +122,7 @@ def build_world(n_identities: int = 10_000, n_rules: int = 64,
 
     if row_capacity is None:
         row_capacity = 1
-        while row_capacity < n_identities + 64:
+        while row_capacity < n_identities + n_v6 + 64:
             row_capacity *= 2
     row_map = IdentityRowMap(capacity=row_capacity)
     for ident in alloc.all_identities():
@@ -121,7 +135,8 @@ def build_world(n_identities: int = 10_000, n_rules: int = 64,
                         ct_shards=ct_shards)
     return World(state=state, policies=policies, ep_policy=ep_policy,
                  row_map=row_map, ipcache=ipcache, alloc=alloc, repo=repo,
-                 tensors=tensors, lpm=lpm, pod_ips=pod_ips)
+                 tensors=tensors, lpm=lpm, pod_ips=pod_ips,
+                 pod_ips6=pod_ips6)
 
 
 def steady_flow_pool(world: World, n_flows: int,
@@ -179,6 +194,50 @@ def steady_traffic(pool: np.ndarray, n: int, rng: np.random.Generator,
         fresh, 40000 + rng.integers(0, 20000, n, dtype=np.uint32),
         rows[:, COL_SPORT])
     rows[:, COL_FLAGS] = np.where(fresh, TCP_SYN, rows[:, COL_FLAGS])
+    return rows
+
+
+def wide_flow_pool(world: World, n_flows: int, rng: np.random.Generator,
+                   v6_frac: float = 0.15) -> np.ndarray:
+    """A dual-stack steady pool: ``v6_frac`` of the flows ride IPv6
+    sources (``build_world(n_v6=...)`` pods, 128-bit addresses through
+    the TCAM LPM) — the wide-path benchmark's flow universe."""
+    from ..core.packets import (COL_DST_IP0, COL_FAMILY, COL_SRC_IP0,
+                                ip_to_words)
+
+    pool = steady_flow_pool(world, n_flows, rng)
+    n6 = int(n_flows * v6_frac)
+    if n6 and world.pod_ips6:
+        idx = rng.choice(n_flows, n6, replace=False)
+        v6w = np.array([ip_to_words(ip) for ip in world.pod_ips6],
+                       dtype=np.uint32)
+        pick = rng.integers(0, len(v6w), n6)
+        cols = np.arange(4)
+        pool[idx[:, None], COL_SRC_IP0 + cols] = v6w[pick]
+        dst6 = np.asarray(ip_to_words("2001:db8::d:b"), dtype=np.uint32)
+        pool[idx[:, None], COL_DST_IP0 + cols] = dst6[None, :]
+        pool[idx, COL_FAMILY] = 6
+    return pool
+
+
+def wide_traffic(pool: np.ndarray, n: int, rng: np.random.Generator,
+                 related_frac: float = 0.03,
+                 new_frac: float = 0.05) -> np.ndarray:
+    """One wide-path batch: the steady dual-stack mix plus
+    ``related_frac`` ICMP destination-unreachable rows about
+    established v4 pool flows (FLAG_RELATED, embedded-tuple semantics —
+    the path the packed 16 B format cannot carry)."""
+    from ..core.packets import COL_FAMILY, COL_FLAGS, FLAG_RELATED
+
+    rows = steady_traffic(pool, n, rng, new_frac=new_frac)
+    nrel = int(n * related_frac)
+    if nrel and len(pool):
+        # errors about v4 AND v6 flows (the renderer emits ICMPv4 or
+        # ICMPv6 per the embedded family)
+        pick = rng.integers(0, len(pool), nrel)
+        idx = rng.choice(n, nrel, replace=False)
+        rows[idx] = pool[pick]
+        rows[idx, COL_FLAGS] = FLAG_RELATED
     return rows
 
 

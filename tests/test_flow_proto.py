@@ -140,11 +140,31 @@ class TestFlowEncoding:
     def test_request_round_trip(self):
         raw = encode_get_flows_request(
             number=50, whitelist=[{"source_ip": "10.0.1.1",
-                                   "verdict": 2}])
+                                   "verdict": 2}],
+            blacklist=[{"destination_ip": "10.0.2.2"}])
         req = decode_get_flows_request(raw)
         assert req["number"] == 50
         assert req["whitelist"] == [{"source_ip": "10.0.1.1",
                                      "verdict": 2}]
+        assert req["blacklist"] == [{"destination_ip": "10.0.2.2"}]
+
+    def test_unsupported_filter_field_matches_nothing(self):
+        """A filter carrying a field this implementation can't evaluate
+        must match NO flows — a blacklist on an unknown field must not
+        become exclude-everything (review r04)."""
+        from cilium_tpu.flow.observer import FlowFilter
+        from cilium_tpu.flow.proto import _msg_field, _str_field
+
+        from cilium_tpu.flow.proto import _varint_field
+
+        # FlowFilter field 9 (source_pod) is not implemented
+        raw = (_varint_field(1, 10)
+               + _msg_field(4, _str_field(9, "default/web-0")))
+        req = decode_get_flows_request(raw)
+        [f] = req["blacklist"]
+        assert f.get("unsupported") is True
+        assert not FlowFilter(**f).mask(
+            type("R", (), {})(), np.arange(3)).any()
 
 
 class TestBinaryObserver:
