@@ -489,7 +489,7 @@ def bench_anomaly() -> dict:
     try:
         proc = subprocess.run(
             [sys.executable, "-m", "cilium_tpu.ml.evaluate"],
-            capture_output=True, text=True, timeout=900)
+            capture_output=True, text=True, timeout=1800)
         line = proc.stdout.strip().splitlines()[-1]
         return json.loads(line)
     except Exception as e:  # bench must still print its JSON line
