@@ -119,6 +119,15 @@ class Daemon:
         self.proxy = L7Proxy()
         self.endpoints.on_attach(self.proxy.update)
 
+        # xDS push surface for an EXTERNAL proxy (reference: pkg/envoy
+        # NPDS) — the native L7 path above stays the default; the
+        # cache just tracks every attach so a fronting Envoy can
+        # subscribe via proxy/xds.serve_xds
+        from ..proxy.xds import XDSCache
+
+        self.xds = XDSCache()
+        self.endpoints.on_attach(self.xds.update_from_policies)
+
         # hubble plane
         self.observer = Observer(
             capacity=self.config.flow_ring_capacity,
@@ -473,6 +482,14 @@ class Daemon:
         row = (self.loader.row_map.row(src_identity)
                if self.loader.row_map else 0)
         return self.proxy.handle_kafka(proxy_port, requests, row)
+
+    def handle_l7(self, kind: str, proxy_port: int, requests,
+                  src_identity: int = 0) -> np.ndarray:
+        """Verdict requests of a PLUGIN protocol (cassandra,
+        memcached, or anything proxy/registry.py knows)."""
+        row = (self.loader.row_map.row(src_identity)
+               if self.loader.row_map else 0)
+        return self.proxy.handle(kind, proxy_port, requests, row)
 
     # -- k8s integration ----------------------------------------------
     _k8s_hub = None

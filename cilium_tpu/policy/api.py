@@ -278,19 +278,49 @@ class L7Rules:
     http: Tuple[PortRuleHTTP, ...] = ()
     dns: Tuple[PortRuleDNS, ...] = ()
     kafka: Tuple[dict, ...] = ()  # schema passthrough
+    # plugin protocols (proxy/registry.py): ((kind_name, (rule, ...)),
+    # ...) — schema keys beyond the three built-ins pass through to
+    # whatever parser plugin registered that name (reference:
+    # api.PortRuleL7 "l7proto" + proxylib plugin rules)
+    extra: Tuple[Tuple[str, Tuple[dict, ...]], ...] = ()
 
     @property
     def is_empty(self) -> bool:
-        return not (self.http or self.dns or self.kafka)
+        return not (self.http or self.dns or self.kafka or self.extra)
+
+    @property
+    def extra_by_name(self) -> Dict[str, Tuple[dict, ...]]:
+        return dict(self.extra)
 
     @staticmethod
     def from_dict(d: Optional[dict]) -> "L7Rules":
         if not d:
             return L7Rules()
+        d = dict(d)
+        # upstream api.PortRuleL7 spells plugin rules as
+        # {"l7proto": "<parser>", "l7": [rule, ...]}; normalize to the
+        # keyed-by-parser form
+        proto_name = d.pop("l7proto", None)
+        l7_list = d.pop("l7", None)
+        extra_items: dict = {}
+        if proto_name:
+            extra_items[str(proto_name)] = list(l7_list or ())
+        for k, v in d.items():
+            if k in ("http", "dns", "kafka") or not v:
+                continue
+            if not isinstance(v, (list, tuple)):
+                raise ValueError(
+                    f"L7 rules for {k!r} must be a list of rule "
+                    f"objects, got {type(v).__name__}")
+            extra_items.setdefault(str(k), []).extend(v)
+        extra = tuple(
+            (k, tuple(dict(x) for x in rules))
+            for k, rules in sorted(extra_items.items()) if rules)
         return L7Rules(
             http=tuple(PortRuleHTTP.from_dict(x) for x in d.get("http") or ()),
             dns=tuple(PortRuleDNS.from_dict(x) for x in d.get("dns") or ()),
             kafka=tuple(dict(x) for x in d.get("kafka") or ()),
+            extra=extra,
         )
 
 

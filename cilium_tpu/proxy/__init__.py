@@ -19,8 +19,12 @@ from .l7policy import (
 )
 from .featurize import featurize_dns, featurize_http, fnv64
 from .proxy import L7Proxy, L7Record
+from .registry import L7Protocol, register
+from . import plugins  # noqa: F401 — registers cassandra/memcached
 
 __all__ = [
+    "L7Protocol",
+    "register",
     "L7PolicyTensors",
     "METHOD_IDS",
     "compile_l7",

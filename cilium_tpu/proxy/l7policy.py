@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..policy.api import L7Rules
+from .registry import get as registry_get
 from .featurize import (
     KAFKA_API_IDS,
     KIND_DNS,
@@ -138,6 +139,23 @@ def compile_l7(redirects: Sequence[Tuple[int, str, L7Rules]]
             c_lo, c_hi = fnv64(client)
             rows.append([port, KIND_KAFKA, api_id,
                          t_lo, t_hi, c_lo, c_hi])
+        # plugin protocols (registry.py): each rule compiles to a row
+        # of the SAME tensor or a host matcher — no per-protocol code
+        # here.  Rules for an UNREGISTERED parser compile to nothing,
+        # which under L7 default deny means such requests are denied
+        # (the reference fails policy push when the parser is missing).
+        for name, extra_rules in getattr(l7, "extra", ()):
+            plugin = registry_get(name)
+            if plugin is None:
+                continue
+            for rule in extra_rules:
+                what, val = plugin.compile_rule(rule)
+                if what == "row":
+                    m, f0l, f0h, f1l, f1h = val
+                    rows.append([port, plugin.kind, m,
+                                 f0l, f0h, f1l, f1h])
+                else:
+                    host_matchers.setdefault(port, []).append(val)
 
     rules = (np.asarray(rows, dtype=np.uint32) if rows
              else np.zeros((0, R_COLS), dtype=np.uint32))

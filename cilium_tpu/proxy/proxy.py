@@ -108,6 +108,8 @@ class L7Proxy:
             "http-rules": len(l7.http),
             "dns-rules": len(l7.dns),
             "kafka-rules": len(l7.kafka),
+            **{f"{name}-rules": len(rules)
+               for name, rules in getattr(l7, "extra", ())},
         } for port, l7 in sorted(by_port.items())]
 
     # -- request paths ------------------------------------------------
@@ -160,6 +162,29 @@ class L7Proxy:
                 method=req.get("method", ""), path=req.get("path", ""),
                 host=req.get("host", ""),
                 status=200 if allow[i] else 403))
+        return allow
+
+    def handle(self, kind_name: str, port: int,
+               requests: Sequence[dict],
+               src_row: int = 0) -> np.ndarray:
+        """Verdict requests of a PLUGIN protocol (registry.py) — the
+        generic path a fourth parser rides without proxy edits."""
+        from . import registry
+
+        plugin = registry.get(kind_name)
+        if plugin is None:
+            raise KeyError(f"no L7 parser registered for {kind_name!r}")
+        rows, raw = plugin.featurize(requests, port, src_row)
+        allow = self._verdicts(rows, port, raw)
+        now = time.time()
+        self.requests_total += len(raw)
+        self.requests_denied += int((allow == 0).sum())
+        for i, req in enumerate(raw):
+            m, p = plugin.record_fields(req)
+            self._emit(L7Record(
+                kind=plugin.kind, verdict=int(allow[i]),
+                proxy_port=port, src_row=src_row, timestamp=now,
+                method=m, path=p))
         return allow
 
     def handle_kafka(self, port: int, requests: Sequence[dict],

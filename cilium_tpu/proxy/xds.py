@@ -1,0 +1,182 @@
+"""xDS-style policy push: the NPDS surface for an EXTERNAL proxy.
+
+Reference: upstream cilium embeds Envoy and pushes per-endpoint
+``cilium.NetworkPolicy`` resources over xDS (``pkg/envoy/xds/server.go``
+— state-of-the-world NetworkPolicyDiscoveryService with ACK/NACK
+version tracking).  This framework enforces L7 natively (SURVEY.md "no
+embedded proxy"), but a deployment fronted by a real Envoy still needs
+a push surface — THIS module is it: the same SotW protocol state
+machine (versioned snapshot, subscribe, ACK by version echo, NACK by
+error detail) over JSON-shaped resources that mirror the
+cilium.NetworkPolicy schema.  Transport: the discover() long-poll is
+transport-agnostic; serve_xds() wraps it in the same JSON-over-gRPC
+streaming used by the observer API.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+TYPE_URL = "type.googleapis.com/cilium.NetworkPolicy"
+
+
+def _l7rules_to_dict(l7) -> dict:
+    """L7Rules -> schema-shaped dict (the rules an external proxy must
+    enforce on the redirected port)."""
+    out: dict = {}
+    if l7.http:
+        out["http"] = [{
+            "method": h.method, "path": h.path, "host": h.host,
+            "headers": list(h.headers),
+        } for h in l7.http]
+    if l7.dns:
+        out["dns"] = [{
+            "matchName": d.match_name, "matchPattern": d.match_pattern,
+        } for d in l7.dns]
+    if l7.kafka:
+        out["kafka"] = [dict(k) for k in l7.kafka]
+    for name, rules in getattr(l7, "extra", ()):
+        out[name] = [dict(r) for r in rules]
+    return out
+
+
+def policy_resource(pol) -> dict:
+    """One resolved EndpointPolicy -> a cilium.NetworkPolicy-shaped
+    resource (per-direction policymap entries + per-port L7 rules)."""
+    def _entries(ms) -> list:
+        return [{
+            "identity": k.identity,
+            "proto": k.proto,
+            "dport_lo": k.dport_lo,
+            "dport_hi": k.dport_hi,
+            "verdict": e.verdict,
+            "proxy_port": e.proxy_port,
+            "derived_from": list(e.derived_from),
+        } for k, e in sorted(
+            ms.to_entries().items(),
+            key=lambda kv: (kv[0].identity, kv[0].proto,
+                            kv[0].dport_lo, kv[0].dport_hi))]
+
+    return {
+        "name": str(pol.subject_labels),
+        "policy_revision": pol.revision,
+        "ingress_enforcing": pol.ingress.enforcing,
+        "egress_enforcing": pol.egress.enforcing,
+        "ingress": _entries(pol.ingress),
+        "egress": _entries(pol.egress),
+        "l7": [{"proxy_port": port, "rule_label": label,
+                "rules": _l7rules_to_dict(l7)}
+               for port, label, l7 in pol.redirects],
+    }
+
+
+class XDSCache:
+    """State-of-the-world resource cache + subscription protocol.
+
+    ``discover(request)`` implements one round of the SotW protocol:
+    a request whose ``version_info`` equals the current version is an
+    ACK (block until the snapshot changes); a request carrying
+    ``error_detail`` is a NACK of that version (recorded, then block
+    the same way — the reference keeps serving the last ACKed version
+    and retries on the next change).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._change = threading.Condition(self._lock)
+        self._version = 0
+        self._resources: Dict[str, dict] = {}
+        self.nacks: List[Tuple[int, str]] = []  # (version, detail)
+
+    # -- producer side ------------------------------------------------
+    def set_resources(self, resources: Dict[str, dict]) -> int:
+        """Replace the snapshot; bumps the version only on change."""
+        with self._change:
+            if resources != self._resources:
+                self._resources = dict(resources)
+                self._version += 1
+                self._change.notify_all()
+            return self._version
+
+    def update_from_policies(self, policies: Sequence) -> int:
+        """EndpointManager attach hook: resolved policies -> snapshot
+        (wired exactly like L7Proxy.update)."""
+        return self.set_resources(
+            {str(p.subject_labels): policy_resource(p)
+             for p in policies})
+
+    # -- consumer side ------------------------------------------------
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def discover(self, request: Optional[dict] = None,
+                 timeout: Optional[float] = None) -> Optional[dict]:
+        """One DiscoveryRequest -> DiscoveryResponse (or None on
+        timeout while up to date)."""
+        request = request or {}
+        acked = int(request.get("version_info") or 0)
+        if request.get("error_detail"):
+            with self._lock:
+                self.nacks.append(
+                    (int(request.get("response_nonce") or 0),
+                     str(request["error_detail"])))
+        names = request.get("resource_names") or ()
+        with self._change:
+            if self._version == acked:
+                if not self._change.wait_for(
+                        lambda: self._version != acked, timeout):
+                    return None
+            resources = [r for n, r in sorted(self._resources.items())
+                         if not names or n in names]
+            return {
+                "version_info": str(self._version),
+                "type_url": request.get("type_url", TYPE_URL),
+                "nonce": str(self._version),
+                "resources": resources,
+            }
+
+
+def serve_xds(cache: XDSCache, address: str):
+    """Expose the cache as a JSON-over-gRPC stream (the observer API's
+    wire style): /cilium.NetworkPolicyDiscoveryService/
+    StreamNetworkPolicies is a bidirectional stream of
+    DiscoveryRequest -> DiscoveryResponse."""
+    import json
+    from concurrent import futures
+
+    import grpc
+
+    def _loads(b: bytes):
+        return json.loads(b.decode())
+
+    def _dumps(o) -> bytes:
+        return json.dumps(o).encode()
+
+    SERVICE = "cilium.NetworkPolicyDiscoveryService"
+
+    def stream(request_iterator, context):
+        for req in request_iterator:
+            # SotW: the client sends nothing further until it gets a
+            # response, so a quiet long-poll must RE-ARM with the same
+            # request — returning to request_iterator after a timeout
+            # would leave an idle subscriber watching nothing and
+            # enforcing stale policy forever
+            while context.is_active():
+                resp = cache.discover(req, timeout=5.0)
+                if resp is not None:
+                    yield resp
+                    break
+
+    handler = grpc.method_handlers_generic_handler(SERVICE, {
+        "StreamNetworkPolicies": grpc.stream_stream_rpc_method_handler(
+            stream, request_deserializer=_loads,
+            response_serializer=_dumps),
+    })
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+    server.add_generic_rpc_handlers((handler,))
+    server.add_insecure_port(address)
+    server.start()
+    return server

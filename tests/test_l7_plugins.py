@@ -1,0 +1,159 @@
+"""L7 protocol plugin registry: cassandra/memcached ride the generic
+seam (reference: proxylib plugin parsers — cassandra query_action/
+query_table, memcache command/key rules)."""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.policy.api import L7Rules
+from cilium_tpu.proxy import L7Proxy
+from cilium_tpu.proxy.plugins import parse_cql
+from cilium_tpu.proxy.registry import (L7Protocol, featurize_generic,
+                                       get, names, next_kind, register)
+
+
+def _proxy(rules_dict, port=11000):
+    l7 = L7Rules.from_dict(rules_dict)
+    proxy = L7Proxy()
+    proxy.update([type("P", (), {"redirects": [(port, "t", l7)]})()])
+    return proxy
+
+
+class TestCassandra:
+    def test_schema_key_rides_l7rules_extra(self):
+        l7 = L7Rules.from_dict({"cassandra": [
+            {"queryAction": "select", "queryTable": "ks.users"}]})
+        assert not l7.is_empty
+        assert l7.extra_by_name["cassandra"][0]["queryAction"] == "select"
+
+    def test_exact_action_table_verdicts(self):
+        proxy = _proxy({"cassandra": [
+            {"queryAction": "select", "queryTable": "ks.users"},
+            {"queryAction": "insert", "queryTable": "ks.audit"},
+        ]})
+        allow = proxy.handle("cassandra", 11000, [
+            {"action": "select", "table": "ks.users"},   # rule 1
+            {"action": "insert", "table": "ks.audit"},   # rule 2
+            {"action": "select", "table": "ks.secrets"}, # no rule
+            {"action": "drop-table", "table": "ks.users"},  # no rule
+        ])
+        assert allow.tolist() == [1, 1, 0, 0]
+
+    def test_query_strings_parse_and_verdict(self):
+        proxy = _proxy({"cassandra": [
+            {"queryAction": "select", "queryTable": "ks.users"}]})
+        allow = proxy.handle("cassandra", 11000, [
+            {"query": "SELECT name FROM ks.users WHERE id = 1"},
+            {"query": "DELETE FROM ks.users WHERE id = 1"},
+        ])
+        assert allow.tolist() == [1, 0]
+
+    def test_regex_table_takes_host_fallback(self):
+        proxy = _proxy({"cassandra": [
+            {"queryAction": "select", "queryTable": "ks\\.(users|posts)"}]})
+        allow = proxy.handle("cassandra", 11000, [
+            {"action": "select", "table": "ks.posts"},
+            {"action": "select", "table": "ks.secrets"},
+        ])
+        assert allow.tolist() == [1, 0]
+        assert proxy.host_fallback_checked > 0
+
+    def test_parse_cql(self):
+        assert parse_cql("INSERT INTO ks.t (a) VALUES (1)") == {
+            "action": "insert", "table": "ks.t"}
+        assert parse_cql("UPDATE ks.t SET a = 1") == {
+            "action": "update", "table": "ks.t"}
+        assert parse_cql("") == {}
+
+
+class TestMemcached:
+    def test_command_and_exact_key(self):
+        proxy = _proxy({"memcached": [
+            {"command": "get", "keyExact": "session/1"}]})
+        allow = proxy.handle("memcached", 11000, [
+            {"command": "get", "key": "session/1"},
+            {"command": "set", "key": "session/1"},
+            {"command": "get", "key": "session/2"},
+        ])
+        assert allow.tolist() == [1, 0, 0]
+
+    def test_key_prefix_fallback(self):
+        proxy = _proxy({"memcached": [
+            {"command": "get", "keyPrefix": "public/"}]})
+        allow = proxy.handle("memcached", 11000, [
+            {"command": "get", "key": "public/motd"},
+            {"command": "get", "key": "private/motd"},
+        ])
+        assert allow.tolist() == [1, 0]
+
+
+class TestRegistrySeam:
+    def test_builtin_plugins_registered(self):
+        assert {"cassandra", "memcached"} <= set(names())
+
+    def test_fourth_protocol_needs_only_registration(self):
+        # a toy "redis"-ish protocol defined ENTIRELY here: commands +
+        # key, no edits to featurize/l7policy/proxy
+        kind = next_kind()
+        cmds = {"get": 1, "set": 2}
+        proto = register(L7Protocol(
+            name="toyredis", kind=kind,
+            featurize=lambda reqs, port, src_row=0: featurize_generic(
+                kind, reqs, port, src_row,
+                method_of=lambda r: cmds.get(r.get("cmd", ""), 0),
+                f0_of=lambda r: r.get("key", "")),
+            compile_rule=lambda rule: (
+                "row", [cmds.get(rule.get("cmd", ""), 0),
+                        *__import__("cilium_tpu.proxy.featurize",
+                                    fromlist=["fnv64"]).fnv64(
+                            rule.get("key", "")), 0, 0]),
+        ))
+        assert get("toyredis") is proto
+        proxy = _proxy({"toyredis": [{"cmd": "get", "key": "k1"}]})
+        allow = proxy.handle("toyredis", 11000, [
+            {"cmd": "get", "key": "k1"},
+            {"cmd": "set", "key": "k1"},
+        ])
+        assert allow.tolist() == [1, 0]
+
+    def test_conflicting_kind_rejected(self):
+        with pytest.raises(ValueError):
+            register(L7Protocol(
+                name="clasher", kind=16,  # cassandra's kind
+                featurize=lambda *a: None,
+                compile_rule=lambda r: ("row", [0, 0, 0, 0, 0])))
+
+    def test_unregistered_protocol_rules_mean_default_deny(self):
+        proxy = _proxy({"nosuchproto": [{"anything": "x"}]})
+        with pytest.raises(KeyError):
+            proxy.handle("nosuchproto", 11000, [{"x": 1}])
+
+    def test_access_records_carry_plugin_fields(self):
+        records = []
+        proxy = _proxy({"memcached": [
+            {"command": "get", "keyExact": "k"}]})
+        proxy.on_record(records.append)
+        proxy.handle("memcached", 11000, [{"command": "get", "key": "k"}])
+        [rec] = records
+        assert rec.method == "get" and rec.path == "k"
+        assert rec.verdict == 1
+
+
+class TestUpstreamL7ProtoSchema:
+    def test_l7proto_key_maps_to_plugin(self):
+        """Review r04: the upstream api.PortRuleL7 spelling
+        ({l7proto, l7}) must reach the registered parser."""
+        l7 = L7Rules.from_dict({"l7proto": "cassandra",
+                                "l7": [{"queryAction": "select",
+                                        "queryTable": "ks.users"}]})
+        assert l7.extra_by_name["cassandra"][0]["queryTable"] == "ks.users"
+        proxy = _proxy({"l7proto": "memcached",
+                        "l7": [{"command": "get", "keyExact": "k"}]})
+        allow = proxy.handle("memcached", 11000,
+                             [{"command": "get", "key": "k"},
+                              {"command": "set", "key": "k"}])
+        assert allow.tolist() == [1, 0]
+
+    def test_non_list_rules_rejected_clearly(self):
+        with pytest.raises(ValueError, match="must be a list"):
+            L7Rules.from_dict({"cassandra": "select"})
