@@ -53,10 +53,44 @@ def _parse_l4(proto: int, payload: bytes) -> Tuple[int, int, int]:
     return 0, 0, 0
 
 
-def _parse_ip_one(pkt: bytes
+class FragTracker:
+    """IPv4 fragment association (reference: the datapath fragmap,
+    ``bpf/lib/ipv4.h ipv4_handle_fragmentation`` + ``pkg/maps/fragmap``).
+
+    The first fragment of a datagram carries the L4 header; later
+    fragments don't — without tracking they'd parse with garbage
+    ports.  The first fragment records (src, dst, proto, ipid) ->
+    l4-prefix; mid-fragments resolve through it; a miss is a skip
+    (upstream: DROP_FRAG_NOT_FOUND).  Bounded FIFO like the
+    reference's LRU fragmap."""
+
+    def __init__(self, capacity: int = 4096):
+        self.capacity = capacity
+        self._map: dict = {}
+
+    def record(self, key: tuple, l4_prefix: bytes) -> None:
+        if key not in self._map and len(self._map) >= self.capacity:
+            self._map.pop(next(iter(self._map)))  # FIFO evict
+        self._map[key] = l4_prefix
+
+    def lookup(self, key: tuple) -> Optional[bytes]:
+        return self._map.get(key)
+
+
+# module-level tracker: fragments of one datagram may straddle parse
+# calls (the kernel fragmap is long-lived for the same reason)
+_FRAGS = FragTracker()
+
+
+def _parse_ip_one(pkt: bytes, frags=None
                   ) -> Optional[Tuple[int, bytes, bytes, int, bytes, int]]:
     """Parse ONE IP header (no decap) -> (family, src16, dst16, proto,
-    l4payload, ip_total_len)."""
+    l4payload, ip_total_len).  IPv4 fragments resolve their L4 ports
+    through the fragment tracker; an unresolvable mid-fragment returns
+    None (parse-stage drop).  ``frags=False`` disables fragment
+    tracking entirely — REQUIRED for ICMP-quoted inner headers, which
+    are attacker-controlled bytes: recording them would let a forged
+    ICMP error poison the tracker with chosen ports."""
     if len(pkt) < 20:
         return None
     ver = pkt[0] >> 4
@@ -68,7 +102,22 @@ def _parse_ip_one(pkt: bytes
         total = struct.unpack_from("!H", pkt, 2)[0]
         src = b"\x00" * 12 + pkt[12:16]
         dst = b"\x00" * 12 + pkt[16:20]
-        return 4, src, dst, proto, pkt[ihl:], total
+        l4 = pkt[ihl:]
+        fo_field = struct.unpack_from("!H", pkt, 6)[0]
+        frag_off = fo_field & 0x1FFF
+        more = bool(fo_field & 0x2000)
+        if (frag_off or more) and proto in (6, 17, 132) \
+                and frags is not False:
+            frags = frags if frags is not None else _FRAGS
+            key = (pkt[12:16], pkt[16:20], proto, pkt[4:6])
+            if frag_off == 0:  # first fragment: carries the L4 header
+                frags.record(key, l4[:8])
+            else:  # mid/last fragment: no L4 header on the wire
+                prefix = frags.lookup(key)
+                if prefix is None:
+                    return None  # DROP_FRAG_NOT_FOUND analogue
+                l4 = prefix
+        return 4, src, dst, proto, l4, total
     if ver == 6 and len(pkt) >= 40:
         proto = pkt[6]
         payload_len = struct.unpack_from("!H", pkt, 4)[0]
@@ -121,7 +170,10 @@ def _related_tuple(fam: int, proto: int, l4: bytes):
     if not ((proto == 1 and t in _ICMP4_ERRORS)
             or (proto == 58 and t in _ICMP6_ERRORS)):
         return None
-    inner = _parse_ip_one(l4[8:])
+    # frags=False: the quoted header is attacker-controlled — fragment
+    # tracking on it would be a poisoning vector (and the native parser
+    # likewise parses quoted headers without fragment logic)
+    inner = _parse_ip_one(l4[8:], frags=False)
     if inner is None:
         return None
     ifam, isrc, idst, iproto, il4, _ = inner

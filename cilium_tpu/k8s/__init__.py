@@ -14,8 +14,9 @@ Namespace semantics (mirroring ParseToCiliumRule):
   ``k8s:io.kubernetes.pod.namespace=<ns>`` unless it already
   constrains the namespace;
 - ``fromEndpoints``/``toEndpoints`` selectors likewise default to the
-  policy's namespace unless they name one or match cluster-wide
-  (``NamespaceSelector`` is out of scope — documented divergence);
+  policy's namespace unless they name one, carry a
+  ``namespaceSelector`` (compiled to namespace-label matches — see
+  ``_selector_in_namespace``), or already match namespace labels;
 - every derived rule carries identity labels
   ``k8s:io.cilium.k8s.policy.name/namespace/uid`` so delete-by-labels
   removes exactly this CNP's rules.
@@ -28,6 +29,10 @@ from typing import Dict, List, Optional
 from ..policy.api import Rule, rule_from_dict
 
 NS_LABEL = "io.kubernetes.pod.namespace"
+# namespace OBJECT labels folded into pod identities (reference:
+# k8s.GetPodMetadata + policy.JoinPath) — what namespaceSelector
+# peers compile down to
+NS_LABELS_PREFIX = "io.cilium.k8s.namespace.labels."
 POLICY_NAME_LABEL = "k8s:io.cilium.k8s.policy.name"
 POLICY_NS_LABEL = "k8s:io.cilium.k8s.policy.namespace"
 POLICY_UID_LABEL = "k8s:io.cilium.k8s.policy.uid"
@@ -35,12 +40,33 @@ POLICY_UID_LABEL = "k8s:io.cilium.k8s.policy.uid"
 
 def _selector_in_namespace(sel: Optional[dict], ns: str) -> dict:
     """Scope a (possibly empty) selector dict to the namespace unless
-    it already constrains it."""
+    it already constrains it.
+
+    A ``namespaceSelector`` key (k8s NetworkPolicyPeer style) compiles
+    to ``k8s:io.cilium.k8s.namespace.labels.<key>`` matches — the
+    labels the pod watcher folds in from Namespace objects — and lifts
+    the default same-namespace scoping (reference:
+    parseNetworkPolicyPeer's namespaceSelector handling)."""
     sel = dict(sel or {})
     ml = dict(sel.get("matchLabels") or {})
     me = list(sel.get("matchExpressions") or ())
-    constrained = any(k.split(":", 1)[-1] == NS_LABEL for k in ml) or any(
-        e.get("key", "").split(":", 1)[-1] == NS_LABEL for e in me)
+    nssel = sel.get("namespaceSelector")
+    ns_constrained = nssel is not None
+    if nssel:
+        for k, v in (nssel.get("matchLabels") or {}).items():
+            ml[f"k8s:{NS_LABELS_PREFIX}{k}"] = v
+        for e in nssel.get("matchExpressions") or ():
+            e = dict(e)
+            e["key"] = f"k8s:{NS_LABELS_PREFIX}{e.get('key', '')}"
+            me.append(e)
+
+    def _ns_key(k: str) -> bool:
+        bare = k.split(":", 1)[-1]
+        return bare == NS_LABEL or bare.startswith(NS_LABELS_PREFIX)
+
+    constrained = (ns_constrained
+                   or any(_ns_key(k) for k in ml)
+                   or any(_ns_key(e.get("key", "")) for e in me))
     if not constrained:
         ml[f"k8s:{NS_LABEL}"] = ns
     out: dict = {}

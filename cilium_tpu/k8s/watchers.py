@@ -28,7 +28,7 @@ import json
 from typing import Dict, List, Optional, Tuple
 
 from ..labels import LabelSet
-from . import NS_LABEL
+from . import NS_LABEL, NS_LABELS_PREFIX
 
 _PROTO_NUM = {"TCP": 6, "UDP": 17, "SCTP": 132}
 
@@ -128,13 +128,19 @@ class ServiceWatcher:
         return sorted(out)
 
 
-def pod_labels(obj: dict) -> List[str]:
+def pod_labels(obj: dict,
+               ns_labels: Optional[Dict[str, str]] = None) -> List[str]:
     """Pod metadata labels -> cilium identity labels (``k8s:`` source
-    + the namespace label, reference: k8s.GetPodMetadata)."""
+    + the namespace label + the NAMESPACE's own labels under the
+    ``io.cilium.k8s.namespace.labels.`` prefix, reference:
+    k8s.GetPodMetadata — that prefix is what namespaceSelector peers
+    compile to)."""
     meta = obj.get("metadata") or {}
     ns = meta.get("namespace", "default")
     out = [f"k8s:{k}={v}" for k, v in (meta.get("labels") or {}).items()]
     out.append(f"k8s:{NS_LABEL}={ns}")
+    for k, v in (ns_labels or {}).items():
+        out.append(f"k8s:{NS_LABELS_PREFIX}{k}={v}")
     return sorted(out)
 
 
@@ -146,11 +152,14 @@ class PodWatcher:
     re-registers the endpoint (identity change = new endpoint policy,
     like upstream's UpdateLabels regeneration)."""
 
-    def __init__(self, daemon, node_name: Optional[str] = None):
+    def __init__(self, daemon, node_name: Optional[str] = None,
+                 namespaces: Optional["NamespaceWatcher"] = None):
         self.daemon = daemon
         self.node_name = node_name or daemon.config.node_name
+        self.namespaces = namespaces
         self._eps: Dict[str, int] = {}  # ns/name -> endpoint id
         self._sig: Dict[str, tuple] = {}  # ns/name -> (labels,ips,ports)
+        self._objs: Dict[str, dict] = {}  # ns/name -> last pod object
 
     def _pod_ips(self, obj: dict) -> Tuple[str, ...]:
         st = obj.get("status") or {}
@@ -175,7 +184,10 @@ class PodWatcher:
         ips = self._pod_ips(obj)
         if not ips:
             return None  # not yet scheduled/IP'd; a later update fires
-        labels = pod_labels(obj)
+        ns = (obj.get("metadata") or {}).get("namespace", "default")
+        ns_labels = (self.namespaces.labels_of(ns)
+                     if self.namespaces else None)
+        labels = pod_labels(obj, ns_labels)
         ports = self._named_ports(obj)
         # idempotency covers EVERYTHING the endpoint derives from the
         # pod: an IP change (sandbox restart) or port change with
@@ -189,6 +201,7 @@ class PodWatcher:
                                       named_ports=ports)
         self._eps[key] = ep.id
         self._sig[key] = sig
+        self._objs[key] = obj
         return ep.id
 
     on_update = on_add
@@ -197,9 +210,52 @@ class PodWatcher:
         key = _meta_key(obj)
         ep_id = self._eps.pop(key, None)
         self._sig.pop(key, None)
+        self._objs.pop(key, None)
         if ep_id is None:
             return False
         return self.daemon.endpoints.remove(ep_id)
+
+    def reregister_namespace(self, ns: str) -> int:
+        """Namespace labels changed: replay every known pod of that
+        namespace so identities pick up the new
+        ``io.cilium.k8s.namespace.labels.*`` set."""
+        n = 0
+        for key, obj in list(self._objs.items()):
+            if key.split("/", 1)[0] == ns:
+                self.on_add(obj)
+                n += 1
+        return n
+
+
+class NamespaceWatcher:
+    """Namespace objects -> namespace-label registry (reference:
+    pkg/k8s watcher for Namespace; upstream folds namespace labels
+    into pod identity labels under ``io.cilium.k8s.namespace.labels.``
+    so namespaceSelector peers can match them)."""
+
+    def __init__(self, pods: Optional[PodWatcher] = None):
+        self.pods = pods
+        self._labels: Dict[str, Dict[str, str]] = {}
+
+    def labels_of(self, ns: str) -> Dict[str, str]:
+        return self._labels.get(ns, {})
+
+    def on_add(self, obj: dict):
+        meta = obj.get("metadata") or {}
+        name = meta.get("name", "")
+        labels = dict(meta.get("labels") or {})
+        if self._labels.get(name) == labels:
+            return
+        self._labels[name] = labels
+        if self.pods is not None:
+            self.pods.reregister_namespace(name)
+
+    on_update = on_add
+
+    def on_delete(self, obj: dict):
+        name = (obj.get("metadata") or {}).get("name", "")
+        if self._labels.pop(name, None) is not None and self.pods:
+            self.pods.reregister_namespace(name)
 
 
 class CiliumIdentityWatcher:
@@ -365,6 +421,8 @@ class K8sWatcherHub:
         self.cnp = CNPWatcher(daemon.repo)
         self.services = ServiceWatcher(daemon.services)
         self.pods = PodWatcher(daemon)
+        self.namespaces = NamespaceWatcher(self.pods)
+        self.pods.namespaces = self.namespaces
         self.identities = CiliumIdentityWatcher(daemon.allocator)
         self.ceps = CiliumEndpointWatcher(daemon)
         self.nodes = CiliumNodeWatcher(daemon.kvstore)
@@ -374,6 +432,7 @@ class K8sWatcherHub:
             "Service": _Renamed(self.services, "service"),
             "Endpoints": _Renamed(self.services, "endpoints"),
             "Pod": self.pods,
+            "Namespace": self.namespaces,
             "CiliumIdentity": self.identities,
             "CiliumEndpoint": self.ceps,
             "CiliumNode": self.nodes,

@@ -174,11 +174,23 @@ def parse_frames_packed_py(buf: bytes,
     FLAG_RELATED bit, and packing the embedded inner tuple as ordinary
     traffic would let a forged ICMP error refresh the original flow's
     CT entry)."""
+    import struct
+
     from ..core.packets import COL_FAMILY, pack_rows
 
+    # skipped counts every frame that produced no packed row — non-v4
+    # rows AND frames the wide parse dropped entirely (malformed,
+    # orphan mid-fragments) — matching the native counter exactly
+    n_frames, off = 0, 0
+    while off + 4 <= len(buf):
+        (flen,) = struct.unpack_from("<I", buf, off)
+        if off + 4 + flen > len(buf):
+            break
+        off += 4 + flen
+        n_frames += 1
     wide = parse_frames_py(buf, related=False)
     v4 = wide[wide[:, COL_FAMILY] == 4]
-    skipped = len(wide) - len(v4)
+    skipped = n_frames - len(v4)
     packed = pack_rows(v4)
     if out is None:
         return packed, len(v4), skipped

@@ -16,6 +16,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 
 namespace {
 
@@ -58,6 +59,85 @@ const uint8_t* decap_overlay(uint32_t proto, const uint8_t* l4,
     return eth + 14;
 }
 
+// --- IPv4 fragment tracking (reference: bpf/lib/ipv4.h
+// ipv4_handle_fragmentation + pkg/maps/fragmap).  The first fragment
+// records (src, dst, proto, ipid) -> its L4 prefix; later fragments
+// (which carry no L4 header) resolve ports through it; a miss is a
+// parse-stage drop (upstream: DROP_FRAG_NOT_FOUND).  Mirrors
+// core/pcap.py FragTracker.
+uint64_t fnv64_bytes(const uint8_t* p, int n) {
+    uint64_t h = 0xCBF29CE484222325ull;
+    for (int i = 0; i < n; ++i) h = (h ^ p[i]) * 0x100000001B3ull;
+    return h;
+}
+
+struct FragSlot {
+    uint64_t key;
+    uint8_t pre[8];
+    bool used;
+};
+constexpr int FRAG_CAP = 4096;
+FragSlot g_frags[FRAG_CAP];
+std::mutex g_frags_mu;
+
+inline uint64_t frag_key(const uint8_t* ip4) {
+    uint8_t kb[11];
+    std::memcpy(kb, ip4 + 12, 8);  // src + dst
+    kb[8] = ip4[9];                // proto
+    std::memcpy(kb + 9, ip4 + 4, 2);  // identification
+    return fnv64_bytes(kb, 11);
+}
+
+void frag_record(uint64_t key, const uint8_t* l4, long l4_len) {
+    std::lock_guard<std::mutex> lk(g_frags_mu);
+    const size_t h = size_t(key) % FRAG_CAP;
+    size_t slot = h;
+    for (int i = 0; i < 8; ++i) {
+        const size_t s = (h + i) % FRAG_CAP;
+        if (!g_frags[s].used || g_frags[s].key == key) { slot = s; break; }
+    }
+    g_frags[slot].key = key;
+    g_frags[slot].used = true;
+    std::memset(g_frags[slot].pre, 0, 8);
+    std::memcpy(g_frags[slot].pre, l4, l4_len < 8 ? l4_len : 8);
+}
+
+bool frag_lookup(uint64_t key, uint8_t* out8) {
+    std::lock_guard<std::mutex> lk(g_frags_mu);
+    const size_t h = size_t(key) % FRAG_CAP;
+    for (int i = 0; i < 8; ++i) {
+        const size_t s = (h + i) % FRAG_CAP;
+        if (g_frags[s].used && g_frags[s].key == key) {
+            std::memcpy(out8, g_frags[s].pre, 8);
+            return true;
+        }
+    }
+    return false;
+}
+
+// Resolve IPv4 fragmentation for one packet: returns false when the
+// packet is an unresolvable mid-fragment (drop).  On a resolved
+// mid-fragment, *l4 / *l4_len point at the recorded 8-byte prefix in
+// scratch8.
+bool resolve_fragment(const uint8_t* ip4, uint32_t proto,
+                      const uint8_t** l4, long* l4_len,
+                      uint8_t* scratch8) {
+    const uint16_t fo = be16(ip4 + 6);
+    const uint16_t frag_off = fo & 0x1FFF;
+    const bool more = fo & 0x2000;
+    if (!(frag_off || more)) return true;  // not fragmented
+    if (!(proto == 6 || proto == 17 || proto == 132)) return true;
+    const uint64_t key = frag_key(ip4);
+    if (frag_off == 0) {  // first fragment carries the L4 header
+        frag_record(key, *l4, *l4_len);
+        return true;
+    }
+    if (!frag_lookup(key, scratch8)) return false;  // FRAG_NOT_FOUND
+    *l4 = scratch8;
+    *l4_len = 8;
+    return true;
+}
+
 inline bool icmp_is_error(uint32_t proto, uint8_t type) {
     if (proto == 1)
         return type == 3 || type == 4 || type == 5 || type == 11 ||
@@ -88,6 +168,23 @@ bool parse_ip(const uint8_t* pkt, long len, uint32_t* row, uint32_t ep,
         row[7] = be32(pkt + 16);
         l4 = pkt + ihl;
         l4_len = len - ihl;
+        uint8_t scratch[8];
+        if (!resolve_fragment(pkt, proto, &l4, &l4_len, scratch))
+            return false;  // mid-fragment with no tracked first frag
+        if (l4 == scratch) {
+            // the prefix must outlive this frame's scope: parse ports
+            // now and short-circuit (a resolved mid-fragment is never
+            // an overlay or an ICMP error)
+            row[8] = be16(scratch);
+            row[9] = be16(scratch + 2);
+            row[10] = proto;
+            row[11] = 0;  // no TCP flags on a headerless fragment
+            row[12] = ip_len;
+            row[13] = fam;
+            row[14] = ep;
+            row[15] = dir;
+            return true;
+        }
     } else if (ver == 6 && len >= 40) {
         proto = pkt[6];
         ip_len = 40 + be16(pkt + 4);
@@ -302,6 +399,14 @@ long parse_frames_packed(const uint8_t* buf, long buf_len, uint32_t* out,
         uint32_t proto = p[9];
         const uint8_t* l4 = p + ihl;
         long l4_len = ip_len - ihl;
+        // fragment resolution BEFORE decap (matches the Python
+        // ordering: a mid-fragment's synthesized 8-byte prefix can
+        // never satisfy the decap length checks)
+        uint8_t fscratch[8];
+        if (!resolve_fragment(p, proto, &l4, &l4_len, fscratch)) {
+            ++skipped;  // mid-fragment with no tracked first fragment
+            continue;
+        }
         // overlay decap (v4-in-v4 only on the fast path; depth 2 to
         // match the wide/Python parsers)
         bool drop = false;
@@ -314,13 +419,24 @@ long parse_frames_packed(const uint8_t* buf, long buf_len, uint32_t* out,
                 drop = true;  // v6-in-v4 overlay: wide path only
                 break;
             }
+            const int iihl = (inner[0] & 0xF) * 4;
+            if (inner_len < iihl || iihl < 20) { drop = true; break; }
+            const uint32_t iproto = inner[9];
+            const uint8_t* il4 = inner + iihl;
+            long il4_len = inner_len - iihl;
+            // inner fragments resolve like outer ones (the Python
+            // fallback runs the same logic on the decapped header);
+            // an UNRESOLVABLE inner mid-fragment keeps the OUTER row,
+            // matching _parse_ip's fallback-to-outer
+            if (!resolve_fragment(inner, iproto, &il4, &il4_len,
+                                  fscratch))
+                break;
             p = inner;
             ip_len = inner_len;
-            ihl = (p[0] & 0xF) * 4;
-            if (ip_len < ihl || ihl < 20) { drop = true; break; }
-            proto = p[9];
-            l4 = p + ihl;
-            l4_len = ip_len - ihl;
+            ihl = iihl;
+            proto = iproto;
+            l4 = il4;
+            l4_len = il4_len;
         }
         if (drop) { ++skipped; continue; }
         // overflow is counted only AFTER full validation so it counts

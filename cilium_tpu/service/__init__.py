@@ -48,15 +48,31 @@ def _fnv1a64(data: bytes) -> int:
     return h
 
 
-def maglev_table(backend_keys: Sequence[str], m: int = M_DEFAULT
-                 ) -> np.ndarray:
+def maglev_table(backend_keys: Sequence[str], m: int = M_DEFAULT,
+                 weights: Optional[Sequence[int]] = None) -> np.ndarray:
     """The classic Maglev population: each backend walks its own
     permutation (offset + j*skip mod m) claiming free slots round-
     robin until the table is full.  [m] int32 of backend indices;
-    all -1 when there are no backends."""
+    all -1 when there are no backends.
+
+    ``weights`` (Maglev paper §3.4 / upstream's weighted
+    ``bpf-lb-maglev``): per sweep, a backend claims a slot only while
+    its claim count is at or below its quota ``filled * w_i / sum(w)``
+    — slot share converges to w/Σw for ANY weight magnitudes (claiming
+    w_i consecutive turns instead would let one large-weight backend
+    fill the whole table before the next ever claimed).  Weight 0
+    backends take no slots (drained)."""
     n = len(backend_keys)
     if n == 0:
         return np.full(m, -1, dtype=np.int32)
+    w = (np.ones(n, dtype=np.int64) if weights is None
+         else np.asarray(list(weights), dtype=np.int64))
+    if len(w) != n:
+        raise ValueError("weights length != backends length")
+    if (w < 0).any():
+        raise ValueError("negative backend weight")
+    if not w.any():
+        return np.full(m, -1, dtype=np.int32)  # all drained
     offsets = np.empty(n, dtype=np.int64)
     skips = np.empty(n, dtype=np.int64)
     for i, key in enumerate(backend_keys):
@@ -65,15 +81,23 @@ def maglev_table(backend_keys: Sequence[str], m: int = M_DEFAULT
         skips[i] = _fnv1a64(kb + b"skip") % (m - 1) + 1
     table = np.full(m, -1, dtype=np.int32)
     next_j = np.zeros(n, dtype=np.int64)
+    claims = np.zeros(n, dtype=np.int64)
+    total_w = int(w.sum())
     filled = 0
+    # every sweep makes progress: if no backend were behind quota,
+    # summing claims[i]*total_w > filled*w[i] over i gives the
+    # contradiction filled*total_w > filled*total_w
     while filled < m:
         for i in range(n):
+            if w[i] == 0 or claims[i] * total_w > filled * w[i]:
+                continue  # at/above quota this sweep
             # advance backend i's permutation to its next free slot
             while True:
                 slot = (offsets[i] + next_j[i] * skips[i]) % m
                 next_j[i] += 1
                 if table[slot] < 0:
                     table[slot] = i
+                    claims[i] += 1
                     filled += 1
                     break
             if filled == m:
@@ -85,7 +109,7 @@ def maglev_table(backend_keys: Sequence[str], m: int = M_DEFAULT
 class Backend:
     ip: str
     port: int
-    weight: int = 1  # schema-level; Maglev weighting not implemented
+    weight: int = 1  # weighted Maglev fill turns (0 = drained)
 
     @property
     def key(self) -> str:
@@ -143,15 +167,22 @@ class ServiceManager:
         self._tensors: Optional[LBTensors] = None
 
     def upsert(self, name: str, frontend: str, backends: Sequence[str],
-               protocol: int = 6) -> Service:
-        """``frontend``/``backends`` are "ip:port" strings."""
+               protocol: int = 6,
+               weights: Optional[Sequence[int]] = None) -> Service:
+        """``frontend``/``backends`` are "ip:port" strings;
+        ``weights`` (optional, parallel to ``backends``) drive the
+        weighted Maglev fill."""
         fip, fport = frontend.rsplit(":", 1)
+        if weights is not None and len(weights) != len(backends):
+            raise ValueError("weights length != backends length")
         svc = Service(name=name, frontend_ip=fip,
                       frontend_port=int(fport), protocol=protocol,
                       backends=[
                           Backend(b.rsplit(":", 1)[0],
-                                  int(b.rsplit(":", 1)[1]))
-                          for b in backends])
+                                  int(b.rsplit(":", 1)[1]),
+                                  weight=(int(weights[i])
+                                          if weights is not None else 1))
+                          for i, b in enumerate(backends)])
         with self._lock:
             self._services[name] = svc
             self._tensors = None
@@ -195,7 +226,9 @@ class ServiceManager:
             for be in svc.backends:
                 b_ip.append(int(ipaddress.IPv4Address(be.ip)))
                 b_port.append(be.port)
-            local = maglev_table([be.key for be in svc.backends], self.m)
+            local = maglev_table([be.key for be in svc.backends], self.m,
+                                 weights=[be.weight
+                                          for be in svc.backends])
             maglev[i] = np.where(local >= 0, local + base, -1)
         if not b_ip:
             b_ip, b_port = [0], [0]

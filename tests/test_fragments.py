@@ -1,0 +1,161 @@
+"""IPv4 fragment tracking (reference: bpf/lib/ipv4.h fragment
+handling + pkg/maps/fragmap): the first fragment records its L4
+header; mid-fragments resolve ports through the tracker; an orphan
+mid-fragment drops (DROP_FRAG_NOT_FOUND)."""
+
+import struct
+
+import numpy as np
+
+from cilium_tpu import native
+from cilium_tpu.core.packets import (
+    COL_DPORT,
+    COL_FLAGS,
+    COL_PROTO,
+    COL_SPORT,
+    TCP_ACK,
+    pack_rows,
+)
+
+
+def _ipv4(src, dst, proto, payload, ipid=0, frag_off=0, mf=False):
+    fo = (frag_off & 0x1FFF) | (0x2000 if mf else 0)
+    total = 20 + len(payload)
+    hdr = struct.pack("!BBHHHBBH4s4s", 0x45, 0, total, ipid, fo, 64,
+                      proto, 0, bytes(src), bytes(dst))
+    return hdr + payload
+
+
+def _tcp(sport, dport, flags=TCP_ACK):
+    return struct.pack("!HHIIBBHHH", sport, dport, 0, 0, 0x50, flags,
+                       65535, 0, 0)
+
+
+def _eth(inner):
+    return b"\x00" * 12 + struct.pack("!H", 0x0800) + inner
+
+
+def _frames(*frames):
+    return b"".join(struct.pack("<I", len(f)) + f for f in frames)
+
+
+A = bytes([10, 7, 1, 1])
+B = bytes([10, 7, 2, 1])
+
+
+class TestFragmentTracking:
+    def test_mid_fragment_inherits_first_fragment_ports(self):
+        first = _ipv4(A, B, 6, _tcp(41000, 5432), ipid=0x1234, mf=True)
+        mid = _ipv4(A, B, 6, b"\x00" * 32, ipid=0x1234, frag_off=185)
+        buf = _frames(_eth(first), _eth(mid))
+        rows = native.parse_frames_py(buf)
+        assert rows.shape[0] == 2
+        # both rows carry the flow's ports — the mid-fragment resolved
+        # through the tracker despite having no L4 header on the wire
+        assert list(rows[:, COL_SPORT]) == [41000, 41000]
+        assert list(rows[:, COL_DPORT]) == [5432, 5432]
+        assert rows[1, COL_FLAGS] == 0  # no TCP flags on a fragment
+
+    def test_native_parser_agrees(self):
+        first = _ipv4(A, B, 6, _tcp(42000, 443), ipid=0x77, mf=True)
+        mid = _ipv4(A, B, 6, b"\x00" * 16, ipid=0x77, frag_off=3)
+        orphan = _ipv4(A, B, 17, b"\x00" * 16, ipid=0x78, frag_off=3)
+        buf = _frames(_eth(first), _eth(mid), _eth(orphan))
+        py = native.parse_frames_py(buf)
+        nat = native.parse_frames(buf)
+        if nat is not None:
+            np.testing.assert_array_equal(np.asarray(nat), py)
+        assert py.shape[0] == 2  # the orphan dropped
+
+    def test_orphan_mid_fragment_drops(self):
+        orphan = _ipv4(A, B, 6, b"\x00" * 16, ipid=0x9999, frag_off=5)
+        rows = native.parse_frames_py(_frames(_eth(orphan)))
+        assert rows.shape[0] == 0
+
+    def test_packed_parser_resolves_fragments(self):
+        first = _ipv4(A, B, 6, _tcp(43000, 80), ipid=0x55, mf=True)
+        mid = _ipv4(A, B, 6, b"\x00" * 24, ipid=0x55, frag_off=4)
+        orphan = _ipv4(A, B, 6, b"\x00" * 24, ipid=0x56, frag_off=4)
+        buf = _frames(_eth(first), _eth(mid), _eth(orphan))
+        py_rows, py_n, py_sk = native.parse_frames_packed_py(buf)
+        assert py_n == 2 and py_sk == 1
+        ports = np.asarray(py_rows)[:2, 2]
+        assert list(ports >> 16) == [43000, 43000]
+        assert list(ports & 0xFFFF) == [80, 80]
+        if native.available():
+            nat_rows, n, sk = native.parse_frames_packed(buf)
+            assert (n, sk) == (py_n, py_sk)
+            np.testing.assert_array_equal(np.asarray(nat_rows)[:n],
+                                          np.asarray(py_rows)[:py_n])
+
+    def test_fragments_straddle_parse_calls(self):
+        first = _ipv4(A, B, 6, _tcp(44000, 8080), ipid=0xAB, mf=True)
+        native.parse_frames_py(_frames(_eth(first)))
+        mid = _ipv4(A, B, 6, b"\x00" * 8, ipid=0xAB, frag_off=2)
+        rows = native.parse_frames_py(_frames(_eth(mid)))
+        assert rows.shape[0] == 1 and rows[0, COL_SPORT] == 44000
+
+    def test_different_ipid_does_not_alias(self):
+        f1 = _ipv4(A, B, 6, _tcp(45000, 80), ipid=1, mf=True)
+        f2 = _ipv4(A, B, 6, _tcp(46000, 81), ipid=2, mf=True)
+        m1 = _ipv4(A, B, 6, b"\x00" * 8, ipid=1, frag_off=2)
+        m2 = _ipv4(A, B, 6, b"\x00" * 8, ipid=2, frag_off=2)
+        rows = native.parse_frames_py(_frames(_eth(f1), _eth(f2),
+                                              _eth(m1), _eth(m2)))
+        assert list(rows[:, COL_SPORT]) == [45000, 46000, 45000, 46000]
+
+
+class TestFragmentPoisoning:
+    def test_icmp_quoted_header_cannot_poison_tracker(self):
+        """Review r04: a forged ICMP error quoting a fake first
+        fragment must NOT record attacker ports into the tracker."""
+        from cilium_tpu.core.pcap import _FRAGS
+
+        victim_src, victim_dst = bytes([10, 7, 3, 1]), bytes([10, 7, 4, 1])
+        # attacker's ICMP error quotes a FIRST-fragment header for the
+        # victim's datagram id with chosen ports 6666->7777
+        quoted = _ipv4(victim_src, victim_dst, 6,
+                       _tcp(6666, 7777), ipid=0xBEEF, mf=True)
+        icmp = struct.pack("!BBHI", 3, 0, 0, 0) + quoted
+        err = _ipv4(bytes([10, 9, 9, 9]), victim_src, 1, icmp)
+        native.parse_frames_py(_frames(_eth(err)))
+        key = (victim_src, victim_dst, 6,
+               struct.pack("!H", 0xBEEF))
+        assert _FRAGS.lookup(key) is None  # nothing recorded
+        # the victim's real mid-fragment therefore DROPS (no tracked
+        # first fragment) instead of resolving to attacker ports
+        mid = _ipv4(victim_src, victim_dst, 6, b"\x00" * 16,
+                    ipid=0xBEEF, frag_off=2)
+        rows = native.parse_frames_py(_frames(_eth(mid)))
+        assert rows.shape[0] == 0
+
+    def test_inner_fragment_resolution_packed_matches_python(self):
+        """Review r04: decapped INNER fragments must resolve on the
+        packed fast path too (and an unresolvable inner mid-fragment
+        falls back to the outer row, both parsers)."""
+        from cilium_tpu.core.packets import VXLAN_PORT
+
+        def vxlan(inner):
+            payload = struct.pack("!II", 0x08000000, 42 << 8) + _eth(inner)
+            udp = struct.pack("!HHHH", 51000, VXLAN_PORT,
+                              8 + len(payload), 0) + payload
+            return _ipv4(bytes([192, 168, 5, 1]), bytes([192, 168, 5, 2]),
+                         17, udp)
+
+        first = _ipv4(A, B, 6, _tcp(47000, 5432), ipid=0xC1, mf=True)
+        mid = _ipv4(A, B, 6, b"\x00" * 24, ipid=0xC1, frag_off=4)
+        orphan = _ipv4(A, B, 6, b"\x00" * 24, ipid=0xC2, frag_off=4)
+        buf = _frames(_eth(vxlan(first)), _eth(vxlan(mid)),
+                      _eth(vxlan(orphan)))
+        py_rows, py_n, py_sk = native.parse_frames_packed_py(buf)
+        py_rows = np.asarray(py_rows)[:py_n]
+        # first + mid resolve to the inner flow; the orphan falls back
+        # to the OUTER row (vxlan UDP tuple)
+        assert py_n == 3 and py_sk == 0
+        assert list(py_rows[:2, 2] >> 16) == [47000, 47000]
+        assert (py_rows[2, 2] >> 16) == 51000
+        if native.available():
+            nat_rows, n, sk = native.parse_frames_packed(buf)
+            assert (n, sk) == (py_n, py_sk)
+            np.testing.assert_array_equal(np.asarray(nat_rows)[:n],
+                                          py_rows)

@@ -259,3 +259,70 @@ class TestCiliumCRDs:
         assert rec["pod-cidrs"] == ["10.7.0.0/24"]
         hub.dispatch("delete", node)
         assert d.kvstore.get(f"{NODES_PREFIX}/node-7") is None
+
+
+def _namespace(name, labels=None):
+    return {"kind": "Namespace",
+            "metadata": {"name": name, "labels": labels or {}}}
+
+
+class TestNamespaceSelector:
+    """namespaceSelector peers (DIVERGENCES #10, closed r04):
+    Namespace labels fold into pod identities and CNP peers select on
+    them via the io.cilium.k8s.namespace.labels.* prefix."""
+
+    def _world(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _namespace("prod", {"env": "prod"}))
+        hub.dispatch("add", _namespace("dev", {"env": "dev"}))
+        hub.dispatch("add", _pod(name="db-0", ns="prod",
+                                 ip="10.0.2.1"))
+        hub.dispatch("add", _pod(name="web-prod", ns="prod",
+                                 ip="10.0.1.1", labels={"app": "web"}))
+        hub.dispatch("add", _pod(name="web-dev", ns="dev",
+                                 ip="10.0.1.2", labels={"app": "web"}))
+        return d, hub
+
+    def test_namespace_labels_fold_into_identities(self):
+        d, hub = self._world()
+        ep = d.endpoints.lookup_by_ip("10.0.1.1")
+        assert any("io.cilium.k8s.namespace.labels.env=prod" in str(l)
+                   for l in ep.labels)
+
+    def test_namespace_selector_peer_crosses_namespaces(self):
+        from cilium_tpu.policy.mapstate import VERDICT_ALLOW
+
+        d, hub = self._world()
+        hub.dispatch("add", {
+            "kind": "CiliumNetworkPolicy",
+            "metadata": {"name": "allow-prod-web", "namespace": "prod"},
+            "spec": {
+                "endpointSelector": {"matchLabels": {"app": "db"}},
+                "ingress": [{
+                    "fromEndpoints": [{
+                        "matchLabels": {"app": "web"},
+                        "namespaceSelector": {
+                            "matchLabels": {"env": "prod"}},
+                    }],
+                    "toPorts": [{"ports": [{"port": "5432",
+                                            "protocol": "TCP"}]}],
+                }],
+            }})
+        db = d.endpoints.lookup_by_ip("10.0.2.1")
+        mk = lambda src: make_batch([dict(
+            src=src, dst="10.0.2.1", sport=40000, dport=5432, proto=6,
+            flags=TCP_SYN, ep=db.id, dir=0)]).data
+        ev_prod = d.process_batch(mk("10.0.1.1"), now=10)
+        ev_dev = d.process_batch(mk("10.0.1.2"), now=11)
+        assert int(ev_prod.verdict[0]) == VERDICT_ALLOW
+        assert int(ev_dev.verdict[0]) != VERDICT_ALLOW
+
+    def test_namespace_label_change_reregisters_pods(self):
+        d, hub = self._world()
+        old = d.endpoints.lookup_by_ip("10.0.1.2").identity.numeric_id
+        hub.dispatch("update", _namespace("dev", {"env": "staging"}))
+        ep = d.endpoints.lookup_by_ip("10.0.1.2")
+        assert ep.identity.numeric_id != old
+        assert any("namespace.labels.env=staging" in str(l)
+                   for l in ep.labels)

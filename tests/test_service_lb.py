@@ -159,3 +159,49 @@ class TestDaemonIntegration:
         # status/introspection surface
         assert d.services.list()[0].to_dict()["backends"][0]["port"] \
             == 5432
+
+
+class TestWeightedMaglev:
+    def test_slot_share_tracks_weights(self):
+        keys = [f"10.0.0.{i}:80" for i in range(3)]
+        t = maglev_table(keys, M, weights=[1, 1, 2])
+        counts = np.bincount(t, minlength=3) / len(t)
+        assert abs(counts[0] - 0.25) < 0.02
+        assert abs(counts[1] - 0.25) < 0.02
+        assert abs(counts[2] - 0.50) < 0.02
+
+    def test_zero_weight_backend_drained(self):
+        keys = [f"10.0.0.{i}:80" for i in range(3)]
+        t = maglev_table(keys, M, weights=[1, 0, 1])
+        assert 1 not in t
+        assert set(np.unique(t)) == {0, 2}
+
+    def test_all_zero_weights_empty_table(self):
+        t = maglev_table(["10.0.0.1:80"], M, weights=[0])
+        assert (t == -1).all()
+
+    def test_uniform_weights_match_unweighted(self):
+        keys = [f"10.0.0.{i}:80" for i in range(5)]
+        np.testing.assert_array_equal(
+            maglev_table(keys, M),
+            maglev_table(keys, M, weights=[1] * 5))
+
+    def test_manager_upsert_with_weights(self):
+        mgr = ServiceManager(m=1021)
+        mgr.upsert("svc", "10.96.0.1:80",
+                   ["10.0.0.1:8080", "10.0.0.2:8080"], weights=[3, 1])
+        t = mgr.tensors()
+        tab = np.asarray(t.maglev[0])
+        counts = np.bincount(tab[tab >= 0], minlength=2) / (tab >= 0).sum()
+        assert abs(counts[0] - 0.75) < 0.03
+
+    def test_huge_weights_do_not_starve(self):
+        """Review r04: backends with large raw weights must still
+        share slots proportionally — not fill the table in one turn."""
+        keys = [f"10.0.0.{i}:80" for i in range(2)]
+        t = maglev_table(keys, M, weights=[5000, 5000])
+        counts = np.bincount(t, minlength=2) / len(t)
+        assert abs(counts[0] - 0.5) < 0.02
+        t = maglev_table(keys, M, weights=[30000, 10000])
+        counts = np.bincount(t, minlength=2) / len(t)
+        assert abs(counts[0] - 0.75) < 0.02
