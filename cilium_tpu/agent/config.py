@@ -1,0 +1,131 @@
+"""Config resolution: defaults < config-dir files < env < flags.
+
+Reference: upstream cilium's option system (``pkg/option`` +
+``pkg/defaults``): ~300 viper/cobra flags whose values resolve from
+CLI flags, environment (``CILIUM_*``), and a config directory — in
+k8s, the ``cilium-config`` ConfigMap mounted as one file per key.
+This module gives :class:`~cilium_tpu.agent.daemon.DaemonConfig` the
+same resolution order; the flag registry derives from the dataclass
+fields so a new config field is automatically a flag, an env var, and
+a config-dir key.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import typing
+from typing import Dict, Optional, Tuple
+
+from .daemon import DaemonConfig
+
+ENV_PREFIX = "CILIUM_TPU_"
+
+_TRUE = {"true", "1", "yes", "on"}
+_FALSE = {"false", "0", "no", "off"}
+
+
+def _cast_for(tp):
+    """Build a string-parser for one DaemonConfig field from its
+    RESOLVED type (Optional[X] unwraps to X; tuples split on
+    commas)."""
+    origin = typing.get_origin(tp)
+    args = typing.get_args(tp)
+    if origin is typing.Union and type(None) in args:  # Optional[X]
+        inner = [a for a in args if a is not type(None)][0]
+        base = _scalar_cast(inner)
+
+        def cast(raw: str):
+            s = str(raw).strip()
+            return None if s in ("", "none", "None") else base(s)
+
+        return cast
+    if origin in (tuple, Tuple):
+        return lambda raw: tuple(
+            s.strip() for s in str(raw).split(",") if s.strip())
+    return _scalar_cast(tp)
+
+
+def _scalar_cast(tp):
+    if tp is bool:
+        def cast(raw: str) -> bool:
+            s = str(raw).strip().lower()
+            if s in _TRUE:
+                return True
+            if s in _FALSE:
+                return False
+            raise ValueError(f"not a boolean: {raw!r}")
+
+        return cast
+    if tp in (int, float, str):
+        return tp
+    return str
+
+
+def flag_registry() -> Dict[str, tuple]:
+    """kebab-case flag name -> (attr, cast) for every DaemonConfig
+    field (the viper-registry analogue).  Types resolve through
+    ``typing.get_type_hints`` so a NEW field's annotation (whatever it
+    is) parses correctly without touching this module."""
+    hints = typing.get_type_hints(DaemonConfig)
+    out: Dict[str, tuple] = {}
+    for f in dataclasses.fields(DaemonConfig):
+        out[f.name.replace("_", "-")] = (f.name,
+                                         _cast_for(hints[f.name]))
+    return out
+
+
+def load_config(config_dir: Optional[str] = None,
+                env: Optional[Dict[str, str]] = None,
+                **overrides) -> DaemonConfig:
+    """Resolve a DaemonConfig.
+
+    Order (weakest first): dataclass defaults, then one-file-per-key
+    ``config_dir`` entries (the mounted-ConfigMap layout; file name =
+    flag name, content = value), then ``CILIUM_TPU_<NAME>`` env vars,
+    then explicit keyword ``overrides`` (CLI flags).  Unknown config
+    keys raise — a typo'd option must not silently fall back to its
+    default (upstream: viper unknown-flag error)."""
+    registry = flag_registry()
+    values: Dict[str, object] = {}
+
+    def apply(flag: str, raw, source: str):
+        spec = registry.get(flag)
+        if spec is None:
+            raise ValueError(f"unknown config option {flag!r} "
+                             f"(from {source})")
+        attr, cast = spec
+        try:
+            values[attr] = cast(raw)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"bad value for {flag!r} (from {source}): {e}") from None
+
+    if config_dir and os.path.isdir(config_dir):
+        for name in sorted(os.listdir(config_dir)):
+            path = os.path.join(config_dir, name)
+            if name.startswith(".") or not os.path.isfile(path):
+                continue  # ConfigMap mounts hide ..data symlink dirs
+            with open(path) as f:
+                apply(name.strip(), f.read().strip(),
+                      f"config-dir {path}")
+    for key, raw in (env if env is not None else os.environ).items():
+        if not key.startswith(ENV_PREFIX):
+            continue
+        flag = key[len(ENV_PREFIX):].lower().replace("_", "-")
+        # a CILIUM_TPU_* var naming no flag is a typo (MASQUERDE=true
+        # silently doing nothing is the failure mode this loader
+        # exists to prevent), same as the config-dir/override layers
+        apply(flag, raw, f"env {key}")
+    for key, raw in overrides.items():
+        flag = key.replace("_", "-")
+        spec = registry.get(flag)
+        if spec is None:
+            raise ValueError(f"unknown config option {flag!r} "
+                             "(from overrides)")
+        # overrides arrive typed (CLI layer already parsed) OR as
+        # strings; cast only strings
+        attr, cast = spec
+        values[attr] = cast(raw) if isinstance(raw, str) else raw
+
+    return DaemonConfig(**values)

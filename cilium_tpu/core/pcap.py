@@ -111,7 +111,10 @@ def _parse_ip_one(pkt: bytes, frags=None
             frags = frags if frags is not None else _FRAGS
             key = (pkt[12:16], pkt[16:20], proto, pkt[4:6])
             if frag_off == 0:  # first fragment: carries the L4 header
-                frags.record(key, l4[:8])
+                # zero-pad to 8 bytes: the native tracker stores a
+                # fixed 8-byte prefix, and a shorter record would make
+                # mid-fragment port parsing diverge between parsers
+                frags.record(key, (l4[:8] + b"\x00" * 8)[:8])
             else:  # mid/last fragment: no L4 header on the wire
                 prefix = frags.lookup(key)
                 if prefix is None:

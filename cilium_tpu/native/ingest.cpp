@@ -71,43 +71,50 @@ uint64_t fnv64_bytes(const uint8_t* p, int n) {
     return h;
 }
 
+constexpr int FRAG_KEY_LEN = 11;  // src4 + dst4 + proto + ipid2
+
 struct FragSlot {
-    uint64_t key;
-    uint8_t pre[8];
+    uint8_t kb[FRAG_KEY_LEN];  // the EXACT key: hash collisions must
+    uint8_t pre[8];            // not alias distinct datagrams
     bool used;
 };
 constexpr int FRAG_CAP = 4096;
 FragSlot g_frags[FRAG_CAP];
 std::mutex g_frags_mu;
 
-inline uint64_t frag_key(const uint8_t* ip4) {
-    uint8_t kb[11];
+inline void frag_key(const uint8_t* ip4, uint8_t* kb) {
     std::memcpy(kb, ip4 + 12, 8);  // src + dst
     kb[8] = ip4[9];                // proto
     std::memcpy(kb + 9, ip4 + 4, 2);  // identification
-    return fnv64_bytes(kb, 11);
 }
 
-void frag_record(uint64_t key, const uint8_t* l4, long l4_len) {
+void frag_record(const uint8_t* kb, const uint8_t* l4, long l4_len) {
     std::lock_guard<std::mutex> lk(g_frags_mu);
-    const size_t h = size_t(key) % FRAG_CAP;
+    const size_t h =
+        size_t(fnv64_bytes(kb, FRAG_KEY_LEN)) % FRAG_CAP;
     size_t slot = h;
     for (int i = 0; i < 8; ++i) {
         const size_t s = (h + i) % FRAG_CAP;
-        if (!g_frags[s].used || g_frags[s].key == key) { slot = s; break; }
+        if (!g_frags[s].used ||
+            !std::memcmp(g_frags[s].kb, kb, FRAG_KEY_LEN)) {
+            slot = s;
+            break;
+        }
     }
-    g_frags[slot].key = key;
+    std::memcpy(g_frags[slot].kb, kb, FRAG_KEY_LEN);
     g_frags[slot].used = true;
     std::memset(g_frags[slot].pre, 0, 8);
     std::memcpy(g_frags[slot].pre, l4, l4_len < 8 ? l4_len : 8);
 }
 
-bool frag_lookup(uint64_t key, uint8_t* out8) {
+bool frag_lookup(const uint8_t* kb, uint8_t* out8) {
     std::lock_guard<std::mutex> lk(g_frags_mu);
-    const size_t h = size_t(key) % FRAG_CAP;
+    const size_t h =
+        size_t(fnv64_bytes(kb, FRAG_KEY_LEN)) % FRAG_CAP;
     for (int i = 0; i < 8; ++i) {
         const size_t s = (h + i) % FRAG_CAP;
-        if (g_frags[s].used && g_frags[s].key == key) {
+        if (g_frags[s].used &&
+            !std::memcmp(g_frags[s].kb, kb, FRAG_KEY_LEN)) {
             std::memcpy(out8, g_frags[s].pre, 8);
             return true;
         }
@@ -127,12 +134,13 @@ bool resolve_fragment(const uint8_t* ip4, uint32_t proto,
     const bool more = fo & 0x2000;
     if (!(frag_off || more)) return true;  // not fragmented
     if (!(proto == 6 || proto == 17 || proto == 132)) return true;
-    const uint64_t key = frag_key(ip4);
+    uint8_t kb[FRAG_KEY_LEN];
+    frag_key(ip4, kb);
     if (frag_off == 0) {  // first fragment carries the L4 header
-        frag_record(key, *l4, *l4_len);
+        frag_record(kb, *l4, *l4_len);
         return true;
     }
-    if (!frag_lookup(key, scratch8)) return false;  // FRAG_NOT_FOUND
+    if (!frag_lookup(kb, scratch8)) return false;  // FRAG_NOT_FOUND
     *l4 = scratch8;
     *l4_len = 8;
     return true;

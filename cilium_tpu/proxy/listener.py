@@ -1,0 +1,192 @@
+"""Byte-level proxy listeners: the transparent socket splice.
+
+Reference: upstream cilium's proxy plane terminates redirected
+connections on a real listener (Envoy, or the Go DNS proxy), parses
+requests off the socket, verdicts them against L7 policy, and splices
+allowed traffic to the original destination (``pkg/proxy`` +
+``proxylib`` OnData).  This module is that last mile for the TPU
+framework: a TCP listener per redirect port that reads HTTP/1.x
+requests off the wire (``featurize.parse_http_bytes``), runs them
+through :class:`~cilium_tpu.proxy.proxy.L7Proxy` (device match
+tensors + host fallback + access records), and either splices
+request+response bytes to the upstream or answers 403 — closing
+DIVERGENCES #12 (the byte-level splice used to be left to the
+deployment's ingest adapter).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Callable, Optional, Tuple
+
+_MAX_HEADER = 64 * 1024
+_DENIED = (b"HTTP/1.1 403 Forbidden\r\n"
+           b"content-length: 15\r\n"
+           b"connection: close\r\n\r\n"
+           b"Access denied\r\n")
+
+
+def _read_request(conn: socket.socket, buf: bytes
+                  ) -> Optional[Tuple[bytes, bytes, bytes]]:
+    """Read one HTTP/1.x request (head + body per content-length) ->
+    (head_bytes, body_bytes, leftover_bytes), or None on EOF/overflow.
+
+    ``buf`` carries bytes already received past the previous request
+    (pipelined clients) — leftover MUST round-trip through the caller
+    or pipelined requests would be silently dropped."""
+    while b"\r\n\r\n" not in buf:
+        chunk = conn.recv(4096)
+        if not chunk:
+            return None
+        buf += chunk
+        if len(buf) > _MAX_HEADER:
+            return None
+    head, rest = buf.split(b"\r\n\r\n", 1)
+    clen = 0
+    for line in head.split(b"\r\n")[1:]:
+        if line.lower().startswith(b"content-length:"):
+            try:
+                clen = int(line.split(b":", 1)[1].strip())
+            except ValueError:
+                return None
+    while len(rest) < clen:
+        chunk = conn.recv(4096)
+        if not chunk:
+            return None
+        rest += chunk
+    return head + b"\r\n\r\n", rest[:clen], rest[clen:]
+
+
+class HTTPListener:
+    """One redirect port's socket listener + splice loop.
+
+    ``upstream`` is the original destination ``(host, port)`` — in a
+    full deployment the datapath's REDIRECT verdict delivers the
+    connection here and the original destination rides the NAT record;
+    tests pass it explicitly.  Without an upstream, allowed requests
+    get a synthesized 200 (the DNS-proxy-style terminating mode)."""
+
+    def __init__(self, proxy, port: int,
+                 upstream: Optional[Tuple[str, int]] = None,
+                 host: str = "127.0.0.1", src_row: int = 0,
+                 upstream_of: Optional[Callable] = None):
+        self.proxy = proxy
+        self.port = port
+        self.upstream = upstream
+        self.upstream_of = upstream_of  # fn(request dict) -> (h, p)
+        self.src_row = src_row
+        self._sock = socket.create_server((host, 0))
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- lifecycle -----------------------------------------------------
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _peer = self._sock.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    # -- per-connection splice ----------------------------------------
+    def _serve_conn(self, conn: socket.socket) -> None:
+        from .featurize import parse_http_bytes
+
+        with conn:
+            leftover = b""
+            while not self._stop.is_set():
+                req = _read_request(conn, leftover)
+                if req is None:
+                    return
+                head, body, leftover = req
+                [parsed] = parse_http_bytes([head])
+                if not parsed:  # unparseable: reject before policy
+                    try:  # (Envoy 400s malformed requests pre-filter)
+                        conn.sendall(b"HTTP/1.1 400 Bad Request\r\n"
+                                     b"content-length: 0\r\n"
+                                     b"connection: close\r\n\r\n")
+                    except OSError:
+                        pass
+                    return
+                allow = self.proxy.handle_http(self.port, [parsed],
+                                               self.src_row)
+                if not int(allow[0]):
+                    try:
+                        conn.sendall(_DENIED)
+                    except OSError:
+                        pass
+                    return  # deny closes, like an Envoy 403 + reset
+                wants_close = b"connection: close" in head.lower()
+                if not self._splice_one(conn, head + body, parsed):
+                    return
+                if wants_close:
+                    return
+
+    def _splice_one(self, conn: socket.socket, request: bytes,
+                    parsed: dict) -> bool:
+        """Forward one allowed request upstream and stream the response
+        back; returns False when the connection should close."""
+        upstream = (self.upstream_of(parsed) if self.upstream_of
+                    else self.upstream)
+        if upstream is None:
+            # terminating mode keeps the connection alive (the DNS-
+            # proxy-style loop); pipelined requests continue via the
+            # caller's leftover buffer
+            conn.sendall(b"HTTP/1.1 200 OK\r\ncontent-length: 0\r\n\r\n")
+            return True
+        try:
+            with socket.create_connection(upstream, timeout=10) as up:
+                up.sendall(request)
+                up.shutdown(socket.SHUT_WR)
+                while True:
+                    chunk = up.recv(65536)
+                    if not chunk:
+                        break
+                    conn.sendall(chunk)
+        except OSError:
+            try:
+                conn.sendall(b"HTTP/1.1 502 Bad Gateway\r\n"
+                             b"content-length: 0\r\n\r\n")
+            except OSError:
+                pass
+            return False
+        return False  # one-shot upstream splice closes the connection
+
+
+class ListenerManager:
+    """Redirect ports -> live listeners (pkg/proxy redirect lifecycle
+    at the SOCKET level: update() reconciles listeners with the
+    proxy's compiled redirect set)."""
+
+    def __init__(self, proxy, upstream_of: Optional[Callable] = None):
+        self.proxy = proxy
+        self.upstream_of = upstream_of
+        self._listeners: dict = {}
+
+    def reconcile(self) -> dict:
+        wanted = {l["proxy-port"] for l in self.proxy.listeners()}
+        for port in list(self._listeners):
+            if port not in wanted:
+                self._listeners.pop(port).close()
+        for port in wanted:
+            if port not in self._listeners:
+                self._listeners[port] = HTTPListener(
+                    self.proxy, port, upstream_of=self.upstream_of)
+        return {p: l.address for p, l in self._listeners.items()}
+
+    def close(self) -> None:
+        for l in self._listeners.values():
+            l.close()
+        self._listeners.clear()

@@ -1,0 +1,120 @@
+"""Fault injection for the distributed control plane.
+
+Reference: upstream cilium's kvstore layer is exercised against etcd
+failures (connection loss, partitions, ambiguous commits); agents are
+expected to retry with backoff and converge.  :class:`ChaosKVStore`
+wraps any kvstore-like object and injects those failure classes
+deterministically (seeded):
+
+- **transient errors**: an op raises ``ConnectionError`` with
+  probability ``fail_rate``;
+- **ambiguous commits**: half of injected MUTATION failures apply the
+  op BEFORE raising — the caller cannot tell (exactly etcd's
+  commit-then-timeout case), so protocols must be re-entrant;
+- **partitions**: while ``partition()`` is active every op fails;
+- **watch lag**: events deliver after ``watch_delay`` seconds.
+
+The invariants the fault suite asserts (tests/test_fault_injection.py)
+are the reference's: no duplicate identity numerics for one label set,
+no lost allocations after heal, replicas converge.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+import numpy as np
+
+_MUTATORS = ("update", "create_only", "delete", "delete_if",
+             "keepalive")
+_READERS = ("get", "list_prefix")
+
+
+class ChaosKVStore:
+    """A kvstore proxy that injects seeded faults (see module doc)."""
+
+    def __init__(self, inner, fail_rate: float = 0.0, seed: int = 0,
+                 watch_delay: float = 0.0):
+        self._inner = inner
+        self.fail_rate = fail_rate
+        self.watch_delay = watch_delay
+        self._rng = np.random.default_rng(seed)
+        self._partitioned = threading.Event()
+        self._lock = threading.Lock()
+        self.injected = 0  # faults raised
+        self.ambiguous = 0  # …of which applied before raising
+
+    # -- fault controls ------------------------------------------------
+    def partition(self, active: bool = True) -> None:
+        if active:
+            self._partitioned.set()
+        else:
+            self._partitioned.clear()
+
+    def _maybe_fail(self) -> bool:
+        """-> True when this op should raise; thread-safe draw."""
+        if self._partitioned.is_set():
+            return True
+        if self.fail_rate <= 0:
+            return False
+        with self._lock:
+            return bool(self._rng.random() < self.fail_rate)
+
+    def _flip(self) -> bool:
+        with self._lock:
+            return bool(self._rng.random() < 0.5)
+
+    # -- op wrappers ---------------------------------------------------
+    def __getattr__(self, name: str):
+        attr = getattr(self._inner, name)
+        if name in _MUTATORS:
+            def wrapped(*a, **kw):
+                if self._maybe_fail():
+                    self.injected += 1
+                    if not self._partitioned.is_set() and self._flip():
+                        # ambiguous commit: applied, then "timed out"
+                        self.ambiguous += 1
+                        attr(*a, **kw)
+                    raise ConnectionError(
+                        f"injected kvstore fault on {name}")
+                return attr(*a, **kw)
+
+            return wrapped
+        if name in _READERS:
+            def wrapped(*a, **kw):
+                if self._maybe_fail():
+                    self.injected += 1
+                    raise ConnectionError(
+                        f"injected kvstore fault on {name}")
+                return attr(*a, **kw)
+
+            return wrapped
+        if name == "watch_prefix" and self.watch_delay > 0:
+            delay = self.watch_delay
+
+            def wrapped(prefix, fn, *a, **kw):
+                def lagged(ev):
+                    time.sleep(delay)
+                    fn(ev)
+
+                return attr(prefix, lagged, *a, **kw)
+
+            return wrapped
+        return attr
+
+
+def retry(fn: Callable, attempts: int = 12,
+          backoff: float = 0.0, swallow=ConnectionError):
+    """The agent-controller retry shape: re-run ``fn`` through
+    transient faults; raises the last error when attempts exhaust."""
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        try:
+            return fn()
+        except swallow as e:  # noqa: PERF203
+            last = e
+            if backoff:
+                time.sleep(backoff * (i + 1))
+    raise last

@@ -1,0 +1,84 @@
+"""Config resolution (reference: pkg/option viper flags + config-dir
++ the cilium-config ConfigMap): defaults < config-dir files < env <
+explicit flags; unknown keys are errors, not silent defaults."""
+
+import os
+
+import pytest
+
+from cilium_tpu.agent.config import ENV_PREFIX, flag_registry, load_config
+
+
+class TestFlagRegistry:
+    def test_every_daemonconfig_field_is_a_flag(self):
+        import dataclasses
+
+        from cilium_tpu.agent.daemon import DaemonConfig
+
+        reg = flag_registry()
+        for f in dataclasses.fields(DaemonConfig):
+            assert f.name.replace("_", "-") in reg
+
+
+class TestLoadConfig:
+    def test_config_dir_one_file_per_key(self, tmp_path):
+        (tmp_path / "node-name").write_text("cfg-node\n")
+        (tmp_path / "ct-capacity").write_text("4096")
+        (tmp_path / "masquerade").write_text("true")
+        (tmp_path / "non-masquerade-cidrs").write_text(
+            "10.0.0.0/8, 192.168.0.0/16")
+        cfg = load_config(config_dir=str(tmp_path), env={})
+        assert cfg.node_name == "cfg-node"
+        assert cfg.ct_capacity == 4096
+        assert cfg.masquerade is True
+        assert cfg.non_masquerade_cidrs == ("10.0.0.0/8",
+                                            "192.168.0.0/16")
+
+    def test_precedence_env_over_dir_flags_over_env(self, tmp_path):
+        (tmp_path / "node-name").write_text("cfg-node")
+        env = {f"{ENV_PREFIX}NODE_NAME": "env-node"}
+        assert load_config(config_dir=str(tmp_path),
+                           env=env).node_name == "env-node"
+        assert load_config(config_dir=str(tmp_path), env=env,
+                           node_name="flag-node").node_name == "flag-node"
+
+    def test_unknown_key_raises(self, tmp_path):
+        (tmp_path / "no-such-option").write_text("1")
+        with pytest.raises(ValueError, match="unknown config option"):
+            load_config(config_dir=str(tmp_path), env={})
+        with pytest.raises(ValueError, match="unknown config option"):
+            load_config(env={}, no_such_flag=1)
+
+    def test_typoed_env_var_raises(self):
+        """Review r04: CILIUM_TPU_MASQUERDE=true silently doing
+        nothing is the exact failure mode the loader must reject."""
+        with pytest.raises(ValueError, match="unknown config option"):
+            load_config(env={f"{ENV_PREFIX}MASQUERDE": "true"})
+
+    def test_bad_value_names_source(self, tmp_path):
+        (tmp_path / "ct-capacity").write_text("a-lot")
+        with pytest.raises(ValueError, match="config-dir"):
+            load_config(config_dir=str(tmp_path), env={})
+
+    def test_optional_fields_parse_none_and_values(self):
+        cfg = load_config(env={f"{ENV_PREFIX}IDENTITY_LEASE_TTL": "30"})
+        assert cfg.identity_lease_ttl == 30.0
+        cfg = load_config(env={f"{ENV_PREFIX}IDENTITY_LEASE_TTL": "none"})
+        assert cfg.identity_lease_ttl is None
+
+    def test_configmap_hidden_entries_skipped(self, tmp_path):
+        # k8s ConfigMap mounts include ..data/..2024_x symlink dirs
+        (tmp_path / "node-name").write_text("n")
+        (tmp_path / "..data").mkdir()
+        hidden = tmp_path / ".hidden"
+        hidden.write_text("x")
+        cfg = load_config(config_dir=str(tmp_path), env={})
+        assert cfg.node_name == "n"
+
+    def test_daemon_boots_from_loaded_config(self, tmp_path):
+        from cilium_tpu.agent import Daemon
+
+        (tmp_path / "backend").write_text("interpreter")
+        (tmp_path / "node-name").write_text("from-files")
+        d = Daemon(load_config(config_dir=str(tmp_path), env={}))
+        assert d.config.node_name == "from-files"
