@@ -303,12 +303,19 @@ def bench_end_to_end_wide(world, state, now0, jax, jnp, iters=12):
     ring.cursor.block_until_ready()
     dt = time.perf_counter() - t0
 
+    # absorb the tunnel d2h debt accrued over this phase's dispatches
+    # with a scalar fetch, so drain_ms reports the DECODE, not the
+    # harness artifact (see bench_end_to_end)
+    t0 = time.perf_counter()
+    _ = np.asarray(state.metrics)
+    sync_dt = time.perf_counter() - t0
     t0 = time.perf_counter()
     events, total, lost = ring_drain(ring)
     drain_dt = time.perf_counter() - t0
     return {
         "verdicts_per_sec": round(BATCH * iters / dt),
         "vs_target_10M": round(BATCH * iters / dt / BASELINE_PPS, 3),
+        "phase_sync_ms": round(sync_dt * 1e3, 1),
         "h2d_bytes_per_pkt": 64,
         "frac_v6": round(frac_v6, 4),
         "frac_related": round(frac_rel, 4),
@@ -349,12 +356,26 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=24,
         rows, _, _ = fn(buf, out_pool[i % 4])
         return rows
 
-    ring = EventRing.create(ring_cap)
     zero = jnp.uint32(0)
+    # establish the POOL's flows first (throwaway ring): the steady
+    # state this phase measures is 95% established traffic — without
+    # this, the first windows are solid NEW-verdict floods and the
+    # "loss" is a warmup artifact, not a drain-cadence property
+    ring = EventRing.create(ring_cap)
+    from cilium_tpu.monitor.ring import serve_step_jit
+    state, ring = serve_step_jit(state, ring, jnp.asarray(pool),
+                                 jnp.uint32(now0), zero)
     state, ring = serve_step_packed_jit(
         state, ring, jax.device_put(parse(frame_bufs[0], 0)),
         jnp.uint32(now0), zero, zero, zero)
     ring.cursor.block_until_ready()
+    # absorb the accumulated tunnel d2h debt so the measured drains
+    # show the monitor's real cadence (directly-attached TPUs have no
+    # such debt at all)
+    t0 = time.perf_counter()
+    _ = np.asarray(state.metrics)
+    sync_ms = round((time.perf_counter() - t0) * 1e3, 1)
+    ring = EventRing.create(ring_cap)
 
     drained = last_total = 0
     window_lost = 0
@@ -382,11 +403,13 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=24,
         "ring_capacity": ring_cap,
         "events_drained": int(drained),
         "window_lost": int(window_lost),
+        "pre_phase_sync_ms": sync_ms,
         "drain_ms_median": round(sorted(drain_times)[
             len(drain_times) // 2] * 1e3, 1),
-        "note": ("per-window zero loss with a bounded ring; drain "
-                 "latency on this harness is dominated by the tunneled "
-                 "d2h fetch, not the decode"),
+        "note": ("per-window loss accounting with a bounded ring; on "
+                 "this harness each drain still pays ~4.5s/dispatch "
+                 "of tunnel d2h debt accrued since the last fetch "
+                 "(absent on directly-attached TPUs)"),
     }, state
 
 
