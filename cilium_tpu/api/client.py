@@ -130,3 +130,9 @@ class APIClient:
 
     def cluster_health(self):
         return self._request("GET", "/cluster/health")
+
+    def proxy_listeners(self):
+        return self._request("GET", "/proxy")
+
+    def xds_status(self):
+        return self._request("GET", "/xds")

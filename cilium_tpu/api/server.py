@@ -149,6 +149,16 @@ def _make_handler(daemon: Daemon):
                     # redirect listeners + their L7 rule shapes (the
                     # xDS NetworkPolicy view; reference: pkg/envoy)
                     self._send(200, daemon.proxy.listeners())
+                elif path == "/xds":
+                    # the SotW push-surface status an external proxy
+                    # subscribes to (proxy/xds.py)
+                    resp = daemon.xds.discover({}) or {}
+                    self._send(200, {
+                        "version": daemon.xds.version,
+                        "resources": [r["name"] for r in
+                                      resp.get("resources", ())],
+                        "nacks": daemon.xds.nacks[-8:],
+                    })
                 elif path == "/service":
                     self._send(200, [s.to_dict()
                                      for s in daemon.services.list()])

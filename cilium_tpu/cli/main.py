@@ -143,6 +143,32 @@ def cmd_config(args) -> int:
     return 0
 
 
+def cmd_proxy(args) -> int:
+    """L7 plane: redirect listeners or the xDS push-surface status."""
+    c = _client(args)
+    if args.obj == "xds":
+        st = c.xds_status()
+        if args.json:
+            _print(st)
+            return 0
+        print(f"xDS version {st['version']}, "
+              f"{len(st['resources'])} resources")
+        for name in st["resources"]:
+            print(f"  {name}")
+        for nonce, detail in st.get("nacks", ()):
+            print(f"  NACK @{nonce}: {detail}")
+        return 0
+    listeners = c.proxy_listeners()
+    if args.json:
+        _print(listeners)
+        return 0
+    for l in listeners:
+        rules = {k: v for k, v in l.items()
+                 if k.endswith("-rules") and v}
+        print(f"port {l['proxy-port']}: {rules or 'no rules'}")
+    return 0
+
+
 def cmd_identity(args) -> int:
     ids = _client(args).identity_list()
     if args.json:
@@ -370,6 +396,11 @@ def main(argv=None) -> int:
     p.add_argument("key", nargs="?")
     p.add_argument("value", nargs="?")
 
+    p = sub.add_parser("proxy",
+                       help="proxy listeners | proxy xds (push status)")
+    p.add_argument("obj", nargs="?", default="listeners",
+                   choices=["listeners", "xds"])
+
     p = sub.add_parser("bpf", help="bpf ct list | bpf policy get ID | "
                                    "bpf ipcache list")
     p.add_argument("obj", choices=["ct", "policy", "ipcache"])
@@ -426,6 +457,7 @@ def main(argv=None) -> int:
             "anomaly": cmd_anomaly, "daemon": cmd_daemon,
             "service": cmd_service, "fqdn": cmd_fqdn,
             "health": cmd_health, "config": cmd_config,
+            "proxy": cmd_proxy,
         }.get(args.cmd)
         if handler is None:
             parser.print_help()

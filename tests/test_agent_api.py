@@ -215,7 +215,30 @@ class TestAPIandCLI:
         assert "Revision" in capsys.readouterr().out
         assert main(["--socket", sock, "bpf", "ipcache"]) == 0
         assert "10.0.2.1/32" in capsys.readouterr().out
+        # L7/xDS plane verbs (r04): an L7 policy creates a listener;
+        # xds shows the pushed resources
+        l7_rules = [{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                "toPorts": [{"ports": [{"port": "80",
+                                        "protocol": "TCP"}],
+                             "rules": {"http": [{"method": "GET"}]}}],
+            }],
+        }]
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f2:
+            json.dump(l7_rules, f2)
+        assert main(["--socket", sock, "policy", "import",
+                     f2.name]) == 0
+        capsys.readouterr()
+        assert main(["--socket", sock, "proxy"]) == 0
+        assert "http-rules" in capsys.readouterr().out
+        assert main(["--socket", sock, "proxy", "xds"]) == 0
+        out = capsys.readouterr().out
+        assert "xDS version" in out and "app=db" in out
         os.unlink(f.name)
+        os.unlink(f2.name)
 
     def test_cli_agent_unreachable(self, capsys):
         from cilium_tpu.cli.main import main
