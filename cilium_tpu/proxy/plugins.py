@@ -89,12 +89,39 @@ def _cass_compile(rule: dict):
     return "row", [action_id, lo, hi, 0, 0]
 
 
+def parse_cql_frames(payloads) -> list:
+    """CQL native-protocol frames -> request dicts (the wire-facing
+    half; reference: proxylib/cassandra parses the 9-byte frame
+    header + QUERY long-string body).  Non-QUERY opcodes pass through
+    as {} (matched by no rule -> denied under enforcing policy);
+    malformed frames likewise."""
+    import struct
+
+    out = []
+    for raw in payloads:
+        try:
+            if len(raw) < 9:
+                out.append({})
+                continue
+            opcode = raw[4]
+            if opcode != 0x07:  # QUERY
+                out.append({"opcode": int(opcode)})
+                continue
+            (qlen,) = struct.unpack_from(">i", raw, 9)
+            query = raw[13:13 + qlen].decode("utf-8", "replace")
+            out.append(parse_cql(query))
+        except (struct.error, IndexError):
+            out.append({})
+    return out
+
+
 CASSANDRA = register(L7Protocol(
     name="cassandra", kind=16,
     featurize=_cass_featurize,
     compile_rule=_cass_compile,
     record_fields=lambda r: (str(r.get("action", "")),
                              str(r.get("table", ""))),
+    parse_bytes=parse_cql_frames,
 ))
 
 # -- memcached ---------------------------------------------------------
@@ -138,10 +165,35 @@ def _mc_compile(rule: dict):
     return "row", [cmd_id, lo, hi, 0, 0]
 
 
+def parse_memcache_lines(payloads) -> list:
+    """Memcached TEXT protocol request lines -> request dicts
+    (reference: proxylib/memcache; the command word + first key).
+    Multi-key gets emit one dict per key is NOT done here — the
+    policy unit is the request line, matching upstream's per-request
+    verdict."""
+    out = []
+    for raw in payloads:
+        try:
+            line = raw.split(b"\r\n", 1)[0].decode("ascii", "replace")
+            parts = line.split()
+            if not parts:
+                out.append({})
+                continue
+            cmd = parts[0].lower()
+            req = {"command": cmd}
+            if len(parts) > 1 and cmd in MEMCACHE_COMMANDS:
+                req["key"] = parts[1]
+            out.append(req)
+        except (IndexError, ValueError):
+            out.append({})
+    return out
+
+
 MEMCACHED = register(L7Protocol(
     name="memcached", kind=17,
     featurize=_mc_featurize,
     compile_rule=_mc_compile,
     record_fields=lambda r: (str(r.get("command", "")),
                              str(r.get("key", ""))),
+    parse_bytes=parse_memcache_lines,
 ))

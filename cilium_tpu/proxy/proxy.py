@@ -187,6 +187,20 @@ class L7Proxy:
                 method=m, path=p))
         return allow
 
+    def handle_bytes(self, kind_name: str, port: int,
+                     payloads: Sequence[bytes],
+                     src_row: int = 0) -> np.ndarray:
+        """Verdict RAW payloads of a plugin protocol that ships a
+        wire parser (proxylib OnData analogue)."""
+        from . import registry
+
+        plugin = registry.get(kind_name)
+        if plugin is None or plugin.parse_bytes is None:
+            raise KeyError(
+                f"no byte-level L7 parser registered for {kind_name!r}")
+        return self.handle(kind_name, port, plugin.parse_bytes(payloads),
+                           src_row)
+
     def handle_kafka(self, port: int, requests: Sequence[dict],
                      src_row: int = 0) -> np.ndarray:
         """Verdict Kafka requests ({api_key, topic, client_id});

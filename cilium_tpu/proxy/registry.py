@@ -57,6 +57,10 @@ class L7Protocol:
     compile_rule: Callable[[dict], Tuple[str, object]]
     record_fields: Callable[[dict], Tuple[str, str]] = \
         lambda r: (str(r.get("method", "")), str(r.get("path", "")))
+    # optional wire-facing half: raw payload bytes -> request dicts
+    # (proxylib OnData analogue; parsers without it accept structured
+    # requests only)
+    parse_bytes: Optional[Callable[[Sequence[bytes]], List[dict]]] = None
 
 
 _registry: Dict[str, L7Protocol] = {}

@@ -157,3 +157,51 @@ class TestUpstreamL7ProtoSchema:
     def test_non_list_rules_rejected_clearly(self):
         with pytest.raises(ValueError, match="must be a list"):
             L7Rules.from_dict({"cassandra": "select"})
+
+
+class TestWireParsers:
+    """The proxylib OnData analogue: raw protocol bytes -> verdicts."""
+
+    def test_cql_query_frame_bytes(self):
+        import struct
+
+        from cilium_tpu.proxy.plugins import parse_cql_frames
+
+        q = b"SELECT * FROM ks.users WHERE id = 1"
+        frame = (bytes([0x04, 0, 0, 0, 0x07])  # v4 request, QUERY
+                 + struct.pack(">i", len(q) + 4)  # body length
+                 + struct.pack(">i", len(q)) + q)
+        [req] = parse_cql_frames([frame])
+        assert req == {"action": "select", "table": "ks.users"}
+        proxy = _proxy({"cassandra": [
+            {"queryAction": "select", "queryTable": "ks.users"}]})
+        allow = proxy.handle_bytes("cassandra", 11000, [frame])
+        assert allow.tolist() == [1]
+        # a DELETE frame against the same policy is denied
+        q2 = b"DELETE FROM ks.users WHERE id = 1"
+        frame2 = (bytes([0x04, 0, 0, 0, 0x07])
+                  + struct.pack(">i", len(q2) + 4)
+                  + struct.pack(">i", len(q2)) + q2)
+        assert proxy.handle_bytes("cassandra", 11000,
+                                  [frame2]).tolist() == [0]
+        # non-QUERY opcodes and garbage match no rule -> denied
+        assert proxy.handle_bytes(
+            "cassandra", 11000,
+            [bytes([0x04, 0, 0, 0, 0x05]) + b"\x00" * 4,
+             b"xx"]).tolist() == [0, 0]
+
+    def test_memcache_text_lines(self):
+        proxy = _proxy({"memcached": [
+            {"command": "get", "keyPrefix": "public/"}]})
+        allow = proxy.handle_bytes("memcached", 11000, [
+            b"get public/motd\r\n",
+            b"get private/motd\r\n",
+            b"set public/motd 0 60 5\r\nhello\r\n",
+            b"",
+        ])
+        assert allow.tolist() == [1, 0, 0, 0]
+
+    def test_plugin_without_wire_parser_raises(self):
+        proxy = _proxy({"toyredis2": [{"cmd": "get"}]})
+        with pytest.raises(KeyError):
+            proxy.handle_bytes("toyredis2", 11000, [b"x"])
