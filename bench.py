@@ -35,7 +35,11 @@ import time
 
 import numpy as np
 
-BATCH = 1 << 17  # 131072 packets/batch
+# 262144 packets/batch: on the tunneled harness, per-dispatch latency
+# dominates the e2e path — doubling the batch from 128k measured
+# 16.4M -> 39.8M burst / 8.6M -> 30.3M sustained verdicts/s at
+# unchanged h2d bytes/packet
+BATCH = 1 << 18
 BASELINE_PPS = 10_000_000.0  # north-star target
 
 
