@@ -78,13 +78,15 @@ TCP_ACK = 0x10
 # COL_FLAGS bit 8 (above the TCP flags byte): this row is an ICMP
 # ERROR whose columns carry the EMBEDDED (original) packet's 5-tuple —
 # the conntrack lookup relates it to the original flow (CT_RELATED,
-# reference: bpf/lib/conntrack.h ICMP error handling).  Wide-format
-# only: the packed 16B wire format has just the 8 TCP-flag bits, so
-# the packed fast path leaves ICMP errors un-related (outer tuple,
-# policy-evaluated) — a documented divergence; ingest adapters that
-# need RELATED on the fast path shunt proto-1/58 frames to the wide
-# parser.
+# reference: bpf/lib/conntrack.h ICMP error handling).  On the packed
+# 16 B wire format the flag rides BIT 15 of the length half-word
+# (META_RELATED_BIT): lengths cap at 0x7FFF, a no-op for any real MTU,
+# and ICMPv4 errors relate on the fast path too (r04; previously a
+# documented divergence).  v6 ICMP errors remain wide-path (the packed
+# format is IPv4-only).
 FLAG_RELATED = 0x100
+META_RELATED_BIT = 1 << 15  # within the META length half-word
+META_LEN_MASK = 0x7FFF
 
 # VXLAN / Geneve UDP ports (reference: bpf_overlay.c decap; Linux
 # defaults).  Overlay frames decap at ingest: the row carries the
@@ -119,9 +121,11 @@ def pack_rows(hdr: np.ndarray, out: Optional[np.ndarray] = None
     p[:, PACKED_DST] = hdr[:, COL_DST_IP3]
     p[:, PACKED_PORTS] = (hdr[:, COL_SPORT] << 16) | (hdr[:, COL_DPORT]
                                                       & 0xFFFF)
+    related = ((hdr[:, COL_FLAGS] & FLAG_RELATED) != 0).astype(np.uint32)
     p[:, PACKED_META] = ((hdr[:, COL_PROTO] << 24)
                          | ((hdr[:, COL_FLAGS] & 0xFF) << 16)
-                         | np.minimum(hdr[:, COL_LEN], 0xFFFF))
+                         | (related << 15)
+                         | np.minimum(hdr[:, COL_LEN], META_LEN_MASK))
     return p
 
 
@@ -143,8 +147,9 @@ def unpack_hdr(packed, ep, dirn):
         packed[:, PACKED_PORTS] >> 16,
         packed[:, PACKED_PORTS] & 0xFFFF,
         packed[:, PACKED_META] >> 24,
-        (packed[:, PACKED_META] >> 16) & 0xFF,
-        packed[:, PACKED_META] & 0xFFFF,
+        ((packed[:, PACKED_META] >> 16) & 0xFF)
+        | (((packed[:, PACKED_META] >> 15) & 1) << 8),  # FLAG_RELATED
+        packed[:, PACKED_META] & META_LEN_MASK,
         jnp.full_like(src, 4),
         jnp.full_like(src, jnp.uint32(ep)),
         jnp.full_like(src, jnp.uint32(dirn)),

@@ -169,11 +169,10 @@ def parse_frames_packed_py(buf: bytes,
     """Pure-Python fallback for :func:`parse_frames_packed` — parses
     wide rows then packs; same return contract.
 
-    ``related=False`` mirrors the native packed parser: ICMP-error
-    frames keep the OUTER tuple (the packed wire format has no
-    FLAG_RELATED bit, and packing the embedded inner tuple as ordinary
-    traffic would let a forged ICMP error refresh the original flow's
-    CT entry)."""
+    ICMP-error frames carry the EMBEDDED tuple + the META_RELATED_BIT
+    (r04: the packed format gained a flag bit — bit 15 of the length
+    half-word — so RELATED semantics ride the fast path exactly like
+    the wide one; pack_rows preserves the bit)."""
     import struct
 
     from ..core.packets import COL_FAMILY, pack_rows
@@ -188,7 +187,7 @@ def parse_frames_packed_py(buf: bytes,
             break
         off += 4 + flen
         n_frames += 1
-    wide = parse_frames_py(buf, related=False)
+    wide = parse_frames_py(buf, related=True)
     v4 = wide[wide[:, COL_FAMILY] == 4]
     skipped = n_frames - len(v4)
     packed = pack_rows(v4)

@@ -451,6 +451,10 @@ long parse_frames_packed(const uint8_t* buf, long buf_len, uint32_t* out,
         // exactly the frames that would have produced rows — an out
         // buffer sized for the valid rows never spuriously overflows
         if (rows >= max_rows) { ++overflow; continue; }
+        // length caps at 0x7FFF: bit 15 of the META half-word is the
+        // RELATED flag (core/packets.py META_RELATED_BIT)
+        uint32_t len15 = be16(p + 2);
+        if (len15 > 0x7FFF) len15 = 0x7FFF;
         uint32_t sport = 0, dport = 0, flags = 0;
         if ((proto == 6 || proto == 17 || proto == 132) && l4_len >= 4) {
             sport = be16(l4);
@@ -458,16 +462,44 @@ long parse_frames_packed(const uint8_t* buf, long buf_len, uint32_t* out,
             if (proto == 6 && l4_len >= 14) flags = l4[13];
         } else if ((proto == 1 || proto == 58) && l4_len >= 2) {
             dport = l4[0];  // ICMP/ICMPv6 type rides the dport column
-            // NOTE: ICMP-error RELATED extraction is wide-path only
-            // (the packed format has no flag bit for it); adapters
-            // needing RELATED on the fast path shunt ICMP to the
-            // wide parser (core/packets.py FLAG_RELATED)
+            // ICMP ERROR: the row carries the EMBEDDED original
+            // tuple + the RELATED bit (r04 — previously wide-path
+            // only; matches parse_ip's wide transform)
+            if (proto == 1 && icmp_is_error(proto, l4[0]) &&
+                l4_len >= 8 + 20) {
+                const uint8_t* in = l4 + 8;
+                const long in_len = l4_len - 8;
+                if ((in[0] >> 4) == 4 && in_len >= 20) {
+                    const int iihl = (in[0] & 0xF) * 4;
+                    if (iihl >= 20 && in_len >= iihl) {
+                        const uint32_t iproto = in[9];
+                        const uint8_t* il4 = in + iihl;
+                        const long il4_len = in_len - iihl;
+                        uint32_t isp = 0, idp = 0;
+                        if ((iproto == 6 || iproto == 17 ||
+                             iproto == 132) && il4_len >= 4) {
+                            isp = be16(il4);
+                            idp = be16(il4 + 2);
+                        } else if ((iproto == 1 || iproto == 58)
+                                   && il4_len >= 2) {
+                            idp = il4[0];
+                        }
+                        uint32_t* w = out + rows * 4;
+                        w[0] = be32(in + 12);
+                        w[1] = be32(in + 16);
+                        w[2] = (isp << 16) | idp;
+                        w[3] = (iproto << 24) | 0x8000u | len15;
+                        ++rows;
+                        continue;
+                    }
+                }
+            }
         }
         uint32_t* w = out + rows * 4;
         w[0] = be32(p + 12);
         w[1] = be32(p + 16);
         w[2] = (sport << 16) | dport;
-        w[3] = (proto << 24) | (flags << 16) | be16(p + 2);
+        w[3] = (proto << 24) | (flags << 16) | len15;
         ++rows;
     }
     if (n_skipped) *n_skipped = skipped;

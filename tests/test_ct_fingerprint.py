@@ -151,6 +151,42 @@ class TestFingerprintProbe:
         state = np.asarray(ct2.table[:, V_STATE])
         assert (fp[state == ST_FREE] == 0).all()
 
+    def test_probe_equivalence_fuzz_through_lifecycle(self):
+        """Randomized gate: across batches of inserts, refreshes,
+        expiries, and GC sweeps, the fingerprint probe must equal the
+        full-window probe on EVERY key, hit or miss."""
+        rng = np.random.default_rng(42)
+        cap = 1 << 10  # small: forces collisions + window pressure
+        ct = CTTable.create(cap)
+        now = 100
+        universe = _flows(600, seed=7)  # ~60% occupancy at peak
+        for step in range(12):
+            pick = rng.choice(600, 128, replace=False)
+            hdr = jnp.asarray(universe.data[pick])
+            fwd, rev = ct_keys_jit(hdr)
+            t = jnp.uint32(now)
+            res, slot, rep = ct_lookup_jit(ct, fwd, rev, t)
+            ct = ct_update_jit(ct, hdr, fwd, res, slot, rep,
+                               do_create=jnp.ones(128, bool),
+                               proxy_port=jnp.zeros(128, jnp.uint32),
+                               now=t)
+            # equivalence sweep over the WHOLE universe
+            afwd, arev = ct_keys_jit(jnp.asarray(universe.data))
+            for keys in (afwd, arev):
+                f0, s0 = _probe(ct.table, keys, t)
+                f1, s1, ovf = _probe_fp(ct.table, ct.fp, keys, t)
+                f1 = np.asarray(f1) | np.asarray(ovf)  # ovf -> full
+                # where no overflow, results must match exactly
+                clean = ~np.asarray(ovf)
+                np.testing.assert_array_equal(np.asarray(f0)[clean],
+                                              np.asarray(f1)[clean])
+                np.testing.assert_array_equal(
+                    np.asarray(s0)[clean & np.asarray(f0)],
+                    np.asarray(s1)[clean & np.asarray(f0)])
+            now += rng.integers(1, 40)  # let lifetimes expire mid-run
+            if step % 4 == 3:
+                ct, _n = ct_gc(ct, jnp.uint32(now))
+
     def test_host_fp_mix_mirrors_device(self):
         keys = np.asarray(_seed_table(n=32)[2])
         h_dev = np.asarray(_fp_mix(_hash(jnp.asarray(keys))))

@@ -86,34 +86,41 @@ def _icmp_error_frame():
     return struct.pack("<I", len(eth)) + eth
 
 
-def test_packed_icmp_error_keeps_outer_tuple_native_and_python():
-    """ADVICE r03 (medium): the packed fast path has no RELATED bit, so
-    BOTH packed parsers must keep the ICMP error's OUTER tuple —
-    packing the embedded inner tuple as ordinary traffic would let a
-    forged ICMP error refresh the original flow's CT entry."""
-    import struct
+def test_packed_icmp_error_carries_related_bit_native_and_python():
+    """r04: the packed format gained a RELATED flag (bit 15 of the
+    length half-word), so ICMP errors carry the EMBEDDED tuple + the
+    bit on the fast path too — unpacking round-trips to exactly the
+    wide parser's transform (FLAG_RELATED + inner 5-tuple), and the
+    datapath relates instead of policy-evaluating a forged-looking
+    outer tuple."""
+    from cilium_tpu.core.packets import (COL_DST_IP3, COL_FLAGS,
+                                         COL_PROTO, COL_SRC_IP3,
+                                         FLAG_RELATED, pack_rows)
 
     buf = _icmp_error_frame()
     rows_n, n_n, sk_n = native.parse_frames_packed(buf)
     rows_p, n_p, sk_p = native.parse_frames_packed_py(buf)
     assert (n_n, sk_n) == (1, 0) and (n_p, sk_p) == (1, 0)
     np.testing.assert_array_equal(np.asarray(rows_n), np.asarray(rows_p))
-    src = int(rows_n[0, 0])
-    dst = int(rows_n[0, 1])
-    ports = int(rows_n[0, 2])
-    meta = int(rows_n[0, 3])
-    assert src == 0x0A000007 and dst == 0x0A000009  # OUTER, not inner
-    assert ports == 3  # sport 0, dport = ICMP type
-    assert meta >> 24 == 1  # proto stays ICMP
-    # ...while the WIDE path applies the RELATED transform
-    from cilium_tpu.core.packets import (COL_DST_IP3, COL_FLAGS,
-                                         COL_PROTO, COL_SRC_IP3,
-                                         FLAG_RELATED)
     wide = native.parse_frames_py(buf)
-    assert int(wide[0, COL_SRC_IP3]) == 0x0A000009
+    assert int(wide[0, COL_SRC_IP3]) == 0x0A000009  # embedded tuple
     assert int(wide[0, COL_DST_IP3]) == 0x0A000007
     assert int(wide[0, COL_PROTO]) == 17
     assert int(wide[0, COL_FLAGS]) == FLAG_RELATED
+    # packed == pack(wide): the bit survives the 16 B format
+    np.testing.assert_array_equal(np.asarray(rows_n), pack_rows(wide))
+    meta = int(rows_n[0, 3])
+    assert meta & (1 << 15)
+    assert meta >> 24 == 17  # embedded proto, not outer ICMP
+    # and unpacking restores FLAG_RELATED for the device pipeline
+    import jax.numpy as jnp
+
+    from cilium_tpu.core.packets import unpack_hdr
+
+    hdr = np.asarray(unpack_hdr(jnp.asarray(np.asarray(rows_n)),
+                                jnp.uint32(0), jnp.uint32(0)))
+    assert int(hdr[0, COL_FLAGS]) == FLAG_RELATED
+    assert int(hdr[0, COL_PROTO]) == 17
 
 
 def test_packed_overflow_counts_only_valid_rows():
