@@ -40,6 +40,12 @@ import numpy as np
 # 16.4M -> 39.8M burst / 8.6M -> 30.3M sustained verdicts/s at
 # unchanged h2d bytes/packet
 BATCH = 1 << 18
+
+
+def _pow2_cap(n_events: int) -> int:
+    """Smallest power-of-two ring capacity holding ``n_events``
+    (EventRing.create asserts 2^k)."""
+    return 1 << max(1, int(n_events) - 1).bit_length()
 BASELINE_PPS = 10_000_000.0  # north-star target
 
 
@@ -187,9 +193,7 @@ def bench_end_to_end(world, state, now0, jax, jnp, datapath_step_jit,
     # sustain_iters a caller passes; both the timed and sustained runs
     # (plus one warmup append) land in the ring before the drain
     n_appends = iters + n_bufs + 1
-    cap = 1
-    while cap < n_appends * (BATCH // 16):
-        cap *= 2
+    cap = _pow2_cap(n_appends * (BATCH // 16))
     ring = EventRing.create(cap)
     # warmup: establish the pool's flows in CT + compile the e2e shapes
     # — NO host fetch (see module doc)
@@ -282,9 +286,7 @@ def bench_end_to_end_wide(world, state, now0, jax, jnp, iters=12):
         rows0 = parse_frames(buf)
     parse_pps = 4 * BATCH / (time.perf_counter() - t0)
 
-    cap = 1
-    while cap < (iters + 2) * (BATCH // 8):
-        cap *= 2
+    cap = _pow2_cap((iters + 2) * (BATCH // 8))
     # warmup: establish the dual-stack pool + compile the wide shapes
     # (throwaway ring: the pool replay is one solid batch of NEW-flow
     # verdict events that would swamp the measured ring)
@@ -351,9 +353,7 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=24,
         # verdicts + 2% scan drops + sampled traces); size the ring at
         # 12.5% of the window so the cadence itself is the experiment,
         # not an undersized buffer
-        ring_cap = 1
-        while ring_cap < drain_every * (BATCH // 8):
-            ring_cap *= 2
+        ring_cap = _pow2_cap(drain_every * (BATCH // 8))
     rng = np.random.default_rng(5)
     pool = steady_flow_pool(world, BATCH, rng)
     frame_bufs = [frames_from_batch(steady_traffic(pool, BATCH, rng))
