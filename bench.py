@@ -45,7 +45,7 @@ BATCH = 1 << 18
 def _pow2_cap(n_events: int) -> int:
     """Smallest power-of-two ring capacity holding ``n_events``
     (EventRing.create asserts 2^k)."""
-    return 1 << max(1, int(n_events) - 1).bit_length()
+    return 1 << max(0, int(n_events) - 1).bit_length()
 BASELINE_PPS = 10_000_000.0  # north-star target
 
 
