@@ -139,6 +139,16 @@ def _make_handler(daemon: Daemon):
                     limit = int(q.get("limit", ["1000"])[0])
                     self._send(200, ct_entries_from_snapshot(
                         daemon.loader.ct_snapshot(), limit))
+                elif path == "/map/nat":
+                    from ..service.nat import nat_entries_from_snapshot
+
+                    snap = daemon.loader.nat_snapshot()
+                    if snap is None:
+                        self._send(200, [])
+                    else:
+                        limit = int(q.get("limit", ["1000"])[0])
+                        self._send(200, nat_entries_from_snapshot(
+                            snap, limit))
                 elif m := re.fullmatch(r"/map/policy/(\d+)", path):
                     self._send(200, _policy_map(daemon, int(m.group(1))))
                 elif path == "/metrics":

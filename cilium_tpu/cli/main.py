@@ -213,6 +213,15 @@ def cmd_bpf(args) -> int:
         for e in entries:
             print(f"{e['cidr']:<24}identity={e['identity']} "
                   f"source={e['source']}")
+    elif args.obj == "nat":
+        entries = c.map_get("nat")
+        if args.json:
+            _print(entries)
+            return 0
+        for e in entries:
+            print(f"{e['proto']} {e['src']}:{e['sport']} -> "
+                  f"{e['dst']}:{e['dport']} node-port={e['node_port']} "
+                  f"expires={e['expires']}")
     return 0
 
 
@@ -402,8 +411,8 @@ def main(argv=None) -> int:
                    choices=["listeners", "xds"])
 
     p = sub.add_parser("bpf", help="bpf ct list | bpf policy get ID | "
-                                   "bpf ipcache list")
-    p.add_argument("obj", choices=["ct", "policy", "ipcache"])
+                                   "bpf ipcache list | bpf nat list")
+    p.add_argument("obj", choices=["ct", "policy", "ipcache", "nat"])
     p.add_argument("action", nargs="?", default="list")
     p.add_argument("id", nargs="?", type=int, default=0)
 

@@ -159,6 +159,29 @@ class NATTable:
         return cls(*children)
 
 
+def nat_entries_from_snapshot(table: np.ndarray,
+                              limit: int = 1000) -> list:
+    """Decode live NAT slots for display (``cilium bpf nat list``):
+    original tuple -> allocated node port (= NAT_PORT_MIN + slot)."""
+    import ipaddress
+
+    table = np.asarray(table)
+    live = np.nonzero(table[:, NV_EXPIRES] > 0)[0][:limit]
+    out = []
+    for s in live:
+        row = table[s]
+        out.append({
+            "node_port": int(NAT_PORT_MIN + s),
+            "src": str(ipaddress.IPv4Address(int(row[NV_SRC]))),
+            "sport": int(row[NV_SPORT]),
+            "dst": str(ipaddress.IPv4Address(int(row[NV_DST]))),
+            "dport": int(row[NV_DP]) >> 8,
+            "proto": int(row[NV_DP]) & 0xFF,
+            "expires": int(row[NV_EXPIRES]),
+        })
+    return out
+
+
 def _nat_hash(words: jnp.ndarray) -> jnp.ndarray:
     """FNV-1a over [N, 4] uint32 key words -> [N] uint32."""
     h = jnp.full(words.shape[0], 0x811C9DC5, dtype=jnp.uint32)

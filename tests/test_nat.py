@@ -380,3 +380,50 @@ class TestSNAT:
         entries = ct_entries_from_snapshot(np.asarray(state.ct.table))
         srcs = {e["src"] for e in entries}
         assert "192.168.0.1" in srcs  # post-NAT source tracked
+
+
+class TestNATMapDisplay:
+    def test_nat_entries_decode_and_rest_surface(self, tmp_path):
+        """`cilium bpf nat list` (r04): live NAT slots decode to the
+        original tuple + allocated node port, served over /map/nat."""
+        import jax.numpy as jnp
+
+        from cilium_tpu.api import APIClient, APIServer
+        from cilium_tpu.core import make_batch
+        from cilium_tpu.service.nat import (NATConfig, NATTable,
+                                            NAT_PORT_MIN,
+                                            nat_entries_from_snapshot,
+                                            snat_egress)
+        from cilium_tpu.datapath.conntrack import CTTable
+
+        t = NATConfig(node_ip="192.168.0.1",
+                      non_masquerade_cidrs=()).compile()
+        tbl = NATTable.create(1 << 10)
+        ct = CTTable.create(1 << 10)
+        pkt = make_batch([dict(src="10.0.2.1", dst="8.8.8.8",
+                               sport=40000, dport=53, proto=17,
+                               ep=1, dir=1)]).data
+        _hdr, tbl = snat_egress(tbl, t, ct, jnp.asarray(pkt),
+                                jnp.uint32(100))
+        [e] = nat_entries_from_snapshot(np.asarray(tbl.table))
+        assert e["src"] == "10.0.2.1" and e["sport"] == 40000
+        assert e["dst"] == "8.8.8.8" and e["dport"] == 53
+        assert e["proto"] == 17 and e["node_port"] >= NAT_PORT_MIN
+
+        # REST: a masquerading daemon serves the same view
+        from cilium_tpu.agent import Daemon, DaemonConfig
+
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12,
+                                masquerade=True,
+                                node_ip="192.168.0.1",
+                                non_masquerade_cidrs=("10.0.0.0/8",)))
+        d.add_endpoint("app-1", ("10.0.2.1",), ["k8s:app=app"])
+        sock = str(tmp_path / "api.sock")
+        server = APIServer(d, sock)
+        server.start()
+        try:
+            c = APIClient(sock)
+            assert c.map_get("nat") == []  # no egress traffic yet
+        finally:
+            server.stop()
+            d.shutdown()
