@@ -46,6 +46,25 @@ class Relay:
                     reverse=not oldest_first)
         return merged[:number]
 
+    def nodes(self) -> List[dict]:
+        """The GetNodes surface (``hubble list nodes``): per-peer
+        availability + flow counts; a dead peer reports unavailable
+        instead of failing the listing."""
+        out = []
+        for name, obs in sorted(self.peers.items()):
+            try:
+                st = (obs.server_status()
+                      if hasattr(obs, "server_status") else {})
+                n = st.get("num_flows",
+                           len(obs) if hasattr(obs, "__len__") else 0)
+                out.append({"name": name, "state": "connected",
+                            "num_flows": int(n),
+                            "seen_flows": int(st.get("seen_flows", n))})
+            except Exception as e:
+                out.append({"name": name, "state": "unavailable",
+                            "error": str(e)[:100]})
+        return out
+
     def server_status(self) -> dict:
         """hubble-relay ServerStatus: aggregate over peers."""
         total = seen = 0

@@ -113,6 +113,18 @@ class TestRelay:
         status = relay.server_status()
         assert status["num_connected_nodes"] == 2
         assert status["num_flows"] == 3
+        # GetNodes (hubble list nodes): per-peer availability
+        nodes = relay.nodes()
+        assert [n["name"] for n in nodes] == ["node-a", "node-b"]
+        assert all(n["state"] == "connected" for n in nodes)
+        assert nodes[0]["num_flows"] == 2 and nodes[1]["num_flows"] == 1
+
+        class Dead:
+            def server_status(self):
+                raise ConnectionError("gone")
+
+        relay.add_peer("node-c", Dead())
+        assert relay.nodes()[2]["state"] == "unavailable"
 
 
 class TestObserverGRPC:
