@@ -329,16 +329,20 @@ def cmd_monitor(args) -> int:
 def cmd_daemon(args) -> int:
     import os
 
-    from ..agent.daemon import Daemon, DaemonConfig
+    from ..agent.config import load_config
+    from ..agent.daemon import Daemon
     from ..api.server import APIServer
 
-    cfg = DaemonConfig(
-        node_name=args.node_name,
-        backend=args.backend,
-        state_dir=args.state_dir,
-        export_path=args.export,
-        anomaly_model_path=args.anomaly_model,
-    )
+    # resolution order (agent/config.py): defaults < --config-dir
+    # files < CILIUM_TPU_* env < explicit CLI flags
+    overrides = {k: v for k, v in {
+        "node_name": args.node_name,
+        "backend": args.backend,
+        "state_dir": args.state_dir,
+        "export_path": args.export,
+        "anomaly_model_path": args.anomaly_model,
+    }.items() if v is not None}
+    cfg = load_config(config_dir=args.config_dir, **overrides)
     d = Daemon(cfg)
     if args.state_dir and d.restore(args.state_dir):
         print(f"restored state from {args.state_dir}")
@@ -444,9 +448,13 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("daemon", help="run the agent")
     p.add_argument("action", choices=["run"])
-    p.add_argument("--backend", default="tpu",
+    p.add_argument("--config-dir",
+                   help="one-file-per-key config dir (the mounted "
+                        "cilium-config ConfigMap layout); CLI flags "
+                        "override it, CILIUM_TPU_* env between")
+    p.add_argument("--backend", default=None,
                    choices=["tpu", "interpreter"])
-    p.add_argument("--node-name", default="node0")
+    p.add_argument("--node-name", default=None)
     p.add_argument("--state-dir")
     p.add_argument("--export", help="flow export JSONL path")
     p.add_argument("--anomaly-model", help="trained AnomalyModel .npz")

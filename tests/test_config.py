@@ -82,3 +82,52 @@ class TestLoadConfig:
         (tmp_path / "node-name").write_text("from-files")
         d = Daemon(load_config(config_dir=str(tmp_path), env={}))
         assert d.config.node_name == "from-files"
+
+
+class TestDaemonRunConfigDir:
+    def test_cli_daemon_resolves_config_dir(self, tmp_path):
+        """`cilium-tpu daemon run --config-dir` boots from the mounted
+        ConfigMap layout; explicit flags still win (subprocess: the
+        run loop blocks forever, so probe the API then kill)."""
+        import json
+        import os
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        cfg_dir = tmp_path / "cfg"
+        cfg_dir.mkdir()
+        (cfg_dir / "backend").write_text("interpreter")
+        (cfg_dir / "node-name").write_text("cfg-name")
+        sock = str(tmp_path / "agent.sock")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "cilium_tpu.cli.main",
+             "--socket", sock, "daemon", "run",
+             "--config-dir", str(cfg_dir),
+             "--node-name", "flag-name"],  # flag beats config-dir
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        try:
+            from cilium_tpu.api import APIClient
+
+            deadline = time.time() + 30
+            st = None
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        f"agent died: {proc.communicate()[0][-800:]}")
+                try:
+                    st = APIClient(sock).healthz()
+                    break
+                except (ConnectionRefusedError, FileNotFoundError,
+                        OSError):
+                    time.sleep(0.2)
+            assert st is not None, "agent never served the API"
+            assert st["node"] == "flag-name"
+            assert st["backend"] == "interpreter"  # from config-dir
+        finally:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
