@@ -282,11 +282,17 @@ def bench_end_to_end_wide(world, state, now0, jax, jnp, iters=12):
     frac_rel = float(np.mean([np.mean((b[:, COL_FLAGS] & FLAG_RELATED)
                                       != 0) for b in batches]))
 
+    # rotating WIDE transfer buffers: same page-registration-cache
+    # trick as the packed path — without it the 16 MB/batch h2d of
+    # fresh numpy arrays collapses to ~1.5 MB/s on the tunneled host
+    out_pool = [np.empty((BATCH + 64, 16), dtype=np.uint32)
+                for _ in range(4)]
+
     # parse-stage rate alone (mixed v4/v6/ICMP-error frames)
-    parse_frames(frame_bufs[0])
+    parse_frames(frame_bufs[0], out=out_pool[0])
     t0 = time.perf_counter()
-    for buf in frame_bufs[:4]:
-        rows0 = parse_frames(buf)
+    for i, buf in enumerate(frame_bufs[:4]):
+        rows0 = parse_frames(buf, out=out_pool[i % 4])
     parse_pps = 4 * BATCH / (time.perf_counter() - t0)
 
     cap = _pow2_cap((iters + 2) * (BATCH // 8))
@@ -304,7 +310,7 @@ def bench_end_to_end_wide(world, state, now0, jax, jnp, iters=12):
 
     t0 = time.perf_counter()
     for i, buf in enumerate(frame_bufs):
-        rows = parse_frames(buf)  # host parse (64 B/pkt rows)
+        rows = parse_frames(buf, out=out_pool[i % 4])  # 64 B/pkt rows
         dev = jax.device_put(rows)
         state, ring = serve_step_jit(state, ring, dev,
                                      jnp.uint32(now0 + 1 + i),

@@ -209,14 +209,19 @@ def wide_frames_from_batch(hdr: np.ndarray) -> bytes:
     return buf[keep].tobytes()
 
 
-def parse_frames(buf: bytes, ep: int = 0,
-                 direction: int = 0) -> np.ndarray:
+def parse_frames(buf: bytes, ep: int = 0, direction: int = 0,
+                 out: np.ndarray = None) -> np.ndarray:
     """Length-prefixed frame stream -> [N, N_COLS] header rows.
 
-    Native C++ when available, Python fallback otherwise."""
+    Native C++ when available, Python fallback otherwise.  ``out``: a
+    reused [max_rows, N_COLS] u32 buffer for transfer-bound callers
+    (page-registration cache; the return is then a VIEW of it)."""
     from .. import native
 
-    rows = native.parse_frames(buf, ep, direction)
+    rows = native.parse_frames(buf, ep, direction, out=out)
     if rows is None:
         rows = native.parse_frames_py(buf, ep, direction)
+        if out is not None:
+            out[:len(rows)] = rows
+            rows = out[:len(rows)]
     return rows

@@ -103,29 +103,43 @@ def available() -> bool:
 
 
 def _call(fn_name: str, buf: bytes, max_rows: int, ep: int,
-          direction: int) -> Optional[np.ndarray]:
+          direction: int,
+          out: Optional[np.ndarray] = None) -> Optional[np.ndarray]:
     lib = _load()
     if lib is None:
         return None
-    out = np.empty((max_rows, N_COLS), dtype=np.uint32)
+    copy = out is None
+    if copy:
+        out = np.empty((max_rows, N_COLS), dtype=np.uint32)
     n = getattr(lib, fn_name)(
         buf, len(buf),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
         max_rows, ep, direction)
     if n < 0:
         raise ValueError("not a pcap buffer")
-    return out[:n].copy()
+    return out[:n].copy() if copy else out[:n]
 
 
 def parse_frames(buf: bytes, ep: int = 0, direction: int = 0,
-                 max_rows: Optional[int] = None) -> Optional[np.ndarray]:
+                 max_rows: Optional[int] = None,
+                 out: Optional[np.ndarray] = None
+                 ) -> Optional[np.ndarray]:
     """Length-prefixed ethernet frame stream -> [N, N_COLS] rows.
 
-    Returns None when the native library is unavailable (callers fall
-    back to the Python parser)."""
-    if max_rows is None:
+    Pass a reused ``out`` buffer ([max_rows, N_COLS] u32,
+    C-contiguous) on transfer-bound paths so h2d hits the host
+    page-registration cache (same contract as parse_frames_packed;
+    the result is then ``out[:n]``, a VIEW).  Returns None when the
+    native library is unavailable (callers fall back to the Python
+    parser)."""
+    if out is not None:
+        if out.dtype != np.uint32 or not out.flags["C_CONTIGUOUS"] \
+                or out.ndim != 2 or out.shape[1] != N_COLS:
+            raise ValueError("out must be C-contiguous [n, N_COLS] u32")
+        max_rows = out.shape[0]
+    elif max_rows is None:
         max_rows = max(len(buf) // 24, 1)  # 4B prefix + >=20B IP
-    return _call("parse_frames", buf, max_rows, ep, direction)
+    return _call("parse_frames", buf, max_rows, ep, direction, out)
 
 
 def parse_frames_packed(buf: bytes, out: Optional[np.ndarray] = None
