@@ -21,9 +21,9 @@ from ..core.packets import (
     COL_PROTO,
     COL_SPORT,
 )
-from ..datapath.verdict import OUT_CT, OUT_ID_ROW
+from ..datapath.verdict import OUT_CT, OUT_ID_ROW, OUT_REASON, OUT_VERDICT
 
-FEAT_DIM = 18
+FEAT_DIM = 20
 
 
 def flow_features(hdr: jnp.ndarray, out: jnp.ndarray
@@ -61,6 +61,13 @@ def flow_features(hdr: jnp.ndarray, out: jnp.ndarray
         (ct == 0).astype(jnp.float32),  # NEW
         (ct == 1).astype(jnp.float32),  # ESTABLISHED
         (ct == 2).astype(jnp.float32),  # REPLY
+        # the POLICY's judgment (BASELINE's metric is anomaly vs eBPF
+        # drops): a scan sweeping random ports lands in default-deny,
+        # while benign bursts target allowed services — the
+        # denied×unusual-port conjunction is what separates held-out
+        # portscan traffic from reconnect-storm hard negatives
+        (out[:, OUT_VERDICT] == 1).astype(jnp.float32),  # allowed
+        (out[:, OUT_REASON] == 2).astype(jnp.float32),  # default-deny
         jnp.ones_like(dirn),  # bias
     ], axis=1)
     return out[:, OUT_ID_ROW].astype(jnp.int32), feats
