@@ -21,6 +21,13 @@ from .daemon import DaemonConfig
 
 ENV_PREFIX = "CILIUM_TPU_"
 
+# CILIUM_TPU_* vars that are NOT DaemonConfig flags: debug/harness
+# switches read directly by other modules (infra/lockdebug.py,
+# __graft_entry__.py).  The env loop must skip them — a documented
+# debug var crashing `daemon run` with "unknown config option" is
+# worse than the typo it guards against.
+ENV_NON_CONFIG = {"LOCKDEBUG", "DRYRUN_CHILD"}
+
 _TRUE = {"true", "1", "yes", "on"}
 _FALSE = {"false", "0", "no", "off"}
 
@@ -111,6 +118,8 @@ def load_config(config_dir: Optional[str] = None,
                       f"config-dir {path}")
     for key, raw in (env if env is not None else os.environ).items():
         if not key.startswith(ENV_PREFIX):
+            continue
+        if key[len(ENV_PREFIX):] in ENV_NON_CONFIG:
             continue
         flag = key[len(ENV_PREFIX):].lower().replace("_", "-")
         # a CILIUM_TPU_* var naming no flag is a typo (MASQUERDE=true

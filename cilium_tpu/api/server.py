@@ -161,14 +161,10 @@ def _make_handler(daemon: Daemon):
                     self._send(200, daemon.proxy.listeners())
                 elif path == "/xds":
                     # the SotW push-surface status an external proxy
-                    # subscribes to (proxy/xds.py)
-                    resp = daemon.xds.discover({}) or {}
-                    self._send(200, {
-                        "version": daemon.xds.version,
-                        "resources": [r["name"] for r in
-                                      resp.get("resources", ())],
-                        "nacks": daemon.xds.nacks[-8:],
-                    })
+                    # subscribes to (proxy/xds.py); snapshot() instead
+                    # of discover() — the long-poll would hang forever
+                    # on a fresh daemon at version 0
+                    self._send(200, daemon.xds.snapshot())
                 elif path == "/service":
                     self._send(200, [s.to_dict()
                                      for s in daemon.services.list()])

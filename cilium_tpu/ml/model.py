@@ -179,14 +179,26 @@ def fit_novelty(params: AnomalyModel, feats: np.ndarray,
 
 
 def save_model(path: str, params: AnomalyModel) -> None:
-    """Persist to .npz (part of the agent checkpoint family)."""
+    """Persist to .npz (part of the agent checkpoint family).  The
+    feature-schema width rides along so a checkpoint trained before a
+    FEAT_DIM bump fails loudly at load, not with an opaque matmul
+    shape error at inference."""
     np.savez_compressed(
-        path, **{k: np.asarray(v) for k, v in zip(
+        path, feat_dim=np.asarray(FEAT_DIM, dtype=np.int32),
+        **{k: np.asarray(v) for k, v in zip(
             _FIELDS, params.tree_flatten()[0])})
 
 
 def load_model(path: str) -> AnomalyModel:
     z = np.load(path)
+    # checkpoints before feat_dim stamping: infer from w1's fan-in
+    saved_dim = (int(z["feat_dim"]) if "feat_dim" in z.files
+                 else int(z["w1"].shape[0] - z["embed"].shape[1]))
+    if saved_dim != FEAT_DIM:
+        raise ValueError(
+            f"anomaly model {path!r} was trained with FEAT_DIM="
+            f"{saved_dim}, but this build uses FEAT_DIM={FEAT_DIM}; "
+            "retrain required (ml/train.py)")
     kw = {}
     for k in _FIELDS:
         if k in z.files:

@@ -118,7 +118,8 @@ def compile_l7(redirects: Sequence[Tuple[int, str, L7Rules]]
                     p_lo, p_hi, ho_lo, ho_hi,
                 ])
                 continue
-            if h.path and not h.headers and _is_literal(h.host):
+            if (h.path and not h.headers and _is_literal(h.host)
+                    and _groupable(h.path)):
                 path_groups.setdefault(
                     (h.method.upper(), h.host), []).append(h.path)
                 continue
@@ -175,11 +176,36 @@ def compile_l7(redirects: Sequence[Tuple[int, str, L7Rules]]
                            ports=frozenset(ports), by_port=by_port)
 
 
+_BACKREF = re.compile(
+    r"\\[1-9]|\(\?P=|\(\?P?<|\((?!\?)|\(\?[aiLmsux-]+\)")
+
+
+def _groupable(path: str) -> bool:
+    """A path regex joins the (method, host) alternation only if it
+    carries no capturing groups, backreferences, or global inline
+    flags — the alternation renumbers groups (``(a)\\1`` would match
+    different text once other patterns precede it), and a ``(?i)``
+    would either fail to compile mid-pattern or leak onto every
+    grouped rule."""
+    return _BACKREF.search(path) is None
+
+
 def _http_group_matcher(meth: str, host: str,
                         paths: Sequence[str]) -> Callable:
     """One matcher for EVERY regex-path rule sharing (method, host):
     a single compiled alternation replaces the per-rule loop."""
-    combined = re.compile("|".join(f"(?:{p})" for p in paths))
+    try:
+        combined = re.compile("|".join(f"(?:{p})" for p in paths))
+    except re.error:
+        # a construct _groupable didn't anticipate: never let one
+        # pattern take down the whole redirect set — match per rule
+        singles = [re.compile(p) for p in paths]
+
+        class combined:  # noqa: N801 — duck-typed fallback
+            @staticmethod
+            def fullmatch(s):
+                return next(
+                    (m for r in singles if (m := r.fullmatch(s))), None)
 
     def match(req) -> bool:
         if not isinstance(req, dict):

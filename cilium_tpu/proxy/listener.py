@@ -21,6 +21,8 @@ import threading
 from typing import Callable, Optional, Tuple
 
 _MAX_HEADER = 64 * 1024
+_MAX_BODY = 8 * 1024 * 1024  # enforcement listener: bound memory
+_REJECT = "reject"  # _read_request sentinel: close with 400, not EOF
 _DENIED = (b"HTTP/1.1 403 Forbidden\r\n"
            b"content-length: 15\r\n"
            b"connection: close\r\n\r\n"
@@ -28,9 +30,14 @@ _DENIED = (b"HTTP/1.1 403 Forbidden\r\n"
 
 
 def _read_request(conn: socket.socket, buf: bytes
-                  ) -> Optional[Tuple[bytes, bytes, bytes]]:
+                  ):
     """Read one HTTP/1.x request (head + body per content-length) ->
-    (head_bytes, body_bytes, leftover_bytes), or None on EOF/overflow.
+    (head_bytes, body_bytes, leftover_bytes), None on EOF, or
+    ``_REJECT`` for a request this listener refuses to frame (header
+    overflow, body over ``_MAX_BODY``, negative/conflicting
+    Content-Length, chunked transfer) — ambiguous framing on an
+    enforcement listener is a smuggling vector, so anything not
+    unambiguously framed closes with 400 at the caller.
 
     ``buf`` carries bytes already received past the previous request
     (pipelined clients) — leftover MUST round-trip through the caller
@@ -41,15 +48,32 @@ def _read_request(conn: socket.socket, buf: bytes
             return None
         buf += chunk
         if len(buf) > _MAX_HEADER:
-            return None
+            return _REJECT
     head, rest = buf.split(b"\r\n\r\n", 1)
     clen = 0
+    seen_clen = False
     for line in head.split(b"\r\n")[1:]:
-        if line.lower().startswith(b"content-length:"):
-            try:
-                clen = int(line.split(b":", 1)[1].strip())
-            except ValueError:
-                return None
+        if line[:1] in (b" ", b"\t"):
+            # obs-fold continuation: an upstream may splice it into
+            # the previous value — framing headers hiding in a fold
+            # are exactly the listener/upstream disagreement to refuse
+            return _REJECT
+        name, _, value = line.partition(b":")
+        name = name.strip().lower()
+        if name == b"content-length":
+            value = value.strip()
+            # strictly digits: int() also takes '+52'/'5_2', which
+            # compliant upstreams reject — no disagreement allowed
+            if not value.isdigit():
+                return _REJECT
+            v = int(value)
+            if seen_clen and v != clen:
+                return _REJECT
+            clen, seen_clen = v, True
+        elif name == b"transfer-encoding":
+            return _REJECT  # chunked would reframe as pipelined reqs
+    if clen > _MAX_BODY:
+        return _REJECT
     while len(rest) < clen:
         chunk = conn.recv(4096)
         if not chunk:
@@ -109,6 +133,14 @@ class HTTPListener:
             while not self._stop.is_set():
                 req = _read_request(conn, leftover)
                 if req is None:
+                    return
+                if req is _REJECT:
+                    try:
+                        conn.sendall(b"HTTP/1.1 400 Bad Request\r\n"
+                                     b"content-length: 0\r\n"
+                                     b"connection: close\r\n\r\n")
+                    except OSError:
+                        pass
                     return
                 head, body, leftover = req
                 [parsed] = parse_http_bytes([head])

@@ -112,6 +112,15 @@ class XDSCache:
         with self._lock:
             return self._version
 
+    def snapshot(self) -> dict:
+        """Non-blocking status view (version + resource names) for the
+        REST /xds endpoint — discover() long-polls when the caller is
+        up to date, which must never stall a status probe."""
+        with self._lock:
+            return {"version": self._version,
+                    "resources": sorted(self._resources),
+                    "nacks": list(self.nacks[-8:])}
+
     def discover(self, request: Optional[dict] = None,
                  timeout: Optional[float] = None) -> Optional[dict]:
         """One DiscoveryRequest -> DiscoveryResponse (or None on

@@ -1,0 +1,205 @@
+"""Round-5 robustness fixes (ADVICE r04).
+
+Covers: the non-blocking /xds status snapshot, the config env-var
+allowlist for documented debug switches, the listener's request-framing
+rejections, anomaly-model FEAT_DIM stamping, and the regex-grouping
+backreference exclusion.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+
+# -- xds snapshot must not long-poll ---------------------------------
+
+def test_xds_snapshot_nonblocking_on_fresh_cache():
+    from cilium_tpu.proxy.xds import XDSCache
+
+    cache = XDSCache()  # version 0, nothing published yet
+    done = []
+
+    def probe():
+        done.append(cache.snapshot())
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout=2.0)
+    assert done, "snapshot() blocked on a fresh cache"
+    assert done[0] == {"version": 0, "resources": [], "nacks": []}
+
+
+def test_xds_snapshot_reflects_published_resources():
+    from cilium_tpu.proxy.xds import XDSCache
+
+    cache = XDSCache()
+    cache.set_resources({"b": {"name": "b"}, "a": {"name": "a"}})
+    snap = cache.snapshot()
+    assert snap["version"] == 1
+    assert snap["resources"] == ["a", "b"]
+
+
+# -- config env allowlist --------------------------------------------
+
+def test_load_config_skips_documented_debug_vars():
+    from cilium_tpu.agent.config import load_config
+
+    cfg = load_config(env={"CILIUM_TPU_LOCKDEBUG": "1",
+                           "CILIUM_TPU_DRYRUN_CHILD": "1"})
+    assert cfg is not None  # no "unknown config option" crash
+
+
+def test_load_config_still_rejects_typos():
+    from cilium_tpu.agent.config import load_config
+
+    with pytest.raises(ValueError, match="unknown config option"):
+        load_config(env={"CILIUM_TPU_MASQUERDE": "true"})
+
+
+# -- listener framing rejections -------------------------------------
+
+def _serve_bytes(payload: bytes) -> bytes:
+    """Run one payload through a terminating-mode HTTPListener and
+    return whatever the listener answers."""
+    from cilium_tpu.proxy.listener import HTTPListener
+
+    class _AllowAll:
+        def handle_http(self, port, reqs, src_row):
+            return np.ones(len(reqs), dtype=np.int32)
+
+    lst = HTTPListener(_AllowAll(), port=15001)
+    try:
+        with socket.create_connection(lst.address, timeout=5) as c:
+            c.sendall(payload)
+            c.settimeout(5)
+            out = b""
+            while True:
+                try:
+                    chunk = c.recv(4096)
+                except socket.timeout:
+                    break
+                if not chunk:
+                    break
+                out += chunk
+            return out
+    finally:
+        lst.close()
+
+
+def test_listener_rejects_negative_content_length():
+    resp = _serve_bytes(b"GET / HTTP/1.1\r\nhost: a\r\n"
+                        b"content-length: -5\r\n\r\n")
+    assert resp.startswith(b"HTTP/1.1 400")
+
+
+def test_listener_rejects_conflicting_content_lengths():
+    resp = _serve_bytes(b"GET / HTTP/1.1\r\nhost: a\r\n"
+                        b"content-length: 3\r\n"
+                        b"content-length: 7\r\n\r\nabcdefg")
+    assert resp.startswith(b"HTTP/1.1 400")
+
+
+def test_listener_rejects_chunked_transfer_encoding():
+    resp = _serve_bytes(b"POST / HTTP/1.1\r\nhost: a\r\n"
+                        b"transfer-encoding: chunked\r\n\r\n"
+                        b"5\r\nhello\r\n0\r\n\r\n")
+    assert resp.startswith(b"HTTP/1.1 400")
+
+
+def test_listener_rejects_oversized_body_declaration():
+    resp = _serve_bytes(b"POST / HTTP/1.1\r\nhost: a\r\n"
+                        b"content-length: 999999999\r\n\r\n")
+    assert resp.startswith(b"HTTP/1.1 400")
+
+
+def test_listener_still_accepts_duplicate_equal_content_length():
+    # equal duplicates are unambiguous; the reject targets conflicts
+    resp = _serve_bytes(b"POST / HTTP/1.1\r\nhost: a\r\n"
+                        b"content-length: 2\r\ncontent-length: 2\r\n"
+                        b"\r\nhi")
+    assert resp.startswith(b"HTTP/1.1 200")
+
+
+# -- model checkpoint FEAT_DIM stamping -------------------------------
+
+def test_model_load_rejects_stale_feat_dim(tmp_path):
+    import jax
+
+    from cilium_tpu.ml import features
+    from cilium_tpu.ml.model import init_params, load_model, save_model
+
+    params = init_params(jax.random.PRNGKey(0), n_rows=8)
+    path = str(tmp_path / "m.npz")
+    save_model(path, params)
+    assert load_model(path) is not None  # round-trips at current dim
+
+    # simulate a checkpoint written under an older, narrower schema
+    z = dict(np.load(path))
+    z["feat_dim"] = np.asarray(features.FEAT_DIM - 2, dtype=np.int32)
+    np.savez_compressed(path, **z)
+    with pytest.raises(ValueError, match="retrain required"):
+        load_model(path)
+
+
+# -- regex grouping excludes backreferences ---------------------------
+
+def test_groupable_excludes_backrefs_and_groups():
+    from cilium_tpu.proxy.l7policy import _groupable
+
+    assert _groupable("/api/v[0-9]+/users")
+    assert _groupable("/files/(?:png|jpg)")
+    assert not _groupable(r"/(a)\1")          # numbered backref
+    assert not _groupable(r"/(?P<x>a)(?P=x)")  # named backref
+    assert not _groupable("/(a)b")             # capturing group
+
+
+def test_backref_path_rule_matches_correctly_when_grouped_with_others():
+    from cilium_tpu.policy.api import PortRuleHTTP, L7Rules
+    from cilium_tpu.proxy.l7policy import compile_l7
+
+    l7 = L7Rules(http=(
+        PortRuleHTTP(method="GET", path="/x/.*"),
+        PortRuleHTTP(method="GET", path=r"/(a+)/\1"),
+    ))
+    tensors = compile_l7([(80, "rule0", l7)])
+    matchers = tensors.host_matchers.get(80, ())
+
+    def matched(path):
+        req = {"method": "GET", "path": path, "host": "", "headers": ()}
+        return any(m(req) for m in matchers)
+
+    assert matched("/aa/aa")       # backref matches same text
+    assert not matched("/aa/aaa")  # and ONLY the same text
+    assert matched("/x/anything")
+
+
+def test_listener_rejects_obs_fold_and_noncanonical_clen():
+    for payload in (
+        b"GET / HTTP/1.1\r\nhost: a\r\nx-pad: x\r\n"
+        b" content-length: 5\r\n\r\nhello",     # obs-fold smuggle
+        b"POST / HTTP/1.1\r\nhost: a\r\n"
+        b"content-length: +5\r\n\r\nhello",     # int() would take it
+        b"POST / HTTP/1.1\r\nhost: a\r\n"
+        b"content-length: 5_0\r\n\r\n",         # underscore literal
+    ):
+        assert _serve_bytes(payload).startswith(b"HTTP/1.1 400")
+
+
+def test_inline_flag_path_rule_does_not_leak_or_crash():
+    from cilium_tpu.policy.api import PortRuleHTTP, L7Rules
+    from cilium_tpu.proxy.l7policy import compile_l7
+
+    l7 = L7Rules(http=(
+        PortRuleHTTP(method="GET", path="(?i)/admin/.*"),
+        PortRuleHTTP(method="GET", path="/x/.*"),
+    ))
+    matchers = compile_l7([(80, "r", l7)]).host_matchers[80]
+
+    def matched(path):
+        req = {"method": "GET", "path": path, "host": "", "headers": ()}
+        return any(m(req) for m in matchers)
+
+    assert matched("/ADMIN/z")   # the (?i) rule still works
+    assert not matched("/X/z")   # and its flag does not leak
