@@ -379,12 +379,16 @@ class Daemon:
                 hdr_dev, _hits = lb_stage_jit(
                     self.services.tensors(),
                     jnp.asarray(np.ascontiguousarray(hdr_dev)))
+            nat_drop = None
             if self.nat is not None:
                 # conntrack-aware egress SNAT with port allocation
                 # (service.nat.snat_egress): inbound-connection
-                # replies keep their source
-                hdr_dev = self.loader.masquerade(self.nat, hdr_dev, now)
-            out, row_map = self.loader.step(hdr_dev, now)
+                # replies keep their source; pool exhaustion marks the
+                # row for a REASON_NAT_EXHAUSTED drop in the step
+                hdr_dev, nat_drop = self.loader.masquerade(
+                    self.nat, hdr_dev, now)
+            out, row_map = self.loader.step(hdr_dev, now,
+                                            pre_drop=nat_drop)
             if self.nat is not None:
                 # reverse translation AFTER the verdict (CT/policy see
                 # the wire tuple; delivery + events see the restored

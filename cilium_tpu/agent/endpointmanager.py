@@ -37,7 +37,7 @@ class EndpointManager:
         self.loader = loader
         self.row_capacity = row_capacity
         self.regenerations = 0
-        repo.named_ports_getter = self.named_ports
+        repo.peer_named_ports_getter = self.named_ports_multimap
         # persistent identity->row map: rows are stable across identity
         # churn so incremental tensor patches address the same row the
         # attached tensors were compiled with (rows are never reused;
@@ -50,17 +50,18 @@ class EndpointManager:
                                       name="endpoint-regeneration")
         self._event_options_cache: Optional[Dict] = None
 
-    def named_ports(self) -> Dict[str, int]:
-        """The node's port-name registry (union over endpoints;
-        last-registered endpoint wins on conflicts — reference:
-        per-endpoint resolution; documented divergence: one registry
-        per node)."""
-        out: Dict[str, int] = {}
+    def named_ports_multimap(self) -> Dict[str, frozenset]:
+        """name -> EVERY port number bound to that name by any
+        endpoint (the NamedPortMultiMap analogue).  Egress rules with
+        named ports expand over all bindings — the destination could
+        be any pod, and last-registered-wins would silently judge one
+        endpoint under another's port."""
+        out: Dict[str, set] = {}
         with self._lock:
-            for ep in sorted(self._endpoints.values(),
-                             key=lambda e: e.created_at):
-                out.update(ep.named_ports)
-        return out
+            for ep in self._endpoints.values():
+                for name, port in ep.named_ports.items():
+                    out.setdefault(name, set()).add(int(port))
+        return {n: frozenset(s) for n, s in out.items()}
 
     def on_attach(self, fn) -> None:
         """Register fn(policies), called after every successful attach
@@ -284,13 +285,18 @@ class EndpointManager:
         policies = []
         row_of: Dict[tuple, int] = {}
         ep_policy: Dict[int, int] = {}
-        resolved: Dict[str, object] = {}
+        resolved: Dict[tuple, object] = {}
         for ep in eps:
-            lkey = ep.labels.sorted_key()
+            # named ports resolve PER ENDPOINT (reference: container
+            # ports belong to the pod) — the distillery key carries the
+            # bindings, so only endpoints that actually differ split
+            np_key = tuple(sorted(ep.named_ports.items()))
+            lkey = (ep.labels.sorted_key(), np_key)
             key = (lkey, ep.enforcement)
             if key not in row_of:
                 if lkey not in resolved:
-                    resolved[lkey] = self.repo.resolve(ep.labels)
+                    resolved[lkey] = self.repo.resolve(
+                        ep.labels, named_ports=ep.named_ports)
                 row_of[key] = len(policies)
                 policies.append(with_enforcement(resolved[lkey],
                                                  ep.enforcement))

@@ -326,7 +326,7 @@ def _policy_map(daemon: Daemon, ep_id: int) -> list:
     ep = daemon.endpoints.get(ep_id)
     if ep is None:
         return []
-    pol = daemon.repo.resolve(ep.labels)
+    pol = daemon.repo.resolve(ep.labels, named_ports=ep.named_ports)
     out = []
     for ms in (pol.ingress, pol.egress):
         for key, entry in ms.to_entries().items():

@@ -44,14 +44,15 @@ class Loader(abc.ABC):
         endpoint id -> row index into ``policies``."""
 
     @abc.abstractmethod
-    def step(self, hdr: np.ndarray, now: int):
+    def step(self, hdr: np.ndarray, now: int, pre_drop=None):
         """Verdict one batch.
 
         Returns ``(out, row_map)``: the out tensor [N, N_OUT] plus the
         IdentityRowMap snapshot that produced it.  The snapshot is
         taken under the same lock as the device step so a concurrent
         ``attach`` can never make the caller decode OUT_ID_ROW values
-        through the wrong row table."""
+        through the wrong row table.  ``pre_drop`` ([N] bool) is the
+        SNAT stage's exhaustion mask from :meth:`masquerade`."""
 
     @abc.abstractmethod
     def gc(self, now: int) -> int:
@@ -148,10 +149,11 @@ class TPULoader(Loader):
                     ct=self.state.ct, metrics=self.state.metrics)
             self.attach_count += 1
 
-    def step(self, hdr, now: int):
+    def step(self, hdr, now: int, pre_drop=None):
         """``hdr`` may be a numpy array OR an already-on-device jax
         array (the LB stage hands its output over without a host
-        round trip)."""
+        round trip).  ``pre_drop`` is the SNAT stage's exhaustion
+        mask (rows drop with REASON_NAT_EXHAUSTED)."""
         from .verdict import datapath_step_jit
 
         jnp = self._jnp
@@ -159,15 +161,17 @@ class TPULoader(Loader):
             hdr = jnp.asarray(np.ascontiguousarray(hdr))
         with self._lock:
             out, self.state = datapath_step_jit(self.state, hdr,
-                                                jnp.uint32(now))
+                                                jnp.uint32(now),
+                                                pre_drop=pre_drop)
             row_map = self.row_map
         return np.asarray(out), row_map
 
     def masquerade(self, nat, hdr, now: int):
         """CT-aware egress SNAT with port allocation (service/nat.py
-        snat_egress); returns the rewritten device hdr.  The NAT
-        table lives with the loader like the CT table does (the
-        pkg/maps/nat analogue)."""
+        snat_egress); returns (rewritten device hdr, exhaustion drop
+        mask) — the mask feeds ``step(pre_drop=...)``.  The NAT table
+        lives with the loader like the CT table does (the pkg/maps/nat
+        analogue)."""
         from ..service.nat import NATTable, snat_egress_jit
 
         jnp = self._jnp
@@ -180,10 +184,10 @@ class TPULoader(Loader):
         with self._lock:
             if self.nat_state is None:
                 self.nat_state = NATTable.create()
-            hdr, self.nat_state = snat_egress_jit(
+            hdr, self.nat_state, dropped = snat_egress_jit(
                 self.nat_state, nat, self.state.ct, hdr,
                 jnp.uint32(now))
-            return hdr
+            return hdr, dropped
 
     def reverse_nat(self, nat, hdr, now: int):
         """Ingress reverse translation (post-verdict delivery rewrite:
@@ -451,11 +455,12 @@ class InterpreterLoader(Loader):
             self.oracle.ct = old_ct
         self.attach_count += 1
 
-    def step(self, hdr: np.ndarray, now: int):
+    def step(self, hdr: np.ndarray, now: int, pre_drop=None):
         from ..core.packets import HeaderBatch, COL_DIR
         from .verdict import N_OUT
 
-        results = self.oracle.step(HeaderBatch(np.asarray(hdr)), now)
+        results = self.oracle.step(HeaderBatch(np.asarray(hdr)), now,
+                                   pre_drop=pre_drop)
         out = np.zeros((len(results), N_OUT), dtype=np.uint32)
         for i, r in enumerate(results):
             out[i] = (r.verdict, r.proxy, r.ct,
@@ -508,8 +513,9 @@ class InterpreterLoader(Loader):
         from ..testing.oracle import OracleDatapath
 
         hdr = np.array(hdr, dtype=np.uint32)
+        dropped = np.zeros(len(hdr), dtype=bool)
         if not nat.enabled:
-            return hdr
+            return hdr, dropped
         table = self._nat_table()
         P = table.shape[0]
         nets = [(int(n), int(m)) for n, m in
@@ -568,10 +574,12 @@ class InterpreterLoader(Loader):
                 else:
                     still.append((i, key, h, proto))
             claimants = still
-        # leftover claimants: pool exhaustion — port-preserving
-        # fallback (parity with snat_egress's `failed` path)
+        # leftover claimants: pool exhaustion — DROP (parity with
+        # snat_egress's `dropped` mask; reference DROP_NAT_NO_MAPPING)
         self.nat_failed += len(claimants)
-        return hdr
+        for i, _key, _h, _proto in claimants:
+            dropped[i] = True
+        return hdr, dropped
 
     def reverse_nat(self, nat, hdr, now: int) -> np.ndarray:
         """Sequential mirror of service.nat.snat_reverse."""

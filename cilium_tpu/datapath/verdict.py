@@ -60,6 +60,7 @@ REASON_POLICY_DENY = 1  # explicit deny rule
 REASON_POLICY_DEFAULT_DENY = 2  # no rule allowed it (default deny)
 REASON_ROUTE_OVERFLOW = 3  # flow-router shard block overflow (RSS queue)
 REASON_NO_ENDPOINT = 4  # unregistered endpoint id (lxcmap miss)
+REASON_NAT_EXHAUSTED = 5  # SNAT port pool exhausted (DROP_NAT_NO_MAPPING)
 N_REASONS = 8
 
 # Event types in the out tensor (monitor vocabulary).
@@ -140,13 +141,22 @@ class DatapathState:
 
 
 def datapath_step(state: DatapathState, hdr: jnp.ndarray,
-                  now: jnp.ndarray, valid: jnp.ndarray = None
+                  now: jnp.ndarray, valid: jnp.ndarray = None,
+                  pre_drop: jnp.ndarray = None
                   ) -> Tuple[jnp.ndarray, DatapathState]:
     """One batched pass of the full verdict pipeline (see module doc).
 
     ``valid`` (optional [N] bool) masks padding rows added by the
     multi-chip flow router; masked rows produce output rows but touch
-    neither CT state nor metrics."""
+    neither CT state nor metrics.
+
+    ``pre_drop`` (optional [N] bool) marks rows an earlier stage
+    already condemned — today the SNAT stage on port-pool exhaustion
+    (reference: DROP_NAT_NO_MAPPING; the reference DROPS rather than
+    emit a colliding node-side tuple).  Policy/lxcmap verdicts keep
+    precedence (upstream order: bpf_lxc judges before host SNAT);
+    rows that would otherwise forward drop with
+    ``REASON_NAT_EXHAUSTED`` and create no CT entry."""
     hdr = hdr.astype(jnp.uint32)
     dirn = hdr[:, COL_DIR].astype(jnp.int32)
     fam = hdr[:, COL_FAMILY].astype(jnp.int32)
@@ -196,6 +206,10 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     # no_ep drops even ESTABLISHED traffic: the endpoint is gone/never
     # existed, so its CT fast path must not forward either
     allowed = (~is_new | allowed_new) & ~no_ep
+    nat_drop = None
+    if pre_drop is not None:
+        nat_drop = pre_drop & allowed  # policy/no_ep drops win
+        allowed = allowed & ~nat_drop
     proxy = jnp.where(is_new, jnp.where(p_verdict == VERDICT_REDIRECT,
                                         p_proxy, 0),
                       ct_proxy)
@@ -211,12 +225,19 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
         jnp.where(no_ep, REASON_NO_ENDPOINT,
                   jnp.where(p_verdict == VERDICT_DENY, REASON_POLICY_DENY,
                             REASON_POLICY_DEFAULT_DENY)))
+    if nat_drop is not None:
+        verdict = jnp.where(nat_drop, VERDICT_DENY, verdict)
+        reason = jnp.where(nat_drop, REASON_NAT_EXHAUSTED, reason)
+        proxy = jnp.where(nat_drop, 0, proxy)
 
     # 5. conntrack create/refresh (create only on allowed NEW; related
     #    rows neither create nor refresh — the ICMP error is evidence
     #    about a flow, not flow traffic; no_ep rows touch nothing).
+    untouched = is_related | no_ep
+    if nat_drop is not None:
+        untouched = untouched | nat_drop  # dropped rows refresh nothing
     ct = ct_update(state.ct, hdr, fwd,
-                   jnp.where(is_related | no_ep, CT_NEW, ct_res), slot,
+                   jnp.where(untouched, CT_NEW, ct_res), slot,
                    is_reply,
                    do_create=allowed & is_new & ~related_hint,
                    proxy_port=proxy.astype(jnp.uint32),

@@ -223,24 +223,36 @@ class PortProtocol:
             return True
 
     def port_range(self, named_ports=None) -> Optional[Tuple[int, int]]:
-        """Resolve to an inclusive [lo, hi] numeric port range.
+        """Resolve to one inclusive [lo, hi] numeric port range (first
+        of :meth:`port_ranges`, or None when the spec matches
+        nothing)."""
+        ranges = self.port_ranges(named_ports)
+        return ranges[0] if ranges else None
 
-        A named port resolves through ``named_ports`` (name -> number,
-        the endpoint port registry); unresolvable names return None
-        and the spec matches nothing (reference: policy with unknown
-        named ports selects no traffic until a pod defines the name)."""
+    def port_ranges(self, named_ports=None) -> List[Tuple[int, int]]:
+        """Resolve to inclusive [lo, hi] numeric port ranges.
+
+        A named port resolves through ``named_ports`` — name -> number
+        for an endpoint's own ports (ingress), or name -> iterable of
+        numbers for the node-wide multimap (egress: the destination
+        could be any pod, so every binding of the name gets an entry;
+        reference: NamedPortMultiMap).  Unresolvable names return []
+        and the spec matches nothing (policy with unknown named ports
+        selects no traffic until a pod defines the name)."""
         if self.icmp_type is not None:
-            return (self.icmp_type, self.icmp_type)
+            return [(self.icmp_type, self.icmp_type)]
         try:
             p = int(self.port or 0)
         except ValueError:
             num = (named_ports or {}).get(self.port)
             if num is None:
-                return None
-            return (int(num), int(num))
+                return []
+            if isinstance(num, (int, str)):
+                return [(int(num), int(num))]
+            return [(int(n), int(n)) for n in sorted(num)]
         if p == 0:
-            return (0, 65535)
-        return (p, self.end_port if self.end_port else p)
+            return [(0, 65535)]
+        return [(p, self.end_port if self.end_port else p)]
 
 
 @dataclass(frozen=True)
@@ -385,7 +397,8 @@ def _fqdn_from_obj(obj) -> str:
 
     Reference: api.FQDNSelector has matchName (exact) and matchPattern
     (glob, ``*`` wildcards).  Patterns keep their ``*`` and are matched
-    with fnmatch against observed fqdn labels at resolve time.
+    under the per-label grammar (fqdn/matchpattern.py) against
+    observed fqdn labels at resolve time.
     """
     if isinstance(obj, str):
         return obj

@@ -95,7 +95,7 @@ class Contribution:
     def selects_labels(self, labels) -> bool:
         """Would an identity with these labels belong to the peer set?
         (The incremental-membership test; wildcard peers select all.)"""
-        import fnmatch
+        from ..fqdn.matchpattern import matches as _pat_matches
 
         if self.identities is None:
             return True
@@ -103,7 +103,7 @@ class Contribution:
             return True
         for pat in self.fqdn_patterns:
             for lab in labels:
-                if lab.source == "fqdn" and fnmatch.fnmatch(lab.key, pat):
+                if lab.source == "fqdn" and _pat_matches(pat, lab.key):
                     return True
         return False
 

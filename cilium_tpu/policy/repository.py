@@ -32,10 +32,13 @@ class PolicyRepository:
         # distillery: subject labels key -> resolved policy @ revision
         self._cache: Dict[str, EndpointPolicy] = {}
         self._listeners: List[Callable[[int], None]] = []
-        # name -> numeric port, fed by the endpoint manager's registry
-        # (reference: named ports resolve against pod container ports)
-        self.named_ports_getter: Optional[Callable[[], Dict[str, int]]] \
-            = None
+        # node-wide named-port MULTIMAP (name -> set of numbers) for
+        # EGRESS rules, where the named port is the destination pod's
+        # (reference: NamedPortMultiMap).  Endpoint churn that changes
+        # bindings calls invalidate(), so cached resolutions never
+        # outlive the map they resolved against.
+        self.peer_named_ports_getter: Optional[
+            Callable[[], Dict[str, frozenset]]] = None
 
     # -- mutation --------------------------------------------------------
     def add_list(self, rules: Sequence[Rule]) -> int:
@@ -101,18 +104,32 @@ class PolicyRepository:
         with self._lock:
             self._listeners.append(fn)
 
-    def resolve(self, subject_labels: LabelSet) -> EndpointPolicy:
-        """Resolve (cached per subject label-set + revision)."""
+    def resolve(self, subject_labels: LabelSet,
+                named_ports: Optional[Dict[str, int]] = None
+                ) -> EndpointPolicy:
+        """Resolve (cached per subject label-set + named-port bindings
+        + revision).
+
+        ``named_ports`` is the ENDPOINT's own name->number map
+        (reference: named ports resolve against the pod's container
+        ports, strictly per endpoint — two endpoints naming the same
+        port differently each get their own resolution); the distillery
+        cache keys on it so label-identical endpoints with identical
+        bindings still share one resolve."""
         key = subject_labels.sorted_key()
+        if named_ports:
+            key += "|np:" + ",".join(
+                f"{n}={p}" for n, p in sorted(named_ports.items()))
         with self._lock:
             pol = self._cache.get(key)
             if pol is not None and pol.revision == self._revision:
                 return pol
-            named = (self.named_ports_getter()
-                     if self.named_ports_getter else None)
+            peer_np = (self.peer_named_ports_getter()
+                       if self.peer_named_ports_getter else None)
             pol = resolve_policy(self._rules, subject_labels,
                                  self.selector_cache, self.allocator,
                                  revision=self._revision,
-                                 named_ports=named)
+                                 named_ports=named_ports,
+                                 peer_named_ports=peer_np)
             self._cache[key] = pol
             return pol

@@ -179,15 +179,16 @@ def _peer_identities(
     # by the DNS-proxy subsystem (reference: pkg/fqdn) as lookups are
     # observed.  Before any DNS activity the set is empty (deny), never
     # a wildcard.  matchPattern globs match against all observed fqdn
-    # labels (reference: api.FQDNSelector.MatchPattern).
-    import fnmatch
+    # labels under the per-label ``*`` grammar (reference:
+    # api.FQDNSelector.MatchPattern via pkg/fqdn/matchpattern).
+    from ..fqdn.matchpattern import matches as _pat_matches
 
     for name in fqdns:
         if "*" in name:
             for ident in selector_cache.known_identities():
                 for lab in ident.labels:
-                    if lab.source == "fqdn" and fnmatch.fnmatch(lab.key,
-                                                                name):
+                    if lab.source == "fqdn" and _pat_matches(name,
+                                                             lab.key):
                         ids.add(ident.numeric_id)
             patterns.append(name)
         else:
@@ -220,16 +221,13 @@ def _port_specs(to_ports: Sequence[PortRule], named_ports=None):
                 out.append((PROTO_ANY, 0, 65535, None))
             continue
         for pp in ports:
-            rng = pp.port_range(named_ports)
-            if rng is None:
-                continue  # unresolved named port: matches nothing
-            lo, hi = rng
-            proto = PROTO_BY_NAME.get(pp.protocol, PROTO_ANY)
-            if proto == PROTO_ANY:
-                for p in (PROTO_TCP, PROTO_UDP, PROTO_SCTP):
-                    out.append((p, lo, hi, l7))
-            else:
-                out.append((proto, lo, hi, l7))
+            for lo, hi in pp.port_ranges(named_ports):
+                proto = PROTO_BY_NAME.get(pp.protocol, PROTO_ANY)
+                if proto == PROTO_ANY:
+                    for p in (PROTO_TCP, PROTO_UDP, PROTO_SCTP):
+                        out.append((p, lo, hi, l7))
+                else:
+                    out.append((proto, lo, hi, l7))
     return out
 
 
@@ -241,6 +239,7 @@ def resolve_policy(
     revision: int = 0,
     proxy_port_for=None,
     named_ports=None,
+    peer_named_ports=None,
 ) -> EndpointPolicy:
     """Resolve the rule set down to per-direction MapStates for a subject.
 
@@ -271,7 +270,13 @@ def resolve_policy(
 
         def emit(ms: MapState, peers: PeerSet,
                  to_ports, is_deny: bool) -> None:
-            for proto, lo, hi, l7 in _port_specs(to_ports, named_ports):
+            # named ports are direction-relative (reference): ingress
+            # names the SUBJECT's own container ports; egress names the
+            # DESTINATION's, which could be any pod — the node-wide
+            # multimap expands every binding of the name
+            np = (named_ports if ms.direction == DIR_INGRESS
+                  else peer_named_ports)
+            for proto, lo, hi, l7 in _port_specs(to_ports, np):
                 redirect = l7 is not None and not is_deny
                 proxy_port = 0
                 if redirect:

@@ -16,7 +16,6 @@ requests no exact rule already admitted.
 
 from __future__ import annotations
 
-import fnmatch
 import re
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Sequence, Tuple
@@ -262,9 +261,11 @@ def _kafka_matcher(rule: dict) -> Callable:
 
 
 def _dns_matcher(pattern: str) -> Callable:
+    from ..fqdn.matchpattern import matches
+
     def match(req) -> bool:
         name = req if isinstance(req, str) else req.get("qname", "")
-        return fnmatch.fnmatch(name, pattern)
+        return matches(pattern, name)
 
     return match
 

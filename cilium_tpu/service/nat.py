@@ -192,22 +192,24 @@ def _nat_hash(words: jnp.ndarray) -> jnp.ndarray:
 
 def snat_egress(tbl: NATTable, t: NATTensors, ct, hdr: jnp.ndarray,
                 now: jnp.ndarray
-                ) -> Tuple[jnp.ndarray, NATTable]:
+                ) -> Tuple[jnp.ndarray, NATTable, jnp.ndarray]:
     """Egress masquerade with port allocation.
 
     Port-bearing egress-to-world rows claim a slot (= unique node
     port) via the CT-style write-then-verify loop; existing mappings
     refresh in place (``claimable`` includes the row's own tuple).
     Rows whose reverse CT entry exists reply to an INBOUND connection
-    and keep their source.  Pool exhaustion falls back to the
-    port-preserving rewrite and counts in ``failed`` (the reference
-    drops; here the verdict stage owns dropping, so the counter is
-    the pressure signal)."""
+    and keep their source.  Pool exhaustion DROPS: the third return
+    is the per-row drop mask the datapath step consumes as
+    ``pre_drop`` (reference: DROP_NAT_NO_MAPPING — a port-preserving
+    fallback could emit two flows with one node-side 5-tuple, exactly
+    the collision SNAT exists to prevent); ``failed`` counts the
+    drops as the pool-pressure signal."""
     from ..datapath.conntrack import _probe, ct_keys_from_headers
 
     hdr = hdr.astype(jnp.uint32)
     if not t.enabled:
-        return hdr, tbl
+        return hdr, tbl, jnp.zeros(hdr.shape[0], dtype=bool)
     P = tbl.capacity
     mask = P - 1
     src = hdr[:, COL_SRC_IP3]
@@ -292,14 +294,15 @@ def snat_egress(tbl: NATTable, t: NATTensors, ct, hdr: jnp.ndarray,
         pending = pending & ~won
 
     allocated = need & ~pending
+    dropped = need & pending  # exhaustion: no slot in the window
     new_port = (jnp.uint32(NAT_PORT_MIN)
                 + final_slot.astype(jnp.uint32))
     hdr = hdr.at[:, COL_SRC_IP3].set(
         jnp.where(masq, t.node_ip, src))
     hdr = hdr.at[:, COL_SPORT].set(
         jnp.where(allocated, new_port, sport))
-    failed = tbl.failed + jnp.sum(need & pending).astype(jnp.uint32)
-    return hdr, NATTable(table=table, failed=failed)
+    failed = tbl.failed + jnp.sum(dropped).astype(jnp.uint32)
+    return hdr, NATTable(table=table, failed=failed), dropped
 
 
 def snat_reverse(tbl: NATTable, t: NATTensors, hdr: jnp.ndarray,

@@ -143,7 +143,15 @@ class OracleDatapath:
         # reply: swap tuple AND hook direction (ipv4_ct_tuple_reverse)
         return (t[1], t[0], t[3], t[2], t[4], 1 - t[5])
 
-    def step(self, batch: HeaderBatch, now: int) -> List[OracleResult]:
+    def step(self, batch: HeaderBatch, now: int,
+             pre_drop=None) -> List[OracleResult]:
+        """``pre_drop`` ([N] bool) marks rows the SNAT stage condemned
+        (pool exhaustion).  Policy/lxcmap drops keep precedence
+        (upstream order: bpf_lxc judges before host SNAT); rows that
+        would otherwise forward drop with REASON_NAT_EXHAUSTED and
+        neither create nor refresh CT."""
+        from ..datapath.verdict import REASON_NAT_EXHAUSTED
+
         results: List[OracleResult] = []
         updates: List[Tuple[tuple, np.ndarray, bool, int, int]] = []
         # phase 1: lookups against the batch-start snapshot
@@ -209,9 +217,17 @@ class OracleDatapath:
                 reason = (REASON_POLICY_DENY if p_verdict == VERDICT_DENY
                           else REASON_POLICY_DEFAULT_DENY)
                 event = EV_DROP
+            if (pre_drop is not None and bool(pre_drop[i])
+                    and reason == REASON_FORWARDED):
+                verdict, proxy = VERDICT_DENY, 0
+                reason, event = REASON_NAT_EXHAUSTED, EV_DROP
             results.append(OracleResult(verdict, proxy, ct_res, ident,
                                         reason, event))
             allowed = reason == REASON_FORWARDED
+            # a NAT-dropped row must not refresh an existing entry
+            # either: CT_NEW + allowed=False touches nothing
+            if reason == REASON_NAT_EXHAUSTED:
+                ct_res = CT_NEW
             updates.append((fwd, row, is_reply, ct_res, proxy if allowed
                             else 0, allowed, related))
         # phase 2: apply CT updates

@@ -1,0 +1,231 @@
+"""Round-5 closures of the verdict-changing semantic divergences.
+
+DIVERGENCES #9 (DNS wildcard spanned dots), #7 (named ports resolved
+node-level last-wins), #17-残 (SNAT exhaustion fell back to
+port-preserving).  Each was a case where this framework silently
+admitted traffic upstream denies; the golden tests here pin the
+upstream-grammar behavior on BOTH backends.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.monitor.api import MSG_DROP
+from cilium_tpu.policy.mapstate import VERDICT_ALLOW, VERDICT_DENY
+
+
+# -- DIVERGENCES #9: per-label DNS wildcards --------------------------
+
+# (pattern, name, upstream verdict) — the per-label grammar corpus
+WILDCARD_CORPUS = [
+    ("*.example.com", "sub.example.com", True),
+    ("*.example.com", "deep.sub.example.com", False),  # the old hole
+    ("*.example.com", "example.com", False),
+    ("*.example.com", "xexample.com", False),  # '*' then literal '.'
+    ("*", "example.com", True),
+    ("*", "a.b.c.example.com", True),
+    ("api-*.example.com", "api-v2.example.com", True),
+    ("api-*.example.com", "api-v2.evil.example.com", False),
+    ("sub.*.example.com", "sub.x.example.com", True),
+    ("sub.*.example.com", "sub.x.y.example.com", False),
+    ("*.*.example.com", "a.b.example.com", True),
+    ("*.*.example.com", "a.b.c.example.com", False),
+    ("example.com", "example.com", True),
+    ("example.com", "Example.COM.", True),  # FQDN-normalized
+    ("example.com", "eexample.com", False),
+]
+
+
+@pytest.mark.parametrize("pattern,name,want", WILDCARD_CORPUS)
+def test_matchpattern_per_label_grammar(pattern, name, want):
+    from cilium_tpu.fqdn.matchpattern import matches
+
+    assert matches(pattern, name) is want
+
+
+def test_dns_l7_rule_uses_per_label_grammar():
+    from cilium_tpu.policy.api import L7Rules, PortRuleDNS
+    from cilium_tpu.proxy.proxy import L7Proxy
+
+    p = L7Proxy()
+    l7 = L7Rules(dns=(PortRuleDNS(match_pattern="*.example.com"),))
+    p.update([type("P", (), {"redirects": [(10053, "r", l7)]})()])
+    got = p.handle_dns(10053, ["ok.example.com",
+                               "deep.sub.example.com"])
+    assert list(got) == [1, 0]
+
+
+def test_tofqdns_pattern_selects_per_label(tmp_path):
+    """An observed DNS name two labels deep must NOT be admitted by a
+    one-label toFQDNs matchPattern (end to end through the daemon's
+    fqdn loop on both backends)."""
+    for backend in ("tpu", "interpreter"):
+        d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+        d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "egress": [{"toFQDNs": [{"matchPattern": "*.example.com"}],
+                        "toPorts": [{"ports": [
+                            {"port": "443", "protocol": "TCP"}]}]}],
+        }])
+        d.start()
+        # the DNS proxy observes both names -> both mint identities
+        d.proxy.observe_answer("ok.example.com", ["198.51.100.7"],
+                               ttl=600)
+        d.proxy.observe_answer("deep.sub.example.com",
+                               ["198.51.100.9"], ttl=600)
+        ep = d.endpoints.list()[0]
+        batch = make_batch([
+            dict(src="10.0.1.1", dst="198.51.100.7", sport=40001,
+                 dport=443, proto=6, flags=TCP_SYN, ep=ep.id, dir=1),
+            dict(src="10.0.1.1", dst="198.51.100.9", sport=40002,
+                 dport=443, proto=6, flags=TCP_SYN, ep=ep.id, dir=1),
+        ]).data
+        ev = d.process_batch(batch, now=5)
+        assert int(ev.verdict[0]) == VERDICT_ALLOW, backend
+        assert int(ev.verdict[1]) != VERDICT_ALLOW, backend
+
+
+# -- DIVERGENCES #7: per-endpoint named ports -------------------------
+
+def _named_port_world(backend):
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+    # two endpoints BOTH name a port "web" but bind it differently
+    a = d.add_endpoint("a", ("10.0.1.1",), ["k8s:app=a"],
+                       named_ports={"web": 8080})
+    b = d.add_endpoint("b", ("10.0.1.2",), ["k8s:app=b"],
+                       named_ports={"web": 9090})
+    d.add_endpoint("client", ("10.0.1.9",), ["k8s:app=client"])
+    d.policy_import([
+        {"endpointSelector": {"matchLabels": {"app": "a"}},
+         "ingress": [{"fromEndpoints": [{"matchLabels":
+                                         {"app": "client"}}],
+                      "toPorts": [{"ports": [
+                          {"port": "web", "protocol": "TCP"}]}]}]},
+        {"endpointSelector": {"matchLabels": {"app": "b"}},
+         "ingress": [{"fromEndpoints": [{"matchLabels":
+                                         {"app": "client"}}],
+                      "toPorts": [{"ports": [
+                          {"port": "web", "protocol": "TCP"}]}]}]},
+    ])
+    return d, a, b
+
+
+@pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+def test_named_ports_resolve_per_endpoint(backend):
+    d, a, b = _named_port_world(backend)
+    batch = make_batch([
+        # a's own binding (8080) allows; b's binding (9090) must NOT
+        # leak onto a
+        dict(src="10.0.1.9", dst="10.0.1.1", sport=40001, dport=8080,
+             proto=6, flags=TCP_SYN, ep=a.id, dir=0),
+        dict(src="10.0.1.9", dst="10.0.1.1", sport=40002, dport=9090,
+             proto=6, flags=TCP_SYN, ep=a.id, dir=0),
+        # and symmetrically for b
+        dict(src="10.0.1.9", dst="10.0.1.2", sport=40003, dport=9090,
+             proto=6, flags=TCP_SYN, ep=b.id, dir=0),
+        dict(src="10.0.1.9", dst="10.0.1.2", sport=40004, dport=8080,
+             proto=6, flags=TCP_SYN, ep=b.id, dir=0),
+    ]).data
+    ev = d.process_batch(batch, now=5)
+    verdicts = [int(v) for v in ev.verdict]
+    assert verdicts[0] == VERDICT_ALLOW
+    assert verdicts[1] != VERDICT_ALLOW  # b's 9090 must not leak to a
+    assert verdicts[2] == VERDICT_ALLOW
+    assert verdicts[3] != VERDICT_ALLOW  # a's 8080 must not leak to b
+
+
+def test_egress_named_port_expands_all_bindings():
+    """An egress rule naming a destination port covers EVERY binding
+    of that name (the NamedPortMultiMap), not the last-registered."""
+    d = Daemon(DaemonConfig(backend="interpreter",
+                            ct_capacity=1 << 12))
+    d.add_endpoint("a", ("10.0.1.1",), ["k8s:app=srv"],
+                   named_ports={"web": 8080})
+    d.add_endpoint("b", ("10.0.1.2",), ["k8s:app=srv"],
+                   named_ports={"web": 9090})
+    client = d.add_endpoint("client", ("10.0.1.9",),
+                            ["k8s:app=client"])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "client"}},
+        "egress": [{"toEndpoints": [{"matchLabels": {"app": "srv"}}],
+                    "toPorts": [{"ports": [
+                        {"port": "web", "protocol": "TCP"}]}]}],
+    }])
+    batch = make_batch([
+        dict(src="10.0.1.9", dst="10.0.1.1", sport=40001, dport=8080,
+             proto=6, flags=TCP_SYN, ep=client.id, dir=1),
+        dict(src="10.0.1.9", dst="10.0.1.2", sport=40002, dport=9090,
+             proto=6, flags=TCP_SYN, ep=client.id, dir=1),
+        dict(src="10.0.1.9", dst="10.0.1.1", sport=40003, dport=7777,
+             proto=6, flags=TCP_SYN, ep=client.id, dir=1),
+    ]).data
+    ev = d.process_batch(batch, now=5)
+    assert int(ev.verdict[0]) == VERDICT_ALLOW
+    assert int(ev.verdict[1]) == VERDICT_ALLOW
+    assert int(ev.verdict[2]) != VERDICT_ALLOW
+
+
+# -- DIVERGENCES #17 residue: SNAT exhaustion drops -------------------
+
+@pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+def test_snat_pool_exhaustion_drops_and_counts(backend):
+    """With every slot of the victim's probe window held by other
+    live tuples, the victim flow must DROP with REASON_NAT_EXHAUSTED
+    (reference: DROP_NAT_NO_MAPPING) — not fall back to a
+    port-preserving rewrite that could collide."""
+    import ipaddress
+
+    from cilium_tpu.datapath.verdict import REASON_NAT_EXHAUSTED
+    from cilium_tpu.service.nat import (NAT_DEFAULT_CAPACITY,
+                                        NAT_PROBE, _nat_hash_py)
+
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12,
+                            masquerade=True, node_ip="192.168.0.1"))
+    ep = d.add_endpoint("pod", ("10.0.2.1", "10.0.2.2"),
+                        ["k8s:app=pod"])
+    P = NAT_DEFAULT_CAPACITY
+
+    def h(src, sport):
+        s = int(ipaddress.IPv4Address(src))
+        dst = int(ipaddress.IPv4Address("8.8.8.8"))
+        return _nat_hash_py((s, sport, dst, (53 << 8) | 17)) % P
+
+    victim_sport = 41000
+    hv = h("10.0.2.2", victim_sport)
+    # fillers: one flow per slot of the victim's window [hv, hv+K);
+    # each hashes exactly onto its slot and claims it first-probe
+    fillers, needed = [], set(range(NAT_PROBE))
+    for p in range(20000, 65000):
+        i = (h("10.0.2.1", p) - hv) % P
+        if i in needed:
+            fillers.append(p)
+            needed.discard(i)
+            if not needed:
+                break
+    assert not needed, "could not fill the window (hash changed?)"
+    batch = make_batch([
+        dict(src="10.0.2.1", dst="8.8.8.8", sport=p, dport=53,
+             proto=17, ep=ep.id, dir=1) for p in fillers
+    ]).data
+    ev1 = d.process_batch(batch, now=5)
+    assert all(int(v) == VERDICT_ALLOW for v in ev1.verdict)
+    assert d.status()["nat"]["alloc-failed"] == 0
+
+    batch2 = make_batch([
+        dict(src="10.0.2.2", dst="8.8.8.8", sport=victim_sport,
+             dport=53, proto=17, ep=ep.id, dir=1),
+    ]).data
+    ev2 = d.process_batch(batch2, now=6)
+    assert int(ev2.verdict[0]) == VERDICT_DENY, backend
+    assert int(ev2.reason[0]) == REASON_NAT_EXHAUSTED, backend
+    assert int(ev2.msg_type[0]) == MSG_DROP, backend
+    # the pressure counter records the drop
+    assert d.status()["nat"]["alloc-failed"] == 1
+    # and the dropped flow created no CT entry
+    from cilium_tpu.datapath.conntrack import ct_entries_from_snapshot
+
+    entries = ct_entries_from_snapshot(d.loader.ct_snapshot(), 1000)
+    assert victim_sport not in {e["sport"] for e in entries}

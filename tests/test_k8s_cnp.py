@@ -161,9 +161,11 @@ class TestNamedPorts:
         ]).data, now=10)
         assert list(evb.verdict) == [0]  # enforcing, nothing matches
 
-    def test_late_endpoint_binds_the_name(self):
-        """A named port defined by a LATER endpoint re-resolves rules
-        (registration invalidates the resolve cache)."""
+    def test_late_endpoint_binds_the_name_for_itself_only(self):
+        """A named port binds strictly per endpoint (r05, upstream
+        semantics): a later endpoint defining the name enforces under
+        its OWN binding, and the name never leaks onto an endpoint
+        that does not define it — even one with identical labels."""
         d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
         db = d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
         d.policy_import([{
@@ -179,9 +181,14 @@ class TestNamedPorts:
             src="10.0.9.9", dst="10.0.2.1", sport=40000, dport=5432,
             proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data
         assert list(d.process_batch(pkt, now=10).verdict) == [0]
-        d.add_endpoint("db-2", ("10.0.2.2",), ["k8s:app=db"],
-                       named_ports={"postgres": 5432})
-        pkt2 = make_batch([dict(
-            src="10.0.9.9", dst="10.0.2.1", sport=40002, dport=5432,
-            proto=6, flags=TCP_SYN, ep=db.id, dir=0)]).data
-        assert list(d.process_batch(pkt2, now=20).verdict) == [1]
+        db2 = d.add_endpoint("db-2", ("10.0.2.2",), ["k8s:app=db"],
+                             named_ports={"postgres": 5432})
+        pkt2 = make_batch([
+            # db-2 defines the name: its own ingress allows 5432
+            dict(src="10.0.9.9", dst="10.0.2.2", sport=40002,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db2.id, dir=0),
+            # db-1 does not: the name still matches nothing there
+            dict(src="10.0.9.9", dst="10.0.2.1", sport=40003,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+        ]).data
+        assert list(d.process_batch(pkt2, now=20).verdict) == [1, 0]

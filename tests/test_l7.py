@@ -158,8 +158,9 @@ class TestDNSVerdicts:
         p = self._proxy([{"matchPattern": "*.example.com"}])
         got = p.handle_dns(10053, ["api.example.com", "example.com",
                                    "deep.sub.example.com", "evil.com"])
-        # fnmatch "*" spans dots, matching upstream's matchPattern
-        assert list(got) == [1, 0, 1, 0]
+        # per-label "*" (upstream pkg/fqdn/matchpattern): a wildcard
+        # never crosses a dot, so deep.sub.example.com does NOT match
+        assert list(got) == [1, 0, 0, 0]
 
     def test_observe_answer_notifies_fqdn_observers(self):
         p = self._proxy([{"matchName": "example.com"}])

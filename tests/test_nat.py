@@ -219,8 +219,8 @@ class TestSNAT:
         pkt = make_batch([dict(src="10.0.2.1", dst="8.8.8.8",
                                sport=40000, dport=53, proto=17,
                                ep=1, dir=1)]).data
-        hdr1, tbl = snat_egress(tbl, t, ct, jnp.asarray(pkt),
-                                jnp.uint32(100))
+        hdr1, tbl, _drop = snat_egress(tbl, t, ct, jnp.asarray(pkt),
+                                       jnp.uint32(100))
         p1 = int(np.asarray(hdr1)[0, COL_SPORT])
         slot = p1 - NAT_PORT_MIN
         # expire a DIFFERENT slot earlier in the probe window — if the
@@ -230,8 +230,8 @@ class TestSNAT:
         # table is empty), and verify the mapping is stable anyway
         from cilium_tpu.service.nat import NAT_LIFETIME_NONTCP
 
-        hdr2, tbl = snat_egress(tbl, t, ct, jnp.asarray(pkt),
-                                jnp.uint32(250))
+        hdr2, tbl, _drop = snat_egress(tbl, t, ct, jnp.asarray(pkt),
+                                       jnp.uint32(250))
         assert int(np.asarray(hdr2)[0, COL_SPORT]) == p1
         assert int(np.asarray(tbl.table)[slot, NV_EXPIRES]) == \
             250 + NAT_LIFETIME_NONTCP
@@ -286,7 +286,9 @@ class TestSNAT:
         from cilium_tpu.datapath.loader import InterpreterLoader
 
         il = InterpreterLoader()
-        np.testing.assert_array_equal(il.masquerade(t, rows, 5), rows)
+        out, dropped = il.masquerade(t, rows, 5)
+        np.testing.assert_array_equal(out, rows)
+        assert not dropped.any()
 
     def test_inbound_reply_is_never_masqueraded(self):
         """r03 review: stateless SNAT corrupted replies of INBOUND
@@ -403,7 +405,7 @@ class TestNATMapDisplay:
         pkt = make_batch([dict(src="10.0.2.1", dst="8.8.8.8",
                                sport=40000, dport=53, proto=17,
                                ep=1, dir=1)]).data
-        _hdr, tbl = snat_egress(tbl, t, ct, jnp.asarray(pkt),
+        _hdr, tbl, _drop = snat_egress(tbl, t, ct, jnp.asarray(pkt),
                                 jnp.uint32(100))
         [e] = nat_entries_from_snapshot(np.asarray(tbl.table))
         assert e["src"] == "10.0.2.1" and e["sport"] == 40000
