@@ -26,7 +26,8 @@ ENV_PREFIX = "CILIUM_TPU_"
 # __graft_entry__.py).  The env loop must skip them — a documented
 # debug var crashing `daemon run` with "unknown config option" is
 # worse than the typo it guards against.
-ENV_NON_CONFIG = {"LOCKDEBUG", "DRYRUN_CHILD"}
+ENV_NON_CONFIG = {"LOCKDEBUG", "DRYRUN_CHILD", "CIC_PCAP",
+                  "CIC_LABELS"}
 
 _TRUE = {"true", "1", "yes", "on"}
 _FALSE = {"false", "0", "no", "off"}

@@ -5,6 +5,23 @@ Features mirror what CIC-IDS2017-style flow classifiers consume
 (packet sizes, flags, ports, direction, CT state) with the remote
 identity handled separately as an embedding index (the SelectorCache
 -derived table in ``ml.model``).
+
+Rate aggregates (r05): per-packet columns cannot see a flood — one
+flood SYN to victim:80 is indistinguishable from a benign SYN — so
+the row also carries BATCH aggregates over hashed traffic keys,
+computed as segment sums on device (one scatter-add + one gather per
+aggregate, fused by XLA):
+
+- (dst, dport, proto) key: how much of this batch converges on one
+  service (log count), how SYN-heavy and how NEW-heavy that
+  convergence is, and how spread its sources/source-ports are (the
+  modal-share proxies below) — the flood signature;
+- (src, proto) key: how many NEW SYNs one source emits and how spread
+  its destination ports are — the scan signature.
+
+On a sharded mesh each shard aggregates its own rows (documented:
+per-shard aggregates approximate the global ones; the batch axis is
+the sequence axis of this framework).
 """
 
 from __future__ import annotations
@@ -16,14 +33,34 @@ import jax.numpy as jnp
 from ..core.packets import (
     COL_DIR,
     COL_DPORT,
+    COL_DST_IP3,
     COL_FLAGS,
     COL_LEN,
     COL_PROTO,
     COL_SPORT,
+    COL_SRC_IP3,
 )
 from ..datapath.verdict import OUT_CT, OUT_ID_ROW, OUT_REASON, OUT_VERDICT
 
-FEAT_DIM = 20
+FEAT_DIM = 27
+
+_N_BUCKETS = 4096  # hashed segment space for the batch aggregates
+
+
+def _bucket(*words) -> jnp.ndarray:
+    """Fold uint32 words into [0, _N_BUCKETS) segment ids."""
+    h = jnp.zeros_like(words[0])
+    for i, w in enumerate(words):
+        h = (h ^ (w * jnp.uint32(0x9E3779B1 + 2 * i))) * jnp.uint32(
+            0x85EBCA77)
+    h = h ^ (h >> 15)
+    return (h & jnp.uint32(_N_BUCKETS - 1)).astype(jnp.int32)
+
+
+def _seg_count(key: jnp.ndarray, weight: jnp.ndarray) -> jnp.ndarray:
+    """Per-row gather of the per-segment sum of ``weight``."""
+    sums = jnp.zeros(_N_BUCKETS, dtype=jnp.float32).at[key].add(weight)
+    return sums[key]
 
 
 def flow_features(hdr: jnp.ndarray, out: jnp.ndarray
@@ -42,6 +79,32 @@ def flow_features(hdr: jnp.ndarray, out: jnp.ndarray
     def bit(b):
         return ((flags >> b) & 1).astype(jnp.float32)
 
+    syn = bit(1)
+    is_new = (ct == 0).astype(jnp.float32)
+
+    # -- batch rate aggregates (see module doc) -----------------------
+    one = jnp.ones_like(proto)
+    svc = _bucket(hdr[:, COL_DST_IP3], hdr[:, COL_DPORT],
+                  hdr[:, COL_PROTO])
+    svc_n = _seg_count(svc, one)
+    svc_syn = _seg_count(svc, syn) / svc_n
+    svc_new = _seg_count(svc, is_new) / svc_n
+    # modal-share proxies for spread: a sub-key's share of its service
+    # key is ~1 for one heavy client and ~1/k under k-way spread —
+    # spoofed-source floods push BOTH toward 0
+    src_share = _seg_count(
+        _bucket(hdr[:, COL_DST_IP3], hdr[:, COL_DPORT],
+                hdr[:, COL_PROTO], hdr[:, COL_SRC_IP3]), one) / svc_n
+    sport_share = _seg_count(
+        _bucket(hdr[:, COL_DST_IP3], hdr[:, COL_DPORT],
+                hdr[:, COL_PROTO], hdr[:, COL_SPORT]), one) / svc_n
+    scan = _bucket(hdr[:, COL_SRC_IP3], hdr[:, COL_PROTO])
+    scan_newsyn = _seg_count(scan, syn * is_new)
+    dport_share = _seg_count(
+        _bucket(hdr[:, COL_SRC_IP3], hdr[:, COL_PROTO],
+                hdr[:, COL_DPORT]), one) / jnp.maximum(
+        _seg_count(scan, one), 1.0)
+
     feats = jnp.stack([
         (proto == 6).astype(jnp.float32),
         (proto == 17).astype(jnp.float32),
@@ -53,12 +116,12 @@ def flow_features(hdr: jnp.ndarray, out: jnp.ndarray
         jnp.log1p(length) / 12.0,
         (length < 100).astype(jnp.float32),  # tiny packets (scans)
         bit(0),  # FIN
-        bit(1),  # SYN
+        syn,  # SYN
         bit(2),  # RST
         bit(3),  # PSH
         bit(4),  # ACK
         dirn,
-        (ct == 0).astype(jnp.float32),  # NEW
+        is_new,  # NEW
         (ct == 1).astype(jnp.float32),  # ESTABLISHED
         (ct == 2).astype(jnp.float32),  # REPLY
         # the POLICY's judgment (BASELINE's metric is anomaly vs eBPF
@@ -68,6 +131,14 @@ def flow_features(hdr: jnp.ndarray, out: jnp.ndarray
         # portscan traffic from reconnect-storm hard negatives
         (out[:, OUT_VERDICT] == 1).astype(jnp.float32),  # allowed
         (out[:, OUT_REASON] == 2).astype(jnp.float32),  # default-deny
+        # rate aggregates (r05, flood/scan signatures)
+        jnp.log1p(svc_n) / 12.0,
+        svc_syn,
+        svc_new,
+        src_share,
+        sport_share,
+        jnp.log1p(scan_newsyn) / 12.0,
+        dport_share,
         jnp.ones_like(dirn),  # bias
     ], axis=1)
     return out[:, OUT_ID_ROW].astype(jnp.int32), feats

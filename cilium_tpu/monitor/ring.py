@@ -29,11 +29,24 @@ import numpy as np
 
 from ..datapath.verdict import EV_TRACE, N_OUT, OUT_EVENT
 
-# ring row: the N_OUT out-columns + packet index within batch + batch seq
+# Decoded ring row: the N_OUT out-columns + packet index within batch
+# + batch seq.  On DEVICE each row packs into RING_WORDS u32 (12 B
+# instead of 32 B) — the drain is a device->host copy, and on tunneled
+# hosts its bandwidth is the monitor plane's ceiling, so the wire
+# format is packed exactly like the reference keeps perf events small.
+# Packing (see _unpack_rows for the decode):
+#   w0: verdict(0..2) | event(3..4) | reason(5..8) | ct(9..11)
+#       | proxy(16..31)
+#   w1: id_row(0..15) | pkt_idx low 16 (16..31)
+#   w2: batch(0..27, wraps) | pkt_idx high 4 (28..31)
+# Limits (asserted where they bind): id_row < 2^16, pkt_idx < 2^20
+# (batches up to 1M rows), batch seq wraps at 2^28.
 RING_COLS = N_OUT + 2
 COL_PKT_IDX = N_OUT
 COL_BATCH = N_OUT + 1
 EMPTY_BATCH = 0xFFFFFFFF
+RING_WORDS = 3
+_EMPTY_W2 = 0xFFFFFFFF  # unreachable batch/pkt combination
 
 
 @jax.tree_util.register_pytree_node_class
@@ -41,7 +54,7 @@ EMPTY_BATCH = 0xFFFFFFFF
 class EventRing:
     """Device state of the ring (pytree: threads through jit)."""
 
-    buf: jnp.ndarray  # [capacity, RING_COLS] uint32
+    buf: jnp.ndarray  # [capacity, RING_WORDS] uint32 (packed rows)
     # total events ever appended, as TWO u32 words [lo, hi] — a single
     # u32 wraps after 2^32 events (hours at target rates; the reference
     # perf/Hubble rings count in u64) and a wrapped cursor makes drain
@@ -52,7 +65,7 @@ class EventRing:
     @staticmethod
     def create(capacity: int = 1 << 15) -> "EventRing":
         assert capacity & (capacity - 1) == 0, "capacity must be 2^k"
-        buf = jnp.full((capacity, RING_COLS), EMPTY_BATCH,
+        buf = jnp.full((capacity, RING_WORDS), _EMPTY_W2,
                        dtype=jnp.uint32)
         return EventRing(buf=buf, cursor=jnp.zeros((2,), jnp.uint32))
 
@@ -84,6 +97,7 @@ def ring_append(ring: EventRing, out: jnp.ndarray, batch_id: jnp.ndarray,
         keep = keep | (idx % trace_sample == 0)
     if valid is not None:
         keep = keep & valid
+    assert n < (1 << 20), "pkt_idx packs into 20 bits"
     pos = jnp.cumsum(keep) - 1  # position among kept rows
     count = keep.sum().astype(jnp.uint32)
     mask = ring.capacity - 1
@@ -95,11 +109,17 @@ def ring_append(ring: EventRing, out: jnp.ndarray, batch_id: jnp.ndarray,
     # order unspecified
     newest = pos.astype(jnp.uint32) + ring.capacity >= count
     target = jnp.where(keep & newest, slot, ring.capacity)  # OOB dropped
-    rows = jnp.concatenate([
-        out.astype(jnp.uint32),
-        idx[:, None],
-        jnp.full((n, 1), batch_id, dtype=jnp.uint32),
-    ], axis=1)
+    o = out.astype(jnp.uint32)
+    from ..datapath.verdict import (OUT_CT, OUT_ID_ROW, OUT_PROXY,
+                                    OUT_REASON, OUT_VERDICT)
+
+    w0 = (o[:, OUT_VERDICT] | (o[:, OUT_EVENT] << 3)
+          | (o[:, OUT_REASON] << 5) | (o[:, OUT_CT] << 9)
+          | (o[:, OUT_PROXY] << 16))
+    w1 = o[:, OUT_ID_ROW] | (idx << 16)
+    w2 = ((jnp.uint32(batch_id) & jnp.uint32(0x0FFFFFFF))
+          | ((idx >> 16) << 28))
+    rows = jnp.stack([w0, w1, w2], axis=1)
     buf = ring.buf.at[target].set(rows, mode="drop")
     new_lo = lo + count
     new_hi = hi + (new_lo < lo).astype(jnp.uint32)  # carry
@@ -144,6 +164,80 @@ serve_step_packed_jit = jax.jit(serve_step_packed, donate_argnums=(0, 1),
                                 static_argnames=("trace_sample",))
 
 
+class AsyncRingDrainer:
+    """Double-buffered drain: the host fetches window N-1 while the
+    device steps window N.
+
+    ``ring_drain`` blocks on a device->host copy that must first
+    retire every dispatch queued since the previous fetch — on
+    tunneled TPUs that sync debt dominates the drain (r04:
+    drain_ms_median 10.3 s).  Double buffering hides it: at each
+    window boundary ``swap(ring)`` starts an ASYNC copy of the
+    just-filled ring and hands the serve loop a fresh one, and
+    ``collect()`` completes the transfer that has been streaming in
+    the background — by then the bytes are already on host.  This is
+    also the production shape of the reference's perf-buffer consumer
+    (the kernel keeps appending to live pages while userspace reads
+    the pages it was handed).
+
+    Because every window starts on a fresh ring, the fetched cursor
+    IS the window's append count and per-window loss is
+    ``max(0, appended - capacity)`` with no cross-window bookkeeping.
+    """
+
+    def __init__(self, capacity: int = 1 << 15):
+        self.capacity = capacity
+        self._pending: EventRing = None
+        self.windows = 0
+        self.events = 0
+        self.lost = 0
+
+    def fresh(self) -> EventRing:
+        return EventRing.create(self.capacity)
+
+    def swap(self, ring: EventRing) -> EventRing:
+        """Start the async fetch of ``ring``; returns the fresh ring
+        for the next window.  At most one fetch may be in flight:
+        call :meth:`collect` first."""
+        assert self._pending is None, "previous window not collected"
+        ring.buf.copy_to_host_async()
+        ring.cursor.copy_to_host_async()
+        self._pending = ring
+        return self.fresh()
+
+    def collect(self) -> Tuple[np.ndarray, int, int]:
+        """Complete the in-flight fetch -> (rows, appended, lost) for
+        that window (empty result when nothing is pending)."""
+        ring = self._pending
+        if ring is None:
+            return np.zeros((0, RING_COLS), dtype=np.uint32), 0, 0
+        self._pending = None
+        rows, appended, lost = ring_drain(ring)
+        self.windows += 1
+        self.events += appended - lost
+        self.lost += lost
+        return rows, appended, lost
+
+
+def _unpack_rows(packed: np.ndarray) -> np.ndarray:
+    """Packed [m, RING_WORDS] device rows -> decoded [m, RING_COLS]
+    (OUT_* columns + pkt_idx + batch), pure host numpy."""
+    from ..datapath.verdict import (OUT_CT, OUT_ID_ROW, OUT_PROXY,
+                                    OUT_REASON, OUT_VERDICT)
+
+    w0, w1, w2 = packed[:, 0], packed[:, 1], packed[:, 2]
+    rows = np.empty((len(packed), RING_COLS), dtype=np.uint32)
+    rows[:, OUT_VERDICT] = w0 & 0x7
+    rows[:, OUT_EVENT] = (w0 >> 3) & 0x3
+    rows[:, OUT_REASON] = (w0 >> 5) & 0xF
+    rows[:, OUT_CT] = (w0 >> 9) & 0x7
+    rows[:, OUT_PROXY] = w0 >> 16
+    rows[:, OUT_ID_ROW] = w1 & 0xFFFF
+    rows[:, COL_PKT_IDX] = (w1 >> 16) | ((w2 >> 28) << 16)
+    rows[:, COL_BATCH] = w2 & 0x0FFFFFFF
+    return rows
+
+
 def ring_drain(ring: EventRing) -> Tuple[np.ndarray, int, int]:
     """Fetch + decode the ring on host.
 
@@ -161,5 +255,5 @@ def ring_drain(ring: EventRing) -> Tuple[np.ndarray, int, int]:
         head = total & (cap - 1)
         rows = np.concatenate([buf[head:], buf[:head]])
         lost = total - cap
-    rows = rows[rows[:, COL_BATCH] != EMPTY_BATCH]
-    return rows, total, lost
+    rows = rows[rows[:, RING_WORDS - 1] != _EMPTY_W2]
+    return _unpack_rows(rows), total, lost
