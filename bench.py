@@ -343,8 +343,9 @@ def bench_end_to_end_wide(world, state, now0, jax, jnp, iters=12):
     }, state
 
 
-def bench_ring_steady_state(world, state, now0, jax, jnp, batches=48,
-                            drain_every=4, ring_cap=None):
+def bench_ring_steady_state(world, state, now0, jax, jnp, batches=64,
+                            drain_every=4, ring_cap=None,
+                            fresh_frac=20):
     """Sustained monitor-plane cadence with OVERLAPPED drains: the
     host fetches window N-1 (AsyncRingDrainer, monitor/ring.py) while
     the device steps window N — the production double-buffered drain
@@ -352,12 +353,25 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=48,
     10.3 s of queued-dispatch sync debt on the tunneled harness).
     Loss accounting stays per window: every window starts on a fresh
     ring, so its fetched cursor is its append count and loss is
-    ``max(0, appended - capacity)``."""
-    from cilium_tpu import native
-    from cilium_tpu.core.ingest import frames_from_batch
-    from cilium_tpu.monitor.ring import (AsyncRingDrainer,
-                                         serve_step_packed_jit)
-    from cilium_tpu.testing.fixtures import steady_flow_pool, steady_traffic
+    ``max(0, appended - capacity)``.
+
+    Traffic is generated ON DEVICE from a pre-staged flow pool (one
+    gather + sport churn per batch, fused into the serve step): this
+    phase measures the MONITOR plane — verdict + ring append +
+    concurrent drain — and host->device ingest is the e2e phases' job.
+    On the tunneled harness the two cannot be measured together in one
+    process (measured r02-r05: a d2h fetch pays ~1 s per intervening
+    4 MB h2d put, an artifact absent on directly-attached TPUs);
+    1/``fresh_frac`` of each batch gets rotating source ports, so CT
+    sees a steady NEW-flow churn and the ring a production event mix.
+    """
+    from functools import partial
+
+    from cilium_tpu.core.packets import COL_SPORT
+    from cilium_tpu.datapath.verdict import datapath_step
+    from cilium_tpu.monitor.ring import (AsyncRingDrainer, ring_append,
+                                         serve_step_jit)
+    from cilium_tpu.testing.fixtures import steady_flow_pool
 
     if ring_cap is None:
         # a drain window carries ~7% of its packets as events (5% new
@@ -366,18 +380,26 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=48,
         # not an undersized buffer
         ring_cap = _pow2_cap(drain_every * (BATCH // 8))
     rng = np.random.default_rng(5)
-    pool = steady_flow_pool(world, BATCH, rng)
-    frame_bufs = [frames_from_batch(steady_traffic(pool, BATCH, rng))
-                  for _ in range(batches)]
-    out_pool = [np.empty((BATCH + 64, 4), dtype=np.uint32)
-                for _ in range(4)]
-    use_native = native.available()
+    pool = jnp.asarray(steady_flow_pool(world, 2 * BATCH, rng))
+    fresh_n = BATCH // fresh_frac
 
-    def parse(buf, i):
-        fn = (native.parse_frames_packed if use_native
-              else native.parse_frames_packed_py)
-        rows, _, _ = fn(buf, out_pool[i % 4])
-        return rows
+    @partial(jax.jit, donate_argnums=(0, 1),
+             static_argnames=("trace_sample",))
+    def serve_gen_step(st, ring, pool, i, now, trace_sample=1024):
+        # batch i = a rotating window of the pool (established flows)
+        # + a slice of never-seen source ports (NEW churn)
+        idx = (i * jnp.uint32(40503) + jnp.arange(BATCH,
+                                                  dtype=jnp.uint32)
+               ) % jnp.uint32(pool.shape[0])
+        hdr = pool[idx.astype(jnp.int32)]
+        fresh_sport = (jnp.uint32(33000)
+                       + (i * jnp.uint32(fresh_n)
+                          + jnp.arange(fresh_n, dtype=jnp.uint32))
+                       % jnp.uint32(30000))
+        hdr = hdr.at[:fresh_n, COL_SPORT].set(fresh_sport)
+        out, st = datapath_step(st, hdr, now)
+        ring = ring_append(ring, out, i, trace_sample=trace_sample)
+        return st, ring
 
     zero = jnp.uint32(0)
     drainer = AsyncRingDrainer(ring_cap)
@@ -386,12 +408,10 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=48,
     # this, the first windows are solid NEW-verdict floods and the
     # "loss" is a warmup artifact, not a drain-cadence property
     ring = drainer.fresh()
-    from cilium_tpu.monitor.ring import serve_step_jit
-    state, ring = serve_step_jit(state, ring, jnp.asarray(pool),
+    state, ring = serve_step_jit(state, ring, pool,
                                  jnp.uint32(now0), zero)
-    state, ring = serve_step_packed_jit(
-        state, ring, jax.device_put(parse(frame_bufs[0], 0)),
-        jnp.uint32(now0), zero, zero, zero)
+    state, ring = serve_gen_step(state, ring, pool, zero,
+                                 jnp.uint32(now0))
     ring.cursor.block_until_ready()
     # absorb the accumulated tunnel warmup debt off the clock
     t0 = time.perf_counter()
@@ -401,11 +421,10 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=48,
 
     swap_times = []
     t_run = time.perf_counter()
-    for i, buf in enumerate(frame_bufs):
-        rows = parse(buf, i)
-        state, ring = serve_step_packed_jit(
-            state, ring, jax.device_put(rows), jnp.uint32(now0 + 1 + i),
-            jnp.uint32(i), zero, zero)
+    for i in range(batches):
+        state, ring = serve_gen_step(state, ring, pool,
+                                     jnp.uint32(1 + i),
+                                     jnp.uint32(now0 + 1 + i))
         if (i + 1) % drain_every == 0:
             # collect window N-1 (already streamed to host while this
             # window was stepping), then hand the filled ring to the
@@ -416,6 +435,7 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=48,
             swap_times.append(time.perf_counter() - t0)
     drainer.collect()  # the last in-flight window
     dt = time.perf_counter() - t_run
+    drained_mb = drainer.windows * ring_cap * 12 / 1e6
     return {
         "sustained_pps_with_drains": round(BATCH * batches / dt),
         "batches": batches,
@@ -424,13 +444,18 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=48,
         "windows_drained": int(drainer.windows),
         "events_drained": int(drainer.events),
         "window_lost": int(drainer.lost),
+        "fresh_flow_frac": round(1 / fresh_frac, 3),
+        "drained_mb": round(drained_mb, 1),
+        "drain_mb_per_s": round(drained_mb / dt, 1),
         "pre_phase_sync_ms": sync_ms,
         "drain_ms_median": round(sorted(swap_times)[
             len(swap_times) // 2] * 1e3, 1),
         "note": ("double-buffered drain: collect(window N-1) + async "
                  "swap while window N steps; per-window loss "
                  "accounting on a bounded ring (12 B/event packed "
-                 "wire format)"),
+                 "wire format); traffic generated on device from a "
+                 "pre-staged pool — ingest is the e2e phases' "
+                 "measurement"),
     }, state
 
 
@@ -523,6 +548,84 @@ def bench_l7(batch: int = 4096, iters: int = 24, n_exact: int = 192,
     }
 
 
+def bench_socket_lb(n_services=512, iters=9) -> dict:
+    """Socket-LB delta (SURVEY §2a bpf_sock row): per-packet LB cost
+    on ESTABLISHED traffic, flow-cached probe (service/socklb.py) vs
+    the per-packet [N, S] frontend compare + Maglev (lb_stage)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.core.packets import (COL_DPORT, COL_DST_IP3,
+                                         COL_FAMILY, COL_PROTO,
+                                         COL_SPORT, COL_SRC_IP3,
+                                         N_COLS)
+    from cilium_tpu.service import ServiceManager, lb_stage_jit
+    from cilium_tpu.service.socklb import SockLBTable, socklb_stage_jit
+
+    m = ServiceManager()
+    for i in range(n_services):
+        vip = f"172.16.{i // 256}.{i % 256}"
+        m.upsert(f"svc{i}", f"{vip}:80",
+                 [f"10.1.{i // 256}.{i % 256}:8080",
+                  f"10.2.{i // 256}.{i % 256}:8080"])
+    t = m.tensors()
+    rng = np.random.default_rng(11)
+    hdr = np.zeros((BATCH, N_COLS), dtype=np.uint32)
+    hdr[:, COL_FAMILY] = 4
+    hdr[:, COL_SRC_IP3] = rng.integers(1, 2**31, BATCH)
+    svc_rows = rng.random(BATCH) < 0.5
+    vip_ips = np.asarray(t.svc_ip)
+    hdr[:, COL_DST_IP3] = np.where(
+        svc_rows, rng.choice(vip_ips, BATCH),
+        rng.integers(1, 2**31, BATCH))
+    hdr[:, COL_DPORT] = np.where(svc_rows, 80,
+                                 rng.integers(1, 65535, BATCH))
+    hdr[:, COL_SPORT] = rng.integers(1024, 65535, BATCH)
+    hdr[:, COL_PROTO] = 6
+    jhdr = jnp.asarray(hdr)
+    now = jnp.uint32(100)
+
+    def median_time(fn, reps=iters):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn()
+            jax.block_until_ready(out)
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    out0 = lb_stage_jit(t, jhdr)  # compile
+    jax.block_until_ready(out0)
+    dt_compare = median_time(lambda: lb_stage_jit(t, jhdr))
+
+    tbl = SockLBTable.create(1 << 20)
+    box = [tbl]
+    _, _, box[0] = socklb_stage_jit(box[0], t, jhdr, now)  # compile
+    _h, hit, box[0] = socklb_stage_jit(box[0], t, jhdr, now)  # warm
+    jax.block_until_ready(hit)  # cache now holds every flow
+
+    def cached_step():
+        h2, hit2, box[0] = socklb_stage_jit(box[0], t, jhdr, now)
+        return hit2
+
+    dt_cached = median_time(cached_step)
+    return {
+        "n_services": n_services,
+        "batch": BATCH,
+        "per_packet_compare_pps": round(BATCH / dt_compare),
+        "flow_cached_pps": round(BATCH / dt_cached),
+        "est_path_speedup": round(dt_compare / dt_cached, 2),
+        "note": ("established-path LB: connect-time resolution cached "
+                 "per flow (bpf_sock analogue) vs per-packet [N,S] "
+                 "frontend compare + Maglev"),
+    }
+
+
+def _run_socklb_phase() -> None:
+    """--socklb: the socket-LB delta standalone (one JSON line)."""
+    print(json.dumps(bench_socket_lb()))
+
+
 def bench_anomaly() -> dict:
     """BASELINE eval config #5 in a SUBPROCESS: a fresh process gets a
     fresh tunnel session, so the training loop (fetch-free) and this
@@ -609,6 +712,7 @@ def main() -> None:
     # the ~4.5 s axon artifact (see _phase_subprocess)
     e2e_wide = _phase_subprocess("--wide")
     ring_ss = _phase_subprocess("--ring")
+    socklb = _phase_subprocess("--socklb")
     artifact = bench_full_readback(world, state, now + 300, jax, jnp,
                                    datapath_step_jit)
     l7 = bench_l7()
@@ -622,6 +726,7 @@ def main() -> None:
         "end_to_end": e2e,
         "end_to_end_wide": e2e_wide,
         "ring_steady_state": ring_ss,
+        "socket_lb": socklb,
         "d2h_artifact": artifact,
         "l7": l7,
         "anomaly_auc": anomaly.get("value"),
@@ -636,5 +741,7 @@ if __name__ == "__main__":
         _run_wide_phase()
     elif "--ring" in sys.argv:
         _run_ring_phase()
+    elif "--socklb" in sys.argv:
+        _run_socklb_phase()
     else:
         main()

@@ -172,6 +172,9 @@ class Daemon:
         from ..service import ServiceManager
 
         self.services = ServiceManager()
+        # connect-time LB flow cache (service/socklb.py, the bpf_sock
+        # analogue): created on first service traffic
+        self._socklb = None
         # egress masquerade (applies after LB, before the datapath, so
         # CT tracks the post-NAT tuple)
         self.nat = None
@@ -374,11 +377,19 @@ class Daemon:
             # rewritten rows anyway
             hdr_dev = hdr
             if len(self.services):
-                from ..service import lb_stage_jit
+                # connect-time translation with a per-flow cache
+                # (socket-LB analogue): established flows ride a
+                # window probe; only genuinely-new flows pay the
+                # frontend compare + Maglev
+                from ..service.socklb import (SockLBTable,
+                                              socklb_stage_jit)
 
-                hdr_dev, _hits = lb_stage_jit(
-                    self.services.tensors(),
-                    jnp.asarray(np.ascontiguousarray(hdr_dev)))
+                if self._socklb is None:
+                    self._socklb = SockLBTable.create()
+                hdr_dev, _hits, self._socklb = socklb_stage_jit(
+                    self._socklb, self.services.tensors(),
+                    jnp.asarray(np.ascontiguousarray(hdr_dev)),
+                    jnp.uint32(now))
             nat_drop = None
             if self.nat is not None:
                 # conntrack-aware egress SNAT with port allocation

@@ -1,0 +1,143 @@
+"""Warm-standby replication + failover for the networked kvstore.
+
+Reference: upstream cilium's availability story for cluster state is
+etcd raft.  DIVERGENCES #14 deliberately keeps a single leader here;
+this module adds the availability layer around it: a
+:class:`WarmStandby` seeds itself from the primary's ``snapshot`` op
+(data + revisions + remaining lease TTLs), tails the primary's watch
+stream (every mutation replays into the standby's own store), and
+polls ``lease_dump`` so keepalives — which extend leases WITHOUT
+emitting watch events — keep the standby's lease copies alive.
+Clients carry a failover address list (``RemoteKVStore`` walks it on
+every re-dial), so killing the primary lands them on the standby with
+their watches re-subscribed and replayed.
+
+Divergence vs raft (documented, deliberate): replication is
+asynchronous — a write acknowledged by the primary in the instant
+before it dies can be lost.  The allocator's claim discipline
+(create-only + write-then-verify + lease fencing) re-converges after
+failover; what raft would add is durability of that last instant, not
+correctness of the survivors.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .remote import KVStoreServer, RemoteKVStore
+from .store import InMemoryKVStore, KVEvent
+
+__all__ = ["WarmStandby"]
+
+
+class WarmStandby:
+    """A live KVStoreServer mirroring a primary until it dies.
+
+    The standby SERVES from birth (clients only dial it once the
+    primary stops answering, so pre-failover staleness is invisible);
+    ``promoted`` flips when replication loses the primary for longer
+    than ``grace`` seconds, after which the standby is authoritative.
+    """
+
+    def __init__(self, primary_address, path: Optional[str] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 lease_poll: float = 0.2, grace: float = 1.0,
+                 lease_tick: float = 0.2):
+        self.store = InMemoryKVStore()
+        self.server = KVStoreServer(self.store, path=path, host=host,
+                                    port=port, lease_tick=lease_tick)
+        self.address = self.server.address
+        self.promoted = False
+        self._closed = False
+        self._grace = grace
+        self._lease_poll = lease_poll
+        # the replication client's timeouts bound promotion latency:
+        # a dead primary must fail lease_dump within ~grace, not a
+        # 5 s dial budget (first dial still gets a real budget via
+        # the constructor's blocking snapshot call)
+        self._repl = RemoteKVStore(primary_address,
+                                   dial_timeout=max(grace, 0.2),
+                                   call_timeout=max(grace, 0.5),
+                                   reconnect=True, max_backoff=0.2)
+        # subscribe FIRST (replay=False), buffering events, then seed
+        # from the snapshot, then apply the buffer — no mutation can
+        # fall between the snapshot and the watch subscription
+        self._buffer: list = []
+        self._buffering = True
+        self._buf_lock = threading.Lock()
+        # per-key applied revision: the buffer drain (main thread) can
+        # interleave with live dispatch; an older event must never
+        # clobber a newer applied state (create rev5 after delete rev7
+        # would resurrect the key)
+        self._key_rev: dict = {}
+        self._repl.watch_prefix("", self._apply, replay=False)
+        snap = self._repl.snapshot()
+        now = time.time()
+        with self.store._lock:
+            for k, (v, rev) in snap["data"].items():
+                self.store._data[k] = (v, rev)
+            for k, ttl in snap["leases"].items():
+                self.store._leases[k] = now + ttl
+            self.store._revision = max(self.store._revision,
+                                       snap["revision"])
+        with self._buf_lock:
+            buffered, self._buffering = self._buffer, False
+            self._buffer = []
+        for ev in buffered:
+            if ev.revision > snap["revision"]:
+                self._apply(ev)
+        threading.Thread(target=self._lease_loop, daemon=True).start()
+
+    # -- replication ---------------------------------------------------
+    def _apply(self, ev: KVEvent) -> None:
+        if self.promoted or self._closed:
+            return
+        with self._buf_lock:
+            if self._buffering:
+                self._buffer.append(ev)
+                return
+        with self.store._lock:
+            if ev.revision <= self._key_rev.get(ev.key, 0):
+                return
+            self._key_rev[ev.key] = ev.revision
+            if ev.kind == "delete":
+                self.store._data.pop(ev.key, None)
+                self.store._leases.pop(ev.key, None)
+            else:
+                self.store._data[ev.key] = (ev.value, ev.revision)
+                if ev.ttl is not None:
+                    self.store._leases[ev.key] = time.time() + ev.ttl
+            self.store._revision = max(self.store._revision,
+                                       ev.revision)
+
+    def _lease_loop(self) -> None:
+        last_ok = time.time()
+        while not self._closed and not self.promoted:
+            time.sleep(self._lease_poll)
+            try:
+                leases = self._repl.lease_dump()
+                last_ok = time.time()
+            except (ConnectionError, TimeoutError, RuntimeError):
+                if time.time() - last_ok > self._grace:
+                    self.promote()
+                continue
+            now = time.time()
+            with self.store._lock:
+                for k, ttl in leases.items():
+                    if k in self.store._data:
+                        self.store._leases[k] = now + ttl
+
+    # -- lifecycle -----------------------------------------------------
+    def promote(self) -> None:
+        """Become authoritative: stop replicating, keep serving."""
+        if self.promoted:
+            return
+        self.promoted = True
+        self._repl.close()
+
+    def close(self) -> None:
+        self._closed = True
+        self._repl.close()
+        self.server.close()

@@ -133,8 +133,15 @@ class _Conn:
                 wid = req["wid"]
 
                 def push(ev: KVEvent, _wid=wid) -> None:
-                    self._send({"w": _wid, "k": ev.kind, "key": ev.key,
-                                "v": _enc(ev.value), "rev": ev.revision})
+                    frame = {"w": _wid, "k": ev.kind, "key": ev.key,
+                             "v": _enc(ev.value), "rev": ev.revision}
+                    # lease TTL rides along so a replicating standby
+                    # re-arms its copy (benign unlocked read: worst
+                    # case the standby holds a lease a tick long)
+                    exp = store._leases.get(ev.key)
+                    if exp is not None and ev.kind != "delete":
+                        frame["ttl"] = max(exp - time.time(), 0.001)
+                    self._send(frame)
 
                 cancel = store.watch_prefix(req["prefix"], push,
                                             replay=req.get("replay", True))
@@ -145,6 +152,26 @@ class _Conn:
                 if cancel:
                     cancel()
                 r = True
+            elif op == "snapshot":
+                # full dump for standby seeding: data + revisions +
+                # remaining lease TTLs (failover.py WarmStandby)
+                with store._lock:
+                    store._expire_leases()
+                    now = time.time()
+                    r = {
+                        "data": {k: [_enc(v), rev]
+                                 for k, (v, rev) in store._data.items()},
+                        "leases": {k: max(exp - now, 0.001)
+                                   for k, exp in store._leases.items()},
+                        "revision": store._revision,
+                    }
+            elif op == "lease_dump":
+                # keepalives extend leases WITHOUT watch events; the
+                # standby polls this to keep its lease copies live
+                with store._lock:
+                    now = time.time()
+                    r = {k: max(exp - now, 0.001)
+                         for k, exp in store._leases.items()}
             elif op == "ping":
                 r = "pong"
             else:
@@ -246,7 +273,15 @@ class RemoteKVStore:
     def __init__(self, address, dial_timeout: float = 5.0,
                  call_timeout: float = 30.0, reconnect: bool = True,
                  max_backoff: float = 2.0):
-        self.address = tuple(address)
+        # ``address`` is one ("unix", path) / ("tcp", host, port)
+        # tuple OR a failover list of them (primary first): every
+        # (re)dial walks the list in order, so clients of a killed
+        # primary land on the warm standby (failover.WarmStandby)
+        if address and isinstance(address[0], (list, tuple)):
+            self._addresses = [tuple(a) for a in address]
+        else:
+            self._addresses = [tuple(address)]
+        self.address = self._addresses[0]
         self._dial_timeout = dial_timeout
         self._call_timeout = call_timeout
         self._reconnect = reconnect
@@ -276,24 +311,29 @@ class RemoteKVStore:
         delay = 0.02
         last: Optional[Exception] = None
         while time.time() < deadline:
-            try:
-                if self.address[0] == "unix":
-                    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-                    s.connect(self.address[1])
-                else:
-                    s = socket.create_connection(
-                        (self.address[1], self.address[2]), timeout=2.0)
-                    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                s.settimeout(None)
-                self._sock = s
-                self._connected.set()
-                return
-            except OSError as exc:
-                last = exc
-                time.sleep(min(delay, self._max_backoff))
-                delay *= 2
+            for addr in self._addresses:
+                try:
+                    if addr[0] == "unix":
+                        s = socket.socket(socket.AF_UNIX,
+                                          socket.SOCK_STREAM)
+                        s.settimeout(2.0)
+                        s.connect(addr[1])
+                    else:
+                        s = socket.create_connection(
+                            (addr[1], addr[2]), timeout=2.0)
+                        s.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_NODELAY, 1)
+                    s.settimeout(None)
+                    self._sock = s
+                    self.address = addr
+                    self._connected.set()
+                    return
+                except OSError as exc:
+                    last = exc
+            time.sleep(min(delay, self._max_backoff))
+            delay *= 2
         raise ConnectionError(
-            f"kvstore server unreachable at {self.address}: {last}")
+            f"kvstore server unreachable at {self._addresses}: {last}")
 
     def _read_loop(self) -> None:
         buf = b""
@@ -384,7 +424,7 @@ class RemoteKVStore:
             _prefix, fn = entry
             try:
                 fn(KVEvent(msg["k"], msg["key"], _dec(msg["v"]),
-                           msg["rev"]))
+                           msg["rev"], ttl=msg.get("ttl")))
             except Exception:
                 pass  # a broken observer must not kill the dispatcher
 
@@ -493,6 +533,19 @@ class RemoteKVStore:
 
     def ping(self) -> bool:
         return self._call("ping") == "pong"
+
+    # -- replication surface (failover.WarmStandby) --------------------
+    def snapshot(self) -> dict:
+        r = self._call("snapshot")
+        return {
+            "data": {k: (_dec(v), rev)
+                     for k, (v, rev) in r["data"].items()},
+            "leases": dict(r["leases"]),
+            "revision": r["revision"],
+        }
+
+    def lease_dump(self) -> Dict[str, float]:
+        return dict(self._call("lease_dump"))
 
     def close(self) -> None:
         self._closed = True

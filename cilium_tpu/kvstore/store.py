@@ -21,6 +21,9 @@ class KVEvent:
     key: str
     value: bytes
     revision: int
+    # remaining lease TTL at emit time (replication transport only:
+    # the standby re-arms its copy of the lease from this)
+    ttl: Optional[float] = None
 
 
 Watcher = Callable[[KVEvent], None]

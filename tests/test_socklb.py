@@ -1,0 +1,159 @@
+"""Socket-LB analogue (service/socklb.py): connect-time VIP->backend
+translation cached per flow — SURVEY §2a's bpf_sock row.
+
+Semantics gates: first-packet resolution equals lb_stage exactly;
+cached packets resolve identically without the frontend compare;
+established flows KEEP their backend across backend-set changes (the
+upstream socket semantics); non-service flows pass through (and their
+negative cache entries stop masking once expired); connect bursts
+beyond the compact buffer still resolve correctly.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cilium_tpu.core import TCP_SYN, TCP_ACK, make_batch
+from cilium_tpu.core.packets import COL_DPORT, COL_DST_IP3, COL_SPORT
+from cilium_tpu.service import ServiceManager, lb_stage
+from cilium_tpu.service.socklb import (SockLBTable, socklb_stage,
+                                       socklb_stage_jit)
+
+
+def _svcs(n_backends=3):
+    m = ServiceManager()
+    m.upsert("web", "172.16.0.10:80",
+             [f"10.0.1.{i + 1}:8080" for i in range(n_backends)])
+    m.upsert("dns", "172.16.0.53:53",
+             ["10.0.2.1:5353"], protocol=17)
+    return m
+
+
+def _flow_rows(n, dst="172.16.0.10", dport=80, proto=6, sport0=41000):
+    return make_batch([
+        dict(src="10.0.9.9", dst=dst, sport=sport0 + i, dport=dport,
+             proto=proto, flags=TCP_SYN, ep=1, dir=1)
+        for i in range(n)
+    ]).data
+
+
+class TestSockLB:
+    def test_first_packet_matches_lb_stage(self):
+        m = _svcs()
+        t = m.tensors()
+        hdr = _flow_rows(64)
+        ref, ref_hit = lb_stage(t, jnp.asarray(hdr))
+        tbl = SockLBTable.create(1 << 10)
+        got, hit, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
+                                     jnp.uint32(10))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        np.testing.assert_array_equal(np.asarray(hit),
+                                      np.asarray(ref_hit))
+
+    def test_cached_packets_resolve_identically(self):
+        m = _svcs()
+        t = m.tensors()
+        hdr = _flow_rows(32)
+        tbl = SockLBTable.create(1 << 10)
+        first, _, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
+                                     jnp.uint32(10))
+        # same flows again (ACKs now): must hit the cache and produce
+        # the same backends
+        hdr2 = hdr.copy()
+        again, hit, tbl = socklb_stage(tbl, t, jnp.asarray(hdr2),
+                                       jnp.uint32(20))
+        np.testing.assert_array_equal(np.asarray(again),
+                                      np.asarray(first))
+        assert np.asarray(hit).all()
+
+    def test_established_flows_keep_backend_across_backend_change(self):
+        m = _svcs(n_backends=3)
+        hdr = _flow_rows(48)
+        tbl = SockLBTable.create(1 << 10)
+        first, _, tbl = socklb_stage(tbl, m.tensors(), jnp.asarray(hdr),
+                                     jnp.uint32(10))
+        first = np.asarray(first)
+        # backend set changes: one backend drains away
+        m.upsert("web", "172.16.0.10:80",
+                 ["10.0.1.1:8080", "10.0.1.2:8080"])
+        again, _, tbl = socklb_stage(tbl, m.tensors(),
+                                     jnp.asarray(hdr.copy()),
+                                     jnp.uint32(20))
+        # cached flows keep their ORIGINAL backend (socket semantics)
+        np.testing.assert_array_equal(np.asarray(again), first)
+        # a NEW flow resolves against the new set only
+        fresh = _flow_rows(8, sport0=55000)
+        out, _, tbl = socklb_stage(tbl, m.tensors(), jnp.asarray(fresh),
+                                   jnp.uint32(21))
+        dsts = set(int(x) for x in np.asarray(out)[:, COL_DST_IP3])
+        import ipaddress
+
+        gone = int(ipaddress.IPv4Address("10.0.1.3"))
+        assert gone in set(int(x) for x in first[:, COL_DST_IP3])
+        assert gone not in dsts
+
+    def test_non_service_flows_pass_through_and_cache_negative(self):
+        m = _svcs()
+        t = m.tensors()
+        hdr = _flow_rows(16, dst="203.0.113.7", dport=443)
+        tbl = SockLBTable.create(1 << 10)
+        out, hit, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
+                                     jnp.uint32(10))
+        np.testing.assert_array_equal(np.asarray(out), hdr)
+        assert not np.asarray(hit).any()
+        # second pass rides the (negative) cache — still pass-through
+        out2, hit2, tbl = socklb_stage(tbl, t, jnp.asarray(hdr.copy()),
+                                       jnp.uint32(20))
+        np.testing.assert_array_equal(np.asarray(out2), hdr)
+        assert not np.asarray(hit2).any()
+
+    def test_connect_burst_beyond_buffer_still_resolves(self):
+        from cilium_tpu.service import socklb as mod
+
+        m = _svcs()
+        t = m.tensors()
+        n = mod.CONNECT_CAP + 512  # every row a new flow: burst path
+        hdr = np.asarray(_flow_rows(1)).repeat(n, axis=0)
+        hdr[:, COL_SPORT] = 20000 + np.arange(n)
+        ref, _ = lb_stage(t, jnp.asarray(hdr))
+        tbl = SockLBTable.create(1 << 15)
+        got, hit, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
+                                     jnp.uint32(10))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+        assert np.asarray(hit).all()
+
+    def test_daemon_serves_services_through_the_flow_cache(self):
+        from cilium_tpu.agent import Daemon, DaemonConfig
+        from cilium_tpu.policy.mapstate import VERDICT_ALLOW
+
+        for backend in ("tpu", "interpreter"):
+            d = Daemon(DaemonConfig(backend=backend,
+                                    ct_capacity=1 << 12))
+            ep = d.add_endpoint("client", ("10.0.9.9",),
+                                ["k8s:app=client"])
+            d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+            d.services.upsert("web", "172.16.0.10:80",
+                              ["10.0.1.1:8080"])
+            d.policy_import([{
+                "endpointSelector": {"matchLabels": {"app": "client"}},
+                "egress": [{"toEndpoints": [{"matchLabels":
+                                             {"app": "web"}}],
+                            "toPorts": [{"ports": [
+                                {"port": "8080",
+                                 "protocol": "TCP"}]}]}],
+            }])
+            syn = make_batch([dict(src="10.0.9.9", dst="172.16.0.10",
+                                   sport=41000, dport=80, proto=6,
+                                   flags=TCP_SYN, ep=ep.id,
+                                   dir=1)]).data
+            ev = d.process_batch(syn, now=5)
+            # DNAT before policy: judged against the backend, allowed
+            assert int(ev.verdict[0]) == VERDICT_ALLOW, backend
+            assert int(ev.hdr[0, COL_DPORT]) == 8080
+            ev2 = d.process_batch(
+                make_batch([dict(src="10.0.9.9", dst="172.16.0.10",
+                                 sport=41000, dport=80, proto=6,
+                                 flags=TCP_ACK, ep=ep.id,
+                                 dir=1)]).data, now=6)
+            assert int(ev2.verdict[0]) == VERDICT_ALLOW, backend
+            assert int(ev2.hdr[0, COL_DPORT]) == 8080
