@@ -343,9 +343,9 @@ def bench_end_to_end_wide(world, state, now0, jax, jnp, iters=12):
     }, state
 
 
-def bench_ring_steady_state(world, state, now0, jax, jnp, batches=64,
-                            drain_every=4, ring_cap=None,
-                            fresh_frac=20):
+def bench_ring_steady_state(world, state, now0, jax, jnp, batches=128,
+                            drain_every=32, ring_cap=None,
+                            fresh_frac=32):
     """Sustained monitor-plane cadence with OVERLAPPED drains: the
     host fetches window N-1 (AsyncRingDrainer, monitor/ring.py) while
     the device steps window N — the production double-buffered drain
@@ -413,7 +413,9 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=64,
     state, ring = serve_gen_step(state, ring, pool, zero,
                                  jnp.uint32(now0))
     ring.cursor.block_until_ready()
-    # absorb the accumulated tunnel warmup debt off the clock
+    # absorb the accumulated tunnel warmup debt off the clock (the
+    # first d2h of a process pays a fixed cost scaling with uploaded
+    # state on this harness)
     t0 = time.perf_counter()
     _ = np.asarray(state.metrics)
     sync_ms = round((time.perf_counter() - t0) * 1e3, 1)

@@ -198,8 +198,16 @@ class AsyncRingDrainer:
     def swap(self, ring: EventRing) -> EventRing:
         """Start the async fetch of ``ring``; returns the fresh ring
         for the next window.  At most one fetch may be in flight:
-        call :meth:`collect` first."""
+        call :meth:`collect` first.
+
+        The block_until_ready BEFORE the copy is load-bearing on
+        tunneled runtimes: a d2h transfer with queued dispatches pays
+        a pathological per-dispatch flush (~9 s each, measured r05),
+        while block_until_ready drains the same queue in
+        milliseconds — sync first, then copy only moves bytes."""
         assert self._pending is None, "previous window not collected"
+        ring.buf.block_until_ready()
+        ring.cursor.block_until_ready()
         ring.buf.copy_to_host_async()
         ring.cursor.copy_to_host_async()
         self._pending = ring

@@ -28,6 +28,13 @@ L7_COLS = 8
 KIND_HTTP = 0
 KIND_DNS = 1
 KIND_KAFKA = 2
+# rule-tensor-only kind: an HTTP PREFIX rule row (matches KIND_HTTP
+# requests through the rolling prefix-hash tensor; l7policy.py)
+KIND_HTTP_PREFIX = 3
+
+# longest path prefix that can match on device (longer prefixes fall
+# back to host matchers); bounds the rolling-hash tensor
+MAX_PREFIX = 48
 
 # Kafka api keys the policy schema names (reference: proxylib kafka
 # parser + api.PortRuleKafka role/apiKey)
@@ -59,6 +66,52 @@ def fnv64(s: str) -> Tuple[int, int]:
 
 def _norm_dns(name: str) -> str:
     return name.rstrip(".").lower()
+
+
+def path_prefix_hashes(paths: Sequence[str],
+                       lengths: Optional[Sequence[int]] = None
+                       ) -> np.ndarray:
+    """Rolling FNV-64 of each path, sampled at prefix lengths.
+
+    ``lengths=None`` samples EVERY position: [N, MAX_PREFIX, 2] u32
+    with column j holding fnv64(path[:j+1]) (bit-equal to
+    :func:`fnv64`, same zero-avoidance).  With ``lengths`` (sorted,
+    ascending — the lengths the compiled prefix rules actually probe)
+    the output is the compact [N, len(lengths), 2] and the rolling
+    loop stops at max(lengths) — the serving-path shape.  Prefixes
+    past a path's end are (0, 0), the "no such prefix" sentinel that
+    doubles as the length check."""
+    n = len(paths)
+    if lengths is None:
+        sample = list(range(1, MAX_PREFIX + 1))
+    else:
+        sample = [int(x) for x in lengths]
+    upto = sample[-1] if sample else 0
+    arr = np.zeros((n, max(upto, 1)), dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.int32)
+    for i, p in enumerate(paths):
+        b = p.encode()[:upto]
+        lens[i] = len(b)
+        arr[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+    # vectorized rolling FNV: one pass over positions, whole batch per
+    # step (uint64 wraps mod 2^64 natively)
+    out = np.zeros((n, len(sample), 2), dtype=np.uint32)
+    h = np.full(n, _FNV_OFFSET, dtype=np.uint64)
+    prime = np.uint64(_FNV_PRIME)
+    col = {L: k for k, L in enumerate(sample)}
+    with np.errstate(over="ignore"):
+        for j in range(upto):
+            h = (h ^ arr[:, j].astype(np.uint64)) * prime
+            k = col.get(j + 1)
+            if k is None:
+                continue
+            lo = (h & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            hi = (h >> np.uint64(32)).astype(np.uint32)
+            lo = np.where((lo | hi) == 0, np.uint32(1), lo)
+            alive = j < lens
+            out[:, k, 0] = np.where(alive, lo, 0)
+            out[:, k, 1] = np.where(alive, hi, 0)
+    return out
 
 
 def featurize_http(requests: Sequence[dict], port: int,

@@ -30,6 +30,7 @@ from .featurize import (
     KAFKA_API_IDS,
     KIND_DNS,
     KIND_HTTP,
+    KIND_HTTP_PREFIX,
     KIND_KAFKA,
     L7_COLS,
     L7_HOST_H0,
@@ -39,6 +40,7 @@ from .featurize import (
     L7_PATH_H0,
     L7_PATH_H1,
     L7_PORT,
+    MAX_PREFIX,
     fnv64,
 )
 
@@ -66,6 +68,20 @@ def _is_literal(path: str) -> bool:
     return not _REGEX_CHARS.search(path)
 
 
+def _prefix_form(path: str):
+    """``LITERAL.*`` / ``LITERAL.+`` -> (literal, min_extra) or None.
+
+    The overwhelmingly common regex-path shape compiles to a device
+    prefix row (rolling prefix-hash compare); anything else stays a
+    host matcher.  The literal must fit the rolling-hash window."""
+    if len(path) < 3 or path[-1] not in "*+" or path[-2] != ".":
+        return None
+    lit = path[:-2]
+    if not lit or not _is_literal(lit) or len(lit) > MAX_PREFIX - 1:
+        return None
+    return lit, (1 if path[-1] == "+" else 0)
+
+
 @dataclass
 class L7PolicyTensors:
     """Compiled L7 policy: device rule tensor + host fallback."""
@@ -77,6 +93,18 @@ class L7PolicyTensors:
     ports: frozenset = frozenset()
     # port -> original L7Rules (for xDS-style display / DNS observers)
     by_port: Dict[int, L7Rules] = field(default_factory=dict)
+
+    # sorted prefix lengths the rules probe (incl. L+1 for .+ rules);
+    # the featurizer samples the rolling hash at exactly these
+    prefix_lengths: Tuple[int, ...] = ()
+
+    @property
+    def n_prefix(self) -> int:
+        """Device prefix rows (callers compute the rolling-hash tensor
+        only when some rule consumes it)."""
+        if self.rules.shape[0] == 0:
+            return 0
+        return int((self.rules[:, R_KIND] == KIND_HTTP_PREFIX).sum())
 
 
 def compile_l7(redirects: Sequence[Tuple[int, str, L7Rules]]
@@ -114,6 +142,22 @@ def compile_l7(redirects: Sequence[Tuple[int, str, L7Rules]]
                 ho_lo, ho_hi = fnv64(h.host)
                 rows.append([
                     port, KIND_HTTP, method_id,
+                    p_lo, p_hi, ho_lo, ho_hi,
+                ])
+                continue
+            pref = (_prefix_form(h.path)
+                    if h.path and not h.headers and method_id is not None
+                    and _is_literal(h.host) else None)
+            if pref is not None:
+                # LITERAL.* rides the device prefix-hash compare: the
+                # method word carries len(prefix) (bits 8..15) and the
+                # .+ at-least-one-more-byte flag (bit 16)
+                lit, extra = pref
+                p_lo, p_hi = fnv64(lit)
+                ho_lo, ho_hi = fnv64(h.host)
+                rows.append([
+                    port, KIND_HTTP_PREFIX,
+                    method_id | (len(lit) << 8) | (extra << 16),
                     p_lo, p_hi, ho_lo, ho_hi,
                 ])
                 continue
@@ -171,8 +215,16 @@ def compile_l7(redirects: Sequence[Tuple[int, str, L7Rules]]
 
     rules = (np.asarray(rows, dtype=np.uint32) if rows
              else np.zeros((0, R_COLS), dtype=np.uint32))
+    plens = set()
+    for row in rows:
+        if row[R_KIND] == KIND_HTTP_PREFIX:
+            L = (row[R_METHOD] >> 8) & 0xFF
+            plens.add(L)
+            if (row[R_METHOD] >> 16) & 1:
+                plens.add(L + 1)  # the .+ at-least-one-more check
     return L7PolicyTensors(rules=rules, host_matchers=host_matchers,
-                           ports=frozenset(ports), by_port=by_port)
+                           ports=frozenset(ports), by_port=by_port,
+                           prefix_lengths=tuple(sorted(plens)))
 
 
 _BACKREF = re.compile(
@@ -270,23 +322,62 @@ def _dns_matcher(pattern: str) -> Callable:
     return match
 
 
-def l7_verdict(rules: jnp.ndarray, rows: jnp.ndarray) -> jnp.ndarray:
+def l7_verdict(rules: jnp.ndarray, rows: jnp.ndarray,
+               pref: jnp.ndarray = None,
+               pref_lengths: jnp.ndarray = None) -> jnp.ndarray:
     """Batched request verdict: [N, L7_COLS] x [R, R_COLS] -> [N] bool.
 
     A request is admitted iff SOME rule row matches on every
     constrained field (L7 default deny otherwise).  One fused masked
-    compare — no per-request control flow."""
+    compare — no per-request control flow.
+
+    ``pref`` ([N, MAX_PREFIX, 2] rolling path prefix hashes,
+    featurize.path_prefix_hashes) serves the KIND_HTTP_PREFIX rows:
+    a ``LITERAL.*`` rule matches when the request's rolling hash at
+    ``len(LITERAL)`` equals the rule's prefix hash (and for ``.+``,
+    a hash exists one byte further — i.e. the path is longer)."""
     if rules.shape[0] == 0:
         return jnp.zeros(rows.shape[0], dtype=bool)
     r = rules[None, :, :].astype(jnp.uint32)  # [1, R, C]
     q = rows[:, None, :].astype(jnp.uint32)  # [N, 1, C]
+    is_pref = r[:, :, R_KIND] == KIND_HTTP_PREFIX
     port_ok = q[:, :, L7_PORT] == r[:, :, R_PORT]
-    kind_ok = q[:, :, L7_KIND] == r[:, :, R_KIND]
-    meth_ok = (r[:, :, R_METHOD] == 0) | (q[:, :, L7_METHOD]
-                                          == r[:, :, R_METHOD])
+    kind_ok = jnp.where(is_pref, q[:, :, L7_KIND] == KIND_HTTP,
+                        q[:, :, L7_KIND] == r[:, :, R_KIND])
+    meth_id = jnp.where(is_pref, r[:, :, R_METHOD] & 0xFF,
+                        r[:, :, R_METHOD])
+    meth_ok = (meth_id == 0) | (q[:, :, L7_METHOD] == meth_id)
     path_any = (r[:, :, R_PATH_H0] == 0) & (r[:, :, R_PATH_H1] == 0)
     path_ok = path_any | ((q[:, :, L7_PATH_H0] == r[:, :, R_PATH_H0])
                           & (q[:, :, L7_PATH_H1] == r[:, :, R_PATH_H1]))
+    if pref is not None:
+        rp = rules.astype(jnp.uint32)
+        plen = ((rp[:, R_METHOD] >> 8) & 0xFF).astype(jnp.int32)  # [R]
+        extra = (rp[:, R_METHOD] >> 16) & 1
+        pq = pref.astype(jnp.uint32)
+        if pref_lengths is None:  # full sampling: column j = length j+1
+            pref_lengths = jnp.arange(1, pq.shape[1] + 1,
+                                      dtype=jnp.int32)
+        K = pq.shape[1]
+        # per-rule column selection via one-hot over the (tiny) K axis
+        # — a [N, R] middle-axis gather compiles to a pathologically
+        # slow scatter on the CPU backend this kernel serves from
+        ks = jnp.arange(K, dtype=jnp.int32)
+        col = jnp.minimum(jnp.searchsorted(pref_lengths, plen), K - 1)
+        ncol = jnp.minimum(jnp.searchsorted(pref_lengths, plen + 1),
+                           K - 1)
+        onehot = ks[None, :] == col[:, None]  # [R, K]
+        nhot = ks[None, :] == ncol[:, None]
+        eq = ((pq[:, None, :, 0] == rp[None, :, None, R_PATH_H0])
+              & (pq[:, None, :, 1] == rp[None, :, None, R_PATH_H1]))
+        ph_hit = jnp.any(eq & onehot[None, :, :], axis=2)  # [N, R]
+        nonempty = (pq[:, :, 0] | pq[:, :, 1]) != 0  # [N, K]
+        beyond_ok = jnp.any(nonempty[:, None, :] & nhot[None, :, :],
+                            axis=2)
+        pref_hit = ph_hit & ((extra[None, :] == 0) | beyond_ok)
+        path_ok = jnp.where(is_pref, pref_hit, path_ok)
+    else:
+        path_ok = path_ok & ~is_pref  # no prefix tensor: can't match
     host_any = (r[:, :, R_HOST_H0] == 0) & (r[:, :, R_HOST_H1] == 0)
     host_ok = host_any | ((q[:, :, L7_HOST_H0] == r[:, :, R_HOST_H0])
                           & (q[:, :, L7_HOST_H1] == r[:, :, R_HOST_H1]))

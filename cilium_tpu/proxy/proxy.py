@@ -125,14 +125,28 @@ class L7Proxy:
             import jax
             import jax.numpy as jnp
 
+            from .featurize import path_prefix_hashes
+
+            # prefix rows consume the rolling path-hash tensor; it is
+            # only computed when some rule needs it
+            pref = None
+            if t.n_prefix:
+                pref = path_prefix_hashes(
+                    [r.get("path", "") if isinstance(r, dict) else ""
+                     for r in raw], t.prefix_lengths)
             # the proxy lives host-side (requests arrive here); the
             # match tensor is tiny, so it runs on the LOCAL cpu
             # backend — a per-request-batch round trip to a remote/
             # tunneled accelerator would be pure latency (measured
-            # ~180ms/batch through the harness tunnel)
+            # ~180ms/batch through the harness tunnel).  EVERY input
+            # must materialize inside this scope: one device-committed
+            # operand drags the whole computation onto the tunnel.
             with jax.default_device(jax.devices("cpu")[0]):
-                allow = np.array(l7_verdict_jit(jnp.asarray(t.rules),
-                                                jnp.asarray(rows)))
+                allow = np.array(l7_verdict_jit(
+                    jnp.asarray(t.rules), jnp.asarray(rows),
+                    None if pref is None else jnp.asarray(pref),
+                    None if pref is None else jnp.asarray(
+                        np.asarray(t.prefix_lengths, dtype=np.int32))))
         else:
             allow = np.zeros(len(raw), dtype=bool)
         matchers = t.host_matchers.get(port)

@@ -36,9 +36,11 @@ class TestCompile:
         assert not t.host_matchers
         assert t.ports == frozenset({10000})
 
-    def test_regex_rules_become_host_matchers(self):
+    def test_nonprefix_regex_rules_become_host_matchers(self):
+        # r05: LITERAL.* compiles to a device prefix row, so only a
+        # genuinely-structured regex still needs the host path
         t = compile_l7([(10000, "r1", _l7(http=[
-            {"method": "GET", "path": "/api/.*"},
+            {"method": "GET", "path": "/api/v[0-9]+/users"},
         ]))])
         assert t.rules.shape[0] == 0
         assert len(t.host_matchers[10000]) == 1
@@ -260,3 +262,60 @@ class TestDaemonE2E:
                            "host": "db.svc"}
         assert reqs[1]["method"] == "POST" and reqs[1]["host"] == ""
         assert reqs[2] == {}
+
+
+class TestDevicePrefixRules:
+    """r05: LITERAL.* / LITERAL.+ path rules compile to device prefix
+    rows (rolling prefix-hash compare) instead of host matchers."""
+
+    def _proxy(self, http):
+        p = L7Proxy()
+        p.update([type("P", (), {
+            "redirects": [(10000, "rule", _l7(http=http))]})()])
+        return p
+
+    def test_prefix_rule_compiles_to_device_row(self):
+        from cilium_tpu.proxy.l7policy import compile_l7
+        from cilium_tpu.policy.api import L7Rules
+
+        l7 = L7Rules.from_dict({"http": [
+            {"method": "GET", "path": "/static/.*"}]})
+        t = compile_l7([(10000, "r", l7)])
+        assert t.n_prefix == 1
+        assert not t.host_matchers  # no fallback needed
+
+    def test_prefix_semantics_match_regex(self):
+        p = self._proxy([{"method": "GET", "path": "/static/.*"},
+                         {"method": "GET", "path": "/api/v1/.+"}])
+        got = p.handle_http(10000, [
+            {"method": "GET", "path": "/static/app.js"},   # 1
+            {"method": "GET", "path": "/static/"},         # 1 (.* empty)
+            {"method": "GET", "path": "/static"},          # 0 (no slash)
+            {"method": "POST", "path": "/static/app.js"},  # 0 (method)
+            {"method": "GET", "path": "/api/v1/x"},        # 1
+            {"method": "GET", "path": "/api/v1/"},         # 0 (.+ needs 1)
+            {"method": "GET", "path": "/api/v2/x"},        # 0
+        ])
+        assert list(got) == [1, 1, 0, 0, 1, 0, 0]
+        # and nothing fell back to host matchers
+        assert p.host_fallback_checked == 0
+
+    def test_long_prefix_falls_back_to_host(self):
+        from cilium_tpu.proxy.l7policy import compile_l7
+        from cilium_tpu.policy.api import L7Rules
+
+        long = "/" + "a" * 60
+        l7 = L7Rules.from_dict({"http": [
+            {"method": "GET", "path": long + "/.*"}]})
+        t = compile_l7([(10000, "r", l7)])
+        assert t.n_prefix == 0
+        assert t.host_matchers  # still enforced, host-side
+
+    def test_prefix_with_host_constraint(self):
+        p = self._proxy([{"method": "GET", "path": "/files/.*",
+                          "host": "cdn.svc"}])
+        got = p.handle_http(10000, [
+            {"method": "GET", "path": "/files/x", "host": "cdn.svc"},
+            {"method": "GET", "path": "/files/x", "host": "evil.svc"},
+        ])
+        assert list(got) == [1, 0]
