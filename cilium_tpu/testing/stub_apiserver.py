@@ -1,0 +1,214 @@
+"""Stub k8s apiserver: LIST + chunked WATCH over real HTTP.
+
+The fake-clientset pattern (SURVEY §4) upgraded to the wire: tests
+mutate the object store (:meth:`add`/:meth:`update`/:meth:`delete`)
+and the stub speaks enough of the k8s API for
+:class:`~cilium_tpu.k8s.informer.K8sClient` to drive a live agent —
+LIST with a collection resourceVersion, ``watch=true`` streams of
+ADDED/MODIFIED/DELETED JSON lines resuming from ``resourceVersion``,
+and 410 Gone once history is compacted (:meth:`compact`), which
+forces the client's re-LIST path.
+
+Runs standalone too: ``python -m cilium_tpu.testing.stub_apiserver``
+prints its address and serves until killed.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+# collection path by kind (must mirror informer.DEFAULT_RESOURCES)
+PATH_BY_KIND = {
+    "Namespace": "/api/v1/namespaces",
+    "Pod": "/api/v1/pods",
+    "Service": "/api/v1/services",
+    "Endpoints": "/api/v1/endpoints",
+    "CiliumNetworkPolicy": "/apis/cilium.io/v2/ciliumnetworkpolicies",
+    "CiliumClusterwideNetworkPolicy":
+        "/apis/cilium.io/v2/ciliumclusterwidenetworkpolicies",
+    "CiliumIdentity": "/apis/cilium.io/v2/ciliumidentities",
+    "CiliumEndpoint": "/apis/cilium.io/v2/ciliumendpoints",
+    "CiliumNode": "/apis/cilium.io/v2/ciliumnodes",
+}
+
+
+def _key(obj: dict) -> str:
+    meta = obj.get("metadata") or {}
+    return f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+
+
+class StubAPIServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.Lock()
+        self._rv = 0
+        # path -> {key -> obj}
+        self._objects: Dict[str, Dict[str, dict]] = {
+            p: {} for p in PATH_BY_KIND.values()}
+        # event log: (rv, path, type, obj); watch replays entries
+        # with rv > the client's resourceVersion
+        self._log: List[Tuple[int, str, str, dict]] = []
+        self._log_floor = 0  # rv below which history is compacted
+        self._watchers: List[queue.Queue] = []
+
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                stub._handle(self)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.url = f"http://{host}:{self._httpd.server_address[1]}"
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    # -- test-side mutations -------------------------------------------
+    def _bump(self, path: str, typ: str, obj: dict) -> dict:
+        self._rv += 1
+        obj = dict(obj)
+        meta = dict(obj.get("metadata") or {})
+        meta["resourceVersion"] = str(self._rv)
+        obj["metadata"] = meta
+        self._log.append((self._rv, path, typ, obj))
+        for q in list(self._watchers):
+            q.put((self._rv, path, typ, obj))
+        return obj
+
+    def add(self, obj: dict) -> None:
+        path = PATH_BY_KIND[obj["kind"]]
+        with self._lock:
+            obj = self._bump(path, "ADDED", obj)
+            self._objects[path][_key(obj)] = obj
+
+    def update(self, obj: dict) -> None:
+        path = PATH_BY_KIND[obj["kind"]]
+        with self._lock:
+            obj = self._bump(path, "MODIFIED", obj)
+            self._objects[path][_key(obj)] = obj
+
+    def delete(self, obj: dict) -> None:
+        path = PATH_BY_KIND[obj["kind"]]
+        with self._lock:
+            obj = self._bump(path, "DELETED", obj)
+            self._objects[path].pop(_key(obj), None)
+
+    def compact(self) -> None:
+        """Drop watch history (forces 410 -> client re-LIST).  Open
+        watch streams get the 410 too — an apiserver that compacted
+        under a live watch terminates it the same way."""
+        with self._lock:
+            # strictly everything-so-far: a watch resuming from any
+            # rv <= the current one gets 410 (etcd compaction at now)
+            self._log_floor = self._rv + 1
+            self._log.clear()
+            for q in list(self._watchers):
+                q.put((0, None, "ERROR",
+                       {"kind": "Status", "code": 410}))
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- HTTP ----------------------------------------------------------
+    def _handle(self, h: BaseHTTPRequestHandler) -> None:
+        u = urlparse(h.path)
+        path = u.path.rstrip("/")
+        q = parse_qs(u.query)
+        objs = self._objects.get(path)
+        if objs is None:
+            h.send_response(404)
+            h.send_header("Content-Length", "0")
+            h.end_headers()
+            return
+        if q.get("watch", ["false"])[0] == "true":
+            self._serve_watch(h, path,
+                              int(q.get("resourceVersion", ["0"])[0]))
+        else:
+            self._serve_list(h, path)
+
+    def _serve_list(self, h, path: str) -> None:
+        with self._lock:
+            body = json.dumps({
+                "kind": "List",
+                "metadata": {"resourceVersion": str(self._rv)},
+                "items": list(self._objects[path].values()),
+            }).encode()
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    def _serve_watch(self, h, path: str, rv: int) -> None:
+        q: queue.Queue = queue.Queue()
+        with self._lock:
+            if rv < self._log_floor:
+                # history compacted: 410 the way etcd/apiserver does
+                replay: List = [(0, path, "ERROR",
+                                 {"kind": "Status", "code": 410})]
+            else:
+                replay = [e for e in self._log
+                          if e[0] > rv and e[1] == path]
+            self._watchers.append(q)
+        h.send_response(200)
+        h.send_header("Content-Type", "application/json")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+        def send(typ: str, obj: dict) -> bool:
+            line = json.dumps({"type": typ, "object": obj}) + "\n"
+            data = line.encode()
+            try:
+                h.wfile.write(f"{len(data):x}\r\n".encode() + data
+                              + b"\r\n")
+                h.wfile.flush()
+                return True
+            except OSError:
+                return False
+
+        try:
+            for _rv, _path, typ, obj in replay:
+                if not send(typ, obj):
+                    return
+                if typ == "ERROR":
+                    return
+            while True:
+                try:
+                    ev_rv, ev_path, typ, obj = q.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+                if ev_path is not None and ev_path != path:
+                    continue
+                if not send(typ, obj):
+                    return
+                if typ == "ERROR":
+                    return  # 410 terminates the stream
+        finally:
+            with self._lock:
+                if q in self._watchers:
+                    self._watchers.remove(q)
+
+
+def main() -> None:
+    import time
+
+    srv = StubAPIServer()
+    print(json.dumps({"url": srv.url}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.close()
+
+
+if __name__ == "__main__":
+    main()
