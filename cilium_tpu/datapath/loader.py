@@ -231,6 +231,7 @@ class TPULoader(Loader):
                 policy=DevicePolicy(
                     proto_table=policy.proto_table,
                     port_class=policy.port_class,
+                    class_map=policy.class_map,
                     verdict=verdict,
                     ep_policy=policy.ep_policy),
                 ipcache=self.state.ipcache, ct=self.state.ct,

@@ -87,8 +87,9 @@ class DevicePolicy:
     (the policymap + lxcmap of the TPU datapath)."""
 
     proto_table: jnp.ndarray  # [256] int32
-    port_class: jnp.ndarray  # [N_PROTO, 65536] int32
-    verdict: jnp.ndarray  # [n_pol, 2, n_rows, n_cls] int32
+    port_class: jnp.ndarray  # [N_PROTO, 65536] int32 -> GLOBAL class
+    class_map: jnp.ndarray  # [n_pol, n_cls_global] int32 -> LOCAL
+    verdict: jnp.ndarray  # [n_pol, 2, n_rows, n_local] int32
     ep_policy: jnp.ndarray  # [MAX_ENDPOINTS] int32 endpoint -> policy row
 
     @staticmethod
@@ -102,13 +103,14 @@ class DevicePolicy:
         return DevicePolicy(
             proto_table=jnp.asarray(t.proto_table),
             port_class=jnp.asarray(t.port_class),
+            class_map=jnp.asarray(t.class_map),
             verdict=jnp.asarray(t.verdict),
             ep_policy=jnp.asarray(ep_policy),
         )
 
     def tree_flatten(self):
-        return ((self.proto_table, self.port_class, self.verdict,
-                 self.ep_policy), None)
+        return ((self.proto_table, self.port_class, self.class_map,
+                 self.verdict, self.ep_policy), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -193,7 +195,11 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     no_ep = (pol_row_raw < 0) | (ep_col >= MAX_ENDPOINTS)
     pol_row = jnp.maximum(pol_row_raw, 0)
     proto_idx = state.policy.proto_table[hdr[:, COL_PROTO].astype(jnp.int32)]
-    cls = state.policy.port_class[proto_idx, hdr[:, COL_DPORT].astype(jnp.int32)]
+    gcls = state.policy.port_class[proto_idx, hdr[:, COL_DPORT].astype(jnp.int32)]
+    # global -> per-policy local class (compiler class_map): the
+    # verdict tensor's class axis is sized to ONE policy's boundaries,
+    # not the union of every policy's (the 17 GB failure mode)
+    cls = state.policy.class_map[pol_row, gcls]
     packed = state.policy.verdict[pol_row, dirn, id_row, cls]
     p_verdict = (packed & VERDICT_MASK).astype(jnp.int32)
     p_proxy = (packed >> PROXY_SHIFT).astype(jnp.int32)

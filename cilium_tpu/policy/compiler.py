@@ -143,11 +143,20 @@ class PolicyTensors:
     proto_table: np.ndarray  # [256] int32: ip proto -> dense proto
     port_class: np.ndarray  # [N_PROTO, 65536] int32: dport -> class
     n_classes: int
-    verdict: np.ndarray  # [n_pol, 2, n_rows, n_classes_padded] int32
+    verdict: np.ndarray  # [n_pol, 2, n_rows, n_local_padded] int32
     policy_index: Dict[str, int]  # subject labels key -> policy row
     row_map: IdentityRowMap
     class_intervals: Dict[int, List[Tuple[int, int, int]]] = field(
         default_factory=dict)  # proto -> [(lo, hi_excl, class_id)]
+    # per-policy class compaction (r05, SURVEY §7 hard part 3 / HBM
+    # audit): GLOBAL classes refine the union of every policy's port
+    # boundaries, so their count scales with the number of DISTINCT
+    # policies — 128 policies x 10k identities was a 17 GB dense
+    # tensor.  Each policy only distinguishes its OWN boundaries, so
+    # the verdict tensor's last axis is per-policy LOCAL classes and
+    # ``class_map`` [n_pol, n_classes_padded] maps global -> local
+    # (one extra tiny gather on device; 32x HBM on that config).
+    class_map: Optional[np.ndarray] = None
 
     def policy_row(self, subject_key: str) -> int:
         return self.policy_index[subject_key]
@@ -159,8 +168,15 @@ class PolicyTensors:
                   dport: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         proto = self.proto_table[ip_proto]
         cls = self.port_class[proto, dport]
+        cls = self.class_map[policy_row, cls]
         packed = self.verdict[policy_row, direction, id_row, cls]
         return unpack_verdict(packed), unpack_proxy(packed)
+
+    def hbm_bytes(self) -> int:
+        """Device bytes of the compiled policy state (the audit
+        number: verdict dominates; class_map/port_class are fixed)."""
+        return (self.verdict.nbytes + self.class_map.nbytes
+                + self.port_class.nbytes + self.proto_table.nbytes)
 
 
 def _collect_boundaries(policies: Sequence[EndpointPolicy]
@@ -213,15 +229,46 @@ def compile_policy(
     n_classes = next_class
     n_classes_padded = -(-n_classes // class_pad) * class_pad
 
+    # per-policy LOCAL class spaces (see PolicyTensors.class_map): a
+    # policy's boundaries partition each proto's port space much more
+    # coarsely than the global union; the verdict tensor's last axis
+    # is sized to the WIDEST policy, not the union
+    local_bounds = [_collect_boundaries([pol]) for pol in policies]
+    local_base: List[Dict[int, int]] = []
+    n_local_max = 1
+    for lb in local_bounds:
+        base: Dict[int, int] = {}
+        nxt = 0
+        for p in range(N_PROTO):
+            base[p] = nxt
+            nxt += len(lb[p]) - 1
+        local_base.append(base)
+        n_local_max = max(n_local_max, nxt)
+    n_local_padded = -(-n_local_max // class_pad) * class_pad
+    class_map = np.zeros((max(len(policies), 1), n_classes_padded),
+                         dtype=np.int32)
+    for pi, lb in enumerate(local_bounds):
+        for p in range(N_PROTO):
+            for lo, _hi, g in class_intervals[p]:
+                k = int(np.searchsorted(lb[p], lo, side="right")) - 1
+                class_map[pi, g] = local_base[pi][p] + k
+
     n_rows = row_map.capacity
     n_pol = len(policies)
-    verdict = np.zeros((n_pol, 2, n_rows, n_classes_padded), dtype=np.int32)
+    verdict = np.zeros((n_pol, 2, n_rows, n_local_padded),
+                       dtype=np.int32)
     policy_index: Dict[str, int] = {}
 
-    def classes_for(proto: int, lo: int, hi: int) -> np.ndarray:
-        return np.unique(port_class[proto, lo:hi + 1])
-
     for pi, pol in enumerate(policies):
+        lb = local_bounds[pi]
+        base = local_base[pi]
+
+        def classes_for(proto: int, lo: int, hi: int) -> np.ndarray:
+            # contribution bounds are local boundaries by construction
+            k0 = int(np.searchsorted(lb[proto], lo, side="right")) - 1
+            k1 = int(np.searchsorted(lb[proto], hi, side="right")) - 1
+            return np.arange(base[proto] + k0, base[proto] + k1 + 1)
+
         policy_index[pol.subject_labels.sorted_key()] = pi
         for di, ms in ((0, pol.ingress), (1, pol.egress)):
             default = (pack_entry(VERDICT_DEFAULT_DENY) if ms.enforcing
@@ -261,4 +308,5 @@ def compile_policy(
         policy_index=policy_index,
         row_map=row_map,
         class_intervals=class_intervals,
+        class_map=class_map,
     )

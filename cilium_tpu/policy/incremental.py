@@ -71,18 +71,23 @@ def update_contributions(policies: Sequence[EndpointPolicy], kind: str,
 
 def compose_row(policies: Sequence[EndpointPolicy], numeric_id: int,
                 tensors: PolicyTensors) -> np.ndarray:
-    """Verdict vector [n_pol, 2, n_classes_padded] for ONE identity.
+    """Verdict vector [n_pol, 2, n_local_padded] for ONE identity.
 
     Must stay the per-row mirror of ``compile_policy``'s scatter order:
     default fill, plain allows, redirects (reversed: first covering
-    redirect's port wins), denies last."""
+    redirect's port wins), denies last.  Classes are the PER-POLICY
+    local classes (compiler class_map): global classes mapped through
+    the policy's row of the map."""
     n_cls = tensors.verdict.shape[3]
     out = np.zeros((len(policies), 2, n_cls), dtype=np.int32)
 
-    def classes_for(proto: int, lo: int, hi: int) -> np.ndarray:
-        return np.unique(tensors.port_class[proto, lo:hi + 1])
-
     for pi, pol in enumerate(policies):
+        cmap = tensors.class_map[pi]
+
+        def classes_for(proto: int, lo: int, hi: int) -> np.ndarray:
+            return np.unique(
+                cmap[tensors.port_class[proto, lo:hi + 1]])
+
         for di, ms in ((0, pol.ingress), (1, pol.egress)):
             default = (pack_entry(VERDICT_DEFAULT_DENY) if ms.enforcing
                        else pack_entry(VERDICT_ALLOW))

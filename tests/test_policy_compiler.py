@@ -86,7 +86,8 @@ def test_tensor_matches_oracle_exhaustive_classes(setup):
                     for port in ports:
                         for row, numeric in enumerate(numerics):
                             want_v, want_p = ms.lookup(numeric, proto, port)
-                            packed = tensors.verdict[pi, di, row, cls]
+                            lcls = tensors.class_map[pi, cls]
+                            packed = tensors.verdict[pi, di, row, lcls]
                             got_v = packed & 0xFF
                             got_p = packed >> 8
                             assert got_v == want_v, (

@@ -1,0 +1,128 @@
+"""Many-policy HBM audit (VERDICT r04 item 9).
+
+The verdict tensor's class axis used to refine the UNION of every
+policy's port boundaries: 128 distinct policies x 10k identities
+measured 17.2 GB (over a v5e's HBM) and 150 s to compile.  With the
+r05 per-policy class compaction (compiler class_map) the same config
+is 2.1 GB and ~1.4 s: the class axis is sized to the widest single
+policy, and a [n_pol, n_global] map adds one tiny gather.
+
+This test pins the scaling law at a CI-sized configuration and checks
+correctness through the remapped lookup on both the numpy reference
+and the device datapath.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.identity import CachingIdentityAllocator
+from cilium_tpu.labels import LabelSet
+from cilium_tpu.policy import PolicyRepository
+from cilium_tpu.policy.compiler import IdentityRowMap, compile_policy
+from cilium_tpu.policy.mapstate import PROTO_TCP
+
+N_POL = 24
+N_IDS = 2000
+
+
+@pytest.fixture(scope="module")
+def world():
+    alloc = CachingIdentityAllocator()
+    repo = PolicyRepository(alloc)
+    for i in range(N_IDS):
+        alloc.allocate(LabelSet.parse(f"k8s:app=svc{i}",
+                                      "k8s:ns=default"))
+    rules = []
+    for p in range(N_POL):
+        rules.append({
+            "endpointSelector": {"matchLabels": {"app": f"subject{p}"}},
+            "ingress": [
+                {"fromEndpoints": [{"matchLabels":
+                                    {"app": f"svc{(p * 37 + j) % N_IDS}"}}],
+                 "toPorts": [{"ports": [
+                     {"port": str(1000 + (p * 7 + j) % 30000),
+                      "protocol": "TCP"}]}]}
+                for j in range(8)
+            ],
+        })
+    repo.add_obj(rules)
+    subjects = [LabelSet.parse(f"k8s:app=subject{p}")
+                for p in range(N_POL)]
+    for s in subjects:
+        alloc.allocate(s)
+    pols = [repo.resolve(s) for s in subjects]
+    row_map = IdentityRowMap(capacity=4096)
+    for ident in alloc.all_identities():
+        row_map.add(ident.numeric_id)
+    return pols, row_map, compile_policy(pols, row_map)
+
+
+def test_class_axis_is_per_policy_not_global(world):
+    pols, row_map, t = world
+    # the global class space scales with DISTINCT policies (one
+    # policy's 8 single-port rules partition into ~21 intervals;
+    # port collisions across policies keep it under 8*N_POL)...
+    assert t.n_classes > 100
+    # ...but the verdict tensor's class axis does NOT: it is the
+    # widest single policy (8 rules -> ~2*8+N_PROTO intervals),
+    # padded to the 128-lane tile
+    assert t.verdict.shape[3] == 128
+    assert t.class_map.shape[0] == N_POL
+    # the audit number: HBM scales n_pol x rows x ONE policy's
+    # classes.  At the full 128-policy x 10k-identity config this is
+    # 2.1 GB (measured) vs 17.2 GB without compaction.
+    expect = N_POL * 2 * row_map.capacity * 128 * 4
+    assert t.verdict.nbytes == expect
+    assert t.hbm_bytes() < expect * 1.1
+
+
+def test_remapped_lookup_matches_mapstate(world):
+    pols, row_map, t = world
+    rng = np.random.default_rng(7)
+    for _ in range(500):
+        pi = int(rng.integers(0, N_POL))
+        numeric = row_map.numeric(int(rng.integers(0, N_IDS)))
+        port = int(rng.integers(1, 65535))
+        want_v, _ = pols[pi].ingress.lookup(numeric, PROTO_TCP, port)
+        got_v, _ = t.lookup_np(
+            np.array([pi]), np.array([0]),
+            np.array([row_map.row(numeric)]),
+            np.array([6]), np.array([port]))
+        assert int(got_v[0]) == want_v, (pi, numeric, port)
+
+
+def test_datapath_judges_under_many_policies(world):
+    """End to end on device: endpoints bound to DIFFERENT policy rows
+    judge the same packet differently (the class remap must be
+    per-policy on the hot path too)."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.core import TCP_SYN, make_batch
+    from cilium_tpu.datapath.lpm import DeviceLPM, compile_lpm
+    from cilium_tpu.datapath.verdict import (DatapathState, DevicePolicy,
+                                             datapath_step)
+    from cilium_tpu.datapath.conntrack import CTTable
+
+    pols, row_map, t = world
+    # find a (policy, peer, port) admitted by policy 3 but not 4
+    pi = 3
+    c = next(c for c in pols[pi].ingress.contributions
+             if c.identities)
+    peer = next(iter(c.identities))
+    port = c.lo
+    ep_policy = np.full(4096, -1, dtype=np.int32)
+    ep_policy[1], ep_policy[2] = 3, 4
+    lpm = compile_lpm({"10.9.0.1/32": row_map.row(peer)})
+    state = DatapathState.create(
+        DevicePolicy.from_tensors(t, ep_policy),
+        DeviceLPM.from_tensors(lpm), CTTable.create(1 << 10))
+    batch = make_batch([
+        dict(src="10.9.0.1", dst="10.0.0.1", sport=40000, dport=port,
+             proto=6, flags=TCP_SYN, ep=1, dir=0),  # policy 3: allow
+        dict(src="10.9.0.1", dst="10.0.0.1", sport=40001, dport=port,
+             proto=6, flags=TCP_SYN, ep=2, dir=0),  # policy 4: deny
+    ]).data
+    out, _ = datapath_step(state, jnp.asarray(batch), jnp.uint32(10))
+    out = np.asarray(out)
+    assert int(out[0, 0]) == 1  # OUT_VERDICT allow
+    assert int(out[1, 0]) != 1
