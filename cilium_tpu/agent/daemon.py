@@ -274,14 +274,21 @@ class Daemon:
     # -- identity churn ----------------------------------------------
     def _on_identity_change(self, kind: str, ident) -> None:
         # CIDR-derived identities feed the ipcache (reference: ipcache
-        # CIDR entries appear when policy references them)
+        # CIDR entries appear when policy references them).  Only the
+        # MOST SPECIFIC cidr label is the identity's prefix — the
+        # parent-prefix labels (r05, label-selecting fromCIDR) are
+        # selection metadata; upserting them would route the whole
+        # parent range onto this identity.
         cidr_labels = []
         if kind == "add":
-            for l in ident.labels:
-                if l.source == SOURCE_CIDR:
-                    self.ipcache.upsert(l.key, ident.numeric_id,
-                                        source="generated")
-                    cidr_labels.append(l.key)
+            cidrs = [l.key for l in ident.labels
+                     if l.source == SOURCE_CIDR]
+            if cidrs:
+                exact = max(cidrs,
+                            key=lambda c: int(c.rsplit("/", 1)[1]))
+                self.ipcache.upsert(exact, ident.numeric_id,
+                                    source="generated")
+                cidr_labels.append(exact)
         if not self._started:
             # no serve loop to patch yet, but cached resolutions are
             # STALE (peer sets freeze at resolve time) — without this,

@@ -70,10 +70,14 @@ class NameManager:
             self._allocator.release(old)
 
     def _allocate(self, ip: str, names: Dict[str, float]) -> Identity:
+        from ..identity.allocator import cidr_labels
+
         suffix = "/128" if ":" in ip else "/32"
+        # full parent-prefix label set (r05): a fromCIDR range
+        # label-selects fqdn-minted /32s inside it
         labels = LabelSet(
             [Label("fqdn", n) for n in sorted(names)]
-            + [Label("cidr", ip + suffix), Label("reserved", "world")])
+            + cidr_labels(ip + suffix) + [Label("reserved", "world")])
         return self._allocator.allocate(labels)
 
     # -- TTL expiry (controller cadence) ------------------------------

@@ -21,6 +21,19 @@ import threading
 from typing import Callable, Dict, List, Optional
 
 from ..labels import Label, LabelSet, SOURCE_CIDR
+
+
+def cidr_labels(cidr: str) -> list:
+    """``cidr:`` labels for a prefix and every parent prefix
+    (reference: pkg/labels GetCIDRLabels — 33 labels for a v4 /32,
+    129 for a v6 /128), so CIDR rules select by LABEL, not by
+    happening to share an exact prefix."""
+    net = ipaddress.ip_network(cidr, strict=False)
+    out = [Label(SOURCE_CIDR, str(net))]
+    for plen in range(net.prefixlen):
+        out.append(Label(SOURCE_CIDR, str(net.supernet(
+            new_prefix=plen))))
+    return out
 from .identity import (
     Identity,
     LOCAL_IDENTITY_FLAG,
@@ -107,13 +120,14 @@ class CachingIdentityAllocator:
     def allocate_cidr(self, cidr: str) -> Identity:
         """Allocate a node-local identity for a CIDR (toCIDR / fqdn flows).
 
-        Reference: pkg/identity CIDR-derived local identities; labels are
-        ``cidr:<prefix>`` plus ``reserved:world``.
+        Reference: pkg/identity CIDR-derived local identities; labels
+        are ``cidr:<prefix>`` for the prefix AND every parent prefix
+        (pkg/labels GetCIDRLabels), plus ``reserved:world`` — so a
+        ``fromCIDR 10.0.0.0/8`` rule label-selects a later-minted
+        ``10.1.2.3/32`` identity (DIVERGENCES #8, closed r05).
         """
-        net = ipaddress.ip_network(cidr, strict=False)
-        labels = LabelSet(
-            [Label(SOURCE_CIDR, str(net)), Label("reserved", "world")]
-        )
+        labels = LabelSet(cidr_labels(cidr)
+                          + [Label("reserved", "world")])
         return self.allocate(labels)
 
     def release(self, ident: Identity) -> bool:

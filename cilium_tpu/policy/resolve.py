@@ -168,9 +168,27 @@ def _peer_identities(
             raise ValueError(f"unknown entity {ent!r}")
         ids |= selector_cache.selections(sel)
         live.append(sel)
+    import ipaddress as _ip
+
     for c in cidrs:
         ident = allocator.allocate_cidr(c.cidr)
         ids.add(ident.numeric_id)
+        # CIDR peers select by LABEL (r05, DIVERGENCES #8 closed):
+        # every CIDR identity carries its parent-prefix labels, so a
+        # fromCIDR range selects later-minted more-specific identities
+        # (fqdn /32s, other rules' toCIDR) — with 'except' prefixes as
+        # DoesNotExist requirements, exactly upstream's
+        # cidrRuleToEndpointSelector translation.
+        net = _ip.ip_network(c.cidr, strict=False)
+        sel = EndpointSelector(
+            match_labels=((f"cidr:{net}", ""),),
+            match_expressions=tuple(
+                Requirement(
+                    key=f"cidr:{_ip.ip_network(e, strict=False)}",
+                    operator="DoesNotExist")
+                for e in c.except_cidrs))
+        ids |= selector_cache.selections(sel)
+        live.append(sel)
         # 'except' CIDRs allocate identities too so the ipcache can carve
         # them out; they are excluded from this peer set.
         for exc in c.except_cidrs:

@@ -229,3 +229,87 @@ def test_snat_pool_exhaustion_drops_and_counts(backend):
 
     entries = ct_entries_from_snapshot(d.loader.ct_snapshot(), 1000)
     assert victim_sport not in {e["sport"] for e in entries}
+
+
+# -- DIVERGENCES #8: CIDR identities carry parent-prefix labels -------
+
+def test_cidr_labels_cover_every_parent_prefix():
+    from cilium_tpu.identity.allocator import cidr_labels
+
+    labs = {str(l.key) for l in cidr_labels("10.1.2.3/32")}
+    assert "10.1.2.3/32" in labs
+    assert "10.0.0.0/8" in labs
+    assert "10.1.0.0/16" in labs
+    assert "0.0.0.0/0" in labs
+    assert len(labs) == 33
+
+
+@pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+def test_fromcidr_selects_later_minted_specific_identity(backend):
+    """A fromCIDR 198.51.0.0/16 rule must admit traffic from an
+    fqdn-minted /32 inside the range created AFTER the rule resolved
+    — by LABEL selection, not LPM coincidence: the /32 has its own
+    more-specific ipcache entry, so the LPM resolves the packet to
+    the /32 identity, and only label membership can admit it."""
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+    ep = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [
+            {"toFQDNs": ["cdn.example.com"],
+             "toPorts": [{"ports": [{"port": "80",
+                                     "protocol": "TCP"}]}]},
+            {"toCIDR": ["198.51.0.0/16"],
+             "toPorts": [{"ports": [{"port": "443",
+                                     "protocol": "TCP"}]}]},
+        ],
+    }])
+    d.start()
+    # the fqdn loop mints 198.51.100.7/32 AFTER the rule resolved;
+    # its ipcache /32 beats the /16 in the LPM
+    d.proxy.observe_answer("cdn.example.com", ["198.51.100.7"],
+                           ttl=600)
+    batch = make_batch([
+        dict(src="10.0.1.1", dst="198.51.100.7", sport=40001,
+             dport=443, proto=6, flags=TCP_SYN, ep=ep.id, dir=1),
+        dict(src="10.0.1.1", dst="198.51.100.7", sport=40002,
+             dport=8443, proto=6, flags=TCP_SYN, ep=ep.id, dir=1),
+    ]).data
+    ev = d.process_batch(batch, now=5)
+    assert int(ev.verdict[0]) == VERDICT_ALLOW, backend
+    assert int(ev.verdict[1]) != VERDICT_ALLOW, backend
+
+
+@pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+def test_fromcidr_except_excludes_inner_range(backend):
+    """fromCIDR with except: identities inside the excepted range
+    carry its cidr label, and the selector's DoesNotExist requirement
+    keeps them out (upstream cidrRuleToEndpointSelector)."""
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+    ep = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [
+            {"toFQDNs": ["a.example.com", "b.example.com"],
+             "toPorts": [{"ports": [{"port": "80",
+                                     "protocol": "TCP"}]}]},
+            {"toCIDR": [{"cidr": "198.51.0.0/16",
+                         "except": ["198.51.100.0/24"]}],
+             "toPorts": [{"ports": [{"port": "443",
+                                     "protocol": "TCP"}]}]},
+        ],
+    }])
+    d.start()
+    d.proxy.observe_answer("a.example.com", ["198.51.7.7"], ttl=600)
+    d.proxy.observe_answer("b.example.com", ["198.51.100.9"], ttl=600)
+    batch = make_batch([
+        # in range, outside the exception: allowed at 443
+        dict(src="10.0.1.1", dst="198.51.7.7", sport=40001,
+             dport=443, proto=6, flags=TCP_SYN, ep=ep.id, dir=1),
+        # inside the exception: denied at 443
+        dict(src="10.0.1.1", dst="198.51.100.9", sport=40002,
+             dport=443, proto=6, flags=TCP_SYN, ep=ep.id, dir=1),
+    ]).data
+    ev = d.process_batch(batch, now=5)
+    assert int(ev.verdict[0]) == VERDICT_ALLOW, backend
+    assert int(ev.verdict[1]) != VERDICT_ALLOW, backend
