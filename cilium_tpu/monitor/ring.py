@@ -200,13 +200,14 @@ class AsyncRingDrainer:
         for the next window.  At most one fetch may be in flight:
         call :meth:`collect` first.
 
-        The block_until_ready BEFORE the copy is load-bearing on
-        tunneled runtimes: a d2h transfer with queued dispatches pays
-        a pathological per-dispatch flush (~9 s each, measured r05),
-        while block_until_ready drains the same queue in
-        milliseconds — sync first, then copy only moves bytes."""
+        The block_until_ready on the CURSOR before the copy is
+        load-bearing on tunneled runtimes: a d2h transfer with queued
+        dispatches pays a pathological per-dispatch flush (~9 s each,
+        measured r05), while blocking on the tiny cursor drains the
+        same queue in milliseconds (blocking on the large buffer
+        triggers the slow path itself — sync on the scalar, then the
+        copies only move bytes)."""
         assert self._pending is None, "previous window not collected"
-        ring.buf.block_until_ready()
         ring.cursor.block_until_ready()
         ring.buf.copy_to_host_async()
         ring.cursor.copy_to_host_async()

@@ -1,0 +1,154 @@
+"""Envoy-shaped xDS interop (VERDICT r04 missing item 5).
+
+The SotW server was only ever golden-tested against straight-line
+in-repo calls; this drives it with a client that behaves like Envoy's
+grpc_mux over the REAL gRPC stream: initial request with empty
+version, ACK every response by echoing version_info + response_nonce,
+NACK with error_detail while keeping the last-good version, RECONNECT
+carrying the last ACKed version into a fresh stream, and resource
+unsubscription by narrowing resource_names.
+"""
+
+import json
+import queue
+import threading
+import time
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.kvstore import InMemoryKVStore
+from cilium_tpu.proxy.xds import TYPE_URL, serve_xds
+
+METHOD = ("/cilium.NetworkPolicyDiscoveryService/"
+          "StreamNetworkPolicies")
+
+
+def _daemon():
+    d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12),
+               kvstore=InMemoryKVStore())
+    d.add_endpoint("db-1", ("10.0.2.1",), ["k8s:app=db"])
+    return d
+
+
+def _cnp(port):
+    return [{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{"fromEndpoints": [{}],
+                     "toPorts": [{"ports": [
+                         {"port": str(port), "protocol": "TCP"}]}]}],
+    }]
+
+
+class EnvoyishMux:
+    """The client half of Envoy's SotW grpc_mux, minimally: one
+    bidirectional stream, an outbound request queue, ACK/NACK
+    bookkeeping (version_info survives NACKs, response_nonce echoes
+    the last response)."""
+
+    def __init__(self, channel, version_info=""):
+        self.version_info = version_info
+        self.nonce = ""
+        self._out: "queue.Queue" = queue.Queue()
+        self._in: "queue.Queue" = queue.Queue()
+        stream = channel.stream_stream(
+            METHOD,
+            request_serializer=lambda o: json.dumps(o).encode(),
+            response_deserializer=lambda b: json.loads(b.decode()))
+        resps = stream(iter(self._out.get, None))
+
+        def reader():  # ONE persistent reader: a timed-out recv must
+            try:       # not orphan a blocked next() that would swallow
+                for r in resps:  # the following response
+                    self._in.put(r)
+            except Exception:
+                pass
+
+        threading.Thread(target=reader, daemon=True).start()
+
+    def send(self, resource_names=(), error_detail=None):
+        req = {"type_url": TYPE_URL,
+               "version_info": self.version_info,
+               "response_nonce": self.nonce}
+        if resource_names:
+            req["resource_names"] = list(resource_names)
+        if error_detail:
+            req["error_detail"] = error_detail
+        self._out.put(req)
+
+    def recv(self, timeout=10.0):
+        try:
+            r = self._in.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError("no DiscoveryResponse") from None
+        self.nonce = r["nonce"]
+        return r
+
+    def ack(self, resp):
+        self.version_info = resp["version_info"]
+
+    def close(self):
+        self._out.put(None)
+
+
+def test_envoy_shaped_session(tmp_path):
+    d = _daemon()
+    addr = f"unix://{tmp_path}/xds.sock"
+    server = serve_xds(d.xds, addr)
+    try:
+        ch = grpc.insecure_channel(addr)
+        mux = EnvoyishMux(ch)
+        # 1. initial request (empty version): full snapshot + ACK
+        mux.send()
+        r1 = mux.recv()
+        assert r1["resources"] and r1["nonce"] == r1["version_info"]
+        mux.ack(r1)
+
+        # 2. ACKed and quiet; a policy import pushes a NEW version
+        mux.send()
+        d.policy_import(_cnp(5432))
+        r2 = mux.recv()
+        assert int(r2["version_info"]) > int(r1["version_info"])
+        names = [res["name"] for res in r2["resources"]]
+        assert any("app=db" in n or "db" in n for n in names), names
+
+        # 3. NACK it: version_info stays at last-good, the server
+        #    records the rejection and immediately RE-SERVES the
+        #    rejected version (the SotW retry — the client is behind)
+        mux.send(error_detail="bad listener config")
+        r3 = mux.recv()
+        assert r3["version_info"] == r2["version_info"]
+        assert d.xds.nacks and d.xds.nacks[-1][1].startswith("bad")
+        mux.ack(r3)  # accepted on retry
+        d.policy_import(_cnp(5433))
+        mux.send()
+        r3 = mux.recv()
+        assert int(r3["version_info"]) > int(r2["version_info"])
+        mux.ack(r3)
+
+        # 4. unsubscribe: narrow resource_names to one resource; the
+        #    next push carries ONLY it
+        keep = [res["name"] for res in r3["resources"]][:1]
+        mux.send(resource_names=keep)
+        d.policy_import(_cnp(5434))
+        r4 = mux.recv()
+        assert [res["name"] for res in r4["resources"]] == keep
+        mux.ack(r4)
+        mux.close()
+
+        # 5. reconnect (Envoy restarts the stream after a drop): the
+        #    fresh stream carries the last ACKed version — the server
+        #    long-polls (nothing to resend) until the next change
+        mux2 = EnvoyishMux(ch, version_info=mux.version_info)
+        mux2.send()
+        with pytest.raises(TimeoutError):
+            mux2.recv(timeout=0.5)  # up to date: no spurious resend
+        d.policy_import(_cnp(5435))
+        r5 = mux2.recv()
+        assert int(r5["version_info"]) > int(r4["version_info"])
+        mux2.close()
+        ch.close()
+    finally:
+        server.stop(0)
