@@ -39,6 +39,16 @@ DEFAULT_RESOURCES: Tuple[Tuple[str, str], ...] = (
     ("CiliumNode", "/apis/cilium.io/v2/ciliumnodes"),
 )
 
+# CES mode (upstream --enable-cilium-endpoint-slice): agents watch
+# operator-batched CiliumEndpointSlices INSTEAD of per-pod
+# CiliumEndpoints — both kinds feed the same CiliumEndpointWatcher
+# state, so watching both would let a slice shrink clobber an entry a
+# live direct CEP still backs (and vice versa).
+CES_RESOURCES: Tuple[Tuple[str, str], ...] = tuple(
+    r for r in DEFAULT_RESOURCES if r[0] != "CiliumEndpoint"
+) + (("CiliumEndpointSlice",
+      "/apis/cilium.io/v2alpha1/ciliumendpointslices"),)
+
 _EVENT_MAP = {"ADDED": "add", "MODIFIED": "update", "DELETED": "delete"}
 
 

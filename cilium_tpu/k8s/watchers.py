@@ -378,6 +378,59 @@ class CiliumEndpointWatcher:
         self.daemon.delete_ipcache(ip + suffix)
 
 
+class CiliumEndpointSliceWatcher:
+    """CiliumEndpointSlice objects -> the same per-endpoint ipcache
+    path as direct CEPs (reference: pkg/k8s/watchers
+    ciliumEndpointSliceInit — agents in CES mode watch slices INSTEAD
+    of CiliumEndpoints; build the informer with ``CES_RESOURCES``.
+    See operator/ces.py for the batching side).
+
+    A slice update diffs against the previous membership so endpoints
+    that left the slice are deleted, not leaked — but membership is
+    tracked GLOBALLY (key -> owning slice): the operator's FCFS
+    refill can migrate an endpoint between slices within one sync
+    window, and whichever slice's update lands second must not tear
+    down the ipcache entry the other slice still carries."""
+
+    def __init__(self, ceps: "CiliumEndpointWatcher"):
+        self.ceps = ceps
+        self._members: Dict[str, Dict[str, dict]] = {}  # slice -> key -> cep
+        self._owner: Dict[str, str] = {}                # key -> slice name
+
+    def on_add(self, obj: dict) -> int:
+        from ..operator.ces import expand_slice
+
+        name = (obj.get("metadata") or {}).get("name", "")
+        now = {_meta_key(cep): cep for cep in expand_slice(obj)}
+        prev = self._members.get(name, {})
+        n = 0
+        for key, cep in prev.items():
+            # delete only if no OTHER slice has since claimed the key
+            if key not in now and self._owner.get(key) == name:
+                self.ceps.on_delete(cep)
+                del self._owner[key]
+                n += 1
+        for key, cep in now.items():
+            self._owner[key] = name
+            if prev.get(key) != cep:  # skip unchanged members
+                n += self.ceps.on_add(cep)
+        self._members[name] = now
+        return n
+
+    on_update = on_add
+
+    def on_delete(self, obj: dict) -> int:
+        name = (obj.get("metadata") or {}).get("name", "")
+        prev = self._members.pop(name, {})
+        n = 0
+        for key, cep in prev.items():
+            if self._owner.get(key) == name:
+                self.ceps.on_delete(cep)
+                del self._owner[key]
+                n += 1
+        return n
+
+
 class CiliumNodeWatcher:
     """CiliumNode objects -> the kvstore node registry (what the
     health mesh probes and the operator's dead-node sweep reads;
@@ -425,6 +478,7 @@ class K8sWatcherHub:
         self.pods.namespaces = self.namespaces
         self.identities = CiliumIdentityWatcher(daemon.allocator)
         self.ceps = CiliumEndpointWatcher(daemon)
+        self.ces = CiliumEndpointSliceWatcher(self.ceps)
         self.nodes = CiliumNodeWatcher(daemon.kvstore)
         self._routes = {
             "CiliumNetworkPolicy": self.cnp,
@@ -435,6 +489,7 @@ class K8sWatcherHub:
             "Namespace": self.namespaces,
             "CiliumIdentity": self.identities,
             "CiliumEndpoint": self.ceps,
+            "CiliumEndpointSlice": self.ces,
             "CiliumNode": self.nodes,
         }
 

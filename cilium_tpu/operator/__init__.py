@@ -2,9 +2,10 @@
 
 Reference: upstream cilium ``operator/`` — one replica per cluster
 garbage-collects unreferenced identities, assigns cluster-pool
-podCIDRs to nodes, and cleans up state of departed nodes.  The heavy
-k8s parts (CEP batching, CRD management) have no analogue here; the
-three responsibilities above do, and all ride the kvstore.
+podCIDRs to nodes, and cleans up state of departed nodes.  The three
+kvstore-riding responsibilities live on :class:`Operator`;
+CiliumEndpointSlice batching (operator/pkg/ciliumendpointslice) lives
+in :mod:`.ces`.
 """
 
 from __future__ import annotations
@@ -16,6 +17,7 @@ from typing import Dict, Optional
 from ..health import NODES_PREFIX
 from ..ipam import ClusterPool
 from ..kvstore.allocator import DEFAULT_PREFIX, KVStoreAllocatorBackend
+from .ces import CES_MAX_ENDPOINTS, CESBatcher  # noqa: F401 (re-export)
 
 
 class Operator:

@@ -33,6 +33,8 @@ PATH_BY_KIND = {
         "/apis/cilium.io/v2/ciliumclusterwidenetworkpolicies",
     "CiliumIdentity": "/apis/cilium.io/v2/ciliumidentities",
     "CiliumEndpoint": "/apis/cilium.io/v2/ciliumendpoints",
+    "CiliumEndpointSlice":
+        "/apis/cilium.io/v2alpha1/ciliumendpointslices",
     "CiliumNode": "/apis/cilium.io/v2/ciliumnodes",
 }
 

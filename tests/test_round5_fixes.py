@@ -156,23 +156,32 @@ def test_groupable_excludes_backrefs_and_groups():
 
 
 def test_backref_path_rule_matches_correctly_when_grouped_with_others():
+    # evaluate through the proxy so BOTH halves participate: /x/.* is
+    # a LITERAL.* rule and rides the device prefix-hash rows (r05),
+    # while the backref rule must stay a per-rule host matcher that
+    # grouping cannot renumber
     from cilium_tpu.policy.api import PortRuleHTTP, L7Rules
-    from cilium_tpu.proxy.l7policy import compile_l7
+    from cilium_tpu.proxy.proxy import L7Proxy
 
     l7 = L7Rules(http=(
         PortRuleHTTP(method="GET", path="/x/.*"),
         PortRuleHTTP(method="GET", path=r"/(a+)/\1"),
     ))
-    tensors = compile_l7([(80, "rule0", l7)])
-    matchers = tensors.host_matchers.get(80, ())
+
+    class _Pol:
+        redirects = ((80, "rule0", l7),)
+
+    proxy = L7Proxy()
+    proxy.update([_Pol()])
 
     def matched(path):
-        req = {"method": "GET", "path": path, "host": "", "headers": ()}
-        return any(m(req) for m in matchers)
+        allow = proxy.handle_http(
+            80, [{"method": "GET", "path": path, "host": ""}])
+        return bool(allow[0])
 
     assert matched("/aa/aa")       # backref matches same text
     assert not matched("/aa/aaa")  # and ONLY the same text
-    assert matched("/x/anything")
+    assert matched("/x/anything")  # prefix rule verdicts on device
 
 
 def test_listener_rejects_obs_fold_and_noncanonical_clen():
