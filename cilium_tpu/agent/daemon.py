@@ -531,6 +531,26 @@ class Daemon:
         config per remote cluster)."""
         return self.clustermesh.connect(name, cluster_id, kv)
 
+    def socklb_entries(self, limit: int = 1000) -> list:
+        """Decode the socket-LB flow cache for GET /map/lb
+        (`cilium-tpu bpf lb list`).  ``socklb_stage_jit`` DONATES the
+        table every batch, so a snapshot raced by process_batch can
+        find its buffer deleted — retry on the replacement table
+        rather than serializing the API against the hot path."""
+        from ..service.socklb import socklb_entries_from_snapshot
+
+        for _ in range(4):
+            tbl = self._socklb
+            if tbl is None:
+                return []
+            try:
+                snap = np.asarray(tbl.table)
+            except RuntimeError:  # donated mid-read
+                continue
+            return socklb_entries_from_snapshot(snap, self._now(),
+                                                limit)
+        return []
+
     # -- ipcache API (the k8s-watcher/clustermesh-facing entry) --------
     def upsert_ipcache(self, cidr: str, numeric_id: int,
                        source: str = "k8s") -> None:

@@ -139,6 +139,9 @@ def _make_handler(daemon: Daemon):
                     limit = int(q.get("limit", ["1000"])[0])
                     self._send(200, ct_entries_from_snapshot(
                         daemon.loader.ct_snapshot(), limit))
+                elif path == "/map/lb":
+                    limit = int(q.get("limit", ["1000"])[0])
+                    self._send(200, daemon.socklb_entries(limit))
                 elif path == "/map/nat":
                     from ..service.nat import nat_entries_from_snapshot
 

@@ -213,6 +213,16 @@ def cmd_bpf(args) -> int:
         for e in entries:
             print(f"{e['cidr']:<24}identity={e['identity']} "
                   f"source={e['source']}")
+    elif args.obj == "lb":
+        entries = c.map_get("lb")
+        if args.json:
+            _print(entries)
+            return 0
+        for e in entries:
+            be = e["backend"] or "(no service)"
+            print(f"{e['proto']} {e['src']}:{e['sport']} -> "
+                  f"{e['vip']}:{e['dport']} backend={be} "
+                  f"expires={e['expires']}")
     elif args.obj == "nat":
         entries = c.map_get("nat")
         if args.json:
@@ -415,8 +425,10 @@ def main(argv=None) -> int:
                    choices=["listeners", "xds"])
 
     p = sub.add_parser("bpf", help="bpf ct list | bpf policy get ID | "
-                                   "bpf ipcache list | bpf nat list")
-    p.add_argument("obj", choices=["ct", "policy", "ipcache", "nat"])
+                                   "bpf ipcache list | bpf nat list | "
+                                   "bpf lb list")
+    p.add_argument("obj", choices=["ct", "policy", "ipcache", "nat",
+                                   "lb"])
     p.add_argument("action", nargs="?", default="list")
     p.add_argument("id", nargs="?", type=int, default=0)
 

@@ -255,3 +255,31 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
 
 
 socklb_stage_jit = jax.jit(socklb_stage, donate_argnums=0)
+
+
+def socklb_entries_from_snapshot(table: np.ndarray, now: int,
+                                 limit: int = 1000) -> list:
+    """Decode live flow-cache slots for `cilium-tpu bpf lb list`
+    (reference: `cilium bpf lb list` over the sock rev-NAT maps).
+    Negative entries (cached "not a service") report backend=None."""
+    import ipaddress
+
+    table = np.asarray(table)
+    live = np.nonzero(table[:, SK_EXPIRES] >= now)[0][:limit]
+    out = []
+    for s in live:
+        row = table[s]
+        neg = int(row[SK_BE_PORT]) == NO_BACKEND
+        out.append({
+            "src": str(ipaddress.IPv4Address(int(row[SK_SRC]))),
+            "sport": int(row[SK_SPORT]),
+            "vip": str(ipaddress.IPv4Address(int(row[SK_VIP]))),
+            "dport": int(row[SK_DP]) >> 8,
+            "proto": int(row[SK_DP]) & 0xFF,
+            "backend": (None if neg else
+                        str(ipaddress.IPv4Address(int(row[SK_BE_IP])))
+                        + f":{int(row[SK_BE_PORT])}"),
+            "expires": int(row[SK_EXPIRES]),
+        })
+    return out
+

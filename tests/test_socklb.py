@@ -157,3 +157,38 @@ class TestSockLB:
                                  dir=1)]).data, now=6)
             assert int(ev2.verdict[0]) == VERDICT_ALLOW, backend
             assert int(ev2.hdr[0, COL_DPORT]) == 8080
+
+
+class TestSockLBIntrospection:
+    def test_bpf_lb_list_shows_cached_flows(self, tmp_path, capsys):
+        """/map/lb + `cilium-tpu bpf lb list` decode the live flow
+        cache (the `cilium bpf lb list` analogue)."""
+        from cilium_tpu.agent import Daemon, DaemonConfig
+        from cilium_tpu.api import APIClient, APIServer
+        from cilium_tpu.cli.main import main as cli_main
+
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+        ep = d.add_endpoint("client", ("10.0.9.9",), ["k8s:app=client"])
+        d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+        d.services.upsert("web", "172.16.0.10:80", ["10.0.1.1:8080"])
+        d.process_batch(
+            make_batch([dict(src="10.0.9.9", dst="172.16.0.10",
+                             sport=41000, dport=80, proto=6,
+                             flags=TCP_SYN, ep=ep.id, dir=1)]).data,
+            now=5)
+        sock = str(tmp_path / "lb.sock")
+        server = APIServer(d, sock)
+        server.start()
+        try:
+            entries = APIClient(sock).map_get("lb")
+            assert any(e["vip"] == "172.16.0.10" and e["dport"] == 80
+                       and e["backend"] == "10.0.1.1:8080"
+                       and e["src"] == "10.0.9.9"
+                       for e in entries)
+            assert cli_main(["--socket", sock, "bpf", "lb",
+                             "list"]) == 0
+            out = capsys.readouterr().out
+            assert "172.16.0.10:80" in out
+            assert "backend=10.0.1.1:8080" in out
+        finally:
+            server.stop()
