@@ -30,23 +30,31 @@ import numpy as np
 from ..datapath.verdict import EV_TRACE, N_OUT, OUT_EVENT
 
 # Decoded ring row: the N_OUT out-columns + packet index within batch
-# + batch seq.  On DEVICE each row packs into RING_WORDS u32 (12 B
-# instead of 32 B) — the drain is a device->host copy, and on tunneled
-# hosts its bandwidth is the monitor plane's ceiling, so the wire
-# format is packed exactly like the reference keeps perf events small.
+# + batch seq.  On DEVICE each row packs into RING_WORDS u32 (8 B
+# instead of 32 B) — the drain is a device->host copy, and its
+# bandwidth is the monitor plane's ceiling (PCIe on direct-attached
+# TPUs, worse on tunneled hosts), so the wire format is packed exactly
+# like the reference keeps perf events small.  r05: 12 B -> 8 B by
+# (a) storing the proxy PORT as a 4-bit index into the small listener
+# table (there are at most a handful of live redirect listeners —
+# upstream allocates them from a ~dozen-wide range) and (b) shrinking
+# the batch-seq field to 13 bits (it disambiguates/orders events
+# within a drain window; windows are a few dozen batches).
 # Packing (see _unpack_rows for the decode):
 #   w0: verdict(0..2) | event(3..4) | reason(5..8) | ct(9..11)
-#       | proxy(16..31)
-#   w1: id_row(0..15) | pkt_idx low 16 (16..31)
-#   w2: batch(0..27, wraps) | pkt_idx high 4 (28..31)
-# Limits (asserted where they bind): id_row < 2^16, pkt_idx < 2^20
-# (batches up to 1M rows), batch seq wraps at 2^28.
+#       | proxy_idx(12..15) | id_row(16..31)
+#   w1: pkt_idx(0..18) | batch(19..31, wraps)
+# Limits (asserted where they bind): id_row < 2^16, pkt_idx < 2^19
+# (batches up to 512k rows), batch seq wraps at 2^13, <= 15 live
+# proxy listeners.  Empty slots carry event bits 0b11 (no EV_* code
+# uses 3), which is how the drain drops never-written rows.
 RING_COLS = N_OUT + 2
 COL_PKT_IDX = N_OUT
 COL_BATCH = N_OUT + 1
 EMPTY_BATCH = 0xFFFFFFFF
-RING_WORDS = 3
-_EMPTY_W2 = 0xFFFFFFFF  # unreachable batch/pkt combination
+RING_WORDS = 2
+MAX_PROXY_PORTS = 15
+_EMPTY = 0xFFFFFFFF
 
 
 @jax.tree_util.register_pytree_node_class
@@ -65,7 +73,7 @@ class EventRing:
     @staticmethod
     def create(capacity: int = 1 << 15) -> "EventRing":
         assert capacity & (capacity - 1) == 0, "capacity must be 2^k"
-        buf = jnp.full((capacity, RING_WORDS), _EMPTY_W2,
+        buf = jnp.full((capacity, RING_WORDS), _EMPTY,
                        dtype=jnp.uint32)
         return EventRing(buf=buf, cursor=jnp.zeros((2,), jnp.uint32))
 
@@ -83,12 +91,19 @@ class EventRing:
 
 def ring_append(ring: EventRing, out: jnp.ndarray, batch_id: jnp.ndarray,
                 trace_sample: int = 1024,
-                valid: jnp.ndarray = None) -> EventRing:
+                valid: jnp.ndarray = None,
+                proxy_ports: jnp.ndarray = None) -> EventRing:
     """Compact one batch's out tensor into the ring (pure device op).
 
     Keeps every non-TRACE event (drops, NEW-connection policy
     verdicts) plus one in ``trace_sample`` established-flow traces
     (``trace_sample=0`` disables trace sampling entirely).
+
+    ``proxy_ports`` is the live listener table ([MAX_PROXY_PORTS]
+    uint32, 0-padded): redirect events store the PORT's index in it
+    (4 bits on the wire); pass the same table to :func:`ring_drain`
+    to restore ports.  Without it redirect events decode with proxy
+    port 0.
     """
     n = out.shape[0]
     idx = jnp.arange(n, dtype=jnp.uint32)
@@ -97,7 +112,7 @@ def ring_append(ring: EventRing, out: jnp.ndarray, batch_id: jnp.ndarray,
         keep = keep | (idx % trace_sample == 0)
     if valid is not None:
         keep = keep & valid
-    assert n < (1 << 20), "pkt_idx packs into 20 bits"
+    assert n <= (1 << 19), "pkt_idx packs into 19 bits"
     pos = jnp.cumsum(keep) - 1  # position among kept rows
     count = keep.sum().astype(jnp.uint32)
     mask = ring.capacity - 1
@@ -113,13 +128,25 @@ def ring_append(ring: EventRing, out: jnp.ndarray, batch_id: jnp.ndarray,
     from ..datapath.verdict import (OUT_CT, OUT_ID_ROW, OUT_PROXY,
                                     OUT_REASON, OUT_VERDICT)
 
-    w0 = (o[:, OUT_VERDICT] | (o[:, OUT_EVENT] << 3)
-          | (o[:, OUT_REASON] << 5) | (o[:, OUT_CT] << 9)
-          | (o[:, OUT_PROXY] << 16))
-    w1 = o[:, OUT_ID_ROW] | (idx << 16)
-    w2 = ((jnp.uint32(batch_id) & jnp.uint32(0x0FFFFFFF))
-          | ((idx >> 16) << 28))
-    rows = jnp.stack([w0, w1, w2], axis=1)
+    if proxy_ports is None:
+        pidx = jnp.zeros(n, dtype=jnp.uint32)
+    else:
+        assert proxy_ports.shape[0] <= MAX_PROXY_PORTS, \
+            "listener index packs into 4 bits"
+        port = o[:, OUT_PROXY]
+        hit = port[:, None] == proxy_ports[None, :].astype(jnp.uint32)
+        pidx = jnp.where(
+            jnp.any(hit, axis=1) & (port != 0),
+            jnp.argmax(hit, axis=1).astype(jnp.uint32) + 1,
+            jnp.uint32(0))
+    # mask each field to its wire width: a value past its width must
+    # corrupt only itself, never a neighbor (the empty-slot sentinel
+    # lives in the event bits)
+    w0 = ((o[:, OUT_VERDICT] & 0x7) | ((o[:, OUT_EVENT] & 0x3) << 3)
+          | ((o[:, OUT_REASON] & 0xF) << 5) | ((o[:, OUT_CT] & 0x7) << 9)
+          | (pidx << 12) | ((o[:, OUT_ID_ROW] & 0xFFFF) << 16))
+    w1 = idx | ((jnp.uint32(batch_id) & jnp.uint32(0x1FFF)) << 19)
+    rows = jnp.stack([w0, w1], axis=1)
     buf = ring.buf.at[target].set(rows, mode="drop")
     new_lo = lo + count
     new_hi = hi + (new_lo < lo).astype(jnp.uint32)  # carry
@@ -132,7 +159,8 @@ ring_append_jit = jax.jit(ring_append, donate_argnums=0,
 
 def serve_step(state, ring: EventRing, hdr: jnp.ndarray,
                now: jnp.ndarray, batch_id: jnp.ndarray,
-               trace_sample: int = 1024, valid: jnp.ndarray = None):
+               trace_sample: int = 1024, valid: jnp.ndarray = None,
+               proxy_ports: jnp.ndarray = None):
     """The serving-path step: fused datapath + event-ring append in ONE
     executable (one dispatch per batch; out rows that the compaction
     discards are never materialized).  Returns (state, ring)."""
@@ -140,7 +168,7 @@ def serve_step(state, ring: EventRing, hdr: jnp.ndarray,
 
     out, state = datapath_step(state, hdr, now, valid=valid)
     ring = ring_append(ring, out, batch_id, trace_sample=trace_sample,
-                       valid=valid)
+                       valid=valid, proxy_ports=proxy_ports)
     return state, ring
 
 
@@ -150,13 +178,15 @@ serve_step_jit = jax.jit(serve_step, donate_argnums=(0, 1),
 
 def serve_step_packed(state, ring: EventRing, packed: jnp.ndarray,
                       now: jnp.ndarray, batch_id: jnp.ndarray,
-                      ep, dirn, trace_sample: int = 1024):
+                      ep, dirn, trace_sample: int = 1024,
+                      proxy_ports: jnp.ndarray = None):
     """Serving path for the packed ingest format (16 B/packet h2d):
     unpack + fused datapath + ring append, ONE dispatch per batch."""
     from ..datapath.verdict import datapath_step_packed
 
     out, state = datapath_step_packed(state, packed, now, ep, dirn)
-    ring = ring_append(ring, out, batch_id, trace_sample=trace_sample)
+    ring = ring_append(ring, out, batch_id, trace_sample=trace_sample,
+                       proxy_ports=proxy_ports)
     return state, ring
 
 
@@ -185,8 +215,10 @@ class AsyncRingDrainer:
     ``max(0, appended - capacity)`` with no cross-window bookkeeping.
     """
 
-    def __init__(self, capacity: int = 1 << 15):
+    def __init__(self, capacity: int = 1 << 15,
+                 proxy_ports: np.ndarray = None):
         self.capacity = capacity
+        self.proxy_ports = proxy_ports
         self._pending: EventRing = None
         self.windows = 0
         self.events = 0
@@ -221,33 +253,48 @@ class AsyncRingDrainer:
         if ring is None:
             return np.zeros((0, RING_COLS), dtype=np.uint32), 0, 0
         self._pending = None
-        rows, appended, lost = ring_drain(ring)
+        rows, appended, lost = ring_drain(ring, self.proxy_ports)
         self.windows += 1
         self.events += appended - lost
         self.lost += lost
         return rows, appended, lost
 
 
-def _unpack_rows(packed: np.ndarray) -> np.ndarray:
+def _unpack_rows(packed: np.ndarray,
+                 proxy_ports: np.ndarray = None) -> np.ndarray:
     """Packed [m, RING_WORDS] device rows -> decoded [m, RING_COLS]
-    (OUT_* columns + pkt_idx + batch), pure host numpy."""
+    (OUT_* columns + pkt_idx + batch), pure host numpy.
+    ``proxy_ports`` (same table given to :func:`ring_append`) restores
+    redirect ports from their 4-bit wire index."""
     from ..datapath.verdict import (OUT_CT, OUT_ID_ROW, OUT_PROXY,
                                     OUT_REASON, OUT_VERDICT)
 
-    w0, w1, w2 = packed[:, 0], packed[:, 1], packed[:, 2]
+    w0, w1 = packed[:, 0], packed[:, 1]
     rows = np.empty((len(packed), RING_COLS), dtype=np.uint32)
     rows[:, OUT_VERDICT] = w0 & 0x7
     rows[:, OUT_EVENT] = (w0 >> 3) & 0x3
     rows[:, OUT_REASON] = (w0 >> 5) & 0xF
     rows[:, OUT_CT] = (w0 >> 9) & 0x7
-    rows[:, OUT_PROXY] = w0 >> 16
-    rows[:, OUT_ID_ROW] = w1 & 0xFFFF
-    rows[:, COL_PKT_IDX] = (w1 >> 16) | ((w2 >> 28) << 16)
-    rows[:, COL_BATCH] = w2 & 0x0FFFFFFF
+    pidx = (w0 >> 12) & 0xF
+    if proxy_ports is None:
+        rows[:, OUT_PROXY] = 0
+    else:
+        # pad to the full 4-bit index space: a drain given a SHORTER
+        # table than append used (listener removed between windows)
+        # must degrade stale rows to port 0, not crash the drain
+        table = np.zeros(MAX_PROXY_PORTS + 1, dtype=np.uint32)
+        pp = np.asarray(proxy_ports, dtype=np.uint32)
+        table[1:1 + len(pp)] = pp
+        rows[:, OUT_PROXY] = table[pidx]
+    rows[:, OUT_ID_ROW] = w0 >> 16
+    rows[:, COL_PKT_IDX] = w1 & 0x7FFFF
+    rows[:, COL_BATCH] = w1 >> 19
     return rows
 
 
-def ring_drain(ring: EventRing) -> Tuple[np.ndarray, int, int]:
+def ring_drain(ring: EventRing,
+               proxy_ports: np.ndarray = None
+               ) -> Tuple[np.ndarray, int, int]:
     """Fetch + decode the ring on host.
 
     Returns (rows [m, RING_COLS] in append order, total_appended,
@@ -264,5 +311,6 @@ def ring_drain(ring: EventRing) -> Tuple[np.ndarray, int, int]:
         head = total & (cap - 1)
         rows = np.concatenate([buf[head:], buf[:head]])
         lost = total - cap
-    rows = rows[rows[:, RING_WORDS - 1] != _EMPTY_W2]
-    return _unpack_rows(rows), total, lost
+    # empty slots carry event bits 0b11 (no EV_* code is 3)
+    rows = rows[((rows[:, 0] >> 3) & 0x3) != 0x3]
+    return _unpack_rows(rows, proxy_ports), total, lost

@@ -86,10 +86,17 @@ def test_ring_matches_host_filter_on_pipeline_output():
     rng = np.random.default_rng(3)
     hdr = jnp.asarray(bench_traffic(world, 2048, rng))
     out, _state = datapath_step_jit(world.state, hdr, jnp.uint32(100))
-    ring = EventRing.create(1 << 12)
-    ring = ring_append(ring, out, jnp.uint32(0), trace_sample=256)
-    rows, total, lost = ring_drain(ring)
     host_out = np.asarray(out)
+    # the live listener table: redirect events carry a 4-bit index
+    # into it on the 8 B wire format; the same table restores ports
+    from cilium_tpu.datapath.verdict import OUT_PROXY
+
+    ports = np.unique(host_out[:, OUT_PROXY])
+    ports = ports[ports != 0].astype(np.uint32)
+    ring = EventRing.create(1 << 12)
+    ring = ring_append(ring, out, jnp.uint32(0), trace_sample=256,
+                       proxy_ports=jnp.asarray(ports))
+    rows, total, lost = ring_drain(ring, proxy_ports=ports)
     keep = (host_out[:, OUT_EVENT] != EV_TRACE) | \
         (np.arange(2048) % 256 == 0)
     assert lost == 0
@@ -97,6 +104,26 @@ def test_ring_matches_host_filter_on_pipeline_output():
     np.testing.assert_array_equal(rows[:, :N_OUT], host_out[keep])
     np.testing.assert_array_equal(rows[:, COL_PKT_IDX],
                                   np.nonzero(keep)[0])
+
+
+def test_proxy_port_round_trips_through_listener_index():
+    """Redirect events store the proxy PORT as a 4-bit index into the
+    live listener table (8 B wire rows); decode restores the port."""
+    from cilium_tpu.datapath.verdict import OUT_PROXY
+
+    ring = EventRing.create(64)
+    out = np.zeros((4, N_OUT), dtype=np.uint32)
+    out[:, OUT_EVENT] = EV_VERDICT
+    out[:, OUT_PROXY] = [15001, 0, 15003, 15001]
+    table = np.asarray([15001, 15003], dtype=np.uint32)
+    ring = ring_append(ring, jnp.asarray(out), jnp.uint32(2),
+                       trace_sample=0, proxy_ports=jnp.asarray(table))
+    rows, total, _ = ring_drain(ring, proxy_ports=table)
+    assert total == 4
+    assert list(rows[:, OUT_PROXY]) == [15001, 0, 15003, 15001]
+    # without the table the index cannot resolve: ports decode as 0
+    rows0, _, _ = ring_drain(ring)
+    assert list(rows0[:, OUT_PROXY]) == [0, 0, 0, 0]
 
 
 def test_serve_step_matches_separate_dispatch():
