@@ -157,7 +157,7 @@ def _ident(d, ip):
     return e.identity if e else None
 
 
-def _wait(cond, timeout=8.0, msg=""):
+def _wait(cond, timeout=30.0, msg=""):
     deadline = time.time() + timeout
     while time.time() < deadline:
         if cond():

@@ -36,7 +36,7 @@ def _cnp():
             }}
 
 
-def _wait(cond, timeout=8.0, msg=""):
+def _wait(cond, timeout=30.0, msg=""):
     deadline = time.time() + timeout
     while time.time() < deadline:
         if cond():
@@ -114,7 +114,7 @@ class TestBootstrap:
         # must re-LIST to see the new pod
         stub.compact()
         stub.add(_pod("web-0", "10.0.1.1", {"app": "web"}))
-        _wait(lambda: len(d.endpoints.list()) == 2, timeout=15,
+        _wait(lambda: len(d.endpoints.list()) == 2, timeout=30,
               msg="post-compaction re-LIST delivers")
         assert pods.lists > lists_before
 
