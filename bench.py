@@ -49,7 +49,12 @@ def _pow2_cap(n_events: int) -> int:
 BASELINE_PPS = 10_000_000.0  # north-star target
 
 
-def bench_device(world, jnp, datapath_step_jit, iters=20):
+def bench_device(world, jnp, datapath_step_jit, iters=10):
+    # iters 20 -> 10 in r05: the phase now runs in its own BOUNDED
+    # subprocess, and its one end-of-phase occupancy fetch pays the
+    # tunnel's ~12 s/dispatch first-fetch toll — 74 dispatches keep
+    # the phase inside its timeout while the measured per-step time
+    # (and so the headline rate) is unchanged.
     from cilium_tpu.datapath.conntrack import ST_FREE, V_STATE
 
     from cilium_tpu.testing.fixtures import bench_traffic
@@ -606,7 +611,7 @@ def bench_socket_lb(n_services=512, iters=9) -> dict:
                                          COL_FAMILY, COL_PROTO,
                                          COL_SPORT, COL_SRC_IP3,
                                          N_COLS)
-    from cilium_tpu.service import ServiceManager, lb_stage_jit
+    from cilium_tpu.service import ServiceManager
     from cilium_tpu.service.socklb import SockLBTable, socklb_stage_jit
 
     m = ServiceManager()
@@ -632,45 +637,114 @@ def bench_socket_lb(n_services=512, iters=9) -> dict:
     jhdr = jnp.asarray(hdr)
     now = jnp.uint32(100)
 
+    # LOOP stage iterations inside ONE dispatch (lax.fori_loop): on
+    # the tunneled harness per-dispatch overhead is ~20-30 ms, so any
+    # per-dispatch timing of a sub-ms stage measures the harness (r05
+    # measured both paths pinned at the dispatch floor and reported a
+    # nonsense speedup <1).  One dispatch of LOOP iterations is the
+    # compute-only comparison.
+    LOOP = 32
+    from functools import partial
+
+    from cilium_tpu.service import lb_stage
+    from cilium_tpu.service.socklb import CONNECT_CAP, socklb_stage
+
+    # `t` rides as an ARGUMENT: closing over it inlines the Maglev
+    # table as an HLO constant, and past ~2k services the serialized
+    # program exceeds the tunnel's remote-compile request limit
+    @jax.jit
+    def brute_loop(t, hdr0):
+        # thread hdr through so iterations cannot be hoisted (the
+        # stage is pure); post-rewrite rows still pay the same [N, S]
+        # compare, which is the cost being measured
+        def body(_i, h):
+            h2, _hits = lb_stage(t, h)
+            return h2
+        return jax.lax.fori_loop(0, LOOP, body, hdr0)
+
+    @partial(jax.jit, donate_argnums=0)
+    def cached_loop(tbl, t, hdr0):
+        # fold the rewritten header + hit mask into a carried scalar:
+        # without a live use, XLA dead-code-eliminates the DNAT
+        # rewrite selects/scatters from the cached path while the
+        # brute loop (which threads h) pays them — an unfair compare
+        def body(_i, carry):
+            tb, acc = carry
+            h2, hits, tb2 = socklb_stage(tb, t, hdr0, now)
+            return tb2, (acc + h2[:, COL_DST_IP3].sum()
+                         + h2[:, COL_DPORT].sum()
+                         + hits.sum().astype(jnp.uint32))
+        return jax.lax.fori_loop(0, LOOP, body,
+                                 (tbl, jnp.uint32(0)))
+
     def median_time(fn, reps=iters):
         ts = []
         for _ in range(reps):
             t0 = time.perf_counter()
-            out = fn()
-            jax.block_until_ready(out)
-            ts.append(time.perf_counter() - t0)
+            jax.block_until_ready(fn())
+            ts.append((time.perf_counter() - t0) / LOOP)
         return sorted(ts)[len(ts) // 2]
 
-    out0 = lb_stage_jit(t, jhdr)  # compile
-    jax.block_until_ready(out0)
-    dt_compare = median_time(lambda: lb_stage_jit(t, jhdr))
+    jax.block_until_ready(brute_loop(t, jhdr))  # compile
+    dt_compare = median_time(lambda: brute_loop(t, jhdr))
 
     tbl = SockLBTable.create(1 << 20)
     box = [tbl]
     _, _, box[0] = socklb_stage_jit(box[0], t, jhdr, now)  # compile
-    _h, hit, box[0] = socklb_stage_jit(box[0], t, jhdr, now)  # warm
+    # warm the flow cache in connect-buffer-sized slices: a single
+    # full-batch step has BATCH >> CONNECT_CAP misses and takes the
+    # resolve-only fallback (nothing caches) — production flows
+    # arrive gradually, which the sliced warmup models
+    for i in range(0, BATCH, CONNECT_CAP):
+        _h, hit, box[0] = socklb_stage_jit(
+            box[0], t, jhdr[i:i + CONNECT_CAP], now)
+    _h, hit, box[0] = socklb_stage_jit(box[0], t, jhdr, now)
     jax.block_until_ready(hit)  # cache now holds every flow
 
+    box[0], _acc = cached_loop(box[0], t, jhdr)  # compile
+    jax.block_until_ready(box[0].fp)
+
     def cached_step():
-        h2, hit2, box[0] = socklb_stage_jit(box[0], t, jhdr, now)
-        return hit2
+        box[0], acc = cached_loop(box[0], t, jhdr)
+        return acc
 
     dt_cached = median_time(cached_step)
     return {
         "n_services": n_services,
         "batch": BATCH,
+        "looped_iterations": LOOP,
         "per_packet_compare_pps": round(BATCH / dt_compare),
         "flow_cached_pps": round(BATCH / dt_cached),
-        "est_path_speedup": round(dt_compare / dt_cached, 2),
         "note": ("established-path LB: connect-time resolution cached "
                  "per flow (bpf_sock analogue) vs per-packet [N,S] "
-                 "frontend compare + Maglev"),
+                 "frontend compare + Maglev.  The cached path is O(1) "
+                 "in the service count (probe window + candidate "
+                 "gathers); the compare is O(S) per packet — run with "
+                 "several n_services to see the flat-vs-linear split. "
+                 "The semantic contract is affinity either way: "
+                 "cached flows keep their backend across backend-set "
+                 "changes."),
+    }
+
+
+def bench_socket_lb_scaling(counts=(512, 4096)) -> dict:
+    """Socket-LB at several service counts: the flow cache's flat
+    cost vs the per-packet compare's O(S) growth (the design claim a
+    single-point speedup number cannot carry)."""
+    points = [bench_socket_lb(n_services=s, iters=5) for s in counts]
+    return {
+        "points": [{k: p[k] for k in ("n_services",
+                                      "per_packet_compare_pps",
+                                      "flow_cached_pps")}
+                   for p in points],
+        "note": points[-1]["note"],
     }
 
 
 def _run_socklb_phase() -> None:
-    """--socklb: the socket-LB delta standalone (one JSON line)."""
-    print(json.dumps(bench_socket_lb()))
+    """--socklb: the socket-LB scaling phase standalone (one JSON
+    line)."""
+    print(json.dumps(bench_socket_lb_scaling()))
 
 
 def bench_anomaly() -> dict:
@@ -712,6 +786,59 @@ def _phase_subprocess(flag: str, timeout: int = 1800) -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _run_device_phase() -> None:
+    """--device: the fused-pipeline headline phase standalone (one
+    JSON line).  Ends with the process's single d2h fetch (occupancy
+    scalar), which pays the whole phase's queued-dispatch toll —
+    bounded here instead of compounding into the e2e phase."""
+    import jax.numpy as jnp
+
+    from cilium_tpu.datapath import datapath_step_jit
+    from cilium_tpu.testing.fixtures import build_world
+
+    world = build_world(n_identities=10_000, ct_capacity=1 << 21,
+                        n_v6=256)
+    dev_pps, state, _now, detail = bench_device(world, jnp,
+                                                datapath_step_jit)
+    detail["ct_occupied"] = int(np.asarray(detail.pop("ct_occupied_dev")))
+    print(json.dumps({"pps": round(dev_pps), "detail": detail}))
+
+
+def _run_e2e_phase() -> None:
+    """--e2e: the packed ingest end-to-end phase standalone (one JSON
+    line).  Fresh process = fresh CT (its pool warmup establishes the
+    steady state); r04 ran it after the device phase in-process, so
+    its CT carried ~1M background entries — the fresh-process number
+    has slightly lighter probe pressure (noted in the output)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.datapath import datapath_step_jit
+    from cilium_tpu.testing.fixtures import build_world
+
+    world = build_world(n_identities=10_000, ct_capacity=1 << 21,
+                        n_v6=256)
+    out, _state = bench_end_to_end(world, world.state, 1_001, jax,
+                                   jnp, datapath_step_jit)
+    out["fresh_process"] = True
+    print(json.dumps(out))
+
+
+def _run_artifact_phase() -> None:
+    """--artifact: the naive fetch-per-batch path standalone (one
+    JSON line)."""
+    import jax
+    import jax.numpy as jnp
+
+    from cilium_tpu.datapath import datapath_step_jit
+    from cilium_tpu.testing.fixtures import build_world
+
+    world = build_world(n_identities=10_000, ct_capacity=1 << 21)
+    out = bench_full_readback(world, world.state, 1_000, jax, jnp,
+                              datapath_step_jit)
+    print(json.dumps(out))
+
+
 def _run_wide_phase() -> None:
     """--wide: the wide-path phase standalone (one JSON line)."""
     import jax
@@ -740,36 +867,28 @@ def _run_ring_phase() -> None:
 
 
 def main() -> None:
-    import jax
-    import jax.numpy as jnp
-
-    from cilium_tpu.datapath import datapath_step_jit
-    from cilium_tpu.testing.fixtures import build_world
-
-    world = build_world(n_identities=10_000, ct_capacity=1 << 21,
-                        n_v6=256)
-    dev_pps, state, now, detail = bench_device(world, jnp,
-                                               datapath_step_jit)
-    e2e, state = bench_end_to_end(world, state, now + 1, jax, jnp,
-                                  datapath_step_jit)
-    # first d2h fetch of the whole bench: resolve the occupancy scalar
-    detail["ct_occupied"] = int(np.asarray(detail.pop("ct_occupied_dev")))
-    # transfer phases after this point run in FRESH processes: this
-    # process is now post-fetch and every further dispatch here pays
-    # the ~4.5 s axon artifact (see _phase_subprocess)
+    # r05: EVERY tpu phase runs in its own bounded subprocess.  Two
+    # reasons: (a) each process's first d2h fetch pays the tunnel's
+    # ~12 s per prior big dispatch, so phases must not inherit each
+    # other's dispatch debt (r04 paid the device phase's 144-dispatch
+    # debt inside the e2e phase — tens of minutes in one unbounded
+    # fetch); (b) a wedged tunnel RPC now costs ONE phase its
+    # timeout, not the whole bench — the JSON line always prints.
+    device = _phase_subprocess("--device", timeout=2100)
+    e2e = _phase_subprocess("--e2e", timeout=2100)
     e2e_wide = _phase_subprocess("--wide")
     ring_ss = _phase_subprocess("--ring")
     socklb = _phase_subprocess("--socklb")
-    artifact = bench_full_readback(world, state, now + 300, jax, jnp,
-                                   datapath_step_jit)
+    artifact = _phase_subprocess("--artifact")
     l7 = bench_l7()
     anomaly = bench_anomaly()
+    dev_pps = device.get("pps", 0) or 0
     print(json.dumps({
         "metric": "policy_verdicts_per_sec_per_chip",
         "value": round(dev_pps),
         "unit": "verdicts/s",
         "vs_baseline": round(dev_pps / BASELINE_PPS, 3),
-        "device_detail": detail,
+        "device_detail": device.get("detail", device),
         "end_to_end": e2e,
         "end_to_end_wide": e2e_wide,
         "ring_steady_state": ring_ss,
@@ -784,7 +903,13 @@ def main() -> None:
 if __name__ == "__main__":
     import sys
 
-    if "--wide" in sys.argv:
+    if "--device" in sys.argv:
+        _run_device_phase()
+    elif "--e2e" in sys.argv:
+        _run_e2e_phase()
+    elif "--artifact" in sys.argv:
+        _run_artifact_phase()
+    elif "--wide" in sys.argv:
         _run_wide_phase()
     elif "--ring" in sys.argv:
         _run_ring_phase()

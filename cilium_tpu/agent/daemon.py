@@ -172,6 +172,7 @@ class Daemon:
         from ..service import ServiceManager
 
         self.services = ServiceManager()
+        self._serving = None  # start_serving() installs the ring path
         # connect-time LB flow cache (service/socklb.py, the bpf_sock
         # analogue): created on first service traffic
         self._socklb = None
@@ -530,6 +531,115 @@ class Daemon:
         """Join a remote cluster's store (reference: clustermesh
         config per remote cluster)."""
         return self.clustermesh.connect(name, cluster_id, kv)
+
+    # -- serving path: device event ring -> monitor plane --------------
+    def start_serving(self, ring_capacity: int = 1 << 15,
+                      drain_every: int = 4,
+                      trace_sample: int = 1024) -> None:
+        """Switch to the SERVING monitor path: batches run through the
+        fused datapath + device event-ring append (one dispatch, no
+        per-packet host fetch), and only the compacted events cross to
+        the host at the drain cadence — upstream's perf-ring economics
+        (the kernel streams events, not packets).  :meth:`serve_batch`
+        feeds it; :meth:`stop_serving` drains what is in flight.
+
+        Requires the tpu backend (the interpreter loader has no device
+        ring).  Redirect events carry their proxy port as an index
+        into the CURRENT listener table (monitor/ring.py); listeners
+        added later stream as port 0 until serving is restarted."""
+        import jax.numpy as jnp
+
+        from ..datapath.loader import TPULoader
+        from ..monitor.ring import AsyncRingDrainer, MAX_PROXY_PORTS
+
+        if not isinstance(self.loader, TPULoader):
+            raise RuntimeError("serving path requires backend='tpu'")
+        if self._serving is not None:
+            # silently replacing the drainer would drop its in-flight
+            # window without any loss accounting
+            raise RuntimeError("already serving; stop_serving() first")
+        table = np.asarray(sorted(self.proxy.ports)[:MAX_PROXY_PORTS],
+                           dtype=np.uint32)
+        drainer = AsyncRingDrainer(ring_capacity, proxy_ports=table)
+        self._serving = {
+            "drainer": drainer,
+            "ring": drainer.fresh(),
+            "table_dev": jnp.asarray(table) if len(table) else None,
+            "trace_sample": trace_sample,
+            "drain_every": drain_every,
+            "seq": 0,
+            # batch_id (wrapped) -> (host hdr, numeric ids, timestamp)
+            "window": {},
+        }
+
+    def serve_batch(self, hdr: np.ndarray,
+                    now: Optional[int] = None) -> None:
+        """One serving-path batch: dispatch, retain the host header
+        rows for the event join, drain/emit every ``drain_every``
+        batches.  ``hdr`` must be HOST memory (the serving path never
+        fetches it back)."""
+        s = self._serving
+        if s is None:
+            raise RuntimeError("call start_serving() first")
+        if now is None:
+            now = self._now()
+        bid = s["seq"] & 0x1FFF  # ring batch field width
+        s["ring"], row_map = self.loader.serve(
+            s["ring"], hdr, now, bid,
+            trace_sample=s["trace_sample"],
+            proxy_ports=s["table_dev"])
+        # numeric_array() copies the whole row->numeric table; the map
+        # only changes on attach/identity churn, so snapshot it per
+        # row_map OBJECT, not per batch
+        if s.get("row_map") is not row_map:
+            s["row_map"] = row_map
+            s["numerics"] = row_map.numeric_array()
+        s["window"][bid] = (np.asarray(hdr), s["numerics"],
+                            time.time())
+        s["seq"] += 1
+        if s["seq"] % s["drain_every"] == 0:
+            rows, _, _ = s["drainer"].collect()
+            self._emit_ring_rows(rows)
+            s["ring"] = s["drainer"].swap(s["ring"])
+            # retain headers for the current window + the one whose
+            # fetch is in flight; older windows have already emitted
+            live = {(s["seq"] - 1 - i) & 0x1FFF
+                    for i in range(2 * s["drain_every"])}
+            for b in list(s["window"]):
+                if b not in live:
+                    del s["window"][b]
+
+    def stop_serving(self) -> dict:
+        """Drain everything in flight and emit it; returns serving
+        stats (windows/events/lost per the drainer's accounting)."""
+        s = self._serving
+        if s is None:
+            return {"windows": 0, "events": 0, "lost": 0}
+        d = s["drainer"]
+        rows, _, _ = d.collect()
+        self._emit_ring_rows(rows)
+        d.swap(s["ring"])
+        rows, _, _ = d.collect()
+        self._emit_ring_rows(rows)
+        self._serving = None
+        return {"windows": d.windows, "events": d.events,
+                "lost": d.lost}
+
+    def _emit_ring_rows(self, rows: np.ndarray) -> None:
+        from ..monitor.api import decode_ring_rows
+        from ..monitor.ring import COL_BATCH
+
+        if rows is None or not len(rows):
+            return
+        s = self._serving
+        for b in np.unique(rows[:, COL_BATCH]):
+            rec = s["window"].get(int(b))
+            if rec is None:
+                continue  # header window expired (overrun drain lag)
+            hdr, numerics, ts = rec
+            batch = decode_ring_rows(rows[rows[:, COL_BATCH] == b],
+                                     hdr, numerics, ts)
+            self.monitor.publish(self._filter_events(batch))
 
     def socklb_entries(self, limit: int = 1000) -> list:
         """Decode the socket-LB flow cache for GET /map/lb

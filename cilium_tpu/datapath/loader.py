@@ -166,6 +166,26 @@ class TPULoader(Loader):
             row_map = self.row_map
         return np.asarray(out), row_map
 
+    def serve(self, ring, hdr, now: int, batch_id: int,
+              trace_sample: int = 1024, proxy_ports=None):
+        """The SERVING-path step: fused datapath + event-ring append
+        in one dispatch, NO host fetch (monitor/ring.py serve_step).
+        Returns (ring', row_map); events reach the host when the
+        caller drains the ring at its own cadence — the perf-ring
+        economics, vs :meth:`step`'s fetch-per-batch debug path."""
+        from ..monitor.ring import serve_step_jit
+
+        jnp = self._jnp
+        if isinstance(hdr, np.ndarray):
+            hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        with self._lock:
+            self.state, ring = serve_step_jit(
+                self.state, ring, hdr, jnp.uint32(now),
+                jnp.uint32(batch_id), trace_sample=trace_sample,
+                proxy_ports=proxy_ports)
+            row_map = self.row_map
+        return ring, row_map
+
     def masquerade(self, nat, hdr, now: int):
         """CT-aware egress SNAT with port allocation (service/nat.py
         snat_egress); returns (rewritten device hdr, exhaustion drop

@@ -19,6 +19,7 @@ from .api import (  # noqa: F401
     PolicyVerdictNotify,
     TraceNotify,
     decode_out,
+    decode_ring_rows,
 )
 from .agent import MonitorAgent  # noqa: F401
 from .ring import (  # noqa: F401

@@ -233,3 +233,29 @@ def decode_out(out: np.ndarray, hdr: np.ndarray,
         hdr=hdr,
         timestamp=timestamp,
     )
+
+
+def decode_ring_rows(rows: np.ndarray, hdr: np.ndarray,
+                     row_to_numeric: np.ndarray,
+                     timestamp: float) -> EventBatch:
+    """Drained ring rows of ONE batch + that batch's retained host
+    header tensor -> EventBatch (the serving-path perf-reader: only
+    the compacted events crossed the device->host link; the header
+    columns rejoin here via the rows' packet index).
+
+    ``rows`` is a ``ring_drain`` slice whose COL_BATCH all match the
+    batch ``hdr`` came from."""
+    from .ring import COL_PKT_IDX
+
+    rows = np.asarray(rows)
+    pkt = rows[:, COL_PKT_IDX].astype(np.int64)
+    return EventBatch(
+        msg_type=_EVENT_TO_MSG[rows[:, OUT_EVENT]],
+        verdict=rows[:, OUT_VERDICT].astype(np.uint8),
+        reason=rows[:, OUT_REASON].astype(np.uint8),
+        ct_state=rows[:, OUT_CT].astype(np.uint8),
+        identity=row_to_numeric[rows[:, OUT_ID_ROW]].astype(np.uint32),
+        proxy_port=rows[:, OUT_PROXY].astype(np.uint16),
+        hdr=np.asarray(hdr)[pkt],
+        timestamp=timestamp,
+    )

@@ -78,21 +78,31 @@ NO_BACKEND = 0xFFFFFFFF
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class SockLBTable:
+    """``fp`` is the per-slot 1-byte key fingerprint (0 = free), the
+    same probe diet conntrack runs (r04): the established path gathers
+    the [N, K] fingerprint window (8 words/pkt) and full rows for only
+    the fingerprint CANDIDATES — on TPU the full [N, K, 8-word] row
+    gather measured SLOWER than a brute [N, n_services] broadcast
+    compare at 512 services (random-gather bytes vs streaming
+    compares); the fingerprint probe wins at any service count."""
+
     table: jnp.ndarray  # [P, ROW_WORDS] uint32
+    fp: jnp.ndarray  # [P] uint32 — key fingerprint, 0 = free
 
     @staticmethod
     def create(capacity: int = SOCK_DEFAULT_CAPACITY) -> "SockLBTable":
         if capacity & (capacity - 1):
             raise ValueError("socklb capacity must be a power of two")
         return SockLBTable(table=jnp.zeros((capacity, ROW_WORDS),
-                                          dtype=jnp.uint32))
+                                           dtype=jnp.uint32),
+                           fp=jnp.zeros((capacity,), dtype=jnp.uint32))
 
     @property
     def capacity(self) -> int:
         return self.table.shape[0]
 
     def tree_flatten(self):
-        return ((self.table,), None)
+        return ((self.table, self.fp), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -105,6 +115,16 @@ def _hash(words: jnp.ndarray) -> jnp.ndarray:
     for w in range(4):
         h = (h ^ words[:, w]) * jnp.uint32(0x01000193)
     return h
+
+
+# the fingerprint construction is conntrack's, shared so the two
+# tables can never silently diverge (key hash -> byte in 1..255,
+# 0 = free marker)
+from ..datapath.conntrack import _fp_mix  # noqa: E402
+
+# full-row gathers per packet on the established path; overflow past
+# this budget falls back to the full-window probe under lax.cond
+SOCK_CAND = 2
 
 
 def _resolve(t: LBTensors, hdr: jnp.ndarray
@@ -153,29 +173,69 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
                          jnp.uint32(LIFETIME_TCP),
                          jnp.uint32(LIFETIME_NONTCP))
 
-    # -- established path: window probe --------------------------------
+    # -- established path: fingerprint-filtered window probe -----------
     win = ((h[:, None] + jnp.arange(SOCK_PROBE, dtype=jnp.uint32))
            & mask).astype(jnp.int32)  # [N, K]
-    wrows = tbl.table[win]  # [N, K, W]
-    match = ((wrows[..., SK_SRC] == src[:, None])
-             & (wrows[..., SK_SPORT] == sport[:, None])
-             & (wrows[..., SK_VIP] == dst[:, None])
-             & (wrows[..., SK_DP] == dp[:, None])
-             & (wrows[..., SK_EXPIRES] >= now))
-    cached = jnp.any(match, axis=1) & v4
-    mcol = jnp.argmax(match, axis=1)
-    mslot = jnp.take_along_axis(win, mcol[:, None], axis=1)[:, 0]
+    key_fp = _fp_mix(h)
+    win_fp = tbl.fp[win]  # [N, K] — 8 words/pkt, not 64
+    fmatch = win_fp == key_fp[:, None]
+
+    def _row_match(rows):
+        return ((rows[..., SK_SRC] == src[:, None])
+                & (rows[..., SK_SPORT] == sport[:, None])
+                & (rows[..., SK_VIP] == dst[:, None])
+                & (rows[..., SK_DP] == dp[:, None])
+                & (rows[..., SK_EXPIRES] >= now))
+
+    # full rows for only the first SOCK_CAND fingerprint candidates.
+    # Two argmax sweeps, NOT a [N, K] sort: XLA sorts cost ~20 ms at
+    # this batch on TPU, two masked argmax reductions are ~free
+    # (SOCK_CAND == 2 is baked into this construction)
+    steps_i = jnp.arange(SOCK_PROBE, dtype=jnp.int32)
+    i1 = jnp.argmax(fmatch, axis=1).astype(jnp.int32)
+    has1 = jnp.any(fmatch, axis=1)
+    f2 = fmatch & (steps_i[None, :] != i1[:, None])
+    i2 = jnp.argmax(f2, axis=1).astype(jnp.int32)
+    has2 = jnp.any(f2, axis=1)
+    pos = jnp.stack([i1, i2], axis=1)  # [N, 2]
+    cand_valid = jnp.stack([has1, has2], axis=1)
+    cand_slots = jnp.take_along_axis(win, pos, axis=1)  # [N, C]
+    crows = tbl.table[cand_slots]  # [N, C, W]
+    cmatch = cand_valid & _row_match(crows)
+    found = jnp.any(cmatch, axis=1)
+    first = jnp.argmax(cmatch, axis=1)
+    slot_fp = jnp.take_along_axis(cand_slots, first[:, None],
+                                  axis=1)[:, 0]
+    # a miss with MORE fingerprint matches than the candidate budget
+    # could hide the true entry past it — rerun the full-window probe
+    # (rare: ~(1/255)^2-rate events decide this branch's execution)
+    overflow = ~found & (jnp.sum(fmatch, axis=1) > SOCK_CAND)
+
+    def full_probe(_):
+        wrows = tbl.table[win]  # [N, K, W]
+        match = _row_match(wrows)
+        f = jnp.any(match, axis=1)
+        mcol = jnp.argmax(match, axis=1)
+        return f, jnp.take_along_axis(win, mcol[:, None],
+                                      axis=1)[:, 0]
+
+    found, mslot = jax.lax.cond(
+        jnp.any(overflow), full_probe,
+        lambda _: (found, slot_fp), None)
+    cached = found & v4
     mrow = tbl.table[mslot]
     c_be_ip = mrow[:, SK_BE_IP]
     c_be_port = mrow[:, SK_BE_PORT]
     # refresh on use (same row content; scatter order immaterial)
     table = tbl.table.at[jnp.where(cached, mslot, P), SK_EXPIRES].set(
         now + lifetime, mode="drop")
+    fp_arr = tbl.fp
 
     miss = v4 & ~cached
     n_miss = jnp.sum(miss)
 
-    def connect_compact(table):
+    def connect_compact(carry):
+        table, fp_arr = carry
         # compact miss rows into the fixed connect buffer
         pos = jnp.where(miss, jnp.cumsum(miss) - 1, CONNECT_CAP)
         comp = jnp.zeros(CONNECT_CAP, dtype=jnp.int32).at[pos].set(
@@ -202,6 +262,7 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
         ], axis=1).astype(jnp.uint32)
         ridx = jnp.arange(CONNECT_CAP, dtype=jnp.int32)
         pending = live
+        claim_fp = _fp_mix(ch)
         for step in range(SOCK_PROBE):
             s = ((ch + step) & mask).astype(jnp.int32)
             stored = table[s]
@@ -215,8 +276,9 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
             owner = jnp.full((P + 1,), CONNECT_CAP, dtype=jnp.int32
                              ).at[rows].min(ridx, mode="drop")
             writer = trying & (owner[s] == ridx)
-            table = table.at[jnp.where(writer, s, P)].set(
-                new_row, mode="drop")
+            wtarget = jnp.where(writer, s, P)
+            table = table.at[wtarget].set(new_row, mode="drop")
+            fp_arr = fp_arr.at[wtarget].set(claim_fp, mode="drop")
             back = table[s]
             won = trying & ((back[:, SK_SRC] == ck[:, 0])
                             & (back[:, SK_SPORT] == ck[:, 1])
@@ -233,16 +295,17 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
             be_port, mode="drop")
         r_svc = jnp.zeros(n, dtype=bool).at[comp_t].set(
             is_svc, mode="drop")
-        return table, r_ip, r_port, r_svc & miss
+        return (table, fp_arr), r_ip, r_port, r_svc & miss
 
-    def connect_full(table):
+    def connect_full(carry):
         # burst of new flows beyond the connect buffer: resolve every
         # row (no caching for this batch — correctness over cache)
         is_svc, be_ip, be_port = _resolve(t, hdr)
-        return (table, be_ip, be_port, is_svc & miss)
+        return (carry, be_ip, be_port, is_svc & miss)
 
-    table, r_ip, r_port, r_svc = jax.lax.cond(
-        n_miss <= CONNECT_CAP, connect_compact, connect_full, table)
+    (table, fp_arr), r_ip, r_port, r_svc = jax.lax.cond(
+        n_miss <= CONNECT_CAP, connect_compact, connect_full,
+        (table, fp_arr))
 
     svc_hit = (cached & (c_be_port != jnp.uint32(NO_BACKEND))) | r_svc
     new_dst = jnp.where(cached & (c_be_port != jnp.uint32(NO_BACKEND)), c_be_ip,
@@ -251,7 +314,7 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
                           jnp.where(r_svc, r_port, hdr[:, COL_DPORT]))
     hdr = hdr.at[:, COL_DST_IP3].set(new_dst)
     hdr = hdr.at[:, COL_DPORT].set(new_dport)
-    return hdr, svc_hit, SockLBTable(table=table)
+    return hdr, svc_hit, SockLBTable(table=table, fp=fp_arr)
 
 
 socklb_stage_jit = jax.jit(socklb_stage, donate_argnums=0)
