@@ -484,7 +484,7 @@ def bench_ring_steady_state(world, state, now0, jax, jnp, batches=24,
         # latency)
         "collect_vs_raw_fetch_ratio": round(
             (ring_bytes / max(med_collect, 1e-6) / 1e6) / raw_mbps, 3),
-        "projected_pps_at_1gbps_link": round(
+        "projected_pps_at_1GBps_link": round(
             window_pkts / (ring_bytes / 1e9)),
         "batches": batches,
         "drain_every": drain_every,

@@ -589,10 +589,13 @@ class Daemon:
             trace_sample=s["trace_sample"],
             proxy_ports=s["table_dev"])
         # numeric_array() copies the whole row->numeric table; the map
-        # only changes on attach/identity churn, so snapshot it per
-        # row_map OBJECT, not per batch
-        if s.get("row_map") is not row_map:
-            s["row_map"] = row_map
+        # only changes on identity churn, so snapshot per
+        # (object, version) — the map object itself is REUSED and
+        # mutated across regenerations, so object identity alone
+        # would serve stale numerics forever after churn
+        rm_key = (id(row_map), row_map.version)
+        if s.get("row_map_key") != rm_key:
+            s["row_map_key"] = rm_key
             s["numerics"] = row_map.numeric_array()
         s["window"][bid] = (np.asarray(hdr), s["numerics"],
                             time.time())

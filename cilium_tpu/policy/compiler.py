@@ -82,6 +82,11 @@ class IdentityRowMap:
         self._row_to_num = np.zeros(capacity, dtype=np.int64)
         self._next = 1
         self._free: List[int] = []  # recycled rows (identity released)
+        # bumped on every mapping mutation: the map object is REUSED
+        # across regenerations, so consumers holding decode snapshots
+        # (the serving path's per-batch numerics) must key refreshes
+        # on (id(map), version), never on object identity alone
+        self.version = 0
 
     def add(self, numeric_id: int) -> int:
         row = self._num_to_row.get(numeric_id)
@@ -96,6 +101,7 @@ class IdentityRowMap:
             self._next += 1
         self._num_to_row[numeric_id] = row
         self._row_to_num[row] = numeric_id
+        self.version += 1
         return row
 
     def remove(self, numeric_id: int) -> Optional[int]:
@@ -108,6 +114,7 @@ class IdentityRowMap:
             return None
         self._row_to_num[row] = 0
         self._free.append(row)
+        self.version += 1
         return row
 
     def _grow(self) -> None:

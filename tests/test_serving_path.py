@@ -134,3 +134,50 @@ class TestServingPath:
         d = Daemon(DaemonConfig(backend="interpreter"))
         with pytest.raises(RuntimeError, match="tpu"):
             d.start_serving()
+
+
+class TestServingAcrossRegeneration:
+    def test_identity_churn_mid_serving_window(self):
+        """Identity churn between serving batches: events of a
+        post-churn batch must decode identities minted BY that churn.
+        The row-map object is reused and mutated across
+        regenerations, so the serving path's numerics snapshot must
+        key on the map's version — object identity alone would serve
+        the stale pre-churn table forever (r05 regression)."""
+        d, db = _world()
+        got = []
+        d.monitor.register("t", got.append)
+        d.start_serving(ring_capacity=1 << 10, drain_every=2,
+                        trace_sample=0)
+        d.serve_batch(_traffic(db.id, 40000), now=10)
+        # churn: a brand-new identity appears and its policy allows
+        # it to reach db (regeneration mutates the SAME row map)
+        d.add_endpoint("cache", ("10.0.3.1",), ["k8s:app=cache"])
+        d.policy_import([{
+            "labels": [{"key": "cache-policy"}],
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": "cache"}}],
+                "toPorts": [{"ports": [
+                    {"port": "5432", "protocol": "TCP"}]}],
+            }],
+        }])
+        # post-churn traffic FROM the new identity
+        d.serve_batch(make_batch([
+            dict(src="10.0.3.1", dst="10.0.2.1", sport=41000 + k,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0)
+            for k in range(8)
+        ]).data, now=11)
+        d.serve_batch(_traffic(db.id, 40200), now=12)
+        stats = d.stop_serving()
+        assert stats["lost"] == 0
+        cache_id = d.endpoints.lookup_by_ip(
+            "10.0.3.1").identity.numeric_id
+        web_id = d.endpoints.lookup_by_ip(
+            "10.0.1.1").identity.numeric_id
+        ids = np.concatenate([b.identity for b in got])
+        assert len(ids) == 2 * 64 + 8
+        # the new identity decodes as ITSELF, not as 0/unknown (a
+        # stale numerics snapshot maps its fresh row to 0)
+        assert (ids == cache_id).sum() == 8
+        assert (ids == web_id).sum() == 2 * 64
