@@ -590,12 +590,15 @@ class Daemon:
             proxy_ports=s["table_dev"])
         # numeric_array() copies the whole row->numeric table; the map
         # only changes on identity churn, so snapshot per
-        # (object, version) — the map object itself is REUSED and
-        # mutated across regenerations, so object identity alone
-        # would serve stale numerics forever after churn
-        rm_key = (id(row_map), row_map.version)
-        if s.get("row_map_key") != rm_key:
-            s["row_map_key"] = rm_key
+        # (object, version) — the map object is REUSED and mutated
+        # across regenerations (object identity alone would serve
+        # stale numerics forever), and the retained REFERENCE keeps
+        # the comparison sound if the loader ever swaps in a fresh
+        # map (an id() of a collected object can false-match)
+        if (s.get("row_map") is not row_map
+                or s.get("row_map_version") != row_map.version):
+            s["row_map"] = row_map
+            s["row_map_version"] = row_map.version
             s["numerics"] = row_map.numeric_array()
         s["window"][bid] = (np.asarray(hdr), s["numerics"],
                             time.time())

@@ -49,6 +49,13 @@ CES_RESOURCES: Tuple[Tuple[str, str], ...] = tuple(
 ) + (("CiliumEndpointSlice",
       "/apis/cilium.io/v2alpha1/ciliumendpointslices"),)
 
+# what the OPERATOR's informer watches to drive CES batching (its
+# "hub" is a CESBatcher — the operator is the only CEP consumer in
+# CES mode; reference: operator/pkg/ciliumendpointslice informer)
+OPERATOR_CES_RESOURCES: Tuple[Tuple[str, str], ...] = (
+    ("CiliumEndpoint", "/apis/cilium.io/v2/ciliumendpoints"),
+)
+
 _EVENT_MAP = {"ADDED": "add", "MODIFIED": "update", "DELETED": "delete"}
 
 

@@ -253,3 +253,51 @@ class TestAgentConsumesSlices:
               msg="slice shrink -> ipcache delete")
         # the others stay
         assert _ident(d, "10.9.0.5") == 5005
+
+
+class TestOperatorInformerCircle:
+    def test_cep_to_slice_to_agent_full_circle(self):
+        """The production CES topology end to end over real HTTP:
+        agents (or tests) publish CiliumEndpoints to the apiserver;
+        the OPERATOR's informer watches them and coalesces slices
+        back into the apiserver; a remote agent in CES mode consumes
+        the slices into its ipcache."""
+        from cilium_tpu.k8s.informer import OPERATOR_CES_RESOURCES
+
+        stub = StubAPIServer()
+        # operator side: its informer drives the batcher directly
+        # (CESBatcher speaks the hub dispatch protocol)
+        batcher = CESBatcher.publish_to(stub, max_per_slice=4)
+        op_client = K8sClient(stub.url, batcher,
+                              resources=OPERATOR_CES_RESOURCES)
+        # agent side: CES mode, slices only
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12,
+                                node_name="node-1"),
+                   kvstore=InMemoryKVStore())
+        ag_client = K8sClient(stub.url, d.k8s_watchers(),
+                              resources=CES_RESOURCES)
+        try:
+            op_client.start()
+            ag_client.start()
+            for i in range(10):
+                stub.add(_cep(f"pod-{i}", f"10.9.1.{i}", 6000 + i))
+            batcher.flush()
+            _wait(lambda: _ident(d, "10.9.1.9") == 6009,
+                  msg="CEP -> operator slices -> agent ipcache")
+            assert _ident(d, "10.9.1.0") == 6000
+            # 10 CEPs at 4/slice -> 3 slices in the apiserver
+            assert batcher.slice_count() == 3
+            # churn round-trips the circle too
+            stub.update(_cep("pod-0", "10.9.1.0", 7777))
+            batcher.flush()
+            _wait(lambda: _ident(d, "10.9.1.0") == 7777,
+                  msg="CEP update -> slice update -> agent")
+            stub.delete(_cep("pod-1", "10.9.1.1", 6001))
+            batcher.flush()
+            _wait(lambda: _ident(d, "10.9.1.1") is None,
+                  msg="CEP delete -> slice shrink -> agent")
+        finally:
+            op_client.stop()
+            ag_client.stop()
+            batcher.close()
+            stub.close()
