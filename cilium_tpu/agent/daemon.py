@@ -173,6 +173,11 @@ class Daemon:
 
         self.services = ServiceManager()
         self._serving = None  # start_serving() installs the ring path
+        # bandwidth manager (pkg/bandwidth analogue): per-endpoint
+        # egress rates; None until some endpoint is limited
+        self._bw = None
+        self._bw_rates = None
+        self._bw_limits: Dict[int, int] = {}
         # connect-time LB flow cache (service/socklb.py, the bpf_sock
         # analogue): created on first service traffic
         self._socklb = None
@@ -370,13 +375,52 @@ class Daemon:
     def _now(self) -> int:
         return int(time.time() - self._boot_time) + 1
 
+    # -- bandwidth manager (pkg/bandwidth / EDT analogue) --------------
+    def set_bandwidth(self, ep_id: int,
+                      bytes_per_sec: Optional[int]) -> None:
+        """Set (or clear with None/0) an endpoint's egress rate limit
+        in bytes/s (reference: kubernetes.io/egress-bandwidth pod
+        annotation -> pkg/bandwidth -> EDT in bpf_lxc)."""
+        import jax.numpy as jnp
+
+        from ..datapath.bandwidth import (BandwidthState, rates_array)
+
+        if bytes_per_sec:
+            self._bw_limits[int(ep_id)] = int(bytes_per_sec)
+        else:
+            self._bw_limits.pop(int(ep_id), None)
+        if self._bw_limits:
+            self._bw_rates = jnp.asarray(rates_array(self._bw_limits))
+            if self._bw is None:
+                self._bw = BandwidthState.create()
+        else:
+            self._bw_rates = None
+            self._bw = None
+
+    def _bw_police(self, hdr, now: int):
+        """-> per-row REASON codes for the datapath's
+        ``pre_drop_reason`` (None when no endpoint is limited)."""
+        if self._bw_rates is None:
+            return None
+        import jax.numpy as jnp
+
+        from ..datapath.bandwidth import bw_stage_jit
+
+        if isinstance(hdr, np.ndarray):
+            hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        reasons, self._bw = bw_stage_jit(self._bw, hdr,
+                                         jnp.uint32(now),
+                                         self._bw_rates)
+        return reasons
+
     # -- the serve loop ----------------------------------------------
     def process_batch(self, hdr: np.ndarray,
                       now: Optional[int] = None) -> EventBatch:
         """One packet tensor through LB -> datapath -> monitor."""
         if now is None:
             now = self._now()
-        if len(self.services) or self.nat is not None:
+        if (len(self.services) or self.nat is not None
+                or self._bw_rates is not None):
             import jax.numpy as jnp
 
             # hdr stays ON DEVICE across the LB -> SNAT -> datapath
@@ -406,8 +450,10 @@ class Daemon:
                 # row for a REASON_NAT_EXHAUSTED drop in the step
                 hdr_dev, nat_drop = self.loader.masquerade(
                     self.nat, hdr_dev, now)
+            bw_reasons = self._bw_police(hdr_dev, now)
             out, row_map = self.loader.step(hdr_dev, now,
-                                            pre_drop=nat_drop)
+                                            pre_drop=nat_drop,
+                                            pre_drop_reason=bw_reasons)
             if self.nat is not None:
                 # reverse translation AFTER the verdict (CT/policy see
                 # the wire tuple; delivery + events see the restored
@@ -812,6 +858,10 @@ class Daemon:
                 for e in self.ipcache.entries()
                 if e.source not in ("endpoint", "generated")],
             "rules": [rule_to_dict(r) for r in self.repo.rules()],
+            # bandwidth limits survive restart (upstream re-derives
+            # them from pod annotations; restore-without-k8s must not
+            # silently unthrottle endpoints)
+            "bandwidth": {str(k): v for k, v in self._bw_limits.items()},
         }
         # ct.npz first, state.json LAST: state.json is the commit point
         # of the checkpoint pair, so a crash between the two renames
@@ -871,6 +921,8 @@ class Daemon:
                                                    "default"),
                                options=rec.get("options"))
         self.endpoints.regenerate()
+        for ep_id, bps in (meta.get("bandwidth") or {}).items():
+            self.set_bandwidth(int(ep_id), int(bps))
         ct_path = os.path.join(state_dir, "ct.npz")
         if os.path.exists(ct_path):
             try:

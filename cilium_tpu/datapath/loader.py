@@ -44,7 +44,8 @@ class Loader(abc.ABC):
         endpoint id -> row index into ``policies``."""
 
     @abc.abstractmethod
-    def step(self, hdr: np.ndarray, now: int, pre_drop=None):
+    def step(self, hdr: np.ndarray, now: int, pre_drop=None,
+             pre_drop_reason=None):
         """Verdict one batch.
 
         Returns ``(out, row_map)``: the out tensor [N, N_OUT] plus the
@@ -149,20 +150,23 @@ class TPULoader(Loader):
                     ct=self.state.ct, metrics=self.state.metrics)
             self.attach_count += 1
 
-    def step(self, hdr, now: int, pre_drop=None):
+    def step(self, hdr, now: int, pre_drop=None,
+             pre_drop_reason=None):
         """``hdr`` may be a numpy array OR an already-on-device jax
         array (the LB stage hands its output over without a host
         round trip).  ``pre_drop`` is the SNAT stage's exhaustion
-        mask (rows drop with REASON_NAT_EXHAUSTED)."""
+        mask (rows drop with REASON_NAT_EXHAUSTED);
+        ``pre_drop_reason`` carries per-row REASON codes (bandwidth
+        manager)."""
         from .verdict import datapath_step_jit
 
         jnp = self._jnp
         if isinstance(hdr, np.ndarray):
             hdr = jnp.asarray(np.ascontiguousarray(hdr))
         with self._lock:
-            out, self.state = datapath_step_jit(self.state, hdr,
-                                                jnp.uint32(now),
-                                                pre_drop=pre_drop)
+            out, self.state = datapath_step_jit(
+                self.state, hdr, jnp.uint32(now), pre_drop=pre_drop,
+                pre_drop_reason=pre_drop_reason)
             row_map = self.row_map
         return np.asarray(out), row_map
 
@@ -476,12 +480,15 @@ class InterpreterLoader(Loader):
             self.oracle.ct = old_ct
         self.attach_count += 1
 
-    def step(self, hdr: np.ndarray, now: int, pre_drop=None):
+    def step(self, hdr: np.ndarray, now: int, pre_drop=None,
+             pre_drop_reason=None):
         from ..core.packets import HeaderBatch, COL_DIR
         from .verdict import N_OUT
 
-        results = self.oracle.step(HeaderBatch(np.asarray(hdr)), now,
-                                   pre_drop=pre_drop)
+        results = self.oracle.step(
+            HeaderBatch(np.asarray(hdr)), now, pre_drop=pre_drop,
+            pre_drop_reason=(None if pre_drop_reason is None
+                             else np.asarray(pre_drop_reason)))
         out = np.zeros((len(results), N_OUT), dtype=np.uint32)
         for i, r in enumerate(results):
             out[i] = (r.verdict, r.proxy, r.ct,

@@ -61,6 +61,7 @@ REASON_POLICY_DEFAULT_DENY = 2  # no rule allowed it (default deny)
 REASON_ROUTE_OVERFLOW = 3  # flow-router shard block overflow (RSS queue)
 REASON_NO_ENDPOINT = 4  # unregistered endpoint id (lxcmap miss)
 REASON_NAT_EXHAUSTED = 5  # SNAT port pool exhausted (DROP_NAT_NO_MAPPING)
+REASON_BANDWIDTH = 6  # egress rate limit (bandwidth manager / EDT)
 N_REASONS = 8
 
 # Event types in the out tensor (monitor vocabulary).
@@ -144,7 +145,8 @@ class DatapathState:
 
 def datapath_step(state: DatapathState, hdr: jnp.ndarray,
                   now: jnp.ndarray, valid: jnp.ndarray = None,
-                  pre_drop: jnp.ndarray = None
+                  pre_drop: jnp.ndarray = None,
+                  pre_drop_reason: jnp.ndarray = None
                   ) -> Tuple[jnp.ndarray, DatapathState]:
     """One batched pass of the full verdict pipeline (see module doc).
 
@@ -158,7 +160,12 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     emit a colliding node-side tuple).  Policy/lxcmap verdicts keep
     precedence (upstream order: bpf_lxc judges before host SNAT);
     rows that would otherwise forward drop with
-    ``REASON_NAT_EXHAUSTED`` and create no CT entry."""
+    ``REASON_NAT_EXHAUSTED`` and create no CT entry.
+
+    ``pre_drop_reason`` (optional [N] uint32, 0 = none) is the
+    generalized form: rows carry their own REASON_* code (today the
+    bandwidth manager's ``REASON_BANDWIDTH``), with the same
+    precedence and CT semantics as ``pre_drop``."""
     hdr = hdr.astype(jnp.uint32)
     dirn = hdr[:, COL_DIR].astype(jnp.int32)
     fam = hdr[:, COL_FAMILY].astype(jnp.int32)
@@ -216,6 +223,10 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     if pre_drop is not None:
         nat_drop = pre_drop & allowed  # policy/no_ep drops win
         allowed = allowed & ~nat_drop
+    stage_drop = None
+    if pre_drop_reason is not None:
+        stage_drop = (pre_drop_reason != 0) & allowed
+        allowed = allowed & ~stage_drop
     proxy = jnp.where(is_new, jnp.where(p_verdict == VERDICT_REDIRECT,
                                         p_proxy, 0),
                       ct_proxy)
@@ -235,6 +246,10 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
         verdict = jnp.where(nat_drop, VERDICT_DENY, verdict)
         reason = jnp.where(nat_drop, REASON_NAT_EXHAUSTED, reason)
         proxy = jnp.where(nat_drop, 0, proxy)
+    if stage_drop is not None:
+        verdict = jnp.where(stage_drop, VERDICT_DENY, verdict)
+        reason = jnp.where(stage_drop, pre_drop_reason, reason)
+        proxy = jnp.where(stage_drop, 0, proxy)
 
     # 5. conntrack create/refresh (create only on allowed NEW; related
     #    rows neither create nor refresh — the ICMP error is evidence
@@ -242,6 +257,8 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     untouched = is_related | no_ep
     if nat_drop is not None:
         untouched = untouched | nat_drop  # dropped rows refresh nothing
+    if stage_drop is not None:
+        untouched = untouched | stage_drop
     ct = ct_update(state.ct, hdr, fwd,
                    jnp.where(untouched, CT_NEW, ct_res), slot,
                    is_reply,

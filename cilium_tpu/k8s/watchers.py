@@ -32,6 +32,35 @@ from . import NS_LABEL, NS_LABELS_PREFIX
 
 _PROTO_NUM = {"TCP": 6, "UDP": 17, "SCTP": 132}
 
+# k8s resource.Quantity suffixes, CASE-SENSITIVE ("m" is milli, "M"
+# mega — upstream parses the annotation as a Quantity of bits/s);
+# "K"/"k" both accepted (common operator typo for the canonical "k")
+_BW_UNITS = {"": 1, "m": 1e-3, "k": 10 ** 3, "K": 10 ** 3,
+             "M": 10 ** 6, "G": 10 ** 9, "T": 10 ** 12,
+             "P": 10 ** 15, "E": 10 ** 18,
+             "Ki": 1 << 10, "Mi": 1 << 20, "Gi": 1 << 30,
+             "Ti": 1 << 40, "Pi": 1 << 50, "Ei": 1 << 60}
+
+
+def parse_bandwidth(spec) -> int:
+    """``kubernetes.io/egress-bandwidth`` quantity -> BYTES/s (0 =
+    none/invalid; the annotation is a k8s resource.Quantity in
+    bits/s — upstream pkg/bandwidth parses it the same way)."""
+    if not spec:
+        return 0
+    s = str(spec).strip()
+    for suffix in sorted(_BW_UNITS, key=len, reverse=True):
+        if suffix and s.endswith(suffix):
+            num = s[: -len(suffix)]
+            break
+    else:
+        suffix, num = "", s
+    try:
+        bits = float(num) * _BW_UNITS[suffix]
+    except ValueError:
+        return 0
+    return max(int(bits / 8), 0)
+
 
 def _meta_key(obj: dict) -> str:
     meta = obj.get("metadata") or {}
@@ -189,16 +218,22 @@ class PodWatcher:
                      if self.namespaces else None)
         labels = pod_labels(obj, ns_labels)
         ports = self._named_ports(obj)
+        bw = parse_bandwidth(((obj.get("metadata") or {}).get(
+            "annotations") or {}).get("kubernetes.io/egress-bandwidth"))
         # idempotency covers EVERYTHING the endpoint derives from the
         # pod: an IP change (sandbox restart) or port change with
         # unchanged labels must still re-register
-        sig = (tuple(labels), ips, tuple(sorted(ports.items())))
+        sig = (tuple(labels), ips, tuple(sorted(ports.items())), bw)
         if key in self._eps:
             if sig == self._sig.get(key):
                 return self._eps[key]  # idempotent re-deliver
             self.on_delete(obj)  # pod changed: re-register
         ep = self.daemon.add_endpoint(key, ips, labels,
                                       named_ports=ports)
+        if bw:
+            # reference: pkg/bandwidth reads the pod annotation and
+            # programs the endpoint's EDT aggregate
+            self.daemon.set_bandwidth(ep.id, bw)
         self._eps[key] = ep.id
         self._sig[key] = sig
         self._objs[key] = obj
@@ -213,6 +248,7 @@ class PodWatcher:
         self._objs.pop(key, None)
         if ep_id is None:
             return False
+        self.daemon.set_bandwidth(ep_id, None)
         return self.daemon.endpoints.remove(ep_id)
 
     def reregister_namespace(self, ns: str) -> int:

@@ -144,12 +144,15 @@ class OracleDatapath:
         return (t[1], t[0], t[3], t[2], t[4], 1 - t[5])
 
     def step(self, batch: HeaderBatch, now: int,
-             pre_drop=None) -> List[OracleResult]:
+             pre_drop=None,
+             pre_drop_reason=None) -> List[OracleResult]:
         """``pre_drop`` ([N] bool) marks rows the SNAT stage condemned
         (pool exhaustion).  Policy/lxcmap drops keep precedence
         (upstream order: bpf_lxc judges before host SNAT); rows that
         would otherwise forward drop with REASON_NAT_EXHAUSTED and
-        neither create nor refresh CT."""
+        neither create nor refresh CT.  ``pre_drop_reason`` ([N]
+        uint32, 0 = none) is the generalized per-row form (bandwidth
+        manager), same precedence and CT semantics."""
         from ..datapath.verdict import REASON_NAT_EXHAUSTED
 
         results: List[OracleResult] = []
@@ -221,12 +224,20 @@ class OracleDatapath:
                     and reason == REASON_FORWARDED):
                 verdict, proxy = VERDICT_DENY, 0
                 reason, event = REASON_NAT_EXHAUSTED, EV_DROP
+            if (pre_drop_reason is not None
+                    and int(pre_drop_reason[i]) != 0
+                    and reason == REASON_FORWARDED):
+                verdict, proxy = VERDICT_DENY, 0
+                reason, event = int(pre_drop_reason[i]), EV_DROP
             results.append(OracleResult(verdict, proxy, ct_res, ident,
                                         reason, event))
             allowed = reason == REASON_FORWARDED
             # a NAT-dropped row must not refresh an existing entry
             # either: CT_NEW + allowed=False touches nothing
-            if reason == REASON_NAT_EXHAUSTED:
+            if reason == REASON_NAT_EXHAUSTED or (
+                    pre_drop_reason is not None
+                    and int(pre_drop_reason[i]) != 0
+                    and reason == int(pre_drop_reason[i])):
                 ct_res = CT_NEW
             updates.append((fwd, row, is_reply, ct_res, proxy if allowed
                             else 0, allowed, related))
