@@ -386,9 +386,12 @@ class Daemon:
         from ..datapath.bandwidth import (BandwidthState, rates_array)
 
         if bytes_per_sec:
+            if self._bw_limits.get(int(ep_id)) == int(bytes_per_sec):
+                return  # unchanged: skip the tensor rebuild
             self._bw_limits[int(ep_id)] = int(bytes_per_sec)
         else:
-            self._bw_limits.pop(int(ep_id), None)
+            if self._bw_limits.pop(int(ep_id), None) is None:
+                return  # nothing was limited: nothing to rebuild
         if self._bw_limits:
             self._bw_rates = jnp.asarray(rates_array(self._bw_limits))
             if self._bw is None:

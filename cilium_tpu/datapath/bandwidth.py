@@ -115,8 +115,10 @@ def rates_array(limits: dict) -> np.ndarray:
     rates = np.zeros(MAX_ENDPOINTS, dtype=np.uint32)
     for ep_id, bps in limits.items():
         if 0 <= int(ep_id) < MAX_ENDPOINTS and bps:
-            # clamp to the token word: ~34 Gbit/s is the ceiling one
-            # u32 byte bucket can express (a pod faster than that is
-            # effectively unlimited here)
-            rates[int(ep_id)] = min(int(bps), 0xFFFFFFFF)
+            # clamp so tokens + rate*dt can NEVER wrap u32: tokens
+            # caps at burst and the accrual at burst, so burst must
+            # stay under 2^31 (~17 Gbit/s ceiling; a pod faster than
+            # that is effectively unlimited here)
+            rates[int(ep_id)] = min(int(bps),
+                                    0x7FFFFFFF // BURST_SECONDS)
     return rates

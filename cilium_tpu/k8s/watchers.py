@@ -57,9 +57,12 @@ def parse_bandwidth(spec) -> int:
         suffix, num = "", s
     try:
         bits = float(num) * _BW_UNITS[suffix]
-    except ValueError:
+        return max(int(bits / 8), 0)
+    except (ValueError, OverflowError):
+        # covers non-numeric specs AND inf/nan/1e400, whose float()
+        # succeeds but whose int() raises — one malformed annotation
+        # must read as "no limit", never crash the watcher
         return 0
-    return max(int(bits / 8), 0)
 
 
 def _meta_key(obj: dict) -> str:

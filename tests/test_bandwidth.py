@@ -172,6 +172,10 @@ class TestEdges:
         assert parse_bandwidth("1Gi") == (1 << 30) // 8
         assert parse_bandwidth("100m") == 0  # milli-bits ~ nothing
         assert parse_bandwidth("8") == 1  # 8 bits/s = 1 B/s
+        # float() accepts these; int() would raise — must read as 0
+        assert parse_bandwidth("inf") == 0
+        assert parse_bandwidth("nan") == 0
+        assert parse_bandwidth("1e400") == 0
 
     def test_limits_survive_checkpoint_restore(self, tmp_path):
         d, web = _world()

@@ -1,0 +1,89 @@
+"""Host firewall (upstream --enable-host-firewall): the node itself
+as a policy subject.  No dedicated machinery — a host endpoint
+carrying ``reserved:host`` (+ node labels) rides the same identity /
+policy / datapath path as any workload, and CCNPs select it with
+``nodeSelector`` exactly as upstream does.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_ACK, TCP_SYN, make_batch
+from cilium_tpu.policy.mapstate import VERDICT_ALLOW
+
+
+def _world():
+    d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12,
+                            node_ip="192.168.0.1"))
+    host = d.add_endpoint(
+        "host", ("192.168.0.1",),
+        ["reserved:host", "k8s:node-role=worker"])
+    d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    return d, host
+
+
+def _to_host(sport, dport, flags=TCP_SYN, src="10.0.1.1", ep=0):
+    return dict(src=src, dst="192.168.0.1", sport=sport, dport=dport,
+                proto=6, flags=flags, ep=ep, dir=0)
+
+
+class TestHostFirewall:
+    def test_ccnp_nodeselector_guards_the_host(self):
+        """A CCNP with nodeSelector (the upstream host-policy form)
+        default-denies the host and allows only web -> ssh."""
+        d, host = _world()
+        d.policy_import([{
+            "labels": [{"key": "host-fw"}],
+            "nodeSelector": {"matchLabels": {"node-role": "worker"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                "toPorts": [{"ports": [{"port": "22",
+                                        "protocol": "TCP"}]}],
+            }],
+        }])
+        ev = d.process_batch(make_batch([
+            _to_host(40000, 22, ep=host.id),            # web -> ssh
+            _to_host(40001, 80, ep=host.id),            # web -> http
+            _to_host(40002, 22, src="10.0.2.1",
+                     ep=host.id),                       # db -> ssh
+        ]).data, now=5)
+        assert [int(v) for v in ev.verdict] == [1, 0, 0]
+
+    def test_host_ct_fast_path(self):
+        d, host = _world()
+        d.policy_import([{
+            "nodeSelector": {"matchLabels": {"node-role": "worker"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                "toPorts": [{"ports": [{"port": "22",
+                                        "protocol": "TCP"}]}],
+            }],
+        }])
+        ev = d.process_batch(make_batch([
+            _to_host(41000, 22, ep=host.id)]).data, now=5)
+        assert int(ev.verdict[0]) == VERDICT_ALLOW
+        # established host flows ride the CT fast path like any other
+        ev2 = d.process_batch(make_batch([
+            _to_host(41000, 22, flags=TCP_ACK, ep=host.id)]).data,
+            now=6)
+        assert int(ev2.verdict[0]) == VERDICT_ALLOW
+
+    def test_reserved_host_peer_selection(self):
+        """Workload policy admitting traffic FROM the host (upstream
+        fromEntities: [host] / the reserved:host peer)."""
+        d, host = _world()
+        db = d.endpoints.lookup_by_ip("10.0.2.1")
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{"fromEntities": ["host"]}],
+        }])
+        ev = d.process_batch(make_batch([
+            dict(src="192.168.0.1", dst="10.0.2.1", sport=50000,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=50001,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0),
+        ]).data, now=5)
+        # host allowed, pod denied
+        assert [int(v) for v in ev.verdict] == [1, 0]
