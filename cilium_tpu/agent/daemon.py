@@ -178,6 +178,13 @@ class Daemon:
         self._bw = None
         self._bw_rates = None
         self._bw_limits: Dict[int, int] = {}
+        # egress-gateway policies (name -> spec); endpoint churn
+        # re-expands the pod selectors over local endpoints
+        self._egress_policies: Dict[str, dict] = {}
+        self._egress_rules_cache = None  # last expanded rule tuple
+        self.endpoints.on_attach(
+            lambda _pols: (self._recompile_nat()
+                           if self._egress_policies else None))
         # connect-time LB flow cache (service/socklb.py, the bpf_sock
         # analogue): created on first service traffic
         self._socklb = None
@@ -374,6 +381,95 @@ class Daemon:
 
     def _now(self) -> int:
         return int(time.time() - self._boot_time) + 1
+
+    # -- egress gateway (CiliumEgressGatewayPolicy analogue) -----------
+    def add_egress_gateway(self, name: str, selector: dict,
+                           dest_cidrs, egress_ip: str) -> None:
+        """Pods matching ``selector`` (a k8s LabelSelector dict) SNAT
+        via ``egress_ip`` toward ``dest_cidrs`` (reference:
+        CiliumEgressGatewayPolicy; single-node scope — the designated
+        gateway is this node).
+
+        Validates BEFORE storing: a malformed policy must raise here
+        (and be skipped by the watcher), never poison every later
+        regeneration's recompile."""
+        import ipaddress as _ip
+
+        eip = _ip.IPv4Address(egress_ip)  # raises on v6/garbage
+        cidrs = []
+        for c in dest_cidrs:
+            net = _ip.ip_network(c, strict=False)
+            if net.version != 4:
+                raise ValueError(
+                    f"egress gateway destinationCIDR {c!r}: the SNAT "
+                    "path is v4-only")
+            cidrs.append(str(net))
+        if not cidrs:
+            raise ValueError("egress gateway needs destinationCIDRs")
+        selectors = (selector if isinstance(selector, (list, tuple))
+                     else (selector,))
+        if not selectors:
+            raise ValueError("egress gateway needs a selector")
+        self._egress_policies[name] = {
+            "selectors": tuple(selectors),
+            "dest_cidrs": tuple(cidrs),
+            "egress_ip": str(eip),
+        }
+        self._recompile_nat()
+
+    def remove_egress_gateway(self, name: str) -> bool:
+        if self._egress_policies.pop(name, None) is None:
+            return False
+        self._recompile_nat()
+        return True
+
+    def _egress_rules(self):
+        """Expand the policies over the CURRENT local endpoints:
+        (pod IP, destination CIDR, egress IP) triples."""
+        from ..policy.api import EndpointSelector
+
+        rules = []
+        for pol in self._egress_policies.values():
+            sels = [EndpointSelector.from_dict(s)
+                    for s in pol["selectors"]]
+            for ep in self.endpoints.list():
+                if not any(s.matches(ep.labels) for s in sels):
+                    continue
+                for ip in ep.ips:
+                    if ":" in ip:
+                        continue  # v4-only SNAT path
+                    for cidr in pol["dest_cidrs"]:
+                        rules.append((ip, cidr, pol["egress_ip"]))
+        return tuple(rules)
+
+    def _recompile_nat(self) -> None:
+        """Rebuild the NAT tensors from masquerade config + egress
+        policies (endpoint churn re-expands the selectors — wired to
+        the regeneration hook).  Skips the rebuild when the expanded
+        rule set is unchanged (most regenerations don't touch the
+        selected endpoints)."""
+        from ..service.nat import NATConfig
+
+        rules = self._egress_rules()
+        if rules == self._egress_rules_cache:
+            return
+        self._egress_rules_cache = rules
+        if self.config.masquerade:
+            self.nat = NATConfig(
+                node_ip=self.config.node_ip,
+                non_masquerade_cidrs=self.config.non_masquerade_cidrs,
+                egress_rules=rules,
+            ).compile()
+        elif rules:
+            # egress gateway without masquerade: the exemption list
+            # covers everything, so ONLY policy-matched rows SNAT
+            self.nat = NATConfig(
+                node_ip=self.config.node_ip or "0.0.0.0",
+                non_masquerade_cidrs=("0.0.0.0/0",),
+                egress_rules=rules,
+            ).compile()
+        else:
+            self.nat = None
 
     # -- bandwidth manager (pkg/bandwidth / EDT analogue) --------------
     def set_bandwidth(self, ep_id: int,

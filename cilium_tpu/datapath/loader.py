@@ -535,8 +535,8 @@ class InterpreterLoader(Loader):
                                     COL_FAMILY, COL_PROTO, COL_SPORT,
                                     COL_SRC_IP3)
         from ..service.nat import (NAT_PORT_MIN, NAT_PROBE, NV_DP,
-                                   NV_DST, NV_EXPIRES, NV_SPORT,
-                                   NV_SRC, _nat_hash_py,
+                                   NV_DST, NV_EXPIRES, NV_SNAT_IP,
+                                   NV_SPORT, NV_SRC, _nat_hash_py,
                                    _nat_lifetime_py)
         from ..testing.oracle import OracleDatapath
 
@@ -549,6 +549,10 @@ class InterpreterLoader(Loader):
         nets = [(int(n), int(m)) for n, m in
                 zip(np.asarray(nat.net), np.asarray(nat.mask))]
         node_ip = int(np.asarray(nat.node_ip))
+        egw = list(zip(np.asarray(nat.egw_src).tolist(),
+                       np.asarray(nat.egw_net).tolist(),
+                       np.asarray(nat.egw_mask).tolist(),
+                       np.asarray(nat.egw_ip).tolist()))
 
         def r_key(s):
             r = table[s]
@@ -561,17 +565,27 @@ class InterpreterLoader(Loader):
             if row[COL_DIR] != 1 or row[COL_FAMILY] != 4:
                 continue
             dst = int(row[COL_DST_IP3])
-            if any((dst & m) == n for n, m in nets):
+            src0 = int(row[COL_SRC_IP3])
+            # egress-gateway policy: first (src, destCIDR) match wins
+            # and overrides the non-masquerade exemption
+            rewrite_ip = node_ip
+            gw = False
+            for g_src, g_net, g_mask, g_ip in egw:
+                if src0 == g_src and (dst & g_mask) == g_net:
+                    rewrite_ip, gw = g_ip, True
+                    break
+            if not gw and any((dst & m) == n for n, m in nets):
                 continue
             rev = OracleDatapath._rev(OracleDatapath._tuple(row))
             e = self.oracle.ct.get(rev)
             if e is not None and e.expires >= now:
                 continue  # reply of an inbound connection
-            src, sport = int(row[COL_SRC_IP3]), int(row[COL_SPORT])
+            src, sport = src0, int(row[COL_SPORT])
             proto = int(row[COL_PROTO])
-            row[COL_SRC_IP3] = node_ip
             if proto not in (6, 17, 132):
-                continue  # portless: port-preserving rewrite only
+                # portless: port-preserving rewrite only
+                row[COL_SRC_IP3] = rewrite_ip
+                continue
             dp = (int(row[COL_DPORT]) << 8) | proto
             key = (src, sport, dst, dp)
             h = _nat_hash_py(key)
@@ -584,28 +598,35 @@ class InterpreterLoader(Loader):
                     hit = s
                     break
             if hit is not None:
-                table[hit] = (*key, now + _nat_lifetime_py(proto), 0)
+                # a live mapping keeps its recorded SNAT ip (device
+                # parity: policy churn must not flip a flow's ip)
+                kept = int(table[hit][NV_SNAT_IP]) or node_ip
+                table[hit] = (*key, now + _nat_lifetime_py(proto),
+                              kept)
+                row[COL_SRC_IP3] = kept
                 row[COL_SPORT] = NAT_PORT_MIN + hit
             else:
-                claimants.append((i, key, h, proto))
+                row[COL_SRC_IP3] = rewrite_ip
+                claimants.append((i, key, h, proto, rewrite_ip))
         # phase 2: lockstep claim rounds (device parity)
         for step in range(NAT_PROBE):
             if not claimants:
                 break
             still = []
-            for i, key, h, proto in claimants:
+            for i, key, h, proto, rewrite_ip in claimants:
                 s = (h + step) % P
                 if (int(table[s][NV_EXPIRES]) < now
                         or r_key(s) == key):
-                    table[s] = (*key, now + _nat_lifetime_py(proto), 0)
+                    table[s] = (*key, now + _nat_lifetime_py(proto),
+                                rewrite_ip)
                     hdr[i][COL_SPORT] = NAT_PORT_MIN + s
                 else:
-                    still.append((i, key, h, proto))
+                    still.append((i, key, h, proto, rewrite_ip))
             claimants = still
         # leftover claimants: pool exhaustion — DROP (parity with
         # snat_egress's `dropped` mask; reference DROP_NAT_NO_MAPPING)
         self.nat_failed += len(claimants)
-        for i, _key, _h, _proto in claimants:
+        for i, _key, _h, _proto, _rip in claimants:
             dropped[i] = True
         return hdr, dropped
 
@@ -615,8 +636,8 @@ class InterpreterLoader(Loader):
                                     COL_FAMILY, COL_PROTO, COL_SPORT,
                                     COL_SRC_IP3)
         from ..service.nat import (NAT_PORT_MIN, NV_DP, NV_DST,
-                                   NV_EXPIRES, NV_SPORT, NV_SRC,
-                                   _nat_lifetime_py)
+                                   NV_EXPIRES, NV_SNAT_IP, NV_SPORT,
+                                   NV_SRC, _nat_lifetime_py)
 
         hdr = np.array(hdr, dtype=np.uint32)
         if not nat.enabled:
@@ -628,11 +649,13 @@ class InterpreterLoader(Loader):
             row = hdr[i]
             dport = int(row[COL_DPORT])
             if (row[COL_DIR] != 0 or row[COL_FAMILY] != 4
-                    or int(row[COL_DST_IP3]) != node_ip
                     or not NAT_PORT_MIN <= dport < NAT_PORT_MIN + P):
                 continue
             s = dport - NAT_PORT_MIN
             r = table[s]
+            row_ip = int(r[NV_SNAT_IP]) or node_ip
+            if int(row[COL_DST_IP3]) != row_ip:
+                continue
             rdp = (int(row[COL_SPORT]) << 8) | int(row[COL_PROTO])
             if (int(r[NV_EXPIRES]) >= now
                     and int(r[NV_DST]) == int(row[COL_SRC_IP3])

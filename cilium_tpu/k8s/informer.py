@@ -36,6 +36,8 @@ DEFAULT_RESOURCES: Tuple[Tuple[str, str], ...] = (
      "/apis/cilium.io/v2/ciliumclusterwidenetworkpolicies"),
     ("CiliumIdentity", "/apis/cilium.io/v2/ciliumidentities"),
     ("CiliumEndpoint", "/apis/cilium.io/v2/ciliumendpoints"),
+    ("CiliumEgressGatewayPolicy",
+     "/apis/cilium.io/v2/ciliumegressgatewaypolicies"),
     ("CiliumNode", "/apis/cilium.io/v2/ciliumnodes"),
 )
 

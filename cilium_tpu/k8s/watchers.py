@@ -470,6 +470,71 @@ class CiliumEndpointSliceWatcher:
         return n
 
 
+class EgressGatewayPolicyWatcher:
+    """CiliumEgressGatewayPolicy objects -> the daemon's egress
+    gateway table (reference: pkg/egressgateway — pods matching the
+    policy's selector SNAT via the designated egress IP toward the
+    destination CIDRs)."""
+
+    def __init__(self, daemon):
+        self.daemon = daemon
+
+    def on_add(self, obj: dict) -> None:
+        name = (obj.get("metadata") or {}).get("name", "")
+        spec = obj.get("spec") or {}
+        gw = spec.get("egressGateway") or {}
+        eip = gw.get("egressIP")
+        dests = spec.get("destinationCIDRs") or ()
+        # EVERY selector entry participates (pods matching ANY of
+        # them); a namespaceSelector translates to the folded
+        # namespace-label prefix, same as CNP peers — an entry with
+        # neither selector contributes nothing (NOT a wildcard)
+        entries = []
+        for sel in spec.get("selectors") or ():
+            pod = sel.get("podSelector")
+            nss = sel.get("namespaceSelector")
+            if not pod and not nss:
+                continue
+            ml = dict((pod or {}).get("matchLabels") or {})
+            me = list((pod or {}).get("matchExpressions") or ())
+            for k, v in ((nss or {}).get("matchLabels") or {}).items():
+                ml[f"k8s:{NS_LABELS_PREFIX}{k}"] = v
+            for e in (nss or {}).get("matchExpressions") or ():
+                e = dict(e)
+                e["key"] = f"k8s:{NS_LABELS_PREFIX}{e.get('key', '')}"
+                me.append(e)
+            combined = {}
+            if ml:
+                combined["matchLabels"] = ml
+            if me:
+                combined["matchExpressions"] = me
+            if combined:
+                entries.append(combined)
+        if not (name and eip and dests and entries):
+            # the spec was edited into an unusable state (cleared
+            # egressIP/CIDRs/selectors): keeping the STALE rules
+            # SNATing would be the opposite of the operator's edit
+            if name:
+                self.daemon.remove_egress_gateway(name)
+            return
+        try:
+            self.daemon.add_egress_gateway(name, entries, dests, eip)
+        except (ValueError, OverflowError) as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "egress gateway policy %s rejected: %s", name, e)
+            # fail closed for THIS policy only: drop any prior
+            # version rather than keep stale rules
+            self.daemon.remove_egress_gateway(name)
+
+    on_update = on_add
+
+    def on_delete(self, obj: dict) -> bool:
+        name = (obj.get("metadata") or {}).get("name", "")
+        return self.daemon.remove_egress_gateway(name)
+
+
 class CiliumNodeWatcher:
     """CiliumNode objects -> the kvstore node registry (what the
     health mesh probes and the operator's dead-node sweep reads;
@@ -518,6 +583,7 @@ class K8sWatcherHub:
         self.identities = CiliumIdentityWatcher(daemon.allocator)
         self.ceps = CiliumEndpointWatcher(daemon)
         self.ces = CiliumEndpointSliceWatcher(self.ceps)
+        self.egress = EgressGatewayPolicyWatcher(daemon)
         self.nodes = CiliumNodeWatcher(daemon.kvstore)
         self._routes = {
             "CiliumNetworkPolicy": self.cnp,
@@ -529,6 +595,7 @@ class K8sWatcherHub:
             "CiliumIdentity": self.identities,
             "CiliumEndpoint": self.ceps,
             "CiliumEndpointSlice": self.ces,
+            "CiliumEgressGatewayPolicy": self.egress,
             "CiliumNode": self.nodes,
         }
 

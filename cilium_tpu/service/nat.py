@@ -46,7 +46,14 @@ from ..core.packets import (
 
 @dataclass
 class NATConfig:
-    """Masquerade configuration (node-level)."""
+    """Masquerade configuration (node-level).
+
+    ``egress_rules`` is the egress-gateway policy table (reference:
+    CiliumEgressGatewayPolicy): (source pod IP, destination CIDR,
+    egress IP) triples.  A matching row SNATs via its designated
+    egress IP — even toward destinations the non-masquerade list
+    would otherwise exempt (the policy is an explicit override).
+    """
 
     node_ip: str
     # destinations inside these ranges keep the original source
@@ -54,6 +61,7 @@ class NATConfig:
     # ipMasqAgent nonMasqueradeCIDRs)
     non_masquerade_cidrs: Tuple[str, ...] = ("10.0.0.0/8",)
     enabled: bool = True
+    egress_rules: Tuple[Tuple[str, str, str], ...] = ()
 
     def compile(self) -> "NATTensors":
         nets = [ipaddress.ip_network(c)
@@ -69,10 +77,27 @@ class NATConfig:
         for i, n in enumerate(nets):
             net[i] = int(n.network_address)
             mask[i] = int(n.netmask)
+        # egress-gateway table, padded with one unsatisfiable row
+        # (src 0 never appears on the wire as a pod source)
+        g = max(len(self.egress_rules), 1)
+        g_src = np.zeros(g, dtype=np.uint32)
+        g_net = np.full(g, 0xFFFFFFFF, dtype=np.uint32)
+        g_mask = np.zeros(g, dtype=np.uint32)
+        g_ip = np.zeros(g, dtype=np.uint32)
+        for i, (src_ip, dst_cidr, eip) in enumerate(self.egress_rules):
+            n4 = ipaddress.ip_network(dst_cidr)
+            g_src[i] = int(ipaddress.IPv4Address(src_ip))
+            g_net[i] = int(n4.network_address)
+            g_mask[i] = int(n4.netmask)
+            g_ip[i] = int(ipaddress.IPv4Address(eip))
         return NATTensors(
             node_ip=jnp.uint32(int(ipaddress.IPv4Address(self.node_ip))),
             net=jnp.asarray(net),
             mask=jnp.asarray(mask),
+            egw_src=jnp.asarray(g_src),
+            egw_net=jnp.asarray(g_net),
+            egw_mask=jnp.asarray(g_mask),
+            egw_ip=jnp.asarray(g_ip),
             enabled=self.enabled,
         )
 
@@ -83,10 +108,16 @@ class NATTensors:
     node_ip: jnp.ndarray  # [] uint32
     net: jnp.ndarray  # [K] uint32 non-masquerade networks
     mask: jnp.ndarray  # [K] uint32
+    egw_src: jnp.ndarray  # [G] uint32 egress-gateway source pod IPs
+    egw_net: jnp.ndarray  # [G] uint32 destination networks
+    egw_mask: jnp.ndarray  # [G] uint32
+    egw_ip: jnp.ndarray  # [G] uint32 designated egress IPs
     enabled: bool
 
     def tree_flatten(self):
-        return ((self.node_ip, self.net, self.mask), self.enabled)
+        return ((self.node_ip, self.net, self.mask, self.egw_src,
+                 self.egw_net, self.egw_mask, self.egw_ip),
+                self.enabled)
 
     @classmethod
     def tree_unflatten(cls, enabled, children):
@@ -126,7 +157,8 @@ NV_SPORT = 1  # original source port
 NV_DST = 2  # destination IP
 NV_DP = 3  # dport << 8 | proto
 NV_EXPIRES = 4
-NV_PAD = 5
+NV_SNAT_IP = 5  # the IP this mapping rewrote to (0 = pre-r05: node_ip)
+NV_PAD = NV_SNAT_IP  # historical alias
 
 
 @jax.tree_util.register_pytree_node_class
@@ -223,7 +255,16 @@ def snat_egress(tbl: NATTable, t: NATTensors, ct, hdr: jnp.ndarray,
     v4 = hdr[:, COL_FAMILY] == 4
     _fwd, rev = ct_keys_from_headers(hdr)
     r_found, _slot = _probe(ct.table, rev, now)
-    masq = egress & v4 & ~internal & ~r_found
+    # egress-gateway policy: (source pod, destination CIDR) pairs
+    # SNAT via their designated egress IP, overriding the
+    # non-masquerade exemption (reference: CiliumEgressGatewayPolicy)
+    g_hit = ((src[:, None] == t.egw_src[None, :])
+             & ((dst[:, None] & t.egw_mask[None, :])
+                == t.egw_net[None, :]))
+    gw = jnp.any(g_hit, axis=1)
+    g_first = jnp.argmax(g_hit, axis=1)
+    rewrite_ip = jnp.where(gw, t.egw_ip[g_first], t.node_ip)
+    masq = egress & v4 & (~internal | gw) & ~r_found
     portful = (proto == 6) | (proto == 17) | (proto == 132)
     need = masq & portful
 
@@ -233,10 +274,6 @@ def snat_egress(tbl: NATTable, t: NATTensors, ct, hdr: jnp.ndarray,
     lifetime = jnp.where(proto == 6, jnp.uint32(NAT_LIFETIME_TCP),
                          jnp.uint32(NAT_LIFETIME_NONTCP))
     expires = (now + lifetime).astype(jnp.uint32)
-    new_row = jnp.stack([
-        src, sport, dst, dp, expires,
-        jnp.zeros_like(src),
-    ], axis=1)
     n = src.shape[0]
     ridx = jnp.arange(n, dtype=jnp.int32)
 
@@ -263,6 +300,17 @@ def snat_egress(tbl: NATTable, t: NATTensors, ct, hdr: jnp.ndarray,
     have_match = jnp.any(live_same, axis=1)
     mcol = jnp.argmax(live_same, axis=1)
     mslot = jnp.take_along_axis(win, mcol[:, None], axis=1)[:, 0]
+    # a LIVE mapping keeps the IP it was created with: an egress
+    # policy added/removed mid-flow must not flip the flow's SNAT ip
+    # mid-stream (same invariant phase 1 protects for the node port);
+    # stored 0 = pre-upgrade row, which could only mean node_ip
+    stored_ip = table[mslot][:, NV_SNAT_IP]
+    stored_ip = jnp.where(stored_ip != 0, stored_ip, t.node_ip)
+    rewrite_ip = jnp.where(have_match & need, stored_ip, rewrite_ip)
+    new_row = jnp.stack([
+        src, sport, dst, dp, expires,
+        rewrite_ip,
+    ], axis=1)
     # refresh matched mappings (duplicate rows of one flow write the
     # same content, so scatter order is immaterial here)
     refresh = jnp.where(need & have_match, mslot, P)
@@ -298,7 +346,7 @@ def snat_egress(tbl: NATTable, t: NATTensors, ct, hdr: jnp.ndarray,
     new_port = (jnp.uint32(NAT_PORT_MIN)
                 + final_slot.astype(jnp.uint32))
     hdr = hdr.at[:, COL_SRC_IP3].set(
-        jnp.where(masq, t.node_ip, src))
+        jnp.where(masq, rewrite_ip, src))
     hdr = hdr.at[:, COL_SPORT].set(
         jnp.where(allocated, new_port, sport))
     failed = tbl.failed + jnp.sum(dropped).astype(jnp.uint32)
@@ -328,7 +376,12 @@ def snat_reverse(tbl: NATTable, t: NATTensors, hdr: jnp.ndarray,
     row = tbl.table[cand]
     # the reply's (src, sport) must be the mapping's (dst, dport)
     rdp = (sport << 8) | proto
-    hit = (ingress & v4 & in_pool & (dst == t.node_ip)
+    # the reply must target the IP this mapping actually rewrote to
+    # (node_ip or an egress-gateway IP; 0 = a pre-upgrade snapshot row
+    # that could only have used node_ip)
+    row_ip = row[:, NV_SNAT_IP]
+    ip_ok = jnp.where(row_ip != 0, dst == row_ip, dst == t.node_ip)
+    hit = (ingress & v4 & in_pool & ip_ok
            & (row[:, NV_EXPIRES] >= now)
            & (row[:, NV_DST] == src) & (row[:, NV_DP] == rdp))
     hdr = hdr.at[:, COL_DST_IP3].set(

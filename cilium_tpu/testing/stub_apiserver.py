@@ -35,6 +35,8 @@ PATH_BY_KIND = {
     "CiliumEndpoint": "/apis/cilium.io/v2/ciliumendpoints",
     "CiliumEndpointSlice":
         "/apis/cilium.io/v2alpha1/ciliumendpointslices",
+    "CiliumEgressGatewayPolicy":
+        "/apis/cilium.io/v2/ciliumegressgatewaypolicies",
     "CiliumNode": "/apis/cilium.io/v2/ciliumnodes",
 }
 

@@ -1,0 +1,241 @@
+"""Egress gateway (CiliumEgressGatewayPolicy analogue): pods matching
+a policy's selector SNAT via the designated egress IP toward the
+policy's destination CIDRs — overriding the non-masquerade exemption;
+replies reverse-translate against the IP the mapping actually used.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.core.packets import (COL_DPORT, COL_DST_IP3,
+                                     COL_SPORT, COL_SRC_IP3)
+
+EGW_IP = "203.0.113.7"
+
+
+def _world(backend="tpu", masquerade=True):
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12,
+                            masquerade=masquerade,
+                            node_ip="192.168.0.1"))
+    gw = d.add_endpoint("gw-pod", ("10.0.5.1",),
+                        ["k8s:app=crawler", "k8s:ns=default"])
+    d.add_endpoint("plain", ("10.0.5.2",),
+                   ["k8s:app=plain", "k8s:ns=default"])
+    # both pods may egress anywhere
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"ns": "default"}},
+        "egress": [{"toEntities": ["world"]}],
+    }])
+    d.add_egress_gateway(
+        "crawler-egress", {"matchLabels": {"app": "crawler"}},
+        ["198.51.100.0/24"], EGW_IP)
+    return d, gw
+
+
+def _pkt(src, dst, sport, ep, dirn=1, dport=443):
+    return dict(src=src, dst=dst, sport=sport, dport=dport, proto=6,
+                flags=TCP_SYN, ep=ep, dir=dirn)
+
+
+def _ip(word):
+    import ipaddress
+
+    return str(ipaddress.IPv4Address(int(word)))
+
+
+class TestEgressGateway:
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_selected_pod_snats_via_egress_ip(self, backend):
+        d, gw = _world(backend)
+        plain = d.endpoints.lookup_by_ip("10.0.5.2")
+        ev = d.process_batch(make_batch([
+            # crawler -> policy CIDR: egress IP
+            _pkt("10.0.5.1", "198.51.100.9", 40000, gw.id),
+            # crawler -> other external: plain masquerade (node IP)
+            _pkt("10.0.5.1", "203.0.114.9", 40001, gw.id),
+            # other pod -> policy CIDR: plain masquerade
+            _pkt("10.0.5.2", "198.51.100.9", 40002, plain.id),
+        ]).data, now=5)
+        srcs = [_ip(w) for w in ev.hdr[:, COL_SRC_IP3]]
+        assert srcs[0] == EGW_IP, backend
+        assert srcs[1] == "192.168.0.1", backend
+        assert srcs[2] == "192.168.0.1", backend
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_reply_reverse_translates_via_egress_ip(self, backend):
+        d, gw = _world(backend)
+        ev = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 41000, gw.id),
+        ]).data, now=5)
+        node_port = int(ev.hdr[0, COL_SPORT])
+        # the reply targets the EGRESS ip at the allocated port
+        ev2 = d.process_batch(make_batch([
+            dict(src="198.51.100.9", dst=EGW_IP, sport=443,
+                 dport=node_port, proto=6, flags=0x12, ep=gw.id,
+                 dir=0),
+        ]).data, now=6)
+        assert _ip(ev2.hdr[0, COL_DST_IP3]) == "10.0.5.1", backend
+        assert int(ev2.hdr[0, COL_DPORT]) == 41000, backend
+        # a reply to the NODE ip for that slot must NOT translate
+        # (the mapping recorded the egress IP)
+        ev3 = d.process_batch(make_batch([
+            dict(src="198.51.100.9", dst="192.168.0.1", sport=443,
+                 dport=node_port, proto=6, flags=0x12, ep=gw.id,
+                 dir=0),
+        ]).data, now=7)
+        assert _ip(ev3.hdr[0, COL_DST_IP3]) == "192.168.0.1", backend
+
+    def test_gateway_without_masquerade(self):
+        """Egress gateway works with masquerade OFF: only
+        policy-matched rows SNAT, everything else keeps its source."""
+        d, gw = _world(masquerade=False)
+        plain = d.endpoints.lookup_by_ip("10.0.5.2")
+        ev = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 42000, gw.id),
+            _pkt("10.0.5.2", "203.0.114.9", 42001, plain.id),
+        ]).data, now=5)
+        srcs = [_ip(w) for w in ev.hdr[:, COL_SRC_IP3]]
+        assert srcs[0] == EGW_IP
+        assert srcs[1] == "10.0.5.2"  # untouched
+
+    def test_policy_removal_restores_masquerade(self):
+        d, gw = _world()
+        assert d.remove_egress_gateway("crawler-egress")
+        ev = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 43000, gw.id),
+        ]).data, now=5)
+        assert _ip(ev.hdr[0, COL_SRC_IP3]) == "192.168.0.1"
+
+    def test_late_endpoint_joins_the_policy(self):
+        """A pod created AFTER the policy still gets gateway'd (the
+        selector re-expands on endpoint churn)."""
+        d, _gw = _world()
+        late = d.add_endpoint("late", ("10.0.5.3",),
+                              ["k8s:app=crawler", "k8s:ns=default"])
+        ev = d.process_batch(make_batch([
+            _pkt("10.0.5.3", "198.51.100.9", 44000, late.id),
+        ]).data, now=5)
+        assert _ip(ev.hdr[0, COL_SRC_IP3]) == EGW_IP
+
+
+class TestCRDWatcher:
+    def test_crd_round_trip(self):
+        d, _gw = _world()
+        d.remove_egress_gateway("crawler-egress")
+        hub = d.k8s_watchers()
+        obj = {
+            "kind": "CiliumEgressGatewayPolicy",
+            "metadata": {"name": "via-crd"},
+            "spec": {
+                "selectors": [{"podSelector": {
+                    "matchLabels": {"app": "crawler"}}}],
+                "destinationCIDRs": ["198.51.100.0/24"],
+                "egressGateway": {"egressIP": EGW_IP},
+            },
+        }
+        hub.dispatch("add", obj)
+        assert "via-crd" in d._egress_policies
+        gw = d.endpoints.lookup_by_ip("10.0.5.1")
+        ev = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 45000, gw.id),
+        ]).data, now=5)
+        assert _ip(ev.hdr[0, COL_SRC_IP3]) == EGW_IP
+        hub.dispatch("delete", obj)
+        assert "via-crd" not in d._egress_policies
+
+
+class TestRobustness:
+    def test_malformed_crd_rejected_without_poisoning(self):
+        """A v6 destinationCIDR (legal per the CRD, unsupported by the
+        v4 SNAT path) is rejected at admission: the watcher drops the
+        policy and later endpoint churn keeps working."""
+        d, _gw = _world()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", {
+            "kind": "CiliumEgressGatewayPolicy",
+            "metadata": {"name": "bad"},
+            "spec": {"selectors": [{"podSelector": {
+                         "matchLabels": {"app": "crawler"}}}],
+                     "destinationCIDRs": ["2001:db8::/32"],
+                     "egressGateway": {"egressIP": EGW_IP}},
+        })
+        assert "bad" not in d._egress_policies
+        # regeneration still healthy
+        d.add_endpoint("later", ("10.0.5.9",), ["k8s:app=later"])
+        assert d.endpoints.lookup_by_ip("10.0.5.9") is not None
+
+    def test_update_clearing_gateway_removes_the_policy(self):
+        d, gw = _world()
+        hub = d.k8s_watchers()
+        assert "crawler-egress" in d._egress_policies
+        hub.dispatch("update", {
+            "kind": "CiliumEgressGatewayPolicy",
+            "metadata": {"name": "crawler-egress"},
+            "spec": {"selectors": [{"podSelector": {
+                         "matchLabels": {"app": "crawler"}}}],
+                     "destinationCIDRs": ["198.51.100.0/24"],
+                     "egressGateway": {}},  # egressIP cleared
+        })
+        assert "crawler-egress" not in d._egress_policies
+        ev = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 46000, gw.id),
+        ]).data, now=5)
+        assert _ip(ev.hdr[0, COL_SRC_IP3]) == "192.168.0.1"
+
+    def test_multiple_selector_entries_all_match(self):
+        d, gw = _world()
+        d.remove_egress_gateway("crawler-egress")
+        plain = d.endpoints.lookup_by_ip("10.0.5.2")
+        hub = d.k8s_watchers()
+        hub.dispatch("add", {
+            "kind": "CiliumEgressGatewayPolicy",
+            "metadata": {"name": "both"},
+            "spec": {"selectors": [
+                         {"podSelector": {"matchLabels":
+                                          {"app": "crawler"}}},
+                         {"podSelector": {"matchLabels":
+                                          {"app": "plain"}}}],
+                     "destinationCIDRs": ["198.51.100.0/24"],
+                     "egressGateway": {"egressIP": EGW_IP}},
+        })
+        ev = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 47000, gw.id),
+            _pkt("10.0.5.2", "198.51.100.9", 47001, plain.id),
+        ]).data, now=5)
+        assert [_ip(w) for w in ev.hdr[:, COL_SRC_IP3]] == \
+            [EGW_IP, EGW_IP]
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_live_flow_keeps_its_snat_ip_across_policy_add(
+            self, backend):
+        """A flow SNAT'd via node_ip before the policy existed keeps
+        node_ip after the policy lands (the same invariant the port
+        has: nothing about a live mapping changes mid-stream)."""
+        d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12,
+                                masquerade=True,
+                                node_ip="192.168.0.1"))
+        gw = d.add_endpoint("crawler", ("10.0.5.1",),
+                            ["k8s:app=crawler"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "crawler"}},
+            "egress": [{"toEntities": ["world"]}],
+        }])
+        ev = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 48000, gw.id),
+        ]).data, now=5)
+        assert _ip(ev.hdr[0, COL_SRC_IP3]) == "192.168.0.1"
+        d.add_egress_gateway(
+            "late", {"matchLabels": {"app": "crawler"}},
+            ["198.51.100.0/24"], EGW_IP)
+        # same flow, next packet: the LIVE mapping keeps node_ip
+        ev2 = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 48000, gw.id),
+        ]).data, now=6)
+        assert _ip(ev2.hdr[0, COL_SRC_IP3]) == "192.168.0.1", backend
+        # a NEW flow takes the gateway
+        ev3 = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 48001, gw.id),
+        ]).data, now=7)
+        assert _ip(ev3.hdr[0, COL_SRC_IP3]) == EGW_IP, backend
