@@ -410,6 +410,13 @@ class Daemon:
                      else (selector,))
         if not selectors:
             raise ValueError("egress gateway needs a selector")
+        # selectors must PARSE before the store: a stored-but-invalid
+        # policy would raise from every later recompile (the
+        # regeneration hook), breaking endpoint churn node-wide
+        from ..policy.api import EndpointSelector
+
+        for sel in selectors:
+            EndpointSelector.from_dict(sel)  # raises on bad operators
         self._egress_policies[name] = {
             "selectors": tuple(selectors),
             "dest_cidrs": tuple(cidrs),
@@ -961,6 +968,14 @@ class Daemon:
             # them from pod annotations; restore-without-k8s must not
             # silently unthrottle endpoints)
             "bandwidth": {str(k): v for k, v in self._bw_limits.items()},
+            # egress policies likewise: the restored NAT snapshot's
+            # mappings carry their egress IPs, and NEW flows must not
+            # silently fall back to node_ip masquerade
+            "egress-gateways": {
+                name: {"selectors": list(p["selectors"]),
+                       "dest_cidrs": list(p["dest_cidrs"]),
+                       "egress_ip": p["egress_ip"]}
+                for name, p in self._egress_policies.items()},
         }
         # ct.npz first, state.json LAST: state.json is the commit point
         # of the checkpoint pair, so a crash between the two renames
@@ -1022,6 +1037,9 @@ class Daemon:
         self.endpoints.regenerate()
         for ep_id, bps in (meta.get("bandwidth") or {}).items():
             self.set_bandwidth(int(ep_id), int(bps))
+        for name, p in (meta.get("egress-gateways") or {}).items():
+            self.add_egress_gateway(name, p["selectors"],
+                                    p["dest_cidrs"], p["egress_ip"])
         ct_path = os.path.join(state_dir, "ct.npz")
         if os.path.exists(ct_path):
             try:

@@ -95,6 +95,9 @@ class APIClient:
     def map_list(self):
         return self._request("GET", "/map")
 
+    def egress_list(self):
+        return self._request("GET", "/egress")
+
     def map_get(self, name: str):
         return self._request("GET", f"/map/{name}")
 

@@ -142,6 +142,13 @@ def _make_handler(daemon: Daemon):
                 elif path == "/map/lb":
                     limit = int(q.get("limit", ["1000"])[0])
                     self._send(200, daemon.socklb_entries(limit))
+                elif path == "/egress":
+                    # expanded egress-gateway rules (cilium egress
+                    # list): one row per (pod IP, destCIDR, egress IP)
+                    self._send(200, [
+                        {"source": s, "destination": c,
+                         "egress-ip": e}
+                        for s, c, e in daemon._egress_rules()])
                 elif path == "/map/nat":
                     from ..service.nat import nat_entries_from_snapshot
 

@@ -239,6 +239,18 @@ def args_dir(e) -> str:
     return {"ingress": "in ", "egress": "out"}.get(e.get("dir", ""), "?")
 
 
+def cmd_egress(args) -> int:
+    entries = _client(args).egress_list()
+    if args.json:
+        _print(entries)
+        return 0
+    print(f"{'SOURCE':<18}{'DESTINATION':<20}EGRESS-IP")
+    for e in entries:
+        print(f"{e['source']:<18}{e['destination']:<20}"
+              f"{e['egress-ip']}")
+    return 0
+
+
 def cmd_map(args) -> int:
     _print(_client(args).map_list())
     return 0
@@ -432,6 +444,7 @@ def main(argv=None) -> int:
     p.add_argument("action", nargs="?", default="list")
     p.add_argument("id", nargs="?", type=int, default=0)
 
+    sub.add_parser("egress", help="egress-gateway rules (expanded)")
     sub.add_parser("map", help="list datapath maps")
     sub.add_parser("metrics", help="prometheus metrics")
 
@@ -487,6 +500,7 @@ def main(argv=None) -> int:
             "service": cmd_service, "fqdn": cmd_fqdn,
             "health": cmd_health, "config": cmd_config,
             "proxy": cmd_proxy,
+            "egress": cmd_egress,
         }.get(args.cmd)
         if handler is None:
             parser.print_help()

@@ -493,8 +493,9 @@ class EgressGatewayPolicyWatcher:
         for sel in spec.get("selectors") or ():
             pod = sel.get("podSelector")
             nss = sel.get("namespaceSelector")
-            if not pod and not nss:
-                continue
+            if "podSelector" not in sel and "namespaceSelector" \
+                    not in sel:
+                continue  # neither key present: contributes nothing
             ml = dict((pod or {}).get("matchLabels") or {})
             me = list((pod or {}).get("matchExpressions") or ())
             for k, v in ((nss or {}).get("matchLabels") or {}).items():
@@ -508,8 +509,10 @@ class EgressGatewayPolicyWatcher:
                 combined["matchLabels"] = ml
             if me:
                 combined["matchExpressions"] = me
-            if combined:
-                entries.append(combined)
+            # an explicitly-present EMPTY podSelector ({}) is the k8s
+            # match-all: the entry stays (as the wildcard selector),
+            # it is NOT dropped
+            entries.append(combined)
         if not (name and eip and dests and entries):
             # the spec was edited into an unusable state (cleared
             # egressIP/CIDRs/selectors): keeping the STALE rules

@@ -239,3 +239,70 @@ class TestRobustness:
             _pkt("10.0.5.1", "198.51.100.9", 48001, gw.id),
         ]).data, now=7)
         assert _ip(ev3.hdr[0, COL_SRC_IP3]) == EGW_IP, backend
+
+
+class TestIntrospection:
+    def test_egress_list_via_api_and_cli(self, tmp_path, capsys):
+        from cilium_tpu.api import APIClient, APIServer
+        from cilium_tpu.cli.main import main as cli_main
+
+        d, _gw = _world()
+        sock = str(tmp_path / "egress.sock")
+        srv = APIServer(d, sock)
+        srv.start()
+        try:
+            entries = APIClient(sock).egress_list()
+            assert entries == [{"source": "10.0.5.1",
+                                "destination": "198.51.100.0/24",
+                                "egress-ip": EGW_IP}]
+            assert cli_main(["--socket", sock, "egress"]) == 0
+            out = capsys.readouterr().out
+            assert "10.0.5.1" in out and EGW_IP in out
+        finally:
+            srv.stop()
+
+
+class TestReviewEdges:
+    def test_invalid_selector_rejected_before_store(self):
+        d, _gw = _world()
+        with pytest.raises(ValueError):
+            d.add_egress_gateway(
+                "bad-sel",
+                {"matchExpressions": [{"key": "a", "operator":
+                                       "Equals", "values": ["b"]}]},
+                ["198.51.100.0/24"], EGW_IP)
+        assert "bad-sel" not in d._egress_policies
+        # regeneration unharmed
+        d.add_endpoint("after", ("10.0.5.8",), ["k8s:app=after"])
+        assert d.endpoints.lookup_by_ip("10.0.5.8") is not None
+
+    def test_empty_podselector_is_match_all(self):
+        d, gw = _world()
+        d.remove_egress_gateway("crawler-egress")
+        hub = d.k8s_watchers()
+        hub.dispatch("add", {
+            "kind": "CiliumEgressGatewayPolicy",
+            "metadata": {"name": "all-pods"},
+            "spec": {"selectors": [{"podSelector": {}}],
+                     "destinationCIDRs": ["198.51.100.0/24"],
+                     "egressGateway": {"egressIP": EGW_IP}},
+        })
+        assert "all-pods" in d._egress_policies
+        ev = d.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 49000, gw.id),
+        ]).data, now=5)
+        assert _ip(ev.hdr[0, COL_SRC_IP3]) == EGW_IP
+
+    def test_policies_survive_checkpoint_restore(self, tmp_path):
+        d, _gw = _world()
+        d.checkpoint(str(tmp_path))
+        d2 = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12,
+                                 masquerade=True,
+                                 node_ip="192.168.0.1"))
+        assert d2.restore(str(tmp_path))
+        assert "crawler-egress" in d2._egress_policies
+        gw2 = d2.endpoints.lookup_by_ip("10.0.5.1")
+        ev = d2.process_batch(make_batch([
+            _pkt("10.0.5.1", "198.51.100.9", 50000, gw2.id),
+        ]).data, now=50)
+        assert _ip(ev.hdr[0, COL_SRC_IP3]) == EGW_IP
