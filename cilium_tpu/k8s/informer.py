@@ -38,6 +38,8 @@ DEFAULT_RESOURCES: Tuple[Tuple[str, str], ...] = (
     ("CiliumEndpoint", "/apis/cilium.io/v2/ciliumendpoints"),
     ("CiliumEgressGatewayPolicy",
      "/apis/cilium.io/v2/ciliumegressgatewaypolicies"),
+    ("CiliumLocalRedirectPolicy",
+     "/apis/cilium.io/v2/ciliumlocalredirectpolicies"),
     ("CiliumNode", "/apis/cilium.io/v2/ciliumnodes"),
 )
 

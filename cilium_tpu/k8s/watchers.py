@@ -538,6 +538,105 @@ class EgressGatewayPolicyWatcher:
         return self.daemon.remove_egress_gateway(name)
 
 
+class LocalRedirectPolicyWatcher:
+    """CiliumLocalRedirectPolicy objects -> node-local service
+    redirects (reference: pkg/redirectpolicy — traffic to a frontend
+    address redirects to node-LOCAL backends, e.g. the node-local DNS
+    cache).  The dataplane is the ordinary service DNAT path; this
+    watcher resolves the backend selector over local endpoints and
+    re-resolves on endpoint churn."""
+
+    PREFIX = "lrp:"
+
+    def __init__(self, daemon):
+        self.daemon = daemon
+        self._specs: Dict[str, dict] = {}  # name -> parsed spec
+        daemon.endpoints.on_attach(lambda _p: self.resync())
+
+    def on_add(self, obj: dict) -> None:
+        name = _meta_key(obj)
+        spec = obj.get("spec") or {}
+        fe = (spec.get("redirectFrontend") or {}).get(
+            "addressMatcher") or {}
+        be = spec.get("redirectBackend") or {}
+        ip = fe.get("ip")
+        ports = [(int(p.get("port", 0)),
+                  _PROTO_NUM.get(p.get("protocol", "TCP"), 6))
+                 for p in fe.get("toPorts") or ()]
+        be_sel = dict(be.get("localEndpointSelector") or {})
+        be_ports = [int(p.get("port", 0))
+                    for p in be.get("toPorts") or ()]
+        if not (ip and ports and be_ports):
+            # cleared/unusable spec: drop any prior version's
+            # redirects instead of leaving them stale
+            self.on_delete(obj)
+            return
+        # backend selection is scoped to the POLICY's namespace
+        # (upstream pkg/redirectpolicy): a matching pod elsewhere
+        # must not capture this namespace's traffic
+        ns = (obj.get("metadata") or {}).get("namespace", "default")
+        ml = dict(be_sel.get("matchLabels") or {})
+        ml[f"k8s:{NS_LABEL}"] = ns
+        be_sel["matchLabels"] = ml
+        # an update may drop frontend ports: uninstall the prior
+        # version's services first, then install the new set
+        if name in self._specs:
+            self._uninstall(name)
+        self._specs[name] = {"ip": ip, "ports": ports,
+                             "selector": be_sel,
+                             "be_ports": be_ports}
+        self._install(name)
+
+    on_update = on_add
+
+    def on_delete(self, obj: dict) -> bool:
+        name = _meta_key(obj)
+        if self._specs.pop(name, None) is None:
+            return False
+        self._uninstall(name)
+        return True
+
+    def resync(self) -> None:
+        """Endpoint churn: re-resolve every policy's local backends."""
+        for name in list(self._specs):
+            self._install(name)
+
+    def _install(self, name: str) -> None:
+        from ..policy.api import EndpointSelector
+
+        spec = self._specs[name]
+        sel = EndpointSelector.from_dict(spec["selector"])
+        local = [ip for ep in self.daemon.endpoints.list()
+                 if sel.matches(ep.labels)
+                 for ip in ep.ips if ":" not in ip]
+        existing = {s.name: s for s in self.daemon.services.list()}
+        for i, (fport, proto) in enumerate(spec["ports"]):
+            be_port = spec["be_ports"][min(i,
+                                           len(spec["be_ports"]) - 1)]
+            # proto in the key: the canonical nodelocaldns LRP fronts
+            # 53/UDP AND 53/TCP — they must not collide
+            svc = f"{self.PREFIX}{name}:{fport}/{proto}"
+            if local:
+                backends = [f"{b}:{be_port}" for b in sorted(local)]
+                cur = existing.get(svc)
+                if (cur is not None and cur.protocol == proto
+                        and [f"{b.ip}:{b.port}" for b in cur.backends]
+                        == backends):
+                    continue  # unchanged: keep the compiled tensors
+                self.daemon.services.upsert(
+                    svc, f"{spec['ip']}:{fport}", backends,
+                    protocol=proto)
+            else:
+                # no local backend (pod gone): withdraw rather than
+                # blackhole via a stale address
+                self.daemon.services.delete(svc)
+
+    def _uninstall(self, name: str) -> None:
+        for svc in [s.name for s in self.daemon.services.list()
+                    if s.name.startswith(f"{self.PREFIX}{name}:")]:
+            self.daemon.services.delete(svc)
+
+
 class CiliumNodeWatcher:
     """CiliumNode objects -> the kvstore node registry (what the
     health mesh probes and the operator's dead-node sweep reads;
@@ -587,6 +686,7 @@ class K8sWatcherHub:
         self.ceps = CiliumEndpointWatcher(daemon)
         self.ces = CiliumEndpointSliceWatcher(self.ceps)
         self.egress = EgressGatewayPolicyWatcher(daemon)
+        self.lrp = LocalRedirectPolicyWatcher(daemon)
         self.nodes = CiliumNodeWatcher(daemon.kvstore)
         self._routes = {
             "CiliumNetworkPolicy": self.cnp,
@@ -599,6 +699,7 @@ class K8sWatcherHub:
             "CiliumEndpoint": self.ceps,
             "CiliumEndpointSlice": self.ces,
             "CiliumEgressGatewayPolicy": self.egress,
+            "CiliumLocalRedirectPolicy": self.lrp,
             "CiliumNode": self.nodes,
         }
 

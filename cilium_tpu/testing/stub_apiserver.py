@@ -37,6 +37,8 @@ PATH_BY_KIND = {
         "/apis/cilium.io/v2alpha1/ciliumendpointslices",
     "CiliumEgressGatewayPolicy":
         "/apis/cilium.io/v2/ciliumegressgatewaypolicies",
+    "CiliumLocalRedirectPolicy":
+        "/apis/cilium.io/v2/ciliumlocalredirectpolicies",
     "CiliumNode": "/apis/cilium.io/v2/ciliumnodes",
 }
 

@@ -1,0 +1,103 @@
+"""Local redirect policy (CiliumLocalRedirectPolicy analogue):
+traffic to a frontend address redirects to node-LOCAL backends
+resolved by selector (the node-local DNS cache pattern), riding the
+ordinary service DNAT path.
+"""
+
+import ipaddress
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import make_batch
+from cilium_tpu.core.packets import COL_DPORT, COL_DST_IP3
+
+LRP = {
+    "kind": "CiliumLocalRedirectPolicy",
+    "metadata": {"name": "nodelocaldns", "namespace": "kube-system"},
+    "spec": {
+        "redirectFrontend": {"addressMatcher": {
+            "ip": "169.254.20.10",
+            "toPorts": [{"port": "53", "protocol": "UDP"}],
+        }},
+        "redirectBackend": {
+            "localEndpointSelector": {
+                "matchLabels": {"k8s-app": "node-local-dns"}},
+            "toPorts": [{"port": "5353"}],
+        },
+    },
+}
+
+
+def _ip(word):
+    return str(ipaddress.IPv4Address(int(word)))
+
+
+def _world():
+    d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+    client = d.add_endpoint("app", ("10.0.1.1",), ["k8s:app=web"])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [{"toEntities": ["all"]}],
+    }])
+    return d, client
+
+
+def _dns(ep, sport):
+    return make_batch([
+        dict(src="10.0.1.1", dst="169.254.20.10", sport=sport,
+             dport=53, proto=17, flags=0, ep=ep.id, dir=1)
+    ]).data
+
+
+class TestLocalRedirect:
+    def test_redirects_to_local_backend(self):
+        d, client = _world()
+        d.add_endpoint(
+            "dns-cache", ("10.0.0.53",),
+            ["k8s:k8s-app=node-local-dns",
+             "k8s:io.kubernetes.pod.namespace=kube-system"])
+        hub = d.k8s_watchers()
+        hub.dispatch("add", LRP)
+        ev = d.process_batch(_dns(client, 40000), now=5)
+        assert _ip(ev.hdr[0, COL_DST_IP3]) == "10.0.0.53"
+        assert int(ev.hdr[0, COL_DPORT]) == 5353
+
+    def test_backend_appears_later(self):
+        """Policy lands before the local backend pod: installs as soon
+        as the endpoint churn resyncs the selector."""
+        d, client = _world()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", LRP)
+        # no local backend yet: traffic passes through un-redirected
+        ev = d.process_batch(_dns(client, 41000), now=5)
+        assert _ip(ev.hdr[0, COL_DST_IP3]) == "169.254.20.10"
+        d.add_endpoint(
+            "dns-cache", ("10.0.0.53",),
+            ["k8s:k8s-app=node-local-dns",
+             "k8s:io.kubernetes.pod.namespace=kube-system"])
+        ev2 = d.process_batch(_dns(client, 41001), now=6)
+        assert _ip(ev2.hdr[0, COL_DST_IP3]) == "10.0.0.53"
+
+    def test_backend_removal_withdraws_redirect(self):
+        d, client = _world()
+        dns = d.add_endpoint(
+            "dns-cache", ("10.0.0.53",),
+            ["k8s:k8s-app=node-local-dns",
+             "k8s:io.kubernetes.pod.namespace=kube-system"])
+        hub = d.k8s_watchers()
+        hub.dispatch("add", LRP)
+        assert d.endpoints.remove(dns.id)
+        ev = d.process_batch(_dns(client, 42000), now=5)
+        # withdrawn, not blackholed via the dead backend
+        assert _ip(ev.hdr[0, COL_DST_IP3]) == "169.254.20.10"
+
+    def test_policy_delete_removes_redirect(self):
+        d, client = _world()
+        d.add_endpoint(
+            "dns-cache", ("10.0.0.53",),
+            ["k8s:k8s-app=node-local-dns",
+             "k8s:io.kubernetes.pod.namespace=kube-system"])
+        hub = d.k8s_watchers()
+        hub.dispatch("add", LRP)
+        hub.dispatch("delete", LRP)
+        ev = d.process_batch(_dns(client, 43000), now=5)
+        assert _ip(ev.hdr[0, COL_DST_IP3]) == "169.254.20.10"
