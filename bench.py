@@ -658,7 +658,7 @@ def bench_socket_lb(n_services=512, iters=9) -> dict:
         # stage is pure); post-rewrite rows still pay the same [N, S]
         # compare, which is the cost being measured
         def body(_i, h):
-            h2, _hits = lb_stage(t, h)
+            h2, _hits, _nobe = lb_stage(t, h)
             return h2
         return jax.lax.fori_loop(0, LOOP, body, hdr0)
 
@@ -670,7 +670,7 @@ def bench_socket_lb(n_services=512, iters=9) -> dict:
         # brute loop (which threads h) pays them — an unfair compare
         def body(_i, carry):
             tb, acc = carry
-            h2, hits, tb2 = socklb_stage(tb, t, hdr0, now)
+            h2, hits, _nobe, tb2 = socklb_stage(tb, t, hdr0, now)
             return tb2, (acc + h2[:, COL_DST_IP3].sum()
                          + h2[:, COL_DPORT].sum()
                          + hits.sum().astype(jnp.uint32))
@@ -690,15 +690,15 @@ def bench_socket_lb(n_services=512, iters=9) -> dict:
 
     tbl = SockLBTable.create(1 << 20)
     box = [tbl]
-    _, _, box[0] = socklb_stage_jit(box[0], t, jhdr, now)  # compile
+    _, _, _, box[0] = socklb_stage_jit(box[0], t, jhdr, now)  # compile
     # warm the flow cache in connect-buffer-sized slices: a single
     # full-batch step has BATCH >> CONNECT_CAP misses and takes the
     # resolve-only fallback (nothing caches) — production flows
     # arrive gradually, which the sliced warmup models
     for i in range(0, BATCH, CONNECT_CAP):
-        _h, hit, box[0] = socklb_stage_jit(
+        _h, hit, _nb, box[0] = socklb_stage_jit(
             box[0], t, jhdr[i:i + CONNECT_CAP], now)
-    _h, hit, box[0] = socklb_stage_jit(box[0], t, jhdr, now)
+    _h, hit, _nb, box[0] = socklb_stage_jit(box[0], t, jhdr, now)
     jax.block_until_ready(hit)  # cache now holds every flow
 
     box[0], _acc = cached_loop(box[0], t, jhdr)  # compile

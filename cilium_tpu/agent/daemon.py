@@ -544,10 +544,13 @@ class Daemon:
 
                 if self._socklb is None:
                     self._socklb = SockLBTable.create()
-                hdr_dev, _hits, self._socklb = socklb_stage_jit(
-                    self._socklb, self.services.tensors(),
-                    jnp.asarray(np.ascontiguousarray(hdr_dev)),
-                    jnp.uint32(now))
+                hdr_dev, _hits, svc_nobe, self._socklb = \
+                    socklb_stage_jit(
+                        self._socklb, self.services.tensors(),
+                        jnp.asarray(np.ascontiguousarray(hdr_dev)),
+                        jnp.uint32(now))
+            else:
+                svc_nobe = None
             nat_drop = None
             if self.nat is not None:
                 # conntrack-aware egress SNAT with port allocation
@@ -557,6 +560,16 @@ class Daemon:
                 hdr_dev, nat_drop = self.loader.masquerade(
                     self.nat, hdr_dev, now)
             bw_reasons = self._bw_police(hdr_dev, now)
+            if svc_nobe is not None:
+                # frontend hit with no backend: DROP_NO_SERVICE.  The
+                # LB stage runs before bandwidth policing, so its
+                # reason wins on overlap
+                from ..datapath.verdict import REASON_NO_SERVICE
+                base = (bw_reasons if bw_reasons is not None
+                        else jnp.zeros(svc_nobe.shape[0],
+                                       dtype=jnp.uint32))
+                bw_reasons = jnp.where(
+                    svc_nobe, jnp.uint32(REASON_NO_SERVICE), base)
             out, row_map = self.loader.step(hdr_dev, now,
                                             pre_drop=nat_drop,
                                             pre_drop_reason=bw_reasons)

@@ -62,6 +62,7 @@ REASON_ROUTE_OVERFLOW = 3  # flow-router shard block overflow (RSS queue)
 REASON_NO_ENDPOINT = 4  # unregistered endpoint id (lxcmap miss)
 REASON_NAT_EXHAUSTED = 5  # SNAT port pool exhausted (DROP_NAT_NO_MAPPING)
 REASON_BANDWIDTH = 6  # egress rate limit (bandwidth manager / EDT)
+REASON_NO_SERVICE = 7  # service frontend with no backend (DROP_NO_SERVICE)
 N_REASONS = 8
 
 # Event types in the out tensor (monitor vocabulary).

@@ -38,6 +38,9 @@ DROP_REASON_DESC = {
     2: "POLICY_DENY_DEFAULT",
     3: "QUEUE_OVERFLOW",
     4: "UNKNOWN_ENDPOINT",  # lxcmap miss (unregistered endpoint id)
+    5: "NO_MAPPING_FOR_NAT_MASQUERADING",  # SNAT pool exhausted
+    6: "BANDWIDTH_LIMITED",  # egress rate limit (EDT analogue)
+    7: "NO_SERVICE",  # frontend with no backend (DROP_NO_SERVICE)
 }
 
 

@@ -73,13 +73,38 @@ def _meta_key(obj: dict) -> str:
 class ServiceWatcher:
     """Service + Endpoints objects -> ServiceManager entries.
 
-    One LB service per (k8s service, port): registry name
-    ``<ns>/<name>:<portname-or-number>``.  Either object may arrive
-    first; reconciliation runs on every event with whatever halves
-    exist (reference: pkg/k8s/watchers service+endpoints caches)."""
+    One LB entry per (k8s service, port, frontend): registry name
+    ``<ns>/<name>:<portname-or-number>`` for the clusterIP frontend,
+    with ``/nodeport``, ``/external/<ip>`` and ``/lb/<ip>`` suffixes
+    for the external frontend classes (reference: pkg/k8s/watchers
+    service+endpoints caches feeding pkg/service's frontend set).
 
-    def __init__(self, services):
+    Frontend classes (reference pkg/loadbalancer SVCType):
+
+    - ClusterIP (spec.clusterIP) — always, unless headless;
+    - NodePort (``node_ip``:spec.ports[].nodePort) for
+      type NodePort/LoadBalancer.  Divergence vs upstream: upstream
+      matches a nodePort on EVERY local address; here the frontend
+      compiles at the agent's configured ``node_ip`` only;
+    - ExternalIP (spec.externalIPs[]);
+    - LoadBalancer (status.loadBalancer.ingress[].ip).
+
+    ``externalTrafficPolicy: Local`` filters external frontends to
+    node-LOCAL backends, ``internalTrafficPolicy: Local`` does the
+    same for the clusterIP frontend (``is_local_ip`` decides — wired
+    to the endpoint registry).  A frontend whose filtered backend set
+    is EMPTY still installs: matching traffic must drop with
+    NO_SERVICE (upstream DROP_NO_SERVICE), not fall through to
+    routing.  ``sessionAffinity: ClientIP`` carries its timeout onto
+    every frontend of the service."""
+
+    def __init__(self, services, node_ip=None, local_ips=None):
         self.services = services  # ServiceManager
+        self.node_ip = node_ip
+        # () -> set of node-local pod IPs, snapshotted ONCE per
+        # reconcile (a per-ip predicate would rescan the endpoint
+        # registry ports x backends times per event)
+        self.local_ips = local_ips
         self._svc: Dict[str, dict] = {}
         self._eps: Dict[str, dict] = {}
         self._installed: Dict[str, set] = {}  # key -> LB names
@@ -113,29 +138,85 @@ class ServiceWatcher:
     def _reconcile(self, key: str) -> None:
         svc = self._svc.get(key)
         eps = self._eps.get(key)
-        wanted: Dict[str, Tuple[str, List[str], int]] = {}
-        if svc is not None and eps is not None:
+        wanted: Dict[str, Tuple[str, List[str], int, str, int]] = {}
+        local_set = None
+        if svc is not None:
             spec = svc.get("spec") or {}
+            stype = spec.get("type") or "ClusterIP"
             cluster_ip = spec.get("clusterIP")
-            if cluster_ip and cluster_ip != "None":  # headless: skip
-                for p in spec.get("ports") or ():
-                    pname = p.get("name") or str(p.get("port"))
-                    proto = _PROTO_NUM.get(p.get("protocol", "TCP"), 6)
-                    backends = self._backends(eps, p)
-                    if backends:
-                        wanted[f"{key}:{pname}"] = (
-                            f"{cluster_ip}:{p.get('port')}", backends,
-                            proto)
+            ext_local = spec.get("externalTrafficPolicy") == "Local"
+            int_local = spec.get("internalTrafficPolicy") == "Local"
+            if (ext_local or int_local) and self.local_ips is not None:
+                local_set = set(self.local_ips())
+            aff = 0
+            if spec.get("sessionAffinity") == "ClientIP":
+                aff = int(((spec.get("sessionAffinityConfig") or {})
+                           .get("clientIP") or {})
+                          .get("timeoutSeconds", 10800))
+            lb_ips = [ing.get("ip")
+                      for ing in ((svc.get("status") or {})
+                                  .get("loadBalancer") or {})
+                      .get("ingress") or () if ing.get("ip")]
+            for p in spec.get("ports") or ():
+                pname = p.get("name") or str(p.get("port"))
+                proto = _PROTO_NUM.get(p.get("protocol", "TCP"), 6)
+                backends = (self._backends(eps, p)
+                            if eps is not None else [])
+                local = (backends if local_set is None else
+                         [b for b in backends
+                          if b.rsplit(":", 1)[0] in local_set])
+                if cluster_ip and cluster_ip != "None":  # headless:
+                    wanted[f"{key}:{pname}"] = (  # no clusterIP fe
+                        f"{cluster_ip}:{p.get('port')}",
+                        local if int_local else backends,
+                        proto, "ClusterIP", aff)
+                ext_be = local if ext_local else backends
+                node_port = p.get("nodePort")
+                if (stype in ("NodePort", "LoadBalancer")
+                        and node_port and self.node_ip):
+                    wanted[f"{key}:{pname}/nodeport"] = (
+                        f"{self.node_ip}:{node_port}", ext_be,
+                        proto, "NodePort", aff)
+                for eip in spec.get("externalIPs") or ():
+                    wanted[f"{key}:{pname}/external/{eip}"] = (
+                        f"{eip}:{p.get('port')}", ext_be,
+                        proto, "ExternalIP", aff)
+                if stype == "LoadBalancer":
+                    for lip in lb_ips:
+                        wanted[f"{key}:{pname}/lb/{lip}"] = (
+                            f"{lip}:{p.get('port')}", ext_be,
+                            proto, "LoadBalancer", aff)
         have = self._installed.get(key, set())
         for name in have - set(wanted):
             self.services.delete(name)
-        for name, (frontend, backends, proto) in wanted.items():
+        cur = {s.name: s for s in self.services.list()}
+        for name, (frontend, backends, proto, kind,
+                   aff) in wanted.items():
+            c = cur.get(name)
+            if (c is not None and c.protocol == proto
+                    and c.kind == kind and c.affinity_timeout == aff
+                    and f"{c.frontend_ip}:{c.frontend_port}" == frontend
+                    and [f"{b.ip}:{b.port}" for b in c.backends]
+                    == backends):
+                continue  # unchanged: keep the compiled LB tensors
             self.services.upsert(name, frontend, backends,
-                                 protocol=proto)
+                                 protocol=proto, kind=kind,
+                                 affinity_timeout=aff)
         if wanted:
             self._installed[key] = set(wanted)
         else:  # fully withdrawn: don't grow an empty entry per
             self._installed.pop(key, None)  # ever-seen service
+
+    def resync(self) -> None:
+        """Endpoint churn: Local traffic policies re-filter their
+        backend sets against the endpoints now on this node (a pod
+        attaching after its Endpoints event must start receiving,
+        and vice versa)."""
+        for key, svc in list(self._svc.items()):
+            spec = svc.get("spec") or {}
+            if (spec.get("externalTrafficPolicy") == "Local"
+                    or spec.get("internalTrafficPolicy") == "Local"):
+                self._reconcile(key)
 
     @staticmethod
     def _backends(eps: dict, svc_port: dict) -> List[str]:
@@ -626,9 +707,11 @@ class LocalRedirectPolicyWatcher:
                 self.daemon.services.upsert(
                     svc, f"{spec['ip']}:{fport}", backends,
                     protocol=proto)
-            else:
+            elif svc in existing:
                 # no local backend (pod gone): withdraw rather than
-                # blackhole via a stale address
+                # blackhole via a stale address.  Only when actually
+                # installed — delete() invalidates the compiled LB
+                # tensors even on a no-op
                 self.daemon.services.delete(svc)
 
     def _uninstall(self, name: str) -> None:
@@ -678,7 +761,12 @@ class K8sWatcherHub:
         from . import CNPWatcher
 
         self.cnp = CNPWatcher(daemon.repo)
-        self.services = ServiceWatcher(daemon.services)
+        self.services = ServiceWatcher(
+            daemon.services, node_ip=daemon.config.node_ip,
+            local_ips=lambda: {ip for ep in daemon.endpoints.list()
+                               for ip in ep.ips})
+        daemon.endpoints.on_attach(
+            lambda _p: self.services.resync())
         self.pods = PodWatcher(daemon)
         self.namespaces = NamespaceWatcher(self.pods)
         self.pods.namespaces = self.namespaces

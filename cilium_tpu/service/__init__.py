@@ -123,14 +123,23 @@ class Service:
     frontend_port: int
     protocol: int = 6  # TCP
     backends: List[Backend] = field(default_factory=list)
+    # frontend class, for display + scope bookkeeping (reference:
+    # pkg/loadbalancer SVCType): ClusterIP | NodePort | ExternalIP |
+    # LoadBalancer | LocalRedirect
+    kind: str = "ClusterIP"
+    # sessionAffinity: ClientIP timeout in seconds (0 = disabled)
+    affinity_timeout: int = 0
 
     def to_dict(self) -> dict:
         return {
             "name": self.name,
             "frontend": f"{self.frontend_ip}:{self.frontend_port}",
             "protocol": self.protocol,
+            "kind": self.kind,
             "backends": [{"ip": b.ip, "port": b.port,
                           "weight": b.weight} for b in self.backends],
+            **({"sessionAffinityTimeout": self.affinity_timeout}
+               if self.affinity_timeout else {}),
         }
 
 
@@ -145,11 +154,13 @@ class LBTensors:
     maglev: jnp.ndarray  # [S, M] int32 -> backend table row (-1 none)
     backend_ip: jnp.ndarray  # [B] uint32
     backend_port: jnp.ndarray  # [B] uint32
+    svc_aff: jnp.ndarray  # [S] uint32 ClientIP affinity TTL (0 = off)
     m: int
 
     def tree_flatten(self):
         return ((self.svc_ip, self.svc_port, self.svc_proto,
-                 self.maglev, self.backend_ip, self.backend_port),
+                 self.maglev, self.backend_ip, self.backend_port,
+                 self.svc_aff),
                 self.m)
 
     @classmethod
@@ -168,15 +179,22 @@ class ServiceManager:
 
     def upsert(self, name: str, frontend: str, backends: Sequence[str],
                protocol: int = 6,
-               weights: Optional[Sequence[int]] = None) -> Service:
+               weights: Optional[Sequence[int]] = None,
+               kind: str = "ClusterIP",
+               affinity_timeout: int = 0) -> Service:
         """``frontend``/``backends`` are "ip:port" strings;
         ``weights`` (optional, parallel to ``backends``) drive the
-        weighted Maglev fill."""
+        weighted Maglev fill.  A service may carry ZERO backends: its
+        frontend still compiles, and matching traffic DROPS with
+        ``REASON_NO_SERVICE`` (upstream DROP_NO_SERVICE — a clusterIP
+        with no ready endpoint, or externalTrafficPolicy=Local with no
+        node-local backend, must not fall through to routing)."""
         fip, fport = frontend.rsplit(":", 1)
         if weights is not None and len(weights) != len(backends):
             raise ValueError("weights length != backends length")
         svc = Service(name=name, frontend_ip=fip,
                       frontend_port=int(fport), protocol=protocol,
+                      kind=kind, affinity_timeout=int(affinity_timeout),
                       backends=[
                           Backend(b.rsplit(":", 1)[0],
                                   int(b.rsplit(":", 1)[1]),
@@ -215,6 +233,7 @@ class ServiceManager:
         svc_ip = np.zeros(s, dtype=np.uint32)
         svc_port = np.zeros(s, dtype=np.uint32)
         svc_proto = np.zeros(s, dtype=np.uint32)
+        svc_aff = np.zeros(s, dtype=np.uint32)
         maglev = np.full((s, self.m), -1, dtype=np.int32)
         b_ip: List[int] = []
         b_port: List[int] = []
@@ -222,6 +241,7 @@ class ServiceManager:
             svc_ip[i] = int(ipaddress.IPv4Address(svc.frontend_ip))
             svc_port[i] = svc.frontend_port
             svc_proto[i] = svc.protocol
+            svc_aff[i] = svc.affinity_timeout
             base = len(b_ip)
             for be in svc.backends:
                 b_ip.append(int(ipaddress.IPv4Address(be.ip)))
@@ -240,18 +260,23 @@ class ServiceManager:
             backend_ip=jnp.asarray(np.asarray(b_ip, dtype=np.uint32)),
             backend_port=jnp.asarray(np.asarray(b_port,
                                                 dtype=np.uint32)),
+            svc_aff=jnp.asarray(svc_aff),
             m=self.m,
         )
 
 
-def lb_stage(t: LBTensors, hdr: jnp.ndarray) -> Tuple[jnp.ndarray,
-                                                      jnp.ndarray]:
+def lb_stage(t: LBTensors, hdr: jnp.ndarray
+             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Batched frontend match + Maglev select + DNAT rewrite.
 
-    Returns (hdr', is_service_hit [N] bool); hdr' has dst ip/port
-    rewritten to the selected backend for hits.  Composes BEFORE
-    datapath_step (reference: bpf/lib/lb.h runs before policy, so
-    policy applies to the backend, not the VIP)."""
+    Returns (hdr', is_service_hit [N] bool, no_backend [N] bool);
+    hdr' has dst ip/port rewritten to the selected backend for hits.
+    ``no_backend`` marks rows whose dst matched a frontend that has no
+    backend — upstream drops these with DROP_NO_SERVICE (a lookup
+    succeeding but selecting nothing must not fall through to
+    routing).  Composes BEFORE datapath_step (reference: bpf/lib/lb.h
+    runs before policy, so policy applies to the backend, not the
+    VIP)."""
     hdr = hdr.astype(jnp.uint32)
     dst = hdr[:, COL_DST_IP3]
     dport = hdr[:, COL_DPORT]
@@ -272,12 +297,13 @@ def lb_stage(t: LBTensors, hdr: jnp.ndarray) -> Tuple[jnp.ndarray,
     slot = (h % jnp.uint32(t.m)).astype(jnp.int32)
     be = t.maglev[svc, slot]
     have_backend = hit & (be >= 0)
+    no_backend = hit & (be < 0)
     be_safe = jnp.maximum(be, 0)
     new_dst = jnp.where(have_backend, t.backend_ip[be_safe], dst)
     new_dport = jnp.where(have_backend, t.backend_port[be_safe], dport)
     hdr = hdr.at[:, COL_DST_IP3].set(new_dst)
     hdr = hdr.at[:, COL_DPORT].set(new_dport)
-    return hdr, have_backend
+    return hdr, have_backend, no_backend
 
 
 lb_stage_jit = jax.jit(lb_stage)

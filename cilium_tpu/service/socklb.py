@@ -128,9 +128,14 @@ SOCK_CAND = 2
 
 
 def _resolve(t: LBTensors, hdr: jnp.ndarray
-             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                        jnp.ndarray]:
     """The connect-path resolution: frontend compare + Maglev.
-    -> (is_service [M], be_ip [M], be_port [M]) for each row."""
+    -> (is_service [M], no_backend [M], be_ip [M], be_port [M]) for
+    each row.  ``no_backend`` rows matched a frontend that selects
+    nothing (empty or fully-drained backend set) — they DROP upstream
+    (DROP_NO_SERVICE) and are deliberately NOT cached, so backends
+    appearing take effect on the very next batch."""
     dst = hdr[:, COL_DST_IP3]
     dport = hdr[:, COL_DPORT]
     proto = hdr[:, COL_PROTO]
@@ -147,17 +152,24 @@ def _resolve(t: LBTensors, hdr: jnp.ndarray
     slot = (h % jnp.uint32(t.m)).astype(jnp.int32)
     be = t.maglev[svc, slot]
     is_svc = hit & (be >= 0)
+    no_be = hit & (be < 0)
     be_safe = jnp.maximum(be, 0)
-    return is_svc, t.backend_ip[be_safe], t.backend_port[be_safe]
+    return is_svc, no_be, t.backend_ip[be_safe], t.backend_port[be_safe]
 
 
 def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
                  now: jnp.ndarray
-                 ) -> Tuple[jnp.ndarray, jnp.ndarray, SockLBTable]:
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                            SockLBTable]:
     """Flow-cached LB: probe -> compacted connect path for misses.
 
-    Returns (hdr', is_service_hit [N] bool, tbl') — drop-in for
-    :func:`lb_stage` plus the threaded table."""
+    Returns (hdr', is_service_hit [N] bool, no_backend [N] bool,
+    tbl') — drop-in for :func:`lb_stage` plus the threaded table.
+    ``no_backend`` rows (frontend hit, nothing to select — upstream
+    DROP_NO_SERVICE) ride the connect path every batch rather than
+    cache: upstream's connect(2) fails without creating a socket, so
+    a backend appearing must take effect immediately, not after a
+    negative entry expires."""
     hdr = hdr.astype(jnp.uint32)
     n = hdr.shape[0]
     P = tbl.capacity
@@ -241,7 +253,7 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
         comp = jnp.zeros(CONNECT_CAP, dtype=jnp.int32).at[pos].set(
             jnp.arange(n, dtype=jnp.int32), mode="drop")
         sub = hdr[comp]
-        is_svc, be_ip, be_port = _resolve(t, sub)
+        is_svc, no_be, be_ip, be_port = _resolve(t, sub)
         # rows beyond the real miss count are duplicates of row 0 in
         # `comp` (scatter default) — mask them out of the claim
         live = jnp.arange(CONNECT_CAP, dtype=jnp.uint32) < n_miss
@@ -261,7 +273,8 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
             jnp.zeros(CONNECT_CAP, dtype=jnp.uint32),
         ], axis=1).astype(jnp.uint32)
         ridx = jnp.arange(CONNECT_CAP, dtype=jnp.int32)
-        pending = live
+        # no_be rows never claim a slot (no caching — see module doc)
+        pending = live & ~no_be
         claim_fp = _fp_mix(ch)
         for step in range(SOCK_PROBE):
             s = ((ch + step) & mask).astype(jnp.int32)
@@ -295,15 +308,18 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
             be_port, mode="drop")
         r_svc = jnp.zeros(n, dtype=bool).at[comp_t].set(
             is_svc, mode="drop")
-        return (table, fp_arr), r_ip, r_port, r_svc & miss
+        r_nobe = jnp.zeros(n, dtype=bool).at[comp_t].set(
+            no_be, mode="drop")
+        return (table, fp_arr), r_ip, r_port, r_svc & miss, \
+            r_nobe & miss
 
     def connect_full(carry):
         # burst of new flows beyond the connect buffer: resolve every
         # row (no caching for this batch — correctness over cache)
-        is_svc, be_ip, be_port = _resolve(t, hdr)
-        return (carry, be_ip, be_port, is_svc & miss)
+        is_svc, no_be, be_ip, be_port = _resolve(t, hdr)
+        return (carry, be_ip, be_port, is_svc & miss, no_be & miss)
 
-    (table, fp_arr), r_ip, r_port, r_svc = jax.lax.cond(
+    (table, fp_arr), r_ip, r_port, r_svc, r_nobe = jax.lax.cond(
         n_miss <= CONNECT_CAP, connect_compact, connect_full,
         (table, fp_arr))
 
@@ -314,7 +330,7 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
                           jnp.where(r_svc, r_port, hdr[:, COL_DPORT]))
     hdr = hdr.at[:, COL_DST_IP3].set(new_dst)
     hdr = hdr.at[:, COL_DPORT].set(new_dport)
-    return hdr, svc_hit, SockLBTable(table=table, fp=fp_arr)
+    return hdr, svc_hit, r_nobe, SockLBTable(table=table, fp=fp_arr)
 
 
 socklb_stage_jit = jax.jit(socklb_stage, donate_argnums=0)

@@ -105,12 +105,16 @@ class TestServiceWatcher:
         hub.dispatch("update", _eps(ips=("10.0.2.1", "10.0.2.9")))
         [svc] = d.services.list()
         assert len(svc.backends) == 2
-        # no ready backends -> service withdrawn (matches upstream: a
-        # frontend with no backends drops, not blackholes, via LB miss)
+        # no ready backends -> the frontend STAYS with an empty
+        # backend set (r05: matching traffic drops with NO_SERVICE,
+        # upstream DROP_NO_SERVICE — withdrawal would let VIP traffic
+        # fall through to routing)
         hub.dispatch("update", _eps(ips=()))
-        assert len(d.services) == 0
+        [svc] = d.services.list()
+        assert svc.backends == []
         hub.dispatch("update", _eps(ips=("10.0.2.1",)))
-        assert len(d.services) == 1
+        [svc] = d.services.list()
+        assert len(svc.backends) == 1
         hub.dispatch("delete", _svc())
         assert len(d.services) == 0
 

@@ -88,7 +88,7 @@ class TestLBStage:
         rng = np.random.default_rng(0)
         vip = 0x0A60000A  # 10.96.0.10
         rows = _pkt_rows(256, vip, 80, rng)
-        out, hits = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
+        out, hits, _nb = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
         out = np.asarray(out)
         assert np.asarray(hits).all()
         # every packet now targets one of the three backends on 8080
@@ -111,7 +111,7 @@ class TestLBStage:
         mgr = self._mgr()
         rng = np.random.default_rng(2)
         rows = _pkt_rows(64, 0x0A000042, 80, rng)  # not a VIP
-        out, hits = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
+        out, hits, _nb = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
         assert not np.asarray(hits).any()
         np.testing.assert_array_equal(np.asarray(out), rows)
 
@@ -119,7 +119,7 @@ class TestLBStage:
         mgr = self._mgr()
         rng = np.random.default_rng(3)
         rows = _pkt_rows(16, 0x0A600035, 53, rng)  # dns VIP but TCP
-        out, hits = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
+        out, hits, _nb = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
         assert not np.asarray(hits).any()
 
     def test_vip_with_no_backends_passes_through(self):
@@ -127,7 +127,7 @@ class TestLBStage:
         mgr.upsert("empty", "10.96.0.99:80", [])
         rng = np.random.default_rng(4)
         rows = _pkt_rows(8, 0x0A600063, 80, rng)
-        out, hits = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
+        out, hits, _nb = lb_stage_jit(mgr.tensors(), jnp.asarray(rows))
         assert not np.asarray(hits).any()
 
 

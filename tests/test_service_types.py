@@ -1,0 +1,220 @@
+"""Service frontend parity (reference: pkg/service + pkg/k8s
+watchers service.go): NodePort / ExternalIP / LoadBalancer frontends,
+externalTrafficPolicy/internalTrafficPolicy Local backend filtering,
+sessionAffinity parsing, and DROP_NO_SERVICE for frontends whose
+backend set is empty.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.datapath.verdict import (REASON_FORWARDED,
+                                         REASON_NO_SERVICE)
+from cilium_tpu.k8s.watchers import ServiceWatcher
+from cilium_tpu.service import ServiceManager, lb_stage
+from cilium_tpu.service.socklb import SockLBTable, socklb_stage
+
+
+NODE_IP = "192.168.7.7"
+
+
+def _svc_obj(stype="ClusterIP", node_port=None, external_ips=(),
+             lb_ips=(), ext_policy=None, int_policy=None,
+             affinity=False, affinity_timeout=None):
+    spec = {
+        "type": stype,
+        "clusterIP": "172.20.0.10",
+        "ports": [{"port": 80, "protocol": "TCP", "targetPort": 8080,
+                   **({"nodePort": node_port} if node_port else {})}],
+    }
+    if external_ips:
+        spec["externalIPs"] = list(external_ips)
+    if ext_policy:
+        spec["externalTrafficPolicy"] = ext_policy
+    if int_policy:
+        spec["internalTrafficPolicy"] = int_policy
+    if affinity:
+        spec["sessionAffinity"] = "ClientIP"
+        if affinity_timeout is not None:
+            spec["sessionAffinityConfig"] = {
+                "clientIP": {"timeoutSeconds": affinity_timeout}}
+    obj = {"metadata": {"name": "web", "namespace": "default"},
+           "spec": spec}
+    if lb_ips:
+        obj["status"] = {"loadBalancer": {
+            "ingress": [{"ip": ip} for ip in lb_ips]}}
+    return obj
+
+
+def _eps_obj(ips=("10.0.1.1", "10.0.1.2")):
+    return {"metadata": {"name": "web", "namespace": "default"},
+            "subsets": [{
+                "addresses": [{"ip": ip} for ip in ips],
+                "ports": [{"port": 8080, "protocol": "TCP"}],
+            }]}
+
+
+def _watch(node_ip=NODE_IP, local_ips=()):
+    mgr = ServiceManager()
+    w = ServiceWatcher(mgr, node_ip=node_ip,
+                       local_ips=lambda: set(local_ips))
+    return mgr, w
+
+
+class TestFrontendClasses:
+    def test_nodeport_installs_node_ip_frontend(self):
+        mgr, w = _watch()
+        w.on_service_add(_svc_obj("NodePort", node_port=30080))
+        w.on_endpoints_add(_eps_obj())
+        by_kind = {s.kind: s for s in mgr.list()}
+        assert set(by_kind) == {"ClusterIP", "NodePort"}
+        np_svc = by_kind["NodePort"]
+        assert np_svc.frontend_ip == NODE_IP
+        assert np_svc.frontend_port == 30080
+        assert len(np_svc.backends) == 2
+        assert by_kind["ClusterIP"].frontend_port == 80
+
+    def test_no_node_ip_no_nodeport_frontend(self):
+        mgr, w = _watch(node_ip=None)
+        w.on_service_add(_svc_obj("NodePort", node_port=30080))
+        w.on_endpoints_add(_eps_obj())
+        assert {s.kind for s in mgr.list()} == {"ClusterIP"}
+
+    def test_external_ips_and_lb_ingress(self):
+        mgr, w = _watch()
+        w.on_service_add(_svc_obj(
+            "LoadBalancer", node_port=30080,
+            external_ips=("198.51.100.5",), lb_ips=("203.0.113.9",)))
+        w.on_endpoints_add(_eps_obj())
+        kinds = {s.kind: s for s in mgr.list()}
+        assert set(kinds) == {"ClusterIP", "NodePort", "ExternalIP",
+                              "LoadBalancer"}
+        assert kinds["ExternalIP"].frontend_ip == "198.51.100.5"
+        assert kinds["LoadBalancer"].frontend_ip == "203.0.113.9"
+        # all share port 80 except the nodeport
+        assert kinds["ExternalIP"].frontend_port == 80
+        assert kinds["LoadBalancer"].frontend_port == 80
+
+    def test_type_downgrade_withdraws_external_frontends(self):
+        mgr, w = _watch()
+        w.on_service_add(_svc_obj("NodePort", node_port=30080))
+        w.on_endpoints_add(_eps_obj())
+        assert len(mgr.list()) == 2
+        w.on_service_update(_svc_obj("ClusterIP"))
+        assert {s.kind for s in mgr.list()} == {"ClusterIP"}
+
+
+class TestTrafficPolicy:
+    def test_external_local_filters_to_node_local(self):
+        mgr, w = _watch(local_ips={"10.0.1.1"})
+        w.on_service_add(_svc_obj("NodePort", node_port=30080,
+                                  ext_policy="Local"))
+        w.on_endpoints_add(_eps_obj())
+        kinds = {s.kind: s for s in mgr.list()}
+        # nodeport frontend sees only the local backend
+        assert [b.ip for b in kinds["NodePort"].backends] == [
+            "10.0.1.1"]
+        # clusterIP frontend keeps the full set
+        assert len(kinds["ClusterIP"].backends) == 2
+
+    def test_internal_local_filters_cluster_ip(self):
+        mgr, w = _watch(local_ips={"10.0.1.2"})
+        w.on_service_add(_svc_obj(int_policy="Local"))
+        w.on_endpoints_add(_eps_obj())
+        (svc,) = mgr.list()
+        assert [b.ip for b in svc.backends] == ["10.0.1.2"]
+
+    def test_local_with_no_local_backend_installs_empty(self):
+        """upstream: externalTrafficPolicy=Local with zero local
+        backends DROPS nodeport traffic (health check reports the
+        node unready) — the frontend must exist and select nothing,
+        not be withdrawn."""
+        mgr, w = _watch(local_ips=set())
+        w.on_service_add(_svc_obj("NodePort", node_port=30080,
+                                  ext_policy="Local"))
+        w.on_endpoints_add(_eps_obj())
+        kinds = {s.kind: s for s in mgr.list()}
+        assert kinds["NodePort"].backends == []
+
+
+class TestSessionAffinityParse:
+    def test_affinity_timeout_default(self):
+        mgr, w = _watch()
+        w.on_service_add(_svc_obj(affinity=True))
+        w.on_endpoints_add(_eps_obj())
+        (svc,) = mgr.list()
+        assert svc.affinity_timeout == 10800  # k8s default
+
+    def test_affinity_timeout_explicit_reaches_tensors(self):
+        mgr, w = _watch()
+        w.on_service_add(_svc_obj(affinity=True, affinity_timeout=60))
+        w.on_endpoints_add(_eps_obj())
+        assert mgr.list()[0].affinity_timeout == 60
+        t = mgr.tensors()
+        assert int(np.asarray(t.svc_aff)[0]) == 60
+
+
+def _rows(n, dst, dport=80, sport0=43000):
+    return make_batch([
+        dict(src="10.0.9.9", dst=dst, sport=sport0 + i, dport=dport,
+             proto=6, flags=TCP_SYN, ep=1, dir=1)
+        for i in range(n)
+    ]).data
+
+
+class TestNoServiceDrop:
+    def test_lb_stage_reports_no_backend(self):
+        mgr = ServiceManager()
+        mgr.upsert("empty", "172.20.0.10:80", [])
+        hdr = _rows(8, "172.20.0.10")
+        out, hit, nobe = lb_stage(mgr.tensors(), jnp.asarray(hdr))
+        assert not bool(np.asarray(hit).any())
+        assert bool(np.asarray(nobe).all())
+        # dst untouched (nothing selected)
+        np.testing.assert_array_equal(np.asarray(out), hdr)
+        # non-frontend traffic is neither hit nor no-backend
+        _, hit2, nobe2 = lb_stage(mgr.tensors(),
+                                  jnp.asarray(_rows(4, "10.9.9.9")))
+        assert not bool(np.asarray(hit2).any())
+        assert not bool(np.asarray(nobe2).any())
+
+    def test_socklb_no_backend_not_cached(self):
+        """Backends appearing must take effect the NEXT batch — a
+        cached negative/drop entry would mask them for its TTL."""
+        mgr = ServiceManager()
+        mgr.upsert("web", "172.20.0.10:80", [])
+        tbl = SockLBTable.create(1 << 10)
+        hdr = jnp.asarray(_rows(8, "172.20.0.10"))
+        out, hit, nobe, tbl = socklb_stage(tbl, mgr.tensors(), hdr,
+                                           jnp.uint32(10))
+        assert bool(np.asarray(nobe).all())
+        assert not bool(np.asarray(hit).any())
+        # backends arrive; the very same flows now resolve
+        mgr.upsert("web", "172.20.0.10:80", ["10.0.1.1:8080"])
+        out, hit, nobe, tbl = socklb_stage(tbl, mgr.tensors(), hdr,
+                                           jnp.uint32(11))
+        assert bool(np.asarray(hit).all())
+        assert not bool(np.asarray(nobe).any())
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_daemon_drops_with_no_service_reason(self, backend):
+        d = Daemon(DaemonConfig(backend=backend,
+                                ct_capacity=1 << 12))
+        web = d.add_endpoint("web", ("10.0.9.9",), ["k8s:app=web"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            "egress": [{}],  # allow-all egress
+        }])
+        d.services.upsert("empty", "172.20.0.10:80", [])
+        ev = d.process_batch(_rows(16, "172.20.0.10"), now=50)
+        assert int((ev.reason == REASON_NO_SERVICE).sum()) == 16
+        # and a populated service forwards
+        d.services.upsert("web", "172.20.0.20:80",
+                          ["10.0.2.1:8080"])
+        d.upsert_ipcache("10.0.2.1/32", 4242)
+        ev = d.process_batch(_rows(16, "172.20.0.20", sport0=44000),
+                             now=51)
+        assert int((ev.reason == REASON_FORWARDED).sum()) == 16

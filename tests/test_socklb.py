@@ -42,9 +42,9 @@ class TestSockLB:
         m = _svcs()
         t = m.tensors()
         hdr = _flow_rows(64)
-        ref, ref_hit = lb_stage(t, jnp.asarray(hdr))
+        ref, ref_hit, _ = lb_stage(t, jnp.asarray(hdr))
         tbl = SockLBTable.create(1 << 10)
-        got, hit, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
+        got, hit, _nb, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
                                      jnp.uint32(10))
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
         np.testing.assert_array_equal(np.asarray(hit),
@@ -55,12 +55,12 @@ class TestSockLB:
         t = m.tensors()
         hdr = _flow_rows(32)
         tbl = SockLBTable.create(1 << 10)
-        first, _, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
+        first, _, _nb, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
                                      jnp.uint32(10))
         # same flows again (ACKs now): must hit the cache and produce
         # the same backends
         hdr2 = hdr.copy()
-        again, hit, tbl = socklb_stage(tbl, t, jnp.asarray(hdr2),
+        again, hit, _nb, tbl = socklb_stage(tbl, t, jnp.asarray(hdr2),
                                        jnp.uint32(20))
         np.testing.assert_array_equal(np.asarray(again),
                                       np.asarray(first))
@@ -70,20 +70,20 @@ class TestSockLB:
         m = _svcs(n_backends=3)
         hdr = _flow_rows(48)
         tbl = SockLBTable.create(1 << 10)
-        first, _, tbl = socklb_stage(tbl, m.tensors(), jnp.asarray(hdr),
+        first, _, _nb, tbl = socklb_stage(tbl, m.tensors(), jnp.asarray(hdr),
                                      jnp.uint32(10))
         first = np.asarray(first)
         # backend set changes: one backend drains away
         m.upsert("web", "172.16.0.10:80",
                  ["10.0.1.1:8080", "10.0.1.2:8080"])
-        again, _, tbl = socklb_stage(tbl, m.tensors(),
+        again, _, _nb, tbl = socklb_stage(tbl, m.tensors(),
                                      jnp.asarray(hdr.copy()),
                                      jnp.uint32(20))
         # cached flows keep their ORIGINAL backend (socket semantics)
         np.testing.assert_array_equal(np.asarray(again), first)
         # a NEW flow resolves against the new set only
         fresh = _flow_rows(8, sport0=55000)
-        out, _, tbl = socklb_stage(tbl, m.tensors(), jnp.asarray(fresh),
+        out, _, _nb, tbl = socklb_stage(tbl, m.tensors(), jnp.asarray(fresh),
                                    jnp.uint32(21))
         dsts = set(int(x) for x in np.asarray(out)[:, COL_DST_IP3])
         import ipaddress
@@ -97,12 +97,12 @@ class TestSockLB:
         t = m.tensors()
         hdr = _flow_rows(16, dst="203.0.113.7", dport=443)
         tbl = SockLBTable.create(1 << 10)
-        out, hit, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
+        out, hit, _nb, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
                                      jnp.uint32(10))
         np.testing.assert_array_equal(np.asarray(out), hdr)
         assert not np.asarray(hit).any()
         # second pass rides the (negative) cache — still pass-through
-        out2, hit2, tbl = socklb_stage(tbl, t, jnp.asarray(hdr.copy()),
+        out2, hit2, _nb, tbl = socklb_stage(tbl, t, jnp.asarray(hdr.copy()),
                                        jnp.uint32(20))
         np.testing.assert_array_equal(np.asarray(out2), hdr)
         assert not np.asarray(hit2).any()
@@ -115,9 +115,9 @@ class TestSockLB:
         n = mod.CONNECT_CAP + 512  # every row a new flow: burst path
         hdr = np.asarray(_flow_rows(1)).repeat(n, axis=0)
         hdr[:, COL_SPORT] = 20000 + np.arange(n)
-        ref, _ = lb_stage(t, jnp.asarray(hdr))
+        ref, _, _ = lb_stage(t, jnp.asarray(hdr))
         tbl = SockLBTable.create(1 << 15)
-        got, hit, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
+        got, hit, _nb, tbl = socklb_stage(tbl, t, jnp.asarray(hdr),
                                      jnp.uint32(10))
         np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
         assert np.asarray(hit).all()
