@@ -188,6 +188,7 @@ class Daemon:
         # connect-time LB flow cache (service/socklb.py, the bpf_sock
         # analogue): created on first service traffic
         self._socklb = None
+        self._svc_version_seen = None  # affinity prune bookkeeping
         # egress masquerade (applies after LB, before the datapath, so
         # CT tracks the post-NAT tuple)
         self.nat = None
@@ -544,6 +545,16 @@ class Daemon:
 
                 if self._socklb is None:
                     self._socklb = SockLBTable.create()
+                svc_ver = self.services.version
+                if self._svc_version_seen != svc_ver:
+                    # backend-set change: expire ClientIP affinity
+                    # pins whose backend no longer exists anywhere.
+                    # Gated on affinity actually being in use — the
+                    # sweep pays a d2h fetch of the pin table
+                    if self.services.any_affinity:
+                        self._socklb = self._socklb.prune_affinity(
+                            self.services.backend_set())
+                    self._svc_version_seen = svc_ver
                 hdr_dev, _hits, svc_nobe, self._socklb = \
                     socklb_stage_jit(
                         self._socklb, self.services.tensors(),
@@ -560,19 +571,13 @@ class Daemon:
                 hdr_dev, nat_drop = self.loader.masquerade(
                     self.nat, hdr_dev, now)
             bw_reasons = self._bw_police(hdr_dev, now)
-            if svc_nobe is not None:
-                # frontend hit with no backend: DROP_NO_SERVICE.  The
-                # LB stage runs before bandwidth policing, so its
-                # reason wins on overlap
-                from ..datapath.verdict import REASON_NO_SERVICE
-                base = (bw_reasons if bw_reasons is not None
-                        else jnp.zeros(svc_nobe.shape[0],
-                                       dtype=jnp.uint32))
-                bw_reasons = jnp.where(
-                    svc_nobe, jnp.uint32(REASON_NO_SERVICE), base)
+            # svc_nobe (frontend hit, no backend) rides the dedicated
+            # lb_drop channel: upstream's LB lookup runs BEFORE the
+            # endpoint program, so NO_SERVICE wins over policy too
             out, row_map = self.loader.step(hdr_dev, now,
                                             pre_drop=nat_drop,
-                                            pre_drop_reason=bw_reasons)
+                                            pre_drop_reason=bw_reasons,
+                                            lb_drop=svc_nobe)
             if self.nat is not None:
                 # reverse translation AFTER the verdict (CT/policy see
                 # the wire tuple; delivery + events see the restored

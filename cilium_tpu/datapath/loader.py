@@ -45,7 +45,7 @@ class Loader(abc.ABC):
 
     @abc.abstractmethod
     def step(self, hdr: np.ndarray, now: int, pre_drop=None,
-             pre_drop_reason=None):
+             pre_drop_reason=None, lb_drop=None):
         """Verdict one batch.
 
         Returns ``(out, row_map)``: the out tensor [N, N_OUT] plus the
@@ -151,7 +151,7 @@ class TPULoader(Loader):
             self.attach_count += 1
 
     def step(self, hdr, now: int, pre_drop=None,
-             pre_drop_reason=None):
+             pre_drop_reason=None, lb_drop=None):
         """``hdr`` may be a numpy array OR an already-on-device jax
         array (the LB stage hands its output over without a host
         round trip).  ``pre_drop`` is the SNAT stage's exhaustion
@@ -166,7 +166,7 @@ class TPULoader(Loader):
         with self._lock:
             out, self.state = datapath_step_jit(
                 self.state, hdr, jnp.uint32(now), pre_drop=pre_drop,
-                pre_drop_reason=pre_drop_reason)
+                pre_drop_reason=pre_drop_reason, lb_drop=lb_drop)
             row_map = self.row_map
         return np.asarray(out), row_map
 
@@ -481,14 +481,16 @@ class InterpreterLoader(Loader):
         self.attach_count += 1
 
     def step(self, hdr: np.ndarray, now: int, pre_drop=None,
-             pre_drop_reason=None):
+             pre_drop_reason=None, lb_drop=None):
         from ..core.packets import HeaderBatch, COL_DIR
         from .verdict import N_OUT
 
         results = self.oracle.step(
             HeaderBatch(np.asarray(hdr)), now, pre_drop=pre_drop,
             pre_drop_reason=(None if pre_drop_reason is None
-                             else np.asarray(pre_drop_reason)))
+                             else np.asarray(pre_drop_reason)),
+            lb_drop=(None if lb_drop is None
+                     else np.asarray(lb_drop)))
         out = np.zeros((len(results), N_OUT), dtype=np.uint32)
         for i, r in enumerate(results):
             out[i] = (r.verdict, r.proxy, r.ct,

@@ -147,7 +147,8 @@ class DatapathState:
 def datapath_step(state: DatapathState, hdr: jnp.ndarray,
                   now: jnp.ndarray, valid: jnp.ndarray = None,
                   pre_drop: jnp.ndarray = None,
-                  pre_drop_reason: jnp.ndarray = None
+                  pre_drop_reason: jnp.ndarray = None,
+                  lb_drop: jnp.ndarray = None
                   ) -> Tuple[jnp.ndarray, DatapathState]:
     """One batched pass of the full verdict pipeline (see module doc).
 
@@ -166,7 +167,14 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     ``pre_drop_reason`` (optional [N] uint32, 0 = none) is the
     generalized form: rows carry their own REASON_* code (today the
     bandwidth manager's ``REASON_BANDWIDTH``), with the same
-    precedence and CT semantics as ``pre_drop``."""
+    precedence and CT semantics as ``pre_drop``.
+
+    ``lb_drop`` (optional [N] bool) marks LB frontend hits with no
+    backend.  Unlike the two channels above this is a PRE-policy
+    drop: upstream's LB lookup (bpf/lib/lb.h, bpf_sock) runs before
+    the endpoint program ever judges the packet, so these rows report
+    ``REASON_NO_SERVICE`` regardless of what policy (or the lxcmap
+    gate) would have said, and touch no CT state."""
     hdr = hdr.astype(jnp.uint32)
     dirn = hdr[:, COL_DIR].astype(jnp.int32)
     fam = hdr[:, COL_FAMILY].astype(jnp.int32)
@@ -251,6 +259,12 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
         verdict = jnp.where(stage_drop, VERDICT_DENY, verdict)
         reason = jnp.where(stage_drop, pre_drop_reason, reason)
         proxy = jnp.where(stage_drop, 0, proxy)
+    if lb_drop is not None:
+        # pre-policy: wins over policy/no_ep/NAT/bandwidth reasons
+        allowed = allowed & ~lb_drop
+        verdict = jnp.where(lb_drop, VERDICT_DENY, verdict)
+        reason = jnp.where(lb_drop, REASON_NO_SERVICE, reason)
+        proxy = jnp.where(lb_drop, 0, proxy)
 
     # 5. conntrack create/refresh (create only on allowed NEW; related
     #    rows neither create nor refresh — the ICMP error is evidence
@@ -260,6 +274,8 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
         untouched = untouched | nat_drop  # dropped rows refresh nothing
     if stage_drop is not None:
         untouched = untouched | stage_drop
+    if lb_drop is not None:
+        untouched = untouched | lb_drop
     ct = ct_update(state.ct, hdr, fwd,
                    jnp.where(untouched, CT_NEW, ct_res), slot,
                    is_reply,

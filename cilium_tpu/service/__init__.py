@@ -176,6 +176,7 @@ class ServiceManager:
         self._services: Dict[str, Service] = {}
         self.m = m
         self._tensors: Optional[LBTensors] = None
+        self._version = 0  # bumps on any upsert/delete (see .version)
 
     def upsert(self, name: str, frontend: str, backends: Sequence[str],
                protocol: int = 6,
@@ -204,13 +205,41 @@ class ServiceManager:
         with self._lock:
             self._services[name] = svc
             self._tensors = None
+            self._version += 1
         return svc
 
     def delete(self, name: str) -> bool:
         with self._lock:
             gone = self._services.pop(name, None) is not None
-            self._tensors = None
+            if gone:
+                self._tensors = None
+                self._version += 1
         return gone
+
+    @property
+    def version(self) -> int:
+        """Monotone change counter — consumers holding derived state
+        (the daemon's ClientIP affinity prune) compare against it."""
+        with self._lock:
+            return self._version
+
+    def backend_set(self) -> set:
+        """The live (ip, port) backend universe, for affinity
+        pruning (a cached affinity entry steering NEW flows to a
+        backend no service references must die with the backend)."""
+        with self._lock:
+            return {(int(ipaddress.IPv4Address(b.ip)), b.port)
+                    for s in self._services.values()
+                    for b in s.backends}
+
+    @property
+    def any_affinity(self) -> bool:
+        """True when any installed service pins ClientIP affinity —
+        gates the daemon's prune sweep (an all-zero affinity table
+        need not ride device->host on every Endpoints churn)."""
+        with self._lock:
+            return any(s.affinity_timeout
+                       for s in self._services.values())
 
     def __len__(self) -> int:
         with self._lock:

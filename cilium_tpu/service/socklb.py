@@ -74,6 +74,20 @@ SK_PAD = 7
 
 NO_BACKEND = 0xFFFFFFFF
 
+# -- sessionAffinity: ClientIP sub-table (reference: the lb4/lb6
+# affinity BPF maps keyed {svc, client-ip} consulted at socket-LB
+# connect time).  Key here = (client src ip, frontend vip,
+# dport<<8|proto); value = the pinned backend + expiry.
+AFF_WORDS = 8
+AF_SRC = 0
+AF_VIP = 1
+AF_DP = 2
+AF_BE_IP = 3
+AF_BE_PORT = 4
+AF_EXPIRES = 5
+AFF_PROBE = 8
+AFF_SALT = 0x5EED_AFF1  # keyed apart from the flow-cache hash
+
 
 @jax.tree_util.register_pytree_node_class
 @dataclass
@@ -88,25 +102,53 @@ class SockLBTable:
 
     table: jnp.ndarray  # [P, ROW_WORDS] uint32
     fp: jnp.ndarray  # [P] uint32 — key fingerprint, 0 = free
+    aff: jnp.ndarray  # [A, AFF_WORDS] uint32 ClientIP affinity rows
 
     @staticmethod
-    def create(capacity: int = SOCK_DEFAULT_CAPACITY) -> "SockLBTable":
+    def create(capacity: int = SOCK_DEFAULT_CAPACITY,
+               aff_capacity: int = None) -> "SockLBTable":
         if capacity & (capacity - 1):
             raise ValueError("socklb capacity must be a power of two")
+        a = aff_capacity if aff_capacity is not None else capacity
+        if a & (a - 1):
+            raise ValueError("affinity capacity must be a power of two")
         return SockLBTable(table=jnp.zeros((capacity, ROW_WORDS),
                                            dtype=jnp.uint32),
-                           fp=jnp.zeros((capacity,), dtype=jnp.uint32))
+                           fp=jnp.zeros((capacity,), dtype=jnp.uint32),
+                           aff=jnp.zeros((a, AFF_WORDS),
+                                         dtype=jnp.uint32))
 
     @property
     def capacity(self) -> int:
         return self.table.shape[0]
 
     def tree_flatten(self):
-        return ((self.table, self.fp), None)
+        return ((self.table, self.fp, self.aff), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         return cls(*children)
+
+    def prune_affinity(self, valid_backends: set) -> "SockLBTable":
+        """Host-side sweep: expire affinity rows whose pinned backend
+        no longer exists in ANY service (reference: upstream validates
+        the affinity backend against the backend map on lookup and
+        falls back to reselection).  Run on service-set changes — the
+        device path deliberately skips the per-row [M, B] membership
+        compare."""
+        a = np.asarray(self.aff).copy()
+        live = a[:, AF_EXPIRES] > 0
+        if not live.any():
+            return self
+        packed = ((a[:, AF_BE_IP].astype(np.uint64) << 32)
+                  | a[:, AF_BE_PORT].astype(np.uint64))
+        valid = np.asarray(
+            [(int(ip) << 32) | int(port)
+             for ip, port in valid_backends], dtype=np.uint64)
+        keep = np.isin(packed, valid)
+        a[live & ~keep, AF_EXPIRES] = 0
+        return SockLBTable(table=self.table, fp=self.fp,
+                           aff=jnp.asarray(a))
 
 
 def _hash(words: jnp.ndarray) -> jnp.ndarray:
@@ -129,13 +171,15 @@ SOCK_CAND = 2
 
 def _resolve(t: LBTensors, hdr: jnp.ndarray
              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
-                        jnp.ndarray]:
+                        jnp.ndarray, jnp.ndarray]:
     """The connect-path resolution: frontend compare + Maglev.
-    -> (is_service [M], no_backend [M], be_ip [M], be_port [M]) for
-    each row.  ``no_backend`` rows matched a frontend that selects
-    nothing (empty or fully-drained backend set) — they DROP upstream
-    (DROP_NO_SERVICE) and are deliberately NOT cached, so backends
-    appearing take effect on the very next batch."""
+    -> (is_service [M], no_backend [M], be_ip [M], be_port [M],
+    aff_ttl [M]) for each row.  ``no_backend`` rows matched a
+    frontend that selects nothing (empty or fully-drained backend
+    set) — they DROP upstream (DROP_NO_SERVICE) and are deliberately
+    NOT cached, so backends appearing take effect on the very next
+    batch.  ``aff_ttl`` is the matched service's sessionAffinity
+    ClientIP timeout (0 = affinity off)."""
     dst = hdr[:, COL_DST_IP3]
     dport = hdr[:, COL_DPORT]
     proto = hdr[:, COL_PROTO]
@@ -154,7 +198,31 @@ def _resolve(t: LBTensors, hdr: jnp.ndarray
     is_svc = hit & (be >= 0)
     no_be = hit & (be < 0)
     be_safe = jnp.maximum(be, 0)
-    return is_svc, no_be, t.backend_ip[be_safe], t.backend_port[be_safe]
+    aff_ttl = jnp.where(hit, t.svc_aff[svc], 0).astype(jnp.uint32)
+    return (is_svc, no_be, t.backend_ip[be_safe],
+            t.backend_port[be_safe], aff_ttl)
+
+
+def _aff_probe(aff_tbl: jnp.ndarray, src: jnp.ndarray,
+               vip: jnp.ndarray, dp: jnp.ndarray, now: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Window-probe the ClientIP affinity table for (client, frontend)
+    rows.  -> (found [M], row [M, AFF_WORDS], hash [M])."""
+    amask = aff_tbl.shape[0] - 1
+    akey = jnp.stack([src, vip, dp,
+                      jnp.full_like(src, AFF_SALT)], axis=1)
+    ah = _hash(akey)
+    awin = ((ah[:, None] + jnp.arange(AFF_PROBE, dtype=jnp.uint32))
+            & amask).astype(jnp.int32)
+    arows = aff_tbl[awin]  # [M, K, W]
+    amatch = ((arows[..., AF_SRC] == src[:, None])
+              & (arows[..., AF_VIP] == vip[:, None])
+              & (arows[..., AF_DP] == dp[:, None])
+              & (arows[..., AF_EXPIRES] >= now))
+    found = jnp.any(amatch, axis=1)
+    col = jnp.argmax(amatch, axis=1)
+    slot = jnp.take_along_axis(awin, col[:, None], axis=1)[:, 0]
+    return found, aff_tbl[slot], ah
 
 
 def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
@@ -247,13 +315,24 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
     n_miss = jnp.sum(miss)
 
     def connect_compact(carry):
-        table, fp_arr = carry
+        table, fp_arr, aff_arr = carry
         # compact miss rows into the fixed connect buffer
         pos = jnp.where(miss, jnp.cumsum(miss) - 1, CONNECT_CAP)
         comp = jnp.zeros(CONNECT_CAP, dtype=jnp.int32).at[pos].set(
             jnp.arange(n, dtype=jnp.int32), mode="drop")
         sub = hdr[comp]
-        is_svc, no_be, be_ip, be_port = _resolve(t, sub)
+        is_svc, no_be, be_ip, be_port, aff_ttl = _resolve(t, sub)
+        # sessionAffinity: a live (client, frontend) pin overrides the
+        # Maglev selection (reference: lb4_affinity consulted before
+        # backend selection in the sock path)
+        a_src = sub[:, COL_SRC_IP3]
+        a_vip = sub[:, COL_DST_IP3]  # pre-rewrite dst IS the vip
+        a_dp = (sub[:, COL_DPORT] << 8) | sub[:, COL_PROTO]
+        afound, arow, ah = _aff_probe(aff_arr, a_src, a_vip, a_dp,
+                                      now)
+        use_aff = is_svc & (aff_ttl > 0) & afound
+        be_ip = jnp.where(use_aff, arow[:, AF_BE_IP], be_ip)
+        be_port = jnp.where(use_aff, arow[:, AF_BE_PORT], be_port)
         # rows beyond the real miss count are duplicates of row 0 in
         # `comp` (scatter default) — mask them out of the claim
         live = jnp.arange(CONNECT_CAP, dtype=jnp.uint32) < n_miss
@@ -298,6 +377,39 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
                             & (back[:, SK_VIP] == ck[:, 2])
                             & (back[:, SK_DP] == ck[:, 3]))
             pending = pending & ~won
+        # claim/refresh affinity pins for affinity-enabled service
+        # rows (write-then-verify like the flow claim; a row whose
+        # key already lives in the window overwrites it in place —
+        # that IS the expiry refresh).  Two same-client first
+        # connects in one batch: the lowest connect row's backend
+        # wins the pin; see DIVERGENCES #22
+        amask_c = aff_arr.shape[0] - 1
+        a_new = jnp.stack([
+            a_src, a_vip, a_dp, be_ip, be_port, now + aff_ttl,
+            jnp.zeros(CONNECT_CAP, dtype=jnp.uint32),
+            jnp.zeros(CONNECT_CAP, dtype=jnp.uint32),
+        ], axis=1).astype(jnp.uint32)
+        A = aff_arr.shape[0]
+        a_pending = live & is_svc & (aff_ttl > 0)
+        for step in range(AFF_PROBE):
+            s = ((ah + step) & amask_c).astype(jnp.int32)
+            stored = aff_arr[s]
+            same = ((stored[:, AF_SRC] == a_src)
+                    & (stored[:, AF_VIP] == a_vip)
+                    & (stored[:, AF_DP] == a_dp))
+            claimable = (stored[:, AF_EXPIRES] < now) | same
+            trying = a_pending & claimable
+            rows_t = jnp.where(trying, s, A)
+            owner = jnp.full((A + 1,), CONNECT_CAP, dtype=jnp.int32
+                             ).at[rows_t].min(ridx, mode="drop")
+            writer = trying & (owner[s] == ridx)
+            wt = jnp.where(writer, s, A)
+            aff_arr = aff_arr.at[wt].set(a_new, mode="drop")
+            back = aff_arr[s]
+            won = trying & ((back[:, AF_SRC] == a_src)
+                            & (back[:, AF_VIP] == a_vip)
+                            & (back[:, AF_DP] == a_dp))
+            a_pending = a_pending & ~won
         # scatter resolutions back to batch rows; DEAD slots (comp
         # defaulted to row 0) must scatter out of bounds, not onto
         # row 0 — duplicate scatter indices have unspecified order
@@ -310,18 +422,24 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
             is_svc, mode="drop")
         r_nobe = jnp.zeros(n, dtype=bool).at[comp_t].set(
             no_be, mode="drop")
-        return (table, fp_arr), r_ip, r_port, r_svc & miss, \
-            r_nobe & miss
+        return (table, fp_arr, aff_arr), r_ip, r_port, \
+            r_svc & miss, r_nobe & miss
 
     def connect_full(carry):
         # burst of new flows beyond the connect buffer: resolve every
-        # row (no caching for this batch — correctness over cache)
-        is_svc, no_be, be_ip, be_port = _resolve(t, hdr)
+        # row (no caching for this batch — correctness over cache;
+        # affinity pins are READ but not claimed)
+        is_svc, no_be, be_ip, be_port, aff_ttl = _resolve(t, hdr)
+        afound, arow, _ah = _aff_probe(carry[2], src, dst, dp, now)
+        use_aff = is_svc & (aff_ttl > 0) & afound
+        be_ip = jnp.where(use_aff, arow[:, AF_BE_IP], be_ip)
+        be_port = jnp.where(use_aff, arow[:, AF_BE_PORT], be_port)
         return (carry, be_ip, be_port, is_svc & miss, no_be & miss)
 
-    (table, fp_arr), r_ip, r_port, r_svc, r_nobe = jax.lax.cond(
-        n_miss <= CONNECT_CAP, connect_compact, connect_full,
-        (table, fp_arr))
+    (table, fp_arr, aff_arr), r_ip, r_port, r_svc, r_nobe = \
+        jax.lax.cond(
+            n_miss <= CONNECT_CAP, connect_compact, connect_full,
+            (table, fp_arr, tbl.aff))
 
     svc_hit = (cached & (c_be_port != jnp.uint32(NO_BACKEND))) | r_svc
     new_dst = jnp.where(cached & (c_be_port != jnp.uint32(NO_BACKEND)), c_be_ip,
@@ -330,7 +448,8 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
                           jnp.where(r_svc, r_port, hdr[:, COL_DPORT]))
     hdr = hdr.at[:, COL_DST_IP3].set(new_dst)
     hdr = hdr.at[:, COL_DPORT].set(new_dport)
-    return hdr, svc_hit, r_nobe, SockLBTable(table=table, fp=fp_arr)
+    return hdr, svc_hit, r_nobe, SockLBTable(table=table, fp=fp_arr,
+                                             aff=aff_arr)
 
 
 socklb_stage_jit = jax.jit(socklb_stage, donate_argnums=0)

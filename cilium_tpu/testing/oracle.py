@@ -145,15 +145,21 @@ class OracleDatapath:
 
     def step(self, batch: HeaderBatch, now: int,
              pre_drop=None,
-             pre_drop_reason=None) -> List[OracleResult]:
+             pre_drop_reason=None,
+             lb_drop=None) -> List[OracleResult]:
         """``pre_drop`` ([N] bool) marks rows the SNAT stage condemned
         (pool exhaustion).  Policy/lxcmap drops keep precedence
         (upstream order: bpf_lxc judges before host SNAT); rows that
         would otherwise forward drop with REASON_NAT_EXHAUSTED and
         neither create nor refresh CT.  ``pre_drop_reason`` ([N]
         uint32, 0 = none) is the generalized per-row form (bandwidth
-        manager), same precedence and CT semantics."""
-        from ..datapath.verdict import REASON_NAT_EXHAUSTED
+        manager), same precedence and CT semantics.  ``lb_drop``
+        ([N] bool) is the PRE-policy LB no-backend drop
+        (REASON_NO_SERVICE): upstream's LB lookup runs before the
+        endpoint program, so it wins over policy AND the lxcmap
+        gate, and touches no CT state."""
+        from ..datapath.verdict import (REASON_NAT_EXHAUSTED,
+                                        REASON_NO_SERVICE)
 
         results: List[OracleResult] = []
         updates: List[Tuple[tuple, np.ndarray, bool, int, int]] = []
@@ -189,6 +195,16 @@ class OracleDatapath:
                 else:
                     ct_res, entry = CT_NEW, None
 
+            if lb_drop is not None and bool(lb_drop[i]):
+                # LB ran before policy (bpf/lib/lb.h): a frontend hit
+                # with no backend drops NO_SERVICE regardless of the
+                # policy/lxcmap verdict, creating/refreshing nothing
+                results.append(OracleResult(
+                    VERDICT_DENY, 0, ct_res, ident,
+                    REASON_NO_SERVICE, EV_DROP))
+                updates.append((fwd, row, is_reply, CT_NEW, 0, False,
+                                related))
+                continue
             pol = self.ep_policies.get(int(row[COL_EP]))
             if pol is None:
                 # lxcmap miss: unregistered endpoint -> drop, CT

@@ -1,0 +1,173 @@
+"""sessionAffinity: ClientIP (reference: the lb4 affinity BPF maps +
+bpf_sock connect-time lookup): new flows from a client that already
+holds a pin follow the pinned backend instead of Maglev; pins expire
+by TTL, refresh on new connects, and die with their backend
+(DIVERGENCES #22).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.core.packets import COL_DST_IP3, COL_DPORT
+from cilium_tpu.service import ServiceManager, lb_stage
+from cilium_tpu.service.socklb import SockLBTable, socklb_stage
+
+VIP = "172.16.0.10"
+BACKENDS = [f"10.0.1.{i + 1}:8080" for i in range(4)]
+
+
+def _mgr(aff=60, backends=BACKENDS):
+    m = ServiceManager()
+    m.upsert("web", f"{VIP}:80", backends, affinity_timeout=aff)
+    return m
+
+
+def _row(sport, src="10.0.9.9", dst=VIP):
+    return make_batch([
+        dict(src=src, dst=dst, sport=sport, dport=80, proto=6,
+             flags=TCP_SYN, ep=1, dir=1)
+    ]).data
+
+
+def _backend_of(out):
+    return (int(np.asarray(out)[0, COL_DST_IP3]),
+            int(np.asarray(out)[0, COL_DPORT]))
+
+
+def _divergent_sports(t):
+    """Find two sports whose Maglev choices differ (so affinity has
+    something to prove)."""
+    base = None
+    for sp in range(41000, 41200):
+        out, hit, _ = lb_stage(t, jnp.asarray(_row(sp)))
+        assert bool(np.asarray(hit)[0])
+        be = _backend_of(out)
+        if base is None:
+            base = (sp, be)
+        elif be != base[1]:
+            return base[0], sp, base[1], be
+    raise AssertionError("Maglev sent 200 sports to one backend")
+
+
+class TestClientIPAffinity:
+    def test_second_flow_follows_pin(self):
+        m = _mgr()
+        t = m.tensors()
+        s1, s2, be1, be2 = _divergent_sports(t)
+        tbl = SockLBTable.create(1 << 10)
+        out, _, _, tbl = socklb_stage(tbl, t, jnp.asarray(_row(s1)),
+                                      jnp.uint32(100))
+        assert _backend_of(out) == be1
+        # a DIFFERENT flow from the same client would Maglev to be2 —
+        # the pin steers it to be1
+        out, _, _, tbl = socklb_stage(tbl, t, jnp.asarray(_row(s2)),
+                                      jnp.uint32(101))
+        assert _backend_of(out) == be1
+
+    def test_no_affinity_service_not_pinned(self):
+        m = _mgr(aff=0)
+        t = m.tensors()
+        s1, s2, be1, be2 = _divergent_sports(t)
+        tbl = SockLBTable.create(1 << 10)
+        out, _, _, tbl = socklb_stage(tbl, t, jnp.asarray(_row(s1)),
+                                      jnp.uint32(100))
+        out, _, _, tbl = socklb_stage(tbl, t, jnp.asarray(_row(s2)),
+                                      jnp.uint32(101))
+        assert _backend_of(out) == be2  # pure Maglev
+        # and the affinity table stayed empty
+        assert int(np.asarray(tbl.aff).sum()) == 0
+
+    def test_pin_expires_after_ttl(self):
+        m = _mgr(aff=60)
+        t = m.tensors()
+        s1, s2, be1, be2 = _divergent_sports(t)
+        tbl = SockLBTable.create(1 << 10)
+        out, _, _, tbl = socklb_stage(tbl, t, jnp.asarray(_row(s1)),
+                                      jnp.uint32(100))
+        # 200s later (pin TTL 60): a new flow re-selects via Maglev
+        out, _, _, tbl = socklb_stage(tbl, t, jnp.asarray(_row(s2)),
+                                      jnp.uint32(300))
+        assert _backend_of(out) == be2
+
+    def test_new_connect_refreshes_pin(self):
+        m = _mgr(aff=60)
+        t = m.tensors()
+        s1, s2, be1, be2 = _divergent_sports(t)
+        tbl = SockLBTable.create(1 << 10)
+        out, _, _, tbl = socklb_stage(tbl, t, jnp.asarray(_row(s1)),
+                                      jnp.uint32(100))
+        # t=150: second connect rides (and refreshes) the pin
+        out, _, _, tbl = socklb_stage(tbl, t, jnp.asarray(_row(s2)),
+                                      jnp.uint32(150))
+        assert _backend_of(out) == be1
+        # t=190: inside the REFRESHED window (150+60), outside the
+        # original (100+60) — still pinned
+        out, _, _, tbl = socklb_stage(
+            tbl, t, jnp.asarray(_row(s2 + 1)), jnp.uint32(190))
+        assert _backend_of(out) == be1
+
+    def test_prune_drops_dead_backend_pins(self):
+        m = _mgr(aff=600)
+        t = m.tensors()
+        s1, s2, be1, be2 = _divergent_sports(t)
+        tbl = SockLBTable.create(1 << 10)
+        out, _, _, tbl = socklb_stage(tbl, t, jnp.asarray(_row(s1)),
+                                      jnp.uint32(100))
+        # the pinned backend leaves the service
+        survivors = [b for b in BACKENDS if not _packed_eq(b, be1)]
+        m.upsert("web", f"{VIP}:80", survivors, affinity_timeout=600)
+        tbl = tbl.prune_affinity(m.backend_set())
+        out, _, _, tbl = socklb_stage(tbl, m.tensors(),
+                                      jnp.asarray(_row(s2 + 7)),
+                                      jnp.uint32(101))
+        assert _backend_of(out) != be1
+
+    def test_distinct_clients_pin_independently(self):
+        m = _mgr(aff=60)
+        t = m.tensors()
+        tbl = SockLBTable.create(1 << 10)
+        pins = {}
+        for i, src in enumerate(("10.0.9.1", "10.0.9.2", "10.0.9.3")):
+            out, _, _, tbl = socklb_stage(
+                tbl, t, jnp.asarray(_row(42000 + i, src=src)),
+                jnp.uint32(100))
+            pins[src] = _backend_of(out)
+        # each client's NEXT flow follows its own pin
+        for i, src in enumerate(("10.0.9.1", "10.0.9.2", "10.0.9.3")):
+            out, _, _, tbl = socklb_stage(
+                tbl, t, jnp.asarray(_row(43000 + i, src=src)),
+                jnp.uint32(101))
+            assert _backend_of(out) == pins[src]
+
+
+def _packed_eq(backend_str: str, packed) -> bool:
+    import ipaddress
+    ip, port = backend_str.rsplit(":", 1)
+    return (int(ipaddress.IPv4Address(ip)), int(port)) == packed
+
+
+class TestDaemonAffinity:
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_watcher_to_datapath_pins(self, backend):
+        d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+        ep = d.add_endpoint("cli", ("10.0.9.9",), ["k8s:app=cli"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "cli"}},
+            "egress": [{}],
+        }])
+        for i in range(4):
+            d.upsert_ipcache(f"10.0.1.{i + 1}/32", 4000 + i)
+        d.services.upsert("web", f"{VIP}:80", BACKENDS,
+                          affinity_timeout=120)
+        t = d.services.tensors()
+        s1, s2, be1, be2 = _divergent_sports(t)
+        d.process_batch(_row(s1), now=100)
+        d.process_batch(_row(s2), now=101)
+        # both cached flows resolved to the SAME (pinned) backend
+        entries = [e for e in d.socklb_entries()
+                   if e.get("backend")]
+        assert len(entries) == 2
+        assert len({e["backend"] for e in entries}) == 1

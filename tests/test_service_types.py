@@ -200,6 +200,25 @@ class TestNoServiceDrop:
         assert not bool(np.asarray(nobe).any())
 
     @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_no_service_wins_over_policy_deny(self, backend):
+        """Upstream's LB lookup runs BEFORE the endpoint program —
+        an endpoint whose egress policy would ALSO deny the VIP must
+        still report NO_SERVICE, not a policy reason (lb_drop is a
+        pre-policy channel, unlike NAT/bandwidth where policy
+        wins)."""
+        d = Daemon(DaemonConfig(backend=backend,
+                                ct_capacity=1 << 12))
+        d.add_endpoint("web", ("10.0.9.9",), ["k8s:app=web"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "web"}},
+            # default-deny egress: no egress rule at all
+            "ingress": [{}],
+        }])
+        d.services.upsert("empty", "172.20.0.10:80", [])
+        ev = d.process_batch(_rows(8, "172.20.0.10"), now=50)
+        assert int((ev.reason == REASON_NO_SERVICE).sum()) == 8
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
     def test_daemon_drops_with_no_service_reason(self, backend):
         d = Daemon(DaemonConfig(backend=backend,
                                 ct_capacity=1 << 12))
