@@ -134,6 +134,71 @@ def rules_from_cnp(obj: dict) -> List[Rule]:
     return rules
 
 
+def _expand_to_services(section: dict, services_view) -> dict:
+    """One egress entry: ``toServices`` -> derived ``toCIDRSet``
+    (reference: pkg/k8s TranslateToServicesRule rewrites the rule
+    in place against the service/endpoints caches).
+
+    An expansion yielding NO peers inserts the unmatchable
+    ``0.0.0.0/32`` instead of leaving the entry peer-less — a
+    peer-less egress entry is an L3 wildcard, and a vanished service
+    must fail closed, not open."""
+    tos = section.get("toServices")
+    if not tos:
+        return section
+    out = dict(section)
+    del out["toServices"]
+    peers: set = set()
+    for ent in tos:
+        ks = ent.get("k8sService") or {}
+        sel = ent.get("k8sServiceSelector") or {}
+        if ks:
+            peers |= services_view.service_peer_ips(
+                ks.get("namespace", "default"),
+                ks.get("serviceName", ""))
+        elif sel:
+            peers |= services_view.select_peer_ips(
+                dict(sel.get("selector") or {}), sel.get("namespace"))
+    cidrs = list(out.get("toCIDRSet") or ())
+    if peers:
+        cidrs.extend({"cidr": (f"{ip}/32" if ":" not in ip
+                               else f"{ip}/128")}
+                     for ip in sorted(peers))
+    else:
+        cidrs.append({"cidr": "0.0.0.0/32"})  # matches nothing real
+    out["toCIDRSet"] = cidrs
+    return out
+
+
+def expand_cnp_services(obj: dict, services_view) -> dict:
+    """Deep-copy a CNP, expanding every egress/egressDeny entry's
+    ``toServices`` against the live service view.  Objects without
+    toServices return unchanged (same identity — callers use that to
+    skip re-imports)."""
+    if not cnp_has_to_services(obj):
+        return obj
+    import copy
+    obj = copy.deepcopy(obj)
+    specs = ([obj["spec"]] if obj.get("spec") else []) + \
+        list(obj.get("specs") or ())
+    for spec in specs:
+        for section in ("egress", "egressDeny"):
+            if spec.get(section):
+                spec[section] = [
+                    _expand_to_services(s, services_view)
+                    for s in spec[section]]
+    return obj
+
+
+def cnp_has_to_services(obj: dict) -> bool:
+    specs = ([obj.get("spec")] if obj.get("spec") else []) + \
+        list(obj.get("specs") or ())
+    return any(e.get("toServices")
+               for spec in specs
+               for section in ("egress", "egressDeny")
+               for e in (spec.get(section) or ()))
+
+
 def cnp_identity_labels(obj: dict) -> List[str]:
     """The derived labels identifying one CNP's rules (for delete)."""
     meta = obj.get("metadata") or {}
@@ -148,17 +213,92 @@ class CNPWatcher:
     """The watcher half: CNP add/update/delete events -> repository
     mutations (reference: pkg/k8s/watchers cilium_network_policy.go).
     Drive it from a fake event stream in tests, or a real informer in
-    deployment."""
+    deployment.
 
-    def __init__(self, repo):
+    ``services`` (a ServiceWatcher, optional) enables ``toServices``
+    egress entries: they expand to the referenced services' peer IPs
+    at import, and :meth:`resync_services` (wired to service/
+    endpoints churn by the hub) re-expands affected CNPs — skipping
+    the repository round-trip when the expansion is unchanged."""
+
+    def __init__(self, repo, services=None):
         self.repo = repo
+        self.services = services
+        # CNPs carrying toServices:
+        #   key -> (raw obj, last expansion, named-ref keys, has_sel)
+        # named-ref keys are the "<ns>/<name>" services the CNP names
+        # via k8sService; has_sel marks k8sServiceSelector use (those
+        # depend on EVERY service's labels, so any change re-expands)
+        self._svc_cnps: Dict[str, tuple] = {}
+
+    @staticmethod
+    def _key(obj: dict) -> str:
+        meta = obj.get("metadata") or {}
+        # kind-qualified: a CCNP and a default-ns CNP may share a name
+        kind = "ccnp" if obj.get("kind") == \
+            "CiliumClusterwideNetworkPolicy" else "cnp"
+        return (f"{kind}:{meta.get('namespace', 'default')}"
+                f"/{meta.get('name')}")
+
+    @staticmethod
+    def _service_refs(obj: dict) -> tuple:
+        """-> (named '<ns>/<name>' keys, any-selector flag)."""
+        named, has_sel = set(), False
+        specs = ([obj.get("spec")] if obj.get("spec") else []) + \
+            list(obj.get("specs") or ())
+        for spec in specs:
+            for section in ("egress", "egressDeny"):
+                for e in spec.get(section) or ():
+                    for ent in e.get("toServices") or ():
+                        ks = ent.get("k8sService") or {}
+                        if ks:
+                            named.add(
+                                f"{ks.get('namespace', 'default')}"
+                                f"/{ks.get('serviceName', '')}")
+                        elif ent.get("k8sServiceSelector"):
+                            has_sel = True
+        return named, has_sel
+
+    def _expand(self, obj: dict) -> dict:
+        if not cnp_has_to_services(obj):
+            self._svc_cnps.pop(self._key(obj), None)
+            return obj
+        if self.services is None:
+            raise ValueError("toServices needs a service view "
+                             "(CNPWatcher(services=...))")
+        expanded = expand_cnp_services(obj, self.services)
+        named, has_sel = self._service_refs(obj)
+        self._svc_cnps[self._key(obj)] = (obj, expanded, named,
+                                          has_sel)
+        return expanded
 
     def on_add(self, obj: dict) -> int:
-        return self.repo.add_list(rules_from_cnp(obj))
+        return self.repo.add_list(rules_from_cnp(self._expand(obj)))
 
     def on_update(self, obj: dict) -> int:
+        expanded = self._expand(obj)
         self.repo.delete_by_labels(cnp_identity_labels(obj))
-        return self.repo.add_list(rules_from_cnp(obj))
+        return self.repo.add_list(rules_from_cnp(expanded))
 
     def on_delete(self, obj: dict) -> int:
+        self._svc_cnps.pop(self._key(obj), None)
         return self.repo.delete_by_labels(cnp_identity_labels(obj))
+
+    def resync_services(self, changed: str = None) -> int:
+        """Service/Endpoints churn: re-expand the toServices CNPs
+        that could see ``changed`` ("<ns>/<name>"; None = all) and
+        whose derived peer set actually moved.  Returns CNPs
+        re-imported."""
+        n = 0
+        for key, (raw, last, named, has_sel) in list(
+                self._svc_cnps.items()):
+            if changed is not None and not has_sel \
+                    and changed not in named:
+                continue
+            fresh = expand_cnp_services(raw, self.services)
+            if fresh != last:
+                self._svc_cnps[key] = (raw, fresh, named, has_sel)
+                self.repo.delete_by_labels(cnp_identity_labels(raw))
+                self.repo.add_list(rules_from_cnp(fresh))
+                n += 1
+        return n

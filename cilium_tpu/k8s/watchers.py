@@ -70,6 +70,34 @@ def _meta_key(obj: dict) -> str:
     return f"{meta.get('namespace', 'default')}/{meta.get('name', '')}"
 
 
+def _k8s_selector_matches(sel: dict, labels: dict) -> bool:
+    """Plain k8s LabelSelector over an object's metadata.labels:
+    matchLabels AND every matchExpression (In/NotIn/Exists/
+    DoesNotExist) must hold.  Unknown operators fail CLOSED (match
+    nothing) — silently ignoring a constraint would widen a policy."""
+    for k, v in (sel.get("matchLabels") or {}).items():
+        if labels.get(k) != v:
+            return False
+    for e in sel.get("matchExpressions") or ():
+        key, op = e.get("key", ""), e.get("operator", "")
+        vals = e.get("values") or ()
+        if op == "In":
+            if labels.get(key) not in vals:
+                return False
+        elif op == "NotIn":
+            if key in labels and labels[key] in vals:
+                return False
+        elif op == "Exists":
+            if key not in labels:
+                return False
+        elif op == "DoesNotExist":
+            if key in labels:
+                return False
+        else:
+            return False
+    return True
+
+
 class ServiceWatcher:
     """Service + Endpoints objects -> ServiceManager entries.
 
@@ -108,12 +136,21 @@ class ServiceWatcher:
         self._svc: Dict[str, dict] = {}
         self._eps: Dict[str, dict] = {}
         self._installed: Dict[str, set] = {}  # key -> LB names
+        # fired with the changed "<ns>/<name>" after every service/
+        # endpoints event (the hub wires CNPWatcher.resync_services
+        # here so toServices re-expands only affected CNPs)
+        self.on_change = None
+
+    def _changed(self, key: str) -> None:
+        if self.on_change is not None:
+            self.on_change(key)
 
     # -- Service objects ---------------------------------------------
     def on_service_add(self, obj: dict) -> None:
         key = _meta_key(obj)
         self._svc[key] = obj
         self._reconcile(key)
+        self._changed(key)
 
     on_service_update = on_service_add
 
@@ -121,12 +158,14 @@ class ServiceWatcher:
         key = _meta_key(obj)
         self._svc.pop(key, None)
         self._reconcile(key)
+        self._changed(key)
 
     # -- Endpoints objects -------------------------------------------
     def on_endpoints_add(self, obj: dict) -> None:
         key = _meta_key(obj)
         self._eps[key] = obj
         self._reconcile(key)
+        self._changed(key)
 
     on_endpoints_update = on_endpoints_add
 
@@ -134,6 +173,7 @@ class ServiceWatcher:
         key = _meta_key(obj)
         self._eps.pop(key, None)
         self._reconcile(key)
+        self._changed(key)
 
     def _reconcile(self, key: str) -> None:
         svc = self._svc.get(key)
@@ -217,6 +257,42 @@ class ServiceWatcher:
             if (spec.get("externalTrafficPolicy") == "Local"
                     or spec.get("internalTrafficPolicy") == "Local"):
                 self._reconcile(key)
+
+    # -- toServices peer views (pkg/k8s TranslateToServicesRule) ------
+    def service_peer_ips(self, ns: str, name: str) -> set:
+        """The IP peer set a ``k8sService`` reference expands to:
+        clusterIP + every ready backend address (upstream translates
+        to the endpoints' IPs; the frontend rides along so socket-LB'd
+        connects to the VIP are judged consistently)."""
+        key = f"{ns}/{name}"
+        out: set = set()
+        svc = self._svc.get(key)
+        if svc is not None:
+            cip = (svc.get("spec") or {}).get("clusterIP")
+            if cip and cip != "None":
+                out.add(cip)
+        eps = self._eps.get(key)
+        if eps is not None:
+            for subset in eps.get("subsets") or ():
+                for a in subset.get("addresses") or ():
+                    if a.get("ip"):
+                        out.add(a["ip"])
+        return out
+
+    def select_peer_ips(self, selector: dict,
+                        ns: Optional[str] = None) -> set:
+        """``k8sServiceSelector`` expansion: services whose OBJECT
+        labels match the full k8s LabelSelector grammar (matchLabels
+        AND matchExpressions), all namespaces unless ``ns`` given."""
+        out: set = set()
+        for key, svc in self._svc.items():
+            sns, name = key.split("/", 1)
+            if ns and sns != ns:
+                continue
+            labels = (svc.get("metadata") or {}).get("labels") or {}
+            if _k8s_selector_matches(selector or {}, labels):
+                out |= self.service_peer_ips(sns, name)
+        return out
 
     @staticmethod
     def _backends(eps: dict, svc_port: dict) -> List[str]:
@@ -760,13 +836,14 @@ class K8sWatcherHub:
     def __init__(self, daemon):
         from . import CNPWatcher
 
-        self.cnp = CNPWatcher(daemon.repo)
         self.services = ServiceWatcher(
             daemon.services, node_ip=daemon.config.node_ip,
             local_ips=lambda: {ip for ep in daemon.endpoints.list()
                                for ip in ep.ips})
         daemon.endpoints.on_attach(
             lambda _p: self.services.resync())
+        self.cnp = CNPWatcher(daemon.repo, services=self.services)
+        self.services.on_change = self.cnp.resync_services
         self.pods = PodWatcher(daemon)
         self.namespaces = NamespaceWatcher(self.pods)
         self.pods.namespaces = self.namespaces

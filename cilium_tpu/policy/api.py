@@ -453,6 +453,16 @@ class EgressRule:
 
     @staticmethod
     def from_dict(d: dict) -> "EgressRule":
+        if d.get("toServices"):
+            # silently ignoring this key would turn the entry into an
+            # L3 WILDCARD (allow-to-everything) — the opposite of the
+            # author's intent.  Upstream's k8s layer translates
+            # toServices to toCIDRSet against the live service cache
+            # (pkg/k8s TranslateToServicesRule); ours does too.
+            raise ValueError(
+                "toServices must be expanded against the k8s service "
+                "cache: import the policy as a CiliumNetworkPolicy "
+                "through the k8s watcher path")
         return EgressRule(
             to_endpoints=tuple(EndpointSelector.from_dict(s)
                                for s in d.get("toEndpoints") or ()),

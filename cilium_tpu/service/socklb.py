@@ -328,8 +328,18 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
         a_src = sub[:, COL_SRC_IP3]
         a_vip = sub[:, COL_DST_IP3]  # pre-rewrite dst IS the vip
         a_dp = (sub[:, COL_DPORT] << 8) | sub[:, COL_PROTO]
-        afound, arow, ah = _aff_probe(aff_arr, a_src, a_vip, a_dp,
-                                      now)
+        m_rows = a_src.shape[0]
+        # gated: with no affinity service in the batch the probe's
+        # gathers never execute (same pattern as the established
+        # path's overflow cond)
+        afound, arow, ah = jax.lax.cond(
+            jnp.any(aff_ttl > 0),
+            lambda _: _aff_probe(aff_arr, a_src, a_vip, a_dp, now),
+            lambda _: (jnp.zeros(m_rows, dtype=bool),
+                       jnp.zeros((m_rows, AFF_WORDS),
+                                 dtype=jnp.uint32),
+                       jnp.zeros(m_rows, dtype=jnp.uint32)),
+            None)
         use_aff = is_svc & (aff_ttl > 0) & afound
         be_ip = jnp.where(use_aff, arow[:, AF_BE_IP], be_ip)
         be_port = jnp.where(use_aff, arow[:, AF_BE_PORT], be_port)
@@ -384,32 +394,41 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
         # connects in one batch: the lowest connect row's backend
         # wins the pin; see DIVERGENCES #22
         amask_c = aff_arr.shape[0] - 1
-        a_new = jnp.stack([
-            a_src, a_vip, a_dp, be_ip, be_port, now + aff_ttl,
-            jnp.zeros(CONNECT_CAP, dtype=jnp.uint32),
-            jnp.zeros(CONNECT_CAP, dtype=jnp.uint32),
-        ], axis=1).astype(jnp.uint32)
         A = aff_arr.shape[0]
-        a_pending = live & is_svc & (aff_ttl > 0)
-        for step in range(AFF_PROBE):
-            s = ((ah + step) & amask_c).astype(jnp.int32)
-            stored = aff_arr[s]
-            same = ((stored[:, AF_SRC] == a_src)
-                    & (stored[:, AF_VIP] == a_vip)
-                    & (stored[:, AF_DP] == a_dp))
-            claimable = (stored[:, AF_EXPIRES] < now) | same
-            trying = a_pending & claimable
-            rows_t = jnp.where(trying, s, A)
-            owner = jnp.full((A + 1,), CONNECT_CAP, dtype=jnp.int32
-                             ).at[rows_t].min(ridx, mode="drop")
-            writer = trying & (owner[s] == ridx)
-            wt = jnp.where(writer, s, A)
-            aff_arr = aff_arr.at[wt].set(a_new, mode="drop")
-            back = aff_arr[s]
-            won = trying & ((back[:, AF_SRC] == a_src)
-                            & (back[:, AF_VIP] == a_vip)
-                            & (back[:, AF_DP] == a_dp))
-            a_pending = a_pending & ~won
+        a_pending0 = live & is_svc & (aff_ttl > 0)
+
+        def do_aff_claims(aff_arr):
+            a_new = jnp.stack([
+                a_src, a_vip, a_dp, be_ip, be_port, now + aff_ttl,
+                jnp.zeros(CONNECT_CAP, dtype=jnp.uint32),
+                jnp.zeros(CONNECT_CAP, dtype=jnp.uint32),
+            ], axis=1).astype(jnp.uint32)
+            a_pending = a_pending0
+            for step in range(AFF_PROBE):
+                s = ((ah + step) & amask_c).astype(jnp.int32)
+                stored = aff_arr[s]
+                same = ((stored[:, AF_SRC] == a_src)
+                        & (stored[:, AF_VIP] == a_vip)
+                        & (stored[:, AF_DP] == a_dp))
+                claimable = (stored[:, AF_EXPIRES] < now) | same
+                trying = a_pending & claimable
+                rows_t = jnp.where(trying, s, A)
+                owner = jnp.full((A + 1,), CONNECT_CAP,
+                                 dtype=jnp.int32
+                                 ).at[rows_t].min(ridx, mode="drop")
+                writer = trying & (owner[s] == ridx)
+                wt = jnp.where(writer, s, A)
+                aff_arr = aff_arr.at[wt].set(a_new, mode="drop")
+                back = aff_arr[s]
+                won = trying & ((back[:, AF_SRC] == a_src)
+                                & (back[:, AF_VIP] == a_vip)
+                                & (back[:, AF_DP] == a_dp))
+                a_pending = a_pending & ~won
+            return aff_arr
+
+        # the 8-round claim only executes when some row pins
+        aff_arr = jax.lax.cond(jnp.any(a_pending0), do_aff_claims,
+                               lambda x: x, aff_arr)
         # scatter resolutions back to batch rows; DEAD slots (comp
         # defaulted to row 0) must scatter out of bounds, not onto
         # row 0 — duplicate scatter indices have unspecified order
@@ -430,7 +449,13 @@ def socklb_stage(tbl: SockLBTable, t: LBTensors, hdr: jnp.ndarray,
         # row (no caching for this batch — correctness over cache;
         # affinity pins are READ but not claimed)
         is_svc, no_be, be_ip, be_port, aff_ttl = _resolve(t, hdr)
-        afound, arow, _ah = _aff_probe(carry[2], src, dst, dp, now)
+        afound, arow, _ah = jax.lax.cond(
+            jnp.any(aff_ttl > 0),
+            lambda _: _aff_probe(carry[2], src, dst, dp, now),
+            lambda _: (jnp.zeros(n, dtype=bool),
+                       jnp.zeros((n, AFF_WORDS), dtype=jnp.uint32),
+                       jnp.zeros(n, dtype=jnp.uint32)),
+            None)
         use_aff = is_svc & (aff_ttl > 0) & afound
         be_ip = jnp.where(use_aff, arow[:, AF_BE_IP], be_ip)
         be_port = jnp.where(use_aff, arow[:, AF_BE_PORT], be_port)

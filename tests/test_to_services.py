@@ -1,0 +1,201 @@
+"""toServices egress rules (reference: pkg/k8s
+TranslateToServicesRule): a k8sService / k8sServiceSelector reference
+expands to the service's clusterIP + ready backend IPs as toCIDRSet
+peers, re-expanded on Service/Endpoints churn, and fails CLOSED when
+the service vanishes.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.datapath.verdict import (REASON_FORWARDED,
+                                         REASON_POLICY_DEFAULT_DENY)
+
+
+def _daemon(backend="interpreter"):
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+    # the namespace label the PodWatcher would fold in (CNP subject
+    # selectors are namespace-scoped)
+    d.add_endpoint("cli", ("10.0.9.9",), [
+        "k8s:app=cli", "k8s:io.kubernetes.pod.namespace=default"])
+    return d
+
+
+def _cnp(to_services):
+    return {
+        "kind": "CiliumNetworkPolicy",
+        "metadata": {"name": "allow-svc", "namespace": "default"},
+        "spec": {
+            "endpointSelector": {"matchLabels": {"app": "cli"}},
+            "egress": [{"toServices": to_services}],
+        },
+    }
+
+
+def _svc(name="db", ns="default", cluster_ip="172.20.0.50",
+         labels=None):
+    return {"kind": "Service",
+            "metadata": {"name": name, "namespace": ns,
+                         **({"labels": labels} if labels else {})},
+            "spec": {"clusterIP": cluster_ip,
+                     "ports": [{"port": 5432, "protocol": "TCP"}]}}
+
+
+def _eps(name="db", ns="default", ips=("10.0.2.1",)):
+    return {"kind": "Endpoints",
+            "metadata": {"name": name, "namespace": ns},
+            "subsets": [{
+                "addresses": [{"ip": ip} for ip in ips],
+                "ports": [{"port": 5432, "protocol": "TCP"}],
+            }]}
+
+
+def _flow(d, dst, sport, now):
+    ep = d.endpoints.lookup_by_ip("10.0.9.9")
+    ev = d.process_batch(make_batch([
+        dict(src="10.0.9.9", dst=dst, sport=sport, dport=5432,
+             proto=6, flags=TCP_SYN, ep=ep.id, dir=1)
+    ]).data, now=now)
+    return int(ev.reason[0])
+
+
+class TestToServices:
+    def test_named_service_expands_and_enforces(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _svc())
+        hub.dispatch("add", _eps())
+        hub.dispatch("add", _cnp(
+            [{"k8sService": {"serviceName": "db",
+                             "namespace": "default"}}]))
+        # the expansion minted a CIDR identity + ipcache route for
+        # the backend; only the stranger needs a manual mapping
+        d.upsert_ipcache("10.0.3.3/32", 4002)
+        # backend allowed, stranger denied
+        assert _flow(d, "10.0.2.1", 41000, 50) == REASON_FORWARDED
+        assert _flow(d, "10.0.3.3", 41001,
+                     51) == REASON_POLICY_DEFAULT_DENY
+        # the derived rule shows toCIDRSet with clusterIP + backend
+        from cilium_tpu.policy.api import rule_to_dict
+        egress = rule_to_dict(d.repo.rules()[0])["egress"][0]
+        cidrs = {c["cidr"] for c in egress["toCIDRSet"]}
+        assert cidrs == {"172.20.0.50/32", "10.0.2.1/32"}
+        assert "toServices" not in egress
+
+    def test_endpoints_churn_re_expands(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _svc())
+        hub.dispatch("add", _eps())
+        hub.dispatch("add", _cnp(
+            [{"k8sService": {"serviceName": "db",
+                             "namespace": "default"}}]))
+        d.upsert_ipcache("10.0.2.9/32", 4003)
+        assert _flow(d, "10.0.2.9", 41010,
+                     50) == REASON_POLICY_DEFAULT_DENY
+        # the service scales out; the new backend joins the peer set
+        hub.dispatch("update", _eps(ips=("10.0.2.1", "10.0.2.9")))
+        assert _flow(d, "10.0.2.9", 41011, 51) == REASON_FORWARDED
+
+    def test_service_delete_fails_closed(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _svc())
+        hub.dispatch("add", _eps())
+        hub.dispatch("add", _cnp(
+            [{"k8sService": {"serviceName": "db",
+                             "namespace": "default"}}]))
+        assert _flow(d, "10.0.2.1", 41020, 50) == REASON_FORWARDED
+        hub.dispatch("delete", _svc())
+        hub.dispatch("delete", _eps())
+        # no peers left: the entry matches NOTHING (not everything)
+        assert _flow(d, "10.0.2.1", 41021,
+                     51) == REASON_POLICY_DEFAULT_DENY
+        from cilium_tpu.policy.api import rule_to_dict
+        egress = rule_to_dict(d.repo.rules()[0])["egress"][0]
+        assert {c["cidr"] for c in egress["toCIDRSet"]} == {
+            "0.0.0.0/32"}
+
+    def test_selector_matches_service_labels_across_namespaces(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _svc(labels={"tier": "db"}))
+        hub.dispatch("add", _eps())
+        hub.dispatch("add", _svc(name="db2", ns="prod",
+                                 cluster_ip="172.20.0.60",
+                                 labels={"tier": "db"}))
+        hub.dispatch("add", _eps(name="db2", ns="prod",
+                                 ips=("10.0.5.1",)))
+        hub.dispatch("add", _svc(name="web", cluster_ip="172.20.0.70",
+                                 labels={"tier": "web"}))
+        hub.dispatch("add", _eps(name="web", ips=("10.0.6.1",)))
+        hub.dispatch("add", _cnp([{"k8sServiceSelector": {
+            "selector": {"matchLabels": {"tier": "db"}}}}]))
+        from cilium_tpu.policy.api import rule_to_dict
+        egress = rule_to_dict(d.repo.rules()[0])["egress"][0]
+        cidrs = {c["cidr"] for c in egress["toCIDRSet"]}
+        assert cidrs == {"172.20.0.50/32", "10.0.2.1/32",
+                         "172.20.0.60/32", "10.0.5.1/32"}
+        # namespace-scoped selector: only the default-ns service
+        hub.dispatch("update", _cnp([{"k8sServiceSelector": {
+            "selector": {"matchLabels": {"tier": "db"}},
+            "namespace": "default"}}]))
+        egress = rule_to_dict(d.repo.rules()[0])["egress"][0]
+        assert {c["cidr"] for c in egress["toCIDRSet"]} == {
+            "172.20.0.50/32", "10.0.2.1/32"}
+
+    def test_selector_match_expressions_enforced(self):
+        """matchExpressions must constrain (not be silently dropped):
+        {app=db} AND {env In [prod]} selects only the prod service."""
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _svc(labels={"app": "db",
+                                         "env": "staging"}))
+        hub.dispatch("add", _eps())
+        hub.dispatch("add", _svc(name="dbp", cluster_ip="172.20.0.60",
+                                 labels={"app": "db", "env": "prod"}))
+        hub.dispatch("add", _eps(name="dbp", ips=("10.0.5.1",)))
+        hub.dispatch("add", _cnp([{"k8sServiceSelector": {
+            "selector": {
+                "matchLabels": {"app": "db"},
+                "matchExpressions": [{"key": "env", "operator": "In",
+                                      "values": ["prod"]}]}}}]))
+        from cilium_tpu.policy.api import rule_to_dict
+        egress = rule_to_dict(d.repo.rules()[0])["egress"][0]
+        assert {c["cidr"] for c in egress["toCIDRSet"]} == {
+            "172.20.0.60/32", "10.0.5.1/32"}
+        # an expressions-only selector works too (Exists)
+        hub.dispatch("update", _cnp([{"k8sServiceSelector": {
+            "selector": {"matchExpressions": [
+                {"key": "env", "operator": "Exists"}]}}}]))
+        egress = rule_to_dict(d.repo.rules()[0])["egress"][0]
+        assert {c["cidr"] for c in egress["toCIDRSet"]} == {
+            "172.20.0.50/32", "10.0.2.1/32",
+            "172.20.0.60/32", "10.0.5.1/32"}
+
+    def test_unchanged_expansion_skips_reimport(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _svc())
+        hub.dispatch("add", _eps())
+        hub.dispatch("add", _cnp(
+            [{"k8sService": {"serviceName": "db",
+                             "namespace": "default"}}]))
+        rev = d.repo.revision
+        # an unrelated service appears: expansion unchanged, no
+        # repository churn
+        hub.dispatch("add", _svc(name="other",
+                                 cluster_ip="172.20.0.99"))
+        hub.dispatch("add", _eps(name="other", ips=("10.0.7.1",)))
+        assert d.repo.revision == rev
+
+    def test_direct_import_rejected(self):
+        d = _daemon()
+        with pytest.raises(ValueError, match="toServices"):
+            d.policy_import([{
+                "endpointSelector": {"matchLabels": {"app": "cli"}},
+                "egress": [{"toServices": [{"k8sService": {
+                    "serviceName": "db", "namespace": "default"}}]}],
+            }])
