@@ -62,6 +62,17 @@ class DaemonConfig:
     # node registry so peers' health meshes can probe it
     api_socket_path: Optional[str] = None
     health_probe_interval: float = 10.0
+    # mutual authentication (pkg/auth): the manager observes
+    # AUTH_REQUIRED drops and handshakes via the provider; TTL is the
+    # grant lifetime (upstream: derived from certificate expiry)
+    mesh_auth: bool = True
+    auth_ttl: int = 3600
+    auth_gc_interval: float = 30.0
+    # transparent encryption (pkg/wireguard analogue): node keypair
+    # published via the node registry; node-to-node batch transport
+    # seals with ChaCha20-Poly1305 (cilium_tpu/encryption)
+    enable_encryption: bool = False
+    encryption_key_path: Optional[str] = None
     # egress masquerade (bpf/lib/nat.h analogue; service/nat.py)
     masquerade: bool = False
     node_ip: Optional[str] = None
@@ -108,6 +119,7 @@ class Daemon:
                                          self.loader)
         self.monitor = MonitorAgent()
         self.controllers = ControllerManager()
+        self.encryption = None  # set below when enabled + kvstore
         self._boot_time = time.time()
         self._started = False
 
@@ -189,6 +201,15 @@ class Daemon:
         # analogue): created on first service traffic
         self._socklb = None
         self._svc_version_seen = None  # affinity prune bookkeeping
+        # mutual auth (pkg/auth): drop-observing handshake manager.
+        # Fed explicitly where the batch's LOGICAL clock is in hand
+        # (process_batch / the serving-path drain) — grants must be
+        # stamped on the same clock the datapath compares against
+        if self.config.mesh_auth:
+            from .auth import AuthManager
+            self.auth_manager = AuthManager(self)
+        else:
+            self.auth_manager = None
         # egress masquerade (applies after LB, before the datapath, so
         # CT tracks the post-NAT tuple)
         self.nat = None
@@ -272,6 +293,13 @@ class Daemon:
             info = {}
             if self.config.api_socket_path:
                 info["api_socket"] = self.config.api_socket_path
+            if self.config.enable_encryption:
+                from ..encryption import EncryptionManager
+
+                self.encryption = EncryptionManager(
+                    self.config.node_name, self.node_registry,
+                    key_path=self.config.encryption_key_path)
+                info = self.encryption.advertise(info)
             self.node_registry.register(self.config.node_name, info)
             self.health = HealthMesh(self.node_registry,
                                      self.config.node_name)
@@ -335,6 +363,11 @@ class Daemon:
             self.config.ct_gc_interval)
         self.controllers.update(
             "fqdn-gc", self.fqdn.gc, self.config.fqdn_gc_interval)
+        if self.auth_manager is not None:
+            self.controllers.update(
+                "auth-gc",
+                lambda: self.auth_manager.gc(self._now()),
+                self.config.auth_gc_interval)
         if self.config.hubble_listen:
             from ..flow.grpc_server import serve as hubble_serve
 
@@ -585,13 +618,19 @@ class Daemon:
                 hdr_dev = self.loader.reverse_nat(self.nat, hdr_dev,
                                                   now)
             hdr = np.asarray(hdr_dev)
-            batch = decode_out(out, hdr, row_map.numeric_array(),
-                               timestamp=time.time())
-            self.monitor.publish(self._filter_events(batch))
-            return batch
+            return self._finish_batch(out, hdr, row_map, now)
         out, row_map = self.loader.step(hdr, now)
+        return self._finish_batch(out, hdr, row_map, now)
+
+    def _finish_batch(self, out, hdr: np.ndarray, row_map,
+                      now: int) -> EventBatch:
+        """The shared process_batch tail: decode -> auth observe ->
+        monitor publish (ONE definition; a per-batch hook added here
+        reaches both the routed and the plain path)."""
         batch = decode_out(out, hdr, row_map.numeric_array(),
                            timestamp=time.time())
+        if self.auth_manager is not None:
+            self.auth_manager.observe(batch, now)
         self.monitor.publish(self._filter_events(batch))
         return batch
 
@@ -815,6 +854,11 @@ class Daemon:
             hdr, numerics, ts = rec
             batch = decode_ring_rows(rows[rows[:, COL_BATCH] == b],
                                      hdr, numerics, ts)
+            if self.auth_manager is not None:
+                # the drained window's logical now is gone; the
+                # serving loop stamps batches with _now(), so grants
+                # land on the same clock
+                self.auth_manager.observe(batch, self._now())
             self.monitor.publish(self._filter_events(batch))
 
     def socklb_entries(self, limit: int = 1000) -> list:

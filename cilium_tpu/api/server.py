@@ -142,6 +142,8 @@ def _make_handler(daemon: Daemon):
                 elif path == "/map/lb":
                     limit = int(q.get("limit", ["1000"])[0])
                     self._send(200, daemon.socklb_entries(limit))
+                elif path == "/map/auth":
+                    self._send(200, daemon.loader.auth_entries())
                 elif path == "/egress":
                     # expanded egress-gateway rules (cilium egress
                     # list): one row per (pod IP, destCIDR, egress IP)

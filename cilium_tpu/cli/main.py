@@ -223,6 +223,14 @@ def cmd_bpf(args) -> int:
             print(f"{e['proto']} {e['src']}:{e['sport']} -> "
                   f"{e['vip']}:{e['dport']} backend={be} "
                   f"expires={e['expires']}")
+    elif args.obj == "auth":
+        entries = c.map_get("auth")
+        if args.json:
+            _print(entries)
+            return 0
+        for e in entries:
+            print(f"ep={e['endpoint']} remote-identity="
+                  f"{e['remote_identity']} expires={e['expires']}")
     elif args.obj == "nat":
         entries = c.map_get("nat")
         if args.json:
@@ -438,9 +446,9 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("bpf", help="bpf ct list | bpf policy get ID | "
                                    "bpf ipcache list | bpf nat list | "
-                                   "bpf lb list")
+                                   "bpf lb list | bpf auth list")
     p.add_argument("obj", choices=["ct", "policy", "ipcache", "nat",
-                                   "lb"])
+                                   "lb", "auth"])
     p.add_argument("action", nargs="?", default="list")
     p.add_argument("id", nargs="?", type=int, default=0)
 

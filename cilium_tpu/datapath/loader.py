@@ -21,7 +21,7 @@ BPF programs while maps persist in bpffs (SURVEY.md §5).
 from __future__ import annotations
 
 import abc
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -58,6 +58,23 @@ class Loader(abc.ABC):
     @abc.abstractmethod
     def gc(self, now: int) -> int:
         """Expire CT entries; returns eviction count."""
+
+    # -- mutual authentication (pkg/auth authmap analogue) ------------
+    @abc.abstractmethod
+    def auth_upsert(self, ep_id: int, remote_id: int,
+                    expires: int) -> bool:
+        """Grant (subject endpoint's identity, remote identity) until
+        ``expires``.  Entries are identity-granular: endpoints sharing
+        a policy row (same labels) share the grant, exactly upstream's
+        {local identity, remote identity} authmap key."""
+
+    @abc.abstractmethod
+    def auth_entries(self) -> list:
+        """Live grants for `cilium-tpu bpf auth list`."""
+
+    @abc.abstractmethod
+    def auth_gc(self, now: int) -> int:
+        """Drop expired grants; returns eviction count."""
 
     @abc.abstractmethod
     def metrics(self) -> np.ndarray:
@@ -107,6 +124,12 @@ class TPULoader(Loader):
         self.nat_state = None  # NATTable, created on first masquerade
         self.row_map: Optional[IdentityRowMap] = None
         self.attach_count = 0
+        # mutual-auth grants, host-authoritative: (ep_id, remote
+        # numeric identity) -> expires.  The device [n_pol, n_rows]
+        # tensor is a projection rebuilt on every attach (rows and
+        # policy indices shift; the dict keys are stable)
+        self._auth: Dict[Tuple[int, int], int] = {}
+        self._epp = None  # ep -> policy row, mirrors the device table
         # attach() runs on API/regeneration threads while the serve
         # loop is in step(); every state swap must be atomic or a
         # concurrent step would resurrect the pre-attach tensors.
@@ -132,9 +155,18 @@ class TPULoader(Loader):
                 raise ValueError(
                     f"endpoint id {ep_id} out of range [0, {MAX_ENDPOINTS})")
             epp[ep_id] = pol_row
-        policy = DevicePolicy.from_tensors(tensors, epp)
+        auth_np = np.zeros((tensors.verdict.shape[0],
+                            tensors.verdict.shape[2]),
+                           dtype=np.uint32)
+        for (ep, rem), exp in self._auth.items():
+            pr = epp[ep] if 0 <= ep < MAX_ENDPOINTS else -1
+            r = row_map.row(rem)
+            if pr >= 0 and 0 < r < auth_np.shape[1]:
+                auth_np[pr, r] = max(auth_np[pr, r], exp)
+        policy = DevicePolicy.from_tensors(tensors, epp, auth=auth_np)
         device_lpm = DeviceLPM.from_tensors(lpm)
         with self._lock:
+            self._epp = epp
             self.row_map = row_map
             self.tensors = tensors
             self._policies = list(policies)
@@ -149,6 +181,46 @@ class TPULoader(Loader):
                     policy=policy, ipcache=device_lpm,
                     ct=self.state.ct, metrics=self.state.metrics)
             self.attach_count += 1
+
+    def auth_upsert(self, ep_id: int, remote_id: int,
+                    expires: int) -> bool:
+        jnp = self._jnp
+        with self._lock:
+            self._auth[(int(ep_id), int(remote_id))] = int(expires)
+            if self.state is None or self._epp is None:
+                return False
+            pr = (self._epp[ep_id]
+                  if 0 <= ep_id < MAX_ENDPOINTS else -1)
+            r = self.row_map.row(remote_id) if self.row_map else 0
+            pol = self.state.policy
+            if pr < 0 or not 0 < r < pol.auth.shape[1]:
+                # unknown endpoint/identity row: the grant stays
+                # host-side and lands at the next attach
+                return False
+            self.state = DatapathState(
+                policy=DevicePolicy(
+                    proto_table=pol.proto_table,
+                    port_class=pol.port_class,
+                    class_map=pol.class_map,
+                    verdict=pol.verdict,
+                    ep_policy=pol.ep_policy,
+                    auth=pol.auth.at[pr, r].set(jnp.uint32(expires))),
+                ipcache=self.state.ipcache, ct=self.state.ct,
+                metrics=self.state.metrics)
+        return True
+
+    def auth_entries(self) -> list:
+        with self._lock:
+            return [{"endpoint": ep, "remote_identity": rem,
+                     "expires": exp}
+                    for (ep, rem), exp in sorted(self._auth.items())]
+
+    def auth_gc(self, now: int) -> int:
+        with self._lock:
+            dead = [k for k, exp in self._auth.items() if exp <= now]
+            for k in dead:
+                del self._auth[k]
+        return len(dead)
 
     def step(self, hdr, now: int, pre_drop=None,
              pre_drop_reason=None, lb_drop=None):
@@ -251,13 +323,29 @@ class TPULoader(Loader):
             policy = self.state.policy
             verdict = policy.verdict.at[:, :, row, :].set(
                 jnp.asarray(vals))
+            # the auth column must track the row's OCCUPANT: a
+            # recycled row would otherwise hand the previous
+            # identity's live grant to the newcomer (no-handshake
+            # forward).  Re-project this numeric's grants from the
+            # host dict; zero on remove.
+            auth_col = np.zeros(policy.auth.shape[0], dtype=np.uint32)
+            if kind == "add" and self._epp is not None:
+                for (ep, rem), exp in self._auth.items():
+                    if rem != numeric_id:
+                        continue
+                    pr = (self._epp[ep]
+                          if 0 <= ep < MAX_ENDPOINTS else -1)
+                    if pr >= 0:
+                        auth_col[pr] = max(auth_col[pr], exp)
+            auth = policy.auth.at[:, row].set(jnp.asarray(auth_col))
             self.state = DatapathState(
                 policy=DevicePolicy(
                     proto_table=policy.proto_table,
                     port_class=policy.port_class,
                     class_map=policy.class_map,
                     verdict=verdict,
-                    ep_policy=policy.ep_policy),
+                    ep_policy=policy.ep_policy,
+                    auth=auth),
                 ipcache=self.state.ipcache, ct=self.state.ct,
                 metrics=self.state.metrics)
             self._policies = list(policies)
@@ -442,12 +530,15 @@ class InterpreterLoader(Loader):
     """Oracle-backed datapath — no accelerator needed (fake datapath)."""
 
     def __init__(self, ct_capacity: int = 0):
+        from .verdict import N_REASONS
+
         self.oracle = None
         self.nat_state = None  # numpy NAT table (port-pool mirror)
         self.nat_failed = 0
         self.row_map: Optional[IdentityRowMap] = None
-        self._metrics = np.zeros((8, 2), dtype=np.uint64)
+        self._metrics = np.zeros((N_REASONS, 2), dtype=np.uint64)
         self.attach_count = 0
+        self._auth_display: Dict[Tuple[int, int], int] = {}
 
     def nat_snapshot(self) -> Optional[np.ndarray]:
         return None if self.nat_state is None else self.nat_state.copy()
@@ -475,10 +566,46 @@ class InterpreterLoader(Loader):
         # endpoints not listed are lxcmap misses: the oracle drops
         # them (REASON_NO_ENDPOINT), matching the device's -1 sentinel
         pol_by_ep = {ep: policies[row] for ep, row in ep_policy.items()}
+        old_auth = self.oracle.auth if self.oracle is not None else None
         self.oracle = OracleDatapath(pol_by_ep, dict(ipcache))
         if old_ct is not None:
             self.oracle.ct = old_ct
+        if old_auth is not None:  # grants survive attach (authmap)
+            self.oracle.auth = old_auth
         self.attach_count += 1
+
+    def auth_upsert(self, ep_id: int, remote_id: int,
+                    expires: int) -> bool:
+        if self.oracle is None:
+            return False
+        pol = self.oracle.ep_policies.get(int(ep_id))
+        if pol is None:
+            return False
+        # keyed by SUBJECT LABELS, not endpoint id: label-identical
+        # endpoints share grants exactly like the device's shared
+        # policy row (upstream: authmap keys the local IDENTITY)
+        self.oracle.auth[(pol.subject_labels.sorted_key(),
+                          int(remote_id))] = int(expires)
+        self._auth_display[(int(ep_id), int(remote_id))] = int(expires)
+        return True
+
+    def auth_entries(self) -> list:
+        return [{"endpoint": ep, "remote_identity": rem,
+                 "expires": exp}
+                for (ep, rem), exp in sorted(
+                    self._auth_display.items())]
+
+    def auth_gc(self, now: int) -> int:
+        if self.oracle is None:
+            return 0
+        dead = [k for k, exp in self.oracle.auth.items()
+                if exp <= now]
+        for k in dead:
+            del self.oracle.auth[k]
+        for k in [k for k, exp in self._auth_display.items()
+                  if exp <= now]:
+            del self._auth_display[k]
+        return len(dead)
 
     def step(self, hdr: np.ndarray, now: int, pre_drop=None,
              pre_drop_reason=None, lb_drop=None):

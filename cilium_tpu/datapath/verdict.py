@@ -34,7 +34,8 @@ from ..core.packets import (
     COL_PROTO,
     COL_SRC_IP0,
 )
-from ..policy.compiler import PolicyTensors, PROXY_SHIFT, VERDICT_MASK
+from ..policy.compiler import (AUTH_SHIFT, PolicyTensors, PROXY_MASK,
+                               PROXY_SHIFT, VERDICT_MASK)
 from ..policy.mapstate import (
     VERDICT_ALLOW,
     VERDICT_DEFAULT_DENY,
@@ -63,7 +64,8 @@ REASON_NO_ENDPOINT = 4  # unregistered endpoint id (lxcmap miss)
 REASON_NAT_EXHAUSTED = 5  # SNAT port pool exhausted (DROP_NAT_NO_MAPPING)
 REASON_BANDWIDTH = 6  # egress rate limit (bandwidth manager / EDT)
 REASON_NO_SERVICE = 7  # service frontend with no backend (DROP_NO_SERVICE)
-N_REASONS = 8
+REASON_AUTH_REQUIRED = 8  # policy allows, mutual auth missing (pkg/auth)
+N_REASONS = 9
 
 # Event types in the out tensor (monitor vocabulary).
 EV_TRACE = 0  # TraceNotify: forwarded established/reply traffic
@@ -93,26 +95,35 @@ class DevicePolicy:
     class_map: jnp.ndarray  # [n_pol, n_cls_global] int32 -> LOCAL
     verdict: jnp.ndarray  # [n_pol, 2, n_rows, n_local] int32
     ep_policy: jnp.ndarray  # [MAX_ENDPOINTS] int32 endpoint -> policy row
+    # [n_pol, n_rows] uint32 mutual-auth expiries (the authmap
+    # analogue, pkg/auth: keyed local identity x remote identity —
+    # policy rows ARE identity-granular via the distillery)
+    auth: jnp.ndarray
 
     @staticmethod
     def from_tensors(t: PolicyTensors,
-                     ep_policy: np.ndarray = None) -> "DevicePolicy":
+                     ep_policy: np.ndarray = None,
+                     auth: np.ndarray = None) -> "DevicePolicy":
         if ep_policy is None:
             # default matches TPULoader.attach: every endpoint id is
             # an lxcmap miss until registered (callers that want the
             # all-registered single-policy shape pass explicit zeros)
             ep_policy = np.full(MAX_ENDPOINTS, -1, dtype=np.int32)
+        if auth is None:
+            auth = np.zeros((t.verdict.shape[0], t.verdict.shape[2]),
+                            dtype=np.uint32)
         return DevicePolicy(
             proto_table=jnp.asarray(t.proto_table),
             port_class=jnp.asarray(t.port_class),
             class_map=jnp.asarray(t.class_map),
             verdict=jnp.asarray(t.verdict),
             ep_policy=jnp.asarray(ep_policy),
+            auth=jnp.asarray(auth),
         )
 
     def tree_flatten(self):
         return ((self.proto_table, self.port_class, self.class_map,
-                 self.verdict, self.ep_policy), None)
+                 self.verdict, self.ep_policy, self.auth), None)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -218,7 +229,8 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     cls = state.policy.class_map[pol_row, gcls]
     packed = state.policy.verdict[pol_row, dirn, id_row, cls]
     p_verdict = (packed & VERDICT_MASK).astype(jnp.int32)
-    p_proxy = (packed >> PROXY_SHIFT).astype(jnp.int32)
+    p_proxy = ((packed >> PROXY_SHIFT) & PROXY_MASK).astype(jnp.int32)
+    p_auth = ((packed >> AUTH_SHIFT) & 1) != 0
 
     # 4. final verdict: established/reply bypass policy (reference: the
     #    CT fast path — policy applies to NEW connections only).
@@ -228,6 +240,14 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     # no_ep drops even ESTABLISHED traffic: the endpoint is gone/never
     # existed, so its CT fast path must not forward either
     allowed = (~is_new | allowed_new) & ~no_ep
+    # mutual auth (pkg/auth): a NEW flow whose winning allow carries
+    # the auth bit forwards only with a live authmap entry; otherwise
+    # it drops AUTH_REQUIRED (and the agent's auth manager observes
+    # the drop and handshakes).  EST flows ride the CT fast path —
+    # upstream judges auth at policy time only.
+    auth_exp = state.policy.auth[pol_row, id_row]
+    auth_drop = allowed & is_new & p_auth & (auth_exp <= now)
+    allowed = allowed & ~auth_drop
     nat_drop = None
     if pre_drop is not None:
         nat_drop = pre_drop & allowed  # policy/no_ep drops win
@@ -251,6 +271,11 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
         jnp.where(no_ep, REASON_NO_ENDPOINT,
                   jnp.where(p_verdict == VERDICT_DENY, REASON_POLICY_DENY,
                             REASON_POLICY_DEFAULT_DENY)))
+    # auth_drop rows carry p_verdict == ALLOW, so the base chain
+    # mislabels them — override both verdict and reason
+    verdict = jnp.where(auth_drop, VERDICT_DENY, verdict)
+    reason = jnp.where(auth_drop, REASON_AUTH_REQUIRED, reason)
+    proxy = jnp.where(auth_drop, 0, proxy)
     if nat_drop is not None:
         verdict = jnp.where(nat_drop, VERDICT_DENY, verdict)
         reason = jnp.where(nat_drop, REASON_NAT_EXHAUSTED, reason)

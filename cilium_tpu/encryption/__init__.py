@@ -1,0 +1,240 @@
+"""Transparent node-to-node encryption — the WireGuard analogue.
+
+Reference: upstream cilium's ``--enable-wireguard`` (pkg/wireguard):
+each agent generates a Curve25519 keypair, publishes the public key on
+its CiliumNode resource, adds every remote node as a wireguard peer,
+and the datapath marks pod-to-remote-pod traffic to route through the
+``cilium_wg0`` device, which encrypts per packet with
+ChaCha20-Poly1305.
+
+TPU-first redesign: packets cross nodes HERE as packed header batches
+(the comm-backend plane, SURVEY §5), so the unit of encryption is the
+BATCH buffer, not the packet — ONE X25519-derived session key per node
+pair and ONE AEAD seal per batch (amortizing the per-message cost
+~batch-size-fold; upstream pays it per packet because the wire
+delivers packets individually).  The key exchange mirrors upstream:
+
+- :class:`NodeKeypair` — the agent's Curve25519 keypair; the public
+  key publishes through the node registry (the CiliumNode annotation
+  analogue) as ``encryption-pubkey``.
+- :func:`derive_session_keys` — X25519 shared secret, then an
+  HKDF-style BLAKE2s expansion bound to (both pubkeys, epoch,
+  direction): each pair holds distinct A->B and B->A keys, and bumping
+  ``epoch`` rotates every key without re-publishing (upstream rotates
+  by replacing the node keypair).
+- :class:`EncryptedChannel` — seal/open of batch buffers with a
+  sequence-number nonce and strictly-monotone replay protection
+  (batches are ordered per channel; a reordered/duplicated frame is
+  REJECTED, matching wireguard's sliding-window intent for an
+  in-order transport).
+
+Crypto primitives: ``native/crypto.cpp`` (RFC 7748 + RFC 8439,
+validated against the RFC vectors and a pure-Python cross-check).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from ..native import crypto
+
+PUBKEY_FIELD = "encryption-pubkey"  # node-registry info key (hex)
+MAGIC = 0xC17E
+HDR = struct.Struct("<HHIQ")  # magic, epoch, reserved, seq
+OVERHEAD = HDR.size + 16  # header + poly1305 tag
+
+
+class DecryptError(Exception):
+    pass
+
+
+class NodeKeypair:
+    """The agent's Curve25519 identity (pkg/wireguard keypair)."""
+
+    def __init__(self, private: Optional[bytes] = None):
+        self.private = private if private is not None else os.urandom(32)
+        if len(self.private) != 32:
+            raise ValueError("private key must be 32 bytes")
+        self.public = crypto.x25519_base(self.private)
+
+    @staticmethod
+    def load_or_create(path: Optional[str]) -> "NodeKeypair":
+        """Persist the node key across agent restarts (upstream keeps
+        it on the wireguard device)."""
+        if path and os.path.exists(path):
+            with open(path, "rb") as f:
+                return NodeKeypair(f.read())
+        kp = NodeKeypair()
+        if path:
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                         0o600)
+            with os.fdopen(fd, "wb") as f:
+                f.write(kp.private)
+        return kp
+
+
+def derive_session_keys(local: NodeKeypair, peer_public: bytes,
+                        epoch: int = 0) -> Tuple[bytes, bytes]:
+    """-> (send_key, recv_key) for this node against ``peer_public``.
+
+    Both sides derive the same pair of keys: direction is bound to the
+    ORDER of the public keys, so A's send key IS B's recv key.  The
+    shared secret never leaves this function."""
+    shared = crypto.x25519(local.private, peer_public)
+    lo, hi = sorted((local.public, peer_public))
+
+    def kdf(direction: bytes) -> bytes:
+        return hashlib.blake2s(
+            shared + lo + hi + epoch.to_bytes(4, "little") + direction,
+            digest_size=32, person=b"ctpu-wg").digest()
+
+    k_lo_to_hi = kdf(b"lo->hi")
+    k_hi_to_lo = kdf(b"hi->lo")
+    if local.public == lo:
+        return k_lo_to_hi, k_hi_to_lo
+    return k_hi_to_lo, k_lo_to_hi
+
+
+class EncryptedChannel:
+    """One node pair's transport: seal/open batch buffers.
+
+    Frame layout: ``magic | epoch | reserved | seq`` (16 B, rides as
+    AAD) + ciphertext + tag.  The nonce is the little-endian sequence
+    number (12 B) — unique per key because seq is strictly monotone
+    and keys rotate with epoch."""
+
+    def __init__(self, local: NodeKeypair, peer_public: bytes,
+                 epoch: int = 0):
+        self.peer_public = peer_public
+        self.epoch = epoch
+        self._local = local
+        self._send_key, self._recv_key = derive_session_keys(
+            local, peer_public, epoch)
+        self._send_seq = 0
+        self._recv_seq = 0  # highest accepted
+        self._lock = threading.Lock()
+        self.sealed = 0
+        self.opened = 0
+        self.rejected = 0
+
+    def rotate(self, epoch: int) -> None:
+        """Key rotation: new epoch -> new session keys, sequence
+        numbers restart (the nonce space is per-key)."""
+        with self._lock:
+            self.epoch = epoch
+            self._send_key, self._recv_key = derive_session_keys(
+                self._local, self.peer_public, epoch)
+            self._send_seq = 0
+            self._recv_seq = 0
+
+    def seal(self, buf: bytes) -> bytes:
+        with self._lock:
+            self._send_seq += 1
+            seq = self._send_seq
+            key = self._send_key
+            epoch = self.epoch
+            self.sealed += 1
+        aad = HDR.pack(MAGIC, epoch & 0xFFFF, 0, seq)
+        nonce = seq.to_bytes(8, "little") + b"\x00\x00\x00\x00"
+        return aad + crypto.aead_seal(key, nonce, aad, bytes(buf))
+
+    def open(self, frame: bytes) -> bytes:
+        if len(frame) < OVERHEAD:
+            raise DecryptError("frame too short")
+        aad = frame[:HDR.size]
+        magic, epoch, _res, seq = HDR.unpack(aad)
+        with self._lock:
+            if magic != MAGIC:
+                self.rejected += 1
+                raise DecryptError("bad magic")
+            if epoch != (self.epoch & 0xFFFF):
+                self.rejected += 1
+                raise DecryptError(
+                    f"epoch {epoch} != local {self.epoch & 0xFFFF} "
+                    "(peer rotated?)")
+            if seq <= self._recv_seq:
+                self.rejected += 1
+                raise DecryptError(f"replayed/reordered seq {seq}")
+            key = self._recv_key
+        nonce = seq.to_bytes(8, "little") + b"\x00\x00\x00\x00"
+        pt = crypto.aead_open(key, nonce, aad, frame[HDR.size:])
+        if pt is None:
+            with self._lock:
+                self.rejected += 1
+            raise DecryptError("authentication failed")
+        with self._lock:
+            # accept AFTER authentication: a forged seq must not
+            # advance the replay window
+            if seq > self._recv_seq:
+                self._recv_seq = seq
+            self.opened += 1
+        return pt
+
+
+class EncryptionManager:
+    """Publishes this node's pubkey, tracks peers' keys from the node
+    registry, hands out channels (pkg/wireguard agent half).
+
+    ``advertise`` augments the info dict the daemon registers; call
+    ``refresh`` after node churn (or rely on lazy channel creation)."""
+
+    def __init__(self, node_name: str, registry,
+                 key_path: Optional[str] = None, epoch: int = 0):
+        self.node_name = node_name
+        self.registry = registry
+        self.keypair = NodeKeypair.load_or_create(key_path)
+        self.epoch = epoch
+        self._channels: Dict[str, EncryptedChannel] = {}
+        self._lock = threading.Lock()
+
+    def advertise(self, info: dict) -> dict:
+        info = dict(info)
+        info[PUBKEY_FIELD] = self.keypair.public.hex()
+        return info
+
+    def peer_public(self, node: str) -> Optional[bytes]:
+        for n in self.registry.nodes():
+            if n.get("name") == node and n.get(PUBKEY_FIELD):
+                return bytes.fromhex(n[PUBKEY_FIELD])
+        return None
+
+    def channel(self, node: str) -> EncryptedChannel:
+        with self._lock:
+            ch = self._channels.get(node)
+            if ch is not None:
+                return ch
+        pub = self.peer_public(node)
+        if pub is None:
+            raise KeyError(f"node {node!r} has no published "
+                           f"{PUBKEY_FIELD}")
+        ch = EncryptedChannel(self.keypair, pub, self.epoch)
+        with self._lock:
+            return self._channels.setdefault(node, ch)
+
+    def rotate(self, epoch: int) -> None:
+        """Bump the key epoch for every channel (both sides must
+        rotate; frames sealed under the old epoch reject afterward)."""
+        with self._lock:
+            self.epoch = epoch
+            for ch in self._channels.values():
+                ch.rotate(epoch)
+
+    def drop(self, node: str) -> None:
+        with self._lock:
+            self._channels.pop(node, None)
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "public-key": self.keypair.public.hex(),
+                "epoch": self.epoch,
+                "peers": {
+                    n: {"sealed": c.sealed, "opened": c.opened,
+                        "rejected": c.rejected}
+                    for n, c in self._channels.items()},
+            }

@@ -41,6 +41,7 @@ DROP_REASON_DESC = {
     5: "NO_MAPPING_FOR_NAT_MASQUERADING",  # SNAT pool exhausted
     6: "BANDWIDTH_LIMITED",  # egress rate limit (EDT analogue)
     7: "NO_SERVICE",  # frontend with no backend (DROP_NO_SERVICE)
+    8: "AUTH_REQUIRED",  # mutual auth missing (pkg/auth)
 }
 
 

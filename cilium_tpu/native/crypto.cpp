@@ -1,0 +1,411 @@
+// Transparent-encryption primitives (the WireGuard-analogue crypto).
+//
+// Reference: upstream cilium's --enable-wireguard encrypts node-to-node
+// pod traffic through the kernel's wireguard device (Curve25519 key
+// exchange + ChaCha20-Poly1305 AEAD, per packet).  Here the same
+// primitives run in the framework's own native layer — RFC 7748 X25519
+// and RFC 8439 ChaCha20-Poly1305 — and seal whole BATCH buffers at the
+// node boundary (one AEAD per batch, not per packet; see
+// cilium_tpu/encryption).  No third-party code: both primitives are
+// implemented from their RFCs and validated against the RFC test
+// vectors (tests/test_encryption.py).
+//
+// Build: g++ -O3 -shared -fPIC (driven by cilium_tpu/native/crypto.py,
+// content-addressed like ingest.cpp).
+
+#include <cstdint>
+#include <cstring>
+
+typedef uint8_t u8;
+typedef uint32_t u32;
+typedef uint64_t u64;
+typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------------------
+// X25519 — RFC 7748.  GF(2^255-19) as 5 x 51-bit limbs.
+
+struct fe { u64 v[5]; };
+
+static const u64 MASK51 = 0x7FFFFFFFFFFFFULL;
+
+static void fe_copy(fe &o, const fe &a) { o = a; }
+
+static void fe_add(fe &o, const fe &a, const fe &b) {
+    for (int i = 0; i < 5; i++) o.v[i] = a.v[i] + b.v[i];
+}
+
+// o = a - b + 8p (bias keeps limbs positive; inputs < 2^52)
+static void fe_sub(fe &o, const fe &a, const fe &b) {
+    static const u64 B0 = 0x3FFFFFFFFFFF68ULL;  // 8 * (2^51 - 19)
+    static const u64 BI = 0x3FFFFFFFFFFFF8ULL;  // 8 * (2^51 - 1)
+    o.v[0] = a.v[0] + B0 - b.v[0];
+    for (int i = 1; i < 5; i++) o.v[i] = a.v[i] + BI - b.v[i];
+}
+
+static void fe_carry(fe &o) {
+    u64 c;
+    c = o.v[0] >> 51; o.v[0] &= MASK51; o.v[1] += c;
+    c = o.v[1] >> 51; o.v[1] &= MASK51; o.v[2] += c;
+    c = o.v[2] >> 51; o.v[2] &= MASK51; o.v[3] += c;
+    c = o.v[3] >> 51; o.v[3] &= MASK51; o.v[4] += c;
+    c = o.v[4] >> 51; o.v[4] &= MASK51; o.v[0] += 19 * c;
+    c = o.v[0] >> 51; o.v[0] &= MASK51; o.v[1] += c;
+}
+
+static void fe_mul(fe &o, const fe &a, const fe &b) {
+    u128 t0 = (u128)a.v[0] * b.v[0]
+            + (u128)(19 * a.v[1]) * b.v[4] + (u128)(19 * a.v[2]) * b.v[3]
+            + (u128)(19 * a.v[3]) * b.v[2] + (u128)(19 * a.v[4]) * b.v[1];
+    u128 t1 = (u128)a.v[0] * b.v[1] + (u128)a.v[1] * b.v[0]
+            + (u128)(19 * a.v[2]) * b.v[4] + (u128)(19 * a.v[3]) * b.v[3]
+            + (u128)(19 * a.v[4]) * b.v[2];
+    u128 t2 = (u128)a.v[0] * b.v[2] + (u128)a.v[1] * b.v[1]
+            + (u128)a.v[2] * b.v[0]
+            + (u128)(19 * a.v[3]) * b.v[4] + (u128)(19 * a.v[4]) * b.v[3];
+    u128 t3 = (u128)a.v[0] * b.v[3] + (u128)a.v[1] * b.v[2]
+            + (u128)a.v[2] * b.v[1] + (u128)a.v[3] * b.v[0]
+            + (u128)(19 * a.v[4]) * b.v[4];
+    u128 t4 = (u128)a.v[0] * b.v[4] + (u128)a.v[1] * b.v[3]
+            + (u128)a.v[2] * b.v[2] + (u128)a.v[3] * b.v[1]
+            + (u128)a.v[4] * b.v[0];
+    u64 c;
+    c = (u64)(t0 >> 51); o.v[0] = (u64)t0 & MASK51; t1 += c;
+    c = (u64)(t1 >> 51); o.v[1] = (u64)t1 & MASK51; t2 += c;
+    c = (u64)(t2 >> 51); o.v[2] = (u64)t2 & MASK51; t3 += c;
+    c = (u64)(t3 >> 51); o.v[3] = (u64)t3 & MASK51; t4 += c;
+    c = (u64)(t4 >> 51); o.v[4] = (u64)t4 & MASK51;
+    o.v[0] += 19 * c;
+    c = o.v[0] >> 51; o.v[0] &= MASK51; o.v[1] += c;
+}
+
+static void fe_sq(fe &o, const fe &a) { fe_mul(o, a, a); }
+
+static void fe_mul121665(fe &o, const fe &a) {
+    u128 t;
+    u64 c = 0;
+    for (int i = 0; i < 5; i++) {
+        t = (u128)a.v[i] * 121665 + c;
+        o.v[i] = (u64)t & MASK51;
+        c = (u64)(t >> 51);
+    }
+    o.v[0] += 19 * c;
+    c = o.v[0] >> 51; o.v[0] &= MASK51; o.v[1] += c;
+}
+
+// o = z^(p-2) (inversion): p-2 = 2^255 - 21 = 250 ones then 01011
+static void fe_invert(fe &o, const fe &z) {
+    fe r;
+    fe_copy(r, z);
+    for (int i = 1; i < 250; i++) { fe_sq(r, r); fe_mul(r, r, z); }
+    fe_sq(r, r);                    // bit 0
+    fe_sq(r, r); fe_mul(r, r, z);   // bit 1
+    fe_sq(r, r);                    // bit 0
+    fe_sq(r, r); fe_mul(r, r, z);   // bit 1
+    fe_sq(r, r); fe_mul(r, r, z);   // bit 1
+    fe_copy(o, r);
+}
+
+static void fe_frombytes(fe &o, const u8 s[32]) {
+    u64 w[4];
+    memcpy(w, s, 32);
+    o.v[0] = w[0] & MASK51;
+    o.v[1] = ((w[0] >> 51) | (w[1] << 13)) & MASK51;
+    o.v[2] = ((w[1] >> 38) | (w[2] << 26)) & MASK51;
+    o.v[3] = ((w[2] >> 25) | (w[3] << 39)) & MASK51;
+    o.v[4] = (w[3] >> 12) & MASK51;  // masks the top bit (RFC 7748)
+}
+
+static void fe_tobytes(u8 s[32], const fe &a) {
+    fe t = a;
+    fe_carry(t);
+    fe_carry(t);
+    // q = 1 iff t >= p  (computed as whether t + 19 overflows 2^255)
+    u64 q = (t.v[0] + 19) >> 51;
+    q = (t.v[1] + q) >> 51;
+    q = (t.v[2] + q) >> 51;
+    q = (t.v[3] + q) >> 51;
+    q = (t.v[4] + q) >> 51;
+    t.v[0] += 19 * q;
+    u64 c;
+    c = t.v[0] >> 51; t.v[0] &= MASK51; t.v[1] += c;
+    c = t.v[1] >> 51; t.v[1] &= MASK51; t.v[2] += c;
+    c = t.v[2] >> 51; t.v[2] &= MASK51; t.v[3] += c;
+    c = t.v[3] >> 51; t.v[3] &= MASK51; t.v[4] += c;
+    t.v[4] &= MASK51;  // drop the 2^255 carry (== subtracting p+19q)
+    u64 w[4];
+    w[0] = t.v[0] | (t.v[1] << 51);
+    w[1] = (t.v[1] >> 13) | (t.v[2] << 38);
+    w[2] = (t.v[2] >> 26) | (t.v[3] << 25);
+    w[3] = (t.v[3] >> 39) | (t.v[4] << 12);
+    memcpy(s, w, 32);
+}
+
+static void fe_cswap(fe &a, fe &b, u64 swap) {
+    u64 m = (u64)0 - swap;
+    for (int i = 0; i < 5; i++) {
+        u64 x = m & (a.v[i] ^ b.v[i]);
+        a.v[i] ^= x;
+        b.v[i] ^= x;
+    }
+}
+
+extern "C" int x25519(u8 out[32], const u8 scalar[32],
+                      const u8 point[32]) {
+    u8 k[32];
+    memcpy(k, scalar, 32);
+    k[0] &= 248; k[31] &= 127; k[31] |= 64;  // clamp
+    fe x1, x2, z2, x3, z3, a, aa, b, bb, e, c, d, da, cb, t;
+    fe_frombytes(x1, point);
+    memset(&x2, 0, sizeof x2); x2.v[0] = 1;
+    memset(&z2, 0, sizeof z2);
+    fe_copy(x3, x1);
+    memset(&z3, 0, sizeof z3); z3.v[0] = 1;
+    u64 swap = 0;
+    for (int t_ = 254; t_ >= 0; t_--) {
+        u64 kt = (k[t_ >> 3] >> (t_ & 7)) & 1;
+        swap ^= kt;
+        fe_cswap(x2, x3, swap);
+        fe_cswap(z2, z3, swap);
+        swap = kt;
+        fe_add(a, x2, z2);  fe_carry(a);
+        fe_sq(aa, a);
+        fe_sub(b, x2, z2);  fe_carry(b);
+        fe_sq(bb, b);
+        fe_sub(e, aa, bb);  fe_carry(e);
+        fe_add(c, x3, z3);  fe_carry(c);
+        fe_sub(d, x3, z3);  fe_carry(d);
+        fe_mul(da, d, a);
+        fe_mul(cb, c, b);
+        fe_add(t, da, cb);  fe_carry(t);
+        fe_sq(x3, t);
+        fe_sub(t, da, cb);  fe_carry(t);
+        fe_sq(t, t);
+        fe_mul(z3, x1, t);
+        fe_mul(x2, aa, bb);
+        // z2 = E * (AA + a24*E), a24 = 121665 (RFC 7748; the ref10
+        // 121666 variant pairs with BB, not AA)
+        fe_mul121665(t, e);
+        fe_add(t, aa, t);   fe_carry(t);
+        fe_mul(z2, e, t);
+    }
+    fe_cswap(x2, x3, swap);
+    fe_cswap(z2, z3, swap);
+    fe_invert(z2, z2);
+    fe_mul(x2, x2, z2);
+    fe_tobytes(out, x2);
+    // RFC 7748: an all-zero output means a low-order point
+    u8 zero = 0;
+    for (int i = 0; i < 32; i++) zero |= out[i];
+    return zero ? 0 : -1;
+}
+
+extern "C" int x25519_base(u8 out[32], const u8 scalar[32]) {
+    u8 base[32] = {9};
+    return x25519(out, scalar, base);
+}
+
+// ---------------------------------------------------------------------------
+// ChaCha20 — RFC 8439 §2.3.
+
+static inline u32 rotl(u32 x, int n) { return (x << n) | (x >> (32 - n)); }
+
+#define QR(a, b, c, d) \
+    a += b; d ^= a; d = rotl(d, 16); \
+    c += d; b ^= c; b = rotl(b, 12); \
+    a += b; d ^= a; d = rotl(d, 8);  \
+    c += d; b ^= c; b = rotl(b, 7);
+
+static void chacha_block(u8 out[64], const u32 key[8], u32 counter,
+                         const u32 nonce[3]) {
+    u32 s[16] = {0x61707865, 0x3320646e, 0x79622d32, 0x6b206574,
+                 key[0], key[1], key[2], key[3],
+                 key[4], key[5], key[6], key[7],
+                 counter, nonce[0], nonce[1], nonce[2]};
+    u32 w[16];
+    memcpy(w, s, sizeof w);
+    for (int i = 0; i < 10; i++) {
+        QR(w[0], w[4], w[8],  w[12])
+        QR(w[1], w[5], w[9],  w[13])
+        QR(w[2], w[6], w[10], w[14])
+        QR(w[3], w[7], w[11], w[15])
+        QR(w[0], w[5], w[10], w[15])
+        QR(w[1], w[6], w[11], w[12])
+        QR(w[2], w[7], w[8],  w[13])
+        QR(w[3], w[4], w[9],  w[14])
+    }
+    for (int i = 0; i < 16; i++) {
+        u32 v = w[i] + s[i];
+        memcpy(out + 4 * i, &v, 4);
+    }
+}
+
+static void chacha_xor(u8 *data, long len, const u32 key[8],
+                       u32 counter, const u32 nonce[3]) {
+    u8 block[64];
+    long off = 0;
+    while (off < len) {
+        chacha_block(block, key, counter++, nonce);
+        long n = len - off < 64 ? len - off : 64;
+        for (long i = 0; i < n; i++) data[off + i] ^= block[i];
+        off += n;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Poly1305 — RFC 8439 §2.5 (26-bit limbs).
+
+struct poly1305 {
+    u32 r[5], h[5], pad[4];
+};
+
+static void poly_init(poly1305 &st, const u8 key[32]) {
+    u32 t[4];
+    memcpy(t, key, 16);
+    st.r[0] = t[0] & 0x3ffffff;
+    st.r[1] = ((t[0] >> 26) | (t[1] << 6)) & 0x3ffff03;
+    st.r[2] = ((t[1] >> 20) | (t[2] << 12)) & 0x3ffc0ff;
+    st.r[3] = ((t[2] >> 14) | (t[3] << 18)) & 0x3f03fff;
+    st.r[4] = (t[3] >> 8) & 0x00fffff;
+    memset(st.h, 0, sizeof st.h);
+    memcpy(st.pad, key + 16, 16);
+}
+
+static void poly_blocks(poly1305 &st, const u8 *m, long len, u32 hibit) {
+    u32 r0 = st.r[0], r1 = st.r[1], r2 = st.r[2], r3 = st.r[3],
+        r4 = st.r[4];
+    u32 s1 = r1 * 5, s2 = r2 * 5, s3 = r3 * 5, s4 = r4 * 5;
+    u32 h0 = st.h[0], h1 = st.h[1], h2 = st.h[2], h3 = st.h[3],
+        h4 = st.h[4];
+    while (len >= 16) {
+        u32 t[4];
+        memcpy(t, m, 16);
+        h0 += t[0] & 0x3ffffff;
+        h1 += ((t[0] >> 26) | ((u64)t[1] << 6)) & 0x3ffffff;
+        h2 += ((t[1] >> 20) | ((u64)t[2] << 12)) & 0x3ffffff;
+        h3 += ((t[2] >> 14) | ((u64)t[3] << 18)) & 0x3ffffff;
+        h4 += (t[3] >> 8) | hibit;
+        u64 d0 = (u64)h0 * r0 + (u64)h1 * s4 + (u64)h2 * s3
+               + (u64)h3 * s2 + (u64)h4 * s1;
+        u64 d1 = (u64)h0 * r1 + (u64)h1 * r0 + (u64)h2 * s4
+               + (u64)h3 * s3 + (u64)h4 * s2;
+        u64 d2 = (u64)h0 * r2 + (u64)h1 * r1 + (u64)h2 * r0
+               + (u64)h3 * s4 + (u64)h4 * s3;
+        u64 d3 = (u64)h0 * r3 + (u64)h1 * r2 + (u64)h2 * r1
+               + (u64)h3 * r0 + (u64)h4 * s4;
+        u64 d4 = (u64)h0 * r4 + (u64)h1 * r3 + (u64)h2 * r2
+               + (u64)h3 * r1 + (u64)h4 * r0;
+        u64 c;
+        c = d0 >> 26; h0 = (u32)d0 & 0x3ffffff; d1 += c;
+        c = d1 >> 26; h1 = (u32)d1 & 0x3ffffff; d2 += c;
+        c = d2 >> 26; h2 = (u32)d2 & 0x3ffffff; d3 += c;
+        c = d3 >> 26; h3 = (u32)d3 & 0x3ffffff; d4 += c;
+        c = d4 >> 26; h4 = (u32)d4 & 0x3ffffff;
+        h0 += (u32)c * 5;
+        c = h0 >> 26; h0 &= 0x3ffffff; h1 += (u32)c;
+        m += 16;
+        len -= 16;
+    }
+    st.h[0] = h0; st.h[1] = h1; st.h[2] = h2; st.h[3] = h3; st.h[4] = h4;
+}
+
+static void poly_finish(poly1305 &st, u8 mac[16]) {
+    u32 h0 = st.h[0], h1 = st.h[1], h2 = st.h[2], h3 = st.h[3],
+        h4 = st.h[4];
+    u32 c;
+    c = h1 >> 26; h1 &= 0x3ffffff; h2 += c;
+    c = h2 >> 26; h2 &= 0x3ffffff; h3 += c;
+    c = h3 >> 26; h3 &= 0x3ffffff; h4 += c;
+    c = h4 >> 26; h4 &= 0x3ffffff; h0 += c * 5;
+    c = h0 >> 26; h0 &= 0x3ffffff; h1 += c;
+    // compute h + -p
+    u32 g0 = h0 + 5; c = g0 >> 26; g0 &= 0x3ffffff;
+    u32 g1 = h1 + c; c = g1 >> 26; g1 &= 0x3ffffff;
+    u32 g2 = h2 + c; c = g2 >> 26; g2 &= 0x3ffffff;
+    u32 g3 = h3 + c; c = g3 >> 26; g3 &= 0x3ffffff;
+    u32 g4 = h4 + c - (1u << 26);
+    u32 mask = (g4 >> 31) - 1;  // all-ones when h >= p
+    h0 = (h0 & ~mask) | (g0 & mask);
+    h1 = (h1 & ~mask) | (g1 & mask);
+    h2 = (h2 & ~mask) | (g2 & mask);
+    h3 = (h3 & ~mask) | (g3 & mask);
+    h4 = (h4 & ~mask) | (g4 & mask);
+    u64 f;
+    u32 out[4];
+    f = (u64)(h0 | (h1 << 26)) + st.pad[0];
+    out[0] = (u32)f;
+    f = (u64)((h1 >> 6) | (h2 << 20)) + st.pad[1] + (f >> 32);
+    out[1] = (u32)f;
+    f = (u64)((h2 >> 12) | (h3 << 14)) + st.pad[2] + (f >> 32);
+    out[2] = (u32)f;
+    f = (u64)((h3 >> 18) | (h4 << 8)) + st.pad[3] + (f >> 32);
+    out[3] = (u32)f;
+    memcpy(mac, out, 16);
+}
+
+// ---------------------------------------------------------------------------
+// AEAD_CHACHA20_POLY1305 — RFC 8439 §2.8.
+
+// AEAD pads each section (AAD, ciphertext) to 16 with ZEROS — not the
+// raw-poly1305 1-marker tail:
+static void poly_update_padded(poly1305 &st, const u8 *m, long len) {
+    long full = len & ~15L;
+    if (full) poly_blocks(st, m, full, 1u << 24);
+    if (len & 15) {
+        u8 block[16] = {0};
+        memcpy(block, m + full, len & 15);
+        poly_blocks(st, block, 16, 1u << 24);
+    }
+}
+
+static void aead_tag(u8 mac[16], const u32 key_words[8],
+                     const u32 nonce[3], const u8 *aad, long aad_len,
+                     const u8 *ct, long ct_len) {
+    u8 polykey[64];
+    chacha_block(polykey, key_words, 0, nonce);
+    poly1305 st;
+    poly_init(st, polykey);
+    poly_update_padded(st, aad, aad_len);
+    poly_update_padded(st, ct, ct_len);
+    u8 lens[16];
+    u64 al = (u64)aad_len, cl = (u64)ct_len;
+    memcpy(lens, &al, 8);
+    memcpy(lens + 8, &cl, 8);
+    poly_blocks(st, lens, 16, 1u << 24);
+    poly_finish(st, mac);
+}
+
+static void load_key(u32 kw[8], const u8 key[32]) { memcpy(kw, key, 32); }
+
+static void load_nonce(u32 nw[3], const u8 nonce[12]) {
+    memcpy(nw, nonce, 12);
+}
+
+extern "C" long aead_seal(const u8 key[32], const u8 nonce[12],
+                          const u8 *aad, long aad_len,
+                          const u8 *pt, long pt_len, u8 *out) {
+    u32 kw[8], nw[3];
+    load_key(kw, key);
+    load_nonce(nw, nonce);
+    memcpy(out, pt, pt_len);
+    chacha_xor(out, pt_len, kw, 1, nw);
+    aead_tag(out + pt_len, kw, nw, aad, aad_len, out, pt_len);
+    return pt_len + 16;
+}
+
+extern "C" long aead_open(const u8 key[32], const u8 nonce[12],
+                          const u8 *aad, long aad_len,
+                          const u8 *ct, long ct_len, u8 *out) {
+    if (ct_len < 16) return -1;
+    long pt_len = ct_len - 16;
+    u32 kw[8], nw[3];
+    load_key(kw, key);
+    load_nonce(nw, nonce);
+    u8 tag[16];
+    aead_tag(tag, kw, nw, aad, aad_len, ct, pt_len);
+    u8 diff = 0;
+    for (int i = 0; i < 16; i++) diff |= tag[i] ^ ct[pt_len + i];
+    if (diff) return -1;
+    memcpy(out, ct, pt_len);
+    chacha_xor(out, pt_len, kw, 1, nw);
+    return pt_len;
+}

@@ -414,6 +414,23 @@ def _fqdn_from_obj(obj) -> str:
 # ---------------------------------------------------------------------------
 # Ingress / Egress rules
 
+AUTH_MODES = ("", "required", "disabled")
+
+
+def _auth_mode(d: dict) -> str:
+    """Rule-level mutual authentication (reference: api.Rule
+    Authentication, cilium 1.14+ pkg/auth): ``required`` gates the
+    entry's allows behind a live authmap entry; ``disabled``
+    explicitly opts out.  Unknown modes are rejected — silently
+    ignoring one would drop the operator's auth requirement."""
+    auth = d.get("authentication")
+    if not auth:
+        return ""
+    mode = str(auth.get("mode", ""))
+    if mode not in AUTH_MODES:
+        raise ValueError(f"unknown authentication mode {mode!r}")
+    return mode
+
 
 @dataclass(frozen=True)
 class IngressRule:
@@ -421,10 +438,12 @@ class IngressRule:
     from_cidr: Tuple[CIDRRule, ...] = ()
     from_entities: Tuple[Entity, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
+    auth_mode: str = ""  # "" | "required" | "disabled"
 
     @staticmethod
     def from_dict(d: dict) -> "IngressRule":
         return IngressRule(
+            auth_mode=_auth_mode(d),
             from_endpoints=tuple(EndpointSelector.from_dict(s)
                                  for s in d.get("fromEndpoints") or ()),
             from_cidr=tuple(CIDRRule.from_obj(c)
@@ -450,6 +469,7 @@ class EgressRule:
     to_entities: Tuple[Entity, ...] = ()
     to_ports: Tuple[PortRule, ...] = ()
     to_fqdns: Tuple[str, ...] = ()
+    auth_mode: str = ""  # "" | "required" | "disabled"
 
     @staticmethod
     def from_dict(d: dict) -> "EgressRule":
@@ -464,6 +484,7 @@ class EgressRule:
                 "cache: import the policy as a CiliumNetworkPolicy "
                 "through the k8s watcher path")
         return EgressRule(
+            auth_mode=_auth_mode(d),
             to_endpoints=tuple(EndpointSelector.from_dict(s)
                                for s in d.get("toEndpoints") or ()),
             to_cidr=tuple(CIDRRule.from_obj(c)
@@ -604,6 +625,8 @@ def _ingress_to_dict(r: IngressRule) -> dict:
         d["fromEntities"] = list(r.from_entities)
     if r.to_ports:
         d["toPorts"] = [_ports_to_dict(p) for p in r.to_ports]
+    if r.auth_mode:
+        d["authentication"] = {"mode": r.auth_mode}
     return d
 
 
@@ -624,6 +647,8 @@ def _egress_to_dict(r: EgressRule) -> dict:
             for f in r.to_fqdns]
     if r.to_ports:
         d["toPorts"] = [_ports_to_dict(p) for p in r.to_ports]
+    if r.auth_mode:
+        d["authentication"] = {"mode": r.auth_mode}
     return d
 
 

@@ -44,10 +44,14 @@ from .resolve import EndpointPolicy
 
 VERDICT_MASK = 0xFF
 PROXY_SHIFT = 8
+PROXY_MASK = 0xFFFF
+AUTH_SHIFT = 24  # bit 24: mutual-auth-required (pkg/auth analogue)
 
 
-def pack_entry(verdict: int, proxy_port: int = 0) -> int:
-    return (verdict & VERDICT_MASK) | (proxy_port << PROXY_SHIFT)
+def pack_entry(verdict: int, proxy_port: int = 0,
+               auth: bool = False) -> int:
+    return ((verdict & VERDICT_MASK) | (proxy_port << PROXY_SHIFT)
+            | (int(bool(auth)) << AUTH_SHIFT))
 
 
 def unpack_verdict(packed: np.ndarray) -> np.ndarray:
@@ -55,7 +59,34 @@ def unpack_verdict(packed: np.ndarray) -> np.ndarray:
 
 
 def unpack_proxy(packed: np.ndarray) -> np.ndarray:
-    return packed >> PROXY_SHIFT
+    return (packed >> PROXY_SHIFT) & PROXY_MASK
+
+
+def unpack_auth(packed: np.ndarray) -> np.ndarray:
+    return (packed >> AUTH_SHIFT) & 1
+
+
+def packed_scatter_order(ms):
+    """(contribution, packed value) pairs in SCATTER order.
+
+    Both the full compile and the incremental ``compose_row`` write
+    with last-writer-wins scatters, while the oracle's winner is the
+    FIRST covering contribution of its precedence class (with
+    redirects beating plain allows) — so each class iterates
+    REVERSED, and denies go last.  ONE definition so the two tensor
+    paths can never desynchronize."""
+    out = []
+    for c in reversed(ms.contributions):
+        if not c.is_deny and not c.redirect:
+            out.append((c, pack_entry(VERDICT_ALLOW, auth=c.auth)))
+    for c in reversed(ms.contributions):
+        if c.redirect and not c.is_deny:
+            out.append((c, pack_entry(VERDICT_REDIRECT, c.proxy_port,
+                                      auth=c.auth)))
+    for c in ms.contributions:
+        if c.is_deny:
+            out.append((c, pack_entry(VERDICT_DENY)))
+    return out
 
 
 def make_proto_table() -> np.ndarray:
@@ -281,31 +312,17 @@ def compile_policy(
             default = (pack_entry(VERDICT_DEFAULT_DENY) if ms.enforcing
                        else pack_entry(VERDICT_ALLOW))
             verdict[pi, di, :, :] = default
-            plain = [c for c in ms.contributions
-                     if not c.is_deny and not c.redirect]
-            # reversed: oracle gives the FIRST covering redirect's proxy
-            # port; last writer wins in the scatter.
-            redirs = [c for c in reversed(ms.contributions)
-                      if c.redirect and not c.is_deny]
-            denies = [c for c in ms.contributions if c.is_deny]
-            for group, value_of in (
-                (plain, lambda c: pack_entry(VERDICT_ALLOW)),
-                (redirs, lambda c: pack_entry(VERDICT_REDIRECT,
-                                              c.proxy_port)),
-                (denies, lambda c: pack_entry(VERDICT_DENY)),
-            ):
-                for c in group:
-                    protos = (range(N_PROTO) if c.proto == PROTO_ANY
-                              else [c.proto])
-                    cls = np.unique(np.concatenate(
-                        [classes_for(p, c.lo, c.hi) for p in protos]))
-                    val = value_of(c)
-                    if c.identities is None:
-                        verdict[pi, di][:, cls] = val
-                    else:
-                        rows = row_map.rows_for(c.identities)
-                        if rows.size:
-                            verdict[pi, di][np.ix_(rows, cls)] = val
+            for c, val in packed_scatter_order(ms):
+                protos = (range(N_PROTO) if c.proto == PROTO_ANY
+                          else [c.proto])
+                cls = np.unique(np.concatenate(
+                    [classes_for(p, c.lo, c.hi) for p in protos]))
+                if c.identities is None:
+                    verdict[pi, di][:, cls] = val
+                else:
+                    rows = row_map.rows_for(c.identities)
+                    if rows.size:
+                        verdict[pi, di][np.ix_(rows, cls)] = val
 
     return PolicyTensors(
         proto_table=make_proto_table(),

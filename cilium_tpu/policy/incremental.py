@@ -28,7 +28,8 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
-from .compiler import PolicyTensors, pack_entry
+from .compiler import (PolicyTensors, pack_entry,
+                       packed_scatter_order)
 from .mapstate import (
     N_PROTO,
     PROTO_ANY,
@@ -92,24 +93,13 @@ def compose_row(policies: Sequence[EndpointPolicy], numeric_id: int,
             default = (pack_entry(VERDICT_DEFAULT_DENY) if ms.enforcing
                        else pack_entry(VERDICT_ALLOW))
             out[pi, di, :] = default
-            plain = [c for c in ms.contributions
-                     if not c.is_deny and not c.redirect]
-            redirs = [c for c in reversed(ms.contributions)
-                      if c.redirect and not c.is_deny]
-            denies = [c for c in ms.contributions if c.is_deny]
-            for group, value_of in (
-                (plain, lambda c: pack_entry(VERDICT_ALLOW)),
-                (redirs, lambda c: pack_entry(VERDICT_REDIRECT,
-                                              c.proxy_port)),
-                (denies, lambda c: pack_entry(VERDICT_DENY)),
-            ):
-                for c in group:
-                    if (c.identities is not None
-                            and numeric_id not in c.identities):
-                        continue
-                    protos = (range(N_PROTO) if c.proto == PROTO_ANY
-                              else [c.proto])
-                    cls = np.unique(np.concatenate(
-                        [classes_for(p, c.lo, c.hi) for p in protos]))
-                    out[pi, di, cls] = value_of(c)
+            for c, val in packed_scatter_order(ms):
+                if (c.identities is not None
+                        and numeric_id not in c.identities):
+                    continue
+                protos = (range(N_PROTO) if c.proto == PROTO_ANY
+                          else [c.proto])
+                cls = np.unique(np.concatenate(
+                    [classes_for(p, c.lo, c.hi) for p in protos]))
+                out[pi, di, cls] = val
     return out

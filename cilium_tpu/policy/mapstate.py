@@ -81,6 +81,9 @@ class Contribution:
     hi: int
     redirect: bool = False
     proxy_port: int = 0
+    # mutual authentication required before this grant forwards
+    # (reference: api.Rule Authentication -> MapStateEntry auth type)
+    auth: bool = False
     rule_label: str = ""
     selectors: Tuple = ()  # Tuple[EndpointSelector, ...]
     fqdn_patterns: Tuple[str, ...] = ()
@@ -137,21 +140,29 @@ class MapState:
     def lookup(self, identity: int, proto: int, port: int
                ) -> Tuple[int, int]:
         """Oracle verdict: returns (verdict, proxy_port)."""
+        v, p, _a = self.lookup_full(identity, proto, port)
+        return v, p
+
+    def lookup_full(self, identity: int, proto: int, port: int
+                    ) -> Tuple[int, int, bool]:
+        """(verdict, proxy_port, auth_required) — auth is the WINNING
+        allow contribution's flag (denies and default verdicts never
+        require auth; there is nothing to gate)."""
         allow: Optional[Contribution] = None
         for c in self.contributions:
             if not c.covers(identity, proto, port):
                 continue
             if c.is_deny:
-                return VERDICT_DENY, 0
+                return VERDICT_DENY, 0, False
             if allow is None or (c.redirect and not allow.redirect):
                 allow = c
         if allow is not None:
             if allow.redirect:
-                return VERDICT_REDIRECT, allow.proxy_port
-            return VERDICT_ALLOW, 0
+                return VERDICT_REDIRECT, allow.proxy_port, allow.auth
+            return VERDICT_ALLOW, 0, allow.auth
         if self.enforcing:
-            return VERDICT_DEFAULT_DENY, 0
-        return VERDICT_ALLOW, 0
+            return VERDICT_DEFAULT_DENY, 0, False
+        return VERDICT_ALLOW, 0, False
 
     def to_entries(self) -> Dict[PolicyKey, PolicyEntry]:
         """Materialize cilium-style map entries (for CLI/diff display)."""

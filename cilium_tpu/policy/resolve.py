@@ -74,6 +74,12 @@ class EndpointPolicy:
                port: int) -> Tuple[int, int]:
         return self.mapstate(direction).lookup(identity, proto, port)
 
+    def lookup_full(self, direction: int, identity: int, proto: int,
+                    port: int) -> Tuple[int, int, bool]:
+        """(verdict, proxy, auth_required) — see MapState.lookup_full."""
+        return self.mapstate(direction).lookup_full(identity, proto,
+                                                    port)
+
 
 # Policy enforcement modes (reference: pkg/option PolicyEnforcement —
 # "default" enforces iff a rule selects the endpoint, "always" is
@@ -287,7 +293,7 @@ def resolve_policy(
         label = ",".join(rule.labels) or rule.description
 
         def emit(ms: MapState, peers: PeerSet,
-                 to_ports, is_deny: bool) -> None:
+                 to_ports, is_deny: bool, auth: bool = False) -> None:
             # named ports are direction-relative (reference): ingress
             # names the SUBJECT's own container ports; egress names the
             # DESTINATION's, which could be any pod — the node-wide
@@ -304,6 +310,7 @@ def resolve_policy(
                     redirects.append((proxy_port, label, l7))
                 ms.contributions.append(Contribution(
                     is_deny=is_deny,
+                    auth=auth and not is_deny,
                     identities=peers.ids,
                     proto=proto,
                     lo=lo,
@@ -319,7 +326,8 @@ def resolve_policy(
             peers = _peer_identities(r.from_endpoints, r.from_cidr,
                                      r.from_entities, selector_cache,
                                      allocator)
-            emit(ing, peers, r.to_ports, is_deny=False)
+            emit(ing, peers, r.to_ports, is_deny=False,
+                 auth=r.auth_mode == "required")
         for r in rule.ingress_deny:
             peers = _peer_identities(r.from_endpoints, r.from_cidr,
                                      r.from_entities, selector_cache,
@@ -329,7 +337,8 @@ def resolve_policy(
             peers = _peer_identities(r.to_endpoints, r.to_cidr,
                                      r.to_entities, selector_cache,
                                      allocator, fqdns=r.to_fqdns)
-            emit(egr, peers, r.to_ports, is_deny=False)
+            emit(egr, peers, r.to_ports, is_deny=False,
+                 auth=r.auth_mode == "required")
         for r in rule.egress_deny:
             peers = _peer_identities(r.to_endpoints, r.to_cidr,
                                      r.to_entities, selector_cache,

@@ -86,6 +86,9 @@ class OracleDatapath:
     def __init__(self, ep_policies: Dict[int, EndpointPolicy],
                  ipcache: Dict[str, int]):
         self.ep_policies = ep_policies
+        # mutual-auth grants: (subject labels key, remote numeric
+        # identity) -> expires (the authmap; see Loader.auth_upsert)
+        self.auth: Dict[Tuple[str, int], int] = {}
         self.ipcache: List[Tuple[int, int, int, int]] = []  # ver, net, plen, id
         # host-route fast path: /32 (v4) and /128 (v6) are the longest
         # possible prefixes, so an exact hit always wins LPM — keeps the
@@ -158,7 +161,8 @@ class OracleDatapath:
         (REASON_NO_SERVICE): upstream's LB lookup runs before the
         endpoint program, so it wins over policy AND the lxcmap
         gate, and touches no CT state."""
-        from ..datapath.verdict import (REASON_NAT_EXHAUSTED,
+        from ..datapath.verdict import (REASON_AUTH_REQUIRED,
+                                        REASON_NAT_EXHAUSTED,
                                         REASON_NO_SERVICE)
 
         results: List[OracleResult] = []
@@ -217,14 +221,24 @@ class OracleDatapath:
                                 related))
                 continue
             proto_idx = int(self.proto_table[int(row[COL_PROTO])])
-            p_verdict, p_proxy = pol.lookup(dirn, ident, proto_idx,
-                                            int(row[COL_DPORT]))
+            p_verdict, p_proxy, p_auth = pol.lookup_full(
+                dirn, ident, proto_idx, int(row[COL_DPORT]))
             if ct_res != CT_NEW:
                 # a related ICMP error is forwarded, never redirected
                 proxy = 0 if ct_res == CT_RELATED else entry.proxy
                 verdict = VERDICT_REDIRECT if proxy > 0 else VERDICT_ALLOW
                 reason = REASON_FORWARDED
                 event = EV_TRACE
+            elif p_verdict in (VERDICT_ALLOW, VERDICT_REDIRECT) and (
+                    p_auth and self.auth.get(
+                        (pol.subject_labels.sorted_key(), ident),
+                        0) <= now):
+                # policy allows but mutual auth is missing/expired:
+                # drop AUTH_REQUIRED, touch nothing (pkg/auth)
+                proxy = 0
+                verdict = VERDICT_DENY
+                reason = REASON_AUTH_REQUIRED
+                event = EV_DROP
             elif p_verdict in (VERDICT_ALLOW, VERDICT_REDIRECT):
                 proxy = p_proxy if p_verdict == VERDICT_REDIRECT else 0
                 verdict = p_verdict

@@ -1,0 +1,212 @@
+"""Mutual authentication (reference: upstream pkg/auth, cilium 1.14+):
+``authentication.mode: required`` policy entries drop un-authenticated
+NEW flows with AUTH_REQUIRED, the agent's auth manager handshakes and
+grants, retried traffic forwards, grants expire and GC, and
+established flows ride the CT fast path through expiry.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.agent.auth import AuthError, DenyAuthProvider
+from cilium_tpu.core import TCP_ACK, TCP_SYN, make_batch
+from cilium_tpu.datapath.verdict import (REASON_AUTH_REQUIRED,
+                                         REASON_FORWARDED)
+
+NS = "k8s:io.kubernetes.pod.namespace=default"
+
+
+def _world(backend="interpreter", auth_ttl=60, mesh_auth=True):
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12,
+                            mesh_auth=mesh_auth, auth_ttl=auth_ttl))
+    web = d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web", NS])
+    d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db", NS])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{
+            "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+            "toPorts": [{"ports": [{"port": "5432",
+                                    "protocol": "TCP"}]}],
+            "authentication": {"mode": "required"},
+        }],
+    }])
+    db = d.endpoints.lookup_by_ip("10.0.2.1")
+    return d, db
+
+
+def _pkt(d, db, sport, flags=TCP_SYN, now=50):
+    ev = d.process_batch(make_batch([
+        dict(src="10.0.1.1", dst="10.0.2.1", sport=sport, dport=5432,
+             proto=6, flags=flags, ep=db.id, dir=0)
+    ]).data, now=now)
+    return int(ev.reason[0])
+
+
+class TestMutualAuth:
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_drop_then_handshake_then_forward(self, backend):
+        d, db = _world(backend)
+        # first packet: policy allows but no grant -> AUTH_REQUIRED;
+        # the manager observes the drop and handshakes synchronously
+        assert _pkt(d, db, 41000, now=50) == REASON_AUTH_REQUIRED
+        assert d.auth_manager.granted == 1
+        # the retry (next batch) forwards
+        assert _pkt(d, db, 41000, now=51) == REASON_FORWARDED
+        # and the grant is visible to `bpf auth list`
+        (entry,) = d.loader.auth_entries()
+        assert entry["expires"] == 50 + 60
+        web = d.endpoints.lookup_by_ip("10.0.1.1")
+        assert entry["remote_identity"] == web.identity.numeric_id
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_established_flows_survive_grant_expiry(self, backend):
+        """Upstream judges auth at policy time (NEW) only: an
+        established connection keeps flowing after its grant
+        expires; a NEW flow re-authenticates."""
+        d, db = _world(backend, auth_ttl=20)
+        assert _pkt(d, db, 41000, now=50) == REASON_AUTH_REQUIRED
+        assert _pkt(d, db, 41000, now=51) == REASON_FORWARDED
+        # grant (TTL 20) long expired, CT entry (SYN lifetime 60)
+        # still live: the EST flow rides the fast path
+        assert _pkt(d, db, 41000, flags=TCP_ACK,
+                    now=100) == REASON_FORWARDED
+        # a NEW flow must re-handshake
+        assert _pkt(d, db, 42000, now=101) == REASON_AUTH_REQUIRED
+        assert _pkt(d, db, 42000, now=102) == REASON_FORWARDED
+
+    def test_deny_provider_keeps_dropping(self):
+        d, db = _world()
+        d.auth_manager.provider = DenyAuthProvider()
+        assert _pkt(d, db, 41000, now=50) == REASON_AUTH_REQUIRED
+        assert _pkt(d, db, 41000, now=51) == REASON_AUTH_REQUIRED
+        assert d.auth_manager.failed >= 1
+        assert d.auth_manager.granted == 0
+        # failures back off: within retry_s no second handshake runs
+        failures = d.auth_manager.failed
+        assert _pkt(d, db, 41001, now=52) == REASON_AUTH_REQUIRED
+        assert d.auth_manager.failed == failures
+
+    def test_mesh_auth_disabled_drops_forever(self):
+        d, db = _world(mesh_auth=False)
+        assert d.auth_manager is None
+        for i in range(3):
+            assert _pkt(d, db, 41000 + i,
+                        now=50 + i) == REASON_AUTH_REQUIRED
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_rules_without_auth_unaffected(self, backend):
+        d, db = _world(backend)
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                "toPorts": [{"ports": [{"port": "5432",
+                                        "protocol": "TCP"}]}],
+                "authentication": {"mode": "required"},
+            }, {
+                "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                "toPorts": [{"ports": [{"port": "8080",
+                                        "protocol": "TCP"}]}],
+            }],
+        }])
+        ev = d.process_batch(make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=43000,
+                 dport=8080, proto=6, flags=TCP_SYN, ep=db.id, dir=0)
+        ]).data, now=50)
+        assert int(ev.reason[0]) == REASON_FORWARDED
+
+    def test_gc_sweeps_expired_grants(self):
+        d, db = _world(auth_ttl=60)
+        _pkt(d, db, 41000, now=50)
+        assert len(d.loader.auth_entries()) == 1
+        assert d.auth_manager.gc(now=300) == 1
+        assert d.loader.auth_entries() == []
+
+    def test_reserved_identity_handshake_fails(self):
+        """reserved:world holds no workload certificate upstream."""
+        d, db = _world()
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEntities": ["world"],
+                "authentication": {"mode": "required"},
+            }],
+        }])
+        ev = d.process_batch(make_batch([
+            dict(src="198.51.100.9", dst="10.0.2.1", sport=41000,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0)
+        ]).data, now=50)
+        assert int(ev.reason[0]) == REASON_AUTH_REQUIRED
+        assert d.auth_manager.failed >= 1
+        assert d.auth_manager.granted == 0
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_recycled_identity_row_does_not_inherit_grant(self,
+                                                          backend):
+        """An identity row freed by incremental churn and handed to a
+        NEW identity must not carry the previous occupant's live
+        grant (the device auth column is re-projected per patch)."""
+        from cilium_tpu.labels import LabelSet
+
+        d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12,
+                                auth_ttl=600))
+        db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db", NS])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"team": "blue"}}],
+                "authentication": {"mode": "required"},
+            }],
+        }])
+        # identity churn lands as INCREMENTAL row patches only on a
+        # started daemon (the recycle path under test)
+        d.start()
+
+        def flow(src, sp, now):
+            ev = d.process_batch(make_batch([
+                dict(src=src, dst="10.0.2.1", sport=sp, dport=5432,
+                     proto=6, flags=TCP_SYN, ep=db.id, dir=0)
+            ]).data, now=now)
+            return int(ev.reason[0])
+
+        try:
+            a = d.allocator.allocate(
+                LabelSet.parse("k8s:team=blue", "k8s:pod=a"))
+            d.upsert_ipcache("10.8.0.1/32", a.numeric_id)
+            assert flow("10.8.0.1", 41000, 50) == REASON_AUTH_REQUIRED
+            assert flow("10.8.0.1", 41000, 51) == REASON_FORWARDED
+            # the identity churns away; its row becomes reusable
+            d.delete_ipcache("10.8.0.1/32")
+            d.allocator.release(a)
+            b = d.allocator.allocate(
+                LabelSet.parse("k8s:team=blue", "k8s:pod=b"))
+            d.upsert_ipcache("10.8.0.2/32", b.numeric_id)
+            # a NEW flow from the newcomer must re-handshake — not
+            # ride the dead identity's grant through the recycled row
+            assert flow("10.8.0.2", 42000,
+                        52) == REASON_AUTH_REQUIRED
+        finally:
+            d.shutdown()
+
+    def test_unknown_auth_mode_rejected(self):
+        d, _db = _world()
+        with pytest.raises(ValueError, match="authentication mode"):
+            d.policy_import([{
+                "endpointSelector": {"matchLabels": {"app": "db"}},
+                "ingress": [{"authentication": {"mode": "maybe"}}],
+            }])
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_grants_survive_regeneration(self, backend):
+        """The authmap is a BPF map upstream — policy regeneration
+        must not wipe live grants (host dict reprojects on attach)."""
+        d, db = _world(backend)
+        assert _pkt(d, db, 41000, now=50) == REASON_AUTH_REQUIRED
+        assert _pkt(d, db, 41000, now=51) == REASON_FORWARDED
+        # unrelated policy import forces a full regeneration/attach
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "other"}},
+            "ingress": [{}],
+        }])
+        assert _pkt(d, db, 44000, now=52) == REASON_FORWARDED
