@@ -86,6 +86,7 @@ class AuthManager:
         self.retry_s = retry_s
         self.granted = 0
         self.failed = 0
+        self.deferred = 0  # handshake OK but device apply deferred
         self._lock = threading.Lock()
         # (ep, remote) -> earliest retry time, for failed handshakes
         self._backoff: Dict[Tuple[int, int], int] = {}
@@ -122,8 +123,15 @@ class AuthManager:
             return False
         ok = self.daemon.loader.auth_upsert(ep_id, remote, now + ttl)
         with self._lock:
-            self.granted += 1
-            self._backoff.pop((ep_id, remote), None)
+            if ok:
+                self.granted += 1
+                self._backoff.pop((ep_id, remote), None)
+            else:
+                # handshake succeeded but the loader could not apply
+                # (endpoint/identity row gone or not yet attached):
+                # damp retries like a failure, count separately
+                self.deferred += 1
+                self._backoff[(ep_id, remote)] = now + self.retry_s
         return ok
 
     def gc(self, now: int) -> int:
@@ -138,4 +146,5 @@ class AuthManager:
         with self._lock:
             return {"provider": self.provider.name,
                     "granted": self.granted, "failed": self.failed,
+                    "deferred": self.deferred,
                     "pending-backoff": len(self._backoff)}

@@ -861,6 +861,42 @@ class Daemon:
                 self.auth_manager.observe(batch, self._now())
             self.monitor.publish(self._filter_events(batch))
 
+    # -- transparent encryption (pkg/wireguard analogue) --------------
+    def seal_batch(self, peer: str, frames: bytes) -> bytes:
+        """Seal a packed wire-frame buffer for ``peer`` — the egress
+        half of node-to-node transparent encryption (the cilium_wg0
+        transmit leg; one AEAD per batch)."""
+        if self.encryption is None:
+            raise RuntimeError("encryption disabled "
+                               "(DaemonConfig.enable_encryption)")
+        return self.encryption.channel(peer).seal(frames)
+
+    def ingest_encrypted(self, peer: str, frame: bytes, ep: int = 0,
+                         direction: int = 0,
+                         now: Optional[int] = None) -> EventBatch:
+        """The ingress half: open a sealed batch from ``peer``, parse
+        the wire frames through the native packed path, and verdict
+        them — decrypt-then-datapath, exactly the wg-device receive
+        leg.  Raises encryption.DecryptError on tamper/replay."""
+        if self.encryption is None:
+            raise RuntimeError("encryption disabled "
+                               "(DaemonConfig.enable_encryption)")
+        wire = self.encryption.channel(peer).open(frame)
+        from .. import native
+
+        got = native.parse_frames_packed(wire)
+        if got is None:
+            got = native.parse_frames_packed_py(wire)
+        rows, n, _skipped = got
+        import jax.numpy as jnp
+
+        from ..core.packets import unpack_hdr
+
+        hdr = np.asarray(unpack_hdr(jnp.asarray(rows[:n]),
+                                    jnp.uint32(ep),
+                                    jnp.uint32(direction)))
+        return self.process_batch(hdr, now=now)
+
     def socklb_entries(self, limit: int = 1000) -> list:
         """Decode the socket-LB flow cache for GET /map/lb
         (`cilium-tpu bpf lb list`).  ``socklb_stage_jit`` DONATES the
@@ -997,6 +1033,10 @@ class Daemon:
                 if self.nat is not None
                 and hasattr(self.loader, "nat_status") else None))
                else {}),
+            **({"auth": self.auth_manager.status()}
+               if self.auth_manager is not None else {}),
+            **({"encryption": self.encryption.status()}
+               if self.encryption is not None else {}),
         }
 
     def _eps_by_state(self) -> Dict[str, int]:

@@ -39,9 +39,39 @@ def cmd_status(args) -> int:
     print(f"Endpoints: {eps['total']} ({eps['by-state']})")
     print(f"Datapath:  {st['forwarded']} forwarded, "
           f"{st['dropped']} dropped, {st['flows-seen']} flows seen")
+    if "auth" in st:
+        a = st["auth"]
+        print(f"Auth:      provider={a['provider']} "
+              f"granted={a['granted']} failed={a['failed']}")
+    if "encryption" in st:
+        e = st["encryption"]
+        print(f"Encrypt:   wireguard-analogue epoch={e['epoch']} "
+              f"peers={len(e['peers'])} "
+              f"pubkey={e['public-key'][:16]}...")
     for name, c in st.get("controllers", {}).items():
         ok = "ok" if not c["last-error"] else f"FAILING: {c['last-error']}"
         print(f"Controller {name}: {c['success']} runs, {ok}")
+    return 0
+
+
+def cmd_encrypt(args) -> int:
+    """`cilium-tpu encrypt status` (reference: cilium encrypt
+    status)."""
+    st = _client(args).healthz()
+    enc = st.get("encryption")
+    if args.json:
+        _print(enc or {"enabled": False})
+        return 0
+    if not enc:
+        print("Encryption: disabled")
+        return 0
+    print(f"Encryption: wireguard-analogue (X25519 + "
+          f"ChaCha20-Poly1305, batch-sealed)")
+    print(f"Public key: {enc['public-key']}")
+    print(f"Key epoch:  {enc['epoch']}")
+    for peer, c in enc["peers"].items():
+        print(f"Peer {peer}: sealed={c['sealed']} "
+              f"opened={c['opened']} rejected={c['rejected']}")
     return 0
 
 
@@ -452,6 +482,10 @@ def main(argv=None) -> int:
     p.add_argument("action", nargs="?", default="list")
     p.add_argument("id", nargs="?", type=int, default=0)
 
+    p = sub.add_parser("encrypt", help="encrypt status")
+    p.add_argument("action", nargs="?", default="status",
+                   choices=["status"])
+
     sub.add_parser("egress", help="egress-gateway rules (expanded)")
     sub.add_parser("map", help="list datapath maps")
     sub.add_parser("metrics", help="prometheus metrics")
@@ -509,6 +543,7 @@ def main(argv=None) -> int:
             "health": cmd_health, "config": cmd_config,
             "proxy": cmd_proxy,
             "egress": cmd_egress,
+            "encrypt": cmd_encrypt,
         }.get(args.cmd)
         if handler is None:
             parser.print_help()

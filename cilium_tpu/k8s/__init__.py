@@ -190,6 +190,64 @@ def expand_cnp_services(obj: dict, services_view) -> dict:
     return obj
 
 
+def cnp_cidr_group_refs(obj: dict) -> set:
+    """Names of every CiliumCIDRGroup the CNP references via
+    fromCIDRSet/toCIDRSet ``cidrGroupRef`` entries."""
+    refs = set()
+    specs = ([obj.get("spec")] if obj.get("spec") else []) + \
+        list(obj.get("specs") or ())
+    for spec in specs:
+        for section in ("ingress", "ingressDeny", "egress",
+                        "egressDeny"):
+            for e in spec.get(section) or ():
+                for key in ("fromCIDRSet", "toCIDRSet"):
+                    for c in e.get(key) or ():
+                        if isinstance(c, dict) and c.get("cidrGroupRef"):
+                            refs.add(c["cidrGroupRef"])
+    return refs
+
+
+def expand_cnp_cidr_groups(obj: dict, groups) -> dict:
+    """Deep-copy a CNP, replacing ``cidrGroupRef`` entries with the
+    referenced group's CIDRs (reference: pkg/policy CIDRGroupRef
+    resolution against CiliumCIDRGroup.spec.externalCIDRs).  A ref to
+    a MISSING/empty group expands to the unmatchable ``0.0.0.0/32``
+    — fail closed, never widen."""
+    if not cnp_cidr_group_refs(obj):
+        return obj
+    import copy
+    obj = copy.deepcopy(obj)
+    specs = ([obj["spec"]] if obj.get("spec") else []) + \
+        list(obj.get("specs") or ())
+    for spec in specs:
+        for section in ("ingress", "ingressDeny", "egress",
+                        "egressDeny"):
+            for e in spec.get(section) or ():
+                for key in ("fromCIDRSet", "toCIDRSet"):
+                    if not e.get(key):
+                        continue
+                    out = []
+                    for c in e[key]:
+                        if not (isinstance(c, dict)
+                                and c.get("cidrGroupRef")):
+                            out.append(c)
+                            continue
+                        cidrs = groups.get(c["cidrGroupRef"]) or ()
+                        exc = list(c.get("except") or ())
+                        if cidrs:
+                            # the entry's 'except' carve-outs apply to
+                            # every expanded CIDR — dropping them
+                            # would WIDEN the policy
+                            out.extend(
+                                {"cidr": x,
+                                 **({"except": exc} if exc else {})}
+                                for x in cidrs)
+                        else:
+                            out.append({"cidr": "0.0.0.0/32"})
+                    e[key] = out
+    return obj
+
+
 def cnp_has_to_services(obj: dict) -> bool:
     specs = ([obj.get("spec")] if obj.get("spec") else []) + \
         list(obj.get("specs") or ())
@@ -219,17 +277,23 @@ class CNPWatcher:
     egress entries: they expand to the referenced services' peer IPs
     at import, and :meth:`resync_services` (wired to service/
     endpoints churn by the hub) re-expands affected CNPs — skipping
-    the repository round-trip when the expansion is unchanged."""
+    the repository round-trip when the expansion is unchanged.
+    ``groups`` (a CIDRGroupWatcher, optional) likewise enables
+    ``cidrGroupRef`` entries (CiliumCIDRGroup expansion), re-expanded
+    via :meth:`resync_cidr_groups`."""
 
-    def __init__(self, repo, services=None):
+    def __init__(self, repo, services=None, groups=None):
         self.repo = repo
         self.services = services
+        self.groups = groups
         # CNPs carrying toServices:
         #   key -> (raw obj, last expansion, named-ref keys, has_sel)
         # named-ref keys are the "<ns>/<name>" services the CNP names
         # via k8sService; has_sel marks k8sServiceSelector use (those
         # depend on EVERY service's labels, so any change re-expands)
         self._svc_cnps: Dict[str, tuple] = {}
+        # CNPs carrying cidrGroupRef: key -> (raw, last, group names)
+        self._group_cnps: Dict[str, tuple] = {}
 
     @staticmethod
     def _key(obj: dict) -> str:
@@ -260,16 +324,32 @@ class CNPWatcher:
         return named, has_sel
 
     def _expand(self, obj: dict) -> dict:
-        if not cnp_has_to_services(obj):
-            self._svc_cnps.pop(self._key(obj), None)
-            return obj
-        if self.services is None:
+        key = self._key(obj)
+        has_svc = cnp_has_to_services(obj)
+        grefs = cnp_cidr_group_refs(obj)
+        if has_svc and self.services is None:
             raise ValueError("toServices needs a service view "
                              "(CNPWatcher(services=...))")
-        expanded = expand_cnp_services(obj, self.services)
-        named, has_sel = self._service_refs(obj)
-        self._svc_cnps[self._key(obj)] = (obj, expanded, named,
-                                          has_sel)
+        if grefs and self.groups is None:
+            raise ValueError("cidrGroupRef needs a CiliumCIDRGroup "
+                             "view (CNPWatcher(groups=...))")
+        expanded = obj
+        if has_svc:
+            expanded = expand_cnp_services(expanded, self.services)
+        if grefs:
+            expanded = expand_cnp_cidr_groups(expanded, self.groups)
+        # both trackers record the FULLY expanded form: the
+        # unchanged-skip in either resync compares against
+        # _reexpand's full composition
+        if has_svc:
+            named, has_sel = self._service_refs(obj)
+            self._svc_cnps[key] = (obj, expanded, named, has_sel)
+        else:
+            self._svc_cnps.pop(key, None)
+        if grefs:
+            self._group_cnps[key] = (obj, expanded, grefs)
+        else:
+            self._group_cnps.pop(key, None)
         return expanded
 
     def on_add(self, obj: dict) -> int:
@@ -282,6 +362,7 @@ class CNPWatcher:
 
     def on_delete(self, obj: dict) -> int:
         self._svc_cnps.pop(self._key(obj), None)
+        self._group_cnps.pop(self._key(obj), None)
         return self.repo.delete_by_labels(cnp_identity_labels(obj))
 
     def resync_services(self, changed: str = None) -> int:
@@ -295,9 +376,40 @@ class CNPWatcher:
             if changed is not None and not has_sel \
                     and changed not in named:
                 continue
-            fresh = expand_cnp_services(raw, self.services)
+            fresh = self._reexpand(raw)
             if fresh != last:
                 self._svc_cnps[key] = (raw, fresh, named, has_sel)
+                self.repo.delete_by_labels(cnp_identity_labels(raw))
+                self.repo.add_list(rules_from_cnp(fresh))
+                n += 1
+        return n
+
+    def _reexpand(self, raw: dict) -> dict:
+        """Full re-expansion (services THEN groups — the import-time
+        composition order), keeping the group tracking in step when a
+        service-driven resync moves a CNP that also carries refs."""
+        fresh = raw
+        if cnp_has_to_services(raw) and self.services is not None:
+            fresh = expand_cnp_services(fresh, self.services)
+        grefs = cnp_cidr_group_refs(raw)
+        if grefs and self.groups is not None:
+            fresh = expand_cnp_cidr_groups(fresh, self.groups)
+            self._group_cnps[self._key(raw)] = (raw, fresh, grefs)
+        return fresh
+
+    def resync_cidr_groups(self, changed: str = None) -> int:
+        """CiliumCIDRGroup churn: re-expand CNPs referencing the
+        changed group (None = all)."""
+        n = 0
+        for key, (raw, last, grefs) in list(self._group_cnps.items()):
+            if changed is not None and changed not in grefs:
+                continue
+            fresh = self._reexpand(raw)
+            if fresh != last:
+                self._group_cnps[key] = (raw, fresh, grefs)
+                if key in self._svc_cnps:
+                    named, has_sel = self._service_refs(raw)
+                    self._svc_cnps[key] = (raw, fresh, named, has_sel)
                 self.repo.delete_by_labels(cnp_identity_labels(raw))
                 self.repo.add_list(rules_from_cnp(fresh))
                 n += 1

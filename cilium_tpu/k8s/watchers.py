@@ -796,6 +796,37 @@ class LocalRedirectPolicyWatcher:
             self.daemon.services.delete(svc)
 
 
+class CIDRGroupWatcher:
+    """CiliumCIDRGroup objects -> named CIDR sets for policy
+    ``cidrGroupRef`` expansion (reference: pkg/policy CIDRGroupRef +
+    the CiliumCIDRGroup CRD, cilium 1.13+).  ``on_change`` fires with
+    the group name so the CNP watcher re-expands only dependents."""
+
+    def __init__(self):
+        self._groups: Dict[str, tuple] = {}
+        self.on_change = None
+
+    def _changed(self, name: str) -> None:
+        if self.on_change is not None:
+            self.on_change(name)
+
+    def on_add(self, obj: dict) -> None:
+        name = (obj.get("metadata") or {}).get("name", "")
+        spec = obj.get("spec") or {}
+        self._groups[name] = tuple(spec.get("externalCIDRs") or ())
+        self._changed(name)
+
+    on_update = on_add
+
+    def on_delete(self, obj: dict) -> None:
+        name = (obj.get("metadata") or {}).get("name", "")
+        self._groups.pop(name, None)
+        self._changed(name)
+
+    def get(self, name: str):
+        return self._groups.get(name)
+
+
 class CiliumNodeWatcher:
     """CiliumNode objects -> the kvstore node registry (what the
     health mesh probes and the operator's dead-node sweep reads;
@@ -842,8 +873,11 @@ class K8sWatcherHub:
                                for ip in ep.ips})
         daemon.endpoints.on_attach(
             lambda _p: self.services.resync())
-        self.cnp = CNPWatcher(daemon.repo, services=self.services)
+        self.cidr_groups = CIDRGroupWatcher()
+        self.cnp = CNPWatcher(daemon.repo, services=self.services,
+                              groups=self.cidr_groups)
         self.services.on_change = self.cnp.resync_services
+        self.cidr_groups.on_change = self.cnp.resync_cidr_groups
         self.pods = PodWatcher(daemon)
         self.namespaces = NamespaceWatcher(self.pods)
         self.pods.namespaces = self.namespaces
@@ -863,6 +897,7 @@ class K8sWatcherHub:
             "CiliumIdentity": self.identities,
             "CiliumEndpoint": self.ceps,
             "CiliumEndpointSlice": self.ces,
+            "CiliumCIDRGroup": self.cidr_groups,
             "CiliumEgressGatewayPolicy": self.egress,
             "CiliumLocalRedirectPolicy": self.lrp,
             "CiliumNode": self.nodes,

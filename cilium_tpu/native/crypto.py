@@ -92,6 +92,12 @@ def _clamp(k: bytes) -> int:
     return int.from_bytes(bytes(a), "little")
 
 
+class LowOrderPointError(ValueError):
+    """The peer's point is low-order: the shared secret would be the
+    all-zero string, i.e. derivable from PUBLIC data (RFC 7748 §6.1
+    mandates rejecting a zero output)."""
+
+
 def _x25519_py(scalar: bytes, point: bytes) -> bytes:
     k = _clamp(scalar)
     u = int.from_bytes(point, "little") & ((1 << 255) - 1)
@@ -118,6 +124,8 @@ def _x25519_py(scalar: bytes, point: bytes) -> bytes:
     if swap:
         x2, z2 = x3, z3
     out = x2 * pow(z2, _P - 2, _P) % _P
+    if out == 0:
+        raise LowOrderPointError("x25519: low-order point")
     return out.to_bytes(32, "little")
 
 
@@ -210,7 +218,8 @@ def x25519(scalar: bytes, point: bytes) -> bytes:
     if lib is None:
         return _x25519_py(scalar, point)
     out = ctypes.create_string_buffer(32)
-    lib.x25519(out, bytes(scalar), bytes(point))
+    if lib.x25519(out, bytes(scalar), bytes(point)) != 0:
+        raise LowOrderPointError("x25519: low-order point")
     return out.raw
 
 
@@ -219,7 +228,8 @@ def x25519_base(scalar: bytes) -> bytes:
     if lib is None:
         return _x25519_py(scalar, (9).to_bytes(32, "little"))
     out = ctypes.create_string_buffer(32)
-    lib.x25519_base(out, bytes(scalar))
+    if lib.x25519_base(out, bytes(scalar)) != 0:
+        raise LowOrderPointError("x25519: low-order scalar/point")
     return out.raw
 
 

@@ -386,6 +386,15 @@ class CIDRRule:
     def from_obj(obj) -> "CIDRRule":
         if isinstance(obj, str):
             return CIDRRule(cidr=obj)
+        if obj.get("cidrGroupRef"):
+            # like toServices: silently dropping the ref would leave
+            # the entry peer-less (an L3 wildcard).  The k8s layer
+            # expands group refs against the live CiliumCIDRGroup
+            # cache (upstream pkg/policy api CIDRGroupRef).
+            raise ValueError(
+                "cidrGroupRef must be expanded against the "
+                "CiliumCIDRGroup cache: import the policy as a "
+                "CiliumNetworkPolicy through the k8s watcher path")
         return CIDRRule(
             cidr=obj["cidr"],
             except_cidrs=tuple(obj.get("except") or ()),

@@ -1,0 +1,141 @@
+"""CiliumCIDRGroup (reference: pkg/policy CIDRGroupRef + the
+CiliumCIDRGroup CRD, cilium 1.13+): ``cidrGroupRef`` entries in
+fromCIDRSet/toCIDRSet expand against the live group cache, re-expand
+on group churn, and fail CLOSED when the group vanishes.
+"""
+
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.datapath.verdict import (REASON_FORWARDED,
+                                         REASON_POLICY_DEFAULT_DENY)
+from cilium_tpu.policy.api import rule_to_dict
+
+NS = "k8s:io.kubernetes.pod.namespace=default"
+
+
+def _daemon():
+    d = Daemon(DaemonConfig(backend="interpreter", ct_capacity=1 << 12))
+    d.add_endpoint("cli", ("10.0.9.9",), ["k8s:app=cli", NS])
+    return d
+
+
+def _group(cidrs, name="partners"):
+    return {"kind": "CiliumCIDRGroup",
+            "metadata": {"name": name},
+            "spec": {"externalCIDRs": list(cidrs)}}
+
+
+def _cnp(ref="partners"):
+    return {
+        "kind": "CiliumNetworkPolicy",
+        "metadata": {"name": "allow-partners",
+                     "namespace": "default"},
+        "spec": {
+            "endpointSelector": {"matchLabels": {"app": "cli"}},
+            "egress": [{"toCIDRSet": [{"cidrGroupRef": ref}]}],
+        },
+    }
+
+
+def _flow(d, dst, sport, now):
+    ep = d.endpoints.lookup_by_ip("10.0.9.9")
+    ev = d.process_batch(make_batch([
+        dict(src="10.0.9.9", dst=dst, sport=sport, dport=443,
+             proto=6, flags=TCP_SYN, ep=ep.id, dir=1)
+    ]).data, now=now)
+    return int(ev.reason[0])
+
+
+def _cidrs(d):
+    egress = rule_to_dict(d.repo.rules()[0])["egress"][0]
+    return {c["cidr"] for c in egress["toCIDRSet"]}
+
+
+class TestCIDRGroups:
+    def test_ref_expands_and_enforces(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _group(["203.0.113.0/24"]))
+        hub.dispatch("add", _cnp())
+        assert _cidrs(d) == {"203.0.113.0/24"}
+        assert _flow(d, "203.0.113.7", 41000, 50) == REASON_FORWARDED
+        assert _flow(d, "198.51.100.7", 41001,
+                     51) == REASON_POLICY_DEFAULT_DENY
+
+    def test_group_churn_re_expands(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _group(["203.0.113.0/24"]))
+        hub.dispatch("add", _cnp())
+        assert _flow(d, "198.51.100.7", 41010,
+                     50) == REASON_POLICY_DEFAULT_DENY
+        hub.dispatch("update", _group(["203.0.113.0/24",
+                                       "198.51.100.0/24"]))
+        assert _cidrs(d) == {"203.0.113.0/24", "198.51.100.0/24"}
+        assert _flow(d, "198.51.100.7", 41011, 51) == REASON_FORWARDED
+
+    def test_missing_group_fails_closed(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        # CNP lands BEFORE its group: matches nothing, not everything
+        hub.dispatch("add", _cnp())
+        assert _cidrs(d) == {"0.0.0.0/32"}
+        assert _flow(d, "203.0.113.7", 41020,
+                     50) == REASON_POLICY_DEFAULT_DENY
+        # the group appears: dependents re-expand
+        hub.dispatch("add", _group(["203.0.113.0/24"]))
+        assert _flow(d, "203.0.113.7", 41021, 51) == REASON_FORWARDED
+        # and vanishes again: fail closed
+        hub.dispatch("delete", _group([]))
+        assert _cidrs(d) == {"0.0.0.0/32"}
+        assert _flow(d, "203.0.113.9", 41022,
+                     52) == REASON_POLICY_DEFAULT_DENY
+
+    def test_plain_cidrs_ride_alongside_refs(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _group(["203.0.113.0/24"]))
+        cnp = _cnp()
+        cnp["spec"]["egress"][0]["toCIDRSet"].append(
+            {"cidr": "192.0.2.0/24"})
+        hub.dispatch("add", cnp)
+        assert _cidrs(d) == {"203.0.113.0/24", "192.0.2.0/24"}
+
+    def test_except_carveouts_survive_expansion(self):
+        """The ref entry's 'except' list applies to every expanded
+        CIDR — dropping it would WIDEN the policy."""
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _group(["203.0.113.0/24"]))
+        cnp = _cnp()
+        cnp["spec"]["egress"][0]["toCIDRSet"] = [
+            {"cidrGroupRef": "partners",
+             "except": ["203.0.113.128/25"]}]
+        hub.dispatch("add", cnp)
+        egress = rule_to_dict(d.repo.rules()[0])["egress"][0]
+        assert egress["toCIDRSet"] == [
+            {"cidr": "203.0.113.0/24",
+             "except": ["203.0.113.128/25"]}]
+        assert _flow(d, "203.0.113.7", 41030, 50) == REASON_FORWARDED
+        assert _flow(d, "203.0.113.200", 41031,
+                     51) == REASON_POLICY_DEFAULT_DENY
+
+    def test_unrelated_group_churn_skips_reimport(self):
+        d = _daemon()
+        hub = d.k8s_watchers()
+        hub.dispatch("add", _group(["203.0.113.0/24"]))
+        hub.dispatch("add", _cnp())
+        rev = d.repo.revision
+        hub.dispatch("add", _group(["10.99.0.0/16"], name="other"))
+        assert d.repo.revision == rev
+
+    def test_direct_import_rejected(self):
+        d = _daemon()
+        with pytest.raises(ValueError, match="cidrGroupRef"):
+            d.policy_import([{
+                "endpointSelector": {"matchLabels": {"app": "cli"}},
+                "egress": [{"toCIDRSet": [
+                    {"cidrGroupRef": "partners"}]}],
+            }])

@@ -167,21 +167,34 @@ class TestManagerEndToEnd:
         ]).data
         wire = frames_from_batch(batch)
 
-        sealed = d0.encryption.channel("node1").seal(wire)
+        # the DAEMON surface: seal on node0, decrypt-then-datapath
+        # on node1 (the wg-device transmit/receive legs)
+        sealed = d0.seal_batch("node1", wire)
         assert sealed != wire and len(sealed) == len(wire) + 32
 
-        opened = d1.encryption.channel("node0").open(sealed)
-        assert opened == wire
-        rows, n, skipped = native.parse_frames_packed(opened)
-        assert n == 32 and skipped == 0
-        from cilium_tpu.core.packets import unpack_hdr
-        import jax.numpy as jnp
-        hdr = np.asarray(unpack_hdr(jnp.asarray(rows[:n]),
-                                    jnp.uint32(web.id), jnp.uint32(0)))
-        ev = d1.process_batch(hdr, now=50)
+        ev = d1.ingest_encrypted("node0", sealed, ep=web.id,
+                                 direction=0, now=50)
         assert int((ev.reason == REASON_FORWARDED).sum()) == 32
         st = d1.encryption.status()
         assert st["peers"]["node0"]["opened"] == 1
+        # a replayed frame is rejected at the daemon surface too
+        from cilium_tpu.encryption import DecryptError
+        with pytest.raises(DecryptError):
+            d1.ingest_encrypted("node0", sealed, ep=web.id)
+
+    def test_low_order_pubkey_rejected(self):
+        """A peer publishing a low-order point must fail channel
+        setup, not silently derive keys from an all-zero secret."""
+        from cilium_tpu.native.crypto import LowOrderPointError
+        kv = InMemoryKVStore()
+        d0 = Daemon(DaemonConfig(node_name="node0",
+                                 backend="interpreter",
+                                 enable_encryption=True), kvstore=kv)
+        # forge a registry entry with an all-zero pubkey
+        from cilium_tpu.encryption import PUBKEY_FIELD
+        d0.node_registry.register("evil", {PUBKEY_FIELD: "00" * 32})
+        with pytest.raises(LowOrderPointError):
+            d0.encryption.channel("evil")
 
     def test_unknown_peer_raises(self):
         kv = InMemoryKVStore()
