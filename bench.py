@@ -741,6 +741,41 @@ def bench_socket_lb_scaling(counts=(512, 4096)) -> dict:
     }
 
 
+def bench_encryption(mb: int = 8, iters: int = 9) -> dict:
+    """Transparent-encryption throughput (host-side, no TPU): seal +
+    open of batch-sized buffers through the native ChaCha20-Poly1305
+    (native/crypto.cpp).  The unit of encryption is the BATCH (one
+    AEAD per batch, DIVERGENCES #24), so GB/s here bounds the
+    node-to-node encrypted plane; at 16 B/packet packed frames,
+    1 GB/s ~ 62M packets/s."""
+    from cilium_tpu.encryption import EncryptedChannel, NodeKeypair
+    from cilium_tpu.native import crypto
+
+    a, b = NodeKeypair(), NodeKeypair()
+    ca = EncryptedChannel(a, b.public)
+    cb = EncryptedChannel(b, a.public)
+    buf = bytes(np.random.default_rng(5).bytes(mb << 20))
+    ts_seal, ts_open = [], []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        frame = ca.seal(buf)
+        ts_seal.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        out = cb.open(frame)
+        ts_open.append(time.perf_counter() - t0)
+        assert out == buf
+    seal_gbps = (mb / (1 << 10)) / sorted(ts_seal)[len(ts_seal) // 2]
+    open_gbps = (mb / (1 << 10)) / sorted(ts_open)[len(ts_open) // 2]
+    return {
+        "native": crypto.available(),
+        "buffer_mb": mb,
+        "seal_gb_per_s": round(seal_gbps, 3),
+        "open_gb_per_s": round(open_gbps, 3),
+        "packed_pps_bound": round(min(seal_gbps, open_gbps)
+                                  * (1 << 30) / 16),
+    }
+
+
 def _run_socklb_phase() -> None:
     """--socklb: the socket-LB scaling phase standalone (one JSON
     line)."""
@@ -882,6 +917,7 @@ def main() -> None:
     artifact = _phase_subprocess("--artifact")
     l7 = bench_l7()
     anomaly = bench_anomaly()
+    encryption = bench_encryption()
     dev_pps = device.get("pps", 0) or 0
     print(json.dumps({
         "metric": "policy_verdicts_per_sec_per_chip",
@@ -895,6 +931,7 @@ def main() -> None:
         "socket_lb": socklb,
         "d2h_artifact": artifact,
         "l7": l7,
+        "encryption": encryption,
         "anomaly_auc": anomaly.get("value"),
         "anomaly": anomaly,
     }))

@@ -243,11 +243,18 @@ static void chacha_xor(u8 *data, long len, const u32 key[8],
                        u32 counter, const u32 nonce[3]) {
     u8 block[64];
     long off = 0;
-    while (off < len) {
+    while (off + 64 <= len) {  // full blocks: 8-byte-wide XOR
         chacha_block(block, key, counter++, nonce);
-        long n = len - off < 64 ? len - off : 64;
-        for (long i = 0; i < n; i++) data[off + i] ^= block[i];
-        off += n;
+        u64 d[8], b[8];
+        memcpy(d, data + off, 64);
+        memcpy(b, block, 64);
+        for (int i = 0; i < 8; i++) d[i] ^= b[i];
+        memcpy(data + off, d, 64);
+        off += 64;
+    }
+    if (off < len) {
+        chacha_block(block, key, counter, nonce);
+        for (long i = 0; off + i < len; i++) data[off + i] ^= block[i];
     }
 }
 
