@@ -745,11 +745,16 @@ def bench_encryption(mb: int = 8, iters: int = 9) -> dict:
     """Transparent-encryption throughput (host-side, no TPU): seal +
     open of batch-sized buffers through the native ChaCha20-Poly1305
     (native/crypto.cpp).  The unit of encryption is the BATCH (one
-    AEAD per batch, DIVERGENCES #24), so GB/s here bounds the
+    AEAD per batch, DIVERGENCES #24), so GiB/s here bounds the
     node-to-node encrypted plane; at 16 B/packet packed frames,
-    1 GB/s ~ 62M packets/s."""
+    1 GiB/s ~ 67M packets/s.  Without the native library (no g++)
+    the pure-Python fallback is orders of magnitude slower, so the
+    buffer shrinks to keep the phase bounded."""
     from cilium_tpu.encryption import EncryptedChannel, NodeKeypair
     from cilium_tpu.native import crypto
+
+    if not crypto.available():
+        mb, iters = 1, 3  # python-fallback path: keep it bounded
 
     a, b = NodeKeypair(), NodeKeypair()
     ca = EncryptedChannel(a, b.public)
