@@ -76,6 +76,9 @@ class DaemonConfig:
     # egress masquerade (bpf/lib/nat.h analogue; service/nat.py)
     masquerade: bool = False
     node_ip: Optional[str] = None
+    # additional node addresses nodePort frontends bind, beyond
+    # node_ip (reference: --nodeport-addresses)
+    nodeport_addresses: Tuple[str, ...] = ()
     non_masquerade_cidrs: Tuple[str, ...] = ("10.0.0.0/8",)
     # identity value-ref lease (reference: etcd lease on pkg/allocator
     # slave keys): None = unleased refs (single-process tests); set it
@@ -593,6 +596,14 @@ class Daemon:
                         self._socklb, self.services.tensors(),
                         jnp.asarray(np.ascontiguousarray(hdr_dev)),
                         jnp.uint32(now))
+                t6 = self.services.tensors6()
+                if t6 is not None:
+                    # dual-stack: v6 frontends ride the per-packet
+                    # pass (socklb judged only v4 rows)
+                    from ..service import lb6_stage_jit
+
+                    hdr_dev, _h6, nobe6 = lb6_stage_jit(t6, hdr_dev)
+                    svc_nobe = svc_nobe | nobe6
             else:
                 svc_nobe = None
             nat_drop = None

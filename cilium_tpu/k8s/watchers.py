@@ -126,9 +126,14 @@ class ServiceWatcher:
     routing.  ``sessionAffinity: ClientIP`` carries its timeout onto
     every frontend of the service."""
 
-    def __init__(self, services, node_ip=None, local_ips=None):
+    def __init__(self, services, node_ip=None, local_ips=None,
+                 nodeport_addresses=()):
         self.services = services  # ServiceManager
         self.node_ip = node_ip
+        # extra addresses nodePort frontends bind (reference:
+        # --nodeport-addresses; narrows DIVERGENCES #21 — upstream's
+        # catch-all binds every local address)
+        self.nodeport_addresses = tuple(nodeport_addresses)
         # () -> set of node-local pod IPs, snapshotted ONCE per
         # reconcile (a per-ip predicate would rescan the endpoint
         # registry ports x backends times per event)
@@ -205,18 +210,31 @@ class ServiceWatcher:
                 local = (backends if local_set is None else
                          [b for b in backends
                           if b.rsplit(":", 1)[0] in local_set])
-                if cluster_ip and cluster_ip != "None":  # headless:
-                    wanted[f"{key}:{pname}"] = (  # no clusterIP fe
-                        f"{cluster_ip}:{p.get('port')}",
+                # dual-stack: spec.clusterIPs may add a second-family
+                # VIP beyond the primary spec.clusterIP
+                cips: List[str] = []
+                for c in ([cluster_ip]
+                          + list(spec.get("clusterIPs") or ())):
+                    if c and c != "None" and c not in cips:
+                        cips.append(c)
+                for j, cip in enumerate(cips):
+                    suffix = "" if j == 0 else f"/ip{j}"
+                    wanted[f"{key}:{pname}{suffix}"] = (
+                        f"{cip}:{p.get('port')}",
                         local if int_local else backends,
                         proto, "ClusterIP", aff)
                 ext_be = local if ext_local else backends
                 node_port = p.get("nodePort")
-                if (stype in ("NodePort", "LoadBalancer")
-                        and node_port and self.node_ip):
-                    wanted[f"{key}:{pname}/nodeport"] = (
-                        f"{self.node_ip}:{node_port}", ext_be,
-                        proto, "NodePort", aff)
+                if stype in ("NodePort", "LoadBalancer") and node_port:
+                    addrs: List[str] = []
+                    for a in (self.node_ip,) + self.nodeport_addresses:
+                        if a and a not in addrs:  # dedup vs node_ip
+                            addrs.append(a)
+                    for i, addr in enumerate(addrs):
+                        suffix = "" if i == 0 else f"/{addr}"
+                        wanted[f"{key}:{pname}/nodeport{suffix}"] = (
+                            f"{addr}:{node_port}", ext_be,
+                            proto, "NodePort", aff)
                 for eip in spec.get("externalIPs") or ():
                     wanted[f"{key}:{pname}/external/{eip}"] = (
                         f"{eip}:{p.get('port')}", ext_be,
@@ -869,6 +887,7 @@ class K8sWatcherHub:
 
         self.services = ServiceWatcher(
             daemon.services, node_ip=daemon.config.node_ip,
+            nodeport_addresses=daemon.config.nodeport_addresses,
             local_ips=lambda: {ip for ep in daemon.endpoints.list()
                                for ip in ep.ips})
         daemon.endpoints.on_attach(

@@ -31,11 +31,14 @@ import numpy as np
 
 from ..core.packets import (
     COL_DPORT,
+    COL_DST_IP0,
     COL_DST_IP3,
     COL_FAMILY,
     COL_PROTO,
     COL_SPORT,
+    COL_SRC_IP0,
     COL_SRC_IP3,
+    ip_to_words,
 )
 
 M_DEFAULT = 16381  # prime; upstream --bpf-lb-maglev-table-size default
@@ -168,6 +171,44 @@ class LBTensors:
         return cls(*children, m=m)
 
 
+def _split_hostport(s: str) -> Tuple[str, int]:
+    """"ip:port" / "[v6]:port" / "v6:port" -> (ip, port)."""
+    if s.startswith("["):
+        host, _, port = s[1:].partition("]:")
+        return host, int(port)
+    host, _, port = s.rpartition(":")
+    return host, int(port)
+
+
+def _is_v6(ip: str) -> bool:
+    return ":" in ip
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class LBTensors6:
+    """Compiled V6 frontends (dual-stack services; reference:
+    lb6 maps).  Word layout matches the header tensor's 4-word
+    big-endian IP columns."""
+
+    svc_ip: jnp.ndarray  # [S, 4] uint32 frontend v6 words
+    svc_port: jnp.ndarray  # [S]
+    svc_proto: jnp.ndarray  # [S]
+    maglev: jnp.ndarray  # [S, M]
+    backend_ip: jnp.ndarray  # [B, 4]
+    backend_port: jnp.ndarray  # [B]
+    m: int
+
+    def tree_flatten(self):
+        return ((self.svc_ip, self.svc_port, self.svc_proto,
+                 self.maglev, self.backend_ip, self.backend_port),
+                self.m)
+
+    @classmethod
+    def tree_unflatten(cls, m, children):
+        return cls(*children, m=m)
+
+
 class ServiceManager:
     """The service registry + compiler (pkg/service analogue)."""
 
@@ -176,6 +217,7 @@ class ServiceManager:
         self._services: Dict[str, Service] = {}
         self.m = m
         self._tensors: Optional[LBTensors] = None
+        self._tensors6 = None  # LBTensors6 | False ("no v6") | None
         self._version = 0  # bumps on any upsert/delete (see .version)
 
     def upsert(self, name: str, frontend: str, backends: Sequence[str],
@@ -190,21 +232,23 @@ class ServiceManager:
         ``REASON_NO_SERVICE`` (upstream DROP_NO_SERVICE — a clusterIP
         with no ready endpoint, or externalTrafficPolicy=Local with no
         node-local backend, must not fall through to routing)."""
-        fip, fport = frontend.rsplit(":", 1)
+        fip, fport = _split_hostport(frontend)
         if weights is not None and len(weights) != len(backends):
             raise ValueError("weights length != backends length")
+        bes = []
+        for i, b in enumerate(backends):
+            bip, bport = _split_hostport(b)
+            bes.append(Backend(bip, bport,
+                               weight=(int(weights[i])
+                                       if weights is not None else 1)))
         svc = Service(name=name, frontend_ip=fip,
                       frontend_port=int(fport), protocol=protocol,
                       kind=kind, affinity_timeout=int(affinity_timeout),
-                      backends=[
-                          Backend(b.rsplit(":", 1)[0],
-                                  int(b.rsplit(":", 1)[1]),
-                                  weight=(int(weights[i])
-                                          if weights is not None else 1))
-                          for i, b in enumerate(backends)])
+                      backends=bes)
         with self._lock:
             self._services[name] = svc
             self._tensors = None
+            self._tensors6 = None
             self._version += 1
         return svc
 
@@ -213,6 +257,7 @@ class ServiceManager:
             gone = self._services.pop(name, None) is not None
             if gone:
                 self._tensors = None
+                self._tensors6 = None
                 self._version += 1
         return gone
 
@@ -230,7 +275,7 @@ class ServiceManager:
         with self._lock:
             return {(int(ipaddress.IPv4Address(b.ip)), b.port)
                     for s in self._services.values()
-                    for b in s.backends}
+                    for b in s.backends if not _is_v6(b.ip)}
 
     @property
     def any_affinity(self) -> bool:
@@ -256,8 +301,57 @@ class ServiceManager:
                 self._tensors = self._compile()
             return self._tensors
 
+    def tensors6(self) -> Optional[LBTensors6]:
+        """Compiled V6 frontends, or None when no service carries a
+        v6 frontend (the common all-v4 cluster skips the v6 pass
+        entirely)."""
+        with self._lock:
+            if self._tensors6 is None:
+                self._tensors6 = self._compile6()
+            return self._tensors6 or None
+
+    def _compile6(self):
+        svcs = [self._services[k] for k in sorted(self._services)
+                if _is_v6(self._services[k].frontend_ip)]
+        if not svcs:
+            return False  # cached "no v6" marker (None = stale)
+        s = len(svcs)
+        svc_ip = np.zeros((s, 4), dtype=np.uint32)
+        svc_port = np.zeros(s, dtype=np.uint32)
+        svc_proto = np.zeros(s, dtype=np.uint32)
+        maglev = np.full((s, self.m), -1, dtype=np.int32)
+        b_ip: List[Tuple[int, int, int, int]] = []
+        b_port: List[int] = []
+        for i, svc in enumerate(svcs):
+            svc_ip[i] = ip_to_words(svc.frontend_ip)
+            svc_port[i] = svc.frontend_port
+            svc_proto[i] = svc.protocol
+            base = len(b_ip)
+            # family consistency: a v6 frontend DNATs only to v6
+            # backends (k8s dual-stack slices are per-family)
+            bes = [be for be in svc.backends if _is_v6(be.ip)]
+            for be in bes:
+                b_ip.append(ip_to_words(be.ip))
+                b_port.append(be.port)
+            local = maglev_table([be.key for be in bes], self.m,
+                                 weights=[be.weight for be in bes])
+            maglev[i] = np.where(local >= 0, local + base, -1)
+        if not b_ip:
+            b_ip, b_port = [(0, 0, 0, 0)], [0]
+        return LBTensors6(
+            svc_ip=jnp.asarray(svc_ip),
+            svc_port=jnp.asarray(svc_port),
+            svc_proto=jnp.asarray(svc_proto),
+            maglev=jnp.asarray(maglev),
+            backend_ip=jnp.asarray(np.asarray(b_ip, dtype=np.uint32)),
+            backend_port=jnp.asarray(np.asarray(b_port,
+                                                dtype=np.uint32)),
+            m=self.m,
+        )
+
     def _compile(self) -> LBTensors:
-        svcs = [self._services[k] for k in sorted(self._services)]
+        svcs = [self._services[k] for k in sorted(self._services)
+                if not _is_v6(self._services[k].frontend_ip)]
         s = max(len(svcs), 1)
         svc_ip = np.zeros(s, dtype=np.uint32)
         svc_port = np.zeros(s, dtype=np.uint32)
@@ -272,12 +366,12 @@ class ServiceManager:
             svc_proto[i] = svc.protocol
             svc_aff[i] = svc.affinity_timeout
             base = len(b_ip)
-            for be in svc.backends:
+            bes = [be for be in svc.backends if not _is_v6(be.ip)]
+            for be in bes:
                 b_ip.append(int(ipaddress.IPv4Address(be.ip)))
                 b_port.append(be.port)
-            local = maglev_table([be.key for be in svc.backends], self.m,
-                                 weights=[be.weight
-                                          for be in svc.backends])
+            local = maglev_table([be.key for be in bes], self.m,
+                                 weights=[be.weight for be in bes])
             maglev[i] = np.where(local >= 0, local + base, -1)
         if not b_ip:
             b_ip, b_port = [0], [0]
@@ -336,3 +430,44 @@ def lb_stage(t: LBTensors, hdr: jnp.ndarray
 
 
 lb_stage_jit = jax.jit(lb_stage)
+
+
+def lb6_stage(t: LBTensors6, hdr: jnp.ndarray
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """The V6 frontend pass: 4-word dst compare + Maglev + DNAT.
+
+    Drop-in alongside :func:`lb_stage`/``socklb_stage`` (which judge
+    v4 rows only); composes AFTER them in the daemon — each pass
+    ignores the other family's rows.  V6 services ride this
+    per-packet path rather than the socket-LB flow cache (the cache
+    rows are v4-word-keyed; see DIVERGENCES #25)."""
+    hdr = hdr.astype(jnp.uint32)
+    dstw = hdr[:, COL_DST_IP0:COL_DST_IP0 + 4]
+    dport = hdr[:, COL_DPORT]
+    proto = hdr[:, COL_PROTO]
+    hit_s = ((dstw[:, None, :] == t.svc_ip[None, :, :]).all(-1)
+             & (dport[:, None] == t.svc_port[None, :])
+             & (proto[:, None] == t.svc_proto[None, :])
+             & (hdr[:, COL_FAMILY] == 6)[:, None])
+    svc = jnp.argmax(hit_s, axis=1).astype(jnp.int32)
+    hit = jnp.any(hit_s, axis=1)
+    srcw = hdr[:, COL_SRC_IP0:COL_SRC_IP0 + 4]
+    h = (srcw[:, 0] * jnp.uint32(0x9E3779B1)
+         ^ srcw[:, 1] * jnp.uint32(0x85EBCA6B)
+         ^ srcw[:, 2] * jnp.uint32(0xC2B2AE35)
+         ^ srcw[:, 3] * jnp.uint32(0x27D4EB2F)
+         ^ hdr[:, COL_SPORT] * jnp.uint32(0x165667B1)
+         ^ dstw[:, 3] ^ dport ^ proto)
+    slot = (h % jnp.uint32(t.m)).astype(jnp.int32)
+    be = t.maglev[svc, slot]
+    have = hit & (be >= 0)
+    no_backend = hit & (be < 0)
+    be_safe = jnp.maximum(be, 0)
+    new_dst = jnp.where(have[:, None], t.backend_ip[be_safe], dstw)
+    hdr = hdr.at[:, COL_DST_IP0:COL_DST_IP0 + 4].set(new_dst)
+    hdr = hdr.at[:, COL_DPORT].set(
+        jnp.where(have, t.backend_port[be_safe], dport))
+    return hdr, have, no_backend
+
+
+lb6_stage_jit = jax.jit(lb6_stage)

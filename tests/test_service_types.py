@@ -77,6 +77,21 @@ class TestFrontendClasses:
         assert len(np_svc.backends) == 2
         assert by_kind["ClusterIP"].frontend_port == 80
 
+    def test_nodeport_addresses_extra_frontends(self):
+        """--nodeport-addresses: every configured address binds the
+        nodePort (narrows DIVERGENCES #21)."""
+        mgr = ServiceManager()
+        w = ServiceWatcher(mgr, node_ip=NODE_IP,
+                           nodeport_addresses=("192.168.7.8",
+                                               "10.44.0.7"),
+                           local_ips=lambda: set())
+        w.on_service_add(_svc_obj("NodePort", node_port=30080))
+        w.on_endpoints_add(_eps_obj())
+        nps = [s for s in mgr.list() if s.kind == "NodePort"]
+        assert {s.frontend_ip for s in nps} == {
+            NODE_IP, "192.168.7.8", "10.44.0.7"}
+        assert all(s.frontend_port == 30080 for s in nps)
+
     def test_no_node_ip_no_nodeport_frontend(self):
         mgr, w = _watch(node_ip=None)
         w.on_service_add(_svc_obj("NodePort", node_port=30080))

@@ -1,0 +1,142 @@
+"""Dual-stack services (reference: lb6 maps + k8s spec.clusterIPs):
+v6 frontends compile into their own tensors, DNAT to v6 backends on
+the per-packet pass, drop NO_SERVICE when empty, and coexist with the
+v4 socket-LB stage (DIVERGENCES #25).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.core.packets import (COL_DPORT, COL_DST_IP0,
+                                     words_to_ip)
+from cilium_tpu.datapath.verdict import (REASON_FORWARDED,
+                                         REASON_NO_SERVICE)
+from cilium_tpu.k8s.watchers import ServiceWatcher
+from cilium_tpu.service import ServiceManager, lb6_stage
+
+V6_VIP = "fd00::10"
+V6_BE = ["fd00:1::1", "fd00:1::2", "fd00:1::3"]
+
+
+def _mgr():
+    m = ServiceManager()
+    m.upsert("web6", f"{V6_VIP}:80", [f"{b}:8080" for b in V6_BE])
+    return m
+
+
+def _rows6(n, dst=V6_VIP, dport=80, sport0=41000):
+    return make_batch([
+        dict(src="fd00:9::9", dst=dst, sport=sport0 + i, dport=dport,
+             proto=6, flags=TCP_SYN, ep=1, dir=1)
+        for i in range(n)
+    ]).data
+
+
+class TestLB6Stage:
+    def test_v6_frontend_dnats_to_v6_backend(self):
+        m = _mgr()
+        t6 = m.tensors6()
+        assert t6 is not None
+        out, hit, nobe = lb6_stage(t6, jnp.asarray(_rows6(32)))
+        out = np.asarray(out)
+        assert bool(np.asarray(hit).all())
+        assert not bool(np.asarray(nobe).any())
+        dsts = {words_to_ip(out[i, COL_DST_IP0:COL_DST_IP0 + 4], 6)
+                for i in range(32)}
+        assert dsts <= set(V6_BE) and len(dsts) > 1
+        assert set(np.asarray(out[:, COL_DPORT]).tolist()) == {8080}
+
+    def test_same_flow_same_backend(self):
+        m = _mgr()
+        t6 = m.tensors6()
+        hdr = _rows6(8, sport0=42000)
+        o1 = np.asarray(lb6_stage(t6, jnp.asarray(hdr))[0])
+        o2 = np.asarray(lb6_stage(t6, jnp.asarray(hdr.copy()))[0])
+        np.testing.assert_array_equal(o1, o2)
+
+    def test_v4_rows_untouched_and_vice_versa(self):
+        m = _mgr()
+        m.upsert("web4", "172.16.0.10:80", ["10.0.1.1:8080"])
+        t6 = m.tensors6()
+        v4 = make_batch([
+            dict(src="10.0.9.9", dst="172.16.0.10", sport=43000,
+                 dport=80, proto=6, flags=TCP_SYN, ep=1, dir=1)
+        ]).data
+        out, hit, nobe = lb6_stage(t6, jnp.asarray(v4))
+        assert not bool(np.asarray(hit).any())
+        np.testing.assert_array_equal(np.asarray(out), v4)
+        # and the v4 tensors exclude the v6 service
+        t4 = m.tensors()
+        assert t4.svc_ip.shape[0] == 1
+
+    def test_empty_v6_frontend_reports_no_backend(self):
+        m = ServiceManager()
+        m.upsert("empty6", f"{V6_VIP}:80", [])
+        out, hit, nobe = lb6_stage(m.tensors6(),
+                                   jnp.asarray(_rows6(4)))
+        assert not bool(np.asarray(hit).any())
+        assert bool(np.asarray(nobe).all())
+
+    def test_family_mismatched_backends_excluded(self):
+        """A v6 frontend must not DNAT to a v4 address."""
+        m = ServiceManager()
+        m.upsert("mixed", f"{V6_VIP}:80",
+                 ["10.0.1.1:8080", f"{V6_BE[0]}:8080"])
+        out, hit, nobe = lb6_stage(m.tensors6(),
+                                   jnp.asarray(_rows6(16)))
+        out = np.asarray(out)
+        dsts = {words_to_ip(out[i, COL_DST_IP0:COL_DST_IP0 + 4], 6)
+                for i in range(16)}
+        assert dsts == {V6_BE[0]}
+
+    def test_no_v6_services_tensors6_none(self):
+        m = ServiceManager()
+        m.upsert("web4", "172.16.0.10:80", ["10.0.1.1:8080"])
+        assert m.tensors6() is None
+
+
+class TestDualStackDaemon:
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_dual_stack_cluster_ips(self, backend):
+        d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+        ep = d.add_endpoint("cli", ("fd00:9::9", "10.0.9.9"),
+                            ["k8s:app=cli"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "cli"}},
+            "egress": [{}],
+        }])
+        hub = d.k8s_watchers()
+        hub.dispatch("add", {
+            "kind": "Service",
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"clusterIP": "172.20.0.10",
+                     "clusterIPs": ["172.20.0.10", V6_VIP],
+                     "ports": [{"port": 80, "protocol": "TCP"}]}})
+        hub.dispatch("add", {
+            "kind": "Endpoints",
+            "metadata": {"name": "web", "namespace": "default"},
+            "subsets": [{
+                "addresses": [{"ip": "10.0.1.1"},
+                              {"ip": V6_BE[0]}],
+                "ports": [{"port": 8080, "protocol": "TCP"}]}]})
+        kinds = [s for s in d.services.list()
+                 if s.kind == "ClusterIP"]
+        assert {s.frontend_ip for s in kinds} == {"172.20.0.10",
+                                                 V6_VIP}
+        d.upsert_ipcache(f"{V6_BE[0]}/128", 4242)
+        d.upsert_ipcache("10.0.1.1/32", 4243)
+        # v6 VIP traffic DNATs + forwards
+        ev = d.process_batch(_rows6(8), now=50)
+        assert int((ev.reason == REASON_FORWARDED).sum()) == 8
+        # a v6 VIP with its (only) v6 backend gone drops NO_SERVICE
+        hub.dispatch("update", {
+            "kind": "Endpoints",
+            "metadata": {"name": "web", "namespace": "default"},
+            "subsets": [{
+                "addresses": [{"ip": "10.0.1.1"}],
+                "ports": [{"port": 8080, "protocol": "TCP"}]}]})
+        ev = d.process_batch(_rows6(8, sport0=44000), now=51)
+        assert int((ev.reason == REASON_NO_SERVICE).sum()) == 8
