@@ -54,6 +54,21 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_connectivity(args) -> int:
+    """`cilium-tpu connectivity test` (reference: cilium-cli
+    connectivity test — BASELINE config 1): self-contained two-pod
+    world + the L3/L4/L7/deny/entity/auth scenario matrix through
+    the real datapath."""
+    from ..testing.connectivity import (format_results,
+                                        run_connectivity_tests)
+    res = run_connectivity_tests(backend=args.backend)
+    if args.json:
+        _print([r.__dict__ for r in res])
+    else:
+        print(format_results(res))
+    return 0 if all(r.ok for r in res) else 1
+
+
 def cmd_encrypt(args) -> int:
     """`cilium-tpu encrypt status` (reference: cilium encrypt
     status)."""
@@ -482,6 +497,13 @@ def main(argv=None) -> int:
     p.add_argument("action", nargs="?", default="list")
     p.add_argument("id", nargs="?", type=int, default=0)
 
+    p = sub.add_parser("connectivity",
+                       help="connectivity test (self-contained)")
+    p.add_argument("action", nargs="?", default="test",
+                   choices=["test"])
+    p.add_argument("--backend", default="interpreter",
+                   choices=["interpreter", "tpu"])
+
     p = sub.add_parser("encrypt", help="encrypt status")
     p.add_argument("action", nargs="?", default="status",
                    choices=["status"])
@@ -544,6 +566,7 @@ def main(argv=None) -> int:
             "proxy": cmd_proxy,
             "egress": cmd_egress,
             "encrypt": cmd_encrypt,
+            "connectivity": cmd_connectivity,
         }.get(args.cmd)
         if handler is None:
             parser.print_help()

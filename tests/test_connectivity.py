@@ -1,0 +1,29 @@
+"""The cilium connectivity-test analogue (BASELINE config 1): the full
+scenario matrix must pass on both backends, and the CLI verb exits 0.
+"""
+
+import pytest
+
+from cilium_tpu.testing.connectivity import (format_results,
+                                             run_connectivity_tests)
+
+
+@pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+def test_connectivity_matrix(backend):
+    res = run_connectivity_tests(backend)
+    failed = [r for r in res if not r.ok]
+    assert not failed, format_results(res)
+    # the matrix covers the BASELINE config-1 surface
+    scenarios = {r.scenario for r in res}
+    assert {"no-policies", "client-ingress-l3", "client-ingress-l4",
+            "all-ingress-deny", "client-egress-l4",
+            "to-entities-world", "echo-ingress-l7",
+            "echo-ingress-mutual-auth"} <= scenarios
+
+
+def test_cli_verb_exits_zero(capsys):
+    from cilium_tpu.cli.main import main
+    rc = main(["connectivity", "test"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Test Summary" in out and "FAIL" not in out
