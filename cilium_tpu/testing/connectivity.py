@@ -180,11 +180,13 @@ def run_connectivity_tests(backend: str = "interpreter",
             "status": {"podIP": ip}})
         return d.endpoints.lookup_by_ip(ip)
 
+    from ..identity import ID_WORLD
+
     client = pod("client", CLIENT_IP)
     client2 = pod("client2", CLIENT2_IP)
     server = pod("server", SERVER_IP)
     assert client and client2 and server, "pod watcher must attach"
-    d.upsert_ipcache(f"{WORLD_IP}/32", 2)  # reserved:world
+    d.upsert_ipcache(f"{WORLD_IP}/32", ID_WORLD)
 
     results: List[ProbeResult] = []
     sport = [40000]
@@ -219,7 +221,8 @@ def run_connectivity_tests(backend: str = "interpreter",
                 verdicts = d.handle_l7_http(
                     int(ev.proxy_port[0]),
                     [{"method": "GET", "path": p.l7_path,
-                      "host": "server"}])
+                      "host": "server"}],
+                    src_identity=client.identity.numeric_id)
                 l7got = ("allow" if int(verdicts[0]) == 1
                          else "deny")
                 ok = l7got == p.l7_expect
