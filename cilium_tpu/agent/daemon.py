@@ -86,6 +86,9 @@ class DaemonConfig:
     # and identity GC can sweep.  A keepalive controller refreshes at
     # ttl/3.
     identity_lease_ttl: Optional[float] = None
+    # policy-audit-mode (reference: --policy-audit-mode): policy
+    # denials forward while verdict events keep the would-be reason
+    policy_audit_mode: bool = False
     # monitor trace aggregation (reference: --monitor-aggregation):
     # "none" emits a TraceNotify per forwarded packet; "medium" only
     # for flow-state-changing packets (non-TCP, or TCP SYN/FIN/RST).
@@ -618,10 +621,10 @@ class Daemon:
             # svc_nobe (frontend hit, no backend) rides the dedicated
             # lb_drop channel: upstream's LB lookup runs BEFORE the
             # endpoint program, so NO_SERVICE wins over policy too
-            out, row_map = self.loader.step(hdr_dev, now,
-                                            pre_drop=nat_drop,
-                                            pre_drop_reason=bw_reasons,
-                                            lb_drop=svc_nobe)
+            out, row_map = self.loader.step(
+                hdr_dev, now, pre_drop=nat_drop,
+                pre_drop_reason=bw_reasons, lb_drop=svc_nobe,
+                audit=self.config.policy_audit_mode)
             if self.nat is not None:
                 # reverse translation AFTER the verdict (CT/policy see
                 # the wire tuple; delivery + events see the restored
@@ -630,7 +633,8 @@ class Daemon:
                                                   now)
             hdr = np.asarray(hdr_dev)
             return self._finish_batch(out, hdr, row_map, now)
-        out, row_map = self.loader.step(hdr, now)
+        out, row_map = self.loader.step(
+            hdr, now, audit=self.config.policy_audit_mode)
         return self._finish_batch(out, hdr, row_map, now)
 
     def _finish_batch(self, out, hdr: np.ndarray, row_map,
@@ -807,7 +811,8 @@ class Daemon:
         s["ring"], row_map = self.loader.serve(
             s["ring"], hdr, now, bid,
             trace_sample=s["trace_sample"],
-            proxy_ports=s["table_dev"])
+            proxy_ports=s["table_dev"],
+            audit=self.config.policy_audit_mode)
         # numeric_array() copies the whole row->numeric table; the map
         # only changes on identity churn, so snapshot per
         # (object, version) — the map object is REUSED and mutated

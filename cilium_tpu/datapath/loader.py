@@ -45,7 +45,7 @@ class Loader(abc.ABC):
 
     @abc.abstractmethod
     def step(self, hdr: np.ndarray, now: int, pre_drop=None,
-             pre_drop_reason=None, lb_drop=None):
+             pre_drop_reason=None, lb_drop=None, audit=False):
         """Verdict one batch.
 
         Returns ``(out, row_map)``: the out tensor [N, N_OUT] plus the
@@ -223,7 +223,7 @@ class TPULoader(Loader):
         return len(dead)
 
     def step(self, hdr, now: int, pre_drop=None,
-             pre_drop_reason=None, lb_drop=None):
+             pre_drop_reason=None, lb_drop=None, audit=False):
         """``hdr`` may be a numpy array OR an already-on-device jax
         array (the LB stage hands its output over without a host
         round trip).  ``pre_drop`` is the SNAT stage's exhaustion
@@ -238,12 +238,14 @@ class TPULoader(Loader):
         with self._lock:
             out, self.state = datapath_step_jit(
                 self.state, hdr, jnp.uint32(now), pre_drop=pre_drop,
-                pre_drop_reason=pre_drop_reason, lb_drop=lb_drop)
+                pre_drop_reason=pre_drop_reason, lb_drop=lb_drop,
+                audit=audit)
             row_map = self.row_map
         return np.asarray(out), row_map
 
     def serve(self, ring, hdr, now: int, batch_id: int,
-              trace_sample: int = 1024, proxy_ports=None):
+              trace_sample: int = 1024, proxy_ports=None,
+              audit: bool = False):
         """The SERVING-path step: fused datapath + event-ring append
         in one dispatch, NO host fetch (monitor/ring.py serve_step).
         Returns (ring', row_map); events reach the host when the
@@ -258,7 +260,7 @@ class TPULoader(Loader):
             self.state, ring = serve_step_jit(
                 self.state, ring, hdr, jnp.uint32(now),
                 jnp.uint32(batch_id), trace_sample=trace_sample,
-                proxy_ports=proxy_ports)
+                proxy_ports=proxy_ports, audit=audit)
             row_map = self.row_map
         return ring, row_map
 
@@ -608,7 +610,7 @@ class InterpreterLoader(Loader):
         return len(dead)
 
     def step(self, hdr: np.ndarray, now: int, pre_drop=None,
-             pre_drop_reason=None, lb_drop=None):
+             pre_drop_reason=None, lb_drop=None, audit=False):
         from ..core.packets import HeaderBatch, COL_DIR
         from .verdict import N_OUT
 
@@ -617,7 +619,8 @@ class InterpreterLoader(Loader):
             pre_drop_reason=(None if pre_drop_reason is None
                              else np.asarray(pre_drop_reason)),
             lb_drop=(None if lb_drop is None
-                     else np.asarray(lb_drop)))
+                     else np.asarray(lb_drop)),
+            audit=audit)
         out = np.zeros((len(results), N_OUT), dtype=np.uint32)
         for i, r in enumerate(results):
             out[i] = (r.verdict, r.proxy, r.ct,

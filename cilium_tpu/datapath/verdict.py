@@ -159,7 +159,8 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
                   now: jnp.ndarray, valid: jnp.ndarray = None,
                   pre_drop: jnp.ndarray = None,
                   pre_drop_reason: jnp.ndarray = None,
-                  lb_drop: jnp.ndarray = None
+                  lb_drop: jnp.ndarray = None,
+                  audit: bool = False
                   ) -> Tuple[jnp.ndarray, DatapathState]:
     """One batched pass of the full verdict pipeline (see module doc).
 
@@ -179,6 +180,14 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     generalized form: rows carry their own REASON_* code (today the
     bandwidth manager's ``REASON_BANDWIDTH``), with the same
     precedence and CT semantics as ``pre_drop``.
+
+    ``audit`` (static): policy-audit-mode (reference:
+    --policy-audit-mode): NEW flows the POLICY stage would deny
+    (explicit deny, default deny, missing mutual auth) FORWARD and
+    create CT state, while the emitted verdict event keeps the
+    would-be reason (verdict ALLOW + reason POLICY_*/AUTH_* is the
+    audit signature the flow layer renders).  Non-policy drops
+    (lxcmap miss, NAT exhaustion, bandwidth, NO_SERVICE) still drop.
 
     ``lb_drop`` (optional [N] bool) marks LB frontend hits with no
     backend.  Unlike the two channels above this is a PRE-policy
@@ -248,6 +257,11 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     auth_exp = state.policy.auth[pol_row, id_row]
     auth_drop = allowed & is_new & p_auth & (auth_exp <= now)
     allowed = allowed & ~auth_drop
+    audit_fwd = None
+    if audit:
+        # policy-audit-mode: would-be policy/auth denials forward
+        audit_fwd = is_new & ~allowed & ~no_ep
+        allowed = allowed | audit_fwd
     nat_drop = None
     if pre_drop is not None:
         nat_drop = pre_drop & allowed  # policy/no_ep drops win
@@ -266,8 +280,10 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
         allowed,
         jnp.where(proxy > 0, VERDICT_REDIRECT, VERDICT_ALLOW),
         jnp.where(no_ep, VERDICT_DENY, p_verdict))
+    reason_allowed = (allowed if audit_fwd is None
+                      else allowed & ~audit_fwd)
     reason = jnp.where(
-        allowed, REASON_FORWARDED,
+        reason_allowed, REASON_FORWARDED,
         jnp.where(no_ep, REASON_NO_ENDPOINT,
                   jnp.where(p_verdict == VERDICT_DENY, REASON_POLICY_DENY,
                             REASON_POLICY_DEFAULT_DENY)))
@@ -276,6 +292,12 @@ def datapath_step(state: DatapathState, hdr: jnp.ndarray,
     verdict = jnp.where(auth_drop, VERDICT_DENY, verdict)
     reason = jnp.where(auth_drop, REASON_AUTH_REQUIRED, reason)
     proxy = jnp.where(auth_drop, 0, proxy)
+    if audit_fwd is not None:
+        # the ACTION is forward; the reason above keeps the would-be
+        # decision (rows a later NAT/bandwidth/LB stage drops get
+        # their reason overridden by that stage, as they really drop)
+        verdict = jnp.where(audit_fwd & allowed, VERDICT_ALLOW,
+                            verdict)
     if nat_drop is not None:
         verdict = jnp.where(nat_drop, VERDICT_DENY, verdict)
         reason = jnp.where(nat_drop, REASON_NAT_EXHAUSTED, reason)
@@ -357,12 +379,14 @@ def apply_masquerade(ct: CTTable, nat, hdr: jnp.ndarray,
 
 apply_masquerade_jit = jax.jit(apply_masquerade)
 
-datapath_step_jit = jax.jit(datapath_step, donate_argnums=0)
+datapath_step_jit = jax.jit(datapath_step, donate_argnums=0,
+                            static_argnames=("audit",))
 
 
 def datapath_step_packed(state: DatapathState, packed: jnp.ndarray,
                          now: jnp.ndarray, ep, dirn,
-                         valid: jnp.ndarray = None
+                         valid: jnp.ndarray = None,
+                         audit: bool = False
                          ) -> Tuple[jnp.ndarray, DatapathState]:
     """The ingest fast path: packed IPv4 rows (16 B/packet on the h2d
     link — see core/packets.py PACKED_*) unpack on device and run the
@@ -371,10 +395,11 @@ def datapath_step_packed(state: DatapathState, packed: jnp.ndarray,
     from ..core.packets import unpack_hdr
 
     return datapath_step(state, unpack_hdr(packed, ep, dirn), now,
-                         valid=valid)
+                         valid=valid, audit=audit)
 
 
-datapath_step_packed_jit = jax.jit(datapath_step_packed, donate_argnums=0)
+datapath_step_packed_jit = jax.jit(datapath_step_packed, donate_argnums=0,
+                                   static_argnames=("audit",))
 
 
 def build_state(policy_tensors: PolicyTensors, lpm_tensors: LPMTensors,

@@ -117,6 +117,11 @@ class Flow:
             d["drop_reason_desc"] = DROP_REASON_DESC.get(
                 self.drop_reason, f"DROP_REASON_{self.drop_reason}")
             d["drop_reason"] = self.drop_reason
+            if self.verdict in (VERDICT_ALLOW, VERDICT_REDIRECT):
+                # forwarded WITH a would-be deny reason: the
+                # policy-audit-mode signature (upstream renders
+                # verdict AUDIT)
+                d["policy_audit"] = True
         if self.l7:
             d["l7"] = self.l7
         d["Summary"] = self.summary()

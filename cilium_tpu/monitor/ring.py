@@ -160,38 +160,42 @@ ring_append_jit = jax.jit(ring_append, donate_argnums=0,
 def serve_step(state, ring: EventRing, hdr: jnp.ndarray,
                now: jnp.ndarray, batch_id: jnp.ndarray,
                trace_sample: int = 1024, valid: jnp.ndarray = None,
-               proxy_ports: jnp.ndarray = None):
+               proxy_ports: jnp.ndarray = None, audit: bool = False):
     """The serving-path step: fused datapath + event-ring append in ONE
     executable (one dispatch per batch; out rows that the compaction
     discards are never materialized).  Returns (state, ring)."""
     from ..datapath.verdict import datapath_step
 
-    out, state = datapath_step(state, hdr, now, valid=valid)
+    out, state = datapath_step(state, hdr, now, valid=valid,
+                               audit=audit)
     ring = ring_append(ring, out, batch_id, trace_sample=trace_sample,
                        valid=valid, proxy_ports=proxy_ports)
     return state, ring
 
 
 serve_step_jit = jax.jit(serve_step, donate_argnums=(0, 1),
-                         static_argnames=("trace_sample",))
+                         static_argnames=("trace_sample", "audit"))
 
 
 def serve_step_packed(state, ring: EventRing, packed: jnp.ndarray,
                       now: jnp.ndarray, batch_id: jnp.ndarray,
                       ep, dirn, trace_sample: int = 1024,
-                      proxy_ports: jnp.ndarray = None):
+                      proxy_ports: jnp.ndarray = None,
+                      audit: bool = False):
     """Serving path for the packed ingest format (16 B/packet h2d):
     unpack + fused datapath + ring append, ONE dispatch per batch."""
     from ..datapath.verdict import datapath_step_packed
 
-    out, state = datapath_step_packed(state, packed, now, ep, dirn)
+    out, state = datapath_step_packed(state, packed, now, ep, dirn,
+                                      audit=audit)
     ring = ring_append(ring, out, batch_id, trace_sample=trace_sample,
                        proxy_ports=proxy_ports)
     return state, ring
 
 
 serve_step_packed_jit = jax.jit(serve_step_packed, donate_argnums=(0, 1),
-                                static_argnames=("trace_sample",))
+                                static_argnames=("trace_sample",
+                                                 "audit"))
 
 
 class AsyncRingDrainer:

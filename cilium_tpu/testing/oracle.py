@@ -149,7 +149,7 @@ class OracleDatapath:
     def step(self, batch: HeaderBatch, now: int,
              pre_drop=None,
              pre_drop_reason=None,
-             lb_drop=None) -> List[OracleResult]:
+             lb_drop=None, audit=False) -> List[OracleResult]:
         """``pre_drop`` ([N] bool) marks rows the SNAT stage condemned
         (pool exhaustion).  Policy/lxcmap drops keep precedence
         (upstream order: bpf_lxc judges before host SNAT); rows that
@@ -250,18 +250,32 @@ class OracleDatapath:
                 reason = (REASON_POLICY_DENY if p_verdict == VERDICT_DENY
                           else REASON_POLICY_DEFAULT_DENY)
                 event = EV_DROP
+            # audit first: a row the policy stage would deny is
+            # forwarded UNLESS a later stage (NAT exhaustion,
+            # bandwidth) really drops it — those stages act on the
+            # post-audit allowed set, mirroring the device
+            audit_fwd = (audit and ct_res == CT_NEW
+                         and reason in (REASON_POLICY_DENY,
+                                        REASON_POLICY_DEFAULT_DENY,
+                                        REASON_AUTH_REQUIRED))
             if (pre_drop is not None and bool(pre_drop[i])
-                    and reason == REASON_FORWARDED):
+                    and (reason == REASON_FORWARDED or audit_fwd)):
                 verdict, proxy = VERDICT_DENY, 0
                 reason, event = REASON_NAT_EXHAUSTED, EV_DROP
+                audit_fwd = False
             if (pre_drop_reason is not None
                     and int(pre_drop_reason[i]) != 0
-                    and reason == REASON_FORWARDED):
+                    and (reason == REASON_FORWARDED or audit_fwd)):
                 verdict, proxy = VERDICT_DENY, 0
                 reason, event = int(pre_drop_reason[i]), EV_DROP
+                audit_fwd = False
+            if audit_fwd:
+                # policy-audit-mode: forward, CT-create, keep the
+                # would-be reason on the verdict event
+                verdict, proxy, event = VERDICT_ALLOW, 0, EV_VERDICT
             results.append(OracleResult(verdict, proxy, ct_res, ident,
                                         reason, event))
-            allowed = reason == REASON_FORWARDED
+            allowed = reason == REASON_FORWARDED or audit_fwd
             # a NAT-dropped row must not refresh an existing entry
             # either: CT_NEW + allowed=False touches nothing
             if reason == REASON_NAT_EXHAUSTED or (

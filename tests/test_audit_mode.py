@@ -1,0 +1,145 @@
+"""Policy-audit-mode (reference: --policy-audit-mode): policy/auth
+denials FORWARD and create CT state while the verdict event keeps the
+would-be reason; non-policy drops (lxcmap miss, NO_SERVICE) still
+drop.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_ACK, TCP_SYN, make_batch
+from cilium_tpu.datapath.verdict import (REASON_AUTH_REQUIRED,
+                                         REASON_FORWARDED,
+                                         REASON_NO_ENDPOINT,
+                                         REASON_NO_SERVICE,
+                                         REASON_POLICY_DEFAULT_DENY,
+                                         REASON_POLICY_DENY)
+from cilium_tpu.policy.mapstate import VERDICT_ALLOW
+
+NS = "k8s:io.kubernetes.pod.namespace=default"
+
+
+def _world(backend, audit=True, mesh_auth=False):
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12,
+                            policy_audit_mode=audit,
+                            mesh_auth=mesh_auth))
+    d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web", NS])
+    db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db", NS])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{
+            "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+            "toPorts": [{"ports": [{"port": "5432",
+                                    "protocol": "TCP"}]}],
+        }],
+    }])
+    return d, db
+
+
+def _pkt(d, db, sport, dport=9999, flags=TCP_SYN, now=50,
+         src="10.0.1.1"):
+    ev = d.process_batch(make_batch([
+        dict(src=src, dst="10.0.2.1", sport=sport, dport=dport,
+             proto=6, flags=flags, ep=db.id, dir=0)
+    ]).data, now=now)
+    return int(ev.verdict[0]), int(ev.reason[0])
+
+
+class TestAuditMode:
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_would_be_deny_forwards_with_reason(self, backend):
+        d, db = _world(backend)
+        # port 9999 is outside the allow: default-deny — audited
+        verdict, reason = _pkt(d, db, 41000)
+        assert verdict == VERDICT_ALLOW
+        assert reason == REASON_POLICY_DEFAULT_DENY
+        # ...and the flow got CT state: the ACK rides the fast path
+        verdict, reason = _pkt(d, db, 41000, flags=TCP_ACK, now=51)
+        assert verdict == VERDICT_ALLOW
+        assert reason == REASON_FORWARDED
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_explicit_deny_audited(self, backend):
+        d, db = _world(backend)
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingressDeny": [{"fromEndpoints": [
+                {"matchLabels": {"app": "web"}}]}],
+        }])
+        verdict, reason = _pkt(d, db, 42000, dport=5432)
+        assert verdict == VERDICT_ALLOW
+        assert reason == REASON_POLICY_DENY
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_auth_required_audited(self, backend):
+        d, db = _world(backend, mesh_auth=False)
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                "authentication": {"mode": "required"},
+            }],
+        }])
+        # port 7777 is covered ONLY by the auth-required rule (the
+        # base policy's no-auth allow covers 5432 and would win the
+        # first-covering race there)
+        verdict, reason = _pkt(d, db, 43000, dport=7777)
+        assert verdict == VERDICT_ALLOW
+        assert reason == REASON_AUTH_REQUIRED
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_non_policy_drops_still_drop(self, backend):
+        d, db = _world(backend)
+        # lxcmap miss: unregistered endpoint id still drops
+        ev = d.process_batch(make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=44000,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=999, dir=0)
+        ]).data, now=50)
+        assert int(ev.reason[0]) == REASON_NO_ENDPOINT
+        assert int(ev.verdict[0]) != VERDICT_ALLOW
+        # NO_SERVICE (empty frontend) still drops
+        d.services.upsert("empty", "172.20.0.10:80", [])
+        ev = d.process_batch(make_batch([
+            dict(src="10.0.2.1", dst="172.20.0.10", sport=44001,
+                 dport=80, proto=6, flags=TCP_SYN, ep=db.id, dir=1)
+        ]).data, now=51)
+        assert int(ev.reason[0]) == REASON_NO_SERVICE
+
+    @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+    def test_pre_stage_drop_beats_audit(self, backend):
+        """A row that is policy-denied AND condemned by a pre-stage
+        (NAT exhaustion) must really DROP under audit on BOTH
+        backends — audit spares only the policy stage."""
+        from cilium_tpu.datapath.verdict import (OUT_REASON,
+                                                 OUT_VERDICT)
+
+        d, db = _world(backend)
+        hdr = make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=47000,
+                 dport=9999, proto=6, flags=TCP_SYN, ep=db.id,
+                 dir=0)
+        ]).data
+        from cilium_tpu.datapath.verdict import REASON_NAT_EXHAUSTED
+        out, _rm = d.loader.step(hdr, 50,
+                                 pre_drop=np.array([True]),
+                                 audit=True)
+        out = np.asarray(out)
+        assert int(out[0, OUT_REASON]) == REASON_NAT_EXHAUSTED
+        assert int(out[0, OUT_VERDICT]) != VERDICT_ALLOW
+
+    def test_audit_off_denies(self):
+        d, db = _world("interpreter", audit=False)
+        verdict, reason = _pkt(d, db, 45000)
+        assert verdict != VERDICT_ALLOW
+        assert reason == REASON_POLICY_DEFAULT_DENY
+
+    def test_flow_renders_audit_flag(self):
+        d, db = _world("interpreter")
+        _pkt(d, db, 46000)
+        flows = [f for f in d.observer.get_flows()
+                 if f.to_dict().get("policy_audit")]
+        assert flows, "audited flow must carry the audit signature"
+        fd = flows[-1].to_dict()
+        assert fd["verdict"] == "FORWARDED"
+        assert fd["drop_reason_desc"] == "POLICY_DENY_DEFAULT"
