@@ -126,6 +126,7 @@ class Daemon:
         self.monitor = MonitorAgent()
         self.controllers = ControllerManager()
         self.encryption = None  # set below when enabled + kvstore
+        self._dns_listeners: Dict[int, object] = {}
         self._boot_time = time.time()
         self._started = False
 
@@ -407,6 +408,7 @@ class Daemon:
 
     def shutdown(self) -> None:
         self.controllers.stop_all()
+        self.stop_dns_proxy()
         if self.hubble_server is not None:
             self.hubble_server.stop(grace=0.5)
         if self.exporter:
@@ -876,6 +878,36 @@ class Daemon:
                 # land on the same clock
                 self.auth_manager.observe(batch, self._now())
             self.monitor.publish(self._filter_events(batch))
+
+    # -- DNS proxy (pkg/fqdn/dnsproxy) --------------------------------
+    def start_dns_proxy(self, resolver, host: str = "127.0.0.1"
+                        ) -> Dict[int, tuple]:
+        """Spawn a wire-level UDP DNS proxy per DNS redirect port
+        (reference: the transparent dnsproxy pods resolve through).
+        Allowed answers feed the fqdn cache, so toFQDNs identities
+        mint from LIVE traffic.  Returns {proxy_port: (host, port)}
+        bind addresses."""
+        from ..proxy.dnslistener import DNSProxyListener
+
+        out: Dict[int, tuple] = {}
+        for l in self.proxy.listeners():
+            port = l["proxy-port"]
+            if l.get("dns-rules") and port not in self._dns_listeners:
+                self._dns_listeners[port] = DNSProxyListener(
+                    self.proxy, port, resolver,
+                    observe=self.fqdn.observe, host=host)
+            if port in self._dns_listeners:
+                out[port] = self._dns_listeners[port].address
+        return out
+
+    def stop_dns_proxy(self) -> dict:
+        stats = {p: {"queries": l.queries, "refused": l.refused,
+                     "errors": l.errors}
+                 for p, l in self._dns_listeners.items()}
+        for l in self._dns_listeners.values():
+            l.close()
+        self._dns_listeners.clear()
+        return stats
 
     # -- transparent encryption (pkg/wireguard analogue) --------------
     def seal_batch(self, peer: str, frames: bytes) -> bytes:

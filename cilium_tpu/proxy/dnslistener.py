@@ -1,0 +1,212 @@
+"""The DNS proxy — wire-level UDP interception (reference: upstream
+``pkg/fqdn/dnsproxy``).
+
+Upstream runs a transparent DNS proxy: pod DNS queries redirect to it,
+the qname is verdicted against the endpoint's ``rules.dns`` L7 policy
+(matchName/matchPattern), allowed queries forward to the real
+resolver, and the ANSWERS feed the fqdn cache — which mints the
+identities ``toFQDNs`` selectors match.  Denied queries answer
+REFUSED (rcode 5) so clients fail fast instead of timing out.
+
+This module is the same loop over a real UDP socket: parse the query
+off the wire, verdict through the compiled DNS L7 tensors
+(``L7Proxy.handle_dns``), forward/refuse, parse A/AAAA answers
+(including name compression) and hand them to ``observe`` — closing
+the toFQDNs loop at the byte level exactly like the HTTP splice
+listeners close HTTP's.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, List, Optional, Tuple
+
+RCODE_REFUSED = 5
+TYPE_A = 1
+TYPE_AAAA = 28
+
+
+class DNSParseError(ValueError):
+    pass
+
+
+def _read_name(buf: bytes, off: int, depth: int = 0
+               ) -> Tuple[str, int]:
+    """Decode a (possibly compressed) DNS name.  Returns (name, next
+    offset); for compressed tails the returned offset is past the
+    POINTER, not the target."""
+    if depth > 16:
+        raise DNSParseError("compression loop")
+    labels: List[str] = []
+    while True:
+        if off >= len(buf):
+            raise DNSParseError("truncated name")
+        n = buf[off]
+        if n == 0:
+            return ".".join(labels), off + 1
+        if n & 0xC0 == 0xC0:  # compression pointer
+            if off + 2 > len(buf):
+                raise DNSParseError("truncated pointer")
+            ptr = ((n & 0x3F) << 8) | buf[off + 1]
+            if ptr >= off:
+                raise DNSParseError("forward pointer")
+            tail, _ = _read_name(buf, ptr, depth + 1)
+            return ".".join(labels + [tail]) if labels else tail, \
+                off + 2
+        off += 1
+        if off + n > len(buf):
+            raise DNSParseError("truncated label")
+        labels.append(buf[off:off + n].decode("ascii",
+                                              errors="replace"))
+        off += n
+
+
+def parse_query(buf: bytes) -> Tuple[int, str, int]:
+    """-> (txn id, qname, qtype) of the FIRST question."""
+    if len(buf) < 12:
+        raise DNSParseError("short header")
+    txid, flags, qd = struct.unpack("!HHH", buf[:6])
+    if qd < 1:
+        raise DNSParseError("no question")
+    name, off = _read_name(buf, 12)
+    if off + 4 > len(buf):
+        raise DNSParseError("truncated question")
+    qtype = struct.unpack("!H", buf[off:off + 2])[0]
+    return txid, name.lower(), qtype
+
+
+def parse_answers(buf: bytes) -> List[Tuple[str, str, int]]:
+    """-> [(owner name, ip, ttl)] for every A/AAAA answer RR."""
+    if len(buf) < 12:
+        raise DNSParseError("short header")
+    qd, an = struct.unpack("!HH", buf[4:8])
+    off = 12
+    for _ in range(qd):
+        _, off = _read_name(buf, off)
+        off += 4
+    out: List[Tuple[str, str, int]] = []
+    for _ in range(an):
+        name, off = _read_name(buf, off)
+        if off + 10 > len(buf):
+            raise DNSParseError("truncated RR")
+        rtype, _cls, ttl, rdlen = struct.unpack(
+            "!HHIH", buf[off:off + 10])
+        off += 10
+        rdata = buf[off:off + rdlen]
+        off += rdlen
+        if rtype == TYPE_A and rdlen == 4:
+            out.append((name.lower(), socket.inet_ntoa(rdata),
+                        int(ttl)))
+        elif rtype == TYPE_AAAA and rdlen == 16:
+            out.append((name.lower(),
+                        socket.inet_ntop(socket.AF_INET6, rdata),
+                        int(ttl)))
+    return out
+
+
+def refused_response(query: bytes) -> bytes:
+    """Echo the question back with QR=1 RCODE=REFUSED (what upstream's
+    proxy answers for policy-denied names — fail fast, not timeout)."""
+    txid = query[:2]
+    # QR=1, opcode from query, RD preserved, RCODE=5
+    flags = struct.unpack("!H", query[2:4])[0]
+    flags = 0x8000 | (flags & 0x7900) | RCODE_REFUSED
+    qd = query[4:6]
+    # body: just the question section(s)
+    _, off = _read_name(query, 12)
+    body = query[12:off + 4]
+    return txid + struct.pack("!H", flags) + qd + b"\x00\x00" * 3 \
+        + body
+
+
+class DNSProxyListener:
+    """One DNS redirect port's UDP proxy loop.
+
+    ``resolver`` is the upstream (host, port) queries forward to;
+    ``observe`` receives (name, [ips], ttl) per allowed answer —
+    wire it to ``FQDNCache.observe`` and toFQDNs selectors update
+    from live traffic."""
+
+    def __init__(self, proxy, proxy_port: int,
+                 resolver: Tuple[str, int],
+                 observe: Optional[Callable] = None,
+                 host: str = "127.0.0.1", src_row: int = 0,
+                 timeout: float = 2.0):
+        self.proxy = proxy
+        self.proxy_port = proxy_port
+        self.resolver = resolver
+        self.observe = observe
+        self.src_row = src_row
+        self.timeout = timeout
+        self.queries = 0
+        self.refused = 0
+        self.errors = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind((host, 0))
+        self._sock.settimeout(0.2)
+        self.address = self._sock.getsockname()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                buf, client = self._sock.recvfrom(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve_one,
+                             args=(buf, client), daemon=True).start()
+
+    def _serve_one(self, buf: bytes, client) -> None:
+        try:
+            _txid, qname, _qtype = parse_query(buf)
+        except DNSParseError:
+            self.errors += 1
+            return  # unparseable: drop silently (upstream logs+drops)
+        self.queries += 1
+        verdicts = self.proxy.handle_dns(self.proxy_port, [qname],
+                                         self.src_row)
+        if not int(verdicts[0]):
+            self.refused += 1
+            try:
+                self._sock.sendto(refused_response(buf), client)
+            except (OSError, DNSParseError):
+                self.errors += 1
+            return
+        # forward to the real resolver, relay the answer back
+        try:
+            with socket.socket(socket.AF_INET,
+                               socket.SOCK_DGRAM) as up:
+                up.settimeout(self.timeout)
+                up.sendto(buf, self.resolver)
+                resp, _ = up.recvfrom(4096)
+        except OSError:
+            self.errors += 1
+            return  # resolver unreachable: client retries
+        try:
+            answers = parse_answers(resp)
+        except DNSParseError:
+            answers = []
+        if self.observe is not None:
+            by_name: dict = {}
+            for name, ip, ttl in answers:
+                by_name.setdefault(name, ([], [0]))[0].append(ip)
+                by_name[name][1][0] = max(by_name[name][1][0], ttl)
+            for name, (ips, ttl_box) in by_name.items():
+                self.observe(name, ips, ttl_box[0])
+        try:
+            self._sock.sendto(resp, client)
+        except OSError:
+            self.errors += 1

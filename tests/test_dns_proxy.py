@@ -1,0 +1,178 @@
+"""Wire-level DNS proxy (reference: pkg/fqdn/dnsproxy): UDP queries
+verdict against the dns L7 rules, denied names answer REFUSED,
+allowed answers feed the fqdn cache and mint the identities toFQDNs
+selectors match.
+"""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_SYN, make_batch
+from cilium_tpu.datapath.verdict import (REASON_FORWARDED,
+                                         REASON_POLICY_DEFAULT_DENY)
+from cilium_tpu.proxy.dnslistener import (parse_answers, parse_query,
+                                          refused_response)
+
+NS = "k8s:io.kubernetes.pod.namespace=default"
+
+
+def _query(name: str, txid=0x1234, qtype=1) -> bytes:
+    q = struct.pack("!HHHHHH", txid, 0x0100, 1, 0, 0, 0)
+    for label in name.split("."):
+        q += bytes([len(label)]) + label.encode()
+    return q + b"\x00" + struct.pack("!HH", qtype, 1)
+
+
+def _answer(query: bytes, ips, ttl=60) -> bytes:
+    """Stub resolver response: echo question + one A RR per ip,
+    owner via compression pointer to the question name."""
+    txid = query[:2]
+    hdr = txid + struct.pack("!HHHHH", 0x8180, 1, len(ips), 0, 0)
+    # question section copied verbatim
+    i = 12
+    while query[i] != 0:
+        i += 1 + query[i]
+    question = query[12:i + 5]
+    body = b""
+    for ip in ips:
+        body += (b"\xc0\x0c"  # pointer to offset 12 (the qname)
+                 + struct.pack("!HHIH", 1, 1, ttl, 4)
+                 + socket.inet_aton(ip))
+    return hdr + question + body
+
+
+class StubResolver:
+    """A UDP resolver answering every A query from a fixed table."""
+
+    def __init__(self, table):
+        self.table = table
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.settimeout(0.2)
+        self.address = self.sock.getsockname()
+        self._stop = threading.Event()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                buf, client = self.sock.recvfrom(4096)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            _, name, _ = parse_query(buf)
+            self.sock.sendto(_answer(buf, self.table.get(name, [])),
+                             client)
+
+    def close(self):
+        self._stop.set()
+        self.sock.close()
+
+
+def _world():
+    d = Daemon(DaemonConfig(backend="interpreter",
+                            ct_capacity=1 << 12))
+    d.add_endpoint("cli", ("10.0.9.9",), ["k8s:app=cli", NS])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "cli"}},
+        "egress": [{
+            "toPorts": [{
+                "ports": [{"port": "53", "protocol": "UDP"}],
+                "rules": {"dns": [{"matchPattern": "*.example.com"}]},
+            }],
+        }, {
+            "toFQDNs": [{"matchName": "api.example.com"}],
+            "toPorts": [{"ports": [{"port": "443",
+                                    "protocol": "TCP"}]}],
+        }],
+    }])
+    return d
+
+
+def _dns_ask(addr, name: str) -> bytes:
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as c:
+        c.settimeout(3.0)
+        c.sendto(_query(name), addr)
+        resp, _ = c.recvfrom(4096)
+    return resp
+
+
+class TestWireParsing:
+    def test_query_roundtrip(self):
+        txid, name, qtype = parse_query(_query("api.example.com"))
+        assert (txid, name, qtype) == (0x1234, "api.example.com", 1)
+
+    def test_answers_with_compression(self):
+        q = _query("api.example.com")
+        resp = _answer(q, ["203.0.113.7", "203.0.113.8"], ttl=90)
+        assert parse_answers(resp) == [
+            ("api.example.com", "203.0.113.7", 90),
+            ("api.example.com", "203.0.113.8", 90)]
+
+    def test_refused_echoes_question(self):
+        q = _query("evil.test")
+        r = refused_response(q)
+        assert r[:2] == q[:2]
+        flags = struct.unpack("!H", r[2:4])[0]
+        assert flags & 0x8000 and flags & 0xF == 5
+        _, name, _ = parse_query(r)
+        assert name == "evil.test"
+
+
+class TestDNSProxyEndToEnd:
+    def test_allowed_query_feeds_fqdn_and_policy(self):
+        d = _world()
+        resolver = StubResolver(
+            {"api.example.com": ["203.0.113.7"]})
+        try:
+            addrs = d.start_dns_proxy(resolver.address)
+            assert addrs, "a DNS redirect port must exist"
+            addr = next(iter(addrs.values()))
+            resp = _dns_ask(addr, "api.example.com")
+            assert parse_answers(resp) == [
+                ("api.example.com", "203.0.113.7", 60)]
+            # the observed answer minted a toFQDNs identity: traffic
+            # to the resolved IP now forwards
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                ep = d.endpoints.lookup_by_ip("10.0.9.9")
+                ev = d.process_batch(make_batch([
+                    dict(src="10.0.9.9", dst="203.0.113.7",
+                         sport=41000, dport=443, proto=6,
+                         flags=TCP_SYN, ep=ep.id, dir=1)
+                ]).data, now=50)
+                if int(ev.reason[0]) == REASON_FORWARDED:
+                    break
+                time.sleep(0.1)
+            assert int(ev.reason[0]) == REASON_FORWARDED
+        finally:
+            resolver.close()
+            stats = d.stop_dns_proxy()
+            assert sum(s["queries"] for s in stats.values()) == 1
+
+    def test_denied_name_refused_and_never_resolves(self):
+        d = _world()
+        resolver = StubResolver({"evil.test": ["198.51.100.66"]})
+        try:
+            addrs = d.start_dns_proxy(resolver.address)
+            addr = next(iter(addrs.values()))
+            resp = _dns_ask(addr, "evil.test")
+            flags = struct.unpack("!H", resp[2:4])[0]
+            assert flags & 0xF == 5  # REFUSED
+            # nothing observed -> the IP stays outside every peer set
+            ep = d.endpoints.lookup_by_ip("10.0.9.9")
+            ev = d.process_batch(make_batch([
+                dict(src="10.0.9.9", dst="198.51.100.66",
+                     sport=42000, dport=443, proto=6, flags=TCP_SYN,
+                     ep=ep.id, dir=1)
+            ]).data, now=50)
+            assert int(ev.reason[0]) == REASON_POLICY_DEFAULT_DENY
+        finally:
+            resolver.close()
+            d.stop_dns_proxy()
