@@ -787,6 +787,149 @@ def _run_socklb_phase() -> None:
     print(json.dumps(bench_socket_lb_scaling()))
 
 
+def bench_serving(offline_batches=24, paced_seconds=2.0) -> dict:
+    """Serving front-end phase: sustained verdicts/sec under Poisson
+    arrivals through the admission queue + adaptive batcher
+    (cilium_tpu/serving) vs the OFFLINE serve_batch ceiling (perfect
+    pre-assembled full buckets) — the first entry in the BENCH
+    trajectory.  Deliberately bounded and CPU-runnable
+    (JAX_PLATFORMS=cpu): the number it defends is the front end's
+    OVERHEAD RATIO (serving_vs_offline), which is platform-relative;
+    absolute pps is whatever the backend does."""
+    import ipaddress
+
+    import jax
+
+    from cilium_tpu.agent import Daemon, DaemonConfig
+    from cilium_tpu.core.packets import (COL_DPORT, COL_DST_IP3,
+                                         COL_EP, COL_FAMILY, COL_FLAGS,
+                                         COL_LEN, COL_PROTO, COL_SPORT,
+                                         COL_SRC_IP3, N_COLS, TCP_ACK)
+
+    LADDER = (512, 2048, 8192)
+    d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 16,
+                            flow_ring_capacity=1 << 14,
+                            serving_queue_depth=1 << 15,
+                            serving_bucket_ladder=LADDER,
+                            serving_max_wait_us=2000.0))
+    d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [{"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                     "toPorts": [{"ports": [{"port": "5432",
+                                             "protocol": "TCP"}]}]}],
+    }])
+    rng = np.random.default_rng(7)
+    B = LADDER[-1]
+    src = int(ipaddress.IPv4Address("10.0.1.1"))
+    dst = int(ipaddress.IPv4Address("10.0.2.1"))
+    # bounded flow universe: after warmup the mix is established
+    # traffic (trace_sample=0 keeps it off the event ring)
+    sports = (1024 + rng.permutation(50000)[:4096]).astype(np.uint32)
+
+    def batch(n):
+        rows = np.zeros((n, N_COLS), dtype=np.uint32)
+        rows[:, COL_SRC_IP3] = src
+        rows[:, COL_DST_IP3] = dst
+        rows[:, COL_SPORT] = rng.choice(sports, n)
+        rows[:, COL_DPORT] = 5432
+        rows[:, COL_PROTO] = 6
+        rows[:, COL_FLAGS] = TCP_ACK
+        rows[:, COL_LEN] = 512
+        rows[:, COL_FAMILY] = 4
+        rows[:, COL_EP] = db.id
+        return rows
+
+    # ---- offline ceiling: pre-assembled full buckets ---------------
+    d.start_serving(trace_sample=0)
+    for b in LADDER:  # compile every ladder shape once (both phases)
+        d.serve_batch(batch(b), valid=np.ones(b, dtype=bool))
+    valid = np.ones(B, dtype=bool)
+    t0 = time.perf_counter()
+    for _ in range(offline_batches):
+        d.serve_batch(batch(B), valid=valid)
+    offline_dt = time.perf_counter() - t0
+    d.stop_serving()
+    offline_pps = offline_batches * B / offline_dt
+
+    # ---- overload: Poisson chunks offered until the target volume
+    # is ADMITTED, backing off only when the queue is full — offered
+    # load exceeds capacity, so sheds are expected and counted
+    chunks = [batch(max(int(rng.poisson(4096.0)), 1))
+              for _ in range(32)]
+    target = offline_batches * B
+    d.start_serving(trace_sample=0, ingress=True)
+    admitted = offered = i = 0
+    t0 = time.perf_counter()
+    while admitted < target:
+        c = chunks[i % len(chunks)]
+        i += 1
+        got = d.submit(c)
+        offered += len(c)
+        admitted += got
+        if got < len(c):
+            time.sleep(0.0005)  # queue full: the backpressure signal
+    stats = d.stop_serving()  # drains everything admitted
+    dt = time.perf_counter() - t0
+    fe = stats["front-end"]
+    sustained_pps = fe["verdicts"] / dt
+
+    # ---- paced: Poisson arrivals at ~50% of the offline rate — the
+    # latency-percentile run (at overload, queue wait just measures
+    # queue depth)
+    d.start_serving(trace_sample=0, ingress=True)
+    rate = max(offline_pps * 0.5, 1.0)
+    t_end = time.perf_counter() + paced_seconds
+    while time.perf_counter() < t_end:
+        c = chunks[i % len(chunks)]
+        i += 1
+        d.submit(c)
+        time.sleep(float(rng.exponential(len(c) / rate)))
+    paced = d.stop_serving()["front-end"]
+    d.shutdown()
+
+    return {
+        "offline_pps": round(offline_pps),
+        "sustained_pps": round(sustained_pps),
+        "serving_vs_offline": round(sustained_pps / offline_pps, 3),
+        "offered": offered,
+        "admitted": fe["admitted"],
+        "shed": fe["shed"],
+        "shed_drop_events": fe["shed-events"],
+        "batch_shapes": fe["batch-shapes"],
+        "pad_efficiency": fe["pad-efficiency"],
+        "bucket_ladder": list(LADDER),
+        "max_wait_us": 2000.0,
+        "overload_queue_wait_us": fe["queue-wait-us"],
+        "paced_latency_us": paced["latency-us"],
+        "paced_queue_wait_us": paced["queue-wait-us"],
+        "paced_pad_efficiency": paced["pad-efficiency"],
+        "platform": jax.default_backend(),
+        "note": ("serving front end (admission queue + power-of-two "
+                 "bucket batcher + drain loop) vs offline "
+                 "pre-assembled buckets; serving_vs_offline is the "
+                 "front end's overhead ratio, sheds are counted "
+                 "monitor DROP events (REASON_INGRESS_OVERFLOW)"),
+    }
+
+
+def _run_serving_phase() -> None:
+    """--serving: the serving front-end phase standalone (one JSON
+    line).  Also writes BENCH_serving.json next to this file — the
+    artifact that seeds the BENCH trajectory; runs bounded under
+    JAX_PLATFORMS=cpu."""
+    import os
+
+    out = bench_serving()
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_serving.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out))
+
+
 def bench_anomaly() -> dict:
     """BASELINE eval config #5 in a SUBPROCESS: a fresh process gets a
     fresh tunnel session, so the training loop (fetch-free) and this
@@ -919,6 +1062,7 @@ def main() -> None:
     e2e_wide = _phase_subprocess("--wide")
     ring_ss = _phase_subprocess("--ring")
     socklb = _phase_subprocess("--socklb")
+    serving = _phase_subprocess("--serving")
     artifact = _phase_subprocess("--artifact")
     l7 = bench_l7()
     anomaly = bench_anomaly()
@@ -934,6 +1078,7 @@ def main() -> None:
         "end_to_end_wide": e2e_wide,
         "ring_steady_state": ring_ss,
         "socket_lb": socklb,
+        "serving": serving,
         "d2h_artifact": artifact,
         "l7": l7,
         "encryption": encryption,
@@ -957,5 +1102,7 @@ if __name__ == "__main__":
         _run_ring_phase()
     elif "--socklb" in sys.argv:
         _run_socklb_phase()
+    elif "--serving" in sys.argv:
+        _run_serving_phase()
     else:
         main()

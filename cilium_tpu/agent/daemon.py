@@ -94,6 +94,21 @@ class DaemonConfig:
     # for flow-state-changing packets (non-TCP, or TCP SYN/FIN/RST).
     # Per-endpoint Debug=True exempts an endpoint from aggregation.
     monitor_aggregation: str = "none"
+    # serving front end (cilium_tpu/serving; the XDP/RSS + per-CPU
+    # ring analogue).  Validated at construction — see
+    # serving.validate_serving_config for the rules.
+    # admission queue capacity in PACKETS; overflow sheds by policy
+    serving_queue_depth: int = 1 << 16
+    # power-of-two padding buckets, strictly ascending: each distinct
+    # batch shape is one XLA compile, so the ladder bounds recompiles
+    serving_bucket_ladder: Tuple[int, ...] = (1024, 4096, 16384,
+                                              65536)
+    # max microseconds a queued packet waits before a partial bucket
+    # flushes (tail-latency bound at low load)
+    serving_max_wait_us: float = 2000.0
+    # "drop-tail" (arriving overflow sheds) | "drop-oldest" (stale
+    # queued rows shed to admit the arrival)
+    serving_overflow_policy: str = "drop-tail"
 
 
 class Daemon:
@@ -105,8 +120,22 @@ class Daemon:
         watch (reference: pkg/kvstore + pkg/allocator + clustermesh).
         Without it the daemon allocates locally."""
         from ..kvstore import ClusterIdentitySync, KVStoreAllocatorBackend
+        from ..serving import validate_serving_config
 
         self.config = config or DaemonConfig()
+        # serving knobs fail at CONSTRUCTION (config resolution hands
+        # them over as strings from env/config-dir): a typo'd policy
+        # or non-power-of-two bucket must not surface as a recompile
+        # storm under load.  Normalized values write back so the
+        # /config surface shows what actually runs.
+        (self.config.serving_queue_depth,
+         self.config.serving_bucket_ladder,
+         self.config.serving_max_wait_us,
+         self.config.serving_overflow_policy) = validate_serving_config(
+            self.config.serving_queue_depth,
+            self.config.serving_bucket_ladder,
+            self.config.serving_max_wait_us,
+            self.config.serving_overflow_policy)
         self.kvstore = kvstore if kvstore is not None else InMemoryKVStore()
         backend = None
         if kvstore is not None:
@@ -408,6 +437,7 @@ class Daemon:
 
     def shutdown(self) -> None:
         self.controllers.stop_all()
+        self.stop_serving()  # no-op when idle; drains in-flight work
         self.stop_dns_proxy()
         if self.hubble_server is not None:
             self.hubble_server.stop(grace=0.5)
@@ -761,13 +791,22 @@ class Daemon:
     # -- serving path: device event ring -> monitor plane --------------
     def start_serving(self, ring_capacity: int = 1 << 15,
                       drain_every: int = 4,
-                      trace_sample: int = 1024) -> None:
+                      trace_sample: int = 1024,
+                      ingress: bool = False) -> None:
         """Switch to the SERVING monitor path: batches run through the
         fused datapath + device event-ring append (one dispatch, no
         per-packet host fetch), and only the compacted events cross to
         the host at the drain cadence — upstream's perf-ring economics
         (the kernel streams events, not packets).  :meth:`serve_batch`
         feeds it; :meth:`stop_serving` drains what is in flight.
+
+        ``ingress=True`` additionally starts the serving FRONT END
+        (cilium_tpu/serving): a bounded admission queue + adaptive
+        batcher + drain loop, configured by the DaemonConfig
+        ``serving_*`` knobs.  :meth:`submit` then feeds a packet
+        STREAM; batches assemble, pad to the bucket ladder, and
+        dispatch through :meth:`serve_batch` with sheds surfaced as
+        monitor DROP events (``REASON_INGRESS_OVERFLOW``).
 
         Requires the tpu backend (the interpreter loader has no device
         ring).  Redirect events carry their proxy port as an index
@@ -777,13 +816,17 @@ class Daemon:
 
         from ..datapath.loader import TPULoader
         from ..monitor.ring import AsyncRingDrainer, MAX_PROXY_PORTS
+        from ..serving import (ServingAlreadyActiveError,
+                               ServingBackendError)
 
         if not isinstance(self.loader, TPULoader):
-            raise RuntimeError("serving path requires backend='tpu'")
+            raise ServingBackendError(
+                "serving path requires backend='tpu'")
         if self._serving is not None:
             # silently replacing the drainer would drop its in-flight
             # window without any loss accounting
-            raise RuntimeError("already serving; stop_serving() first")
+            raise ServingAlreadyActiveError(
+                "already serving; stop_serving() first")
         table = np.asarray(sorted(self.proxy.ports)[:MAX_PROXY_PORTS],
                            dtype=np.uint32)
         drainer = AsyncRingDrainer(ring_capacity, proxy_ports=table)
@@ -797,16 +840,87 @@ class Daemon:
             # batch_id (wrapped) -> (host hdr, numeric ids, timestamp)
             "window": {},
         }
+        if ingress:
+            from ..core.packets import N_COLS
+            from ..serving import ServingRuntime
+
+            cfg = self.config
+            runtime = ServingRuntime(
+                dispatch=self._serving_dispatch,
+                on_shed=self._publish_sheds,
+                queue_depth=cfg.serving_queue_depth,
+                bucket_ladder=cfg.serving_bucket_ladder,
+                max_wait_us=cfg.serving_max_wait_us,
+                overflow_policy=cfg.serving_overflow_policy,
+                expected_cols=N_COLS)
+            self._serving["runtime"] = runtime
+            runtime.start()
+
+    def _serving_dispatch(self, hdr: np.ndarray, valid: np.ndarray,
+                          n_valid: int) -> None:
+        """The runtime's device leg: one padded bucket through
+        serve_batch (padding masked out of CT/metrics/events).
+        ``hdr`` arrives freshly allocated per batch (batcher
+        ownership transfer), so serve_batch's retain-by-reference
+        window join is safe without a copy."""
+        self.serve_batch(hdr, valid=valid)
+
+    def _publish_sheds(self, rows: Optional[np.ndarray],
+                       count: int) -> None:
+        """Admission sheds -> monitor DROP events.  ``rows`` is the
+        bounded retained subset; ``count`` is exact (the counter in
+        serving stats carries the difference when retention capped)."""
+        from ..datapath.verdict import REASON_INGRESS_OVERFLOW
+        from ..monitor.api import synth_drop_batch
+
+        if rows is None or not len(rows):
+            return
+        batch = synth_drop_batch(rows, REASON_INGRESS_OVERFLOW,
+                                 time.time())
+        self.monitor.publish(self._filter_events(batch))
+
+    def submit(self, rows: np.ndarray,
+               t: Optional[float] = None) -> int:
+        """Offer a chunk of header rows to the serving front end
+        (requires ``start_serving(ingress=True)``); returns how many
+        were admitted.  Never blocks — overflow sheds by the
+        configured policy and surfaces as counted DROP events."""
+        from ..serving import ServingNotStartedError
+
+        s = self._serving
+        runtime = s.get("runtime") if s is not None else None
+        if runtime is None:
+            raise ServingNotStartedError(
+                "call start_serving(ingress=True) first")
+        return runtime.submit(rows, t)
+
+    def serving_stats(self) -> dict:
+        """GET /serving — front-end telemetry + ring-drain counters."""
+        s = self._serving
+        if s is None:
+            return {"active": False}
+        d = s["drainer"]
+        out = {"active": True,
+               "ring": {"windows": d.windows, "events": d.events,
+                        "lost": d.lost}}
+        runtime = s.get("runtime")
+        if runtime is not None:
+            out.update(runtime.snapshot())
+        return out
 
     def serve_batch(self, hdr: np.ndarray,
-                    now: Optional[int] = None) -> None:
+                    now: Optional[int] = None,
+                    valid: Optional[np.ndarray] = None) -> None:
         """One serving-path batch: dispatch, retain the host header
         rows for the event join, drain/emit every ``drain_every``
         batches.  ``hdr`` must be HOST memory (the serving path never
-        fetches it back)."""
+        fetches it back).  ``valid`` masks the adaptive batcher's
+        padding rows (they touch neither CT, metrics, nor the ring)."""
+        from ..serving import ServingNotStartedError
+
         s = self._serving
         if s is None:
-            raise RuntimeError("call start_serving() first")
+            raise ServingNotStartedError("call start_serving() first")
         if now is None:
             now = self._now()
         bid = s["seq"] & 0x1FFF  # ring batch field width
@@ -814,7 +928,8 @@ class Daemon:
             s["ring"], hdr, now, bid,
             trace_sample=s["trace_sample"],
             proxy_ports=s["table_dev"],
-            audit=self.config.policy_audit_mode)
+            audit=self.config.policy_audit_mode,
+            valid=valid)
         # numeric_array() copies the whole row->numeric table; the map
         # only changes on identity churn, so snapshot per
         # (object, version) — the map object is REUSED and mutated
@@ -827,6 +942,10 @@ class Daemon:
             s["row_map"] = row_map
             s["row_map_version"] = row_map.version
             s["numerics"] = row_map.numeric_array()
+        # retained by REFERENCE: callers must not mutate hdr until
+        # its window drains (the ingress runtime satisfies this by
+        # allocating a fresh hdr per batch — batcher ownership
+        # transfer, never buffer reuse)
         s["window"][bid] = (np.asarray(hdr), s["numerics"],
                             time.time())
         s["seq"] += 1
@@ -844,10 +963,18 @@ class Daemon:
 
     def stop_serving(self) -> dict:
         """Drain everything in flight and emit it; returns serving
-        stats (windows/events/lost per the drainer's accounting)."""
+        stats (windows/events/lost per the drainer's accounting, plus
+        the front-end snapshot when ingress mode was on).  Idempotent:
+        stopping an idle daemon is a no-op returning zero counters."""
         s = self._serving
         if s is None:
             return {"windows": 0, "events": 0, "lost": 0}
+        runtime = s.get("runtime")
+        front = None
+        if runtime is not None:
+            # stop the front end FIRST: its drain flushes every queued
+            # row through serve_batch before the ring drains below
+            front = runtime.stop(drain=True)
         d = s["drainer"]
         rows, _, _ = d.collect()
         self._emit_ring_rows(rows)
@@ -855,8 +982,11 @@ class Daemon:
         rows, _, _ = d.collect()
         self._emit_ring_rows(rows)
         self._serving = None
-        return {"windows": d.windows, "events": d.events,
-                "lost": d.lost}
+        out = {"windows": d.windows, "events": d.events,
+               "lost": d.lost}
+        if front is not None:
+            out["front-end"] = front
+        return out
 
     def _emit_ring_rows(self, rows: np.ndarray) -> None:
         from ..monitor.api import decode_ring_rows

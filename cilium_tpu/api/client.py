@@ -137,5 +137,8 @@ class APIClient:
     def proxy_listeners(self):
         return self._request("GET", "/proxy")
 
+    def serving_stats(self):
+        return self._request("GET", "/serving")
+
     def xds_status(self):
         return self._request("GET", "/xds")

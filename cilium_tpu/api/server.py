@@ -188,6 +188,10 @@ def _make_handler(daemon: Daemon):
                                          "with a shared kvstore)"})
                     else:
                         self._send(200, daemon.health.to_dict())
+                elif path == "/serving":
+                    # serving front-end telemetry (queue wait, pad
+                    # efficiency, verdicts/sec, latency percentiles)
+                    self._send(200, daemon.serving_stats())
                 elif path == "/anomaly":
                     if daemon.anomaly is None:
                         self._send(404, {"error": "anomaly scoring "
@@ -373,6 +377,14 @@ def _metrics_text(daemon: Daemon) -> str:
         f"cilium_endpoint_count {len(daemon.endpoints.list())}")
     lines.append(
         f"cilium_identity_count {len(daemon.allocator.all_identities())}")
+    sv = daemon.serving_stats()
+    if sv.get("active") and "verdicts" in sv:
+        lines.append("# TYPE cilium_serving_verdicts_total counter")
+        lines.append(f"cilium_serving_verdicts_total {sv['verdicts']}")
+        lines.append("# TYPE cilium_serving_shed_total counter")
+        lines.append(f"cilium_serving_shed_total {sv['shed']}")
+        lines.append("# TYPE cilium_serving_batches_total counter")
+        lines.append(f"cilium_serving_batches_total {sv['batches']}")
     return "\n".join(lines) + "\n" + daemon.flow_metrics.render()
 
 

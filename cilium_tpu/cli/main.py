@@ -382,6 +382,48 @@ def cmd_anomaly(args) -> int:
     return 1
 
 
+def cmd_serving(args) -> int:
+    """`cilium-tpu serving stats [--follow]`: the serving front-end's
+    live telemetry (queue depth/wait, pad efficiency, batches/sec,
+    verdicts/sec, shed counters, p50/p95/p99 latency)."""
+    c = _client(args)
+    try:
+        while True:
+            st = c.serving_stats()
+            if args.json:
+                _print(st)
+            elif not st.get("active"):
+                print("Serving: inactive (start_serving has not run)")
+            else:
+                ring = st.get("ring", {})
+                print(f"Serving:   up {st.get('uptime-seconds', 0)}s, "
+                      f"{st.get('batches', 0)} batches, "
+                      f"{st.get('batches-per-sec', 0)}/s")
+                print(f"Verdicts:  {st.get('verdicts', 0)} "
+                      f"({st.get('verdicts-per-sec', 0)}/s), "
+                      f"pad-efficiency {st.get('pad-efficiency')}")
+                print(f"Queue:     {st.get('queue-pending', 0)}/"
+                      f"{st.get('queue-depth', 0)} pending, "
+                      f"admitted {st.get('admitted', 0)}, "
+                      f"shed {st.get('shed', 0)} "
+                      f"({st.get('shed-events', 0)} as drop events)")
+                print(f"Shapes:    {st.get('batch-shapes', {})}")
+                for name, key in (("Queue-wait", "queue-wait-us"),
+                                  ("Latency", "latency-us")):
+                    h = st.get(key) or {}
+                    print(f"{name}: p50={h.get('p50')}us "
+                          f"p95={h.get('p95')}us p99={h.get('p99')}us "
+                          f"max={h.get('max')}us n={h.get('count')}")
+                print(f"Ring:      {ring.get('windows', 0)} windows, "
+                      f"{ring.get('events', 0)} events, "
+                      f"{ring.get('lost', 0)} lost")
+            if not args.follow:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
 def cmd_monitor(args) -> int:
     """Tail the flow stream (reference: `cilium monitor`)."""
     c = _client(args)
@@ -416,6 +458,10 @@ def cmd_daemon(args) -> int:
         "state_dir": args.state_dir,
         "export_path": args.export,
         "anomaly_model_path": args.anomaly_model,
+        "serving_queue_depth": args.serving_queue_depth,
+        "serving_bucket_ladder": args.serving_bucket_ladder,
+        "serving_max_wait_us": args.serving_max_wait_us,
+        "serving_overflow_policy": args.serving_overflow_policy,
     }.items() if v is not None}
     cfg = load_config(config_dir=args.config_dir, **overrides)
     d = Daemon(cfg)
@@ -522,6 +568,14 @@ def main(argv=None) -> int:
     p.add_argument("--follow", "-f", action="store_true")
     p.add_argument("--interval", type=float, default=1.0)
 
+    p = sub.add_parser("serving",
+                       help="serving front-end stats (queue, batches, "
+                            "sheds, latency percentiles)")
+    p.add_argument("action", nargs="?", default="stats",
+                   choices=["stats"])
+    p.add_argument("--follow", "-f", action="store_true")
+    p.add_argument("--interval", type=float, default=1.0)
+
     p = sub.add_parser("anomaly", help="anomaly stats | train | synth "
                                        "| score (pcap evaluation)")
     p.add_argument("action", nargs="?", default="stats",
@@ -547,6 +601,25 @@ def main(argv=None) -> int:
     p.add_argument("--state-dir")
     p.add_argument("--export", help="flow export JSONL path")
     p.add_argument("--anomaly-model", help="trained AnomalyModel .npz")
+    p.add_argument("--serving-queue-depth", type=int, default=None,
+                   help="serving admission queue capacity in packets "
+                        "(default 65536); overflow sheds by "
+                        "--serving-overflow-policy and is counted as "
+                        "monitor drop events")
+    p.add_argument("--serving-bucket-ladder", default=None,
+                   help="comma-separated power-of-two batch buckets, "
+                        "ascending (default 1024,4096,16384,65536); "
+                        "each distinct bucket is one JIT-compiled "
+                        "shape, so the ladder bounds recompiles")
+    p.add_argument("--serving-max-wait-us", type=float, default=None,
+                   help="max microseconds a queued packet waits before "
+                        "a partial bucket flushes (default 2000); "
+                        "bounds tail latency at low load")
+    p.add_argument("--serving-overflow-policy", default=None,
+                   choices=["drop-tail", "drop-oldest"],
+                   help="admission shed policy when the queue is full "
+                        "(default drop-tail: arriving overflow sheds; "
+                        "drop-oldest evicts stale queued rows)")
 
     args = parser.parse_args(argv)
     if args.cmd == "version":
@@ -560,6 +633,7 @@ def main(argv=None) -> int:
             "endpoint": cmd_endpoint, "identity": cmd_identity,
             "bpf": cmd_bpf, "map": cmd_map, "metrics": cmd_metrics,
             "flows": cmd_flows, "monitor": cmd_monitor,
+            "serving": cmd_serving,
             "anomaly": cmd_anomaly, "daemon": cmd_daemon,
             "service": cmd_service, "fqdn": cmd_fqdn,
             "health": cmd_health, "config": cmd_config,

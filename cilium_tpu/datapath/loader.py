@@ -245,22 +245,28 @@ class TPULoader(Loader):
 
     def serve(self, ring, hdr, now: int, batch_id: int,
               trace_sample: int = 1024, proxy_ports=None,
-              audit: bool = False):
+              audit: bool = False, valid=None):
         """The SERVING-path step: fused datapath + event-ring append
         in one dispatch, NO host fetch (monitor/ring.py serve_step).
         Returns (ring', row_map); events reach the host when the
         caller drains the ring at its own cadence — the perf-ring
-        economics, vs :meth:`step`'s fetch-per-batch debug path."""
+        economics, vs :meth:`step`'s fetch-per-batch debug path.
+
+        ``valid`` ([N] bool, optional) masks the adaptive batcher's
+        padding rows: masked rows touch neither CT, metrics, nor the
+        event ring, so one bucket size stays one compiled shape."""
         from ..monitor.ring import serve_step_jit
 
         jnp = self._jnp
         if isinstance(hdr, np.ndarray):
             hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        if isinstance(valid, np.ndarray):
+            valid = jnp.asarray(valid)
         with self._lock:
             self.state, ring = serve_step_jit(
                 self.state, ring, hdr, jnp.uint32(now),
                 jnp.uint32(batch_id), trace_sample=trace_sample,
-                proxy_ports=proxy_ports, audit=audit)
+                valid=valid, proxy_ports=proxy_ports, audit=audit)
             row_map = self.row_map
         return ring, row_map
 

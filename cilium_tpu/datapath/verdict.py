@@ -65,7 +65,13 @@ REASON_NAT_EXHAUSTED = 5  # SNAT port pool exhausted (DROP_NAT_NO_MAPPING)
 REASON_BANDWIDTH = 6  # egress rate limit (bandwidth manager / EDT)
 REASON_NO_SERVICE = 7  # service frontend with no backend (DROP_NO_SERVICE)
 REASON_AUTH_REQUIRED = 8  # policy allows, mutual auth missing (pkg/auth)
-N_REASONS = 9
+# admission-queue shed at the serving front door (cilium_tpu/serving):
+# the XDP-ring-overflow analogue.  Host-synthesized (the row never
+# reached the device), but numbered in this space so every decode
+# table — monitor, flow layer, ring wire format (4-bit field) — names
+# it like any datapath drop.
+REASON_INGRESS_OVERFLOW = 9
+N_REASONS = 10
 
 # Event types in the out tensor (monitor vocabulary).
 EV_TRACE = 0  # TraceNotify: forwarded established/reply traffic

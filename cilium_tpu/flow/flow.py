@@ -42,6 +42,7 @@ DROP_REASON_DESC = {
     6: "BANDWIDTH_LIMITED",  # egress rate limit (EDT analogue)
     7: "NO_SERVICE",  # frontend with no backend (DROP_NO_SERVICE)
     8: "AUTH_REQUIRED",  # mutual auth missing (pkg/auth)
+    9: "INGRESS_QUEUE_OVERFLOW",  # serving admission shed (XDP ring)
 }
 
 

@@ -62,6 +62,11 @@ DROP_REASON_NAMES = {
     2: "Policy denied (default deny)",
     3: "Shard queue overflow",
     4: "No endpoint found",  # lxcmap miss (unregistered endpoint id)
+    5: "No mapping for NAT masquerade",  # SNAT port pool exhausted
+    6: "Bandwidth limit exceeded",  # egress rate limit (EDT)
+    7: "No service backend",  # frontend with no backend
+    8: "Authentication required",  # mutual auth missing (pkg/auth)
+    9: "Ingress queue overflow",  # serving admission shed (XDP ring)
 }
 
 
@@ -230,6 +235,27 @@ def decode_out(out: np.ndarray, hdr: np.ndarray,
         ct_state=out[:, OUT_CT].astype(np.uint8),
         identity=row_to_numeric[out[:, OUT_ID_ROW]].astype(np.uint32),
         proxy_port=out[:, OUT_PROXY].astype(np.uint16),
+        hdr=hdr,
+        timestamp=timestamp,
+    )
+
+
+def synth_drop_batch(hdr: np.ndarray, reason: int,
+                     timestamp: float) -> EventBatch:
+    """Host-synthesized DROP events for rows that never reached the
+    device — today the serving plane's admission sheds
+    (``REASON_INGRESS_OVERFLOW``).  Identity is 0 (unknown): the shed
+    happens BEFORE ipcache resolution, exactly like an XDP-ring drop
+    fires before any per-packet program runs."""
+    hdr = np.asarray(hdr)
+    n = len(hdr)
+    return EventBatch(
+        msg_type=np.full(n, MSG_DROP, dtype=np.uint8),
+        verdict=np.zeros(n, dtype=np.uint8),  # 0 = dropped
+        reason=np.full(n, reason, dtype=np.uint8),
+        ct_state=np.zeros(n, dtype=np.uint8),
+        identity=np.zeros(n, dtype=np.uint32),
+        proxy_port=np.zeros(n, dtype=np.uint16),
         hdr=hdr,
         timestamp=timestamp,
     )

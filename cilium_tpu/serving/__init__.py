@@ -1,0 +1,101 @@
+"""Serving front-end: the stream -> batch admission layer.
+
+Reference: upstream cilium absorbs variable-rate traffic with the
+XDP/RSS front end and per-CPU rings before any per-packet program
+runs; production inference stacks solve the same problem with
+continuous batching.  This package is that layer for the TPU
+datapath: a packet *stream* enters, fixed-shape batches leave.
+
+Pieces (PARITY.md row 54):
+
+- :mod:`.ingress` — bounded admission queue (the XDP ring analogue)
+  with a configurable overflow policy; sheds are counted and surface
+  as monitor DROP events (``REASON_INGRESS_OVERFLOW``), never lost
+  silently.
+- :mod:`.batcher` — adaptive batcher padding to a small ladder of
+  power-of-two bucket sizes (bounds JIT recompiles to the ladder
+  length) and flushing on bucket-full OR a max-wait deadline.
+- :mod:`.runtime` — the drain loop: assemble batch N+1 on the host
+  while batch N executes on device (``Daemon.serve_batch``), with
+  clean start/stop/drain semantics.
+- :mod:`.stats` — per-batch telemetry: queue wait, pad efficiency,
+  batches/sec, verdicts/sec, p50/p95/p99 end-to-end latency.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base of the serving plane's typed errors.  Subclasses
+    RuntimeError so pre-existing ``except RuntimeError`` callers (and
+    tests matching it) keep working."""
+
+
+class ServingNotStartedError(ServingError):
+    """serve_batch()/submit() before start_serving()."""
+
+
+class ServingAlreadyActiveError(ServingError):
+    """start_serving() while a serving session is live — silently
+    replacing the drainer would drop its in-flight window without any
+    loss accounting."""
+
+
+class ServingBackendError(ServingError):
+    """The serving path needs the tpu backend (the interpreter loader
+    has no device event ring)."""
+
+
+def validate_serving_config(queue_depth: int, bucket_ladder,
+                            max_wait_us, overflow_policy: str) -> tuple:
+    """Validate the DaemonConfig serving knobs; returns the normalized
+    ``(queue_depth, ladder, max_wait_us, overflow_policy)`` tuple.
+    Raises ValueError with an actionable message — a typo'd policy or
+    a non-power-of-two bucket must fail at construction, not as a
+    recompile storm (or an assert) under load."""
+    ladder = tuple(int(b) for b in bucket_ladder)
+    if not ladder:
+        raise ValueError("serving_bucket_ladder must name at least "
+                         "one bucket size")
+    for b in ladder:
+        if b <= 0 or b & (b - 1):
+            raise ValueError(
+                f"serving bucket size {b} is not a power of two "
+                "(each distinct batch shape is one JIT compile; the "
+                "ladder exists to bound them)")
+    if list(ladder) != sorted(set(ladder)):
+        raise ValueError(
+            f"serving_bucket_ladder {ladder} must be strictly "
+            "ascending with no duplicates")
+    depth = int(queue_depth)
+    if depth < ladder[-1]:
+        raise ValueError(
+            f"serving_queue_depth {depth} is smaller than the largest "
+            f"bucket {ladder[-1]}; a full bucket could never assemble")
+    wait = float(max_wait_us)
+    if wait < 0:
+        raise ValueError("serving_max_wait_us must be >= 0")
+    if overflow_policy not in ("drop-tail", "drop-oldest"):
+        raise ValueError(
+            f"serving_overflow_policy must be drop-tail|drop-oldest, "
+            f"got {overflow_policy!r}")
+    return depth, ladder, wait, overflow_policy
+
+
+from .batcher import AdaptiveBatcher  # noqa: E402
+from .ingress import IngressQueue  # noqa: E402
+from .runtime import ServingRuntime  # noqa: E402
+from .stats import LatencyHistogram, ServingStats  # noqa: E402
+
+__all__ = [
+    "AdaptiveBatcher",
+    "IngressQueue",
+    "LatencyHistogram",
+    "ServingError",
+    "ServingAlreadyActiveError",
+    "ServingBackendError",
+    "ServingNotStartedError",
+    "ServingRuntime",
+    "ServingStats",
+    "validate_serving_config",
+]

@@ -1,0 +1,99 @@
+"""Adaptive batcher: stream -> fixed-shape device batches.
+
+The device path wants large fixed shapes (every distinct batch shape
+is one XLA compile); the stream wants low latency.  The batcher pads
+to a small LADDER of power-of-two bucket sizes — bounding the set of
+compiled shapes to ``len(ladder)`` — and flushes on bucket-full OR a
+max-wait deadline, so tail latency is bounded at low load and
+throughput is maximized at high load (the continuous-batching
+trade-off every serving stack makes; upstream's analogue is NAPI
+polling — batch what arrived, don't wait for a full ring).
+
+Padding rows are ZEROS carried with a ``valid`` mask: the datapath
+masks them out of CT and metrics (``datapath_step(valid=...)``) and
+the event ring never emits them, so a padded batch is
+indistinguishable from its real rows downstream.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from .ingress import IngressQueue
+
+
+class AssembledBatch(NamedTuple):
+    hdr: np.ndarray  # [bucket, N_COLS] uint32 (padded)
+    valid: np.ndarray  # [bucket] bool
+    n_valid: int
+    arrivals: List[Tuple[int, float]]  # (count, t_arrival) chunks
+
+
+class AdaptiveBatcher:
+    def __init__(self, bucket_ladder, max_wait_us: float):
+        self.ladder = tuple(int(b) for b in bucket_ladder)
+        assert self.ladder == tuple(sorted(set(self.ladder))), \
+            "ladder must be validated (ascending, unique) upstream"
+        self.max_wait_s = float(max_wait_us) * 1e-6
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket holding ``n`` rows (the largest
+        bucket when ``n`` exceeds it — callers take at most that)."""
+        for b in self.ladder:
+            if n <= b:
+                return b
+        return self.ladder[-1]
+
+    def due(self, queue: IngressQueue,
+            now: Optional[float] = None) -> bool:
+        """Is a flush warranted right now?  Full-bucket OR deadline."""
+        pending = queue.pending
+        if pending == 0:
+            return False
+        if pending >= self.ladder[-1]:
+            return True
+        return queue.oldest_age(now) >= self.max_wait_s
+
+    def assemble(self, queue: IngressQueue,
+                 now: Optional[float] = None,
+                 force: bool = False) -> Optional[AssembledBatch]:
+        """Dequeue one batch if a flush is due; None otherwise.
+        ``force`` flushes whatever is queued regardless of deadline
+        (the stop/drain path).
+
+        The returned ``hdr``/``valid`` arrays are FRESH per batch —
+        ownership transfers to the dispatcher, which retains ``hdr``
+        for the drain-time event join and may still be feeding an
+        async h2d copy when the next batch assembles.  One bucket
+        write per batch either way; reusable buffers would force the
+        dispatcher to copy anyway.
+
+        The ``valid`` mask is passed even for full buckets so each
+        bucket size stays ONE compiled shape (a with-mask and a
+        without-mask variant would double the compile count)."""
+        if now is None:
+            now = time.monotonic()
+        if not force and not self.due(queue, now):
+            return None
+        rows, arrivals = queue.take(self.ladder[-1])
+        n = len(rows)
+        if n == 0:
+            return None
+        bucket = self.bucket_for(n)
+        hdr = np.zeros((bucket, rows.shape[1]), dtype=np.uint32)
+        hdr[:n] = rows
+        valid = np.zeros(bucket, dtype=bool)
+        valid[:n] = True
+        return AssembledBatch(hdr=hdr, valid=valid, n_valid=n,
+                              arrivals=arrivals)
+
+    def time_to_deadline(self, queue: IngressQueue,
+                         now: Optional[float] = None) -> float:
+        """Seconds until the head-of-line chunk's deadline expires
+        (max_wait when empty) — the runtime's idle-wait bound."""
+        if queue.pending == 0:
+            return self.max_wait_s
+        return max(0.0, self.max_wait_s - queue.oldest_age(now))

@@ -1,0 +1,229 @@
+"""The serving drain loop: ingress stream -> device batches.
+
+Reference: upstream cilium's NAPI-ish consumption of the XDP/RSS
+front end — a poll loop takes what arrived (up to the ring budget),
+runs it through the datapath, and surfaces sheds as counted drops.
+Production inference stacks call the same shape "continuous
+batching".
+
+Double buffering: ``dispatch`` (``Daemon.serve_batch`` under the
+hood) ENQUEUES the device work and returns — jax dispatch is async —
+so while batch N executes on device, this loop is already draining
+the queue and padding batch N+1 on the host.  The batcher allocates
+FRESH hdr/valid arrays per batch (ownership transfers to the
+dispatcher), so assembly never touches pages an in-flight h2d copy
+or the drain-time event join may still be reading.
+
+The loop owns all dispatch: ``submit()`` (any thread) only offers
+rows to the bounded ingress queue, which is the backpressure point —
+overflow sheds by policy, sheds surface through ``on_shed`` as
+monitor DROP events, and nothing ever blocks the producer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from . import ServingAlreadyActiveError, validate_serving_config
+from .batcher import AdaptiveBatcher, AssembledBatch
+from .ingress import IngressQueue
+from .stats import ServingStats
+
+# dispatch(hdr [bucket, N_COLS], valid [bucket] bool, n_valid) -> None
+DispatchFn = Callable[[np.ndarray, np.ndarray, int], None]
+# on_shed(retained header rows or None, exact shed count) -> None
+ShedFn = Callable[[Optional[np.ndarray], int], None]
+
+# idle wait granularity: how long the loop sleeps when rows are
+# pending but neither bucket-full nor deadline has fired yet.  Small
+# enough that a max-wait deadline is honored within ~1ms.
+_TICK_S = 0.001
+
+
+class ServingRuntime:
+    """start() -> submit() from any thread -> stop(drain=True).
+
+    ``dispatch`` is the device leg (``Daemon.serve_batch``); the
+    runtime never imports the agent so the serving plane stays a
+    leaf package."""
+
+    def __init__(self, dispatch: DispatchFn, queue_depth: int,
+                 bucket_ladder, max_wait_us: float,
+                 overflow_policy: str = "drop-tail",
+                 on_shed: Optional[ShedFn] = None,
+                 expected_cols: Optional[int] = None):
+        depth, ladder, wait, policy = validate_serving_config(
+            queue_depth, bucket_ladder, max_wait_us, overflow_policy)
+        self.queue = IngressQueue(depth, policy)
+        self.batcher = AdaptiveBatcher(ladder, wait)
+        self.stats = ServingStats()
+        self._dispatch = dispatch
+        self._on_shed = on_shed
+        # row width the datapath expects (N_COLS): a malformed chunk
+        # must bounce off submit() with a ValueError, not detonate
+        # inside the drain thread batches later
+        self._expected_cols = expected_cols
+        self._error: Optional[str] = None  # terminal drain-loop fault
+        self._stop = threading.Event()
+        # serializes submit() against stop()'s final drain: a chunk
+        # offered after the drain swept the queue would sit there
+        # forever — neither dispatched nor shed-counted
+        self._submit_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        # arrivals of the batch currently executing on device: its
+        # end-to-end completion is stamped when the NEXT dispatch
+        # returns (the device runs batches in order, so by then batch
+        # N's events have been appended)
+        self._prev_arrivals: List[Tuple[int, float]] = []
+
+    # -- producer side (any thread) -----------------------------------
+    def submit(self, rows: np.ndarray,
+               t: Optional[float] = None) -> int:
+        """Offer a chunk of header rows; returns how many were
+        admitted.  Never blocks on the datapath: overflow sheds by
+        the configured policy and is surfaced as counted monitor DROP
+        events.  Raises after :meth:`stop` — a post-drain chunk would
+        queue forever, neither dispatched nor shed-counted."""
+        from . import ServingError, ServingNotStartedError
+
+        rows = np.asarray(rows)
+        if rows.ndim != 2 or not np.issubdtype(rows.dtype,
+                                               np.integer):
+            raise ValueError(
+                "submit() wants [n, N_COLS] integer header rows, got "
+                f"shape {rows.shape} dtype {rows.dtype}")
+        if (self._expected_cols is not None
+                and rows.shape[1] != self._expected_cols):
+            raise ValueError(
+                f"submit() wants {self._expected_cols}-column header "
+                f"rows, got {rows.shape[1]}")
+        with self._submit_lock:
+            if self._error is not None:
+                raise ServingError(
+                    f"serving drain loop died: {self._error}")
+            if self._stop.is_set():
+                raise ServingNotStartedError(
+                    "serving runtime is stopped")
+            offered = len(rows)
+            accepted = self.queue.offer(rows, t)
+            self.stats.record_submit(offered, accepted)
+            return accepted
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise ServingAlreadyActiveError(
+                "serving runtime already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True,
+                                        name="serving-drain")
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> dict:
+        """Stop the loop; with ``drain`` (default) every queued row is
+        batched and dispatched before returning.  Idempotent.
+
+        Raises :class:`ServingError` if the loop thread does not exit
+        within ``timeout`` (e.g. stuck in a first-dispatch XLA
+        compile): draining concurrently with a live loop would race
+        on the batcher's unsynchronized buffers — the caller retries
+        once the dispatch returns."""
+        from . import ServingError
+
+        with self._submit_lock:  # in-flight submit finishes or fails
+            self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                raise ServingError(
+                    f"serving drain loop still running after "
+                    f"{timeout}s (dispatch in flight?); retry stop()")
+            self._thread = None
+        if drain and self._error is None:
+            # the loop thread has exited; dispatch stays serialized.
+            # (a dead loop skips the drain — the same fault would
+            # fire again; the error rides the snapshot instead)
+            while True:
+                batch = self.batcher.assemble(self.queue, force=True)
+                if batch is None:
+                    break
+                self._dispatch_one(batch)
+        if self._prev_arrivals:
+            self.stats.record_completion(self._prev_arrivals,
+                                         time.monotonic())
+            self._prev_arrivals = []
+        self._flush_sheds()
+        return self.snapshot()
+
+    def snapshot(self) -> dict:
+        out = self.stats.snapshot(queue_pending=self.queue.pending,
+                                  queue_depth=self.queue.capacity)
+        if self._error is not None:
+            out["error"] = self._error
+        return out
+
+    # -- the drain loop ------------------------------------------------
+    def _loop(self) -> None:
+        try:
+            self._loop_body()
+        except Exception as e:  # noqa: BLE001 — a dying drain thread
+            # must leave a visible corpse: submit() raises from here
+            # on, serving_stats() carries the fault, and stop() skips
+            # the doomed drain
+            self._error = f"{type(e).__name__}: {e}"
+
+    def _loop_body(self) -> None:
+        while not self._stop.is_set():
+            batch = self.batcher.assemble(self.queue)
+            if batch is not None:
+                self._dispatch_one(batch)
+                continue
+            # idle: stamp the last batch's completion now rather than
+            # at the next dispatch (which may never come — an idle
+            # hour must not be recorded as that batch's latency at
+            # stop).  Approximate on async backends: its dispatch has
+            # returned, residual device work is bounded by the drain
+            # cadence.
+            if self._prev_arrivals:
+                self.stats.record_completion(self._prev_arrivals,
+                                             time.monotonic())
+                self._prev_arrivals = []
+            self._flush_sheds()
+            if self.queue.pending:
+                # rows are waiting but neither full-bucket nor
+                # deadline fired: sleep toward the deadline
+                time.sleep(min(
+                    self.batcher.time_to_deadline(self.queue),
+                    _TICK_S) or _TICK_S)
+            else:
+                self.queue.wait_nonempty(0.05)
+
+    def _dispatch_one(self, batch: AssembledBatch) -> None:
+        t0 = time.monotonic()
+        self._dispatch(batch.hdr, batch.valid, batch.n_valid)
+        t1 = time.monotonic()
+        self.stats.record_batch(batch.n_valid, len(batch.hdr),
+                                batch.arrivals, t0)
+        if self._prev_arrivals:
+            self.stats.record_completion(self._prev_arrivals, t1)
+        self._prev_arrivals = batch.arrivals
+        self._flush_sheds()
+
+    def _flush_sheds(self) -> None:
+        rows, count = self.queue.take_sheds()
+        if count == 0:
+            return
+        if self._on_shed is not None:
+            self._on_shed(rows, count)
+        self.stats.record_sheds(count,
+                                len(rows) if rows is not None else 0)

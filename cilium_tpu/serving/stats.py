@@ -1,0 +1,153 @@
+"""Serving telemetry: per-batch counters + latency histograms.
+
+The monitor plane streams EVENTS; this module answers the operator
+questions events cannot: how long do packets wait for admission, how
+much device work is padding, what end-to-end latency do the p95/p99
+packets see, and is the runtime keeping up with offered load.
+Exposed through ``GET /serving`` and ``cilium-tpu serving stats``.
+
+Histograms are fixed log2 buckets in microseconds (1µs .. ~17min) —
+constant memory, lock-cheap to record, and percentile reads return
+the bucket upper bound (the conservative read: a reported p99 is
+never better than reality).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+N_BUCKETS = 30  # 2^30 us ~ 17.9 min: past any sane serving latency
+
+
+class LatencyHistogram:
+    """Log2-bucketed microsecond histogram."""
+
+    def __init__(self):
+        self.buckets = [0] * N_BUCKETS
+        self.count = 0
+        self.max_us = 0.0
+
+    def record(self, us: float) -> None:
+        if us < 0:
+            us = 0.0
+        idx = min(max(int(us), 0).bit_length(), N_BUCKETS - 1)
+        self.buckets[idx] += 1
+        self.count += 1
+        if us > self.max_us:
+            self.max_us = us
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Upper bound of the bucket holding the p-quantile (None
+        when empty)."""
+        if self.count == 0:
+            return None
+        target = p * self.count
+        acc = 0
+        for i, c in enumerate(self.buckets):
+            acc += c
+            if acc >= target:
+                # bucket i holds [2^(i-1), 2^i); report its upper
+                # bound, capped at the observed max
+                return float(min(1 << i, max(self.max_us, 1.0)))
+        return self.max_us
+
+    def snapshot(self) -> Dict[str, Optional[float]]:
+        return {
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+            "max": self.max_us if self.count else None,
+            "count": self.count,
+        }
+
+
+class ServingStats:
+    """Cumulative serving-session telemetry.  Written by the runtime
+    thread, snapshot by API/CLI threads — one lock, coarse."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.started_at = time.monotonic()
+        self.submitted = 0  # packets offered to the queue
+        self.admitted = 0  # packets the queue accepted
+        self.shed = 0  # packets shed at admission (exact)
+        self.shed_events = 0  # shed rows surfaced as DROP events
+        self.batches = 0
+        self.verdicts = 0  # real (valid) rows dispatched
+        self.padded_rows = 0  # padding rows dispatched
+        self.shapes: Dict[int, int] = {}  # bucket size -> batches
+        self.queue_wait = LatencyHistogram()  # arrival -> dispatch
+        self.latency = LatencyHistogram()  # arrival -> events emitted
+
+    # -- recording (runtime thread) -----------------------------------
+    def record_submit(self, offered: int, accepted: int) -> None:
+        """``accepted`` is what the queue took from THIS chunk.  The
+        shed counter is NOT derived from the difference — under
+        drop-oldest the queue admits the whole arrival and evicts
+        previously-admitted rows instead, so sheds are recorded from
+        the queue's own exact accounting (:meth:`record_sheds`)."""
+        with self._lock:
+            self.submitted += offered
+            self.admitted += accepted
+
+    def record_sheds(self, count: int, retained: int) -> None:
+        """``count`` exact sheds since the last flush (either policy);
+        ``retained`` of them surfaced as DROP events (retention is
+        bounded, the counter is not)."""
+        with self._lock:
+            self.shed += count
+            self.shed_events += retained
+
+    def record_batch(self, n_valid: int, bucket: int,
+                     arrivals: List[Tuple[int, float]],
+                     t_dispatch: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.verdicts += n_valid
+            self.padded_rows += bucket - n_valid
+            self.shapes[bucket] = self.shapes.get(bucket, 0) + 1
+            # chunk-granular: one sample per chunk keeps the record
+            # cost O(chunks), not O(packets)
+            for count, t in arrivals:
+                if count:
+                    self.queue_wait.record((t_dispatch - t) * 1e6)
+
+    def record_completion(self, arrivals: List[Tuple[int, float]],
+                          t_done: float) -> None:
+        """End-to-end: arrival -> the batch's events emitted to the
+        monitor plane (the drain boundary)."""
+        with self._lock:
+            for _count, t in arrivals:
+                self.latency.record((t_done - t) * 1e6)
+
+    # -- reading (API/CLI threads) ------------------------------------
+    def snapshot(self, queue_pending: int = 0,
+                 queue_depth: int = 0) -> dict:
+        with self._lock:
+            dt = max(time.monotonic() - self.started_at, 1e-9)
+            pad = self.padded_rows
+            real = self.verdicts
+            return {
+                # no "active" key: liveness is the daemon's to report
+                # (a snapshot outlives the session that produced it)
+                "uptime-seconds": round(dt, 3),
+                "submitted": self.submitted,
+                "admitted": self.admitted,
+                "shed": self.shed,
+                "shed-events": self.shed_events,
+                "batches": self.batches,
+                "verdicts": real,
+                "padded-rows": pad,
+                "pad-efficiency": round(real / (real + pad), 4)
+                if (real + pad) else None,
+                "batches-per-sec": round(self.batches / dt, 2),
+                "verdicts-per-sec": round(real / dt),
+                "batch-shapes": {str(k): v for k, v in
+                                 sorted(self.shapes.items())},
+                "queue-pending": queue_pending,
+                "queue-depth": queue_depth,
+                "queue-wait-us": self.queue_wait.snapshot(),
+                "latency-us": self.latency.snapshot(),
+            }

@@ -1,0 +1,642 @@
+"""Serving front end: admission queue, adaptive batcher, drain
+runtime, backpressure, and latency telemetry (cilium_tpu/serving).
+
+Acceptance (ISSUE 1): under Poisson-ish arrival load the serving
+runtime sustains >= 90% of the offline serve_batch throughput at high
+load, bounds batch shapes to the configured bucket ladder, and
+reports non-zero shed counters as monitor drop events
+(REASON_INGRESS_OVERFLOW) when offered load exceeds capacity — all on
+CPU.
+"""
+
+import ipaddress
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.agent.config import load_config
+from cilium_tpu.core.packets import (COL_DPORT, COL_DST_IP3, COL_EP,
+                                     COL_FAMILY, COL_FLAGS, COL_LEN,
+                                     COL_PROTO, COL_SPORT, COL_SRC_IP3,
+                                     N_COLS, TCP_ACK)
+from cilium_tpu.datapath.verdict import REASON_INGRESS_OVERFLOW
+from cilium_tpu.monitor.api import (DROP_REASON_NAMES, MSG_DROP,
+                                    DropNotify, materialize,
+                                    synth_drop_batch)
+from cilium_tpu.serving import (AdaptiveBatcher, IngressQueue,
+                                LatencyHistogram,
+                                ServingAlreadyActiveError,
+                                ServingBackendError,
+                                ServingNotStartedError, ServingRuntime,
+                                validate_serving_config)
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }],
+}]
+
+SRC = int(ipaddress.IPv4Address("10.0.1.1"))
+DST = int(ipaddress.IPv4Address("10.0.2.1"))
+
+
+def _daemon(queue=8192, ladder=(256, 1024), wait_us=1000.0,
+            policy="drop-tail"):
+    d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12,
+                            flow_ring_capacity=1 << 13,
+                            serving_queue_depth=queue,
+                            serving_bucket_ladder=ladder,
+                            serving_max_wait_us=wait_us,
+                            serving_overflow_policy=policy))
+    d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    d.policy_import(RULES)
+    return d, db
+
+
+def _traffic(db_id, n, rng, sport_pool=2048):
+    """Established-flow-shaped rows (bounded sport universe)."""
+    rows = np.zeros((n, N_COLS), dtype=np.uint32)
+    rows[:, COL_SRC_IP3] = SRC
+    rows[:, COL_DST_IP3] = DST
+    rows[:, COL_SPORT] = 1024 + rng.integers(0, sport_pool, n)
+    rows[:, COL_DPORT] = 5432
+    rows[:, COL_PROTO] = 6
+    rows[:, COL_FLAGS] = TCP_ACK
+    rows[:, COL_LEN] = 512
+    rows[:, COL_FAMILY] = 4
+    rows[:, COL_EP] = db_id
+    return rows
+
+
+class TestIngressQueue:
+    def test_drop_tail_sheds_the_arrival_overflow(self):
+        q = IngressQueue(100, "drop-tail")
+        rows = np.arange(150 * N_COLS, dtype=np.uint32).reshape(150, -1)
+        assert q.offer(rows[:60]) == 60
+        assert q.offer(rows[60:]) == 40  # room for 40 of 90
+        assert q.pending == 100
+        assert q.shed == 50
+        shed_rows, count = q.take_sheds()
+        assert count == 50
+        # drop-tail: the TAIL of the arriving chunk shed
+        np.testing.assert_array_equal(shed_rows, rows[100:])
+        # accounting drains: second call reports nothing
+        assert q.take_sheds() == (None, 0)
+
+    def test_drop_oldest_evicts_the_head(self):
+        q = IngressQueue(100, "drop-oldest")
+        rows = np.arange(160 * N_COLS, dtype=np.uint32).reshape(160, -1)
+        assert q.offer(rows[:100]) == 100
+        assert q.offer(rows[100:]) == 60  # all admitted; oldest shed
+        assert q.pending == 100
+        shed_rows, count = q.take_sheds()
+        assert count == 60
+        np.testing.assert_array_equal(shed_rows, rows[:60])
+        got, _ = q.take(100)
+        np.testing.assert_array_equal(got, rows[60:])
+
+    def test_take_is_fifo_with_chunk_granular_arrivals(self):
+        q = IngressQueue(1000)
+        a = np.full((30, N_COLS), 1, dtype=np.uint32)
+        b = np.full((50, N_COLS), 2, dtype=np.uint32)
+        q.offer(a, t=10.0)
+        q.offer(b, t=11.0)
+        got, arrivals = q.take(40)
+        assert len(got) == 40
+        assert [c for c, _ in arrivals] == [30, 10]
+        assert [t for _, t in arrivals] == [10.0, 11.0]
+        assert q.pending == 40
+        got2, arr2 = q.take(100)
+        assert len(got2) == 40 and arr2 == [(40, 11.0)]
+
+    def test_offer_copies_producer_buffers(self):
+        """A producer refills its chunk buffer right after offer();
+        the queue must have taken a copy, not a view."""
+        q = IngressQueue(1000)
+        buf = np.full((50, N_COLS), 1, dtype=np.uint32)
+        q.offer(buf, t=0.0)
+        buf[:] = 99  # producer reuses its buffer
+        got, _ = q.take(50)
+        assert (got == 1).all(), "queued rows aliased caller memory"
+
+    def test_oversized_chunk_still_bounded(self):
+        for policy in ("drop-tail", "drop-oldest"):
+            q = IngressQueue(64, policy)
+            rows = np.zeros((200, N_COLS), dtype=np.uint32)
+            assert q.offer(rows) == 64
+            assert q.shed == 136
+
+
+class TestAdaptiveBatcher:
+    def test_bucket_selection_walks_the_ladder(self):
+        b = AdaptiveBatcher((256, 1024, 4096), 1000.0)
+        assert b.bucket_for(1) == 256
+        assert b.bucket_for(256) == 256
+        assert b.bucket_for(257) == 1024
+        assert b.bucket_for(4096) == 4096
+        assert b.bucket_for(9999) == 4096  # callers take at most max
+
+    def test_full_bucket_flushes_immediately(self):
+        q = IngressQueue(1 << 14)
+        b = AdaptiveBatcher((256, 1024), 1e6)  # 1s deadline: irrelevant
+        q.offer(np.zeros((1024, N_COLS), dtype=np.uint32), t=0.0)
+        batch = b.assemble(q, now=0.0)
+        assert batch is not None and batch.n_valid == 1024
+        assert len(batch.hdr) == 1024
+        assert batch.valid.all()
+
+    def test_partial_waits_for_the_deadline_then_pads(self):
+        q = IngressQueue(1 << 14)
+        b = AdaptiveBatcher((256, 1024), 500.0)  # 500us
+        rows = np.ones((100, N_COLS), dtype=np.uint32)
+        q.offer(rows, t=0.0)
+        assert b.assemble(q, now=0.0) is None  # not due yet
+        assert b.assemble(q, now=0.0002) is None
+        batch = b.assemble(q, now=0.001)  # deadline passed
+        assert batch is not None
+        assert batch.n_valid == 100 and len(batch.hdr) == 256
+        assert batch.valid[:100].all() and not batch.valid[100:].any()
+        assert (batch.hdr[100:] == 0).all()  # padding rows are zeros
+
+    def test_force_flush_ignores_the_deadline(self):
+        q = IngressQueue(1 << 14)
+        b = AdaptiveBatcher((256,), 1e6)
+        q.offer(np.ones((7, N_COLS), dtype=np.uint32), t=0.0)
+        batch = b.assemble(q, now=0.0, force=True)
+        assert batch is not None and batch.n_valid == 7
+
+    def test_consecutive_batches_get_fresh_buffers(self):
+        """Ownership transfer: batch N's hdr (retained by serve_batch
+        for the drain-time event join, possibly feeding an async h2d)
+        must survive batch N+1 assembling the same bucket size."""
+        q = IngressQueue(1 << 14)
+        b = AdaptiveBatcher((256,), 0.0)
+        q.offer(np.full((256, N_COLS), 7, dtype=np.uint32), t=0.0)
+        first = b.assemble(q, now=1.0)
+        q.offer(np.full((256, N_COLS), 9, dtype=np.uint32), t=0.0)
+        second = b.assemble(q, now=1.0)
+        assert first.hdr is not second.hdr
+        assert (first.hdr == 7).all() and (second.hdr == 9).all()
+
+
+class TestServingConfigValidation:
+    def test_rejects_non_power_of_two_bucket(self):
+        with pytest.raises(ValueError, match="power of two"):
+            validate_serving_config(4096, (256, 1000), 100.0,
+                                    "drop-tail")
+
+    def test_rejects_unsorted_or_duplicate_ladder(self):
+        with pytest.raises(ValueError, match="ascending"):
+            validate_serving_config(4096, (1024, 256), 100.0,
+                                    "drop-tail")
+        with pytest.raises(ValueError, match="ascending"):
+            validate_serving_config(4096, (256, 256), 100.0,
+                                    "drop-tail")
+
+    def test_rejects_queue_smaller_than_largest_bucket(self):
+        with pytest.raises(ValueError, match="smaller than"):
+            validate_serving_config(512, (256, 1024), 100.0,
+                                    "drop-tail")
+
+    def test_rejects_unknown_policy_and_negative_wait(self):
+        with pytest.raises(ValueError, match="drop-tail"):
+            validate_serving_config(4096, (256,), 100.0, "drop-front")
+        with pytest.raises(ValueError, match=">= 0"):
+            validate_serving_config(4096, (256,), -1.0, "drop-tail")
+
+    def test_daemon_construction_validates_and_normalizes(self):
+        with pytest.raises(ValueError, match="power of two"):
+            Daemon(DaemonConfig(backend="interpreter",
+                                serving_bucket_ladder=(100,)))
+        # env-sourced strings normalize to ints at construction
+        cfg = load_config(env={
+            "CILIUM_TPU_SERVING_BUCKET_LADDER": "256,1024",
+            "CILIUM_TPU_SERVING_QUEUE_DEPTH": "2048",
+            "CILIUM_TPU_SERVING_MAX_WAIT_US": "750",
+        })
+        cfg.backend = "interpreter"
+        d = Daemon(cfg)
+        assert d.config.serving_bucket_ladder == (256, 1024)
+        assert d.config.serving_queue_depth == 2048
+        assert d.config.serving_max_wait_us == 750.0
+
+
+class TestLatencyHistogram:
+    def test_percentiles_are_conservative_upper_bounds(self):
+        h = LatencyHistogram()
+        assert h.percentile(0.5) is None
+        for us in (10, 10, 10, 1000):
+            h.record(us)
+        assert h.percentile(0.5) == 16  # 2^4 >= 10
+        assert h.percentile(0.99) >= 1000
+        snap = h.snapshot()
+        assert snap["count"] == 4 and snap["max"] == 1000
+        assert snap["p50"] <= snap["p95"] <= snap["p99"]
+
+
+class TestTypedErrors:
+    def test_serve_batch_before_start_raises_typed(self):
+        d, _db = _daemon()
+        with pytest.raises(ServingNotStartedError):
+            d.serve_batch(np.zeros((4, N_COLS), np.uint32))
+        # the typed error IS a RuntimeError: pre-existing callers keep
+        # working
+        with pytest.raises(RuntimeError):
+            d.serve_batch(np.zeros((4, N_COLS), np.uint32))
+        d.shutdown()
+
+    def test_submit_without_ingress_mode_raises_typed(self):
+        d, _db = _daemon()
+        with pytest.raises(ServingNotStartedError, match="ingress"):
+            d.submit(np.zeros((4, N_COLS), np.uint32))
+        d.start_serving(trace_sample=0)  # ring path only, no ingress
+        with pytest.raises(ServingNotStartedError, match="ingress"):
+            d.submit(np.zeros((4, N_COLS), np.uint32))
+        d.stop_serving()
+        d.shutdown()
+
+    def test_double_start_raises_typed(self):
+        d, _db = _daemon()
+        d.start_serving(trace_sample=0)
+        with pytest.raises(ServingAlreadyActiveError):
+            d.start_serving()
+        d.stop_serving()
+        d.shutdown()
+
+    def test_interpreter_backend_raises_typed(self):
+        d = Daemon(DaemonConfig(backend="interpreter"))
+        with pytest.raises(ServingBackendError, match="tpu"):
+            d.start_serving()
+        d.shutdown()
+
+    def test_malformed_submit_bounces_at_the_door(self):
+        """Wrong column count / dtype must raise at submit(), never
+        detonate inside the drain thread batches later."""
+        d, db = _daemon()
+        d.start_serving(trace_sample=0, ingress=True)
+        with pytest.raises(ValueError, match="column"):
+            d.submit(np.zeros((4, 3), dtype=np.uint32))
+        with pytest.raises(ValueError, match="integer"):
+            d.submit(np.zeros((4, N_COLS), dtype=np.float32))
+        # the loop is alive and well-formed traffic still serves
+        rng = np.random.default_rng(11)
+        assert d.submit(_traffic(db.id, 100, rng)) == 100
+        fe = d.stop_serving()["front-end"]
+        assert fe["verdicts"] == 100 and "error" not in fe
+        d.shutdown()
+
+    def test_drain_loop_death_is_visible(self):
+        """If a dispatch fault kills the loop, submit() must raise
+        and the snapshot must carry the error — never a silent
+        blackhole."""
+        from cilium_tpu.serving import ServingError
+
+        def exploding(hdr, valid, n):
+            raise RuntimeError("device on fire")
+
+        rt = ServingRuntime(dispatch=exploding, queue_depth=256,
+                            bucket_ladder=(256,), max_wait_us=0.0)
+        rt.start()
+        rt.submit(np.zeros((10, N_COLS), dtype=np.uint32))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and rt._error is None:
+            time.sleep(0.005)
+        with pytest.raises(ServingError, match="device on fire"):
+            rt.submit(np.zeros((10, N_COLS), dtype=np.uint32))
+        snap = rt.stop()
+        assert "device on fire" in snap["error"]
+
+    def test_idle_period_not_recorded_as_latency(self):
+        """After a burst, the runtime idles; the last batch's
+        end-to-end latency must be stamped at the idle tick, not at
+        stop() an arbitrary time later."""
+        rt = ServingRuntime(dispatch=lambda h, v, n: None,
+                            queue_depth=256, bucket_ladder=(256,),
+                            max_wait_us=0.0)
+        rt.start()
+        rt.submit(np.zeros((10, N_COLS), dtype=np.uint32))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if rt.stats.latency.count:
+                break
+            time.sleep(0.005)
+        assert rt.stats.latency.count == 1, \
+            "completion not stamped while idle"
+        time.sleep(0.3)  # idle period that must NOT become latency
+        rt.stop()
+        assert rt.stats.latency.max_us < 0.2e6
+
+    def test_runtime_submit_after_stop_raises(self):
+        """A chunk offered after the final drain would queue forever,
+        neither dispatched nor shed-counted — it must raise instead."""
+        rt = ServingRuntime(dispatch=lambda h, v, n: None,
+                            queue_depth=256, bucket_ladder=(256,),
+                            max_wait_us=0.0)
+        rt.start()
+        rt.stop()
+        with pytest.raises(ServingNotStartedError):
+            rt.submit(np.zeros((4, N_COLS), np.uint32))
+
+    def test_stop_serving_is_idempotent(self):
+        d, db = _daemon()
+        assert d.stop_serving() == {"windows": 0, "events": 0,
+                                    "lost": 0}
+        d.start_serving(trace_sample=0, ingress=True)
+        rng = np.random.default_rng(0)
+        d.submit(_traffic(db.id, 300, rng))
+        first = d.stop_serving()
+        assert first["front-end"]["verdicts"] == 300
+        again = d.stop_serving()  # second stop: clean no-op
+        assert again == {"windows": 0, "events": 0, "lost": 0}
+        assert d.serving_stats() == {"active": False}
+        d.shutdown()
+
+
+class TestShapeDiscipline:
+    def test_batch_shapes_never_exceed_the_ladder(self):
+        """Recompile guard: every hdr handed to serve_batch is exactly
+        one of the configured bucket shapes, no matter how ragged the
+        arrival chunks are."""
+        d, db = _daemon(ladder=(256, 1024), wait_us=200.0)
+        shapes = []
+        inner = d.serve_batch
+
+        def spy(hdr, now=None, valid=None):
+            shapes.append(tuple(hdr.shape))
+            return inner(hdr, now=now, valid=valid)
+
+        d.serve_batch = spy
+        d.start_serving(trace_sample=0, ingress=True)
+        rng = np.random.default_rng(1)
+        for _ in range(40):  # ragged Poisson-ish chunk sizes
+            n = max(int(rng.poisson(300)), 1)
+            d.submit(_traffic(db.id, n, rng))
+        stats = d.stop_serving()
+        d.shutdown()
+        fe = stats["front-end"]
+        assert fe["batches"] > 0
+        allowed = {(b, N_COLS) for b in (256, 1024)}
+        assert set(shapes) <= allowed, f"off-ladder shapes: {shapes}"
+        assert set(map(int, fe["batch-shapes"])) <= {256, 1024}
+        # nothing lost: every admitted packet was dispatched
+        assert fe["verdicts"] == fe["admitted"]
+
+    def test_low_load_flushes_padded_on_the_deadline(self):
+        d, db = _daemon(ladder=(256, 1024), wait_us=500.0)
+        d.start_serving(trace_sample=0, ingress=True)
+        rng = np.random.default_rng(2)
+        d.submit(_traffic(db.id, 10, rng))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if d.serving_stats().get("batches"):
+                break
+            time.sleep(0.005)
+        stats = d.stop_serving()
+        d.shutdown()
+        fe = stats["front-end"]
+        # flushed without more traffic, padded to the SMALLEST bucket
+        assert fe["batch-shapes"] == {"256": 1}
+        assert fe["verdicts"] == 10 and fe["padded-rows"] == 246
+        assert fe["pad-efficiency"] == pytest.approx(10 / 256, abs=1e-4)
+        assert fe["queue-wait-us"]["count"] == 1
+
+    def test_padding_rows_touch_neither_metrics_nor_events(self):
+        d, db = _daemon(ladder=(256,), wait_us=0.0)
+        got = []
+        d.monitor.register("t", got.append)
+        before = d.loader.metrics().sum()
+        d.start_serving(trace_sample=0, ingress=True)
+        rng = np.random.default_rng(3)
+        d.submit(_traffic(db.id, 40, rng))
+        d.stop_serving()
+        d.shutdown()
+        # metrics counted exactly the 40 real rows, not the padding
+        assert d.loader.metrics().sum() - before == 40
+        # no event carries a padding row (all-zero header)
+        for b in got:
+            assert (b.hdr.sum(axis=1) != 0).all()
+
+
+class TestBackpressureAndSheds:
+    def test_overflow_sheds_surface_as_monitor_drop_events(self):
+        """The satellite end-to-end: shed -> REASON_INGRESS_OVERFLOW
+        drop event -> flow layer -> `cilium-tpu monitor` rendering."""
+        d, db = _daemon(queue=1024, ladder=(256, 1024), wait_us=100.0)
+        got = []
+        d.monitor.register("t", got.append)
+        d.start_serving(trace_sample=0, ingress=True)
+        rng = np.random.default_rng(4)
+        # one chunk twice the queue depth: sheds regardless of how
+        # fast the drain loop runs
+        chunk = _traffic(db.id, 2048, rng)
+        accepted = d.submit(chunk)
+        assert accepted <= 1024
+        stats = d.stop_serving()
+        d.shutdown()
+        fe = stats["front-end"]
+        assert fe["shed"] >= 1024
+        assert fe["shed"] == fe["submitted"] - fe["admitted"]
+        assert fe["shed-events"] == fe["shed"]  # retention not capped
+        # monitor plane: DROP events with the new reason
+        drops = [b for b in got
+                 if (np.asarray(b.msg_type) == MSG_DROP).any()
+                 and (np.asarray(b.reason)
+                      == REASON_INGRESS_OVERFLOW).any()]
+        assert drops, "sheds never reached the monitor plane"
+        n_shed_events = sum(
+            int((np.asarray(b.reason)
+                 == REASON_INGRESS_OVERFLOW).sum()) for b in got)
+        assert n_shed_events == fe["shed"]
+        ev = materialize(drops[0], 0)
+        assert DropNotify(ev).reason_name == "Ingress queue overflow"
+        # flow layer (what `cilium-tpu monitor` / `flows` render)
+        flows = [f.to_dict() for f in d.observer.get_flows(number=8192)]
+        shed_flows = [f for f in flows if f.get("drop_reason")
+                      == REASON_INGRESS_OVERFLOW]
+        assert shed_flows
+        assert shed_flows[0]["drop_reason_desc"] == \
+            "INGRESS_QUEUE_OVERFLOW"
+        assert shed_flows[0]["verdict"] == "DROPPED"
+
+    def test_drop_oldest_policy_admits_fresh_traffic(self):
+        # the runtime standalone (not started): drive the queue
+        # directly so the drain cannot race the assertions
+        dispatched = []
+        rt = ServingRuntime(
+            dispatch=lambda hdr, valid, n: dispatched.append(n),
+            queue_depth=1024, bucket_ladder=(1024,), max_wait_us=1e6,
+            overflow_policy="drop-oldest")
+        old = _traffic(2, 1024, np.random.default_rng(5))
+        new = _traffic(2, 512, np.random.default_rng(6))
+        assert rt.submit(old) == 1024
+        assert rt.submit(new) == 512  # admitted by evicting oldest
+        assert rt.queue.shed == 512
+        rows, _ = rt.queue.take(2048)
+        np.testing.assert_array_equal(rows[-512:], new)
+
+    def test_reason_survives_the_ring_wire_format(self):
+        """REASON_INGRESS_OVERFLOW fits the ring's 4-bit reason field
+        (ring row -> decode keeps the code)."""
+        import jax.numpy as jnp
+
+        from cilium_tpu.datapath.verdict import (EV_DROP, N_OUT,
+                                                 OUT_EVENT, OUT_REASON)
+        from cilium_tpu.monitor.ring import EventRing, ring_append, \
+            ring_drain
+
+        assert REASON_INGRESS_OVERFLOW <= 0xF
+        out = np.zeros((4, N_OUT), dtype=np.uint32)
+        out[:, OUT_EVENT] = EV_DROP
+        out[:, OUT_REASON] = REASON_INGRESS_OVERFLOW
+        ring = EventRing.create(16)
+        ring = ring_append(ring, jnp.asarray(out), jnp.uint32(0),
+                           trace_sample=0)
+        rows, total, lost = ring_drain(ring)
+        assert total == 4 and lost == 0
+        assert (rows[:, OUT_REASON] == REASON_INGRESS_OVERFLOW).all()
+        assert DROP_REASON_NAMES[REASON_INGRESS_OVERFLOW] == \
+            "Ingress queue overflow"
+
+    def test_synth_drop_batch_shape(self):
+        hdr = _traffic(3, 5, np.random.default_rng(7))
+        b = synth_drop_batch(hdr, REASON_INGRESS_OVERFLOW, 1.5)
+        assert len(b) == 5
+        assert (b.msg_type == MSG_DROP).all()
+        assert (b.reason == REASON_INGRESS_OVERFLOW).all()
+        assert (b.verdict == 0).all() and b.timestamp == 1.5
+
+
+class TestServingAPI:
+    def test_serving_stats_over_api_cli_and_metrics(self, tmp_path):
+        from cilium_tpu.api.client import APIClient
+        from cilium_tpu.api.server import APIServer
+        from cilium_tpu.cli.main import main as cli_main
+
+        d, db = _daemon()
+        sock = str(tmp_path / "cilium.sock")
+        server = APIServer(d, sock)
+        server.start()
+        try:
+            c = APIClient(sock)
+            assert c.serving_stats() == {"active": False}
+            d.start_serving(trace_sample=0, ingress=True)
+            rng = np.random.default_rng(9)
+            d.submit(_traffic(db.id, 500, rng))
+            deadline = time.monotonic() + 5.0
+            st = {}
+            while time.monotonic() < deadline:
+                st = c.serving_stats()
+                if st.get("verdicts"):
+                    break
+                time.sleep(0.01)
+            assert st["active"] is True
+            assert st["verdicts"] == 500
+            assert st["queue-depth"] == 8192
+            assert "ring" in st and "latency-us" in st
+            # the CLI verb renders the same surface
+            assert cli_main(["--socket", sock, "serving",
+                             "stats"]) == 0
+            # prometheus exposition carries the serving counters
+            assert "cilium_serving_verdicts_total 500" in c.metrics()
+            d.stop_serving()
+        finally:
+            server.stop()
+            d.shutdown()
+
+
+class TestServingThroughput:
+    def test_sustains_90pct_of_offline_under_poisson_load(self):
+        """The acceptance gate: offered load above capacity, the
+        runtime keeps >= 90% of the offline serve_batch rate, stays
+        on the bucket ladder, and sheds are counted.
+
+        Both sides are measured 3x interleaved and compared
+        best-of-3: single-shot wall timings on a shared CPU runner
+        swing +-15%, and the gate must measure the front end, not the
+        machine's scheduling weather."""
+        B = 8192
+        queue = 4 * B
+        d, db = _daemon(queue=queue, ladder=(2048, B), wait_us=1000.0)
+        rng = np.random.default_rng(8)
+        n_batches = 12
+        target = n_batches * B
+
+        shapes = set()
+        inner = d.serve_batch
+
+        def spy(hdr, now=None, valid=None):
+            shapes.add(tuple(hdr.shape))
+            return inner(hdr, now=now, valid=valid)
+
+        d.serve_batch = spy
+        # compile both ladder shapes up front (shared by both sides)
+        d.start_serving(trace_sample=0)
+        for b in (2048, B):
+            d.serve_batch(_traffic(db.id, b, rng),
+                          valid=np.ones(b, dtype=bool))
+        d.stop_serving()
+        valid = np.ones(B, dtype=bool)
+        # pre-generated traffic for BOTH sides: neither pays
+        # generation inside its timed loop
+        pre = [_traffic(db.id, B, rng) for _ in range(n_batches)]
+        chunks = [_traffic(db.id, max(int(rng.poisson(B // 2)), 1),
+                           rng) for _ in range(16)]
+
+        offline_pps = 0.0
+        serving_pps = 0.0
+        shed = shed_events = 0
+        for _rep in range(3):
+            # offline ceiling: perfect pre-assembled full buckets
+            d.start_serving(trace_sample=0)
+            t0 = time.perf_counter()
+            for h in pre:
+                d.serve_batch(h, valid=valid)
+            off_dt = time.perf_counter() - t0
+            d.stop_serving()
+            offline_pps = max(offline_pps, target / off_dt)
+
+            # serving: one oversized chunk first (guaranteed sheds:
+            # offered 2x the queue depth in one doorbell), then
+            # Poisson chunks keeping the queue saturated until the
+            # target volume is admitted
+            d.start_serving(trace_sample=0, ingress=True)
+            q = d._serving["runtime"].queue
+            admitted = i = 0
+            t0 = time.perf_counter()
+            admitted += d.submit(_traffic(db.id, 2 * queue, rng))
+            while admitted < target:
+                c = chunks[i % len(chunks)]
+                i += 1
+                got = d.submit(c)
+                admitted += got
+                if got < len(c):
+                    # backpressure: refill once half the queue drained
+                    while q.pending > queue // 2:
+                        time.sleep(0.002)
+            fe = d.stop_serving()["front-end"]
+            dt = time.perf_counter() - t0
+            assert fe["verdicts"] == fe["admitted"] >= target
+            serving_pps = max(serving_pps, fe["verdicts"] / dt)
+            shed += fe["shed"]
+            shed_events += fe["shed-events"]
+        d.shutdown()
+
+        ratio = serving_pps / offline_pps
+        assert ratio >= 0.9, (
+            f"serving sustained {serving_pps:.0f} pps vs offline "
+            f"{offline_pps:.0f} pps (ratio {ratio:.3f})")
+        # offered load exceeded capacity: sheds are non-zero and
+        # surfaced as drop events
+        assert shed >= queue  # the oversized chunk alone sheds this
+        assert shed_events > 0
+        # shape discipline held under load
+        assert shapes <= {(2048, N_COLS), (B, N_COLS)}
+        # telemetry is live
+        assert fe["verdicts-per-sec"] > 0
+        assert fe["queue-wait-us"]["count"] > 0
+        assert fe["latency-us"]["p50"] is not None
