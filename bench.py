@@ -787,7 +787,7 @@ def _run_socklb_phase() -> None:
     print(json.dumps(bench_socket_lb_scaling()))
 
 
-def bench_serving(offline_batches=24, paced_seconds=2.0) -> dict:
+def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
     """Serving front-end phase: sustained verdicts/sec under Poisson
     arrivals through the admission queue + adaptive batcher
     (cilium_tpu/serving) vs the OFFLINE serve_batch ceiling (perfect
@@ -795,7 +795,14 @@ def bench_serving(offline_batches=24, paced_seconds=2.0) -> dict:
     trajectory.  Deliberately bounded and CPU-runnable
     (JAX_PLATFORMS=cpu): the number it defends is the front end's
     OVERHEAD RATIO (serving_vs_offline), which is platform-relative;
-    absolute pps is whatever the backend does."""
+    absolute pps is whatever the backend does.
+
+    The ingress side runs the PACKED 16 B/packet h2d path (PR 2
+    tentpole): BENCH_serving.json records the packed-vs-wide batch
+    split and measured h2d bytes/packet alongside the ratio.  Both
+    sides are measured 3x INTERLEAVED and compared best-of-3 —
+    single-shot CPU wall timings swing +-15%, and the ratio must
+    measure the front end, not scheduling weather."""
     import ipaddress
 
     import jax
@@ -841,46 +848,77 @@ def bench_serving(offline_batches=24, paced_seconds=2.0) -> dict:
         rows[:, COL_EP] = db.id
         return rows
 
-    # ---- offline ceiling: pre-assembled full buckets ---------------
-    d.start_serving(trace_sample=0)
-    for b in LADDER:  # compile every ladder shape once (both phases)
-        d.serve_batch(batch(b), valid=np.ones(b, dtype=bool))
-    valid = np.ones(B, dtype=bool)
-    t0 = time.perf_counter()
-    for _ in range(offline_batches):
-        d.serve_batch(batch(B), valid=valid)
-    offline_dt = time.perf_counter() - t0
-    d.stop_serving()
-    offline_pps = offline_batches * B / offline_dt
+    from cilium_tpu.core.packets import pack_eligibility, pack_rows
 
-    # ---- overload: Poisson chunks offered until the target volume
-    # is ADMITTED, backing off only when the queue is full — offered
-    # load exceeds capacity, so sheds are expected and counted
+    # ---- warm every compiled shape once (shared by all reps):
+    # wide ladder (offline side) + packed ladder (ingress side)
+    d.start_serving(trace_sample=0, packed=True)
+    for b in LADDER:
+        d.serve_batch(batch(b), valid=np.ones(b, dtype=bool))
+        w = batch(b)
+        ok, ep, dirn = pack_eligibility(w)
+        assert ok, "bench traffic must be packed-eligible"
+        d.serve_batch(pack_rows(w), valid=np.ones(b, dtype=bool),
+                      packed_meta=(ep, dirn))
+    d.stop_serving()
+
+    valid = np.ones(B, dtype=bool)
     chunks = [batch(max(int(rng.poisson(4096.0)), 1))
               for _ in range(32)]
     target = offline_batches * B
-    d.start_serving(trace_sample=0, ingress=True)
-    admitted = offered = i = 0
-    t0 = time.perf_counter()
-    while admitted < target:
-        c = chunks[i % len(chunks)]
-        i += 1
-        got = d.submit(c)
-        offered += len(c)
-        admitted += got
-        if got < len(c):
-            time.sleep(0.0005)  # queue full: the backpressure signal
-    stats = d.stop_serving()  # drains everything admitted
-    dt = time.perf_counter() - t0
-    fe = stats["front-end"]
-    sustained_pps = fe["verdicts"] / dt
+
+    def rep_offline() -> float:
+        """Offline ceiling: perfect pre-assembled full WIDE buckets."""
+        d.start_serving(trace_sample=0)
+        t0 = time.perf_counter()
+        for _ in range(offline_batches):
+            d.serve_batch(batch(B), valid=valid)
+        dt = time.perf_counter() - t0
+        d.stop_serving()
+        return offline_batches * B / dt
+
+    def rep_overload():
+        """Overload: Poisson chunks offered until the target volume
+        is ADMITTED, backing off only when the queue is full —
+        offered load exceeds capacity, so sheds are expected and
+        counted.  The ingress runtime ships eligible buckets packed
+        (16 B/packet h2d)."""
+        d.start_serving(trace_sample=0, ingress=True, packed=True)
+        admitted = offered = i = 0
+        t0 = time.perf_counter()
+        while admitted < target:
+            c = chunks[i % len(chunks)]
+            i += 1
+            got = d.submit(c)
+            offered += len(c)
+            admitted += got
+            if got < len(c):
+                time.sleep(0.0005)  # queue full: backpressure signal
+        stats = d.stop_serving()  # drains everything admitted
+        dt = time.perf_counter() - t0
+        fe = stats["front-end"]
+        return fe["verdicts"] / dt, fe, offered
+
+    # ---- best-of-3 INTERLEAVED: rep k runs offline then overload
+    # back to back, so both sides sample the same machine weather.
+    # fe/offered come from the SAME rep as the reported max pps —
+    # mixed-provenance telemetry would mislead anyone correlating
+    # the ratio with the shed/queue-wait numbers
+    offline_pps = sustained_pps = 0.0
+    fe = offered = None
+    for _ in range(3):
+        offline_pps = max(offline_pps, rep_offline())
+        pps, rep_fe, rep_offered = rep_overload()
+        if pps > sustained_pps:
+            sustained_pps, fe, offered = pps, rep_fe, rep_offered
 
     # ---- paced: Poisson arrivals at ~50% of the offline rate — the
     # latency-percentile run (at overload, queue wait just measures
     # queue depth)
-    d.start_serving(trace_sample=0, ingress=True)
+    d.start_serving(trace_sample=0, ingress=True, packed=True)
     rate = max(offline_pps * 0.5, 1.0)
     t_end = time.perf_counter() + paced_seconds
+    i = 0
     while time.perf_counter() < t_end:
         c = chunks[i % len(chunks)]
         i += 1
@@ -899,6 +937,11 @@ def bench_serving(offline_batches=24, paced_seconds=2.0) -> dict:
         "shed_drop_events": fe["shed-events"],
         "batch_shapes": fe["batch-shapes"],
         "pad_efficiency": fe["pad-efficiency"],
+        # the h2d link scoreboard (PR 2 tentpole): bytes/packet on
+        # the wire and how many batches shipped packed vs wide
+        "h2d_bytes_per_packet": fe["h2d"]["bytes-per-packet"],
+        "packed_batches": fe["h2d"]["packed-batches"],
+        "wide_batches": fe["h2d"]["wide-batches"],
         "bucket_ladder": list(LADDER),
         "max_wait_us": 2000.0,
         "overload_queue_wait_us": fe["queue-wait-us"],
@@ -907,9 +950,10 @@ def bench_serving(offline_batches=24, paced_seconds=2.0) -> dict:
         "paced_pad_efficiency": paced["pad-efficiency"],
         "platform": jax.default_backend(),
         "note": ("serving front end (admission queue + power-of-two "
-                 "bucket batcher + drain loop) vs offline "
-                 "pre-assembled buckets; serving_vs_offline is the "
-                 "front end's overhead ratio, sheds are counted "
+                 "bucket batcher + drain loop, PACKED 16 B/packet "
+                 "h2d) vs offline pre-assembled wide buckets; "
+                 "serving_vs_offline is the front end's overhead "
+                 "ratio, best-of-3 interleaved; sheds are counted "
                  "monitor DROP events (REASON_INGRESS_OVERFLOW)"),
     }
 

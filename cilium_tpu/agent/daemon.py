@@ -109,6 +109,12 @@ class DaemonConfig:
     # "drop-tail" (arriving overflow sheds) | "drop-oldest" (stale
     # queued rows shed to admit the arrival)
     serving_overflow_policy: str = "drop-tail"
+    # ship eligible IPv4 single-stream batches as the packed
+    # 16 B/packet h2d wire format (core/packets.py PACKED_*) instead
+    # of wide 64 B/packet rows; ineligible traffic (IPv6, mixed
+    # ep/dir streams) keeps the wide fallback shape either way.
+    # start_serving(packed=...) overrides per session.
+    serving_packed_ingest: bool = False
 
 
 class Daemon:
@@ -792,7 +798,10 @@ class Daemon:
     def start_serving(self, ring_capacity: int = 1 << 15,
                       drain_every: int = 4,
                       trace_sample: int = 1024,
-                      ingress: bool = False) -> None:
+                      ingress: bool = False,
+                      packed: Optional[bool] = None,
+                      mesh=None,
+                      shard_headroom: int = 2) -> None:
         """Switch to the SERVING monitor path: batches run through the
         fused datapath + device event-ring append (one dispatch, no
         per-packet host fetch), and only the compacted events cross to
@@ -808,6 +817,27 @@ class Daemon:
         dispatch through :meth:`serve_batch` with sheds surfaced as
         monitor DROP events (``REASON_INGRESS_OVERFLOW``).
 
+        ``packed=True`` (default: the ``serving_packed_ingest``
+        config knob) ships eligible IPv4 single-stream buckets as the
+        packed 16 B/packet wire format — 4x fewer h2d bytes — through
+        :meth:`TPULoader.serve_packed`; ineligible traffic falls back
+        to the wide shape per batch.
+
+        ``mesh=...`` (a ``jax.sharding.Mesh`` or a device count)
+        switches to MULTI-CHIP serving: each assembled bucket is
+        flow-routed (``parallel.route_by_flow`` — the RSS analogue)
+        into per-shard blocks and dispatched through the sharded
+        serve step (CT private per chip, policy/ipcache replicated,
+        per-chip event rings drained round-robin).  Router overflow
+        is counted in the metricsmap as ``REASON_ROUTE_OVERFLOW`` and
+        surfaced as monitor DROP events.  ``shard_headroom`` sizes
+        each shard's block at ``headroom * bucket / n_shards`` — the
+        RSS ring-sizing trade-off: headroom 1 ships the fewest bytes
+        but a full bucket of uniform flows overflows ~every shard
+        (block == fair share, zero slack); the default 2 makes skew
+        loss negligible for ~2x link/lane padding, and every drop is
+        counted either way.
+
         Requires the tpu backend (the interpreter loader has no device
         ring).  Redirect events carry their proxy port as an index
         into the CURRENT listener table (monitor/ring.py); listeners
@@ -815,8 +845,9 @@ class Daemon:
         import jax.numpy as jnp
 
         from ..datapath.loader import TPULoader
-        from ..monitor.ring import AsyncRingDrainer, MAX_PROXY_PORTS
-        from ..serving import (ServingAlreadyActiveError,
+        from ..monitor.ring import (AsyncRingDrainer, MAX_PROXY_PORTS,
+                                    ShardedAsyncRingDrainer)
+        from ..serving import (BucketArena, ServingAlreadyActiveError,
                                ServingBackendError)
 
         if not isinstance(self.loader, TPULoader):
@@ -827,9 +858,43 @@ class Daemon:
             # window without any loss accounting
             raise ServingAlreadyActiveError(
                 "already serving; stop_serving() first")
+        if packed is None:
+            packed = self.config.serving_packed_ingest
         table = np.asarray(sorted(self.proxy.ports)[:MAX_PROXY_PORTS],
                            dtype=np.uint32)
-        drainer = AsyncRingDrainer(ring_capacity, proxy_ports=table)
+        n_shards = 0
+        if mesh is not None:
+            from ..parallel import make_mesh, make_sharded_ring
+
+            if isinstance(mesh, int):
+                mesh = make_mesh(mesh)
+            if "data" not in mesh.axis_names:
+                # the sharded serving stack steers over the "data"
+                # axis end-to-end (shard_state, make_sharded_ring,
+                # make_sharded_serve_step); a differently-named axis
+                # would die deep inside jax with an unbound-axis error
+                raise ValueError(
+                    f"serving mesh must have a 'data' axis, got "
+                    f"axis_names={mesh.axis_names} (make_mesh builds "
+                    f"the right one)")
+            n_shards = int(mesh.devices.size)
+            ladder = self.config.serving_bucket_ladder
+            if ladder[0] % n_shards:
+                raise ValueError(
+                    f"sharded serving needs every ladder bucket "
+                    f"divisible by the {n_shards}-chip mesh; smallest "
+                    f"bucket is {ladder[0]}")
+            if shard_headroom < 1:
+                raise ValueError("shard_headroom must be >= 1")
+            self.loader.serving_shard(mesh)
+            drainer = ShardedAsyncRingDrainer(
+                ring_capacity, n_shards,
+                fresh_fn=lambda: make_sharded_ring(mesh,
+                                                   ring_capacity),
+                proxy_ports=table)
+        else:
+            drainer = AsyncRingDrainer(ring_capacity,
+                                       proxy_ports=table)
         self._serving = {
             "drainer": drainer,
             "ring": drainer.fresh(),
@@ -837,7 +902,16 @@ class Daemon:
             "trace_sample": trace_sample,
             "drain_every": drain_every,
             "seq": 0,
-            # batch_id (wrapped) -> (host hdr, numeric ids, timestamp)
+            "packed": bool(packed),
+            "mesh": mesh,
+            "n_shards": n_shards,
+            "headroom": int(shard_headroom),
+            "route_overflow": 0,
+            # packed re-staging arena for the sharded path; depth
+            # covers the event-join retention window below
+            "route_arena": BucketArena(2 * drain_every + 2),
+            # batch_id (wrapped) -> (kind, host rows, (ep, dirn) or
+            # None, numeric ids, timestamp); kind "wide" | "packed"
             "window": {},
         }
         if ingress:
@@ -852,18 +926,33 @@ class Daemon:
                 bucket_ladder=cfg.serving_bucket_ladder,
                 max_wait_us=cfg.serving_max_wait_us,
                 overflow_policy=cfg.serving_overflow_policy,
-                expected_cols=N_COLS)
+                expected_cols=N_COLS,
+                # sharded dispatch flow-routes WIDE rows and re-packs
+                # after routing, so the batcher packs only when the
+                # bucket goes straight to the single-chip device leg
+                pack=bool(packed) and mesh is None,
+                # arena slots outlive the daemon's event-join
+                # retention (2 * drain_every windows) — the ownership
+                # handoff contract in serving/batcher.py
+                arena_depth=2 * drain_every + 2)
             self._serving["runtime"] = runtime
             runtime.start()
 
     def _serving_dispatch(self, hdr: np.ndarray, valid: np.ndarray,
-                          n_valid: int) -> None:
+                          n_valid: int, packed_meta=None):
         """The runtime's device leg: one padded bucket through
         serve_batch (padding masked out of CT/metrics/events).
-        ``hdr`` arrives freshly allocated per batch (batcher
-        ownership transfer), so serve_batch's retain-by-reference
-        window join is safe without a copy."""
-        self.serve_batch(hdr, valid=valid)
+        ``hdr`` arrives as a batcher arena slot whose recycling
+        horizon outlives serve_batch's retain-by-reference window
+        join (arena_depth above), so no copy is needed.
+
+        Wide batches keep the legacy 3-arg serve_batch call shape —
+        tests (and operators) wrap serve_batch with spies that only
+        know (hdr, now, valid)."""
+        if packed_meta is None:
+            return self.serve_batch(hdr, valid=valid)
+        return self.serve_batch(hdr, valid=valid,
+                                packed_meta=packed_meta)
 
     def _publish_sheds(self, rows: Optional[np.ndarray],
                        count: int) -> None:
@@ -903,6 +992,9 @@ class Daemon:
         out = {"active": True,
                "ring": {"windows": d.windows, "events": d.events,
                         "lost": d.lost}}
+        if s["n_shards"]:
+            out["shards"] = s["n_shards"]
+            out["route-overflow"] = s["route_overflow"]
         runtime = s.get("runtime")
         if runtime is not None:
             out.update(runtime.snapshot())
@@ -910,12 +1002,23 @@ class Daemon:
 
     def serve_batch(self, hdr: np.ndarray,
                     now: Optional[int] = None,
-                    valid: Optional[np.ndarray] = None) -> None:
+                    valid: Optional[np.ndarray] = None,
+                    packed_meta=None) -> Optional[dict]:
         """One serving-path batch: dispatch, retain the host header
         rows for the event join, drain/emit every ``drain_every``
         batches.  ``hdr`` must be HOST memory (the serving path never
         fetches it back).  ``valid`` masks the adaptive batcher's
-        padding rows (they touch neither CT, metrics, nor the ring)."""
+        padding rows (they touch neither CT, metrics, nor the ring).
+
+        ``packed_meta=(ep, dirn)`` marks ``hdr`` as PACKED [N, 4]
+        wire rows (16 B/packet h2d) with the stream-metadata scalars;
+        the fused packed step unpacks on device and the event join
+        reconstructs wide columns host-side only for the few rows the
+        ring kept.  Under ``start_serving(mesh=...)`` the batch is
+        flow-routed into per-shard blocks first (wide input only —
+        the router needs wide columns; the 16 B format then ships the
+        ROUTED rows).  Returns link accounting ({"h2d_bytes",
+        "mode"}) for the runtime's telemetry."""
         from ..serving import ServingNotStartedError
 
         s = self._serving
@@ -924,12 +1027,54 @@ class Daemon:
         if now is None:
             now = self._now()
         bid = s["seq"] & 0x1FFF  # ring batch field width
-        s["ring"], row_map = self.loader.serve(
-            s["ring"], hdr, now, bid,
-            trace_sample=s["trace_sample"],
-            proxy_ports=s["table_dev"],
-            audit=self.config.policy_audit_mode,
-            valid=valid)
+        if s["mesh"] is not None:
+            if packed_meta is not None:
+                raise ValueError(
+                    "sharded serving routes wide rows (packing "
+                    "happens after flow routing); submit wide "
+                    "batches")
+            info = self._serve_batch_sharded(s, hdr, now, bid, valid)
+        elif packed_meta is not None:
+            ep, dirn = packed_meta
+            s["ring"], row_map = self.loader.serve_packed(
+                s["ring"], hdr, now, bid, ep, dirn,
+                trace_sample=s["trace_sample"],
+                proxy_ports=s["table_dev"],
+                audit=self.config.policy_audit_mode,
+                valid=valid)
+            self._serving_snapshot_numerics(s, row_map)
+            s["window"][bid] = ("packed", np.asarray(hdr),
+                                (int(ep), int(dirn)), s["numerics"],
+                                time.time())
+            info = {"h2d_bytes": hdr.nbytes, "mode": "packed"}
+        else:
+            s["ring"], row_map = self.loader.serve(
+                s["ring"], hdr, now, bid,
+                trace_sample=s["trace_sample"],
+                proxy_ports=s["table_dev"],
+                audit=self.config.policy_audit_mode,
+                valid=valid)
+            self._serving_snapshot_numerics(s, row_map)
+            # retained by REFERENCE: callers must not mutate hdr
+            # until its window drains (the ingress runtime satisfies
+            # this via the batcher arena's recycling horizon)
+            s["window"][bid] = ("wide", np.asarray(hdr), None,
+                                s["numerics"], time.time())
+            info = {"h2d_bytes": hdr.nbytes, "mode": "wide"}
+        s["seq"] += 1
+        if s["seq"] % s["drain_every"] == 0:
+            self._collect_and_emit(s)
+            s["ring"] = s["drainer"].swap(s["ring"])
+            # retain headers for the current window + the one whose
+            # fetch is in flight; older windows have already emitted
+            live = {(s["seq"] - 1 - i) & 0x1FFF
+                    for i in range(2 * s["drain_every"])}
+            for b in list(s["window"]):
+                if b not in live:
+                    del s["window"][b]
+        return info
+
+    def _serving_snapshot_numerics(self, s, row_map) -> None:
         # numeric_array() copies the whole row->numeric table; the map
         # only changes on identity churn, so snapshot per
         # (object, version) — the map object is REUSED and mutated
@@ -942,24 +1087,91 @@ class Daemon:
             s["row_map"] = row_map
             s["row_map_version"] = row_map.version
             s["numerics"] = row_map.numeric_array()
-        # retained by REFERENCE: callers must not mutate hdr until
-        # its window drains (the ingress runtime satisfies this by
-        # allocating a fresh hdr per batch — batcher ownership
-        # transfer, never buffer reuse)
-        s["window"][bid] = (np.asarray(hdr), s["numerics"],
+
+    def _serve_batch_sharded(self, s, hdr: np.ndarray, now: int,
+                             bid: int, valid) -> dict:
+        """The multi-chip leg: flow-route the bucket into per-shard
+        blocks (the RSS analogue), account router overflow as
+        REASON_ROUTE_OVERFLOW (metricsmap + synthesized DROP events),
+        re-pack eligible routed batches to 16 B/packet, and dispatch
+        the sharded serve step (CT private per chip, per-chip rings)."""
+        from ..core.packets import (N_COLS, PACKED_COLS,
+                                    pack_eligibility, pack_rows)
+        from ..datapath.verdict import REASON_ROUTE_OVERFLOW
+        from ..monitor.api import synth_drop_batch
+        from ..parallel import route_by_flow
+
+        S = s["n_shards"]
+        hdr = np.asarray(hdr)
+        if valid is None:
+            rows = hdr
+        else:
+            n_valid = int(valid.sum())
+            # the batcher always produces prefix-valid buckets (slice
+            # = view, no copy); a direct caller may pass an arbitrary
+            # mask — honor the holes (fancy-index copy) rather than
+            # silently routing masked-out rows
+            if valid[:n_valid].all():
+                rows = hdr[:n_valid]
+            else:
+                rows = hdr[valid]
+        bucket = max(len(hdr), S)
+        # ONE routed shape per ladder rung: block is fixed at
+        # headroom * bucket / S across batches of this rung (a
+        # data-dependent block would retrace the sharded step every
+        # batch); the headroom slack absorbs flow skew — see
+        # start_serving.  Routed/valid/orig buffers come from the
+        # serving arena (same recycling-horizon contract as the
+        # batcher slots), keeping this leg allocation-free too.
+        block = s["headroom"] * bucket // S
+        arena = s["route_arena"]
+        out = (arena.slot(S * block, N_COLS),
+               arena.slot(S * block, 0, dtype=bool),
+               arena.slot(S * block, 0, dtype=np.int64))
+        routed, rvalid, orig, n_ovf = route_by_flow(rows, S, block,
+                                                    out=out)
+        if n_ovf:
+            # a shard's block overflowed (flow skew): the loss is
+            # counted where operators look (metricsmap) AND each
+            # overflowed packet surfaces as a DROP event, exactly
+            # like admission sheds
+            s["route_overflow"] += n_ovf
+            self.loader.add_route_overflow(n_ovf)
+            dropped = np.ones(len(rows), dtype=bool)
+            dropped[orig[orig >= 0]] = False
+            batch = synth_drop_batch(rows[dropped],
+                                     REASON_ROUTE_OVERFLOW,
+                                     time.time())
+            self.monitor.publish(self._filter_events(batch))
+        ship, meta, kind = routed, None, "wide"
+        if s["packed"]:
+            ok, ep, dirn = pack_eligibility(rows)
+            if ok:
+                ship = pack_rows(
+                    routed, out=s["route_arena"].slot(len(routed),
+                                                      PACKED_COLS))
+                meta, kind = (ep, dirn), "packed"
+        s["ring"], row_map = self.loader.serve_sharded(
+            s["ring"], ship, now, bid,
+            trace_sample=s["trace_sample"],
+            proxy_ports=s["table_dev"],
+            audit=self.config.policy_audit_mode,
+            valid=rvalid, packed_meta=meta)
+        self._serving_snapshot_numerics(s, row_map)
+        s["window"][bid] = (kind, ship, meta, s["numerics"],
                             time.time())
-        s["seq"] += 1
-        if s["seq"] % s["drain_every"] == 0:
+        return {"h2d_bytes": ship.nbytes,
+                "mode": f"sharded-{kind}"}
+
+    def _collect_and_emit(self, s) -> None:
+        """Complete the in-flight ring fetch and publish its events
+        (per-chip rings hand back a shard id per row)."""
+        if s["n_shards"]:
+            rows, shards, _, _ = s["drainer"].collect()
+            self._emit_ring_rows(rows, shards)
+        else:
             rows, _, _ = s["drainer"].collect()
             self._emit_ring_rows(rows)
-            s["ring"] = s["drainer"].swap(s["ring"])
-            # retain headers for the current window + the one whose
-            # fetch is in flight; older windows have already emitted
-            live = {(s["seq"] - 1 - i) & 0x1FFF
-                    for i in range(2 * s["drain_every"])}
-            for b in list(s["window"]):
-                if b not in live:
-                    del s["window"][b]
 
     def stop_serving(self) -> dict:
         """Drain everything in flight and emit it; returns serving
@@ -976,21 +1188,28 @@ class Daemon:
             # row through serve_batch before the ring drains below
             front = runtime.stop(drain=True)
         d = s["drainer"]
-        rows, _, _ = d.collect()
-        self._emit_ring_rows(rows)
+        self._collect_and_emit(s)
         d.swap(s["ring"])
-        rows, _, _ = d.collect()
-        self._emit_ring_rows(rows)
+        self._collect_and_emit(s)
+        if s["mesh"] is not None:
+            # leave the loader in the default single-device placement
+            # (subsequent step()/process_batch callers expect it)
+            self.loader.serving_unshard()
         self._serving = None
         out = {"windows": d.windows, "events": d.events,
                "lost": d.lost}
+        if s["n_shards"]:
+            out["shards"] = s["n_shards"]
+            out["route-overflow"] = s["route_overflow"]
         if front is not None:
             out["front-end"] = front
         return out
 
-    def _emit_ring_rows(self, rows: np.ndarray) -> None:
+    def _emit_ring_rows(self, rows: np.ndarray,
+                        shards: Optional[np.ndarray] = None) -> None:
+        from ..core.packets import unpack_rows_np
         from ..monitor.api import decode_ring_rows
-        from ..monitor.ring import COL_BATCH
+        from ..monitor.ring import COL_BATCH, COL_PKT_IDX
 
         if rows is None or not len(rows):
             return
@@ -999,9 +1218,23 @@ class Daemon:
             rec = s["window"].get(int(b))
             if rec is None:
                 continue  # header window expired (overrun drain lag)
-            hdr, numerics, ts = rec
-            batch = decode_ring_rows(rows[rows[:, COL_BATCH] == b],
-                                     hdr, numerics, ts)
+            kind, hdr, meta, numerics, ts = rec
+            m = rows[:, COL_BATCH] == b
+            rows_b = rows[m]
+            pkt = rows_b[:, COL_PKT_IDX].astype(np.int64)
+            if shards is not None:
+                # per-chip rings carry shard-LOCAL packet indices;
+                # the retained window is the ROUTED tensor, shard s
+                # owning rows [s*block, (s+1)*block)
+                pkt = shards[m] * (len(hdr) // s["n_shards"]) + pkt
+            sel = hdr[pkt]
+            if kind == "packed":
+                # wide columns reconstructed host-side ONLY for the
+                # rows the ring compaction kept — the whole point of
+                # retaining the 4x smaller packed window
+                sel = unpack_rows_np(sel, *meta)
+            batch = decode_ring_rows(rows_b, sel, numerics, ts,
+                                     aligned=True)
             if self.auth_manager is not None:
                 # the drained window's logical now is gone; the
                 # serving loop stamps batches with _now(), so grants

@@ -385,6 +385,21 @@ def _metrics_text(daemon: Daemon) -> str:
         lines.append(f"cilium_serving_shed_total {sv['shed']}")
         lines.append("# TYPE cilium_serving_batches_total counter")
         lines.append(f"cilium_serving_batches_total {sv['batches']}")
+        h2d = sv.get("h2d") or {}
+        if "bytes" in h2d:
+            lines.append("# TYPE cilium_serving_h2d_bytes_total "
+                         "counter")
+            lines.append(
+                f"cilium_serving_h2d_bytes_total {h2d['bytes']}")
+            lines.append("# TYPE cilium_serving_packed_batches_total "
+                         "counter")
+            lines.append(f"cilium_serving_packed_batches_total "
+                         f"{h2d['packed-batches']}")
+    if sv.get("active") and sv.get("shards"):
+        lines.append("# TYPE cilium_serving_route_overflow_total "
+                     "counter")
+        lines.append(f"cilium_serving_route_overflow_total "
+                     f"{sv['route-overflow']}")
     return "\n".join(lines) + "\n" + daemon.flow_metrics.render()
 
 

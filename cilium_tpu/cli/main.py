@@ -408,6 +408,16 @@ def cmd_serving(args) -> int:
                       f"shed {st.get('shed', 0)} "
                       f"({st.get('shed-events', 0)} as drop events)")
                 print(f"Shapes:    {st.get('batch-shapes', {})}")
+                h2d = st.get("h2d") or {}
+                if h2d.get("packed-batches") or h2d.get("wide-batches"):
+                    print(f"H2D:       {h2d.get('bytes-per-packet')} "
+                          f"B/packet "
+                          f"({h2d.get('packed-batches', 0)} packed / "
+                          f"{h2d.get('wide-batches', 0)} wide batches)")
+                if st.get("shards"):
+                    print(f"Shards:    {st['shards']} chips, "
+                          f"route-overflow "
+                          f"{st.get('route-overflow', 0)}")
                 for name, key in (("Queue-wait", "queue-wait-us"),
                                   ("Latency", "latency-us")):
                     h = st.get(key) or {}
@@ -462,6 +472,7 @@ def cmd_daemon(args) -> int:
         "serving_bucket_ladder": args.serving_bucket_ladder,
         "serving_max_wait_us": args.serving_max_wait_us,
         "serving_overflow_policy": args.serving_overflow_policy,
+        "serving_packed_ingest": args.serving_packed_ingest,
     }.items() if v is not None}
     cfg = load_config(config_dir=args.config_dir, **overrides)
     d = Daemon(cfg)
@@ -620,6 +631,13 @@ def main(argv=None) -> int:
                    help="admission shed policy when the queue is full "
                         "(default drop-tail: arriving overflow sheds; "
                         "drop-oldest evicts stale queued rows)")
+    p.add_argument("--serving-packed-ingest", default=None,
+                   choices=["true", "false"],
+                   help="ship eligible IPv4 single-stream batches as "
+                        "the packed 16 B/packet h2d wire format (4x "
+                        "fewer bytes than wide rows; IPv6/mixed "
+                        "streams fall back to wide per batch); "
+                        "'false' overrides a config-dir/env true")
 
     args = parser.parse_args(argv)
     if args.cmd == "version":

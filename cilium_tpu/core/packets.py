@@ -129,6 +129,29 @@ def pack_rows(hdr: np.ndarray, out: Optional[np.ndarray] = None
     return p
 
 
+def _unpack_hdr_xp(xp, packed, ep, dirn):
+    """The packed->wide bit layout, ONCE, over xp = np or jnp — the
+    device unpack (:func:`unpack_hdr`) and the host event join
+    (:func:`unpack_rows_np`) must never drift apart on the wire
+    format (same discipline as normalize_ports)."""
+    packed = packed.astype(xp.uint32)
+    src = packed[:, PACKED_SRC]
+    z = xp.zeros_like(src)
+    return xp.stack([
+        z, z, z, src,
+        z, z, z, packed[:, PACKED_DST],
+        packed[:, PACKED_PORTS] >> 16,
+        packed[:, PACKED_PORTS] & 0xFFFF,
+        packed[:, PACKED_META] >> 24,
+        ((packed[:, PACKED_META] >> 16) & 0xFF)
+        | (((packed[:, PACKED_META] >> 15) & 1) << 8),  # FLAG_RELATED
+        packed[:, PACKED_META] & META_LEN_MASK,
+        xp.full_like(src, 4),
+        xp.full_like(src, xp.uint32(ep)),
+        xp.full_like(src, xp.uint32(dirn)),
+    ], axis=1)
+
+
 def unpack_hdr(packed, ep, dirn):
     """Packed rows [N, 4] -> wide header tensor [N, N_COLS] (jax).
 
@@ -138,22 +161,49 @@ def unpack_hdr(packed, ep, dirn):
     metadata)."""
     import jax.numpy as jnp
 
-    packed = packed.astype(jnp.uint32)
-    src = packed[:, PACKED_SRC]
-    z = jnp.zeros_like(src)
-    return jnp.stack([
-        z, z, z, src,
-        z, z, z, packed[:, PACKED_DST],
-        packed[:, PACKED_PORTS] >> 16,
-        packed[:, PACKED_PORTS] & 0xFFFF,
-        packed[:, PACKED_META] >> 24,
-        ((packed[:, PACKED_META] >> 16) & 0xFF)
-        | (((packed[:, PACKED_META] >> 15) & 1) << 8),  # FLAG_RELATED
-        packed[:, PACKED_META] & META_LEN_MASK,
-        jnp.full_like(src, 4),
-        jnp.full_like(src, jnp.uint32(ep)),
-        jnp.full_like(src, jnp.uint32(dirn)),
-    ], axis=1)
+    return _unpack_hdr_xp(jnp, packed, ep, dirn)
+
+
+def unpack_rows_np(packed: np.ndarray, ep: int, dirn: int) -> np.ndarray:
+    """Packed rows [N, 4] -> wide header rows [N, N_COLS], host numpy.
+
+    The host inverse of :func:`pack_rows` — the SAME bit-layout
+    definition as the device unpack (:func:`_unpack_hdr_xp`): the
+    serving path retains only the PACKED rows per batch window, and
+    the event join reconstructs wide columns for just the few rows
+    the ring compaction kept."""
+    packed = np.asarray(packed, dtype=np.uint32)
+    return _unpack_hdr_xp(np, packed, int(ep), int(dirn))
+
+
+def pack_eligibility(hdr: np.ndarray,
+                     n: Optional[int] = None) -> Tuple[bool, int, int]:
+    """May ``hdr[:n]`` ship as packed 16 B rows VERDICT-IDENTICALLY?
+
+    Returns ``(eligible, ep, dirn)``.  Eligible means: IPv4 in the
+    mapped layout (src/dst words 0-2 zero), every field inside its
+    packed wire width (ports 16 bit, proto 8 bit, flags 8 bit +
+    RELATED, len <= 0x7FFF — capping would change what the datapath
+    sees), and ONE (ep, dir) stream (they ride as scalars, the
+    per-endpoint tc hook analogue).  Anything else takes the wide
+    fallback shape."""
+    h = np.asarray(hdr)[:n]
+    if len(h) == 0:
+        return False, 0, 0
+    ep, dirn = int(h[0, COL_EP]), int(h[0, COL_DIR])
+    ok = (
+        (h[:, COL_FAMILY] == 4).all()
+        and not h[:, COL_SRC_IP0:COL_SRC_IP3].any()
+        and not h[:, COL_DST_IP0:COL_DST_IP3].any()
+        and (h[:, COL_SPORT] < (1 << 16)).all()
+        and (h[:, COL_DPORT] < (1 << 16)).all()
+        and (h[:, COL_PROTO] < (1 << 8)).all()
+        and not (h[:, COL_FLAGS] & ~np.uint32(0xFF | FLAG_RELATED)).any()
+        and (h[:, COL_LEN] <= META_LEN_MASK).all()
+        and (h[:, COL_EP] == ep).all()
+        and (h[:, COL_DIR] == dirn).all()
+    )
+    return bool(ok), ep, dirn
 
 
 IPAddr = Union[str, int, ipaddress.IPv4Address, ipaddress.IPv6Address]

@@ -135,7 +135,34 @@ class TPULoader(Loader):
         # concurrent step would resurrect the pre-attach tensors.
         # make_lock: plain Lock normally, order-checked DebugLock
         # under CILIUM_TPU_LOCKDEBUG=1 (SURVEY §5 race detection)
+        #
+        # Lock discipline for the hot path: ALL host-side staging
+        # (np.ascontiguousarray + the h2d jnp.asarray/device_put)
+        # happens BEFORE the lock is taken; the lock covers only the
+        # async dispatch + state swap, so attach/auth/API calls never
+        # stall behind a host->device copy, and host assembly of
+        # batch N+1 overlaps device execution of batch N.
         self._lock = make_lock("datapath-loader")
+        # multi-chip serving (parallel/mesh.py): serving_shard()
+        # installs the mesh and re-places state (CT sharded per chip,
+        # tables replicated); sharded serve steps are cached per
+        # (packed, trace_sample, audit) so one serving session
+        # compiles exactly one executable per ladder rung and mode
+        self._serving_mesh = None
+        self._sharded_steps: Dict[tuple, object] = {}
+
+    def _rekeep_serving_placement(self) -> None:
+        """Call (under the lock) after ANY state swap that introduces
+        fresh arrays: during sharded serving the swap must not
+        silently unshard the CT or leave new tensors single-device —
+        the next sharded step would either recompile or, worse, run
+        against an implicitly resharded CT.  No-op outside sharded
+        serving; device_put is a no-op on already-placed leaves."""
+        if self._serving_mesh is None:
+            return
+        from ..parallel.mesh import shard_state
+
+        self.state = shard_state(self.state, self._serving_mesh)
 
     def attach(self, policies, ipcache, ep_policy, row_map) -> None:
         from .conntrack import CTTable
@@ -180,6 +207,7 @@ class TPULoader(Loader):
                 self.state = DatapathState(
                     policy=policy, ipcache=device_lpm,
                     ct=self.state.ct, metrics=self.state.metrics)
+            self._rekeep_serving_placement()
             self.attach_count += 1
 
     def auth_upsert(self, ep_id: int, remote_id: int,
@@ -233,11 +261,21 @@ class TPULoader(Loader):
         from .verdict import datapath_step_jit
 
         jnp = self._jnp
+        # host staging OUT from under the lock (see __init__ lock
+        # discipline): the lock protects dispatch + state swap only,
+        # never an h2d copy
         if isinstance(hdr, np.ndarray):
             hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        if isinstance(pre_drop, np.ndarray):
+            pre_drop = jnp.asarray(pre_drop)
+        if isinstance(pre_drop_reason, np.ndarray):
+            pre_drop_reason = jnp.asarray(pre_drop_reason)
+        if isinstance(lb_drop, np.ndarray):
+            lb_drop = jnp.asarray(lb_drop)
+        now = jnp.uint32(now)
         with self._lock:
             out, self.state = datapath_step_jit(
-                self.state, hdr, jnp.uint32(now), pre_drop=pre_drop,
+                self.state, hdr, now, pre_drop=pre_drop,
                 pre_drop_reason=pre_drop_reason, lb_drop=lb_drop,
                 audit=audit)
             row_map = self.row_map
@@ -258,17 +296,142 @@ class TPULoader(Loader):
         from ..monitor.ring import serve_step_jit
 
         jnp = self._jnp
+        # staging before the lock: only the async dispatch is
+        # serialized (lock discipline in __init__)
         if isinstance(hdr, np.ndarray):
             hdr = jnp.asarray(np.ascontiguousarray(hdr))
         if isinstance(valid, np.ndarray):
             valid = jnp.asarray(valid)
+        now, batch_id = jnp.uint32(now), jnp.uint32(batch_id)
         with self._lock:
             self.state, ring = serve_step_jit(
-                self.state, ring, hdr, jnp.uint32(now),
-                jnp.uint32(batch_id), trace_sample=trace_sample,
+                self.state, ring, hdr, now, batch_id,
+                trace_sample=trace_sample,
                 valid=valid, proxy_ports=proxy_ports, audit=audit)
             row_map = self.row_map
         return ring, row_map
+
+    def serve_packed(self, ring, packed, now: int, batch_id: int,
+                     ep: int, dirn: int, trace_sample: int = 1024,
+                     proxy_ports=None, audit: bool = False,
+                     valid=None):
+        """The packed serving fast path: [N, 4] uint32 rows —
+        16 B/packet on the h2d link instead of :meth:`serve`'s 64 B —
+        with on-device unpack + datapath + event-ring append fused in
+        ONE dispatch (monitor/ring.py serve_step_packed).  ``ep`` /
+        ``dirn`` are per-batch stream metadata scalars;  ``valid``
+        masks the adaptive batcher's padding rows exactly like the
+        wide path, so each bucket size stays one compiled shape."""
+        from ..monitor.ring import serve_step_packed_jit
+
+        jnp = self._jnp
+        if isinstance(packed, np.ndarray):
+            packed = jnp.asarray(np.ascontiguousarray(packed))
+        if isinstance(valid, np.ndarray):
+            valid = jnp.asarray(valid)
+        now, batch_id = jnp.uint32(now), jnp.uint32(batch_id)
+        ep, dirn = jnp.uint32(ep), jnp.uint32(dirn)
+        with self._lock:
+            self.state, ring = serve_step_packed_jit(
+                self.state, ring, packed, now, batch_id, ep, dirn,
+                trace_sample=trace_sample, valid=valid,
+                proxy_ports=proxy_ports, audit=audit)
+            row_map = self.row_map
+        return ring, row_map
+
+    # -- multi-chip serving (parallel/mesh.py) ------------------------
+    def serving_shard(self, mesh) -> None:
+        """Enter sharded-serving mode: place the live state for the
+        mesh (CT private per chip, policy/ipcache/metrics replicated)
+        and route subsequent :meth:`serve_sharded` dispatches through
+        per-shard serve steps.  attach()/gc()/ct_restore() keep the
+        placement across swaps until :meth:`serving_unshard`."""
+        from ..parallel.mesh import shard_state
+
+        with self._lock:
+            self._serving_mesh = mesh
+            self._sharded_steps = {}
+            self.state = shard_state(self.state, mesh)
+
+    def serving_unshard(self) -> None:
+        """Leave sharded-serving mode: gather state back to the
+        default single-device placement (host round trip — cold path,
+        stop_serving only)."""
+        import jax
+
+        jnp = self._jnp
+        with self._lock:
+            if self._serving_mesh is None:
+                return
+            self._serving_mesh = None
+            self._sharded_steps = {}
+            self.state = jax.tree.map(
+                lambda x: jnp.asarray(np.asarray(x)), self.state)
+
+    def serve_sharded(self, ring, hdr, now: int, batch_id: int,
+                      trace_sample: int = 1024, proxy_ports=None,
+                      audit: bool = False, valid=None,
+                      packed_meta=None):
+        """One flow-routed batch through the multi-chip serve step.
+
+        ``hdr`` is the ``route_by_flow`` output — wide
+        [n_shards*block, N_COLS], or packed [n_shards*block, 4] with
+        ``packed_meta=(ep, dirn)`` for the 16 B/packet link format —
+        and ``ring`` a :func:`parallel.mesh.make_sharded_ring` pair
+        (per-chip private rings).  Each chip runs datapath + ring
+        append on its own block; counters psum to global totals."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import make_sharded_serve_step
+
+        jnp = self._jnp
+        mesh = self._serving_mesh
+        assert mesh is not None, "serving_shard(mesh) first"
+        packed = packed_meta is not None
+        # explicit per-chip placement of the batch OUTSIDE the lock:
+        # the h2d copy lands each shard's block on its own chip.
+        # P("data") spelling matters for the compile cache — see
+        # parallel.mesh.make_sharded_ring
+        row_sh = NamedSharding(mesh, P("data"))
+        if isinstance(hdr, np.ndarray):
+            hdr = jax.device_put(np.ascontiguousarray(hdr), row_sh)
+        if isinstance(valid, np.ndarray):
+            # reuse row_sh: sharding-spelling identity is load-bearing
+            # for the compile cache (see make_sharded_ring)
+            valid = jax.device_put(valid, row_sh)
+        if proxy_ports is None:
+            proxy_ports = jnp.zeros((0,), jnp.uint32)
+        now, batch_id = jnp.uint32(now), jnp.uint32(batch_id)
+        key = (packed, int(trace_sample), bool(audit))
+        with self._lock:
+            step = self._sharded_steps.get(key)
+            if step is None:
+                step = make_sharded_serve_step(
+                    mesh, packed=packed, trace_sample=trace_sample,
+                    audit=audit)
+                self._sharded_steps[key] = step
+            if packed:
+                ep, dirn = packed_meta
+                self.state, ring = step(
+                    self.state, ring, hdr, now, batch_id, valid,
+                    proxy_ports, jnp.uint32(ep), jnp.uint32(dirn))
+            else:
+                self.state, ring = step(self.state, ring, hdr, now,
+                                        batch_id, valid, proxy_ports)
+            row_map = self.row_map
+        return ring, row_map
+
+    def add_route_overflow(self, n: int) -> None:
+        """Account host-side flow-router overflow in the device
+        metricsmap (REASON_ROUTE_OVERFLOW) — the RSS-queue-overflow
+        counter; sharding-preserving (.at on the replicated array)."""
+        from ..parallel.mesh import add_route_overflow
+
+        if n == 0:
+            return
+        with self._lock:
+            self.state = add_route_overflow(self.state, int(n))
 
     def masquerade(self, nat, hdr, now: int):
         """CT-aware egress SNAT with port allocation (service/nat.py
@@ -499,6 +662,7 @@ class TPULoader(Loader):
             self.state = DatapathState(
                 policy=self.state.policy, ipcache=self.state.ipcache,
                 ct=ct, metrics=self.state.metrics)
+            self._rekeep_serving_placement()
         return int(n)
 
     def metrics(self) -> np.ndarray:
@@ -532,6 +696,7 @@ class TPULoader(Loader):
                            fp=jnp.asarray(ct_fp_from_table(table)),
                            dropped=jnp.uint32(n_dropped)),
                 metrics=self.state.metrics)
+            self._rekeep_serving_placement()
 
 
 class InterpreterLoader(Loader):

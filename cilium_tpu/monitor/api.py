@@ -263,18 +263,23 @@ def synth_drop_batch(hdr: np.ndarray, reason: int,
 
 def decode_ring_rows(rows: np.ndarray, hdr: np.ndarray,
                      row_to_numeric: np.ndarray,
-                     timestamp: float) -> EventBatch:
+                     timestamp: float,
+                     aligned: bool = False) -> EventBatch:
     """Drained ring rows of ONE batch + that batch's retained host
     header tensor -> EventBatch (the serving-path perf-reader: only
     the compacted events crossed the device->host link; the header
     columns rejoin here via the rows' packet index).
 
     ``rows`` is a ``ring_drain`` slice whose COL_BATCH all match the
-    batch ``hdr`` came from."""
+    batch ``hdr`` came from.  ``aligned=True`` means the caller
+    already gathered ``hdr`` per row (the packed/sharded serving
+    windows reconstruct wide columns for just the kept rows)."""
     from .ring import COL_PKT_IDX
 
     rows = np.asarray(rows)
-    pkt = rows[:, COL_PKT_IDX].astype(np.int64)
+    hdr = np.asarray(hdr)
+    if not aligned:
+        hdr = hdr[rows[:, COL_PKT_IDX].astype(np.int64)]
     return EventBatch(
         msg_type=_EVENT_TO_MSG[rows[:, OUT_EVENT]],
         verdict=rows[:, OUT_VERDICT].astype(np.uint8),
@@ -282,6 +287,6 @@ def decode_ring_rows(rows: np.ndarray, hdr: np.ndarray,
         ct_state=rows[:, OUT_CT].astype(np.uint8),
         identity=row_to_numeric[rows[:, OUT_ID_ROW]].astype(np.uint32),
         proxy_port=rows[:, OUT_PROXY].astype(np.uint16),
-        hdr=np.asarray(hdr)[pkt],
+        hdr=hdr,
         timestamp=timestamp,
     )

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
-from typing import Tuple
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -128,7 +128,10 @@ def ring_append(ring: EventRing, out: jnp.ndarray, batch_id: jnp.ndarray,
     from ..datapath.verdict import (OUT_CT, OUT_ID_ROW, OUT_PROXY,
                                     OUT_REASON, OUT_VERDICT)
 
-    if proxy_ports is None:
+    if proxy_ports is None or proxy_ports.shape[0] == 0:
+        # an EMPTY table also means "no listeners" — the sharded step
+        # passes a zero-length placeholder because shard_map wants a
+        # fixed arity (argmax over a 0-wide axis would be an error)
         pidx = jnp.zeros(n, dtype=jnp.uint32)
     else:
         assert proxy_ports.shape[0] <= MAX_PROXY_PORTS, \
@@ -180,16 +183,20 @@ serve_step_jit = jax.jit(serve_step, donate_argnums=(0, 1),
 def serve_step_packed(state, ring: EventRing, packed: jnp.ndarray,
                       now: jnp.ndarray, batch_id: jnp.ndarray,
                       ep, dirn, trace_sample: int = 1024,
+                      valid: jnp.ndarray = None,
                       proxy_ports: jnp.ndarray = None,
                       audit: bool = False):
     """Serving path for the packed ingest format (16 B/packet h2d):
-    unpack + fused datapath + ring append, ONE dispatch per batch."""
+    unpack + fused datapath + ring append, ONE dispatch per batch.
+    ``valid`` masks the adaptive batcher's padding rows exactly like
+    the wide :func:`serve_step` — padding touches neither CT, metrics,
+    nor the ring, so each bucket size stays one compiled shape."""
     from ..datapath.verdict import datapath_step_packed
 
     out, state = datapath_step_packed(state, packed, now, ep, dirn,
-                                      audit=audit)
+                                      valid=valid, audit=audit)
     ring = ring_append(ring, out, batch_id, trace_sample=trace_sample,
-                       proxy_ports=proxy_ports)
+                       valid=valid, proxy_ports=proxy_ports)
     return state, ring
 
 
@@ -296,16 +303,16 @@ def _unpack_rows(packed: np.ndarray,
     return rows
 
 
-def ring_drain(ring: EventRing,
-               proxy_ports: np.ndarray = None
-               ) -> Tuple[np.ndarray, int, int]:
-    """Fetch + decode the ring on host.
-
-    Returns (rows [m, RING_COLS] in append order, total_appended,
-    n_overwritten).  The single host fetch happens HERE, at the
-    monitor's cadence — never in the datapath hot loop."""
-    buf = np.asarray(ring.buf)
-    lo, hi = (int(w) for w in np.asarray(ring.cursor))
+def _drain_window(buf: np.ndarray, cursor: np.ndarray,
+                  proxy_ports: np.ndarray = None
+                  ) -> Tuple[np.ndarray, int, int]:
+    """Decode ONE ring's fetched window: 64-bit cursor assembly,
+    wrap/lost math, empty-slot filter, wire unpack.  The single
+    definition of the drain rules — :func:`ring_drain` (one ring) and
+    :func:`sharded_ring_drain` (per-chip rings) both call it, so a
+    future wire-format change (e.g. widening the 4-bit reason field)
+    lands in one place."""
+    lo, hi = int(cursor[0]), int(cursor[1])
     total = (hi << 32) | lo
     cap = buf.shape[0]
     if total <= cap:
@@ -318,3 +325,98 @@ def ring_drain(ring: EventRing,
     # empty slots carry event bits 0b11 (no EV_* code is 3)
     rows = rows[((rows[:, 0] >> 3) & 0x3) != 0x3]
     return _unpack_rows(rows, proxy_ports), total, lost
+
+
+def sharded_ring_drain(buf: np.ndarray, cursor: np.ndarray,
+                       proxy_ports: np.ndarray = None
+                       ) -> Tuple[np.ndarray, np.ndarray, int, int]:
+    """Host decode of a SHARDED ring window (per-chip private rings
+    drained round-robin, shard 0 first).
+
+    ``buf`` is the fetched [n_shards * cap, RING_WORDS] buffer (shard
+    s owns rows [s*cap, (s+1)*cap)), ``cursor`` the [n_shards, 2]
+    per-shard cursors.  Returns ``(rows, shard_ids, appended, lost)``
+    — ``rows`` decoded like :func:`ring_drain` with shard-LOCAL packet
+    indices, ``shard_ids`` aligned per row so the caller can map a row
+    back to its per-shard header block (global row = shard * block +
+    pkt_idx; the header join knows each batch's block)."""
+    n_shards = cursor.shape[0]
+    cap = buf.shape[0] // n_shards
+    parts: List[np.ndarray] = []
+    shard_ids: List[np.ndarray] = []
+    appended = lost = 0
+    for s in range(n_shards):
+        rows, total, lost_s = _drain_window(
+            buf[s * cap:(s + 1) * cap], cursor[s], proxy_ports)
+        parts.append(rows)
+        shard_ids.append(np.full(len(rows), s, dtype=np.int64))
+        appended += total
+        lost += lost_s
+    return (np.concatenate(parts) if parts
+            else np.zeros((0, RING_COLS), dtype=np.uint32),
+            np.concatenate(shard_ids) if shard_ids
+            else np.zeros(0, dtype=np.int64),
+            appended, lost)
+
+
+class ShardedAsyncRingDrainer:
+    """The :class:`AsyncRingDrainer` shape for per-chip rings: one
+    device-sharded (buf, cursor) pair holds every chip's private ring;
+    ``swap`` starts the async fetch of the just-filled window and
+    hands back a fresh one, ``collect`` completes it and decodes the
+    shards round-robin.  Loss accounting is per shard per window
+    (every window starts on fresh rings), summed."""
+
+    def __init__(self, capacity: int, n_shards: int,
+                 fresh_fn, proxy_ports: np.ndarray = None):
+        # fresh_fn: () -> device EventRing with buf [S*cap, RING_WORDS]
+        # sharded on axis 0 and cursor [S, 2] sharded (parallel.mesh
+        # builds it — placement needs the mesh, which lives there)
+        self.capacity = capacity
+        self.n_shards = n_shards
+        self.proxy_ports = proxy_ports
+        self._fresh_fn = fresh_fn
+        self._pending = None
+        self.windows = 0
+        self.events = 0
+        self.lost = 0
+
+    def fresh(self):
+        return self._fresh_fn()
+
+    def swap(self, ring):
+        """Same cursor-first sync discipline as the single-chip
+        drainer (see AsyncRingDrainer.swap): block on the small
+        cursor, then the buffer bytes stream in the background."""
+        assert self._pending is None, "previous window not collected"
+        ring.cursor.block_until_ready()
+        ring.buf.copy_to_host_async()
+        ring.cursor.copy_to_host_async()
+        self._pending = ring
+        return self.fresh()
+
+    def collect(self) -> Tuple[np.ndarray, np.ndarray, int, int]:
+        ring = self._pending
+        if ring is None:
+            return (np.zeros((0, RING_COLS), dtype=np.uint32),
+                    np.zeros(0, dtype=np.int64), 0, 0)
+        self._pending = None
+        rows, shards, appended, lost = sharded_ring_drain(
+            np.asarray(ring.buf), np.asarray(ring.cursor),
+            self.proxy_ports)
+        self.windows += 1
+        self.events += appended - lost
+        self.lost += lost
+        return rows, shards, appended, lost
+
+
+def ring_drain(ring: EventRing,
+               proxy_ports: np.ndarray = None
+               ) -> Tuple[np.ndarray, int, int]:
+    """Fetch + decode the ring on host.
+
+    Returns (rows [m, RING_COLS] in append order, total_appended,
+    n_overwritten).  The single host fetch happens HERE, at the
+    monitor's cadence — never in the datapath hot loop."""
+    return _drain_window(np.asarray(ring.buf), np.asarray(ring.cursor),
+                         proxy_ports)

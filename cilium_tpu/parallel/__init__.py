@@ -15,6 +15,8 @@ directions of a flow land on the same shard.
 from .mesh import (  # noqa: F401
     flow_shard_ids,
     make_mesh,
+    make_sharded_ring,
+    make_sharded_serve_step,
     make_sharded_step,
     add_route_overflow,
     route_by_flow,

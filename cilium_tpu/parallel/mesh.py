@@ -82,7 +82,9 @@ def flow_shard_ids(data: np.ndarray, n_shards: int) -> np.ndarray:
 
 
 def route_by_flow(data: np.ndarray, n_shards: int,
-                  block: Optional[int] = None
+                  block: Optional[int] = None,
+                  out: Optional[Tuple[np.ndarray, np.ndarray,
+                                      np.ndarray]] = None
                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, int]:
     """Steer packets into equal-size per-shard blocks (host side).
 
@@ -97,7 +99,13 @@ def route_by_flow(data: np.ndarray, n_shards: int,
     ``block`` (per-shard rows) should be FIXED by the caller across
     batches — a data-dependent shape would retrace the jitted sharded
     step every batch.  Default: 2x the fair share, rounded to a power
-    of two."""
+    of two.
+
+    ``out`` is an optional preallocated ``(routed, valid, orig)``
+    triple (e.g. serving-arena slots) with shapes
+    ``[n_shards*block, N_COLS] u32 / [n_shards*block] bool / int64``
+    — the serving hot path reuses buffers instead of allocating per
+    batch; contents are fully overwritten."""
     ids = flow_shard_ids(data, n_shards)
     if block is None:
         fair = max(-(-len(data) // n_shards), 1)
@@ -119,9 +127,17 @@ def route_by_flow(data: np.ndarray, n_shards: int,
     n_overflow = int(n - keep.sum())
     dest = sorted_ids[keep] * block + rank[keep]
     src_rows = order[keep]
-    routed = np.zeros((n_shards * block, N_COLS), dtype=np.uint32)
-    valid = np.zeros(n_shards * block, dtype=bool)
-    orig = np.full(n_shards * block, -1, dtype=np.int64)
+    if out is None:
+        routed = np.zeros((n_shards * block, N_COLS), dtype=np.uint32)
+        valid = np.zeros(n_shards * block, dtype=bool)
+        orig = np.full(n_shards * block, -1, dtype=np.int64)
+    else:
+        routed, valid, orig = out
+        assert routed.shape[0] == valid.shape[0] == orig.shape[0] \
+            == n_shards * block, "out buffers must match the routed shape"
+        routed[:] = 0
+        valid[:] = False
+        orig[:] = -1
     routed[dest] = data[src_rows]
     valid[dest] = True
     orig[dest] = src_rows
@@ -147,7 +163,9 @@ def shard_state(state: DatapathState, mesh: Mesh,
     """Place device state per the sharded-step layout: CT table sharded
     over chips, everything else replicated."""
     repl = NamedSharding(mesh, P())
-    ct_sh = NamedSharding(mesh, P(axis, None))
+    # P(axis), not P(axis, None): the spellings place identically but
+    # the compile cache keys on them — see make_sharded_ring
+    ct_sh = NamedSharding(mesh, P(axis))
     fp_sh = NamedSharding(mesh, P(axis))
 
     def put(x, sharding):
@@ -161,6 +179,108 @@ def shard_state(state: DatapathState, mesh: Mesh,
                    dropped=put(state.ct.dropped, repl)),
         metrics=put(state.metrics, repl),
     )
+
+
+def make_sharded_ring(mesh: Mesh, capacity: int, axis: str = "data"):
+    """Per-chip private event rings as ONE device-sharded EventRing:
+    ``buf`` [n_shards * capacity, RING_WORDS] sharded on axis 0 (shard
+    s owns its contiguous block), ``cursor`` [n_shards, 2] sharded.
+    Inside the sharded serve step each chip sees exactly a single-chip
+    ring and appends locally — no cross-chip traffic on the monitor
+    plane, the per-CPU perf-ring layout."""
+    from ..monitor.ring import RING_WORDS, EventRing, _EMPTY
+
+    assert capacity & (capacity - 1) == 0, "capacity must be 2^k"
+    n_shards = mesh.devices.size
+    # P(axis), NOT P(axis, None): jit normalizes output specs by
+    # trimming trailing Nones, and the two spell the SAME placement —
+    # but the compilation cache keys on the spelling, so a fresh ring
+    # written P(axis, None) would recompile the serve step every
+    # window swap (caught by the recompile-guard test)
+    row_sh = NamedSharding(mesh, P(axis))
+    buf = jax.device_put(
+        jnp.full((n_shards * capacity, RING_WORDS), _EMPTY,
+                 dtype=jnp.uint32), row_sh)
+    cursor = jax.device_put(
+        jnp.zeros((n_shards, 2), dtype=jnp.uint32), row_sh)
+    return EventRing(buf=buf, cursor=cursor)
+
+
+def make_sharded_serve_step(mesh: Mesh, axis: str = "data",
+                            packed: bool = False,
+                            trace_sample: int = 1024,
+                            audit: bool = False) -> Callable:
+    """Build the jitted multi-chip SERVING step: per shard, fused
+    datapath + event-ring append (monitor/ring.py serve_step) with the
+    CT private per chip, policy/ipcache replicated, counters psum-ed,
+    and each chip appending to its own private ring block (see
+    :func:`make_sharded_ring`).
+
+    ``packed=True`` builds the 16 B/packet variant: ``hdr`` is the
+    flow-routed packed tensor [n_shards*block, 4] and ``ep``/``dirn``
+    ride as replicated scalars (stream metadata); the wide tensor is
+    only ever materialized on device, per shard.
+
+    step(state, ring, hdr, now, batch_id, valid, proxy_ports[, ep,
+    dirn]) -> (state', ring') with hdr/valid sharded on the batch
+    axis.  ``proxy_ports`` must be a device array (possibly length 0 —
+    "no listeners"); ``trace_sample``/``audit`` are baked into the
+    built step (they are per serving session, and the loader caches
+    one step per configuration)."""
+    from ..datapath.verdict import datapath_step, datapath_step_packed
+    from ..monitor.ring import EventRing, ring_append
+
+    state_specs = (P(), P(), P(axis, None), P(axis), P(), P())
+    ring_specs = (P(axis, None), P(axis, None))
+    meta_specs = ((P(), P()) if packed else ())
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=state_specs + ring_specs
+        + (P(axis, None), P(), P(), P(axis), P()) + meta_specs,
+        out_specs=(P(axis, None), P(axis), P(), P(),
+                   P(axis, None), P(axis, None)),
+    )
+    def _step(policy, ipcache, ct_table, ct_fp, ct_dropped, metrics,
+              rbuf, rcur, hdr, now, batch_id, valid, proxy_ports,
+              *meta):
+        state = DatapathState(
+            policy=policy, ipcache=ipcache,
+            ct=CTTable(table=ct_table, fp=ct_fp, dropped=ct_dropped),
+            metrics=metrics)
+        if packed:
+            ep, dirn = meta
+            out, ns = datapath_step_packed(state, hdr, now, ep, dirn,
+                                           valid=valid, audit=audit)
+        else:
+            out, ns = datapath_step(state, hdr, now, valid=valid,
+                                    audit=audit)
+        ring = ring_append(EventRing(buf=rbuf, cursor=rcur[0]), out,
+                           batch_id, trace_sample=trace_sample,
+                           valid=valid, proxy_ports=proxy_ports)
+        d_dropped = jax.lax.psum(ns.ct.dropped - ct_dropped, axis)
+        d_metrics = jax.lax.psum(ns.metrics - metrics, axis)
+        return (ns.ct.table, ns.ct.fp, ct_dropped + d_dropped,
+                metrics + d_metrics, ring.buf, ring.cursor[None])
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(state: DatapathState, ring, hdr: jnp.ndarray,
+             now: jnp.ndarray, batch_id: jnp.ndarray,
+             valid: jnp.ndarray, proxy_ports: jnp.ndarray,
+             ep=None, dirn=None):
+        meta = (ep, dirn) if packed else ()
+        table, fp, dropped, metrics, rbuf, rcur = _step(
+            state.policy, state.ipcache, state.ct.table, state.ct.fp,
+            state.ct.dropped, state.metrics, ring.buf, ring.cursor,
+            hdr, now, batch_id, valid, proxy_ports, *meta)
+        return (DatapathState(
+            policy=state.policy, ipcache=state.ipcache,
+            ct=CTTable(table=table, fp=fp, dropped=dropped),
+            metrics=metrics),
+            EventRing(buf=rbuf, cursor=rcur))
+
+    return step
 
 
 def make_sharded_step(mesh: Mesh, axis: str = "data") -> Callable:

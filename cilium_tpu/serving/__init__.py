@@ -15,6 +15,9 @@ Pieces (PARITY.md row 54):
 - :mod:`.batcher` — adaptive batcher padding to a small ladder of
   power-of-two bucket sizes (bounds JIT recompiles to the ladder
   length) and flushing on bucket-full OR a max-wait deadline.
+  Assembles into a preallocated per-bucket arena (allocation-free hot
+  path) and, with ``pack=True``, emits eligible IPv4 single-stream
+  batches as the packed 16 B/packet h2d wire format.
 - :mod:`.runtime` — the drain loop: assemble batch N+1 on the host
   while batch N executes on device (``Daemon.serve_batch``), with
   clean start/stop/drain semantics.
@@ -82,13 +85,14 @@ def validate_serving_config(queue_depth: int, bucket_ladder,
     return depth, ladder, wait, overflow_policy
 
 
-from .batcher import AdaptiveBatcher  # noqa: E402
+from .batcher import AdaptiveBatcher, BucketArena  # noqa: E402
 from .ingress import IngressQueue  # noqa: E402
 from .runtime import ServingRuntime  # noqa: E402
 from .stats import LatencyHistogram, ServingStats  # noqa: E402
 
 __all__ = [
     "AdaptiveBatcher",
+    "BucketArena",
     "IngressQueue",
     "LatencyHistogram",
     "ServingError",

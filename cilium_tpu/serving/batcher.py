@@ -13,31 +13,98 @@ Padding rows are ZEROS carried with a ``valid`` mask: the datapath
 masks them out of CT and metrics (``datapath_step(valid=...)``) and
 the event ring never emits them, so a padded batch is
 indistinguishable from its real rows downstream.
+
+Two staging disciplines:
+
+- **Arena (the production hot path).** Buffers come from a
+  preallocated per-bucket :class:`BucketArena` recycled round-robin —
+  no per-batch allocation, queue rows memcpy straight into the slot
+  (``IngressQueue.take_into``).  OWNERSHIP HANDOFF RULE: a slot handed
+  out with batch N of bucket B is reused by batch N + ``depth`` of
+  the SAME bucket; the consumer (the daemon retains ``hdr`` for the
+  drain-time event join, and may still be feeding an async h2d copy)
+  must be done with it by then.  ``Daemon.start_serving`` sizes
+  ``depth`` to its retention window (2 * drain_every + slack), which
+  is the only consumer contract.
+- **``pack=...`` (the 16 B/packet h2d format).** When a batch's rows
+  are IPv4 with one (ep, dir) stream (``core.packets.
+  pack_eligibility``), the batcher emits PACKED [bucket, 4] rows
+  (``AssembledBatch.packed`` True, ``ep``/``dirn`` carried as stream
+  metadata) — 4x fewer bytes on the host->device link.  Ineligible
+  traffic (IPv6, mixed streams, out-of-width fields) keeps the wide
+  [bucket, N_COLS] fallback shape, so each ladder rung compiles at
+  most one packed and one wide executable.
 """
 
 from __future__ import annotations
 
 import time
-from typing import List, NamedTuple, Optional, Tuple
+from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
 from .ingress import IngressQueue
 
+# default arena depth: enough slots that a consumer retaining a
+# handful of in-flight windows (async h2d + event join) never sees a
+# slot recycled under it; Daemon.start_serving overrides to match its
+# actual retention horizon
+DEFAULT_ARENA_DEPTH = 16
+
 
 class AssembledBatch(NamedTuple):
-    hdr: np.ndarray  # [bucket, N_COLS] uint32 (padded)
+    hdr: np.ndarray  # [bucket, N_COLS] u32, or [bucket, 4] when packed
     valid: np.ndarray  # [bucket] bool
     n_valid: int
     arrivals: List[Tuple[int, float]]  # (count, t_arrival) chunks
+    packed: bool = False  # hdr is the 16 B/packet wire format
+    ep: int = 0  # stream metadata scalars (packed batches only)
+    dirn: int = 0
+
+
+class BucketArena:
+    """Preallocated per-(bucket, width) staging slots, recycled
+    round-robin.  Slots allocate lazily on first use of a shape, so
+    an all-packed session never pays for wide slots at the big rungs
+    (and vice versa)."""
+
+    def __init__(self, depth: int = DEFAULT_ARENA_DEPTH):
+        assert depth >= 2, "arena depth < 2 would alias consecutive batches"
+        self.depth = int(depth)
+        self._slots: Dict[tuple, np.ndarray] = {}
+        self._next: Dict[tuple, int] = {}
+
+    def slot(self, bucket: int, cols: int,
+             dtype=np.uint32) -> np.ndarray:
+        """Next staging buffer for this shape ([bucket, cols], or
+        [bucket] when cols is 0).  The caller owns it for the next
+        ``depth - 1`` requests of the SAME shape (see module doc)."""
+        key = (int(bucket), int(cols), np.dtype(dtype).str)
+        pool = self._slots.get(key)
+        if pool is None:
+            shape = ((self.depth, bucket, cols) if cols
+                     else (self.depth, bucket))
+            pool = np.zeros(shape, dtype=dtype)
+            self._slots[key] = pool
+        i = self._next.get(key, 0)
+        self._next[key] = (i + 1) % self.depth
+        return pool[i]
 
 
 class AdaptiveBatcher:
-    def __init__(self, bucket_ladder, max_wait_us: float):
+    def __init__(self, bucket_ladder, max_wait_us: float,
+                 pack: bool = False,
+                 arena_depth: int = DEFAULT_ARENA_DEPTH):
         self.ladder = tuple(int(b) for b in bucket_ladder)
         assert self.ladder == tuple(sorted(set(self.ladder))), \
             "ladder must be validated (ascending, unique) upstream"
         self.max_wait_s = float(max_wait_us) * 1e-6
+        self.pack = bool(pack)
+        self.arena = BucketArena(arena_depth)
+        # wide dequeue scratch, reused EVERY batch: rows land here
+        # from the queue, then one copy moves them to their arena slot
+        # (wide) or packs them 4x smaller (packed) — never handed out
+        self._scratch: Optional[np.ndarray] = None
 
     def bucket_for(self, n: int) -> int:
         """Smallest ladder bucket holding ``n`` rows (the largest
@@ -64,12 +131,12 @@ class AdaptiveBatcher:
         ``force`` flushes whatever is queued regardless of deadline
         (the stop/drain path).
 
-        The returned ``hdr``/``valid`` arrays are FRESH per batch —
-        ownership transfers to the dispatcher, which retains ``hdr``
-        for the drain-time event join and may still be feeding an
-        async h2d copy when the next batch assembles.  One bucket
-        write per batch either way; reusable buffers would force the
-        dispatcher to copy anyway.
+        The returned ``hdr``/``valid`` buffers are ARENA slots —
+        ownership transfers to the dispatcher under the recycling
+        horizon documented in the module header: the dispatcher may
+        retain ``hdr`` for the drain-time event join and feed an
+        async h2d copy, and the slot is not touched again until
+        ``depth`` more batches of the same shape have assembled.
 
         The ``valid`` mask is passed even for full buckets so each
         bucket size stays ONE compiled shape (a with-mask and a
@@ -78,17 +145,41 @@ class AdaptiveBatcher:
             now = time.monotonic()
         if not force and not self.due(queue, now):
             return None
-        rows, arrivals = queue.take(self.ladder[-1])
-        n = len(rows)
+        cap = self.ladder[-1]
+        if self._scratch is None or self._scratch.shape[0] < cap:
+            w = queue.row_width()
+            if w is None:  # force-flush of an empty queue
+                return None
+            # one scratch per session: the queue admits a single row
+            # schema (submit() width-checks), so the first chunk's
+            # width is THE width
+            self._scratch = np.zeros((cap, w), dtype=np.uint32)
+        n, arrivals = queue.take_into(self._scratch)
         if n == 0:
             return None
         bucket = self.bucket_for(n)
-        hdr = np.zeros((bucket, rows.shape[1]), dtype=np.uint32)
-        hdr[:n] = rows
-        valid = np.zeros(bucket, dtype=bool)
+        rows = self._scratch[:n]
+        packed, ep, dirn = False, 0, 0
+        if self.pack:
+            from ..core.packets import (PACKED_COLS, pack_eligibility,
+                                        pack_rows)
+
+            packed, ep, dirn = pack_eligibility(rows)
+        if packed:
+            hdr = self.arena.slot(bucket, PACKED_COLS)
+            pack_rows(rows, out=hdr)
+        else:
+            hdr = self.arena.slot(bucket, self._scratch.shape[1])
+            hdr[:n] = rows
+        # recycled-slot hygiene, shared by both wire formats: the
+        # tail may hold a previous batch's rows
+        hdr[n:] = 0
+        valid = self.arena.slot(bucket, 0, dtype=bool)
         valid[:n] = True
+        valid[n:] = False
         return AssembledBatch(hdr=hdr, valid=valid, n_valid=n,
-                              arrivals=arrivals)
+                              arrivals=arrivals, packed=packed,
+                              ep=ep, dirn=dirn)
 
     def time_to_deadline(self, queue: IngressQueue,
                          now: Optional[float] = None) -> float:

@@ -124,6 +124,14 @@ class IngressQueue:
     def pending(self) -> int:
         return self._pending
 
+    def row_width(self) -> Optional[int]:
+        """Column count of the queued rows (None when empty) — the
+        batcher sizes its staging scratch from the head chunk."""
+        with self._lock:
+            if not self._chunks:
+                return None
+            return self._chunks[0][0].shape[1]
+
     def oldest_age(self, now: Optional[float] = None) -> float:
         """Seconds the head-of-line chunk has waited (0 when empty)."""
         with self._lock:
@@ -161,6 +169,33 @@ class IngressQueue:
         if len(parts) == 1:
             return parts[0], arrivals
         return np.concatenate(parts), arrivals
+
+    def take_into(self, out: np.ndarray
+                  ) -> Tuple[int, List[Tuple[int, float]]]:
+        """Dequeue up to ``len(out)`` rows in FIFO order DIRECTLY into
+        ``out`` (the batcher's staging arena): one vectorized memcpy
+        per chunk, no intermediate concatenate — the zero-copy half of
+        batch assembly.  Returns ``(n, arrivals)``; ``out[:n]`` holds
+        the rows, everything past ``n`` is untouched."""
+        n = len(out)
+        arrivals: List[Tuple[int, float]] = []
+        got = 0
+        with self._lock:
+            while got < n and self._chunks:
+                rows, t = self._chunks[0]
+                want = n - got
+                if len(rows) <= want:
+                    self._chunks.popleft()
+                    out[got:got + len(rows)] = rows
+                    arrivals.append((len(rows), t))
+                    got += len(rows)
+                else:
+                    out[got:got + want] = rows[:want]
+                    self._chunks[0] = (rows[want:], t)
+                    arrivals.append((want, t))
+                    got += want
+            self._pending -= got
+        return got, arrivals
 
     def take_sheds(self) -> Tuple[Optional[np.ndarray], int]:
         """Drain the shed accounting accumulated since the last call:

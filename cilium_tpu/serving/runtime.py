@@ -9,10 +9,11 @@ batching".
 Double buffering: ``dispatch`` (``Daemon.serve_batch`` under the
 hood) ENQUEUES the device work and returns — jax dispatch is async —
 so while batch N executes on device, this loop is already draining
-the queue and padding batch N+1 on the host.  The batcher allocates
-FRESH hdr/valid arrays per batch (ownership transfers to the
-dispatcher), so assembly never touches pages an in-flight h2d copy
-or the drain-time event join may still be reading.
+the queue and padding batch N+1 on the host.  hdr/valid buffers come
+from the batcher's preallocated arena (ownership transfers to the
+dispatcher under the recycling horizon documented in batcher.py), so
+assembly is allocation-free AND never touches pages an in-flight h2d
+copy or the drain-time event join may still be reading.
 
 The loop owns all dispatch: ``submit()`` (any thread) only offers
 rows to the bounded ingress queue, which is the backpressure point —
@@ -33,8 +34,13 @@ from .batcher import AdaptiveBatcher, AssembledBatch
 from .ingress import IngressQueue
 from .stats import ServingStats
 
-# dispatch(hdr [bucket, N_COLS], valid [bucket] bool, n_valid) -> None
-DispatchFn = Callable[[np.ndarray, np.ndarray, int], None]
+# dispatch(hdr [bucket, N_COLS], valid [bucket] bool, n_valid) -> any;
+# packed batches (pack=True and the rows were eligible) add a
+# packed_meta=(ep, dirn) kwarg and ship hdr as [bucket, 4] wire rows.
+# A dispatcher may return a dict with "h2d_bytes" to override the
+# link accounting (the sharded path re-routes and re-packs, so the
+# bytes that actually crossed differ from the assembled hdr's size).
+DispatchFn = Callable[[np.ndarray, np.ndarray, int], Optional[dict]]
 # on_shed(retained header rows or None, exact shed count) -> None
 ShedFn = Callable[[Optional[np.ndarray], int], None]
 
@@ -55,11 +61,21 @@ class ServingRuntime:
                  bucket_ladder, max_wait_us: float,
                  overflow_policy: str = "drop-tail",
                  on_shed: Optional[ShedFn] = None,
-                 expected_cols: Optional[int] = None):
+                 expected_cols: Optional[int] = None,
+                 pack: bool = False,
+                 arena_depth: Optional[int] = None):
+        from .batcher import DEFAULT_ARENA_DEPTH
+
         depth, ladder, wait, policy = validate_serving_config(
             queue_depth, bucket_ladder, max_wait_us, overflow_policy)
         self.queue = IngressQueue(depth, policy)
-        self.batcher = AdaptiveBatcher(ladder, wait)
+        # pack: assemble eligible IPv4 single-stream batches as the
+        # 16 B/packet wire format; arena_depth: the staging-slot
+        # recycling horizon — MUST exceed however many in-flight
+        # batches the dispatcher retains (batcher.py module doc)
+        self.batcher = AdaptiveBatcher(
+            ladder, wait, pack=pack,
+            arena_depth=arena_depth or DEFAULT_ARENA_DEPTH)
         self.stats = ServingStats()
         self._dispatch = dispatch
         self._on_shed = on_shed
@@ -201,19 +217,40 @@ class ServingRuntime:
             self._flush_sheds()
             if self.queue.pending:
                 # rows are waiting but neither full-bucket nor
-                # deadline fired: sleep toward the deadline
-                time.sleep(min(
-                    self.batcher.time_to_deadline(self.queue),
-                    _TICK_S) or _TICK_S)
+                # deadline fired: sleep toward the deadline.  An
+                # ALREADY-EXPIRED deadline (0.0 — it can expire
+                # between the assemble above and here) loops straight
+                # back to flush; the old `min(ttd, tick) or tick`
+                # turned that 0 into a full tick of tail latency on
+                # every deadline flush.
+                ttd = self.batcher.time_to_deadline(self.queue)
+                if ttd > 0.0:
+                    time.sleep(min(ttd, _TICK_S))
             else:
                 self.queue.wait_nonempty(0.05)
 
     def _dispatch_one(self, batch: AssembledBatch) -> None:
         t0 = time.monotonic()
-        self._dispatch(batch.hdr, batch.valid, batch.n_valid)
+        if batch.packed:
+            info = self._dispatch(batch.hdr, batch.valid,
+                                  batch.n_valid,
+                                  packed_meta=(batch.ep, batch.dirn))
+        else:
+            info = self._dispatch(batch.hdr, batch.valid,
+                                  batch.n_valid)
         t1 = time.monotonic()
+        # the dispatcher knows best what crossed the link: the
+        # sharded leg re-packs AFTER flow routing, so the assembled
+        # batch's format/size can differ from the shipped one
+        h2d, packed = None, batch.packed
+        if isinstance(info, dict):
+            h2d = info.get("h2d_bytes")
+            if "mode" in info:
+                packed = "packed" in info["mode"]
         self.stats.record_batch(batch.n_valid, len(batch.hdr),
-                                batch.arrivals, t0)
+                                batch.arrivals, t0, packed=packed,
+                                h2d_bytes=(h2d if h2d is not None
+                                           else batch.hdr.nbytes))
         if self._prev_arrivals:
             self.stats.record_completion(self._prev_arrivals, t1)
         self._prev_arrivals = batch.arrivals

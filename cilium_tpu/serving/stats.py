@@ -78,6 +78,13 @@ class ServingStats:
         self.verdicts = 0  # real (valid) rows dispatched
         self.padded_rows = 0  # padding rows dispatched
         self.shapes: Dict[int, int] = {}  # bucket size -> batches
+        # h2d link accounting (the 16 B/packet tentpole's scoreboard):
+        # batches and bytes per wire format.  Bytes are the hdr tensor
+        # actually shipped (packed 16 B/row vs wide 64 B/row,
+        # including padding rows — they cross the link too).
+        self.packed_batches = 0
+        self.wide_batches = 0
+        self.h2d_bytes = 0
         self.queue_wait = LatencyHistogram()  # arrival -> dispatch
         self.latency = LatencyHistogram()  # arrival -> events emitted
 
@@ -102,12 +109,18 @@ class ServingStats:
 
     def record_batch(self, n_valid: int, bucket: int,
                      arrivals: List[Tuple[int, float]],
-                     t_dispatch: float) -> None:
+                     t_dispatch: float, packed: bool = False,
+                     h2d_bytes: int = 0) -> None:
         with self._lock:
             self.batches += 1
             self.verdicts += n_valid
             self.padded_rows += bucket - n_valid
             self.shapes[bucket] = self.shapes.get(bucket, 0) + 1
+            if packed:
+                self.packed_batches += 1
+            else:
+                self.wide_batches += 1
+            self.h2d_bytes += h2d_bytes
             # chunk-granular: one sample per chunk keeps the record
             # cost O(chunks), not O(packets)
             for count, t in arrivals:
@@ -146,6 +159,15 @@ class ServingStats:
                 "verdicts-per-sec": round(real / dt),
                 "batch-shapes": {str(k): v for k, v in
                                  sorted(self.shapes.items())},
+                "h2d": {
+                    "packed-batches": self.packed_batches,
+                    "wide-batches": self.wide_batches,
+                    "bytes": self.h2d_bytes,
+                    # per REAL packet: padding crosses the link too,
+                    # so a mostly-padded session reads honestly worse
+                    "bytes-per-packet": round(self.h2d_bytes / real, 2)
+                    if real else None,
+                },
                 "queue-pending": queue_pending,
                 "queue-depth": queue_depth,
                 "queue-wait-us": self.queue_wait.snapshot(),

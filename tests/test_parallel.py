@@ -12,6 +12,7 @@ import pytest
 
 from cilium_tpu.core import make_batch, TCP_ACK, TCP_SYN
 from cilium_tpu.parallel import (
+    add_route_overflow,
     flow_shard_ids,
     make_mesh,
     make_sharded_step,
@@ -37,6 +38,80 @@ def test_flow_hash_spreads():
     ids = flow_shard_ids(batch.data, 8)
     counts = np.bincount(ids, minlength=8)
     assert (counts > 20).all(), counts  # roughly uniform
+
+
+def test_flow_hash_symmetric_over_normalize_ports_space():
+    """Property (PR 2 satellite): for RANDOM tuples across the
+    normalize_ports space — porty protocols with real ports, portless
+    protocols (ICMP/ICMPv6) with arbitrary type/code junk in the port
+    columns — forward and reply packets always land on the same
+    shard.  Portless protocols are the trap: an ICMP echo request
+    carries dport=8 while its reply carries dport=0, so steering that
+    hashed raw ports would split the flow across shards and the reply
+    would miss its CT entry."""
+    from cilium_tpu.core.packets import (COL_DPORT, COL_DST_IP0,
+                                         COL_DST_IP3, COL_SPORT,
+                                         COL_SRC_IP0, COL_SRC_IP3,
+                                         N_COLS)
+
+    rng = np.random.default_rng(77)
+    n = 2048
+    fwd = np.zeros((n, N_COLS), dtype=np.uint32)
+    for w in range(4):
+        fwd[:, COL_SRC_IP0 + w] = rng.integers(0, 1 << 32, n,
+                                               dtype=np.uint32)
+        fwd[:, COL_DST_IP0 + w] = rng.integers(0, 1 << 32, n,
+                                               dtype=np.uint32)
+    fwd[:, COL_SPORT] = rng.integers(0, 1 << 16, n, dtype=np.uint32)
+    fwd[:, COL_DPORT] = rng.integers(0, 1 << 16, n, dtype=np.uint32)
+    fwd[:, 10] = rng.choice(
+        np.array([6, 17, 132, 1, 58, 47], dtype=np.uint32), n)
+    # the reply: src/dst and ports swapped; for portless protos ALSO
+    # scramble the ports entirely (echo reply type != request type)
+    rev = fwd.copy()
+    rev[:, COL_SRC_IP0:COL_SRC_IP3 + 1] = \
+        fwd[:, COL_DST_IP0:COL_DST_IP3 + 1]
+    rev[:, COL_DST_IP0:COL_DST_IP3 + 1] = \
+        fwd[:, COL_SRC_IP0:COL_SRC_IP3 + 1]
+    rev[:, COL_SPORT] = fwd[:, COL_DPORT]
+    rev[:, COL_DPORT] = fwd[:, COL_SPORT]
+    portless = (fwd[:, 10] == 1) | (fwd[:, 10] == 58)
+    rev[portless, COL_SPORT] = rng.integers(
+        0, 1 << 16, int(portless.sum()), dtype=np.uint32)
+    rev[portless, COL_DPORT] = rng.integers(
+        0, 1 << 16, int(portless.sum()), dtype=np.uint32)
+    for shards in (2, 8, 16):
+        np.testing.assert_array_equal(flow_shard_ids(fwd, shards),
+                                      flow_shard_ids(rev, shards))
+
+
+def test_route_overflow_counts_and_decodes(world):  # noqa: F811
+    """route_by_flow overflow -> add_route_overflow lands the EXACT
+    count under REASON_ROUTE_OVERFLOW (ingress column) without
+    touching any other counter, and the code decodes to names at the
+    monitor and flow layers.  (The serving-path end-to-end version —
+    overflow as DROP events through a live daemon — lives in
+    test_serving_sharded.py.)"""
+    from cilium_tpu.datapath.verdict import REASON_ROUTE_OVERFLOW
+    from cilium_tpu.flow.flow import DROP_REASON_DESC
+    from cilium_tpu.monitor.api import DROP_REASON_NAMES
+
+    state, _oracle, _r2n = world
+    # one elephant flow, tiny blocks: everything past one block drops
+    batch = make_batch([dict(src="10.0.1.1", dst="10.0.2.9",
+                             sport=999, dport=80, proto=6)] * 64).data
+    routed, valid, orig, n_ovf = route_by_flow(batch, 8, block=4)
+    assert n_ovf == 60 and int(valid.sum()) == 4
+    # kept rows preserve their original identity
+    assert (orig[valid] >= 0).all()
+    before = np.asarray(state.metrics).copy()
+    state = add_route_overflow(state, n_ovf)
+    delta = np.asarray(state.metrics).astype(np.int64) - before
+    assert delta[REASON_ROUTE_OVERFLOW, 0] == 60
+    assert delta.sum() == 60  # nothing else moved
+    assert DROP_REASON_NAMES[REASON_ROUTE_OVERFLOW] \
+        == "Shard queue overflow"
+    assert DROP_REASON_DESC[REASON_ROUTE_OVERFLOW] == "QUEUE_OVERFLOW"
 
 
 def test_sharded_step_matches_oracle(world):  # noqa: F811

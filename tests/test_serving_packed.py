@@ -1,0 +1,295 @@
+"""The packed serving hot path (PR 2 tentpole): 16 B/packet h2d.
+
+Acceptance: the packed serving path is VERDICT-IDENTICAL to the
+InterpreterLoader oracle on mixed IPv4 traffic (0 divergence), padding
+stays invisible, ineligible traffic falls back to the wide shape, and
+sweeping the bucket ladder creates exactly one executable per
+(ladder rung, mode) — the recompile guard, by jit-cache inspection,
+no timing.
+"""
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.core import TCP_ACK, TCP_FIN, TCP_SYN, make_batch
+from cilium_tpu.core.packets import (COL_DIR, COL_DPORT, COL_EP,
+                                     COL_FAMILY, COL_LEN, COL_PROTO,
+                                     COL_SPORT, FLAG_RELATED, N_COLS,
+                                     PACKED_COLS, pack_eligibility,
+                                     pack_rows, unpack_rows_np)
+from cilium_tpu.monitor.api import MSG_TRACE, decode_out
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }, {
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "53", "protocol": "UDP"}]}],
+    }],
+}]
+
+
+def _world(backend, ladder=(256, 1024)):
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12,
+                            flow_ring_capacity=1 << 13,
+                            serving_bucket_ladder=ladder))
+    d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    d.policy_import(RULES)
+    return d, db
+
+
+def _mixed_ipv4(db_id, rng, n=96, base_sport=20000):
+    """Mixed IPv4 traffic, ONE (ep, dir) stream: TCP (allowed +
+    scan-drops), UDP, ICMP echo, an ICMP-error RELATED row, GRE —
+    every packed wire feature except v6 (which is wide-path by
+    design)."""
+    rows = []
+    for i in range(n):
+        proto = int(rng.choice([6, 6, 6, 17, 1, 47]))
+        r = dict(src="10.0.1.1", dst="10.0.2.1",
+                 sport=base_sport + i,
+                 dport=int(rng.choice([5432, 53, 9999, 80])),
+                 proto=proto,
+                 flags=int(rng.choice([TCP_SYN, TCP_ACK,
+                                       TCP_ACK | TCP_FIN]))
+                 if proto == 6 else 0,
+                 length=int(rng.integers(60, 1500)),
+                 ep=db_id, dir=0)
+        if proto == 1:
+            r["sport"], r["dport"] = 0, int(rng.integers(0, 2)) * 8
+        rows.append(r)
+    # one ICMP error relating to an embedded tuple (META bit 15)
+    rows[-1] = dict(src="10.0.1.1", dst="10.0.2.1",
+                    sport=base_sport + n, dport=5432, proto=6,
+                    flags=TCP_ACK | FLAG_RELATED, ep=db_id, dir=0)
+    return make_batch(rows).data
+
+
+class TestPackedDivergence:
+    def test_packed_serving_identical_to_interpreter(self):
+        """The acceptance gate: every event the packed serving path
+        emits agrees with the InterpreterLoader oracle on (msg,
+        verdict, reason, identity) AND carries correctly
+        reconstructed header columns — 0 divergence on mixed IPv4."""
+        d_t, db_t = _world("tpu")
+        d_i, db_i = _world("interpreter")
+        rng = np.random.default_rng(17)
+        batches = [_mixed_ipv4(db_t.id, rng, base_sport=20000 + 200 * k)
+                   for k in range(4)]
+
+        got = []
+        d_t.monitor.register("t", got.append)
+        # trace_sample=1: EVERY packet events, so the comparison is
+        # per-packet, not just the compacted subset
+        d_t.start_serving(ring_capacity=1 << 12, drain_every=2,
+                          trace_sample=1, packed=True)
+        for k, wide in enumerate(batches):
+            ok, ep, dirn = pack_eligibility(wide)
+            assert ok, "fixture must be packed-eligible"
+            packed = pack_rows(wide)
+            assert packed.shape == (len(wide), PACKED_COLS)
+            d_t.serve_batch(packed, now=100 + k,
+                            packed_meta=(ep, dirn))
+        stats = d_t.stop_serving()
+        assert stats["lost"] == 0
+
+        def key(b, i):
+            return (int(b.msg_type[i]), int(b.verdict[i]),
+                    int(b.reason[i]), int(b.identity[i]),
+                    int(b.hdr[i, COL_SPORT]), int(b.hdr[i, COL_DPORT]),
+                    int(b.hdr[i, COL_PROTO]))
+
+        served = sorted(key(b, i) for b in got for i in range(len(b)))
+
+        want = []
+        for k, wide in enumerate(batches):
+            out, row_map = d_i.loader.step(wide, now=100 + k)
+            eb = decode_out(out, wide, row_map.numeric_array(), 0.0)
+            want.extend(key(eb, i) for i in range(len(eb)))
+        assert served == sorted(want), "packed serving diverged"
+
+        # header reconstruction: every event's wide columns round-trip
+        # the 16 B wire format (keyed by unique sport for TCP rows)
+        by_sport = {int(r[COL_SPORT]): r
+                    for b in batches for r in b if r[COL_PROTO] == 6}
+        for b in got:
+            for i in range(len(b)):
+                sp = int(b.hdr[i, COL_SPORT])
+                if sp in by_sport:
+                    r = by_sport[sp]
+                    assert int(b.hdr[i, COL_LEN]) == int(r[COL_LEN])
+                    assert int(b.hdr[i, COL_EP]) == int(r[COL_EP])
+                    assert int(b.hdr[i, COL_FAMILY]) == 4
+        d_t.shutdown()
+        d_i.shutdown()
+
+    def test_unpack_rows_np_inverts_pack_rows(self):
+        rng = np.random.default_rng(3)
+        wide = _mixed_ipv4(1, rng)
+        back = unpack_rows_np(pack_rows(wide), 1, 0)
+        np.testing.assert_array_equal(back, wide)
+
+
+class TestPackedIngestRuntime:
+    def test_eligible_stream_ships_packed_16B(self):
+        """The ingress runtime packs eligible buckets: h2d telemetry
+        shows 16 B/row and the dispatcher sees [bucket, 4] tensors."""
+        d, db = _world("tpu")
+        seen = []
+        inner = d.serve_batch
+
+        def spy(hdr, now=None, valid=None, packed_meta=None):
+            seen.append((tuple(hdr.shape), packed_meta))
+            return inner(hdr, now=now, valid=valid,
+                         packed_meta=packed_meta)
+
+        d.serve_batch = spy
+        d.start_serving(trace_sample=0, ingress=True, packed=True)
+        rows = make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=30000 + i,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0)
+            for i in range(40)]).data
+        d.submit(rows)
+        stats = d.stop_serving()
+        d.shutdown()
+        fe = stats["front-end"]
+        assert fe["verdicts"] == 40
+        assert fe["h2d"]["packed-batches"] >= 1
+        assert fe["h2d"]["wide-batches"] == 0
+        # every dispatched bucket rode the 16 B wire format
+        assert all(shape[1] == PACKED_COLS and meta is not None
+                   for shape, meta in seen), seen
+        # bytes = bucket rows * 16 B (padding crosses the link too)
+        assert fe["h2d"]["bytes"] == sum(
+            shape[0] * 16 for shape, _ in seen)
+
+    def test_padding_invisible_on_packed_path(self):
+        d, db = _world("tpu")
+        got = []
+        d.monitor.register("t", got.append)
+        before = d.loader.metrics().sum()
+        d.start_serving(trace_sample=0, ingress=True, packed=True)
+        rows = make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=31000 + i,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0)
+            for i in range(40)]).data
+        d.submit(rows)
+        d.stop_serving()
+        d.shutdown()
+        assert d.loader.metrics().sum() - before == 40
+        for b in got:
+            assert (b.hdr.sum(axis=1) != 0).all()
+
+    def test_ineligible_traffic_falls_back_wide(self):
+        """IPv6 and mixed-ep buckets keep the wide shape (verdicts
+        still correct); eligibility is per BATCH."""
+        d, db = _world("tpu")
+        seen = []
+        inner = d.serve_batch
+
+        def spy(hdr, now=None, valid=None, packed_meta=None):
+            seen.append(tuple(hdr.shape))
+            return inner(hdr, now=now, valid=valid,
+                         packed_meta=packed_meta)
+
+        d.serve_batch = spy
+        d.start_serving(trace_sample=0, ingress=True, packed=True)
+        v6 = make_batch([
+            dict(src="fd00::1", dst="fd00::2", sport=32000 + i,
+                 dport=5432, proto=6, flags=TCP_SYN, ep=db.id, dir=0)
+            for i in range(16)]).data
+        d.submit(v6)
+        stats = d.stop_serving()
+        d.shutdown()
+        fe = stats["front-end"]
+        assert fe["verdicts"] == 16
+        assert fe["h2d"]["wide-batches"] >= 1
+        assert fe["h2d"]["packed-batches"] == 0
+        assert all(s[1] == N_COLS for s in seen), seen
+
+    def test_pack_eligibility_rules(self):
+        base = make_batch([
+            dict(src="10.0.1.1", dst="10.0.2.1", sport=1, dport=2,
+                 proto=6, flags=TCP_SYN, ep=3, dir=0)] * 4).data
+        assert pack_eligibility(base)[0]
+        v6 = base.copy()
+        v6[0, COL_FAMILY] = 6
+        assert not pack_eligibility(v6)[0]
+        mixed_ep = base.copy()
+        mixed_ep[1, COL_EP] = 9
+        assert not pack_eligibility(mixed_ep)[0]
+        mixed_dir = base.copy()
+        mixed_dir[2, COL_DIR] = 1
+        assert not pack_eligibility(mixed_dir)[0]
+        jumbo = base.copy()
+        jumbo[3, COL_LEN] = 0x8000  # past the 15-bit length field:
+        assert not pack_eligibility(jumbo)[0]  # capping would diverge
+
+
+class TestRecompileGuard:
+    def test_one_executable_per_rung_and_mode(self):
+        """CI satellite: sweeping the FULL bucket ladder through
+        packed single-chip and sharded serving creates exactly one
+        executable per (ladder rung, mode), and a second sweep
+        retraces NOTHING (jit cache inspection, no timing)."""
+        import jax
+
+        from cilium_tpu.monitor.ring import serve_step_packed_jit
+        from cilium_tpu.parallel import make_mesh
+
+        LADDER = (128, 512)
+        d, db = _world("tpu", ladder=LADDER)
+
+        def sweep():
+            for k, b in enumerate(LADDER):
+                wide = make_batch([
+                    dict(src="10.0.1.1", dst="10.0.2.1",
+                         sport=40000 + 100 * k + i, dport=5432,
+                         proto=6, flags=TCP_SYN, ep=db.id, dir=0)
+                    for i in range(b // 2)]).data
+                hdr = np.zeros((b, N_COLS), dtype=np.uint32)
+                hdr[:len(wide)] = wide
+                valid = np.zeros(b, dtype=bool)
+                valid[:len(wide)] = True
+                yield hdr, valid
+
+        # -- packed single-chip: one serve_step_packed executable per
+        # rung, none on re-sweep
+        d.start_serving(trace_sample=0, packed=True)
+        before = serve_step_packed_jit._cache_size()
+        for hdr, valid in sweep():
+            ok, ep, dirn = pack_eligibility(hdr, int(valid.sum()))
+            assert ok
+            d.serve_batch(pack_rows(hdr), valid=valid,
+                          packed_meta=(ep, dirn))
+        first = serve_step_packed_jit._cache_size() - before
+        assert first == len(LADDER), \
+            f"{first} executables for {len(LADDER)} rungs"
+        for hdr, valid in sweep():
+            ok, ep, dirn = pack_eligibility(hdr, int(valid.sum()))
+            d.serve_batch(pack_rows(hdr), valid=valid,
+                          packed_meta=(ep, dirn))
+        assert serve_step_packed_jit._cache_size() - before \
+            == len(LADDER), "re-sweep retraced the packed step"
+        d.stop_serving()
+
+        # -- sharded: the session's step fn compiles one executable
+        # per rung (same shapes on re-sweep: no retrace)
+        assert len(jax.devices()) == 8
+        d.start_serving(trace_sample=0, packed=True,
+                        mesh=make_mesh(8))
+        for _ in range(2):  # sweep twice: second pass must be free
+            for hdr, valid in sweep():
+                d.serve_batch(hdr, valid=valid)
+        steps = d.loader._sharded_steps
+        assert len(steps) == 1, \
+            f"one (mode) step expected, got keys {list(steps)}"
+        n_exec = sum(s._cache_size() for s in steps.values())
+        assert n_exec == len(LADDER), \
+            f"{n_exec} sharded executables for {len(LADDER)} rungs"
+        d.stop_serving()
+        d.shutdown()
