@@ -115,6 +115,43 @@ class DaemonConfig:
     # ep/dir streams) keeps the wide fallback shape either way.
     # start_serving(packed=...) overrides per session.
     serving_packed_ingest: bool = False
+    # -- serving fault tolerance (cilium_tpu/serving runtime watchdog
+    # + degraded-mode ladder; the cilium-health / endpoint-
+    # regeneration analogue for the serving plane).  Validated at
+    # construction like the knobs above.
+    # per-batch dispatch deadline in ms; a dispatch exceeding it is
+    # declared hung, its rows counted as REASON_DISPATCH_TIMEOUT
+    # drops, and the drain loop restarted.  0 disables hang detection
+    serving_dispatch_deadline_ms: float = 1000.0
+    # how many drain-loop restarts the watchdog may spend before the
+    # runtime goes terminal (0 disables supervision entirely: a dead
+    # drain loop stays a visible corpse, the pre-PR3 behavior)
+    serving_restart_budget: int = 8
+    # initial restart backoff in ms (doubles per consecutive restart,
+    # capped at 1s; resets after a healthy interval)
+    serving_restart_backoff_ms: float = 10.0
+    # consecutive dispatch failures on one ladder rung before the
+    # serving session demotes (sharded -> single-chip -> wide); one
+    # success resets the streak
+    serving_demote_threshold: int = 3
+    # consecutive healthy batches before a degraded session promotes
+    # one rung back up...
+    serving_promote_after: int = 64
+    # ...and the minimum seconds since the last rung change (the
+    # hysteresis half: a flapping shard burns a full cooldown per
+    # re-promotion attempt)
+    serving_promote_cooldown_s: float = 5.0
+    # periodic CT snapshot cadence in seconds (0 = only on demotion /
+    # checkpoint): the last snapshot rides recovery paths where the
+    # live device CT is unreadable, so a loader rebuild keeps
+    # established flows
+    ct_snapshot_interval: float = 0.0
+    # deterministic fault injection (infra/faults.py spec string,
+    # e.g. "serving.dispatch=1x1~0.3"); armed process-global at
+    # construction, disarmed on shutdown.  For chaos testing — leave
+    # None in production
+    fault_injection: Optional[str] = None
+    fault_seed: int = 0
 
 
 class Daemon:
@@ -126,7 +163,8 @@ class Daemon:
         watch (reference: pkg/kvstore + pkg/allocator + clustermesh).
         Without it the daemon allocates locally."""
         from ..kvstore import ClusterIdentitySync, KVStoreAllocatorBackend
-        from ..serving import validate_serving_config
+        from ..serving import (validate_recovery_config,
+                               validate_serving_config)
 
         self.config = config or DaemonConfig()
         # serving knobs fail at CONSTRUCTION (config resolution hands
@@ -142,6 +180,35 @@ class Daemon:
             self.config.serving_bucket_ladder,
             self.config.serving_max_wait_us,
             self.config.serving_overflow_policy)
+        (self.config.serving_dispatch_deadline_ms,
+         self.config.serving_restart_budget,
+         self.config.serving_restart_backoff_ms,
+         self.config.serving_demote_threshold,
+         self.config.serving_promote_after,
+         self.config.serving_promote_cooldown_s
+         ) = validate_recovery_config(
+            self.config.serving_dispatch_deadline_ms,
+            self.config.serving_restart_budget,
+            self.config.serving_restart_backoff_ms,
+            self.config.serving_demote_threshold,
+            self.config.serving_promote_after,
+            self.config.serving_promote_cooldown_s)
+        if self.config.ct_snapshot_interval < 0:
+            raise ValueError("ct_snapshot_interval must be >= 0")
+        # deterministic fault injection (chaos testing): arm the
+        # process-global injector; spec typos fail here, not as a
+        # silently-inert chaos run.  shutdown() disarms what we armed
+        self._fault_injector = None
+        if self.config.fault_injection:
+            from ..infra import faults
+
+            self._fault_injector = faults.arm(
+                self.config.fault_injection,
+                seed=self.config.fault_seed)
+        # last CT snapshot (periodic controller / demotion / on
+        # demand): recovery paths restore from it when the live
+        # device CT is unreadable
+        self._ct_snap: Optional[dict] = None
         self.kvstore = kvstore if kvstore is not None else InMemoryKVStore()
         backend = None
         if kvstore is not None:
@@ -419,11 +486,26 @@ class Daemon:
         if self.health is not None:
             def _health_sweep():
                 self.node_registry.heartbeat(self.config.node_name)
+                # advertise the serving plane's fault state alongside
+                # reachability (reference: cilium-health carries more
+                # than liveness) — peers see a degraded/restarting
+                # node in their node info, not just "reachable"
+                self.node_registry.annotate(self.config.node_name,
+                                            self._node_fault_info())
                 self.health.probe_all()
 
             self.controllers.update(
                 "health-probe", _health_sweep,
                 self.config.health_probe_interval)
+        if self.config.ct_snapshot_interval > 0:
+            # periodic CT snapshots (the pinned-map persistence
+            # analogue, but in-memory + on a cadence): recovery and
+            # loader rebuilds restore established flows from the
+            # last one when the live CT is gone
+            self.controllers.update(
+                "ct-snapshot",
+                lambda: self.ct_snapshot_now(trigger="interval"),
+                self.config.ct_snapshot_interval)
         # endpoints whose identity allocation failed (kvstore outage)
         # retry here until they leave waiting-for-identity
         self.controllers.update(
@@ -456,6 +538,11 @@ class Daemon:
         if self.identity_sync is not None:
             self.identity_sync.close()
         self.allocator.close()
+        if self._fault_injector is not None:
+            from ..infra import faults
+
+            faults.disarm(self._fault_injector)
+            self._fault_injector = None
 
     def _now(self) -> int:
         return int(time.time() - self._boot_time) + 1
@@ -895,18 +982,36 @@ class Daemon:
         else:
             drainer = AsyncRingDrainer(ring_capacity,
                                        proxy_ports=table)
+        # the degraded-mode ladder (serving/ladder.py): rungs this
+        # session can actually run — no mesh, no "sharded" rung; no
+        # packing, no "single" rung; "wide" is always the floor
+        from ..serving.ladder import (FallbackLadder, RUNG_SHARDED,
+                                      RUNG_SINGLE, RUNG_WIDE)
+
+        rungs = ([RUNG_SHARDED] if mesh is not None else []) \
+            + ([RUNG_SINGLE] if packed else []) + [RUNG_WIDE]
+        cfg = self.config
         self._serving = {
             "drainer": drainer,
             "ring": drainer.fresh(),
             "table_dev": jnp.asarray(table) if len(table) else None,
+            "proxy_table": table,  # host copy: demotion rebuilds the
+            "ring_capacity": ring_capacity,  # drainer from these
             "trace_sample": trace_sample,
             "drain_every": drain_every,
             "seq": 0,
             "packed": bool(packed),
+            "packed_pref": bool(packed),  # survives wide demotion
             "mesh": mesh,
+            "mesh_pref": mesh,  # survives sharded demotion
             "n_shards": n_shards,
             "headroom": int(shard_headroom),
             "route_overflow": 0,
+            "ladder": FallbackLadder(
+                rungs,
+                demote_threshold=cfg.serving_demote_threshold,
+                promote_after=cfg.serving_promote_after,
+                cooldown_s=cfg.serving_promote_cooldown_s),
             # packed re-staging arena for the sharded path; depth
             # covers the event-join retention window below
             "route_arena": BucketArena(2 * drain_every + 2),
@@ -918,10 +1023,11 @@ class Daemon:
             from ..core.packets import N_COLS
             from ..serving import ServingRuntime
 
-            cfg = self.config
+            deadline_s = cfg.serving_dispatch_deadline_ms * 1e-3
             runtime = ServingRuntime(
                 dispatch=self._serving_dispatch,
                 on_shed=self._publish_sheds,
+                on_recovery_drop=self._publish_recovery_drops,
                 queue_depth=cfg.serving_queue_depth,
                 bucket_ladder=cfg.serving_bucket_ladder,
                 max_wait_us=cfg.serving_max_wait_us,
@@ -934,7 +1040,18 @@ class Daemon:
                 # arena slots outlive the daemon's event-join
                 # retention (2 * drain_every windows) — the ownership
                 # handoff contract in serving/batcher.py
-                arena_depth=2 * drain_every + 2)
+                arena_depth=2 * drain_every + 2,
+                # fault tolerance: watchdog deadline + restart budget
+                # from the serving_* knobs; the consumer-idle tick is
+                # DERIVED from the deadline so sub-50ms deadlines are
+                # honorable (a loop asleep in a 50ms wait cannot
+                # notice churn faster than the wait)
+                dispatch_deadline_s=deadline_s,
+                restart_budget=cfg.serving_restart_budget,
+                restart_backoff_s=cfg.serving_restart_backoff_ms
+                * 1e-3,
+                idle_wait_s=(min(0.05, deadline_s / 4)
+                             if deadline_s > 0 else 0.05))
             self._serving["runtime"] = runtime
             runtime.start()
 
@@ -948,11 +1065,186 @@ class Daemon:
 
         Wide batches keep the legacy 3-arg serve_batch call shape —
         tests (and operators) wrap serve_batch with spies that only
-        know (hdr, now, valid)."""
+        know (hdr, now, valid).
+
+        The DEGRADED-MODE LADDER wraps the device leg: a dispatch
+        failure counts toward the rung's demotion threshold; at the
+        threshold the session demotes (sharded -> single-chip ->
+        wide, CT carried via snapshot + restore) and the TRIGGERING
+        batch retries on the demoted rung — it has not been recorded
+        anywhere yet, so nothing double-counts.  Below the threshold
+        the failure is CONTAINED (DispatchFailedError): the runtime
+        accounts the batch as counted recovery drops and keeps the
+        loop alive.  At the ladder floor failures escalate raw —
+        burning the runtime's restart budget until terminal.
+        Sustained health re-promotes after the cooldown."""
+        from ..serving import DispatchFailedError
+
+        s = self._serving
+        try:
+            info = self._serving_device_leg(hdr, valid, packed_meta)
+        except Exception as e:  # noqa: BLE001 — any device-leg fault
+            lad = s.get("ladder")
+            if lad is None:
+                raise
+            cause = f"{type(e).__name__}: {e}"
+            if not lad.record_failure(cause):
+                if lad.at_floor:
+                    raise  # not containable: escalate to the watchdog
+                raise DispatchFailedError(
+                    f"dispatch failed on rung {lad.rung!r} "
+                    f"({lad.fail_streak}/{lad.demote_threshold}): "
+                    f"{cause}") from e
+            self._serving_demote(cause)
+            # retry the triggering batch on the demoted rung: a
+            # sharded-mode bucket is wide (the batcher never packs
+            # under a mesh), and a packed bucket demoting to wide
+            # unpacks host-side first
+            if packed_meta is not None and not s["packed"]:
+                from ..core.packets import unpack_rows_np
+
+                hdr = unpack_rows_np(np.asarray(hdr), *packed_meta)
+                packed_meta = None
+            info = self._serving_device_leg(hdr, valid, packed_meta)
+        lad = s.get("ladder")
+        if (lad is not None and lad.record_success()
+                and s.get("runtime") is not None):
+            self._serving_promote()
+        return info
+
+    def _serving_device_leg(self, hdr, valid, packed_meta):
         if packed_meta is None:
             return self.serve_batch(hdr, valid=valid)
         return self.serve_batch(hdr, valid=valid,
                                 packed_meta=packed_meta)
+
+    def _serving_demote(self, cause: str) -> None:
+        """One rung down (drain-thread context).  sharded -> single:
+        drain the per-chip rings, SNAPSHOT the (sharded) CT, rebuild
+        the single-device placement, and ct_restore the snapshot so
+        established flows survive — the endpoint-regeneration
+        discipline applied to the serving plane.  single -> wide:
+        stop packing (both the batcher and the per-batch eligibility
+        path)."""
+        import logging
+
+        s = self._serving
+        old = s["ladder"].rung
+        new = s["ladder"].demote()
+        logging.getLogger(__name__).warning(
+            "serving ladder demotes %s -> %s: %s", old, new, cause)
+        if old == "sharded":
+            from ..monitor.ring import AsyncRingDrainer
+
+            # flush what the per-chip rings already hold (best
+            # effort: the drainer's lost counter carries anything a
+            # wedged fetch abandons)
+            try:
+                self._collect_and_emit(s)
+                s["drainer"].swap(s["ring"])
+                self._collect_and_emit(s)
+            except Exception:  # noqa: BLE001
+                logging.getLogger(__name__).warning(
+                    "sharded ring drain failed during demotion; "
+                    "in-flight window events lost (counted)")
+            # CT continuity: snapshot (gathers every chip's private
+            # shard), unshard, restore into the single-device
+            # placement.  A wedged gather falls back to the last
+            # periodic snapshot rather than dropping all flows.
+            ct, fresh = None, False
+            try:
+                ct = self.loader.ct_snapshot()
+                fresh = True
+            except Exception:  # noqa: BLE001
+                if self._ct_snap is not None:
+                    ct = self._ct_snap["rows"]
+                    logging.getLogger(__name__).warning(
+                        "live CT unreadable during demotion; "
+                        "restoring the %.1fs-old periodic snapshot",
+                        time.time() - self._ct_snap["taken-at"])
+            self.loader.serving_unshard()
+            if ct is not None:
+                if fresh:
+                    # a STALE fallback keeps its original taken-at:
+                    # re-stamping it would zero the age every
+                    # telemetry surface reports and hide how old a
+                    # later restore really is
+                    self._store_ct_snapshot(ct, trigger="demotion")
+                self.loader.ct_restore(ct)
+            s["mesh"] = None
+            s["n_shards"] = 0
+            d = AsyncRingDrainer(s["ring_capacity"],
+                                 proxy_ports=s["proxy_table"])
+            s["drainer"] = d
+            s["ring"] = d.fresh()
+            s["window"].clear()
+        s["packed"] = (new == "single") and s["packed_pref"]
+        runtime = s.get("runtime")
+        if runtime is not None:
+            # single-chip rungs pack in the batcher; wide never does
+            runtime.batcher.pack = s["packed"] and s["mesh"] is None
+            # the demoted mode's executables compile on first
+            # dispatch — not a hang
+            runtime.reset_warm_shapes()
+
+    def _serving_promote(self) -> None:
+        """One rung back up after sustained health + cooldown
+        (drain-thread context).  wide -> single re-enables packing;
+        single -> sharded re-places the live state on the mesh and
+        swaps back to per-chip rings.  NOTE: re-sharding scatters CT
+        rows by position, not flow hash — flows whose entry lands on
+        a different chip than their flow route re-establish on their
+        next packet (counted as NEW, never dropped); demotion is the
+        direction that must be lossless, and is."""
+        import logging
+
+        s = self._serving
+        old = s["ladder"].rung
+        new = s["ladder"].promote()
+        logging.getLogger(__name__).info(
+            "serving ladder promotes %s -> %s", old, new)
+        if new == "sharded":
+            from ..monitor.ring import ShardedAsyncRingDrainer
+            from ..parallel import make_sharded_ring
+
+            mesh = s["mesh_pref"]
+            try:
+                self._collect_and_emit(s)
+                s["drainer"].swap(s["ring"])
+                self._collect_and_emit(s)
+            except Exception:  # noqa: BLE001
+                pass
+            self.loader.serving_shard(mesh)
+            s["mesh"] = mesh
+            s["n_shards"] = int(mesh.devices.size)
+            cap = s["ring_capacity"]
+            s["drainer"] = ShardedAsyncRingDrainer(
+                cap, s["n_shards"],
+                fresh_fn=lambda: make_sharded_ring(mesh, cap),
+                proxy_ports=s["proxy_table"])
+            s["ring"] = s["drainer"].fresh()
+            s["window"].clear()
+            s["packed"] = False
+        else:  # -> single
+            s["packed"] = s["packed_pref"]
+        runtime = s.get("runtime")
+        if runtime is not None:
+            runtime.batcher.pack = s["packed"] and s["mesh"] is None
+            runtime.reset_warm_shapes()
+
+    def _publish_recovery_drops(self, rows: Optional[np.ndarray],
+                                count: int, reason: int) -> None:
+        """Recovery-plane drops (dead/hung/failed dispatch, dead-loop
+        stop sweep) -> metricsmap + decoded monitor DROP events —
+        the same double surfacing REASON_ROUTE_OVERFLOW gets, so the
+        loss is visible both to counters and to flow consumers."""
+        from ..monitor.api import synth_drop_batch
+
+        self.loader.add_host_drops(reason, count)
+        if rows is None or not len(rows):
+            return
+        batch = synth_drop_batch(rows, reason, time.time())
+        self.monitor.publish(self._filter_events(batch))
 
     def _publish_sheds(self, rows: Optional[np.ndarray],
                        count: int) -> None:
@@ -983,8 +1275,76 @@ class Daemon:
                 "call start_serving(ingress=True) first")
         return runtime.submit(rows, t)
 
+    # -- CT snapshots (periodic + on-demotion + on-demand) -------------
+    def ct_snapshot_now(self, trigger: str = "manual") -> dict:
+        """Take and retain a CT snapshot (dense portable rows).  The
+        retained copy rides recovery paths — a demotion or loader
+        rebuild whose live CT is unreadable restores from it instead
+        of dropping every established flow."""
+        rows = self.loader.ct_snapshot()
+        return self._store_ct_snapshot(rows, trigger)
+
+    def _store_ct_snapshot(self, rows: np.ndarray,
+                           trigger: str) -> dict:
+        s = self._serving
+        lad = s.get("ladder") if s is not None else None
+        self._ct_snap = {
+            "rows": np.array(rows, copy=True),
+            "taken-at": time.time(),
+            "trigger": trigger,
+            "mode": lad.rung if lad is not None else "offline",
+            "revision": self.repo.revision,
+        }
+        return self.ct_snapshot_info()
+
+    def ct_snapshot_info(self) -> Optional[dict]:
+        """Metadata of the retained CT snapshot (None before the
+        first one) — surfaced via serving stats / status /
+        prometheus so operators can see how stale a recovery
+        restore would be."""
+        snap = self._ct_snap
+        if snap is None:
+            return None
+        return {
+            "age-seconds": round(time.time() - snap["taken-at"], 3),
+            "entries": int(len(snap["rows"])),
+            "trigger": snap["trigger"],
+            "mode": snap["mode"],
+            "revision": snap["revision"],
+        }
+
+    def restore_ct_snapshot(self) -> bool:
+        """Restore the retained snapshot into the live loader (the
+        recovery entry for an operator-driven or rebuild-driven CT
+        reload).  False when no snapshot has been taken."""
+        if self._ct_snap is None:
+            return False
+        self.loader.ct_restore(self._ct_snap["rows"])
+        return True
+
+    def _node_fault_info(self) -> dict:
+        """The serving fault state advertised in the node registry
+        (health plane): enough for a peer (or operator sweep) to see
+        a degraded/restarting node without scraping its API."""
+        out = {}
+        s = self._serving
+        if s is not None:
+            lad = s.get("ladder")
+            if lad is not None:
+                out["serving-mode"] = lad.rung
+                out["serving-degraded"] = lad.degraded
+            runtime = s.get("runtime")
+            if runtime is not None:
+                out["serving-restarts"] = runtime.stats.restarts
+        snap = self.ct_snapshot_info()
+        if snap is not None:
+            out["ct-snapshot-age-seconds"] = snap["age-seconds"]
+        return out
+
     def serving_stats(self) -> dict:
-        """GET /serving — front-end telemetry + ring-drain counters."""
+        """GET /serving — front-end telemetry + ring-drain counters +
+        the fault-tolerance plane (mode/ladder, restarts, recovery
+        drops, CT-snapshot age)."""
         s = self._serving
         if s is None:
             return {"active": False}
@@ -995,9 +1355,16 @@ class Daemon:
         if s["n_shards"]:
             out["shards"] = s["n_shards"]
             out["route-overflow"] = s["route_overflow"]
+        lad = s.get("ladder")
+        if lad is not None:
+            out["mode"] = lad.rung
+            out["ladder"] = lad.to_dict()
         runtime = s.get("runtime")
         if runtime is not None:
             out.update(runtime.snapshot())
+        snap = self.ct_snapshot_info()
+        if snap is not None:
+            out["ct-snapshot"] = snap
         return out
 
     def serve_batch(self, hdr: np.ndarray,
@@ -1201,6 +1568,9 @@ class Daemon:
         if s["n_shards"]:
             out["shards"] = s["n_shards"]
             out["route-overflow"] = s["route_overflow"]
+        lad = s.get("ladder")
+        if lad is not None and (lad.demotions or lad.promotions):
+            out["ladder"] = lad.to_dict()
         if front is not None:
             out["front-end"] = front
         return out
@@ -1438,6 +1808,10 @@ class Daemon:
                 for n, s in self.controllers.statuses().items()},
             **({"cluster-health": self.health.to_dict()}
                if self.health is not None else {}),
+            **({"serving": {
+                k: v for k, v in self._node_fault_info().items()}}
+               if (self._serving is not None
+                   or self._ct_snap is not None) else {}),
             **({"clustermesh": mesh} if mesh else {}),
             **({"nat": nat_st} if (nat_st := (
                 self.loader.nat_status(self._now())
@@ -1500,6 +1874,7 @@ class Daemon:
         # restore time by the revision mismatch and the snapshot is
         # skipped.
         ct = self.loader.ct_snapshot()
+        self._store_ct_snapshot(ct, trigger="checkpoint")
         ct_tmp = os.path.join(state_dir, "ct.npz.tmp")
         extra = {}
         nat = getattr(self.loader, "nat_snapshot", lambda: None)()

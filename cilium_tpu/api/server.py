@@ -400,6 +400,36 @@ def _metrics_text(daemon: Daemon) -> str:
                      "counter")
         lines.append(f"cilium_serving_route_overflow_total "
                      f"{sv['route-overflow']}")
+    # fault-tolerance plane: restarts, recovery drops, degraded mode
+    ft = sv.get("fault-tolerance") if sv.get("active") else None
+    if ft:
+        lines.append("# TYPE cilium_serving_restarts_total counter")
+        lines.append(f"cilium_serving_restarts_total "
+                     f"{ft['restarts']}")
+        lines.append("# TYPE cilium_serving_dispatch_timeouts_total "
+                     "counter")
+        lines.append(f"cilium_serving_dispatch_timeouts_total "
+                     f"{ft['dispatch-timeouts']}")
+        lines.append("# TYPE cilium_serving_recovery_dropped_total "
+                     "counter")
+        lines.append(f"cilium_serving_recovery_dropped_total "
+                     f"{ft['recovery-dropped']}")
+    if sv.get("active") and sv.get("ladder"):
+        lad = sv["ladder"]
+        lines.append("# TYPE cilium_serving_degraded gauge")
+        lines.append(f'cilium_serving_degraded'
+                     f'{{mode="{lad["rung"]}"}} '
+                     f'{1 if lad["degraded"] else 0}')
+        lines.append("# TYPE cilium_serving_demotions_total counter")
+        lines.append(f"cilium_serving_demotions_total "
+                     f"{lad['demotions']}")
+    snap = daemon.ct_snapshot_info()
+    if snap is not None:
+        lines.append("# TYPE cilium_ct_snapshot_age_seconds gauge")
+        lines.append(f"cilium_ct_snapshot_age_seconds "
+                     f"{snap['age-seconds']}")
+        lines.append("# TYPE cilium_ct_snapshot_entries gauge")
+        lines.append(f"cilium_ct_snapshot_entries {snap['entries']}")
     return "\n".join(lines) + "\n" + daemon.flow_metrics.render()
 
 

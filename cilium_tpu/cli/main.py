@@ -418,6 +418,27 @@ def cmd_serving(args) -> int:
                     print(f"Shards:    {st['shards']} chips, "
                           f"route-overflow "
                           f"{st.get('route-overflow', 0)}")
+                ft = st.get("fault-tolerance") or {}
+                if ft.get("supervised"):
+                    lad = st.get("ladder") or {}
+                    mode = st.get("mode", "?")
+                    flag = (" DEGRADED" if lad.get("degraded")
+                            else "")
+                    print(f"Fault-tol: mode={mode}{flag}, restarts "
+                          f"{ft.get('restarts', 0)}/"
+                          f"{ft.get('restart-budget', 0)}, "
+                          f"recovery-dropped "
+                          f"{ft.get('recovery-dropped', 0)} "
+                          f"({ft.get('dispatch-timeouts', 0)} "
+                          f"deadline hits), demotions "
+                          f"{lad.get('demotions', 0)}")
+                snap = st.get("ct-snapshot")
+                if snap:
+                    print(f"CT-snap:   {snap.get('entries', 0)} "
+                          f"entries, age "
+                          f"{snap.get('age-seconds', 0)}s "
+                          f"({snap.get('trigger')}, "
+                          f"mode {snap.get('mode')})")
                 for name, key in (("Queue-wait", "queue-wait-us"),
                                   ("Latency", "latency-us")):
                     h = st.get(key) or {}
@@ -473,6 +494,11 @@ def cmd_daemon(args) -> int:
         "serving_max_wait_us": args.serving_max_wait_us,
         "serving_overflow_policy": args.serving_overflow_policy,
         "serving_packed_ingest": args.serving_packed_ingest,
+        "serving_dispatch_deadline_ms":
+            args.serving_dispatch_deadline_ms,
+        "serving_restart_budget": args.serving_restart_budget,
+        "ct_snapshot_interval": args.ct_snapshot_interval,
+        "fault_injection": args.fault_injection,
     }.items() if v is not None}
     cfg = load_config(config_dir=args.config_dir, **overrides)
     d = Daemon(cfg)
@@ -638,6 +664,28 @@ def main(argv=None) -> int:
                         "fewer bytes than wide rows; IPv6/mixed "
                         "streams fall back to wide per batch); "
                         "'false' overrides a config-dir/env true")
+    p.add_argument("--serving-dispatch-deadline-ms", type=float,
+                   default=None,
+                   help="per-batch dispatch deadline in ms (default "
+                        "1000): a dispatch exceeding it is declared "
+                        "hung, its rows counted as DISPATCH_TIMEOUT "
+                        "drops, and the drain loop restarted; 0 "
+                        "disables hang detection")
+    p.add_argument("--serving-restart-budget", type=int, default=None,
+                   help="drain-loop restarts the serving watchdog "
+                        "may spend before going terminal (default "
+                        "8; 0 disables supervision)")
+    p.add_argument("--ct-snapshot-interval", type=float, default=None,
+                   help="periodic CT snapshot cadence in seconds "
+                        "(default 0 = only on demotion/checkpoint); "
+                        "recovery restores established flows from "
+                        "the last snapshot when the live CT is "
+                        "unreadable")
+    p.add_argument("--fault-injection", default=None,
+                   help="deterministic fault-injection spec "
+                        "(infra/faults.py), e.g. "
+                        "'serving.dispatch=1x1~0.3'; chaos testing "
+                        "only")
 
     args = parser.parse_args(argv)
     if args.cmd == "version":

@@ -143,6 +143,10 @@ class TPULoader(Loader):
         # stall behind a host->device copy, and host assembly of
         # batch N+1 overlaps device execution of batch N.
         self._lock = make_lock("datapath-loader")
+        # host-drop counts awaiting a free dispatch lock (see
+        # add_host_drops: the watchdog must never block on _lock)
+        self._host_drops: Dict[int, int] = {}
+        self._host_drops_lock = make_lock("loader-host-drops")
         # multi-chip serving (parallel/mesh.py): serving_shard()
         # installs the mesh and re-places state (CT sharded per chip,
         # tables replicated); sharded serve steps are cached per
@@ -293,8 +297,10 @@ class TPULoader(Loader):
         ``valid`` ([N] bool, optional) masks the adaptive batcher's
         padding rows: masked rows touch neither CT, metrics, nor the
         event ring, so one bucket size stays one compiled shape."""
+        from ..infra import faults
         from ..monitor.ring import serve_step_jit
 
+        faults.check(faults.SITE_LOADER_SERVE)
         jnp = self._jnp
         # staging before the lock: only the async dispatch is
         # serialized (lock discipline in __init__)
@@ -322,8 +328,10 @@ class TPULoader(Loader):
         ``dirn`` are per-batch stream metadata scalars;  ``valid``
         masks the adaptive batcher's padding rows exactly like the
         wide path, so each bucket size stays one compiled shape."""
+        from ..infra import faults
         from ..monitor.ring import serve_step_packed_jit
 
+        faults.check(faults.SITE_LOADER_SERVE_PACKED)
         jnp = self._jnp
         if isinstance(packed, np.ndarray):
             packed = jnp.asarray(np.ascontiguousarray(packed))
@@ -383,8 +391,13 @@ class TPULoader(Loader):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from ..infra import faults
         from ..parallel.mesh import make_sharded_serve_step
 
+        # the shard-unavailable failure mode: a chip dropping off the
+        # mesh surfaces as the sharded dispatch raising — exactly
+        # where the degraded-mode ladder catches it
+        faults.check(faults.SITE_LOADER_SERVE_SHARDED)
         jnp = self._jnp
         mesh = self._serving_mesh
         assert mesh is not None, "serving_shard(mesh) first"
@@ -426,12 +439,49 @@ class TPULoader(Loader):
         """Account host-side flow-router overflow in the device
         metricsmap (REASON_ROUTE_OVERFLOW) — the RSS-queue-overflow
         counter; sharding-preserving (.at on the replicated array)."""
-        from ..parallel.mesh import add_route_overflow
+        from .verdict import REASON_ROUTE_OVERFLOW
 
+        self.add_host_drops(REASON_ROUTE_OVERFLOW, n)
+
+    def add_host_drops(self, reason: int, n: int) -> None:
+        """Account host-side drops under ``reason`` in the device
+        metricsmap — the serving recovery plane's counterpart of
+        :meth:`add_route_overflow`: batches lost to a dead/hung
+        dispatch (REASON_DISPATCH_TIMEOUT / REASON_RECOVERY_DROP)
+        must show up where operators look, exactly like datapath
+        drops.
+
+        NEVER BLOCKS on the dispatch lock: the caller may be the
+        serving WATCHDOG accounting a dispatch that is hung INSIDE
+        that very lock — waiting here would deadlock recovery
+        against the wedge it is recovering from.  When the lock is
+        busy the count lands in a host-side pending buffer that
+        :meth:`metrics` folds into every read and later calls flush
+        opportunistically, so totals are exact either way."""
         if n == 0:
             return
-        with self._lock:
-            self.state = add_route_overflow(self.state, int(n))
+        r = int(reason)
+        with self._host_drops_lock:
+            self._host_drops[r] = self._host_drops.get(r, 0) + int(n)
+        self._flush_host_drops()
+
+    def _flush_host_drops(self) -> None:
+        """Move pending host-drop counts into the device metricsmap
+        if the dispatch lock is free RIGHT NOW (non-blocking)."""
+        from ..parallel.mesh import add_host_drops
+
+        if not self._lock.acquire(blocking=False):
+            return
+        try:
+            with self._host_drops_lock:
+                pending = self._host_drops
+                if not pending:
+                    return
+                self._host_drops = {}
+            for reason, n in pending.items():
+                self.state = add_host_drops(self.state, reason, n)
+        finally:
+            self._lock.release()
 
     def masquerade(self, nat, hdr, now: int):
         """CT-aware egress SNAT with port allocation (service/nat.py
@@ -667,7 +717,13 @@ class TPULoader(Loader):
 
     def metrics(self) -> np.ndarray:
         with self._lock:
-            return np.asarray(self.state.metrics)
+            out = np.array(np.asarray(self.state.metrics))
+        # fold in host drops still awaiting a lock-free flush (NOT
+        # zeroed here — display-only add keeps flush idempotent)
+        with self._host_drops_lock:
+            for reason, n in self._host_drops.items():
+                out[reason, 0] += n
+        return out
 
     def ct_snapshot(self) -> np.ndarray:
         """Dense live rows — the canonical (placement-free) snapshot
@@ -1004,6 +1060,12 @@ class InterpreterLoader(Loader):
                 e for e in self.oracle.ipcache if e[:3] != key]
         self.oracle._lpm_memo.clear()
         return True
+
+    def add_host_drops(self, reason: int, n: int) -> None:
+        """Host-side drop accounting (ingress column), mirroring
+        :meth:`TPULoader.add_host_drops`."""
+        if n:
+            self._metrics[int(reason), 0] += int(n)
 
     def metrics(self) -> np.ndarray:
         return self._metrics.copy()

@@ -71,7 +71,16 @@ REASON_AUTH_REQUIRED = 8  # policy allows, mutual auth missing (pkg/auth)
 # table — monitor, flow layer, ring wire format (4-bit field) — names
 # it like any datapath drop.
 REASON_INGRESS_OVERFLOW = 9
-N_REASONS = 10
+# serving fault recovery (host-synthesized, like INGRESS_OVERFLOW):
+# the dispatch watchdog deadlined a hung device dispatch and dropped
+# its in-flight batch...
+REASON_DISPATCH_TIMEOUT = 10
+# ...or a dead/failed dispatch's rows (and any rows still queued when
+# a dead drain loop stops) were accounted by the recovery supervisor
+# instead of silently vanishing — admitted traffic is ALWAYS one of
+# completed / shed / recovery-dropped (serving/runtime.py invariant)
+REASON_RECOVERY_DROP = 11
+N_REASONS = 12
 
 # Event types in the out tensor (monitor vocabulary).
 EV_TRACE = 0  # TraceNotify: forwarded established/reply traffic

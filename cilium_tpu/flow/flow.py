@@ -43,6 +43,8 @@ DROP_REASON_DESC = {
     7: "NO_SERVICE",  # frontend with no backend (DROP_NO_SERVICE)
     8: "AUTH_REQUIRED",  # mutual auth missing (pkg/auth)
     9: "INGRESS_QUEUE_OVERFLOW",  # serving admission shed (XDP ring)
+    10: "DISPATCH_TIMEOUT",  # serving watchdog deadlined a hung dispatch
+    11: "RECOVERY_DROP",  # serving recovery accounted a lost batch
 }
 
 

@@ -39,6 +39,24 @@ class NodeRegistry:
         if self.lease_ttl:
             self.kv.keepalive(f"{NODES_PREFIX}/{name}", self.lease_ttl)
 
+    def annotate(self, name: str, extra: dict) -> None:
+        """Merge ``extra`` keys into the node's advertised info (a
+        re-register preserving existing keys).  The serving plane's
+        fault state rides here — mode, restarts, CT-snapshot age —
+        so `cilium-health`-style consumers see a DEGRADED node, not
+        just a reachable one.  No-op keys-wise for an unregistered
+        node (it becomes a registration)."""
+        if not extra:
+            return
+        cur = {}
+        raw = self.kv.get(f"{NODES_PREFIX}/{name}")
+        if raw:
+            cur = json.loads(raw)
+        self.kv.update(f"{NODES_PREFIX}/{name}",
+                       json.dumps({"name": name, **cur,
+                                   **extra}).encode(),
+                       lease_ttl=self.lease_ttl)
+
     def unregister(self, name: str) -> None:
         self.kv.delete(f"{NODES_PREFIX}/{name}")
 

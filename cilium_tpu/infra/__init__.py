@@ -2,8 +2,10 @@
 
 Reference: ``pkg/controller`` (named retry loops with backoff, surfaced
 in ``cilium status``), ``pkg/trigger`` (debounced triggers serializing
-expensive work like endpoint regeneration).
+expensive work like endpoint regeneration), plus the datapath fault
+injector (``faults``) the chaos suite drives the serving plane with.
 """
 
 from .controller import Controller, ControllerManager  # noqa: F401
+from .faults import FaultInjector, InjectedFault  # noqa: F401
 from .trigger import Trigger  # noqa: F401

@@ -67,6 +67,8 @@ DROP_REASON_NAMES = {
     7: "No service backend",  # frontend with no backend
     8: "Authentication required",  # mutual auth missing (pkg/auth)
     9: "Ingress queue overflow",  # serving admission shed (XDP ring)
+    10: "Dispatch deadline exceeded",  # watchdog deadlined a hung dispatch
+    11: "Recovery drop",  # serving recovery accounted a lost batch
 }
 
 

@@ -44,6 +44,12 @@ from ..datapath.verdict import EV_TRACE, N_OUT, OUT_EVENT
 #   w0: verdict(0..2) | event(3..4) | reason(5..8) | ct(9..11)
 #       | proxy_idx(12..15) | id_row(16..31)
 #   w1: pkt_idx(0..18) | batch(19..31, wraps)
+# The 4-bit reason field holds codes 0..15.  N_REASONS is 12 —
+# REASON_DISPATCH_TIMEOUT (10) and REASON_RECOVERY_DROP (11) are
+# RESERVED for the serving recovery plane (host-synthesized, so they
+# never transit this ring today, but the wire width must cover them:
+# a drained row's reason decodes through the same DROP_REASON_NAMES
+# table).  4 codes (12..15) remain before the field must widen.
 # Limits (asserted where they bind): id_row < 2^16, pkt_idx < 2^19
 # (batches up to 512k rows), batch seq wraps at 2^13, <= 15 live
 # proxy listeners.  Empty slots carry event bits 0b11 (no EV_* code
@@ -250,6 +256,9 @@ class AsyncRingDrainer:
         same queue in milliseconds (blocking on the large buffer
         triggers the slow path itself — sync on the scalar, then the
         copies only move bytes)."""
+        from ..infra import faults
+
+        faults.check(faults.SITE_RING_SWAP)
         assert self._pending is None, "previous window not collected"
         ring.cursor.block_until_ready()
         ring.buf.copy_to_host_async()
@@ -260,6 +269,9 @@ class AsyncRingDrainer:
     def collect(self) -> Tuple[np.ndarray, int, int]:
         """Complete the in-flight fetch -> (rows, appended, lost) for
         that window (empty result when nothing is pending)."""
+        from ..infra import faults
+
+        faults.check(faults.SITE_RING_COLLECT)
         ring = self._pending
         if ring is None:
             return np.zeros((0, RING_COLS), dtype=np.uint32), 0, 0
@@ -388,6 +400,9 @@ class ShardedAsyncRingDrainer:
         """Same cursor-first sync discipline as the single-chip
         drainer (see AsyncRingDrainer.swap): block on the small
         cursor, then the buffer bytes stream in the background."""
+        from ..infra import faults
+
+        faults.check(faults.SITE_RING_SWAP)
         assert self._pending is None, "previous window not collected"
         ring.cursor.block_until_ready()
         ring.buf.copy_to_host_async()
@@ -396,6 +411,9 @@ class ShardedAsyncRingDrainer:
         return self.fresh()
 
     def collect(self) -> Tuple[np.ndarray, np.ndarray, int, int]:
+        from ..infra import faults
+
+        faults.check(faults.SITE_RING_COLLECT)
         ring = self._pending
         if ring is None:
             return (np.zeros((0, RING_COLS), dtype=np.uint32),

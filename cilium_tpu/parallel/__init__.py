@@ -13,12 +13,13 @@ directions of a flow land on the same shard.
 """
 
 from .mesh import (  # noqa: F401
+    add_host_drops,
+    add_route_overflow,
     flow_shard_ids,
     make_mesh,
     make_sharded_ring,
     make_sharded_serve_step,
     make_sharded_step,
-    add_route_overflow,
     route_by_flow,
     shard_state,
 )

@@ -144,18 +144,27 @@ def route_by_flow(data: np.ndarray, n_shards: int,
     return routed, valid, orig, n_overflow
 
 
-def add_route_overflow(state: DatapathState, n: int) -> DatapathState:
-    """Account host-side router overflow drops in the device metricsmap
-    (REASON_ROUTE_OVERFLOW, ingress column) so the loss is visible to
-    operators exactly like CT map-pressure drops."""
-    from ..datapath.verdict import REASON_ROUTE_OVERFLOW
-
+def add_host_drops(state: DatapathState, reason: int,
+                   n: int) -> DatapathState:
+    """Account host-side drops in the device metricsmap (ingress
+    column) so the loss is visible to operators exactly like CT
+    map-pressure drops.  Used for every drop class that never reaches
+    the device: flow-router overflow (REASON_ROUTE_OVERFLOW), and the
+    serving recovery plane's lost batches (REASON_DISPATCH_TIMEOUT /
+    REASON_RECOVERY_DROP).  Sharding-preserving (.at on the
+    replicated array)."""
     if n == 0:
         return state
-    metrics = state.metrics.at[REASON_ROUTE_OVERFLOW, 0].add(
-        jnp.uint32(n))
+    metrics = state.metrics.at[int(reason), 0].add(jnp.uint32(n))
     return DatapathState(policy=state.policy, ipcache=state.ipcache,
                          ct=state.ct, metrics=metrics)
+
+
+def add_route_overflow(state: DatapathState, n: int) -> DatapathState:
+    """RSS-queue-overflow accounting: see :func:`add_host_drops`."""
+    from ..datapath.verdict import REASON_ROUTE_OVERFLOW
+
+    return add_host_drops(state, REASON_ROUTE_OVERFLOW, n)
 
 
 def shard_state(state: DatapathState, mesh: Mesh,

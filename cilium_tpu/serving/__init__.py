@@ -49,6 +49,16 @@ class ServingBackendError(ServingError):
     has no device event ring)."""
 
 
+class DispatchFailedError(ServingError):
+    """A dispatch callable's device leg failed in a CONTAINED way (the
+    degraded-mode ladder saw the failure, counted it toward its
+    demotion threshold, and did not — or could not yet — demote).  The
+    drain runtime accounts the batch's rows as recovery drops
+    (``REASON_RECOVERY_DROP``, counted + surfaced as DROP events) and
+    KEEPS THE LOOP ALIVE: no thread death, no restart burned.  Wrap
+    the original exception as ``__cause__``."""
+
+
 def validate_serving_config(queue_depth: int, bucket_ladder,
                             max_wait_us, overflow_policy: str) -> tuple:
     """Validate the DaemonConfig serving knobs; returns the normalized
@@ -85,14 +95,50 @@ def validate_serving_config(queue_depth: int, bucket_ladder,
     return depth, ladder, wait, overflow_policy
 
 
+def validate_recovery_config(dispatch_deadline_ms, restart_budget,
+                             restart_backoff_ms, demote_threshold,
+                             promote_after,
+                             promote_cooldown_s) -> tuple:
+    """Validate the fault-tolerance knobs; returns the normalized
+    tuple.  Same contract as :func:`validate_serving_config`: a bad
+    knob fails at daemon construction with an actionable message, not
+    as a watchdog that silently never fires under load."""
+    deadline = float(dispatch_deadline_ms)
+    if deadline < 0:
+        raise ValueError("serving_dispatch_deadline_ms must be >= 0 "
+                         "(0 disables hang detection)")
+    budget = int(restart_budget)
+    if budget < 0:
+        raise ValueError("serving_restart_budget must be >= 0 "
+                         "(0 disables the recovery supervisor)")
+    backoff = float(restart_backoff_ms)
+    if backoff < 0:
+        raise ValueError("serving_restart_backoff_ms must be >= 0")
+    demote = int(demote_threshold)
+    if demote < 1:
+        raise ValueError("serving_demote_threshold must be >= 1 "
+                         "(consecutive dispatch failures per rung)")
+    promote = int(promote_after)
+    if promote < 1:
+        raise ValueError("serving_promote_after must be >= 1 "
+                         "(consecutive healthy batches)")
+    cooldown = float(promote_cooldown_s)
+    if cooldown < 0:
+        raise ValueError("serving_promote_cooldown_s must be >= 0")
+    return deadline, budget, backoff, demote, promote, cooldown
+
+
 from .batcher import AdaptiveBatcher, BucketArena  # noqa: E402
 from .ingress import IngressQueue  # noqa: E402
+from .ladder import FallbackLadder  # noqa: E402
 from .runtime import ServingRuntime  # noqa: E402
 from .stats import LatencyHistogram, ServingStats  # noqa: E402
 
 __all__ = [
     "AdaptiveBatcher",
     "BucketArena",
+    "DispatchFailedError",
+    "FallbackLadder",
     "IngressQueue",
     "LatencyHistogram",
     "ServingError",
@@ -101,5 +147,6 @@ __all__ = [
     "ServingNotStartedError",
     "ServingRuntime",
     "ServingStats",
+    "validate_recovery_config",
     "validate_serving_config",
 ]

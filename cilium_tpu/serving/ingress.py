@@ -176,24 +176,39 @@ class IngressQueue:
         ``out`` (the batcher's staging arena): one vectorized memcpy
         per chunk, no intermediate concatenate — the zero-copy half of
         batch assembly.  Returns ``(n, arrivals)``; ``out[:n]`` holds
-        the rows, everything past ``n`` is untouched."""
+        the rows, everything past ``n`` is untouched.
+
+        EXCEPTION-ATOMIC: all copies land before ANY chunk is popped
+        (copy first, commit after), so a memcpy fault mid-dequeue —
+        the ``serving.queue.take`` injection site, or a real staging
+        failure — leaves every row still queued.  A dead drain thread
+        then loses nothing: its restart (or the stop-path recovery
+        sweep) finds the rows where they were."""
+        from ..infra import faults
+
         n = len(out)
         arrivals: List[Tuple[int, float]] = []
         got = 0
         with self._lock:
-            while got < n and self._chunks:
+            # copy phase: nothing is mutated; a raise here (injected
+            # or organic) aborts with the queue intact
+            plan: List[int] = []
+            for rows, t in self._chunks:
+                if got >= n:
+                    break
+                faults.check(faults.SITE_QUEUE_TAKE)
+                take = min(len(rows), n - got)
+                out[got:got + take] = rows[:take]
+                arrivals.append((take, t))
+                plan.append(take)
+                got += take
+            # commit phase: pure pointer moves, cannot fail
+            for take in plan:
                 rows, t = self._chunks[0]
-                want = n - got
-                if len(rows) <= want:
+                if take == len(rows):
                     self._chunks.popleft()
-                    out[got:got + len(rows)] = rows
-                    arrivals.append((len(rows), t))
-                    got += len(rows)
                 else:
-                    out[got:got + want] = rows[:want]
-                    self._chunks[0] = (rows[want:], t)
-                    arrivals.append((want, t))
-                    got += want
+                    self._chunks[0] = (rows[take:], t)
             self._pending -= got
         return got, arrivals
 

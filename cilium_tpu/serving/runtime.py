@@ -19,6 +19,36 @@ The loop owns all dispatch: ``submit()`` (any thread) only offers
 rows to the bounded ingress queue, which is the backpressure point —
 overflow sheds by policy, sheds surface through ``on_shed`` as
 monitor DROP events, and nothing ever blocks the producer.
+
+Fault tolerance (the cilium-health / endpoint-regeneration analogue
+for the serving plane): with ``restart_budget > 0`` a WATCHDOG thread
+supervises the drain loop —
+
+- a DEAD drain thread (any uncaught exception) is restarted with
+  exponential backoff, its in-flight batch accounted as counted
+  recovery drops (``REASON_RECOVERY_DROP``);
+- a HUNG dispatch is deadlined (``dispatch_deadline_s``): the wedged
+  generation is ABANDONED (a bumped generation counter makes the old
+  thread exit without dispatching or double-recording when it ever
+  wakes), its batch accounted as ``REASON_DISPATCH_TIMEOUT`` drops,
+  and a fresh drain thread takes over.  A REAL hang inside a device
+  call cannot be cancelled from Python — if it eventually completes,
+  its device side effects land but its host accounting is discarded
+  (the restart budget bounds how often this can happen);
+- a dispatch that raises :class:`~..serving.DispatchFailedError`
+  (the degraded-mode ladder's "contained failure") costs neither a
+  thread death nor a restart: the batch's rows become recovery drops
+  and the loop continues;
+- the restart budget caps recovery: once exhausted the runtime goes
+  TERMINAL (submit() raises, the error rides every snapshot) —
+  exactly the pre-watchdog corpse, but only after the budget proved
+  the fault persistent.
+
+The no-silent-loss ledger holds throughout:
+``submitted == verdicts + shed + recovery_dropped`` after a drained
+stop, with every recovery drop ALSO surfaced as a decoded monitor
+DROP event via ``on_recovery_drop`` (retention-bounded, counter
+exact) — the same contract admission sheds have.
 """
 
 from __future__ import annotations
@@ -30,6 +60,7 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from . import ServingAlreadyActiveError, validate_serving_config
+from ..infra import faults
 from .batcher import AdaptiveBatcher, AssembledBatch
 from .ingress import IngressQueue
 from .stats import ServingStats
@@ -43,11 +74,21 @@ from .stats import ServingStats
 DispatchFn = Callable[[np.ndarray, np.ndarray, int], Optional[dict]]
 # on_shed(retained header rows or None, exact shed count) -> None
 ShedFn = Callable[[Optional[np.ndarray], int], None]
+# on_recovery_drop(wide rows or None, exact count, REASON_*) -> None:
+# the recovery plane's event + metricsmap surfacing (rows may be
+# fewer than count when a lost batch could not be reconstructed)
+RecoveryFn = Callable[[Optional[np.ndarray], int, int], None]
 
 # idle wait granularity: how long the loop sleeps when rows are
 # pending but neither bucket-full nor deadline has fired yet.  Small
 # enough that a max-wait deadline is honored within ~1ms.
 _TICK_S = 0.001
+# default consumer-idle wait (queue empty).  Overridable per runtime:
+# the daemon derives it from the dispatch deadline so watchdog
+# deadlines shorter than this are actually honorable — a loop asleep
+# in a 50ms wait cannot notice stop/generation churn any faster.
+DEFAULT_IDLE_WAIT_S = 0.05
+_BACKOFF_CAP_S = 1.0
 
 
 class ServingRuntime:
@@ -63,7 +104,12 @@ class ServingRuntime:
                  on_shed: Optional[ShedFn] = None,
                  expected_cols: Optional[int] = None,
                  pack: bool = False,
-                 arena_depth: Optional[int] = None):
+                 arena_depth: Optional[int] = None,
+                 dispatch_deadline_s: float = 0.0,
+                 restart_budget: int = 0,
+                 restart_backoff_s: float = 0.01,
+                 idle_wait_s: float = DEFAULT_IDLE_WAIT_S,
+                 on_recovery_drop: Optional[RecoveryFn] = None):
         from .batcher import DEFAULT_ARENA_DEPTH
 
         depth, ladder, wait, policy = validate_serving_config(
@@ -79,17 +125,47 @@ class ServingRuntime:
         self.stats = ServingStats()
         self._dispatch = dispatch
         self._on_shed = on_shed
+        self._on_recovery_drop = on_recovery_drop
         # row width the datapath expects (N_COLS): a malformed chunk
         # must bounce off submit() with a ValueError, not detonate
         # inside the drain thread batches later
         self._expected_cols = expected_cols
-        self._error: Optional[str] = None  # terminal drain-loop fault
+        # fault-tolerance knobs (module doc): budget 0 = unsupervised
+        # (legacy: a dead loop is a terminal, visible corpse)
+        self._deadline_s = max(float(dispatch_deadline_s), 0.0)
+        self._budget = max(int(restart_budget), 0)
+        self._backoff_s = max(float(restart_backoff_s), 0.0)
+        self._idle_wait_s = max(float(idle_wait_s), _TICK_S)
+        self._supervised = self._budget > 0
+        self._error: Optional[str] = None  # drain-loop fault (the
+        # watchdog clears it on recovery; terminal once the budget is
+        # exhausted or when unsupervised)
         self._stop = threading.Event()
         # serializes submit() against stop()'s final drain: a chunk
         # offered after the drain swept the queue would sit there
         # forever — neither dispatched nor shed-counted
         self._submit_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
+        # recovery bookkeeping, guarded by _rec_lock: the drain-thread
+        # GENERATION (an abandoned generation exits without touching
+        # stats), the IN-FLIGHT batch (registered before the device
+        # leg so a death/hang between "rows left the queue" and "stats
+        # recorded" can always be accounted), and the restart count.
+        self._rec_lock = threading.Lock()
+        self._gen = 0
+        # (gen, t0, batch, deadline_exempt, warm_gen)
+        self._inflight: Optional[tuple] = None
+        # shapes that completed a dispatch: the FIRST dispatch of a
+        # (bucket, format) pays its XLA compile — unbounded wall time
+        # that must not read as a hung device (the watchdog would
+        # restart-storm through the budget deadlining compiles).  A
+        # hang on a genuinely cold shape is the one blind spot; every
+        # warm-shape dispatch is deadlined.  _warm_gen invalidates the
+        # set on a mode change — see reset_warm_shapes.
+        self._warm_shapes: set = set()
+        self._warm_gen = 0
+        self.restarts = 0
         # arrivals of the batch currently executing on device: its
         # end-to-end completion is stamped when the NEXT dispatch
         # returns (the device runs batches in order, so by then batch
@@ -103,7 +179,13 @@ class ServingRuntime:
         admitted.  Never blocks on the datapath: overflow sheds by
         the configured policy and is surfaced as counted monitor DROP
         events.  Raises after :meth:`stop` — a post-drain chunk would
-        queue forever, neither dispatched nor shed-counted."""
+        queue forever, neither dispatched nor shed-counted.
+
+        Under supervision a dead drain loop does NOT bounce submits:
+        the queue is intact, the watchdog is restarting the consumer,
+        and producers should not see a blip the supervisor will heal.
+        Only a TERMINAL fault (unsupervised death, or restart budget
+        exhausted) raises."""
         from . import ServingError, ServingNotStartedError
 
         rows = np.asarray(rows)
@@ -118,7 +200,7 @@ class ServingRuntime:
                 f"submit() wants {self._expected_cols}-column header "
                 f"rows, got {rows.shape[1]}")
         with self._submit_lock:
-            if self._error is not None:
+            if self._error is not None and self._terminal():
                 raise ServingError(
                     f"serving drain loop died: {self._error}")
             if self._stop.is_set():
@@ -128,6 +210,26 @@ class ServingRuntime:
             accepted = self.queue.offer(rows, t)
             self.stats.record_submit(offered, accepted)
             return accepted
+
+    def _terminal(self) -> bool:
+        return not self._supervised or self.restarts >= self._budget
+
+    def reset_warm_shapes(self) -> None:
+        """Forget which shapes have compiled — call after a dispatch
+        MODE change (ladder demotion/promotion): the same bucket then
+        maps to a different executable, and its first dispatch pays a
+        fresh compile the deadline must not misread as a hang.  The
+        CURRENTLY in-flight dispatch (the demotion-triggering batch
+        being retried on the new rung) goes cold too — its retry pays
+        the new rung's compile under the old registration, and its
+        completion must NOT warm the shape for the NEW mode (the
+        warm-generation bump makes _dispatch_one skip the add)."""
+        with self._rec_lock:
+            self._warm_shapes.clear()
+            self._warm_gen += 1
+            if self._inflight is not None:
+                gen, t0, batch, _exempt, wg = self._inflight
+                self._inflight = (gen, t0, batch, True, wg)
 
     # -- lifecycle -----------------------------------------------------
     @property
@@ -140,9 +242,20 @@ class ServingRuntime:
                 "serving runtime already started")
         self._stop.clear()
         self._thread = threading.Thread(target=self._loop,
+                                        args=(self._gen,),
                                         daemon=True,
                                         name="serving-drain")
         self._thread.start()
+        if self._supervised:
+            # watchdog tick: fine enough that a deadline is detected
+            # within ~deadline * 1.25, and a dead thread within ~10ms
+            tick = (min(max(self._deadline_s / 4.0, 0.002), 0.05)
+                    if self._deadline_s > 0 else 0.01)
+            self._watch_tick = tick
+            self._watchdog = threading.Thread(target=self._watch,
+                                              daemon=True,
+                                              name="serving-watchdog")
+            self._watchdog.start()
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> dict:
         """Stop the loop; with ``drain`` (default) every queued row is
@@ -152,11 +265,23 @@ class ServingRuntime:
         within ``timeout`` (e.g. stuck in a first-dispatch XLA
         compile): draining concurrently with a live loop would race
         on the batcher's unsynchronized buffers — the caller retries
-        once the dispatch returns."""
+        once the dispatch returns.
+
+        After a drain-loop DEATH the queued-but-never-dispatched rows
+        are not skipped: they are swept and counted as recovery drops
+        (the same fault would fire again if we dispatched them), the
+        pending sheds still flush as DROP events, and the last
+        completed batch's latency is stamped — the ledger
+        ``submitted == verdicts + shed + recovery_dropped`` balances
+        exactly even for a stop over a corpse."""
         from . import ServingError
 
         with self._submit_lock:  # in-flight submit finishes or fails
             self._stop.set()
+        w = self._watchdog
+        if w is not None:
+            w.join(timeout=5.0)
+            self._watchdog = None
         t = self._thread
         if t is not None:
             t.join(timeout)
@@ -165,15 +290,26 @@ class ServingRuntime:
                     f"serving drain loop still running after "
                     f"{timeout}s (dispatch in flight?); retry stop()")
             self._thread = None
+        # a batch registered in flight but never accounted means the
+        # thread died (or was abandoned) between dequeue and stats —
+        # account it now, before the ledger below is read
+        with self._rec_lock:
+            inflight, self._inflight = self._inflight, None
+            self._gen += 1
+        if inflight is not None:
+            self._account_lost(inflight[2], timeout_flavor=False)
         if drain and self._error is None:
             # the loop thread has exited; dispatch stays serialized.
-            # (a dead loop skips the drain — the same fault would
-            # fire again; the error rides the snapshot instead)
             while True:
                 batch = self.batcher.assemble(self.queue, force=True)
                 if batch is None:
                     break
-                self._dispatch_one(batch)
+                self._dispatch_one(batch, self._gen)
+        elif self._error is not None:
+            # dead loop: the same fault would fire again — sweep the
+            # queue into counted recovery drops instead (no silent
+            # loss; the error rides the snapshot)
+            self._sweep_queue_as_recovery_drops()
         if self._prev_arrivals:
             self.stats.record_completion(self._prev_arrivals,
                                          time.monotonic())
@@ -186,23 +322,33 @@ class ServingRuntime:
                                   queue_depth=self.queue.capacity)
         if self._error is not None:
             out["error"] = self._error
+        ft = out.get("fault-tolerance")
+        if ft is not None:
+            ft["supervised"] = self._supervised
+            ft["restart-budget"] = self._budget
+            ft["dispatch-deadline-ms"] = round(self._deadline_s * 1e3,
+                                               3)
         return out
 
     # -- the drain loop ------------------------------------------------
-    def _loop(self) -> None:
+    def _loop(self, gen: int) -> None:
         try:
-            self._loop_body()
+            self._loop_body(gen)
         except Exception as e:  # noqa: BLE001 — a dying drain thread
-            # must leave a visible corpse: submit() raises from here
-            # on, serving_stats() carries the fault, and stop() skips
-            # the doomed drain
+            # must leave a visible corpse: the watchdog (when armed)
+            # accounts + restarts from here; otherwise submit() raises
+            # from here on, serving_stats() carries the fault, and
+            # stop() sweeps instead of draining
+            with self._rec_lock:
+                if self._gen != gen:
+                    return  # abandoned generation: already accounted
             self._error = f"{type(e).__name__}: {e}"
 
-    def _loop_body(self) -> None:
-        while not self._stop.is_set():
+    def _loop_body(self, gen: int) -> None:
+        while not self._stop.is_set() and self._gen == gen:
             batch = self.batcher.assemble(self.queue)
             if batch is not None:
-                self._dispatch_one(batch)
+                self._dispatch_one(batch, gen)
                 continue
             # idle: stamp the last batch's completion now rather than
             # at the next dispatch (which may never come — an idle
@@ -227,18 +373,68 @@ class ServingRuntime:
                 if ttd > 0.0:
                     time.sleep(min(ttd, _TICK_S))
             else:
-                self.queue.wait_nonempty(0.05)
+                self.queue.wait_nonempty(self._idle_wait_s)
 
-    def _dispatch_one(self, batch: AssembledBatch) -> None:
+    def _dispatch_one(self, batch: AssembledBatch, gen: int) -> None:
+        from . import DispatchFailedError
+
         t0 = time.monotonic()
-        if batch.packed:
-            info = self._dispatch(batch.hdr, batch.valid,
-                                  batch.n_valid,
-                                  packed_meta=(batch.ep, batch.dirn))
-        else:
-            info = self._dispatch(batch.hdr, batch.valid,
-                                  batch.n_valid)
+        shape = (batch.hdr.shape, batch.packed)
+        # register BEFORE the device leg: a death or hang from here on
+        # can always be accounted by the watchdog / stop()
+        with self._rec_lock:
+            self._inflight = (gen, t0, batch,
+                              shape not in self._warm_shapes,
+                              self._warm_gen)
+        # injection sites: a raise kills this thread (dead-thread
+        # recovery); a hang (~S) wedges it past the dispatch deadline
+        faults.check(faults.SITE_SERVING_DISPATCH,
+                     abort=lambda: (self._gen != gen
+                                    or self._stop.is_set()))
+        with self._rec_lock:
+            if self._gen != gen:
+                # deadlined while wedged: the watchdog already
+                # accounted this batch and a successor owns the loop —
+                # do NOT dispatch (the device never saw these rows)
+                return
+        try:
+            if batch.packed:
+                info = self._dispatch(batch.hdr, batch.valid,
+                                      batch.n_valid,
+                                      packed_meta=(batch.ep,
+                                                   batch.dirn))
+            else:
+                info = self._dispatch(batch.hdr, batch.valid,
+                                      batch.n_valid)
+        except DispatchFailedError:
+            # contained device-leg failure (degraded-mode ladder):
+            # the batch is lost but counted; the loop lives on
+            self.stats.record_dispatch_failure()
+            with self._rec_lock:
+                mine = (self._inflight is not None
+                        and self._inflight[0] == gen)
+                if mine:
+                    self._inflight = None
+            if mine:
+                self._account_lost(batch, timeout_flavor=False)
+            self._flush_sheds()
+            return
         t1 = time.monotonic()
+        with self._rec_lock:
+            if self._gen != gen:
+                # a real hang that eventually completed after the
+                # watchdog recovered: device effects landed, but the
+                # rows were already accounted as timeout drops —
+                # recording them again would double-count
+                return
+            inflight, self._inflight = self._inflight, None
+            # skip the warm-add when a ladder transition happened
+            # while this dispatch ran: the shape key now names a
+            # DIFFERENT executable, and warming it would let the new
+            # mode's first compile be misread as a hang
+            if (inflight is not None
+                    and inflight[4] == self._warm_gen):
+                self._warm_shapes.add(shape)
         # the dispatcher knows best what crossed the link: the
         # sharded leg re-packs AFTER flow routing, so the assembled
         # batch's format/size can differ from the shipped one
@@ -264,3 +460,112 @@ class ServingRuntime:
             self._on_shed(rows, count)
         self.stats.record_sheds(count,
                                 len(rows) if rows is not None else 0)
+
+    # -- the recovery plane (watchdog thread + stop path) --------------
+    def _watch(self) -> None:
+        """Supervise the drain thread: restart a dead one, deadline a
+        hung dispatch, account every lost row.  Exits when the stop
+        flag rises or the restart budget is exhausted."""
+        backoff = self._backoff_s
+        while not self._stop.wait(self._watch_tick):
+            if self._stop.is_set():
+                return  # stop raced the tick: not a death
+            t = self._thread
+            dead = (self._error is not None
+                    or (t is not None and not t.is_alive()
+                        and not self._stop.is_set()))
+            hung = False
+            if not dead and self._deadline_s > 0:
+                with self._rec_lock:
+                    inflight = self._inflight
+                    hung = (inflight is not None
+                            and inflight[0] == self._gen
+                            and not inflight[3]  # cold-shape compile
+                            and (time.monotonic() - inflight[1]
+                                 > self._deadline_s))
+            if not dead and not hung:
+                backoff = self._backoff_s  # healthy: backoff re-arms
+                continue
+            cause = (self._error
+                     or ("dispatch exceeded deadline "
+                         f"{self._deadline_s * 1e3:.0f}ms" if hung
+                         else "drain thread died"))
+            if self.restarts >= self._budget:
+                # budget exhausted: go terminal with a visible corpse
+                self._error = (f"restart budget ({self._budget}) "
+                               f"exhausted; last fault: {cause}")
+                return
+            # abandon the current generation (a wedged thread that
+            # ever wakes will exit without dispatching or recording)
+            # and account its in-flight batch
+            with self._rec_lock:
+                self._gen += 1
+                gen = self._gen
+                inflight, self._inflight = self._inflight, None
+            # record the restart AT detection (the observable tests
+            # and operators time against), then account: the first
+            # accounting pays a one-time metricsmap-op compile that
+            # must not read as detection latency
+            self._error = None
+            self.stats.record_restart(cause, timeout=hung)
+            self.restarts += 1
+            if inflight is not None:
+                self._account_lost(inflight[2], timeout_flavor=hung)
+            if self._stop.wait(backoff):  # exponential, stop-aware
+                return
+            backoff = min(backoff * 2 if backoff else self._backoff_s,
+                          _BACKOFF_CAP_S)
+            t = threading.Thread(target=self._loop, args=(gen,),
+                                 daemon=True,
+                                 name=f"serving-drain-r{self.restarts}")
+            self._thread = t
+            t.start()
+
+    def _account_lost(self, batch: AssembledBatch,
+                      timeout_flavor: bool) -> None:
+        """One lost batch -> counted recovery drops + decoded DROP
+        events.  ``timeout_flavor`` picks REASON_DISPATCH_TIMEOUT
+        (watchdog deadline) over REASON_RECOVERY_DROP."""
+        from ..datapath.verdict import (REASON_DISPATCH_TIMEOUT,
+                                        REASON_RECOVERY_DROP)
+
+        n = batch.n_valid
+        if n == 0:
+            return
+        rows: Optional[np.ndarray] = None
+        try:
+            # the batcher emits prefix-valid buckets; reconstruct wide
+            # rows for event synthesis (COPY — the hdr is an arena
+            # slot that recycles under the next generation)
+            if batch.packed:
+                from ..core.packets import unpack_rows_np
+
+                rows = unpack_rows_np(np.asarray(batch.hdr[:n]),
+                                      batch.ep, batch.dirn)
+            else:
+                rows = np.array(batch.hdr[:n], copy=True)
+        except Exception:  # noqa: BLE001 — accounting must not die on
+            rows = None  # a corrupt lost batch; the COUNT stays exact
+        reason = (REASON_DISPATCH_TIMEOUT if timeout_flavor
+                  else REASON_RECOVERY_DROP)
+        self.stats.record_recovery_drops(
+            n, timeout=timeout_flavor,
+            events=len(rows) if rows is not None else 0)
+        if self._on_recovery_drop is not None:
+            self._on_recovery_drop(rows, n, reason)
+
+    def _sweep_queue_as_recovery_drops(self) -> None:
+        """stop() over a dead loop: queued-but-never-dispatched rows
+        become counted recovery drops (REASON_RECOVERY_DROP) instead
+        of silently vanishing with the queue object."""
+        from ..datapath.verdict import REASON_RECOVERY_DROP
+
+        pending = self.queue.pending
+        if pending == 0:
+            return
+        rows, _arrivals = self.queue.take(pending)
+        n = len(rows)
+        self.stats.record_recovery_drops(n, timeout=False, events=n)
+        if self._on_recovery_drop is not None and n:
+            self._on_recovery_drop(np.array(rows, copy=True), n,
+                                   REASON_RECOVERY_DROP)

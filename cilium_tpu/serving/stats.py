@@ -87,6 +87,21 @@ class ServingStats:
         self.h2d_bytes = 0
         self.queue_wait = LatencyHistogram()  # arrival -> dispatch
         self.latency = LatencyHistogram()  # arrival -> events emitted
+        # fault-tolerance plane (serving/runtime.py watchdog): the
+        # conservation law the chaos suite asserts is
+        #   submitted == verdicts + shed + recovery_dropped
+        # after a drained stop — every offered row is exactly one of
+        # dispatched, shed (either overflow policy), or accounted by
+        # recovery (dead/hung/failed dispatch, or queued rows swept at
+        # a dead-loop stop).
+        self.recovery_dropped = 0  # rows accounted by recovery (all)
+        self.timeout_dropped = 0  # ...of which via dispatch deadline
+        self.recovery_events = 0  # recovery rows surfaced as DROPs
+        self.dispatch_failures = 0  # contained dispatch failures
+        self.dispatch_timeouts = 0  # watchdog deadline hits
+        self.restarts = 0  # drain-thread restarts
+        self.last_restart_cause = ""
+        self.last_restart_at: Optional[float] = None  # monotonic
 
     # -- recording (runtime thread) -----------------------------------
     def record_submit(self, offered: int, accepted: int) -> None:
@@ -126,6 +141,30 @@ class ServingStats:
             for count, t in arrivals:
                 if count:
                     self.queue_wait.record((t_dispatch - t) * 1e6)
+
+    def record_recovery_drops(self, count: int, timeout: bool,
+                              events: int = 0) -> None:
+        """``count`` rows lost to a dead/hung/failed dispatch (or the
+        dead-loop stop sweep), ``events`` of them surfaced as decoded
+        DROP events; ``timeout`` marks the watchdog-deadline flavor
+        (REASON_DISPATCH_TIMEOUT vs REASON_RECOVERY_DROP)."""
+        with self._lock:
+            self.recovery_dropped += count
+            self.recovery_events += events
+            if timeout:
+                self.timeout_dropped += count
+
+    def record_dispatch_failure(self) -> None:
+        with self._lock:
+            self.dispatch_failures += 1
+
+    def record_restart(self, cause: str, timeout: bool) -> None:
+        with self._lock:
+            self.restarts += 1
+            self.last_restart_cause = cause[:200]
+            self.last_restart_at = time.monotonic()
+            if timeout:
+                self.dispatch_timeouts += 1
 
     def record_completion(self, arrivals: List[Tuple[int, float]],
                           t_done: float) -> None:
@@ -172,4 +211,23 @@ class ServingStats:
                 "queue-depth": queue_depth,
                 "queue-wait-us": self.queue_wait.snapshot(),
                 "latency-us": self.latency.snapshot(),
+                "fault-tolerance": {
+                    "restarts": self.restarts,
+                    "dispatch-timeouts": self.dispatch_timeouts,
+                    "dispatch-failures": self.dispatch_failures,
+                    "recovery-dropped": self.recovery_dropped,
+                    "timeout-dropped": self.timeout_dropped,
+                    "recovery-events": self.recovery_events,
+                    "last-restart-cause": self.last_restart_cause,
+                    "seconds-since-restart": (
+                        round(time.monotonic()
+                              - self.last_restart_at, 3)
+                        if self.last_restart_at is not None else None),
+                    # the no-silent-loss ledger: exact once the queue
+                    # is drained (post-stop) — while running, rows in
+                    # the queue / in flight are outside every counter
+                    "accounted": (self.verdicts + self.shed
+                                  + self.recovery_dropped
+                                  + queue_pending),
+                },
             }

@@ -37,3 +37,27 @@ os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# Dump-on-timeout: the tier-1 runner wraps pytest in
+# `timeout -k 10 870`, which delivers SIGTERM at the budget and
+# SIGKILLs 10s later.  A REAL hang (a wedged drain thread, a deadlock
+# the chaos suite failed to contain) must leave every thread's stack
+# on stderr in that 10s window instead of dying silently — the
+# fault-tolerance suite exists to prevent hangs, and this is the
+# evidence trail when one escapes anyway.  faulthandler.register
+# replaces SIGTERM's default terminate, which is fine: the runner's
+# follow-up SIGKILL still ends the process.
+import faulthandler
+import signal
+
+faulthandler.enable()
+if hasattr(signal, "SIGTERM"):
+    faulthandler.register(signal.SIGTERM, chain=False)
+
+
+def pytest_runtest_teardown(item):
+    """Chaos hygiene: no armed injector may leak into the next test —
+    a leaked site would fire nondeterministically suite-wide."""
+    from cilium_tpu.infra import faults
+
+    faults.disarm()
