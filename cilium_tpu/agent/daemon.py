@@ -152,6 +152,18 @@ class DaemonConfig:
     # None in production
     fault_injection: Optional[str] = None
     fault_seed: int = 0
+    # -- observability (cilium_tpu/obs; the Hubble/pkg/monitor-depth
+    # introspection layer for the serving plane).
+    # sampled per-packet trace spans: 1-in-N admitted packets get a
+    # span carried admission -> batcher -> staging -> dispatch ->
+    # verdict join with six monotonic stage timestamps (GET
+    # /debug/traces, `cilium-tpu trace`).  0 = off = zero overhead
+    serving_trace_sample: int = 0
+    # jax.profiler capture window: trace the first profile_batches
+    # serving dispatches into this directory, then stop (viewable in
+    # TensorBoard/Perfetto).  None = off
+    profile_dir: Optional[str] = None
+    profile_batches: int = 16
 
 
 class Daemon:
@@ -195,6 +207,14 @@ class Daemon:
             self.config.serving_promote_cooldown_s)
         if self.config.ct_snapshot_interval < 0:
             raise ValueError("ct_snapshot_interval must be >= 0")
+        from ..obs import validate_obs_config
+
+        (self.config.serving_trace_sample,
+         self.config.profile_dir,
+         self.config.profile_batches) = validate_obs_config(
+            self.config.serving_trace_sample,
+            self.config.profile_dir,
+            self.config.profile_batches)
         # deterministic fault injection (chaos testing): arm the
         # process-global injector; spec typos fail here, not as a
         # silently-inert chaos run.  shutdown() disarms what we armed
@@ -412,6 +432,14 @@ class Daemon:
             self.node_registry.register(self.config.node_name, info)
             self.health = HealthMesh(self.node_registry,
                                      self.config.node_name)
+
+        # the unified metrics registry (obs/registry.py): every
+        # prometheus series GET /metrics serves is declared here —
+        # collectors are lazy closures over this daemon, so
+        # registration costs the hot path nothing
+        from ..obs import build_daemon_registry
+
+        self.registry = build_daemon_registry(self)
 
     # -- getters for flow enrichment ---------------------------------
     def _identity_labels(self, numeric: int) -> Tuple[str, ...]:
@@ -888,7 +916,8 @@ class Daemon:
                       ingress: bool = False,
                       packed: Optional[bool] = None,
                       mesh=None,
-                      shard_headroom: int = 2) -> None:
+                      shard_headroom: int = 2,
+                      span_sample: Optional[int] = None) -> None:
         """Switch to the SERVING monitor path: batches run through the
         fused datapath + device event-ring append (one dispatch, no
         per-packet host fetch), and only the compacted events cross to
@@ -909,6 +938,15 @@ class Daemon:
         packed 16 B/packet wire format — 4x fewer h2d bytes — through
         :meth:`TPULoader.serve_packed`; ineligible traffic falls back
         to the wide shape per batch.
+
+        ``span_sample`` (default: the ``serving_trace_sample``
+        config knob) arms PER-PACKET TRACE SPANS on the ingress
+        path: 1-in-N admitted packets carry a span through admission
+        -> dequeue -> staging -> dispatch -> device -> verdict join
+        (six monotonic stage timestamps + batch/bucket/mode
+        annotations), surfaced via ``GET /debug/traces`` and
+        ``cilium-tpu trace``.  0 = off = zero overhead; sampling is
+        deterministic over the admitted-packet sequence.
 
         ``mesh=...`` (a ``jax.sharding.Mesh`` or a device count)
         switches to MULTI-CHIP serving: each assembled bucket is
@@ -947,6 +985,28 @@ class Daemon:
                 "already serving; stop_serving() first")
         if packed is None:
             packed = self.config.serving_packed_ingest
+        if span_sample is None:
+            span_sample = self.config.serving_trace_sample
+        span_sample = int(span_sample)
+        if span_sample < 0:
+            # the whole obs-knob contract, applied to the explicit
+            # argument: reject here, before self._serving is
+            # assigned or the loader re-sharded — a raise below
+            # would wedge the daemon in a phantom "already serving"
+            # state
+            raise ValueError("span_sample must be >= 0 "
+                             "(0 disables span tracing)")
+        if span_sample and not ingress:
+            # validate BEFORE any side effect: below this point the
+            # loader may already be re-sharded, and an error path
+            # that leaves mutated placement behind is worse than the
+            # misconfiguration it reports.  The config knob resolves
+            # first so a daemon armed with serving_trace_sample fails
+            # just as loudly as an explicit span_sample= argument
+            # instead of silently tracing nothing
+            raise ValueError(
+                "span_sample tracing needs ingress=True: spans are "
+                "allocated at IngressQueue admission")
         table = np.asarray(sorted(self.proxy.ports)[:MAX_PROXY_PORTS],
                            dtype=np.uint32)
         n_shards = 0
@@ -1018,11 +1078,18 @@ class Daemon:
             # batch_id (wrapped) -> (kind, host rows, (ep, dirn) or
             # None, numeric ids, timestamp); kind "wide" | "packed"
             "window": {},
+            "tracer": None,
         }
         if ingress:
             from ..core.packets import N_COLS
             from ..serving import ServingRuntime
 
+            tracer = None
+            if span_sample:
+                from ..obs import SpanTracer
+
+                tracer = SpanTracer(span_sample, seed=cfg.fault_seed)
+            self._serving["tracer"] = tracer
             deadline_s = cfg.serving_dispatch_deadline_ms * 1e-3
             runtime = ServingRuntime(
                 dispatch=self._serving_dispatch,
@@ -1051,7 +1118,16 @@ class Daemon:
                 restart_backoff_s=cfg.serving_restart_backoff_ms
                 * 1e-3,
                 idle_wait_s=(min(0.05, deadline_s / 4)
-                             if deadline_s > 0 else 0.05))
+                             if deadline_s > 0 else 0.05),
+                # obs plane: span tracer + the batch-scoped
+                # jax.profiler capture window.  No gauge_fn: the
+                # registry reads the in-flight window live at scrape
+                # (an idle-tick copy would disagree with /metrics
+                # during sustained load, when the idle tick never
+                # fires)
+                tracer=tracer,
+                profile_dir=cfg.profile_dir,
+                profile_batches=cfg.profile_batches)
             self._serving["runtime"] = runtime
             runtime.start()
 
@@ -1106,6 +1182,12 @@ class Daemon:
                 hdr = unpack_rows_np(np.asarray(hdr), *packed_meta)
                 packed_meta = None
             info = self._serving_device_leg(hdr, valid, packed_meta)
+            if isinstance(info, dict):
+                # obs plane: this batch CROSSED the demotion — its
+                # sampled spans carry the flag (a trace through a
+                # ladder transition is exactly what the span ring
+                # exists to explain after the fact)
+                info["demoted"] = True
         lad = s.get("ladder")
         if (lad is not None and lad.record_success()
                 and s.get("runtime") is not None):
@@ -1365,6 +1447,34 @@ class Daemon:
         snap = self.ct_snapshot_info()
         if snap is not None:
             out["ct-snapshot"] = snap
+        log = getattr(self.loader, "compile_log", None)
+        if log is not None:
+            out["compile"] = log.summary()
+        return out
+
+    def debug_traces(self, limit: int = 64) -> dict:
+        """``GET /debug/traces``: the sampled span plane (per-stage
+        aggregate histograms, recent + slowest completed traces) plus
+        the compile-event log — the introspection surfaces an
+        operator reaches for when a latency histogram says "slow"
+        but not "where"."""
+        out = {"enabled": False}
+        s = self._serving
+        tracer = s.get("tracer") if s is not None else None
+        if tracer is not None:
+            out = tracer.snapshot(limit=limit)
+            out["enabled"] = True
+        lad = s.get("ladder") if s is not None else None
+        if lad is not None:
+            out["mode"] = lad.rung
+        log = getattr(self.loader, "compile_log", None)
+        if log is not None:
+            out["compile"] = log.snapshot()
+        runtime = s.get("runtime") if s is not None else None
+        if runtime is not None:
+            prof = runtime.profile_status()
+            if prof is not None:
+                out["profile"] = prof
         return out
 
     def serve_batch(self, hdr: np.ndarray,
@@ -1413,7 +1523,8 @@ class Daemon:
             s["window"][bid] = ("packed", np.asarray(hdr),
                                 (int(ep), int(dirn)), s["numerics"],
                                 time.time())
-            info = {"h2d_bytes": hdr.nbytes, "mode": "packed"}
+            info = {"h2d_bytes": hdr.nbytes, "mode": "packed",
+                    "batch_id": bid}
         else:
             s["ring"], row_map = self.loader.serve(
                 s["ring"], hdr, now, bid,
@@ -1427,7 +1538,8 @@ class Daemon:
             # this via the batcher arena's recycling horizon)
             s["window"][bid] = ("wide", np.asarray(hdr), None,
                                 s["numerics"], time.time())
-            info = {"h2d_bytes": hdr.nbytes, "mode": "wide"}
+            info = {"h2d_bytes": hdr.nbytes, "mode": "wide",
+                    "batch_id": bid}
         s["seq"] += 1
         if s["seq"] % s["drain_every"] == 0:
             self._collect_and_emit(s)
@@ -1527,8 +1639,18 @@ class Daemon:
         self._serving_snapshot_numerics(s, row_map)
         s["window"][bid] = (kind, ship, meta, s["numerics"],
                             time.time())
-        return {"h2d_bytes": ship.nbytes,
-                "mode": f"sharded-{kind}"}
+        info = {"h2d_bytes": ship.nbytes,
+                "mode": f"sharded-{kind}", "batch_id": bid}
+        if s.get("tracer") is not None:
+            # per-shard span attribution: invert the router's
+            # orig-index map into batch_pos -> owning shard (routed
+            # position // block); -1 marks a route-overflow drop.
+            # Only paid while tracing is armed, O(routed) per batch
+            shard_of = np.full(len(rows), -1, dtype=np.int64)
+            p = np.flatnonzero(orig >= 0)
+            shard_of[orig[p]] = p // block
+            info["shard_of"] = shard_of
+        return info
 
     def _collect_and_emit(self, s) -> None:
         """Complete the in-flight ring fetch and publish its events

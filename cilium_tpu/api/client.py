@@ -140,5 +140,11 @@ class APIClient:
     def serving_stats(self):
         return self._request("GET", "/serving")
 
+    def debug_traces(self, limit: int = 64):
+        return self._request("GET", f"/debug/traces?limit={limit}")
+
+    def metrics_inventory(self):
+        return self._request("GET", "/metrics/inventory")
+
     def xds_status(self):
         return self._request("GET", "/xds")

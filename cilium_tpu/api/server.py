@@ -165,6 +165,16 @@ def _make_handler(daemon: Daemon):
                     self._send(200, _policy_map(daemon, int(m.group(1))))
                 elif path == "/metrics":
                     self._send_text(200, _metrics_text(daemon))
+                elif path == "/metrics/inventory":
+                    # the registry's self-description: every series
+                    # /metrics can serve, with type + help (the
+                    # README metric-inventory table's source)
+                    self._send(200, daemon.registry.inventory())
+                elif path == "/debug/traces":
+                    # the sampled span plane + compile-event log
+                    # (cilium-tpu trace reads this)
+                    limit = int(q.get("limit", ["64"])[0])
+                    self._send(200, daemon.debug_traces(limit=limit))
                 elif path == "/flows":
                     self._send(200, _flows(daemon, q))
                 elif path == "/proxy":
@@ -361,76 +371,12 @@ def _policy_map(daemon: Daemon, ep_id: int) -> list:
 
 
 def _metrics_text(daemon: Daemon) -> str:
-    """Prometheus exposition: agent + hubble metrics (pkg/metrics)."""
-    m = daemon.loader.metrics()
-    lines = ["# TYPE cilium_datapath_packets_total counter"]
-    for reason in range(m.shape[0]):
-        for d in (0, 1):
-            if m[reason, d]:
-                lines.append(
-                    f'cilium_datapath_packets_total{{reason="{reason}",'
-                    f'direction="{"ingress" if d == 0 else "egress"}"}} '
-                    f'{int(m[reason, d])}')
-    lines.append(
-        f"cilium_policy_revision {daemon.repo.revision}")
-    lines.append(
-        f"cilium_endpoint_count {len(daemon.endpoints.list())}")
-    lines.append(
-        f"cilium_identity_count {len(daemon.allocator.all_identities())}")
-    sv = daemon.serving_stats()
-    if sv.get("active") and "verdicts" in sv:
-        lines.append("# TYPE cilium_serving_verdicts_total counter")
-        lines.append(f"cilium_serving_verdicts_total {sv['verdicts']}")
-        lines.append("# TYPE cilium_serving_shed_total counter")
-        lines.append(f"cilium_serving_shed_total {sv['shed']}")
-        lines.append("# TYPE cilium_serving_batches_total counter")
-        lines.append(f"cilium_serving_batches_total {sv['batches']}")
-        h2d = sv.get("h2d") or {}
-        if "bytes" in h2d:
-            lines.append("# TYPE cilium_serving_h2d_bytes_total "
-                         "counter")
-            lines.append(
-                f"cilium_serving_h2d_bytes_total {h2d['bytes']}")
-            lines.append("# TYPE cilium_serving_packed_batches_total "
-                         "counter")
-            lines.append(f"cilium_serving_packed_batches_total "
-                         f"{h2d['packed-batches']}")
-    if sv.get("active") and sv.get("shards"):
-        lines.append("# TYPE cilium_serving_route_overflow_total "
-                     "counter")
-        lines.append(f"cilium_serving_route_overflow_total "
-                     f"{sv['route-overflow']}")
-    # fault-tolerance plane: restarts, recovery drops, degraded mode
-    ft = sv.get("fault-tolerance") if sv.get("active") else None
-    if ft:
-        lines.append("# TYPE cilium_serving_restarts_total counter")
-        lines.append(f"cilium_serving_restarts_total "
-                     f"{ft['restarts']}")
-        lines.append("# TYPE cilium_serving_dispatch_timeouts_total "
-                     "counter")
-        lines.append(f"cilium_serving_dispatch_timeouts_total "
-                     f"{ft['dispatch-timeouts']}")
-        lines.append("# TYPE cilium_serving_recovery_dropped_total "
-                     "counter")
-        lines.append(f"cilium_serving_recovery_dropped_total "
-                     f"{ft['recovery-dropped']}")
-    if sv.get("active") and sv.get("ladder"):
-        lad = sv["ladder"]
-        lines.append("# TYPE cilium_serving_degraded gauge")
-        lines.append(f'cilium_serving_degraded'
-                     f'{{mode="{lad["rung"]}"}} '
-                     f'{1 if lad["degraded"] else 0}')
-        lines.append("# TYPE cilium_serving_demotions_total counter")
-        lines.append(f"cilium_serving_demotions_total "
-                     f"{lad['demotions']}")
-    snap = daemon.ct_snapshot_info()
-    if snap is not None:
-        lines.append("# TYPE cilium_ct_snapshot_age_seconds gauge")
-        lines.append(f"cilium_ct_snapshot_age_seconds "
-                     f"{snap['age-seconds']}")
-        lines.append("# TYPE cilium_ct_snapshot_entries gauge")
-        lines.append(f"cilium_ct_snapshot_entries {snap['entries']}")
-    return "\n".join(lines) + "\n" + daemon.flow_metrics.render()
+    """Prometheus exposition — every series comes from the ONE
+    unified registry (obs/registry.py).  Kept as a function (not
+    inlined into the handler) because tests and tooling import it;
+    the exposition text itself is built nowhere but the registry
+    (enforced by scripts/check_metrics_registry.py)."""
+    return daemon.registry.render()
 
 
 def _flows(daemon: Daemon, q: dict) -> list:

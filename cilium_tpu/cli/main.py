@@ -382,16 +382,91 @@ def cmd_anomaly(args) -> int:
     return 1
 
 
+# cumulative serving counters the follow mode diffs per interval
+# (path into the snapshot dict -> display label)
+_SERVING_RATE_KEYS = (
+    (("submitted",), "submitted"),
+    (("admitted",), "admitted"),
+    (("shed",), "shed"),
+    (("batches",), "batches"),
+    (("verdicts",), "verdicts"),
+    (("h2d", "bytes"), "h2d-bytes"),
+    (("ring", "events"), "ring-events"),
+    (("ring", "lost"), "ring-lost"),
+    (("fault-tolerance", "restarts"), "restarts"),
+    (("fault-tolerance", "recovery-dropped"), "recovery-dropped"),
+    (("fault-tolerance", "dispatch-timeouts"), "timeouts"),
+)
+
+
+def _pluck(st: dict, keys) -> object:
+    v = st
+    for k in keys:
+        if not isinstance(v, dict):
+            return None
+        v = v.get(k)
+    return v
+
+
+def _counters_reset(cur: dict, prev: dict) -> bool:
+    """Any cumulative counter going BACKWARD means the serving
+    session restarted between ticks (stop_serving + start_serving
+    zeroes them): the diff would render nonsense negative rates, so
+    the follow loop resyncs with a full block instead — the standard
+    rate-over-counter reset convention."""
+    for keys, _label in _SERVING_RATE_KEYS:
+        a, b = _pluck(cur, keys), _pluck(prev, keys)
+        if (isinstance(a, (int, float)) and isinstance(b, (int, float))
+                and a < b):
+            return True
+    return False
+
+
+def _print_serving_interval(cur: dict, prev: dict,
+                            dt: float) -> None:
+    """Follow-mode rendering: DIFF the cumulative counters against
+    the previous sample so each tick reads as a rate, not a growing
+    total (totals made chaos runs unreadable — a restart burst looks
+    identical to steady state when you only see lifetime sums)."""
+    parts = []
+    for keys, label in _SERVING_RATE_KEYS:
+        a, b = _pluck(cur, keys), _pluck(prev, keys)
+        if a is None or b is None or not isinstance(a, (int, float)):
+            continue
+        delta = a - b
+        if delta == 0 and label not in ("submitted", "verdicts"):
+            continue  # quiet counters stay off the line
+        parts.append(f"{label} +{delta:g} ({delta / dt:,.0f}/s)")
+    print(f"[{dt:.1f}s] " + ", ".join(parts))
+    q = cur.get("queue-pending", 0)
+    lat = cur.get("latency-us") or {}
+    mode = cur.get("mode")
+    tail = (f"     queue {q}/{cur.get('queue-depth', 0)}, "
+            f"p50={lat.get('p50')}us p99={lat.get('p99')}us")
+    if mode:
+        tail += f", mode={mode}"
+    print(tail)
+
+
 def cmd_serving(args) -> int:
     """`cilium-tpu serving stats [--follow]`: the serving front-end's
     live telemetry (queue depth/wait, pad efficiency, batches/sec,
-    verdicts/sec, shed counters, p50/p95/p99 latency)."""
+    verdicts/sec, shed counters, p50/p95/p99 latency).  Follow mode
+    diffs the cumulative counters per interval."""
     c = _client(args)
+    prev = None
+    prev_t = None
     try:
         while True:
             st = c.serving_stats()
+            now = time.monotonic()
             if args.json:
                 _print(st)
+            elif (prev is not None and st.get("active")
+                    and prev.get("active")
+                    and not _counters_reset(st, prev)):
+                _print_serving_interval(st, prev, max(now - prev_t,
+                                                      1e-9))
             elif not st.get("active"):
                 print("Serving: inactive (start_serving has not run)")
             else:
@@ -448,11 +523,109 @@ def cmd_serving(args) -> int:
                 print(f"Ring:      {ring.get('windows', 0)} windows, "
                       f"{ring.get('events', 0)} events, "
                       f"{ring.get('lost', 0)} lost")
+            prev, prev_t = st, now
             if not args.follow:
                 return 0
             time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0
+
+
+def _print_profile_state(tr: dict) -> None:
+    """The jax.profiler capture-window status line — a capture can
+    be armed with tracing off, so both cmd_trace branches print it."""
+    prof = tr.get("profile")
+    if prof:
+        print(f"Profile:   {prof['state']} "
+              f"({prof['batches']}/{prof['window']} "
+              f"batches) -> {prof['dir']}")
+
+
+def cmd_trace(args) -> int:
+    """`cilium-tpu trace [-f]`: the sampled span plane — per-stage
+    latency breakdown across the serving pipeline (admission ->
+    dequeue -> staging -> dispatch -> device -> verdict join) plus
+    the slowest-trace table and the compile-event log."""
+    c = _client(args)
+    try:
+        while True:
+            tr = c.debug_traces(limit=args.number)
+            if args.json:
+                _print(tr)
+            elif not tr.get("enabled"):
+                print("Tracing: off (start_serving(ingress=True) "
+                      "with serving_trace_sample=N, or "
+                      "span_sample=N)")
+                comp = tr.get("compile")
+                if comp:
+                    print(f"Compiles:  {comp['compiles']} "
+                          f"({comp['executables']} executables, "
+                          f"{comp['violations']} violations)")
+                _print_profile_state(tr)
+            else:
+                print(f"Tracing:   1-in-{tr['sample']} sampled; "
+                      f"{tr['completed']} complete, "
+                      f"{tr['started']} started, "
+                      f"{tr['dropped']} dropped"
+                      + (f", mode={tr['mode']}" if tr.get("mode")
+                         else ""))
+                print(f"{'STAGE':<20}{'P50us':>10}{'P95us':>10}"
+                      f"{'P99us':>10}{'MAXus':>10}{'N':>8}")
+                stages = tr.get("stages-us") or {}
+                for name, h in stages.items():
+                    print(f"{name:<20}"
+                          f"{_us(h.get('p50')):>10}"
+                          f"{_us(h.get('p95')):>10}"
+                          f"{_us(h.get('p99')):>10}"
+                          f"{_us(h.get('max')):>10}"
+                          f"{h.get('count', 0):>8}")
+                e2e = tr.get("e2e-us") or {}
+                print(f"{'end-to-end':<20}"
+                      f"{_us(e2e.get('p50')):>10}"
+                      f"{_us(e2e.get('p95')):>10}"
+                      f"{_us(e2e.get('p99')):>10}"
+                      f"{_us(e2e.get('max')):>10}"
+                      f"{e2e.get('count', 0):>8}")
+                slow = tr.get("slowest") or []
+                if slow:
+                    print(f"\nSlowest traces:")
+                    print(f"{'SEQ':<10}{'E2Eus':>10}{'BUCKET':>8}"
+                          f"{'MODE':>16}{'SHARD':>7}{'DEMOTED':>9}"
+                          f"  SLOWEST-STAGE")
+                    for t in slow[:args.number]:
+                        st = t.get("stages-us") or {}
+                        worst = max(st, key=st.get) if st else ""
+                        tail = (f"  {worst} ({_us(st.get(worst))}us)"
+                                if worst else "")
+                        shard = t.get("shard", -1)
+                        print(f"{t['seq']:<10}"
+                              f"{_us(t.get('e2e-us')):>10}"
+                              f"{t.get('bucket', 0):>8}"
+                              f"{t.get('mode', ''):>16}"
+                              f"{shard if shard >= 0 else '':>7}"
+                              f"{'yes' if t.get('demoted') else '':>9}"
+                              + tail)
+                comp = tr.get("compile") or {}
+                if comp:
+                    print(f"\nCompiles:  {comp['compiles']} "
+                          f"({comp['executables']} executables, "
+                          f"{comp['violations']} violations)")
+                    for ev in (comp.get("events") or [])[-5:]:
+                        print(f"  {ev['mode']:<16}"
+                              f"shape={tuple(ev['shape'])} "
+                              f"{ev['compile-ms']}ms"
+                              + (" DUPLICATE" if ev["duplicate"]
+                                 else ""))
+                _print_profile_state(tr)
+            if not args.follow:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _us(v) -> str:
+    return "-" if v is None else f"{v:,.0f}"
 
 
 def cmd_monitor(args) -> int:
@@ -499,6 +672,9 @@ def cmd_daemon(args) -> int:
         "serving_restart_budget": args.serving_restart_budget,
         "ct_snapshot_interval": args.ct_snapshot_interval,
         "fault_injection": args.fault_injection,
+        "serving_trace_sample": args.serving_trace_sample,
+        "profile_dir": args.profile_dir,
+        "profile_batches": args.profile_batches,
     }.items() if v is not None}
     cfg = load_config(config_dir=args.config_dir, **overrides)
     d = Daemon(cfg)
@@ -607,11 +783,21 @@ def main(argv=None) -> int:
 
     p = sub.add_parser("serving",
                        help="serving front-end stats (queue, batches, "
-                            "sheds, latency percentiles)")
+                            "sheds, latency percentiles); follow "
+                            "mode diffs counters per interval")
     p.add_argument("action", nargs="?", default="stats",
                    choices=["stats"])
     p.add_argument("--follow", "-f", action="store_true")
     p.add_argument("--interval", type=float, default=1.0)
+
+    p = sub.add_parser("trace",
+                       help="sampled per-packet traces: per-stage "
+                            "latency breakdown, slowest-trace table, "
+                            "compile-event log")
+    p.add_argument("--follow", "-f", action="store_true")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--number", type=int, default=10,
+                   help="traces to show in the slowest table")
 
     p = sub.add_parser("anomaly", help="anomaly stats | train | synth "
                                        "| score (pcap evaluation)")
@@ -686,6 +872,20 @@ def main(argv=None) -> int:
                         "(infra/faults.py), e.g. "
                         "'serving.dispatch=1x1~0.3'; chaos testing "
                         "only")
+    p.add_argument("--serving-trace-sample", type=int, default=None,
+                   help="sample 1-in-N admitted packets with a "
+                        "per-packet trace span (six-stage latency "
+                        "breakdown via GET /debug/traces and "
+                        "`cilium-tpu trace`); default 0 = off = "
+                        "zero overhead")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler trace of the first "
+                        "--profile-batches serving dispatches into "
+                        "this directory (TensorBoard/Perfetto "
+                        "viewable), then stop")
+    p.add_argument("--profile-batches", type=int, default=None,
+                   help="profile capture window length in batches "
+                        "(default 16)")
 
     args = parser.parse_args(argv)
     if args.cmd == "version":
@@ -699,7 +899,7 @@ def main(argv=None) -> int:
             "endpoint": cmd_endpoint, "identity": cmd_identity,
             "bpf": cmd_bpf, "map": cmd_map, "metrics": cmd_metrics,
             "flows": cmd_flows, "monitor": cmd_monitor,
-            "serving": cmd_serving,
+            "serving": cmd_serving, "trace": cmd_trace,
             "anomaly": cmd_anomaly, "daemon": cmd_daemon,
             "service": cmd_service, "fqdn": cmd_fqdn,
             "health": cmd_health, "config": cmd_config,

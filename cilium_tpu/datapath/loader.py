@@ -21,6 +21,7 @@ BPF programs while maps persist in bpffs (SURVEY.md §5).
 from __future__ import annotations
 
 import abc
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -154,6 +155,43 @@ class TPULoader(Loader):
         # compiles exactly one executable per ladder rung and mode
         self._serving_mesh = None
         self._sharded_steps: Dict[tuple, object] = {}
+        # compile introspection (obs/compile_log.py): every XLA
+        # retrace on the serving path is recorded with shape/mode and
+        # the one-executable-per-(rung, mode) invariant asserted at
+        # runtime — the jit-cache sizes are sampled around each
+        # dispatch (two dict-len reads; noise against the dispatch)
+        from ..obs.compile_log import CompileLog
+
+        self.compile_log = CompileLog()
+
+    def _serving_cache_size(self, mode: str) -> int:
+        """Executable count backing one serving mode RIGHT NOW."""
+        from ..monitor.ring import serve_step_jit, serve_step_packed_jit
+
+        if mode == "wide":
+            fn = serve_step_jit
+        elif mode == "packed":
+            fn = serve_step_packed_jit
+        else:  # sharded steps are per-(packed, sample, audit) jits
+            return sum(
+                getattr(f, "_cache_size", lambda: 1)()
+                for f in self._sharded_steps.values())
+        size = getattr(fn, "_cache_size", None)
+        return size() if size is not None else 0
+
+    def _record_compile(self, mode: str, shape, ring_cap: int,
+                        statics: tuple, before: int, after: int,
+                        elapsed_s: float) -> None:
+        """Key the invariant on everything that LEGITIMATELY selects
+        a distinct executable — shape, ring capacity, static args,
+        and the attach generation (a policy-world change retraces by
+        design) — so a growth on an already-seen key is a genuine
+        retrace (e.g. the P(axis) vs P(axis, None) sharding-spelling
+        trap), not a config change."""
+        self.compile_log.record_dispatch(
+            mode, tuple(shape), before, after, elapsed_s,
+            key_extra=(int(ring_cap),) + tuple(statics)
+            + (self.attach_count,))
 
     def _rekeep_serving_placement(self) -> None:
         """Call (under the lock) after ANY state swap that introduces
@@ -310,11 +348,20 @@ class TPULoader(Loader):
             valid = jnp.asarray(valid)
         now, batch_id = jnp.uint32(now), jnp.uint32(batch_id)
         with self._lock:
+            before = self._serving_cache_size("wide")
+            t0 = time.monotonic()
             self.state, ring = serve_step_jit(
                 self.state, ring, hdr, now, batch_id,
                 trace_sample=trace_sample,
                 valid=valid, proxy_ports=proxy_ports, audit=audit)
+            after = self._serving_cache_size("wide")
             row_map = self.row_map
+        if after > before:
+            self._record_compile(
+                "wide", hdr.shape, ring.capacity,
+                (int(trace_sample), bool(audit),
+                 proxy_ports is not None, valid is not None),
+                before, after, time.monotonic() - t0)
         return ring, row_map
 
     def serve_packed(self, ring, packed, now: int, batch_id: int,
@@ -340,11 +387,20 @@ class TPULoader(Loader):
         now, batch_id = jnp.uint32(now), jnp.uint32(batch_id)
         ep, dirn = jnp.uint32(ep), jnp.uint32(dirn)
         with self._lock:
+            before = self._serving_cache_size("packed")
+            t0 = time.monotonic()
             self.state, ring = serve_step_packed_jit(
                 self.state, ring, packed, now, batch_id, ep, dirn,
                 trace_sample=trace_sample, valid=valid,
                 proxy_ports=proxy_ports, audit=audit)
+            after = self._serving_cache_size("packed")
             row_map = self.row_map
+        if after > before:
+            self._record_compile(
+                "packed", packed.shape, ring.capacity,
+                (int(trace_sample), bool(audit),
+                 proxy_ports is not None, valid is not None),
+                before, after, time.monotonic() - t0)
         return ring, row_map
 
     # -- multi-chip serving (parallel/mesh.py) ------------------------
@@ -417,6 +473,7 @@ class TPULoader(Loader):
             proxy_ports = jnp.zeros((0,), jnp.uint32)
         now, batch_id = jnp.uint32(now), jnp.uint32(batch_id)
         key = (packed, int(trace_sample), bool(audit))
+        mode = "sharded-packed" if packed else "sharded"
         with self._lock:
             step = self._sharded_steps.get(key)
             if step is None:
@@ -424,6 +481,8 @@ class TPULoader(Loader):
                     mesh, packed=packed, trace_sample=trace_sample,
                     audit=audit)
                 self._sharded_steps[key] = step
+            before = self._serving_cache_size(mode)
+            t0 = time.monotonic()
             if packed:
                 ep, dirn = packed_meta
                 self.state, ring = step(
@@ -432,7 +491,13 @@ class TPULoader(Loader):
             else:
                 self.state, ring = step(self.state, ring, hdr, now,
                                         batch_id, valid, proxy_ports)
+            after = self._serving_cache_size(mode)
             row_map = self.row_map
+        if after > before:
+            self._record_compile(
+                mode, hdr.shape, ring.buf.shape[0],
+                key + (valid is not None,),
+                before, after, time.monotonic() - t0)
         return ring, row_map
 
     def add_route_overflow(self, n: int) -> None:

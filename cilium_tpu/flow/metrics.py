@@ -10,7 +10,7 @@ not per-flow callbacks.
 from __future__ import annotations
 
 from collections import defaultdict
-from typing import Dict, List, Tuple
+from typing import Dict, Tuple
 
 import numpy as np
 
@@ -64,25 +64,14 @@ class FlowMetrics:
                 (verdict_ev & ~fwd).sum())
 
     def render(self) -> str:
-        """Prometheus text exposition (the /metrics endpoint body)."""
-        lines: List[str] = []
-        lines.append("# TYPE hubble_flows_processed_total counter")
-        for (verdict, d), v in sorted(self.flows_total.items()):
-            lines.append(
-                f'hubble_flows_processed_total{{verdict="{verdict}",'
-                f'direction="{d}"}} {v}')
-        lines.append("# TYPE hubble_drop_total counter")
-        for (reason, d), v in sorted(self.drops_total.items()):
-            lines.append(
-                f'hubble_drop_total{{reason="{reason}",direction="{d}"}} {v}')
-        lines.append("# TYPE hubble_port_distribution_total counter")
-        for (proto, port), v in sorted(self.port_distribution.items()):
-            lines.append(
-                f'hubble_port_distribution_total{{protocol="{proto}",'
-                f'port="{port}"}} {v}')
-        lines.append("# TYPE hubble_policy_verdicts_total counter")
-        for (verdict, match), v in sorted(self.policy_verdicts.items()):
-            lines.append(
-                f'hubble_policy_verdicts_total{{verdict="{verdict}",'
-                f'match="{match}"}} {v}')
-        return "\n".join(lines) + "\n"
+        """Prometheus text exposition of the flow series.  Inside an
+        agent the daemon's unified registry serves these (the
+        /metrics endpoint body); this standalone render exists for
+        tooling that holds a bare FlowMetrics — it goes through the
+        SAME registry renderer, so exposition text is built in
+        exactly one module (the check_metrics_registry lint)."""
+        from ..obs.registry import MetricsRegistry, register_flow_metrics
+
+        reg = MetricsRegistry()
+        register_flow_metrics(reg, self)
+        return reg.render()

@@ -1,0 +1,56 @@
+"""Observability plane: per-packet trace spans, the unified metrics
+registry, and compile/profile introspection.
+
+Reference: upstream cilium's killer observability feature is Hubble —
+every datapath event is attributed (``pkg/monitor`` + the
+``threefour`` parser) and queryable.  The serving plane here has five
+pipeline stages (admission -> batch assembly -> h2d staging -> device
+dispatch -> ring drain/verdict join) whose latency the pre-obs
+telemetry could only see as one opaque end-to-end histogram.  This
+package is the Dapper-style answer (Sigelman et al., 2010): thread a
+trace through the hot path for 1-in-N sampled packets at near-zero
+cost, attribute per-stage latency, and make the recompile / demotion
+/ recovery machinery explainable after the fact instead of only
+countable.
+
+Pieces (PARITY.md row 57):
+
+- :mod:`.trace` — sampled per-packet trace spans: a span allocated at
+  ``IngressQueue`` admission for 1-in-N packets (``span_sample`` /
+  the ``serving_trace_sample`` DaemonConfig knob; default 0 = off =
+  zero overhead), carried through the batcher, arena staging, device
+  dispatch, and the drain-time verdict join, recording six monotonic
+  stage timestamps plus batch/bucket/mode annotations into a
+  fixed-size lock-cheap span ring.  Surfaced via ``GET
+  /debug/traces`` and ``cilium-tpu trace [-f]``.
+- :mod:`.registry` — the unified prometheus registry: every counter /
+  gauge / histogram the agent exports lives behind ONE self-
+  describing registry backing ``GET /metrics`` (the ``pkg/metrics``
+  analogue), with log2 histograms exported as cumulative buckets.
+  ``scripts/check_metrics_registry.py`` lints that no exposition
+  text is built anywhere else, so the pre-obs scatter (serving
+  stats, flow metrics, loader metricsmap, fault counters each
+  rendering their own lines) cannot regrow.
+- :mod:`.compile_log` — compile-event introspection: every XLA
+  retrace on the serving path is recorded with shape/mode/latency,
+  and the one-executable-per-(rung, mode) invariant is asserted at
+  RUNTIME (a duplicate compile for a seen key counts as a violation
+  and logs), not just in tests.
+"""
+
+from __future__ import annotations
+
+from .compile_log import CompileLog  # noqa: F401
+from .registry import MetricsRegistry, build_daemon_registry  # noqa: F401
+from .trace import (SPAN_STAGES, SpanTracer, TraceSpan,  # noqa: F401
+                    validate_obs_config)
+
+__all__ = [
+    "CompileLog",
+    "MetricsRegistry",
+    "SPAN_STAGES",
+    "SpanTracer",
+    "TraceSpan",
+    "build_daemon_registry",
+    "validate_obs_config",
+]

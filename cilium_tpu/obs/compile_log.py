@@ -1,0 +1,111 @@
+"""Compile-event introspection for the serving hot path.
+
+Every distinct batch shape on the serving path is one XLA compile,
+and the whole serving design (power-of-two bucket ladder, packed vs
+wide formats, per-(rung, mode) sharded steps) exists to BOUND that
+set.  PR 2 proved the invariant in tests by jit-cache inspection —
+and promptly caught the ``P(axis)`` vs ``P(axis, None)``
+sharding-spelling retrace.  This module makes the same check a
+RUNTIME surface: the loader reports its jit-cache size around every
+serving dispatch, a growth is recorded as a compile event (shape,
+mode, wall time — the wall time of the dispatch that paid the
+trace), and a SECOND compile for an already-seen ``(mode, shape)``
+key is an invariant VIOLATION: counted, logged, and surfaced through
+``serving stats`` / ``GET /metrics`` so a recompile storm shows up
+where operators look instead of only as mysteriously lost
+throughput.
+
+Cost when nothing compiles: two ``_cache_size()`` reads (dict-len
+lookups on the jitted callables) per dispatch — noise against a
+device dispatch.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+MAX_EVENTS = 256
+
+
+class CompileLog:
+    """Bounded log of serving-path compile events + the
+    one-executable-per-(mode, shape) invariant.
+
+    ``mode`` is the dispatch flavor ("wide" | "packed" | "sharded" |
+    "sharded-packed"); the daemon maps it onto the degraded-mode
+    ladder rung (wide -> wide, packed -> single, sharded-* ->
+    sharded) when surfacing."""
+
+    def __init__(self, capacity: int = MAX_EVENTS):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        # (mode, shape) -> compile count; >1 is a violation
+        self.executables: Dict[Tuple[str, tuple], int] = {}
+        self.compiles = 0
+        self.violations = 0
+
+    def record_dispatch(self, mode: str, shape: tuple,
+                        cache_before: int, cache_after: int,
+                        elapsed_s: float,
+                        key_extra: tuple = ()) -> None:
+        """Called by the loader after a serving dispatch with the
+        jit-cache sizes sampled around it.  No growth = no event.
+        ``key_extra`` extends the dedup key with everything that
+        LEGITIMATELY selects a distinct executable beyond (mode,
+        shape) — ring capacity, static args, the attach generation —
+        so only a same-key regrowth counts as a violation."""
+        if cache_after <= cache_before:
+            return
+        key = (str(mode), tuple(int(d) for d in shape)
+               + tuple(key_extra))
+        with self._lock:
+            seen = self.executables.get(key, 0)
+            self.executables[key] = seen + 1
+            self.compiles += cache_after - cache_before
+            duplicate = seen > 0
+            if duplicate:
+                self.violations += 1
+            ev = {
+                "t": time.time(),
+                "mode": key[0],
+                "shape": [int(d) for d in shape],
+                "key": list(key[1]),
+                "compile-ms": round(elapsed_s * 1e3, 3),
+                "cache-size": cache_after,
+                "duplicate": duplicate,
+            }
+            self._events.append(ev)
+            if len(self._events) > self.capacity:
+                del self._events[:len(self._events) - self.capacity]
+        if duplicate:
+            logging.getLogger(__name__).warning(
+                "serving recompile VIOLATION: a second executable "
+                "compiled for mode=%s shape=%s (one-executable-per-"
+                "(rung, mode) invariant; sharding-spec spelling or a "
+                "leaked non-ladder shape are the usual causes)",
+                key[0], key[1])
+
+    def snapshot(self, limit: int = 32) -> dict:
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "executables": len(self.executables),
+                "violations": self.violations,
+                "by-key": [
+                    {"mode": m, "shape": list(s), "compiles": c}
+                    for (m, s), c in sorted(self.executables.items())],
+                "events": list(self._events[-limit:]),
+            }
+
+    def summary(self) -> dict:
+        """The compact form riding ``serving_stats()``."""
+        with self._lock:
+            return {
+                "compiles": self.compiles,
+                "executables": len(self.executables),
+                "violations": self.violations,
+            }

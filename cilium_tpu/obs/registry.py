@@ -1,0 +1,378 @@
+"""The unified metrics registry: every prometheus series the agent
+exports, declared in ONE place.
+
+Reference: upstream cilium ``pkg/metrics`` — a single agent registry
+every subsystem registers into, backing ``GET /metrics``.  Before
+this module the exposition text was hand-assembled in four places
+(serving stats, ``flow/metrics.py``, the loader metricsmap render,
+the fault/recovery counters), each with its own formatting and its
+own chances to drift; ``scripts/check_metrics_registry.py`` lints
+that no exposition text is built anywhere else, so the scatter
+cannot regrow.
+
+Pull model: a metric is a NAME + TYPE + HELP + a zero-arg COLLECT
+callable sampled at render time, so registration costs the hot path
+nothing — all reads happen when an operator scrapes.  A collector
+returning ``None`` omits its series (e.g. serving counters while no
+session is active, matching the pre-registry behavior tests pin).
+
+Histograms render as CUMULATIVE log2 buckets (``_bucket{le=...}`` +
+``_sum`` + ``_count``) instead of only p50/95/99 point reads — the
+form Prometheus can aggregate across scrapes and nodes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..serving.stats import N_BUCKETS, LatencyHistogram
+
+# collect() -> None (omit) | scalar | [(labels_dict, value), ...]
+Collect = Callable[[], object]
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(round(v, 6))
+    return str(v)
+
+
+def _labels(d: Dict[str, object]) -> str:
+    if not d:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in d.items())
+    return "{" + inner + "}"
+
+
+class MetricsRegistry:
+    """Self-describing registry.  ``prepare`` (optional) runs once
+    per render before any collector — the place to snapshot shared
+    state (e.g. one ``serving_stats()`` call feeding a dozen
+    collectors) instead of re-snapshotting per metric."""
+
+    def __init__(self, prepare: Optional[Callable[[], None]] = None):
+        self._metrics: List[dict] = []
+        self._names: set = set()
+        self._prepare = prepare
+
+    def _add(self, name: str, mtype: str, help_: str,
+             collect: Collect) -> None:
+        if name in self._names:
+            raise ValueError(f"metric {name!r} registered twice")
+        self._names.add(name)
+        self._metrics.append({"name": name, "type": mtype,
+                              "help": help_, "collect": collect})
+
+    def counter(self, name: str, help_: str,
+                collect: Collect) -> None:
+        self._add(name, "counter", help_, collect)
+
+    def gauge(self, name: str, help_: str, collect: Collect) -> None:
+        self._add(name, "gauge", help_, collect)
+
+    def histogram(self, name: str, help_: str,
+                  collect: Callable[[], Optional[LatencyHistogram]]
+                  ) -> None:
+        """``collect`` returns the live :class:`LatencyHistogram`
+        (log2 µs buckets) or None to omit."""
+        self._add(name, "histogram", help_, collect)
+
+    # -- rendering -----------------------------------------------------
+    def render(self) -> str:
+        """The ``GET /metrics`` body (prometheus text exposition)."""
+        if self._prepare is not None:
+            self._prepare()
+        lines: List[str] = []
+        for m in self._metrics:
+            try:
+                got = m["collect"]()
+            except Exception:  # a broken collector must not 500 the
+                continue  # whole scrape
+            if got is None:
+                continue
+            name = m["name"]
+            lines.append(f"# HELP {name} {m['help']}")
+            if m["type"] == "histogram":
+                self._render_histogram(lines, name, got)
+                continue
+            lines.append(f"# TYPE {name} {m['type']}")
+            if isinstance(got, (list, tuple)):
+                for labels, v in got:
+                    lines.append(f"{name}{_labels(labels)} {_fmt(v)}")
+            else:
+                lines.append(f"{name} {_fmt(got)}")
+        return "\n".join(lines) + "\n"
+
+    @staticmethod
+    def _render_histogram(lines: List[str], name: str,
+                          h: LatencyHistogram) -> None:
+        """Cumulative-bucket exposition of a log2 µs histogram.
+        Bucket ``i`` holds values in ``[2^(i-1), 2^i)`` (µs), so the
+        cumulative count at ``le="2^i"`` includes buckets ``0..i``.
+        Trailing empty buckets collapse into ``+Inf`` — cumulative
+        semantics survive a partial bound list."""
+        lines.append(f"# TYPE {name} histogram")
+        # copy the bucket list ONCE and derive +Inf/_count from that
+        # copy: re-reading h.count while the drain thread is between
+        # its bucket and count increments would emit a non-monotone
+        # cumulative series (+Inf below an earlier le bucket)
+        buckets = list(h.buckets)
+        total = sum(buckets)
+        acc = 0
+        top = max((i for i, c in enumerate(buckets) if c),
+                  default=-1)
+        for i in range(min(top + 1, N_BUCKETS)):
+            acc += buckets[i]
+            lines.append(f'{name}_bucket{{le="{1 << i}"}} {acc}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{name}_sum {_fmt(h.total_us)}")
+        lines.append(f"{name}_count {total}")
+
+    def inventory(self) -> List[dict]:
+        """The self-description: name/type/help for every registered
+        metric (the README metric-inventory table's source)."""
+        return [{"name": m["name"], "type": m["type"],
+                 "help": m["help"]} for m in self._metrics]
+
+
+# -- flow metrics (pkg/hubble/metrics analogue) -----------------------
+def register_flow_metrics(reg: MetricsRegistry, fm) -> None:
+    """Register the flow-stream handlers' series (``FlowMetrics``
+    dicts) — the pre-registry ``flow/metrics.py`` render, now behind
+    the one registry (satellite: these counters reach the prometheus
+    endpoint through the same path as everything else)."""
+    reg.counter(
+        "hubble_flows_processed_total",
+        "flows seen on the monitor stream by verdict/direction",
+        lambda: [({"verdict": v, "direction": d}, n)
+                 for (v, d), n in sorted(fm.flows_total.items())])
+    reg.counter(
+        "hubble_drop_total",
+        "dropped flows by datapath reason code/direction",
+        lambda: [({"reason": r, "direction": d}, n)
+                 for (r, d), n in sorted(fm.drops_total.items())])
+    reg.counter(
+        "hubble_port_distribution_total",
+        "destination (protocol, port) histogram over the flow stream",
+        lambda: [({"protocol": p, "port": port}, n)
+                 for (p, port), n in
+                 sorted(fm.port_distribution.items())])
+    reg.counter(
+        "hubble_policy_verdicts_total",
+        "policy-verdict events by verdict/match type",
+        lambda: [({"verdict": v, "match": match}, n)
+                 for (v, match), n in sorted(fm.policy_verdicts.items())])
+
+
+def build_daemon_registry(daemon) -> MetricsRegistry:
+    """Wire one agent's full metric surface: datapath metricsmap,
+    control-plane gauges, serving counters + fault-tolerance plane,
+    the NEW registry-backed idle-tick gauges (queue depth, arena
+    occupancy, in-flight window) and cumulative latency histograms,
+    compile/trace introspection, CT snapshots, and the flow-stream
+    handlers."""
+    state: Dict[str, object] = {}
+
+    def prepare() -> None:
+        state["sv"] = daemon.serving_stats()
+        # snapshot the lock-guarded summaries ONCE per scrape — the
+        # per-key collectors below index these instead of re-taking
+        # the compile-log/tracer locks per metric
+        log = getattr(daemon.loader, "compile_log", None)
+        state["compile"] = (log.summary() if log is not None
+                            else None)
+        s = daemon._serving
+        tr = s.get("tracer") if s is not None else None
+        state["trace"] = tr.stats() if tr is not None else None
+
+    def sv(*keys, active_only: bool = True):
+        """Pluck a value out of the serving snapshot (None omits)."""
+        cur = state.get("sv") or {}
+        if active_only and not cur.get("active"):
+            return None
+        for k in keys:
+            if not isinstance(cur, dict) or k not in cur:
+                return None
+            cur = cur[k]
+        return cur
+
+    def runtime():
+        s = daemon._serving
+        return s.get("runtime") if s is not None else None
+
+    reg = MetricsRegistry(prepare=prepare)
+
+    # -- datapath + control plane -------------------------------------
+    def datapath_packets():
+        m = daemon.loader.metrics()
+        return [({"reason": r, "direction":
+                  "ingress" if d == 0 else "egress"}, int(m[r, d]))
+                for r in range(m.shape[0]) for d in (0, 1)
+                if m[r, d]]
+
+    reg.counter("cilium_datapath_packets_total",
+                "verdicted packets by reason code and direction "
+                "(the device metricsmap)", datapath_packets)
+    reg.gauge("cilium_policy_revision",
+              "policy repository revision",
+              lambda: daemon.repo.revision)
+    reg.gauge("cilium_endpoint_count", "registered local endpoints",
+              lambda: len(daemon.endpoints.list()))
+    reg.gauge("cilium_identity_count", "allocated security identities",
+              lambda: len(daemon.allocator.all_identities()))
+
+    # -- serving counters (only while a session is active) ------------
+    reg.counter("cilium_serving_verdicts_total",
+                "real (valid) rows dispatched by the serving plane",
+                lambda: sv("verdicts"))
+    reg.counter("cilium_serving_shed_total",
+                "packets shed at serving admission",
+                lambda: sv("shed"))
+    reg.counter("cilium_serving_batches_total",
+                "serving batches dispatched", lambda: sv("batches"))
+    reg.counter("cilium_serving_h2d_bytes_total",
+                "host->device header bytes shipped (padding included)",
+                lambda: sv("h2d", "bytes"))
+    reg.counter("cilium_serving_packed_batches_total",
+                "batches shipped in the packed 16 B/packet format",
+                lambda: sv("h2d", "packed-batches"))
+    reg.counter("cilium_serving_route_overflow_total",
+                "packets lost to per-shard block overflow (flow skew)",
+                lambda: sv("route-overflow"))
+
+    # -- fault-tolerance plane ----------------------------------------
+    reg.counter("cilium_serving_restarts_total",
+                "drain-loop restarts spent by the serving watchdog",
+                lambda: sv("fault-tolerance", "restarts"))
+    reg.counter("cilium_serving_dispatch_timeouts_total",
+                "dispatches declared hung at the deadline",
+                lambda: sv("fault-tolerance", "dispatch-timeouts"))
+    reg.counter("cilium_serving_recovery_dropped_total",
+                "rows accounted by the recovery plane "
+                "(dead/hung/failed dispatch + stop sweep)",
+                lambda: sv("fault-tolerance", "recovery-dropped"))
+    reg.gauge("cilium_serving_degraded",
+              "1 while the degraded-mode ladder is below its top rung",
+              lambda: ([({"mode": lad["rung"]},
+                         1 if lad["degraded"] else 0)]
+                       if (lad := sv("ladder")) else None))
+    reg.counter("cilium_serving_demotions_total",
+                "degraded-mode ladder demotions",
+                lambda: (lad["demotions"]
+                         if (lad := sv("ladder")) else None))
+
+    # -- registry-backed gauges.  Queue backlog and the in-flight
+    # window read LIVE at scrape time (plain attribute / len reads —
+    # the idle tick only fires when the queue is EMPTY, so a sampled
+    # copy would read ~0 during exactly the overload episodes the
+    # backlog gauge exists for); arena occupancy iterates the slot
+    # dict, which only the drain thread may do safely, so it stays on
+    # the idle-tick sample (ServingRuntime._sample_gauges) ------------
+    def live_queue(attr):
+        def collect():
+            rt = runtime()
+            return getattr(rt.queue, attr) if rt is not None else None
+
+        return collect
+
+    def idle_gauge(key):
+        def collect():
+            rt = runtime()
+            if rt is None:
+                return None
+            return rt.stats.gauges.get(key)
+
+        return collect
+
+    reg.gauge("cilium_serving_queue_pending",
+              "admission-queue backlog (live at scrape time)",
+              live_queue("pending"))
+    reg.gauge("cilium_serving_queue_depth",
+              "admission-queue capacity", live_queue("capacity"))
+    reg.gauge("cilium_serving_arena_bytes",
+              "staging-arena bytes allocated at the last idle tick",
+              idle_gauge("arena-bytes"))
+    reg.gauge("cilium_serving_arena_shapes",
+              "distinct staging-slot shapes allocated",
+              idle_gauge("arena-shapes"))
+
+    def inflight_window():
+        s = daemon._serving
+        if s is None or s.get("runtime") is None:
+            return None
+        return len(s["window"])
+
+    reg.gauge("cilium_serving_inflight_window",
+              "serve_batch header windows retained for the event join "
+              "(live at scrape time)", inflight_window)
+
+    # -- cumulative latency histograms --------------------------------
+    def hist(attr):
+        def collect():
+            rt = runtime()
+            return getattr(rt.stats, attr) if rt is not None else None
+
+        return collect
+
+    reg.histogram("cilium_serving_queue_wait_us",
+                  "admission -> dispatch wait (µs, log2 buckets)",
+                  hist("queue_wait"))
+    reg.histogram("cilium_serving_latency_us",
+                  "admission -> events-emitted end-to-end latency "
+                  "(µs, log2 buckets)", hist("latency"))
+
+    # -- compile / trace introspection --------------------------------
+    def compile_stat(key):
+        def collect():
+            summ = state.get("compile")
+            return summ[key] if summ is not None else None
+
+        return collect
+
+    reg.counter("cilium_serving_compiles_total",
+                "XLA executables compiled on the serving path",
+                compile_stat("compiles"))
+    reg.counter("cilium_serving_compile_violations_total",
+                "one-executable-per-(rung, mode) invariant violations",
+                compile_stat("violations"))
+    reg.gauge("cilium_serving_executables",
+              "live serving executables by (mode, shape)",
+              compile_stat("executables"))
+
+    def tracer_stat(key):
+        def collect():
+            st = state.get("trace")
+            return st[key] if st is not None else None
+
+        return collect
+
+    reg.counter("cilium_obs_spans_started_total",
+                "trace spans allocated at admission (1-in-N sampled)",
+                tracer_stat("started"))
+    reg.counter("cilium_obs_spans_completed_total",
+                "trace spans that reached the verdict-join boundary",
+                tracer_stat("completed"))
+    reg.counter("cilium_obs_spans_dropped_total",
+                "trace spans whose packet died mid-pipeline",
+                tracer_stat("dropped"))
+
+    # -- CT snapshots (age/entries ride recovery decisions) -----------
+    def ct_snap(key):
+        def collect():
+            snap = daemon.ct_snapshot_info()
+            return snap[key] if snap is not None else None
+
+        return collect
+
+    reg.gauge("cilium_ct_snapshot_age_seconds",
+              "age of the retained CT snapshot recovery would restore",
+              ct_snap("age-seconds"))
+    reg.gauge("cilium_ct_snapshot_entries",
+              "entries in the retained CT snapshot",
+              ct_snap("entries"))
+
+    # -- flow-stream handlers (pkg/hubble/metrics) --------------------
+    register_flow_metrics(reg, daemon.flow_metrics)
+    return reg

@@ -60,6 +60,9 @@ class AssembledBatch(NamedTuple):
     packed: bool = False  # hdr is the 16 B/packet wire format
     ep: int = 0  # stream metadata scalars (packed batches only)
     dirn: int = 0
+    # sampled obs spans riding this batch (obs/trace.py; empty when
+    # tracing is off) — the runtime stamps dispatch/device/join
+    spans: tuple = ()
 
 
 class BucketArena:
@@ -89,6 +92,12 @@ class BucketArena:
         i = self._next.get(key, 0)
         self._next[key] = (i + 1) % self.depth
         return pool[i]
+
+    def occupancy(self) -> Dict[str, int]:
+        """Allocated staging footprint (shapes lazily materialize on
+        first use) — the obs plane's arena-occupancy gauge."""
+        return {"shapes": len(self._slots),
+                "bytes": sum(p.nbytes for p in self._slots.values())}
 
 
 class AdaptiveBatcher:
@@ -157,29 +166,54 @@ class AdaptiveBatcher:
         n, arrivals = queue.take_into(self._scratch)
         if n == 0:
             return None
-        bucket = self.bucket_for(n)
-        rows = self._scratch[:n]
-        packed, ep, dirn = False, 0, 0
-        if self.pack:
-            from ..core.packets import (PACKED_COLS, pack_eligibility,
-                                        pack_rows)
+        # claim the dequeued spans IMMEDIATELY: if any staging work
+        # below raises (injected or organic), they are evicted with
+        # the batch instead of sitting in the queue's dequeued list
+        # to be popped by — and corrupt — a later batch after a
+        # drain-loop restart
+        deq = (queue.pop_dequeued_spans()
+               if queue.tracer is not None else [])
+        try:
+            bucket = self.bucket_for(n)
+            rows = self._scratch[:n]
+            packed, ep, dirn = False, 0, 0
+            if self.pack:
+                from ..core.packets import (PACKED_COLS,
+                                            pack_eligibility,
+                                            pack_rows)
 
-            packed, ep, dirn = pack_eligibility(rows)
-        if packed:
-            hdr = self.arena.slot(bucket, PACKED_COLS)
-            pack_rows(rows, out=hdr)
-        else:
-            hdr = self.arena.slot(bucket, self._scratch.shape[1])
-            hdr[:n] = rows
-        # recycled-slot hygiene, shared by both wire formats: the
-        # tail may hold a previous batch's rows
-        hdr[n:] = 0
-        valid = self.arena.slot(bucket, 0, dtype=bool)
-        valid[:n] = True
-        valid[n:] = False
+                packed, ep, dirn = pack_eligibility(rows)
+            if packed:
+                hdr = self.arena.slot(bucket, PACKED_COLS)
+                pack_rows(rows, out=hdr)
+            else:
+                hdr = self.arena.slot(bucket,
+                                      self._scratch.shape[1])
+                hdr[:n] = rows
+            # recycled-slot hygiene, shared by both wire formats:
+            # the tail may hold a previous batch's rows
+            hdr[n:] = 0
+            valid = self.arena.slot(bucket, 0, dtype=bool)
+            valid[:n] = True
+            valid[n:] = False
+        except BaseException:
+            if deq:
+                queue.tracer.evict(sp for _pos, sp in deq)
+            raise
+        spans = ()
+        if deq:
+            from ..obs.trace import STAGE_STAGED
+
+            t_staged = time.monotonic()
+            for pos, sp in deq:
+                sp.ts[STAGE_STAGED] = t_staged
+                sp.batch_pos = pos
+                sp.bucket = bucket
+                sp.n_valid = n
+            spans = tuple(sp for _pos, sp in deq)
         return AssembledBatch(hdr=hdr, valid=valid, n_valid=n,
                               arrivals=arrivals, packed=packed,
-                              ep=ep, dirn=dirn)
+                              ep=ep, dirn=dirn, spans=spans)
 
     def time_to_deadline(self, queue: IngressQueue,
                          now: Optional[float] = None) -> float:

@@ -48,7 +48,7 @@ class IngressQueue:
             raise ValueError(f"unknown overflow policy {policy!r}")
         self.capacity = int(capacity)
         self.policy = policy
-        self._chunks: deque = deque()  # (rows, t_arrival)
+        self._chunks: deque = deque()  # (rows, t_arrival, spans)
         self._pending = 0
         self.admitted = 0  # packets ever admitted
         self.shed = 0  # packets ever shed (exact)
@@ -56,6 +56,13 @@ class IngressQueue:
         self._shed_retained = 0
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
+        # obs plane (obs/trace.py SpanTracer or None): when armed,
+        # admission allocates spans for 1-in-N admitted packets; the
+        # spans ride their chunk ((offset, span) tuples, offsets
+        # re-based as chunks split/evict) and leave via take_into for
+        # the batcher to stamp.  None = the zero-overhead default.
+        self.tracer = None
+        self._dequeued_spans: List[tuple] = []  # (pos_in_out, span)
 
     # -- producer side -------------------------------------------------
     def offer(self, rows: np.ndarray,
@@ -94,18 +101,34 @@ class IngressQueue:
                     rows = rows[n - accepted:]
                 need = accepted - room
                 while need > 0 and self._chunks:
-                    old, old_t = self._chunks.popleft()
+                    old, old_t, old_sp = self._chunks.popleft()
                     if len(old) <= need:
                         self._shed(old)
+                        if old_sp:
+                            self.tracer.evict(s for _, s in old_sp)
                         self._pending -= len(old)
                         need -= len(old)
                     else:
                         self._shed(old[:need])
-                        self._chunks.appendleft((old[need:], old_t))
+                        if old_sp:
+                            self.tracer.evict(
+                                s for o, s in old_sp if o < need)
+                            old_sp = tuple((o - need, s)
+                                           for o, s in old_sp
+                                           if o >= need)
+                        self._chunks.appendleft((old[need:], old_t,
+                                                 old_sp))
                         self._pending -= need
                         need = 0
             if accepted:
-                self._chunks.append((np.array(rows, copy=True), t))
+                # spans sample over the ADMITTED rows only (the shed
+                # tail never enters the pipeline); the tracer's
+                # admitted-seq counter advances under this lock, so
+                # the sampled set is deterministic per stream
+                spans = (tuple(self.tracer.sample_chunk(accepted, t))
+                         if self.tracer is not None else ())
+                self._chunks.append((np.array(rows, copy=True), t,
+                                     spans))
                 self._pending += accepted
                 self.admitted += accepted
                 self._nonempty.notify()
@@ -151,16 +174,23 @@ class IngressQueue:
         got = 0
         with self._lock:
             while got < n and self._chunks:
-                rows, t = self._chunks[0]
+                rows, t, spans = self._chunks[0]
                 want = n - got
                 if len(rows) <= want:
                     self._chunks.popleft()
                     parts.append(rows)
                     arrivals.append((len(rows), t))
                     got += len(rows)
+                    if spans:  # take() rows bypass the span pipeline
+                        self.tracer.evict(s for _, s in spans)
                 else:
                     parts.append(rows[:want])
-                    self._chunks[0] = (rows[want:], t)
+                    if spans:
+                        self.tracer.evict(
+                            s for o, s in spans if o < want)
+                        spans = tuple((o - want, s) for o, s in spans
+                                      if o >= want)
+                    self._chunks[0] = (rows[want:], t, spans)
                     arrivals.append((want, t))
                     got += want
             self._pending -= got
@@ -193,24 +223,53 @@ class IngressQueue:
             # copy phase: nothing is mutated; a raise here (injected
             # or organic) aborts with the queue intact
             plan: List[int] = []
-            for rows, t in self._chunks:
-                if got >= n:
+            pos = 0
+            for rows, t, _spans in self._chunks:
+                if pos >= n:
                     break
                 faults.check(faults.SITE_QUEUE_TAKE)
-                take = min(len(rows), n - got)
-                out[got:got + take] = rows[:take]
+                take = min(len(rows), n - pos)
+                out[pos:pos + take] = rows[:take]
                 arrivals.append((take, t))
                 plan.append(take)
-                got += take
-            # commit phase: pure pointer moves, cannot fail
+                pos += take
+            # commit phase: pure pointer moves, cannot fail.  Spans
+            # whose rows left stamp STAGE_DEQUEUE here (commit time:
+            # an aborted copy must leave them queued) and move to the
+            # dequeued list the batcher drains right after.
+            t_deq = time.monotonic() if self.tracer is not None else 0.0
             for take in plan:
-                rows, t = self._chunks[0]
+                rows, t, spans = self._chunks[0]
+                if spans:
+                    from ..obs.trace import STAGE_DEQUEUE
+
+                    keep = []
+                    for off, sp in spans:
+                        if off < take:
+                            sp.ts[STAGE_DEQUEUE] = t_deq
+                            self._dequeued_spans.append((got + off,
+                                                         sp))
+                        else:
+                            keep.append((off - take, sp))
+                    spans = tuple(keep)
+                got += take
                 if take == len(rows):
                     self._chunks.popleft()
                 else:
-                    self._chunks[0] = (rows[take:], t)
+                    self._chunks[0] = (rows[take:], t, spans)
             self._pending -= got
         return got, arrivals
+
+    def pop_dequeued_spans(self) -> List[tuple]:
+        """Drain the ``(batch_pos, span)`` pairs the last
+        :meth:`take_into` committed — the batcher attaches them to
+        its :class:`~.batcher.AssembledBatch`.  Single-consumer like
+        take_into itself (the drain thread)."""
+        if not self._dequeued_spans:
+            return []
+        with self._lock:
+            out, self._dequeued_spans = self._dequeued_spans, []
+        return out
 
     def take_sheds(self) -> Tuple[Optional[np.ndarray], int]:
         """Drain the shed accounting accumulated since the last call:

@@ -109,7 +109,11 @@ class ServingRuntime:
                  restart_budget: int = 0,
                  restart_backoff_s: float = 0.01,
                  idle_wait_s: float = DEFAULT_IDLE_WAIT_S,
-                 on_recovery_drop: Optional[RecoveryFn] = None):
+                 on_recovery_drop: Optional[RecoveryFn] = None,
+                 tracer=None,
+                 gauge_fn: Optional[Callable[[], dict]] = None,
+                 profile_dir: Optional[str] = None,
+                 profile_batches: int = 0):
         from .batcher import DEFAULT_ARENA_DEPTH
 
         depth, ladder, wait, policy = validate_serving_config(
@@ -171,6 +175,28 @@ class ServingRuntime:
         # returns (the device runs batches in order, so by then batch
         # N's events have been appended)
         self._prev_arrivals: List[Tuple[int, float]] = []
+        # obs plane (obs/trace.py): the tracer rides the queue (span
+        # allocation at admission) and this loop (dispatch/device/
+        # join stamps); None costs one branch per batch.  The spans
+        # of the batch on device complete WITH its arrivals — same
+        # drain-boundary clock as the latency histogram.
+        self._tracer = tracer
+        self.queue.tracer = tracer
+        self._prev_spans: tuple = ()
+        # idle-tick gauges (arena occupancy + whatever the owner's
+        # gauge_fn adds) land in stats.gauges; gauges that must stay
+        # fresh under load (queue backlog, in-flight window) are read
+        # live by the metrics registry instead — the idle tick only
+        # fires when the queue is empty
+        self._gauge_fn = gauge_fn
+        # optional jax.profiler capture window: trace the first
+        # profile_batches dispatches into profile_dir, then stop —
+        # the batch-scoped sibling of GET /debug/profile's
+        # wall-clock window
+        self._profile_dir = profile_dir
+        self._profile_batches = int(profile_batches)
+        self._profile_state = "armed" if profile_dir else "off"
+        self._profile_count = 0
 
     # -- producer side (any thread) -----------------------------------
     def submit(self, rows: np.ndarray,
@@ -311,10 +337,13 @@ class ServingRuntime:
             # loss; the error rides the snapshot)
             self._sweep_queue_as_recovery_drops()
         if self._prev_arrivals:
-            self.stats.record_completion(self._prev_arrivals,
-                                         time.monotonic())
+            t_done = time.monotonic()
+            self.stats.record_completion(self._prev_arrivals, t_done)
             self._prev_arrivals = []
+            self._complete_spans(t_done)
         self._flush_sheds()
+        if self._profile_state == "active":
+            self._profile_stop()
         return self.snapshot()
 
     def snapshot(self) -> dict:
@@ -328,6 +357,11 @@ class ServingRuntime:
             ft["restart-budget"] = self._budget
             ft["dispatch-deadline-ms"] = round(self._deadline_s * 1e3,
                                                3)
+        if self._tracer is not None:
+            out["trace"] = self._tracer.stats()
+        prof = self.profile_status()
+        if prof is not None:
+            out["profile"] = prof
         return out
 
     # -- the drain loop ------------------------------------------------
@@ -357,9 +391,11 @@ class ServingRuntime:
             # returned, residual device work is bounded by the drain
             # cadence.
             if self._prev_arrivals:
+                t_done = time.monotonic()
                 self.stats.record_completion(self._prev_arrivals,
-                                             time.monotonic())
+                                             t_done)
                 self._prev_arrivals = []
+                self._complete_spans(t_done)
             self._flush_sheds()
             if self.queue.pending:
                 # rows are waiting but neither full-bucket nor
@@ -373,12 +409,23 @@ class ServingRuntime:
                 if ttd > 0.0:
                     time.sleep(min(ttd, _TICK_S))
             else:
+                # the idle tick: the registry-backed gauges (queue
+                # depth, arena occupancy, in-flight window) sample
+                # here — off the dispatch path, at the idle cadence
+                self._sample_gauges()
                 self.queue.wait_nonempty(self._idle_wait_s)
 
     def _dispatch_one(self, batch: AssembledBatch, gen: int) -> None:
         from . import DispatchFailedError
 
+        if self._profile_state == "armed":
+            self._profile_start()
         t0 = time.monotonic()
+        if batch.spans:
+            from ..obs.trace import STAGE_DISPATCH
+
+            for sp in batch.spans:
+                sp.ts[STAGE_DISPATCH] = t0
         shape = (batch.hdr.shape, batch.packed)
         # register BEFORE the device leg: a death or hang from here on
         # can always be accounted by the watchdog / stop()
@@ -439,18 +486,120 @@ class ServingRuntime:
         # sharded leg re-packs AFTER flow routing, so the assembled
         # batch's format/size can differ from the shipped one
         h2d, packed = None, batch.packed
+        mode = "packed" if batch.packed else "wide"
+        demoted, bid = False, -1
         if isinstance(info, dict):
             h2d = info.get("h2d_bytes")
             if "mode" in info:
-                packed = "packed" in info["mode"]
+                mode = info["mode"]
+                packed = "packed" in mode
+            demoted = bool(info.get("demoted"))
+            bid = int(info.get("batch_id", -1))
+        spans = batch.spans
+        if spans:
+            from ..obs.trace import STAGE_DEVICE
+
+            shard_of = (info.get("shard_of")
+                        if isinstance(info, dict) else None)
+            overflowed = []
+            kept = []
+            for sp in spans:
+                sp.ts[STAGE_DEVICE] = t1
+                sp.mode = mode
+                sp.demoted = demoted
+                sp.batch_id = bid
+                if (shard_of is not None
+                        and 0 <= sp.batch_pos < len(shard_of)):
+                    sp.shard = int(shard_of[sp.batch_pos])
+                    if sp.shard < 0:
+                        # the router dropped this packet (full shard
+                        # block): its DROP event is already counted,
+                        # and its span is a counted loss — a
+                        # completed trace would report a fake e2e
+                        # latency for a packet the device never saw
+                        overflowed.append(sp)
+                        continue
+                kept.append(sp)
+            if overflowed and self._tracer is not None:
+                self._tracer.evict(overflowed)
+            spans = tuple(kept)
         self.stats.record_batch(batch.n_valid, len(batch.hdr),
                                 batch.arrivals, t0, packed=packed,
                                 h2d_bytes=(h2d if h2d is not None
                                            else batch.hdr.nbytes))
         if self._prev_arrivals:
             self.stats.record_completion(self._prev_arrivals, t1)
+        self._complete_spans(t1)
         self._prev_arrivals = batch.arrivals
+        self._prev_spans = spans
         self._flush_sheds()
+        if self._profile_state == "active":
+            self._profile_count += 1
+            if self._profile_count >= self._profile_batches:
+                self._profile_stop()
+
+    # -- the obs plane (spans, gauges, profile window) -----------------
+    def _complete_spans(self, t_done: float) -> None:
+        """The batch whose arrivals just completed reached the join
+        boundary: stamp STAGE_JOIN and commit its spans (same clock
+        as the end-to-end latency histogram)."""
+        spans, self._prev_spans = self._prev_spans, ()
+        if not spans or self._tracer is None:
+            return
+        from ..obs.trace import STAGE_JOIN
+
+        for sp in spans:
+            sp.ts[STAGE_JOIN] = t_done
+            self._tracer.commit(sp)
+
+    def _sample_gauges(self) -> None:
+        # queue backlog/depth deliberately NOT copied here: the idle
+        # tick only fires when the queue is empty, so a sampled copy
+        # would read ~0 during exactly the overload episodes a
+        # backlog gauge exists for — the registry reads them live.
+        # Arena occupancy iterates the slot dict, which only this
+        # (drain) thread may do safely, hence the sampled copy
+        occ = self.batcher.arena.occupancy()
+        g = {"arena-shapes": occ["shapes"],
+             "arena-bytes": occ["bytes"]}
+        if self._gauge_fn is not None:
+            try:
+                g.update(self._gauge_fn())
+            except Exception:  # noqa: BLE001 — a gauge hook must
+                pass  # never kill the drain loop
+        g["sampled-at"] = time.monotonic()
+        self.stats.gauges = g  # whole-dict swap: no torn reads
+
+    def _profile_start(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.start_trace(self._profile_dir)
+            self._profile_state = "active"
+        except Exception as e:  # noqa: BLE001 — profiling is
+            # best-effort; a capture failure must not kill serving
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "serving profile capture failed to start: %s", e)
+            self._profile_state = "failed"
+
+    def _profile_stop(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._profile_state = "done"
+        except Exception:  # noqa: BLE001
+            self._profile_state = "failed"
+
+    def profile_status(self) -> Optional[dict]:
+        if self._profile_state == "off":
+            return None
+        return {"dir": self._profile_dir,
+                "state": self._profile_state,
+                "batches": self._profile_count,
+                "window": self._profile_batches}
 
     def _flush_sheds(self) -> None:
         rows, count = self.queue.take_sheds()
@@ -529,6 +678,10 @@ class ServingRuntime:
         from ..datapath.verdict import (REASON_DISPATCH_TIMEOUT,
                                         REASON_RECOVERY_DROP)
 
+        if batch.spans and self._tracer is not None:
+            # the batch died before the join boundary: its spans are
+            # counted losses, never completed traces
+            self._tracer.evict(batch.spans)
         n = batch.n_valid
         if n == 0:
             return

@@ -7,9 +7,11 @@ packets see, and is the runtime keeping up with offered load.
 Exposed through ``GET /serving`` and ``cilium-tpu serving stats``.
 
 Histograms are fixed log2 buckets in microseconds (1µs .. ~17min) —
-constant memory, lock-cheap to record, and percentile reads return
-the bucket upper bound (the conservative read: a reported p99 is
-never better than reality).
+constant memory, lock-cheap to record.  Percentile reads LINEARLY
+INTERPOLATE within the winning bucket (the upper bound overstated
+p99 by up to 2x at coarse buckets); ``percentile(p, upper=True)``
+keeps the conservative bucket-upper-bound read for callers that
+want "never better than reality".
 """
 
 from __future__ import annotations
@@ -28,6 +30,7 @@ class LatencyHistogram:
         self.buckets = [0] * N_BUCKETS
         self.count = 0
         self.max_us = 0.0
+        self.total_us = 0.0  # the prometheus histogram _sum
 
     def record(self, us: float) -> None:
         if us < 0:
@@ -35,22 +38,34 @@ class LatencyHistogram:
         idx = min(max(int(us), 0).bit_length(), N_BUCKETS - 1)
         self.buckets[idx] += 1
         self.count += 1
+        self.total_us += us
         if us > self.max_us:
             self.max_us = us
 
-    def percentile(self, p: float) -> Optional[float]:
-        """Upper bound of the bucket holding the p-quantile (None
-        when empty)."""
+    def percentile(self, p: float,
+                   upper: bool = False) -> Optional[float]:
+        """The p-quantile, linearly interpolated within the winning
+        log2 bucket (None when empty).  ``upper=True`` returns the
+        bucket's upper bound instead — the conservative read (a
+        reported p99 is never better than reality), which the
+        default overstated by up to 2x at coarse buckets."""
         if self.count == 0:
             return None
         target = p * self.count
         acc = 0
         for i, c in enumerate(self.buckets):
+            if not c:
+                continue
+            if acc + c >= target:
+                # bucket i holds [2^(i-1), 2^i); bucket 0 is [0, 1)
+                hi = float(min(1 << i, max(self.max_us, 1.0)))
+                if upper:
+                    return hi
+                lo = float(1 << (i - 1)) if i else 0.0
+                hi = min(float(1 << i), max(self.max_us, lo))
+                frac = (target - acc) / c
+                return lo + frac * (hi - lo)
             acc += c
-            if acc >= target:
-                # bucket i holds [2^(i-1), 2^i); report its upper
-                # bound, capped at the observed max
-                return float(min(1 << i, max(self.max_us, 1.0)))
         return self.max_us
 
     def snapshot(self) -> Dict[str, Optional[float]]:
@@ -102,6 +117,11 @@ class ServingStats:
         self.restarts = 0  # drain-thread restarts
         self.last_restart_cause = ""
         self.last_restart_at: Optional[float] = None  # monotonic
+        # point-in-time gauges sampled by the drain loop's idle tick
+        # (queue depth, arena occupancy, in-flight window) — written
+        # whole-dict by the runtime, read by the metrics registry, so
+        # no lock is needed beyond the GIL's dict-swap atomicity
+        self.gauges: Dict[str, float] = {}
 
     # -- recording (runtime thread) -----------------------------------
     def record_submit(self, offered: int, accepted: int) -> None:
@@ -209,6 +229,7 @@ class ServingStats:
                 },
                 "queue-pending": queue_pending,
                 "queue-depth": queue_depth,
+                "gauges": dict(self.gauges),
                 "queue-wait-us": self.queue_wait.snapshot(),
                 "latency-us": self.latency.snapshot(),
                 "fault-tolerance": {

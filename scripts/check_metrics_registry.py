@@ -1,0 +1,94 @@
+#!/usr/bin/env python
+"""Lint: prometheus exposition text is built ONLY in the unified
+registry (cilium_tpu/obs/registry.py).
+
+Before the registry existed the /metrics body was hand-assembled in
+four modules, each inventing its own `# TYPE` lines and label
+formatting; this check fails the suite if that scatter regrows.  Two
+things are flagged anywhere outside the registry module:
+
+1. a ``# TYPE`` exposition header inside a string literal (the
+   unmistakable signature of hand-built exposition text);
+2. an f-string interpolating label values into a metric sample, i.e.
+   a literal like ``some_metric_total{...="...``.
+
+Registering a metric NAME with the registry (a plain string passed
+to ``registry.counter(...)``) is fine — names must live at their
+declaration sites; only the exposition *rendering* is centralized.
+
+Exit status 0 = clean; 1 = violations (printed one per line).
+Run it standalone, or via tests/test_obs_registry.py (tier-1).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import sys
+import tokenize
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "cilium_tpu")
+# the one module allowed to build exposition text
+ALLOWED = {os.path.join("cilium_tpu", "obs", "registry.py")}
+
+# exposition-text signatures inside a string literal
+_TYPE_LINE = re.compile(r"#\s*TYPE\s+\w+\s+(counter|gauge|histogram)")
+# metric sample with inline labels: name{key="  (catches both the
+# f-string template text and fully literal lines)
+_SAMPLE = re.compile(r"\b[a-z][a-z0-9_]*_(total|bucket|sum|count|"
+                     r"seconds|bytes|info)\{[^}]*=")
+_GENERIC_SAMPLE = re.compile(r"\b(cilium|hubble)_[a-z0-9_]+\{")
+
+
+def scan_file(path: str) -> list:
+    with open(path, "rb") as f:
+        src = f.read()
+    out = []
+    try:
+        toks = tokenize.tokenize(io.BytesIO(src).readline)
+        for tok in toks:
+            if tok.type not in (tokenize.STRING,
+                                getattr(tokenize, "FSTRING_MIDDLE",
+                                        -1)):
+                continue
+            s = tok.string
+            for pat, what in ((_TYPE_LINE, "# TYPE exposition line"),
+                              (_SAMPLE, "labelled metric sample"),
+                              (_GENERIC_SAMPLE,
+                               "labelled metric sample")):
+                if pat.search(s):
+                    out.append((tok.start[0], what, s.strip()[:70]))
+                    break
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def main() -> int:
+    bad = []
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for name in filenames:
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, REPO)
+            if rel in ALLOWED:
+                continue
+            for line, what, snippet in scan_file(path):
+                bad.append(f"{rel}:{line}: {what} outside the "
+                           f"metrics registry: {snippet!r}")
+    if bad:
+        print("metrics-registry lint FAILED — exposition text must "
+              "only be built in cilium_tpu/obs/registry.py "
+              "(register a collector instead):", file=sys.stderr)
+        for b in bad:
+            print("  " + b, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

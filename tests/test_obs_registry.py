@@ -1,0 +1,215 @@
+"""Observability: the unified metrics registry, cumulative-bucket
+histograms, percentile interpolation, the compile-event log, and the
+exposition-scatter lint (cilium_tpu/obs + scripts/).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import Daemon, DaemonConfig
+from cilium_tpu.obs.compile_log import CompileLog
+from cilium_tpu.obs.registry import (MetricsRegistry,
+                                     register_flow_metrics)
+from cilium_tpu.serving import LatencyHistogram
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "check_metrics_registry.py")
+
+
+class TestPercentileInterpolation:
+    def test_interpolates_within_the_winning_bucket(self):
+        """100 samples at 520..619µs all land in the [512, 1024)
+        bucket; the old upper-bound read called every percentile
+        1024 (2x the true p50).  Interpolation spreads the quantile
+        across the bucket."""
+        h = LatencyHistogram()
+        for us in range(520, 620):
+            h.record(float(us))
+        p50 = h.percentile(0.5)
+        assert 512 <= p50 < 800  # interpolated, not the 1024 bound
+        assert h.percentile(0.99) <= h.max_us + 1e-9
+        # the conservative read stays available and unchanged
+        assert h.percentile(0.5, upper=True) == 619  # min(1024, max)
+        h2 = LatencyHistogram()
+        for us in (10, 10, 10, 1000):
+            h2.record(us)
+        assert h2.percentile(0.5, upper=True) == 16  # 2^4 >= 10
+
+    def test_percentiles_stay_ordered_and_bounded(self):
+        h = LatencyHistogram()
+        rng = np.random.default_rng(5)
+        for us in rng.exponential(300.0, size=2000):
+            h.record(float(us))
+        snap = h.snapshot()
+        assert snap["p50"] <= snap["p95"] <= snap["p99"]
+        assert snap["p99"] <= snap["max"] + 1e-9
+        for p in (0.5, 0.95, 0.99):
+            assert h.percentile(p) <= h.percentile(p, upper=True) \
+                + 1e-9
+
+    def test_empty_and_single_value(self):
+        h = LatencyHistogram()
+        assert h.percentile(0.5) is None
+        h.record(100.0)
+        assert 64 <= h.percentile(0.5) <= 100.0
+        assert h.total_us == 100.0
+
+
+class TestRegistryRender:
+    def test_counter_gauge_labels_and_omission(self):
+        reg = MetricsRegistry()
+        reg.counter("x_total", "x", lambda: 7)
+        reg.gauge("g", "g", lambda: None)  # omitted
+        reg.counter("lab_total", "l",
+                    lambda: [({"a": 1, "b": "y"}, 2)])
+        text = reg.render()
+        assert "# TYPE x_total counter\nx_total 7" in text
+        assert "# TYPE g gauge" not in text  # None => omitted
+        assert 'lab_total{a="1",b="y"} 2' in text
+
+    def test_histogram_renders_cumulative_buckets(self):
+        h = LatencyHistogram()
+        for us in (0.5, 3.0, 3.0, 100.0):
+            h.record(us)
+        reg = MetricsRegistry()
+        reg.histogram("lat_us", "lat", lambda: h)
+        text = reg.render()
+        assert "# TYPE lat_us histogram" in text
+        # cumulative: le=1 holds the 0.5; le=4 adds both 3.0s
+        assert 'lat_us_bucket{le="1"} 1' in text
+        assert 'lat_us_bucket{le="4"} 3' in text
+        assert 'lat_us_bucket{le="128"} 4' in text
+        assert 'lat_us_bucket{le="+Inf"} 4' in text
+        assert "lat_us_count 4" in text
+        assert "lat_us_sum 106.5" in text
+
+    def test_duplicate_registration_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("a_total", "a", lambda: 1)
+        with pytest.raises(ValueError, match="registered twice"):
+            reg.counter("a_total", "again", lambda: 2)
+
+    def test_broken_collector_does_not_kill_the_scrape(self):
+        reg = MetricsRegistry()
+        reg.counter("bad_total", "b",
+                    lambda: (_ for _ in ()).throw(RuntimeError()))
+        reg.counter("good_total", "g", lambda: 1)
+        assert "good_total 1" in reg.render()
+
+    def test_flow_metrics_ride_the_registry(self):
+        """Satellite: the flow counters reach the prometheus text
+        through the same registry as everything else."""
+        from cilium_tpu.flow import FlowMetrics
+
+        fm = FlowMetrics()
+        fm.flows_total[("forwarded", "ingress")] = 5
+        fm.drops_total[(9, "egress")] = 2
+        reg = MetricsRegistry()
+        register_flow_metrics(reg, fm)
+        text = reg.render()
+        assert ('hubble_flows_processed_total{verdict="forwarded",'
+                'direction="ingress"} 5') in text
+        assert ('hubble_drop_total{reason="9",direction="egress"} 2'
+                ) in text
+        # the standalone render delegates to the same renderer
+        assert fm.render() == text
+
+
+class TestDaemonRegistry:
+    def test_daemon_surface_is_self_describing(self):
+        """Interpreter backend (no XLA compiles): the full inventory
+        is queryable and the legacy names render."""
+        d = Daemon(DaemonConfig(backend="interpreter"))
+        inv = {m["name"]: m for m in d.registry.inventory()}
+        for name in ("cilium_datapath_packets_total",
+                     "cilium_policy_revision",
+                     "cilium_serving_verdicts_total",
+                     "cilium_serving_restarts_total",
+                     "cilium_serving_queue_pending",
+                     "cilium_serving_latency_us",
+                     "cilium_serving_compiles_total",
+                     "cilium_obs_spans_completed_total",
+                     "cilium_ct_snapshot_age_seconds",
+                     "hubble_flows_processed_total"):
+            assert name in inv, name
+            assert inv[name]["help"]  # self-describing
+        text = d.registry.render()
+        assert f"cilium_policy_revision {d.repo.revision}" in text
+        assert "cilium_endpoint_count 0" in text
+        # serving inactive: its counters are omitted, like the
+        # pre-registry exposition
+        assert "cilium_serving_verdicts_total" not in text
+        d.shutdown()
+
+    def test_metrics_text_delegates_to_registry(self):
+        from cilium_tpu.api.server import _metrics_text
+
+        d = Daemon(DaemonConfig(backend="interpreter"))
+        assert _metrics_text(d) == d.registry.render()
+        d.shutdown()
+
+
+class TestCompileLog:
+    def test_records_growth_and_flags_same_key_regrowth(self):
+        log = CompileLog()
+        log.record_dispatch("wide", (64, 16), 0, 1, 0.5,
+                            key_extra=(32768,))
+        assert log.summary() == {"compiles": 1, "executables": 1,
+                                 "violations": 0}
+        # a DIFFERENT key growing is a legitimate second executable
+        log.record_dispatch("packed", (64, 4), 1, 2, 0.2,
+                            key_extra=(32768,))
+        assert log.summary()["violations"] == 0
+        # the SAME key growing again is the retrace trap
+        log.record_dispatch("wide", (64, 16), 2, 3, 0.4,
+                            key_extra=(32768,))
+        s = log.summary()
+        assert s["violations"] == 1 and s["compiles"] == 3
+        snap = log.snapshot()
+        assert snap["events"][-1]["duplicate"] is True
+        assert snap["events"][-1]["compile-ms"] == 400.0
+        dup = [k for k in snap["by-key"] if k["compiles"] == 2]
+        assert len(dup) == 1 and dup[0]["mode"] == "wide"
+
+    def test_no_growth_records_nothing(self):
+        log = CompileLog()
+        log.record_dispatch("wide", (64, 16), 3, 3, 0.1)
+        assert log.summary()["compiles"] == 0
+
+
+class TestRegistryLint:
+    def test_tree_is_clean(self):
+        """CI/tooling satellite: no prometheus exposition text is
+        built outside obs/registry.py."""
+        out = subprocess.run([sys.executable, LINT],
+                             capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr
+
+    def test_lint_catches_hand_built_exposition(self, tmp_path):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            import check_metrics_registry as lint
+        finally:
+            sys.path.pop(0)
+        bad = tmp_path / "scatter.py"
+        bad.write_text(
+            "def render(v):\n"
+            "    lines = ['# TYPE foo_total counter']\n"
+            "    lines.append(f'cilium_foo_total{{x=\"{v}\"}} 1')\n"
+            "    return lines\n")
+        hits = lint.scan_file(str(bad))
+        assert len(hits) == 2
+        ok = tmp_path / "registration.py"
+        ok.write_text(
+            "def register(reg):\n"
+            "    reg.counter('cilium_foo_total', 'help',\n"
+            "                lambda: 1)\n")
+        assert lint.scan_file(str(ok)) == []

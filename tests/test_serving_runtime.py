@@ -227,13 +227,17 @@ class TestServingConfigValidation:
 
 
 class TestLatencyHistogram:
-    def test_percentiles_are_conservative_upper_bounds(self):
+    def test_percentiles_interpolate_with_conservative_option(self):
         h = LatencyHistogram()
         assert h.percentile(0.5) is None
         for us in (10, 10, 10, 1000):
             h.record(us)
-        assert h.percentile(0.5) == 16  # 2^4 >= 10
-        assert h.percentile(0.99) >= 1000
+        # default: linear interpolation within the [8, 16) bucket
+        assert 8 <= h.percentile(0.5) < 16
+        # upper=True keeps the conservative bucket-bound read
+        assert h.percentile(0.5, upper=True) == 16  # 2^4 >= 10
+        assert h.percentile(0.99) >= 512  # in the 1000's bucket
+        assert h.percentile(0.99, upper=True) >= 1000
         snap = h.snapshot()
         assert snap["count"] == 4 and snap["max"] == 1000
         assert snap["p50"] <= snap["p95"] <= snap["p99"]
