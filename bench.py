@@ -802,7 +802,19 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
     split and measured h2d bytes/packet alongside the ratio.  Both
     sides are measured 3x INTERLEAVED and compared best-of-3 —
     single-shot CPU wall timings swing +-15%, and the ratio must
-    measure the front end, not scheduling weather."""
+    measure the front end, not scheduling weather.
+
+    Since PR 5 the overload legs run with EVENT DECODE ENABLED: the
+    headline ``sustained_pps`` at the production-default
+    ``trace_sample=1024`` (PR 4 measured with events disabled
+    outright), plus a dedicated DECODE-UNDER-LOAD leg
+    (``sustained_pps_decode``, ``trace_sample=1``: every admitted
+    packet appends a ring event, every event is
+    fetched/decoded/joined/emitted on the async event plane's
+    worker).  ``d2h_bytes_per_event`` + ``event_join_lag_us`` come
+    from that leg's best rep, and ``d2h_scaling`` contrasts the
+    occupancy-bounded gather against the legacy full-capacity copy
+    at LOW occupancy, where the diet matters."""
     import ipaddress
 
     import jax
@@ -851,16 +863,35 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
     from cilium_tpu.core.packets import pack_eligibility, pack_rows
 
     # ---- warm every compiled shape once (shared by all reps):
-    # wide ladder (offline side) + packed ladder (ingress side)
-    d.start_serving(trace_sample=0, packed=True)
-    for b in LADDER:
-        d.serve_batch(batch(b), valid=np.ones(b, dtype=bool))
-        w = batch(b)
-        ok, ep, dirn = pack_eligibility(w)
-        assert ok, "bench traffic must be packed-eligible"
-        d.serve_batch(pack_rows(w), valid=np.ones(b, dtype=bool),
-                      packed_meta=(ep, dirn))
-    d.stop_serving()
+    # wide + packed ladders at trace_sample=0 (the offline ceiling)
+    # AND trace_sample=1 (the decode-under-load ingress side) —
+    # trace_sample is a static arg, so each value is its own
+    # executable and an unwarmed one would bill XLA compile time to
+    # the first timed rep
+    for ts in (0, 1024, 1):
+        # the ts>0 sessions mirror the overload legs' 2^16 ring —
+        # the gather executables key on (rung, shards, capacity)
+        d.start_serving(ring_capacity=(1 << 16) if ts else (1 << 15),
+                        trace_sample=ts, packed=True)
+        for b in LADDER:
+            d.serve_batch(batch(b), valid=np.ones(b, dtype=bool))
+            w = batch(b)
+            ok, ep, dirn = pack_eligibility(w)
+            assert ok, "bench traffic must be packed-eligible"
+            d.serve_batch(pack_rows(w), valid=np.ones(b, dtype=bool),
+                          packed_meta=(ep, dirn))
+        if ts:
+            # fill one whole drain window at full occupancy so the
+            # top ring-gather rung (the one the timed overload legs
+            # hit) compiles here, not inside a timed rep
+            w = batch(B)
+            ok, ep, dirn = pack_eligibility(w)
+            pw = pack_rows(w)
+            for _ in range(4):
+                d.serve_batch(pw.copy(),
+                              valid=np.ones(B, dtype=bool),
+                              packed_meta=(ep, dirn))
+        d.stop_serving()
 
     valid = np.ones(B, dtype=bool)
     chunks = [batch(max(int(rng.poisson(4096.0)), 1))
@@ -877,15 +908,26 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
         d.stop_serving()
         return offline_batches * B / dt
 
-    def rep_overload(span_sample=0):
+    def rep_overload(span_sample=0, trace_sample=1024):
         """Overload: Poisson chunks offered until the target volume
         is ADMITTED, backing off only when the queue is full —
         offered load exceeds capacity, so sheds are expected and
         counted.  The ingress runtime ships eligible buckets packed
-        (16 B/packet h2d).  ``span_sample`` arms the obs span tracer
-        (the trace-overhead leg); 0 keeps the production default
-        (tracer None, one is-None branch on the hot path)."""
-        d.start_serving(trace_sample=0, ingress=True, packed=True,
+        (16 B/packet h2d) with event decode ENABLED
+        (``trace_sample=1024`` is the production default;
+        ``trace_sample=1`` is the decode-under-load leg — every
+        admitted packet appends a ring event; either way the async
+        event plane fetches the occupancy-bounded gather and
+        decodes/joins/emits on its worker, off the dispatch path).
+        ``span_sample`` arms the obs span tracer (the trace-overhead
+        leg); 0 keeps the production default (tracer None, one
+        is-None branch on the hot path)."""
+        # 2^16 ring: a full drain window (drain_every=4 x 8192-row
+        # buckets at trace_sample=1) is half the capacity, so the
+        # bench measures the gather diet, never lap loss
+        d.start_serving(ring_capacity=1 << 16,
+                        trace_sample=trace_sample,
+                        ingress=True, packed=True,
                         span_sample=span_sample or None)
         admitted = offered = i = 0
         t0 = time.perf_counter()
@@ -900,34 +942,43 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
         stats = d.stop_serving()  # drains everything admitted
         dt = time.perf_counter() - t0
         fe = stats["front-end"]
-        return fe["verdicts"] / dt, fe, offered
+        return fe["verdicts"] / dt, fe, offered, stats["event-plane"]
 
     # ---- best-of-3 INTERLEAVED: rep k runs offline then overload
     # back to back, so both sides sample the same machine weather.
     # fe/offered come from the SAME rep as the reported max pps —
     # mixed-provenance telemetry would mislead anyone correlating
     # the ratio with the shed/queue-wait numbers
-    offline_pps = sustained_pps = traced_pps = 0.0
-    fe = offered = fe_traced = None
+    offline_pps = sustained_pps = decode_pps = traced_pps = 0.0
+    fe = offered = fe_traced = ev = dec_ev = None
     for _ in range(3):
         offline_pps = max(offline_pps, rep_offline())
-        pps, rep_fe, rep_offered = rep_overload()
+        pps, rep_fe, rep_offered, rep_ev = rep_overload()
         if pps > sustained_pps:
-            sustained_pps, fe, offered = pps, rep_fe, rep_offered
+            sustained_pps, fe, offered, ev = (pps, rep_fe,
+                                              rep_offered, rep_ev)
+        # the PR 5 decode-under-load leg: identical overload, every
+        # packet an event — the event plane's worker decodes ~all of
+        # the admitted volume while the drain thread keeps
+        # dispatching
+        pps_dec, _, _, rep_dec_ev = rep_overload(trace_sample=1)
+        if pps_dec > decode_pps:
+            decode_pps, dec_ev = pps_dec, rep_dec_ev
         # the obs satellite's guard leg: the SAME overload rep with
         # 1-in-64 span tracing armed, interleaved so both legs see
         # the same machine weather.  trace_overhead_ratio ~ 1.0
         # documents the sampled cost; the DISABLED cost is the
         # default path above (tracer None) and is what the pre/post
         # bench comparison defends
-        pps_tr, rep_fe_tr, _ = rep_overload(span_sample=64)
+        pps_tr, rep_fe_tr, _, _ = rep_overload(span_sample=64)
         if pps_tr > traced_pps:
             traced_pps, fe_traced = pps_tr, rep_fe_tr
 
     # ---- paced: Poisson arrivals at ~50% of the offline rate — the
     # latency-percentile run (at overload, queue wait just measures
     # queue depth)
-    d.start_serving(trace_sample=0, ingress=True, packed=True)
+    d.start_serving(ring_capacity=1 << 16, trace_sample=1,
+                    ingress=True, packed=True)
     rate = max(offline_pps * 0.5, 1.0)
     t_end = time.perf_counter() + paced_seconds
     i = 0
@@ -936,7 +987,23 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
         i += 1
         d.submit(c)
         time.sleep(float(rng.exponential(len(c) / rate)))
-    paced = d.stop_serving()["front-end"]
+    paced_out = d.stop_serving()
+    paced = paced_out["front-end"]
+    paced_ev = paced_out["event-plane"]
+
+    # ---- d2h scaling contrast: the same LOW-occupancy window (one
+    # 512-row bucket per drain tick on the 2^16 ring) fetched via the
+    # occupancy-bounded gather vs the legacy full-capacity copy —
+    # the bytes-per-event gap IS the tentpole's d2h diet
+    scaling = {"ring_capacity": 1 << 16}
+    for label, g in (("gather", True), ("fullcopy", False)):
+        d.start_serving(ring_capacity=1 << 16, drain_every=1,
+                        trace_sample=1, packed=True, event_gather=g)
+        b = LADDER[0]
+        for _ in range(4):
+            d.serve_batch(batch(b), valid=np.ones(b, dtype=bool))
+        sc = d.stop_serving()["event-plane"]
+        scaling[f"{label}_bytes_per_event"] = sc["d2h-bytes-per-event"]
     d.shutdown()
 
     return {
@@ -954,6 +1021,25 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
         "h2d_bytes_per_packet": fe["h2d"]["bytes-per-packet"],
         "packed_batches": fe["h2d"]["packed-batches"],
         "wide_batches": fe["h2d"]["wide-batches"],
+        # the d2h link scoreboard (PR 5 tentpole): event decode is ON
+        # in every overload/paced leg (sustained_pps at the
+        # production-default trace_sample=1024; sustained_pps_decode
+        # with EVERY packet an event), the fetch is the
+        # occupancy-bounded gather, and decode/join/emit run on the
+        # event-join worker off the dispatch path
+        "event_decode": "enabled (trace_sample=1024 headline; "
+                        "decode leg trace_sample=1)",
+        "sustained_pps_decode": round(decode_pps),
+        "decode_overhead_ratio": round(decode_pps / sustained_pps, 4)
+        if sustained_pps else None,
+        "d2h_bytes_per_event": dec_ev["d2h-bytes-per-event"],
+        "event_join_lag_us": dec_ev["join-lag-us"],
+        "event_windows": {"joined": dec_ev["windows-joined"],
+                          "dropped": dec_ev["windows-dropped"],
+                          "ring-lost": dec_ev["ring-lost"],
+                          "events-joined": dec_ev["events-joined"]},
+        "paced_d2h_bytes_per_event": paced_ev["d2h-bytes-per-event"],
+        "d2h_scaling": scaling,
         "bucket_ladder": list(LADDER),
         "max_wait_us": 2000.0,
         "overload_queue_wait_us": fe["queue-wait-us"],
@@ -972,10 +1058,16 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
         "platform": jax.default_backend(),
         "note": ("serving front end (admission queue + power-of-two "
                  "bucket batcher + drain loop, PACKED 16 B/packet "
-                 "h2d) vs offline pre-assembled wide buckets; "
-                 "serving_vs_offline is the front end's overhead "
-                 "ratio, best-of-3 interleaved; sheds are counted "
-                 "monitor DROP events (REASON_INGRESS_OVERFLOW)"),
+                 "h2d, EVENT DECODE enabled on the async event "
+                 "plane: headline at the production-default "
+                 "trace_sample=1024, decode-under-load leg at "
+                 "trace_sample=1) vs offline pre-assembled wide "
+                 "buckets at trace_sample=0; serving_vs_offline is "
+                 "the front end's overhead ratio, best-of-3 "
+                 "interleaved; sheds are counted monitor DROP "
+                 "events (REASON_INGRESS_OVERFLOW); d2h_scaling "
+                 "contrasts the occupancy-bounded gather with the "
+                 "legacy full-capacity copy at low ring occupancy"),
     }
 
 

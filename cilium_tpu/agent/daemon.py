@@ -115,6 +115,19 @@ class DaemonConfig:
     # ep/dir streams) keeps the wide fallback shape either way.
     # start_serving(packed=...) overrides per session.
     serving_packed_ingest: bool = False
+    # -- the async event plane (serving/eventplane.py).  How many
+    # drain windows the event-join worker's bounded queue may hold;
+    # overflow drops the OLDEST offered window, counted
+    # (windows-dropped), never silently.  Also sizes the batcher
+    # arena's recycling horizon: header slots must outlive every
+    # window in flight on the worker, so arena memory scales with
+    # (depth + 2) * drain_every slots per bucket shape
+    serving_window_queue_depth: int = 4
+    # occupancy-bounded ring drain: fetch a power-of-two-rung device
+    # GATHER of just the window's occupied slots instead of the full
+    # ring — d2h bytes scale with events appended, not ring capacity.
+    # False falls back to the full-capacity copy (the pre-PR5 wire)
+    serving_event_gather: bool = True
     # -- serving fault tolerance (cilium_tpu/serving runtime watchdog
     # + degraded-mode ladder; the cilium-health / endpoint-
     # regeneration analogue for the serving plane).  Validated at
@@ -207,6 +220,12 @@ class Daemon:
             self.config.serving_promote_cooldown_s)
         if self.config.ct_snapshot_interval < 0:
             raise ValueError("ct_snapshot_interval must be >= 0")
+        self.config.serving_window_queue_depth = int(
+            self.config.serving_window_queue_depth)
+        if self.config.serving_window_queue_depth < 1:
+            raise ValueError(
+                "serving_window_queue_depth must be >= 1 (the "
+                "event-join worker's bounded window queue)")
         from ..obs import validate_obs_config
 
         (self.config.serving_trace_sample,
@@ -917,7 +936,9 @@ class Daemon:
                       packed: Optional[bool] = None,
                       mesh=None,
                       shard_headroom: int = 2,
-                      span_sample: Optional[int] = None) -> None:
+                      span_sample: Optional[int] = None,
+                      window_queue_depth: Optional[int] = None,
+                      event_gather: Optional[bool] = None) -> None:
         """Switch to the SERVING monitor path: batches run through the
         fused datapath + device event-ring append (one dispatch, no
         per-packet host fetch), and only the compacted events cross to
@@ -947,6 +968,18 @@ class Daemon:
         annotations), surfaced via ``GET /debug/traces`` and
         ``cilium-tpu trace``.  0 = off = zero overhead; sampling is
         deterministic over the admitted-packet sequence.
+
+        ``window_queue_depth`` / ``event_gather`` (defaults: the
+        ``serving_window_queue_depth`` / ``serving_event_gather``
+        config knobs) shape the ASYNC EVENT PLANE
+        (serving/eventplane.py): drained windows are handed to a
+        dedicated event-join worker over a bounded queue (overflow
+        drops the offered window, COUNTED) and the fetch ships an
+        occupancy-bounded device gather — d2h bytes scale with the
+        events a window appended, not the ring capacity.  The drain
+        thread's only event work is the 8-byte cursor sync + a queue
+        push; decode / wide-column join / monitor fan-out all run on
+        the worker.
 
         ``mesh=...`` (a ``jax.sharding.Mesh`` or a device count)
         switches to MULTI-CHIP serving: each assembled bucket is
@@ -1007,6 +1040,14 @@ class Daemon:
             raise ValueError(
                 "span_sample tracing needs ingress=True: spans are "
                 "allocated at IngressQueue admission")
+        if window_queue_depth is None:
+            window_queue_depth = self.config.serving_window_queue_depth
+        window_queue_depth = int(window_queue_depth)
+        if window_queue_depth < 1:
+            raise ValueError("window_queue_depth must be >= 1")
+        if event_gather is None:
+            event_gather = self.config.serving_event_gather
+        event_gather = bool(event_gather)
         table = np.asarray(sorted(self.proxy.ports)[:MAX_PROXY_PORTS],
                            dtype=np.uint32)
         n_shards = 0
@@ -1038,10 +1079,13 @@ class Daemon:
                 ring_capacity, n_shards,
                 fresh_fn=lambda: make_sharded_ring(mesh,
                                                    ring_capacity),
-                proxy_ports=table)
+                proxy_ports=table, gather=event_gather,
+                compile_log=self.loader.compile_log)
         else:
-            drainer = AsyncRingDrainer(ring_capacity,
-                                       proxy_ports=table)
+            drainer = AsyncRingDrainer(
+                ring_capacity, proxy_ports=table,
+                gather=event_gather,
+                compile_log=self.loader.compile_log)
         # the degraded-mode ladder (serving/ladder.py): rungs this
         # session can actually run — no mesh, no "sharded" rung; no
         # packing, no "single" rung; "wide" is always the floor
@@ -1051,6 +1095,33 @@ class Daemon:
         rungs = ([RUNG_SHARDED] if mesh is not None else []) \
             + ([RUNG_SINGLE] if packed else []) + [RUNG_WIDE]
         cfg = self.config
+        # arena recycling horizon (batcher.py ownership-handoff
+        # contract), EXTENDED to cover the async event plane: a
+        # header slot must outlive the batches filling the next
+        # window (drain_every) plus every window in flight on the
+        # worker (bounded queue + the one being joined, +1 window of
+        # mid-join slack) — windows keep their records by REFERENCE
+        # (the swap-time snapshot), so the slot count is the only
+        # thing that scales.  The worker REFUSES joins older than
+        # join_horizon batches (counted drops), which is what makes
+        # this depth a guarantee rather than a hope when the plane
+        # stalls: a window's records span [seq-2*drain_every, seq),
+        # slots recycle depth batches after allocation, so joins are
+        # safe while (live seq - window seq) < depth - 2*drain_every;
+        # the horizon keeps one extra drain_every of slack under that
+        arena_depth = (window_queue_depth + 3) * drain_every + 2
+        join_horizon = window_queue_depth * drain_every + 2
+        # the event-join worker: the drain thread's only event work
+        # becomes swap (cursor sync + async occupancy-bounded copy)
+        # + one bounded-queue push; THIS thread finishes the
+        # transfer, decodes, joins, and emits — restart-on-death
+        # under the serving restart budget, terminal once exhausted
+        from ..serving.eventplane import EventJoinWorker
+
+        worker = EventJoinWorker(
+            self._event_join, drop_fn=self._event_drop,
+            queue_depth=window_queue_depth,
+            restart_budget=cfg.serving_restart_budget)
         self._serving = {
             "drainer": drainer,
             "ring": drainer.fresh(),
@@ -1072,14 +1143,29 @@ class Daemon:
                 demote_threshold=cfg.serving_demote_threshold,
                 promote_after=cfg.serving_promote_after,
                 cooldown_s=cfg.serving_promote_cooldown_s),
-            # packed re-staging arena for the sharded path; depth
-            # covers the event-join retention window below
-            "route_arena": BucketArena(2 * drain_every + 2),
+            # packed re-staging arena for the sharded path; same
+            # recycling horizon as the batcher arena (routed/valid/
+            # orig buffers ride windows onto the worker too)
+            "route_arena": BucketArena(arena_depth),
             # batch_id (wrapped) -> (kind, host rows, (ep, dirn) or
             # None, numeric ids, timestamp); kind "wide" | "packed"
             "window": {},
+            # the async event plane: worker + the spans accumulated
+            # since the last drain tick (bid -> tuple[TraceSpan];
+            # drain-thread-only, snapshotted into each DrainWindow)
+            "eventplane": worker,
+            "gather": event_gather,
+            "join_horizon": join_horizon,
+            "spans": {},
+            # seq at the last drain tick: serve_batch ticks when
+            # drain_every batches have dispatched since; the idle
+            # hook ticks whenever ANY have (so windows flush when
+            # traffic pauses instead of waiting for a batch that may
+            # never come)
+            "last_tick": 0,
             "tracer": None,
         }
+        worker.start()
         if ingress:
             from ..core.packets import N_COLS
             from ..serving import ServingRuntime
@@ -1104,10 +1190,10 @@ class Daemon:
                 # after routing, so the batcher packs only when the
                 # bucket goes straight to the single-chip device leg
                 pack=bool(packed) and mesh is None,
-                # arena slots outlive the daemon's event-join
-                # retention (2 * drain_every windows) — the ownership
-                # handoff contract in serving/batcher.py
-                arena_depth=2 * drain_every + 2,
+                # arena slots outlive every window in flight on the
+                # event-join worker — the ownership handoff contract
+                # in serving/batcher.py, sized above
+                arena_depth=arena_depth,
                 # fault tolerance: watchdog deadline + restart budget
                 # from the serving_* knobs; the consumer-idle tick is
                 # DERIVED from the deadline so sub-50ms deadlines are
@@ -1126,6 +1212,14 @@ class Daemon:
                 # during sustained load, when the idle tick never
                 # fires)
                 tracer=tracer,
+                # the async event plane owns sampled spans from the
+                # dispatch return on: device/join stamp at true
+                # window-join time on the worker
+                span_sink=self._serving_span_sink,
+                # idle-cadence drain tick: flush the pending window
+                # when traffic pauses (the worker then joins it off
+                # the dispatch path as usual)
+                idle_fn=self._serving_event_idle_tick,
                 profile_dir=cfg.profile_dir,
                 profile_batches=cfg.profile_batches)
             self._serving["runtime"] = runtime
@@ -1218,13 +1312,13 @@ class Daemon:
         if old == "sharded":
             from ..monitor.ring import AsyncRingDrainer
 
-            # flush what the per-chip rings already hold (best
-            # effort: the drainer's lost counter carries anything a
-            # wedged fetch abandons)
+            # flush what the per-chip rings already hold onto the
+            # event plane (the window keeps its own buffer/record
+            # references, so rebuilding the drainer below is safe
+            # while the worker is still joining it); best effort —
+            # the ledger counts anything a wedged swap abandons
             try:
-                self._collect_and_emit(s)
-                s["drainer"].swap(s["ring"])
-                self._collect_and_emit(s)
+                self._serving_drain_tick(s)
             except Exception:  # noqa: BLE001
                 logging.getLogger(__name__).warning(
                     "sharded ring drain failed during demotion; "
@@ -1256,7 +1350,9 @@ class Daemon:
             s["mesh"] = None
             s["n_shards"] = 0
             d = AsyncRingDrainer(s["ring_capacity"],
-                                 proxy_ports=s["proxy_table"])
+                                 proxy_ports=s["proxy_table"],
+                                 gather=s["gather"],
+                                 compile_log=self.loader.compile_log)
             s["drainer"] = d
             s["ring"] = d.fresh()
             s["window"].clear()
@@ -1291,9 +1387,7 @@ class Daemon:
 
             mesh = s["mesh_pref"]
             try:
-                self._collect_and_emit(s)
-                s["drainer"].swap(s["ring"])
-                self._collect_and_emit(s)
+                self._serving_drain_tick(s)
             except Exception:  # noqa: BLE001
                 pass
             self.loader.serving_shard(mesh)
@@ -1303,7 +1397,8 @@ class Daemon:
             s["drainer"] = ShardedAsyncRingDrainer(
                 cap, s["n_shards"],
                 fresh_fn=lambda: make_sharded_ring(mesh, cap),
-                proxy_ports=s["proxy_table"])
+                proxy_ports=s["proxy_table"], gather=s["gather"],
+                compile_log=self.loader.compile_log)
             s["ring"] = s["drainer"].fresh()
             s["window"].clear()
             s["packed"] = False
@@ -1433,7 +1528,8 @@ class Daemon:
         d = s["drainer"]
         out = {"active": True,
                "ring": {"windows": d.windows, "events": d.events,
-                        "lost": d.lost}}
+                        "lost": d.lost},
+               "event-plane": s["eventplane"].stats()}
         if s["n_shards"]:
             out["shards"] = s["n_shards"]
             out["route-overflow"] = s["route_overflow"]
@@ -1503,6 +1599,14 @@ class Daemon:
             raise ServingNotStartedError("call start_serving() first")
         if now is None:
             now = self._now()
+        # drain tick BEFORE the dispatch (not after, as pre-PR5): the
+        # window then covers exactly the batches dispatched since the
+        # previous tick, every one of which has already handed its
+        # sampled spans to _serving_span_sink — so the swap-time
+        # snapshot is complete and the worker can stamp device/join
+        # at true window-join time with no cross-thread rendezvous
+        if s["seq"] - s["last_tick"] >= s["drain_every"]:
+            self._serving_drain_tick(s)
         bid = s["seq"] & 0x1FFF  # ring batch field width
         if s["mesh"] is not None:
             if packed_meta is not None:
@@ -1541,16 +1645,6 @@ class Daemon:
             info = {"h2d_bytes": hdr.nbytes, "mode": "wide",
                     "batch_id": bid}
         s["seq"] += 1
-        if s["seq"] % s["drain_every"] == 0:
-            self._collect_and_emit(s)
-            s["ring"] = s["drainer"].swap(s["ring"])
-            # retain headers for the current window + the one whose
-            # fetch is in flight; older windows have already emitted
-            live = {(s["seq"] - 1 - i) & 0x1FFF
-                    for i in range(2 * s["drain_every"])}
-            for b in list(s["window"]):
-                if b not in live:
-                    del s["window"][b]
         return info
 
     def _serving_snapshot_numerics(self, s, row_map) -> None:
@@ -1652,15 +1746,144 @@ class Daemon:
             info["shard_of"] = shard_of
         return info
 
-    def _collect_and_emit(self, s) -> None:
-        """Complete the in-flight ring fetch and publish its events
-        (per-chip rings hand back a shard id per row)."""
-        if s["n_shards"]:
-            rows, shards, _, _ = s["drainer"].collect()
-            self._emit_ring_rows(rows, shards)
-        else:
-            rows, _, _ = s["drainer"].collect()
-            self._emit_ring_rows(rows)
+    def _serving_drain_tick(self, s) -> None:
+        """The drain thread's ENTIRE event leg after the async event
+        plane (PR 5): block on the 8-byte cursor, start the
+        occupancy-bounded async copy (``swap_window``), and push the
+        window handle + its join context — the retained batch records
+        and the spans accumulated since the last tick — onto the
+        worker's bounded queue.  No d2h buffer wait, no decode, no
+        wide-column join, no monitor fan-out here; a queue overflow
+        drops the window COUNTED (never silently)."""
+        from ..serving.eventplane import DrainWindow
+
+        window, s["ring"] = s["drainer"].swap_window(s["ring"])
+        s["last_tick"] = s["seq"]
+        spans, s["spans"] = s["spans"], {}
+        # shallow snapshot: the window keeps the records (arena slot
+        # + numerics references) alive on the worker regardless of
+        # the pruning below — zero copy, the ownership-horizon shape
+        records = dict(s["window"])
+        s["eventplane"].submit(DrainWindow(
+            window, records, spans, s["n_shards"],
+            tracer=s.get("tracer"), seq=s["seq"]))
+        # retain headers for the batches filling the next window plus
+        # one horizon of slack; in-flight windows hold their own refs
+        live = {(s["seq"] - 1 - i) & 0x1FFF
+                for i in range(2 * s["drain_every"])}
+        for b in list(s["window"]):
+            if b not in live:
+                del s["window"][b]
+
+    def _serving_event_idle_tick(self) -> None:
+        """ServingRuntime's idle hook (drain-thread context, queue
+        empty): if any batch dispatched since the last drain tick,
+        tick now — a traffic pause must flush the pending window to
+        the event plane instead of letting its events (and sampled
+        spans) wait for a drain_every-th batch that may never come.
+        The monitor plane drains at its own cadence, as the
+        reference's userspace perf-ring reader does."""
+        s = self._serving
+        if s is None or s["seq"] <= s["last_tick"]:
+            return
+        try:
+            self._serving_drain_tick(s)
+        except Exception:  # noqa: BLE001 — an idle-cadence swap
+            # failure must not kill the drain loop; the dispatch-path
+            # tick keeps the fault-propagation discipline
+            logging.getLogger(__name__).warning(
+                "idle event-plane drain tick failed", exc_info=True)
+
+    def _serving_span_sink(self, bid: int, spans: tuple) -> bool:
+        """The runtime hands a dispatched batch's sampled spans to
+        the event plane (drain-thread context).  Returns False — the
+        runtime falls back to completion-boundary stamping — when the
+        worker is terminal, so tracing degrades instead of leaking
+        spans into a queue nobody drains."""
+        s = self._serving
+        if s is None:
+            return False
+        worker = s.get("eventplane")
+        if worker is None or worker.error is not None:
+            return False
+        cur = s["spans"].get(bid)
+        s["spans"][bid] = (cur + spans) if cur else spans
+        return True
+
+    def _event_join(self, dw) -> None:
+        """The worker's join leg (eventplane thread, NEVER the drain
+        thread): finish the d2h transfer + decode, join packed rows
+        back to wide columns, emit to monitor/hubble consumers, and
+        stamp sampled spans at TRUE window-join time — device work is
+        provably complete once the window's fetch lands."""
+        self._event_check_horizon(dw, self._serving)
+        rows, shards, _appended, _lost = dw.ring.fetch()
+        t_dev = time.monotonic()
+        try:
+            # the fetch itself can stall (tunneled d2h): the producer
+            # may have dispatched past the recycling horizon while it
+            # waited, so a window admitted inside the horizon can
+            # still reference recycled slots by the time the rows
+            # land — re-check before publishing anything
+            self._event_check_horizon(dw, self._serving)
+            self._emit_ring_rows(rows, shards, dw.records, dw.n_shards)
+        except Exception:
+            # fetch() already credited the drainer's delivered
+            # counters; a refuse/emit failure means the monitor plane
+            # got NOTHING, and the worker will count the whole window
+            # dropped — roll the credit back so the ring ledger and
+            # the event-plane ledger never double-count the same
+            # events (single-writer: this thread owns the window)
+            d = dw.ring.drainer
+            if d is not None:
+                d.windows -= 1
+                d.events -= dw.appended - dw.lost
+                d.lost -= dw.lost
+            raise
+        if dw.spans and dw.tracer is not None:
+            from ..obs.trace import STAGE_DEVICE, STAGE_JOIN
+
+            t_join = time.monotonic()
+            flat = [sp for spans in dw.spans.values() for sp in spans]
+            for i, sp in enumerate(flat):
+                sp.ts[STAGE_DEVICE] = t_dev
+                sp.ts[STAGE_JOIN] = t_join
+                try:
+                    dw.tracer.commit(sp)
+                except Exception:  # noqa: BLE001 — the events WERE
+                    # delivered above, so a tracer failure must not
+                    # recount the window as a drop (the drainer credit
+                    # stands); evict the uncommitted remainder so the
+                    # span ledger stays exact and join normally
+                    dw.tracer.evict(flat[i:])
+                    logging.getLogger(__name__).warning(
+                        "span commit failed at window join",
+                        exc_info=True)
+                    break
+
+    @staticmethod
+    def _event_check_horizon(dw, s) -> None:
+        """Refuse a window the producer has dispatched past the arena
+        recycling horizon (stalled plane): its record references may
+        point at RECYCLED slots, so a join would publish corrupted
+        events.  Raising makes it a contained, COUNTED drop — never
+        silent corruption.  (After stop_serving, ``s`` is None and no
+        check is needed: the runtime stops dispatching before the
+        worker drains.)"""
+        if (s is not None and dw.seq is not None
+                and s["seq"] - dw.seq > s.get("join_horizon", 1 << 30)):
+            raise RuntimeError(
+                f"arena horizon exceeded: window is "
+                f"{s['seq'] - dw.seq} batches stale "
+                f"(horizon {s['join_horizon']})")
+
+    def _event_drop(self, dw) -> None:
+        """A window the event plane LOST (queue overflow, contained
+        join failure, worker death, stop sweep): its spans are
+        counted tracer drops — never left incomplete."""
+        if dw.tracer is not None:
+            for spans in dw.spans.values():
+                dw.tracer.evict(spans)
 
     def stop_serving(self) -> dict:
         """Drain everything in flight and emit it; returns serving
@@ -1677,16 +1900,20 @@ class Daemon:
             # row through serve_batch before the ring drains below
             front = runtime.stop(drain=True)
         d = s["drainer"]
-        self._collect_and_emit(s)
-        d.swap(s["ring"])
-        self._collect_and_emit(s)
+        # the final window (everything appended since the last tick)
+        # rides the event plane like any other, then the worker is
+        # drained BEFORE the sweep: every queued window joins, and
+        # anything a dead/terminal worker left behind is swept as a
+        # COUNTED drop — submitted == joined + dropped holds exactly
+        self._serving_drain_tick(s)
+        ev = s["eventplane"].stop(drain=True)
         if s["mesh"] is not None:
             # leave the loader in the default single-device placement
             # (subsequent step()/process_batch callers expect it)
             self.loader.serving_unshard()
         self._serving = None
         out = {"windows": d.windows, "events": d.events,
-               "lost": d.lost}
+               "lost": d.lost, "event-plane": ev}
         if s["n_shards"]:
             out["shards"] = s["n_shards"]
             out["route-overflow"] = s["route_overflow"]
@@ -1698,16 +1925,21 @@ class Daemon:
         return out
 
     def _emit_ring_rows(self, rows: np.ndarray,
-                        shards: Optional[np.ndarray] = None) -> None:
+                        shards: Optional[np.ndarray],
+                        records: dict, n_shards: int) -> None:
+        """Join decoded ring rows back to their retained batch
+        records and publish (event-join WORKER context: ``records``
+        is the window's swap-time snapshot, so this never touches
+        ``self._serving`` — which the drain thread may be mutating,
+        or stop_serving may already have cleared)."""
         from ..core.packets import unpack_rows_np
         from ..monitor.api import decode_ring_rows
         from ..monitor.ring import COL_BATCH, COL_PKT_IDX
 
         if rows is None or not len(rows):
             return
-        s = self._serving
         for b in np.unique(rows[:, COL_BATCH]):
-            rec = s["window"].get(int(b))
+            rec = records.get(int(b))
             if rec is None:
                 continue  # header window expired (overrun drain lag)
             kind, hdr, meta, numerics, ts = rec
@@ -1718,7 +1950,7 @@ class Daemon:
                 # per-chip rings carry shard-LOCAL packet indices;
                 # the retained window is the ROUTED tensor, shard s
                 # owning rows [s*block, (s+1)*block)
-                pkt = shards[m] * (len(hdr) // s["n_shards"]) + pkt
+                pkt = shards[m] * (len(hdr) // n_shards) + pkt
             sel = hdr[pkt]
             if kind == "packed":
                 # wide columns reconstructed host-side ONLY for the

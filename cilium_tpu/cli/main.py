@@ -392,7 +392,10 @@ _SERVING_RATE_KEYS = (
     (("verdicts",), "verdicts"),
     (("h2d", "bytes"), "h2d-bytes"),
     (("ring", "events"), "ring-events"),
-    (("ring", "lost"), "ring-lost"),
+    (("event-plane", "ring-lost"), "ring-lost"),
+    (("event-plane", "d2h-bytes"), "d2h-bytes"),
+    (("event-plane", "windows-joined"), "windows-joined"),
+    (("event-plane", "windows-dropped"), "windows-dropped"),
     (("fault-tolerance", "restarts"), "restarts"),
     (("fault-tolerance", "recovery-dropped"), "recovery-dropped"),
     (("fault-tolerance", "dispatch-timeouts"), "timeouts"),
@@ -523,6 +526,25 @@ def cmd_serving(args) -> int:
                 print(f"Ring:      {ring.get('windows', 0)} windows, "
                       f"{ring.get('events', 0)} events, "
                       f"{ring.get('lost', 0)} lost")
+                ev = st.get("event-plane") or {}
+                if ev:
+                    lag = ev.get("join-lag-us") or {}
+                    print(f"Event:     {ev.get('windows-joined', 0)} "
+                          f"windows joined / "
+                          f"{ev.get('windows-dropped', 0)} dropped "
+                          f"({ev.get('queue-overflows', 0)} queue "
+                          f"overflows), {ev.get('windows-pending', 0)}"
+                          f"/{ev.get('queue-depth', 0)} pending, "
+                          f"ring-lost {ev.get('ring-lost', 0)}")
+                    bpe = ev.get("d2h-bytes-per-event")
+                    print(f"           d2h "
+                          f"{ev.get('d2h-bytes', 0)} B "
+                          f"({'-' if bpe is None else bpe} B/event)"
+                          f", join-lag p50={_us(lag.get('p50'))} "
+                          f"p99={_us(lag.get('p99'))}, restarts "
+                          f"{ev.get('worker-restarts', 0)}"
+                          + (f" TERMINAL: {ev['error']}"
+                             if ev.get("error") else ""))
             prev, prev_t = st, now
             if not args.follow:
                 return 0

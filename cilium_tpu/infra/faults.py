@@ -73,6 +73,11 @@ SITE_LOADER_SERVE_SHARDED = "loader.serve_sharded"
 # (arm with ``~S`` for the ring-drain stall failure mode).
 SITE_RING_SWAP = "ring.swap"
 SITE_RING_COLLECT = "ring.collect"
+# serving/eventplane.py — the event-join worker, just before it joins
+# a popped window: a raise KILLS the worker thread (restart-on-death
+# under its budget); a ``~S`` hang stalls the join plane so windows
+# pile up against the bounded queue (overflow accounting).
+SITE_EVENT_JOIN = "eventplane.join"
 
 SITES = frozenset({
     SITE_SERVING_DISPATCH,
@@ -82,6 +87,7 @@ SITES = frozenset({
     SITE_LOADER_SERVE_SHARDED,
     SITE_RING_SWAP,
     SITE_RING_COLLECT,
+    SITE_EVENT_JOIN,
 })
 
 

@@ -31,6 +31,9 @@ class MonitorAgent:
         self._lost: Dict[str, int] = {}
         self._queue_depth = queue_depth
         self._lock = threading.Lock()
+        # serializes the publish fan-out across emitting threads
+        # (event-join worker + drain thread) — see publish()
+        self._emit_lock = threading.RLock()
         self.published = 0
 
     def register(self, name: str, consumer: Consumer) -> None:
@@ -57,21 +60,34 @@ class MonitorAgent:
             self._queues.pop(name, None)
 
     def publish(self, batch: EventBatch) -> None:
-        """Called by the loader after each datapath step."""
+        """Called by the loader after each datapath step.
+
+        The fan-out is serialized under ``_emit_lock``: since the
+        async event plane (PR 5) ring-event joins publish from the
+        event-join WORKER while host-synthesized drops (shed /
+        recovery events) still publish from the drain thread, and
+        consumers (flow aggregation, metrics dicts) are not
+        individually thread-safe.  Reentrant (RLock) so a consumer
+        that publishes derived events from its callback cannot
+        deadlock itself."""
         with self._lock:
             consumers = list(self._consumers.items())
             queues = list(self._queues.items())
-        self.published += len(batch)
-        for name, consumer in consumers:
-            try:
-                consumer(batch)
-            except Exception:
-                # a broken consumer must not take down the datapath
-                self._lost[name] = self._lost.get(name, 0) + len(batch)
-        for name, q in queues:
-            if q.maxlen is not None and len(q) == q.maxlen:
-                self._lost[name] = self._lost.get(name, 0) + len(q[0])
-            q.append(batch)
+        with self._emit_lock:
+            self.published += len(batch)
+            for name, consumer in consumers:
+                try:
+                    consumer(batch)
+                except Exception:
+                    # a broken consumer must not take down the
+                    # datapath
+                    self._lost[name] = (self._lost.get(name, 0)
+                                        + len(batch))
+            for name, q in queues:
+                if q.maxlen is not None and len(q) == q.maxlen:
+                    self._lost[name] = (self._lost.get(name, 0)
+                                        + len(q[0]))
+                q.append(batch)
 
     def lost_count(self, name: str) -> int:
         return self._lost.get(name, 0)

@@ -19,9 +19,10 @@ ring; total appended count is monotone so the host computes loss as
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import time
+from dataclasses import dataclass, field
 from functools import partial
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -211,6 +212,176 @@ serve_step_packed_jit = jax.jit(serve_step_packed, donate_argnums=(0, 1),
                                                  "audit"))
 
 
+# -- occupancy-bounded drain (the d2h diet) ---------------------------
+# The fetched window's byte count should scale with the EVENTS the
+# window appended, not the ring's capacity: `swap` already blocks on
+# the 8-byte cursor, so the host knows the occupancy before a single
+# buffer byte moves.  A device-side gather pulls just the occupied
+# slots (wrap-aware: slot of the i-th surviving event is
+# (total - kept + i) & mask, which is the identity prefix [0, total)
+# until the ring laps) into a contiguous buffer bucketed to a
+# power-of-two RUNG ladder — each rung is ONE compiled executable
+# (registered with TPULoader.compile_log like every other serving
+# shape), and the d2h copy ships rung*8 bytes instead of capacity*8.
+GATHER_MIN_RUNG = 64
+
+
+def _gather_rung(kept: int, cap: int) -> int:
+    """Smallest ladder rung holding ``kept`` rows (power of two,
+    floored at GATHER_MIN_RUNG, capped at the ring capacity)."""
+    r = min(GATHER_MIN_RUNG, cap)
+    while r < kept:
+        r <<= 1
+    return min(r, cap)
+
+
+@partial(jax.jit, static_argnames=("rung", "cap"))
+def ring_gather(buf: jnp.ndarray, starts: jnp.ndarray, rung: int,
+                cap: int) -> jnp.ndarray:
+    """Gather each shard's occupied window slots, in append order,
+    into a contiguous [n_shards * rung, RING_WORDS] buffer.
+
+    ``buf`` is [n_shards * cap, RING_WORDS] (n_shards=1 for the
+    single-chip ring), ``starts`` [n_shards] uint32 — each shard's
+    oldest surviving slot ((total - kept) & mask; 0 until the ring
+    laps).  Slots past a shard's occupancy are EMPTY on a fresh-per-
+    window ring, so the host's empty-slot filter drops them exactly
+    like the full-copy path.  One executable per (rung, shard count):
+    ``starts`` is traced, the rung is static."""
+    n_shards = starts.shape[0]
+    offs = jnp.arange(rung, dtype=jnp.uint32)[None, :]
+    idx = (starts[:, None] + offs) & jnp.uint32(cap - 1)
+    idx = idx + (jnp.arange(n_shards, dtype=jnp.uint32)
+                 * jnp.uint32(cap))[:, None]
+    return buf[idx.reshape(-1).astype(jnp.int32)]
+
+
+def _cursor_totals(cursor: np.ndarray) -> np.ndarray:
+    """Host cursor ([2] or [S, 2] of u32 lo/hi words) -> int64 totals
+    per shard ([S])."""
+    c = np.asarray(cursor, dtype=np.uint64).reshape(-1, 2)
+    return (c[:, 0] | (c[:, 1] << np.uint64(32))).astype(np.int64)
+
+
+@dataclass
+class RingWindow:
+    """One drained window's in-flight handle: the device buffer whose
+    host copy is already streaming, plus everything the EVENT-JOIN
+    WORKER (serving/eventplane.py) needs to finish off the dispatch
+    path — the synced host cursor, the occupancy/loss math done at
+    swap time, and the originating drainer for counter accounting.
+
+    Ownership: ``swap_window`` hands the window out and the drainer
+    forgets it; exactly one thread (the worker, or a legacy
+    ``collect()`` caller) calls :meth:`fetch` exactly once."""
+
+    buf: Optional[object]  # device rows (None = empty window)
+    cursor: np.ndarray  # host copy, [n_shards(|1), 2] u32
+    capacity: int
+    n_shards: int  # 0 = single-chip ring
+    appended: int  # events appended across shards this window
+    lost: int  # lap loss (appended - capacity when the host lagged)
+    d2h_bytes: int  # bytes this window put on the d2h link
+    gathered: bool  # buf is a rung gather, already in append order
+    rung: int
+    proxy_ports: Optional[np.ndarray]
+    drainer: object
+    t_swap: float = field(default_factory=time.monotonic)
+
+    def fetch(self):
+        """Complete the transfer and decode.  Returns
+        ``(rows, shard_ids, appended, lost)``; ``shard_ids`` is None
+        for a single-chip window.  Updates the originating drainer's
+        windows/events/lost counters (single-writer: whoever owns the
+        window)."""
+        d = self.drainer
+        if self.buf is None:
+            rows = np.zeros((0, RING_COLS), dtype=np.uint32)
+            shards = (np.zeros(0, dtype=np.int64)
+                      if self.n_shards else None)
+            if d is not None:
+                d.windows += 1
+            return rows, shards, 0, 0
+        buf = np.asarray(self.buf)  # blocks until the copy lands
+        self.buf = None
+        totals = _cursor_totals(self.cursor)
+        cap = self.capacity
+        if self.n_shards:
+            S = self.n_shards
+            blk = buf.shape[0] // S
+            parts: List[np.ndarray] = []
+            sids: List[np.ndarray] = []
+            for s in range(S):
+                r, _total, _lost = _decode_fetched(
+                    buf[s * blk:(s + 1) * blk], int(totals[s]), cap,
+                    self.proxy_ports, gathered=self.gathered)
+                parts.append(r)
+                sids.append(np.full(len(r), s, dtype=np.int64))
+            rows = (np.concatenate(parts) if parts else
+                    np.zeros((0, RING_COLS), dtype=np.uint32))
+            shards = (np.concatenate(sids) if sids else
+                      np.zeros(0, dtype=np.int64))
+        else:
+            rows, _total, _lost = _decode_fetched(
+                buf, int(totals[0]), cap, self.proxy_ports,
+                gathered=self.gathered)
+            shards = None
+        if d is not None:
+            d.windows += 1
+            d.events += self.appended - self.lost
+            d.lost += self.lost
+        return rows, shards, self.appended, self.lost
+
+
+def _start_window(ring: EventRing, capacity: int, n_shards: int,
+                  proxy_ports, drainer, gather: bool,
+                  compile_log) -> RingWindow:
+    """The shared swap leg: sync the cursor (retires every queued
+    dispatch — see AsyncRingDrainer.swap), do the occupancy math on
+    host, start the async copy of either the rung gather or the full
+    buffer, and wrap it all in a :class:`RingWindow`."""
+    ring.cursor.block_until_ready()
+    cur = np.array(np.asarray(ring.cursor), copy=True).reshape(-1, 2)
+    totals = _cursor_totals(cur)
+    appended = int(totals.sum())
+    lost = int(np.maximum(totals - capacity, 0).sum())
+    if appended == 0:
+        return RingWindow(buf=None, cursor=cur, capacity=capacity,
+                          n_shards=n_shards, appended=0, lost=0,
+                          d2h_bytes=0, gathered=False, rung=0,
+                          proxy_ports=proxy_ports, drainer=drainer)
+    if gather:
+        kept = np.minimum(totals, capacity)
+        rung = _gather_rung(int(kept.max()), capacity)
+        # oldest surviving slot per shard: 0 until the ring laps,
+        # then the wrapped cursor (total & mask)
+        starts = np.where(totals > capacity,
+                          totals & (capacity - 1),
+                          0).astype(np.uint32)
+        size = getattr(ring_gather, "_cache_size", lambda: 0)
+        before = size() if compile_log is not None else 0
+        t0 = time.monotonic()
+        buf = ring_gather(ring.buf, starts, rung, capacity)
+        if compile_log is not None:
+            after = size()
+            if after > before:
+                compile_log.record_dispatch(
+                    "gather", (max(n_shards, 1), rung), before, after,
+                    time.monotonic() - t0, key_extra=(capacity,))
+        buf.copy_to_host_async()
+        return RingWindow(buf=buf, cursor=cur, capacity=capacity,
+                          n_shards=n_shards, appended=appended,
+                          lost=lost, d2h_bytes=buf.nbytes + cur.nbytes,
+                          gathered=True, rung=rung,
+                          proxy_ports=proxy_ports, drainer=drainer)
+    ring.buf.copy_to_host_async()
+    return RingWindow(buf=ring.buf, cursor=cur, capacity=capacity,
+                      n_shards=n_shards, appended=appended, lost=lost,
+                      d2h_bytes=ring.buf.nbytes + cur.nbytes,
+                      gathered=False, rung=capacity,
+                      proxy_ports=proxy_ports, drainer=drainer)
+
+
 class AsyncRingDrainer:
     """Double-buffered drain: the host fetches window N-1 while the
     device steps window N.
@@ -233,10 +404,18 @@ class AsyncRingDrainer:
     """
 
     def __init__(self, capacity: int = 1 << 15,
-                 proxy_ports: np.ndarray = None):
+                 proxy_ports: np.ndarray = None,
+                 gather: bool = True, compile_log=None):
         self.capacity = capacity
         self.proxy_ports = proxy_ports
-        self._pending: EventRing = None
+        # occupancy-bounded fetch (module comment at GATHER_MIN_RUNG):
+        # d2h bytes scale with the window's events, not the capacity.
+        # compile_log (TPULoader.compile_log) records the bucketed
+        # gather's rung executables under the same one-executable-
+        # per-(rung, mode) guard as the serve steps
+        self.gather = bool(gather)
+        self.compile_log = compile_log
+        self._pending: Optional[RingWindow] = None
         self.windows = 0
         self.events = 0
         self.lost = 0
@@ -244,10 +423,12 @@ class AsyncRingDrainer:
     def fresh(self) -> EventRing:
         return EventRing.create(self.capacity)
 
-    def swap(self, ring: EventRing) -> EventRing:
-        """Start the async fetch of ``ring``; returns the fresh ring
-        for the next window.  At most one fetch may be in flight:
-        call :meth:`collect` first.
+    def swap_window(self, ring: EventRing
+                    ) -> Tuple[RingWindow, EventRing]:
+        """Start the async fetch of ``ring`` and hand its window out
+        as a :class:`RingWindow` (ownership transfers to the caller —
+        the event-join worker's shape); returns the fresh ring for
+        the next window alongside it.
 
         The block_until_ready on the CURSOR before the copy is
         load-bearing on tunneled runtimes: a d2h transfer with queued
@@ -255,16 +436,26 @@ class AsyncRingDrainer:
         measured r05), while blocking on the tiny cursor drains the
         same queue in milliseconds (blocking on the large buffer
         triggers the slow path itself — sync on the scalar, then the
-        copies only move bytes)."""
+        copies only move bytes).  It is also what makes the
+        occupancy-bounded gather possible at all: the synced cursor
+        IS the window's event count, so the rung is known before a
+        single buffer byte moves."""
         from ..infra import faults
 
         faults.check(faults.SITE_RING_SWAP)
+        window = _start_window(ring, self.capacity, 0,
+                               self.proxy_ports, self, self.gather,
+                               self.compile_log)
+        return window, self.fresh()
+
+    def swap(self, ring: EventRing) -> EventRing:
+        """Legacy single-window double buffering: start the async
+        fetch, retain the window internally for :meth:`collect`.  At
+        most one fetch may be in flight."""
         assert self._pending is None, "previous window not collected"
-        ring.cursor.block_until_ready()
-        ring.buf.copy_to_host_async()
-        ring.cursor.copy_to_host_async()
-        self._pending = ring
-        return self.fresh()
+        window, fresh = self.swap_window(ring)
+        self._pending = window
+        return fresh
 
     def collect(self) -> Tuple[np.ndarray, int, int]:
         """Complete the in-flight fetch -> (rows, appended, lost) for
@@ -272,14 +463,11 @@ class AsyncRingDrainer:
         from ..infra import faults
 
         faults.check(faults.SITE_RING_COLLECT)
-        ring = self._pending
-        if ring is None:
+        window = self._pending
+        if window is None:
             return np.zeros((0, RING_COLS), dtype=np.uint32), 0, 0
         self._pending = None
-        rows, appended, lost = ring_drain(ring, self.proxy_ports)
-        self.windows += 1
-        self.events += appended - lost
-        self.lost += lost
+        rows, _shards, appended, lost = window.fetch()
         return rows, appended, lost
 
 
@@ -315,28 +503,42 @@ def _unpack_rows(packed: np.ndarray,
     return rows
 
 
-def _drain_window(buf: np.ndarray, cursor: np.ndarray,
-                  proxy_ports: np.ndarray = None
-                  ) -> Tuple[np.ndarray, int, int]:
-    """Decode ONE ring's fetched window: 64-bit cursor assembly,
+def _decode_fetched(buf: np.ndarray, total: int, cap: int,
+                    proxy_ports: np.ndarray = None,
+                    gathered: bool = False
+                    ) -> Tuple[np.ndarray, int, int]:
+    """Decode ONE ring's fetched window given its 64-bit append total:
     wrap/lost math, empty-slot filter, wire unpack.  The single
-    definition of the drain rules — :func:`ring_drain` (one ring) and
-    :func:`sharded_ring_drain` (per-chip rings) both call it, so a
-    future wire-format change (e.g. widening the 4-bit reason field)
-    lands in one place."""
-    lo, hi = int(cursor[0]), int(cursor[1])
-    total = (hi << 32) | lo
-    cap = buf.shape[0]
-    if total <= cap:
+    definition of the drain rules — :func:`ring_drain` (one ring),
+    :func:`sharded_ring_drain` (per-chip rings), and
+    :meth:`RingWindow.fetch` (the async event plane) all land here,
+    so a future wire-format change (e.g. widening the 4-bit reason
+    field) lands in one place.
+
+    ``gathered=True`` means ``buf`` is a :func:`ring_gather` output:
+    already rotated into append order on device (its length is the
+    rung, not the capacity), so only the prefix/empty filter
+    applies."""
+    lost = max(0, total - cap)
+    if gathered:
+        rows = buf[:min(total, cap, buf.shape[0])]
+    elif total <= cap:
         rows = buf[:total]
-        lost = 0
     else:
         head = total & (cap - 1)
         rows = np.concatenate([buf[head:], buf[:head]])
-        lost = total - cap
     # empty slots carry event bits 0b11 (no EV_* code is 3)
     rows = rows[((rows[:, 0] >> 3) & 0x3) != 0x3]
     return _unpack_rows(rows, proxy_ports), total, lost
+
+
+def _drain_window(buf: np.ndarray, cursor: np.ndarray,
+                  proxy_ports: np.ndarray = None
+                  ) -> Tuple[np.ndarray, int, int]:
+    """Legacy full-copy decode: cursor words -> total, then
+    :func:`_decode_fetched` over the whole fetched buffer."""
+    total = int(_cursor_totals(cursor)[0])
+    return _decode_fetched(buf, total, buf.shape[0], proxy_ports)
 
 
 def sharded_ring_drain(buf: np.ndarray, cursor: np.ndarray,
@@ -380,7 +582,8 @@ class ShardedAsyncRingDrainer:
     (every window starts on fresh rings), summed."""
 
     def __init__(self, capacity: int, n_shards: int,
-                 fresh_fn, proxy_ports: np.ndarray = None):
+                 fresh_fn, proxy_ports: np.ndarray = None,
+                 gather: bool = True, compile_log=None):
         # fresh_fn: () -> device EventRing with buf [S*cap, RING_WORDS]
         # sharded on axis 0 and cursor [S, 2] sharded (parallel.mesh
         # builds it — placement needs the mesh, which lives there)
@@ -388,7 +591,9 @@ class ShardedAsyncRingDrainer:
         self.n_shards = n_shards
         self.proxy_ports = proxy_ports
         self._fresh_fn = fresh_fn
-        self._pending = None
+        self.gather = bool(gather)
+        self.compile_log = compile_log
+        self._pending: Optional[RingWindow] = None
         self.windows = 0
         self.events = 0
         self.lost = 0
@@ -396,35 +601,37 @@ class ShardedAsyncRingDrainer:
     def fresh(self):
         return self._fresh_fn()
 
-    def swap(self, ring):
+    def swap_window(self, ring) -> Tuple[RingWindow, object]:
         """Same cursor-first sync discipline as the single-chip
-        drainer (see AsyncRingDrainer.swap): block on the small
-        cursor, then the buffer bytes stream in the background."""
+        drainer (see AsyncRingDrainer.swap_window): block on the
+        small cursor, then the (gathered) buffer bytes stream in the
+        background.  The gather rung is COMMON across shards (the max
+        occupancy, bucketed) so the fetched layout stays one block
+        per shard."""
         from ..infra import faults
 
         faults.check(faults.SITE_RING_SWAP)
+        window = _start_window(ring, self.capacity, self.n_shards,
+                               self.proxy_ports, self, self.gather,
+                               self.compile_log)
+        return window, self.fresh()
+
+    def swap(self, ring):
         assert self._pending is None, "previous window not collected"
-        ring.cursor.block_until_ready()
-        ring.buf.copy_to_host_async()
-        ring.cursor.copy_to_host_async()
-        self._pending = ring
-        return self.fresh()
+        window, fresh = self.swap_window(ring)
+        self._pending = window
+        return fresh
 
     def collect(self) -> Tuple[np.ndarray, np.ndarray, int, int]:
         from ..infra import faults
 
         faults.check(faults.SITE_RING_COLLECT)
-        ring = self._pending
-        if ring is None:
+        window = self._pending
+        if window is None:
             return (np.zeros((0, RING_COLS), dtype=np.uint32),
                     np.zeros(0, dtype=np.int64), 0, 0)
         self._pending = None
-        rows, shards, appended, lost = sharded_ring_drain(
-            np.asarray(ring.buf), np.asarray(ring.cursor),
-            self.proxy_ports)
-        self.windows += 1
-        self.events += appended - lost
-        self.lost += lost
+        rows, shards, appended, lost = window.fetch()
         return rows, shards, appended, lost
 
 

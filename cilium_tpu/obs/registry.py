@@ -242,6 +242,47 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                 "packets lost to per-shard block overflow (flow skew)",
                 lambda: sv("route-overflow"))
 
+    # -- the async event plane (serving/eventplane.py): the d2h leg's
+    # scoreboard.  d2h bytes are counted at SWAP (they crossed the
+    # link whatever happens to the window), window drops are the
+    # no-silent-loss ledger's monitor-plane side, and ring lap loss
+    # is summed over every window — joined or dropped — so a lagging
+    # consumer shows up here even when its windows never decode ------
+    reg.counter("cilium_serving_d2h_bytes_total",
+                "device->host event-window bytes shipped "
+                "(occupancy-bounded gather + cursor)",
+                lambda: sv("event-plane", "d2h-bytes"))
+    reg.counter("cilium_serving_event_windows_dropped_total",
+                "drain windows lost by the event plane (queue "
+                "overflow, join failure, worker death, stop sweep)",
+                lambda: sv("event-plane", "windows-dropped"))
+    reg.counter("cilium_serving_event_window_overflows_total",
+                "drain windows dropped at the bounded window queue",
+                lambda: sv("event-plane", "queue-overflows"))
+    reg.counter("cilium_serving_event_worker_restarts_total",
+                "event-join worker restarts spent",
+                lambda: sv("event-plane", "worker-restarts"))
+    reg.counter("cilium_ring_lost_total",
+                "ring events lost to lap overrun (appended - "
+                "capacity while the consumer lagged a full lap)",
+                lambda: sv("event-plane", "ring-lost"))
+
+    def eventplane():
+        s = daemon._serving
+        return s.get("eventplane") if s is not None else None
+
+    reg.gauge("cilium_serving_event_windows_pending",
+              "drain windows queued or joining on the event-join "
+              "worker (live at scrape time)",
+              lambda: (w.pending if (w := eventplane()) is not None
+                       else None))
+    reg.histogram("cilium_serving_event_join_lag_us",
+                  "window swap -> events emitted lag on the "
+                  "event-join worker (µs, log2 buckets)",
+                  lambda: (w.join_lag
+                           if (w := eventplane()) is not None
+                           else None))
+
     # -- fault-tolerance plane ----------------------------------------
     reg.counter("cilium_serving_restarts_total",
                 "drain-loop restarts spent by the serving watchdog",

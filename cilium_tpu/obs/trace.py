@@ -6,21 +6,29 @@ never looked up — so the cost when sampling is off is a single
 ``is not None`` branch per chunk, and when on it is O(sampled
 packets), not O(packets).
 
-The six stage timestamps (``SPAN_STAGES``):
+The seven stage timestamps (``SPAN_STAGES``):
 
-===========  ======================================================
-``admit``    the packet's chunk was admitted by ``IngressQueue``
-``dequeue``  ``take_into`` memcpy'd its row out of the queue
-``staged``   the batcher finished arena staging / packing + masking
-``dispatch`` the drain loop handed the batch to the device leg
-``device``   the (async) dispatch call returned
-``join``     the batch's events were emitted to the monitor plane
-===========  ======================================================
+================  ===================================================
+``admit``         the packet's chunk was admitted by ``IngressQueue``
+``dequeue``       ``take_into`` memcpy'd its row out of the queue
+``staged``        the batcher finished arena staging/packing+masking
+``dispatch``      the drain loop handed the batch to the device leg
+``dispatch-ret``  the (async) dispatch call returned
+``device``        the batch's drain window was fetched — device work
+                  provably complete (stamped by the event-join
+                  worker; under-reported as the dispatch return
+                  before the async event plane existed)
+``join``          the batch's events were emitted to the monitor
+                  plane
+================  ===================================================
 
 Timestamps are ``time.monotonic`` so consecutive stamps are
-monotonic by construction and the five stage intervals telescope to
+monotonic by construction and the six stage intervals telescope to
 exactly the end-to-end latency — the property the determinism tests
-assert.
+assert.  Without an event-join worker (a bare ServingRuntime), the
+``device``/``join`` stamps fall back to the completion boundary the
+latency histogram uses, so the telescoping property holds on every
+path.
 
 Sampling is DETERMINISTIC over the admitted-packet sequence: packet
 ``seq`` is sampled iff ``(seq + seed) % sample == 0``, so the same
@@ -45,12 +53,12 @@ from typing import Dict, List, Optional, Tuple
 
 from ..serving.stats import LatencyHistogram
 
-SPAN_STAGES = ("admit", "dequeue", "staged", "dispatch", "device",
-               "join")
+SPAN_STAGES = ("admit", "dequeue", "staged", "dispatch",
+               "dispatch-ret", "device", "join")
 N_STAGES = len(SPAN_STAGES)
 # indices into TraceSpan.ts
 STAGE_ADMIT, STAGE_DEQUEUE, STAGE_STAGED, STAGE_DISPATCH, \
-    STAGE_DEVICE, STAGE_JOIN = range(N_STAGES)
+    STAGE_DISPATCH_RET, STAGE_DEVICE, STAGE_JOIN = range(N_STAGES)
 
 DEFAULT_SPAN_RING = 512
 

@@ -24,8 +24,22 @@ Two staging disciplines:
   the SAME bucket; the consumer (the daemon retains ``hdr`` for the
   drain-time event join, and may still be feeding an async h2d copy)
   must be done with it by then.  ``Daemon.start_serving`` sizes
-  ``depth`` to its retention window (2 * drain_every + slack), which
-  is the only consumer contract.
+  ``depth`` to its retention window, which is the only consumer
+  contract.  Since the async event plane (PR 5,
+  ``serving/eventplane.py``) that horizon covers WINDOWS IN FLIGHT
+  ON THE EVENT-JOIN WORKER too: each drain window snapshots its
+  batch records (arena-slot ``hdr`` references included) at swap
+  time and rides a bounded queue until the worker joins it, so a
+  slot may be live for up to (window_queue_depth [queued] + 1
+  [joining] + 1 [accumulating] + 1 [mid-join slack]) * drain_every
+  batches after dispatch — the ``(window_queue_depth + 3) *
+  drain_every + 2`` depth ``start_serving`` passes.  The depth is a
+  GUARANTEE, not a hope: the worker refuses joins older than the
+  matching join horizon (``Daemon._event_join``) as counted drops,
+  so a stalled plane can never join against a recycled slot.  A
+  dropped window releases its references when the worker counts the
+  drop; nothing extends the horizon past stop() because
+  ``stop_serving`` drains the worker before the runtime sweeps.
 - **``pack=...`` (the 16 B/packet h2d format).** When a batch's rows
   are IPv4 with one (ep, dir) stream (``core.packets.
   pack_eligibility``), the batcher emits PACKED [bucket, 4] rows

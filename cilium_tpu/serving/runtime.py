@@ -111,7 +111,10 @@ class ServingRuntime:
                  idle_wait_s: float = DEFAULT_IDLE_WAIT_S,
                  on_recovery_drop: Optional[RecoveryFn] = None,
                  tracer=None,
+                 span_sink: Optional[Callable[[int, tuple], bool]]
+                 = None,
                  gauge_fn: Optional[Callable[[], dict]] = None,
+                 idle_fn: Optional[Callable[[], None]] = None,
                  profile_dir: Optional[str] = None,
                  profile_batches: int = 0):
         from .batcher import DEFAULT_ARENA_DEPTH
@@ -182,6 +185,12 @@ class ServingRuntime:
         # drain-boundary clock as the latency histogram.
         self._tracer = tracer
         self.queue.tracer = tracer
+        # span_sink(batch_id, spans) -> bool: the owner's ASYNC event
+        # plane takes over device/join stamping (the worker stamps at
+        # true window-join time).  When absent — or when it declines —
+        # the legacy fallback stamps device/join at the completion
+        # boundary the latency histogram uses
+        self._span_sink = span_sink
         self._prev_spans: tuple = ()
         # idle-tick gauges (arena occupancy + whatever the owner's
         # gauge_fn adds) land in stats.gauges; gauges that must stay
@@ -189,6 +198,14 @@ class ServingRuntime:
         # live by the metrics registry instead — the idle tick only
         # fires when the queue is empty
         self._gauge_fn = gauge_fn
+        # idle_fn runs in the drain loop's queue-empty branch (drain-
+        # thread context, same as dispatch): the owner's chance to
+        # tick work that otherwise only advances per-dispatch — the
+        # daemon drains pending event windows here, so ring events
+        # and sampled spans flush when traffic PAUSES instead of
+        # waiting for the next drain_every-th batch that may never
+        # come
+        self._idle_fn = idle_fn
         # optional jax.profiler capture window: trace the first
         # profile_batches dispatches into profile_dir, then stop —
         # the batch-scoped sibling of GET /debug/profile's
@@ -413,6 +430,11 @@ class ServingRuntime:
                 # depth, arena occupancy, in-flight window) sample
                 # here — off the dispatch path, at the idle cadence
                 self._sample_gauges()
+                if self._idle_fn is not None:
+                    try:
+                        self._idle_fn()
+                    except Exception:  # noqa: BLE001 — an idle hook
+                        pass  # must never kill the drain loop
                 self.queue.wait_nonempty(self._idle_wait_s)
 
     def _dispatch_one(self, batch: AssembledBatch, gen: int) -> None:
@@ -497,14 +519,14 @@ class ServingRuntime:
             bid = int(info.get("batch_id", -1))
         spans = batch.spans
         if spans:
-            from ..obs.trace import STAGE_DEVICE
+            from ..obs.trace import STAGE_DISPATCH_RET
 
             shard_of = (info.get("shard_of")
                         if isinstance(info, dict) else None)
             overflowed = []
             kept = []
             for sp in spans:
-                sp.ts[STAGE_DEVICE] = t1
+                sp.ts[STAGE_DISPATCH_RET] = t1
                 sp.mode = mode
                 sp.demoted = demoted
                 sp.batch_id = bid
@@ -523,6 +545,13 @@ class ServingRuntime:
             if overflowed and self._tracer is not None:
                 self._tracer.evict(overflowed)
             spans = tuple(kept)
+            if spans and self._span_sink is not None and bid >= 0:
+                # the async event plane owns these spans now: the
+                # join worker stamps device/join at true window-join
+                # time and commits (or evicts, counted, if the
+                # window is lost)
+                if self._span_sink(bid, spans):
+                    spans = ()
         self.stats.record_batch(batch.n_valid, len(batch.hdr),
                                 batch.arrivals, t0, packed=packed,
                                 h2d_bytes=(h2d if h2d is not None
@@ -540,15 +569,17 @@ class ServingRuntime:
 
     # -- the obs plane (spans, gauges, profile window) -----------------
     def _complete_spans(self, t_done: float) -> None:
-        """The batch whose arrivals just completed reached the join
-        boundary: stamp STAGE_JOIN and commit its spans (same clock
-        as the end-to-end latency histogram)."""
+        """Fallback (no async event plane took the spans): the batch
+        whose arrivals just completed reached the join boundary —
+        stamp device/join there and commit (same clock as the
+        end-to-end latency histogram)."""
         spans, self._prev_spans = self._prev_spans, ()
         if not spans or self._tracer is None:
             return
-        from ..obs.trace import STAGE_JOIN
+        from ..obs.trace import STAGE_DEVICE, STAGE_JOIN
 
         for sp in spans:
+            sp.ts[STAGE_DEVICE] = t_done
             sp.ts[STAGE_JOIN] = t_done
             self._tracer.commit(sp)
 

@@ -1,0 +1,577 @@
+"""The async event plane (ISSUE 5): off-hot-path event-join worker +
+occupancy-bounded ring drain.
+
+Acceptance properties covered here:
+
+- NO DECODE ON THE DRAIN THREAD: under a serving load, every
+  ``decode_ring_rows`` call runs on the event-join worker (tier-1
+  regression for the tentpole's whole point);
+- WRAP-AROUND EQUIVALENCE: a drain window that crosses the ring's lap
+  boundary gathers/decodes identically via the bucketed device path
+  and the legacy full-copy path (property test over cursor totals);
+- D2H DIET: drain bytes scale with the window's event count, not the
+  ring capacity (the gather-vs-fullcopy contrast);
+- LAP LOSS is counted (``cilium_ring_lost_total``) and surfaced, with
+  a deliberately-lagged consumer;
+- NO SILENT LOSS under chaos: worker death/restart (the
+  ``eventplane.join`` fault site), bounded-window-queue overflow, and
+  stop-with-windows-in-flight all keep ``submitted == joined +
+  dropped`` exact on the event plane AND ``submitted == verdicts +
+  shed + recovery_dropped`` exact on the packet ledger.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.infra import faults
+from cilium_tpu.monitor.ring import (GATHER_MIN_RUNG, RING_WORDS,
+                                     AsyncRingDrainer, EventRing,
+                                     _start_window)
+from cilium_tpu.serving.eventplane import DrainWindow, EventJoinWorker
+
+# ---------------------------------------------------------------------
+# EventJoinWorker unit tests: pure threads + fakes, no jax
+# ---------------------------------------------------------------------
+
+
+class _FakeRing:
+    """Stands in for monitor.ring.RingWindow in worker unit tests:
+    the worker itself only reads the accounting attributes."""
+
+    def __init__(self, appended=4, lost=0, nbytes=64):
+        self.appended = appended
+        self.lost = lost
+        self.d2h_bytes = nbytes
+        self.t_swap = time.monotonic()
+
+
+def _win(appended=4, lost=0, nbytes=64):
+    return DrainWindow(_FakeRing(appended, lost, nbytes), {}, {}, 0)
+
+
+def _wait(pred, timeout=30.0, tick=0.002):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+class TestWorkerLedger:
+    def test_joins_and_stop_drain(self):
+        joined = []
+        w = EventJoinWorker(joined.append, queue_depth=8)
+        w.start()
+        for i in range(5):
+            assert w.submit(_win(appended=i + 1))
+        st = w.stop(drain=True)
+        assert len(joined) == 5
+        assert st["windows-submitted"] == 5
+        assert st["windows-joined"] == 5
+        assert st["windows-dropped"] == 0
+        assert st["events-joined"] == 1 + 2 + 3 + 4 + 5
+        assert st["d2h-bytes"] == 5 * 64
+        assert st["join-lag-us"]["count"] == 5
+        # post-drain the ledger is exact and nothing is pending
+        assert st["windows-pending"] == 0
+
+    def test_bounded_queue_overflow_drops_oldest_counted(self):
+        started, release = threading.Event(), threading.Event()
+        dropped = []
+
+        def slow_join(win):
+            started.set()
+            release.wait(10)
+
+        w = EventJoinWorker(slow_join, drop_fn=dropped.append,
+                            queue_depth=2)
+        w.start()
+        assert w.submit(_win())  # worker picks this up and blocks
+        assert started.wait(5)
+        assert w.submit(_win(appended=2))  # queued 1/2
+        assert w.submit(_win(appended=3))  # queued 2/2
+        # overflow drops the OLDEST queued window (2), admits the new
+        assert w.submit(_win(appended=7))
+        assert w.submit(_win(appended=9))  # drops (3)
+        assert w.overflows == 2
+        assert len(dropped) == 2
+        assert w.last_drop_cause == "window queue full"
+        release.set()
+        st = w.stop(drain=True)
+        assert st["windows-submitted"] == 5
+        assert st["windows-joined"] == 3
+        assert st["windows-dropped"] == 2
+        # ...and the dropped events are the OLDEST two's
+        assert st["events-dropped"] == 2 + 3
+
+    def test_contained_join_failure_keeps_worker_alive(self):
+        joined, dropped = [], []
+
+        def join(win):
+            if win.appended == 13:
+                raise ValueError("poison window")
+            joined.append(win)
+
+        w = EventJoinWorker(join, drop_fn=dropped.append)
+        w.start()
+        w.submit(_win(appended=13))
+        w.submit(_win(appended=1))
+        st = w.stop(drain=True)
+        # one window lost (counted, cause recorded), no restart
+        # burned, the plane lived on and joined the next
+        assert len(joined) == 1 and len(dropped) == 1
+        assert st["windows-dropped"] == 1
+        assert st["worker-restarts"] == 0
+        assert "join failed" in st["last-drop-cause"]
+        assert "error" not in st
+
+    def test_death_restarts_under_budget(self):
+        # the injection site raises OUTSIDE the per-window
+        # containment -> thread death -> restart (the drain-loop
+        # watchdog discipline, applied to the join plane)
+        inj = faults.arm("eventplane.join=1x1@1", seed=1)
+        joined = []
+        try:
+            w = EventJoinWorker(joined.append, restart_budget=3)
+            w.start()
+            w.submit(_win())  # skipped by @1: joins
+            w.submit(_win(appended=5))  # dies: counted drop + restart
+            # a death DURING stop is deliberately terminal (no
+            # restart burned on a plane being shut down), so let the
+            # restart land before stopping
+            assert _wait(lambda: w.restarts >= 1)
+            w.submit(_win())  # the restarted thread joins
+            st = w.stop(drain=True)
+        finally:
+            faults.disarm(inj)
+        assert len(joined) == 2
+        assert st["worker-restarts"] == 1
+        assert st["windows-dropped"] == 1
+        assert st["events-dropped"] == 5
+        assert "worker died" in st["last-drop-cause"]
+        assert "error" not in st
+
+    def test_budget_exhaustion_is_terminal_and_swept(self):
+        inj = faults.arm("eventplane.join=1x8", seed=1)
+        try:
+            w = EventJoinWorker(lambda win: None, restart_budget=1)
+            w.start()
+            w.submit(_win())  # dies (restart 1/1)
+            _wait(lambda: w.restarts >= 1)
+            w.submit(_win())  # dies again: budget gone -> terminal
+            _wait(lambda: w.error is not None)
+            # a terminal worker drops further submits, counted
+            assert not w.submit(_win())
+            st = w.stop(drain=True)
+        finally:
+            faults.disarm(inj)
+        assert st["error"] and "exhausted" in st["error"]
+        assert st["windows-submitted"] == 3
+        assert st["windows-joined"] + st["windows-dropped"] == 3
+        assert st["windows-dropped"] >= 2
+
+    def test_stop_sweeps_hung_join_no_double_count(self):
+        """A join wedged past stop()'s timeout is claimed and counted
+        dropped (submitted == joined + dropped still exact, pending
+        0); when the wedged join_fn finally returns it must NOT also
+        count the window joined."""
+        started = threading.Event()
+        release = threading.Event()
+
+        def join(w):
+            started.set()
+            release.wait(10.0)
+
+        w = EventJoinWorker(join, queue_depth=4)
+        w.start()
+        assert w.submit(_win())
+        assert started.wait(5.0)
+        out = w.stop(drain=True, timeout=0.3)
+        assert out["windows-submitted"] == 1
+        assert out["windows-dropped"] == 1
+        assert out["windows-joined"] == 0
+        assert out["windows-pending"] == 0
+        release.set()  # let the wedged join land late
+        assert _wait(lambda: not w._thread.is_alive(), timeout=5.0)
+        st = w.stats()
+        assert st["windows-joined"] == 0  # late join didn't recount
+        assert st["windows-dropped"] == 1
+
+    def test_stop_without_drain_sweeps_counted(self):
+        started, release = threading.Event(), threading.Event()
+
+        def slow_join(win):
+            started.set()
+            release.wait(10)
+
+        w = EventJoinWorker(slow_join, queue_depth=8)
+        w.start()
+        w.submit(_win())
+        assert started.wait(5)
+        w.submit(_win())
+        w.submit(_win())
+        release.set()
+        st = w.stop(drain=False)
+        assert st["windows-submitted"] == 3
+        # the in-join window may finish; the queued ones are swept
+        assert st["windows-joined"] + st["windows-dropped"] == 3
+        assert st["windows-dropped"] >= 2
+
+
+# ---------------------------------------------------------------------
+# Occupancy-bounded gather == legacy full copy (property over cursors)
+# ---------------------------------------------------------------------
+
+
+def _packed_row(i: int) -> np.ndarray:
+    """A distinguishable wire row for global event index ``i``:
+    event bits 0b01 (occupied), id_row/pkt_idx derived from ``i``."""
+    w0 = np.uint32((1 << 3) | ((i & 0xFFFF) << 16)
+                   | ((i % 11) & 0xF) << 5)
+    w1 = np.uint32(i & 0x7FFFF)
+    return np.array([w0, w1], dtype=np.uint32)
+
+
+def _ring_with_total(cap: int, total: int, base: int = 0) -> EventRing:
+    """A synthetic ring after ``total`` appends: slot ``i & mask``
+    holds the NEWEST event with that residue (exactly what the device
+    scatter leaves behind), cursor carries the 64-bit total."""
+    import jax.numpy as jnp
+
+    buf = np.full((cap, RING_WORDS), 0xFFFFFFFF, dtype=np.uint32)
+    for i in range(max(0, total - cap), total):
+        buf[i & (cap - 1)] = _packed_row(base + i)
+    cursor = np.array([total & 0xFFFFFFFF, total >> 32],
+                      dtype=np.uint32)
+    return EventRing(buf=jnp.asarray(buf), cursor=jnp.asarray(cursor))
+
+
+class TestGatherEquivalence:
+    # cursor totals walking every regime: empty, sub-rung, rung
+    # boundaries, just-below/at/above capacity (the lap boundary),
+    # deep into the second and third laps
+    TOTALS = (0, 1, 5, 63, 64, 65, 100, 127, 128, 129, 200, 255, 256,
+              257, 300, 383, 384, 511, 512, 525)
+
+    @pytest.mark.parametrize("total", TOTALS)
+    def test_gather_matches_fullcopy(self, total):
+        cap = 128
+        rows = {}
+        meta = {}
+        for gather in (True, False):
+            d = AsyncRingDrainer(cap, gather=gather)
+            fresh = d.swap(_ring_with_total(cap, total))
+            assert fresh.capacity == cap
+            r, appended, lost = d.collect()
+            rows[gather] = r
+            meta[gather] = (appended, lost, d.events, d.lost)
+        np.testing.assert_array_equal(rows[True], rows[False])
+        assert meta[True] == meta[False]
+        # and both agree with first principles
+        appended, lost = meta[True][0], meta[True][1]
+        assert appended == total
+        assert lost == max(0, total - cap)
+        assert len(rows[True]) == min(total, cap)
+
+    def test_window_d2h_bytes_scale_with_occupancy(self):
+        cap = 1 << 12
+        # 3 events: the gather ships one GATHER_MIN_RUNG bucket, the
+        # full copy ships the whole ring regardless
+        wg, _ = AsyncRingDrainer(cap, gather=True).swap_window(
+            _ring_with_total(cap, 3))
+        wf, _ = AsyncRingDrainer(cap, gather=False).swap_window(
+            _ring_with_total(cap, 3))
+        assert wg.rung == GATHER_MIN_RUNG
+        assert wg.d2h_bytes == GATHER_MIN_RUNG * RING_WORDS * 4 + 8
+        assert wf.d2h_bytes == cap * RING_WORDS * 4 + 8
+        assert wg.d2h_bytes * 32 < wf.d2h_bytes
+        rg = wg.fetch()[0]
+        rf = wf.fetch()[0]
+        np.testing.assert_array_equal(rg, rf)
+        # empty window: nothing crosses the link at all
+        we, _ = AsyncRingDrainer(cap, gather=True).swap_window(
+            _ring_with_total(cap, 0))
+        assert we.d2h_bytes == 0 and we.buf is None
+
+    @pytest.mark.parametrize("totals", [(0, 0), (5, 0), (0, 9),
+                                        (40, 70), (64, 130), (150, 3)])
+    def test_sharded_window_gather_matches_fullcopy(self, totals):
+        """Per-chip rings: a [S*cap] buffer + [S, 2] cursor window
+        decodes identically via both paths, per-shard wrap included
+        (the rung is COMMON across shards — max occupancy)."""
+        import jax.numpy as jnp
+
+        cap, S = 64, 2
+        bufs, curs = [], []
+        for s, total in enumerate(totals):
+            r = _ring_with_total(cap, total, base=1000 * s)
+            bufs.append(np.asarray(r.buf))
+            curs.append(np.asarray(r.cursor))
+
+        class _Sharded:
+            buf = jnp.asarray(np.concatenate(bufs))
+            cursor = jnp.asarray(np.stack(curs))
+
+        out = {}
+        for gather in (True, False):
+            w = _start_window(_Sharded(), cap, S, None, None, gather,
+                              None)
+            rows, shards, appended, lost = w.fetch()
+            out[gather] = (rows, shards, appended, lost)
+        np.testing.assert_array_equal(out[True][0], out[False][0])
+        np.testing.assert_array_equal(out[True][1], out[False][1])
+        assert out[True][2:] == out[False][2:]
+        assert out[True][2] == sum(totals)
+        assert out[True][3] == sum(max(0, t - cap) for t in totals)
+
+
+# ---------------------------------------------------------------------
+# End-to-end: the serving daemon on the tpu backend
+# ---------------------------------------------------------------------
+from cilium_tpu.agent import Daemon, DaemonConfig  # noqa: E402
+from cilium_tpu.core import TCP_SYN, make_batch  # noqa: E402
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }],
+}]
+
+
+def _daemon(fault_spec=None, **over):
+    # ONE 64-wide ladder rung: shared XLA executables with the chaos
+    # suite (same (64, 16) shapes), so this file adds ~no compile cost
+    cfg = dict(backend="tpu", ct_capacity=1 << 12,
+               flow_ring_capacity=1 << 13,
+               serving_queue_depth=4096,
+               serving_bucket_ladder=(64,),
+               serving_max_wait_us=500.0,
+               serving_dispatch_deadline_ms=500.0,
+               serving_restart_budget=4,
+               fault_injection=fault_spec, fault_seed=1)
+    cfg.update(over)
+    d = Daemon(DaemonConfig(**cfg))
+    d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    d.policy_import(RULES)
+    return d, db
+
+
+def _fwd(db_id, n=64, base=20000):
+    return make_batch([
+        dict(src="10.0.1.1", dst="10.0.2.1", sport=base + i,
+             dport=5432, proto=6, flags=TCP_SYN, ep=db_id, dir=0)
+        for i in range(n)]).data
+
+
+def _assert_ledgers(out):
+    fe = out["front-end"]
+    ft = fe["fault-tolerance"]
+    assert fe["submitted"] == (fe["verdicts"] + fe["shed"]
+                               + ft["recovery-dropped"])
+    ev = out["event-plane"]
+    assert ev["windows-submitted"] == (ev["windows-joined"]
+                                       + ev["windows-dropped"])
+    assert ev["windows-pending"] == 0
+    return fe, ev
+
+
+class TestNoDecodeOnDrainThread:
+    def test_decode_runs_only_on_the_worker(self, monkeypatch):
+        """THE tier-1 regression for the tentpole: under a serving
+        load with per-packet events, every ``decode_ring_rows`` call
+        happens on the event-join worker — the drain thread's event
+        work is the 8-byte cursor sync + a queue push, nothing
+        else."""
+        import cilium_tpu.monitor.api as mon_api
+
+        seen = []
+        real = mon_api.decode_ring_rows
+
+        def spy(*a, **k):
+            seen.append(threading.current_thread().name)
+            return real(*a, **k)
+
+        monkeypatch.setattr(mon_api, "decode_ring_rows", spy)
+        d, db = _daemon()
+        d.start_serving(trace_sample=1, ingress=True, drain_every=2)
+        rt = d._serving["runtime"]
+        for i in range(4):
+            d.submit(_fwd(db.id, base=20000 + 100 * i))
+        assert _wait(lambda: rt.stats.verdicts >= 256)
+        worker = d._serving["eventplane"]
+        assert _wait(lambda: worker.windows_joined >= 1)
+        out = d.stop_serving()
+        fe, ev = _assert_ledgers(out)
+        assert ev["events-joined"] >= 256  # decode actually ran
+        assert seen, "no decode observed — the spy never fired"
+        bad = [n for n in seen
+               if not n.startswith("serving-eventjoin")]
+        assert not bad, f"event decode ran on {sorted(set(bad))}"
+        d.shutdown()
+
+
+class TestLapLoss:
+    def test_lagged_consumer_loss_counted_and_surfaced(self):
+        """A deliberately-lagged consumer: drain cadence spans more
+        events than the ring holds, so the window laps and the host
+        computes ``appended - capacity`` loss — counted in the
+        event-plane ledger and exported as
+        ``cilium_ring_lost_total``."""
+        d, db = _daemon()
+        # 64-slot ring, 4 batches x 64 events per window: 192 of the
+        # 256 appended events are lapped before the swap
+        d.start_serving(ring_capacity=64, drain_every=4,
+                        trace_sample=1)
+        for i in range(4):
+            d.serve_batch(_fwd(db.id, base=21000 + 100 * i),
+                          valid=np.ones(64, dtype=bool))
+        # the 5th serve ticks the drain (seq - last_tick >= 4)
+        d.serve_batch(_fwd(db.id, base=25000),
+                      valid=np.ones(64, dtype=bool))
+        worker = d._serving["eventplane"]
+        assert _wait(lambda: worker.windows_joined >= 1)
+        st = d.serving_stats()["event-plane"]
+        assert st["ring-lost"] == 192
+        assert st["events-joined"] == 64
+        # satellite surface: the metrics registry while serving
+        prom = d.registry.render()
+        assert "cilium_ring_lost_total 192" in prom
+        assert "cilium_serving_d2h_bytes_total" in prom
+        assert "cilium_serving_event_join_lag_us_count" in prom
+        out = d.stop_serving()
+        ev = out["event-plane"]
+        assert ev["windows-submitted"] == (ev["windows-joined"]
+                                           + ev["windows-dropped"])
+        assert ev["ring-lost"] == 192  # the last window didn't lap
+        d.shutdown()
+
+    def test_stale_window_join_refused_never_corrupts(self):
+        """The arena-horizon guard: a window whose join starts after
+        the producer dispatched past the recycling horizon is
+        REFUSED (a counted drop) — its record references may point
+        at recycled slots, and a silent join would publish events
+        attributed to the wrong packets."""
+        d, db = _daemon()
+        d.start_serving(drain_every=2, trace_sample=1)
+        d.serve_batch(_fwd(db.id), valid=np.ones(64, dtype=bool))
+        s = d._serving
+        window, s["ring"] = s["drainer"].swap_window(s["ring"])
+        stale = DrainWindow(window, {}, {}, 0,
+                            seq=s["seq"] - s["join_horizon"] - 1)
+        with pytest.raises(RuntimeError, match="arena horizon"):
+            d._event_join(stale)
+        # the refusal rolled the drainer's delivered credit back:
+        # ring.events must not count events the monitor never got
+        assert s["drainer"].events == 0
+        d.stop_serving()
+        d.shutdown()
+
+    def test_gather_off_matches_and_costs_capacity(self):
+        """event_gather=False is the legacy wire: same decoded
+        events, full-capacity d2h bytes — the contrast that proves
+        the diet is the gather, not the async plane."""
+        per_event = {}
+        for gather in (True, False):
+            d, db = _daemon()
+            d.start_serving(ring_capacity=1 << 12, drain_every=4,
+                            trace_sample=1, event_gather=gather)
+            for i in range(4):
+                d.serve_batch(_fwd(db.id, base=22000 + 100 * i),
+                              valid=np.ones(64, dtype=bool))
+            out = d.stop_serving()
+            ev = out["event-plane"]
+            assert ev["events-joined"] == 256
+            assert ev["ring-lost"] == 0
+            per_event[gather] = ev["d2h-bytes-per-event"]
+            d.shutdown()
+        # gather: 256 events ship one 256-rung bucket (8 B/event +
+        # cursor) = 16x fewer bytes than the 4096-slot full copy
+        assert per_event[True] <= 16
+        assert per_event[False] >= (1 << 12) * RING_WORDS * 4 / 256
+        assert per_event[True] * 8 < per_event[False]
+
+
+@pytest.mark.chaos
+class TestEventPlaneChaos:
+    def test_worker_death_restart_ledger_exact(self):
+        """The ``eventplane.join`` fault site kills the worker
+        mid-plane; the thread restarts under the budget, the dead
+        join's window is a COUNTED drop, its spans are evicted (the
+        tracer ledger stays exact), and the packet ledger never
+        notices."""
+        d, db = _daemon(fault_spec="eventplane.join=1x1@1")
+        d.start_serving(trace_sample=1, ingress=True, drain_every=2,
+                        span_sample=16)
+        rt = d._serving["runtime"]
+        worker = d._serving["eventplane"]
+        for i in range(6):
+            d.submit(_fwd(db.id, base=23000 + 100 * i))
+        assert _wait(lambda: rt.stats.verdicts >= 384)
+        assert _wait(lambda: worker.restarts >= 1)
+        tracer = d._serving["tracer"]
+        out = d.stop_serving()
+        fe, ev = _assert_ledgers(out)
+        assert ev["worker-restarts"] == 1
+        assert ev["windows-dropped"] >= 1
+        assert "worker died" in ev["last-drop-cause"]
+        # the dropped window's spans were evicted, not leaked
+        ts = tracer.stats()
+        assert ts["started"] == ts["completed"] + ts["dropped"]
+        d.shutdown()
+
+    def test_overflow_and_stop_with_windows_in_flight(self):
+        """A hung join stalls the plane: windows pile into the
+        bounded queue, overflow drops are counted, and
+        ``stop_serving`` over the backlog still reconciles exactly
+        (drain joins what it can, the sweep counts the rest)."""
+        d, db = _daemon(fault_spec="eventplane.join=1~0.15")
+        d.start_serving(trace_sample=1, ingress=True, drain_every=1,
+                        window_queue_depth=1)
+        rt = d._serving["runtime"]
+        worker = d._serving["eventplane"]
+        for i in range(10):
+            d.submit(_fwd(db.id, base=24000 + 50 * i))
+            _wait(lambda: rt.queue.pending == 0, timeout=5)
+        assert _wait(lambda: rt.stats.verdicts >= 640)
+        # stop while the plane still holds queued/hung windows
+        out = d.stop_serving()
+        fe, ev = _assert_ledgers(out)
+        assert ev["windows-submitted"] >= 10
+        if ev["queue-overflows"]:
+            assert ev["windows-dropped"] >= ev["queue-overflows"]
+        d.shutdown()
+
+    def test_terminal_worker_degrades_not_crashes(self):
+        """Budget exhausted mid-serve: the event plane goes terminal
+        (drops counted, error surfaced), but dispatch keeps verdicting
+        and span tracing falls back to completion-boundary stamping
+        instead of leaking into a dead queue."""
+        d, db = _daemon(fault_spec="eventplane.join=1x8",
+                        serving_restart_budget=1)
+        d.start_serving(trace_sample=1, ingress=True, drain_every=1,
+                        span_sample=8)
+        rt = d._serving["runtime"]
+        worker = d._serving["eventplane"]
+        for i in range(8):
+            d.submit(_fwd(db.id, base=26000 + 50 * i))
+            _wait(lambda: rt.queue.pending == 0, timeout=5)
+        assert _wait(lambda: worker.error is not None)
+        # serving survives the dead event plane
+        d.submit(_fwd(db.id, base=27000))
+        assert _wait(lambda: rt.stats.verdicts >= 576)
+        st = d.serving_stats()["event-plane"]
+        assert "error" in st and "exhausted" in st["error"]
+        tracer = d._serving["tracer"]
+        out = d.stop_serving()
+        fe, ev = _assert_ledgers(out)
+        assert fe["verdicts"] >= 576  # packets never stopped
+        ts = tracer.stats()
+        assert ts["started"] == ts["completed"] + ts["dropped"]
+        d.shutdown()

@@ -5,9 +5,10 @@ Covers the PR-4 tentpole acceptance properties:
 - DETERMINISM: same seed + same packet stream => the identical
   sampled-trace set (the replayable-chaos property, applied to
   tracing);
-- CORRECTNESS: six stage timestamps monotonic, the five stage
-  intervals telescope to the recorded end-to-end latency (sum <=
-  e2e, within 10%);
+- CORRECTNESS: seven stage timestamps monotonic (PR 5 split the old
+  ``device`` stamp into ``dispatch-ret`` + true window-join
+  ``device``), the six stage intervals telescope to the recorded
+  end-to-end latency (sum <= e2e, within 10%);
 - ZERO OVERHEAD OFF: sampling disabled leaves no tracer object in
   the pipeline — the hot path pays one ``is not None`` branch;
 - NO SILENT LOSS: spans whose packet dies mid-pipeline (drop-oldest
@@ -91,8 +92,8 @@ class TestSamplingDeterminism:
         assert traces
         for t in traces:
             ts = t["timestamps"]
-            assert len(ts) == len(SPAN_STAGES) == 6
-            assert all(ts[i + 1] >= ts[i] for i in range(5)), t
+            assert len(ts) == len(SPAN_STAGES) == 7
+            assert all(ts[i + 1] >= ts[i] for i in range(6)), t
             assert t["monotonic"]
             stage_sum = sum(t["stages-us"].values())
             # the intervals telescope: their sum IS the end-to-end
@@ -226,8 +227,9 @@ def _wait(pred, timeout=60.0, tick=0.002):
 class TestTraceE2EDemotion:
     def test_trace_crosses_demotion_with_monotonic_stages(self):
         """THE acceptance e2e: serving_trace_sample=64 over a real
-        tpu-backend session retrieves complete traces (six monotonic
-        stamps, stage-sum within 10% of e2e) INCLUDING one that
+        tpu-backend session retrieves complete traces (seven
+        monotonic stamps, stage-sum within 10% of e2e) INCLUDING one
+        that
         crossed a single->wide ladder demotion (its batch was
         retried on the demoted rung, so the span carries
         demoted=True and the wide mode), and the compile-event log
@@ -265,6 +267,14 @@ class TestTraceE2EDemotion:
         # a few more batches so post-demotion traces complete
         d.submit(_fwd(db.id, base=23000))
         assert _wait(lambda: rt.stats.verdicts >= 192)
+        # spans now complete ASYNCHRONOUSLY (the event-join worker
+        # stamps device/join at true window-join time); the idle-tick
+        # drain flushes the last window once traffic pauses, so wait
+        # for the ledger to reconcile before snapshotting
+        tracer = d._serving["tracer"]
+        assert _wait(lambda: (lambda st:
+                              st["started"] == st["completed"]
+                              + st["dropped"])(tracer.stats()))
         tr = d.debug_traces(limit=256)
         assert tr["enabled"] and tr["sample"] == 64
         complete = tr["traces"]
@@ -291,7 +301,9 @@ class TestTraceE2EDemotion:
         assert comp["violations"] == 0
         assert all(k["compiles"] == 1 for k in comp["by-key"])
         modes = {k["mode"] for k in comp["by-key"]}
-        assert modes <= {"packed", "wide"}
+        # "gather" = the occupancy-bounded ring-drain executables
+        # (PR 5) — bucketed rungs under the same one-per-key guard
+        assert modes <= {"packed", "wide", "gather"}
         # prometheus: the obs series ride the unified registry
         prom = d.registry.render()
         assert "cilium_obs_spans_completed_total" in prom
