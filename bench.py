@@ -908,7 +908,7 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
         d.stop_serving()
         return offline_batches * B / dt
 
-    def rep_overload(span_sample=0, trace_sample=1024):
+    def rep_overload(span_sample=0, trace_sample=1024, agg=True):
         """Overload: Poisson chunks offered until the target volume
         is ADMITTED, backing off only when the queue is full —
         offered load exceeds capacity, so sheds are expected and
@@ -921,25 +921,35 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
         decodes/joins/emits on its worker, off the dispatch path).
         ``span_sample`` arms the obs span tracer (the trace-overhead
         leg); 0 keeps the production default (tracer None, one
-        is-None branch on the hot path)."""
+        is-None branch on the hot path).  ``agg`` toggles the FLOW
+        ANALYTICS plane (windowed per-identity aggregation + top-K
+        sketches on the event-join worker, PR 6): True is the
+        production default and the headline legs run with it; the
+        dedicated agg-vs-no-agg pair at ``trace_sample=1`` isolates
+        its dispatch-path cost (agg_overhead_ratio — the aggregation
+        itself runs off-path, so the ratio defends ~1.0)."""
         # 2^16 ring: a full drain window (drain_every=4 x 8192-row
         # buckets at trace_sample=1) is half the capacity, so the
         # bench measures the gather diet, never lap loss
+        d.analytics.enabled = bool(agg)
         d.start_serving(ring_capacity=1 << 16,
                         trace_sample=trace_sample,
                         ingress=True, packed=True,
                         span_sample=span_sample or None)
         admitted = offered = i = 0
         t0 = time.perf_counter()
-        while admitted < target:
-            c = chunks[i % len(chunks)]
-            i += 1
-            got = d.submit(c)
-            offered += len(c)
-            admitted += got
-            if got < len(c):
-                time.sleep(0.0005)  # queue full: backpressure signal
-        stats = d.stop_serving()  # drains everything admitted
+        try:
+            while admitted < target:
+                c = chunks[i % len(chunks)]
+                i += 1
+                got = d.submit(c)
+                offered += len(c)
+                admitted += got
+                if got < len(c):
+                    time.sleep(0.0005)  # queue full: backpressure
+            stats = d.stop_serving()  # drains everything admitted
+        finally:
+            d.analytics.enabled = True  # the production default
         dt = time.perf_counter() - t0
         fe = stats["front-end"]
         return fe["verdicts"] / dt, fe, offered, stats["event-plane"]
@@ -950,20 +960,72 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
     # mixed-provenance telemetry would mislead anyone correlating
     # the ratio with the shed/queue-wait numbers
     offline_pps = sustained_pps = decode_pps = traced_pps = 0.0
-    fe = offered = fe_traced = ev = dec_ev = None
-    for _ in range(3):
+    noagg_pps = aggdec_pps = 0.0
+    agg_pairs = []  # per-rep (noagg, agg) adjacent-leg ratios
+    fe = offered = fe_traced = ev = dec_ev = agg_stats = None
+    # untimed ingress warm leg: the very first overload leg of a run
+    # pays residual warmth (first partial-bucket shapes, thread/alloc
+    # steady state) that a timed pair member must not absorb
+    rep_overload(agg=False)
+    for k in range(3):
         offline_pps = max(offline_pps, rep_offline())
-        pps, rep_fe, rep_offered, rep_ev = rep_overload()
-        if pps > sustained_pps:
-            sustained_pps, fe, offered, ev = (pps, rep_fe,
-                                              rep_offered, rep_ev)
-        # the PR 5 decode-under-load leg: identical overload, every
-        # packet an event — the event plane's worker decodes ~all of
-        # the admitted volume while the drain thread keeps
-        # dispatching
-        pps_dec, _, _, rep_dec_ev = rep_overload(trace_sample=1)
+        # the PR 6 agg pair: the HEADLINE leg runs at production
+        # defaults (trace_sample=1024, flow analytics ENABLED —
+        # windowed counters, both top-K sketches, and the spike
+        # detector see every decoded event AND every shed drop
+        # batch, on the event-join worker), its baseline is the
+        # identical overload with the analytics plane OFF.  The
+        # ratio between the two is the dispatch-path cost of
+        # aggregation (defended ~1.0: the drain thread only pays the
+        # O(1) monitor-consumer reference park; worker-side CPU is
+        # duty-cycle capped by flow_agg_max_duty).  The pair
+        # ALTERNATES order per rep — measured on this box, whichever
+        # leg runs second in a pair reads a few percent faster
+        # (thermal/cache settling), so a fixed order masquerades as
+        # aggregation cost; alternation cancels it in the median
+        def agg_leg():
+            nonlocal sustained_pps, fe, offered, ev, agg_stats
+            s0 = d.analytics.stats()
+            pps, rep_fe, rep_offered, rep_ev = rep_overload()
+            if pps > sustained_pps:
+                sustained_pps, fe, offered, ev = (pps, rep_fe,
+                                                  rep_offered,
+                                                  rep_ev)
+                # THIS leg's analytics activity (counters are
+                # daemon-lifetime cumulative — a raw snapshot would
+                # conflate every earlier agg-enabled leg)
+                s1 = d.analytics.stats()
+                agg_stats = {k: (s1[k] - s0[k]
+                                 if type(s1[k]) is int
+                                 and type(s0.get(k)) is int
+                                 else s1[k])
+                             for k in s1}
+            return pps
+
+        def noagg_leg():
+            nonlocal noagg_pps
+            pps_na, _, _, _ = rep_overload(agg=False)
+            noagg_pps = max(noagg_pps, pps_na)
+            return pps_na
+
+        if k % 2 == 0:
+            pps, pps_na = agg_leg(), noagg_leg()
+        else:
+            pps_na, pps = noagg_leg(), agg_leg()
+        agg_pairs.append(pps_na / pps)
+        # the PR 5 decode-under-load leg: every packet an event —
+        # the event plane's worker decodes ~all of the admitted
+        # volume while the drain thread keeps dispatching (agg off:
+        # PR 5 semantics)
+        pps_dec, _, _, rep_dec_ev = rep_overload(trace_sample=1,
+                                                 agg=False)
         if pps_dec > decode_pps:
             decode_pps, dec_ev = pps_dec, rep_dec_ev
+        # the stress contrast: per-packet events AND aggregation —
+        # the worst case the duty governor exists for, reported as
+        # a secondary honesty number (not the acceptance ratio)
+        pps_ad, _, _, _ = rep_overload(trace_sample=1)
+        aggdec_pps = max(aggdec_pps, pps_ad)
         # the obs satellite's guard leg: the SAME overload rep with
         # 1-in-64 span tracing armed, interleaved so both legs see
         # the same machine weather.  trace_overhead_ratio ~ 1.0
@@ -976,7 +1038,11 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
 
     # ---- paced: Poisson arrivals at ~50% of the offline rate — the
     # latency-percentile run (at overload, queue wait just measures
-    # queue depth)
+    # queue depth).  Analytics OFF: this leg's percentiles are the
+    # PR 5 decode-latency trajectory (trace_sample=1 is already a
+    # stress shape, not the production default) — the aggregation
+    # cost has its own dedicated pair above
+    d.analytics.enabled = False
     d.start_serving(ring_capacity=1 << 16, trace_sample=1,
                     ingress=True, packed=True)
     rate = max(offline_pps * 0.5, 1.0)
@@ -988,6 +1054,7 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
         d.submit(c)
         time.sleep(float(rng.exponential(len(c) / rate)))
     paced_out = d.stop_serving()
+    d.analytics.enabled = True
     paced = paced_out["front-end"]
     paced_ev = paced_out["event-plane"]
 
@@ -1029,9 +1096,33 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
         # event-join worker off the dispatch path
         "event_decode": "enabled (trace_sample=1024 headline; "
                         "decode leg trace_sample=1)",
+        # decode ratio keeps its PR 5 meaning (events-per-packet vs
+        # events-sampled, both with analytics OFF): the denominator
+        # is the no-agg production-default leg, not the analytics-
+        # enabled headline
         "sustained_pps_decode": round(decode_pps),
-        "decode_overhead_ratio": round(decode_pps / sustained_pps, 4)
-        if sustained_pps else None,
+        "decode_overhead_ratio": round(decode_pps / noagg_pps, 4)
+        if noagg_pps else None,
+        # the flow analytics scoreboard (PR 6 tentpole): the
+        # HEADLINE runs at production defaults with aggregation ON;
+        # sustained_pps_noagg is the identical overload with it OFF,
+        # so agg_overhead_ratio = noagg/agg defends <= 1.05 (the
+        # dispatch path only pays the O(1) reference park; worker
+        # CPU is duty-capped by flow_agg_max_duty).  The *_aggdecode
+        # pair is the per-packet-event stress contrast (every packet
+        # decoded AND aggregated) — the governor's worst case,
+        # reported for honesty, not the acceptance gate
+        "flow_agg": "headline at production defaults WITH "
+                    "aggregation; sustained_pps_noagg = same leg "
+                    "with analytics off",
+        "sustained_pps_noagg": round(noagg_pps),
+        "agg_overhead_ratio": round(sorted(agg_pairs)[1], 4)
+        if len(agg_pairs) == 3 else None,
+        "agg_overhead_ratio_pairs": [round(r, 4) for r in agg_pairs],
+        "sustained_pps_aggdecode": round(aggdec_pps),
+        "aggdecode_vs_decode_ratio": round(decode_pps / aggdec_pps, 4)
+        if aggdec_pps else None,
+        "flow_agg_stats": agg_stats,
         "d2h_bytes_per_event": dec_ev["d2h-bytes-per-event"],
         "event_join_lag_us": dec_ev["join-lag-us"],
         "event_windows": {"joined": dec_ev["windows-joined"],
@@ -1067,7 +1158,20 @@ def bench_serving(offline_batches=16, paced_seconds=2.0) -> dict:
                  "interleaved; sheds are counted monitor DROP "
                  "events (REASON_INGRESS_OVERFLOW); d2h_scaling "
                  "contrasts the occupancy-bounded gather with the "
-                 "legacy full-capacity copy at low ring occupancy"),
+                 "legacy full-capacity copy at low ring occupancy; "
+                 "agg_overhead_ratio is the median of order-"
+                 "alternated adjacent-leg pairs (production-default "
+                 "overload, analytics on vs off; aggregation runs "
+                 "on the event-join worker, duty-capped, so the "
+                 "ratio defends the dispatch path staying "
+                 "untouched).  CAVEAT: every single-run ratio here "
+                 "(trace_overhead_ratio, the agg pairs, "
+                 "serving_vs_offline) divides two wall-clock "
+                 "measurements on a shared CPU box whose weather "
+                 "swings far beyond the documented +-15%; judge "
+                 "ratios across runs (the agg pairs field exposes "
+                 "the per-rep spread for exactly this reason), "
+                 "never from one leg"),
     }
 
 

@@ -15,6 +15,7 @@ analogue).
 from __future__ import annotations
 
 import json
+import logging
 import os
 import time
 from dataclasses import dataclass, field
@@ -177,6 +178,58 @@ class DaemonConfig:
     # TensorBoard/Perfetto).  None = off
     profile_dir: Optional[str] = None
     profile_batches: int = 16
+    # -- flow analytics plane (obs/analytics.py): windowed
+    # per-identity aggregation, top-K talkers, drop-spike detection
+    # over the decoded event stream.  The aggregation work runs on
+    # the event-join worker and query threads, NEVER the serving
+    # drain thread (publishing threads only pay an O(1) reference
+    # park) — disabling it removes already-off-path work only
+    flow_agg_enabled: bool = True
+    # rolling window width in seconds, and how many CLOSED windows
+    # the ring-of-windows retains behind the open one
+    flow_agg_window_s: float = 1.0
+    flow_agg_windows: int = 8
+    # space-saving sketch capacity K (top talkers by flow 4-tuple
+    # and by identity pair): any key with true count > N/K is
+    # guaranteed retained, every estimate overshoots by <= N/K
+    flow_agg_topk: int = 32
+    # decoded batches parked between monitor publish and the
+    # worker-side drain; overflow drops the OLDEST pending batch,
+    # counted (the event plane's drop-oldest discipline)
+    flow_agg_queue_depth: int = 16
+    # aggregation duty-cycle cap (fraction of wall time per rolling
+    # second the worker may spend aggregating): "off the dispatch
+    # path" must also mean "not eating the dispatch path's machine"
+    # on CPU hosts (python-held segments contend on the GIL with the
+    # drain loop), so past the budget pending batches become counted
+    # drops instead of stolen cycles.  0.1 = 100 ms/s — ample for
+    # 1-in-N sampled traffic plus drop-storm accounting
+    flow_agg_max_duty: float = 0.1
+    # drop-spike detector: a closed window whose drop count crosses
+    # max(spike_min_drops, spike_factor * trailing-baseline) raises
+    # ONE drop-spike incident; hysteresis holds the state until
+    # drops fall back to baseline, and spike windows are excluded
+    # from the baseline (a burst must not teach itself normal)
+    spike_factor: float = 4.0
+    spike_min_drops: int = 64
+    spike_baseline_windows: int = 4
+    # -- incident flight recorder (obs/flightrec.py).  Where sysdump
+    # bundles land; None records incident history but captures no
+    # bundles.  Incidents that fire a capture: drop-spike, watchdog
+    # restart/terminal, ladder demotion, terminal event-join worker,
+    # and the manual API/CLI trigger
+    sysdump_dir: Optional[str] = None
+    # bundles kept on disk (oldest pruned past this)
+    sysdump_retention: int = 8
+    # bundle size cap; oversize bundles shed their largest optional
+    # sections (metrics text, flows, traces...) until they fit
+    sysdump_max_bytes: int = 1 << 20
+    # auto-captures inside this interval are skipped (counted) so a
+    # restart storm cannot write a bundle per restart; manual
+    # triggers bypass the limit
+    sysdump_min_interval_s: float = 1.0
+    # last-N Observer flows included per bundle
+    sysdump_flows: int = 128
 
 
 class Daemon:
@@ -327,6 +380,69 @@ class Daemon:
                 threshold=self.config.anomaly_threshold)
             self.monitor.register("anomaly", self.anomaly.consume)
 
+        # flow analytics + incident flight recorder (obs/analytics,
+        # obs/flightrec): the analytics engine rides the monitor
+        # stream as one O(1) reference-park consumer and aggregates
+        # on the event-join worker / query threads; incidents —
+        # spike, watchdog restart, ladder demotion, terminal event
+        # worker, manual — capture a sysdump bundle when a dir is
+        # configured
+        from ..obs import (FlightRecorder, FlowAnalytics,
+                           validate_analytics_config,
+                           validate_flightrec_config)
+
+        (self.config.flow_agg_window_s,
+         self.config.flow_agg_windows,
+         self.config.flow_agg_topk,
+         self.config.flow_agg_queue_depth,
+         self.config.spike_factor,
+         self.config.spike_min_drops,
+         self.config.spike_baseline_windows,
+         self.config.flow_agg_max_duty
+         ) = validate_analytics_config(
+            self.config.flow_agg_window_s,
+            self.config.flow_agg_windows,
+            self.config.flow_agg_topk,
+            self.config.flow_agg_queue_depth,
+            self.config.spike_factor,
+            self.config.spike_min_drops,
+            self.config.spike_baseline_windows,
+            self.config.flow_agg_max_duty)
+        (self.config.sysdump_dir,
+         self.config.sysdump_retention,
+         self.config.sysdump_max_bytes,
+         self.config.sysdump_min_interval_s,
+         self.config.sysdump_flows) = validate_flightrec_config(
+            self.config.sysdump_dir,
+            self.config.sysdump_retention,
+            self.config.sysdump_max_bytes,
+            self.config.sysdump_min_interval_s,
+            self.config.sysdump_flows)
+        self.flightrec = FlightRecorder(
+            self._sysdump_collect,
+            sysdump_dir=self.config.sysdump_dir,
+            retention=self.config.sysdump_retention,
+            max_bytes=self.config.sysdump_max_bytes,
+            min_interval_s=self.config.sysdump_min_interval_s,
+            node=self.config.node_name)
+        self.analytics = FlowAnalytics(
+            window_s=self.config.flow_agg_window_s,
+            retention=self.config.flow_agg_windows,
+            topk=self.config.flow_agg_topk,
+            queue_depth=self.config.flow_agg_queue_depth,
+            spike_factor=self.config.spike_factor,
+            spike_min_drops=self.config.spike_min_drops,
+            spike_baseline_windows=self.config.spike_baseline_windows,
+            max_duty=self.config.flow_agg_max_duty,
+            ep_identity=self._endpoint_identity,
+            on_incident=self.record_incident,
+            enabled=self.config.flow_agg_enabled)
+        self.monitor.register("analytics", self.analytics.submit)
+        # hubble-relay analogue: add_relay_peer() builds it lazily;
+        # when peers exist the sysdump bundle carries a relay-merged
+        # flow sample stamped with node names
+        self.relay = None
+
         # service LB: VIP -> Maglev backend selection, applied before
         # the policy pipeline (reference: pkg/service + bpf/lib/lb.h)
         from ..service import ServiceManager
@@ -469,6 +585,123 @@ class Daemon:
         ep = self.endpoints.get(ep_id)
         return (ep.name, ep.id) if ep else ("", ep_id)
 
+    def _endpoint_identity(self, ep_id: int) -> int:
+        """ep id -> LOCAL numeric identity (the analytics plane's
+        src/dst attribution for the local side of a flow)."""
+        ep = self.endpoints.get(ep_id)
+        if ep is not None and ep.identity is not None:
+            return int(ep.identity.numeric_id)
+        return 0
+
+    # -- incidents + flight recorder -----------------------------------
+    def record_incident(self, kind: str, detail=None,
+                        capture: bool = True):
+        """The one incident entry every hook funnels through: spike
+        detection (analytics, worker thread), watchdog restart /
+        terminal (serving/runtime.py on_restart, watchdog thread),
+        ladder demotion (_serving_demote, drain thread), terminal
+        event-join worker (serving/eventplane.py on_terminal), and
+        the manual API/CLI trigger.  Never raises — incident
+        recording must not take down whatever plane just faulted."""
+        try:
+            return self.flightrec.record_incident(kind, detail,
+                                                  capture=capture)
+        except Exception:  # noqa: BLE001
+            logging.getLogger(__name__).warning(
+                "incident recording failed (kind=%s)", kind,
+                exc_info=True)
+            return None
+
+    def _serving_restart_incident(self, cause: str,
+                                  terminal: bool) -> None:
+        """ServingRuntime's on_restart hook (watchdog thread)."""
+        from ..obs.flightrec import KIND_RESTART, KIND_TERMINAL
+
+        self.record_incident(
+            KIND_TERMINAL if terminal else KIND_RESTART,
+            {"cause": cause})
+
+    def _eventworker_incident(self, error: str) -> None:
+        """EventJoinWorker's on_terminal hook (worker thread)."""
+        from ..obs.flightrec import KIND_EVENTWORKER
+
+        self.record_incident(KIND_EVENTWORKER, {"error": error})
+
+    def sysdump_now(self, trigger: str = "manual") -> dict:
+        """The manual trigger (``GET /debug/sysdump?trigger=1``,
+        ``cilium-tpu sysdump``): records a manual incident and
+        captures OUTSIDE the auto rate limit.  A disabled recorder
+        declines WITHOUT recording — a probe polling the 400-ing
+        endpoint must not evict real incidents from the bounded
+        history."""
+        from ..obs.flightrec import KIND_MANUAL
+
+        if not self.flightrec.enabled:
+            return {"written": None, "enabled": False,
+                    "bundles": [], "stats": self.flightrec.stats()}
+        inc = self.flightrec.record_incident(KIND_MANUAL,
+                                             {"trigger": trigger},
+                                             capture=False)
+        path = self.flightrec.capture(trigger=KIND_MANUAL,
+                                      incident=inc, manual=True)
+        return {"written": path,
+                "enabled": self.flightrec.enabled,
+                "bundles": self.flightrec.list_bundles(),
+                "stats": self.flightrec.stats()}
+
+    def flows_aggregate(self, top: int = 16) -> dict:
+        """``GET /flows/aggregate``: the analytics snapshot (drains
+        pending batches on THIS thread — query threads are off the
+        dispatch path by definition)."""
+        return self.analytics.snapshot(top=top)
+
+    def add_relay_peer(self, name: str, observer) -> None:
+        """Register a peer agent's Observer(-protocol object) for
+        relay-merged flow views (the hubble-relay analogue; prep for
+        the clustermesh serving tier).  Once any peer is registered,
+        sysdump bundles include a relay flow sample stamped with
+        node_name."""
+        from ..flow.relay import Relay
+
+        if self.relay is None:
+            self.relay = Relay({self.config.node_name: self.observer})
+        self.relay.add_peer(name, observer)
+
+    def _sysdump_collect(self) -> dict:
+        """The flight recorder's section collector.  Each section is
+        INDIVIDUALLY contained — incident time is exactly when
+        subsystems misbehave, and one failing snapshot must not cost
+        the whole artifact."""
+        from dataclasses import asdict
+
+        out: dict = {}
+
+        def section(name, fn):
+            try:
+                out[name] = fn()
+            except Exception as e:  # noqa: BLE001
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+
+        cfg = self.config
+        section("config", lambda: asdict(cfg))
+        section("serving", self.serving_stats)
+        section("compile",
+                lambda: (self.loader.compile_log.snapshot()
+                         if getattr(self.loader, "compile_log", None)
+                         is not None else None))
+        section("traces", lambda: self.debug_traces(limit=16))
+        section("flows",
+                lambda: [f.to_dict() for f in self.observer.get_flows(
+                    number=cfg.sysdump_flows)])
+        section("flow-aggregation",
+                lambda: self.analytics.snapshot(top=16))
+        section("metrics", self.registry.render)
+        section("ct-snapshot", self.ct_snapshot_info)
+        if self.relay is not None:
+            section("relay-flows", lambda: self.relay.get_flows(
+                number=min(cfg.sysdump_flows, 64)))
+        return out
+
     # -- identity churn ----------------------------------------------
     def _on_identity_change(self, kind: str, ident) -> None:
         # CIDR-derived identities feed the ipcache (reference: ipcache
@@ -553,6 +786,15 @@ class Daemon:
                 "ct-snapshot",
                 lambda: self.ct_snapshot_now(trigger="interval"),
                 self.config.ct_snapshot_interval)
+        if self.config.flow_agg_enabled:
+            # close aggregation windows on WALL time: a drop burst
+            # followed by total silence must still reach the spike
+            # detector (ingest-driven rolls need a later batch that
+            # may never come).  Controller thread = off the dispatch
+            # path, like every other drain() caller
+            self.controllers.update(
+                "flow-agg-roll", self.analytics.drain,
+                self.config.flow_agg_window_s)
         # endpoints whose identity allocation failed (kvstore outage)
         # retry here until they leave waiting-for-identity
         self.controllers.update(
@@ -819,6 +1061,9 @@ class Daemon:
         if self.auth_manager is not None:
             self.auth_manager.observe(batch, now)
         self.monitor.publish(self._filter_events(batch))
+        # offline path: aggregate inline on the CALLER's thread (the
+        # serving path instead drains on the event-join worker)
+        self.analytics.drain()
         return batch
 
     def _filter_events(self, batch: EventBatch) -> EventBatch:
@@ -1121,7 +1366,8 @@ class Daemon:
         worker = EventJoinWorker(
             self._event_join, drop_fn=self._event_drop,
             queue_depth=window_queue_depth,
-            restart_budget=cfg.serving_restart_budget)
+            restart_budget=cfg.serving_restart_budget,
+            on_terminal=self._eventworker_incident)
         self._serving = {
             "drainer": drainer,
             "ring": drainer.fresh(),
@@ -1220,6 +1466,10 @@ class Daemon:
                 # when traffic pauses (the worker then joins it off
                 # the dispatch path as usual)
                 idle_fn=self._serving_event_idle_tick,
+                # flight recorder: every watchdog restart (and the
+                # terminal transition) is a named incident with an
+                # auto-captured sysdump bundle
+                on_restart=self._serving_restart_incident,
                 profile_dir=cfg.profile_dir,
                 profile_batches=cfg.profile_batches)
             self._serving["runtime"] = runtime
@@ -1309,6 +1559,10 @@ class Daemon:
         new = s["ladder"].demote()
         logging.getLogger(__name__).warning(
             "serving ladder demotes %s -> %s: %s", old, new, cause)
+        from ..obs.flightrec import KIND_DEMOTION
+
+        self.record_incident(KIND_DEMOTION,
+                             {"from": old, "to": new, "cause": cause})
         if old == "sharded":
             from ..monitor.ring import AsyncRingDrainer
 
@@ -1529,7 +1783,8 @@ class Daemon:
         out = {"active": True,
                "ring": {"windows": d.windows, "events": d.events,
                         "lost": d.lost},
-               "event-plane": s["eventplane"].stats()}
+               "event-plane": s["eventplane"].stats(),
+               "analytics": self.analytics.stats()}
         if s["n_shards"]:
             out["shards"] = s["n_shards"]
             out["route-overflow"] = s["route_overflow"]
@@ -1860,6 +2115,16 @@ class Daemon:
                         "span commit failed at window join",
                         exc_info=True)
                     break
+        # the flow analytics plane drains HERE — on the event-join
+        # worker, never the drain thread.  Contained: the window's
+        # events were already delivered above, so an analytics fault
+        # must not recount the window as a drop
+        try:
+            self.analytics.drain()
+        except Exception:  # noqa: BLE001
+            logging.getLogger(__name__).warning(
+                "flow-analytics drain failed at window join",
+                exc_info=True)
 
     @staticmethod
     def _event_check_horizon(dw, s) -> None:
@@ -1907,6 +2172,9 @@ class Daemon:
         # COUNTED drop — submitted == joined + dropped holds exactly
         self._serving_drain_tick(s)
         ev = s["eventplane"].stop(drain=True)
+        # the worker is drained: aggregate whatever it published
+        # (caller-thread context — the drain loop has stopped)
+        self.analytics.drain()
         if s["mesh"] is not None:
             # leave the loader in the default single-device placement
             # (subsequent step()/process_batch callers expect it)
@@ -2155,6 +2423,11 @@ class Daemon:
             "dropped": int(m[1:].sum()),
             "monitor-events": self.monitor.published,
             "flows-seen": self.observer.seq,
+            # via stats(): the sum happens under the recorder's lock
+            # (an unlocked dict iteration races first-of-a-kind
+            # incident insertion on worker/watchdog threads)
+            "incidents": self.flightrec.stats()["incidents"],
+            "flow-aggregation": self.analytics.stats(),
             "controllers": {
                 n: {"success": s.success_count, "failure": s.failure_count,
                     "last-error": s.last_error.splitlines()[-1]

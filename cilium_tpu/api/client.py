@@ -143,6 +143,14 @@ class APIClient:
     def debug_traces(self, limit: int = 64):
         return self._request("GET", f"/debug/traces?limit={limit}")
 
+    def flows_aggregate(self, top: int = 16):
+        return self._request("GET", f"/flows/aggregate?top={top}")
+
+    def sysdump(self, trigger: bool = False):
+        return self._request(
+            "GET", "/debug/sysdump" + ("?trigger=1" if trigger
+                                       else ""))
+
     def metrics_inventory(self):
         return self._request("GET", "/metrics/inventory")
 

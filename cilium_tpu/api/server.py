@@ -177,6 +177,31 @@ def _make_handler(daemon: Daemon):
                     self._send(200, daemon.debug_traces(limit=limit))
                 elif path == "/flows":
                     self._send(200, _flows(daemon, q))
+                elif path == "/flows/aggregate":
+                    # the flow analytics plane: windowed per-identity
+                    # aggregates, verdict matrix, top-K talkers,
+                    # spike state (`cilium-tpu top` reads this)
+                    top = int(q.get("top", ["16"])[0])
+                    self._send(200, daemon.flows_aggregate(top=top))
+                elif path == "/debug/sysdump":
+                    # the incident flight recorder: list bundles +
+                    # incident history; ?trigger=1 captures a manual
+                    # bundle first (bypasses the auto rate limit)
+                    if q.get("trigger", ["0"])[0] in ("1", "true"):
+                        out = daemon.sysdump_now()
+                        if out["written"] is None and \
+                                not out["enabled"]:
+                            self._send(400, {
+                                "error": "sysdump disabled: run the "
+                                "agent with --sysdump-dir"})
+                            return
+                        self._send(200, out)
+                    else:
+                        self._send(200, {
+                            "enabled": daemon.flightrec.enabled,
+                            "bundles": daemon.flightrec.list_bundles(),
+                            "incidents": daemon.flightrec.incidents(),
+                            "stats": daemon.flightrec.stats()})
                 elif path == "/proxy":
                     # redirect listeners + their L7 rule shapes (the
                     # xDS NetworkPolicy view; reference: pkg/envoy)
@@ -380,15 +405,23 @@ def _metrics_text(daemon: Daemon) -> str:
 
 
 def _flows(daemon: Daemon, q: dict) -> list:
+    """GET /flows with the shared filter vocabulary (`cilium-tpu
+    flows` and `top` speak the same flags): verdict/port/protocol/
+    source_ip/destination_ip/since/identity map straight onto
+    FlowFilter fields (`identity` = the flow's remote security
+    identity — the only identity column the ring stores)."""
     f = FlowFilter(
         verdict=int(q["verdict"][0]) if "verdict" in q else None,
         port=int(q["port"][0]) if "port" in q else None,
         protocol=int(q["protocol"][0]) if "protocol" in q else None,
         source_ip=q.get("source_ip", [None])[0],
         destination_ip=q.get("destination_ip", [None])[0],
+        since=float(q["since"][0]) if "since" in q else None,
+        identity=int(q["identity"][0]) if "identity" in q else None,
     )
     n = int(q.get("number", ["100"])[0])
-    filters = [] if all(
-        v is None for v in (f.verdict, f.port, f.protocol, f.source_ip,
-                            f.destination_ip)) else [f]
+    filters = [f] if any(
+        v is not None for v in (f.verdict, f.port, f.protocol,
+                                f.source_ip, f.destination_ip,
+                                f.since, f.identity)) else []
     return [fl.to_dict() for fl in daemon.observer.get_flows(filters, n)]

@@ -315,14 +315,133 @@ def cmd_metrics(args) -> int:
 
 
 def cmd_flows(args) -> int:
+    """`cilium-tpu flows [-f]` (hubble observe): recent flows with
+    the SHARED filter vocabulary — `--verdict/--identity/--port/
+    --protocol/--since` map onto the Observer's vectorized
+    FlowFilter, and `top` renders aggregates over the same fields.
+    Follow mode tails new flows by uuid."""
     c = _client(args)
-    flows = c.flows(number=args.number, verdict=args.verdict,
-                    port=args.port, protocol=args.protocol)
-    if args.json:
-        _print(flows)
+    # --since S = "the last S seconds": resolve to the epoch once so
+    # a follow session keeps its original left edge
+    since = (time.time() - args.since) if args.since else None
+    seen = 0
+    try:
+        while True:
+            flows = c.flows(number=args.number, verdict=args.verdict,
+                            port=args.port, protocol=args.protocol,
+                            identity=args.identity, since=since)
+            if args.json:
+                # json mode follows too (one snapshot per tick, like
+                # `top --json -f`) instead of silently ignoring -f
+                _print(flows)
+            else:
+                fresh = [f for f in flows if int(f["uuid"]) >= seen]
+                for fl in sorted(fresh, key=lambda f: int(f["uuid"])):
+                    print(f"{fl['time']:.3f} {fl['Summary']}")
+                    seen = max(seen, int(fl["uuid"]) + 1)
+            if not args.follow:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
         return 0
-    for fl in reversed(flows):
-        print(f"{fl['time']:.3f} {fl['Summary']}")
+
+
+def cmd_top(args) -> int:
+    """`cilium-tpu top [-f]`: the flow analytics plane live — top
+    talkers (space-saving sketch with its error bound), the
+    per-identity verdict matrix over the retained windows, and the
+    drop-spike state (GET /flows/aggregate)."""
+    c = _client(args)
+    try:
+        while True:
+            agg = c.flows_aggregate(top=args.number)
+            if args.json:
+                _print(agg)
+            elif not agg.get("enabled"):
+                print("Flow analytics: disabled "
+                      "(flow-agg-enabled=false)")
+            else:
+                cur = agg.get("current-window") or {}
+                spike = agg.get("spike") or {}
+                led = agg.get("ledger") or {}
+                print(f"Analytics: window {agg.get('window-s')}s x "
+                      f"{agg.get('retention')} retained, "
+                      f"{agg.get('windows-closed', 0)} closed, "
+                      f"{led.get('packets-seen', 0)} packets seen, "
+                      f"spikes {spike.get('spikes', 0)}"
+                      + (" [IN SPIKE]" if spike.get("in-spike")
+                         else ""))
+                print(f"Window:    {cur.get('packets', 0)} packets, "
+                      f"{cur.get('bytes', 0)} B, "
+                      f"{cur.get('drops', 0)} drops "
+                      f"(baseline {spike.get('baseline-drops')}, "
+                      f"threshold >= {spike.get('min-drops')} or "
+                      f"{spike.get('factor')}x)")
+                talkers = agg.get("top-talkers") or []
+                if talkers:
+                    print(f"\nTop talkers (overcount <= "
+                          f"{agg.get('sketch-error-bound', 0)}):")
+                    print(f"{'SRC':<24}{'DST':<24}{'PROTO':<7}"
+                          f"{'PACKETS':>10}{'BYTES':>13}{'ERR':>7}")
+                    for t in talkers[:args.number]:
+                        print(f"{t['src'] + ':' + str(t['sport']):<24}"
+                              f"{t['dst'] + ':' + str(t['dport']):<24}"
+                              f"{t['proto']:<7}{t['packets']:>10}"
+                              f"{t['bytes']:>13}{t['error']:>7}")
+                matrix = agg.get("matrix") or []
+                if matrix:
+                    print(f"\nVerdict matrix (retained windows):")
+                    print(f"{'SRC-ID':<10}{'DST-ID':<10}"
+                          f"{'VERDICT':<9}{'REASON':<8}"
+                          f"{'PACKETS':>10}{'BYTES':>13}")
+                    for m in matrix[:args.number]:
+                        print(f"{m['src-identity']:<10}"
+                              f"{m['dst-identity']:<10}"
+                              f"{m['verdict']:<9}{m['reason']:<8}"
+                              f"{m['packets']:>10}{m['bytes']:>13}")
+            if not args.follow:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_sysdump(args) -> int:
+    """`cilium-tpu sysdump [list]`: trigger a manual flight-recorder
+    bundle (bypasses the auto rate limit) or list what the incident
+    machinery has already captured."""
+    c = _client(args)
+    if args.action == "list":
+        out = c.sysdump(trigger=False)
+        if args.json:
+            _print(out)
+            return 0
+        if not out.get("enabled"):
+            print("Sysdump: disabled (run the agent with "
+                  "--sysdump-dir)")
+        for b in out.get("bundles", []):
+            print(f"{b['name']}  {b['bytes']} B")
+        for i in (out.get("incidents") or [])[-10:]:
+            print(f"incident #{i['seq']} {i['kind']} "
+                  f"@{i['time']:.3f}")
+        return 0
+    out = c.sysdump(trigger=True)
+    if args.json:
+        _print(out)
+        return 0 if out.get("written") else 1
+    written = out.get("written")
+    if not written:
+        # enabled but nothing written: another capture held the
+        # re-entrancy guard — tell the operator instead of lying
+        # "wrote None" with a zero exit
+        print("no bundle written (another capture in progress; "
+              "retry, or see `sysdump list`)", file=sys.stderr)
+        return 1
+    print(f"wrote {written}")
+    st = out.get("stats") or {}
+    print(f"bundles: {len(out.get('bundles', []))} on disk, "
+          f"writes {st.get('writes')}, "
+          f"incidents {st.get('incidents')}")
     return 0
 
 
@@ -697,6 +816,8 @@ def cmd_daemon(args) -> int:
         "serving_trace_sample": args.serving_trace_sample,
         "profile_dir": args.profile_dir,
         "profile_batches": args.profile_batches,
+        "sysdump_dir": args.sysdump_dir,
+        "flow_agg_enabled": args.flow_agg,
     }.items() if v is not None}
     cfg = load_config(config_dir=args.config_dir, **overrides)
     d = Daemon(cfg)
@@ -793,11 +914,35 @@ def main(argv=None) -> int:
     sub.add_parser("map", help="list datapath maps")
     sub.add_parser("metrics", help="prometheus metrics")
 
-    p = sub.add_parser("flows", help="recent flows (hubble observe)")
+    p = sub.add_parser("flows", help="recent flows (hubble observe); "
+                                     "-f tails, filters share the "
+                                     "`top` vocabulary")
     p.add_argument("--number", type=int, default=20)
     p.add_argument("--verdict", type=int)
     p.add_argument("--port", type=int)
     p.add_argument("--protocol", type=int)
+    p.add_argument("--identity", type=int,
+                   help="the flow's remote security identity "
+                        "(numeric)")
+    p.add_argument("--since", type=float,
+                   help="only flows from the last SECONDS")
+    p.add_argument("--follow", "-f", action="store_true")
+    p.add_argument("--interval", type=float, default=1.0)
+
+    p = sub.add_parser("top",
+                       help="live top talkers + per-identity verdict "
+                            "matrix + drop-spike state (the flow "
+                            "analytics plane)")
+    p.add_argument("--follow", "-f", action="store_true")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--number", type=int, default=10,
+                   help="rows per table")
+
+    p = sub.add_parser("sysdump",
+                       help="trigger a flight-recorder bundle | "
+                            "sysdump list")
+    p.add_argument("action", nargs="?", default="capture",
+                   choices=["capture", "list"])
 
     p = sub.add_parser("monitor", help="tail the event stream")
     p.add_argument("--follow", "-f", action="store_true")
@@ -908,6 +1053,19 @@ def main(argv=None) -> int:
     p.add_argument("--profile-batches", type=int, default=None,
                    help="profile capture window length in batches "
                         "(default 16)")
+    p.add_argument("--sysdump-dir", default=None,
+                   help="incident flight-recorder bundle directory: "
+                        "drop-spike / watchdog-restart / "
+                        "ladder-demotion / terminal-event-worker / "
+                        "manual incidents each capture a bounded "
+                        "JSON sysdump here (retention-capped); "
+                        "unset = incidents recorded, no bundles")
+    p.add_argument("--flow-agg", default=None,
+                   choices=["true", "false"],
+                   help="flow analytics plane (windowed per-identity "
+                        "aggregation, top-K talkers, drop-spike "
+                        "detection; runs off the dispatch path on "
+                        "the event-join worker; default true)")
 
     args = parser.parse_args(argv)
     if args.cmd == "version":
@@ -921,6 +1079,7 @@ def main(argv=None) -> int:
             "endpoint": cmd_endpoint, "identity": cmd_identity,
             "bpf": cmd_bpf, "map": cmd_map, "metrics": cmd_metrics,
             "flows": cmd_flows, "monitor": cmd_monitor,
+            "top": cmd_top, "sysdump": cmd_sysdump,
             "serving": cmd_serving, "trace": cmd_trace,
             "anomaly": cmd_anomaly, "daemon": cmd_daemon,
             "service": cmd_service, "fqdn": cmd_fqdn,

@@ -51,6 +51,14 @@ class FlowFilter:
     destination_ip: Optional[str] = None
     source_identity: Optional[int] = None
     destination_identity: Optional[int] = None
+    # the flow's security identity on WHICHEVER side is remote (the
+    # ring stores only the remote numeric identity; the local side
+    # is an endpoint, not an identity column).  This is what
+    # `--identity` / ?identity= mean: "flows involving identity N" —
+    # note that OR-ing source_identity with destination_identity
+    # does NOT express this (each wildcards the rows the other
+    # constrains, so the union matches everything)
+    identity: Optional[int] = None
     port: Optional[int] = None
     protocol: Optional[int] = None
     since: Optional[float] = None
@@ -86,6 +94,8 @@ class FlowFilter:
             m &= ring.time[idx] <= self.until
         if self.reply is not None:
             m &= (ring.ct_state[idx] == CT_REPLY) == self.reply
+        if self.identity is not None:
+            m &= ring.identity[idx] == self.identity
         if self.source_identity is not None or \
                 self.destination_identity is not None:
             is_reply = ring.ct_state[idx] == CT_REPLY
@@ -104,7 +114,21 @@ class FlowFilter:
 
 
 class Observer:
-    """Fixed-capacity SoA flow ring (power-of-two capacity)."""
+    """Fixed-capacity SoA flow ring (power-of-two capacity).
+
+    Thread-safety contract (audited for the async event plane):
+    under live serving ``consume`` runs on the EVENT-JOIN WORKER
+    (monitor fan-out), ``append_l7`` on proxy threads, and
+    ``get_flows`` on API handler threads — concurrently.  Every ring
+    mutation (the vectorized slice-assign + the ``seq`` bump) and
+    every read (the oldest-pointer computation, filter masks, and
+    row materialization) happens under ``_lock``, so a query
+    observes either ALL of a batch's rows or none of them: no torn
+    rows (a row whose columns mix two different flows), and ``seq``
+    is monotonic across queries.  The seq bump deliberately happens
+    LAST inside the locked block, after every column landed.
+    ``tests/test_flow_analytics.py`` pins this with a concurrent
+    query-during-live-consume test."""
 
     def __init__(self, capacity: int = 4096,
                  identity_getter: Optional[IdentityGetter] = None,

@@ -36,21 +36,44 @@ Pieces (PARITY.md row 57):
   and the one-executable-per-(rung, mode) invariant is asserted at
   RUNTIME (a duplicate compile for a seen key counts as a violation
   and logs), not just in tests.
+- :mod:`.analytics` — the flow analytics plane (PARITY row 59):
+  windowed per-identity aggregation, space-saving top-K talkers,
+  and drop-spike detection over the decoded event stream; all
+  aggregation runs OFF the dispatch path (event-join worker / query
+  threads).  ``GET /flows/aggregate``, ``cilium-tpu top [-f]``.
+- :mod:`.flightrec` — the incident flight recorder: named incidents
+  (spike, watchdog restart, ladder demotion, terminal event worker,
+  manual) capture bounded, retention-capped sysdump bundles to
+  ``--sysdump-dir``.  ``GET /debug/sysdump``, ``cilium-tpu
+  sysdump``, ``scripts/check_sysdump_schema.py``.
 """
 
 from __future__ import annotations
 
+from .analytics import (FlowAnalytics, SpaceSavingSketch,  # noqa: F401
+                        SpikeDetector, WindowAggregator,
+                        validate_analytics_config)
 from .compile_log import CompileLog  # noqa: F401
+from .flightrec import (SYSDUMP_REQUIRED_KEYS,  # noqa: F401
+                        FlightRecorder, validate_flightrec_config)
 from .registry import MetricsRegistry, build_daemon_registry  # noqa: F401
 from .trace import (SPAN_STAGES, SpanTracer, TraceSpan,  # noqa: F401
                     validate_obs_config)
 
 __all__ = [
     "CompileLog",
+    "FlightRecorder",
+    "FlowAnalytics",
     "MetricsRegistry",
     "SPAN_STAGES",
+    "SYSDUMP_REQUIRED_KEYS",
+    "SpaceSavingSketch",
     "SpanTracer",
+    "SpikeDetector",
     "TraceSpan",
+    "WindowAggregator",
     "build_daemon_registry",
+    "validate_analytics_config",
+    "validate_flightrec_config",
     "validate_obs_config",
 ]

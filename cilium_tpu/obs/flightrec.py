@@ -1,0 +1,339 @@
+"""The incident flight recorder: named incidents + sysdump bundles.
+
+Reference: production cilium ships ``cilium-bugtool`` and
+``cilium sysdump`` — when something goes wrong, the FIRST operator
+move is capturing the agent's state as one artifact, because by the
+time a human looks, the interesting state (ladder position, recent
+flows, queue depths) has healed or rolled over.  This module is that
+discipline made automatic: the serving plane's failure machinery
+(watchdog restart, ladder demotion, terminal event-join worker), the
+analytics plane's drop-spike detector, and a manual API/CLI trigger
+all RECORD a named incident here, and — when a ``sysdump_dir`` is
+configured — each incident captures a bundle at the moment it fired.
+
+The bundle is one JSON file assembled by the owner's ``collect_fn``
+(the daemon snapshots DaemonConfig, serving stats + ladder state, the
+compile log, the span tracer's slowest/latest traces, the last N
+flows from the Observer, the live aggregation windows, the metrics
+registry render, and — when relay peers are registered — a
+relay-merged flow sample stamped with node names).  Guarantees:
+
+- SECTION-CONTAINED collection: a failing section becomes
+  ``{"error": ...}`` in the bundle instead of killing the capture
+  (incident time is exactly when subsystems misbehave);
+- BOUNDED size: an oversize bundle sheds its largest optional
+  sections in a fixed order (metrics text, flows, relay flows,
+  traces, aggregation) until it fits ``max_bytes``, recording what
+  was truncated — a flight recorder that can fill a disk during an
+  incident storm is itself an incident;
+- ATOMIC writes (tmp + rename) with a RETENTION cap (oldest bundles
+  deleted past ``retention``);
+- RATE-LIMITED auto-capture (``min_interval_s``): a restart storm
+  records every incident but skips captures inside the interval,
+  counted — manual triggers bypass the limit;
+- RE-ENTRANCY-SAFE: an incident fired from inside a capture's
+  collect (e.g. a spike detected while the capture drains analytics)
+  records but never nests a second capture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+# incident kinds the agent fires (detail payloads differ per kind);
+# the registry's cilium_incidents_total{kind=} labels come from here
+KIND_SPIKE = "drop-spike"
+KIND_RESTART = "watchdog-restart"
+KIND_TERMINAL = "watchdog-terminal"
+KIND_DEMOTION = "ladder-demotion"
+KIND_EVENTWORKER = "eventworker-terminal"
+KIND_MANUAL = "manual"
+
+# required top-level bundle keys (scripts/check_sysdump_schema.py
+# validates written bundles against this; keep the two in sync via
+# the import there)
+SYSDUMP_REQUIRED_KEYS = (
+    "schema", "node", "taken-at", "trigger", "incident", "config",
+    "serving", "compile", "traces", "flows", "flow-aggregation",
+    "incidents", "metrics",
+)
+SYSDUMP_SCHEMA = 1
+
+# oversize bundles shed these sections in order until under the cap
+_SHED_ORDER = ("metrics", "flows", "relay-flows", "traces",
+               "flow-aggregation")
+
+MAX_INCIDENTS = 128
+
+
+def validate_flightrec_config(sysdump_dir, retention, max_bytes,
+                              min_interval_s, flows) -> tuple:
+    """Validate the flight-recorder DaemonConfig knobs (the
+    validate_serving_config contract)."""
+    if sysdump_dir is not None:
+        sysdump_dir = str(sysdump_dir)
+        if not sysdump_dir:
+            sysdump_dir = None
+    retention = int(retention)
+    if retention < 1:
+        raise ValueError("sysdump_retention must be >= 1")
+    max_bytes = int(max_bytes)
+    if max_bytes < 4096:
+        raise ValueError("sysdump_max_bytes must be >= 4096 (the "
+                         "bundle skeleton alone needs that)")
+    min_interval_s = float(min_interval_s)
+    if min_interval_s < 0:
+        raise ValueError("sysdump_min_interval_s must be >= 0")
+    flows = int(flows)
+    if flows < 0:
+        raise ValueError("sysdump_flows must be >= 0")
+    return sysdump_dir, retention, max_bytes, min_interval_s, flows
+
+
+class FlightRecorder:
+    """Incident history + bundle capture.  ``collect_fn()`` returns
+    the section dict (each value already JSON-safe or str()-able);
+    the recorder adds the envelope (schema/trigger/incident/
+    incidents) and enforces the size/retention bounds."""
+
+    def __init__(self, collect_fn: Callable[[], Dict[str, object]],
+                 sysdump_dir: Optional[str] = None,
+                 retention: int = 8, max_bytes: int = 1 << 20,
+                 min_interval_s: float = 1.0, node: str = "node0"):
+        (sysdump_dir, retention, max_bytes, min_interval_s, _
+         ) = validate_flightrec_config(sysdump_dir, retention,
+                                       max_bytes, min_interval_s, 0)
+        self._collect = collect_fn
+        self.sysdump_dir = sysdump_dir
+        self.retention = retention
+        self.max_bytes = max_bytes
+        self.min_interval_s = min_interval_s
+        self.node = node
+        self._lock = threading.Lock()
+        self._incidents: List[dict] = []
+        self._seq = 0
+        self._last_capture = 0.0
+        self._capturing = False  # re-entrancy guard (same or cross
+        # thread: a capture triggered during a capture is skipped,
+        # counted — its incident is still recorded)
+        self.incidents_total: Dict[str, int] = {}
+        self.writes_total = 0
+        self.captures_skipped = 0
+        self.write_errors = 0
+        self.last_bundle: Optional[str] = None
+        self.last_error: Optional[str] = None
+
+    # -- incidents -----------------------------------------------------
+    def record_incident(self, kind: str, detail=None,
+                        capture: bool = True) -> dict:
+        """Record one named incident; with ``capture`` (and a
+        configured dir, outside the rate limit) also writes a sysdump
+        bundle ASYNCHRONOUSLY on a short-lived capture thread.  Safe
+        (and cheap) from any thread — the serving DRAIN thread fires
+        this on ladder demotion, and a synchronous capture there
+        would drag the whole collect (analytics drain, metrics
+        render) onto the dispatch path; the watchdog and event-join
+        worker likewise must not stall behind a bundle write."""
+        with self._lock:
+            self._seq += 1
+            inc = {
+                "seq": self._seq,
+                "kind": str(kind),
+                "time": time.time(),
+                "detail": self._safe_detail(detail),
+            }
+            self._incidents.append(inc)
+            del self._incidents[:-MAX_INCIDENTS]
+            self.incidents_total[inc["kind"]] = (
+                self.incidents_total.get(inc["kind"], 0) + 1)
+        if capture and self.enabled:
+            # pre-check the rate limit / re-entrancy under the lock
+            # so an incident storm does not spawn a thread per
+            # incident just for capture() to decline; capture()
+            # re-checks authoritatively (a racing pair costs one
+            # wasted thread, never a double bundle)
+            with self._lock:
+                skip = (self._capturing
+                        or (self.min_interval_s > 0
+                            and self._last_capture
+                            and time.monotonic() - self._last_capture
+                            < self.min_interval_s))
+                if skip:
+                    self.captures_skipped += 1
+            if not skip:
+                threading.Thread(
+                    target=self.capture,
+                    kwargs={"trigger": kind, "incident": inc,
+                            "manual": False},
+                    daemon=True, name="sysdump-capture").start()
+        return inc
+
+    @staticmethod
+    def _safe_detail(detail):
+        if detail is None:
+            return None
+        if isinstance(detail, (str, int, float, bool)):
+            return detail
+        try:
+            json.dumps(detail)
+            return detail
+        except (TypeError, ValueError):
+            return str(detail)[:500]
+
+    def incidents(self, limit: int = 32) -> List[dict]:
+        with self._lock:
+            return [dict(i) for i in self._incidents[-limit:]]
+
+    # -- bundles -------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self.sysdump_dir is not None
+
+    def capture(self, trigger: str = KIND_MANUAL,
+                incident: Optional[dict] = None,
+                manual: bool = True) -> Optional[str]:
+        """Write one bundle; returns its path, or None when disabled,
+        rate-limited (auto only), or nested inside another capture."""
+        if not self.enabled:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if self._capturing:
+                self.captures_skipped += 1
+                return None
+            if (not manual and self.min_interval_s > 0
+                    and self._last_capture
+                    and now - self._last_capture
+                    < self.min_interval_s):
+                self.captures_skipped += 1
+                return None
+            self._capturing = True
+            self._last_capture = now
+            seq = self._seq
+            recent = [dict(i) for i in self._incidents[-32:]]
+        try:
+            return self._write_bundle(trigger, incident, recent, seq)
+        finally:
+            with self._lock:
+                self._capturing = False
+
+    def _write_bundle(self, trigger: str, incident: Optional[dict],
+                      recent: List[dict], seq: int) -> Optional[str]:
+        bundle: Dict[str, object] = {
+            "schema": SYSDUMP_SCHEMA,
+            "node": self.node,
+            "taken-at": time.time(),
+            "trigger": str(trigger),
+            "incident": incident,
+            "incidents": recent,
+            "max-bytes": self.max_bytes,
+        }
+        try:
+            sections = self._collect() or {}
+        except Exception as e:  # noqa: BLE001 — a wholly-failed
+            sections = {"collect-error": str(e)}  # collect still
+            # yields a bundle: the envelope + incident history alone
+            # beat no artifact
+        for key, val in sections.items():
+            bundle.setdefault(key, val)
+        for key in SYSDUMP_REQUIRED_KEYS:
+            bundle.setdefault(key, None)
+        body, _ = self._bound(bundle)  # shed record rides the body
+        name = (f"sysdump-{time.strftime('%Y%m%d-%H%M%S')}"
+                f"-{seq:05d}-{_slug(trigger)}.json")
+        path = os.path.join(self.sysdump_dir, name)
+        try:
+            os.makedirs(self.sysdump_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(body)
+            os.replace(tmp, path)
+        except OSError as e:
+            with self._lock:
+                self.write_errors += 1
+                self.last_error = str(e)
+            return None
+        with self._lock:
+            self.writes_total += 1
+            self.last_bundle = path
+        self._prune()
+        return path
+
+    def _bound(self, bundle: Dict[str, object]) -> tuple:
+        """Serialize under the size cap, shedding the largest
+        optional sections in ``_SHED_ORDER`` until it fits."""
+        truncated: List[str] = []
+        while True:
+            bundle["truncated"] = truncated
+            body = json.dumps(bundle, indent=1, default=str)
+            if len(body.encode()) <= self.max_bytes:
+                return body, truncated
+            for key in _SHED_ORDER:
+                if bundle.get(key) not in (None, "(truncated)"):
+                    bundle[key] = "(truncated)"
+                    truncated.append(key)
+                    break
+            else:
+                # nothing left to shed: hard-truncate the body (an
+                # invalid-JSON tail beats an unbounded file; the
+                # schema check treats this as a failed bundle, which
+                # is the honest answer)
+                return body[:self.max_bytes], truncated + ["(body)"]
+
+    def _prune(self) -> None:
+        try:
+            names = sorted(n for n in os.listdir(self.sysdump_dir)
+                           if n.startswith("sysdump-")
+                           and n.endswith(".json"))
+            for n in names[:-self.retention]:
+                os.unlink(os.path.join(self.sysdump_dir, n))
+        except OSError:
+            pass
+
+    def list_bundles(self) -> List[dict]:
+        """``GET /debug/sysdump``'s listing: newest first."""
+        if not self.enabled:
+            return []
+        try:
+            names = sorted((n for n in os.listdir(self.sysdump_dir)
+                            if n.startswith("sysdump-")
+                            and n.endswith(".json")), reverse=True)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            path = os.path.join(self.sysdump_dir, n)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue
+            out.append({"name": n, "path": path,
+                        "bytes": int(st.st_size),
+                        "modified": round(st.st_mtime, 3)})
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "dir": self.sysdump_dir,
+                "retention": self.retention,
+                "max-bytes": self.max_bytes,
+                "incidents": sum(self.incidents_total.values()),
+                "incidents-by-kind": dict(self.incidents_total),
+                "writes": self.writes_total,
+                "captures-skipped": self.captures_skipped,
+                "write-errors": self.write_errors,
+                "last-bundle": self.last_bundle,
+                **({"last-error": self.last_error}
+                   if self.last_error else {}),
+            }
+
+
+def _slug(s: str) -> str:
+    return "".join(c if c.isalnum() or c == "-" else "-"
+                   for c in str(s))[:32] or "incident"

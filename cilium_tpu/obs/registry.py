@@ -399,6 +399,38 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                 "trace spans whose packet died mid-pipeline",
                 tracer_stat("dropped"))
 
+    # -- the flow analytics plane + incident flight recorder.  These
+    # counters live for the daemon's lifetime (not session-scoped
+    # like the serving block): aggregation also runs on the offline
+    # process_batch path, and incidents outlive the session that
+    # fired them ------------------------------------------------------
+    reg.counter("cilium_flow_agg_windows_total",
+                "aggregation windows closed by the flow analytics "
+                "plane (ring-of-windows roll-overs)",
+                lambda: daemon.analytics.windows.windows_closed)
+    reg.counter("cilium_top_talkers_evictions_total",
+                "space-saving sketch evictions across the 4-tuple "
+                "and identity-pair top-K sketches",
+                lambda: (daemon.analytics.talkers.evictions
+                         + daemon.analytics.pairs.evictions))
+    reg.counter("cilium_flow_agg_batches_dropped_total",
+                "decoded batches the analytics plane lost (pending-"
+                "queue overflow or poisoned ingest)",
+                lambda: daemon.analytics.batches_dropped)
+    reg.counter("cilium_incidents_total",
+                "named incidents recorded by the flight recorder",
+                # via stats(): a locked copy — unlocked iteration
+                # races first-of-a-kind inserts on worker/watchdog
+                # threads and would silently drop the series from
+                # the scrape
+                lambda: ([({"kind": k}, n) for k, n in sorted(
+                    daemon.flightrec.stats()[
+                        "incidents-by-kind"].items())]
+                    or None))
+    reg.counter("cilium_sysdump_writes_total",
+                "sysdump bundles written by the flight recorder",
+                lambda: daemon.flightrec.writes_total)
+
     # -- CT snapshots (age/entries ride recovery decisions) -----------
     def ct_snap(key):
         def collect():

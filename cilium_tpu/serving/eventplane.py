@@ -108,9 +108,18 @@ class EventJoinWorker:
 
     def __init__(self, join_fn: Callable, drop_fn: Optional[Callable]
                  = None, queue_depth: int = DEFAULT_WINDOW_QUEUE,
-                 restart_budget: int = 3):
+                 restart_budget: int = 3,
+                 on_terminal: Optional[Callable[[str], None]] = None):
         self._join_fn = join_fn
         self._drop_fn = drop_fn
+        # INCIDENT HOOK POINT (obs/flightrec.py): on_terminal(error)
+        # fires once, from the dying worker thread, when the restart
+        # budget exhausts — the daemon wires it to the flight
+        # recorder (a terminal event worker means the monitor plane
+        # went dark, which is exactly when an operator wants a
+        # state bundle).  Contained: a failing hook must not mask
+        # the terminal error it reports
+        self._on_terminal = on_terminal
         self.queue_depth = max(1, int(queue_depth))
         self._budget = max(0, int(restart_budget))
         self._cv = threading.Condition()
@@ -226,16 +235,28 @@ class EventJoinWorker:
                 cur, self._current = self._current, None
             if cur is not None:
                 self._drop(cur, f"worker died: {e}")
+            went_terminal = fire = False
             with self._cv:
                 if self._stop or self.restarts >= self._budget:
+                    went_terminal = True
+                    # a worker dying DURING stop() is the sweep's
+                    # business, not an incident
+                    fire = not self._stop
                     self.error = (
                         f"event-join worker died ({type(e).__name__}: "
                         f"{e}); restart budget "
                         f"{self.restarts}/{self._budget} exhausted")
                     self._cv.notify_all()
-                    return
-                self.restarts += 1
-                n = self.restarts
+                else:
+                    self.restarts += 1
+                    n = self.restarts
+            if went_terminal:
+                if fire and self._on_terminal is not None:
+                    try:  # outside the lock: the hook may read stats()
+                        self._on_terminal(self.error)
+                    except Exception:  # noqa: BLE001
+                        pass
+                return
             t = threading.Thread(target=self._run, daemon=True,
                                  name=f"serving-eventjoin-r{n}")
             self._thread = t

@@ -14,7 +14,19 @@ dispatch modes ordered by capability:
 
 This module is the pure STATE MACHINE (hysteresis + bookkeeping);
 ``Daemon`` owns the transition mechanics (ring swap, CT snapshot +
-restore, loader re-placement).  Rules:
+restore, loader re-placement).
+
+INCIDENT HOOK POINT (obs/flightrec.py): every demotion is a named
+``ladder-demotion`` incident — ``Daemon._serving_demote`` calls
+``record_incident`` right after :meth:`FallbackLadder.demote`, so a
+rung drop leaves a sysdump bundle (ladder state, recent flows, live
+aggregation windows) behind.  The capture runs on a dedicated
+capture thread, never the drain thread driving this state machine;
+promotions are routine recovery and deliberately NOT incidents.
+The other serving-plane hooks live in runtime.py (``on_restart``,
+the watchdog) and eventplane.py (``on_terminal``, the join worker).
+
+Rules:
 
 - DEMOTE after ``demote_threshold`` CONSECUTIVE dispatch failures on
   the current rung (one success resets the streak — flapping shards

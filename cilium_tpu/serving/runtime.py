@@ -115,6 +115,8 @@ class ServingRuntime:
                  = None,
                  gauge_fn: Optional[Callable[[], dict]] = None,
                  idle_fn: Optional[Callable[[], None]] = None,
+                 on_restart: Optional[Callable[[str, bool], None]]
+                 = None,
                  profile_dir: Optional[str] = None,
                  profile_batches: int = 0):
         from .batcher import DEFAULT_ARENA_DEPTH
@@ -206,6 +208,14 @@ class ServingRuntime:
         # waiting for the next drain_every-th batch that may never
         # come
         self._idle_fn = idle_fn
+        # INCIDENT HOOK POINT (obs/flightrec.py): on_restart(cause,
+        # terminal) fires from the WATCHDOG thread on every
+        # drain-loop restart (terminal=False) and once more when the
+        # restart budget exhausts (terminal=True) — the daemon wires
+        # it to the flight recorder so each recovery event leaves a
+        # sysdump bundle behind.  Contained: a failing hook must not
+        # cost the restart it describes
+        self._on_restart = on_restart
         # optional jax.profiler capture window: trace the first
         # profile_batches dispatches into profile_dir, then stop —
         # the batch-scoped sibling of GET /debug/profile's
@@ -674,6 +684,7 @@ class ServingRuntime:
                 # budget exhausted: go terminal with a visible corpse
                 self._error = (f"restart budget ({self._budget}) "
                                f"exhausted; last fault: {cause}")
+                self._notify_restart(self._error, terminal=True)
                 return
             # abandon the current generation (a wedged thread that
             # ever wakes will exit without dispatching or recording)
@@ -689,6 +700,7 @@ class ServingRuntime:
             self._error = None
             self.stats.record_restart(cause, timeout=hung)
             self.restarts += 1
+            self._notify_restart(cause, terminal=False)
             if inflight is not None:
                 self._account_lost(inflight[2], timeout_flavor=hung)
             if self._stop.wait(backoff):  # exponential, stop-aware
@@ -700,6 +712,15 @@ class ServingRuntime:
                                  name=f"serving-drain-r{self.restarts}")
             self._thread = t
             t.start()
+
+    def _notify_restart(self, cause: str, terminal: bool) -> None:
+        """Fire the incident hook (watchdog thread); contained."""
+        if self._on_restart is None:
+            return
+        try:
+            self._on_restart(cause, terminal)
+        except Exception:  # noqa: BLE001 — an incident hook must
+            pass  # never cost the recovery it describes
 
     def _account_lost(self, batch: AssembledBatch,
                       timeout_flavor: bool) -> None:

@@ -16,6 +16,14 @@ Registering a metric NAME with the registry (a plain string passed
 to ``registry.counter(...)``) is fine — names must live at their
 declaration sites; only the exposition *rendering* is centralized.
 
+Additionally, REQUIRED_SERIES lists names that MUST be registered in
+the registry module: the flow-analytics / flight-recorder series
+(and a couple of long-standing anchors) are part of the operator
+contract, and a refactor that silently drops their registration
+would pass the scatter lint while still breaking every dashboard.
+The check is textual on purpose — the declaration site is the
+registry module, so the name literal must appear there.
+
 Exit status 0 = clean; 1 = violations (printed one per line).
 Run it standalone, or via tests/test_obs_registry.py (tier-1).
 """
@@ -31,7 +39,24 @@ import tokenize
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "cilium_tpu")
 # the one module allowed to build exposition text
-ALLOWED = {os.path.join("cilium_tpu", "obs", "registry.py")}
+REGISTRY_MODULE = os.path.join("cilium_tpu", "obs", "registry.py")
+ALLOWED = {REGISTRY_MODULE}
+
+# series that must be REGISTERED (their name literal present in the
+# registry module) — the operator-contract floor
+REQUIRED_SERIES = (
+    # flow analytics plane + incident flight recorder
+    "cilium_flow_agg_windows_total",
+    "cilium_flow_agg_batches_dropped_total",
+    "cilium_top_talkers_evictions_total",
+    "cilium_incidents_total",
+    "cilium_sysdump_writes_total",
+    # long-standing anchors (a registry rewrite that loses these
+    # fails here, not on a dashboard)
+    "cilium_datapath_packets_total",
+    "cilium_serving_verdicts_total",
+    "cilium_ring_lost_total",
+)
 
 # exposition-text signatures inside a string literal
 _TYPE_LINE = re.compile(r"#\s*TYPE\s+\w+\s+(counter|gauge|histogram)")
@@ -66,8 +91,22 @@ def scan_file(path: str) -> list:
     return out
 
 
+def check_required() -> list:
+    """Every REQUIRED_SERIES name must appear in the registry
+    module (i.e. still be registered)."""
+    path = os.path.join(REPO, REGISTRY_MODULE)
+    try:
+        with open(path) as f:
+            src = f.read()
+    except OSError as e:
+        return [f"{REGISTRY_MODULE}: unreadable ({e})"]
+    return [f"{REGISTRY_MODULE}: required series {name!r} is not "
+            f"registered"
+            for name in REQUIRED_SERIES if f'"{name}"' not in src]
+
+
 def main() -> int:
-    bad = []
+    bad = list(check_required())
     for dirpath, dirnames, filenames in os.walk(PKG):
         dirnames[:] = [d for d in dirnames if d != "__pycache__"]
         for name in filenames:
@@ -82,8 +121,9 @@ def main() -> int:
                            f"metrics registry: {snippet!r}")
     if bad:
         print("metrics-registry lint FAILED — exposition text must "
-              "only be built in cilium_tpu/obs/registry.py "
-              "(register a collector instead):", file=sys.stderr)
+              "only be built in cilium_tpu/obs/registry.py (register "
+              "a collector instead), and every REQUIRED_SERIES must "
+              "stay registered:", file=sys.stderr)
         for b in bad:
             print("  " + b, file=sys.stderr)
         return 1

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Sysdump bundle schema check: a flight-recorder artifact must be
+USABLE at 3am, which means three machine-checkable properties —
+
+1. the bundle LOADS (valid JSON; a hard-truncated body fails here,
+   which is the honest answer for a bundle the size bound had to
+   amputate);
+2. every REQUIRED top-level key is present (the key list is imported
+   from ``cilium_tpu.obs.flightrec`` so this check and the writer
+   cannot drift apart), and the schema version is one we know;
+3. the file fits the size cap the bundle itself declares
+   (``max-bytes``) — the flight recorder's own bound, re-verified
+   from the outside.
+
+Usage::
+
+    python scripts/check_sysdump_schema.py BUNDLE.json [...]
+    python scripts/check_sysdump_schema.py SYSDUMP_DIR
+
+Exit status 0 = every bundle clean; 1 = violations (one per line).
+Run standalone, or from the test suite (tests/test_flightrec.py
+round-trips every bundle the incident e2e produces through
+``check_bundle``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cilium_tpu.obs.flightrec import (SYSDUMP_REQUIRED_KEYS,  # noqa: E402
+                                      SYSDUMP_SCHEMA)
+
+
+def check_bundle(path: str) -> list:
+    """-> list of violation strings (empty = clean)."""
+    bad = []
+    try:
+        size = os.path.getsize(path)
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    try:
+        with open(path) as f:
+            bundle = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: does not load as JSON ({e})"]
+    if not isinstance(bundle, dict):
+        return [f"{path}: top level is {type(bundle).__name__}, "
+                f"not an object"]
+    if bundle.get("schema") != SYSDUMP_SCHEMA:
+        bad.append(f"{path}: schema {bundle.get('schema')!r} != "
+                   f"{SYSDUMP_SCHEMA}")
+    for key in SYSDUMP_REQUIRED_KEYS:
+        if key not in bundle:
+            bad.append(f"{path}: missing required key {key!r}")
+    cap = bundle.get("max-bytes")
+    if isinstance(cap, int) and size > cap:
+        bad.append(f"{path}: {size} bytes exceeds its declared "
+                   f"cap {cap}")
+    return bad
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:])
+    if not args:
+        print(__doc__, file=sys.stderr)
+        return 2
+    paths = []
+    for a in args:
+        if os.path.isdir(a):
+            paths.extend(
+                os.path.join(a, n) for n in sorted(os.listdir(a))
+                if n.startswith("sysdump-") and n.endswith(".json"))
+        else:
+            paths.append(a)
+    if not paths:
+        print("no sysdump bundles found", file=sys.stderr)
+        return 1
+    bad = []
+    for p in paths:
+        bad.extend(check_bundle(p))
+    if bad:
+        print("sysdump schema check FAILED:", file=sys.stderr)
+        for b in bad:
+            print("  " + b, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

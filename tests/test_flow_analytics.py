@@ -1,0 +1,494 @@
+"""The flow analytics plane (ISSUE 6): windowed per-identity
+aggregation, space-saving top-K, drop-spike detection.
+
+Acceptance properties covered here:
+
+- TOP-K CORRECTNESS on Zipf traffic: every elephant (true count >
+  N/k) is retained by the space-saving sketch, and every estimate
+  overshoots its true count by at most N/k (the documented bound,
+  asserted per key via the sketch's own error field);
+- SPIKE DETERMINISM: a seeded burst schedule raises EXACTLY ONE
+  incident (no flapping across window boundaries — hysteresis +
+  spike windows excluded from the baseline), and the same seed
+  replays the identical detection;
+- NO AGGREGATION ON THE DRAIN THREAD: under a serving load with
+  per-packet events, every ``FlowAnalytics._ingest`` call happens on
+  the event-join worker or a query thread — never the serving drain
+  thread (the monkeypatch-records-thread-identity idiom of the PR 5
+  decode test);
+- WINDOWED AGGREGATION correctness + ring retention + the
+  bounded-pending-queue ledger;
+- OBSERVER THREAD SAFETY: concurrent ``get_flows`` during live
+  ``consume`` observes no torn rows and a monotonic seq (the
+  satellite audit's regression);
+- the ``/flows`` filter vocabulary (identity / since) the new CLI
+  flags map onto.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.core.packets import (COL_DIR, COL_DPORT, COL_DST_IP3,
+                                     COL_EP, COL_FAMILY, COL_LEN,
+                                     COL_PROTO, COL_SPORT,
+                                     COL_SRC_IP3, N_COLS)
+from cilium_tpu.monitor.api import MSG_DROP, MSG_TRACE, EventBatch
+from cilium_tpu.obs import analytics as amod
+from cilium_tpu.obs.analytics import (FlowAnalytics,
+                                      SpaceSavingSketch,
+                                      SpikeDetector,
+                                      validate_analytics_config)
+
+pytestmark = pytest.mark.obs
+
+
+def _batch(n=32, ts=100.0, verdict=1, reason=0, drop=False, ep=7,
+           direction=0, identity=99, sport0=1000, length=100):
+    hdr = np.zeros((n, N_COLS), dtype=np.uint32)
+    hdr[:, COL_SRC_IP3] = 0x0A000101
+    hdr[:, COL_DST_IP3] = 0x0A000201
+    hdr[:, COL_SPORT] = sport0 + np.arange(n)
+    hdr[:, COL_DPORT] = 443
+    hdr[:, COL_PROTO] = 6
+    hdr[:, COL_LEN] = length
+    hdr[:, COL_FAMILY] = 4
+    hdr[:, COL_EP] = ep
+    hdr[:, COL_DIR] = direction
+    return EventBatch(
+        msg_type=np.full(n, MSG_DROP if drop else MSG_TRACE,
+                         dtype=np.uint8),
+        verdict=np.full(n, verdict, dtype=np.uint8),
+        reason=np.full(n, reason, dtype=np.uint8),
+        ct_state=np.zeros(n, dtype=np.uint8),
+        identity=np.full(n, identity, dtype=np.uint32),
+        proxy_port=np.zeros(n, dtype=np.uint16),
+        hdr=hdr, timestamp=ts)
+
+
+# ---------------------------------------------------------------------
+# space-saving sketch: the documented guarantees, on Zipf traffic
+# ---------------------------------------------------------------------
+class TestSpaceSavingSketch:
+    def test_zipf_elephants_retained_and_error_bounded(self):
+        """Sketch vs exact counts on a Zipf stream: elephants always
+        retained, per-key overestimate <= N/k."""
+        rng = np.random.default_rng(42)
+        k = 64
+        draws = rng.zipf(1.5, size=50_000)
+        draws = draws[draws < 100_000]  # clip the unbounded tail
+        n = len(draws)
+        keys, exact = np.unique(draws, return_counts=True)
+        sk = SpaceSavingSketch(k)
+        # feed in batches pre-aggregated per key — the production
+        # shape (vectorized unique per batch, one merge per batch)
+        for lo in range(0, n, 1000):
+            bk, bc = np.unique(draws[lo:lo + 1000],
+                               return_counts=True)
+            counts = bc.tolist()
+            sk.update_many([(kk,) for kk in bk.tolist()], counts,
+                           [c * 100 for c in counts])
+        bound = n // k
+        assert sk.total == n
+        assert sk.error_bound() == bound
+        assert sk.evictions > 0  # the stream has > k distinct keys
+        exact_of = {(int(kk),): int(c) for kk, c in zip(keys, exact)}
+        monitored = {r["key"]: r for r in sk.top()}
+        assert len(monitored) == k
+        # (1) every elephant is retained
+        elephants = [kk for kk, c in exact_of.items() if c > bound]
+        assert elephants, "test traffic must contain elephants"
+        for kk in elephants:
+            assert kk in monitored, f"elephant {kk} evicted"
+        # (2) estimate bounds: exact <= estimate <= exact + N/k, and
+        # the per-key error field is itself a valid bound
+        for kk, row in monitored.items():
+            true = exact_of[kk]
+            assert true <= row["packets"] <= true + bound
+            assert row["packets"] - row["error"] <= true
+            assert row["error"] <= bound
+
+    def test_small_stream_is_exact(self):
+        sk = SpaceSavingSketch(8)
+        for i in range(5):
+            sk.update((i,), i + 1, 10 * (i + 1))
+        assert sk.evictions == 0
+        top = sk.top(2)
+        assert top[0] == {"key": (4,), "packets": 5, "bytes": 50,
+                          "error": 0}
+        assert sk.error_bound() == (1 + 2 + 3 + 4 + 5) // 8
+
+
+# ---------------------------------------------------------------------
+# spike detector: seeded determinism, exactly-one incident
+# ---------------------------------------------------------------------
+def _run_schedule(seed=7, factor=4.0, min_drops=64, baseline=4):
+    """One seeded traffic schedule through a fresh detector (the
+    infra/faults.py seeding idiom: same seed => same schedule =>
+    same detections)."""
+    rng = np.random.default_rng(seed)
+    quiet = rng.poisson(5.0, size=12)
+    burst = rng.integers(400, 600, size=3)  # 3 consecutive windows
+    tail = rng.poisson(5.0, size=8)
+    det = SpikeDetector(factor, min_drops, baseline)
+    fired = []
+    for i, drops in enumerate(list(quiet) + list(burst) + list(tail)):
+        w = amod._Window(i, 1.0)
+        w.drops = int(drops)
+        w.packets = int(drops) + 1000
+        got = det.observe(w)
+        if got is not None:
+            fired.append(got)
+    return det, fired
+
+
+class TestSpikeDetector:
+    def test_seeded_burst_raises_exactly_one_incident(self):
+        det, fired = _run_schedule()
+        assert det.spikes == 1
+        assert len(fired) == 1
+        assert fired[0]["window"] == 12  # first burst window
+        assert fired[0]["drops"] >= 400
+        # the burst ended: state released, ready for the next one
+        assert not det.in_spike
+
+    def test_no_flapping_across_window_boundaries(self):
+        """Three consecutive over-threshold windows are ONE spike:
+        hysteresis holds the state and burst windows never enter the
+        baseline (which would re-arm mid-burst)."""
+        det, fired = _run_schedule()
+        assert det.spikes == 1  # not 3
+        # baseline never learned the burst
+        assert det.baseline < 64
+
+    def test_same_seed_replays_identically(self):
+        def strip(fired):  # detected-at is a wall-clock stamp
+            return [{k: v for k, v in f.items() if k != "detected-at"}
+                    for f in fired]
+
+        d1, f1 = _run_schedule(seed=11)
+        d2, f2 = _run_schedule(seed=11)
+        assert (d1.spikes, strip(f1)) == (d2.spikes, strip(f2))
+
+    def test_second_burst_after_release_fires_again(self):
+        det = SpikeDetector(4.0, 64, 4)
+        seq = [5, 5, 5, 5, 500, 5, 5, 600, 4]
+        for i, drops in enumerate(seq):
+            w = amod._Window(i, 1.0)
+            w.drops = drops
+            w.packets = drops + 100
+            det.observe(w)
+        assert det.spikes == 2
+
+
+# ---------------------------------------------------------------------
+# the engine: windows, ledger, rendering
+# ---------------------------------------------------------------------
+class TestFlowAnalyticsEngine:
+    def _engine(self, **over):
+        kw = dict(window_s=1.0, retention=4, topk=16, queue_depth=8,
+                  spike_factor=4.0, spike_min_drops=50,
+                  spike_baseline_windows=3,
+                  ep_identity=lambda e: 1000 + e)
+        kw.update(over)
+        return FlowAnalytics(**kw)
+
+    def test_identity_pair_attribution_and_windows(self):
+        a = self._engine()
+        # ingress non-reply: remote identity is the SOURCE
+        a.submit(_batch(n=32, ts=10.2, identity=99, ep=7))
+        a.submit(_batch(n=16, ts=10.7, identity=99, ep=7, verdict=0,
+                        reason=1, drop=True))
+        assert a.drain() == 2
+        cur = a.windows.current
+        assert cur.packets == 48
+        assert cur.drops == 16
+        assert cur.bytes == 48 * 100
+        assert cur.counters[(99, 1007, 1, 0)] == [32, 3200]
+        assert cur.counters[(99, 1007, 0, 1)] == [16, 1600]
+        # crossing the window boundary closes the first window
+        a.submit(_batch(n=8, ts=11.4))
+        a.drain()
+        assert a.windows.windows_closed == 1
+        assert len(a.windows.closed) == 1
+        snap = a.snapshot()
+        assert snap["windows-closed"] == 1
+        assert snap["current-window"]["packets"] == 8
+        m = snap["matrix"][0]
+        assert (m["src-identity"], m["dst-identity"]) == (99, 1007)
+        t = snap["top-talkers"][0]
+        assert t["src"] == "10.0.1.1" and t["dst"] == "10.0.2.1"
+        assert t["dport"] == 443
+        p = snap["top-identity-pairs"][0]
+        assert (p["src-identity"], p["dst-identity"]) == (99, 1007)
+        assert p["packets"] == 56
+
+    def test_retention_ring_caps_closed_windows(self):
+        a = self._engine(retention=3)
+        for i in range(8):
+            a.submit(_batch(n=4, ts=100.0 + i))
+        a.drain()
+        assert a.windows.windows_closed == 7
+        assert len(a.windows.closed) == 3  # ring retention
+        assert [w.wid for w in a.windows.closed] == [104, 105, 106]
+
+    def test_pending_queue_overflow_drops_oldest_counted(self):
+        a = self._engine(queue_depth=4)
+        for i in range(7):
+            a.submit(_batch(n=4, ts=50.0, sport0=100 * i))
+        assert a.pending == 4
+        assert a.batches_submitted == 7
+        assert a.batches_dropped == 3
+        a.drain()
+        assert a.batches_ingested == 4
+        # ledger: submitted == ingested + dropped once drained
+        assert a.batches_submitted == (a.batches_ingested
+                                       + a.batches_dropped)
+
+    def test_disabled_engine_parks_nothing(self):
+        a = self._engine(enabled=False)
+        a.submit(_batch())
+        assert a.pending == 0 and a.batches_submitted == 0
+        assert a.snapshot()["enabled"] is False
+
+    def test_spike_incident_fires_via_drain_outside_lock(self):
+        fired = []
+        a = self._engine(
+            on_incident=lambda kind, det: fired.append((kind, det)))
+        # 4 quiet windows build the baseline, then a burst window
+        for i in range(4):
+            a.submit(_batch(n=4, ts=200.0 + i))
+        a.submit(_batch(n=200, ts=204.0, drop=True, verdict=0,
+                        reason=1))
+        a.submit(_batch(n=4, ts=205.0))  # closes the burst window
+        a.drain()
+        assert [k for k, _ in fired] == ["drop-spike"]
+        assert fired[0][1]["drops"] == 200
+        # the incident callback may snapshot the engine (the flight
+        # recorder does): must not deadlock
+        snap = a.snapshot()
+        assert snap["spike"]["spikes"] == 1
+
+    def test_spike_detected_after_burst_then_silence(self):
+        """A drop burst followed by total SILENCE still raises the
+        incident: the age-based roll in drain() closes the burst
+        window without needing a successor batch (the daemon's
+        flow-agg-roll controller ticks drain on the window cadence),
+        because 'the datapath went dark' is exactly the moment the
+        flight recorder must not sleep through."""
+        fired = []
+        a = self._engine(
+            window_s=0.05, spike_min_drops=50,
+            on_incident=lambda kind, det: fired.append(kind))
+        a.submit(_batch(n=200, ts=time.time(), drop=True, verdict=0,
+                        reason=1))
+        a.drain()
+        assert not fired  # window still open, nothing rolled yet
+        time.sleep(0.08)  # silence past the window width
+        a.drain()  # the roll-controller tick
+        assert fired == ["drop-spike"]
+        assert a.windows.windows_closed == 1
+        # pure silence afterwards does not churn empty windows
+        time.sleep(0.08)
+        a.drain()  # releases the spike state (empty window observed)
+        closed_after_release = a.windows.windows_closed
+        time.sleep(0.08)
+        a.drain()
+        assert a.windows.windows_closed == closed_after_release
+
+    def test_reply_direction_flips_attribution(self):
+        a = self._engine()
+        from cilium_tpu.datapath.conntrack import CT_REPLY
+
+        b = _batch(n=8, ts=30.0, identity=99, ep=7, direction=0)
+        b.ct_state = np.full(8, CT_REPLY, dtype=np.uint8)
+        a.submit(b)
+        a.drain()
+        # ingress REPLY: the local endpoint is the source now
+        assert (1007, 99, 1, 0) in a.windows.current.counters
+
+    def test_validate_rejects_bad_knobs(self):
+        with pytest.raises(ValueError):
+            validate_analytics_config(0, 8, 32, 64, 4.0, 64, 4)
+        with pytest.raises(ValueError):
+            validate_analytics_config(1.0, 0, 32, 64, 4.0, 64, 4)
+        with pytest.raises(ValueError):
+            validate_analytics_config(1.0, 8, 32, 64, 0.5, 64, 4)
+
+
+# ---------------------------------------------------------------------
+# observer thread-safety (satellite): query during live consume
+# ---------------------------------------------------------------------
+class TestObserverConcurrency:
+    def test_no_torn_rows_and_monotonic_seq(self):
+        """``consume`` hammers the ring from a writer thread (the
+        event-join worker's role) while ``get_flows`` queries from
+        this thread: every materialized flow must be INTERNALLY
+        consistent (verdict/sport/identity all from the same source
+        batch — a torn row would mix them) and seq only grows."""
+        from cilium_tpu.flow.observer import Observer
+
+        obs = Observer(capacity=256)
+        stop = threading.Event()
+        wrote = {"batches": 0}
+
+        def writer():
+            k = 0
+            while not stop.is_set():
+                # batch k: verdict k%3, sport 5000+k%3, identity
+                # 70000+k%3 — all three derive from the same value,
+                # so a torn row is detectable
+                tag = k % 3
+                b = _batch(n=32, ts=float(k), verdict=tag,
+                           identity=70000 + tag, sport0=5000 + tag,
+                           length=0)
+                b.hdr[:, COL_SPORT] = 5000 + tag  # constant column
+                obs.consume(b)
+                wrote["batches"] += 1
+                k += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        last_seq = 0
+        deadline = time.monotonic() + 1.0
+        checked = 0
+        while time.monotonic() < deadline:
+            assert obs.seq >= last_seq
+            last_seq = obs.seq
+            for f in obs.get_flows(number=64):
+                tag = f.verdict
+                assert f.source.port == 5000 + tag
+                assert 70000 + tag in (f.source.identity,
+                                       f.destination.identity)
+                checked += 1
+        stop.set()
+        t.join(5)
+        assert wrote["batches"] > 3 and checked > 100
+
+
+# ---------------------------------------------------------------------
+# end-to-end on the serving daemon (tpu backend)
+# ---------------------------------------------------------------------
+from cilium_tpu.agent import Daemon, DaemonConfig  # noqa: E402
+from cilium_tpu.core import TCP_SYN, make_batch  # noqa: E402
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }],
+}]
+
+
+def _daemon(**over):
+    # ONE 64-wide ladder rung: shared XLA executables with the chaos
+    # suite (same (64, 16) shapes), so this file adds ~no compile cost
+    cfg = dict(backend="tpu", ct_capacity=1 << 12,
+               flow_ring_capacity=1 << 13,
+               serving_queue_depth=4096,
+               serving_bucket_ladder=(64,),
+               serving_max_wait_us=500.0,
+               serving_dispatch_deadline_ms=500.0,
+               serving_restart_budget=4,
+               flow_agg_window_s=0.2)
+    cfg.update(over)
+    d = Daemon(DaemonConfig(**cfg))
+    d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    d.policy_import(RULES)
+    return d, db
+
+
+def _fwd(db_id, n=64, base=20000):
+    return make_batch([
+        dict(src="10.0.1.1", dst="10.0.2.1", sport=base + i,
+             dport=5432, proto=6, flags=TCP_SYN, ep=db_id, dir=0)
+        for i in range(n)]).data
+
+
+def _wait(pred, timeout=30.0, tick=0.002):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+class TestNoAggregationOnDrainThread:
+    def test_ingest_runs_only_off_the_dispatch_path(self, monkeypatch):
+        """THE tier-1 regression for the tentpole's hot-path claim:
+        under a serving load with per-packet events, every
+        ``FlowAnalytics._ingest`` call happens on the event-join
+        worker (or a stop/query thread) — the serving drain thread
+        only ever pays the O(1) reference park in ``submit``."""
+        seen = []
+        real = FlowAnalytics._ingest
+
+        def spy(self, batch):
+            seen.append(threading.current_thread().name)
+            return real(self, batch)
+
+        monkeypatch.setattr(FlowAnalytics, "_ingest", spy)
+        d, db = _daemon()
+        d.start_serving(trace_sample=1, ingress=True, drain_every=2)
+        rt = d._serving["runtime"]
+        for i in range(4):
+            d.submit(_fwd(db.id, base=20000 + 100 * i))
+        assert _wait(lambda: rt.stats.verdicts >= 256)
+        assert _wait(lambda: d.analytics.packets_seen >= 256)
+        out = d.stop_serving()
+        fe = out["front-end"]
+        assert fe["submitted"] == (
+            fe["verdicts"] + fe["shed"]
+            + fe["fault-tolerance"]["recovery-dropped"])
+        assert seen, "aggregation never ran — the spy never fired"
+        drain_threads = [n for n in seen
+                         if n.startswith("serving-drain")]
+        assert not drain_threads, (
+            f"aggregation ran on the drain thread: "
+            f"{sorted(set(drain_threads))}")
+        # and it genuinely ran on the event plane's worker
+        assert any(n.startswith("serving-eventjoin") for n in seen)
+        # the analytics ledger drained exact
+        a = d.analytics
+        assert a.batches_submitted == (a.batches_ingested
+                                       + a.batches_dropped)
+        assert a.pending == 0
+        d.shutdown()
+
+
+class TestServingSurfaces:
+    def test_aggregate_api_and_serving_stats_block(self, tmp_path):
+        d, db = _daemon()
+        d.start_serving(trace_sample=1, ingress=True, drain_every=2)
+        for i in range(4):
+            d.submit(_fwd(db.id, base=24000 + 100 * i))
+        assert _wait(lambda: d.analytics.packets_seen >= 256)
+        st = d.serving_stats()
+        assert st["analytics"]["enabled"]
+        assert st["analytics"]["packets-seen"] >= 256
+        agg = d.flows_aggregate(top=4)
+        assert agg["matrix"], "verdict matrix empty under load"
+        assert agg["top-talkers"]
+        d.stop_serving()
+
+        # the /flows filter vocabulary the CLI flags map onto
+        from cilium_tpu.api.server import _flows
+
+        ident = agg["matrix"][0]["src-identity"]
+        got = _flows(d, {"identity": [str(ident)], "number": ["10"]})
+        assert got and all(
+            ident in (f["source"]["identity"],
+                      f["destination"]["identity"]) for f in got)
+        # a non-existent identity matches NOTHING (regression: the
+        # old source-OR-destination filter pair wildcarded each
+        # other's rows and matched every flow)
+        assert _flows(d, {"identity": ["987654"]}) == []
+        cutoff = time.time() + 3600  # future => nothing matches
+        assert _flows(d, {"since": [str(cutoff)]}) == []
+        assert _flows(d, {"since": ["1.0"]})  # epoch 1.0: everything
+        d.shutdown()
