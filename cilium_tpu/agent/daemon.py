@@ -594,6 +594,7 @@ class Daemon:
         return 0
 
     # -- incidents + flight recorder -----------------------------------
+    # thread-affinity: any
     def record_incident(self, kind: str, detail=None,
                         capture: bool = True):
         """The one incident entry every hook funnels through: spike
@@ -607,6 +608,9 @@ class Daemon:
             return self.flightrec.record_incident(kind, detail,
                                                   capture=capture)
         except Exception:  # noqa: BLE001
+            # hot-path-ok: the incident-recorder-itself-broke path —
+            # by definition not steady state, and swallowing it
+            # silently would hide a dead flight recorder
             logging.getLogger(__name__).warning(
                 "incident recording failed (kind=%s)", kind,
                 exc_info=True)
@@ -731,7 +735,17 @@ class Daemon:
             # and a full invalidate() would regen once per replayed
             # identity at startup.
             self.repo.invalidate_cache()
-            return
+            # ...EXCEPT a CIDR/fqdn identity minted into a LIVE
+            # pre-start world (the DNS proxy observes answers before
+            # start()): its ipcache upsert must reach the datapath
+            # NOW — no later regeneration is coming, so the cache-only
+            # shortcut left toFQDNs traffic default-denying until an
+            # unrelated revision bump.  Startup replay keeps the
+            # cache-only path: identities restore before any endpoint
+            # registers, so the gate below stays closed there.
+            if not (kind == "add" and cidr_labels
+                    and self.endpoints.list()):
+                return
         # Incremental fast path (SURVEY.md §7 hard part #3): patch the
         # identity's verdict row + LPM slots in place — no re-resolve,
         # no compile_policy, no re-attach.  Falls back to a full
@@ -976,6 +990,7 @@ class Daemon:
     # -- the serve loop ----------------------------------------------
     def process_batch(self, hdr: np.ndarray,
                       now: Optional[int] = None) -> EventBatch:
+        # thread-affinity: offline, api, cli
         """One packet tensor through LB -> datapath -> monitor."""
         if now is None:
             now = self._now()
@@ -1053,6 +1068,7 @@ class Daemon:
 
     def _finish_batch(self, out, hdr: np.ndarray, row_map,
                       now: int) -> EventBatch:
+        # thread-affinity: offline, api, cli
         """The shared process_batch tail: decode -> auth observe ->
         monitor publish (ONE definition; a per-batch hook added here
         reaches both the routed and the plain path)."""
@@ -1477,6 +1493,8 @@ class Daemon:
 
     def _serving_dispatch(self, hdr: np.ndarray, valid: np.ndarray,
                           n_valid: int, packed_meta=None):
+        # thread-affinity: drain, api -- the ServingRuntime dispatch
+        # callback; stop()'s final drain also lands here
         """The runtime's device leg: one padded bucket through
         serve_batch (padding masked out of CT/metrics/events).
         ``hdr`` arrives as a batcher arena slot whose recycling
@@ -1539,12 +1557,14 @@ class Daemon:
         return info
 
     def _serving_device_leg(self, hdr, valid, packed_meta):
+        # thread-affinity: drain, api
         if packed_meta is None:
             return self.serve_batch(hdr, valid=valid)
         return self.serve_batch(hdr, valid=valid,
                                 packed_meta=packed_meta)
 
     def _serving_demote(self, cause: str) -> None:
+        # thread-affinity: drain, api
         """One rung down (drain-thread context).  sharded -> single:
         drain the per-chip rings, SNAPSHOT the (sharded) CT, rebuild
         the single-device placement, and ct_restore the snapshot so
@@ -1557,6 +1577,9 @@ class Daemon:
         s = self._serving
         old = s["ladder"].rung
         new = s["ladder"].demote()
+        # hot-path-ok: a LADDER DEMOTION is a rare contained-failure
+        # event (>= demote_threshold consecutive dispatch failures) —
+        # the warning is part of the incident record, never per-batch
         logging.getLogger(__name__).warning(
             "serving ladder demotes %s -> %s: %s", old, new, cause)
         from ..obs.flightrec import KIND_DEMOTION
@@ -1574,6 +1597,7 @@ class Daemon:
             try:
                 self._serving_drain_tick(s)
             except Exception:  # noqa: BLE001
+                # hot-path-ok: demotion failure path only (see above)
                 logging.getLogger(__name__).warning(
                     "sharded ring drain failed during demotion; "
                     "in-flight window events lost (counted)")
@@ -1588,6 +1612,7 @@ class Daemon:
             except Exception:  # noqa: BLE001
                 if self._ct_snap is not None:
                     ct = self._ct_snap["rows"]
+                    # hot-path-ok: demotion failure path only
                     logging.getLogger(__name__).warning(
                         "live CT unreadable during demotion; "
                         "restoring the %.1fs-old periodic snapshot",
@@ -1620,6 +1645,7 @@ class Daemon:
             runtime.reset_warm_shapes()
 
     def _serving_promote(self) -> None:
+        # thread-affinity: drain, api
         """One rung back up after sustained health + cooldown
         (drain-thread context).  wide -> single re-enables packing;
         single -> sharded re-places the live state on the mesh and
@@ -1633,6 +1659,8 @@ class Daemon:
         s = self._serving
         old = s["ladder"].rung
         new = s["ladder"].promote()
+        # hot-path-ok: promotions happen at most once per cooldown_s
+        # (hysteresis-gated recovery, not steady state)
         logging.getLogger(__name__).info(
             "serving ladder promotes %s -> %s", old, new)
         if new == "sharded":
@@ -1665,6 +1693,7 @@ class Daemon:
 
     def _publish_recovery_drops(self, rows: Optional[np.ndarray],
                                 count: int, reason: int) -> None:
+        # thread-affinity: drain, watchdog, api
         """Recovery-plane drops (dead/hung/failed dispatch, dead-loop
         stop sweep) -> metricsmap + decoded monitor DROP events —
         the same double surfacing REASON_ROUTE_OVERFLOW gets, so the
@@ -1679,6 +1708,7 @@ class Daemon:
 
     def _publish_sheds(self, rows: Optional[np.ndarray],
                        count: int) -> None:
+        # thread-affinity: drain, api
         """Admission sheds -> monitor DROP events.  ``rows`` is the
         bounded retained subset; ``count`` is exact (the counter in
         serving stats carries the difference when retention capped)."""
@@ -1693,6 +1723,7 @@ class Daemon:
 
     def submit(self, rows: np.ndarray,
                t: Optional[float] = None) -> int:
+        # thread-affinity: any
         """Offer a chunk of header rows to the serving front end
         (requires ``start_serving(ingress=True)``); returns how many
         were admitted.  Never blocks — overflow sheds by the
@@ -1832,6 +1863,7 @@ class Daemon:
                     now: Optional[int] = None,
                     valid: Optional[np.ndarray] = None,
                     packed_meta=None) -> Optional[dict]:
+        # thread-affinity: drain, api
         """One serving-path batch: dispatch, retain the host header
         rows for the event join, drain/emit every ``drain_every``
         batches.  ``hdr`` must be HOST memory (the serving path never
@@ -1903,6 +1935,7 @@ class Daemon:
         return info
 
     def _serving_snapshot_numerics(self, s, row_map) -> None:
+        # thread-affinity: drain, api
         # numeric_array() copies the whole row->numeric table; the map
         # only changes on identity churn, so snapshot per
         # (object, version) — the map object is REUSED and mutated
@@ -1918,6 +1951,7 @@ class Daemon:
 
     def _serve_batch_sharded(self, s, hdr: np.ndarray, now: int,
                              bid: int, valid) -> dict:
+        # thread-affinity: drain, api
         """The multi-chip leg: flow-route the bucket into per-shard
         blocks (the RSS analogue), account router overflow as
         REASON_ROUTE_OVERFLOW (metricsmap + synthesized DROP events),
@@ -2002,6 +2036,7 @@ class Daemon:
         return info
 
     def _serving_drain_tick(self, s) -> None:
+        # thread-affinity: drain, api
         """The drain thread's ENTIRE event leg after the async event
         plane (PR 5): block on the 8-byte cursor, start the
         occupancy-bounded async copy (``swap_window``), and push the
@@ -2031,6 +2066,7 @@ class Daemon:
                 del s["window"][b]
 
     def _serving_event_idle_tick(self) -> None:
+        # thread-affinity: drain
         """ServingRuntime's idle hook (drain-thread context, queue
         empty): if any batch dispatched since the last drain tick,
         tick now — a traffic pause must flush the pending window to
@@ -2046,10 +2082,13 @@ class Daemon:
         except Exception:  # noqa: BLE001 — an idle-cadence swap
             # failure must not kill the drain loop; the dispatch-path
             # tick keeps the fault-propagation discipline
+            # hot-path-ok: failure path of the IDLE tick — the queue
+            # is empty by definition when this fires
             logging.getLogger(__name__).warning(
                 "idle event-plane drain tick failed", exc_info=True)
 
     def _serving_span_sink(self, bid: int, spans: tuple) -> bool:
+        # thread-affinity: drain, api
         """The runtime hands a dispatched batch's sampled spans to
         the event plane (drain-thread context).  Returns False — the
         runtime falls back to completion-boundary stamping — when the
@@ -2066,6 +2105,7 @@ class Daemon:
         return True
 
     def _event_join(self, dw) -> None:
+        # thread-affinity: event-worker
         """The worker's join leg (eventplane thread, NEVER the drain
         thread): finish the d2h transfer + decode, join packed rows
         back to wide columns, emit to monitor/hubble consumers, and
@@ -2128,6 +2168,7 @@ class Daemon:
 
     @staticmethod
     def _event_check_horizon(dw, s) -> None:
+        # thread-affinity: event-worker
         """Refuse a window the producer has dispatched past the arena
         recycling horizon (stalled plane): its record references may
         point at RECYCLED slots, so a join would publish corrupted
@@ -2143,6 +2184,7 @@ class Daemon:
                 f"(horizon {s['join_horizon']})")
 
     def _event_drop(self, dw) -> None:
+        # thread-affinity: any
         """A window the event plane LOST (queue overflow, contained
         join failure, worker death, stop sweep): its spans are
         counted tracer drops — never left incomplete."""
@@ -2151,6 +2193,9 @@ class Daemon:
                 dw.tracer.evict(spans)
 
     def stop_serving(self) -> dict:
+        # thread-affinity: api -- `api` covers every control-plane
+        # caller (API handlers, CLI shutdown, tests' main thread);
+        # what matters is that it is never the drain/worker threads
         """Drain everything in flight and emit it; returns serving
         stats (windows/events/lost per the drainer's accounting, plus
         the front-end snapshot when ingress mode was on).  Idempotent:
@@ -2195,6 +2240,7 @@ class Daemon:
     def _emit_ring_rows(self, rows: np.ndarray,
                         shards: Optional[np.ndarray],
                         records: dict, n_shards: int) -> None:
+        # thread-affinity: event-worker
         """Join decoded ring rows back to their retained batch
         records and publish (event-join WORKER context: ``records``
         is the window's swap-time snapshot, so this never touches

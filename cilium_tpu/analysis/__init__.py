@@ -1,0 +1,140 @@
+"""Concurrency & invariant static analysis (the lockdebug-tag + CI
+lint layer, made static).
+
+Reference: upstream cilium ships its concurrency discipline as
+TOOLING — CI builds with the ``lockdebug`` tag (go-deadlock wrapping
+every mutex, ``infra/lockdebug.py`` is this repo's runtime mirror)
+and a large golangci-lint/staticcheck pass gates every PR.  This
+package is the static half: a pure-stdlib ``ast`` analyzer that
+checks, at every call site on every tier-1 run, the invariants the
+serving plane's five threads (drain, event-join worker, watchdog,
+capture, API) depend on — invariants previously proven only by
+runtime monkeypatch tests and hand audits.
+
+Run it::
+
+    python -m cilium_tpu.analysis          # human output, exit != 0 on findings
+    python -m cilium_tpu.analysis --json   # machine output
+    python scripts/lint.py                 # the CI entry point (same thing)
+
+Checkers (stable codes)
+-----------------------
+
+========  ===========================================================
+CTA000    analyzer configuration errors: malformed suppression or
+          annotation, unknown checker code, unknown affinity token,
+          unknown lock name in a ``guarded-by``/``holds`` reference
+CTA001    guarded-by lock discipline: an attribute declared
+          ``guarded-by: <lock>`` is touched outside ``with
+          self.<lock>:`` (``__init__`` exempt; ``# holds:`` methods
+          exempt for that lock)
+CTA002    thread-affinity: code annotated (or reachable from code
+          annotated) with affinity A calls a function whose declared
+          affinity set excludes A — e.g. the drain thread reaching
+          ``decode_ring_rows`` or ``FlowAnalytics._ingest``
+CTA003    hot-path purity: code reachable from the serving drain
+          loop (any function whose affinity includes ``drain``)
+          calls ``time.sleep``, logs at INFO or above, does file
+          I/O (``open``), ``json.dumps``, or
+          ``.block_until_ready()`` without a ``hot-path-ok`` waiver
+CTA004    sharding-spec spelling: a trailing-``None``
+          ``P(axis, None)`` outside a ``shard_map``
+          ``in_specs``/``out_specs`` context — the spelling places
+          identically to ``P(axis)`` but keys the compile cache
+          differently, so fresh ``device_put`` arrays spelled with
+          the trailing ``None`` recompile the serve step every
+          window swap (the PR 2 retrace trap)
+CTA005    reason-code budget: ``REASON_*`` constants must be unique,
+          fit the ring's 4-bit wire field (< 16), agree with
+          ``N_REASONS``, and every ``DROP_REASON_*`` decode table in
+          the repo must cover every nonzero code
+CTA006    metrics-registry scatter: prometheus exposition text built
+          outside ``obs/registry.py``, or a required operator-
+          contract series no longer registered (the former
+          ``scripts/check_metrics_registry.py``)
+CTA007    sysdump schema sync: ``SYSDUMP_REQUIRED_KEYS`` drifting
+          from the daemon's ``_sysdump_collect`` sections (a renamed
+          section silently yields ``None`` bundles); also validates
+          bundle files passed on the command line (the former
+          ``scripts/check_sysdump_schema.py``)
+========  ===========================================================
+
+Annotation grammar
+------------------
+
+All annotations are ordinary comments, parsed with ``tokenize`` so
+they survive formatting.
+
+``# guarded-by: <lock>: <attr>[, <attr> ...]``
+    Class-body declaration (conventionally next to the lock's
+    creation in ``__init__``): the listed ``self.<attr>`` names may
+    only be touched lexically inside ``with self.<lock>:`` (or a
+    ``# holds:`` method).  ``__init__`` is exempt.  ``<lock>`` is a
+    lock attribute name (``_lock``), any alias of it (a
+    ``threading.Condition(self._lock)`` attribute resolves to the
+    wrapped lock), or the runtime name given to
+    ``infra.lockdebug.make_lock("<name>")`` — the static lock-alias
+    map and the runtime lock registry share identities.
+
+``self.attr = ...  # guarded-by: <lock>``
+    Per-attribute trailing form on an ``__init__`` assignment.
+
+``# holds: <lock>[, <lock> ...]``
+    On the ``def`` line (trailing), directly above it, or as the
+    first comment of the body: every caller guarantees the named
+    lock is held, so the method's guarded accesses are exempt for
+    that lock.
+
+``# thread-affinity: <aff>[, <aff> ...]``
+    Same placement as ``holds``.  Vocabulary: ``drain`` |
+    ``event-worker`` | ``watchdog`` | ``capture`` | ``api`` |
+    ``cli`` | ``offline`` | ``any``.  A function annotated with set
+    S may only (transitively) call functions whose declared set is a
+    superset of S (or contains ``any``); unannotated functions
+    inherit their callers' affinities during the call-graph walk.
+    Functions whose set includes ``drain`` are the hot-path roots
+    CTA003 scans from.
+
+``# hot-path-ok: <reason>``
+    Trailing waiver on a line CTA003 would flag (e.g. the drain
+    loop's bounded idle ``time.sleep``, the load-bearing cursor
+    ``block_until_ready`` in ``ring._start_window``).
+
+``# lint: disable=<CODE>[,<CODE>...] -- <reason>``
+    Suppress the listed codes on this line (trailing form) or on the
+    next line (standalone form).  The reason is mandatory; a
+    suppression without one is itself a CTA000 finding.
+
+Baseline
+--------
+
+``ANALYSIS_BASELINE.json`` at the repo root grandfathers known
+findings (matched by a line-content fingerprint, stable across line
+drift).  It is committed EMPTY — every violation the analyzer
+surfaced in this repo was fixed, not baselined — and exists so a
+future bulk import can land incrementally.  Refresh with
+``python -m cilium_tpu.analysis --write-baseline``.
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    BASELINE_NAME,
+    Baseline,
+    FileCtx,
+    Finding,
+    Repo,
+    repo_root,
+)
+from .driver import CHECKERS, run_analysis  # noqa: F401
+
+__all__ = [
+    "BASELINE_NAME",
+    "Baseline",
+    "CHECKERS",
+    "FileCtx",
+    "Finding",
+    "Repo",
+    "repo_root",
+    "run_analysis",
+]

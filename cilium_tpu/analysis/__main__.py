@@ -1,0 +1,77 @@
+"""``python -m cilium_tpu.analysis`` — the static-analysis CLI.
+
+Exit status: 0 clean, 1 findings, 2 usage error.
+
+Bundle files/dirs passed as positional arguments are additionally
+validated against the sysdump schema (CTA007's bundle half)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from .core import BASELINE_NAME, Baseline, repo_root
+from .driver import CHECKERS, render_human, render_json, run_analysis
+from .sysdump_lint import check_bundle
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cilium_tpu.analysis",
+        description="concurrency & invariant static analyzer")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--checker", action="append", default=None,
+                    choices=sorted(CHECKERS),
+                    help="run only the named checker(s)")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: "
+                         f"<root>/{BASELINE_NAME})")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather every current finding into "
+                         "the baseline and exit 0")
+    ap.add_argument("--list-checkers", action="store_true")
+    ap.add_argument("bundles", nargs="*",
+                    help="sysdump bundle files/dirs to validate")
+    args = ap.parse_args(argv)
+
+    if args.list_checkers:
+        for name, (code, _fn) in sorted(CHECKERS.items()):
+            print(f"{code}  {name}")
+        return 0
+
+    result = run_analysis(root=args.root, checkers=args.checker,
+                          baseline_path=args.baseline)
+
+    bundle_bad = []
+    for a in args.bundles:
+        if os.path.isdir(a):
+            for n in sorted(os.listdir(a)):
+                if n.startswith("sysdump-") and n.endswith(".json"):
+                    bundle_bad.extend(
+                        check_bundle(os.path.join(a, n)))
+        else:
+            bundle_bad.extend(check_bundle(a))
+
+    if args.write_baseline:
+        root = args.root or repo_root()
+        path = args.baseline or os.path.join(root, BASELINE_NAME)
+        all_findings = result["findings"] + result["baselined"]
+        Baseline(path).write(all_findings, result["repo"])
+        print(f"wrote {len(all_findings)} finding(s) to {path}")
+        return 0
+
+    if args.json:
+        print(render_json(result))
+    else:
+        print(render_human(result))
+    for b in bundle_bad:
+        print(f"sysdump: {b}", file=sys.stderr)
+    return 1 if (result["findings"] or bundle_bad) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""CTA002 — thread-affinity: the static generalization of the PR 5/6
+monkeypatch proofs ("decode never runs on the drain thread",
+"analytics ingest never runs on the drain thread").
+
+Every function may declare the set of threads it is allowed to run
+on (``# thread-affinity: drain, api`` ...).  The checker propagates
+affinities over the call graph: an annotated function's body runs
+under exactly its declared set; an unannotated function inherits the
+union of its callers' sets.  A call edge from code that may run
+under affinity set S into a function whose declared set D satisfies
+neither ``S ⊆ D`` nor ``any ∈ D`` is a violation — flagged at the
+call site, naming both sides.
+
+``any`` in the CALLER set means "may run on every thread", so it only
+passes into callees that also declare ``any``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set, Tuple
+
+from .callgraph import CallGraph
+from .core import Finding, Repo
+
+CODE = "CTA002"
+NAME = "thread-affinity"
+
+
+def propagate(graph: CallGraph
+              ) -> Tuple[Dict[str, Set[str]], List[Finding]]:
+    """-> (state, findings): ``state[key]`` is the set of affinities
+    code in that function may execute under (declared for annotated
+    functions, inherited for the rest)."""
+    findings: List[Finding] = []
+    declared = {k: frozenset(fi.affinity)
+                for k, fi in graph.funcs.items()
+                if fi.affinity is not None}
+    state: Dict[str, Set[str]] = {
+        k: set(v) for k, v in declared.items()}
+    work = list(declared)
+    reported: Set[Tuple[str, str, int]] = set()
+    while work:
+        f = work.pop()
+        inc = (set(declared[f]) if f in declared
+               else set(state.get(f, ())))
+        if not inc:
+            continue
+        fi = graph.funcs[f]
+        for g, line in graph.edges.get(f, ()):
+            if g in declared:
+                dg = declared[g]
+                if "any" in dg:
+                    continue
+                bad = inc - dg
+                if bad and (f, g, line) not in reported:
+                    reported.add((f, g, line))
+                    if fi.ctx.suppressed(CODE, line):
+                        continue
+                    gi = graph.funcs[g]
+                    gname = (f"{gi.cls}.{gi.name}" if gi.cls
+                             else gi.name)
+                    findings.append(Finding(
+                        CODE, fi.ctx.rel, line,
+                        f"{gname} (thread-affinity: "
+                        f"{', '.join(sorted(dg))}) is reachable from "
+                        f"{'/'.join(sorted(bad))}-affine code via "
+                        f"{fi.cls + '.' if fi.cls else ''}{fi.name}",
+                        checker=NAME))
+                continue
+            new = inc - state.get(g, set())
+            if new:
+                state.setdefault(g, set()).update(new)
+                work.append(g)
+    return state, findings
+
+
+def check(repo: Repo, graph: CallGraph) -> List[Finding]:
+    _state, findings = propagate(graph)
+    return findings
+
+
+def affinity_map(graph: CallGraph) -> Dict[Tuple[str, str],
+                                           Tuple[str, ...]]:
+    """{(rel, qualname): declared affinities} — the test surface:
+    deleting the ``decode_ring_rows`` or ``FlowAnalytics._ingest``
+    annotation makes the tier-1 analysis test fail by this map
+    losing the entry."""
+    out = {}
+    for fi in graph.funcs.values():
+        if fi.affinity is not None:
+            qual = f"{fi.cls}.{fi.name}" if fi.cls else fi.name
+            out[(fi.ctx.rel, qual)] = fi.affinity
+    return out

@@ -1,0 +1,207 @@
+"""Annotation extraction: guarded-by / holds / thread-affinity
+comments attached to classes and functions, plus the per-class
+lock-alias map (Condition wrappers and ``make_lock`` runtime names
+resolve to one identity — the same identity ``infra/lockdebug.py``
+uses at runtime)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import FileCtx, Finding
+
+AFFINITIES = ("drain", "event-worker", "watchdog", "capture", "api",
+              "cli", "offline", "any")
+
+_GUARDED_LIST_RE = re.compile(
+    r"#\s*guarded-by:\s*(?P<lock>[\w.-]+)\s*:\s*(?P<attrs>[\w,\s]+)$")
+_GUARDED_TRAIL_RE = re.compile(
+    r"#\s*guarded-by:\s*(?P<lock>[\w.-]+)\s*$")
+_HOLDS_RE = re.compile(r"#\s*holds:\s*(?P<locks>[\w.,\s-]+?)"
+                       r"(?:\s+--.*)?$")
+_AFFINITY_RE = re.compile(
+    r"#\s*thread-affinity:\s*(?P<affs>[\w,\s-]+?)(?:\s+--.*)?$")
+
+
+def _def_comment_range(node: ast.AST, ctx: FileCtx
+                       ) -> List[Tuple[int, str]]:
+    """Comments attached to a def/class: trailing comments anywhere in
+    the signature (def line .. first body statement), plus the
+    contiguous comment block immediately above the def/decorators."""
+    first_stmt = node.body[0].lineno if node.body else node.lineno + 1
+    start = node.lineno
+    if getattr(node, "decorator_list", None):
+        start = min(d.lineno for d in node.decorator_list)
+    out = ctx.comments_in(node.lineno, first_stmt)
+    ln = start - 1
+    above: List[Tuple[int, str]] = []
+    while ln >= 1 and ctx.comment_only.get(ln):
+        for c in ctx.comments[ln]:
+            above.append((ln, c))
+        ln -= 1
+    return above + out
+
+
+@dataclass
+class LockMap:
+    """Per-class lock identities.  ``canon`` maps every way a lock
+    can be named — its attribute, a Condition-wrapper attribute, or
+    its ``make_lock`` runtime name — onto one canonical attribute."""
+
+    canon: Dict[str, str] = field(default_factory=dict)
+
+    def resolve(self, name: str) -> Optional[str]:
+        return self.canon.get(name)
+
+
+def extract_lock_map(cls: ast.ClassDef) -> LockMap:
+    lm = LockMap()
+    aliases: List[Tuple[str, str]] = []  # (alias attr, inner attr)
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            continue
+        attr = tgt.attr
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        fn = call.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if fname in ("Lock", "RLock"):
+            lm.canon[attr] = attr
+        elif fname == "Condition":
+            inner = None
+            if call.args and isinstance(call.args[0], ast.Attribute) \
+                    and isinstance(call.args[0].value, ast.Name) \
+                    and call.args[0].value.id == "self":
+                inner = call.args[0].attr
+            if inner is not None:
+                aliases.append((attr, inner))
+            else:
+                lm.canon[attr] = attr
+        elif fname == "make_lock":
+            lm.canon[attr] = attr
+            if call.args and isinstance(call.args[0], ast.Constant) \
+                    and isinstance(call.args[0].value, str):
+                # the runtime lockdebug name IS a valid static alias:
+                # `guarded-by: datapath-loader` == `guarded-by: _lock`
+                lm.canon[call.args[0].value] = attr
+    for alias, inner in aliases:
+        lm.canon[alias] = lm.canon.get(inner, inner)
+    return lm
+
+
+@dataclass
+class GuardedClass:
+    cls: ast.ClassDef
+    ctx: FileCtx
+    locks: LockMap
+    guarded: Dict[str, str] = field(default_factory=dict)  # attr->lock
+    findings: List[Finding] = field(default_factory=list)
+
+
+def extract_guarded(cls: ast.ClassDef, ctx: FileCtx) -> GuardedClass:
+    """Parse both guarded-by forms within one class body."""
+    gc = GuardedClass(cls, ctx, extract_lock_map(cls))
+    end = max((getattr(n, "end_lineno", None) or n.lineno
+               for n in ast.walk(cls)
+               if getattr(n, "lineno", None) is not None),
+              default=cls.lineno)
+    # list form, anywhere in the class span
+    for ln, c in ctx.comments_in(cls.lineno, end + 1):
+        m = _GUARDED_LIST_RE.search(c)
+        if m is None:
+            continue
+        lock = gc.locks.resolve(m.group("lock"))
+        if lock is None:
+            gc.findings.append(Finding(
+                "CTA000", ctx.rel, ln,
+                f"guarded-by names unknown lock "
+                f"{m.group('lock')!r} (no matching Lock/RLock/"
+                f"Condition/make_lock attribute in "
+                f"{cls.name})", checker="config"))
+            continue
+        for attr in m.group("attrs").split(","):
+            attr = attr.strip()
+            if attr:
+                gc.guarded[attr] = lock
+    # trailing form on __init__ self.X = ... lines
+    for fn in cls.body:
+        if isinstance(fn, ast.FunctionDef) and fn.name == "__init__":
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for ln, c in ctx.comments_in(node.lineno,
+                                             (node.end_lineno
+                                              or node.lineno) + 1):
+                    m = _GUARDED_TRAIL_RE.search(c)
+                    if m is None:
+                        continue
+                    lock = gc.locks.resolve(m.group("lock"))
+                    if lock is None:
+                        gc.findings.append(Finding(
+                            "CTA000", ctx.rel, ln,
+                            f"guarded-by names unknown lock "
+                            f"{m.group('lock')!r} in {cls.name}",
+                            checker="config"))
+                        continue
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            gc.guarded[tgt.attr] = lock
+    return gc
+
+
+def extract_holds(node: ast.FunctionDef, ctx: FileCtx,
+                  locks: LockMap,
+                  findings: List[Finding]) -> Set[str]:
+    """Locks a method declares as held by every caller."""
+    held: Set[str] = set()
+    for ln, c in _def_comment_range(node, ctx):
+        m = _HOLDS_RE.search(c)
+        if m is None:
+            continue
+        for name in m.group("locks").split(","):
+            name = name.strip()
+            if not name:
+                continue
+            lock = locks.resolve(name)
+            if lock is None:
+                findings.append(Finding(
+                    "CTA000", ctx.rel, ln,
+                    f"holds names unknown lock {name!r}",
+                    checker="config"))
+                continue
+            held.add(lock)
+    return held
+
+
+def extract_affinity(node: ast.FunctionDef, ctx: FileCtx,
+                     findings: List[Finding]
+                     ) -> Optional[Tuple[str, ...]]:
+    """The function's declared thread-affinity set, or None."""
+    for ln, c in _def_comment_range(node, ctx):
+        m = _AFFINITY_RE.search(c)
+        if m is None:
+            continue
+        affs = tuple(a.strip() for a in m.group("affs").split(",")
+                     if a.strip())
+        bad = [a for a in affs if a not in AFFINITIES]
+        if bad or not affs:
+            findings.append(Finding(
+                "CTA000", ctx.rel, ln,
+                f"unknown thread-affinity {', '.join(bad)!r} "
+                f"(vocabulary: {', '.join(AFFINITIES)})",
+                checker="config"))
+            return None
+        return affs
+    return None

@@ -1,0 +1,323 @@
+"""Approximate, annotation-anchored static call graph.
+
+Resolution policy (documented because it IS the precision contract):
+
+1. ``self.m(...)`` resolves to method ``m`` of the lexically
+   enclosing class when it exists.
+2. ``name(...)`` resolves through the file's import map (module- and
+   function-level ``from X import name`` / ``from . import mod``,
+   relative imports included) to module-level functions, and to
+   same-module functions/classes (a class call edges to its
+   ``__init__``).
+3. ``self.attr.m(...)`` / ``var.m(...)`` resolve through inferred
+   types: ``self.attr = ClassName(...)`` anywhere in the class and
+   ``var = ClassName(...)`` in the local function body bind the
+   receiver to ``ClassName``.
+4. Anything else (``s["drainer"].swap_window(...)``, untyped
+   parameters) falls back to NAME MATCHING — but only against
+   functions that carry a ``thread-affinity`` annotation, and never
+   for ubiquitous names (``get``, ``append``, ``start``, ...).
+   Annotating a function is what opts it into being a fallback
+   target, which keeps the graph precise exactly where the checkers
+   need edges.
+
+Nested ``def``/``lambda`` bodies are deferred execution and are NOT
+attributed to the enclosing function.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .annotations import extract_affinity
+from .core import FileCtx, Finding, Repo
+
+# receiver-method names too generic to name-match (containers, numpy,
+# threading, re, file objects): a fallback edge on these would wire
+# unrelated subsystems together
+_FALLBACK_BLOCKLIST = {
+    "get", "set", "add", "append", "appendleft", "pop", "popleft",
+    "update", "setdefault", "items", "keys", "values", "sum", "min",
+    "max", "mean", "copy", "sort", "join", "split", "strip", "read",
+    "write", "close", "clear", "extend", "insert", "remove", "count",
+    "index", "format", "encode", "decode", "wait", "notify",
+    "notify_all", "acquire", "release", "put", "reshape", "astype",
+    "tolist", "item", "any", "all", "cumsum", "start", "is_alive",
+    "search", "match", "group", "flatten", "locked", "is_set",
+}
+
+
+@dataclass
+class FuncInfo:
+    key: str  # "<rel>::<Class.>name"
+    name: str
+    cls: Optional[str]
+    node: ast.FunctionDef
+    ctx: FileCtx
+    affinity: Optional[Tuple[str, ...]] = None
+
+
+def _module_rel(rel: str, level: int, module: str,
+                repo: Repo) -> Optional[str]:
+    """Resolve a (possibly relative) import to a repo-relative module
+    path WITHOUT the .py suffix, or None when outside the repo."""
+    if level == 0:
+        parts = module.split(".") if module else []
+    else:
+        base = rel.rsplit("/", 1)[0].split("/")
+        if level - 1 > 0:
+            base = base[:-(level - 1)] if level - 1 <= len(base) else []
+        parts = base + (module.split(".") if module else [])
+    if not parts or parts[0] != repo.package:
+        if level == 0:
+            return None
+    return "/".join(parts)
+
+
+class CallGraph:
+    def __init__(self, repo: Repo):
+        self.repo = repo
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.config_findings: List[Finding] = []
+        # bare/method name -> candidate keys
+        self._by_name: Dict[str, List[str]] = {}
+        # (rel, Class) -> {attr: set of class "rel::Class" keys}
+        self._attr_types: Dict[Tuple[str, str], Dict[str, Set[str]]] = {}
+        # "rel::Class" -> {method name: key}
+        self._class_methods: Dict[str, Dict[str, str]] = {}
+        # rel -> {local name: target} where target is
+        # ("func", key) | ("class", class key) | ("module", mod rel)
+        self._scopes: Dict[str, Dict[str, tuple]] = {}
+        self.edges: Dict[str, List[Tuple[str, int]]] = {}
+        self._collect()
+        self._resolve_imports()
+        self._infer_attr_types()
+        self._build_edges()
+
+    # -- collection ----------------------------------------------------
+    def _collect(self) -> None:
+        for ctx in self.repo.files:
+            if ctx.tree is None:
+                continue
+            for node in ctx.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    self._add_func(ctx, node, None)
+                elif isinstance(node, ast.ClassDef):
+                    ckey = f"{ctx.rel}::{node.name}"
+                    self._class_methods.setdefault(ckey, {})
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            fi = self._add_func(ctx, sub, node.name)
+                            self._class_methods[ckey][sub.name] = fi.key
+
+    def _add_func(self, ctx: FileCtx, node, cls: Optional[str]
+                  ) -> FuncInfo:
+        qual = f"{cls}.{node.name}" if cls else node.name
+        key = f"{ctx.rel}::{qual}"
+        fi = FuncInfo(key=key, name=node.name, cls=cls, node=node,
+                      ctx=ctx,
+                      affinity=extract_affinity(
+                          node, ctx, self.config_findings))
+        self.funcs[key] = fi
+        self._by_name.setdefault(node.name, []).append(key)
+        return fi
+
+    # -- imports -------------------------------------------------------
+    def _resolve_imports(self) -> None:
+        have_modules = {f.rel[:-3] for f in self.repo.files}
+        for ctx in self.repo.files:
+            if ctx.tree is None:
+                continue
+            scope: Dict[str, tuple] = {}
+            # same-module functions/classes first
+            for node in ctx.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    scope[node.name] = ("func",
+                                        f"{ctx.rel}::{node.name}")
+                elif isinstance(node, ast.ClassDef):
+                    scope[node.name] = ("class",
+                                        f"{ctx.rel}::{node.name}")
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ImportFrom):
+                    mod = _module_rel(ctx.rel, node.level,
+                                      node.module or "", self.repo)
+                    if mod is None:
+                        continue
+                    for alias in node.names:
+                        name = alias.asname or alias.name
+                        sub = f"{mod}/{alias.name}"
+                        if sub in have_modules:
+                            scope[name] = ("module", sub)
+                            continue
+                        target = self._lookup_module_symbol(
+                            mod, alias.name)
+                        if target is not None:
+                            scope[name] = target
+                elif isinstance(node, ast.Import):
+                    for alias in node.names:
+                        mod = alias.name.replace(".", "/")
+                        if mod in have_modules:
+                            scope[alias.asname
+                                  or alias.name.split(".")[0]] = (
+                                "module", mod)
+            self._scopes[ctx.rel] = scope
+
+    def _lookup_module_symbol(self, mod: str,
+                              name: str) -> Optional[tuple]:
+        rel = mod + ".py"
+        if not any(f.rel == rel for f in self.repo.files):
+            rel = mod + "/__init__.py"
+        key = f"{rel}::{name}"
+        if key in self.funcs:
+            return ("func", key)
+        if key in self._class_methods:
+            return ("class", key)
+        return None
+
+    # -- type inference ------------------------------------------------
+    def _class_of_call(self, rel: str, call: ast.Call
+                       ) -> Optional[str]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            tgt = self._scopes.get(rel, {}).get(fn.id)
+            if tgt is not None and tgt[0] == "class":
+                return tgt[1]
+        return None
+
+    def _infer_attr_types(self) -> None:
+        for ctx in self.repo.files:
+            if ctx.tree is None:
+                continue
+            for node in ctx.tree.body:
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                attrs: Dict[str, Set[str]] = {}
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Assign) \
+                            or not isinstance(sub.value, ast.Call):
+                        continue
+                    ck = self._class_of_call(ctx.rel, sub.value)
+                    if ck is None:
+                        continue
+                    for tgt in sub.targets:
+                        if isinstance(tgt, ast.Attribute) \
+                                and isinstance(tgt.value, ast.Name) \
+                                and tgt.value.id == "self":
+                            attrs.setdefault(tgt.attr, set()).add(ck)
+                self._attr_types[(ctx.rel, node.name)] = attrs
+
+    # -- edges ---------------------------------------------------------
+    def _build_edges(self) -> None:
+        for fi in self.funcs.values():
+            self.edges[fi.key] = self._edges_of(fi)
+
+    def _own_statements(self, fn: ast.FunctionDef) -> List[ast.AST]:
+        """The function's body EXCLUDING nested def/lambda bodies."""
+        out: List[ast.AST] = []
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                out.append(child)
+                walk(child)
+
+        walk(fn)
+        return out
+
+    def _edges_of(self, fi: FuncInfo) -> List[Tuple[str, int]]:
+        rel = fi.ctx.rel
+        scope = self._scopes.get(rel, {})
+        # local variable types: var = ClassName(...)
+        local_types: Dict[str, Set[str]] = {}
+        nodes = self._own_statements(fi.node)
+        for node in nodes:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call):
+                ck = self._class_of_call(rel, node.value)
+                if ck is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            local_types.setdefault(tgt.id,
+                                                   set()).add(ck)
+        out: List[Tuple[str, int]] = []
+        seen: Set[Tuple[str, int]] = set()
+
+        def add(key: Optional[str], line: int) -> None:
+            if key is not None and key in self.funcs \
+                    and (key, line) not in seen:
+                seen.add((key, line))
+                out.append((key, line))
+
+        for node in nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            line = node.lineno
+            if isinstance(fn, ast.Name):
+                tgt = scope.get(fn.id)
+                if tgt is None:
+                    continue
+                if tgt[0] == "func":
+                    add(tgt[1], line)
+                elif tgt[0] == "class":
+                    add(self._class_methods.get(tgt[1], {})
+                        .get("__init__"), line)
+                continue
+            if not isinstance(fn, ast.Attribute):
+                continue
+            meth = fn.attr
+            base = fn.value
+            resolved = False
+            if isinstance(base, ast.Name):
+                if base.id == "self" and fi.cls is not None:
+                    ckey = f"{rel}::{fi.cls}"
+                    key = self._class_methods.get(ckey, {}).get(meth)
+                    if key is not None:
+                        add(key, line)
+                        resolved = True
+                    else:
+                        resolved = True  # unknown self-attr callable:
+                        # callbacks are annotated at their defs
+                elif base.id in local_types:
+                    for ck in local_types[base.id]:
+                        key = self._class_methods.get(ck, {}).get(meth)
+                        if key is not None:
+                            add(key, line)
+                            resolved = True
+                else:
+                    tgt = scope.get(base.id)
+                    if tgt is not None and tgt[0] == "module":
+                        for suffix in (".py", "/__init__.py"):
+                            key = f"{tgt[1]}{suffix}::{meth}"
+                            if key in self.funcs:
+                                add(key, line)
+                                resolved = True
+                                break
+                        resolved = True  # module attr either way
+            elif isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self" and fi.cls is not None:
+                types = self._attr_types.get((rel, fi.cls), {}) \
+                    .get(base.attr)
+                if types:
+                    for ck in types:
+                        key = self._class_methods.get(ck, {}).get(meth)
+                        if key is not None:
+                            add(key, line)
+                            resolved = True
+            if not resolved and meth not in _FALLBACK_BLOCKLIST:
+                for key in self._by_name.get(meth, ()):
+                    cand = self.funcs[key]
+                    if cand.cls is not None \
+                            and cand.affinity is not None:
+                        add(key, line)
+        return out

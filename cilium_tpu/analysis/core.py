@@ -1,0 +1,249 @@
+"""Analyzer plumbing: findings, suppressions, baseline, file contexts.
+
+Everything here is pure stdlib (``ast`` + ``tokenize``) — the
+analyzer must import and run on a box with no jax at all, because it
+IS the gate that runs before anything else does.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+BASELINE_NAME = "ANALYSIS_BASELINE.json"
+
+# the one suppression grammar every checker shares:
+#   # lint: disable=CTA003[,CTA004] -- reason
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*disable=(?P<codes>[A-Z0-9, ]+?)"
+    r"(?:\s*--\s*(?P<reason>.*))?$")
+_CODE_RE = re.compile(r"^CTA\d{3}$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One structured finding: ``file:line: CODE message``."""
+
+    code: str  # stable CTAnnn code
+    path: str  # repo-relative, forward slashes
+    line: int  # 1-based
+    message: str
+    checker: str = ""  # human checker name
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "file": self.path,
+                "line": self.line, "message": self.message,
+                "checker": self.checker}
+
+    def fingerprint(self, line_text: str, occurrence: int = 0) -> str:
+        """Stable identity for baselining: survives line-number drift
+        (keyed on the flagged line's stripped text, not its number);
+        ``occurrence`` disambiguates identical lines in one file."""
+        h = hashlib.sha1()
+        h.update(self.code.encode())
+        h.update(b"\0")
+        h.update(self.path.encode())
+        h.update(b"\0")
+        h.update(line_text.strip().encode())
+        h.update(b"\0")
+        h.update(str(occurrence).encode())
+        return h.hexdigest()[:16]
+
+
+@dataclass
+class Suppression:
+    line: int  # line the suppression applies to
+    codes: Tuple[str, ...]
+    reason: str
+    comment_line: int  # where the comment itself sits
+    used: bool = False
+
+
+class FileCtx:
+    """One parsed source file: tree + per-line comments + source."""
+
+    def __init__(self, path: str, rel: str):
+        self.path = path
+        self.rel = rel
+        with open(path, "rb") as f:
+            raw = f.read()
+        self.source = raw.decode("utf-8", errors="replace")
+        self.lines = self.source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.source, filename=rel)
+        except SyntaxError as e:
+            self.parse_error = str(e)
+        # line -> [comment text] (text includes the leading '#')
+        self.comments: Dict[int, List[str]] = {}
+        # line -> True when the line holds ONLY a comment
+        self.comment_only: Dict[int, bool] = {}
+        try:
+            for tok in tokenize.tokenize(io.BytesIO(raw).readline):
+                if tok.type == tokenize.COMMENT:
+                    ln = tok.start[0]
+                    self.comments.setdefault(ln, []).append(tok.string)
+                    before = (self.lines[ln - 1][:tok.start[1]]
+                              if ln - 1 < len(self.lines) else "")
+                    self.comment_only[ln] = not before.strip()
+        except tokenize.TokenError:
+            pass
+        self.suppressions: List[Suppression] = []
+        self.config_findings: List[Finding] = []  # CTA000s found here
+        self._parse_suppressions()
+        # line -> reason for `# hot-path-ok: reason`
+        self.hotpath_ok: Dict[int, str] = {}
+        for ln, comments in self.comments.items():
+            for c in comments:
+                m = re.search(r"#\s*hot-path-ok:\s*(.*)$", c)
+                if m:
+                    self.hotpath_ok[ln] = m.group(1).strip()
+
+    def _parse_suppressions(self) -> None:
+        for ln in sorted(self.comments):
+            for c in self.comments[ln]:
+                m = _SUPPRESS_RE.search(c)
+                if m is None:
+                    continue
+                codes = tuple(
+                    x.strip() for x in m.group("codes").split(",")
+                    if x.strip())
+                reason = (m.group("reason") or "").strip()
+                bad = [x for x in codes if not _CODE_RE.match(x)]
+                if bad or not codes:
+                    self.config_findings.append(Finding(
+                        "CTA000", self.rel, ln,
+                        f"malformed suppression (bad code "
+                        f"{', '.join(bad) or '<none>'}): {c.strip()!r}",
+                        checker="config"))
+                    continue
+                if not reason:
+                    self.config_findings.append(Finding(
+                        "CTA000", self.rel, ln,
+                        "suppression without a reason (want "
+                        "`# lint: disable=CODE -- reason`)",
+                        checker="config"))
+                    continue
+                target = ln + 1 if self.comment_only.get(ln) else ln
+                self.suppressions.append(
+                    Suppression(target, codes, reason, ln))
+
+    def suppressed(self, code: str, line: int) -> bool:
+        for s in self.suppressions:
+            if s.line == line and code in s.codes:
+                s.used = True
+                return True
+        return False
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1]
+        return ""
+
+    def comments_in(self, lo: int, hi: int) -> List[Tuple[int, str]]:
+        """All (line, text) comments with lo <= line < hi."""
+        out = []
+        for ln in sorted(self.comments):
+            if lo <= ln < hi:
+                for c in self.comments[ln]:
+                    out.append((ln, c))
+        return out
+
+
+def repo_root() -> str:
+    """The directory containing the ``cilium_tpu`` package."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+class Repo:
+    """Every parsed .py file under the package, plus shared indexes."""
+
+    def __init__(self, root: Optional[str] = None,
+                 package: str = "cilium_tpu"):
+        self.root = root or repo_root()
+        self.package = package
+        self.files: List[FileCtx] = []
+        pkg_dir = os.path.join(self.root, package)
+        for dirpath, dirnames, filenames in os.walk(pkg_dir):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for name in sorted(filenames):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, self.root).replace(
+                    os.sep, "/")
+                self.files.append(FileCtx(path, rel))
+
+    def by_rel(self, rel: str) -> Optional[FileCtx]:
+        for f in self.files:
+            if f.rel == rel:
+                return f
+        return None
+
+
+class Baseline:
+    """The committed grandfather list: findings present here are
+    reported as baselined (informational) instead of failing the
+    run."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.fingerprints: Dict[str, dict] = {}
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    data = json.load(f)
+                for e in data.get("findings", []):
+                    self.fingerprints[e["fingerprint"]] = e
+            except (OSError, ValueError, KeyError, TypeError):
+                # an unreadable baseline grandfathers nothing —
+                # the safe direction
+                self.fingerprints = {}
+
+    @staticmethod
+    def _fingerprint_all(findings: Iterable[Finding],
+                         repo: Repo) -> List[Tuple[Finding, str]]:
+        seen: Dict[tuple, int] = {}
+        out = []
+        for f in findings:
+            ctx = repo.by_rel(f.path)
+            text = ctx.line_text(f.line) if ctx is not None else ""
+            key = (f.code, f.path, text.strip())
+            occ = seen.get(key, 0)
+            seen[key] = occ + 1
+            out.append((f, f.fingerprint(text, occ)))
+        return out
+
+    def split(self, findings: List[Finding], repo: Repo
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """-> (new, baselined)."""
+        new: List[Finding] = []
+        old: List[Finding] = []
+        for f, fp in self._fingerprint_all(findings, repo):
+            (old if fp in self.fingerprints else new).append(f)
+        return new, old
+
+    def write(self, findings: List[Finding], repo: Repo) -> None:
+        entries = [
+            {"fingerprint": fp, "code": f.code, "file": f.path,
+             "message": f.message}
+            for f, fp in self._fingerprint_all(findings, repo)]
+        with open(self.path, "w") as f:
+            json.dump({"comment": "grandfathered static-analysis "
+                       "findings; refresh with `python -m "
+                       "cilium_tpu.analysis --write-baseline`",
+                       "findings": entries}, f, indent=1)
+            f.write("\n")

@@ -1,0 +1,92 @@
+"""The analysis driver: run every registered checker over the repo,
+apply suppressions (done per-checker) and the baseline, and render
+human or JSON output."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import (affinity, guarded, hotpath, reasons, registry_lint,
+               sharding, sysdump_lint)
+from .callgraph import CallGraph
+from .core import BASELINE_NAME, Baseline, Finding, Repo, repo_root
+
+# name -> (code, check(repo, graph) -> [Finding])
+CHECKERS: Dict[str, Tuple[str, Callable]] = {
+    "guarded-by": (guarded.CODE, guarded.check),
+    "thread-affinity": (affinity.CODE, affinity.check),
+    "hot-path": (hotpath.CODE, hotpath.check),
+    "sharding-spec": (sharding.CODE, sharding.check),
+    "reason-codes": (reasons.CODE, reasons.check),
+    "metrics-registry": (registry_lint.CODE, registry_lint.check),
+    "sysdump-schema": (sysdump_lint.CODE, sysdump_lint.check),
+}
+# checkers that walk the call graph; selecting none of these skips
+# the (comparatively expensive) CallGraph build entirely
+_GRAPH_CHECKERS = {"thread-affinity", "hot-path"}
+
+
+def run_analysis(root: Optional[str] = None,
+                 checkers: Optional[List[str]] = None,
+                 repo: Optional[Repo] = None,
+                 baseline_path: Optional[str] = None) -> dict:
+    """-> {"findings": [...], "baselined": [...], "config": [...],
+    "elapsed-s": float, "files": int}.  ``findings`` are the new,
+    unsuppressed, non-baselined ones — a clean tree has none."""
+    t0 = time.monotonic()
+    root = root or repo_root()
+    repo = repo or Repo(root)
+    names = checkers or list(CHECKERS)
+    graph = (CallGraph(repo)
+             if _GRAPH_CHECKERS & set(names) else None)
+    all_findings: List[Finding] = list(
+        graph.config_findings if graph is not None else ())
+    for ctx in repo.files:
+        all_findings.extend(ctx.config_findings)
+        if ctx.parse_error is not None:
+            all_findings.append(Finding(
+                "CTA000", ctx.rel, 1,
+                f"does not parse: {ctx.parse_error}",
+                checker="config"))
+    for name in names:
+        _code, fn = CHECKERS[name]
+        all_findings.extend(fn(repo, graph))
+    baseline = Baseline(baseline_path
+                        or os.path.join(root, BASELINE_NAME))
+    new, old = baseline.split(all_findings, repo)
+    new.sort(key=lambda f: (f.path, f.line, f.code))
+    return {
+        "findings": new,
+        "baselined": old,
+        "elapsed-s": round(time.monotonic() - t0, 3),
+        "files": len(repo.files),
+        "repo": repo,
+        "graph": graph,
+    }
+
+
+def render_human(result: dict) -> str:
+    lines: List[str] = []
+    for f in result["findings"]:
+        lines.append(f.render())
+    if result["baselined"]:
+        lines.append(f"({len(result['baselined'])} baselined "
+                     f"finding(s) suppressed by {BASELINE_NAME})")
+    n = len(result["findings"])
+    lines.append(
+        f"analysis: {n} finding(s) across {result['files']} files "
+        f"in {result['elapsed-s']}s"
+        + (" — clean" if n == 0 else ""))
+    return "\n".join(lines)
+
+
+def render_json(result: dict) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in result["findings"]],
+        "baselined": [f.to_dict() for f in result["baselined"]],
+        "files": result["files"],
+        "elapsed-s": result["elapsed-s"],
+    }, indent=1)

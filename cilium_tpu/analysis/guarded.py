@@ -1,0 +1,137 @@
+"""CTA001 — guarded-by lock discipline.
+
+An attribute declared ``guarded-by: <lock>`` in a class body may only
+be touched (read, written, deleted, or used as a call receiver)
+lexically inside ``with self.<lock>:`` — the go-deadlock-adjacent
+half of upstream's lockdebug CI tag, checked statically.  Exemptions:
+
+- ``__init__`` (no concurrent readers exist during construction);
+- methods annotated ``# holds: <lock>`` (callers hold the lock —
+  the lexical contract moves to the call sites, which the runtime
+  DebugLock still verifies under CILIUM_TPU_LOCKDEBUG=1);
+- lambda / nested-def bodies hold NOTHING (deferred execution: a
+  closure built under the lock runs after it is released).
+
+Lock identity goes through the class's alias map: a
+``threading.Condition(self._lock)`` attribute and the runtime name
+given to ``make_lock("<name>")`` both resolve to the wrapped lock, so
+``with self._nonempty:`` satisfies ``guarded-by: _lock``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .annotations import extract_guarded, extract_holds
+from .core import FileCtx, Finding, Repo
+
+CODE = "CTA001"
+NAME = "guarded-by"
+
+
+def _with_locks(node: ast.With, locks) -> Set[str]:
+    """Canonical lock identities a ``with`` statement acquires."""
+    out: Set[str] = set()
+    for item in node.items:
+        e = item.context_expr
+        if isinstance(e, ast.Attribute) \
+                and isinstance(e.value, ast.Name) \
+                and e.value.id == "self":
+            canon = locks.resolve(e.attr)
+            if canon is not None:
+                out.add(canon)
+    return out
+
+
+class _MethodVisitor(ast.NodeVisitor):
+    def __init__(self, checker: "_ClassChecker", held: Set[str]):
+        self.c = checker
+        self.held = held
+
+    def visit_With(self, node: ast.With) -> None:
+        got = _with_locks(node, self.c.gc.locks)
+        added = got - self.held
+        self.held |= added
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= added
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        _MethodVisitor(self.c, set()).generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        _MethodVisitor(self.c, set()).generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            lock = self.c.gc.guarded.get(node.attr)
+            if lock is not None and lock not in self.held:
+                self.c.report(node, node.attr, lock)
+        self.generic_visit(node)
+
+
+class _ClassChecker:
+    def __init__(self, gc, findings: List[Finding]):
+        self.gc = gc
+        self.findings = findings
+
+    def report(self, node: ast.AST, attr: str, lock: str) -> None:
+        ctx: FileCtx = self.gc.ctx
+        line = node.lineno
+        if ctx.suppressed(CODE, line):
+            return
+        self.findings.append(Finding(
+            CODE, ctx.rel, line,
+            f"{self.gc.cls.name}.{attr} is guarded by self.{lock} "
+            f"but touched outside `with self.{lock}:` (annotate the "
+            f"method `# holds: {lock}` if every caller holds it)",
+            checker=NAME))
+
+    def run(self) -> None:
+        for node in self.gc.cls.body:
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if node.name == "__init__":
+                continue
+            holds = extract_holds(node, self.gc.ctx, self.gc.locks,
+                                  self.findings)
+            _MethodVisitor(self, set(holds)).generic_visit(node)
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for ctx in repo.files:
+        if ctx.tree is None:
+            continue
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            gc = extract_guarded(node, ctx)
+            findings.extend(gc.findings)
+            if gc.guarded:
+                _ClassChecker(gc, findings).run()
+    return findings
+
+
+def guarded_map(repo: Repo) -> dict:
+    """{(rel, class): {attr: lock}} — the test surface proving the
+    repo-wide annotation pass is in place."""
+    out = {}
+    for ctx in repo.files:
+        if ctx.tree is None:
+            continue
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                gc = extract_guarded(node, ctx)
+                if gc.guarded:
+                    out[(ctx.rel, node.name)] = dict(gc.guarded)
+    return out

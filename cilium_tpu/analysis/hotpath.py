@@ -1,0 +1,143 @@
+"""CTA003 — hot-path purity.
+
+The serving drain loop's latency budget is the product's throughput
+ceiling (ROADMAP item 2: Python dispatch overhead IS the bottleneck),
+so code reachable from it must not:
+
+- ``time.sleep`` (the bounded idle tick is waived explicitly);
+- log at INFO or above (DEBUG is allowed — it is compiled out of hot
+  configs; WARNING+ formats strings and may hit handlers/IO);
+- do file I/O (``open``);
+- ``json.dumps`` / ``json.dump`` (serialization belongs on the
+  capture/API planes);
+- ``.block_until_ready()`` (a device sync; the one load-bearing
+  cursor sync in ``ring._start_window`` is waived with its reason).
+
+Roots are every function whose declared thread-affinity includes
+``drain``.  Reachability follows the call graph WITHOUT stopping at
+``any``-affine boundaries (the drain thread really executes those
+bodies) but does not descend into functions whose declared affinity
+excludes ``drain`` — that edge is CTA002's business.
+
+Waive a line with ``# hot-path-ok: <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .callgraph import CallGraph, FuncInfo
+from .core import Finding, Repo
+
+CODE = "CTA003"
+NAME = "hot-path"
+
+_LOG_LEVELS = {"info", "warning", "warn", "error", "critical",
+               "exception", "log"}
+
+
+def drain_roots(graph: CallGraph) -> List[str]:
+    return [k for k, fi in graph.funcs.items()
+            if fi.affinity is not None and "drain" in fi.affinity]
+
+
+def reachable(graph: CallGraph) -> Set[str]:
+    seen: Set[str] = set()
+    work = drain_roots(graph)
+    while work:
+        f = work.pop()
+        if f in seen:
+            continue
+        seen.add(f)
+        for g, _line in graph.edges.get(f, ()):
+            gi = graph.funcs[g]
+            if gi.affinity is not None \
+                    and "drain" not in gi.affinity \
+                    and "any" not in gi.affinity:
+                continue  # CTA002 territory, not hot-path reach
+            if g not in seen:
+                work.append(g)
+    return seen
+
+
+def _own_nodes(fn: ast.FunctionDef) -> List[ast.AST]:
+    out: List[ast.AST] = []
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            out.append(child)
+            walk(child)
+
+    walk(fn)
+    return out
+
+
+def _violation(node: ast.Call, src: str) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "open":
+            return "file I/O (open)"
+        return None
+    if not isinstance(fn, ast.Attribute):
+        return None
+    if fn.attr == "block_until_ready":
+        return "device sync (block_until_ready)"
+    if fn.attr == "sleep" and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "time":
+        return "time.sleep"
+    if fn.attr in ("dumps", "dump") and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "json":
+        return f"json.{fn.attr}"
+    if fn.attr in _LOG_LEVELS:
+        try:
+            base = ast.unparse(fn.value)
+        except Exception:  # noqa: BLE001 — unparse is best-effort
+            base = ""
+        if "logg" in base.lower():
+            return f"logging.{fn.attr} (>= INFO)"
+    return None
+
+
+def check(repo: Repo, graph: CallGraph) -> List[Finding]:
+    findings: List[Finding] = []
+    seen_lines: Set[Tuple[str, int]] = set()
+    for key in sorted(reachable(graph)):
+        fi: FuncInfo = graph.funcs[key]
+        for node in _own_nodes(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            what = _violation(node, fi.ctx.source)
+            if what is None:
+                continue
+            line = node.lineno
+            if (fi.ctx.rel, line) in seen_lines:
+                continue
+            seen_lines.add((fi.ctx.rel, line))
+            # a waiver may sit on any line of a multi-line call, or
+            # anywhere in the contiguous comment block directly above
+            end = getattr(node, "end_lineno", None) or line
+            if any(ln in fi.ctx.hotpath_ok
+                   for ln in range(line, end + 1)):
+                continue
+            above = line - 1
+            waived = False
+            while above >= 1 and fi.ctx.comment_only.get(above):
+                if above in fi.ctx.hotpath_ok:
+                    waived = True
+                    break
+                above -= 1
+            if waived:
+                continue
+            if fi.ctx.suppressed(CODE, line):
+                continue
+            qual = f"{fi.cls}.{fi.name}" if fi.cls else fi.name
+            findings.append(Finding(
+                CODE, fi.ctx.rel, line,
+                f"{what} in {qual}, which is reachable from the "
+                f"serving drain loop (waive with `# hot-path-ok: "
+                f"reason` if intentional)", checker=NAME))
+    return findings

@@ -1,0 +1,134 @@
+"""CTA005 — reason-code budget.
+
+The event ring packs the drop reason into a 4-BIT wire field
+(``monitor/ring.py`` w0 bits 5..8), so the ``REASON_*`` space is a
+real budget: codes must be unique, fit in [0, 16), agree with
+``N_REASONS``, and every decode table that renders them — monitor
+(``DROP_REASON_NAMES``), flow/hubble (``DROP_REASON_DESC``), and any
+future CLI table matching the ``DROP_REASON_*`` naming convention —
+must cover every nonzero code, or a freshly minted reason decodes as
+``"reason 13"`` on exactly the surface an operator is staring at
+during the incident that minted it.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .core import FileCtx, Finding, Repo
+
+CODE = "CTA005"
+NAME = "reason-codes"
+
+VERDICT_MODULE = "cilium_tpu/datapath/verdict.py"
+_TABLE_RE = re.compile(r"^DROP_REASON_[A-Z_]*$")
+# the ring's 4-bit wire field (monitor/ring.py)
+WIRE_LIMIT = 16
+
+
+def _collect_reasons(ctx: FileCtx
+                     ) -> Tuple[Dict[str, int], Optional[int]]:
+    reasons: Dict[str, int] = {}
+    n_reasons: Optional[int] = None
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        if tgt.id.startswith("REASON_") \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, int):
+            reasons[tgt.id] = node.value.value
+        elif tgt.id == "N_REASONS" \
+                and isinstance(node.value, ast.Constant):
+            n_reasons = node.value.value
+    return reasons, n_reasons
+
+
+def _decode_tables(repo: Repo) -> List[Tuple[FileCtx, str, ast.Dict,
+                                             Dict[int, str]]]:
+    out = []
+    for ctx in repo.files:
+        if ctx.tree is None:
+            continue
+        for node in ctx.tree.body:
+            if not isinstance(node, ast.Assign) \
+                    or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Name)
+                    and _TABLE_RE.match(tgt.id)
+                    and isinstance(node.value, ast.Dict)):
+                continue
+            table: Dict[int, str] = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) \
+                        and isinstance(k.value, int) \
+                        and isinstance(v, ast.Constant):
+                    table[k.value] = str(v.value)
+            out.append((ctx, tgt.id, node.value, table))
+    return out
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    ctx = repo.by_rel(VERDICT_MODULE)
+    if ctx is None or ctx.tree is None:
+        return [Finding(CODE, VERDICT_MODULE, 1,
+                        "REASON_* home module missing or unparsable",
+                        checker=NAME)]
+    reasons, n_reasons = _collect_reasons(ctx)
+
+    def flag(line: int, msg: str) -> None:
+        if not ctx.suppressed(CODE, line):
+            findings.append(Finding(CODE, ctx.rel, line, msg,
+                                    checker=NAME))
+
+    by_value: Dict[int, List[str]] = {}
+    for name, value in reasons.items():
+        by_value.setdefault(value, []).append(name)
+        if not 0 <= value < WIRE_LIMIT:
+            flag(1, f"{name} = {value} does not fit the ring's "
+                    f"4-bit reason field (codes must be < "
+                    f"{WIRE_LIMIT})")
+    for value, names in sorted(by_value.items()):
+        if len(names) > 1:
+            flag(1, f"duplicate reason code {value}: "
+                    f"{', '.join(sorted(names))}")
+    if reasons:
+        expect = max(reasons.values()) + 1
+        if n_reasons is None:
+            flag(1, "N_REASONS is not defined next to the REASON_* "
+                    "constants")
+        elif n_reasons != expect:
+            flag(1, f"N_REASONS = {n_reasons} but the REASON_* "
+                    f"constants cover 0..{expect - 1} (want "
+                    f"{expect})")
+        elif n_reasons != len(reasons):
+            flag(1, f"N_REASONS = {n_reasons} but only "
+                    f"{len(reasons)} REASON_* constants exist "
+                    f"(holes in the code space)")
+    codes = set(range(1, (n_reasons
+                          or (max(reasons.values()) + 1
+                              if reasons else 1))))
+    for tctx, tname, node, table in _decode_tables(repo):
+        missing = sorted(codes - set(table))
+        extra = sorted(k for k in table
+                       if k not in codes and k != 0)
+        line = node.lineno
+        if missing and not tctx.suppressed(CODE, line):
+            findings.append(Finding(
+                CODE, tctx.rel, line,
+                f"decode table {tname} is missing reason code(s) "
+                f"{missing} — a drained row with one of these "
+                f"renders as a bare number", checker=NAME))
+        if extra and not tctx.suppressed(CODE, line):
+            findings.append(Finding(
+                CODE, tctx.rel, line,
+                f"decode table {tname} names unknown reason "
+                f"code(s) {extra} (not in REASON_* / N_REASONS)",
+                checker=NAME))
+    return findings

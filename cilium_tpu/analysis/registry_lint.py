@@ -1,0 +1,105 @@
+"""CTA006 — metrics-registry exposition scatter (the former
+``scripts/check_metrics_registry.py``, now a registered checker
+sharing the finding/suppression/baseline machinery; the script
+remains as a thin delegating shim).
+
+Prometheus exposition text may only be built in
+``cilium_tpu/obs/registry.py``.  Flagged anywhere else:
+
+1. a TYPE exposition header inside a string literal;
+2. a labelled metric sample literal (a metric-suffixed name opening
+   an inline label brace).
+
+Additionally, every REQUIRED_SERIES name (the operator-contract
+floor) must stay registered — its literal must appear in the
+registry module.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from typing import List
+
+from .core import Finding, Repo
+
+CODE = "CTA006"
+NAME = "metrics-registry"
+
+REGISTRY_MODULE = "cilium_tpu/obs/registry.py"
+
+# series that must be REGISTERED (their name literal present in the
+# registry module) — the operator-contract floor
+REQUIRED_SERIES = (
+    # flow analytics plane + incident flight recorder
+    "cilium_flow_agg_windows_total",
+    "cilium_flow_agg_batches_dropped_total",
+    "cilium_top_talkers_evictions_total",
+    "cilium_incidents_total",
+    "cilium_sysdump_writes_total",
+    # long-standing anchors (a registry rewrite that loses these
+    # fails here, not on a dashboard)
+    "cilium_datapath_packets_total",
+    "cilium_serving_verdicts_total",
+    "cilium_ring_lost_total",
+)
+
+_TYPE_LINE = re.compile(r"#\s*TYPE\s+\w+\s+(counter|gauge|histogram)")
+_SAMPLE = re.compile(r"\b[a-z][a-z0-9_]*_(total|bucket|sum|count|"
+                     r"seconds|bytes|info)\{[^}]*=")
+_GENERIC_SAMPLE = re.compile(r"\b(cilium|hubble)_[a-z0-9_]+\{")
+
+
+def scan_file(path: str) -> list:
+    """-> [(line, what, snippet)] exposition-text hits in one file.
+    (The shim script re-exports this; tests call it directly.)"""
+    with open(path, "rb") as f:
+        src = f.read()
+    out = []
+    try:
+        toks = tokenize.tokenize(io.BytesIO(src).readline)
+        for tok in toks:
+            if tok.type not in (tokenize.STRING,
+                                getattr(tokenize, "FSTRING_MIDDLE",
+                                        -1)):
+                continue
+            s = tok.string
+            for pat, what in ((_TYPE_LINE, "# TYPE exposition line"),
+                              (_SAMPLE, "labelled metric sample"),
+                              (_GENERIC_SAMPLE,
+                               "labelled metric sample")):
+                if pat.search(s):
+                    out.append((tok.start[0], what, s.strip()[:70]))
+                    break
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    reg = repo.by_rel(REGISTRY_MODULE)
+    if reg is None:
+        findings.append(Finding(
+            CODE, REGISTRY_MODULE, 1,
+            "registry module missing", checker=NAME))
+    else:
+        for name in REQUIRED_SERIES:
+            if f'"{name}"' not in reg.source:
+                findings.append(Finding(
+                    CODE, reg.rel, 1,
+                    f"required series {name!r} is not registered "
+                    f"(operator-contract floor)", checker=NAME))
+    for ctx in repo.files:
+        if ctx.rel == REGISTRY_MODULE:
+            continue
+        for line, what, snippet in scan_file(ctx.path):
+            if ctx.suppressed(CODE, line):
+                continue
+            findings.append(Finding(
+                CODE, ctx.rel, line,
+                f"{what} outside the metrics registry (register a "
+                f"collector in obs/registry.py instead): "
+                f"{snippet!r}", checker=NAME))
+    return findings

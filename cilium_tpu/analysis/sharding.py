@@ -1,0 +1,92 @@
+"""CTA004 — sharding-spec spelling.
+
+``P(axis)`` and ``P(axis, None)`` place identically, but jax's
+compilation cache keys on the SPELLING: jit normalizes output specs
+by trimming trailing ``None``s, so a fresh array ``device_put`` with
+the trailing-``None`` spelling mismatches the executable's cached
+layout key and retraces the serve step on every window swap — the
+trap PR 2 fixed once (``parallel/mesh.py`` ``make_sharded_ring``)
+and nothing but this checker prevents reintroducing.
+
+Rule: a ``P(...)``/``PartitionSpec(...)`` call whose LAST positional
+argument is the literal ``None`` is flagged, unless it appears where
+the rank-explicit spelling is the convention:
+
+- inside the value of an ``in_specs=`` / ``out_specs=`` keyword
+  (``shard_map`` specs are rank-matched by position), or
+- in an assignment to a name containing ``spec`` (the
+  ``state_specs = (P(), P(axis, None), ...)`` staging idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .core import Finding, Repo
+
+CODE = "CTA004"
+NAME = "sharding-spec"
+
+_SPEC_NAMES = {"P", "PartitionSpec"}
+_SPEC_KEYWORDS = {"in_specs", "out_specs"}
+
+
+def _trailing_none_p_calls(tree: ast.AST) -> List[ast.Call]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else (
+            fn.attr if isinstance(fn, ast.Attribute) else "")
+        if name not in _SPEC_NAMES or not node.args:
+            continue
+        last = node.args[-1]
+        if isinstance(last, ast.Constant) and last.value is None:
+            out.append(node)
+    return out
+
+
+def _allowed_spans(tree: ast.AST) -> Set[int]:
+    """ids of every AST node inside an in_specs/out_specs keyword
+    value or a ``*spec*``-named assignment."""
+    allowed: Set[int] = set()
+
+    def mark(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            allowed.add(id(sub))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg in _SPEC_KEYWORDS:
+                    mark(kw.value)
+        elif isinstance(node, ast.Assign):
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if any("spec" in n.lower() for n in names):
+                mark(node.value)
+    return allowed
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for ctx in repo.files:
+        if ctx.tree is None:
+            continue
+        allowed = _allowed_spans(ctx.tree)
+        for call in _trailing_none_p_calls(ctx.tree):
+            if id(call) in allowed:
+                continue
+            line = call.lineno
+            if ctx.suppressed(CODE, line):
+                continue
+            findings.append(Finding(
+                CODE, ctx.rel, line,
+                "trailing-None PartitionSpec spelling (P(axis, None) "
+                "places like P(axis) but keys the compile cache "
+                "differently — the window-swap retrace trap); trim "
+                "the trailing None outside shard_map in_specs/"
+                "out_specs", checker=NAME))
+    return findings

@@ -1,0 +1,137 @@
+"""CTA007 — sysdump schema sync (the former
+``scripts/check_sysdump_schema.py``, folded in as a registered
+checker; the script remains a thin delegating shim).
+
+Two halves:
+
+1. STATIC drift check, run on every analysis pass: every
+   ``SYSDUMP_REQUIRED_KEYS`` entry that is not part of the envelope
+   the flight recorder writes itself must appear as a section name
+   in the daemon's ``_sysdump_collect`` — the writer defaults
+   missing keys to ``None``, so a renamed section otherwise degrades
+   silently into a bundle full of nulls that still "passes" the old
+   schema check.
+
+2. BUNDLE validation (``check_bundle``), used by the shim CLI and
+   the flight-recorder tests: the bundle must load as JSON, carry
+   every required key and a known schema version, and fit the size
+   cap it declares.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import List, Optional, Set
+
+from .core import FileCtx, Finding, Repo
+
+CODE = "CTA007"
+NAME = "sysdump-schema"
+
+FLIGHTREC_MODULE = "cilium_tpu/obs/flightrec.py"
+DAEMON_MODULE = "cilium_tpu/agent/daemon.py"
+# keys the recorder's envelope provides regardless of collect_fn
+ENVELOPE_KEYS = {"schema", "node", "taken-at", "trigger", "incident",
+                 "incidents"}
+
+
+def _required_keys(ctx: FileCtx) -> Optional[List[str]]:
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "SYSDUMP_REQUIRED_KEYS" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)]
+    return None
+
+
+def _collect_sections(ctx: FileCtx) -> Set[str]:
+    """Section names ``_sysdump_collect`` produces: every string
+    constant passed as the first argument of a ``section(...)``
+    call, plus literal dict keys of its return value."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "_sysdump_collect":
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Name) \
+                        and sub.func.id == "section" and sub.args \
+                        and isinstance(sub.args[0], ast.Constant):
+                    out.add(str(sub.args[0].value))
+                elif isinstance(sub, ast.Dict):
+                    for k in sub.keys:
+                        if isinstance(k, ast.Constant):
+                            out.add(str(k.value))
+                elif isinstance(sub, ast.Subscript) \
+                        and isinstance(sub.slice, ast.Constant):
+                    out.add(str(sub.slice.value))
+    return out
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    fr = repo.by_rel(FLIGHTREC_MODULE)
+    if fr is None or fr.tree is None:
+        return [Finding(CODE, FLIGHTREC_MODULE, 1,
+                        "flight-recorder module missing",
+                        checker=NAME)]
+    required = _required_keys(fr)
+    if required is None:
+        return [Finding(CODE, fr.rel, 1,
+                        "SYSDUMP_REQUIRED_KEYS literal not found",
+                        checker=NAME)]
+    daemon = repo.by_rel(DAEMON_MODULE)
+    if daemon is None or daemon.tree is None:
+        return findings
+    sections = _collect_sections(daemon)
+    if not sections:
+        findings.append(Finding(
+            CODE, daemon.rel, 1,
+            "Daemon._sysdump_collect not found (the sysdump section "
+            "producer moved — update the checker's module map)",
+            checker=NAME))
+        return findings
+    for key in required:
+        if key in ENVELOPE_KEYS or key in sections:
+            continue
+        findings.append(Finding(
+            CODE, daemon.rel, 1,
+            f"sysdump required key {key!r} is not produced by "
+            f"Daemon._sysdump_collect — bundles will carry it as "
+            f"null", checker=NAME))
+    return findings
+
+
+# -- bundle validation (shim CLI + tests) ------------------------------
+def check_bundle(path: str) -> list:
+    """-> list of violation strings (empty = clean)."""
+    from ..obs.flightrec import SYSDUMP_REQUIRED_KEYS, SYSDUMP_SCHEMA
+
+    bad = []
+    try:
+        size = os.path.getsize(path)
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    try:
+        with open(path) as f:
+            bundle = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: does not load as JSON ({e})"]
+    if not isinstance(bundle, dict):
+        return [f"{path}: top level is {type(bundle).__name__}, "
+                f"not an object"]
+    if bundle.get("schema") != SYSDUMP_SCHEMA:
+        bad.append(f"{path}: schema {bundle.get('schema')!r} != "
+                   f"{SYSDUMP_SCHEMA}")
+    for key in SYSDUMP_REQUIRED_KEYS:
+        if key not in bundle:
+            bad.append(f"{path}: missing required key {key!r}")
+    cap = bundle.get("max-bytes")
+    if isinstance(cap, int) and size > cap:
+        bad.append(f"{path}: {size} bytes exceeds its declared "
+                   f"cap {cap}")
+    return bad

@@ -144,10 +144,14 @@ class TPULoader(Loader):
         # stall behind a host->device copy, and host assembly of
         # batch N+1 overlaps device execution of batch N.
         self._lock = make_lock("datapath-loader")
+        # guarded-by: datapath-loader: state
+        # (the runtime lockdebug name resolves to _lock in the static
+        # checker's alias map too — one identity, both worlds)
         # host-drop counts awaiting a free dispatch lock (see
         # add_host_drops: the watchdog must never block on _lock)
         self._host_drops: Dict[int, int] = {}
         self._host_drops_lock = make_lock("loader-host-drops")
+        # guarded-by: loader-host-drops: _host_drops
         # multi-chip serving (parallel/mesh.py): serving_shard()
         # installs the mesh and re-places state (CT sharded per chip,
         # tables replicated); sharded serve steps are cached per
@@ -194,6 +198,7 @@ class TPULoader(Loader):
             + (self.attach_count,))
 
     def _rekeep_serving_placement(self) -> None:
+        # holds: datapath-loader
         """Call (under the lock) after ANY state swap that introduces
         fresh arrays: during sharded serving the swap must not
         silently unshard the CT or leave new tensors single-device —
@@ -326,6 +331,7 @@ class TPULoader(Loader):
     def serve(self, ring, hdr, now: int, batch_id: int,
               trace_sample: int = 1024, proxy_ports=None,
               audit: bool = False, valid=None):
+        # thread-affinity: drain, api
         """The SERVING-path step: fused datapath + event-ring append
         in one dispatch, NO host fetch (monitor/ring.py serve_step).
         Returns (ring', row_map); events reach the host when the
@@ -368,6 +374,7 @@ class TPULoader(Loader):
                      ep: int, dirn: int, trace_sample: int = 1024,
                      proxy_ports=None, audit: bool = False,
                      valid=None):
+        # thread-affinity: drain, api
         """The packed serving fast path: [N, 4] uint32 rows —
         16 B/packet on the h2d link instead of :meth:`serve`'s 64 B —
         with on-device unpack + datapath + event-ring append fused in
@@ -405,6 +412,7 @@ class TPULoader(Loader):
 
     # -- multi-chip serving (parallel/mesh.py) ------------------------
     def serving_shard(self, mesh) -> None:
+        # thread-affinity: drain, api
         """Enter sharded-serving mode: place the live state for the
         mesh (CT private per chip, policy/ipcache/metrics replicated)
         and route subsequent :meth:`serve_sharded` dispatches through
@@ -418,6 +426,7 @@ class TPULoader(Loader):
             self.state = shard_state(self.state, mesh)
 
     def serving_unshard(self) -> None:
+        # thread-affinity: drain, api
         """Leave sharded-serving mode: gather state back to the
         default single-device placement (host round trip — cold path,
         stop_serving only)."""
@@ -436,6 +445,7 @@ class TPULoader(Loader):
                       trace_sample: int = 1024, proxy_ports=None,
                       audit: bool = False, valid=None,
                       packed_meta=None):
+        # thread-affinity: drain, api
         """One flow-routed batch through the multi-chip serve step.
 
         ``hdr`` is the ``route_by_flow`` output — wide
@@ -501,6 +511,7 @@ class TPULoader(Loader):
         return ring, row_map
 
     def add_route_overflow(self, n: int) -> None:
+        # thread-affinity: any
         """Account host-side flow-router overflow in the device
         metricsmap (REASON_ROUTE_OVERFLOW) — the RSS-queue-overflow
         counter; sharding-preserving (.at on the replicated array)."""
@@ -509,6 +520,7 @@ class TPULoader(Loader):
         self.add_host_drops(REASON_ROUTE_OVERFLOW, n)
 
     def add_host_drops(self, reason: int, n: int) -> None:
+        # thread-affinity: any
         """Account host-side drops under ``reason`` in the device
         metricsmap — the serving recovery plane's counterpart of
         :meth:`add_route_overflow`: batches lost to a dead/hung
@@ -531,6 +543,9 @@ class TPULoader(Loader):
         self._flush_host_drops()
 
     def _flush_host_drops(self) -> None:
+        # holds: datapath-loader -- acquired NON-BLOCKING at entry
+        # (the early return when busy); every state touch sits inside
+        # the acquire/release window the try/finally pins
         """Move pending host-drop counts into the device metricsmap
         if the dispatch lock is free RIGHT NOW (non-blocking)."""
         from ..parallel.mesh import add_host_drops
@@ -791,6 +806,7 @@ class TPULoader(Loader):
         return out
 
     def ct_snapshot(self) -> np.ndarray:
+        # thread-affinity: drain, api, watchdog
         """Dense live rows — the canonical (placement-free) snapshot
         format, restorable into any capacity or backend."""
         from .conntrack import ct_rows_from_table
@@ -799,6 +815,7 @@ class TPULoader(Loader):
             return ct_rows_from_table(np.asarray(self.state.ct.table))
 
     def ct_restore(self, table: np.ndarray) -> None:
+        # thread-affinity: drain, api, offline
         from .conntrack import (CTTable, ROW_WORDS, ct_fp_from_table,
                                 ct_rows_from_table, ct_table_from_rows)
 

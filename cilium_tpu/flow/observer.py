@@ -150,11 +150,27 @@ class Observer:
         self.identity_getter = identity_getter or (lambda n: ())
         self.endpoint_getter = endpoint_getter or (lambda e: ("", e))
         self._lock = threading.Lock()
+        # guarded-by: _lock: time, verdict, reason, ct_state, msg_type,
+        # guarded-by: _lock: identity, proxy, hdr, flow_seq, l7, seq
 
     def __len__(self) -> int:
+        # holds: _lock -- get_flows reads it inside its locked region;
+        # external callers use the locked server_status()
         return min(self.seq, self.capacity)
 
+    def server_status(self) -> dict:
+        # thread-affinity: any
+        """Locked num/seen/max counts (hubble ServerStatus shape).
+        The gRPC server and relay prefer this over their fallback
+        ``len(obs)``/``obs.seq`` reads, which raced a live consume."""
+        with self._lock:
+            return {"num_flows": len(self), "seen_flows": self.seq,
+                    "max_flows": self.capacity}
+
     def consume(self, batch: EventBatch) -> None:
+        # thread-affinity: any -- publish() fans out on whichever
+        # thread published (event-join worker for ring joins, drain
+        # thread for host-synthesized shed/recovery drops)
         """Vectorized ring append (a MonitorAgent consumer)."""
         n = len(batch)
         if n == 0:
@@ -185,6 +201,7 @@ class Observer:
 
     def append_l7(self, hdr_row: np.ndarray, l7: dict, verdict: int,
                   identity: int, timestamp: float) -> None:
+        # thread-affinity: any
         """One seven-parser flow (proxy access record) into the ring."""
         from ..flow.seven import MSG_L7
 
@@ -206,6 +223,7 @@ class Observer:
                   number: int = 100, oldest_first: bool = False,
                   blacklist: Sequence[FlowFilter] = ()
                   ) -> List[Flow]:
+        # thread-affinity: api, cli, capture, offline
         """The Observer.GetFlows equivalent: ``filters`` (whitelist)
         OR together; ``blacklist`` filters then EXCLUDE (reference:
         GetFlowsRequest whitelist/blacklist semantics)."""
@@ -232,6 +250,7 @@ class Observer:
             return [self._materialize(i) for i in idx]
 
     def _materialize(self, i: int) -> Flow:
+        # holds: _lock -- called from get_flows' locked region only
         f = materialize_flow(
             self.hdr[i], float(self.time[i]), int(self.flow_seq[i]),
             int(self.verdict[i]), int(self.reason[i]),

@@ -158,6 +158,7 @@ class FaultInjector:
 
     def check(self, site: str,
               abort: Optional[Callable[[], bool]] = None) -> None:
+        # thread-affinity: any
         """Fire the site per its armed spec: raise
         :class:`InjectedFault`, or stall ``~S`` seconds (ended early
         when ``abort()`` turns True).  No-op for unarmed sites."""
@@ -184,6 +185,9 @@ class FaultInjector:
                 return
             if abort is not None and abort():
                 return
+            # hot-path-ok: the ~S HANG INJECTION itself — only
+            # reachable while a fault site is armed (tests/chaos);
+            # disarmed cost is one global load + None check
             time.sleep(min(0.005, left))
 
 

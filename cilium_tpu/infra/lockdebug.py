@@ -26,6 +26,7 @@ class _Registry:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # guarded-by: _lock: _edges, violations
         self._edges: Dict[str, Set[str]] = {}  # held -> then-acquired
         self.violations: List[Tuple[str, str]] = []
 
@@ -47,6 +48,7 @@ class _Registry:
                             f"reverse order exists elsewhere")
 
     def _reachable(self, src: str, dst: str) -> bool:
+        # holds: _lock -- only called from record()'s locked region
         seen: Set[str] = set()
         stack = [src]
         while stack:

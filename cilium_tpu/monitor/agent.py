@@ -31,12 +31,15 @@ class MonitorAgent:
         self._lost: Dict[str, int] = {}
         self._queue_depth = queue_depth
         self._lock = threading.Lock()
+        # guarded-by: _lock: _consumers, _queues, _lost
         # serializes the publish fan-out across emitting threads
         # (event-join worker + drain thread) — see publish()
         self._emit_lock = threading.RLock()
+        # guarded-by: _emit_lock: published
         self.published = 0
 
     def register(self, name: str, consumer: Consumer) -> None:
+        # thread-affinity: any
         """In-process consumer (e.g. the Hubble observer)."""
         with self._lock:
             self._consumers[name] = consumer
@@ -60,6 +63,7 @@ class MonitorAgent:
             self._queues.pop(name, None)
 
     def publish(self, batch: EventBatch) -> None:
+        # thread-affinity: any
         """Called by the loader after each datapath step.
 
         The fan-out is serialized under ``_emit_lock``: since the
@@ -69,7 +73,13 @@ class MonitorAgent:
         consumers (flow aggregation, metrics dicts) are not
         individually thread-safe.  Reentrant (RLock) so a consumer
         that publishes derived events from its callback cannot
-        deadlock itself."""
+        deadlock itself.
+
+        ``_lost`` increments take ``_lock``: they used to mutate
+        under ``_emit_lock`` only, racing the ``setdefault`` in
+        ``register``/``subscribe_queue`` (two locks guarding one
+        dict can lose an increment on a concurrent first-register —
+        the static guarded-by pass surfaced it)."""
         with self._lock:
             consumers = list(self._consumers.items())
             queues = list(self._queues.items())
@@ -81,13 +91,17 @@ class MonitorAgent:
                 except Exception:
                     # a broken consumer must not take down the
                     # datapath
-                    self._lost[name] = (self._lost.get(name, 0)
-                                        + len(batch))
+                    with self._lock:
+                        self._lost[name] = (self._lost.get(name, 0)
+                                            + len(batch))
             for name, q in queues:
                 if q.maxlen is not None and len(q) == q.maxlen:
-                    self._lost[name] = (self._lost.get(name, 0)
-                                        + len(q[0]))
+                    with self._lock:
+                        self._lost[name] = (self._lost.get(name, 0)
+                                            + len(q[0]))
                 q.append(batch)
 
     def lost_count(self, name: str) -> int:
-        return self._lost.get(name, 0)
+        # thread-affinity: any
+        with self._lock:
+            return self._lost.get(name, 0)

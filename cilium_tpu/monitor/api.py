@@ -267,6 +267,9 @@ def decode_ring_rows(rows: np.ndarray, hdr: np.ndarray,
                      row_to_numeric: np.ndarray,
                      timestamp: float,
                      aligned: bool = False) -> EventBatch:
+    # thread-affinity: event-worker, cli, offline -- NEVER the drain
+    # thread: per-packet decode on the dispatch path is exactly what
+    # PR 5 removed (the static half of the monkeypatch thread proof)
     """Drained ring rows of ONE batch + that batch's retained host
     header tensor -> EventBatch (the serving-path perf-reader: only
     the compacted events crossed the device->host link; the header

@@ -289,6 +289,8 @@ class RingWindow:
     t_swap: float = field(default_factory=time.monotonic)
 
     def fetch(self):
+        # thread-affinity: event-worker, api, offline -- the blocking
+        # d2h wait lives here; the drain thread only ever swaps
         """Complete the transfer and decode.  Returns
         ``(rows, shard_ids, appended, lost)``; ``shard_ids`` is None
         for a single-chip window.  Updates the originating drainer's
@@ -336,10 +338,15 @@ class RingWindow:
 def _start_window(ring: EventRing, capacity: int, n_shards: int,
                   proxy_ports, drainer, gather: bool,
                   compile_log) -> RingWindow:
+    # thread-affinity: drain, api, offline
     """The shared swap leg: sync the cursor (retires every queued
     dispatch — see AsyncRingDrainer.swap), do the occupancy math on
     host, start the async copy of either the rung gather or the full
     buffer, and wrap it all in a :class:`RingWindow`."""
+    # hot-path-ok: the load-bearing 8-byte cursor sync — blocking on
+    # the scalar drains the dispatch queue in ms where blocking on
+    # the buffer pays ~9s/dispatch on tunneled runtimes (r05); it is
+    # also what makes the occupancy-bounded gather possible at all
     ring.cursor.block_until_ready()
     cur = np.array(np.asarray(ring.cursor), copy=True).reshape(-1, 2)
     totals = _cursor_totals(cur)
@@ -425,6 +432,7 @@ class AsyncRingDrainer:
 
     def swap_window(self, ring: EventRing
                     ) -> Tuple[RingWindow, EventRing]:
+        # thread-affinity: drain, api, offline
         """Start the async fetch of ``ring`` and hand its window out
         as a :class:`RingWindow` (ownership transfers to the caller —
         the event-join worker's shape); returns the fresh ring for
@@ -449,6 +457,7 @@ class AsyncRingDrainer:
         return window, self.fresh()
 
     def swap(self, ring: EventRing) -> EventRing:
+        # thread-affinity: drain, api, offline
         """Legacy single-window double buffering: start the async
         fetch, retain the window internally for :meth:`collect`.  At
         most one fetch may be in flight."""
@@ -458,6 +467,7 @@ class AsyncRingDrainer:
         return fresh
 
     def collect(self) -> Tuple[np.ndarray, int, int]:
+        # thread-affinity: event-worker, api, offline
         """Complete the in-flight fetch -> (rows, appended, lost) for
         that window (empty result when nothing is pending)."""
         from ..infra import faults
@@ -507,6 +517,7 @@ def _decode_fetched(buf: np.ndarray, total: int, cap: int,
                     proxy_ports: np.ndarray = None,
                     gathered: bool = False
                     ) -> Tuple[np.ndarray, int, int]:
+    # thread-affinity: event-worker, api, cli, offline
     """Decode ONE ring's fetched window given its 64-bit append total:
     wrap/lost math, empty-slot filter, wire unpack.  The single
     definition of the drain rules — :func:`ring_drain` (one ring),
@@ -602,6 +613,7 @@ class ShardedAsyncRingDrainer:
         return self._fresh_fn()
 
     def swap_window(self, ring) -> Tuple[RingWindow, object]:
+        # thread-affinity: drain, api, offline
         """Same cursor-first sync discipline as the single-chip
         drainer (see AsyncRingDrainer.swap_window): block on the
         small cursor, then the (gathered) buffer bytes stream in the
@@ -617,12 +629,14 @@ class ShardedAsyncRingDrainer:
         return window, self.fresh()
 
     def swap(self, ring):
+        # thread-affinity: drain, api, offline
         assert self._pending is None, "previous window not collected"
         window, fresh = self.swap_window(ring)
         self._pending = window
         return fresh
 
     def collect(self) -> Tuple[np.ndarray, np.ndarray, int, int]:
+        # thread-affinity: event-worker, api, offline
         from ..infra import faults
 
         faults.check(faults.SITE_RING_COLLECT)

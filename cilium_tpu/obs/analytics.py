@@ -537,9 +537,16 @@ class FlowAnalytics:
         # ALL a publishing thread (incl. the serving drain thread)
         # ever touches
         self._qlock = threading.Lock()
+        # guarded-by: _qlock: _pending, batches_submitted,
+        # guarded-by: _qlock: batches_ingested, batches_dropped
         self._pending: Deque[object] = collections.deque()
-        # the aggregation state: worker/API threads only
+        # the aggregation state: worker/API threads only.  Lock order
+        # where both are held: _lock THEN _qlock (drain's ledger
+        # updates nest _qlock inside the aggregation lock)
         self._lock = threading.Lock()
+        # guarded-by: _lock: windows, talkers, pairs, detector,
+        # guarded-by: _lock: _fired_spikes, _duty_t0, _duty_spent,
+        # guarded-by: _lock: packets_seen
         self.detector = SpikeDetector(
             spike_factor, spike_min_drops, spike_baseline_windows)
         # spikes detected while the aggregation lock is held are
@@ -561,6 +568,7 @@ class FlowAnalytics:
 
     # -- producer side (ANY thread, including the drain thread) --------
     def submit(self, batch) -> None:
+        # thread-affinity: any
         """A MonitorAgent consumer: park one decoded EventBatch by
         reference.  Never aggregates here — the deque append is the
         entire cost on the publishing thread.  While the duty budget
@@ -573,8 +581,16 @@ class FlowAnalytics:
             return
         with self._qlock:
             self.batches_submitted += 1
-            if (self._duty_spent >= self.max_duty
-                    and time.monotonic() - self._duty_t0 < 1.0):
+            # ADVISORY cross-lock read of the _lock-guarded duty
+            # clock, racy BY DESIGN: taking _lock on the publishing
+            # path would make the drain thread wait out a whole
+            # aggregation pass — the exact contention submit() exists
+            # to avoid.  Worst case one batch is parked (or dropped)
+            # a beat late; drain() re-checks authoritatively.
+            # lint: disable=CTA001 -- advisory racy read; drain() re-checks under _lock
+            spent, t0 = self._duty_spent, self._duty_t0
+            if (spent >= self.max_duty
+                    and time.monotonic() - t0 < 1.0):
                 self.batches_dropped += 1
                 return
             if len(self._pending) >= self.queue_depth:
@@ -584,11 +600,13 @@ class FlowAnalytics:
 
     @property
     def pending(self) -> int:
+        # thread-affinity: any
         with self._qlock:
             return len(self._pending)
 
     # -- consumer side (event-join worker / API / offline callers) -----
     def drain(self) -> int:
+        # thread-affinity: event-worker, capture, api, cli, offline
         """Aggregate everything pending, then roll the open window
         if wall time has crossed its boundary — a drop burst
         followed by SILENCE must still close its window and reach
@@ -649,6 +667,9 @@ class FlowAnalytics:
         return len(batches)
 
     def _window_closed(self, window: _Window) -> None:
+        # holds: _lock -- the WindowAggregator close hook fires from
+        # drain()'s locked region
+        # thread-affinity: event-worker, capture, api, cli, offline
         """WindowAggregator close hook (called under ``_lock``):
         detect, but DEFER the incident callback to drain()'s
         unlocked tail."""
@@ -657,6 +678,10 @@ class FlowAnalytics:
             self._fired_spikes.append(fired)
 
     def _ingest(self, batch) -> None:
+        # holds: _lock -- called from drain()'s locked region only
+        # thread-affinity: event-worker, capture, api, cli, offline
+        # -- NEVER the drain thread: the static half of the tier-1
+        # monkeypatch thread-identity proof
         """Vectorized aggregation of one EventBatch (the monkeypatch
         point for the never-on-the-drain-thread tier-1 proof)."""
         hdr = batch.hdr
@@ -703,6 +728,7 @@ class FlowAnalytics:
         self.talkers.update_batch(tuniq, tcnt, tbyts)
 
     def _spike_incident(self, spike: dict) -> None:
+        # thread-affinity: event-worker, capture, api, cli, offline
         if self._on_incident is not None:
             self._on_incident("drop-spike", spike)
 
@@ -722,11 +748,17 @@ class FlowAnalytics:
         }
 
     def snapshot(self, top: int = 16) -> dict:
+        # thread-affinity: capture, api, cli, offline
         """``GET /flows/aggregate``: windows, matrix, top talkers,
         spike state, ledger.  Drains pending first so queries read
         fresh aggregates (query threads are off the dispatch path by
         definition)."""
         self.drain()
+        # the ledger reads OUTSIDE the aggregation lock: stats() now
+        # takes both locks itself, and calling it from inside the
+        # `with self._lock:` below would deadlock on the
+        # non-reentrant lock
+        ledger = self.stats()
         with self._lock:
             cur = self.windows.current
             out = {
@@ -752,22 +784,31 @@ class FlowAnalytics:
                 "evictions": (self.talkers.evictions
                               + self.pairs.evictions),
                 "spike": self.detector.to_dict(),
-                "ledger": self.stats(),
+                "ledger": ledger,
             }
             return out
 
     def stats(self) -> dict:
+        # thread-affinity: any
         """The serving-stats / registry block (cheap counters; no
-        drain — safe from any thread)."""
-        return {
-            "enabled": self.enabled,
-            "batches-submitted": self.batches_submitted,
-            "batches-ingested": self.batches_ingested,
-            "batches-dropped": self.batches_dropped,
-            "packets-seen": self.packets_seen,
-            "pending": self.pending,
-            "windows-closed": self.windows.windows_closed,
-            "talker-evictions": (self.talkers.evictions
-                                 + self.pairs.evictions),
-            "spikes": self.detector.spikes,
-        }
+        drain — safe from any thread).  Takes both locks (aggregation
+        then ledger, the drain() nesting order) so a scrape never
+        reads a half-updated window count against the matching
+        ledger; the bare reads it replaces raced live aggregation."""
+        with self._lock:
+            windows_closed = self.windows.windows_closed
+            evictions = self.talkers.evictions + self.pairs.evictions
+            spikes = self.detector.spikes
+            packets = self.packets_seen
+            with self._qlock:
+                return {
+                    "enabled": self.enabled,
+                    "batches-submitted": self.batches_submitted,
+                    "batches-ingested": self.batches_ingested,
+                    "batches-dropped": self.batches_dropped,
+                    "packets-seen": packets,
+                    "pending": len(self._pending),
+                    "windows-closed": windows_closed,
+                    "talker-evictions": evictions,
+                    "spikes": spikes,
+                }

@@ -42,6 +42,7 @@ class CompileLog:
     def __init__(self, capacity: int = MAX_EVENTS):
         self.capacity = int(capacity)
         self._lock = threading.Lock()
+        # guarded-by: _lock: _events, executables, compiles, violations
         self._events: List[dict] = []
         # (mode, shape) -> compile count; >1 is a violation
         self.executables: Dict[Tuple[str, tuple], int] = {}
@@ -52,6 +53,7 @@ class CompileLog:
                         cache_before: int, cache_after: int,
                         elapsed_s: float,
                         key_extra: tuple = ()) -> None:
+        # thread-affinity: any
         """Called by the loader after a serving dispatch with the
         jit-cache sizes sampled around it.  No growth = no event.
         ``key_extra`` extends the dedup key with everything that
@@ -82,6 +84,9 @@ class CompileLog:
             if len(self._events) > self.capacity:
                 del self._events[:len(self._events) - self.capacity]
         if duplicate:
+            # hot-path-ok: fires only on a one-executable-per-(rung,
+            # mode) invariant VIOLATION — the warning is the surface
+            # the recompile storm is reported on, never steady state
             logging.getLogger(__name__).warning(
                 "serving recompile VIOLATION: a second executable "
                 "compiled for mode=%s shape=%s (one-executable-per-"
@@ -90,6 +95,7 @@ class CompileLog:
                 key[0], key[1])
 
     def snapshot(self, limit: int = 32) -> dict:
+        # thread-affinity: any
         with self._lock:
             return {
                 "compiles": self.compiles,
@@ -102,6 +108,7 @@ class CompileLog:
             }
 
     def summary(self) -> dict:
+        # thread-affinity: any
         """The compact form riding ``serving_stats()``."""
         with self._lock:
             return {

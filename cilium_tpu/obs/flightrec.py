@@ -114,6 +114,10 @@ class FlightRecorder:
         self.min_interval_s = min_interval_s
         self.node = node
         self._lock = threading.Lock()
+        # guarded-by: _lock: _incidents, _seq, _capturing,
+        # guarded-by: _lock: _last_capture, incidents_total,
+        # guarded-by: _lock: writes_total, captures_skipped,
+        # guarded-by: _lock: write_errors, last_bundle, last_error
         self._incidents: List[dict] = []
         self._seq = 0
         self._last_capture = 0.0
@@ -130,6 +134,7 @@ class FlightRecorder:
     # -- incidents -----------------------------------------------------
     def record_incident(self, kind: str, detail=None,
                         capture: bool = True) -> dict:
+        # thread-affinity: any
         """Record one named incident; with ``capture`` (and a
         configured dir, outside the rate limit) also writes a sysdump
         bundle ASYNCHRONOUSLY on a short-lived capture thread.  Safe
@@ -174,17 +179,23 @@ class FlightRecorder:
 
     @staticmethod
     def _safe_detail(detail):
+        # thread-affinity: any
         if detail is None:
             return None
         if isinstance(detail, (str, int, float, bool)):
             return detail
         try:
+            # hot-path-ok: probe-serializes a HAND-SIZED incident
+            # detail dict (demotion cause, spike summary) — incidents
+            # are rare by construction; the bundle write itself runs
+            # on the capture thread
             json.dumps(detail)
             return detail
         except (TypeError, ValueError):
             return str(detail)[:500]
 
     def incidents(self, limit: int = 32) -> List[dict]:
+        # thread-affinity: any
         with self._lock:
             return [dict(i) for i in self._incidents[-limit:]]
 
@@ -196,6 +207,7 @@ class FlightRecorder:
     def capture(self, trigger: str = KIND_MANUAL,
                 incident: Optional[dict] = None,
                 manual: bool = True) -> Optional[str]:
+        # thread-affinity: capture, api, cli
         """Write one bundle; returns its path, or None when disabled,
         rate-limited (auto only), or nested inside another capture."""
         if not self.enabled:
@@ -223,6 +235,7 @@ class FlightRecorder:
 
     def _write_bundle(self, trigger: str, incident: Optional[dict],
                       recent: List[dict], seq: int) -> Optional[str]:
+        # thread-affinity: capture, api, cli
         bundle: Dict[str, object] = {
             "schema": SYSDUMP_SCHEMA,
             "node": self.node,
@@ -317,6 +330,7 @@ class FlightRecorder:
         return out
 
     def stats(self) -> dict:
+        # thread-affinity: any
         with self._lock:
             return {
                 "enabled": self.enabled,

@@ -166,6 +166,10 @@ class SpanTracer:
         self._ring: List[Optional[TraceSpan]] = [None] * self.capacity
         self._w = 0  # total committed (ring cursor)
         self._lock = threading.Lock()
+        # guarded-by: _lock: _ring, _w, completed, dropped
+        # (started/_seq/_next_id are guarded EXTERNALLY by the
+        # IngressQueue lock — sample_chunk's documented contract —
+        # which a per-class lexical checker cannot see)
         self._seq = 0  # admitted packets seen (queue-lock guarded)
         self._next_id = 0
         self.started = 0
@@ -178,6 +182,7 @@ class SpanTracer:
     # -- admission side (under the IngressQueue lock) ------------------
     def sample_chunk(self, n: int,
                      t: float) -> List[Tuple[int, TraceSpan]]:
+        # thread-affinity: any
         """Advance the admitted-seq counter by ``n`` and allocate
         spans for the sampled offsets; returns ``[(offset_in_chunk,
         span)]`` (usually empty).  ``t`` is the chunk's arrival
@@ -199,6 +204,7 @@ class SpanTracer:
 
     # -- pipeline side -------------------------------------------------
     def commit(self, span: TraceSpan) -> None:
+        # thread-affinity: any
         """A span reached the join boundary with all six stamps."""
         if span.done:
             return
@@ -213,6 +219,7 @@ class SpanTracer:
             self.e2e_hist.record(span.e2e_us())
 
     def evict(self, spans) -> None:
+        # thread-affinity: any
         """Spans whose packet died mid-pipeline (admission shed,
         recovery drop, lost batch): counted, never completed."""
         n = 0
@@ -226,6 +233,7 @@ class SpanTracer:
 
     # -- reading (API threads) -----------------------------------------
     def stats(self) -> dict:
+        # thread-affinity: any
         """The compact summary riding ``serving_stats()``."""
         with self._lock:
             return {

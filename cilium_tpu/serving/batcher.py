@@ -93,6 +93,7 @@ class BucketArena:
 
     def slot(self, bucket: int, cols: int,
              dtype=np.uint32) -> np.ndarray:
+        # thread-affinity: drain, api
         """Next staging buffer for this shape ([bucket, cols], or
         [bucket] when cols is 0).  The caller owns it for the next
         ``depth - 1`` requests of the SAME shape (see module doc)."""
@@ -108,8 +109,11 @@ class BucketArena:
         return pool[i]
 
     def occupancy(self) -> Dict[str, int]:
+        # thread-affinity: drain
         """Allocated staging footprint (shapes lazily materialize on
-        first use) — the obs plane's arena-occupancy gauge."""
+        first use) — the obs plane's arena-occupancy gauge.  DRAIN
+        THREAD ONLY: iterating the lazily-growing slot dict is only
+        safe on the thread that grows it (runtime._sample_gauges)."""
         return {"shapes": len(self._slots),
                 "bytes": sum(p.nbytes for p in self._slots.values())}
 
@@ -139,6 +143,7 @@ class AdaptiveBatcher:
 
     def due(self, queue: IngressQueue,
             now: Optional[float] = None) -> bool:
+        # thread-affinity: drain, api
         """Is a flush warranted right now?  Full-bucket OR deadline."""
         pending = queue.pending
         if pending == 0:
@@ -150,6 +155,7 @@ class AdaptiveBatcher:
     def assemble(self, queue: IngressQueue,
                  now: Optional[float] = None,
                  force: bool = False) -> Optional[AssembledBatch]:
+        # thread-affinity: drain, api
         """Dequeue one batch if a flush is due; None otherwise.
         ``force`` flushes whatever is queued regardless of deadline
         (the stop/drain path).
@@ -231,6 +237,7 @@ class AdaptiveBatcher:
 
     def time_to_deadline(self, queue: IngressQueue,
                          now: Optional[float] = None) -> float:
+        # thread-affinity: drain, api
         """Seconds until the head-of-line chunk's deadline expires
         (max_wait when empty) — the runtime's idle-wait bound."""
         if queue.pending == 0:

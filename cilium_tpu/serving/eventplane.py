@@ -123,6 +123,11 @@ class EventJoinWorker:
         self.queue_depth = max(1, int(queue_depth))
         self._budget = max(0, int(restart_budget))
         self._cv = threading.Condition()
+        # guarded-by: _cv: _q, _current, _stop, error, restarts,
+        # guarded-by: _cv: windows_submitted, windows_joined,
+        # guarded-by: _cv: windows_dropped, overflows, events_joined,
+        # guarded-by: _cv: events_dropped, ring_lost, d2h_bytes,
+        # guarded-by: _cv: join_lag, last_drop_cause
         self._q: list = []
         self._current: Optional[DrainWindow] = None
         self._stop = False
@@ -144,6 +149,7 @@ class EventJoinWorker:
 
     # -- producer side (the serving drain thread) ----------------------
     def submit(self, window: DrainWindow) -> bool:
+        # thread-affinity: any
         """Offer one window; never blocks.  A full queue drops the
         OLDEST queued window (counted) to admit the new one — the
         drop-oldest discipline the monitor queues use, so a stalled
@@ -177,18 +183,28 @@ class EventJoinWorker:
 
     @property
     def pending(self) -> int:
+        # thread-affinity: any
         with self._cv:
             return len(self._q) + (1 if self._current is not None
                                    else 0)
 
+    def _stopping(self) -> bool:
+        """Locked read of the stop-and-drained predicate (the fault
+        site's abort hook — the bare lambda read violated the
+        guarded-by contract)."""
+        with self._cv:
+            return self._stop and not self._q
+
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
+        # thread-affinity: api
         assert self._thread is None, "worker already started"
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="serving-eventjoin")
         self._thread.start()
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> dict:
+        # thread-affinity: api
         """Stop the worker.  With ``drain`` (default) every queued
         window is joined first — the ``stop_serving`` contract; the
         sweep below only fires for a dead/terminal worker or a
@@ -216,14 +232,19 @@ class EventJoinWorker:
             # eventually returns, _run_body sees it lost the claim
             # and does NOT also count the window joined.
             cur, self._current = self._current, None
+            # the terminal error is read under the SAME lock that
+            # writes it (the bare `self.error or ...` read below the
+            # block raced a dying worker's write)
+            sweep_cause = self.error or "worker did not drain in time"
         for w in swept:
-            self._drop(w, self.error or "worker did not drain in time")
+            self._drop(w, sweep_cause)
         if cur is not None:
             self._drop(cur, "join hung past stop timeout")
         return self.stats()
 
     # -- the worker thread ---------------------------------------------
     def _run(self) -> None:
+        # thread-affinity: event-worker
         try:
             self._run_body()
         except BaseException as e:  # noqa: BLE001 — death path: the
@@ -236,13 +257,14 @@ class EventJoinWorker:
             if cur is not None:
                 self._drop(cur, f"worker died: {e}")
             went_terminal = fire = False
+            err = None
             with self._cv:
                 if self._stop or self.restarts >= self._budget:
                     went_terminal = True
                     # a worker dying DURING stop() is the sweep's
                     # business, not an incident
                     fire = not self._stop
-                    self.error = (
+                    err = self.error = (
                         f"event-join worker died ({type(e).__name__}: "
                         f"{e}); restart budget "
                         f"{self.restarts}/{self._budget} exhausted")
@@ -252,8 +274,9 @@ class EventJoinWorker:
                     n = self.restarts
             if went_terminal:
                 if fire and self._on_terminal is not None:
-                    try:  # outside the lock: the hook may read stats()
-                        self._on_terminal(self.error)
+                    try:  # outside the lock: the hook may read
+                        # stats(), so hand it the captured error
+                        self._on_terminal(err)
                     except Exception:  # noqa: BLE001
                         pass
                 return
@@ -263,6 +286,7 @@ class EventJoinWorker:
             t.start()
 
     def _run_body(self) -> None:
+        # thread-affinity: event-worker
         while True:
             with self._cv:
                 while not self._q and not self._stop:
@@ -275,8 +299,7 @@ class EventJoinWorker:
             # the injection site: a raise here kills the worker
             # (restart-on-death); a ~S hang stalls the plane so the
             # bounded queue's overflow accounting can be proven
-            faults.check(faults.SITE_EVENT_JOIN,
-                         abort=lambda: self._stop and not self._q)
+            faults.check(faults.SITE_EVENT_JOIN, abort=self._stopping)
             try:
                 self._join_fn(window)
             except Exception as e:  # noqa: BLE001 — contained: one
@@ -303,6 +326,7 @@ class EventJoinWorker:
                 self._cv.notify_all()
 
     def _drop(self, window: DrainWindow, cause: str) -> None:
+        # thread-affinity: any
         with self._cv:
             self.windows_dropped += 1
             self.events_dropped += window.appended - window.lost
@@ -317,6 +341,7 @@ class EventJoinWorker:
 
     # -- reading (API/CLI threads) -------------------------------------
     def stats(self) -> Dict[str, object]:
+        # thread-affinity: any
         with self._cv:
             out = {
                 "queue-depth": self.queue_depth,

@@ -56,6 +56,8 @@ class IngressQueue:
         self._shed_retained = 0
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
+        # guarded-by: _lock: _chunks, _pending, admitted, shed,
+        # guarded-by: _lock: _shed_rows, _shed_retained, _dequeued_spans
         # obs plane (obs/trace.py SpanTracer or None): when armed,
         # admission allocates spans for 1-in-N admitted packets; the
         # spans ride their chunk ((offset, span) tuples, offsets
@@ -67,6 +69,7 @@ class IngressQueue:
     # -- producer side -------------------------------------------------
     def offer(self, rows: np.ndarray,
               t: Optional[float] = None) -> int:
+        # thread-affinity: any
         """Admit a chunk; returns how many of its rows were accepted.
         Sheds (from either end, per policy) are counted and retained
         for drop-event synthesis.
@@ -135,6 +138,7 @@ class IngressQueue:
             return accepted
 
     def _shed(self, rows: np.ndarray) -> None:
+        # holds: _lock -- only called from offer()'s locked region
         n = len(rows)
         self.shed += n
         keep = min(n, MAX_RETAINED_SHED_ROWS - self._shed_retained)
@@ -145,7 +149,9 @@ class IngressQueue:
     # -- consumer side -------------------------------------------------
     @property
     def pending(self) -> int:
-        return self._pending
+        # thread-affinity: any
+        with self._lock:
+            return self._pending
 
     def row_width(self) -> Optional[int]:
         """Column count of the queued rows (None when empty) — the
@@ -164,6 +170,7 @@ class IngressQueue:
         return (now if now is not None else time.monotonic()) - head_t
 
     def take(self, n: int) -> Tuple[np.ndarray, List[Tuple[int, float]]]:
+        # thread-affinity: drain, api
         """Dequeue up to ``n`` rows in FIFO order.
 
         Returns ``(rows, arrivals)`` where ``arrivals`` is a list of
@@ -202,6 +209,7 @@ class IngressQueue:
 
     def take_into(self, out: np.ndarray
                   ) -> Tuple[int, List[Tuple[int, float]]]:
+        # thread-affinity: drain, api
         """Dequeue up to ``len(out)`` rows in FIFO order DIRECTLY into
         ``out`` (the batcher's staging arena): one vectorized memcpy
         per chunk, no intermediate concatenate — the zero-copy half of
@@ -261,17 +269,19 @@ class IngressQueue:
         return got, arrivals
 
     def pop_dequeued_spans(self) -> List[tuple]:
+        # thread-affinity: drain, api
         """Drain the ``(batch_pos, span)`` pairs the last
         :meth:`take_into` committed — the batcher attaches them to
         its :class:`~.batcher.AssembledBatch`.  Single-consumer like
         take_into itself (the drain thread)."""
-        if not self._dequeued_spans:
-            return []
         with self._lock:
+            if not self._dequeued_spans:
+                return []
             out, self._dequeued_spans = self._dequeued_spans, []
         return out
 
     def take_sheds(self) -> Tuple[Optional[np.ndarray], int]:
+        # thread-affinity: drain, api
         """Drain the shed accounting accumulated since the last call:
         ``(retained header rows or None, exact shed count)``.  The
         count can exceed the row count when retention was capped."""
@@ -287,6 +297,7 @@ class IngressQueue:
         return rows, count
 
     def wait_nonempty(self, timeout: float) -> bool:
+        # thread-affinity: drain
         """Block until a chunk is queued (or timeout); the runtime's
         idle wait between deadline checks."""
         with self._nonempty:

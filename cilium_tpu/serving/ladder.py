@@ -88,6 +88,7 @@ class FallbackLadder:
         return self.rung != self.rungs[0]
 
     def record_failure(self, cause: str = "") -> bool:
+        # thread-affinity: drain, api
         """One dispatch failure on the current rung.  Returns True
         when the threshold fired and the caller should demote NOW
         (via :meth:`demote` after performing the mode switch); at the
@@ -100,6 +101,7 @@ class FallbackLadder:
 
     def record_success(self,
                        now: Optional[float] = None) -> bool:
+        # thread-affinity: drain, api
         """One healthy dispatch.  Returns True when sustained health
         plus an elapsed cooldown warrant promoting one rung."""
         self.fail_streak = 0
@@ -116,6 +118,7 @@ class FallbackLadder:
         return True
 
     def demote(self) -> str:
+        # thread-affinity: drain, api
         """Step one rung down; returns the new rung."""
         i = self.rungs.index(self.rung)
         assert i + 1 < len(self.rungs), "cannot demote past the floor"
@@ -127,6 +130,7 @@ class FallbackLadder:
         return self.rung
 
     def promote(self) -> str:
+        # thread-affinity: drain, api
         """Step one rung up; returns the new rung."""
         i = self.rungs.index(self.rung)
         assert i > 0, "already at the top rung"

@@ -162,6 +162,7 @@ class ServingRuntime:
         # leg so a death/hang between "rows left the queue" and "stats
         # recorded" can always be accounted), and the restart count.
         self._rec_lock = threading.Lock()
+        # guarded-by: _rec_lock: _gen, _inflight, _warm_shapes, _warm_gen
         self._gen = 0
         # (gen, t0, batch, deadline_exempt, warm_gen)
         self._inflight: Optional[tuple] = None
@@ -228,6 +229,7 @@ class ServingRuntime:
     # -- producer side (any thread) -----------------------------------
     def submit(self, rows: np.ndarray,
                t: Optional[float] = None) -> int:
+        # thread-affinity: any
         """Offer a chunk of header rows; returns how many were
         admitted.  Never blocks on the datapath: overflow sheds by
         the configured policy and is surfaced as counted monitor DROP
@@ -267,7 +269,17 @@ class ServingRuntime:
     def _terminal(self) -> bool:
         return not self._supervised or self.restarts >= self._budget
 
+    def _gen_is(self, gen: int) -> bool:
+        """Locked read of the drain-thread generation — the loop's
+        am-I-still-the-owner check.  A bare ``self._gen == gen`` read
+        was benign on CPython but violated the guarded-by contract;
+        the authoritative checks in ``_dispatch_one`` stay where they
+        were."""
+        with self._rec_lock:
+            return self._gen == gen
+
     def reset_warm_shapes(self) -> None:
+        # thread-affinity: drain, api
         """Forget which shapes have compiled — call after a dispatch
         MODE change (ladder demotion/promotion): the same bucket then
         maps to a different executable, and its first dispatch pays a
@@ -290,12 +302,15 @@ class ServingRuntime:
         return self._thread is not None and self._thread.is_alive()
 
     def start(self) -> None:
+        # thread-affinity: api
         if self._thread is not None:
             raise ServingAlreadyActiveError(
                 "serving runtime already started")
         self._stop.clear()
+        with self._rec_lock:
+            gen0 = self._gen
         self._thread = threading.Thread(target=self._loop,
-                                        args=(self._gen,),
+                                        args=(gen0,),
                                         daemon=True,
                                         name="serving-drain")
         self._thread.start()
@@ -311,6 +326,7 @@ class ServingRuntime:
             self._watchdog.start()
 
     def stop(self, drain: bool = True, timeout: float = 60.0) -> dict:
+        # thread-affinity: api
         """Stop the loop; with ``drain`` (default) every queued row is
         batched and dispatched before returning.  Idempotent.
 
@@ -349,6 +365,7 @@ class ServingRuntime:
         with self._rec_lock:
             inflight, self._inflight = self._inflight, None
             self._gen += 1
+            gen = self._gen
         if inflight is not None:
             self._account_lost(inflight[2], timeout_flavor=False)
         if drain and self._error is None:
@@ -357,7 +374,7 @@ class ServingRuntime:
                 batch = self.batcher.assemble(self.queue, force=True)
                 if batch is None:
                     break
-                self._dispatch_one(batch, self._gen)
+                self._dispatch_one(batch, gen)
         elif self._error is not None:
             # dead loop: the same fault would fire again — sweep the
             # queue into counted recovery drops instead (no silent
@@ -374,6 +391,7 @@ class ServingRuntime:
         return self.snapshot()
 
     def snapshot(self) -> dict:
+        # thread-affinity: any
         out = self.stats.snapshot(queue_pending=self.queue.pending,
                                   queue_depth=self.queue.capacity)
         if self._error is not None:
@@ -393,6 +411,7 @@ class ServingRuntime:
 
     # -- the drain loop ------------------------------------------------
     def _loop(self, gen: int) -> None:
+        # thread-affinity: drain
         try:
             self._loop_body(gen)
         except Exception as e:  # noqa: BLE001 — a dying drain thread
@@ -406,7 +425,8 @@ class ServingRuntime:
             self._error = f"{type(e).__name__}: {e}"
 
     def _loop_body(self, gen: int) -> None:
-        while not self._stop.is_set() and self._gen == gen:
+        # thread-affinity: drain
+        while not self._stop.is_set() and self._gen_is(gen):
             batch = self.batcher.assemble(self.queue)
             if batch is not None:
                 self._dispatch_one(batch, gen)
@@ -434,6 +454,10 @@ class ServingRuntime:
                 # every deadline flush.
                 ttd = self.batcher.time_to_deadline(self.queue)
                 if ttd > 0.0:
+                    # hot-path-ok: the bounded idle tick — rows are
+                    # waiting but neither full-bucket nor deadline
+                    # fired; sleeping toward the deadline IS the
+                    # batching policy, capped at _TICK_S
                     time.sleep(min(ttd, _TICK_S))
             else:
                 # the idle tick: the registry-backed gauges (queue
@@ -448,6 +472,7 @@ class ServingRuntime:
                 self.queue.wait_nonempty(self._idle_wait_s)
 
     def _dispatch_one(self, batch: AssembledBatch, gen: int) -> None:
+        # thread-affinity: drain, api -- stop()'s final drain runs here
         from . import DispatchFailedError
 
         if self._profile_state == "armed":
@@ -468,7 +493,7 @@ class ServingRuntime:
         # injection sites: a raise kills this thread (dead-thread
         # recovery); a hang (~S) wedges it past the dispatch deadline
         faults.check(faults.SITE_SERVING_DISPATCH,
-                     abort=lambda: (self._gen != gen
+                     abort=lambda: (not self._gen_is(gen)
                                     or self._stop.is_set()))
         with self._rec_lock:
             if self._gen != gen:
@@ -579,6 +604,7 @@ class ServingRuntime:
 
     # -- the obs plane (spans, gauges, profile window) -----------------
     def _complete_spans(self, t_done: float) -> None:
+        # thread-affinity: drain, api
         """Fallback (no async event plane took the spans): the batch
         whose arrivals just completed reached the join boundary —
         stamp device/join there and commit (same clock as the
@@ -594,6 +620,7 @@ class ServingRuntime:
             self._tracer.commit(sp)
 
     def _sample_gauges(self) -> None:
+        # thread-affinity: drain
         # queue backlog/depth deliberately NOT copied here: the idle
         # tick only fires when the queue is empty, so a sampled copy
         # would read ~0 during exactly the overload episodes a
@@ -612,6 +639,7 @@ class ServingRuntime:
         self.stats.gauges = g  # whole-dict swap: no torn reads
 
     def _profile_start(self) -> None:
+        # thread-affinity: drain, api
         try:
             import jax
 
@@ -621,11 +649,15 @@ class ServingRuntime:
             # best-effort; a capture failure must not kill serving
             import logging
 
+            # hot-path-ok: fires only when a profile capture FAILS to
+            # start — an operator-requested debug window, never
+            # steady state
             logging.getLogger(__name__).warning(
                 "serving profile capture failed to start: %s", e)
             self._profile_state = "failed"
 
     def _profile_stop(self) -> None:
+        # thread-affinity: drain, api
         try:
             import jax
 
@@ -643,6 +675,7 @@ class ServingRuntime:
                 "window": self._profile_batches}
 
     def _flush_sheds(self) -> None:
+        # thread-affinity: drain, api
         rows, count = self.queue.take_sheds()
         if count == 0:
             return
@@ -653,6 +686,7 @@ class ServingRuntime:
 
     # -- the recovery plane (watchdog thread + stop path) --------------
     def _watch(self) -> None:
+        # thread-affinity: watchdog
         """Supervise the drain thread: restart a dead one, deadline a
         hung dispatch, account every lost row.  Exits when the stop
         flag rises or the restart budget is exhausted."""
@@ -714,6 +748,7 @@ class ServingRuntime:
             t.start()
 
     def _notify_restart(self, cause: str, terminal: bool) -> None:
+        # thread-affinity: watchdog
         """Fire the incident hook (watchdog thread); contained."""
         if self._on_restart is None:
             return
@@ -724,6 +759,7 @@ class ServingRuntime:
 
     def _account_lost(self, batch: AssembledBatch,
                       timeout_flavor: bool) -> None:
+        # thread-affinity: drain, watchdog, api
         """One lost batch -> counted recovery drops + decoded DROP
         events.  ``timeout_flavor`` picks REASON_DISPATCH_TIMEOUT
         (watchdog deadline) over REASON_RECOVERY_DROP."""
@@ -760,6 +796,7 @@ class ServingRuntime:
             self._on_recovery_drop(rows, n, reason)
 
     def _sweep_queue_as_recovery_drops(self) -> None:
+        # thread-affinity: api
         """stop() over a dead loop: queued-but-never-dispatched rows
         become counted recovery drops (REASON_RECOVERY_DROP) instead
         of silently vanishing with the queue object."""

@@ -84,6 +84,14 @@ class ServingStats:
 
     def __init__(self):
         self._lock = threading.Lock()
+        # guarded-by: _lock: submitted, admitted, shed, shed_events,
+        # guarded-by: _lock: batches, verdicts, padded_rows, shapes,
+        # guarded-by: _lock: packed_batches, wide_batches, h2d_bytes,
+        # guarded-by: _lock: queue_wait, latency, recovery_dropped,
+        # guarded-by: _lock: timeout_dropped, recovery_events,
+        # guarded-by: _lock: dispatch_failures, dispatch_timeouts,
+        # guarded-by: _lock: restarts, last_restart_cause,
+        # guarded-by: _lock: last_restart_at
         self.started_at = time.monotonic()
         self.submitted = 0  # packets offered to the queue
         self.admitted = 0  # packets the queue accepted

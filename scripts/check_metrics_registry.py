@@ -2,93 +2,32 @@
 """Lint: prometheus exposition text is built ONLY in the unified
 registry (cilium_tpu/obs/registry.py).
 
-Before the registry existed the /metrics body was hand-assembled in
-four modules, each inventing its own `# TYPE` lines and label
-formatting; this check fails the suite if that scatter regrows.  Two
-things are flagged anywhere outside the registry module:
-
-1. a ``# TYPE`` exposition header inside a string literal (the
-   unmistakable signature of hand-built exposition text);
-2. an f-string interpolating label values into a metric sample, i.e.
-   a literal like ``some_metric_total{...="...``.
-
-Registering a metric NAME with the registry (a plain string passed
-to ``registry.counter(...)``) is fine — names must live at their
-declaration sites; only the exposition *rendering* is centralized.
-
-Additionally, REQUIRED_SERIES lists names that MUST be registered in
-the registry module: the flow-analytics / flight-recorder series
-(and a couple of long-standing anchors) are part of the operator
-contract, and a refactor that silently drops their registration
-would pass the scatter lint while still breaking every dashboard.
-The check is textual on purpose — the declaration site is the
-registry module, so the name literal must appear there.
+THIN SHIM: the implementation moved into the static-analysis package
+(``cilium_tpu.analysis.registry_lint``, checker CTA006) so it shares
+the finding/suppression/baseline machinery with every other checker
+— run ``python scripts/lint.py`` (or ``python -m
+cilium_tpu.analysis``) for the full pass.  This script keeps the
+original standalone CLI and the importable ``scan_file`` /
+``check_required`` surface (tests import them).
 
 Exit status 0 = clean; 1 = violations (printed one per line).
-Run it standalone, or via tests/test_obs_registry.py (tier-1).
 """
 
 from __future__ import annotations
 
-import io
 import os
-import re
 import sys
-import tokenize
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "cilium_tpu")
-# the one module allowed to build exposition text
-REGISTRY_MODULE = os.path.join("cilium_tpu", "obs", "registry.py")
-ALLOWED = {REGISTRY_MODULE}
+sys.path.insert(0, REPO)
 
-# series that must be REGISTERED (their name literal present in the
-# registry module) — the operator-contract floor
-REQUIRED_SERIES = (
-    # flow analytics plane + incident flight recorder
-    "cilium_flow_agg_windows_total",
-    "cilium_flow_agg_batches_dropped_total",
-    "cilium_top_talkers_evictions_total",
-    "cilium_incidents_total",
-    "cilium_sysdump_writes_total",
-    # long-standing anchors (a registry rewrite that loses these
-    # fails here, not on a dashboard)
-    "cilium_datapath_packets_total",
-    "cilium_serving_verdicts_total",
-    "cilium_ring_lost_total",
+from cilium_tpu.analysis.registry_lint import (  # noqa: E402,F401
+    REGISTRY_MODULE,
+    REQUIRED_SERIES,
+    check,
+    scan_file,
 )
-
-# exposition-text signatures inside a string literal
-_TYPE_LINE = re.compile(r"#\s*TYPE\s+\w+\s+(counter|gauge|histogram)")
-# metric sample with inline labels: name{key="  (catches both the
-# f-string template text and fully literal lines)
-_SAMPLE = re.compile(r"\b[a-z][a-z0-9_]*_(total|bucket|sum|count|"
-                     r"seconds|bytes|info)\{[^}]*=")
-_GENERIC_SAMPLE = re.compile(r"\b(cilium|hubble)_[a-z0-9_]+\{")
-
-
-def scan_file(path: str) -> list:
-    with open(path, "rb") as f:
-        src = f.read()
-    out = []
-    try:
-        toks = tokenize.tokenize(io.BytesIO(src).readline)
-        for tok in toks:
-            if tok.type not in (tokenize.STRING,
-                                getattr(tokenize, "FSTRING_MIDDLE",
-                                        -1)):
-                continue
-            s = tok.string
-            for pat, what in ((_TYPE_LINE, "# TYPE exposition line"),
-                              (_SAMPLE, "labelled metric sample"),
-                              (_GENERIC_SAMPLE,
-                               "labelled metric sample")):
-                if pat.search(s):
-                    out.append((tok.start[0], what, s.strip()[:70]))
-                    break
-    except tokenize.TokenError:
-        pass
-    return out
+from cilium_tpu.analysis.core import Repo  # noqa: E402
 
 
 def check_required() -> list:
@@ -106,26 +45,14 @@ def check_required() -> list:
 
 
 def main() -> int:
-    bad = list(check_required())
-    for dirpath, dirnames, filenames in os.walk(PKG):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for name in filenames:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, REPO)
-            if rel in ALLOWED:
-                continue
-            for line, what, snippet in scan_file(path):
-                bad.append(f"{rel}:{line}: {what} outside the "
-                           f"metrics registry: {snippet!r}")
-    if bad:
+    findings = check(Repo(REPO))
+    if findings:
         print("metrics-registry lint FAILED — exposition text must "
               "only be built in cilium_tpu/obs/registry.py (register "
               "a collector instead), and every REQUIRED_SERIES must "
               "stay registered:", file=sys.stderr)
-        for b in bad:
-            print("  " + b, file=sys.stderr)
+        for f in findings:
+            print("  " + f.render(), file=sys.stderr)
         return 1
     return 0
 

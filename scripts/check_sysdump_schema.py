@@ -1,16 +1,14 @@
 #!/usr/bin/env python
 """Sysdump bundle schema check: a flight-recorder artifact must be
-USABLE at 3am, which means three machine-checkable properties —
+USABLE at 3am — it loads, carries every required key, and fits the
+size cap it declares.
 
-1. the bundle LOADS (valid JSON; a hard-truncated body fails here,
-   which is the honest answer for a bundle the size bound had to
-   amputate);
-2. every REQUIRED top-level key is present (the key list is imported
-   from ``cilium_tpu.obs.flightrec`` so this check and the writer
-   cannot drift apart), and the schema version is one we know;
-3. the file fits the size cap the bundle itself declares
-   (``max-bytes``) — the flight recorder's own bound, re-verified
-   from the outside.
+THIN SHIM: the implementation moved into the static-analysis package
+(``cilium_tpu.analysis.sysdump_lint``, checker CTA007), which also
+statically checks that ``SYSDUMP_REQUIRED_KEYS`` stays in sync with
+the daemon's ``_sysdump_collect`` sections on every analysis pass.
+This script keeps the original standalone CLI and the importable
+``check_bundle`` surface (tests import it).
 
 Usage::
 
@@ -18,50 +16,17 @@ Usage::
     python scripts/check_sysdump_schema.py SYSDUMP_DIR
 
 Exit status 0 = every bundle clean; 1 = violations (one per line).
-Run standalone, or from the test suite (tests/test_flightrec.py
-round-trips every bundle the incident e2e produces through
-``check_bundle``).
 """
 
 from __future__ import annotations
 
-import json
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-from cilium_tpu.obs.flightrec import (SYSDUMP_REQUIRED_KEYS,  # noqa: E402
-                                      SYSDUMP_SCHEMA)
-
-
-def check_bundle(path: str) -> list:
-    """-> list of violation strings (empty = clean)."""
-    bad = []
-    try:
-        size = os.path.getsize(path)
-    except OSError as e:
-        return [f"{path}: unreadable ({e})"]
-    try:
-        with open(path) as f:
-            bundle = json.load(f)
-    except (OSError, ValueError) as e:
-        return [f"{path}: does not load as JSON ({e})"]
-    if not isinstance(bundle, dict):
-        return [f"{path}: top level is {type(bundle).__name__}, "
-                f"not an object"]
-    if bundle.get("schema") != SYSDUMP_SCHEMA:
-        bad.append(f"{path}: schema {bundle.get('schema')!r} != "
-                   f"{SYSDUMP_SCHEMA}")
-    for key in SYSDUMP_REQUIRED_KEYS:
-        if key not in bundle:
-            bad.append(f"{path}: missing required key {key!r}")
-    cap = bundle.get("max-bytes")
-    if isinstance(cap, int) and size > cap:
-        bad.append(f"{path}: {size} bytes exceeds its declared "
-                   f"cap {cap}")
-    return bad
+from cilium_tpu.analysis.sysdump_lint import check_bundle  # noqa: E402,F401
 
 
 def main(argv=None) -> int:

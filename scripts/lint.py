@@ -1,0 +1,25 @@
+#!/usr/bin/env python
+"""The single CI lint entry point: every registered static checker
+(guarded-by, thread-affinity, hot-path, sharding-spec, reason-codes,
+metrics-registry, sysdump-schema) through one driver with shared
+finding/suppression/baseline machinery.
+
+Usage::
+
+    python scripts/lint.py [--json] [--checker NAME ...] [BUNDLE...]
+
+Exit status 0 = clean; 1 = findings; 2 = usage.  Equivalent to
+``python -m cilium_tpu.analysis`` — see that package's docstring for
+the annotation grammar and checker codes.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cilium_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
