@@ -230,6 +230,28 @@ class DaemonConfig:
     sysdump_min_interval_s: float = 1.0
     # last-N Observer flows included per bundle
     sysdump_flows: int = 128
+    # -- clustermesh serving tier (cilium_tpu/cluster): N in-process
+    # daemon replicas behind one flow-affine front-end router, built
+    # by start_cluster_serving(nodes=N, config=...).  Validated at
+    # ClusterServing construction (cluster.validate_cluster_config).
+    # per-node forward queue capacity in PACKETS between the router
+    # and a replica's admission queue; overflow sheds drop-tail as
+    # counted REASON_CLUSTER_OVERFLOW drops
+    cluster_forward_depth: int = 1 << 15
+    # membership liveness sweep cadence...
+    cluster_probe_interval_s: float = 0.5
+    # ...and how many CONSECUTIVE failed probes declare a node dead
+    # (then: CT-replay failover onto the designated peer)
+    cluster_death_threshold: int = 2
+    # how long identity/policy mutations may take to reach every
+    # replica over the kvstore before wait_identity / wait_policy
+    # report divergence
+    cluster_convergence_deadline_s: float = 5.0
+    # "remote" serves the shared store over a real socket
+    # (kvstore/remote.py — one client per replica, the deployment
+    # shape); "memory" shares the InMemoryKVStore object (cheapest
+    # tests)
+    cluster_kvstore: str = "remote"
 
 
 class Daemon:
@@ -449,6 +471,11 @@ class Daemon:
 
         self.services = ServiceManager()
         self._serving = None  # start_serving() installs the ring path
+        # set by ClusterServing on every member replica: the back
+        # reference the Cluster serving-stats block, GET
+        # /cluster/status and the cilium_cluster_* registry series
+        # read (None = not part of a cluster serving tier)
+        self._cluster = None
         # bandwidth manager (pkg/bandwidth analogue): per-endpoint
         # egress rates; None until some endpoint is limited
         self._bw = None
@@ -1721,6 +1748,24 @@ class Daemon:
                                  time.time())
         self.monitor.publish(self._filter_events(batch))
 
+    def _publish_cluster_drops(self, rows: Optional[np.ndarray],
+                               count: int) -> None:
+        # thread-affinity: router, api
+        """Cluster-router sheds -> metricsmap + decoded monitor DROP
+        events on THIS node (the flow's owner, or a surviving peer
+        when the owner died) — the same double surfacing every other
+        host-side drop gets.  ``rows`` is the bounded retained
+        subset; ``count`` is exact."""
+        from ..datapath.verdict import REASON_CLUSTER_OVERFLOW
+        from ..monitor.api import synth_drop_batch
+
+        self.loader.add_host_drops(REASON_CLUSTER_OVERFLOW, count)
+        if rows is None or not len(rows):
+            return
+        batch = synth_drop_batch(rows, REASON_CLUSTER_OVERFLOW,
+                                 time.time())
+        self.monitor.publish(self._filter_events(batch))
+
     def submit(self, rows: np.ndarray,
                t: Optional[float] = None) -> int:
         # thread-affinity: any
@@ -1832,6 +1877,11 @@ class Daemon:
         log = getattr(self.loader, "compile_log", None)
         if log is not None:
             out["compile"] = log.summary()
+        if self._cluster is not None:
+            # the Cluster block: tier-level counters only (router,
+            # membership, failovers) — cheap by contract, because
+            # every member node renders it per scrape
+            out["cluster"] = self._cluster.summary()
         return out
 
     def debug_traces(self, limit: int = 64) -> dict:

@@ -32,8 +32,9 @@ CTA002    thread-affinity: code annotated (or reachable from code
           annotated) with affinity A calls a function whose declared
           affinity set excludes A — e.g. the drain thread reaching
           ``decode_ring_rows`` or ``FlowAnalytics._ingest``
-CTA003    hot-path purity: code reachable from the serving drain
-          loop (any function whose affinity includes ``drain``)
+CTA003    hot-path purity: code reachable from a hot domain — the
+          serving drain loop (affinity includes ``drain``) or the
+          cluster router's enqueue/forwarder path (``router``) —
           calls ``time.sleep``, logs at INFO or above, does file
           I/O (``open``), ``json.dumps``, or
           ``.block_until_ready()`` without a ``hot-path-ok`` waiver
@@ -57,6 +58,14 @@ CTA007    sysdump schema sync: ``SYSDUMP_REQUIRED_KEYS`` drifting
           section silently yields ``None`` bundles); also validates
           bundle files passed on the command line (the former
           ``scripts/check_sysdump_schema.py``)
+CTA008    cluster-ledger: every ``*_overflow``/``*_dropped``
+          increment in ``cilium_tpu/cluster/`` must use a counter
+          declared in ``router.DROP_COUNTERS``, each declared
+          counter must have its ``cilium_cluster_*_total`` registry
+          series, every ``DROP_REASON_*`` table must decode
+          ``REASON_CLUSTER_OVERFLOW``, and ``BENCH_cluster.json``
+          (when present) must keep its schema
+          (``scripts/check_cluster_ledger.py`` is the shim CLI)
 ========  ===========================================================
 
 Annotation grammar
@@ -88,12 +97,15 @@ they survive formatting.
 ``# thread-affinity: <aff>[, <aff> ...]``
     Same placement as ``holds``.  Vocabulary: ``drain`` |
     ``event-worker`` | ``watchdog`` | ``capture`` | ``api`` |
-    ``cli`` | ``offline`` | ``any``.  A function annotated with set
-    S may only (transitively) call functions whose declared set is a
-    superset of S (or contains ``any``); unannotated functions
-    inherit their callers' affinities during the call-graph walk.
-    Functions whose set includes ``drain`` are the hot-path roots
-    CTA003 scans from.
+    ``cli`` | ``offline`` | ``router`` | ``any``.  A function
+    annotated with set S may only (transitively) call functions
+    whose declared set is a superset of S (or contains ``any``);
+    unannotated functions inherit their callers' affinities during
+    the call-graph walk.  Functions whose set includes a hot domain
+    (``drain``, or ``router`` — the cluster front end's enqueue path
+    and forwarder threads) are the hot-path roots CTA003 scans from;
+    ``api`` names the control-plane family (API handlers, CLI,
+    tests' main thread, cluster membership/failover orchestration).
 
 ``# hot-path-ok: <reason>``
     Trailing waiver on a line CTA003 would flag (e.g. the drain

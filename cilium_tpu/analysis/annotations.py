@@ -13,8 +13,14 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from .core import FileCtx, Finding
 
+# "router" = the cluster serving tier's routing plane: the front-end
+# enqueue path plus the per-node forwarder threads
+# (cilium_tpu/cluster/router.py) — a hot-path domain like "drain"
+# (see hotpath.HOT_DOMAINS).  "api" covers the control-plane thread
+# family: API handlers, CLI, tests' main thread, and the cluster
+# membership/failover orchestration threads.
 AFFINITIES = ("drain", "event-worker", "watchdog", "capture", "api",
-              "cli", "offline", "any")
+              "cli", "offline", "router", "any")
 
 _GUARDED_LIST_RE = re.compile(
     r"#\s*guarded-by:\s*(?P<lock>[\w.-]+)\s*:\s*(?P<attrs>[\w,\s]+)$")

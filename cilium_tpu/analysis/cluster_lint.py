@@ -1,0 +1,201 @@
+"""CTA008 — cluster-ledger: every cluster-router drop site is
+counted, surfaced, and decodable; the cluster bench artifact keeps
+its schema.
+
+The cluster-wide no-silent-loss ledger (``submitted == per-node
+accounted + router_overflow + failover_dropped``) is only as strong
+as the discipline that every drop site in ``cilium_tpu/cluster/``
+feeds a declared counter.  Statically enforced:
+
+1. ``router.DROP_COUNTERS`` exists (the declared drop-counter
+   vocabulary), and every ``self.<name> += ...`` in cluster/ whose
+   name ends ``_overflow`` / ``_dropped`` uses a DECLARED name — an
+   undeclared increment is a drop site the ledger (and the registry)
+   cannot see;
+2. every declared counter has its prometheus series
+   (``cilium_cluster_<name>_total``) registered in the metrics
+   registry module — counted must also mean scrapeable;
+3. ``REASON_CLUSTER_OVERFLOW`` exists in the reason space and every
+   ``DROP_REASON_*`` decode table covers it (CTA005 enforces this
+   generically; CTA008 names the cluster code specifically so a
+   botched renumber fails with a cluster-shaped message);
+4. when ``BENCH_cluster.json`` exists at the repo root, it carries
+   every :data:`BENCH_CLUSTER_KEYS` entry — the bench-schema wire
+   for the cluster artifact (``check_bench`` is the importable
+   validator the shim CLI and tests share).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional
+
+from .core import FileCtx, Finding, Repo
+
+CODE = "CTA008"
+NAME = "cluster-ledger"
+
+CLUSTER_DIR = "cilium_tpu/cluster/"
+ROUTER_MODULE = "cilium_tpu/cluster/router.py"
+REGISTRY_MODULE = "cilium_tpu/obs/registry.py"
+VERDICT_MODULE = "cilium_tpu/datapath/verdict.py"
+CLUSTER_REASON = "REASON_CLUSTER_OVERFLOW"
+# decode tables that must name the cluster reason (module -> dict)
+DECODE_TABLES = (
+    ("cilium_tpu/monitor/api.py", "DROP_REASON_NAMES"),
+    ("cilium_tpu/flow/flow.py", "DROP_REASON_DESC"),
+    ("cilium_tpu/flow/proto.py", "DROP_REASON_WIRE"),
+)
+
+BENCH_NAME = "BENCH_cluster.json"
+# the cluster bench artifact's schema floor (bench.py --cluster)
+BENCH_CLUSTER_KEYS = (
+    "schema", "best_of",
+    "sustained_pps_n1", "sustained_pps_n2", "sustained_pps_n3",
+    "scaling_n2", "scaling_n3",
+    "failover_blackout_ms", "failover_detect_ms",
+    "failover_ct_entries", "failover_dropped",
+    "ledger_exact",
+)
+BENCH_SCHEMA = "bench-cluster-v1"
+
+
+def _module_tuple(ctx: FileCtx, name: str) -> Optional[List[str]]:
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            return [e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)]
+    return None
+
+
+def _module_const(ctx: FileCtx, name: str) -> Optional[int]:
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, ast.Constant):
+            return node.value.value
+    return None
+
+
+def _dict_keys(ctx: FileCtx, name: str) -> Optional[Dict[int, bool]]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, ast.Dict):
+            return {k.value: True for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, int)}
+    return None
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    router = repo.by_rel(ROUTER_MODULE)
+    if router is None or router.tree is None:
+        return [Finding(CODE, ROUTER_MODULE, 1,
+                        "cluster router module missing",
+                        checker=NAME)]
+    declared = _module_tuple(router, "DROP_COUNTERS")
+    if declared is None:
+        findings.append(Finding(
+            CODE, router.rel, 1,
+            "DROP_COUNTERS literal not found (the declared "
+            "drop-counter vocabulary the ledger checks against)",
+            checker=NAME))
+        declared = []
+
+    # 1. undeclared drop-site increments anywhere in cluster/
+    for ctx in repo.files:
+        if not ctx.rel.startswith(CLUSTER_DIR) or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.AugAssign) \
+                    or not isinstance(node.op, ast.Add):
+                continue
+            tgt = node.target
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            name = tgt.attr
+            if not (name.endswith("_overflow")
+                    or name.endswith("_dropped")):
+                continue
+            if name in declared:
+                continue
+            if ctx.suppressed(CODE, node.lineno):
+                continue
+            findings.append(Finding(
+                CODE, ctx.rel, node.lineno,
+                f"drop counter {name!r} incremented but not declared "
+                f"in router.DROP_COUNTERS — an uncounted (registry-"
+                f"invisible) router drop site", checker=NAME))
+
+    # 2. one registered series per declared counter
+    reg = repo.by_rel(REGISTRY_MODULE)
+    for name in declared:
+        series = f"cilium_cluster_{name}_total"  # lint: disable=CTA006 -- series-NAME construction for the presence check, not exposition text
+        if reg is None or f'"{series}"' not in reg.source:
+            findings.append(Finding(
+                CODE, REGISTRY_MODULE, 1,
+                f"router drop counter {name!r} has no registered "
+                f"series {series!r}", checker=NAME))
+
+    # 3. the cluster reason code decodes everywhere
+    verdict = repo.by_rel(VERDICT_MODULE)
+    reason = (_module_const(verdict, CLUSTER_REASON)
+              if verdict is not None and verdict.tree is not None
+              else None)
+    if reason is None:
+        findings.append(Finding(
+            CODE, VERDICT_MODULE, 1,
+            f"{CLUSTER_REASON} is not defined in the reason space",
+            checker=NAME))
+    else:
+        for rel, table in DECODE_TABLES:
+            ctx = repo.by_rel(rel)
+            keys = (_dict_keys(ctx, table)
+                    if ctx is not None and ctx.tree is not None
+                    else None)
+            if keys is None or reason not in keys:
+                findings.append(Finding(
+                    CODE, rel, 1,
+                    f"{table} does not decode {CLUSTER_REASON} "
+                    f"({reason}) — the cluster router's drops would "
+                    f"render as 'reason {reason}'", checker=NAME))
+
+    # 4. bench artifact schema (only when the artifact exists)
+    bench_path = os.path.join(repo.root, BENCH_NAME)
+    if os.path.exists(bench_path):
+        for msg in check_bench(bench_path):
+            findings.append(Finding(CODE, BENCH_NAME, 1, msg,
+                                    checker=NAME))
+    return findings
+
+
+# -- bench artifact validation (shim CLI + tests) ----------------------
+def check_bench(path: str) -> List[str]:
+    """-> list of violation strings (empty = clean)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: does not load as JSON ({e})"]
+    if not isinstance(data, dict):
+        return [f"{path}: top level is {type(data).__name__}, "
+                f"not an object"]
+    bad = []
+    if data.get("schema") != BENCH_SCHEMA:
+        bad.append(f"{path}: schema {data.get('schema')!r} != "
+                   f"{BENCH_SCHEMA}")
+    for key in BENCH_CLUSTER_KEYS:
+        if key not in data:
+            bad.append(f"{path}: missing required key {key!r}")
+    return bad

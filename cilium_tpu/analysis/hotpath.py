@@ -13,11 +13,15 @@ so code reachable from it must not:
 - ``.block_until_ready()`` (a device sync; the one load-bearing
   cursor sync in ``ring._start_window`` is waived with its reason).
 
-Roots are every function whose declared thread-affinity includes
-``drain``.  Reachability follows the call graph WITHOUT stopping at
-``any``-affine boundaries (the drain thread really executes those
-bodies) but does not descend into functions whose declared affinity
-excludes ``drain`` — that edge is CTA002's business.
+Roots are every function whose declared thread-affinity includes a
+HOT DOMAIN — ``drain`` (the serving drain loop) or ``router`` (the
+cluster front-end's enqueue path + per-node forwarder threads, PR 8:
+the cluster tier's submit latency is its admission ceiling exactly
+like dispatch latency is the node's).  Reachability follows the call
+graph WITHOUT stopping at ``any``-affine boundaries (the hot thread
+really executes those bodies) but does not descend into functions
+whose declared affinity excludes the domain — that edge is CTA002's
+business.
 
 Waive a line with ``# hot-path-ok: <reason>``.
 """
@@ -37,14 +41,27 @@ _LOG_LEVELS = {"info", "warning", "warn", "error", "critical",
                "exception", "log"}
 
 
-def drain_roots(graph: CallGraph) -> List[str]:
+# the hot thread-affinity domains this checker roots at, and the
+# human name each renders with in findings
+HOT_DOMAINS = {
+    "drain": "serving drain loop",
+    "router": "cluster router hot path",
+}
+
+
+def domain_roots(graph: CallGraph, domain: str) -> List[str]:
     return [k for k, fi in graph.funcs.items()
-            if fi.affinity is not None and "drain" in fi.affinity]
+            if fi.affinity is not None and domain in fi.affinity]
 
 
-def reachable(graph: CallGraph) -> Set[str]:
+def drain_roots(graph: CallGraph) -> List[str]:
+    """Kept for callers/tests of the original single-domain API."""
+    return domain_roots(graph, "drain")
+
+
+def reachable(graph: CallGraph, domain: str = "drain") -> Set[str]:
     seen: Set[str] = set()
-    work = drain_roots(graph)
+    work = domain_roots(graph, domain)
     while work:
         f = work.pop()
         if f in seen:
@@ -53,7 +70,7 @@ def reachable(graph: CallGraph) -> Set[str]:
         for g, _line in graph.edges.get(f, ()):
             gi = graph.funcs[g]
             if gi.affinity is not None \
-                    and "drain" not in gi.affinity \
+                    and domain not in gi.affinity \
                     and "any" not in gi.affinity:
                 continue  # CTA002 territory, not hot-path reach
             if g not in seen:
@@ -105,39 +122,42 @@ def _violation(node: ast.Call, src: str) -> Optional[str]:
 def check(repo: Repo, graph: CallGraph) -> List[Finding]:
     findings: List[Finding] = []
     seen_lines: Set[Tuple[str, int]] = set()
-    for key in sorted(reachable(graph)):
-        fi: FuncInfo = graph.funcs[key]
-        for node in _own_nodes(fi.node):
-            if not isinstance(node, ast.Call):
-                continue
-            what = _violation(node, fi.ctx.source)
-            if what is None:
-                continue
-            line = node.lineno
-            if (fi.ctx.rel, line) in seen_lines:
-                continue
-            seen_lines.add((fi.ctx.rel, line))
-            # a waiver may sit on any line of a multi-line call, or
-            # anywhere in the contiguous comment block directly above
-            end = getattr(node, "end_lineno", None) or line
-            if any(ln in fi.ctx.hotpath_ok
-                   for ln in range(line, end + 1)):
-                continue
-            above = line - 1
-            waived = False
-            while above >= 1 and fi.ctx.comment_only.get(above):
-                if above in fi.ctx.hotpath_ok:
-                    waived = True
-                    break
-                above -= 1
-            if waived:
-                continue
-            if fi.ctx.suppressed(CODE, line):
-                continue
-            qual = f"{fi.cls}.{fi.name}" if fi.cls else fi.name
-            findings.append(Finding(
-                CODE, fi.ctx.rel, line,
-                f"{what} in {qual}, which is reachable from the "
-                f"serving drain loop (waive with `# hot-path-ok: "
-                f"reason` if intentional)", checker=NAME))
+    for domain, domain_name in HOT_DOMAINS.items():
+        for key in sorted(reachable(graph, domain)):
+            fi: FuncInfo = graph.funcs[key]
+            for node in _own_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                what = _violation(node, fi.ctx.source)
+                if what is None:
+                    continue
+                line = node.lineno
+                if (fi.ctx.rel, line) in seen_lines:
+                    continue  # also dedupes across domains: one
+                    # violating line is one finding
+                seen_lines.add((fi.ctx.rel, line))
+                # a waiver may sit on any line of a multi-line call,
+                # or anywhere in the contiguous comment block
+                # directly above
+                end = getattr(node, "end_lineno", None) or line
+                if any(ln in fi.ctx.hotpath_ok
+                       for ln in range(line, end + 1)):
+                    continue
+                above = line - 1
+                waived = False
+                while above >= 1 and fi.ctx.comment_only.get(above):
+                    if above in fi.ctx.hotpath_ok:
+                        waived = True
+                        break
+                    above -= 1
+                if waived:
+                    continue
+                if fi.ctx.suppressed(CODE, line):
+                    continue
+                qual = f"{fi.cls}.{fi.name}" if fi.cls else fi.name
+                findings.append(Finding(
+                    CODE, fi.ctx.rel, line,
+                    f"{what} in {qual}, which is reachable from the "
+                    f"{domain_name} (waive with `# hot-path-ok: "
+                    f"reason` if intentional)", checker=NAME))
     return findings

@@ -38,6 +38,12 @@ REQUIRED_SERIES = (
     "cilium_top_talkers_evictions_total",
     "cilium_incidents_total",
     "cilium_sysdump_writes_total",
+    # clustermesh serving tier (every router drop site's series —
+    # CTA008 enforces the site -> counter mapping, this floor keeps
+    # the counters registered)
+    "cilium_cluster_router_overflow_total",
+    "cilium_cluster_failover_dropped_total",
+    "cilium_cluster_failovers_total",
     # long-standing anchors (a registry rewrite that loses these
     # fails here, not on a dashboard)
     "cilium_datapath_packets_total",

@@ -131,6 +131,9 @@ class APIClient:
     def fqdn_cache(self):
         return self._request("GET", "/fqdn/cache")
 
+    def cluster_status(self):
+        return self._request("GET", "/cluster/status")
+
     def cluster_health(self):
         return self._request("GET", "/cluster/health")
 

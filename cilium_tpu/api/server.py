@@ -223,6 +223,15 @@ def _make_handler(daemon: Daemon):
                                          "with a shared kvstore)"})
                     else:
                         self._send(200, daemon.health.to_dict())
+                elif path == "/cluster/status":
+                    # the clustermesh serving tier (one answer from
+                    # any member node's socket)
+                    if daemon._cluster is None:
+                        self._send(404, {
+                            "error": "not part of a cluster serving "
+                                     "tier (start_cluster_serving)"})
+                    else:
+                        self._send(200, daemon._cluster.status())
                 elif path == "/serving":
                     # serving front-end telemetry (queue wait, pad
                     # efficiency, verdicts/sec, latency percentiles)

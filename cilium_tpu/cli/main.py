@@ -179,6 +179,45 @@ def cmd_health(args) -> int:
     return 0
 
 
+def cmd_cluster(args) -> int:
+    """`cilium-tpu cluster status`: the clustermesh serving tier —
+    membership, routing table, failover history, and the
+    cluster-wide no-silent-loss ledger (any member node answers)."""
+    st = _client(args).cluster_status()
+    if args.json:
+        _print(st)
+        return 0
+    c = st["cluster"]
+    print(f"Cluster: {c['live']}/{c['nodes']} nodes live "
+          f"(kvstore {c['kvstore']}, failovers {c['failovers']})")
+    for m in st["membership"]:
+        node = st["per-node"].get(m["name"], {})
+        mode = node.get("mode") or "-"
+        lat = m.get("probe-latency-ms")
+        extra = (f"probe {lat}ms" if m["state"] == "live"
+                 and lat is not None else
+                 m.get("death", {}).get("cause", ""))
+        print(f"  {m['name']:<16}{m['state']:<6}mode={mode:<9}{extra}")
+    r = c.get("router")
+    if r is not None:
+        print(f"Router: submitted {r['submitted']}, pending "
+              f"{sum(r['pending'])}, overflow {r['router-overflow']}, "
+              f"failover-dropped {r['failover-dropped']}")
+        print(f"  slot owners: {r['slot-owner']}")
+    led = st["ledger"]
+    print(f"Ledger: submitted {led['submitted']} == accounted "
+          f"{led['accounted']} -> "
+          f"{'EXACT' if led['exact'] else 'OPEN (in flight)'}")
+    lf = c.get("last-failover")
+    if lf:
+        print(f"Last failover: {lf['dead']} -> {lf['peer']} "
+              f"(blackout {lf['blackout-ms']}ms, detect "
+              f"{lf.get('detect-ms')}ms, CT entries "
+              f"{lf['ct-replayed-entries']}, dropped "
+              f"{lf['dropped-rows']})")
+    return 0
+
+
 def cmd_config(args) -> int:
     c = _client(args)
     if args.action == "get":
@@ -880,6 +919,12 @@ def main(argv=None) -> int:
 
     sub.add_parser("health", help="cluster health (probe mesh)")
 
+    p = sub.add_parser("cluster",
+                       help="clustermesh serving tier status "
+                            "(membership, router, failovers, ledger)")
+    p.add_argument("action", nargs="?", default="status",
+                   choices=["status"])
+
     p = sub.add_parser("config", help="config get | set KEY VALUE")
     p.add_argument("action", nargs="?", default="get",
                    choices=["get", "set"])
@@ -1083,7 +1128,8 @@ def main(argv=None) -> int:
             "serving": cmd_serving, "trace": cmd_trace,
             "anomaly": cmd_anomaly, "daemon": cmd_daemon,
             "service": cmd_service, "fqdn": cmd_fqdn,
-            "health": cmd_health, "config": cmd_config,
+            "health": cmd_health, "cluster": cmd_cluster,
+            "config": cmd_config,
             "proxy": cmd_proxy,
             "egress": cmd_egress,
             "encrypt": cmd_encrypt,

@@ -1,0 +1,507 @@
+"""Clustermesh serving tier: N daemon replicas behind one flow-affine
+front-end router, with kvstore identity/policy propagation and
+CT-replay node failover.
+
+Reference: upstream cilium's horizontal story — per-node agents,
+identities/state fanned through the kvstore (clustermesh-apiserver /
+kvstoremesh), health probing, and connection ownership pinned to the
+node that saw the flow.  PRs 1-7 built a production-grade SINGLE-node
+serving plane; this package composes the repo's existing parts
+(``kvstore/remote.py`` networked store, ``health/`` node registry,
+``parallel.flow_shard_ids`` routing hash, PR 3 CT snapshot/restore)
+into the multi-node tier (PARITY row 61):
+
+- :class:`ClusterServing` / :func:`start_cluster_serving` — build N
+  in-process daemon replicas ("nodes": threads, not processes — the
+  CPU backend cannot run cross-process collectives; see
+  DIVERGENCES), each with its own serving runtime and its own
+  kvstore CLIENT against one shared :class:`KVStoreServer`, so
+  identity mints and policy publishes propagate node-to-node over
+  the REAL networked transport, not object sharing;
+- :mod:`.router` — the flow-affine front end: a 4-tuple's forward
+  and reply packets pin to one node; bounded per-node forward
+  queues shed with counted ``REASON_CLUSTER_OVERFLOW`` drops;
+- :mod:`.membership` — liveness sweep + injectable node death
+  (``cluster.probe`` fault site) + the kvstore policy plane;
+- :mod:`.failover` — CT-replay failover onto a designated peer:
+  replies for pre-failover connections keep passing egress
+  enforcement on the peer (the PR 3 demotion proof, extended to
+  node death).
+
+The cluster-wide no-silent-loss ledger (asserted exact in every
+cluster test)::
+
+    submitted == sum over nodes (verdicts + shed + recovery_dropped)
+                 + router_overflow + failover_dropped
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..serving import ServingError
+from .failover import FailoverOrchestrator
+from .membership import (ClusterMembership, ClusterPolicySync,
+                         publish_policy)
+from .router import ClusterRouter
+
+__all__ = [
+    "ClusterServing", "ClusterNode", "ClusterRouter",
+    "ClusterMembership", "ClusterPolicySync", "FailoverOrchestrator",
+    "start_cluster_serving", "validate_cluster_config",
+]
+
+_KVSTORE_MODES = ("remote", "memory")
+
+
+def validate_cluster_config(nodes, forward_depth, probe_interval_s,
+                            death_threshold, convergence_deadline_s,
+                            kvstore_mode):
+    """Normalize + validate the cluster knobs (the serving-knob
+    discipline: a typo'd cluster config fails at construction, not as
+    a silent misroute under load)."""
+    nodes = int(nodes)
+    if nodes < 1:
+        raise ValueError("cluster needs nodes >= 1")
+    forward_depth = int(forward_depth)
+    if forward_depth < 1:
+        raise ValueError("cluster_forward_depth must be >= 1")
+    probe_interval_s = float(probe_interval_s)
+    if probe_interval_s <= 0:
+        raise ValueError("cluster_probe_interval_s must be > 0")
+    death_threshold = int(death_threshold)
+    if death_threshold < 1:
+        raise ValueError("cluster_death_threshold must be >= 1")
+    convergence_deadline_s = float(convergence_deadline_s)
+    if convergence_deadline_s <= 0:
+        raise ValueError("cluster_convergence_deadline_s must be > 0")
+    kvstore_mode = str(kvstore_mode)
+    if kvstore_mode not in _KVSTORE_MODES:
+        raise ValueError(
+            f"cluster_kvstore must be one of {_KVSTORE_MODES}, got "
+            f"{kvstore_mode!r}")
+    return (nodes, forward_depth, probe_interval_s, death_threshold,
+            convergence_deadline_s, kvstore_mode)
+
+
+class ClusterNode:
+    """One replica: a full Daemon with its own serving runtime and
+    kvstore client.  ``alive`` flips exactly once (True -> False) on
+    crash; the final front-end snapshot is retained so the cluster
+    ledger can close over a corpse."""
+
+    # guarded-by: _lock: alive, final
+
+    def __init__(self, idx: int, name: str, daemon, kv_client=None,
+                 policy_sync=None):
+        self.idx = idx
+        self.name = name
+        self.daemon = daemon
+        self.kv_client = kv_client
+        self.policy_sync = policy_sync
+        self._lock = threading.Lock()
+        self.alive = True
+        self.final: Optional[dict] = None
+
+    def submit(self, rows: np.ndarray) -> int:
+        # (unannotated on purpose: inherits the router forwarder's
+        # affinity; Daemon.submit is any-affine)
+        return self.daemon.submit(rows)
+
+    def probe(self) -> bool:
+        # thread-affinity: api
+        """In-process liveness: the node is alive and its drain loop
+        is running.  (Multi-host deployments swap in the health
+        plane's socket probers — the membership layer only needs a
+        bool.)"""
+        with self._lock:
+            if not self.alive:
+                return False
+        s = self.daemon._serving
+        rt = s.get("runtime") if s is not None else None
+        return rt is not None and rt.running
+
+    def crash(self, cause: str) -> None:
+        # thread-affinity: api
+        """Simulated node death: the serving runtime is crash-stopped
+        (no drain — queued rows become counted recovery drops in this
+        node's own ledger) and the node stops probing healthy.
+        Idempotent."""
+        with self._lock:
+            if not self.alive:
+                return
+            self.alive = False
+        s = self.daemon._serving
+        rt = s.get("runtime") if s is not None else None
+        # kill OUTSIDE the node lock: it joins the drain thread, and
+        # a probe blocked behind that join would stall the sweep.
+        # Wrapped in the same {"front-end": ...} shape stop_serving
+        # returns — per_node_stats/ledger read n.final through that
+        # key, and a bare runtime snapshot here would make the dead
+        # node's verdicts + recovery drops VANISH from every surface
+        # between failover and cluster stop
+        final = rt.kill(cause) if rt is not None else None
+        with self._lock:
+            self.final = ({"front-end": final} if final is not None
+                          else None)
+
+    def mode(self) -> Optional[str]:
+        # thread-affinity: any
+        s = self.daemon._serving
+        lad = s.get("ladder") if s is not None else None
+        return lad.rung if lad is not None else None
+
+
+class ClusterServing:
+    """The cluster serving tier facade: construct -> add endpoints /
+    import policy (fan-out + kvstore propagation) -> :meth:`start`
+    -> :meth:`submit` from any thread -> :meth:`stop`.
+
+    Every node daemon gets ``daemon._cluster = self`` so the
+    per-node surfaces (serving stats Cluster block, GET
+    /cluster/status, the ``cilium_cluster_*`` registry series) can
+    reach the tier from any node's API socket."""
+
+    def __init__(self, nodes: int = 3, config=None,
+                 node_prefix: str = "node"):
+        from ..agent.daemon import Daemon, DaemonConfig
+
+        template = config or DaemonConfig()
+        (self.n_nodes, self.forward_depth, self.probe_interval_s,
+         self.death_threshold, self.convergence_deadline_s,
+         self.kvstore_mode) = validate_cluster_config(
+            nodes, template.cluster_forward_depth,
+            template.cluster_probe_interval_s,
+            template.cluster_death_threshold,
+            template.cluster_convergence_deadline_s,
+            template.cluster_kvstore)
+        # -- the shared identity/policy plane ---------------------------
+        self._kv_server = None
+        self._kv_store = None
+        if self.kvstore_mode == "remote":
+            from ..kvstore.remote import KVStoreServer, RemoteKVStore
+
+            self._kv_server = KVStoreServer(host="127.0.0.1", port=0)
+
+            def client():
+                return RemoteKVStore([self._kv_server.address])
+        else:
+            from ..kvstore import InMemoryKVStore
+
+            self._kv_store = InMemoryKVStore()
+
+            def client():
+                return self._kv_store
+
+        # -- the replicas ----------------------------------------------
+        self.nodes: List[ClusterNode] = []
+        for i in range(self.n_nodes):
+            cfg = dataclasses.replace(template,
+                                      node_name=f"{node_prefix}{i}")
+            kv = client()
+            daemon = Daemon(cfg, kvstore=kv)
+            sync = ClusterPolicySync(kv, daemon)
+            node = ClusterNode(i, cfg.node_name, daemon,
+                               kv_client=(kv if self._kv_server
+                                          is not None else None),
+                               policy_sync=sync)
+            daemon._cluster = self
+            self.nodes.append(node)
+        self._by_name = {n.name: n for n in self.nodes}
+        self._policy_rev = 0
+        self.router: Optional[ClusterRouter] = None
+        self.failover = FailoverOrchestrator(self)
+        self.membership = ClusterMembership(
+            self.nodes, self.probe_interval_s, self.death_threshold,
+            on_death=self._on_node_death,
+            node_registry=self.nodes[0].daemon.node_registry)
+        self._started = False
+        self._stopped = False
+        self._final: Optional[dict] = None
+
+    # -- topology ------------------------------------------------------
+    def node(self, name: str) -> ClusterNode:
+        return self._by_name[name]
+
+    def designated_peer(self, dead_idx: int) -> Optional[ClusterNode]:
+        """Next LIVE node in ring order after the dead one — the
+        deterministic failover target every test and operator can
+        predict."""
+        for step in range(1, self.n_nodes):
+            cand = self.nodes[(dead_idx + step) % self.n_nodes]
+            if cand.alive:
+                return cand
+        return None
+
+    # -- control plane (fan-out + kvstore propagation) -----------------
+    def add_endpoint(self, name: str, ips, labels):
+        """Register one logical endpoint on EVERY replica (same id
+        everywhere — the router may pin any flow to any node)."""
+        eps = [n.daemon.add_endpoint(name, tuple(ips), list(labels))
+               for n in self.nodes]
+        ids = {ep.id for ep in eps}
+        if len(ids) != 1:
+            raise ServingError(
+                f"endpoint id diverged across replicas: {sorted(ids)}"
+                f" (register endpoints in the same order everywhere)")
+        return eps[0]
+
+    def policy_import(self, rules) -> int:
+        """Publish one ruleset revision through the kvstore; every
+        node (the publisher included) applies it exactly once via its
+        watch.  Returns the revision — :meth:`wait_policy` blocks on
+        cluster-wide convergence."""
+        self._policy_rev += 1
+        kv = (self.nodes[0].kv_client
+              if self._kv_server is not None else self._kv_store)
+        publish_policy(kv, self._policy_rev, rules)
+        return self._policy_rev
+
+    def wait_policy(self, rev: Optional[int] = None,
+                    timeout: Optional[float] = None) -> bool:
+        rev = self._policy_rev if rev is None else rev
+        timeout = (self.convergence_deadline_s if timeout is None
+                   else timeout)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(n.policy_sync.applied_rev >= rev
+                   for n in self.nodes if n.alive):
+                return True
+            time.sleep(0.005)
+        return False
+
+    def snapshot_now(self, trigger: str = "cluster") -> None:
+        """Fan out a CT snapshot on every live replica — the failover
+        replay source.  Production deployments get the same cadence
+        from ``ct_snapshot_interval`` + ``Daemon.start()`` (the
+        periodic snapshot controller); tests and the bench drive it
+        explicitly."""
+        for n in self.nodes:
+            if n.alive:
+                n.daemon.ct_snapshot_now(trigger)
+
+    def wait_identity(self, numeric: int,
+                      timeout: Optional[float] = None) -> bool:
+        """Block until every live replica's allocator mirrors the
+        identity (the kvstore convergence window made testable)."""
+        timeout = (self.convergence_deadline_s if timeout is None
+                   else timeout)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(n.daemon.allocator.lookup_by_id(numeric)
+                   is not None for n in self.nodes if n.alive):
+                return True
+            time.sleep(0.005)
+        return False
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self, trace_sample: int = 0, packed: bool = True,
+              ring_capacity: int = 1 << 15, drain_every: int = 4,
+              span_sample: Optional[int] = None) -> None:
+        if self._started:
+            raise ServingError("cluster already started")
+        for n in self.nodes:
+            n.daemon.start_serving(ring_capacity=ring_capacity,
+                                   drain_every=drain_every,
+                                   trace_sample=trace_sample,
+                                   ingress=True, packed=packed,
+                                   span_sample=span_sample)
+        self.router = ClusterRouter(self.nodes, self.forward_depth,
+                                    on_overflow=self._surface_overflow)
+        self.router.start()
+        self.membership.start()
+        self._started = True
+
+    def submit(self, rows: np.ndarray) -> int:
+        # (the cluster tier's enqueue entry; the annotated router
+        # hot path is ClusterRouter._route)
+        r = self.router
+        if r is None:
+            raise ServingError("call ClusterServing.start() first")
+        return r.submit(rows)
+
+    def stop(self) -> dict:
+        """Drain the router and every replica; returns (and retains)
+        the final cluster stats with the ledger closed."""
+        if self._stopped:
+            return self._final or self.stats()
+        self.membership.stop()
+        if self.router is not None:
+            self.router.stop(drain=True)
+        for n in self.nodes:
+            # a crashed node's stop_serving is idempotent over the
+            # corpse: its runtime snapshot (swept queue included)
+            # is what the ledger reads
+            n.final = n.daemon.stop_serving()
+        self._stopped = True
+        self._final = self.stats()
+        return self._final
+
+    def shutdown(self) -> None:
+        self.stop()
+        for n in self.nodes:
+            if n.policy_sync is not None:
+                n.policy_sync.close()
+            n.daemon.shutdown()
+            if n.kv_client is not None:
+                n.kv_client.close()
+        if self._kv_server is not None:
+            self._kv_server.close()
+
+    # -- death handling -------------------------------------------------
+    def _on_node_death(self, name: str, detail: dict) -> None:
+        # thread-affinity: api -- membership prober thread
+        self.failover.fail_over(name, detail)
+
+    def kill_node(self, name: str) -> None:
+        """Crash a node and let the HEALTH path find it (probe
+        failures -> death threshold -> failover) — the organic-death
+        shape."""
+        self.node(name).crash("operator kill_node")
+
+    def fail_node(self, name: str) -> dict:
+        """Crash a node and fail it over immediately (deterministic
+        test/bench path — no probe latency in the measurement)."""
+        t0 = time.monotonic()
+        self.node(name).crash("operator fail_node")
+        self.membership.declare_dead(name, {
+            "cause": "operator fail_node",
+            "detect-ms": round((time.monotonic() - t0) * 1e3, 3)})
+        recs = self.failover.snapshot()
+        return recs[-1] if recs else {}
+
+    # -- shed surfacing -------------------------------------------------
+    def _surface_overflow(self, idx: int,
+                          rows: Optional[np.ndarray],
+                          count: int) -> None:
+        # thread-affinity: router, api
+        """Router sheds -> REASON_CLUSTER_OVERFLOW metricsmap counts
+        + decoded monitor DROP events, on the owning node (or, when
+        it died, the first live node — the count must land
+        SOMEWHERE operators look)."""
+        node = self.nodes[idx]
+        if not node.alive:
+            node = next((n for n in self.nodes if n.alive), None)
+        if node is None:
+            return  # cluster-wide corpse: router_overflow holds the
+            # exact count; there is no live surface left to decorate
+        node.daemon._publish_cluster_drops(rows, count)
+
+    # -- reading --------------------------------------------------------
+    def router_overflow_total(self) -> int:
+        r = self.router
+        return r.router_overflow if r is not None else 0
+
+    def failover_dropped_total(self) -> int:
+        r = self.router
+        return r.failover_dropped if r is not None else 0
+
+    def failovers_total(self) -> int:
+        return len(self.failover.snapshot())
+
+    def live_dead_counts(self):
+        live = sum(1 for n in self.nodes if n.alive)
+        return live, self.n_nodes - live
+
+    def forward_pending(self) -> int:
+        r = self.router
+        return r.pending_total() if r is not None else 0
+
+    def summary(self) -> dict:
+        """The serving-stats Cluster block: cheap counters only (no
+        per-node stats recursion — this renders inside every node's
+        own serving_stats)."""
+        live, dead = self.live_dead_counts()
+        recs = self.failover.snapshot()
+        out = {
+            "nodes": self.n_nodes,
+            "live": live,
+            "dead": dead,
+            "kvstore": self.kvstore_mode,
+            "router": (self.router.snapshot()
+                       if self.router is not None else None),
+            "failovers": len(recs),
+        }
+        if recs:
+            out["last-failover"] = recs[-1]
+        return out
+
+    def per_node_stats(self) -> Dict[str, dict]:
+        out = {}
+        for n in self.nodes:
+            if n.final is not None:
+                fe = n.final.get("front-end")
+            else:
+                s = n.daemon._serving
+                rt = s.get("runtime") if s is not None else None
+                fe = rt.snapshot() if rt is not None else None
+            out[n.name] = {
+                "alive": n.alive,
+                "mode": n.mode(),
+                "front-end": fe,
+            }
+        return out
+
+    def ledger(self) -> dict:
+        """The cluster-wide no-silent-loss ledger.  ``exact`` is
+        meaningful after :meth:`stop` (while running, rows in
+        forward/admission queues and in flight sit outside every
+        counter, mirroring the node-level ledger's contract)."""
+        r = self.router
+        submitted = r.submitted if r is not None else 0
+        overflow = r.router_overflow if r is not None else 0
+        fo_dropped = r.failover_dropped if r is not None else 0
+        pending = r.pending_total() if r is not None else 0
+        per_node = 0
+        for name, st in self.per_node_stats().items():
+            fe = st.get("front-end")
+            if fe is None:
+                continue
+            ft = fe.get("fault-tolerance", {})
+            per_node += (fe.get("verdicts", 0) + fe.get("shed", 0)
+                         + ft.get("recovery-dropped", 0))
+        accounted = per_node + overflow + fo_dropped + pending
+        return {
+            "submitted": submitted,
+            "per-node-accounted": per_node,
+            "router-overflow": overflow,
+            "failover-dropped": fo_dropped,
+            "forward-pending": pending,
+            "accounted": accounted,
+            "exact": submitted == accounted,
+        }
+
+    def stats(self) -> dict:
+        return {
+            "cluster": self.summary(),
+            "membership": self.membership.statuses(),
+            "per-node": self.per_node_stats(),
+            "ledger": self.ledger(),
+            "failovers": self.failover.snapshot(),
+        }
+
+    def status(self) -> dict:
+        """GET /cluster/status — the operator view (`cilium-tpu
+        cluster status`)."""
+        return self.stats()
+
+
+def start_cluster_serving(nodes: int = 3, config=None,
+                          trace_sample: int = 0, packed: bool = True,
+                          ring_capacity: int = 1 << 15,
+                          drain_every: int = 4,
+                          node_prefix: str = "node") -> ClusterServing:
+    """Build AND start a cluster serving tier in one call (the
+    ``Daemon.start_serving`` analogue one level up): N replicas, one
+    shared kvstore plane, the flow-affine router, membership, and
+    failover — ready for :meth:`ClusterServing.submit`."""
+    c = ClusterServing(nodes=nodes, config=config,
+                       node_prefix=node_prefix)
+    c.start(trace_sample=trace_sample, packed=packed,
+            ring_capacity=ring_capacity, drain_every=drain_every)
+    return c

@@ -1,0 +1,132 @@
+"""Node failover: drain a dead replica's flows onto a designated
+peer with CT continuity.
+
+Reference: upstream cilium survives node loss because connection
+state lives WITH the flow's owner and ECMP re-steers; a stateful
+serving tier must migrate that state explicitly.  This module extends
+the PR 3 demotion proof (sharded -> single CT carry via snapshot +
+restore) to NODE DEATH:
+
+1. the dead node is crash-stopped (its queued rows become counted
+   recovery drops in ITS OWN ledger — a crash loses work, it never
+   hides work);
+2. a designated peer is chosen (next live node in ring order — the
+   same deterministic choice a rendezvous hash would make for the
+   freed slot);
+3. the dead node's latest retained CT snapshot is REPLAYED into the
+   peer, MERGED with the peer's own live CT (snapshot + concat +
+   ``ct_restore``: flow-affine routing guarantees the two tables are
+   disjoint, and the device re-hash resolves any residue) — so a
+   reply for a connection established on the dead node passes the
+   peer's egress enforcement through the CT fast path, exactly like
+   a demotion survivor;
+4. the router re-pins the dead node's slots and migrates its queued
+   chunks; rows the peer cannot absorb are counted
+   ``failover_dropped``;
+5. the whole episode is a named ``node-failover`` incident on the
+   peer (flight recorder: sysdump bundle with ledger + membership
+   state), and the blackout/detect latencies land in cluster stats
+   for the bench to report.
+
+In-process deployment note: when the dead node never took a snapshot
+(no periodic cadence configured), the orchestrator falls back to
+reading the dead daemon's device CT directly — possible here because
+"nodes" are threads sharing the host; a multi-host deployment gets
+that only from the replicated snapshot artifact (DIVERGENCES:
+threads-as-nodes).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class FailoverOrchestrator:
+    """Owns the failover sequence + the failover record history.
+    Driven by membership's ``on_death`` (prober thread) or directly
+    by ``ClusterServing.fail_node`` — control-plane contexts both."""
+
+    # guarded-by: _lock: records
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._lock = threading.Lock()
+        self.records: List[dict] = []
+
+    def fail_over(self, dead_name: str,
+                  detail: Optional[dict] = None) -> dict:
+        # thread-affinity: api
+        """Run the full sequence for ``dead_name``; returns the
+        failover record.  Idempotent per node: a second call for the
+        same node only crash-stops it again (no-op) and re-pins
+        nothing new."""
+        c = self._cluster
+        t0 = time.monotonic()
+        dead = c.node(dead_name)
+        dead.crash("declared dead by cluster membership")
+        peer = c.designated_peer(dead.idx)
+        ct_entries = 0
+        if peer is not None:
+            rows = self._dead_ct_rows(dead)
+            ct_entries = int(len(rows))
+            if ct_entries:
+                # merge, not replace: the peer keeps its own live
+                # flows AND inherits the dead node's.  ct_restore
+                # re-hashes the union at the peer's capacity.
+                merged = np.concatenate([
+                    peer.daemon.loader.ct_snapshot(),
+                    np.asarray(rows)])
+                peer.daemon.loader.ct_restore(merged)
+        moved = c.router.fail_over(dead.idx,
+                                   peer.idx if peer is not None
+                                   else None)
+        rec = {
+            "dead": dead_name,
+            "peer": peer.name if peer is not None else None,
+            "blackout-ms": round((time.monotonic() - t0) * 1e3, 3),
+            "detect-ms": (detail or {}).get("detect-ms"),
+            "cause": (detail or {}).get("cause", ""),
+            "ct-replayed-entries": ct_entries,
+            "moved-rows": moved["moved"],
+            "dropped-rows": moved["dropped"],
+            "at": time.time(),
+        }
+        with self._lock:
+            self.records.append(rec)
+        if peer is not None:
+            from ..obs.flightrec import KIND_NODE_FAILOVER
+
+            # the incident lands on the PEER (the dead node's flight
+            # recorder died with it); capture runs on the recorder's
+            # capture thread, never this one
+            peer.daemon.record_incident(KIND_NODE_FAILOVER, rec)
+        return rec
+
+    @staticmethod
+    def _dead_ct_rows(dead) -> np.ndarray:
+        # thread-affinity: api
+        """The dead node's latest retained CT snapshot; in-process
+        fallback reads the corpse's device CT directly (module doc)."""
+        snap = dead.daemon._ct_snap
+        if snap is not None:
+            return snap["rows"]
+        try:
+            return dead.daemon.loader.ct_snapshot()
+        except Exception:  # noqa: BLE001 — an unreadable corpse CT
+            # degrades to an empty replay: pre-failover connections
+            # then re-establish instead of resuming (counted by the
+            # policy plane, never silent)
+            import numpy as _np
+
+            from ..datapath.conntrack import ROW_WORDS
+
+            return _np.zeros((0, ROW_WORDS), dtype=_np.uint32)
+
+    def snapshot(self) -> List[dict]:
+        # thread-affinity: any
+        with self._lock:
+            return [dict(r) for r in self.records]

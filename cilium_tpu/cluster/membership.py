@@ -1,0 +1,294 @@
+"""Cluster membership: node liveness on top of the health plane, and
+kvstore-propagated policy so every replica converges on one ruleset.
+
+Reference: upstream cilium-health probes every registered node and
+``clustermesh-apiserver`` / kvstoremesh fan cluster state through the
+kvstore.  Here the node registry + probe mesh (``health/``) already
+exist; this module adds the two cluster-serving pieces on top:
+
+- :class:`ClusterMembership` — a periodic liveness sweep over the
+  node replicas with a DEATH THRESHOLD (consecutive failed probes)
+  and an exactly-once ``on_death`` hook the failover orchestrator
+  hangs off.  The probe site (``infra/faults.py`` ``cluster.probe``)
+  makes node death INJECTABLE and deterministic: an armed
+  ``cluster.probe=1x1@K`` fault CRASHES the K-th probed node (probe
+  order is fixed), after which the health-driven path detects and
+  fails it over exactly as it would a organic death.
+- :class:`ClusterPolicySync` — policy rules ride the same kvstore
+  plane identities replicate over (``cilium/state/policy/v1``):
+  ``publish`` bumps a revision, every node's watch applies it once
+  (including the publisher's own — exactly-once via the revision
+  guard), so all replicas enforce the same ruleset within the
+  convergence window the kvstore transport provides.
+
+THREAD AFFINITY NOTE: the prober runs on its own thread, declared
+``api`` — the annotation vocabulary's control-plane family (API
+handlers, CLI, tests' main thread, and now cluster orchestration).
+Failover work it triggers (CT replay, runtime kill, router re-pin)
+is control-plane work and reuses the ``api``-declared surfaces
+(``ct_restore``, ``runtime.stop`` ...) without widening them.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..infra import faults
+
+POLICY_PREFIX = "cilium/state/policy/v1"
+POLICY_KEY = f"{POLICY_PREFIX}/rules"
+
+
+class ClusterMembership:
+    """Liveness sweep + death detection over the node replicas.
+
+    ``on_death(name, detail)`` fires EXACTLY ONCE per node, from the
+    prober thread (or the caller's thread via
+    :meth:`declare_dead`)."""
+
+    # guarded-by: _lock: _failures, _dead, _first_fail, _latency_ms,
+    # guarded-by: _lock: _probes
+
+    def __init__(self, nodes: Sequence,
+                 probe_interval_s: float,
+                 death_threshold: int,
+                 on_death: Callable[[str, dict], None],
+                 node_registry=None):
+        self.nodes = list(nodes)
+        self.probe_interval_s = float(probe_interval_s)
+        self.death_threshold = int(death_threshold)
+        if self.probe_interval_s <= 0:
+            raise ValueError("probe_interval_s must be > 0")
+        if self.death_threshold < 1:
+            raise ValueError("death_threshold must be >= 1")
+        self._on_death = on_death
+        self._registry = node_registry
+        self._lock = threading.Lock()
+        self._failures: Dict[str, int] = {}
+        self._first_fail: Dict[str, float] = {}
+        self._latency_ms: Dict[str, float] = {}
+        self._dead: Dict[str, dict] = {}
+        self._probes = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        # thread-affinity: api
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._probe_loop,
+                                        daemon=True,
+                                        name="cluster-membership")
+        self._thread.start()
+
+    def stop(self) -> None:
+        # thread-affinity: api
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(5.0)
+            self._thread = None
+
+    # -- probing -------------------------------------------------------
+    def _probe_loop(self) -> None:
+        # thread-affinity: api -- the membership prober is a
+        # control-plane thread (see module doc)
+        while not self._stop.wait(self.probe_interval_s):
+            self.probe_all()
+
+    def probe_all(self) -> None:
+        # thread-affinity: api
+        """One sweep: probe every not-yet-dead node in fixed order.
+        The ``cluster.probe`` fault site fires per probe; an injected
+        fault CRASHES the probed node (deterministic node death for
+        chaos tests) and the probe records the failure."""
+        for node in self.nodes:
+            with self._lock:
+                if node.name in self._dead:
+                    continue
+                self._probes += 1
+            ok, err = True, ""
+            t0 = time.perf_counter()
+            try:
+                faults.check(faults.SITE_CLUSTER_PROBE)
+                ok = bool(node.probe())
+                if not ok:
+                    err = "probe returned unhealthy"
+            except faults.InjectedFault as e:
+                node.crash(f"injected node death ({e})")
+                ok, err = False, str(e)
+            except Exception as e:  # noqa: BLE001 — a probe transport
+                ok, err = False, f"{type(e).__name__}: {e}"  # fault
+            latency_ms = (time.perf_counter() - t0) * 1e3
+            declare = None
+            with self._lock:
+                self._latency_ms[node.name] = round(latency_ms, 3)
+                if ok:
+                    self._failures[node.name] = 0
+                    self._first_fail.pop(node.name, None)
+                    continue
+                n = self._failures.get(node.name, 0) + 1
+                self._failures[node.name] = n
+                self._first_fail.setdefault(node.name,
+                                            time.monotonic())
+                if n >= self.death_threshold:
+                    declare = {
+                        "cause": err[:200],
+                        "consecutive-failures": n,
+                        "detect-ms": round(
+                            (time.monotonic()
+                             - self._first_fail[node.name]) * 1e3, 3),
+                    }
+            if declare is not None:
+                self.declare_dead(node.name, declare)
+
+    def declare_dead(self, name: str, detail: Optional[dict] = None
+                     ) -> bool:
+        # thread-affinity: api
+        """Mark ``name`` dead and fire ``on_death`` exactly once.
+        Returns False when the node was already declared (the hook
+        does not re-fire)."""
+        detail = dict(detail or {})
+        with self._lock:
+            if name in self._dead:
+                return False
+            detail.setdefault("declared-at", time.time())
+            self._dead[name] = detail
+        if self._registry is not None:
+            try:
+                self._registry.annotate(name, {"cluster-state": "dead"})
+            except Exception:  # noqa: BLE001 — registry annotation is
+                pass  # advisory; death handling must not die on it
+        try:
+            self._on_death(name, detail)
+        except Exception:  # noqa: BLE001 — a failing failover (e.g.
+            # a crash-stop join timing out behind a wedged compile)
+            # must not kill the prober thread: LATER node deaths
+            # still have to be detected, and the failure must be
+            # loud — this is an incident, not steady state
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "cluster failover for %s failed", name)
+        return True
+
+    # -- reading -------------------------------------------------------
+    def is_dead(self, name: str) -> bool:
+        # thread-affinity: any
+        with self._lock:
+            return name in self._dead
+
+    def dead_nodes(self) -> List[str]:
+        # thread-affinity: any
+        with self._lock:
+            return sorted(self._dead)
+
+    def statuses(self) -> List[dict]:
+        # thread-affinity: any
+        with self._lock:
+            out = []
+            for node in self.nodes:
+                d = self._dead.get(node.name)
+                out.append({
+                    "name": node.name,
+                    "state": "dead" if d is not None else "live",
+                    "consecutive-failures":
+                        self._failures.get(node.name, 0),
+                    "probe-latency-ms":
+                        self._latency_ms.get(node.name),
+                    **({"death": d} if d is not None else {}),
+                })
+            return out
+
+
+class ClusterPolicySync:
+    """One node's end of the kvstore policy plane: watch the policy
+    key, apply each revision exactly once (the publisher applies its
+    own write through the same watch — no special-casing).
+
+    Application is DEFERRED to a dedicated applier thread, never run
+    on the kvstore client's watch-dispatcher thread: a policy import
+    regenerates every endpoint, which takes the allocator lock — and
+    a caller holding that lock inside ``allocate()`` is itself
+    waiting for an identity watch-mirror event that only the SAME
+    single dispatcher thread can deliver.  Inline application
+    deadlocks the node; the applier thread breaks the cycle (the
+    dispatcher only parses + parks)."""
+
+    # guarded-by: _lock: _applied_rev, _pending
+
+    def __init__(self, kv, daemon):
+        self._daemon = daemon
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._applied_rev = 0
+        self._pending = None  # newest unapplied (rev, rules)
+        self._thread = threading.Thread(target=self._apply_loop,
+                                        daemon=True,
+                                        name="cluster-policy-sync")
+        self._thread.start()
+        self._cancel = kv.watch_prefix(POLICY_KEY, self._on_event,
+                                       replay=True)
+
+    def _on_event(self, ev) -> None:
+        # thread-affinity: any -- kvstore watch dispatcher thread:
+        # parse + park ONLY (see class doc)
+        if ev.kind == "delete":
+            return
+        try:
+            body = json.loads(ev.value.decode())
+            rev = int(body["rev"])
+            rules = body["rules"]
+        except (ValueError, KeyError, TypeError):
+            return  # a malformed publish must not kill the watcher
+        with self._lock:
+            if rev <= self._applied_rev or (
+                    self._pending is not None
+                    and rev <= self._pending[0]):
+                return
+            self._pending = (rev, rules)
+        self._wake.set()
+
+    def _apply_loop(self) -> None:
+        # thread-affinity: api -- the policy applier is a
+        # control-plane thread of its own
+        while True:
+            self._wake.wait()
+            if self._stop.is_set():
+                return
+            with self._lock:
+                pending, self._pending = self._pending, None
+                self._wake.clear()
+            if pending is None:
+                continue
+            rev, rules = pending
+            try:
+                self._daemon.policy_import(rules)
+            except Exception:  # noqa: BLE001 — one bad ruleset must
+                continue  # not kill the sync plane (rev not applied)
+            with self._lock:
+                self._applied_rev = max(self._applied_rev, rev)
+
+    @property
+    def applied_rev(self) -> int:
+        with self._lock:
+            return self._applied_rev
+
+    def close(self) -> None:
+        self._cancel()
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(5.0)
+
+
+def publish_policy(kv, rev: int, rules) -> None:
+    """Publisher side: write revision ``rev`` of the cluster ruleset
+    (every node's :class:`ClusterPolicySync` applies it once)."""
+    kv.update(POLICY_KEY,
+              json.dumps({"rev": int(rev), "rules": rules}).encode())

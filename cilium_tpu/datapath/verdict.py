@@ -80,7 +80,14 @@ REASON_DISPATCH_TIMEOUT = 10
 # instead of silently vanishing — admitted traffic is ALWAYS one of
 # completed / shed / recovery-dropped (serving/runtime.py invariant)
 REASON_RECOVERY_DROP = 11
-N_REASONS = 12
+# cluster front-end router shed (cilium_tpu/cluster/router.py): a
+# node replica's bounded forward queue was full, so the packet never
+# reached that node's admission queue.  Host-synthesized like
+# INGRESS_OVERFLOW, one level further out — the cluster tier's entry
+# in the cluster-wide ledger (submitted == per-node accounted
+# + router_overflow + failover_dropped).
+REASON_CLUSTER_OVERFLOW = 12
+N_REASONS = 13
 
 # Event types in the out tensor (monitor vocabulary).
 EV_TRACE = 0  # TraceNotify: forwarded established/reply traffic

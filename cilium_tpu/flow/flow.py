@@ -45,6 +45,7 @@ DROP_REASON_DESC = {
     9: "INGRESS_QUEUE_OVERFLOW",  # serving admission shed (XDP ring)
     10: "DISPATCH_TIMEOUT",  # serving watchdog deadlined a hung dispatch
     11: "RECOVERY_DROP",  # serving recovery accounted a lost batch
+    12: "CLUSTER_ROUTER_OVERFLOW",  # cluster forward queue full
 }
 
 

@@ -121,7 +121,7 @@ VERDICT_WIRE_TO_INTERNAL = {1: (1,), 2: (0, 2), 5: (3,)}
 # Reasons without an upstream value travel as 0 (UNKNOWN) on the wire
 # while the JSON surface keeps the precise name.
 DROP_REASON_WIRE = {1: 133, 2: 133, 3: 0, 4: 0, 5: 0, 6: 0, 7: 0,
-                    8: 0, 9: 0, 10: 0, 11: 0}
+                    8: 0, 9: 0, 10: 0, 11: 0, 12: 0}
 
 # enum FlowType
 FLOW_TYPE_L3_L4 = 1

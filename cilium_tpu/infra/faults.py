@@ -78,6 +78,12 @@ SITE_RING_COLLECT = "ring.collect"
 # under its budget); a ``~S`` hang stalls the join plane so windows
 # pile up against the bounded queue (overflow accounting).
 SITE_EVENT_JOIN = "eventplane.join"
+# cluster/membership.py — fired per node probe (fixed sweep order):
+# a raise CRASHES the probed node (its serving runtime is
+# crash-stopped, queued rows counted) and fails the probe, so
+# ``cluster.probe=1x1@K`` is a deterministic "kill the K-th probed
+# node" — the injected-node-death entry for cluster failover chaos.
+SITE_CLUSTER_PROBE = "cluster.probe"
 
 SITES = frozenset({
     SITE_SERVING_DISPATCH,
@@ -88,6 +94,7 @@ SITES = frozenset({
     SITE_RING_SWAP,
     SITE_RING_COLLECT,
     SITE_EVENT_JOIN,
+    SITE_CLUSTER_PROBE,
 })
 
 

@@ -189,6 +189,17 @@ class _Conn:
         for cancel in self._watches.values():
             cancel()
         self._watches.clear()
+        # shutdown BEFORE close: this conn's reader thread is blocked
+        # in recv() on the same fd, and POSIX close() neither wakes it
+        # nor sends FIN while the fd is pinned in that syscall — so a
+        # killed server's clients would never see EOF, and their
+        # watches would stay silently dead until their next RPC (an
+        # idle watch-only replica missing every event across a
+        # failover).  shutdown() delivers both halves immediately.
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self.sock.close()
         except OSError:
@@ -234,6 +245,17 @@ class KVStoreServer:
                 sock, _ = self._sock.accept()
             except OSError:
                 return
+            if self._closed:
+                # close() raced an in-flight accept: the kernel can
+                # hand us one last connection — refusing it here is
+                # what makes a "killed" server actually dead (a
+                # zombie acceptor would capture failover clients'
+                # watch re-subscriptions onto the corpse's store)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1) \
                 if self.address[0] == "tcp" else None
             self._conns.add(_Conn(self, sock))
@@ -246,6 +268,18 @@ class KVStoreServer:
 
     def close(self) -> None:
         self._closed = True
+        # shutdown BEFORE close: the accept loop is blocked in
+        # accept() on this fd, and close() alone neither wakes it nor
+        # releases the listening socket while the fd is pinned in
+        # that syscall — the "killed" server would keep ACCEPTING,
+        # and a failover client re-dialing its address list would
+        # reconnect to the corpse (and re-subscribe its watches onto
+        # a store nobody mutates any more).  shutdown() fails the
+        # blocked accept immediately.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:
@@ -551,6 +585,14 @@ class RemoteKVStore:
         self._closed = True
         self._connected.set()
         self._events.put(None)
+        try:
+            if self._sock is not None:
+                # same shutdown-before-close as _Conn.close: the
+                # reader thread is blocked in recv() on this fd and
+                # plain close() would leave it wedged forever
+                self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             if self._sock is not None:
                 self._sock.close()

@@ -69,6 +69,7 @@ DROP_REASON_NAMES = {
     9: "Ingress queue overflow",  # serving admission shed (XDP ring)
     10: "Dispatch deadline exceeded",  # watchdog deadlined a hung dispatch
     11: "Recovery drop",  # serving recovery accounted a lost batch
+    12: "Cluster router overflow",  # cluster forward queue full (router shed)
 }
 
 

@@ -45,10 +45,11 @@ from ..datapath.verdict import EV_TRACE, N_OUT, OUT_EVENT
 #   w0: verdict(0..2) | event(3..4) | reason(5..8) | ct(9..11)
 #       | proxy_idx(12..15) | id_row(16..31)
 #   w1: pkt_idx(0..18) | batch(19..31, wraps)
-# The 4-bit reason field holds codes 0..15.  N_REASONS is 12 —
-# REASON_DISPATCH_TIMEOUT (10) and REASON_RECOVERY_DROP (11) are
-# RESERVED for the serving recovery plane (host-synthesized, so they
-# never transit this ring today, but the wire width must cover them:
+# The 4-bit reason field holds codes 0..15.  N_REASONS is 13 —
+# REASON_DISPATCH_TIMEOUT (10), REASON_RECOVERY_DROP (11) and
+# REASON_CLUSTER_OVERFLOW (12) are RESERVED for the serving recovery
+# and cluster routing planes (host-synthesized, so they never transit
+# this ring today, but the wire width must cover them:
 # a drained row's reason decodes through the same DROP_REASON_NAMES
 # table).  4 codes (12..15) remain before the field must widen.
 # Limits (asserted where they bind): id_row < 2^16, pkt_idx < 2^19

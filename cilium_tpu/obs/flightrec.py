@@ -51,6 +51,10 @@ KIND_RESTART = "watchdog-restart"
 KIND_TERMINAL = "watchdog-terminal"
 KIND_DEMOTION = "ladder-demotion"
 KIND_EVENTWORKER = "eventworker-terminal"
+# a cluster node replica died and its flows were failed over onto a
+# designated peer (CT snapshot replayed, router re-pinned); recorded
+# on the PEER — the dead node's recorder died with it
+KIND_NODE_FAILOVER = "node-failover"
 KIND_MANUAL = "manual"
 
 # required top-level bundle keys (scripts/check_sysdump_schema.py

@@ -283,6 +283,41 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                            if (w := eventplane()) is not None
                            else None))
 
+    # -- clustermesh serving tier (cilium_tpu/cluster): per-node
+    # series for the tier the node belongs to.  Collectors read the
+    # daemon's _cluster back reference live — None (not a cluster
+    # member) omits the whole family.  CTA008 pins every router drop
+    # counter to a series here ------------------------------------------
+    def cl(fn):
+        c = daemon._cluster
+        return None if c is None else fn(c)
+
+    reg.counter("cilium_cluster_submitted_total",
+                "packets offered to the cluster front-end router",
+                lambda: cl(lambda c: (c.router.submitted
+                                      if c.router is not None
+                                      else None)))
+    reg.counter("cilium_cluster_router_overflow_total",
+                "packets shed at the router's bounded per-node "
+                "forward queues (REASON_CLUSTER_OVERFLOW)",
+                lambda: cl(lambda c: c.router_overflow_total()))
+    reg.counter("cilium_cluster_failover_dropped_total",
+                "packets lost migrating a dead node's forward queue "
+                "onto its failover peer",
+                lambda: cl(lambda c: c.failover_dropped_total()))
+    reg.counter("cilium_cluster_failovers_total",
+                "completed node failovers (CT replay + router re-pin)",
+                lambda: cl(lambda c: c.failovers_total()))
+    reg.gauge("cilium_cluster_nodes",
+              "cluster node replicas by liveness",
+              lambda: cl(lambda c: [
+                  ({"state": "live"}, c.live_dead_counts()[0]),
+                  ({"state": "dead"}, c.live_dead_counts()[1])]))
+    reg.gauge("cilium_cluster_forward_pending",
+              "rows queued in the router's forward queues "
+              "(live at scrape time)",
+              lambda: cl(lambda c: c.forward_pending()))
+
     # -- fault-tolerance plane ----------------------------------------
     reg.counter("cilium_serving_restarts_total",
                 "drain-loop restarts spent by the serving watchdog",

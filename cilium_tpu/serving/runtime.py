@@ -149,6 +149,7 @@ class ServingRuntime:
         self._error: Optional[str] = None  # drain-loop fault (the
         # watchdog clears it on recovery; terminal once the budget is
         # exhausted or when unsupervised)
+        self._killed = False  # kill() crash stop: terminal, no drain
         self._stop = threading.Event()
         # serializes submit() against stop()'s final drain: a chunk
         # offered after the drain swept the queue would sit there
@@ -267,7 +268,8 @@ class ServingRuntime:
             return accepted
 
     def _terminal(self) -> bool:
-        return not self._supervised or self.restarts >= self._budget
+        return (self._killed or not self._supervised
+                or self.restarts >= self._budget)
 
     def _gen_is(self, gen: int) -> bool:
         """Locked read of the drain-thread generation — the loop's
@@ -325,10 +327,28 @@ class ServingRuntime:
                                               name="serving-watchdog")
             self._watchdog.start()
 
+    def kill(self, cause: str, timeout: float = 60.0) -> dict:
+        # thread-affinity: api
+        """Simulated crash stop (chaos / cluster node death): no
+        drain — queued rows are swept as COUNTED recovery drops, the
+        runtime goes terminal (submit raises, the cause rides every
+        snapshot), and the returned snapshot closes the ledger over
+        the corpse.  The in-flight dispatch, if any, completes or is
+        accounted exactly as a stop() would."""
+        with self._submit_lock:
+            self._stop.set()  # producers bounce from here on; also
+            # parks the watchdog before it can clear the error below
+            self._killed = True
+        if self._error is None:
+            self._error = f"killed: {cause}"
+        return self.stop(drain=False, timeout=timeout)
+
     def stop(self, drain: bool = True, timeout: float = 60.0) -> dict:
         # thread-affinity: api
         """Stop the loop; with ``drain`` (default) every queued row is
         batched and dispatched before returning.  Idempotent.
+        ``drain=False`` never loses silently either: pending rows are
+        swept as counted recovery drops (the kill()/crash path).
 
         Raises :class:`ServingError` if the loop thread does not exit
         within ``timeout`` (e.g. stuck in a first-dispatch XLA
@@ -368,17 +388,18 @@ class ServingRuntime:
             gen = self._gen
         if inflight is not None:
             self._account_lost(inflight[2], timeout_flavor=False)
-        if drain and self._error is None:
+        if drain and self._error is None and not self._killed:
             # the loop thread has exited; dispatch stays serialized.
             while True:
                 batch = self.batcher.assemble(self.queue, force=True)
                 if batch is None:
                     break
                 self._dispatch_one(batch, gen)
-        elif self._error is not None:
-            # dead loop: the same fault would fire again — sweep the
-            # queue into counted recovery drops instead (no silent
-            # loss; the error rides the snapshot)
+        else:
+            # dead loop / crash stop: the same fault would fire again
+            # (or the operator asked for no drain) — sweep the queue
+            # into counted recovery drops instead (no silent loss;
+            # the error rides the snapshot)
             self._sweep_queue_as_recovery_drops()
         if self._prev_arrivals:
             t_done = time.monotonic()

@@ -16,9 +16,9 @@ import textwrap
 import pytest
 
 from cilium_tpu.analysis import Repo, repo_root, run_analysis
-from cilium_tpu.analysis import (affinity, guarded, hotpath, reasons,
-                                 registry_lint, sharding,
-                                 sysdump_lint)
+from cilium_tpu.analysis import (affinity, cluster_lint, guarded,
+                                 hotpath, reasons, registry_lint,
+                                 sharding, sysdump_lint)
 from cilium_tpu.analysis.annotations import extract_lock_map
 from cilium_tpu.analysis.callgraph import CallGraph
 from cilium_tpu.analysis.core import Baseline
@@ -264,6 +264,29 @@ class TestThreadAffinity:
         assert "drain" in am[("cilium_tpu/serving/runtime.py",
                               "ServingRuntime._loop_body")]
 
+    def test_cluster_router_annotations_present(self):
+        """ISSUE 8: the cluster tier's hot path declares the
+        ``router`` domain — deleting either annotation (the enqueue
+        path or the forwarder loop) fails here, and the CTA003
+        purity pass loses its roots."""
+        am = affinity.affinity_map(CallGraph(Repo(REPO)))
+        route = am[("cilium_tpu/cluster/router.py",
+                    "ClusterRouter._route")]
+        fwd = am[("cilium_tpu/cluster/router.py",
+                  "ClusterRouter._forward_loop")]
+        assert "router" in route and "router" in fwd
+        # the surfacing leg is router-reachable too (sheds decode on
+        # a node's monitor plane without leaving the domain)
+        assert "router" in am[("cilium_tpu/agent/daemon.py",
+                               "Daemon._publish_cluster_drops")]
+        # membership/failover are control-plane (api family), NOT
+        # router — failover's CT replay must never look like the
+        # enqueue hot path
+        assert "api" in am[("cilium_tpu/cluster/membership.py",
+                            "ClusterMembership._probe_loop")]
+        assert "api" in am[("cilium_tpu/cluster/failover.py",
+                            "FailoverOrchestrator.fail_over")]
+
 
 # ---------------------------------------------------------------------
 # CTA003 hot-path purity
@@ -328,6 +351,42 @@ class TestHotPath:
                 json.dumps({})
         """})
         assert hotpath.check(repo, CallGraph(repo)) == []
+
+    def test_router_domain_is_a_hot_path_root(self, tmp_path):
+        """ISSUE 8 satellite: the cluster router's enqueue path is a
+        CTA003 domain of its own — router-affine code is scanned
+        (and named as the router hot path), api-affine code is
+        not."""
+        repo = _mini_repo(tmp_path, {"m.py": """
+            import time
+
+            def enqueue():
+                # thread-affinity: router
+                time.sleep(0.1)
+
+            def failover():
+                # thread-affinity: api
+                time.sleep(1.0)
+        """})
+        fs = hotpath.check(repo, CallGraph(repo))
+        assert len(fs) == 1
+        assert "cluster router hot path" in fs[0].message
+        assert "time.sleep" in fs[0].message
+
+    def test_router_reaching_drain_only_code_flags_cta002(self,
+                                                          tmp_path):
+        repo = _mini_repo(tmp_path, {"m.py": """
+            def decode():
+                # thread-affinity: event-worker
+                pass
+
+            def forward_loop():
+                # thread-affinity: router
+                decode()
+        """})
+        fs = affinity.check(repo, CallGraph(repo))
+        assert len(fs) == 1 and fs[0].code == "CTA002"
+        assert "router" in fs[0].message
 
 
 # ---------------------------------------------------------------------
@@ -544,13 +603,74 @@ class TestFoldedCheckers:
         assert any("JSON" in b
                    for b in sysdump_lint.check_bundle(str(p)))
 
+    def test_undeclared_cluster_drop_counter_flags_cta008(
+            self, tmp_path):
+        """ISSUE 8 satellite: an ``*_overflow``/``*_dropped``
+        increment in cluster/ outside router.DROP_COUNTERS is an
+        uncounted drop site."""
+        repo = _mini_repo(tmp_path, {
+            "cluster/router.py": """
+                DROP_COUNTERS = ("router_overflow",)
+
+                class R:
+                    def drop(self, n):
+                        self.router_overflow += n      # declared: ok
+                        self.sneaky_dropped += n       # undeclared
+            """,
+            "obs/registry.py":
+                '_S = "cilium_cluster_router_overflow_total"',
+            "datapath/verdict.py": "REASON_CLUSTER_OVERFLOW = 12",
+            "monitor/api.py": "DROP_REASON_NAMES = {12: 'x'}",
+            "flow/flow.py": "DROP_REASON_DESC = {12: 'X'}",
+            "flow/proto.py": "DROP_REASON_WIRE = {12: 0}",
+        })
+        fs = cluster_lint.check(repo)
+        assert len(fs) == 1 and fs[0].code == "CTA008"
+        assert "sneaky_dropped" in fs[0].message
+
+    def test_missing_series_and_decode_flag_cta008(self, tmp_path):
+        repo = _mini_repo(tmp_path, {
+            "cluster/router.py":
+                'DROP_COUNTERS = ("failover_dropped",)',
+            "obs/registry.py": "# no series",
+            "datapath/verdict.py": "REASON_CLUSTER_OVERFLOW = 12",
+            "monitor/api.py": "DROP_REASON_NAMES = {12: 'x'}",
+            "flow/flow.py": "DROP_REASON_DESC = {11: 'stale'}",
+            "flow/proto.py": "DROP_REASON_WIRE = {12: 0}",
+        })
+        msgs = [f.message for f in cluster_lint.check(repo)]
+        assert any("cilium_cluster_failover_dropped_total" in m
+                   for m in msgs)
+        assert any("DROP_REASON_DESC" in m for m in msgs)
+        # the two present tables do NOT flag
+        assert not any("DROP_REASON_NAMES" in m for m in msgs)
+
+    def test_bench_schema_check_cta008(self, tmp_path):
+        import json
+
+        good = {k: 1 for k in cluster_lint.BENCH_CLUSTER_KEYS}
+        good["schema"] = cluster_lint.BENCH_SCHEMA
+        p = tmp_path / "BENCH_cluster.json"
+        p.write_text(json.dumps(good))
+        assert cluster_lint.check_bench(str(p)) == []
+        bad = dict(good)
+        del bad["failover_blackout_ms"]
+        bad["schema"] = "nope"
+        p.write_text(json.dumps(bad))
+        problems = cluster_lint.check_bench(str(p))
+        assert any("schema" in b for b in problems)
+        assert any("failover_blackout_ms" in b for b in problems)
+        p.write_text("{not json")
+        assert any("JSON" in b
+                   for b in cluster_lint.check_bench(str(p)))
+
     def test_shims_still_importable(self):
         """Old entry points survive as delegating shims — the
         contract test_obs_registry / test_flightrec import by path."""
         import importlib.util
 
         for name in ("check_metrics_registry", "check_sysdump_schema",
-                     "lint"):
+                     "check_cluster_ledger", "lint"):
             path = os.path.join(REPO, "scripts", f"{name}.py")
             spec = importlib.util.spec_from_file_location(name, path)
             mod = importlib.util.module_from_spec(spec)

@@ -78,15 +78,43 @@ class TestConfigPatch:
 
     def test_debug_profile_captures_trace(self, served, tmp_path):
         """The pprof analogue: /debug/profile runs the jax profiler
-        and returns the trace dir."""
+        and returns the trace dir.
+
+        LOAD-TOLERANT BY DESIGN (PR 6/7 tier-1 notes: passes
+        standalone, intermittently fails under full-suite load): the
+        jax profiler is PROCESS-GLOBAL and cannot nest, so under
+        tier-1 load a capture left mid-teardown by another test (or
+        this endpoint's own 409 window) makes a single-shot request
+        racy, and the trace's plugin directory is flushed
+        asynchronously after stop_trace.  The documented remedy is a
+        bounded retry on the request plus a bounded poll for the
+        artifact — the assertion itself (profiler ran, plugins dir
+        exists) is unchanged."""
         import os
+        import time
 
         d, c = served
-        out = c._request("GET",
-                         f"/debug/profile?seconds=0.1&dir={tmp_path}")
-        assert out["trace-dir"] == str(tmp_path)
-        # the profiler wrote its plugin directory structure
-        assert os.path.isdir(os.path.join(str(tmp_path), "plugins"))
+        out = None
+        for attempt in range(3):
+            try:
+                out = c._request(
+                    "GET",
+                    f"/debug/profile?seconds=0.1&dir={tmp_path}")
+                break
+            except APIError as e:
+                # 409: another capture in flight; 500: the global
+                # profiler was mid start/stop elsewhere — both clear
+                if e.status not in (409, 500) or attempt == 2:
+                    raise
+                time.sleep(0.3)
+        assert out is not None and out["trace-dir"] == str(tmp_path)
+        # the plugin directory write is async wrt stop_trace: poll
+        plugins = os.path.join(str(tmp_path), "plugins")
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline \
+                and not os.path.isdir(plugins):
+            time.sleep(0.05)
+        assert os.path.isdir(plugins)
 
     def test_cluster_health_404_without_kvstore(self, served):
         d, c = served

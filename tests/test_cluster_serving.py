@@ -1,0 +1,514 @@
+"""Clustermesh serving tier (ISSUE 8): N daemon replicas behind one
+flow-affine router, kvstore identity/policy propagation, CT-replay
+node failover.
+
+Acceptance:
+(a) flow affinity: a 4-tuple's forward and reply packets route to
+    ONE node, and failover re-pins EXACTLY the dead node's slots;
+(b) node-kill chaos (seeded via ``infra/faults.py`` ``cluster.probe``):
+    kill one of 3 replicas mid-load; the router re-pins its flows
+    onto the designated peer, the dead node's CT snapshot replays,
+    and a reply for a pre-failover connection passes EGRESS
+    enforcement on the peer (the PR 3 demotion proof extended to
+    node death);
+(c) the cluster-wide no-silent-loss ledger holds EXACTLY in every
+    test: submitted == per-node (verdicts + shed + recovery_dropped)
+    + router_overflow + failover_dropped.
+
+Discipline: ONE bucket rung (64) shared with the fault/chaos suites
+so XLA executables are compiled once per tier-1 run; every fault is
+seeded; progress is observed by bounded polling, never sleeps.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import DaemonConfig
+from cilium_tpu.cluster import (ClusterRouter, ClusterServing,
+                                start_cluster_serving,
+                                validate_cluster_config)
+from cilium_tpu.core import TCP_ACK, TCP_SYN, make_batch
+from cilium_tpu.core.packets import COL_DIR
+from cilium_tpu.datapath.verdict import REASON_CLUSTER_OVERFLOW
+from cilium_tpu.flow.flow import DROP_REASON_DESC
+from cilium_tpu.infra import faults
+from cilium_tpu.monitor.api import DROP_REASON_NAMES, MSG_DROP
+from cilium_tpu.parallel.mesh import flow_shard_ids
+
+pytestmark = pytest.mark.cluster
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }],
+}]
+
+# db egress-enforced: a db-sourced reply passes ONLY via the CT reply
+# fast path — the CT-continuity oracle for node failover (same
+# construction as the demotion proof in test_serving_faults.py)
+RULES_EGRESS_ENFORCED = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }],
+    "egress": [{
+        "toEndpoints": [{"matchLabels": {"app": "db"}}],
+        "toPorts": [{"ports": [{"port": "1", "protocol": "TCP"}]}],
+    }],
+}]
+
+
+def _config(**over):
+    cfg = dict(backend="tpu", ct_capacity=1 << 12,
+               flow_ring_capacity=1 << 13,
+               serving_queue_depth=4096,
+               serving_bucket_ladder=(64,),
+               serving_max_wait_us=500.0,
+               serving_restart_backoff_ms=1.0,
+               cluster_probe_interval_s=0.05,
+               cluster_death_threshold=2,
+               cluster_forward_depth=8192)
+    cfg.update(over)
+    return DaemonConfig(**cfg)
+
+
+def _cluster(nodes=3, rules=RULES, **over):
+    c = ClusterServing(nodes=nodes, config=_config(**over))
+    c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    rev = c.policy_import(rules)
+    assert c.wait_policy(rev), "policy failed to converge"
+    return c, db
+
+
+def _fwd(db_id, n=128, base=20000):
+    return make_batch([
+        dict(src="10.0.1.1", dst="10.0.2.1", sport=base + i,
+             dport=5432, proto=6, flags=TCP_SYN, ep=db_id, dir=0)
+        for i in range(n)]).data
+
+
+def _rep(db_id, n=128, base=20000):
+    return make_batch([
+        dict(src="10.0.2.1", dst="10.0.1.1", sport=5432,
+             dport=base + i, proto=6, flags=TCP_ACK, ep=db_id, dir=1)
+        for i in range(n)]).data
+
+
+def _wait(pred, timeout=60.0, tick=0.005):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+def _assert_cluster_ledger(stats):
+    """The cluster-wide no-silent-loss ledger, asserted EXACT (every
+    cluster test closes through here)."""
+    led = stats["ledger"]
+    assert led["exact"], (
+        f"cluster ledger broken: submitted {led['submitted']} != "
+        f"per-node {led['per-node-accounted']} + overflow "
+        f"{led['router-overflow']} + failover-dropped "
+        f"{led['failover-dropped']} + pending "
+        f"{led['forward-pending']}")
+    return led
+
+
+# ---------------------------------------------------------------------
+# router unit layer (fake nodes — no devices, no daemons)
+# ---------------------------------------------------------------------
+class _FakeNode:
+    def __init__(self, idx, accept=True):
+        self.idx = idx
+        self.name = f"fake{idx}"
+        self.alive = True
+        self.accept = accept
+        self.rows = []
+
+    def submit(self, rows):
+        if not self.accept:
+            raise RuntimeError("node refuses")
+        self.rows.append(np.array(rows, copy=True))
+        return len(rows)
+
+    def received(self):
+        return (np.concatenate(self.rows) if self.rows
+                else np.zeros((0, 16), dtype=np.uint32))
+
+
+class TestRouterUnit:
+    def test_flow_affinity_fwd_and_reply_same_node(self):
+        db_id = 7
+        fwd, rep = _fwd(db_id, n=256), _rep(db_id, n=256)
+        ids_f = flow_shard_ids(fwd, 3)
+        ids_r = flow_shard_ids(rep, 3)
+        assert (ids_f == ids_r).all(), "reply hashed off its node"
+        # and the hash actually spreads (a degenerate all-one-node
+        # hash would make the tier a fan-in, not a cluster)
+        assert len(np.unique(ids_f)) == 3
+
+    def test_router_delivers_by_slot_and_ledger_closes(self):
+        nodes = [_FakeNode(i) for i in range(3)]
+        r = ClusterRouter(nodes, forward_depth=4096)
+        r.start()
+        rows = _fwd(1, n=300)
+        admitted = r.submit(rows)
+        assert admitted == 300
+        assert _wait(lambda: r.pending_total() == 0, timeout=10)
+        snap = r.stop()
+        assert snap["submitted"] == 300
+        assert sum(snap["forwarded"]) == 300
+        assert snap["router-overflow"] == 0
+        ids = flow_shard_ids(rows, 3)
+        for i, n in enumerate(nodes):
+            assert len(n.received()) == int((ids == i).sum())
+
+    def test_overflow_sheds_counted_exactly(self):
+        surfaced = []
+        nodes = [_FakeNode(i) for i in range(2)]
+        # park the forwarders so the queue genuinely fills
+        for n in nodes:
+            n.alive = False
+        r = ClusterRouter(nodes, forward_depth=64,
+                          on_overflow=lambda i, rows, n:
+                          surfaced.append((i, n)))
+        r.start()
+        rows = _fwd(1, n=512)
+        admitted = r.submit(rows)
+        assert admitted <= 128  # 64 per node
+        assert r.router_overflow == 512 - admitted
+        for n in nodes:
+            n.alive = True
+        assert _wait(lambda: r.pending_total() == 0, timeout=10)
+        snap = r.stop()
+        assert (snap["submitted"]
+                == sum(snap["forwarded"]) + snap["router-overflow"])
+        assert sum(n for _i, n in surfaced) == snap["router-overflow"]
+
+    def test_failover_repins_only_dead_slots(self):
+        nodes = [_FakeNode(i) for i in range(3)]
+        nodes[1].alive = False  # parked: its queue retains chunks
+        r = ClusterRouter(nodes, forward_depth=4096)
+        r.start()
+        rows = _fwd(1, n=300)
+        ids = flow_shard_ids(rows, 3)
+        r.submit(rows)
+        # live nodes drain; node1's chunks sit in its queue
+        assert _wait(lambda: r.snapshot()["pending"][0] == 0
+                     and r.snapshot()["pending"][2] == 0, timeout=10)
+        moved = r.fail_over(1, 2)
+        assert moved["moved"] == int((ids == 1).sum())
+        assert moved["dropped"] == 0
+        assert r.snapshot()["slot-owner"] == [0, 2, 2]
+        assert _wait(lambda: r.pending_total() == 0, timeout=10)
+        snap = r.stop()
+        assert snap["failover-dropped"] == 0
+        # node2 now holds its own flows AND node1's; node0 untouched
+        assert len(nodes[0].received()) == int((ids == 0).sum())
+        assert len(nodes[2].received()) == int(((ids == 1)
+                                                | (ids == 2)).sum())
+        # post-failover traffic for the dead slot goes to the peer
+        more = _fwd(1, n=64)
+        r2 = ClusterRouter(nodes, forward_depth=4096)
+        with r2._cv:  # mirror the failed-over table
+            r2._slot_owner = [0, 2, 2]
+            r2._owner_arr = np.asarray([0, 2, 2])
+        ids2 = r2._owner_arr[flow_shard_ids(more, 3)]
+        assert not (ids2 == 1).any()
+
+    def test_failover_peer_overflow_is_failover_dropped(self):
+        nodes = [_FakeNode(i) for i in range(2)]
+        nodes[0].alive = False
+        nodes[1].alive = False
+        r = ClusterRouter(nodes, forward_depth=128)
+        r.start()
+        rows = _fwd(1, n=256)
+        admitted = r.submit(rows)
+        ids = flow_shard_ids(rows, 2)
+        n0 = min(int((ids == 0).sum()), 128)
+        moved = r.fail_over(0, 1)
+        # peer's queue already holds its own share; whatever does not
+        # fit is counted failover_dropped — never silent
+        assert moved["moved"] + moved["dropped"] == n0
+        assert r.failover_dropped == moved["dropped"]
+        nodes[1].alive = True
+        assert _wait(lambda: r.pending_total() == 0, timeout=10)
+        snap = r.stop()
+        assert (snap["submitted"] == sum(snap["forwarded"])
+                + snap["router-overflow"] + snap["failover-dropped"])
+        assert admitted == (sum(snap["forwarded"])
+                            + snap["failover-dropped"])
+
+    def test_validate_cluster_config_rejects_junk(self):
+        ok = validate_cluster_config(3, 1024, 0.5, 2, 5.0, "remote")
+        assert ok[0] == 3 and ok[5] == "remote"
+        with pytest.raises(ValueError, match="nodes"):
+            validate_cluster_config(0, 1024, 0.5, 2, 5.0, "remote")
+        with pytest.raises(ValueError, match="forward_depth"):
+            validate_cluster_config(3, 0, 0.5, 2, 5.0, "remote")
+        with pytest.raises(ValueError, match="probe_interval"):
+            validate_cluster_config(3, 1024, 0.0, 2, 5.0, "remote")
+        with pytest.raises(ValueError, match="death_threshold"):
+            validate_cluster_config(3, 1024, 0.5, 0, 5.0, "remote")
+        with pytest.raises(ValueError, match="kvstore"):
+            validate_cluster_config(3, 1024, 0.5, 2, 5.0, "etcd")
+
+
+# ---------------------------------------------------------------------
+# kvstore propagation (identity + policy over the REAL remote store)
+# ---------------------------------------------------------------------
+class TestKVStorePropagation:
+    def test_identity_and_policy_converge_across_replicas(self):
+        """An identity minted on one replica (and a policy published
+        once) reaches every replica over the networked kvstore within
+        the convergence deadline; endpoint ids agree everywhere."""
+        c, db = _cluster(nodes=3)
+        try:
+            # add_endpoint asserted id agreement already; now a LIVE
+            # mint on node0 must converge to node1/node2 by watch
+            from cilium_tpu.labels import LabelSet
+
+            ident = c.nodes[0].daemon.allocator.allocate(
+                LabelSet.parse("k8s:app=fresh-mint"))
+            assert c.wait_identity(ident.numeric_id), (
+                "identity did not reach every replica inside the "
+                "convergence deadline")
+            # policy: every replica applied rev 1 exactly once
+            revs = {n.name: n.policy_sync.applied_rev
+                    for n in c.nodes}
+            assert set(revs.values()) == {1}, revs
+            # and the repos themselves agree (one shared ruleset ->
+            # identical repository revisions everywhere)
+            repo_revs = {n.daemon.repo.revision for n in c.nodes}
+            assert len(repo_revs) == 1, repo_revs
+        finally:
+            c.shutdown()
+
+
+# ---------------------------------------------------------------------
+# the serving tier end to end
+# ---------------------------------------------------------------------
+class TestClusterServing:
+    def test_serve_spread_ledger_and_surfaces(self):
+        """Traffic spreads across all 3 replicas, the ledger closes
+        exactly, and the tier surfaces everywhere an operator looks:
+        serving-stats Cluster block, GET /cluster/status, the
+        cilium_cluster_* registry series."""
+        c, db = _cluster(nodes=3)
+        try:
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            rows = _fwd(db.id, n=192)
+            assert c.submit(rows) == 192
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= 192)
+            # every node saw its hash share
+            ids = flow_shard_ids(rows, 3)
+            for i, n in enumerate(c.nodes):
+                s = n.daemon._serving
+                rt = s.get("runtime")
+                assert rt.stats.verdicts == int((ids == i).sum())
+            # surfaces, before stop: Cluster block on EVERY node
+            for n in c.nodes:
+                blk = n.daemon.serving_stats()["cluster"]
+                assert blk["nodes"] == 3 and blk["live"] == 3
+                assert blk["router"]["submitted"] == 192
+            # registry series render on a member node
+            prom = c.nodes[0].daemon.registry.render()
+            assert "cilium_cluster_submitted_total 192" in prom
+            assert 'cilium_cluster_nodes{state="live"} 3' in prom
+            st = c.stop()
+            _assert_cluster_ledger(st)
+            assert st["ledger"]["submitted"] == 192
+        finally:
+            c.shutdown()
+
+    def test_cluster_status_api(self, tmp_path):
+        """GET /cluster/status answers from any member node's socket
+        (404 on a non-member)."""
+        from cilium_tpu.agent import Daemon
+        from cilium_tpu.api.client import APIClient, APIError
+        from cilium_tpu.api.server import APIServer
+
+        c, db = _cluster(nodes=2)
+        try:
+            sock = str(tmp_path / "cilium.sock")
+            srv = APIServer(c.nodes[0].daemon, sock)
+            srv.start()
+            try:
+                st = APIClient(sock).cluster_status()
+                assert st["cluster"]["nodes"] == 2
+                assert [m["state"] for m in st["membership"]] \
+                    == ["live", "live"]
+            finally:
+                srv.stop()
+            lone = Daemon(DaemonConfig(backend="interpreter"))
+            sock2 = str(tmp_path / "lone.sock")
+            srv2 = APIServer(lone, sock2)
+            srv2.start()
+            try:
+                with pytest.raises(APIError) as ei:
+                    APIClient(sock2).cluster_status()
+                assert ei.value.status == 404
+            finally:
+                srv2.stop()
+        finally:
+            c.shutdown()
+
+    def test_router_overflow_surfaces_as_decoded_drops(self):
+        """Router sheds are REASON_CLUSTER_OVERFLOW: counted in the
+        metricsmap AND decoded monitor->flow, with the cluster
+        ledger exact around them."""
+        assert REASON_CLUSTER_OVERFLOW in DROP_REASON_NAMES
+        assert REASON_CLUSTER_OVERFLOW in DROP_REASON_DESC
+        # a one-node cluster with a tiny forward queue: the submit
+        # burst overflows deterministically (the single drain loop
+        # cannot outrun one giant chunk)
+        c, db = _cluster(nodes=1, cluster_forward_depth=64)
+        got = []
+        c.nodes[0].daemon.monitor.register("t", got.append)
+        try:
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            rows = _fwd(db.id, n=512)
+            admitted = c.submit(rows)
+            assert admitted < 512  # the queue is 64 deep
+            overflow = c.router.router_overflow
+            assert overflow == 512 - admitted
+            st = c.stop()
+            led = _assert_cluster_ledger(st)
+            assert led["router-overflow"] == overflow
+            # surfaced: metricsmap count + decoded DROP events
+            m = c.nodes[0].daemon.loader.metrics()
+            assert int(m[REASON_CLUSTER_OVERFLOW, 0]) == overflow
+            drops = sum(
+                int((b.reason[b.msg_type == MSG_DROP]
+                     == REASON_CLUSTER_OVERFLOW).sum()) for b in got)
+            assert 0 < drops <= overflow  # retention-bounded rows,
+            # exact counter — the admission-shed contract
+        finally:
+            c.shutdown()
+
+
+# ---------------------------------------------------------------------
+# THE acceptance test: node-kill chaos with CT-replay failover
+# ---------------------------------------------------------------------
+class TestNodeKillChaos:
+    @pytest.mark.chaos
+    def test_node_kill_mid_load_repins_and_replays_ct(self):
+        """Kill one of 3 replicas mid-load via the seeded
+        ``cluster.probe`` fault site; the router re-pins its flows to
+        the designated peer, the CT snapshot replays, and a reply for
+        EVERY pre-failover connection passes egress enforcement on
+        the peer — ledger exact, node-failover incident on the
+        peer."""
+        c, db = _cluster(nodes=3, rules=RULES_EGRESS_ENFORCED)
+        got = []
+        for n in c.nodes:
+            n.daemon.monitor.register("t", got.append)
+        try:
+            c.start(trace_sample=1, packed=True,
+                    ring_capacity=1 << 10)
+            # establish 128 flows loss-free across the 3 replicas
+            rows = _fwd(db.id)
+            ids = flow_shard_ids(rows, 3)
+            assert c.submit(rows) == 128
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= 128)
+            c.snapshot_now()  # the periodic-cadence analogue
+            # mid-load: keep established traffic flowing while the
+            # injected probe fault kills whichever node the sweep
+            # probes next (seeded + x1 => exactly one node dies)
+            faults.arm("cluster.probe=1x1", seed=3)
+            sent = 128  # OFFERED rows (the ledger's submitted side;
+            # router overflow, if any, is accounted not admitted)
+            t0 = time.monotonic()
+            k = 0
+            while not c.membership.dead_nodes():
+                # mid-load traffic is FORWARD-direction (fresh SYNs):
+                # the reply-direction filter below then isolates the
+                # one post-failover reply batch exactly
+                c.submit(_fwd(db.id, base=40000 + 128 * k))
+                sent += 128
+                k += 1
+                assert time.monotonic() - t0 < 30, "no node died"
+                time.sleep(0.01)
+            dead = c.membership.dead_nodes()[0]
+            dead_idx = c.node(dead).idx
+            assert _wait(lambda: c.failovers_total() == 1, timeout=10)
+            rec = c.failover.snapshot()[0]
+            peer = c.designated_peer(dead_idx)
+            assert rec["dead"] == dead and rec["peer"] == peer.name
+            # the dead node's CT snapshot replayed onto the peer
+            assert rec["ct-replayed-entries"] >= int(
+                (ids == dead_idx).sum())
+            assert rec["blackout-ms"] < 5000
+            # replies for the PRE-FAILOVER flows: the dead node's
+            # share must pass the peer's egress hook via replayed CT
+            got.clear()
+            c.submit(_rep(db.id))
+            sent += 128
+            assert _wait(lambda: c.forward_pending() == 0)
+            st = c.stop()
+            led = _assert_cluster_ledger(st)
+            assert led["submitted"] == sent
+            rep_fwd = rep_drop = 0
+            for b in got:
+                m = b.hdr[:, COL_DIR] == 1
+                rep_fwd += int((b.msg_type[m] != MSG_DROP).sum())
+                rep_drop += int((b.msg_type[m] == MSG_DROP).sum())
+            assert rep_drop == 0 and rep_fwd == 128, (
+                f"CT continuity broken across node death: "
+                f"{rep_drop} replies dropped, {rep_fwd} forwarded")
+            # the episode is a named incident ON THE PEER
+            kinds = [i["kind"] for i in
+                     peer.daemon.flightrec.incidents()]
+            assert "node-failover" in kinds
+            # and the peer's registry shows the failover
+            prom = peer.daemon.registry.render()
+            assert "cilium_cluster_failovers_total 1" in prom
+        finally:
+            faults.disarm()
+            c.shutdown()
+
+    @pytest.mark.chaos
+    def test_kill_node_health_path_and_start_cluster_serving(self):
+        """The one-call wiring (start_cluster_serving) + the
+        operator kill path: kill_node relies purely on probe-driven
+        detection; the tier keeps serving on the survivors with the
+        ledger exact."""
+        c = start_cluster_serving(
+            nodes=2, config=_config(), trace_sample=0,
+            ring_capacity=1 << 10)
+        try:
+            c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+            db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+            rev = c.policy_import(RULES)
+            assert c.wait_policy(rev)
+            rows = _fwd(db.id, n=128)
+            assert c.submit(rows) == 128
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= 128)
+            c.kill_node("node0")
+            assert _wait(lambda: c.membership.is_dead("node0"),
+                         timeout=10)
+            assert _wait(lambda: c.failovers_total() == 1, timeout=10)
+            # the survivor serves the WHOLE hash space now
+            c.submit(_fwd(db.id, n=128, base=40000))
+            sent = 256  # offered (the ledger's submitted side)
+            assert _wait(lambda: c.forward_pending() == 0)
+            st = c.stop()
+            led = _assert_cluster_ledger(st)
+            assert led["submitted"] == sent
+            assert st["cluster"]["live"] == 1
+            assert st["per-node"]["node0"]["alive"] is False
+        finally:
+            c.shutdown()

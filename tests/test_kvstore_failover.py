@@ -144,3 +144,139 @@ class TestFailover:
         assert any(ev.kind == "delete" and ev.key == "node/dead"
                    for ev in events)
         c.close(); standby.close()
+
+
+class TestFailoverUnderIdentityChurn:
+    """ISSUE 8 satellite: the warm-standby failover exercised UNDER
+    the identity plane it exists for — two full daemons churning
+    identities through RemoteKVStore clients while the primary dies —
+    rather than standalone against raw keys.
+
+    Interpreter-backend daemons: this is a control-plane test; no
+    device work."""
+
+    CONVERGE_S = 5.0  # the cluster_convergence_deadline_s default
+
+    def _daemons(self, primary, standby, partition_b=False):
+        """Two agents on the shared identity plane.  ``partition_b``
+        gives node b a client that only knows the PRIMARY address —
+        the deterministic partition: after failover it can reach
+        nobody (its configured peer list is exhausted), while node a
+        walks onto the standby."""
+        from cilium_tpu.agent import Daemon, DaemonConfig
+
+        kv_a = _client(primary, standby)
+        if partition_b:
+            kv_b = RemoteKVStore([primary.address], dial_timeout=5.0,
+                                 max_backoff=0.2)
+        else:
+            kv_b = _client(primary, standby)
+        da = Daemon(DaemonConfig(backend="interpreter",
+                                 node_name="churn-a"), kvstore=kv_a)
+        db = Daemon(DaemonConfig(backend="interpreter",
+                                 node_name="churn-b"), kvstore=kv_b)
+        return da, db, kv_a, kv_b
+
+    @staticmethod
+    def _mint(daemon, label):
+        from cilium_tpu.labels import LabelSet
+
+        return daemon.allocator.allocate(
+            LabelSet.parse(label)).numeric_id
+
+    @staticmethod
+    def _observed(daemon, numeric, deadline_s):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            if daemon.allocator.lookup_by_id(numeric) is not None:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def test_replica_observes_mint_across_failover(self, tmp_path):
+        """Identity churn runs THROUGH the failover: pre-failover
+        mints replicate, the primary dies mid-churn, and a mint made
+        on node a AFTER failover still reaches node b before the
+        convergence deadline — watches re-subscribed with replay on
+        the standby."""
+        import threading
+
+        primary, standby = _pair(tmp_path)
+        da, db, kv_a, kv_b = self._daemons(primary, standby)
+        try:
+            pre = self._mint(da, "k8s:app=pre-failover")
+            assert self._observed(db, pre, self.CONVERGE_S)
+
+            # live churn while the leader dies
+            stop = threading.Event()
+            minted = []
+
+            def churn():
+                i = 0
+                while not stop.is_set():
+                    try:
+                        minted.append(self._mint(
+                            da, f"k8s:app=churn-{i}"))
+                    except Exception:  # noqa: BLE001 — mid-failover
+                        pass  # blip; the next mint lands
+                    i += 1
+                    time.sleep(0.05)
+
+            t = threading.Thread(target=churn, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            primary.close()  # chaos: leader dies mid-churn
+            deadline = time.time() + 5
+            while time.time() < deadline and not standby.promoted:
+                time.sleep(0.05)
+            assert standby.promoted
+            time.sleep(0.3)  # a few post-failover mints land
+            stop.set()
+            t.join(timeout=2)
+
+            # THE satellite property: a mint made strictly AFTER
+            # promotion converges to the other replica in time
+            post = self._mint(da, "k8s:app=post-failover")
+            assert self._observed(db, post, self.CONVERGE_S), (
+                "replica b never observed a post-failover identity "
+                "within the convergence deadline")
+            # and the churn stream survived (no duplicate numerics)
+            nums = [pre, post] + minted
+            assert len(set(nums)) == len(nums)
+        finally:
+            for x in (kv_a, kv_b):
+                x.close()
+            standby.close()
+            primary.close()
+
+    def test_seeded_partition_blocks_convergence(self, tmp_path):
+        """Negative control: node b's client is PARTITIONED from the
+        standby (its peer list only names the dead primary — a
+        deterministic, construction-seeded partition).  A
+        post-failover mint must NOT reach it inside the deadline —
+        proving the positive test measures real propagation, not
+        test slack."""
+        primary, standby = _pair(tmp_path)
+        da, db, kv_a, kv_b = self._daemons(primary, standby,
+                                           partition_b=True)
+        try:
+            pre = self._mint(da, "k8s:app=pre-part")
+            assert self._observed(db, pre, self.CONVERGE_S)
+
+            primary.close()  # the partition becomes total for b
+            deadline = time.time() + 5
+            while time.time() < deadline and not standby.promoted:
+                time.sleep(0.05)
+            assert standby.promoted
+
+            post = self._mint(da, "k8s:app=post-part")
+            # bounded negative wait: 1s is 20+ watch round trips on
+            # this transport — a partitioned replica staying blind
+            # here is structural, not a timing accident
+            assert not self._observed(db, post, 1.0), (
+                "a partitioned replica observed an identity it has "
+                "no path to — the convergence test proves nothing")
+        finally:
+            for x in (kv_a, kv_b):
+                x.close()
+            standby.close()

@@ -579,7 +579,8 @@ class TestRandomFaultSchedule:
 class TestSurfacing:
     def test_reason_codes_fit_the_ring_wire_format(self):
         """The 4-bit ring reason field covers the reserved recovery
-        codes (N_REASONS=12 -> 4 codes of headroom)."""
+        codes (N_REASONS=13 -> 3 codes of headroom; 12 is the
+        cluster router's REASON_CLUSTER_OVERFLOW)."""
         import jax.numpy as jnp
 
         from cilium_tpu.datapath.verdict import (EV_DROP, N_OUT,
@@ -588,7 +589,7 @@ class TestSurfacing:
         from cilium_tpu.monitor.ring import EventRing, ring_append, \
             ring_drain
 
-        assert N_REASONS == 12 and N_REASONS <= 0xF + 1
+        assert N_REASONS == 13 and N_REASONS <= 0xF + 1
         for reason in (REASON_DISPATCH_TIMEOUT, REASON_RECOVERY_DROP):
             out = np.zeros((4, N_OUT), dtype=np.uint32)
             out[:, OUT_EVENT] = EV_DROP
