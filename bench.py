@@ -1372,6 +1372,226 @@ def _run_serving_phase() -> None:
     print(json.dumps(out))
 
 
+def _hist_pct_delta(before, after, p, max_us_hint=None):
+    """Percentile over the DELTA of two log2-µs histogram snapshots —
+    lets a leg report its own p99 out of a cumulative histogram
+    without a reset API.  Delegates to LatencyHistogram.percentile on
+    a throwaway instance so the bucket convention and interpolation
+    can never drift from the registry/CLI numbers."""
+    from cilium_tpu.serving.stats import LatencyHistogram
+
+    h = LatencyHistogram()
+    h.buckets = [b - a for a, b in zip(before, after)]
+    h.count = sum(h.buckets)
+    if h.count <= 0:
+        return None
+    h.max_us = (float(max_us_hint) if max_us_hint
+                else float("inf"))
+    v = h.percentile(p)
+    return round(v, 3) if v is not None else None
+
+
+def bench_churn(target_packets=81920, reps=3, churn_hz=200.0) -> dict:
+    """--churn: live policy/identity churn under serving (ISSUE 10)
+    -> BENCH_churn.json.
+
+    Two legs per rep, INTERLEAVED (rep k runs no-churn then churn
+    back to back so both sample the same machine weather; best-of-3
+    per leg):
+
+    - NO-CHURN OVERLOAD: the PR 1-style sustained leg on the packed
+      path at one bucket rung — the baseline ``sustained_pps``.
+    - CHURN OVERLOAD: the same loop while the seeded
+      ``identity_churn`` scenario (testing/workloads.py) mints and
+      withdraws label-selected peer identities at ``churn_hz`` from
+      the driver thread — every op is a patch_identity +
+      patch_ipcache publish pair against the live tables.
+
+    Reported: ``sustained_pps_churn`` vs ``sustained_pps`` (the
+    churn tax), ``update_visible_p50/p99_us`` (mutation entry ->
+    published generation, measured per op by the driver),
+    ``swap_stall_p99_us`` (dispatch-lock hold per publish flip, from
+    the churn legs' delta of the loader's cumulative histogram), the
+    generation/swap totals, ``ledger_exact`` (every leg's
+    ``submitted == verdicts + shed + recovery_dropped``), and
+    ``compile_violations`` — the one-executable guard must stay at
+    zero through churn (identity churn never retraces the serving
+    executables; that IS the delta-compile story)."""
+    import ipaddress
+
+    from cilium_tpu.agent import Daemon, DaemonConfig
+    from cilium_tpu.core.packets import (COL_DPORT, COL_DST_IP3,
+                                         COL_EP, COL_FAMILY,
+                                         COL_FLAGS, COL_LEN,
+                                         COL_PROTO, COL_SPORT,
+                                         COL_SRC_IP3, N_COLS,
+                                         TCP_ACK)
+    from cilium_tpu.testing.workloads import make_scenario
+
+    BUCKET = 2048
+    d = Daemon(DaemonConfig(
+        backend="tpu", ct_capacity=1 << 16,
+        flow_ring_capacity=1 << 14,
+        serving_queue_depth=1 << 15,
+        serving_bucket_ladder=(BUCKET,),
+        serving_max_wait_us=2000.0))
+    d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "db"}},
+        "ingress": [
+            {"fromEndpoints": [{"matchLabels": {"app": "web"}}],
+             "toPorts": [{"ports": [{"port": "5432",
+                                     "protocol": "TCP"}]}]},
+            {"fromEndpoints": [{"matchLabels": {"churn": "yes"}}],
+             "toPorts": [{"ports": [{"port": "5432",
+                                     "protocol": "TCP"}]}]},
+        ],
+    }])
+    d.start()
+    sc = make_scenario("identity_churn", seed=23, n_slots=16,
+                       zipf_a=1.3, rate_hz=churn_hz)
+    rng = np.random.default_rng(23)
+    src = int(ipaddress.IPv4Address("10.0.1.1"))
+    dst = int(ipaddress.IPv4Address("10.0.2.1"))
+    sports = (1024 + rng.permutation(50000)[:4096]).astype(np.uint32)
+
+    def batch(n):
+        rows = np.zeros((n, N_COLS), dtype=np.uint32)
+        rows[:, COL_SRC_IP3] = src
+        rows[:, COL_DST_IP3] = dst
+        rows[:, COL_SPORT] = rng.choice(sports, n)
+        rows[:, COL_DPORT] = 5432
+        rows[:, COL_PROTO] = 6
+        rows[:, COL_FLAGS] = TCP_ACK
+        rows[:, COL_LEN] = 512
+        rows[:, COL_FAMILY] = 4
+        rows[:, COL_EP] = db.id
+        return rows
+
+    chunks = [batch(max(int(rng.poisson(1024.0)), 1))
+              for _ in range(32)]
+
+    # warm: the serving executables at this rung AND the patch
+    # publish ops (first .at[].set per shape pays a tiny compile)
+    d.start_serving(ring_capacity=1 << 14, trace_sample=0,
+                    packed=True, ingress=True)
+    d.submit(batch(BUCKET))
+    live = {}
+    ops_warm = iter(sc.iter_ops())
+    for _ in range(4):
+        sc.apply(d, next(ops_warm), live)
+    t0 = time.perf_counter()
+    while (d._serving["runtime"].stats.verdicts < BUCKET
+           and time.perf_counter() - t0 < 120):
+        time.sleep(0.005)
+    d.stop_serving()
+    # warmup identities must not leak into the measured legs' worlds
+    sc.drain(d, live)
+
+    def overload_leg(churn: bool):
+        q = None
+        d.start_serving(ring_capacity=1 << 14, trace_sample=0,
+                        packed=True, ingress=True)
+        q = d._serving["runtime"].queue
+        ops = iter(sc.iter_ops())
+        leg_live = {}
+        op_lat = []
+        next_op = time.perf_counter()
+        submitted = 0
+        t0 = time.perf_counter()
+        while submitted < target_packets:
+            for c in chunks:
+                if submitted >= target_packets:
+                    break
+                submitted += d.submit(c.copy())
+                if q.pending > (1 << 15) // 2:
+                    while q.pending > (1 << 15) // 4:
+                        if churn and time.perf_counter() >= next_op:
+                            break
+                        time.sleep(0.001)
+                if churn and time.perf_counter() >= next_op:
+                    next_op += sc.interval_s
+                    t1 = time.perf_counter()
+                    sc.apply(d, next(ops), leg_live)
+                    op_lat.append((time.perf_counter() - t1) * 1e6)
+        fe = d.stop_serving()["front-end"]
+        dt = time.perf_counter() - t0
+        ft = fe["fault-tolerance"]
+        exact = fe["submitted"] == (fe["verdicts"] + fe["shed"]
+                                    + ft["recovery-dropped"])
+        # drain the leg's surviving identities so legs are
+        # independent worlds
+        sc.drain(d, leg_live)
+        return fe["verdicts"] / dt, op_lat, exact
+
+    best = {"plain": 0.0, "churn": 0.0}
+    all_op_lat = []
+    ledger_exact = True
+    stall_before = list(d.loader.tables.swap_stall.buckets)
+    churn_ops_total = 0
+    for _rep in range(reps):
+        pps, _, exact = overload_leg(churn=False)
+        best["plain"] = max(best["plain"], pps)
+        ledger_exact = ledger_exact and exact
+        pps, op_lat, exact = overload_leg(churn=True)
+        best["churn"] = max(best["churn"], pps)
+        all_op_lat.extend(op_lat)
+        churn_ops_total += len(op_lat)
+        ledger_exact = ledger_exact and exact
+    stall_after = list(d.loader.tables.swap_stall.buckets)
+    stall_p99 = _hist_pct_delta(
+        stall_before, stall_after, 0.99,
+        max_us_hint=d.loader.tables.swap_stall.max_us)
+    ts = d.loader.table_stats()
+    comp = d.loader.compile_log.summary()
+    d.shutdown()
+    lat = np.asarray(all_op_lat) if all_op_lat else np.zeros(1)
+    return {
+        "schema": "bench-churn-v1",
+        "best_of": reps,
+        "sustained_pps": round(best["plain"]),
+        "sustained_pps_churn": round(best["churn"]),
+        "churn_ratio": round(best["churn"] / best["plain"], 4)
+        if best["plain"] else None,
+        "churn_ops": churn_ops_total,
+        "churn_rate_hz": churn_hz,
+        "update_visible_p50_us": round(
+            float(np.percentile(lat, 50)), 1),
+        "update_visible_p99_us": round(
+            float(np.percentile(lat, 99)), 1),
+        "swap_stall_p99_us": stall_p99,
+        "swaps": ts["swaps"],
+        "generation": ts["generation"],
+        "delta_attaches": ts["delta-attaches"],
+        "patches": ts["patches"],
+        "ledger_exact": ledger_exact,
+        "compile_violations": comp["violations"],
+        "note": ("churn legs mint/withdraw label-selected peer "
+                 "identities (2 publish flips per op) from the "
+                 "driver thread during the packed overload leg; "
+                 "update-visible latency measured per op by the "
+                 "driver, swap stall from the loader histogram's "
+                 "leg delta; best-of-%d interleaved (CPU wall "
+                 "timings swing +-15%%)" % reps),
+    }
+
+
+def _run_churn_phase() -> None:
+    """--churn: the live-churn phase standalone (one JSON line).
+    Also writes BENCH_churn.json next to this file; schema-checked
+    by the CTA009 bench machinery."""
+    import os
+
+    out = bench_churn()
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_churn.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out))
+
+
 def bench_cluster(target_packets=49152, reps=3) -> dict:
     """--cluster: the clustermesh serving tier phase (ISSUE 8) ->
     BENCH_cluster.json.
@@ -1698,6 +1918,7 @@ def main() -> None:
     serving = _phase_subprocess("--serving")
     recovery = _phase_subprocess("--recovery")
     cluster = _phase_subprocess("--cluster")
+    churn = _phase_subprocess("--churn")
     artifact = _phase_subprocess("--artifact")
     l7 = bench_l7()
     anomaly = bench_anomaly()
@@ -1716,6 +1937,7 @@ def main() -> None:
         "serving": serving,
         "recovery": recovery,
         "cluster": cluster,
+        "churn": churn,
         "d2h_artifact": artifact,
         "l7": l7,
         "encryption": encryption,
@@ -1745,5 +1967,7 @@ if __name__ == "__main__":
         _run_recovery_phase()
     elif "--cluster" in sys.argv:
         _run_cluster_phase()
+    elif "--churn" in sys.argv:
+        _run_churn_phase()
     else:
         main()

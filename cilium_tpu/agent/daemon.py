@@ -252,6 +252,16 @@ class DaemonConfig:
     # shape); "memory" shares the InMemoryKVStore object (cheapest
     # tests)
     cluster_kvstore: str = "remote"
+    # -- live policy churn (datapath/tables.py table versioning;
+    # ISSUE 10).  Delta attach: repaint only fingerprint-changed
+    # policies on a re-attach instead of recompiling the world
+    # (policy.incremental.delta_compile); False forces every attach
+    # down the full-compile path (debug / A-B comparison)
+    policy_delta_compile: bool = True
+    # warn when a table publish holds the dispatch lock longer than
+    # this many ms (the flip is supposed to be a pointer swap; a slow
+    # one means device work leaked inside the lock).  0 = off
+    policy_swap_warn_ms: float = 0.0
 
 
 class Daemon:
@@ -333,8 +343,15 @@ class Daemon:
         self.identity_sync: Optional[ClusterIdentitySync] = None
         self.repo = PolicyRepository(self.allocator)
         self.ipcache = IPCache()
+        self.config.policy_swap_warn_ms = float(
+            self.config.policy_swap_warn_ms)
+        if self.config.policy_swap_warn_ms < 0:
+            raise ValueError("policy_swap_warn_ms must be >= 0")
         if self.config.backend == "tpu":
-            self.loader: Loader = TPULoader(self.config.ct_capacity)
+            self.loader: Loader = TPULoader(
+                self.config.ct_capacity,
+                delta_compile=self.config.policy_delta_compile,
+                swap_warn_ms=self.config.policy_swap_warn_ms)
         else:
             self.loader = InterpreterLoader()
         self.endpoints = EndpointManager(self.repo, self.ipcache,
@@ -1877,6 +1894,11 @@ class Daemon:
         log = getattr(self.loader, "compile_log", None)
         if log is not None:
             out["compile"] = log.summary()
+        # live-churn plane (datapath/tables.py): published generation,
+        # swap/update latency, delta-compile scoreboard
+        tstats = getattr(self.loader, "table_stats", None)
+        if tstats is not None:
+            out["tables"] = tstats()
         if self._cluster is not None:
             # the Cluster block: tier-level counters only (router,
             # membership, failovers) — cheap by contract, because

@@ -66,6 +66,15 @@ CTA008    cluster-ledger: every ``*_overflow``/``*_dropped``
           ``REASON_CLUSTER_OVERFLOW``, and ``BENCH_cluster.json``
           (when present) must keep its schema
           (``scripts/check_cluster_ledger.py`` is the shim CLI)
+CTA009    generation discipline: a class's declared
+          ``active-tables`` attrs (the published device tables and
+          their host mirrors in ``datapath/loader.py``) may only be
+          WRITTEN in methods annotated ``# table-swap-ok: <reason>``
+          — every other mutation must go through the versioned
+          builder/publish protocol (``datapath/tables.py``); the
+          loader module must keep its ``state``/``oracle``
+          declarations and annotated ``_publish_tables`` helper, and
+          ``BENCH_churn.json`` (when present) must keep its schema
 ========  ===========================================================
 
 Annotation grammar
@@ -111,6 +120,21 @@ they survive formatting.
     Trailing waiver on a line CTA003 would flag (e.g. the drain
     loop's bounded idle ``time.sleep``, the load-bearing cursor
     ``block_until_ready`` in ``ring._start_window``).
+
+``# active-tables: <attr>[, <attr> ...]``
+    Class-body declaration (CTA009): the listed ``self.<attr>``
+    names are published tables / table mirrors.  Any write —
+    assignment (including tuple unpacking and stores rooted at the
+    attr, e.g. ``self.tensors.verdict[...] = v``), ``del``, or a
+    known container-mutator call — outside a ``table-swap-ok``
+    method is a finding.  Reads are never flagged; ``__init__`` is
+    exempt.  May repeat across lines (the union is declared).
+
+``# table-swap-ok: <reason>``
+    Same placement as ``holds``: marks a method as a sanctioned
+    table-swap site (the publish helper, a builder, a CT-only or
+    placement-only state swap).  The reason is mandatory — every
+    swap site must say what class of swap it is.
 
 ``# lint: disable=<CODE>[,<CODE>...] -- <reason>``
     Suppress the listed codes on this line (trailing form) or on the

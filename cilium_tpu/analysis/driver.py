@@ -9,8 +9,8 @@ import os
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
-from . import (affinity, cluster_lint, guarded, hotpath, reasons,
-               registry_lint, sharding, sysdump_lint)
+from . import (affinity, cluster_lint, generation, guarded, hotpath,
+               reasons, registry_lint, sharding, sysdump_lint)
 from .callgraph import CallGraph
 from .core import BASELINE_NAME, Baseline, Finding, Repo, repo_root
 
@@ -24,6 +24,7 @@ CHECKERS: Dict[str, Tuple[str, Callable]] = {
     "metrics-registry": (registry_lint.CODE, registry_lint.check),
     "sysdump-schema": (sysdump_lint.CODE, sysdump_lint.check),
     "cluster-ledger": (cluster_lint.CODE, cluster_lint.check),
+    "generation-discipline": (generation.CODE, generation.check),
 }
 # checkers that walk the call graph; selecting none of these skips
 # the (comparatively expensive) CallGraph build entirely

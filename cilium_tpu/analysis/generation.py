@@ -1,0 +1,274 @@
+"""CTA009 — generation discipline: active-table writes only through
+annotated swap/builder methods; plus the churn bench artifact schema.
+
+The table-versioning tentpole (datapath/tables.py) only guarantees
+torn-free swaps if EVERY mutation of the published tables goes
+through the builder/publish protocol — one shortcut that pokes a
+live tensor or mirror in place re-opens the mid-swap window the
+whole design exists to close.  Statically enforced:
+
+1. a class may declare its published-table attrs in a class-body
+   annotation::
+
+       # active-tables: state, tensors, _lpm_entries
+
+   Any WRITE to a declared attr — plain/aug/ann assignment, tuple
+   unpacking, ``del``, a subscript or dotted store rooted at it
+   (``self.tensors.verdict[...] = v``), or a known mutator call
+   (``self._lpm_entries.pop(...)``) — outside a method annotated
+   ``# table-swap-ok: <reason>`` is a CTA009 finding.  ``__init__``
+   is exempt (no published generation exists during construction);
+   reads are never flagged (discipline covers mutation, not
+   observation).  The reason is MANDATORY: every swap site must say
+   what class of swap it is (table publish / CT-only / placement /
+   oracle apply).
+
+2. ``cilium_tpu/datapath/loader.py`` must keep the discipline armed:
+   a class declaring ``state`` among its active tables, a class
+   declaring ``oracle``, and an annotated ``_publish_tables`` swap
+   helper — deleting any of the annotations fails tier-1, the same
+   presence idiom as the CTA002 tentpole annotations.
+
+3. when ``BENCH_churn.json`` exists at the repo root it carries
+   every :data:`BENCH_CHURN_KEYS` entry (the churn bench artifact's
+   schema floor, the CTA008 bench-schema idiom; ``check_bench`` is
+   the importable validator tests share).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+from .annotations import _def_comment_range
+from .core import FileCtx, Finding, Repo
+
+CODE = "CTA009"
+NAME = "generation-discipline"
+
+LOADER_MODULE = "cilium_tpu/datapath/loader.py"
+
+BENCH_NAME = "BENCH_churn.json"
+BENCH_SCHEMA = "bench-churn-v1"
+# the churn bench artifact's schema floor (bench.py --churn)
+BENCH_CHURN_KEYS = (
+    "schema", "best_of",
+    "sustained_pps", "sustained_pps_churn", "churn_ratio",
+    "churn_ops", "churn_rate_hz",
+    "update_visible_p50_us", "update_visible_p99_us",
+    "swap_stall_p99_us", "swaps", "generation",
+    "ledger_exact", "compile_violations",
+)
+
+_ACTIVE_RE = re.compile(
+    r"#\s*active-tables:\s*(?P<attrs>[\w,\s]+?)\s*$")
+_SWAP_OK_RE = re.compile(
+    r"#\s*table-swap-ok\s*(?::\s*(?P<reason>.*))?$")
+
+# method calls that mutate their receiver (the lexical-store
+# approximation's blind spot, closed for the common containers)
+_MUTATORS = frozenset({
+    "pop", "clear", "update", "append", "extend", "insert",
+    "setdefault", "add", "remove", "discard", "popitem", "sort",
+    "fill",
+})
+
+
+def _class_active_tables(cls: ast.ClassDef,
+                         ctx: FileCtx) -> Set[str]:
+    """Declared attrs from every ``# active-tables:`` comment line in
+    the class range (multiple lines union — the declaration may wrap)."""
+    out: Set[str] = set()
+    end = getattr(cls, "end_lineno", None) or cls.lineno
+    for ln in range(cls.lineno, end + 1):
+        for c in ctx.comments.get(ln, ()):
+            m = _ACTIVE_RE.match(c.strip())
+            if m:
+                out.update(a.strip() for a in
+                           m.group("attrs").split(",") if a.strip())
+    return out
+
+
+def _swap_ok(node: ast.FunctionDef, ctx: FileCtx,
+             findings: List[Finding]) -> bool:
+    """True when the def carries ``# table-swap-ok: <reason>``; a
+    reason-less annotation is itself a finding (and does NOT arm the
+    exemption — an unexplained swap site is the problem)."""
+    for ln, c in _def_comment_range(node, ctx):
+        m = _SWAP_OK_RE.match(c.strip())
+        if m is None:
+            continue
+        reason = (m.group("reason") or "").strip()
+        if not reason:
+            if not ctx.suppressed(CODE, ln):
+                findings.append(Finding(
+                    CODE, ctx.rel, ln,
+                    "table-swap-ok needs a reason (`# table-swap-ok: "
+                    "<what class of swap this is>`)", checker=NAME))
+            return False
+        return True
+    return False
+
+
+def _root_self_attr(node: ast.AST) -> Optional[str]:
+    """The first attribute above ``self`` in a store-target chain:
+    ``self.tensors.verdict[...]`` -> ``tensors``; None when the chain
+    is not rooted at self."""
+    chain: List[str] = []
+    cur = node
+    while True:
+        if isinstance(cur, ast.Subscript):
+            cur = cur.value
+        elif isinstance(cur, ast.Attribute):
+            chain.append(cur.attr)
+            cur = cur.value
+        elif isinstance(cur, ast.Name):
+            return chain[-1] if cur.id == "self" and chain else None
+        else:
+            return None
+
+
+class _WriteVisitor(ast.NodeVisitor):
+    """Collect writes to declared attrs anywhere in one method body
+    (nested defs/lambdas INCLUDED: a mirror closure defined in an
+    annotated builder inherits its exemption lexically)."""
+
+    def __init__(self, declared: Set[str]):
+        self.declared = declared
+        self.hits: List[tuple] = []  # (lineno, attr, how)
+
+    def _check(self, target: ast.AST, how: str) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._check(e, how)
+            return
+        attr = _root_self_attr(target)
+        if attr is not None and attr in self.declared:
+            self.hits.append((target.lineno, attr, how))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._check(t, "assigned")
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._check(node.target, "aug-assigned")
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._check(node.target, "assigned")
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._check(t, "deleted")
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+            attr = _root_self_attr(fn.value)
+            if attr is not None and attr in self.declared:
+                self.hits.append((node.lineno, attr,
+                                  f"mutated via .{fn.attr}()"))
+        self.generic_visit(node)
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    loader_declares_state = False
+    loader_declares_oracle = False
+    loader_publish_ok = False
+
+    for ctx in repo.files:
+        if ctx.tree is None:
+            continue
+        is_loader = ctx.rel == LOADER_MODULE
+        for cls in ctx.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            declared = _class_active_tables(cls, ctx)
+            if not declared:
+                continue
+            if is_loader and "state" in declared:
+                loader_declares_state = True
+            if is_loader and "oracle" in declared:
+                loader_declares_oracle = True
+            for node in cls.body:
+                if not isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if node.name == "__init__":
+                    continue
+                exempt = _swap_ok(node, ctx, findings)
+                if exempt:
+                    if is_loader and node.name == "_publish_tables":
+                        loader_publish_ok = True
+                    continue
+                v = _WriteVisitor(declared)
+                v.visit(node)
+                for line, attr, how in v.hits:
+                    if ctx.suppressed(CODE, line):
+                        continue
+                    findings.append(Finding(
+                        CODE, ctx.rel, line,
+                        f"{cls.name}.{attr} is an active table but "
+                        f"{how} in {node.name}() without a "
+                        f"`# table-swap-ok: <reason>` annotation — "
+                        f"published tables mutate only through the "
+                        f"builder/publish protocol "
+                        f"(datapath/tables.py)", checker=NAME))
+
+    # 2. the loader keeps the discipline armed
+    if repo.by_rel(LOADER_MODULE) is not None:
+        if not loader_declares_state:
+            findings.append(Finding(
+                CODE, LOADER_MODULE, 1,
+                "no class declares `state` in an active-tables "
+                "annotation — the device loader's generation "
+                "discipline is unchecked", checker=NAME))
+        if not loader_declares_oracle:
+            findings.append(Finding(
+                CODE, LOADER_MODULE, 1,
+                "no class declares `oracle` in an active-tables "
+                "annotation — the interpreter loader's generation "
+                "discipline is unchecked", checker=NAME))
+        if not loader_publish_ok:
+            findings.append(Finding(
+                CODE, LOADER_MODULE, 1,
+                "no annotated _publish_tables swap helper found — "
+                "the single-flip publish protocol has no anchor",
+                checker=NAME))
+
+    # 3. bench artifact schema (only when the artifact exists)
+    bench_path = os.path.join(repo.root, BENCH_NAME)
+    if os.path.exists(bench_path):
+        for msg in check_bench(bench_path):
+            findings.append(Finding(CODE, BENCH_NAME, 1, msg,
+                                    checker=NAME))
+    return findings
+
+
+# -- bench artifact validation (tests + bench share it) ----------------
+def check_bench(path: str) -> List[str]:
+    """-> list of violation strings (empty = clean)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: does not load as JSON ({e})"]
+    if not isinstance(data, dict):
+        return [f"{path}: top level is {type(data).__name__}, "
+                f"not an object"]
+    bad = []
+    if data.get("schema") != BENCH_SCHEMA:
+        bad.append(f"{path}: schema {data.get('schema')!r} != "
+                   f"{BENCH_SCHEMA}")
+    for key in BENCH_CHURN_KEYS:
+        if key not in data:
+            bad.append(f"{path}: missing required key {key!r}")
+    return bad

@@ -44,6 +44,14 @@ REQUIRED_SERIES = (
     "cilium_cluster_router_overflow_total",
     "cilium_cluster_failover_dropped_total",
     "cilium_cluster_failovers_total",
+    # live policy churn (datapath/tables.py table versioning): the
+    # published generation and its swap plane must stay scrapeable —
+    # an invisible generation means churn incidents cannot be
+    # correlated with policy updates
+    "cilium_policy_generation",
+    "cilium_policy_swaps_total",
+    "cilium_policy_swap_latency_us",
+    "cilium_policy_update_visible_us",
     # long-standing anchors (a registry rewrite that loses these
     # fails here, not on a dashboard)
     "cilium_datapath_packets_total",

@@ -675,6 +675,18 @@ def cmd_serving(args) -> int:
                           f"{snap.get('age-seconds', 0)}s "
                           f"({snap.get('trigger')}, "
                           f"mode {snap.get('mode')})")
+                tb = st.get("tables")
+                if tb:
+                    stall = tb.get("swap-stall-us") or {}
+                    vis = tb.get("update-visible-us") or {}
+                    print(f"Tables:    gen {tb.get('generation', 0)}, "
+                          f"{tb.get('swaps', 0)} swaps "
+                          f"({tb.get('delta-attaches', 0)} delta / "
+                          f"{tb.get('full-attaches', 0)} full / "
+                          f"{tb.get('patches', 0)} patches), "
+                          f"stall p99={_us(stall.get('p99'))} "
+                          f"visible p99={_us(vis.get('p99'))} "
+                          f"last {_us(tb.get('last-swap-us'))}")
                 for name, key in (("Queue-wait", "queue-wait-us"),
                                   ("Latency", "latency-us")):
                     h = st.get(key) or {}

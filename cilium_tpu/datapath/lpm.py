@@ -212,6 +212,42 @@ def lpm_upsert(t: LPMTensors, cidr: str,
     return patches
 
 
+class LPMUndo:
+    """Rollback snapshot for ONE :func:`lpm_upsert` against the host
+    mirror — the crash-safety half of the loader's table-versioning
+    contract: a build that fails AFTER the mirror upsert but BEFORE
+    the generation flip (the seeded ``churn.*`` fault sites) must
+    leave the mirror exactly as published, or the next rebuild would
+    resurrect an entry the datapath never served.
+
+    Snapshots the same (l1 slot, l2 block, l3 block) the upsert's
+    plan derives — the derivation here MUST mirror ``lpm_upsert``'s;
+    both live in this file so they cannot drift apart silently."""
+
+    def __init__(self, t: LPMTensors, cidr: str):
+        self.cells: List[tuple] = []  # ("l1"|"l2"|"l3", idx, payload)
+        net = ipaddress.ip_network(cidr, strict=False)
+        if net.version != 4 or net.prefixlen != 32:
+            return  # rebuild path: the mirror object is REPLACED,
+            # not mutated — nothing to snapshot
+        addr = int(net.network_address)
+        n_l2, n_l3 = lpm_used_blocks(t)
+        hi16, mid8 = addr >> 16, (addr >> 8) & 0xFF
+        cur1 = int(t.l1[hi16])
+        blk2 = n_l2 if cur1 >= 0 else -cur1 - 1
+        cur2 = cur1 if cur1 >= 0 else int(t.l2[blk2, mid8])
+        blk3 = n_l3 if cur2 >= 0 else -cur2 - 1
+        self.cells.append(("l1", hi16, np.int32(cur1)))
+        if blk2 < t.l2.shape[0]:
+            self.cells.append(("l2", blk2, t.l2[blk2].copy()))
+        if blk3 < t.l3.shape[0]:
+            self.cells.append(("l3", blk3, t.l3[blk3].copy()))
+
+    def restore(self, t: LPMTensors) -> None:
+        for field, idx, payload in self.cells:
+            getattr(t, field)[idx] = payload
+
+
 def lookup_v4(t_l1: jnp.ndarray, t_l2: jnp.ndarray, t_l3: jnp.ndarray,
               ip: jnp.ndarray) -> jnp.ndarray:
     """Batched IPv4 LPM: [N] uint32 -> [N] int32 values. Three gathers."""

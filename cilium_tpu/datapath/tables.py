@@ -1,0 +1,217 @@
+"""Versioned, double-buffered device table slots (ISSUE 10 tentpole).
+
+Reference: upstream cilium's SelectorCache-driven incremental updates
+mutate pinned BPF maps while traffic flows — the datapath always sees
+either the pre-change or the post-change entry, never a torn hybrid.
+The TPU analogue has to provide the same guarantee for the DENSE
+tables (verdict tensor, LPM, ep_policy, auth): this module is the
+publication protocol every table mutation in ``datapath/loader.py``
+goes through.
+
+The idiom is BucketArena's recycling-horizon ownership handoff,
+applied to device tables:
+
+- TWO SLOTS, one ACTIVE: the slot pair holds the published table
+  bundle (``DevicePolicy`` + ``DeviceLPM``) for the current and the
+  previous generation.  Builders assemble the successor bundle OFF
+  the dispatch path (host compile + ``.at[].set`` device work happen
+  with only the BUILD lock held, never the loader's dispatch lock).
+- ONE FLIP: publication is :meth:`flip` — an index swap plus a
+  monotonic ``generation`` bump — executed while the caller holds the
+  loader's dispatch lock, so a concurrent serving dispatch captures
+  either the old bundle or the new one, whole.  The dispatch lock is
+  held only for the flip (a pointer swap), never the rebuild.
+- RECYCLING HORIZON: after a flip the demoted slot keeps the previous
+  generation's bundle until the NEXT build overwrites it.  After an
+  ATTACH flip those are live arrays (an in-flight dispatch that
+  captured them holds its own references); after a PATCH flip the
+  previous generation's patched arrays are CONSUMED handles — the
+  loader's donating in-place update (``loader._dus``) recycled their
+  buffers into the new generation, sequenced after every in-flight
+  read by device-stream order.  Either way the spare slot is
+  BOOKKEEPING (generation tags, test assertions), never a read path
+  — the same ownership handoff BucketArena slots make at their
+  recycling horizon.
+
+A failed build (exception anywhere before :meth:`flip`, including the
+seeded ``churn.build`` / ``churn.swap`` fault sites) leaves the
+active slot, the generation, and every published table byte exactly
+as they were: half-built generations are unreachable by construction
+because nothing exposes the spare slot until the flip.
+
+Builders serialize on :attr:`build_lock` (lock order: table-builder
+BEFORE datapath-loader — the publish step takes the dispatch lock
+while holding the build lock, never the reverse).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+
+class TableSlot:
+    """One published table bundle: the device arrays plus the
+    generation they were published as (0 = never published)."""
+
+    __slots__ = ("policy", "lpm", "gen")
+
+    def __init__(self, policy=None, lpm=None, gen: int = 0):
+        self.policy = policy
+        self.lpm = lpm
+        self.gen = gen
+
+
+class _Build:
+    """Handle for one builder pass (see :meth:`TableVersioner.building`).
+    ``published`` carries the generation the pass flipped to, or None
+    when the builder bailed out without publishing (validation
+    ``return False`` paths)."""
+
+    __slots__ = ("t0", "published")
+
+    def __init__(self, t0: float):
+        self.t0 = t0
+        self.published: Optional[int] = None
+
+
+class TableVersioner:
+    """Double-buffered table slot pair + monotonic generation tag.
+
+    Written by builder threads (API / regeneration / allocator
+    observers) under :attr:`build_lock`; the flip itself additionally
+    runs under the loader's dispatch lock.  Counters and histograms
+    are read lock-free by stats/registry scrapes (single-writer
+    ints/log2-buckets — the same torn-read tolerance every serving
+    histogram has)."""
+
+    def __init__(self, warn_ms: float = 0.0):
+        # deferred: keeps this module importable without the serving
+        # package on pure-analysis boxes (scripts/lint.py discipline)
+        from ..infra.lockdebug import make_lock
+        from ..serving.stats import LatencyHistogram
+
+        # serializes builders end to end (compute + publish + mirror
+        # writes); the flip additionally holds the dispatch lock
+        self.build_lock = make_lock("table-builder")
+        # guarded-by: table-builder: _slots, _spare_dirty
+        self._slots = [TableSlot(), TableSlot()]
+        self._active = 0
+        # marks the spare slot's arrays as overwritten by an ABORTED
+        # build since the last flip (test surface: proves a failed
+        # build never reached the active index)
+        self._spare_dirty = False
+        self.generation = 0  # monotonic; bumps ONLY at flip
+        self.swaps = 0
+        self.last_swap_us: Optional[float] = None
+        # dispatch-lock hold for one flip (the drain thread's swap
+        # stall ceiling) and mutation-entry -> published latency (the
+        # operator-visible "policy update latency")
+        self.swap_stall = LatencyHistogram()
+        self.update_visible = LatencyHistogram()
+        # delta-compile scoreboard (TPULoader.attach)
+        self.full_attaches = 0
+        self.delta_attaches = 0
+        self.policies_recompiled = 0
+        self.patches = 0  # in-place row/LPM patch publishes
+        self.failed_builds = 0  # builder passes that raised
+        self.warn_ms = float(warn_ms)
+
+    # -- builder side ---------------------------------------------------
+    @contextmanager
+    def building(self):
+        """One serialized builder pass.  Records update-visible
+        latency on publish, counts a failed build on exception (the
+        publish-or-nothing contract: an exception before the flip
+        leaves the active generation untouched)."""
+        t0 = time.monotonic()  # BEFORE the lock: update-visible
+        # latency includes builder contention — the operator waits
+        # through a slow attach ahead in line too
+        with self.build_lock:
+            b = _Build(t0)
+            try:
+                yield b
+            except BaseException:
+                self.failed_builds += 1
+                self._spare_dirty = True
+                raise
+            if b.published is not None:
+                self.update_visible.record(
+                    (time.monotonic() - b.t0) * 1e6)
+
+    @property
+    def active(self) -> TableSlot:
+        # holds: build_lock -- builders; other callers accept a
+        # point-in-time read (slots hold immutable array bundles)
+        return self._slots[self._active]
+
+    @property
+    def spare(self) -> TableSlot:
+        # holds: build_lock -- builders; other callers accept a
+        # point-in-time read (slots hold immutable array bundles)
+        """The previous generation's slot (recycled at the next flip)."""
+        return self._slots[1 - self._active]
+
+    @property
+    def spare_dirty(self) -> bool:
+        # holds: build_lock -- builders; other callers accept a
+        # point-in-time read of the flag
+        """True when the last builder pass aborted after staging work:
+        the spare holds half-built state the flip never exposed."""
+        return self._spare_dirty
+
+    def flip(self, build: _Build, policy, lpm, t_lock: float) -> int:
+        # holds: build_lock -- builders call this via the loader's
+        # _publish_tables while additionally holding the dispatch lock
+        """Publish: write the successor bundle into the spare slot,
+        swap the active index, bump the generation.  ``t_lock`` is
+        when the caller acquired the dispatch lock — the stall clock.
+        MUST be called with the loader's dispatch lock held."""
+        spare = 1 - self._active
+        self.generation += 1
+        slot = self._slots[spare]
+        slot.policy = policy
+        slot.lpm = lpm
+        slot.gen = self.generation
+        self._active = spare
+        self._spare_dirty = False
+        self.swaps += 1
+        stall_us = (time.monotonic() - t_lock) * 1e6
+        self.last_swap_us = round(stall_us, 3)
+        self.swap_stall.record(stall_us)
+        build.published = self.generation
+        if self.warn_ms > 0 and stall_us > self.warn_ms * 1e3:
+            # hot-path-ok: operator-armed slow-swap warning
+            # (policy_swap_warn_ms, default off) — fires only when a
+            # flip exceeds the configured budget, never steady state
+            logging.getLogger(__name__).warning(
+                "table publish held the dispatch lock %.1fms "
+                "(policy_swap_warn_ms=%.1f) at generation %d",
+                stall_us / 1e3, self.warn_ms, self.generation)
+        return self.generation
+
+    def note_publish(self, build: _Build) -> int:
+        """The InterpreterLoader's flip: no device slots to buffer
+        (the oracle applies updates structurally), but the generation
+        tag / swap counters keep parity so every surface and test
+        reads the same shape from either backend."""
+        return self.flip(build, None, None, time.monotonic())
+
+    # -- read side ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """The ``tables`` stats block (serving stats -> GET /serving
+        -> CLI -> registry)."""
+        return {
+            "generation": self.generation,
+            "swaps": self.swaps,
+            "last-swap-us": self.last_swap_us,
+            "swap-stall-us": self.swap_stall.snapshot(),
+            "update-visible-us": self.update_visible.snapshot(),
+            "full-attaches": self.full_attaches,
+            "delta-attaches": self.delta_attaches,
+            "policies-recompiled": self.policies_recompiled,
+            "patches": self.patches,
+            "failed-builds": self.failed_builds,
+        }

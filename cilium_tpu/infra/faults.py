@@ -84,6 +84,19 @@ SITE_EVENT_JOIN = "eventplane.join"
 # ``cluster.probe=1x1@K`` is a deterministic "kill the K-th probed
 # node" — the injected-node-death entry for cluster failover chaos.
 SITE_CLUSTER_PROBE = "cluster.probe"
+# datapath/loader.py table versioning (datapath/tables.py) — the
+# mid-swap crash/hang sites of the churn chaos gate.  ``churn.build``
+# fires in the BUILDER, after the successor tables are assembled but
+# before publication: a raise abandons the build (the published
+# generation and its tables stay untouched); a ``~S`` hang stalls the
+# builder with only the build lock held, proving serving dispatches
+# keep flowing through a slow rebuild.  ``churn.swap`` fires INSIDE
+# the dispatch lock immediately before the generation flip: a raise
+# proves a crash at the last possible instant still publishes
+# nothing; a ``~S`` hang holds the dispatch lock (the worst-case
+# swap stall the watchdog's deadline machinery must tolerate).
+SITE_CHURN_BUILD = "churn.build"
+SITE_CHURN_SWAP = "churn.swap"
 
 SITES = frozenset({
     SITE_SERVING_DISPATCH,
@@ -95,6 +108,8 @@ SITES = frozenset({
     SITE_RING_COLLECT,
     SITE_EVENT_JOIN,
     SITE_CLUSTER_PROBE,
+    SITE_CHURN_BUILD,
+    SITE_CHURN_SWAP,
 })
 
 

@@ -399,6 +399,38 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                   "admission -> events-emitted end-to-end latency "
                   "(µs, log2 buckets)", hist("latency"))
 
+    # -- live policy churn (datapath/tables.py table versioning):
+    # the published table generation and the swap plane's latency.
+    # Collectors read the loader's versioner live — single-writer
+    # counters/log2-buckets, same torn-read tolerance as every
+    # serving histogram ------------------------------------------------
+    def tablesv():
+        return getattr(daemon.loader, "tables", None)
+
+    reg.gauge("cilium_policy_generation",
+              "published device table generation (monotonic; bumps "
+              "on every attach/patch publish flip)",
+              lambda: (tv.generation
+                       if (tv := tablesv()) is not None else None))
+    reg.counter("cilium_policy_swaps_total",
+                "table generation flips published (full + delta "
+                "attaches, identity/ipcache patches, auth grants)",
+                lambda: (tv.swaps
+                         if (tv := tablesv()) is not None else None))
+    reg.histogram("cilium_policy_swap_latency_us",
+                  "dispatch-lock hold for one table publish flip "
+                  "(µs, log2 buckets) — the drain thread's swap "
+                  "stall ceiling",
+                  lambda: (tv.swap_stall
+                           if (tv := tablesv()) is not None
+                           else None))
+    reg.histogram("cilium_policy_update_visible_us",
+                  "table mutation entry -> published generation "
+                  "latency (µs, log2 buckets)",
+                  lambda: (tv.update_visible
+                           if (tv := tablesv()) is not None
+                           else None))
+
     # -- compile / trace introspection --------------------------------
     def compile_stat(key):
         def collect():

@@ -108,6 +108,8 @@ class IdentityRowMap:
     """
 
     def __init__(self, capacity: int = 1024):
+        import threading
+
         self.capacity = capacity
         self._num_to_row: Dict[int, int] = {0: 0}
         self._row_to_num = np.zeros(capacity, dtype=np.int64)
@@ -118,35 +120,46 @@ class IdentityRowMap:
         # (the serving path's per-batch numerics) must key refreshes
         # on (id(map), version), never on object identity alone
         self.version = 0
+        # mutation lock: the map is shared between REGENERATION
+        # (resolve + compile on API/trigger threads) and live CHURN
+        # patch builders (loader table-builder lock) — add/remove
+        # are compound (free-list pop / next bump + two stores) and
+        # an interleaving could hand ONE row to two identities, the
+        # silent-misverdict class ISSUE 10 exists to close.  Reads
+        # (row/numeric lookups) stay lock-free: CPython dict/array
+        # point reads are GIL-atomic against these locked mutations
+        self._mut = threading.Lock()
 
     def add(self, numeric_id: int) -> int:
-        row = self._num_to_row.get(numeric_id)
-        if row is not None:
+        with self._mut:
+            row = self._num_to_row.get(numeric_id)
+            if row is not None:
+                return row
+            if self._free:
+                row = self._free.pop()
+            else:
+                if self._next >= self.capacity:
+                    self._grow()
+                row = self._next
+                self._next += 1
+            self._num_to_row[numeric_id] = row
+            self._row_to_num[row] = numeric_id
+            self.version += 1
             return row
-        if self._free:
-            row = self._free.pop()
-        else:
-            if self._next >= self.capacity:
-                self._grow()
-            row = self._next
-            self._next += 1
-        self._num_to_row[numeric_id] = row
-        self._row_to_num[row] = numeric_id
-        self.version += 1
-        return row
 
     def remove(self, numeric_id: int) -> Optional[int]:
         """Recycle a released identity's row (fqdn/identity churn must
         not grow the verdict tensor without bound).  Callers free a
         row ONLY after its tensor contents were reset to defaults and
         no LPM entry references it."""
-        row = self._num_to_row.pop(numeric_id, None)
-        if row is None or row == 0:
-            return None
-        self._row_to_num[row] = 0
-        self._free.append(row)
-        self.version += 1
-        return row
+        with self._mut:
+            row = self._num_to_row.pop(numeric_id, None)
+            if row is None or row == 0:
+                return None
+            self._row_to_num[row] = 0
+            self._free.append(row)
+            self.version += 1
+            return row
 
     def _grow(self) -> None:
         self.capacity *= 2
@@ -217,6 +230,33 @@ class PolicyTensors:
                 + self.port_class.nbytes + self.proto_table.nbytes)
 
 
+def policy_fingerprint(pol: EndpointPolicy) -> tuple:
+    """Structural fingerprint of one resolved policy — everything
+    that feeds its verdict-tensor slice: subject key, enforcement,
+    and every contribution's (proto, port range, verdict class,
+    proxy, auth, FROZEN peer set).  Two policies with equal
+    fingerprints compile to byte-equal ``verdict[pi]`` slices (given
+    the same row map), which is exactly what
+    :func:`~..policy.incremental.delta_compile` needs to reuse the
+    previous attach's slice instead of repainting it.
+
+    Identity churn is IN the fingerprint (``identities``): an
+    identity joining a selector's peer set marks only the policies
+    whose selectors changed — the delta-compile partition the r05
+    class compaction set up."""
+
+    def ms_fp(ms) -> tuple:
+        return (bool(ms.enforcing), tuple(
+            (c.proto, c.lo, c.hi, bool(c.is_deny), bool(c.redirect),
+             int(c.proxy_port), bool(c.auth),
+             None if c.identities is None
+             else tuple(sorted(c.identities)))
+            for c in ms.contributions))
+
+    return (pol.subject_labels.sorted_key(),
+            ms_fp(pol.ingress), ms_fp(pol.egress))
+
+
 def _collect_boundaries(policies: Sequence[EndpointPolicy]
                         ) -> Dict[int, np.ndarray]:
     """Per-proto sorted boundary sets partitioning [0, 65536)."""
@@ -234,24 +274,25 @@ def _collect_boundaries(policies: Sequence[EndpointPolicy]
             for p, b in bounds.items()}
 
 
-def compile_policy(
-    policies: Sequence[EndpointPolicy],
-    row_map: IdentityRowMap,
-    class_pad: int = 128,
-) -> PolicyTensors:
-    """Compile resolved endpoint policies into dense device tensors.
+@dataclass
+class ClassStructure:
+    """The class-partition half of a compile — everything EXCEPT the
+    verdict paint.  Shared by :func:`compile_policy` and the delta
+    path (``policy.incremental.delta_compile``): ONE definition so a
+    delta attach can never desynchronize from a full one."""
 
-    O(contributions x touched-rows) via vectorized numpy scatters; the
-    10k-identity benchmark set compiles in milliseconds.
-    """
-    # Ensure every identity referenced by any contribution has a row.
-    for pol in policies:
-        for ms in (pol.ingress, pol.egress):
-            for c in ms.contributions:
-                if c.identities:
-                    for i in c.identities:
-                        row_map.add(i)
+    port_class: np.ndarray  # [N_PROTO, 65536] global classes
+    n_classes: int
+    class_intervals: Dict[int, List[Tuple[int, int, int]]]
+    class_map: np.ndarray  # [n_pol, n_classes_padded] global -> local
+    local_bounds: List[Dict[int, np.ndarray]]
+    local_base: List[Dict[int, int]]
+    n_local_padded: int
 
+
+def class_structure(policies: Sequence[EndpointPolicy],
+                    class_pad: int = 128) -> ClassStructure:
+    """Global + per-policy-local port class partitions."""
     bounds = _collect_boundaries(policies)
     port_class = np.zeros((N_PROTO, 65536), dtype=np.int32)
     class_intervals: Dict[int, List[Tuple[int, int, int]]] = {}
@@ -290,47 +331,89 @@ def compile_policy(
             for lo, _hi, g in class_intervals[p]:
                 k = int(np.searchsorted(lb[p], lo, side="right")) - 1
                 class_map[pi, g] = local_base[pi][p] + k
+    return ClassStructure(
+        port_class=port_class, n_classes=n_classes,
+        class_intervals=class_intervals, class_map=class_map,
+        local_bounds=local_bounds, local_base=local_base,
+        n_local_padded=n_local_padded)
 
-    n_rows = row_map.capacity
-    n_pol = len(policies)
-    verdict = np.zeros((n_pol, 2, n_rows, n_local_padded),
-                       dtype=np.int32)
+
+def paint_policy(pol: EndpointPolicy, pi: int,
+                 struct: ClassStructure, row_map: IdentityRowMap,
+                 width: Optional[int] = None) -> np.ndarray:
+    """One policy's verdict slice [2, n_rows, width] — the per-policy
+    half of the compile, shared verbatim by :func:`compile_policy`
+    and the delta path.  ``width`` may exceed the structure's
+    ``n_local_padded`` (delta reuse into a wider existing tensor: the
+    extra padding classes keep the direction default, and the class
+    map never addresses them)."""
+    lb = struct.local_bounds[pi]
+    base = struct.local_base[pi]
+    width = struct.n_local_padded if width is None else width
+    out = np.zeros((2, row_map.capacity, width), dtype=np.int32)
+
+    def classes_for(proto: int, lo: int, hi: int) -> np.ndarray:
+        # contribution bounds are local boundaries by construction
+        k0 = int(np.searchsorted(lb[proto], lo, side="right")) - 1
+        k1 = int(np.searchsorted(lb[proto], hi, side="right")) - 1
+        return np.arange(base[proto] + k0, base[proto] + k1 + 1)
+
+    for di, ms in ((0, pol.ingress), (1, pol.egress)):
+        default = (pack_entry(VERDICT_DEFAULT_DENY) if ms.enforcing
+                   else pack_entry(VERDICT_ALLOW))
+        out[di, :, :] = default
+        for c, val in packed_scatter_order(ms):
+            protos = (range(N_PROTO) if c.proto == PROTO_ANY
+                      else [c.proto])
+            cls = np.unique(np.concatenate(
+                [classes_for(p, c.lo, c.hi) for p in protos]))
+            if c.identities is None:
+                out[di][:, cls] = val
+            else:
+                rows = row_map.rows_for(c.identities)
+                if rows.size:
+                    out[di][np.ix_(rows, cls)] = val
+    return out
+
+
+def ensure_identity_rows(policies: Sequence[EndpointPolicy],
+                         row_map: IdentityRowMap) -> None:
+    """Every identity referenced by any contribution gets a row."""
+    for pol in policies:
+        for ms in (pol.ingress, pol.egress):
+            for c in ms.contributions:
+                if c.identities:
+                    for i in c.identities:
+                        row_map.add(i)
+
+
+def compile_policy(
+    policies: Sequence[EndpointPolicy],
+    row_map: IdentityRowMap,
+    class_pad: int = 128,
+) -> PolicyTensors:
+    """Compile resolved endpoint policies into dense device tensors.
+
+    O(contributions x touched-rows) via vectorized numpy scatters; the
+    10k-identity benchmark set compiles in milliseconds.
+    """
+    ensure_identity_rows(policies, row_map)
+    struct = class_structure(policies, class_pad)
+
+    verdict = np.zeros((len(policies), 2, row_map.capacity,
+                        struct.n_local_padded), dtype=np.int32)
     policy_index: Dict[str, int] = {}
-
     for pi, pol in enumerate(policies):
-        lb = local_bounds[pi]
-        base = local_base[pi]
-
-        def classes_for(proto: int, lo: int, hi: int) -> np.ndarray:
-            # contribution bounds are local boundaries by construction
-            k0 = int(np.searchsorted(lb[proto], lo, side="right")) - 1
-            k1 = int(np.searchsorted(lb[proto], hi, side="right")) - 1
-            return np.arange(base[proto] + k0, base[proto] + k1 + 1)
-
         policy_index[pol.subject_labels.sorted_key()] = pi
-        for di, ms in ((0, pol.ingress), (1, pol.egress)):
-            default = (pack_entry(VERDICT_DEFAULT_DENY) if ms.enforcing
-                       else pack_entry(VERDICT_ALLOW))
-            verdict[pi, di, :, :] = default
-            for c, val in packed_scatter_order(ms):
-                protos = (range(N_PROTO) if c.proto == PROTO_ANY
-                          else [c.proto])
-                cls = np.unique(np.concatenate(
-                    [classes_for(p, c.lo, c.hi) for p in protos]))
-                if c.identities is None:
-                    verdict[pi, di][:, cls] = val
-                else:
-                    rows = row_map.rows_for(c.identities)
-                    if rows.size:
-                        verdict[pi, di][np.ix_(rows, cls)] = val
+        verdict[pi] = paint_policy(pol, pi, struct, row_map)
 
     return PolicyTensors(
         proto_table=make_proto_table(),
-        port_class=port_class,
-        n_classes=n_classes,
+        port_class=struct.port_class,
+        n_classes=struct.n_classes,
         verdict=verdict,
         policy_index=policy_index,
         row_map=row_map,
-        class_intervals=class_intervals,
-        class_map=class_map,
+        class_intervals=struct.class_intervals,
+        class_map=struct.class_map,
     )

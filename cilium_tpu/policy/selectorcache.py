@@ -40,6 +40,7 @@ class CachedSelector:
 class SelectorCache:
     def __init__(self, allocator: CachingIdentityAllocator):
         self._lock = threading.RLock()
+        # guarded-by: _lock: _selectors, _identities, _users
         self._allocator = allocator
         self._selectors: Dict[EndpointSelector, CachedSelector] = {}
         self._identities: Dict[int, Identity] = {}
@@ -47,6 +48,12 @@ class SelectorCache:
         allocator.observe(self._on_identity_change)
 
     # -- identity events (from the allocator) ----------------------------
+    # Runs on whatever thread mints/releases the identity (API, DNS
+    # proxy, kvstore watch dispatcher, the churn scenario driver) —
+    # and the user callbacks it fans into end in the loader's table
+    # publish, so the lock ORDER here is selectorcache -> (user) ->
+    # table-builder -> datapath-loader; nothing may call back into
+    # this cache while holding either loader lock.
     def _on_identity_change(self, kind: str, ident: Identity) -> None:
         with self._lock:
             if kind == "add":
@@ -64,6 +71,8 @@ class SelectorCache:
 
     def _notify(self, sel: EndpointSelector, added: Set[int],
                 removed: Set[int]) -> None:
+        # holds: _lock -- only _on_identity_change calls this (RLock:
+        # user callbacks may re-enter queries, not mutations)
         for fn in list(self._users):
             fn(sel, added, removed)
 

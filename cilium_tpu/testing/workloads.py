@@ -1,0 +1,181 @@
+"""Reusable workload scenario library (ROADMAP item 5 down payment).
+
+bench.py's overload/paced legs drive uniform synthetic flows; real
+clusters serve heavy-tailed traffic while the control plane churns
+under them.  This module factors that gap into NAMED, SEEDED
+scenarios: each one is a deterministic generator of driver events
+that both the chaos tests and ``bench.py`` replay — same seed, same
+schedule, byte for byte — with per-scenario pass criteria living in
+the caller (ledger exact, oracle match, p99 bounds).
+
+The registry is the extension point: later scenarios (SYN flood,
+port scan, NAT-exhaustion ramp, endpoint connect/disconnect churn,
+pcap replay — ROADMAP item 5's full list) slot in as new entries
+without touching any driver.
+
+First entry: ``identity_churn`` (ISSUE 10) — peer identities minted
+and withdrawn at a fixed rate over a pool of slots, slot choice
+Zipf-weighted (elephant peers churn often, mice rarely — the
+heavy-tail shape SelectorCache updates see in production).  Each
+mint drives BOTH incremental paths: the identity's labels join the
+selecting contributions (``patch_identity``) and its /32 lands in
+the ipcache (``patch_ipcache``); a withdraw unwinds both, so a
+slot's traffic verdict flips with its liveness — the pre/post
+oracle pair the churn chaos gate checks against.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ChurnOp:
+    """One scenario event: mint or withdraw slot ``slot``'s identity.
+
+    ``cidr`` is the slot's /32.  Minting allocates an identity for
+    the slot's labels (see :meth:`IdentityChurnScenario.slot_labels`
+    — rules select them via the ``k8s:churn=yes`` convention) and
+    upserts the /32; withdrawing deletes the ipcache entry and
+    releases the identity.  ``t_s`` is the op's offset from the
+    scenario start at the configured rate."""
+
+    kind: str  # "mint" | "withdraw"
+    slot: int
+    cidr: str
+    t_s: float
+
+
+class IdentityChurnScenario:
+    """Mint/withdraw CIDR identities at ``rate_hz``, Zipf-weighted
+    over ``n_slots`` peer slots.
+
+    Each slot alternates mint -> withdraw -> mint ... (an op on a
+    live slot withdraws it, on a dead slot mints it), so the op
+    stream is valid by construction and the live set follows the
+    Zipf weights.  Deterministic per (seed, n_slots, zipf_a,
+    rate_hz): the chaos gate and ``bench.py --churn`` replay the
+    same schedule.
+    """
+
+    name = "identity_churn"
+
+    def __init__(self, seed: int = 0, n_slots: int = 16,
+                 zipf_a: float = 1.3, rate_hz: float = 200.0,
+                 subnet: Tuple[int, int] = (10, 9)):
+        if n_slots < 1:
+            raise ValueError("n_slots must be >= 1")
+        if zipf_a <= 1.0:
+            raise ValueError("zipf_a must be > 1 (Zipf exponent)")
+        if rate_hz <= 0:
+            raise ValueError("rate_hz must be > 0")
+        self.seed = int(seed)
+        self.n_slots = int(n_slots)
+        self.zipf_a = float(zipf_a)
+        self.rate_hz = float(rate_hz)
+        self.interval_s = 1.0 / self.rate_hz
+        if self.n_slots > 65534:
+            raise ValueError("n_slots must fit the /16 slot space")
+        a, b = subnet
+        # host s+1 within the /16 (skips .0.0; (s+1) & 0xFF may be 0
+        # — x.y.z.0/32 is a valid host route)
+        self._cidrs = [f"{a}.{b}.{(s + 1) >> 8}.{(s + 1) & 0xFF}/32"
+                       for s in range(self.n_slots)]
+        # rank -> probability ~ 1/rank^a (normalized), slot i = rank
+        # i+1: slot 0 is the elephant peer
+        w = 1.0 / np.power(np.arange(1, self.n_slots + 1),
+                           self.zipf_a)
+        self._weights = w / w.sum()
+
+    def slot_cidr(self, slot: int) -> str:
+        return self._cidrs[slot]
+
+    def slot_ip(self, slot: int) -> str:
+        return self._cidrs[slot].rsplit("/", 1)[0]
+
+    def slot_labels(self, slot: int) -> List[str]:
+        """The slot identity's labels.  ``k8s:churn=yes`` is the
+        selection convention: a rule with ``fromEndpoints``
+        ``matchLabels {"churn": "yes"}`` admits exactly the LIVE
+        slots (a dead slot's /32 resolves to identity 0 and
+        default-denies) — deliberately NOT a ``fromCIDR`` rule,
+        whose covering-prefix identity would admit the whole subnet
+        regardless of slot liveness."""
+        return [f"k8s:app=churn{slot}", "k8s:churn=yes",
+                "k8s:ns=default"]
+
+    def ops(self, n: int) -> List[ChurnOp]:
+        """The first ``n`` ops of the schedule (deterministic)."""
+        return list(self.iter_ops(n))
+
+    def iter_ops(self, n: Optional[int] = None) -> Iterator[ChurnOp]:
+        rng = np.random.default_rng(self.seed)
+        live = [False] * self.n_slots
+        i = 0
+        while n is None or i < n:
+            slot = int(rng.choice(self.n_slots, p=self._weights))
+            kind = "withdraw" if live[slot] else "mint"
+            live[slot] = not live[slot]
+            yield ChurnOp(kind=kind, slot=slot,
+                          cidr=self._cidrs[slot],
+                          t_s=i * self.interval_s)
+            i += 1
+
+    # -- the daemon driver (chaos tests + bench share it) --------------
+    def apply(self, daemon, op: ChurnOp, live: Dict[int, object]
+              ) -> None:
+        """Apply one op against a live daemon.  ``live`` is the
+        caller's slot -> Identity map (the scenario owns the
+        schedule, the caller owns the handles).
+
+        Mint allocates the slot's labeled identity — the allocator
+        observer chain applies it to the selecting contributions and
+        patches its verdict row in place (``patch_identity``) — then
+        upserts the slot's /32 (``patch_ipcache``).  Withdraw
+        deletes the ipcache entry FIRST (no LPM entry may reference
+        the row when it recycles), then releases the identity."""
+        from ..labels import LabelSet
+
+        if op.kind == "mint":
+            ident = daemon.allocator.allocate(
+                LabelSet.parse(*self.slot_labels(op.slot)))
+            daemon.upsert_ipcache(op.cidr, ident.numeric_id,
+                                  source="generated")
+            live[op.slot] = ident
+        else:
+            ident = live.pop(op.slot, None)
+            if ident is not None:
+                daemon.delete_ipcache(op.cidr)
+                daemon.allocator.release(ident)
+
+    def drain(self, daemon, live: Dict[int, object]) -> None:
+        """Withdraw every surviving slot — the teardown both bench
+        legs and test cleanup use, so op semantics (field order,
+        withdraw steps) live only here."""
+        for slot in list(live):
+            self.apply(daemon, ChurnOp("withdraw", slot,
+                                       self.slot_cidr(slot), 0.0),
+                       live)
+
+
+# -- the registry ------------------------------------------------------
+# name -> scenario class; later entries (ROADMAP item 5: syn_flood,
+# port_scan, nat_exhaustion, endpoint_churn, pcap_replay) register
+# here and become runnable by name from tests and bench
+SCENARIOS = {
+    IdentityChurnScenario.name: IdentityChurnScenario,
+}
+
+
+def make_scenario(name: str, seed: int = 0, **kw):
+    """Instantiate a named scenario; unknown names list the registry
+    (the bench flag's error message)."""
+    cls = SCENARIOS.get(name)
+    if cls is None:
+        raise ValueError(
+            f"unknown scenario {name!r}; registered: "
+            f"{', '.join(sorted(SCENARIOS))}")
+    return cls(seed=seed, **kw)

@@ -681,6 +681,131 @@ class TestFoldedCheckers:
 # ---------------------------------------------------------------------
 # the live-repo gate (the acceptance criterion)
 # ---------------------------------------------------------------------
+# ---------------------------------------------------------------------
+# CTA009 generation discipline (ISSUE 10)
+# ---------------------------------------------------------------------
+class TestGenerationDiscipline:
+    _BAD = """
+        class L:
+            # active-tables: state, tensors, entries
+            def __init__(self):
+                self.state = None  # exempt
+
+            def sneaky(self):
+                self.state = 1
+                self.tensors.verdict[:, 2] = 7
+                out, self.state = f()
+                self.entries.pop("x", None)
+                del self.tensors
+                self.other = 9
+                v = self.state  # reads never flag
+
+            # table-swap-ok: the sanctioned publish path
+            def publish(self):
+                self.state = 2
+                self.entries["k"] = 3
+        """
+
+    def test_writes_outside_swap_ok_flag_with_lines(self, tmp_path):
+        from cilium_tpu.analysis import generation
+
+        repo = _mini_repo(tmp_path, {"m.py": self._BAD})
+        fs = generation.check(repo)
+        assert all(f.code == "CTA009" for f in fs)
+        # assignment, subscript-chain store, tuple target, mutator
+        # call, delete — one finding each, nothing else
+        lines = sorted(f.line for f in fs)
+        assert len(fs) == 5
+        msgs = "\n".join(f.message for f in fs)
+        assert "mutated via .pop()" in msgs
+        assert "deleted" in msgs
+        # sneaky() spans lines 8-14 of the dedented fixture
+        assert lines == [8, 9, 10, 11, 12]
+
+    def test_reasonless_swap_ok_is_a_finding_not_an_exemption(
+            self, tmp_path):
+        from cilium_tpu.analysis import generation
+
+        repo = _mini_repo(tmp_path, {"m.py": """
+            class L:
+                # active-tables: state
+                # table-swap-ok:
+                def publish(self):
+                    self.state = 2
+            """})
+        fs = generation.check(repo)
+        assert any("needs a reason" in f.message for f in fs)
+        assert any("without a" in f.message for f in fs)
+
+    def test_suppression_silences(self, tmp_path):
+        from cilium_tpu.analysis import generation
+
+        repo = _mini_repo(tmp_path, {"m.py": """
+            class L:
+                # active-tables: state
+                def hot(self):
+                    self.state = 1  # lint: disable=CTA009 -- test fixture
+            """})
+        assert generation.check(repo) == []
+
+    def test_nested_closure_inherits_the_builder_exemption(
+            self, tmp_path):
+        from cilium_tpu.analysis import generation
+
+        repo = _mini_repo(tmp_path, {"m.py": """
+            class L:
+                # active-tables: tensors
+                # table-swap-ok: builder -- mirrors painted post-flip
+                def patch(self):
+                    def mirrors():
+                        self.tensors.verdict[:, 1] = 0
+                    return mirrors
+            """})
+        assert generation.check(repo) == []
+
+    def test_loader_annotation_presence_floor(self, tmp_path):
+        """Deleting the loader's active-tables declarations (or the
+        annotated _publish_tables helper) fails tier-1 — the CTA002
+        tentpole-annotation idiom for the churn plane."""
+        from cilium_tpu.analysis import generation
+
+        real = open(os.path.join(
+            REPO, "cilium_tpu/datapath/loader.py")).read()
+        stripped = "\n".join(
+            ln for ln in real.splitlines()
+            if "active-tables:" not in ln)
+        repo = _mini_repo(tmp_path,
+                          {"datapath/loader.py": stripped})
+        msgs = [f.message for f in generation.check(repo)]
+        assert any("declares `state`" in m for m in msgs)
+        assert any("declares `oracle`" in m for m in msgs)
+        # ...and the real tree keeps all three anchors
+        assert not any(
+            "active-tables" in f.message
+            or "_publish_tables" in f.message
+            for f in generation.check(Repo(REPO)))
+
+    def test_bench_schema_floor(self, tmp_path):
+        import json
+
+        from cilium_tpu.analysis.generation import (BENCH_CHURN_KEYS,
+                                                    BENCH_SCHEMA,
+                                                    check_bench)
+
+        p = tmp_path / "BENCH_churn.json"
+        good = {k: 0 for k in BENCH_CHURN_KEYS}
+        good["schema"] = BENCH_SCHEMA
+        p.write_text(json.dumps(good))
+        assert check_bench(str(p)) == []
+        bad = dict(good)
+        del bad["swap_stall_p99_us"]
+        bad["schema"] = "bench-churn-v0"
+        p.write_text(json.dumps(bad))
+        msgs = check_bench(str(p))
+        assert any("swap_stall_p99_us" in m for m in msgs)
+        assert any("bench-churn-v0" in m for m in msgs)
+
+
 class TestLiveRepo:
     def test_analysis_clean_and_fast(self):
         """`python -m cilium_tpu.analysis` exits 0 on the repo: zero
