@@ -116,6 +116,20 @@ class DaemonConfig:
     # ep/dir streams) keeps the wide fallback shape either way.
     # start_serving(packed=...) overrides per session.
     serving_packed_ingest: bool = False
+    # K-batch superbatch dispatch (ISSUE 11): fuse up to K ready
+    # batches into ONE device dispatch — a lax.scan runs datapath +
+    # ring append for all K steps, so the drain loop's per-dispatch
+    # Python cost (lock window, arena bookkeeping, one jit call) is
+    # paid once per K batches.  Power of two; 1 disables.  K is a
+    # fallback-ladder rung property: demotion shrinks K before it
+    # would ever change mode.  Interaction with serving_max_wait_us:
+    # assembly never WAITS for K batches — it takes what is already
+    # queued (single-batch fallback below two full buckets), so tail
+    # latency at low offered load is unchanged; the tradeoff it DOES
+    # buy is generation-pinning latency (one dispatch pins one table
+    # generation for K batches — BENCH_churn measures it at K>1).
+    # start_serving(superbatch_k=...) overrides per session.
+    serving_superbatch_k: int = 1
     # -- the async event plane (serving/eventplane.py).  How many
     # drain windows the event-join worker's bounded queue may hold;
     # overflow drops the OLDEST offered window, counted
@@ -274,7 +288,8 @@ class Daemon:
         Without it the daemon allocates locally."""
         from ..kvstore import ClusterIdentitySync, KVStoreAllocatorBackend
         from ..serving import (validate_recovery_config,
-                               validate_serving_config)
+                               validate_serving_config,
+                               validate_superbatch_config)
 
         self.config = config or DaemonConfig()
         # serving knobs fail at CONSTRUCTION (config resolution hands
@@ -303,6 +318,9 @@ class Daemon:
             self.config.serving_demote_threshold,
             self.config.serving_promote_after,
             self.config.serving_promote_cooldown_s)
+        self.config.serving_superbatch_k, _ = (
+            validate_superbatch_config(
+                self.config.serving_superbatch_k))
         if self.config.ct_snapshot_interval < 0:
             raise ValueError("ct_snapshot_interval must be >= 0")
         self.config.serving_window_queue_depth = int(
@@ -1243,7 +1261,8 @@ class Daemon:
                       shard_headroom: int = 2,
                       span_sample: Optional[int] = None,
                       window_queue_depth: Optional[int] = None,
-                      event_gather: Optional[bool] = None) -> None:
+                      event_gather: Optional[bool] = None,
+                      superbatch_k: Optional[int] = None) -> None:
         """Switch to the SERVING monitor path: batches run through the
         fused datapath + device event-ring append (one dispatch, no
         per-packet host fetch), and only the compacted events cross to
@@ -1285,6 +1304,16 @@ class Daemon:
         thread's only event work is the 8-byte cursor sync + a queue
         push; decode / wide-column join / monitor fan-out all run on
         the worker.
+
+        ``superbatch_k`` (default: the ``serving_superbatch_k``
+        config knob) arms K-BATCH SUPERBATCH DISPATCH on the ingress
+        path: the drain loop fuses up to K ready batches into one
+        device dispatch (``lax.scan`` over the K steps — datapath +
+        ring append per step, one cursor sync per drain tick), so
+        per-dispatch Python cost is paid once per K batches.  K is a
+        fallback-ladder rung property (demotion shrinks K before
+        changing mode); assembly never waits for K batches, so
+        low-load latency is unchanged.  1 disables.
 
         ``mesh=...`` (a ``jax.sharding.Mesh`` or a device count)
         switches to MULTI-CHIP serving: each assembled bucket is
@@ -1353,6 +1382,15 @@ class Daemon:
         if event_gather is None:
             event_gather = self.config.serving_event_gather
         event_gather = bool(event_gather)
+        # K-batch superbatch dispatch (ISSUE 11): validate the
+        # per-session override exactly like the config knob — before
+        # any side effect below
+        from ..serving import validate_superbatch_config
+
+        if superbatch_k is None:
+            superbatch_k = self.config.serving_superbatch_k
+        superbatch_k, k_ladder = validate_superbatch_config(
+            superbatch_k)
         table = np.asarray(sorted(self.proxy.ports)[:MAX_PROXY_PORTS],
                            dtype=np.uint32)
         n_shards = 0
@@ -1448,7 +1486,12 @@ class Daemon:
                 rungs,
                 demote_threshold=cfg.serving_demote_threshold,
                 promote_after=cfg.serving_promote_after,
-                cooldown_s=cfg.serving_promote_cooldown_s),
+                cooldown_s=cfg.serving_promote_cooldown_s,
+                k_ladder=k_ladder),
+            # superbatch dispatch: the configured K ceiling (the
+            # ladder's live K can sit below it after demotions) —
+            # also stretches the drain tick's window retention
+            "superbatch_k": superbatch_k,
             # packed re-staging arena for the sharded path; same
             # recycling horizon as the batcher arena (routed/valid/
             # orig buffers ride windows onto the worker too)
@@ -1485,6 +1528,11 @@ class Daemon:
             deadline_s = cfg.serving_dispatch_deadline_ms * 1e-3
             runtime = ServingRuntime(
                 dispatch=self._serving_dispatch,
+                # the K-batch leg: the ladder's CURRENT (mode, K)
+                # rung decides the live K (sharded rungs pin K=1 —
+                # superbatching is a single-chip dispatch shape)
+                dispatch_super=self._serving_dispatch_super,
+                superbatch_k=self._serving["ladder"].k,
                 on_shed=self._publish_sheds,
                 on_recovery_drop=self._publish_recovery_drops,
                 queue_depth=cfg.serving_queue_depth,
@@ -1607,6 +1655,75 @@ class Daemon:
         return self.serve_batch(hdr, valid=valid,
                                 packed_meta=packed_meta)
 
+    def _serving_dispatch_super(self, sb):
+        # thread-affinity: drain
+        """The runtime's K-BATCH device leg (ISSUE 11) with the same
+        degraded-mode ladder wrap as :meth:`_serving_dispatch`: a
+        failure counts toward the rung's demotion threshold; at the
+        threshold the session demotes — shrinking K BEFORE changing
+        mode — and the triggering K batches retry ONE BY ONE on the
+        demoted rung (nothing recorded yet, so nothing
+        double-counts).  Below the threshold the failure is contained
+        exactly like a single-batch one."""
+        from ..serving import DispatchFailedError
+
+        s = self._serving
+        try:
+            info = self.serve_superbatch(sb)
+        except Exception as e:  # noqa: BLE001 — any device-leg fault
+            lad = s.get("ladder")
+            if lad is None:
+                raise
+            cause = f"{type(e).__name__}: {e}"
+            if not lad.record_failure(cause):
+                if lad.at_floor:
+                    raise  # not containable: escalate to the watchdog
+                raise DispatchFailedError(
+                    f"superbatch dispatch failed on rung "
+                    f"{lad.rung!r} k={lad.k} "
+                    f"({lad.fail_streak}/{lad.demote_threshold}): "
+                    f"{cause}") from e
+            self._serving_demote(cause)
+            info = self._serving_retry_super_steps(sb)
+            info["demoted"] = True
+        lad = s.get("ladder")
+        if (lad is not None and lad.record_success()
+                and s.get("runtime") is not None):
+            self._serving_promote()
+        return info
+
+    def _serving_retry_super_steps(self, sb) -> dict:
+        # thread-affinity: drain
+        """Retry a failed superbatch's steps one-by-one through the
+        single-batch device leg (the most conservative rung of the K
+        ladder) — a packed step unpacks host-side first when the
+        demotion also left packed mode."""
+        s = self._serving
+        bids, total_h2d, mode = [], 0, None
+        for k in range(sb.k):
+            hdr = sb.hdr[k]
+            meta = ((int(sb.eps[k]), int(sb.dirns[k]))
+                    if sb.packed else None)
+            if meta is not None and not s["packed"]:
+                from ..core.packets import unpack_rows_np
+
+                hdr = unpack_rows_np(np.asarray(hdr), *meta)
+                meta = None
+            info = self._serving_device_leg(hdr, sb.valid[k], meta)
+            if isinstance(info, dict):
+                bids.append(int(info.get("batch_id", -1)))
+                total_h2d += int(info.get("h2d_bytes", 0))
+                mode = info.get("mode", mode)
+            else:
+                bids.append(-1)
+        return {"h2d_bytes": total_h2d,
+                "mode": mode or ("packed" if s["packed"] else "wide"),
+                "bids": bids,
+                # K single dispatches actually ran — the dispatch
+                # scoreboard must not count the retry as one fused
+                # superbatch
+                "dispatches": sb.k}
+
     def _serving_demote(self, cause: str) -> None:
         # thread-affinity: drain, api
         """One rung down (drain-thread context).  sharded -> single:
@@ -1619,15 +1736,46 @@ class Daemon:
         import logging
 
         s = self._serving
-        old = s["ladder"].rung
-        new = s["ladder"].demote()
+        lad = s["ladder"]
+        old, old_k = lad.rung, lad.k
+        new = lad.demote()
+        from ..obs.flightrec import KIND_DEMOTION
+
+        if new == old:
+            # K-ONLY shrink (ISSUE 11): the mode keeps its
+            # capability, only the superbatch amortization drops —
+            # no ring/CT/placement mechanics, no warm-shape reset
+            # (each K is its own executable shape; the smaller K is
+            # already warm from before superbatching engaged, or
+            # compiles under the cold-shape deadline exemption)
+            # hot-path-ok: a LADDER DEMOTION is a rare contained-
+            # failure event, never per-batch
+            logging.getLogger(__name__).warning(
+                "serving ladder shrinks superbatch K %d -> %d on "
+                "rung %s: %s", old_k, lad.k, new, cause)
+            self.record_incident(KIND_DEMOTION,
+                                 {"from": f"{old}@k{old_k}",
+                                  "to": f"{new}@k{lad.k}",
+                                  "cause": cause})
+            runtime = s.get("runtime")
+            if runtime is not None:
+                runtime.superbatch_k = lad.k
+                # the triggering superbatch retries its steps
+                # one-by-one: a single-batch shape that never
+                # dispatched this session pays its XLA compile
+                # during the retry, and the in-flight registration
+                # (computed from the SUPERBATCH shape) would not be
+                # deadline-exempt — the watchdog would deadline the
+                # retry mid-flight and double-account rows whose
+                # device effects already landed.  Same discipline
+                # as the mode-demotion path below.
+                runtime.reset_warm_shapes()
+            return
         # hot-path-ok: a LADDER DEMOTION is a rare contained-failure
         # event (>= demote_threshold consecutive dispatch failures) —
         # the warning is part of the incident record, never per-batch
         logging.getLogger(__name__).warning(
             "serving ladder demotes %s -> %s: %s", old, new, cause)
-        from ..obs.flightrec import KIND_DEMOTION
-
         self.record_incident(KIND_DEMOTION,
                              {"from": old, "to": new, "cause": cause})
         if old == "sharded":
@@ -1684,6 +1832,8 @@ class Daemon:
         if runtime is not None:
             # single-chip rungs pack in the batcher; wide never does
             runtime.batcher.pack = s["packed"] and s["mesh"] is None
+            # a mode demotion enters the new mode at ITS best K
+            runtime.superbatch_k = lad.k
             # the demoted mode's executables compile on first
             # dispatch — not a hang
             runtime.reset_warm_shapes()
@@ -1701,8 +1851,21 @@ class Daemon:
         import logging
 
         s = self._serving
-        old = s["ladder"].rung
-        new = s["ladder"].promote()
+        lad = s["ladder"]
+        old, old_k = lad.rung, lad.k
+        new = lad.promote()
+        if new == old:
+            # K-ONLY growth: re-arm the superbatch amortization on
+            # the same mode — no placement/ring mechanics
+            # hot-path-ok: promotions happen at most once per
+            # cooldown_s (hysteresis-gated recovery)
+            logging.getLogger(__name__).info(
+                "serving ladder grows superbatch K %d -> %d on "
+                "rung %s", old_k, lad.k, new)
+            runtime = s.get("runtime")
+            if runtime is not None:
+                runtime.superbatch_k = lad.k
+            return
         # hot-path-ok: promotions happen at most once per cooldown_s
         # (hysteresis-gated recovery, not steady state)
         logging.getLogger(__name__).info(
@@ -1733,6 +1896,9 @@ class Daemon:
         runtime = s.get("runtime")
         if runtime is not None:
             runtime.batcher.pack = s["packed"] and s["mesh"] is None
+            # a mode promotion enters the better mode at its
+            # SMALLEST K (the inverse of demote's entry-at-best-K)
+            runtime.superbatch_k = lad.k
             runtime.reset_warm_shapes()
 
     def _publish_recovery_drops(self, rows: Optional[np.ndarray],
@@ -2021,6 +2187,63 @@ class Daemon:
             s["row_map_version"] = row_map.version
             s["numerics"] = row_map.numeric_array()
 
+    def serve_superbatch(self, sb, now: Optional[int] = None) -> dict:
+        # thread-affinity: drain, api
+        """K batches in ONE device dispatch (ISSUE 11): ``sb`` is the
+        batcher's :class:`~..serving.batcher.SuperBatch` — [K, bucket,
+        cols] rows + [K, bucket] valid masks.  Each inner step gets
+        its own batch id (``seq + k``, the same 13-bit wrap the ring
+        uses) and its own retained window record, so the event-join
+        worker decodes a superbatch window exactly like K single
+        batches; the drain tick still fires per DISPATCH, which is
+        the one-cursor-sync-per-K-batches the amortization buys.
+        Returns link accounting plus the per-step ``bids`` the
+        runtime's span sink needs."""
+        from ..serving import ServingNotStartedError
+
+        s = self._serving
+        if s is None:
+            raise ServingNotStartedError("call start_serving() first")
+        if s["mesh"] is not None:
+            # the sharded session's ring is per-chip and its state
+            # mesh-placed: feeding them to the single-chip superbatch
+            # executable would crash opaquely (or worse) — mirror
+            # serve_batch's explicit rejection.  The ladder pins K=1
+            # on the sharded rung, so the drain loop never gets here;
+            # this guards direct callers (warm-up scripts, operators)
+            raise ValueError(
+                "superbatch dispatch is a single-chip shape; "
+                "sharded serving flow-routes per batch (the ladder "
+                "pins K=1 on the sharded rung)")
+        if now is None:
+            now = self._now()
+        if s["seq"] - s["last_tick"] >= s["drain_every"]:
+            self._serving_drain_tick(s)
+        bid0 = s["seq"] & 0x1FFF
+        s["ring"], row_map = self.loader.serve_superbatch(
+            s["ring"], sb.hdr, now, bid0, eps=sb.eps, dirns=sb.dirns,
+            trace_sample=s["trace_sample"],
+            proxy_ports=s["table_dev"],
+            audit=self.config.policy_audit_mode,
+            valid=sb.valid, packed=sb.packed)
+        self._serving_snapshot_numerics(s, row_map)
+        ts = time.time()
+        kind = "packed" if sb.packed else "wide"
+        bids = []
+        for k in range(sb.k):
+            bid = (s["seq"] + k) & 0x1FFF
+            meta = ((int(sb.eps[k]), int(sb.dirns[k]))
+                    if sb.packed else None)
+            # per-step records retained by REFERENCE (views into the
+            # superbatch arena slot, whose per-dispatch recycling
+            # horizon spans K times more batches than a single slot)
+            s["window"][bid] = (kind, sb.hdr[k], meta, s["numerics"],
+                                ts)
+            bids.append(bid)
+        s["seq"] += sb.k
+        return {"h2d_bytes": sb.hdr.nbytes, "mode": f"super-{kind}",
+                "batch_id0": bid0, "bids": bids, "k": sb.k}
+
     def _serve_batch_sharded(self, s, hdr: np.ndarray, now: int,
                              bid: int, valid) -> dict:
         # thread-affinity: drain, api
@@ -2130,9 +2353,13 @@ class Daemon:
             window, records, spans, s["n_shards"],
             tracer=s.get("tracer"), seq=s["seq"]))
         # retain headers for the batches filling the next window plus
-        # one horizon of slack; in-flight windows hold their own refs
+        # one horizon of slack; in-flight windows hold their own refs.
+        # A superbatch advances seq by K in one dispatch, so a window
+        # spans up to drain_every + K - 1 batch records — the
+        # retention stretches by the configured K ceiling
         live = {(s["seq"] - 1 - i) & 0x1FFF
-                for i in range(2 * s["drain_every"])}
+                for i in range(2 * (s["drain_every"]
+                                    + s.get("superbatch_k", 1)))}
         for b in list(s["window"]):
             if b not in live:
                 del s["window"][b]

@@ -59,6 +59,10 @@ BENCH_CHURN_KEYS = (
     "sustained_pps", "sustained_pps_churn", "churn_ratio",
     "churn_ops", "churn_rate_hz",
     "update_visible_p50_us", "update_visible_p99_us",
+    # superbatch-granularity generation pinning (ISSUE 11): the K=8
+    # legs' update-visible latency and throughput ride the artifact
+    "superbatch_k", "sustained_pps_churn_k8", "churn_ratio_k8",
+    "update_visible_p50_us_k8", "update_visible_p99_us_k8",
     "swap_stall_p99_us", "swaps", "generation",
     "ledger_exact", "compile_violations",
 )

@@ -547,6 +547,7 @@ _SERVING_RATE_KEYS = (
     (("admitted",), "admitted"),
     (("shed",), "shed"),
     (("batches",), "batches"),
+    (("dispatch", "dispatches"), "dispatches"),
     (("verdicts",), "verdicts"),
     (("h2d", "bytes"), "h2d-bytes"),
     (("ring", "events"), "ring-events"),
@@ -644,6 +645,16 @@ def cmd_serving(args) -> int:
                       f"shed {st.get('shed', 0)} "
                       f"({st.get('shed-events', 0)} as drop events)")
                 print(f"Shapes:    {st.get('batch-shapes', {})}")
+                dp = st.get("dispatch") or {}
+                if dp.get("superbatches"):
+                    fill = dp.get("superbatch-fill")
+                    print(f"Dispatch:  {dp.get('dispatches', 0)} "
+                          f"dispatches, "
+                          f"{dp.get('batches-per-dispatch')} "
+                          f"batches/dispatch "
+                          f"({dp.get('superbatches', 0)} superbatches"
+                          f" {dp.get('superbatch-shapes', {})}, "
+                          f"fill {'-' if fill is None else fill})")
                 h2d = st.get("h2d") or {}
                 if h2d.get("packed-batches") or h2d.get("wide-batches"):
                     print(f"H2D:       {h2d.get('bytes-per-packet')} "

@@ -239,12 +239,19 @@ class TPULoader(Loader):
 
     def _serving_cache_size(self, mode: str) -> int:
         """Executable count backing one serving mode RIGHT NOW."""
-        from ..monitor.ring import serve_step_jit, serve_step_packed_jit
+        from ..monitor.ring import (serve_step_jit,
+                                    serve_step_packed_jit,
+                                    serve_superbatch_jit,
+                                    serve_superbatch_packed_jit)
 
         if mode == "wide":
             fn = serve_step_jit
         elif mode == "packed":
             fn = serve_step_packed_jit
+        elif mode == "super-wide":
+            fn = serve_superbatch_jit
+        elif mode == "super-packed":
+            fn = serve_superbatch_packed_jit
         else:  # sharded steps are per-(packed, sample, audit) jits
             return sum(
                 getattr(f, "_cache_size", lambda: 1)()
@@ -750,6 +757,71 @@ class TPULoader(Loader):
                 "packed", packed.shape, ring.capacity,
                 (int(trace_sample), bool(audit),
                  proxy_ports is not None, valid is not None),
+                before, after, time.monotonic() - t0)
+        return ring, row_map
+
+    def serve_superbatch(self, ring, hdr, now: int, batch_id0: int,
+                         eps=None, dirns=None,
+                         trace_sample: int = 1024,
+                         proxy_ports=None, audit: bool = False,
+                         valid=None, packed: bool = False):
+        # thread-affinity: drain, api
+        # table-swap-ok: dispatch-result swap — CT/metrics advance,
+        # policy+ipcache references carried unchanged
+        """The K-batch superbatch dispatch (ISSUE 11): ``hdr`` is
+        [K, bucket, 4] packed rows (``packed=True``, with ``eps``/
+        ``dirns`` [K] per-step stream scalars) or [K, bucket, N_COLS]
+        wide rows; ``valid`` [K, bucket] masks padding rows AND whole
+        empty trailing steps.  One lock window, one h2d staging copy,
+        one jit call for K batches — the Python per-dispatch cost the
+        drain loop pays is amortized K-fold
+        (monitor/ring.py serve_superbatch*).
+
+        Generation pinning: the scan captures ONE DatapathState, so
+        the whole superbatch serves a single table generation — a
+        concurrent publish flips wholly before or wholly after this
+        dispatch (re-proven at K>1 by the churn chaos gate)."""
+        from ..infra import faults
+        from ..monitor.ring import (serve_superbatch_jit,
+                                    serve_superbatch_packed_jit)
+
+        faults.check(faults.SITE_LOADER_SERVE_SUPER)
+        jnp = self._jnp
+        # staging before the lock: only the async dispatch is
+        # serialized (lock discipline in __init__)
+        if isinstance(hdr, np.ndarray):
+            hdr = jnp.asarray(np.ascontiguousarray(hdr))
+        if isinstance(valid, np.ndarray):
+            valid = jnp.asarray(valid)
+        if packed:
+            eps = jnp.asarray(
+                np.ascontiguousarray(eps, dtype=np.uint32))
+            dirns = jnp.asarray(
+                np.ascontiguousarray(dirns, dtype=np.uint32))
+        now, batch_id0 = jnp.uint32(now), jnp.uint32(batch_id0)
+        mode = "super-packed" if packed else "super-wide"
+        with self._lock:
+            before = self._serving_cache_size(mode)
+            t0 = time.monotonic()
+            if packed:
+                self.state, ring = serve_superbatch_packed_jit(
+                    self.state, ring, hdr, now, batch_id0, eps,
+                    dirns, trace_sample=trace_sample, valid=valid,
+                    proxy_ports=proxy_ports, audit=audit)
+            else:
+                self.state, ring = serve_superbatch_jit(
+                    self.state, ring, hdr, now, batch_id0,
+                    trace_sample=trace_sample, valid=valid,
+                    proxy_ports=proxy_ports, audit=audit)
+            after = self._serving_cache_size(mode)
+            row_map = self.row_map
+        if after > before:
+            # hdr.shape is (K, bucket, cols): K rides the shape, so
+            # the one-executable invariant keys on (rung, mode, K)
+            self._record_compile(
+                mode, hdr.shape, ring.capacity,
+                (int(trace_sample), bool(audit),
+                 proxy_ports is not None),
                 before, after, time.monotonic() - t0)
         return ring, row_map
 

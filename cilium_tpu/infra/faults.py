@@ -69,6 +69,10 @@ SITE_QUEUE_TAKE = "serving.queue.take"
 SITE_LOADER_SERVE = "loader.serve"
 SITE_LOADER_SERVE_PACKED = "loader.serve_packed"
 SITE_LOADER_SERVE_SHARDED = "loader.serve_sharded"
+# ...and the K-batch superbatch dispatch (ISSUE 11): a raise fails
+# the whole K-batch dispatch, which is exactly how the ladder's
+# K-shrink demotion path is exercised.
+SITE_LOADER_SERVE_SUPER = "loader.serve_super"
 # monitor/ring.py — the window swap / collect of the async drainer
 # (arm with ``~S`` for the ring-drain stall failure mode).
 SITE_RING_SWAP = "ring.swap"
@@ -104,6 +108,7 @@ SITES = frozenset({
     SITE_LOADER_SERVE,
     SITE_LOADER_SERVE_PACKED,
     SITE_LOADER_SERVE_SHARDED,
+    SITE_LOADER_SERVE_SUPER,
     SITE_RING_SWAP,
     SITE_RING_COLLECT,
     SITE_EVENT_JOIN,

@@ -213,6 +213,110 @@ serve_step_packed_jit = jax.jit(serve_step_packed, donate_argnums=(0, 1),
                                                  "audit"))
 
 
+# -- K-batch superbatch dispatch (the Python-dispatch diet) ----------
+# The datapath math was never the serving ceiling — the per-batch
+# Python dispatch (lock acquire, arena bookkeeping, one jit call) was
+# (ROADMAP item 1: BENCH_churn's no-churn leg reads 334k pps where
+# BENCH_serving sustains ~200-260k).  These steps fuse K batches into
+# ONE executable: a lax.scan over the K steps runs datapath + ring
+# append per step entirely on device, so the host pays one staging
+# copy, one lock window, and one dispatch per K batches.  Each K is
+# one compiled shape ([K, bucket, cols] — K rides the shape, so the
+# compile-log's one-executable guard keys on (rung, mode, K) for
+# free), which is why the serving plane restricts K to a small
+# power-of-two ladder (DaemonConfig.serving_superbatch_k).
+#
+# Per-step ``valid`` masks do double duty: within a step they mask
+# the adaptive batcher's padding rows exactly like serve_step, and a
+# trailing ALL-FALSE step masks an empty slot of a partially-filled
+# superbatch (the batcher rounds the ready-batch count up to the K
+# ladder) — an empty step touches neither CT, metrics, nor the ring,
+# and appends under a batch id the host never recorded.
+#
+# Atomicity note (the TableVersioner interplay): the whole scan
+# captures ONE ``state`` — a concurrent generation flip lands wholly
+# before or wholly after the dispatch, never between inner steps, so
+# superbatching cannot tear a table mid-scan; what it DOES stretch is
+# update-visible latency (one dispatch pins a generation for K
+# batches), which BENCH_churn measures at K>1.
+
+
+def serve_superbatch(state, ring: EventRing, hdr: jnp.ndarray,
+                     now: jnp.ndarray, batch_id0: jnp.ndarray,
+                     trace_sample: int = 1024,
+                     valid: jnp.ndarray = None,
+                     proxy_ports: jnp.ndarray = None,
+                     audit: bool = False):
+    """K wide batches in one dispatch: ``hdr`` [K, bucket, N_COLS],
+    ``valid`` [K, bucket] (REQUIRED — the empty-step masking above
+    depends on it), batch ids ``batch_id0 + k`` per step (the ring's
+    13-bit field wraps them exactly like the host's seq mask).
+    Returns (state, ring) after all K steps."""
+    from ..datapath.verdict import datapath_step
+
+    assert valid is not None, "superbatch dispatch requires valid masks"
+    K = hdr.shape[0]
+
+    def body(carry, xs):
+        st, rg = carry
+        hdr_k, valid_k, k = xs
+        out, st = datapath_step(st, hdr_k, now, valid=valid_k,
+                                audit=audit)
+        rg = ring_append(rg, out, batch_id0 + k,
+                         trace_sample=trace_sample, valid=valid_k,
+                         proxy_ports=proxy_ports)
+        return (st, rg), None
+
+    xs = (hdr, valid, jnp.arange(K, dtype=jnp.uint32))
+    (state, ring), _ = jax.lax.scan(body, (state, ring), xs)
+    return state, ring
+
+
+serve_superbatch_jit = jax.jit(serve_superbatch, donate_argnums=(0, 1),
+                               static_argnames=("trace_sample",
+                                                "audit"))
+
+
+def serve_superbatch_packed(state, ring: EventRing,
+                            packed: jnp.ndarray, now: jnp.ndarray,
+                            batch_id0: jnp.ndarray,
+                            eps: jnp.ndarray, dirns: jnp.ndarray,
+                            trace_sample: int = 1024,
+                            valid: jnp.ndarray = None,
+                            proxy_ports: jnp.ndarray = None,
+                            audit: bool = False):
+    """K packed batches in one dispatch: ``packed`` [K, bucket, 4]
+    (16 B/packet on the h2d link, 4x fewer bytes AND one copy for K
+    batches), ``eps``/``dirns`` [K] per-step stream-metadata scalars,
+    ``valid`` [K, bucket].  On-device unpack + datapath + ring append
+    per scan step; same empty-step semantics as
+    :func:`serve_superbatch`."""
+    from ..datapath.verdict import datapath_step_packed
+
+    assert valid is not None, "superbatch dispatch requires valid masks"
+    K = packed.shape[0]
+
+    def body(carry, xs):
+        st, rg = carry
+        hdr_k, valid_k, ep_k, dirn_k, k = xs
+        out, st = datapath_step_packed(st, hdr_k, now, ep_k, dirn_k,
+                                       valid=valid_k, audit=audit)
+        rg = ring_append(rg, out, batch_id0 + k,
+                         trace_sample=trace_sample, valid=valid_k,
+                         proxy_ports=proxy_ports)
+        return (st, rg), None
+
+    xs = (packed, valid, eps, dirns, jnp.arange(K, dtype=jnp.uint32))
+    (state, ring), _ = jax.lax.scan(body, (state, ring), xs)
+    return state, ring
+
+
+serve_superbatch_packed_jit = jax.jit(serve_superbatch_packed,
+                                      donate_argnums=(0, 1),
+                                      static_argnames=("trace_sample",
+                                                       "audit"))
+
+
 # -- occupancy-bounded drain (the d2h diet) ---------------------------
 # The fetched window's byte count should scale with the EVENTS the
 # window appended, not the ring's capacity: `swap` already blocks on

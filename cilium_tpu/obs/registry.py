@@ -232,6 +232,19 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                 lambda: sv("shed"))
     reg.counter("cilium_serving_batches_total",
                 "serving batches dispatched", lambda: sv("batches"))
+    # the K-batch superbatch scoreboard (ISSUE 11): device dispatches
+    # vs batches — batches/dispatches > 1 IS the amortization the
+    # fused K-batch scan buys; the fill gauge defends the no-empty-
+    # steps assembly (real rows / rows shipped in superbatches)
+    reg.counter("cilium_serving_dispatches_total",
+                "device dispatches (a superbatch carries K batches)",
+                lambda: sv("dispatch", "dispatches"))
+    reg.counter("cilium_serving_superbatches_total",
+                "dispatches that carried K > 1 fused batches",
+                lambda: sv("dispatch", "superbatches"))
+    reg.gauge("cilium_serving_batches_per_dispatch",
+              "batches per device dispatch (superbatch amortization)",
+              lambda: sv("dispatch", "batches-per-dispatch"))
     reg.counter("cilium_serving_h2d_bytes_total",
                 "host->device header bytes shipped (padding included)",
                 lambda: sv("h2d", "bytes"))

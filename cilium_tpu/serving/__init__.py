@@ -95,6 +95,26 @@ def validate_serving_config(queue_depth: int, bucket_ladder,
     return depth, ladder, wait, overflow_policy
 
 
+def validate_superbatch_config(superbatch_k) -> tuple:
+    """Validate ``serving_superbatch_k``; returns ``(k_max,
+    k_ladder)`` where ``k_ladder`` is the power-of-two K rung set
+    {1, 2, ..., k_max} the fallback ladder walks.  Same contract as
+    the validators above: a bad K fails at daemon construction, not
+    as a compiled-shape explosion under load (each K is one
+    executable per bucket rung)."""
+    k = int(superbatch_k)
+    if k < 1 or k & (k - 1):
+        raise ValueError(
+            f"serving_superbatch_k {k} must be a power of two >= 1 "
+            "(each K is one compiled executable per bucket rung; the "
+            "K ladder exists to bound them; 1 disables superbatching)")
+    ladder, v = [], 1
+    while v <= k:
+        ladder.append(v)
+        v <<= 1
+    return k, tuple(ladder)
+
+
 def validate_recovery_config(dispatch_deadline_ms, restart_budget,
                              restart_backoff_ms, demote_threshold,
                              promote_after,
@@ -149,4 +169,5 @@ __all__ = [
     "ServingStats",
     "validate_recovery_config",
     "validate_serving_config",
+    "validate_superbatch_config",
 ]

@@ -79,6 +79,41 @@ class AssembledBatch(NamedTuple):
     spans: tuple = ()
 
 
+class SuperBatch(NamedTuple):
+    """K batches fused into ONE device dispatch (ISSUE 11): the
+    drain loop pays its per-dispatch Python cost (lock window, arena
+    bookkeeping, one jit call) once per K batches.  Every step is a
+    FULL top-rung bucket — :meth:`AdaptiveBatcher.assemble_super`
+    rounds the ready-batch count DOWN to a power-of-two K, so no
+    device math is wasted on empty steps and per-step valid masks are
+    all-true (they still ship: one compiled shape per (bucket, K)).
+
+    ``hdr``/``valid`` are ``steps=K`` arena slots under the same
+    recycling-horizon contract as single batches — a superbatch slot
+    is handed out per DISPATCH, so it recycles after ``depth`` more
+    superbatches of the same shape, which is K times LONGER in batch
+    units than the single-batch horizon the consumer is sized for."""
+
+    hdr: np.ndarray  # [K, bucket, N_COLS] u32, or [K, bucket, 4]
+    valid: np.ndarray  # [K, bucket] bool
+    bucket: int
+    arrivals: List[Tuple[int, float]]  # merged (count, t) chunks
+    packed: bool = False
+    eps: Optional[np.ndarray] = None  # [K] u32 per-step stream meta
+    dirns: Optional[np.ndarray] = None  # (packed superbatches only)
+    # per-step span tuples, len K (empty tuple when tracing is off)
+    spans: tuple = ()
+
+    @property
+    def k(self) -> int:
+        return self.hdr.shape[0]
+
+    @property
+    def n_valid(self) -> int:
+        # every step is a full bucket (assemble_super's contract)
+        return self.hdr.shape[0] * self.bucket
+
+
 class BucketArena:
     """Preallocated per-(bucket, width) staging slots, recycled
     round-robin.  Slots allocate lazily on first use of a shape, so
@@ -92,17 +127,22 @@ class BucketArena:
         self._next: Dict[tuple, int] = {}
 
     def slot(self, bucket: int, cols: int,
-             dtype=np.uint32) -> np.ndarray:
+             dtype=np.uint32, steps: int = 0) -> np.ndarray:
         # thread-affinity: drain, api
         """Next staging buffer for this shape ([bucket, cols], or
-        [bucket] when cols is 0).  The caller owns it for the next
-        ``depth - 1`` requests of the SAME shape (see module doc)."""
-        key = (int(bucket), int(cols), np.dtype(dtype).str)
+        [bucket] when cols is 0; ``steps=K`` prepends a superbatch
+        axis: [K, bucket, cols]).  The caller owns it for the next
+        ``depth - 1`` requests of the SAME shape (see module doc) —
+        superbatch slots are requested per DISPATCH, so their horizon
+        in batch units is K times the single-batch one."""
+        key = (int(steps), int(bucket), int(cols),
+               np.dtype(dtype).str)
         pool = self._slots.get(key)
         if pool is None:
-            shape = ((self.depth, bucket, cols) if cols
-                     else (self.depth, bucket))
-            pool = np.zeros(shape, dtype=dtype)
+            shape = (bucket, cols) if cols else (bucket,)
+            if steps:
+                shape = (steps,) + shape
+            pool = np.zeros((self.depth,) + shape, dtype=dtype)
             self._slots[key] = pool
         i = self._next.get(key, 0)
         self._next[key] = (i + 1) % self.depth
@@ -234,6 +274,91 @@ class AdaptiveBatcher:
         return AssembledBatch(hdr=hdr, valid=valid, n_valid=n,
                               arrivals=arrivals, packed=packed,
                               ep=ep, dirn=dirn, spans=spans)
+
+    def assemble_super(self, queue: IngressQueue, k_max: int,
+                       now: Optional[float] = None,
+                       force: bool = False):
+        # thread-affinity: drain, api
+        """Multi-batch assembly (ISSUE 11): when at least TWO full
+        top-rung buckets are pending, dequeue K of them — K rounded
+        DOWN to the largest power of two <= min(k_max, ready) so no
+        step is ever padded whole — in ONE exception-atomic
+        ``take_into`` against a ``steps=K`` arena slot, and return a
+        :class:`SuperBatch` for the fused K-batch dispatch.
+
+        Anything less rides the single-batch path unchanged (the
+        adaptive K=1 fallback): a partial bucket keeps its own
+        deadline semantics and per-batch pack eligibility, so low
+        offered load sees byte-identical behavior to ``assemble`` —
+        superbatching only engages when the queue is deep enough that
+        dispatch amortization is the binding constraint.
+
+        Packed wire format: the K steps dequeue into the WIDE slot
+        first (it doubles as staging), each step's eligibility is
+        checked independently, and only an all-eligible superbatch
+        re-packs into the 16 B/packet slot — per-step ``eps``/
+        ``dirns`` ride along, so steps need not share a stream."""
+        if now is None:
+            now = time.monotonic()
+        if not force and not self.due(queue, now):
+            return None
+        cap = self.ladder[-1]
+        ready = queue.pending // cap
+        if int(k_max) < 2 or ready < 2:
+            return self.assemble(queue, now=now, force=force)
+        K = 1
+        while K * 2 <= min(int(k_max), ready):
+            K *= 2
+        w = queue.row_width()
+        if w is None:
+            return None
+        wide = self.arena.slot(cap, w, steps=K)
+        # ONE locked, exception-atomic dequeue for all K steps: the
+        # drain thread is the only consumer, so the K*cap rows seen
+        # pending above cannot shrink before the take
+        n, arrivals = queue.take_into(wide.reshape(K * cap, w))
+        assert n == K * cap, f"superbatch dequeue got {n}/{K * cap}"
+        deq = (queue.pop_dequeued_spans()
+               if queue.tracer is not None else [])
+        try:
+            packed, eps, dirns, hdr = False, None, None, wide
+            if self.pack:
+                from ..core.packets import (PACKED_COLS,
+                                            pack_eligibility,
+                                            pack_rows)
+
+                metas = [pack_eligibility(wide[k]) for k in range(K)]
+                if all(m[0] for m in metas):
+                    hdr = self.arena.slot(cap, PACKED_COLS, steps=K)
+                    for k in range(K):
+                        pack_rows(wide[k], out=hdr[k])
+                    packed = True
+                    eps = np.fromiter((m[1] for m in metas),
+                                      dtype=np.uint32, count=K)
+                    dirns = np.fromiter((m[2] for m in metas),
+                                        dtype=np.uint32, count=K)
+            valid = self.arena.slot(cap, 0, dtype=bool, steps=K)
+            valid[:] = True  # every step is a full bucket
+        except BaseException:
+            if deq:
+                queue.tracer.evict(sp for _pos, sp in deq)
+            raise
+        spans: tuple = ()
+        if deq:
+            from ..obs.trace import STAGE_STAGED
+
+            t_staged = time.monotonic()
+            per_step: List[list] = [[] for _ in range(K)]
+            for pos, sp in deq:
+                sp.ts[STAGE_STAGED] = t_staged
+                sp.batch_pos = pos % cap
+                sp.bucket = cap
+                sp.n_valid = cap
+                per_step[pos // cap].append(sp)
+            spans = tuple(tuple(s) for s in per_step)
+        return SuperBatch(hdr=hdr, valid=valid, bucket=cap,
+                          arrivals=arrivals, packed=packed,
+                          eps=eps, dirns=dirns, spans=spans)
 
     def time_to_deadline(self, queue: IngressQueue,
                          now: Optional[float] = None) -> float:

@@ -60,7 +60,8 @@ class FallbackLadder:
     reads from API threads are snapshot-style (``to_dict``)."""
 
     def __init__(self, rungs: List[str], demote_threshold: int = 3,
-                 promote_after: int = 64, cooldown_s: float = 5.0):
+                 promote_after: int = 64, cooldown_s: float = 5.0,
+                 k_ladder=(1,)):
         if not rungs:
             raise ValueError("ladder needs at least one rung")
         order = [r for r in RUNG_ORDER if r in rungs]
@@ -69,9 +70,22 @@ class FallbackLadder:
                              f"{RUNG_ORDER}")
         self.rungs = tuple(order)
         self.rung = self.rungs[0]  # start at the best the config has
+        # the superbatch K dimension (ISSUE 11): K is a RUNG PROPERTY
+        # — demotion shrinks K one step before it would ever change
+        # mode (a K-related fault costs amortization, not capability),
+        # and the floor is the last mode at K=1.  The sharded rung
+        # pins K=1 (superbatching is a single-chip dispatch shape;
+        # the router re-routes per batch), so sharded sessions walk
+        # the K ladder only after demoting off the mesh.  Default
+        # (1,) keeps the pre-superbatch ladder byte-identical.
+        kl = tuple(sorted(set(int(k) for k in k_ladder)))
+        if not kl or kl[0] < 1:
+            raise ValueError(f"k_ladder must be >= 1, got {k_ladder!r}")
+        self.k_ladder = kl
         self.demote_threshold = int(demote_threshold)
         self.promote_after = int(promote_after)
         self.cooldown_s = float(cooldown_s)
+        self._k_idx = len(self._k_options()) - 1  # best K of the rung
         self.fail_streak = 0
         self.ok_streak = 0
         self.demotions = 0
@@ -79,13 +93,23 @@ class FallbackLadder:
         self.last_change: Optional[float] = None  # monotonic
         self.last_cause = ""
 
+    def _k_options(self):
+        """The K rungs the CURRENT mode can run (sharded pins 1)."""
+        return self.k_ladder if self.rung != RUNG_SHARDED else (1,)
+
+    @property
+    def k(self) -> int:
+        """The superbatch K of the current (mode, K) rung."""
+        return self._k_options()[self._k_idx]
+
     @property
     def at_floor(self) -> bool:
-        return self.rung == self.rungs[-1]
+        return self.rung == self.rungs[-1] and self._k_idx == 0
 
     @property
     def degraded(self) -> bool:
-        return self.rung != self.rungs[0]
+        return (self.rung != self.rungs[0]
+                or self._k_idx != len(self._k_options()) - 1)
 
     def record_failure(self, cause: str = "") -> bool:
         # thread-affinity: drain, api
@@ -119,10 +143,18 @@ class FallbackLadder:
 
     def demote(self) -> str:
         # thread-affinity: drain, api
-        """Step one rung down; returns the new rung."""
-        i = self.rungs.index(self.rung)
-        assert i + 1 < len(self.rungs), "cannot demote past the floor"
-        self.rung = self.rungs[i + 1]
+        """Step one (mode, K) rung down; returns the (possibly
+        unchanged) mode rung.  K shrinks FIRST: only at K=1 does the
+        mode itself demote — entering the next mode at ITS best K
+        (the new mode's executables are fresh capability; the K tax
+        re-proves itself there)."""
+        assert not self.at_floor, "cannot demote past the floor"
+        if self._k_idx > 0:
+            self._k_idx -= 1
+        else:
+            i = self.rungs.index(self.rung)
+            self.rung = self.rungs[i + 1]
+            self._k_idx = len(self._k_options()) - 1
         self.demotions += 1
         self.fail_streak = 0
         self.ok_streak = 0
@@ -131,10 +163,18 @@ class FallbackLadder:
 
     def promote(self) -> str:
         # thread-affinity: drain, api
-        """Step one rung up; returns the new rung."""
-        i = self.rungs.index(self.rung)
-        assert i > 0, "already at the top rung"
-        self.rung = self.rungs[i - 1]
+        """Step one (mode, K) rung up (the exact inverse of
+        :meth:`demote`'s walk); returns the mode rung.  K grows back
+        to the mode's best before the mode itself promotes, and a
+        mode promotion enters the better mode at its SMALLEST K."""
+        opts = self._k_options()
+        if self._k_idx < len(opts) - 1:
+            self._k_idx += 1
+        else:
+            i = self.rungs.index(self.rung)
+            assert i > 0, "already at the top rung"
+            self.rung = self.rungs[i - 1]
+            self._k_idx = 0
         self.promotions += 1
         self.fail_streak = 0
         self.ok_streak = 0
@@ -145,6 +185,8 @@ class FallbackLadder:
         return {
             "rung": self.rung,
             "rungs": list(self.rungs),
+            "k": self.k,
+            "k-ladder": list(self.k_ladder),
             "degraded": self.degraded,
             "demotions": self.demotions,
             "promotions": self.promotions,

@@ -118,7 +118,9 @@ class ServingRuntime:
                  on_restart: Optional[Callable[[str, bool], None]]
                  = None,
                  profile_dir: Optional[str] = None,
-                 profile_batches: int = 0):
+                 profile_batches: int = 0,
+                 dispatch_super: Optional[Callable] = None,
+                 superbatch_k: int = 1):
         from .batcher import DEFAULT_ARENA_DEPTH
 
         depth, ladder, wait, policy = validate_serving_config(
@@ -133,6 +135,15 @@ class ServingRuntime:
             arena_depth=arena_depth or DEFAULT_ARENA_DEPTH)
         self.stats = ServingStats()
         self._dispatch = dispatch
+        # K-batch superbatch dispatch (ISSUE 11): when armed
+        # (dispatch_super given AND superbatch_k > 1) the drain loop
+        # assembles up to K ready batches per device dispatch —
+        # Python dispatch cost amortized K-fold.  superbatch_k is
+        # MUTABLE from the ladder (a K-shrink demotion writes it, the
+        # drain loop reads it once per assembly — benign int race,
+        # next assembly sees the new K)
+        self._dispatch_super = dispatch_super
+        self.superbatch_k = max(int(superbatch_k), 1)
         self._on_shed = on_shed
         self._on_recovery_drop = on_recovery_drop
         # row width the datapath expects (N_COLS): a malformed chunk
@@ -447,10 +458,20 @@ class ServingRuntime:
 
     def _loop_body(self, gen: int) -> None:
         # thread-affinity: drain
+        from .batcher import SuperBatch
+
         while not self._stop.is_set() and self._gen_is(gen):
-            batch = self.batcher.assemble(self.queue)
+            k_max = self.superbatch_k
+            if k_max > 1 and self._dispatch_super is not None:
+                batch = self.batcher.assemble_super(self.queue,
+                                                    k_max)
+            else:
+                batch = self.batcher.assemble(self.queue)
             if batch is not None:
-                self._dispatch_one(batch, gen)
+                if isinstance(batch, SuperBatch):
+                    self._dispatch_one_super(batch, gen)
+                else:
+                    self._dispatch_one(batch, gen)
                 continue
             # idle: stamp the last batch's completion now rather than
             # at the next dispatch (which may never come — an idle
@@ -612,11 +633,122 @@ class ServingRuntime:
                                 batch.arrivals, t0, packed=packed,
                                 h2d_bytes=(h2d if h2d is not None
                                            else batch.hdr.nbytes))
+        self.stats.record_dispatch(1)
         if self._prev_arrivals:
             self.stats.record_completion(self._prev_arrivals, t1)
         self._complete_spans(t1)
         self._prev_arrivals = batch.arrivals
         self._prev_spans = spans
+        self._flush_sheds()
+        if self._profile_state == "active":
+            self._profile_count += 1
+            if self._profile_count >= self._profile_batches:
+                self._profile_stop()
+
+    def _dispatch_one_super(self, sb, gen: int) -> None:
+        # thread-affinity: drain
+        """The K-batch flavor of :meth:`_dispatch_one`: same
+        registration / generation / warm-shape / accounting
+        discipline, one device dispatch for ``sb.k`` batches.  The
+        in-flight registration carries the whole SuperBatch, so a
+        death or hang accounts all K batches' rows exactly like a
+        single lost batch would."""
+        from . import DispatchFailedError
+
+        if self._profile_state == "armed":
+            self._profile_start()
+        t0 = time.monotonic()
+        flat_spans = [sp for step in sb.spans for sp in step]
+        if flat_spans:
+            from ..obs.trace import STAGE_DISPATCH
+
+            for sp in flat_spans:
+                sp.ts[STAGE_DISPATCH] = t0
+        shape = (sb.hdr.shape, sb.packed)
+        with self._rec_lock:
+            self._inflight = (gen, t0, sb,
+                              shape not in self._warm_shapes,
+                              self._warm_gen)
+        faults.check(faults.SITE_SERVING_DISPATCH,
+                     abort=lambda: (not self._gen_is(gen)
+                                    or self._stop.is_set()))
+        with self._rec_lock:
+            if self._gen != gen:
+                return  # deadlined while wedged (see _dispatch_one)
+        try:
+            info = self._dispatch_super(sb)
+        except DispatchFailedError:
+            self.stats.record_dispatch_failure()
+            with self._rec_lock:
+                mine = (self._inflight is not None
+                        and self._inflight[0] == gen)
+                if mine:
+                    self._inflight = None
+            if mine:
+                self._account_lost(sb, timeout_flavor=False)
+            self._flush_sheds()
+            return
+        t1 = time.monotonic()
+        with self._rec_lock:
+            if self._gen != gen:
+                return  # late wake after watchdog recovery
+            inflight, self._inflight = self._inflight, None
+            if (inflight is not None
+                    and inflight[4] == self._warm_gen):
+                self._warm_shapes.add(shape)
+        h2d, mode = None, ("packed" if sb.packed else "wide")
+        packed = sb.packed
+        demoted, bids, n_disp = False, (), 1
+        if isinstance(info, dict):
+            h2d = info.get("h2d_bytes")
+            if "mode" in info:
+                # recompute the wire format from what actually
+                # shipped: a mode-demoted per-step retry of a packed
+                # superbatch ships WIDE rows (same recompute the
+                # single-batch path does)
+                mode = info["mode"]
+                packed = "packed" in mode
+            demoted = bool(info.get("demoted"))
+            bids = tuple(info.get("bids", ()))
+            # a demoted retry ran K single dispatches, not one fused
+            # one — the dispatch scoreboard must count what happened
+            n_disp = int(info.get("dispatches", 1))
+        if flat_spans:
+            from ..obs.trace import STAGE_DISPATCH_RET
+
+            leftover = []
+            for k, step_spans in enumerate(sb.spans):
+                if not step_spans:
+                    continue
+                bid = bids[k] if k < len(bids) else -1
+                for sp in step_spans:
+                    sp.ts[STAGE_DISPATCH_RET] = t1
+                    sp.mode = mode
+                    sp.demoted = demoted
+                    sp.batch_id = bid
+                if (self._span_sink is not None and bid >= 0
+                        and self._span_sink(bid, tuple(step_spans))):
+                    continue  # the async event plane owns them now
+                leftover.extend(step_spans)
+            flat_spans = leftover
+        # per-step batch accounting keeps every existing counter's
+        # meaning (batches counts INNER batches); the dispatch
+        # amortization shows up in dispatches/batches-per-dispatch.
+        # h2d bytes for the whole superbatch land on step 0.
+        total_h2d = h2d if h2d is not None else sb.hdr.nbytes
+        for k in range(sb.k):
+            self.stats.record_batch(
+                sb.bucket, sb.bucket,
+                sb.arrivals if k == 0 else [], t0, packed=packed,
+                h2d_bytes=total_h2d if k == 0 else 0)
+        self.stats.record_dispatch(sb.k, rows_real=sb.n_valid,
+                                   rows_shipped=sb.k * sb.bucket,
+                                   dispatches=n_disp)
+        if self._prev_arrivals:
+            self.stats.record_completion(self._prev_arrivals, t1)
+        self._complete_spans(t1)
+        self._prev_arrivals = sb.arrivals
+        self._prev_spans = tuple(flat_spans)
         self._flush_sheds()
         if self._profile_state == "active":
             self._profile_count += 1
@@ -778,19 +910,24 @@ class ServingRuntime:
         except Exception:  # noqa: BLE001 — an incident hook must
             pass  # never cost the recovery it describes
 
-    def _account_lost(self, batch: AssembledBatch,
+    def _account_lost(self, batch,
                       timeout_flavor: bool) -> None:
         # thread-affinity: drain, watchdog, api
-        """One lost batch -> counted recovery drops + decoded DROP
-        events.  ``timeout_flavor`` picks REASON_DISPATCH_TIMEOUT
-        (watchdog deadline) over REASON_RECOVERY_DROP."""
+        """One lost batch (or SuperBatch — all K inner batches) ->
+        counted recovery drops + decoded DROP events.
+        ``timeout_flavor`` picks REASON_DISPATCH_TIMEOUT (watchdog
+        deadline) over REASON_RECOVERY_DROP."""
         from ..datapath.verdict import (REASON_DISPATCH_TIMEOUT,
                                         REASON_RECOVERY_DROP)
+        from .batcher import SuperBatch
 
-        if batch.spans and self._tracer is not None:
+        sup = isinstance(batch, SuperBatch)
+        spans = ([sp for step in batch.spans for sp in step]
+                 if sup else batch.spans)
+        if spans and self._tracer is not None:
             # the batch died before the join boundary: its spans are
             # counted losses, never completed traces
-            self._tracer.evict(batch.spans)
+            self._tracer.evict(spans)
         n = batch.n_valid
         if n == 0:
             return
@@ -799,7 +936,18 @@ class ServingRuntime:
             # the batcher emits prefix-valid buckets; reconstruct wide
             # rows for event synthesis (COPY — the hdr is an arena
             # slot that recycles under the next generation)
-            if batch.packed:
+            if sup and batch.packed:
+                from ..core.packets import unpack_rows_np
+
+                rows = np.concatenate([
+                    unpack_rows_np(np.asarray(batch.hdr[k]),
+                                   int(batch.eps[k]),
+                                   int(batch.dirns[k]))
+                    for k in range(batch.k)])
+            elif sup:
+                rows = np.array(batch.hdr, copy=True).reshape(
+                    n, batch.hdr.shape[2])
+            elif batch.packed:
                 from ..core.packets import unpack_rows_np
 
                 rows = unpack_rows_np(np.asarray(batch.hdr[:n]),

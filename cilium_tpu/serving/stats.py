@@ -108,6 +108,20 @@ class ServingStats:
         self.packed_batches = 0
         self.wide_batches = 0
         self.h2d_bytes = 0
+        # superbatch dispatch scoreboard (ISSUE 11): device DISPATCHES
+        # vs batches — the amortization the K-batch scan buys is
+        # batches/dispatches > 1.  Fill tracks real rows vs rows
+        # shipped across superbatch dispatches (the round-down
+        # assembly keeps every step a full bucket, so fill defends
+        # the no-empty-steps design at 1.0).
+        # guarded-by: _lock: dispatches, superbatches,
+        # guarded-by: _lock: super_rows_real, super_rows_shipped,
+        # guarded-by: _lock: super_shapes
+        self.dispatches = 0  # device dispatches (single + super)
+        self.superbatches = 0  # ...of which carried K > 1 batches
+        self.super_rows_real = 0
+        self.super_rows_shipped = 0
+        self.super_shapes: Dict[int, int] = {}  # K -> dispatches
         self.queue_wait = LatencyHistogram()  # arrival -> dispatch
         self.latency = LatencyHistogram()  # arrival -> events emitted
         # fault-tolerance plane (serving/runtime.py watchdog): the
@@ -169,6 +183,24 @@ class ServingStats:
             for count, t in arrivals:
                 if count:
                     self.queue_wait.record((t_dispatch - t) * 1e6)
+
+    def record_dispatch(self, batches: int, rows_real: int = 0,
+                        rows_shipped: int = 0,
+                        dispatches: int = 1) -> None:
+        """``dispatches`` DEVICE dispatches carried ``batches`` inner
+        batches (1/1 on the single-batch path; K/1 for a fused
+        superbatch; K/K for a demoted superbatch retried one step at
+        a time — which therefore does NOT count as a superbatch).
+        ``rows_real``/``rows_shipped`` feed the fill-efficiency
+        read."""
+        with self._lock:
+            self.dispatches += dispatches
+            if batches > 1 and dispatches == 1:
+                self.superbatches += 1
+                self.super_rows_real += rows_real
+                self.super_rows_shipped += rows_shipped
+                self.super_shapes[batches] = (
+                    self.super_shapes.get(batches, 0) + 1)
 
     def record_recovery_drops(self, count: int, timeout: bool,
                               events: int = 0) -> None:
@@ -234,6 +266,22 @@ class ServingStats:
                     # so a mostly-padded session reads honestly worse
                     "bytes-per-packet": round(self.h2d_bytes / real, 2)
                     if real else None,
+                },
+                # the superbatch scoreboard: batches-per-dispatch is
+                # THE amortization number the K-batch scan exists for
+                "dispatch": {
+                    "dispatches": self.dispatches,
+                    "batches-per-dispatch": round(
+                        self.batches / self.dispatches, 3)
+                    if self.dispatches else None,
+                    "superbatches": self.superbatches,
+                    "superbatch-shapes": {
+                        str(k): v for k, v in
+                        sorted(self.super_shapes.items())},
+                    "superbatch-fill": round(
+                        self.super_rows_real
+                        / self.super_rows_shipped, 4)
+                    if self.super_rows_shipped else None,
                 },
                 "queue-pending": queue_pending,
                 "queue-depth": queue_depth,

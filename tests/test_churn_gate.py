@@ -640,7 +640,7 @@ class TestChurnChaosGate:
     bounded, zero serving recompiles, generation strictly grows."""
 
     def _run_leg(self, d, db, sc, n_batches=36, ops_every=2,
-                 fault_tolerant=False):
+                 fault_tolerant=False, superbatch_k=1, burst=1):
         sports = iter(range(30000, 60000))
         batches, kinds = [], {}
         for _ in range(n_batches):
@@ -649,8 +649,37 @@ class TestChurnChaosGate:
             kinds.update(k)
         got = []
         d.monitor.register("churn-gate", got.append)
+        if superbatch_k > 1:
+            # warm the K-batch superbatch executables in a throwaway
+            # session with sports OUTSIDE the oracle's key space (a
+            # re-dispatched oracle batch would shift its CT verdicts
+            # away from the fresh-world oracle): the compile-count
+            # freeze below must only see CHURN-caused retraces
+            from cilium_tpu.serving.batcher import SuperBatch
+
+            warm = make_batch([
+                dict(src="10.0.1.1", dst="10.0.2.1",
+                     sport=60000 + i, dport=5432, proto=6,
+                     flags=TCP_SYN, ep=db.id, dir=0)
+                for i in range(64)]).data
+            ok, ep, dirn = pack_eligibility(warm)
+            assert ok
+            pw = pack_rows(warm)
+            d.start_serving(ring_capacity=1 << 12, drain_every=2,
+                            trace_sample=1, packed=True)
+            K = 2
+            while K <= superbatch_k:
+                d.serve_superbatch(SuperBatch(
+                    hdr=np.stack([pw] * K),
+                    valid=np.ones((K, 64), dtype=bool),
+                    bucket=64, arrivals=[], packed=True,
+                    eps=np.full(K, ep, np.uint32),
+                    dirns=np.full(K, dirn, np.uint32)))
+                K *= 2
+            d.stop_serving()
         d.start_serving(ring_capacity=1 << 12, drain_every=2,
-                        trace_sample=1, packed=True, ingress=True)
+                        trace_sample=1, packed=True, ingress=True,
+                        superbatch_k=superbatch_k)
         # warm the packed executable, then freeze the compile count:
         # the churn leg must not grow it
         d.submit(batches[0])
@@ -671,9 +700,14 @@ class TestChurnChaosGate:
         live = {}
         ops = iter(sc.iter_ops())
         applied = 0
-        for i, wide in enumerate(batches[1:]):
-            d.submit(wide)
-            if i % ops_every == 0:
+        rest = batches[1:]
+        # burst > 1 (the superbatch legs): submit enough full buckets
+        # per step that assemble_super finds >= 2 ready and the fused
+        # K-batch dispatch actually engages under churn
+        for i in range(0, len(rest), burst):
+            for wide in rest[i:i + burst]:
+                d.submit(wide)
+            if (i // burst) % ops_every == 0:
                 try:
                     sc.apply(d, next(ops), live)
                     applied += 1
@@ -706,6 +740,26 @@ class TestChurnChaosGate:
         fe, _ft = self._run_leg(d, db, sc)
         assert fe["verdicts"] > 0
         assert d.loader.table_stats()["generation"] >= 1
+        d.shutdown()
+
+    def test_superbatch_k8_generation_pinning(self):
+        """ISSUE 11 satellite: the churn gate at SUPERBATCH
+        granularity.  A K-batch dispatch captures ONE DatapathState
+        for the whole lax.scan, so a concurrent generation flip lands
+        wholly before or wholly after it — every device verdict must
+        still match a pre- or post-flip oracle with NO torn hybrid
+        inside one scan, the ledger exact, zero serving recompiles,
+        and superbatches provably engaged during the churn."""
+        d, db = _daemon(serving_queue_depth=1 << 14)
+        sc = make_scenario("identity_churn", seed=19, n_slots=6,
+                           rate_hz=500.0)
+        fe, _ft = self._run_leg(d, db, sc, n_batches=129,
+                                ops_every=1, superbatch_k=8,
+                                burst=16)
+        dp = fe["dispatch"]
+        assert dp["superbatches"] > 0, \
+            "superbatch dispatch never engaged under churn"
+        assert dp["batches-per-dispatch"] > 1
         d.shutdown()
 
     def test_mid_swap_drain_death_never_publishes_half_built(self):
