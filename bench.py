@@ -1875,6 +1875,94 @@ def _run_churn_phase() -> None:
     print(json.dumps(out))
 
 
+def bench_scenarios(seed: int = 31) -> dict:
+    """--scenarios: the adversarial scenario engine (ISSUE 12) ->
+    BENCH_scenarios.json.
+
+    Every registered scenario (``testing/workloads.SCENARIOS``) runs
+    once through the shared :func:`run_scenario` driver against a
+    fresh daemon built from the scenario's own ``daemon_overrides``
+    (the pressure shape it declares — a 1k-entry CT map for
+    ``syn_flood``, a 256-port SNAT pool for ``nat_exhaustion``) and
+    is judged against its DECLARED pass criteria.  Per-scenario
+    sustained pps, shed fraction, pressure counters, and pass/fail
+    land in the artifact; ``all_passed`` is the regression gate.
+    Schema-checked by the CTA010 machinery (importable
+    ``check_bench`` in ``cilium_tpu.analysis.scenario_lint``).
+
+    CPU-bounded numbers (the standing caveat): pps here defends the
+    DRIVER's honesty (ledger exact under each hostile shape), not
+    device throughput — --serving/--churn own the speed story."""
+    from cilium_tpu.testing.workloads import (SCENARIOS,
+                                              make_scenario,
+                                              run_scenario,
+                                              scenario_daemon)
+
+    results = {}
+    for name in sorted(SCENARIOS):
+        sc = make_scenario(name, seed=seed)
+        d = None
+        try:
+            # construction/start INSIDE the guard: one scenario's
+            # bad daemon shape must not abort the whole sweep either
+            d = scenario_daemon(sc, map_pressure_interval=0.25)
+            d.start()
+            r = run_scenario(d, sc)
+            m = r["metrics"]
+            results[name] = {
+                "seed": r["seed"],
+                "criteria": r["criteria"],
+                "checks": r["checks"],
+                "passed": r["passed"],
+                "sustained_pps": m["sustained_pps"],
+                "shed_frac": m["shed_frac"],
+                "p99_us": m["p99_us"],
+                "packets": m["submitted"],
+                "ops_applied": m["ops_applied"],
+                "ct_insert_drops": m["ct_insert_drops"],
+                "nat_failures": m["nat_failures"],
+                "drop_frac": m["drop_frac"],
+                "pressure_state": d.pressure.stats()["state"],
+                "pressure_episodes": d.pressure.stats()["episodes"],
+            }
+        except Exception as e:  # one hostile shape failing must not
+            results[name] = {  # hide the rest of the sweep
+                "seed": seed, "criteria": dict(sc.criteria),
+                "checks": {}, "passed": False,
+                "sustained_pps": 0.0, "shed_frac": None,
+                "error": f"{type(e).__name__}: {e}"[:200],
+            }
+        finally:
+            if d is not None:
+                d.shutdown()
+    return {
+        "schema": "bench-scenarios-v1",
+        "scenarios": results,
+        "all_passed": all(r.get("passed") for r in results.values()),
+        "note": ("each scenario runs the shared run_scenario driver "
+                 "against a fresh daemon built from its own "
+                 "daemon_overrides and is judged against its "
+                 "DECLARED criteria; pps is CPU-bounded and defends "
+                 "ledger exactness under hostile shapes, not device "
+                 "throughput"),
+    }
+
+
+def _run_scenarios_phase() -> None:
+    """--scenarios: the adversarial scenario phase standalone (one
+    JSON line).  Also writes BENCH_scenarios.json next to this file;
+    schema-checked by the CTA010 bench machinery."""
+    import os
+
+    out = bench_scenarios()
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_scenarios.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out))
+
+
 def bench_cluster(target_packets=49152, reps=3) -> dict:
     """--cluster: the clustermesh serving tier phase (ISSUE 8) ->
     BENCH_cluster.json.
@@ -2202,6 +2290,7 @@ def main() -> None:
     recovery = _phase_subprocess("--recovery")
     cluster = _phase_subprocess("--cluster")
     churn = _phase_subprocess("--churn")
+    scenarios = _phase_subprocess("--scenarios")
     artifact = _phase_subprocess("--artifact")
     l7 = bench_l7()
     anomaly = bench_anomaly()
@@ -2221,6 +2310,7 @@ def main() -> None:
         "recovery": recovery,
         "cluster": cluster,
         "churn": churn,
+        "scenarios": scenarios,
         "d2h_artifact": artifact,
         "l7": l7,
         "encryption": encryption,
@@ -2252,5 +2342,7 @@ if __name__ == "__main__":
         _run_cluster_phase()
     elif "--churn" in sys.argv:
         _run_churn_phase()
+    elif "--scenarios" in sys.argv:
+        _run_scenarios_phase()
     else:
         main()

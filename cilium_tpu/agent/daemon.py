@@ -276,6 +276,30 @@ class DaemonConfig:
     # this many ms (the flip is supposed to be a pointer swap; a slow
     # one means device work leaked inside the lock).  0 = off
     policy_swap_warn_ms: float = 0.0
+    # -- map-pressure graceful degradation (datapath/pressure.py;
+    # ISSUE 12 — the ctmap adaptive-GC / map-pressure-gauge
+    # analogue).  A named controller samples CT occupancy, insert-
+    # drop rate, and NAT pool failures off the drain thread; crossing
+    # a threshold accelerates the CT aging sweep, records ONE
+    # `map-pressure` incident per episode (sysdump capture), and
+    # surfaces the state in serving stats / GET /serving / CLI.
+    # sample cadence in seconds; 0 disables the monitor entirely
+    map_pressure_interval: float = 5.0
+    # CT occupancy fraction (occupied slots / capacity) entering the
+    # pressure state...
+    ct_pressure_threshold: float = 0.85
+    # ...and the hysteresis exit: pressure clears only once occupancy
+    # falls back under this AND a sample window sees no new insert
+    # drops / NAT failures (a storm cannot flap incidents)
+    ct_pressure_clear: float = 0.70
+    # the ACCELERATED CT aging-sweep cadence while under pressure
+    # (ct_gc_interval is the normal cadence it returns to)
+    ct_gc_pressure_interval: float = 1.0
+    # SNAT port-pool size (service/nat.py NATTable): power of two,
+    # pool must fit the port space above NAT_PORT_MIN.  None = the
+    # NAT_DEFAULT_CAPACITY (1 << 14).  Small pools are the
+    # nat_exhaustion scenario's pressure shape
+    nat_pool_capacity: Optional[int] = None
 
 
 class Daemon:
@@ -365,13 +389,44 @@ class Daemon:
             self.config.policy_swap_warn_ms)
         if self.config.policy_swap_warn_ms < 0:
             raise ValueError("policy_swap_warn_ms must be >= 0")
+        # map-pressure knobs (datapath/pressure.py) + the SNAT pool
+        # size: fail at construction like every serving knob
+        from ..datapath.pressure import validate_pressure_config
+
+        (self.config.map_pressure_interval,
+         self.config.ct_pressure_threshold,
+         self.config.ct_pressure_clear,
+         self.config.ct_gc_pressure_interval
+         ) = validate_pressure_config(
+            self.config.map_pressure_interval,
+            self.config.ct_pressure_threshold,
+            self.config.ct_pressure_clear,
+            self.config.ct_gc_pressure_interval)
+        if self.config.nat_pool_capacity is not None:
+            # NAT_PORT_MIN is the single pool-base authority
+            # (service/nat.py); NATTable.create re-validates — this
+            # check exists so the failure names the config knob, not
+            # a lazy first-masquerade deep in a serving leg
+            from ..service.nat import NAT_PORT_MIN
+
+            cap = int(self.config.nat_pool_capacity)
+            if cap < 8 or cap & (cap - 1) \
+                    or NAT_PORT_MIN + cap > 65536:
+                raise ValueError(
+                    f"nat_pool_capacity must be a power of two with "
+                    f"NAT_PORT_MIN + capacity <= 65536 (the pool is "
+                    f"[{NAT_PORT_MIN}, {NAT_PORT_MIN} + capacity) "
+                    f"node ports)")
+            self.config.nat_pool_capacity = cap
         if self.config.backend == "tpu":
             self.loader: Loader = TPULoader(
                 self.config.ct_capacity,
                 delta_compile=self.config.policy_delta_compile,
-                swap_warn_ms=self.config.policy_swap_warn_ms)
+                swap_warn_ms=self.config.policy_swap_warn_ms,
+                nat_capacity=self.config.nat_pool_capacity)
         else:
-            self.loader = InterpreterLoader()
+            self.loader = InterpreterLoader(
+                nat_capacity=self.config.nat_pool_capacity)
         self.endpoints = EndpointManager(self.repo, self.ipcache,
                                          self.loader)
         self.monitor = MonitorAgent()
@@ -495,6 +550,22 @@ class Daemon:
             on_incident=self.record_incident,
             enabled=self.config.flow_agg_enabled)
         self.monitor.register("analytics", self.analytics.submit)
+        # map-pressure monitor + graceful degradation
+        # (datapath/pressure.py): samples CT occupancy / insert-drop
+        # rate / NAT pool failures on a named controller (started in
+        # start()), accelerates the CT aging sweep under pressure,
+        # and records a `map-pressure` incident per episode
+        from ..datapath.pressure import MapPressureMonitor
+
+        self.pressure = MapPressureMonitor(
+            sample_fn=lambda: self.loader.map_pressure(self._now()),
+            on_accelerate=self._ct_gc_accelerate,
+            on_restore=self._ct_gc_restore,
+            record_incident=self.record_incident,
+            ct_threshold=self.config.ct_pressure_threshold,
+            ct_clear=self.config.ct_pressure_clear,
+            gc_pressure_interval_s=self.config
+            .ct_gc_pressure_interval)
         # hubble-relay analogue: add_relay_peer() builds it lazily;
         # when peers exist the sysdump bundle carries a relay-merged
         # flow sample stamped with node names
@@ -763,6 +834,7 @@ class Daemon:
                 lambda: self.analytics.snapshot(top=16))
         section("metrics", self.registry.render)
         section("ct-snapshot", self.ct_snapshot_info)
+        section("pressure", self.pressure.stats)
         if self.relay is not None:
             section("relay-flows", lambda: self.relay.get_flows(
                 number=min(cfg.sysdump_flows, 64)))
@@ -819,13 +891,46 @@ class Daemon:
                 return
         self.repo.invalidate()  # also triggers regeneration
 
+    # -- graceful degradation (datapath/pressure.py hooks) -------------
+    def _ct_gc_schedule(self, interval: float) -> None:
+        """(Re-)register the CT aging sweep at ``interval`` — ONE
+        definition for start(), patch_config, and the pressure
+        monitor's accelerate/restore transitions."""
+        self.controllers.update(
+            "ct-gc", lambda: self.loader.gc(self._now()), interval)
+
+    def _ct_gc_accelerate(self, interval: float) -> None:
+        # thread-affinity: api -- the map-pressure controller thread
+        """Pressure entered: accelerate the aging sweep and run one
+        NOW (the ctmap adaptive-GC response)."""
+        if not self._started:
+            return
+        self._ct_gc_schedule(interval)
+        c = self.controllers.get("ct-gc")
+        if c is not None:
+            c.trigger()
+
+    def _ct_gc_restore(self) -> None:
+        # thread-affinity: api -- the map-pressure controller thread
+        """Pressure cleared: back to the configured cadence."""
+        if not self._started:
+            return
+        self._ct_gc_schedule(self.config.ct_gc_interval)
+
     # -- lifecycle ----------------------------------------------------
     def start(self) -> None:
         """Start background controllers (CT GC, fqdn TTL GC)."""
         self._started = True
-        self.controllers.update(
-            "ct-gc", lambda: self.loader.gc(self._now()),
-            self.config.ct_gc_interval)
+        self._ct_gc_schedule(self.config.ct_gc_interval)
+        if self.config.map_pressure_interval > 0:
+            # one SYNCHRONOUS warm sample before the controller
+            # ticks: compiles the occupancy executable while no
+            # serving session's compile-count freeze is live, and
+            # seeds the insert-drop/NAT-failure delta baselines
+            self.pressure.sample()
+            self.controllers.update(
+                "map-pressure", self.pressure.sample,
+                self.config.map_pressure_interval)
         self.controllers.update(
             "fqdn-gc", self.fqdn.gc, self.config.fqdn_gc_interval)
         if self.auth_manager is not None:
@@ -2043,7 +2148,11 @@ class Daemon:
                "ring": {"windows": d.windows, "events": d.events,
                         "lost": d.lost},
                "event-plane": s["eventplane"].stats(),
-               "analytics": self.analytics.stats()}
+               "analytics": self.analytics.stats(),
+               # the map-pressure block (datapath/pressure.py):
+               # cached last sample + state machine — never touches
+               # the device at render time
+               "pressure": self.pressure.stats()}
         if s["n_shards"]:
             out["shards"] = s["n_shards"]
             out["route-overflow"] = s["route_overflow"]
@@ -2725,9 +2834,15 @@ class Daemon:
         # re-arm controllers whose cadence changed
         if self._started:
             if "ct-gc-interval" in changed:
-                self.controllers.update(
-                    "ct-gc", lambda: self.loader.gc(self._now()),
-                    self.config.ct_gc_interval)
+                # serialized against the monitor's state transitions
+                # (monitor lock): a LIVE pressure episode keeps the
+                # accelerated cadence — the monitor only accelerates
+                # on the OK->PRESSURE edge, so an unsynchronized
+                # reset here would silently cancel the response for
+                # the rest of the episode; the new normal cadence
+                # applies once the episode exits
+                self.pressure.resync(self.config.ct_gc_interval,
+                                     self._ct_gc_schedule)
             if "fqdn-gc-interval" in changed:
                 self.controllers.update(
                     "fqdn-gc", self.fqdn.gc,
@@ -2773,6 +2888,7 @@ class Daemon:
             # incident insertion on worker/watchdog threads)
             "incidents": self.flightrec.stats()["incidents"],
             "flow-aggregation": self.analytics.stats(),
+            "map-pressure": self.pressure.stats(),
             "controllers": {
                 n: {"success": s.success_count, "failure": s.failure_count,
                     "last-error": s.last_error.splitlines()[-1]

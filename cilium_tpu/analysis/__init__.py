@@ -75,6 +75,13 @@ CTA009    generation discipline: a class's declared
           loader module must keep its ``state``/``oracle``
           declarations and annotated ``_publish_tables`` helper, and
           ``BENCH_churn.json`` (when present) must keep its schema
+CTA010    scenario contract: every class registered in the
+          ``testing/workloads.py`` ``SCENARIOS`` registry declares a
+          docstring, a ``name`` literal, a ``criteria`` dict literal
+          drawn from the known-criteria vocabulary, and a ``seed``
+          constructor parameter (the determinism contract); the
+          ``BENCH_scenarios.json`` artifact (when present) must keep
+          its schema (``scripts/check_scenarios.py`` is the shim CLI)
 ========  ===========================================================
 
 Annotation grammar

@@ -52,6 +52,12 @@ REQUIRED_SERIES = (
     "cilium_policy_swaps_total",
     "cilium_policy_swap_latency_us",
     "cilium_policy_update_visible_us",
+    # map-pressure graceful degradation (datapath/pressure.py): the
+    # CT/NAT pressure floor — an invisible pressure state means the
+    # accelerated-GC response cannot be correlated with its cause
+    "cilium_ct_occupancy",
+    "cilium_ct_insert_drops_total",
+    "cilium_nat_pool_failures_total",
     # long-standing anchors (a registry rewrite that loses these
     # fails here, not on a dashboard)
     "cilium_datapath_packets_total",

@@ -1,0 +1,215 @@
+"""CTA010 — scenario contract: every registered adversarial scenario
+declares its identity and pass criteria; the scenario bench artifact
+keeps its schema.
+
+The scenario engine (``testing/workloads.py``) is regression-gated
+COVERAGE: tests, the everything-on soak gate, and ``bench.py
+--scenarios`` all replay scenarios BY NAME from the ``SCENARIOS``
+registry and judge them against criteria the scenario itself
+declares.  A registry entry missing its declaration half silently
+weakens every consumer, so it is enforced statically (the
+CTA008/CTA009 idiom):
+
+1. every class registered in ``SCENARIOS`` must
+   - carry a DOCSTRING (what hostile shape it reproduces),
+   - bind a ``name`` string literal in its class body (the registry
+     key / bench artifact key),
+   - bind a ``criteria`` dict literal in its class body (the
+     declared pass criteria ``run_scenario`` evaluates), and
+   - take a ``seed`` parameter in ``__init__`` (same name+seed =>
+     byte-identical streams — the determinism contract);
+2. every ``criteria`` key must come from the
+   :data:`KNOWN_CRITERIA` vocabulary — ``evaluate_criteria`` fails
+   unknown keys at runtime, and this closes the loop at lint time;
+3. when ``BENCH_scenarios.json`` exists at the repo root it carries
+   the :data:`BENCH_SCENARIO_KEYS` floor per scenario entry
+   (``check_bench`` is the importable validator bench and tests
+   share; ``scripts/check_scenarios.py`` is the shim CLI).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional
+
+from .core import FileCtx, Finding, Repo
+
+CODE = "CTA010"
+NAME = "scenario-contract"
+
+WORKLOADS_MODULE = "cilium_tpu/testing/workloads.py"
+
+# the criteria vocabulary evaluate_criteria understands (keep in sync
+# with testing/workloads.py — a key added there without a branch here
+# fails the live-repo gate, which is the point)
+KNOWN_CRITERIA = (
+    "ledger_exact", "max_shed_frac", "p99_ms",
+    "min_ct_insert_drops", "min_nat_failures", "min_drop_frac",
+)
+
+BENCH_NAME = "BENCH_scenarios.json"
+BENCH_SCHEMA = "bench-scenarios-v1"
+# per-scenario keys the bench artifact must carry (the acceptance
+# surface: sustained pps, shed fraction, pass/fail vs criteria)
+BENCH_SCENARIO_KEYS = (
+    "seed", "sustained_pps", "shed_frac", "passed", "checks",
+    "criteria",
+)
+
+
+def _registry_classes(ctx: FileCtx) -> Optional[List[str]]:
+    """Class names registered in the SCENARIOS dict literal (values
+    are plain Names; ``Cls.name: Cls`` keys resolve via the value)."""
+    for node in ctx.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "SCENARIOS" \
+                and isinstance(node.value, ast.Dict):
+            return [v.id for v in node.value.values
+                    if isinstance(v, ast.Name)]
+    return None
+
+
+def _class_str_attr(cls: ast.ClassDef, name: str) -> Optional[str]:
+    for node in cls.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            return node.value.value
+    return None
+
+
+def _class_dict_attr(cls: ast.ClassDef,
+                     name: str) -> Optional[ast.Dict]:
+    for node in cls.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, ast.Dict):
+            return node.value
+    return None
+
+
+def _init_has_seed(cls: ast.ClassDef) -> bool:
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "__init__":
+            args = node.args
+            names = [a.arg for a in args.args] \
+                + [a.arg for a in args.kwonlyargs]
+            return "seed" in names
+    return False  # no __init__ at all: no seed parameter
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    ctx = repo.by_rel(WORKLOADS_MODULE)
+    if ctx is None or ctx.tree is None:
+        return [Finding(CODE, WORKLOADS_MODULE, 1,
+                        "scenario module missing", checker=NAME)]
+    registered = _registry_classes(ctx)
+    if registered is None:
+        return [Finding(
+            CODE, ctx.rel, 1,
+            "SCENARIOS dict literal not found (the scenario "
+            "registry tests/bench/the soak gate replay from)",
+            checker=NAME)]
+    if not registered:
+        findings.append(Finding(
+            CODE, ctx.rel, 1, "SCENARIOS registry is empty",
+            checker=NAME))
+    by_name: Dict[str, ast.ClassDef] = {
+        node.name: node for node in ctx.tree.body
+        if isinstance(node, ast.ClassDef)}
+    for cls_name in registered:
+        cls = by_name.get(cls_name)
+        if cls is None:
+            findings.append(Finding(
+                CODE, ctx.rel, 1,
+                f"SCENARIOS registers {cls_name!r} but no such "
+                f"class is defined in the module", checker=NAME))
+            continue
+        line = cls.lineno
+        if ctx.suppressed(CODE, line):
+            continue
+        if not ast.get_docstring(cls):
+            findings.append(Finding(
+                CODE, ctx.rel, line,
+                f"scenario {cls_name} has no docstring (say what "
+                f"hostile shape it reproduces)", checker=NAME))
+        if _class_str_attr(cls, "name") is None:
+            findings.append(Finding(
+                CODE, ctx.rel, line,
+                f"scenario {cls_name} does not bind a `name` "
+                f"string literal in its class body (the registry "
+                f"key)", checker=NAME))
+        crit = _class_dict_attr(cls, "criteria")
+        if crit is None:
+            findings.append(Finding(
+                CODE, ctx.rel, line,
+                f"scenario {cls_name} does not declare a "
+                f"`criteria` dict literal (the pass criteria "
+                f"run_scenario evaluates)", checker=NAME))
+        else:
+            for k in crit.keys:
+                if isinstance(k, ast.Constant) \
+                        and k.value not in KNOWN_CRITERIA:
+                    findings.append(Finding(
+                        CODE, ctx.rel, k.lineno,
+                        f"scenario {cls_name} declares unknown "
+                        f"criterion {k.value!r} (known: "
+                        f"{', '.join(KNOWN_CRITERIA)})",
+                        checker=NAME))
+        if not _init_has_seed(cls):
+            findings.append(Finding(
+                CODE, ctx.rel, line,
+                f"scenario {cls_name}.__init__ has no `seed` "
+                f"parameter (the determinism contract: same "
+                f"name+seed => byte-identical streams)",
+                checker=NAME))
+
+    # the bench artifact schema (only when the artifact exists)
+    bench_path = os.path.join(repo.root, BENCH_NAME)
+    if os.path.exists(bench_path):
+        for msg in check_bench(bench_path):
+            findings.append(Finding(CODE, BENCH_NAME, 1, msg,
+                                    checker=NAME))
+    return findings
+
+
+# -- bench artifact validation (bench + tests share it) ----------------
+def check_bench(path: str) -> List[str]:
+    """-> list of violation strings (empty = clean)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: does not load as JSON ({e})"]
+    if not isinstance(data, dict):
+        return [f"{path}: top level is {type(data).__name__}, "
+                f"not an object"]
+    bad = []
+    if data.get("schema") != BENCH_SCHEMA:
+        bad.append(f"{path}: schema {data.get('schema')!r} != "
+                   f"{BENCH_SCHEMA}")
+    scenarios = data.get("scenarios")
+    if not isinstance(scenarios, dict) or not scenarios:
+        bad.append(f"{path}: 'scenarios' must be a non-empty object "
+                   f"(per-scenario results keyed by name)")
+        return bad
+    for name, entry in scenarios.items():
+        if not isinstance(entry, dict):
+            bad.append(f"{path}: scenarios[{name!r}] is not an "
+                       f"object")
+            continue
+        for key in BENCH_SCENARIO_KEYS:
+            if key not in entry:
+                bad.append(f"{path}: scenarios[{name!r}] missing "
+                           f"required key {key!r}")
+    if "all_passed" not in data:
+        bad.append(f"{path}: missing required key 'all_passed'")
+    return bad

@@ -558,6 +558,11 @@ _SERVING_RATE_KEYS = (
     (("fault-tolerance", "restarts"), "restarts"),
     (("fault-tolerance", "recovery-dropped"), "recovery-dropped"),
     (("fault-tolerance", "dispatch-timeouts"), "timeouts"),
+    # map-pressure counters (datapath/pressure.py): cumulative, so
+    # the follow mode renders them as per-interval rates like every
+    # other counter here
+    (("pressure", "ct", "insert-drops"), "ct-insert-drops"),
+    (("pressure", "nat", "failures"), "nat-failures"),
 )
 
 
@@ -686,6 +691,21 @@ def cmd_serving(args) -> int:
                           f"{snap.get('age-seconds', 0)}s "
                           f"({snap.get('trigger')}, "
                           f"mode {snap.get('mode')})")
+                pr = st.get("pressure")
+                if pr and pr.get("ct"):
+                    ct = pr["ct"]
+                    nat = pr.get("nat") or {}
+                    occ = ct.get("occupancy")
+                    flag = (" ACCELERATED (gc "
+                            f"{pr.get('gc-pressure-interval-s')}s)"
+                            if pr.get("accelerated") else "")
+                    print(f"Pressure:  {pr.get('state', '?')}{flag}, "
+                          f"ct {ct.get('occupied', 0)}/"
+                          f"{ct.get('capacity', 0)} "
+                          f"({'-' if occ is None else occ}), "
+                          f"insert-drops {ct.get('insert-drops', 0)}"
+                          f", nat-failures {nat.get('failures', 0)}, "
+                          f"episodes {pr.get('episodes', 0)}")
                 tb = st.get("tables")
                 if tb:
                     stall = tb.get("swap-stall-us") or {}

@@ -223,6 +223,11 @@ class ClusterServing:
         self._started = False
         self._stopped = False
         self._final: Optional[dict] = None
+        # per-node span-tracer / event-plane refs, captured at
+        # start() (stop_serving clears daemon._serving; ledgers()
+        # closes those ledgers post-stop through these)
+        self._tracers: Dict[str, object] = {}
+        self._eventplanes: Dict[str, object] = {}
 
     # -- topology ------------------------------------------------------
     def node(self, name: str) -> ClusterNode:
@@ -311,6 +316,15 @@ class ClusterServing:
                                    trace_sample=trace_sample,
                                    ingress=True, packed=packed,
                                    span_sample=span_sample)
+        # retain per-node span-tracer / event-plane references NOW:
+        # stop_serving clears daemon._serving, and the everything-on
+        # soak gate closes the span and event ledgers AFTER stop
+        self._tracers = {
+            n.name: n.daemon._serving.get("tracer")
+            for n in self.nodes}
+        self._eventplanes = {
+            n.name: n.daemon._serving.get("eventplane")
+            for n in self.nodes}
         self.router = ClusterRouter(self.nodes, self.forward_depth,
                                     on_overflow=self._surface_overflow)
         self.router.start()
@@ -475,6 +489,72 @@ class ClusterServing:
             "accounted": accounted,
             "exact": submitted == accounted,
         }
+
+    def ledgers(self) -> dict:
+        """EVERY no-silent-loss ledger the tier runs, closed in one
+        read — the everything-on soak gate's assertion surface
+        (ISSUE 12).  Five ledgers:
+
+        - ``packet`` (per node): submitted == verdicts + shed +
+          recovery_dropped (exact after stop);
+        - ``event`` (per node): event-plane windows submitted ==
+          joined + dropped;
+        - ``span`` (per node, when tracing armed): spans started ==
+          completed + dropped;
+        - ``agg`` (per node): analytics batches submitted ==
+          ingested + dropped;
+        - ``cluster``: the router-level ledger (:meth:`ledger`).
+
+        ``exact`` is the conjunction.  Meaningful after
+        :meth:`stop`, like every in-flight-exclusive ledger here."""
+        out: Dict[str, dict] = {"packet": {}, "event": {},
+                                "span": {}, "agg": {}}
+        ok = True
+        for name, st in self.per_node_stats().items():
+            fe = st.get("front-end")
+            if fe is not None:
+                ft = fe.get("fault-tolerance", {})
+                acc = (fe.get("verdicts", 0) + fe.get("shed", 0)
+                       + ft.get("recovery-dropped", 0))
+                exact = fe.get("submitted", 0) == acc
+                out["packet"][name] = {
+                    "submitted": fe.get("submitted", 0),
+                    "accounted": acc, "exact": exact}
+                ok = ok and exact
+        for name, w in getattr(self, "_eventplanes", {}).items():
+            if w is None:
+                continue
+            ev = w.stats()
+            exact = ev["windows-submitted"] == (
+                ev["windows-joined"] + ev["windows-dropped"])
+            out["event"][name] = {
+                "submitted": ev["windows-submitted"],
+                "joined": ev["windows-joined"],
+                "dropped": ev["windows-dropped"], "exact": exact}
+            ok = ok and exact
+        for name, tr in getattr(self, "_tracers", {}).items():
+            if tr is None:
+                continue
+            ts = tr.stats()
+            exact = ts["started"] == (ts["completed"]
+                                      + ts["dropped"])
+            out["span"][name] = {
+                "started": ts["started"],
+                "completed": ts["completed"],
+                "dropped": ts["dropped"], "exact": exact}
+            ok = ok and exact
+        for n in self.nodes:
+            ag = n.daemon.analytics.stats()
+            exact = ag["batches-submitted"] == (
+                ag["batches-ingested"] + ag["batches-dropped"])
+            out["agg"][n.name] = {
+                "submitted": ag["batches-submitted"],
+                "ingested": ag["batches-ingested"],
+                "dropped": ag["batches-dropped"], "exact": exact}
+            ok = ok and exact
+        out["cluster"] = self.ledger()
+        out["exact"] = ok and bool(out["cluster"]["exact"])
+        return out
 
     def stats(self) -> dict:
         return {

@@ -64,6 +64,30 @@ def _dus(arr, upd, starts):
     return _dus_jit(arr, upd, tuple(starts))
 
 
+# Jitted CT-occupancy reduction (the map-pressure sample's one piece
+# of device math).  MODULE-level like _dus: one executable per
+# (capacity, placement) per process, shared across loaders, so the
+# periodic pressure sample never pays — or worse, races a serving
+# dispatch's compile-log window with — a fresh XLA compile after the
+# first warm call (Daemon.start() / serving_shard warm it).
+_occ_jit = None
+
+
+def _ct_occupied(fp):
+    """Occupied CT slots (live + expired-but-unswept): fp != 0 —
+    the per-slot key fingerprint's free marker doubles as the
+    occupancy bitmap, so the sample reduces 4 B/slot instead of
+    loading the 68 B rows."""
+    global _occ_jit
+    if _occ_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        _occ_jit = jax.jit(
+            lambda f: jnp.sum(f != 0, dtype=jnp.uint32))
+    return _occ_jit(fp)
+
+
 class Loader(abc.ABC):
     """What the agent needs from a datapath (pkg/datapath.Loader)."""
 
@@ -142,6 +166,16 @@ class Loader(abc.ABC):
         """Remove one ipcache prefix in place (fqdn TTL expiry)."""
         return False
 
+    # -- map pressure (ISSUE 12: pkg/maps ctmap pressure analogue) ----
+    def map_pressure(self, now: int) -> dict:
+        """Point-in-time map-pressure snapshot: CT occupancy +
+        cumulative insert drops, NAT pool failures.  Backends
+        override; the default reports an unmeasurable world (the
+        monitor then keys on the counters alone)."""
+        return {"ct": {"capacity": 0, "occupied": 0,
+                       "occupancy": None, "insert-drops": 0},
+                "nat": {"capacity": None, "failures": 0}}
+
 
 class TPULoader(Loader):
     """The real datapath: device tensors + fused jit pipeline.
@@ -165,7 +199,8 @@ class TPULoader(Loader):
 
     def __init__(self, ct_capacity: int = 1 << 20,
                  delta_compile: bool = True,
-                 swap_warn_ms: float = 0.0):
+                 swap_warn_ms: float = 0.0,
+                 nat_capacity: Optional[int] = None):
         import jax.numpy as jnp  # deferred so CPU-only tools can import
 
         from ..infra.lockdebug import make_lock
@@ -173,6 +208,10 @@ class TPULoader(Loader):
 
         self._jnp = jnp
         self.ct_capacity = ct_capacity
+        # SNAT port-pool size (service/nat.py NATTable); None = the
+        # NAT_DEFAULT_CAPACITY.  Small pools are the nat_exhaustion
+        # scenario's pressure shape
+        self.nat_capacity = nat_capacity
         self.state: Optional[DatapathState] = None
         self.nat_state = None  # NATTable, created on first masquerade
         self.row_map: Optional[IdentityRowMap] = None
@@ -841,6 +880,12 @@ class TPULoader(Loader):
             self._serving_mesh = mesh
             self._sharded_steps = {}
             self.state = shard_state(self.state, mesh)
+            # warm the map-pressure occupancy executable for the NEW
+            # placement NOW (start_serving runs before tests/benches
+            # freeze compile counts): a first pressure sample landing
+            # mid-dispatch would otherwise charge its compile to the
+            # serving executables' one-per-(rung, mode) window
+            _ct_occupied(self.state.ct.fp)
 
     def serving_unshard(self) -> None:
         # thread-affinity: drain, api
@@ -859,6 +904,7 @@ class TPULoader(Loader):
             self._sharded_steps = {}
             self.state = jax.tree.map(
                 lambda x: jnp.asarray(np.asarray(x)), self.state)
+            _ct_occupied(self.state.ct.fp)  # re-warm single-device
 
     def serve_sharded(self, ring, hdr, now: int, batch_id: int,
                       trace_sample: int = 1024, proxy_ports=None,
@@ -1003,7 +1049,9 @@ class TPULoader(Loader):
         # capture and dispatch
         with self._lock:
             if self.nat_state is None:
-                self.nat_state = NATTable.create()
+                self.nat_state = (NATTable.create(self.nat_capacity)
+                                  if self.nat_capacity
+                                  else NATTable.create())
             hdr, self.nat_state, dropped = snat_egress_jit(
                 self.nat_state, nat, self.state.ct, hdr,
                 jnp.uint32(now))
@@ -1020,7 +1068,9 @@ class TPULoader(Loader):
             hdr = jnp.asarray(np.ascontiguousarray(hdr))
         with self._lock:
             if self.nat_state is None:
-                self.nat_state = NATTable.create()
+                self.nat_state = (NATTable.create(self.nat_capacity)
+                                  if self.nat_capacity
+                                  else NATTable.create())
             hdr, self.nat_state = snat_reverse_jit(
                 self.nat_state, nat, hdr, jnp.uint32(now))
             return hdr
@@ -1346,6 +1396,33 @@ class TPULoader(Loader):
                 "alloc-failed": int(np.asarray(self.nat_state.failed)),
             }
 
+    def map_pressure(self, now: int) -> dict:
+        # thread-affinity: api, offline, cli -- the map-pressure
+        # controller / query threads; NEVER the drain thread (the
+        # occupancy reduction + scalar fetches block on the device)
+        """The map-pressure sample (datapath/pressure.py): occupied
+        CT slots via the fingerprint bitmap (one warmed jitted
+        reduction, ~4 B/slot), cumulative insert drops
+        (``CTTable.dropped`` — restore-time drops included), and
+        SNAT pool failures.  Runs under the dispatch lock like gc():
+        the state capture must not race a donating dispatch."""
+        with self._lock:
+            ct = self.state.ct
+            occupied = int(np.asarray(_ct_occupied(ct.fp)))
+            drops = int(np.asarray(ct.dropped))
+            nat_cap = (self.nat_state.capacity
+                       if self.nat_state is not None else None)
+            nat_failed = (int(np.asarray(self.nat_state.failed))
+                          if self.nat_state is not None else 0)
+        return {
+            "ct": {"capacity": self.ct_capacity,
+                   "occupied": occupied,
+                   "occupancy": round(occupied / self.ct_capacity,
+                                      4),
+                   "insert-drops": drops},
+            "nat": {"capacity": nat_cap, "failures": nat_failed},
+        }
+
     def gc(self, now: int) -> int:
         # table-swap-ok: CT-only swap (expiry sweep) — tables carried
         # unchanged
@@ -1413,13 +1490,15 @@ class InterpreterLoader(Loader):
     """
     # active-tables: oracle
 
-    def __init__(self, ct_capacity: int = 0):
+    def __init__(self, ct_capacity: int = 0,
+                 nat_capacity: Optional[int] = None):
         from .tables import TableVersioner
         from .verdict import N_REASONS
 
         self.oracle = None
         self.nat_state = None  # numpy NAT table (port-pool mirror)
         self.nat_failed = 0
+        self.nat_capacity = nat_capacity  # None = default pool
         self.row_map: Optional[IdentityRowMap] = None
         self._metrics = np.zeros((N_REASONS, 2), dtype=np.uint64)
         self.attach_count = 0
@@ -1429,6 +1508,22 @@ class InterpreterLoader(Loader):
     def table_stats(self) -> dict:
         # thread-affinity: any
         return self.tables.snapshot()
+
+    def map_pressure(self, now: int) -> dict:
+        # thread-affinity: any
+        """TPULoader.map_pressure parity.  The oracle CT is an
+        unbounded dict (no probe window), so occupancy is None and
+        insert drops stay 0 — the pressure monitor then keys on the
+        NAT counters alone, which DO mirror the device pool."""
+        live = len(self.oracle.ct) if self.oracle is not None else 0
+        return {
+            "ct": {"capacity": 0, "occupied": live,
+                   "occupancy": None, "insert-drops": 0},
+            "nat": {"capacity": (self.nat_state.shape[0]
+                                 if self.nat_state is not None
+                                 else None),
+                    "failures": self.nat_failed},
+        }
 
     def nat_snapshot(self) -> Optional[np.ndarray]:
         return None if self.nat_state is None else self.nat_state.copy()
@@ -1561,7 +1656,8 @@ class InterpreterLoader(Loader):
 
         if self.nat_state is None:
             self.nat_state = np.zeros(
-                (NAT_DEFAULT_CAPACITY, NAT_ROW_WORDS), dtype=np.uint32)
+                (self.nat_capacity or NAT_DEFAULT_CAPACITY,
+                 NAT_ROW_WORDS), dtype=np.uint32)
         return self.nat_state
 
     def masquerade(self, nat, hdr, now: int) -> np.ndarray:

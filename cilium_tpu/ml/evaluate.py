@@ -22,6 +22,7 @@ why that matters on tunneled TPU hosts):
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 from typing import Dict, Optional, Tuple
@@ -176,6 +177,30 @@ def evaluate_capture(model, world, pcap_path: str,
         "anomaly_auc": round(float(auc(scores, labels)), 4),
         "packets": int(len(hdr)),
         "attack_packets": int((labels > 0.5).sum()),
+    }
+
+
+def score_scenario(model, world, scenario, ep: int = 0,
+                   n_batches: int = 8,
+                   threshold: float = 0.8) -> dict:
+    """Replay a registered adversarial scenario's deterministic
+    traffic (``testing/workloads.py`` — ``syn_flood``,
+    ``port_scan``, ...) through the real datapath and score it
+    (ISSUE 12 satellite: the r05 anomaly models must SEE the
+    scenario engine's synthetic attacks, not just their own training
+    generator).  Returns score statistics the tests assert against a
+    benign baseline."""
+    hdr = np.concatenate(list(
+        itertools.islice(scenario.iter_batches(ep), n_batches)))
+    scores = score_capture(model, world, hdr)
+    return {
+        "scenario": scenario.name,
+        "packets": int(len(hdr)),
+        "mean_score": round(float(scores.mean()), 4),
+        "p95_score": round(float(np.percentile(scores, 95)), 4),
+        "flagged_frac": round(
+            float((scores >= threshold).mean()), 4),
+        "scores": scores,
     }
 
 

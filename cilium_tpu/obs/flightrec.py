@@ -55,6 +55,11 @@ KIND_EVENTWORKER = "eventworker-terminal"
 # designated peer (CT snapshot replayed, router re-pinned); recorded
 # on the PEER — the dead node's recorder died with it
 KIND_NODE_FAILOVER = "node-failover"
+# the map-pressure monitor (datapath/pressure.py) crossed a
+# threshold — CT occupancy, insert-drop rate, or NAT pool failures —
+# and entered the pressure state (one incident per episode; the
+# accelerated CT aging sweep is the paired response)
+KIND_MAP_PRESSURE = "map-pressure"
 KIND_MANUAL = "manual"
 
 # required top-level bundle keys (scripts/check_sysdump_schema.py
@@ -63,7 +68,7 @@ KIND_MANUAL = "manual"
 SYSDUMP_REQUIRED_KEYS = (
     "schema", "node", "taken-at", "trigger", "incident", "config",
     "serving", "compile", "traces", "flows", "flow-aggregation",
-    "incidents", "metrics",
+    "incidents", "metrics", "pressure",
 )
 SYSDUMP_SCHEMA = 1
 

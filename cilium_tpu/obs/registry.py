@@ -511,6 +511,43 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                 "sysdump bundles written by the flight recorder",
                 lambda: daemon.flightrec.writes_total)
 
+    # -- map pressure (datapath/pressure.py).  Collectors read the
+    # monitor's CACHED last sample — the periodic controller does the
+    # device work; a scrape never touches the device.  None before
+    # the first sample (or when the backend cannot measure) omits
+    # the series, the standard collector contract ------------------
+    def pressure(*keys):
+        def collect():
+            last = daemon.pressure.last
+            if last is None:
+                return None
+            cur = last
+            for k in keys:
+                if not isinstance(cur, dict):
+                    return None
+                cur = cur.get(k)
+            return cur
+
+        return collect
+
+    reg.gauge("cilium_ct_occupancy",
+              "CT map occupancy fraction (occupied slots / capacity, "
+              "live + expired-unswept) at the last pressure sample",
+              pressure("ct", "occupancy"))
+    reg.counter("cilium_ct_insert_drops_total",
+                "CT inserts dropped at a full probe window (map "
+                "pressure; restore-time placement drops included)",
+                pressure("ct", "insert-drops"))
+    reg.counter("cilium_nat_pool_failures_total",
+                "SNAT port allocations failed on pool exhaustion "
+                "(DROP_NAT_NO_MAPPING pressure)",
+                pressure("nat", "failures"))
+    reg.gauge("cilium_map_pressure",
+              "1 while the map-pressure monitor is in the pressure "
+              "state (CT aging sweep accelerated)",
+              lambda: (1 if daemon.pressure.state == "pressure"
+                       else 0))
+
     # -- CT snapshots (age/entries ride recovery decisions) -----------
     def ct_snap(key):
         def collect():

@@ -249,6 +249,11 @@ class ServingStats:
                 "admitted": self.admitted,
                 "shed": self.shed,
                 "shed-events": self.shed_events,
+                # the scenario drivers' shed criterion + the
+                # operator's first overload read (exact, from the
+                # queue's own accounting)
+                "shed-fraction": round(self.shed / self.submitted, 4)
+                if self.submitted else None,
                 "batches": self.batches,
                 "verdicts": real,
                 "padded-rows": pad,

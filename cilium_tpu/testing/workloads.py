@@ -1,35 +1,149 @@
-"""Reusable workload scenario library (ROADMAP item 5 down payment).
+"""Adversarial workload scenario library (ROADMAP item 4; ISSUE 12).
 
 bench.py's overload/paced legs drive uniform synthetic flows; real
-clusters serve heavy-tailed traffic while the control plane churns
-under them.  This module factors that gap into NAMED, SEEDED
-scenarios: each one is a deterministic generator of driver events
-that both the chaos tests and ``bench.py`` replay — same seed, same
-schedule, byte for byte — with per-scenario pass criteria living in
-the caller (ledger exact, oracle match, p99 bounds).
+clusters serve hostile traffic shapes — SYN floods that fill the CT
+map, port scans, NAT port exhaustion, heavy-tailed flow popularity —
+while the control plane churns under them.  This module factors that
+gap into NAMED, SEEDED scenarios: each one is a deterministic
+generator of driver events (traffic batches and/or control-plane ops)
+that the chaos tests, the everything-on soak gate, and ``bench.py
+--scenarios`` replay — same seed, same schedule, byte for byte — with
+per-scenario PASS CRITERIA declared on the scenario class and
+evaluated by one shared :func:`run_scenario` driver.
 
-The registry is the extension point: later scenarios (SYN flood,
-port scan, NAT-exhaustion ramp, endpoint connect/disconnect churn,
-pcap replay — ROADMAP item 5's full list) slot in as new entries
-without touching any driver.
+The contract every registry entry satisfies (statically enforced by
+the CTA010 checker, ``analysis/scenario_lint.py``):
 
-First entry: ``identity_churn`` (ISSUE 10) — peer identities minted
-and withdrawn at a fixed rate over a pool of slots, slot choice
-Zipf-weighted (elephant peers churn often, mice rarely — the
-heavy-tail shape SelectorCache updates see in production).  Each
-mint drives BOTH incremental paths: the identity's labels join the
-selecting contributions (``patch_identity``) and its /32 lands in
-the ipcache (``patch_ipcache``); a withdraw unwinds both, so a
-slot's traffic verdict flips with its liveness — the pre/post
-oracle pair the churn chaos gate checks against.
+- a docstring saying what hostile shape it reproduces;
+- a ``name`` literal (the registry key / bench artifact key);
+- a ``criteria`` dict literal — the declared pass criteria
+  (``ledger_exact``, ``max_shed_frac``, ``p99_ms``,
+  ``min_ct_insert_drops``, ``min_nat_failures``, ``min_drop_frac``;
+  unknown keys FAIL evaluation, so a typo'd criterion is loud);
+- a ``seed`` constructor parameter (same name+seed => byte-identical
+  op/packet streams, proven per-entry by the determinism contract
+  test via :meth:`Scenario.signature`).
+
+Scenarios:
+
+- ``identity_churn`` (ISSUE 10) — mint/withdraw label-selected peer
+  identities, Zipf-weighted (the original entry, API unchanged);
+- ``syn_flood`` — a new-flow storm of unique-tuple SYNs sized past
+  the CT map, driving insert-drop pressure (``CTTable.dropped``) and
+  the full-window-probe rerun path;
+- ``port_scan`` — one source sweeping the port space with tiny SYNs,
+  feeding the drop-spike detector, the flow aggregates, and the
+  anomaly models;
+- ``nat_exhaustion`` — an egress ramp of unique flows that drains
+  the SNAT port pool into ``REASON_NAT_EXHAUSTED`` drops (runs on
+  the offline ``process_batch`` path — masquerade rides there);
+- ``elephant_mice`` — Zipf flow popularity over a fixed flow pool,
+  stressing the space-saving top-K sketches;
+- ``endpoint_churn`` — endpoints connecting/disconnecting (full
+  add_endpoint/remove regeneration churn) under live traffic.
 """
 
 from __future__ import annotations
 
+import hashlib
+import itertools
+import time
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
+
+from ..core.packets import (
+    COL_DIR,
+    COL_DPORT,
+    COL_DST_IP3,
+    COL_EP,
+    COL_FAMILY,
+    COL_FLAGS,
+    COL_LEN,
+    COL_PROTO,
+    COL_SPORT,
+    COL_SRC_IP3,
+    N_COLS,
+    TCP_ACK,
+    TCP_SYN,
+)
+
+
+def _ip(s: str) -> int:
+    import ipaddress
+
+    return int(ipaddress.IPv4Address(s))
+
+
+def _rows(n: int) -> np.ndarray:
+    out = np.zeros((n, N_COLS), dtype=np.uint32)
+    out[:, COL_FAMILY] = 4
+    out[:, COL_PROTO] = 6
+    return out
+
+
+def _zipf_weights(n: int, a: float) -> np.ndarray:
+    """Rank -> probability ~ 1/rank^a (normalized); rank 0 is the
+    elephant.  ONE definition for every Zipf-weighted scenario."""
+    w = 1.0 / np.power(np.arange(1, n + 1), a)
+    return w / w.sum()
+
+
+class Scenario:
+    """The scenario contract (see the module docstring; CTA010
+    enforces the declaration half statically).
+
+    A scenario owns two deterministic streams — ``iter_batches(ep)``
+    (wide ``[N, N_COLS]`` uint32 header tensors) and ``ops(n)``
+    (control-plane events applied via :meth:`apply`) — plus
+    ``setup(target)``, which registers whatever endpoints/policy the
+    streams assume (``target`` is duck-typed: a ``Daemon`` or a
+    ``ClusterServing`` — both expose ``add_endpoint`` /
+    ``policy_import``).  ``path`` picks the driver leg: ``serving``
+    (admission queue -> drain loop) or ``offline``
+    (``process_batch`` — the masquerade/NAT pipeline only rides
+    there).  ``daemon_overrides`` are the DaemonConfig knobs the
+    scenario's pressure shape needs (a tiny CT map for ``syn_flood``,
+    masquerade + a small SNAT pool for ``nat_exhaustion``); tests and
+    ``bench.py --scenarios`` both build daemons from them.
+    """
+
+    name: str = ""
+    criteria: Dict[str, object] = {}
+    path: str = "serving"
+    daemon_overrides: Dict[str, object] = {}
+    interval_s: float = 0.0  # op spacing; 0 = no op stream
+
+    def setup(self, target) -> dict:
+        """Register the scenario's world; returns the driver context
+        (at least ``{"ep": <endpoint id>}`` for traffic scenarios)."""
+        return {"ep": 0}
+
+    def iter_batches(self, ep: int) -> Iterator[np.ndarray]:
+        return iter(())
+
+    def ops(self, n: Optional[int] = None) -> List:
+        return []
+
+    def apply(self, daemon, op, live: Dict) -> None:
+        raise NotImplementedError
+
+    def drain(self, daemon, live: Dict) -> None:
+        """Unwind every surviving op (teardown; default no-op)."""
+
+    # -- the determinism contract --------------------------------------
+    def signature(self, ep: int = 7, n_batches: int = 3,
+                  n_ops: int = 64) -> str:
+        """Digest of the scenario's first ``n_batches`` batches and
+        ``n_ops`` ops — two fresh instances with the same constructor
+        args must agree byte for byte (the contract test's surface)."""
+        h = hashlib.sha256()
+        for b in itertools.islice(self.iter_batches(ep), n_batches):
+            h.update(np.ascontiguousarray(b).tobytes())
+        for op in self.ops(n_ops):
+            h.update(repr(op).encode())
+        return h.hexdigest()
 
 
 @dataclass(frozen=True)
@@ -49,7 +163,7 @@ class ChurnOp:
     t_s: float
 
 
-class IdentityChurnScenario:
+class IdentityChurnScenario(Scenario):
     """Mint/withdraw CIDR identities at ``rate_hz``, Zipf-weighted
     over ``n_slots`` peer slots.
 
@@ -62,10 +176,15 @@ class IdentityChurnScenario:
     """
 
     name = "identity_churn"
+    criteria = {"ledger_exact": True, "max_shed_frac": 0.95}
+    path = "serving"
+    daemon_overrides = {"serving_bucket_ladder": (64,),
+                        "serving_max_wait_us": 500.0}
 
     def __init__(self, seed: int = 0, n_slots: int = 16,
                  zipf_a: float = 1.3, rate_hz: float = 200.0,
-                 subnet: Tuple[int, int] = (10, 9)):
+                 subnet: Tuple[int, int] = (10, 9),
+                 n_batches: int = 48):
         if n_slots < 1:
             raise ValueError("n_slots must be >= 1")
         if zipf_a <= 1.0:
@@ -73,6 +192,7 @@ class IdentityChurnScenario:
         if rate_hz <= 0:
             raise ValueError("rate_hz must be > 0")
         self.seed = int(seed)
+        self.n_batches = int(n_batches)
         self.n_slots = int(n_slots)
         self.zipf_a = float(zipf_a)
         self.rate_hz = float(rate_hz)
@@ -84,11 +204,8 @@ class IdentityChurnScenario:
         # — x.y.z.0/32 is a valid host route)
         self._cidrs = [f"{a}.{b}.{(s + 1) >> 8}.{(s + 1) & 0xFF}/32"
                        for s in range(self.n_slots)]
-        # rank -> probability ~ 1/rank^a (normalized), slot i = rank
-        # i+1: slot 0 is the elephant peer
-        w = 1.0 / np.power(np.arange(1, self.n_slots + 1),
-                           self.zipf_a)
-        self._weights = w / w.sum()
+        # slot 0 is the elephant peer
+        self._weights = _zipf_weights(self.n_slots, self.zipf_a)
 
     def slot_cidr(self, slot: int) -> str:
         return self._cidrs[slot]
@@ -107,9 +224,45 @@ class IdentityChurnScenario:
         return [f"k8s:app=churn{slot}", "k8s:churn=yes",
                 "k8s:ns=default"]
 
-    def ops(self, n: int) -> List[ChurnOp]:
+    def setup(self, target) -> dict:
+        target.add_endpoint("churn-web", ("10.9.255.1",),
+                            ["k8s:app=churn-web"])
+        ep = target.add_endpoint("churn-db", ("10.9.255.2",),
+                                 ["k8s:app=churn-db"])
+        target.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "churn-db"}},
+            "ingress": [
+                {"fromEndpoints": [
+                    {"matchLabels": {"app": "churn-web"}}],
+                 "toPorts": [{"ports": [{"port": "5432",
+                                         "protocol": "TCP"}]}]},
+                {"fromEndpoints": [{"matchLabels": {"churn": "yes"}}],
+                 "toPorts": [{"ports": [{"port": "5432",
+                                         "protocol": "TCP"}]}]},
+            ],
+        }])
+        return {"ep": ep.id}
+
+    def iter_batches(self, ep: int) -> Iterator[np.ndarray]:
+        """A light stable-allowed stream (churn-web -> :5432) so the
+        serving plane has traffic while the op stream churns —
+        ``n_batches`` of 64 rows (bounded: run_scenario drains the
+        whole stream)."""
+        rng = np.random.default_rng(self.seed + 1)
+        for _ in range(self.n_batches):
+            out = _rows(64)
+            out[:, COL_SRC_IP3] = _ip("10.9.255.1")
+            out[:, COL_DST_IP3] = _ip("10.9.255.2")
+            out[:, COL_SPORT] = rng.integers(1024, 60000, 64)
+            out[:, COL_DPORT] = 5432
+            out[:, COL_FLAGS] = TCP_ACK
+            out[:, COL_LEN] = 512
+            out[:, COL_EP] = ep
+            yield out
+
+    def ops(self, n: Optional[int] = None) -> List[ChurnOp]:
         """The first ``n`` ops of the schedule (deterministic)."""
-        return list(self.iter_ops(n))
+        return list(self.iter_ops(n if n is not None else 256))
 
     def iter_ops(self, n: Optional[int] = None) -> Iterator[ChurnOp]:
         rng = np.random.default_rng(self.seed)
@@ -161,12 +314,368 @@ class IdentityChurnScenario:
                        live)
 
 
+class SynFloodScenario(Scenario):
+    """A new-flow SYN storm: ``n_flows`` unique (src, sport) tuples,
+    each one SYN at the victim's allowed port — every packet is a CT
+    insert, so a storm sized past the CT map fills it and drives
+    insert-drop pressure (``CTTable.dropped``, the ctmap map-pressure
+    analogue) plus the fingerprint-overflow full-window-probe rerun
+    at high occupancy.  The flood is ALLOWED traffic by design
+    (``fromEntities: [world]`` to the flood port): only the allow
+    path creates CT entries, and surviving a flood of wanted-looking
+    connections is exactly the ctmap GC story."""
+
+    name = "syn_flood"
+    criteria = {"ledger_exact": True, "max_shed_frac": 0.95,
+                "min_ct_insert_drops": 1, "p99_ms": 120000.0}
+    path = "serving"
+    # the storm must outsize the CT map: 4096 unique flows against a
+    # 1k-entry table (bench + tests build the daemon from these)
+    daemon_overrides = {"ct_capacity": 1 << 10,
+                        "serving_bucket_ladder": (512,),
+                        "serving_queue_depth": 1 << 14}
+
+    def __init__(self, seed: int = 0, n_flows: int = 4096,
+                 batch: int = 512, dport: int = 80):
+        if n_flows < 1 or batch < 1:
+            raise ValueError("n_flows and batch must be >= 1")
+        self.seed = int(seed)
+        self.n_flows = int(n_flows)
+        self.batch = int(batch)
+        self.dport = int(dport)
+
+    def setup(self, target) -> dict:
+        ep = target.add_endpoint("sf-victim", ("10.0.40.1",),
+                                 ["k8s:app=sf-victim"])
+        target.policy_import([{
+            "endpointSelector": {"matchLabels":
+                                 {"app": "sf-victim"}},
+            "ingress": [{"fromEntities": ["world"],
+                         "toPorts": [{"ports": [
+                             {"port": str(self.dport),
+                              "protocol": "TCP"}]}]}],
+        }])
+        return {"ep": ep.id}
+
+    def iter_batches(self, ep: int) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        base = _ip("172.16.0.1")
+        dst = _ip("10.0.40.1")
+        flow = 0
+        while flow < self.n_flows:
+            n = min(self.batch, self.n_flows - flow)
+            i = np.arange(flow, flow + n, dtype=np.uint32)
+            out = _rows(n)
+            # unique tuple per flow: 1024 sources x rotating sports
+            out[:, COL_SRC_IP3] = base + (i % 1024)
+            out[:, COL_SPORT] = 1024 + (i // 1024) * 1024 \
+                + rng.integers(0, 1024, n).astype(np.uint32)
+            out[:, COL_DST_IP3] = dst
+            out[:, COL_DPORT] = self.dport
+            out[:, COL_FLAGS] = TCP_SYN
+            out[:, COL_LEN] = rng.integers(40, 60, n)
+            out[:, COL_EP] = ep
+            yield out
+            flow += n
+
+
+class PortScanScenario(Scenario):
+    """One source sweeping the destination port space with tiny SYNs
+    (the classic recon shape): all but the victim's one allowed port
+    default-deny, so the stream feeds the drop-spike detector, the
+    per-identity-pair aggregates, and the anomaly models a clean
+    synthetic attack (the r05 evaluation's ``portscan`` kind,
+    replayed through the REAL serving/offline pipeline)."""
+
+    name = "port_scan"
+    criteria = {"ledger_exact": True, "max_shed_frac": 0.95,
+                "min_drop_frac": 0.5}
+    path = "serving"
+    daemon_overrides = {"serving_bucket_ladder": (512,),
+                        "serving_queue_depth": 1 << 14,
+                        "spike_min_drops": 64}
+
+    def __init__(self, seed: int = 0, n_packets: int = 4096,
+                 batch: int = 512, open_port: int = 5432):
+        if n_packets < 1 or batch < 1:
+            raise ValueError("n_packets and batch must be >= 1")
+        self.seed = int(seed)
+        self.n_packets = int(n_packets)
+        self.batch = int(batch)
+        self.open_port = int(open_port)
+
+    def setup(self, target) -> dict:
+        ep = target.add_endpoint("ps-victim", ("10.0.41.1",),
+                                 ["k8s:app=ps-victim"])
+        target.policy_import([{
+            "endpointSelector": {"matchLabels":
+                                 {"app": "ps-victim"}},
+            "ingress": [{"fromEntities": ["world"],
+                         "toPorts": [{"ports": [
+                             {"port": str(self.open_port),
+                              "protocol": "TCP"}]}]}],
+        }])
+        return {"ep": ep.id}
+
+    def iter_batches(self, ep: int) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        src = _ip("172.20.0.7")
+        dst = _ip("10.0.41.1")
+        sent = 0
+        while sent < self.n_packets:
+            n = min(self.batch, self.n_packets - sent)
+            out = _rows(n)
+            out[:, COL_SRC_IP3] = src
+            out[:, COL_SPORT] = rng.integers(1024, 65535, n)
+            out[:, COL_DST_IP3] = dst
+            out[:, COL_DPORT] = rng.integers(1, 65535, n)
+            out[:, COL_FLAGS] = TCP_SYN
+            out[:, COL_LEN] = rng.integers(40, 60, n)
+            out[:, COL_EP] = ep
+            yield out
+            sent += n
+
+
+class NatExhaustionScenario(Scenario):
+    """An egress ramp of unique pod -> world flows sized past the
+    SNAT port pool: once every probe-window slot is live, allocation
+    fails and the row drops as ``REASON_NAT_EXHAUSTED``
+    (DROP_NAT_NO_MAPPING) — counted in ``NATTable.failed`` (the NAT
+    pool-pressure signal) and decoded metricsmap -> monitor -> flow
+    -> CLI.  Runs on the OFFLINE path: masquerade rides
+    ``process_batch`` (LB -> SNAT -> datapath), not the serving drain
+    loop."""
+
+    name = "nat_exhaustion"
+    criteria = {"ledger_exact": True, "min_nat_failures": 1}
+    path = "offline"
+    # a 256-port pool against a 1k-flow ramp: exhaustion by design
+    daemon_overrides = {"masquerade": True, "node_ip": "192.168.0.1",
+                        "nat_pool_capacity": 256,
+                        "ct_capacity": 1 << 12}
+
+    def __init__(self, seed: int = 0, n_flows: int = 1024,
+                 batch: int = 256):
+        if n_flows < 1 or batch < 1:
+            raise ValueError("n_flows and batch must be >= 1")
+        self.seed = int(seed)
+        self.n_flows = int(n_flows)
+        self.batch = int(batch)
+
+    def setup(self, target) -> dict:
+        ep = target.add_endpoint("nat-client", ("10.0.45.1",),
+                                 ["k8s:app=nat-client"])
+        target.policy_import([{
+            "endpointSelector": {"matchLabels":
+                                 {"app": "nat-client"}},
+            "egress": [{"toEntities": ["world"]}],
+        }])
+        return {"ep": ep.id}
+
+    def iter_batches(self, ep: int) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        src = _ip("10.0.45.1")
+        dst_base = _ip("93.184.0.1")
+        flow = 0
+        while flow < self.n_flows:
+            n = min(self.batch, self.n_flows - flow)
+            i = np.arange(flow, flow + n, dtype=np.uint32)
+            out = _rows(n)
+            out[:, COL_SRC_IP3] = src
+            out[:, COL_SPORT] = 1024 + (i % 60000)
+            out[:, COL_DST_IP3] = dst_base + (i % 512)
+            out[:, COL_DPORT] = 443
+            out[:, COL_FLAGS] = TCP_SYN
+            out[:, COL_LEN] = rng.integers(60, 120, n)
+            out[:, COL_EP] = ep
+            out[:, COL_DIR] = 1  # egress: the masquerade hook
+            yield out
+            flow += n
+
+
+class ElephantMiceScenario(Scenario):
+    """Zipf flow popularity over a fixed flow pool: a few elephant
+    flows carry most packets while a long tail of mice appears once
+    or twice — the heavy-tail shape the space-saving top-K sketches
+    must survive (elephants always retained, per-key overcount
+    bounded; the mergeable-summaries contract under realistic
+    skew)."""
+
+    name = "elephant_mice"
+    criteria = {"ledger_exact": True, "max_shed_frac": 0.95,
+                "p99_ms": 120000.0}
+    path = "serving"
+    daemon_overrides = {"serving_bucket_ladder": (512,),
+                        "serving_queue_depth": 1 << 14}
+
+    def __init__(self, seed: int = 0, n_flows: int = 512,
+                 n_packets: int = 8192, batch: int = 512,
+                 zipf_a: float = 1.2):
+        if n_flows < 1 or n_packets < 1 or batch < 1:
+            raise ValueError("n_flows/n_packets/batch must be >= 1")
+        if zipf_a <= 1.0:
+            raise ValueError("zipf_a must be > 1 (Zipf exponent)")
+        self.seed = int(seed)
+        self.n_flows = int(n_flows)
+        self.n_packets = int(n_packets)
+        self.batch = int(batch)
+        self.zipf_a = float(zipf_a)
+        self._weights = _zipf_weights(self.n_flows, self.zipf_a)
+
+    def setup(self, target) -> dict:
+        ep = target.add_endpoint("em-srv", ("10.0.42.1",),
+                                 ["k8s:app=em-srv"])
+        target.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "em-srv"}},
+            "ingress": [{"fromEntities": ["world"]}],
+        }])
+        return {"ep": ep.id}
+
+    def flow_tuple(self, rank: int) -> Tuple[int, int]:
+        """Rank -> (src ip, sport); rank 0 is the top elephant."""
+        return (_ip("172.24.0.1") + rank % 256,
+                1024 + rank)
+
+    def iter_batches(self, ep: int) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        dst = _ip("10.0.42.1")
+        sent = 0
+        while sent < self.n_packets:
+            n = min(self.batch, self.n_packets - sent)
+            ranks = rng.choice(self.n_flows, n, p=self._weights)
+            srcs = (_ip("172.24.0.1")
+                    + (ranks % 256)).astype(np.uint32)
+            sports = (1024 + ranks).astype(np.uint32)
+            out = _rows(n)
+            out[:, COL_SRC_IP3] = srcs
+            out[:, COL_SPORT] = sports
+            out[:, COL_DST_IP3] = dst
+            out[:, COL_DPORT] = 443
+            out[:, COL_FLAGS] = TCP_ACK
+            out[:, COL_LEN] = rng.integers(60, 1500, n)
+            out[:, COL_EP] = ep
+            yield out
+            sent += n
+
+
+@dataclass(frozen=True)
+class EndpointOp:
+    """One endpoint-churn event: connect or disconnect slot
+    ``slot``'s endpoint (full add_endpoint/remove regeneration)."""
+
+    kind: str  # "connect" | "disconnect"
+    slot: int
+    ip: str
+    t_s: float
+
+
+class EndpointChurnScenario(Scenario):
+    """Endpoints connecting and disconnecting under live traffic:
+    each op is a FULL ``add_endpoint``/``remove`` (policy
+    re-resolve + regeneration + table publish), Zipf-weighted over
+    slots — the pod-churn shape that stresses the attach path while
+    the serving plane keeps dispatching."""
+
+    name = "endpoint_churn"
+    criteria = {"ledger_exact": True, "max_shed_frac": 0.95}
+    path = "serving"
+    daemon_overrides = {"serving_bucket_ladder": (64,),
+                        "serving_max_wait_us": 500.0}
+
+    def __init__(self, seed: int = 0, n_slots: int = 8,
+                 zipf_a: float = 1.3, rate_hz: float = 50.0,
+                 n_batches: int = 32):
+        if n_slots < 1 or n_slots > 250:
+            raise ValueError("n_slots must be in [1, 250]")
+        if zipf_a <= 1.0:
+            raise ValueError("zipf_a must be > 1 (Zipf exponent)")
+        if rate_hz <= 0:
+            raise ValueError("rate_hz must be > 0")
+        self.seed = int(seed)
+        self.n_slots = int(n_slots)
+        self.n_batches = int(n_batches)
+        self.zipf_a = float(zipf_a)
+        self.rate_hz = float(rate_hz)
+        self.interval_s = 1.0 / self.rate_hz
+        self._weights = _zipf_weights(self.n_slots, self.zipf_a)
+
+    def slot_ip(self, slot: int) -> str:
+        return f"10.0.44.{slot + 1}"
+
+    def setup(self, target) -> dict:
+        ep = target.add_endpoint("ec-svc", ("10.0.43.1",),
+                                 ["k8s:app=ec-svc"])
+        target.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "ec-svc"}},
+            "ingress": [{"fromEntities": ["world"],
+                         "toPorts": [{"ports": [
+                             {"port": "8080",
+                              "protocol": "TCP"}]}]}],
+        }])
+        return {"ep": ep.id}
+
+    def iter_batches(self, ep: int) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed + 1)
+        dst = _ip("10.0.43.1")
+        for _ in range(self.n_batches):
+            out = _rows(64)
+            out[:, COL_SRC_IP3] = _ip("172.28.0.1") \
+                + rng.integers(0, 64, 64).astype(np.uint32)
+            out[:, COL_SPORT] = rng.integers(1024, 60000, 64)
+            out[:, COL_DST_IP3] = dst
+            out[:, COL_DPORT] = 8080
+            out[:, COL_FLAGS] = TCP_ACK
+            out[:, COL_LEN] = 256
+            out[:, COL_EP] = ep
+            yield out
+
+    def ops(self, n: Optional[int] = None) -> List[EndpointOp]:
+        return list(self.iter_ops(n if n is not None else 256))
+
+    def iter_ops(self, n: Optional[int] = None
+                 ) -> Iterator[EndpointOp]:
+        rng = np.random.default_rng(self.seed)
+        live = [False] * self.n_slots
+        i = 0
+        while n is None or i < n:
+            slot = int(rng.choice(self.n_slots, p=self._weights))
+            kind = "disconnect" if live[slot] else "connect"
+            live[slot] = not live[slot]
+            yield EndpointOp(kind=kind, slot=slot,
+                             ip=self.slot_ip(slot),
+                             t_s=i * self.interval_s)
+            i += 1
+
+    def apply(self, daemon, op: EndpointOp,
+              live: Dict[int, object]) -> None:
+        if op.kind == "connect":
+            live[op.slot] = daemon.add_endpoint(
+                f"ec{op.slot}", (op.ip,),
+                [f"k8s:app=ec{op.slot}", "k8s:ec-churn=yes"])
+        else:
+            ep = live.pop(op.slot, None)
+            if ep is not None:
+                daemon.endpoints.remove(ep.id)
+
+    def drain(self, daemon, live: Dict[int, object]) -> None:
+        for slot in list(live):
+            self.apply(daemon, EndpointOp("disconnect", slot,
+                                          self.slot_ip(slot), 0.0),
+                       live)
+
+
 # -- the registry ------------------------------------------------------
-# name -> scenario class; later entries (ROADMAP item 5: syn_flood,
-# port_scan, nat_exhaustion, endpoint_churn, pcap_replay) register
-# here and become runnable by name from tests and bench
+# name -> scenario class: every entry is runnable by name from tests,
+# the everything-on soak gate, and `bench.py --scenarios`, and must
+# satisfy the CTA010 declaration contract (docstring, name literal,
+# criteria dict, seed parameter)
 SCENARIOS = {
     IdentityChurnScenario.name: IdentityChurnScenario,
+    SynFloodScenario.name: SynFloodScenario,
+    PortScanScenario.name: PortScanScenario,
+    NatExhaustionScenario.name: NatExhaustionScenario,
+    ElephantMiceScenario.name: ElephantMiceScenario,
+    EndpointChurnScenario.name: EndpointChurnScenario,
 }
 
 
@@ -179,3 +688,176 @@ def make_scenario(name: str, seed: int = 0, **kw):
             f"unknown scenario {name!r}; registered: "
             f"{', '.join(sorted(SCENARIOS))}")
     return cls(seed=seed, **kw)
+
+
+def scenario_daemon(scenario, **overrides):
+    """Build a Daemon shaped for ``scenario`` (its
+    ``daemon_overrides`` under the caller's ``overrides``) — the one
+    construction tests and ``bench.py --scenarios`` share, so the
+    pressure shape a scenario declares is the shape it is always
+    run against."""
+    from ..agent.daemon import Daemon, DaemonConfig
+
+    cfg = dict(backend="tpu", flow_ring_capacity=1 << 13)
+    cfg.update(scenario.daemon_overrides)
+    cfg.update(overrides)
+    return Daemon(DaemonConfig(**cfg))
+
+
+# -- criteria evaluation ----------------------------------------------
+def evaluate_criteria(criteria: Dict[str, object],
+                      metrics: Dict[str, object]) -> Dict[str, bool]:
+    """Declared criteria -> {criterion: passed}.  Unknown criterion
+    keys evaluate False (a typo'd criterion must fail loudly, not
+    vacuously pass)."""
+    checks: Dict[str, bool] = {}
+    for key, want in criteria.items():
+        if key == "ledger_exact":
+            checks[key] = bool(metrics.get("ledger_exact")) == bool(
+                want)
+        elif key == "max_shed_frac":
+            shed = metrics.get("shed_frac")
+            checks[key] = shed is not None and shed <= float(want)
+        elif key == "p99_ms":
+            p99 = metrics.get("p99_us")
+            checks[key] = (p99 is not None
+                           and p99 <= float(want) * 1e3)
+        elif key == "min_ct_insert_drops":
+            checks[key] = (metrics.get("ct_insert_drops", 0)
+                           >= int(want))
+        elif key == "min_nat_failures":
+            checks[key] = (metrics.get("nat_failures", 0)
+                           >= int(want))
+        elif key == "min_drop_frac":
+            frac = metrics.get("drop_frac")
+            checks[key] = frac is not None and frac >= float(want)
+        else:
+            checks[key] = False
+    return checks
+
+
+def run_scenario(daemon, scenario, *, ctx: Optional[dict] = None,
+                 max_ops: int = 256,
+                 serving_kwargs: Optional[dict] = None) -> dict:
+    """The one scenario driver tests and ``bench.py --scenarios``
+    share: replay the scenario's batch stream (serving or offline
+    path) while applying its op stream on schedule, then evaluate
+    the declared pass criteria.
+
+    Returns ``{"name", "seed", "criteria", "metrics", "checks",
+    "passed"}`` where ``metrics`` carries ``submitted`` /
+    ``verdicts`` / ``shed`` / ``shed_frac`` / ``sustained_pps`` /
+    ``p99_us`` / ``ledger_exact`` / ``ct_insert_drops`` /
+    ``nat_failures`` / ``drop_frac`` and ``checks`` maps each
+    declared criterion to its verdict."""
+    if ctx is None:
+        ctx = scenario.setup(daemon)
+    ep = ctx.get("ep", 0)
+    pressure0 = daemon.loader.map_pressure(daemon._now())
+    metrics0 = np.array(daemon.loader.metrics(), dtype=np.int64)
+    ops = iter(scenario.ops(max_ops))
+    live: Dict = {}
+    applied = 0
+    next_op = None
+
+    def tick_ops(elapsed: float) -> None:
+        nonlocal next_op, applied
+        if scenario.interval_s <= 0:
+            return
+        if next_op is None:
+            next_op = elapsed
+        # catch-up is CAPPED: an op that runs slower than its
+        # schedule (endpoint churn's full regeneration on CPU) must
+        # not replay its whole backlog in one burst — the driver
+        # degrades to best-effort rate instead of stalling traffic
+        burst = 0
+        while next_op is not None and elapsed >= next_op \
+                and burst < 4:
+            try:
+                scenario.apply(daemon, next(ops), live)
+                applied += 1
+                burst += 1
+                next_op += scenario.interval_s
+            except StopIteration:
+                next_op = None
+        if next_op is not None and elapsed - next_op \
+                > 64 * scenario.interval_s:
+            next_op = elapsed  # drop an unservable backlog
+
+    submitted = 0
+    events = 0
+    if scenario.path == "serving":
+        kw = dict(ring_capacity=1 << 13, trace_sample=0,
+                  packed=True, ingress=True)
+        kw.update(serving_kwargs or {})
+        daemon.start_serving(**kw)
+        q = daemon._serving["runtime"].queue
+        t0 = time.perf_counter()
+        for b in scenario.iter_batches(ep):
+            # submit() returns the ADMITTED count; the exact
+            # submitted/shed split comes from the front-end snapshot
+            daemon.submit(b)
+            tick_ops(time.perf_counter() - t0)
+            # backpressure: let the drain loop keep up instead of
+            # shedding the whole storm at admission
+            while q.pending > q.capacity // 2:
+                time.sleep(0.001)
+                tick_ops(time.perf_counter() - t0)
+        fe = daemon.stop_serving()["front-end"]
+        dt = max(time.perf_counter() - t0, 1e-9)
+        ft = fe["fault-tolerance"]
+        ledger_exact = fe["submitted"] == (
+            fe["verdicts"] + fe["shed"] + ft["recovery-dropped"])
+        shed_frac = (fe["shed"] / fe["submitted"]
+                     if fe["submitted"] else 0.0)
+        p99 = (fe.get("latency-us") or {}).get("p99")
+        verdicts = fe["verdicts"]
+        submitted = fe["submitted"]
+        pps = verdicts / dt
+    else:  # offline: the process_batch pipeline (LB -> SNAT -> step)
+        t0 = time.perf_counter()
+        for b in scenario.iter_batches(ep):
+            evb = daemon.process_batch(b)
+            submitted += len(b)
+            events += len(evb)
+            tick_ops(time.perf_counter() - t0)
+        dt = max(time.perf_counter() - t0, 1e-9)
+        ledger_exact = events == submitted
+        shed_frac = 0.0
+        p99 = None
+        verdicts = events
+        pps = submitted / dt
+    scenario.drain(daemon, live)
+    pressure1 = daemon.loader.map_pressure(daemon._now())
+    metrics1 = np.array(daemon.loader.metrics(), dtype=np.int64)
+    reason_delta = (metrics1 - metrics0).sum(axis=1)
+    dropped = int(reason_delta[1:].sum())  # reason 0 = forwarded
+    metrics = {
+        "submitted": int(submitted),
+        "verdicts": int(verdicts),
+        "shed_frac": round(float(shed_frac), 4),
+        "sustained_pps": round(float(pps), 1),
+        "p99_us": p99,
+        "ledger_exact": bool(ledger_exact),
+        "ops_applied": applied,
+        "ct_insert_drops": (pressure1["ct"]["insert-drops"]
+                            - pressure0["ct"]["insert-drops"]),
+        "ct_occupancy": pressure1["ct"]["occupancy"],
+        "nat_failures": (pressure1["nat"]["failures"]
+                         - pressure0["nat"]["failures"]),
+        "drop_frac": (round(dropped / submitted, 4)
+                      if submitted else None),
+        "drops_by_reason": {
+            int(r): int(n) for r, n in enumerate(reason_delta)
+            if r and n},
+        "elapsed_s": round(dt, 3),
+    }
+    checks = evaluate_criteria(scenario.criteria, metrics)
+    return {
+        "name": scenario.name,
+        "seed": scenario.seed,
+        "criteria": dict(scenario.criteria),
+        "metrics": metrics,
+        "checks": checks,
+        "passed": all(checks.values()),
+    }

@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Scenario-contract check: every registered adversarial scenario
+declares docstring / name / criteria / seed, and the scenario bench
+artifact keeps its schema.
+
+THIN SHIM: the implementation lives in the static-analysis package
+(``cilium_tpu.analysis.scenario_lint``, checker CTA010) and runs on
+every analysis pass / tier-1 run.  This script keeps a standalone
+CLI (the check_cluster_ledger idiom) and the importable
+``check_bench`` surface.
+
+Usage::
+
+    python scripts/check_scenarios.py                    # repo pass
+    python scripts/check_scenarios.py BENCH_scenarios.json [...]
+
+Exit status 0 = clean; 1 = violations (one per line).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cilium_tpu.analysis.scenario_lint import (  # noqa: E402,F401
+    BENCH_SCENARIO_KEYS, check, check_bench)
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:])
+    bad = []
+    if args:
+        for path in args:
+            bad.extend(check_bench(path))
+    else:
+        from cilium_tpu.analysis import Repo, repo_root
+
+        for f in check(Repo(repo_root())):
+            bad.append(f.render())
+    if bad:
+        print("scenario contract check FAILED:", file=sys.stderr)
+        for b in bad:
+            print("  " + b, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

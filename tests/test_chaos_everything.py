@@ -1,0 +1,285 @@
+"""The everything-on soak gate (ISSUE 12 capstone): cluster serving
++ identity churn + analytics + armed fault injection + an
+adversarial scenario mix, ALL AT ONCE, with every no-silent-loss
+ledger the repo runs — packet, event, cluster, span, agg — asserted
+exact over a sustained window, and ZERO serving-executable
+recompiles during the run.
+
+Two variants share one harness: the SHORT tier-1 chaos gate (this
+file sorts early per the budget-truncation convention) and a
+minutes-long ``slow``-marked soak excluded from the tier-1 budget.
+
+Discipline mirrors test_churn_gate: seeded schedules, bounded
+polling, one ladder rung (shape coverage is other suites' job)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import DaemonConfig
+from cilium_tpu.cluster import ClusterServing
+from cilium_tpu.infra import faults
+from cilium_tpu.testing.workloads import make_scenario
+
+
+def _wait(pred, timeout=60.0, tick=0.005):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+def _dispatch_compiles(daemon):
+    """Serving-executable compile count (the churn-gate idiom:
+    gather rungs are occupancy-dependent, not traffic-dependent)."""
+    return sum(e["compiles"]
+               for e in daemon.loader.compile_log.snapshot(
+                   limit=0)["by-key"]
+               if e["mode"] != "gather")
+
+
+def _run_everything(tmp_path, duration_s: float, nodes: int = 2,
+                    seed: int = 29) -> dict:
+    """The shared harness.  Returns the closed ledgers + per-node
+    facts for the caller's assertions."""
+    cfg = DaemonConfig(
+        backend="tpu",
+        ct_capacity=1 << 10,  # syn_flood outsizes it: CT pressure ON
+        flow_ring_capacity=1 << 13,
+        serving_queue_depth=1 << 13,
+        serving_bucket_ladder=(64,),
+        serving_max_wait_us=500.0,
+        serving_restart_budget=8,
+        serving_restart_backoff_ms=1.0,
+        map_pressure_interval=0.2,
+        ct_gc_pressure_interval=0.25,
+        sysdump_dir=str(tmp_path),
+        spike_min_drops=64,
+    )
+    c = ClusterServing(nodes=nodes, config=cfg)
+    result = {}
+    try:
+        # everything-on includes the background controllers: CT GC,
+        # the map-pressure monitor, health — a not-started daemon
+        # also takes the pre-start cache-only identity path, so
+        # churn would never patch the replicas' tables
+        for n in c.nodes:
+            n.daemon.start()
+        # -- the worlds: every scenario's endpoints/policy fan out
+        # over the kvstore; policy publishes COALESCE to the newest
+        # revision, so convergence is awaited per import
+        mix_names = ("syn_flood", "port_scan", "elephant_mice")
+        mix = {}
+        ctxs = {}
+        for name in mix_names:
+            # the flood must outsize EVERY node's CT map: flows
+            # split ~evenly across replicas by the flow-affine hash,
+            # so 4096 unique tuples vs two 1k-entry maps pressures
+            # both nodes deterministically (occupancy pins at 1.0,
+            # further inserts drop), not just on lucky hash skew
+            sc = make_scenario(name, seed=seed, n_flows=4096,
+                               batch=64) \
+                if name == "syn_flood" else \
+                make_scenario(name, seed=seed, n_packets=1024,
+                              batch=64)
+            ctxs[name] = sc.setup(c)
+            assert c.wait_policy(timeout=15), f"{name} policy"
+            mix[name] = sc
+        churn = make_scenario("identity_churn", seed=seed,
+                              n_slots=6, rate_hz=200.0)
+        ctxs["identity_churn"] = churn.setup(c)
+        assert c.wait_policy(timeout=15), "churn policy"
+
+        # -- warm BOTH serving executables (packed + wide, each full
+        # AND valid-masked) in a THROWAWAY non-ingress session on
+        # node0 (the churn-gate superbatch-warm idiom): serve_batch
+        # here races no drain loop, touches no packet ledger, and —
+        # since executables key on the datapath-state SHAPES, which
+        # the kvstore-propagated world makes identical across
+        # replicas, and the jit caches are process-global — one
+        # node's compile is every node's cache hit
+        from cilium_tpu.core.packets import (pack_eligibility,
+                                             pack_rows)
+
+        node0 = c.nodes[0].daemon
+        wb = next(mix["elephant_mice"].iter_batches(
+            ctxs["elephant_mice"]["ep"]))
+        ok, wep, wdirn = pack_eligibility(wb)
+        assert ok
+        mixed = wb.copy()
+        mixed[1::2, 14] = ctxs["syn_flood"]["ep"]  # COL_EP -> wide
+        vfull = np.ones(64, dtype=bool)
+        vpart = vfull.copy()
+        vpart[40:] = False
+        node0.start_serving(ring_capacity=1 << 13, drain_every=2,
+                            trace_sample=1, packed=True)
+        node0.serve_batch(pack_rows(wb), valid=vfull,
+                          packed_meta=(wep, wdirn))
+        node0.serve_batch(pack_rows(wb), valid=vpart,
+                          packed_meta=(wep, wdirn))
+        node0.serve_batch(mixed.copy(), valid=vfull)
+        node0.serve_batch(mixed.copy(), valid=vpart)
+        node0.stop_serving()
+
+        # -- everything ON: spans + per-packet events + analytics
+        c.start(trace_sample=1, packed=True, span_sample=64,
+                ring_capacity=1 << 13, drain_every=2)
+
+        # warm the churn patch path (DUS executables per table
+        # shape) on EVERY node — mints propagate over the kvstore
+        # watch and patch each replica — then FREEZE compile counts:
+        # the mixed run must not retrace a serving executable
+        live = {}
+        ops = iter(churn.iter_ops())
+        gens0 = {n.name: n.daemon.loader.tables.generation
+                 for n in c.nodes}
+        for _ in range(4):
+            churn.apply(node0, next(ops), live)
+        assert _wait(lambda: all(
+            n.daemon.loader.tables.generation > gens0[n.name]
+            for n in c.nodes), timeout=15), "churn propagation"
+        time.sleep(0.2)  # let in-flight watch patches settle
+        compiles0 = {n.name: _dispatch_compiles(n.daemon)
+                     for n in c.nodes}
+
+        # -- armed faults: one seeded drain-loop death mid-run (the
+        # PR 3 watchdog recovers it; the ledgers must close anyway)
+        inj = faults.arm("serving.dispatch=1x1@40", seed=9)
+        submitted = 0
+        churn_applied = 4
+        try:
+            t0 = time.monotonic()
+            rounds = 0
+            while True:
+                streams = [
+                    (name, mix[name].iter_batches(ctxs[name]["ep"]))
+                    for name in mix_names]
+                alive = dict(streams)
+                while alive:
+                    for name in list(alive):
+                        b = next(alive[name], None)
+                        if b is None:
+                            del alive[name]
+                            continue
+                        submitted += c.submit(b)
+                    if (submitted // 64) % 4 == 0:
+                        try:
+                            churn.apply(node0, next(ops), live)
+                            churn_applied += 1
+                        except faults.InjectedFault:
+                            pass
+                    while c.forward_pending() > (1 << 13):
+                        time.sleep(0.002)
+                rounds += 1
+                if time.monotonic() - t0 >= duration_s:
+                    break
+        finally:
+            faults.disarm(inj)
+        churn.drain(node0, live)
+        elapsed = time.monotonic() - t0
+        final = c.stop()
+        ledgers = c.ledgers()
+        result = {
+            "ledgers": ledgers,
+            "final": final,
+            "elapsed": elapsed,
+            "rounds": rounds,
+            "submitted": submitted,
+            "churn_applied": churn_applied,
+            "compiles0": compiles0,
+            "compiles1": {n.name: _dispatch_compiles(n.daemon)
+                          for n in c.nodes},
+            "compile_keys": {
+                n.name: n.daemon.loader.compile_log.snapshot(
+                    limit=0)["by-key"] for n in c.nodes},
+            "violations": {
+                n.name: n.daemon.loader.compile_log.summary()
+                ["violations"] for n in c.nodes},
+            "restarts": sum(
+                (st["front-end"] or {}).get(
+                    "fault-tolerance", {}).get("restarts", 0)
+                for st in c.per_node_stats().values()),
+            "pressure": {n.name: n.daemon.pressure.stats()
+                         for n in c.nodes},
+            "incidents": {
+                n.name: n.daemon.flightrec.stats()
+                ["incidents-by-kind"] for n in c.nodes},
+        }
+        return result
+    finally:
+        c.shutdown()
+
+
+def _assert_everything(r):
+    """The gate's common assertions: five ledgers exact and
+    non-trivial, zero serving recompiles, the armed fault both
+    FIRED and was absorbed."""
+    led = r["ledgers"]
+    assert led["exact"], led
+    # non-trivial: every ledger actually saw traffic
+    assert led["cluster"]["submitted"] == r["submitted"] > 0
+    for name, pk in led["packet"].items():
+        assert pk["exact"], (name, pk)
+    assert sum(ev["joined"] for ev in led["event"].values()) > 0
+    for name, ev in led["event"].items():
+        assert ev["exact"], (name, ev)
+    assert sum(sp["started"] for sp in led["span"].values()) > 0
+    for name, sp in led["span"].items():
+        assert sp["exact"], (name, sp)
+    assert sum(ag["ingested"] for ag in led["agg"].values()) > 0
+    for name, ag in led["agg"].items():
+        assert ag["exact"], (name, ag)
+    # zero serving-executable recompiles during the mixed run
+    assert r["compiles1"] == r["compiles0"], (r["compiles0"],
+                                              r["compiles1"])
+    assert all(v == 0 for v in r["violations"].values()), \
+        r["violations"]
+    # the armed fault fired and the watchdog absorbed it
+    assert r["restarts"] >= 1, r["restarts"]
+    assert r["churn_applied"] >= 8
+
+
+@pytest.mark.chaos
+@pytest.mark.cluster
+@pytest.mark.scenario
+class TestEverythingOnGate:
+    """The SHORT tier-1 gate: one mixed round over a ~seconds
+    window."""
+
+    def test_everything_on_short(self, tmp_path):
+        r = _run_everything(tmp_path, duration_s=2.0)
+        _assert_everything(r)
+        # the syn_flood leg pressured the 1k CT map on node(s) that
+        # own its flows: SOME node entered pressure and recorded the
+        # incident (flow-affine routing decides which)
+        states = [p["state"] for p in r["pressure"].values()]
+        episodes = sum(p["episodes"] for p in r["pressure"].values())
+        assert episodes >= 1, r["pressure"]
+        assert "pressure" in states or episodes >= 1
+        assert any(inc.get("map-pressure", 0) >= 1
+                   for inc in r["incidents"].values()), \
+            r["incidents"]
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.cluster
+@pytest.mark.scenario
+class TestEverythingOnSoak:
+    """The minutes-long soak (excluded from the tier-1 budget by the
+    slow marker): the same everything-on composition held for a
+    sustained multi-round window — long enough for multiple mixed
+    rounds, repeated churn cycles, and pressure-state dwell."""
+
+    def test_everything_on_soak(self, tmp_path):
+        r = _run_everything(tmp_path, duration_s=90.0)
+        _assert_everything(r)
+        assert r["rounds"] >= 3
+        assert r["elapsed"] >= 90.0
+        episodes = sum(p["episodes"] for p in r["pressure"].values())
+        assert episodes >= 1
+        assert any(inc.get("map-pressure", 0) >= 1
+                   for inc in r["incidents"].values())

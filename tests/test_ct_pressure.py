@@ -1,0 +1,374 @@
+"""Map-pressure monitor + graceful degradation (ISSUE 12,
+datapath/pressure.py): the CT/NAT pressure floor, the adaptive
+CT-GC response, the `map-pressure` incident + sysdump capture, and
+the REASON_NAT_EXHAUSTED end-to-end decode.
+
+Named to sort early per the tier-1 budget-truncation convention."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.datapath.pressure import (MapPressureMonitor,
+                                          validate_pressure_config)
+from cilium_tpu.testing.workloads import (make_scenario, run_scenario,
+                                          scenario_daemon)
+
+
+def _wait(pred, timeout=30.0, tick=0.005):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+# ---------------------------------------------------------------------
+class TestConfigValidation:
+    def test_pressure_knob_errors(self):
+        with pytest.raises(ValueError, match="map_pressure_interval"):
+            validate_pressure_config(-1, 0.85, 0.7, 1.0)
+        with pytest.raises(ValueError, match="ct_pressure_threshold"):
+            validate_pressure_config(5, 1.5, 0.7, 1.0)
+        with pytest.raises(ValueError, match="ct_pressure_clear"):
+            validate_pressure_config(5, 0.85, 0.9, 1.0)
+        with pytest.raises(ValueError,
+                           match="ct_gc_pressure_interval"):
+            validate_pressure_config(5, 0.85, 0.7, 0)
+
+    def test_daemon_validates_at_construction(self):
+        from cilium_tpu.agent import Daemon, DaemonConfig
+
+        with pytest.raises(ValueError, match="ct_pressure_clear"):
+            Daemon(DaemonConfig(backend="interpreter",
+                                ct_pressure_clear=0.95,
+                                ct_pressure_threshold=0.9))
+        with pytest.raises(ValueError, match="nat_pool_capacity"):
+            Daemon(DaemonConfig(backend="interpreter",
+                                nat_pool_capacity=100))  # not 2^k
+        with pytest.raises(ValueError, match="nat_pool_capacity"):
+            Daemon(DaemonConfig(backend="interpreter",
+                                nat_pool_capacity=1 << 16))
+
+
+# ---------------------------------------------------------------------
+class TestMonitorStateMachine:
+    """Unit surface: scripted samples drive enter/exit with
+    hysteresis and exactly one incident per episode."""
+
+    def _monitor(self, samples):
+        it = iter(samples)
+        calls = {"accel": [], "restore": 0, "incidents": []}
+
+        def sample_fn():
+            return next(it)
+
+        mon = MapPressureMonitor(
+            sample_fn,
+            on_accelerate=lambda s: calls["accel"].append(s),
+            on_restore=lambda: calls.__setitem__(
+                "restore", calls["restore"] + 1),
+            record_incident=lambda kind, det: calls[
+                "incidents"].append((kind, det)),
+            ct_threshold=0.85, ct_clear=0.70,
+            gc_pressure_interval_s=0.5)
+        return mon, calls
+
+    @staticmethod
+    def _s(occ, drops=0, nat=0):
+        return {"ct": {"capacity": 100, "occupied": int(occ * 100),
+                       "occupancy": occ, "insert-drops": drops},
+                "nat": {"capacity": 64, "failures": nat}}
+
+    def test_occupancy_threshold_enters_and_hysteresis_exits(self):
+        mon, calls = self._monitor([
+            self._s(0.2), self._s(0.9), self._s(0.8),
+            self._s(0.75), self._s(0.6), self._s(0.9)])
+        mon.sample()
+        assert mon.state == "ok"
+        mon.sample()
+        assert mon.state == "pressure"
+        assert calls["accel"] == [0.5]
+        assert [k for k, _ in calls["incidents"]] == ["map-pressure"]
+        mon.sample()  # 0.8: above clear — still pressure, no new
+        mon.sample()  # 0.75: still above clear
+        assert mon.state == "pressure"
+        assert len(calls["incidents"]) == 1  # one per episode
+        mon.sample()  # 0.6: clears
+        assert mon.state == "ok" and calls["restore"] == 1
+        mon.sample()  # re-enters: a NEW episode, a NEW incident
+        assert mon.state == "pressure"
+        assert mon.episodes == 2
+        assert len(calls["incidents"]) == 2
+
+    def test_insert_drop_delta_triggers(self):
+        mon, calls = self._monitor([
+            self._s(0.1, drops=5),  # baseline sample seeds deltas
+            self._s(0.1, drops=5),  # no NEW drops: ok
+            self._s(0.1, drops=9),  # +4: pressure
+            self._s(0.1, drops=9),  # quiet + under clear: exits
+        ])
+        mon.sample()
+        mon.sample()
+        assert mon.state == "ok"
+        mon.sample()
+        assert mon.state == "pressure"
+        assert mon.last["ct"]["insert-drop-delta"] == 4
+        mon.sample()
+        assert mon.state == "ok"
+
+    def test_nat_failure_delta_triggers(self):
+        mon, _calls = self._monitor([
+            self._s(0.1), self._s(0.1, nat=3)])
+        mon.sample()
+        mon.sample()
+        assert mon.state == "pressure"
+        assert mon.last["nat"]["failure-delta"] == 3
+
+    def test_interpreter_occupancy_none_keys_on_counters(self):
+        s = {"ct": {"capacity": 0, "occupied": 7, "occupancy": None,
+                    "insert-drops": 0},
+             "nat": {"capacity": None, "failures": 0}}
+        mon, _ = self._monitor([s, s])
+        mon.sample()
+        mon.sample()
+        assert mon.state == "ok"
+
+    def test_stats_shape(self):
+        mon, _ = self._monitor([self._s(0.5)])
+        mon.sample()
+        st = mon.stats()
+        for key in ("state", "episodes", "samples", "accelerated",
+                    "ct", "nat", "ct-threshold", "ct-clear"):
+            assert key in st, key
+
+
+# ---------------------------------------------------------------------
+class TestLoaderPressureSurface:
+    def test_interpreter_map_pressure_shape(self):
+        from cilium_tpu.agent import Daemon, DaemonConfig
+
+        d = Daemon(DaemonConfig(backend="interpreter"))
+        p = d.loader.map_pressure(10)
+        assert p["ct"]["occupancy"] is None
+        assert p["nat"]["failures"] == 0
+        d.shutdown()
+
+    def test_tpu_map_pressure_counts_entries(self):
+        from cilium_tpu.agent import Daemon, DaemonConfig
+        from cilium_tpu.core import TCP_SYN, make_batch
+
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 10,
+                                map_pressure_interval=0.0))
+        ep = d.add_endpoint("srv", ("10.0.2.1",), ["k8s:app=srv"])
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "srv"}},
+            "ingress": [{"fromEntities": ["world"]}]}])
+        d.start()
+        p0 = d.loader.map_pressure(d._now())
+        assert p0["ct"]["occupied"] == 0
+        rows = make_batch([dict(
+            src=f"8.8.{i // 250}.{i % 250 + 1}", dst="10.0.2.1",
+            sport=30000 + i, dport=443, proto=6, flags=TCP_SYN,
+            ep=ep.id, dir=0) for i in range(64)]).data
+        d.process_batch(rows)
+        p1 = d.loader.map_pressure(d._now())
+        assert p1["ct"]["occupied"] == 64
+        assert p1["ct"]["occupancy"] == pytest.approx(64 / 1024)
+        d.shutdown()
+
+
+# ---------------------------------------------------------------------
+@pytest.mark.chaos
+@pytest.mark.scenario
+class TestSynFloodPressureLeg:
+    """The acceptance leg: syn_flood demonstrably drives CT
+    insert-drop pressure, the controller accelerates the aging sweep
+    and records a `map-pressure` incident with a sysdump bundle, and
+    the packet ledger stays exact through the storm."""
+
+    def test_syn_flood_end_to_end(self, tmp_path):
+        sc = make_scenario("syn_flood", seed=3, n_flows=3072,
+                           batch=512)
+        d = scenario_daemon(sc, map_pressure_interval=0.1,
+                            ct_gc_pressure_interval=0.25,
+                            sysdump_dir=str(tmp_path))
+        d.start()
+        try:
+            normal = d.controllers.get("ct-gc")._interval
+            assert normal == d.config.ct_gc_interval
+            r = run_scenario(d, sc)
+            assert r["passed"], r["checks"]
+            m = r["metrics"]
+            assert m["ledger_exact"]
+            assert m["ct_insert_drops"] > 0
+            assert m["ct_occupancy"] >= 0.85
+            # the monitor noticed (bounded poll: the controller
+            # samples every 100ms)
+            assert _wait(lambda: d.pressure.stats()["state"]
+                         == "pressure", timeout=10)
+            st = d.pressure.stats()
+            assert st["accelerated"] and st["episodes"] >= 1
+            assert st["ct"]["insert-drops"] > 0
+            # the aging sweep ACCELERATED (the adaptive-GC response)
+            assert _wait(lambda: d.controllers.get("ct-gc")
+                         ._interval == 0.25, timeout=10)
+            # ...and actually swept under the accelerated cadence
+            gc = d.controllers.get("ct-gc").status
+            n0 = gc.success_count
+            assert _wait(lambda: gc.success_count > n0, timeout=10)
+            # ONE map-pressure incident, with a sysdump bundle
+            assert _wait(lambda: d.flightrec.stats()
+                         ["incidents-by-kind"].get("map-pressure",
+                                                   0) >= 1,
+                         timeout=10)
+            assert _wait(lambda: d.flightrec.list_bundles(),
+                         timeout=10)
+            bundle = d.flightrec.list_bundles()[0]["path"]
+            from cilium_tpu.analysis.sysdump_lint import check_bundle
+
+            assert check_bundle(bundle) == []
+            import json
+
+            with open(bundle) as f:
+                body = json.load(f)
+            assert body["pressure"]["state"] == "pressure"
+            # pressure state rides serving stats + GET /serving shape
+            d.start_serving(trace_sample=0, ingress=True,
+                            packed=True)
+            try:
+                pr = d.serving_stats()["pressure"]
+                assert pr["state"] == "pressure"
+                assert pr["ct"]["insert-drops"] > 0
+            finally:
+                d.stop_serving()
+        finally:
+            d.shutdown()
+
+    def test_patch_config_keeps_acceleration_mid_episode(self):
+        """Review regression: a `ct-gc-interval` patch DURING a live
+        pressure episode must not silently cancel the accelerated
+        sweep (the monitor only accelerates on the OK->PRESSURE
+        transition, so a reset here would stick until the episode
+        re-entered)."""
+        sc = make_scenario("syn_flood", seed=5, n_flows=2048,
+                           batch=512)
+        d = scenario_daemon(sc, map_pressure_interval=0.1,
+                            ct_gc_pressure_interval=0.25)
+        d.start()
+        try:
+            r = run_scenario(d, sc)
+            assert r["metrics"]["ct_insert_drops"] > 0
+            assert _wait(lambda: d.pressure.stats()["accelerated"],
+                         timeout=10)
+            assert _wait(lambda: d.controllers.get("ct-gc")
+                         ._interval == 0.25, timeout=10)
+            d.patch_config({"ct-gc-interval": 60.0})
+            assert d.config.ct_gc_interval == 60.0
+            # still accelerated: the episode owns the cadence
+            assert d.controllers.get("ct-gc")._interval == 0.25
+            # once the episode would exit, restore targets the NEW
+            # configured cadence
+            d._ct_gc_restore()
+            assert d.controllers.get("ct-gc")._interval == 60.0
+        finally:
+            d.shutdown()
+
+    def test_registry_series_after_sample(self):
+        sc = make_scenario("syn_flood", seed=5, n_flows=2048,
+                           batch=512)
+        d = scenario_daemon(sc, map_pressure_interval=0.1)
+        d.start()
+        try:
+            r = run_scenario(d, sc)
+            assert r["metrics"]["ct_insert_drops"] > 0
+            assert _wait(lambda: (d.pressure.last or {}).get(
+                "ct", {}).get("insert-drops", 0) > 0, timeout=10)
+            prom = d.registry.render()
+            assert "cilium_ct_occupancy " in prom
+            assert "cilium_ct_insert_drops_total " in prom
+            assert "cilium_nat_pool_failures_total " in prom
+            assert "cilium_map_pressure 1" in prom
+            drops = int(float(next(
+                line.split()[1] for line in prom.splitlines()
+                if line.startswith("cilium_ct_insert_drops_total "))))
+            assert drops >= r["metrics"]["ct_insert_drops"]
+        finally:
+            d.shutdown()
+
+    def test_follow_mode_rate_keys_cover_pressure(self):
+        from cilium_tpu.cli.main import _SERVING_RATE_KEYS
+
+        paths = {keys for keys, _label in _SERVING_RATE_KEYS}
+        assert ("pressure", "ct", "insert-drops") in paths
+        assert ("pressure", "nat", "failures") in paths
+
+
+# ---------------------------------------------------------------------
+@pytest.mark.scenario
+class TestNatExhaustionLeg:
+    """The acceptance leg: nat_exhaustion drops count as
+    REASON_NAT_EXHAUSTED end-to-end — metricsmap -> monitor -> flow
+    -> CLI decode tables — and surface as NAT pool pressure."""
+
+    def test_nat_exhaustion_end_to_end(self):
+        from cilium_tpu.datapath.verdict import REASON_NAT_EXHAUSTED
+        from cilium_tpu.flow.flow import DROP_REASON_DESC
+        from cilium_tpu.monitor.api import DROP_REASON_NAMES
+
+        sc = make_scenario("nat_exhaustion", seed=7)
+        d = scenario_daemon(sc, map_pressure_interval=0.1)
+        d.start()
+        try:
+            r = run_scenario(d, sc)
+            assert r["passed"], r["checks"]
+            m = r["metrics"]
+            assert m["nat_failures"] > 0
+            # metricsmap
+            assert m["drops_by_reason"].get(
+                REASON_NAT_EXHAUSTED, 0) > 0
+            mm = d.loader.metrics()
+            assert mm[REASON_NAT_EXHAUSTED].sum() > 0
+            # monitor -> flow: the observer holds DROP flows with the
+            # NAT reason and the hubble JSON renders its desc
+            flows = [f for f in d.observer.get_flows(number=2000)
+                     if f.drop_reason == REASON_NAT_EXHAUSTED]
+            assert flows, "no NAT-exhausted flows reached the ring"
+            fd = flows[0].to_dict()
+            assert fd["drop_reason_desc"] == \
+                DROP_REASON_DESC[REASON_NAT_EXHAUSTED]
+            # CLI decode table (monitor/api)
+            assert DROP_REASON_NAMES[REASON_NAT_EXHAUSTED] \
+                == "No mapping for NAT masquerade"
+            # the pool-pressure surface: loader sample + nat_status
+            p = d.loader.map_pressure(d._now())
+            assert p["nat"]["failures"] == m["nat_failures"]
+            assert p["nat"]["capacity"] == 256
+            ns = d.loader.nat_status(d._now())
+            assert ns["alloc-failed"] == m["nat_failures"]
+            # the monitor entered pressure off the NAT deltas
+            assert _wait(lambda: d.pressure.stats()["episodes"] >= 1,
+                         timeout=10)
+        finally:
+            d.shutdown()
+
+    def test_interpreter_backend_parity(self):
+        """The same ramp on the oracle backend: same reason, pool
+        failures counted (generation/metrics parity discipline)."""
+        from cilium_tpu.datapath.verdict import REASON_NAT_EXHAUSTED
+
+        sc = make_scenario("nat_exhaustion", seed=7, n_flows=512,
+                           batch=128)
+        d = scenario_daemon(sc, backend="interpreter",
+                            map_pressure_interval=0.0)
+        d.start()
+        try:
+            r = run_scenario(d, sc)
+            m = r["metrics"]
+            assert m["nat_failures"] > 0
+            assert m["drops_by_reason"].get(
+                REASON_NAT_EXHAUSTED, 0) > 0
+        finally:
+            d.shutdown()
