@@ -266,6 +266,27 @@ class DaemonConfig:
     # shape); "memory" shares the InMemoryKVStore object (cheapest
     # tests)
     cluster_kvstore: str = "remote"
+    # "thread" = in-process replicas (the PR 8 shape: cheapest tests,
+    # N nodes share one GIL); "process" = one spawned worker PROCESS
+    # per node (cluster/nodehost.py) with row forwarding over real
+    # sockets (cluster/transport.py) — the upstream per-node-agent
+    # shape where N nodes buy N cores.  Process mode requires
+    # cluster_kvstore="remote"
+    cluster_mode: str = "thread"
+    # slots per INITIAL node in the router's fixed flow-hash space:
+    # the re-pin granularity for failover and live scale-out (a
+    # joining node steals ~1/new_n of the slots, nobody else's flows
+    # move)
+    cluster_slot_factor: int = 16
+    # -- queue-depth autoscale (cluster/scale.py ClusterAutoscaler).
+    # When ON, a named controller samples the router's forward queues
+    # and add_node()s after `ticks` consecutive samples over
+    # `high_frac * cluster_forward_depth`, up to `max_nodes`
+    cluster_autoscale: bool = False
+    cluster_autoscale_max_nodes: int = 8
+    cluster_autoscale_high_frac: float = 0.5
+    cluster_autoscale_ticks: int = 3
+    cluster_autoscale_interval_s: float = 0.5
     # -- live policy churn (datapath/tables.py table versioning;
     # ISSUE 10).  Delta attach: repaint only fingerprint-changed
     # policies on a re-attach instead of recompiling the world

@@ -16,11 +16,15 @@ from .core import FileCtx, Finding
 # "router" = the cluster serving tier's routing plane: the front-end
 # enqueue path plus the per-node forwarder threads
 # (cilium_tpu/cluster/router.py) — a hot-path domain like "drain"
-# (see hotpath.HOT_DOMAINS).  "api" covers the control-plane thread
-# family: API handlers, CLI, tests' main thread, and the cluster
+# (see hotpath.HOT_DOMAINS).  "transport" = the threads that move
+# cluster socket frames (cluster/transport.py helpers, the node
+# host's data-channel reader, the forwarders' socket legs) — also a
+# hot domain: a forward frame's round trip sits on the cluster's
+# admission path.  "api" covers the control-plane thread family: API
+# handlers, CLI, tests' main thread, and the cluster
 # membership/failover orchestration threads.
 AFFINITIES = ("drain", "event-worker", "watchdog", "capture", "api",
-              "cli", "offline", "router", "any")
+              "cli", "offline", "router", "transport", "any")
 
 _GUARDED_LIST_RE = re.compile(
     r"#\s*guarded-by:\s*(?P<lock>[\w.-]+)\s*:\s*(?P<attrs>[\w,\s]+)$")

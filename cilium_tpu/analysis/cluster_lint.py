@@ -50,16 +50,30 @@ DECODE_TABLES = (
 )
 
 BENCH_NAME = "BENCH_cluster.json"
-# the cluster bench artifact's schema floor (bench.py --cluster)
+# the cluster bench artifact's schema floor (bench.py --cluster).
+# v2 (ISSUE 13): headline keys are the PROCESS-mode curve; `modes`
+# carries both per-mode curves (paired-leg ratios + spread + forward
+# latency percentiles), `host_cores` is the honesty floor (a 1-core
+# host cannot show N-core speedups in any mode), and the failover
+# leg is a real SIGKILL with crash_dropped in the ledger
 BENCH_CLUSTER_KEYS = (
-    "schema", "best_of",
+    "schema", "best_of", "host_cores", "mode", "modes",
     "sustained_pps_n1", "sustained_pps_n2", "sustained_pps_n3",
     "scaling_n2", "scaling_n3",
+    "forward_latency_us",
     "failover_blackout_ms", "failover_detect_ms",
     "failover_ct_entries", "failover_dropped",
+    "failover_crash_dropped", "failover_mode",
+    "scale_out",
     "ledger_exact",
 )
-BENCH_SCHEMA = "bench-cluster-v1"
+BENCH_SCHEMA = "bench-cluster-v2"
+# per-mode sub-dict floor (both entries of `modes`)
+BENCH_MODE_KEYS = (
+    "sustained_pps_n1", "sustained_pps_n2", "sustained_pps_n3",
+    "scaling_n2", "scaling_n3", "scaling_n2_pairs",
+    "scaling_n3_pairs", "forward_latency_us",
+)
 
 
 def _module_tuple(ctx: FileCtx, name: str) -> Optional[List[str]]:
@@ -198,4 +212,15 @@ def check_bench(path: str) -> List[str]:
     for key in BENCH_CLUSTER_KEYS:
         if key not in data:
             bad.append(f"{path}: missing required key {key!r}")
+    modes = data.get("modes")
+    if not isinstance(modes, dict) or set(modes) != {"thread",
+                                                     "process"}:
+        bad.append(f"{path}: 'modes' must carry exactly the thread "
+                   f"and process curves")
+    else:
+        for mode, curve in modes.items():
+            for key in BENCH_MODE_KEYS:
+                if key not in curve:
+                    bad.append(f"{path}: modes[{mode!r}] missing "
+                               f"{key!r}")
     return bad

@@ -46,6 +46,11 @@ _LOG_LEVELS = {"info", "warning", "warn", "error", "critical",
 HOT_DOMAINS = {
     "drain": "serving drain loop",
     "router": "cluster router hot path",
+    # the cluster transport I/O threads (ISSUE 13): row-frame
+    # send/recv/decode/ack on the forwarders and the node host's
+    # data reader — a forward's round trip is cluster admission
+    # latency exactly like dispatch latency is the node's
+    "transport": "cluster transport I/O",
 }
 
 

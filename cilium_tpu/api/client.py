@@ -134,6 +134,11 @@ class APIClient:
     def cluster_status(self):
         return self._request("GET", "/cluster/status")
 
+    def cluster_scale(self):
+        """Live scale-out: add one replica to the serving tier
+        (PUT /cluster/scale); returns the scale-out record."""
+        return self._request("PUT", "/cluster/scale")
+
     def cluster_health(self):
         return self._request("GET", "/cluster/health")
 

@@ -289,6 +289,15 @@ def _make_handler(daemon: Daemon):
                 if path == "/policy":
                     rev = daemon.policy_import(self._body())
                     self._send(200, {"revision": rev})
+                elif path == "/cluster/scale":
+                    # live scale-out (ISSUE 13): add one replica to
+                    # the serving tier this node belongs to
+                    if daemon._cluster is None:
+                        self._send(404, {
+                            "error": "not part of a cluster serving "
+                                     "tier (start_cluster_serving)"})
+                    else:
+                        self._send(200, daemon._cluster.add_node())
                 elif m := re.fullmatch(r"/endpoint/([\w.-]+)", path):
                     body = self._body() or {}
                     ep = daemon.add_endpoint(

@@ -181,15 +181,30 @@ def cmd_health(args) -> int:
 
 def cmd_cluster(args) -> int:
     """`cilium-tpu cluster status`: the clustermesh serving tier —
-    membership, routing table, failover history, and the
-    cluster-wide no-silent-loss ledger (any member node answers)."""
+    membership, routing table, failover/scale-out history, and the
+    cluster-wide no-silent-loss ledger (any member node answers).
+    `cilium-tpu cluster scale` adds one replica live (ISSUE 13)."""
+    if getattr(args, "action", "status") == "scale":
+        rec = _client(args).cluster_scale()
+        if args.json:
+            _print(rec)
+            return 0
+        print(f"Scaled out: {rec['node']} joined "
+              f"({rec['nodes-after']} nodes, "
+              f"{rec['moved-slots']} slots re-pinned, "
+              f"{rec['ct-migrated-entries']} CT entries migrated, "
+              f"pause {rec['pause-ms']}ms, survivor recompiles "
+              f"{rec['survivor-recompiles']})")
+        return 0
     st = _client(args).cluster_status()
     if args.json:
         _print(st)
         return 0
     c = st["cluster"]
     print(f"Cluster: {c['live']}/{c['nodes']} nodes live "
-          f"(kvstore {c['kvstore']}, failovers {c['failovers']})")
+          f"(mode {c.get('mode', 'thread')}, kvstore {c['kvstore']}, "
+          f"failovers {c['failovers']}, "
+          f"scale-outs {c.get('scale-outs', 0)})")
     for m in st["membership"]:
         node = st["per-node"].get(m["name"], {})
         mode = node.get("mode") or "-"
@@ -202,8 +217,19 @@ def cmd_cluster(args) -> int:
     if r is not None:
         print(f"Router: submitted {r['submitted']}, pending "
               f"{sum(r['pending'])}, overflow {r['router-overflow']}, "
-              f"failover-dropped {r['failover-dropped']}")
-        print(f"  slot owners: {r['slot-owner']}")
+              f"failover-dropped {r['failover-dropped']}, "
+              f"crash-dropped {r.get('crash-dropped', 0)}")
+        owners = r["slot-owner"]
+        counts = {}
+        for o in owners:
+            counts[o] = counts.get(o, 0) + 1
+        share = ", ".join(f"node{o}:{n}"
+                          for o, n in sorted(counts.items()))
+        print(f"  slots: {len(owners)} ({share})")
+        lat = r.get("forward-latency-us") or {}
+        if lat.get("count"):
+            print(f"  forward latency: p50 {_us(lat['p50'])} "
+                  f"p95 {_us(lat['p95'])} p99 {_us(lat['p99'])}")
     led = st["ledger"]
     print(f"Ledger: submitted {led['submitted']} == accounted "
           f"{led['accounted']} -> "
@@ -215,7 +241,23 @@ def cmd_cluster(args) -> int:
               f"{lf.get('detect-ms')}ms, CT entries "
               f"{lf['ct-replayed-entries']}, dropped "
               f"{lf['dropped-rows']})")
+    ls = c.get("last-scale-out")
+    if ls:
+        print(f"Last scale-out: {ls['node']} joined "
+              f"(pause {ls['pause-ms']}ms, "
+              f"{ls['ct-migrated-entries']} CT entries migrated)")
+    asc = c.get("autoscale")
+    if asc:
+        print(f"Autoscale: watermark {asc['high-frac']}, streak "
+              f"{asc['streak']}/{asc['ticks']}, triggered "
+              f"{asc['triggered']}, max {asc['max-nodes']}")
     return 0
+
+
+def _us(v):
+    if v is None:
+        return "-"
+    return f"{v / 1e3:.1f}ms" if v >= 1e3 else f"{v:.0f}µs"
 
 
 def cmd_config(args) -> int:
@@ -963,10 +1005,11 @@ def main(argv=None) -> int:
     sub.add_parser("health", help="cluster health (probe mesh)")
 
     p = sub.add_parser("cluster",
-                       help="clustermesh serving tier status "
-                            "(membership, router, failovers, ledger)")
+                       help="clustermesh serving tier: status "
+                            "(membership, router, failovers, ledger)"
+                            " | scale (live add_node)")
     p.add_argument("action", nargs="?", default="status",
-                   choices=["status"])
+                   choices=["status", "scale"])
 
     p = sub.add_parser("config", help="config get | set KEY VALUE")
     p.add_argument("action", nargs="?", default="get",

@@ -1,38 +1,53 @@
 """Clustermesh serving tier: N daemon replicas behind one flow-affine
-front-end router, with kvstore identity/policy propagation and
-CT-replay node failover.
+front-end router, with kvstore identity/policy propagation, CT-replay
+node failover, and live scale-out.
 
-Reference: upstream cilium's horizontal story — per-node agents,
-identities/state fanned through the kvstore (clustermesh-apiserver /
-kvstoremesh), health probing, and connection ownership pinned to the
-node that saw the flow.  PRs 1-7 built a production-grade SINGLE-node
-serving plane; this package composes the repo's existing parts
-(``kvstore/remote.py`` networked store, ``health/`` node registry,
-``parallel.flow_shard_ids`` routing hash, PR 3 CT snapshot/restore)
-into the multi-node tier (PARITY row 61):
+Reference: upstream cilium's horizontal story — per-node agent
+PROCESSES, identities/state fanned through the kvstore
+(clustermesh-apiserver / kvstoremesh), health probing, and connection
+ownership pinned to the node that saw the flow.  PRs 1-7 built a
+production-grade SINGLE-node serving plane; PR 8 composed the
+multi-node tier from the repo's existing parts (``kvstore/remote.py``
+networked store, ``health/`` node registry, ``parallel.flow_shard_ids``
+routing hash, PR 3 CT snapshot/restore); ISSUE 13 makes it honest and
+elastic (PARITY rows 61/65):
 
 - :class:`ClusterServing` / :func:`start_cluster_serving` — build N
-  in-process daemon replicas ("nodes": threads, not processes — the
-  CPU backend cannot run cross-process collectives; see
-  DIVERGENCES), each with its own serving runtime and its own
-  kvstore CLIENT against one shared :class:`KVStoreServer`, so
-  identity mints and policy publishes propagate node-to-node over
-  the REAL networked transport, not object sharing;
+  daemon replicas in one of two modes (``cluster_mode``):
+  ``"thread"`` (in-process replicas, the PR 8 shape — cheapest
+  tests, but N nodes share one GIL) or ``"process"`` (one spawned
+  worker PROCESS per node hosting a full Daemon + serving runtime —
+  ``cluster/nodehost.py`` / ``cluster/process.py`` — forwarding over
+  real sockets on the shared ``cluster/transport.py`` framing, so N
+  nodes buy N cores).  Either way each replica runs its own kvstore
+  CLIENT against one shared :class:`KVStoreServer`, so identity
+  mints and policy publishes propagate node-to-node over the REAL
+  networked transport, not object sharing;
 - :mod:`.router` — the flow-affine front end: a 4-tuple's forward
-  and reply packets pin to one node; bounded per-node forward
-  queues shed with counted ``REASON_CLUSTER_OVERFLOW`` drops;
+  and reply packets pin to one node via a FIXED slot space
+  (``cluster_slot_factor`` slots per initial node) and a mutable
+  slot->owner table; bounded per-node forward queues shed with
+  counted ``REASON_CLUSTER_OVERFLOW`` drops;
 - :mod:`.membership` — liveness sweep + injectable node death
   (``cluster.probe`` fault site) + the kvstore policy plane;
 - :mod:`.failover` — CT-replay failover onto a designated peer:
   replies for pre-failover connections keep passing egress
-  enforcement on the peer (the PR 3 demotion proof, extended to
-  node death).
+  enforcement on the peer.  In process mode the dead node is a real
+  SIGKILLed process: its CT replays from the parent-retained
+  snapshot replica, its final ledger is its last data-channel ACK,
+  and the admitted-but-unresolved delta is counted
+  ``crash_dropped``;
+- :mod:`.scale` — LIVE SCALE-OUT (``add_node()``): a fresh replica
+  joins a serving cluster, a fair slot share re-pins to it, the
+  moved slots' CT migrates via the snapshot/merge/restore path (the
+  failover proof run in reverse), ledger exact across the
+  transition; plus a queue-depth-driven autoscale controller.
 
 The cluster-wide no-silent-loss ledger (asserted exact in every
 cluster test)::
 
     submitted == sum over nodes (verdicts + shed + recovery_dropped)
-                 + router_overflow + failover_dropped
+                 + router_overflow + failover_dropped + crash_dropped
 """
 
 from __future__ import annotations
@@ -40,7 +55,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -48,7 +63,7 @@ from ..serving import ServingError
 from .failover import FailoverOrchestrator
 from .membership import (ClusterMembership, ClusterPolicySync,
                          publish_policy)
-from .router import ClusterRouter
+from .router import SLOT_FACTOR, ClusterRouter
 
 __all__ = [
     "ClusterServing", "ClusterNode", "ClusterRouter",
@@ -57,11 +72,17 @@ __all__ = [
 ]
 
 _KVSTORE_MODES = ("remote", "memory")
+_CLUSTER_MODES = ("thread", "process")
 
 
 def validate_cluster_config(nodes, forward_depth, probe_interval_s,
                             death_threshold, convergence_deadline_s,
-                            kvstore_mode):
+                            kvstore_mode, mode="thread",
+                            slot_factor=SLOT_FACTOR,
+                            autoscale_max_nodes=8,
+                            autoscale_high_frac=0.5,
+                            autoscale_ticks=3,
+                            autoscale_interval_s=0.5):
     """Normalize + validate the cluster knobs (the serving-knob
     discipline: a typo'd cluster config fails at construction, not as
     a silent misroute under load)."""
@@ -85,15 +106,105 @@ def validate_cluster_config(nodes, forward_depth, probe_interval_s,
         raise ValueError(
             f"cluster_kvstore must be one of {_KVSTORE_MODES}, got "
             f"{kvstore_mode!r}")
+    mode = str(mode)
+    if mode not in _CLUSTER_MODES:
+        raise ValueError(
+            f"cluster_mode must be one of {_CLUSTER_MODES}, got "
+            f"{mode!r}")
+    if mode == "process" and kvstore_mode != "remote":
+        raise ValueError(
+            "cluster_mode='process' requires cluster_kvstore="
+            "'remote': worker processes cannot share an in-memory "
+            "store object")
+    slot_factor = int(slot_factor)
+    if slot_factor < 1:
+        raise ValueError("cluster_slot_factor must be >= 1")
+    autoscale_max_nodes = int(autoscale_max_nodes)
+    if autoscale_max_nodes < 1:
+        raise ValueError("cluster_autoscale_max_nodes must be >= 1")
+    autoscale_high_frac = float(autoscale_high_frac)
+    if not 0.0 < autoscale_high_frac <= 1.0:
+        raise ValueError(
+            "cluster_autoscale_high_frac must be in (0, 1]")
+    autoscale_ticks = int(autoscale_ticks)
+    if autoscale_ticks < 1:
+        raise ValueError("cluster_autoscale_ticks must be >= 1")
+    autoscale_interval_s = float(autoscale_interval_s)
+    if autoscale_interval_s <= 0:
+        raise ValueError("cluster_autoscale_interval_s must be > 0")
     return (nodes, forward_depth, probe_interval_s, death_threshold,
-            convergence_deadline_s, kvstore_mode)
+            convergence_deadline_s, kvstore_mode, mode, slot_factor,
+            autoscale_max_nodes, autoscale_high_frac, autoscale_ticks,
+            autoscale_interval_s)
+
+
+def warm_serving_session(daemon, bucket: int, ep: int,
+                         trace_sample: int,
+                         ring_capacity: int) -> bool:
+    """The ONE warm-up recipe (ISSUE 13 satellite — the PR 12 gate's
+    inline workaround made cluster infrastructure): compile the
+    packed+wide × full/valid-masked serving executables in a
+    throwaway non-ingress session BEFORE a real session starts.
+    ``trace_sample`` and ``ring_capacity`` are compile-key statics
+    and MUST mirror the real session's values — the zero-recompile
+    regression pins catch a drift.  One definition for both modes:
+    the thread branch of ``ClusterServing._warm_nodes`` calls it on
+    node0 (jit caches are process-global); every worker process runs
+    it on itself (``nodehost._op_warm``).  Returns whether the
+    packed path was warmable."""
+    from ..core.packets import (COL_DST_IP3, COL_EP, COL_FAMILY,
+                                COL_LEN, COL_PROTO, COL_SPORT,
+                                COL_SRC_IP3, N_COLS,
+                                pack_eligibility, pack_rows)
+
+    rows = np.zeros((bucket, N_COLS), dtype=np.uint32)
+    rows[:, COL_SRC_IP3] = 1
+    rows[:, COL_DST_IP3] = 2
+    rows[:, COL_SPORT] = 1024 + (np.arange(bucket) % 4096)
+    rows[:, COL_PROTO] = 6
+    rows[:, COL_LEN] = 64
+    rows[:, COL_FAMILY] = 4
+    rows[:, COL_EP] = ep
+    ok, wep, wdirn = pack_eligibility(rows)
+    vfull = np.ones(bucket, dtype=bool)
+    vpart = vfull.copy()
+    vpart[bucket // 2:] = False
+    daemon.start_serving(ring_capacity=ring_capacity, drain_every=2,
+                         trace_sample=trace_sample, packed=True)
+    try:
+        if ok:
+            daemon.serve_batch(pack_rows(rows), valid=vfull,
+                               packed_meta=(wep, wdirn))
+            daemon.serve_batch(pack_rows(rows), valid=vpart,
+                               packed_meta=(wep, wdirn))
+        daemon.serve_batch(rows.copy(), valid=vfull)
+        daemon.serve_batch(rows.copy(), valid=vpart)
+    finally:
+        daemon.stop_serving()
+    return bool(ok)
+
+
+@dataclasses.dataclass(frozen=True)
+class _EndpointRef:
+    """What ``add_endpoint`` returns in process mode: workers own the
+    Endpoint objects; callers only ever need the agreed id."""
+
+    id: int
+    name: str
 
 
 class ClusterNode:
-    """One replica: a full Daemon with its own serving runtime and
-    kvstore client.  ``alive`` flips exactly once (True -> False) on
-    crash; the final front-end snapshot is retained so the cluster
-    ledger can close over a corpse."""
+    """One in-process replica (``cluster_mode="thread"``): a full
+    Daemon with its own serving runtime and kvstore client.
+    ``alive`` flips exactly once (True -> False) on crash; the final
+    front-end snapshot is retained so the cluster ledger can close
+    over a corpse.
+
+    Presents the NODE INTERFACE the tier's orchestrators (failover,
+    scale-out, ledgers, surfaces) are written against —
+    ``cluster/process.py``'s :class:`~.process.ProcessNode` is the
+    other implementation, so everything above this layer runs
+    unchanged in either mode."""
 
     # guarded-by: _lock: alive, final
 
@@ -107,6 +218,11 @@ class ClusterNode:
         self._lock = threading.Lock()
         self.alive = True
         self.final: Optional[dict] = None
+        # span-tracer / event-plane refs captured at start_serving
+        # (stop_serving clears daemon._serving; node_ledgers() closes
+        # those ledgers post-stop through these)
+        self._tracer = None
+        self._eventplane = None
 
     def submit(self, rows: np.ndarray) -> int:
         # (unannotated on purpose: inherits the router forwarder's
@@ -116,9 +232,8 @@ class ClusterNode:
     def probe(self) -> bool:
         # thread-affinity: api
         """In-process liveness: the node is alive and its drain loop
-        is running.  (Multi-host deployments swap in the health
-        plane's socket probers — the membership layer only needs a
-        bool.)"""
+        is running.  (Process mode probes over the control socket —
+        ``ProcessNode.probe``.)"""
         with self._lock:
             if not self.alive:
                 return False
@@ -150,39 +265,171 @@ class ClusterNode:
             self.final = ({"front-end": final} if final is not None
                           else None)
 
+    def take_crash_loss(self) -> int:
+        # thread-affinity: api
+        """Thread-mode corpses yield a FULL final snapshot
+        (``kill()`` sweeps queued rows as counted recovery drops), so
+        there is never an unaccounted admitted-row delta — the
+        process-mode SIGKILL term is structurally zero here."""
+        return 0
+
     def mode(self) -> Optional[str]:
         # thread-affinity: any
         s = self.daemon._serving
         lad = s.get("ladder") if s is not None else None
         return lad.rung if lad is not None else None
 
+    # -- node interface: bring-up --------------------------------------
+    def start_node(self) -> None:
+        self.daemon.start()
+
+    def start_serving(self, **kwargs) -> None:
+        self.daemon.start_serving(ingress=True, **kwargs)
+        self._tracer = self.daemon._serving.get("tracer")
+        self._eventplane = self.daemon._serving.get("eventplane")
+
+    def stop_serving(self) -> Optional[dict]:
+        with self._lock:
+            if not self.alive and self.final is not None:
+                return self.final
+        fin = self.daemon.stop_serving()
+        with self._lock:
+            if self.final is None:
+                self.final = fin
+            return self.final
+
+    def add_endpoint(self, name: str, ips, labels) -> int:
+        return int(self.daemon.add_endpoint(
+            name, tuple(ips), list(labels)).id)
+
+    def applied_policy_rev(self) -> int:
+        return (self.policy_sync.applied_rev
+                if self.policy_sync is not None else -1)
+
+    def has_identity(self, numeric: int) -> bool:
+        return self.daemon.allocator.lookup_by_id(
+            int(numeric)) is not None
+
+    # -- node interface: reading ---------------------------------------
+    def front_end(self) -> Optional[dict]:
+        with self._lock:
+            fin = self.final
+        if fin is not None:
+            return fin.get("front-end")
+        s = self.daemon._serving
+        rt = s.get("runtime") if s is not None else None
+        return rt.snapshot() if rt is not None else None
+
+    def node_ledgers(self) -> Optional[dict]:
+        out: Dict[str, dict] = {}
+        if self._eventplane is not None:
+            out["event"] = self._eventplane.stats()
+        if self._tracer is not None:
+            out["span"] = self._tracer.stats()
+        out["agg"] = self.daemon.analytics.stats()
+        return out
+
+    def metrics(self) -> Optional[np.ndarray]:
+        return np.asarray(self.daemon.loader.metrics())
+
+    def map_pressure(self) -> Optional[dict]:
+        return self.daemon.loader.map_pressure(self.daemon._now())
+
+    def dispatch_compiles(self) -> Optional[dict]:
+        return self.daemon.loader.compile_log.dispatch_summary()
+
+    def transport_stats(self) -> dict:
+        return {}  # in-process forwarding: no wire
+
+    # -- node interface: CT migration + surfacing ----------------------
+    def snapshot_ct(self, trigger: str = "cluster") -> np.ndarray:
+        self.daemon.ct_snapshot_now(trigger)
+        return self.daemon._ct_snap["rows"]
+
+    def ct_rows_for_failover(self) -> np.ndarray:
+        """The latest retained CT snapshot; in-process fallback reads
+        the corpse's device CT directly (possible here because
+        "nodes" are threads sharing the host — a SIGKILLed process
+        node gets only the parent-retained replica)."""
+        snap = self.daemon._ct_snap
+        if snap is not None:
+            return snap["rows"]
+        try:
+            return self.daemon.loader.ct_snapshot()
+        except Exception:  # noqa: BLE001 — an unreadable corpse CT
+            # degrades to an empty replay: pre-failover connections
+            # then re-establish instead of resuming (counted by the
+            # policy plane, never silent)
+            from ..datapath.conntrack import ROW_WORDS
+
+            return np.zeros((0, ROW_WORDS), dtype=np.uint32)
+
+    def merge_ct(self, rows: np.ndarray) -> None:
+        """Merge foreign CT rows with the live table — snapshot +
+        concat + restore (flow-affine routing keeps the two tables
+        disjoint; the device re-hash resolves any residue)."""
+        if not len(rows):
+            return
+        merged = np.concatenate([
+            self.daemon.loader.ct_snapshot(), np.asarray(rows)])
+        self.daemon.loader.ct_restore(merged)
+
+    def record_incident(self, kind: str, rec: dict) -> None:
+        self.daemon.record_incident(kind, rec)
+
+    def publish_cluster_drops(self, rows: Optional[np.ndarray],
+                              count: int) -> None:
+        self.daemon._publish_cluster_drops(rows, count)
+
+    def shutdown(self) -> None:
+        if self.policy_sync is not None:
+            self.policy_sync.close()
+        self.daemon.shutdown()
+        if self.kv_client is not None:
+            self.kv_client.close()
+
 
 class ClusterServing:
     """The cluster serving tier facade: construct -> add endpoints /
     import policy (fan-out + kvstore propagation) -> :meth:`start`
-    -> :meth:`submit` from any thread -> :meth:`stop`.
+    (node bring-up + warm-up + router + membership) -> :meth:`submit`
+    from any thread -> :meth:`add_node` to grow live ->
+    :meth:`stop`.
 
-    Every node daemon gets ``daemon._cluster = self`` so the
+    Thread-mode node daemons get ``daemon._cluster = self`` so the
     per-node surfaces (serving stats Cluster block, GET
     /cluster/status, the ``cilium_cluster_*`` registry series) can
     reach the tier from any node's API socket."""
 
     def __init__(self, nodes: int = 3, config=None,
                  node_prefix: str = "node"):
-        from ..agent.daemon import Daemon, DaemonConfig
+        from ..agent.daemon import DaemonConfig
 
         template = config or DaemonConfig()
+        self._template = template
+        self._node_prefix = node_prefix
         (self.n_nodes, self.forward_depth, self.probe_interval_s,
          self.death_threshold, self.convergence_deadline_s,
-         self.kvstore_mode) = validate_cluster_config(
+         self.kvstore_mode, self.mode, self.slot_factor,
+         self.autoscale_max_nodes, self.autoscale_high_frac,
+         self.autoscale_ticks, self.autoscale_interval_s
+         ) = validate_cluster_config(
             nodes, template.cluster_forward_depth,
             template.cluster_probe_interval_s,
             template.cluster_death_threshold,
             template.cluster_convergence_deadline_s,
-            template.cluster_kvstore)
+            template.cluster_kvstore,
+            mode=template.cluster_mode,
+            slot_factor=template.cluster_slot_factor,
+            autoscale_max_nodes=template.cluster_autoscale_max_nodes,
+            autoscale_high_frac=template.cluster_autoscale_high_frac,
+            autoscale_ticks=template.cluster_autoscale_ticks,
+            autoscale_interval_s=(
+                template.cluster_autoscale_interval_s))
         # -- the shared identity/policy plane ---------------------------
         self._kv_server = None
         self._kv_store = None
+        self._spawner = None
         if self.kvstore_mode == "remote":
             from ..kvstore.remote import KVStoreServer, RemoteKVStore
 
@@ -198,47 +445,99 @@ class ClusterServing:
             def client():
                 return self._kv_store
 
+        self._kv_client_factory = client
         # -- the replicas ----------------------------------------------
-        self.nodes: List[ClusterNode] = []
-        for i in range(self.n_nodes):
-            cfg = dataclasses.replace(template,
-                                      node_name=f"{node_prefix}{i}")
-            kv = client()
-            daemon = Daemon(cfg, kvstore=kv)
-            sync = ClusterPolicySync(kv, daemon)
-            node = ClusterNode(i, cfg.node_name, daemon,
-                               kv_client=(kv if self._kv_server
-                                          is not None else None),
-                               policy_sync=sync)
-            daemon._cluster = self
-            self.nodes.append(node)
+        # partial construction must not leak: a failed spawn/attach
+        # mid-loop tears down the kvstore server, the rendezvous
+        # listener, and every already-built replica (daemonic worker
+        # processes only die with the PARENT process — a long-lived
+        # test runner or API server would accumulate them otherwise)
+        self.nodes: List = []
+        try:
+            if self.mode == "process":
+                from .process import (ProcessNodeSpawner,
+                                      spawn_available)
+
+                if not spawn_available():
+                    raise ServingError(
+                        "cluster_mode='process' needs the "
+                        "multiprocessing 'spawn' start method, "
+                        "unavailable here")
+                self._spawner = ProcessNodeSpawner()
+            for i in range(self.n_nodes):
+                self.nodes.append(self._build_node(i))
+            if self.mode == "process":
+                for n in self.nodes:
+                    n.wait_ready()
+        except BaseException:
+            for n in self.nodes:
+                try:
+                    n.shutdown()
+                except Exception:  # noqa: BLE001 — best-effort
+                    pass  # teardown of a half-built replica
+            if self._spawner is not None:
+                self._spawner.close()
+            if self._kv_server is not None:
+                self._kv_server.close()
+            raise
         self._by_name = {n.name: n for n in self.nodes}
         self._policy_rev = 0
+        # the control-plane journal add_node replays onto a joining
+        # replica (endpoints registered in order => ids agree)
+        self._endpoints: List[tuple] = []
+        self._first_ep_id: Optional[int] = None
+        self._serving_kwargs: Optional[dict] = None
         self.router: Optional[ClusterRouter] = None
         self.failover = FailoverOrchestrator(self)
+        node0 = self.nodes[0]
         self.membership = ClusterMembership(
             self.nodes, self.probe_interval_s, self.death_threshold,
             on_death=self._on_node_death,
-            node_registry=self.nodes[0].daemon.node_registry)
+            node_registry=(node0.daemon.node_registry
+                           if isinstance(node0, ClusterNode)
+                           else None))
+        self.autoscaler = None
+        self._scale_lock = threading.Lock()
+        self.scale_events: List[dict] = []
         self._started = False
         self._stopped = False
         self._final: Optional[dict] = None
-        # per-node span-tracer / event-plane refs, captured at
-        # start() (stop_serving clears daemon._serving; ledgers()
-        # closes those ledgers post-stop through these)
-        self._tracers: Dict[str, object] = {}
-        self._eventplanes: Dict[str, object] = {}
+
+    def _build_node(self, idx: int, name: Optional[str] = None):
+        """One replica, either mode — construction (here) is separate
+        from bring-up (:meth:`start` / ``scale.scale_out``), so
+        scale-out can build a node while the cluster serves."""
+        name = name or f"{self._node_prefix}{idx}"
+        if self.mode == "process":
+            node = self._spawner.spawn(name, self._template,
+                                       self._kv_server.address)
+            node.idx = idx
+            node.attach()
+            return node
+        from ..agent.daemon import Daemon
+
+        cfg = dataclasses.replace(self._template, node_name=name)
+        kv = self._kv_client_factory()
+        daemon = Daemon(cfg, kvstore=kv)
+        sync = ClusterPolicySync(kv, daemon)
+        node = ClusterNode(idx, name, daemon,
+                           kv_client=(kv if self._kv_server
+                                      is not None else None),
+                           policy_sync=sync)
+        daemon._cluster = self
+        return node
 
     # -- topology ------------------------------------------------------
-    def node(self, name: str) -> ClusterNode:
+    def node(self, name: str):
         return self._by_name[name]
 
-    def designated_peer(self, dead_idx: int) -> Optional[ClusterNode]:
+    def designated_peer(self, dead_idx: int):
         """Next LIVE node in ring order after the dead one — the
         deterministic failover target every test and operator can
         predict."""
-        for step in range(1, self.n_nodes):
-            cand = self.nodes[(dead_idx + step) % self.n_nodes]
+        n = len(self.nodes)
+        for step in range(1, n):
+            cand = self.nodes[(dead_idx + step) % n]
             if cand.alive:
                 return cand
         return None
@@ -246,15 +545,32 @@ class ClusterServing:
     # -- control plane (fan-out + kvstore propagation) -----------------
     def add_endpoint(self, name: str, ips, labels):
         """Register one logical endpoint on EVERY replica (same id
-        everywhere — the router may pin any flow to any node)."""
-        eps = [n.daemon.add_endpoint(name, tuple(ips), list(labels))
-               for n in self.nodes]
-        ids = {ep.id for ep in eps}
+        everywhere — the router may pin any flow to any node).  The
+        registration is journaled so a scale-out replica replays it
+        in the same order."""
+        ids = {n.add_endpoint(name, tuple(ips), list(labels))
+               for n in self.nodes}
         if len(ids) != 1:
             raise ServingError(
                 f"endpoint id diverged across replicas: {sorted(ids)}"
                 f" (register endpoints in the same order everywhere)")
-        return eps[0]
+        ep_id = ids.pop()
+        self._endpoints.append((name, tuple(ips), list(labels)))
+        if self._first_ep_id is None:
+            self._first_ep_id = ep_id
+        if self.mode == "process":
+            return _EndpointRef(ep_id, name)
+        # thread mode keeps returning the node0 Endpoint object (the
+        # PR 8 surface tests and callers use)
+        return self.nodes[0].daemon.endpoints.get(ep_id)
+
+    def _policy_kv(self):
+        if self._kv_server is not None:
+            # the server's own store: an update triggers every
+            # replica's watch over the socket transport (the parent
+            # needs no client of its own)
+            return self._kv_server.store
+        return self._kv_store
 
     def policy_import(self, rules) -> int:
         """Publish one ruleset revision through the kvstore; every
@@ -262,9 +578,7 @@ class ClusterServing:
         watch.  Returns the revision — :meth:`wait_policy` blocks on
         cluster-wide convergence."""
         self._policy_rev += 1
-        kv = (self.nodes[0].kv_client
-              if self._kv_server is not None else self._kv_store)
-        publish_policy(kv, self._policy_rev, rules)
+        publish_policy(self._policy_kv(), self._policy_rev, rules)
         return self._policy_rev
 
     def wait_policy(self, rev: Optional[int] = None,
@@ -274,7 +588,7 @@ class ClusterServing:
                    else timeout)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if all(n.policy_sync.applied_rev >= rev
+            if all(n.applied_policy_rev() >= rev
                    for n in self.nodes if n.alive):
                 return True
             time.sleep(0.005)
@@ -282,13 +596,16 @@ class ClusterServing:
 
     def snapshot_now(self, trigger: str = "cluster") -> None:
         """Fan out a CT snapshot on every live replica — the failover
-        replay source.  Production deployments get the same cadence
-        from ``ct_snapshot_interval`` + ``Daemon.start()`` (the
-        periodic snapshot controller); tests and the bench drive it
+        replay source.  In process mode the rows also SHIP to the
+        parent (``ProcessNode.snapshot_ct``): after a SIGKILL the
+        parent-side replica is all that is left to replay.
+        Production deployments get the same cadence from
+        ``ct_snapshot_interval`` + ``Daemon.start()`` (the periodic
+        snapshot controller); tests and the bench drive it
         explicitly."""
         for n in self.nodes:
             if n.alive:
-                n.daemon.ct_snapshot_now(trigger)
+                n.snapshot_ct(trigger)
 
     def wait_identity(self, numeric: int,
                       timeout: Optional[float] = None) -> bool:
@@ -298,37 +615,95 @@ class ClusterServing:
                    else timeout)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
-            if all(n.daemon.allocator.lookup_by_id(numeric)
-                   is not None for n in self.nodes if n.alive):
+            if all(n.has_identity(numeric)
+                   for n in self.nodes if n.alive):
                 return True
             time.sleep(0.005)
         return False
 
     # -- lifecycle -----------------------------------------------------
+    def _warm_nodes(self, nodes: Sequence,
+                    trace_sample: int = 0,
+                    ring_capacity: int = 1 << 15) -> None:
+        """The bring-up warm discipline (ISSUE 13 satellite — the
+        PR 12 gate's inline workaround moved into the tier): compile
+        packed+wide × full/masked serving executables in a throwaway
+        non-ingress session BEFORE the real sessions start.  Thread
+        mode warms once (jit caches are process-global, and the
+        kvstore-propagated world makes state shapes identical across
+        replicas); process mode warms every worker in parallel (each
+        owns its own cache)."""
+        bucket = max(self._template.serving_bucket_ladder)
+        # trace_sample AND ring_capacity are part of the serving
+        # executables' compile keys (device-side sampling; the
+        # ring rides the dispatch): the warm session must mirror
+        # the real session's values or it warms the wrong keys
+        ep = self._first_ep_id if self._first_ep_id is not None else 0
+        if self.mode == "process":
+            errs: List[BaseException] = []
+
+            def _w(n):
+                try:
+                    n.warm(bucket, ep, trace_sample, ring_capacity)
+                except BaseException as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=_w, args=(n,), daemon=True)
+                  for n in nodes]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if errs:
+                raise ServingError(f"cluster warm-up failed: "
+                                   f"{errs[0]}")
+            return
+        # thread mode: one throwaway session on the first node warms
+        # every replica's executables (jit caches are process-global)
+        warm_serving_session(nodes[0].daemon, bucket, ep,
+                             trace_sample, ring_capacity)
+
     def start(self, trace_sample: int = 0, packed: bool = True,
               ring_capacity: int = 1 << 15, drain_every: int = 4,
-              span_sample: Optional[int] = None) -> None:
+              span_sample: Optional[int] = None,
+              warm: bool = True) -> None:
+        """Cluster bring-up proper (ISSUE 13 satellite): START every
+        node daemon (background controllers, map-pressure monitor,
+        and — critically — the post-start identity patch path, which
+        the pre-start cache-only path silently isn't), run the
+        warm-up discipline, start every serving session, then the
+        router, membership, and (when configured) the autoscaler.
+        Every construction path gets started nodes — the PR 12 gate's
+        inline workaround is retired."""
         if self._started:
             raise ServingError("cluster already started")
         for n in self.nodes:
-            n.daemon.start_serving(ring_capacity=ring_capacity,
-                                   drain_every=drain_every,
-                                   trace_sample=trace_sample,
-                                   ingress=True, packed=packed,
-                                   span_sample=span_sample)
-        # retain per-node span-tracer / event-plane references NOW:
-        # stop_serving clears daemon._serving, and the everything-on
-        # soak gate closes the span and event ledgers AFTER stop
-        self._tracers = {
-            n.name: n.daemon._serving.get("tracer")
-            for n in self.nodes}
-        self._eventplanes = {
-            n.name: n.daemon._serving.get("eventplane")
-            for n in self.nodes}
+            n.start_node()
+        if warm:
+            self._warm_nodes(self.nodes, trace_sample,
+                             ring_capacity)
+        kwargs = dict(ring_capacity=ring_capacity,
+                      drain_every=drain_every,
+                      trace_sample=trace_sample,
+                      packed=packed, span_sample=span_sample)
+        self._serving_kwargs = kwargs
+        for n in self.nodes:
+            n.start_serving(**kwargs)
         self.router = ClusterRouter(self.nodes, self.forward_depth,
-                                    on_overflow=self._surface_overflow)
+                                    on_overflow=self._surface_overflow,
+                                    slot_factor=self.slot_factor)
         self.router.start()
         self.membership.start()
+        if self._template.cluster_autoscale:
+            from .scale import ClusterAutoscaler
+
+            self.autoscaler = ClusterAutoscaler(
+                self,
+                high_frac=self.autoscale_high_frac,
+                ticks=self.autoscale_ticks,
+                max_nodes=self.autoscale_max_nodes,
+                interval_s=self.autoscale_interval_s)
+            self.autoscaler.start()
         self._started = True
 
     def submit(self, rows: np.ndarray) -> int:
@@ -339,19 +714,34 @@ class ClusterServing:
             raise ServingError("call ClusterServing.start() first")
         return r.submit(rows)
 
+    # -- live scale-out -------------------------------------------------
+    def add_node(self) -> dict:
+        """Grow a SERVING cluster by one replica: build + converge +
+        warm the newcomer, freeze/quiesce the router, re-pin a fair
+        slot share, migrate the moved slots' CT (the failover proof
+        run in reverse), resume.  Returns the scale-out record
+        (moved slots, migrated CT entries, pause window).  See
+        ``cluster/scale.py``."""
+        from .scale import scale_out
+
+        return scale_out(self)
+
     def stop(self) -> dict:
         """Drain the router and every replica; returns (and retains)
         the final cluster stats with the ledger closed."""
         if self._stopped:
             return self._final or self.stats()
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self.membership.stop()
         if self.router is not None:
             self.router.stop(drain=True)
         for n in self.nodes:
             # a crashed node's stop_serving is idempotent over the
-            # corpse: its runtime snapshot (swept queue included)
-            # is what the ledger reads
-            n.final = n.daemon.stop_serving()
+            # corpse: its retained final (swept queue included, or
+            # the last ack for a SIGKILLed worker) is what the
+            # ledger reads
+            n.stop_serving()
         self._stopped = True
         self._final = self.stats()
         return self._final
@@ -359,11 +749,9 @@ class ClusterServing:
     def shutdown(self) -> None:
         self.stop()
         for n in self.nodes:
-            if n.policy_sync is not None:
-                n.policy_sync.close()
-            n.daemon.shutdown()
-            if n.kv_client is not None:
-                n.kv_client.close()
+            n.shutdown()
+        if self._spawner is not None:
+            self._spawner.close()
         if self._kv_server is not None:
             self._kv_server.close()
 
@@ -375,7 +763,7 @@ class ClusterServing:
     def kill_node(self, name: str) -> None:
         """Crash a node and let the HEALTH path find it (probe
         failures -> death threshold -> failover) — the organic-death
-        shape."""
+        shape.  In process mode this is a REAL SIGKILL."""
         self.node(name).crash("operator kill_node")
 
     def fail_node(self, name: str) -> dict:
@@ -404,7 +792,7 @@ class ClusterServing:
         if node is None:
             return  # cluster-wide corpse: router_overflow holds the
             # exact count; there is no live surface left to decorate
-        node.daemon._publish_cluster_drops(rows, count)
+        node.publish_cluster_drops(rows, count)
 
     # -- reading --------------------------------------------------------
     def router_overflow_total(self) -> int:
@@ -415,12 +803,16 @@ class ClusterServing:
         r = self.router
         return r.failover_dropped if r is not None else 0
 
+    def crash_dropped_total(self) -> int:
+        r = self.router
+        return r.crash_dropped if r is not None else 0
+
     def failovers_total(self) -> int:
         return len(self.failover.snapshot())
 
     def live_dead_counts(self):
         live = sum(1 for n in self.nodes if n.alive)
-        return live, self.n_nodes - live
+        return live, len(self.nodes) - live
 
     def forward_pending(self) -> int:
         r = self.router
@@ -433,31 +825,33 @@ class ClusterServing:
         live, dead = self.live_dead_counts()
         recs = self.failover.snapshot()
         out = {
-            "nodes": self.n_nodes,
+            "nodes": len(self.nodes),
             "live": live,
             "dead": dead,
+            "mode": self.mode,
             "kvstore": self.kvstore_mode,
             "router": (self.router.snapshot()
                        if self.router is not None else None),
             "failovers": len(recs),
+            "scale-outs": len(self.scale_events),
         }
         if recs:
             out["last-failover"] = recs[-1]
+        if self.scale_events:
+            out["last-scale-out"] = self.scale_events[-1]
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.stats()
         return out
 
     def per_node_stats(self) -> Dict[str, dict]:
         out = {}
         for n in self.nodes:
-            if n.final is not None:
-                fe = n.final.get("front-end")
-            else:
-                s = n.daemon._serving
-                rt = s.get("runtime") if s is not None else None
-                fe = rt.snapshot() if rt is not None else None
             out[n.name] = {
                 "alive": n.alive,
                 "mode": n.mode(),
-                "front-end": fe,
+                "front-end": n.front_end(),
+                **({"transport": ts}
+                   if (ts := n.transport_stats()) else {}),
             }
         return out
 
@@ -470,6 +864,7 @@ class ClusterServing:
         submitted = r.submitted if r is not None else 0
         overflow = r.router_overflow if r is not None else 0
         fo_dropped = r.failover_dropped if r is not None else 0
+        crash = r.crash_dropped if r is not None else 0
         pending = r.pending_total() if r is not None else 0
         per_node = 0
         for name, st in self.per_node_stats().items():
@@ -479,12 +874,14 @@ class ClusterServing:
             ft = fe.get("fault-tolerance", {})
             per_node += (fe.get("verdicts", 0) + fe.get("shed", 0)
                          + ft.get("recovery-dropped", 0))
-        accounted = per_node + overflow + fo_dropped + pending
+        accounted = (per_node + overflow + fo_dropped + crash
+                     + pending)
         return {
             "submitted": submitted,
             "per-node-accounted": per_node,
             "router-overflow": overflow,
             "failover-dropped": fo_dropped,
+            "crash-dropped": crash,
             "forward-pending": pending,
             "accounted": accounted,
             "exact": submitted == accounted,
@@ -506,52 +903,55 @@ class ClusterServing:
         - ``cluster``: the router-level ledger (:meth:`ledger`).
 
         ``exact`` is the conjunction.  Meaningful after
-        :meth:`stop`, like every in-flight-exclusive ledger here."""
+        :meth:`stop`, like every in-flight-exclusive ledger here.
+        A SIGKILLed process node contributes its packet ledger (the
+        last-ack word, closed by ``crash_dropped``); its in-process
+        event/span/agg planes died with it and are skipped — loss a
+        thread-mode corpse never shows."""
         out: Dict[str, dict] = {"packet": {}, "event": {},
                                 "span": {}, "agg": {}}
         ok = True
-        for name, st in self.per_node_stats().items():
-            fe = st.get("front-end")
+        per_node = self.per_node_stats()
+        for n in self.nodes:
+            fe = (per_node.get(n.name) or {}).get("front-end")
             if fe is not None:
                 ft = fe.get("fault-tolerance", {})
                 acc = (fe.get("verdicts", 0) + fe.get("shed", 0)
                        + ft.get("recovery-dropped", 0))
-                exact = fe.get("submitted", 0) == acc
-                out["packet"][name] = {
+                exact = fe.get("submitted", 0) == acc \
+                    or "crash" in ft or "crash" in fe
+                out["packet"][n.name] = {
                     "submitted": fe.get("submitted", 0),
                     "accounted": acc, "exact": exact}
                 ok = ok and exact
-        for name, w in getattr(self, "_eventplanes", {}).items():
-            if w is None:
-                continue
-            ev = w.stats()
-            exact = ev["windows-submitted"] == (
-                ev["windows-joined"] + ev["windows-dropped"])
-            out["event"][name] = {
-                "submitted": ev["windows-submitted"],
-                "joined": ev["windows-joined"],
-                "dropped": ev["windows-dropped"], "exact": exact}
-            ok = ok and exact
-        for name, tr in getattr(self, "_tracers", {}).items():
-            if tr is None:
-                continue
-            ts = tr.stats()
-            exact = ts["started"] == (ts["completed"]
-                                      + ts["dropped"])
-            out["span"][name] = {
-                "started": ts["started"],
-                "completed": ts["completed"],
-                "dropped": ts["dropped"], "exact": exact}
-            ok = ok and exact
-        for n in self.nodes:
-            ag = n.daemon.analytics.stats()
-            exact = ag["batches-submitted"] == (
-                ag["batches-ingested"] + ag["batches-dropped"])
-            out["agg"][n.name] = {
-                "submitted": ag["batches-submitted"],
-                "ingested": ag["batches-ingested"],
-                "dropped": ag["batches-dropped"], "exact": exact}
-            ok = ok and exact
+            led = n.node_ledgers() or {}
+            ev = led.get("event")
+            if ev is not None:
+                exact = ev["windows-submitted"] == (
+                    ev["windows-joined"] + ev["windows-dropped"])
+                out["event"][n.name] = {
+                    "submitted": ev["windows-submitted"],
+                    "joined": ev["windows-joined"],
+                    "dropped": ev["windows-dropped"], "exact": exact}
+                ok = ok and exact
+            ts = led.get("span")
+            if ts is not None:
+                exact = ts["started"] == (ts["completed"]
+                                          + ts["dropped"])
+                out["span"][n.name] = {
+                    "started": ts["started"],
+                    "completed": ts["completed"],
+                    "dropped": ts["dropped"], "exact": exact}
+                ok = ok and exact
+            ag = led.get("agg")
+            if ag is not None:
+                exact = ag["batches-submitted"] == (
+                    ag["batches-ingested"] + ag["batches-dropped"])
+                out["agg"][n.name] = {
+                    "submitted": ag["batches-submitted"],
+                    "ingested": ag["batches-ingested"],
+                    "dropped": ag["batches-dropped"], "exact": exact}
+                ok = ok and exact
         out["cluster"] = self.ledger()
         out["exact"] = ok and bool(out["cluster"]["exact"])
         return out
@@ -563,6 +963,7 @@ class ClusterServing:
             "per-node": self.per_node_stats(),
             "ledger": self.ledger(),
             "failovers": self.failover.snapshot(),
+            "scale-outs": list(self.scale_events),
         }
 
     def status(self) -> dict:
@@ -575,13 +976,17 @@ def start_cluster_serving(nodes: int = 3, config=None,
                           trace_sample: int = 0, packed: bool = True,
                           ring_capacity: int = 1 << 15,
                           drain_every: int = 4,
-                          node_prefix: str = "node") -> ClusterServing:
+                          node_prefix: str = "node",
+                          warm: bool = True) -> ClusterServing:
     """Build AND start a cluster serving tier in one call (the
-    ``Daemon.start_serving`` analogue one level up): N replicas, one
-    shared kvstore plane, the flow-affine router, membership, and
-    failover — ready for :meth:`ClusterServing.submit`."""
+    ``Daemon.start_serving`` analogue one level up): N replicas
+    (threads or real worker processes per ``config.cluster_mode``),
+    one shared kvstore plane, the flow-affine router, membership,
+    failover, and — when configured — the autoscaler; ready for
+    :meth:`ClusterServing.submit`."""
     c = ClusterServing(nodes=nodes, config=config,
                        node_prefix=node_prefix)
     c.start(trace_sample=trace_sample, packed=packed,
-            ring_capacity=ring_capacity, drain_every=drain_every)
+            ring_capacity=ring_capacity, drain_every=drain_every,
+            warm=warm)
     return c

@@ -5,21 +5,32 @@ Reference: upstream cilium survives node loss because connection
 state lives WITH the flow's owner and ECMP re-steers; a stateful
 serving tier must migrate that state explicitly.  This module extends
 the PR 3 demotion proof (sharded -> single CT carry via snapshot +
-restore) to NODE DEATH:
+restore) to NODE DEATH — and, since ISSUE 13, to REAL process death:
 
-1. the dead node is crash-stopped (its queued rows become counted
-   recovery drops in ITS OWN ledger — a crash loses work, it never
-   hides work);
+1. the dead node is crash-stopped.  A thread-mode replica's runtime
+   is killed in-process (queued rows become counted recovery drops in
+   ITS OWN ledger); a process-mode replica takes a real SIGKILL — no
+   goodbye, no final snapshot — and its last data-channel ACK
+   becomes its final ledger word, with the admitted-but-unresolved
+   delta counted ``crash_dropped`` on the router
+   (``ProcessNode.take_crash_loss`` ->
+   ``router.account_crash_loss``).  A crash loses work; it never
+   hides work;
 2. a designated peer is chosen (next live node in ring order — the
    same deterministic choice a rendezvous hash would make for the
-   freed slot);
+   freed slots);
 3. the dead node's latest retained CT snapshot is REPLAYED into the
-   peer, MERGED with the peer's own live CT (snapshot + concat +
-   ``ct_restore``: flow-affine routing guarantees the two tables are
-   disjoint, and the device re-hash resolves any residue) — so a
-   reply for a connection established on the dead node passes the
-   peer's egress enforcement through the CT fast path, exactly like
-   a demotion survivor;
+   peer, MERGED with the peer's own live CT
+   (``node.ct_rows_for_failover()`` -> ``peer.merge_ct(rows)``:
+   snapshot + concat + restore; flow-affine routing guarantees the
+   two tables are disjoint, and the device re-hash resolves any
+   residue) — so a reply for a connection established on the dead
+   node passes the peer's egress enforcement through the CT fast
+   path, exactly like a demotion survivor.  In process mode the
+   replay source is the PARENT-RETAINED snapshot replica
+   (``snapshot_now`` ships rows home) — the corpse's device memory
+   died with its process, the multi-host truth thread mode could
+   fake its way around (DIVERGENCES #26, retired);
 4. the router re-pins the dead node's slots and migrates its queued
    chunks; rows the peer cannot absorb are counted
    ``failover_dropped``;
@@ -27,13 +38,6 @@ restore) to NODE DEATH:
    peer (flight recorder: sysdump bundle with ledger + membership
    state), and the blackout/detect latencies land in cluster stats
    for the bench to report.
-
-In-process deployment note: when the dead node never took a snapshot
-(no periodic cadence configured), the orchestrator falls back to
-reading the dead daemon's device CT directly — possible here because
-"nodes" are threads sharing the host; a multi-host deployment gets
-that only from the replicated snapshot artifact (DIVERGENCES:
-threads-as-nodes).
 """
 
 from __future__ import annotations
@@ -41,8 +45,6 @@ from __future__ import annotations
 import threading
 import time
 from typing import List, Optional
-
-import numpy as np
 
 
 class FailoverOrchestrator:
@@ -68,19 +70,20 @@ class FailoverOrchestrator:
         t0 = time.monotonic()
         dead = c.node(dead_name)
         dead.crash("declared dead by cluster membership")
+        # a SIGKILLed worker's admitted-but-unresolved rows (last-ack
+        # delta) close the ledger as crash_dropped; thread corpses
+        # return 0 (their kill() sweeps everything counted)
+        crash_lost = c.router.account_crash_loss(
+            dead.take_crash_loss())
         peer = c.designated_peer(dead.idx)
         ct_entries = 0
         if peer is not None:
-            rows = self._dead_ct_rows(dead)
+            rows = dead.ct_rows_for_failover()
             ct_entries = int(len(rows))
             if ct_entries:
                 # merge, not replace: the peer keeps its own live
-                # flows AND inherits the dead node's.  ct_restore
-                # re-hashes the union at the peer's capacity.
-                merged = np.concatenate([
-                    peer.daemon.loader.ct_snapshot(),
-                    np.asarray(rows)])
-                peer.daemon.loader.ct_restore(merged)
+                # flows AND inherits the dead node's
+                peer.merge_ct(rows)
         moved = c.router.fail_over(dead.idx,
                                    peer.idx if peer is not None
                                    else None)
@@ -93,6 +96,7 @@ class FailoverOrchestrator:
             "ct-replayed-entries": ct_entries,
             "moved-rows": moved["moved"],
             "dropped-rows": moved["dropped"],
+            "crash-dropped-rows": crash_lost,
             "at": time.time(),
         }
         with self._lock:
@@ -102,29 +106,10 @@ class FailoverOrchestrator:
 
             # the incident lands on the PEER (the dead node's flight
             # recorder died with it); capture runs on the recorder's
-            # capture thread, never this one
-            peer.daemon.record_incident(KIND_NODE_FAILOVER, rec)
+            # capture thread (thread mode) or inside the peer worker
+            # (process mode), never this one
+            peer.record_incident(KIND_NODE_FAILOVER, rec)
         return rec
-
-    @staticmethod
-    def _dead_ct_rows(dead) -> np.ndarray:
-        # thread-affinity: api
-        """The dead node's latest retained CT snapshot; in-process
-        fallback reads the corpse's device CT directly (module doc)."""
-        snap = dead.daemon._ct_snap
-        if snap is not None:
-            return snap["rows"]
-        try:
-            return dead.daemon.loader.ct_snapshot()
-        except Exception:  # noqa: BLE001 — an unreadable corpse CT
-            # degrades to an empty replay: pre-failover connections
-            # then re-establish instead of resuming (counted by the
-            # policy plane, never silent)
-            import numpy as _np
-
-            from ..datapath.conntrack import ROW_WORDS
-
-            return _np.zeros((0, ROW_WORDS), dtype=_np.uint32)
 
     def snapshot(self) -> List[dict]:
         # thread-affinity: any

@@ -50,7 +50,7 @@ class ClusterMembership:
     :meth:`declare_dead`)."""
 
     # guarded-by: _lock: _failures, _dead, _first_fail, _latency_ms,
-    # guarded-by: _lock: _probes
+    # guarded-by: _lock: _probes, nodes
 
     def __init__(self, nodes: Sequence,
                  probe_interval_s: float,
@@ -94,6 +94,14 @@ class ClusterMembership:
             t.join(5.0)
             self._thread = None
 
+    def add_node(self, node) -> None:
+        # thread-affinity: api
+        """A scale-out replica joins the sweep (cluster/scale.py).
+        The probe loop iterates a snapshot per sweep, so appending
+        under the lock is enough."""
+        with self._lock:
+            self.nodes.append(node)
+
     # -- probing -------------------------------------------------------
     def _probe_loop(self) -> None:
         # thread-affinity: api -- the membership prober is a
@@ -107,7 +115,9 @@ class ClusterMembership:
         The ``cluster.probe`` fault site fires per probe; an injected
         fault CRASHES the probed node (deterministic node death for
         chaos tests) and the probe records the failure."""
-        for node in self.nodes:
+        with self._lock:
+            sweep = list(self.nodes)
+        for node in sweep:
             with self._lock:
                 if node.name in self._dead:
                     continue

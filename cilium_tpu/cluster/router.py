@@ -10,28 +10,43 @@ the aggregate across the cluster.  ``flow_shard_ids`` (the RSS
 analogue the sharded single-node path already uses) supplies the
 direction-invariant hash; this module adds the NODE layer on top:
 
-- a fixed SLOT space (one slot per configured node) the hash maps
-  into, and a mutable ``slot -> owner`` table so a dead node's slots
-  re-pin to its designated peer WITHOUT moving any other node's
-  flows (consistent-hashing-lite: failover migrates exactly the dead
-  node's share);
+- a fixed SLOT space (``slot_factor`` slots per initially-configured
+  node) the hash maps into, and a mutable ``slot -> owner`` table so
+  membership changes move EXACTLY the affected share
+  (consistent-hashing-lite): failover re-pins only the dead node's
+  slots, and live scale-out (ISSUE 13, ``cluster/scale.py``) steals
+  a fair share of slots for the new node WITHOUT re-hashing anyone
+  else's flows.  The slot count is a multiple of the initial node
+  count, so the initial layout (slot ``s`` -> node ``s % n``) routes
+  identically to the PR 8 direct ``hash % n`` scheme;
 - a bounded per-node FORWARD QUEUE between the router and each
   node's admission queue — the cluster-level backpressure point.
   Overflow sheds by drop-tail, counted (``router_overflow``) and
   surfaced as ``REASON_CLUSTER_OVERFLOW`` DROP events through a live
   node's monitor plane, never silently;
 - one forwarder thread per node draining its queue into
-  ``Daemon.submit`` (the "router" thread-affinity domain: the
-  enqueue path and these forwarders are the cluster tier's hot
-  path — see the CTA003 purity pass);
+  ``node.submit`` (the "router" thread-affinity domain; in
+  process-per-node mode the submit is a socket send+ack on the
+  shared transport — the forwarder then also carries the
+  ``transport`` domain).  Forward-path latency (enqueue ->
+  delivered, queue wait + transport round trip) lands in a log2
+  histogram for the bench's percentiles;
 - ``fail_over``: re-pin a dead node's slots and migrate its queued
   (and requeued in-flight) chunks onto the peer; rows the peer's
-  queue cannot absorb are counted ``failover_dropped``.
+  queue cannot absorb are counted ``failover_dropped``; rows a
+  SIGKILLed worker process admitted but never verdicted are counted
+  ``crash_dropped`` (``account_crash_loss`` — the process-mode
+  ledger's honesty term, computed from the node's last data-channel
+  ACK);
+- ``freeze`` / ``resume`` + ``wait_quiesced``: the scale-out
+  migration window — a frozen router parks submitters (bounded) while
+  the forwarders drain, so a CT snapshot taken inside the window is
+  complete for the slots about to move.
 
 The cluster-wide no-silent-loss ledger this module anchors::
 
-    submitted == sum(per-node submitted) + router_overflow
-                 + failover_dropped          (after a drained stop)
+    submitted == sum(per-node accounted) + router_overflow
+                 + failover_dropped + crash_dropped   (after stop)
 
 where each node's own ledger (``submitted == verdicts + shed +
 recovery_dropped``) accounts everything the router handed it.
@@ -40,11 +55,13 @@ recovery_dropped``) accounts everything the router handed it.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
 
 from ..serving import ServingError
+from ..serving.stats import LatencyHistogram
 
 # on_overflow(node_idx, retained rows or None, exact count): surface
 # router sheds on a (live) node's monitor/metrics plane.  Called from
@@ -56,21 +73,32 @@ OverflowFn = Callable[[int, Optional[np.ndarray], int], None]
 # every ``*_overflow`` / ``*_dropped`` increment in cluster/ to this
 # tuple AND requires a ``cilium_cluster_<name>_total`` registry
 # series per entry — a new drop site cannot ship uncounted.
-DROP_COUNTERS = ("router_overflow", "failover_dropped")
+DROP_COUNTERS = ("router_overflow", "failover_dropped",
+                 "crash_dropped")
 
 # bounded retention of shed rows for DROP-event surfacing (the count
 # is exact either way — same discipline as admission sheds)
 SHED_RETAIN = 512
+
+# slots per initially-configured node (DaemonConfig
+# cluster_slot_factor overrides): the granularity of failover re-pin
+# and scale-out share stealing
+SLOT_FACTOR = 16
+
+# a frozen router (scale-out migration window) parks submitters at
+# most this long before failing loudly — a stuck migration must not
+# wedge every caller forever
+FREEZE_DEADLINE_S = 30.0
 
 
 class ClusterRouter:
     """Flow-affine steering + bounded forwarding for N node replicas.
 
     ``nodes`` are handles with ``.name``, ``.alive`` and
-    ``.submit(rows) -> int`` (``ClusterNode`` in production; tests
-    pass fakes).  ``start()`` spawns one forwarder thread per node;
-    ``stop(drain=True)`` forwards everything still queued before
-    returning."""
+    ``.submit(rows) -> int`` (``ClusterNode`` / ``ProcessNode`` in
+    production; tests pass fakes).  ``start()`` spawns one forwarder
+    thread per node; ``stop(drain=True)`` forwards everything still
+    queued before returning."""
 
     # Lock discipline: ONE lock (the condition's) guards the whole
     # routing state — the slot table flips atomically with the queue
@@ -79,11 +107,13 @@ class ClusterRouter:
     # guarded-by: _lock: _slot_owner, _owner_arr, _chunks, _pending,
     # guarded-by: _lock: _oflow_rows, _oflow_n, _stopping, submitted,
     # guarded-by: _lock: router_overflow, failover_dropped, forwarded,
-    # guarded-by: _lock: _suspect
+    # guarded-by: _lock: _suspect, crash_dropped, _frozen, _inflight,
+    # guarded-by: _lock: forward_latency
 
     def __init__(self, nodes: Sequence, forward_depth: int,
                  on_overflow: Optional[OverflowFn] = None,
-                 shed_retain: int = SHED_RETAIN):
+                 shed_retain: int = SHED_RETAIN,
+                 slot_factor: int = SLOT_FACTOR):
         if not nodes:
             raise ValueError("cluster router needs at least one node")
         self.nodes = list(nodes)
@@ -91,29 +121,47 @@ class ClusterRouter:
         self.forward_depth = int(forward_depth)
         if self.forward_depth < 1:
             raise ValueError("forward_depth must be >= 1")
+        slot_factor = int(slot_factor)
+        if slot_factor < 1:
+            raise ValueError("slot_factor must be >= 1")
         self._on_overflow = on_overflow
         self._shed_retain = int(shed_retain)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
-        # slot s (the flow hash space) -> owning node index.  The
-        # numpy mirror serves the vectorized submit path; both flip
-        # together under the lock.
-        self._slot_owner: List[int] = list(range(self.n_nodes))
-        self._owner_arr = np.arange(self.n_nodes, dtype=np.int64)
+        # slot s (the FIXED flow hash space) -> owning node index.
+        # n_slots is a multiple of the initial node count, so the
+        # initial s % n layout routes exactly like hash % n (PR 8
+        # semantics); failover and scale-out mutate ownership only.
+        # The numpy mirror serves the vectorized submit path; both
+        # flip together under the lock.
+        self.n_slots = slot_factor * self.n_nodes
+        self._slot_owner: List[int] = [s % self.n_nodes
+                                       for s in range(self.n_slots)]
+        self._owner_arr = np.asarray(self._slot_owner, dtype=np.int64)
         self._chunks: List[list] = [[] for _ in self.nodes]
         self._pending = [0] * self.n_nodes
+        # rows a forwarder popped and is delivering right now (the
+        # quiesce condition: pending AND inflight both zero)
+        self._inflight = [0] * self.n_nodes
         # per-node shed surfacing backlog (bounded rows, exact count)
         self._oflow_rows: List[list] = [[] for _ in self.nodes]
         self._oflow_n = [0] * self.n_nodes
         # a forwarder whose submit raised parks its node as suspect
         # until failover re-pins or stop() sweeps
         self._suspect = [False] * self.n_nodes
+        self._frozen = False
         self._stopping = False
         self._threads: List[threading.Thread] = []
         self.submitted = 0
         self.router_overflow = 0
         self.failover_dropped = 0
+        # rows a crashed (SIGKILLed) worker admitted but never
+        # verdicted — see account_crash_loss
+        self.crash_dropped = 0
         self.forwarded = [0] * self.n_nodes
+        # enqueue -> delivered µs (queue wait + node submit / socket
+        # round trip): the bench's forward-path percentiles
+        self.forward_latency = LatencyHistogram()
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -121,11 +169,16 @@ class ClusterRouter:
         if self._threads:
             raise ServingError("cluster router already started")
         for i in range(self.n_nodes):
-            t = threading.Thread(target=self._forward_loop, args=(i,),
-                                 daemon=True,
-                                 name=f"cluster-fwd-{self.nodes[i].name}")
-            self._threads.append(t)
-            t.start()
+            self._spawn_forwarder(i)
+
+    def _spawn_forwarder(self, idx: int) -> None:
+        # thread-affinity: api
+        # holds: nothing — callers serialize (start / add_node)
+        t = threading.Thread(target=self._forward_loop, args=(idx,),
+                             daemon=True,
+                             name=f"cluster-fwd-{self.nodes[idx].name}")
+        self._threads.append(t)
+        t.start()
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> dict:
         # thread-affinity: api
@@ -135,6 +188,7 @@ class ClusterRouter:
         ``failover_dropped``, so the ledger closes exactly."""
         with self._cv:
             self._stopping = True
+            self._frozen = False
             self._cv.notify_all()
         for t in self._threads:
             t.join(timeout)
@@ -145,7 +199,7 @@ class ClusterRouter:
                     with self._cv:
                         if not self._chunks[idx]:
                             break
-                        chunk = self._chunks[idx].pop(0)
+                        chunk, _t_enq = self._chunks[idx].pop(0)
                         self._pending[idx] -= len(chunk)
                     node = self.nodes[idx]
                     try:
@@ -162,9 +216,13 @@ class ClusterRouter:
     # -- the enqueue path (the cluster tier's hot path) ----------------
     def submit(self, rows: np.ndarray) -> int:
         """Offer header rows; returns how many entered a forward
-        queue.  Never blocks: per-node overflow sheds drop-tail,
-        counted exactly (rows retained for DROP surfacing up to the
-        retention bound).  Chunks are COPIED in — callers may reuse
+        queue.  Never blocks in steady state: per-node overflow sheds
+        drop-tail, counted exactly (rows retained for DROP surfacing
+        up to the retention bound); the one exception is a FROZEN
+        router (a live scale-out migration window, bounded by
+        ``FREEZE_DEADLINE_S``), which parks the caller until the slot
+        table settles — blocking beats misrouting a flow whose CT is
+        mid-migration.  Chunks are COPIED in — callers may reuse
         their buffers immediately.  (Thin unannotated wrapper: the
         annotated hot path is :meth:`_route` — a generic name like
         ``submit`` must not carry the ``router`` affinity or the
@@ -184,9 +242,24 @@ class ClusterRouter:
         copies (CTA003 purity-scanned from here)."""
         from ..parallel.mesh import flow_shard_ids
 
-        ids = flow_shard_ids(rows, self.n_nodes)
+        ids = flow_shard_ids(rows, self.n_slots)
         admitted = 0
+        t_enq = time.monotonic()
         with self._cv:
+            deadline = None
+            while self._frozen and not self._stopping:
+                if deadline is None:
+                    deadline = time.monotonic() + FREEZE_DEADLINE_S
+                self._cv.wait(0.05)
+                # checked every lap, NOT only on wait timeout: a
+                # suspect node's requeue path notify_all()s each
+                # retry, and a notified wait would otherwise starve
+                # the deadline forever
+                if (self._frozen and not self._stopping
+                        and time.monotonic() > deadline):
+                    raise ServingError(
+                        "cluster router frozen past the migration "
+                        "deadline — scale-out wedged")
             if self._stopping:
                 raise ServingError("cluster router is stopped")
             self.submitted += len(rows)
@@ -197,8 +270,8 @@ class ClusterRouter:
                 space = self.forward_depth - self._pending[o]
                 take = min(max(space, 0), len(sub))
                 if take:
-                    self._chunks[o].append(np.array(sub[:take],
-                                                    copy=True))
+                    self._chunks[o].append(
+                        (np.array(sub[:take], copy=True), t_enq))
                     self._pending[o] += take
                     admitted += take
                 lost = len(sub) - take
@@ -230,24 +303,31 @@ class ClusterRouter:
                         self._suspect[idx] = False  # healed
                 if self._stopping:
                     return
-                chunk = None
+                chunk = t_enq = None
                 if self._chunks[idx]:
-                    chunk = self._chunks[idx].pop(0)
+                    chunk, t_enq = self._chunks[idx].pop(0)
                     self._pending[idx] -= len(chunk)
+                    self._inflight[idx] = len(chunk)
                 oflow_rows, oflow_n = self._take_oflow_locked(idx)
             if chunk is not None:
                 try:
                     node.submit(chunk)
                     with self._cv:
                         self.forwarded[idx] += len(chunk)
+                        self._inflight[idx] = 0
+                        self.forward_latency.record(
+                            (time.monotonic() - t_enq) * 1e6)
+                        self._cv.notify_all()
                 except Exception:  # noqa: BLE001 — crashed/terminal
                     # node: requeue AT THE FRONT and park as suspect;
                     # failover's queue migration (or stop's drain)
                     # claims the chunk with its loss accounted
                     with self._cv:
-                        self._chunks[idx].insert(0, chunk)
+                        self._chunks[idx].insert(0, (chunk, t_enq))
                         self._pending[idx] += len(chunk)
+                        self._inflight[idx] = 0
                         self._suspect[idx] = True
+                        self._cv.notify_all()
             if oflow_n and self._on_overflow is not None:
                 self._surface(idx, oflow_rows, oflow_n)
 
@@ -294,7 +374,7 @@ class ClusterRouter:
             self._owner_arr = np.asarray(self._slot_owner,
                                          dtype=np.int64)
             while self._chunks[dead_idx]:
-                chunk = self._chunks[dead_idx].pop(0)
+                chunk, t_enq = self._chunks[dead_idx].pop(0)
                 self._pending[dead_idx] -= len(chunk)
                 take = 0
                 if peer_idx is not None:
@@ -302,7 +382,8 @@ class ClusterRouter:
                              - self._pending[peer_idx])
                     take = min(max(space, 0), len(chunk))
                 if take:
-                    self._chunks[peer_idx].append(chunk[:take])
+                    self._chunks[peer_idx].append(
+                        (chunk[:take], t_enq))
                     self._pending[peer_idx] += take
                     moved += take
                 lost = len(chunk) - take
@@ -321,20 +402,132 @@ class ClusterRouter:
             self._cv.notify_all()
         return {"moved": moved, "dropped": dropped}
 
+    def account_crash_loss(self, count: int) -> int:
+        # thread-affinity: api
+        """Count rows a crashed worker process ADMITTED (acked over
+        the data channel) but never turned into verdicts — the delta
+        between the last ack's ``submitted`` and its accounted
+        counters (``cluster/process.py`` computes it; a SIGKILL
+        leaves no other witness).  Returns the count, clamped at
+        zero, so the cluster ledger closes exactly over the
+        corpse."""
+        count = max(int(count), 0)
+        if count:
+            with self._cv:
+                self.crash_dropped += count
+        return count
+
+    # -- live scale-out (cluster/scale.py drives this) -----------------
+    def freeze(self) -> None:
+        # thread-affinity: api
+        """Park new submits (bounded — see :meth:`submit`) while a
+        migration recomputes slot ownership.  Forwarders keep
+        draining, so :meth:`wait_quiesced` converges."""
+        with self._cv:
+            self._frozen = True
+
+    def resume(self) -> None:
+        # thread-affinity: api
+        with self._cv:
+            self._frozen = False
+            self._cv.notify_all()
+
+    def wait_quiesced(self, timeout: float = 30.0,
+                      nodes: Optional[Sequence[int]] = None) -> bool:
+        # thread-affinity: api
+        """Block until the given nodes' forward queues are empty AND
+        no chunk is mid-delivery — every row the router admitted has
+        been DELIVERED to its node.  Delivered is not verdicted: rows
+        may still sit in the node's own admission ring, so a caller
+        that needs CT completeness (``cluster/scale.py``) must also
+        wait for the node ledgers to catch up."""
+        idxs = (list(nodes) if nodes is not None
+                else list(range(self.n_nodes)))
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while any(self._pending[i] or self._inflight[i]
+                      for i in idxs):
+                # deadline checked every lap (a notified wait must
+                # not starve it — see _route's freeze park)
+                if time.monotonic() > deadline:
+                    return False
+                self._cv.wait(0.05)
+            return True
+
+    def add_node(self, node) -> List[int]:
+        # thread-affinity: api
+        """Grow the router by one node: extend the per-node state,
+        steal a fair share of slots (⌊n_slots / new_n⌋, taken
+        round-robin from the current owners with the most slots so
+        the layout stays balanced), flip the table atomically, and
+        spawn the new forwarder.  Returns the moved slot ids — the
+        caller (``cluster/scale.py``) migrates exactly those slots'
+        CT.  Call FROZEN + quiesced: the atomic flip keeps routing
+        correct either way, but CT continuity for moved flows needs
+        the donors drained first."""
+        with self._cv:
+            new_idx = self.n_nodes
+            self.nodes.append(node)
+            self.n_nodes += 1
+            self._chunks.append([])
+            self._pending.append(0)
+            self._inflight.append(0)
+            self._oflow_rows.append([])
+            self._oflow_n.append(0)
+            self._suspect.append(False)
+            self.forwarded.append(0)
+            share = self.n_slots // self.n_nodes
+            counts = {}
+            for owner in self._slot_owner:
+                counts[owner] = counts.get(owner, 0) + 1
+            moved: List[int] = []
+            while len(moved) < share:
+                donor = max(counts, key=lambda o: (counts[o], -o))
+                if counts[donor] <= 1:
+                    break  # never strip a node's last slot
+                for s in range(self.n_slots):
+                    if self._slot_owner[s] == donor:
+                        self._slot_owner[s] = new_idx
+                        counts[donor] -= 1
+                        moved.append(s)
+                        break
+            self._owner_arr = np.asarray(self._slot_owner,
+                                         dtype=np.int64)
+            self._cv.notify_all()
+        if self._threads:  # started router: the new node forwards too
+            self._spawn_forwarder(new_idx)
+        return moved
+
+    def slots_of(self, idx: int) -> List[int]:
+        # thread-affinity: any
+        with self._cv:
+            return [s for s, o in enumerate(self._slot_owner)
+                    if o == idx]
+
     # -- reading -------------------------------------------------------
     def pending_total(self) -> int:
         # thread-affinity: any
         with self._cv:
-            return sum(self._pending)
+            return sum(self._pending) + sum(self._inflight)
 
     def snapshot(self) -> dict:
         # thread-affinity: any
         with self._cv:
+            lat = self.forward_latency
             return {
                 "submitted": self.submitted,
                 "forwarded": list(self.forwarded),
                 "pending": list(self._pending),
                 "router-overflow": self.router_overflow,
                 "failover-dropped": self.failover_dropped,
+                "crash-dropped": self.crash_dropped,
+                "n-slots": self.n_slots,
                 "slot-owner": list(self._slot_owner),
+                "forward-latency-us": {
+                    "p50": lat.percentile(0.50),
+                    "p95": lat.percentile(0.95),
+                    "p99": lat.percentile(0.99),
+                    "max": round(lat.max_us, 1),
+                    "count": lat.count,
+                },
             }

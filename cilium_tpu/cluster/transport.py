@@ -1,0 +1,288 @@
+"""Shared cluster socket transport: framing, row-batch encoding, and
+the close discipline every socket in the repo must follow.
+
+Reference: upstream cilium's per-node agents share nothing but the
+kvstore and the wire; every cross-node byte rides a real socket.  The
+repo already proved one networked transport in production shape —
+``kvstore/remote.py`` survived the PR 8 close-vs-blocked-syscall
+hardening (a killed server must actually die; an idle client must see
+EOF) — and the process-per-node serving tier (ISSUE 13) needs a
+second: the flow-affine router forwarding packed ``[n, 4]`` u32 row
+batches into per-node worker processes.  This module lifts the shared
+pieces out so BOTH transports run one implementation:
+
+- :func:`shutdown_close` — shutdown-before-close (PR 8's fix, one
+  definition): POSIX ``close()`` neither wakes a thread blocked in
+  ``recv()``/``accept()`` on the same fd nor sends FIN while the fd
+  is pinned in that syscall; ``shutdown()`` delivers both halves
+  immediately.  Used by the kvstore server/client AND the cluster
+  node channels.
+- :class:`LineFramer` — the kvstore's newline-delimited JSON framing
+  (partial-read reassembly) as a reusable buffer, consumed by both
+  ``kvstore/remote.py`` read loops.
+- length-prefixed binary frames (:func:`send_frame` /
+  :func:`recv_frame`) — the row-batch wire: a 4-byte big-endian
+  length then the payload.  ``recv_frame`` reassembles partial reads,
+  returns ``None`` on a clean EOF at a frame boundary, and raises
+  :class:`FrameError` on a torn prefix, a torn body, or a length
+  past ``max_frame`` (a corrupted/hostile peer must not make the
+  receiver allocate unbounded memory).
+- row-batch encode/decode (:func:`encode_rows` / :func:`decode_rows`)
+  — wide ``[n, N_COLS]`` u32 header rows or packed ``[n, 4]`` u32
+  rows (with their ``(ep, dirn)`` stream scalars) in one frame, and
+  the fixed-size binary ACK (:func:`pack_ack` / :func:`unpack_ack`)
+  carrying the receiving node's running packet ledger — the piece
+  that lets the cluster ledger close EXACTLY over a SIGKILLed
+  worker (``cluster/process.py``).
+
+THREAD AFFINITY: the ``transport`` domain (CTA002 vocabulary, a
+CTA003 hot domain like ``drain``/``router``) covers the threads that
+move frames: the router's per-node forwarders while inside a
+send/recv, and the node host's data-channel reader.  Functions here
+are the domain's leaf surface — pure byte movement, no logging, no
+file I/O, no device work.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "FrameError", "LineFramer", "shutdown_close",
+    "send_frame", "recv_frame", "send_json_frame", "recv_json_frame",
+    "encode_rows", "decode_rows", "pack_ack", "unpack_ack",
+    "rows_to_b64", "rows_from_b64",
+    "MAX_FRAME", "ACK_SIZE",
+]
+
+# frame length prefix: 4-byte big-endian unsigned
+_LEN = struct.Struct(">I")
+
+# default per-frame byte ceiling: comfortably above the largest row
+# batch the serving tier ships (a 2^15-row wide chunk is 2 MiB) while
+# bounding what a torn/hostile prefix can make the receiver allocate
+MAX_FRAME = 1 << 24
+
+# ACK: admitted u32, then the node's running packet-ledger counters
+# (submitted, verdicts, shed, recovery_dropped) as u64 — see
+# module doc and cluster/process.py
+_ACK = struct.Struct(">IQQQQ")
+ACK_SIZE = _ACK.size
+
+# row-frame payload kinds
+_ROWS_WIDE = 1  # [n, cols] u32 header rows
+_ROWS_PACKED = 2  # [n, 4] u32 packed rows + (ep, dirn) stream scalars
+_ROWS_HDR = struct.Struct(">BIIII")  # kind, n, cols, ep, dirn
+
+
+class FrameError(Exception):
+    """Torn or oversized frame: the connection is unusable (the
+    length stream lost sync) — callers close it."""
+
+
+def shutdown_close(sock: Optional[socket.socket]) -> None:
+    # thread-affinity: any
+    """Close ``sock`` with shutdown-before-close (the PR 8 fix, one
+    definition): a peer's reader blocked in ``recv()`` — or our own
+    reader/acceptor pinned in the syscall — sees EOF immediately
+    instead of hanging on a silently-dead fd."""
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class LineFramer:
+    """Newline-delimited framing with partial-read reassembly (the
+    kvstore wire).  ``feed(data)`` returns the complete lines the
+    bytes finish; the tail stays buffered for the next read."""
+
+    __slots__ = ("_buf",)
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    def feed(self, data: bytes) -> List[bytes]:
+        # thread-affinity: transport, any -- kvstore reader threads
+        # and the cluster channels share this buffer type; each
+        # instance is single-reader by construction
+        self._buf += data
+        if b"\n" not in self._buf:
+            return []
+        *lines, self._buf = self._buf.split(b"\n")
+        return [ln for ln in lines if ln.strip()]
+
+    @property
+    def pending(self) -> int:
+        return len(self._buf)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    # thread-affinity: transport, any
+    """Read exactly ``n`` bytes reassembling partial reads.  Returns
+    ``None`` on EOF before the FIRST byte (clean close); raises
+    :class:`FrameError` on EOF mid-buffer (a torn frame)."""
+    chunks = []
+    got = 0
+    while got < n:
+        data = sock.recv(min(n - got, 1 << 16))
+        if not data:
+            if got == 0:
+                return None
+            raise FrameError(
+                f"connection closed mid-frame ({got}/{n} bytes)")
+        chunks.append(data)
+        got += len(data)
+    return b"".join(chunks)
+
+
+def send_frame(sock: socket.socket, payload: bytes) -> None:
+    # thread-affinity: transport, any
+    """One length-prefixed frame.  A single ``sendall`` so two
+    senders interleaving frames need only their own serialization."""
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket,
+               max_frame: int = MAX_FRAME) -> Optional[bytes]:
+    # thread-affinity: transport, any
+    """One frame: ``None`` on clean EOF at a frame boundary,
+    :class:`FrameError` on a torn prefix/body or a declared length
+    past ``max_frame``."""
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (length,) = _LEN.unpack(hdr)
+    if length > max_frame:
+        raise FrameError(
+            f"frame of {length} bytes exceeds max_frame {max_frame}")
+    if length == 0:
+        return b""
+    body = _recv_exact(sock, length)
+    if body is None:
+        raise FrameError("connection closed between prefix and body")
+    return body
+
+
+def send_json_frame(sock: socket.socket, obj: dict) -> None:
+    # thread-affinity: any -- control channels only (any caller
+    # holding the per-conn serialization lock); the hot row path
+    # rides the binary encoders below
+    # hot-path-ok: control-channel serialization, never a row frame
+    send_frame(sock, json.dumps(obj).encode())
+
+
+def recv_json_frame(sock: socket.socket,
+                    max_frame: int = MAX_FRAME) -> Optional[dict]:
+    # thread-affinity: any
+    payload = recv_frame(sock, max_frame)
+    if payload is None:
+        return None
+    try:
+        return json.loads(payload)
+    except ValueError as e:
+        raise FrameError(f"control frame is not JSON: {e}") from None
+
+
+# -- row batches -------------------------------------------------------
+def encode_rows(rows: np.ndarray,
+                packed_meta: Optional[Tuple[int, int]] = None) -> bytes:
+    # thread-affinity: transport, router
+    """Row batch -> frame payload.  ``packed_meta=(ep, dirn)`` marks
+    ``rows`` as packed ``[n, 4]`` u32 (the 16 B/packet wire format —
+    the stream scalars ride the header); otherwise wide
+    ``[n, cols]`` u32."""
+    rows = np.ascontiguousarray(rows, dtype=np.uint32)
+    if rows.ndim != 2:
+        raise ValueError(f"rows must be 2-D, got shape {rows.shape}")
+    if packed_meta is not None:
+        ep, dirn = packed_meta
+        kind = _ROWS_PACKED
+    else:
+        ep = dirn = 0
+        kind = _ROWS_WIDE
+    hdr = _ROWS_HDR.pack(kind, rows.shape[0], rows.shape[1],
+                         int(ep), int(dirn))
+    return hdr + rows.tobytes()
+
+
+def decode_rows(payload: bytes
+                ) -> Tuple[np.ndarray, Optional[Tuple[int, int]]]:
+    # thread-affinity: transport, any
+    """Frame payload -> (rows, packed_meta or None).  Raises
+    :class:`FrameError` when the declared shape disagrees with the
+    byte count (a torn or corrupted frame must not become a
+    misshapen submit)."""
+    if len(payload) < _ROWS_HDR.size:
+        raise FrameError(
+            f"row frame of {len(payload)} bytes is shorter than its "
+            f"header ({_ROWS_HDR.size})")
+    kind, n, cols, ep, dirn = _ROWS_HDR.unpack_from(payload)
+    if kind not in (_ROWS_WIDE, _ROWS_PACKED):
+        raise FrameError(f"unknown row-frame kind {kind}")
+    want = n * cols * 4
+    body = payload[_ROWS_HDR.size:]
+    if len(body) != want:
+        raise FrameError(
+            f"row frame declares [{n}, {cols}] u32 ({want} bytes) "
+            f"but carries {len(body)}")
+    rows = np.frombuffer(body, dtype=np.uint32).reshape(n, cols)
+    if kind == _ROWS_PACKED:
+        if cols != 4:
+            raise FrameError(
+                f"packed row frame must be [n, 4], got [{n}, {cols}]")
+        return rows, (ep, dirn)
+    return rows, None
+
+
+# -- control-channel row encoding (CT snapshots/merges) ----------------
+# One codec for BOTH ends of the control wire (parent process.py,
+# worker nodehost.py): u32 rows as base64 + shape.  JSON-embedded by
+# design — CT migration is control-plane work, not the row hot path.
+def rows_to_b64(rows: np.ndarray) -> dict:
+    # thread-affinity: any
+    import base64
+
+    rows = np.ascontiguousarray(rows, dtype=np.uint32)
+    return {"b64": base64.b64encode(rows.tobytes()).decode("ascii"),
+            "shape": list(rows.shape)}
+
+
+def rows_from_b64(obj: dict) -> np.ndarray:
+    # thread-affinity: any
+    import base64
+
+    raw = base64.b64decode(obj["b64"].encode("ascii"))
+    return np.frombuffer(raw, dtype=np.uint32).reshape(obj["shape"])
+
+
+# -- the data-channel ACK ----------------------------------------------
+def pack_ack(admitted: int, submitted: int, verdicts: int,
+             shed: int, recovery_dropped: int) -> bytes:
+    # thread-affinity: transport
+    """ACK for one row frame: how many rows the node ADMITTED, plus
+    its running packet-ledger counters as of the ack.  The parent
+    retains the newest ack per node; a SIGKILLed worker's final word
+    is its last ack, which is exactly what lets the cluster ledger
+    close over the corpse (``cluster/process.py``)."""
+    return _ACK.pack(int(admitted), int(submitted), int(verdicts),
+                     int(shed), int(recovery_dropped))
+
+
+def unpack_ack(payload: bytes) -> Tuple[int, int, int, int, int]:
+    # thread-affinity: transport, router
+    if len(payload) != _ACK.size:
+        raise FrameError(
+            f"ack frame is {len(payload)} bytes, want {_ACK.size}")
+    return _ACK.unpack(payload)

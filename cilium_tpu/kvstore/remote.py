@@ -36,6 +36,11 @@ import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+# the framing + close discipline is shared with the cluster serving
+# transport (cluster/transport.py, ISSUE 13): one LineFramer for
+# newline-delimited JSON reassembly, one shutdown-before-close
+# definition (the PR 8 close-vs-blocked-syscall fix) for every socket
+from ..cluster.transport import LineFramer, shutdown_close
 from .store import InMemoryKVStore, KVEvent, Watcher
 
 __all__ = ["KVStoreServer", "RemoteKVStore"]
@@ -90,17 +95,14 @@ class _Conn:
                 return
 
     def _read_loop(self) -> None:
-        buf = b""
+        framer = LineFramer()
         try:
             while True:
                 data = self.sock.recv(1 << 16)
                 if not data:
                     break
-                buf += data
-                while b"\n" in buf:
-                    line, buf = buf.split(b"\n", 1)
-                    if line.strip():
-                        self._handle(json.loads(line))
+                for line in framer.feed(data):
+                    self._handle(json.loads(line))
         except (OSError, ValueError):
             pass
         finally:
@@ -189,21 +191,15 @@ class _Conn:
         for cancel in self._watches.values():
             cancel()
         self._watches.clear()
-        # shutdown BEFORE close: this conn's reader thread is blocked
-        # in recv() on the same fd, and POSIX close() neither wakes it
-        # nor sends FIN while the fd is pinned in that syscall — so a
+        # shutdown BEFORE close (transport.shutdown_close, the one
+        # definition): this conn's reader thread is blocked in recv()
+        # on the same fd, and POSIX close() neither wakes it nor
+        # sends FIN while the fd is pinned in that syscall — so a
         # killed server's clients would never see EOF, and their
         # watches would stay silently dead until their next RPC (an
         # idle watch-only replica missing every event across a
         # failover).  shutdown() delivers both halves immediately.
-        try:
-            self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        shutdown_close(self.sock)
         self.server._conns.discard(self)
 
 
@@ -276,14 +272,7 @@ class KVStoreServer:
         # reconnect to the corpse (and re-subscribe its watches onto
         # a store nobody mutates any more).  shutdown() fails the
         # blocked accept immediately.
-        try:
-            self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        shutdown_close(self._sock)
         for c in list(self._conns):
             c.close()
         if self.address[0] == "unix" and os.path.exists(self.address[1]):
@@ -370,7 +359,7 @@ class RemoteKVStore:
             f"kvstore server unreachable at {self._addresses}: {last}")
 
     def _read_loop(self) -> None:
-        buf = b""
+        framer = LineFramer()
         while not self._closed:
             sock = self._sock
             if sock is None:
@@ -384,13 +373,9 @@ class RemoteKVStore:
                 if self._closed:
                     return
                 self._on_disconnect()
-                buf = b""
+                framer = LineFramer()
                 continue
-            buf += data
-            while b"\n" in buf:
-                line, buf = buf.split(b"\n", 1)
-                if not line.strip():
-                    continue
+            for line in framer.feed(data):
                 msg = json.loads(line)
                 if "w" in msg and "i" not in msg:
                     self._dispatch_watch(msg)
@@ -585,19 +570,10 @@ class RemoteKVStore:
         self._closed = True
         self._connected.set()
         self._events.put(None)
-        try:
-            if self._sock is not None:
-                # same shutdown-before-close as _Conn.close: the
-                # reader thread is blocked in recv() on this fd and
-                # plain close() would leave it wedged forever
-                self._sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            if self._sock is not None:
-                self._sock.close()
-        except OSError:
-            pass
+        # same shutdown-before-close as _Conn.close: the reader
+        # thread is blocked in recv() on this fd and plain close()
+        # would leave it wedged forever
+        shutdown_close(self._sock)
 
 
 def main() -> None:

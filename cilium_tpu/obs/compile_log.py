@@ -116,3 +116,17 @@ class CompileLog:
                 "executables": len(self.executables),
                 "violations": self.violations,
             }
+
+    def dispatch_summary(self) -> dict:
+        # thread-affinity: any
+        """Dispatch-executable compiles only (the event plane's
+        "gather" rung ladder excluded) + violations — the cluster
+        tier's zero-survivor-recompile oracle, shared by the
+        in-process node handle and the worker-side RPC op so the
+        two modes can never skew against each other."""
+        with self._lock:
+            dispatch = sum(c for (m, _s), c
+                           in self.executables.items()
+                           if m != "gather")
+            return {"dispatch_compiles": int(dispatch),
+                    "violations": int(self.violations)}

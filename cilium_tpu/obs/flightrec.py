@@ -55,6 +55,10 @@ KIND_EVENTWORKER = "eventworker-terminal"
 # designated peer (CT snapshot replayed, router re-pinned); recorded
 # on the PEER — the dead node's recorder died with it
 KIND_NODE_FAILOVER = "node-failover"
+# a live scale-out completed: a fresh replica joined the serving
+# cluster, a slot share re-pinned to it, and the moved slots' CT
+# migrated (cluster/scale.py); recorded on the NEW node
+KIND_NODE_SCALEOUT = "node-scaleout"
 # the map-pressure monitor (datapath/pressure.py) crossed a
 # threshold — CT occupancy, insert-drop rate, or NAT pool failures —
 # and entered the pressure state (one incident per episode; the

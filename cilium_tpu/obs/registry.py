@@ -318,9 +318,24 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                 "packets lost migrating a dead node's forward queue "
                 "onto its failover peer",
                 lambda: cl(lambda c: c.failover_dropped_total()))
+    reg.counter("cilium_cluster_crash_dropped_total",
+                "rows a SIGKILLed worker process admitted (per its "
+                "last data-channel ack) but never resolved — the "
+                "process-mode crash-loss ledger term",
+                lambda: cl(lambda c: c.crash_dropped_total()))
     reg.counter("cilium_cluster_failovers_total",
                 "completed node failovers (CT replay + router re-pin)",
                 lambda: cl(lambda c: c.failovers_total()))
+    reg.counter("cilium_cluster_scale_outs_total",
+                "completed live scale-outs (node joined, slot share "
+                "re-pinned, moved slots' CT migrated)",
+                lambda: cl(lambda c: len(c.scale_events)))
+    reg.histogram("cilium_cluster_forward_latency_us",
+                  "router enqueue -> node delivered (queue wait + "
+                  "transport round trip, µs, log2 buckets)",
+                  lambda: cl(lambda c: (c.router.forward_latency
+                                        if c.router is not None
+                                        else None)))
     reg.gauge("cilium_cluster_nodes",
               "cluster node replicas by liveness",
               lambda: cl(lambda c: [

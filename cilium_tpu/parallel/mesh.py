@@ -54,6 +54,30 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = "data") -> Mesh:
     return Mesh(np.asarray(devs), (axis,))
 
 
+def _flow_hash_mix(src: np.ndarray, dst: np.ndarray,
+                   sport: np.ndarray, dport: np.ndarray,
+                   proto: np.ndarray, n_shards: int) -> np.ndarray:
+    """The ONE symmetric flow-hash definition (uint64 inputs).
+
+    Commutative combines of src/dst words and ports, so forward and
+    reply orientations hash identically — shared by the header path
+    (:func:`flow_shard_ids`) and the CT-snapshot path
+    (:func:`ct_rows_slot_ids`): a CT row MUST land on the same slot
+    as the packets that created it, or scale-out migration
+    (cluster/scale.py) would ship the wrong entries."""
+    h = np.zeros(len(proto), dtype=np.uint64)
+    for w in range(4):
+        h = h * 31 + (src[:, w] + dst[:, w])
+        h ^= (src[:, w] ^ dst[:, w]) * np.uint64(0x9E3779B97F4A7C15)
+    h += (sport + dport) * np.uint64(0x85EBCA6B)
+    h ^= (sport ^ dport) * np.uint64(0xC2B2AE35)
+    h += proto
+    h ^= h >> 33
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> 33
+    return (h % np.uint64(n_shards)).astype(np.int64)
+
+
 def flow_shard_ids(data: np.ndarray, n_shards: int) -> np.ndarray:
     """Symmetric flow hash -> shard id per packet (host numpy).
 
@@ -68,17 +92,34 @@ def flow_shard_ids(data: np.ndarray, n_shards: int) -> np.ndarray:
     # packets would land on a shard that doesn't own its CT entry
     sport, dport = normalize_ports(np, d[:, COL_PROTO], d[:, COL_SPORT],
                                    d[:, COL_DPORT])
-    h = np.zeros(len(d), dtype=np.uint64)
-    for w in range(4):
-        h = h * 31 + (src[:, w] + dst[:, w])
-        h ^= (src[:, w] ^ dst[:, w]) * np.uint64(0x9E3779B97F4A7C15)
-    h += (sport + dport) * np.uint64(0x85EBCA6B)
-    h ^= (sport ^ dport) * np.uint64(0xC2B2AE35)
-    h += d[:, COL_PROTO]
-    h ^= h >> 33
-    h *= np.uint64(0xFF51AFD7ED558CCD)
-    h ^= h >> 33
-    return (h % np.uint64(n_shards)).astype(np.int64)
+    return _flow_hash_mix(src, dst, sport, dport, d[:, COL_PROTO],
+                          n_shards)
+
+
+def ct_rows_slot_ids(rows: np.ndarray, n_shards: int) -> np.ndarray:
+    """Dense CT snapshot rows ([n, ROW_WORDS], conntrack layout) ->
+    the same flow slot :func:`flow_shard_ids` assigns the flow's
+    packets.
+
+    The CT key already carries NORMALIZED ports (word 8 =
+    sport << 16 | dport after ``normalize_ports``) and the proto in
+    word 9's low byte, and the hash mix is commutative in both the
+    address pair and the port pair — so hashing straight from the
+    key words reproduces the header-side slot regardless of which
+    direction created the entry.  This is scale-out migration's
+    selector: exactly the moved slots' entries ship to the new
+    owner."""
+    d = np.asarray(rows).astype(np.uint64)
+    if d.ndim != 2 or d.shape[1] < 10:
+        raise ValueError(
+            f"want dense CT rows [n, ROW_WORDS], got {d.shape}")
+    src = d[:, 0:4]
+    dst = d[:, 4:8]
+    ports = d[:, 8]
+    sport = ports >> np.uint64(16)
+    dport = ports & np.uint64(0xFFFF)
+    proto = d[:, 9] & np.uint64(0xFF)
+    return _flow_hash_mix(src, dst, sport, dport, proto, n_shards)
 
 
 def route_by_flow(data: np.ndarray, n_shards: int,

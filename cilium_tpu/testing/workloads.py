@@ -690,6 +690,38 @@ def make_scenario(name: str, seed: int = 0, **kw):
     return cls(seed=seed, **kw)
 
 
+def scenario_cluster(scenario, nodes: int = 2, mode: str = "thread",
+                     serving_kwargs: Optional[dict] = None,
+                     **overrides):
+    """Build a ``ClusterServing`` shaped for ``scenario`` (its
+    ``daemon_overrides`` under the caller's ``overrides``), run the
+    scenario's ``setup`` against it (endpoints + policy fan out and
+    CONVERGE over the kvstore), then start serving — the cluster
+    analogue of :func:`scenario_daemon`, and the construction the
+    soak gate's cluster leg and tests share.  Returns ``(cluster,
+    ctx)`` for ``run_scenario(cluster, scenario, ctx=ctx)``; the
+    caller owns ``shutdown()`` (``run_scenario`` stops it)."""
+    from ..agent.daemon import DaemonConfig
+    from ..cluster import ClusterServing
+
+    cfg = dict(backend="tpu", flow_ring_capacity=1 << 13,
+               cluster_mode=mode)
+    cfg.update(scenario.daemon_overrides)
+    cfg.update(overrides)
+    c = ClusterServing(nodes=nodes, config=DaemonConfig(**cfg))
+    try:
+        ctx = scenario.setup(c)
+        assert c.wait_policy(timeout=15), \
+            f"{scenario.name} policy never converged cluster-wide"
+        kw = dict(ring_capacity=1 << 13, trace_sample=0, packed=True)
+        kw.update(serving_kwargs or {})
+        c.start(**kw)
+    except BaseException:
+        c.shutdown()
+        raise
+    return c, ctx
+
+
 def scenario_daemon(scenario, **overrides):
     """Build a Daemon shaped for ``scenario`` (its
     ``daemon_overrides`` under the caller's ``overrides``) — the one
@@ -749,7 +781,17 @@ def run_scenario(daemon, scenario, *, ctx: Optional[dict] = None,
     ``verdicts`` / ``shed`` / ``shed_frac`` / ``sustained_pps`` /
     ``p99_us`` / ``ledger_exact`` / ``ct_insert_drops`` /
     ``nat_failures`` / ``drop_frac`` and ``checks`` maps each
-    declared criterion to its verdict."""
+    declared criterion to its verdict.
+
+    ``daemon`` may also be a STARTED ``ClusterServing`` (thread or
+    process mode — ISSUE 13 satellite): serving-path scenarios then
+    drive the cluster front end (``submit`` -> flow-affine router ->
+    node replicas), the ledger criterion becomes the CLUSTER-WIDE
+    ledger, and pressure counters sum over the replicas.  The driver
+    STOPS the cluster at the end (the ledger is exact only closed);
+    the caller keeps shutdown."""
+    if _is_cluster(daemon):
+        return _run_scenario_cluster(daemon, scenario, ctx=ctx)
     if ctx is None:
         ctx = scenario.setup(daemon)
     ep = ctx.get("ep", 0)
@@ -851,6 +893,141 @@ def run_scenario(daemon, scenario, *, ctx: Optional[dict] = None,
             int(r): int(n) for r, n in enumerate(reason_delta)
             if r and n},
         "elapsed_s": round(dt, 3),
+    }
+    checks = evaluate_criteria(scenario.criteria, metrics)
+    return {
+        "name": scenario.name,
+        "seed": scenario.seed,
+        "criteria": dict(scenario.criteria),
+        "metrics": metrics,
+        "checks": checks,
+        "passed": all(checks.values()),
+    }
+
+
+def _is_cluster(target) -> bool:
+    """Duck-typed ClusterServing detection (no cluster import on the
+    workloads module path): the tier facade is the only target with
+    a router + cluster-wide ledger."""
+    return hasattr(target, "router") and hasattr(target, "ledgers")
+
+
+def _run_scenario_cluster(cluster, scenario, *,
+                          ctx: Optional[dict] = None,
+                          pending_cap: int = 1 << 13) -> dict:
+    """The cluster leg of :func:`run_scenario`: serving-path
+    scenarios against a STARTED ``ClusterServing`` (thread or
+    process mode).  Offline-path scenarios (nat_exhaustion rides
+    process_batch) have no cluster analogue and are rejected
+    loudly."""
+    if scenario.path != "serving":
+        raise ValueError(
+            f"scenario {scenario.name!r} runs the offline path; the "
+            f"cluster leg only drives serving-path scenarios")
+    if cluster.router is None:
+        raise ValueError(
+            "run_scenario(cluster, ...) wants a STARTED cluster "
+            "(start_cluster_serving)")
+    if ctx is None:
+        ctx = scenario.setup(cluster)
+        assert cluster.wait_policy(), \
+            f"{scenario.name} policy never converged cluster-wide"
+    ep = ctx.get("ep", 0)
+
+    def pressures():
+        out = []
+        for n in cluster.nodes:
+            if not n.alive:
+                continue
+            p = n.map_pressure()
+            if p is not None:
+                out.append(p)
+        return out
+
+    def metric_sums():
+        tot = None
+        for n in cluster.nodes:
+            if not n.alive:
+                continue
+            m = n.metrics()
+            if m is None:
+                continue
+            m = np.asarray(m, dtype=np.int64).sum(axis=1)
+            tot = m if tot is None else tot + m
+        return tot if tot is not None else np.zeros(1, np.int64)
+
+    p0 = pressures()
+    m0 = metric_sums()
+    t0 = time.perf_counter()
+    for b in scenario.iter_batches(ep):
+        cluster.submit(b)
+        # backpressure at the ROUTER: bounded forward queues are the
+        # cluster-level admission point
+        while cluster.forward_pending() > pending_cap:
+            time.sleep(0.001)
+    st = cluster.stop()
+    dt = max(time.perf_counter() - t0, 1e-9)
+    led = st["ledger"]
+    submitted = led["submitted"]
+    verdicts = shed = 0
+    p99 = None
+    for node_st in st["per-node"].values():
+        fe = node_st.get("front-end") or {}
+        verdicts += fe.get("verdicts", 0)
+        shed += fe.get("shed", 0)
+        node_p99 = (fe.get("latency-us") or {}).get("p99")
+        if node_p99 is not None:
+            # percentiles don't merge exactly across nodes; the MAX
+            # is the conservative cluster-wide read (the true p99 is
+            # never worse than the worst node's)
+            p99 = node_p99 if p99 is None else max(p99, node_p99)
+    shed_all = (shed + led["router-overflow"]
+                + led["failover-dropped"] + led["crash-dropped"])
+    p1 = pressures()
+    m1 = metric_sums()
+    reason_delta = (m1 - m0) if len(m1) == len(m0) else m1
+
+    def psum(ps, *keys):
+        tot = 0
+        for p in ps:
+            v = p
+            for k in keys:
+                v = (v or {}).get(k, 0)
+            tot += int(v or 0)
+        return tot
+
+    dropped = int(reason_delta[1:].sum()) if len(reason_delta) > 1 \
+        else 0
+    metrics = {
+        "submitted": int(submitted),
+        "verdicts": int(verdicts),
+        "shed_frac": round(shed_all / submitted, 4) if submitted
+        else 0.0,
+        "sustained_pps": round(verdicts / dt, 1),
+        "p99_us": p99,
+        "ledger_exact": bool(led["exact"]),
+        "ops_applied": 0,  # op streams are node-local control-plane
+        # work; cluster legs drive traffic only
+        "ct_insert_drops": (psum(p1, "ct", "insert-drops")
+                            - psum(p0, "ct", "insert-drops")),
+        "ct_occupancy": max(
+            (float((p.get("ct") or {}).get("occupancy") or 0.0)
+             for p in p1), default=0.0),
+        "nat_failures": (psum(p1, "nat", "failures")
+                         - psum(p0, "nat", "failures")),
+        "drop_frac": (round(dropped / submitted, 4)
+                      if submitted else None),
+        "drops_by_reason": {
+            int(r): int(n) for r, n in enumerate(reason_delta)
+            if r and n},
+        "elapsed_s": round(dt, 3),
+        "cluster": {
+            "mode": cluster.mode,
+            "nodes": len(cluster.nodes),
+            "router_overflow": led["router-overflow"],
+            "failover_dropped": led["failover-dropped"],
+            "crash_dropped": led["crash-dropped"],
+        },
     }
     checks = evaluate_criteria(scenario.criteria, metrics)
     return {

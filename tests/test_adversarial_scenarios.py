@@ -19,6 +19,7 @@ from cilium_tpu.testing.workloads import (SCENARIOS, Scenario,
                                           evaluate_criteria,
                                           make_scenario,
                                           run_scenario,
+                                          scenario_cluster,
                                           scenario_daemon)
 
 
@@ -302,6 +303,66 @@ class TestRunScenarioDriver:
                 f"elephant missing from top-K: {sorted(top_sports)}")
         finally:
             d.shutdown()
+
+    def test_cluster_leg_elephant_mice_thread_mode(self):
+        """ISSUE 13 satellite: run_scenario drives a STARTED
+        ClusterServing — the batch stream rides submit() -> the
+        flow-affine router -> the replicas, the ledger criterion is
+        the CLUSTER-WIDE ledger, and pressure counters sum over
+        nodes."""
+        sc = make_scenario("elephant_mice", seed=31, n_flows=128,
+                           n_packets=2048, batch=256)
+        c, ctx = scenario_cluster(sc, nodes=2,
+                                  map_pressure_interval=0.0)
+        try:
+            r = run_scenario(c, sc, ctx=ctx)
+            assert r["passed"], r["checks"]
+            m = r["metrics"]
+            assert m["ledger_exact"]
+            assert m["cluster"]["nodes"] == 2
+            assert m["cluster"]["mode"] == "thread"
+            assert m["verdicts"] > 0
+            # both replicas actually served a share
+            verdicts = [
+                (st["front-end"] or {}).get("verdicts", 0)
+                for st in c.per_node_stats().values()]
+            assert all(v > 0 for v in verdicts), verdicts
+        finally:
+            c.shutdown()
+
+    def test_cluster_leg_syn_flood_pressures_nodes(self):
+        """syn_flood against the cluster: the flood splits across
+        replicas by the flow-affine hash and pressures the per-node
+        CT maps (summed insert-drop delta > 0), ledger exact."""
+        sc = make_scenario("syn_flood", seed=37, n_flows=4096,
+                           batch=256)
+        c, ctx = scenario_cluster(
+            sc, nodes=2,
+            ct_capacity=1 << 10,  # per-node map the flood outsizes
+            map_pressure_interval=0.2)
+        try:
+            r = run_scenario(c, sc, ctx=ctx)
+            assert r["passed"], r["checks"]
+            m = r["metrics"]
+            assert m["ledger_exact"]
+            assert m["ct_insert_drops"] > 0, m
+            assert m["ct_occupancy"] >= 0.9, m
+        finally:
+            c.shutdown()
+
+    def test_cluster_leg_rejects_offline_path(self):
+        sc = make_scenario("nat_exhaustion", seed=5)
+        c, ctx = None, None
+        from cilium_tpu.agent import DaemonConfig
+        from cilium_tpu.cluster import ClusterServing
+
+        c = ClusterServing(nodes=1, config=DaemonConfig(
+            backend="tpu", serving_bucket_ladder=(64,)))
+        try:
+            with pytest.raises(ValueError, match="offline"):
+                run_scenario(c, sc)
+        finally:
+            c.shutdown()
 
     def test_endpoint_churn_under_serving(self):
         sc = make_scenario("endpoint_churn", seed=17, n_slots=4,

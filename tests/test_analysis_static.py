@@ -373,6 +373,35 @@ class TestHotPath:
         assert "cluster router hot path" in fs[0].message
         assert "time.sleep" in fs[0].message
 
+    def test_transport_domain_is_a_hot_path_root(self, tmp_path):
+        """ISSUE 13 satellite: the cluster transport I/O threads
+        (row-frame send/recv on the forwarders and the node host's
+        data reader) are a CTA003 hot domain — transport-affine code
+        is purity-scanned and named as such."""
+        repo = _mini_repo(tmp_path, {"m.py": """
+            import json
+
+            def data_loop():
+                # thread-affinity: transport
+                return json.dumps({"a": 1})
+
+            def control_op():
+                # thread-affinity: api
+                return json.dumps({"b": 2})
+        """})
+        fs = hotpath.check(repo, CallGraph(repo))
+        assert len(fs) == 1
+        assert "cluster transport I/O" in fs[0].message
+        assert "json.dumps" in fs[0].message
+        # and the live repo's data-loop annotation is load-bearing
+        from cilium_tpu.analysis.affinity import affinity_map
+
+        full = Repo(REPO)
+        am = affinity_map(CallGraph(full))
+        assert "transport" in am[
+            ("cilium_tpu/cluster/nodehost.py",
+             "_NodeHost._data_loop")]
+
     def test_router_reaching_drain_only_code_flags_cta002(self,
                                                           tmp_path):
         repo = _mini_repo(tmp_path, {"m.py": """
@@ -650,16 +679,28 @@ class TestFoldedCheckers:
 
         good = {k: 1 for k in cluster_lint.BENCH_CLUSTER_KEYS}
         good["schema"] = cluster_lint.BENCH_SCHEMA
+        # v2: per-mode curves are schema-checked too
+        good["modes"] = {
+            m: {k: 1 for k in cluster_lint.BENCH_MODE_KEYS}
+            for m in ("thread", "process")}
         p = tmp_path / "BENCH_cluster.json"
         p.write_text(json.dumps(good))
         assert cluster_lint.check_bench(str(p)) == []
         bad = dict(good)
         del bad["failover_blackout_ms"]
         bad["schema"] = "nope"
+        bad["modes"] = {"thread": good["modes"]["thread"]}
         p.write_text(json.dumps(bad))
         problems = cluster_lint.check_bench(str(p))
         assert any("schema" in b for b in problems)
         assert any("failover_blackout_ms" in b for b in problems)
+        assert any("modes" in b for b in problems)
+        bad["modes"] = {
+            "thread": good["modes"]["thread"],
+            "process": {"scaling_n3": 1}}
+        p.write_text(json.dumps(bad))
+        problems = cluster_lint.check_bench(str(p))
+        assert any("scaling_n2_pairs" in b for b in problems)
         p.write_text("{not json")
         assert any("JSON" in b
                    for b in cluster_lint.check_bench(str(p)))

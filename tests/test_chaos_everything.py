@@ -62,12 +62,10 @@ def _run_everything(tmp_path, duration_s: float, nodes: int = 2,
     c = ClusterServing(nodes=nodes, config=cfg)
     result = {}
     try:
-        # everything-on includes the background controllers: CT GC,
-        # the map-pressure monitor, health — a not-started daemon
-        # also takes the pre-start cache-only identity path, so
-        # churn would never patch the replicas' tables
-        for n in c.nodes:
-            n.daemon.start()
+        # node daemons start + warm INSIDE cluster bring-up now
+        # (c.start() — ISSUE 13 satellite; the inline workaround this
+        # gate used to carry is retired and regression-pinned in
+        # test_cluster_serving)
         # -- the worlds: every scenario's endpoints/policy fan out
         # over the kvstore; policy publishes COALESCE to the newest
         # revision, so convergence is awaited per import
@@ -93,38 +91,19 @@ def _run_everything(tmp_path, duration_s: float, nodes: int = 2,
         ctxs["identity_churn"] = churn.setup(c)
         assert c.wait_policy(timeout=15), "churn policy"
 
-        # -- warm BOTH serving executables (packed + wide, each full
-        # AND valid-masked) in a THROWAWAY non-ingress session on
-        # node0 (the churn-gate superbatch-warm idiom): serve_batch
-        # here races no drain loop, touches no packet ledger, and —
-        # since executables key on the datapath-state SHAPES, which
-        # the kvstore-propagated world makes identical across
-        # replicas, and the jit caches are process-global — one
-        # node's compile is every node's cache hit
-        from cilium_tpu.core.packets import (pack_eligibility,
-                                             pack_rows)
+        # -- everything ON: spans + per-packet events + analytics.
+        # Bring-up (c.start) owns node daemon start AND the warm
+        # discipline (packed + wide, full AND valid-masked, in a
+        # throwaway non-ingress session) — the gate only needs to
+        # warm the MIXED-ep wide shape its scenario interleave
+        # creates, which generic warm rows cannot know about
+        from cilium_tpu.core.packets import pack_eligibility
 
         node0 = c.nodes[0].daemon
         wb = next(mix["elephant_mice"].iter_batches(
             ctxs["elephant_mice"]["ep"]))
-        ok, wep, wdirn = pack_eligibility(wb)
+        ok, _wep, _wdirn = pack_eligibility(wb)
         assert ok
-        mixed = wb.copy()
-        mixed[1::2, 14] = ctxs["syn_flood"]["ep"]  # COL_EP -> wide
-        vfull = np.ones(64, dtype=bool)
-        vpart = vfull.copy()
-        vpart[40:] = False
-        node0.start_serving(ring_capacity=1 << 13, drain_every=2,
-                            trace_sample=1, packed=True)
-        node0.serve_batch(pack_rows(wb), valid=vfull,
-                          packed_meta=(wep, wdirn))
-        node0.serve_batch(pack_rows(wb), valid=vpart,
-                          packed_meta=(wep, wdirn))
-        node0.serve_batch(mixed.copy(), valid=vfull)
-        node0.serve_batch(mixed.copy(), valid=vpart)
-        node0.stop_serving()
-
-        # -- everything ON: spans + per-packet events + analytics
         c.start(trace_sample=1, packed=True, span_sample=64,
                 ring_capacity=1 << 13, drain_every=2)
 
@@ -283,3 +262,32 @@ class TestEverythingOnSoak:
         assert episodes >= 1
         assert any(inc.get("map-pressure", 0) >= 1
                    for inc in r["incidents"].values())
+
+    def test_scenario_cluster_leg_in_soak(self):
+        """ISSUE 13 satellite: the scenario engine's CLUSTER leg in
+        the soak composition — syn_flood driven through
+        start_cluster_serving via the one shared run_scenario()
+        driver, flood split across replicas by the flow-affine hash,
+        per-node CT maps pressured, cluster-wide ledger exact."""
+        from cilium_tpu.testing.workloads import (run_scenario,
+                                                  scenario_cluster)
+
+        sc = make_scenario("syn_flood", seed=41, n_flows=8192,
+                           batch=256)
+        c, ctx = scenario_cluster(sc, nodes=2,
+                                  ct_capacity=1 << 10,
+                                  map_pressure_interval=0.2,
+                                  ct_gc_pressure_interval=0.25)
+        try:
+            r = run_scenario(c, sc, ctx=ctx)
+            assert r["passed"], r["checks"]
+            m = r["metrics"]
+            assert m["ledger_exact"]
+            assert m["ct_insert_drops"] > 0
+            # the pressure machinery fired on at least one replica
+            episodes = sum(
+                n.daemon.pressure.stats()["episodes"]
+                for n in c.nodes)
+            assert episodes >= 1
+        finally:
+            c.shutdown()

@@ -1,0 +1,182 @@
+"""Process-per-node cluster serving (ISSUE 13 tentpole): real worker
+processes behind the flow-affine router, row forwarding over real
+sockets, and SIGKILL chaos with the cluster ledger exact.
+
+Acceptance:
+(a) a 2-process cluster serves with the cluster-wide ledger EXACT,
+    eligible chunks riding the packed 16 B/packet wire;
+(b) mid-forward SIGKILL (a raw ``proc.kill()``, not a cooperative
+    crash): the health path detects the corpse, failover replays the
+    parent-retained CT snapshot onto the peer, replies for
+    pre-failover flows pass the peer's egress enforcement (metrics
+    delta: zero new drops), and the ledger closes EXACTLY with the
+    admitted-but-unresolved rows counted ``crash_dropped`` and the
+    in-flight frame's rows migrated/counted by failover;
+(c) process mode skips cleanly where multiprocessing spawn is
+    unavailable, and rejects configs it cannot honor.
+
+Cost discipline: worker processes pay their own jax init (~10 s per
+build on CPU), so the file runs ONE process-cluster lifecycle and
+proves (a)+(b) inside it.  Named to sort early (the tier-1
+budget-truncation convention)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import DaemonConfig
+from cilium_tpu.cluster import ClusterServing
+from cilium_tpu.cluster.process import spawn_available
+from cilium_tpu.core import TCP_ACK, TCP_SYN, make_batch
+
+pytestmark = [
+    pytest.mark.cluster,
+    pytest.mark.skipif(not spawn_available(),
+                       reason="multiprocessing 'spawn' unavailable"),
+]
+
+RULES_EGRESS_ENFORCED = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }],
+    "egress": [{
+        "toEndpoints": [{"matchLabels": {"app": "db"}}],
+        "toPorts": [{"ports": [{"port": "1", "protocol": "TCP"}]}],
+    }],
+}]
+
+
+def _config(**over):
+    cfg = dict(backend="tpu", ct_capacity=1 << 12,
+               flow_ring_capacity=1 << 13,
+               serving_queue_depth=4096,
+               serving_bucket_ladder=(64,),
+               serving_max_wait_us=500.0,
+               serving_restart_backoff_ms=1.0,
+               cluster_probe_interval_s=0.1,
+               cluster_death_threshold=2,
+               cluster_forward_depth=8192,
+               cluster_mode="process")
+    cfg.update(over)
+    return DaemonConfig(**cfg)
+
+
+def _fwd(db_id, n=128, base=20000):
+    return make_batch([
+        dict(src="10.0.1.1", dst="10.0.2.1", sport=base + i,
+             dport=5432, proto=6, flags=TCP_SYN, ep=db_id, dir=0)
+        for i in range(n)]).data
+
+
+def _rep(db_id, n=128, base=20000):
+    return make_batch([
+        dict(src="10.0.2.1", dst="10.0.1.1", sport=5432,
+             dport=base + i, proto=6, flags=TCP_ACK, ep=db_id, dir=1)
+        for i in range(n)]).data
+
+
+def _wait(pred, timeout=60.0, tick=0.01):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+class TestProcessClusterConfig:
+    def test_process_mode_requires_remote_kvstore(self):
+        with pytest.raises(ValueError, match="remote"):
+            ClusterServing(nodes=1, config=_config(
+                cluster_kvstore="memory"))
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="cluster_mode"):
+            ClusterServing(nodes=1, config=_config(
+                cluster_mode="fiber"))
+
+
+@pytest.mark.chaos
+class TestProcessClusterLifecycle:
+    """One full process-cluster lifecycle: serve -> mid-forward
+    SIGKILL -> health-path failover -> CT-replay continuity -> exact
+    ledger.  (One build: worker jax init dominates the budget.)"""
+
+    def test_serve_sigkill_failover_ledger_exact(self):
+        c = ClusterServing(nodes=2, config=_config())
+        try:
+            c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+            db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+            rev = c.policy_import(RULES_EGRESS_ENFORCED)
+            assert c.wait_policy(rev, timeout=30)
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            # every replica is a REAL process
+            pids = {n.proc.pid for n in c.nodes}
+            assert len(pids) == 2 and all(p for p in pids)
+            # -- (a) serve: ledger exact, packed wire used ----------
+            rows = _fwd(db.id)
+            assert c.submit(rows) == 128
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= 128)
+            for n in c.nodes:
+                ts = n.transport_stats()
+                assert ts["frames"] >= 1
+                assert ts["frames-packed"] == ts["frames"], (
+                    "single-stream chunks must ride the packed "
+                    "16 B/packet wire")
+            c.snapshot_now()  # parent-retained CT replica per node
+            m0 = {n.name: n.metrics().sum(axis=1) for n in c.nodes}
+            # -- (b) mid-forward SIGKILL ----------------------------
+            victim = c.nodes[1]
+            victim.proc.kill()  # raw SIGKILL: no goodbye, frames may
+            # be mid-flight; the forwarder's requeue + the last-ack
+            # crash accounting must absorb all of it
+            sent = 128
+            t0 = time.monotonic()
+            k = 0
+            while not c.membership.dead_nodes():
+                c.submit(_fwd(db.id, base=40000 + 128 * k))
+                sent += 128
+                k += 1
+                assert time.monotonic() - t0 < 60, "death undetected"
+                time.sleep(0.02)
+            assert c.membership.dead_nodes() == ["node1"]
+            assert _wait(lambda: c.failovers_total() == 1)
+            rec = c.failover.snapshot()[0]
+            assert rec["dead"] == "node1" and rec["peer"] == "node0"
+            # the parent-retained snapshot replayed onto the peer
+            assert rec["ct-replayed-entries"] > 0
+            # -- replies for pre-failover flows pass the peer's
+            # egress enforcement via the replayed CT ----------------
+            c.submit(_rep(db.id))
+            sent += 128
+            assert _wait(lambda: c.forward_pending() == 0)
+            st = c.stop()
+            led = st["ledger"]
+            assert led["exact"], led
+            assert led["submitted"] == sent
+            # SIGKILL accounting: whatever the corpse had admitted
+            # beyond its last-acked resolved counters is crash
+            # loss — counted, surfaced, never silent
+            assert led["crash-dropped"] == rec["crash-dropped-rows"]
+            fe_dead = st["per-node"]["node1"]["front-end"]
+            assert fe_dead["submitted"] >= (
+                fe_dead["verdicts"] + fe_dead["shed"])
+            # zero NEW drops on the survivor across the reply wave
+            m1 = c.nodes[0].metrics().sum(axis=1)
+            delta = m1 - m0["node0"]
+            drops = {i: int(d) for i, d in enumerate(delta)
+                     if i and d}
+            assert not drops, (
+                f"CT continuity broken across SIGKILL: {drops}")
+            # the registry on the survivor carries the crash counter
+            assert c.crash_dropped_total() == led["crash-dropped"]
+        finally:
+            c.shutdown()
+        # shutdown reaps every worker
+        for n in c.nodes:
+            assert not n.proc.is_alive()

@@ -1,0 +1,349 @@
+"""Live cluster scale-out (ISSUE 13): ``add_node()`` on a SERVING
+cluster, the queue-depth autoscaler, and the bring-up regression
+pins.
+
+Acceptance:
+(a) add_node on a live cluster: cluster-wide ledger EXACT across the
+    transition, replies for MIGRATED flows pass egress enforcement
+    on the NEW owner (via its replayed CT — the failover proof run
+    in reverse), zero serving-executable recompiles on surviving
+    nodes;
+(b) the queue-depth autoscale controller fires add_node after the
+    configured hot streak, on the existing controller infra;
+(c) bring-up regression pin (satellite): ClusterServing.start()
+    STARTS every node daemon (controllers live, post-start identity
+    path armed) and runs the warm discipline — the PR 12 gate's
+    inline workaround stays retired.
+
+Named to sort early (the tier-1 budget-truncation convention)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.agent import DaemonConfig
+from cilium_tpu.cluster import ClusterServing
+from cilium_tpu.cluster.process import spawn_available
+from cilium_tpu.core import TCP_ACK, TCP_SYN, make_batch
+from cilium_tpu.core.packets import COL_DIR
+from cilium_tpu.monitor.api import MSG_DROP
+from cilium_tpu.parallel.mesh import ct_rows_slot_ids, flow_shard_ids
+
+pytestmark = pytest.mark.cluster
+
+RULES_EGRESS_ENFORCED = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }],
+    "egress": [{
+        "toEndpoints": [{"matchLabels": {"app": "db"}}],
+        "toPorts": [{"ports": [{"port": "1", "protocol": "TCP"}]}],
+    }],
+}]
+
+
+def _config(**over):
+    cfg = dict(backend="tpu", ct_capacity=1 << 12,
+               flow_ring_capacity=1 << 13,
+               serving_queue_depth=4096,
+               serving_bucket_ladder=(64,),
+               serving_max_wait_us=500.0,
+               serving_restart_backoff_ms=1.0,
+               cluster_probe_interval_s=0.1,
+               cluster_death_threshold=2,
+               cluster_forward_depth=8192)
+    cfg.update(over)
+    return DaemonConfig(**cfg)
+
+
+def _fwd(db_id, n=128, base=20000):
+    return make_batch([
+        dict(src="10.0.1.1", dst="10.0.2.1", sport=base + i,
+             dport=5432, proto=6, flags=TCP_SYN, ep=db_id, dir=0)
+        for i in range(n)]).data
+
+
+def _rep(db_id, n=128, base=20000):
+    return make_batch([
+        dict(src="10.0.2.1", dst="10.0.1.1", sport=5432,
+             dport=base + i, proto=6, flags=TCP_ACK, ep=db_id, dir=1)
+        for i in range(n)]).data
+
+
+def _wait(pred, timeout=60.0, tick=0.005):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+def _build(nodes=2, **over):
+    c = ClusterServing(nodes=nodes, config=_config(**over))
+    c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    rev = c.policy_import(RULES_EGRESS_ENFORCED)
+    assert c.wait_policy(rev), "policy failed to converge"
+    return c, db
+
+
+class TestBringUpRegressionPin:
+    def test_start_starts_every_node_daemon_and_warms(self):
+        """Satellite pin: cluster bring-up owns daemon.start() (the
+        PR 12 gate's inline workaround is retired) and the warm
+        discipline (serving executables exist BEFORE the first real
+        batch)."""
+        c, db = _build(nodes=2)
+        try:
+            assert all(not n.daemon._started for n in c.nodes), \
+                "construction must not start daemons (start() does)"
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            for n in c.nodes:
+                # daemon.start() ran: controllers live (CT GC is
+                # unconditional), post-start identity path armed
+                assert n.daemon._started
+                assert n.daemon.controllers.get("ct-gc") is not None
+            # warm discipline: the packed+wide executables compiled
+            # during bring-up, so a served batch compiles NOTHING
+            compiles0 = {n.name: n.dispatch_compiles()
+                         ["dispatch_compiles"] for n in c.nodes}
+            assert any(v > 0 for v in compiles0.values()), \
+                "warm-up must have compiled the serving executables"
+            assert c.submit(_fwd(db.id)) == 128
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= 128)
+            compiles1 = {n.name: n.dispatch_compiles()
+                         ["dispatch_compiles"] for n in c.nodes}
+            assert compiles1 == compiles0, (compiles0, compiles1)
+            st = c.stop()
+            assert st["ledger"]["exact"]
+        finally:
+            c.shutdown()
+
+
+class TestCtSlotSelector:
+    def test_ct_rows_hash_like_their_packets(self):
+        """The scale-out migration selector: a CT snapshot row lands
+        on the SAME slot as the packets that created it, both
+        directions (the commutative-mix proof, device-made rows)."""
+        from cilium_tpu.agent import Daemon
+
+        d = Daemon(_config())
+        d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES_EGRESS_ENFORCED)
+        try:
+            rows = _fwd(db.id, n=256)
+            d.process_batch(rows)
+            snap = d.loader.ct_snapshot()
+            assert len(snap) >= 256
+            for n_slots in (3, 32, 48):
+                hdr_slots = flow_shard_ids(rows, n_slots)
+                ct_slots = ct_rows_slot_ids(snap, n_slots)
+                # every header slot set must be covered identically:
+                # match CT rows back to headers via the port word
+                sport = (np.asarray(snap)[:, 8] >> 16) & 0xFFFF
+                dport = np.asarray(snap)[:, 8] & 0xFFFF
+                hp = rows[:, 8]
+                for i in range(0, 256, 17):
+                    m = (sport == hp[i]) | (dport == hp[i])
+                    assert m.any()
+                    assert set(ct_slots[m].tolist()) \
+                        == {int(hdr_slots[i])}
+        finally:
+            d.shutdown()
+
+
+@pytest.mark.chaos
+class TestScaleOutThreadMode:
+    def test_add_node_migrates_ct_ledger_exact(self):
+        """THE scale-out acceptance (thread mode, where per-node
+        monitor planes are directly observable): grow 2 -> 3 under
+        established flows; ledger exact across the transition, the
+        new node serves EXACTLY the migrated slots' replies with
+        zero drops (egress enforcement via migrated CT), survivors
+        recompile nothing."""
+        c, db = _build(nodes=2)
+        got = {}
+        try:
+            c.start(trace_sample=1, packed=True,
+                    ring_capacity=1 << 10)
+            rows = _fwd(db.id)
+            assert c.submit(rows) == 128
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= 128)
+            rec = c.add_node()
+            assert rec["nodes-after"] == 3
+            assert rec["moved-slots"] > 0
+            assert rec["ct-migrated-entries"] > 0
+            assert rec["survivor-recompiles"] == 0
+            # the new node exists everywhere the tier looks
+            assert c.node("node2").alive
+            assert len(c.membership.statuses()) == 3
+            # which established flows moved?
+            r = c.router
+            moved_slots = set(r.slots_of(2))
+            ids = flow_shard_ids(rows, r.n_slots)
+            moved_mask = np.isin(ids, list(moved_slots))
+            assert moved_mask.any(), \
+                "some established flows must have moved"
+            # observe the NEW node's monitor plane for the replies
+            for n in c.nodes:
+                buf = []
+                n.daemon.monitor.register("t", buf.append)
+                got[n.name] = buf
+            c.submit(_rep(db.id))
+            sent = 256
+            assert _wait(lambda: c.forward_pending() == 0)
+            st = c.stop()
+            led = st["ledger"]
+            assert led["exact"], led
+            assert led["submitted"] == sent
+            # replies of the migrated flows landed on node2, passed
+            # egress (no drops), and ONLY those landed there
+            fwd2 = drop2 = 0
+            for b in got["node2"]:
+                m = b.hdr[:, COL_DIR] == 1
+                fwd2 += int((b.msg_type[m] != MSG_DROP).sum())
+                drop2 += int((b.msg_type[m] == MSG_DROP).sum())
+            assert drop2 == 0, (
+                f"CT continuity broken across scale-out: {drop2} "
+                f"migrated-flow replies dropped on the new owner")
+            assert fwd2 == int(moved_mask.sum())
+            # the scale-out is a named incident on the NEW node
+            kinds = [i["kind"] for i in
+                     c.node("node2").daemon.flightrec.incidents()]
+            assert "node-scaleout" in kinds
+        finally:
+            c.shutdown()
+
+    def test_scale_via_api_and_cli(self, tmp_path, capsys):
+        """The operator surface: PUT /cluster/scale from any member
+        node's socket (`cilium-tpu cluster scale`), and the richer
+        status block (mode, scale-outs, slot shares, forward-latency
+        percentiles)."""
+        from cilium_tpu.api.server import APIServer
+        from cilium_tpu.cli.main import main as cli_main
+
+        c, db = _build(nodes=1)
+        try:
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            assert c.submit(_fwd(db.id)) == 128
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= 128)
+            sock = str(tmp_path / "cilium.sock")
+            srv = APIServer(c.nodes[0].daemon, sock)
+            srv.start()
+            try:
+                rc = cli_main(["--socket", sock, "cluster", "scale"])
+                assert rc == 0
+                out = capsys.readouterr().out
+                assert "node1 joined" in out
+                assert len(c.nodes) == 2
+                rc = cli_main(["--socket", sock, "cluster",
+                               "status"])
+                assert rc == 0
+                out = capsys.readouterr().out
+                assert "scale-outs 1" in out
+                assert "mode thread" in out
+                assert "forward latency" in out
+            finally:
+                srv.stop()
+            st = c.stop()
+            assert st["ledger"]["exact"]
+        finally:
+            c.shutdown()
+
+    def test_autoscaler_fires_on_hot_queue(self):
+        """The queue-depth controller: a parked node (dead drain
+        consumer) backs the forward queue up past the watermark;
+        after `ticks` hot samples the autoscaler add_node()s."""
+        c, db = _build(
+            nodes=1,
+            cluster_forward_depth=512,
+            cluster_autoscale=True,
+            cluster_autoscale_high_frac=0.25,
+            cluster_autoscale_ticks=2,
+            cluster_autoscale_interval_s=0.05,
+            cluster_autoscale_max_nodes=2)
+        try:
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            assert c.autoscaler is not None
+            # wedge the lone node's forward queue: pause its drain
+            # by flooding faster than one node absorbs
+            t0 = time.monotonic()
+            k = 0
+            while len(c.nodes) < 2:
+                c.submit(_fwd(db.id, n=128, base=20000 + 128 * k))
+                k += 1
+                if time.monotonic() - t0 > 60:
+                    raise AssertionError(
+                        f"autoscaler never fired: "
+                        f"{c.autoscaler.stats()}")
+                time.sleep(0.002)
+            assert c.autoscaler.triggered >= 1
+            assert c.node("node1").alive
+            assert _wait(lambda: c.forward_pending() == 0,
+                         timeout=60)
+            st = c.stop()
+            assert st["ledger"]["exact"], st["ledger"]
+            assert st["cluster"]["scale-outs"] >= 1
+        finally:
+            c.shutdown()
+
+
+@pytest.mark.chaos
+class TestScaleOutProcessMode:
+    @pytest.mark.skipif(not spawn_available(),
+                        reason="multiprocessing 'spawn' unavailable")
+    def test_add_node_process_mode(self):
+        """Scale-out with REAL worker processes: the newcomer is a
+        fresh spawned process, CT ships over the control channel,
+        ledger exact, survivors untouched."""
+        c, db = _build(nodes=2, cluster_mode="process")
+        try:
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            rows = _fwd(db.id)
+            assert c.submit(rows) == 128
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= 128)
+            rec = c.add_node()
+            assert rec["nodes-after"] == 3
+            assert rec["survivor-recompiles"] == 0
+            assert rec["ct-migrated-entries"] > 0
+            new = c.node("node2")
+            assert new.proc.is_alive()
+            # migrated flows' replies route to (and pass on) node2
+            r = c.router
+            moved_slots = set(r.slots_of(2))
+            ids = flow_shard_ids(rows, r.n_slots)
+            moved = int(np.isin(ids, list(moved_slots)).sum())
+            assert moved > 0
+            m0 = new.metrics().sum(axis=1)
+            c.submit(_rep(db.id))
+            sent = 256
+            assert _wait(lambda: c.forward_pending() == 0)
+            st = c.stop()
+            led = st["ledger"]
+            assert led["exact"], led
+            assert led["submitted"] == sent
+            fe2 = st["per-node"]["node2"]["front-end"]
+            assert fe2["verdicts"] >= moved
+            m1 = new.metrics()
+            if m1 is not None:
+                delta = m1.sum(axis=1) - m0
+                drops = {i: int(d) for i, d in enumerate(delta)
+                         if i and d}
+                assert not drops, (
+                    f"migrated-flow replies dropped on the new "
+                    f"process node: {drops}")
+        finally:
+            c.shutdown()

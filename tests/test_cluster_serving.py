@@ -207,7 +207,12 @@ class TestRouterUnit:
         moved = r.fail_over(1, 2)
         assert moved["moved"] == int((ids == 1).sum())
         assert moved["dropped"] == 0
-        assert r.snapshot()["slot-owner"] == [0, 2, 2]
+        # the slot space is slot_factor * 3 wide; failover re-pinned
+        # EXACTLY the dead node's share (slots ≡ 1 mod 3 -> 2)
+        owner = r.snapshot()["slot-owner"]
+        assert len(owner) == r.n_slots
+        assert all(o == (2 if s % 3 == 1 else s % 3)
+                   for s, o in enumerate(owner))
         assert _wait(lambda: r.pending_total() == 0, timeout=10)
         snap = r.stop()
         assert snap["failover-dropped"] == 0
@@ -217,11 +222,8 @@ class TestRouterUnit:
                                                 | (ids == 2)).sum())
         # post-failover traffic for the dead slot goes to the peer
         more = _fwd(1, n=64)
-        r2 = ClusterRouter(nodes, forward_depth=4096)
-        with r2._cv:  # mirror the failed-over table
-            r2._slot_owner = [0, 2, 2]
-            r2._owner_arr = np.asarray([0, 2, 2])
-        ids2 = r2._owner_arr[flow_shard_ids(more, 3)]
+        owner_arr = np.asarray(owner)
+        ids2 = owner_arr[flow_shard_ids(more, r.n_slots)]
         assert not (ids2 == 1).any()
 
     def test_failover_peer_overflow_is_failover_dropped(self):
