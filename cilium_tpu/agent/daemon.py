@@ -278,6 +278,24 @@ class DaemonConfig:
     # joining node steals ~1/new_n of the slots, nobody else's flows
     # move)
     cluster_slot_factor: int = 16
+    # -- cluster observability relay (obs/relay.py; ISSUE 14).  The
+    # parent-side scrape loop's cadence in seconds: every tick pulls
+    # each node's registry exposition, flow-ring tail, analytics
+    # top-K, tracer stats, and incident list into the merged cluster
+    # views (GET /cluster/metrics, flows --cluster, top --cluster,
+    # cluster sysdump).  0 disables the periodic loop — queries then
+    # scrape on demand
+    cluster_obs_interval_s: float = 1.0
+    # a node whose scrape fails keeps serving its last-known-good
+    # snapshot this long; past the bound its per-node series drop
+    # (only the relay's scrape_ok/age meta-series remain)
+    cluster_obs_stale_after_s: float = 30.0
+    # cross-process trace stitching: every Nth forwarded chunk
+    # carries (trace_id, router stamps) through the data channel and
+    # the worker's stage stamps ride the ack back — one stitched span
+    # per sample (router-queue -> forward -> worker-admit -> ack).
+    # 0 = off (the hot-path cost when off is one int compare)
+    cluster_trace_sample: int = 0
     # -- queue-depth autoscale (cluster/scale.py ClusterAutoscaler).
     # When ON, a named controller samples the router's forward queues
     # and add_node()s after `ticks` consecutive samples over
@@ -812,6 +830,31 @@ class Daemon:
         pending batches on THIS thread — query threads are off the
         dispatch path by definition)."""
         return self.analytics.snapshot(top=top)
+
+    def obs_scrape_snapshot(self, cursor: int = 0, flows: int = 512,
+                            top: int = 16) -> dict:
+        """One relay scrape (ISSUE 14): registry exposition + the
+        flow-ring tail since the caller's cursor + analytics top-K +
+        tracer stats + the incident list, in one round trip —
+        everything the parent-side ``ClusterObsRelay`` merges into
+        the cluster views.  The ONE definition behind BOTH node
+        modes (``ClusterNode.obs_scrape`` in-process and the
+        ``nodehost`` ``obs_scrape`` control op): a field added to a
+        single copy would silently diverge thread-mode and
+        process-mode merged views (the PR 12 warm-recipe regression
+        class)."""
+        fls, new_cursor = self.observer.flows_since(int(cursor),
+                                                    limit=int(flows))
+        s = self._serving
+        tr = s.get("tracer") if s is not None else None
+        return {
+            "metrics-text": self.registry.render(),
+            "flows": [f.to_dict() for f in fls],
+            "cursor": new_cursor,
+            "top": self.flows_aggregate(top=int(top)),
+            "trace": tr.stats() if tr is not None else None,
+            "incidents": self.flightrec.incidents(),
+        }
 
     def add_relay_peer(self, name: str, observer) -> None:
         """Register a peer agent's Observer(-protocol object) for

@@ -82,6 +82,14 @@ CTA010    scenario contract: every class registered in the
           constructor parameter (the determinism contract); the
           ``BENCH_scenarios.json`` artifact (when present) must keep
           its schema (``scripts/check_scenarios.py`` is the shim CLI)
+CTA011    nodehost control-op discipline: every ``cluster/nodehost``
+          ``_OPS`` entry has a positive ``OP_TIMEOUTS`` bound (the
+          parent's ``ProcessNode.call`` default — an unbounded RPC
+          against a wedged worker parks every later control caller,
+          probes included, forever) and is referenced by at least
+          one test under ``tests/``; ``OP_TIMEOUTS`` carries no
+          stale entries; ``BENCH_obs.json`` (when present) must
+          keep its schema
 ========  ===========================================================
 
 Annotation grammar

@@ -1,0 +1,170 @@
+"""CTA011 — nodehost control-op discipline: every worker control op
+is timeout-bounded and test-referenced.
+
+The process-per-node tier's control channel (``cluster/nodehost.py``
+``_OPS``) is the parent's ONLY window into a worker.  Two failure
+modes this checker makes impossible to ship:
+
+1. **An unbounded op.**  ``ProcessNode.call`` serializes RPCs under
+   the per-node control lock; one call with no deadline against a
+   wedged worker parks every later caller (probes included) behind
+   it forever — the membership prober can then never declare the
+   node dead.  Every ``_OPS`` key must therefore have a positive
+   numeric bound in ``nodehost.OP_TIMEOUTS`` (which ``call`` uses as
+   its default), and the table must not carry stale entries for ops
+   that no longer exist.
+
+2. **An untested op.**  The control vocabulary is a cross-process
+   wire contract with no type checker across it; an op nothing
+   references from ``tests/`` is a dead letter the next refactor
+   breaks silently.  Every ``_OPS`` key must appear as a string
+   literal somewhere under ``tests/``.
+
+Additionally, when ``BENCH_obs.json`` exists at the repo root it
+must carry the observability bench schema floor
+(:data:`BENCH_OBS_KEYS`; ``check_bench`` is the importable
+validator, the CTA008 idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional
+
+from .core import FileCtx, Finding, Repo
+
+CODE = "CTA011"
+NAME = "nodehost-ops"
+
+NODEHOST_MODULE = "cilium_tpu/cluster/nodehost.py"
+TESTS_DIR = "tests"
+
+BENCH_NAME = "BENCH_obs.json"
+# the observability bench artifact's schema floor (bench.py --obs):
+# the paired-leg scrape-overhead ratio (relay polling on vs off
+# during cluster serving) and the scrape round-trip percentiles
+BENCH_OBS_KEYS = (
+    "schema", "best_of",
+    "sustained_pps_obs", "sustained_pps_noobs",
+    "scrape_overhead_ratio", "scrape_overhead_pairs",
+    "scrape_rtt_us", "scrapes_total",
+    "stitched_spans", "ledger_exact",
+)
+BENCH_SCHEMA = "bench-obs-v1"
+
+
+def _dict_str_keys(ctx: FileCtx, name: str) -> Optional[Dict[str,
+                                                             object]]:
+    """Module- or class-level ``name = {"k": v, ...}`` -> {k: value
+    node} (string keys only)."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, ast.Dict):
+            out = {}
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) \
+                        and isinstance(k.value, str):
+                    out[k.value] = v
+            return out
+    return None
+
+
+def _tests_source(root: str) -> str:
+    """Concatenated test sources (the reference scan — tests/ sits
+    outside the package walk, like the BENCH artifacts)."""
+    chunks: List[str] = []
+    tests = os.path.join(root, TESTS_DIR)
+    for dirpath, dirnames, filenames in os.walk(tests):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8", errors="replace") as f:
+                    chunks.append(f.read())
+            except OSError:
+                continue
+    return "\n".join(chunks)
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    ctx = repo.by_rel(NODEHOST_MODULE)
+    if ctx is None or ctx.tree is None:
+        return [Finding(CODE, NODEHOST_MODULE, 1,
+                        "nodehost module missing", checker=NAME)]
+    ops = _dict_str_keys(ctx, "_OPS")
+    timeouts = _dict_str_keys(ctx, "OP_TIMEOUTS")
+    if ops is None:
+        return [Finding(CODE, ctx.rel, 1,
+                        "_OPS dict literal not found", checker=NAME)]
+    if timeouts is None:
+        return [Finding(
+            CODE, ctx.rel, 1,
+            "OP_TIMEOUTS dict literal not found (every control op "
+            "needs a declared timeout bound)", checker=NAME)]
+    for op, vnode in ops.items():
+        line = getattr(vnode, "lineno", 1)
+        tnode = timeouts.get(op)
+        if tnode is None:
+            findings.append(Finding(
+                CODE, ctx.rel, line,
+                f"control op {op!r} has no OP_TIMEOUTS bound — an "
+                f"unbounded RPC against a wedged worker parks every "
+                f"later control caller (probes included) forever",
+                checker=NAME))
+        elif not (isinstance(tnode, ast.Constant)
+                  and isinstance(tnode.value, (int, float))
+                  and tnode.value > 0):
+            findings.append(Finding(
+                CODE, ctx.rel, getattr(tnode, "lineno", line),
+                f"control op {op!r}'s OP_TIMEOUTS entry must be a "
+                f"positive numeric literal", checker=NAME))
+    for op, tnode in timeouts.items():
+        if op not in ops:
+            findings.append(Finding(
+                CODE, ctx.rel, getattr(tnode, "lineno", 1),
+                f"OP_TIMEOUTS carries {op!r} but no such _OPS entry "
+                f"exists (stale bound)", checker=NAME))
+    tests_src = _tests_source(repo.root)
+    for op, vnode in ops.items():
+        if f'"{op}"' in tests_src or f"'{op}'" in tests_src:
+            continue
+        findings.append(Finding(
+            CODE, ctx.rel, getattr(vnode, "lineno", 1),
+            f"control op {op!r} is referenced by no test under "
+            f"tests/ — a cross-process wire contract with no "
+            f"coverage is a dead letter", checker=NAME))
+    # bench artifact schema (only when the artifact exists)
+    bench_path = os.path.join(repo.root, BENCH_NAME)
+    if os.path.exists(bench_path):
+        for msg in check_bench(bench_path):
+            findings.append(Finding(CODE, BENCH_NAME, 1, msg,
+                                    checker=NAME))
+    return findings
+
+
+# -- bench artifact validation (tests import this) ---------------------
+def check_bench(path: str) -> List[str]:
+    """-> list of violation strings (empty = clean)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: does not load as JSON ({e})"]
+    if not isinstance(data, dict):
+        return [f"{path}: top level is {type(data).__name__}, "
+                f"not an object"]
+    bad = []
+    if data.get("schema") != BENCH_SCHEMA:
+        bad.append(f"{path}: schema {data.get('schema')!r} != "
+                   f"{BENCH_SCHEMA}")
+    for key in BENCH_OBS_KEYS:
+        if key not in data:
+            bad.append(f"{path}: missing required key {key!r}")
+    return bad

@@ -4,15 +4,18 @@ sharing the finding/suppression/baseline machinery; the script
 remains as a thin delegating shim).
 
 Prometheus exposition text may only be built in
-``cilium_tpu/obs/registry.py``.  Flagged anywhere else:
+``cilium_tpu/obs/registry.py`` and ``cilium_tpu/obs/relay.py`` (the
+cluster relay merges per-node expositions and renders its own scrape
+meta-series — ISSUE 14).  Flagged anywhere else:
 
 1. a TYPE exposition header inside a string literal;
 2. a labelled metric sample literal (a metric-suffixed name opening
    an inline label brace).
 
 Additionally, every REQUIRED_SERIES name (the operator-contract
-floor) must stay registered — its literal must appear in the
-registry module.
+floor) must stay registered in the registry module, and every
+RELAY_REQUIRED_SERIES name (the cluster relay's meta-series floor)
+must stay rendered in the relay module.
 """
 
 from __future__ import annotations
@@ -28,6 +31,21 @@ CODE = "CTA006"
 NAME = "metrics-registry"
 
 REGISTRY_MODULE = "cilium_tpu/obs/registry.py"
+# the cluster observability relay also builds exposition text (the
+# merged per-node view + its own scrape meta-series)
+RELAY_MODULE = "cilium_tpu/obs/relay.py"
+ALLOWED_MODULES = (REGISTRY_MODULE, RELAY_MODULE)
+
+# the relay's meta-series floor: these must stay rendered in the
+# relay module — a cluster whose scrape plane cannot say which node
+# went dark is the ISSUE 14 failure mode
+RELAY_REQUIRED_SERIES = (
+    "cilium_cluster_node_scrape_ok",
+    "cilium_cluster_node_scrape_age_seconds",
+    "cilium_cluster_scrapes_total",
+    "cilium_cluster_scrape_errors_total",
+    "cilium_cluster_scrape_rtt_us",
+)
 
 # series that must be REGISTERED (their name literal present in the
 # registry module) — the operator-contract floor
@@ -43,7 +61,9 @@ REQUIRED_SERIES = (
     # the counters registered)
     "cilium_cluster_router_overflow_total",
     "cilium_cluster_failover_dropped_total",
+    "cilium_cluster_crash_dropped_total",
     "cilium_cluster_failovers_total",
+    "cilium_cluster_forward_latency_us",
     # live policy churn (datapath/tables.py table versioning): the
     # published generation and its swap plane must stay scrapeable —
     # an invisible generation means churn incidents cannot be
@@ -111,8 +131,20 @@ def check(repo: Repo, graph=None) -> List[Finding]:
                     CODE, reg.rel, 1,
                     f"required series {name!r} is not registered "
                     f"(operator-contract floor)", checker=NAME))
+    relay = repo.by_rel(RELAY_MODULE)
+    if relay is None:
+        findings.append(Finding(
+            CODE, RELAY_MODULE, 1,
+            "cluster relay module missing", checker=NAME))
+    else:
+        for name in RELAY_REQUIRED_SERIES:
+            if name not in relay.source:
+                findings.append(Finding(
+                    CODE, relay.rel, 1,
+                    f"required relay series {name!r} is not rendered "
+                    f"(cluster scrape-plane floor)", checker=NAME))
     for ctx in repo.files:
-        if ctx.rel == REGISTRY_MODULE:
+        if ctx.rel in ALLOWED_MODULES:
             continue
         for line, what, snippet in scan_file(ctx.path):
             if ctx.suppressed(CODE, line):

@@ -139,6 +139,27 @@ class APIClient:
         (PUT /cluster/scale); returns the scale-out record."""
         return self._request("PUT", "/cluster/scale")
 
+    # -- the cluster observability relay (ISSUE 14) --------------------
+    def cluster_metrics(self) -> str:
+        """One exposition text, every series node-labelled."""
+        return self._request("GET", "/cluster/metrics")
+
+    def cluster_flows(self, **params):
+        q = "&".join(f"{k}={v}" for k, v in params.items()
+                     if v is not None)
+        return self._request(
+            "GET", f"/cluster/flows{'?' + q if q else ''}")
+
+    def cluster_top(self, top: int = 16):
+        return self._request("GET", f"/cluster/top?top={top}")
+
+    def cluster_trace(self, limit: int = 32):
+        return self._request("GET",
+                             f"/cluster/trace?limit={limit}")
+
+    def cluster_sysdump(self):
+        return self._request("GET", "/cluster/sysdump")
+
     def cluster_health(self):
         return self._request("GET", "/cluster/health")
 

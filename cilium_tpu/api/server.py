@@ -28,6 +28,9 @@ class _UnixHTTPServer(socketserver.ThreadingUnixStreamServer):
 # jax's profiler is process-global state; one capture at a time
 _PROFILE_LOCK = threading.Lock()
 
+_NO_CLUSTER = ("not part of a cluster serving tier "
+               "(start_cluster_serving)")
+
 
 class APIServer:
     def __init__(self, daemon: Daemon, socket_path: str):
@@ -232,6 +235,59 @@ def _make_handler(daemon: Daemon):
                                      "tier (start_cluster_serving)"})
                     else:
                         self._send(200, daemon._cluster.status())
+                elif path == "/cluster/metrics":
+                    # the cluster observability relay (ISSUE 14): one
+                    # exposition, every series node-labelled, relay
+                    # scrape meta-series appended
+                    if daemon._cluster is None:
+                        self._send(404, {"error": _NO_CLUSTER})
+                    else:
+                        self._send_text(
+                            200, daemon._cluster.obs.cluster_metrics())
+                elif path == "/cluster/flows":
+                    # merged time-ordered flows from every node
+                    # (hubble-relay parity; each dict carries
+                    # node_name)
+                    if daemon._cluster is None:
+                        self._send(404, {"error": _NO_CLUSTER})
+                    else:
+                        n = int(q.get("number", ["100"])[0])
+                        oldest = q.get("oldest_first",
+                                       ["0"])[0] in ("1", "true")
+                        self._send(200,
+                                   daemon._cluster.obs.cluster_flows(
+                                       number=n, oldest_first=oldest))
+                elif path == "/cluster/top":
+                    # analytics top-K merged across nodes
+                    if daemon._cluster is None:
+                        self._send(404, {"error": _NO_CLUSTER})
+                    else:
+                        top = int(q.get("top", ["16"])[0])
+                        self._send(200,
+                                   daemon._cluster.obs.cluster_top(
+                                       top=top))
+                elif path == "/cluster/trace":
+                    # stitched cross-process spans + per-node tracer
+                    # summaries
+                    if daemon._cluster is None:
+                        self._send(404, {"error": _NO_CLUSTER})
+                    else:
+                        limit = int(q.get("limit", ["32"])[0])
+                        self._send(200,
+                                   daemon._cluster.obs.cluster_trace(
+                                       limit=limit))
+                elif path == "/cluster/sysdump":
+                    # the cluster sysdump archive: every worker
+                    # bundle + the parent bundle + a manifest
+                    if daemon._cluster is None:
+                        self._send(404, {"error": _NO_CLUSTER})
+                    else:
+                        try:
+                            self._send(
+                                200,
+                                daemon._cluster.cluster_sysdump())
+                        except Exception as e:
+                            self._send(400, {"error": str(e)})
                 elif path == "/serving":
                     # serving front-end telemetry (queue wait, pad
                     # efficiency, verdicts/sec, latency percentiles)

@@ -196,6 +196,44 @@ def cmd_cluster(args) -> int:
               f"pause {rec['pause-ms']}ms, survivor recompiles "
               f"{rec['survivor-recompiles']})")
         return 0
+    if getattr(args, "action", "status") == "sysdump":
+        # the cluster sysdump archive (ISSUE 14): every worker's
+        # flight-recorder bundle + the parent bundle + a manifest
+        out = _client(args).cluster_sysdump()
+        if args.json:
+            _print(out)
+            return 0
+        man = out.get("manifest") or {}
+        print(f"wrote {out.get('path')}")
+        for name, ent in sorted((man.get("nodes") or {}).items()):
+            state = ("ok" if ent.get("ok")
+                     else f"FAILED ({ent.get('error', '?')})")
+            print(f"  {name:<16}{state}")
+        return 0
+    if getattr(args, "action", "status") == "trace":
+        # stitched cross-process spans (router-queue -> forward ->
+        # worker-admit -> ack) + per-node tracer summaries
+        tr = _client(args).cluster_trace()
+        if args.json:
+            _print(tr)
+            return 0
+        st = tr.get("stitched")
+        if not st:
+            print("No stitched spans (set cluster_trace_sample > 0)")
+            return 0
+        print(f"Stitched spans: {st['committed']} committed, "
+              f"{st['dropped']} dropped of {st['sampled']} sampled")
+        for hop, h in (st.get("hops-us") or {}).items():
+            if h and h.get("count"):
+                print(f"  {hop:<28}p50 {_us(h['p50'])} "
+                      f"p99 {_us(h['p99'])}")
+        for sp in (st.get("spans") or [])[:8]:
+            hops = " ".join(f"{k.split('->')[1]}+{_us(v)}"
+                            for k, v in sp["hops-us"].items())
+            print(f"  #{sp['trace-id']} {sp['node']} "
+                  f"rows={sp['rows']} e2e {_us(sp['e2e-us'])}: "
+                  f"{hops}")
+        return 0
     st = _client(args).cluster_status()
     if args.json:
         _print(st)
@@ -391,7 +429,12 @@ def cmd_map(args) -> int:
 
 
 def cmd_metrics(args) -> int:
-    print(_client(args).metrics(), end="")
+    c = _client(args)
+    if getattr(args, "cluster", False):
+        # the relay's merged exposition: every series node-labelled
+        print(c.cluster_metrics(), end="")
+        return 0
+    print(c.metrics(), end="")
     return 0
 
 
@@ -406,6 +449,67 @@ def cmd_flows(args) -> int:
     # a follow session keeps its original left edge
     since = (time.time() - args.since) if args.since else None
     seen = 0
+    if getattr(args, "cluster", False):
+        # `flows --cluster` (hubble-relay parity): merged
+        # time-ordered flows from every node, node_name stamped.
+        # The shared filter vocabulary applies CLIENT-side over the
+        # merged dicts (the relay buffer is node-merged, not an
+        # Observer ring), and -f tails by (node, uuid).
+        from ..flow.flow import PROTO_NAMES, VERDICT_NAMES
+
+        want_verdict = (VERDICT_NAMES.get(args.verdict)
+                        if args.verdict is not None else None)
+        # the merged dicts carry protocol as the l4 key name
+        # ("TCP"/"UDP"/...) or {"proto": n} for codes without a name
+        want_proto = (PROTO_NAMES.get(args.protocol, args.protocol)
+                      if args.protocol is not None else None)
+
+        def keep(fl) -> bool:
+            if want_verdict is not None \
+                    and fl.get("verdict") != want_verdict:
+                return False
+            if want_proto is not None:
+                l4 = fl.get("l4") or {}
+                if want_proto not in l4 \
+                        and l4.get("proto") != want_proto:
+                    return False
+            if args.port is not None:
+                l4 = next(iter((fl.get("l4") or {}).values()), {})
+                if args.port not in (l4.get("source_port"),
+                                     l4.get("destination_port")):
+                    return False
+            if args.identity is not None:
+                idents = {(fl.get("source") or {}).get("identity"),
+                          (fl.get("destination") or {})
+                          .get("identity")}
+                if args.identity not in idents:
+                    return False
+            if since is not None and fl.get("time", 0) < since:
+                return False
+            return True
+
+        seen_keys = set()
+        try:
+            while True:
+                flows = [fl for fl in c.cluster_flows(
+                    number=args.number, oldest_first=1)
+                    if keep(fl)]
+                if args.json:
+                    _print(flows)
+                else:
+                    for fl in flows:
+                        key = (fl.get("node_name"), fl.get("uuid"))
+                        if key in seen_keys:
+                            continue
+                        seen_keys.add(key)
+                        print(f"{fl.get('time', 0):.3f} "
+                              f"[{fl.get('node_name', '?')}] "
+                              f"{fl.get('Summary', '')}")
+                if not args.follow:
+                    return 0
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
     try:
         while True:
             flows = c.flows(number=args.number, verdict=args.verdict,
@@ -433,6 +537,31 @@ def cmd_top(args) -> int:
     per-identity verdict matrix over the retained windows, and the
     drop-spike state (GET /flows/aggregate)."""
     c = _client(args)
+    if getattr(args, "cluster", False):
+        # `top --cluster`: the relay's merged top-K (sketch sums,
+        # summed error bounds, per-node scrape health)
+        agg = c.cluster_top(top=args.number)
+        if args.json:
+            _print(agg)
+            return 0
+        print("Cluster top (merged across nodes; overcount <= "
+              f"{agg.get('sketch-error-bound', 0)}):")
+        for name, st in (agg.get("nodes") or {}).items():
+            mark = "ok" if st.get("ok") else "STALE"
+            print(f"  {name:<16}{mark:<7}"
+                  f"windows={st.get('windows-closed')} "
+                  + ("[IN SPIKE]" if st.get("spike") else ""))
+        talkers = agg.get("top-talkers") or []
+        if talkers:
+            print(f"{'SRC':<24}{'DST':<24}{'PROTO':<7}"
+                  f"{'PACKETS':>10}{'BYTES':>13}  NODES")
+            for t in talkers[:args.number]:
+                print(f"{t['src'] + ':' + str(t['sport']):<24}"
+                      f"{t['dst'] + ':' + str(t['dport']):<24}"
+                      f"{t['proto']:<7}{t['packets']:>10}"
+                      f"{t['bytes']:>13}  "
+                      f"{','.join(t.get('nodes', []))}")
+        return 0
     try:
         while True:
             agg = c.flows_aggregate(top=args.number)
@@ -1007,9 +1136,11 @@ def main(argv=None) -> int:
     p = sub.add_parser("cluster",
                        help="clustermesh serving tier: status "
                             "(membership, router, failovers, ledger)"
-                            " | scale (live add_node)")
+                            " | scale (live add_node) | sysdump "
+                            "(all-node archive) | trace (stitched "
+                            "cross-process spans)")
     p.add_argument("action", nargs="?", default="status",
-                   choices=["status", "scale"])
+                   choices=["status", "scale", "sysdump", "trace"])
 
     p = sub.add_parser("config", help="config get | set KEY VALUE")
     p.add_argument("action", nargs="?", default="get",
@@ -1043,11 +1174,17 @@ def main(argv=None) -> int:
 
     sub.add_parser("egress", help="egress-gateway rules (expanded)")
     sub.add_parser("map", help="list datapath maps")
-    sub.add_parser("metrics", help="prometheus metrics")
+    p = sub.add_parser("metrics", help="prometheus metrics")
+    p.add_argument("--cluster", action="store_true",
+                   help="the relay's merged cluster exposition "
+                        "(every series node-labelled)")
 
     p = sub.add_parser("flows", help="recent flows (hubble observe); "
                                      "-f tails, filters share the "
                                      "`top` vocabulary")
+    p.add_argument("--cluster", action="store_true",
+                   help="merged time-ordered flows from every "
+                        "cluster node (node_name stamped)")
     p.add_argument("--number", type=int, default=20)
     p.add_argument("--verdict", type=int)
     p.add_argument("--port", type=int)
@@ -1064,6 +1201,9 @@ def main(argv=None) -> int:
                        help="live top talkers + per-identity verdict "
                             "matrix + drop-spike state (the flow "
                             "analytics plane)")
+    p.add_argument("--cluster", action="store_true",
+                   help="top-K merged across every cluster node "
+                        "(sketch sums + summed error bounds)")
     p.add_argument("--follow", "-f", action="store_true")
     p.add_argument("--interval", type=float, default=1.0)
     p.add_argument("--number", type=int, default=10,

@@ -82,7 +82,10 @@ def validate_cluster_config(nodes, forward_depth, probe_interval_s,
                             autoscale_max_nodes=8,
                             autoscale_high_frac=0.5,
                             autoscale_ticks=3,
-                            autoscale_interval_s=0.5):
+                            autoscale_interval_s=0.5,
+                            obs_interval_s=1.0,
+                            obs_stale_after_s=30.0,
+                            trace_sample=0):
     """Normalize + validate the cluster knobs (the serving-knob
     discipline: a typo'd cluster config fails at construction, not as
     a silent misroute under load)."""
@@ -132,10 +135,23 @@ def validate_cluster_config(nodes, forward_depth, probe_interval_s,
     autoscale_interval_s = float(autoscale_interval_s)
     if autoscale_interval_s <= 0:
         raise ValueError("cluster_autoscale_interval_s must be > 0")
+    obs_interval_s = float(obs_interval_s)
+    if obs_interval_s < 0:
+        raise ValueError("cluster_obs_interval_s must be >= 0 "
+                         "(0 disables the periodic scrape; queries "
+                         "then scrape on demand)")
+    obs_stale_after_s = float(obs_stale_after_s)
+    if obs_stale_after_s <= 0:
+        raise ValueError("cluster_obs_stale_after_s must be > 0")
+    trace_sample = int(trace_sample)
+    if trace_sample < 0:
+        raise ValueError("cluster_trace_sample must be >= 0 "
+                         "(0 disables cross-process span stitching)")
     return (nodes, forward_depth, probe_interval_s, death_threshold,
             convergence_deadline_s, kvstore_mode, mode, slot_factor,
             autoscale_max_nodes, autoscale_high_frac, autoscale_ticks,
-            autoscale_interval_s)
+            autoscale_interval_s, obs_interval_s, obs_stale_after_s,
+            trace_sample)
 
 
 def warm_serving_session(daemon, bucket: int, ep: int,
@@ -224,9 +240,16 @@ class ClusterNode:
         self._tracer = None
         self._eventplane = None
 
-    def submit(self, rows: np.ndarray) -> int:
+    def submit(self, rows: np.ndarray, trace=None) -> int:
         # (unannotated on purpose: inherits the router forwarder's
         # affinity; Daemon.submit is any-affine)
+        if trace is not None:
+            # in-process span stitching: recv==frame arrival and
+            # admit==runtime accepted collapse around the direct call
+            trace.t_recv = time.monotonic()
+            n = self.daemon.submit(rows)
+            trace.t_admit = time.monotonic()
+            return n
         return self.daemon.submit(rows)
 
     def probe(self) -> bool:
@@ -332,6 +355,23 @@ class ClusterNode:
     def metrics(self) -> Optional[np.ndarray]:
         return np.asarray(self.daemon.loader.metrics())
 
+    def metrics_text(self) -> Optional[str]:
+        return self.daemon.registry.render()
+
+    # -- node obs interface (the ClusterObsRelay scrape surface;
+    # ProcessNode implements the same methods over the control
+    # channel) ----------------------------------------------------------
+    def obs_scrape(self, cursor: int = 0, flows: int = 512,
+                   top: int = 16) -> dict:
+        # thread-affinity: api, cli
+        return self.daemon.obs_scrape_snapshot(cursor=cursor,
+                                               flows=flows, top=top)
+
+    def sysdump_bundle(self, trigger: str = "cluster-sysdump"
+                       ) -> dict:
+        # thread-affinity: api, cli, capture
+        return self.daemon.flightrec.collect_bundle(trigger=trigger)
+
     def map_pressure(self) -> Optional[dict]:
         return self.daemon.loader.map_pressure(self.daemon._now())
 
@@ -412,7 +452,9 @@ class ClusterServing:
          self.death_threshold, self.convergence_deadline_s,
          self.kvstore_mode, self.mode, self.slot_factor,
          self.autoscale_max_nodes, self.autoscale_high_frac,
-         self.autoscale_ticks, self.autoscale_interval_s
+         self.autoscale_ticks, self.autoscale_interval_s,
+         self.obs_interval_s, self.obs_stale_after_s,
+         self.trace_sample
          ) = validate_cluster_config(
             nodes, template.cluster_forward_depth,
             template.cluster_probe_interval_s,
@@ -425,7 +467,10 @@ class ClusterServing:
             autoscale_high_frac=template.cluster_autoscale_high_frac,
             autoscale_ticks=template.cluster_autoscale_ticks,
             autoscale_interval_s=(
-                template.cluster_autoscale_interval_s))
+                template.cluster_autoscale_interval_s),
+            obs_interval_s=template.cluster_obs_interval_s,
+            obs_stale_after_s=template.cluster_obs_stale_after_s,
+            trace_sample=template.cluster_trace_sample)
         # -- the shared identity/policy plane ---------------------------
         self._kv_server = None
         self._kv_store = None
@@ -502,6 +547,22 @@ class ClusterServing:
         self._started = False
         self._stopped = False
         self._final: Optional[dict] = None
+        # -- the cluster observability relay (ISSUE 14, obs/relay.py):
+        # periodic low-duty scrape of every node's registry/flows/
+        # top-K/tracer/incidents into the merged cluster views, plus
+        # the cross-process span store when trace sampling is armed.
+        # peers_fn reads self.nodes LIVE so scale-out replicas join
+        # the scrape set without registration.
+        from ..obs.relay import ClusterObsRelay, ClusterSpanStore
+
+        self.span_store = (ClusterSpanStore()
+                           if self.trace_sample > 0 else None)
+        self.obs = ClusterObsRelay(
+            peers_fn=lambda: list(self.nodes),
+            interval_s=self.obs_interval_s,
+            stale_after_s=self.obs_stale_after_s,
+            span_store=self.span_store,
+            parent_collect=self._parent_obs_collect)
 
     def _build_node(self, idx: int, name: Optional[str] = None):
         """One replica, either mode — construction (here) is separate
@@ -691,9 +752,12 @@ class ClusterServing:
             n.start_serving(**kwargs)
         self.router = ClusterRouter(self.nodes, self.forward_depth,
                                     on_overflow=self._surface_overflow,
-                                    slot_factor=self.slot_factor)
+                                    slot_factor=self.slot_factor,
+                                    trace_sample=self.trace_sample,
+                                    span_store=self.span_store)
         self.router.start()
         self.membership.start()
+        self.obs.start()  # no-op when cluster_obs_interval_s == 0
         if self._template.cluster_autoscale:
             from .scale import ClusterAutoscaler
 
@@ -733,6 +797,7 @@ class ClusterServing:
             return self._final or self.stats()
         if self.autoscaler is not None:
             self.autoscaler.stop()
+        self.obs.stop()  # the scrape loop must not race teardown
         self.membership.stop()
         if self.router is not None:
             self.router.stop(drain=True)
@@ -776,6 +841,28 @@ class ClusterServing:
             "detect-ms": round((time.monotonic() - t0) * 1e3, 3)})
         recs = self.failover.snapshot()
         return recs[-1] if recs else {}
+
+    # -- cluster observability (ISSUE 14) -------------------------------
+    def _parent_obs_collect(self) -> dict:
+        # thread-affinity: api, cli, capture
+        """The PARENT's bundle half for the cluster sysdump archive:
+        the cluster-level state no single node can see — router +
+        slot table, membership, failover/scale-out history, the
+        cluster ledger, and the relay's own scrape plane."""
+        return {"cluster": self.stats()}
+
+    def cluster_sysdump(self, out_dir: Optional[str] = None) -> dict:
+        # thread-affinity: api, cli, capture
+        """One archive: every node's flight-recorder bundle + the
+        parent's cluster bundle + a manifest (``cilium-sysdump``
+        parity for the serving tier).  ``out_dir`` defaults to the
+        template's ``sysdump_dir``."""
+        out_dir = out_dir or self._template.sysdump_dir
+        if not out_dir:
+            raise ServingError(
+                "cluster sysdump needs a directory: pass out_dir or "
+                "configure sysdump_dir")
+        return self.obs.cluster_sysdump(out_dir)
 
     # -- shed surfacing -------------------------------------------------
     def _surface_overflow(self, idx: int,
@@ -964,6 +1051,7 @@ class ClusterServing:
             "ledger": self.ledger(),
             "failovers": self.failover.snapshot(),
             "scale-outs": list(self.scale_events),
+            "obs": self.obs.stats(),
         }
 
     def status(self) -> dict:

@@ -16,6 +16,12 @@ Topology (all loopback TCP, ``cluster/transport.py`` framing):
   bring-up, endpoint registration, warm-up, serving lifecycle,
   stats/ledger reads, CT snapshot/merge (the failover and scale-out
   migration path), incident/drop surfacing on behalf of the router.
+- OBS channel (ISSUE 14) — the same strict req/resp loop on a THIRD
+  socket + its own worker thread, carrying the relay's scrape and
+  sysdump ops.  Isolation is the point: a slow or timed-out scrape
+  desyncs (and so breaks) only the obs stream — membership probes
+  ride the control channel untouched, so observability can never
+  get a healthy node declared dead.
 - DATA channel — length-prefixed binary row frames (packed
   ``[n, 4]`` u32 when the chunk is pack-eligible, wide
   ``[n, N_COLS]`` otherwise) each answered by a fixed-size ACK
@@ -40,16 +46,50 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 from typing import Optional, Tuple
 
 import numpy as np
 
-from .transport import (decode_rows, pack_ack, recv_frame,
+from .transport import (decode_rows_ex, pack_ack, recv_frame,
                         recv_json_frame, rows_from_b64,
                         rows_to_b64, send_frame, send_json_frame,
                         shutdown_close)
 
-__all__ = ["node_host_main", "connect_channels"]
+__all__ = ["node_host_main", "connect_channels", "OP_TIMEOUTS"]
+
+# The per-op control-RPC timeout bound, in seconds — the parent's
+# ``ProcessNode.call`` defaults to this table, and the CTA011 checker
+# (``analysis/nodehost_lint.py``) statically requires EVERY ``_OPS``
+# entry to have a positive bound here plus a test referencing the op
+# by name: an unbounded control RPC is a wedged-worker hang the
+# membership prober cannot see past, and an untested op is a dead
+# letter the next refactor silently breaks.  READY-class ops (those
+# that may legitimately wait out a worker's whole jax bring-up or a
+# full CT ship) get the long bound; reads get short ones.
+OP_TIMEOUTS = {
+    "ready": 300.0,
+    "probe": 5.0,
+    "add_endpoint": 60.0,
+    "policy_rev": 10.0,
+    "has_identity": 10.0,
+    "start_node": 300.0,
+    "warm": 300.0,
+    "start_serving": 300.0,
+    "front_end": 30.0,
+    "stop_serving": 300.0,
+    "metrics": 30.0,
+    "metricsmap": 30.0,
+    "map_pressure": 30.0,
+    "compile_stats": 30.0,
+    "ct_snapshot": 300.0,
+    "ct_merge": 300.0,
+    "record_incident": 30.0,
+    "publish_drops": 30.0,
+    "obs_scrape": 30.0,
+    "sysdump": 60.0,
+    "shutdown": 30.0,
+}
 
 
 def _jsonable(obj):
@@ -67,20 +107,25 @@ def _jsonable(obj):
     return obj
 
 
-def connect_channels(host: str, port: int, name: str,
-                     token: str) -> Tuple[socket.socket, socket.socket]:
-    """Dial the parent's listener twice (control, then data), each
-    introducing itself with a hello frame — the parent matches hellos
-    to its ``ProcessNode`` handles (spawn order is not arrival
-    order)."""
+def connect_channels(host: str, port: int, name: str, token: str
+                     ) -> Tuple[socket.socket, socket.socket,
+                                socket.socket]:
+    """Dial the parent's listener three times (control, data, obs),
+    each introducing itself with a hello frame — the parent matches
+    hellos to its ``ProcessNode`` handles (spawn order is not
+    arrival order).  The OBS channel (ISSUE 14) carries the relay's
+    scrape/sysdump ops on its own socket + worker thread so a slow
+    or timed-out scrape can NEVER desync the control stream the
+    membership prober depends on — observability must not be able
+    to get a healthy node declared dead."""
     socks = []
-    for role in ("ctrl", "data"):
+    for role in ("ctrl", "data", "obs"):
         s = socket.create_connection((host, port), timeout=30.0)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         send_json_frame(s, {"hello": True, "node": name,
                             "role": role, "token": token})
         socks.append(s)
-    return socks[0], socks[1]
+    return socks[0], socks[1], socks[2]
 
 
 class _NodeHost:
@@ -100,7 +145,9 @@ class _NodeHost:
         self.policy_sync = ClusterPolicySync(self.kv, self.daemon)
         self._ctrl: Optional[socket.socket] = None
         self._data: Optional[socket.socket] = None
+        self._obs: Optional[socket.socket] = None
         self._data_thread: Optional[threading.Thread] = None
+        self._obs_thread: Optional[threading.Thread] = None
         self._final: Optional[dict] = None
         self._stopping = threading.Event()
 
@@ -118,7 +165,13 @@ class _NodeHost:
                 payload = recv_frame(sock)
                 if payload is None:
                     break
-                rows, packed_meta = decode_rows(payload)
+                rows, packed_meta, trace = decode_rows_ex(payload)
+                # ISSUE 14 span stitching: a traced frame gets its
+                # worker-side stage stamps — recv (frame decoded)
+                # and admit (runtime.submit returned) — echoed on
+                # the ack.  One is-None branch when tracing is off.
+                t_recv = time.monotonic() if trace is not None \
+                    else 0.0
                 if packed_meta is not None:
                     ep, dirn = packed_meta
                     rows = unpack_rows_np(rows, ep, dirn)
@@ -130,9 +183,12 @@ class _NodeHost:
                 # monotonic): worst case the ack understates
                 # verdicts by an in-flight batch, which the
                 # crash-loss term absorbs by design
+                echo = ((trace[0], t_recv, time.monotonic())
+                        if trace is not None else None)
                 send_frame(sock, pack_ack(admitted, st.submitted,
                                           st.verdicts, st.shed,
-                                          st.recovery_dropped))
+                                          st.recovery_dropped,
+                                          trace=echo))
         except Exception:  # noqa: BLE001 — torn frame, dead fd, OR
             # a failed decode/submit/ack: the channel contract is
             # dead either way.  CLOSE the socket before exiting —
@@ -239,8 +295,35 @@ class _NodeHost:
         return dict(self._final)
 
     def _op_metrics(self, req: dict) -> dict:
+        """The worker's SELF-DESCRIBING metric surface: the full
+        registry exposition text (ISSUE 14 — this op used to return
+        the raw unlabeled metricsmap array, which made the worker's
+        richest subsystem invisible behind the control channel; the
+        raw array moved to the precisely-named ``metricsmap`` op for
+        the CT-continuity proofs that genuinely want the decoded
+        device counters)."""
+        return {"text": self.daemon.registry.render()}
+
+    def _op_metricsmap(self, req: dict) -> dict:
         return {"metrics": np.asarray(
             self.daemon.loader.metrics()).tolist()}
+
+    def _op_obs_scrape(self, req: dict) -> dict:
+        """One relay scrape — ``Daemon.obs_scrape_snapshot`` holds
+        the one snapshot definition shared with thread-mode
+        ``ClusterNode.obs_scrape``."""
+        return _jsonable(self.daemon.obs_scrape_snapshot(
+            cursor=int(req.get("cursor", 0)),
+            flows=int(req.get("flows", 512)),
+            top=int(req.get("top", 16))))
+
+    def _op_sysdump(self, req: dict) -> dict:
+        """Ship this worker's flight-recorder bundle (size-bounded,
+        assembled in memory — works without a sysdump dir) for the
+        parent's cluster sysdump archive."""
+        return {"bundle": _jsonable(
+            self.daemon.flightrec.collect_bundle(
+                trigger=str(req.get("trigger", "cluster-sysdump"))))}
 
     def _op_map_pressure(self, req: dict) -> dict:
         return {"pressure": _jsonable(
@@ -293,6 +376,9 @@ class _NodeHost:
         "front_end": _op_front_end,
         "stop_serving": _op_stop_serving,
         "metrics": _op_metrics,
+        "metricsmap": _op_metricsmap,
+        "obs_scrape": _op_obs_scrape,
+        "sysdump": _op_sysdump,
         "map_pressure": _op_map_pressure,
         "compile_stats": _op_compile_stats,
         "ct_snapshot": _op_ct_snapshot,
@@ -302,35 +388,60 @@ class _NodeHost:
         "shutdown": _op_shutdown,
     }
 
-    # -- the control loop ----------------------------------------------
+    # -- the op loops ---------------------------------------------------
     # (named control_loop, not serve: the callgraph name-match
     # fallback would otherwise bind loader.serve call sites here)
-    def control_loop(self, ctrl: socket.socket,
-                     data: socket.socket) -> None:
-        # thread-affinity: api -- the worker's control plane
-        self._ctrl, self._data = ctrl, data
+    def _serve_ops(self, sock: socket.socket) -> None:
+        # thread-affinity: api -- one strict request/response loop;
+        # runs on the control thread AND (a second instance) on the
+        # obs thread — the op table is shared, the sockets are not
+        while not self._stopping.is_set():
+            req = recv_json_frame(sock)
+            if req is None:
+                break  # peer hung up
+            op = self._OPS.get(req.get("op"))
+            if op is None:
+                send_json_frame(sock, {
+                    "e": f"unknown op {req.get('op')!r}"})
+                continue
+            try:
+                resp = op(self, req)
+            except Exception as exc:  # noqa: BLE001 — surface to
+                # the parent, keep serving (its retry/abandon call)
+                resp = {"e": f"{type(exc).__name__}: {exc}"}
+            send_json_frame(sock, resp)
+
+    def _obs_loop(self) -> None:
+        # thread-affinity: api -- the worker's OBS plane: scrape and
+        # sysdump ops on their own socket + thread, so a slow scrape
+        # can neither desync the control stream nor park a probe
+        # behind it (observability-induced node death — ISSUE 14
+        # review finding).  A dead obs loop degrades scraping only;
+        # the worker serves on.
         try:
-            while not self._stopping.is_set():
-                req = recv_json_frame(ctrl)
-                if req is None:
-                    break  # parent hung up: die with it
-                op = self._OPS.get(req.get("op"))
-                if op is None:
-                    send_json_frame(ctrl, {
-                        "e": f"unknown op {req.get('op')!r}"})
-                    continue
-                try:
-                    resp = op(self, req)
-                except Exception as exc:  # noqa: BLE001 — surface to
-                    # the parent, keep serving (its retry/abandon call)
-                    resp = {"e": f"{type(exc).__name__}: {exc}"}
-                send_json_frame(ctrl, resp)
+            self._serve_ops(self._obs)
+        except Exception:  # noqa: BLE001 — torn frame/dead fd: the
+            pass  # obs channel is gone, nothing else is
+        finally:
+            shutdown_close(self._obs)
+
+    def control_loop(self, ctrl: socket.socket, data: socket.socket,
+                     obs: socket.socket) -> None:
+        # thread-affinity: api -- the worker's control plane
+        self._ctrl, self._data, self._obs = ctrl, data, obs
+        self._obs_thread = threading.Thread(
+            target=self._obs_loop, daemon=True,
+            name=f"nodehost-obs-{self.name}")
+        self._obs_thread.start()
+        try:
+            self._serve_ops(ctrl)
         finally:
             self.close()
 
     def close(self) -> None:
         self._stopping.set()
         shutdown_close(self._data)
+        shutdown_close(self._obs)
         shutdown_close(self._ctrl)
         try:
             self.policy_sync.close()
@@ -351,7 +462,7 @@ def node_host_main(host: str, port: int, token: str, name: str,
     """The spawn target: dial home, build the daemon world, serve
     until the parent says shutdown (or the control channel dies —
     an orphaned worker must not outlive its cluster)."""
-    ctrl, data = connect_channels(host, port, name, token)
+    ctrl, data, obs = connect_channels(host, port, name, token)
     try:
         node = _NodeHost(name, cfg_fields, kv_addr)
     except Exception as exc:  # noqa: BLE001 — a worker that cannot
@@ -364,6 +475,7 @@ def node_host_main(host: str, port: int, token: str, name: str,
         except OSError:
             pass
         shutdown_close(data)
+        shutdown_close(obs)
         shutdown_close(ctrl)
         raise
-    node.control_loop(ctrl, data)
+    node.control_loop(ctrl, data, obs)

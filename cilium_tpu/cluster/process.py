@@ -41,16 +41,19 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..serving import ServingError
+from .nodehost import OP_TIMEOUTS
 from .transport import (encode_rows, recv_frame, recv_json_frame,
                         rows_from_b64, rows_to_b64, send_frame,
                         send_json_frame, shutdown_close,
-                        unpack_ack)
+                        unpack_ack_ex)
 
 __all__ = ["ProcessNode", "ProcessNodeSpawner", "spawn_available"]
 
 # one RPC may legitimately take this long (a worker's first RPC waits
 # out its whole jax+daemon bring-up)
 READY_TIMEOUT_S = 300.0
+# the fallback bound for an op missing from nodehost.OP_TIMEOUTS —
+# CTA011 keeps that table total, so this only covers test fakes
 CTRL_TIMEOUT_S = 60.0
 
 
@@ -91,7 +94,12 @@ class ProcessNodeSpawner:
 
         from .nodehost import node_host_main
 
-        cfg_fields = dataclasses.asdict(config)
+        # the worker's daemon must self-identify as ITS node (thread
+        # mode does the same via dataclasses.replace): the flight
+        # recorder stamps bundles with it, and a cluster sysdump
+        # where every worker claims to be node0 is unusable
+        cfg_fields = dataclasses.asdict(
+            dataclasses.replace(config, node_name=name))
         ctx = mp.get_context("spawn")
         proc = ctx.Process(
             target=node_host_main,
@@ -102,12 +110,13 @@ class ProcessNodeSpawner:
         return ProcessNode(name, proc, self)
 
     def accept_channels(self, name: str, timeout: float = 60.0
-                        ) -> Tuple[socket.socket, socket.socket]:
-        """Accept until both of ``name``'s channels arrived (workers
-        race; hellos disambiguate)."""
+                        ) -> Tuple[socket.socket, socket.socket,
+                                   socket.socket]:
+        """Accept until all three of ``name``'s channels arrived
+        (workers race; hellos disambiguate)."""
         got: Dict[str, socket.socket] = {}
         deadline = time.monotonic() + timeout
-        while "ctrl" not in got or "data" not in got:
+        while not {"ctrl", "data", "obs"} <= set(got):
             self._sock.settimeout(max(deadline - time.monotonic(),
                                       0.01))
             try:
@@ -123,13 +132,14 @@ class ProcessNodeSpawner:
                 continue
             if (not hello or hello.get("token") != self.token
                     or hello.get("node") != name
-                    or hello.get("role") not in ("ctrl", "data")):
+                    or hello.get("role") not in ("ctrl", "data",
+                                                 "obs")):
                 shutdown_close(sock)
                 continue
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             got[hello["role"]] = sock
-        return got["ctrl"], got["data"]
+        return got["ctrl"], got["data"], got["obs"]
 
     def close(self) -> None:
         shutdown_close(self._sock)
@@ -156,9 +166,16 @@ class ProcessNode:
         self._spawner = spawner
         self._lock = threading.Lock()
         self._ctrl_lock = threading.Lock()
+        # the OBS channel gets its own socket + lock + broken flag:
+        # a slow/timed-out scrape desyncs (and so breaks) only the
+        # obs stream — membership probes ride ctrl untouched, so
+        # observability can never get a healthy node declared dead
+        self._obs_lock = threading.Lock()
         self._ctrl: Optional[socket.socket] = None
         self._data: Optional[socket.socket] = None
+        self._obs: Optional[socket.socket] = None
         self._ctrl_broken: Optional[str] = None
+        self._obs_broken: Optional[str] = None
         self.alive = True
         self.final: Optional[dict] = None
         self.kv_client = None  # the worker owns its kv client
@@ -173,23 +190,36 @@ class ProcessNode:
 
     # -- bring-up ------------------------------------------------------
     def attach(self, timeout: float = 60.0) -> None:
-        self._ctrl, self._data = self._spawner.accept_channels(
-            self.name, timeout)
+        (self._ctrl, self._data,
+         self._obs) = self._spawner.accept_channels(self.name,
+                                                    timeout)
 
     def wait_ready(self, timeout: float = READY_TIMEOUT_S) -> None:
         self.call("ready", timeout=timeout)
 
-    # -- control RPC ---------------------------------------------------
-    def call(self, op: str, timeout: float = CTRL_TIMEOUT_S,
-             **args) -> dict:
-        # thread-affinity: any -- _ctrl_lock serializes callers
-        # (control-plane threads and any-affine readers alike)
-        with self._ctrl_lock:
-            if self._ctrl_broken is not None:
+    # -- control / obs RPC ---------------------------------------------
+    def _rpc(self, channel: str, op: str,
+             timeout: Optional[float], args: dict) -> dict:
+        # thread-affinity: any -- the per-channel lock serializes
+        # callers (control-plane threads and any-affine readers
+        # alike); a broken channel fails every later call fast (the
+        # byte stream lost sync)
+        if timeout is None:
+            # the per-op bound table (nodehost.OP_TIMEOUTS, CTA011-
+            # enforced total): every control RPC is bounded even
+            # when the caller states no deadline of its own
+            timeout = OP_TIMEOUTS.get(op, CTRL_TIMEOUT_S)
+        lock = self._obs_lock if channel == "obs" \
+            else self._ctrl_lock
+        broken_attr = ("_obs_broken" if channel == "obs"
+                       else "_ctrl_broken")
+        with lock:
+            broken = getattr(self, broken_attr)
+            if broken is not None:
                 raise ServingError(
-                    f"control channel to {self.name} broken: "
-                    f"{self._ctrl_broken}")
-            sock = self._ctrl
+                    f"{channel} channel to {self.name} broken: "
+                    f"{broken}")
+            sock = self._obs if channel == "obs" else self._ctrl
             if sock is None:
                 raise ServingError(
                     f"worker {self.name} not attached")
@@ -201,12 +231,13 @@ class ProcessNode:
                 resp = recv_json_frame(sock)
             except Exception as exc:  # noqa: BLE001 — timeout, EOF,
                 # torn frame: the stream lost sync either way
-                self._ctrl_broken = f"{type(exc).__name__}: {exc}"
+                setattr(self, broken_attr,
+                        f"{type(exc).__name__}: {exc}")
                 raise ServingError(
-                    f"control call {op!r} to {self.name} failed: "
-                    f"{self._ctrl_broken}") from None
+                    f"{channel} call {op!r} to {self.name} failed: "
+                    f"{getattr(self, broken_attr)}") from None
             if resp is None:
-                self._ctrl_broken = "EOF"
+                setattr(self, broken_attr, "EOF")
                 raise ServingError(
                     f"worker {self.name} hung up mid-call ({op})")
             if "e" in resp:
@@ -214,32 +245,52 @@ class ProcessNode:
                     f"worker {self.name} {op} error: {resp['e']}")
             return resp
 
+    def call(self, op: str, timeout: Optional[float] = None,
+             **args) -> dict:
+        return self._rpc("ctrl", op, timeout, args)
+
+    def obs_call(self, op: str, timeout: Optional[float] = None,
+                 **args) -> dict:
+        """Observability RPC on the DEDICATED obs channel: a scrape
+        that times out breaks only this stream — probes and failover
+        control keep their own (ISSUE 14 review hardening)."""
+        return self._rpc("obs", op, timeout, args)
+
     # -- the ClusterNode interface ------------------------------------
-    def submit(self, rows: np.ndarray) -> int:
+    def submit(self, rows: np.ndarray, trace=None) -> int:
         # (unannotated on purpose: inherits the router forwarder's
         # affinity, like ClusterNode.submit — the socket leg is the
         # transport domain's territory via the framing helpers)
         """Forward one chunk over the data channel and wait for the
         ack (one outstanding frame per node by construction — the
         per-node forwarder is the only caller).  Packs eligible
-        single-stream chunks to the 16 B/packet wire."""
+        single-stream chunks to the 16 B/packet wire.  ``trace``
+        (an ``obs.relay.TraceCtx`` with t_enq/t_fwd stamped) rides
+        the frame; the worker's recv/admit stamps come back on the
+        ack echo (ISSUE 14 cross-process span stitching)."""
         from ..core.packets import pack_eligibility, pack_rows
 
         sock = self._data
         if sock is None:
             raise ServingError(f"worker {self.name} not attached")
+        wire_trace = ((trace.trace_id, trace.t_enq, trace.t_fwd)
+                      if trace is not None else None)
         ok, ep, dirn = pack_eligibility(rows)
         if ok:
             payload = encode_rows(pack_rows(rows),
-                                  packed_meta=(ep, dirn))
+                                  packed_meta=(ep, dirn),
+                                  trace=wire_trace)
         else:
-            payload = encode_rows(rows)
+            payload = encode_rows(rows, trace=wire_trace)
         send_frame(sock, payload)
         ack = recv_frame(sock)
         if ack is None:
             raise ServingError(
                 f"worker {self.name} closed the data channel")
-        admitted, sub, ver, shed, rec = unpack_ack(ack)
+        (admitted, sub, ver, shed, rec), echo = unpack_ack_ex(ack)
+        if trace is not None and echo is not None \
+                and echo[0] == trace.trace_id:
+            trace.t_recv, trace.t_admit = echo[1], echo[2]
         with self._lock:
             self._last_ack = (sub, ver, shed, rec)
             self._frames += 1
@@ -291,9 +342,12 @@ class ProcessNode:
         except Exception:  # noqa: BLE001 — already gone
             pass
         shutdown_close(self._data)
+        shutdown_close(self._obs)
         shutdown_close(self._ctrl)
         with self._ctrl_lock:
             self._ctrl_broken = f"killed: {cause}"
+        with self._obs_lock:
+            self._obs_broken = f"killed: {cause}"
         self.proc.join(timeout=10.0)
 
     def take_crash_loss(self) -> int:
@@ -434,10 +488,32 @@ class ProcessNode:
 
     def metrics(self) -> Optional[np.ndarray]:
         try:
-            return np.asarray(self.call("metrics",
+            return np.asarray(self.call("metricsmap",
                                         timeout=30.0)["metrics"])
         except ServingError:
             return None
+
+    def metrics_text(self) -> Optional[str]:
+        """The worker's self-describing registry exposition (the
+        ``metrics`` op's ISSUE 14 shape)."""
+        try:
+            return self.call("metrics", timeout=30.0)["text"]
+        except ServingError:
+            return None
+
+    # -- node obs interface (the relay's scrape surface) ---------------
+    def obs_scrape(self, cursor: int = 0, flows: int = 512,
+                   top: int = 16) -> dict:
+        """One observability scrape over the DEDICATED obs channel —
+        raises on failure (the relay counts it and serves
+        last-known-good; swallowing here would make a dead worker
+        look healthily empty)."""
+        return self.obs_call("obs_scrape", cursor=int(cursor),
+                             flows=int(flows), top=int(top))
+
+    def sysdump_bundle(self, trigger: str = "cluster-sysdump"
+                       ) -> dict:
+        return self.obs_call("sysdump", trigger=trigger)["bundle"]
 
     def map_pressure(self) -> Optional[dict]:
         try:
@@ -467,6 +543,7 @@ class ProcessNode:
             except ServingError:
                 pass
         shutdown_close(self._data)
+        shutdown_close(self._obs)
         shutdown_close(self._ctrl)
         self.proc.join(timeout=30.0)
         if self.proc.is_alive():

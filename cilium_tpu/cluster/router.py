@@ -108,12 +108,13 @@ class ClusterRouter:
     # guarded-by: _lock: _oflow_rows, _oflow_n, _stopping, submitted,
     # guarded-by: _lock: router_overflow, failover_dropped, forwarded,
     # guarded-by: _lock: _suspect, crash_dropped, _frozen, _inflight,
-    # guarded-by: _lock: forward_latency
+    # guarded-by: _lock: forward_latency, _nchunks
 
     def __init__(self, nodes: Sequence, forward_depth: int,
                  on_overflow: Optional[OverflowFn] = None,
                  shed_retain: int = SHED_RETAIN,
-                 slot_factor: int = SLOT_FACTOR):
+                 slot_factor: int = SLOT_FACTOR,
+                 trace_sample: int = 0, span_store=None):
         if not nodes:
             raise ValueError("cluster router needs at least one node")
         self.nodes = list(nodes)
@@ -162,6 +163,14 @@ class ClusterRouter:
         # enqueue -> delivered µs (queue wait + node submit / socket
         # round trip): the bench's forward-path percentiles
         self.forward_latency = LatencyHistogram()
+        # ISSUE 14 cross-process span stitching: every trace_sample'th
+        # APPENDED chunk carries a TraceCtx through the forward path
+        # (frame + ack echo in process mode); completed spans land in
+        # span_store (obs/relay.ClusterSpanStore).  0 = off — the
+        # hot-path cost is one int compare per appended chunk.
+        self._trace_sample = int(trace_sample)
+        self.span_store = span_store
+        self._nchunks = 0
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> None:
@@ -199,8 +208,10 @@ class ClusterRouter:
                     with self._cv:
                         if not self._chunks[idx]:
                             break
-                        chunk, _t_enq = self._chunks[idx].pop(0)
+                        chunk, _t_enq, ctx = self._chunks[idx].pop(0)
                         self._pending[idx] -= len(chunk)
+                    if ctx is not None and self.span_store is not None:
+                        self.span_store.drop_span(ctx)  # span lost at stop
                     node = self.nodes[idx]
                     try:
                         node.submit(chunk)
@@ -270,8 +281,16 @@ class ClusterRouter:
                 space = self.forward_depth - self._pending[o]
                 take = min(max(space, 0), len(sub))
                 if take:
+                    ctx = None
+                    if self._trace_sample > 0 \
+                            and self.span_store is not None:
+                        if self._nchunks % self._trace_sample == 0:
+                            ctx = self.span_store.allocate_span(
+                                take, t_enq)
+                        self._nchunks += 1
                     self._chunks[o].append(
-                        (np.array(sub[:take], copy=True), t_enq))
+                        (np.array(sub[:take], copy=True), t_enq,
+                         ctx))
                     self._pending[o] += take
                     admitted += take
                 lost = len(sub) - take
@@ -303,27 +322,41 @@ class ClusterRouter:
                         self._suspect[idx] = False  # healed
                 if self._stopping:
                     return
-                chunk = t_enq = None
+                chunk = t_enq = ctx = None
                 if self._chunks[idx]:
-                    chunk, t_enq = self._chunks[idx].pop(0)
+                    chunk, t_enq, ctx = self._chunks[idx].pop(0)
                     self._pending[idx] -= len(chunk)
                     self._inflight[idx] = len(chunk)
                 oflow_rows, oflow_n = self._take_oflow_locked(idx)
             if chunk is not None:
                 try:
-                    node.submit(chunk)
+                    if ctx is not None:
+                        # span stitching: stamp the forward stage and
+                        # ride the chunk; the node fills recv/admit
+                        # (ack echo in process mode, direct stamps
+                        # in thread mode)
+                        ctx.node = node.name
+                        ctx.t_fwd = time.monotonic()
+                        node.submit(chunk, trace=ctx)
+                    else:
+                        node.submit(chunk)
                     with self._cv:
                         self.forwarded[idx] += len(chunk)
                         self._inflight[idx] = 0
                         self.forward_latency.record(
                             (time.monotonic() - t_enq) * 1e6)
                         self._cv.notify_all()
+                    if ctx is not None:
+                        ctx.t_ack = time.monotonic()
+                        # commit counts an echo-less span as dropped
+                        self.span_store.commit_span(ctx)
                 except Exception:  # noqa: BLE001 — crashed/terminal
                     # node: requeue AT THE FRONT and park as suspect;
                     # failover's queue migration (or stop's drain)
                     # claims the chunk with its loss accounted
                     with self._cv:
-                        self._chunks[idx].insert(0, (chunk, t_enq))
+                        self._chunks[idx].insert(0, (chunk, t_enq,
+                                                     ctx))
                         self._pending[idx] += len(chunk)
                         self._inflight[idx] = 0
                         self._suspect[idx] = True
@@ -374,7 +407,7 @@ class ClusterRouter:
             self._owner_arr = np.asarray(self._slot_owner,
                                          dtype=np.int64)
             while self._chunks[dead_idx]:
-                chunk, t_enq = self._chunks[dead_idx].pop(0)
+                chunk, t_enq, ctx = self._chunks[dead_idx].pop(0)
                 self._pending[dead_idx] -= len(chunk)
                 take = 0
                 if peer_idx is not None:
@@ -382,10 +415,19 @@ class ClusterRouter:
                              - self._pending[peer_idx])
                     take = min(max(space, 0), len(chunk))
                 if take:
+                    # a WHOLLY-moved chunk keeps its trace ctx (the
+                    # span completes on the peer); a split one drops
+                    # it — half a chunk's hop timings would lie
                     self._chunks[peer_idx].append(
-                        (chunk[:take], t_enq))
+                        (chunk[:take], t_enq,
+                         ctx if take == len(chunk) else None))
                     self._pending[peer_idx] += take
                     moved += take
+                    if ctx is not None and take != len(chunk) \
+                            and self.span_store is not None:
+                        self.span_store.drop_span(ctx)
+                elif ctx is not None and self.span_store is not None:
+                    self.span_store.drop_span(ctx)
                 lost = len(chunk) - take
                 if lost:
                     self.failover_dropped += lost
@@ -530,4 +572,6 @@ class ClusterRouter:
                     "max": round(lat.max_us, 1),
                     "count": lat.count,
                 },
+                "trace": (self.span_store.span_stats()
+                          if self.span_store is not None else None),
             }

@@ -34,6 +34,17 @@ pieces out so BOTH transports run one implementation:
   carrying the receiving node's running packet ledger — the piece
   that lets the cluster ledger close EXACTLY over a SIGKILLed
   worker (``cluster/process.py``).
+- CROSS-PROCESS TRACE CONTEXT (ISSUE 14): a 1-in-N sampled forward
+  frame carries ``(trace_id, t_enqueue, t_forward)`` router-side
+  stamps ahead of its rows (the TRACED frame kinds), and its ACK
+  echoes ``(trace_id, t_recv, t_admit)`` worker-side stamps back —
+  the router stitches one span (router-queue -> forward ->
+  worker-admit -> ack) with per-hop latency
+  (``obs/relay.ClusterSpanStore``).  Timestamps are
+  ``time.monotonic()`` on BOTH ends: on Linux that is the
+  machine-wide CLOCK_MONOTONIC, so stamps from the parent and a
+  worker process on the same host compare directly (the repo's
+  cluster is same-host loopback by construction — DIVERGENCES #26).
 
 THREAD AFFINITY: the ``transport`` domain (CTA002 vocabulary, a
 CTA003 hot domain like ``drain``/``router``) covers the threads that
@@ -55,9 +66,10 @@ import numpy as np
 __all__ = [
     "FrameError", "LineFramer", "shutdown_close",
     "send_frame", "recv_frame", "send_json_frame", "recv_json_frame",
-    "encode_rows", "decode_rows", "pack_ack", "unpack_ack",
+    "encode_rows", "decode_rows", "decode_rows_ex",
+    "pack_ack", "unpack_ack", "unpack_ack_ex",
     "rows_to_b64", "rows_from_b64",
-    "MAX_FRAME", "ACK_SIZE",
+    "MAX_FRAME", "ACK_SIZE", "ACK_TRACED_SIZE",
 ]
 
 # frame length prefix: 4-byte big-endian unsigned
@@ -73,11 +85,22 @@ MAX_FRAME = 1 << 24
 # module doc and cluster/process.py
 _ACK = struct.Struct(">IQQQQ")
 ACK_SIZE = _ACK.size
+# traced ACK: the plain ACK followed by the trace echo
+# (trace_id u64, t_recv f64, t_admit f64) — only on frames that
+# carried trace context; the two sizes disambiguate on the wire
+_ACK_TRACE = struct.Struct(">Qdd")
+ACK_TRACED_SIZE = ACK_SIZE + _ACK_TRACE.size
 
 # row-frame payload kinds
 _ROWS_WIDE = 1  # [n, cols] u32 header rows
 _ROWS_PACKED = 2  # [n, 4] u32 packed rows + (ep, dirn) stream scalars
+# traced variants: same layout with a trace-context block
+# (trace_id u64, t_enqueue f64, t_forward f64) between the fixed
+# header and the rows (ISSUE 14 cross-process trace stitching)
+_ROWS_WIDE_TRACED = 3
+_ROWS_PACKED_TRACED = 4
 _ROWS_HDR = struct.Struct(">BIIII")  # kind, n, cols, ep, dirn
+_TRACE_HDR = struct.Struct(">Qdd")  # trace_id, t_enq, t_fwd
 
 
 class FrameError(Exception):
@@ -197,53 +220,81 @@ def recv_json_frame(sock: socket.socket,
 
 # -- row batches -------------------------------------------------------
 def encode_rows(rows: np.ndarray,
-                packed_meta: Optional[Tuple[int, int]] = None) -> bytes:
+                packed_meta: Optional[Tuple[int, int]] = None,
+                trace: Optional[Tuple[int, float, float]] = None
+                ) -> bytes:
     # thread-affinity: transport, router
     """Row batch -> frame payload.  ``packed_meta=(ep, dirn)`` marks
     ``rows`` as packed ``[n, 4]`` u32 (the 16 B/packet wire format —
     the stream scalars ride the header); otherwise wide
-    ``[n, cols]`` u32."""
+    ``[n, cols]`` u32.  ``trace=(trace_id, t_enq, t_fwd)`` makes the
+    frame a TRACED one: the receiver stamps its own stages and
+    echoes the trace id on the ack (cross-process span stitching)."""
     rows = np.ascontiguousarray(rows, dtype=np.uint32)
     if rows.ndim != 2:
         raise ValueError(f"rows must be 2-D, got shape {rows.shape}")
     if packed_meta is not None:
         ep, dirn = packed_meta
-        kind = _ROWS_PACKED
+        kind = (_ROWS_PACKED_TRACED if trace is not None
+                else _ROWS_PACKED)
     else:
         ep = dirn = 0
-        kind = _ROWS_WIDE
+        kind = (_ROWS_WIDE_TRACED if trace is not None
+                else _ROWS_WIDE)
     hdr = _ROWS_HDR.pack(kind, rows.shape[0], rows.shape[1],
                          int(ep), int(dirn))
+    if trace is not None:
+        tid, t_enq, t_fwd = trace
+        hdr += _TRACE_HDR.pack(int(tid), float(t_enq), float(t_fwd))
     return hdr + rows.tobytes()
 
 
-def decode_rows(payload: bytes
-                ) -> Tuple[np.ndarray, Optional[Tuple[int, int]]]:
+def decode_rows_ex(payload: bytes) -> Tuple[
+        np.ndarray, Optional[Tuple[int, int]],
+        Optional[Tuple[int, float, float]]]:
     # thread-affinity: transport, any
-    """Frame payload -> (rows, packed_meta or None).  Raises
-    :class:`FrameError` when the declared shape disagrees with the
-    byte count (a torn or corrupted frame must not become a
-    misshapen submit)."""
+    """Frame payload -> (rows, packed_meta or None, trace context or
+    None).  Raises :class:`FrameError` when the declared shape
+    disagrees with the byte count (a torn or corrupted frame must
+    not become a misshapen submit)."""
     if len(payload) < _ROWS_HDR.size:
         raise FrameError(
             f"row frame of {len(payload)} bytes is shorter than its "
             f"header ({_ROWS_HDR.size})")
     kind, n, cols, ep, dirn = _ROWS_HDR.unpack_from(payload)
-    if kind not in (_ROWS_WIDE, _ROWS_PACKED):
+    if kind not in (_ROWS_WIDE, _ROWS_PACKED,
+                    _ROWS_WIDE_TRACED, _ROWS_PACKED_TRACED):
         raise FrameError(f"unknown row-frame kind {kind}")
+    off = _ROWS_HDR.size
+    trace = None
+    if kind in (_ROWS_WIDE_TRACED, _ROWS_PACKED_TRACED):
+        if len(payload) < off + _TRACE_HDR.size:
+            raise FrameError(
+                "traced row frame is shorter than its trace block")
+        trace = _TRACE_HDR.unpack_from(payload, off)
+        off += _TRACE_HDR.size
     want = n * cols * 4
-    body = payload[_ROWS_HDR.size:]
+    body = payload[off:]
     if len(body) != want:
         raise FrameError(
             f"row frame declares [{n}, {cols}] u32 ({want} bytes) "
             f"but carries {len(body)}")
     rows = np.frombuffer(body, dtype=np.uint32).reshape(n, cols)
-    if kind == _ROWS_PACKED:
+    if kind in (_ROWS_PACKED, _ROWS_PACKED_TRACED):
         if cols != 4:
             raise FrameError(
                 f"packed row frame must be [n, 4], got [{n}, {cols}]")
-        return rows, (ep, dirn)
-    return rows, None
+        return rows, (ep, dirn), trace
+    return rows, None, trace
+
+
+def decode_rows(payload: bytes
+                ) -> Tuple[np.ndarray, Optional[Tuple[int, int]]]:
+    # thread-affinity: transport, any
+    """The pre-trace two-tuple surface (rows, packed_meta or None);
+    traced frames decode fine — the context is simply dropped."""
+    rows, packed_meta, _trace = decode_rows_ex(payload)
+    return rows, packed_meta
 
 
 # -- control-channel row encoding (CT snapshots/merges) ----------------
@@ -269,20 +320,43 @@ def rows_from_b64(obj: dict) -> np.ndarray:
 
 # -- the data-channel ACK ----------------------------------------------
 def pack_ack(admitted: int, submitted: int, verdicts: int,
-             shed: int, recovery_dropped: int) -> bytes:
+             shed: int, recovery_dropped: int,
+             trace: Optional[Tuple[int, float, float]] = None
+             ) -> bytes:
     # thread-affinity: transport
     """ACK for one row frame: how many rows the node ADMITTED, plus
     its running packet-ledger counters as of the ack.  The parent
     retains the newest ack per node; a SIGKILLed worker's final word
     is its last ack, which is exactly what lets the cluster ledger
-    close over the corpse (``cluster/process.py``)."""
-    return _ACK.pack(int(admitted), int(submitted), int(verdicts),
+    close over the corpse (``cluster/process.py``).
+    ``trace=(trace_id, t_recv, t_admit)`` echoes a traced frame's
+    worker-side stage stamps (span stitching)."""
+    body = _ACK.pack(int(admitted), int(submitted), int(verdicts),
                      int(shed), int(recovery_dropped))
+    if trace is not None:
+        tid, t_recv, t_admit = trace
+        body += _ACK_TRACE.pack(int(tid), float(t_recv),
+                                float(t_admit))
+    return body
+
+
+def unpack_ack_ex(payload: bytes) -> Tuple[
+        Tuple[int, int, int, int, int],
+        Optional[Tuple[int, float, float]]]:
+    # thread-affinity: transport, router
+    """ACK payload -> (ledger 5-tuple, trace echo or None)."""
+    if len(payload) == _ACK.size:
+        return _ACK.unpack(payload), None
+    if len(payload) == ACK_TRACED_SIZE:
+        return (_ACK.unpack_from(payload),
+                _ACK_TRACE.unpack_from(payload, _ACK.size))
+    raise FrameError(
+        f"ack frame is {len(payload)} bytes, want {_ACK.size} "
+        f"or {ACK_TRACED_SIZE}")
 
 
 def unpack_ack(payload: bytes) -> Tuple[int, int, int, int, int]:
     # thread-affinity: transport, router
-    if len(payload) != _ACK.size:
-        raise FrameError(
-            f"ack frame is {len(payload)} bytes, want {_ACK.size}")
-    return _ACK.unpack(payload)
+    """The pre-trace five-tuple surface (trace echo dropped)."""
+    ledger, _trace = unpack_ack_ex(payload)
+    return ledger

@@ -208,6 +208,24 @@ class BinaryObserverClient:
                                        blacklist=blacklist)
         return [decode_message(raw) for raw in self._get(req)]
 
+    def get_flow_dicts(self, number: int = 100,
+                       whitelist: Sequence[dict] = (),
+                       blacklist: Sequence[dict] = ()) -> List[dict]:
+        """GetFlows decoded to hubble-JSON-shaped dicts with NATIVE
+        drop-reason fidelity (``flow/proto.decode_flow`` prefers the
+        field-3 native code over the lossy field-25 enum) — the
+        relay-peer surface over the binary wire: a Relay fed these
+        merges flows whose repo-native drop reasons survive the
+        round trip (DIVERGENCES #15 caveat, closed)."""
+        from .proto import decode_flow
+
+        out = []
+        for msg in self.get_flows(number=number, whitelist=whitelist,
+                                  blacklist=blacklist):
+            if 1 in msg:
+                out.append(decode_flow(msg[1][-1]))
+        return out
+
     def server_status(self) -> dict:
         from .proto import decode_message
 

@@ -249,6 +249,33 @@ class Observer:
             idx = idx[:number]
             return [self._materialize(i) for i in idx]
 
+    def flows_since(self, cursor: int, limit: int = 512
+                    ) -> Tuple[List[Flow], int]:
+        # thread-affinity: api, cli, capture, offline
+        """The since-cursor ring TAIL (ISSUE 14 cluster relay): every
+        flow whose ``flow_seq`` is >= ``cursor``, oldest first,
+        newest ``limit`` kept when the tail outgrew it, plus the new
+        cursor (``seq`` high-water — pass it back next time).  Flows
+        that lapped out of the ring between scrapes are simply gone
+        (the ring's standing newest-wins contract); the cursor jump
+        makes the gap visible to the caller."""
+        with self._lock:
+            new_cursor = self.seq
+            n = len(self)
+            if n == 0 or cursor >= new_cursor:
+                return [], new_cursor
+            if self.seq <= self.capacity:
+                idx = np.arange(n)
+            else:
+                start = self.seq % self.capacity
+                idx = (start + np.arange(self.capacity)) \
+                    % self.capacity
+            keep = self.flow_seq[idx] >= cursor
+            idx = idx[keep]
+            if limit and len(idx) > limit:
+                idx = idx[-limit:]  # the newest `limit`, time order
+            return [self._materialize(i) for i in idx], new_cursor
+
     def _materialize(self, i: int) -> Flow:
         # holds: _lock -- called from get_flows' locked region only
         f = materialize_flow(

@@ -118,8 +118,14 @@ VERDICT_WIRE_TO_INTERNAL = {1: (1,), 2: (0, 2), 5: (3,)}
 
 # enum DropReason: internal reason codes -> flow.proto values.  The
 # reference's bpf DROP_* space starts at 130; POLICY_DENIED is 133.
-# Reasons without an upstream value travel as 0 (UNKNOWN) on the wire
-# while the JSON surface keeps the precise name.
+# Reasons without an upstream value travel as 0 (UNKNOWN) in the
+# field-25 ENUM — but the NATIVE code always rides field 3 (the
+# deprecated uint32 ``drop_reason``, numerically below the bpf
+# DROP_* floor so it cannot collide with an upstream value), and
+# :func:`decode_flow` prefers it, so relay-merged flows decoded from
+# the binary wire keep full drop-reason fidelity (the DIVERGENCES
+# #15 caveat, closed in ISSUE 14).  A stock hubble reader that only
+# looks at field 25 still sees a valid (if generic) enum value.
 DROP_REASON_WIRE = {1: 133, 2: 133, 3: 0, 4: 0, 5: 0, 6: 0, 7: 0,
                     8: 0, 9: 0, 10: 0, 11: 0, 12: 0}
 
@@ -342,4 +348,86 @@ def decode_get_flows_request(data: bytes) -> dict:
         out["blacklist"] = _filters(msg[4])
     if 5 in msg:
         out["whitelist"] = _filters(msg[5])
+    return out
+
+
+# wire Verdict -> hubble JSON verdict name (decode side)
+_VERDICT_WIRE_NAMES = {1: "FORWARDED", 2: "DROPPED", 5: "REDIRECTED"}
+
+
+def _decode_endpoint(raw: bytes) -> dict:
+    m = decode_message(raw)
+    out: dict = {"identity": int(m.get(2, [0])[-1])}
+    labels = [b.decode() for b in m.get(4, [])]
+    if labels:
+        out["labels"] = labels
+    if 5 in m:
+        pod = m[5][-1].decode()
+        ns = m[3][-1].decode() if 3 in m else ""
+        out["podName"] = f"{ns}/{pod}" if ns else pod
+    if 1 in m:
+        out["ID"] = int(m[1][-1])
+    return out
+
+
+def decode_flow(raw: bytes) -> dict:
+    """One encoded ``Flow`` message -> the hubble-JSON-shaped dict
+    ``Flow.to_dict`` produces, with NATIVE drop-reason fidelity: the
+    native reason code rides field 3 (the deprecated uint32
+    ``drop_reason``) and is preferred over the field-25 enum, so a
+    repo-native reason (ingress shed, dispatch timeout, cluster
+    overflow, NAT exhaustion...) decoded off the binary wire renders
+    its precise name instead of UNKNOWN(0) — the DIVERGENCES #15
+    caveat, closed.  Used by ``BinaryObserverClient.get_flow_dicts``
+    (the relay-peer surface over the binary wire)."""
+    from .flow import DROP_REASON_DESC
+
+    m = decode_message(raw)
+    out: dict = {}
+    if 1 in m:
+        t = decode_message(m[1][-1])
+        out["time"] = (int(t.get(1, [0])[-1])
+                       + int(t.get(2, [0])[-1]) / 1e9)
+    out["verdict"] = _VERDICT_WIRE_NAMES.get(
+        int(m.get(2, [0])[-1]), "VERDICT_UNKNOWN")
+    if 5 in m:
+        ip = decode_message(m[5][-1])
+        out["IP"] = {
+            "source": (ip[1][-1].decode() if 1 in ip else ""),
+            "destination": (ip[2][-1].decode() if 2 in ip else ""),
+        }
+    if 8 in m:
+        out["source"] = _decode_endpoint(m[8][-1])
+    if 9 in m:
+        out["destination"] = _decode_endpoint(m[9][-1])
+    out["Type"] = ("L7" if int(m.get(10, [1])[-1]) == FLOW_TYPE_L7
+                   else "L3_L4")
+    if 11 in m:
+        out["node_name"] = m[11][-1].decode()
+    if 19 in m:
+        et = decode_message(m[19][-1])
+        out["event_type"] = {"type": int(et.get(1, [0])[-1])}
+    out["traffic_direction"] = (
+        "EGRESS" if int(m.get(22, [TRAFFIC_INGRESS])[-1])
+        == TRAFFIC_EGRESS else "INGRESS")
+    if 26 in m:
+        br = decode_message(m[26][-1])
+        out["is_reply"] = bool(int(br.get(1, [0])[-1]))
+    else:
+        out["is_reply"] = bool(int(m.get(16, [0])[-1]))
+    # drop-reason fidelity: field 3 carries the NATIVE code; field 25
+    # the (lossy) upstream enum.  Prefer native when present.
+    native = int(m.get(3, [0])[-1])
+    wire_desc = int(m.get(25, [0])[-1])
+    if native:
+        out["drop_reason"] = native
+        out["drop_reason_desc"] = DROP_REASON_DESC.get(
+            native, f"DROP_REASON_{native}")
+    elif wire_desc:
+        out["drop_reason"] = wire_desc
+        out["drop_reason_desc"] = f"DROP_REASON_{wire_desc}"
+    if 100000 in m:
+        out["Summary"] = m[100000][-1].decode()
+    if 34 in m:
+        out["uuid"] = m[34][-1].decode()
     return out

@@ -246,9 +246,25 @@ class FlightRecorder:
             with self._lock:
                 self._capturing = False
 
-    def _write_bundle(self, trigger: str, incident: Optional[dict],
-                      recent: List[dict], seq: int) -> Optional[str]:
+    def collect_bundle(self, trigger: str = KIND_MANUAL,
+                       incident: Optional[dict] = None,
+                       recent: Optional[List[dict]] = None,
+                       bound: bool = True) -> Dict[str, object]:
         # thread-affinity: capture, api, cli
+        """Assemble one bundle DICT without writing it — the
+        envelope + section collect + (with ``bound``) the
+        shed-to-fit pass.  The disk path (:meth:`capture`) passes
+        ``bound=False`` and runs the pass itself while serializing
+        (one pass total — a longer capture widens the re-entrancy
+        skip window for concurrent incidents); the cluster sysdump
+        relay (``obs/relay.py``) keeps ``bound=True`` and ships the
+        dict over the control channel, so a worker process's bundle
+        lands in the parent's archive without touching the worker's
+        filesystem.  Works with the recorder DISABLED (no sysdump
+        dir): collection never needed one."""
+        if recent is None:
+            with self._lock:
+                recent = [dict(i) for i in self._incidents[-32:]]
         bundle: Dict[str, object] = {
             "schema": SYSDUMP_SCHEMA,
             "node": self.node,
@@ -268,6 +284,17 @@ class FlightRecorder:
             bundle.setdefault(key, val)
         for key in SYSDUMP_REQUIRED_KEYS:
             bundle.setdefault(key, None)
+        if bound:
+            # shed-to-fit so control-channel consumers honor
+            # max_bytes too; mutates in place, stamps `truncated`
+            self._bound(bundle)
+        return bundle
+
+    def _write_bundle(self, trigger: str, incident: Optional[dict],
+                      recent: List[dict], seq: int) -> Optional[str]:
+        # thread-affinity: capture, api, cli
+        bundle = self.collect_bundle(trigger, incident, recent,
+                                     bound=False)
         body, _ = self._bound(bundle)  # shed record rides the body
         name = (f"sysdump-{time.strftime('%Y%m%d-%H%M%S')}"
                 f"-{seq:05d}-{_slug(trigger)}.json")
@@ -291,8 +318,10 @@ class FlightRecorder:
 
     def _bound(self, bundle: Dict[str, object]) -> tuple:
         """Serialize under the size cap, shedding the largest
-        optional sections in ``_SHED_ORDER`` until it fits."""
-        truncated: List[str] = []
+        optional sections in ``_SHED_ORDER`` until it fits.
+        Idempotent: a bundle already bounded (collect_bundle runs
+        the pass; the disk path re-checks) keeps its shed record."""
+        truncated: List[str] = list(bundle.get("truncated") or [])
         while True:
             bundle["truncated"] = truncated
             body = json.dumps(bundle, indent=1, default=str)

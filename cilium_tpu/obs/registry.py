@@ -23,12 +23,19 @@ form Prometheus can aggregate across scrapes and nodes.
 
 from __future__ import annotations
 
+import re
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..serving.stats import N_BUCKETS, LatencyHistogram
 
 # collect() -> None (omit) | scalar | [(labels_dict, value), ...]
 Collect = Callable[[], object]
+
+# prometheus metric-name grammar — asserted at registration time so a
+# typo'd name fails where it was written, not on a scraper.  \Z, not
+# $: a $ matches BEFORE a trailing newline, which is exactly the
+# exposition-tearing input this guard exists to reject
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
 
 
 def _fmt(v) -> str:
@@ -39,10 +46,22 @@ def _fmt(v) -> str:
     return str(v)
 
 
+def escape_label_value(v) -> str:
+    """Prometheus text-exposition label-value escaping (backslash,
+    double quote, newline — in that order, per the format spec).
+    One definition for the registry's own ``_labels`` AND the
+    cluster relay's injected ``node`` label (``obs/relay.py``): node
+    names are operator input, and an unescaped quote or newline
+    would tear the whole exposition, not one sample."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def _labels(d: Dict[str, object]) -> str:
     if not d:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in d.items())
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in d.items())
     return "{" + inner + "}"
 
 
@@ -59,6 +78,10 @@ class MetricsRegistry:
 
     def _add(self, name: str, mtype: str, help_: str,
              collect: Collect) -> None:
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} is not a valid prometheus "
+                f"series name ([a-zA-Z_:][a-zA-Z0-9_:]*)")
         if name in self._names:
             raise ValueError(f"metric {name!r} registered twice")
         self._names.add(name)

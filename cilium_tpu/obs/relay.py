@@ -1,0 +1,749 @@
+"""Cluster observability relay: one operator surface over N nodes.
+
+Reference: upstream cilium runs one agent per node, and the pieces
+that make a CLUSTER operable are dedicated aggregators — Hubble Relay
+(``pkg/hubble/relay``) fans GetFlows out to every node and merges the
+streams time-ordered with a node label, Prometheus scrapes every
+agent's ``/metrics`` and the ``instance`` label keys the dashboards,
+and ``cilium-sysdump`` collects every node's bugtool bundle into one
+archive.  PR 13 made this repo's nodes real processes and thereby
+made its richest subsystem invisible: each worker's registry, flow
+ring, span tracer, analytics top-K, and flight recorder live behind a
+control channel.  This module is the aggregator tier (ISSUE 14):
+
+- :class:`ClusterObsRelay` — a periodic LOW-DUTY scrape loop (its own
+  thread, bounded control-RPC timeouts, never on the router's
+  forward path) pulling each node's full observability snapshot: the
+  registry exposition text, the flow-ring tail (since-cursor), the
+  tracer + analytics snapshots, and the incident list.  Merged views:
+
+  * :meth:`cluster_metrics` — ONE prometheus exposition where every
+    per-node series carries a ``node`` label (grouped per family, no
+    duplicate series), plus the relay's own meta-series:
+    ``cilium_cluster_node_scrape_ok{node=}`` (0 marks a node whose
+    scrape failed — the worker-death-during-scrape contract),
+    ``cilium_cluster_node_scrape_age_seconds{node=}``,
+    ``cilium_cluster_scrapes_total`` and the scrape round-trip
+    histogram ``cilium_cluster_scrape_rtt_us``.  A failed node's
+    last-known-good series keep serving until ``stale_after_s``,
+    then drop (bounded staleness beats silently-frozen gauges);
+  * :meth:`cluster_flows` — time-ordered merged flows from every
+    node's ring tail, each stamped ``node_name`` (hubble-relay
+    parity for the serving tier);
+  * :meth:`cluster_top` — analytics top-K merged across nodes
+    (space-saving sketches are mergeable summaries: per-key sums
+    with summed error bounds — the PR 6 batch-merge idiom one level
+    up);
+  * :meth:`cluster_sysdump` — every worker's flight-recorder bundle
+    plus the parent's cluster-level bundle in one tar archive with a
+    manifest (the ``cilium-sysdump`` shape).
+
+- :class:`ClusterSpanStore` — the landing zone for CROSS-PROCESS
+  stitched spans: a 1-in-N sampled forward chunk carries
+  ``(trace_id, t_enqueue, t_forward)`` through the socket transport,
+  the worker stamps ``(t_recv, t_admit)`` and echoes them on the
+  ack, and the router commits the completed span here with per-hop
+  log2 histograms — BENCH_cluster's forward-latency percentiles
+  become inspectable per-flow.  Same-host ``time.monotonic()``
+  stamps compare across processes (Linux CLOCK_MONOTONIC is
+  machine-wide), so consecutive stages are monotonic by
+  construction.
+
+Exposition text is deliberately built HERE and in ``obs/registry.py``
+only — the CTA006 checker allowlists exactly these two modules.
+
+THREAD AFFINITY: the scrape loop is control-plane work (``api``
+domain — it shares the per-node control channel lock with membership
+probes); :class:`ClusterSpanStore` commits arrive from router
+forwarder threads.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tarfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..serving.stats import LatencyHistogram
+from .registry import MetricsRegistry, escape_label_value
+
+__all__ = [
+    "ClusterObsRelay", "ClusterSpanStore", "TraceCtx",
+    "merge_expositions", "SPAN_HOPS", "CLUSTER_SYSDUMP_SCHEMA",
+]
+
+# per-node flow-ring tail retention inside the relay's merged buffer
+FLOW_BUFFER = 4096
+# flows pulled per node per scrape (since-cursor: the tail only)
+FLOWS_PER_SCRAPE = 512
+# with the periodic loop disabled, a query re-sweeps when the
+# freshest cached snapshot is older than this (bursts of queries
+# share one sweep; a lone query always answers fresh)
+ON_DEMAND_MAX_AGE_S = 1.0
+
+# default scrape duty bound: sweeps may consume at most this fraction
+# of wall clock (the loop stretches its cadence to honor it).  Sized
+# against the ISSUE 14 acceptance floor (scrape-overhead throughput
+# ratio >= 0.95): on a fully-contended host the steady-state tax
+# approaches the duty, so 2% leaves real margin; a sweep on this
+# class of box costs ~0.2-0.4 s (registry render includes a device
+# metricsmap fetch that waits out queued dispatches), putting the
+# governed cadence at ~10-20 s under load and at the interval_s
+# ceiling when idle
+SCRAPE_DUTY = 0.02
+
+CLUSTER_SYSDUMP_SCHEMA = 1
+
+# the stitched span's hop vocabulary (consecutive stage pairs):
+# router enqueue -> forwarder pop/send -> worker recv -> worker
+# admit (runtime.submit returned) -> ack landed back on the router
+SPAN_STAGES = ("enqueue", "forward", "worker-recv", "worker-admit",
+               "ack")
+SPAN_HOPS = tuple(f"{SPAN_STAGES[i]}->{SPAN_STAGES[i + 1]}"
+                  for i in range(len(SPAN_STAGES) - 1))
+
+
+class TraceCtx:
+    """One sampled forward chunk's cross-process trace context.
+    Mutated only by the thread currently holding the chunk (router
+    submit -> forwarder -> the ack parse), committed once."""
+
+    __slots__ = ("trace_id", "node", "rows", "t_enq", "t_fwd",
+                 "t_recv", "t_admit", "t_ack")
+
+    def __init__(self, trace_id: int, rows: int, t_enq: float):
+        self.trace_id = trace_id
+        self.node = ""
+        self.rows = rows
+        self.t_enq = t_enq
+        self.t_fwd = 0.0
+        self.t_recv = 0.0
+        self.t_admit = 0.0
+        self.t_ack = 0.0
+
+    def stages(self) -> List[float]:
+        return [self.t_enq, self.t_fwd, self.t_recv, self.t_admit,
+                self.t_ack]
+
+    def complete(self) -> bool:
+        ts = self.stages()
+        return all(t > 0.0 for t in ts)
+
+    def monotonic(self) -> bool:
+        ts = self.stages()
+        return all(ts[i + 1] >= ts[i] for i in range(len(ts) - 1))
+
+    def to_dict(self) -> dict:
+        ts = self.stages()
+        return {
+            "trace-id": self.trace_id,
+            "node": self.node,
+            "rows": self.rows,
+            "timestamps": list(ts),
+            "hops-us": {SPAN_HOPS[i]:
+                        round((ts[i + 1] - ts[i]) * 1e6, 3)
+                        for i in range(len(SPAN_HOPS))},
+            "e2e-us": round((self.t_ack - self.t_enq) * 1e6, 3),
+            "monotonic": self.monotonic(),
+        }
+
+
+class ClusterSpanStore:
+    """Completed cross-process spans: fixed ring (newest wins) +
+    per-hop aggregate log2 histograms, loss-exact (sampled ==
+    committed + dropped — a chunk whose worker died mid-flight is a
+    counted drop, never a vanished span)."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        # guarded-by: _lock: _ring, _w, sampled, committed, dropped
+        self._ring: List[Optional[TraceCtx]] = [None] * self.capacity
+        self._w = 0
+        self.sampled = 0
+        self.committed = 0
+        self.dropped = 0
+        self.hop_hist = [LatencyHistogram() for _ in SPAN_HOPS]
+        self.e2e_hist = LatencyHistogram()
+
+    def allocate_span(self, rows: int, t_enq: float) -> TraceCtx:
+        # thread-affinity: router
+        with self._lock:
+            ctx = TraceCtx(self.sampled, rows, t_enq)
+            self.sampled += 1
+        return ctx
+
+    def commit_span(self, ctx: TraceCtx) -> None:
+        # thread-affinity: router
+        with self._lock:
+            if not ctx.complete():
+                self.dropped += 1
+                return
+            self._ring[self._w % self.capacity] = ctx
+            self._w += 1
+            self.committed += 1
+            ts = ctx.stages()
+            for i in range(len(SPAN_HOPS)):
+                self.hop_hist[i].record(max(ts[i + 1] - ts[i], 0.0)
+                                        * 1e6)
+            self.e2e_hist.record(max(ctx.t_ack - ctx.t_enq, 0.0)
+                                 * 1e6)
+
+    def drop_span(self, ctx: TraceCtx) -> None:
+        # thread-affinity: router, api
+        """The chunk died before its ack (crashed worker, failover
+        migration, stop sweep): the span is counted lost."""
+        with self._lock:
+            self.dropped += 1
+
+    def span_stats(self) -> dict:
+        # thread-affinity: any
+        with self._lock:
+            return {"sampled": self.sampled,
+                    "committed": self.committed,
+                    "dropped": self.dropped,
+                    "in-flight": (self.sampled - self.committed
+                                  - self.dropped)}
+
+    def snapshot_spans(self, limit: int = 32) -> dict:
+        # thread-affinity: api, cli -- the cluster_trace query
+        # surface (the histogram-snapshot leaf has query-thread
+        # affinity; counters-only reads ride span_stats instead)
+        with self._lock:
+            held = min(self._w, self.capacity)
+            spans = [self._ring[(self._w - 1 - i) % self.capacity]
+                     for i in range(held)]
+            out = {
+                "sampled": self.sampled,
+                "committed": self.committed,
+                "dropped": self.dropped,
+                "hops-us": {SPAN_HOPS[i]: self.hop_hist[i].snapshot()
+                            for i in range(len(SPAN_HOPS))},
+                "e2e-us": self.e2e_hist.snapshot(),
+            }
+        out["spans"] = [sp.to_dict() for sp in spans[:limit]
+                        if sp is not None]
+        return out
+
+
+# -- exposition merging ------------------------------------------------
+def _inject_node(line: str, node_esc: str) -> str:
+    """One sample line -> the same sample with a leading ``node``
+    label.  ``name{a="b"} v`` and ``name v`` forms both handled; the
+    value (and any exemplar/timestamp tail) is preserved verbatim."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        # labelled: name{...} value
+        return (line[:brace + 1] + 'node="' + node_esc + '",'
+                + line[brace + 1:])
+    if space == -1:
+        return line  # malformed; pass through untouched
+    return (line[:space] + '{node="' + node_esc + '"}'
+            + line[space:])
+
+
+def merge_expositions(node_texts: "Dict[str, str]") -> List[str]:
+    """Per-node exposition texts -> one cluster exposition, grouped
+    per metric family (prometheus requires a family's samples
+    contiguous), every sample stamped with its ``node`` label.  HELP
+    and TYPE lines are emitted once per family (nodes render the
+    same registry, so the first node's metadata stands for all)."""
+    order: List[str] = []  # family names, first-seen order
+    meta: Dict[str, List[str]] = {}  # family -> [# HELP, # TYPE]
+    samples: Dict[str, List[str]] = {}  # family -> injected samples
+    for node, text in node_texts.items():
+        esc = escape_label_value(node)
+        family = None
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                if len(parts) < 3:
+                    continue
+                family = parts[2]
+                if family not in meta:
+                    meta[family] = []
+                    samples[family] = []
+                    order.append(family)
+                if line not in meta[family]:
+                    meta[family].append(line)
+            else:
+                if family is None:
+                    # headerless sample (never produced by the
+                    # registry, but a peer must not tear the merge)
+                    family = line.split("{")[0].split(" ")[0]
+                    if family not in meta:
+                        meta[family] = []
+                        samples[family] = []
+                        order.append(family)
+                samples[family].append(_inject_node(line, esc))
+    out: List[str] = []
+    for family in order:
+        out.extend(meta[family])
+        out.extend(samples[family])
+    return out
+
+
+def _render_hist_lines(name: str, hist: LatencyHistogram,
+                       lines: List[str]) -> None:
+    """Cumulative log2 exposition for a relay-level histogram — the
+    registry's ONE renderer (torn-read discipline and all), plus the
+    HELP line it leaves to its caller."""
+    lines.append(f"# HELP {name} relay scrape round trip (µs)")
+    MetricsRegistry._render_histogram(lines, name, hist)
+
+
+class ClusterObsRelay:
+    """The parent-side scraper/merger.  ``peers_fn`` returns the
+    CURRENT node handles (so scale-out replicas join the scrape set
+    without registration); each handle implements the node obs
+    interface — ``name`` / ``alive`` / ``obs_scrape(cursor, flows,
+    top)`` / ``sysdump_bundle()`` (``cluster.ClusterNode`` in-process,
+    ``cluster.process.ProcessNode`` over the control channel).
+
+    The scrape loop NEVER runs on a router/forwarder thread and never
+    takes router locks: a wedged worker costs one bounded control RPC
+    timeout, after which the node is marked un-scrapeable
+    (``scrape_ok 0``) and its last-known-good snapshot keeps serving
+    until ``stale_after_s``."""
+
+    # guarded-by: _lock: _cache, _cursors, scrapes_total,
+    # guarded-by: _lock: scrape_errors
+
+    def __init__(self, peers_fn: Callable[[], Sequence],
+                 interval_s: float = 1.0,
+                 stale_after_s: float = 30.0,
+                 span_store: Optional[ClusterSpanStore] = None,
+                 parent_collect: Optional[Callable[[], dict]] = None,
+                 flows_per_scrape: int = FLOWS_PER_SCRAPE,
+                 flow_buffer: int = FLOW_BUFFER,
+                 duty: float = SCRAPE_DUTY):
+        self._peers_fn = peers_fn
+        self.interval_s = float(interval_s)
+        self.stale_after_s = float(stale_after_s)
+        self.span_store = span_store
+        self._parent_collect = parent_collect
+        self.flows_per_scrape = int(flows_per_scrape)
+        self.flow_buffer = int(flow_buffer)
+        # the scrape DUTY GOVERNOR (the flow-analytics max_duty idiom
+        # one level up): interval_s is a cadence CEILING — after each
+        # sweep the loop stretches its next delay so sweep time stays
+        # under `duty` of wall clock.  A worker answering scrape ops
+        # spends ITS core doing so (obs_scrape renders the registry,
+        # drains analytics, materializes the flow tail); on saturated
+        # hosts an eager cadence would tax serving throughput, which
+        # is exactly what "off the hot path" must not do.  0 disables
+        # the governor (fixed cadence).
+        self.duty = float(duty)
+        self._delay = self.interval_s
+        self._lock = threading.Lock()
+        # ONE sweep at a time (review hardening): two concurrent
+        # scrape_now calls — API threads racing each other or the
+        # periodic tick — would read the same per-node flow cursor
+        # and commit the same ring tail twice, duplicating every
+        # flow in the merged buffer
+        self._sweep_lock = threading.Lock()
+        # node name -> {"ok", "at" (monotonic), "metrics-text",
+        #               "flows" (bounded list), "top", "trace",
+        #               "incidents", "error"}
+        self._cache: Dict[str, dict] = {}
+        self._cursors: Dict[str, int] = {}
+        self.scrapes_total = 0
+        self.scrape_errors = 0
+        self.rtt = LatencyHistogram()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        # thread-affinity: api
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True,
+                                        name="cluster-obs-relay")
+        self._thread.start()
+
+    def stop(self) -> None:
+        # thread-affinity: api
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        # thread-affinity: api -- the relay's own scrape thread
+        while not self._stop.wait(self._delay):
+            t0 = time.monotonic()
+            try:
+                self.scrape_now()
+            except Exception:  # noqa: BLE001 — one broken sweep must
+                # not kill the loop; per-node failures are already
+                # contained + counted inside scrape_now
+                with self._lock:
+                    self.scrape_errors += 1
+            if self.duty > 0:
+                # duty governor: cost/(cost+delay) <= duty
+                cost = time.monotonic() - t0
+                self._delay = max(
+                    self.interval_s,
+                    cost * (1.0 - self.duty) / self.duty)
+
+    # -- scraping ------------------------------------------------------
+    def scrape_now(self) -> Dict[str, bool]:
+        # thread-affinity: api, cli
+        """One synchronous sweep over the current peers; returns
+        ``{node: ok}``.  Per-node failures are contained: the node is
+        marked un-scrapeable, its cached snapshot stands (until the
+        staleness bound), the sweep continues.  Sweeps are
+        SERIALIZED (``_sweep_lock``): a second caller waits, then
+        runs against the advanced cursors — never the same window
+        twice."""
+        with self._sweep_lock:
+            return self._sweep()
+
+    def _sweep(self) -> Dict[str, bool]:
+        # thread-affinity: api, cli
+        # holds: _sweep_lock
+        results: Dict[str, bool] = {}
+        for node in list(self._peers_fn()):
+            name = node.name
+            if not getattr(node, "alive", True):
+                self._mark_failed(name, "node dead")
+                results[name] = False
+                continue
+            with self._lock:
+                cursor = self._cursors.get(name, 0)
+            t0 = time.monotonic()
+            try:
+                snap = node.obs_scrape(cursor=cursor,
+                                       flows=self.flows_per_scrape,
+                                       top=16)
+            except Exception as e:  # noqa: BLE001 — a worker dying
+                # MID-SCRAPE (SIGKILL chaos leg) or a wedged control
+                # channel: contained, counted, last-known-good stands
+                self._mark_failed(name, f"{type(e).__name__}: {e}")
+                results[name] = False
+                continue
+            rtt_us = (time.monotonic() - t0) * 1e6
+            self._commit(name, snap, rtt_us)
+            results[name] = True
+        return results
+
+    def _mark_failed(self, name: str, error: str) -> None:
+        # thread-affinity: api, cli
+        with self._lock:
+            self.scrape_errors += 1
+            ent = self._cache.get(name)
+            if ent is None:
+                self._cache[name] = {
+                    "ok": False, "at": None, "metrics-text": None,
+                    "flows": [], "top": None, "trace": None,
+                    "incidents": [], "error": error}
+            else:
+                ent["ok"] = False
+                ent["error"] = error
+
+    def _commit(self, name: str, snap: dict, rtt_us: float) -> None:
+        # thread-affinity: api, cli
+        with self._lock:
+            self.scrapes_total += 1
+            self.rtt.record(rtt_us)
+            ent = self._cache.setdefault(name, {"flows": []})
+            ent["ok"] = True
+            ent["error"] = None
+            ent["at"] = time.monotonic()
+            ent["metrics-text"] = snap.get("metrics-text")
+            ent["top"] = snap.get("top")
+            ent["trace"] = snap.get("trace")
+            ent["incidents"] = snap.get("incidents") or []
+            fresh = snap.get("flows") or []
+            for f in fresh:
+                f["node_name"] = name
+            flows = ent.get("flows") or []
+            flows.extend(fresh)
+            ent["flows"] = flows[-self.flow_buffer:]
+            self._cursors[name] = int(snap.get("cursor", 0))
+
+    def _fresh_cache(self) -> Dict[str, dict]:
+        """Locked copy of the cache with staleness applied: a failed
+        node's last-known-good snapshot serves inside the bound,
+        after which its per-node series drop (only the relay's own
+        scrape_ok/age meta-series remain to say why).  The age bound
+        applies only to FAILED nodes (ok 0): on a saturated host the
+        duty governor can legally stretch the sweep delay past
+        ``stale_after_s``, and an unconditional bound would then mark
+        every HEALTHY node stale between sweeps — blanking the merged
+        views while scrape_ok still read 1.  A node whose LAST scrape
+        succeeded serves that snapshot however old it is (it is as
+        fresh as the scrape plane can make it, and the age
+        meta-series says exactly how old that is)."""
+        now = time.monotonic()
+        with self._lock:
+            out = {}
+            for name, ent in self._cache.items():
+                e = dict(ent)
+                # the flow buffer is mutated in place by _commit;
+                # hand readers their own copy, taken under the lock
+                e["flows"] = list(ent.get("flows") or [])
+                at = e.get("at")
+                e["age-s"] = (now - at) if at is not None else None
+                e["stale"] = (e["age-s"] is None
+                              or (not e.get("ok")
+                                  and e["age-s"] > self.stale_after_s))
+                out[name] = e
+            return out
+
+    def _ensure_scraped(self) -> None:
+        """Queries keep the surface answering without the periodic
+        loop: a never-scraped relay (or a first query racing the
+        first tick) runs one synchronous sweep, and with the loop
+        DISABLED (interval 0) a query re-sweeps whenever the
+        freshest snapshot is older than ``ON_DEMAND_MAX_AGE_S`` —
+        otherwise merged views would freeze at the first query's
+        snapshot and go permanently empty past the staleness
+        bound while scrape_ok still read 1."""
+        with self._lock:
+            if self._cache:
+                if self._thread is not None:
+                    return  # the periodic loop owns freshness
+                now = time.monotonic()
+                ages = [now - e["at"] for e in self._cache.values()
+                        if e.get("at") is not None]
+                if ages and min(ages) <= ON_DEMAND_MAX_AGE_S:
+                    return
+        self.scrape_now()
+
+    # -- merged views --------------------------------------------------
+    def cluster_metrics(self) -> str:
+        # thread-affinity: api, cli
+        """``GET /cluster/metrics``: one exposition, every series
+        node-labelled, relay meta-series appended."""
+        self._ensure_scraped()
+        cache = self._fresh_cache()
+        texts = {name: e["metrics-text"] for name, e in cache.items()
+                 if not e["stale"] and e.get("metrics-text")}
+        lines = merge_expositions(texts)
+        # relay meta-series: the scrape plane's own observability
+        lines.append("# HELP cilium_cluster_node_scrape_ok last "
+                     "relay scrape of this node succeeded")
+        lines.append("# TYPE cilium_cluster_node_scrape_ok gauge")
+        for name, e in sorted(cache.items()):
+            esc = escape_label_value(name)
+            lines.append(f'cilium_cluster_node_scrape_ok{{'
+                         f'node="{esc}"}} '
+                         f'{1 if e.get("ok") else 0}')
+        lines.append("# HELP cilium_cluster_node_scrape_age_seconds "
+                     "age of the node's last successful scrape")
+        lines.append("# TYPE cilium_cluster_node_scrape_age_seconds "
+                     "gauge")
+        for name, e in sorted(cache.items()):
+            if e.get("age-s") is None:
+                continue
+            esc = escape_label_value(name)
+            lines.append(f'cilium_cluster_node_scrape_age_seconds{{'
+                         f'node="{esc}"}} {round(e["age-s"], 3)}')
+        with self._lock:
+            total = self.scrapes_total
+            errors = self.scrape_errors
+            rtt = self.rtt
+        lines.append("# HELP cilium_cluster_scrapes_total successful "
+                     "per-node relay scrapes")
+        lines.append("# TYPE cilium_cluster_scrapes_total counter")
+        lines.append(f"cilium_cluster_scrapes_total {total}")
+        lines.append("# HELP cilium_cluster_scrape_errors_total "
+                     "failed per-node relay scrapes")
+        lines.append("# TYPE cilium_cluster_scrape_errors_total "
+                     "counter")
+        lines.append(f"cilium_cluster_scrape_errors_total {errors}")
+        _render_hist_lines("cilium_cluster_scrape_rtt_us", rtt,
+                           lines)
+        return "\n".join(lines) + "\n"
+
+    def cluster_flows(self, number: int = 100,
+                      oldest_first: bool = False) -> List[dict]:
+        # thread-affinity: api, cli
+        """Merged time-ordered flows (each dict stamped
+        ``node_name``) — the hubble-relay GetFlows shape over the
+        relay's since-cursor buffers."""
+        self._ensure_scraped()
+        cache = self._fresh_cache()
+        merged: List[dict] = []
+        for name, e in cache.items():
+            if not e["stale"]:
+                merged.extend(e.get("flows") or [])
+        merged.sort(key=lambda d: d.get("time", 0.0))
+        merged = merged[-number:] if number else merged
+        if not oldest_first:
+            merged = merged[::-1]
+        return merged
+
+    def cluster_top(self, top: int = 16) -> dict:
+        # thread-affinity: api, cli
+        """Analytics top-K merged across nodes.  Space-saving
+        sketches are mergeable: per-key counts SUM and per-key error
+        bounds sum too (the union's overcount is at most the sum of
+        the parts' — the PR 6 merge bound, applied across nodes)."""
+        self._ensure_scraped()
+        cache = self._fresh_cache()
+        talkers: Dict[tuple, dict] = {}
+        pairs: Dict[tuple, dict] = {}
+        per_node: Dict[str, dict] = {}
+        error_bound = 0
+        enabled = False
+        for name, e in sorted(cache.items()):
+            t = e.get("top")
+            per_node[name] = {
+                "ok": bool(e.get("ok")), "stale": e["stale"],
+                "age-s": (round(e["age-s"], 3)
+                          if e.get("age-s") is not None else None),
+                "windows-closed": (t or {}).get("windows-closed"),
+                "spike": ((t or {}).get("spike") or {}).get(
+                    "in-spike"),
+            }
+            if e["stale"] or not t:
+                continue
+            enabled = enabled or bool(t.get("enabled"))
+            error_bound += int(t.get("sketch-error-bound") or 0)
+            for row in t.get("top-talkers") or []:
+                key = (row["src"], row["sport"], row["dst"],
+                       row["dport"], row["proto"])
+                ent = talkers.setdefault(key, dict(
+                    row, packets=0, bytes=0, error=0, nodes=[]))
+                ent["packets"] += int(row["packets"])
+                ent["bytes"] += int(row["bytes"])
+                ent["error"] += int(row["error"])
+                ent["nodes"].append(name)
+            for row in t.get("top-identity-pairs") or []:
+                key = (row["src-identity"], row["dst-identity"])
+                ent = pairs.setdefault(key, dict(
+                    row, packets=0, bytes=0, error=0, nodes=[]))
+                ent["packets"] += int(row["packets"])
+                ent["bytes"] += int(row["bytes"])
+                ent["error"] += int(row["error"])
+                ent["nodes"].append(name)
+        rank = sorted(talkers.values(), key=lambda r: -r["packets"])
+        prank = sorted(pairs.values(), key=lambda r: -r["packets"])
+        return {
+            "enabled": enabled,
+            "nodes": per_node,
+            "top-talkers": rank[:top],
+            "top-identity-pairs": prank[:top],
+            "sketch-error-bound": error_bound,
+        }
+
+    def cluster_trace(self, limit: int = 32) -> dict:
+        # thread-affinity: api, cli
+        """Stitched cross-process spans (when the router samples
+        them) + each node's own tracer summary from the scrape."""
+        self._ensure_scraped()
+        cache = self._fresh_cache()
+        out: dict = {
+            "stitched": (self.span_store.snapshot_spans(limit)
+                         if self.span_store is not None else None),
+            "nodes": {},
+        }
+        for name, e in sorted(cache.items()):
+            tr = e.get("trace")
+            if tr is not None and not e["stale"]:
+                out["nodes"][name] = {
+                    k: tr.get(k)
+                    for k in ("sample", "started", "completed",
+                              "dropped")}
+        return out
+
+    def stats(self) -> dict:
+        # thread-affinity: any
+        cache = self._fresh_cache()
+        with self._lock:
+            out = {
+                "interval-s": self.interval_s,
+                "effective-interval-s": round(self._delay, 3),
+                "duty": self.duty,
+                "stale-after-s": self.stale_after_s,
+                "scrapes": self.scrapes_total,
+                "scrape-errors": self.scrape_errors,
+                "rtt-us": {"p50": self.rtt.percentile(0.50),
+                           "p95": self.rtt.percentile(0.95),
+                           "p99": self.rtt.percentile(0.99),
+                           "count": self.rtt.count},
+            }
+        out["nodes"] = {
+            name: {"ok": bool(e.get("ok")), "stale": e["stale"],
+                   "age-s": (round(e["age-s"], 3)
+                             if e.get("age-s") is not None
+                             else None),
+                   "flows-buffered": len(e.get("flows") or []),
+                   **({"error": e["error"]} if e.get("error")
+                      else {})}
+            for name, e in sorted(cache.items())}
+        if self.span_store is not None:
+            out["spans"] = self.span_store.span_stats()
+        return out
+
+    # -- cluster sysdump -----------------------------------------------
+    def cluster_sysdump(self, out_dir: str) -> dict:
+        # thread-affinity: api, cli, capture
+        """Pull every node's flight-recorder bundle + the parent's
+        cluster-level bundle into ONE tar archive with a manifest
+        (the ``cilium-sysdump`` shape).  Per-node collection is
+        contained: a dead/wedged worker becomes a manifest entry
+        with its error, never a failed archive."""
+        nodes: Dict[str, dict] = {}
+        bundles: Dict[str, dict] = {}
+        for node in list(self._peers_fn()):
+            name = node.name
+            if not getattr(node, "alive", True):
+                nodes[name] = {"ok": False, "error": "node dead"}
+                continue
+            try:
+                bundle = node.sysdump_bundle()
+                bundles[name] = bundle
+                nodes[name] = {"ok": True,
+                               "trigger": bundle.get("trigger"),
+                               "taken-at": bundle.get("taken-at")}
+            except Exception as e:  # noqa: BLE001 — contained per
+                # node; the manifest records why
+                nodes[name] = {"ok": False,
+                               "error": f"{type(e).__name__}: {e}"}
+        parent: dict = {"taken-at": time.time()}
+        if self._parent_collect is not None:
+            try:
+                parent.update(self._parent_collect() or {})
+            except Exception as e:  # noqa: BLE001
+                parent["error"] = f"{type(e).__name__}: {e}"
+        manifest = {
+            "schema": CLUSTER_SYSDUMP_SCHEMA,
+            "taken-at": time.time(),
+            "nodes": nodes,
+            "relay": self.stats(),
+        }
+        name = (f"cluster-sysdump-"
+                f"{time.strftime('%Y%m%d-%H%M%S')}.tar")
+        os.makedirs(out_dir, exist_ok=True)
+        path = os.path.join(out_dir, name)
+        tmp = path + ".tmp"
+
+        def add(tar: tarfile.TarFile, arcname: str, obj) -> int:
+            body = json.dumps(obj, indent=1, default=str).encode()
+            info = tarfile.TarInfo(arcname)
+            info.size = len(body)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(body))
+            return len(body)
+
+        with tarfile.open(tmp, "w") as tar:
+            for node_name, bundle in bundles.items():
+                nodes[node_name]["bytes"] = add(
+                    tar, f"nodes/{node_name}.json", bundle)
+            add(tar, "parent.json", parent)
+            add(tar, "manifest.json", manifest)
+        os.replace(tmp, path)
+        return {"path": path, "manifest": manifest}

@@ -48,9 +48,10 @@ def main() -> int:
     findings = check(Repo(REPO))
     if findings:
         print("metrics-registry lint FAILED — exposition text must "
-              "only be built in cilium_tpu/obs/registry.py (register "
-              "a collector instead), and every REQUIRED_SERIES must "
-              "stay registered:", file=sys.stderr)
+              "only be built in cilium_tpu/obs/registry.py or "
+              "cilium_tpu/obs/relay.py (register a collector "
+              "instead), and every REQUIRED_SERIES must stay "
+              "registered:", file=sys.stderr)
         for f in findings:
             print("  " + f.render(), file=sys.stderr)
         return 1

@@ -571,10 +571,16 @@ class TestSuppressionAndBaseline:
 # folded-in checkers (the former standalone scripts)
 # ---------------------------------------------------------------------
 class TestFoldedCheckers:
+    def _relay_stub(self):
+        return "\n".join(
+            f'_R = "{n}"'
+            for n in registry_lint.RELAY_REQUIRED_SERIES)
+
     def test_registry_scatter_flags_as_cta006(self, tmp_path):
         repo = _mini_repo(tmp_path, {
             "obs/registry.py": "\n".join(
                 f'_R = "{n}"' for n in registry_lint.REQUIRED_SERIES),
+            "obs/relay.py": self._relay_stub(),
             "scatter.py": """
                 def render(v):
                     return ['# TYPE foo_total counter']
@@ -584,10 +590,23 @@ class TestFoldedCheckers:
         assert fs[0].path == "cilium_tpu/scatter.py"
 
     def test_registry_required_series_enforced(self, tmp_path):
-        repo = _mini_repo(tmp_path, {"obs/registry.py": "# empty"})
+        repo = _mini_repo(tmp_path, {
+            "obs/registry.py": "# empty",
+            "obs/relay.py": self._relay_stub()})
         fs = registry_lint.check(repo)
         assert len(fs) == len(registry_lint.REQUIRED_SERIES)
         assert {f.code for f in fs} == {"CTA006"}
+
+    def test_relay_required_series_enforced(self, tmp_path):
+        # the relay's scrape-plane floor (ISSUE 14): a relay module
+        # that stops rendering scrape_ok/age/rtt fails CTA006
+        repo = _mini_repo(tmp_path, {
+            "obs/registry.py": "\n".join(
+                f'_R = "{n}"' for n in registry_lint.REQUIRED_SERIES),
+            "obs/relay.py": "# renders nothing"})
+        fs = registry_lint.check(repo)
+        assert len(fs) == len(registry_lint.RELAY_REQUIRED_SERIES)
+        assert all("relay series" in f.message for f in fs)
 
     def test_sysdump_key_drift_flags_as_cta007(self, tmp_path):
         repo = _mini_repo(tmp_path, {

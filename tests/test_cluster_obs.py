@@ -1,0 +1,808 @@
+"""Cluster observability relay (ISSUE 14 tentpole): node-labeled
+merged metrics, hubble-relay-style merged flows, cluster sysdump,
+and cross-process trace stitching.
+
+Acceptance (split by cost):
+(a) UNITS (no daemon): exposition merging injects correctly-escaped
+    ``node`` labels with families grouped and HELP/TYPE deduped;
+    registry registration asserts name validity/uniqueness and
+    render() escapes label values; traced transport frames/acks
+    round-trip; the span store's ledger is exact; the nodehost op
+    vocabulary is timeout-bounded (CTA011's floor, pinned here);
+    flow.proto carries native drop reasons (DIVERGENCES #15 closed).
+(b) THREAD-MODE integration (cheap): a live 2-node cluster serves
+    the merged views + the HTTP surface (/cluster/metrics, /flows,
+    /top, /trace, /sysdump) from a member daemon's socket; a crashed
+    node degrades to scrape_ok 0 with last-known-good series inside
+    the staleness bound and dropped past it.
+(c) PROCESS-MODE lifecycles (``slow`` lap — worker jax init
+    dominates; TIER-1 process-mode obs coverage rides the compact
+    leg folded into ``test_cluster_process``'s single lifecycle):
+    scrape over the real control channel, stitched cross-process
+    spans with monotonic stages, the cluster sysdump tar with every
+    worker bundle + parent + manifest, a SIGKILL MID-SCRAPE chaos
+    leg (the relay marks the corpse un-scrapeable, keeps serving
+    the survivors, never blocks the router, and the cluster ledger
+    still closes exactly), and the 3-node full acceptance.
+
+Named to sort early (the tier-1 budget-truncation convention).
+"""
+
+import json
+import os
+import tarfile
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.obs.registry import MetricsRegistry, escape_label_value
+from cilium_tpu.obs.relay import (SPAN_HOPS, ClusterSpanStore,
+                                  TraceCtx, merge_expositions)
+
+pytestmark = [pytest.mark.cluster, pytest.mark.obs]
+
+
+def _wait(pred, timeout=60.0, tick=0.01):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+# ---------------------------------------------------------------------
+# (a) units
+# ---------------------------------------------------------------------
+class TestExpositionMerge:
+    def test_node_label_injection_and_family_grouping(self):
+        texts = {
+            "node0": ("# HELP cilium_x things\n"
+                      "# TYPE cilium_x counter\n"
+                      "cilium_x 5\n"
+                      "# HELP cilium_y labelled\n"
+                      "# TYPE cilium_y counter\n"
+                      'cilium_y{reason="policy"} 2\n'),
+            "node1": ("# HELP cilium_x things\n"
+                      "# TYPE cilium_x counter\n"
+                      "cilium_x 7\n"
+                      "# HELP cilium_y labelled\n"
+                      "# TYPE cilium_y counter\n"
+                      'cilium_y{reason="policy"} 3\n'),
+        }
+        lines = merge_expositions(texts)
+        assert 'cilium_x{node="node0"} 5' in lines
+        assert 'cilium_x{node="node1"} 7' in lines
+        assert 'cilium_y{node="node0",reason="policy"} 2' in lines
+        assert 'cilium_y{node="node1",reason="policy"} 3' in lines
+        # HELP/TYPE once per family, samples contiguous under them
+        assert lines.count("# TYPE cilium_x counter") == 1
+        ix = lines.index("# TYPE cilium_x counter")
+        assert lines[ix + 1].startswith("cilium_x{")
+        assert lines[ix + 2].startswith("cilium_x{")
+        # no duplicate series after injection
+        samples = [l for l in lines if not l.startswith("#")]
+        assert len(samples) == len(set(samples))
+
+    def test_histogram_family_samples_stay_grouped(self):
+        text = ("# HELP cilium_h lat\n"
+                "# TYPE cilium_h histogram\n"
+                'cilium_h_bucket{le="1"} 1\n'
+                'cilium_h_bucket{le="+Inf"} 2\n'
+                "cilium_h_sum 3.0\n"
+                "cilium_h_count 2\n")
+        lines = merge_expositions({"a": text, "b": text})
+        ix = lines.index("# TYPE cilium_h histogram")
+        tail = lines[ix + 1:ix + 9]
+        assert all(l.startswith("cilium_h") for l in tail)
+        assert 'cilium_h_bucket{node="a",le="1"} 1' in tail
+        assert 'cilium_h_count{node="b"} 2' in tail
+
+    def test_node_name_escaping(self):
+        evil = 'no"de\\one\n'
+        lines = merge_expositions({evil: "# TYPE m counter\nm 1\n"})
+        sample = [l for l in lines if not l.startswith("#")][0]
+        assert sample == 'm{node="no\\"de\\\\one\\n"} 1'
+        assert "\n" not in sample
+
+    def test_escape_label_value_order(self):
+        # backslash first, then quote, then newline (spec order) —
+        # a quote-then-backslash order would double-escape
+        assert escape_label_value('a\\"b\nc') == 'a\\\\\\"b\\nc'
+
+
+class TestRegistryHygiene:
+    def test_duplicate_registration_rejected(self):
+        reg = MetricsRegistry()
+        reg.counter("cilium_t_total", "h", lambda: 1)
+        with pytest.raises(ValueError, match="twice"):
+            reg.counter("cilium_t_total", "h", lambda: 1)
+
+    def test_invalid_series_name_rejected(self):
+        reg = MetricsRegistry()
+        with pytest.raises(ValueError, match="not a valid"):
+            reg.counter("cilium bad name", "h", lambda: 1)
+        with pytest.raises(ValueError, match="not a valid"):
+            reg.gauge("9starts_with_digit", "h", lambda: 1)
+        with pytest.raises(ValueError, match="not a valid"):
+            # $ would match before the trailing newline; the guard
+            # must use \Z (review-round regression)
+            reg.counter("cilium_trailing_newline\n", "h", lambda: 1)
+
+    def test_render_escapes_label_values(self):
+        reg = MetricsRegistry()
+        reg.gauge("cilium_esc", "h",
+                  lambda: [({"k": 'v"1\\2\n3'}, 7)])
+        text = reg.render()
+        assert 'cilium_esc{k="v\\"1\\\\2\\n3"} 7' in text
+        # the exposition stays line-parseable
+        for line in text.splitlines():
+            assert "\n" not in line
+
+
+class TestSpanStore:
+    def _ctx(self, store, complete=True):
+        ctx = store.allocate_span(64, 1.0)
+        ctx.node = "node0"
+        ctx.t_fwd = 2.0
+        if complete:
+            ctx.t_recv, ctx.t_admit, ctx.t_ack = 3.0, 4.0, 5.0
+        return ctx
+
+    def test_ledger_exact_and_monotonic(self):
+        store = ClusterSpanStore(capacity=4)
+        for _ in range(6):
+            store.commit_span(self._ctx(store))
+        store.drop_span(self._ctx(store, complete=False))
+        st = store.span_stats()
+        assert st["sampled"] == st["committed"] + st["dropped"]
+        assert st["committed"] == 6 and st["dropped"] == 1
+        snap = store.snapshot_spans()
+        assert len(snap["spans"]) == 4  # ring capacity, newest wins
+        for sp in snap["spans"]:
+            assert sp["monotonic"]
+            assert set(sp["hops-us"]) == set(SPAN_HOPS)
+
+    def test_incomplete_span_counts_dropped_not_committed(self):
+        store = ClusterSpanStore()
+        ctx = self._ctx(store, complete=False)  # no ack echo
+        ctx.t_ack = 6.0
+        store.commit_span(ctx)
+        st = store.span_stats()
+        assert st["committed"] == 0 and st["dropped"] == 1
+
+
+class TestTracedTransport:
+    def test_traced_frame_round_trip(self):
+        from cilium_tpu.cluster.transport import (decode_rows,
+                                                  decode_rows_ex,
+                                                  encode_rows)
+
+        rows = np.arange(32, dtype=np.uint32).reshape(8, 4)
+        payload = encode_rows(rows, packed_meta=(3, 1),
+                              trace=(42, 1.5, 2.5))
+        out, meta, trace = decode_rows_ex(payload)
+        assert np.array_equal(out, rows) and meta == (3, 1)
+        assert trace == (42, 1.5, 2.5)
+        # the legacy two-tuple surface drops the context, not the rows
+        out2, meta2 = decode_rows(payload)
+        assert np.array_equal(out2, rows) and meta2 == (3, 1)
+        # untraced frames decode with trace None
+        _, _, none = decode_rows_ex(encode_rows(rows,
+                                                packed_meta=(3, 1)))
+        assert none is None
+
+    def test_traced_ack_round_trip(self):
+        from cilium_tpu.cluster.transport import (ACK_SIZE,
+                                                  ACK_TRACED_SIZE,
+                                                  pack_ack,
+                                                  unpack_ack,
+                                                  unpack_ack_ex)
+
+        plain = pack_ack(5, 10, 6, 2, 1)
+        assert len(plain) == ACK_SIZE
+        assert unpack_ack(plain) == (5, 10, 6, 2, 1)
+        traced = pack_ack(5, 10, 6, 2, 1, trace=(7, 1.25, 2.75))
+        assert len(traced) == ACK_TRACED_SIZE
+        ledger, echo = unpack_ack_ex(traced)
+        assert ledger == (5, 10, 6, 2, 1)
+        assert echo == (7, 1.25, 2.75)
+        # the legacy surface tolerates the traced size
+        assert unpack_ack(traced) == (5, 10, 6, 2, 1)
+
+    def test_torn_traced_frame_is_loud(self):
+        from cilium_tpu.cluster.transport import (FrameError,
+                                                  decode_rows_ex,
+                                                  encode_rows)
+
+        rows = np.zeros((4, 4), dtype=np.uint32)
+        payload = encode_rows(rows, trace=(1, 1.0, 2.0))
+        with pytest.raises(FrameError):
+            decode_rows_ex(payload[:20])  # mid-trace-block cut
+        with pytest.raises(FrameError):
+            decode_rows_ex(payload[:-3])  # torn body
+
+
+# the nodehost control-op vocabulary: every op named HERE (CTA011
+# requires a test referencing each op; this table-driven pin is that
+# reference for the whole wire contract, and the live ops are driven
+# end-to-end by the process-mode lifecycle below)
+EXPECTED_OPS = (
+    "ready", "probe", "add_endpoint", "policy_rev", "has_identity",
+    "start_node", "warm", "start_serving", "front_end",
+    "stop_serving", "metrics", "metricsmap", "obs_scrape", "sysdump",
+    "map_pressure", "compile_stats", "ct_snapshot", "ct_merge",
+    "record_incident", "publish_drops", "shutdown",
+)
+
+
+class TestNodehostOpDiscipline:
+    def test_op_vocabulary_pinned_and_timeout_bounded(self):
+        from cilium_tpu.cluster.nodehost import OP_TIMEOUTS, _NodeHost
+
+        assert set(_NodeHost._OPS) == set(EXPECTED_OPS), (
+            "control-op vocabulary changed: update EXPECTED_OPS "
+            "(and the CTA011 coverage it pins)")
+        assert set(OP_TIMEOUTS) == set(_NodeHost._OPS)
+        for op, bound in OP_TIMEOUTS.items():
+            assert isinstance(bound, (int, float)) and bound > 0, op
+
+    def test_cta011_live_repo_clean(self):
+        from cilium_tpu.analysis.driver import run_analysis
+
+        result = run_analysis(checkers=["nodehost-ops"])
+        assert [f.render() for f in result["findings"]] == []
+
+    def test_cta011_bench_schema(self, tmp_path):
+        from cilium_tpu.analysis.nodehost_lint import (BENCH_OBS_KEYS,
+                                                       check_bench)
+
+        good = {k: 1 for k in BENCH_OBS_KEYS}
+        good["schema"] = "bench-obs-v1"
+        p = tmp_path / "BENCH_obs.json"
+        p.write_text(json.dumps(good))
+        assert check_bench(str(p)) == []
+        bad = dict(good)
+        del bad["scrape_overhead_ratio"]
+        bad["schema"] = "bench-obs-v0"
+        p.write_text(json.dumps(bad))
+        msgs = check_bench(str(p))
+        assert any("scrape_overhead_ratio" in m for m in msgs)
+        assert any("schema" in m for m in msgs)
+
+
+class TestNativeDropReasonFidelity:
+    """DIVERGENCES #15 satellite: repo-native drop reasons survive
+    the binary flow.proto round trip (field 3 carries the native
+    code; decode prefers it over the lossy field-25 enum)."""
+
+    def _flow(self, reason):
+        from cilium_tpu.flow.flow import Flow, FlowEndpoint
+
+        return Flow(
+            time=123.456, uuid=7, verdict=0, drop_reason=reason,
+            event_type=1, is_reply=False, traffic_direction=0,
+            proto=6, flags=0x02, length=64,
+            source=FlowEndpoint(ip="10.0.1.1", port=1234),
+            destination=FlowEndpoint(ip="10.0.2.1", port=5432,
+                                     identity=1011,
+                                     labels=("k8s:app=db",),
+                                     pod_name="ns/db",
+                                     endpoint_id=3))
+
+    def test_every_native_reason_round_trips(self):
+        from cilium_tpu.flow.flow import DROP_REASON_DESC
+        from cilium_tpu.flow.proto import decode_flow, encode_flow
+
+        for reason, name in DROP_REASON_DESC.items():
+            d = decode_flow(encode_flow(self._flow(reason),
+                                        node_name="node1"))
+            assert d["drop_reason"] == reason
+            assert d["drop_reason_desc"] == name
+            assert d["node_name"] == "node1"
+            assert d["verdict"] == "DROPPED"
+
+    def test_relay_merge_keeps_native_reasons(self):
+        from cilium_tpu.flow.proto import decode_flow, encode_flow
+        from cilium_tpu.flow.relay import Relay
+
+        class _Peer:  # Observer-protocol peer yielding wire decodes
+            def __init__(self, reason):
+                self._d = decode_flow(encode_flow(
+                    TestNativeDropReasonFidelity()._flow(reason)))
+
+            def get_flows(self, filters=(), number=100,
+                          oldest_first=False, blacklist=()):
+                return [self._d]
+
+        relay = Relay({"a": _Peer(9), "b": _Peer(12)})
+        merged = relay.get_flows(number=10)
+        descs = {d["drop_reason_desc"] for d in merged}
+        assert descs == {"INGRESS_QUEUE_OVERFLOW",
+                         "CLUSTER_ROUTER_OVERFLOW"}
+        assert {d["node_name"] for d in merged} == {"a", "b"}
+
+
+class TestOnDemandFreshness:
+    """Review-round regression: with the periodic loop DISABLED
+    (interval 0), queries must RE-sweep once the cached snapshot
+    outgrows ON_DEMAND_MAX_AGE_S — the first cut scraped only on an
+    empty cache, so merged views froze at the first query and went
+    permanently empty past the staleness bound while scrape_ok
+    still read 1."""
+
+    class _Peer:
+        name = "node0"
+        alive = True
+
+        def __init__(self):
+            self.scrapes = 0
+
+        def obs_scrape(self, cursor=0, flows=512, top=16):
+            self.scrapes += 1
+            return {"metrics-text": "# TYPE m counter\nm 1\n",
+                    "flows": [], "cursor": 0, "top": None,
+                    "trace": None, "incidents": []}
+
+    def test_disabled_loop_requeries_past_age_bound(self,
+                                                    monkeypatch):
+        import cilium_tpu.obs.relay as relay_mod
+        from cilium_tpu.obs.relay import ClusterObsRelay
+
+        peer = self._Peer()
+        relay = ClusterObsRelay(lambda: [peer], interval_s=0.0)
+        monkeypatch.setattr(relay_mod, "ON_DEMAND_MAX_AGE_S", 0.05)
+        relay.cluster_metrics()
+        assert peer.scrapes == 1
+        relay.cluster_metrics()  # fresh: bursts share one sweep
+        assert peer.scrapes == 1
+        time.sleep(0.06)
+        text = relay.cluster_metrics()  # aged out: re-sweeps
+        assert peer.scrapes == 2
+        assert 'm{node="node0"} 1' in text
+        # cluster_trace answers on a fresh relay too (it shares
+        # _ensure_scraped with the other merged views)
+        relay2 = ClusterObsRelay(lambda: [self._Peer()],
+                                 interval_s=0.0)
+        out = relay2.cluster_trace()
+        assert "nodes" in out
+
+
+class TestFlowsSince:
+    def test_cursor_tail_semantics(self):
+        from cilium_tpu.flow.observer import Observer
+
+        obs = Observer(capacity=8)
+        hdr = np.zeros(obs.hdr.shape[1], dtype=np.uint32)
+        for i in range(5):
+            obs.append_l7(hdr, {"type": "REQUEST"}, 1, 0,
+                          float(i))
+        flows, cur = obs.flows_since(0)
+        assert len(flows) == 5 and cur == 5
+        # nothing new: empty tail, cursor stands
+        flows, cur2 = obs.flows_since(cur)
+        assert flows == [] and cur2 == 5
+        for i in range(5, 12):  # wrap the 8-ring
+            obs.append_l7(hdr, {"type": "REQUEST"}, 1, 0,
+                          float(i))
+        flows, cur3 = obs.flows_since(cur)
+        # seq 5..11 wanted; the ring holds the newest 8 (4..11), so
+        # all 7 are still present, oldest first
+        assert [f.uuid for f in flows] == list(range(5, 12))
+        assert cur3 == 12
+        # a lagging cursor sees only what survived the lap
+        flows, _ = obs.flows_since(0)
+        assert [f.uuid for f in flows] == list(range(4, 12))
+
+
+class TestClusterFlowsCliFilters:
+    """`flows --cluster` applies the SHARED filter vocabulary
+    CLIENT-side over the merged dicts — every accepted flag must
+    actually filter (review-round: --protocol was parsed but
+    silently dropped on the cluster branch)."""
+
+    FLOWS = [
+        {"time": 10.0, "uuid": "a", "node_name": "node0",
+         "verdict": "FORWARDED", "Summary": "tcp-allow",
+         "l4": {"TCP": {"source_port": 1111,
+                        "destination_port": 5432}},
+         "source": {"identity": 100}, "destination": {"identity": 7}},
+        {"time": 11.0, "uuid": "b", "node_name": "node1",
+         "verdict": "DROPPED", "Summary": "udp-drop",
+         "l4": {"UDP": {"source_port": 2222,
+                        "destination_port": 53}},
+         "source": {"identity": 200}, "destination": {"identity": 7}},
+    ]
+
+    def _run(self, capsys, monkeypatch, **over):
+        import argparse
+
+        from cilium_tpu.cli import main as cli
+
+        class _Stub:
+            def cluster_flows(_s, number=0, oldest_first=1):
+                return list(self.FLOWS)
+
+        monkeypatch.setattr(cli, "_client", lambda args: _Stub())
+        ns = dict(socket="unused", cluster=True, number=10,
+                  json=False, follow=False, interval=1.0,
+                  verdict=None, port=None, protocol=None,
+                  identity=None, since=None)
+        ns.update(over)
+        assert cli.cmd_flows(argparse.Namespace(**ns)) == 0
+        return capsys.readouterr().out
+
+    def test_protocol_filters_cluster_flows(self, capsys,
+                                            monkeypatch):
+        out = self._run(capsys, monkeypatch, protocol=17)
+        assert "udp-drop" in out and "tcp-allow" not in out
+        out = self._run(capsys, monkeypatch, protocol=6)
+        assert "tcp-allow" in out and "udp-drop" not in out
+
+    def test_verdict_and_identity_filter(self, capsys, monkeypatch):
+        out = self._run(capsys, monkeypatch, verdict=2)
+        assert "udp-drop" in out and "tcp-allow" not in out
+        out = self._run(capsys, monkeypatch, identity=100)
+        assert "tcp-allow" in out and "udp-drop" not in out
+
+
+# ---------------------------------------------------------------------
+# (b) thread-mode integration
+# ---------------------------------------------------------------------
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432",
+                                "protocol": "TCP"}]}],
+    }],
+}]
+
+# the per-node floor asserted in the merged exposition: one sample
+# per node per series (the ISSUE 14 acceptance shape)
+NODE_SERIES_FLOOR = (
+    "cilium_datapath_packets_total",
+    "cilium_serving_verdicts_total",
+    "cilium_policy_generation",
+    "cilium_flow_agg_windows_total",
+    "cilium_incidents_total",
+)
+
+
+def _mk_config(**over):
+    from cilium_tpu.agent import DaemonConfig
+
+    cfg = dict(backend="tpu", ct_capacity=1 << 12,
+               flow_ring_capacity=1 << 13,
+               serving_queue_depth=4096,
+               serving_bucket_ladder=(64,),
+               serving_max_wait_us=500.0,
+               serving_restart_backoff_ms=1.0,
+               cluster_probe_interval_s=0.1,
+               cluster_death_threshold=2,
+               cluster_forward_depth=8192,
+               cluster_obs_interval_s=0.0,  # scrape on demand /
+               # explicitly — deterministic tests
+               cluster_trace_sample=1)
+    cfg.update(over)
+    return DaemonConfig(**cfg)
+
+
+def _batch(db_id, n=128, base=20000, sport_stride=1):
+    from cilium_tpu.core import TCP_SYN, make_batch
+
+    return make_batch([
+        dict(src="10.0.1.1", dst="10.0.2.1",
+             sport=base + i * sport_stride, dport=5432, proto=6,
+             flags=TCP_SYN, ep=db_id, dir=0)
+        for i in range(n)]).data
+
+
+def _build_cluster(nodes, ring_capacity=1 << 10, **cfg_over):
+    from cilium_tpu.cluster import ClusterServing
+
+    c = ClusterServing(nodes=nodes, config=_mk_config(**cfg_over))
+    c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    rev = c.policy_import(RULES)
+    assert c.wait_policy(rev, timeout=30)
+    c.start(trace_sample=0, packed=True,
+            ring_capacity=ring_capacity)
+    return c, db
+
+
+def _assert_cluster_exposition(text, node_names):
+    """The acceptance shape: every rendered REQUIRED_SERIES appears
+    once per node (distinct node labels), the floor series render
+    for every node, no duplicate series."""
+    from cilium_tpu.analysis.registry_lint import REQUIRED_SERIES
+
+    samples = [l for l in text.splitlines()
+               if l and not l.startswith("#")]
+    assert len(samples) == len(set(samples)), "duplicate series"
+    by_series = {}
+    for line in samples:
+        name = line.split("{")[0].split(" ")[0]
+        by_series.setdefault(name, []).append(line)
+    for name in NODE_SERIES_FLOOR:
+        for node in node_names:
+            assert any(f'node="{node}"' in l
+                       for l in by_series.get(name, ())), (
+                f"{name} missing for {node}")
+    for name in REQUIRED_SERIES:
+        lines = by_series.get(name)
+        if lines is None:
+            continue  # not rendered in this state (e.g. NAT off)
+        for node in node_names:
+            node_lines = [l for l in lines if f'node="{node}"' in l]
+            assert node_lines, f"{name} missing for {node}"
+    for node in node_names:
+        assert (f'cilium_cluster_node_scrape_ok{{node="{node}"}} 1'
+                in samples)
+
+
+class TestThreadClusterObs:
+    def test_merged_views_http_surface_and_staleness(self, tmp_path):
+        import urllib.parse
+
+        from cilium_tpu.api.client import APIClient
+        from cilium_tpu.api.server import APIServer
+
+        # ring_capacity 1<<11, NOT the 1<<10 every other cluster
+        # test warms: executables key on it and jit caches are
+        # process-global, so sharing the key would pre-warm
+        # test_cluster_scaleout's bring-up pin into a false
+        # "warm-up compiled nothing" failure (caught in tier-1)
+        c, db = _build_cluster(2, ring_capacity=1 << 11,
+                               cluster_kvstore="memory",
+                               cluster_obs_stale_after_s=1.5,
+                               sysdump_dir=str(tmp_path / "dumps"))
+        api = None
+        try:
+            # spread flows over both nodes (distinct tuples)
+            for k in range(4):
+                c.submit(_batch(db.id, base=20000 + 512 * k,
+                                sport_stride=3))
+            assert _wait(lambda: c.ledger()[
+                "per-node-accounted"] >= 512)
+            for n in c.nodes:
+                n.record_incident("manual", {"why": "obs-test"})
+            assert c.obs.scrape_now() == {"node0": True,
+                                          "node1": True}
+            # -- merged exposition (the acceptance shape) -----------
+            text = c.obs.cluster_metrics()
+            _assert_cluster_exposition(text, ["node0", "node1"])
+            # -- merged flows: time-ordered, both nodes represented -
+            flows = c.obs.cluster_flows(number=400,
+                                        oldest_first=True)
+            assert flows
+            times = [f["time"] for f in flows]
+            assert times == sorted(times)
+            assert {f["node_name"] for f in flows} == {"node0",
+                                                      "node1"}
+            # -- merged top-K ---------------------------------------
+            top = c.obs.cluster_top(8)
+            assert top["enabled"]
+            assert set(top["nodes"]) == {"node0", "node1"}
+            # -- stitched spans (thread mode stamps in-process) -----
+            tr = c.obs.cluster_trace()
+            st = tr["stitched"]
+            assert st["committed"] > 0
+            assert all(sp["monotonic"] for sp in st["spans"])
+            # -- the HTTP surface from a member daemon's socket -----
+            sock = str(tmp_path / "cilium.sock")
+            api = APIServer(c.nodes[0].daemon, sock)
+            api.start()
+            cli = APIClient(sock)
+            assert 'node="node1"' in cli.cluster_metrics()
+            assert cli.cluster_flows(number=5)
+            assert cli.cluster_top(4)["enabled"]
+            assert cli.cluster_trace()["stitched"]["committed"] > 0
+            dump = cli.cluster_sysdump()
+            assert os.path.exists(dump["path"])
+            with tarfile.open(dump["path"]) as tar:
+                names = set(tar.getnames())
+                assert {"nodes/node0.json", "nodes/node1.json",
+                        "parent.json", "manifest.json"} <= names
+                man = json.load(tar.extractfile("manifest.json"))
+                assert man["nodes"]["node0"]["ok"]
+                assert man["nodes"]["node1"]["ok"]
+                bundle = json.load(
+                    tar.extractfile("nodes/node0.json"))
+                assert bundle["node"] == "node0"
+                parent = json.load(tar.extractfile("parent.json"))
+                assert parent["cluster"]["ledger"] is not None
+            # -- staleness: a crashed node degrades, bounded --------
+            c.node("node1").crash("obs staleness test")
+            res = c.obs.scrape_now()
+            assert res["node1"] is False and res["node0"] is True
+            text = c.obs.cluster_metrics()
+            # last-known-good inside the bound: node1 series remain
+            assert ('cilium_cluster_node_scrape_ok{node="node1"} 0'
+                    in text)
+            assert 'cilium_serving_verdicts_total{node="node1"}' \
+                in text
+            time.sleep(1.6)  # past cluster_obs_stale_after_s
+            # the periodic loop would have kept refreshing node0;
+            # with the loop off, refresh explicitly (node1's retry
+            # keeps failing — it is a corpse)
+            assert c.obs.scrape_now() == {"node0": True,
+                                          "node1": False}
+            text = c.obs.cluster_metrics()
+            assert ('cilium_cluster_node_scrape_ok{node="node1"} 0'
+                    in text)
+            assert 'cilium_serving_verdicts_total{node="node1"}' \
+                not in text, "stale series must drop past the bound"
+            # the survivor keeps rendering
+            assert 'cilium_serving_verdicts_total{node="node0"}' \
+                in text
+        finally:
+            if api is not None:
+                api.stop()
+            c.shutdown()
+
+
+# ---------------------------------------------------------------------
+# (c) process-mode lifecycle
+# ---------------------------------------------------------------------
+def _spawn_ok():
+    from cilium_tpu.cluster.process import spawn_available
+
+    return spawn_available()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.skipif(not _spawn_ok(),
+                    reason="multiprocessing 'spawn' unavailable")
+class TestProcessClusterObs:
+    """One 2-worker process lifecycle: real-socket scrape + stitched
+    spans + sysdump + SIGKILL mid-scrape.  SLOW lap: worker jax init
+    dominates (~19 s) and tier-1's process-mode obs coverage rides
+    the compact leg folded into test_cluster_process's one
+    lifecycle (the file's own cost discipline)."""
+
+    def test_scrape_stitch_sysdump_and_sigkill_mid_scrape(
+            self, tmp_path):
+        from cilium_tpu.cluster import ClusterServing
+
+        c = ClusterServing(nodes=2, config=_mk_config(
+            cluster_mode="process",
+            cluster_trace_sample=4,
+            cluster_obs_interval_s=0.25,
+            cluster_obs_stale_after_s=30.0))
+        c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+        db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        rev = c.policy_import(RULES)
+        assert c.wait_policy(rev, timeout=30)
+        try:
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            sent = 0
+            for k in range(6):
+                sent += c.submit(_batch(db.id, base=20000 + 512 * k,
+                                        sport_stride=3))
+            assert _wait(lambda: c.ledger()[
+                "per-node-accounted"] >= sent)
+            for n in c.nodes:
+                n.record_incident("manual", {"why": "obs-test"})
+            assert c.obs.scrape_now() == {"node0": True,
+                                          "node1": True}
+            # merged exposition over the REAL control channel
+            text = c.obs.cluster_metrics()
+            _assert_cluster_exposition(text, ["node0", "node1"])
+            # merged flows: time-ordered, node-stamped
+            flows = c.obs.cluster_flows(number=400,
+                                        oldest_first=True)
+            times = [f["time"] for f in flows]
+            assert times == sorted(times) and flows
+            assert {f["node_name"] for f in flows} <= {"node0",
+                                                       "node1"}
+            # stitched CROSS-PROCESS spans: every stage stamped on
+            # its own side of the socket, monotonic end to end
+            st = c.obs.cluster_trace()["stitched"]
+            assert st["committed"] > 0
+            for sp in st["spans"]:
+                assert sp["monotonic"], sp
+                assert set(sp["hops-us"]) == set(SPAN_HOPS)
+                assert all(v >= 0 for v in sp["hops-us"].values())
+            # the self-describing metrics op (the raw array moved
+            # to `metricsmap`, still served for CT proofs)
+            assert "# TYPE cilium_datapath_packets_total" in (
+                c.nodes[0].metrics_text() or "")
+            assert c.nodes[0].metrics() is not None
+            # worker map_pressure/compile/front_end ops stay live
+            assert c.nodes[0].map_pressure() is not None
+            assert c.nodes[0].dispatch_compiles() is not None
+            # cluster sysdump: every worker bundle + parent +
+            # manifest in one tar
+            rec = c.cluster_sysdump(str(tmp_path / "dumps"))
+            with tarfile.open(rec["path"]) as tar:
+                names = set(tar.getnames())
+                assert {"nodes/node0.json", "nodes/node1.json",
+                        "parent.json", "manifest.json"} <= names
+                b = json.load(tar.extractfile("nodes/node1.json"))
+                assert b["node"] == "node1" and "metrics" in b
+            # -- SIGKILL MID-SCRAPE chaos leg -----------------------
+            # (the periodic loop is live — duty-stretched cadence —
+            # and the explicit sweep below races the corpse; the
+            # relay must degrade, not wedge, and the router must
+            # keep serving)
+            c.node("node1").proc.kill()
+            res = c.obs.scrape_now()
+            assert res["node1"] is False
+            text = c.obs.cluster_metrics()
+            assert ('cilium_cluster_node_scrape_ok{node="node1"} 0'
+                    in text)
+            # the router keeps accepting while the corpse is found
+            t0 = time.monotonic()
+            while not c.membership.dead_nodes():
+                c.submit(_batch(db.id, base=40000, sport_stride=3))
+                assert time.monotonic() - t0 < 60
+                time.sleep(0.02)
+            assert _wait(lambda: c.failovers_total() == 1)
+            stt = c.stop()
+            assert stt["ledger"]["exact"], stt["ledger"]
+            # the relay's own stats survived the chaos
+            assert stt["obs"]["scrape-errors"] >= 1
+            assert stt["obs"]["nodes"]["node1"]["ok"] is False
+        finally:
+            c.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+@pytest.mark.skipif(not _spawn_ok(),
+                    reason="multiprocessing 'spawn' unavailable")
+class TestProcessClusterObsAcceptance:
+    """The full ISSUE 14 acceptance: a live THREE-node process
+    cluster under load answers every merged view (slow lap — three
+    worker jax inits)."""
+
+    def test_three_node_acceptance(self, tmp_path):
+        from cilium_tpu.cluster import ClusterServing
+
+        names = ["node0", "node1", "node2"]
+        c = ClusterServing(nodes=3, config=_mk_config(
+            cluster_mode="process",
+            cluster_trace_sample=4,
+            cluster_obs_interval_s=0.25))
+        c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+        db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        rev = c.policy_import(RULES)
+        assert c.wait_policy(rev, timeout=30)
+        try:
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            sent = 0
+            for k in range(12):
+                sent += c.submit(_batch(db.id, base=15000 + 512 * k,
+                                        sport_stride=7))
+            assert _wait(lambda: c.ledger()[
+                "per-node-accounted"] >= sent)
+            for n in c.nodes:
+                n.record_incident("manual", {"why": "obs-test"})
+            assert all(c.obs.scrape_now().values())
+            text = c.obs.cluster_metrics()
+            _assert_cluster_exposition(text, names)
+            flows = c.obs.cluster_flows(number=1000,
+                                        oldest_first=True)
+            times = [f["time"] for f in flows]
+            assert times == sorted(times)
+            assert {f["node_name"] for f in flows} == set(names), (
+                "flows must merge from ALL nodes")
+            st = c.obs.cluster_trace()["stitched"]
+            assert st["committed"] > 0
+            assert all(sp["monotonic"] for sp in st["spans"])
+            rec = c.cluster_sysdump(str(tmp_path / "dumps"))
+            with tarfile.open(rec["path"]) as tar:
+                got = set(tar.getnames())
+                assert {f"nodes/{n}.json" for n in names} <= got
+                assert {"parent.json", "manifest.json"} <= got
+            top = c.obs.cluster_top(8)
+            assert set(top["nodes"]) == set(names)
+            stt = c.stop()
+            assert stt["ledger"]["exact"], stt["ledger"]
+        finally:
+            c.shutdown()

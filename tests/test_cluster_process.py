@@ -59,7 +59,12 @@ def _config(**over):
                cluster_probe_interval_s=0.1,
                cluster_death_threshold=2,
                cluster_forward_depth=8192,
-               cluster_mode="process")
+               cluster_mode="process",
+               # ISSUE 14: stitch every 8th forwarded chunk; scrape
+               # on demand (the compact obs leg below — the full
+               # relay lifecycle lives in test_cluster_obs)
+               cluster_trace_sample=8,
+               cluster_obs_interval_s=0.0)
     cfg.update(over)
     return DaemonConfig(**cfg)
 
@@ -128,6 +133,23 @@ class TestProcessClusterLifecycle:
                 assert ts["frames-packed"] == ts["frames"], (
                     "single-stream chunks must ride the packed "
                     "16 B/packet wire")
+            # -- ISSUE 14 compact obs leg: the relay's merged views
+            # over the LIVE workers (real control-channel scrape +
+            # cross-process span stitching; the full relay
+            # lifecycle incl. sysdump is test_cluster_obs) --------
+            assert c.obs.scrape_now() == {"node0": True,
+                                          "node1": True}
+            text = c.obs.cluster_metrics()
+            for node in ("node0", "node1"):
+                assert (f'cilium_serving_verdicts_total{{'
+                        f'node="{node}"}}') in text
+            samples = [l for l in text.splitlines()
+                       if l and not l.startswith("#")]
+            assert len(samples) == len(set(samples))
+            stitched = c.obs.cluster_trace()["stitched"]
+            assert stitched["committed"] > 0
+            assert all(sp["monotonic"]
+                       for sp in stitched["spans"])
             c.snapshot_now()  # parent-retained CT replica per node
             m0 = {n.name: n.metrics().sum(axis=1) for n in c.nodes}
             # -- (b) mid-forward SIGKILL ----------------------------
@@ -145,6 +167,12 @@ class TestProcessClusterLifecycle:
                 assert time.monotonic() - t0 < 60, "death undetected"
                 time.sleep(0.02)
             assert c.membership.dead_nodes() == ["node1"]
+            # ISSUE 14: scraping the corpse degrades (ok 0), never
+            # wedges, and the survivor's series keep serving
+            res = c.obs.scrape_now()
+            assert res["node1"] is False and res["node0"] is True
+            assert ('cilium_cluster_node_scrape_ok{node="node1"} 0'
+                    in c.obs.cluster_metrics())
             assert _wait(lambda: c.failovers_total() == 1)
             rec = c.failover.snapshot()[0]
             assert rec["dead"] == "node1" and rec["peer"] == "node0"
