@@ -652,6 +652,134 @@ def bench_l7(batch: int = 4096, iters: int = 24, n_exact: int = 192,
     }
 
 
+def bench_l7_redirect(batch=1024, iters=6, reps=3) -> dict:
+    """The ``l7_redirect`` rung (ISSUE 16): paired-leg redirect
+    overhead through LIVE serving.  Baseline leg serves SYN traffic
+    against a plain L4 allow on port 80; the candidate leg serves the
+    IDENTICAL traffic shape against the same policy WITH an HTTP rule
+    — every row then verdicts REDIRECT, emits its verdict event, and
+    detours through the L7 worker pool (parse + per-rule verdict),
+    and the candidate's wall clock includes waiting for the pool to
+    drain what the leg submitted.  The paired ratio is the honest
+    cost of making REDIRECT a real serving outcome; both legs ride
+    :func:`paired_legs` so machine weather cancels per pair."""
+    import ipaddress
+
+    from cilium_tpu.agent import Daemon, DaemonConfig
+    from cilium_tpu.core.packets import (COL_DPORT, COL_DST_IP3,
+                                         COL_EP, COL_FAMILY, COL_FLAGS,
+                                         COL_LEN, COL_PROTO, COL_SPORT,
+                                         COL_SRC_IP3, N_COLS, TCP_SYN)
+
+    def build(with_l7):
+        d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 16,
+                                flow_ring_capacity=1 << 13,
+                                serving_bucket_ladder=(batch,),
+                                serving_queue_depth=1 << 14))
+        d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        tp = {"ports": [{"port": "80", "protocol": "TCP"}]}
+        if with_l7:
+            tp["rules"] = {"http": [{"method": "GET"}]}
+        d.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "db"}},
+            "ingress": [{
+                "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+                "toPorts": [tp]}],
+        }])
+        d.start_serving(ring_capacity=1 << 13, trace_sample=0,
+                        drain_every=1)
+        return d, db.id
+
+    src = int(ipaddress.IPv4Address("10.0.1.1"))
+    dst = int(ipaddress.IPv4Address("10.0.2.1"))
+    counters = {"base": 0, "redir": 0}
+
+    def rows_for(n, key, ep):
+        rows = np.zeros((n, N_COLS), dtype=np.uint32)
+        rows[:, COL_SRC_IP3] = src
+        rows[:, COL_DST_IP3] = dst
+        start = counters[key]
+        counters[key] += n
+        # fresh sport per row: every packet is a NEW flow, so each
+        # redirect verdict emits its event and detours the pool —
+        # the exact path whose overhead this rung defends
+        rows[:, COL_SPORT] = 1024 + (start + np.arange(n)) % 60000
+        rows[:, COL_DPORT] = 80
+        rows[:, COL_PROTO] = 6
+        rows[:, COL_FLAGS] = TCP_SYN
+        rows[:, COL_LEN] = 64
+        rows[:, COL_FAMILY] = 4
+        rows[:, COL_EP] = ep
+        return rows
+
+    d_base, ep_b = build(False)
+    d_red, ep_r = build(True)
+    try:
+        # warm both executables (same bucket shape, but the first
+        # dispatch of each daemon pays compile)
+        d_base.serve_batch(rows_for(batch, "base", ep_b))
+        d_red.serve_batch(rows_for(batch, "redir", ep_r))
+
+        def leg(d, ep, key):
+            def run():
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    d.serve_batch(rows_for(batch, key, ep))
+                # the candidate pays its detour in full: wall time
+                # includes the pool draining this leg's tasks (the
+                # baseline's plane never sees a row — no-op)
+                plane = d._l7plane
+                if plane is not None:
+                    deadline = time.monotonic() + 30.0
+                    while plane.pool.pending \
+                            and time.monotonic() < deadline:
+                        time.sleep(0.0005)
+                return batch * iters / (time.perf_counter() - t0)
+            return run
+
+        out = paired_legs(leg(d_base, ep_b, "base"),
+                          leg(d_red, ep_r, "redir"), reps=reps)
+        st = d_red.stop_serving()
+        d_base.stop_serving()
+        out["l7"] = st.get("l7")
+        out["batch"] = batch
+        out["packets_per_leg"] = batch * iters
+        return out
+    finally:
+        d_base.shutdown()
+        d_red.shutdown()
+
+
+def _run_l7_phase() -> None:
+    """--l7: the L7 proxy-plane phase standalone (one JSON line).
+    Also writes BENCH_l7.json next to this file — schema-checked by
+    the CTA012 machinery (importable ``check_bench`` in
+    ``cilium_tpu.analysis.proxy_lint``)."""
+    import os
+
+    from cilium_tpu.proxy import registry as l7registry
+
+    redirect = bench_l7_redirect()
+    out = {
+        "schema": "bench-l7-v1",
+        # paired-leg redirect overhead: candidate (redirect + pool
+        # drain) over baseline (plain L4 allow), same traffic shape
+        "redirect_overhead": redirect,
+        # per-plugin parse+verdict percentiles recorded by the
+        # candidate leg's workers through the registry seam
+        "parse_latency_by_plugin": l7registry.latency_snapshot(),
+        # the offline proxy microbench rides along (eval config #4)
+        "offline_http": bench_l7(),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_l7.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(json.dumps(out))
+
+
 def bench_socket_lb(n_services=512, iters=9) -> dict:
     """Socket-LB delta (SURVEY §2a bpf_sock row): per-packet LB cost
     on ESTABLISHED traffic, flow-cached probe (service/socklb.py) vs
@@ -2564,7 +2692,7 @@ def main() -> None:
     churn = _phase_subprocess("--churn")
     scenarios = _phase_subprocess("--scenarios")
     artifact = _phase_subprocess("--artifact")
-    l7 = bench_l7()
+    l7 = _phase_subprocess("--l7")
     anomaly = bench_anomaly()
     encryption = bench_encryption()
     dev_pps = device.get("pps", 0) or 0
@@ -2619,5 +2747,7 @@ if __name__ == "__main__":
         _run_churn_phase()
     elif "--scenarios" in sys.argv:
         _run_scenarios_phase()
+    elif "--l7" in sys.argv:
+        _run_l7_phase()
     else:
         main()

@@ -138,6 +138,14 @@ class DaemonConfig:
     # window in flight on the worker, so arena memory scales with
     # (depth + 2) * drain_every slots per bucket shape
     serving_window_queue_depth: int = 4
+    # -- the L7 proxy plane (serving/l7plane.py + proxy/worker.py):
+    # redirected rows fan out of the event-join worker into a bounded
+    # pool of L7 workers (upstream: the Envoy/proxylib userspace
+    # proxy).  Worker count and task-queue depth; overflow sheds the
+    # OLDEST queued task, counted l7_shed, never silently.  The pool
+    # shares serving_restart_budget for its restart-on-death budget
+    l7_workers: int = 2
+    l7_queue_depth: int = 128
     # occupancy-bounded ring drain: fetch a power-of-two-rung device
     # GATHER of just the window's occupied slots instead of the full
     # ring — d2h bytes scale with events appended, not ring capacity.
@@ -392,6 +400,15 @@ class Daemon:
             raise ValueError(
                 "serving_window_queue_depth must be >= 1 (the "
                 "event-join worker's bounded window queue)")
+        self.config.l7_workers = int(self.config.l7_workers)
+        if self.config.l7_workers < 1:
+            raise ValueError(
+                "l7_workers must be >= 1 (the L7 proxy worker pool)")
+        self.config.l7_queue_depth = int(self.config.l7_queue_depth)
+        if self.config.l7_queue_depth < 1:
+            raise ValueError(
+                "l7_queue_depth must be >= 1 (the L7 pool's bounded "
+                "task queue)")
         from ..obs import validate_obs_config
 
         (self.config.serving_trace_sample,
@@ -491,6 +508,20 @@ class Daemon:
 
         self.xds = XDSCache()
         self.endpoints.on_attach(self.xds.update_from_policies)
+
+        # the live L7 proxy plane (serving/l7plane.py): constructed
+        # per serving session in start_serving, read lock-free from
+        # the event-join worker via this attribute (NEVER through
+        # self._serving — _emit_ring_rows is contractually barred
+        # from touching the session dict).  _l7_last keeps the final
+        # stats of the most recent session for post-stop reads.
+        self._l7plane = None
+        self._l7_last: Optional[dict] = None
+        # embedder seams for the plane's parse leg: a request source
+        # (port, kind, task) -> requests, and a DNS resolver
+        # (qname) -> (ips, ttl) feeding live FQDN identity mints
+        self.l7_request_source = None
+        self.l7_dns_resolver = None
 
         # hubble plane
         self.observer = Observer(
@@ -802,6 +833,12 @@ class Daemon:
         from ..obs.flightrec import KIND_EVENTWORKER
 
         self.record_incident(KIND_EVENTWORKER, {"error": error})
+
+    def _l7pool_incident(self, error: str) -> None:
+        """L7WorkerPool's on_terminal hook (dying l7 thread)."""
+        from ..obs.flightrec import KIND_L7POOL
+
+        self.record_incident(KIND_L7POOL, {"error": error})
 
     def sysdump_now(self, trigger: str = "manual") -> dict:
         """The manual trigger (``GET /debug/sysdump?trigger=1``,
@@ -1402,6 +1439,27 @@ class Daemon:
                if self.loader.row_map else 0)
         return self.proxy.handle(kind, proxy_port, requests, row)
 
+    def proxy_stats(self) -> dict:
+        """``GET /proxy/stats`` / ``cilium-tpu proxy stats``: the
+        proxy plane's full picture — listener table, offline proxy
+        counters, the LIVE L7 worker-pool ledger (or the last
+        session's final one), per-plugin parse latency."""
+        from ..proxy import registry as l7registry
+
+        l7 = self._l7plane
+        out = {
+            "listeners": self.proxy.listeners(),
+            "requests-total": self.proxy.requests_total,
+            "requests-denied": self.proxy.requests_denied,
+            "plane-active": l7 is not None,
+            "parse-latency-by-plugin": l7registry.latency_snapshot(),
+        }
+        if l7 is not None:
+            out["plane"] = l7.stats()
+        elif self._l7_last is not None:
+            out["plane"] = self._l7_last
+        return out
+
     # -- k8s integration ----------------------------------------------
     _k8s_hub = None
 
@@ -1635,6 +1693,22 @@ class Daemon:
             queue_depth=window_queue_depth,
             restart_budget=cfg.serving_restart_budget,
             on_terminal=self._eventworker_incident)
+        # the L7 proxy plane (serving/l7plane.py): redirected rows fan
+        # out of the event-join worker into the bounded worker pool.
+        # Held as a daemon ATTRIBUTE, not a _serving key —
+        # _emit_ring_rows (event-worker thread) is contractually
+        # barred from touching the session dict, and an atomic
+        # attribute read is all the fan-out needs
+        from ..serving.l7plane import L7Plane
+
+        l7plane = L7Plane(
+            self.proxy,
+            workers=cfg.l7_workers,
+            queue_depth=cfg.l7_queue_depth,
+            restart_budget=cfg.serving_restart_budget,
+            on_terminal=self._l7pool_incident,
+            request_source=self.l7_request_source,
+            dns_resolver=self.l7_dns_resolver)
         self._serving = {
             "drainer": drainer,
             "ring": drainer.fresh(),
@@ -1683,6 +1757,8 @@ class Daemon:
             "last_tick": 0,
             "tracer": None,
         }
+        l7plane.start()
+        self._l7plane = l7plane
         worker.start()
         if ingress:
             from ..core.packets import N_COLS
@@ -2243,6 +2319,9 @@ class Daemon:
             # membership, failovers) — cheap by contract, because
             # every member node renders it per scrape
             out["cluster"] = self._cluster.summary()
+        l7 = self._l7plane
+        if l7 is not None:
+            out["l7"] = l7.stats()
         return out
 
     def debug_traces(self, limit: int = 64) -> dict:
@@ -2692,6 +2771,15 @@ class Daemon:
         # the worker is drained: aggregate whatever it published
         # (caller-thread context — the drain loop has stopped)
         self.analytics.drain()
+        # the L7 plane stops AFTER the event plane: the join worker
+        # above was still fanning redirect rows into the pool until
+        # its drain completed.  Drain the pool, keep the final stats
+        # for post-stop reads (proxy stats / metrics), then detach
+        l7 = None
+        if self._l7plane is not None:
+            l7 = self._l7plane.stop(drain=True)
+            self._l7_last = l7
+            self._l7plane = None
         if s["mesh"] is not None:
             # leave the loader in the default single-device placement
             # (subsequent step()/process_batch callers expect it)
@@ -2707,6 +2795,8 @@ class Daemon:
             out["ladder"] = lad.to_dict()
         if front is not None:
             out["front-end"] = front
+        if l7 is not None:
+            out["l7"] = l7
         return out
 
     def _emit_ring_rows(self, rows: np.ndarray,
@@ -2745,6 +2835,14 @@ class Daemon:
                 sel = unpack_rows_np(sel, *meta)
             batch = decode_ring_rows(rows_b, sel, numerics, ts,
                                      aligned=True)
+            # redirect fan-out: the L7 plane's bounded submit (never
+            # blocks, shed is counted).  Attribute read, not a
+            # _serving key — see the contract in the docstring; a
+            # racing stop_serving already drained what we submitted
+            # or sheds it counted, either way the ledger closes
+            l7 = self._l7plane
+            if l7 is not None:
+                l7.ingest(batch)
             if self.auth_manager is not None:
                 # the drained window's logical now is gone; the
                 # serving loop stamps batches with _now(), so grants

@@ -22,9 +22,12 @@ from .core import FileCtx, Finding
 # hot domain: a forward frame's round trip sits on the cluster's
 # admission path.  "api" covers the control-plane thread family: API
 # handlers, CLI, tests' main thread, and the cluster
-# membership/failover orchestration threads.
+# membership/failover orchestration threads.  "l7" = the L7 proxy
+# worker pool (proxy/worker.py): redirected rows' parse + verdict
+# threads — a hot domain (see hotpath.HOT_DOMAINS): a redirect's
+# detour latency is that flow's serving latency.
 AFFINITIES = ("drain", "event-worker", "watchdog", "capture", "api",
-              "cli", "offline", "router", "transport", "any")
+              "cli", "offline", "router", "transport", "l7", "any")
 
 _GUARDED_LIST_RE = re.compile(
     r"#\s*guarded-by:\s*(?P<lock>[\w.-]+)\s*:\s*(?P<attrs>[\w,\s]+)$")

@@ -51,6 +51,11 @@ HOT_DOMAINS = {
     # data reader — a forward's round trip is cluster admission
     # latency exactly like dispatch latency is the node's
     "transport": "cluster transport I/O",
+    # the L7 worker pool (ISSUE 16): parse + fused-tensor verdict on
+    # the proxy workers — a redirect's detour latency is serving
+    # latency for that flow, so the same no-sleep/no-logging/no-file
+    # discipline applies
+    "l7": "L7 proxy worker",
 }
 
 

@@ -1,0 +1,194 @@
+"""CTA012 — proxy-ledger contract: the L7 redirect ledger's counters
+stay declared, surfaced, and scrapeable; the L7 bench artifact keeps
+its schema.
+
+The L7 plane's no-silent-loss contract (``redirected == l7_allowed +
+l7_denied + l7_shed + l7_failed``) is only worth anything while every
+leg stays VISIBLE end to end: counter declared in the pool, stat key
+in the pool's snapshot, ``cilium_l7_*`` series in the metrics
+registry, and that series pinned by CTA006's REQUIRED_SERIES floor.
+A refactor that drops any link quietly turns counted loss back into
+silent loss, so the chain is enforced statically (the CTA006/CTA010
+idiom):
+
+1. every :data:`LEDGER_COUNTERS` name must be DECLARED
+   (``self.<name> = 0``) in ``proxy/worker.py`` — the single
+   authoritative home of the ledger;
+2. every :data:`LEDGER_STAT_KEYS` kebab key must appear as a string
+   literal in ``proxy/worker.py`` (the ``stats()`` snapshot every
+   surface above reads);
+3. every :data:`REQUIRED_L7_SERIES` name must be registered in
+   ``obs/registry.py`` AND pinned in ``registry_lint.py``'s
+   REQUIRED_SERIES floor (one floor per checker is not enough: THIS
+   check fails when someone edits the floor out from under the L7
+   family);
+4. the ``l7.parse`` fault site must stay declared in
+   ``infra/faults.py`` and armed-before-parse in ``proxy/worker.py``
+   — the chaos gate's worker-death leg dies silently without it;
+5. when ``BENCH_l7.json`` exists at the repo root it carries the
+   :data:`BENCH_L7_KEYS` floor (``check_bench`` is the importable
+   validator bench and tests share).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import List
+
+from .core import Finding, Repo
+
+CODE = "CTA012"
+NAME = "proxy-ledger"
+
+WORKER_MODULE = "cilium_tpu/proxy/worker.py"
+PLANE_MODULE = "cilium_tpu/serving/l7plane.py"
+REGISTRY_MODULE = "cilium_tpu/obs/registry.py"
+REGISTRY_LINT_MODULE = "cilium_tpu/analysis/registry_lint.py"
+FAULTS_MODULE = "cilium_tpu/infra/faults.py"
+
+# the ledger: redirected == l7_allowed + l7_denied + l7_shed +
+# l7_failed (rows, exact post-stop)
+LEDGER_COUNTERS = (
+    "redirected", "l7_allowed", "l7_denied", "l7_shed", "l7_failed",
+)
+# ...and the kebab keys the pool's stats() snapshot surfaces them as
+LEDGER_STAT_KEYS = (
+    "redirected", "l7-allowed", "l7-denied", "l7-shed", "l7-failed",
+    "ledger-exact",
+)
+# the scrape-plane floor for the family (mirrored into CTA006's
+# REQUIRED_SERIES — both must hold)
+REQUIRED_L7_SERIES = (
+    "cilium_l7_redirected_total",
+    "cilium_l7_allowed_total",
+    "cilium_l7_denied_total",
+    "cilium_l7_shed_total",
+    "cilium_l7_failed_total",
+    "cilium_l7_worker_restarts_total",
+    "cilium_l7_dns_answers_total",
+    "cilium_l7_parse_lag_us",
+)
+
+FAULT_SITE = "l7.parse"
+
+BENCH_NAME = "BENCH_l7.json"
+BENCH_SCHEMA = "bench-l7-v1"
+# top-level keys the L7 bench artifact must carry: the paired-leg
+# redirect-overhead ratio, per-plugin parse percentiles, and the
+# offline proxy microbench riding along
+BENCH_L7_KEYS = (
+    "schema", "redirect_overhead", "parse_latency_by_plugin",
+    "offline_http",
+)
+# the paired-leg result keys inside redirect_overhead (the
+# bench.paired_legs contract)
+BENCH_OVERHEAD_KEYS = (
+    "baseline_pps", "candidate_pps", "ratio_median", "ratio_best",
+)
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    worker = repo.by_rel(WORKER_MODULE)
+    if worker is None:
+        findings.append(Finding(
+            CODE, WORKER_MODULE, 1,
+            "L7 worker-pool module missing (the redirect ledger's "
+            "home)", checker=NAME))
+    else:
+        for name in LEDGER_COUNTERS:
+            if not re.search(rf"self\.{name}\s*=\s*0\b",
+                             worker.source):
+                findings.append(Finding(
+                    CODE, worker.rel, 1,
+                    f"ledger counter {name!r} is not declared "
+                    f"(`self.{name} = 0`) in the worker pool — the "
+                    f"redirect ledger cannot close without it",
+                    checker=NAME))
+        for key in LEDGER_STAT_KEYS:
+            if f'"{key}"' not in worker.source:
+                findings.append(Finding(
+                    CODE, worker.rel, 1,
+                    f"ledger stat key {key!r} is not surfaced by the "
+                    f"pool's stats() snapshot", checker=NAME))
+        if "SITE_L7_PARSE" not in worker.source:
+            findings.append(Finding(
+                CODE, worker.rel, 1,
+                f"the {FAULT_SITE!r} fault site is not armed in the "
+                f"worker loop (the chaos gate's worker-death leg)",
+                checker=NAME))
+    plane = repo.by_rel(PLANE_MODULE)
+    if plane is None:
+        findings.append(Finding(
+            CODE, PLANE_MODULE, 1,
+            "L7 plane module missing (the redirect fan-out)",
+            checker=NAME))
+    reg = repo.by_rel(REGISTRY_MODULE)
+    if reg is not None:  # CTA006 owns the missing-module finding
+        for name in REQUIRED_L7_SERIES:
+            if f'"{name}"' not in reg.source:
+                findings.append(Finding(
+                    CODE, reg.rel, 1,
+                    f"L7 series {name!r} is not registered — a "
+                    f"ledger leg went scrape-invisible",
+                    checker=NAME))
+    lint = repo.by_rel(REGISTRY_LINT_MODULE)
+    if lint is not None:
+        for name in REQUIRED_L7_SERIES:
+            if f'"{name}"' not in lint.source:
+                findings.append(Finding(
+                    CODE, lint.rel, 1,
+                    f"L7 series {name!r} is not pinned in CTA006's "
+                    f"REQUIRED_SERIES floor", checker=NAME))
+    faults = repo.by_rel(FAULTS_MODULE)
+    if faults is not None and f'"{FAULT_SITE}"' not in faults.source:
+        findings.append(Finding(
+            CODE, FAULTS_MODULE, 1,
+            f"fault site {FAULT_SITE!r} is not declared in the "
+            f"injector's SITES", checker=NAME))
+    bench_path = os.path.join(repo.root, BENCH_NAME)
+    if os.path.exists(bench_path):
+        for msg in check_bench(bench_path):
+            findings.append(Finding(CODE, BENCH_NAME, 1, msg,
+                                    checker=NAME))
+    return findings
+
+
+# -- bench artifact validation (bench + tests share it) ----------------
+def check_bench(path: str) -> List[str]:
+    """-> list of violation strings (empty = clean)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: does not load as JSON ({e})"]
+    if not isinstance(data, dict):
+        return [f"{path}: top level is {type(data).__name__}, "
+                f"not an object"]
+    bad = []
+    if data.get("schema") != BENCH_SCHEMA:
+        bad.append(f"{path}: schema {data.get('schema')!r} != "
+                   f"{BENCH_SCHEMA}")
+    for key in BENCH_L7_KEYS:
+        if key not in data:
+            bad.append(f"{path}: missing required key {key!r}")
+    ov = data.get("redirect_overhead")
+    if isinstance(ov, dict):
+        for key in BENCH_OVERHEAD_KEYS:
+            if key not in ov:
+                bad.append(f"{path}: redirect_overhead missing "
+                           f"required key {key!r}")
+    elif "redirect_overhead" in data:
+        bad.append(f"{path}: redirect_overhead is not an object")
+    plat = data.get("parse_latency_by_plugin")
+    if isinstance(plat, dict):
+        for name, snap in plat.items():
+            if not isinstance(snap, dict) or "p99" not in snap:
+                bad.append(f"{path}: parse_latency_by_plugin"
+                           f"[{name!r}] missing percentile keys")
+    elif "parse_latency_by_plugin" in data:
+        bad.append(f"{path}: parse_latency_by_plugin is not an "
+                   f"object")
+    return bad

@@ -78,6 +78,19 @@ REQUIRED_SERIES = (
     "cilium_ct_occupancy",
     "cilium_ct_insert_drops_total",
     "cilium_nat_pool_failures_total",
+    # the L7 proxy plane (serving/l7plane.py): every leg of the
+    # redirect ledger — redirected == allowed + denied + shed +
+    # failed — must stay scrapeable, or shed/failed redirect rows
+    # become invisible loss (CTA012 owns the deeper ledger checks;
+    # this floor keeps the series registered)
+    "cilium_l7_redirected_total",
+    "cilium_l7_allowed_total",
+    "cilium_l7_denied_total",
+    "cilium_l7_shed_total",
+    "cilium_l7_failed_total",
+    "cilium_l7_worker_restarts_total",
+    "cilium_l7_dns_answers_total",
+    "cilium_l7_parse_lag_us",
     # long-standing anchors (a registry rewrite that loses these
     # fails here, not on a dashboard)
     "cilium_datapath_packets_total",

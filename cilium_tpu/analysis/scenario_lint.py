@@ -47,6 +47,7 @@ WORKLOADS_MODULE = "cilium_tpu/testing/workloads.py"
 KNOWN_CRITERIA = (
     "ledger_exact", "max_shed_frac", "p99_ms",
     "min_ct_insert_drops", "min_nat_failures", "min_drop_frac",
+    "l7_ledger_exact", "min_l7_redirected",
 )
 
 BENCH_NAME = "BENCH_scenarios.json"

@@ -166,6 +166,9 @@ class APIClient:
     def proxy_listeners(self):
         return self._request("GET", "/proxy")
 
+    def proxy_stats(self):
+        return self._request("GET", "/proxy/stats")
+
     def serving_stats(self):
         return self._request("GET", "/serving")
 

@@ -209,6 +209,10 @@ def _make_handler(daemon: Daemon):
                     # redirect listeners + their L7 rule shapes (the
                     # xDS NetworkPolicy view; reference: pkg/envoy)
                     self._send(200, daemon.proxy.listeners())
+                elif path == "/proxy/stats":
+                    # the L7 plane's ledger + per-plugin parse
+                    # percentiles (ISSUE 16)
+                    self._send(200, daemon.proxy_stats())
                 elif path == "/xds":
                     # the SotW push-surface status an external proxy
                     # subscribes to (proxy/xds.py); snapshot() instead

@@ -322,6 +322,34 @@ def cmd_proxy(args) -> int:
         for nonce, detail in st.get("nacks", ()):
             print(f"  NACK @{nonce}: {detail}")
         return 0
+    if args.obj == "stats":
+        st = c.proxy_stats()
+        if args.json:
+            _print(st)
+            return 0
+        plane = st.get("plane") or {}
+        print(f"plane {'ACTIVE' if st.get('plane-active') else 'stopped'}"
+              f", {len(st.get('listeners') or ())} listener(s), "
+              f"requests {st.get('requests-total', 0)} "
+              f"(denied {st.get('requests-denied', 0)})")
+        if plane:
+            print(f"redirected {plane.get('redirected', 0)}: "
+                  f"allowed {plane.get('l7-allowed', 0)} "
+                  f"denied {plane.get('l7-denied', 0)} "
+                  f"shed {plane.get('l7-shed', 0)} "
+                  f"failed {plane.get('l7-failed', 0)} "
+                  f"(ledger "
+                  f"{'exact' if plane.get('ledger-exact') else 'OPEN'})")
+            print(f"workers {plane.get('workers', 0)} "
+                  f"restarts {plane.get('worker-restarts', 0)} "
+                  f"queue {plane.get('queue-depth', 0)} "
+                  f"dns-answers {plane.get('dns-answers', 0)}")
+        for name, h in sorted(
+                (st.get("parse-latency-by-plugin") or {}).items()):
+            print(f"  {name}: p50={h.get('p50')}us "
+                  f"p95={h.get('p95')}us p99={h.get('p99')}us "
+                  f"n={h.get('count')}")
+        return 0
     listeners = c.proxy_listeners()
     if args.json:
         _print(listeners)
@@ -1149,9 +1177,10 @@ def main(argv=None) -> int:
     p.add_argument("value", nargs="?")
 
     p = sub.add_parser("proxy",
-                       help="proxy listeners | proxy xds (push status)")
+                       help="proxy listeners | proxy stats (L7 plane "
+                            "ledger) | proxy xds (push status)")
     p.add_argument("obj", nargs="?", default="listeners",
-                   choices=["listeners", "xds"])
+                   choices=["listeners", "stats", "xds"])
 
     p = sub.add_parser("bpf", help="bpf ct list | bpf policy get ID | "
                                    "bpf ipcache list | bpf nat list | "

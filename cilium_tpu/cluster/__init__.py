@@ -352,6 +352,17 @@ class ClusterNode:
         out["agg"] = self.daemon.analytics.stats()
         return out
 
+    def l7_stats(self) -> Optional[dict]:
+        """The node's L7 proxy-plane block: the retained stop
+        snapshot once serving stopped (or the node crashed), else
+        the live pool."""
+        with self._lock:
+            fin = self.final
+        if fin is not None:
+            return fin.get("l7")
+        l7 = self.daemon._l7plane
+        return l7.stats() if l7 is not None else None
+
     def metrics(self) -> Optional[np.ndarray]:
         return np.asarray(self.daemon.loader.metrics())
 
@@ -937,6 +948,7 @@ class ClusterServing:
                 "alive": n.alive,
                 "mode": n.mode(),
                 "front-end": n.front_end(),
+                **({"l7": l7s} if (l7s := n.l7_stats()) else {}),
                 **({"transport": ts}
                    if (ts := n.transport_stats()) else {}),
             }

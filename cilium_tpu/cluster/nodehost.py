@@ -268,15 +268,19 @@ class _NodeHost:
         if self._final is not None:
             return {"front-end": self._final.get("front-end"),
                     "ledgers": self._final.get("ledgers"),
-                    "mode": self._final.get("mode")}
+                    "mode": self._final.get("mode"),
+                    "l7": self._final.get("l7")}
         s = self.daemon._serving
         rt = s.get("runtime") if s is not None else None
         lad = s.get("ladder") if s is not None else None
+        l7 = self.daemon._l7plane
         return {
             "front-end": (_jsonable(rt.snapshot())
                           if rt is not None else None),
             "ledgers": self._node_ledgers(),
             "mode": lad.rung if lad is not None else None,
+            "l7": (_jsonable(l7.stats()) if l7 is not None
+                   else None),
         }
 
     def _op_stop_serving(self, req: dict) -> dict:
@@ -291,6 +295,7 @@ class _NodeHost:
             "front-end": _jsonable((final or {}).get("front-end")),
             "ledgers": ledgers,
             "mode": mode,
+            "l7": _jsonable((final or {}).get("l7")),
         }
         return dict(self._final)
 

@@ -444,6 +444,19 @@ class ProcessNode:
         except ServingError:
             return None
 
+    def l7_stats(self) -> Optional[dict]:
+        """The node's L7 proxy-plane block (the worker ships it with
+        ``front_end``; the retained final survives a clean stop —
+        SIGKILL erases the pool with the process)."""
+        with self._lock:
+            fin = self.final
+        if fin is not None:
+            return fin.get("l7")
+        try:
+            return self.call("front_end", timeout=30.0).get("l7")
+        except ServingError:
+            return None
+
     def snapshot_ct(self, trigger: str = "cluster") -> np.ndarray:
         """Fan-out snapshot: the worker snapshots AND ships the rows;
         the parent-side replica is what failover replays after a

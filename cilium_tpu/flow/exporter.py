@@ -51,7 +51,8 @@ class FlowExporter:
                 batch.hdr[i], batch.timestamp, self._seq + i,
                 int(batch.verdict[i]), int(batch.reason[i]),
                 int(batch.ct_state[i]), int(batch.msg_type[i]),
-                int(batch.identity[i]), ident_get, ep_get)
+                int(batch.identity[i]), ident_get, ep_get,
+                proxy_port=int(batch.proxy_port[i]))
             rec = {"flow": fl.to_dict(), "node_name": self.node_name,
                    "time": fl.time}
             fh.write(json.dumps(rec) + "\n")

@@ -86,6 +86,10 @@ class Flow:
     source: FlowEndpoint
     destination: FlowEndpoint
     l7: Optional[dict] = None  # L7 record when proxy-parsed
+    # flow.proto proxy_port: the listener a REDIRECTED flow detoured
+    # to (0 = no redirect) — without it a redirect row renders
+    # indistinguishably from plain ALLOW (ISSUE 16 satellite)
+    proxy_port: int = 0
 
     @property
     def verdict_name(self) -> str:
@@ -94,9 +98,12 @@ class Flow:
     def summary(self) -> str:
         p = PROTO_NAMES.get(self.proto, str(self.proto))
         arrow = "<-" if self.is_reply else "->"
+        to_proxy = (f" to-proxy:{self.proxy_port}"
+                    if self.verdict == VERDICT_REDIRECT
+                    and self.proxy_port else "")
         return (f"{self.source.ip}:{self.source.port} {arrow} "
                 f"{self.destination.ip}:{self.destination.port} "
-                f"{p} {self.verdict_name}")
+                f"{p} {self.verdict_name}{to_proxy}")
 
     def to_dict(self) -> dict:
         """hubble-JSON-shaped rendering (flow.proto JSON)."""
@@ -126,6 +133,8 @@ class Flow:
                 # policy-audit-mode signature (upstream renders
                 # verdict AUDIT)
                 d["policy_audit"] = True
+        if self.proxy_port:
+            d["proxy_port"] = self.proxy_port
         if self.l7:
             d["l7"] = self.l7
         d["Summary"] = self.summary()

@@ -283,7 +283,7 @@ class Observer:
             int(self.verdict[i]), int(self.reason[i]),
             int(self.ct_state[i]), int(self.msg_type[i]),
             int(self.identity[i]), self.identity_getter,
-            self.endpoint_getter)
+            self.endpoint_getter, proxy_port=int(self.proxy[i]))
         if self.l7[i] is not None:
             f.l7 = self.l7[i]
         return f
@@ -292,7 +292,8 @@ class Observer:
 def materialize_flow(r: np.ndarray, time: float, seq: int, verdict: int,
                      reason: int, ct_state: int, msg_type: int,
                      remote_ident: int, identity_getter: IdentityGetter,
-                     endpoint_getter: EndpointGetter) -> Flow:
+                     endpoint_getter: EndpointGetter,
+                     proxy_port: int = 0) -> Flow:
     """One header row + event fields -> enriched Flow (shared by the
     observer ring and the exporter's direct batch path)."""
     fam = int(r[COL_FAMILY])
@@ -323,4 +324,5 @@ def materialize_flow(r: np.ndarray, time: float, seq: int, verdict: int,
         length=int(r[COL_LEN]),
         source=src,
         destination=dst,
+        proxy_port=proxy_port,
     )

@@ -101,6 +101,13 @@ SITE_CLUSTER_PROBE = "cluster.probe"
 # swap stall the watchdog's deadline machinery must tolerate).
 SITE_CHURN_BUILD = "churn.build"
 SITE_CHURN_SWAP = "churn.swap"
+# proxy/worker.py — an L7 worker, just before it parses a redirected
+# task's payloads: a raise KILLS the worker mid-parse (the pool's
+# watchdog restarts it under the budget and the task's rows are
+# counted l7_failed, keeping the redirect ledger exact); a ``~S``
+# hang stalls the pool so redirected tasks pile against the bounded
+# queue (shed accounting).
+SITE_L7_PARSE = "l7.parse"
 
 SITES = frozenset({
     SITE_SERVING_DISPATCH,
@@ -115,6 +122,7 @@ SITES = frozenset({
     SITE_CLUSTER_PROBE,
     SITE_CHURN_BUILD,
     SITE_CHURN_SWAP,
+    SITE_L7_PARSE,
 })
 
 
@@ -129,7 +137,7 @@ class InjectedFault(RuntimeError):
 
 
 _ENTRY_RE = re.compile(
-    r"^(?P<site>[a-z_.]+)=(?P<rate>[0-9.]+)"
+    r"^(?P<site>[a-z][a-z0-9_.]*)=(?P<rate>[0-9.]+)"
     r"(?:x(?P<count>[0-9]+))?(?:@(?P<skip>[0-9]+))?"
     r"(?:~(?P<hang>[0-9.]+))?$")
 

@@ -51,6 +51,9 @@ KIND_RESTART = "watchdog-restart"
 KIND_TERMINAL = "watchdog-terminal"
 KIND_DEMOTION = "ladder-demotion"
 KIND_EVENTWORKER = "eventworker-terminal"
+# the L7 worker pool's restart budget exhausted — redirected traffic
+# is shedding to the l7_shed ledger leg from here on
+KIND_L7POOL = "l7pool-terminal"
 # a cluster node replica died and its flows were failed over onto a
 # designated peer (CT snapshot replayed, router re-pinned); recorded
 # on the PEER — the dead node's recorder died with it

@@ -319,6 +319,63 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                            if (w := eventplane()) is not None
                            else None))
 
+    # -- the L7 proxy plane (serving/l7plane.py + proxy/worker.py):
+    # the redirect ledger — redirected == allowed + denied + shed +
+    # failed — surfaced leg by leg.  Collectors prefer the LIVE
+    # session's snapshot and fall back to the last session's final
+    # ledger (daemon._l7_last), so the post-stop scrape still shows
+    # where every redirected row went.  CTA012 pins this floor -------
+    def l7(*keys):
+        cur = sv("l7", *keys)
+        if cur is not None:
+            return cur
+        cur = daemon._l7_last
+        for k in keys:
+            if not isinstance(cur, dict) or k not in cur:
+                return None
+            cur = cur[k]
+        return cur
+
+    reg.counter("cilium_l7_redirected_total",
+                "redirect rows ingested by the L7 proxy plane",
+                lambda: l7("redirected"))
+    reg.counter("cilium_l7_allowed_total",
+                "redirect rows the L7 verdict allowed",
+                lambda: l7("l7-allowed"))
+    reg.counter("cilium_l7_denied_total",
+                "redirect rows the L7 verdict denied",
+                lambda: l7("l7-denied"))
+    reg.counter("cilium_l7_shed_total",
+                "redirect rows shed at the bounded L7 task queue "
+                "(overflow, stopped/terminal pool)",
+                lambda: l7("l7-shed"))
+    reg.counter("cilium_l7_failed_total",
+                "redirect rows lost to parse/handler failure or "
+                "worker death (counted, never silent)",
+                lambda: l7("l7-failed"))
+    reg.counter("cilium_l7_worker_restarts_total",
+                "L7 worker restarts spent against the pool budget",
+                lambda: l7("worker-restarts"))
+    reg.counter("cilium_l7_dns_answers_total",
+                "DNS answers observed by L7 workers (each feeds a "
+                "live FQDN identity mint)",
+                lambda: l7("dns-answers"))
+
+    def l7pool():
+        p = daemon._l7plane
+        return p.pool if p is not None else None
+
+    reg.gauge("cilium_l7_tasks_pending",
+              "redirect tasks queued or parsing on the L7 pool "
+              "(live at scrape time)",
+              lambda: (p.pending if (p := l7pool()) is not None
+                       else None))
+    reg.histogram("cilium_l7_parse_lag_us",
+                  "redirect submit -> L7 verdict lag on the worker "
+                  "pool (µs, log2 buckets)",
+                  lambda: (p.parse_lag
+                           if (p := l7pool()) is not None else None))
+
     # -- clustermesh serving tier (cilium_tpu/cluster): per-node
     # series for the tier the node belongs to.  Collectors read the
     # daemon's _cluster back reference live — None (not a cluster

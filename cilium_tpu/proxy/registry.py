@@ -17,6 +17,7 @@ cassandra/memcached proofs).
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -97,6 +98,46 @@ def get(name: str) -> Optional[L7Protocol]:
 
 def names() -> Tuple[str, ...]:
     return tuple(sorted(_registry))
+
+
+# -- per-plugin parse latency -------------------------------------------
+# Upstream's Envoy proxy exports per-listener histogram stats; here the
+# registry is the shared seam every plugin's parse+verdict rides
+# through, so the parse-latency histograms live beside it.  Keyed by
+# plugin/kind NAME ("http", "dns", "kafka", "cassandra", ...).  The L7
+# workers record into these from the ``l7`` domain; snapshots feed
+# ``proxy stats`` / GET /proxy/stats / BENCH_l7.json percentiles.
+_lat_lock = threading.Lock()
+_latency: Dict[str, object] = {}
+
+
+def observe_parse(name: str, us: float) -> None:
+    # thread-affinity: any
+    """Record one parse+verdict latency (µs) for plugin ``name``."""
+    from ..serving.stats import LatencyHistogram
+
+    h = _latency.get(name)
+    if h is None:
+        with _lat_lock:
+            h = _latency.setdefault(name, LatencyHistogram())
+    h.record(us)
+
+
+def latency_snapshot() -> Dict[str, dict]:
+    """Per-plugin parse-latency percentiles (p50/p95/p99/max/count)."""
+    with _lat_lock:
+        items = list(_latency.items())
+    # lint: disable=CTA002 -- .snapshot here is LatencyHistogram's, not FlowAnalytics'
+    return {name: h.snapshot() for name, h in items}
+
+
+def latency_histogram(name: str):
+    """The live histogram for ``name`` (created on first use) — the
+    obs registry collects these directly."""
+    from ..serving.stats import LatencyHistogram
+
+    with _lat_lock:
+        return _latency.setdefault(name, LatencyHistogram())
 
 
 def featurize_generic(kind: int, requests: Sequence[dict], port: int,

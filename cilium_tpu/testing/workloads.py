@@ -436,6 +436,82 @@ class PortScanScenario(Scenario):
             sent += n
 
 
+class L7AbuseScenario(Scenario):
+    """Port-scan-shaped probes against a victim whose one open port
+    carries an L7 HTTP redirect rule (ISSUE 16): a slice of the sweep
+    lands on the redirect port and verdicts REDIRECT — feeding the
+    serving L7 plane a sustained redirect stream under drop pressure
+    — while the rest of the sweep default-denies.  Proves the proxy
+    plane's no-silent-loss ledger (``redirected == l7_allowed +
+    l7_denied + l7_shed + l7_failed``) closes under recon-shaped
+    abuse, not just clean traffic."""
+
+    name = "l7_abuse"
+    criteria = {"ledger_exact": True, "l7_ledger_exact": True,
+                "min_l7_redirected": 1, "max_shed_frac": 0.95,
+                "min_drop_frac": 0.25}
+    path = "serving"
+    daemon_overrides = {"serving_bucket_ladder": (512,),
+                        "serving_queue_depth": 1 << 14,
+                        "spike_min_drops": 64}
+
+    def __init__(self, seed: int = 0, n_packets: int = 4096,
+                 batch: int = 512, redirect_port: int = 80,
+                 redirect_every: int = 4):
+        if n_packets < 1 or batch < 1:
+            raise ValueError("n_packets and batch must be >= 1")
+        if redirect_every < 1:
+            raise ValueError("redirect_every must be >= 1")
+        self.seed = int(seed)
+        self.n_packets = int(n_packets)
+        self.batch = int(batch)
+        self.redirect_port = int(redirect_port)
+        self.redirect_every = int(redirect_every)
+
+    def setup(self, target) -> dict:
+        ep = target.add_endpoint("l7-victim", ("10.0.47.1",),
+                                 ["k8s:app=l7-victim"])
+        target.policy_import([{
+            "endpointSelector": {"matchLabels":
+                                 {"app": "l7-victim"}},
+            "ingress": [{"fromEntities": ["world"],
+                         "toPorts": [{
+                             "ports": [
+                                 {"port": str(self.redirect_port),
+                                  "protocol": "TCP"}],
+                             "rules": {"http": [
+                                 {"method": "GET",
+                                  "path": "/public"}]},
+                         }]}],
+        }])
+        return {"ep": ep.id}
+
+    def iter_batches(self, ep: int) -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(self.seed)
+        src = _ip("172.20.0.9")
+        dst = _ip("10.0.47.1")
+        sent = 0
+        while sent < self.n_packets:
+            n = min(self.batch, self.n_packets - sent)
+            out = _rows(n)
+            out[:, COL_SRC_IP3] = src
+            out[:, COL_SPORT] = rng.integers(1024, 65535, n)
+            out[:, COL_DST_IP3] = dst
+            dports = rng.integers(1, 65535, n).astype(np.uint32)
+            # every redirect_every-th probe hits the L7 port: the
+            # sweep's recon shape stays, the redirect stream is
+            # deterministic and non-empty
+            idx = np.arange(sent, sent + n)
+            dports[idx % self.redirect_every == 0] = \
+                self.redirect_port
+            out[:, COL_DPORT] = dports
+            out[:, COL_FLAGS] = TCP_SYN
+            out[:, COL_LEN] = rng.integers(40, 60, n)
+            out[:, COL_EP] = ep
+            yield out
+            sent += n
+
+
 class NatExhaustionScenario(Scenario):
     """An egress ramp of unique pod -> world flows sized past the
     SNAT port pool: once every probe-window slot is live, allocation
@@ -673,6 +749,7 @@ SCENARIOS = {
     IdentityChurnScenario.name: IdentityChurnScenario,
     SynFloodScenario.name: SynFloodScenario,
     PortScanScenario.name: PortScanScenario,
+    L7AbuseScenario.name: L7AbuseScenario,
     NatExhaustionScenario.name: NatExhaustionScenario,
     ElephantMiceScenario.name: ElephantMiceScenario,
     EndpointChurnScenario.name: EndpointChurnScenario,
@@ -763,6 +840,12 @@ def evaluate_criteria(criteria: Dict[str, object],
         elif key == "min_drop_frac":
             frac = metrics.get("drop_frac")
             checks[key] = frac is not None and frac >= float(want)
+        elif key == "l7_ledger_exact":
+            checks[key] = bool(metrics.get("l7_ledger_exact")) \
+                == bool(want)
+        elif key == "min_l7_redirected":
+            checks[key] = (metrics.get("l7_redirected", 0)
+                           >= int(want))
         else:
             checks[key] = False
     return checks
@@ -845,7 +928,9 @@ def run_scenario(daemon, scenario, *, ctx: Optional[dict] = None,
             while q.pending > q.capacity // 2:
                 time.sleep(0.001)
                 tick_ops(time.perf_counter() - t0)
-        fe = daemon.stop_serving()["front-end"]
+        st = daemon.stop_serving()
+        fe = st["front-end"]
+        l7 = st.get("l7") or {}
         dt = max(time.perf_counter() - t0, 1e-9)
         ft = fe["fault-tolerance"]
         ledger_exact = fe["submitted"] == (
@@ -857,6 +942,7 @@ def run_scenario(daemon, scenario, *, ctx: Optional[dict] = None,
         submitted = fe["submitted"]
         pps = verdicts / dt
     else:  # offline: the process_batch pipeline (LB -> SNAT -> step)
+        l7 = {}
         t0 = time.perf_counter()
         for b in scenario.iter_batches(ep):
             evb = daemon.process_batch(b)
@@ -893,6 +979,14 @@ def run_scenario(daemon, scenario, *, ctx: Optional[dict] = None,
             int(r): int(n) for r, n in enumerate(reason_delta)
             if r and n},
         "elapsed_s": round(dt, 3),
+        # L7 proxy-plane ledger (ISSUE 16): rows that verdicted
+        # REDIRECT and their fate through the worker pool
+        "l7_redirected": int(l7.get("redirected", 0)),
+        "l7_allowed": int(l7.get("l7-allowed", 0)),
+        "l7_denied": int(l7.get("l7-denied", 0)),
+        "l7_shed": int(l7.get("l7-shed", 0)),
+        "l7_failed": int(l7.get("l7-failed", 0)),
+        "l7_ledger_exact": bool(l7.get("ledger-exact", False)),
     }
     checks = evaluate_criteria(scenario.criteria, metrics)
     return {
@@ -971,6 +1065,10 @@ def _run_scenario_cluster(cluster, scenario, *,
     submitted = led["submitted"]
     verdicts = shed = 0
     p99 = None
+    l7_sums = {"redirected": 0, "l7-allowed": 0, "l7-denied": 0,
+               "l7-shed": 0, "l7-failed": 0}
+    l7_exact = True
+    l7_seen = False
     for node_st in st["per-node"].values():
         fe = node_st.get("front-end") or {}
         verdicts += fe.get("verdicts", 0)
@@ -981,6 +1079,14 @@ def _run_scenario_cluster(cluster, scenario, *,
             # is the conservative cluster-wide read (the true p99 is
             # never worse than the worst node's)
             p99 = node_p99 if p99 is None else max(p99, node_p99)
+        nl7 = node_st.get("l7")
+        if nl7:
+            l7_seen = True
+            for k in l7_sums:
+                l7_sums[k] += int(nl7.get(k, 0))
+            # cluster-wide exactness = every node's pool closed its
+            # own ledger (sums of exact ledgers are exact)
+            l7_exact = l7_exact and bool(nl7.get("ledger-exact"))
     shed_all = (shed + led["router-overflow"]
                 + led["failover-dropped"] + led["crash-dropped"])
     p1 = pressures()
@@ -1021,6 +1127,12 @@ def _run_scenario_cluster(cluster, scenario, *,
             int(r): int(n) for r, n in enumerate(reason_delta)
             if r and n},
         "elapsed_s": round(dt, 3),
+        "l7_redirected": l7_sums["redirected"],
+        "l7_allowed": l7_sums["l7-allowed"],
+        "l7_denied": l7_sums["l7-denied"],
+        "l7_shed": l7_sums["l7-shed"],
+        "l7_failed": l7_sums["l7-failed"],
+        "l7_ledger_exact": bool(l7_seen and l7_exact),
         "cluster": {
             "mode": cluster.mode,
             "nodes": len(cluster.nodes),

@@ -69,7 +69,12 @@ def _run_everything(tmp_path, duration_s: float, nodes: int = 2,
         # -- the worlds: every scenario's endpoints/policy fan out
         # over the kvstore; policy publishes COALESCE to the newest
         # revision, so convergence is awaited per import
-        mix_names = ("syn_flood", "port_scan", "elephant_mice")
+        # l7_abuse points the gate at the L7 proxy plane (ISSUE 16):
+        # a slice of its sweep verdicts REDIRECT and detours through
+        # each node's worker pool, whose ledger must close with
+        # everything else on
+        mix_names = ("syn_flood", "port_scan", "elephant_mice",
+                     "l7_abuse")
         mix = {}
         ctxs = {}
         for name in mix_names:
@@ -186,6 +191,9 @@ def _run_everything(tmp_path, duration_s: float, nodes: int = 2,
             "incidents": {
                 n.name: n.daemon.flightrec.stats()
                 ["incidents-by-kind"] for n in c.nodes},
+            "l7": {name: (st or {}).get("l7") or {}
+                   for name, st in (final.get("per-node")
+                                    or {}).items()},
         }
         return result
     finally:
@@ -211,6 +219,12 @@ def _assert_everything(r):
     assert sum(ag["ingested"] for ag in led["agg"].values()) > 0
     for name, ag in led["agg"].items():
         assert ag["exact"], (name, ag)
+    # the L7 proxy plane saw redirect traffic and every node's pool
+    # ledger closed (redirected == allowed + denied + shed + failed)
+    assert sum(l7.get("redirected", 0)
+               for l7 in r["l7"].values()) > 0, r["l7"]
+    for name, l7 in r["l7"].items():
+        assert l7.get("ledger-exact"), (name, l7)
     # zero serving-executable recompiles during the mixed run
     assert r["compiles1"] == r["compiles0"], (r["compiles0"],
                                               r["compiles1"])

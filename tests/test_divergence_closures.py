@@ -280,6 +280,144 @@ def test_fromcidr_selects_later_minted_specific_identity(backend):
     assert int(ev.verdict[1]) != VERDICT_ALLOW, backend
 
 
+# -- ISSUE 16: the closures hold on the REDIRECT verdict path ---------
+# Each closed divergence above changed which peers/ports a rule
+# covers; an L7 ("rules") block on the same rule turns its ALLOW into
+# REDIRECT, so the closures must reproduce with verdict 3 + a proxy
+# port — on both backends — or the L7 plane inspects the wrong flows.
+
+@pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+def test_named_port_http_redirect_per_endpoint(backend):
+    """#7 x REDIRECT: an http rule on named port "web" redirects on
+    each endpoint's OWN binding only — b's 9090 must not detour
+    traffic aimed at a, nor a's 8080 at b."""
+    from cilium_tpu.policy.mapstate import VERDICT_REDIRECT
+
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+    a = d.add_endpoint("a", ("10.0.1.1",), ["k8s:app=a"],
+                       named_ports={"web": 8080})
+    b = d.add_endpoint("b", ("10.0.1.2",), ["k8s:app=b"],
+                       named_ports={"web": 9090})
+    d.add_endpoint("client", ("10.0.1.9",), ["k8s:app=client"])
+    http = {"http": [{"method": "GET"}]}
+    d.policy_import([
+        {"endpointSelector": {"matchLabels": {"app": "a"}},
+         "ingress": [{"fromEndpoints": [{"matchLabels":
+                                         {"app": "client"}}],
+                      "toPorts": [{"ports": [
+                          {"port": "web", "protocol": "TCP"}],
+                          "rules": http}]}]},
+        {"endpointSelector": {"matchLabels": {"app": "b"}},
+         "ingress": [{"fromEndpoints": [{"matchLabels":
+                                         {"app": "client"}}],
+                      "toPorts": [{"ports": [
+                          {"port": "web", "protocol": "TCP"}],
+                          "rules": http}]}]},
+    ])
+    batch = make_batch([
+        dict(src="10.0.1.9", dst="10.0.1.1", sport=40001, dport=8080,
+             proto=6, flags=TCP_SYN, ep=a.id, dir=0),
+        dict(src="10.0.1.9", dst="10.0.1.1", sport=40002, dport=9090,
+             proto=6, flags=TCP_SYN, ep=a.id, dir=0),
+        dict(src="10.0.1.9", dst="10.0.1.2", sport=40003, dport=9090,
+             proto=6, flags=TCP_SYN, ep=b.id, dir=0),
+        dict(src="10.0.1.9", dst="10.0.1.2", sport=40004, dport=8080,
+             proto=6, flags=TCP_SYN, ep=b.id, dir=0),
+    ]).data
+    ev = d.process_batch(batch, now=5)
+    verdicts = [int(v) for v in ev.verdict]
+    assert verdicts[0] == VERDICT_REDIRECT, backend
+    assert int(ev.proxy_port[0]) > 0, backend
+    assert verdicts[1] not in (VERDICT_ALLOW, VERDICT_REDIRECT)
+    assert verdicts[2] == VERDICT_REDIRECT, backend
+    assert int(ev.proxy_port[2]) > 0, backend
+    assert verdicts[3] not in (VERDICT_ALLOW, VERDICT_REDIRECT)
+
+
+@pytest.mark.parametrize("backend", ["tpu", "interpreter"])
+def test_tocidr_http_redirect_admits_late_minted_slash32(backend):
+    """#8 x REDIRECT: a toCIDR /16 redirect rule keeps REDIRECTING
+    traffic whose destination gains a later-minted /32 identity
+    inside the range — the /32 beats the /16 in the LPM, so only the
+    parent-prefix LABEL join (via the incremental patch path) can
+    keep the detour alive."""
+    from cilium_tpu.policy.mapstate import VERDICT_REDIRECT
+
+    d = Daemon(DaemonConfig(backend=backend, ct_capacity=1 << 12))
+    ep = d.add_endpoint("web-1", ("10.0.1.1",), ["k8s:app=web"])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "web"}},
+        "egress": [
+            {"toFQDNs": ["cdn.example.com"],
+             "toPorts": [{"ports": [{"port": "80",
+                                     "protocol": "TCP"}]}]},
+            {"toCIDR": ["198.51.0.0/16"],
+             "toPorts": [{"ports": [{"port": "443",
+                                     "protocol": "TCP"}],
+                          "rules": {"http": [{"method": "GET"}]}}]},
+        ],
+    }])
+    d.start()
+
+    def probe(sport):
+        ev = d.process_batch(make_batch([
+            dict(src="10.0.1.1", dst="198.51.100.7", sport=sport,
+                 dport=443, proto=6, flags=TCP_SYN, ep=ep.id,
+                 dir=1)]).data, now=5)
+        return int(ev.verdict[0]), int(ev.proxy_port[0])
+
+    v0, p0 = probe(40001)  # pre-mint: the /16 LPM entry matches
+    assert v0 == VERDICT_REDIRECT and p0 > 0, backend
+    # the fqdn loop mints 198.51.100.7/32 AFTER the rule resolved
+    d.proxy.observe_answer("cdn.example.com", ["198.51.100.7"],
+                           ttl=600)
+    v1, p1 = probe(40002)
+    assert v1 == VERDICT_REDIRECT, backend  # still detoured
+    assert p1 == p0, backend  # ...to the SAME listener
+
+
+def test_dns_matchpattern_per_label_through_the_plane():
+    """#9 x REDIRECT: the per-label wildcard grammar applied by the
+    L7 plane's worker leg — one redirected row group, one query a
+    single label deep (allowed) and one two labels deep (denied),
+    both counted in the pool ledger."""
+    from cilium_tpu.policy.mapstate import VERDICT_REDIRECT
+    from cilium_tpu.serving.l7plane import L7Plane
+
+    d = Daemon(DaemonConfig(backend="tpu", ct_capacity=1 << 12))
+    ep = d.add_endpoint("client-1", ("10.0.1.1",),
+                        ["k8s:app=client"])
+    d.policy_import([{
+        "endpointSelector": {"matchLabels": {"app": "client"}},
+        "egress": [{
+            "toEntities": ["world"],
+            "toPorts": [{"ports": [{"port": "53",
+                                    "protocol": "UDP"}],
+                         "rules": {"dns": [
+                             {"matchPattern":
+                              "*.example.com"}]}}]}],
+    }])
+    d.start()
+    evb = d.process_batch(make_batch([
+        dict(src="10.0.1.1", dst="8.8.8.8", sport=40001, dport=53,
+             proto=17, flags=TCP_SYN, ep=ep.id, dir=1),
+        dict(src="10.0.1.1", dst="8.8.8.8", sport=40002, dport=53,
+             proto=17, flags=TCP_SYN, ep=ep.id, dir=1),
+    ]).data, now=5)
+    assert all(int(v) == VERDICT_REDIRECT for v in evb.verdict)
+    plane = L7Plane(
+        d.proxy,
+        request_source=lambda port, kind, task:
+            ["ok.example.com", "deep.sub.example.com"])
+    plane.start()
+    assert plane.ingest(evb) == 2  # one (port, identity) group
+    st = plane.stop()
+    assert st["l7-allowed"] == 1  # ok.example.com
+    assert st["l7-denied"] == 1  # the old spanned-dots hole
+    assert st["redirected"] == 2 and st["ledger-exact"]
+    d.shutdown()
+
+
 @pytest.mark.parametrize("backend", ["tpu", "interpreter"])
 def test_fromcidr_except_excludes_inner_range(backend):
     """fromCIDR with except: identities inside the excepted range
