@@ -2093,9 +2093,9 @@ def _run_scenarios_phase() -> None:
 
 def bench_cluster(target_packets=98304, reps=3) -> dict:
     """--cluster: the clustermesh serving tier phase (ISSUE 8 +
-    ISSUE 13) -> BENCH_cluster.json.
+    ISSUE 13 + ISSUE 17) -> BENCH_cluster.json.
 
-    Four legs, CPU-bounded and deterministic:
+    Eight legs, CPU-bounded and deterministic:
 
     - SCALING-vs-NODES, PER MODE (``thread`` and ``process``):
       sustained verdicts/sec through the cluster front end at
@@ -2128,7 +2128,42 @@ def bench_cluster(target_packets=98304, reps=3) -> dict:
     - LIVE SCALE-OUT (process mode): ``add_node()`` on the serving
       cluster — build/converge/warm off to the side, freeze +
       quiesce, slot re-pin + CT migration, resume; the pause window
-      and survivor recompile count ship in the artifact."""
+      and survivor recompile count ship in the artifact.
+
+    v3 legs (ISSUE 17 — the pipelined data channel):
+
+    - PIPELINED THROUGHPUT (process mode, ONE node, small frames):
+      window=1 (the PR 13 sync-ack protocol, byte-identical wire)
+      vs window=8 (credit-windowed streaming with coalesced acks),
+      through the ``paired_legs`` harness — ``pipelined_speedup``
+      is the PAIR-MEDIAN of windowed/sync ratios.  Small frames on
+      purpose: the channel is ACK-CADENCE-bound, the regime the
+      window exists for (big frames amortize the RTT and hide it).
+      Same ``host_cores`` honesty floor as the scaling curve: the
+      overlap win needs parent and worker on separate cores — a
+      1-core host shows only the ack-coalescing share of it.
+
+    - FORWARD-LATENCY p50 AT LOW LOAD, sync vs pipelined: one small
+      frame at a time, fully landed before the next — the window
+      must not buy throughput by selling latency
+      (``latency_p50_ratio`` is pipelined/sync; target <= 1.5x —
+      what the worker's flush-on-drain ack exists for).
+      Both sides measure the SAME enqueue->acked interval (the sync
+      path's blocking submit and the windowed path's cumulative-ack
+      retire record into one histogram).
+
+    - SIGKILL MID-WINDOW (process mode): the corpse dies with the
+      credit window OPEN — sent-but-unacked frames outstanding.
+      The last cumulative ack is the final word; everything past it
+      requeues to the failover peer or lands ``crash_dropped``, and
+      the ledger closes EXACTLY (the property test's claim, re-made
+      against a real process corpse under real load).
+
+    - LIVE SCALE-IN (process mode): ``remove_node()`` on the
+      serving cluster — freeze + quiesce (window drained), victim
+      CT migrated out, slots re-pinned onto survivors, victim
+      retired; the pause window and the ZERO survivor-recompile
+      count ship in the artifact."""
     import ipaddress
     import os as _os
 
@@ -2180,9 +2215,9 @@ def bench_cluster(target_packets=98304, reps=3) -> dict:
                                     "protocol": "TCP"}]}]}],
     }]
 
-    def build(n_nodes, mode):
+    def build(n_nodes, mode, **over):
         c = ClusterServing(nodes=n_nodes,
-                           config=cfg(cluster_mode=mode))
+                           config=cfg(cluster_mode=mode, **over))
         c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
         db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
         rev = c.policy_import(RULES)
@@ -2339,9 +2374,198 @@ def bench_cluster(target_packets=98304, reps=3) -> dict:
 
     so = scale_out_leg()
     ledger_ok = ledger_ok and so["ledger_exact"]
+
+    # -- v3: the pipelined data channel (ISSUE 17) --------------------
+    FRAME = 128       # small frames: the channel is ack-cadence-
+    WAVE_FRAMES = 128  # bound, the regime the window exists for
+    WAVES = 9
+    WINDOW = cfg().cluster_forward_window  # the shipped default
+
+    def window_leg(window: int) -> float:
+        """Per-node forward throughput through ONE process-mode
+        channel at the given credit window.  window=1 degenerates to
+        the PR 13 sync-ack protocol (one frame in flight, one ack
+        per frame, byte-identical wire) — the baseline side of the
+        paired legs.  The timed interval per WAVE is push-from-idle
+        to all-RETIRED (sync: the blocking submit returned = acked;
+        windowed: the cumulative ack covered it) — the channel rate,
+        with the worker's verdict pipeline draining UNTIMED between
+        waves so the verdict executor's throughput does not cap both
+        sides into a false tie.  Median-of-waves damps scheduler
+        weather (this leg is switch-cost-sensitive on small hosts).
+        HONESTY FLOOR: the overlap win (parent packs frame k+1 while
+        the worker admits frame k) needs parent and worker on
+        SEPARATE cores; a 1-core host time-slices them and the
+        measured win shrinks to what ack-coalescing alone buys
+        (fewer wakeups + 1/ack_every of the ack legs) — the >=2x
+        claim needs ``host_cores`` >= 2, same convention as the
+        scaling curve."""
+        c, db = build(1, "process", cluster_forward_window=window)
+        try:
+            frames = [batch(FRAME, db.id) for _ in range(16)]
+            wave_rows = WAVE_FRAMES * FRAME
+
+            def accounted():
+                return c.ledger()["per-node-accounted"]
+
+            def fwd():
+                # dirty read on purpose: a locked snapshot() in the
+                # poll loop would stall the ack reader's retire path
+                # and bill the contention to the thing measured
+                return sum(c.router.forwarded)
+
+            for i in range(8):  # settle wave, untimed
+                c.submit(frames[i % len(frames)])
+            t0 = time.perf_counter()
+            while accounted() < 8 * FRAME:
+                if time.perf_counter() - t0 > 120:
+                    raise TimeoutError("window settle stalled")
+                time.sleep(0.002)
+            rates = []
+            for w in range(WAVES):
+                # drain: worker queue empty before the timed push
+                t0 = time.perf_counter()
+                while accounted() < 8 * FRAME + w * wave_rows:
+                    if time.perf_counter() - t0 > 120:
+                        raise TimeoutError("window drain stalled")
+                    time.sleep(0.002)
+                f0 = fwd()
+                t0 = time.perf_counter()
+                for i in range(WAVE_FRAMES):
+                    got = c.submit(frames[i % len(frames)])
+                    assert got == FRAME, "router backpressured"
+                while fwd() - f0 < wave_rows:
+                    if time.perf_counter() - t0 > 120:
+                        raise TimeoutError("window wave stalled")
+                    time.sleep(0.0005)
+                rates.append(wave_rows / (time.perf_counter() - t0))
+            st = c.stop()
+            assert st["ledger"]["exact"], st["ledger"]
+            rates.sort()
+            return rates[len(rates) // 2]
+        finally:
+            c.shutdown()
+            time.sleep(0.5)
+
+    window_leg(WINDOW)  # untimed warm leg
+    pipe = paired_legs(lambda: window_leg(1),
+                       lambda: window_leg(WINDOW), reps=reps)
+
+    def latency_leg(window: int) -> float:
+        """Forward-latency p50 at LOW load: ONE small frame at a
+        time, fully landed before the next, idle gaps in between —
+        the regime where the worker's flush-on-drain acks each
+        frame immediately (channel empty after the admit) and the
+        window must not cost latency over the sync baseline."""
+        c, db = build(1, "process", cluster_forward_window=window)
+        try:
+            def accounted():
+                return c.ledger()["per-node-accounted"]
+
+            done = 0
+            for _ in range(192):
+                c.submit(batch(64, db.id))
+                done += 64
+                t0 = time.perf_counter()
+                while accounted() < done:
+                    if time.perf_counter() - t0 > 60:
+                        raise TimeoutError("latency leg stalled")
+                    time.sleep(0.0005)
+                time.sleep(0.002)  # low load: idle gap per frame
+            st = c.stop()
+            assert st["ledger"]["exact"], st["ledger"]
+            lat = (st["cluster"]["router"]
+                   or {})["forward-latency-us"]
+            return float(lat["p50"])
+        finally:
+            c.shutdown()
+            time.sleep(0.5)
+
+    lat_sync = latency_leg(1)
+    lat_pipe = latency_leg(WINDOW)
+
+    def sigkill_mid_window_rep() -> dict:
+        """SIGKILL a worker with the credit window OPEN — frames
+        sent-but-unacked at the corpse.  The last cumulative ack is
+        the final word; everything past it requeues to the failover
+        peer or lands ``crash_dropped``, and the ledger closes
+        EXACTLY — the property test's claim against a real corpse
+        under real load."""
+        c, db = build(2, "process")
+        try:
+            c.submit(batch(BUCKET, db.id))
+            t0 = time.perf_counter()
+            while c.ledger()["per-node-accounted"] < BUCKET:
+                if time.perf_counter() - t0 > 120:
+                    raise TimeoutError("mid-window warm stalled")
+                time.sleep(0.002)
+            c.snapshot_now()  # parent-retained replica per worker
+            # open the window: a burst of small frames, then the
+            # kill lands while they are in flight
+            for _ in range(64):
+                c.submit(batch(FRAME, db.id))
+            win = (c.router.snapshot().get("window") or {})
+            inflight_at_kill = win.get("inflight-frames", 0)
+            c.node("node1").proc.kill()  # raw SIGKILL mid-window
+            while not c.membership.is_dead("node1"):
+                c.submit(batch(FRAME, db.id))
+                if time.perf_counter() - t0 > 120:
+                    raise TimeoutError("death never detected")
+                time.sleep(0.002)
+            while c.failovers_total() < 1:
+                if time.perf_counter() - t0 > 120:
+                    raise TimeoutError("failover never completed")
+                time.sleep(0.002)
+            c.submit(batch(BUCKET, db.id))  # survivor keeps serving
+            st = c.stop()
+            assert st["ledger"]["exact"], st["ledger"]
+            return {
+                "inflight_frames_at_kill": inflight_at_kill,
+                "crash_dropped": st["ledger"]["crash-dropped"],
+                "failover_dropped":
+                    st["ledger"]["failover-dropped"],
+                "ledger_exact": st["ledger"]["exact"],
+            }
+        finally:
+            c.shutdown()
+
+    skw = [sigkill_mid_window_rep() for _ in range(reps)]
+    ledger_ok = ledger_ok and all(r["ledger_exact"] for r in skw)
+
+    def scale_in_leg() -> dict:
+        """remove_node() on a live 3-worker cluster under
+        established flows: quiesce (window drained), victim CT
+        migrated onto survivors, slots re-pinned, victim retired —
+        with ZERO survivor recompiles and the ledger exact across
+        the transition."""
+        c, db = build(3, "process")
+        try:
+            c.submit(batch(BUCKET, db.id))
+            t0 = time.perf_counter()
+            while c.ledger()["per-node-accounted"] < BUCKET:
+                if time.perf_counter() - t0 > 120:
+                    raise TimeoutError("scale-in leg stalled")
+                time.sleep(0.002)
+            rec = c.remove_node()
+            c.submit(batch(BUCKET, db.id))
+            st = c.stop()
+            assert st["ledger"]["exact"], st["ledger"]
+            return {
+                "pause_ms": rec["pause-ms"],
+                "moved_slots": rec["moved-slots"],
+                "ct_migrated_entries": rec["ct-migrated-entries"],
+                "survivor_recompiles": rec["survivor-recompiles"],
+                "ledger_exact": st["ledger"]["exact"],
+            }
+        finally:
+            c.shutdown()
+
+    si = scale_in_leg()
+    ledger_ok = ledger_ok and si["ledger_exact"]
+
     proc = modes_out["process"]
     return {
-        "schema": "bench-cluster-v2",
+        "schema": "bench-cluster-v3",
         "best_of": reps,
         "host_cores": _os.cpu_count(),
         "mode": "process",  # the headline curve below
@@ -2360,6 +2584,21 @@ def bench_cluster(target_packets=98304, reps=3) -> dict:
         "failover_mode": "process",
         "failover_reps": fo,
         "scale_out": so,
+        # -- v3: the pipelined data channel (ISSUE 17) ----------------
+        "forward_window": WINDOW,
+        "pipelined_speedup": pipe["ratio_median"],
+        "pipelined_speedup_pairs": pipe["pairs"],
+        "pipelined_speedup_spread": pipe["spread"],
+        "latency_p50_sync_us": lat_sync,
+        "latency_p50_pipelined_us": lat_pipe,
+        "latency_p50_ratio": (round(lat_pipe / lat_sync, 4)
+                              if lat_sync else None),
+        # headline rep: the one killed with the MOST frames in
+        # flight — the deepest mid-window corpse the run produced
+        "sigkill_mid_window": max(
+            skw, key=lambda r: r["inflight_frames_at_kill"]),
+        "sigkill_mid_window_reps": skw,
+        "scale_in": si,
         "ledger_exact": ledger_ok,
     }
 

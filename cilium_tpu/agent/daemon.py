@@ -304,15 +304,35 @@ class DaemonConfig:
     # per sample (router-queue -> forward -> worker-admit -> ack).
     # 0 = off (the hot-path cost when off is one int compare)
     cluster_trace_sample: int = 0
+    # -- pipelined data channel (ISSUE 17).  Frames a process-mode
+    # forwarder may have ON THE WIRE (sent, not yet cumulatively
+    # acked) per node before it blocks for credit.  1 = the PR 13
+    # synchronous per-frame-ack protocol, byte-identical on the wire;
+    # >= 2 switches to sequenced frames + cumulative acks and pays
+    # the round trip once per window
+    cluster_forward_window: int = 8
+    # worker-side ack coalescer: one cumulative ack per this many
+    # admitted frames (or immediately when the channel drains —
+    # nothing else buffered after an admit — so low-load frames ack
+    # sync-like)...
+    cluster_ack_every: int = 4
+    # ...or after this many ms of quiet (the flush-on-idle timer that
+    # bounds the tail latency coalescing could otherwise add)
+    cluster_ack_flush_ms: float = 2.0
     # -- queue-depth autoscale (cluster/scale.py ClusterAutoscaler).
     # When ON, a named controller samples the router's forward queues
     # and add_node()s after `ticks` consecutive samples over
-    # `high_frac * cluster_forward_depth`, up to `max_nodes`
+    # `high_frac * cluster_forward_depth`, up to `max_nodes`;
+    # when `low_frac` > 0 it also remove_node()s after `ticks`
+    # consecutive samples under `low_frac * cluster_forward_depth`,
+    # down to `min_nodes` (scale-in, ISSUE 17)
     cluster_autoscale: bool = False
     cluster_autoscale_max_nodes: int = 8
     cluster_autoscale_high_frac: float = 0.5
     cluster_autoscale_ticks: int = 3
     cluster_autoscale_interval_s: float = 0.5
+    cluster_autoscale_min_nodes: int = 1
+    cluster_autoscale_low_frac: float = 0.0
     # -- live policy churn (datapath/tables.py table versioning;
     # ISSUE 10).  Delta attach: repaint only fingerprint-changed
     # policies on a re-attach instead of recompiling the world
@@ -880,6 +900,8 @@ class Daemon:
         single copy would silently diverge thread-mode and
         process-mode merged views (the PR 12 warm-recipe regression
         class)."""
+        from ..proxy import registry as l7registry
+
         fls, new_cursor = self.observer.flows_since(int(cursor),
                                                     limit=int(flows))
         s = self._serving
@@ -891,6 +913,10 @@ class Daemon:
             "top": self.flows_aggregate(top=int(top)),
             "trace": tr.stats() if tr is not None else None,
             "incidents": self.flightrec.incidents(),
+            # per-plugin L7 parse latency (ISSUE 17 — PR 16 residue
+            # c): the relay renders it node+plugin-labeled in the
+            # merged exposition instead of summed across plugins
+            "l7-by-plugin": l7registry.latency_snapshot(),
         }
 
     def add_relay_peer(self, name: str, observer) -> None:

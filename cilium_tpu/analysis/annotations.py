@@ -25,9 +25,15 @@ from .core import FileCtx, Finding
 # membership/failover orchestration threads.  "l7" = the L7 proxy
 # worker pool (proxy/worker.py): redirected rows' parse + verdict
 # threads — a hot domain (see hotpath.HOT_DOMAINS): a redirect's
-# detour latency is that flow's serving latency.
+# detour latency is that flow's serving latency.  "ackflush" = the
+# worker-side ack-coalescer flush timer (cluster/nodehost.py
+# _ack_flush_loop, ISSUE 17): a sleepy periodic thread that only
+# flushes the pending cumulative ack — NOT a hot domain (the data
+# thread flushes inline at the ack_every stride; the timer bounds
+# idle-tail latency only).
 AFFINITIES = ("drain", "event-worker", "watchdog", "capture", "api",
-              "cli", "offline", "router", "transport", "l7", "any")
+              "cli", "offline", "router", "transport", "l7",
+              "ackflush", "any")
 
 _GUARDED_LIST_RE = re.compile(
     r"#\s*guarded-by:\s*(?P<lock>[\w.-]+)\s*:\s*(?P<attrs>[\w,\s]+)$")

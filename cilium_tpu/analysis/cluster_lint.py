@@ -14,7 +14,9 @@ feeds a declared counter.  Statically enforced:
    cannot see;
 2. every declared counter has its prometheus series
    (``cilium_cluster_<name>_total``) registered in the metrics
-   registry module — counted must also mean scrapeable;
+   registry module — counted must also mean scrapeable; likewise
+   every :data:`REQUIRED_SERIES` entry (the pipelined-window
+   credit-loop gauges/counters, ISSUE 17);
 3. ``REASON_CLUSTER_OVERFLOW`` exists in the reason space and every
    ``DROP_REASON_*`` decode table covers it (CTA005 enforces this
    generically; CTA008 names the cluster code specifically so a
@@ -55,7 +57,12 @@ BENCH_NAME = "BENCH_cluster.json"
 # carries both per-mode curves (paired-leg ratios + spread + forward
 # latency percentiles), `host_cores` is the honesty floor (a 1-core
 # host cannot show N-core speedups in any mode), and the failover
-# leg is a real SIGKILL with crash_dropped in the ledger
+# leg is a real SIGKILL with crash_dropped in the ledger.
+# v3 (ISSUE 17): adds the pipelined-transport legs — paired
+# interleaved sync(window=1) vs pipelined(window>=8) forward
+# throughput (per-pair ratios + spread), the low-load forward-latency
+# p50 comparison, the SIGKILL-mid-window ledger leg, and the live
+# scale-in leg (zero survivor recompiles)
 BENCH_CLUSTER_KEYS = (
     "schema", "best_of", "host_cores", "mode", "modes",
     "sustained_pps_n1", "sustained_pps_n2", "sustained_pps_n3",
@@ -66,8 +73,26 @@ BENCH_CLUSTER_KEYS = (
     "failover_crash_dropped", "failover_mode",
     "scale_out",
     "ledger_exact",
+    # -- v3: pipelined data channel --
+    "forward_window",
+    "pipelined_speedup", "pipelined_speedup_pairs",
+    "pipelined_speedup_spread",
+    "latency_p50_sync_us", "latency_p50_pipelined_us",
+    "latency_p50_ratio",
+    "sigkill_mid_window",
+    "scale_in",
 )
-BENCH_SCHEMA = "bench-cluster-v2"
+BENCH_SCHEMA = "bench-cluster-v3"
+# pipelined-transport series the registry must export (checked the
+# same way as the drop-counter series: the literal name appears in
+# the registry module).  The window counters are the observable half
+# of the credit loop — without them an operator cannot see a stalled
+# window or how much coalescing is buying.
+REQUIRED_SERIES = (
+    "cilium_cluster_inflight_frames",
+    "cilium_cluster_acks_coalesced_total",
+    "cilium_cluster_window_stalls_total",
+)
 # per-mode sub-dict floor (both entries of `modes`)
 BENCH_MODE_KEYS = (
     "sustained_pps_n1", "sustained_pps_n2", "sustained_pps_n3",
@@ -161,6 +186,16 @@ def check(repo: Repo, graph=None) -> List[Finding]:
                 CODE, REGISTRY_MODULE, 1,
                 f"router drop counter {name!r} has no registered "
                 f"series {series!r}", checker=NAME))
+
+    # 2b. pipelined-window series floor (ISSUE 17): the credit-loop
+    # gauges/counters must be registered just like the drop counters
+    for series in REQUIRED_SERIES:
+        if reg is None or f'"{series}"' not in reg.source:
+            findings.append(Finding(
+                CODE, REGISTRY_MODULE, 1,
+                f"pipelined-transport series {series!r} is not "
+                f"registered — the credit window would be "
+                f"unobservable", checker=NAME))
 
     # 3. the cluster reason code decodes everywhere
     verdict = repo.by_rel(VERDICT_MODULE)

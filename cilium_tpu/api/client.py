@@ -134,10 +134,18 @@ class APIClient:
     def cluster_status(self):
         return self._request("GET", "/cluster/status")
 
-    def cluster_scale(self):
-        """Live scale-out: add one replica to the serving tier
-        (PUT /cluster/scale); returns the scale-out record."""
-        return self._request("PUT", "/cluster/scale")
+    def cluster_scale(self, down: bool = False,
+                      node: "Optional[str]" = None):
+        """Live scale-out/in (PUT /cluster/scale): add one replica,
+        or with ``down`` retire one (``node`` picks the victim;
+        default the highest-index live node).  Returns the scale
+        record."""
+        body = None
+        if down:
+            body = {"down": True}
+            if node is not None:
+                body["node"] = node
+        return self._request("PUT", "/cluster/scale", body)
 
     # -- the cluster observability relay (ISSUE 14) --------------------
     def cluster_metrics(self) -> str:

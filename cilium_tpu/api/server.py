@@ -350,14 +350,23 @@ def _make_handler(daemon: Daemon):
                     rev = daemon.policy_import(self._body())
                     self._send(200, {"revision": rev})
                 elif path == "/cluster/scale":
-                    # live scale-out (ISSUE 13): add one replica to
-                    # the serving tier this node belongs to
+                    # live scale-out (ISSUE 13) / scale-in
+                    # (ISSUE 17): grow or shrink the serving tier
+                    # this node belongs to.  Body {"down": true
+                    # [, "node": name]} retires a replica; empty or
+                    # {"down": false} adds one
                     if daemon._cluster is None:
                         self._send(404, {
                             "error": "not part of a cluster serving "
                                      "tier (start_cluster_serving)"})
                     else:
-                        self._send(200, daemon._cluster.add_node())
+                        body = self._body() or {}
+                        if body.get("down"):
+                            self._send(200, daemon._cluster.
+                                       remove_node(body.get("node")))
+                        else:
+                            self._send(200,
+                                       daemon._cluster.add_node())
                 elif m := re.fullmatch(r"/endpoint/([\w.-]+)", path):
                     body = self._body() or {}
                     ep = daemon.add_endpoint(

@@ -183,13 +183,19 @@ def cmd_cluster(args) -> int:
     """`cilium-tpu cluster status`: the clustermesh serving tier —
     membership, routing table, failover/scale-out history, and the
     cluster-wide no-silent-loss ledger (any member node answers).
-    `cilium-tpu cluster scale` adds one replica live (ISSUE 13)."""
+    `cilium-tpu cluster scale` adds one replica live (ISSUE 13);
+    `cluster scale --down [--node NAME]` retires one (ISSUE 17)."""
     if getattr(args, "action", "status") == "scale":
-        rec = _client(args).cluster_scale()
+        down = getattr(args, "down", False)
+        rec = _client(args).cluster_scale(
+            down=down, node=getattr(args, "node", None))
         if args.json:
             _print(rec)
             return 0
-        print(f"Scaled out: {rec['node']} joined "
+        verb = ("Scaled in: {node} retired" if down
+                else "Scaled out: {node} joined").format(
+                    node=rec['node'])
+        print(f"{verb} "
               f"({rec['nodes-after']} nodes, "
               f"{rec['moved-slots']} slots re-pinned, "
               f"{rec['ct-migrated-entries']} CT entries migrated, "
@@ -1164,11 +1170,17 @@ def main(argv=None) -> int:
     p = sub.add_parser("cluster",
                        help="clustermesh serving tier: status "
                             "(membership, router, failovers, ledger)"
-                            " | scale (live add_node) | sysdump "
-                            "(all-node archive) | trace (stitched "
-                            "cross-process spans)")
+                            " | scale (live add_node; --down retires"
+                            " one) | sysdump (all-node archive) | "
+                            "trace (stitched cross-process spans)")
     p.add_argument("action", nargs="?", default="status",
                    choices=["status", "scale", "sysdump", "trace"])
+    p.add_argument("--down", action="store_true",
+                   help="scale IN: retire one replica (drain its "
+                        "send window, re-pin slots, migrate CT)")
+    p.add_argument("--node",
+                   help="scale --down victim (default: the "
+                        "highest-index live node)")
 
     p = sub.add_parser("config", help="config get | set KEY VALUE")
     p.add_argument("action", nargs="?", default="get",

@@ -85,7 +85,12 @@ def validate_cluster_config(nodes, forward_depth, probe_interval_s,
                             autoscale_interval_s=0.5,
                             obs_interval_s=1.0,
                             obs_stale_after_s=30.0,
-                            trace_sample=0):
+                            trace_sample=0,
+                            forward_window=8,
+                            ack_every=4,
+                            ack_flush_ms=2.0,
+                            autoscale_min_nodes=1,
+                            autoscale_low_frac=0.0):
     """Normalize + validate the cluster knobs (the serving-knob
     discipline: a typo'd cluster config fails at construction, not as
     a silent misroute under load)."""
@@ -147,11 +152,32 @@ def validate_cluster_config(nodes, forward_depth, probe_interval_s,
     if trace_sample < 0:
         raise ValueError("cluster_trace_sample must be >= 0 "
                          "(0 disables cross-process span stitching)")
+    forward_window = int(forward_window)
+    if forward_window < 1:
+        raise ValueError("cluster_forward_window must be >= 1 "
+                         "(1 = synchronous per-frame acks, the "
+                         "PR 13 protocol)")
+    ack_every = int(ack_every)
+    if ack_every < 1:
+        raise ValueError("cluster_ack_every must be >= 1")
+    ack_flush_ms = float(ack_flush_ms)
+    if ack_flush_ms <= 0:
+        raise ValueError("cluster_ack_flush_ms must be > 0 (the "
+                         "coalescer's flush-on-idle timer)")
+    autoscale_min_nodes = int(autoscale_min_nodes)
+    if autoscale_min_nodes < 1:
+        raise ValueError("cluster_autoscale_min_nodes must be >= 1")
+    autoscale_low_frac = float(autoscale_low_frac)
+    if not 0.0 <= autoscale_low_frac < autoscale_high_frac:
+        raise ValueError(
+            "cluster_autoscale_low_frac must be in [0, high_frac) "
+            "(0 disables autoscale scale-down)")
     return (nodes, forward_depth, probe_interval_s, death_threshold,
             convergence_deadline_s, kvstore_mode, mode, slot_factor,
             autoscale_max_nodes, autoscale_high_frac, autoscale_ticks,
             autoscale_interval_s, obs_interval_s, obs_stale_after_s,
-            trace_sample)
+            trace_sample, forward_window, ack_every, ack_flush_ms,
+            autoscale_min_nodes, autoscale_low_frac)
 
 
 def warm_serving_session(daemon, bucket: int, ep: int,
@@ -465,7 +491,9 @@ class ClusterServing:
          self.autoscale_max_nodes, self.autoscale_high_frac,
          self.autoscale_ticks, self.autoscale_interval_s,
          self.obs_interval_s, self.obs_stale_after_s,
-         self.trace_sample
+         self.trace_sample, self.forward_window, self.ack_every,
+         self.ack_flush_ms, self.autoscale_min_nodes,
+         self.autoscale_low_frac
          ) = validate_cluster_config(
             nodes, template.cluster_forward_depth,
             template.cluster_probe_interval_s,
@@ -481,7 +509,13 @@ class ClusterServing:
                 template.cluster_autoscale_interval_s),
             obs_interval_s=template.cluster_obs_interval_s,
             obs_stale_after_s=template.cluster_obs_stale_after_s,
-            trace_sample=template.cluster_trace_sample)
+            trace_sample=template.cluster_trace_sample,
+            forward_window=template.cluster_forward_window,
+            ack_every=template.cluster_ack_every,
+            ack_flush_ms=template.cluster_ack_flush_ms,
+            autoscale_min_nodes=(
+                template.cluster_autoscale_min_nodes),
+            autoscale_low_frac=template.cluster_autoscale_low_frac)
         # -- the shared identity/policy plane ---------------------------
         self._kv_server = None
         self._kv_store = None
@@ -761,11 +795,17 @@ class ClusterServing:
         self._serving_kwargs = kwargs
         for n in self.nodes:
             n.start_serving(**kwargs)
-        self.router = ClusterRouter(self.nodes, self.forward_depth,
-                                    on_overflow=self._surface_overflow,
-                                    slot_factor=self.slot_factor,
-                                    trace_sample=self.trace_sample,
-                                    span_store=self.span_store)
+        self.router = ClusterRouter(
+            self.nodes, self.forward_depth,
+            on_overflow=self._surface_overflow,
+            slot_factor=self.slot_factor,
+            trace_sample=self.trace_sample,
+            span_store=self.span_store,
+            # the credit window is a process-mode (socket transport)
+            # concept; thread-mode submits are already synchronous
+            # in-process calls with nothing to pipeline
+            forward_window=(self.forward_window
+                            if self.mode == "process" else 1))
         self.router.start()
         self.membership.start()
         self.obs.start()  # no-op when cluster_obs_interval_s == 0
@@ -777,7 +817,9 @@ class ClusterServing:
                 high_frac=self.autoscale_high_frac,
                 ticks=self.autoscale_ticks,
                 max_nodes=self.autoscale_max_nodes,
-                interval_s=self.autoscale_interval_s)
+                interval_s=self.autoscale_interval_s,
+                low_frac=self.autoscale_low_frac,
+                min_nodes=self.autoscale_min_nodes)
             self.autoscaler.start()
         self._started = True
 
@@ -800,6 +842,18 @@ class ClusterServing:
         from .scale import scale_out
 
         return scale_out(self)
+
+    def remove_node(self, name: Optional[str] = None) -> dict:
+        """Shrink a SERVING cluster by one replica (ROADMAP item 3
+        residue b — failover minus the death): freeze, drain the
+        victim's forward queue AND its open send window, re-pin its
+        slots onto the survivors, migrate the moved slots' CT to
+        each slot's new owner, retire the worker cleanly.  ``name``
+        defaults to the last live node.  Returns the scale-in record.
+        See ``cluster/scale.py``."""
+        from .scale import scale_in
+
+        return scale_in(self, name=name)
 
     def stop(self) -> dict:
         """Drain the router and every replica; returns (and retains)
@@ -908,6 +962,34 @@ class ClusterServing:
     def failovers_total(self) -> int:
         return len(self.failover.snapshot())
 
+    def scale_ins_total(self) -> int:
+        return sum(1 for e in self.scale_events
+                   if e.get("kind") == "scale-in")
+
+    def _window_counters(self) -> dict:
+        r = self.router
+        if r is None:
+            return {"acks": 0, "acks-coalesced": 0,
+                    "window-stalls": 0, "inflight-frames": 0}
+        return r.snapshot()["window"]
+
+    def inflight_frames(self) -> int:
+        """Frames sent but not yet cumulatively acked, cluster-wide
+        (the pipelined channel's live credit debt)."""
+        return self._window_counters()["inflight-frames"]
+
+    def acks_coalesced_total(self) -> int:
+        """Per-frame acks the coalescer ELIDED — each cumulative ack
+        covering k frames counts k-1 (the round trips the window
+        bought back)."""
+        return self._window_counters()["acks-coalesced"]
+
+    def window_stalls_total(self) -> int:
+        """Times a forwarder ran out of credit (send window full) and
+        had to wait for an ack — the backpressure signal that says
+        the window, not the worker, is the bottleneck."""
+        return self._window_counters()["window-stalls"]
+
     def live_dead_counts(self):
         live = sum(1 for n in self.nodes if n.alive)
         return live, len(self.nodes) - live
@@ -931,7 +1013,10 @@ class ClusterServing:
             "router": (self.router.snapshot()
                        if self.router is not None else None),
             "failovers": len(recs),
-            "scale-outs": len(self.scale_events),
+            "scale-outs": sum(1 for e in self.scale_events
+                              if e.get("kind") != "scale-in"),
+            "scale-ins": sum(1 for e in self.scale_events
+                             if e.get("kind") == "scale-in"),
         }
         if recs:
             out["last-failover"] = recs[-1]

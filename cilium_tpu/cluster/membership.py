@@ -102,6 +102,21 @@ class ClusterMembership:
         with self._lock:
             self.nodes.append(node)
 
+    def remove_node(self, name: str) -> bool:
+        # thread-affinity: api
+        """Scale-IN: take ``name`` out of the sweep WITHOUT declaring
+        it dead — a retired node must not trigger failover when its
+        process exits.  Returns whether the node was swept.  Probe
+        bookkeeping for the name is cleared so a future replica
+        reusing it starts clean."""
+        with self._lock:
+            before = len(self.nodes)
+            self.nodes = [n for n in self.nodes if n.name != name]
+            self._failures.pop(name, None)
+            self._first_fail.pop(name, None)
+            self._latency_ms.pop(name, None)
+            return len(self.nodes) != before
+
     # -- probing -------------------------------------------------------
     def _probe_loop(self) -> None:
         # thread-affinity: api -- the membership prober is a

@@ -24,9 +24,13 @@ Topology (all loopback TCP, ``cluster/transport.py`` framing):
   get a healthy node declared dead.
 - DATA channel — length-prefixed binary row frames (packed
   ``[n, 4]`` u32 when the chunk is pack-eligible, wide
-  ``[n, N_COLS]`` otherwise) each answered by a fixed-size ACK
-  carrying the node's RUNNING packet ledger (submitted, verdicts,
-  shed, recovery_dropped).  The parent retains the newest ack; a
+  ``[n, N_COLS]`` otherwise).  Legacy unsequenced frames are each
+  answered by a fixed-size ACK; SEQUENCED frames (the pipelined
+  channel, ISSUE 17) are answered CUMULATIVELY — one ack per
+  ``cluster_ack_every`` frames or ``cluster_ack_flush_ms`` of
+  quiet, carrying the highest contiguous sequence admitted plus the
+  node's RUNNING packet ledger (submitted, verdicts, shed,
+  recovery_dropped).  The parent retains the newest ack; a
   SIGKILLed worker's last ack is its final word, which is exactly
   what closes the cluster ledger over a corpse
   (``cluster/process.py`` + ``router.account_crash_loss``).
@@ -39,11 +43,15 @@ control channel only answers "which revision have you applied"
 
 THREAD AFFINITY: the data-channel reader is the worker's ``transport``
 thread (a CTA003 hot domain — recv/decode/submit/ack, nothing else);
-the control loop is ``api``.
+the control loop is ``api``; the ack-coalescer's flush-on-idle timer
+is the ``ackflush`` seam (ISSUE 17, CTA002 vocabulary) — it exists so
+a sub-``ack_every`` trickle still gets acknowledged within the flush
+window instead of waiting for frames that never come.
 """
 
 from __future__ import annotations
 
+import select
 import socket
 import threading
 import time
@@ -51,8 +59,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from .transport import (decode_rows_ex, pack_ack, recv_frame,
-                        recv_json_frame, rows_from_b64,
+from .transport import (decode_rows_seq, pack_ack, pack_cum_ack,
+                        recv_frame, recv_json_frame, rows_from_b64,
                         rows_to_b64, send_frame, send_json_frame,
                         shutdown_close)
 
@@ -88,6 +96,7 @@ OP_TIMEOUTS = {
     "publish_drops": 30.0,
     "obs_scrape": 30.0,
     "sysdump": 60.0,
+    "ack_flush": 10.0,
     "shutdown": 30.0,
 }
 
@@ -150,6 +159,25 @@ class _NodeHost:
         self._obs_thread: Optional[threading.Thread] = None
         self._final: Optional[dict] = None
         self._stopping = threading.Event()
+        # -- ack coalescer (ISSUE 17): pending cumulative-ack state
+        # for sequenced frames.  The ledger snapshot is taken on the
+        # data thread RIGHT AFTER each admit, so a flush (from either
+        # thread) sends counters that cover exactly the frames up to
+        # _ack_seq — never rows the parent still holds in its window
+        # (double-count would break the crash ledger).
+        # guarded-by: _ack_lock: _ack_seq, _ack_frames, _ack_admitted,
+        # guarded-by: _ack_lock: _ack_ledger, _ack_echoes, _acks_sent,
+        # guarded-by: _ack_lock: _acks_coalesced, _frames_acked
+        self._ack_lock = threading.Lock()
+        self._ack_seq = 0
+        self._ack_frames = 0
+        self._ack_admitted = 0
+        self._ack_ledger = (0, 0, 0, 0)
+        self._ack_echoes: list = []
+        self._acks_sent = 0
+        self._acks_coalesced = 0
+        self._frames_acked = 0
+        self._ack_thread: Optional[threading.Thread] = None
 
     # -- data channel --------------------------------------------------
     def _data_loop(self) -> None:
@@ -160,12 +188,14 @@ class _NodeHost:
         sock = self._data
         runtime = self.daemon._serving["runtime"]
         st = runtime.stats
+        ack_every = max(int(self.daemon.config.cluster_ack_every), 1)
         try:
             while True:
                 payload = recv_frame(sock)
                 if payload is None:
                     break
-                rows, packed_meta, trace = decode_rows_ex(payload)
+                rows, packed_meta, trace, seq = \
+                    decode_rows_seq(payload)
                 # ISSUE 14 span stitching: a traced frame gets its
                 # worker-side stage stamps — recv (frame decoded)
                 # and admit (runtime.submit returned) — echoed on
@@ -185,10 +215,47 @@ class _NodeHost:
                 # crash-loss term absorbs by design
                 echo = ((trace[0], t_recv, time.monotonic())
                         if trace is not None else None)
-                send_frame(sock, pack_ack(admitted, st.submitted,
-                                          st.verdicts, st.shed,
-                                          st.recovery_dropped,
-                                          trace=echo))
+                if seq is None:
+                    # legacy sync frame: the PR 13 per-frame ack,
+                    # byte-identical (window=1 degenerates to it)
+                    send_frame(sock, pack_ack(admitted, st.submitted,
+                                              st.verdicts, st.shed,
+                                              st.recovery_dropped,
+                                              trace=echo))
+                    continue
+                # sequenced frame (ISSUE 17): accumulate toward a
+                # cumulative ack.  TCP delivers in order, so the
+                # newest seq IS the highest contiguous one.  The
+                # ledger snapshot taken here — on this thread, after
+                # this admit — is what a flush sends for seq: it
+                # covers exactly frames 1..seq, no more (a frame
+                # admitted after it would inflate `submitted` past
+                # what the parent retires, double-counting rows the
+                # failover path also requeues).
+                with self._ack_lock:
+                    self._ack_seq = seq
+                    self._ack_frames += 1
+                    self._ack_admitted += admitted
+                    self._ack_ledger = (st.submitted, st.verdicts,
+                                        st.shed, st.recovery_dropped)
+                    if echo is not None:
+                        self._ack_echoes.append(echo)
+                    do_flush = self._ack_frames >= ack_every
+                if not do_flush:
+                    # flush-on-drain: if the channel has NOTHING
+                    # more buffered, ack NOW instead of riding the
+                    # idle timer — at low load (one frame at a time)
+                    # every frame acks immediately, sync-like, while
+                    # a loaded channel (next frame already in the
+                    # socket buffer) keeps coalescing at the cadence.
+                    # The coalescer must not buy throughput by
+                    # selling low-load latency
+                    rd, _, _ = select.select([sock], [], [], 0)
+                    do_flush = not rd
+                if do_flush:
+                    self._flush_acks()
+                if self._ack_thread is None:
+                    self._start_ack_flusher()
         except Exception:  # noqa: BLE001 — torn frame, dead fd, OR
             # a failed decode/submit/ack: the channel contract is
             # dead either way.  CLOSE the socket before exiting —
@@ -200,6 +267,52 @@ class _NodeHost:
             pass
         finally:
             shutdown_close(sock)
+
+    def _flush_acks(self) -> None:
+        # thread-affinity: transport, ackflush -- both the data
+        # thread (ack_every reached) and the flush timer call this;
+        # build + send under _ack_lock so two flushes can never put
+        # their acks on the wire out of sequence order (the parent's
+        # retire-up-to would regress)
+        with self._ack_lock:
+            if self._ack_frames == 0:
+                return
+            blob = pack_cum_ack(self._ack_seq, self._ack_frames,
+                                self._ack_admitted, *self._ack_ledger,
+                                echoes=tuple(self._ack_echoes))
+            self._acks_sent += 1
+            self._acks_coalesced += self._ack_frames - 1
+            self._frames_acked += self._ack_frames
+            self._ack_frames = 0
+            self._ack_admitted = 0
+            self._ack_echoes = []
+            send_frame(self._data, blob)
+
+    def _start_ack_flusher(self) -> None:
+        # thread-affinity: transport -- spawned lazily by the data
+        # loop on the first sequenced frame; a sync-only channel
+        # never pays for the thread
+        self._ack_thread = threading.Thread(
+            target=self._ack_flush_loop, daemon=True,
+            name=f"nodehost-ackflush-{self.name}")
+        self._ack_thread.start()
+
+    def _ack_flush_loop(self) -> None:
+        # thread-affinity: ackflush -- the flush-on-idle timer
+        # (ISSUE 17): any pending cumulative ack goes on the wire
+        # within cluster_ack_flush_ms even when the frame trickle
+        # stays below ack_every — bounded ack latency is what keeps
+        # low-load forward latency near the sync baseline
+        flush_s = max(
+            float(self.daemon.config.cluster_ack_flush_ms), 0.1) / 1e3
+        while not self._stopping.is_set():
+            time.sleep(flush_s)
+            try:
+                self._flush_acks()
+            except Exception:  # noqa: BLE001 — dead data fd: the
+                # channel is gone; the data loop (or close()) owns
+                # the teardown, the timer just stops
+                return
 
     # -- control ops ---------------------------------------------------
     def _op_ready(self, req: dict) -> dict:
@@ -365,6 +478,17 @@ class _NodeHost:
         self.daemon._publish_cluster_drops(rows, int(req["count"]))
         return {"ok": True}
 
+    def _op_ack_flush(self, req: dict) -> dict:
+        """Force the ack coalescer to flush NOW and report its
+        counters — the parent's drain paths (stop, scale-in quiesce)
+        use it to collapse the flush-timer tail, and the stats ride
+        ``transport_stats`` into the cluster exposition."""
+        self._flush_acks()
+        with self._ack_lock:
+            return {"acks-sent": self._acks_sent,
+                    "acks-coalesced": self._acks_coalesced,
+                    "frames-acked": self._frames_acked}
+
     def _op_shutdown(self, req: dict) -> dict:
         self._stopping.set()
         return {"ok": True}
@@ -390,6 +514,7 @@ class _NodeHost:
         "ct_merge": _op_ct_merge,
         "record_incident": _op_record_incident,
         "publish_drops": _op_publish_drops,
+        "ack_flush": _op_ack_flush,
         "shutdown": _op_shutdown,
     }
 

@@ -27,6 +27,21 @@ stays EXACT over a corpse.  (Between the last ack and the kill the
 worker may have resolved a few more rows; the ledger attributes them
 to ``crash_dropped`` instead of ``verdicts`` — loss is never
 under-counted, which is the contract.)
+
+PIPELINED MODE (ISSUE 17): with ``cluster_forward_window > 1`` the
+router enables a SEND WINDOW on each process node — ``submit``
+returns after the sequenced frame is on the wire (blocking only
+while the window is full: credit backpressure), and a dedicated
+ACK-READER thread retires in-flight frames as the worker's
+CUMULATIVE acks arrive, returning credit to the forwarder through
+the router's ``on_ack`` callback.  The crash contract is unchanged
+because it never depended on synchrony: the last cumulative ack's
+ledger covers exactly the frames the window has retired, and every
+sent-but-unacked frame is retained WITH ITS ROWS in the window —
+on channel death the ack reader hands them back to the router
+(``on_broken``), where they are requeued for the failover peer or
+counted, so the identity above closes at ANY kill point inside an
+open window.  Window=1 keeps the PR 13 sync path byte-identical.
 """
 
 from __future__ import annotations
@@ -42,10 +57,10 @@ import numpy as np
 
 from ..serving import ServingError
 from .nodehost import OP_TIMEOUTS
-from .transport import (encode_rows, recv_frame, recv_json_frame,
-                        rows_from_b64, rows_to_b64, send_frame,
-                        send_json_frame, shutdown_close,
-                        unpack_ack_ex)
+from .transport import (SendWindow, encode_rows, recv_frame,
+                        recv_json_frame, rows_from_b64, rows_to_b64,
+                        send_frame, send_json_frame, shutdown_close,
+                        unpack_ack_ex, unpack_cum_ack)
 
 __all__ = ["ProcessNode", "ProcessNodeSpawner", "spawn_available"]
 
@@ -157,7 +172,8 @@ class ProcessNode:
 
     # guarded-by: _lock: alive, final, _ct_snap_rows, _last_ack,
     # guarded-by: _lock: _crash_loss_pending, _frames, _bytes,
-    # guarded-by: _lock: _frames_packed
+    # guarded-by: _lock: _frames_packed, _acks, _acks_coalesced
+    # guarded-by: _win_cv: _win, _win_broken, _window_stalls
 
     def __init__(self, name: str, proc, spawner: ProcessNodeSpawner):
         self.idx = -1  # assigned by ClusterServing
@@ -187,6 +203,16 @@ class ProcessNode:
         self._frames = 0
         self._frames_packed = 0
         self._bytes = 0
+        # -- pipelined mode (ISSUE 17): send window + ack reader
+        self._win: Optional[SendWindow] = None
+        self._win_cv = threading.Condition()
+        self._win_broken: Optional[str] = None
+        self._window_stalls = 0
+        self._acks = 0
+        self._acks_coalesced = 0
+        self._on_ack = None
+        self._on_broken = None
+        self._ack_thread: Optional[threading.Thread] = None
 
     # -- bring-up ------------------------------------------------------
     def attach(self, timeout: float = 60.0) -> None:
@@ -257,46 +283,198 @@ class ProcessNode:
         return self._rpc("obs", op, timeout, args)
 
     # -- the ClusterNode interface ------------------------------------
-    def submit(self, rows: np.ndarray, trace=None) -> int:
+    def submit(self, rows: np.ndarray, trace=None,
+               t_enq: Optional[float] = None) -> int:
         # (unannotated on purpose: inherits the router forwarder's
         # affinity, like ClusterNode.submit — the socket leg is the
         # transport domain's territory via the framing helpers)
-        """Forward one chunk over the data channel and wait for the
-        ack (one outstanding frame per node by construction — the
-        per-node forwarder is the only caller).  Packs eligible
-        single-stream chunks to the 16 B/packet wire.  ``trace``
-        (an ``obs.relay.TraceCtx`` with t_enq/t_fwd stamped) rides
-        the frame; the worker's recv/admit stamps come back on the
-        ack echo (ISSUE 14 cross-process span stitching)."""
+        """Forward one chunk over the data channel.  SYNC mode (no
+        window enabled — the PR 13 protocol, byte-identical): send
+        one unsequenced frame, block for its per-frame ack.
+        PIPELINED mode (``enable_window`` called — ISSUE 17): block
+        only while the send window is FULL (credit backpressure),
+        then send a sequenced frame and return; the ack reader
+        retires it when the worker's cumulative ack arrives.  In
+        both modes the per-node forwarder is the only caller.  Packs
+        eligible single-stream chunks to the 16 B/packet wire.
+        ``trace`` (an ``obs.relay.TraceCtx`` with t_enq/t_fwd
+        stamped) rides the frame; the worker's recv/admit stamps
+        come back on the (possibly coalesced) ack echo (ISSUE 14
+        cross-process span stitching)."""
         from ..core.packets import pack_eligibility, pack_rows
 
         sock = self._data
         if sock is None:
             raise ServingError(f"worker {self.name} not attached")
+        with self._win_cv:
+            win = self._win
         wire_trace = ((trace.trace_id, trace.t_enq, trace.t_fwd)
                       if trace is not None else None)
         ok, ep, dirn = pack_eligibility(rows)
-        if ok:
-            payload = encode_rows(pack_rows(rows),
-                                  packed_meta=(ep, dirn),
+        wire_rows = pack_rows(rows) if ok else rows
+        meta = (ep, dirn) if ok else None
+        if win is None:
+            payload = encode_rows(wire_rows, packed_meta=meta,
                                   trace=wire_trace)
-        else:
-            payload = encode_rows(rows, trace=wire_trace)
-        send_frame(sock, payload)
-        ack = recv_frame(sock)
-        if ack is None:
+            send_frame(sock, payload)
+            ack = recv_frame(sock)
+            if ack is None:
+                raise ServingError(
+                    f"worker {self.name} closed the data channel")
+            (admitted, sub, ver, shed, rec), echo = unpack_ack_ex(ack)
+            if trace is not None and echo is not None \
+                    and echo[0] == trace.trace_id:
+                trace.t_recv, trace.t_admit = echo[1], echo[2]
+            with self._lock:
+                self._last_ack = (sub, ver, shed, rec)
+                self._frames += 1
+                self._frames_packed += 1 if ok else 0
+                self._bytes += len(payload)
+            return admitted
+        # pipelined: wait for credit, register, send, return.  The
+        # entry registers BEFORE the send so a cumulative ack racing
+        # the sendall's return can never arrive for a frame the
+        # window does not know; a FAILED send unregisters it (the
+        # frame never reached the worker — the forwarder's requeue
+        # owns those rows alone).
+        with self._win_cv:
+            if win.full:
+                self._window_stalls += 1
+                while win.full and self._win_broken is None:
+                    self._win_cv.wait(0.5)
+            if self._win_broken is not None:
+                raise ServingError(
+                    f"data channel to {self.name} broken: "
+                    f"{self._win_broken}")
+            seq = win.add(rows, t_enq if t_enq is not None
+                          else time.monotonic(), trace)
+        payload = encode_rows(wire_rows, packed_meta=meta,
+                              trace=wire_trace, seq=seq)
+        try:
+            send_frame(sock, payload)
+        except Exception as exc:  # noqa: BLE001 — dead fd mid-send
+            with self._win_cv:
+                win.drop(seq)
+                self._win_cv.notify_all()
             raise ServingError(
-                f"worker {self.name} closed the data channel")
-        (admitted, sub, ver, shed, rec), echo = unpack_ack_ex(ack)
-        if trace is not None and echo is not None \
-                and echo[0] == trace.trace_id:
-            trace.t_recv, trace.t_admit = echo[1], echo[2]
+                f"send to {self.name} failed: "
+                f"{type(exc).__name__}: {exc}") from None
         with self._lock:
-            self._last_ack = (sub, ver, shed, rec)
             self._frames += 1
             self._frames_packed += 1 if ok else 0
             self._bytes += len(payload)
-        return admitted
+        return len(rows)
+
+    # -- pipelined mode (ISSUE 17) -------------------------------------
+    def enable_window(self, window: int, on_ack=None,
+                      on_broken=None) -> None:
+        # thread-affinity: api -- router.start / router.add_node,
+        # before any frame flows on the channel
+        """Switch the data channel to pipelined mode: a send window
+        of ``window`` frames and a dedicated ack-reader thread.
+        ``on_ack(entries)`` fires with the retired
+        ``(n_rows, t_enq, ctx)`` list per cumulative ack (the
+        router's credit return + latency/span accounting);
+        ``on_broken(entries)`` fires ONCE with every sent-but-unacked
+        ``(rows, t_enq, ctx)`` when the channel dies (the router
+        requeues them for failover)."""
+        if window < 2:
+            return  # window 1 IS the sync protocol; keep it exact
+        with self._win_cv:
+            if self._win is not None:
+                return
+            self._win = SendWindow(window)
+            self._on_ack = on_ack
+            self._on_broken = on_broken
+        self._ack_thread = threading.Thread(
+            target=self._ack_read_loop, daemon=True,
+            name=f"cluster-ack-{self.name}")
+        self._ack_thread.start()
+
+    def _ack_read_loop(self) -> None:
+        # thread-affinity: transport -- the parent's half of the
+        # coalesced-ack channel: recv, retire, return credit.  On
+        # ANY exit every in-flight frame is handed back to the
+        # router exactly once (requeue or counted loss — never
+        # silent).
+        sock = self._data
+        with self._win_cv:
+            win = self._win
+        try:
+            while True:
+                payload = recv_frame(sock)
+                if payload is None:
+                    break
+                (seq, frames, _admitted, sub, ver, shed,
+                 rec), echoes = unpack_cum_ack(payload)
+                with self._win_cv:
+                    entries = win.retire(seq)
+                    self._win_cv.notify_all()
+                with self._lock:
+                    self._last_ack = (sub, ver, shed, rec)
+                    self._acks += 1
+                    self._acks_coalesced += max(int(frames) - 1, 0)
+                if echoes:
+                    by_tid = {e[0]: e for e in echoes}
+                    for _s, _rows, _t_enq, ctx in entries:
+                        if ctx is not None:
+                            e = by_tid.get(ctx.trace_id)
+                            if e is not None:
+                                ctx.t_recv, ctx.t_admit = e[1], e[2]
+                cb = self._on_ack
+                if cb is not None and entries:
+                    cb([(len(r), t_enq, ctx)
+                        for _s, r, t_enq, ctx in entries])
+        except Exception:  # noqa: BLE001 — torn frame/dead fd: the
+            pass  # channel contract is dead; the finally owns the
+            # in-flight hand-back
+        finally:
+            with self._win_cv:
+                if self._win_broken is None:
+                    self._win_broken = "data channel closed"
+                entries = win.take_all()
+                self._win_cv.notify_all()
+            cb = self._on_broken
+            if cb is not None and entries:
+                cb([(r, t_enq, ctx)
+                    for _s, r, t_enq, ctx in entries])
+
+    def drain_window(self, timeout: float = 30.0) -> bool:
+        # thread-affinity: api
+        """Block until every in-flight frame is acked (True) or the
+        channel broke / ``timeout`` ran out (False when frames were
+        still pending).  The quiesce primitive for stop/scale-in:
+        "drained" now means the WINDOW is empty, not just the
+        queues."""
+        deadline = time.monotonic() + timeout
+        with self._win_cv:
+            win = self._win
+            if win is None:
+                return True
+            while win.inflight_frames and self._win_broken is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._win_cv.wait(min(left, 0.1))
+            return win.inflight_frames == 0
+
+    def window_inflight(self) -> Tuple[int, int]:
+        # thread-affinity: any
+        """(frames, rows) currently sent-but-unacked."""
+        with self._win_cv:
+            win = self._win
+            if win is None:
+                return (0, 0)
+            return (win.inflight_frames, win.inflight_rows)
+
+    def ack_flush(self) -> Optional[dict]:
+        # thread-affinity: api
+        """Ask the worker's coalescer to flush NOW (collapses the
+        flush-timer tail out of a drain) and return its counters."""
+        try:
+            return self.call("ack_flush", timeout=10.0)
+        except ServingError:
+            return None
 
     def probe(self) -> bool:
         # thread-affinity: api
@@ -348,6 +526,14 @@ class ProcessNode:
             self._ctrl_broken = f"killed: {cause}"
         with self._obs_lock:
             self._obs_broken = f"killed: {cause}"
+        # pipelined mode: the closed fd EOFs the ack reader, whose
+        # exit path hands every sent-but-unacked frame back to the
+        # router (on_broken requeue).  JOIN it before returning so
+        # the failover that called crash() migrates a queue that
+        # already contains them — mid-window SIGKILL loses nothing.
+        t = self._ack_thread
+        if t is not None:
+            t.join(timeout=10.0)
         self.proc.join(timeout=10.0)
 
     def take_crash_loss(self) -> int:
@@ -543,9 +729,18 @@ class ProcessNode:
 
     def transport_stats(self) -> dict:
         with self._lock:
-            return {"frames": self._frames,
-                    "frames-packed": self._frames_packed,
-                    "bytes": self._bytes}
+            out = {"frames": self._frames,
+                   "frames-packed": self._frames_packed,
+                   "bytes": self._bytes,
+                   "acks": self._acks,
+                   "acks-coalesced": self._acks_coalesced}
+        with self._win_cv:
+            win = self._win
+            out["window"] = win.window if win is not None else 1
+            out["inflight-frames"] = (win.inflight_frames
+                                      if win is not None else 0)
+            out["window-stalls"] = self._window_stalls
+        return out
 
     def shutdown(self) -> None:
         with self._lock:
@@ -558,6 +753,9 @@ class ProcessNode:
         shutdown_close(self._data)
         shutdown_close(self._obs)
         shutdown_close(self._ctrl)
+        t = self._ack_thread
+        if t is not None:
+            t.join(timeout=5.0)
         self.proc.join(timeout=30.0)
         if self.proc.is_alive():
             self.proc.kill()

@@ -41,7 +41,25 @@ direction-invariant hash; this module adds the NODE layer on top:
 - ``freeze`` / ``resume`` + ``wait_quiesced``: the scale-out
   migration window — a frozen router parks submitters (bounded) while
   the forwarders drain, so a CT snapshot taken inside the window is
-  complete for the slots about to move.
+  complete for the slots about to move;
+- PIPELINED FORWARDING (ISSUE 17): with ``forward_window > 1`` a
+  process-mode node's forwarder no longer blocks on a per-frame ack —
+  it streams sequenced frames until the node's send window is full
+  (``ProcessNode.enable_window``) and the credit comes back on the
+  worker's CUMULATIVE ack, which retires every frame up to the acked
+  sequence at once.  Delivery accounting moves with the credit:
+  ``forwarded`` / ``forward_latency`` / ``_inflight`` for a windowed
+  frame are settled by the ack callback (:meth:`_on_node_ack`), not
+  the forwarder's send return, so enqueue->acked latency stays the
+  honest number and ``wait_quiesced`` still means "every admitted row
+  delivered AND acknowledged".  A channel that dies with frames in
+  flight hands them back exactly once (:meth:`_on_window_broken`) —
+  requeued at the front for failover's queue migration, so the ledger
+  identity below is unchanged by the window;
+- ``remove_node``: live scale-IN (the inverse of ``add_node``) — the
+  victim's slots re-pin onto the surviving nodes (fewest-loaded
+  first), the victim's forwarder retires, and the caller
+  (``cluster/scale.py``) migrates exactly the moved slots' CT.
 
 The cluster-wide no-silent-loss ledger this module anchors::
 
@@ -108,13 +126,15 @@ class ClusterRouter:
     # guarded-by: _lock: _oflow_rows, _oflow_n, _stopping, submitted,
     # guarded-by: _lock: router_overflow, failover_dropped, forwarded,
     # guarded-by: _lock: _suspect, crash_dropped, _frozen, _inflight,
-    # guarded-by: _lock: forward_latency, _nchunks
+    # guarded-by: _lock: forward_latency, _nchunks, _retired,
+    # guarded-by: _lock: _win_swept
 
     def __init__(self, nodes: Sequence, forward_depth: int,
                  on_overflow: Optional[OverflowFn] = None,
                  shed_retain: int = SHED_RETAIN,
                  slot_factor: int = SLOT_FACTOR,
-                 trace_sample: int = 0, span_store=None):
+                 trace_sample: int = 0, span_store=None,
+                 forward_window: int = 1):
         if not nodes:
             raise ValueError("cluster router needs at least one node")
         self.nodes = list(nodes)
@@ -150,6 +170,19 @@ class ClusterRouter:
         # a forwarder whose submit raised parks its node as suspect
         # until failover re-pins or stop() sweeps
         self._suspect = [False] * self.n_nodes
+        # scale-in leaves the index in place (ledger continuity) but a
+        # retired node routes nothing and its forwarder has exited
+        self._retired = [False] * self.n_nodes
+        # ISSUE 17 pipelining: frames-in-flight credit window per
+        # node.  Indices whose node handle grew a send window
+        # (ProcessNode.enable_window) — membership is fixed before the
+        # forwarder thread starts, so forwarders read it lock-free.
+        self.forward_window = max(int(forward_window), 1)
+        self._windowed: set = set()
+        # nodes whose undrained in-flight rows stop() already counted
+        # failover_dropped: a later broken-window hand-back for them
+        # is span-loss only, never a requeue (no double-count)
+        self._win_swept: set = set()
         self._frozen = False
         self._stopping = False
         self._threads: List[threading.Thread] = []
@@ -183,9 +216,21 @@ class ClusterRouter:
     def _spawn_forwarder(self, idx: int) -> None:
         # thread-affinity: api
         # holds: nothing — callers serialize (start / add_node)
+        node = self.nodes[idx]
+        if (self.forward_window > 1 and idx not in self._windowed
+                and hasattr(node, "enable_window")):
+            # windowed membership is decided HERE, before the thread
+            # exists — the forwarder reads _windowed without the lock
+            node.enable_window(
+                self.forward_window,
+                on_ack=lambda entries, i=idx:
+                    self._on_node_ack(i, entries),
+                on_broken=lambda entries, i=idx:
+                    self._on_window_broken(i, entries))
+            self._windowed.add(idx)
         t = threading.Thread(target=self._forward_loop, args=(idx,),
                              daemon=True,
-                             name=f"cluster-fwd-{self.nodes[idx].name}")
+                             name=f"cluster-fwd-{node.name}")
         self._threads.append(t)
         t.start()
 
@@ -203,24 +248,92 @@ class ClusterRouter:
             t.join(timeout)
         self._threads = []
         if drain:
+            with self._cv:
+                retired = list(self._retired)
             for idx in range(self.n_nodes):
+                if retired[idx]:
+                    continue
+                windowed = idx in self._windowed
                 while True:
                     with self._cv:
                         if not self._chunks[idx]:
                             break
-                        chunk, _t_enq, ctx = self._chunks[idx].pop(0)
+                        chunk, t_enq, ctx = self._chunks[idx].pop(0)
                         self._pending[idx] -= len(chunk)
-                    if ctx is not None and self.span_store is not None:
-                        self.span_store.drop_span(ctx)  # span lost at stop
                     node = self.nodes[idx]
                     try:
-                        node.submit(chunk)
-                        with self._cv:
-                            self.forwarded[idx] += len(chunk)
+                        if windowed:
+                            # windowed delivery settles on the ack:
+                            # forwarded/latency/span land in
+                            # _on_node_ack, loss on a broken channel
+                            # comes back via _on_window_broken and is
+                            # swept below
+                            with self._cv:
+                                self._inflight[idx] += len(chunk)
+                            if ctx is not None:
+                                ctx.node = node.name
+                                ctx.t_fwd = time.monotonic()
+                            node.submit(chunk, trace=ctx, t_enq=t_enq)
+                        else:
+                            if ctx is not None \
+                                    and self.span_store is not None:
+                                # span lost at stop (sync path has no
+                                # ack to complete it here)
+                                self.span_store.drop_span(ctx)
+                            node.submit(chunk)
+                            with self._cv:
+                                self.forwarded[idx] += len(chunk)
                     except Exception:  # noqa: BLE001 — a dead/terminal
                         # node at stop: its loss is counted, not raised
                         with self._cv:
+                            if windowed:
+                                self._inflight[idx] -= len(chunk)
                             self.failover_dropped += len(chunk)
+                        if windowed and ctx is not None \
+                                and self.span_store is not None:
+                            self.span_store.drop_span(ctx)
+            # close every open window: force the worker-side flush
+            # timer's hand, then wait for the cumulative acks so the
+            # ledger below reflects every delivered frame
+            for idx in sorted(self._windowed):
+                if retired[idx]:
+                    continue
+                node = self.nodes[idx]
+                try:
+                    node.ack_flush()
+                except Exception:  # noqa: BLE001 — dead channel: the
+                    pass  # broken-window sweep below accounts it
+                try:
+                    node.drain_window(timeout)
+                except Exception:  # noqa: BLE001
+                    pass
+                # a window that did NOT drain (dead worker holding
+                # the channel half-open, or the timeout) still owes
+                # its in-flight rows to the ledger: count them lost
+                # NOW and mark the node swept — the late hand-back
+                # when its channel finally closes must not resurrect
+                # rows the ledger already closed over
+                with self._cv:
+                    left = self._inflight[idx]
+                    if left:
+                        self.failover_dropped += left
+                        self._inflight[idx] = 0
+                        self._win_swept.add(idx)
+            # a channel that broke during the drain handed its
+            # in-flight frames back to the queue — no forwarder is
+            # left to retry them, so their loss is counted now
+            lost_spans = []
+            with self._cv:
+                for idx in range(self.n_nodes):
+                    while self._chunks[idx]:
+                        chunk, _t_enq, ctx = self._chunks[idx].pop(0)
+                        self._pending[idx] -= len(chunk)
+                        self.failover_dropped += len(chunk)
+                        if ctx is not None:
+                            lost_spans.append(ctx)
+            if self.span_store is not None:
+                for ctx in lost_spans:
+                    self.span_store.drop_span(ctx)
         self._flush_overflow_all()
         return self.snapshot()
 
@@ -309,24 +422,29 @@ class ClusterRouter:
     def _forward_loop(self, idx: int) -> None:
         # thread-affinity: router
         node = self.nodes[idx]
+        windowed = idx in self._windowed  # fixed before thread start
         while True:
             with self._cv:
-                while (not self._stopping
+                while (not self._stopping and not self._retired[idx]
                        and (not node.alive or self._suspect[idx]
                             or (not self._chunks[idx]
                                 and not self._oflow_n[idx]))):
                     # parked: dead/suspect node (failover will steal
-                    # the queue) or simply nothing to do
+                    # the queue), retired node (scale-in), or simply
+                    # nothing to do
                     self._cv.wait(0.05)
                     if node.alive and self._suspect[idx]:
                         self._suspect[idx] = False  # healed
-                if self._stopping:
+                if self._stopping or self._retired[idx]:
                     return
                 chunk = t_enq = ctx = None
                 if self._chunks[idx]:
                     chunk, t_enq, ctx = self._chunks[idx].pop(0)
                     self._pending[idx] -= len(chunk)
-                    self._inflight[idx] = len(chunk)
+                    # additive, not assignment: a windowed node keeps
+                    # rows in flight across many forwarder laps until
+                    # the cumulative ack retires them
+                    self._inflight[idx] += len(chunk)
                 oflow_rows, oflow_n = self._take_oflow_locked(idx)
             if chunk is not None:
                 try:
@@ -337,32 +455,96 @@ class ClusterRouter:
                         # in thread mode)
                         ctx.node = node.name
                         ctx.t_fwd = time.monotonic()
-                        node.submit(chunk, trace=ctx)
+                    if windowed:
+                        # pipelined: submit returns once the frame is
+                        # ON THE WIRE (blocking only while the send
+                        # window is out of credit).  forwarded /
+                        # latency / inflight settle in _on_node_ack
+                        # when the cumulative ack covers this frame —
+                        # after this call the ack thread owns ctx.
+                        node.submit(chunk, trace=ctx, t_enq=t_enq)
                     else:
-                        node.submit(chunk)
-                    with self._cv:
-                        self.forwarded[idx] += len(chunk)
-                        self._inflight[idx] = 0
-                        self.forward_latency.record(
-                            (time.monotonic() - t_enq) * 1e6)
-                        self._cv.notify_all()
-                    if ctx is not None:
-                        ctx.t_ack = time.monotonic()
-                        # commit counts an echo-less span as dropped
-                        self.span_store.commit_span(ctx)
+                        if ctx is not None:
+                            node.submit(chunk, trace=ctx)
+                        else:
+                            node.submit(chunk)
+                        with self._cv:
+                            self.forwarded[idx] += len(chunk)
+                            self._inflight[idx] -= len(chunk)
+                            self.forward_latency.record(
+                                (time.monotonic() - t_enq) * 1e6)
+                            self._cv.notify_all()
+                        if ctx is not None:
+                            ctx.t_ack = time.monotonic()
+                            # commit counts an echo-less span as
+                            # dropped
+                            self.span_store.commit_span(ctx)
                 except Exception:  # noqa: BLE001 — crashed/terminal
                     # node: requeue AT THE FRONT and park as suspect;
                     # failover's queue migration (or stop's drain)
-                    # claims the chunk with its loss accounted
+                    # claims the chunk with its loss accounted.  A
+                    # windowed submit that raised never entered the
+                    # send window (SendWindow.drop unwinds a failed
+                    # send), so this requeue cannot double with
+                    # _on_window_broken's.
                     with self._cv:
                         self._chunks[idx].insert(0, (chunk, t_enq,
                                                      ctx))
                         self._pending[idx] += len(chunk)
-                        self._inflight[idx] = 0
+                        self._inflight[idx] -= len(chunk)
                         self._suspect[idx] = True
                         self._cv.notify_all()
             if oflow_n and self._on_overflow is not None:
                 self._surface(idx, oflow_rows, oflow_n)
+
+    def _on_node_ack(self, idx: int, entries: list) -> None:
+        # thread-affinity: transport
+        """Credit return: the node's cumulative ack just covered
+        ``entries`` (``(n_rows, t_enq, ctx)`` in send order) —
+        delivery accounting for windowed frames lands here, with the
+        SAME enqueue->acked semantics the sync path's blocking submit
+        measured, so the bench's p50 comparison is honest."""
+        now = time.monotonic()
+        with self._cv:
+            for n_rows, t_enq, _ctx in entries:
+                self.forwarded[idx] += n_rows
+                self._inflight[idx] -= n_rows
+                self.forward_latency.record((now - t_enq) * 1e6)
+            self._cv.notify_all()
+        if self.span_store is not None:
+            for _n, _t, ctx in entries:
+                if ctx is not None:
+                    ctx.t_ack = now
+                    # commit counts an echo-less span as dropped
+                    self.span_store.commit_span(ctx)
+
+    def _on_window_broken(self, idx: int, entries: list) -> None:
+        # thread-affinity: transport
+        """The node's data channel died with ``entries``
+        (``(rows, t_enq, ctx)`` ascending by sequence) sent but never
+        acked.  They were never admitted by the worker — the last
+        cumulative ack is the final word — so they re-enter the queue
+        AT THE FRONT (order preserved) for failover's migration or
+        stop's sweep to account.  Called exactly once per channel
+        (``ProcessNode`` hands the window back via ``take_all``).
+        A node stop() already SWEPT (its undrained in-flight rows
+        counted ``failover_dropped``) only loses spans here — the
+        rows are closed ledger, requeuing would double-count."""
+        with self._cv:
+            if idx in self._win_swept:
+                swept = True
+            else:
+                swept = False
+                for rows, t_enq, ctx in reversed(entries):
+                    self._chunks[idx].insert(0, (rows, t_enq, ctx))
+                    self._pending[idx] += len(rows)
+                    self._inflight[idx] -= len(rows)
+                self._suspect[idx] = True
+            self._cv.notify_all()
+        if swept and self.span_store is not None:
+            for _rows, _t_enq, ctx in entries:
+                if ctx is not None:
+                    self.span_store.drop_span(ctx)
 
     def _take_oflow_locked(self, idx: int):
         # thread-affinity: router, api -- forwarder flush + the stop
@@ -517,6 +699,7 @@ class ClusterRouter:
             self._oflow_rows.append([])
             self._oflow_n.append(0)
             self._suspect.append(False)
+            self._retired.append(False)
             self.forwarded.append(0)
             share = self.n_slots // self.n_nodes
             counts = {}
@@ -540,6 +723,72 @@ class ClusterRouter:
             self._spawn_forwarder(new_idx)
         return moved
 
+    def remove_node(self, idx: int) -> List[int]:
+        # thread-affinity: api
+        """Live scale-IN: re-pin every slot ``idx`` owns onto the
+        surviving live nodes (fewest-loaded first, so the layout stays
+        balanced), retire the forwarder, and return the moved slot ids
+        — the caller (``cluster/scale.py``) migrates exactly those
+        slots' CT to each slot's NEW owner.  Call FROZEN + quiesced
+        (window drained): the victim's queue is normally empty; any
+        residue is migrated like failover would, counted if a
+        survivor's queue cannot absorb it.  The index stays in place —
+        a retired node keeps its ledger row but routes nothing."""
+        with self._cv:
+            if self._retired[idx]:
+                raise ServingError(
+                    f"node index {idx} is already retired")
+            survivors = [i for i in range(self.n_nodes)
+                         if i != idx and not self._retired[i]
+                         and self.nodes[i].alive]
+            if not survivors:
+                raise ServingError(
+                    "cannot retire the last live node")
+            counts = {i: 0 for i in survivors}
+            for o in self._slot_owner:
+                if o in counts:
+                    counts[o] += 1
+            moved: List[int] = []
+            for s in range(self.n_slots):
+                if self._slot_owner[s] == idx:
+                    tgt = min(counts, key=lambda i: (counts[i], i))
+                    self._slot_owner[s] = tgt
+                    counts[tgt] += 1
+                    moved.append(s)
+            self._owner_arr = np.asarray(self._slot_owner,
+                                         dtype=np.int64)
+            # residual queue (quiesced callers hit the fast path:
+            # it's empty) — migrate to the least-loaded survivor
+            while self._chunks[idx]:
+                chunk, t_enq, ctx = self._chunks[idx].pop(0)
+                self._pending[idx] -= len(chunk)
+                pend = self._pending
+                tgt = min(counts, key=lambda i: (pend[i], i))
+                space = self.forward_depth - self._pending[tgt]
+                take = min(max(space, 0), len(chunk))
+                if take:
+                    self._chunks[tgt].append(
+                        (chunk[:take], t_enq,
+                         ctx if take == len(chunk) else None))
+                    self._pending[tgt] += take
+                lost = len(chunk) - take
+                if lost:
+                    self.failover_dropped += lost
+                if ctx is not None and take != len(chunk) \
+                        and self.span_store is not None:
+                    self.span_store.drop_span(ctx)
+            # shed-surfacing backlog follows the flows
+            if self._oflow_n[idx]:
+                tgt = survivors[0]
+                self._oflow_rows[tgt].extend(self._oflow_rows[idx])
+                self._oflow_n[tgt] += self._oflow_n[idx]
+                self._oflow_rows[idx] = []
+                self._oflow_n[idx] = 0
+            self._retired[idx] = True
+            self._suspect[idx] = False
+            self._cv.notify_all()
+        return moved
+
     def slots_of(self, idx: int) -> List[int]:
         # thread-affinity: any
         with self._cv:
@@ -556,10 +805,13 @@ class ClusterRouter:
         # thread-affinity: any
         with self._cv:
             lat = self.forward_latency
-            return {
+            snap = {
                 "submitted": self.submitted,
                 "forwarded": list(self.forwarded),
                 "pending": list(self._pending),
+                "inflight": list(self._inflight),
+                "retired": list(self._retired),
+                "forward-window": self.forward_window,
                 "router-overflow": self.router_overflow,
                 "failover-dropped": self.failover_dropped,
                 "crash-dropped": self.crash_dropped,
@@ -575,3 +827,22 @@ class ClusterRouter:
                 "trace": (self.span_store.span_stats()
                           if self.span_store is not None else None),
             }
+        # window/credit counters live on the node handles (their own
+        # locks) — read outside the router lock
+        acks = coalesced = stalls = frames = 0
+        for idx in sorted(self._windowed):
+            try:
+                ts = self.nodes[idx].transport_stats()
+            except Exception:  # noqa: BLE001 — a dead handle still
+                continue  # counts: skip only on a torn read
+            acks += int(ts.get("acks", 0))
+            coalesced += int(ts.get("acks-coalesced", 0))
+            stalls += int(ts.get("window-stalls", 0))
+            frames += int(ts.get("inflight-frames", 0))
+        snap["window"] = {
+            "acks": acks,
+            "acks-coalesced": coalesced,
+            "window-stalls": stalls,
+            "inflight-frames": frames,
+        }
+        return snap

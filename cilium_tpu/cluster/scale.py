@@ -36,11 +36,23 @@ re-spread.  ``scale_out`` is the PR 8 failover proof run in REVERSE
    submits waited instead of shedding), so it stays EXACT across
    the transition.
 
+``scale_in`` (ISSUE 17 — ROADMAP item 3 residue b) is failover MINUS
+the death: freeze, quiesce (with a pipelined data channel that means
+"the victim's send window is fully ACKED", not just "queue empty"),
+snapshot the victim's CT, re-pin its slots onto the survivors
+(``router.remove_node``, fewest-loaded first), ship each moved
+slot's CT entries to the slot's NEW owner, retire the worker
+cleanly (stop_serving retains its final ledger — the victim stays in
+``cluster.nodes`` so the cluster ledger closes over it), resume.
+Survivors NEVER recompile a serving executable.
+
 ``ClusterAutoscaler`` drives the same path automatically: a named
 controller (``infra/controller.py`` — the repo's reconciliation
 primitive) samples forward-queue occupancy; ``ticks`` consecutive
 samples over ``high_frac`` of ``forward_depth`` trigger one
-``add_node()`` (serialized, budget-capped by ``max_nodes``).
+``add_node()`` (serialized, budget-capped by ``max_nodes``); with
+``low_frac`` > 0, ``ticks`` consecutive samples under ``low_frac``
+trigger one ``remove_node()`` (floor-capped by ``min_nodes``).
 """
 
 from __future__ import annotations
@@ -234,6 +246,126 @@ def _migrate_ct(cluster, new_node, moved_slots: List[int],
     return total
 
 
+def scale_in(cluster, name: Optional[str] = None,
+             timeout: float = 60.0) -> dict:
+    """Remove one replica from a live serving cluster (see module
+    doc).  ``name`` defaults to the highest-index live node (the
+    autoscaler's retire order — last in, first out).  Returns the
+    scale-in record; raises when fewer than two nodes are live."""
+    if cluster.router is None or not cluster._started:
+        raise ServingError("scale_in needs a started cluster")
+    if cluster._stopped:
+        raise ServingError("cluster already stopped")
+    with cluster._scale_lock:
+        t0 = time.monotonic()
+        live = [n for n in cluster.nodes if n.alive]
+        if len(live) < 2:
+            raise ServingError(
+                "scale_in needs at least two live nodes")
+        if name is None:
+            victim = live[-1]
+        else:
+            victim = cluster.node(name)
+            if not victim.alive:
+                raise ServingError(
+                    f"scale_in victim {name} is not alive")
+        vidx = cluster.nodes.index(victim)
+        r = cluster.router
+        # survivors must not pay a recompile for the retire: pin
+        # their dispatch-compile counts across the migration
+        survivors0 = {
+            n.name: (n.dispatch_compiles() or {}).get(
+                "dispatch_compiles")
+            for n in cluster.nodes
+            if n.alive and n is not victim}
+        r.freeze()
+        t_frozen = time.monotonic()
+        try:
+            # quiesce: every admitted row DELIVERED AND (pipelined
+            # channel) ACKED — the victim's send window is empty, so
+            # its last cumulative ack covers everything it was sent
+            if not r.wait_quiesced(timeout=timeout):
+                raise ServingError(
+                    "scale-in: router never quiesced (the victim's "
+                    "window holds unacked frames)")
+            if not _wait_nodes_drained(cluster, timeout):
+                raise ServingError(
+                    "scale-in: a node never verdicted its admitted "
+                    "rows (the CT snapshot would miss flows still "
+                    "in its admission ring)")
+            # the victim's CT, complete by the quiesce above, BEFORE
+            # its slots move (snapshot_ct ships rows to the parent
+            # in process mode — the worker is about to retire)
+            ct_rows = victim.snapshot_ct(trigger="scale-in")
+            moved = r.remove_node(vidx)
+            cluster.membership.remove_node(victim.name)
+            migrated = _migrate_ct_out(cluster, ct_rows, moved,
+                                       r.n_slots, r)
+        finally:
+            r.resume()
+        # retire the worker OUTSIDE the frozen window: the survivors
+        # own every slot already; the victim serves nothing.
+        # stop_serving retains the final front-end snapshot — the
+        # victim stays in cluster.nodes (and _by_name) so the
+        # cluster ledger closes over its verdicts
+        victim.stop_serving()
+        victim.shutdown()
+        victim.alive = False
+        t_done = time.monotonic()
+        survivors1 = {
+            n.name: (n.dispatch_compiles() or {}).get(
+                "dispatch_compiles")
+            for n in cluster.nodes
+            if n.alive and n is not victim}
+        rec = {
+            "kind": "scale-in",
+            "node": victim.name,
+            "nodes-after": sum(1 for n in cluster.nodes if n.alive),
+            "moved-slots": len(moved),
+            "ct-migrated-entries": migrated,
+            "pause-ms": round((t_done - t_frozen) * 1e3, 3),
+            "total-ms": round((t_done - t0) * 1e3, 3),
+            "survivor-recompiles": sum(
+                1 for k, v in survivors1.items()
+                if survivors0.get(k) is not None
+                and v is not None and v != survivors0[k]),
+            "at": time.time(),
+        }
+        cluster.scale_events.append(rec)
+        from ..obs.flightrec import KIND_NODE_SCALEIN
+
+        survivor = next((n for n in cluster.nodes if n.alive), None)
+        if survivor is not None:
+            survivor.record_incident(KIND_NODE_SCALEIN, rec)
+        return rec
+
+
+def _migrate_ct_out(cluster, ct_rows, moved_slots: List[int],
+                    n_slots: int, router) -> int:
+    """Ship the retiring victim's CT entries for the moved slots to
+    each slot's NEW owner (the inverse of :func:`_migrate_ct`, which
+    fans IN to one newcomer).  Runs inside the frozen+quiesced
+    window, after the slot table flipped — the table IS the
+    destination map."""
+    from ..parallel.mesh import ct_rows_slot_ids
+
+    if ct_rows is None or not len(ct_rows) or not moved_slots:
+        return 0
+    rows = np.asarray(ct_rows)
+    slots = ct_rows_slot_ids(rows, n_slots)
+    owner_of = router.snapshot()["slot-owner"]
+    total = 0
+    for tgt_idx in sorted({owner_of[s] for s in moved_slots}):
+        tgt_slots = np.asarray(
+            [s for s in moved_slots if owner_of[s] == tgt_idx],
+            dtype=np.int64)
+        mask = np.isin(slots, tgt_slots)
+        if mask.any():
+            cluster.nodes[tgt_idx].merge_ct(rows[mask])
+            total += int(mask.sum())
+    return total
+
+
 class ClusterAutoscaler:
     """Queue-depth-driven scale-out on the repo's controller infra.
 
@@ -245,18 +377,28 @@ class ClusterAutoscaler:
     single thread serializes; a failed scale-out backs off on the
     controller's own failure backoff)."""
 
-    # guarded-by: _lock: _streak, triggered, last_error
+    # guarded-by: _lock: _streak, _cold_streak, triggered,
+    # guarded-by: _lock: triggered_down, last_error
 
     def __init__(self, cluster, high_frac: float, ticks: int,
-                 max_nodes: int, interval_s: float):
+                 max_nodes: int, interval_s: float,
+                 low_frac: float = 0.0, min_nodes: int = 1):
         self._cluster = cluster
         self.high_frac = float(high_frac)
         self.ticks = int(ticks)
         self.max_nodes = int(max_nodes)
         self.interval_s = float(interval_s)
+        # low watermark for scale-IN: `ticks` consecutive samples
+        # with EVERY queue under low_frac * forward_depth retire one
+        # node (0 disables — the conservative default: shrinking a
+        # stateful tier moves CT)
+        self.low_frac = float(low_frac)
+        self.min_nodes = int(min_nodes)
         self._lock = threading.Lock()
         self._streak = 0
+        self._cold_streak = 0
         self.triggered = 0
+        self.triggered_down = 0
         self.last_error: Optional[str] = None
         self._controller: Optional[Controller] = None
 
@@ -281,8 +423,11 @@ class ClusterAutoscaler:
         snap = r.snapshot()
         depth = max(snap["pending"]) if snap["pending"] else 0
         hot = depth >= self.high_frac * r.forward_depth
+        cold = (self.low_frac > 0
+                and depth <= self.low_frac * r.forward_depth)
         with self._lock:
             self._streak = self._streak + 1 if hot else 0
+            self._cold_streak = self._cold_streak + 1 if cold else 0
             # the budget caps LIVE replicas: a SIGKILLed corpse
             # stays in c.nodes for its retained ledgers but consumes
             # no capacity — counting it would wedge the autoscaler
@@ -290,21 +435,31 @@ class ClusterAutoscaler:
             alive = sum(1 for n in c.nodes if n.alive)
             fire = (self._streak >= self.ticks
                     and alive < self.max_nodes)
+            fire_down = (not fire
+                         and self._cold_streak >= self.ticks
+                         and alive > self.min_nodes)
             if fire:
                 self._streak = 0
+                self._cold_streak = 0
                 # counted at FIRE time (before the node appears in
                 # c.nodes): an observer seeing the new node must
                 # also see the trigger that built it
                 self.triggered += 1
-        if not fire:
+            elif fire_down:
+                self._cold_streak = 0
+                self.triggered_down += 1
+        if not fire and not fire_down:
             return
         try:
-            c.add_node()
+            if fire:
+                c.add_node()
+            else:
+                c.remove_node()
             with self._lock:
                 self.last_error = None
         except Exception as e:  # noqa: BLE001 — surfaced in stats +
-            # the controller's failure backoff; the next hot streak
-            # retries
+            # the controller's failure backoff; the next hot/cold
+            # streak retries
             with self._lock:
                 self.last_error = f"{type(e).__name__}: {e}"
             raise
@@ -314,10 +469,14 @@ class ClusterAutoscaler:
         with self._lock:
             return {
                 "high-frac": self.high_frac,
+                "low-frac": self.low_frac,
                 "ticks": self.ticks,
                 "max-nodes": self.max_nodes,
+                "min-nodes": self.min_nodes,
                 "streak": self._streak,
+                "cold-streak": self._cold_streak,
                 "triggered": self.triggered,
+                "triggered-down": self.triggered_down,
                 **({"last-error": self.last_error}
                    if self.last_error else {}),
             }

@@ -45,6 +45,19 @@ pieces out so BOTH transports run one implementation:
   machine-wide CLOCK_MONOTONIC, so stamps from the parent and a
   worker process on the same host compare directly (the repo's
   cluster is same-host loopback by construction — DIVERGENCES #26).
+- PIPELINED DATA CHANNEL (ISSUE 17): the SEQUENCED frame kinds carry
+  a monotonic per-channel sequence number ahead of the (optional)
+  trace block, and the CUMULATIVE ACK (:func:`pack_cum_ack` /
+  :func:`unpack_cum_ack`) acknowledges every frame up to its highest
+  contiguous sequence in ONE frame — admitted-row delta, the running
+  packet ledger, and the per-frame trace echo LIST for any traced
+  frames the window covered.  :class:`SendWindow` is the sender-side
+  bookkeeping: frames in flight between send and cumulative ack,
+  retained with their rows so a dead channel's unacked frames can be
+  requeued to a failover peer (or counted ``crash_dropped``) —
+  nothing in flight is ever silently lost.  The legacy unsequenced
+  kinds and the per-frame ACK stay byte-identical: a window-1
+  channel degenerates to the PR 13 protocol exactly.
 
 THREAD AFFINITY: the ``transport`` domain (CTA002 vocabulary, a
 CTA003 hot domain like ``drain``/``router``) covers the threads that
@@ -64,12 +77,13 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 __all__ = [
-    "FrameError", "LineFramer", "shutdown_close",
+    "FrameError", "LineFramer", "shutdown_close", "SendWindow",
     "send_frame", "recv_frame", "send_json_frame", "recv_json_frame",
-    "encode_rows", "decode_rows", "decode_rows_ex",
+    "encode_rows", "decode_rows", "decode_rows_ex", "decode_rows_seq",
     "pack_ack", "unpack_ack", "unpack_ack_ex",
+    "pack_cum_ack", "unpack_cum_ack",
     "rows_to_b64", "rows_from_b64",
-    "MAX_FRAME", "ACK_SIZE", "ACK_TRACED_SIZE",
+    "MAX_FRAME", "ACK_SIZE", "ACK_TRACED_SIZE", "CUM_ACK_MIN_SIZE",
 ]
 
 # frame length prefix: 4-byte big-endian unsigned
@@ -99,8 +113,35 @@ _ROWS_PACKED = 2  # [n, 4] u32 packed rows + (ep, dirn) stream scalars
 # header and the rows (ISSUE 14 cross-process trace stitching)
 _ROWS_WIDE_TRACED = 3
 _ROWS_PACKED_TRACED = 4
+# sequenced variants (ISSUE 17, the pipelined channel): a u64
+# sequence number between the fixed header and the (optional) trace
+# block.  Sequence numbers are per-channel monotonic starting at 1;
+# the worker acks them CUMULATIVELY (pack_cum_ack below).
+_ROWS_WIDE_SEQ = 5
+_ROWS_PACKED_SEQ = 6
+_ROWS_WIDE_TRACED_SEQ = 7
+_ROWS_PACKED_TRACED_SEQ = 8
+_SEQ_KINDS = (_ROWS_WIDE_SEQ, _ROWS_PACKED_SEQ,
+              _ROWS_WIDE_TRACED_SEQ, _ROWS_PACKED_TRACED_SEQ)
+_TRACED_KINDS = (_ROWS_WIDE_TRACED, _ROWS_PACKED_TRACED,
+                 _ROWS_WIDE_TRACED_SEQ, _ROWS_PACKED_TRACED_SEQ)
+_PACKED_KINDS = (_ROWS_PACKED, _ROWS_PACKED_TRACED,
+                 _ROWS_PACKED_SEQ, _ROWS_PACKED_TRACED_SEQ)
 _ROWS_HDR = struct.Struct(">BIIII")  # kind, n, cols, ep, dirn
 _TRACE_HDR = struct.Struct(">Qdd")  # trace_id, t_enq, t_fwd
+_SEQ = struct.Struct(">Q")  # per-channel frame sequence number
+
+# cumulative ACK (ISSUE 17): one frame acknowledging every sequenced
+# frame up to ``seq``.  Leading kind byte + highest contiguous seq
+# u64 + frames-covered u32, then admitted-row DELTA for the covered
+# frames u64 and the running packet ledger (same four counters as the
+# legacy ACK), then an echo count u32 and that many trace echoes.
+# Minimum size 57 bytes — never collides with the legacy 36/60-byte
+# per-frame ACK sizes, so both can share a channel during tests.
+CUM_ACK_KIND = 0xC5
+_CUM_ACK = struct.Struct(">BQIQQQQQ")
+_ECHO_N = struct.Struct(">I")
+CUM_ACK_MIN_SIZE = _CUM_ACK.size + _ECHO_N.size
 
 
 class FrameError(Exception):
@@ -221,53 +262,74 @@ def recv_json_frame(sock: socket.socket,
 # -- row batches -------------------------------------------------------
 def encode_rows(rows: np.ndarray,
                 packed_meta: Optional[Tuple[int, int]] = None,
-                trace: Optional[Tuple[int, float, float]] = None
-                ) -> bytes:
+                trace: Optional[Tuple[int, float, float]] = None,
+                seq: Optional[int] = None) -> bytes:
     # thread-affinity: transport, router
     """Row batch -> frame payload.  ``packed_meta=(ep, dirn)`` marks
     ``rows`` as packed ``[n, 4]`` u32 (the 16 B/packet wire format —
     the stream scalars ride the header); otherwise wide
     ``[n, cols]`` u32.  ``trace=(trace_id, t_enq, t_fwd)`` makes the
     frame a TRACED one: the receiver stamps its own stages and
-    echoes the trace id on the ack (cross-process span stitching)."""
+    echoes the trace id on the ack (cross-process span stitching).
+    ``seq`` makes the frame a SEQUENCED one (the pipelined channel,
+    ISSUE 17): the receiver acks it cumulatively instead of
+    per-frame.  ``seq=None`` keeps the PR 13 wire byte-identical."""
     rows = np.ascontiguousarray(rows, dtype=np.uint32)
     if rows.ndim != 2:
         raise ValueError(f"rows must be 2-D, got shape {rows.shape}")
     if packed_meta is not None:
         ep, dirn = packed_meta
-        kind = (_ROWS_PACKED_TRACED if trace is not None
-                else _ROWS_PACKED)
+        if seq is not None:
+            kind = (_ROWS_PACKED_TRACED_SEQ if trace is not None
+                    else _ROWS_PACKED_SEQ)
+        else:
+            kind = (_ROWS_PACKED_TRACED if trace is not None
+                    else _ROWS_PACKED)
     else:
         ep = dirn = 0
-        kind = (_ROWS_WIDE_TRACED if trace is not None
-                else _ROWS_WIDE)
+        if seq is not None:
+            kind = (_ROWS_WIDE_TRACED_SEQ if trace is not None
+                    else _ROWS_WIDE_SEQ)
+        else:
+            kind = (_ROWS_WIDE_TRACED if trace is not None
+                    else _ROWS_WIDE)
     hdr = _ROWS_HDR.pack(kind, rows.shape[0], rows.shape[1],
                          int(ep), int(dirn))
+    if seq is not None:
+        hdr += _SEQ.pack(int(seq))
     if trace is not None:
         tid, t_enq, t_fwd = trace
         hdr += _TRACE_HDR.pack(int(tid), float(t_enq), float(t_fwd))
     return hdr + rows.tobytes()
 
 
-def decode_rows_ex(payload: bytes) -> Tuple[
+def decode_rows_seq(payload: bytes) -> Tuple[
         np.ndarray, Optional[Tuple[int, int]],
-        Optional[Tuple[int, float, float]]]:
+        Optional[Tuple[int, float, float]], Optional[int]]:
     # thread-affinity: transport, any
     """Frame payload -> (rows, packed_meta or None, trace context or
-    None).  Raises :class:`FrameError` when the declared shape
-    disagrees with the byte count (a torn or corrupted frame must
-    not become a misshapen submit)."""
+    None, sequence number or None).  Raises :class:`FrameError` when
+    the declared shape disagrees with the byte count (a torn or
+    corrupted frame must not become a misshapen submit)."""
     if len(payload) < _ROWS_HDR.size:
         raise FrameError(
             f"row frame of {len(payload)} bytes is shorter than its "
             f"header ({_ROWS_HDR.size})")
     kind, n, cols, ep, dirn = _ROWS_HDR.unpack_from(payload)
     if kind not in (_ROWS_WIDE, _ROWS_PACKED,
-                    _ROWS_WIDE_TRACED, _ROWS_PACKED_TRACED):
+                    _ROWS_WIDE_TRACED, _ROWS_PACKED_TRACED,
+                    *_SEQ_KINDS):
         raise FrameError(f"unknown row-frame kind {kind}")
     off = _ROWS_HDR.size
+    seq = None
+    if kind in _SEQ_KINDS:
+        if len(payload) < off + _SEQ.size:
+            raise FrameError(
+                "sequenced row frame is shorter than its seq block")
+        (seq,) = _SEQ.unpack_from(payload, off)
+        off += _SEQ.size
     trace = None
-    if kind in (_ROWS_WIDE_TRACED, _ROWS_PACKED_TRACED):
+    if kind in _TRACED_KINDS:
         if len(payload) < off + _TRACE_HDR.size:
             raise FrameError(
                 "traced row frame is shorter than its trace block")
@@ -280,12 +342,23 @@ def decode_rows_ex(payload: bytes) -> Tuple[
             f"row frame declares [{n}, {cols}] u32 ({want} bytes) "
             f"but carries {len(body)}")
     rows = np.frombuffer(body, dtype=np.uint32).reshape(n, cols)
-    if kind in (_ROWS_PACKED, _ROWS_PACKED_TRACED):
+    if kind in _PACKED_KINDS:
         if cols != 4:
             raise FrameError(
                 f"packed row frame must be [n, 4], got [{n}, {cols}]")
-        return rows, (ep, dirn), trace
-    return rows, None, trace
+        return rows, (ep, dirn), trace, seq
+    return rows, None, trace, seq
+
+
+def decode_rows_ex(payload: bytes) -> Tuple[
+        np.ndarray, Optional[Tuple[int, int]],
+        Optional[Tuple[int, float, float]]]:
+    # thread-affinity: transport, any
+    """The pre-pipelining three-tuple surface (rows, packed_meta or
+    None, trace or None); sequenced frames decode fine — the seq is
+    simply dropped."""
+    rows, packed_meta, trace, _seq = decode_rows_seq(payload)
+    return rows, packed_meta, trace
 
 
 def decode_rows(payload: bytes
@@ -293,7 +366,7 @@ def decode_rows(payload: bytes
     # thread-affinity: transport, any
     """The pre-trace two-tuple surface (rows, packed_meta or None);
     traced frames decode fine — the context is simply dropped."""
-    rows, packed_meta, _trace = decode_rows_ex(payload)
+    rows, packed_meta, _trace, _seq = decode_rows_seq(payload)
     return rows, packed_meta
 
 
@@ -360,3 +433,129 @@ def unpack_ack(payload: bytes) -> Tuple[int, int, int, int, int]:
     """The pre-trace five-tuple surface (trace echo dropped)."""
     ledger, _trace = unpack_ack_ex(payload)
     return ledger
+
+
+# -- the cumulative ACK + send window (ISSUE 17) -----------------------
+def pack_cum_ack(seq: int, frames: int, admitted: int,
+                 submitted: int, verdicts: int, shed: int,
+                 recovery_dropped: int,
+                 echoes: Tuple[Tuple[int, float, float], ...] = ()
+                 ) -> bytes:
+    # thread-affinity: transport, ackflush -- the worker's data
+    # thread packs acks at the cadence boundary; the flush-on-idle
+    # timer packs the quiet-tail ack
+    """One CUMULATIVE ack: every sequenced frame up to ``seq`` (the
+    highest contiguous sequence admitted) is acknowledged at once.
+    ``frames`` is how many frames this ack covers (since the previous
+    ack), ``admitted`` the admitted-row delta across them, and the
+    four ledger counters are the node's RUNNING packet ledger as of
+    the last covered admit — the same final-word contract the
+    per-frame ack carries, so a SIGKILLed worker's last cumulative
+    ack still closes the cluster ledger exactly.  ``echoes`` is the
+    per-frame trace echo list ``(trace_id, t_recv, t_admit)`` for
+    any traced frames the ack covers (span stitching keeps working
+    through coalescing)."""
+    body = _CUM_ACK.pack(CUM_ACK_KIND, int(seq), int(frames),
+                         int(admitted), int(submitted), int(verdicts),
+                         int(shed), int(recovery_dropped))
+    body += _ECHO_N.pack(len(echoes))
+    for tid, t_recv, t_admit in echoes:
+        body += _ACK_TRACE.pack(int(tid), float(t_recv),
+                                float(t_admit))
+    return body
+
+
+def unpack_cum_ack(payload: bytes) -> Tuple[
+        Tuple[int, int, int, int, int, int, int],
+        List[Tuple[int, float, float]]]:
+    # thread-affinity: transport, router
+    """Cumulative-ack payload -> ((seq, frames, admitted, submitted,
+    verdicts, shed, recovery_dropped), echo list)."""
+    if len(payload) < CUM_ACK_MIN_SIZE:
+        raise FrameError(
+            f"cumulative ack is {len(payload)} bytes, want >= "
+            f"{CUM_ACK_MIN_SIZE}")
+    kind = payload[0]
+    if kind != CUM_ACK_KIND:
+        raise FrameError(f"cumulative ack kind {kind:#x}, want "
+                         f"{CUM_ACK_KIND:#x}")
+    hdr = _CUM_ACK.unpack_from(payload)
+    (n_echo,) = _ECHO_N.unpack_from(payload, _CUM_ACK.size)
+    off = _CUM_ACK.size + _ECHO_N.size
+    want = off + n_echo * _ACK_TRACE.size
+    if len(payload) != want:
+        raise FrameError(
+            f"cumulative ack declares {n_echo} echoes ({want} bytes) "
+            f"but carries {len(payload)}")
+    echoes = []
+    for _ in range(n_echo):
+        echoes.append(_ACK_TRACE.unpack_from(payload, off))
+        off += _ACK_TRACE.size
+    return hdr[1:], echoes
+
+
+class SendWindow:
+    """Sender-side bookkeeping for the pipelined channel: the frames
+    in flight between send and cumulative ack, in sequence order,
+    RETAINED WITH THEIR ROWS — a dead channel's unacked frames are
+    either requeued to the failover peer or counted ``crash_dropped``
+    (cluster/process.py), never silently lost.
+
+    Pure bookkeeping: callers (ProcessNode) hold their own lock; each
+    instance is single-writer by construction."""
+
+    __slots__ = ("window", "entries", "next_seq", "inflight_rows")
+
+    def __init__(self, window: int) -> None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        # (seq, rows, t_enq, ctx) in ascending seq order
+        self.entries: List[tuple] = []
+        self.next_seq = 1
+        self.inflight_rows = 0
+
+    @property
+    def full(self) -> bool:
+        return len(self.entries) >= self.window
+
+    @property
+    def inflight_frames(self) -> int:
+        return len(self.entries)
+
+    def add(self, rows, t_enq: float, ctx=None) -> int:
+        # thread-affinity: router -- the forwarder registers the
+        # frame it is about to send
+        seq = self.next_seq
+        self.next_seq += 1
+        self.entries.append((seq, rows, t_enq, ctx))
+        self.inflight_rows += len(rows)
+        return seq
+
+    def retire(self, up_to: int) -> List[tuple]:
+        # thread-affinity: transport -- the ack reader retires the
+        # contiguous prefix a cumulative ack covers
+        out = []
+        while self.entries and self.entries[0][0] <= up_to:
+            ent = self.entries.pop(0)
+            self.inflight_rows -= len(ent[1])
+            out.append(ent)
+        return out
+
+    def drop(self, seq: int) -> bool:
+        # thread-affinity: router -- a frame whose SEND failed never
+        # reached the worker: unregister it so the forwarder's
+        # requeue-on-error does not double-count its rows
+        for i, ent in enumerate(self.entries):
+            if ent[0] == seq:
+                self.inflight_rows -= len(ent[1])
+                del self.entries[i]
+                return True
+        return False
+
+    def take_all(self) -> List[tuple]:
+        # thread-affinity: any -- crash/teardown: every sent-but-
+        # unacked frame, for requeue or counted loss
+        out, self.entries = self.entries, []
+        self.inflight_rows = 0
+        return out

@@ -17,6 +17,13 @@ Wire format: newline-delimited JSON frames (values base64).
 - request   ``{"i": n, "op": "...", ...args}``
 - response  ``{"i": n, "r": <result>}`` or ``{"i": n, "e": "msg"}``
 - watch push ``{"w": wid, "k": kind, "key": k, "v": b64, "rev": n}``
+- watch batch ``{"wb": [push, push, ...]}`` — CONSECUTIVE watch
+  pushes found in one writer-drain are coalesced into one frame
+  (ISSUE 17 — the cluster data channel's coalesced-ack idea applied
+  to watch fan-out: a policy publish fanning to N watchers pays one
+  syscall + one frame per drain, not one per event).  Only adjacent
+  pushes merge, so ordering against responses is preserved; a lone
+  push keeps the PR 8 single-frame format byte-identical.
 
 The client reconnects with backoff on connection loss and re-subscribes
 its watches with replay (consumers are idempotent: allocator mirrors,
@@ -72,24 +79,53 @@ class _Conn:
         threading.Thread(target=self._write_loop, daemon=True).start()
 
     def _send(self, obj: dict) -> None:
-        data = (json.dumps(obj) + "\n").encode()
+        # objects, not bytes: the writer decides the framing at drain
+        # time (consecutive watch pushes coalesce into one "wb" frame)
         with self._out_lock:
             if self._closed:
                 return
-            self._out.append(data)
+            self._out.append(obj)
         self._out_ready.set()
+
+    @staticmethod
+    def _frame_batch(objs: list) -> bytes:
+        """One writer-drain's objects -> wire bytes.  Runs of >= 2
+        consecutive watch pushes (have "w", no "i") become one
+        ``{"wb": [...]}`` line; everything else — responses, and a
+        LONE watch push — keeps its own line unchanged.  Merging only
+        adjacent pushes preserves order against responses."""
+        lines = []
+        run: list = []
+
+        def flush_run() -> None:
+            if not run:
+                return
+            if len(run) == 1:
+                lines.append(json.dumps(run[0]))
+            else:
+                lines.append(json.dumps({"wb": list(run)}))
+            run.clear()
+
+        for obj in objs:
+            if "w" in obj and "i" not in obj:
+                run.append(obj)
+            else:
+                flush_run()
+                lines.append(json.dumps(obj))
+        flush_run()
+        return ("\n".join(lines) + "\n").encode()
 
     def _write_loop(self) -> None:
         while True:
             self._out_ready.wait()
             with self._out_lock:
-                chunks, self._out = self._out, []
+                objs, self._out = self._out, []
                 self._out_ready.clear()
-                if self._closed and not chunks:
+                if self._closed and not objs:
                     return
             try:
-                for c in chunks:
-                    self.sock.sendall(c)
+                if objs:
+                    self.sock.sendall(self._frame_batch(objs))
             except OSError:
                 self.close()
                 return
@@ -377,7 +413,13 @@ class RemoteKVStore:
                 continue
             for line in framer.feed(data):
                 msg = json.loads(line)
-                if "w" in msg and "i" not in msg:
+                if "wb" in msg:
+                    # coalesced watch batch: unpack in order — the
+                    # single dispatcher queue keeps delivery order
+                    # identical to the unbatched protocol's
+                    for ev in msg["wb"]:
+                        self._dispatch_watch(ev)
+                elif "w" in msg and "i" not in msg:
                     self._dispatch_watch(msg)
                 else:
                     with self._lock:

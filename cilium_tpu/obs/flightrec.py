@@ -62,6 +62,11 @@ KIND_NODE_FAILOVER = "node-failover"
 # cluster, a slot share re-pinned to it, and the moved slots' CT
 # migrated (cluster/scale.py); recorded on the NEW node
 KIND_NODE_SCALEOUT = "node-scaleout"
+# a live scale-IN completed: a replica retired cleanly — window
+# drained, slots re-pinned onto the survivors, its CT migrated to
+# each slot's new owner (cluster/scale.py scale_in); recorded on a
+# SURVIVOR — the victim's recorder retires with it
+KIND_NODE_SCALEIN = "node-scalein"
 # the map-pressure monitor (datapath/pressure.py) crossed a
 # threshold — CT occupancy, insert-drop rate, or NAT pool failures —
 # and entered the pressure state (one incident per episode; the

@@ -409,7 +409,28 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
     reg.counter("cilium_cluster_scale_outs_total",
                 "completed live scale-outs (node joined, slot share "
                 "re-pinned, moved slots' CT migrated)",
-                lambda: cl(lambda c: len(c.scale_events)))
+                lambda: cl(lambda c: sum(
+                    1 for e in c.scale_events
+                    if e.get("kind") != "scale-in")))
+    reg.counter("cilium_cluster_scale_ins_total",
+                "completed live scale-ins (node retired cleanly: "
+                "window drained, slots re-pinned, CT migrated to "
+                "each slot's new owner)",
+                lambda: cl(lambda c: c.scale_ins_total()))
+    reg.gauge("cilium_cluster_inflight_frames",
+              "pipelined data-channel frames sent but not yet "
+              "cumulatively acked, summed over windowed nodes "
+              "(live at scrape time)",
+              lambda: cl(lambda c: c.inflight_frames()))
+    reg.counter("cilium_cluster_acks_coalesced_total",
+                "per-frame acks elided by the worker-side ack "
+                "coalescer (a cumulative ack covering k frames "
+                "counts k-1)",
+                lambda: cl(lambda c: c.acks_coalesced_total()))
+    reg.counter("cilium_cluster_window_stalls_total",
+                "times a forwarder exhausted its send-window credit "
+                "and waited for a cumulative ack",
+                lambda: cl(lambda c: c.window_stalls_total()))
     reg.histogram("cilium_cluster_forward_latency_us",
                   "router enqueue -> node delivered (queue wait + "
                   "transport round trip, µs, log2 buckets)",

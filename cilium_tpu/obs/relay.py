@@ -178,7 +178,9 @@ class ClusterSpanStore:
         return ctx
 
     def commit_span(self, ctx: TraceCtx) -> None:
-        # thread-affinity: router
+        # thread-affinity: router, transport -- sync path commits on
+        # the forwarder; pipelined frames commit on the parent's ack
+        # reader (ISSUE 17) when the cumulative ack lands
         with self._lock:
             if not ctx.complete():
                 self.dropped += 1
@@ -194,7 +196,9 @@ class ClusterSpanStore:
                                  * 1e6)
 
     def drop_span(self, ctx: TraceCtx) -> None:
-        # thread-affinity: router, api
+        # thread-affinity: router, api, transport -- the parent's
+        # ack reader counts a swept window's late hand-back as span
+        # loss (ISSUE 17)
         """The chunk died before its ack (crashed worker, failover
         migration, stop sweep): the span is counted lost."""
         with self._lock:
@@ -464,6 +468,7 @@ class ClusterObsRelay:
             ent["top"] = snap.get("top")
             ent["trace"] = snap.get("trace")
             ent["incidents"] = snap.get("incidents") or []
+            ent["l7-by-plugin"] = snap.get("l7-by-plugin") or {}
             fresh = snap.get("flows") or []
             for f in fresh:
                 f["node_name"] = name
@@ -531,6 +536,33 @@ class ClusterObsRelay:
         texts = {name: e["metrics-text"] for name, e in cache.items()
                  if not e["stale"] and e.get("metrics-text")}
         lines = merge_expositions(texts)
+        # node+plugin-labeled L7 parse latency (PR 16 residue c):
+        # the per-node registries already render an L7 family, but
+        # summed across plugins — operators comparing one plugin's
+        # tail across nodes need the plugin label preserved
+        l7_lines: List[str] = []
+        for name, e in sorted(cache.items()):
+            if e["stale"]:
+                continue
+            esc = escape_label_value(name)
+            for plugin, snap in sorted(
+                    (e.get("l7-by-plugin") or {}).items()):
+                pesc = escape_label_value(str(plugin))
+                for stat in ("p50", "p95", "p99", "max", "count"):
+                    v = snap.get(stat)
+                    if v is None:
+                        continue
+                    l7_lines.append(
+                        f'cilium_cluster_l7_parse_latency_us{{'
+                        f'node="{esc}",plugin="{pesc}",'
+                        f'stat="{stat}"}} {v}')
+        if l7_lines:
+            lines.append("# HELP cilium_cluster_l7_parse_latency_us "
+                         "per-plugin L7 parse+verdict latency by "
+                         "node (µs percentiles)")
+            lines.append("# TYPE cilium_cluster_l7_parse_latency_us "
+                         "gauge")
+            lines.extend(l7_lines)
         # relay meta-series: the scrape plane's own observability
         lines.append("# HELP cilium_cluster_node_scrape_ok last "
                      "relay scrape of this node succeeded")

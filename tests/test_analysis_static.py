@@ -665,8 +665,11 @@ class TestFoldedCheckers:
                         self.router_overflow += n      # declared: ok
                         self.sneaky_dropped += n       # undeclared
             """,
-            "obs/registry.py":
-                '_S = "cilium_cluster_router_overflow_total"',
+            "obs/registry.py": '_S = (\n'
+                '    "cilium_cluster_router_overflow_total",\n'
+                '    "cilium_cluster_inflight_frames",\n'
+                '    "cilium_cluster_acks_coalesced_total",\n'
+                '    "cilium_cluster_window_stalls_total")',
             "datapath/verdict.py": "REASON_CLUSTER_OVERFLOW = 12",
             "monitor/api.py": "DROP_REASON_NAMES = {12: 'x'}",
             "flow/flow.py": "DROP_REASON_DESC = {12: 'X'}",

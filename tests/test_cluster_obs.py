@@ -233,7 +233,7 @@ EXPECTED_OPS = (
     "start_node", "warm", "start_serving", "front_end",
     "stop_serving", "metrics", "metricsmap", "obs_scrape", "sysdump",
     "map_pressure", "compile_stats", "ct_snapshot", "ct_merge",
-    "record_incident", "publish_drops", "shutdown",
+    "record_incident", "publish_drops", "shutdown", "ack_flush",
 )
 
 
@@ -806,3 +806,53 @@ class TestProcessClusterObsAcceptance:
             assert stt["ledger"]["exact"], stt["ledger"]
         finally:
             c.shutdown()
+
+
+class TestL7NodeLabeledStats:
+    """ISSUE 17 satellite (PR 16 residue c): the relay's merged
+    exposition carries per-plugin L7 parse/verdict latency
+    node-labeled — one family, one HELP/TYPE, every live node's
+    plugins inside it."""
+
+    class _Peer:
+        alive = True
+
+        def __init__(self, name, l7):
+            self.name = name
+            self._l7 = l7
+
+        def obs_scrape(self, cursor=0, flows=512, top=16):
+            return {"metrics-text": "", "flows": [], "cursor": 0,
+                    "top": None, "trace": None, "incidents": [],
+                    "l7-by-plugin": self._l7}
+
+    def test_merged_exposition_carries_per_plugin_series(self):
+        from cilium_tpu.obs.relay import ClusterObsRelay
+
+        peers = [
+            self._Peer("node0", {"http": {
+                "p50": 10.0, "p95": 20.0, "p99": 30.0,
+                "max": 40.0, "count": 5}}),
+            self._Peer("node1", {"dns": {
+                "p50": 1.5, "p95": 2.5, "p99": 3.5,
+                "max": 4.5, "count": 2}}),
+        ]
+        relay = ClusterObsRelay(lambda: peers, interval_s=0.0)
+        text = relay.cluster_metrics()
+        assert ('cilium_cluster_l7_parse_latency_us{node="node0",'
+                'plugin="http",stat="p50"} 10.0') in text
+        assert ('cilium_cluster_l7_parse_latency_us{node="node0",'
+                'plugin="http",stat="count"} 5') in text
+        assert ('cilium_cluster_l7_parse_latency_us{node="node1",'
+                'plugin="dns",stat="p99"} 3.5') in text
+        # one family: HELP/TYPE exactly once
+        assert text.count(
+            "# TYPE cilium_cluster_l7_parse_latency_us") == 1
+
+    def test_family_absent_without_l7_traffic(self):
+        from cilium_tpu.obs.relay import ClusterObsRelay
+
+        relay = ClusterObsRelay(
+            lambda: [self._Peer("node0", {})], interval_s=0.0)
+        text = relay.cluster_metrics()
+        assert "cilium_cluster_l7_parse_latency_us" not in text

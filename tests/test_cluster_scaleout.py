@@ -253,6 +253,16 @@ class TestScaleOutThreadMode:
                 assert "scale-outs 1" in out
                 assert "mode thread" in out
                 assert "forward latency" in out
+                # and back down (ISSUE 17): the CLI retires the
+                # newcomer; the scale-out count is unchanged
+                rc = cli_main(["--socket", sock, "cluster",
+                               "scale", "--down"])
+                assert rc == 0
+                out = capsys.readouterr().out
+                assert "node1 retired" in out
+                assert sum(1 for n in c.nodes if n.alive) == 1
+                assert c.summary()["scale-outs"] == 1
+                assert c.summary()["scale-ins"] == 1
             finally:
                 srv.stop()
             st = c.stop()
@@ -345,5 +355,80 @@ class TestScaleOutProcessMode:
                 assert not drops, (
                     f"migrated-flow replies dropped on the new "
                     f"process node: {drops}")
+        finally:
+            c.shutdown()
+
+
+@pytest.mark.chaos
+class TestScaleInThreadMode:
+    def test_remove_node_migrates_ct_ledger_exact(self):
+        """THE scale-in acceptance (ISSUE 17 satellite, ROADMAP
+        item 3 residue b): shrink 2 -> 1 under established flows —
+        ledger exact across the transition, replies of the victim's
+        flows pass egress enforcement on the survivor via the
+        shipped CT (zero drops), and the survivor recompiles
+        nothing."""
+        c, db = _build(nodes=2)
+        try:
+            c.start(trace_sample=1, packed=True,
+                    ring_capacity=1 << 10)
+            rows = _fwd(db.id)
+            assert c.submit(rows) == 128
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= 128)
+            r = c.router
+            victim_slots = set(r.slots_of(1))  # default victim:
+            ids = flow_shard_ids(rows, r.n_slots)  # last live node
+            moved_mask = np.isin(ids, list(victim_slots))
+            assert moved_mask.any(), \
+                "some established flows must live on the victim"
+            rec = c.remove_node()
+            assert rec["kind"] == "scale-in"
+            assert rec["node"] == "node1"
+            assert rec["nodes-after"] == 1
+            assert rec["moved-slots"] == len(victim_slots)
+            assert rec["ct-migrated-entries"] > 0
+            assert rec["survivor-recompiles"] == 0
+            # the victim is retired everywhere the tier looks — but
+            # stays in c.nodes so the ledger closes over its verdicts
+            assert not c.node("node1").alive
+            assert len(c.membership.statuses()) == 1
+            assert c.router.snapshot()["retired"] == [False, True]
+            # EVERY reply (migrated flows included) now lands on the
+            # survivor and passes egress via the migrated CT
+            buf = []
+            c.node("node0").daemon.monitor.register("t", buf.append)
+            c.submit(_rep(db.id))
+            assert _wait(lambda: c.forward_pending() == 0)
+            st = c.stop()
+            led = st["ledger"]
+            assert led["exact"], led
+            assert led["submitted"] == 256
+            assert st["cluster"]["scale-ins"] == 1
+            fwd = drop = 0
+            for b in buf:
+                m = b.hdr[:, COL_DIR] == 1
+                fwd += int((b.msg_type[m] != MSG_DROP).sum())
+                drop += int((b.msg_type[m] == MSG_DROP).sum())
+            assert drop == 0, (
+                f"CT continuity broken across scale-in: {drop} "
+                f"migrated-flow replies dropped on the survivor")
+            assert fwd == 128
+            # the scale-in is a named incident on the SURVIVOR
+            kinds = [i["kind"] for i in
+                     c.node("node0").daemon.flightrec.incidents()]
+            assert "node-scalein" in kinds
+        finally:
+            c.shutdown()
+
+    def test_scale_in_refuses_last_node(self):
+        from cilium_tpu.serving import ServingError
+
+        c, db = _build(nodes=1)
+        try:
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            with pytest.raises(ServingError, match="two live"):
+                c.remove_node()
         finally:
             c.shutdown()

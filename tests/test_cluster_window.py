@@ -1,0 +1,571 @@
+"""Pipelined cluster data channel (ISSUE 17 tentpole): the send
+window, the cumulative-ack codec, and exact crash accounting with
+the window OPEN.
+
+Acceptance:
+(a) window-vs-sync byte equivalence: ``encode_rows(..., seq=None)``
+    is byte-identical to the PR 13 wire, a ``forward_window=1``
+    router never enables a window, and the legacy per-frame ack
+    sizes never collide with the cumulative ack's;
+(b) seeded mid-window crash property: a fake worker over a real
+    socketpair acks cumulatively up to an arbitrary point then
+    dies — at EVERY kill point the sender-side identity
+    ``acked + handed_back == sent`` holds exactly (nothing in
+    flight is ever silently lost), and each ack's admitted delta
+    matches exactly the frames it retires;
+(c) the router's windowed accounting: delivery settles on the ack
+    (forwarded/latency/inflight), a broken window's frames re-enter
+    the queue in order, ``remove_node`` migrates slots + residual
+    queue with the ledger exact, and the ``ack_flush`` control op
+    is a pinned contract (CTA011);
+(d) the queue-depth autoscaler's scale-DOWN half: `ticks` cold
+    samples retire one node, never below ``min_nodes``.
+
+Named to sort early (the tier-1 budget-truncation convention)."""
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.cluster.transport import (ACK_SIZE, ACK_TRACED_SIZE,
+                                          CUM_ACK_MIN_SIZE,
+                                          FrameError, SendWindow,
+                                          decode_rows_ex,
+                                          decode_rows_seq,
+                                          encode_rows, pack_ack,
+                                          pack_cum_ack, recv_frame,
+                                          send_frame, shutdown_close,
+                                          unpack_cum_ack)
+
+pytestmark = pytest.mark.cluster
+
+
+# -- the send window ---------------------------------------------------
+class TestSendWindow:
+    def test_sequences_are_monotonic_from_one(self):
+        w = SendWindow(4)
+        r = np.zeros((3, 4), dtype=np.uint32)
+        assert w.add(r, 0.0) == 1
+        assert w.add(r, 0.0) == 2
+        assert w.inflight_frames == 2
+        assert w.inflight_rows == 6
+
+    def test_full_at_window(self):
+        w = SendWindow(2)
+        r = np.zeros((1, 4), dtype=np.uint32)
+        assert not w.full
+        w.add(r, 0.0)
+        assert not w.full
+        w.add(r, 0.0)
+        assert w.full
+
+    def test_retire_contiguous_prefix_only(self):
+        w = SendWindow(8)
+        rows = [np.zeros((i + 1, 4), dtype=np.uint32)
+                for i in range(4)]
+        for r in rows:
+            w.add(r, 0.0)
+        out = w.retire(2)
+        assert [e[0] for e in out] == [1, 2]
+        assert w.inflight_frames == 2
+        assert w.inflight_rows == 3 + 4
+        # re-acking an already-retired seq is a no-op
+        assert w.retire(2) == []
+
+    def test_drop_unregisters_failed_send(self):
+        w = SendWindow(8)
+        r = np.zeros((5, 4), dtype=np.uint32)
+        s1 = w.add(r, 0.0)
+        s2 = w.add(r, 0.0)
+        assert w.drop(s1) is True
+        assert w.drop(s1) is False
+        assert w.inflight_frames == 1
+        assert w.inflight_rows == 5
+        # the surviving entry retires normally
+        assert [e[0] for e in w.retire(s2)] == [s2]
+
+    def test_take_all_empties(self):
+        w = SendWindow(8)
+        r = np.zeros((2, 4), dtype=np.uint32)
+        w.add(r, 0.0)
+        w.add(r, 0.0)
+        out = w.take_all()
+        assert [e[0] for e in out] == [1, 2]
+        assert w.inflight_frames == 0
+        assert w.inflight_rows == 0
+
+    def test_window_must_be_positive(self):
+        with pytest.raises(ValueError):
+            SendWindow(0)
+
+
+# -- the cumulative-ack codec ------------------------------------------
+class TestCumAckCodec:
+    def test_roundtrip_no_echoes(self):
+        blob = pack_cum_ack(7, 3, 384, 1000, 900, 50, 10)
+        (seq, frames, admitted, sub, ver, shed, rec), echoes = \
+            unpack_cum_ack(blob)
+        assert (seq, frames, admitted) == (7, 3, 384)
+        assert (sub, ver, shed, rec) == (1000, 900, 50, 10)
+        assert echoes == []
+
+    def test_roundtrip_with_echoes(self):
+        want = [(11, 1.5, 2.5), (12, 3.0, 4.0)]
+        blob = pack_cum_ack(9, 2, 64, 1, 2, 3, 4,
+                            echoes=tuple(want))
+        hdr, echoes = unpack_cum_ack(blob)
+        assert hdr == (9, 2, 64, 1, 2, 3, 4)
+        assert [(t, r, a) for t, r, a in echoes] == want
+
+    def test_short_payload_is_loud(self):
+        with pytest.raises(FrameError):
+            unpack_cum_ack(b"\x00" * (CUM_ACK_MIN_SIZE - 1))
+
+    def test_wrong_kind_is_loud(self):
+        blob = bytearray(pack_cum_ack(1, 1, 1, 1, 1, 1, 1))
+        blob[0] = 0x01
+        with pytest.raises(FrameError):
+            unpack_cum_ack(bytes(blob))
+
+    def test_torn_echo_block_is_loud(self):
+        blob = pack_cum_ack(1, 1, 1, 1, 1, 1, 1,
+                            echoes=((5, 1.0, 2.0),))
+        with pytest.raises(FrameError):
+            unpack_cum_ack(blob[:-4])
+
+    def test_sizes_never_collide_with_legacy_acks(self):
+        """The sync per-frame ack (36 or 60 bytes) and the cumulative
+        ack (>= 57, kind-tagged) can share a channel in tests."""
+        assert CUM_ACK_MIN_SIZE not in (ACK_SIZE, ACK_TRACED_SIZE)
+        assert len(pack_cum_ack(1, 1, 1, 1, 1, 1, 1)) \
+            == CUM_ACK_MIN_SIZE
+
+
+# -- window-vs-sync wire equivalence -----------------------------------
+class TestWireEquivalence:
+    def test_unsequenced_frame_is_pr13_byte_identical(self):
+        """``seq=None`` keeps the PR 13 wire EXACT: kind-1 wide /
+        kind-2 packed header then raw row bytes, nothing else."""
+        wide = np.arange(32, dtype=np.uint32).reshape(2, 16)
+        want = struct.pack(">BIIII", 1, 2, 16, 0, 0) + wide.tobytes()
+        assert encode_rows(wide) == want
+        packed = np.arange(8, dtype=np.uint32).reshape(2, 4)
+        want = struct.pack(">BIIII", 2, 2, 4, 7, 1) + packed.tobytes()
+        assert encode_rows(packed, packed_meta=(7, 1)) == want
+
+    def test_sequenced_frame_roundtrips_and_downgrades(self):
+        rows = np.arange(16, dtype=np.uint32).reshape(4, 4)
+        blob = encode_rows(rows, packed_meta=(3, 0), seq=42)
+        got, meta, trace, seq = decode_rows_seq(blob)
+        assert np.array_equal(got, rows)
+        assert meta == (3, 0)
+        assert trace is None
+        assert seq == 42
+        # the pre-pipelining decode surface simply drops the seq
+        got2, meta2, _ = decode_rows_ex(blob)
+        assert np.array_equal(got2, rows)
+        assert meta2 == (3, 0)
+
+    def test_sequenced_traced_frame_carries_both(self):
+        rows = np.zeros((2, 16), dtype=np.uint32)
+        blob = encode_rows(rows, trace=(99, 1.0, 2.0), seq=5)
+        got, meta, trace, seq = decode_rows_seq(blob)
+        assert np.array_equal(got, rows)
+        assert meta is None
+        assert trace == (99, 1.0, 2.0)
+        assert seq == 5
+
+    def test_torn_seq_block_is_loud(self):
+        rows = np.zeros((1, 4), dtype=np.uint32)
+        blob = encode_rows(rows, packed_meta=(0, 0), seq=1)
+        hdr = struct.calcsize(">BIIII")
+        with pytest.raises(FrameError):
+            decode_rows_seq(blob[:hdr + 4])
+
+
+# -- exact crash accounting at every kill point ------------------------
+class TestMidWindowCrashProperty:
+    """A fake worker on the far end of a real socketpair implements
+    the coalesced-ack protocol, admits frames, acks cumulatively at
+    a random cadence, then DIES at a random point — sometimes with
+    admitted-but-unflushed frames (the SIGKILL-between-admit-and-ack
+    hole the cumulative protocol must close).  The sender-side
+    identity must hold at EVERY kill point."""
+
+    @staticmethod
+    def _run_one(seed: int):
+        rng = np.random.default_rng(seed)
+        k = int(rng.integers(1, 12))             # frames to send
+        ack_every = int(rng.integers(1, 5))      # worker cadence
+        die_after = int(rng.integers(0, k + 1))  # frames admitted
+        flush_tail = bool(rng.integers(0, 2))    # ack the tail first?
+        sizes = [int(rng.integers(1, 64)) for _ in range(k)]
+        parent, worker = socket.socketpair()
+
+        def run_worker():
+            admitted_since = frames_since = 0
+            ledger_rows = 0
+            last_seq = 0
+            try:
+                for _ in range(die_after):
+                    payload = recv_frame(worker)
+                    if payload is None:
+                        return
+                    rows, _meta, _tr, seq = decode_rows_seq(payload)
+                    ledger_rows += len(rows)
+                    admitted_since += len(rows)
+                    frames_since += 1
+                    last_seq = seq
+                    if frames_since >= ack_every:
+                        send_frame(worker, pack_cum_ack(
+                            last_seq, frames_since, admitted_since,
+                            ledger_rows, ledger_rows, 0, 0))
+                        admitted_since = frames_since = 0
+                if flush_tail and frames_since:
+                    send_frame(worker, pack_cum_ack(
+                        last_seq, frames_since, admitted_since,
+                        ledger_rows, ledger_rows, 0, 0))
+            finally:
+                # SIGKILL stand-in: the channel just dies
+                shutdown_close(worker)
+
+        t = threading.Thread(target=run_worker, daemon=True)
+        t.start()
+
+        win = SendWindow(16)
+        total = 0
+        send_failed = 0
+        for n in sizes:
+            rows = np.zeros((n, 4), dtype=np.uint32)
+            seq = win.add(rows, time.monotonic())
+            total += n
+            try:
+                send_frame(parent, encode_rows(
+                    rows, packed_meta=(0, 0), seq=seq))
+            except OSError:
+                # a dead peer mid-send: the frame never reached the
+                # worker — unregister it (the forwarder's requeue
+                # owns those rows alone, ProcessNode.submit's
+                # contract)
+                win.drop(seq)
+                send_failed += n
+        acked = 0
+        final_word = None
+        while True:
+            try:
+                payload = recv_frame(parent)
+            except (FrameError, OSError):
+                break  # torn frame / reset: the channel is dead
+            if payload is None:
+                break
+            (seq, _frames, admitted, sub, _v, _s,
+             _r), _echoes = unpack_cum_ack(payload)
+            entries = win.retire(seq)
+            retired_rows = sum(len(e[1]) for e in entries)
+            # each ack's admitted DELTA covers exactly the frames it
+            # retires — the piece that makes the ledger exact
+            assert admitted == retired_rows, seed
+            acked += retired_rows
+            final_word = sub
+        handed_back = win.take_all()
+        requeued = sum(len(e[1]) for e in handed_back)
+        # THE identity: at every kill point, every row is acked,
+        # handed back for requeue/crash accounting, or a counted
+        # failed send — never silently lost
+        assert acked + requeued + send_failed == total, seed
+        # the last cumulative ack is the final word: its running
+        # ledger equals exactly the rows the sender retired
+        if final_word is not None:
+            assert final_word == acked, seed
+        shutdown_close(parent)
+        t.join(timeout=10)
+
+    def test_ledger_identity_at_every_kill_point(self):
+        for seed in range(24):
+            self._run_one(seed)
+
+
+# -- router windowed accounting (fake nodes, no serving build) ---------
+class _WinNode:
+    """Records the pipelined node surface; acks synchronously from
+    ``submit`` when ``echo`` (the in-order happy path)."""
+
+    alive = True
+
+    def __init__(self, name="w0", echo=True):
+        self.name = name
+        self.echo = echo
+        self.window = None
+        self.on_ack = None
+        self.on_broken = None
+        self.sent = []
+        self.flushes = 0
+
+    def enable_window(self, window, on_ack=None, on_broken=None):
+        self.window = window
+        self.on_ack = on_ack
+        self.on_broken = on_broken
+
+    def submit(self, rows, trace=None, t_enq=None):
+        self.sent.append((rows, t_enq, trace))
+        if self.echo and self.on_ack is not None:
+            self.on_ack([(len(rows), t_enq if t_enq is not None
+                          else time.monotonic(), trace)])
+        return len(rows)
+
+    def ack_flush(self):
+        self.flushes += 1
+        return None
+
+    def drain_window(self, timeout=30.0):
+        return True
+
+    def transport_stats(self):
+        return {"acks": len(self.sent), "acks-coalesced": 0,
+                "window-stalls": 0, "inflight-frames": 0,
+                "window": self.window or 1}
+
+
+class _SyncNode:
+    alive = True
+
+    def __init__(self, name="s0"):
+        self.name = name
+        self.got = 0
+
+    def submit(self, rows):
+        self.got += len(rows)
+        return len(rows)
+
+
+def _rows(n=128, sport0=1024):
+    rows = np.zeros((n, 16), dtype=np.uint32)
+    rows[:, 13] = 4  # COL_FAMILY
+    rows[:, 8] = sport0 + np.arange(n)  # COL_SPORT: spread the flows
+    return rows
+
+
+def _wait(pred, timeout=30.0, tick=0.005):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+class TestRouterWindowed:
+    def test_window_one_never_enables_a_window(self):
+        """forward_window=1 IS the sync protocol: the router must
+        not touch ``enable_window`` even on a capable node."""
+        from cilium_tpu.cluster.router import ClusterRouter
+
+        node = _WinNode()
+        r = ClusterRouter([node], forward_depth=4096,
+                          forward_window=1)
+        r.start()
+        assert r.submit(_rows()) == 128
+        assert _wait(lambda: node.window is None
+                     and len(node.sent) > 0)
+        snap = r.stop(drain=True)
+        assert node.window is None
+        assert snap["forward-window"] == 1
+        assert (snap["submitted"] == sum(snap["forwarded"])
+                + snap["router-overflow"])
+
+    def test_windowed_delivery_settles_on_the_ack(self):
+        from cilium_tpu.cluster.router import ClusterRouter
+
+        node = _WinNode()
+        r = ClusterRouter([node], forward_depth=4096,
+                          forward_window=8)
+        r.start()
+        assert node.window == 8
+        assert r.submit(_rows()) == 128
+        assert _wait(lambda: r.snapshot()["forwarded"][0] == 128)
+        snap = r.snapshot()
+        assert snap["inflight"] == [0]
+        assert snap["forward-latency-us"]["count"] >= 1
+        assert snap["window"]["acks"] == len(node.sent)
+        snap = r.stop(drain=True)
+        assert node.flushes >= 1  # stop forces the coalescer's hand
+        assert (snap["submitted"] == sum(snap["forwarded"])
+                + snap["router-overflow"]
+                + snap["failover-dropped"])
+
+    def test_incapable_node_stays_sync_under_windowed_router(self):
+        from cilium_tpu.cluster.router import ClusterRouter
+
+        node = _SyncNode()
+        r = ClusterRouter([node], forward_depth=4096,
+                          forward_window=8)
+        r.start()
+        assert r.submit(_rows()) == 128
+        assert _wait(lambda: node.got == 128)
+        snap = r.stop(drain=True)
+        assert snap["forwarded"][0] == 128
+
+    def test_broken_window_requeues_in_order(self):
+        """A dead channel's sent-but-unacked frames re-enter the
+        queue AT THE FRONT (order preserved) and the node parks
+        suspect — failover's migration or stop's sweep accounts
+        them; nothing vanishes."""
+        from cilium_tpu.cluster.router import ClusterRouter
+
+        node = _WinNode(echo=False)  # never acks: frames hang open
+        r = ClusterRouter([node], forward_depth=4096,
+                          forward_window=8)
+        r.start()
+        assert r.submit(_rows()) == 128
+        assert _wait(lambda: len(node.sent) > 0)
+        assert r.snapshot()["inflight"][0] == 128
+        # the channel dies: ProcessNode would hand the window back
+        node.on_broken([(rows, t_enq, tr)
+                        for rows, t_enq, tr in node.sent])
+        snap = r.snapshot()
+        assert snap["inflight"] == [0]
+        assert snap["pending"] == [128]
+        assert snap["forwarded"] == [0]
+        # the handed-back frames drain at stop: this fake can no
+        # longer ack, so stop counts them failover_dropped — the
+        # ledger still closes exactly
+        node.alive = False
+        snap = r.stop(drain=True)
+        assert (snap["submitted"] == sum(snap["forwarded"])
+                + snap["router-overflow"]
+                + snap["failover-dropped"])
+
+    def test_remove_node_migrates_slots_and_queue(self):
+        from cilium_tpu.cluster.router import ClusterRouter
+
+        victim, survivor = _SyncNode("v0"), _SyncNode("s1")
+        victim.alive = False  # parked: its queue holds still
+        r = ClusterRouter([victim, survivor], forward_depth=4096)
+        r.start()
+        sent = 0
+        for i in range(8):
+            sent += r.submit(_rows(sport0=1024 + 128 * i))
+        assert _wait(lambda: r.snapshot()["pending"][1] == 0
+                     and r.snapshot()["inflight"][1] == 0)
+        queued = r.snapshot()["pending"][0]
+        assert queued > 0  # the parked victim holds a backlog
+        moved = r.remove_node(0)
+        assert moved  # it owned slots; they all moved
+        snap = r.snapshot()
+        assert snap["retired"] == [True, False]
+        assert 0 not in snap["slot-owner"]
+        assert snap["pending"][0] == 0  # residual queue migrated
+        # the survivor drains the migrated rows
+        assert _wait(lambda: survivor.got + r.snapshot()
+                     ["failover-dropped"] >= sent)
+        snap = r.stop(drain=True)
+        assert victim.got + survivor.got == sum(snap["forwarded"])
+        assert (snap["submitted"] == sum(snap["forwarded"])
+                + snap["router-overflow"]
+                + snap["failover-dropped"])
+
+    def test_remove_last_live_node_refuses(self):
+        from cilium_tpu.cluster.router import ClusterRouter
+        from cilium_tpu.serving import ServingError
+
+        node = _SyncNode()
+        r = ClusterRouter([node], forward_depth=64)
+        r.start()
+        with pytest.raises(ServingError):
+            r.remove_node(0)
+        r.stop(drain=False)
+
+
+# -- the ack-flush control op is a pinned contract (CTA011) ------------
+class TestAckFlushOpContract:
+    def test_ack_flush_op_registered_with_timeout(self):
+        from cilium_tpu.cluster.nodehost import (OP_TIMEOUTS,
+                                                 _NodeHost)
+        assert OP_TIMEOUTS["ack_flush"] > 0
+        assert "ack_flush" in _NodeHost._OPS
+
+
+# -- autoscaler scale-down ---------------------------------------------
+class _FakeRouter:
+    forward_depth = 100
+
+    def __init__(self):
+        self.pending = [0, 0]
+
+    def snapshot(self):
+        return {"pending": list(self.pending)}
+
+
+class _FakeNode:
+    alive = True
+
+
+class _FakeCluster:
+    _stopped = False
+
+    def __init__(self, n=2):
+        self.router = _FakeRouter()
+        self.nodes = [_FakeNode() for _ in range(n)]
+        self.added = 0
+        self.removed = 0
+
+    def add_node(self):
+        self.added += 1
+        self.nodes.append(_FakeNode())
+
+    def remove_node(self, name=None):
+        self.removed += 1
+        self.nodes.pop()
+
+
+class TestAutoscalerScaleDown:
+    def test_cold_streak_retires_one_node(self):
+        from cilium_tpu.cluster.scale import ClusterAutoscaler
+
+        c = _FakeCluster(n=2)
+        a = ClusterAutoscaler(c, high_frac=0.5, ticks=2,
+                              max_nodes=4, interval_s=999.0,
+                              low_frac=0.1, min_nodes=1)
+        a._tick()
+        assert c.removed == 0  # one cold sample is not a streak
+        a._tick()
+        assert c.removed == 1
+        assert a.triggered_down == 1
+        assert a.stats()["cold-streak"] == 0  # streak reset at fire
+
+    def test_never_below_min_nodes(self):
+        from cilium_tpu.cluster.scale import ClusterAutoscaler
+
+        c = _FakeCluster(n=2)
+        a = ClusterAutoscaler(c, high_frac=0.5, ticks=1,
+                              max_nodes=4, interval_s=999.0,
+                              low_frac=0.1, min_nodes=2)
+        for _ in range(4):
+            a._tick()
+        assert c.removed == 0
+
+    def test_low_frac_zero_disables_scale_in(self):
+        from cilium_tpu.cluster.scale import ClusterAutoscaler
+
+        c = _FakeCluster(n=3)
+        a = ClusterAutoscaler(c, high_frac=0.5, ticks=1,
+                              max_nodes=4, interval_s=999.0)
+        for _ in range(4):
+            a._tick()
+        assert c.removed == 0
+
+    def test_hot_wins_over_cold(self):
+        from cilium_tpu.cluster.scale import ClusterAutoscaler
+
+        c = _FakeCluster(n=2)
+        c.router.pending = [80, 0]  # hot AND (trivially) not cold
+        a = ClusterAutoscaler(c, high_frac=0.5, ticks=1,
+                              max_nodes=4, interval_s=999.0,
+                              low_frac=0.9, min_nodes=1)
+        a._tick()
+        assert c.added == 1
+        assert c.removed == 0

@@ -254,3 +254,60 @@ class TestOSProcesses:
             assert first["identity"] == second["identity"]
         finally:
             srv.close()
+
+
+class TestWatchEventBatching:
+    """ISSUE 17 satellite: the server's writer drain coalesces
+    CONSECUTIVE watch pushes into one ``{"wb": [...]}`` frame —
+    fewer wakeups under event storms — while a LONE push stays
+    byte-identical to the pre-batching wire and responses never
+    reorder against the pushes around them."""
+
+    @staticmethod
+    def _push(i):
+        return {"w": 1, "k": "create", "key": f"p/{i}",
+                "v": None, "rev": i}
+
+    def test_run_of_pushes_becomes_one_wb_line(self):
+        from cilium_tpu.kvstore.remote import _Conn
+
+        objs = [self._push(i) for i in range(3)]
+        out = _Conn._frame_batch(objs).decode()
+        lines = out.strip().split("\n")
+        assert len(lines) == 1
+        assert json.loads(lines[0]) == {"wb": objs}
+
+    def test_lone_push_is_byte_identical(self):
+        from cilium_tpu.kvstore.remote import _Conn
+
+        obj = self._push(7)
+        assert _Conn._frame_batch([obj]) \
+            == (json.dumps(obj) + "\n").encode()
+
+    def test_response_breaks_the_run_order_preserved(self):
+        from cilium_tpu.kvstore.remote import _Conn
+
+        resp = {"i": 5, "r": True}
+        objs = [self._push(1), self._push(2), resp, self._push(3)]
+        lines = [json.loads(ln) for ln in
+                 _Conn._frame_batch(objs).decode().strip()
+                 .split("\n")]
+        assert lines == [{"wb": [self._push(1), self._push(2)]},
+                         resp, self._push(3)]
+
+    def test_burst_fans_out_in_order_e2e(self, server):
+        """A mutation burst from one client reaches a watcher on
+        another COMPLETE and IN ORDER through the batched wire."""
+        c1, c2 = _client(server), _client(server)
+        seen = []
+        c2.watch_prefix("burst/", lambda ev: seen.append(ev.key),
+                        replay=False)
+        n = 64
+        for i in range(n):
+            c1.update(f"burst/{i:03d}", b"x")
+        deadline = time.time() + 5
+        while len(seen) < n and time.time() < deadline:
+            time.sleep(0.01)
+        assert seen == [f"burst/{i:03d}" for i in range(n)]
+        c1.close()
+        c2.close()
