@@ -2029,6 +2029,11 @@ def bench_scenarios(seed: int = 31) -> dict:
     results = {}
     for name in sorted(SCENARIOS):
         sc = make_scenario(name, seed=seed)
+        if getattr(sc, "cluster_ops", False):
+            # cluster-facade op streams (rotation_storm's epoch
+            # bumps) have no plain-daemon leg — the soak gate's
+            # encrypted cluster leg owns them (ISSUE 18)
+            continue
         d = None
         try:
             # construction/start INSIDE the guard: one scenario's
@@ -2163,9 +2168,34 @@ def bench_cluster(target_packets=98304, reps=3) -> dict:
       serving cluster — freeze + quiesce (window drained), victim
       CT migrated out, slots re-pinned onto survivors, victim
       retired; the pause window and the ZERO survivor-recompile
-      count ship in the artifact."""
+      count ship in the artifact.
+
+    v4 legs (ISSUE 18 — the encrypted data channel):
+
+    - ENCRYPTED THROUGHPUT (process mode, ONE node, the shipped
+      window): ``cluster_encrypt=False`` vs ``True`` through the
+      ``paired_legs`` harness — ``encrypted_ratio`` is the
+      PAIR-MEDIAN of encrypted/plaintext rates, the AEAD toll
+      honestly measured on the same wire at the same window (one
+      seal per frame + one open per ack on the parent, the mirror
+      pair on the worker).
+
+    - SEAL/OPEN LATENCY: per-op percentiles for one bucket-sized
+      packed wire buffer through ``EncryptedChannel`` directly (no
+      cluster in the loop) — the per-frame cost floor an operator
+      pays for ``cluster_encrypt=True``.
+
+    - SIGKILL MID-ROTATION (process mode, encrypted): the corpse
+      dies CONCURRENT with a cluster-wide ``rotate_epoch`` under an
+      open window.  Whatever interleaving lands (rotation acked
+      then killed, killed mid-ack, killed before), the survivors
+      carry the new epoch, every undecryptable/unacked frame's rows
+      are counted (``crypto_dropped``/``crash_dropped``), and the
+      ledger closes EXACTLY — the chaos gate's claim, re-made as a
+      shipped artifact."""
     import ipaddress
     import os as _os
+    import threading as _threading
 
     from cilium_tpu.agent import DaemonConfig
     from cilium_tpu.cluster import ClusterServing
@@ -2381,7 +2411,7 @@ def bench_cluster(target_packets=98304, reps=3) -> dict:
     WAVES = 9
     WINDOW = cfg().cluster_forward_window  # the shipped default
 
-    def window_leg(window: int) -> float:
+    def window_leg(window: int, encrypt: bool = False) -> float:
         """Per-node forward throughput through ONE process-mode
         channel at the given credit window.  window=1 degenerates to
         the PR 13 sync-ack protocol (one frame in flight, one ack
@@ -2399,8 +2429,10 @@ def bench_cluster(target_packets=98304, reps=3) -> dict:
         measured win shrinks to what ack-coalescing alone buys
         (fewer wakeups + 1/ack_every of the ack legs) — the >=2x
         claim needs ``host_cores`` >= 2, same convention as the
-        scaling curve."""
-        c, db = build(1, "process", cluster_forward_window=window)
+        scaling curve.  ``encrypt=True`` runs the identical leg
+        with the channel sealed (the v4 paired comparison)."""
+        c, db = build(1, "process", cluster_forward_window=window,
+                      cluster_encrypt=encrypt)
         try:
             frames = [batch(FRAME, db.id) for _ in range(16)]
             wave_rows = WAVE_FRAMES * FRAME
@@ -2563,9 +2595,105 @@ def bench_cluster(target_packets=98304, reps=3) -> dict:
     si = scale_in_leg()
     ledger_ok = ledger_ok and si["ledger_exact"]
 
+    # -- v4: the encrypted data channel (ISSUE 18) --------------------
+    enc = paired_legs(lambda: window_leg(WINDOW, encrypt=False),
+                      lambda: window_leg(WINDOW, encrypt=True),
+                      reps=reps)
+
+    def crypto_latency() -> tuple:
+        """Per-op seal/open percentiles through the channel itself
+        (no cluster in the loop): one bucket-sized packed wire
+        buffer (BUCKET packets x 16 B), the unit the transport
+        actually seals."""
+        from cilium_tpu.encryption import (EncryptedChannel,
+                                           NodeKeypair)
+
+        a, b = NodeKeypair(), NodeKeypair()
+        tx = EncryptedChannel(a, b.public)
+        rx = EncryptedChannel(b, a.public)
+        payload = np.ascontiguousarray(
+            batch(BUCKET, 1)[:, :4]).tobytes()
+        seal_ns, open_ns = [], []
+        t_end = time.perf_counter() + 2.0  # time-boxed: the pure-
+        # python fallback must not stall the phase
+        for _ in range(512):
+            t0 = time.perf_counter_ns()
+            frame = tx.seal(payload)
+            t1 = time.perf_counter_ns()
+            rx.open(frame)
+            t2 = time.perf_counter_ns()
+            seal_ns.append(t1 - t0)
+            open_ns.append(t2 - t1)
+            if time.perf_counter() > t_end and len(seal_ns) >= 32:
+                break
+
+        def pct(v):
+            v = sorted(v)
+            return {"p50": round(v[len(v) // 2] / 1e3, 2),
+                    "p90": round(v[(len(v) * 9) // 10] / 1e3, 2),
+                    "p99": round(v[(len(v) * 99) // 100] / 1e3, 2),
+                    "n": len(v),
+                    "payload_bytes": len(payload)}
+
+        return pct(seal_ns), pct(open_ns)
+
+    seal_lat, open_lat = crypto_latency()
+
+    def sigkill_mid_rotation_rep() -> dict:
+        """SIGKILL one worker CONCURRENT with rotate_epoch on an
+        encrypted 2-worker cluster with the window open: survivors
+        carry the new epoch, the corpse's debt is counted, ledger
+        exact (the chaos gate's claim as a shipped number)."""
+        c, db = build(2, "process", cluster_encrypt=True)
+        try:
+            c.submit(batch(BUCKET, db.id))
+            t0 = time.perf_counter()
+            while c.ledger()["per-node-accounted"] < BUCKET:
+                if time.perf_counter() - t0 > 120:
+                    raise TimeoutError("mid-rotation warm stalled")
+                time.sleep(0.002)
+            c.snapshot_now()  # parent-retained replica per worker
+            for _ in range(64):  # open the window
+                c.submit(batch(FRAME, db.id))
+            killer = _threading.Thread(
+                target=lambda: (time.sleep(0.002),
+                                c.node("node1").proc.kill()))
+            killer.start()
+            rot = c.rotate_epoch()  # races the kill: any
+            # interleaving must land counted, never hung
+            killer.join()
+            while not c.membership.is_dead("node1"):
+                c.submit(batch(FRAME, db.id))
+                if time.perf_counter() - t0 > 120:
+                    raise TimeoutError("death never detected")
+                time.sleep(0.002)
+            while c.failovers_total() < 1:
+                if time.perf_counter() - t0 > 120:
+                    raise TimeoutError("failover never completed")
+                time.sleep(0.002)
+            c.submit(batch(BUCKET, db.id))  # survivor at new epoch
+            st = c.stop()
+            assert st["ledger"]["exact"], st["ledger"]
+            return {
+                "epoch": rot["epoch"],
+                "rotation_acked": rot["acked"],
+                "rotation_failed": [f["node"]
+                                    for f in rot.get("failed", ())],
+                "crash_dropped": st["ledger"]["crash-dropped"],
+                "crypto_dropped": st["ledger"]["crypto-dropped"],
+                "failover_dropped":
+                    st["ledger"]["failover-dropped"],
+                "ledger_exact": st["ledger"]["exact"],
+            }
+        finally:
+            c.shutdown()
+
+    skr = [sigkill_mid_rotation_rep() for _ in range(reps)]
+    ledger_ok = ledger_ok and all(r["ledger_exact"] for r in skr)
+
     proc = modes_out["process"]
     return {
-        "schema": "bench-cluster-v3",
+        "schema": "bench-cluster-v4",
         "best_of": reps,
         "host_cores": _os.cpu_count(),
         "mode": "process",  # the headline curve below
@@ -2599,6 +2727,20 @@ def bench_cluster(target_packets=98304, reps=3) -> dict:
             skw, key=lambda r: r["inflight_frames_at_kill"]),
         "sigkill_mid_window_reps": skw,
         "scale_in": si,
+        # -- v4: the encrypted data channel (ISSUE 18) ----------------
+        "encrypted_pps": enc["candidate_pps"],
+        "plaintext_pps": enc["baseline_pps"],
+        "encrypted_ratio": enc["ratio_median"],
+        "encrypted_ratio_pairs": enc["pairs"],
+        "encrypted_ratio_spread": enc["spread"],
+        "seal_latency_us": seal_lat,
+        "open_latency_us": open_lat,
+        # headline rep: the one whose rotation saw a FAILED node —
+        # the deepest kill/rotate interleaving the run produced
+        "sigkill_mid_rotation": max(
+            skr, key=lambda r: (len(r["rotation_failed"]),
+                                r["crypto_dropped"])),
+        "sigkill_mid_rotation_reps": skr,
         "ledger_exact": ledger_ok,
     }
 

@@ -319,6 +319,20 @@ class DaemonConfig:
     # ...or after this many ms of quiet (the flush-on-idle timer that
     # bounds the tail latency coalescing could otherwise add)
     cluster_ack_flush_ms: float = 2.0
+    # -- encrypted data channel (ISSUE 18).  When ON (process mode),
+    # every router->worker data frame AND every worker->router ack
+    # travels as one AEAD seal (EncryptedChannel; X25519 session keys
+    # exchanged through the spawn handshake + node registry), decrypt
+    # failures are counted + contained (typed reject record, never a
+    # worker crash), and ClusterServing.rotate_epoch() rotates every
+    # channel live.  OFF = byte-identical to the plaintext wire.
+    # Thread mode has no sockets, so the knob is a no-op there.
+    cluster_encrypt: bool = False
+    # how long the receive side keeps the PREVIOUS epoch's key alive
+    # after rotate_epoch() (its own replay window), so in-flight
+    # frames sealed pre-rotation still open; past the grace they
+    # reject as epoch-old
+    cluster_epoch_grace_s: float = 2.0
     # -- queue-depth autoscale (cluster/scale.py ClusterAutoscaler).
     # When ON, a named controller samples the router's forward queues
     # and add_node()s after `ticks` consecutive samples over
@@ -371,12 +385,19 @@ class DaemonConfig:
 
 class Daemon:
     def __init__(self, config: Optional[DaemonConfig] = None,
-                 kvstore: Optional[InMemoryKVStore] = None):
+                 kvstore: Optional[InMemoryKVStore] = None,
+                 encryption_keypair=None):
         """``kvstore``: pass one shared store to multiple daemons and
         they agree on identity numerics through the distributed
         allocator protocol AND replicate each other's allocations by
         watch (reference: pkg/kvstore + pkg/allocator + clustermesh).
-        Without it the daemon allocates locally."""
+        Without it the daemon allocates locally.
+
+        ``encryption_keypair``: inject the node's Curve25519 identity
+        instead of generating/loading one — the process-per-node
+        worker hands over the keypair it already introduced in its
+        spawn handshake, so the registry-advertised pubkey and the
+        cluster data channel's key are the SAME identity."""
         from ..kvstore import ClusterIdentitySync, KVStoreAllocatorBackend
         from ..serving import (validate_recovery_config,
                                validate_serving_config,
@@ -785,7 +806,8 @@ class Daemon:
 
                 self.encryption = EncryptionManager(
                     self.config.node_name, self.node_registry,
-                    key_path=self.config.encryption_key_path)
+                    key_path=self.config.encryption_key_path,
+                    keypair=encryption_keypair)
                 info = self.encryption.advertise(info)
             self.node_registry.register(self.config.node_name, info)
             self.health = HealthMesh(self.node_registry,

@@ -90,6 +90,17 @@ CTA011    nodehost control-op discipline: every ``cluster/nodehost``
           one test under ``tests/``; ``OP_TIMEOUTS`` carries no
           stale entries; ``BENCH_obs.json`` (when present) must
           keep its schema
+CTA012    proxy-ledger contract: the L7 redirect ledger's counters
+          stay declared in ``proxy/worker.py``, surfaced in its
+          stats snapshot, registered/floored as ``cilium_l7_*``
+          series, and the ``l7.parse`` fault site stays armed;
+          ``BENCH_l7.json`` (when present) keeps its schema
+CTA013    encryption key hygiene: key material (X25519 private
+          keys, derived session keys) never reaches a log call, an
+          incident payload, a serializer, a sysdump/obs-collect
+          surface, or the exposition/bundle modules; only
+          ``NodeKeypair.load_or_create`` may persist a private key
+          (``scripts/check_crypto_keys.py`` is the shim CLI)
 ========  ===========================================================
 
 Annotation grammar

@@ -63,6 +63,10 @@ BENCH_NAME = "BENCH_cluster.json"
 # throughput (per-pair ratios + spread), the low-load forward-latency
 # p50 comparison, the SIGKILL-mid-window ledger leg, and the live
 # scale-in leg (zero survivor recompiles)
+# v4 (ISSUE 18): adds the encrypted-channel legs — paired
+# interleaved encrypted vs plaintext forward throughput (per-pair
+# ratios + spread: the AEAD toll, honestly measured), seal/open
+# latency percentiles, and the SIGKILL-mid-rotation ledger leg
 BENCH_CLUSTER_KEYS = (
     "schema", "best_of", "host_cores", "mode", "modes",
     "sustained_pps_n1", "sustained_pps_n2", "sustained_pps_n3",
@@ -81,8 +85,14 @@ BENCH_CLUSTER_KEYS = (
     "latency_p50_ratio",
     "sigkill_mid_window",
     "scale_in",
+    # -- v4: encrypted data channel --
+    "encrypted_pps", "plaintext_pps",
+    "encrypted_ratio", "encrypted_ratio_pairs",
+    "encrypted_ratio_spread",
+    "seal_latency_us", "open_latency_us",
+    "sigkill_mid_rotation",
 )
-BENCH_SCHEMA = "bench-cluster-v3"
+BENCH_SCHEMA = "bench-cluster-v4"
 # pipelined-transport series the registry must export (checked the
 # same way as the drop-counter series: the literal name appears in
 # the registry module).  The window counters are the observable half
@@ -92,6 +102,13 @@ REQUIRED_SERIES = (
     "cilium_cluster_inflight_frames",
     "cilium_cluster_acks_coalesced_total",
     "cilium_cluster_window_stalls_total",
+    # the encrypted channel's observable half (ISSUE 18): rejects,
+    # replays, and rotations must be scrapeable or the crypto plane
+    # fails silently from the operator's seat.  crypto_dropped_total
+    # is enforced separately via DROP_COUNTERS (it is a ledger term).
+    "cilium_cluster_crypto_rejected_total",
+    "cilium_cluster_crypto_replays_total",
+    "cilium_cluster_crypto_rotations_total",
 )
 # per-mode sub-dict floor (both entries of `modes`)
 BENCH_MODE_KEYS = (

@@ -1,0 +1,218 @@
+"""CTA013 — encryption key hygiene (ISSUE 18).
+
+The encrypted cluster channel's status surfaces expose COUNTERS AND
+EPOCHS ONLY; the key material itself (X25519 private keys, derived
+session keys) must never be observable.  A private key that leaks
+into a sysdump bundle, a metrics exposition, a log line, or an
+incident payload outlives the process that held it — bundles are
+shipped to operators, scrapes are retained by monitoring stacks, and
+neither is covered by rotation.  Four statically-checkable rules:
+
+1. SINK CALLS: no key-bearing expression may appear in the arguments
+   of a log call (``log.*``/``logger.*``/``logging.*``), an incident
+   recorder (``record_incident``), or a serializer headed for an
+   observability surface (``json.dumps`` / ``_jsonable``).
+2. SURFACE FUNCTIONS: functions that build operator-visible bundles
+   (any ``*sysdump*`` / ``*obs_collect*`` function, the worker's
+   ``_crypto_block``, ``worker_crypto``, ``transport_stats``) must
+   not reference key-bearing attributes AT ALL — their job is to
+   summarize the channel, and a summary never needs the keys.
+3. SEALED MODULES: the exposition/bundle modules
+   (``obs/registry.py``, ``obs/relay.py``, ``obs/flightrec.py``)
+   must not reference key-bearing names and must not import from
+   ``encryption`` — key material cannot leak through a module that
+   cannot name it.
+4. KEY PERSISTENCE: ``NodeKeypair.load_or_create`` is the ONLY
+   place allowed to write ``.private`` to disk (0600, the wireguard
+   private-key file analogue) — flagged anywhere else.
+
+Key-bearing names: the ``private`` half of a keypair, a channel's
+``_send_key``/``_recv_key``/``_local``, the cluster facade's
+``_crypto_kp`` keypair, and conventional locals like ``send_key`` /
+``shared_secret``.  The PUBLIC key is exempt by design — advertising
+it through the node registry is the whole point.
+
+Suppression: the shared grammar
+(``# lint: disable=CTA013 -- reason``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import FileCtx, Finding, Repo
+
+CODE = "CTA013"
+NAME = "crypto-hygiene"
+
+# attribute names that hold key material (object.attr accesses)
+KEY_ATTRS = {"private", "_send_key", "_recv_key", "_local",
+             "_crypto_kp"}
+# bare names that conventionally hold key material
+KEY_NAMES = {"private_key", "send_key", "recv_key", "session_key",
+             "shared_secret"}
+
+LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+               "critical", "log"}
+INCIDENT_FUNCS = {"record_incident"}
+SERIALIZERS = {"dumps", "_jsonable"}
+
+# modules that build operator-facing expositions/bundles: no key
+# name may even appear here
+SEALED_MODULES = (
+    "cilium_tpu/obs/registry.py",
+    "cilium_tpu/obs/relay.py",
+    "cilium_tpu/obs/flightrec.py",
+)
+
+# function-name predicates for rule 2 (operator-visible surfaces)
+_SURFACE_EXACT = {"_crypto_block", "worker_crypto",
+                  "transport_stats"}
+_SURFACE_SUBSTR = ("sysdump", "obs_collect")
+
+# the one sanctioned key writer (rule 4)
+_KEYFILE_OWNER = "cilium_tpu/encryption/__init__.py"
+_KEYFILE_FUNC = "load_or_create"
+
+
+def _taint(node: ast.AST) -> Optional[str]:
+    """The first key-bearing name referenced under ``node``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in KEY_ATTRS:
+            return sub.attr
+        if isinstance(sub, ast.Name) and sub.id in KEY_NAMES:
+            return sub.id
+    return None
+
+
+def _is_logger_call(call: ast.Call) -> bool:
+    f = call.func
+    if not (isinstance(f, ast.Attribute)
+            and f.attr in LOG_METHODS):
+        return False
+    base = f.value
+    while isinstance(base, ast.Attribute):
+        base = base.value
+    return isinstance(base, ast.Name) and "log" in base.id.lower()
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _emit(findings: List[Finding], ctx: FileCtx, line: int,
+          msg: str, end_line: Optional[int] = None) -> None:
+    # a multi-line sink call is waivable from any of its lines (the
+    # suppression comment naturally lands next to the offending arg)
+    for ln in range(line, (end_line or line) + 1):
+        if ctx.suppressed(CODE, ln):
+            return
+    findings.append(Finding(CODE, ctx.rel, line, msg,
+                            checker=NAME))
+
+
+def _check_sink_calls(ctx: FileCtx,
+                      findings: List[Finding]) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if _is_logger_call(node):
+            sink = f"log call .{name}()"
+        elif name in INCIDENT_FUNCS:
+            sink = f"incident payload ({name})"
+        elif name in SERIALIZERS:
+            sink = f"serializer {name}()"
+        else:
+            continue
+        for arg in [*node.args,
+                    *(kw.value for kw in node.keywords)]:
+            t = _taint(arg)
+            if t is not None:
+                _emit(findings, ctx, node.lineno,
+                      f"key material ({t!r}) reaches {sink} — "
+                      f"keys must never be logged, recorded, or "
+                      f"serialized into an observability surface",
+                      end_line=getattr(node, "end_lineno", None))
+                break
+
+
+def _check_surface_funcs(ctx: FileCtx,
+                         findings: List[Finding]) -> None:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        fname = node.name
+        if not (fname in _SURFACE_EXACT
+                or any(s in fname for s in _SURFACE_SUBSTR)):
+            continue
+        for stmt in node.body:
+            t = _taint(stmt)
+            if t is not None:
+                _emit(findings, ctx, stmt.lineno,
+                      f"operator-visible surface {fname}() "
+                      f"references key material ({t!r}) — status "
+                      f"surfaces carry counters and epochs only")
+                break
+
+
+def _check_sealed_module(ctx: FileCtx,
+                         findings: List[Finding]) -> None:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom) \
+                and "encryption" in (node.module or ""):
+            _emit(findings, ctx, node.lineno,
+                  "exposition/bundle module imports from the "
+                  "encryption package — key material must not be "
+                  "nameable here")
+        elif isinstance(node, ast.Attribute) \
+                and node.attr in KEY_ATTRS:
+            _emit(findings, ctx, node.lineno,
+                  f"exposition/bundle module references key "
+                  f"material ({node.attr!r})")
+        elif isinstance(node, ast.Name) and node.id in KEY_NAMES:
+            _emit(findings, ctx, node.lineno,
+                  f"exposition/bundle module references key "
+                  f"material ({node.id!r})")
+
+
+def _check_key_writes(ctx: FileCtx,
+                      findings: List[Finding]) -> None:
+    """``f.write(<something>.private)`` outside the sanctioned
+    keyfile writer."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if ctx.rel == _KEYFILE_OWNER and node.name == _KEYFILE_FUNC:
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) \
+                    and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in ("write", "sendall",
+                                          "send", "put", "update") \
+                    and any(_taint(a) for a in sub.args):
+                _emit(findings, ctx, sub.lineno,
+                      f"key material written/sent by "
+                      f"{node.name}() — only NodeKeypair."
+                      f"{_KEYFILE_FUNC} may persist a private key")
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for ctx in repo.files:
+        if ctx.tree is None:
+            continue
+        if ctx.rel in SEALED_MODULES:
+            _check_sealed_module(ctx, findings)
+        _check_sink_calls(ctx, findings)
+        _check_surface_funcs(ctx, findings)
+        _check_key_writes(ctx, findings)
+    return findings

@@ -48,6 +48,10 @@ KNOWN_CRITERIA = (
     "ledger_exact", "max_shed_frac", "p99_ms",
     "min_ct_insert_drops", "min_nat_failures", "min_drop_frac",
     "l7_ledger_exact", "min_l7_redirected",
+    # encrypted-channel rotation floor (ISSUE 18): the cluster leg's
+    # landed-epoch-bump count must clear this or the storm rotated
+    # nothing (plaintext/thread-mode degrade fails loudly)
+    "min_rotations",
 )
 
 BENCH_NAME = "BENCH_scenarios.json"

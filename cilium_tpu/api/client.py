@@ -147,6 +147,15 @@ class APIClient:
                 body["node"] = node
         return self._request("PUT", "/cluster/scale", body)
 
+    def cluster_rotate(self, grace_s: "Optional[float]" = None):
+        """Cluster-wide key-epoch rotation (PUT /cluster/rotate,
+        ISSUE 18): re-key every live encrypted channel under the
+        grace window, serving uninterrupted.  Returns the rotation
+        record (epoch, per-node acks, wall ms)."""
+        body = ({"grace-s": float(grace_s)}
+                if grace_s is not None else None)
+        return self._request("PUT", "/cluster/rotate", body)
+
     # -- the cluster observability relay (ISSUE 14) --------------------
     def cluster_metrics(self) -> str:
         """One exposition text, every series node-labelled."""

@@ -367,6 +367,19 @@ def _make_handler(daemon: Daemon):
                         else:
                             self._send(200,
                                        daemon._cluster.add_node())
+                elif path == "/cluster/rotate":
+                    # cluster-wide key-epoch rotation (ISSUE 18):
+                    # re-key every live encrypted channel under the
+                    # grace window, live serving uninterrupted.
+                    # Body {"grace-s": f} overrides the config knob
+                    if daemon._cluster is None:
+                        self._send(404, {
+                            "error": "not part of a cluster serving "
+                                     "tier (start_cluster_serving)"})
+                    else:
+                        body = self._body() or {}
+                        self._send(200, daemon._cluster.rotate_epoch(
+                            grace_s=body.get("grace-s")))
                 elif m := re.fullmatch(r"/endpoint/([\w.-]+)", path):
                     body = self._body() or {}
                     ep = daemon.add_endpoint(

@@ -202,6 +202,23 @@ def cmd_cluster(args) -> int:
               f"pause {rec['pause-ms']}ms, survivor recompiles "
               f"{rec['survivor-recompiles']})")
         return 0
+    if getattr(args, "action", "status") == "rotate":
+        # cluster-wide key-epoch rotation (ISSUE 18): every live
+        # encrypted channel re-keys under the grace window, serving
+        # uninterrupted
+        rec = _client(args).cluster_rotate(
+            grace_s=getattr(args, "grace", None))
+        if args.json:
+            _print(rec)
+            return 0
+        failed = rec.get("failed") or []
+        print(f"Rotated to epoch {rec['epoch']}: "
+              f"{len(rec['acked'])} nodes acked in {rec['ms']}ms "
+              f"(grace {rec['grace-s']}s)"
+              + (f", {len(failed)} FAILED" if failed else ""))
+        for f in failed:
+            print(f"  {f['node']:<16}{f['error']}")
+        return 0
     if getattr(args, "action", "status") == "sysdump":
         # the cluster sysdump archive (ISSUE 14): every worker's
         # flight-recorder bundle + the parent bundle + a manifest
@@ -262,7 +279,8 @@ def cmd_cluster(args) -> int:
         print(f"Router: submitted {r['submitted']}, pending "
               f"{sum(r['pending'])}, overflow {r['router-overflow']}, "
               f"failover-dropped {r['failover-dropped']}, "
-              f"crash-dropped {r.get('crash-dropped', 0)}")
+              f"crash-dropped {r.get('crash-dropped', 0)}, "
+              f"crypto-dropped {r.get('crypto-dropped', 0)}")
         owners = r["slot-owner"]
         counts = {}
         for o in owners:
@@ -295,6 +313,18 @@ def cmd_cluster(args) -> int:
         print(f"Autoscale: watermark {asc['high-frac']}, streak "
               f"{asc['streak']}/{asc['ticks']}, triggered "
               f"{asc['triggered']}, max {asc['max-nodes']}")
+    cr = c.get("crypto")
+    if cr:
+        ch = (r or {}).get("crypto") or {}
+        print(f"Crypto: epoch {cr['epoch']}, rotations "
+              f"{cr['rotations']} (grace {cr['grace-s']}s), sealed "
+              f"{ch.get('sealed', 0)}, rejected "
+              f"{ch.get('rejected', 0)}, replays "
+              f"{ch.get('replays', 0)}")
+        lr = c.get("last-rotation")
+        if lr:
+            print(f"  last rotation: -> epoch {lr['epoch']} "
+                  f"({len(lr['acked'])} acked, {lr['ms']}ms)")
     return 0
 
 
@@ -1172,15 +1202,20 @@ def main(argv=None) -> int:
                             "(membership, router, failovers, ledger)"
                             " | scale (live add_node; --down retires"
                             " one) | sysdump (all-node archive) | "
-                            "trace (stitched cross-process spans)")
+                            "trace (stitched cross-process spans) | "
+                            "rotate (key-epoch rotation, live)")
     p.add_argument("action", nargs="?", default="status",
-                   choices=["status", "scale", "sysdump", "trace"])
+                   choices=["status", "scale", "sysdump", "trace",
+                            "rotate"])
     p.add_argument("--down", action="store_true",
                    help="scale IN: retire one replica (drain its "
                         "send window, re-pin slots, migrate CT)")
     p.add_argument("--node",
                    help="scale --down victim (default: the "
                         "highest-index live node)")
+    p.add_argument("--grace", type=float,
+                   help="rotate: seconds old-epoch frames stay "
+                        "openable (default cluster_epoch_grace_s)")
 
     p = sub.add_parser("config", help="config get | set KEY VALUE")
     p.add_argument("action", nargs="?", default="get",

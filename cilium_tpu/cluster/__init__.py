@@ -48,6 +48,17 @@ cluster test)::
 
     submitted == sum over nodes (verdicts + shed + recovery_dropped)
                  + router_overflow + failover_dropped + crash_dropped
+                 + crypto_dropped
+
+ISSUE 18 rides the data channel on the crypto plane: with
+``cluster_encrypt=True`` (process mode) every router->worker frame
+and every ack travels as one AEAD seal over the PR 17 wire
+(``encryption.EncryptedChannel``; keys exchanged through the spawn
+handshake + node registry), rejects are counted ``crypto_dropped``
+(typed NACKs, never a worker crash), and :meth:`ClusterServing.
+rotate_epoch` re-keys the LIVE cluster under a bounded grace window
+with the ledger exact across the rotation.  With the knob off the
+wire is byte-identical to PR 17.
 """
 
 from __future__ import annotations
@@ -90,7 +101,9 @@ def validate_cluster_config(nodes, forward_depth, probe_interval_s,
                             ack_every=4,
                             ack_flush_ms=2.0,
                             autoscale_min_nodes=1,
-                            autoscale_low_frac=0.0):
+                            autoscale_low_frac=0.0,
+                            encrypt=False,
+                            epoch_grace_s=2.0):
     """Normalize + validate the cluster knobs (the serving-knob
     discipline: a typo'd cluster config fails at construction, not as
     a silent misroute under load)."""
@@ -172,12 +185,19 @@ def validate_cluster_config(nodes, forward_depth, probe_interval_s,
         raise ValueError(
             "cluster_autoscale_low_frac must be in [0, high_frac) "
             "(0 disables autoscale scale-down)")
+    encrypt = bool(encrypt)
+    epoch_grace_s = float(epoch_grace_s)
+    if epoch_grace_s < 0:
+        raise ValueError("cluster_epoch_grace_s must be >= 0 "
+                         "(0 = strict epoch equality: any in-flight "
+                         "old-epoch frame rejects at rotation)")
     return (nodes, forward_depth, probe_interval_s, death_threshold,
             convergence_deadline_s, kvstore_mode, mode, slot_factor,
             autoscale_max_nodes, autoscale_high_frac, autoscale_ticks,
             autoscale_interval_s, obs_interval_s, obs_stale_after_s,
             trace_sample, forward_window, ack_every, ack_flush_ms,
-            autoscale_min_nodes, autoscale_low_frac)
+            autoscale_min_nodes, autoscale_low_frac,
+            encrypt, epoch_grace_s)
 
 
 def warm_serving_session(daemon, bucket: int, ep: int,
@@ -493,7 +513,7 @@ class ClusterServing:
          self.obs_interval_s, self.obs_stale_after_s,
          self.trace_sample, self.forward_window, self.ack_every,
          self.ack_flush_ms, self.autoscale_min_nodes,
-         self.autoscale_low_frac
+         self.autoscale_low_frac, self.encrypt, self.epoch_grace_s
          ) = validate_cluster_config(
             nodes, template.cluster_forward_depth,
             template.cluster_probe_interval_s,
@@ -515,7 +535,28 @@ class ClusterServing:
             ack_flush_ms=template.cluster_ack_flush_ms,
             autoscale_min_nodes=(
                 template.cluster_autoscale_min_nodes),
-            autoscale_low_frac=template.cluster_autoscale_low_frac)
+            autoscale_low_frac=template.cluster_autoscale_low_frac,
+            encrypt=template.cluster_encrypt,
+            epoch_grace_s=template.cluster_epoch_grace_s)
+        # -- the crypto plane (ISSUE 18) --------------------------------
+        # one parent keypair, one EncryptedChannel per forwarder;
+        # the epoch is CLUSTER state owned here (kvstore-published by
+        # rotate_epoch, handed to joiners at spawn).  Thread mode has
+        # no wire to seal: cluster_encrypt is a documented no-op
+        # there (in-process submits never leave the address space).
+        # guarded-by: _rotate_lock -- epoch bump + per-node rotation
+        # fan-out + _rotations append (reads of self.epoch elsewhere
+        # are single-word and tolerate staleness by design: a joiner
+        # racing a rotation lands one epoch behind, inside grace,
+        # and the next rotation re-keys it)
+        self._crypto_kp = None
+        self.epoch = 0
+        self._rotations: List[dict] = []
+        self._rotate_lock = threading.Lock()
+        if self.encrypt and self.mode == "process":
+            from ..encryption import NodeKeypair
+
+            self._crypto_kp = NodeKeypair()
         # -- the shared identity/policy plane ---------------------------
         self._kv_server = None
         self._kv_store = None
@@ -615,10 +656,28 @@ class ClusterServing:
         scale-out can build a node while the cluster serves."""
         name = name or f"{self._node_prefix}{idx}"
         if self.mode == "process":
-            node = self._spawner.spawn(name, self._template,
-                                       self._kv_server.address)
+            node = self._spawner.spawn(
+                name, self._template, self._kv_server.address,
+                parent_pub=(self._crypto_kp.public.hex()
+                            if self._crypto_kp is not None else None),
+                epoch=self.epoch)
             node.idx = idx
             node.attach()
+            if self._crypto_kp is not None:
+                # the worker minted its keypair in-process and
+                # advertised only the PUBLIC half in its hello
+                # (nodehost.node_host_main); arm the parent half of
+                # the channel at the cluster's CURRENT epoch so a
+                # scale-out joiner lands in key agreement immediately
+                if not node.peer_pub_hex:
+                    raise ServingError(
+                        f"cluster_encrypt=True but worker {name} "
+                        f"advertised no pubkey in its hello")
+                node.enable_crypto(
+                    self._crypto_kp,
+                    bytes.fromhex(node.peer_pub_hex),
+                    grace_s=self.epoch_grace_s,
+                    epoch=self.epoch)
             return node
         from ..agent.daemon import Daemon
 
@@ -907,6 +966,60 @@ class ClusterServing:
         recs = self.failover.snapshot()
         return recs[-1] if recs else {}
 
+    # -- key rotation (ISSUE 18) ----------------------------------------
+    def rotate_epoch(self, grace_s: Optional[float] = None) -> dict:
+        # thread-affinity: api, cli
+        """Cluster-wide key-epoch rotation DURING live serving: bump
+        the epoch, publish it through the kvstore (cluster state any
+        operator or late joiner can read — not a per-channel
+        whisper), then rotate every live channel in the TWO-PHASE
+        order (``ProcessNode.rotate_epoch``: parent pre-installs the
+        new epoch's recv key, worker flushes pending acks under the
+        OLD epoch and re-keys, parent re-keys — so neither side ever
+        seals at an epoch the other cannot open, in EITHER
+        direction).  In-flight frames sealed pre-rotation
+        stay openable for ``grace_s`` via the channel's bounded
+        previous-epoch grace window (its own replay state — see
+        ``encryption.EncryptedChannel``), so not a single row is
+        lost or double-counted at any interleaving.  A node whose
+        rotation fails keeps serving at its old epoch (worker-first
+        means neither half re-keyed) and is surfaced in the record —
+        degraded and counted, never hung."""
+        if self._crypto_kp is None:
+            raise ServingError(
+                "rotate_epoch needs cluster_encrypt=True in "
+                "process mode")
+        grace = (self.epoch_grace_s if grace_s is None
+                 else float(grace_s))
+        with self._rotate_lock:
+            epoch = self.epoch + 1
+            t0 = time.monotonic()
+            self._policy_kv().update(
+                "cilium/cluster/crypto/epoch",
+                str(epoch).encode())
+            acked: List[str] = []
+            failed: List[dict] = []
+            for n in self.nodes:
+                if not n.alive:
+                    continue
+                try:
+                    n.rotate_epoch(epoch, grace)
+                    acked.append(n.name)
+                except Exception as exc:  # noqa: BLE001 — a node
+                    # that cannot rotate (crashed mid-op, control
+                    # channel gone) is degraded, not fatal: its
+                    # channel stays self-consistent at the old epoch
+                    # and the next rotation (or failover) covers it
+                    failed.append({"node": n.name,
+                                   "error": str(exc)})
+            self.epoch = epoch
+            rec = {"epoch": epoch, "acked": acked, "grace-s": grace,
+                   "ms": round((time.monotonic() - t0) * 1e3, 3)}
+            if failed:
+                rec["failed"] = failed
+            self._rotations.append(rec)
+            return rec
+
     # -- cluster observability (ISSUE 14) -------------------------------
     def _parent_obs_collect(self) -> dict:
         # thread-affinity: api, cli, capture
@@ -990,6 +1103,31 @@ class ClusterServing:
         the window, not the worker, is the bottleneck."""
         return self._window_counters()["window-stalls"]
 
+    def crypto_dropped_total(self) -> int:
+        r = self.router
+        return r.crypto_dropped if r is not None else 0
+
+    def _crypto_counters(self) -> Optional[dict]:
+        r = self.router
+        return r.snapshot().get("crypto") if r is not None else None
+
+    def crypto_rejected_total(self) -> int:
+        """Sealed frames some channel end REFUSED (auth / replay /
+        epoch skew / injected fault), cluster-wide — every one is a
+        counted NACK or a counted parent-side open failure, never a
+        worker crash."""
+        c = self._crypto_counters()
+        return int(c["rejected"]) if c else 0
+
+    def crypto_replays_total(self) -> int:
+        c = self._crypto_counters()
+        return int(c["replays"]) if c else 0
+
+    def crypto_rotations_total(self) -> int:
+        """Cluster-wide rotation OPERATIONS (one op re-keys every
+        live channel — not the per-channel rotate count)."""
+        return len(self._rotations)
+
     def live_dead_counts(self):
         live = sum(1 for n in self.nodes if n.alive)
         return live, len(self.nodes) - live
@@ -1024,6 +1162,12 @@ class ClusterServing:
             out["last-scale-out"] = self.scale_events[-1]
         if self.autoscaler is not None:
             out["autoscale"] = self.autoscaler.stats()
+        if self._crypto_kp is not None:
+            out["crypto"] = {"epoch": self.epoch,
+                             "rotations": len(self._rotations),
+                             "grace-s": self.epoch_grace_s}
+            if self._rotations:
+                out["last-rotation"] = self._rotations[-1]
         return out
 
     def per_node_stats(self) -> Dict[str, dict]:
@@ -1036,6 +1180,10 @@ class ClusterServing:
                 **({"l7": l7s} if (l7s := n.l7_stats()) else {}),
                 **({"transport": ts}
                    if (ts := n.transport_stats()) else {}),
+                **({"crypto": cb}
+                   if (cb := (n.worker_crypto()
+                              if hasattr(n, "worker_crypto")
+                              else None)) else {}),
             }
         return out
 
@@ -1049,6 +1197,7 @@ class ClusterServing:
         overflow = r.router_overflow if r is not None else 0
         fo_dropped = r.failover_dropped if r is not None else 0
         crash = r.crash_dropped if r is not None else 0
+        crypto = r.crypto_dropped if r is not None else 0
         pending = r.pending_total() if r is not None else 0
         per_node = 0
         for name, st in self.per_node_stats().items():
@@ -1059,13 +1208,14 @@ class ClusterServing:
             per_node += (fe.get("verdicts", 0) + fe.get("shed", 0)
                          + ft.get("recovery-dropped", 0))
         accounted = (per_node + overflow + fo_dropped + crash
-                     + pending)
+                     + crypto + pending)
         return {
             "submitted": submitted,
             "per-node-accounted": per_node,
             "router-overflow": overflow,
             "failover-dropped": fo_dropped,
             "crash-dropped": crash,
+            "crypto-dropped": crypto,
             "forward-pending": pending,
             "accounted": accounted,
             "exact": submitted == accounted,
@@ -1148,6 +1298,7 @@ class ClusterServing:
             "ledger": self.ledger(),
             "failovers": self.failover.snapshot(),
             "scale-outs": list(self.scale_events),
+            "rotations": list(self._rotations),
             "obs": self.obs.stats(),
         }
 

@@ -59,10 +59,12 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-from .transport import (decode_rows_seq, pack_ack, pack_cum_ack,
-                        recv_frame, recv_json_frame, rows_from_b64,
-                        rows_to_b64, send_frame, send_json_frame,
-                        shutdown_close)
+from ..encryption import DecryptError
+from ..infra.faults import InjectedFault
+from .transport import (decode_rows_seq, pack_ack, pack_crypto_reject,
+                        pack_cum_ack, recv_frame, recv_json_frame,
+                        rows_from_b64, rows_to_b64, send_frame,
+                        send_json_frame, shutdown_close)
 
 __all__ = ["node_host_main", "connect_channels", "OP_TIMEOUTS"]
 
@@ -97,6 +99,7 @@ OP_TIMEOUTS = {
     "obs_scrape": 30.0,
     "sysdump": 60.0,
     "ack_flush": 10.0,
+    "rotate_epoch": 30.0,
     "shutdown": 30.0,
 }
 
@@ -116,23 +119,31 @@ def _jsonable(obj):
     return obj
 
 
-def connect_channels(host: str, port: int, name: str, token: str
+def connect_channels(host: str, port: int, name: str, token: str,
+                     pubkey: Optional[str] = None
                      ) -> Tuple[socket.socket, socket.socket,
                                 socket.socket]:
     """Dial the parent's listener three times (control, data, obs),
     each introducing itself with a hello frame — the parent matches
     hellos to its ``ProcessNode`` handles (spawn order is not
-    arrival order).  The OBS channel (ISSUE 14) carries the relay's
-    scrape/sysdump ops on its own socket + worker thread so a slow
-    or timed-out scrape can NEVER desync the control stream the
-    membership prober depends on — observability must not be able
-    to get a healthy node declared dead."""
+    arrival order).  ``pubkey`` (hex) rides the hello when the data
+    channel is encrypted (ISSUE 18): the spawn handshake IS the key
+    exchange — the parent pins this worker's X25519 pubkey before
+    the first sealed frame flows.  The OBS channel (ISSUE 14)
+    carries the relay's scrape/sysdump ops on its own socket +
+    worker thread so a slow or timed-out scrape can NEVER desync
+    the control stream the membership prober depends on —
+    observability must not be able to get a healthy node declared
+    dead."""
     socks = []
     for role in ("ctrl", "data", "obs"):
         s = socket.create_connection((host, port), timeout=30.0)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        send_json_frame(s, {"hello": True, "node": name,
-                            "role": role, "token": token})
+        hello = {"hello": True, "node": name,
+                 "role": role, "token": token}
+        if pubkey is not None:
+            hello["pubkey"] = pubkey
+        send_json_frame(s, hello)
         socks.append(s)
     return socks[0], socks[1], socks[2]
 
@@ -141,7 +152,9 @@ class _NodeHost:
     """The worker's brain: owns the daemon and serves both channels.
     Single-process single-instance; built by :func:`node_host_main`."""
 
-    def __init__(self, name: str, cfg_fields: dict, kv_addr):
+    def __init__(self, name: str, cfg_fields: dict, kv_addr,
+                 crypto_kp=None, parent_pub: Optional[str] = None,
+                 epoch: int = 0):
         # imports INSIDE the worker: a spawn child pays its own jax
         # init here, off the parent's critical path
         from ..agent.daemon import Daemon, DaemonConfig
@@ -150,8 +163,39 @@ class _NodeHost:
 
         self.name = name
         self.kv = RemoteKVStore([tuple(kv_addr)])
-        self.daemon = Daemon(DaemonConfig(**cfg_fields), kvstore=self.kv)
+        cfg_fields = dict(cfg_fields)
+        if crypto_kp is not None:
+            # the encrypted data channel forces the node-encryption
+            # plane ON with the SAME keypair the hello advertised:
+            # the registry-published pubkey and the data-channel key
+            # are one identity (key desync between the two planes
+            # would be undebuggable)
+            cfg_fields["enable_encryption"] = True
+        self.daemon = Daemon(DaemonConfig(**cfg_fields),
+                             kvstore=self.kv,
+                             encryption_keypair=crypto_kp)
         self.policy_sync = ClusterPolicySync(self.kv, self.daemon)
+        # -- encrypted data channel, worker half (ISSUE 18) ---------
+        self._crypto = None
+        self._crypto_grace_s = float(
+            cfg_fields.get("cluster_epoch_grace_s", 2.0))
+        if crypto_kp is not None and parent_pub is not None:
+            from ..encryption import EncryptedChannel
+
+            # epoch in the CONSTRUCTOR, not via rotate(): a
+            # scale-out worker joining mid-history starts at the
+            # cluster's current keys with zero rotations on its own
+            # books
+            self._crypto = EncryptedChannel(
+                crypto_kp, bytes.fromhex(parent_pub),
+                epoch=int(epoch))
+        # data frames RECEIVED (transport thread only) — the NACK
+        # ordinal space: TCP ordering makes our Nth receipt the
+        # parent's Nth send, which is how a reject names a frame
+        # whose sealed seq it cannot read
+        self._rx_frames = 0
+        self._crypto_rejected = 0  # transport thread writes; ops read
+        self._crypto_replays = 0
         self._ctrl: Optional[socket.socket] = None
         self._data: Optional[socket.socket] = None
         self._obs: Optional[socket.socket] = None
@@ -189,11 +233,31 @@ class _NodeHost:
         runtime = self.daemon._serving["runtime"]
         st = runtime.stats
         ack_every = max(int(self.daemon.config.cluster_ack_every), 1)
+        ch = self._crypto
         try:
             while True:
                 payload = recv_frame(sock)
                 if payload is None:
                     break
+                if ch is not None:
+                    # ISSUE 18: open/verify BEFORE decode — nothing
+                    # unauthenticated ever reaches decode_rows or
+                    # runtime.submit.  A failure is COUNTED and
+                    # answered with the typed reject record (by
+                    # receipt ordinal — the sealed seq is
+                    # unreadable), never a worker death: the typed
+                    # catch comes before the loop's generic
+                    # channel-teardown handler.
+                    self._rx_frames += 1
+                    try:
+                        payload = ch.open(payload)
+                    except (DecryptError, InjectedFault) as exc:
+                        reason = getattr(exc, "reason", "fault")
+                        self._crypto_rejected += 1
+                        if reason == "replay":
+                            self._crypto_replays += 1
+                        self._send_reject(self._rx_frames, reason)
+                        continue
                 rows, packed_meta, trace, seq = \
                     decode_rows_seq(payload)
                 # ISSUE 14 span stitching: a traced frame gets its
@@ -217,11 +281,19 @@ class _NodeHost:
                         if trace is not None else None)
                 if seq is None:
                     # legacy sync frame: the PR 13 per-frame ack,
-                    # byte-identical (window=1 degenerates to it)
-                    send_frame(sock, pack_ack(admitted, st.submitted,
-                                              st.verdicts, st.shed,
-                                              st.recovery_dropped,
-                                              trace=echo))
+                    # byte-identical when the channel is plaintext
+                    # (window=1 degenerates to it); sealed when
+                    # encrypted.  A seal fault here propagates: the
+                    # parent is blocked on THIS reply, so the only
+                    # contained answer is the channel-death path the
+                    # pipelined tier already proves exact (EOF ->
+                    # forwarder requeue -> counted by failover/stop)
+                    blob = pack_ack(admitted, st.submitted,
+                                    st.verdicts, st.shed,
+                                    st.recovery_dropped, trace=echo)
+                    if ch is not None:
+                        blob = ch.seal(blob)
+                    send_frame(sock, blob)
                     continue
                 # sequenced frame (ISSUE 17): accumulate toward a
                 # cumulative ack.  TCP delivers in order, so the
@@ -280,6 +352,16 @@ class _NodeHost:
             blob = pack_cum_ack(self._ack_seq, self._ack_frames,
                                 self._ack_admitted, *self._ack_ledger,
                                 echoes=tuple(self._ack_echoes))
+            if self._crypto is not None:
+                # seal BEFORE resetting the pending state: an
+                # injected seal fault costs one flush, not one ack —
+                # the counters stay pending and the next flush (or
+                # the idle timer) sends a cumulative ack that covers
+                # everything.  Deferred, never lost.
+                try:
+                    blob = self._crypto.seal(blob)
+                except InjectedFault:
+                    return
             self._acks_sent += 1
             self._acks_coalesced += self._ack_frames - 1
             self._frames_acked += self._ack_frames
@@ -287,6 +369,23 @@ class _NodeHost:
             self._ack_admitted = 0
             self._ack_echoes = []
             send_frame(self._data, blob)
+
+    def _send_reject(self, ordinal: int, reason: str) -> None:
+        # thread-affinity: transport -- the data loop's reject
+        # answer; serialized under _ack_lock with the coalescer's
+        # flushes so a reject and a cumulative ack can never
+        # interleave mid-wire
+        blob = pack_crypto_reject(ordinal, reason)
+        try:
+            wire = self._crypto.seal(blob)
+        except InjectedFault:
+            # seal fault on the reject itself: ship it RAW — the
+            # parent's open() fails it "short" (counted, outside the
+            # desync class), and in sync mode the reply unblocks the
+            # forwarder, which is the one job this frame must do
+            wire = blob
+        with self._ack_lock:
+            send_frame(self._data, wire)
 
     def _start_ack_flusher(self) -> None:
         # thread-affinity: transport -- spawned lazily by the data
@@ -377,12 +476,27 @@ class _NodeHost:
         out["agg"] = _jsonable(self.daemon.analytics.stats())
         return out
 
+    def _crypto_block(self) -> Optional[dict]:
+        """The worker half of the encrypted channel's status surface
+        (COUNTERS AND EPOCH ONLY — key material never leaves the
+        channel object; CTA013 pins that)."""
+        ch = self._crypto
+        if ch is None:
+            return None
+        return {"epoch": ch.epoch, "sealed": ch.sealed,
+                "opened": ch.opened,
+                "rejected": self._crypto_rejected,
+                "replays": self._crypto_replays,
+                "rx-frames": self._rx_frames,
+                "rotations": ch.rotations}
+
     def _op_front_end(self, req: dict) -> dict:
         if self._final is not None:
             return {"front-end": self._final.get("front-end"),
                     "ledgers": self._final.get("ledgers"),
                     "mode": self._final.get("mode"),
-                    "l7": self._final.get("l7")}
+                    "l7": self._final.get("l7"),
+                    "crypto": self._crypto_block()}
         s = self.daemon._serving
         rt = s.get("runtime") if s is not None else None
         lad = s.get("ladder") if s is not None else None
@@ -394,6 +508,7 @@ class _NodeHost:
             "mode": lad.rung if lad is not None else None,
             "l7": (_jsonable(l7.stats()) if l7 is not None
                    else None),
+            "crypto": self._crypto_block(),
         }
 
     def _op_stop_serving(self, req: dict) -> dict:
@@ -409,6 +524,7 @@ class _NodeHost:
             "ledgers": ledgers,
             "mode": mode,
             "l7": _jsonable((final or {}).get("l7")),
+            "crypto": self._crypto_block(),
         }
         return dict(self._final)
 
@@ -489,6 +605,26 @@ class _NodeHost:
                     "acks-coalesced": self._acks_coalesced,
                     "frames-acked": self._frames_acked}
 
+    def _op_rotate_epoch(self, req: dict) -> dict:
+        """The worker half of the cluster-wide key rotation
+        (ISSUE 18), called FIRST (worker-first ordering): flush any
+        pending cumulative ack under the OLD epoch, rotate the data
+        channel (old epoch parked in its grace window so the
+        parent's in-flight frames still open), and rotate the
+        daemon's node-encryption plane to keep the registry epoch in
+        step.  The control-channel ack IS the per-node rotation
+        ack."""
+        if self._crypto is None:
+            raise ValueError(
+                "rotate_epoch needs cluster_encrypt=True")
+        epoch = int(req["epoch"])
+        grace = float(req.get("grace_s", self._crypto_grace_s))
+        self._flush_acks()
+        self._crypto.rotate(epoch, grace_s=grace)
+        if self.daemon.encryption is not None:
+            self.daemon.encryption.rotate(epoch, grace_s=grace)
+        return {"ok": True, "epoch": epoch}
+
     def _op_shutdown(self, req: dict) -> dict:
         self._stopping.set()
         return {"ok": True}
@@ -515,6 +651,7 @@ class _NodeHost:
         "record_incident": _op_record_incident,
         "publish_drops": _op_publish_drops,
         "ack_flush": _op_ack_flush,
+        "rotate_epoch": _op_rotate_epoch,
         "shutdown": _op_shutdown,
     }
 
@@ -588,13 +725,28 @@ class _NodeHost:
 
 
 def node_host_main(host: str, port: int, token: str, name: str,
-                   cfg_fields: dict, kv_addr) -> None:
+                   cfg_fields: dict, kv_addr,
+                   parent_pub: Optional[str] = None,
+                   epoch: int = 0) -> None:
     """The spawn target: dial home, build the daemon world, serve
     until the parent says shutdown (or the control channel dies —
-    an orphaned worker must not outlive its cluster)."""
-    ctrl, data, obs = connect_channels(host, port, name, token)
+    an orphaned worker must not outlive its cluster).  When
+    ``parent_pub`` (hex) is given the data channel is ENCRYPTED
+    (ISSUE 18): the worker mints its own X25519 keypair here — the
+    private key never crosses a process boundary — advertises the
+    pubkey in its hellos, and joins at the cluster's current key
+    ``epoch``."""
+    kp = None
+    if parent_pub is not None:
+        from ..encryption import NodeKeypair
+
+        kp = NodeKeypair()
+    ctrl, data, obs = connect_channels(
+        host, port, name, token,
+        pubkey=(kp.public.hex() if kp is not None else None))
     try:
-        node = _NodeHost(name, cfg_fields, kv_addr)
+        node = _NodeHost(name, cfg_fields, kv_addr, crypto_kp=kp,
+                         parent_pub=parent_pub, epoch=int(epoch))
     except Exception as exc:  # noqa: BLE001 — a worker that cannot
         # build its daemon reports WHY before dying (the parent's
         # first RPC would otherwise just see EOF)

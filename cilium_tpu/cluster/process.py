@@ -46,6 +46,7 @@ open window.  Window=1 keeps the PR 13 sync path byte-identical.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import secrets
 import socket
@@ -55,14 +56,28 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..encryption import DecryptError
+from ..infra.faults import InjectedFault
 from ..serving import ServingError
 from .nodehost import OP_TIMEOUTS
-from .transport import (SendWindow, encode_rows, recv_frame,
-                        recv_json_frame, rows_from_b64, rows_to_b64,
-                        send_frame, send_json_frame, shutdown_close,
-                        unpack_ack_ex, unpack_cum_ack)
+from .transport import (SendWindow, encode_rows, is_crypto_reject,
+                        recv_frame, recv_json_frame, rows_from_b64,
+                        rows_to_b64, send_frame, send_json_frame,
+                        shutdown_close, unpack_ack_ex,
+                        unpack_crypto_reject, unpack_cum_ack)
 
-__all__ = ["ProcessNode", "ProcessNodeSpawner", "spawn_available"]
+__all__ = ["ProcessNode", "ProcessNodeSpawner", "spawn_available",
+           "CRYPTO_DESYNC_THRESHOLD"]
+
+# ENCRYPTED MODE (ISSUE 18): consecutive parent-side ack/NACK open
+# failures in the KEY-MISMATCH class before the channel is declared
+# desynced (crypto-desync incident + channel break -> the router's
+# requeue/failover path).  The class is {"auth", "magic"} — wrong
+# session keys fail AEAD verification on every frame, while rotation
+# races surface as epoch-* rejects and injected faults as "fault",
+# neither of which means the peer holds the wrong key.
+CRYPTO_DESYNC_THRESHOLD = 3
+_DESYNC_REASONS = frozenset({"auth", "magic"})
 
 # one RPC may legitimately take this long (a worker's first RPC waits
 # out its whole jax+daemon bring-up)
@@ -102,9 +117,16 @@ class ProcessNodeSpawner:
         self._sock.listen(64)
         self.host, self.port = self._sock.getsockname()
 
-    def spawn(self, name: str, config, kv_addr) -> "ProcessNode":
+    def spawn(self, name: str, config, kv_addr,
+              parent_pub: Optional[str] = None,
+              epoch: int = 0) -> "ProcessNode":
         """Launch one worker process (daemon bring-up runs in the
-        child; :meth:`ProcessNode.wait_ready` blocks on it)."""
+        child; :meth:`ProcessNode.wait_ready` blocks on it).
+        ``parent_pub`` (hex) arms the encrypted data channel: the
+        worker mints its own X25519 keypair, advertises the pubkey in
+        its hello frames, and seals/opens every data-channel frame;
+        ``epoch`` is the cluster's CURRENT key epoch so a scale-out
+        worker joins mid-rotation-history at the right keys."""
         import multiprocessing as mp
 
         from .nodehost import node_host_main
@@ -119,17 +141,22 @@ class ProcessNodeSpawner:
         proc = ctx.Process(
             target=node_host_main,
             args=(self.host, self.port, self.token, name,
-                  cfg_fields, tuple(kv_addr)),
+                  cfg_fields, tuple(kv_addr), parent_pub,
+                  int(epoch)),
             daemon=True, name=f"cluster-node-{name}")
         proc.start()
         return ProcessNode(name, proc, self)
 
     def accept_channels(self, name: str, timeout: float = 60.0
                         ) -> Tuple[socket.socket, socket.socket,
-                                   socket.socket]:
+                                   socket.socket, Optional[str]]:
         """Accept until all three of ``name``'s channels arrived
-        (workers race; hellos disambiguate)."""
+        (workers race; hellos disambiguate).  Returns the sockets
+        plus the worker's advertised X25519 pubkey (hex, or None for
+        a plaintext worker) — the spawn-handshake half of the
+        encrypted-channel key exchange (ISSUE 18)."""
         got: Dict[str, socket.socket] = {}
+        pubkey: Optional[str] = None
         deadline = time.monotonic() + timeout
         while not {"ctrl", "data", "obs"} <= set(got):
             self._sock.settimeout(max(deadline - time.monotonic(),
@@ -154,7 +181,9 @@ class ProcessNodeSpawner:
             sock.settimeout(None)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             got[hello["role"]] = sock
-        return got["ctrl"], got["data"], got["obs"]
+            if hello.get("pubkey"):
+                pubkey = hello["pubkey"]
+        return got["ctrl"], got["data"], got["obs"], pubkey
 
     def close(self) -> None:
         shutdown_close(self._sock)
@@ -173,7 +202,10 @@ class ProcessNode:
     # guarded-by: _lock: alive, final, _ct_snap_rows, _last_ack,
     # guarded-by: _lock: _crash_loss_pending, _frames, _bytes,
     # guarded-by: _lock: _frames_packed, _acks, _acks_coalesced
-    # guarded-by: _win_cv: _win, _win_broken, _window_stalls
+    # guarded-by: _lock: _crypto_nacks, _crypto_replays,
+    # guarded-by: _lock: _crypto_open_failures, _open_fail_run
+    # guarded-by: _win_cv: _win, _win_broken, _window_stalls,
+    # guarded-by: _win_cv: _ord_sent, _ord_map
 
     def __init__(self, name: str, proc, spawner: ProcessNodeSpawner):
         self.idx = -1  # assigned by ClusterServing
@@ -213,12 +245,183 @@ class ProcessNode:
         self._on_ack = None
         self._on_broken = None
         self._ack_thread: Optional[threading.Thread] = None
+        # -- encrypted mode (ISSUE 18): parent half of the sealed
+        # data channel.  peer_pub_hex arrives with the spawn
+        # handshake; enable_crypto builds the channel before any
+        # frame flows.
+        self.peer_pub_hex: Optional[str] = None
+        self._crypto = None  # encryption.EncryptedChannel
+        self._crypto_grace_s = 0.0
+        self._on_reject = None  # router's crypto-drop accounting
+        self._crypto_nacks = 0  # worker-side rejects (NACK records)
+        self._crypto_replays = 0  # NACKs with reason "replay"
+        self._crypto_open_failures = 0  # parent-side open failures
+        self._open_fail_run = 0  # consecutive key-mismatch failures
+        self._ord_sent = 0  # sealed data frames sent (NACK ordinals)
+        # ordinal -> window seq for sealed windowed frames; the
+        # worker cannot read a rejected frame's seq (it is inside the
+        # sealed payload), so its NACK carries the frame's receipt
+        # ORDINAL instead — TCP ordering makes the parent's Nth send
+        # the worker's Nth receipt, and this map turns the ordinal
+        # back into the window entry whose rows the reject dropped
+        self._ord_map: "collections.OrderedDict[int, int]" = \
+            collections.OrderedDict()
+        # replay test hook + wire-identity probe (the exact bytes of
+        # the most recent data frame as they left for the socket)
+        self._last_wire: Optional[bytes] = None
 
     # -- bring-up ------------------------------------------------------
     def attach(self, timeout: float = 60.0) -> None:
-        (self._ctrl, self._data,
-         self._obs) = self._spawner.accept_channels(self.name,
-                                                    timeout)
+        (self._ctrl, self._data, self._obs,
+         self.peer_pub_hex) = self._spawner.accept_channels(
+            self.name, timeout)
+
+    # -- encrypted mode (ISSUE 18) -------------------------------------
+    def enable_crypto(self, keypair, peer_pub: bytes,
+                      grace_s: float = 0.0, epoch: int = 0) -> None:
+        # thread-affinity: api -- ClusterServing._build_node, before
+        # any data frame flows on the channel
+        """Arm the parent half of the sealed data channel: every
+        frame this node sends or receives on the data socket is one
+        AEAD seal/open.  ``epoch`` > 0 joins the channel at the
+        cluster's current key epoch (scale-out under rotation)."""
+        from ..encryption import EncryptedChannel
+
+        self._crypto = EncryptedChannel(keypair, peer_pub,
+                                        epoch=int(epoch))
+        self._crypto_grace_s = float(grace_s)
+
+    def set_reject_cb(self, cb) -> None:
+        # thread-affinity: api -- router.start, before frames flow.
+        """``cb(n_rows, reason, ctx)`` per worker crypto-reject —
+        the router's ``crypto_dropped`` ledger term."""
+        self._on_reject = cb
+
+    def rotate_channel(self, epoch: int,
+                       grace_s: Optional[float] = None) -> None:
+        # thread-affinity: api -- ClusterServing.rotate_epoch (the
+        # channel's own lock serializes against in-flight seal/open)
+        ch = self._crypto
+        if ch is None:
+            return
+        ch.rotate(int(epoch), self._crypto_grace_s
+                  if grace_s is None else float(grace_s))
+
+    def rotate_epoch(self, epoch: int,
+                     grace_s: Optional[float] = None) -> dict:
+        """One node's leg of the cluster-wide key rotation, in the
+        two-phase order that closes BOTH directions at every
+        interleaving: (1) the parent PRE-INSTALLS the new epoch's
+        receive key (``prepare_recv``) so an ack the worker seals
+        at e+1 right after its own rotate — while this control call
+        is still in flight — opens instead of rejecting
+        ``epoch-ahead`` (a discarded cumulative ack that covered
+        the whole send window would wedge the channel's credit);
+        (2) the worker rotates, parking the old epoch in its grace
+        window so the parent's in-flight e-sealed data frames still
+        open, and acks over control; (3) the parent channel
+        rotates, adopting the prepared replay window."""
+        g = (self._crypto_grace_s if grace_s is None
+             else float(grace_s))
+        ch = self._crypto
+        if ch is not None:
+            ch.prepare_recv(int(epoch))
+        resp = self.call("rotate_epoch", epoch=int(epoch), grace_s=g)
+        self.rotate_channel(epoch, g)
+        return resp
+
+    def _note_open_failure(self, exc: Exception) -> bool:
+        # thread-affinity: transport, api -- the data-channel reader
+        # (forwarder in sync mode, ack reader in pipelined mode);
+        # api only via the quiesced inject_replay test hook
+
+        """Count one parent-side open failure; True when this one
+        crossed the key-desync threshold (the caller breaks the
+        channel — counted degradation, never a hang)."""
+        reason = getattr(exc, "reason", "fault")
+        with self._lock:
+            self._crypto_open_failures += 1
+            if reason in _DESYNC_REASONS:
+                self._open_fail_run += 1
+                run = self._open_fail_run
+            else:
+                run = 0
+        if run == CRYPTO_DESYNC_THRESHOLD:
+            from ..obs.flightrec import KIND_CRYPTO_DESYNC
+
+            self.record_incident(KIND_CRYPTO_DESYNC, {
+                "node": self.name, "consecutive-failures": run,
+                "reason": reason})
+            return True
+        return False
+
+    def _count_nack(self, reason: str) -> None:
+        # thread-affinity: transport, api -- api only via the
+        # quiesced inject_replay test hook
+        with self._lock:
+            self._crypto_nacks += 1
+            if reason == "replay":
+                self._crypto_replays += 1
+
+    def _open_sync_ack(self, ack: bytes, n_rows: int, trace
+                       ) -> Tuple[Optional[bytes], int]:
+        # thread-affinity: transport -- the sync submit path
+        """Open one sync-mode ack frame.  Returns ``(plaintext,
+        0)`` when the caller should parse the ack, or ``(None,
+        count)`` when the frame resolved the submit here: a worker
+        crypto-reject (rows dropped and counted) or a parent-side
+        open failure (counted; delivered-or-dropped decided by the
+        failure class — see below)."""
+        ch = self._crypto
+        try:
+            plain = ch.open(ack)
+            with self._lock:
+                self._open_fail_run = 0
+        except (DecryptError, InjectedFault) as exc:
+            if is_crypto_reject(ack):
+                # RAW reject record: the worker's reject-seal leg
+                # faulted and it shipped the record unauthenticated.
+                # Accept it for LOSS ACCOUNTING only — a forged one
+                # can reclassify loss, never admit traffic — because
+                # dropping it here would leave the rejected frame's
+                # rows in no counter at all (silent loss)
+                plain = ack
+            else:
+                return self._account_sync_open_failure(exc, n_rows,
+                                                       trace)
+        if not is_crypto_reject(plain):
+            return plain, 0
+        _ordn, reason = unpack_crypto_reject(plain)
+        self._count_nack(reason)
+        cb = self._on_reject
+        if cb is not None:
+            cb(n_rows, reason, trace)
+        return None, 0
+
+    def _account_sync_open_failure(self, exc: Exception, n_rows: int,
+                                   trace) -> Tuple[None, int]:
+        # thread-affinity: transport -- _open_sync_ack's failure leg
+        reason = getattr(exc, "reason", "fault")
+        if self._note_open_failure(exc):
+            with self._win_cv:
+                if self._win_broken is None:
+                    self._win_broken = "crypto-desync"
+        if reason in _DESYNC_REASONS:
+            # wrong keys are SYMMETRIC (both directions derive from
+            # the same shared secret): the worker cannot have opened
+            # our data frame either — this response is its NACK,
+            # unreadable.  Count the rows dropped; sync mode's 1:1
+            # frame:response keeps that exact.
+            cb = self._on_reject
+            if cb is not None:
+                cb(n_rows, reason, trace)
+            return None, 0
+        # outside the key-mismatch class (an injected open fault, a
+        # rotation-race epoch reject): the worker DID open and admit
+        # the frame — its own counters own these rows.  Skip the
+        # _last_ack update; acked ledgers are cumulative, so the
+        # next readable ack repairs it.
+        return None, n_rows
 
     def wait_ready(self, timeout: float = READY_TIMEOUT_S) -> None:
         self.call("ready", timeout=timeout)
@@ -308,6 +511,13 @@ class ProcessNode:
             raise ServingError(f"worker {self.name} not attached")
         with self._win_cv:
             win = self._win
+            if self._win_broken is not None:
+                # a desynced (or otherwise dead) channel fails every
+                # submit fast — the forwarder's requeue owns the rows
+                raise ServingError(
+                    f"data channel to {self.name} broken: "
+                    f"{self._win_broken}")
+        ch = self._crypto
         wire_trace = ((trace.trace_id, trace.t_enq, trace.t_fwd)
                       if trace is not None else None)
         ok, ep, dirn = pack_eligibility(rows)
@@ -316,11 +526,29 @@ class ProcessNode:
         if win is None:
             payload = encode_rows(wire_rows, packed_meta=meta,
                                   trace=wire_trace)
+            if ch is not None:
+                try:
+                    payload = ch.seal(payload)
+                except InjectedFault as exc:
+                    # the frame never reached the wire: the
+                    # forwarder's requeue-on-error owns these rows
+                    raise ServingError(
+                        f"seal to {self.name} failed: "
+                        f"{exc}") from None
             send_frame(sock, payload)
+            if ch is not None:
+                with self._win_cv:
+                    self._ord_sent += 1
+            self._last_wire = payload
             ack = recv_frame(sock)
             if ack is None:
                 raise ServingError(
                     f"worker {self.name} closed the data channel")
+            if ch is not None:
+                ack, shortcut = self._open_sync_ack(ack, len(rows),
+                                                    trace)
+                if ack is None:
+                    return shortcut
             (admitted, sub, ver, shed, rec), echo = unpack_ack_ex(ack)
             if trace is not None and echo is not None \
                     and echo[0] == trace.trace_id:
@@ -350,11 +578,32 @@ class ProcessNode:
                           else time.monotonic(), trace)
         payload = encode_rows(wire_rows, packed_meta=meta,
                               trace=wire_trace, seq=seq)
+        ordn = None
+        if ch is not None:
+            try:
+                payload = ch.seal(payload)
+            except InjectedFault as exc:
+                # never reached the wire: unwind the window entry
+                # and let the forwarder's requeue own the rows
+                with self._win_cv:
+                    win.drop(seq)
+                    self._win_cv.notify_all()
+                raise ServingError(
+                    f"seal to {self.name} failed: {exc}") from None
+            # register BEFORE the send (like win.add): a NACK racing
+            # the sendall's return must find its ordinal mapped
+            with self._win_cv:
+                self._ord_sent += 1
+                ordn = self._ord_sent
+                self._ord_map[ordn] = seq
+        self._last_wire = payload
         try:
             send_frame(sock, payload)
         except Exception as exc:  # noqa: BLE001 — dead fd mid-send
             with self._win_cv:
                 win.drop(seq)
+                if ordn is not None:
+                    self._ord_map.pop(ordn, None)
                 self._win_cv.notify_all()
             raise ServingError(
                 f"send to {self.name} failed: "
@@ -400,15 +649,66 @@ class ProcessNode:
         sock = self._data
         with self._win_cv:
             win = self._win
+        ch = self._crypto
         try:
             while True:
                 payload = recv_frame(sock)
                 if payload is None:
                     break
+                if ch is not None:
+                    raw = payload
+                    try:
+                        payload = ch.open(payload)
+                        with self._lock:
+                            self._open_fail_run = 0
+                    except (DecryptError, InjectedFault) as exc:
+                        if is_crypto_reject(raw):
+                            # RAW reject fallback (the worker's
+                            # reject-seal leg faulted): accept it for
+                            # loss accounting only — see
+                            # _open_sync_ack — else the rejected
+                            # frame's rows land in no counter
+                            payload = raw
+                        elif self._note_open_failure(exc):
+                            # key desync: break the channel so the
+                            # finally's take_all hands every
+                            # in-flight frame back to the router
+                            # (requeued and counted — never silent,
+                            # never a hang)
+                            with self._win_cv:
+                                if self._win_broken is None:
+                                    self._win_broken = "crypto-desync"
+                            break
+                        else:
+                            continue
+                    if is_crypto_reject(payload):
+                        # the worker could not open our Nth data
+                        # frame: pop exactly that window entry — its
+                        # rows are a counted, flow-visible drop, NOT
+                        # a requeue (the frame reached the worker)
+                        ordn, reason = unpack_crypto_reject(payload)
+                        with self._win_cv:
+                            seq = self._ord_map.pop(ordn, None)
+                            ent = (win.pop(seq) if seq is not None
+                                   else None)
+                            self._win_cv.notify_all()
+                        self._count_nack(reason)
+                        cb = self._on_reject
+                        if cb is not None:
+                            cb(len(ent[1]) if ent is not None else 0,
+                               reason,
+                               ent[3] if ent is not None else None)
+                        continue
                 (seq, frames, _admitted, sub, ver, shed,
                  rec), echoes = unpack_cum_ack(payload)
                 with self._win_cv:
                     entries = win.retire(seq)
+                    # ordinals the cumulative ack covered can never
+                    # be NACKed again — prune the map from the front
+                    # (insertion order == seq order)
+                    while self._ord_map and next(iter(
+                            self._ord_map.values())) <= seq:
+                        self._ord_map.popitem(last=False)
                     self._win_cv.notify_all()
                 with self._lock:
                     self._last_ack = (sub, ver, shed, rec)
@@ -466,6 +766,42 @@ class ProcessNode:
             if win is None:
                 return (0, 0)
             return (win.inflight_frames, win.inflight_rows)
+
+    def inject_replay(self) -> bool:
+        # thread-affinity: api -- TEST HOOK (chaos gate): call only
+        # on a quiesced channel (no forwarder traffic in flight)
+        """Re-send the last sealed data frame VERBATIM — the
+        replay-attack injection.  The worker's per-epoch replay
+        window must reject it (counted, NACKed, zero rows dropped —
+        the original already resolved).  True when the replay was
+        rejected as a replay."""
+        wire = self._last_wire
+        sock = self._data
+        if wire is None or self._crypto is None or sock is None:
+            return False
+        send_frame(sock, wire)
+        with self._win_cv:
+            self._ord_sent += 1
+            win = self._win
+        if win is not None:
+            return True  # the ack reader counts the NACK
+        # sync protocol: consume the reject reply in-line
+        resp = recv_frame(sock)
+        if resp is None:
+            return False
+        try:
+            resp = self._crypto.open(resp)
+        except (DecryptError, InjectedFault) as exc:
+            self._note_open_failure(exc)
+            return False
+        if not is_crypto_reject(resp):
+            return False
+        _ordn, reason = unpack_crypto_reject(resp)
+        self._count_nack(reason)
+        cb = self._on_reject
+        if cb is not None:
+            cb(0, reason, None)
+        return reason == "replay"
 
     def ack_flush(self) -> Optional[dict]:
         # thread-affinity: api
@@ -630,6 +966,22 @@ class ProcessNode:
         except ServingError:
             return None
 
+    def worker_crypto(self) -> Optional[dict]:
+        """The WORKER half's channel counters (rx frames, rejects,
+        replays, epoch — the parent half rides
+        :meth:`transport_stats`); ``None`` on a plaintext cluster.
+        The retained final survives a clean stop; SIGKILL erases the
+        worker's counters with the process (the parent half is then
+        the only surviving record of the channel)."""
+        with self._lock:
+            fin = self.final
+        if fin is not None:
+            return fin.get("crypto")
+        try:
+            return self.call("front_end", timeout=30.0).get("crypto")
+        except ServingError:
+            return None
+
     def l7_stats(self) -> Optional[dict]:
         """The node's L7 proxy-plane block (the worker ships it with
         ``front_end``; the retained final survives a clean stop —
@@ -740,6 +1092,22 @@ class ProcessNode:
             out["inflight-frames"] = (win.inflight_frames
                                       if win is not None else 0)
             out["window-stalls"] = self._window_stalls
+        ch = self._crypto
+        if ch is not None:
+            with self._lock:
+                out["crypto"] = {
+                    "epoch": ch.epoch,
+                    "sealed": ch.sealed,
+                    "opened": ch.opened,
+                    # worker NACKs + every parent-side open failure
+                    # (channel rejects and injected faults alike)
+                    "rejected": (self._crypto_nacks
+                                 + self._crypto_open_failures),
+                    "nacks": self._crypto_nacks,
+                    "open-failures": self._crypto_open_failures,
+                    "replays": ch.replays + self._crypto_replays,
+                    "rotations": ch.rotations,
+                }
         return out
 
     def shutdown(self) -> None:

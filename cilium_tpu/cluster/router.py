@@ -92,7 +92,7 @@ OverflowFn = Callable[[int, Optional[np.ndarray], int], None]
 # tuple AND requires a ``cilium_cluster_<name>_total`` registry
 # series per entry — a new drop site cannot ship uncounted.
 DROP_COUNTERS = ("router_overflow", "failover_dropped",
-                 "crash_dropped")
+                 "crash_dropped", "crypto_dropped")
 
 # bounded retention of shed rows for DROP-event surfacing (the count
 # is exact either way — same discipline as admission sheds)
@@ -127,7 +127,7 @@ class ClusterRouter:
     # guarded-by: _lock: router_overflow, failover_dropped, forwarded,
     # guarded-by: _lock: _suspect, crash_dropped, _frozen, _inflight,
     # guarded-by: _lock: forward_latency, _nchunks, _retired,
-    # guarded-by: _lock: _win_swept
+    # guarded-by: _lock: _win_swept, crypto_dropped
 
     def __init__(self, nodes: Sequence, forward_depth: int,
                  on_overflow: Optional[OverflowFn] = None,
@@ -192,6 +192,10 @@ class ClusterRouter:
         # rows a crashed (SIGKILLed) worker admitted but never
         # verdicted — see account_crash_loss
         self.crash_dropped = 0
+        # rows in sealed frames the worker REJECTED (decrypt failure,
+        # replay, stale epoch — ISSUE 18): delivered but never
+        # admitted, counted here via the node's reject callback
+        self.crypto_dropped = 0
         self.forwarded = [0] * self.n_nodes
         # enqueue -> delivered µs (queue wait + node submit / socket
         # round trip): the bench's forward-path percentiles
@@ -217,6 +221,13 @@ class ClusterRouter:
         # thread-affinity: api
         # holds: nothing — callers serialize (start / add_node)
         node = self.nodes[idx]
+        if hasattr(node, "set_reject_cb"):
+            # ISSUE 18 encrypted channel: a worker's crypto-reject
+            # (NACK) lands here — the frame was DELIVERED but its
+            # rows were never admitted, a counted flow-visible drop
+            node.set_reject_cb(
+                lambda n_rows, reason, ctx=None, i=idx:
+                    self._on_crypto_reject(i, n_rows, reason, ctx))
         if (self.forward_window > 1 and idx not in self._windowed
                 and hasattr(node, "enable_window")):
             # windowed membership is decided HERE, before the thread
@@ -641,6 +652,29 @@ class ClusterRouter:
                 self.crash_dropped += count
         return count
 
+    def _on_crypto_reject(self, idx: int, n_rows: int, reason: str,
+                          ctx=None) -> None:
+        # thread-affinity: transport -- the node's data-channel
+        # reader (sync submit or ack reader), via set_reject_cb
+        """Account one worker crypto-reject (ISSUE 18).  The rows
+        reached the worker but were never admitted — a counted
+        ``crypto_dropped``, NOT a requeue (retrying a frame the
+        worker's replay window already saw would just reject again).
+        In pipelined mode the NACK also popped the frame from the
+        send window, so its in-flight debt retires here; sync mode's
+        forwarder settles its own in-flight accounting."""
+        with self._cv:
+            if n_rows and idx not in self._win_swept:
+                # a node stop() already swept counted its in-flight
+                # rows failover_dropped; a late NACK for one of them
+                # must not count the rows twice
+                self.crypto_dropped += n_rows
+                if idx in self._windowed:
+                    self._inflight[idx] -= n_rows
+            self._cv.notify_all()
+        if ctx is not None and self.span_store is not None:
+            self.span_store.drop_span(ctx)
+
     # -- live scale-out (cluster/scale.py drives this) -----------------
     def freeze(self) -> None:
         # thread-affinity: api
@@ -815,6 +849,7 @@ class ClusterRouter:
                 "router-overflow": self.router_overflow,
                 "failover-dropped": self.failover_dropped,
                 "crash-dropped": self.crash_dropped,
+                "crypto-dropped": self.crypto_dropped,
                 "n-slots": self.n_slots,
                 "slot-owner": list(self._slot_owner),
                 "forward-latency-us": {
@@ -845,4 +880,24 @@ class ClusterRouter:
             "window-stalls": stalls,
             "inflight-frames": frames,
         }
+        # ISSUE 18 encrypted channel: parent-side seal/open counters
+        # summed over every encrypted node handle (None when the
+        # cluster runs plaintext — the surfaces omit the block)
+        crypto = None
+        for node in self.nodes:
+            try:
+                cs = node.transport_stats().get("crypto")
+            except Exception:  # noqa: BLE001 — torn read on a dead
+                continue  # handle: skip, counters only
+            if cs is None:
+                continue
+            if crypto is None:
+                crypto = {"sealed": 0, "opened": 0, "rejected": 0,
+                          "replays": 0, "rotations": 0, "epoch": 0}
+            for k in ("sealed", "opened", "rejected", "replays",
+                      "rotations"):
+                crypto[k] += int(cs.get(k, 0))
+            crypto["epoch"] = max(crypto["epoch"],
+                                  int(cs.get("epoch", 0)))
+        snap["crypto"] = crypto
         return snap

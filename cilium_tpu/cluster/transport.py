@@ -82,8 +82,10 @@ __all__ = [
     "encode_rows", "decode_rows", "decode_rows_ex", "decode_rows_seq",
     "pack_ack", "unpack_ack", "unpack_ack_ex",
     "pack_cum_ack", "unpack_cum_ack",
+    "pack_crypto_reject", "unpack_crypto_reject", "is_crypto_reject",
     "rows_to_b64", "rows_from_b64",
     "MAX_FRAME", "ACK_SIZE", "ACK_TRACED_SIZE", "CUM_ACK_MIN_SIZE",
+    "CRYPTO_REJECT_SIZE", "CRYPTO_REJECT_REASONS",
 ]
 
 # frame length prefix: 4-byte big-endian unsigned
@@ -142,6 +144,27 @@ CUM_ACK_KIND = 0xC5
 _CUM_ACK = struct.Struct(">BQIQQQQQ")
 _ECHO_N = struct.Struct(">I")
 CUM_ACK_MIN_SIZE = _CUM_ACK.size + _ECHO_N.size
+
+# the encrypted channel's TYPED REJECT record (ISSUE 18): sent by the
+# worker in place of an ack when a sealed data frame fails to open
+# (decrypt failure, replay, stale epoch, injected crypto fault).  The
+# worker cannot read the frame's transport sequence — the whole
+# payload including the seq block is sealed — so the record carries
+# the frame's ORDINAL instead: the 1-based count of data frames
+# received on the channel.  TCP preserves order and count, so the
+# parent's Nth send IS the worker's Nth receipt, and the parent maps
+# ordinal -> (its transport seq, row count) to drop the exact frame
+# from its send window and count its rows ``crypto_dropped`` — a
+# rejected frame is flow-visible loss, never silent and never a
+# worker crash.  13 bytes: never collides with the 36/60-byte
+# per-frame acks or the >= 57-byte cumulative ack.
+CRYPTO_REJECT_KIND = 0xC6
+_CRYPTO_REJECT = struct.Struct(">BQI")
+CRYPTO_REJECT_SIZE = _CRYPTO_REJECT.size
+# coded reject reasons (the wire carries an index; unknown indices
+# decode as "other" — forward compatibility over a mixed-version pair)
+CRYPTO_REJECT_REASONS = ("auth", "replay", "epoch-old", "epoch-ahead",
+                         "short", "magic", "fault", "other")
 
 
 class FrameError(Exception):
@@ -494,6 +517,41 @@ def unpack_cum_ack(payload: bytes) -> Tuple[
     return hdr[1:], echoes
 
 
+# -- the typed crypto-reject record (ISSUE 18) -------------------------
+def pack_crypto_reject(ordinal: int, reason: str) -> bytes:
+    # thread-affinity: transport
+    """The worker's word for ONE undecryptable data frame: its
+    ordinal (Nth data frame received on this channel) and the coded
+    reject reason.  Travels sealed like any other ack."""
+    try:
+        code = CRYPTO_REJECT_REASONS.index(reason)
+    except ValueError:
+        code = CRYPTO_REJECT_REASONS.index("other")
+    return _CRYPTO_REJECT.pack(CRYPTO_REJECT_KIND, int(ordinal), code)
+
+
+def is_crypto_reject(payload: bytes) -> bool:
+    # thread-affinity: transport, router, api -- api only via the
+    # quiesced inject_replay test hook
+    return (len(payload) == CRYPTO_REJECT_SIZE
+            and payload[0] == CRYPTO_REJECT_KIND)
+
+
+def unpack_crypto_reject(payload: bytes) -> Tuple[int, str]:
+    # thread-affinity: transport, router, api -- api only via the
+    # quiesced inject_replay test hook
+    """Reject payload -> (frame ordinal, reason string)."""
+    if not is_crypto_reject(payload):
+        raise FrameError(
+            f"crypto-reject record is {len(payload)} bytes / kind "
+            f"{payload[0] if payload else None}, want "
+            f"{CRYPTO_REJECT_SIZE} / {CRYPTO_REJECT_KIND:#x}")
+    _kind, ordinal, code = _CRYPTO_REJECT.unpack(payload)
+    if code >= len(CRYPTO_REJECT_REASONS):
+        return ordinal, "other"
+    return ordinal, CRYPTO_REJECT_REASONS[code]
+
+
 class SendWindow:
     """Sender-side bookkeeping for the pipelined channel: the frames
     in flight between send and cumulative ack, in sequence order,
@@ -542,16 +600,24 @@ class SendWindow:
             out.append(ent)
         return out
 
-    def drop(self, seq: int) -> bool:
-        # thread-affinity: router -- a frame whose SEND failed never
-        # reached the worker: unregister it so the forwarder's
-        # requeue-on-error does not double-count its rows
+    def pop(self, seq: int) -> Optional[tuple]:
+        # thread-affinity: router, transport -- unregister one frame
+        # and hand its entry back: the send-failure unwind (drop) and
+        # the crypto-reject path (ISSUE 18 — the rejected frame's
+        # rows are counted ``crypto_dropped`` from the entry) share
+        # this removal
         for i, ent in enumerate(self.entries):
             if ent[0] == seq:
                 self.inflight_rows -= len(ent[1])
                 del self.entries[i]
-                return True
-        return False
+                return ent
+        return None
+
+    def drop(self, seq: int) -> bool:
+        # thread-affinity: router -- a frame whose SEND failed never
+        # reached the worker: unregister it so the forwarder's
+        # requeue-on-error does not double-count its rows
+        return self.pop(seq) is not None
 
     def take_all(self) -> List[tuple]:
         # thread-affinity: any -- crash/teardown: every sent-but-

@@ -39,18 +39,31 @@ import json
 import os
 import struct
 import threading
-from typing import Dict, Optional, Tuple
+import time
+from typing import Dict, List, Optional, Tuple
 
+from ..infra import faults
 from ..native import crypto
 
 PUBKEY_FIELD = "encryption-pubkey"  # node-registry info key (hex)
 MAGIC = 0xC17E
 HDR = struct.Struct("<HHIQ")  # magic, epoch, reserved, seq
 OVERHEAD = HDR.size + 16  # header + poly1305 tag
+# rotation grace: how many superseded epochs a channel will keep
+# receive state for at once (each with its own replay window).  A
+# serving rotation keeps at most ONE epoch in flight; the bound only
+# matters under rotation storms, where the oldest key ages out.
+GRACE_MAX = 4
 
 
 class DecryptError(Exception):
-    pass
+    """A sealed frame that must not be admitted.  ``reason`` is the
+    machine-readable flavor: short | magic | epoch-old | epoch-ahead |
+    replay | auth."""
+
+    def __init__(self, msg: str, reason: str = "auth"):
+        super().__init__(msg)
+        self.reason = reason
 
 
 class NodeKeypair:
@@ -106,7 +119,16 @@ class EncryptedChannel:
     Frame layout: ``magic | epoch | reserved | seq`` (16 B, rides as
     AAD) + ciphertext + tag.  The nonce is the little-endian sequence
     number (12 B) — unique per key because seq is strictly monotone
-    and keys rotate with epoch."""
+    and keys rotate with epoch.
+
+    Rotation grace: ``rotate(epoch, grace_s=G)`` keeps the superseded
+    epoch's receive key alive for G seconds, with ITS OWN replay
+    window — frames sealed just before a peer rotated still open
+    (wireguard keeps the previous session key for exactly this
+    reason), while a replayed old-epoch frame is still rejected by
+    that epoch's window and an EXPIRED old epoch rejects outright.
+    The default ``grace_s=0`` preserves the strict behavior: any
+    non-current epoch rejects immediately."""
 
     def __init__(self, local: NodeKeypair, peer_public: bytes,
                  epoch: int = 0):
@@ -116,23 +138,80 @@ class EncryptedChannel:
         self._send_key, self._recv_key = derive_session_keys(
             local, peer_public, epoch)
         self._send_seq = 0
-        self._recv_seq = 0  # highest accepted
+        self._recv_seq = 0  # highest accepted (current epoch)
+        # guarded-by: _lock — superseded-epoch receive state,
+        # epoch16 -> [recv_key, recv_seq, expiry_monotonic]
+        self._grace: Dict[int, List] = {}
+        # guarded-by: _lock — NEXT-epoch receive state installed by
+        # prepare_recv() ahead of a rotation,
+        # [epoch16, recv_key, recv_seq]
+        self._pending: Optional[List] = None
         self._lock = threading.Lock()
         self.sealed = 0
         self.opened = 0
         self.rejected = 0
+        self.replays = 0  # subset of rejected: replay-window hits
+        self.rotations = 0
 
-    def rotate(self, epoch: int) -> None:
-        """Key rotation: new epoch -> new session keys, sequence
-        numbers restart (the nonce space is per-key)."""
+    def prepare_recv(self, epoch: int) -> None:
+        """Pre-install the RECEIVE half of ``epoch`` ahead of a
+        rotation (wireguard installs the responder's receiving key
+        before it ever sends with it, for the same reason): frames
+        the peer seals at the new epoch in the gap between ITS
+        rotation and ours open here instead of rejecting
+        ``epoch-ahead``.  Without this, a coalesced ack sealed at
+        e+1 right after the worker's rotate — before the parent's —
+        is discarded, and if it covered the whole send window the
+        credit never returns (a wedged channel the stop-sweep then
+        double-counts).  Send stays at the CURRENT epoch; a later
+        :meth:`rotate` to the same epoch adopts the pending replay
+        window so early frames stay unreplayable."""
         with self._lock:
+            e16 = epoch & 0xFFFF
+            if e16 == (self.epoch & 0xFFFF):
+                return
+            if self._pending is not None and self._pending[0] == e16:
+                return  # keep the already-advanced replay window
+            _send, recv = derive_session_keys(
+                self._local, self.peer_public, epoch)
+            self._pending = [e16, recv, 0]
+
+    def rotate(self, epoch: int, grace_s: float = 0.0) -> None:
+        """Key rotation: new epoch -> new session keys, sequence
+        numbers restart (the nonce space is per-key).  With
+        ``grace_s > 0`` the outgoing epoch's RECEIVE side survives
+        that long (bounded to :data:`GRACE_MAX` epochs), so in-flight
+        peer frames are not lost to the flip.  A matching
+        :meth:`prepare_recv` hands its replay window over — frames
+        accepted at the new epoch BEFORE the flip stay
+        unreplayable after it."""
+        with self._lock:
+            old16 = self.epoch & 0xFFFF
+            if grace_s > 0 and epoch != self.epoch:
+                self._grace[old16] = [
+                    self._recv_key, self._recv_seq,
+                    time.monotonic() + grace_s]
+                while len(self._grace) > GRACE_MAX:
+                    oldest = min(self._grace,
+                                 key=lambda e: self._grace[e][2])
+                    del self._grace[oldest]
             self.epoch = epoch
             self._send_key, self._recv_key = derive_session_keys(
                 self._local, self.peer_public, epoch)
             self._send_seq = 0
             self._recv_seq = 0
+            pend = self._pending
+            if pend is not None and pend[0] == (epoch & 0xFFFF):
+                self._recv_seq = pend[2]
+            self._pending = None  # stale prepares (a rotation that
+            # skipped past them) die here too
+            # a 16-bit collision with the new epoch would shadow the
+            # live key — the fresh epoch always wins
+            self._grace.pop(epoch & 0xFFFF, None)
+            self.rotations += 1
 
     def seal(self, buf: bytes) -> bytes:
+        faults.check(faults.SITE_CRYPTO_SEAL)
         with self._lock:
             self._send_seq += 1
             seq = self._send_seq
@@ -144,34 +223,85 @@ class EncryptedChannel:
         return aad + crypto.aead_seal(key, nonce, aad, bytes(buf))
 
     def open(self, frame: bytes) -> bytes:
+        faults.check(faults.SITE_CRYPTO_OPEN)
         if len(frame) < OVERHEAD:
-            raise DecryptError("frame too short")
+            raise DecryptError("frame too short", "short")
         aad = frame[:HDR.size]
         magic, epoch, _res, seq = HDR.unpack(aad)
         with self._lock:
             if magic != MAGIC:
                 self.rejected += 1
-                raise DecryptError("bad magic")
-            if epoch != (self.epoch & 0xFFFF):
-                self.rejected += 1
-                raise DecryptError(
-                    f"epoch {epoch} != local {self.epoch & 0xFFFF} "
-                    "(peer rotated?)")
-            if seq <= self._recv_seq:
-                self.rejected += 1
-                raise DecryptError(f"replayed/reordered seq {seq}")
-            key = self._recv_key
+                raise DecryptError("bad magic", "magic")
+            cur16 = self.epoch & 0xFFFF
+            now = time.monotonic()
+            for e in [e for e, g in self._grace.items()
+                      if g[2] <= now]:
+                del self._grace[e]
+            pend = grace = None
+            if epoch == cur16:
+                if seq <= self._recv_seq:
+                    self.rejected += 1
+                    self.replays += 1
+                    raise DecryptError(
+                        f"replayed/reordered seq {seq}", "replay")
+                key = self._recv_key
+            elif self._pending is not None \
+                    and epoch == self._pending[0]:
+                # peer rotated first; we pre-installed its next
+                # epoch's recv key (prepare_recv) — its own replay
+                # window, handed to rotate() at the flip
+                pend = self._pending
+                if seq <= pend[2]:
+                    self.rejected += 1
+                    self.replays += 1
+                    raise DecryptError(
+                        f"replayed/reordered seq {seq} "
+                        f"(pending epoch {epoch})", "replay")
+                key = pend[1]
+            else:
+                grace = self._grace.get(epoch)
+                if grace is None:
+                    self.rejected += 1
+                    # 16-bit wraparound ordering: "ahead" means the
+                    # peer rotated first and we have not caught up yet
+                    if ((epoch - cur16) & 0xFFFF) < 0x8000:
+                        raise DecryptError(
+                            f"epoch {epoch} ahead of local {cur16} "
+                            "(peer rotated first?)", "epoch-ahead")
+                    raise DecryptError(
+                        f"epoch {epoch} != local {cur16} "
+                        "(grace expired?)", "epoch-old")
+                if seq <= grace[1]:
+                    self.rejected += 1
+                    self.replays += 1
+                    raise DecryptError(
+                        f"replayed/reordered seq {seq} "
+                        f"(grace epoch {epoch})", "replay")
+                key = grace[0]
         nonce = seq.to_bytes(8, "little") + b"\x00\x00\x00\x00"
         pt = crypto.aead_open(key, nonce, aad, frame[HDR.size:])
         if pt is None:
             with self._lock:
                 self.rejected += 1
-            raise DecryptError("authentication failed")
+            raise DecryptError("authentication failed", "auth")
         with self._lock:
             # accept AFTER authentication: a forged seq must not
-            # advance the replay window
-            if seq > self._recv_seq:
-                self._recv_seq = seq
+            # advance the replay window.  Re-resolve the window — a
+            # concurrent rotate may have moved this epoch to grace
+            # (or promoted the pending epoch to current).
+            if grace is not None:
+                if seq > grace[1]:
+                    grace[1] = seq
+            elif pend is not None and self._pending is pend:
+                if seq > pend[2]:
+                    pend[2] = seq
+            elif epoch == (self.epoch & 0xFFFF):
+                if seq > self._recv_seq:
+                    self._recv_seq = seq
+            elif epoch in self._grace:
+                g = self._grace[epoch]
+                if seq > g[1]:
+                    g[1] = seq
             self.opened += 1
         return pt
 
@@ -184,10 +314,12 @@ class EncryptionManager:
     ``refresh`` after node churn (or rely on lazy channel creation)."""
 
     def __init__(self, node_name: str, registry,
-                 key_path: Optional[str] = None, epoch: int = 0):
+                 key_path: Optional[str] = None, epoch: int = 0,
+                 keypair: Optional[NodeKeypair] = None):
         self.node_name = node_name
         self.registry = registry
-        self.keypair = NodeKeypair.load_or_create(key_path)
+        self.keypair = (keypair if keypair is not None
+                        else NodeKeypair.load_or_create(key_path))
         self.epoch = epoch
         self._channels: Dict[str, EncryptedChannel] = {}
         self._lock = threading.Lock()
@@ -216,13 +348,15 @@ class EncryptionManager:
         with self._lock:
             return self._channels.setdefault(node, ch)
 
-    def rotate(self, epoch: int) -> None:
+    def rotate(self, epoch: int, grace_s: float = 0.0) -> None:
         """Bump the key epoch for every channel (both sides must
-        rotate; frames sealed under the old epoch reject afterward)."""
+        rotate; with ``grace_s=0`` frames sealed under the old epoch
+        reject afterward, with a grace they keep opening until it
+        expires)."""
         with self._lock:
             self.epoch = epoch
             for ch in self._channels.values():
-                ch.rotate(epoch)
+                ch.rotate(epoch, grace_s)
 
     def drop(self, node: str) -> None:
         with self._lock:
@@ -235,6 +369,8 @@ class EncryptionManager:
                 "epoch": self.epoch,
                 "peers": {
                     n: {"sealed": c.sealed, "opened": c.opened,
-                        "rejected": c.rejected}
+                        "rejected": c.rejected,
+                        "replays": c.replays,
+                        "rotations": c.rotations}
                     for n, c in self._channels.items()},
             }

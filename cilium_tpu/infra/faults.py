@@ -108,6 +108,16 @@ SITE_CHURN_SWAP = "churn.swap"
 # hang stalls the pool so redirected tasks pile against the bounded
 # queue (shed accounting).
 SITE_L7_PARSE = "l7.parse"
+# encryption/__init__.py — the AEAD legs of the encrypted cluster
+# data channel.  ``crypto.seal`` fires in EncryptedChannel.seal just
+# before the AEAD: a raise on the parent's forward path drops the
+# frame BEFORE it reaches the wire (rows requeue through the window's
+# drop accounting, ledger exact).  ``crypto.open`` fires in
+# EncryptedChannel.open before verification: the frame arrived but
+# cannot be opened — the receiver must count it rejected and reply
+# with the typed crypto-reject record, never die.
+SITE_CRYPTO_SEAL = "crypto.seal"
+SITE_CRYPTO_OPEN = "crypto.open"
 
 SITES = frozenset({
     SITE_SERVING_DISPATCH,
@@ -123,6 +133,8 @@ SITES = frozenset({
     SITE_CHURN_BUILD,
     SITE_CHURN_SWAP,
     SITE_L7_PARSE,
+    SITE_CRYPTO_SEAL,
+    SITE_CRYPTO_OPEN,
 })
 
 

@@ -25,6 +25,9 @@ _build_failed = False
 
 
 def _so_path() -> str:
+    # hot-path-ok: one-time lazy .so fingerprint under _lock — the
+    # library handle is cached in _lib after the first load, so the
+    # transport's per-frame seal/open never re-enters this
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
     return os.path.join(_DIR, f"_crypto_{digest}.so")

@@ -67,6 +67,13 @@ KIND_NODE_SCALEOUT = "node-scaleout"
 # each slot's new owner (cluster/scale.py scale_in); recorded on a
 # SURVIVOR — the victim's recorder retires with it
 KIND_NODE_SCALEIN = "node-scalein"
+# an encrypted cluster channel hit CRYPTO_DESYNC_THRESHOLD
+# consecutive key-mismatch open failures (wrong peer key: AEAD auth
+# fails every frame, both directions) — the channel is broken toward
+# the router's requeue/failover path instead of hanging; recorded on
+# the WORKER over the (plaintext) control channel, the only leg a
+# desync cannot poison (cluster/process.py _note_open_failure)
+KIND_CRYPTO_DESYNC = "crypto-desync"
 # the map-pressure monitor (datapath/pressure.py) crossed a
 # threshold — CT occupancy, insert-drop rate, or NAT pool failures —
 # and entered the pressure state (one incident per episode; the

@@ -446,6 +446,28 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
               "rows queued in the router's forward queues "
               "(live at scrape time)",
               lambda: cl(lambda c: c.forward_pending()))
+    # -- encrypted data channel (ISSUE 18) ----------------------------
+    reg.counter("cilium_cluster_crypto_rejected_total",
+                "sealed cluster frames some channel end refused "
+                "(AEAD auth, replay, epoch skew, injected fault) — "
+                "each a counted NACK or parent-side open failure, "
+                "never a worker crash",
+                lambda: cl(lambda c: c.crypto_rejected_total()))
+    reg.counter("cilium_cluster_crypto_replays_total",
+                "sealed frames refused as REPLAYS specifically "
+                "(sequence already seen inside the epoch's replay "
+                "window)",
+                lambda: cl(lambda c: c.crypto_replays_total()))
+    reg.counter("cilium_cluster_crypto_rotations_total",
+                "cluster-wide key-epoch rotation operations "
+                "completed (rotate_epoch: kvstore-published, every "
+                "live channel re-keyed worker-first under grace)",
+                lambda: cl(lambda c: c.crypto_rotations_total()))
+    reg.counter("cilium_cluster_crypto_dropped_total",
+                "rows lost to crypto rejects (the ledger term "
+                "paired with crypto_rejected: every refused data "
+                "frame's rows land here, exact)",
+                lambda: cl(lambda c: c.crypto_dropped_total()))
 
     # -- fault-tolerance plane ----------------------------------------
     reg.counter("cilium_serving_restarts_total",

@@ -18,7 +18,8 @@ the CTA010 checker, ``analysis/scenario_lint.py``):
 - a ``name`` literal (the registry key / bench artifact key);
 - a ``criteria`` dict literal — the declared pass criteria
   (``ledger_exact``, ``max_shed_frac``, ``p99_ms``,
-  ``min_ct_insert_drops``, ``min_nat_failures``, ``min_drop_frac``;
+  ``min_ct_insert_drops``, ``min_nat_failures``, ``min_drop_frac``,
+  ``min_rotations``;
   unknown keys FAIL evaluation, so a typo'd criterion is loud);
 - a ``seed`` constructor parameter (same name+seed => byte-identical
   op/packet streams, proven per-entry by the determinism contract
@@ -40,7 +41,11 @@ Scenarios:
 - ``elephant_mice`` — Zipf flow popularity over a fixed flow pool,
   stressing the space-saving top-K sketches;
 - ``endpoint_churn`` — endpoints connecting/disconnecting (full
-  add_endpoint/remove regeneration churn) under live traffic.
+  add_endpoint/remove regeneration churn) under live traffic;
+- ``rotation_storm`` (ISSUE 18) — repeated cluster-wide key-epoch
+  rotations at a fixed cadence under mixed traffic, sweeping the
+  grace-window rotation-race interleavings on the encrypted data
+  channel (``cluster_ops = True``: ops target the cluster facade).
 """
 
 from __future__ import annotations
@@ -740,6 +745,117 @@ class EndpointChurnScenario(Scenario):
                        live)
 
 
+@dataclass(frozen=True)
+class RotateOp:
+    """One rotation-storm event: the ``n``-th cluster-wide key-epoch
+    bump, ``t_s`` seconds into the storm (ISSUE 18)."""
+
+    n: int
+    t_s: float
+
+
+class RotationStormScenario(Scenario):
+    """Repeated cluster-wide key-epoch rotations at a fixed cadence
+    under mixed SYN/ACK traffic (ISSUE 18): every op re-keys every
+    live encrypted channel WORKER-FIRST while sealed frames are in
+    flight, sweeping exactly the rotation-race interleavings the
+    previous-epoch grace window exists for.  The pass criteria are
+    the robustness core: the cluster ledger stays exact across every
+    seam (no frame lost or double-counted to an epoch boundary) and
+    at least ``min_rotations`` bumps actually landed — on a
+    plaintext or thread-mode target :meth:`apply` degrades to a
+    no-op, the rotation count stays 0, and the criterion fails
+    loudly instead of vacuously passing.  Declares
+    ``cluster_ops = True``: the op stream targets the CLUSTER facade
+    (``rotate_epoch``), not a node-local daemon, so the plain-daemon
+    driver ignores it and only the cluster leg rotates."""
+
+    name = "rotation_storm"
+    criteria = {"ledger_exact": True, "max_shed_frac": 0.95,
+                "min_rotations": 3}
+    path = "serving"
+    # ops apply against the ClusterServing facade, not a daemon
+    cluster_ops = True
+    daemon_overrides = {"serving_bucket_ladder": (256,),
+                        "serving_queue_depth": 1 << 14,
+                        "cluster_encrypt": True,
+                        "cluster_epoch_grace_s": 2.0}
+
+    def __init__(self, seed: int = 0, n_flows: int = 256,
+                 n_packets: int = 8192, batch: int = 256,
+                 rotations: int = 6, rate_hz: float = 8.0):
+        if n_flows < 1 or n_packets < 1 or batch < 1:
+            raise ValueError("n_flows/n_packets/batch must be >= 1")
+        if rotations < 1:
+            raise ValueError("rotations must be >= 1")
+        if rate_hz <= 0:
+            raise ValueError("rate_hz must be > 0")
+        self.seed = int(seed)
+        self.n_flows = int(n_flows)
+        self.n_packets = int(n_packets)
+        self.batch = int(batch)
+        self.rotations = int(rotations)
+        self.rate_hz = float(rate_hz)
+        self.interval_s = 1.0 / self.rate_hz
+        # paced submission: spread the batch stream across the whole
+        # storm so every rotation lands under LIVE mixed traffic —
+        # an unpaced stream drains in milliseconds and the seams
+        # would all fall on an idle pipeline
+        n_batches = (self.n_packets + self.batch - 1) // self.batch
+        self.pace_s = ((self.rotations + 1) * self.interval_s
+                       / max(n_batches, 1))
+
+    def setup(self, target) -> dict:
+        ep = target.add_endpoint("rs-srv", ("10.0.45.1",),
+                                 ["k8s:app=rs-srv"])
+        target.policy_import([{
+            "endpointSelector": {"matchLabels": {"app": "rs-srv"}},
+            "ingress": [{"fromEntities": ["world"]}],
+        }])
+        return {"ep": ep.id}
+
+    def iter_batches(self, ep: int) -> Iterator[np.ndarray]:
+        # mixed traffic: new-flow SYNs and established ACKs over a
+        # fixed pool, so rotation seams land between both shapes
+        rng = np.random.default_rng(self.seed)
+        dst = _ip("10.0.45.1")
+        sent = 0
+        while sent < self.n_packets:
+            n = min(self.batch, self.n_packets - sent)
+            flows = rng.integers(0, self.n_flows, n)
+            out = _rows(n)
+            out[:, COL_SRC_IP3] = (_ip("172.30.0.1")
+                                   + flows % 256).astype(np.uint32)
+            out[:, COL_SPORT] = (1024 + flows).astype(np.uint32)
+            out[:, COL_DST_IP3] = dst
+            out[:, COL_DPORT] = 443
+            out[:, COL_FLAGS] = np.where(
+                rng.random(n) < 0.25, TCP_SYN, TCP_ACK
+            ).astype(np.uint32)
+            out[:, COL_LEN] = rng.integers(60, 1500, n)
+            out[:, COL_EP] = ep
+            yield out
+            sent += n
+
+    def ops(self, n: Optional[int] = None) -> List[RotateOp]:
+        k = self.rotations if n is None else min(n, self.rotations)
+        return [RotateOp(n=i + 1, t_s=(i + 1) * self.interval_s)
+                for i in range(k)]
+
+    def apply(self, target, op: RotateOp, live: Dict) -> None:
+        rotate = getattr(target, "rotate_epoch", None)
+        if rotate is None:
+            return  # plain daemon: no cluster-wide epoch to bump
+        from ..serving import ServingError
+        try:
+            live.setdefault("epochs", []).append(rotate()["epoch"])
+        except ServingError:
+            # plaintext / thread-mode cluster: no keypair to rotate.
+            # Deliberately NOT counted — min_rotations then fails.
+            live["rotate_rejected"] = \
+                live.get("rotate_rejected", 0) + 1
+
+
 # -- the registry ------------------------------------------------------
 # name -> scenario class: every entry is runnable by name from tests,
 # the everything-on soak gate, and `bench.py --scenarios`, and must
@@ -753,6 +869,7 @@ SCENARIOS = {
     NatExhaustionScenario.name: NatExhaustionScenario,
     ElephantMiceScenario.name: ElephantMiceScenario,
     EndpointChurnScenario.name: EndpointChurnScenario,
+    RotationStormScenario.name: RotationStormScenario,
 }
 
 
@@ -845,6 +962,9 @@ def evaluate_criteria(criteria: Dict[str, object],
                 == bool(want)
         elif key == "min_l7_redirected":
             checks[key] = (metrics.get("l7_redirected", 0)
+                           >= int(want))
+        elif key == "min_rotations":
+            checks[key] = (metrics.get("rotations", 0)
                            >= int(want))
         else:
             checks[key] = False
@@ -1050,16 +1170,66 @@ def _run_scenario_cluster(cluster, scenario, *,
             tot = m if tot is None else tot + m
         return tot if tot is not None else np.zeros(1, np.int64)
 
+    # cluster-level op stream (ISSUE 18): scenarios that declare
+    # ``cluster_ops = True`` apply ops against the TIER facade
+    # (epoch rotations) on the daemon driver's capped-catch-up
+    # schedule.  Everything else keeps the historical contract:
+    # cluster legs drive traffic only, ops stay node-local.
+    cluster_ops = bool(getattr(scenario, "cluster_ops", False)) \
+        and scenario.interval_s > 0
+    ops = iter(scenario.ops(256) if cluster_ops else ())
+    live: Dict = {}
+    applied = 0
+    next_op = None
+
+    def tick_ops(elapsed: float) -> None:
+        nonlocal next_op, applied
+        if not cluster_ops:
+            return
+        if next_op is None:
+            next_op = elapsed
+        burst = 0
+        while next_op is not None and elapsed >= next_op \
+                and burst < 4:
+            try:
+                scenario.apply(cluster, next(ops), live)
+                applied += 1
+                burst += 1
+                next_op += scenario.interval_s
+            except StopIteration:
+                next_op = None
+        if next_op is not None and elapsed - next_op \
+                > 64 * scenario.interval_s:
+            next_op = elapsed  # drop an unservable backlog
+
+    pace_s = float(getattr(scenario, "pace_s", 0.0))
     p0 = pressures()
     m0 = metric_sums()
     t0 = time.perf_counter()
-    for b in scenario.iter_batches(ep):
+    for i, b in enumerate(scenario.iter_batches(ep)):
         cluster.submit(b)
+        tick_ops(time.perf_counter() - t0)
         # backpressure at the ROUTER: bounded forward queues are the
         # cluster-level admission point
         while cluster.forward_pending() > pending_cap:
             time.sleep(0.001)
+            tick_ops(time.perf_counter() - t0)
+        # paced submission (cluster_ops scenarios): hold the next
+        # batch until its slot so the op schedule interleaves with
+        # traffic instead of firing on a drained pipeline
+        while pace_s > 0 \
+                and time.perf_counter() - t0 < (i + 1) * pace_s:
+            time.sleep(0.002)
+            tick_ops(time.perf_counter() - t0)
+    # drain the remaining op schedule (bounded) before closing the
+    # ledger — a storm's declared op count is part of its contract
+    deadline = t0 + 30.0
+    while cluster_ops and next_op is not None \
+            and time.perf_counter() < deadline:
+        time.sleep(0.002)
+        tick_ops(time.perf_counter() - t0)
     st = cluster.stop()
+    scenario.drain(cluster, live)
     dt = max(time.perf_counter() - t0, 1e-9)
     led = st["ledger"]
     submitted = led["submitted"]
@@ -1088,7 +1258,8 @@ def _run_scenario_cluster(cluster, scenario, *,
             # own ledger (sums of exact ledgers are exact)
             l7_exact = l7_exact and bool(nl7.get("ledger-exact"))
     shed_all = (shed + led["router-overflow"]
-                + led["failover-dropped"] + led["crash-dropped"])
+                + led["failover-dropped"] + led["crash-dropped"]
+                + led.get("crypto-dropped", 0))
     p1 = pressures()
     m1 = metric_sums()
     reason_delta = (m1 - m0) if len(m1) == len(m0) else m1
@@ -1112,8 +1283,8 @@ def _run_scenario_cluster(cluster, scenario, *,
         "sustained_pps": round(verdicts / dt, 1),
         "p99_us": p99,
         "ledger_exact": bool(led["exact"]),
-        "ops_applied": 0,  # op streams are node-local control-plane
-        # work; cluster legs drive traffic only
+        "ops_applied": applied,  # non-zero only for cluster_ops
+        # scenarios; node-local op streams stay traffic-only here
         "ct_insert_drops": (psum(p1, "ct", "insert-drops")
                             - psum(p0, "ct", "insert-drops")),
         "ct_occupancy": max(
@@ -1133,12 +1304,17 @@ def _run_scenario_cluster(cluster, scenario, *,
         "l7_shed": l7_sums["l7-shed"],
         "l7_failed": l7_sums["l7-failed"],
         "l7_ledger_exact": bool(l7_seen and l7_exact),
+        # epoch rotations that LANDED (len(cluster._rotations) via
+        # the facade counter) — the min_rotations criterion's input
+        "rotations": int(getattr(cluster, "crypto_rotations_total",
+                                 lambda: 0)()),
         "cluster": {
             "mode": cluster.mode,
             "nodes": len(cluster.nodes),
             "router_overflow": led["router-overflow"],
             "failover_dropped": led["failover-dropped"],
             "crash_dropped": led["crash-dropped"],
+            "crypto_dropped": led.get("crypto-dropped", 0),
         },
     }
     checks = evaluate_criteria(scenario.criteria, metrics)

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Encryption key-hygiene check: private keys and derived session
+keys never reach a log call, an incident payload, a serializer, an
+operator-visible bundle surface, or the exposition modules.
+
+THIN SHIM: the implementation lives in the static-analysis package
+(``cilium_tpu.analysis.crypto_lint``, checker CTA013) and runs on
+every analysis pass / tier-1 run.  This script keeps a standalone
+CLI (the check_cluster_ledger idiom).
+
+Usage::
+
+    python scripts/check_crypto_keys.py    # repo pass
+
+Exit status 0 = clean; 1 = violations (one per line).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cilium_tpu.analysis.crypto_lint import check  # noqa: E402
+
+
+def main(argv=None) -> int:
+    from cilium_tpu.analysis import Repo, repo_root
+
+    bad = [f.render() for f in check(Repo(repo_root()))]
+    if bad:
+        print("crypto key-hygiene check FAILED:", file=sys.stderr)
+        for b in bad:
+            print("  " + b, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,8 @@ class TestRegistry:
     def test_every_planned_scenario_is_registered(self):
         for name in ("identity_churn", "syn_flood", "port_scan",
                      "nat_exhaustion", "elephant_mice",
-                     "endpoint_churn", "l7_abuse"):
+                     "endpoint_churn", "l7_abuse",
+                     "rotation_storm"):
             assert name in SCENARIOS, name
 
     def test_unknown_name_lists_registry(self):

@@ -669,7 +669,11 @@ class TestFoldedCheckers:
                 '    "cilium_cluster_router_overflow_total",\n'
                 '    "cilium_cluster_inflight_frames",\n'
                 '    "cilium_cluster_acks_coalesced_total",\n'
-                '    "cilium_cluster_window_stalls_total")',
+                '    "cilium_cluster_window_stalls_total",\n'
+                '    "cilium_cluster_crypto_rejected_total",\n'
+                '    "cilium_cluster_crypto_replays_total",\n'
+                '    "cilium_cluster_crypto_rotations_total",\n'
+                '    "cilium_cluster_crypto_dropped_total")',
             "datapath/verdict.py": "REASON_CLUSTER_OVERFLOW = 12",
             "monitor/api.py": "DROP_REASON_NAMES = {12: 'x'}",
             "flow/flow.py": "DROP_REASON_DESC = {12: 'X'}",
@@ -971,3 +975,99 @@ class TestSurfacedFixRegressions:
         assert q.pending == 4
         rows, _ = q.take(4)
         assert len(rows) == 4 and q.pending == 0
+
+
+# ---------------------------------------------------------------------
+# CTA013 crypto-hygiene
+# ---------------------------------------------------------------------
+class TestCryptoHygiene:
+    def test_key_material_in_sinks_flags(self, tmp_path):
+        from cilium_tpu.analysis import crypto_lint
+
+        repo = _mini_repo(tmp_path, {"m.py": """
+            import json
+            import logging
+
+            log = logging.getLogger(__name__)
+
+            def leak_log(kp):
+                log.info("key is %s", kp.private)
+
+            def leak_incident(rec, ch):
+                rec.record_incident("x", {"k": ch._send_key})
+
+            def leak_json(kp):
+                return json.dumps({"private": kp.private.hex()})
+
+            def leak_write(path, kp):
+                with open(path, "wb") as f:
+                    f.write(kp.private)
+            """})
+        found = crypto_lint.check(repo)
+        msgs = "\n".join(f.message for f in found)
+        assert len(found) == 4, found
+        assert "log call" in msgs
+        assert "incident payload" in msgs
+        assert "serializer" in msgs
+        assert "written/sent" in msgs
+
+    def test_surface_functions_and_sealed_modules_flag(self, tmp_path):
+        from cilium_tpu.analysis import crypto_lint
+
+        repo = _mini_repo(tmp_path, {
+            "w.py": """
+            def _crypto_block(self):
+                return {"epoch": self.ch.epoch,
+                        "key": self.ch._recv_key.hex()}
+
+            def my_sysdump_collect(self):
+                return {"wg": self.kp.private}
+            """,
+            "obs/registry.py": """
+            from ..encryption import NodeKeypair
+
+            def series(kp):
+                return kp.private
+            """})
+        found = crypto_lint.check(repo)
+        assert len(found) == 4, found
+        surfaces = [f for f in found if f.path == "cilium_tpu/w.py"]
+        assert len(surfaces) == 2
+        assert all("operator-visible surface" in f.message
+                   for f in surfaces)
+        sealed = [f for f in found
+                  if f.path == "cilium_tpu/obs/registry.py"]
+        assert len(sealed) == 2
+        assert any("imports from the encryption" in f.message
+                   for f in sealed)
+
+    def test_counters_only_surfaces_and_suppression_pass(
+            self, tmp_path):
+        from cilium_tpu.analysis import crypto_lint
+
+        repo = _mini_repo(tmp_path, {"m.py": """
+            import logging
+
+            log = logging.getLogger(__name__)
+
+            def _crypto_block(self):
+                ch = self._crypto
+                return {"epoch": ch.epoch, "sealed": ch.sealed,
+                        "rejected": ch.rejected}
+
+            def fine(kp):
+                # the PUBLIC key is exempt by design
+                log.info("pub %s", kp.public.hex())
+
+            def waived(kp):
+                log.debug(
+                    "dbg %s",
+                    kp.private)  # lint: disable=CTA013 -- test rig
+            """})
+        assert crypto_lint.check(repo) == []
+
+    def test_live_repo_is_clean(self):
+        from cilium_tpu.analysis import crypto_lint
+
+        assert [f.render()
+                for f in crypto_lint.check(Repo(REPO))] == []

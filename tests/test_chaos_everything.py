@@ -305,3 +305,38 @@ class TestEverythingOnSoak:
             assert episodes >= 1
         finally:
             c.shutdown()
+
+    def test_rotation_storm_encrypted_cluster_leg_in_soak(self):
+        """ISSUE 18 satellite: the rotation_storm scenario in the
+        soak composition — an ENCRYPTED process-mode cluster serving
+        mixed traffic while the driver fires repeated cluster-wide
+        ``rotate_epoch`` bumps on the scenario's cadence.  Every
+        rotation must land (min_rotations), the cluster ledger must
+        close exact across every epoch seam, and nothing may reach
+        crypto_dropped on a healthy (fault-free) run."""
+        from cilium_tpu.cluster.process import spawn_available
+        from cilium_tpu.testing.workloads import (run_scenario,
+                                                  scenario_cluster)
+
+        if not spawn_available():
+            pytest.skip("no usable multiprocessing start method")
+        sc = make_scenario("rotation_storm", seed=18,
+                           n_packets=8192, rotations=6)
+        c, ctx = scenario_cluster(sc, nodes=2, mode="process",
+                                  cluster_kvstore="remote",
+                                  cluster_encrypt=True,
+                                  cluster_probe_interval_s=0.1,
+                                  cluster_obs_interval_s=0.0,
+                                  serving_restart_backoff_ms=1.0)
+        try:
+            r = run_scenario(c, sc, ctx=ctx)
+            assert r["passed"], r["checks"]
+            m = r["metrics"]
+            assert m["ledger_exact"]
+            assert m["rotations"] >= 6, m
+            assert m["cluster"]["crypto_dropped"] == 0, m
+            # the storm actually rode the crypto plane: the facade's
+            # epoch advanced once per landed rotation
+            assert c.epoch == m["rotations"]
+        finally:
+            c.shutdown()

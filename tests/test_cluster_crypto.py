@@ -1,0 +1,627 @@
+"""Encrypted cluster data channel (ISSUE 18): sealed frames over the
+credit window, live epoch rotation under chaos.
+
+Unit halves (no cluster build):
+- the rotation grace window (satellite 1): a frame sealed under the
+  OUTGOING epoch still opens within ``grace_s`` of the flip, with a
+  per-epoch replay window — the regression pin for the in-flight
+  frame-loss bug the hard epoch-equality reject caused;
+- transport frame fuzz (satellite 2): truncated / bit-flipped /
+  oversized / replayed sealed frames against ``LineFramer``,
+  ``decode_rows`` and ``EncryptedChannel.open`` — every mutation is
+  a TYPED error (``DecryptError``/``FrameError``), counted, and the
+  channel keeps serving afterward;
+- the typed crypto-reject record codec + the seeded
+  ``crypto.seal``/``crypto.open`` fault sites.
+
+Cluster halves (process mode, one worker build each):
+- the ROTATION CHAOS GATE: an encrypted 2-node cluster serving over
+  the pipelined credit window with a seeded worker-side open fault,
+  an injected replay, ``rotate_epoch`` racing live submit load (zero
+  loss, zero survivor recompiles), a scale-out join at the current
+  epoch, and a SIGKILL concurrent with a rotation — the cluster
+  ledger closes EXACTLY with every undecryptable frame's rows
+  counted ``crypto_dropped``;
+- the KEY-DESYNC leg (sync protocol): a wrong peer pubkey turns
+  into counted rejects, a ``crypto-desync`` incident and a
+  fast-failing broken channel — never a hang, never silent loss.
+
+Named to sort early (the tier-1 budget-truncation convention).
+Cost discipline: worker processes pay their own jax init, so each
+cluster class runs ONE lifecycle and proves its legs inside it."""
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cilium_tpu.cluster.transport import (
+    CRYPTO_REJECT_KIND,
+    CRYPTO_REJECT_REASONS,
+    CRYPTO_REJECT_SIZE,
+    FrameError,
+    LineFramer,
+    MAX_FRAME,
+    decode_rows,
+    decode_rows_seq,
+    encode_rows,
+    is_crypto_reject,
+    pack_ack,
+    pack_crypto_reject,
+    pack_cum_ack,
+    recv_frame,
+    unpack_crypto_reject,
+)
+from cilium_tpu.encryption import (
+    GRACE_MAX,
+    PUBKEY_FIELD,
+    DecryptError,
+    EncryptedChannel,
+    EncryptionManager,
+    NodeKeypair,
+)
+from cilium_tpu.infra import faults
+
+
+def _pair(epoch: int = 0):
+    """A connected channel pair: (a->b, b->a) over fresh keypairs."""
+    a, b = NodeKeypair(), NodeKeypair()
+    return (EncryptedChannel(a, b.public, epoch),
+            EncryptedChannel(b, a.public, epoch))
+
+
+# ---------------------------------------------------------------------
+class TestRotationGraceWindow:
+    """Satellite 1: the bounded previous-epoch grace window that
+    replaced the hard epoch-equality reject."""
+
+    def test_in_flight_frame_sealed_pre_rotation_opens_post(self):
+        """THE regression pin: a frame sealed just before a rotation
+        must still open just after it (both sides rotated, grace
+        armed) — the old behavior rejected it outright, losing every
+        row that was on the wire at the flip."""
+        tx, rx = _pair()
+        in_flight = tx.seal(b"rows on the wire at the flip")
+        tx.rotate(1, grace_s=5.0)
+        rx.rotate(1, grace_s=5.0)
+        assert rx.open(in_flight) == b"rows on the wire at the flip"
+        # and the NEW epoch serves (fresh key, seq space restarted)
+        assert rx.open(tx.seal(b"epoch 1")) == b"epoch 1"
+        assert rx.rejected == 0
+
+    def test_zero_grace_preserves_the_strict_reject(self):
+        tx, rx = _pair()
+        in_flight = tx.seal(b"x")
+        tx.rotate(1)
+        rx.rotate(1)  # grace_s defaults to 0: strict
+        with pytest.raises(DecryptError) as ei:
+            rx.open(in_flight)
+        assert ei.value.reason == "epoch-old"
+        assert rx.rejected == 1
+
+    def test_grace_expiry_rejects_epoch_old(self):
+        tx, rx = _pair()
+        stale = tx.seal(b"stale")
+        tx.rotate(1, grace_s=0.05)
+        rx.rotate(1, grace_s=0.05)
+        time.sleep(0.1)
+        with pytest.raises(DecryptError) as ei:
+            rx.open(stale)
+        assert ei.value.reason == "epoch-old"
+
+    def test_per_epoch_replay_windows(self):
+        """Each grace epoch keeps ITS OWN replay window: an old-epoch
+        frame opens once and only once, and the new epoch's restarted
+        sequence space is not shadowed by the old epoch's highs."""
+        tx, rx = _pair()
+        rx.open(tx.seal(b"e0 s1"))
+        f2 = tx.seal(b"e0 s2")
+        tx.rotate(1, grace_s=5.0)
+        rx.rotate(1, grace_s=5.0)
+        assert rx.open(f2) == b"e0 s2"  # in-flight across the flip
+        with pytest.raises(DecryptError) as ei:
+            rx.open(f2)  # replayed old-epoch frame
+        assert ei.value.reason == "replay"
+        assert rx.replays == 1
+        # new epoch seq restarts at 1 — NOT rejected as replay even
+        # though the superseded epoch already accepted seq 2
+        assert rx.open(tx.seal(b"e1 s1")) == b"e1 s1"
+
+    def test_peer_rotated_first_is_epoch_ahead(self):
+        tx, rx = _pair()
+        tx.rotate(1, grace_s=5.0)
+        with pytest.raises(DecryptError) as ei:
+            rx.open(tx.seal(b"from the future"))
+        assert ei.value.reason == "epoch-ahead"
+
+    def test_prepared_recv_opens_the_ack_direction_gap(self):
+        # the wedge regression (caught by the bench SIGKILL-mid-
+        # rotation leg): worker-first rotation means the worker can
+        # seal a cumulative ack at e+1 BEFORE the parent's channel
+        # rotates.  prepare_recv pre-installs e+1's recv key, so
+        # that ack opens instead of being discarded — a discarded
+        # full-window ack would never return the credit (wedged
+        # channel, stop-sweep double count).
+        tx, rx = _pair()  # tx = worker's channel, rx = parent's
+        rx.prepare_recv(1)           # parent phase 1
+        tx.rotate(1, grace_s=5.0)    # worker rotates + acks
+        gap_ack = tx.seal(b"cum-ack sealed in the gap")
+        assert rx.open(gap_ack) == b"cum-ack sealed in the gap"
+        assert rx.rejected == 0
+        # a replay of the gap frame is caught by the pending window
+        with pytest.raises(DecryptError) as ei:
+            rx.open(gap_ack)
+        assert ei.value.reason == "replay"
+        rx.rotate(1, grace_s=5.0)    # parent phase 3: promote
+        # the pending replay window carried over the flip — the gap
+        # frame stays unreplayable at the now-current epoch
+        with pytest.raises(DecryptError) as ei:
+            rx.open(gap_ack)
+        assert ei.value.reason == "replay"
+        # and ordinary post-rotation traffic flows both ways
+        assert rx.open(tx.seal(b"after")) == b"after"
+        assert tx.open(rx.seal(b"data")) == b"data"
+
+    def test_stale_prepare_dies_at_the_next_rotation(self):
+        # a prepare whose rotation never completed (node crashed
+        # mid-op) must not leave a forever-open recv epoch behind
+        tx, rx = _pair()
+        rx.prepare_recv(1)
+        rx.rotate(2, grace_s=0.0)    # rotation skipped past it
+        assert rx._pending is None
+        tx.rotate(1, grace_s=0.0)
+        with pytest.raises(DecryptError) as ei:
+            rx.open(tx.seal(b"stale epoch"))
+        assert ei.value.reason == "epoch-old"
+
+    def test_grace_state_is_bounded(self):
+        tx, rx = _pair()
+        for e in range(1, GRACE_MAX + 4):
+            rx.rotate(e, grace_s=60.0)
+        assert len(rx._grace) <= GRACE_MAX
+
+
+# ---------------------------------------------------------------------
+class TestTransportFrameFuzz:
+    """Satellite 2: hostile bytes against every wire layer — typed
+    errors, counted, the channel/framer survives."""
+
+    def test_sealed_frame_mutations_are_typed_and_survivable(self):
+        rng = np.random.default_rng(18)
+        tx, rx = _pair()
+        reasons = set()
+        for i in range(96):
+            frame = bytearray(tx.seal(b"payload-%d" % i))
+            mode = i % 3
+            if mode == 0:  # truncate
+                frame = frame[:int(rng.integers(0, len(frame)))]
+            elif mode == 1:  # flip one bit
+                pos = int(rng.integers(0, len(frame)))
+                frame[pos] ^= 1 << int(rng.integers(0, 8))
+            else:  # extend with junk
+                frame += bytes(rng.integers(0, 256, 7, dtype=np.uint8))
+            with pytest.raises(DecryptError) as ei:
+                rx.open(bytes(frame))
+            assert ei.value.reason in (
+                "short", "magic", "epoch-old", "epoch-ahead",
+                "replay", "auth"), ei.value.reason
+            reasons.add(ei.value.reason)
+        # the fuzz actually exercised more than one reject class
+        assert len(reasons) >= 2, reasons
+        # rejections were COUNTED ("short" precedes the counters by
+        # design — it never reached the header parse)
+        assert rx.rejected > 0
+        # ...and the channel still serves: no forged frame advanced
+        # the replay window or corrupted receive state
+        assert rx.open(tx.seal(b"still alive")) == b"still alive"
+        assert rx.open(tx.seal(b"and ordered")) == b"and ordered"
+
+    def test_replayed_sealed_frame_rejected_channel_survives(self):
+        tx, rx = _pair()
+        f = tx.seal(b"once")
+        assert rx.open(f) == b"once"
+        with pytest.raises(DecryptError) as ei:
+            rx.open(f)
+        assert ei.value.reason == "replay"
+        assert rx.replays == 1
+        assert rx.open(tx.seal(b"next")) == b"next"
+
+    def test_decode_rows_rejects_torn_and_oversized_loudly(self):
+        payload = encode_rows(
+            np.arange(32, dtype=np.uint32).reshape(8, 4),
+            packed_meta=(3, 0), seq=7)
+        rows, meta, _trace, seq = decode_rows_seq(payload)
+        assert meta == (3, 0) and seq == 7
+        # torn at every prefix length: FrameError, never ValueError
+        rng = np.random.default_rng(7)
+        for cut in rng.integers(0, len(payload), 16):
+            if int(cut) == len(payload):
+                continue
+            with pytest.raises(FrameError):
+                decode_rows(payload[:int(cut)])
+        # declared shape bigger than the body: loud, no allocation
+        # of the declared size
+        forged = bytearray(payload)
+        forged[1:5] = (1 << 30).to_bytes(4, "big")  # n = 2**30
+        with pytest.raises(FrameError):
+            decode_rows(bytes(forged))
+
+    def test_recv_frame_rejects_oversized_declared_length(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall((MAX_FRAME + 1).to_bytes(4, "big") + b"x")
+            with pytest.raises(FrameError, match="max_frame"):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_lineframer_reassembles_fuzzed_chunking(self):
+        rng = np.random.default_rng(21)
+        lines = [b"line-%d" % i for i in range(64)]
+        stream = b"\n".join(lines) + b"\n"
+        for _ in range(8):
+            fr = LineFramer()
+            got = []
+            i = 0
+            while i < len(stream):
+                n = int(rng.integers(1, 17))
+                got.extend(fr.feed(stream[i:i + n]))
+                i += n
+            assert got == lines
+            assert fr.pending == 0
+
+
+# ---------------------------------------------------------------------
+class TestCryptoRejectRecord:
+    """The 13-byte typed NACK that makes a decrypt failure a counted,
+    flow-visible drop instead of a worker crash."""
+
+    def test_roundtrip_every_reason(self):
+        for reason in CRYPTO_REJECT_REASONS:
+            rec = pack_crypto_reject(41, reason)
+            assert len(rec) == CRYPTO_REJECT_SIZE
+            assert is_crypto_reject(rec)
+            assert unpack_crypto_reject(rec) == (41, reason)
+
+    def test_unknown_reason_codes_as_other(self):
+        rec = pack_crypto_reject(1, "no-such-reason")
+        assert unpack_crypto_reject(rec) == (1, "other")
+        # a wire code past the table decodes "other", never raises
+        forged = bytearray(rec)
+        forged[-1] = 250
+        assert unpack_crypto_reject(bytes(forged)) == (1, "other")
+
+    def test_never_collides_with_ack_payloads(self):
+        legacy = pack_ack(1, 2, 3, 4, 5)
+        cum = pack_cum_ack(9, 1, 128, 128, 128, 0, 0)
+        for payload in (legacy, cum):
+            assert not is_crypto_reject(payload)
+        assert not is_crypto_reject(b"")
+        assert not is_crypto_reject(
+            bytes([CRYPTO_REJECT_KIND]) * (CRYPTO_REJECT_SIZE - 1))
+        with pytest.raises(FrameError):
+            unpack_crypto_reject(legacy)
+
+
+# ---------------------------------------------------------------------
+class TestSeededCryptoFaultSites:
+    """The ``crypto.seal`` / ``crypto.open`` fault sites: armed specs
+    fire as :class:`InjectedFault` inside the channel, and disarm
+    restores clean service."""
+
+    def test_seal_and_open_sites_fire_then_clear(self):
+        tx, rx = _pair()
+        inj = faults.arm("crypto.seal=1x1", seed=3)
+        try:
+            with pytest.raises(faults.InjectedFault) as ei:
+                tx.seal(b"doomed")
+            assert ei.value.site == faults.SITE_CRYPTO_SEAL
+            frame = tx.seal(b"after the fault")  # x1 consumed
+        finally:
+            faults.disarm(inj)
+        inj = faults.arm("crypto.open=1x1", seed=3)
+        try:
+            with pytest.raises(faults.InjectedFault):
+                rx.open(frame)
+        finally:
+            faults.disarm(inj)
+        # the frame itself was never consumed: it still opens
+        assert rx.open(frame) == b"after the fault"
+
+    def test_rotate_epoch_op_carries_a_timeout_bound(self):
+        """The worker-side ``rotate_epoch`` control op must keep a
+        positive RPC timeout (CTA011): a rotation against a wedged
+        worker degrades into a counted failure, never an unbounded
+        wait that parks probes behind it."""
+        from cilium_tpu.cluster.nodehost import OP_TIMEOUTS, _NodeHost
+        assert "rotate_epoch" in _NodeHost._OPS
+        assert OP_TIMEOUTS["rotate_epoch"] > 0
+
+    def test_advertise_publishes_pubkey_hex(self):
+        mgr = EncryptionManager("node-x", registry=None,
+                                keypair=NodeKeypair())
+        info = mgr.advertise({"name": "node-x"})
+        assert info[PUBKEY_FIELD] == mgr.keypair.public.hex()
+        assert bytes.fromhex(info[PUBKEY_FIELD]) \
+            == mgr.keypair.public
+
+
+# ---------------------------------------------------------------------
+# the cluster halves (process mode)
+from cilium_tpu.agent import DaemonConfig  # noqa: E402
+from cilium_tpu.cluster import ClusterServing  # noqa: E402
+from cilium_tpu.cluster.process import spawn_available  # noqa: E402
+from cilium_tpu.core import TCP_ACK, make_batch  # noqa: E402
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "srv"}},
+    "ingress": [{"fromEntities": ["world"]}],
+}]
+
+
+def _config(**over):
+    cfg = dict(backend="tpu", ct_capacity=1 << 12,
+               flow_ring_capacity=1 << 13,
+               serving_queue_depth=4096,
+               serving_bucket_ladder=(64,),
+               serving_max_wait_us=500.0,
+               serving_restart_backoff_ms=1.0,
+               cluster_probe_interval_s=0.1,
+               cluster_death_threshold=2,
+               cluster_forward_depth=8192,
+               cluster_mode="process",
+               cluster_obs_interval_s=0.0,
+               cluster_encrypt=True,
+               cluster_epoch_grace_s=2.0)
+    cfg.update(over)
+    return DaemonConfig(**cfg)
+
+
+def _fwd(ep_id, n=128, base=20000):
+    return make_batch([
+        dict(src="10.0.1.1", dst="10.0.2.1", sport=base + i,
+             dport=443, proto=6, flags=TCP_ACK, ep=ep_id, dir=0)
+        for i in range(n)]).data
+
+
+def _wait(pred, timeout=60.0, tick=0.01):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+@pytest.mark.chaos
+@pytest.mark.cluster
+@pytest.mark.skipif(not spawn_available(),
+                    reason="multiprocessing 'spawn' unavailable")
+class TestEncryptedClusterRotationGate:
+    """The ISSUE 18 rotation chaos gate (tier-1): ONE encrypted
+    2-node process cluster proving, in order — sealed serving with a
+    seeded worker-side ``crypto.open`` fault (counted
+    ``crypto_dropped``, never a worker crash), an injected replay, a
+    ``rotate_epoch`` race against live submit load (zero loss, zero
+    survivor recompiles), a scale-out join at the current epoch, and
+    a SIGKILL concurrent with a rotation — with the cluster-wide
+    ledger closing EXACTLY."""
+
+    def test_rotate_epoch_chaos_ledger_exact(self):
+        c = ClusterServing(nodes=2, config=_config(
+            # each worker's 3rd data-frame open fires once: the
+            # seeded crypto fault leg (reason "fault" on the NACK)
+            fault_injection="crypto.open=1x1@2", fault_seed=18))
+        try:
+            srv = c.add_endpoint("srv", ("10.0.2.1",),
+                                 ["k8s:app=srv"])
+            rev = c.policy_import(RULES)
+            assert c.wait_policy(rev, timeout=30)
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            # the spawn handshake advertised a real worker pubkey,
+            # distinct from the parent's
+            parent_pub = c._crypto_kp.public.hex()
+            for n in c.nodes:
+                assert len(n.peer_pub_hex) == 64
+                assert n.peer_pub_hex != parent_pub
+
+            # -- (a) sealed serving + the seeded worker open fault --
+            sent = 0
+            for k in range(6):
+                c.submit(_fwd(srv.id, base=20000 + 128 * k))
+                sent += 128
+            assert _wait(lambda: c.forward_pending() == 0)
+            for n in c.nodes:
+                assert n.drain_window()
+            assert _wait(lambda: (
+                c.ledger()["per-node-accounted"]
+                + c.ledger()["crypto-dropped"]) >= sent)
+            led = c.ledger()
+            # both workers' armed fault fired: undecryptable frames
+            # became counted, flow-visible drops — not crashes (both
+            # workers are still alive and serving)
+            assert led["crypto-dropped"] > 0
+            assert c.crypto_rejected_total() >= 2
+            assert not c.membership.dead_nodes()
+            for n in c.nodes:
+                cb = n.transport_stats()["crypto"]
+                assert cb["sealed"] > 0 and cb["epoch"] == 0
+                wc = n.worker_crypto()
+                assert wc is not None and wc["rx-frames"] > 0
+
+            # -- (b) replay injection on the quiesced channel -------
+            assert c.nodes[0].inject_replay()
+            assert _wait(lambda: c.crypto_replays_total() >= 1)
+            drops_after_a = c.crypto_dropped_total()
+
+            # -- (c) rotate_epoch racing live submit load: zero
+            # rows lost to any epoch seam, zero survivor recompiles -
+            compiles0 = {n.name: n.dispatch_compiles()
+                         ["dispatch_compiles"] for n in c.nodes}
+            stop_load = threading.Event()
+            load_sent = [0]
+
+            def load():
+                k = 0
+                while not stop_load.is_set():
+                    c.submit(_fwd(srv.id,
+                                  base=30000 + 128 * (k % 64)))
+                    load_sent[0] += 128
+                    k += 1
+                    time.sleep(0.005)
+
+            th = threading.Thread(target=load)
+            th.start()
+            try:
+                for want in (1, 2):
+                    time.sleep(0.05)
+                    res = c.rotate_epoch()
+                    assert res["epoch"] == want
+                    assert sorted(res["acked"]) == ["node0", "node1"]
+            finally:
+                stop_load.set()
+                th.join()
+            sent += load_sent[0]
+            assert c.epoch == 2
+            assert _wait(lambda: c.forward_pending() == 0)
+            for n in c.nodes:
+                assert n.drain_window()
+            assert _wait(lambda: (
+                c.ledger()["per-node-accounted"]
+                + c.ledger()["crypto-dropped"]) >= sent)
+            # the robustness core: rotation under load lost NOTHING
+            # (in-flight old-epoch frames opened through the grace
+            # window on both halves)
+            assert c.crypto_dropped_total() == drops_after_a, \
+                "rotation lost rows"
+            assert c.crypto_rotations_total() == 2
+            for n in c.nodes:
+                assert n.transport_stats()["crypto"]["epoch"] == 2
+            compiles1 = {n.name: n.dispatch_compiles()
+                         ["dispatch_compiles"] for n in c.nodes}
+            assert compiles1 == compiles0, (
+                "epoch rotation must never recompile a serving "
+                "executable", compiles0, compiles1)
+
+            # -- (d) scale-out joins at the CURRENT epoch -----------
+            c.add_node()
+            joiner = c.nodes[-1]
+            assert joiner.name == "node2"
+            assert joiner.transport_stats()["crypto"]["epoch"] == 2
+            c.submit(_fwd(srv.id, base=52000))
+            sent += 128
+            assert _wait(lambda: c.forward_pending() == 0)
+
+            # -- (e) SIGKILL concurrent with a rotation -------------
+            victim = c.nodes[1]
+            killer = threading.Thread(
+                target=lambda: (time.sleep(0.002),
+                                victim.proc.kill()))
+            killer.start()
+            c.rotate_epoch()  # the victim's ack may fail: tolerated
+            killer.join()
+            assert c.epoch == 3
+            t0 = time.monotonic()
+            k = 0
+            while not c.membership.dead_nodes():
+                c.submit(_fwd(srv.id, base=60000 + 128 * k))
+                sent += 128
+                k += 1
+                assert time.monotonic() - t0 < 60, "death undetected"
+                time.sleep(0.02)
+            assert c.membership.dead_nodes() == ["node1"]
+            assert _wait(lambda: c.failovers_total() == 1)
+            # survivors carry the post-kill epoch
+            for n in c.nodes:
+                if n.alive:
+                    assert n.transport_stats()["crypto"]["epoch"] \
+                        == 3
+
+            # -- close the ledger: exact, crypto drops included -----
+            assert _wait(lambda: c.forward_pending() == 0)
+            st = c.stop()
+            led = st["ledger"]
+            assert led["exact"], led
+            assert led["submitted"] == sent
+            assert led["crypto-dropped"] == c.crypto_dropped_total()
+            assert st["cluster"]["crypto"]["epoch"] == 3
+            assert st["cluster"]["crypto"]["rotations"] == 3
+        finally:
+            c.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.cluster
+@pytest.mark.skipif(not spawn_available(),
+                    reason="multiprocessing 'spawn' unavailable")
+class TestKeyDesyncContainment:
+    """A wrong peer pubkey (key desync) on the sync protocol: every
+    frame seals fine locally but neither direction can open — the
+    channel must degrade to counted rejects, a ``crypto-desync``
+    incident, and fast-failing submits.  Never a hang, never a
+    worker crash, ledger exact."""
+
+    def test_wrong_pubkey_counted_incident_no_hang(self):
+        c = ClusterServing(nodes=2, config=_config(
+            cluster_forward_window=1))  # sync protocol
+        try:
+            srv = c.add_endpoint("srv", ("10.0.2.1",),
+                                 ["k8s:app=srv"])
+            rev = c.policy_import(RULES)
+            assert c.wait_policy(rev, timeout=30)
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            sent = 0
+            for k in range(2):
+                c.submit(_fwd(srv.id, base=20000 + 128 * k))
+                sent += 128
+            assert _wait(lambda: c.forward_pending() == 0)
+            assert _wait(lambda:
+                         c.ledger()["per-node-accounted"] >= sent)
+
+            # -- desync node1: re-key the PARENT half against a key
+            # the worker does not hold; push the sequence space past
+            # the worker's replay window so the reject class is the
+            # key-mismatch one ("auth"), not "replay"
+            mark = c.nodes[1]
+            rej0 = c.crypto_rejected_total()
+            mark.enable_crypto(c._crypto_kp, NodeKeypair().public,
+                               grace_s=2.0, epoch=c.epoch)
+            mark._crypto._send_seq = 1 << 20
+            t0 = time.monotonic()
+            k = 0
+            while mark._win_broken is None \
+                    and time.monotonic() - t0 < 30:
+                c.submit(_fwd(srv.id, base=40000 + 128 * (k % 64)))
+                sent += 128
+                k += 1
+                time.sleep(0.02)
+            # contained: the channel BROKE (fast-fail), with the
+            # failures counted and the incident recorded — no hang,
+            # and the worker is still alive (a desync is the
+            # parent's problem to surface, not a worker crash)
+            assert mark._win_broken == "crypto-desync"
+            assert c.crypto_rejected_total() > rej0
+            assert mark.alive and mark.probe()
+            incs = (mark.obs_scrape() or {}).get("incidents") or []
+            assert any("crypto-desync" in str(i) for i in incs), incs
+            # submits against the broken channel fail FAST (the
+            # forwarder requeues; nothing blocks on the dead keys)
+            t1 = time.monotonic()
+            c.submit(_fwd(srv.id, base=59000))
+            sent += 128
+            assert time.monotonic() - t1 < 5.0
+
+            st = c.stop()
+            led = st["ledger"]
+            assert led["exact"], led
+            assert led["submitted"] == sent
+            # the desynced frames' rows are all accounted: counted
+            # crypto drops (NACK-class) plus the stop-swept requeues
+            assert led["crypto-dropped"] > 0
+        finally:
+            c.shutdown()

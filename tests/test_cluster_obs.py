@@ -234,6 +234,7 @@ EXPECTED_OPS = (
     "stop_serving", "metrics", "metricsmap", "obs_scrape", "sysdump",
     "map_pressure", "compile_stats", "ct_snapshot", "ct_merge",
     "record_incident", "publish_drops", "shutdown", "ack_flush",
+    "rotate_epoch",
 )
 
 

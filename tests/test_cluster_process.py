@@ -133,6 +133,22 @@ class TestProcessClusterLifecycle:
                 assert ts["frames-packed"] == ts["frames"], (
                     "single-stream chunks must ride the packed "
                     "16 B/packet wire")
+                # ISSUE 18: cluster_encrypt defaults OFF and the
+                # plaintext wire must be byte-identical to the
+                # PR 17 protocol — no crypto block in the stats,
+                # and the last frame that crossed the socket is a
+                # plain encode_rows product (decoding it and
+                # re-encoding the pieces reproduces the exact
+                # bytes; a sealed frame would fail the decode)
+                assert "crypto" not in ts
+                wire = n._last_wire
+                if wire is not None:
+                    from cilium_tpu.cluster.transport import (
+                        decode_rows_seq, encode_rows)
+                    drows, meta, trace, seq = decode_rows_seq(wire)
+                    assert encode_rows(
+                        drows, packed_meta=meta, trace=trace,
+                        seq=seq) == wire
             # -- ISSUE 14 compact obs leg: the relay's merged views
             # over the LIVE workers (real control-channel scrape +
             # cross-process span stitching; the full relay
