@@ -2792,7 +2792,22 @@ def bench_obs(target_packets=1 << 20, reps=3) -> dict:
     window is sized to several seconds so it reads the governed
     steady state, not a single worst-case sweep: ungoverned 0.25 s
     cadence measured 0.72-0.77 on this box (that experiment is why
-    the governor exists), governed runs clear the floor."""
+    the governor exists), governed runs clear the floor.
+
+    v2 (ISSUE 19) adds two more numbers:
+
+    - ``sampler_overhead_ratio``: a second paired-leg pair, single
+      daemon under the same ingress overload, the SLO plane's
+      sampler (history rings + burn evaluation, the `slo-sampler`
+      thread) armed at an aggressive 0.25 s cadence vs off.  The
+      same duty governor (``slo_max_duty``) defends this ratio: a
+      tick's cost stretches the next delay, so sampling never
+      claims more than the duty fraction of wall clock.
+    - ``burn_detect_s``: detection latency of the shipped
+      multi-window config for a seeded admission-shed burst, on a
+      FAKE 10 s-tick timeline (deterministic — it characterizes the
+      window math, not machine weather): fake seconds from the
+      burst to the serving-availability SLO's page verdict."""
     import ipaddress
 
     from cilium_tpu.agent import DaemonConfig
@@ -2900,9 +2915,63 @@ def bench_obs(target_packets=1 << 20, reps=3) -> dict:
     leg(False)  # untimed warm leg (executable/thread steady state)
     pair = paired_legs(lambda: leg(False), lambda: leg(True),
                        reps=reps)
+
+    # ---- sampler tax (ISSUE 19): ONE daemon, same ingress overload
+    # loop, the history+SLO sampler armed at a 0.25 s cadence vs
+    # stopped — paired order-alternating like the relay legs.  One
+    # daemon (not one per leg) so both legs share executables and
+    # thread steady state; only the `slo-sampler` thread differs.
+    from cilium_tpu.agent import Daemon
+
+    s_target = max(target_packets // 4, 64 * BUCKET)
+    d = Daemon(DaemonConfig(
+        backend="tpu", ct_capacity=1 << 14,
+        flow_ring_capacity=1 << 13,
+        serving_queue_depth=1 << 15,
+        serving_bucket_ladder=(BUCKET,),
+        serving_max_wait_us=1000.0,
+        history_interval=0.25))
+    try:
+        d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+        db_l = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(RULES)
+        # warm occupancy sample (the daemon.start idiom): the armed
+        # sampler reads the occupancy gauges, and their executable
+        # must compile before any timed serving window
+        d.pressure.sample()
+        chunks = [batch(BUCKET, db_l.id) for _ in range(8)]
+
+        def sampler_leg(armed: bool):
+            if armed:
+                d.slo.start()
+            else:
+                d.slo.stop()
+            d.start_serving(ring_capacity=1 << 15, trace_sample=0,
+                            ingress=True, packed=True)
+            admitted = i = 0
+            t0 = time.perf_counter()
+            while admitted < s_target:
+                got = d.submit(chunks[i % len(chunks)])
+                admitted += got
+                i += 1
+                if got < BUCKET:
+                    time.sleep(0.0005)  # queue full: backpressure
+            stats = d.stop_serving()  # drains everything admitted
+            dt = time.perf_counter() - t0
+            return stats["front-end"]["verdicts"] / dt
+
+        sampler_leg(False)  # untimed warm (compiles + steady state)
+        spair = paired_legs(lambda: sampler_leg(False),
+                            lambda: sampler_leg(True), reps=reps)
+        sampler_ticks = d.slo.ticks
+    finally:
+        d.slo.stop()
+        d.shutdown()
+
+    burn_detect_s = _obs_burn_detect(batch, RULES, BUCKET)
     ob = extras.get("obs") or {}
     return {
-        "schema": "bench-obs-v1",
+        "schema": "bench-obs-v2",
         "best_of": reps,
         "sustained_pps_noobs": pair["baseline_pps"],
         "sustained_pps_obs": pair["candidate_pps"],
@@ -2915,13 +2984,87 @@ def bench_obs(target_packets=1 << 20, reps=3) -> dict:
         "stitched_spans": (ob.get("spans") or {}).get("committed"),
         "spans_dropped": (ob.get("spans") or {}).get("dropped"),
         "ledger_exact": extras["ledger_exact"],
+        "sampler_overhead_ratio": spair["ratio_median"],
+        "sampler_overhead_pairs": spair["pairs"],
+        "sampler_overhead_spread": spair["spread"],
+        "sampler_pps_off": spair["baseline_pps"],
+        "sampler_pps_armed": spair["candidate_pps"],
+        "sampler_ticks": sampler_ticks,
+        "burn_detect_s": burn_detect_s,
     }
+
+
+def _obs_burn_detect(batch, rules, bucket) -> float:
+    """``burn_detect_s``: fake seconds from a seeded admission-shed
+    burst to the serving-availability SLO's page verdict, at the
+    shipped multi-window config on a 10 s tick cadence.
+
+    Deterministic by construction: the engine's clocks are
+    injectable, so the timeline is fake (the number characterizes
+    the burn-rate window math, not this box), while the COUNTERS are
+    real — a healthy baseline covers the slow window, then a burst
+    overflows the admission queue and the real shed ledger (exact,
+    flushed by the drain thread) is what burns the budget."""
+    from cilium_tpu.agent import Daemon, DaemonConfig
+
+    d = Daemon(DaemonConfig(
+        backend="tpu", ct_capacity=1 << 14,
+        flow_ring_capacity=1 << 13,
+        serving_queue_depth=1 << 15,
+        serving_bucket_ladder=(bucket,),
+        serving_max_wait_us=1000.0,
+        history_interval=0.0))  # no sampler thread: tick() driven
+    try:
+        d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+        db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+        d.policy_import(rules)
+        d.pressure.sample()  # occupancy executable, pre-session
+        d.start_serving(ring_capacity=1 << 15, trace_sample=0,
+                        ingress=True, packed=True)
+        step, t, w0 = 10.0, 0.0, 1.7e9
+        # healthy baseline covering the slow window: 256-row chunks
+        # can never overflow the 2^15 queue even undrained, so the
+        # burst below is the FIRST shed the ledger ever sees
+        rows = batch(256, db.id)
+        for _ in range(int(d.config.slo_slow_window / step) + 1):
+            d.submit(rows.copy())
+            d.slo.tick(now=t, wall=w0 + t)
+            t += step
+        ev = d.slo.last["evals"]["serving-availability"]
+        assert ev["state"] == "ok", ev
+        t_burst = t
+        burst = [batch(bucket, db.id) for _ in range(8)]
+        shed = 0
+        for i in range(64):
+            shed += bucket - d.submit(burst[i % len(burst)].copy())
+        assert shed > 0, "burst never overflowed admission"
+        # the exact shed ledger flushes on drain activity — wait for
+        # the registry (what the sampler reads) to surface all of it
+        t0 = time.perf_counter()
+        while (d.registry.sample(("cilium_serving_shed_total",))
+               .get("cilium_serving_shed_total", 0)) < shed:
+            if time.perf_counter() - t0 > 120:
+                raise TimeoutError("shed ledger never surfaced")
+            time.sleep(0.002)
+        detect = None
+        for _ in range(60):
+            t += step
+            out = d.slo.tick(now=t, wall=w0 + t)
+            if (out["evals"]["serving-availability"]["state"]
+                    == "page"):
+                detect = t - t_burst
+                break
+        assert detect is not None, "seeded burst never paged"
+        d.stop_serving()
+        return detect
+    finally:
+        d.shutdown()
 
 
 def _run_obs_phase() -> None:
     """--obs: the cluster observability relay phase standalone (one
     JSON line).  Also writes BENCH_obs.json next to this file —
-    schema-checked by CTA011 (analysis/nodehost_lint.check_bench);
+    schema-checked by CTA014 (analysis/slo_lint.check_bench);
     bounded under JAX_PLATFORMS=cpu."""
     import os
 

@@ -381,6 +381,48 @@ class DaemonConfig:
     # NAT_DEFAULT_CAPACITY (1 << 14).  Small pools are the
     # nat_exhaustion scenario's pressure shape
     nat_pool_capacity: Optional[int] = None
+    # -- adaptive GC relaxation (ISSUE 19 satellite; the other half
+    # of ctmap's adaptive interval: the sweep accelerates under
+    # pressure AND relaxes back out when the map stays calm).  After
+    # every ct_gc_relax_after seconds of CONTINUOUS calm (state ok,
+    # occupancy under the clear bound, no new drops/failures) the
+    # monitor stretches the normal CT-GC cadence by
+    # ct_gc_relax_factor, compounding up to the ct_gc_relax_max
+    # multiplier; any pressure episode snaps the multiplier back to
+    # 1 — relaxation can never fire mid-episode.  0 = off
+    ct_gc_relax_after: float = 300.0
+    ct_gc_relax_factor: float = 2.0
+    ct_gc_relax_max: float = 4.0
+    # -- SLO plane (obs/history.py + obs/slo.py; ISSUE 19).  One
+    # sampler thread (CTA002 domain `slo`, duty-governed) retains a
+    # declared registry subset in two fixed-memory ring tiers and
+    # evaluates the shipped SLO set with fast+slow burn rates; a
+    # page-severity burn opens a `slo-burn` incident episode.
+    # sampler cadence in seconds; 0 disables history AND SLO
+    # evaluation entirely
+    history_interval: float = 10.0
+    # fast-tier ring slots (span = history_interval * slots)
+    history_slots: int = 360
+    # every Nth sample also lands in the slow tier...
+    history_slow_every: int = 30
+    # ...whose ring holds this many slots (default 5 min x 288 = 24 h)
+    history_slow_slots: int = 288
+    # the multi-window burn evaluation windows (seconds); both must
+    # fit the rings' span to ever leave no-data
+    slo_fast_window: float = 60.0
+    slo_slow_window: float = 600.0
+    # burn-rate thresholds: PAGE when both windows burn at/over
+    # slo_page_burn (opens the incident episode), WARN at
+    # slo_warn_burn
+    slo_page_burn: float = 10.0
+    slo_warn_burn: float = 2.0
+    # hysteresis: an episode closes only after this many consecutive
+    # calm evaluations (both windows under the warn burn)
+    slo_clear_ticks: int = 3
+    # the sampler's duty-governor ceiling (the flow-analytics
+    # max_duty idiom): sampling+evaluation time stays under this
+    # fraction of wall clock by stretching the cadence. 0 = fixed
+    slo_max_duty: float = 0.05
 
 
 class Daemon:
@@ -499,6 +541,38 @@ class Daemon:
             self.config.ct_pressure_threshold,
             self.config.ct_pressure_clear,
             self.config.ct_gc_pressure_interval)
+        from ..datapath.pressure import validate_relax_config
+
+        (self.config.ct_gc_relax_after,
+         self.config.ct_gc_relax_factor,
+         self.config.ct_gc_relax_max) = validate_relax_config(
+            self.config.ct_gc_relax_after,
+            self.config.ct_gc_relax_factor,
+            self.config.ct_gc_relax_max)
+        # SLO-plane knobs (obs/history.py + obs/slo.py): same
+        # fail-at-construction contract
+        from ..obs import validate_history_config, validate_slo_config
+
+        (self.config.history_interval,
+         self.config.history_slots,
+         self.config.history_slow_every,
+         self.config.history_slow_slots) = validate_history_config(
+            self.config.history_interval,
+            self.config.history_slots,
+            self.config.history_slow_every,
+            self.config.history_slow_slots)
+        (self.config.slo_fast_window,
+         self.config.slo_slow_window,
+         self.config.slo_page_burn,
+         self.config.slo_warn_burn,
+         self.config.slo_clear_ticks,
+         self.config.slo_max_duty) = validate_slo_config(
+            self.config.slo_fast_window,
+            self.config.slo_slow_window,
+            self.config.slo_page_burn,
+            self.config.slo_warn_burn,
+            self.config.slo_clear_ticks,
+            self.config.slo_max_duty)
         if self.config.nat_pool_capacity is not None:
             # NAT_PORT_MIN is the single pool-base authority
             # (service/nat.py); NATTable.create re-validates — this
@@ -676,7 +750,11 @@ class Daemon:
             ct_threshold=self.config.ct_pressure_threshold,
             ct_clear=self.config.ct_pressure_clear,
             gc_pressure_interval_s=self.config
-            .ct_gc_pressure_interval)
+            .ct_gc_pressure_interval,
+            relax_after_s=self.config.ct_gc_relax_after,
+            relax_factor=self.config.ct_gc_relax_factor,
+            relax_max=self.config.ct_gc_relax_max,
+            on_relax=self._ct_gc_relax)
         # hubble-relay analogue: add_relay_peer() builds it lazily;
         # when peers exist the sysdump bundle carries a relay-merged
         # flow sample stamped with node names
@@ -820,6 +898,34 @@ class Daemon:
         from ..obs import build_daemon_registry
 
         self.registry = build_daemon_registry(self)
+        # the SLO plane (ISSUE 19): history rings + burn-rate engine,
+        # constructed AFTER the registry because the sampler pulls
+        # registry.sample() — the registry's own cilium_slo_*
+        # collectors resolve this attribute lazily for the same
+        # reason.  The engine exists even with the sampler disabled
+        # (history_interval 0): tests and operators can drive
+        # tick() synchronously
+        from ..obs import SLOEngine, SeriesHistory, default_slos
+        from ..obs.slo import HISTORY_SERIES
+
+        self.history = SeriesHistory(
+            sample_fn=lambda: self.registry.sample(HISTORY_SERIES),
+            kinds={name: kind for name in HISTORY_SERIES
+                   if (kind := self.registry.kind(name)) is not None},
+            interval_s=self.config.history_interval,
+            slots=self.config.history_slots,
+            slow_every=self.config.history_slow_every,
+            slow_slots=self.config.history_slow_slots)
+        self.slo = SLOEngine(
+            self.history, default_slos(),
+            record_incident=self.record_incident,
+            interval_s=self.config.history_interval,
+            fast_window_s=self.config.slo_fast_window,
+            slow_window_s=self.config.slo_slow_window,
+            page_burn=self.config.slo_page_burn,
+            warn_burn=self.config.slo_warn_burn,
+            clear_ticks=self.config.slo_clear_ticks,
+            max_duty=self.config.slo_max_duty)
 
     # -- getters for flow enrichment ---------------------------------
     def _identity_labels(self, numeric: int) -> Tuple[str, ...]:
@@ -941,6 +1047,24 @@ class Daemon:
             "l7-by-plugin": l7registry.latency_snapshot(),
         }
 
+    def slo_snapshot(self) -> dict:
+        """``GET /slo`` body, node-stamped.  The ONE definition
+        behind BOTH node modes (``ClusterNode.slo`` in-process and
+        the ``nodehost`` ``slo`` control op) — the
+        obs_scrape_snapshot contract."""
+        out = self.slo.snapshot()
+        out["node"] = self.config.node_name
+        return out
+
+    def history_snapshot(self, series=None, since: float = 0.0
+                         ) -> dict:
+        """``GET /metrics/history`` body, node-stamped — the one
+        definition behind both node modes, like
+        :meth:`slo_snapshot`."""
+        out = self.history.query(series=series, since=float(since))
+        out["node"] = self.config.node_name
+        return out
+
     def add_relay_peer(self, name: str, observer) -> None:
         """Register a peer agent's Observer(-protocol object) for
         relay-merged flow views (the hubble-relay analogue; prep for
@@ -984,6 +1108,11 @@ class Daemon:
         section("metrics", self.registry.render)
         section("ct-snapshot", self.ct_snapshot_info)
         section("pressure", self.pressure.stats)
+        # the SLO plane (ISSUE 19): a slo-burn capture must carry
+        # the evidence — the full evaluation/episode state plus the
+        # retained series window the burn was computed over
+        section("slo", self.slo.snapshot)
+        section("history", self.history.query)
         if self.relay is not None:
             section("relay-flows", lambda: self.relay.get_flows(
                 number=min(cfg.sysdump_flows, 64)))
@@ -1066,6 +1195,14 @@ class Daemon:
             return
         self._ct_gc_schedule(self.config.ct_gc_interval)
 
+    def _ct_gc_relax(self, multiplier: float) -> None:
+        # thread-affinity: api -- the map-pressure controller thread
+        """A sustained-calm relax step (ISSUE 19 satellite): stretch
+        the normal cadence by the monitor's bounded multiplier."""
+        if not self._started:
+            return
+        self._ct_gc_schedule(self.config.ct_gc_interval * multiplier)
+
     # -- lifecycle ----------------------------------------------------
     def start(self) -> None:
         """Start background controllers (CT GC, fqdn TTL GC)."""
@@ -1080,6 +1217,11 @@ class Daemon:
             self.controllers.update(
                 "map-pressure", self.pressure.sample,
                 self.config.map_pressure_interval)
+        # the SLO plane's sampler thread (obs/slo.py `slo-sampler`,
+        # CTA002 domain `slo`): history sampling + burn evaluation,
+        # duty-governed, never the drain thread.  start() is a no-op
+        # when history_interval is 0
+        self.slo.start()
         self.controllers.update(
             "fqdn-gc", self.fqdn.gc, self.config.fqdn_gc_interval)
         if self.auth_manager is not None:
@@ -1143,6 +1285,7 @@ class Daemon:
     hubble_server = None
 
     def shutdown(self) -> None:
+        self.slo.stop()
         self.controllers.stop_all()
         self.stop_serving()  # no-op when idle; drains in-flight work
         self.stop_dns_proxy()
@@ -2340,7 +2483,12 @@ class Daemon:
                # the map-pressure block (datapath/pressure.py):
                # cached last sample + state machine — never touches
                # the device at render time
-               "pressure": self.pressure.stats()}
+               "pressure": self.pressure.stats(),
+               # the SLO block (obs/slo.py): verdict + per-SLO
+               # states off the engine's cached last evaluation —
+               # a stats render never evaluates
+               "slo": self.slo.stats(),
+               "history": self.history.stats()}
         if s["n_shards"]:
             out["shards"] = s["n_shards"]
             out["route-overflow"] = s["route_overflow"]

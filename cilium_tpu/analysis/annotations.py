@@ -30,10 +30,14 @@ from .core import FileCtx, Finding
 # _ack_flush_loop, ISSUE 17): a sleepy periodic thread that only
 # flushes the pending cumulative ack — NOT a hot domain (the data
 # thread flushes inline at the ack_every stride; the timer bounds
-# idle-tail latency only).
+# idle-tail latency only).  "slo" = the SLO plane's sampler thread
+# (obs/slo.py ``slo-sampler``, ISSUE 19): samples the registry
+# subset into the history rings and evaluates burn rates — NOT a hot
+# domain (it reads lock-guarded ledgers on its own duty-governed
+# cadence; by construction never the drain thread).
 AFFINITIES = ("drain", "event-worker", "watchdog", "capture", "api",
               "cli", "offline", "router", "transport", "l7",
-              "ackflush", "any")
+              "ackflush", "slo", "any")
 
 _GUARDED_LIST_RE = re.compile(
     r"#\s*guarded-by:\s*(?P<lock>[\w.-]+)\s*:\s*(?P<attrs>[\w,\s]+)$")

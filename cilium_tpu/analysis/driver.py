@@ -11,7 +11,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from . import (affinity, cluster_lint, crypto_lint, generation,
                guarded, hotpath, nodehost_lint, proxy_lint, reasons,
-               registry_lint, scenario_lint, sharding, sysdump_lint)
+               registry_lint, scenario_lint, sharding, slo_lint,
+               sysdump_lint)
 from .callgraph import CallGraph
 from .core import BASELINE_NAME, Baseline, Finding, Repo, repo_root
 
@@ -30,6 +31,7 @@ CHECKERS: Dict[str, Tuple[str, Callable]] = {
     "nodehost-ops": (nodehost_lint.CODE, nodehost_lint.check),
     "proxy-ledger": (proxy_lint.CODE, proxy_lint.check),
     "crypto-hygiene": (crypto_lint.CODE, crypto_lint.check),
+    "slo-contract": (slo_lint.CODE, slo_lint.check),
 }
 # checkers that walk the call graph; selecting none of these skips
 # the (comparatively expensive) CallGraph build entirely

@@ -20,16 +20,13 @@ modes this checker makes impossible to ship:
    breaks silently.  Every ``_OPS`` key must appear as a string
    literal somewhere under ``tests/``.
 
-Additionally, when ``BENCH_obs.json`` exists at the repo root it
-must carry the observability bench schema floor
-(:data:`BENCH_OBS_KEYS`; ``check_bench`` is the importable
-validator, the CTA008 idiom).
+(The ``BENCH_obs.json`` schema gate that used to ride here moved to
+``slo_lint`` (CTA014) with the v1->v2 schema bump.)
 """
 
 from __future__ import annotations
 
 import ast
-import json
 import os
 from typing import Dict, List, Optional
 
@@ -40,19 +37,6 @@ NAME = "nodehost-ops"
 
 NODEHOST_MODULE = "cilium_tpu/cluster/nodehost.py"
 TESTS_DIR = "tests"
-
-BENCH_NAME = "BENCH_obs.json"
-# the observability bench artifact's schema floor (bench.py --obs):
-# the paired-leg scrape-overhead ratio (relay polling on vs off
-# during cluster serving) and the scrape round-trip percentiles
-BENCH_OBS_KEYS = (
-    "schema", "best_of",
-    "sustained_pps_obs", "sustained_pps_noobs",
-    "scrape_overhead_ratio", "scrape_overhead_pairs",
-    "scrape_rtt_us", "scrapes_total",
-    "stitched_spans", "ledger_exact",
-)
-BENCH_SCHEMA = "bench-obs-v1"
 
 
 def _dict_str_keys(ctx: FileCtx, name: str) -> Optional[Dict[str,
@@ -140,31 +124,4 @@ def check(repo: Repo, graph=None) -> List[Finding]:
             f"control op {op!r} is referenced by no test under "
             f"tests/ — a cross-process wire contract with no "
             f"coverage is a dead letter", checker=NAME))
-    # bench artifact schema (only when the artifact exists)
-    bench_path = os.path.join(repo.root, BENCH_NAME)
-    if os.path.exists(bench_path):
-        for msg in check_bench(bench_path):
-            findings.append(Finding(CODE, BENCH_NAME, 1, msg,
-                                    checker=NAME))
     return findings
-
-
-# -- bench artifact validation (tests import this) ---------------------
-def check_bench(path: str) -> List[str]:
-    """-> list of violation strings (empty = clean)."""
-    try:
-        with open(path) as f:
-            data = json.load(f)
-    except (OSError, ValueError) as e:
-        return [f"{path}: does not load as JSON ({e})"]
-    if not isinstance(data, dict):
-        return [f"{path}: top level is {type(data).__name__}, "
-                f"not an object"]
-    bad = []
-    if data.get("schema") != BENCH_SCHEMA:
-        bad.append(f"{path}: schema {data.get('schema')!r} != "
-                   f"{BENCH_SCHEMA}")
-    for key in BENCH_OBS_KEYS:
-        if key not in data:
-            bad.append(f"{path}: missing required key {key!r}")
-    return bad

@@ -91,6 +91,11 @@ REQUIRED_SERIES = (
     "cilium_l7_worker_restarts_total",
     "cilium_l7_dns_answers_total",
     "cilium_l7_parse_lag_us",
+    # map-pressure breadth (ISSUE 19): the SLO plane's map-headroom
+    # verdict reads lpm + policy occupancy alongside ct — losing
+    # either blinds the headroom SLO for that map
+    "cilium_lpm_occupancy",
+    "cilium_policy_map_occupancy",
     # long-standing anchors (a registry rewrite that loses these
     # fails here, not on a dashboard)
     "cilium_datapath_packets_total",

@@ -1,0 +1,204 @@
+"""CTA014 — SLO-plane contract: declared objectives stay evaluable.
+
+The SLO engine (``obs/slo.py``) is only as honest as its inputs: an
+SLO referencing a series the registry no longer exports, or one the
+history ring does not sample, silently degrades to permanent
+``no-data`` — the alert that can never fire.  The engine validates
+this at construction, but only on the daemon path that actually
+builds it; this checker makes the contract a repo invariant:
+
+1. **Every series a shipped SLO references** (``default_slos``'s
+   ``bad``/``total``/``series`` fields) must be a registered name in
+   ``obs/registry.py`` AND a member of ``HISTORY_SERIES`` (the
+   ring's declared sampling subset) — either gap is the
+   alert-that-cannot-fire failure mode.
+2. **Every HISTORY_SERIES name** must stay registered: the sampler
+   drops unknown names silently (a torn registry rename would
+   otherwise blank a ring series with no error anywhere).
+3. **The ``cilium_slo_*`` exposition floor** must stay registered —
+   the burn verdicts themselves are an operator contract
+   (:data:`SLO_REQUIRED_SERIES`, the CTA006 floor idiom).
+
+Additionally, when ``BENCH_obs.json`` exists at the repo root it
+must carry the v2 observability bench schema
+(:data:`BENCH_OBS_KEYS`: the v1 scrape-overhead floor plus the
+ISSUE 19 sampler-overhead paired legs and the burn-detection
+latency; ``check_bench`` is the importable validator — the CTA008
+idiom, migrated here from CTA011 with the v1->v2 bump).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import List, Optional, Tuple
+
+from .core import FileCtx, Finding, Repo
+
+CODE = "CTA014"
+NAME = "slo-contract"
+
+SLO_MODULE = "cilium_tpu/obs/slo.py"
+REGISTRY_MODULE = "cilium_tpu/obs/registry.py"
+
+# the SLO plane's own exposition floor: burn verdicts must stay
+# scrapeable (dashboards alert on these, not on the JSON surface)
+SLO_REQUIRED_SERIES = (
+    "cilium_slo_budget_remaining",
+    "cilium_slo_burn_rate",
+    "cilium_slo_state",
+)
+
+BENCH_NAME = "BENCH_obs.json"
+# the observability bench artifact's schema floor (bench.py --obs):
+# v1's paired-leg scrape-overhead ratio + rtt percentiles, plus the
+# ISSUE 19 additions — the sampler-overhead paired legs (history +
+# SLO engine armed vs off) and the burn-detection latency for a
+# seeded shed burst
+BENCH_OBS_KEYS = (
+    "schema", "best_of",
+    "sustained_pps_obs", "sustained_pps_noobs",
+    "scrape_overhead_ratio", "scrape_overhead_pairs",
+    "scrape_rtt_us", "scrapes_total",
+    "stitched_spans", "ledger_exact",
+    "sampler_overhead_ratio", "sampler_overhead_pairs",
+    "burn_detect_s",
+)
+BENCH_SCHEMA = "bench-obs-v2"
+
+_SLO_SERIES_FIELDS = ("bad", "total", "series")
+
+
+def _tuple_strs(ctx: FileCtx, name: str) -> Optional[List[Tuple[str,
+                                                                int]]]:
+    """Module-level ``name = ("a", "b", ...)`` -> [(value, lineno)]."""
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == name \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            out = []
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    out.append((elt.value, elt.lineno))
+            return out
+    return None
+
+
+def _declared_slo_series(ctx: FileCtx) -> List[Tuple[str, str, int]]:
+    """-> [(slo_name, series, lineno)] from every ``SLODef(...)``
+    call inside ``default_slos`` (keyword fields only — the
+    dataclass is keyword-constructed by convention)."""
+    fn = None
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef) \
+                and node.name == "default_slos":
+            fn = node
+            break
+    if fn is None:
+        return []
+    out: List[Tuple[str, str, int]] = []
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "SLODef"):
+            continue
+        slo_name = "?"
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                slo_name = str(kw.value.value)
+        for kw in node.keywords:
+            if kw.arg not in _SLO_SERIES_FIELDS:
+                continue
+            vals = (kw.value.elts
+                    if isinstance(kw.value, (ast.Tuple, ast.List))
+                    else [kw.value])
+            for v in vals:
+                if isinstance(v, ast.Constant) \
+                        and isinstance(v.value, str) and v.value:
+                    out.append((slo_name, v.value, v.lineno))
+    return out
+
+
+def check(repo: Repo, graph=None) -> List[Finding]:
+    findings: List[Finding] = []
+    slo = repo.by_rel(SLO_MODULE)
+    reg = repo.by_rel(REGISTRY_MODULE)
+    if slo is None or slo.tree is None:
+        return [Finding(CODE, SLO_MODULE, 1,
+                        "SLO module missing", checker=NAME)]
+    if reg is None:
+        return [Finding(CODE, REGISTRY_MODULE, 1,
+                        "registry module missing", checker=NAME)]
+    history = _tuple_strs(slo, "HISTORY_SERIES")
+    if history is None:
+        findings.append(Finding(
+            CODE, slo.rel, 1,
+            "HISTORY_SERIES tuple literal not found (the ring's "
+            "declared sampling subset)", checker=NAME))
+        history = []
+    history_names = {n for n, _ in history}
+    for name, line in history:
+        if f'"{name}"' not in reg.source:
+            findings.append(Finding(
+                CODE, slo.rel, line,
+                f"history series {name!r} is not registered in "
+                f"obs/registry.py — the sampler would drop it "
+                f"silently", checker=NAME))
+    refs = _declared_slo_series(slo)
+    if not refs:
+        findings.append(Finding(
+            CODE, slo.rel, 1,
+            "no SLODef series references found under default_slos "
+            "(the shipped SLO set went invisible to this checker)",
+            checker=NAME))
+    for slo_name, series, line in refs:
+        if f'"{series}"' not in reg.source:
+            findings.append(Finding(
+                CODE, slo.rel, line,
+                f"SLO {slo_name!r} references unregistered series "
+                f"{series!r} — an alert that can never fire",
+                checker=NAME))
+        if history_names and series not in history_names:
+            findings.append(Finding(
+                CODE, slo.rel, line,
+                f"SLO {slo_name!r} references {series!r} which is "
+                f"not in HISTORY_SERIES — the ring never samples "
+                f"it, so the SLO evaluates to permanent no-data",
+                checker=NAME))
+    for name in SLO_REQUIRED_SERIES:
+        if f'"{name}"' not in reg.source:
+            findings.append(Finding(
+                CODE, REGISTRY_MODULE, 1,
+                f"required series {name!r} is not registered "
+                f"(the SLO exposition floor)", checker=NAME))
+    # bench artifact schema (only when the artifact exists)
+    bench_path = os.path.join(repo.root, BENCH_NAME)
+    if os.path.exists(bench_path):
+        for msg in check_bench(bench_path):
+            findings.append(Finding(CODE, BENCH_NAME, 1, msg,
+                                    checker=NAME))
+    return findings
+
+
+# -- bench artifact validation (tests import this) ---------------------
+def check_bench(path: str) -> List[str]:
+    """-> list of violation strings (empty = clean)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: does not load as JSON ({e})"]
+    if not isinstance(data, dict):
+        return [f"{path}: top level is {type(data).__name__}, "
+                f"not an object"]
+    bad = []
+    if data.get("schema") != BENCH_SCHEMA:
+        bad.append(f"{path}: schema {data.get('schema')!r} != "
+                   f"{BENCH_SCHEMA}")
+    for key in BENCH_OBS_KEYS:
+        if key not in data:
+            bad.append(f"{path}: missing required key {key!r}")
+    return bad

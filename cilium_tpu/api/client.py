@@ -203,5 +203,25 @@ class APIClient:
     def metrics_inventory(self):
         return self._request("GET", "/metrics/inventory")
 
+    def metrics_history(self, series=None, since: float = 0.0):
+        """Windowed in-process metrics history (ISSUE 19): fast +
+        slow downsample tiers for the declared series subset."""
+        q = []
+        if series:
+            q.append("series=" + ",".join(series))
+        if since:
+            q.append(f"since={since}")
+        return self._request(
+            "GET",
+            "/metrics/history" + ("?" + "&".join(q) if q else ""))
+
+    def slo(self):
+        """This node's SLO verdict + per-SLO burn evaluations."""
+        return self._request("GET", "/slo")
+
+    def cluster_slo(self):
+        """Merged node-labeled cluster health verdict."""
+        return self._request("GET", "/cluster/slo")
+
     def xds_status(self):
         return self._request("GET", "/xds")

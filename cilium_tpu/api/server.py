@@ -173,6 +173,20 @@ def _make_handler(daemon: Daemon):
                     # /metrics can serve, with type + help (the
                     # README metric-inventory table's source)
                     self._send(200, daemon.registry.inventory())
+                elif path == "/metrics/history":
+                    # the SLO plane's retained series rings
+                    # (?series=a,b&since=epoch; `cilium-tpu history`
+                    # reads this)
+                    series = [s for s in
+                              q.get("series", [""])[0].split(",")
+                              if s] or None
+                    since = float(q.get("since", ["0"])[0])
+                    self._send(200, daemon.history_snapshot(
+                        series=series, since=since))
+                elif path == "/slo":
+                    # the SLO plane's verdict + per-objective burn
+                    # evaluation (`cilium-tpu slo` reads this)
+                    self._send(200, daemon.slo_snapshot())
                 elif path == "/debug/traces":
                     # the sampled span plane + compile-event log
                     # (cilium-tpu trace reads this)
@@ -292,6 +306,15 @@ def _make_handler(daemon: Daemon):
                                 daemon._cluster.cluster_sysdump())
                         except Exception as e:
                             self._send(400, {"error": str(e)})
+                elif path == "/cluster/slo":
+                    # the relay's merged cluster health verdict:
+                    # worst-of over per-node SLO verdicts,
+                    # node-labeled (`cilium-tpu cluster slo`)
+                    if daemon._cluster is None:
+                        self._send(404, {"error": _NO_CLUSTER})
+                    else:
+                        self._send(
+                            200, daemon._cluster.obs.cluster_slo())
                 elif path == "/serving":
                     # serving front-end telemetry (queue wait, pad
                     # efficiency, verdicts/sec, latency percentiles)

@@ -233,6 +233,29 @@ def cmd_cluster(args) -> int:
                      else f"FAILED ({ent.get('error', '?')})")
             print(f"  {name:<16}{state}")
         return 0
+    if getattr(args, "action", "status") == "slo":
+        # the relay's merged cluster health verdict (ISSUE 19):
+        # worst-of over node verdicts, every contribution labeled
+        out = _client(args).cluster_slo()
+        if args.json:
+            _print(out)
+            return 0
+        un = out.get("unreachable") or []
+        print(f"Cluster SLO: {str(out.get('verdict', '?')).upper()} "
+              f"({out.get('node-count', 0)} nodes"
+              + (f", {len(un)} unreachable" if un else "") + ")")
+        for name, e in sorted((out.get("nodes") or {}).items()):
+            bad = ", ".join(
+                f"{k}={v}"
+                for k, v in sorted((e.get("slos") or {}).items())
+                if v != "ok")
+            extra = e.get("error") or bad
+            age = e.get("age-s")
+            age_s = "-" if age is None else f"{age:.1f}s"
+            print(f"  {name:<16}{e.get('verdict', '?'):<9}"
+                  f"age {age_s}"
+                  + (f"  {extra}" if extra else ""))
+        return 0
     if getattr(args, "action", "status") == "trace":
         # stitched cross-process spans (router-queue -> forward ->
         # worker-admit -> ack) + per-node tracer summaries
@@ -814,14 +837,15 @@ def _counters_reset(cur: dict, prev: dict) -> bool:
     """Any cumulative counter going BACKWARD means the serving
     session restarted between ticks (stop_serving + start_serving
     zeroes them): the diff would render nonsense negative rates, so
-    the follow loop resyncs with a full block instead — the standard
-    rate-over-counter reset convention."""
-    for keys, _label in _SERVING_RATE_KEYS:
-        a, b = _pluck(cur, keys), _pluck(prev, keys)
-        if (isinstance(a, (int, float)) and isinstance(b, (int, float))
-                and a < b):
-            return True
-    return False
+    the follow loop resyncs with a full block instead.  The reset
+    DEFINITION lives in ``obs.history`` — the one convention shared
+    with the SeriesHistory ring's splice — this wrapper only plucks
+    the serving rate keys."""
+    from ..obs.history import counters_reset
+
+    return counters_reset(
+        (_pluck(cur, keys), _pluck(prev, keys))
+        for keys, _label in _SERVING_RATE_KEYS)
 
 
 def _print_serving_interval(cur: dict, prev: dict,
@@ -1086,6 +1110,85 @@ def _us(v) -> str:
     return "-" if v is None else f"{v:,.0f}"
 
 
+def _print_slo(st: dict) -> None:
+    en = "" if st.get("enabled") else " (sampler disabled)"
+    print(f"Verdict:   {str(st.get('verdict', '?')).upper()}{en} — "
+          f"{st.get('ticks', 0)} ticks, windows "
+          f"{st.get('fast-window-s')}s/{st.get('slow-window-s')}s, "
+          f"page>={st.get('page-burn')}x warn>={st.get('warn-burn')}x"
+          f", resyncs {st.get('resyncs', 0)}")
+    slos = st.get("slos") or {}
+    if not slos:
+        print("  (no evaluations yet — first tick pending)")
+    else:
+        print(f"  {'SLO':<26}{'STATE':<9}{'BUDGET':>8}"
+              f"{'FAST-BURN':>11}{'SLOW-BURN':>11}")
+        for name, ev in sorted(slos.items()):
+            bud = ev.get("budget-remaining")
+            fb = ev.get("fast-burn")
+            sb = ev.get("slow-burn")
+            bud_s = "-" if bud is None else f"{bud:.1%}"
+            fb_s = "-" if fb is None else f"{fb:.2f}x"
+            sb_s = "-" if sb is None else f"{sb:.2f}x"
+            print(f"  {name:<26}{ev.get('state', '?'):<9}"
+                  f"{bud_s:>8}{fb_s:>11}{sb_s:>11}")
+    for name, ep in sorted((st.get("active") or {}).items()):
+        print(f"  BURNING {name}: peak {ep.get('peak-burn')}x, "
+              f"calm {ep.get('calm', 0)}/{st.get('clear-ticks')} "
+              f"(since {ep.get('started-at')})")
+    for e in (st.get("episodes") or [])[-3:]:
+        print(f"  recovered {e.get('slo')}: "
+              f"{e.get('duration-s')}s burn episode, "
+              f"peak {e.get('peak-burn')}x")
+
+
+def cmd_slo(args) -> int:
+    """`cilium-tpu slo [-f]`: the SLO plane (ISSUE 19) — per-SLO
+    multi-window burn rates, budget remaining, burn-episode state,
+    and the node verdict.  Follow mode re-renders per interval."""
+    c = _client(args)
+    try:
+        while True:
+            st = c.slo()
+            if args.json:
+                _print(st)
+            else:
+                _print_slo(st)
+            if not args.follow:
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_history(args) -> int:
+    """`cilium-tpu history [SERIES...]`: the in-process metrics
+    history ring (ISSUE 19) — recent fast-tier samples per series,
+    newest last; histograms render their cumulative event count.
+    No Prometheus required."""
+    c = _client(args)
+    h = c.metrics_history(series=args.series or None,
+                          since=args.since or 0.0)
+    if args.json:
+        _print(h)
+        return 0
+    fast = h.get("fast") or []
+    print(f"History:   {h.get('samples', 0)} samples, "
+          f"{h.get('resyncs', 0)} resyncs; fast {len(fast)}"
+          f"x{h.get('interval-s')}s, slow {len(h.get('slow') or [])}"
+          f" (1-in-{h.get('slow-every')})")
+    recs = fast[-args.number:]
+    for name in h.get("series") or []:
+        vals = []
+        for r in recs:
+            v = (r.get("v") or {}).get(name)
+            if isinstance(v, dict):
+                v = v.get("count")
+            vals.append("-" if v is None else f"{v:g}")
+        print(f"  {name:<44}{' '.join(vals)}")
+    return 0
+
+
 def cmd_monitor(args) -> int:
     """Tail the flow stream (reference: `cilium monitor`)."""
     c = _client(args)
@@ -1203,10 +1306,12 @@ def main(argv=None) -> int:
                             " | scale (live add_node; --down retires"
                             " one) | sysdump (all-node archive) | "
                             "trace (stitched cross-process spans) | "
-                            "rotate (key-epoch rotation, live)")
+                            "rotate (key-epoch rotation, live) | "
+                            "slo (merged node-labeled health "
+                            "verdict)")
     p.add_argument("action", nargs="?", default="status",
                    choices=["status", "scale", "sysdump", "trace",
-                            "rotate"])
+                            "rotate", "slo"])
     p.add_argument("--down", action="store_true",
                    help="scale IN: retire one replica (drain its "
                         "send window, re-pin slots, migrate CT)")
@@ -1312,6 +1417,25 @@ def main(argv=None) -> int:
     p.add_argument("--interval", type=float, default=1.0)
     p.add_argument("--number", type=int, default=10,
                    help="traces to show in the slowest table")
+
+    p = sub.add_parser("slo",
+                       help="the SLO plane: per-SLO multi-window "
+                            "burn rates, budget remaining, burn "
+                            "episodes, node verdict")
+    p.add_argument("--follow", "-f", action="store_true")
+    p.add_argument("--interval", type=float, default=1.0)
+
+    p = sub.add_parser("history",
+                       help="in-process metrics history ring: "
+                            "recent samples per declared series "
+                            "(10s fast tier + 5min slow tier)")
+    p.add_argument("series", nargs="*",
+                   help="series names (default: every declared "
+                        "history series)")
+    p.add_argument("--since", type=float, default=0.0,
+                   help="only samples from the last SECONDS")
+    p.add_argument("--number", type=int, default=12,
+                   help="fast-tier samples to render per series")
 
     p = sub.add_parser("anomaly", help="anomaly stats | train | synth "
                                        "| score (pcap evaluation)")
@@ -1428,6 +1552,7 @@ def main(argv=None) -> int:
             "flows": cmd_flows, "monitor": cmd_monitor,
             "top": cmd_top, "sysdump": cmd_sysdump,
             "serving": cmd_serving, "trace": cmd_trace,
+            "slo": cmd_slo, "history": cmd_history,
             "anomaly": cmd_anomaly, "daemon": cmd_daemon,
             "service": cmd_service, "fqdn": cmd_fqdn,
             "health": cmd_health, "cluster": cmd_cluster,

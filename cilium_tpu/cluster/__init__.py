@@ -429,6 +429,15 @@ class ClusterNode:
         # thread-affinity: api, cli, capture
         return self.daemon.flightrec.collect_bundle(trigger=trigger)
 
+    def slo(self) -> dict:
+        # thread-affinity: api, cli
+        return self.daemon.slo_snapshot()
+
+    def history(self, series=None, since: float = 0.0) -> dict:
+        # thread-affinity: api, cli
+        return self.daemon.history_snapshot(series=series,
+                                            since=since)
+
     def map_pressure(self) -> Optional[dict]:
         return self.daemon.loader.map_pressure(self.daemon._now())
 

@@ -98,6 +98,8 @@ OP_TIMEOUTS = {
     "publish_drops": 30.0,
     "obs_scrape": 30.0,
     "sysdump": 60.0,
+    "slo": 30.0,
+    "history": 30.0,
     "ack_flush": 10.0,
     "rotate_epoch": 30.0,
     "shutdown": 30.0,
@@ -559,6 +561,21 @@ class _NodeHost:
             self.daemon.flightrec.collect_bundle(
                 trigger=str(req.get("trigger", "cluster-sysdump"))))}
 
+    def _op_slo(self, req: dict) -> dict:
+        """This worker's SLO verdict — ``Daemon.slo_snapshot`` is the
+        one node-stamped definition shared with thread-mode
+        ``ClusterNode.slo``; the relay merges these into the
+        cluster-wide verdict."""
+        return _jsonable(self.daemon.slo_snapshot())
+
+    def _op_history(self, req: dict) -> dict:
+        """Windowed metrics history from this worker's ring —
+        ``Daemon.history_snapshot`` is the shared definition."""
+        series = req.get("series")
+        return _jsonable(self.daemon.history_snapshot(
+            series=list(series) if series is not None else None,
+            since=float(req.get("since", 0.0))))
+
     def _op_map_pressure(self, req: dict) -> dict:
         return {"pressure": _jsonable(
             self.daemon.loader.map_pressure(self.daemon._now()))}
@@ -644,6 +661,8 @@ class _NodeHost:
         "metricsmap": _op_metricsmap,
         "obs_scrape": _op_obs_scrape,
         "sysdump": _op_sysdump,
+        "slo": _op_slo,
+        "history": _op_history,
         "map_pressure": _op_map_pressure,
         "compile_stats": _op_compile_stats,
         "ct_snapshot": _op_ct_snapshot,

@@ -1066,6 +1066,18 @@ class ProcessNode:
                        ) -> dict:
         return self.obs_call("sysdump", trigger=trigger)["bundle"]
 
+    def slo(self) -> dict:
+        """This worker's node-stamped SLO verdict — raises on
+        failure, like ``obs_scrape``: the relay's cluster verdict
+        must COUNT an unreachable node, not skip it."""
+        return self.obs_call("slo")
+
+    def history(self, series=None, since: float = 0.0) -> dict:
+        return self.obs_call(
+            "history",
+            series=list(series) if series is not None else None,
+            since=float(since))
+
     def map_pressure(self) -> Optional[dict]:
         try:
             return self.call("map_pressure",

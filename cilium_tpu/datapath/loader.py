@@ -166,15 +166,24 @@ class Loader(abc.ABC):
         """Remove one ipcache prefix in place (fqdn TTL expiry)."""
         return False
 
-    # -- map pressure (ISSUE 12: pkg/maps ctmap pressure analogue) ----
+    # -- map pressure (ISSUE 12: pkg/maps ctmap pressure analogue;
+    # ISSUE 19 widened the sample beyond CT: LPM/ipcache prefix
+    # occupancy and policy-table row occupancy ride the same
+    # snapshot, feeding cilium_lpm_occupancy /
+    # cilium_policy_map_occupancy and the map-headroom SLO) ----------
     def map_pressure(self, now: int) -> dict:
         """Point-in-time map-pressure snapshot: CT occupancy +
-        cumulative insert drops, NAT pool failures.  Backends
-        override; the default reports an unmeasurable world (the
-        monitor then keys on the counters alone)."""
+        cumulative insert drops, NAT pool failures, LPM/ipcache and
+        policy-table occupancy.  Backends override; the default
+        reports an unmeasurable world (the monitor then keys on the
+        counters alone)."""
         return {"ct": {"capacity": 0, "occupied": 0,
                        "occupancy": None, "insert-drops": 0},
-                "nat": {"capacity": None, "failures": 0}}
+                "nat": {"capacity": None, "failures": 0},
+                "lpm": {"capacity": 0, "entries": 0,
+                        "occupancy": None},
+                "policy": {"capacity": 0, "rows": 0,
+                           "occupancy": None}}
 
 
 class TPULoader(Loader):
@@ -1406,6 +1415,8 @@ class TPULoader(Loader):
         (``CTTable.dropped`` — restore-time drops included), and
         SNAT pool failures.  Runs under the dispatch lock like gc():
         the state capture must not race a donating dispatch."""
+        from .lpm import LPM_NOMINAL_CAPACITY
+
         with self._lock:
             ct = self.state.ct
             occupied = int(np.asarray(_ct_occupied(ct.fp)))
@@ -1414,6 +1425,11 @@ class TPULoader(Loader):
                        if self.nat_state is not None else None)
             nat_failed = (int(np.asarray(self.nat_state.failed))
                           if self.nat_state is not None else 0)
+            # host mirrors only from here down: programmed prefixes
+            # and identity-row headroom never touch the device
+            lpm_entries = len(self._lpm_entries)
+            rows, rows_cap = (self.row_map.row_occupancy()
+                              if self.row_map is not None else (0, 0))
         return {
             "ct": {"capacity": self.ct_capacity,
                    "occupied": occupied,
@@ -1421,6 +1437,13 @@ class TPULoader(Loader):
                                       4),
                    "insert-drops": drops},
             "nat": {"capacity": nat_cap, "failures": nat_failed},
+            "lpm": {"capacity": LPM_NOMINAL_CAPACITY,
+                    "entries": lpm_entries,
+                    "occupancy": round(
+                        lpm_entries / LPM_NOMINAL_CAPACITY, 6)},
+            "policy": {"capacity": rows_cap, "rows": rows,
+                       "occupancy": (round(rows / rows_cap, 4)
+                                     if rows_cap else None)},
         }
 
     def gc(self, now: int) -> int:
@@ -1515,7 +1538,14 @@ class InterpreterLoader(Loader):
         unbounded dict (no probe window), so occupancy is None and
         insert drops stay 0 — the pressure monitor then keys on the
         NAT counters alone, which DO mirror the device pool."""
+        from .lpm import LPM_NOMINAL_CAPACITY
+
         live = len(self.oracle.ct) if self.oracle is not None else 0
+        lpm_entries = (len(self.oracle.ipcache)
+                       + len(self.oracle._exact)
+                       if self.oracle is not None else 0)
+        rows, rows_cap = (self.row_map.row_occupancy()
+                          if self.row_map is not None else (0, 0))
         return {
             "ct": {"capacity": 0, "occupied": live,
                    "occupancy": None, "insert-drops": 0},
@@ -1523,6 +1553,13 @@ class InterpreterLoader(Loader):
                                  if self.nat_state is not None
                                  else None),
                     "failures": self.nat_failed},
+            "lpm": {"capacity": LPM_NOMINAL_CAPACITY,
+                    "entries": lpm_entries,
+                    "occupancy": round(
+                        lpm_entries / LPM_NOMINAL_CAPACITY, 6)},
+            "policy": {"capacity": rows_cap, "rows": rows,
+                       "occupancy": (round(rows / rows_cap, 4)
+                                     if rows_cap else None)},
         }
 
     def nat_snapshot(self) -> Optional[np.ndarray]:

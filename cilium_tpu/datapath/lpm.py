@@ -29,6 +29,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# nominal prefix budget for the map-pressure occupancy fraction
+# (ISSUE 19): the DIR-16-8-8 tables grow on demand, but operators
+# need a headroom signal like upstream's fixed-size ipcache map —
+# this is the declared comfortable ceiling the pressure monitor and
+# the map-headroom SLO measure against
+LPM_NOMINAL_CAPACITY = 1 << 16
+
 
 @dataclass
 class LPMTensors:

@@ -35,6 +35,7 @@ number the accelerated sweep visibly drives back down.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, Optional
 
 STATE_OK = "ok"
@@ -64,6 +65,27 @@ def validate_pressure_config(interval_s, ct_threshold, ct_clear,
             gc_pressure_interval_s)
 
 
+def validate_relax_config(relax_after_s, relax_factor,
+                          relax_max) -> tuple:
+    """Validate the adaptive GC-relaxation knobs (ISSUE 19
+    satellite; same fail-at-construction contract)."""
+    relax_after_s = float(relax_after_s)
+    if relax_after_s < 0:
+        raise ValueError("ct_gc_relax_after must be >= 0 "
+                         "(0 disables relaxation)")
+    relax_factor = float(relax_factor)
+    if relax_factor <= 1.0:
+        raise ValueError("ct_gc_relax_factor must be > 1 (a "
+                         "non-stretching relax step would spin the "
+                         "multiplier without changing the cadence)")
+    relax_max = float(relax_max)
+    if relax_max < relax_factor:
+        raise ValueError("ct_gc_relax_max must be >= "
+                         "ct_gc_relax_factor (the bound must admit "
+                         "at least one step)")
+    return relax_after_s, relax_factor, relax_max
+
+
 class MapPressureMonitor:
     """Samples map pressure, drives the graceful-degradation
     response.  ``sample_fn()`` returns the loader's map_pressure
@@ -78,7 +100,11 @@ class MapPressureMonitor:
                  record_incident: Optional[Callable] = None,
                  ct_threshold: float = 0.85,
                  ct_clear: float = 0.70,
-                 gc_pressure_interval_s: float = 1.0):
+                 gc_pressure_interval_s: float = 1.0,
+                 relax_after_s: float = 0.0,
+                 relax_factor: float = 2.0,
+                 relax_max: float = 4.0,
+                 on_relax: Optional[Callable[[float], None]] = None):
         self._sample_fn = sample_fn
         self._on_accelerate = on_accelerate
         self._on_restore = on_restore
@@ -86,9 +112,19 @@ class MapPressureMonitor:
         self.ct_threshold = float(ct_threshold)
         self.ct_clear = float(ct_clear)
         self.gc_pressure_interval_s = float(gc_pressure_interval_s)
+        # adaptive relaxation (ISSUE 19 satellite): after every
+        # relax_after_s of CONTINUOUS calm the normal GC cadence
+        # stretches by relax_factor (compounding, bounded by
+        # relax_max); any episode snaps the multiplier back to 1.
+        # 0 disables.  on_relax(multiplier) re-schedules the sweep
+        self.relax_after_s = float(relax_after_s)
+        self.relax_factor = float(relax_factor)
+        self.relax_max = float(relax_max)
+        self._on_relax = on_relax
         self._lock = threading.Lock()
         # guarded-by: _lock: state, episodes, samples, last,
-        # guarded-by: _lock: _prev_drops, _prev_nat, last_episode
+        # guarded-by: _lock: _prev_drops, _prev_nat, last_episode,
+        # guarded-by: _lock: relax_mult, relaxes, _calm_since
         self.state = STATE_OK
         self.episodes = 0  # completed ENTRIES into pressure
         self.samples = 0
@@ -98,14 +134,22 @@ class MapPressureMonitor:
         self.last_episode: Optional[Dict] = None
         self._prev_drops: Optional[int] = None
         self._prev_nat: Optional[int] = None
+        self.relax_mult = 1.0
+        self.relaxes = 0  # completed relax STEPS
+        self._calm_since: Optional[float] = None
 
     # -- the controller body -------------------------------------------
-    def sample(self) -> Dict:
+    def sample(self, now: Optional[float] = None) -> Dict:
         # thread-affinity: api -- the map-pressure controller thread
         # (plus Daemon.start()'s synchronous warm call); never the
         # drain thread
         """One monitor tick: fetch the pressure snapshot, update the
-        per-window rates, and walk the state machine."""
+        per-window rates, and walk the state machine.  ``now`` is the
+        monotonic clock the relaxation streak measures against —
+        injectable so tests pin the never-mid-episode guarantee on a
+        fake timeline."""
+        if now is None:
+            now = time.monotonic()
         snap = self._sample_fn()
         ct = snap["ct"]
         nat = snap["nat"]
@@ -129,6 +173,11 @@ class MapPressureMonitor:
             if self.state == STATE_OK and hot:
                 self.state = STATE_PRESSURE
                 self.episodes += 1
+                # entering an episode snaps relaxation back: the
+                # accelerated cadence takes over, and whatever calm
+                # streak was building is void
+                self.relax_mult = 1.0
+                self._calm_since = None
                 episode_detail = {
                     "occupancy": occ,
                     "insert-drop-delta": d_drops,
@@ -155,8 +204,34 @@ class MapPressureMonitor:
                 self.state = STATE_OK
                 snap["state"] = self.state
                 self.last = snap
+                # the episode just closed: the calm streak starts
+                # NOW — relaxation needs a full relax_after_s of
+                # post-episode calm before its first step, so it can
+                # never fire mid-episode (test-pinned)
+                self._calm_since = now
                 self._on_restore()
             else:
+                if self.state == STATE_OK and self.relax_after_s > 0:
+                    if not calm:
+                        # sub-threshold heat (occupancy inside the
+                        # hysteresis band, or deltas on an already-
+                        # pressured map shape) resets the streak
+                        # without opening an episode
+                        self._calm_since = None
+                    elif self._calm_since is None:
+                        self._calm_since = now
+                    elif (now - self._calm_since >= self.relax_after_s
+                          and self.relax_mult < self.relax_max):
+                        self.relax_mult = min(
+                            self.relax_max,
+                            self.relax_mult * self.relax_factor)
+                        self.relaxes += 1
+                        self._calm_since = now
+                        if self._on_relax is not None:
+                            # under the lock like on_accelerate: a
+                            # concurrent resync() serializes against
+                            # the stretched cadence
+                            self._on_relax(self.relax_mult)
                 snap["state"] = self.state
                 self.last = snap
         return snap
@@ -172,7 +247,7 @@ class MapPressureMonitor:
         with self._lock:
             schedule(self.gc_pressure_interval_s
                      if self.state == STATE_PRESSURE
-                     else normal_interval_s)
+                     else normal_interval_s * self.relax_mult)
 
     # -- reading --------------------------------------------------------
     def stats(self) -> Dict:
@@ -187,6 +262,13 @@ class MapPressureMonitor:
                 "ct-clear": self.ct_clear,
                 "gc-pressure-interval-s": self.gc_pressure_interval_s,
                 "accelerated": self.state == STATE_PRESSURE,
+                "relax": {
+                    "after-s": self.relax_after_s,
+                    "factor": self.relax_factor,
+                    "max": self.relax_max,
+                    "multiplier": self.relax_mult,
+                    "steps": self.relaxes,
+                },
             }
             if self.last is not None:
                 out["ct"] = dict(self.last["ct"])

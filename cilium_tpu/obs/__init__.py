@@ -41,6 +41,14 @@ Pieces (PARITY.md row 57):
   and drop-spike detection over the decoded event stream; all
   aggregation runs OFF the dispatch path (event-join worker / query
   threads).  ``GET /flows/aggregate``, ``cilium-tpu top [-f]``.
+- :mod:`.history` / :mod:`.slo` — the SLO plane (ISSUE 19): fixed-
+  memory two-tier rings retaining a declared subset of registry
+  series (counter-reset splicing included), and declarative SLOs
+  evaluated with fast+slow multi-window burn rates over those rings
+  on one off-hot-path sampler thread — a page-severity burn opens a
+  ``slo-burn`` incident episode (sysdump auto-capture, hysteresis,
+  recovery recorded).  ``GET /metrics/history``, ``GET /slo``,
+  ``cilium-tpu history/slo``, ``cilium_slo_*`` series.
 - :mod:`.flightrec` — the incident flight recorder: named incidents
   (spike, watchdog restart, ladder demotion, terminal event worker,
   manual) capture bounded, retention-capped sysdump bundles to
@@ -56,7 +64,11 @@ from .analytics import (FlowAnalytics, SpaceSavingSketch,  # noqa: F401
 from .compile_log import CompileLog  # noqa: F401
 from .flightrec import (SYSDUMP_REQUIRED_KEYS,  # noqa: F401
                         FlightRecorder, validate_flightrec_config)
+from .history import (SeriesHistory, counters_reset,  # noqa: F401
+                      validate_history_config)
 from .registry import MetricsRegistry, build_daemon_registry  # noqa: F401
+from .slo import (HISTORY_SERIES, SLODef, SLOEngine,  # noqa: F401
+                  default_slos, validate_slo_config)
 from .trace import (SPAN_STAGES, SpanTracer, TraceSpan,  # noqa: F401
                     validate_obs_config)
 
@@ -64,16 +76,24 @@ __all__ = [
     "CompileLog",
     "FlightRecorder",
     "FlowAnalytics",
+    "HISTORY_SERIES",
     "MetricsRegistry",
+    "SLODef",
+    "SLOEngine",
     "SPAN_STAGES",
     "SYSDUMP_REQUIRED_KEYS",
+    "SeriesHistory",
     "SpaceSavingSketch",
     "SpanTracer",
     "SpikeDetector",
     "TraceSpan",
     "WindowAggregator",
     "build_daemon_registry",
+    "counters_reset",
+    "default_slos",
     "validate_analytics_config",
     "validate_flightrec_config",
+    "validate_history_config",
     "validate_obs_config",
+    "validate_slo_config",
 ]

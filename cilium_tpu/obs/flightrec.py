@@ -79,6 +79,11 @@ KIND_CRYPTO_DESYNC = "crypto-desync"
 # and entered the pressure state (one incident per episode; the
 # accelerated CT aging sweep is the paired response)
 KIND_MAP_PRESSURE = "map-pressure"
+# an SLO's fast AND slow burn rates crossed the page threshold
+# (obs/slo.py) — the error budget is burning fast enough to exhaust
+# inside the slow window; one incident per episode (hysteresis), the
+# recovery recorded on the episode when the burn clears
+KIND_SLO_BURN = "slo-burn"
 KIND_MANUAL = "manual"
 
 # required top-level bundle keys (scripts/check_sysdump_schema.py
@@ -87,7 +92,7 @@ KIND_MANUAL = "manual"
 SYSDUMP_REQUIRED_KEYS = (
     "schema", "node", "taken-at", "trigger", "incident", "config",
     "serving", "compile", "traces", "flows", "flow-aggregation",
-    "incidents", "metrics", "pressure",
+    "incidents", "metrics", "pressure", "history", "slo",
 )
 SYSDUMP_SCHEMA = 1
 
@@ -143,15 +148,20 @@ class FlightRecorder:
         self.node = node
         self._lock = threading.Lock()
         # guarded-by: _lock: _incidents, _seq, _capturing,
-        # guarded-by: _lock: _last_capture, incidents_total,
+        # guarded-by: _lock: _capture_owner, _last_capture,
+        # guarded-by: _lock: incidents_total,
         # guarded-by: _lock: writes_total, captures_skipped,
         # guarded-by: _lock: write_errors, last_bundle, last_error
+        self._capture_done = threading.Condition(self._lock)
         self._incidents: List[dict] = []
         self._seq = 0
         self._last_capture = 0.0
-        self._capturing = False  # re-entrancy guard (same or cross
-        # thread: a capture triggered during a capture is skipped,
-        # counted — its incident is still recorded)
+        self._capturing = False  # re-entrancy guard: an AUTO capture
+        # triggered during a capture is skipped, counted — its
+        # incident is still recorded; a MANUAL capture waits briefly
+        # for the in-flight bundle (an operator's sysdump must not
+        # be declined because a burn episode happened to be writing)
+        self._capture_owner: Optional[int] = None
         self.incidents_total: Dict[str, int] = {}
         self.writes_total = 0
         self.captures_skipped = 0
@@ -237,29 +247,47 @@ class FlightRecorder:
                 manual: bool = True) -> Optional[str]:
         # thread-affinity: capture, api, cli
         """Write one bundle; returns its path, or None when disabled,
-        rate-limited (auto only), or nested inside another capture."""
+        rate-limited (auto only), nested inside another capture on
+        the SAME thread, or (manual) when a concurrent capture does
+        not finish within the grace period.  A manual request racing
+        an auto-capture thread WAITS for it rather than declining:
+        with periodic burn evaluation an auto bundle can be mid-write
+        at any instant, and the operator asked for a dump, not a
+        maybe."""
         if not self.enabled:
             return None
         now = time.monotonic()
-        with self._lock:
-            if self._capturing:
+        me = threading.get_ident()
+        with self._capture_done:
+            if self._capturing and (not manual
+                                    or self._capture_owner == me):
                 self.captures_skipped += 1
                 return None
+            deadline = now + 5.0
+            while self._capturing:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self.captures_skipped += 1
+                    return None
+                self._capture_done.wait(remaining)
             if (not manual and self.min_interval_s > 0
                     and self._last_capture
-                    and now - self._last_capture
+                    and time.monotonic() - self._last_capture
                     < self.min_interval_s):
                 self.captures_skipped += 1
                 return None
             self._capturing = True
-            self._last_capture = now
+            self._capture_owner = me
+            self._last_capture = time.monotonic()
             seq = self._seq
             recent = [dict(i) for i in self._incidents[-32:]]
         try:
             return self._write_bundle(trigger, incident, recent, seq)
         finally:
-            with self._lock:
+            with self._capture_done:
                 self._capturing = False
+                self._capture_owner = None
+                self._capture_done.notify_all()
 
     def collect_bundle(self, trigger: str = KIND_MANUAL,
                        incident: Optional[dict] = None,

@@ -1,0 +1,328 @@
+"""In-process metrics history: fixed-memory ring of registry samples
+(ISSUE 19, tentpole part 1).
+
+Reference: upstream cilium leans on an external Prometheus for
+retention, but cilium-health and Hubble both keep a bounded
+in-process window so "trending which way" survives without a scrape
+stack.  Here `SeriesHistory` retains a DECLARED subset of registry
+series (``MetricsRegistry.sample``) in two downsample tiers — a fast
+ring (default 10 s x 360 slots = 1 h) and a slow ring fed every
+``slow_every``-th sample (default 5 min x 288 slots = 24 h) — both
+``deque(maxlen=...)``, so memory is fixed no matter the uptime.
+
+Counter-reset discipline: a daemon restart zeroes every cumulative
+counter.  Emitting the raw values would make every windowed rate go
+negative for one window; instead the sampler detects the reset
+(:func:`counters_reset` — the ONE definition, shared with the CLI's
+``serving stats --follow`` resync) and carries a per-series offset so
+the ADJUSTED series stays monotone (the Prometheus
+``rate()``-across-restart convention).  The reset is recorded on the
+sample (``resync: [names]``) so operators see the restart instead of
+a silent splice.  Histograms get the same treatment vectorized over
+their cumulative bucket counts.
+
+The ring is a pure data structure — it owns no thread.  The SLO
+engine (``obs/slo.py``) owns the sampler cadence and calls
+:meth:`take_sample`; queries (``GET /metrics/history``, ``cilium-tpu
+history``) only read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def counters_reset(pairs: "Sequence[Tuple[object, object]]") -> bool:
+    """True when any (current, previous) cumulative-counter pair went
+    BACKWARD — the one shared definition of "the process restarted"
+    (a live counter is monotone; only a restart rewinds it).  Used by
+    the CLI follow loop (full-block resync, no negative rates) and
+    the history sampler (offset splice, no negative deltas).
+    Non-numeric / missing values never signal a reset."""
+    for cur, prev in pairs:
+        if (isinstance(cur, (int, float))
+                and isinstance(prev, (int, float))
+                and not isinstance(cur, bool)
+                and not isinstance(prev, bool)
+                and cur < prev):
+            return True
+    return False
+
+
+def validate_history_config(interval_s, slots, slow_every,
+                            slow_slots) -> tuple:
+    """Validate the history DaemonConfig knobs (the
+    validate_serving_config contract: fail at construction)."""
+    interval_s = float(interval_s)
+    if interval_s < 0:
+        raise ValueError("history_interval must be >= 0 "
+                         "(0 disables the sampler)")
+    slots = int(slots)
+    slow_slots = int(slow_slots)
+    if slots <= 1 or slow_slots <= 1:
+        raise ValueError("history_slots / history_slow_slots must "
+                         "be > 1 (a one-slot ring cannot hold a "
+                         "rate window)")
+    slow_every = int(slow_every)
+    if slow_every <= 0:
+        raise ValueError("history_slow_every must be > 0")
+    return interval_s, slots, slow_every, slow_slots
+
+
+class SeriesHistory:
+    """Two-tier ring of adjusted registry samples.
+
+    ``sample_fn()`` returns ``{name: value}`` in the
+    ``MetricsRegistry.sample`` shape; ``kinds`` maps each declared
+    name to counter/gauge/histogram (the reset-vs-passthrough
+    switch).  All mutation happens in :meth:`take_sample` under one
+    lock; readers get copies."""
+
+    # guarded-by: _lock: _fast, _slow, _offset, _prev, samples,
+    # guarded-by: _lock: resyncs
+
+    def __init__(self, sample_fn: Callable[[], Dict[str, object]],
+                 kinds: Dict[str, str],
+                 interval_s: float = 10.0,
+                 slots: int = 360,
+                 slow_every: int = 30,
+                 slow_slots: int = 288):
+        self._sample_fn = sample_fn
+        self.kinds = dict(kinds)
+        self.interval_s = float(interval_s)
+        self.slots = int(slots)
+        self.slow_every = int(slow_every)
+        self.slow_slots = int(slow_slots)
+        self._lock = threading.Lock()
+        self._fast: deque = deque(maxlen=self.slots)
+        self._slow: deque = deque(maxlen=self.slow_slots)
+        # per-series reset splice state: _prev holds the last RAW
+        # value (scalar for counters, the full dict for histograms),
+        # _offset the accumulated pre-restart total the adjusted
+        # series continues from
+        self._prev: Dict[str, object] = {}
+        self._offset: Dict[str, object] = {}
+        self.samples = 0
+        self.resyncs = 0
+
+    # -- writing (the SLO engine's tick) -------------------------------
+    # (named take_sample, not sample: the callgraph's name-match
+    # fallback would otherwise bind tick's call here to the
+    # api-affine MapPressureMonitor.sample)
+    def take_sample(self, now: Optional[float] = None,
+                    wall: Optional[float] = None) -> dict:
+        # thread-affinity: slo, api, cli
+        """One sampler tick: pull the declared subset, splice any
+        counter reset, append to the fast ring (and every
+        ``slow_every``-th tick to the slow ring).  ``now`` is the
+        monotonic timestamp window math uses; ``wall`` the operator-
+        facing epoch time — both injectable for deterministic
+        tests."""
+        raw = self._sample_fn()
+        if now is None:
+            now = time.monotonic()
+        if wall is None:
+            wall = time.time()
+        with self._lock:
+            values: Dict[str, object] = {}
+            reset_names: List[str] = []
+            for name, v in raw.items():
+                kind = self.kinds.get(name)
+                if kind == "counter":
+                    values[name] = self._adjust_counter(
+                        name, v, reset_names)
+                elif kind == "histogram":
+                    values[name] = self._adjust_hist(
+                        name, v, reset_names)
+                else:  # gauge (or undeclared kind): pass through
+                    values[name] = v
+            rec = {"t": now, "at": wall, "v": values}
+            if reset_names:
+                self.resyncs += 1
+                rec["resync"] = sorted(reset_names)
+            self._fast.append(rec)
+            if self.samples % self.slow_every == 0:
+                self._slow.append(rec)
+            self.samples += 1
+            return rec
+
+    def _adjust_counter(self, name: str, v, reset_names) -> float:
+        # holds: _lock
+        prev = self._prev.get(name)
+        off = self._offset.get(name, 0.0)
+        if prev is not None and counters_reset([(v, prev)]):
+            # splice: the adjusted series continues from where the
+            # dead process left it, the fresh raw counts from there
+            off = off + prev
+            reset_names.append(name)
+        self._prev[name] = v
+        self._offset[name] = off
+        return float(off) + float(v)
+
+    def _adjust_hist(self, name: str, v: dict, reset_names) -> dict:
+        # holds: _lock
+        prev = self._prev.get(name)
+        off = self._offset.get(name)
+        if off is None:
+            off = {"buckets": [0] * len(v["buckets"]),
+                   "count": 0, "sum": 0.0}
+        if (prev is not None
+                and counters_reset([(v["count"], prev["count"])])):
+            # vectorized splice over the cumulative bucket counts
+            off = {"buckets": [o + p for o, p in
+                               zip(off["buckets"], prev["buckets"])],
+                   "count": off["count"] + prev["count"],
+                   "sum": off["sum"] + prev["sum"]}
+            reset_names.append(name)
+        self._prev[name] = v
+        self._offset[name] = off
+        return {"buckets": [o + b for o, b in
+                            zip(off["buckets"], v["buckets"])],
+                "count": off["count"] + v["count"],
+                "sum": off["sum"] + v["sum"]}
+
+    # -- reading --------------------------------------------------------
+    def _merged(self) -> List[dict]:
+        """Both tiers as one time-ordered record list (the slow ring
+        extends the window past the fast ring's span; records the
+        fast ring still holds dedupe by timestamp)."""
+        with self._lock:
+            recs = {r["t"]: r for r in self._slow}
+            recs.update({r["t"]: r for r in self._fast})
+        return [recs[t] for t in sorted(recs)]
+
+    def _window(self, window_s: float, now: Optional[float]
+                ) -> Tuple[Optional[dict], List[dict]]:
+        """Records inside ``[now - window_s, now]`` plus the baseline
+        record just BEFORE the window (rate deltas anchor on it, so a
+        window covers its full span instead of losing the first
+        sample interval)."""
+        if now is None:
+            now = time.monotonic()
+        cutoff = now - float(window_s)
+        base: Optional[dict] = None
+        win: List[dict] = []
+        for r in self._merged():
+            if r["t"] < cutoff:
+                base = r
+            else:
+                win.append(r)
+        return base, win
+
+    def counter_delta(self, name: str, window_s: float,
+                      now: Optional[float] = None
+                      ) -> Optional[float]:
+        """Adjusted increase of a counter over the window, or None
+        when the ring lacks two datapoints for it (never negative —
+        the splice guarantees monotone)."""
+        base, win = self._window(window_s, now)
+        if not win:
+            return None
+        first = base if base is not None else win[0]
+        last = win[-1]
+        if first is last:
+            return None
+        a, b = first["v"].get(name), last["v"].get(name)
+        if not isinstance(a, (int, float)) or not isinstance(
+                b, (int, float)):
+            return None
+        return float(b) - float(a)
+
+    def hist_delta(self, name: str, window_s: float,
+                   now: Optional[float] = None) -> Optional[dict]:
+        """Adjusted bucket/count increase over the window (the
+        percentile-SLO substrate: cumulative log2 buckets are
+        counters, so the window's distribution is a difference)."""
+        base, win = self._window(window_s, now)
+        if not win:
+            return None
+        first = base if base is not None else win[0]
+        last = win[-1]
+        if first is last:
+            return None
+        a, b = first["v"].get(name), last["v"].get(name)
+        if not isinstance(a, dict) or not isinstance(b, dict):
+            return None
+        return {"buckets": [y - x for x, y in
+                            zip(a["buckets"], b["buckets"])],
+                "count": b["count"] - a["count"],
+                "sum": b["sum"] - a["sum"]}
+
+    def gauge_window(self, name: str, window_s: float,
+                     now: Optional[float] = None) -> List[float]:
+        """Every gauge sample inside the window, oldest first."""
+        _base, win = self._window(window_s, now)
+        out: List[float] = []
+        for r in win:
+            v = r["v"].get(name)
+            if isinstance(v, (int, float)) and not isinstance(
+                    v, bool):
+                out.append(float(v))
+        return out
+
+    def query(self, series: Optional[Sequence[str]] = None,
+              since: float = 0.0) -> dict:
+        # thread-affinity: any
+        """``GET /metrics/history`` body: both tiers, operator
+        (epoch) timestamps, optionally filtered to a series subset
+        and to samples at/after ``since``."""
+        want = set(series) if series else None
+
+        def emit(ring: Sequence[dict]) -> List[dict]:
+            out = []
+            for r in ring:
+                if r["at"] < since:
+                    continue
+                v = r["v"]
+                if want is not None:
+                    v = {k: v[k] for k in want if k in v}
+                row = {"at": r["at"], "v": v}
+                if "resync" in r:
+                    row["resync"] = r["resync"]
+                out.append(row)
+            return out
+
+        with self._lock:
+            fast = list(self._fast)
+            slow = list(self._slow)
+            samples = self.samples
+            resyncs = self.resyncs
+        return {
+            "interval-s": self.interval_s,
+            "slots": self.slots,
+            "slow-every": self.slow_every,
+            "slow-slots": self.slow_slots,
+            "series": (sorted(want & set(self.kinds))
+                       if want is not None else sorted(self.kinds)),
+            "samples": samples,
+            "resyncs": resyncs,
+            "fast": emit(fast),
+            "slow": emit(slow),
+        }
+
+    def stats(self) -> dict:
+        # thread-affinity: any
+        """The serving-stats / sysdump summary block (counts, not
+        the rings themselves)."""
+        with self._lock:
+            fast_len = len(self._fast)
+            slow_len = len(self._slow)
+            span = (self._fast[-1]["t"] - self._fast[0]["t"]
+                    if fast_len >= 2 else 0.0)
+            if slow_len >= 2:
+                span = max(span,
+                           self._slow[-1]["t"] - self._slow[0]["t"])
+            samples = self.samples
+            resyncs = self.resyncs
+        return {
+            "interval-s": self.interval_s,
+            "series": len(self.kinds),
+            "samples": samples,
+            "resyncs": resyncs,
+            "fast-len": fast_len,
+            "slow-len": slow_len,
+            "span-s": round(span, 3),
+        }

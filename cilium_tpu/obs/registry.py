@@ -159,6 +159,57 @@ class MetricsRegistry:
         return [{"name": m["name"], "type": m["type"],
                  "help": m["help"]} for m in self._metrics]
 
+    def kind(self, name: str) -> Optional[str]:
+        """counter/gauge/histogram for a registered name, else None
+        — the SeriesHistory sampler's reset-vs-passthrough switch."""
+        for m in self._metrics:
+            if m["name"] == name:
+                return m["type"]
+        return None
+
+    # -- sampling (the SeriesHistory feed) -----------------------------
+    def sample(self, names: "Sequence[str]") -> Dict[str, object]:
+        """One NUMERIC sample of a declared subset — the
+        ``SeriesHistory`` feed.  Same pull model as :meth:`render`
+        (``prepare`` once, then only the requested collectors; a
+        broken or None collector omits its series), but values come
+        back as numbers, not exposition text: counters/gauges as a
+        float (labelled families summed — history retains the
+        family total, the live exposition keeps the breakdown),
+        histograms as ``{"buckets": [...], "count", "sum"}`` with
+        the bucket list copied ONCE (the torn-read discipline of
+        ``_render_histogram``: count derives from that copy)."""
+        want = set(names)
+        if self._prepare is not None:
+            self._prepare()
+        out: Dict[str, object] = {}
+        for m in self._metrics:
+            if m["name"] not in want:
+                continue
+            try:
+                got = m["collect"]()
+            except Exception:  # noqa: BLE001 — a broken collector
+                continue  # must not kill the sampler tick
+            if got is None:
+                continue
+            if m["type"] == "histogram":
+                buckets = list(got.buckets)
+                out[m["name"]] = {"buckets": buckets,
+                                  "count": sum(buckets),
+                                  "sum": float(got.total_us)}
+            elif isinstance(got, (list, tuple)):
+                total = 0.0
+                for _labels_d, v in got:
+                    if isinstance(v, bool) or not isinstance(
+                            v, (int, float)):
+                        continue
+                    total += float(v)
+                out[m["name"]] = total
+            elif isinstance(got, (int, float)) and not isinstance(
+                    got, bool):
+                out[m["name"]] = float(got)
+        return out
+
 
 # -- flow metrics (pkg/hubble/metrics analogue) -----------------------
 def register_flow_metrics(reg: MetricsRegistry, fm) -> None:
@@ -253,6 +304,11 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
     reg.counter("cilium_serving_shed_total",
                 "packets shed at serving admission",
                 lambda: sv("shed"))
+    reg.counter("cilium_serving_submitted_total",
+                "packets offered to serving admission (the "
+                "availability SLO denominator: shed + recovery "
+                "drops over this)",
+                lambda: sv("submitted"))
     reg.counter("cilium_serving_batches_total",
                 "serving batches dispatched", lambda: sv("batches"))
     # the K-batch superbatch scoreboard (ISSUE 11): device dispatches
@@ -302,6 +358,19 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                 "ring events lost to lap overrun (appended - "
                 "capacity while the consumer lagged a full lap)",
                 lambda: sv("event-plane", "ring-lost"))
+
+    def ring_events_total():
+        ep = sv("event-plane")
+        if not isinstance(ep, dict):
+            return None
+        return (int(ep.get("events-joined") or 0)
+                + int(ep.get("events-dropped") or 0)
+                + int(ep.get("ring-lost") or 0))
+
+    reg.counter("cilium_serving_ring_events_total",
+                "ring events produced (joined + dropped + lapped) — "
+                "the event-plane loss SLO denominator",
+                ring_events_total)
 
     def eventplane():
         s = daemon._serving
@@ -417,6 +486,13 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                 "window drained, slots re-pinned, CT migrated to "
                 "each slot's new owner)",
                 lambda: cl(lambda c: c.scale_ins_total()))
+    reg.counter("cilium_cluster_obs_scrapes_total",
+                "successful relay scrapes of worker nodes (the "
+                "cluster scrape-health SLO denominator)",
+                lambda: cl(lambda c: c.obs.scrape_counts()[0]))
+    reg.counter("cilium_cluster_obs_scrape_errors_total",
+                "failed relay scrapes of worker nodes",
+                lambda: cl(lambda c: c.obs.scrape_counts()[1]))
     reg.gauge("cilium_cluster_inflight_frames",
               "pipelined data-channel frames sent but not yet "
               "cumulatively acked, summed over windowed nodes "
@@ -680,6 +756,16 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
                 "SNAT port allocations failed on pool exhaustion "
                 "(DROP_NAT_NO_MAPPING pressure)",
                 pressure("nat", "failures"))
+    reg.gauge("cilium_lpm_occupancy",
+              "LPM/ipcache table occupancy fraction (programmed "
+              "prefixes / table capacity) at the last pressure "
+              "sample",
+              pressure("lpm", "occupancy"))
+    reg.gauge("cilium_policy_map_occupancy",
+              "policy-table occupancy fraction (programmed "
+              "identity rows / table capacity) at the last "
+              "pressure sample",
+              pressure("policy", "occupancy"))
     reg.gauge("cilium_map_pressure",
               "1 while the map-pressure monitor is in the pressure "
               "state (CT aging sweep accelerated)",
@@ -700,6 +786,28 @@ def build_daemon_registry(daemon) -> MetricsRegistry:
     reg.gauge("cilium_ct_snapshot_entries",
               "entries in the retained CT snapshot",
               ct_snap("entries"))
+
+    # -- SLO plane (obs/slo.py).  Collectors read the engine's CACHED
+    # last evaluation — the slo-sampler thread does the window math,
+    # a scrape never evaluates.  getattr: the engine is constructed
+    # AFTER the registry (it samples the registry), so the back
+    # reference resolves lazily; None (engine off or not yet ticked)
+    # omits the family.  CTA014 pins these three names ---------------
+    def slo(fn):
+        eng = getattr(daemon, "slo", None)
+        return None if eng is None else fn(eng)
+
+    reg.gauge("cilium_slo_budget_remaining",
+              "unconsumed fraction of each SLO's slow-window error "
+              "budget (1 = untouched, 0 = exhausted)",
+              lambda: slo(lambda e: e.budget_series()))
+    reg.gauge("cilium_slo_burn_rate",
+              "error-budget burn rate per SLO and window (1 = "
+              "burning exactly the window's budget)",
+              lambda: slo(lambda e: e.burn_series()))
+    reg.gauge("cilium_slo_state",
+              "SLO state code (0 ok, 1 no-data, 2 warn, 3 page)",
+              lambda: slo(lambda e: e.state_series()))
 
     # -- flow-stream handlers (pkg/hubble/metrics) --------------------
     register_flow_metrics(reg, daemon.flow_metrics)

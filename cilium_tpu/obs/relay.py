@@ -66,10 +66,11 @@ import os
 import tarfile
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..serving.stats import LatencyHistogram
 from .registry import MetricsRegistry, escape_label_value
+from .slo import STATE_CODES, STATE_NO_DATA, STATE_OK
 
 __all__ = [
     "ClusterObsRelay", "ClusterSpanStore", "TraceCtx",
@@ -358,6 +359,12 @@ class ClusterObsRelay:
         #               "incidents", "error"}
         self._cache: Dict[str, dict] = {}
         self._cursors: Dict[str, int] = {}
+        # node name -> {"snap" (last-good slo_snapshot), "at"
+        #               (monotonic), "ok", "error"} — same
+        # last-known-good + staleness discipline as _cache, but for
+        # the SLO verdict pull (cluster_slo sweeps on demand; the
+        # verdict is too small to ride the scrape snapshot)
+        self._slo_cache: Dict[str, dict] = {}
         self.scrapes_total = 0
         self.scrape_errors = 0
         self.rtt = LatencyHistogram()
@@ -690,6 +697,88 @@ class ClusterObsRelay:
                     for k in ("sample", "started", "completed",
                               "dropped")}
         return out
+
+    def cluster_slo(self) -> dict:
+        # thread-affinity: api, cli
+        """``GET /cluster/slo``: ONE cluster health verdict, merged
+        worst-of over every node's SLO verdict with each node's
+        contribution labeled.  Per-node pulls are contained exactly
+        like ``_sweep``: a dead/wedged worker is COUNTED (its node
+        entry degrades to no-data with the error string), never
+        skipped — a SIGKILLed worker must move the cluster verdict,
+        not silently shrink the denominator.  Last-known-good
+        verdicts serve under the PR 14 staleness rules (the age
+        bound applies only to FAILED nodes; a node whose last pull
+        succeeded serves however old, with age-s saying how old)."""
+        now = time.monotonic()
+        for node in list(self._peers_fn()):
+            name = node.name
+            snap, err = None, None
+            if not getattr(node, "alive", True):
+                err = "node dead"
+            else:
+                try:
+                    snap = node.slo()
+                except Exception as e:  # noqa: BLE001 — contained,
+                    # like _sweep: the verdict merge below turns the
+                    # failure into a node-labeled degradation
+                    err = f"{type(e).__name__}: {e}"
+            with self._lock:
+                if snap is not None:
+                    self._slo_cache[name] = {
+                        "snap": snap, "at": time.monotonic(),
+                        "ok": True, "error": None}
+                else:
+                    ent = self._slo_cache.setdefault(
+                        name, {"snap": None, "at": None})
+                    ent["ok"] = False
+                    ent["error"] = err
+        with self._lock:
+            cache = {name: dict(e)
+                     for name, e in self._slo_cache.items()}
+        worst = STATE_OK
+        nodes: Dict[str, dict] = {}
+        unreachable: List[str] = []
+        for name, ent in sorted(cache.items()):
+            at = ent.get("at")
+            age = (now - at) if at is not None else None
+            stale = (age is None
+                     or (not ent.get("ok")
+                         and age > self.stale_after_s))
+            snap = ent.get("snap")
+            out = {"ok": bool(ent.get("ok")), "stale": stale,
+                   "age-s": (round(age, 3) if age is not None
+                             else None)}
+            if ent.get("error"):
+                out["error"] = ent["error"]
+            if stale or snap is None:
+                out["verdict"] = STATE_NO_DATA
+            else:
+                out["verdict"] = str(snap.get("verdict",
+                                              STATE_NO_DATA))
+                out["slos"] = {
+                    sname: ev.get("state")
+                    for sname, ev in (snap.get("slos") or {}).items()}
+                out["active"] = sorted(snap.get("active") or {})
+            if not ent.get("ok"):
+                unreachable.append(name)
+            if (STATE_CODES.get(out["verdict"], 0)
+                    > STATE_CODES.get(worst, 0)):
+                worst = out["verdict"]
+            nodes[name] = out
+        return {"verdict": worst,
+                "nodes": nodes,
+                "node-count": len(nodes),
+                "unreachable": unreachable}
+
+    def scrape_counts(self) -> "Tuple[int, int]":
+        # thread-affinity: any
+        """(scrapes_total, scrape_errors) under the lock — the cheap
+        read the parent registry's cluster scrape-health SLO
+        denominators use (``stats()`` copies every node's flow
+        buffer; a 10 s sampler should not)."""
+        with self._lock:
+            return self.scrapes_total, self.scrape_errors
 
     def stats(self) -> dict:
         # thread-affinity: any

@@ -1,0 +1,563 @@
+"""Declarative SLOs + multi-window burn-rate alerting (ISSUE 19,
+tentpole part 2).
+
+Reference: upstream cilium's operability story turns counters into
+JUDGMENTS — cilium-health says healthy/degraded, Hubble metrics feed
+the SRE-workbook burn-rate alerts.  This module is that layer over
+the PR 4 registry and the ISSUE 19 history rings: an SLO declares an
+OBJECTIVE over a series expression, the engine evaluates each one
+over a FAST and a SLOW window, and the pair of burn rates classifies
+the moment:
+
+- ``burn = error_rate / error_budget`` where ``error_budget = 1 -
+  objective``: burn 1.0 consumes exactly the window's budget; burn
+  10 exhausts the slow window's budget in a tenth of it.
+- PAGE only when BOTH windows burn past ``page_burn`` — the fast
+  window makes the alert responsive, the slow window makes it hold
+  evidence (a one-sample blip cannot page; the SRE-workbook
+  multi-window rationale).
+- A page opens an EPISODE: one ``slo-burn`` flight-recorder incident
+  (sysdump auto-capture) at entry, hysteresis on the way out
+  (``clear_ticks`` consecutive calm evaluations), the recovery
+  recorded on the episode — a storm cannot flap incidents, and the
+  operator sees when it healed, not just when it fired.
+
+Three SLO kinds cover the shipped defaults:
+
+- ``ratio``: bad-counter sum over a total counter (availability,
+  event-plane loss, L7 parse failures, cluster scrape health);
+- ``percentile``: a latency histogram's tail mass over a threshold —
+  cumulative log2 buckets are counters, so the window's distribution
+  is a bucket difference and "p99 under 100 ms" is the ratio of
+  over-threshold mass to total mass;
+- ``gauge``: fraction of window samples at/over a threshold (map
+  occupancy headroom).
+
+The engine owns the ONE sampler thread (``slo-sampler``, CTA002
+domain ``slo`` — never the drain thread) driving both the history
+rings and the evaluations on the flow-analytics duty idiom: the
+cadence is a ceiling, and on a loaded host the loop stretches its
+delay so sampling stays under ``max_duty`` of wall clock.  ``tick``
+is callable synchronously with injected clocks, so tests drive the
+whole plane deterministically.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .flightrec import KIND_SLO_BURN
+from .history import SeriesHistory
+
+SLO_KINDS = ("ratio", "percentile", "gauge")
+
+STATE_OK = "ok"
+STATE_NO_DATA = "no-data"
+STATE_WARN = "warn"
+STATE_PAGE = "page"
+# cilium_slo_state codes (registry exposition)
+STATE_CODES = {STATE_OK: 0, STATE_NO_DATA: 1, STATE_WARN: 2,
+               STATE_PAGE: 3}
+
+# dispatch tail bound for the shipped dispatch-p99 SLO (µs): one
+# admission-to-events-emitted dispatch should clear in 100 ms
+DISPATCH_P99_US = 100_000
+# occupancy headroom bound for the shipped map-headroom SLO: a map
+# sample at/over this fraction counts against the objective
+MAP_HEADROOM_OCCUPANCY = 0.90
+
+MAX_EPISODES = 64
+
+
+@dataclass(frozen=True)
+class SLODef:
+    """One declared objective.  ``bad``/``total`` for ratio kinds,
+    ``series`` (+ ``threshold``) for percentile/gauge kinds; all
+    series names must be registered (validated at engine
+    construction, linted by CTA014)."""
+    name: str
+    description: str
+    kind: str
+    objective: float
+    bad: Tuple[str, ...] = ()
+    total: str = ""
+    series: Tuple[str, ...] = ()
+    threshold: float = 0.0
+
+    def referenced_series(self) -> Tuple[str, ...]:
+        return tuple(self.bad) + (
+            (self.total,) if self.total else ()) + tuple(self.series)
+
+
+def default_slos() -> Tuple[SLODef, ...]:
+    """The shipped SLO set (ISSUE 19): every objective the serving,
+    event, L7, cluster-scrape, and map planes already ledger."""
+    return (
+        SLODef(
+            name="serving-availability",
+            description="packets neither shed at admission nor "
+                        "dropped in fault recovery",
+            kind="ratio", objective=0.999,
+            bad=("cilium_serving_shed_total",
+                 "cilium_serving_recovery_dropped_total"),
+            total="cilium_serving_submitted_total"),
+        SLODef(
+            name="dispatch-p99",
+            description="dispatch latency p99 under 100 ms "
+                        "(admission -> events emitted)",
+            kind="percentile", objective=0.99,
+            series=("cilium_serving_latency_us",),
+            threshold=DISPATCH_P99_US),
+        SLODef(
+            name="event-plane-loss",
+            description="ring events neither lapped nor dropped "
+                        "with their window",
+            kind="ratio", objective=0.999,
+            bad=("cilium_ring_lost_total",
+                 "cilium_serving_event_windows_dropped_total"),
+            total="cilium_serving_ring_events_total"),
+        SLODef(
+            name="cluster-scrape-health",
+            description="relay scrapes of worker nodes succeeding",
+            kind="ratio", objective=0.95,
+            bad=("cilium_cluster_obs_scrape_errors_total",),
+            total="cilium_cluster_obs_scrapes_total"),
+        SLODef(
+            name="l7-parse-failure",
+            description="redirected rows reaching an L7 verdict "
+                        "(parse failures burn)",
+            kind="ratio", objective=0.995,
+            bad=("cilium_l7_failed_total",),
+            total="cilium_l7_redirected_total"),
+        SLODef(
+            name="map-headroom",
+            description="datapath map occupancy samples under the "
+                        "headroom bound (CT, LPM/ipcache, policy)",
+            kind="gauge", objective=0.99,
+            series=("cilium_ct_occupancy", "cilium_lpm_occupancy",
+                    "cilium_policy_map_occupancy"),
+            threshold=MAP_HEADROOM_OCCUPANCY),
+    )
+
+
+# the declared history subset: every series the shipped SLOs
+# reference plus the trend gauges operators diff by hand today.
+# EXCLUDES device-touching collectors (cilium_datapath_packets_total
+# renders the metricsmap) and the cilium_slo_* family itself (the
+# engine feeds those; sampling them would read the previous tick).
+# CTA014 floors each name against the registry
+HISTORY_SERIES = (
+    "cilium_serving_submitted_total",
+    "cilium_serving_shed_total",
+    "cilium_serving_recovery_dropped_total",
+    "cilium_serving_verdicts_total",
+    "cilium_serving_ring_events_total",
+    "cilium_ring_lost_total",
+    "cilium_serving_event_windows_dropped_total",
+    "cilium_serving_latency_us",
+    "cilium_serving_queue_wait_us",
+    "cilium_serving_queue_pending",
+    "cilium_serving_degraded",
+    "cilium_l7_failed_total",
+    "cilium_l7_redirected_total",
+    "cilium_ct_occupancy",
+    "cilium_lpm_occupancy",
+    "cilium_policy_map_occupancy",
+    "cilium_ct_insert_drops_total",
+    "cilium_nat_pool_failures_total",
+    "cilium_cluster_obs_scrapes_total",
+    "cilium_cluster_obs_scrape_errors_total",
+    "cilium_incidents_total",
+)
+
+
+def validate_slo_config(fast_window_s, slow_window_s, page_burn,
+                        warn_burn, clear_ticks, max_duty) -> tuple:
+    """Validate the SLO DaemonConfig knobs (the
+    validate_serving_config contract: fail at construction)."""
+    fast_window_s = float(fast_window_s)
+    slow_window_s = float(slow_window_s)
+    if fast_window_s <= 0:
+        raise ValueError("slo_fast_window must be > 0")
+    if slow_window_s <= fast_window_s:
+        raise ValueError("slo_slow_window must be > slo_fast_window "
+                         "(the multi-window premise)")
+    page_burn = float(page_burn)
+    warn_burn = float(warn_burn)
+    if warn_burn <= 0:
+        raise ValueError("slo_warn_burn must be > 0")
+    if page_burn < warn_burn:
+        raise ValueError("slo_page_burn must be >= slo_warn_burn")
+    clear_ticks = int(clear_ticks)
+    if clear_ticks <= 0:
+        raise ValueError("slo_clear_ticks must be > 0")
+    max_duty = float(max_duty)
+    if not 0.0 <= max_duty < 1.0:
+        raise ValueError("slo_max_duty must be in [0, 1) "
+                         "(0 disables the governor)")
+    return (fast_window_s, slow_window_s, page_burn, warn_burn,
+            clear_ticks, max_duty)
+
+
+class SLOEngine:
+    """Owns the sampler cadence (one thread drives history + SLO
+    evaluation), the per-SLO episode state machines, and the cached
+    last evaluation the registry collectors and ``GET /slo`` read."""
+
+    # guarded-by: _lock: last, ticks, active, episodes, _delay
+
+    def __init__(self, history: SeriesHistory,
+                 slos: Sequence[SLODef],
+                 record_incident: Optional[Callable] = None,
+                 interval_s: float = 10.0,
+                 fast_window_s: float = 60.0,
+                 slow_window_s: float = 600.0,
+                 page_burn: float = 10.0,
+                 warn_burn: float = 2.0,
+                 clear_ticks: int = 3,
+                 max_duty: float = 0.05):
+        self.history = history
+        self.slos: Tuple[SLODef, ...] = tuple(slos)
+        self._record_incident = record_incident
+        self.interval_s = float(interval_s)
+        self.fast_window_s = float(fast_window_s)
+        self.slow_window_s = float(slow_window_s)
+        self.page_burn = float(page_burn)
+        self.warn_burn = float(warn_burn)
+        self.clear_ticks = int(clear_ticks)
+        self.max_duty = float(max_duty)
+        seen = set()
+        for d in self.slos:
+            if d.kind not in SLO_KINDS:
+                raise ValueError(f"SLO {d.name!r}: unknown kind "
+                                 f"{d.kind!r} (one of {SLO_KINDS})")
+            if not 0.0 < d.objective < 1.0:
+                raise ValueError(f"SLO {d.name!r}: objective must "
+                                 f"be in (0, 1)")
+            if d.name in seen:
+                raise ValueError(f"SLO {d.name!r} declared twice")
+            seen.add(d.name)
+            for s in d.referenced_series():
+                if s not in history.kinds:
+                    raise ValueError(
+                        f"SLO {d.name!r} references series {s!r} "
+                        f"outside the declared history subset")
+        self._lock = threading.Lock()
+        self.last: Optional[dict] = None
+        self.ticks = 0
+        # SLO name -> open episode (page entered, not yet cleared)
+        self.active: Dict[str, dict] = {}
+        # closed episodes, oldest first, bounded
+        self.episodes: List[dict] = []
+        self._delay = self.interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> None:
+        # thread-affinity: api
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        # restartable (stop() then start(), the bench's paired
+        # armed/off legs): a FRESH event rather than clear() — a
+        # straggler thread from a timed-out join still sees its own
+        # set event and exits instead of racing the new cadence
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True,
+                                        name="slo-sampler")
+        self._thread.start()
+
+    def stop(self) -> None:
+        # thread-affinity: api
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=30.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        # thread-affinity: slo -- the engine's own sampler thread;
+        # never the drain thread (samples snapshot lock-guarded
+        # ledgers, evaluation walks the history rings — all
+        # off-hot-path by construction)
+        while True:
+            with self._lock:
+                delay = self._delay
+            if self._stop.wait(delay):
+                return
+            t0 = time.monotonic()
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — one broken tick must
+                pass  # not kill the sampler cadence
+            if self.max_duty > 0:
+                # duty governor: cost/(cost+delay) <= max_duty
+                cost = time.monotonic() - t0
+                with self._lock:
+                    self._delay = max(
+                        self.interval_s,
+                        cost * (1.0 - self.max_duty) / self.max_duty)
+
+    # -- the evaluator -------------------------------------------------
+    def tick(self, now: Optional[float] = None,
+             wall: Optional[float] = None) -> dict:
+        # thread-affinity: slo, api, cli
+        """One sampler tick: append a history sample, evaluate every
+        SLO over both windows, walk the episode machines.  Clocks
+        are injectable (deterministic chaos tests drive a fake
+        timeline through here)."""
+        rec = self.history.take_sample(now=now, wall=wall)
+        now = rec["t"]
+        wall = rec["at"]
+        fired: List[dict] = []
+        with self._lock:
+            evals: Dict[str, dict] = {}
+            for d in self.slos:
+                ev = self._evaluate(d, now)
+                evals[d.name] = ev
+                self._episode_step(d, ev, now, wall, fired)
+            self.ticks += 1
+            self.last = {
+                "at": wall,
+                "verdict": self._verdict_locked(evals),
+                "evals": evals,
+            }
+            out = self.last
+        # incidents fire OUTSIDE the lock: the capture thread's
+        # collect calls back into snapshot(), and holding _lock
+        # across record_incident would make that wait on this tick
+        # for no reason
+        for detail in fired:
+            if self._record_incident is not None:
+                self._record_incident(KIND_SLO_BURN, detail)
+        return out
+
+    def _evaluate(self, d: SLODef, now: float) -> dict:
+        # holds: _lock
+        fast = self._window_error(d, self.fast_window_s, now)
+        slow = self._window_error(d, self.slow_window_s, now)
+        budget = 1.0 - d.objective
+        ev: dict = {
+            "kind": d.kind,
+            "objective": d.objective,
+            "fast-window-s": self.fast_window_s,
+            "slow-window-s": self.slow_window_s,
+        }
+        if fast is None or slow is None:
+            ev["state"] = STATE_NO_DATA
+            ev["budget-remaining"] = 1.0
+            return ev
+        fast_burn = fast / budget
+        slow_burn = slow / budget
+        ev["error-fast"] = round(fast, 6)
+        ev["error-slow"] = round(slow, 6)
+        ev["fast-burn"] = round(fast_burn, 3)
+        ev["slow-burn"] = round(slow_burn, 3)
+        # budget remaining: the slow window IS the budget period —
+        # burn 1.0 sustained for the whole window exhausts it
+        ev["budget-remaining"] = round(
+            max(0.0, min(1.0, 1.0 - slow_burn)), 6)
+        if fast_burn >= self.page_burn and slow_burn >= self.page_burn:
+            ev["state"] = STATE_PAGE
+        elif fast_burn >= self.warn_burn and slow_burn >= self.warn_burn:
+            ev["state"] = STATE_WARN
+        else:
+            ev["state"] = STATE_OK
+        return ev
+
+    def _window_error(self, d: SLODef, window_s: float,
+                      now: float) -> Optional[float]:
+        # holds: _lock
+        """The window's error fraction, or None when the rings lack
+        data.  Zero traffic in the window is burn 0 (an idle plane
+        consumes no budget), distinct from no-data (the rings have
+        not covered the window for these series yet)."""
+        h = self.history
+        if d.kind == "ratio":
+            total = h.counter_delta(d.total, window_s, now)
+            if total is None:
+                return None
+            if total <= 0:
+                return 0.0
+            bad = 0.0
+            for name in d.bad:
+                delta = h.counter_delta(name, window_s, now)
+                if delta is not None:
+                    bad += delta
+            return min(1.0, bad / total)
+        if d.kind == "percentile":
+            delta = h.hist_delta(d.series[0], window_s, now)
+            if delta is None:
+                return None
+            count = delta["count"]
+            if count <= 0:
+                return 0.0
+            # log2 buckets: bucket i holds [2^(i-1), 2^i) µs, so the
+            # mass known under the threshold is the cumulative count
+            # through the largest bucket whose upper bound fits
+            under = sum(b for i, b in enumerate(delta["buckets"])
+                        if (1 << i) <= d.threshold)
+            return max(0.0, min(1.0, (count - under) / count))
+        # gauge: fraction of window samples at/over the threshold,
+        # worst series per sample (one saturated map burns even while
+        # its siblings idle)
+        rows = [h.gauge_window(name, window_s, now)
+                for name in d.series]
+        n = max((len(r) for r in rows), default=0)
+        if n == 0:
+            return None
+        over = 0
+        for i in range(n):
+            worst = max((r[i] for r in rows if i < len(r)),
+                        default=0.0)
+            if worst >= d.threshold:
+                over += 1
+        return over / n
+
+    def _episode_step(self, d: SLODef, ev: dict, now: float,
+                      wall: float, fired: List[dict]) -> None:
+        # holds: _lock
+        state = ev["state"]
+        ep = self.active.get(d.name)
+        if ep is None:
+            if state == STATE_PAGE:
+                ep = {
+                    "slo": d.name,
+                    "started-at": wall,
+                    "t0": now,
+                    "peak-burn": max(ev.get("fast-burn", 0.0),
+                                     ev.get("slow-burn", 0.0)),
+                    "calm": 0,
+                }
+                self.active[d.name] = ep
+                fired.append({
+                    "slo": d.name,
+                    "kind": d.kind,
+                    "objective": d.objective,
+                    "fast-burn": ev.get("fast-burn"),
+                    "slow-burn": ev.get("slow-burn"),
+                    "budget-remaining": ev.get("budget-remaining"),
+                })
+            return
+        ep["peak-burn"] = max(ep["peak-burn"],
+                              ev.get("fast-burn", 0.0),
+                              ev.get("slow-burn", 0.0))
+        # hysteresis: the episode closes only after clear_ticks
+        # consecutive evaluations with BOTH windows calm (under the
+        # warn burn) — a storm re-arms the counter, so one episode
+        # is one incident however long it flaps
+        calm = (state in (STATE_OK, STATE_NO_DATA)
+                and ev.get("fast-burn", 0.0) < self.warn_burn
+                and ev.get("slow-burn", 0.0) < self.warn_burn)
+        if calm:
+            ep["calm"] += 1
+            if ep["calm"] >= self.clear_ticks:
+                del self.active[d.name]
+                self.episodes.append({
+                    "slo": d.name,
+                    "started-at": ep["started-at"],
+                    "recovered-at": wall,
+                    "duration-s": round(now - ep["t0"], 3),
+                    "peak-burn": round(ep["peak-burn"], 3),
+                })
+                del self.episodes[:-MAX_EPISODES]
+        else:
+            ep["calm"] = 0
+
+    def _verdict_locked(self, evals: Dict[str, dict]) -> str:
+        # holds: _lock
+        states = [e["state"] for e in evals.values()]
+        if self.active or STATE_PAGE in states:
+            return STATE_PAGE
+        if STATE_WARN in states:
+            return STATE_WARN
+        return STATE_OK
+
+    # -- reading --------------------------------------------------------
+    def snapshot(self) -> dict:
+        # thread-affinity: any
+        """``GET /slo`` body + the sysdump ``slo`` section."""
+        with self._lock:
+            last = self.last
+            return {
+                "enabled": self.interval_s > 0,
+                "interval-s": self.interval_s,
+                "effective-interval-s": round(self._delay, 3),
+                "fast-window-s": self.fast_window_s,
+                "slow-window-s": self.slow_window_s,
+                "page-burn": self.page_burn,
+                "warn-burn": self.warn_burn,
+                "clear-ticks": self.clear_ticks,
+                "ticks": self.ticks,
+                "verdict": (last["verdict"] if last is not None
+                            else STATE_NO_DATA),
+                "at": last["at"] if last is not None else None,
+                "slos": ({name: dict(ev) for name, ev
+                          in last["evals"].items()}
+                         if last is not None else {}),
+                "active": {name: {k: v for k, v in ep.items()
+                                  if k != "t0"}
+                           for name, ep in self.active.items()},
+                "episodes": [dict(e) for e in self.episodes],
+                "resyncs": self.history.resyncs,
+            }
+
+    def stats(self) -> dict:
+        # thread-affinity: any
+        """The serving-stats block: verdict + per-SLO states only
+        (the full evaluation rides ``GET /slo``)."""
+        with self._lock:
+            last = self.last
+            out = {
+                "enabled": self.interval_s > 0,
+                "verdict": (last["verdict"] if last is not None
+                            else STATE_NO_DATA),
+                "ticks": self.ticks,
+                "active-episodes": len(self.active),
+                "episodes": len(self.episodes),
+            }
+            if last is not None:
+                out["states"] = {
+                    name: ev["state"]
+                    for name, ev in last["evals"].items()}
+                out["budget-remaining"] = {
+                    name: ev.get("budget-remaining")
+                    for name, ev in last["evals"].items()}
+            return out
+
+    # -- registry collectors (read the cached evaluation) ---------------
+    def budget_series(self):
+        # thread-affinity: any
+        with self._lock:
+            if self.last is None:
+                return None
+            return [({"slo": name}, ev["budget-remaining"])
+                    for name, ev in sorted(self.last["evals"].items())
+                    if ev.get("budget-remaining") is not None]
+
+    def burn_series(self):
+        # thread-affinity: any
+        with self._lock:
+            if self.last is None:
+                return None
+            out = []
+            for name, ev in sorted(self.last["evals"].items()):
+                for window in ("fast", "slow"):
+                    v = ev.get(f"{window}-burn")
+                    if v is not None:
+                        out.append(({"slo": name, "window": window},
+                                    v))
+            return out
+
+    def state_series(self):
+        # thread-affinity: any
+        with self._lock:
+            if self.last is None:
+                return None
+            return [({"slo": name}, STATE_CODES[ev["state"]])
+                    for name, ev in
+                    sorted(self.last["evals"].items())]

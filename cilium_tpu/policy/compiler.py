@@ -130,6 +130,17 @@ class IdentityRowMap:
         # point reads are GIL-atomic against these locked mutations
         self._mut = threading.Lock()
 
+    def row_occupancy(self) -> Tuple[int, int]:
+        # thread-affinity: any
+        """(mapped identities, current capacity) — the policy-table
+        pressure sample (ISSUE 19).  Capacity grows on demand, so
+        the fraction reads headroom-to-next-grow: the moment
+        identity churn is about to pay a regeneration.  (Named
+        distinctly from the drain-affine arena ``occupancy`` — the
+        callgraph's name-match fallback must not bind them.)"""
+        with self._mut:
+            return len(self._num_to_row), self.capacity
+
     def add(self, numeric_id: int) -> int:
         with self._mut:
             row = self._num_to_row.get(numeric_id)

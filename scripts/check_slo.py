@@ -1,0 +1,53 @@
+#!/usr/bin/env python
+"""SLO-plane contract check: every declared SLO references series
+the registry exports AND the history ring samples, the cilium_slo_*
+exposition floor stays registered, and the observability bench
+artifact keeps its v2 schema.
+
+THIN SHIM: the implementation lives in the static-analysis package
+(``cilium_tpu.analysis.slo_lint``, checker CTA014) and runs on
+every analysis pass / tier-1 run.  This script keeps a standalone
+CLI (the check_cluster_ledger idiom) and the importable
+``check_bench`` surface.
+
+Usage::
+
+    python scripts/check_slo.py                   # repo pass
+    python scripts/check_slo.py BENCH_obs.json [...]
+
+Exit status 0 = clean; 1 = violations (one per line).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from cilium_tpu.analysis.slo_lint import (  # noqa: E402,F401
+    BENCH_OBS_KEYS, check, check_bench)
+
+
+def main(argv=None) -> int:
+    args = (argv if argv is not None else sys.argv[1:])
+    bad = []
+    if args:
+        for path in args:
+            bad.extend(check_bench(path))
+    else:
+        from cilium_tpu.analysis import Repo, repo_root
+
+        for f in check(Repo(repo_root())):
+            bad.append(f.render())
+    if bad:
+        print("SLO contract check FAILED:", file=sys.stderr)
+        for b in bad:
+            print("  " + b, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,880 @@
+"""SLO plane (ISSUE 19 tentpole): in-process metrics history,
+multi-window burn-rate alerting, cluster-wide health verdicts.
+
+Acceptance (split by cost):
+(a) UNITS (no daemon): the ONE counter-reset definition
+    (``obs.history.counters_reset``, shared with the CLI follow
+    loop's resync); the two-tier fixed-memory ring with the
+    reset-splice (adjusted series stay monotone, resyncs recorded);
+    the burn-rate engine over fake timelines — no-data vs
+    zero-traffic, the multi-window page premise (a one-tick spike
+    cannot alert), one-episode-one-incident hysteresis, all three
+    SLO kinds; the adaptive GC-relaxation state machine (never
+    mid-episode, compounding, bounded, snaps on pressure entry).
+(b) DAEMON integration: the chaos gate — a seeded admission-shed
+    burst burns the availability SLO on a fake 10 s timeline:
+    exactly one ``slo-burn`` incident per SLO episode, the
+    auto-captured sysdump carries the ``slo`` + ``history``
+    sections, hysteresis recovery is recorded, zero serving
+    recompiles, the packet ledger exact.  Plus the sampler's thread
+    identity (``slo-sampler``, never the drain thread) and the
+    registry's ``cilium_slo_*`` exposition floor.
+(c) THREAD-MODE cluster: per-node verdicts merge worst-of into one
+    node-labeled cluster verdict; a crashed node serves last-known
+    inside the staleness bound and degrades to no-data past it.
+    (The process-mode SIGKILL leg rides test_cluster_obs's one
+    process lifecycle — the file cost discipline.)
+
+Named to sort early (the tier-1 budget-truncation convention).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from cilium_tpu.obs.history import (SeriesHistory, counters_reset,
+                                    validate_history_config)
+from cilium_tpu.obs.slo import (HISTORY_SERIES, STATE_CODES, SLODef,
+                                SLOEngine, default_slos,
+                                validate_slo_config)
+
+pytestmark = [pytest.mark.obs]
+
+
+def _wait(pred, timeout=60.0, tick=0.01):
+    t0 = time.monotonic()
+    while not pred():
+        if time.monotonic() - t0 > timeout:
+            return False
+        time.sleep(tick)
+    return True
+
+
+# ---------------------------------------------------------------------
+# (a) units: the shared counter-reset definition
+# ---------------------------------------------------------------------
+class TestCounterResetDefinition:
+    def test_backward_numeric_pair_signals_reset(self):
+        assert counters_reset([(3, 10)])
+        assert counters_reset([(5, 5), (0.0, 0.5)])
+
+    def test_forward_equal_missing_and_non_numeric_do_not(self):
+        assert not counters_reset([(10, 3)])
+        assert not counters_reset([(5, 5)])
+        assert not counters_reset([(None, 7), (7, None)])
+        assert not counters_reset([("a", "b"), ({}, 1)])
+        # bools are not counters (False < True is not a restart)
+        assert not counters_reset([(False, True)])
+        assert not counters_reset([])
+
+    def test_cli_follow_resync_delegates_to_the_one_definition(self):
+        # the CLI wrapper only plucks the serving rate keys; the
+        # reset SEMANTICS must be obs.history's (a fork would let
+        # the ring splice and the follow loop disagree on what a
+        # restart looks like)
+        from cilium_tpu.cli.main import _counters_reset
+
+        prev = {"submitted": 100, "verdicts": 90,
+                "dispatch": {"dispatches": 10},
+                "fault-tolerance": {"restarts": 0}}
+        cur_fwd = {"submitted": 150, "verdicts": 140,
+                   "dispatch": {"dispatches": 15},
+                   "fault-tolerance": {"restarts": 0}}
+        cur_rst = {"submitted": 5, "verdicts": 4,
+                   "dispatch": {"dispatches": 1},
+                   "fault-tolerance": {"restarts": 0}}
+        assert not _counters_reset(cur_fwd, prev)
+        assert _counters_reset(cur_rst, prev)
+        # a NESTED counter rewinding alone is enough
+        cur_nested = dict(cur_fwd)
+        cur_nested["dispatch"] = {"dispatches": 2}
+        assert _counters_reset(cur_nested, prev)
+
+
+# ---------------------------------------------------------------------
+# (a) units: the two-tier history ring
+# ---------------------------------------------------------------------
+def _ring(state, kinds, **kw):
+    return SeriesHistory(lambda: dict(state), kinds, **kw)
+
+
+class TestSeriesHistory:
+    def test_two_tiers_fixed_memory(self):
+        state = {"c": 0}
+        h = _ring(state, {"c": "counter"}, interval_s=10.0, slots=4,
+                  slow_every=2, slow_slots=3)
+        for i in range(20):
+            state["c"] = i
+            h.take_sample(now=float(i * 10), wall=1000.0 + i * 10)
+        q = h.query()
+        # both rings bounded no matter the uptime; total samples
+        # keep counting
+        assert len(q["fast"]) == 4 and len(q["slow"]) == 3
+        assert q["samples"] == 20 and h.stats()["samples"] == 20
+        # the slow tier extends the merged window past the fast span
+        base, win = h._window(1000.0, now=190.0)
+        assert len(win) > 4
+
+    def test_counter_reset_splices_and_records_resync(self):
+        state = {"c": 10}
+        h = _ring(state, {"c": "counter"}, interval_s=10.0)
+        h.take_sample(now=0.0, wall=0.0)
+        state["c"] = 15
+        h.take_sample(now=10.0, wall=10.0)
+        state["c"] = 3  # the restart: raw counter rewound
+        rec = h.take_sample(now=20.0, wall=20.0)
+        assert rec["resync"] == ["c"]
+        state["c"] = 7
+        h.take_sample(now=30.0, wall=30.0)
+        vals = [r["v"]["c"] for r in h.query()["fast"]]
+        # adjusted series continues from where the dead process left
+        # it: 10, 15, 15+3, 15+7 — monotone through the splice
+        assert vals == [10.0, 15.0, 18.0, 22.0]
+        assert h.query()["resyncs"] == 1
+        # the windowed delta the SLO math consumes never goes
+        # negative across the restart
+        d = h.counter_delta("c", 100.0, now=30.0)
+        assert d == 12.0
+
+    def test_histogram_reset_splices_bucket_counts(self):
+        state = {"h": {"buckets": [2, 3], "count": 5, "sum": 9.0}}
+        h = _ring(state, {"h": "histogram"}, interval_s=10.0)
+        h.take_sample(now=0.0, wall=0.0)
+        # restart: cumulative bucket counts rewound
+        state["h"] = {"buckets": [1, 0], "count": 1, "sum": 1.0}
+        rec = h.take_sample(now=10.0, wall=10.0)
+        assert rec["resync"] == ["h"]
+        assert rec["v"]["h"] == {"buckets": [3, 3], "count": 6,
+                                 "sum": 10.0}
+        d = h.hist_delta("h", 100.0, now=10.0)
+        assert d["count"] == 1 and all(b >= 0 for b in d["buckets"])
+
+    def test_query_filters_series_and_since(self):
+        state = {"a": 1, "b": 2}
+        h = _ring(state, {"a": "gauge", "b": "gauge"},
+                  interval_s=10.0)
+        h.take_sample(now=0.0, wall=100.0)
+        h.take_sample(now=10.0, wall=110.0)
+        q = h.query(series=["b", "nope"], since=105.0)
+        assert q["series"] == ["b"]  # the filter, minus unknowns
+        assert len(q["fast"]) == 1
+        assert q["fast"][0]["v"] == {"b": 2}
+
+    def test_validate_history_config(self):
+        assert validate_history_config(0, 360, 30, 288)[0] == 0.0
+        with pytest.raises(ValueError, match="history_interval"):
+            validate_history_config(-1, 360, 30, 288)
+        with pytest.raises(ValueError, match="slots"):
+            validate_history_config(10, 1, 30, 288)
+        with pytest.raises(ValueError, match="slow_every"):
+            validate_history_config(10, 360, 0, 288)
+
+
+# ---------------------------------------------------------------------
+# (a) units: the burn-rate engine on fake timelines
+# ---------------------------------------------------------------------
+def _ratio_engine(state, fired, objective=0.99, **kw):
+    kinds = {"x_bad": "counter", "x_total": "counter"}
+    h = SeriesHistory(lambda: dict(state), kinds, interval_s=10.0)
+    eng = SLOEngine(
+        h, [SLODef(name="avail", description="t", kind="ratio",
+                   objective=objective, bad=("x_bad",),
+                   total="x_total")],
+        record_incident=lambda kind, detail: fired.append(
+            (kind, detail)),
+        interval_s=10.0, fast_window_s=60.0, slow_window_s=600.0,
+        page_burn=10.0, warn_burn=2.0, clear_ticks=3, **kw)
+    return h, eng
+
+
+def _run_healthy(state, eng, ticks, t0=0.0, step=10.0, rate=100):
+    t = t0
+    for _ in range(ticks):
+        state["x_total"] += rate
+        eng.tick(now=t, wall=1e9 + t)
+        t += step
+    return t
+
+
+class TestSLOEngine:
+    def test_no_data_vs_zero_traffic(self):
+        state = {"x_bad": 0, "x_total": 0}
+        eng = _ratio_engine(state, [])[1]
+        out = eng.tick(now=0.0, wall=1e9)
+        # one record: no window has two datapoints yet
+        assert out["evals"]["avail"]["state"] == "no-data"
+        out = eng.tick(now=10.0, wall=1e9 + 10)
+        ev = out["evals"]["avail"]
+        # zero traffic is burn 0 (an idle plane consumes no
+        # budget), DISTINCT from no-data
+        assert ev["state"] == "ok"
+        assert ev["budget-remaining"] == 1.0
+        assert out["verdict"] == "ok"
+
+    def test_one_tick_spike_cannot_alert(self):
+        # the multi-window premise: a fast-window burn without slow
+        # -window evidence is a blip, not an alert
+        state = {"x_bad": 0, "x_total": 0}
+        fired = []
+        eng = _ratio_engine(state, fired)[1]
+        t = _run_healthy(state, eng, 61)
+        state["x_bad"] += 30  # one bad tick: fast burn ~5x
+        state["x_total"] += 100
+        out = eng.tick(now=t, wall=1e9 + t)
+        ev = out["evals"]["avail"]
+        assert ev["fast-burn"] >= 2.0
+        assert ev["slow-burn"] < 2.0
+        assert ev["state"] == "ok"
+        assert fired == []
+
+    def test_page_episode_one_incident_and_hysteresis(self):
+        state = {"x_bad": 0, "x_total": 0}
+        fired = []
+        eng = _ratio_engine(state, fired)[1]
+        t = _run_healthy(state, eng, 61)
+        # sustained 100%-error burst: both windows cross page
+        paged_at = None
+        for _ in range(12):
+            state["x_bad"] += 100
+            state["x_total"] += 100
+            out = eng.tick(now=t, wall=1e9 + t)
+            t += 10.0
+            if out["evals"]["avail"]["state"] == "page":
+                paged_at = t
+                break
+        assert paged_at is not None
+        assert out["verdict"] == "page"
+        # one episode = ONE incident, however long the storm runs
+        for _ in range(3):
+            state["x_bad"] += 100
+            state["x_total"] += 100
+            eng.tick(now=t, wall=1e9 + t)
+            t += 10.0
+        assert [k for k, _ in fired] == ["slo-burn"]
+        assert fired[0][1]["slo"] == "avail"
+        assert "avail" in eng.snapshot()["active"]
+        # recovery: healthy traffic until the burst slides out of
+        # the slow window, then clear_ticks calm evaluations
+        for _ in range(80):
+            state["x_total"] += 100
+            eng.tick(now=t, wall=1e9 + t)
+            t += 10.0
+        snap = eng.snapshot()
+        assert snap["active"] == {}
+        assert snap["verdict"] == "ok"
+        eps = [e for e in snap["episodes"] if e["slo"] == "avail"]
+        assert len(eps) == 1
+        assert eps[0]["recovered-at"] > eps[0]["started-at"]
+        assert eps[0]["peak-burn"] >= 10.0
+        assert len(fired) == 1  # still: recovery fires nothing
+
+    def test_calm_streak_rearms_inside_episode(self):
+        # hysteresis: calm ticks below clear_ticks then a re-burn
+        # keep the SAME episode open (and fire nothing new)
+        state = {"x_bad": 0, "x_total": 0}
+        fired = []
+        eng = _ratio_engine(state, fired)[1]
+        t = _run_healthy(state, eng, 61)
+        for _ in range(8):
+            state["x_bad"] += 100
+            state["x_total"] += 100
+            eng.tick(now=t, wall=1e9 + t)
+            t += 10.0
+        assert len(fired) == 1
+        ep = eng.active["avail"]
+        ep["calm"] = 2  # one tick short of clear_ticks
+        state["x_bad"] += 100  # the storm returns
+        state["x_total"] += 100
+        eng.tick(now=t, wall=1e9 + t)
+        assert eng.active["avail"]["calm"] == 0  # re-armed
+        assert len(fired) == 1  # same episode, same incident
+
+    def test_percentile_kind_tail_mass(self):
+        # log2 buckets: bucket i holds [2^(i-1), 2^i) µs; threshold
+        # 8 µs admits buckets 0..3
+        state = {"lat": {"buckets": [0] * 8, "count": 0, "sum": 0.0}}
+        h = SeriesHistory(lambda: {"lat": dict(
+            state["lat"], buckets=list(state["lat"]["buckets"]))},
+            {"lat": "histogram"}, interval_s=10.0)
+        eng = SLOEngine(
+            h, [SLODef(name="p99", description="t",
+                       kind="percentile", objective=0.99,
+                       series=("lat",), threshold=8)],
+            interval_s=10.0, fast_window_s=60.0,
+            slow_window_s=600.0, page_burn=10.0, warn_burn=2.0,
+            clear_ticks=3)
+        t = 0.0
+        for _ in range(61):  # fast mass only: under the threshold
+            state["lat"]["buckets"][2] += 100
+            state["lat"]["count"] += 100
+            eng.tick(now=t, wall=1e9 + t)
+            t += 10.0
+        assert eng.last["evals"]["p99"]["state"] == "ok"
+        for _ in range(12):  # all mass over the threshold
+            state["lat"]["buckets"][6] += 100
+            state["lat"]["count"] += 100
+            out = eng.tick(now=t, wall=1e9 + t)
+            t += 10.0
+            if out["evals"]["p99"]["state"] == "page":
+                break
+        assert out["evals"]["p99"]["state"] == "page"
+
+    def test_gauge_kind_worst_series_per_sample(self):
+        # one saturated map burns even while its sibling idles
+        state = {"m1": 0.1, "m2": 0.1}
+        h = SeriesHistory(lambda: dict(state),
+                          {"m1": "gauge", "m2": "gauge"},
+                          interval_s=10.0)
+        eng = SLOEngine(
+            h, [SLODef(name="head", description="t", kind="gauge",
+                       objective=0.99, series=("m1", "m2"),
+                       threshold=0.9)],
+            interval_s=10.0, fast_window_s=60.0,
+            slow_window_s=600.0, page_burn=10.0, warn_burn=2.0,
+            clear_ticks=3)
+        t = 0.0
+        for _ in range(61):
+            eng.tick(now=t, wall=1e9 + t)
+            t += 10.0
+        assert eng.last["evals"]["head"]["state"] == "ok"
+        state["m2"] = 0.97  # sibling m1 stays cold
+        for _ in range(70):
+            out = eng.tick(now=t, wall=1e9 + t)
+            t += 10.0
+            if out["evals"]["head"]["state"] == "page":
+                break
+        assert out["evals"]["head"]["state"] == "page"
+
+    def test_constructor_validates_the_contract(self):
+        h = SeriesHistory(lambda: {}, {"a": "counter"})
+        with pytest.raises(ValueError, match="outside the declared"):
+            SLOEngine(h, [SLODef(name="s", description="t",
+                                 kind="ratio", objective=0.9,
+                                 bad=("missing",), total="a")])
+        with pytest.raises(ValueError, match="unknown kind"):
+            SLOEngine(h, [SLODef(name="s", description="t",
+                                 kind="nope", objective=0.9,
+                                 total="a")])
+        with pytest.raises(ValueError, match="objective"):
+            SLOEngine(h, [SLODef(name="s", description="t",
+                                 kind="ratio", objective=1.5,
+                                 total="a")])
+        with pytest.raises(ValueError, match="twice"):
+            SLOEngine(h, [SLODef(name="s", description="t",
+                                 kind="ratio", objective=0.9,
+                                 total="a"),
+                          SLODef(name="s", description="t",
+                                 kind="ratio", objective=0.9,
+                                 total="a")])
+
+    def test_validate_slo_config(self):
+        with pytest.raises(ValueError, match="slow_window"):
+            validate_slo_config(60, 60, 10, 2, 3, 0.05)
+        with pytest.raises(ValueError, match="page_burn"):
+            validate_slo_config(60, 600, 1, 2, 3, 0.05)
+        with pytest.raises(ValueError, match="clear_ticks"):
+            validate_slo_config(60, 600, 10, 2, 0, 0.05)
+        with pytest.raises(ValueError, match="max_duty"):
+            validate_slo_config(60, 600, 10, 2, 3, 1.0)
+
+    def test_shipped_slos_construct_over_the_declared_subset(self):
+        # the CTA014 contract, live: every shipped SLO's series is
+        # inside HISTORY_SERIES, so the engine constructs
+        kinds = {n: "counter" for n in HISTORY_SERIES}
+        h = SeriesHistory(lambda: {}, kinds)
+        eng = SLOEngine(h, default_slos())
+        assert len(eng.slos) == 6
+        assert STATE_CODES == {"ok": 0, "no-data": 1, "warn": 2,
+                               "page": 3}
+
+
+# ---------------------------------------------------------------------
+# (a) units: adaptive GC relaxation (the pressure monitor's other
+# half — tightens under pressure, relaxes back out when calm)
+# ---------------------------------------------------------------------
+class TestAdaptiveGcRelaxation:
+    def _mon(self, state, relaxed, accel, restore):
+        from cilium_tpu.datapath.pressure import MapPressureMonitor
+
+        def sf():
+            return {"ct": {"occupancy": state["occ"],
+                           "insert-drops": state["drops"]},
+                    "nat": {"failures": state["nat"]}}
+
+        return MapPressureMonitor(
+            sf, accel.append, lambda: restore.append(1),
+            ct_threshold=0.85, ct_clear=0.70,
+            gc_pressure_interval_s=1.0,
+            relax_after_s=10.0, relax_factor=2.0, relax_max=4.0,
+            on_relax=relaxed.append)
+
+    def test_calm_streak_compounds_and_caps(self):
+        state = {"occ": 0.1, "drops": 0, "nat": 0}
+        relaxed, accel, restore = [], [], []
+        mon = self._mon(state, relaxed, accel, restore)
+        mon.sample(now=0.0)  # streak starts
+        mon.sample(now=9.0)
+        assert relaxed == []  # not a full relax_after_s yet
+        mon.sample(now=10.0)
+        assert relaxed == [2.0]
+        mon.sample(now=20.0)
+        assert relaxed == [2.0, 4.0]  # compounding
+        mon.sample(now=40.0)
+        assert relaxed == [2.0, 4.0]  # bounded by relax_max
+        assert mon.stats()["relax"]["steps"] == 2
+        assert mon.stats()["relax"]["multiplier"] == 4.0
+
+    def test_pressure_entry_snaps_multiplier_never_mid_episode(self):
+        state = {"occ": 0.1, "drops": 0, "nat": 0}
+        relaxed, accel, restore = [], [], []
+        mon = self._mon(state, relaxed, accel, restore)
+        mon.sample(now=0.0)
+        mon.sample(now=10.0)
+        assert mon.relax_mult == 2.0
+        state["drops"] = 5  # insert-drop delta: pressure episode
+        mon.sample(now=20.0)
+        assert mon.state == "pressure"
+        assert accel == [1.0]  # accelerated cadence took over
+        assert mon.relax_mult == 1.0  # snapped back
+        # mid-episode: however much time passes, no relax step can
+        # fire while the episode is open (the drops keep it hot)
+        state["drops"] += 1
+        mon.sample(now=200.0)
+        state["drops"] += 1
+        mon.sample(now=400.0)
+        assert mon.state == "pressure"
+        assert relaxed == [2.0]
+        # episode exits; the streak starts OVER from the recovery
+        mon.sample(now=500.0)
+        assert mon.state == "ok" and restore == [1]
+        mon.sample(now=509.0)
+        assert relaxed == [2.0]  # 9 s post-recovery: not yet
+        mon.sample(now=510.0)
+        assert relaxed == [2.0, 2.0]
+
+    def test_resync_applies_the_relaxed_cadence(self):
+        state = {"occ": 0.1, "drops": 0, "nat": 0}
+        relaxed, accel, restore = [], [], []
+        mon = self._mon(state, relaxed, accel, restore)
+        mon.sample(now=0.0)
+        mon.sample(now=10.0)
+        sched = []
+        mon.resync(30.0, sched.append)
+        assert sched == [60.0]  # normal interval x multiplier
+
+    def test_validate_relax_config(self):
+        from cilium_tpu.datapath.pressure import validate_relax_config
+
+        assert validate_relax_config(0, 2.0, 4.0)[0] == 0.0
+        with pytest.raises(ValueError, match="relax_after"):
+            validate_relax_config(-1, 2.0, 4.0)
+        with pytest.raises(ValueError, match="relax_factor"):
+            validate_relax_config(10, 1.0, 4.0)
+        with pytest.raises(ValueError, match="relax_max"):
+            validate_relax_config(10, 2.0, 1.5)
+
+
+# ---------------------------------------------------------------------
+# (a) units: CLI rendering (stubbed client — the flows-CLI idiom)
+# ---------------------------------------------------------------------
+class TestSloCli:
+    def _ns(self, **over):
+        import argparse
+
+        ns = dict(socket="unused", json=False, follow=False,
+                  interval=1.0)
+        ns.update(over)
+        return argparse.Namespace(**ns)
+
+    def test_cmd_slo_renders_verdict_table_and_episodes(
+            self, capsys, monkeypatch):
+        from cilium_tpu.cli import main as cli
+
+        snap = {
+            "enabled": True, "verdict": "page", "ticks": 42,
+            "fast-window-s": 60.0, "slow-window-s": 600.0,
+            "page-burn": 10.0, "warn-burn": 2.0, "clear-ticks": 3,
+            "resyncs": 1,
+            "slos": {"serving-availability": {
+                "state": "page", "budget-remaining": 0.25,
+                "fast-burn": 14.0, "slow-burn": 11.0}},
+            "active": {"serving-availability": {
+                "peak-burn": 14.0, "calm": 1,
+                "started-at": 123.0}},
+            "episodes": [{"slo": "dispatch-p99",
+                          "duration-s": 30.0, "peak-burn": 12.0}],
+        }
+
+        class _Stub:
+            def slo(self):
+                return snap
+
+        monkeypatch.setattr(cli, "_client", lambda args: _Stub())
+        assert cli.cmd_slo(self._ns()) == 0
+        out = capsys.readouterr().out
+        assert "Verdict:   PAGE" in out
+        assert "serving-availability" in out and "14.00x" in out
+        assert "BURNING serving-availability" in out
+        assert "recovered dispatch-p99" in out
+
+    def test_cmd_history_renders_series_rows(self, capsys,
+                                             monkeypatch):
+        from cilium_tpu.cli import main as cli
+
+        hist = {
+            "interval-s": 10.0, "slow-every": 30, "samples": 3,
+            "resyncs": 0, "series": ["a_total", "lat"],
+            "fast": [
+                {"at": 1.0, "v": {"a_total": 5,
+                                  "lat": {"count": 2}}},
+                {"at": 2.0, "v": {"a_total": 9,
+                                  "lat": {"count": 4}}},
+            ],
+            "slow": [],
+        }
+
+        class _Stub:
+            def metrics_history(self, series=None, since=0.0):
+                return hist
+
+        monkeypatch.setattr(cli, "_client", lambda args: _Stub())
+        assert cli.cmd_history(self._ns(series=[], since=0.0,
+                                        number=12)) == 0
+        out = capsys.readouterr().out
+        assert "a_total" in out and "5 9" in out
+        # histograms render their cumulative event count
+        assert "lat" in out and "2 4" in out
+
+    def test_cmd_cluster_slo_renders_node_labels(self, capsys,
+                                                 monkeypatch):
+        from cilium_tpu.cli import main as cli
+
+        merged = {
+            "verdict": "no-data", "node-count": 2,
+            "unreachable": ["node1"],
+            "nodes": {
+                "node0": {"ok": True, "stale": False,
+                          "age-s": 0.1, "verdict": "ok",
+                          "slos": {"serving-availability": "ok"}},
+                "node1": {"ok": False, "stale": True, "age-s": 9.0,
+                          "verdict": "no-data",
+                          "error": "node dead"},
+            },
+        }
+
+        class _Stub:
+            def cluster_slo(self):
+                return merged
+
+        monkeypatch.setattr(cli, "_client", lambda args: _Stub())
+        assert cli.cmd_cluster(self._ns(action="slo")) == 0
+        out = capsys.readouterr().out
+        assert "Cluster SLO: NO-DATA (2 nodes, 1 unreachable)" in out
+        assert "node1" in out and "node dead" in out
+
+
+class TestCta014LiveRepo:
+    @pytest.mark.analysis
+    def test_cta014_live_repo_clean(self):
+        from cilium_tpu.analysis.driver import run_analysis
+
+        result = run_analysis(checkers=["slo-contract"])
+        assert [f.render() for f in result["findings"]] == []
+
+
+# ---------------------------------------------------------------------
+# (b) daemon integration
+# ---------------------------------------------------------------------
+from cilium_tpu.agent import Daemon, DaemonConfig  # noqa: E402
+from cilium_tpu.core import TCP_SYN, make_batch  # noqa: E402
+
+RULES = [{
+    "endpointSelector": {"matchLabels": {"app": "db"}},
+    "ingress": [{
+        "fromEndpoints": [{"matchLabels": {"app": "web"}}],
+        "toPorts": [{"ports": [{"port": "5432", "protocol": "TCP"}]}],
+    }],
+}]
+
+
+def _daemon(**over):
+    # the chaos-suite (64, 16) shapes: shared XLA executables
+    cfg = dict(backend="tpu", ct_capacity=1 << 12,
+               flow_ring_capacity=1 << 13,
+               serving_queue_depth=4096,
+               serving_bucket_ladder=(64,),
+               serving_max_wait_us=500.0,
+               serving_restart_backoff_ms=1.0,
+               sysdump_min_interval_s=0.0,
+               history_interval=0.0)  # tests drive tick() directly
+    cfg.update(over)
+    d = Daemon(DaemonConfig(**cfg))
+    d.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+    db = d.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+    d.policy_import(RULES)
+    return d, db
+
+
+def _fwd(db_id, n=64, base=20000):
+    return make_batch([
+        dict(src="10.0.1.1", dst="10.0.2.1", sport=base + (i % 40000),
+             dport=5432, proto=6, flags=TCP_SYN, ep=db_id, dir=0)
+        for i in range(n)]).data
+
+
+def _dispatch_compiles(daemon):
+    return sum(e["compiles"]
+               for e in daemon.loader.compile_log.snapshot(
+                   limit=0)["by-key"]
+               if e["mode"] != "gather")
+
+
+@pytest.mark.chaos
+class TestSloBurnChaosGate:
+    def test_seeded_shed_burst_pages_once_with_sysdump(
+            self, tmp_path):
+        """The ISSUE 19 acceptance e2e: a REAL admission-shed burst
+        (queue overflow, exact ledger) burns the availability SLO on
+        a fake 10 s timeline -> exactly one slo-burn incident for the
+        episode, whose auto-captured sysdump carries the slo +
+        history sections; hysteresis closes the episode and records
+        the recovery; zero serving recompiles; the packet ledger
+        stays exact."""
+        d, db = _daemon(sysdump_dir=str(tmp_path / "dumps"))
+        # warm the occupancy executable BEFORE the compile-count
+        # baseline (the Daemon.start idiom): the incident capture
+        # reads map pressure on its own thread, and its first read
+        # compiles
+        d.pressure.sample()
+        d.start_serving(trace_sample=0, ingress=True,
+                        ring_capacity=1 << 13)
+        try:
+            step, t, w0 = 10.0, 0.0, 1.7e9
+            # healthy baseline covering the slow window: 64-row
+            # chunks can never overflow the 4096 queue undrained
+            # before the drain catches up, so shed stays 0
+            rt = d._serving["runtime"]
+            n_base = int(d.config.slo_slow_window / step) + 1
+            for i in range(n_base):
+                d.submit(_fwd(db.id, base=20000 + 97 * i))
+                d.slo.tick(now=t, wall=w0 + t)
+                t += step
+            ev = d.slo.last["evals"]["serving-availability"]
+            assert ev["state"] == "ok", ev
+            # every baseline row drained before the compile-count
+            # baseline: the first dispatch's compile is async, and a
+            # baseline taken mid-compile would blame the burst for it
+            assert _wait(lambda: rt.stats.verdicts >= 64 * n_base,
+                         timeout=120)
+            c0 = _dispatch_compiles(d)
+
+            # -- the seeded burst: overflow admission for real ------
+            t_burst = t
+            shed = 0
+            for i in range(4000):
+                got = d.submit(_fwd(db.id, base=30000 + 61 * i))
+                shed += 64 - got
+                if shed >= 2048:
+                    break
+            assert shed >= 2048, "burst never overflowed admission"
+            # the exact shed ledger flushes on drain activity — wait
+            # for the registry (what the sampler reads) to carry it
+            assert _wait(lambda: d.registry.sample(
+                ("cilium_serving_shed_total",)).get(
+                    "cilium_serving_shed_total", 0) >= shed)
+
+            paged = False
+            for _ in range(12):
+                t += step
+                out = d.slo.tick(now=t, wall=w0 + t)
+                if (out["evals"]["serving-availability"]["state"]
+                        == "page"):
+                    paged = True
+                    break
+            assert paged, d.slo.last
+            assert out["verdict"] == "page"
+
+            def _avail_incidents():
+                return [i for i in d.flightrec.incidents()
+                        if i["kind"] == "slo-burn"
+                        and (i.get("detail") or {}).get("slo")
+                        == "serving-availability"]
+
+            # storm ticks: the open episode fires NOTHING new
+            for _ in range(3):
+                t += step
+                d.slo.tick(now=t, wall=w0 + t)
+            assert len(_avail_incidents()) == 1
+            inc = _avail_incidents()[0]
+            assert inc["detail"]["fast-burn"] >= 10.0
+
+            # -- the auto-captured sysdump carries the evidence -----
+            assert _wait(lambda: any(
+                "slo-burn" in b["name"]
+                for b in d.flightrec.list_bundles()), timeout=30)
+            path = next(
+                b["path"] for b in d.flightrec.list_bundles()
+                if "slo-burn" in b["name"])
+            with open(path) as f:
+                b = json.load(f)
+            assert b["incident"]["kind"] == "slo-burn"
+            assert b["slo"]["verdict"] == "page"
+            assert b["slo"]["active"], b["slo"]
+            # the retained series window the burn was computed over
+            assert b["history"]["fast"]
+            assert any("cilium_serving_shed_total" in r["v"]
+                       for r in b["history"]["fast"])
+
+            # -- hysteresis recovery: burst slides out of the slow
+            # window, clear_ticks calm evaluations close the episode
+            for _ in range(80):
+                t += step
+                d.submit(_fwd(db.id, base=50000))
+                d.slo.tick(now=t, wall=w0 + t)
+            snap = d.slo_snapshot()
+            assert snap["node"] == d.config.node_name
+            assert "serving-availability" not in snap["active"]
+            eps = [e for e in snap["episodes"]
+                   if e["slo"] == "serving-availability"]
+            assert len(eps) == 1
+            assert eps[0]["recovered-at"] > eps[0]["started-at"]
+            assert (snap["slos"]["serving-availability"]["state"]
+                    == "ok")
+            # STILL one incident for the whole episode
+            assert len(_avail_incidents()) == 1
+
+            # zero serving recompiles across burst + recovery
+            assert _dispatch_compiles(d) == c0
+            # the burn verdicts reached the exposition floor
+            text = d.registry.render()
+            assert 'cilium_slo_state{slo="serving-availability"}' \
+                in text
+            assert "cilium_slo_budget_remaining" in text
+            assert "cilium_slo_burn_rate" in text
+
+            stats = d.stop_serving()
+            fe = stats["front-end"]
+            # exact ledger: every offered row dispatched, shed, or
+            # recovery-accounted
+            assert fe["submitted"] == (
+                fe["verdicts"] + fe["shed"]
+                + fe["fault-tolerance"]["recovery-dropped"])
+            assert fe["shed"] >= shed
+        finally:
+            d.shutdown()
+
+    def test_sampler_thread_identity_and_restart(self):
+        """The sampler is its OWN thread (`slo-sampler`, CTA002
+        domain `slo`) — never the drain thread — and the engine is
+        restartable (the bench's paired armed/off legs)."""
+        d, db = _daemon(history_interval=0.02)
+        d.start_serving(trace_sample=0, ingress=True,
+                        ring_capacity=1 << 13)
+        try:
+            names = []
+            orig = d.history.take_sample
+
+            def spy(now=None, wall=None):
+                names.append(threading.current_thread().name)
+                return orig(now=now, wall=wall)
+
+            d.history.take_sample = spy
+            d.slo.start()
+            d.submit(_fwd(db.id))
+            assert _wait(lambda: len(names) >= 2)
+            assert set(names) == {"slo-sampler"}
+            d.slo.stop()
+            n0, t0 = len(names), d.slo.ticks
+            d.slo.start()  # restart: a fresh stop event re-arms
+            assert _wait(lambda: d.slo.ticks > t0 and
+                         len(names) > n0)
+            assert set(names) == {"slo-sampler"}
+            # serving stats carry the slo + history blocks off the
+            # cached evaluation (a stats render never evaluates)
+            st = d.serving_stats()
+            assert st["slo"]["enabled"] is True
+            assert st["history"]["samples"] >= 1
+        finally:
+            d.slo.stop()
+            d.shutdown()
+
+
+# ---------------------------------------------------------------------
+# (c) thread-mode cluster verdict
+# ---------------------------------------------------------------------
+@pytest.mark.cluster
+class TestClusterVerdict:
+    def test_worst_of_merge_staleness_and_degradation(self):
+        from cilium_tpu.cluster import ClusterServing
+
+        c = ClusterServing(nodes=2, config=DaemonConfig(
+            backend="tpu", ct_capacity=1 << 12,
+            flow_ring_capacity=1 << 13,
+            serving_queue_depth=4096,
+            # ladder (128,) keeps this bring-up's serving
+            # executables shape-distinct from every (64,)-ladder
+            # compile-count pin (jit caches are process-global;
+            # test_cluster_scaleout's warm oracle must still see
+            # its own compiles)
+            serving_bucket_ladder=(128,),
+            serving_max_wait_us=500.0,
+            serving_restart_backoff_ms=1.0,
+            cluster_probe_interval_s=0.1,
+            cluster_death_threshold=2,
+            cluster_obs_interval_s=0.0,  # verdicts on demand —
+            # deterministic
+            cluster_obs_stale_after_s=0.5,
+            history_interval=0.0))  # ticks injected below
+        try:
+            c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
+            db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
+            rev = c.policy_import(RULES)
+            assert c.wait_policy(rev, timeout=30)
+            # serving must be LIVE: the serving-ledger collectors
+            # sample None (-> no-data) outside a session
+            c.start(trace_sample=0, packed=True,
+                    ring_capacity=1 << 10)
+            del db
+            # two fake-timeline ticks spanning both windows give
+            # every node a real OK verdict
+            for n in c.nodes:
+                n.daemon.slo.tick(now=0.0, wall=1.7e9)
+                n.daemon.slo.tick(now=601.0, wall=1.7e9 + 601)
+            cs = c.obs.cluster_slo()
+            assert cs["verdict"] == "ok"
+            assert cs["node-count"] == 2
+            assert cs["unreachable"] == []
+            assert set(cs["nodes"]) == {"node0", "node1"}
+            for ent in cs["nodes"].values():
+                assert ent["ok"] and ent["verdict"] == "ok"
+                assert ent["slos"]["serving-availability"] == "ok"
+            # node-stamped per-node surfaces (the one shared
+            # definition behind both node modes)
+            assert c.nodes[1].slo()["node"] == "node1"
+            h = c.nodes[0].history(
+                series=["cilium_serving_submitted_total"])
+            assert h["node"] == "node0"
+            assert h["series"] == ["cilium_serving_submitted_total"]
+
+            # -- a dead node: last-known verdict INSIDE the bound,
+            # but counted unreachable and node-labeled
+            c.node("node1").crash("slo verdict test")
+            cs = c.obs.cluster_slo()
+            assert cs["unreachable"] == ["node1"]
+            assert cs["nodes"]["node1"]["ok"] is False
+            assert cs["nodes"]["node1"]["error"]
+            assert cs["nodes"]["node1"]["verdict"] == "ok"
+            assert cs["verdict"] == "ok"  # PR 14 staleness rule
+
+            # -- past the bound: the corpse degrades the CLUSTER
+            # verdict to no-data, worst-of over node verdicts
+            time.sleep(0.6)
+            cs = c.obs.cluster_slo()
+            assert cs["nodes"]["node1"]["stale"] is True
+            assert cs["nodes"]["node1"]["verdict"] == "no-data"
+            assert cs["verdict"] == "no-data"
+            assert cs["nodes"]["node0"]["verdict"] == "ok"
+        finally:
+            c.shutdown()

@@ -232,6 +232,7 @@ EXPECTED_OPS = (
     "ready", "probe", "add_endpoint", "policy_rev", "has_identity",
     "start_node", "warm", "start_serving", "front_end",
     "stop_serving", "metrics", "metricsmap", "obs_scrape", "sysdump",
+    "slo", "history",
     "map_pressure", "compile_stats", "ct_snapshot", "ct_merge",
     "record_incident", "publish_drops", "shutdown", "ack_flush",
     "rotate_epoch",
@@ -255,21 +256,24 @@ class TestNodehostOpDiscipline:
         result = run_analysis(checkers=["nodehost-ops"])
         assert [f.render() for f in result["findings"]] == []
 
-    def test_cta011_bench_schema(self, tmp_path):
-        from cilium_tpu.analysis.nodehost_lint import (BENCH_OBS_KEYS,
-                                                       check_bench)
+    def test_cta014_bench_schema(self, tmp_path):
+        # the BENCH_obs gate moved to slo_lint (CTA014) with the
+        # ISSUE 19 v2 schema (sampler-overhead paired legs +
+        # burn-detection latency)
+        from cilium_tpu.analysis.slo_lint import (BENCH_OBS_KEYS,
+                                                  check_bench)
 
         good = {k: 1 for k in BENCH_OBS_KEYS}
-        good["schema"] = "bench-obs-v1"
+        good["schema"] = "bench-obs-v2"
         p = tmp_path / "BENCH_obs.json"
         p.write_text(json.dumps(good))
         assert check_bench(str(p)) == []
         bad = dict(good)
-        del bad["scrape_overhead_ratio"]
-        bad["schema"] = "bench-obs-v0"
+        del bad["sampler_overhead_ratio"]
+        bad["schema"] = "bench-obs-v1"
         p.write_text(json.dumps(bad))
         msgs = check_bench(str(p))
-        assert any("scrape_overhead_ratio" in m for m in msgs)
+        assert any("sampler_overhead_ratio" in m for m in msgs)
         assert any("schema" in m for m in msgs)
 
 
@@ -672,7 +676,9 @@ class TestProcessClusterObs:
             cluster_mode="process",
             cluster_trace_sample=4,
             cluster_obs_interval_s=0.25,
-            cluster_obs_stale_after_s=30.0))
+            cluster_obs_stale_after_s=30.0,
+            history_interval=0.25))  # workers tick their SLO
+        # engines fast enough to hold a real verdict pre-SIGKILL
         c.add_endpoint("web", ("10.0.1.1",), ["k8s:app=web"])
         db = c.add_endpoint("db", ("10.0.2.1",), ["k8s:app=db"])
         rev = c.policy_import(RULES)
@@ -725,6 +731,24 @@ class TestProcessClusterObs:
                         "parent.json", "manifest.json"} <= names
                 b = json.load(tar.extractfile("nodes/node1.json"))
                 assert b["node"] == "node1" and "metrics" in b
+            # -- SLO plane over the REAL control channel (ISSUE 19):
+            # node-stamped slo/history ops, and the relay's merged
+            # cluster verdict with every worker evaluated
+            assert _wait(lambda: all(
+                n.slo()["verdict"] != "no-data" for n in c.nodes),
+                timeout=30)
+            s1 = c.nodes[1].slo()
+            assert s1["node"] == "node1" and s1["ticks"] >= 2
+            assert "serving-availability" in s1["slos"]
+            h0 = c.nodes[0].history(
+                series=["cilium_serving_submitted_total"])
+            assert h0["node"] == "node0"
+            assert h0["series"] == ["cilium_serving_submitted_total"]
+            assert h0["fast"]
+            cs = c.obs.cluster_slo()
+            assert cs["node-count"] == 2
+            assert cs["unreachable"] == []
+            assert all(e["ok"] for e in cs["nodes"].values())
             # -- SIGKILL MID-SCRAPE chaos leg -----------------------
             # (the periodic loop is live — duty-stretched cadence —
             # and the explicit sweep below races the corpse; the
@@ -736,6 +760,16 @@ class TestProcessClusterObs:
             text = c.obs.cluster_metrics()
             assert ('cilium_cluster_node_scrape_ok{node="node1"} 0'
                     in text)
+            # the corpse degrades the merged health verdict NODE-
+            # LABELED: counted unreachable with its error, never
+            # silently dropped from the denominator (the verdict
+            # flip past the staleness bound is pinned deterministic
+            # in test_agent_slo's thread-mode leg)
+            cs = c.obs.cluster_slo()
+            assert "node1" in cs["unreachable"]
+            assert cs["nodes"]["node1"]["ok"] is False
+            assert cs["nodes"]["node1"]["error"]
+            assert cs["nodes"]["node0"]["ok"] is True
             # the router keeps accepting while the corpse is found
             t0 = time.monotonic()
             while not c.membership.dead_nodes():

@@ -284,8 +284,12 @@ class TestSynFloodPressureLeg:
         try:
             r = run_scenario(d, sc)
             assert r["metrics"]["ct_insert_drops"] > 0
+            # wait until the sampler has caught up to the FINAL drop
+            # count — a mid-run sample can satisfy a bare > 0 check
+            # and leave the render one 0.1 s tick stale
             assert _wait(lambda: (d.pressure.last or {}).get(
-                "ct", {}).get("insert-drops", 0) > 0, timeout=10)
+                "ct", {}).get("insert-drops", 0)
+                >= r["metrics"]["ct_insert_drops"], timeout=10)
             prom = d.registry.render()
             assert "cilium_ct_occupancy " in prom
             assert "cilium_ct_insert_drops_total " in prom

@@ -95,6 +95,36 @@ class TestRecorderUnit:
         assert fr.capture(manual=True) is not None
         assert fr.writes_total == 2
 
+    def test_manual_capture_waits_for_inflight_auto(self, tmp_path):
+        """The burn-episode race pin (PR 19): periodic SLO
+        evaluation means an AUTO bundle can be mid-write at any
+        instant — a MANUAL sysdump arriving then must wait for the
+        in-flight capture and write its own bundle, never decline.
+        A racing AUTO capture still declines (counted), and that is
+        correct: its incident is recorded either way."""
+        gate = threading.Event()
+        entered = threading.Event()
+
+        def collect():
+            entered.set()
+            assert gate.wait(10)
+            return _collect_small()
+
+        fr = FlightRecorder(collect, sysdump_dir=str(tmp_path),
+                            min_interval_s=0.0)
+        fr.record_incident("watchdog-restart", {"cause": "slow"})
+        assert entered.wait(10)  # the auto capture is mid-collect
+        skipped0 = fr.captures_skipped
+        assert fr.capture(trigger="watchdog-restart",
+                          manual=False) is None
+        assert fr.captures_skipped == skipped0 + 1
+        # release the in-flight bundle while the manual request is
+        # blocked in its grace-period wait
+        threading.Timer(0.2, gate.set).start()
+        path = fr.capture(manual=True)
+        assert path and os.path.exists(path)
+        assert fr.writes_total == 2
+
     def test_retention_prunes_oldest(self, tmp_path):
         fr = FlightRecorder(_collect_small, sysdump_dir=str(tmp_path),
                             retention=3, min_interval_s=0.0)
